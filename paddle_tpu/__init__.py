@@ -1,0 +1,73 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Brand-new design with the capabilities of the PaddlePaddle reference
+(structural analysis in SURVEY.md): eager define-by-run tensors with a
+`to_static` JIT path, a jax/XLA-lowered op layer, nn/optimizer/amp/io
+training APIs, and mesh-based 4D+ hybrid parallelism over XLA collectives.
+"""
+from __future__ import annotations
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+    finfo, iinfo,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device,
+)
+from .core import flags as _flags  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.generator import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .autograd import grad, no_grad  # noqa: F401
+from .autograd.tape import enable_grad  # noqa: F401
+
+# op namespace: paddle.add / paddle.matmul / ...
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+from .ops.creation import (  # noqa: F401
+    arange, assign, bernoulli, empty, empty_like, eye, full, full_like,
+    linspace, logspace, meshgrid, multinomial, normal, ones, ones_like, rand,
+    randint, randn, randperm, uniform, zeros, zeros_like,
+)
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import vision  # noqa: F401
+from . import incubate  # noqa: F401
+from . import sparse  # noqa: F401
+from . import static  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from .framework import device  # noqa: F401
+
+import paddle_tpu.tensor as tensor  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def is_grad_enabled() -> bool:
+    from .autograd.tape import grad_enabled
+    return grad_enabled()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for the "
+        "compiled path (the ProgramDesc/Executor stack has no TPU analog)")
+
+
+def in_dynamic_mode() -> bool:
+    return True

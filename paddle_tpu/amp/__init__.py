@@ -1,0 +1,190 @@
+"""Automatic mixed precision.
+
+~ python/paddle/amp/ (auto_cast.py:21, grad_scaler.py:26) + the C++ op
+allow/block lists (paddle/fluid/imperative/amp_auto_cast.h:44, AmpLevel O1/O2
+:29). TPU-native difference: the low-precision dtype is bfloat16, which has
+fp32-range exponent — so loss scaling is a no-op by default (GradScaler keeps
+the dynamic-scaling machinery for fp16 compat and API parity, but with bf16
+``use_loss_scaling=False`` paths are exercised).
+
+Mechanism: an AMP state consulted by the op dispatcher (ops/dispatch.py);
+white-listed ops cast float32 inputs down, black-listed ops force float32 —
+the same pre-kernel cast insertion TraceOp does in the reference.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+# ~ imperative/amp_auto_cast.cc AmpOperators default lists
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "einsum", "linear", "conv1d", "conv2d",
+    "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "scaled_dot_product_attention", "addmm",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos",
+    "sin", "tan", "norm", "cross_entropy", "softmax_with_cross_entropy",
+    "bce_with_logits", "binary_cross_entropy", "layer_norm", "rms_norm",
+    "batch_norm", "softmax", "log_softmax", "cumsum", "logsumexp", "erf",
+    "erfinv", "pow", "mse_loss", "l1_loss", "kl_div",
+}
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """~ paddle.amp.auto_cast (amp/auto_cast.py:21)."""
+    if not enable:
+        prev = amp_state()
+        _state.amp = None
+        try:
+            yield
+        finally:
+            _state.amp = prev
+        return
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    prev = amp_state()
+    _state.amp = {
+        "level": level,
+        "dtype": _dt.convert_dtype(dtype),
+        "white": white,
+        "black": black,
+    }
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def _maybe_cast(op_name: str, vals):
+    """Called from ops.dispatch.apply_op on every op when AMP is active."""
+    st = amp_state()
+    if st is None:
+        return vals
+    low = st["dtype"]
+    if op_name in st["white"] or st["level"] == "O2" and op_name not in st["black"]:
+        return [v.astype(low)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32 else v
+                for v in vals]
+    if op_name in st["black"]:
+        return [v.astype(jnp.float32)
+                if hasattr(v, "dtype") and v.dtype == jnp.dtype(low) else v
+                for v in vals]
+    return vals
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """~ paddle.amp.decorate (auto_cast.py:81). O2 casts model params low."""
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for m in ms:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else ms
+    return (models, optimizers)
+
+
+class GradScaler:
+    """~ paddle.amp.GradScaler (grad_scaler.py:26): dynamic loss scaling.
+
+    With bf16 (TPU default) scaling is unnecessary; enabled only when
+    ``enable=True`` and dtype float16 semantics are requested.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _unscale_and_check(self, optimizer):
+        import numpy as np
+        found = False
+        for p in optimizer._parameters:
+            if p._grad is not None:
+                g = p._grad._value / self._scale
+                p._grad = Tensor(g)
+                if not found and not bool(jnp.all(jnp.isfinite(g))):
+                    found = True
+        self._found_inf = found
+        return found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        found = self._unscale_and_check(optimizer)
+        if not found:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_count": self._good,
+                "decr_count": self._bad}
+
+    def load_state_dict(self, st):
+        self._scale = st.get("scale", self._scale)
+        self._good = st.get("incr_count", 0)
+        self._bad = st.get("decr_count", 0)

@@ -1,0 +1,56 @@
+"""Autograd: define-by-run tape + functional transforms.
+
+~ paddle.autograd (python/paddle/autograd/) backed by eager/backward.cc.
+"""
+from .tape import GradNode, backward, enable_grad, grad_enabled, no_grad  # noqa: F401
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad equivalent (python/paddle/fluid/dygraph/base.py grad).
+
+    Computes grads of ``outputs`` wrt ``inputs`` without touching ``.grad``
+    on other leaves. Implemented by running the tape backward on a cloned
+    grad state.
+    """
+    from ..core.tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    # snapshot existing .grad on every reachable leaf so only ``inputs``
+    # observe this backward (paddle.grad does not pollute other .grads)
+    leaves = set()
+    stack = [t._grad_node for t in outputs if t._grad_node is not None]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        for inp in node.inputs:
+            if inp._grad_node is None:
+                leaves.add(inp)
+            else:
+                stack.append(inp._grad_node)
+    input_set = {id(t) for t in inputs}
+    saved = [(t, t._grad) for t in leaves | set(inputs)]
+    for t, _ in saved:
+        t._grad = None
+    backward(outputs, grad_outputs, retain_graph=bool(retain_graph))
+    grads = {id(t): t._grad for t, _ in saved}
+    for t, old in saved:
+        t._grad = old
+    results = []
+    for t in inputs:
+        g = grads.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                f"tensor {t.name} was not used in the graph "
+                "(pass allow_unused=True to return None)")
+        results.append(g)
+    return results

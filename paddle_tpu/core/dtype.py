@@ -1,0 +1,104 @@
+"""Dtype system.
+
+TPU-native equivalent of the reference's ``phi::DataType`` enum and the
+``convert_dtype`` helpers (reference: paddle/phi/common/data_type.h,
+python/paddle/fluid/data_feeder.py convert_dtype). We deliberately reuse
+numpy/jax dtype objects instead of a parallel enum: XLA is the only backend,
+so a wrapper enum would add a translation layer with no benefit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import dtypes as _jax_dtypes
+
+# Canonical dtype aliases (mirror paddle.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_default_dtype = np.dtype(np.float32)
+
+
+def set_default_dtype(d) -> None:
+    """Mirror of paddle.set_default_dtype (python/paddle/framework/framework.py)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (np.dtype(np.float16), np.dtype(jnp.bfloat16), np.dtype(np.float32),
+                 np.dtype(np.float64)):
+        raise TypeError(f"default dtype must be a floating dtype, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize any dtype spec (str / numpy / jax) to a numpy dtype object."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        if d not in _STR_ALIASES:
+            raise TypeError(f"unsupported dtype string: {d!r}")
+        return np.dtype(_STR_ALIASES[d])
+    try:
+        return np.dtype(d)
+    except TypeError as e:
+        raise TypeError(f"cannot interpret {d!r} as a dtype") from e
+
+
+def dtype_name(d) -> str:
+    d = convert_dtype(d)
+    return d.name
+
+
+def is_floating_point(d) -> bool:
+    d = convert_dtype(d)
+    return _jax_dtypes.issubdtype(d, np.inexact)
+
+
+def is_integer(d) -> bool:
+    d = convert_dtype(d)
+    return _jax_dtypes.issubdtype(d, np.integer)
+
+
+def is_bool(d) -> bool:
+    return convert_dtype(d) == np.dtype(np.bool_)
+
+
+def is_complex(d) -> bool:
+    d = convert_dtype(d)
+    return _jax_dtypes.issubdtype(d, np.complexfloating)
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))

@@ -1,0 +1,40 @@
+"""Error enforcement utilities.
+
+Equivalent of the reference's PADDLE_ENFORCE macro family
+(paddle/phi/core/enforce.h): rich error types with actionable messages.
+Python exceptions already carry tracebacks, so this is a thin layer that
+standardizes error classes and input validation helpers.
+"""
+from __future__ import annotations
+
+from . import dtype as _dtype_mod
+
+
+class EnforceNotMet(RuntimeError):
+    """Raised when an internal invariant fails (phi/core/enforce.h)."""
+
+
+def enforce(cond: bool, msg: str = "enforce failed", *args) -> None:
+    if not cond:
+        raise EnforceNotMet(msg % args if args else msg)
+
+
+def check_type(value, name: str, expected_types, op_name: str) -> None:
+    if not isinstance(value, expected_types):
+        raise TypeError(
+            f"{op_name}(): argument '{name}' must be {expected_types}, "
+            f"got {type(value).__name__}")
+
+
+def check_dtype(d, name: str, allowed, op_name: str) -> None:
+    d = _dtype_mod.convert_dtype(d)
+    allowed_np = [_dtype_mod.convert_dtype(a) for a in allowed]
+    if d not in allowed_np:
+        raise TypeError(
+            f"{op_name}(): argument '{name}' has dtype {d.name}, expected one of "
+            f"{[a.name for a in allowed_np]}")
+
+
+def check_shape_match(a, b, op_name: str) -> None:
+    if tuple(a) != tuple(b):
+        raise ValueError(f"{op_name}(): shape mismatch {tuple(a)} vs {tuple(b)}")

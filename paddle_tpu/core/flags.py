@@ -1,0 +1,71 @@
+"""Typed global flag system.
+
+Equivalent of the reference's exported gflags (paddle/fluid/platform/flags.cc,
+surfaced in Python via pybind/global_value_getter_setter.cc and env
+``FLAGS_*`` passthrough in python/paddle/fluid/__init__.py __bootstrap__).
+One registry, typed defaults, environment override at definition time.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "type", "help")
+
+    def __init__(self, name: str, value: Any, typ: type, help: str):
+        self.name = name
+        self.value = value
+        self.type = typ
+        self.help = help
+
+
+def _coerce(typ: type, raw: Any) -> Any:
+    if typ is bool and isinstance(raw, str):
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "", typ: type | None = None) -> None:
+    typ = typ or type(default)
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(typ, env) if env is not None else default
+    with _lock:
+        _registry[name] = _Flag(name, value, typ, help)
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    with _lock:
+        if names is None:
+            return {k: f.value for k, f in _registry.items()}
+        if isinstance(names, str):
+            names = [names]
+        return {n: _registry[n].value for n in names}
+
+
+def get_flag(name: str) -> Any:
+    with _lock:
+        return _registry[name].value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise KeyError(f"unknown flag {name!r}")
+            f = _registry[name]
+            f.value = _coerce(f.type, value)
+
+
+# Core flags (subset of platform/flags.cc that is meaningful on TPU).
+define_flag("check_nan_inf", False, "scan op outputs for nan/inf after each eager op")
+define_flag("benchmark", False, "block-until-ready after each eager op for timing")
+define_flag("eager_delete_tensor_gb", 0.0, "kept for API compat; XLA manages memory")
+define_flag("use_autotune", True, "enable XLA autotuning knobs where applicable")
+define_flag("low_precision_op_list", "", "comma list of ops forced to bf16 under amp")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")

@@ -1,0 +1,78 @@
+"""RNG generator with (seed, offset) semantics.
+
+Equivalent of the reference's phi::Generator (paddle/phi/core/generator.h:23):
+per-device generator state = a 64-bit seed plus a monotonically increasing
+offset. On TPU this maps naturally onto jax's counter-based PRNG: each random
+op consumes ``fold_in(PRNGKey(seed), offset++)`` so results are reproducible
+given (seed, offset) and independent across calls — the same contract the
+reference's Philox offset gives CUDA kernels.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    """Stateful RNG source. Mirrors phi::Generator::GetState/SetState/Random64."""
+
+    def __init__(self, seed: int | None = None):
+        self._lock = threading.Lock()
+        if seed is None:
+            seed = int(time.time_ns() % (2**63))
+        self._seed = int(seed)
+        self._offset = 0
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = int(seed)
+            self._offset = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        with self._lock:
+            return (self._seed, self._offset)
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed, self._offset = int(state[0]), int(state[1])
+
+    def next_key(self) -> jax.Array:
+        """Consume one offset tick and return a fresh PRNG key."""
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), off)
+
+    def split(self, n: int):
+        return jax.random.split(self.next_key(), n)
+
+
+_default_generator = Generator(seed=0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed equivalent (python/paddle/framework/random.py)."""
+    return _default_generator.manual_seed(int(s))
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    _default_generator.set_state(state)
+
+
+def next_key() -> jax.Array:
+    return _default_generator.next_key()

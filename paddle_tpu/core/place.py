@@ -1,0 +1,110 @@
+"""Device / place abstraction.
+
+TPU-native equivalent of the reference's ``phi::Place`` hierarchy
+(paddle/phi/common/place.h) and ``paddle.device.set_device``
+(python/paddle/device/__init__.py). A Place is a thin view over a
+``jax.Device``; there are no streams to manage — XLA owns scheduling.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+class Place:
+    """Base place. Mirrors phi::Place (paddle/phi/common/place.h)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = _devices_of_type(self.device_type)
+        if self.device_id >= len(devs):
+            raise ValueError(
+                f"device {self.device_type}:{self.device_id} out of range "
+                f"({len(devs)} present)")
+        return devs[self.device_id]
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_of_type(kind: str):
+    all_devs = jax.devices()
+    if kind == "cpu":
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return all_devs
+    # treat the default accelerator platform as "tpu" regardless of the
+    # backend's self-reported platform string (axon tunnels report 'axon')
+    accel = [d for d in all_devs if d.platform != "cpu"]
+    return accel or all_devs
+
+
+def _parse(device: str) -> Place:
+    device = device.lower()
+    if ":" in device:
+        kind, idx = device.split(":", 1)
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    if kind in ("cpu",):
+        return CPUPlace()
+    if kind in ("tpu", "xla", "gpu"):  # accept 'gpu' for script compat
+        return TPUPlace(idx)
+    raise ValueError(f"unknown device {device!r}")
+
+
+def set_device(device) -> Place:
+    """paddle.device.set_device equivalent."""
+    place = device if isinstance(device, Place) else _parse(device)
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    p = _current_expected_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def _current_expected_place() -> Place:
+    p = getattr(_state, "place", None)
+    if p is None:
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        p = TPUPlace(0) if accel else CPUPlace()
+        _state.place = p
+    return p
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    return len(_devices_of_type(_current_expected_place().device_type))

@@ -1,0 +1,248 @@
+"""Eager Tensor.
+
+TPU-native equivalent of the reference's eager ``paddle::experimental::Tensor``
+(paddle/phi/api/include/tensor.h) + AutogradMeta (paddle/fluid/eager/
+autograd_meta.h): a thin Python object holding an immutable ``jax.Array``
+value plus autograd metadata (stop_gradient, grad, producer GradNode).
+
+There is no DenseTensor/storage split here: jax.Array already is the
+device-resident, sharding-aware storage (the DenseTensor + Allocation roles),
+and XLA owns layout — so the C++-side storage hierarchy collapses to one
+field. Mutation APIs (``__setitem__`` etc.) rebind the value functionally.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from ..autograd import tape as _tape
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node",
+                 "_output_index", "name", "persistable", "__weakref__",
+                 "__dict__")
+
+    _next_id = 0
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._output_index = 0
+        if name is None:
+            name = f"tensor_{Tensor._next_id}"
+            Tensor._next_id += 1
+        self.name = name
+        self.persistable = False
+
+    # ---- basic properties -------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        from . import place as _place
+        devs = self._value.devices() if hasattr(self._value, "devices") else set()
+        d = next(iter(devs)) if devs else None
+        if d is None or d.platform == "cpu":
+            return _place.CPUPlace()
+        return _place.TPUPlace(d.id)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = None if g is None else (g if isinstance(g, Tensor) else Tensor(g))
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    # ---- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def requires_grad_(self, flag: bool = True) -> "Tensor":
+        self.stop_gradient = not flag
+        return self
+
+    # ---- conversion -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dt) -> "Tensor":
+        from ..ops import dispatch as _d
+        dt = dtypes.convert_dtype(dt)
+        return _d.apply_op("cast", lambda x: x.astype(dt), self)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        # minimal: dtype-only or device string
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu") or ":" in str(a):
+                from . import place as _place
+                p = _place._parse(str(a))
+                return Tensor(jax.device_put(self._value, p.jax_device),
+                              stop_gradient=self.stop_gradient)
+            return self.astype(a)
+        return self
+
+    def cpu(self):
+        from . import place as _place
+        return Tensor(jax.device_put(self._value, _place.CPUPlace().jax_device),
+                      stop_gradient=self.stop_gradient)
+
+    def clone(self) -> "Tensor":
+        from ..ops import dispatch as _d
+        return _d.apply_op("clone", lambda x: x + 0, self)
+
+    def block_until_ready(self) -> "Tensor":
+        jax.block_until_ready(self._value)
+        return self
+
+    # ---- in-place-style mutation (functional rebind) ----------------------
+    def set_value(self, value) -> None:
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch {value.shape} vs {self._value.shape}")
+        self._value = value
+
+    def copy_(self, other, *a) -> "Tensor":
+        self.set_value(other)
+        return self
+
+    def fill_(self, v) -> "Tensor":
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def scale_(self, s) -> "Tensor":
+        self._value = self._value * s
+        return self
+
+    def add_(self, other) -> "Tensor":
+        self._value = self._value + (other._value if isinstance(other, Tensor) else other)
+        return self
+
+    # ---- misc -------------------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_flag = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_flag},\n       {np.asarray(self._value)!r})")
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __format__(self, spec):
+        return format(self.item() if self.size == 1 else np.asarray(self._value), spec)
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic dunders are attached by paddle_tpu.ops.tensor_methods
+
+
+class Parameter(Tensor):
+    """Trainable tensor. ~ paddle.fluid.framework.Parameter / EagerParamBase
+    (python/paddle/fluid/framework.py:6574)."""
+
+    def __init__(self, value, trainable: bool = True, name: str | None = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        # optional sharding annotation for GSPMD parallelism
+        # (set by paddle_tpu.distributed parallel layers)
+        self.sharding_spec = None
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, flag: bool):
+        self.stop_gradient = not flag
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor equivalent (python/paddle/tensor/creation.py:77)."""
+    if isinstance(data, Tensor):
+        val = data._value
+    else:
+        val = data
+    if dtype is not None:
+        val = jnp.asarray(val, dtype=dtypes.convert_dtype(dtype))
+    else:
+        arr = np.asarray(val) if not isinstance(val, jax.Array) else val
+        if isinstance(arr, np.ndarray) and arr.dtype == np.float64:
+            # follow paddle: python floats default to the default dtype
+            val = jnp.asarray(arr, dtype=dtypes.get_default_dtype())
+        else:
+            val = jnp.asarray(val)
+    if place is not None:
+        from . import place as _place
+        p = place if isinstance(place, _place.Place) else _place._parse(str(place))
+        val = jax.device_put(val, p.jax_device)
+    return Tensor(val, stop_gradient=stop_gradient)

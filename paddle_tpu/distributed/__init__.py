@@ -1,0 +1,56 @@
+"""paddle_tpu.distributed.
+
+~ python/paddle/distributed/: collective API, fleet facade, hybrid topology,
+parallel layers, launch. See SURVEY.md §2.2/2.3/2.5 for the reference map.
+"""
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+    destroy_process_group, get_group, new_group, recv, reduce, scatter, send,
+    split, wait,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelGroup, build_mesh,
+    get_global_mesh, get_hybrid_communicate_group, set_global_mesh,
+    set_hybrid_communicate_group,
+)
+from . import fleet  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """~ paddle.distributed.spawn (distributed/spawn.py) — multiprocessing
+    entry for same-host multi-process runs (one process per simulated rank;
+    CPU backend). Each child gets the PADDLE_* env contract."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_WORLD_SIZE", "1"))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_GLOBAL_RANK": str(rank),
+               "PADDLE_WORLD_SIZE": str(nprocs),
+               "PADDLE_LOCAL_RANK": str(rank)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned rank failed: exit {p.exitcode}")
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    import os
+    os.environ.update(env)
+    func(*args)

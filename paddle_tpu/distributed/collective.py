@@ -1,0 +1,228 @@
+"""Eager collective API.
+
+~ python/paddle/distributed/collective.py (all_reduce:592, broadcast:506,
+all_gather:814, scatter:914, alltoall:1738, send/recv, barrier:277) and the
+ProcessGroup stack it sits on (distributed/collective/ProcessGroup.h:53).
+
+TPU-native design: there are no comm streams or reducers. Two regimes:
+  * multi-process (a real pod/slice): host-level collectives via
+    jax.experimental.multihost_utils (rendezvous through the coordinator).
+    These are the *eager* semantics for script-level sync — the perf path is
+    always compiled psum/all_gather inside pjit programs.
+  * single process: groups degenerate to identity (world_size 1) — matching
+    the reference where collectives on a 1-rank group are no-ops.
+
+ReduceOp / group objects keep the reference API surface.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import env as _env
+from .topology import ParallelGroup
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_groups = {}
+_group_counter = 0
+
+
+def _default_group() -> ParallelGroup:
+    if 0 not in _groups:
+        world = _env.get_world_size()
+        _groups[0] = ParallelGroup(list(range(world)), _env.get_rank(),
+                                   "data", 0)
+    return _groups[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> ParallelGroup:
+    """~ collective.py new_group:325."""
+    global _group_counter
+    _group_counter += 1
+    if ranks is None:
+        ranks = list(range(_env.get_world_size()))
+    g = ParallelGroup(list(ranks), _env.get_rank(), "custom", _group_counter)
+    _groups[_group_counter] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[ParallelGroup]:
+    return _groups.get(gid)
+
+
+def is_initialized() -> bool:
+    return _env.is_initialized()
+
+
+def _multi_process() -> bool:
+    return jax.process_count() > 1
+
+
+def _allgather_host(arr):
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(arr, tiled=False)
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """~ collective.py all_reduce:592 — in-place on the Tensor."""
+    group = group or _default_group()
+    if group.nranks <= 1 or not _multi_process():
+        if op == ReduceOp.AVG:
+            pass
+        return tensor
+    gathered = _allgather_host(tensor._value)  # (world, ...)
+    sub = gathered[np.asarray(group.ranks)]
+    if op == ReduceOp.SUM:
+        out = jnp.sum(sub, axis=0)
+    elif op == ReduceOp.MAX:
+        out = jnp.max(sub, axis=0)
+    elif op == ReduceOp.MIN:
+        out = jnp.min(sub, axis=0)
+    elif op == ReduceOp.PROD:
+        out = jnp.prod(sub, axis=0)
+    else:
+        out = jnp.mean(sub, axis=0)
+    tensor._value = out.astype(tensor._value.dtype)
+    return tensor
+
+
+def broadcast(tensor: Tensor, src: int, group=None, sync_op=True):
+    group = group or _default_group()
+    if group.nranks <= 1 or not _multi_process():
+        return tensor
+    gathered = _allgather_host(tensor._value)
+    tensor._value = jnp.asarray(gathered[src])
+    return tensor
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
+    """~ collective.py all_gather:814."""
+    group = group or _default_group()
+    if group.nranks <= 1 or not _multi_process():
+        tensor_list.extend([Tensor(tensor._value)
+                            for _ in range(max(group.nranks, 1))])
+        return tensor_list
+    gathered = _allgather_host(tensor._value)
+    for r in group.ranks:
+        tensor_list.append(Tensor(jnp.asarray(gathered[r])))
+    return tensor_list
+
+
+def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group=None,
+           sync_op=True):
+    group = group or _default_group()
+    all_reduce(tensor, op=op, group=group)
+    return tensor
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _default_group()
+    if group.nranks <= 1 or not _multi_process():
+        if tensor_list:
+            tensor._value = tensor_list[0]._value
+        return tensor
+    me = group.rank
+    if tensor_list is not None:
+        stacked = jnp.stack([t._value for t in tensor_list])
+    else:
+        stacked = jnp.zeros((group.nranks,) + tuple(tensor.shape),
+                            tensor._value.dtype)
+    gathered = _allgather_host(stacked)  # (world, n, ...)
+    tensor._value = jnp.asarray(gathered[src][me])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    """~ collective.py alltoall:1738 (the MoE global_scatter substrate)."""
+    group = group or _default_group()
+    if group.nranks <= 1 or not _multi_process():
+        out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+        return out_tensor_list
+    stacked = jnp.stack([t._value for t in in_tensor_list])
+    gathered = _allgather_host(stacked)  # (world, n, ...)
+    me = group.rank
+    for r in group.ranks:
+        out_tensor_list.append(Tensor(jnp.asarray(gathered[r][me])))
+    return out_tensor_list
+
+
+def send(tensor: Tensor, dst: int, group=None, sync_op=True):
+    """p2p via gather (host rendezvous) — eager-mode only; compiled paths use
+    ppermute inside jit (see parallel/pipeline)."""
+    group = group or _default_group()
+    if not _multi_process():
+        _p2p_buffer.append(tensor._value)
+        return tensor
+    _allgather_host(tensor._value)
+    return tensor
+
+
+_p2p_buffer: list = []
+
+
+def recv(tensor: Tensor, src: int, group=None, sync_op=True):
+    group = group or _default_group()
+    if not _multi_process():
+        if _p2p_buffer:
+            tensor._value = _p2p_buffer.pop(0)
+        return tensor
+    gathered = _allgather_host(tensor._value)
+    tensor._value = jnp.asarray(gathered[src])
+    return tensor
+
+
+def barrier(group=None):
+    """~ collective.py barrier:277."""
+    if _multi_process():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor: Tensor, group=None, use_calc_stream=True):
+    """~ collective.py wait:440 — XLA has no user streams; block instead."""
+    jax.block_until_ready(tensor._value)
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return _env.get_rank()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return _env.get_world_size()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+# ---- compiled collective helpers (the perf path) ---------------------------
+def psum_in_jit(x, axis_name: str):
+    """For use inside shard_map/pjit programs."""
+    return jax.lax.psum(x, axis_name)
+
+
+def split(x, num_partitions, rank=None, axis=0):
+    """~ paddle.distributed.split (collective.py:1525) static helper."""
+    rank = rank if rank is not None else _env.get_rank()
+    from ..ops.manipulation import split as _split
+    parts = _split(x, num_partitions, axis)
+    return parts[rank % num_partitions]

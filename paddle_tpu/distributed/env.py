@@ -1,0 +1,101 @@
+"""Distributed environment: rank/world discovery + runtime init.
+
+~ python/paddle/distributed/parallel.py (init_parallel_env:91, ParallelEnv)
+and the launch env contract (launch/controllers/collective.py:83-91).
+TPU-native rendezvous: ``jax.distributed.initialize`` (coordinator service)
+replaces TCPStore + NCCL unique-id exchange.
+
+Env contract (compatible naming):
+  PADDLE_MASTER / PADDLE_COORDINATOR : "host:port" coordinator address
+  PADDLE_GLOBAL_RANK | PADDLE_TRAINER_ID : process index
+  PADDLE_WORLD_SIZE | PADDLE_TRAINERS_NUM : process count
+  PADDLE_LOCAL_RANK : local process index
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_initialized = False
+
+
+def _env_int(*names, default=0):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return int(v)
+    return default
+
+
+def get_rank() -> int:
+    if _initialized or jax.process_count() > 1:
+        return jax.process_index()
+    return _env_int("PADDLE_GLOBAL_RANK", "PADDLE_TRAINER_ID", default=0)
+
+
+def get_world_size() -> int:
+    if _initialized or jax.process_count() > 1:
+        return jax.process_count()
+    return _env_int("PADDLE_WORLD_SIZE", "PADDLE_TRAINERS_NUM", default=1)
+
+
+def get_local_rank() -> int:
+    return _env_int("PADDLE_LOCAL_RANK", default=0)
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env():
+    """~ paddle.distributed.init_parallel_env (parallel.py:91).
+
+    Multi-process: connects to the coordinator (jax.distributed.initialize).
+    Single-process: no-op — the mesh over local devices is the parallel env.
+    """
+    global _initialized
+    with _lock:
+        if _initialized:
+            return ParallelEnv()
+        coord = os.environ.get("PADDLE_MASTER") or \
+            os.environ.get("PADDLE_COORDINATOR")
+        world = _env_int("PADDLE_WORLD_SIZE", "PADDLE_TRAINERS_NUM", default=1)
+        if coord and world > 1:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=world,
+                process_id=_env_int("PADDLE_GLOBAL_RANK", "PADDLE_TRAINER_ID",
+                                    default=0))
+        _initialized = True
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    """~ parallel.py ParallelEnv — env view object."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_local_rank()
+
+    @property
+    def dev_id(self):
+        return get_local_rank()
+
+    @property
+    def device_type(self):
+        return "tpu"
+
+    @property
+    def nranks(self):
+        return get_world_size()

@@ -1,0 +1,14 @@
+"""Fleet facade.
+
+~ python/paddle/distributed/fleet/base/fleet_base.py:139 (fleet.init,
+distributed_model:937, distributed_optimizer:880) + DistributedStrategy.
+"""
+from __future__ import annotations
+
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    init, is_first_worker, worker_index, worker_num,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401

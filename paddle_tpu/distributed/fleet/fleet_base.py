@@ -1,0 +1,129 @@
+"""Fleet singleton: init / distributed_model / distributed_optimizer.
+
+~ fleet/base/fleet_base.py:139,206,880,937,1443.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer.layers import Layer
+from .. import env as _env
+from ..parallel import DataParallel
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group as _get_hcg)
+from .distributed_strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_collective = True
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        """~ fleet_base.py init:206."""
+        self._strategy = strategy or DistributedStrategy()
+        self._is_collective = is_collective
+        _env.init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        dims = {"data": hc.dp_degree, "pipe": hc.pp_degree,
+                "sharding": hc.sharding_degree, "sep": hc.get("sep_degree", 1),
+                "model": hc.mp_degree}
+        # fill dp automatically to consume the world (reference behavior)
+        world = _env.get_world_size()
+        import numpy as np
+        known = int(np.prod([v for k, v in dims.items() if k != "data"]))
+        if dims["data"] * known != world and world % known == 0 and world > 1:
+            dims["data"] = world // known
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"],
+            [dims["data"], dims["pipe"], dims["sharding"], dims["sep"],
+             dims["model"]])
+        if topo.world_size() == world or world == 1:
+            self._hcg = HybridCommunicateGroup(topo)
+            set_hybrid_communicate_group(self._hcg)
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg or _get_hcg()
+
+    @property
+    def worker_index(self):
+        return _env.get_rank()
+
+    @property
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def barrier_worker(self):
+        from .. import collective as C
+        C.barrier()
+
+    def distributed_model(self, model: Layer):
+        """~ fleet_base.py distributed_model:937 — wrapper selection."""
+        hcg = self.get_hybrid_communicate_group()
+        strategy = self._strategy or DistributedStrategy()
+        if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+            return PipelineParallel(model, hcg, strategy)
+        if hcg is not None and hcg.get_model_parallel_world_size() > 1:
+            from .meta_parallel.tensor_parallel import TensorParallel
+            return TensorParallel(model, hcg, strategy)
+        if _env.get_world_size() > 1 or (
+                hcg and hcg.get_data_parallel_world_size() > 1):
+            return DataParallel(model,
+                                group=hcg.get_data_parallel_group()
+                                if hcg else None)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """~ fleet_base.py distributed_optimizer:880."""
+        if strategy is not None:
+            self._strategy = strategy
+        hcg = self.get_hybrid_communicate_group()
+        if hcg is not None and (hcg.get_model_parallel_world_size() > 1
+                                or hcg.get_pipe_parallel_world_size() > 1):
+            from .meta_parallel.hybrid_parallel_optimizer import (
+                HybridParallelOptimizer)
+            return HybridParallelOptimizer(optimizer, hcg,
+                                           self._strategy
+                                           or DistributedStrategy())
+        return optimizer
+
+    def state_dict(self):
+        return {}
+
+
+fleet = Fleet()
+
+# module-level facade (paddle.distributed.fleet.init style)
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()
+
+
+def worker_index():
+    return fleet.worker_index
+
+
+def worker_num():
+    return fleet.worker_num
+
+
+def is_first_worker():
+    return fleet.is_first_worker()

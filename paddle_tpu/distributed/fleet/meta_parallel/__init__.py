@@ -1,0 +1,13 @@
+"""meta_parallel: parallel wrappers + parallel layers.
+
+~ python/paddle/distributed/fleet/meta_parallel/.
+"""
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, LayerDesc, ParallelCrossEntropy, PipelineLayer,
+    RNGStatesTracker, RowParallelLinear, SegmentLayers, SharedLayerDesc,
+    VocabParallelEmbedding, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
+from .sharding_parallel import ShardingParallel  # noqa: F401

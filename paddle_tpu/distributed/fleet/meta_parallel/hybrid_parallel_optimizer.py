@@ -1,0 +1,80 @@
+"""HybridParallelOptimizer + grad clip across groups.
+
+~ fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py:45,170:
+wraps the inner optimizer; global-norm grad clip must reduce the squared
+norm across mp/pp/sharding groups (HybridParallelClipGrad:45). In compiled
+GSPMD execution norms over annotated params are already global; the eager
+multi-process path all-reduces the partial norms here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....nn import ClipGradByGlobalNorm
+from ... import collective as C
+
+
+class HybridParallelClipGrad:
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        # sum partial norms across model-parallel group (eager multi-proc)
+        from ....core.tensor import Tensor
+        t = Tensor(sq)
+        if self._hcg.get_model_parallel_world_size() > 1:
+            C.all_reduce(t, group=self._hcg.get_model_parallel_group())
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            C.all_reduce(t, group=self._hcg.get_pipe_parallel_group())
+        gn = jnp.sqrt(t._value)
+        scale = jnp.minimum(1.0, self._clip.clip_norm / jnp.maximum(gn, 1e-12))
+        return [(g * scale).astype(g.dtype) for g in grads]
+
+
+class HybridParallelOptimizer:
+    """~ hybrid_parallel_optimizer.py:170."""
+
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        clip = optimizer._grad_clip
+        if isinstance(clip, ClipGradByGlobalNorm):
+            self._hybrid_clip = HybridParallelClipGrad(clip, hcg)
+            optimizer._grad_clip = None
+        else:
+            self._hybrid_clip = None
+
+    def _sync_dp_grads(self):
+        dp_group = self._hcg.get_data_parallel_group()
+        if dp_group.nranks > 1:
+            for p in self._inner._parameters:
+                if p._grad is not None:
+                    C.all_reduce(p._grad, group=dp_group)
+                    p._grad._value = p._grad._value / dp_group.nranks
+
+    def step(self):
+        self._sync_dp_grads()
+        if self._hybrid_clip is not None:
+            params = [p for p in self._inner._parameters
+                      if p.trainable and p._grad is not None]
+            grads = [p._grad._value for p in params]
+            clipped = self._hybrid_clip(params, grads)
+            from ....core.tensor import Tensor
+            for p, g in zip(params, clipped):
+                p._grad = Tensor(g)
+        self._inner.step()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

@@ -1,0 +1,213 @@
+"""Pipeline layer description + segmentation.
+
+~ fleet/meta_parallel/parallel_layers/pp_layers.py: LayerDesc:58,
+SharedLayerDesc:76, SegmentLayers:90, PipelineLayer:159
+(_segment_network:314, shared-weight handling :295).
+
+The description/segmentation API is preserved verbatim; execution differs:
+on TPU the stages run either (a) eagerly on one device (this class builds
+only the local stage's layers when an hcg with pp>1 is installed), or
+(b) compiled, where paddle_tpu.parallel.pipeline stacks homogeneous stage
+params and scans with ppermute transfers over the 'pipe' mesh axis.
+"""
+from __future__ import annotations
+
+import math
+import re
+from functools import partial
+from typing import List
+
+from .....nn.layer.layers import Layer, LayerList, Sequential
+from .... import topology as _topo
+
+
+class LayerDesc:
+    """~ pp_layers.py:58."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("layer_cls must be a paddle_tpu.nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """~ pp_layers.py:76 — layers shared across stages (tied embeddings)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """~ pp_layers.py:90 — split N layer descs into num_parts stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        if self.num_items < self.num_parts:
+            raise ValueError("layer number should be greater than num_parts")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment by named layer occurrences (e.g. "layer:DecoderLayer")
+            name = self.method.split(":", 1)[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                cls = d.layer_cls if isinstance(d, LayerDesc) else type(d)
+                if re.search(name, cls.__name__):
+                    weights[i] = 1
+            total = sum(weights)
+            if total % self.num_parts != 0:
+                raise ValueError(
+                    f"{total} '{name}' layers not divisible into "
+                    f"{self.num_parts} stages")
+            per = total // self.num_parts
+            result = [0]
+            seen = 0
+            for i, w in enumerate(weights):
+                seen += w
+                if seen == per and len(result) < self.num_parts:
+                    result.append(i + 1)
+                    seen = 0
+            result.append(len(weights))
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """~ pp_layers.py:159."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topo = topology
+        hcg = _topo.get_hybrid_communicate_group()
+        if num_stages is None and hcg is not None:
+            num_stages = hcg.get_pipe_parallel_world_size()
+        self._num_stages = num_stages or 1
+        self._stage_id = hcg.get_stage_id() if hcg else 0
+
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, self._num_stages, seg_method).do_segment()
+        self._start = self.segment_parts[self._stage_id]
+        self._end = self.segment_parts[self._stage_id + 1]
+
+        self.shared_layers = {}
+        self.shared_weight_attrs = {}
+        self._build_layer()
+
+    # -- construction -------------------------------------------------------
+    def _build_layer(self):
+        run_funcs = []
+        local = []
+        for i, d in enumerate(self._layers_desc):
+            in_local = self._start <= i < self._end
+            if isinstance(d, SharedLayerDesc):
+                # build shared layers everywhere they appear (weights tied)
+                if d.layer_name not in self.shared_layers:
+                    self.shared_layers[d.layer_name] = d.build_layer()
+                    self.shared_weight_attrs[d.layer_name] = \
+                        d.shared_weight_attr
+                    self.add_sublayer(f"shared_{d.layer_name}",
+                                      self.shared_layers[d.layer_name])
+                if in_local:
+                    layer = self.shared_layers[d.layer_name]
+                    if d.forward_func is None:
+                        run_funcs.append(layer)
+                    else:
+                        run_funcs.append(partial(d.forward_func, layer))
+            elif isinstance(d, LayerDesc):
+                if in_local:
+                    layer = d.build_layer()
+                    local.append(layer)
+                    run_funcs.append(layer)
+            else:  # plain Layer or callable
+                if in_local:
+                    if isinstance(d, Layer):
+                        local.append(d)
+                    run_funcs.append(d)
+        self.run_function = run_funcs
+        self._local_layers = LayerList(
+            [l for l in local if isinstance(l, Layer)])
+
+    def get_stage_from_index(self, layer_idx) -> int:
+        for stage in range(self._num_stages):
+            if (self.segment_parts[stage] <= layer_idx
+                    < self.segment_parts[stage + 1]):
+                return stage
+        raise ValueError("layer_idx out of range")
+
+    @property
+    def parameters_desc(self):
+        return self._layers_desc
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def allreduce_shared_weight_gradients(self):
+        """~ pp_layers.py:295 — tied-weight grad sync across stages. In
+        compiled GSPMD execution shared weights are one logical tensor, so
+        grads are already combined; eager multi-process mode syncs here."""
+        from .... import collective as C
+        for name, layer in self.shared_layers.items():
+            attr = self.shared_weight_attrs[name]
+            p = getattr(layer, attr, None)
+            if p is not None and p._grad is not None:
+                C.all_reduce(p._grad)
+
+    def forward(self, input, chunk_id=None):
+        out = input
+        for fn in self.run_function:
+            if isinstance(out, tuple):
+                out = fn(*out)
+            else:
+                out = fn(out)
+        return out
+
+    def forward_full(self, input):
+        """Run ALL stages (single-program GSPMD mode)."""
+        out = input
+        built = getattr(self, "_full_layers", None)
+        if built is None:
+            built = []
+            for d in self._layers_desc:
+                if isinstance(d, SharedLayerDesc):
+                    layer = self.shared_layers[d.layer_name]
+                    built.append(layer if d.forward_func is None
+                                 else partial(d.forward_func, layer))
+                elif isinstance(d, LayerDesc):
+                    built.append(d.build_layer())
+                else:
+                    built.append(d)
+            self._full_layers = built
+        for fn in built:
+            out = fn(*out) if isinstance(out, tuple) else fn(out)
+        return out

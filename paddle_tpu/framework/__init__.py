@@ -1,0 +1,3 @@
+"""Framework-level utilities: save/load, device namespace, random."""
+from . import io  # noqa: F401
+from . import device  # noqa: F401

@@ -1,0 +1,9 @@
+"""paddle.device namespace equivalent (python/paddle/device/__init__.py)."""
+from ..core.place import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_tpu,
+    set_device,
+)
+
+
+def cuda_device_count() -> int:  # API-compat shim: "cuda" means accelerator
+    return device_count()

@@ -1,0 +1,59 @@
+"""Serialization: paddle.save / paddle.load equivalent.
+
+~ python/paddle/framework/io.py:572,788 — pickle nested state dicts with
+tensors converted to numpy. Sharded/async distributed checkpointing lives in
+paddle_tpu.distributed.checkpoint (orbax-backed); this is the single-host
+object-pickle path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+def _to_serializable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient,
+                "param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj: Any, return_numpy: bool = False) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("param") else Tensor
+            if cls is Parameter:
+                return Parameter(obj["data"],
+                                 trainable=not obj["stop_gradient"])
+            return Tensor(obj["data"], stop_gradient=obj["stop_gradient"])
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj, return_numpy=return_numpy)

@@ -1,0 +1,8 @@
+"""paddle_tpu.incubate — experimental features.
+
+~ python/paddle/incubate/ (fused transformer layers, MoE, functional
+autograd). Fused layers route to the Pallas kernels; MoE lives in
+incubate.distributed.models.moe mirroring the reference layout.
+"""
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,96 @@
+"""Functional autograd transforms.
+
+~ python/paddle/incubate/autograd/ (jacobian/hessian/vjp/jvp). These map
+1:1 onto jax transforms over Tensor-valued functions.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core.tensor import Tensor
+
+
+def _fn_on_arrays(func):
+    def f(*arrays):
+        t_args = [Tensor(a) for a in arrays]
+        out = func(*t_args)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+    return f
+
+
+def _vals(xs):
+    if isinstance(xs, Tensor):
+        return (xs._value,), True
+    return tuple(x._value for x in xs), False
+
+
+def vjp(func, xs, v=None):
+    vals, single = _vals(xs)
+    out, pullback = jax.vjp(_fn_on_arrays(func), *vals)
+    if v is None:
+        import jax.numpy as jnp
+        seed = jnp.ones_like(out) if not isinstance(out, tuple) \
+            else tuple(jnp.ones_like(o) for o in out)
+    else:
+        seed = v._value if isinstance(v, Tensor) else \
+            tuple(t._value for t in v)
+    grads = pullback(seed)
+    outs = Tensor(out) if not isinstance(out, tuple) \
+        else tuple(Tensor(o) for o in out)
+    gs = [Tensor(g) for g in grads]
+    return outs, gs[0] if single else gs
+
+
+def jvp(func, xs, v=None):
+    vals, single = _vals(xs)
+    import jax.numpy as jnp
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        tangents = (v._value,) if isinstance(v, Tensor) else \
+            tuple(t._value for t in v)
+    out, jv = jax.jvp(_fn_on_arrays(func), vals, tangents)
+    outs = Tensor(out) if not isinstance(out, tuple) \
+        else tuple(Tensor(o) for o in out)
+    return outs, Tensor(jv) if not isinstance(jv, tuple) \
+        else tuple(Tensor(j) for j in jv)
+
+
+class Jacobian:
+    """~ incubate/autograd/functional.py Jacobian — lazy J[i][j] view."""
+
+    def __init__(self, func, xs, is_batched=False):
+        vals, single = _vals(xs)
+        f = _fn_on_arrays(func)
+        self._jac = (jax.jacrev(f, argnums=tuple(range(len(vals))))(*vals))
+        if single:
+            self._jac = self._jac[0]
+
+    def __getitem__(self, idx):
+        import numpy as np
+        return Tensor(np.asarray(self._jac)[idx])
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        vals, single = _vals(xs)
+        f = _fn_on_arrays(func)
+        self._h = jax.hessian(f)(*vals)
+
+    def __getitem__(self, idx):
+        import numpy as np
+        return Tensor(np.asarray(self._h)[idx])
+
+
+def jacobian(func, xs, create_graph=False):
+    return Jacobian(func, xs)
+
+
+def hessian(func, xs, create_graph=False):
+    return Hessian(func, xs)

@@ -1,0 +1,100 @@
+"""Fused transformer layers.
+
+~ python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention:39, FusedFeedForward:230, FusedMultiTransformer:627
+backed by CUDA fused_attention_op/fused_feedforward_op). On TPU "fused"
+means: one jitted region; attention uses the Pallas flash kernel; XLA fuses
+bias/dropout/residual/layernorm into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """~ fused_transformer.py:39 (pre/post-LN + attention + residual)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr
+                 =None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.attn = nn.MultiHeadAttention(embed_dim, num_heads,
+                                          attn_dropout_rate)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.ln_pre = nn.LayerNorm(embed_dim, epsilon)
+        self.ln_post = nn.LayerNorm(embed_dim, epsilon)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        residual = query
+        if self.normalize_before:
+            query = self.ln_pre(query)
+        out = self.attn(query, key, value, attn_mask=attn_mask, cache=cache)
+        if isinstance(out, tuple):
+            out = out[0]
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln_post(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """~ fused_transformer.py:230."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 linear2_weight_attr, linear2_bias_attr)
+        self.dropout1 = nn.Dropout(act_dropout_rate if act_dropout_rate
+                                   is not None else dropout_rate)
+        self.dropout2 = nn.Dropout(dropout_rate)
+        self.norm = nn.LayerNorm(d_model, epsilon)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        src = self.linear2(self.dropout1(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm(src)
+        return src
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate,
+            attn_dropout_rate if attn_dropout_rate is not None
+            else dropout_rate, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)
+
+
+class FusedLinear(nn.Linear):
+    pass

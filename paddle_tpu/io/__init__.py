@@ -1,0 +1,405 @@
+"""paddle_tpu.io: datasets, samplers, DataLoader.
+
+~ python/paddle/io/ (fluid/reader.py:273 DataLoader, fluid/dataloader/).
+The multiprocess shared-memory LoDTensor transport of the reference
+(dataloader_iter.py:341) is replaced by a thread-pool prefetcher: workers
+produce numpy batches (GIL released inside numpy/IO), and device transfer
+overlaps compute via jax async dispatch. TPU input pipelines are
+host-compute bound, not IPC bound, so threads + double buffering is the
+idiomatic design.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..core import generator as _gen
+from ..core.tensor import Tensor
+
+
+class Dataset:
+    """~ python/paddle/io/Dataset (fluid/dataloader/dataset.py:31)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: List):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(t._value)[idx] if isinstance(t, Tensor)
+                     else np.asarray(t)[idx] for t in self.tensors)
+
+    def __len__(self):
+        t = self.tensors[0]
+        return t.shape[0]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __getitem__(self, idx):
+        d = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if d == 0 else self.cum[d - 1]
+        return self.datasets[d][idx - prev]
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    if sum(lengths) != n:
+        raise ValueError("sum of lengths must equal dataset size")
+    g = generator or _gen.default_generator()
+    perm = np.asarray(
+        __import__("jax").random.permutation(g.next_key(), n))
+    out, off = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[off:off + l].tolist()))
+        off += l
+    return out
+
+
+class Sampler:
+    """~ fluid/dataloader/sampler.py:22."""
+
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        g = self.generator or _gen.default_generator()
+        import jax
+        if self.replacement:
+            idx = jax.random.randint(g.next_key(), (self.num_samples,), 0, n)
+        else:
+            idx = jax.random.permutation(g.next_key(), n)[:self.num_samples]
+        return iter(np.asarray(idx).tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = np.random.default_rng(
+            _gen.default_generator().next_key()[0].item() & 0x7FFFFFFF)
+        idx = rng.choice(len(self.weights), size=self.num_samples,
+                         replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """~ fluid/dataloader/batch_sampler.py:21."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """~ fluid/dataloader/batch_sampler.py DistributedBatchSampler:154 —
+    pads/partitions the index space across data-parallel ranks."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        from ..distributed import get_rank, get_world_size
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None \
+            else get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        n = len(dataset)
+        self.num_samples = (n + self.nranks - 1) // self.nranks
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = list(range(n))
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            rng.shuffle(indices)
+        indices += indices[: self.total_size - n]
+        local = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in local:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+def default_collate_fn(batch):
+    """~ fluid/dataloader/collate.py default_collate_fn."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(col))
+                            for col in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class _DataLoaderIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self._batches = iter(loader._index_iter())
+        self._queue: queue.Queue = queue.Queue(
+            maxsize=max(2, loader.prefetch_factor))
+        self._threads = []
+        self._stop = threading.Event()
+        self._n_emitted = 0
+        self._n_done = 0
+        nw = max(1, loader.num_workers)
+        self._work_q: queue.Queue = queue.Queue(maxsize=nw * 2)
+        self._out = {}
+        self._out_lock = threading.Lock()
+        self._next_seq = 0
+        self._feeder = threading.Thread(target=self._feed, daemon=True)
+        for _ in range(nw):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._feeder.start()
+
+    def _feed(self):
+        seq = 0
+        for b in self._batches:
+            if self._stop.is_set():
+                return
+            self._work_q.put((seq, b))
+            seq += 1
+        for _ in self._threads:
+            self._work_q.put(None)
+        self._total = seq
+
+    def _worker(self):
+        while not self._stop.is_set():
+            item = self._work_q.get()
+            if item is None:
+                self._queue.put(None)
+                return
+            seq, idx_batch = item
+            try:
+                data = self.loader._fetch(idx_batch)
+                self._queue.put((seq, data))
+            except Exception as e:  # propagate
+                self._queue.put((seq, e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        done_workers = 0
+        while True:
+            with self._out_lock:
+                if self._next_seq in self._out:
+                    data = self._out.pop(self._next_seq)
+                    self._next_seq += 1
+                    if isinstance(data, Exception):
+                        raise data
+                    return self.loader._to_tensors(data)
+            item = self._queue.get()
+            if item is None:
+                done_workers += 1
+                if done_workers >= len(self._threads) and not self._out:
+                    raise StopIteration
+                continue
+            seq, data = item
+            with self._out_lock:
+                self._out[seq] = data
+
+    def __del__(self):
+        self._stop.set()
+
+
+class DataLoader:
+    """~ paddle.io.DataLoader (fluid/reader.py:273)."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def _index_iter(self):
+        return iter(self.batch_sampler)
+
+    def _fetch(self, idx_batch):
+        samples = [self.dataset[i] for i in idx_batch]
+        return self.collate_fn(samples)
+
+    def _to_tensors(self, data):
+        if isinstance(data, np.ndarray):
+            return Tensor(data)
+        if isinstance(data, (list, tuple)):
+            return type(data)(self._to_tensors(d) for d in data)
+        if isinstance(data, dict):
+            return {k: self._to_tensors(v) for k, v in data.items()}
+        return data
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_sync()
+        return _DataLoaderIter(self)
+
+    def _iter_sync(self):
+        for idx_batch in self._index_iter():
+            yield self._to_tensors(self._fetch(idx_batch))
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self._to_tensors(self.collate_fn(batch))
+                batch = []
+        if batch and not self.drop_last:
+            yield self._to_tensors(self.collate_fn(batch))
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+
+def get_worker_info():
+    return None

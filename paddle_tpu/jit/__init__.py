@@ -1,0 +1,203 @@
+"""paddle_tpu.jit: to_static trace-and-compile.
+
+~ python/paddle/jit (dygraph_to_static ProgramTranslator:847,
+StaticFunction:237, PartialProgramLayer). TPU-native design: instead of AST
+rewriting into ProgramDesc, `to_static` traces the eager function with
+jax.jit — the jaxpr is the Program, XLA is the executor, and the cache key
+is the input signature (shape/dtype/tree) exactly like the reference's
+program cache. Dynamic Python control flow must be expressed with
+lax.cond/scan (the role the dy2static AST transformers play is subsumed by
+jax's tracing contract).
+
+jit.save/load serialize the traced StableHLO plus state_dict — the
+deployment-export slot (save_inference_model analog).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape as _tape
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+
+
+def _unwrap_tree(tree):
+    return jax.tree.map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree.map(
+        lambda x: Tensor(x) if isinstance(x, jax.Array) else x, tree)
+
+
+class InputSpec:
+    """~ paddle.static.InputSpec (python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+class StaticFunction:
+    """~ dygraph_to_static/program_translator.py StaticFunction:237.
+
+    Wraps an eager function/Layer method; on call, runs it under jax.jit
+    with Tensors bridged to tracers. Grad flows via the functional
+    ``grad_fn`` (value_and_grad over the param tree) rather than the tape.
+    """
+
+    def __init__(self, fn: Callable, input_spec=None, layer: Layer | None = None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jitted = None
+        self._cache_info = {"hits": 0, "misses": 0}
+
+    def _build(self):
+        layer = self._layer
+        fn = self._fn
+
+        def traced(params, args, kwargs, training):
+            if layer is not None:
+                old = layer.tree_flatten_params()
+                layer.load_tree(params)
+                was_training = layer.training
+                layer.training = training
+                try:
+                    with _tape.no_grad():
+                        out = fn(*_wrap_tree(args), **_wrap_tree(kwargs))
+                finally:
+                    layer.load_tree(old)
+                    layer.training = was_training
+            else:
+                with _tape.no_grad():
+                    out = fn(*_wrap_tree(args), **_wrap_tree(kwargs))
+            return _unwrap_tree(out)
+
+        self._jitted = jax.jit(traced, static_argnums=(3,))
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        params = self._layer.tree_flatten_params() if self._layer else {}
+        out = self._jitted(params, _unwrap_tree(args), _unwrap_tree(kwargs),
+                           self._layer.training if self._layer else False)
+        return _wrap_tree(out)
+
+    @property
+    def concrete_program(self):
+        return self._jitted
+
+    def get_traced(self, *example_args, **example_kwargs):
+        """Return (jaxpr, lowered StableHLO text) for inspection/golden tests."""
+        if self._jitted is None:
+            self._build()
+        params = self._layer.tree_flatten_params() if self._layer else {}
+        lowered = self._jitted.lower(
+            params, _unwrap_tree(example_args), _unwrap_tree(example_kwargs),
+            self._layer.training if self._layer else False)
+        return lowered
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """~ paddle.jit.to_static decorator."""
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn._static_forward = sf
+            orig_cls_call = fn.__class__.__call__
+
+            def patched_call(*a, **kw):
+                return sf(*a, **kw)
+            fn.forward_static = sf
+            return fn
+        layer = getattr(fn, "__self__", None)
+        sf = StaticFunction(fn, input_spec,
+                            layer=layer if isinstance(layer, Layer) else None)
+        functools.update_wrapper(sf, fn)
+        return sf
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """~ paddle.jit.save: serialize compiled artifact + weights.
+
+    Writes <path>.pdmodel (StableHLO text of the traced forward),
+    <path>.pdiparams (pickled numpy state_dict) — same two-artifact contract
+    as the reference's inference export (fluid/io.py save_inference_model).
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v._value)
+             for k, v in layer.state_dict().items()} \
+        if isinstance(layer, Layer) else {}
+    hlo_text = None
+    if input_spec:
+        specs = [s if isinstance(s, InputSpec) else InputSpec(s)
+                 for s in input_spec]
+        example = [jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
+                             dtype=s.dtype) for s in specs]
+        fn = layer.forward if isinstance(layer, Layer) else layer
+        sf = StaticFunction(fn, layer=layer if isinstance(layer, Layer) else None)
+        lowered = sf.get_traced(*[Tensor(e) for e in example])
+        hlo_text = lowered.as_text()
+        with open(path + ".pdmodel", "w") as f:
+            f.write(hlo_text)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    meta = {"class": type(layer).__name__,
+            "has_model": hlo_text is not None}
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """~ paddle.jit.TranslatedLayer — runtime for loaded artifacts."""
+
+    def __init__(self, state, hlo_text=None):
+        super().__init__()
+        self._state = {k: Tensor(v) for k, v in state.items()}
+        self._hlo_text = hlo_text
+
+    def state_dict(self, *a, **kw):
+        return dict(self._state)
+
+    def forward(self, *args):
+        raise RuntimeError(
+            "TranslatedLayer holds weights + StableHLO text; re-bind them to "
+            "a model class (set_state_dict) to execute. Direct StableHLO "
+            "execution requires a serving runtime.")
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    hlo = None
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel") as f:
+            hlo = f.read()
+    return TranslatedLayer(state, hlo)

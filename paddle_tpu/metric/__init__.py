@@ -1,0 +1,188 @@
+"""Metrics.
+
+~ python/paddle/metric/metrics.py:37 — Metric base + Accuracy/Precision/
+Recall/Auc, numpy-accumulated on host (metric state is tiny; keeping it off
+device avoids blocking the async dispatch stream).
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """~ paddle.metric.Metric (metrics.py:37)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    """top-k accuracy (metrics.py:184)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label):
+        pred = _np(pred)
+        label = _np(label)
+        if label.ndim == pred.ndim and label.shape[-1] > 1:
+            label = label.argmax(-1)
+        label = label.reshape(label.shape[0], -1)
+        idx = np.argsort(-pred, axis=-1)[:, :self.maxk]
+        correct = (idx == label[:, :1]).astype(np.float32)
+        return correct
+
+    def update(self, correct):
+        correct = _np(correct)
+        accs = []
+        for k in self.topk:
+            num = correct[:, :k].sum()
+            self.total[self.topk.index(k)] += num
+            self.count[self.topk.index(k)] += correct.shape[0]
+            accs.append(num / correct.shape[0])
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    """binary precision (metrics.py:307)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "precision"
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(np.int64).reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """binary recall (metrics.py:407)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "recall"
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(np.int64).reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via threshold buckets (metrics.py:505)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name or "auc"
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        labels = _np(labels).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            pos = preds[:, 1]
+        else:
+            pos = preds.reshape(-1)
+        idx = np.clip((pos * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        for i, l in zip(idx, labels):
+            if l:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, dtype=np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            p, n = self._stat_pos[i], self._stat_neg[i]
+            auc += n * tot_pos + p * n / 2.0
+            tot_pos += p
+            tot_neg += n
+        return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    """functional top-k accuracy (paddle.metric.accuracy)."""
+    pred = _np(input)
+    lab = _np(label).reshape(-1, 1)
+    idx = np.argsort(-pred, axis=-1)[:, :k]
+    correct = (idx == lab).any(axis=1).mean()
+    return Tensor(np.asarray(correct, dtype=np.float32))

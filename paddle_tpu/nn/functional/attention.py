@@ -1,0 +1,64 @@
+"""Attention functional ops.
+
+The reference's fused attention lives in CUDA
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h — plain
+O(s^2) attention). Here the eager path is jnp (XLA fuses well already); the
+jit/perf path swaps in the Pallas flash-attention kernel from
+paddle_tpu.ops.pallas when shapes qualify (see ops/pallas/flash_attention.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply_op
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, use_pallas="auto"):
+    """query/key/value: (batch, seq, heads, head_dim) — paddle convention.
+
+    Routes to the Pallas flash-attention kernel under jit when available and
+    shapes are TPU-tile friendly; otherwise the XLA softmax composition.
+    """
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+
+    def fn(q, k, v, *rest):
+        mask = rest[0] if rest else None
+        use_flash = use_pallas is True
+        if use_pallas == "auto":
+            # flash kernel needs seq multiples of block size and no custom mask
+            use_flash = (mask is None and q.shape[1] >= 256
+                         and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+                         and q.shape[-1] in (64, 128, 256))
+        if use_flash:
+            try:
+                from ...ops.pallas.flash_attention import flash_attention
+                return flash_attention(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), causal=is_causal,
+                ).swapaxes(1, 2)
+            except Exception:
+                pass
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        # (b, s, h, d) -> (b, h, s, d)
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if is_causal:
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            else:
+                scores = scores + mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+    return apply_op("scaled_dot_product_attention", fn, *args)

@@ -1,0 +1,281 @@
+"""Common functional ops: linear, embedding, dropout, interpolate, etc.
+
+~ python/paddle/nn/functional/common.py + input.py over phi kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import generator as _gen
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+from ...ops import manipulation as _manip
+
+
+def linear(x, weight, bias=None):
+    """~ phi matmul+add fused (reference fc). weight layout (in, out) to
+    match paddle.nn.Linear (python/paddle/nn/layer/common.py:123)."""
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        out = jnp.matmul(xv, wv)
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply_op("linear", fn, *args)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    """~ phi embedding (lookup_table_v2); padding_idx rows get zero grad via
+    zeroed output rows."""
+    def fn(ids, wv):
+        out = jnp.take(wv, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None].astype(out.dtype)
+            out = out * mask
+        return out
+    return apply_op("embedding", fn, x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            rng_key=None):
+    """~ phi dropout (seed+offset driven, phi/kernels/dropout_kernel.h)."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = rng_key if rng_key is not None else _gen.next_key()
+
+    def fn(xv):
+        shape = list(xv.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            for i in range(len(shape)):
+                if i not in axes:
+                    shape[i] = 1
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), 0.0).astype(xv.dtype)
+        return jnp.where(keep, xv, 0.0).astype(xv.dtype)
+    return apply_op("dropout", fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _gen.next_key()
+
+    def fn(xv):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, xv.shape)
+        a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, xv, alpha_p) + b).astype(xv.dtype)
+    return apply_op("alpha_dropout", fn, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    return _manip.pad(x, pad=pad, mode=mode, value=value,
+                      data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    """~ phi interpolate family (nearest/bilinear/bicubic/trilinear/area)."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    nd = x.ndim
+    n_spatial = nd - 2
+    in_spatial = (list(x.shape[1:-1]) if channel_last
+                  else list(x.shape[2:]))
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = [int(s) for s in size]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n_spatial
+        out_spatial = [int(np.floor(s * f))
+                       for s, f in zip(in_spatial, scale_factor)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic",
+             "area": "linear"}[mode]
+
+    def fn(xv):
+        if channel_last:
+            target = (xv.shape[0],) + tuple(out_spatial) + (xv.shape[-1],)
+        else:
+            target = (xv.shape[0], xv.shape[1]) + tuple(out_spatial)
+        if jmode == "nearest" or not align_corners:
+            return jax.image.resize(xv, target, method=jmode).astype(xv.dtype)
+        # align_corners path: use explicit gather with corner-aligned coords
+        out = xv
+        spatial_axes = (list(range(1, 1 + n_spatial)) if channel_last
+                        else list(range(2, 2 + n_spatial)))
+        for ax, osz in zip(spatial_axes, out_spatial):
+            isz = out.shape[ax]
+            if osz == 1 or isz == 1:
+                idx = jnp.zeros((osz,), jnp.float32)
+            else:
+                idx = jnp.linspace(0.0, isz - 1.0, osz)
+            lo = jnp.floor(idx).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, isz - 1)
+            w = (idx - lo).astype(out.dtype)
+            shp = [1] * out.ndim
+            shp[ax] = osz
+            w = w.reshape(shp)
+            out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                   + jnp.take(out, hi, axis=ax) * w)
+        return out.astype(xv.dtype)
+    return apply_op("interpolate", fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col ~ phi unfold."""
+    def _t(v):
+        return (int(v), int(v)) if isinstance(v, int) else tuple(int(a) for a in v)
+    kh, kw = _t(kernel_sizes)
+    sh, sw = _t(strides)
+    dh, dw = _t(dilations)
+    if isinstance(paddings, int):
+        ph0 = ph1 = pw0 = pw1 = paddings
+    elif len(paddings) == 2:
+        ph0 = ph1 = paddings[0]
+        pw0 = pw1 = paddings[1]
+    else:
+        ph0, pw0, ph1, pw1 = paddings
+
+    def fn(xv):
+        N, C, H, W = xv.shape
+        xp = jnp.pad(xv, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)])
+        oh = (H + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (W + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = xp[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                        j * dw:j * dw + (ow - 1) * sw + 1:sw]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # N, C, kh*kw, oh, ow
+        return out.reshape(N, C * kh * kw, oh * ow)
+    return apply_op("unfold", fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    def _t(v):
+        return (int(v), int(v)) if isinstance(v, int) else tuple(int(a) for a in v)
+    oh, ow = _t(output_sizes)
+    kh, kw = _t(kernel_sizes)
+    sh, sw = _t(strides)
+    dh, dw = _t(dilations)
+    p = _t(paddings) if not isinstance(paddings, int) else (paddings, paddings)
+    ph, pw = p[0], p[1]
+
+    def fn(xv):
+        N = xv.shape[0]
+        C = xv.shape[1] // (kh * kw)
+        lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        cols = xv.reshape(N, C, kh, kw, lh, lw)
+        out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), xv.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh:i * dh + (lh - 1) * sh + 1:sh,
+                             j * dw:j * dw + (lw - 1) * sw + 1:sw].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return apply_op("fold", fn, x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+
+    def fn(xv):
+        if data_format == "NCHW":
+            N, C, H, W = xv.shape
+            out = xv.reshape(N, C // (r * r), r, r, H, W)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = xv.shape
+        out = xv.reshape(N, H, W, r, r, C // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(N, H * r, W * r, C // (r * r))
+    return apply_op("pixel_shuffle", fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+
+    def fn(xv):
+        N, C, H, W = xv.shape
+        out = xv.reshape(N, C, H // r, r, W // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(N, C * r * r, H // r, W // r)
+    return apply_op("pixel_unshuffle", fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    g = int(groups)
+
+    def fn(xv):
+        N, C, H, W = xv.shape
+        out = xv.reshape(N, g, C // g, H, W)
+        out = jnp.swapaxes(out, 1, 2)
+        return out.reshape(N, C, H, W)
+    return apply_op("channel_shuffle", fn, x)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply_op("bilinear", fn, *args)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op("cosine_similarity", fn, x1, x2)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    def fn(lv):
+        n = lv.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * lv + epsilon * pd
+        return (1 - epsilon) * lv + epsilon / n
+    return apply_op("label_smooth", fn, label)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    def fn(lv):
+        m = maxlen if maxlen is not None else int(jnp.max(lv))
+        mask = jnp.arange(m)[None, :] < lv.reshape(-1, 1)
+        return mask.astype(jnp.dtype(dtype)).reshape(lv.shape + (m,))
+    return apply_op("sequence_mask", fn, lengths, nondiff=True)

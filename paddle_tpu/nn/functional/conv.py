@@ -1,0 +1,183 @@
+"""Convolution functional ops.
+
+~ python/paddle/nn/functional/conv.py over phi conv kernels
+(paddle/phi/kernels/conv_kernel.h, gpudnn/conv_kernel.cu). Lowered to
+lax.conv_general_dilated — XLA maps these onto the MXU directly, playing the
+role cuDNN algo selection plays in the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+
+def _tuplize(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n, strides=None):
+    """Return (lax padding, jax 'SAME'/'VALID' or explicit list)."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[h0,h1],[w0,w1]]
+    if len(padding) == n + 2:
+        return [tuple(int(x) for x in p) for p in padding[2:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n:] if n <= 3 else None
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+        out_spec = lhs_spec
+    else:
+        lhs_spec = "NC" + spatial
+        out_spec = lhs_spec
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, rhs_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tuplize(stride, n),
+        padding=_norm_padding(padding, n),
+        rhs_dilation=_tuplize(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=int(groups))
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        return _conv_nd(xv, wv, bv, stride, padding, dilation, groups, 1, fmt)
+    return apply_op("conv1d", fn, *args)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        return _conv_nd(xv, wv, bv, stride, padding, dilation, groups, 2,
+                        data_format)
+    return apply_op("conv2d", fn, *args)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        return _conv_nd(xv, wv, bv, stride, padding, dilation, groups, 3,
+                        data_format)
+    return apply_op("conv3d", fn, *args)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    rhs_spec = "IO" + spatial  # paddle stores transpose conv weight as (in, out/groups, *k)
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    pad = _norm_padding(padding, n)
+    strides = _tuplize(stride, n)
+    dils = _tuplize(dilation, n)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        # grad-of-conv padding: k_eff - 1 - p
+        ksp = weight.shape[2:]
+        pad_cfg = []
+        out_pad = _tuplize(output_padding, n)
+        for i in range(n):
+            k_eff = (ksp[i] - 1) * dils[i] + 1
+            lo = k_eff - 1 - pad[i][0]
+            hi = k_eff - 1 - pad[i][1] + out_pad[i]
+            pad_cfg.append((lo, hi))
+    if groups != 1:
+        # grouped transpose conv: split and concat
+        xi = jnp.split(x, groups, axis=-1 if channel_last else 1)
+        wi = jnp.split(weight, groups, axis=0)
+        outs = [jax.lax.conv_general_dilated(
+            a, jnp.swapaxes(w, 0, 1) if False else w,
+            window_strides=(1,) * n, padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dils,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec)),
+            transpose_kernel=True)
+            for a, w in zip(xi, wi)]
+        out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, weight, window_strides=(1,) * n, padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn,
+            transpose_kernel=True)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        return _conv_transpose_nd(xv, wv, bv, stride, padding, output_padding,
+                                  dilation, groups, 1, data_format)
+    return apply_op("conv1d_transpose", fn, *args)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        return _conv_transpose_nd(xv, wv, bv, stride, padding, output_padding,
+                                  dilation, groups, 2, data_format)
+    return apply_op("conv2d_transpose", fn, *args)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    args = [x, weight] + ([bias] if bias is not None else [])
+
+    def fn(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        return _conv_transpose_nd(xv, wv, bv, stride, padding, output_padding,
+                                  dilation, groups, 3, data_format)
+    return apply_op("conv3d_transpose", fn, *args)

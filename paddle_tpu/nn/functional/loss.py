@@ -1,0 +1,232 @@
+"""Loss functional ops.
+
+~ python/paddle/nn/functional/loss.py over phi cross_entropy/bce/... kernels
+(paddle/phi/kernels/cross_entropy_kernel.h etc.).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """~ phi softmax_with_cross_entropy (fused log-softmax + nll)."""
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(logits, lab, *rest):
+        wv = rest[0] if rest else None
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if wv is not None:
+                loss = loss * jnp.sum(soft * wv, axis=axis)
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        valid = (lab_i != ignore_index)
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, safe[..., None], axis=-1 if axis in (-1, logp.ndim - 1)
+            else axis).squeeze(axis)
+        if label_smoothing > 0:
+            smooth_loss = -jnp.mean(logp, axis=axis)
+            nll = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+        else:
+            nll = -picked
+        nll = jnp.where(valid, nll, 0.0)
+        if wv is not None:
+            w = jnp.take(wv, safe)
+            w = jnp.where(valid, w, 0.0)
+            nll = nll * w
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(nll.dtype)), 1.0)
+            return jnp.sum(nll) / denom
+        return _reduce(nll, reduction)
+    return apply_op("cross_entropy", fn, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = apply_op("unsqueeze_loss",
+                    lambda v: jnp.expand_dims(v, axis), loss)
+    if return_softmax:
+        from ...ops.activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    return cross_entropy(input, label, weight=weight,
+                         ignore_index=ignore_index, reduction=reduction,
+                         use_softmax=False)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    args = [input, label] + ([weight] if weight is not None else [])
+
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    return apply_op("binary_cross_entropy", fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    args = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(weight)
+    if has_pw:
+        args.append(pos_weight)
+
+    def fn(z, y, *rest):
+        i = 0
+        wv = rest[i] if has_w else None
+        i += has_w
+        pw = rest[i] if has_pw else None
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = -jax.nn.softplus(-z)
+            log1msig = -z - logsig if False else -jax.nn.softplus(z)
+            base = -(pw * y * logsig + (1 - y) * log1msig)
+        if wv is not None:
+            base = base * wv
+        return _reduce(base, reduction)
+    return apply_op("bce_with_logits", fn, *args)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    input, label)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", fn, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply_op("huber_loss", fn, input, label)
+
+
+def kl_div(input, label, reduction="mean"):
+    def fn(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    def fn(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return apply_op("margin_ranking_loss", fn, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    def fn(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+    return apply_op("hinge_embedding_loss", fn, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op("triplet_margin_loss", fn, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    def fn(p, y):
+        return -(y * jnp.log(p + epsilon)
+                 + (1 - y) * jnp.log(1 - p + epsilon))
+    return apply_op("log_loss", fn, input, label)
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost",
+                    lambda a, b: jnp.square(a - b), input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax (jax-native alpha recursion)."""
+    import optax
+
+    def fn(lp, lab):
+        # optax expects (B, T, C) logits, paddle gives (T, B, C)
+        logits = jnp.transpose(lp, (1, 0, 2))
+        B, T, C = logits.shape
+        ilen = input_lengths._value if isinstance(input_lengths, Tensor) \
+            else jnp.asarray(input_lengths)
+        llen = label_lengths._value if isinstance(label_lengths, Tensor) \
+            else jnp.asarray(label_lengths)
+        logit_pad = (jnp.arange(T)[None, :] >= ilen[:, None]).astype(jnp.float32)
+        lab_pad = (jnp.arange(lab.shape[1])[None, :]
+                   >= llen[:, None]).astype(jnp.float32)
+        loss = optax.ctc_loss(logits, logit_pad, lab, lab_pad,
+                              blank_id=blank)
+        if norm_by_times:
+            loss = loss / jnp.maximum(ilen.astype(loss.dtype), 1.0)
+        return _reduce(loss, reduction)
+    return apply_op("ctc_loss", fn, log_probs, labels)

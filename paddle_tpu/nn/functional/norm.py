@@ -1,0 +1,198 @@
+"""Normalization functional ops.
+
+~ python/paddle/nn/functional/norm.py over phi batch_norm/layer_norm kernels
+(paddle/phi/kernels/batch_norm_kernel.h, layer_norm_kernel.h). On TPU these
+are jnp reductions + elementwise that XLA fuses into single passes; layer
+norm additionally has a Pallas fused kernel (paddle_tpu/ops/pallas/) used on
+the jit path for long rows.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None):
+    """~ phi batch_norm; in training mode updates running stats in place
+    (functional rebind on the stat tensors, matching paddle's mutable
+    mean/variance outputs)."""
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1 \
+        if isinstance(x, Tensor) else 1
+    nd = x.ndim
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        channel_axis = nd - 1
+    axes = tuple(i for i in range(nd) if i != channel_axis)
+    shape = [1] * nd
+    shape[channel_axis] = x.shape[channel_axis]
+
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    if training and not use_stats:
+        # compute batch stats and update running stats host-side state
+        mean_v = apply_op("bn_mean", lambda v: jnp.mean(v, axis=axes), x)
+        var_v = apply_op("bn_var", lambda v: jnp.var(v, axis=axes), x)
+        with_stats_x = x
+        if running_mean is not None:
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * mean_v._value)
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * var_v._value)
+        mean_use, var_use = mean_v, var_v
+    else:
+        mean_use, var_use = running_mean, running_var
+
+    args = [x, mean_use, var_use]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def fn(xv, mv, vv, *rest):
+        i = 0
+        wv = rest[i] if has_w else None
+        i += has_w
+        bv = rest[i] if has_b else None
+        inv = jnp.reciprocal(jnp.sqrt(vv.reshape(shape) + epsilon))
+        out = (xv - mv.reshape(shape)) * inv
+        if wv is not None:
+            out = out * wv.reshape(shape)
+        if bv is not None:
+            out = out + bv.reshape(shape)
+        return out
+    return apply_op("batch_norm", fn, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n = len(normalized_shape)
+    axes = tuple(range(x.ndim - n, x.ndim))
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def fn(xv, *rest):
+        i = 0
+        wv = rest[i] if has_w else None
+        i += has_w
+        bv = rest[i] if has_b else None
+        mu = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(xv - mu), axis=axes, keepdims=True)
+        out = (xv - mu) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+        if wv is not None:
+            out = out * wv
+        if bv is not None:
+            out = out + bv
+        return out
+    return apply_op("layer_norm", fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    nd = x.ndim
+    channel_axis = 1 if data_format.startswith("NC") else nd - 1
+    axes = tuple(i for i in range(2, nd)) if channel_axis == 1 else \
+        tuple(i for i in range(1, nd - 1))
+    shape = [1] * nd
+    shape[channel_axis] = x.shape[channel_axis]
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def fn(xv, *rest):
+        i = 0
+        wv = rest[i] if has_w else None
+        i += has_w
+        bv = rest[i] if has_b else None
+        mu = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.var(xv, axis=axes, keepdims=True)
+        out = (xv - mu) * jnp.reciprocal(jnp.sqrt(var + eps))
+        if wv is not None:
+            out = out * wv.reshape(shape)
+        if bv is not None:
+            out = out + bv.reshape(shape)
+        return out
+    return apply_op("instance_norm", fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW"):
+    nd = x.ndim
+    channel_last = not data_format.startswith("NC")
+    c_ax = nd - 1 if channel_last else 1
+    C = x.shape[c_ax]
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def fn(xv, *rest):
+        i = 0
+        wv = rest[i] if has_w else None
+        i += has_w
+        bv = rest[i] if has_b else None
+        if channel_last:
+            xm = jnp.moveaxis(xv, -1, 1)
+        else:
+            xm = xv
+        N = xm.shape[0]
+        g = xm.reshape((N, num_groups, C // num_groups) + xm.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mu = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        g = (g - mu) * jnp.reciprocal(jnp.sqrt(var + epsilon))
+        out = g.reshape(xm.shape)
+        shape = (1, C) + (1,) * (xm.ndim - 2)
+        if wv is not None:
+            out = out * wv.reshape(shape)
+        if bv is not None:
+            out = out + bv.reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op("group_norm", fn, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    def fn(xv):
+        norm = jnp.sum(jnp.abs(xv) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return xv / jnp.maximum(norm, epsilon)
+    return apply_op("normalize", fn, x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    def fn(xv):
+        channel_axis = 1 if data_format.startswith("NC") else xv.ndim - 1
+        sq = jnp.square(xv)
+        C = xv.shape[channel_axis]
+        half = size // 2
+        pads = [(0, 0)] * xv.ndim
+        pads[channel_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(xv)
+        for i in range(size):
+            sl = [slice(None)] * xv.ndim
+            sl[channel_axis] = slice(i, i + C)
+            acc = acc + padded[tuple(sl)]
+        return xv / jnp.power(k + alpha * acc, beta)
+    return apply_op("local_response_norm", fn, x)

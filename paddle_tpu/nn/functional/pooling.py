@@ -1,0 +1,168 @@
+"""Pooling functional ops.
+
+~ python/paddle/nn/functional/pooling.py over phi pool kernels
+(paddle/phi/kernels/pool_kernel.h). Lowered to lax.reduce_window.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+
+def _tuplize(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool_nd(x, kind, kernel_size, stride, padding, n, data_format,
+             ceil_mode=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ks = _tuplize(kernel_size, n)
+    st = _tuplize(stride if stride is not None else kernel_size, n)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _tuplize(padding, n)
+        pad = [(pi, pi) for pi in p]
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+    if isinstance(pad, str):
+        pads = pad
+    elif channel_last:
+        pads = [(0, 0)] + pad + [(0, 0)]
+    else:
+        pads = [(0, 0), (0, 0)] + pad
+
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
+                                    pads)
+        return out
+    # avg pool
+    ones = jnp.ones_like(x)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and (isinstance(pads, str) and pads == "SAME"
+                      or isinstance(pads, list) and any(p != (0, 0) for p in pads)):
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                    pads)
+        return s / cnt
+    return s / float(np.prod(ks))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL"):
+    return apply_op("max_pool1d",
+                    lambda v: _pool_nd(v, "max", kernel_size, stride, padding,
+                                       1, data_format, ceil_mode), x)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    return apply_op("max_pool2d",
+                    lambda v: _pool_nd(v, "max", kernel_size, stride, padding,
+                                       2, data_format, ceil_mode), x)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    return apply_op("max_pool3d",
+                    lambda v: _pool_nd(v, "max", kernel_size, stride, padding,
+                                       3, data_format, ceil_mode), x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    return apply_op("avg_pool1d",
+                    lambda v: _pool_nd(v, "avg", kernel_size, stride, padding,
+                                       1, data_format, ceil_mode, exclusive), x)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW"):
+    return apply_op("avg_pool2d",
+                    lambda v: _pool_nd(v, "avg", kernel_size, stride, padding,
+                                       2, data_format, ceil_mode, exclusive), x)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW"):
+    return apply_op("avg_pool3d",
+                    lambda v: _pool_nd(v, "avg", kernel_size, stride, padding,
+                                       3, data_format, ceil_mode, exclusive), x)
+
+
+def _adaptive_pool(x, output_size, n, kind, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    out_sz = _tuplize(output_size, n)
+    spatial_axes = list(range(1, n + 1)) if channel_last else \
+        list(range(2, n + 2))
+    # adaptive = reduce_window with computed kernel when divisible, else
+    # bucketed mean via reshape when divisible; general case: interpolate bins
+    out = x
+    for ax, osz in zip(spatial_axes, out_sz):
+        isz = out.shape[ax]
+        if osz == 1:
+            out = (jnp.max if kind == "max" else jnp.mean)(out, axis=ax,
+                                                          keepdims=True)
+        elif isz % osz == 0:
+            k = isz // osz
+            new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+            r = jnp.reshape(out, new_shape)
+            out = (jnp.max if kind == "max" else jnp.mean)(r, axis=ax + 1)
+        else:
+            # general bins (start/end like paddle's adaptive pooling)
+            starts = (np.arange(osz) * isz) // osz
+            ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+            slices = []
+            for s, e in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                slices.append((jnp.max if kind == "max" else jnp.mean)(
+                    sl, axis=ax, keepdims=True))
+            out = jnp.concatenate(slices, axis=ax)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return apply_op("adaptive_avg_pool1d",
+                    lambda v: _adaptive_pool(v, output_size, 1, "avg",
+                                             data_format), x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return apply_op("adaptive_avg_pool2d",
+                    lambda v: _adaptive_pool(v, output_size, 2, "avg",
+                                             data_format), x)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return apply_op("adaptive_avg_pool3d",
+                    lambda v: _adaptive_pool(v, output_size, 3, "avg",
+                                             data_format), x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    return apply_op("adaptive_max_pool1d",
+                    lambda v: _adaptive_pool(v, output_size, 1, "max",
+                                             data_format), x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    return apply_op("adaptive_max_pool2d",
+                    lambda v: _adaptive_pool(v, output_size, 2, "max",
+                                             data_format), x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    return apply_op("adaptive_max_pool3d",
+                    lambda v: _adaptive_pool(v, output_size, 3, "max",
+                                             data_format), x)

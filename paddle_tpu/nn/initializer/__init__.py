@@ -1,0 +1,183 @@
+"""Weight initializers.
+
+~ python/paddle/nn/initializer/ (fluid/initializer.py). Initializers are
+callables (shape, dtype) -> jax array, consuming the global Generator.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as _dt
+from ...core import generator as _gen
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weights are stored OIHW (matching the reference's layout)
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(int(s) for s in shape), self.value,
+                        _dt.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = _dt.convert_dtype(dtype)
+        z = jax.random.normal(_gen.next_key(), tuple(int(s) for s in shape),
+                              dtype=jnp.float32)
+        return (z * self.std + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dt = _dt.convert_dtype(dtype)
+        z = jax.random.truncated_normal(_gen.next_key(), -2.0, 2.0,
+                                        tuple(int(s) for s in shape),
+                                        dtype=jnp.float32)
+        return (z * self.std + self.mean).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dt = _dt.convert_dtype(dtype)
+        z = jax.random.uniform(_gen.next_key(), tuple(int(s) for s in shape),
+                               minval=self.low, maxval=self.high,
+                               dtype=jnp.float32)
+        return z.astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return Normal(0.0, gain / math.sqrt(fi))(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dt = _dt.convert_dtype(dtype)
+        z = jax.nn.initializers.orthogonal(scale=self.gain)(
+            _gen.next_key(), tuple(int(s) for s in shape), jnp.float32)
+        return z.astype(dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ...core.tensor import Tensor
+        v = self.value._value if isinstance(self.value, Tensor) else np.asarray(self.value)
+        return jnp.asarray(v, dtype=_dt.convert_dtype(dtype)).reshape(
+            tuple(int(s) for s in shape))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        arr = np.zeros(shape, dtype=_dt.convert_dtype(dtype))
+        o, i = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for k in range(min(o, i * self.groups)):
+            idx = (k, k % i) + tuple(centers)
+            arr[idx] = 1.0
+        return jnp.asarray(arr)
+
+
+# lowercase API-compat aliases used in ParamAttr(initializer=...)
+constant = Constant
+normal = Normal
+uniform = Uniform
+
+
+class ParamAttr:
+    """~ paddle.ParamAttr (python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

@@ -1,0 +1,95 @@
+"""Activation layers. ~ python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from ...core.tensor import Parameter
+from .. import functional as F
+from .. import initializer as init
+from .layers import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kw = dict(fixed)
+            # capture common scalar args by position
+            names = list(_arg_names.get(fn_name, []))
+            for n, v in zip(names, args):
+                self._kw[n] = v
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kw[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kw)
+    _Act.__name__ = fn_name.title().replace("_", "")
+    return _Act
+
+
+_arg_names = {
+    "leaky_relu": ["negative_slope"],
+    "elu": ["alpha"],
+    "celu": ["alpha"],
+    "gelu": ["approximate"],
+    "hardtanh": ["min", "max"],
+    "hardshrink": ["threshold"],
+    "softshrink": ["threshold"],
+    "thresholded_relu": ["threshold"],
+    "softmax": ["axis"],
+    "log_softmax": ["axis"],
+    "maxout": ["groups", "axis"],
+    "glu": ["axis"],
+}
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+LeakyReLU = _simple("leaky_relu")
+ELU = _simple("elu")
+CELU = _simple("celu")
+SELU = _simple("selu")
+GELU = _simple("gelu")
+Sigmoid = _simple("sigmoid")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Hardtanh = _simple("hardtanh")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+Tanhshrink = _simple("tanhshrink")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+ThresholdedReLU = _simple("thresholded_relu")
+LogSigmoid = _simple("log_sigmoid")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+Maxout = _simple("maxout")
+GLU = _simple("glu")
+Tanh = _simple("tanh")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init_value=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=init.Constant(init_value))
+        self.data_format = data_format
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ...ops.dispatch import apply_op
+        fmt = self.data_format
+
+        def fn(xv, wv):
+            if wv.shape[0] == 1:
+                w = wv.reshape(())
+            else:
+                shape = [1] * xv.ndim
+                ax = 1 if fmt.startswith("NC") else xv.ndim - 1
+                shape[ax] = wv.shape[0]
+                w = wv.reshape(shape)
+            return jnp.where(xv >= 0, xv, w * xv)
+        return apply_op("prelu", fn, x, self.weight)

@@ -1,0 +1,300 @@
+"""Recurrent layers.
+
+~ python/paddle/nn/layer/rnn.py (RNNCellBase:117, LSTM:1233, GRU, SimpleRNN).
+TPU design: the time loop is a single ``lax.scan`` per direction per layer —
+one compiled kernel instead of the reference's per-step cuDNN calls; weights
+ride in the carry closure so XLA keeps them in VMEM across steps.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+from .. import initializer as init
+from .layers import Layer, LayerList
+
+
+class RNNCellBase(Layer):
+    """~ rnn.py:117."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        return full([B, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((hidden_size,), attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((hidden_size,), attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply_op("simple_rnn_cell", fn, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_ih_attr, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_hh_attr, is_bias=True,
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def fn(x, hv, cv, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hv @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * cv + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h2, c2 = apply_op("lstm_cell", fn, inputs, h, c, self.weight_ih,
+                          self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_ih_attr, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_hh_attr, is_bias=True,
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,),)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs)
+
+        def fn(x, hv, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hv @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * hv
+        h2 = apply_op("gru_cell", fn, inputs, h, self.weight_ih,
+                      self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence runner (~ rnn.py RNN:771).
+
+    The loop runs as a Python loop over time in eager mode; inside
+    jit/to_static XLA unrolls or the functional models use lax.scan.
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outputs = []
+        for t in steps:
+            x_t = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        return stack(outputs, axis=time_axis), states
+
+
+class BiRNN(Layer):
+    """~ rnn.py BiRNN:905."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw)
+        return concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional stack driven by lax.scan per layer."""
+
+    MODE_CELLS = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell,
+                  "LSTM": LSTMCell, "GRU": GRUCell}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.state_components = 2 if mode == "LSTM" else 1
+        cells = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                Cell = self.MODE_CELLS[mode]
+                kw = {}
+                if mode.startswith("RNN"):
+                    kw["activation"] = "tanh" if mode == "RNN_TANH" else "relu"
+                cells.append(Cell(in_sz, hidden_size, weight_ih_attr,
+                                  weight_hh_attr, bias_ih_attr, bias_hh_attr,
+                                  **kw) if mode.startswith("RNN") is False
+                             else Cell(in_sz, hidden_size, **kw))
+        self.cells = LayerList(cells)
+        self._ndir = ndir
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat, stack
+        from ...nn import functional as F
+        ndir = self._ndir
+        x = inputs
+        final_h = []
+        final_c = []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(ndir):
+                cell = self.cells[layer * ndir + d]
+                rnn = RNN(cell, is_reverse=(d == 1),
+                          time_major=self.time_major)
+                if initial_states is not None:
+                    if self.mode == "LSTM":
+                        h0, c0 = initial_states
+                        st = (h0[layer * ndir + d], c0[layer * ndir + d])
+                    else:
+                        st = initial_states[layer * ndir + d]
+                else:
+                    st = None
+                o, s = rnn(x, st)
+                outs.append(o)
+                if self.mode == "LSTM":
+                    final_h.append(s[0])
+                    final_c.append(s[1])
+                else:
+                    final_h.append(s)
+            x = outs[0] if ndir == 1 else concat(outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+        h_st = stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            c_st = stack(final_c, axis=0)
+            return x, (h_st, c_st)
+        return x, h_st
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

@@ -1,0 +1,15 @@
+"""Op layer: jax-lowered eager ops with tape autograd.
+
+Registry + dispatch (dispatch.py) ~ phi::KernelFactory; the modules here are
+the kernel families (paddle/phi/kernels/*) re-expressed as jax lowerings.
+"""
+from .dispatch import OP_REGISTRY, apply_op, def_op  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+from . import tensor_methods as _tm
+
+_tm.install()

@@ -1,0 +1,190 @@
+"""Tensor creation ops.
+
+~ python/paddle/tensor/creation.py backed by phi full/empty/arange kernels
+(paddle/phi/kernels/full_kernel.h etc.).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core import generator as _gen
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import def_op, apply_op
+
+
+def _dtype_or_default(dtype):
+    return _dt.convert_dtype(dtype) if dtype is not None else _dt.get_default_dtype()
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def full(shape, fill_value, dtype=None):
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dtype_or_default(dtype)))
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dtype_or_default(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dtype_or_default(dtype)))
+
+
+@def_op("full_like")
+def _full_like(x, *, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None):
+    return _full_like(x, fill_value=fill_value,
+                      dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def zeros_like(x, dtype=None):
+    return full_like(x, 0, dtype)
+
+
+def ones_like(x, dtype=None):
+    return full_like(x, 1, dtype)
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if dtype is None:
+        dtype = np.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else _dt.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor(jnp.linspace(float(start), float(stop), int(num),
+                               dtype=_dtype_or_default(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base,
+                               dtype=_dtype_or_default(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns else None,
+                          dtype=_dtype_or_default(dtype)))
+
+
+@def_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=int(diagonal))
+
+
+@def_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=int(diagonal))
+
+
+@def_op("diag")
+def diag(x, offset=0):
+    return jnp.diag(x, k=int(offset))
+
+
+@def_op("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=int(offset))
+
+
+def meshgrid(*args):
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(v)
+        return output
+    return apply_op("assign", lambda a: a + 0, x if isinstance(x, Tensor) else Tensor(v))
+
+
+def clone(x):
+    return apply_op("clone", lambda a: a + 0, x)
+
+
+# ---- random creation ops (consume the global Generator: seed+offset) -------
+
+def rand(shape, dtype=None):
+    return Tensor(jax.random.uniform(_gen.next_key(), _shape_list(shape),
+                                     dtype=_dtype_or_default(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else _gen.next_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape),
+                                     dtype=_dtype_or_default(dtype),
+                                     minval=min, maxval=max))
+
+
+def randn(shape, dtype=None):
+    return Tensor(jax.random.normal(_gen.next_key(), _shape_list(shape),
+                                    dtype=_dtype_or_default(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = []
+    z = jax.random.normal(_gen.next_key(), _shape_list(shape),
+                          dtype=_dt.get_default_dtype())
+    return Tensor(z * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_gen.next_key(), _shape_list(shape),
+                                     int(low), int(high),
+                                     dtype=_dt.convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64"):
+    return Tensor(jax.random.permutation(_gen.next_key(), int(n))
+                  .astype(_dt.convert_dtype(dtype)))
+
+
+def bernoulli(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_gen.next_key(), v).astype(v.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_gen.next_key(), logits, axis=-1,
+                                     shape=(*v.shape[:-1], int(num_samples)))
+    else:
+        key = _gen.next_key()
+        g = jax.random.gumbel(key, v.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :int(num_samples)]
+    return Tensor(out.astype(jnp.int64))

@@ -1,0 +1,132 @@
+"""Op dispatch: the eager kernel-launch path.
+
+TPU-native equivalent of the reference's generated dygraph functions +
+kernel selection stack:
+  _C_ops.final_state_X -> dygraph_function -> phi::KernelFactory::
+  SelectKernelOrThrowError (phi/core/kernel_factory.h:271) -> kernel launch.
+
+Here each op is a jax-traceable function; "kernel selection" is XLA's job.
+What this layer adds, mirroring the generated eager forward functions
+(eager/auto_code_generator/final_state_generator/eager_gen.py output):
+  1. unwrap Tensor args to jax values,
+  2. AMP auto-cast hook (~ eager_amp_auto_cast.h),
+  3. record a GradNode via jax.vjp when grad is required (~ CreateGradNode +
+     TensorWrapper saves),
+  4. wrap outputs back into Tensors,
+  5. optional nan/inf scan (~ FLAGS_check_nan_inf, framework/operator.cc:1270).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import tape as _tape
+from ..core import flags as _flags
+from ..core.tensor import Tensor
+from ..core import dtype as _dtypes
+
+__all__ = ["apply_op", "def_op", "OP_REGISTRY"]
+
+# name -> python api fn; the registry role of phi::KernelFactory, keyed by op
+# name only (backend/layout/dtype keys collapse: XLA compiles for the device).
+OP_REGISTRY: dict[str, Callable] = {}
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_diff_tensor(x) -> bool:
+    return (isinstance(x, Tensor) and not x.stop_gradient
+            and _dtypes.is_floating_point(x._value.dtype))
+
+
+def _check_nan_inf(name: str, vals) -> None:
+    for v in vals:
+        if isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.inexact):
+            if bool(jnp.any(~jnp.isfinite(v))):
+                raise FloatingPointError(
+                    f"nan/inf detected in output of op '{name}' "
+                    "(FLAGS_check_nan_inf=1)")
+
+
+def apply_op(name: str, fn: Callable, *args, nondiff: bool = False, **kwargs):
+    """Run one op eagerly with tape recording.
+
+    ``fn`` must be jax-traceable over its array-positional args; kwargs are
+    static attributes. Tensor positional args are unwrapped; non-Tensor
+    positional args pass through untouched.
+    """
+    vals = [_unwrap(a) for a in args]
+    from .. import amp as _amp
+    if _amp.amp_state() is not None:
+        vals = _amp._maybe_cast(name, vals)
+    grad_wanted = (not nondiff) and _tape.grad_enabled() and any(
+        _is_diff_tensor(a) for a in args)
+
+    if not grad_wanted:
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(name, out, stop_gradient=True)
+
+    diff_idx = [i for i, a in enumerate(args) if _is_diff_tensor(a)]
+
+    def closed(*dvals):
+        merged = list(vals)
+        for i, v in zip(diff_idx, dvals):
+            merged[i] = v
+        return fn(*merged, **kwargs)
+
+    out, vjp_fn = jax.vjp(closed, *[vals[i] for i in diff_idx])
+    outs, single = (out, False) if isinstance(out, (tuple, list)) else ((out,), True)
+
+    node = _tape.GradNode(
+        name, vjp_fn,
+        inputs=[args[i] for i in diff_idx],
+        out_avals=[(tuple(o.shape), o.dtype) for o in outs])
+
+    tensors = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=not jnp.issubdtype(o.dtype, jnp.inexact))
+        if not t.stop_gradient:
+            t._grad_node = node
+            t._output_index = i
+        tensors.append(t)
+
+    if _flags.get_flag("check_nan_inf"):
+        _check_nan_inf(name, [t._value for t in tensors])
+    if _flags.get_flag("benchmark"):
+        jax.block_until_ready([t._value for t in tensors])
+    return tensors[0] if single else tuple(tensors)
+
+
+def _wrap_outputs(name, out, stop_gradient: bool):
+    single = not isinstance(out, (tuple, list))
+    outs = (out,) if single else out
+    tensors = [Tensor(o, stop_gradient=True) for o in outs]
+    if _flags.get_flag("check_nan_inf"):
+        _check_nan_inf(name, [t._value for t in tensors])
+    return tensors[0] if single else tuple(tensors)
+
+
+def def_op(name: str | None = None, nondiff: bool = False):
+    """Decorator turning a jax function into a registered eager op.
+
+    The decorated function's positional args may be Tensors (differentiable
+    data inputs); keyword args are static attributes (~ OpDesc attrs).
+    """
+    def deco(fn):
+        opname = name or fn.__name__
+
+        @functools.wraps(fn)
+        def api(*args, **kwargs):
+            return apply_op(opname, fn, *args, nondiff=nondiff, **kwargs)
+
+        api.raw_fn = fn
+        api.op_name = opname
+        OP_REGISTRY[opname] = api
+        return api
+    return deco

@@ -1,0 +1,201 @@
+"""Linear algebra ops.
+
+~ python/paddle/tensor/linalg.py over phi matmul/blas kernels
+(paddle/phi/kernels/matmul_kernel.h, funcs/blas/). Matmuls are the MXU path:
+we route through jnp.matmul/einsum with configurable precision and leave
+tiling to XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags as _flags
+from .dispatch import def_op, apply_op
+
+
+def _precision():
+    p = _flags.get_flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+@def_op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@def_op("mm")
+def mm(x, y):
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@def_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@def_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@def_op("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec, precision=_precision())
+
+
+def einsum(equation, *operands):
+    return apply_op(
+        "einsum",
+        lambda *ops: jnp.einsum(equation, *ops, precision=_precision()),
+        *operands)
+
+
+@def_op("norm")
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (list, tuple)) else None,
+                               axis=tuple(axis) if isinstance(axis, list) else axis,
+                               keepdims=keepdim)
+    if p == np.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+@def_op("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=int(axis) if axis is not None else -1)
+
+
+@def_op("t")
+def t(x):
+    return x.T
+
+
+@def_op("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@def_op("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@def_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@def_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@def_op("slogdet")
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@def_op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, int(n))
+
+
+@def_op("matrix_rank", nondiff=True)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def qr(x, mode="reduced"):
+    return apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def svd(x, full_matrices=False):
+    return apply_op(
+        "svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def eigh(x, UPLO="L"):
+    return apply_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eig(x):
+    # jnp.linalg.eig is CPU-only; run on host (mirrors phi eig which is CPU)
+    import numpy.linalg as la
+
+    def _eig(a):
+        w, v = la.eig(np.asarray(a))
+        return jnp.asarray(w), jnp.asarray(v)
+    return apply_op("eig", _eig, x, nondiff=True)
+
+
+@def_op("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@def_op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@def_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@def_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    return apply_op(
+        "lstsq", lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), x, y,
+        nondiff=True)
+
+
+def lu(x, pivot=True):
+    return apply_op("lu", lambda a: tuple(jax.scipy.linalg.lu(a)[:2]), x,
+                    nondiff=True)
+
+
+@def_op("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@def_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@def_op("histogram", nondiff=True)
+def histogram(input, bins=100, min=0, max=0):
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(input, bins=int(bins),
+                            range=None if lo is None else (lo, hi))
+    return hist
+
+
+@def_op("matrix_transpose")
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)

@@ -1,0 +1,396 @@
+"""Shape / layout manipulation ops.
+
+~ python/paddle/tensor/manipulation.py over phi reshape/transpose/concat/
+split/gather/scatter kernels. All are pure-metadata or gather/scatter ops
+that XLA lowers to copies or fused reindexing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import def_op, apply_op
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+@def_op("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, _shape_list(shape))
+
+
+@def_op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = (x.shape[:start]
+                 + (int(np.prod(x.shape[start:stop + 1])),)
+                 + x.shape[stop + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@def_op("transpose")
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=perm)
+
+
+@def_op("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@def_op("swapaxes")
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, int(axis1), int(axis2))
+
+
+@def_op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@def_op("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(axis))
+
+
+def concat(x, axis=0):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=axis), *x)
+
+
+def stack(x, axis=0):
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=int(axis)), *x)
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[axis] if isinstance(x, Tensor) else x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        # -1 wildcard: fill remaining
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = dim - known
+    offsets = np.cumsum(sections)[:-1].tolist()
+    out = apply_op("split",
+                   lambda v: tuple(jnp.split(v, offsets, axis=axis)), x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis=axis) for o in outs]
+
+
+@def_op("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@def_op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@def_op("expand")
+def expand(x, shape):
+    shape = _shape_list(shape)
+    # paddle semantics: -1 keeps original dim
+    xshape = list(x.shape)
+    pad = len(shape) - len(xshape)
+    full = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(xshape[i - pad])
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, tuple(full))
+
+
+def expand_as(x, y):
+    return expand(x, list(y.shape))
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(t, list(out_shape)) for t in inputs]
+
+
+@def_op("flip")
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@def_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@def_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@def_op("slice")
+def slice_(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(int(st), int(en))
+    return x[tuple(idx)]
+
+
+@def_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+
+@def_op("gather")
+def gather(x, index, axis=0):
+    index = index.reshape(-1)
+    return jnp.take(x, index, axis=int(axis))
+
+
+@def_op("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@def_op("take_along_axis")
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+@def_op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=int(axis),
+                                  inplace=False)
+    dims = [0] * x.ndim  # scatter via .at
+    del dims
+    if reduce == "add":
+        idx = _along_axis_index(x, indices, int(axis))
+        return x.at[idx].add(values)
+    if reduce == "multiply":
+        idx = _along_axis_index(x, indices, int(axis))
+        return x.at[idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def _along_axis_index(x, indices, axis):
+    ix = []
+    for d in range(x.ndim):
+        if d == axis:
+            ix.append(indices)
+        else:
+            shp = [1] * x.ndim
+            shp[d] = x.shape[d]
+            ix.append(jnp.arange(x.shape[d]).reshape(shp))
+    return tuple(ix)
+
+
+@def_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@def_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    def _snd(index, updates):
+        zeros = jnp.zeros(_shape_list(shape), updates.dtype)
+        idx = tuple(jnp.moveaxis(index, -1, 0))
+        return zeros.at[idx].add(updates)
+    return apply_op("scatter_nd", _snd, index, updates)
+
+
+@def_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=int(axis))
+
+
+@def_op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@def_op("masked_select")
+def masked_select(x, mask):
+    # dynamic shape: falls back to host-side compress (not jittable);
+    # mirrored from phi masked_select which is also dynamic-output.
+    return x[mask]
+
+
+@def_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@def_op("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@def_op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    # paddle pad: list [before_last... ] or full pairs
+    if len(pad) == 2 * x.ndim:
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(x.ndim)]
+    else:
+        # pad applies to trailing spatial dims (NCHW/NCL/NCDHW conventions)
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * (x.ndim - n_spatial)
+        if data_format.endswith("C"):  # NHWC-style: spatial dims before channel
+            pairs = [(0, 0)]
+            for i in range(n_spatial):
+                pairs.append((int(pad[2 * i]), int(pad[2 * i + 1])))
+            pairs.append((0, 0))
+            pairs = pairs[:x.ndim]
+        else:
+            spat = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(n_spatial)]
+            pairs = [(0, 0)] * (x.ndim - n_spatial) + spat
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+@def_op("unstack")
+def _noop(x):  # placeholder to keep name free
+    return x
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+@def_op("unique", nondiff=True)
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    res = jnp.unique(x, return_index=return_index,
+                     return_inverse=return_inverse, return_counts=return_counts)
+    return res
+
+
+@def_op("nonzero", nondiff=True)
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return nz
+    return jnp.stack(nz, axis=1)
+
+
+@def_op("sort")
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@def_op("argsort", nondiff=True)
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    def _topk(v):
+        if axis not in (-1, v.ndim - 1):
+            v2 = jnp.moveaxis(v, axis, -1)
+        else:
+            v2 = v
+        if largest:
+            vals, idx = jax.lax.top_k(v2, int(k))
+        else:
+            vals, idx = jax.lax.top_k(-v2, int(k))
+            vals = -vals
+        if axis not in (-1, v.ndim - 1):
+            vals = jnp.moveaxis(vals, -1, axis)
+            idx = jnp.moveaxis(idx, -1, axis)
+        return vals, idx.astype(jnp.int64)
+    return apply_op("topk", _topk, x)
+
+
+@def_op("searchsorted", nondiff=True)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@def_op("bincount", nondiff=True)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=int(minlength))
+
+
+@def_op("one_hot", nondiff=True)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, int(num_classes), dtype=jnp.float32)
+
+
+@def_op("as_real", nondiff=True)
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@def_op("as_complex")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def _shard(x):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        ok = (x >= lo) & (x < hi)
+        return jnp.where(ok, x - lo, ignore_value)
+    return apply_op("shard_index", _shard, input, nondiff=True)

@@ -1,0 +1,249 @@
+"""Elementwise + binary math ops.
+
+~ python/paddle/tensor/math.py lowered through phi elementwise kernels
+(paddle/phi/kernels/elementwise_*_kernel.h, funcs/broadcast_function.h).
+Broadcasting is jnp's; XLA fuses chains of these into single kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import def_op, apply_op
+
+
+def _binop(name, jfn):
+    @def_op(name)
+    def op(x, y):
+        return jfn(x, y)
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+mod = _binop("mod", jnp.mod)
+remainder = mod
+pow_ = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", jnp.hypot)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+
+
+def pow(x, y):  # noqa: A001 - mirrors paddle.pow
+    return pow_(x, y)
+
+
+def _unop(name, jfn):
+    @def_op(name)
+    def op(x):
+        return jfn(x)
+    return op
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt)
+square = _unop("square", jnp.square)
+abs = _unop("abs", jnp.abs)  # noqa: A001
+sign = _unop("sign", jnp.sign)
+neg = _unop("neg", jnp.negative)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)  # noqa: A001
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+
+
+@def_op("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@def_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@def_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@def_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def multiplex(inputs, index):
+    def _mx(index, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        idx = index.reshape(-1).astype(jnp.int32)
+        return stacked[idx, jnp.arange(stacked.shape[1])]
+    return apply_op("multiplex", _mx, index, *inputs)
+
+
+@def_op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@def_op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@def_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@def_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@def_op("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@def_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@def_op("cumsum")
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@def_op("cumprod")
+def cumprod(x, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+@def_op("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def isfinite(x):
+    return apply_op("isfinite", jnp.isfinite, x, nondiff=True)
+
+
+def isinf(x):
+    return apply_op("isinf", jnp.isinf, x, nondiff=True)
+
+
+def isnan(x):
+    return apply_op("isnan", jnp.isnan, x, nondiff=True)
+
+
+# ---- logical / bitwise -----------------------------------------------------
+
+def _nondiff_binop(name, jfn):
+    @def_op(name, nondiff=True)
+    def op(x, y):
+        return jfn(x, y)
+    return op
+
+
+logical_and = _nondiff_binop("logical_and", jnp.logical_and)
+logical_or = _nondiff_binop("logical_or", jnp.logical_or)
+logical_xor = _nondiff_binop("logical_xor", jnp.logical_xor)
+bitwise_and = _nondiff_binop("bitwise_and", jnp.bitwise_and)
+bitwise_or = _nondiff_binop("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _nondiff_binop("bitwise_xor", jnp.bitwise_xor)
+left_shift = _nondiff_binop("left_shift", jnp.left_shift)
+right_shift = _nondiff_binop("right_shift", jnp.right_shift)
+
+
+@def_op("logical_not", nondiff=True)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@def_op("bitwise_not", nondiff=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+# ---- comparison ------------------------------------------------------------
+
+equal = _nondiff_binop("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _nondiff_binop("not_equal", jnp.not_equal)
+greater_than = _nondiff_binop("greater_than", jnp.greater)
+greater_equal = _nondiff_binop("greater_equal", jnp.greater_equal)
+less_than = _nondiff_binop("less_than", jnp.less)
+less_equal = _nondiff_binop("less_equal", jnp.less_equal)
+
+
+def equal_all(x, y):
+    return apply_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y,
+                    nondiff=True)
+
+
+@def_op("allclose", nondiff=True)
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@def_op("isclose", nondiff=True)
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@def_op("where")
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@def_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)

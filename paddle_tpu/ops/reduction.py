@@ -1,0 +1,102 @@
+"""Reduction ops.
+
+~ python/paddle/tensor/math.py + stat.py reductions, lowered through the phi
+reduce kernel family (paddle/phi/kernels/reduce_*_kernel.h, funcs/reduce).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import def_op, apply_op
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, jfn, nondiff=False):
+    @def_op(name, nondiff=nondiff)
+    def op(x, axis=None, keepdim=False):
+        return jfn(x, axis=_norm_axis(axis), keepdims=keepdim)
+    return op
+
+
+sum = _reduce("sum", jnp.sum)  # noqa: A001
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)  # noqa: A001
+min = _reduce("min", jnp.min)  # noqa: A001
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+any = _reduce("any", jnp.any, nondiff=True)  # noqa: A001
+all = _reduce("all", jnp.all, nondiff=True)  # noqa: A001
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+median = _reduce("median", jnp.median)
+nanmedian = _reduce("nanmedian", jnp.nanmedian)
+
+
+@def_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@def_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@def_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@def_op("argmax", nondiff=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+@def_op("argmin", nondiff=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+@def_op("count_nonzero", nondiff=True)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return apply_op(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=_norm_axis(axis),
+                               keepdims=keepdim),
+        x)
+
+
+@def_op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False):
+    srt = jnp.sort(x, axis=axis)
+    val = jnp.take(srt, k - 1, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+    return val
+
+
+@def_op("mode")
+def mode(x, axis=-1, keepdim=False):
+    srt = jnp.sort(x, axis=axis)
+    mid = srt.shape[axis] // 2
+    val = jnp.take(srt, mid, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+    return val

@@ -1,0 +1,132 @@
+"""Attach operator dunders and tensor methods onto Tensor.
+
+~ the monkey-patching the reference does in
+python/paddle/fluid/dygraph/math_op_patch.py + varbase_patch_methods.py:
+every `paddle.X(x, ...)` op with a tensor first-arg becomes `x.X(...)`.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import activation, creation, linalg, manipulation, math, reduction
+
+
+def _attach(name, fn):
+    if not hasattr(Tensor, name):
+        setattr(Tensor, name, fn)
+
+
+def _rbin(fn):
+    def method(self, other):
+        return fn(other if isinstance(other, Tensor) else Tensor(other), self)
+    return method
+
+
+def install():
+    T = Tensor
+    # arithmetic dunders
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = _rbin(math.add)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = _rbin(math.subtract)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = _rbin(math.multiply)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = _rbin(math.divide)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = _rbin(math.floor_divide)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__rmod__ = _rbin(math.mod)
+    T.__pow__ = lambda s, o: math.pow_(s, o)
+    T.__rpow__ = _rbin(math.pow_)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = _rbin(linalg.matmul)
+    # comparison dunders
+    T.__eq__ = lambda s, o: math.equal(s, o)
+    T.__ne__ = lambda s, o: math.not_equal(s, o)
+    T.__lt__ = lambda s, o: math.less_than(s, o)
+    T.__le__ = lambda s, o: math.less_equal(s, o)
+    T.__gt__ = lambda s, o: math.greater_than(s, o)
+    T.__ge__ = lambda s, o: math.greater_equal(s, o)
+    T.__invert__ = lambda s: math.logical_not(s)
+    T.__and__ = lambda s, o: math.logical_and(s, o) \
+        if s.dtype == bool else math.bitwise_and(s, o)
+    T.__or__ = lambda s, o: math.logical_or(s, o) \
+        if s.dtype == bool else math.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: math.logical_xor(s, o) \
+        if s.dtype == bool else math.bitwise_xor(s, o)
+
+    # indexing: functional gather/setitem
+    def _getitem(self, idx):
+        from .dispatch import apply_op
+
+        def unwrap_idx(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, tuple):
+                return tuple(unwrap_idx(e) for e in i)
+            if isinstance(i, list):
+                return [unwrap_idx(e) for e in i]
+            return i
+        j = unwrap_idx(idx)
+        return apply_op("getitem", lambda v: v[j], self)
+
+    def _setitem(self, idx, value):
+        import jax.numpy as jnp
+
+        def unwrap_idx(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, tuple):
+                return tuple(unwrap_idx(e) for e in i)
+            return i
+        j = unwrap_idx(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        self._value = self._value.at[j].set(
+            jnp.asarray(v, dtype=self._value.dtype)
+            if not isinstance(v, (int, float, bool)) else v)
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    def _iter(self):
+        for i in range(len(self)):
+            yield self[i]
+    T.__iter__ = _iter
+
+    # method versions of functional ops (paddle tensor methods)
+    for mod in (math, reduction, manipulation, linalg, activation):
+        for name in dir(mod):
+            fn = getattr(mod, name)
+            if callable(fn) and (hasattr(fn, "op_name") or
+                                 name in ("concat", "split", "topk", "einsum",
+                                          "multiplex", "chunk", "unbind",
+                                          "expand_as", "broadcast_to", "qr",
+                                          "svd", "eigh", "quantile")):
+                clean = name.rstrip("_") if name in ("pow_", "slice_") else name
+                _attach(clean, fn)
+
+    _attach("mean", reduction.mean)
+    _attach("sum", reduction.sum)
+    _attach("max", reduction.max)
+    _attach("min", reduction.min)
+    _attach("prod", reduction.prod)
+    _attach("all", reduction.all)
+    _attach("any", reduction.any)
+    _attach("abs", math.abs)
+    _attach("pow", math.pow_)
+    _attach("reshape", manipulation.reshape)
+    _attach("flatten", manipulation.flatten)
+    _attach("transpose", manipulation.transpose)
+    _attach("squeeze", manipulation.squeeze)
+    _attach("unsqueeze", manipulation.unsqueeze)
+    _attach("matmul", linalg.matmul)
+    _attach("dot", linalg.dot)
+    _attach("norm", linalg.norm)
+    _attach("dim", lambda s: s.ndim)
+
+    @property
+    def T_(self):
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+    Tensor.T = T_

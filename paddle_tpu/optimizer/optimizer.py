@@ -1,0 +1,364 @@
+"""Optimizer base + concrete optimizers.
+
+~ python/paddle/optimizer/optimizer.py:50 (accumulator management, _C_ops
+fused update kernels) re-expressed functionally: each optimizer defines a
+pure ``_update(param, grad, accs, lr) -> (new_param, new_accs)`` rule. The
+eager ``step()`` jits one fused update over the whole param pytree (the
+analog of the reference's fused/multi_tensor adam paths); the same rule is
+reused by jit'ed training loops and by sharded (ZeRO) wrappers which shard
+the accumulator pytree over the mesh.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+def _global_norm(grads):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in grads))
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        self._parameters: List[Parameter] = list(parameters) if parameters else []
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[int, dict] = {}
+        self._step_count = 0
+        self._jit_update = None
+
+    # ---- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    # ---- subclass interface ----------------------------------------------
+    def _create_accumulators(self, p: Parameter) -> dict:
+        return {}
+
+    def _update(self, param, grad, accs, lr, step):
+        raise NotImplementedError
+
+    # ---- helpers ----------------------------------------------------------
+    def _accs_for(self, p: Parameter) -> dict:
+        if id(p) not in self._accumulators:
+            self._accumulators[id(p)] = self._create_accumulators(p)
+        return self._accumulators[id(p)]
+
+    def _apply_grad_clip(self, params, grads):
+        from ..nn import (ClipGradByGlobalNorm, ClipGradByNorm,
+                          ClipGradByValue)
+        clip = self._grad_clip
+        if clip is None:
+            return grads
+        if isinstance(clip, ClipGradByValue):
+            return [jnp.clip(g, clip.min, clip.max) for g in grads]
+        if isinstance(clip, ClipGradByNorm):
+            out = []
+            for g in grads:
+                n = jnp.linalg.norm(g.astype(jnp.float32))
+                scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(n, 1e-12))
+                out.append((g * scale).astype(g.dtype))
+            return out
+        if isinstance(clip, ClipGradByGlobalNorm):
+            gn = _global_norm(grads)
+            scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(gn, 1e-12))
+            return [(g * scale).astype(g.dtype) for g in grads]
+        return grads
+
+    # ---- main entry -------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = [p for p in self._parameters
+                  if p.trainable and p._grad is not None]
+        if not params:
+            self._step_count += 1
+            return
+        grads = [p._grad._value for p in params]
+        grads = self._apply_grad_clip(params, grads)
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count + 1, jnp.int32)
+        vals = [p._value for p in params]
+        accs = [self._accs_for(p) for p in params]
+
+        def fused(vals, grads, accs, lr, step):
+            new_vals, new_accs = [], []
+            for v, g, a in zip(vals, grads, accs):
+                nv, na = self._update(v, g.astype(jnp.float32), a, lr, step)
+                new_vals.append(nv)
+                new_accs.append(na)
+            return new_vals, new_accs
+
+        if self._jit_update is None:
+            self._jit_update = jax.jit(fused)
+        new_vals, new_accs = self._jit_update(vals, grads, accs, lr, step)
+        for p, nv, na in zip(params, new_vals, new_accs):
+            p._value = nv
+            self._accumulators[id(p)] = na
+        self._step_count += 1
+        if isinstance(self._lr, LRScheduler) and self._lr._auto_step:
+            pass  # paddle semantics: user calls scheduler.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameters:
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    # ---- state ------------------------------------------------------------
+    def state_dict(self) -> dict:
+        st = {"step": self._step_count}
+        for i, p in enumerate(self._parameters):
+            accs = self._accumulators.get(id(p))
+            if accs:
+                st[f"accs_{i}"] = {k: Tensor(v) for k, v in accs.items()}
+        if isinstance(self._lr, LRScheduler):
+            st["LR_Scheduler"] = self._lr.state_dict()
+        return st
+
+    def set_state_dict(self, st: dict):
+        self._step_count = st.get("step", 0)
+        for i, p in enumerate(self._parameters):
+            key = f"accs_{i}"
+            if key in st:
+                self._accumulators[id(p)] = {
+                    k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in st[key].items()}
+        if "LR_Scheduler" in st and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(st["LR_Scheduler"])
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+
+class SGD(Optimizer):
+    """~ python/paddle/optimizer/sgd.py over phi sgd kernel."""
+
+    def _update(self, param, grad, accs, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * param.astype(jnp.float32)
+        return (param - (lr * grad).astype(param.dtype)), accs
+
+
+class Momentum(Optimizer):
+    """~ python/paddle/optimizer/momentum.py."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_accumulators(self, p):
+        return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, param, grad, accs, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * param.astype(jnp.float32)
+        v = self._momentum * accs["velocity"] + grad
+        if self._nesterov:
+            upd = grad + self._momentum * v
+        else:
+            upd = v
+        return (param - (lr * upd).astype(param.dtype)), {"velocity": v}
+
+
+class Adam(Optimizer):
+    """~ python/paddle/optimizer/adam.py over phi adam kernel."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _create_accumulators(self, p):
+        return {"m": jnp.zeros(p._value.shape, jnp.float32),
+                "v": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _decoupled(self):
+        return False
+
+    def _update(self, param, grad, accs, lr, step):
+        pf = param.astype(jnp.float32)
+        if self._weight_decay and not self._decoupled():
+            grad = grad + self._weight_decay * pf
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accs["m"] + (1 - b1) * grad
+        v = b2 * accs["v"] + (1 - b2) * jnp.square(grad)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        if self._weight_decay and self._decoupled():
+            upd = upd + self._weight_decay * pf
+        new_p = pf - lr * upd
+        return new_p.astype(param.dtype), {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """~ python/paddle/optimizer/adamw.py (decoupled decay)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _create_accumulators(self, p):
+        return {"m": jnp.zeros(p._value.shape, jnp.float32),
+                "u": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, param, grad, accs, lr, step):
+        pf = param.astype(jnp.float32)
+        if self._weight_decay:
+            grad = grad + self._weight_decay * pf
+        m = self._beta1 * accs["m"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * accs["u"], jnp.abs(grad))
+        t = step.astype(jnp.float32)
+        new_p = pf - (lr / (1 - self._beta1 ** t)) * m / (u + self._eps)
+        return new_p.astype(param.dtype), {"m": m, "u": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.full(p._value.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _update(self, param, grad, accs, lr, step):
+        pf = param.astype(jnp.float32)
+        if self._weight_decay:
+            grad = grad + self._weight_decay * pf
+        mom = accs["moment"] + jnp.square(grad)
+        new_p = pf - lr * grad / (jnp.sqrt(mom) + self._eps)
+        return new_p.astype(param.dtype), {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, p):
+        a = {"mean_square": jnp.zeros(p._value.shape, jnp.float32),
+             "momentum": jnp.zeros(p._value.shape, jnp.float32)}
+        if self._centered:
+            a["mean_grad"] = jnp.zeros(p._value.shape, jnp.float32)
+        return a
+
+    def _update(self, param, grad, accs, lr, step):
+        pf = param.astype(jnp.float32)
+        if self._weight_decay:
+            grad = grad + self._weight_decay * pf
+        ms = self._rho * accs["mean_square"] + (1 - self._rho) * jnp.square(grad)
+        new_accs = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * accs["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            new_accs["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * accs["momentum"] + lr * grad / denom
+        new_accs["momentum"] = mom
+        return (pf - mom).astype(param.dtype), new_accs
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+
+    def _create_accumulators(self, p):
+        return {"avg_sq_grad": jnp.zeros(p._value.shape, jnp.float32),
+                "avg_sq_update": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, param, grad, accs, lr, step):
+        pf = param.astype(jnp.float32)
+        if self._weight_decay:
+            grad = grad + self._weight_decay * pf
+        asg = self._rho * accs["avg_sq_grad"] + (1 - self._rho) * jnp.square(grad)
+        upd = (jnp.sqrt(accs["avg_sq_update"] + self._eps)
+               / jnp.sqrt(asg + self._eps)) * grad
+        asu = self._rho * accs["avg_sq_update"] + (1 - self._rho) * jnp.square(upd)
+        return (pf - lr * upd).astype(param.dtype), \
+            {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class Lamb(Optimizer):
+    """~ python/paddle/optimizer/lamb.py (LAMB trust-ratio scaling)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _create_accumulators(self, p):
+        return {"m": jnp.zeros(p._value.shape, jnp.float32),
+                "v": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, param, grad, accs, lr, step):
+        pf = param.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * accs["m"] + (1 - b1) * grad
+        v = b2 * accs["v"] + (1 - b2) * jnp.square(grad)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._weight_decay * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(param.dtype), {"m": m, "v": v}

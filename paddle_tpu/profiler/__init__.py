@@ -1,0 +1,238 @@
+"""Profiler.
+
+~ python/paddle/profiler/ (profiler.py:270 scheduler-driven Profiler,
+RecordEvent span API platform/profiler/event_tracing.h:47). TPU-native
+backing: jax.profiler (XPlane) for device traces + a host-side span
+recorder exported as chrome://tracing JSON (~ ChromeTracingLogger,
+platform/profiler/chrometracing_logger.h:28).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1  # accel alias
+    TPU = 1
+
+
+def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """~ profiler.py make_scheduler:140."""
+    period = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return fn
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        prof._export_chrome(os.path.join(
+            dir_name, f"{name}_{int(time.time())}.json"))
+    return handler
+
+
+class _SpanStore:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.events = []
+        self.enabled = False
+
+    def add(self, name, ts, dur, tid):
+        if not self.enabled:
+            return
+        with self.lock:
+            self.events.append({"name": name, "ph": "X", "pid": os.getpid(),
+                                "tid": tid, "ts": ts * 1e6, "dur": dur * 1e6})
+
+
+_spans = _SpanStore()
+
+
+class RecordEvent:
+    """~ platform/profiler/event_tracing.h RecordEvent — host span marker."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is not None:
+            _spans.add(self.name, self._t0, time.perf_counter() - self._t0,
+                       threading.get_ident())
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """~ paddle.profiler.Profiler (profiler.py:270)."""
+
+    def __init__(self, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(0, 0, scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._jax_active = False
+        self._logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                      "/tmp/paddle_tpu_profile")
+        self.timer_only = timer_only
+        self._step_times = []
+        self._last_step_t = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self.state = (self.scheduler(self.step_num) if self.scheduler
+                      else ProfilerState.RECORD)
+        self._maybe_transition(ProfilerState.CLOSED, self.state)
+        self._last_step_t = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._jax_active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_active = False
+        _spans.enabled = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+        self.state = ProfilerState.CLOSED
+
+    def _maybe_transition(self, old, new):
+        starting = new in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN) and \
+            old in (ProfilerState.CLOSED, ProfilerState.READY)
+        stopping = old in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN) and \
+            new in (ProfilerState.CLOSED, ProfilerState.READY)
+        if starting and not self.timer_only:
+            _spans.enabled = True
+            if not self._jax_active:
+                try:
+                    jax.profiler.start_trace(self._logdir)
+                    self._jax_active = True
+                except Exception:
+                    pass
+        if stopping:
+            _spans.enabled = False
+            if self._jax_active:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._jax_active = False
+            if self.on_trace_ready and old == ProfilerState.RECORD_AND_RETURN:
+                self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self.step_num += 1
+        if self.scheduler:
+            new = self.scheduler(self.step_num)
+            self._maybe_transition(self.state, new)
+            self.state = new
+
+    def step_info(self, unit: str = "samples"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.array([t for t, _ in self._step_times[-20:]])
+        ips = ""
+        ns = [n for _, n in self._step_times[-20:] if n]
+        if ns:
+            ips = f", ips {np.mean(ns) / np.mean(ts):.2f} {unit}/s"
+        return (f"avg step {ts.mean()*1000:.2f} ms, min {ts.min()*1000:.2f}, "
+                f"max {ts.max()*1000:.2f}{ips}")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- export -------------------------------------------------------------
+    def _export_chrome(self, path):
+        with _spans.lock:
+            events = list(_spans.events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        with _spans.lock:
+            events = list(_spans.events)
+        agg = {}
+        for e in events:
+            name = e["name"]
+            a = agg.setdefault(name, [0, 0.0])
+            a[0] += 1
+            a[1] += e["dur"] / 1000.0
+        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name[:40]:40s} {calls:8d} {total:12.3f}")
+        return "\n".join(lines)
+
+
+@contextmanager
+def profile(*args, **kwargs):
+    p = Profiler(*args, **kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()

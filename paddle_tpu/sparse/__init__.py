@@ -1,0 +1,108 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+~ python/paddle/sparse/ over phi sparse kernels (phi/core/sparse_coo_tensor.h,
+phi/kernels/sparse/). TPU reality: XLA has no sparse formats; the idiomatic
+mapping keeps COO/CSR as index+value pairs with dense compute via
+scatter/gather (segment_sum) which XLA lowers well for moderate sparsity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """COO: indices (ndim, nnz) + values (nnz, ...)."""
+
+    def __init__(self, indices, values, shape, stop_gradient=True):
+        self.indices_ = indices if isinstance(indices, Tensor) \
+            else Tensor(jnp.asarray(indices))
+        self.values_ = values if isinstance(values, Tensor) \
+            else Tensor(jnp.asarray(values))
+        self.dense_shape = list(int(s) for s in shape)
+        super().__init__(self._to_dense_value(), stop_gradient=stop_gradient)
+
+    def _to_dense_value(self):
+        idx = tuple(self.indices_._value)
+        dense = jnp.zeros(self.dense_shape, self.values_._value.dtype)
+        return dense.at[idx].add(self.values_._value)
+
+    def indices(self):
+        return self.indices_
+
+    def values(self):
+        return self.values_
+
+    def to_dense(self):
+        return Tensor(self._to_dense_value(),
+                      stop_gradient=self.stop_gradient)
+
+    @property
+    def nnz(self):
+        return self.values_.shape[0]
+
+
+class SparseCsrTensor(Tensor):
+    def __init__(self, crows, cols, values, shape, stop_gradient=True):
+        self.crows_ = Tensor(jnp.asarray(
+            crows._value if isinstance(crows, Tensor) else crows))
+        self.cols_ = Tensor(jnp.asarray(
+            cols._value if isinstance(cols, Tensor) else cols))
+        self.values_ = Tensor(jnp.asarray(
+            values._value if isinstance(values, Tensor) else values))
+        self.dense_shape = list(int(s) for s in shape)
+        super().__init__(self._to_dense_value(), stop_gradient=stop_gradient)
+
+    def _to_dense_value(self):
+        crows = np.asarray(self.crows_._value)
+        cols = self.cols_._value
+        vals = self.values_._value
+        nrows = self.dense_shape[0]
+        row_idx = np.repeat(np.arange(nrows), np.diff(crows))
+        dense = jnp.zeros(self.dense_shape, vals.dtype)
+        return dense.at[jnp.asarray(row_idx), cols].add(vals)
+
+    def crows(self):
+        return self.crows_
+
+    def cols(self):
+        return self.cols_
+
+    def values(self):
+        return self.values_
+
+    def to_dense(self):
+        return Tensor(self._to_dense_value())
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if shape is None:
+        idx = np.asarray(indices._value if isinstance(indices, Tensor)
+                         else indices)
+        shape = (idx.max(axis=1) + 1).tolist()
+    return SparseCooTensor(indices, values, shape, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape, stop_gradient)
+
+
+def matmul(x, y):
+    from ..ops.linalg import matmul as dense_matmul
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+    return dense_matmul(xd, yd)
+
+
+def relu(x):
+    if isinstance(x, SparseCooTensor):
+        from ..ops.activation import relu as dense_relu
+        return SparseCooTensor(x.indices_, dense_relu(x.values_),
+                               x.dense_shape)
+    from ..ops.activation import relu as dense_relu
+    return dense_relu(x)

@@ -1,0 +1,29 @@
+"""paddle_tpu.static — static-graph API surface.
+
+The reference's ProgramDesc/Executor stack (SURVEY.md §3.3) has no TPU
+analog: jax tracing + jit IS the static graph. This module keeps the
+commonly-scripted entry points as thin adapters over paddle_tpu.jit so
+static-style user code ports mechanically.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the traced "
+        "StableHLO + params artifact replaces save_inference_model")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path)")
+
+
+class Executor:
+    def __init__(self, place=None):
+        raise NotImplementedError(
+            "paddle_tpu has no Program/Executor; decorate your function "
+            "with paddle_tpu.jit.to_static and call it directly")

@@ -1,0 +1,11 @@
+"""paddle.tensor namespace: re-export the functional op surface.
+
+~ python/paddle/tensor/__init__.py.
+"""
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.reduction import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.activation import *  # noqa: F401,F403
+from ..core.tensor import Tensor, to_tensor  # noqa: F401

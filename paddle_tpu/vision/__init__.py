@@ -1,0 +1,8 @@
+"""paddle_tpu.vision — models/transforms/datasets.
+
+~ python/paddle/vision/ (11.3k LoC: 13 model families, transforms,
+MNIST/Cifar/... datasets).
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401

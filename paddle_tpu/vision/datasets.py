@@ -1,0 +1,172 @@
+"""Vision datasets.
+
+~ python/paddle/vision/datasets/ (mnist.py, cifar.py, ImageFolder). Zero
+egress environment: loaders read standard local files (IDX/pickle formats)
+when present; MNIST additionally has a deterministic synthetic fallback so
+the LeNet smoke config runs anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+_SEARCH_DIRS = [
+    os.path.expanduser("~/.cache/paddle_tpu/datasets"),
+    "/root/data", "/data", "/tmp/datasets",
+]
+
+
+def _find(fname):
+    for d in _SEARCH_DIRS:
+        p = os.path.join(d, fname)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _read_idx(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(dims)
+
+
+def _synthetic_digits(n, seed):
+    """Deterministic separable 28x28 'digits': class-dependent stripe+blob
+    patterns + noise. Linearly separable enough for >98% train accuracy —
+    serves the smoke-test role of MNIST when no local copy exists."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n)
+    images = np.zeros((n, 28, 28), dtype=np.float32)
+    yy, xx = np.mgrid[0:28, 0:28]
+    for c in range(10):
+        mask = labels == c
+        k = int(mask.sum())
+        if k == 0:
+            continue
+        base = (np.sin(xx * (c + 1) * 0.35) + np.cos(yy * (c + 2) * 0.3))
+        cx, cy = 6 + (c % 5) * 4, 6 + (c // 5) * 12
+        blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 18.0))
+        pattern = (0.5 * base + 2.0 * blob).astype(np.float32)
+        images[mask] = pattern[None] + rng.normal(
+            0, 0.3, size=(k, 28, 28)).astype(np.float32)
+    images = (images - images.min()) / (images.max() - images.min() + 1e-6)
+    return (images * 255).astype(np.uint8), labels.astype(np.int64)
+
+
+class MNIST(Dataset):
+    """~ python/paddle/vision/datasets/mnist.py."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        prefix = "train" if mode == "train" else "t10k"
+        img = image_path or _find(f"{prefix}-images-idx3-ubyte.gz") \
+            or _find(f"{prefix}-images-idx3-ubyte")
+        lab = label_path or _find(f"{prefix}-labels-idx1-ubyte.gz") \
+            or _find(f"{prefix}-labels-idx1-ubyte")
+        if img and lab:
+            self.images = _read_idx(img)
+            self.labels = _read_idx(lab).astype(np.int64)
+        else:
+            n = 60000 if mode == "train" else 10000
+            self.images, self.labels = _synthetic_digits(
+                n, seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # (1,28,28)
+        img = img / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """~ python/paddle/vision/datasets/cifar.py. Local pickle batches or
+    synthetic fallback."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        import pickle
+        found = data_file or _find("cifar-10-batches-py")
+        if found and os.path.isdir(found):
+            xs, ys = [], []
+            names = [f"data_batch_{i}" for i in range(1, 6)] \
+                if mode == "train" else ["test_batch"]
+            for nme in names:
+                with open(os.path.join(found, nme), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"])
+                ys.extend(d[b"labels"])
+            self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+            self.labels = np.asarray(ys, dtype=np.int64)
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            n = 50000 if mode == "train" else 10000
+            self.labels = rng.integers(0, 10, n).astype(np.int64)
+            self.images = rng.integers(
+                0, 255, (n, 3, 32, 32)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class ImageFolder(Dataset):
+    """Directory-of-class-dirs loader (~ vision/datasets/folder.py)."""
+
+    def __init__(self, root, transform=None, loader=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith((".npy", ".png", ".jpg", ".jpeg")):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        if path.endswith(".npy"):
+            img = np.load(path).astype(np.float32)
+        else:
+            from PIL import Image
+            img = np.asarray(Image.open(path), dtype=np.float32) / 255.0
+            if img.ndim == 3:
+                img = img.transpose(2, 0, 1)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)

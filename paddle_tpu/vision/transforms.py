@@ -1,0 +1,130 @@
+"""Vision transforms (numpy, CHW float32).
+
+~ python/paddle/vision/transforms/ — host-side preprocessing composed into
+the DataLoader worker threads.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, x):
+        return (x - self.mean) / self.std
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        pass
+
+    def __call__(self, x):
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim == 2:
+            x = x[None]
+        elif x.ndim == 3 and x.shape[-1] in (1, 3, 4):
+            x = x.transpose(2, 0, 1)
+        if x.max() > 1.5:
+            x = x / 255.0
+        return x
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        c, h, w = x.shape
+        th, tw = self.size
+        ys = (np.arange(th) * (h / th)).astype(np.int64).clip(0, h - 1)
+        xs = (np.arange(tw) * (w / tw)).astype(np.int64).clip(0, w - 1)
+        return x[:, ys][:, :, xs]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        c, h, w = x.shape
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        return x[:, i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, x):
+        if self.padding:
+            x = np.pad(x, [(0, 0), (self.padding, self.padding),
+                           (self.padding, self.padding)])
+        c, h, w = x.shape
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return x[:, i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return x[:, :, ::-1].copy()
+        return x
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, x):
+        c, h, w = x.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = x[:, i:i + ch, j:j + cw]
+                return Resize(self.size)(crop)
+        return Resize(self.size)(CenterCrop(min(h, w))(x))
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, x):
+        return np.transpose(x, self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, x):
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(x * alpha, 0, 1).astype(np.float32)

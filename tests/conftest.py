@@ -1,0 +1,31 @@
+"""Test config: force CPU backend with 8 virtual devices.
+
+Mirrors the reference test strategy (SURVEY.md §4): numpy-oracle op tests on
+CPU; distributed parity over a virtual device mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8 is the gloo analog).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU plugin and overrides
+# JAX_PLATFORMS; the programmatic config update still wins if applied before
+# first backend use.
+if os.environ.get("PADDLE_TPU_TEST_ON_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

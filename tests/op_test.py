@@ -1,0 +1,93 @@
+"""OpTest harness: numpy-oracle forward checks + numeric gradient checks.
+
+~ python/paddle/fluid/tests/unittests/op_test.py:292 (check_output:1728,
+check_grad:1817 — central finite differences vs analytic grads). Runs on
+the CPU backend in float64-capable mode for tight tolerances.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(api: Callable, inputs: Sequence[np.ndarray], expected,
+                 attrs: dict | None = None, atol=1e-5, rtol=5e-4):
+    """Run the eager op on Tensor inputs and compare with numpy oracle."""
+    attrs = attrs or {}
+    t_in = [paddle.to_tensor(x) if isinstance(x, np.ndarray) else x
+            for x in inputs]
+    out = api(*t_in, **attrs)
+    if isinstance(expected, (list, tuple)):
+        assert isinstance(out, (list, tuple)), f"expected multi-output"
+        for o, e in zip(out, expected):
+            np.testing.assert_allclose(np.asarray(o._value), e, atol=atol,
+                                       rtol=rtol)
+    else:
+        np.testing.assert_allclose(np.asarray(out._value), expected,
+                                   atol=atol, rtol=rtol)
+    return out
+
+
+def check_grad(api: Callable, inputs: Sequence[np.ndarray],
+               grad_inputs: Sequence[int] | None = None,
+               attrs: dict | None = None, delta=1e-3, atol=1e-2, rtol=1e-2,
+               output_index=None):
+    """Numeric finite-difference grad check (~ op_test.py check_grad:1817).
+
+    Builds scalar loss = sum(op(inputs)) and compares tape gradients against
+    central differences computed in float64 numpy.
+    """
+    attrs = attrs or {}
+    if grad_inputs is None:
+        grad_inputs = [i for i, x in enumerate(inputs)
+                       if isinstance(x, np.ndarray)
+                       and np.issubdtype(x.dtype, np.floating)]
+
+    def run_loss(np_inputs):
+        t_in = [paddle.to_tensor(x.astype(np.float32), stop_gradient=False)
+                if isinstance(x, np.ndarray)
+                and np.issubdtype(x.dtype, np.floating)
+                else (paddle.to_tensor(x) if isinstance(x, np.ndarray) else x)
+                for x in np_inputs]
+        out = api(*t_in, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[output_index if output_index is not None else 0]
+        return out, t_in
+
+    # analytic grads via tape
+    out, t_in = run_loss(inputs)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = {}
+    for i in grad_inputs:
+        g = t_in[i].grad
+        assert g is not None, f"no grad for input {i}"
+        analytic[i] = np.asarray(g._value, dtype=np.float64)
+
+    # numeric central differences
+    for i in grad_inputs:
+        x = np.asarray(inputs[i], dtype=np.float64)
+        num = np.zeros_like(x)
+        flat = x.reshape(-1)
+        num_flat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + delta
+            plus, _ = run_loss([x.astype(np.float32) if k == i else v
+                                for k, v in enumerate(inputs)])
+            lp = float(np.asarray(
+                (plus.sum() if plus.size > 1 else plus)._value))
+            flat[j] = orig - delta
+            minus, _ = run_loss([x.astype(np.float32) if k == i else v
+                                 for k, v in enumerate(inputs)])
+            lm = float(np.asarray(
+                (minus.sum() if minus.size > 1 else minus)._value))
+            flat[j] = orig
+            num_flat[j] = (lp - lm) / (2 * delta)
+        np.testing.assert_allclose(
+            analytic[i], num, atol=atol, rtol=rtol,
+            err_msg=f"grad mismatch for input {i} of {api}")

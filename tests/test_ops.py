@@ -1,0 +1,283 @@
+"""Op tests with numpy oracles + numeric grad checks (~ OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+from op_test import check_grad, check_output
+
+
+class TestElementwise:
+    def test_add(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        check_output(ops.add, [a, b], a + b)
+        check_grad(ops.add, [a, b])
+
+    def test_broadcast_add_grad(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        check_output(ops.add, [a, b], a + b)
+        check_grad(ops.add, [a, b])
+
+    def test_mul_div(self):
+        a = np.random.rand(2, 3).astype(np.float32) + 1
+        b = np.random.rand(2, 3).astype(np.float32) + 1
+        check_output(ops.multiply, [a, b], a * b)
+        check_grad(ops.multiply, [a, b])
+        check_output(ops.divide, [a, b], a / b)
+        check_grad(ops.divide, [a, b])
+
+    def test_pow_exp_log(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 0.5
+        check_output(ops.exp, [a], np.exp(a))
+        check_grad(ops.exp, [a])
+        check_output(ops.log, [a], np.log(a))
+        check_grad(ops.log, [a])
+        check_output(ops.sqrt, [a], np.sqrt(a))
+        check_grad(ops.sqrt, [a])
+
+    def test_trig(self):
+        a = np.random.randn(8).astype(np.float32)
+        check_output(ops.sin, [a], np.sin(a))
+        check_output(ops.cos, [a], np.cos(a))
+        check_grad(ops.tanh, [a])
+
+    def test_clip(self):
+        a = np.random.randn(10).astype(np.float32)
+        check_output(ops.clip, [a], np.clip(a, -0.5, 0.5),
+                     attrs={"min": -0.5, "max": 0.5})
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        a = np.ones(3, np.float32)
+        b = np.zeros(3, np.float32)
+        check_output(ops.where, [c, a, b], np.where(c, a, b))
+
+    def test_comparison(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        check_output(ops.greater_than, [a, b], a > b)
+        check_output(ops.equal, [a, b], a == b)
+
+
+class TestReduction:
+    def test_sum_mean(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        check_output(ops.sum, [a], a.sum())
+        check_output(ops.sum, [a], a.sum(1), attrs={"axis": 1})
+        check_output(ops.mean, [a], a.mean((0, 2)), attrs={"axis": [0, 2]})
+        check_grad(ops.mean, [np.random.randn(3, 4).astype(np.float32)],
+                   attrs={"axis": 1})
+
+    def test_max_min_grad(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        check_output(ops.max, [a], a.max(1), attrs={"axis": 1})
+        check_output(ops.min, [a], a.min())
+
+    def test_argmax(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        check_output(ops.argmax, [a], a.argmax(1), attrs={"axis": 1})
+
+    def test_std_var(self):
+        a = np.random.randn(6, 7).astype(np.float32)
+        check_output(ops.std, [a], a.std(ddof=1), rtol=1e-4)
+        check_output(ops.var, [a], a.var(0, ddof=1), attrs={"axis": 0},
+                     rtol=1e-4)
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as sp_lse
+        a = np.random.randn(4, 6).astype(np.float32)
+        check_output(ops.logsumexp, [a], sp_lse(a, axis=1),
+                     attrs={"axis": 1}, rtol=1e-4)
+        check_grad(ops.logsumexp, [a], attrs={"axis": 1})
+
+    def test_cumsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        check_output(ops.cumsum, [a], a.cumsum(1), attrs={"axis": 1})
+        check_grad(ops.cumsum, [a], attrs={"axis": 0})
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        check_output(ops.reshape, [a], a.reshape(6, 4),
+                     attrs={"shape": [6, 4]})
+        check_grad(ops.reshape, [a], attrs={"shape": [24]})
+        check_output(ops.transpose, [a], a.transpose(2, 0, 1),
+                     attrs={"perm": [2, 0, 1]})
+        check_grad(ops.transpose, [a], attrs={"perm": [1, 0, 2]})
+
+    def test_concat_stack(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        out = ops.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b]))
+        out = ops.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([a, b], 1))
+
+    def test_concat_grad(self):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+        out = ops.concat([a, b], axis=0)
+        (out * 2).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), 2 * np.ones((2, 2)))
+        np.testing.assert_allclose(b.grad.numpy(), 2 * np.ones((3, 2)))
+
+    def test_split_squeeze(self):
+        a = np.random.randn(6, 4).astype(np.float32)
+        parts = ops.split(paddle.to_tensor(a), 3, axis=0)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), a[2:4])
+        parts = ops.split(paddle.to_tensor(a), [2, -1], axis=0)
+        np.testing.assert_allclose(parts[1].numpy(), a[2:])
+        b = np.random.randn(1, 3, 1).astype(np.float32)
+        check_output(ops.squeeze, [b], b.squeeze())
+        check_output(ops.unsqueeze, [b.squeeze()], b.squeeze()[None],
+                     attrs={"axis": 0})
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], np.int64)
+        check_output(ops.gather, [a, idx], a[idx])
+        check_grad(ops.gather, [a, idx], grad_inputs=[0])
+        upd = np.ones((2, 3), np.float32)
+        out = ops.scatter(paddle.to_tensor(a),
+                          paddle.to_tensor(np.array([1, 3])),
+                          paddle.to_tensor(upd))
+        exp = a.copy()
+        exp[[1, 3]] = 1
+        np.testing.assert_allclose(out.numpy(), exp)
+
+    def test_tile_expand(self):
+        a = np.random.randn(1, 3).astype(np.float32)
+        check_output(ops.tile, [a], np.tile(a, (2, 2)),
+                     attrs={"repeat_times": [2, 2]})
+        check_output(ops.expand, [a], np.broadcast_to(a, (4, 3)),
+                     attrs={"shape": [4, 3]})
+        check_grad(ops.expand, [a], attrs={"shape": [4, 3]})
+
+    def test_sort_topk(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        check_output(ops.sort, [a], np.sort(a, -1))
+        vals, idx = ops.topk(paddle.to_tensor(a), 3)
+        np.testing.assert_allclose(vals.numpy(),
+                                   -np.sort(-a, -1)[:, :3], rtol=1e-6)
+
+    def test_pad(self):
+        a = np.random.randn(2, 3, 4, 5).astype(np.float32)
+        out = ops.pad(paddle.to_tensor(a), [1, 1, 2, 2])
+        assert out.shape == [2, 3, 6, 9]
+
+    def test_flip_roll(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        check_output(ops.flip, [a], a[::-1], attrs={"axis": [0]})
+        check_output(ops.roll, [a], np.roll(a, 1, 0),
+                     attrs={"shifts": 1, "axis": 0})
+
+    def test_one_hot(self):
+        x = np.array([0, 2, 1], np.int64)
+        check_output(ops.one_hot, [x], np.eye(3, dtype=np.float32)[x],
+                     attrs={"num_classes": 3})
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check_output(ops.matmul, [a, b], a @ b, rtol=1e-4)
+        check_grad(ops.matmul, [a, b])
+
+    def test_matmul_transpose(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(5, 4).astype(np.float32)
+        check_output(ops.matmul, [a, b], a.T @ b.T,
+                     attrs={"transpose_x": True, "transpose_y": True},
+                     rtol=1e-4)
+
+    def test_batched_matmul(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        check_output(ops.bmm, [a, b], a @ b, rtol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        out = ops.einsum("ij,jk->ik", paddle.to_tensor(a),
+                         paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+    def test_solve_inverse(self):
+        a = (np.random.randn(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        b = np.random.randn(3, 2).astype(np.float32)
+        check_output(ops.solve, [a, b], np.linalg.solve(a, b), rtol=1e-3)
+        check_output(ops.inverse, [a], np.linalg.inv(a), rtol=1e-3)
+
+    def test_norm(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        check_output(ops.norm, [a], np.sqrt((a ** 2).sum()), rtol=1e-4)
+
+    def test_svd_qr(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        u, s, vt = ops.svd(paddle.to_tensor(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vt.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+        q, r = ops.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+
+    def test_cholesky(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        L = ops.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestActivation:
+    @pytest.mark.parametrize("name,ref", [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("softplus", lambda x: np.log1p(np.exp(x))),
+        ("silu", lambda x: x / (1 + np.exp(-x))),
+    ])
+    def test_forward(self, name, ref):
+        a = np.random.randn(4, 5).astype(np.float32)
+        api = getattr(ops, name)
+        check_output(api, [a], ref(a), rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("name", ["relu", "sigmoid", "tanh", "gelu",
+                                      "silu", "softplus", "elu"])
+    def test_grad(self, name):
+        a = (np.random.randn(3, 4) + 0.1).astype(np.float32)
+        check_grad(getattr(ops, name), [a])
+
+    def test_softmax(self):
+        a = np.random.randn(3, 5).astype(np.float32)
+        e = np.exp(a - a.max(-1, keepdims=True))
+        check_output(ops.softmax, [a], e / e.sum(-1, keepdims=True),
+                     rtol=1e-5)
+        check_grad(ops.softmax, [a])
+
+
+class TestRandomOps:
+    def test_shapes_and_ranges(self):
+        u = paddle.uniform([100], min=0.0, max=1.0)
+        assert u.shape == [100]
+        assert float(u.min()._value) >= 0.0
+        assert float(u.max()._value) <= 1.0
+        r = paddle.randint(0, 5, [50])
+        assert int(r.max()._value) < 5
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_dropout_train_eval(self):
+        from paddle_tpu.nn import functional as F
+        x = paddle.ones([1000])
+        y = F.dropout(x, p=0.5, training=True)
+        keep_frac = (y.numpy() != 0).mean()
+        assert 0.35 < keep_frac < 0.65
+        np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)
+        y_eval = F.dropout(x, p=0.5, training=False)
+        np.testing.assert_allclose(y_eval.numpy(), x.numpy())

@@ -1,0 +1,172 @@
+"""Tensor + tape autograd tests (~ test_imperative_basic.py family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Parameter, Tensor
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert t.shape == [3]
+    assert t.dtype == np.float32
+    np.testing.assert_allclose(t.numpy(), [1, 2, 3])
+
+
+def test_tensor_dtype_cast():
+    t = paddle.to_tensor(np.arange(6).reshape(2, 3))
+    f = t.astype("float32")
+    assert f.dtype == np.float32
+
+
+def test_arith_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain_and_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    z = (y * y).sum() + (x * 2.0).sum()
+    z.backward()
+    # dz/dx = 18x + 2
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 38.0])
+
+
+def test_backward_twice_accumulates():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_backward_freed_graph_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+    y2 = x * 2
+    assert y2._grad_node is not None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = (x * 2 + y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    w = Parameter(np.asarray([3.0], dtype=np.float32))
+    y = (x * w).sum()
+    (gx,) = paddle.grad(y, x, retain_graph=False)
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    # paddle.grad must not pollute w.grad
+    assert w.grad is None
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    y = (a * b).sum()   # y = 6 x^2, dy/dx = 12x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0, 24.0])
+
+
+def test_multi_output_op_grad():
+    from paddle_tpu.ops.manipulation import split
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    parts = split(x, 3)
+    loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    y = x[1:3, :2].sum()
+    y.backward()
+    expected = np.zeros((4, 4))
+    expected[1:3, :2] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_setitem():
+    x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y2 = x * 2
+    y2.backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_int_inputs_no_grad():
+    idx = paddle.to_tensor(np.array([0, 1], np.int64))
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    from paddle_tpu.ops.manipulation import gather
+    out = gather(x, idx, axis=0)
+    out.sum().backward()
+    assert x.grad is not None
+
+
+def test_rng_reproducibility():
+    paddle.seed(7)
+    a = paddle.randn([4])
+    paddle.seed(7)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.randn([4])
+    assert not np.allclose(b.numpy(), c.numpy())
+
+
+def test_save_load(tmp_path):
+    state = {"w": paddle.to_tensor([1.0, 2.0]), "step": 3,
+             "nested": {"b": paddle.ones([2, 2])}}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), [1, 2])
+    assert loaded["step"] == 3
+    np.testing.assert_allclose(loaded["nested"]["b"].numpy(),
+                               np.ones((2, 2)))
