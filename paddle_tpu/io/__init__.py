@@ -275,6 +275,7 @@ class _DataLoaderIter:
         self._out = {}
         self._out_lock = threading.Lock()
         self._next_seq = 0
+        self._done_workers = 0
         self._feeder = threading.Thread(target=self._feed, daemon=True)
         for _ in range(nw):
             t = threading.Thread(target=self._worker, daemon=True)
@@ -310,7 +311,6 @@ class _DataLoaderIter:
         return self
 
     def __next__(self):
-        done_workers = 0
         while True:
             with self._out_lock:
                 if self._next_seq in self._out:
@@ -319,11 +319,11 @@ class _DataLoaderIter:
                     if isinstance(data, Exception):
                         raise data
                     return self.loader._to_tensors(data)
+            if self._done_workers >= len(self._threads) and not self._out:
+                raise StopIteration
             item = self._queue.get()
             if item is None:
-                done_workers += 1
-                if done_workers >= len(self._threads) and not self._out:
-                    raise StopIteration
+                self._done_workers += 1
                 continue
             seq, data = item
             with self._out_lock:

@@ -1,0 +1,3 @@
+"""Model zoo. Vision lives in paddle_tpu.vision.models (hapi layout); NLP
+model families (BERT/GPT/Llama/MoE — the PaddleNLP capability slots) here."""
+from . import nlp  # noqa: F401

@@ -1,0 +1,6 @@
+from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_train_step_factory,
+)
+from .moe import MoEConfig, MoEForCausalLM  # noqa: F401

@@ -1,0 +1,345 @@
+"""Llama family — the flagship hybrid-parallel model (BASELINE config 4).
+
+Capability slot of the PaddleNLP llm/ Llama recipe running on the
+reference's fleet 4D parallelism (SURVEY.md §2.2). TPU-native design:
+
+  * nn.Layer model built from the TP parallel layers (GSPMD sharding
+    annotations on weights: attention/ffn column+row split over 'model',
+    embeddings over vocab) — the eager / checkpoint-compatible surface.
+  * ``llama_train_step_factory``: the compiled path. Takes a Mesh with axes
+    (data, sep, model) [+ pipe via paddle_tpu.parallel.pipeline], lays out
+    params by their sharding_spec, shards the batch on 'data' and the
+    sequence on 'sep' (context parallelism — EXCEEDS the reference, which
+    has no sequence parallel, SURVEY.md §5), and returns a jitted
+    loss+grad+adamw step. XLA inserts all collectives (psum over 'model'
+    for row-parallel matmuls, all_gathers for column outputs, grad psums
+    over 'data') — the role of the reference's hand-written
+    c_allreduce/reducer stack.
+
+Architecture (standard Llama-3): RMSNorm pre-norm, rotary embeddings, GQA,
+SwiGLU MLP, tied-off LM head, causal flash attention (Pallas kernel on the
+jit path).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ... import nn
+from ...core.tensor import Parameter, Tensor
+from ...distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                                RowParallelLinear,
+                                                VocabParallelEmbedding)
+from ...nn import functional as F
+from ...ops.dispatch import apply_op
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def llama3_8b():
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_hidden_layers=32,
+                           num_attention_heads=32, num_key_value_heads=8,
+                           rope_theta=500000.0)
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2):
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                           intermediate_size=hidden * 2,
+                           num_hidden_layers=layers,
+                           num_attention_heads=heads,
+                           num_key_value_heads=kv_heads,
+                           max_position_embeddings=512, dtype=jnp.float32)
+
+
+def _rope_freqs(head_dim, theta):
+    return 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+
+
+def apply_rotary(x, positions, theta):
+    """x: (..., seq, heads, head_dim)."""
+    head_dim = x.shape[-1]
+    freqs = jnp.asarray(_rope_freqs(head_dim, theta), jnp.float32)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (s, hd/2)
+    cos = jnp.cos(angles)[..., None, :]  # (s, 1, hd/2)
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.rope_theta = c.rope_theta
+        self.q_proj = ColumnParallelLinear(c.hidden_size, c.hidden_size,
+                                           has_bias=False)
+        self.k_proj = ColumnParallelLinear(
+            c.hidden_size, self.num_kv_heads * self.head_dim, has_bias=False)
+        self.v_proj = ColumnParallelLinear(
+            c.hidden_size, self.num_kv_heads * self.head_dim, has_bias=False)
+        self.o_proj = RowParallelLinear(c.hidden_size, c.hidden_size,
+                                        has_bias=False)
+
+    def forward(self, x, positions=None):
+        B, S, H = x.shape
+        q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
+
+        theta = self.rope_theta
+        n_rep = self.num_heads // self.num_kv_heads
+
+        def attn(qv, kv, vv):
+            pos = jnp.arange(S) if positions is None else positions
+            qv = apply_rotary(qv, pos, theta)
+            kv = apply_rotary(kv, pos, theta)
+            if n_rep > 1:
+                kv = jnp.repeat(kv, n_rep, axis=2)
+                vv = jnp.repeat(vv, n_rep, axis=2)
+            scale = 1.0 / math.sqrt(qv.shape[-1])
+            qt = jnp.swapaxes(qv, 1, 2)
+            kt = jnp.swapaxes(kv, 1, 2)
+            vt = jnp.swapaxes(vv, 1, 2)
+            use_flash = (S >= 256 and S % 128 == 0
+                         and qt.shape[-1] in (64, 128, 256)
+                         and qt.dtype in (jnp.float32, jnp.bfloat16))
+            if use_flash:
+                try:
+                    from ...ops.pallas.flash_attention import flash_attention
+                    out = flash_attention(qt, kt, vt, True)
+                    return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
+                except Exception:
+                    pass
+            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(causal, s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qt.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
+
+        ctx = apply_op("llama_attention", attn, q, k, v)
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.gate_proj = ColumnParallelLinear(c.hidden_size,
+                                              c.intermediate_size,
+                                              has_bias=False)
+        self.up_proj = ColumnParallelLinear(c.hidden_size,
+                                            c.intermediate_size,
+                                            has_bias=False)
+        self.down_proj = RowParallelLinear(c.intermediate_size, c.hidden_size,
+                                           has_bias=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, positions=None):
+        x = x + self.self_attn(self.input_layernorm(x), positions)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, positions=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, positions)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(config.hidden_size,
+                                                config.vocab_size,
+                                                has_bias=False)
+
+    def forward(self, input_ids, positions=None):
+        h = self.model(input_ids, positions)
+        if self.lm_head is None:
+            from ...ops.linalg import matmul
+            return matmul(h, self.model.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(h)
+
+    # -- generation (greedy, incremental) ----------------------------------
+    def generate(self, input_ids, max_new_tokens=16):
+        from ...autograd import no_grad
+        out = input_ids
+        with no_grad():
+            for _ in range(max_new_tokens):
+                logits = self(out)
+                nxt = logits[:, -1].argmax(-1)
+                from ...ops.manipulation import concat, unsqueeze
+                out = concat([out, unsqueeze(nxt, 1)], axis=1)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Compiled GSPMD training path
+# ---------------------------------------------------------------------------
+
+def param_shardings(model: nn.Layer, mesh: Mesh) -> Dict[str, NamedSharding]:
+    """Build NamedShardings from the layers' sharding_spec annotations,
+    keeping only axes that exist in the mesh (degenerate axes drop out)."""
+    out = {}
+    for name, p in model.state_dict().items():
+        spec = getattr(p, "sharding_spec", None)
+        if spec is None:
+            out[name] = NamedSharding(mesh, P())
+        else:
+            fixed = []
+            for s in spec:
+                if s is None or s in mesh.axis_names:
+                    fixed.append(s)
+                else:
+                    fixed.append(None)
+            out[name] = NamedSharding(mesh, P(*fixed))
+    return out
+
+
+def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
+                             learning_rate=1e-4, weight_decay=0.01,
+                             beta1=0.9, beta2=0.95, eps=1e-8,
+                             accum_dtype=jnp.float32,
+                             remat: bool = True):
+    """Returns (params, opt_state, train_step) for pjit execution.
+
+    Shardings: params per annotation; adamw moments mirror the params but
+    additionally sharded over 'sharding' axis if present (ZeRO-1); batch on
+    'data'; sequence on 'sep' (context parallel).
+    """
+    config = model.config
+    shardings = param_shardings(model, mesh)
+    params = {k: jax.device_put(v._value, shardings[k])
+              for k, v in model.state_dict().items()}
+
+    def zero_like_sharded(name, v):
+        sh = shardings[name]
+        spec = list(sh.spec) + [None] * (v.ndim - len(sh.spec))
+        if "sharding" in mesh.axis_names and \
+                mesh.shape.get("sharding", 1) > 1:
+            # ZeRO: shard moments along the largest unsharded dim
+            for i in np.argsort([-s for s in v.shape]):
+                i = int(i)
+                if spec[i] is None and v.shape[i] % mesh.shape["sharding"] == 0:
+                    spec[i] = "sharding"
+                    break
+        return jax.device_put(jnp.zeros(v.shape, accum_dtype),
+                              NamedSharding(mesh, P(*spec)))
+
+    opt_state = {
+        "step": jnp.zeros((), jnp.int32),
+        "m": {k: zero_like_sharded(k, v) for k, v in params.items()},
+        "v": {k: zero_like_sharded(k, v) for k, v in params.items()},
+    }
+
+    batch_sharding = NamedSharding(
+        mesh, P("data" if "data" in mesh.axis_names else None,
+                "sep" if "sep" in mesh.axis_names else None))
+
+    def forward_loss(params, tokens, labels):
+        model.load_tree(params)
+        logits = model(Tensor(tokens))._value
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+        return jnp.mean(nll)
+
+    loss_fn = forward_loss
+    if remat:
+        loss_fn = jax.checkpoint(forward_loss)
+
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+        lr = learning_rate
+
+        def upd(p, g, m, v):
+            g = g.astype(accum_dtype)
+            m2 = beta1 * m + (1 - beta1) * g
+            v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+            mhat = m2 / (1 - beta1 ** t)
+            vhat = v2 / (1 - beta2 ** t)
+            delta = mhat / (jnp.sqrt(vhat) + eps) \
+                + weight_decay * p.astype(accum_dtype)
+            return (p.astype(accum_dtype) - lr * delta).astype(p.dtype), m2, v2
+
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            new_p[k], new_m[k], new_v[k] = upd(
+                params[k], grads[k], opt_state["m"][k], opt_state["v"][k])
+        return new_p, {"step": step, "m": new_m, "v": new_v}, loss
+
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(shardings,
+                      {"step": NamedSharding(mesh, P()),
+                       "m": {k: opt_state["m"][k].sharding for k in params},
+                       "v": {k: opt_state["v"][k].sharding for k in params}},
+                      batch_sharding, batch_sharding),
+        out_shardings=(shardings,
+                       {"step": NamedSharding(mesh, P()),
+                        "m": {k: opt_state["m"][k].sharding for k in params},
+                        "v": {k: opt_state["v"][k].sharding for k in params}},
+                       NamedSharding(mesh, P())),
+        donate_argnums=(0, 1),
+    )
+    return params, opt_state, jitted, batch_sharding

@@ -103,42 +103,47 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     spatial = "DHW"[3 - n:]
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
-    rhs_spec = "IO" + spatial  # paddle stores transpose conv weight as (in, out/groups, *k)
-    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
-                                        (lhs_spec, rhs_spec, lhs_spec))
+    # paddle stores transpose-conv weight as (in, out/groups, *k); running the
+    # equivalent fractionally-strided forward conv means: treat dim0 as the
+    # conv's input channels (spec "IO...") and flip the kernel spatially
+    # (the explicit form of lax's old transpose_kernel flag).
+    rhs_spec = "IO" + spatial
+    spatial_axes = tuple(range(2, 2 + n))
     pad = _norm_padding(padding, n)
     strides = _tuplize(stride, n)
     dils = _tuplize(dilation, n)
     if isinstance(pad, str):
-        pad_cfg = pad
-    else:
-        # grad-of-conv padding: k_eff - 1 - p
-        ksp = weight.shape[2:]
-        pad_cfg = []
-        out_pad = _tuplize(output_padding, n)
-        for i in range(n):
-            k_eff = (ksp[i] - 1) * dils[i] + 1
-            lo = k_eff - 1 - pad[i][0]
-            hi = k_eff - 1 - pad[i][1] + out_pad[i]
-            pad_cfg.append((lo, hi))
+        if pad == "SAME":
+            pad = [( (dils[i] * (weight.shape[2 + i] - 1)) // 2,
+                     (dils[i] * (weight.shape[2 + i] - 1) + 1) // 2)
+                   for i in range(n)]
+        else:
+            pad = [(0, 0)] * n
+    # grad-of-conv padding: k_eff - 1 - p
+    ksp = weight.shape[2:]
+    pad_cfg = []
+    out_pad = _tuplize(output_padding, n)
+    for i in range(n):
+        k_eff = (ksp[i] - 1) * dils[i] + 1
+        lo = k_eff - 1 - pad[i][0]
+        hi = k_eff - 1 - pad[i][1] + out_pad[i]
+        pad_cfg.append((lo, hi))
+
+    def one_group(a, w):
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape,
+                                            (lhs_spec, rhs_spec, lhs_spec))
+        return jax.lax.conv_general_dilated(
+            a, jnp.flip(w, spatial_axes), window_strides=(1,) * n,
+            padding=pad_cfg, lhs_dilation=strides, rhs_dilation=dils,
+            dimension_numbers=dn)
+
     if groups != 1:
-        # grouped transpose conv: split and concat
         xi = jnp.split(x, groups, axis=-1 if channel_last else 1)
         wi = jnp.split(weight, groups, axis=0)
-        outs = [jax.lax.conv_general_dilated(
-            a, jnp.swapaxes(w, 0, 1) if False else w,
-            window_strides=(1,) * n, padding=pad_cfg,
-            lhs_dilation=strides, rhs_dilation=dils,
-            dimension_numbers=jax.lax.conv_dimension_numbers(
-                a.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec)),
-            transpose_kernel=True)
-            for a, w in zip(xi, wi)]
+        outs = [one_group(a, w) for a, w in zip(xi, wi)]
         out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
     else:
-        out = jax.lax.conv_general_dilated(
-            x, weight, window_strides=(1,) * n, padding=pad_cfg,
-            lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn,
-            transpose_kernel=True)
+        out = one_group(x, weight)
     if bias is not None:
         if channel_last:
             out = out + bias.reshape((1,) * (n + 1) + (-1,))

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels — the hand-kernel slots of the reference
+(operators/fused/*.cu) rebuilt for the MXU/VMEM model."""
+from .flash_attention import flash_attention  # noqa: F401
+from .layer_norm import fused_layer_norm, fused_rms_norm  # noqa: F401

@@ -1,0 +1,131 @@
+"""End-to-end slice: LeNet on (synthetic) MNIST — BASELINE config 1.
+
+~ the reference's test_mnist.py hapi test. Exercises the full stack:
+DataLoader -> eager forward -> tape backward -> Adam step, plus the
+jit'ed (to_static analog) training path used by bench.py.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_forward_shape():
+    model = LeNet()
+    x = paddle.randn([4, 1, 28, 28])
+    out = model(x)
+    assert out.shape == [4, 10]
+
+
+def test_lenet_trains_eager():
+    paddle.seed(0)
+    model = LeNet()
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    train = MNIST(mode="train")
+    # small slice for speed
+    train.images = train.images[:512]
+    train.labels = train.labels[:512]
+    loader = DataLoader(train, batch_size=64, shuffle=True)
+
+    first_loss = last_loss = None
+    for epoch in range(3):
+        for x, y in loader:
+            logits = model(x)
+            loss = F.cross_entropy(logits, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first_loss is None:
+                first_loss = float(loss._value)
+            last_loss = float(loss._value)
+    assert last_loss < first_loss * 0.7, (first_loss, last_loss)
+
+    # accuracy on train slice should be well above chance
+    model.eval()
+    correct = total = 0
+    for x, y in DataLoader(train, batch_size=128):
+        pred = model(x).numpy().argmax(-1)
+        correct += (pred == y.numpy()).sum()
+        total += len(pred)
+    assert correct / total > 0.5
+
+
+def test_lenet_trains_jit():
+    """The perf path: functional jit'ed train step (to_static role)."""
+    import jax
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    model = LeNet()
+    params = model.tree_flatten_params()
+
+    def loss_fn(params, x, y):
+        model.load_tree(params)
+        with paddle.no_grad():
+            pass
+        logits = model(paddle.Tensor(x))
+        loss = F.cross_entropy(logits, paddle.Tensor(y))
+        return loss._value
+
+    @jax.jit
+    def train_step(params, x, y, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    train = MNIST(mode="train")
+    xs = train.images[:256].astype(np.float32)[:, None] / 255.0
+    ys = train.labels[:256]
+    losses = []
+    for i in range(20):
+        j = (i * 64) % 256
+        params, loss = train_step(params, jnp.asarray(xs[j:j + 64]),
+                                  jnp.asarray(ys[j:j + 64]), 0.05)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_dataloader_workers():
+    train = MNIST(mode="train")
+    train.images = train.images[:200]
+    train.labels = train.labels[:200]
+    loader = DataLoader(train, batch_size=32, num_workers=2, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 7
+    assert batches[0][0].shape == [32, 1, 28, 28]
+    # order preserved vs sync loader
+    sync = list(DataLoader(train, batch_size=32, num_workers=0))
+    np.testing.assert_allclose(batches[0][0].numpy(), sync[0][0].numpy())
+    np.testing.assert_allclose(batches[3][1].numpy(), sync[3][1].numpy())
+
+
+def test_jit_to_static_layer():
+    model = LeNet()
+    model.eval()
+    static_fn = paddle.jit.to_static(model.forward)
+    x = paddle.randn([2, 1, 28, 28])
+    out_static = static_fn(x)
+    out_eager = model(x)
+    np.testing.assert_allclose(out_static.numpy(), out_eager.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_jit_save_load(tmp_path):
+    model = LeNet()
+    model.eval()
+    path = str(tmp_path / "lenet")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.jit.InputSpec([1, 1, 28, 28])])
+    import os
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+    loaded = paddle.jit.load(path)
+    st = loaded.state_dict()
+    assert "features.0.weight" in st
+    # hlo text contains convolution op
+    assert "convolution" in loaded._hlo_text

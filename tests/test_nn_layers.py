@@ -1,0 +1,310 @@
+"""nn.Layer + layer zoo tests (~ test_layers.py family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+            self.w = self.create_parameter((2, 2))
+            self.register_buffer("buf", paddle.ones([2]))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    names = dict(m.named_parameters())
+    assert set(names) == {"w", "fc.weight", "fc.bias"}
+    sd = m.state_dict()
+    assert "buf" in sd
+    assert len(m.parameters()) == 3
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m1 = nn.Linear(4, 3)
+    m2 = nn.Linear(4, 3)
+    paddle.save(m1.state_dict(), str(tmp_path / "m.pdparams"))
+    m2.set_state_dict(paddle.load(str(tmp_path / "m.pdparams")))
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(3, 3), nn.Dropout(0.5))
+    assert m.training
+    m.eval()
+    assert not m[1].training
+    m.train()
+    assert m[1].training
+
+
+def test_forward_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h1 = m.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = m.register_forward_post_hook(
+        lambda layer, inp, out: calls.append("post"))
+    m(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    m(paddle.ones([1, 2]))
+    assert calls == []
+
+
+def test_linear_math():
+    m = nn.Linear(3, 2)
+    x = paddle.ones([4, 3])
+    out = m(x)
+    expected = x.numpy() @ m.weight.numpy() + m.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+
+def test_conv2d_shape_and_grad():
+    m = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.to_tensor(np.random.randn(2, 3, 16, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = m(x)
+    assert out.shape == [2, 8, 8, 8]
+    out.sum().backward()
+    assert m.weight.grad is not None
+    assert x.grad.shape == [2, 3, 16, 16]
+
+
+def test_conv2d_vs_scipy():
+    from scipy.signal import correlate2d
+    x = np.random.randn(1, 1, 8, 8).astype(np.float32)
+    w = np.random.randn(1, 1, 3, 3).astype(np.float32)
+    m = nn.Conv2D(1, 1, 3, bias_attr=False)
+    m.weight.set_value(w)
+    out = m(paddle.to_tensor(x))
+    ref = correlate2d(x[0, 0], w[0, 0], mode="valid")
+    np.testing.assert_allclose(out.numpy()[0, 0], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_inverts_shape():
+    m = nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1, output_padding=1)
+    x = paddle.randn([2, 4, 8, 8])
+    out = m(x)
+    assert out.shape == [2, 3, 16, 16]
+
+
+def test_grouped_depthwise_conv():
+    m = nn.Conv2D(8, 8, 3, groups=8, padding=1)
+    x = paddle.randn([1, 8, 5, 5])
+    assert m(x).shape == [1, 8, 5, 5]
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[5, 7], [13, 15]])
+    out = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    out = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[7.5]])
+
+
+def test_batchnorm_stats_update():
+    m = nn.BatchNorm2D(3, momentum=0.9)
+    x = paddle.to_tensor(
+        (np.random.randn(8, 3, 4, 4) * 2 + 5).astype(np.float32))
+    m.train()
+    out = m(x)
+    # output approx standardized
+    o = out.numpy()
+    assert abs(o.mean()) < 0.1
+    assert abs(o.std() - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert np.all(m._mean.numpy() > 0.1)
+    m.eval()
+    out_eval = m(x)
+    assert out_eval.shape == [8, 3, 4, 4]
+
+
+def test_layernorm():
+    m = nn.LayerNorm(6)
+    x = paddle.to_tensor(np.random.randn(4, 6).astype(np.float32) * 3 + 1)
+    out = m(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+
+def test_rmsnorm():
+    m = nn.RMSNorm(8)
+    x = paddle.randn([2, 8])
+    out = m(x).numpy()
+    rms = np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, x.numpy() / rms, rtol=1e-4, atol=1e-5)
+
+
+def test_groupnorm_instancenorm():
+    x = paddle.randn([2, 8, 4, 4])
+    gn = nn.GroupNorm(4, 8)
+    assert gn(x).shape == [2, 8, 4, 4]
+    inorm = nn.InstanceNorm2D(8)
+    assert inorm(x).shape == [2, 8, 4, 4]
+
+
+def test_embedding():
+    m = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 2], [0, 3]], np.int64))
+    out = m(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[1, 0], np.zeros(4))
+    out.sum().backward()
+    g = m.weight.grad.numpy()
+    assert np.allclose(g[0], 0)
+    assert not np.allclose(g[1], 0)
+
+
+def test_sequential_and_layerlist():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert m(paddle.ones([1, 4])).shape == [1, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.random.randn(8, 5).astype(np.float32),
+                              stop_gradient=False)
+    labels = paddle.to_tensor(np.random.randint(0, 5, 8).astype(np.int64))
+    loss = F.cross_entropy(logits, labels)
+    assert loss.size == 1
+    loss.backward()
+    assert logits.grad is not None
+    # numpy oracle
+    x = logits.numpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(8), labels.numpy()]).mean()
+    np.testing.assert_allclose(float(loss._value), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, -100, 2, 1], np.int64))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    x = logits.numpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2, 3], [0, 2, 1]]).mean()
+    np.testing.assert_allclose(float(loss._value), ref, rtol=1e-5)
+    soft = paddle.to_tensor(np.full((4, 3), 1 / 3, np.float32))
+    loss2 = F.cross_entropy(logits, soft, soft_label=True)
+    assert loss2.size == 1
+
+
+def test_bce_mse():
+    p = paddle.to_tensor(np.array([0.3, 0.7], np.float32))
+    y = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+    ref = -(np.log(0.7) + np.log(0.7)) / 2
+    np.testing.assert_allclose(
+        float(F.binary_cross_entropy(p, y)._value), ref, rtol=1e-5)
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([0.0, 0.0], np.float32))
+    np.testing.assert_allclose(float(F.mse_loss(a, b)._value), 2.5)
+
+
+def test_multihead_attention():
+    m = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = m(x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_mha_cache_incremental():
+    m = nn.MultiHeadAttention(8, 2)
+    m.eval()
+    x = paddle.randn([1, 4, 8])
+    cache = m.gen_cache(x, type=nn.MultiHeadAttention.Cache)
+    step = paddle.randn([1, 1, 8])
+    out, cache = m(step, step, step, None, cache)
+    assert out.shape == [1, 1, 8]
+    assert cache.k.shape[1] == 1
+    out, cache = m(step, step, step, None, cache)
+    assert cache.k.shape[1] == 2
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    # each cloned layer has独立 params
+    p0 = enc.layers[0].linear1.weight.numpy()
+    p1 = enc.layers[1].linear1.weight.numpy()
+    assert p0.shape == p1.shape
+
+
+def test_full_transformer():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+    src = paddle.randn([2, 4, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_scaled_dot_product_attention_causal():
+    q = paddle.randn([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+    # causal: first position output depends only on first kv
+    q2_np = q.numpy().copy()
+    q2_np[:, 1:] = 0
+    out2 = F.scaled_dot_product_attention(
+        paddle.to_tensor(q.numpy()), paddle.to_tensor(q2_np),
+        paddle.to_tensor(q2_np), is_causal=True)
+    np.testing.assert_allclose(out.numpy()[:, 0], out2.numpy()[:, 0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.randn([2, 5, 4])
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]
+    gru = nn.GRU(4, 8, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm_cell_grad():
+    cell = nn.LSTMCell(3, 4)
+    x = paddle.to_tensor(np.random.randn(2, 3).astype(np.float32),
+                         stop_gradient=False)
+    h, (h2, c2) = cell(x)
+    h.sum().backward()
+    assert x.grad is not None
+    assert cell.weight_ih.grad is not None
+
+
+def test_interpolate():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = F.interpolate(x, size=[4, 4], mode="nearest")
+    assert out.shape == [1, 1, 4, 4]
+    out = F.interpolate(x, scale_factor=2, mode="bilinear")
+    assert out.shape == [1, 1, 4, 4]
+
+
+def test_pixel_shuffle():
+    x = paddle.randn([1, 8, 2, 2])
+    out = F.pixel_shuffle(x, 2)
+    assert out.shape == [1, 2, 4, 4]
+
+
+def test_to_dtype():
+    m = nn.Linear(2, 2)
+    m.to(dtype="bfloat16")
+    assert m.weight.dtype == paddle.bfloat16

@@ -1,0 +1,191 @@
+"""Optimizer + LR scheduler + amp tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.tensor import Parameter
+
+
+def _quadratic_param():
+    return Parameter(np.array([5.0, -3.0], dtype=np.float32))
+
+
+def _step(opt, p, n=1):
+    for _ in range(n):
+        loss = (p * p).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def test_sgd_descends():
+    p = _quadratic_param()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    _step(opt, p, 50)
+    assert np.abs(p.numpy()).max() < 0.01
+
+
+def test_sgd_matches_formula():
+    p = Parameter(np.array([2.0], dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=0.5, parameters=[p])
+    (p * 3.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.5])  # 2 - 0.5*3
+
+
+def test_momentum():
+    p = _quadratic_param()
+    opt = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                             parameters=[p])
+    _step(opt, p, 200)
+    assert np.abs(p.numpy()).max() < 0.05
+
+
+def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.random.randn(4, 3).astype(np.float32)
+    g = np.random.randn(4, 3).astype(np.float32)
+
+    p = Parameter(w0.copy())
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    for _ in range(3):
+        p._grad = paddle.to_tensor(g)
+        opt.step()
+
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.Adam([tp], lr=0.01, eps=1e-8)
+    for _ in range(3):
+        tp.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_adamw_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.random.randn(4).astype(np.float32)
+    g = np.random.randn(4).astype(np.float32)
+    p = Parameter(w0.copy())
+    opt = optimizer.AdamW(learning_rate=0.01, weight_decay=0.1,
+                          parameters=[p])
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.1)
+    for _ in range(3):
+        p._grad = paddle.to_tensor(g)
+        opt.step()
+        tp.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    p = _quadratic_param()
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    _step(opt, p, 3)
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+
+    p2 = _quadratic_param()
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p2])
+    opt2.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+    assert opt2._step_count == 3
+    accs = opt2._accumulators[id(p2)]
+    ref = opt._accumulators[id(p)]
+    np.testing.assert_allclose(np.asarray(accs["m"]), np.asarray(ref["m"]))
+
+
+def test_grad_clip_global_norm():
+    p = Parameter(np.array([1.0], dtype=np.float32))
+    clip = nn.ClipGradByGlobalNorm(0.5)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    p._grad = paddle.to_tensor(np.array([10.0], np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.5], rtol=1e-5)  # 1 - 1*0.5
+
+
+def test_lr_scheduler_step():
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = _quadratic_param()
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for _ in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+
+def test_warmup_scheduler():
+    sched = optimizer.lr.LinearWarmup(learning_rate=0.1, warmup_steps=4,
+                                      start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(6):
+        vals.append(sched())
+        sched.step()
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075])
+    np.testing.assert_allclose(vals[4:], [0.1, 0.1])
+
+
+def test_cosine_scheduler():
+    sched = optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    v0 = sched()
+    sched.step(5)
+    v5 = sched()
+    np.testing.assert_allclose(v0, 1.0)
+    np.testing.assert_allclose(v5, 0.5, atol=1e-6)
+
+
+def test_reduce_on_plateau():
+    sched = optimizer.lr.ReduceOnPlateau(learning_rate=1.0, patience=1,
+                                         factor=0.1)
+    for loss in [1.0, 1.0, 1.0]:
+        sched.step(loss)
+    assert sched() == pytest.approx(0.1)
+
+
+class TestAmp:
+    def test_auto_cast_casts_matmul(self):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        with paddle.amp.auto_cast():
+            out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+        out2 = paddle.matmul(a, b)
+        assert out2.dtype == np.float32
+
+    def test_black_list_stays_fp32(self):
+        a = paddle.randn([4])
+        with paddle.amp.auto_cast():
+            out = paddle.exp(a)
+        assert out.dtype == np.float32
+
+    def test_grad_scaler_noop_path(self):
+        p = Parameter(np.array([1.0], dtype=np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(enable=False)
+        loss = (p * 2).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-6)
+
+    def test_grad_scaler_dynamic(self):
+        p = Parameter(np.array([1.0], dtype=np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       incr_every_n_steps=1)
+        loss = (p * 2).sum()
+        scaled = scaler.scale(loss)
+        np.testing.assert_allclose(float(scaled._value), 8.0)
+        scaled.backward()
+        scaler.step(opt)
+        # grads unscaled before update: p = 1 - 0.1*2
+        np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-6)
+
+    def test_grad_scaler_inf_skips_step(self):
+        p = Parameter(np.array([1.0], dtype=np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        p._grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [1.0])
+        assert scaler._scale < 4.0 or scaler._bad > 0
