@@ -1,0 +1,112 @@
+"""Benchmark: flagship Llama training step on one real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric = model FLOPs utilization (MFU) of a causal-LM training step
+(fwd+bwd+adamw, bf16 params, f32 moments, remat, Pallas flash attention).
+vs_baseline = MFU / 0.40 — the north-star ladder target is >=40% MFU
+(BASELINE.md config 4). The reference publishes no numbers (BASELINE.md),
+so the MFU ceiling is the honest yardstick.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PEAK_FLOPS = {
+    # bf16 peak per chip
+    "v5 lite": 394e12 / 2,   # v5e: 197 bf16 TFLOP/s
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v6": 918e12,
+    "cpu": 1e12,
+}
+
+
+def peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama import llama_train_step_factory
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        # ~0.5B-param Llama slice that fits one v5e with adam moments
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048,
+                          dtype=jnp.bfloat16)
+        B, S = 8, 2048
+        steps, warmup = 10, 3
+    else:
+        cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4)
+        B, S = 2, 128
+        steps, warmup = 2, 1
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    params, opt_state, step, _ = llama_train_step_factory(
+        model, mesh, learning_rate=1e-4, remat=True)
+
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = B * S
+    tok_per_sec = tokens_per_step / dt
+    # standard 6ND causal-LM training FLOPs + attention term
+    attn_flops = (12 * cfg.num_hidden_layers * cfg.hidden_size * S
+                  * tokens_per_step)
+    flops_per_step = 6 * n_params * tokens_per_step + attn_flops
+    mfu = (flops_per_step / dt) / peak_for(dev)
+
+    print(json.dumps({
+        "metric": "llama_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+            "step_ms": round(dt * 1000, 2),
+            "params": n_params,
+            "batch": B, "seq": S,
+            "device": str(dev),
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

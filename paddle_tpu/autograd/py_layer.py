@@ -1,0 +1,103 @@
+"""PyLayer: user-defined autograd functions.
+
+~ python/paddle/autograd/py_layer.py (eager PyLayer over
+paddle/fluid/eager/pylayer/). The tape records a node whose pullback calls
+the user's static ``backward``; ``ctx.save_for_backward`` keeps forward
+tensors (the TensorWrapper role).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.tensor import Tensor
+from . import tape as _tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(
+            f"use {cls.__name__}.apply(...) — PyLayer is not instantiated")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+        with _tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient]
+        if _tape.grad_enabled() and diff_inputs:
+            out_avals = [(tuple(o.shape), o._value.dtype) for o in out_list
+                         if isinstance(o, Tensor)]
+
+            def vjp_fn(cts):
+                if not isinstance(cts, (tuple, list)):
+                    cts = (cts,)
+                ct_tensors = [Tensor(c) for c in cts]
+                with _tape.no_grad():
+                    grads = cls.backward(ctx, *ct_tensors)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                vals = []
+                for g in grads:
+                    if g is None:
+                        vals.append(None)
+                    else:
+                        vals.append(g._value if isinstance(g, Tensor) else g)
+                # align with diff_inputs: user returns one grad per
+                # *tensor* input (paddle contract)
+                tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+                out = []
+                gi = 0
+                for a in tensor_inputs:
+                    g = vals[gi] if gi < len(vals) else None
+                    gi += 1
+                    if not a.stop_gradient:
+                        out.append(g if g is not None
+                                   else jnp.zeros(a.shape, a._value.dtype))
+                return tuple(out)
+
+            node = _tape.GradNode(cls.__name__, vjp_fn, diff_inputs,
+                                  out_avals)
+            idx = 0
+            for o in out_list:
+                if isinstance(o, Tensor):
+                    o.stop_gradient = False
+                    o._grad_node = node
+                    o._output_index = idx
+                    idx += 1
+        return out_list[0] if single else tuple(out_list)
+
+
+class LegacyPyLayer(PyLayer):
+    pass

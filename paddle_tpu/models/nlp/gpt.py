@@ -1,0 +1,72 @@
+"""GPT — decoder-only transformer (PaddleNLP GPT capability slot)."""
+from __future__ import annotations
+
+import dataclasses
+
+from ... import nn
+from ...nn import functional as F
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-5
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, intermediate_size=128,
+                         dropout=0.0)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.attn = nn.MultiHeadAttention(c.hidden_size,
+                                          c.num_attention_heads, c.dropout)
+        self.ln_2 = nn.LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.fc1 = nn.Linear(c.hidden_size, c.intermediate_size)
+        self.fc2 = nn.Linear(c.intermediate_size, c.hidden_size)
+        self.dropout = nn.Dropout(c.dropout)
+
+    def forward(self, x, mask):
+        x = x + self.attn(self.ln_1(x), attn_mask=mask)
+        h = self.ln_2(x)
+        return x + self.dropout(self.fc2(F.gelu(self.fc1(h))))
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList(
+            [GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids):
+        import paddle_tpu as paddle
+        import jax.numpy as jnp
+        from ...ops.dispatch import apply_op
+        S = input_ids.shape[1]
+        pos = paddle.arange(S, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        mask = apply_op(
+            "causal_mask",
+            lambda: jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0,
+                              jnp.finfo(jnp.float32).min), nondiff=True)
+        for blk in self.blocks:
+            x = blk(x, mask)
+        x = self.ln_f(x)
+        from ...ops.linalg import matmul
+        return matmul(x, self.wte.weight, transpose_y=True)

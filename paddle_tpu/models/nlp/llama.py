@@ -295,8 +295,12 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                 "sep" if "sep" in mesh.axis_names else None))
 
     def forward_loss(params, tokens, labels):
+        saved = model.tree_flatten_params()
         model.load_tree(params)
-        logits = model(Tensor(tokens))._value
+        try:
+            logits = model(Tensor(tokens))._value
+        finally:
+            model.load_tree(saved)  # don't leave tracers in the Layer
         logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, -1)
         nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]

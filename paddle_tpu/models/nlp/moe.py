@@ -1,0 +1,108 @@
+"""MoE decoder LM — BASELINE config 5 (DeepSeekMoE / Qwen2-MoE slot).
+
+Llama-style decoder where MLPs alternate with MoELayer (expert parallel
+over the 'expert' mesh axis; dispatch einsum = compiled all_to_all).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...incubate.distributed.models.moe import MoELayer
+from .llama import LlamaAttention, LlamaConfig
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    intermediate_size: int = 2816
+    num_hidden_layers: int = 8
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2  # every Nth layer is MoE
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    aux_loss_weight: float = 0.01
+
+    @staticmethod
+    def tiny():
+        return MoEConfig(vocab_size=256, hidden_size=64,
+                         intermediate_size=128, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=4,
+                         num_experts=4, moe_every=1)
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            dtype=jnp.float32)
+
+
+class MoEDecoderLayer(nn.Layer):
+    def __init__(self, config: MoEConfig, use_moe: bool):
+        super().__init__()
+        lc = config.as_llama()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = LlamaAttention(lc)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        if use_moe:
+            self.mlp = MoELayer(config.hidden_size, config.intermediate_size,
+                                config.num_experts,
+                                gate="gshard" if config.top_k == 2
+                                else "switch",
+                                capacity_factor=config.capacity_factor,
+                                top_k=config.top_k)
+        else:
+            from .llama import LlamaMLP
+            self.mlp = LlamaMLP(lc)
+        self.use_moe = use_moe
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class MoEForCausalLM(nn.Layer):
+    def __init__(self, config: MoEConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.layers = nn.LayerList([
+            MoEDecoderLayer(config,
+                            use_moe=(i % config.moe_every
+                                     == config.moe_every - 1))
+            for i in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        x = self.norm(x)
+        from ...ops.linalg import matmul
+        return matmul(x, self.embed_tokens.weight, transpose_y=True)
+
+    def aux_loss(self):
+        total = None
+        for layer in self.layers:
+            if layer.use_moe and layer.mlp.aux_loss is not None:
+                al = layer.mlp.aux_loss
+                total = al if total is None else total + al
+        if total is None:
+            import paddle_tpu as paddle
+            return paddle.zeros([])
+        return total * self.config.aux_loss_weight

@@ -1,0 +1,125 @@
+"""Structured control-flow ops.
+
+~ the reference's controlflow operators (paddle/fluid/operators/controlflow/
+conditional_block_op.cc, while_op.cc) and paddle.static.nn.cond/while_loop.
+On TPU these ARE the dy2static story: data-dependent control flow inside
+jit must be lax.cond/while_loop/scan; eagerly they just execute.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op
+
+
+def _unwrap(tree):
+    return jax.tree.map(lambda x: x._value if isinstance(x, Tensor) else x,
+                        tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree.map(lambda x: Tensor(x) if isinstance(x, jax.Array)
+                        else x, tree)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """~ paddle.static.nn.cond / lax.cond hybrid.
+
+    Eager (concrete pred): runs the chosen branch directly — autograd tape
+    records through it. Traced (pred is a tracer): lowers to lax.cond.
+    """
+    pv = pred._value if isinstance(pred, Tensor) else pred
+    if isinstance(pv, jax.core.Tracer):
+        ops_v = _unwrap(operands)
+
+        def tf(ops):
+            return _unwrap(true_fn(*_wrap(ops)))
+
+        def ff(ops):
+            return _unwrap(false_fn(*_wrap(ops)))
+        return _wrap(jax.lax.cond(pv, tf, ff, ops_v))
+    if bool(pv):
+        return true_fn(*operands)
+    return false_fn(*operands)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
+    """~ paddle.static.nn.while_loop (fluid/layers/control_flow.py).
+
+    Eager: python loop (tape-recorded). Traced: lax.while_loop with shape
+    invariants enforced by jax.
+    """
+    loop_vars = list(loop_vars)
+    vals = _unwrap(loop_vars)
+    leaves = jax.tree.leaves(vals)
+    if any(isinstance(l, jax.core.Tracer) for l in leaves):
+        def cf(vs):
+            out = cond_fn(*_wrap(vs))
+            return out._value if isinstance(out, Tensor) else out
+
+        def bf(vs):
+            return _unwrap(list(body_fn(*_wrap(vs))))
+        return _wrap(jax.lax.while_loop(cf, bf, vals))
+    while bool(_unwrap(cond_fn(*loop_vars))
+               if isinstance(cond_fn(*loop_vars), Tensor)
+               else cond_fn(*loop_vars)):
+        loop_vars = list(body_fn(*loop_vars))
+    return loop_vars
+
+
+def scan(body_fn: Callable, init, xs, length=None):
+    """jax-native scan surfaced at the framework level (no direct reference
+    analog — the TPU-idiomatic replacement for unrolled RNN loops)."""
+    init_v = _unwrap(init)
+    xs_v = _unwrap(xs)
+
+    def bf(carry, x):
+        c, y = body_fn(_wrap(carry), _wrap(x))
+        return _unwrap(c), _unwrap(y)
+    carry, ys = jax.lax.scan(bf, init_v, xs_v, length=length)
+    return _wrap(carry), _wrap(ys)
+
+
+def case(pred_fn_pairs, default=None):
+    """~ paddle.static.nn.case."""
+    for pred, fn in pred_fn_pairs:
+        pv = pred._value if isinstance(pred, Tensor) else pred
+        if bool(pv):
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken and no default given")
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """~ paddle.static.nn.switch_case; lowers to lax.switch when traced."""
+    iv = branch_index._value if isinstance(branch_index, Tensor) \
+        else branch_index
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        index_map = {k: i for i, k in enumerate(keys)}
+    else:
+        fns = list(branch_fns)
+        index_map = None
+    if isinstance(iv, jax.core.Tracer):
+        def mk(fn):
+            return lambda _: _unwrap(fn())
+        return _wrap(jax.lax.switch(jnp.clip(iv, 0, len(fns) - 1),
+                                    [mk(f) for f in fns], 0))
+    i = int(iv)
+    if index_map is not None:
+        i = index_map.get(i, None)
+        if i is None:
+            if default is not None:
+                return default()
+            raise ValueError(f"branch {iv} not found")
+    if 0 <= i < len(fns):
+        return fns[i]()
+    if default is not None:
+        return default()
+    raise IndexError(f"branch index {i} out of range")

@@ -21,6 +21,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
+def _interpret() -> bool:
+    # CPU backend (tests / sim meshes) runs kernels in interpreter mode
+    import jax
+    return jax.default_backend() == "cpu"
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
@@ -162,7 +168,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     grid = (bh, Sq // block_q)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k, kv_len=Sk)
-    out, lse = pl.pallas_call(
+    out, lse = functools.partial(pl.pallas_call, interpret=_interpret())(
         kernel,
         grid=grid,
         in_specs=[
@@ -216,7 +222,7 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
     dor = do.reshape(bh, Sq, D)
     lser = lse.reshape(bh, Sq)
 
-    dq = pl.pallas_call(
+    dq = functools.partial(pl.pallas_call, interpret=_interpret())(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=Sk),
         grid=(bh, Sq // block_q),
@@ -234,7 +240,7 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
             dimension_semantics=("parallel", "arbitrary")),
     )(qr, kr, vr, dor, lser, delta)
 
-    dk, dv = pl.pallas_call(
+    dk, dv = functools.partial(pl.pallas_call, interpret=_interpret())(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, q_len=Sq),
         grid=(bh, Sk // block_k),

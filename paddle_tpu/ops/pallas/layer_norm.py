@@ -15,6 +15,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+
+def _interpret() -> bool:
+    # CPU backend (tests / sim meshes) runs kernels in interpreter mode
+    import jax
+    return jax.default_backend() == "cpu"
+
 BLOCK_ROWS = 256
 
 
@@ -51,7 +57,7 @@ def fused_layer_norm(x, weight, bias, eps=1e-5):
     xr = x.reshape(-1, H)
     R = xr.shape[0]
     br = _rows_block(R)
-    out = pl.pallas_call(
+    out = functools.partial(pl.pallas_call, interpret=_interpret())(
         functools.partial(_ln_kernel, eps=eps),
         grid=(R // br,),
         in_specs=[
@@ -72,7 +78,7 @@ def fused_rms_norm(x, weight, eps=1e-6):
     xr = x.reshape(-1, H)
     R = xr.shape[0]
     br = _rows_block(R)
-    out = pl.pallas_call(
+    out = functools.partial(pl.pallas_call, interpret=_interpret())(
         functools.partial(_rms_kernel, eps=eps),
         grid=(R // br,),
         in_specs=[

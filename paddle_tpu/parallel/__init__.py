@@ -1,0 +1,13 @@
+"""Compiled parallelism primitives (the perf path).
+
+The eager wrappers in distributed/fleet provide API parity; this package is
+where the TPU-native execution actually scales:
+  pipeline.py        — GPipe/1F1B pipeline as shard_map + ppermute + scan
+                       over the 'pipe' mesh axis (replaces SectionWorker /
+                       p2p_communication / fleet_executor interceptors)
+  ring_attention.py  — sequence/context parallelism over the 'sep' axis
+                       (ppermute KV rotation; absent from the reference,
+                       SURVEY.md §5)
+"""
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,103 @@
+"""Compiled pipeline parallelism.
+
+Replaces the reference's pipeline machinery (SURVEY.md §2.2): the dygraph
+1F1B loop (fleet/meta_parallel/pipeline_parallel.py:81), NCCL p2p protocol
+(pp_utils/p2p_communication.py:217), static SectionWorker
+(framework/section_worker.cc) and the fleet_executor actor runtime
+(distributed/fleet_executor/carrier.h:49).
+
+TPU-native form: ONE SPMD program. Stage parameters are stacked along a
+leading axis sharded over the 'pipe' mesh axis; a lax.scan steps the
+software pipeline; jax.lax.ppermute rotates activations stage->stage over
+ICI. Backward is jax.grad of the scan (ppermute transposes to the reverse
+rotation), with jax.checkpoint on the stage body bounding activation
+memory — the compiled equivalent of 1F1B's schedule-managed buffers.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params):
+    """[stage_tree_0, ...] -> one tree with leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   n_microbatches: int, axis: str = "pipe",
+                   remat: bool = True):
+    """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
+
+    stage_fn(stage_params, activation) -> activation (same shape) — the body
+    of ONE stage (e.g. a block of decoder layers).
+    stacked_params: pytree, each leaf (n_stages, ...), sharded over `axis`.
+    x: (batch, ...) global input; it is split into n_microbatches along
+    batch inside the program.
+    Returns y: (batch, ...) output of the last stage, replicated.
+
+    Schedule: classic GPipe fill/steady/drain (n_micro + n_stages - 1
+    ticks). Stage s at tick t computes micro (t - s). 1F1B's memory profile
+    comes from remat + scan rather than schedule interleaving; the compiled
+    program overlaps ppermute with the next tick's compute via XLA's
+    latency-hiding scheduler.
+    """
+    n_stages = mesh.shape[axis]
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def spmd(params, xm):
+        # params: (1, ...) local stage slice; xm: (M, mb, ...) microbatches
+        # (replicated; each stage reads only what it needs)
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis)
+        M = xm.shape[0]
+        ticks = M + n_stages - 1
+        state = jnp.zeros_like(xm[0])          # current activation buffer
+        outputs = jnp.zeros_like(xm)           # last stage writes here
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (if in range) else keeps buffer
+            mb_idx = jnp.clip(t, 0, M - 1)
+            injected = jax.lax.select(
+                jnp.logical_and(stage == 0, t < M),
+                jax.lax.dynamic_index_in_dim(xm, mb_idx, 0, keepdims=False),
+                state)
+            out = body(params, injected)
+            # last stage records micro (t - (n_stages-1))
+            out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+            write = jnp.logical_and(stage == n_stages - 1,
+                                    t >= n_stages - 1)
+            outputs = jax.lax.cond(
+                write,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, out, out_idx, 0),
+                lambda o: o, outputs)
+            # rotate activations forward one stage over ICI
+            nxt = jax.lax.ppermute(
+                out, axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (nxt, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(ticks))
+        # everyone returns the last stage's outputs (broadcast over axis)
+        outputs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, 1.0, 0.0) * outputs, axis)
+        return outputs
+
+    B = x.shape[0]
+    mb = B // n_microbatches
+    xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), P())
+    out_specs = P()
+    fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    y = fn(stacked_params, xm)
+    return y.reshape((B,) + y.shape[2:])

@@ -1,0 +1,92 @@
+"""Ring attention — sequence/context parallelism over the 'sep' mesh axis.
+
+The reference has NO sequence/context parallelism (grep-verified,
+SURVEY.md §0/§5); this is the capability the TPU build adds to reach
+long-context scale. Design: sequence sharded over 'sep'; each step every
+device computes blockwise attention of its local Q against the currently
+held KV chunk with online-softmax accumulation, then rotates KV one
+neighbor over ICI via ppermute. Compute (local attention block) overlaps
+the KV transfer thanks to XLA's latency-hiding scheduler — the classic
+ring schedule.
+
+Causal masking uses global block positions: chunk c attends chunk k fully
+if k < c, diagonally if k == c, not at all if k > c (those steps still run
+for SPMD uniformity; their contribution is masked to -inf).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """q: (B,H,Sq,D); k/v: (B,H,Sk,D); mask broadcastable (Sq,Sk) bool.
+    Returns (scores_max, exp_sum, acc) partials in f32."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, -1)
+    # guard fully-masked rows
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, -1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_safe, l, acc
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
+                   causal: bool = True, sm_scale=None):
+    """q/k/v: GLOBAL (batch, heads, seq, head_dim) arrays (or sharded);
+    seq dim is sharded over `axis` inside. Returns same-shape output."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = mesh.shape[axis]
+
+    def spmd(ql, kl, vl):
+        # local chunks: (B,H,S/n,D)
+        my = jax.lax.axis_index(axis)
+        ql32 = ql.astype(jnp.float32) * sm_scale
+        Sq = ql.shape[2]
+
+        m = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+        l = jnp.zeros(ql.shape[:3], jnp.float32)
+        acc = jnp.zeros(ql32.shape, jnp.float32)
+
+        def step(carry, i):
+            m, l, acc, kb, vb = carry
+            src_chunk = (my - i) % n  # whose KV we hold at step i
+            if causal:
+                full = src_chunk < my
+                diag = src_chunk == my
+                tri = jnp.tril(jnp.ones((Sq, kb.shape[2]), bool))
+                mask = jnp.where(diag, tri, full)
+            else:
+                mask = jnp.ones((Sq, kb.shape[2]), bool)
+            bm, bl, bacc = _block_attn(ql32, kb, vb, mask)
+            m_new = jnp.maximum(m, bm)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(bm - m_new)
+            l_new = alpha * l + beta * bl
+            acc_new = acc * alpha[..., None] + bacc * beta[..., None]
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return (m_new, l_new, acc_new, kb, vb), None
+
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            step, (m, l, acc, kl, vl), jnp.arange(n))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l[..., None]).astype(q.dtype)
+
+    fn = jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None), check_vma=False)
+    return fn(q, k, v)
