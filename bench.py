@@ -55,7 +55,7 @@ def main():
                           max_position_embeddings=2048,
                           dtype=jnp.bfloat16)
         B, S = 8, 2048
-        steps, warmup = 10, 3
+        steps, warmup = 30, 3
     else:
         cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4)
         B, S = 2, 128
@@ -74,15 +74,23 @@ def main():
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
     labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
 
-    for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, tokens, labels)
-    jax.block_until_ready(loss)
+    def timed_run(n):
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            params, opt_state, loss = step(params, opt_state, tokens, labels)
+        lv = float(loss)  # host readback = real synchronization under axon
+        return time.perf_counter() - t0, lv
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens, labels)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / steps
+    timed_run(warmup)  # compile + warm
+    # two-point measurement cancels the fixed dispatch/tunnel overhead
+    t_small, _ = timed_run(max(2, steps // 5))
+    t_big, loss_val = timed_run(steps)
+    dt = (t_big - t_small) / (steps - max(2, steps // 5))
+    if dt <= 0:  # overhead-dominated; fall back to the big run
+        dt = t_big / steps
+    loss = loss_val
 
     tokens_per_step = B * S
     tok_per_sec = tokens_per_step / dt

@@ -4,48 +4,86 @@
 drop forward activations of a segment and recompute them in backward, with
 RNG state restore so dropout masks match.
 
-TPU-native implementation: ``jax.checkpoint`` (remat) composed with the
-eager tape — the segment runs under jax.checkpoint inside the recorded vjp,
-so XLA rematerializes inside the compiled backward. RNG determinism comes
-from pre-drawing the generator offsets (keys are captured as closure
-constants, so forward and recompute see identical randomness — the role of
-the reference's RNG state stash/restore).
+Eager design: the forward runs under no_grad (no residuals retained — the
+memory saving); the tape records ONE node whose pullback re-runs the
+function with grad enabled and backpropagates through the fresh subgraph.
+Parameter grads accumulate directly (the re-run touches the same Parameter
+objects); input cotangents are returned to the outer graph. RNG (seed,
+offset) state is snapshotted and restored so dropout masks match — the role
+of the reference's CUDA RNG state stash.
+
+Compiled paths use jax.checkpoint directly (see llama_train_step_factory);
+this module is the eager/tape-level equivalent.
 """
 from __future__ import annotations
 
-import jax
+import jax.numpy as jnp
 
+from ....autograd import tape as _tape
+from ....core import generator as _gen
 from ....core.tensor import Tensor
-from ....ops.dispatch import apply_op
 
 
-def recompute(function, *args, **kwargs):
-    """~ recompute.py:331. function: callable over Tensors."""
-    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
-    use_reentrant = kwargs.pop("use_reentrant", True)
-    del preserve_rng_state, use_reentrant
+def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+    """~ recompute.py:331."""
+    kwargs.pop("use_reentrant", None)
+    rng_state = _gen.get_rng_state() if preserve_rng_state else None
 
-    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
-    others = {i: a for i, a in enumerate(args) if not isinstance(a, Tensor)}
+    with _tape.no_grad():
+        outputs = function(*args, **kwargs)
+    single = isinstance(outputs, Tensor)
+    out_list = [outputs] if single else [o for o in outputs
+                                         if isinstance(o, Tensor)]
 
-    def fn(*tvals):
-        def inner(*vals):
-            merged = []
-            it = iter(vals)
-            for i in range(len(args)):
-                merged.append(others[i] if i in others else Tensor(next(it)))
-            out = function(*merged, **kwargs)
-            if isinstance(out, Tensor):
-                return out._value
-            return tuple(o._value if isinstance(o, Tensor) else o
-                         for o in out)
-        return jax.checkpoint(inner)(*tvals)
+    diff_inputs = [a for a in args
+                   if isinstance(a, Tensor) and not a.stop_gradient]
+    if not _tape.grad_enabled():
+        return outputs
 
-    t_args = [args[i] for i in tensor_idx]
-    return apply_op("recompute", fn, *t_args)
+    def vjp_fn(cts):
+        if not isinstance(cts, (tuple, list)):
+            cts = (cts,)
+        if preserve_rng_state:
+            post_state = _gen.get_rng_state()
+            _gen.set_rng_state(rng_state)
+        # re-run with fresh, grad-tracked input copies
+        replay_args = []
+        replay_inputs = []
+        for a in args:
+            if isinstance(a, Tensor) and not a.stop_gradient:
+                ra = Tensor(a._value, stop_gradient=False)
+                replay_inputs.append(ra)
+                replay_args.append(ra)
+            else:
+                replay_args.append(a)
+        with _tape.enable_grad():
+            re_out = function(*replay_args, **kwargs)
+        if preserve_rng_state:
+            _gen.set_rng_state(post_state)
+        re_list = [re_out] if isinstance(re_out, Tensor) else \
+            [o for o in re_out if isinstance(o, Tensor)]
+        # backprop the cotangents through the replayed subgraph;
+        # parameter grads accumulate as in normal backward
+        _tape.backward(re_list, [Tensor(c) for c in cts])
+        grads = []
+        for ra in replay_inputs:
+            g = ra._grad
+            grads.append(g._value if g is not None
+                         else jnp.zeros(ra.shape, ra._value.dtype))
+        return tuple(grads)
+
+    node = _tape.GradNode("recompute", vjp_fn, diff_inputs,
+                          [(tuple(o.shape), o._value.dtype)
+                           for o in out_list])
+    for i, o in enumerate(out_list):
+        o.stop_gradient = False
+        o._grad_node = node
+        o._output_index = i
+    return outputs
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
+    """~ incubate recompute_sequential — segment a Sequential-like list."""
     segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
     funcs = list(functions)
     seg_size = max(1, len(funcs) // segments)
@@ -58,7 +96,8 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
             for f in _chunk:
                 o = f(*o) if isinstance(o, tuple) else (f(o),)
             return o[0] if len(o) == 1 else o
-        out = recompute(run_chunk, *(out if isinstance(out, tuple) else (out,)))
+        out = recompute(run_chunk,
+                        *(out if isinstance(out, tuple) else (out,)))
         if not isinstance(out, tuple):
             out = (out,)
     return out[0] if isinstance(out, tuple) and len(out) == 1 else out

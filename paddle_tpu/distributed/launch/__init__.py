@@ -1,0 +1,2 @@
+"""Cluster launcher. ~ python/paddle/distributed/launch/ (SURVEY.md §3.5)."""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,170 @@
+"""Launcher entry: python -m paddle_tpu.distributed.launch train.py
+
+~ distributed/launch/main.py:18 + controllers/collective.py:32 (build_pod)
++ job/container.py:97 (subprocess per rank) + controller watch loop.
+
+Per-node it spawns one process per local rank with the env contract
+(PADDLE_MASTER, PADDLE_GLOBAL_RANK, PADDLE_LOCAL_RANK, PADDLE_WORLD_SIZE,
+PADDLE_TRAINER_ENDPOINTS); multi-node rendezvous goes through HTTPMaster
+(node 0). jax.distributed.initialize in the trainer (init_parallel_env)
+then uses PADDLE_MASTER as the coordinator. Elastic mode watches children
+and relaunches the pod on failure (~ ElasticManager, bounded restarts).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+from .master import HTTPMaster
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="host:port of node-0 KV (defaults to localhost)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help=">0: restart pod on child failure (max_restart times)")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--devices", default=None,
+                   help="comma ids exported as PADDLE_VISIBLE_DEVICES")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Container:
+    """One local rank (~ launch/job/container.py)."""
+
+    def __init__(self, cmd: List[str], env: dict, log_path: str | None):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self._log_f = None
+
+    def start(self):
+        out = None
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+            self._log_f = open(self.log_path, "w")
+            out = self._log_f
+        self.proc = subprocess.Popen(
+            self.cmd, env={**os.environ, **self.env}, stdout=out,
+            stderr=subprocess.STDOUT if out else None)
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def returncode(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+def build_pod(args) -> List[Container]:
+    """~ CollectiveController.build_pod (controllers/collective.py:32)."""
+    nproc = args.nproc_per_node
+    if nproc is None:
+        nproc = 1
+    world = args.nnodes * nproc
+    master_ep = args.master or "127.0.0.1:34782"
+
+    endpoints = None
+    if args.nnodes > 1:
+        master = HTTPMaster(master_ep, is_host=args.node_rank == 0)
+        import socket
+        my_ip = socket.gethostbyname(socket.gethostname())
+        peers = master.sync_peers("peers", f"{my_ip}:{nproc}",
+                                  args.node_rank, args.nnodes)
+        endpoints = ",".join(peers)
+
+    containers = []
+    for local_rank in range(nproc):
+        rank = args.node_rank * nproc + local_rank
+        env = {
+            "PADDLE_MASTER": master_ep,
+            "PADDLE_COORDINATOR": master_ep,
+            "PADDLE_GLOBAL_RANK": str(rank),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_WORLD_SIZE": str(world),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_NNODES": str(args.nnodes),
+        }
+        if endpoints:
+            env["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+        if args.devices:
+            env["PADDLE_VISIBLE_DEVICES"] = args.devices
+        log = None
+        if args.log_dir:
+            log = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        containers.append(Container(
+            [sys.executable, args.training_script]
+            + args.training_script_args, env, log))
+    return containers
+
+
+def watch(containers: List[Container], poll: float = 2.0) -> int:
+    """~ controller.watch: exit 0 when all done, kill pod on any failure."""
+    while True:
+        codes = [c.returncode for c in containers]
+        if any(c is not None and c != 0 for c in codes):
+            for c in containers:
+                c.terminate()
+            return next(c for c in codes if c)
+        if all(c == 0 for c in codes):
+            return 0
+        time.sleep(poll)
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    restarts = 0
+    while True:
+        containers = build_pod(args)
+        for c in containers:
+            c.start()
+
+        def handler(sig, frame):
+            for c in containers:
+                c.terminate()
+            sys.exit(1)
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
+
+        code = watch(containers)
+        if code == 0:
+            return 0
+        restarts += 1
+        if args.elastic_level <= 0 or restarts > args.max_restart:
+            return code
+        print(f"[launch] pod failed (exit {code}); elastic restart "
+              f"{restarts}/{args.max_restart}", file=sys.stderr)
+        time.sleep(2.0)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

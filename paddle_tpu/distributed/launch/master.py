@@ -1,0 +1,102 @@
+"""Rendezvous master: HTTP key-value store.
+
+~ distributed/launch/controllers/master.py:66 (HTTPMaster — node-0-hosted
+KV used by peers to exchange endpoints; sync_peers:129, heartbeat:232).
+The ETCD variant is out of scope (external service); the KV contract is the
+same one jax.distributed's coordinator fills for collective init — this
+master only orchestrates process bring-up.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KVHandler(BaseHTTPRequestHandler):
+    store: dict = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.lock:
+            KVHandler.store[self.path] = value
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        with self.lock:
+            if self.path == "/__all__":
+                body = json.dumps(
+                    {k: v.decode() for k, v in KVHandler.store.items()}
+                ).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            value = KVHandler.store.get(self.path)
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(value)
+
+    def do_DELETE(self):
+        with self.lock:
+            KVHandler.store.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class HTTPMaster:
+    """Node-0 hosted KV (~ controllers/master.py:66)."""
+
+    def __init__(self, endpoint: str, is_host: bool):
+        self.endpoint = endpoint
+        self.is_host = is_host
+        self.server = None
+        if is_host:
+            host, port = endpoint.split(":")
+            self.server = ThreadingHTTPServer(("0.0.0.0", int(port)),
+                                              KVHandler)
+            t = threading.Thread(target=self.server.serve_forever,
+                                 daemon=True)
+            t.start()
+
+    def put(self, key: str, value: str):
+        req = urllib.request.Request(
+            f"http://{self.endpoint}/{key}", data=value.encode(),
+            method="PUT")
+        urllib.request.urlopen(req, timeout=10)
+
+    def get(self, key: str):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.endpoint}/{key}", timeout=10) as r:
+                return r.read().decode()
+        except Exception:
+            return None
+
+    def sync_peers(self, prefix: str, my_value: str, rank: int, size: int,
+                   timeout: float = 300.0):
+        """~ master.py sync_peers:129 — publish self, wait for all."""
+        self.put(f"{prefix}/{rank}", my_value)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            values = [self.get(f"{prefix}/{i}") for i in range(size)]
+            if all(v is not None for v in values):
+                return values
+            time.sleep(0.5)
+        raise TimeoutError(f"sync_peers: not all {size} peers reported")
+
+    def stop(self):
+        if self.server:
+            self.server.shutdown()

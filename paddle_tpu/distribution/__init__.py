@@ -1,0 +1,293 @@
+"""Probability distributions.
+
+~ python/paddle/distribution/ (Normal/Uniform/Categorical/Beta/Dirichlet/
+ExponentialFamily + kl_divergence registry). Sampling consumes the global
+Generator; densities are jnp formulas.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import generator as _gen
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+def _t(x):
+    """Keep caller Tensors (so grads flow to them); wrap raw values."""
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x, jnp.float32))
+
+
+class Distribution:
+    """~ distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op("dist_prob", lambda lv: jnp.exp(lv),
+                        self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        z = jax.random.normal(_gen.next_key(), shape, jnp.float32)
+        return Tensor(z * self.scale._value + self.loc._value)
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return apply_op("normal_log_prob", fn, value, self.loc, self.scale)
+
+    def entropy(self):
+        def fn(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return apply_op("normal_entropy", fn, self.scale)
+
+    def cdf(self, value):
+        def fn(v, loc, scale):
+            return 0.5 * (1 + jax.scipy.special.erf(
+                (v - loc) / (scale * math.sqrt(2))))
+        return apply_op("normal_cdf", fn, value, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.low._value.shape, self.high._value.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_gen.next_key(), shape)
+        return Tensor(self.low._value + u * (self.high._value
+                                             - self.low._value))
+
+    def log_prob(self, value):
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply_op("uniform_log_prob", fn, value, self.low, self.high)
+
+    def entropy(self):
+        return apply_op("uniform_entropy",
+                        lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = Tensor(jnp.log(jnp.maximum(_v(probs), 1e-30)))
+        super().__init__(self.logits._value.shape[:-1])
+
+    @property
+    def probs(self):
+        return apply_op("cat_probs", lambda l: jax.nn.softmax(l, -1),
+                        self.logits)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_gen.next_key(), self.logits._value,
+                                     shape=tuple(shape) + self._batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        def fn(logits, v):
+            logp = jax.nn.log_softmax(logits, -1)
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), -1)[..., 0]
+        return apply_op("cat_log_prob", fn, self.logits, value)
+
+    def entropy(self):
+        def fn(logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+        return apply_op("cat_entropy", fn, self.logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = _t(probs)
+        super().__init__(self.probs_t._value.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            _gen.next_key(), self.probs_t._value, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply_op("bern_log_prob", fn, self.probs_t, value)
+
+    def entropy(self):
+        def fn(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply_op("bern_entropy", fn, self.probs_t)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.alpha._value.shape, self.beta._value.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        out = jax.random.beta(_gen.next_key(), self.alpha._value,
+                              self.beta._value, shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def fn(v, a, b):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return apply_op("beta_log_prob", fn, value, self.alpha, self.beta)
+
+    def entropy(self):
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return apply_op("beta_entropy", fn, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        c = self.concentration._value
+        super().__init__(c.shape[:-1], c.shape[-1:])
+
+    def sample(self, shape=()):
+        out = jax.random.dirichlet(_gen.next_key(),
+                                   self.concentration._value,
+                                   tuple(shape) + self._batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def fn(v, c):
+            lnorm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                     - jax.scipy.special.gammaln(jnp.sum(c, -1)))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - lnorm
+        return apply_op("dirichlet_log_prob", fn, value, self.concentration)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._value.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(_gen.next_key(), shape)
+                      / self.rate._value)
+
+    def log_prob(self, value):
+        return apply_op("exp_log_prob",
+                        lambda v, r: jnp.log(r) - r * v, value, self.rate)
+
+    def entropy(self):
+        return apply_op("exp_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self._batch_shape
+        g = jax.random.gumbel(_gen.next_key(), shape)
+        return Tensor(self.loc._value + self.scale._value * g)
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+        return apply_op("gumbel_log_prob", fn, value, self.loc, self.scale)
+
+
+# ---- KL registry -----------------------------------------------------------
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """~ distribution/kl.py kl_divergence with a (type,type) registry."""
+    key = (type(p).__name__, type(q).__name__)
+    if key == ("Normal", "Normal"):
+        def fn(lp, sp, lq, sq):
+            var_ratio = (sp / sq) ** 2
+            t1 = ((lp - lq) / sq) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+        return apply_op("kl_normal", fn, p.loc, p.scale, q.loc, q.scale)
+    if key == ("Categorical", "Categorical"):
+        def fn(lp, lq):
+            a = jax.nn.log_softmax(lp, -1)
+            b = jax.nn.log_softmax(lq, -1)
+            return jnp.sum(jnp.exp(a) * (a - b), -1)
+        return apply_op("kl_cat", fn, p.logits, q.logits)
+    if key == ("Uniform", "Uniform"):
+        def fn(alo, ahi, blo, bhi):
+            return jnp.log((bhi - blo) / (ahi - alo))
+        return apply_op("kl_uniform", fn, p.low, p.high, q.low, q.high)
+    if key == ("Beta", "Beta"):
+        def fn(a1, b1, a2, b2):
+            dg = jax.scipy.special.digamma
+            gl = jax.scipy.special.gammaln
+            lb1 = gl(a1) + gl(b1) - gl(a1 + b1)
+            lb2 = gl(a2) + gl(b2) - gl(a2 + b2)
+            return (lb2 - lb1 + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                    + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+        return apply_op("kl_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
+    raise NotImplementedError(f"kl_divergence not registered for {key}")

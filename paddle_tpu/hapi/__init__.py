@@ -1,0 +1,11 @@
+"""High-level Model API (hapi).
+
+~ python/paddle/hapi/model.py:907 (Model.fit:1557/evaluate/predict) and
+callbacks.py (ModelCheckpoint:533, EarlyStopping:689, LRScheduler:598).
+Single dynamic-graph adapter (the static adapter has no TPU analog — jit
+happens under the hood per-step when enabled).
+"""
+from .model import Model, summary  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)

@@ -1,0 +1,251 @@
+"""hapi Model: fit/evaluate/predict/save/load + summary.
+
+~ python/paddle/hapi/model.py:907 with the DynamicGraphAdapter (:667)
+folded in (there is no static adapter — jit is a per-step detail).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..autograd import no_grad
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class Model:
+    """~ hapi/model.py Model:907."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # -- single-batch ops ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*inputs)
+        losses = self._loss(*(_to_list(outputs) + labels))
+        losses.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*( _to_list(outputs) + labels))))
+            metrics.append(m.accumulate())
+        return ([float(losses._value)], metrics) if metrics \
+            else [float(losses._value)]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*inputs)
+        metrics = []
+        loss_v = None
+        if self._loss is not None and labels:
+            loss_v = [float(self._loss(*(_to_list(outputs) + labels))._value)]
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*( _to_list(outputs) + labels))))
+            metrics.append(m.accumulate())
+        return (loss_v, metrics) if loss_v is not None else metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        return self.network(*_to_list(inputs))
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """~ model.py fit:1557."""
+        from .callbacks import CallbackList, LRScheduler, ProgBarLogger
+
+        train_loader = train_data if isinstance(train_data, DataLoader) \
+            else DataLoader(train_data, batch_size=batch_size,
+                            shuffle=shuffle, drop_last=drop_last,
+                            num_workers=num_workers)
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) \
+                else DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+
+        cbs = _to_list(callbacks)
+        if verbose:
+            cbs = [ProgBarLogger(log_freq, verbose=verbose)] + cbs
+        cbs.append(LRScheduler())
+        cb = CallbackList(cbs)
+        cb.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cb.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+
+        self.stop_training = False
+        cb.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            cb.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, data in enumerate(train_loader):
+                cb.on_train_batch_begin(step)
+                inputs, labels = self._split_data(data)
+                res = self.train_batch(inputs, labels)
+                logs = self._pack_logs(res)
+                cb.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cb)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cb.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cb.on_train_end()
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) \
+            else DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        cb = _callbacks
+        if cb:
+            cb.on_eval_begin()
+        losses = []
+        for data in loader:
+            inputs, labels = self._split_data(data)
+            res = self.eval_batch(inputs, labels)
+            if isinstance(res, tuple):
+                losses.append(res[0][0])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        if cb:
+            cb.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) \
+            else DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers)
+        outputs = []
+        for data in loader:
+            inputs, _ = self._split_data(data)
+            out = self.predict_batch(inputs)
+            outputs.append(out.numpy() if isinstance(out, Tensor) else out)
+        if stack_outputs:
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # -- helpers ------------------------------------------------------------
+    def _split_data(self, data, has_labels=True):
+        if isinstance(data, (list, tuple)):
+            data = list(data)
+            if has_labels and len(data) >= 2:
+                return data[:-1], data[-1:]
+            return data, []
+        return [data], []
+
+    def _pack_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            loss, metrics = res
+            logs["loss"] = loss[0]
+            for m, v in zip(self._metrics, metrics):
+                logs[m.name()] = v
+        else:
+            logs["loss"] = res[0]
+        return logs
+
+    def summary(self, input_size=None, dtype=None):
+        return summary_layer(self.network)
+
+
+def summary_layer(network: Layer):
+    """~ hapi/model_summary.py — parameter count table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in network.named_parameters():
+        n = p.size
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Param':{width}s} {'Shape':24s} {'Count':>12s}"]
+    for name, shape, n in rows:
+        lines.append(f"{name:{width}s} {str(shape):24s} {n:12d}")
+    lines.append("-" * (width + 38))
+    lines.append(f"Total params: {total:,} (trainable {trainable:,})")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def summary(net, input_size=None, dtypes=None):
+    return summary_layer(net)

@@ -295,10 +295,15 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                 "sep" if "sep" in mesh.axis_names else None))
 
     def forward_loss(params, tokens, labels):
+        from ...autograd import no_grad
         saved = model.tree_flatten_params()
         model.load_tree(params)
         try:
-            logits = model(Tensor(tokens))._value
+            # tape off: jax.value_and_grad differentiates this trace; the
+            # eager tape's per-op jax.vjp would otherwise nest a second
+            # linearization around the Pallas custom_vjp kernels
+            with no_grad():
+                logits = model(Tensor(tokens))._value
         finally:
             model.load_tree(saved)  # don't leave tracers in the Layer
         logits = logits.astype(jnp.float32)

@@ -73,7 +73,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m, l, acc = jax.lax.fori_loop(0, num_live, body, (m, l, acc))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None].astype(jnp.float32)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -81,8 +81,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
     dq = jnp.zeros_like(q)
     num_kv = kv_len // block_k
     if causal:
@@ -131,8 +131,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.dslice(qi * block_q, block_q)].astype(jnp.float32)
         do = do_ref[0, pl.dslice(qi * block_q, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+        lse = lse_ref[0, pl.dslice(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.dslice(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -178,16 +178,16 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, Sq, 1), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(qr, kr, vr)
-    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+    return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -215,12 +215,12 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
     Sk = k.shape[2]
     bh = B * H
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, Sq)
+                    axis=-1).reshape(bh, Sq, 1)
     qr = q.reshape(bh, Sq, D)
     kr = k.reshape(bh, Sk, D)
     vr = v.reshape(bh, Sk, D)
     dor = do.reshape(bh, Sq, D)
-    lser = lse.reshape(bh, Sq)
+    lser = lse.reshape(bh, Sq, 1)
 
     dq = functools.partial(pl.pallas_call, interpret=_interpret())(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -231,8 +231,8 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
@@ -249,8 +249,8 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Sq), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, Sq), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, Sq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
