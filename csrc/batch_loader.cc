@@ -1,0 +1,136 @@
+// BatchLoader: threaded batch assembly for fixed-record datasets.
+//
+// Native data-path equivalent of the reference's C++ DataLoader machinery
+// (the shared-memory LoDTensor transport of fluid/dataloader and the
+// framework/data_feed.cc async readers): worker threads gather sample rows
+// from a source buffer (user numpy array or mmap'ed file) into prefetched
+// batch buffers on a lock-free-ish ring, fully outside the GIL.
+//
+// C ABI for ctypes:
+//   bl_create(src_ptr, n_samples, sample_bytes, batch_size, n_threads,
+//             queue_cap) -> handle
+//   bl_submit(handle, indices_ptr, count)  // enqueue one batch's indices
+//   bl_next(handle, out_ptr)               // blocking; copies batch out
+//   bl_destroy(handle)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  int64_t seq;
+  std::vector<char> data;
+};
+
+struct Loader {
+  const char* src;
+  int64_t n_samples;
+  int64_t sample_bytes;
+  int64_t batch_size;
+  size_t queue_cap;
+
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  std::deque<std::pair<int64_t, std::vector<int64_t>>> work;  // seq, indices
+  std::deque<Batch> done;
+  int64_t next_submit = 0;
+  int64_t next_emit = 0;
+  bool stop = false;
+  std::vector<std::thread> threads;
+
+  void worker() {
+    for (;;) {
+      std::pair<int64_t, std::vector<int64_t>> job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return stop || !work.empty(); });
+        if (stop) return;
+        job = std::move(work.front());
+        work.pop_front();
+      }
+      Batch b;
+      b.seq = job.first;
+      b.data.resize(static_cast<size_t>(job.second.size()) *
+                    static_cast<size_t>(sample_bytes));
+      char* dst = b.data.data();
+      for (int64_t idx : job.second) {
+        std::memcpy(dst, src + idx * sample_bytes,
+                    static_cast<size_t>(sample_bytes));
+        dst += sample_bytes;
+      }
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        done.push_back(std::move(b));
+      }
+      cv_done.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bl_create(const char* src, int64_t n_samples, int64_t sample_bytes,
+                int64_t batch_size, int n_threads, int queue_cap) {
+  Loader* l = new Loader();
+  l->src = src;
+  l->n_samples = n_samples;
+  l->sample_bytes = sample_bytes;
+  l->batch_size = batch_size;
+  l->queue_cap = static_cast<size_t>(queue_cap);
+  for (int i = 0; i < n_threads; ++i)
+    l->threads.emplace_back([l] { l->worker(); });
+  return l;
+}
+
+int64_t bl_submit(void* handle, const int64_t* indices, int64_t count) {
+  Loader* l = static_cast<Loader*>(handle);
+  std::vector<int64_t> idx(indices, indices + count);
+  int64_t seq;
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    seq = l->next_submit++;
+    l->work.emplace_back(seq, std::move(idx));
+  }
+  l->cv_work.notify_one();
+  return seq;
+}
+
+// blocking: copies the NEXT in-order batch into out; returns its byte size
+int64_t bl_next(void* handle, char* out) {
+  Loader* l = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(l->mu);
+  for (;;) {
+    for (auto it = l->done.begin(); it != l->done.end(); ++it) {
+      if (it->seq == l->next_emit) {
+        int64_t n = static_cast<int64_t>(it->data.size());
+        std::memcpy(out, it->data.data(), it->data.size());
+        l->done.erase(it);
+        l->next_emit++;
+        return n;
+      }
+    }
+    l->cv_done.wait(lk);
+  }
+}
+
+void bl_destroy(void* handle) {
+  Loader* l = static_cast<Loader*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(l->mu);
+    l->stop = true;
+  }
+  l->cv_work.notify_all();
+  for (auto& t : l->threads) t.join();
+  delete l;
+}
+
+}  // extern "C"

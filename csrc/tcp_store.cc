@@ -1,0 +1,261 @@
+// TCPStore: rendezvous key-value store.
+//
+// TPU-native equivalent of the reference's C++ TCPStore
+// (paddle/fluid/distributed/store/tcp_store.h:91, tcp_utils.cc): a
+// master-hosted KV with blocking wait/add used for process-group bootstrap.
+// Here it backs paddle_tpu.distributed.store (jax.distributed's coordinator
+// handles collective init; this store serves the script-level barrier /
+// key-exchange API the reference exposes to users).
+//
+// Protocol (length-prefixed):
+//   request : u8 op | u32 klen | key | u32 vlen | value
+//   ops     : 0=SET 1=GET 2=ADD(i64 delta in value) 3=WAIT 4=DELETE
+//   response: u32 vlen | value   (GET/ADD/WAIT; SET/DELETE reply vlen=0)
+//
+// Exposed as a C ABI for ctypes; server runs detached threads per client.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_value(int fd, const std::string& v) {
+  uint32_t len = static_cast<uint32_t>(v.size());
+  if (!write_full(fd, &len, 4)) return false;
+  return v.empty() || write_full(fd, v.data(), v.size());
+}
+
+void serve_client(Store* store, int fd) {
+  for (;;) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    std::string value(vlen, '\0');
+    if (vlen && !read_full(fd, &value[0], vlen)) break;
+
+    bool ok = true;
+    switch (op) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(store->mu);
+          store->data[key] = value;
+        }
+        store->cv.notify_all();
+        ok = send_value(fd, "");
+        break;
+      }
+      case 1: {  // GET (non-blocking; missing -> empty)
+        std::string out;
+        {
+          std::lock_guard<std::mutex> lk(store->mu);
+          auto it = store->data.find(key);
+          if (it != store->data.end()) out = it->second;
+        }
+        ok = send_value(fd, out);
+        break;
+      }
+      case 2: {  // ADD
+        int64_t delta = 0;
+        if (value.size() == 8) std::memcpy(&delta, value.data(), 8);
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lk(store->mu);
+          int64_t cur = 0;
+          auto it = store->data.find(key);
+          if (it != store->data.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          result = cur + delta;
+          std::string stored(8, '\0');
+          std::memcpy(&stored[0], &result, 8);
+          store->data[key] = stored;
+        }
+        store->cv.notify_all();
+        std::string out(8, '\0');
+        std::memcpy(&out[0], &result, 8);
+        ok = send_value(fd, out);
+        break;
+      }
+      case 3: {  // WAIT (block until key exists)
+        std::string out;
+        {
+          std::unique_lock<std::mutex> lk(store->mu);
+          store->cv.wait(lk, [&] {
+            return store->data.count(key) > 0;
+          });
+          out = store->data[key];
+        }
+        ok = send_value(fd, out);
+        break;
+      }
+      case 4: {  // DELETE
+        {
+          std::lock_guard<std::mutex> lk(store->mu);
+          store->data.erase(key);
+        }
+        ok = send_value(fd, "");
+        break;
+      }
+      default:
+        ok = false;
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Store* store, int listen_fd) {
+  for (;;) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_client, store, fd).detach();
+  }
+}
+
+int connect_to(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+bool roundtrip(int fd, uint8_t op, const std::string& key,
+               const std::string& value, std::string* out) {
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  uint32_t vlen = static_cast<uint32_t>(value.size());
+  if (!write_full(fd, &op, 1) || !write_full(fd, &klen, 4) ||
+      (klen && !write_full(fd, key.data(), klen)) ||
+      !write_full(fd, &vlen, 4) ||
+      (vlen && !write_full(fd, value.data(), vlen)))
+    return false;
+  uint32_t rlen;
+  if (!read_full(fd, &rlen, 4)) return false;
+  out->assign(rlen, '\0');
+  return rlen == 0 || read_full(fd, &(*out)[0], rlen);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* tcpstore_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  Store* store = new Store();
+  std::thread(accept_loop, store, fd).detach();
+  return store;
+}
+
+// ---- client ----
+int tcpstore_connect(const char* host, int port) {
+  return connect_to(host, port);
+}
+
+int tcpstore_set(int fd, const char* key, const char* value, int vlen) {
+  std::string out;
+  return roundtrip(fd, 0, key, std::string(value, vlen), &out) ? 0 : -1;
+}
+
+// returns length, copies up to cap bytes into buf; -1 on error
+int tcpstore_get(int fd, const char* key, char* buf, int cap) {
+  std::string out;
+  if (!roundtrip(fd, 1, key, "", &out)) return -1;
+  int n = static_cast<int>(out.size());
+  std::memcpy(buf, out.data(), std::min(n, cap));
+  return n;
+}
+
+int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
+  std::string v(8, '\0');
+  std::memcpy(&v[0], &delta, 8);
+  std::string out;
+  if (!roundtrip(fd, 2, key, v, &out) || out.size() != 8) return INT64_MIN;
+  int64_t result;
+  std::memcpy(&result, out.data(), 8);
+  return result;
+}
+
+int tcpstore_wait(int fd, const char* key, char* buf, int cap) {
+  std::string out;
+  if (!roundtrip(fd, 3, key, "", &out)) return -1;
+  int n = static_cast<int>(out.size());
+  std::memcpy(buf, out.data(), std::min(n, cap));
+  return n;
+}
+
+int tcpstore_delete(int fd, const char* key) {
+  std::string out;
+  return roundtrip(fd, 4, key, "", &out) ? 0 : -1;
+}
+
+void tcpstore_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -17,6 +17,7 @@ from .topology import (  # noqa: F401
     set_hybrid_communicate_group,
 )
 from . import fleet  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -1,0 +1,230 @@
+"""TCPStore: rendezvous key-value store.
+
+~ paddle/fluid/distributed/store/tcp_store.h:91 (core.TCPStore, used by
+init_parallel_env for id exchange + barrier). Native C++ implementation in
+csrc/tcp_store.cc bound via ctypes; pure-python socket fallback keeps the
+exact wire protocol so mixed deployments interoperate.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..utils import native as _native
+
+
+class _PyClient:
+    """Pure-python client speaking the csrc/tcp_store.cc protocol."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                self.sock = socket.create_connection((host, port), timeout=5)
+                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self.lock = threading.Lock()
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.2)
+        raise ConnectionError(f"cannot reach TCPStore {host}:{port}: {last}")
+
+    def _roundtrip(self, op: int, key: bytes, value: bytes) -> bytes:
+        with self.lock:
+            msg = (struct.pack("<BI", op, len(key)) + key
+                   + struct.pack("<I", len(value)) + value)
+            self.sock.sendall(msg)
+            rlen = struct.unpack("<I", self._recv(4))[0]
+            return self._recv(rlen) if rlen else b""
+
+    def _recv(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("TCPStore connection closed")
+            buf += chunk
+        return buf
+
+    def close(self):
+        self.sock.close()
+
+
+class _PyServer:
+    """Pure-python server (same protocol)."""
+
+    def __init__(self, port: int):
+        self.data = {}
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", port))
+        self.sock.listen(128)
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        def recv(n):
+            buf = b""
+            while len(buf) < n:
+                c = conn.recv(n - len(buf))
+                if not c:
+                    raise ConnectionError
+                buf += c
+            return buf
+        try:
+            while True:
+                op, klen = struct.unpack("<BI", recv(5))
+                key = recv(klen).decode()
+                vlen = struct.unpack("<I", recv(4))[0]
+                value = recv(vlen)
+                if op == 0:
+                    with self.cond:
+                        self.data[key] = value
+                        self.cond.notify_all()
+                    out = b""
+                elif op == 1:
+                    with self.lock:
+                        out = self.data.get(key, b"")
+                elif op == 2:
+                    delta = struct.unpack("<q", value)[0] if vlen == 8 else 0
+                    with self.cond:
+                        cur = struct.unpack(
+                            "<q", self.data.get(key, b"\0" * 8))[0]
+                        new = cur + delta
+                        self.data[key] = struct.pack("<q", new)
+                        self.cond.notify_all()
+                    out = struct.pack("<q", new)
+                elif op == 3:
+                    with self.cond:
+                        while key not in self.data:
+                            self.cond.wait()
+                        out = self.data[key]
+                elif op == 4:
+                    with self.cond:
+                        self.data.pop(key, None)
+                    out = b""
+                else:
+                    return
+                conn.sendall(struct.pack("<I", len(out)) + out)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self.sock.close()
+
+
+class TCPStore:
+    """~ core.TCPStore(host, port, is_master, world_size, timeout)."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self._lib = _native.get_lib()
+        self._server = None
+        self._fd = None
+        self._py = None
+        if is_master:
+            if self._lib is not None:
+                self._server = self._lib.tcpstore_server_start(port)
+                if not self._server:
+                    raise RuntimeError(f"cannot bind TCPStore on :{port}")
+            else:
+                self._server = _PyServer(port)
+        # resolve hostname for the C client (needs dotted quad)
+        ip = socket.gethostbyname(host)
+        if self._lib is not None:
+            deadline = time.time() + timeout
+            fd = -1
+            while time.time() < deadline:
+                fd = self._lib.tcpstore_connect(ip.encode(), port)
+                if fd >= 0:
+                    break
+                time.sleep(0.2)
+            if fd < 0:
+                raise ConnectionError(f"cannot reach TCPStore {host}:{port}")
+            self._fd = fd
+        else:
+            self._py = _PyClient(ip, port, timeout)
+
+    # ---- API (paddle parity: set/get/wait/add) ----------------------------
+    def set(self, key: str, value) -> None:
+        v = value if isinstance(value, bytes) else str(value).encode()
+        if self._fd is not None:
+            rc = self._lib.tcpstore_set(self._fd, key.encode(), v, len(v))
+            if rc != 0:
+                raise ConnectionError("TCPStore set failed")
+        else:
+            self._py._roundtrip(0, key.encode(), v)
+
+    def get(self, key: str) -> bytes:
+        if self._fd is not None:
+            buf = (ctypes_buffer := bytearray(1 << 20))
+            import ctypes
+            c_buf = (ctypes.c_char * len(buf)).from_buffer(buf)
+            n = self._lib.tcpstore_get(self._fd, key.encode(), c_buf,
+                                       len(buf))
+            if n < 0:
+                raise ConnectionError("TCPStore get failed")
+            return bytes(buf[:n])
+        return self._py._roundtrip(1, key.encode(), b"")
+
+    def add(self, key: str, delta: int) -> int:
+        if self._fd is not None:
+            out = self._lib.tcpstore_add(self._fd, key.encode(), delta)
+            if out == -(2 ** 63):
+                raise ConnectionError("TCPStore add failed")
+            return int(out)
+        import struct as _s
+        out = self._py._roundtrip(2, key.encode(), _s.pack("<q", delta))
+        return _s.unpack("<q", out)[0]
+
+    def wait(self, key: str) -> bytes:
+        if self._fd is not None:
+            import ctypes
+            buf = bytearray(1 << 20)
+            c_buf = (ctypes.c_char * len(buf)).from_buffer(buf)
+            n = self._lib.tcpstore_wait(self._fd, key.encode(), c_buf,
+                                        len(buf))
+            if n < 0:
+                raise ConnectionError("TCPStore wait failed")
+            return bytes(buf[:n])
+        return self._py._roundtrip(3, key.encode(), b"")
+
+    def delete_key(self, key: str) -> None:
+        if self._fd is not None:
+            self._lib.tcpstore_delete(self._fd, key.encode())
+        else:
+            self._py._roundtrip(4, key.encode(), b"")
+
+    def barrier(self, name: str, world_size: int, timeout: float = 300.0):
+        """all ranks add 1, wait for count==world_size."""
+        count = self.add(f"__barrier__/{name}", 1)
+        if count == world_size:
+            self.set(f"__barrier_done__/{name}", b"1")
+        self.wait(f"__barrier_done__/{name}")
+
+    def close(self):
+        if self._fd is not None:
+            self._lib.tcpstore_close(self._fd)
+            self._fd = None
+        if self._py is not None:
+            self._py.close()

@@ -1,0 +1,68 @@
+"""Native batch assembly bridge.
+
+Uses csrc/batch_loader.cc (threaded row gather outside the GIL) for
+datasets backed by contiguous numpy arrays — the native-path analog of the
+reference's C++ data_feed/shared-memory DataLoader workers. Falls back to
+numpy fancy-indexing when the native lib is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Sequence
+
+import numpy as np
+
+from ..utils import native as _native
+
+
+class NativeBatchAssembler:
+    """Gathers rows of one contiguous array into batches with C++ threads."""
+
+    def __init__(self, array: np.ndarray, n_threads: int = 4,
+                 queue_cap: int = 8):
+        self.array = np.ascontiguousarray(array)
+        self.sample_bytes = int(self.array.dtype.itemsize
+                               * np.prod(self.array.shape[1:]))
+        self._lib = _native.get_lib()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.bl_create(
+                self.array.ctypes.data_as(ctypes.c_void_p),
+                self.array.shape[0], self.sample_bytes, 0, n_threads,
+                queue_cap)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def submit(self, indices: Sequence[int]) -> None:
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        if self._handle is not None:
+            self._lib.bl_submit(self._handle,
+                                idx.ctypes.data_as(ctypes.c_void_p),
+                                len(idx))
+        else:
+            self._fallback_queue = getattr(self, "_fallback_queue", [])
+            self._fallback_queue.append(idx)
+
+    def next(self, batch_len: int) -> np.ndarray:
+        shape = (batch_len,) + self.array.shape[1:]
+        if self._handle is not None:
+            out = np.empty(shape, dtype=self.array.dtype)
+            n = self._lib.bl_next(self._handle,
+                                  out.ctypes.data_as(ctypes.c_void_p))
+            assert n == out.nbytes, (n, out.nbytes)
+            return out
+        idx = self._fallback_queue.pop(0)
+        return self.array[idx]
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.bl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

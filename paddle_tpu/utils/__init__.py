@@ -1,0 +1,2 @@
+from . import native  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401

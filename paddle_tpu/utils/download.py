@@ -1,0 +1,22 @@
+"""Weight path helper (zero-egress: local cache only).
+
+~ python/paddle/utils/download.py get_weights_path_from_url — in this
+environment there is no network; the helper resolves URLs to a local cache
+and errors with a clear message if the file was never placed there.
+"""
+from __future__ import annotations
+
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    fname = url.split("/")[-1]
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"pretrained weights {fname} not found at {path}; this "
+            "environment has no network egress — place the file there "
+            "manually")
+    return path

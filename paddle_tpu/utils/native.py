@@ -1,0 +1,83 @@
+"""ctypes bindings for the native runtime (csrc/).
+
+Loads libpaddle_tpu_native.so (built by csrc/Makefile — attempted
+automatically on first import). All users fall back to pure-python when the
+library is unavailable, so the wheel works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "lib",
+                         "libpaddle_tpu_native.so")
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    csrc = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+    if not os.path.isdir(csrc):
+        return False
+    try:
+        subprocess.run(["make", "-s"], cwd=csrc, check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    path = os.path.abspath(_LIB_PATH)
+    if not os.path.exists(path):
+        if not _build():
+            return None
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    # signatures
+    lib.tcpstore_server_start.restype = ctypes.c_void_p
+    lib.tcpstore_server_start.argtypes = [ctypes.c_int]
+    lib.tcpstore_connect.restype = ctypes.c_int
+    lib.tcpstore_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_set.restype = ctypes.c_int
+    lib.tcpstore_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_get.restype = ctypes.c_int
+    lib.tcpstore_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_add.restype = ctypes.c_int64
+    lib.tcpstore_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.tcpstore_wait.restype = ctypes.c_int
+    lib.tcpstore_wait.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_delete.restype = ctypes.c_int
+    lib.tcpstore_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.tcpstore_close.argtypes = [ctypes.c_int]
+
+    lib.bl_create.restype = ctypes.c_void_p
+    lib.bl_create.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                              ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                              ctypes.c_int]
+    lib.bl_submit.restype = ctypes.c_int64
+    lib.bl_submit.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_int64]
+    lib.bl_next.restype = ctypes.c_int64
+    lib.bl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.bl_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,103 @@
+"""Native runtime component tests (TCPStore, BatchLoader)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, _PyClient, _PyServer
+from paddle_tpu.io.native_loader import NativeBatchAssembler
+from paddle_tpu.utils import native
+
+
+def test_native_lib_builds():
+    assert native.available(), "csrc native library failed to build/load"
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        store = TCPStore("127.0.0.1", 29617, is_master=True)
+        client = TCPStore("127.0.0.1", 29617, is_master=False)
+        store.set("k", b"hello")
+        assert client.get("k") == b"hello"
+        assert client.add("ctr", 5) == 5
+        assert store.add("ctr", 2) == 7
+        client.delete_key("k")
+        assert client.get("k") == b""
+        store.close()
+        client.close()
+
+    def test_wait_blocks_until_set(self):
+        store = TCPStore("127.0.0.1", 29618, is_master=True)
+        results = []
+
+        def waiter():
+            c = TCPStore("127.0.0.1", 29618, is_master=False)
+            results.append(c.wait("late_key"))
+            c.close()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.3)
+        assert not results
+        store.set("late_key", b"now")
+        t.join(timeout=10)
+        assert results == [b"now"]
+        store.close()
+
+    def test_barrier(self):
+        store = TCPStore("127.0.0.1", 29619, is_master=True)
+        n = 4
+        done = []
+
+        def rank(i):
+            c = TCPStore("127.0.0.1", 29619, is_master=False)
+            c.barrier("b1", n)
+            done.append(i)
+            c.close()
+
+        ts = [threading.Thread(target=rank, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert sorted(done) == list(range(n))
+        store.close()
+
+    def test_python_fallback_protocol_interop(self):
+        # python server + python client speak the same protocol as C
+        srv = _PyServer(29620)
+        c = _PyClient("127.0.0.1", 29620)
+        assert c._roundtrip(0, b"x", b"v") == b""
+        assert c._roundtrip(1, b"x", b"") == b"v"
+        import struct
+        out = c._roundtrip(2, b"n", struct.pack("<q", 3))
+        assert struct.unpack("<q", out)[0] == 3
+        c.close()
+        srv.stop()
+
+
+class TestBatchLoader:
+    def test_gathers_rows(self):
+        data = np.arange(100 * 8, dtype=np.float32).reshape(100, 8)
+        bl = NativeBatchAssembler(data, n_threads=2)
+        assert bl.native
+        bl.submit([3, 1, 4])
+        bl.submit([10, 20])
+        b1 = bl.next(3)
+        b2 = bl.next(2)
+        np.testing.assert_array_equal(b1, data[[3, 1, 4]])
+        np.testing.assert_array_equal(b2, data[[10, 20]])
+        bl.close()
+
+    def test_many_batches_in_order(self):
+        data = np.random.randn(1000, 16).astype(np.float32)
+        bl = NativeBatchAssembler(data, n_threads=4)
+        rng = np.random.default_rng(0)
+        all_idx = [rng.integers(0, 1000, 32) for _ in range(50)]
+        for idx in all_idx:
+            bl.submit(idx)
+        for idx in all_idx:
+            out = bl.next(32)
+            np.testing.assert_array_equal(out, data[idx])
+        bl.close()
