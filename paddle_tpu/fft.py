@@ -1,0 +1,91 @@
+"""FFT ops. ~ python/paddle/fft.py over phi fft kernels (CUFFT in the
+reference; XLA's FFT HLO here)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import def_op
+
+
+def _norm(norm):
+    return norm if norm in ("backward", "ortho", "forward") else "backward"
+
+
+@def_op("fft")
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@def_op("ifft")
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@def_op("fft2")
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@def_op("ifft2")
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@def_op("fftn")
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@def_op("ifftn")
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@def_op("rfft")
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@def_op("irfft")
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@def_op("rfft2")
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@def_op("irfft2")
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@def_op("hfft")
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@def_op("ihfft")
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@def_op("fftshift")
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@def_op("ifftshift")
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+@def_op("fftfreq", nondiff=True)
+def fftfreq(n, d=1.0):
+    return jnp.fft.fftfreq(int(n), d=d)
+
+
+@def_op("rfftfreq", nondiff=True)
+def rfftfreq(n, d=1.0):
+    return jnp.fft.rfftfreq(int(n), d=d)

@@ -1,0 +1,146 @@
+"""Quantization toolkit (slim).
+
+~ python/paddle/fluid/contrib/slim/quantization/ (quantization_pass.py QAT
+fake-quant insertion, imperative/qat.py ImperativeQuantAware,
+post_training_quantization.py). TPU-native: fake-quant is a straight-
+through-estimator op pair (quant sim in the graph); int8 execution on TPU
+rides XLA's native int8 matmul when exported.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+
+def fake_quantize_dequantize(x, scale, bits=8):
+    """Symmetric per-tensor fake quant with straight-through gradient
+    (~ fake_quantize_dequantize_moving_average_abs_max op)."""
+    import jax
+    qmax = 2 ** (bits - 1) - 1
+
+    def fn(v, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        deq = q * s / qmax
+        # straight-through: gradient of round treated as identity
+        return v + jax.lax.stop_gradient(deq - v)
+    return apply_op("fake_quant_dequant", fn, x, scale)
+
+
+class FakeQuant(nn.Layer):
+    """Moving-average abs-max observer + fake quant (~ imperative/qat.py)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale", Tensor(jnp.asarray(1.0, jnp.float32)))
+        self._observed = False
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._value)))
+            if not self._observed:
+                self.scale._value = jnp.asarray(cur, jnp.float32)
+                self._observed = True
+            else:
+                self.scale._value = (self.momentum * self.scale._value
+                                     + (1 - self.momentum) * cur)
+        return fake_quantize_dequantize(x, self.scale, self.bits)
+
+
+class QuantedLinear(nn.Layer):
+    def __init__(self, linear: nn.Linear, bits=8):
+        super().__init__()
+        self.inner = linear
+        self.act_quant = FakeQuant(bits)
+        self.w_quant = FakeQuant(bits)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = self.w_quant(self.inner.weight)
+        from ..nn import functional as F
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, conv: nn.Conv2D, bits=8):
+        super().__init__()
+        self.inner = conv
+        self.act_quant = FakeQuant(bits)
+        self.w_quant = FakeQuant(bits)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = self.w_quant(self.inner.weight)
+        from ..nn import functional as F
+        c = self.inner
+        return F.conv2d(x, w, c.bias, c.stride, c.padding, c.dilation,
+                        c.groups, c.data_format)
+
+
+class ImperativeQuantAware:
+    """QAT transformer (~ slim/quantization/imperative/qat.py:104):
+    swaps Linear/Conv2D sublayers for fake-quantized versions."""
+
+    def __init__(self, bits=8, quantizable_layer_type=("Linear", "Conv2D")):
+        self.bits = bits
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model: nn.Layer) -> nn.Layer:
+        for name, sub in list(model._sub_layers.items()):
+            cls = type(sub).__name__
+            if cls == "Linear" and "Linear" in self.types:
+                model._sub_layers[name] = QuantedLinear(sub, self.bits)
+            elif cls == "Conv2D" and "Conv2D" in self.types:
+                model._sub_layers[name] = QuantedConv2D(sub, self.bits)
+            else:
+                self.quantize(sub)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+        jit.save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ calibration (~ post_training_quantization.py): run calibration
+    batches, record abs-max scales per quantized layer, emit int8 weights +
+    scales."""
+
+    def __init__(self, model: nn.Layer, data_loader, bits=8,
+                 algo="abs_max"):
+        self.model = model
+        self.loader = data_loader
+        self.bits = bits
+
+    def quantize(self):
+        qat = ImperativeQuantAware(self.bits)
+        model = qat.quantize(self.model)
+        model.train()
+        from ..autograd import no_grad
+        with no_grad():
+            for batch in self.loader:
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                model(x)
+        model.eval()
+        return model
+
+    def save_quantized_model(self, save_model_path, **kw):
+        from ..framework.io import save
+        state = {}
+        qmax = 2 ** (self.bits - 1) - 1
+        for name, layer in self.model.named_sublayers():
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                w = layer.inner.weight._value
+                s = float(layer.w_quant.scale._value)
+                q = np.clip(np.round(np.asarray(w) / max(s, 1e-8) * qmax),
+                            -qmax, qmax).astype(np.int8)
+                state[f"{name}.weight_int8"] = q
+                state[f"{name}.weight_scale"] = s
+        save(state, save_model_path + ".pdquant")
+        return state

@@ -1,0 +1,106 @@
+"""Signal ops: stft/istft. ~ python/paddle/signal.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+from .ops.dispatch import apply_op
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    def fn(v):
+        n = v.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (np.arange(frame_length)[None, :]
+               + hop_length * np.arange(num)[:, None])
+        return jnp.take(v, jnp.asarray(idx), axis=axis)
+    return apply_op("frame", fn, x)
+
+
+def overlap_add(x, hop_length, axis=-1):
+    def fn(v):
+        # v: (..., frames, frame_length) on last two axes
+        frames, flen = v.shape[-2], v.shape[-1]
+        out_len = (frames - 1) * hop_length + flen
+        out = jnp.zeros(v.shape[:-2] + (out_len,), v.dtype)
+        for i in range(frames):
+            out = out.at[..., i * hop_length:i * hop_length + flen].add(
+                v[..., i, :])
+        return out
+    return apply_op("overlap_add", fn, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window._value if isinstance(window, Tensor) else window
+
+    def fn(v):
+        val = v
+        if center:
+            pad = n_fft // 2
+            val = jnp.pad(val, [(0, 0)] * (val.ndim - 1) + [(pad, pad)],
+                          mode=pad_mode)
+        n = val.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (np.arange(n_fft)[None, :]
+               + hop_length * np.arange(num)[:, None])
+        frames = jnp.take(val, jnp.asarray(idx), axis=-1)  # (..., num, n_fft)
+        if wv is not None:
+            w = jnp.asarray(wv)
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+            frames = frames * w
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # (..., freq, frames)
+    return apply_op("stft", fn, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window._value if isinstance(window, Tensor) else window
+
+    def fn(spec):
+        s = jnp.swapaxes(spec, -1, -2)  # (..., frames, freq)
+        if normalized:
+            s = s * jnp.sqrt(n_fft)
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1).real
+        if wv is not None:
+            w = jnp.asarray(wv)
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+            frames = frames * w
+            wsq = w * w
+        else:
+            wsq = jnp.ones((n_fft,))
+        nf = frames.shape[-2]
+        out_len = (nf - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        norm = jnp.zeros((out_len,))
+        for i in range(nf):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(wsq)
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out.shape[-1] - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply_op("istft", fn, x)

@@ -1,0 +1,126 @@
+"""fft/signal, quantization, auto_parallel annotation tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        from paddle_tpu import fft
+        x = paddle.to_tensor(np.random.randn(16).astype(np.float32))
+        X = fft.fft(x)
+        back = fft.ifft(X)
+        np.testing.assert_allclose(back.numpy().real, x.numpy(), atol=1e-5)
+
+    def test_rfft_shapes(self):
+        from paddle_tpu import fft
+        x = paddle.to_tensor(np.random.randn(4, 32).astype(np.float32))
+        X = fft.rfft(x)
+        assert X.shape == [4, 17]
+        back = fft.irfft(X)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-5)
+
+    def test_fft_matches_numpy(self):
+        from paddle_tpu import fft
+        x = np.random.randn(8).astype(np.float32)
+        out = fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        from paddle_tpu import signal
+        x = paddle.to_tensor(np.random.randn(1, 512).astype(np.float32))
+        win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+        spec = signal.stft(x, n_fft=128, hop_length=32, window=win)
+        assert spec.shape[1] == 65
+        rec = signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                           length=512)
+        # center-padded regions reconstruct well away from edges
+        np.testing.assert_allclose(rec.numpy()[0, 64:-64],
+                                   x.numpy()[0, 64:-64], atol=1e-3)
+
+
+class TestQuantization:
+    def test_fake_quant_forward_and_ste_grad(self):
+        from paddle_tpu.quantization import fake_quantize_dequantize
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32),
+                             stop_gradient=False)
+        s = paddle.to_tensor(1.0)
+        out = fake_quantize_dequantize(x, s, bits=8)
+        assert np.abs(out.numpy() - x.numpy()).max() < 1 / 127 + 1e-6
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(11), rtol=1e-6)
+
+    def test_qat_swaps_layers_and_trains(self):
+        from paddle_tpu.quantization import ImperativeQuantAware, QuantedLinear
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        qat = ImperativeQuantAware()
+        qnet = qat.quantize(net)
+        assert isinstance(qnet[0], QuantedLinear)
+        x = paddle.randn([4, 8])
+        out = qnet(x)
+        assert out.shape == [4, 4]
+        out.sum().backward()
+        assert qnet[0].inner.weight.grad is not None
+
+    def test_ptq(self, tmp_path):
+        from paddle_tpu.io import TensorDataset, DataLoader
+        from paddle_tpu.quantization import PostTrainingQuantization
+        net = nn.Sequential(nn.Linear(8, 4))
+        data = DataLoader(TensorDataset(
+            [np.random.randn(32, 8).astype(np.float32)]), batch_size=8)
+        ptq = PostTrainingQuantization(net, data)
+        ptq.quantize()
+        state = ptq.save_quantized_model(str(tmp_path / "q"))
+        keys = [k for k in state if k.endswith("weight_int8")]
+        assert keys and state[keys[0]].dtype == np.int8
+
+
+class TestAutoParallel:
+    def test_process_mesh_and_shard_tensor(self):
+        from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                          shard_tensor)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4).tolist(),
+                           dim_names=["x", "y"])
+        assert mesh.shape == [2, 4]
+        t = shard_tensor(paddle.randn([8, 16]), mesh, ["x", None])
+        assert t.sharding_spec == jax.sharding.PartitionSpec("x", None)
+        # actually sharded over devices
+        assert len(t._value.sharding.device_set) >= 2
+
+    def test_shard_op_in_jit(self):
+        from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                          shard_op)
+        from paddle_tpu.core.tensor import Tensor
+        mesh = ProcessMesh(np.arange(8).tolist(), dim_names=["x"])
+
+        def matmul_op(a, b):
+            return paddle.matmul(a, b)
+        sharded_mm = shard_op(matmul_op, mesh, out_shard_specs=[["x", None]])
+
+        def f(av, bv):
+            return sharded_mm(Tensor(av), Tensor(bv))._value
+        a = jnp.ones((8, 4))
+        b = jnp.ones((4, 4))
+        with mesh.jax_mesh():
+            out = jax.jit(f)(a, b)
+        np.testing.assert_allclose(np.asarray(out), 4 * np.ones((8, 4)))
+
+    def test_engine_fit(self):
+        from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh
+        from paddle_tpu.io import TensorDataset
+        from paddle_tpu import optimizer
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        eng = Engine(net, nn.CrossEntropyLoss(),
+                     optimizer.Adam(1e-2, parameters=net.parameters()))
+        mesh = ProcessMesh(np.arange(8).tolist(), dim_names=["data"])
+        eng.prepare(process_mesh=mesh)
+        x = np.random.randn(32, 4).astype(np.float32)
+        y = np.random.randint(0, 2, 32).astype(np.int64)
+        eng.fit(TensorDataset([x, y]), epochs=1, batch_size=8)
+        assert eng.cost()["total_params"] > 0
