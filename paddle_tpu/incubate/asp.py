@@ -1,0 +1,72 @@
+"""ASP: 2:4 structured sparsity.
+
+~ python/paddle/incubate/asp (static/sparsity + fluid/contrib/sparsity):
+prune weights to the 2-out-of-4 pattern the MXU-era sparse units exploit,
+keep masks, and re-apply after each optimizer step.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+_masks: Dict[int, "jnp.ndarray"] = {}
+
+
+def compute_mask_2d(weight: np.ndarray, n=2, m=4) -> np.ndarray:
+    """Keep the n largest-|w| of every m consecutive elements (last dim)."""
+    w = np.asarray(weight)
+    orig_shape = w.shape
+    flat = w.reshape(-1)
+    pad = (-len(flat)) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat).reshape(-1, m)
+    thresh_idx = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, thresh_idx, True, axis=1)
+    mask = mask.reshape(-1)[:w.size].reshape(orig_shape)
+    return mask
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d"):
+    """~ asp.prune_model: prune eligible weights, remember masks."""
+    for name, p in model.named_parameters():
+        if p.ndim < 2 or "bias" in name:
+            continue
+        mask = compute_mask_2d(p.numpy(), n, m)
+        _masks[id(p)] = jnp.asarray(mask)
+        p._value = p._value * _masks[id(p)].astype(p._value.dtype)
+    return model
+
+
+def decorate(optimizer):
+    """~ asp.decorate: re-apply masks after each step."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameters:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask.astype(p._value.dtype)
+    optimizer.step = step
+    return optimizer
+
+
+def check_sparsity(weight, n=2, m=4) -> bool:
+    w = np.asarray(weight._value if isinstance(weight, Tensor) else weight)
+    flat = w.reshape(-1)
+    pad = (-len(flat)) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = flat.reshape(-1, m)
+    return bool(((groups != 0).sum(axis=1) <= n).all())
+
+
+def reset_masks():
+    _masks.clear()

@@ -1,0 +1,106 @@
+"""Incubate optimizers: LookAhead, ModelAverage.
+
+~ python/paddle/incubate/optimizer/ (lookahead.py, modelaverage.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..core.tensor import Tensor
+from ..optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """~ incubate/optimizer/lookahead.py: slow/fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._step_num = 0
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameters
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self.inner_optimizer._parameters:
+                if id(p) not in self._slow:
+                    self._slow[id(p)] = p._value
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_num": self._step_num}
+
+    def set_state_dict(self, st):
+        self.inner_optimizer.set_state_dict(st.get("inner", {}))
+        self._step_num = st.get("step_num", 0)
+
+
+class ModelAverage(Optimizer):
+    """~ incubate/optimizer/modelaverage.py: EMA of parameters with
+    apply/restore context."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters)
+        self.rate = average_window_rate
+        self._sum = {}
+        self._count = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        self._count += 1
+        for p in self._parameters:
+            acc = self._sum.get(id(p))
+            self._sum[id(p)] = p._value if acc is None else acc + p._value
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._parameters}
+        for p in self._parameters:
+            if id(p) in self._sum and self._count:
+                p._value = self._sum[id(p)] / self._count
+        return _RestoreCtx(self) if need_restore else None
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameters:
+                if id(p) in self._backup:
+                    p._value = self._backup[id(p)]
+            self._backup = None
+
+
+class _RestoreCtx:
+    def __init__(self, ma):
+        self.ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.ma.restore()
+        return False

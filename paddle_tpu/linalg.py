@@ -1,0 +1,18 @@
+"""paddle.linalg namespace. ~ python/paddle/linalg.py re-exports."""
+from .ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, corrcoef, cov, det, eig, eigh, eigvalsh,
+    inverse, lstsq, lu, matmul, matrix_power, matrix_rank, mv, norm, pinv,
+    qr, slogdet, solve, svd, triangular_solve,
+)
+
+multi_dot = None
+
+
+def _multi_dot(tensors):
+    out = tensors[0]
+    for t in tensors[1:]:
+        out = matmul(out, t)
+    return out
+
+
+multi_dot = _multi_dot

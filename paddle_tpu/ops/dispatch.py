@@ -61,6 +61,20 @@ def apply_op(name: str, fn: Callable, *args, nondiff: bool = False, **kwargs):
     static attributes. Tensor positional args are unwrapped; non-Tensor
     positional args pass through untouched.
     """
+    from ..profiler import _spans
+    if _spans.enabled:
+        import time as _time
+        _t0 = _time.perf_counter()
+        try:
+            return _apply_op_inner(name, fn, args, kwargs, nondiff)
+        finally:
+            import threading as _th
+            _spans.add(f"op::{name}", _t0, _time.perf_counter() - _t0,
+                       _th.get_ident())
+    return _apply_op_inner(name, fn, args, kwargs, nondiff)
+
+
+def _apply_op_inner(name, fn, args, kwargs, nondiff):
     vals = [_unwrap(a) for a in args]
     from .. import amp as _amp
     if _amp.amp_state() is not None:

