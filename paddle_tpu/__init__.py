@@ -50,6 +50,19 @@ from .framework.io import load, save  # noqa: F401
 from .framework import device  # noqa: F401
 
 import paddle_tpu.tensor as tensor  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import linalg  # noqa: F401
+from . import text  # noqa: F401
+from . import hapi  # noqa: F401
+from . import distribution  # noqa: F401
+from . import quantization  # noqa: F401
+from . import models  # noqa: F401
+from . import parallel  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from .ops.control_flow import case, cond, scan, switch_case, while_loop  # noqa: F401
+from .autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .nn.initializer import ParamAttr  # noqa: F401
 
 __version__ = "0.1.0"
 

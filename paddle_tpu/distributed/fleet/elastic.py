@@ -1,0 +1,104 @@
+"""Elastic training manager.
+
+~ python/paddle/distributed/fleet/elastic/manager.py:130 (ElasticManager:
+etcd lease+watch on node membership, scale between --np min:max, relaunch
+local trainers with rewritten rank envs). TPU-native substitution: the
+membership registry is the TCPStore (heartbeat keys with timestamps); the
+watcher detects dead/new peers and triggers pod relaunch through the
+launch controller (launch/main.py elastic_level). No etcd dependency.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..store import TCPStore
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Heartbeat + membership watch over TCPStore."""
+
+    def __init__(self, store: TCPStore, node_id: str, np_range=(1, 1),
+                 heartbeat_interval: float = 2.0,
+                 dead_after: float = 10.0):
+        self.store = store
+        self.node_id = node_id
+        self.min_np, self.max_np = np_range
+        self.interval = heartbeat_interval
+        self.dead_after = dead_after
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watchers: List[Callable[[List[str], List[str]], None]] = []
+        self._last_members: List[str] = []
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self.store.set(f"__hb__/{self.node_id}", str(time.time()))
+        self._register_member()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _register_member(self):
+        raw = self.store.get("__members__") or b"[]"
+        members = set(json.loads(raw.decode() or "[]"))
+        members.add(self.node_id)
+        self.store.set("__members__", json.dumps(sorted(members)))
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.store.set(f"__hb__/{self.node_id}", str(time.time()))
+            alive = self.alive_members()
+            if self._last_members and alive != self._last_members:
+                for w in self._watchers:
+                    w(self._last_members, alive)
+            self._last_members = alive
+            self._stop.wait(self.interval)
+
+    # -- membership ---------------------------------------------------------
+    def alive_members(self) -> List[str]:
+        raw = self.store.get("__members__") or b"[]"
+        members = json.loads(raw.decode() or "[]")
+        now = time.time()
+        alive = []
+        for m in members:
+            hb = self.store.get(f"__hb__/{m}")
+            try:
+                if hb and now - float(hb.decode()) < self.dead_after:
+                    alive.append(m)
+            except ValueError:
+                pass
+        return alive
+
+    def watch(self, callback: Callable[[List[str], List[str]], None]):
+        """callback(old_members, new_members) on membership change."""
+        self._watchers.append(callback)
+
+    # -- decisions ----------------------------------------------------------
+    def pod_status(self) -> str:
+        n = len(self.alive_members())
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        if self._last_members and n != len(self._last_members):
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def should_scale(self) -> bool:
+        n = len(self.alive_members())
+        return self.min_np <= n <= self.max_np and (
+            not self._last_members or n != len(self._last_members))

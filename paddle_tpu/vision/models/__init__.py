@@ -5,3 +5,7 @@ from .resnet import (  # noqa: F401
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .small_nets import (  # noqa: F401
+    AlexNet, DenseNet, MobileNetV1, ShuffleNetV2, SqueezeNet, alexnet,
+    densenet121, mobilenet_v1, shufflenet_v2_x1_0, squeezenet1_1,
+)

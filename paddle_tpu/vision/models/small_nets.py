@@ -1,0 +1,231 @@
+"""Additional vision model families.
+
+~ python/paddle/vision/models/{alexnet,squeezenet,shufflenetv2,densenet,
+mobilenetv1}.py — the remaining hapi model-zoo capability slots.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2))
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(flatten(x, 1))
+
+
+def alexnet(pretrained=False, **kw):
+    return AlexNet(**kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.relu = nn.ReLU()
+        self.expand1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1(s)),
+                       self.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.1", num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+            nn.MaxPool2D(3, 2),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            nn.MaxPool2D(3, 2),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return flatten(x, 1)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU())
+
+    def forward(self, x):
+        from ...nn.functional import channel_shuffle
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        stage_out = {0.5: [24, 48, 96, 192, 1024],
+                     1.0: [24, 116, 232, 464, 1024],
+                     1.5: [24, 176, 352, 704, 1024],
+                     2.0: [24, 244, 488, 976, 2048]}[scale]
+        repeats = [4, 8, 4]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, stage_out[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(stage_out[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = stage_out[0]
+        for i, r in enumerate(repeats):
+            out_c = stage_out[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2)]
+            for _ in range(r - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, stage_out[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[-1]), nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.pool(self.conv5(x))
+        return self.fc(flatten(x, 1))
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.body = nn.Sequential(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        return concat([x, self.body(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000):
+        super().__init__()
+        cfg = {121: [6, 12, 24, 16], 161: [6, 12, 36, 24],
+               169: [6, 12, 32, 32], 201: [6, 12, 48, 32]}[layers]
+        c = 64
+        feats = [nn.Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1)]
+        for i, n in enumerate(cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(cfg) - 1:
+                feats.extend([nn.BatchNorm2D(c), nn.ReLU(),
+                              nn.Conv2D(c, c // 2, 1, bias_attr=False),
+                              nn.AvgPool2D(2, 2)])
+                c //= 2
+        feats.extend([nn.BatchNorm2D(c), nn.ReLU()])
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(flatten(x, 1))
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+
+        def dw_sep(in_c, out_c, stride):
+            return nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c), nn.ReLU(),
+                nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+                nn.BatchNorm2D(out_c), nn.ReLU())
+
+        def c(v):
+            return max(8, int(v * scale))
+
+        self.net = nn.Sequential(
+            nn.Conv2D(3, c(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c(32)), nn.ReLU(),
+            dw_sep(c(32), c(64), 1), dw_sep(c(64), c(128), 2),
+            dw_sep(c(128), c(128), 1), dw_sep(c(128), c(256), 2),
+            dw_sep(c(256), c(256), 1), dw_sep(c(256), c(512), 2),
+            *[dw_sep(c(512), c(512), 1) for _ in range(5)],
+            dw_sep(c(512), c(1024), 2), dw_sep(c(1024), c(1024), 1),
+            nn.AdaptiveAvgPool2D((1, 1)))
+        self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        return self.fc(flatten(self.net(x), 1))
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale, **kw)
