@@ -1,0 +1,129 @@
+"""Gradient-communication meta-optimizers.
+
+~ fleet/meta_optimizers/ (gradient_merge_optimizer.py:20,
+localsgd_optimizer.py:26, dgc_optimizer.py:21, fp16_allreduce_optimizer.py).
+Eager wrappers around an inner optimizer; the compiled path gets the same
+effects from GSPMD (grad psum) + microbatching, so these serve the
+script-level strategy knobs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+from ...core.tensor import Tensor
+from .. import collective as C
+
+
+class GradientMergeOptimizer:
+    """Accumulate grads for k steps, then apply (~ gradient_merge)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    @no_grad()
+    def step(self):
+        self._count += 1
+        for p in self.inner._parameters:
+            if p._grad is not None:
+                acc = self._acc.get(id(p))
+                g = p._grad._value
+                self._acc[id(p)] = g if acc is None else acc + g
+                p._grad = None
+        if self._count >= self.k_steps:
+            for p in self.inner._parameters:
+                acc = self._acc.get(id(p))
+                if acc is not None:
+                    if self.avg:
+                        acc = acc / self._count
+                    p._grad = Tensor(acc)
+            self.inner.step()
+            self.inner.clear_grad()
+            self._acc = {}
+            self._count = 0
+
+    def clear_grad(self):
+        for p in self.inner._parameters:
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class LocalSGDOptimizer:
+    """Local updates with periodic parameter averaging (~ localsgd)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, group=None):
+        self.inner = inner_optimizer
+        self.k_steps = k_steps
+        self.group = group
+        self._count = 0
+
+    @no_grad()
+    def step(self):
+        self.inner.step()
+        self._count += 1
+        if self._count % self.k_steps == 0:
+            world = C.get_world_size(self.group)
+            if world > 1:
+                for p in self.inner._parameters:
+                    C.all_reduce(p, group=self.group)
+                    p._value = p._value / world
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class DGCMomentumOptimizer:
+    """Deep gradient compression: top-k sparsified grad sync with local
+    accumulation of the residual (~ dgc_optimizer + dgc_momentum_op).
+    On TPU the compiled DP path makes this unnecessary (psum over ICI is
+    cheap); kept for capability parity on slow-interconnect eager DP."""
+
+    def __init__(self, inner_optimizer, rampup_begin_step=0, sparsity=0.999,
+                 group=None):
+        self.inner = inner_optimizer
+        self.sparsity = sparsity
+        self.group = group
+        self._residual = {}
+
+    @no_grad()
+    def step(self):
+        world = C.get_world_size(self.group)
+        for p in self.inner._parameters:
+            if p._grad is None:
+                continue
+            g = p._grad._value + self._residual.get(id(p), 0.0)
+            k = max(1, int(round(g.size * (1 - self.sparsity))))
+            flat = jnp.abs(g.reshape(-1))
+            if k < flat.shape[0]:
+                thresh = jnp.sort(flat)[-k]
+                mask = (jnp.abs(g) >= thresh)
+            else:
+                mask = jnp.ones_like(g, bool)
+            sparse_g = jnp.where(mask, g, 0.0)
+            self._residual[id(p)] = g - sparse_g
+            p._grad = Tensor(sparse_g)
+            if world > 1:
+                C.all_reduce(p._grad, group=self.group)
+                p._grad._value = p._grad._value / world
+        self.inner.step()
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -267,7 +267,9 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     """
     config = model.config
     shardings = param_shardings(model, mesh)
-    params = {k: jax.device_put(v._value, shardings[k])
+    # copy defensively: device_put to an identical sharding would alias the
+    # model's own buffers, and the donated train step would delete them
+    params = {k: jax.device_put(jnp.array(v._value, copy=True), shardings[k])
               for k, v in model.state_dict().items()}
 
     def zero_like_sharded(name, v):

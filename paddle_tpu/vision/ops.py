@@ -1,0 +1,114 @@
+"""Vision ops: nms, roi_align, box utilities.
+
+~ python/paddle/vision/ops.py over the reference's detection op set
+(paddle/fluid/operators/detection/). TPU note: nms is data-dependent; the
+jit-friendly form returns a fixed-size keep mask (callers slice on host).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+
+def box_area(boxes):
+    def fn(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply_op("box_area", fn, boxes)
+
+
+def box_iou(boxes1, boxes2):
+    def fn(a, b):
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        return inter / (area_a[:, None] + area_b[None] - inter + 1e-10)
+    return apply_op("box_iou", fn, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Returns kept indices (host-side, dynamic length —
+    mirrors the reference's dynamic-output nms)."""
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes)
+    s = (np.asarray(scores._value if isinstance(scores, Tensor) else scores)
+         if scores is not None else np.ones(len(b), np.float32))
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        if top_k is not None and len(keep) >= top_k:
+            break
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        iou = inter / (area_i + areas - inter + 1e-10)
+        same_cat = np.ones(len(b), bool)
+        if category_idxs is not None:
+            cat = np.asarray(category_idxs._value
+                             if isinstance(category_idxs, Tensor)
+                             else category_idxs)
+            same_cat = cat == cat[i]
+        suppressed |= (iou > iou_threshold) & same_cat
+    return Tensor(np.asarray(keep, np.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign via bilinear gather (jit-friendly; ~ roi_align op)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def fn(feat, rois):
+        # feat: (N,C,H,W); rois: (R,4) in input coords; all rois on image 0
+        # (multi-image routing via boxes_num handled by caller slicing)
+        N, Cc, H, W = feat.shape
+        R = rois.shape[0]
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        ys = (y1[:, None] + (jnp.arange(oh) + 0.5)[None] * rh[:, None] / oh)
+        xs = (x1[:, None] + (jnp.arange(ow) + 0.5)[None] * rw[:, None] / ow)
+        img0 = feat[0]
+
+        def one_roi(ygrid, xgrid):
+            yy0 = jnp.clip(jnp.floor(ygrid).astype(jnp.int32), 0, H - 1)
+            xx0 = jnp.clip(jnp.floor(xgrid).astype(jnp.int32), 0, W - 1)
+            yy1 = jnp.clip(yy0 + 1, 0, H - 1)
+            xx1 = jnp.clip(xx0 + 1, 0, W - 1)
+            fy = ygrid - yy0
+            fx = xgrid - xx0
+            i00 = img0[:, yy0][:, :, xx0]
+            i01 = img0[:, yy0][:, :, xx1]
+            i10 = img0[:, yy1][:, :, xx0]
+            i11 = img0[:, yy1][:, :, xx1]
+            top = i00 * (1 - fx)[None, None, :] + i01 * fx[None, None, :]
+            bot = i10 * (1 - fx)[None, None, :] + i11 * fx[None, None, :]
+            return top * (1 - fy)[None, :, None] + bot * fy[None, :, None]
+
+        return jax.vmap(one_roi)(ys, xs)  # (R, C, oh, ow)
+    return apply_op("roi_align", fn, x, boxes)
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: irregular gather pattern — planned as a Pallas "
+        "kernel; use roi_align/grid-sample style gathers meanwhile")

@@ -1,0 +1,76 @@
+"""Gradient-comm meta-optimizers + vision ops tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.core.tensor import Parameter
+
+
+class TestGradientMerge:
+    def test_applies_every_k(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+        p = Parameter(np.array([1.0], np.float32))
+        gm = GradientMergeOptimizer(optimizer.SGD(0.1, parameters=[p]),
+                                    k_steps=2, avg=True)
+        (p * 2.0).sum().backward()
+        gm.step()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # not yet applied
+        (p * 2.0).sum().backward()
+        gm.step()
+        # avg grad = 2 -> p = 1 - 0.1*2
+        np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-6)
+
+
+class TestDGC:
+    def test_sparsifies_and_keeps_residual(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentumOptimizer)
+        p = Parameter(np.arange(10, dtype=np.float32))
+        dgc = DGCMomentumOptimizer(optimizer.SGD(1.0, parameters=[p]),
+                                   sparsity=0.8)
+        p._grad = paddle.to_tensor(
+            np.array([0, 1, 2, 3, 4, 5, 6, 7, 8, 9], np.float32))
+        dgc.step()
+        # only top-2 grads applied (sparsity 0.8 of 10 -> k=2)
+        applied = np.arange(10, dtype=np.float32) - p.numpy()
+        assert (applied != 0).sum() == 2
+        assert applied[9] == 9 and applied[8] == 8
+        # residual holds the rest
+        res = np.asarray(dgc._residual[id(p)])
+        assert res[7] == 7 and res[9] == 0
+
+
+class TestVisionOps:
+    def test_box_iou(self):
+        from paddle_tpu.vision.ops import box_iou
+        a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+        b = paddle.to_tensor(np.array([[1, 1, 3, 3], [0, 0, 2, 2]],
+                                      np.float32))
+        iou = box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou[0, 0], 1 / 7, rtol=1e-5)
+        np.testing.assert_allclose(iou[0, 1], 1.0, rtol=1e-5)
+
+    def test_nms(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = nms(boxes, iou_threshold=0.5, scores=scores)
+        assert keep.numpy().tolist() == [0, 2]
+
+    def test_roi_align_shape(self):
+        from paddle_tpu.vision.ops import roi_align
+        feat = paddle.randn([1, 8, 16, 16])
+        rois = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]],
+                                         np.float32))
+        out = roi_align(feat, rois, None, output_size=4)
+        assert out.shape == [2, 8, 4, 4]
+
+    def test_roi_align_constant_feature(self):
+        from paddle_tpu.vision.ops import roi_align
+        feat = paddle.ones([1, 2, 8, 8])
+        rois = paddle.to_tensor(np.array([[1, 1, 5, 5]], np.float32))
+        out = roi_align(feat, rois, None, output_size=2)
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 2, 2, 2)),
+                                   rtol=1e-5)
