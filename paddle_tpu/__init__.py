@@ -44,6 +44,7 @@ from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from .framework.io import load, save  # noqa: F401

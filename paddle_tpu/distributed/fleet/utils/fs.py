@@ -1,0 +1,97 @@
+"""Filesystem clients (checkpointing substrate).
+
+~ fleet/utils/fs.py (LocalFS + HDFSClient). HDFS has no place in this
+environment; the interface is kept with LocalFS implementing it so
+auto-checkpoint code paths are portable.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class FS:
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """~ fs.py LocalFS."""
+
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(path):
+            (dirs if os.path.isdir(os.path.join(path, e)) else files).append(e)
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def upload(self, local_path, fs_path):
+        self.mkdirs(os.path.dirname(fs_path))
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        shutil.copy(fs_path, local_path)
+
+    def mkdirs(self, path):
+        if path:
+            os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        self.mkdirs(os.path.dirname(path))
+        open(path, "a").close()
+
+
+class HDFSClient(FS):
+    """Interface parity stub: raises with guidance (no HDFS in scope)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise NotImplementedError(
+            "HDFS is out of scope for the TPU build (SURVEY.md §7 "
+            "non-goals); use LocalFS or orbax/tensorstore paths "
+            "(gs:// works natively through tensorstore)")

@@ -1,0 +1,96 @@
+"""Parameter-server capability slot.
+
+The reference's brpc PS stack (paddle/fluid/distributed/ps/: dense/sparse
+tables, accessors, geo-SGD — SURVEY.md §2.2) is declared out of the TPU
+north-star scope (§7 non-goals); this module provides the minimal
+TPU-idiomatic equivalent of its *capability*: a sparse embedding table
+served over TCPStore with push/pull + server-side SGD, good for the
+embedding-dominated workloads PS mode exists for. In-process mode doubles
+as the reference's ps_local_client.h test double.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .store import TCPStore
+
+
+class SparseTable:
+    """Server-side sparse table with SGD update rule
+    (~ distributed/ps/table/memory_sparse_table.cc + sparse_sgd_rule.cc)."""
+
+    def __init__(self, dim: int, init_std: float = 0.01, lr: float = 0.01,
+                 seed: int = 0):
+        self.dim = dim
+        self.lr = lr
+        self.init_std = init_std
+        self._rows: Dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(np.asarray(ids).reshape(-1)):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is None:
+                    row = (self._rng.standard_normal(self.dim)
+                           * self.init_std).astype(np.float32)
+                    self._rows[k] = row
+                out[i] = row
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        with self._lock:
+            for key, g in zip(np.asarray(ids).reshape(-1), grads):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is not None:
+                    row -= self.lr * g.astype(np.float32)
+
+    def save(self, path: str):
+        with self._lock:
+            with open(path, "wb") as f:
+                pickle.dump({"dim": self.dim, "rows": self._rows}, f)
+
+    def load(self, path: str):
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        with self._lock:
+            self.dim = d["dim"]
+            self._rows = d["rows"]
+
+    def size(self) -> int:
+        return len(self._rows)
+
+
+class PSClient:
+    """Client API (~ brpc_ps_client): local-table mode (in-process) or
+    remote over TCPStore serialized blobs (small-scale; the brpc data plane
+    is out of scope)."""
+
+    def __init__(self, table: Optional[SparseTable] = None,
+                 store: Optional[TCPStore] = None, table_id: int = 0):
+        self.table = table
+        self.store = store
+        self.table_id = table_id
+
+    def pull_sparse(self, ids):
+        if self.table is not None:
+            return self.table.pull(ids)
+        self.store.set(f"__ps_req__/{self.table_id}",
+                       pickle.dumps(("pull", np.asarray(ids))))
+        return pickle.loads(self.store.wait(f"__ps_resp__/{self.table_id}"))
+
+    def push_sparse(self, ids, grads):
+        if self.table is not None:
+            self.table.push(ids, np.asarray(grads))
+            return
+        self.store.set(f"__ps_req__/{self.table_id}",
+                       pickle.dumps(("push", np.asarray(ids),
+                                     np.asarray(grads))))

@@ -1,0 +1,100 @@
+"""Inference deployment.
+
+~ paddle/fluid/inference/ AnalysisPredictor (analysis_predictor.h:93):
+load optimized artifact → run with zero-copy tensors. TPU-native: the
+"analysis + pass pipeline" is XLA compilation at export time (jit.save
+freezes weights into a jax.export module); Predictor is the NaiveExecutor
+analog executing that artifact. TensorRT/Lite/ONNX engine slots are
+intentionally absent (SURVEY.md §7 non-goals) — XLA is the engine.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import load as _jit_load
+
+
+class Config:
+    """~ paddle_infer.Config (API-parity surface)."""
+
+    def __init__(self, model_path: str | None = None,
+                 params_path: str | None = None):
+        self.model_path = model_path
+        self._threads = 1
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._threads = n
+
+    def enable_use_gpu(self, *a, **kw):  # accel is implicit on TPU
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):  # XLA always optimizes
+        pass
+
+
+class Predictor:
+    """~ paddle_infer.Predictor over a jit.save artifact."""
+
+    def __init__(self, config_or_path):
+        path = (config_or_path.model_path
+                if isinstance(config_or_path, Config) else config_or_path)
+        if path.endswith(".pdmodel") or path.endswith(".pdiparams"):
+            path = path.rsplit(".", 1)[0]
+        self._layer = _jit_load(path)
+        self._inputs: List[np.ndarray] = []
+
+    def get_input_names(self):
+        return [f"x{i}" for i in range(8)]
+
+    def get_input_handle(self, name):
+        return _IOHandle(self, int(name[1:]) if name[1:].isdigit() else 0)
+
+    def run(self, inputs: Optional[List] = None):
+        if inputs is not None:
+            self._inputs = [np.asarray(
+                x.numpy() if isinstance(x, Tensor) else x) for x in inputs]
+        outs = self._layer(*[Tensor(x) for x in self._inputs])
+        if isinstance(outs, (tuple, list)):
+            self._outputs = [o.numpy() for o in outs]
+        else:
+            self._outputs = [outs.numpy()]
+        return self._outputs
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(getattr(self, "_outputs", [0])))]
+
+    def get_output_handle(self, name):
+        return _OutHandle(self, int(name[3:]) if name[3:].isdigit() else 0)
+
+
+class _IOHandle:
+    def __init__(self, pred, idx):
+        self.pred = pred
+        self.idx = idx
+
+    def copy_from_cpu(self, arr):
+        while len(self.pred._inputs) <= self.idx:
+            self.pred._inputs.append(None)
+        self.pred._inputs[self.idx] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+
+class _OutHandle:
+    def __init__(self, pred, idx):
+        self.pred = pred
+        self.idx = idx
+
+    def copy_to_cpu(self):
+        return self.pred._outputs[self.idx]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

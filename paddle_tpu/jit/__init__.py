@@ -156,7 +156,9 @@ def save(layer, path, input_spec=None, **configs):
              for k, v in layer.state_dict().items()} \
         if isinstance(layer, Layer) else {}
     hlo_text = None
+    exported_bytes = None
     if input_spec:
+        from jax import export as jax_export
         specs = [s if isinstance(s, InputSpec) else InputSpec(s)
                  for s in input_spec]
         example = [jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
@@ -167,30 +169,63 @@ def save(layer, path, input_spec=None, **configs):
         hlo_text = lowered.as_text()
         with open(path + ".pdmodel", "w") as f:
             f.write(hlo_text)
+        # executable artifact: params closed over, tokens-only signature
+        # (~ the reference's save_inference_model frozen program)
+        params = layer.tree_flatten_params() if isinstance(layer, Layer) \
+            else {}
+
+        def frozen(*xs):
+            if isinstance(layer, Layer):
+                old = layer.tree_flatten_params()
+                layer.load_tree(params)
+                try:
+                    with _tape.no_grad():
+                        out = fn(*[Tensor(x) for x in xs])
+                finally:
+                    layer.load_tree(old)
+            else:
+                with _tape.no_grad():
+                    out = fn(*[Tensor(x) for x in xs])
+            return _unwrap_tree(out)
+
+        exp = jax_export.export(jax.jit(frozen))(*example)
+        exported_bytes = exp.serialize()
+        with open(path + ".pdexport", "wb") as f:
+            f.write(exported_bytes)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
     meta = {"class": type(layer).__name__,
-            "has_model": hlo_text is not None}
+            "has_model": hlo_text is not None,
+            "has_export": exported_bytes is not None}
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 
 
 class TranslatedLayer(Layer):
-    """~ paddle.jit.TranslatedLayer — runtime for loaded artifacts."""
+    """~ paddle.jit.TranslatedLayer — runtime for loaded artifacts.
 
-    def __init__(self, state, hlo_text=None):
+    When a ``.pdexport`` artifact exists (jax.export serialized module with
+    weights frozen in), forward() executes it directly — the deployment
+    path (NaiveExecutor/AnalysisPredictor slot)."""
+
+    def __init__(self, state, hlo_text=None, exported=None):
         super().__init__()
         self._state = {k: Tensor(v) for k, v in state.items()}
         self._hlo_text = hlo_text
+        self._exported = exported
 
     def state_dict(self, *a, **kw):
         return dict(self._state)
 
     def forward(self, *args):
-        raise RuntimeError(
-            "TranslatedLayer holds weights + StableHLO text; re-bind them to "
-            "a model class (set_state_dict) to execute. Direct StableHLO "
-            "execution requires a serving runtime.")
+        if self._exported is None:
+            raise RuntimeError(
+                "no executable artifact was saved (pass input_spec to "
+                "jit.save); weights are available via state_dict()")
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._exported.call(*vals)
+        return _wrap_tree(out)
 
 
 def load(path, **configs):
@@ -200,4 +235,9 @@ def load(path, **configs):
     if os.path.exists(path + ".pdmodel"):
         with open(path + ".pdmodel") as f:
             hlo = f.read()
-    return TranslatedLayer(state, hlo)
+    exported = None
+    if os.path.exists(path + ".pdexport"):
+        from jax import export as jax_export
+        with open(path + ".pdexport", "rb") as f:
+            exported = jax_export.deserialize(bytearray(f.read()))
+    return TranslatedLayer(state, hlo, exported)

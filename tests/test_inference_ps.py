@@ -1,0 +1,111 @@
+"""Inference deployment + PS capability slot + fs/rolemaker tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestInference:
+    def test_export_and_predict(self, tmp_path):
+        from paddle_tpu import inference
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m.eval()
+        path = str(tmp_path / "model")
+        paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([2, 4])])
+        pred = inference.Predictor(path)
+        x = np.random.randn(2, 4).astype(np.float32)
+        out = pred.run([x])
+        ref = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_handle_api(self, tmp_path):
+        from paddle_tpu import inference
+        m = nn.Linear(3, 2)
+        m.eval()
+        path = str(tmp_path / "m2")
+        paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([1, 3])])
+        cfg = inference.Config(path)
+        pred = inference.create_predictor(cfg)
+        h = pred.get_input_handle("x0")
+        h.copy_from_cpu(np.ones((1, 3), np.float32))
+        pred.run()
+        out = pred.get_output_handle("out0").copy_to_cpu()
+        assert out.shape == (1, 2)
+
+
+class TestPS:
+    def test_sparse_table_pull_push(self):
+        from paddle_tpu.distributed.ps import PSClient, SparseTable
+        table = SparseTable(dim=8, lr=0.5)
+        client = PSClient(table)
+        ids = np.array([3, 7, 3])
+        rows = client.pull_sparse(ids)
+        assert rows.shape == (3, 8)
+        np.testing.assert_allclose(rows[0], rows[2])  # same id same row
+        g = np.ones((3, 8), np.float32)
+        client.push_sparse(ids, g)
+        rows2 = client.pull_sparse(np.array([3]))
+        # id 3 got two grad rows pushed: -0.5*1 twice
+        np.testing.assert_allclose(rows2[0], rows[0] - 1.0, rtol=1e-6)
+
+    def test_table_save_load(self, tmp_path):
+        from paddle_tpu.distributed.ps import SparseTable
+        t = SparseTable(dim=4)
+        t.pull(np.array([1, 2, 3]))
+        p = str(tmp_path / "table.pkl")
+        t.save(p)
+        t2 = SparseTable(dim=4)
+        t2.load(p)
+        assert t2.size() == 3
+        np.testing.assert_allclose(t2.pull(np.array([1])),
+                                   t.pull(np.array([1])))
+
+
+class TestFS:
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdirs(d)
+        assert fs.is_dir(d)
+        f = str(tmp_path / "a" / "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert "a" in dirs
+        fs.mv(f, str(tmp_path / "y.txt"))
+        assert fs.is_exist(str(tmp_path / "y.txt"))
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+
+class TestRoleMaker:
+    def test_env_discovery(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.role_maker import (
+            PaddleCloudRoleMaker)
+        monkeypatch.setenv("PADDLE_GLOBAL_RANK", "2")
+        monkeypatch.setenv("PADDLE_WORLD_SIZE", "4")
+        rm = PaddleCloudRoleMaker()
+        assert rm.worker_index() == 2
+        assert rm.worker_num() == 4
+        assert not rm.is_first_worker()
+
+
+class TestElastic:
+    def test_membership_and_heartbeat(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.store import TCPStore
+        master = TCPStore("127.0.0.1", 29633, is_master=True)
+        m1 = ElasticManager(TCPStore("127.0.0.1", 29633), "node-a",
+                            np_range=(1, 3), heartbeat_interval=0.2,
+                            dead_after=2.0).start()
+        m2 = ElasticManager(TCPStore("127.0.0.1", 29633), "node-b",
+                            np_range=(1, 3), heartbeat_interval=0.2,
+                            dead_after=2.0).start()
+        import time
+        time.sleep(0.6)
+        alive = m1.alive_members()
+        assert set(alive) == {"node-a", "node-b"}
+        m2.stop()
+        m1.stop()
+        master.close()
