@@ -11,5 +11,8 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .activation import *  # noqa: F401,F403
 from . import tensor_methods as _tm
+from . import codegen as _codegen
+from .codegen import infer_meta  # noqa: F401
 
+_generated_ops = _codegen.generate(globals())
 _tm.install()
