@@ -1,0 +1,225 @@
+"""Functional Llama: scan-over-layers + pipeline-parallel training.
+
+The nn.Layer Llama (llama.py) is the eager/API surface; this module is the
+scaled execution form:
+  * layer params STACKED along a leading axis; the decoder stack runs as
+    ``lax.scan`` over layer params — one compiled layer body regardless of
+    depth (fast compiles, natural remat granularity), and the stacking is
+    exactly what pipeline parallelism needs.
+  * ``llama_pp_train_step_factory``: dp x pp training. Decoder layers are
+    split into `pipe` stages (leading axis sharded over the 'pipe' mesh
+    axis); microbatches flow through parallel.pipeline_apply (shard_map +
+    ppermute), embedding/norm/lm-head run replicated outside the rotation.
+    This is the compiled replacement for the reference's 1F1B runtime
+    (SURVEY.md §2.2 pipeline rows) composed with data parallelism.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .llama import LlamaConfig, LlamaForCausalLM, apply_rotary
+
+LAYER_KEYS = [
+    "input_layernorm.weight",
+    "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+    "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+    "post_attention_layernorm.weight",
+    "mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight",
+]
+
+
+def split_params(model: LlamaForCausalLM):
+    """model state_dict -> (outer_params, stacked_layer_params)."""
+    sd = {k: v._value for k, v in model.state_dict().items()}
+    L = model.config.num_hidden_layers
+    layers = {}
+    for key in LAYER_KEYS:
+        leaves = [sd.pop(f"model.layers.{i}.{key}") for i in range(L)]
+        layers[key] = jnp.stack(leaves)
+    return sd, layers
+
+
+def merge_params(model: LlamaForCausalLM, outer, layers):
+    sd = dict(outer)
+    L = model.config.num_hidden_layers
+    for key, stacked in layers.items():
+        for i in range(L):
+            sd[f"model.layers.{i}.{key}"] = stacked[i]
+    model.load_tree(sd)
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return y.astype(x.dtype) * w
+
+
+def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
+    """One decoder layer over its param dict (pure)."""
+    B, S, H = x.shape
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = H // nh
+    h = _rms(x, p["input_layernorm.weight"], cfg.rms_norm_eps)
+    q = (h @ p["self_attn.q_proj.weight"]).reshape(B, S, nh, hd)
+    k = (h @ p["self_attn.k_proj.weight"]).reshape(B, S, nkv, hd)
+    v = (h @ p["self_attn.v_proj.weight"]).reshape(B, S, nkv, hd)
+    pos = jnp.arange(S)
+    q = apply_rotary(q, pos, cfg.rope_theta)
+    k = apply_rotary(k, pos, cfg.rope_theta)
+    if nh != nkv:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    use_flash = (S >= 256 and S % 128 == 0 and hd in (64, 128, 256)
+                 and qt.dtype in (jnp.float32, jnp.bfloat16)
+                 and jax.default_backend() != "cpu")
+    if use_flash:
+        from ...ops.pallas.flash_attention import flash_attention
+        ctx = flash_attention(qt, kt, vt, True)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(hd)
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(causal, s, jnp.finfo(s.dtype).min)
+        probs = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qt.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    attn = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H) \
+        @ p["self_attn.o_proj.weight"]
+    x = x + attn
+    h2 = _rms(x, p["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(h2 @ p["mlp.gate_proj.weight"])
+           * (h2 @ p["mlp.up_proj.weight"])) @ p["mlp.down_proj.weight"]
+    return x + mlp
+
+
+def forward(cfg: LlamaConfig, outer, layers, tokens, remat=True):
+    """Full causal-LM forward with lax.scan over stacked layers."""
+    x = jnp.take(outer["model.embed_tokens.weight"], tokens, axis=0)
+
+    body = (lambda carry, lp: (layer_forward(cfg, lp, carry), None))
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, layers)
+    x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+    head = outer.get("lm_head.weight")
+    if head is None:
+        return x @ outer["model.embed_tokens.weight"].T
+    return x @ head
+
+
+def loss_fn(cfg, outer, layers, tokens, labels, remat=True):
+    logits = forward(cfg, outer, layers, tokens, remat).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    return jnp.mean(-jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
+
+
+def llama_pp_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
+                                n_microbatches: int = 2,
+                                learning_rate=1e-4, weight_decay=0.01,
+                                beta1=0.9, beta2=0.95, eps=1e-8,
+                                remat: bool = True):
+    """dp x pp compiled training step.
+
+    mesh axes: 'pipe' (required) and optionally 'data'. Decoder layers are
+    evenly split over stages; stage leaf shape (n_stages, L/stage, ...).
+    Returns (params, opt_state, step_fn).
+    """
+    from ...parallel.pipeline import pipeline_apply
+
+    cfg = model.config
+    n_stages = mesh.shape["pipe"]
+    data_axis = "data" if "data" in mesh.axis_names else None
+    L = cfg.num_hidden_layers
+    assert L % n_stages == 0, (L, n_stages)
+    per = L // n_stages
+
+    outer, layers = split_params(model)
+    # reshape stacked layers (L, ...) -> (n_stages, per, ...)
+    layers = jax.tree.map(
+        lambda a: jnp.array(a, copy=True).reshape(
+            (n_stages, per) + a.shape[1:]), layers)
+    outer = {k: jnp.array(v, copy=True) for k, v in outer.items()}
+
+    rep = NamedSharding(mesh, P())
+    pipe_sh = {k: NamedSharding(mesh, P("pipe"))
+               for k in layers}
+    outer_sh = {k: rep for k in outer}
+    outer = {k: jax.device_put(v, rep) for k, v in outer.items()}
+    layers = {k: jax.device_put(v, pipe_sh[k]) for k, v in layers.items()}
+
+    params = {"outer": outer, "layers": layers}
+    shardings = {"outer": outer_sh, "layers": pipe_sh}
+    moments_sh = shardings
+
+    def zeros_like_tree(tree, sh):
+        return {k: jax.device_put(jnp.zeros(v.shape, jnp.float32), sh[k])
+                for k, v in tree.items()}
+
+    opt_state = {
+        "step": jnp.zeros((), jnp.int32),
+        "m": {"outer": zeros_like_tree(outer, outer_sh),
+              "layers": zeros_like_tree(layers, pipe_sh)},
+        "v": {"outer": zeros_like_tree(outer, outer_sh),
+              "layers": zeros_like_tree(layers, pipe_sh)},
+    }
+
+    def stage_fn(stage_params, x):
+        body = lambda carry, lp: (layer_forward(cfg, lp, carry), None)
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    def pipe_loss(params, tokens, labels):
+        emb = jnp.take(params["outer"]["model.embed_tokens.weight"], tokens,
+                       axis=0)
+        h = pipeline_apply(stage_fn, params["layers"], emb, mesh,
+                           n_microbatches, remat=remat, data_axis=data_axis)
+        h = _rms(h, params["outer"]["model.norm.weight"], cfg.rms_norm_eps)
+        head = params["outer"].get("lm_head.weight")
+        logits = (h @ (head if head is not None
+                       else params["outer"]["model.embed_tokens.weight"].T))
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.mean(
+            -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
+
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(pipe_loss)(params, tokens, labels)
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m2 = beta1 * m + (1 - beta1) * g
+            v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+            mhat = m2 / (1 - beta1 ** t)
+            vhat = v2 / (1 - beta2 ** t)
+            delta = mhat / (jnp.sqrt(vhat) + eps) \
+                + weight_decay * p.astype(jnp.float32)
+            return ((p.astype(jnp.float32)
+                     - learning_rate * delta).astype(p.dtype), m2, v2)
+
+        new_p = {"outer": {}, "layers": {}}
+        new_m = {"outer": {}, "layers": {}}
+        new_v = {"outer": {}, "layers": {}}
+        for grp in ("outer", "layers"):
+            for k in params[grp]:
+                new_p[grp][k], new_m[grp][k], new_v[grp][k] = upd(
+                    params[grp][k], grads[grp][k],
+                    opt_state["m"][grp][k], opt_state["v"][grp][k])
+        return new_p, {"step": step, "m": new_m, "v": new_v}, loss
+
+    batch_sh = NamedSharding(mesh, P(data_axis) if data_axis else P())
+    jitted = jax.jit(
+        train_step,
+        in_shardings=({"outer": outer_sh, "layers": pipe_sh},
+                      {"step": rep,
+                       "m": {"outer": outer_sh, "layers": pipe_sh},
+                       "v": {"outer": outer_sh, "layers": pipe_sh}},
+                      batch_sh, batch_sh),
+        donate_argnums=(0, 1))
+    return params, opt_state, jitted

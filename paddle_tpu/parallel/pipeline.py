@@ -31,7 +31,7 @@ def stack_stage_params(per_stage_params):
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
                    n_microbatches: int, axis: str = "pipe",
-                   remat: bool = True):
+                   remat: bool = True, data_axis: str | None = None):
     """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
 
     stage_fn(stage_params, activation) -> activation (same shape) — the body
@@ -95,9 +95,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     mb = B // n_microbatches
     xm = x.reshape((n_microbatches, mb) + x.shape[1:])
 
-    in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), P())
-    out_specs = P()
+    # batch (microbatch dim 1) may additionally shard over a data axis —
+    # each data shard runs its own pipeline instance over the same stages
+    x_spec = P(None, data_axis) if data_axis else P()
+    in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), x_spec)
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
+                       out_specs=x_spec, check_vma=False)
     y = fn(stacked_params, xm)
     return y.reshape((B,) + y.shape[2:])
