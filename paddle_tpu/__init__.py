@@ -74,14 +74,15 @@ def is_grad_enabled() -> bool:
 
 
 def disable_static(place=None):
-    return None
+    from .static.graph import disable_static as _ds
+    return _ds(place)
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for the "
-        "compiled path (the ProgramDesc/Executor stack has no TPU analog)")
+    from .static.graph import enable_static as _es
+    return _es()
 
 
 def in_dynamic_mode() -> bool:
-    return True
+    from .static.graph import in_static_mode
+    return not in_static_mode()

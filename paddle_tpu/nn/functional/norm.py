@@ -37,7 +37,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         mean_v = apply_op("bn_mean", lambda v: jnp.mean(v, axis=axes), x)
         var_v = apply_op("bn_var", lambda v: jnp.var(v, axis=axes), x)
         with_stats_x = x
-        if running_mean is not None:
+        if running_mean is not None and not getattr(mean_v, "_symbolic",
+                                                    False):
+            # static-graph capture: batch stats are symbolic, so the running
+            # stats stay frozen inside the compiled program
             running_mean._value = (momentum * running_mean._value
                                    + (1 - momentum) * mean_v._value)
             running_var._value = (momentum * running_var._value

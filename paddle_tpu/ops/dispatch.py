@@ -35,6 +35,11 @@ __all__ = ["apply_op", "def_op", "OP_REGISTRY"]
 # name only (backend/layout/dtype keys collapse: XLA compiles for the device).
 OP_REGISTRY: dict[str, Callable] = {}
 
+# flipped by paddle_tpu.static.graph.enable_static(); when True, ops whose
+# inputs include symbolic StaticVars are captured into the default Program
+# instead of executing (~ LayerHelper.append_op vs the eager trampoline)
+STATIC_MODE = False
+
 
 def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
@@ -75,6 +80,9 @@ def apply_op(name: str, fn: Callable, *args, nondiff: bool = False, **kwargs):
 
 
 def _apply_op_inner(name, fn, args, kwargs, nondiff):
+    if STATIC_MODE and any(getattr(a, "_symbolic", False) for a in args):
+        from ..static import graph as _sg
+        return _sg.capture(name, fn, args, kwargs)
     vals = [_unwrap(a) for a in args]
     from .. import amp as _amp
     if _amp.amp_state() is not None:

@@ -116,6 +116,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        if getattr(loss, "_symbolic", False):
+            # static-graph mode: append the update step to the program; the
+            # Executor compiles grads+update into the jitted step
+            # (~ Optimizer.minimize appending backward + optimize ops)
+            from ..static import graph as _sg
+            prog = _sg.default_main_program()
+            params = parameters or self._parameters or None
+            prog._append_opt(self, loss, params)
+            pg = _sg.append_backward(loss, params)
+            return None, pg
         loss.backward()
         self.step()
         return None, None

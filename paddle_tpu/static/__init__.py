@@ -1,29 +1,38 @@
-"""paddle_tpu.static — static-graph API surface.
+"""paddle_tpu.static — static-graph API.
 
-The reference's ProgramDesc/Executor stack (SURVEY.md §3.3) has no TPU
-analog: jax tracing + jit IS the static graph. This module keeps the
-commonly-scripted entry points as thin adapters over paddle_tpu.jit so
-static-style user code ports mechanically.
+~ python/paddle/static/ over the ProgramDesc/Executor stack (SURVEY.md §3.3,
+layer 5). TPU-native: ops on symbolic ``static.data`` vars are captured as a
+functional DAG (graph.py); ``Executor.run`` compiles the whole program —
+forward, ``append_backward`` grads, ``Optimizer.minimize`` updates — into a
+single ``jax.jit`` program per feed signature (executor.py). The
+InterpreterCore/ParallelExecutor machinery collapses into the XLA scheduler.
 """
 from __future__ import annotations
 
 from ..jit import InputSpec  # noqa: F401
+from .graph import (Program, StaticVar, GradVar, data, program_guard,  # noqa
+                    default_main_program, default_startup_program,
+                    append_backward, gradients)
+from .executor import (Executor, CompiledProgram, Scope, global_scope,  # noqa
+                       scope_guard)
+from .io import save_inference_model, load_inference_model  # noqa: F401
+from . import nn  # noqa: F401
+
+Variable = StaticVar
+
+__all__ = [
+    "Program", "StaticVar", "Variable", "GradVar", "data", "program_guard",
+    "default_main_program", "default_startup_program", "append_backward",
+    "gradients", "Executor", "CompiledProgram", "Scope", "global_scope",
+    "scope_guard", "save_inference_model", "load_inference_model", "nn",
+    "InputSpec",
+]
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the traced "
-        "StableHLO + params artifact replaces save_inference_model")
+def name_scope(prefix=None):
+    import contextlib
 
-
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.load(path)")
-
-
-class Executor:
-    def __init__(self, place=None):
-        raise NotImplementedError(
-            "paddle_tpu has no Program/Executor; decorate your function "
-            "with paddle_tpu.jit.to_static and call it directly")
+    @contextlib.contextmanager
+    def _g():
+        yield
+    return _g()

@@ -1,0 +1,285 @@
+"""Static-graph Executor: compose the captured DAG and jit it.
+
+~ the reference's executor stack (SURVEY.md §3.3): python Executor
+(fluid/executor.py:1103 run → _run_impl:1301) over StandaloneExecutor/
+InterpreterCore (framework/new_executor/interpretercore.cc). Here the
+"instruction list build" is a functional composition of the captured DAG
+into one f(feeds, params) and the async dependency-driven dispatch is XLA's
+scheduler: the whole program — forward, grads, optimizer update — compiles
+to a single donated-state device program per feed signature (the fusion
+InterpreterCore could never do).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from . import graph as G
+
+
+class Scope:
+    """~ framework/scope.h seen from python: name -> value view over the
+    program's persistables."""
+
+    def __init__(self):
+        self._extra: Dict[str, np.ndarray] = {}
+
+    def find_var(self, name):
+        prog = G.default_main_program()
+        try:
+            v = prog.var(name)
+        except KeyError:
+            return self._extra.get(name)
+        return v
+
+    def var(self, name):
+        return self.find_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _g():
+        yield scope
+    return _g()
+
+
+def _eval_var(var, env):
+    """Recursively evaluate a StaticVar under the value environment.
+    env maps id(var-or-param) -> traced jax value."""
+    key = id(var)
+    if key in env:
+        return env[key]
+    node = var._node
+    if node is None:
+        raise RuntimeError(
+            f"StaticVar '{var.name}' was not fed (feed slots present: "
+            "check the feed dict keys against static.data names)")
+    vals = []
+    for a in node.args:
+        if G._is_symbolic(a):
+            vals.append(_eval_var(a, env))
+        elif isinstance(a, Tensor):
+            vals.append(env.get(id(a), a._value))
+        else:
+            vals.append(a)
+    out = node.fn(*vals, **node.kwargs)
+    outs = (out,) if node.single else tuple(out)
+    for v, o in zip(node.out_vars, outs):
+        env[id(v)] = o
+    return env[key]
+
+
+class CompiledProgram:
+    """~ fluid.CompiledProgram/compiler.py — the jit happens inside
+    Executor.run regardless, so this is a strategy-carrying view."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+class Executor:
+    """~ paddle.static.Executor. place is accepted for API parity; XLA owns
+    placement (the default device)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, callable] = {}
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_prune=False):
+        prog = program if program is not None else G.default_main_program()
+        if isinstance(prog, CompiledProgram):
+            prog = prog._program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        # startup program: snapshot/restore parameter init values
+        if prog._n_ops == 0 and not prog._opts and not fetch_list:
+            self._run_startup(prog)
+            return []
+
+        fetch_vars = [self._resolve_fetch(prog, f) for f in fetch_list]
+        feed_items = sorted(feed.items())
+        feed_names, feed_vals = [], []
+        for name, v in feed_items:
+            dv = prog._datas.get(name)
+            if dv is None:
+                raise KeyError(
+                    f"feed key {name!r} does not match any static.data var "
+                    f"(have {list(prog._datas)})")
+            arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            feed_names.append(name)
+            feed_vals.append(jnp.asarray(arr, dv._jdtype))
+
+        key = (prog.id, prog._version,
+               tuple(feed_names),
+               tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
+               tuple(id(f) for f in fetch_vars))
+        step_fn = self._cache.get(key)
+        if step_fn is None:
+            step_fn = self._build(prog, feed_names, fetch_vars)
+            self._cache[key] = step_fn
+
+        params = list(prog._params)
+        param_vals = [p._value for p in params]
+        opt_states, lrs, steps = [], [], []
+        for optimizer, _loss, opt_params in prog._opts:
+            ps = self._opt_params(prog, optimizer, opt_params)
+            opt_states.append([optimizer._accs_for(p) for p in ps])
+            lrs.append(jnp.asarray(optimizer.get_lr(), jnp.float32))
+            steps.append(jnp.asarray(optimizer._step_count + 1, jnp.int32))
+
+        fetches, new_param_vals, new_opt_states = step_fn(
+            feed_vals, param_vals, opt_states, lrs, steps)
+
+        if new_param_vals is not None:
+            for p, nv in zip(params, new_param_vals):
+                p._value = nv
+            for (optimizer, _loss, opt_params), accs in zip(
+                    prog._opts, new_opt_states):
+                ps = self._opt_params(prog, optimizer, opt_params)
+                for p, na in zip(ps, accs):
+                    optimizer._accumulators[id(p)] = na
+                optimizer._step_count += 1
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # ------------------------------------------------------------------
+    def _run_startup(self, prog):
+        params = prog._params or G.default_main_program()._params
+        if prog._param_snapshot is None:
+            prog._param_snapshot = {
+                id(p): np.asarray(p._value) for p in params}
+        else:
+            for p in params:
+                snap = prog._param_snapshot.get(id(p))
+                if snap is not None:
+                    p._value = jnp.asarray(snap)
+
+    def _resolve_fetch(self, prog, f):
+        if isinstance(f, str):
+            return prog.var(f)
+        if isinstance(f, (G.StaticVar, Parameter, Tensor)):
+            return f
+        raise TypeError(f"bad fetch_list entry: {f!r}")
+
+    @staticmethod
+    def _opt_params(prog, optimizer, opt_params):
+        if opt_params:
+            ps = opt_params
+        elif optimizer._parameters:
+            ps = optimizer._parameters
+        else:
+            ps = prog._params
+        return [p for p in ps if p.trainable]
+
+    # ------------------------------------------------------------------
+    def _build(self, prog, feed_names, fetch_vars):
+        """Compile one (program, feed signature, fetch set) entry."""
+        params = list(prog._params)
+        data_vars = [prog._datas[n] for n in feed_names]
+        opt_entries = [(opt, loss, self._opt_params(prog, opt, ps))
+                       for opt, loss, ps in prog._opts]
+        train = bool(opt_entries)
+
+        grad_fetches = [f for f in fetch_vars if isinstance(f, G.GradVar)]
+        need_grads = train or bool(grad_fetches)
+        # grads additionally wrt fed data vars named by fetched GradVars
+        grad_data_wrts = [g.wrt for g in grad_fetches
+                          if isinstance(g.wrt, G.StaticVar)]
+        # loss used for pure append_backward/gradients fetches
+        aux_losses = [g.loss for g in grad_fetches]
+        assert len({id(loss) for _, loss, _ in opt_entries}
+                   | {id(l) for l in aux_losses}) <= 1 or not need_grads, \
+            "all grads in one program must flow from a single loss"
+        loss_var = (opt_entries[0][1] if train
+                    else (aux_losses[0] if aux_losses else None))
+
+        def forward(env):
+            # evaluate every fetch (memoized through the shared env)
+            outs = []
+            for f in fetch_vars:
+                if isinstance(f, G.GradVar):
+                    outs.append(None)  # filled after grad computation
+                elif G._is_symbolic(f):
+                    outs.append(_eval_var(f, env))
+                else:  # concrete Tensor/Parameter fetch
+                    outs.append(env.get(id(f), f._value))
+            return outs
+
+        def make_env(feed_vals, param_vals, data_grads_vals=None):
+            env = {}
+            for dv, v in zip(data_vars, feed_vals):
+                env[id(dv)] = v
+            for p, v in zip(params, param_vals):
+                env[id(p)] = v
+            return env
+
+        def step(feed_vals, param_vals, opt_states, lrs, steps):
+            if not need_grads:
+                env = make_env(feed_vals, param_vals)
+                return forward(env), None, None
+
+            diff_feed_idx = [i for i, dv in enumerate(data_vars)
+                             if any(g is dv for g in grad_data_wrts)]
+
+            def loss_fn(pvals, dvals):
+                fv = list(feed_vals)
+                for i, v in zip(diff_feed_idx, dvals):
+                    fv[i] = v
+                env = make_env(fv, pvals)
+                lv = _eval_var(loss_var, env) if loss_var is not None else 0.
+                return lv, forward(env)
+
+            (loss_val, outs), (pgrads, dgrads) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(
+                param_vals, [feed_vals[i] for i in diff_feed_idx])
+
+            grad_by_id = {id(p): g for p, g in zip(params, pgrads)}
+            for i, g in zip(diff_feed_idx, dgrads):
+                grad_by_id[id(data_vars[i])] = g
+            for k, f in enumerate(fetch_vars):
+                if isinstance(f, G.GradVar):
+                    outs[k] = grad_by_id[id(f.wrt)]
+
+            new_param_vals = list(param_vals)
+            new_opt_states = []
+            if train:
+                pos = {id(p): i for i, p in enumerate(params)}
+                for (optimizer, _loss, ps), accs, lr, stp in zip(
+                        opt_entries, opt_states, lrs, steps):
+                    grads = [grad_by_id[id(p)].astype(jnp.float32)
+                             for p in ps]
+                    grads = optimizer._apply_grad_clip(ps, grads)
+                    new_accs = []
+                    for p, g, a in zip(ps, grads, accs):
+                        nv, na = optimizer._update(
+                            new_param_vals[pos[id(p)]], g, a, lr, stp)
+                        new_param_vals[pos[id(p)]] = nv
+                        new_accs.append(na)
+                    new_opt_states.append(new_accs)
+            return outs, (new_param_vals if train else None), \
+                (new_opt_states if train else None)
+
+        return jax.jit(step)

@@ -1,0 +1,334 @@
+"""Static-graph capture: Program / StaticVar / data / program_guard.
+
+TPU-native equivalent of the reference's ProgramDesc stack
+(paddle/fluid/framework/framework.proto:236 ProgramDesc -> BlockDesc:212 ->
+OpDesc:50; python mirror python/paddle/fluid/framework.py Program/Block/
+Variable). Instead of a protobuf op list interpreted by an executor, ops
+applied to symbolic ``StaticVar`` inputs are captured as a functional DAG
+(the jaxpr-before-the-jaxpr); ``Executor.run`` composes the DAG into one
+function of (feeds, params) and ``jax.jit``-compiles it per feed signature —
+the InterpreterCore/CINN roles collapse into XLA.
+
+Dynamic dims: ``static.data`` accepts -1/None dims (framework.py Variable
+semantics). Shape inference runs with a probe extent; dims that inherit the
+probe report as -1. Compilation is per concrete feed signature, so the
+executed program always has static shapes (XLA requirement).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtypes
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "Program", "StaticVar", "GradVar", "data", "program_guard",
+    "default_main_program", "default_startup_program", "enable_static",
+    "disable_static", "in_static_mode", "append_backward", "gradients",
+]
+
+# probe extent substituted for -1/None dims during shape inference; any
+# inferred dim equal to it is reported back as -1 (dynamic)
+_PROBE = 97
+
+
+class OpNode:
+    """One captured op (~ OpDesc framework.proto:50): the raw jax-traceable
+    fn, its positional inputs (StaticVar | Tensor | python), static attrs,
+    and the output vars."""
+
+    __slots__ = ("name", "fn", "args", "kwargs", "out_vars", "single")
+
+    def __init__(self, name, fn, args, kwargs):
+        self.name = name
+        self.fn = fn
+        self.args = list(args)
+        self.kwargs = dict(kwargs)
+        self.out_vars: List["StaticVar"] = []
+        self.single = True
+
+
+class StaticVar(Tensor):
+    """Symbolic variable (~ framework.py Variable:1212): shape/dtype known,
+    no value until ``Executor.run``. Flows through the same python op APIs
+    as eager Tensors; the dispatcher reroutes ops on it into graph capture.
+    """
+
+    _symbolic = True
+    _counter = 0
+
+    def __init__(self, shape, dtype, name=None, node=None, out_index=0,
+                 is_data=False):
+        # deliberately not calling Tensor.__init__: there is no value
+        self._shape = tuple(
+            -1 if (d is None or int(d) < 0) else int(d) for d in shape)
+        self._probe_shape = tuple(
+            _PROBE if d == -1 else d for d in self._shape)
+        self._jdtype = jnp.dtype(_dtypes.convert_dtype(dtype))
+        self.stop_gradient = True
+        self._grad = None
+        self._grad_node = None
+        self._output_index = out_index
+        self._node: Optional[OpNode] = node
+        self.is_data = is_data
+        self.persistable = False
+        if name is None:
+            name = f"_generated_var_{StaticVar._counter}"
+            StaticVar._counter += 1
+        self.name = name
+
+    # ---- abstract properties (shadow Tensor's value-backed ones) ----------
+    @property
+    def _value(self):
+        raise RuntimeError(
+            f"StaticVar '{self.name}' has no value at graph-build time; "
+            "values exist only inside Executor.run (feed it or fetch it)")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._jdtype)
+
+    @property
+    def size(self):
+        if -1 in self._shape:
+            return -1
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    def numpy(self):
+        raise RuntimeError(
+            f"StaticVar '{self.name}' is symbolic; fetch it via "
+            "Executor.run(fetch_list=[var]) to get a value")
+
+    def aval(self) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(self._probe_shape, self._jdtype)
+
+    def __repr__(self):
+        return (f"StaticVar(name={self.name}, shape={list(self._shape)}, "
+                f"dtype={self.dtype.name})")
+
+    def __hash__(self):
+        return id(self)
+
+
+class GradVar(StaticVar):
+    """Symbolic gradient d(loss)/d(wrt) (~ the grad vars append_backward
+    creates, fluid/backward.py). ``wrt`` is a Parameter or a data StaticVar;
+    the executor computes it with jax.grad over the composed program."""
+
+    def __init__(self, loss: StaticVar, wrt):
+        shape = wrt.shape if isinstance(wrt, StaticVar) \
+            else list(wrt._value.shape)
+        dt = wrt.dtype
+        super().__init__(shape, dt, name=f"{getattr(wrt, 'name', 'w')}@GRAD")
+        self.loss = loss
+        self.wrt = wrt
+
+
+class Program:
+    """~ fluid.Program (framework.py): the captured graph + its parameters +
+    appended optimizer steps. There is one flat block; control flow is
+    lax.cond/scan inside ops rather than sub-blocks."""
+
+    _counter = 0
+
+    def __init__(self):
+        self._datas: Dict[str, StaticVar] = {}
+        self._vars: Dict[str, StaticVar] = {}
+        self._params: List[Parameter] = []
+        self._param_ids = set()
+        self._opts: List[tuple] = []     # (optimizer, loss_var, params|None)
+        self._layers: List[Any] = []     # static.nn layers kept alive
+        self._n_ops = 0
+        self._version = 0
+        self._param_snapshot: Optional[Dict[int, np.ndarray]] = None
+        self.random_seed = 0
+        self.id = Program._counter
+        Program._counter += 1
+
+    # ---- registration ------------------------------------------------------
+    def _add_param(self, p: Parameter):
+        if id(p) not in self._param_ids:
+            self._param_ids.add(id(p))
+            self._params.append(p)
+            self._version += 1
+
+    def _add_var(self, v: StaticVar):
+        self._vars[v.name] = v
+
+    def _append_opt(self, optimizer, loss, parameters=None):
+        self._opts.append((optimizer, loss, parameters))
+        self._version += 1
+
+    # ---- paddle API compat -------------------------------------------------
+    def global_block(self):
+        return self
+
+    @property
+    def blocks(self):
+        return [self]
+
+    def all_parameters(self):
+        return list(self._params)
+
+    def list_vars(self):
+        return list(self._datas.values()) + list(self._vars.values())
+
+    def var(self, name):
+        if name in self._datas:
+            return self._datas[name]
+        if name in self._vars:
+            return self._vars[name]
+        for p in self._params:
+            if p.name == name:
+                return p
+        raise KeyError(f"no var named {name!r} in program")
+
+    has_var = lambda self, name: (name in self._datas or name in self._vars)
+
+    def clone(self, for_test: bool = False):
+        # vars are shared; cloning is a view (the reference deep-copies the
+        # proto, but our graph is immutable-by-construction)
+        c = Program.__new__(Program)
+        c.__dict__ = dict(self.__dict__)
+        c.id = Program._counter  # distinct executor compile-cache identity
+        Program._counter += 1
+        if for_test:
+            c._opts = []
+        return c
+
+    def __repr__(self):
+        return (f"Program(id={self.id}, datas={list(self._datas)}, "
+                f"params={len(self._params)}, ops={self._n_ops}, "
+                f"opt_steps={len(self._opts)})")
+
+
+_default_main = Program()
+_default_startup = Program()
+_static_mode = False
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    """~ fluid.program_guard."""
+    global _default_main, _default_startup
+    old_m, old_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = old_m, old_s
+
+
+def enable_static():
+    """~ paddle.enable_static (python/paddle/fluid/framework.py): flips the
+    dispatcher into graph-capture mode for ops touching StaticVars."""
+    global _static_mode
+    _static_mode = True
+    from ..ops import dispatch as _d
+    _d.STATIC_MODE = True
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+    from ..ops import dispatch as _d
+    _d.STATIC_MODE = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def data(name: str, shape: Sequence[int], dtype=None, lod_level=0) -> StaticVar:
+    """~ paddle.static.data (python/paddle/fluid/data.py): a feed slot."""
+    if dtype is None:
+        dtype = _dtypes.get_default_dtype()
+    v = StaticVar(shape, dtype, name=name, is_data=True)
+    default_main_program()._datas[name] = v
+    return v
+
+
+def _is_symbolic(x) -> bool:
+    return getattr(x, "_symbolic", False)
+
+
+def capture(name: str, fn, args, kwargs):
+    """Append one op to the default main program (~ LayerHelper.append_op →
+    block.append_op, framework.py Operator:2533). Computes output shapes
+    through jax.eval_shape (the infermeta role) and returns StaticVars."""
+    prog = default_main_program()
+
+    abstract = []
+    for a in args:
+        if _is_symbolic(a):
+            abstract.append(a.aval())
+        elif isinstance(a, Tensor):
+            if isinstance(a, Parameter):
+                prog._add_param(a)
+                # the paired startup program owns initialization state
+                default_startup_program()._add_param(a)
+            abstract.append(jax.ShapeDtypeStruct(
+                tuple(a._value.shape), a._value.dtype))
+        else:
+            abstract.append(a)
+
+    out_aval = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *abstract)
+    single = not isinstance(out_aval, (tuple, list))
+    avals = (out_aval,) if single else tuple(out_aval)
+
+    node = OpNode(name, fn, args, kwargs)
+    node.single = single
+    probing = any(_is_symbolic(a) and -1 in a._shape for a in args)
+    outs = []
+    for i, av in enumerate(avals):
+        shp = [(-1 if (probing and d == _PROBE) else d) for d in av.shape]
+        v = StaticVar(shp, av.dtype, node=node, out_index=i)
+        # keep exact probe shape for downstream inference (PROBE**2 etc.
+        # would otherwise be lost by the -1 round trip)
+        v._probe_shape = tuple(av.shape)
+        outs.append(v)
+        prog._add_var(v)
+    node.out_vars = outs
+    prog._n_ops += 1
+    prog._version += 1
+    return outs[0] if single else tuple(outs)
+
+
+def append_backward(loss: StaticVar, parameter_list=None, no_grad_set=None):
+    """~ fluid.backward.append_backward: returns [(param, grad_var)]."""
+    prog = default_main_program()
+    params = parameter_list if parameter_list is not None else prog._params
+    params = [p for p in params
+              if isinstance(p, Parameter) and p.trainable]
+    return [(p, GradVar(loss, p)) for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """~ paddle.static.gradients: symbolic grads of targets wrt inputs."""
+    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert len(tgts) == 1, "gradients() supports a single scalar target"
+    return [GradVar(tgts[0], x) for x in ins]

@@ -1,0 +1,70 @@
+"""static.save/load_inference_model over jax.export.
+
+~ python/paddle/static/io.py (save_inference_model → pruned frozen program
++ params; fluid/io.cc). TPU-native artifact: the captured DAG is pruned to
+feed→fetch, parameters are frozen in as constants, and the result is
+serialized with jax.export (same .pdexport contract as paddle_tpu.jit.save)
+— loadable by paddle_tpu.jit.load / inference.Predictor.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import graph as G
+from .executor import _eval_var
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    prog = program if program is not None else G.default_main_program()
+    params = list(prog._params)
+    param_vals = [p._value for p in params]
+
+    def frozen(*feed_arrays):
+        env = {}
+        for dv, v in zip(feed_vars, feed_arrays):
+            env[id(dv)] = v
+        for p, v in zip(params, param_vals):
+            env[id(p)] = v
+        return tuple(_eval_var(f, env) for f in fetch_vars)
+
+    example = [jnp.zeros(tuple(1 if d == -1 else d for d in dv.shape),
+                         dv._jdtype) for dv in feed_vars]
+    from jax import export as jax_export
+    exp = jax_export.export(jax.jit(frozen))(*example)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "w") as f:
+        f.write(str(exp.mlir_module()))
+    with open(path_prefix + ".pdexport", "wb") as f:
+        f.write(exp.serialize())
+    state = {p.name: np.asarray(v) for p, v in zip(params, param_vals)}
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"class": "StaticProgram", "has_model": True,
+                     "has_export": True,
+                     "feed_names": [v.name for v in feed_vars],
+                     "fetch_names": [v.name for v in fetch_vars]}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program_like, feed_names, fetch_names); the program_like is
+    a TranslatedLayer callable on feed arrays (the NaiveExecutor role)."""
+    from ..jit import load as jit_load
+    layer = jit_load(path_prefix)
+    meta = {}
+    if os.path.exists(path_prefix + ".pdmeta"):
+        with open(path_prefix + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+    return (layer, meta.get("feed_names", []), meta.get("fetch_names", []))

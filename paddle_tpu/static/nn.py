@@ -1,0 +1,86 @@
+"""paddle.static.nn — program-building layer functions.
+
+~ python/paddle/static/nn/common.py (fc, conv2d, batch_norm, embedding...):
+each call creates fresh parameters in the default main program (the
+reference's LayerHelper.create_parameter) by instantiating the eager nn
+layer and calling it on the symbolic input; the layer object is parked on
+the program so its Parameters stay alive.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import graph as G
+
+
+def _park(layer):
+    G.default_main_program()._layers.append(layer)
+    return layer
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """~ static.nn.fc: flattens trailing dims, affine, optional act."""
+    from .. import nn
+    from ..nn import functional as F
+    from ..ops import manipulation as M
+
+    in_shape = x.shape
+    in_features = int(np.prod(in_shape[num_flatten_dims:]))
+    layer = _park(nn.Linear(in_features, size,
+                            weight_attr=weight_attr, bias_attr=bias_attr))
+    h = x
+    if len(in_shape) > num_flatten_dims + 1:
+        lead = list(in_shape[:num_flatten_dims])
+        lead = [(-1 if d == -1 else d) for d in lead]
+        h = M.reshape(h, lead + [in_features])
+    out = layer(h)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """~ static.nn.embedding."""
+    from .. import nn
+    layer = _park(nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                               weight_attr=param_attr))
+    return layer(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False,
+               name=None, **kwargs):
+    """~ static.nn.batch_norm. Running stats stay frozen inside the compiled
+    program (batch stats are used in training mode)."""
+    from .. import nn
+    from ..nn import functional as F
+    nc = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _park(nn.BatchNorm2D(nc, momentum=momentum, epsilon=epsilon,
+                                 data_format=data_layout)
+                  if input.ndim == 4 else
+                  nn.BatchNorm1D(nc, momentum=momentum, epsilon=epsilon))
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    """~ static.nn.conv2d."""
+    from .. import nn
+    from ..nn import functional as F
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _park(nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out

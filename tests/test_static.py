@@ -1,0 +1,267 @@
+"""Static-graph mode: capture, Executor, minimize, grads, inference export.
+
+Mirrors the reference's static tests (e.g. test_executor_and_use_program_cache,
+test_optimizer, fluid/tests/unittests/test_static_save_load.py) — SURVEY.md
+§3.3 stack rebuilt as DAG capture + jax.jit (paddle_tpu/static/).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture()
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+class TestCapture:
+    def test_data_and_shapes(self, static_mode):
+        with static.program_guard(static.Program(), static.Program()):
+            x = static.data("x", [-1, 4], "float32")
+            assert x.shape == [-1, 4]
+            y = x * 2.0 + 1.0
+            assert y.shape == [-1, 4]
+            assert y.dtype == np.float32
+            r = paddle.sum(y, axis=1)
+            assert r.shape == [-1]
+
+    def test_static_var_has_no_value(self, static_mode):
+        with static.program_guard(static.Program(), static.Program()):
+            x = static.data("x", [2, 2], "float32")
+            with pytest.raises(RuntimeError):
+                x.numpy()
+
+    def test_program_repr_and_vars(self, static_mode):
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [2, 3], "float32")
+            h = static.nn.fc(x, 5)
+        assert prog.has_var("x")
+        assert len(prog.all_parameters()) == 2  # W, b
+        assert prog.var("x") is x
+        assert h.shape == [2, 5]
+
+
+class TestExecutor:
+    def test_forward_matches_numpy(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 3], "float32")
+            h = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        W = np.asarray(main._params[0]._value)
+        b = np.asarray(main._params[1]._value)
+        xs = np.random.default_rng(0).normal(size=(5, 3)).astype("float32")
+        hv, = exe.run(main, feed={"x": xs}, fetch_list=[h])
+        np.testing.assert_allclose(hv, xs @ W + b, atol=1e-5)
+
+    def test_recompiles_per_batch_size(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 4], "float32")
+            s = paddle.sum(x)
+        exe = static.Executor()
+        for bs in (2, 7, 2):
+            xs = np.ones((bs, 4), "float32")
+            sv, = exe.run(main, feed={"x": xs}, fetch_list=[s])
+            assert float(sv) == pytest.approx(bs * 4.0)
+
+    def test_fetch_by_name_and_tensor(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 2], "float32")
+            y = x + 1.0
+        exe = static.Executor()
+        xs = np.zeros((2, 2), "float32")
+        a, b = exe.run(main, feed={"x": xs}, fetch_list=[y, y.name])
+        np.testing.assert_allclose(a, b)
+
+    def test_bad_feed_key_raises(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 2], "float32")
+            y = x + 1.0
+        exe = static.Executor()
+        with pytest.raises(KeyError):
+            exe.run(main, feed={"nope": np.zeros((2, 2), "f4")},
+                    fetch_list=[y])
+
+
+class TestTraining:
+    def test_sgd_minimize_converges(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 4], "float32")
+            y = static.data("y", [-1, 1], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean((pred - y) ** 2)
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(4, 1)).astype("float32")
+        xs = rng.normal(size=(64, 4)).astype("float32")
+        ys = xs @ W
+        first = last = None
+        for _ in range(50):
+            lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            first = float(lv) if first is None else first
+            last = float(lv)
+        assert last < first * 0.01
+
+    def test_static_matches_eager_training(self, static_mode):
+        """One Adam step on identical params/grads: static vs eager parity
+        (the OpTest static-vs-dygraph pillar, SURVEY.md §4)."""
+        rng = np.random.default_rng(3)
+        W0 = rng.normal(size=(3, 2)).astype("float32")
+        xs = rng.normal(size=(6, 3)).astype("float32")
+        ys = rng.normal(size=(6, 2)).astype("float32")
+
+        # static
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [6, 3], "float32")
+            y = static.data("y", [6, 2], "float32")
+            lin = nn.Linear(3, 2, bias_attr=False)
+            lin.weight.set_value(W0)
+            loss = paddle.mean((lin(x) - y) ** 2)
+            optimizer.Adam(learning_rate=0.01,
+                           parameters=lin.parameters()).minimize(loss)
+        exe = static.Executor()
+        lv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        W_static = np.asarray(lin.weight._value)
+
+        # eager
+        paddle.disable_static()
+        lin2 = nn.Linear(3, 2, bias_attr=False)
+        lin2.weight.set_value(W0)
+        opt2 = optimizer.Adam(learning_rate=0.01,
+                              parameters=lin2.parameters())
+        out = lin2(paddle.to_tensor(xs))
+        loss2 = paddle.mean((out - paddle.to_tensor(ys)) ** 2)
+        loss2.backward()
+        opt2.step()
+        paddle.enable_static()
+
+        assert float(lv) == pytest.approx(float(loss2.numpy()), abs=1e-5)
+        np.testing.assert_allclose(W_static, np.asarray(lin2.weight._value),
+                                   atol=1e-5)
+
+    def test_startup_reinitializes(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 2], "float32")
+            pred = static.nn.fc(x, 1)
+            loss = paddle.mean(pred ** 2)
+            optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        p = main._params[0]
+        w_init = np.asarray(p._value).copy()
+        xs = np.random.default_rng(0).normal(size=(4, 2)).astype("float32")
+        exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        assert not np.allclose(np.asarray(p._value), w_init)
+        exe.run(startup)  # restore
+        np.testing.assert_allclose(np.asarray(p._value), w_init)
+
+
+class TestGradients:
+    def test_append_backward_numeric(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 3], "float32")
+            h = static.nn.fc(x, 2)
+            loss = paddle.sum(h * h)
+            pg = static.append_backward(loss)
+        exe = static.Executor()
+        xs = np.random.default_rng(1).normal(size=(8, 3)).astype("float32")
+        (p, gvar) = pg[0]
+        _, gv = exe.run(main, feed={"x": xs}, fetch_list=[loss, gvar])
+        W = np.asarray(p._value)
+        b = np.asarray(main._params[1]._value)
+        ref = 2 * xs.T @ (xs @ W + b)
+        np.testing.assert_allclose(gv, ref, atol=1e-4)
+
+    def test_gradients_wrt_data(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 3], "float32")
+            h = static.nn.fc(x, 2)
+            loss = paddle.sum(h * h)
+            gx, = static.gradients(loss, [x])
+        exe = static.Executor()
+        xs = np.random.default_rng(1).normal(size=(8, 3)).astype("float32")
+        gxv, = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+        W = np.asarray(main._params[0]._value)
+        b = np.asarray(main._params[1]._value)
+        np.testing.assert_allclose(gxv, 2 * (xs @ W + b) @ W.T, atol=1e-4)
+
+
+class TestInferenceIO:
+    def test_save_load_inference_model(self, static_mode, tmp_path):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            h = static.nn.fc(x, 2)
+        exe = static.Executor()
+        path = os.path.join(str(tmp_path), "m")
+        static.save_inference_model(path, [x], [h], exe, program=main)
+        assert os.path.exists(path + ".pdmodel")
+        assert os.path.exists(path + ".pdexport")
+        layer, feeds, fetches = static.load_inference_model(path, exe)
+        assert feeds == ["x"]
+        xs = np.random.default_rng(0).normal(size=(4, 3)).astype("float32")
+        out = layer(xs)
+        out0 = out[0] if isinstance(out, (list, tuple)) else out
+        W = np.asarray(main._params[0]._value)
+        b = np.asarray(main._params[1]._value)
+        np.testing.assert_allclose(np.asarray(out0.numpy()), xs @ W + b,
+                                   atol=1e-5)
+
+
+class TestStaticNN:
+    def test_conv_bn_pipeline(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            im = static.data("im", [-1, 3, 8, 8], "float32")
+            c = static.nn.conv2d(im, 4, 3, padding=1, act="relu")
+            b = static.nn.batch_norm(c)
+            pooled = paddle.mean(b)
+        exe = static.Executor()
+        exe.run(startup)
+        ims = np.random.default_rng(2).normal(
+            size=(2, 3, 8, 8)).astype("float32")
+        pv, = exe.run(main, feed={"im": ims}, fetch_list=[pooled])
+        assert np.isfinite(pv).all()
+
+    def test_embedding(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            ids = static.data("ids", [-1, 5], "int64")
+            emb = static.nn.embedding(ids, size=[10, 4])
+        exe = static.Executor()
+        idv = np.array([[1, 2, 3, 4, 5]], dtype=np.int64)
+        ev, = exe.run(main, feed={"ids": idv}, fetch_list=[emb])
+        table = np.asarray(main._params[0]._value)
+        np.testing.assert_allclose(ev[0], table[idv[0]], atol=1e-6)
+
+
+class TestModeSwitch:
+    def test_mode_flags(self):
+        assert paddle.in_dynamic_mode()
+        paddle.enable_static()
+        try:
+            assert not paddle.in_dynamic_mode()
+        finally:
+            paddle.disable_static()
+        assert paddle.in_dynamic_mode()
