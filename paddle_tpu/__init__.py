@@ -65,10 +65,90 @@ from .ops.control_flow import case, cond, scan, switch_case, while_loop  # noqa:
 from .autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .nn.initializer import ParamAttr  # noqa: F401
 
+from .core.place import (  # noqa: F401
+    CUDAPinnedPlace, CUDAPlace, NPUPlace, XPUPlace,
+)
+from .distributed.parallel import DataParallel  # noqa: F401
+from .ops.manipulation import slice_ as slice  # noqa: F401,A001
+from .hapi.model import flops  # noqa: F401
+from .core.generator import (  # noqa: F401
+    get_rng_state as get_cuda_rng_state,
+    set_rng_state as set_cuda_rng_state,
+)
+
+# dtype aliases completing the public dtype namespace
+import builtins
+import numpy as _np
+bool = bool_  # noqa: A001
+dtype = _np.dtype
+
 __version__ = "0.1.0"
 
 
-def is_grad_enabled() -> bool:
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """~ paddle.set_printoptions — numpy repr drives Tensor printing here."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+class set_grad_enabled:
+    """~ paddle.set_grad_enabled — context manager / immediate switch."""
+
+    def __init__(self, mode: builtins.bool):
+        from .autograd import tape as _t
+        self._prev = _t._set_grad_enabled(builtins.bool(mode))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        from .autograd import tape as _t
+        _t._set_grad_enabled(self._prev)
+        return False
+
+
+def disable_signal_handler():
+    """~ paddle.disable_signal_handler — the reference unhooks its C++ signal
+    handlers; this runtime installs none, so this is a checked no-op."""
+    return None
+
+
+def check_shape(shape):
+    """Validate a shape argument (list/tuple of ints, -1 allowed once)."""
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = list(shape)
+    if sum(1 for s in shape if int(s) == -1) > 1:
+        raise ValueError(f"shape may contain at most one -1, got {shape}")
+    return shape
+
+
+def batch(reader, batch_size, drop_last=False):
+    """~ paddle.batch (python/paddle/batch.py) — legacy reader batching."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def is_grad_enabled() -> builtins.bool:
     from .autograd.tape import grad_enabled
     return grad_enabled()
 

@@ -53,6 +53,25 @@ class TPUPlace(Place):
         super().__init__("tpu", device_id)
 
 
+class CUDAPlace(TPUPlace):
+    """Accelerator-place API-compat alias (~ paddle.CUDAPlace): on this
+    framework the accelerator is the TPU, so CUDAPlace(i) denotes device i
+    of the default accelerator platform."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """~ paddle.CUDAPinnedPlace — host memory; jax manages pinned staging
+    buffers itself, so this is the CPU place."""
+
+
+class NPUPlace(TPUPlace):
+    """~ paddle.NPUPlace API-compat alias (custom accelerator slot)."""
+
+
+class XPUPlace(TPUPlace):
+    """~ paddle.XPUPlace API-compat alias."""
+
+
 @functools.lru_cache(maxsize=None)
 def _devices_of_type(kind: str):
     all_devs = jax.devices()

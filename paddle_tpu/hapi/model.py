@@ -249,3 +249,66 @@ def summary_layer(network: Layer):
 
 def summary(net, input_size=None, dtypes=None):
     return summary_layer(net)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """~ paddle.flops (python/paddle/hapi/dynamic_flops.py).
+
+    Forward-hook FLOPs counter: runs one forward pass on zeros of
+    ``input_size`` capturing per-layer in/out shapes, then applies the
+    standard per-layer-type cost formulas (multiply-adds counted as 2 ops
+    halved, matching the reference's convention of counting MACs).
+    """
+    import numpy as np
+    from ..core.tensor import Tensor
+    from ..nn import layer as _nl
+    from ..autograd import no_grad
+
+    counts = {}
+    handles = []
+
+    def make_hook(name, lyr):
+        def hook(layer, inputs, outputs):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            f = 0
+            tname = type(layer).__name__
+            if custom_ops and type(layer) in custom_ops:
+                f = custom_ops[type(layer)](layer, x, out)
+            elif hasattr(layer, "weight") and layer.weight is not None:
+                w = layer.weight
+                if "Conv" in tname:
+                    out_elems = int(np.prod(out.shape))
+                    kernel_ops = int(np.prod(w.shape[1:]))
+                    f = out_elems * kernel_ops
+                elif "Linear" in tname:
+                    batch = int(np.prod(x.shape[:-1]))
+                    f = batch * int(np.prod(w.shape))
+                elif "Norm" in tname:
+                    f = int(np.prod(x.shape)) * 2
+                elif "Embedding" in tname:
+                    f = 0
+            elif "Pool" in tname:
+                f = int(np.prod(out.shape))
+            if f:
+                counts[name] = counts.get(name, 0) + f
+        return hook
+
+    for name, lyr in net.named_sublayers(include_self=True):
+        handles.append(lyr.register_forward_post_hook(make_hook(name or "net", lyr)))
+    try:
+        x = Tensor(np.zeros(tuple(input_size), dtype="float32"))
+        was_training = net.training
+        net.eval()
+        with no_grad():
+            net(x)
+        net.training = was_training
+    finally:
+        for h in handles:
+            h.remove()
+    total = sum(counts.values())
+    if print_detail:
+        for k, v in counts.items():
+            print(f"{k:40s} {v:15,d}")
+        print(f"Total FLOPs: {total:,}")
+    return total

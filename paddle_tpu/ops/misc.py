@@ -1,0 +1,301 @@
+"""Remaining top-level paddle.* namespace ops.
+
+~ scattered reference sources: python/paddle/tensor/manipulation.py (cast,
+crop, reverse, unique_consecutive, tolist), math.py (add_n, increment, logit,
+dist, nanquantile, tensordot, broadcast_shape), attribute.py (shape, rank,
+is_complex/is_floating_point/is_integer), creation.py (complex,
+create_parameter), random.py (poisson, standard_normal, randint_like), and
+logic.py (is_empty, is_tensor). These round out the public `paddle.`
+namespace to parity; all lower to single jnp calls XLA fuses freely.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..core import dtype as dtypes
+from ..core.generator import default_generator
+from .dispatch import def_op
+
+
+@def_op("cast")
+def cast(x, dtype):
+    return x.astype(dtypes.convert_dtype(dtype))
+
+
+def add_n(inputs):
+    """~ paddle.add_n — sum of a tensor list; tape-recorded via `+`."""
+    if isinstance(inputs, (list, tuple)):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = out + t
+        return out
+    return inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+
+
+@def_op("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@def_op("dist")
+def dist(x, y, p=2):
+    d = jnp.abs(x - y)
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+@def_op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+@def_op("tensordot")
+def tensordot(x, y, axes=2):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a.tolist() if isinstance(a, Tensor) else a)
+                     if isinstance(a, (list, tuple, Tensor)) else a
+                     for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@def_op("crop")
+def crop(x, shape=None, offsets=None):
+    ndim = x.ndim
+    if shape is None:
+        shape = list(x.shape)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+    shape = [x.shape[i] if shape[i] in (-1, 0) else shape[i]
+             for i in range(ndim)]
+    if offsets is None:
+        offsets = [0] * ndim
+    if isinstance(offsets, Tensor):
+        offsets = offsets.tolist()
+    offsets = [int(o._value) if isinstance(o, Tensor) else int(o)
+               for o in offsets]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@def_op("reverse")
+def reverse(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@def_op("complex")
+def complex(real, imag):  # noqa: A001 - mirrors paddle.complex
+    return jax.lax.complex(real, imag)
+
+
+@def_op("floor_mod")
+def floor_mod(x, y):
+    return jnp.mod(x, y)
+
+
+# ---- predicates / attributes (non-traced, host-side) -----------------------
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def is_complex(x) -> bool:
+    return bool(jnp.issubdtype(_dt(x), jnp.complexfloating))
+
+
+def is_floating_point(x) -> bool:
+    return bool(jnp.issubdtype(_dt(x), jnp.floating))
+
+
+def is_integer(x) -> bool:
+    return bool(jnp.issubdtype(_dt(x), jnp.integer))
+
+
+def _dt(x):
+    return x._value.dtype if isinstance(x, Tensor) else jnp.asarray(x).dtype
+
+
+def shape(x):
+    """~ paddle.shape: runtime shape as an int32 tensor."""
+    return Tensor(jnp.asarray(x._value.shape if isinstance(x, Tensor)
+                              else np.shape(x), dtype=jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim if isinstance(x, Tensor)
+                              else np.ndim(x), dtype=jnp.int32))
+
+
+def numel(x):
+    n = int(np.prod(x.shape)) if x.shape else 1
+    return Tensor(jnp.asarray(n, dtype=jnp.int64
+                              if jax.config.jax_enable_x64 else jnp.int32))
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else np.asarray(x).tolist()
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0):
+    """~ paddle.increment — in-place add on a 1-element tensor."""
+    x._value = x._value + jnp.asarray(value, dtype=x._value.dtype)
+    return x
+
+
+# ---- random ---------------------------------------------------------------
+
+def poisson(x):
+    key = default_generator().next_key()
+    lam = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(key, lam).astype(lam.dtype))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    from .creation import randn
+    return randn(shape, dtype=dtype)
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    from .creation import randint
+    target = dtypes.convert_dtype(dtype if dtype is not None else x.dtype)
+    if jnp.issubdtype(target, jnp.integer):
+        return randint(low, high, shape=x.shape, dtype=target)
+    out = randint(low, high, shape=x.shape, dtype="int32")
+    return Tensor(out._value.astype(target))
+
+
+# ---- misc host-side utilities --------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     default_initializer=None):
+    """~ paddle.create_parameter (python/paddle/tensor/creation.py)."""
+    shape = [int(s) for s in shape]
+    jdt = dtypes.convert_dtype(dtype)
+    if default_initializer is not None:
+        p = Parameter(jnp.zeros(shape, jdt))
+        default_initializer(p)
+        return p
+    if jnp.issubdtype(jdt, jnp.floating):
+        fan_in = shape[0] if shape else 1
+        limit = float(np.sqrt(6.0 / max(1, fan_in)))
+        val = jax.random.uniform(default_generator().next_key(), shape,
+                                 jdt, -limit, limit)
+    else:
+        val = jnp.zeros(shape, jdt)
+    p = Parameter(val)
+    if name:
+        p.name = name
+    return p
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64"):
+    """~ paddle.unique_consecutive — data-dependent output size, so this is
+    an eager/host op (the reference's GPU kernel is likewise sync)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is None:
+        flat = arr.reshape(-1)
+        if flat.size == 0:
+            outs = [Tensor(jnp.asarray(flat))]
+            if return_inverse:
+                outs.append(Tensor(jnp.asarray(np.zeros(0, np.int32))))
+            if return_counts:
+                outs.append(Tensor(jnp.asarray(np.zeros(0, np.int32))))
+            return outs[0] if len(outs) == 1 else tuple(outs)
+        change = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[change]
+        inv = np.cumsum(change) - 1
+        counts = np.diff(np.concatenate(
+            [np.nonzero(change)[0], [flat.size]]))
+    else:
+        moved = np.moveaxis(arr, axis, 0)
+        if moved.shape[0] == 0:
+            change = np.zeros(0, bool)
+        else:
+            flat2 = moved.reshape(moved.shape[0], -1)
+            change = np.concatenate(
+                [[True], np.any(flat2[1:] != flat2[:-1], axis=1)])
+        out = np.moveaxis(moved[change], 0, axis)
+        inv = np.cumsum(change) - 1
+        counts = np.diff(np.concatenate(
+            [np.nonzero(change)[0], [moved.shape[0]]]))
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int32))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# ---- in-place variants ----------------------------------------------------
+
+def _inplace(fn):
+    def wrapper(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        return x
+    wrapper.__name__ = fn.__name__ + "_"
+    return wrapper
+
+
+def _install_inplace():
+    from . import manipulation, activation, math
+    mapping = {}
+    mapping["reshape_"] = _inplace(manipulation.reshape)
+    mapping["squeeze_"] = _inplace(manipulation.squeeze)
+    mapping["unsqueeze_"] = _inplace(manipulation.unsqueeze)
+    mapping["flatten_"] = _inplace(manipulation.flatten)
+    mapping["scatter_"] = _inplace(manipulation.scatter)
+    mapping["tanh_"] = _inplace(math.tanh)
+    mapping["exp_"] = _inplace(math.exp)
+    mapping["sqrt_"] = _inplace(math.sqrt)
+    mapping["rsqrt_"] = _inplace(math.rsqrt)
+    mapping["clip_"] = _inplace(math.clip)
+    mapping["ceil_"] = _inplace(math.ceil)
+    mapping["floor_"] = _inplace(math.floor)
+    mapping["round_"] = _inplace(math.round)
+    mapping["reciprocal_"] = _inplace(math.reciprocal)
+    mapping["subtract_"] = _inplace(math.subtract)
+    mapping["add_"] = _inplace(math.add)
+    mapping["scale_"] = _inplace(math.scale)
+    mapping["zero_"] = _inplace(lambda x: Tensor(jnp.zeros_like(x._value)))
+    mapping["fill_"] = _inplace(
+        lambda x, v: Tensor(jnp.full_like(x._value, v)))
+    for name, fn in mapping.items():
+        globals()[name] = fn
+        setattr(Tensor, name, fn)
+    return list(mapping)
+
+
+_INPLACE_NAMES = _install_inplace()
+
+__all__ = [
+    "cast", "add_n", "logit", "dist", "nanquantile", "tensordot", "crop",
+    "reverse", "complex", "floor_mod", "is_tensor", "is_complex",
+    "is_floating_point", "is_integer", "shape", "rank", "numel", "is_empty",
+    "tolist", "broadcast_shape", "increment", "poisson", "standard_normal",
+    "randint_like", "create_parameter", "unique_consecutive",
+] + _INPLACE_NAMES

@@ -1,0 +1,95 @@
+"""API parity audit: reference python/paddle __all__ lists vs paddle_tpu exports.
+
+Parses the reference source with ast (it is not importable — C++ core), and
+imports paddle_tpu for real. Prints missing names per namespace.
+"""
+from __future__ import annotations
+
+import ast
+import importlib
+import sys
+from pathlib import Path
+
+REF = Path("/root/reference/python/paddle")
+
+# namespace -> (reference file(s) carrying __all__, our module path)
+NAMESPACES = {
+    "paddle (tensor methods/ops)": (["__init__.py"], "paddle_tpu"),
+    "paddle.nn": (["nn/__init__.py"], "paddle_tpu.nn"),
+    "paddle.nn.functional": (["nn/functional/__init__.py"], "paddle_tpu.nn.functional"),
+    "paddle.nn.initializer": (["nn/initializer/__init__.py"], "paddle_tpu.nn.initializer"),
+    "paddle.linalg": (["linalg.py"], "paddle_tpu.linalg"),
+    "paddle.fft": (["fft.py"], "paddle_tpu.fft"),
+    "paddle.signal": (["signal.py"], "paddle_tpu.signal"),
+    "paddle.optimizer": (["optimizer/__init__.py"], "paddle_tpu.optimizer"),
+    "paddle.optimizer.lr": (["optimizer/lr.py"], "paddle_tpu.optimizer.lr"),
+    "paddle.metric": (["metric/__init__.py"], "paddle_tpu.metric"),
+    "paddle.distribution": (["distribution/__init__.py"], "paddle_tpu.distribution"),
+    "paddle.distributed": (["distributed/__init__.py"], "paddle_tpu.distributed"),
+    "paddle.vision.ops": (["vision/ops.py"], "paddle_tpu.vision.ops"),
+    "paddle.vision.transforms": (["vision/transforms/__init__.py"], "paddle_tpu.vision.transforms"),
+    "paddle.io": (["io/__init__.py"], "paddle_tpu.io"),
+    "paddle.amp": (["amp/__init__.py"], "paddle_tpu.amp"),
+    "paddle.jit": (["jit/__init__.py"], "paddle_tpu.jit"),
+    "paddle.static": (["static/__init__.py"], "paddle_tpu.static"),
+    "paddle.static.nn": (["static/nn/__init__.py"], "paddle_tpu.static.nn"),
+    "paddle.sparse": (["sparse/__init__.py"], "paddle_tpu.sparse"),
+    "paddle.text": (["text/__init__.py"], "paddle_tpu.text"),
+    "paddle.utils": (["utils/__init__.py"], "paddle_tpu.utils"),
+}
+
+
+def ref_all(rel_paths):
+    names = []
+    for rel in rel_paths:
+        p = REF / rel
+        if not p.exists():
+            continue
+        tree = ast.parse(p.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        try:
+                            names += [e for e in ast.literal_eval(node.value)]
+                        except Exception:
+                            pass
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name) and node.target.id == "__all__":
+                    try:
+                        names += [e for e in ast.literal_eval(node.value)]
+                    except Exception:
+                        pass
+    return sorted(set(names))
+
+
+def main():
+    sys.path.insert(0, "/root/repo")
+    total_missing = 0
+    report = []
+    for ns, (rels, ours_path) in NAMESPACES.items():
+        ref_names = ref_all(rels)
+        if not ref_names:
+            report.append((ns, None, None, "NO __all__ FOUND"))
+            continue
+        try:
+            ours = importlib.import_module(ours_path)
+        except Exception as e:
+            report.append((ns, len(ref_names), None, f"IMPORT FAIL: {e}"))
+            continue
+        missing = [n for n in ref_names if not hasattr(ours, n)]
+        total_missing += len(missing)
+        report.append((ns, len(ref_names), missing, None))
+    for ns, nref, missing, err in report:
+        if err:
+            print(f"== {ns}: {err}")
+            continue
+        print(f"== {ns}: {nref - len(missing)}/{nref} present, {len(missing)} missing")
+        if missing:
+            for i in range(0, len(missing), 8):
+                print("   " + ", ".join(missing[i:i + 8]))
+    print(f"\nTOTAL MISSING: {total_missing}")
+
+
+if __name__ == "__main__":
+    main()
