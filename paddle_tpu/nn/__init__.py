@@ -6,13 +6,14 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .initializer import ParamAttr  # noqa: F401
 from .layer.layers import (  # noqa: F401
-    Layer, LayerList, ParameterList, Sequential,
+    Layer, LayerDict, LayerList, ParameterList, Sequential,
 )
 from .layer.common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
     Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
     Pad2D, Pad3D, PixelShuffle, PixelUnshuffle, Unfold, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
+    PairwiseDistance, Softmax2D,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
@@ -25,7 +26,8 @@ from .layer.norm import (  # noqa: F401
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
-    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
@@ -36,15 +38,16 @@ from .layer.activation import (  # noqa: F401
 from .layer.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
     CTCLoss, HuberLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
-    NLLLoss, SmoothL1Loss, TripletMarginLoss,
+    NLLLoss, SmoothL1Loss, TripletMarginLoss, HingeEmbeddingLoss,
+    HSigmoidLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
 from .layer.rnn import (  # noqa: F401
-    BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
-    SimpleRNNCell,
+    BeamSearchDecoder, BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase,
+    SimpleRNN, SimpleRNNCell, dynamic_decode,
 )
 
 

@@ -35,3 +35,32 @@ from .pooling import (  # noqa: F401
     avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
+from .pooling import (  # noqa: F401
+    max_unpool1d, max_unpool2d, max_unpool3d,
+)
+from .loss import (  # noqa: F401
+    dice_loss, hsigmoid_loss, margin_cross_entropy, npair_loss,
+    sigmoid_focal_loss,
+)
+from .common import (  # noqa: F401
+    class_center_sample, diag_embed, gather_tree, one_hot, zeropad2d,
+)
+from .vision import (  # noqa: F401
+    affine_grid, grid_sample, temporal_shift,
+)
+from .attention import sparse_attention  # noqa: F401
+
+
+def _make_inplace_act(fn):
+    def wrapper(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        return x
+    wrapper.__name__ = fn.__name__ + "_"
+    return wrapper
+
+
+relu_ = _make_inplace_act(relu)
+elu_ = _make_inplace_act(elu)
+tanh_ = _make_inplace_act(tanh)
+softmax_ = _make_inplace_act(softmax)

@@ -62,3 +62,39 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
         return jnp.swapaxes(out, 1, 2)
     return apply_op("scaled_dot_product_attention", fn, *args)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """~ paddle.nn.functional.sparse_attention
+    (operators/sparse_attention_op.cu, block-sparse SDD attention).
+
+    TPU lowering: the CSR pattern (offset/columns per head) is expanded to an
+    attention mask with static-nnz scatter (searchsorted over the offset
+    vector gives each nonzero's row), then one fused masked softmax-matmul —
+    XLA tiles it on the MXU; true block-sparsity on TPU comes from the
+    Pallas splash kernel (ops/pallas/flash_attention.py) which skips masked
+    blocks."""
+    import numpy as np
+
+    def fn(q, k, v, off, cols):
+        B, H, L, D = q.shape
+        nnz = cols.shape[-1]
+        scale = 1.0 / np.sqrt(D)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+        pos = jnp.arange(nnz)
+        # rows[i] = which row the i-th nonzero belongs to (CSR expansion)
+        def expand(off_h, cols_h):
+            rows = jnp.searchsorted(off_h, pos, side="right") - 1
+            m = jnp.zeros((L, L), dtype=bool).at[rows, cols_h].set(True)
+            return m
+        mask = jax.vmap(jax.vmap(expand))(
+            jnp.broadcast_to(off, (B, H) + off.shape[-1:]),
+            jnp.broadcast_to(cols, (B, H, nnz)))
+        scores = jnp.where(mask, scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(mask, probs, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return apply_op("sparse_attention", fn, query, key, value,
+                    sparse_csr_offset, sparse_csr_columns)

@@ -279,3 +279,90 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
         mask = jnp.arange(m)[None, :] < lv.reshape(-1, 1)
         return mask.astype(jnp.dtype(dtype)).reshape(lv.shape + (m,))
     return apply_op("sequence_mask", fn, lengths, nondiff=True)
+
+
+def one_hot(x, num_classes, name=None):
+    """~ paddle.nn.functional.one_hot (phi one_hot kernel)."""
+    return apply_op("one_hot",
+                    lambda v: jax.nn.one_hot(v, num_classes,
+                                             dtype=jnp.float32), x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """~ paddle.nn.functional.diag_embed: batch of diagonal matrices from the
+    last dim of ``input`` placed at (dim1, dim2) of the output."""
+    def fn(v):
+        n = v.shape[-1]
+        size = n + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (size, size), dtype=v.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        # diagonal currently occupies the last two axes; move them into place
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+    return apply_op("diag_embed", fn, input)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """~ paddle.nn.functional.zeropad2d — pad = [left, right, top, bottom]."""
+    l, r, t, b = [int(p) for p in padding]
+
+    def fn(v):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(v, cfg)
+    return apply_op("zeropad2d", fn, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """~ paddle.nn.functional.class_center_sample
+    (operators/class_center_sample_op.cu): sample the positive class centers
+    plus random negatives; returns (remapped_label, sampled_class_index).
+    Data-dependent output order -> host-side op (the reference's kernel also
+    materializes the unique set)."""
+    from ...core.generator import default_generator
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    n_extra = max(0, num_samples - pos.size)
+    if n_extra > 0:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        key = default_generator().next_key()
+        perm = np.asarray(jax.random.permutation(key, rest.size))
+        sampled = np.concatenate([pos, rest[perm[:n_extra]]])
+    else:
+        sampled = pos
+    remap = -np.ones(num_classes, dtype=lab.dtype)
+    remap[sampled] = np.arange(sampled.size)
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled.astype(np.int64 if lab.dtype.kind == "i"
+                                              else lab.dtype))))
+
+
+def gather_tree(ids, parents):
+    """~ paddle.nn.functional.gather_tree (phi gather_tree kernel): walk
+    beam-search parent pointers backwards to assemble full predicted
+    sequences. ids/parents: (max_time, batch, beam)."""
+    def fn(idv, parv):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry  # (batch, beam) current beam index per slot
+            out_t = jnp.take_along_axis(idv[t], beams, axis=1)
+            par_t = jnp.take_along_axis(parv[t], beams, axis=1)
+            return par_t, out_t
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2], dtype=idv.dtype),
+                                idv.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+    return apply_op("gather_tree", fn, ids, parents, nondiff=True)

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.tensor import Tensor
 from ...ops.dispatch import apply_op
@@ -230,3 +231,126 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             loss = loss / jnp.maximum(ilen.astype(loss.dtype), 1.0)
         return _reduce(loss, reduction)
     return apply_op("ctc_loss", fn, log_probs, labels)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """~ paddle.nn.functional.dice_loss (python/paddle/nn/functional/loss.py):
+    1 - 2|X∩Y| / (|X|+|Y|) over the flattened per-sample maps; label is
+    integer class ids one-hotted against the channel dim."""
+    def fn(x, lab):
+        nclass = x.shape[-1]
+        lab = jax.nn.one_hot(jnp.squeeze(lab, -1), nclass, dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = 2.0 * jnp.sum(x * lab, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+        return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", fn, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """~ paddle.nn.functional.npair_loss — improved N-pair metric loss."""
+    def fn(a, p, lab):
+        lab = jnp.reshape(lab.astype(a.dtype), (-1, 1))
+        same = (lab == lab.T).astype(a.dtype)
+        target = same / jnp.sum(same, axis=1, keepdims=True)
+        logits = a @ p.T
+        ce = jnp.mean(
+            jnp.sum(-target * jax.nn.log_softmax(logits, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+    return apply_op("npair_loss", fn, anchor, positive, labels)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    """~ paddle.nn.functional.sigmoid_focal_loss (RetinaNet focal loss)."""
+    def fn(x, y, *rest):
+        y = y.astype(x.dtype)
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            loss = loss * (alpha * y + (1 - alpha) * (1 - y))
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply_op("sigmoid_focal_loss", fn, *args)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """~ paddle.nn.functional.hsigmoid_loss (phi hsigmoid_loss kernel).
+
+    Default complete-binary-tree hierarchy: class c's path is the binary
+    expansion of c + num_classes (the leaf's heap index); inner nodes are
+    rows of ``weight``. Custom trees come in via path_table/path_code."""
+    def fn(x, lab, w, *rest):
+        b = rest[0] if bias is not None else None
+        depth = max(1, int(np.ceil(np.log2(max(2, num_classes)))))
+        lab = lab.reshape(-1)
+        if path_table is not None:
+            pt = path_table._value if hasattr(path_table, "_value") \
+                else jnp.asarray(path_table)
+            pc = path_code._value if hasattr(path_code, "_value") \
+                else jnp.asarray(path_code)
+            nodes = pt[lab]
+            codes = pc[lab].astype(x.dtype)
+            valid = (nodes >= 0).astype(x.dtype)
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            heap = lab + num_classes
+            levels = []
+            codes_l = []
+            h = heap
+            for _ in range(depth):
+                codes_l.append((h % 2).astype(x.dtype))
+                h = h // 2
+                levels.append(h)
+            nodes = jnp.stack(levels[::-1], axis=1) - 1  # inner nodes, 0-based
+            codes = jnp.stack(codes_l[::-1], axis=1)
+            valid = (nodes >= 0) & (nodes < w.shape[0])
+            valid = valid.astype(x.dtype)
+            nodes = jnp.clip(nodes, 0, w.shape[0] - 1)
+        wsel = w[nodes]                      # (B, D, feat)
+        logits = jnp.einsum("bdf,bf->bd", wsel, x)
+        if b is not None:
+            logits = logits + b.reshape(-1)[nodes]
+        # code 1 -> right branch: loss = softplus(-sign*logit), sign=+1 left
+        sign = 1.0 - 2.0 * codes
+        z = sign * logits
+        loss = jnp.maximum(-z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        loss = jnp.sum(loss * valid, axis=1, keepdims=True)
+        return loss
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return apply_op("hsigmoid_loss", fn, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """~ paddle.nn.functional.margin_cross_entropy
+    (operators/margin_cross_entropy_op.cu): ArcFace-family margin softmax
+    cos(m1*theta + m2) - m3 applied to the target logit. The reference's
+    model-parallel class split (group) maps to a sharded class dim under
+    pjit; single-group math here."""
+    def fn(x, lab):
+        lab = lab.reshape(-1)
+        theta = jnp.arccos(jnp.clip(x, -1.0, 1.0))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lab, x.shape[-1], dtype=x.dtype)
+        adj = jnp.where(onehot > 0, tgt, x) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        if reduction == "mean":
+            loss_r = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_r = jnp.sum(loss)
+        else:
+            loss_r = loss
+        if return_softmax:
+            return loss_r, jax.nn.softmax(adj, axis=-1)
+        return loss_r
+    return apply_op("margin_cross_entropy", fn, logits, label)

@@ -58,25 +58,133 @@ def _pool_nd(x, kind, kernel_size, stride, padding, n, data_format,
     return s / float(np.prod(ks))
 
 
+def _max_pool_indices(x, ks, st, pad, n):
+    """Argmax indices into the flattened input spatial map (paddle's
+    return_mask contract: int32 index into prod(spatial) per window), NC*
+    layout. Built from conv_general_dilated_patches so stride/padding follow
+    the exact same windowing as the pooling reduce_window."""
+    spatial = x.shape[2:]
+    # patches of the *linear index* grid, window-extracted like the values
+    lin = jnp.arange(int(np.prod(spatial)), dtype=jnp.float32).reshape(
+        (1, 1) + spatial)
+    lin = jnp.broadcast_to(lin, (x.shape[0], 1) + spatial)
+    if isinstance(pad, str):
+        padding = pad
+    else:
+        padding = pad
+    xp = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=st, padding=padding)
+    lp = jax.lax.conv_general_dilated_patches(
+        lin, filter_shape=ks, window_strides=st,
+        padding=padding, precision=None)
+    # xp: (N, C*prod(ks), *out_spatial); reshape to (N, C, prod(ks), ...)
+    out_spatial = xp.shape[2:]
+    k = int(np.prod(ks))
+    xp = xp.reshape(x.shape[0], x.shape[1], k, *out_spatial)
+    lp = lp.reshape(x.shape[0], 1, k, *out_spatial)
+    arg = jnp.argmax(xp, axis=2)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(lp, (x.shape[0], x.shape[1], k) + out_spatial),
+        arg[:, :, None], axis=2)[:, :, 0]
+    return idx.astype(jnp.int32)
+
+
+def _max_pool_nd(x, kernel_size, stride, padding, n, data_format, ceil_mode,
+                 return_mask):
+    out = _pool_nd(x, "max", kernel_size, stride, padding, n, data_format,
+                   ceil_mode)
+    if not return_mask:
+        return out
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    v = jnp.moveaxis(x, -1, 1) if channel_last else x
+    ks = _tuplize(kernel_size, n)
+    st = _tuplize(stride if stride is not None else kernel_size, n)
+    pad = padding.upper() if isinstance(padding, str) else \
+        [(pi, pi) for pi in _tuplize(padding, n)]
+    idx = _max_pool_indices(v, ks, st, pad, n)
+    if channel_last:
+        idx = jnp.moveaxis(idx, 1, -1)
+    return out, idx
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCL"):
     return apply_op("max_pool1d",
-                    lambda v: _pool_nd(v, "max", kernel_size, stride, padding,
-                                       1, data_format, ceil_mode), x)
+                    lambda v: _max_pool_nd(v, kernel_size, stride, padding,
+                                           1, data_format, ceil_mode,
+                                           return_mask), x)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW"):
     return apply_op("max_pool2d",
-                    lambda v: _pool_nd(v, "max", kernel_size, stride, padding,
-                                       2, data_format, ceil_mode), x)
+                    lambda v: _max_pool_nd(v, kernel_size, stride, padding,
+                                           2, data_format, ceil_mode,
+                                           return_mask), x)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
     return apply_op("max_pool3d",
-                    lambda v: _pool_nd(v, "max", kernel_size, stride, padding,
-                                       3, data_format, ceil_mode), x)
+                    lambda v: _max_pool_nd(v, kernel_size, stride, padding,
+                                           3, data_format, ceil_mode,
+                                           return_mask), x)
+
+
+def _max_unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                   n, data_format):
+    """Scatter pooled values back to their argmax positions.
+
+    ~ phi max_unpool kernels (paddle/phi/kernels/unpool_kernel.h): indices
+    address the flattened spatial block of the *output* map."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    v = jnp.moveaxis(x, -1, 1) if channel_last else x
+    idx = jnp.moveaxis(indices, -1, 1) if channel_last else indices
+    ks = _tuplize(kernel_size, n)
+    st = _tuplize(stride if stride is not None else kernel_size, n)
+    p = _tuplize(padding, n)
+    in_spatial = v.shape[2:]
+    if output_size is None:
+        out_spatial = tuple(
+            (in_spatial[i] - 1) * st[i] - 2 * p[i] + ks[i] for i in range(n))
+    else:
+        out_spatial = tuple(int(s) for s in output_size[-n:])
+    N, C = v.shape[0], v.shape[1]
+    flat_len = int(np.prod(out_spatial))
+    vals = v.reshape(N, C, -1)
+    flat_idx = idx.reshape(N, C, -1)
+    out = jnp.zeros((N, C, flat_len), dtype=v.dtype)
+    n_idx = jnp.arange(N)[:, None, None]
+    c_idx = jnp.arange(C)[None, :, None]
+    out = out.at[n_idx, c_idx, flat_idx].set(vals)
+    out = out.reshape((N, C) + out_spatial)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return apply_op("max_unpool1d",
+                    lambda v, i: _max_unpool_nd(v, i, kernel_size, stride,
+                                                padding, output_size, 1,
+                                                data_format), x, indices)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return apply_op("max_unpool2d",
+                    lambda v, i: _max_unpool_nd(v, i, kernel_size, stride,
+                                                padding, output_size, 2,
+                                                data_format), x, indices)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return apply_op("max_unpool3d",
+                    lambda v, i: _max_unpool_nd(v, i, kernel_size, stride,
+                                                padding, output_size, 3,
+                                                data_format), x, indices)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

@@ -1,0 +1,142 @@
+"""Vision-oriented functional ops.
+
+~ python/paddle/nn/functional/vision.py (affine_grid, grid_sample,
+pixel_shuffle) + extension.py (temporal_shift) over phi affine_grid /
+grid_sample kernels. Gather-heavy ops that XLA lowers to fused dynamic
+gathers; all shapes static so they tile cleanly on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+
+def _affine_grid(theta, out_shape, align_corners):
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+    # theta: (N, 2, 3); grid = base @ theta^T -> (N, H, W, 2)
+    return jnp.einsum("hwk,njk->nhwj", base, theta.astype(jnp.float32)) \
+        .astype(theta.dtype)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """~ paddle.nn.functional.affine_grid."""
+    if hasattr(out_shape, "tolist"):
+        out_shape = out_shape.tolist()
+    return apply_op("affine_grid",
+                    lambda t: _affine_grid(t, out_shape, align_corners),
+                    theta)
+
+
+def _reflect(x, lo, hi):
+    # reflect coordinates into [lo, hi] (inclusive range semantics)
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    dbl = 2 * rng
+    x = jnp.mod(jnp.abs(x - lo), dbl)
+    return lo + jnp.where(x > rng, dbl - x, x)
+
+
+def _grid_sample(x, grid, mode, padding_mode, align_corners):
+    # x: (N, C, H, W); grid: (N, Ho, Wo, 2) in [-1, 1] (x, y) order
+    N, C, H, W = x.shape
+    gx = grid[..., 0].astype(jnp.float32)
+    gy = grid[..., 1].astype(jnp.float32)
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) / 2.0 * (size - 1)
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    fx = unnorm(gx, W)
+    fy = unnorm(gy, H)
+
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, W - 1)
+        fy = jnp.clip(fy, 0, H - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            fx = _reflect(fx, 0.0, W - 1.0)
+            fy = _reflect(fy, 0.0, H - 1.0)
+        else:
+            fx = jnp.clip(_reflect(fx, -0.5, W - 0.5), 0, W - 1)
+            fy = jnp.clip(_reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+    def gather(iy, ix):
+        iyc = jnp.clip(iy, 0, H - 1)
+        ixc = jnp.clip(ix, 0, W - 1)
+        # (N, C, Ho, Wo) gather per batch
+        out = x[jnp.arange(N)[:, None, None], :, iyc, ixc]  # (N,Ho,Wo,C)
+        out = jnp.moveaxis(out, -1, 1)
+        if padding_mode == "zeros":
+            valid = ((iy >= 0) & (iy <= H - 1) & (ix >= 0)
+                     & (ix <= W - 1)).astype(x.dtype)
+            out = out * valid[:, None, :, :]
+        return out
+
+    if mode == "nearest":
+        ix = jnp.round(fx).astype(jnp.int32)
+        iy = jnp.round(fy).astype(jnp.int32)
+        return gather(iy, ix)
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx = (fx - x0.astype(jnp.float32)).astype(x.dtype)
+    wy = (fy - y0.astype(jnp.float32)).astype(x.dtype)
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x1)
+    v10 = gather(y1, x0)
+    v11 = gather(y1, x1)
+    wxe = wx[:, None]
+    wye = wy[:, None]
+    top = v00 * (1 - wxe) + v01 * wxe
+    bot = v10 * (1 - wxe) + v11 * wxe
+    return top * (1 - wye) + bot * wye
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """~ paddle.nn.functional.grid_sample (phi grid_sample kernel)."""
+    return apply_op("grid_sample",
+                    lambda v, g: _grid_sample(v, g, mode, padding_mode,
+                                              align_corners), x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """~ paddle.nn.functional.temporal_shift (TSM op,
+    paddle/phi/kernels/temporal_shift_kernel.h): shift a leading fraction of
+    channels one step back/forward along the segment (time) axis."""
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        r = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [r[:, 1:, :c1], jnp.zeros_like(r[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(r[:, :1, c1:c2]), r[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, r[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op("temporal_shift", fn, x)

@@ -181,3 +181,52 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.need_clip = need_clip
+
+
+class Bilinear(Initializer):
+    """~ paddle.nn.initializer.Bilinear — bilinear-upsampling kernel init for
+    transposed conv weights (shape [C_out, C_in, k, k])."""
+
+    def __call__(self, shape, dtype=None):
+        dt = _dt.convert_dtype(dtype)
+        arr = np.zeros(tuple(int(s) for s in shape), dtype=np.float32)
+        if len(shape) < 3:
+            return jnp.asarray(arr.astype(dt))
+        k = shape[-1]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        coords = np.arange(k)
+        kernel1d = 1 - np.abs(coords / f - c)
+        kernel = np.outer(kernel1d, kernel1d) if len(shape) >= 4 else kernel1d
+        arr[...] = kernel
+        return jnp.asarray(arr.astype(dt))
+
+
+def calculate_gain(nonlinearity, param=None):
+    """~ paddle.nn.initializer.calculate_gain."""
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": float(np.sqrt(2.0)),
+        "leaky_relu": float(np.sqrt(2.0 / (1 + (param if param is not None
+                                                else 0.01) ** 2))),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return gains[nonlinearity]
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """~ paddle.nn.initializer.set_global_initializer: default initializers
+    applied by layers that don't specify weight_attr/bias_attr."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init)
+
+
+def get_global_initializer():
+    return _global_initializer

@@ -264,3 +264,30 @@ class Pad3D(_PadNd):
 class ZeroPad2D(Pad2D):
     def __init__(self, padding, data_format="NCHW", name=None):
         super().__init__(padding, "constant", 0.0, data_format)
+
+
+class PairwiseDistance(Layer):
+    """~ paddle.nn.PairwiseDistance — p-norm of x - y along the last dim."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops.dispatch import apply_op
+        import jax.numpy as jnp
+
+        def fn(a, b):
+            d = a - b + self.epsilon
+            return jnp.linalg.norm(d, ord=self.p, axis=-1,
+                                   keepdims=self.keepdim)
+        return apply_op("pairwise_distance", fn, x, y)
+
+
+class Softmax2D(Layer):
+    """~ paddle.nn.Softmax2D — softmax over the channel dim of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

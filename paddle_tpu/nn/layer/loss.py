@@ -147,3 +147,42 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class HingeEmbeddingLoss(Layer):
+    """~ paddle.nn.HingeEmbeddingLoss."""
+
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin,
+                                      self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """~ paddle.nn.HSigmoidLoss (hierarchical sigmoid over a class tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        import numpy as np
+        from ...core.tensor import Parameter
+        from ...ops import creation
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        limit = float(np.sqrt(6.0 / (feature_size + max(1, n_nodes))))
+        self.weight = Parameter(
+            (creation.uniform([max(1, n_nodes), feature_size],
+                              min=-limit, max=limit))._value)
+        if bias_attr is not False:
+            self.bias = Parameter(creation.zeros([max(1, n_nodes)])._value)
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

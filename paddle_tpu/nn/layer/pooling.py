@@ -94,3 +94,42 @@ class AdaptiveMaxPool2D(_Pool):
 class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(F.adaptive_max_pool3d, output_size=output_size)
+
+
+class MaxUnPool1D(Layer):
+    """~ paddle.nn.MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._args
+        return F.max_unpool1d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool2D(Layer):
+    """~ paddle.nn.MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._args
+        return F.max_unpool2d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool3D(Layer):
+    """~ paddle.nn.MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._args
+        return F.max_unpool3d(x, indices, k, s, p, df, osz)

@@ -298,3 +298,123 @@ class GRU(_RNNBase):
                  direction="forward", time_major=False, dropout=0.0, **kw):
         super().__init__("GRU", input_size, hidden_size, num_layers,
                          direction, time_major, dropout)
+
+
+class BeamSearchDecoder:
+    """~ paddle.nn.BeamSearchDecoder (python/paddle/fluid/layers/rnn.py
+    BeamSearchDecoder:792): beam-expanded single-step decoder over an RNN
+    cell, driven by :func:`dynamic_decode`."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        v = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v, batch):
+        return v.reshape((batch, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        cs = jax.tree.map(
+            lambda t: self.tile_beam_merge_with_batch(t, self.beam_size)._value
+            if isinstance(t, Tensor) else t, initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        batch = jax.tree.leaves(initial_cell_states)[0].shape[0]
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int64
+                       if False else jnp.int32)
+        # only beam 0 is live initially so duplicated beams don't tie
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, (cs, log_probs, finished)
+
+    def step(self, time, inputs, states):
+        cell_states, log_probs, finished = states
+        batch = log_probs.shape[0]
+        inp = Tensor(self._merge(inputs.astype(jnp.int32))) \
+            if not isinstance(inputs, Tensor) else inputs
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        wrapped_states = jax.tree.map(
+            lambda v: Tensor(v), cell_states,
+            is_leaf=lambda v: isinstance(v, jax.Array))
+        out, next_states = self.cell(inp, wrapped_states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = out._value  # (batch*beam, vocab)
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        step_lp = step_lp.reshape(batch, self.beam_size, vocab)
+        # finished beams only extend with end_token at zero cost
+        mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], mask[None, None, :], step_lp)
+        total = log_probs[..., None] + step_lp
+        flat = total.reshape(batch, self.beam_size * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int32)
+        token = (top_idx % vocab).astype(jnp.int32)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) \
+            | (token == self.end_token)
+        gathered_states = jax.tree.map(
+            lambda v: self._merge(jnp.take_along_axis(
+                self._split(v._value if isinstance(v, Tensor) else v, batch),
+                parent.reshape(batch, self.beam_size, *([1] * (v.ndim - 1))),
+                axis=1)), next_states,
+            is_leaf=lambda v: isinstance(v, (Tensor, jax.Array)))
+        return (token, parent), (gathered_states, top_lp, new_finished)
+
+    def finalize(self, tokens, parents):
+        # tokens/parents: lists over time of (batch, beam)
+        from .. import functional as Fn
+        ids = Tensor(jnp.stack(tokens))          # (T, batch, beam)
+        par = Tensor(jnp.stack(parents))
+        seqs = Fn.gather_tree(ids, par)
+        return seqs
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """~ paddle.nn.dynamic_decode (fluid/layers/rnn.py dynamic_decode:1393).
+
+    Eager loop with early exit when every beam is finished; each step is one
+    XLA program (cell + top-k), so the hot path stays on-device."""
+    ids, states = decoder.initialize(inits)
+    tokens, parents = [], []
+    inputs = ids
+    t = 0
+    while t <= int(max_step_num):
+        (token, parent), states = decoder.step(t, inputs, states)
+        tokens.append(token)
+        parents.append(parent)
+        inputs = token
+        t += 1
+        if bool(jnp.all(states[2])):
+            break
+    seqs = decoder.finalize(tokens, parents)
+    if not output_time_major:
+        seqs = Tensor(jnp.moveaxis(seqs._value, 0, 1))
+    # length per (batch, beam): steps up to and including the first end token
+    tb = seqs._value if output_time_major else \
+        jnp.moveaxis(seqs._value, 1, 0)          # (T, batch, beam)
+    T = tb.shape[0]
+    is_end = tb == decoder.end_token
+    any_end = jnp.any(is_end, axis=0)
+    first_end = jnp.argmax(is_end, axis=0) + 1
+    lengths = Tensor(jnp.where(any_end, first_end, T).astype(jnp.int32))
+    if return_length:
+        return seqs, states, lengths
+    return seqs, states

@@ -195,8 +195,9 @@ def create_parameter(shape, dtype, name=None, attr=None,
     shape = [int(s) for s in shape]
     jdt = dtypes.convert_dtype(dtype)
     if default_initializer is not None:
-        p = Parameter(jnp.zeros(shape, jdt))
-        default_initializer(p)
+        p = Parameter(jnp.asarray(default_initializer(shape, jdt)))
+        if name:
+            p.name = name
         return p
     if jnp.issubdtype(jdt, jnp.floating):
         fan_in = shape[0] if shape else 1

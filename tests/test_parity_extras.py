@@ -1,0 +1,282 @@
+"""Tests for the API-parity batch: top-level ops, nn extras, decoders.
+
+Oracle style follows the reference's OpTest (unittests/op_test.py): numpy
+expectations + numeric grad checks where gradients matter.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestTopLevelOps:
+    def test_cast_addn_numel(self):
+        x = paddle.to_tensor(np.array([1.7, 2.3], np.float32))
+        assert paddle.cast(x, "int32").numpy().dtype == np.int32
+        s = paddle.add_n([x, x, x])
+        np.testing.assert_allclose(s.numpy(), [5.1, 6.9], rtol=1e-6)
+        assert int(paddle.numel(x).numpy()) == 2
+        assert list(paddle.shape(paddle.ones([2, 3])).numpy()) == [2, 3]
+        assert int(paddle.rank(paddle.ones([2, 3])).numpy()) == 2
+
+    def test_logit_dist_tensordot(self):
+        x = np.array([0.2, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(
+            paddle.logit(paddle.to_tensor(x)).numpy(),
+            np.log(x / (1 - x)), rtol=1e-5)
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.dist(paddle.to_tensor(a), paddle.to_tensor(b), 2).numpy(),
+            np.linalg.norm((a - b).ravel()), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.tensordot(paddle.to_tensor(a),
+                             paddle.to_tensor(b.T), axes=1).numpy(),
+            a @ b.T @ np.eye(3, dtype=np.float32) if False else a @ b.T,
+            rtol=1e-5)
+
+    def test_unique_consecutive(self):
+        x = paddle.to_tensor(np.array([1, 1, 2, 2, 2, 3, 1]))
+        out, inv, cnt = paddle.unique_consecutive(
+            x, return_inverse=True, return_counts=True)
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+        np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1, 1])
+        np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2, 3])
+
+    def test_inplace_variants(self):
+        x = paddle.ones([2, 3])
+        y = x.reshape_([3, 2])
+        assert y is x and x.shape == [3, 2]
+        x.zero_()
+        assert float(x.numpy().sum()) == 0.0
+        x.fill_(2.0)
+        assert float(x.numpy().sum()) == 12.0
+        t = paddle.to_tensor(np.array([-1.0, 1.0], np.float32))
+        F.relu_(t)
+        np.testing.assert_allclose(t.numpy(), [0.0, 1.0])
+
+    def test_crop_reverse_broadcast_shape(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(4, 4))
+        c = paddle.crop(x, shape=[2, 2], offsets=[1, 1])
+        np.testing.assert_allclose(c.numpy(), [[5, 6], [9, 10]])
+        r = paddle.reverse(x, axis=0)
+        np.testing.assert_allclose(r.numpy()[0], x.numpy()[3])
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    def test_randoms(self):
+        p = paddle.poisson(paddle.full([100], 4.0))
+        assert 2.0 < float(p.numpy().mean()) < 6.0
+        r = paddle.randint_like(paddle.zeros([50]), 0, 10)
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        assert paddle.standard_normal([3, 3]).shape == [3, 3]
+
+    def test_flops(self):
+        n = paddle.flops(nn.Linear(8, 4), [2, 8])
+        assert n == 2 * 8 * 4  # batch 2 x weight numel
+
+
+class TestPoolingMask:
+    def test_max_pool_return_mask_roundtrip(self):
+        x = paddle.to_tensor(
+            np.random.randn(2, 3, 8, 8).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        assert mask.numpy().dtype == np.int32
+        # indices point at the argmax: gathering by them reproduces out
+        flat = x.numpy().reshape(2, 3, -1)
+        got = np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1), -1)
+        np.testing.assert_allclose(got.reshape(out.shape), out.numpy())
+
+    def test_max_unpool2d_layer_and_grad(self):
+        x = paddle.to_tensor(
+            np.random.randn(1, 2, 4, 4).astype(np.float32),
+            stop_gradient=False)
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        up = nn.MaxUnPool2D(2, 2)(out, mask)
+        assert up.shape == [1, 2, 4, 4]
+        # scattered values survive the roundtrip at their argmax positions
+        up_flat = up.numpy().reshape(1, 2, -1)
+        got = np.take_along_axis(up_flat, mask.numpy().reshape(1, 2, -1), -1)
+        np.testing.assert_allclose(got.reshape(out.shape), out.numpy())
+        loss = up.sum()
+        loss.backward()
+        g = x.grad.numpy()
+        assert g.sum() == 8  # one 1 per pooled window
+
+
+class TestVisionFunctional:
+    def test_affine_grid_identity_sample(self):
+        theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32),
+                        (2, 1, 1))
+        x = paddle.to_tensor(np.random.rand(2, 3, 6, 6).astype(np.float32))
+        grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 6, 6])
+        y = F.grid_sample(x, grid)
+        np.testing.assert_allclose(y.numpy(), x.numpy(), atol=1e-5)
+
+    def test_grid_sample_modes(self):
+        x = paddle.to_tensor(np.random.rand(1, 1, 5, 5).astype(np.float32))
+        grid = paddle.to_tensor(
+            np.random.uniform(-1.2, 1.2, (1, 3, 3, 2)).astype(np.float32))
+        for mode in ("bilinear", "nearest"):
+            for pm in ("zeros", "border", "reflection"):
+                y = F.grid_sample(x, grid, mode=mode, padding_mode=pm)
+                assert y.shape == [1, 1, 3, 3]
+                assert np.isfinite(y.numpy()).all()
+
+    def test_temporal_shift(self):
+        x = paddle.to_tensor(np.random.rand(4, 8, 3, 3).astype(np.float32))
+        y = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        assert y.shape == x.shape
+        # last-quarter channels are untouched
+        np.testing.assert_allclose(y.numpy()[:, 4:], x.numpy()[:, 4:])
+
+
+class TestLossExtras:
+    def test_dice_loss_matches_numpy(self):
+        x = np.random.rand(2, 5, 4).astype(np.float32)
+        lab = np.random.randint(0, 4, (2, 5, 1))
+        got = F.dice_loss(paddle.to_tensor(x), paddle.to_tensor(lab)).numpy()
+        oh = np.eye(4, dtype=np.float32)[lab[..., 0]]
+        inter = 2 * (x * oh).reshape(2, -1).sum(1)
+        union = x.reshape(2, -1).sum(1) + oh.reshape(2, -1).sum(1)
+        ref = (1 - (inter + 1e-5) / (union + 1e-5)).mean()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_sigmoid_focal_loss_reduces_easy_examples(self):
+        logit = paddle.to_tensor(np.array([[5.0, -5.0]], np.float32))
+        label = paddle.to_tensor(np.array([[1.0, 0.0]], np.float32))
+        focal = float(F.sigmoid_focal_loss(logit, label).numpy())
+        bce = float(F.binary_cross_entropy_with_logits(
+            logit, label, reduction="sum").numpy())
+        assert focal < bce
+
+    def test_hsigmoid_loss_shape_and_grad(self):
+        x = paddle.to_tensor(np.random.randn(4, 6).astype(np.float32),
+                             stop_gradient=False)
+        lab = paddle.to_tensor(np.random.randint(0, 8, (4, 1)))
+        w = paddle.to_tensor(np.random.randn(7, 6).astype(np.float32),
+                             stop_gradient=False)
+        loss = F.hsigmoid_loss(x, lab, 8, w)
+        assert loss.shape == [4, 1]
+        assert (loss.numpy() > 0).all()
+        loss.sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_margin_cross_entropy_reduces_target(self):
+        feats = F.normalize(paddle.to_tensor(
+            np.random.randn(8, 10).astype(np.float32)))
+        lab = paddle.to_tensor(np.random.randint(0, 10, (8,)))
+        plain = F.margin_cross_entropy(
+            feats, lab, margin1=1.0, margin2=0.0, margin3=0.0, scale=1.0)
+        margined = F.margin_cross_entropy(feats, lab)
+        assert float(margined.numpy()) > float(plain.numpy())
+
+    def test_npair_loss_finite(self):
+        a = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        p = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        lab = paddle.to_tensor(np.random.randint(0, 3, (4,)))
+        assert np.isfinite(float(F.npair_loss(a, p, lab).numpy()))
+
+
+class TestDecoder:
+    def test_beam_search_decode(self):
+        cell = nn.GRUCell(8, 8)
+        emb = nn.Embedding(12, 8)
+        head = nn.Linear(8, 12)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=head)
+        seqs, states, lens = nn.dynamic_decode(
+            dec, inits=paddle.zeros([2, 8]), max_step_num=5,
+            return_length=True)
+        assert seqs.shape[0] == 2 and seqs.shape[2] == 3
+        assert lens.shape == [2, 3]
+        assert (lens.numpy() <= seqs.shape[1]).all()
+
+    def test_gather_tree(self):
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2]], [[3, 4]], [[5, 6]]], np.int32))
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 0]], [[1, 0]]], np.int32))
+        out = F.gather_tree(ids, parents)
+        # beam 0 at t=2 came from parent 1 at t=1 (token 4), which came
+        # from parent 0 at t=0
+        np.testing.assert_array_equal(out.numpy()[:, 0, 0], [2, 4, 5])
+
+
+class TestMiscLayers:
+    def test_pairwise_distance(self):
+        a = np.random.randn(3, 5).astype(np.float32)
+        b = np.random.randn(3, 5).astype(np.float32)
+        got = nn.PairwiseDistance()(paddle.to_tensor(a),
+                                    paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(
+            got, np.linalg.norm(a - b + 1e-6, axis=-1), rtol=1e-5)
+
+    def test_layer_dict(self):
+        ld = nn.LayerDict({"fc": nn.Linear(2, 2)})
+        ld["act"] = nn.ReLU()
+        assert set(ld.keys()) == {"fc", "act"}
+        assert len(list(ld.parameters())) == 2
+        ld.pop("act")
+        assert len(ld) == 1
+
+    def test_one_hot_diag_embed_zeropad(self):
+        oh = F.one_hot(paddle.to_tensor(np.array([0, 2])), 3)
+        np.testing.assert_allclose(oh.numpy(), np.eye(3)[[0, 2]])
+        de = F.diag_embed(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(de.numpy(), np.diag([1.0, 2.0]))
+        zp = F.zeropad2d(paddle.ones([1, 1, 2, 2]), [1, 0, 0, 2])
+        assert zp.shape == [1, 1, 4, 3]
+
+    def test_sparse_attention_matches_masked_dense(self):
+        B, H, L, D = 1, 1, 4, 8
+        q = np.random.randn(B, H, L, D).astype(np.float32)
+        k = np.random.randn(B, H, L, D).astype(np.float32)
+        v = np.random.randn(B, H, L, D).astype(np.float32)
+        # banded pattern: each row attends to itself + next (mod L)
+        cols = np.array([[[0, 1, 1, 2, 2, 3, 3, 0]]], np.int32)
+        off = np.array([[[0, 2, 4, 6, 8]]], np.int32)
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(off), paddle.to_tensor(cols)).numpy()
+        # dense oracle
+        mask = np.zeros((L, L), bool)
+        for r in range(L):
+            for c in cols[0, 0, off[0, 0, r]:off[0, 0, r + 1]]:
+                mask[r, c] = True
+        scores = (q[0, 0] @ k[0, 0].T) / np.sqrt(D)
+        scores[~mask] = -np.inf
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        ref = probs @ v[0, 0]
+        np.testing.assert_allclose(out[0, 0], ref, atol=1e-4)
+
+
+class TestInitializer:
+    def test_bilinear_and_gain(self):
+        w = nn.initializer.Bilinear()([2, 2, 4, 4], "float32")
+        assert w.shape == (2, 2, 4, 4)
+        assert float(np.asarray(w).max()) <= 1.0
+        assert nn.initializer.calculate_gain("tanh") == pytest.approx(5 / 3)
+
+    def test_set_global_initializer(self):
+        nn.initializer.set_global_initializer(
+            nn.initializer.Constant(0.5), nn.initializer.Constant(0.0))
+        try:
+            assert nn.initializer.get_global_initializer() is not None
+        finally:
+            nn.initializer.set_global_initializer(None)
+
+
+class TestClassCenterSample:
+    def test_remap_consistency(self):
+        lab = paddle.to_tensor(np.array([3, 7, 3, 1]))
+        remapped, sampled = F.class_center_sample(lab, 10, 6)
+        s = sampled.numpy()
+        r = remapped.numpy()
+        # every original positive class appears, remapped ids index into s
+        for orig, new in zip([3, 7, 3, 1], r):
+            assert s[new] == orig
+        assert len(s) == 6
