@@ -264,6 +264,9 @@ class Gumbel(Distribution):
 
 def kl_divergence(p: Distribution, q: Distribution):
     """~ distribution/kl.py kl_divergence with a (type,type) registry."""
+    fn = _lookup_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     key = (type(p).__name__, type(q).__name__)
     if key == ("Normal", "Normal"):
         def fn(lp, sp, lq, sq):
@@ -291,3 +294,251 @@ def kl_divergence(p: Distribution, q: Distribution):
                     + (a2 - a1 + b2 - b1) * dg(a1 + b1))
         return apply_op("kl_beta", fn, p.alpha, p.beta, q.alpha, q.beta)
     raise NotImplementedError(f"kl_divergence not registered for {key}")
+
+
+# ---- registry + composite distributions ------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """~ paddle.distribution.register_kl (distribution/kl.py): decorator
+    registering a KL implementation for a (type, type) pair; dispatch walks
+    the MRO of both args so subclasses inherit registrations."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def _lookup_kl(p, q):
+    best = None
+    best_rank = None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            rank = (type(p).__mro__.index(cp), type(q).__mro__.index(cq))
+            if best_rank is None or rank < best_rank:
+                best, best_rank = fn, rank
+    return best
+
+
+class ExponentialFamily(Distribution):
+    """~ paddle.distribution.ExponentialFamily: distributions with natural
+    parameters; entropy via the Bregman identity (log-normalizer gradients),
+    which jax.grad supplies directly."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        nat = [n._value if isinstance(n, Tensor) else jnp.asarray(n)
+               for n in self._natural_parameters]
+
+        def fn(*nat_in):
+            logz, grads = jax.value_and_grad(
+                lambda ps: jnp.sum(self._log_normalizer(*ps)),
+                )(tuple(nat_in))
+            ent = logz - self._mean_carrier_measure
+            for np_, g in zip(nat_in, grads):
+                ent = ent - jnp.sum(np_ * g)
+            return ent
+        return apply_op("ef_entropy", fn, *[Tensor(n) for n in nat])
+
+
+class Independent(Distribution):
+    """~ paddle.distribution.Independent: reinterprets trailing batch dims of
+    ``base`` as event dims (sums log_prob over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=0):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        bs = tuple(base.batch_shape)
+        k = len(bs) - self.reinterpreted_batch_rank
+        super().__init__(bs[:k], bs[k:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self.reinterpreted_batch_rank == 0:
+            return lp
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return apply_op("independent_logprob",
+                        lambda v: jnp.sum(v, axis=axes), lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self.reinterpreted_batch_rank == 0:
+            return ent
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return apply_op("independent_entropy",
+                        lambda v: jnp.sum(v, axis=axes), ent)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class Multinomial(Distribution):
+    """~ paddle.distribution.Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = probs if isinstance(probs, Tensor) else Tensor(
+            jnp.asarray(probs, jnp.float32))
+        p = self.probs._value
+        super().__init__(p.shape[:-1], p.shape[-1:])
+
+    @property
+    def mean(self):
+        return apply_op("multinomial_mean",
+                        lambda p: self.total_count * p
+                        / jnp.sum(p, -1, keepdims=True), self.probs)
+
+    @property
+    def variance(self):
+        def fn(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return self.total_count * pn * (1 - pn)
+        return apply_op("multinomial_var", fn, self.probs)
+
+    def sample(self, shape=()):
+        from ..core.generator import default_generator
+        shape = tuple(shape)
+        p = self.probs._value
+        pn = p / jnp.sum(p, -1, keepdims=True)
+        key = default_generator().next_key()
+        # counts via total_count categorical draws, one-hot summed
+        draws = jax.random.categorical(
+            key, jnp.log(jnp.maximum(pn, 1e-30)),
+            shape=shape + (self.total_count,) + p.shape[:-1])
+        counts = jax.nn.one_hot(draws, p.shape[-1]).sum(len(shape))
+        return Tensor(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v, p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            gl = jax.scipy.special.gammaln
+            return (gl(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(gl(v + 1.0), -1)
+                    + jnp.sum(v * jnp.log(jnp.maximum(pn, 1e-30)), -1))
+        return apply_op("multinomial_logprob", fn, value, self.probs)
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate (matches reference's absence
+        # of an exact formula — it doesn't implement entropy either)
+        samples = self.sample((128,))
+        lp = self.log_prob(samples)
+        return apply_op("multinomial_entropy",
+                        lambda v: -jnp.mean(v, axis=0), lp)
+
+
+class Transform:
+    """~ paddle.distribution.Transform (distribution/transform.py)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op("neg_ldj", lambda v: -v,
+                        self.forward_log_det_jacobian(self.inverse(y)))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """~ paddle.distribution.AffineTransform: y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(
+            jnp.asarray(loc, jnp.float32))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(
+            jnp.asarray(scale, jnp.float32))
+
+    def forward(self, x):
+        return apply_op("affine_fwd", lambda v, l, s: l + s * v,
+                        x, self.loc, self.scale)
+
+    def inverse(self, y):
+        return apply_op("affine_inv", lambda v, l, s: (v - l) / s,
+                        y, self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op("affine_ldj",
+                        lambda v, s: jnp.broadcast_to(
+                            jnp.log(jnp.abs(s)), v.shape),
+                        x, self.scale)
+
+
+class ExpTransform(Transform):
+    """~ paddle.distribution.ExpTransform: y = exp(x)."""
+
+    def forward(self, x):
+        return apply_op("exp_fwd", jnp.exp, x)
+
+    def inverse(self, y):
+        return apply_op("exp_inv", jnp.log, y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op("exp_ldj", lambda v: v, x)
+
+
+class TransformedDistribution(Distribution):
+    """~ paddle.distribution.TransformedDistribution(base, transforms)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms) if isinstance(
+            transforms, (list, tuple)) else [transforms]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        ldj_terms = []
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj_terms.append(t.forward_log_det_jacobian(x))
+            y = x
+        lp = self.base.log_prob(y)
+
+        def fn(base_lp, *ldjs):
+            out = base_lp
+            for l in ldjs:
+                out = out - l
+            return out
+        return apply_op("transformed_logprob", fn, lp, *ldj_terms)

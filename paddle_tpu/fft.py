@@ -89,3 +89,48 @@ def fftfreq(n, d=1.0):
 @def_op("rfftfreq", nondiff=True)
 def rfftfreq(n, d=1.0):
     return jnp.fft.rfftfreq(int(n), d=d)
+
+
+@def_op("rfftn")
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@def_op("irfftn")
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@def_op("hfft2")
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    # hermitian fft over the last axis after an inverse fft on the rest
+    out = jnp.fft.ifftn(x, s=None if s is None else s[:-1], axes=axes[:-1],
+                        norm=_norm(norm))
+    return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=_norm(norm))
+
+
+@def_op("ihfft2")
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=_norm(norm))
+    return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=axes[:-1],
+                        norm=_norm(norm))
+
+
+@def_op("hfftn")
+def hfftn(x, s=None, axes=None, norm="backward"):
+    ax = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
+    out = jnp.fft.ifftn(x, s=None if s is None else s[:-1], axes=ax[:-1],
+                        norm=_norm(norm))
+    return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=ax[-1],
+                        norm=_norm(norm))
+
+
+@def_op("ihfftn")
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    ax = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
+    out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=ax[-1],
+                        norm=_norm(norm))
+    return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=ax[:-1],
+                        norm=_norm(norm))

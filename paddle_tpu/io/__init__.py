@@ -89,6 +89,32 @@ class ChainDataset(IterableDataset):
         return itertools.chain(*self.datasets)
 
 
+class ComposeDataset(Dataset):
+    """~ paddle.io.ComposeDataset: zip map-style datasets field-wise."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets must not be empty")
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError("all datasets must have the same length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            if isinstance(item, (tuple, list)):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)
+
+
 def random_split(dataset, lengths, generator=None):
     n = len(dataset)
     if sum(lengths) != n:

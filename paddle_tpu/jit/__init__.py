@@ -241,3 +241,71 @@ def load(path, **configs):
         with open(path + ".pdexport", "rb") as f:
             exported = jax_export.deserialize(bytearray(f.read()))
     return TranslatedLayer(state, hlo, exported)
+
+
+class ProgramTranslator:
+    """~ dygraph_to_static/program_translator.py ProgramTranslator:847 —
+    process-wide switch for to_static tracing (singleton)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)
+
+
+def enable_to_static(flag: bool = True):
+    ProgramTranslator().enable(flag)
+
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """~ paddle.jit.set_verbosity — dy2static transform logging level."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """~ paddle.jit.set_code_level — which transformed code stage to print."""
+    global _code_level
+    _code_level = int(level)
+
+
+class TracedLayer:
+    """~ paddle.jit.TracedLayer (fluid/dygraph/jit.py): trace a dygraph
+    layer into an executable program with example inputs."""
+
+    def __init__(self, static_fn, layer, example_args):
+        self._sf = static_fn
+        self._layer = layer
+        self._example = example_args
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        sf = StaticFunction(layer.forward, layer=layer)
+        out = sf(*inputs)
+        return out, TracedLayer(sf, layer, inputs)
+
+    def __call__(self, *args):
+        return self._sf(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        specs = [InputSpec(t.shape, str(t.dtype)) for t in self._example]
+        save(self._layer, path, input_spec=specs)
+
+    @property
+    def program(self):
+        return self._sf.get_traced(*self._example)

@@ -1,8 +1,8 @@
 """paddle.linalg namespace. ~ python/paddle/linalg.py re-exports."""
 from .ops.linalg import (  # noqa: F401
-    cholesky, cholesky_solve, corrcoef, cov, det, eig, eigh, eigvalsh,
-    inverse, lstsq, lu, matmul, matrix_power, matrix_rank, mv, norm, pinv,
-    qr, slogdet, solve, svd, triangular_solve,
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh, eigvals,
+    eigvalsh, inv, inverse, lstsq, lu, lu_unpack, matmul, matrix_power,
+    matrix_rank, mv, norm, pinv, qr, slogdet, solve, svd, triangular_solve,
 )
 
 multi_dot = None

@@ -173,8 +173,70 @@ def lstsq(x, y, rcond=None, driver=None):
 
 
 def lu(x, pivot=True):
-    return apply_op("lu", lambda a: tuple(jax.scipy.linalg.lu(a)[:2]), x,
-                    nondiff=True)
+    """~ paddle.linalg.lu: packed LU factors + 1-based LAPACK pivots
+    (python/paddle/tensor/linalg.py lu)."""
+    def fn(a):
+        lu_packed, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_packed, (piv + 1).astype(jnp.int32)
+    return apply_op("lu", fn, x, nondiff=True)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """~ paddle.linalg.lu_unpack: (P, L, U) from packed LU + pivots."""
+    def fn(lu_packed, piv):
+        m = lu_packed.shape[-2]
+        n = lu_packed.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_packed[..., :, :k], -1) + jnp.eye(m, k,
+                                                          dtype=lu_packed.dtype)
+        U = jnp.triu(lu_packed[..., :k, :])
+        # P from the ipiv swap sequence (1-based)
+        def perm_of(piv1):
+            def body(i, perm):
+                j = piv1[i] - 1
+                pi = perm[i]
+                pj = perm[j]
+                return perm.at[i].set(pj).at[j].set(pi)
+            perm0 = jnp.arange(m)
+            return jax.lax.fori_loop(0, piv1.shape[0], body, perm0)
+        perm = perm_of(piv) if piv.ndim == 1 else jax.vmap(perm_of)(piv)
+        P = jax.nn.one_hot(perm, m, dtype=lu_packed.dtype)
+        # rows of P: P[i, perm[i]] = 1 -> P @ A permutes; paddle wants
+        # A = P L U, i.e. P is the inverse permutation matrix
+        P = jnp.swapaxes(P, -1, -2)
+        return P, L, U
+    return apply_op("lu_unpack", fn, x, y, nondiff=True)
+
+
+def eigvals(x):
+    """~ paddle.linalg.eigvals (host eig; XLA has no general eig)."""
+    def fn(a):
+        host = np.linalg.eigvals(np.asarray(a))
+        return jnp.asarray(host)
+    return apply_op("eigvals", fn, x, nondiff=True)
+
+
+def cond(x, p=None):
+    """~ paddle.linalg.cond — condition number under norm p."""
+    def fn(a):
+        if p is None or p == 2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        if p == "fro":
+            return (jnp.linalg.norm(a, "fro", axis=(-2, -1))
+                    * jnp.linalg.norm(jnp.linalg.inv(a), "fro", axis=(-2, -1)))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            si = jnp.linalg.svd(jnp.linalg.inv(a), compute_uv=False)
+            return jnp.sum(s, -1) * jnp.sum(si, -1)
+        if p in (np.inf, float("inf"), -np.inf, float("-inf"), 1, -1, 2, -2):
+            return (jnp.linalg.norm(a, p, axis=(-2, -1))
+                    * jnp.linalg.norm(jnp.linalg.inv(a), p, axis=(-2, -1)))
+        raise ValueError(f"unsupported norm order {p}")
+    return apply_op("cond", fn, x)
+
+
+inv = inverse
 
 
 @def_op("corrcoef")

@@ -106,3 +106,155 @@ def relu(x):
                                x.dense_shape)
     from ..ops.activation import relu as dense_relu
     return dense_relu(x)
+
+
+def _coo_from_dense(dense, stop_gradient=True):
+    """Host-side sparsification (data-dependent nnz -> eager op, like the
+    reference's sparse kernels which also materialize index sets)."""
+    arr = np.asarray(dense._value if isinstance(dense, Tensor) else dense)
+    # last dim is channels for conv-style layouts: a site is occupied if any
+    # channel is nonzero
+    occ = np.abs(arr).sum(axis=-1) if arr.ndim > 1 else np.abs(arr)
+    coords = np.argwhere(occ != 0)
+    vals = arr[tuple(coords.T)]
+    return SparseCooTensor(coords.T.astype(np.int64), vals, arr.shape)
+
+
+class ReLU:
+    """~ paddle.sparse.ReLU (phi/kernels/sparse/activation_kernel.cc):
+    elementwise on stored values only — the sparsity pattern is preserved."""
+
+    def __call__(self, x):
+        return relu(x)
+
+
+class Conv3D:
+    """~ paddle.sparse.Conv3D (phi/kernels/sparse/convolution_kernel.h).
+
+    NDHWC sparse conv: computed as a dense lax conv (XLA/MXU path) and
+    re-sparsified to the reachable output sites. The reference's gather-
+    scatter rulebook formulation targets GPU hash tables; on TPU the dense
+    formulation wins until occupancy is very low, at which point the Pallas
+    gather kernel applies."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        from ..core.generator import default_generator
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.kernel_size = ks
+        self.stride = (stride,) * 3 if isinstance(stride, int) \
+            else tuple(stride)
+        self.padding = (padding,) * 3 if isinstance(padding, int) \
+            else tuple(padding)
+        self.dilation = (dilation,) * 3 if isinstance(dilation, int) \
+            else tuple(dilation)
+        self.groups = groups
+        fan_in = in_channels * int(np.prod(ks))
+        limit = float(np.sqrt(6.0 / max(1, fan_in)))
+        from ..core.tensor import Parameter
+        key = default_generator().next_key()
+        self.weight = Parameter(jax.random.uniform(
+            key, ks + (in_channels // groups, out_channels),
+            jnp.float32, -limit, limit))
+        self.bias = Parameter(jnp.zeros((out_channels,))) \
+            if bias_attr is not False else None
+        self._subm = False
+
+    def _dense_conv(self, dense):
+        dn = jax.lax.conv_dimension_numbers(
+            dense.shape, self.weight._value.shape,
+            ("NDHWC", "DHWIO", "NDHWC"))
+        out = jax.lax.conv_general_dilated(
+            dense, self.weight._value, self.stride,
+            [(p, p) for p in self.padding], rhs_dilation=self.dilation,
+            dimension_numbers=dn, feature_group_count=self.groups)
+        if self.bias is not None:
+            out = out + self.bias._value
+        return out
+
+    def __call__(self, x):
+        dense = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        out = self._dense_conv(dense)
+        if self._subm:
+            # submanifold: output keeps the input's sparsity pattern
+            idx = x.indices_._value  # (4, nnz) over (n, d, h, w) sites
+            vals = out[tuple(idx)]   # (nnz, C_out)
+            return SparseCooTensor(idx, vals, list(out.shape))
+        return _coo_from_dense(Tensor(out))
+
+
+class SubmConv3D(Conv3D):
+    """~ paddle.sparse.SubmConv3D — submanifold conv (output sites = input
+    sites), the standard trick keeping 3D point-cloud activations sparse."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._subm = True
+
+
+class BatchNorm:
+    """~ paddle.sparse.BatchNorm — batch norm over stored values (channel
+    stats computed on the nnz values only, matching the reference)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        from ..nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def train(self):
+        self._bn.train()
+
+    def eval(self):
+        self._bn.eval()
+
+    def __call__(self, x):
+        if isinstance(x, SparseCooTensor):
+            vals = self._bn(x.values_)
+            return SparseCooTensor(x.indices_, vals, x.dense_shape)
+        return self._bn(x)
+
+
+class MaxPool3D:
+    """~ paddle.sparse.MaxPool3D — NDHWC max pool on the dense view,
+    re-sparsified."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+
+    def __call__(self, x):
+        from ..nn import functional as F
+        dense = Tensor(x._value if isinstance(x, Tensor) else jnp.asarray(x))
+        out = F.max_pool3d(dense, self.kernel_size, self.stride, self.padding,
+                           data_format="NDHWC")
+        return _coo_from_dense(out)
+
+
+def add(x, y):
+    """~ paddle.sparse.add — union-pattern elementwise add."""
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+    from ..ops.math import add as dense_add
+    return _coo_from_dense(dense_add(xd, yd))
+
+
+def masked_matmul(x, y, mask):
+    """~ paddle.sparse.masked_matmul: dense@dense evaluated only at mask's
+    sparsity pattern (SDDMM). TPU lowering: full MXU matmul + gather at the
+    pattern — wins whenever nnz is a significant fraction of the output."""
+    from ..ops.linalg import matmul as dense_matmul
+    out = dense_matmul(x, y)
+    if isinstance(mask, SparseCsrTensor):
+        crows = np.asarray(mask.crows_._value)
+        cols = np.asarray(mask.cols_._value)
+        rows = np.repeat(np.arange(mask.dense_shape[0]), np.diff(crows))
+        vals = out._value[rows, cols]
+        return SparseCsrTensor(crows, cols, vals, mask.dense_shape)
+    idx = mask.indices_._value
+    vals = out._value[tuple(idx)]
+    return SparseCooTensor(idx, vals, mask.dense_shape)

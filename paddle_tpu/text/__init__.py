@@ -115,3 +115,77 @@ class Conll05st(_SyntheticTextDataset):
 class Movielens(_SyntheticTextDataset):
     def __init__(self, data_file=None, mode="train", **kw):
         super().__init__(4000, 16, 4000, 5, seed=3)
+
+
+class ViterbiDecoder:
+    """~ paddle.text.ViterbiDecoder (python/paddle/text/viterbi_decode.py):
+    layer-style wrapper over :func:`viterbi_decode`."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class Imikolov(_SyntheticTextDataset):
+    """~ text/datasets/imikolov.py (PTB-style n-gram LM dataset)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        local = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/datasets/imikolov.npz")
+        self.window_size = window_size
+        if os.path.exists(local):
+            d = np.load(local)
+            self.x = d[f"x_{mode}"]
+            self.y = d[f"y_{mode}"]
+        else:
+            rng = np.random.default_rng(4 if mode == "train" else 5)
+            grams = rng.integers(
+                1, 2000, (8000 if mode == "train" else 1000, window_size))
+            self.x = grams[:, :-1].astype(np.int64)
+            self.y = grams[:, -1:].astype(np.int64)
+
+    def __getitem__(self, i):
+        return tuple(self.x[i]) + (self.y[i],)
+
+
+class _SyntheticTranslationDataset(Dataset):
+    """src/trg token-id pairs for WMT-style translation sets."""
+
+    def __init__(self, n, src_len, trg_len, vocab, seed):
+        rng = np.random.default_rng(seed)
+        self.src = rng.integers(2, vocab, (n, src_len)).astype(np.int64)
+        self.trg = rng.integers(2, vocab, (n, trg_len)).astype(np.int64)
+
+    def __getitem__(self, i):
+        src = self.src[i]
+        trg = self.trg[i]
+        # (src_ids, trg_ids, trg_ids_next) like the reference
+        return src, trg[:-1], trg[1:]
+
+    def __len__(self):
+        return len(self.src)
+
+
+class WMT14(_SyntheticTranslationDataset):
+    """~ text/datasets/wmt14.py; local file or deterministic synthetic."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        super().__init__(4000 if mode == "train" else 500, 20, 21,
+                         min(dict_size, 30000), seed=6)
+        self.dict_size = dict_size
+
+
+class WMT16(_SyntheticTranslationDataset):
+    """~ text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        super().__init__(4000 if mode == "train" else 500, 24, 25,
+                         min(src_dict_size, 30000), seed=7)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size

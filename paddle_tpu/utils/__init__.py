@@ -1,2 +1,65 @@
 from . import native  # noqa: F401
 from .download import get_weights_path_from_url  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """~ paddle.utils.deprecated decorator (python/paddle/utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """~ paddle.utils.try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"Failed to import {module_name}. Please install "
+                          f"it before using this API.")
+
+
+def require_version(min_version, max_version=None):
+    """~ paddle.utils.require_version — checks the framework version."""
+    from .. import __version__
+
+    def to_tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+    cur = to_tuple(__version__)
+    if to_tuple(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and to_tuple(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def run_check():
+    """~ paddle.utils.run_check — verifies the runtime can compile and run a
+    matmul on the available device(s)."""
+    import jax
+    import jax.numpy as jnp
+    n = len(jax.devices())
+    x = jnp.ones((8, 8))
+    y = jax.jit(lambda a: a @ a)(x)
+    assert float(y[0, 0]) == 8.0
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} {jax.devices()[0].platform} device(s) available.")

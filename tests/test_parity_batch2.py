@@ -1,0 +1,195 @@
+"""Parity batch 2: linalg/fft extras, distribution composites, sparse nn,
+jit trace helpers, io/text/utils fillers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import sparse
+
+
+class TestLinalgExtras:
+    def test_lu_roundtrip(self):
+        a = np.random.rand(5, 5).astype(np.float32) + 2 * np.eye(
+            5, dtype=np.float32)
+        lu_p, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_p, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ L.numpy() @ U.numpy(), a, atol=1e-5)
+
+    def test_cond_eigvals_inv(self):
+        a = np.random.rand(4, 4).astype(np.float32) + 2 * np.eye(
+            4, dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.cond(paddle.to_tensor(a)).numpy(),
+            np.linalg.cond(a), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.sort(np.abs(paddle.linalg.eigvals(
+                paddle.to_tensor(a)).numpy())),
+            np.sort(np.abs(np.linalg.eigvals(a))), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), atol=1e-5)
+
+
+class TestFFTExtras:
+    def test_rfftn_irfftn(self):
+        x = np.random.rand(4, 6).astype(np.float32)
+        got = paddle.fft.rfftn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.rfftn(x), atol=1e-4)
+        back = paddle.fft.irfftn(paddle.to_tensor(got)).numpy()
+        np.testing.assert_allclose(back, x, atol=1e-5)
+
+    def test_hermitian_families(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        assert paddle.fft.ihfft2(paddle.to_tensor(x)).shape == [4, 3]
+        h = paddle.fft.ihfftn(paddle.to_tensor(x))
+        assert paddle.fft.hfftn(h).shape == [4, 4]
+
+
+class TestDistributionComposites:
+    def test_independent(self):
+        base = D.Normal(paddle.zeros([3, 2]), paddle.ones([3, 2]))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == [3] and ind.event_shape == [2]
+        lp = ind.log_prob(paddle.zeros([3, 2]))
+        np.testing.assert_allclose(
+            lp.numpy(), 2 * -0.5 * np.log(2 * np.pi) * np.ones(3), rtol=1e-5)
+
+    def test_multinomial_logprob(self):
+        m = D.Multinomial(10, paddle.to_tensor(
+            np.array([0.3, 0.7], np.float32)))
+        from scipy import stats
+        ref = stats.multinomial(10, [0.3, 0.7]).logpmf([3, 7])
+        got = float(m.log_prob(paddle.to_tensor(
+            np.array([3.0, 7.0], np.float32))).numpy())
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        s = m.sample([5])
+        assert s.shape == [5, 2]
+        np.testing.assert_allclose(s.numpy().sum(-1), 10 * np.ones(5))
+
+    def test_transformed_lognormal(self):
+        td = D.TransformedDistribution(
+            D.Normal(paddle.zeros([1]), paddle.ones([1])),
+            [D.ExpTransform()])
+        from scipy import stats
+        got = float(td.log_prob(paddle.to_tensor(
+            np.array([2.0], np.float32))).numpy())
+        np.testing.assert_allclose(got, stats.lognorm(1).logpdf(2.0),
+                                   rtol=1e-5)
+
+    def test_register_kl(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return paddle.full([1], 42.0)
+
+        d = MyDist(paddle.zeros([1]), paddle.ones([1]))
+        assert float(D.kl_divergence(d, d).numpy()) == 42.0
+
+    def test_affine_transform(self):
+        t = D.AffineTransform(paddle.full([1], 1.0), paddle.full([1], 2.0))
+        y = t.forward(paddle.full([1], 3.0))
+        assert float(y.numpy()) == 7.0
+        x = t.inverse(y)
+        assert float(x.numpy()) == 3.0
+        assert float(t.forward_log_det_jacobian(x).numpy()) == pytest.approx(
+            np.log(2.0))
+
+
+class TestSparseNN:
+    def _coo(self):
+        idx = np.array([[0, 0, 0, 0], [0, 1, 1, 2], [1, 1, 2, 2],
+                        [0, 3, 3, 0]])
+        vals = np.random.randn(4, 3).astype(np.float32)
+        return sparse.sparse_coo_tensor(idx, vals, [1, 4, 4, 4, 3])
+
+    def test_subm_conv_keeps_pattern(self):
+        x = self._coo()
+        y = sparse.SubmConv3D(3, 8, 3, padding=1)(x)
+        assert y.nnz == x.nnz
+        np.testing.assert_array_equal(y.indices().numpy(),
+                                      x.indices().numpy())
+        assert y.values().shape == [4, 8]
+
+    def test_conv3d_expands_pattern(self):
+        x = self._coo()
+        y = sparse.Conv3D(3, 8, 3, padding=1)(x)
+        assert y.nnz >= x.nnz
+        assert y.dense_shape == [1, 4, 4, 4, 8]
+
+    def test_batchnorm_relu_pool(self):
+        x = self._coo()
+        y = sparse.BatchNorm(3)(x)
+        assert y.nnz == x.nnz
+        r = sparse.ReLU()(x)
+        assert (r.values().numpy() >= 0).all()
+        p = sparse.MaxPool3D(2, 2)(x)
+        assert p.dense_shape == [1, 2, 2, 2, 3]
+
+    def test_masked_matmul(self):
+        a = paddle.randn([4, 5])
+        b = paddle.randn([5, 4])
+        mask = sparse.sparse_coo_tensor(
+            np.array([[0, 1, 2], [1, 2, 3]]), np.ones(3, np.float32), [4, 4])
+        out = sparse.masked_matmul(a, b, mask)
+        dense = a.numpy() @ b.numpy()
+        for r, c in [(0, 1), (1, 2), (2, 3)]:
+            np.testing.assert_allclose(
+                out.to_dense().numpy()[r, c], dense[r, c], rtol=1e-5)
+
+
+class TestJitHelpers:
+    def test_traced_layer(self):
+        import paddle_tpu.jit as jit
+        net = paddle.nn.Linear(4, 2)
+        out, tl = jit.TracedLayer.trace(net, [paddle.randn([1, 4])])
+        got = tl(paddle.randn([3, 4]))
+        assert got.shape == [3, 2]
+        pt = jit.ProgramTranslator.get_instance()
+        assert pt is jit.ProgramTranslator()
+        pt.enable(True)
+
+    def test_verbosity_flags(self):
+        import paddle_tpu.jit as jit
+        jit.set_verbosity(2)
+        jit.set_code_level(1)
+
+
+class TestIoTextUtils:
+    def test_compose_dataset(self):
+        d1 = paddle.text.UCIHousing()
+        ds = paddle.io.ComposeDataset([d1, d1])
+        assert len(ds) == len(d1)
+        assert len(ds[0]) == 4
+
+    def test_viterbi_decoder_class(self):
+        trans = paddle.randn([5, 5])
+        dec = paddle.text.ViterbiDecoder(trans)
+        scores, paths = dec(paddle.randn([2, 7, 5]))
+        assert scores.shape == [2] and paths.shape == [2, 7]
+
+    def test_text_datasets(self):
+        assert len(paddle.text.Imikolov()[0]) == 5
+        src, trg_in, trg_next = paddle.text.WMT14()[0]
+        assert len(trg_in) == len(trg_next)
+        assert len(paddle.text.WMT16()) > 0
+
+    def test_utils(self):
+        from paddle_tpu.utils import (deprecated, require_version,
+                                      try_import)
+        assert require_version("0.0.1")
+        with pytest.raises(Exception):
+            require_version("99.0")
+        np_mod = try_import("numpy")
+        assert np_mod is np
+        with pytest.raises(ImportError):
+            try_import("definitely_not_a_module_xyz")
+
+        @deprecated(update_to="paddle.new_api", since="0.1")
+        def old_fn():
+            return 5
+        with pytest.warns(DeprecationWarning):
+            assert old_fn() == 5
