@@ -112,3 +112,417 @@ def deform_conv2d(*args, **kwargs):
     raise NotImplementedError(
         "deform_conv2d: irregular gather pattern — planned as a Pallas "
         "kernel; use roi_align/grid-sample style gathers meanwhile")
+
+
+def _roi_grid(rois, spatial_scale, oh, ow, H, W):
+    x1 = rois[:, 0] * spatial_scale
+    y1 = rois[:, 1] * spatial_scale
+    x2 = rois[:, 2] * spatial_scale
+    y2 = rois[:, 3] * spatial_scale
+    return x1, y1, jnp.maximum(x2 - x1, 1.0), jnp.maximum(y2 - y1, 1.0)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """~ paddle.vision.ops.roi_pool (max pooling inside each RoI bin)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def fn(feat, rois):
+        N, C, H, W = feat.shape
+        img0 = feat[0]
+        x1, y1, rw, rh = _roi_grid(rois, spatial_scale, oh, ow, H, W)
+
+        def one_roi(px1, py1, prw, prh):
+            # integer bin boundaries like the reference's roi_pool
+            ys = py1 + jnp.arange(oh + 1) * prh / oh
+            xs = px1 + jnp.arange(ow + 1) * prw / ow
+            ys = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, H)
+            xs = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, W)
+            yy = jnp.arange(H)
+            xx = jnp.arange(W)
+
+            def bin_max(i, j):
+                row_m = (yy >= ys[i]) & (yy < jnp.maximum(ys[i + 1],
+                                                          ys[i] + 1))
+                col_m = (xx >= xs[j]) & (xx < jnp.maximum(xs[j + 1],
+                                                          xs[j] + 1))
+                m = row_m[:, None] & col_m[None, :]
+                neg = jnp.finfo(img0.dtype).min
+                return jnp.max(jnp.where(m[None], img0, neg), axis=(1, 2))
+
+            rows = []
+            for i in range(oh):
+                cols = [bin_max(i, j) for j in range(ow)]
+                rows.append(jnp.stack(cols, -1))
+            return jnp.stack(rows, -2)  # (C, oh, ow)
+        return jax.vmap(one_roi)(x1, y1, rw, rh)
+    return apply_op("roi_pool", fn, x, boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """~ paddle.vision.ops.psroi_pool (position-sensitive RoI average pool,
+    R-FCN): input channels = C_out * oh * ow; bin (i, j) of output channel c
+    averages input channel c*oh*ow + i*ow + j inside that bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def fn(feat, rois):
+        N, C, H, W = feat.shape
+        c_out = C // (oh * ow)
+        img0 = feat[0]
+        x1, y1, rw, rh = _roi_grid(rois, spatial_scale, oh, ow, H, W)
+
+        def one_roi(px1, py1, prw, prh):
+            ys = py1 + jnp.arange(oh + 1) * prh / oh
+            xs = px1 + jnp.arange(ow + 1) * prw / ow
+            yy = jnp.arange(H)
+            xx = jnp.arange(W)
+            out = []
+            for i in range(oh):
+                row = []
+                for j in range(ow):
+                    row_m = (yy + 0.5 >= ys[i]) & (yy + 0.5 <= ys[i + 1])
+                    col_m = (xx + 0.5 >= xs[j]) & (xx + 0.5 <= xs[j + 1])
+                    m = (row_m[:, None] & col_m[None, :]).astype(img0.dtype)
+                    cnt = jnp.maximum(jnp.sum(m), 1.0)
+                    chans = img0[jnp.arange(c_out) * (oh * ow) + i * ow + j]
+                    row.append(jnp.sum(chans * m[None], axis=(1, 2)) / cnt)
+                out.append(jnp.stack(row, -1))
+            return jnp.stack(out, -2)  # (c_out, oh, ow)
+        return jax.vmap(one_roi)(x1, y1, rw, rh)
+    return apply_op("psroi_pool", fn, x, boxes)
+
+
+def _bilinear_sample_nchw(img, ygrid, xgrid):
+    """img: (C,H,W); grids: arbitrary equal shapes -> (C, *grid.shape)."""
+    C, H, W = img.shape
+    y0 = jnp.floor(ygrid).astype(jnp.int32)
+    x0 = jnp.floor(xgrid).astype(jnp.int32)
+    y1c = jnp.clip(y0 + 1, 0, H - 1)
+    x1c = jnp.clip(x0 + 1, 0, W - 1)
+    y0c = jnp.clip(y0, 0, H - 1)
+    x0c = jnp.clip(x0, 0, W - 1)
+    fy = ygrid - y0
+    fx = xgrid - x0
+    valid = ((ygrid > -1) & (ygrid < H) & (xgrid > -1)
+             & (xgrid < W)).astype(img.dtype)
+    i00 = img[:, y0c, x0c]
+    i01 = img[:, y0c, x1c]
+    i10 = img[:, y1c, x0c]
+    i11 = img[:, y1c, x1c]
+    top = i00 * (1 - fx) + i01 * fx
+    bot = i10 * (1 - fx) + i11 * fx
+    return (top * (1 - fy) + bot * fy) * valid
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """~ paddle.vision.ops.deform_conv2d
+    (operators/deformable_conv_op.cu): each kernel tap samples the input at
+    its regular position plus a learned per-pixel offset (v2 adds a
+    modulation mask). TPU lowering: one bilinear gather per kernel tap
+    (kh*kw fused gathers) followed by a dense 1x1 contraction on the MXU —
+    no im2col buffer materialized."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def fn(xv, off, w, *rest):
+        maskv = None
+        biasv = None
+        ri = 0
+        if mask is not None:
+            maskv = rest[ri]
+            ri += 1
+        if bias is not None:
+            biasv = rest[ri]
+        B, Cin, H, W = xv.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        Wo = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        base_y = jnp.arange(Ho) * st[0] - pd[0]
+        base_x = jnp.arange(Wo) * st[1] - pd[1]
+        # offsets: (B, 2*dg*kh*kw, Ho, Wo) ordered (y, x) per tap
+        off = off.reshape(B, deformable_groups, kh * kw, 2, Ho, Wo)
+        if maskv is not None:
+            maskv = maskv.reshape(B, deformable_groups, kh * kw, Ho, Wo)
+        cg = Cin // deformable_groups
+
+        def per_image(img, off_b, mask_b):
+            cols = []
+            for k in range(kh * kw):
+                ky, kx = divmod(k, kw)
+                groups_out = []
+                for dg in range(deformable_groups):
+                    yg = (base_y[:, None] + ky * dl[0]
+                          + off_b[dg, k, 0])
+                    xg = (base_x[None, :] + kx * dl[1]
+                          + off_b[dg, k, 1])
+                    sub = img[dg * cg:(dg + 1) * cg]
+                    samp = _bilinear_sample_nchw(sub, yg, xg)
+                    if mask_b is not None:
+                        samp = samp * mask_b[dg, k][None]
+                    groups_out.append(samp)
+                cols.append(jnp.concatenate(groups_out, 0))  # (Cin,Ho,Wo)
+            return jnp.stack(cols, 1)  # (Cin, kh*kw, Ho, Wo)
+
+        cols = jax.vmap(per_image)(
+            xv, off,
+            maskv if maskv is not None else jnp.zeros((B, 0, 0, 0, 0)),
+        ) if maskv is not None else jax.vmap(
+            lambda img, off_b: per_image(img, off_b, None))(xv, off)
+        # contraction: out[b,o,h,w] = sum_{ci,k} w[o,ci,k] * cols[b,ci,k,h,w]
+        wf = w.reshape(Cout, Cin_g * kh * kw)
+        if groups == 1:
+            colsf = cols.reshape(B, Cin * kh * kw, Ho, Wo)
+            out = jnp.einsum("ok,bkhw->bohw", wf, colsf)
+        else:
+            og = Cout // groups
+            outs = []
+            for g in range(groups):
+                colsg = cols[:, g * Cin_g:(g + 1) * Cin_g].reshape(
+                    B, Cin_g * kh * kw, Ho, Wo)
+                outs.append(jnp.einsum(
+                    "ok,bkhw->bohw", wf[g * og:(g + 1) * og], colsg))
+            out = jnp.concatenate(outs, 1)
+        if biasv is not None:
+            out = out + biasv[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("deform_conv2d", fn, *args)
+
+
+class DeformConv2D:
+    """~ paddle.nn / paddle.vision.ops.DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from ..core.tensor import Parameter
+        from ..core.generator import default_generator
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = (stride, padding, dilation, deformable_groups, groups)
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        limit = float(np.sqrt(6.0 / max(1, fan_in)))
+        key = default_generator().next_key()
+        self.weight = Parameter(jax.random.uniform(
+            key, (out_channels, in_channels // groups) + ks,
+            jnp.float32, -limit, limit))
+        self.bias = Parameter(jnp.zeros((out_channels,))) \
+            if bias_attr is not False else None
+
+    def __call__(self, x, offset, mask=None):
+        st, pd, dl, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias, st, pd, dl,
+                             dg, g, mask)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """~ paddle.vision.ops.read_file — raw bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, dtype=np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """~ paddle.vision.ops.decode_jpeg (CPU-side decode; the reference uses
+    nvjpeg on GPU — host decode feeds the TPU input pipeline)."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("decode_jpeg requires Pillow on the host") from e
+    import io as _io
+    buf = np.asarray(x._value if isinstance(x, Tensor) else x,
+                     dtype=np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(buf))
+    if mode.lower() == "gray":
+        img = img.convert("L")
+    elif mode.lower() == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """~ paddle.vision.ops.yolo_box (operators/detection/yolo_box_op): decode
+    YOLOv3 head predictions into boxes + per-class scores."""
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = len(an)
+
+    def fn(xv, imgs):
+        B, C, H, W = xv.shape
+        p = xv.reshape(B, na, 5 + class_num, H, W)
+        gx = (jnp.arange(W)[None, :] + 0.0)
+        gy = (jnp.arange(H)[:, None] + 0.0)
+        sig = jax.nn.sigmoid
+        bx = (sig(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / W
+        by = (sig(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / H
+        bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] \
+            / (W * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] \
+            / (H * downsample_ratio)
+        conf = sig(p[:, :, 4])
+        cls = sig(p[:, :, 5:])
+        score = conf[:, :, None] * cls
+        # to corner coords scaled by image size
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(B, -1, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(B, -1, class_num)
+        keep = (conf > conf_thresh).reshape(B, -1)
+        boxes = boxes * keep[..., None]
+        scores = scores * keep[..., None]
+        return boxes, scores
+    return apply_op("yolo_box", fn, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio=32, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0):
+    """~ paddle.vision.ops.yolo_loss (operators/detection/yolov3_loss_op):
+    YOLOv3 training loss — best-anchor assignment per gt, bce objectness
+    with ignore region, l1/bce box terms, bce class term."""
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    amask = list(anchor_mask)
+    an = an_all[amask]
+    na = len(amask)
+
+    def fn(xv, gtb, gtl):
+        B, C, H, W = xv.shape
+        p = xv.reshape(B, na, 5 + class_num, H, W)
+        sig = jax.nn.sigmoid
+        # decode predicted objectness for the ignore mask
+        bx = (sig(p[:, :, 0]) + jnp.arange(W)[None, :]) / W
+        by = (sig(p[:, :, 1]) + jnp.arange(H)[:, None]) / H
+        bw = jnp.exp(jnp.clip(p[:, :, 2], -10, 10)) \
+            * an[None, :, 0, None, None] / (W * downsample_ratio)
+        bh = jnp.exp(jnp.clip(p[:, :, 3], -10, 10)) \
+            * an[None, :, 1, None, None] / (H * downsample_ratio)
+        # gt: (B, G, 4) cxcywh normalized; labels: (B, G)
+        G = gtb.shape[1]
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)
+
+        # iou of each pred box with each gt (for ignore mask)
+        pb = jnp.stack([bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2],
+                       -1).reshape(B, -1, 4)
+        gb = jnp.stack([gtb[..., 0] - gtb[..., 2] / 2,
+                        gtb[..., 1] - gtb[..., 3] / 2,
+                        gtb[..., 0] + gtb[..., 2] / 2,
+                        gtb[..., 1] + gtb[..., 3] / 2], -1)
+        lt = jnp.maximum(pb[:, :, None, :2], gb[:, None, :, :2])
+        rb = jnp.minimum(pb[:, :, None, 2:], gb[:, None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        pa = (pb[..., 2] - pb[..., 0]) * (pb[..., 3] - pb[..., 1])
+        ga = (gb[..., 2] - gb[..., 0]) * (gb[..., 3] - gb[..., 1])
+        iou = inter / (pa[:, :, None] + ga[:, None] - inter + 1e-10)
+        iou = jnp.where(valid[:, None, :], iou, 0.0)
+        best_iou = jnp.max(iou, -1).reshape(B, na, H, W)
+        ignore = best_iou > ignore_thresh
+
+        # best anchor (within this mask) per gt by wh-iou
+        gw = gtb[..., 2] * W * downsample_ratio
+        gh = gtb[..., 3] * H * downsample_ratio
+        inter_a = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+                   * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+        union_a = (gw * gh)[..., None] \
+            + (an_all[:, 0] * an_all[:, 1])[None, None] - inter_a
+        anchor_iou = inter_a / (union_a + 1e-10)
+        best_anchor = jnp.argmax(anchor_iou, -1)  # (B, G) global anchor idx
+
+        # targets on the grid
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        obj_t = jnp.zeros((B, na, H, W))
+        tx = jnp.zeros((B, na, H, W))
+        ty = jnp.zeros((B, na, H, W))
+        tw = jnp.zeros((B, na, H, W))
+        th = jnp.zeros((B, na, H, W))
+        tcls = jnp.zeros((B, na, class_num, H, W))
+        bidx = jnp.arange(B)[:, None].repeat(G, 1)
+        for mi, a_global in enumerate(amask):
+            sel = valid & (best_anchor == a_global)
+            w_sel = sel.astype(jnp.float32)
+            obj_t = obj_t.at[bidx, mi, gj, gi].max(w_sel)
+            tx = tx.at[bidx, mi, gj, gi].add(
+                w_sel * (gtb[..., 0] * W - gi))
+            ty = ty.at[bidx, mi, gj, gi].add(
+                w_sel * (gtb[..., 1] * H - gj))
+            tw = tw.at[bidx, mi, gj, gi].add(w_sel * jnp.log(
+                jnp.maximum(gw / an_all[a_global, 0], 1e-9)))
+            th = th.at[bidx, mi, gj, gi].add(w_sel * jnp.log(
+                jnp.maximum(gh / an_all[a_global, 1], 1e-9)))
+            tcls = tcls.at[bidx, mi, gtl.astype(jnp.int32), gj, gi].max(
+                w_sel)
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        pos = obj_t
+        scale = 2.0 - (tw * 0 + 1) * 0  # box loss weight ~ (2 - w*h) omitted
+        loss_xy = pos * (bce(p[:, :, 0], tx) + bce(p[:, :, 1], ty))
+        loss_wh = pos * (jnp.abs(p[:, :, 2] - tw)
+                         + jnp.abs(p[:, :, 3] - th))
+        noobj = (1 - pos) * (1 - ignore.astype(jnp.float32))
+        loss_obj = pos * bce(p[:, :, 4], jnp.ones_like(pos)) \
+            + noobj * bce(p[:, :, 4], jnp.zeros_like(pos))
+        loss_cls = pos[:, :, None] * bce(p[:, :, 5:], tcls)
+        total = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                 + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+        return total
+    return apply_op("yolo_loss", fn, x, gt_box, gt_label)

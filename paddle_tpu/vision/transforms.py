@@ -128,3 +128,309 @@ class BrightnessTransform:
     def __call__(self, x):
         alpha = 1 + np.random.uniform(-self.value, self.value)
         return np.clip(x * alpha, 0, 1).astype(np.float32)
+
+
+# ---- functional API --------------------------------------------------------
+# ~ python/paddle/vision/transforms/functional.py (+functional_cv2.py):
+# host-side numpy ops on CHW float arrays, composed in DataLoader workers.
+
+def _chw(x):
+    x = np.asarray(x)
+    if x.ndim == 2:
+        x = x[None]
+    return x
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(_chw(img))
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    return np.pad(_chw(img), [(0, 0), (t, b), (l, r)], mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    return _chw(img)[:, top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(_chw(img))
+
+
+def hflip(img):
+    return _chw(img)[:, :, ::-1].copy()
+
+
+def vflip(img):
+    return _chw(img)[:, ::-1].copy()
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(np.asarray(img,
+                                                        dtype=np.float32))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    from scipy import ndimage
+    x = _chw(img)
+    order = 0 if interpolation == "nearest" else 1
+    out = ndimage.rotate(x, -angle, axes=(2, 1), reshape=expand, order=order,
+                         mode="constant", cval=fill)
+    return out.astype(x.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    x = _chw(img).astype(np.float32)
+    if x.shape[0] >= 3:
+        g = (0.299 * x[0] + 0.587 * x[1] + 0.114 * x[2])[None]
+    else:
+        g = x[:1]
+    return np.repeat(g, num_output_channels, axis=0)
+
+
+def adjust_brightness(img, brightness_factor):
+    x = _chw(img).astype(np.float32)
+    hi = 1.0 if x.max() <= 1.5 else 255.0
+    return np.clip(x * brightness_factor, 0, hi)
+
+
+def adjust_contrast(img, contrast_factor):
+    x = _chw(img).astype(np.float32)
+    hi = 1.0 if x.max() <= 1.5 else 255.0
+    mean = to_grayscale(x)[0].mean()
+    return np.clip(mean + contrast_factor * (x - mean), 0, hi)
+
+
+def adjust_saturation(img, saturation_factor):
+    x = _chw(img).astype(np.float32)
+    hi = 1.0 if x.max() <= 1.5 else 255.0
+    gray = to_grayscale(x, x.shape[0])
+    return np.clip(gray + saturation_factor * (x - gray), 0, hi)
+
+
+def _rgb_to_hsv(x):
+    r, g, b = x[0], x[1], x[2]
+    mx = np.max(x[:3], axis=0)
+    mn = np.min(x[:3], axis=0)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b) / diff)[m] % 6
+    m = mx == g
+    h[m] = ((b - r) / diff + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / diff + 4)[m]
+    h = h / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    return np.stack([h, s, mx])
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[0] * 6.0, hsv[1], hsv[2]
+    i = np.floor(h).astype(np.int32) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    choices = [np.stack(c) for c in
+               [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+                (v, p, q)]]
+    out = np.zeros_like(np.stack([v, v, v]))
+    for k in range(6):
+        out = np.where(i == k, choices[k], out)
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    x = _chw(img).astype(np.float32)
+    scaled = x.max() > 1.5
+    y = x / 255.0 if scaled else x
+    hsv = _rgb_to_hsv(y)
+    hsv[0] = (hsv[0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv)
+    return (out * 255.0 if scaled else out).astype(x.dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    x = _chw(img) if inplace else _chw(img).copy()
+    x[:, i:i + h, j:j + w] = v
+    return x
+
+
+# ---- transform classes -----------------------------------------------------
+
+class BaseTransform:
+    """~ python/paddle/vision/transforms/transforms.py BaseTransform: keyed
+    multi-input transforms; subclasses implement _apply_image (and
+    optionally _apply_{coords,boxes,mask})."""
+
+    def __init__(self, keys=None):
+        self.keys = keys if keys is not None else ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            self.params = self._get_params(inputs)
+            out = []
+            for key, data in zip(self.keys, inputs):
+                apply = getattr(self, f"_apply_{key}", None)
+                out.append(apply(data) if apply else data)
+            return tuple(out)
+        self.params = self._get_params((inputs,))
+        return self._apply_image(inputs)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _chw(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = min(value, 0.5)
+
+    def _apply_image(self, img):
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """~ transforms.ColorJitter: random brightness/contrast/saturation/hue
+    in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    """~ transforms.RandomErasing (cutout regularization)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        x = _chw(img)
+        if np.random.rand() >= self.prob:
+            return x
+        c, h, w = x.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = np.random.standard_normal((c, eh, ew)).astype(x.dtype) \
+                    if self.value == "random" else self.value
+                return erase(x, i, j, eh, ew, v, self.inplace)
+        return x

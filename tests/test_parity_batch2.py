@@ -193,3 +193,89 @@ class TestIoTextUtils:
             return 5
         with pytest.warns(DeprecationWarning):
             assert old_fn() == 5
+
+
+class TestVisionOps:
+    def test_roi_pools(self):
+        import paddle_tpu.vision.ops as vops
+        x = paddle.randn([1, 4, 16, 16])
+        boxes = paddle.to_tensor(
+            np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
+        bn = paddle.to_tensor(np.array([2], np.int32))
+        assert vops.roi_pool(x, boxes, bn, 2).shape == [2, 4, 2, 2]
+        assert vops.RoIAlign(2)(x, boxes, bn).shape == [2, 4, 2, 2]
+        xp = paddle.randn([1, 8 * 4, 16, 16])
+        assert vops.PSRoIPool(2)(xp, boxes, bn).shape == [2, 8, 2, 2]
+
+    def test_deform_conv_zero_offset_equals_conv(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.vision.ops as vops
+        x = paddle.randn([2, 3, 8, 8])
+        w = paddle.randn([6, 3, 3, 3])
+        off = paddle.zeros([2, 18, 6, 6])
+        out = vops.deform_conv2d(x, off, w)
+        dn = jax.lax.conv_dimension_numbers(
+            x._value.shape, w._value.shape, ("NCHW", "OIHW", "NCHW"))
+        ref = jax.lax.conv_general_dilated(
+            x._value, w._value, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=dn)
+        assert float(jnp.abs(out._value - ref).max()) < 1e-4
+
+    def test_deform_conv_offset_shifts(self):
+        import paddle_tpu.vision.ops as vops
+        # constant offset (0, 1) shifts sampling one pixel right
+        x = paddle.to_tensor(
+            np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+        w = paddle.ones([1, 1, 1, 1])
+        off = np.zeros((1, 2, 5, 5), np.float32)
+        off[:, 1] = 1.0  # x-offset
+        out = vops.deform_conv2d(x, paddle.to_tensor(off), w)
+        ref = np.pad(x.numpy()[0, 0, :, 1:], ((0, 0), (0, 1)))
+        np.testing.assert_allclose(out.numpy()[0, 0], ref, atol=1e-5)
+
+    def test_yolo_box_and_loss(self):
+        import paddle_tpu.vision.ops as vops
+        p = paddle.randn([2, 3 * 9, 8, 8])
+        img = paddle.to_tensor(np.array([[256, 256], [256, 256]], np.int32))
+        boxes, scores = vops.yolo_box(p, img, [10, 13, 16, 30, 33, 23], 4,
+                                      0.01)
+        assert boxes.shape == [2, 192, 4] and scores.shape == [2, 192, 4]
+        assert (boxes.numpy() >= 0).all() and (boxes.numpy() <= 255).all()
+        gtb = paddle.to_tensor(
+            np.random.uniform(0.2, 0.6, (2, 5, 4)).astype(np.float32))
+        gtl = paddle.to_tensor(np.random.randint(0, 4, (2, 5)))
+        loss = vops.yolo_loss(p, gtb, gtl,
+                              [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59,
+                               119], [0, 1, 2], 4, 0.7)
+        assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+
+
+class TestVisionTransforms:
+    def test_functional(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(3, 16, 16).astype(np.float32)
+        assert T.hflip(img).shape == (3, 16, 16)
+        np.testing.assert_allclose(T.vflip(T.vflip(img)), img)
+        assert T.pad(img, 2).shape == (3, 20, 20)
+        assert T.crop(img, 2, 2, 8, 8).shape == (3, 8, 8)
+        assert T.rotate(img, 45).shape == (3, 16, 16)
+        assert T.to_grayscale(img).shape == (1, 16, 16)
+        b = T.adjust_brightness(img, 2.0)
+        assert b.max() <= 1.0 + 1e-6
+        hsv_rt = T._hsv_to_rgb(T._rgb_to_hsv(img))
+        np.testing.assert_allclose(hsv_rt, img, atol=1e-5)
+        h = T.adjust_hue(img, 0.25)
+        assert h.shape == img.shape and not np.allclose(h, img)
+
+    def test_classes(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(3, 16, 16).astype(np.float32)
+        assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+        erased = T.RandomErasing(prob=1.0)(img)
+        assert (erased == 0).any()
+        assert T.RandomRotation(30)(img).shape == img.shape
+        assert T.Grayscale(3)(img).shape == img.shape
+        out = T.RandomVerticalFlip(prob=1.0)(img)
+        np.testing.assert_allclose(out, img[:, ::-1])
+        assert T.Pad([1, 2])(img).shape == (3, 20, 18)
