@@ -55,3 +55,32 @@ def _spawn_entry(func, args, env):
     import os
     os.environ.update(env)
     func(*args)
+from . import launch  # noqa: F401
+from .entry_attr import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
+    QueueDataset, ShowClickEntry,
+)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """~ paddle.distributed.gloo_init_parallel_env: CPU-collective bootstrap.
+    Maps to the same coordinator init as init_parallel_env (jax.distributed
+    is transport-agnostic; gloo's role — CPU rendezvous/barrier — is played
+    by the coordinator service)."""
+    import os
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    from .parallel import init_parallel_env
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    """~ paddle.distributed.gloo_barrier — host-level barrier."""
+    from .collective import barrier
+    return barrier()
+
+
+def gloo_release():
+    """~ paddle.distributed.gloo_release — tear down CPU rendezvous state."""
+    return None

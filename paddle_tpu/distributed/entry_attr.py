@@ -1,0 +1,163 @@
+"""Sparse-table entry policies + file datasets for the PS data pipeline.
+
+~ python/paddle/distributed/entry_attr.py (ProbabilityEntry:*, CountFilterEntry)
+and python/paddle/distributed/fleet/dataset/dataset.py (InMemoryDataset:*,
+QueueDataset). The reference's datasets drive C++ DataFeed readers
+(framework/data_feed.h) over file lists; here the same API surface feeds the
+native threaded batch loader (csrc/batch_loader.cc) / python fallback.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class EntryAttr:
+    """Base for sparse-embedding entry policies (when a new key is admitted
+    to the table)."""
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit new sparse keys with fixed probability."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a sparse key once it has been seen >= count times."""
+
+    def __init__(self, count):
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        self.count = count
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count}"
+
+
+class ShowClickEntry(EntryAttr):
+    """Track show/click stats per key (CTR accessors)."""
+
+    def __init__(self, show_name, click_name):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+class _FileListDataset:
+    """Shared file-list plumbing (~ fleet/dataset/dataset.py DatasetBase)."""
+
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var = []
+        self._pipe_command = None
+        self._parse_fn = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_parse_fn(self, fn):
+        """Line -> sample parser (the data_generator role)."""
+        self._parse_fn = fn
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if not line:
+                        continue
+                    yield self._parse_fn(line) if self._parse_fn \
+                        else line
+
+    def _batches(self, samples):
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self._batch_size:
+                yield _stack_batch(buf)
+                buf = []
+        if buf:
+            yield _stack_batch(buf)
+
+
+def _stack_batch(samples):
+    if isinstance(samples[0], (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples])
+                     for i in range(len(samples[0])))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class InMemoryDataset(_FileListDataset):
+    """~ fleet InMemoryDataset: load file list into memory, global shuffle,
+    then iterate batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self):
+        np.random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host form of the PS global shuffle
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        return self._batches(iter(self._samples))
+
+
+class QueueDataset(_FileListDataset):
+    """~ fleet QueueDataset: streaming file reader (no in-memory buffer)."""
+
+    def __iter__(self):
+        return self._batches(self._iter_lines())
+
+
+class ParallelMode:
+    """~ python/paddle/distributed/parallel.py ParallelMode enum."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3

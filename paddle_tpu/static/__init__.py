@@ -17,6 +17,17 @@ from .executor import (Executor, CompiledProgram, Scope, global_scope,  # noqa
                        scope_guard)
 from .io import save_inference_model, load_inference_model  # noqa: F401
 from . import nn  # noqa: F401
+from .extras import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, ExponentialMovingAverage,
+    IpuCompiledProgram, IpuStrategy, ParallelExecutor, Print,
+    WeightNormParamAttr, accuracy, auc, cpu_places, create_global_var,
+    create_parameter, cuda_places, deserialize_persistables,
+    deserialize_program, device_guard, ipu_shard_guard, load, load_from_file,
+    load_program_state, mlu_places, normalize_program, npu_places, save,
+    save_to_file, serialize_persistables, serialize_program,
+    set_program_state, xpu_places,
+)
+from .nn import py_func  # noqa: F401
 
 Variable = StaticVar
 

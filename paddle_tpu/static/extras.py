@@ -1,0 +1,372 @@
+"""paddle.static namespace fillers: strategies, EMA, places, program state
+serialization, host-print.
+
+~ python/paddle/static/__init__.py re-exports backed by fluid/framework.py,
+compiler.py, fluid/io.py. Program "serialization" here pickles the parameter
+set (the graph itself is re-captured from Python — the TPU design has no
+protobuf ProgramDesc; StableHLO export in static/io.py is the compiled-program
+artifact)."""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from . import graph as G
+
+
+class BuildStrategy:
+    """~ BuildStrategy (framework/details/build_strategy.h): graph-build
+    knobs. XLA owns fusion/memory planning, so these are accepted and
+    recorded; reduce_strategy etc. remain meaningful to the distributed
+    wrappers that read them."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_broadcast_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.memory_optimize = None
+        self.enable_inplace = False
+        self.build_cinn_pass = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """~ ExecutionStrategy: executor scheduling knobs (XLA schedules)."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = True
+
+
+class ParallelExecutor:
+    """~ fluid.ParallelExecutor (framework/parallel_executor.h) — legacy
+    multi-device wrapper. Maps onto the jit Executor: XLA + mesh sharding
+    replace SSA-graph replication; kept for API compat."""
+
+    def __init__(self, use_cuda=None, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        from .executor import Executor
+        self._program = main_program or G.default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+class CompiledProgramExtras:
+    pass
+
+
+class WeightNormParamAttr:
+    """~ paddle.static.WeightNormParamAttr (fluid/param_attr.py)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """~ paddle.static.ExponentialMovingAverage (fluid/optimizer.py:...):
+    shadow = decay * shadow + (1 - decay) * param, with apply()/restore()
+    context for eval-time parameter swapping."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, program=None):
+        prog = program or G.default_main_program()
+        self._step += 1
+        decay = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in prog.all_parameters():
+            key = id(p)
+            cur = np.asarray(p._value)
+            if key not in self._shadow:
+                self._shadow[key] = cur.copy()
+            else:
+                self._shadow[key] = (decay * self._shadow[key]
+                                     + (1 - decay) * cur)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        prog = G.default_main_program()
+        for p in prog.all_parameters():
+            key = id(p)
+            if key in self._shadow:
+                self._backup[key] = p._value
+                p._value = jnp.asarray(self._shadow[key])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        prog = G.default_main_program()
+        for p in prog.all_parameters():
+            key = id(p)
+            if key in self._backup:
+                p._value = self._backup.pop(key)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """~ paddle.static.Print (operators/print_op): identity + host print."""
+    vals = np.asarray(input._value)
+    head = message or "Var"
+    parts = [head]
+    if print_tensor_name:
+        parts.append(f"name={input.name}")
+    if print_tensor_shape:
+        parts.append(f"shape={list(vals.shape)}")
+    if print_tensor_type:
+        parts.append(f"dtype={vals.dtype}")
+    flat = vals.reshape(-1)[:summarize]
+    parts.append(f"data={flat.tolist()}")
+    print("  ".join(str(p) for p in parts))
+    return input
+
+
+# ---- metric helpers --------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """~ paddle.static.accuracy (metrics.py)."""
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(x, y):
+        topk = jnp.argsort(-x, axis=-1)[..., :k]
+        y = y.reshape(-1, 1)
+        hit = jnp.any(topk == y, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply_op("accuracy", fn, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """~ paddle.static.auc — single-batch AUC (host; the reference
+    accumulates stat tensors across batches, covered by metric.Auc)."""
+    from ..metric import Auc as _Auc
+    m = _Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input._value), np.asarray(label._value))
+    val = m.accumulate()
+    return (Tensor(np.float32(val)), Tensor(np.float32(val)),
+            Tensor(np.zeros(1)), Tensor(np.zeros(1)), Tensor(np.zeros(1)),
+            Tensor(np.zeros(1)))
+
+
+# ---- places ----------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import CUDAPlace, device_count as _dc
+    ids = device_ids if device_ids is not None else range(_dc())
+    return [CUDAPlace(i) for i in ids]
+
+
+def npu_places(device_ids=None):
+    from ..core.place import NPUPlace, device_count as _dc
+    ids = device_ids if device_ids is not None else range(_dc())
+    return [NPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..core.place import XPUPlace, device_count as _dc
+    ids = device_ids if device_ids is not None else range(_dc())
+    return [XPUPlace(i) for i in ids]
+
+
+def mlu_places(device_ids=None):
+    return npu_places(device_ids)
+
+
+# ---- global vars / parameters ----------------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+    from ..core import dtype as dtypes
+    v = Parameter(jnp.full([int(s) for s in shape], value,
+                           dtypes.convert_dtype(dtype)))
+    v.persistable = persistable
+    if name:
+        v.name = name
+    v.stop_gradient = True
+    G.default_main_program()._add_param(v)
+    return v
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.misc import create_parameter as _cp
+    p = _cp(shape, dtype, name=name, attr=attr,
+            default_initializer=default_initializer)
+    G.default_main_program()._add_param(p)
+    return p
+
+
+# ---- guards ----------------------------------------------------------------
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """~ paddle.static.device_guard: pins ops to a device. XLA handles
+    placement inside one program; host pinning maps to jax.default_device."""
+    import jax
+    if device in (None, "cpu"):
+        dev = jax.devices("cpu")[0] if device == "cpu" else None
+    else:
+        dev = jax.devices()[0]
+    if dev is None:
+        yield
+    else:
+        with jax.default_device(dev):
+            yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+class IpuStrategy:
+    """Capability slot for the reference's Graphcore backend
+    (python/paddle/fluid/compiler.py IpuStrategy) — config container only;
+    this framework's accelerator is the TPU."""
+
+    def __init__(self):
+        self.num_ipus = 1
+        self.is_training = True
+        self.micro_batch_size = 1
+        self.enable_manual_shard = False
+
+    def set_graph_config(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def set_pipelining_config(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        raise RuntimeError(
+            "IpuCompiledProgram targets Graphcore IPUs; this framework "
+            "compiles for TPU via static.CompiledProgram / jax.jit")
+
+
+# ---- program state io ------------------------------------------------------
+
+def _program_state(program=None):
+    prog = program or G.default_main_program()
+    state = {}
+    for i, p in enumerate(prog.all_parameters()):
+        state[p.name or f"param_{i}"] = np.asarray(p._value)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams" if not model_path.endswith(".pdparams")
+              else model_path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+    prog = program or G.default_main_program()
+    for i, p in enumerate(prog.all_parameters()):
+        key = p.name or f"param_{i}"
+        if key in state_dict:
+            p._value = jnp.asarray(state_dict[key])
+    return program
+
+
+def save(program, model_path, protocol=4):
+    """~ paddle.static.save — persist program parameters (+ a manifest)."""
+    state = _program_state(program)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump({"n_params": len(state), "names": list(state)}, f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    state = load_program_state(model_path)
+    set_program_state(program, state)
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    prog = program or G.default_main_program()
+    return pickle.dumps({"names": [p.name for p in prog.all_parameters()],
+                         "datas": list(prog._datas)})
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
+    return pickle.dumps(_program_state(program))
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    set_program_state(program, pickle.loads(data))
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """~ paddle.static.normalize_program — prune to the feed->fetch
+    subgraph; our Program is already the captured minimal DAG, so this
+    returns an inference clone."""
+    return program.clone(for_test=True)

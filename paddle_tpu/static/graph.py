@@ -282,9 +282,11 @@ def capture(name: str, fn, args, kwargs):
     prog = default_main_program()
 
     abstract = []
-    for a in args:
+    tensor_idx = []
+    for i, a in enumerate(args):
         if _is_symbolic(a):
             abstract.append(a.aval())
+            tensor_idx.append(i)
         elif isinstance(a, Tensor):
             if isinstance(a, Parameter):
                 prog._add_param(a)
@@ -292,10 +294,17 @@ def capture(name: str, fn, args, kwargs):
                 default_startup_program()._add_param(a)
             abstract.append(jax.ShapeDtypeStruct(
                 tuple(a._value.shape), a._value.dtype))
-        else:
-            abstract.append(a)
+            tensor_idx.append(i)
 
-    out_aval = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *abstract)
+    def _infer(*xs):
+        # non-tensor args (shape lists, axes, scalars) stay static — they
+        # are op attributes, not data (~ OpDesc attrs vs inputs)
+        merged = list(args)
+        for j, x in zip(tensor_idx, xs):
+            merged[j] = x
+        return fn(*merged, **kwargs)
+
+    out_aval = jax.eval_shape(_infer, *abstract)
     single = not isinstance(out_aval, (tuple, list))
     avals = (out_aval,) if single else tuple(out_aval)
 

@@ -84,3 +84,570 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     if act:
         out = getattr(F, act)(out)
     return out
+
+
+# ---- conv / norm family ----------------------------------------------------
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    from .. import nn
+    from ..nn import functional as F
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    if filter_size is None:
+        # derive kernel from requested output size (reference behavior)
+        osz = (output_size, output_size) if isinstance(output_size, int) \
+            else tuple(output_size)
+        st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        pd = (padding, padding) if isinstance(padding, int) \
+            else tuple(padding)
+        in_sp = input.shape[2:] if data_format == "NCHW" else input.shape[1:3]
+        filter_size = tuple(
+            osz[i] - (in_sp[i] - 1) * st[i] + 2 * pd[i] for i in range(2))
+    layer = _park(nn.Conv2DTranspose(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    from .. import nn
+    from ..nn import functional as F
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _park(nn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None):
+    from .. import nn
+    from ..nn import functional as F
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _park(nn.Conv3DTranspose(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+    from ..nn import functional as F
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = _park(nn.LayerNorm(shape, epsilon=epsilon))
+    if not scale:
+        layer.weight = None
+    if not shift:
+        layer.bias = None
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    from ..nn import functional as F
+    nc = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _park(nn.GroupNorm(groups, nc, epsilon=epsilon))
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+    nc = input.shape[1]
+    cls = {3: nn.InstanceNorm1D, 4: nn.InstanceNorm2D,
+           5: nn.InstanceNorm3D}[input.ndim]
+    return _park(cls(nc, epsilon=epsilon))(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, **kwargs):
+    """~ static.nn.data_norm: normalization by accumulated batch statistics
+    (PS-style CTR models). Single-program form: batch statistics."""
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(x):
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=0, keepdims=True)
+        return (x - mean) / jnp.sqrt(var + epsilon)
+    out = apply_op("data_norm", fn, input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layer.norm import spectral_normalize
+    return spectral_normalize(weight, dim=dim, power_iters=power_iters,
+                              eps=eps)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..core.tensor import Parameter
+    from ..ops import activation as A
+    import jax.numpy as jnp
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:
+        n = int(np.prod(x.shape[1:]))
+    alpha = Parameter(jnp.full((n,), 0.25, jnp.float32))
+    G.default_main_program()._layers.append(alpha)
+    if mode == "channel" and data_format == "NCHW":
+        from ..ops.manipulation import reshape
+        a = reshape(alpha, [1, n] + [1] * (x.ndim - 2))
+    else:
+        a = alpha
+    return A.prelu(x, a)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    from .. import nn
+    layer = _park(nn.Bilinear(x.shape[-1], y.shape[-1], size))
+    out = layer(x, y)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+    layer = _park(DeformConv2D(input.shape[1], num_filters, filter_size,
+                               stride, padding, dilation, deformable_groups,
+                               groups, param_attr, bias_attr))
+    return layer(input, offset, mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """~ static.nn.row_conv (lookahead conv, Deep Speech): each step mixes
+    the next ``future_context_size`` steps per feature channel."""
+    from ..core.tensor import Parameter
+    from ..ops.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+    d = input.shape[-1]
+    k = future_context_size + 1
+    w = Parameter(jnp.full((k, d), 1.0 / k, jnp.float32))
+    G.default_main_program()._layers.append(w)
+
+    def fn(x, wv):
+        # x: (B, T, D)
+        xp = jnp.pad(x, [(0, 0), (0, k - 1), (0, 0)])
+        out = jnp.zeros_like(x)
+        for i in range(k):
+            out = out + xp[:, i:i + x.shape[1]] * wv[i]
+        return out
+    out = apply_op("row_conv", fn, input, w)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """~ static.nn.crf_decoding — viterbi path over emissions. The
+    transition matrix is the learned CRF parameter (created here when not
+    passed, like the reference's LayerHelper parameter)."""
+    from ..core.tensor import Parameter
+    from ..text import viterbi_decode
+    import jax.numpy as jnp
+    n = input.shape[-1]
+    if transition is None:
+        transition = Parameter(jnp.zeros((n, n), jnp.float32))
+        G.default_main_program()._layers.append(transition)
+    emis = input if input.ndim == 3 else input[None]
+    scores, path = viterbi_decode(emis, transition, lengths=length)
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=5, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """~ static.nn.nce (noise-contrastive estimation, operators/nce_op):
+    logistic loss on the true class + sampled negatives."""
+    from ..core.tensor import Parameter
+    from ..core.generator import default_generator
+    from ..ops.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+    d = input.shape[-1]
+    w = Parameter(jax.random.normal(default_generator().next_key(),
+                                    (num_total_classes, d)) * 0.01)
+    b = Parameter(jnp.zeros((num_total_classes,)))
+    G.default_main_program()._layers.extend([w, b])
+    neg = jax.random.randint(default_generator().next_key(),
+                             (num_neg_samples,), 0, num_total_classes)
+
+    def fn(x, lab, wv, bv):
+        lab = lab.reshape(-1)
+        pos_logit = jnp.sum(x * wv[lab], -1) + bv[lab]
+        neg_logit = x @ wv[neg].T + bv[neg]        # (B, S)
+
+        def softplus(z):
+            return jnp.maximum(z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        loss = softplus(-pos_logit) + jnp.sum(softplus(neg_logit), -1)
+        return loss[:, None]
+    return apply_op("nce", fn, input, label, w, b)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """~ static.nn.sparse_embedding — the PS-backed large-table embedding
+    slot; single-host form is a dense table (the distributed table lives in
+    distributed.ps)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """~ static.nn.py_func (operators/py_func_op): host-python op. Eager
+    semantics: call through (jax.pure_callback inside jit programs)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """~ static.nn.multi_box_head (SSD detection head,
+    python/paddle/fluid/layers/detection.py): per-feature-map loc/conf conv
+    heads + prior boxes."""
+    from .. import nn
+    from ..ops.manipulation import concat, reshape, transpose
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    n_layers = len(inputs)
+    if min_sizes is None:
+        min_ratio, max_ratio = int(min_ratio), int(max_ratio)
+        step = int((max_ratio - min_ratio) / max(1, n_layers - 2))
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n_layers - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n_layers - 1]
+    locs, confs, priors, vars_ = [], [], [], []
+    img_h = image.shape[2]
+    img_w = image.shape[3]
+    for i, feat in enumerate(inputs):
+        ars = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                             (list, tuple)) \
+            else [aspect_ratios[i]]
+        n_prior = len([a for a in ars if a != 1]) * (2 if flip else 1) + 2
+        h, w = feat.shape[2], feat.shape[3]
+        loc_head = _park(nn.Conv2D(feat.shape[1], n_prior * 4, kernel_size,
+                                   padding=pad, stride=stride))
+        conf_head = _park(nn.Conv2D(feat.shape[1], n_prior * num_classes,
+                                    kernel_size, padding=pad, stride=stride))
+        loc = loc_head(feat)
+        conf = conf_head(feat)
+        locs.append(reshape(transpose(loc, [0, 2, 3, 1]),
+                            [loc.shape[0], -1, 4]))
+        confs.append(reshape(transpose(conf, [0, 2, 3, 1]),
+                             [conf.shape[0], -1, num_classes]))
+        step_w = steps[i] if steps else img_w / w
+        step_h = steps[i] if steps else img_h / h
+        cx = (np.arange(w) + offset) * step_w / img_w
+        cy = (np.arange(h) + offset) * step_h / img_h
+        cxg, cyg = np.meshgrid(cx, cy)
+        smin = min_sizes[i] / base_size
+        smax = (max_sizes[i] / base_size) if max_sizes else smin
+        sizes = [(smin, smin), (float(np.sqrt(smin * smax)),) * 2]
+        for ar in ars:
+            if ar == 1:
+                continue
+            sizes.append((smin * np.sqrt(ar), smin / np.sqrt(ar)))
+            if flip:
+                sizes.append((smin / np.sqrt(ar), smin * np.sqrt(ar)))
+        boxes = []
+        for (sw, sh) in sizes[:n_prior]:
+            boxes.append(np.stack([cxg - sw / 2, cyg - sh / 2,
+                                   cxg + sw / 2, cyg + sh / 2], -1))
+        pb = np.stack(boxes, 2).reshape(-1, 4).astype(np.float32)
+        if clip:
+            pb = pb.clip(0, 1)
+        priors.append(pb)
+        vars_.append(np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32),
+                             (len(pb), 1)))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    box = Tensor(jnp.asarray(np.concatenate(priors)))
+    var = Tensor(jnp.asarray(np.concatenate(vars_)))
+    return mbox_locs, mbox_confs, box, var
+
+
+# ---- sequence ops ----------------------------------------------------------
+# The reference's sequence_* ops act on LoD (ragged) tensors
+# (paddle/fluid/operators/sequence_ops/). TPU-native representation: padded
+# dense (B, T, ...) plus an optional lengths vector — the static-shape form
+# XLA requires; lengths default to full T.
+
+def _seq_mask(x, length):
+    import jax.numpy as jnp
+    B, T = x.shape[0], x.shape[1]
+    if length is None:
+        return jnp.ones((B, T), bool)
+    lv = length._value if hasattr(length, "_value") else jnp.asarray(length)
+    return jnp.arange(T)[None, :] < lv[:, None]
+
+
+def sequence_pool(input, pool_type="sum", length=None, pad_value=0.0):
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(x, *rest):
+        m = _seq_mask(x, rest[0] if rest else None)
+        mf = m.astype(x.dtype)
+        while mf.ndim < x.ndim:
+            mf = mf[..., None]
+        pt = pool_type.lower()
+        if pt == "sum":
+            return jnp.sum(x * mf, 1)
+        if pt in ("average", "mean"):
+            return jnp.sum(x * mf, 1) / jnp.maximum(mf.sum(1), 1.0)
+        if pt == "sqrt":
+            return jnp.sum(x * mf, 1) / jnp.sqrt(jnp.maximum(mf.sum(1), 1.0))
+        if pt == "max":
+            neg = jnp.finfo(x.dtype).min
+            return jnp.max(jnp.where(mf > 0, x, neg), 1)
+        if pt == "last":
+            if rest:
+                idx = jnp.clip(rest[0].astype(jnp.int32) - 1, 0, None)
+            else:
+                idx = jnp.full((x.shape[0],), x.shape[1] - 1, jnp.int32)
+            sel = idx.reshape(-1, *([1] * (x.ndim - 1)))
+            return jnp.take_along_axis(
+                x, jnp.broadcast_to(sel, (x.shape[0], 1) + x.shape[2:]),
+                1)[:, 0]
+        if pt == "first":
+            return x[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type}")
+    args = [input] + ([length] if length is not None else [])
+    return apply_op("sequence_pool", fn, *args)
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_softmax(input, length=None):
+    from ..ops.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x, *rest):
+        m = _seq_mask(x, rest[0] if rest else None)
+        while m.ndim < x.ndim:
+            m = m[..., None]
+        neg = jnp.finfo(x.dtype).min
+        return jax.nn.softmax(jnp.where(m, x, neg), axis=1)
+    args = [input] + ([length] if length is not None else [])
+    return apply_op("sequence_softmax", fn, *args)
+
+
+def sequence_reverse(x, length=None, name=None):
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(v, *rest):
+        if not rest:
+            return jnp.flip(v, 1)
+        lv = rest[0].astype(jnp.int32)
+        T = v.shape[1]
+        idx = jnp.arange(T)[None, :]
+        rev = jnp.where(idx < lv[:, None], lv[:, None] - 1 - idx, idx)
+        sel = rev.reshape(rev.shape + (1,) * (v.ndim - 2))
+        sel = jnp.broadcast_to(sel, v.shape)
+        return jnp.take_along_axis(v, sel, 1)
+    args = [x] + ([length] if length is not None else [])
+    return apply_op("sequence_reverse", fn, *args)
+
+
+def sequence_concat(input, name=None):
+    from ..ops.manipulation import concat
+    return concat(list(input), axis=1)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x to y's time length (padded-form expand)."""
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(xv, yv):
+        reps = yv.shape[1]
+        return jnp.repeat(xv[:, None], reps, 1) if xv.ndim == 2 \
+            else jnp.repeat(xv, reps // xv.shape[1], 1)
+    return apply_op("sequence_expand", fn, x, y)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """(B, T, ...) dense input; pads/trims to maxlen, returns
+    (padded, lengths) like the reference."""
+    from ..ops.dispatch import apply_op
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    def fn(v, pv):
+        T = v.shape[1]
+        target = maxlen or T
+        if target > T:
+            return jnp.pad(v, [(0, 0), (0, target - T)]
+                           + [(0, 0)] * (v.ndim - 2))
+        return v[:, :target]
+    padded = apply_op("sequence_pad", fn, x, pad_value)
+    lengths = Tensor(jnp.full((x.shape[0],),
+                              min(x.shape[1], maxlen or x.shape[1]),
+                              jnp.int32))
+    return padded, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """Trim to max(length) (static-shape trim; per-row raggedness remains
+    masked)."""
+    from ..ops.dispatch import apply_op
+    import numpy as _np
+    lv = length.numpy() if hasattr(length, "numpy") else _np.asarray(length)
+    tmax = int(lv.max()) if lv.size else 0
+    return apply_op("sequence_unpad", lambda v, l: v[:, :tmax], x, length)
+
+
+def sequence_reshape(input, new_dim):
+    from ..ops.manipulation import reshape
+    return reshape(input, [input.shape[0], -1, new_dim])
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(v):
+        T = v.shape[1]
+        vp = jnp.pad(v, [(0, 0), (0, win_size - 1)],
+                     constant_values=pad_value)
+        return jnp.stack([vp[:, i:i + T] for i in range(win_size)], -1)
+    return apply_op("sequence_enumerate", fn, input, nondiff=True)
+
+
+def sequence_slice(input, offset, length, name=None):
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(v, off, ln):
+        T = v.shape[1]
+        idx = off.reshape(-1, 1).astype(jnp.int32) + jnp.arange(T)[None]
+        m = jnp.arange(T)[None] < ln.reshape(-1, 1)
+        idx = jnp.clip(idx, 0, T - 1)
+        sel = idx.reshape(idx.shape + (1,) * (v.ndim - 2))
+        g = jnp.take_along_axis(
+            v, jnp.broadcast_to(sel, (v.shape[0], T) + v.shape[2:]), 1)
+        mf = m.astype(v.dtype)
+        while mf.ndim < g.ndim:
+            mf = mf[..., None]
+        return g * mf
+    return apply_op("sequence_slice", fn, input, offset, length)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    def fn(v, idx, upd):
+        B = v.shape[0]
+        b = jnp.repeat(jnp.arange(B)[:, None], idx.shape[1], 1)
+        return v.at[b, idx].add(upd)
+    return apply_op("sequence_scatter", fn, input, index, updates)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, act=None,
+                  param_attr=None, bias_attr=None, name=None):
+    """~ static.nn.sequence_conv: 1D context window conv over time."""
+    from ..core.tensor import Parameter
+    from ..core.generator import default_generator
+    from ..ops.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+    d = input.shape[-1]
+    limit = float(np.sqrt(6.0 / (filter_size * d + num_filters)))
+    w = Parameter(jax.random.uniform(default_generator().next_key(),
+                                     (filter_size * d, num_filters),
+                                     jnp.float32, -limit, limit))
+    b = Parameter(jnp.zeros((num_filters,))) if bias_attr is not False \
+        else None
+    G.default_main_program()._layers.extend([w] + ([b] if b is not None
+                                                   else []))
+    start = padding_start if padding_start is not None \
+        else -(filter_size // 2)
+
+    def fn(x, wv, *rest):
+        B, T, D = x.shape
+        cols = []
+        for k in range(filter_size):
+            shift = start + k
+            if shift < 0:
+                xs = jnp.pad(x, [(0, 0), (-shift, 0), (0, 0)])[:, :T]
+            elif shift > 0:
+                xs = jnp.pad(x, [(0, 0), (0, shift), (0, 0)])[:, shift:]
+            else:
+                xs = x
+            cols.append(xs)
+        col = jnp.concatenate(cols, -1)  # (B, T, k*D)
+        out = col @ wv
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [input, w] + ([b] if b is not None else [])
+    out = apply_op("sequence_conv", fn, *args)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+# ---- control flow re-exports ----------------------------------------------
+from ..ops.control_flow import case, cond, switch_case, while_loop  # noqa

@@ -1,0 +1,270 @@
+"""Static-namespace parity: static.nn layer functions, sequence ops,
+program-state io, strategies, distributed entries/datasets."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestStaticNNLayers:
+    def test_conv_norm_stack(self, static_mode):
+        prog = static.Program()
+        start = static.Program()
+        with static.program_guard(prog, start):
+            x = static.data("x", [4, 1, 8, 8], "float32")
+            h = static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+            h = static.nn.batch_norm(h)
+            ct = static.nn.conv2d_transpose(h, 2, filter_size=3, padding=1)
+            gn = static.nn.group_norm(h, 2)
+            ln = static.nn.layer_norm(h)
+            inorm = static.nn.instance_norm(h)
+            pr = static.nn.prelu(h, mode="channel")
+            out = static.nn.fc(h, 10)
+            loss = paddle.mean(out)
+        exe = static.Executor()
+        exe.run(start)
+        fetches = exe.run(
+            prog, feed={"x": np.random.rand(4, 1, 8, 8).astype("float32")},
+            fetch_list=[loss, ct, gn, ln, inorm, pr])
+        assert fetches[0].shape == ()
+        assert fetches[1].shape == (4, 2, 8, 8)
+        for f in fetches:
+            assert np.isfinite(f).all()
+
+    def test_conv3d(self, static_mode):
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [2, 1, 4, 4, 4], "float32")
+            h = static.nn.conv3d(x, 3, 3, padding=1)
+            h = static.nn.conv3d_transpose(h, 2, filter_size=3, padding=1)
+        out = static.Executor().run(
+            prog, feed={"x": np.random.rand(2, 1, 4, 4, 4).astype("f4")},
+            fetch_list=[h])
+        assert out[0].shape == (2, 2, 4, 4, 4)
+
+    def test_bilinear_and_row_conv(self, static_mode):
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            a = static.data("a", [3, 5], "float32")
+            b = static.data("b", [3, 4], "float32")
+            btp = static.nn.bilinear_tensor_product(a, b, 6)
+            seq = static.data("s", [2, 7, 5], "float32")
+            rc = static.nn.row_conv(seq, 2)
+        out = static.Executor().run(
+            prog, feed={"a": np.random.rand(3, 5).astype("f4"),
+                        "b": np.random.rand(3, 4).astype("f4"),
+                        "s": np.random.rand(2, 7, 5).astype("f4")},
+            fetch_list=[btp, rc])
+        assert out[0].shape == (3, 6)
+        assert out[1].shape == (2, 7, 5)
+
+    def test_nce_and_crf(self, static_mode):
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [6, 8], "float32")
+            lab = static.data("y", [6, 1], "int64")
+            loss = static.nn.nce(x, lab, num_total_classes=20)
+            emis = static.data("e", [2, 5, 4], "float32")
+            path = static.nn.crf_decoding(emis)
+        out = static.Executor().run(
+            prog, feed={"x": np.random.rand(6, 8).astype("f4"),
+                        "y": np.random.randint(0, 20, (6, 1)),
+                        "e": np.random.rand(2, 5, 4).astype("f4")},
+            fetch_list=[loss, path])
+        assert out[0].shape == (6, 1) and (out[0] > 0).all()
+        assert out[1].shape == (2, 5)
+        assert (out[1] >= 0).all() and (out[1] < 4).all()
+
+
+class TestSequenceOps:
+    def test_pool_variants(self):
+        x = paddle.to_tensor(np.random.rand(3, 5, 4).astype("f4"))
+        lens = paddle.to_tensor(np.array([2, 5, 3], np.int32))
+        s = static.nn.sequence_pool(x, "sum", lens)
+        ref = np.stack([x.numpy()[i, :n].sum(0)
+                        for i, n in enumerate([2, 5, 3])])
+        np.testing.assert_allclose(s.numpy(), ref, rtol=1e-5)
+        mx = static.nn.sequence_pool(x, "max", lens)
+        ref = np.stack([x.numpy()[i, :n].max(0)
+                        for i, n in enumerate([2, 5, 3])])
+        np.testing.assert_allclose(mx.numpy(), ref, rtol=1e-5)
+        first = static.nn.sequence_first_step(x)
+        np.testing.assert_allclose(first.numpy(), x.numpy()[:, 0])
+        last = static.nn.sequence_last_step(x, lens)
+        ref = np.stack([x.numpy()[i, n - 1]
+                        for i, n in enumerate([2, 5, 3])])
+        np.testing.assert_allclose(last.numpy(), ref, rtol=1e-5)
+
+    def test_softmax_reverse(self):
+        x = paddle.to_tensor(np.random.rand(2, 4, 3).astype("f4"))
+        lens = paddle.to_tensor(np.array([2, 4], np.int32))
+        sm = static.nn.sequence_softmax(x, lens)
+        got = sm.numpy()
+        # masked-out steps get ~0 probability
+        assert got[0, 2:].max() < 1e-6
+        np.testing.assert_allclose(got[0, :2].sum(0),
+                                   np.ones(3), rtol=1e-5)
+        rv = static.nn.sequence_reverse(x, lens)
+        np.testing.assert_allclose(rv.numpy()[0, 0], x.numpy()[0, 1])
+        np.testing.assert_allclose(rv.numpy()[0, 2], x.numpy()[0, 2])
+        np.testing.assert_allclose(rv.numpy()[1, 0], x.numpy()[1, 3])
+
+    def test_pad_unpad_concat_reshape(self):
+        x = paddle.to_tensor(np.random.rand(2, 3, 4).astype("f4"))
+        padded, lens = static.nn.sequence_pad(x, 0.0, maxlen=5)
+        assert padded.shape == [2, 5, 4]
+        assert list(lens.numpy()) == [3, 3]
+        trimmed = static.nn.sequence_unpad(
+            padded, paddle.to_tensor(np.array([3, 2], np.int32)))
+        assert trimmed.shape == [2, 3, 4]
+        cc = static.nn.sequence_concat([x, x])
+        assert cc.shape == [2, 6, 4]
+        rs = static.nn.sequence_reshape(x, 2)
+        assert rs.shape == [2, 6, 2]
+
+    def test_enumerate_slice_scatter_expand(self):
+        ids = paddle.to_tensor(np.arange(8).reshape(2, 4))
+        en = static.nn.sequence_enumerate(ids, 2)
+        assert en.shape == [2, 4, 2]
+        np.testing.assert_array_equal(en.numpy()[0, 0], [0, 1])
+        x = paddle.to_tensor(np.random.rand(2, 4, 3).astype("f4"))
+        sl = static.nn.sequence_slice(
+            x, paddle.to_tensor(np.array([1, 0], np.int32)),
+            paddle.to_tensor(np.array([2, 3], np.int32)))
+        np.testing.assert_allclose(sl.numpy()[0, 0], x.numpy()[0, 1])
+        assert abs(sl.numpy()[0, 2]).max() == 0  # masked beyond length
+        base = paddle.zeros([2, 6])
+        upd = paddle.ones([2, 2])
+        idx = paddle.to_tensor(np.array([[0, 2], [1, 3]], np.int32))
+        sc = static.nn.sequence_scatter(base, idx, upd)
+        assert sc.numpy()[0, 0] == 1 and sc.numpy()[1, 3] == 1
+        y = paddle.zeros([2, 5, 3])
+        ex = static.nn.sequence_expand(paddle.ones([2, 3]), y)
+        assert ex.shape == [2, 5, 3]
+
+    def test_sequence_conv(self):
+        x = paddle.to_tensor(np.random.rand(2, 6, 4).astype("f4"))
+        out = static.nn.sequence_conv(x, 8, 3)
+        assert out.shape == [2, 6, 8]
+
+
+class TestStaticExtras:
+    def test_program_state_roundtrip(self, static_mode):
+        prog = static.Program()
+        start = static.Program()
+        with static.program_guard(prog, start):
+            x = static.data("x", [2, 4], "float32")
+            out = static.nn.fc(x, 3)
+        exe = static.Executor()
+        exe.run(start)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model")
+            static.save(prog, path)
+            st = static.load_program_state(path)
+            assert len(st) == len(prog.all_parameters())
+            # perturb then restore
+            for p in prog.all_parameters():
+                p._value = p._value * 0
+            static.set_program_state(prog, st)
+            for p in prog.all_parameters():
+                key = p.name
+                if key in st:
+                    np.testing.assert_allclose(np.asarray(p._value), st[key])
+            blob = static.serialize_persistables(program=prog)
+            static.deserialize_persistables(prog, blob)
+            f = os.path.join(d, "blob.bin")
+            static.save_to_file(f, blob)
+            assert static.load_from_file(f) == blob
+
+    def test_strategies_places_ema(self):
+        bs = static.BuildStrategy()
+        bs.reduce_strategy = static.BuildStrategy.ReduceStrategy.Reduce
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+        assert len(static.cpu_places(3)) == 3
+        assert len(static.cuda_places()) >= 1
+        w = static.WeightNormParamAttr(dim=0, name="wn")
+        assert w.dim == 0
+
+    def test_ema_apply_restore(self):
+        prog = static.default_main_program()
+        p = static.create_parameter([2, 2], "float32", name="ema_p")
+        ema = static.ExponentialMovingAverage(0.5)
+        orig = np.asarray(p._value).copy()
+        ema.update()
+        p._value = p._value + 100.0
+        ema.update()
+        with ema.apply():
+            inside = np.asarray(p._value)
+            assert abs(inside - orig).max() < 100
+        np.testing.assert_allclose(np.asarray(p._value), orig + 100.0)
+
+    def test_accuracy_print(self):
+        logits = paddle.to_tensor(
+            np.array([[9.0, 1.0], [1.0, 9.0]], np.float32))
+        lab = paddle.to_tensor(np.array([[0], [1]]))
+        assert float(static.accuracy(logits, lab).numpy()) == 1.0
+        out = static.Print(paddle.ones([2]), message="test")
+        assert out.shape == [2]
+
+    def test_device_guard(self):
+        with static.device_guard("cpu"):
+            t = paddle.ones([2])
+        assert t.shape == [2]
+
+
+class TestDistributedEntries:
+    def test_entry_attrs(self):
+        import paddle_tpu.distributed as dist
+        assert dist.ProbabilityEntry(0.5)._to_attr() == \
+            "probability_entry:0.5"
+        assert dist.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+        assert "show" in dist.ShowClickEntry("show", "click")._to_attr()
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(0)
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(0)
+
+    def test_in_memory_dataset(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        f = tmp_path / "data.txt"
+        f.write_text("\n".join(f"{i} {i % 3}" for i in range(10)))
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=4)
+        ds.set_filelist([str(f)])
+        ds.set_parse_fn(lambda line: tuple(
+            np.int64(v) for v in line.split()))
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        ds.global_shuffle()
+        batches = list(ds)
+        assert len(batches) == 3
+        assert batches[0][0].shape == (4,)
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        f = tmp_path / "q.txt"
+        f.write_text("\n".join(str(i) for i in range(6)))
+        ds = dist.QueueDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(f)])
+        ds.set_parse_fn(lambda line: np.int64(line))
+        assert len(list(ds)) == 3
+
+    def test_parallel_mode(self):
+        import paddle_tpu.distributed as dist
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        assert dist.ParallelMode.SHARDING_PARALLEL == 3
