@@ -69,6 +69,21 @@ class StaticFunction:
     def _build(self):
         layer = self._layer
         fn = self._fn
+        # dy2static AST pass: Python control flow on tensors ->
+        # lax.cond/while_loop converter calls (jit/dy2static/)
+        try:
+            if ProgramTranslator().enable_to_static:
+                import inspect as _inspect
+                import types as _types
+                from .dy2static import convert_to_static
+                if _inspect.ismethod(fn):
+                    fn = _types.MethodType(
+                        convert_to_static(fn.__func__), fn.__self__)
+                else:
+                    fn = convert_to_static(fn)
+                self._converted_fn = fn
+        except SyntaxError:
+            pass
 
         def traced(params, args, kwargs, training):
             if layer is not None:

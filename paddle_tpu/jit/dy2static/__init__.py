@@ -1,0 +1,24 @@
+"""dy2static: AST-level dynamic-to-static conversion.
+
+~ python/paddle/fluid/dygraph/dygraph_to_static/ (20 AST transformer files:
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py,
+convert_operators.py, convert_call_func.py, program_translator.py).
+
+The reference rewrites Python control flow into ProgramDesc cond/while ops.
+TPU-native: the same AST rewrite, but the runtime converters dispatch to
+``lax.cond`` / ``lax.while_loop`` when the predicate is a traced tensor and
+to plain Python control flow otherwise — so one source supports eager runs
+AND jit tracing with data-dependent branches.
+
+Pipeline (``convert_to_static``):
+  source -> ast.parse -> LogicalTransformer (and/or/not -> converter calls)
+         -> ForToWhileTransformer (for-range -> while)
+         -> WhileTransformer (while -> functional cond_fn/body_fn + carry)
+         -> IfElseTransformer (if -> functional branches + carry)
+         -> compile + exec in the original closure environment.
+"""
+from .convert_operators import (  # noqa: F401
+    convert_ifelse, convert_logical_and, convert_logical_not,
+    convert_logical_or, convert_while_loop, UndefinedVar,
+)
+from .transformer import convert_to_static, code_of  # noqa: F401

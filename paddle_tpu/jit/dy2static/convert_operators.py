@@ -1,0 +1,192 @@
+"""Runtime converters the transformed AST calls into.
+
+~ dygraph_to_static/convert_operators.py (convert_ifelse, convert_while_loop,
+convert_logical_and/or/not): each checks whether the control value is a
+tensor/tracer; tensor -> compiled control flow (lax.cond / lax.while_loop),
+plain Python value -> native control flow. This runtime dispatch is what
+lets one transformed source serve both eager and traced execution.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+class UndefinedVar:
+    """Sentinel for names that may be defined only inside a branch
+    (~ dygraph_to_static/utils.py UndefinedVar)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"UndefinedVar({self.name!r})"
+
+
+def _is_traced(x) -> bool:
+    if isinstance(x, Tensor):
+        return isinstance(x._value, jax.core.Tracer)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_tensorish(x) -> bool:
+    return isinstance(x, (Tensor, jax.Array)) or isinstance(
+        x, jax.core.Tracer)
+
+
+def _to_bool_value(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def _unwrap_tree(tree):
+    return jax.tree.map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _check_carry(name, tree):
+    leaves = jax.tree.leaves(
+        tree, is_leaf=lambda x: isinstance(x, UndefinedVar))
+    for leaf in leaves:
+        if isinstance(leaf, UndefinedVar):
+            raise ValueError(
+                f"variable '{leaf.name}' is set in only one branch of a "
+                f"tensor-dependent `{name}` — both paths must define it "
+                "for compiled control flow (the reference raises the same "
+                "constraint from its IfElse transformer)")
+
+
+def _wrap_tree(tree):
+    return jax.tree.map(
+        lambda x: Tensor(x) if isinstance(x, (jax.Array, jax.core.Tracer))
+        else x, tree)
+
+
+def _partition(carry):
+    """Split the carry tuple into the compiled subset (tensor/array/scalar
+    leaves defined before the block) and static passthrough values
+    (UndefinedVar temps, strings, arbitrary objects).
+
+    The compiled subset is what rides through lax.cond/while_loop; statics
+    are re-inserted on the way out (matching the reference's treatment of
+    non-Variable loop vars)."""
+    flat = list(carry)
+    dyn_idx = []
+    for i, v in enumerate(flat):
+        if isinstance(v, UndefinedVar):
+            continue
+        if _is_tensorish(v) or isinstance(v, (int, float, bool, complex)):
+            dyn_idx.append(i)
+    return flat, dyn_idx
+
+
+def _to_full(flat, dyn_idx, sub):
+    out = list(flat)
+    for j, i in enumerate(dyn_idx):
+        out[i] = sub[j]
+    return tuple(out)
+
+
+def convert_ifelse(pred, true_fn, false_fn, carry):
+    """``out = convert_ifelse(cond, true_fn, false_fn, (a, b))``.
+
+    Tensor/tracer pred -> lax.cond with both branches traced over the carry;
+    Python pred -> call the taken branch only.
+    """
+    if _is_tensorish(pred):
+        pv = _to_bool_value(pred)
+        if getattr(pv, "ndim", 0) > 0:
+            pv = jnp.all(pv)
+        if not _is_traced(pred):
+            # concrete device value in eager mode: take one branch natively
+            return true_fn(carry) if bool(pv) else false_fn(carry)
+        flat, dyn_idx = _partition(carry)
+
+        def run(branch_fn, sub):
+            out = branch_fn(_wrap_tree(_to_full(flat, dyn_idx, sub)))
+            out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            for v in out:
+                if isinstance(v, UndefinedVar):
+                    raise ValueError(
+                        f"variable '{v.name}' must be defined in both "
+                        "branches of a tensor-dependent `if` (or before "
+                        "it) for compiled control flow")
+            return tuple(_unwrap_tree(list(out)))
+
+        def t(sub):
+            return run(true_fn, sub)
+
+        def f(sub):
+            return run(false_fn, sub)
+        sub0 = tuple(_unwrap_tree([flat[i] for i in dyn_idx]))
+        out = jax.lax.cond(pv, t, f, sub0)
+        return _wrap_tree(tuple(out))
+    return true_fn(carry) if pred else false_fn(carry)
+
+
+def convert_while_loop(cond_fn, body_fn, carry):
+    """Tensor-valued condition -> lax.while_loop; else native while."""
+    probe = cond_fn(carry)
+    if _is_traced(probe):
+        flat, dyn_idx = _partition(carry)
+
+        def cond(sub):
+            r = cond_fn(_wrap_tree(_to_full(flat, dyn_idx, sub)))
+            r = r._value if isinstance(r, Tensor) else r
+            return jnp.all(r) if getattr(r, "ndim", 0) > 0 else r
+
+        def body(sub):
+            out = body_fn(_wrap_tree(_to_full(flat, dyn_idx, sub)))
+            out = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            return tuple(_unwrap_tree([out[i] for i in dyn_idx]))
+        sub0 = tuple(_unwrap_tree([flat[i] for i in dyn_idx]))
+        res = jax.lax.while_loop(cond, body, sub0)
+        return _wrap_tree(_to_full(flat, dyn_idx, tuple(res)))
+    while _bool(probe):
+        carry = body_fn(carry)
+        probe = cond_fn(carry)
+    return carry
+
+
+def _bool(x):
+    if isinstance(x, Tensor):
+        return bool(jnp.all(x._value))
+    return bool(x)
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    """``a and b`` with tensor short-circuit semantics preserved for
+    Python values (rhs not evaluated when lhs falsy and plain)."""
+    lhs = lhs_fn()
+    if _is_tensorish(lhs):
+        rhs = rhs_fn()
+        if _is_tensorish(rhs):
+            return Tensor(jnp.logical_and(_to_bool_value(lhs),
+                                          _to_bool_value(rhs)))
+        return Tensor(jnp.logical_and(_to_bool_value(lhs), bool(rhs)))
+    if not lhs:
+        return lhs
+    return rhs_fn()
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tensorish(lhs):
+        rhs = rhs_fn()
+        if _is_tensorish(rhs):
+            return Tensor(jnp.logical_or(_to_bool_value(lhs),
+                                         _to_bool_value(rhs)))
+        return Tensor(jnp.logical_or(_to_bool_value(lhs), bool(rhs)))
+    if lhs:
+        return lhs
+    return rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensorish(x):
+        return Tensor(jnp.logical_not(_to_bool_value(x)))
+    return not x

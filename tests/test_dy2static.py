@@ -1,0 +1,214 @@
+"""dy2static AST conversion tests.
+
+~ the reference's dygraph_to_static test tree
+(python/paddle/fluid/tests/unittests/dygraph_to_static/): same eager-vs-
+converted parity style, plus jit-traced checks that tensor-dependent
+control flow actually compiles (lax.cond / lax.while_loop in the jaxpr).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.dy2static import convert_to_static, code_of
+
+
+def branchy(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+
+
+def nested_if(x):
+    if x.sum() > 0:
+        if x.max() > 10:
+            y = x * 100
+        else:
+            y = x * 2
+    else:
+        y = -x
+    return y
+
+
+def loopy(x, n):
+    s = x
+    i = 0
+    while i < n:
+        s = s + x
+        i = i + 1
+    return s
+
+
+def for_range_loop(x):
+    acc = x * 0
+    for i in range(4):
+        acc = acc + x * (i + 1)
+    return acc
+
+
+def logical_fn(x, flag):
+    if flag and x.sum() > 0:
+        r = x
+    else:
+        r = -x
+    return r
+
+
+def not_fn(x):
+    if not (x.sum() > 0):
+        r = x * 0
+    else:
+        r = x
+    return r
+
+
+def temp_in_loop(x, n):
+    s = x * 0
+    i = 0
+    while i < n:
+        t = x * 2          # pure temp, first defined inside the loop
+        s = s + t
+        i = i + 1
+    return s
+
+
+class TestConversion:
+    def test_source_is_rewritten(self):
+        conv = convert_to_static(branchy)
+        src = code_of(conv)
+        assert "convert_ifelse" in src
+        assert "__true_fn" in src and "__false_fn" in src
+
+    def test_if_eager_parity(self):
+        conv = convert_to_static(branchy)
+        pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        neg = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        np.testing.assert_allclose(conv(pos).numpy(), branchy(pos).numpy())
+        np.testing.assert_allclose(conv(neg).numpy(), branchy(neg).numpy())
+
+    def test_if_compiles_to_lax_cond(self):
+        conv = convert_to_static(branchy)
+
+        def fn(v):
+            return conv(Tensor(v))._value
+        jaxpr = str(jax.make_jaxpr(fn)(jnp.zeros(2)))
+        assert "cond" in jaxpr
+        jf = jax.jit(fn)
+        np.testing.assert_allclose(jf(jnp.asarray([1.0, 2.0])), [2.0, 4.0])
+        np.testing.assert_allclose(jf(jnp.asarray([-1.0, -2.0])),
+                                   [-2.0, -3.0])
+
+    def test_nested_if(self):
+        conv = convert_to_static(nested_if)
+        big = paddle.to_tensor(np.array([20.0], np.float32))
+        small = paddle.to_tensor(np.array([1.0], np.float32))
+        neg = paddle.to_tensor(np.array([-1.0], np.float32))
+        for t in (big, small, neg):
+            np.testing.assert_allclose(conv(t).numpy(),
+                                       nested_if(t).numpy())
+        jf = jax.jit(lambda v: conv(Tensor(v))._value)
+        np.testing.assert_allclose(jf(jnp.asarray([20.0])), [2000.0])
+        np.testing.assert_allclose(jf(jnp.asarray([-3.0])), [3.0])
+
+    def test_while_tensor_bound_compiles(self):
+        conv = convert_to_static(loopy)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(conv(x, 3).numpy(), [4.0, 8.0])
+
+        def fn(v, n):
+            return conv(Tensor(v), Tensor(n))._value
+        jaxpr = str(jax.make_jaxpr(fn)(jnp.zeros(2), jnp.asarray(3)))
+        assert "while" in jaxpr
+        np.testing.assert_allclose(
+            jax.jit(fn)(jnp.asarray([1.0, 2.0]), jnp.asarray(5)),
+            [6.0, 12.0])
+
+    def test_for_range(self):
+        conv = convert_to_static(for_range_loop)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [10.0])
+
+    def test_logicals(self):
+        conv = convert_to_static(logical_fn)
+        src = code_of(conv)
+        assert "convert_logical_and" in src
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(conv(x, True).numpy(), [1.0])
+        np.testing.assert_allclose(conv(x, False).numpy(), [-1.0])
+        convn = convert_to_static(not_fn)
+        assert "convert_logical_not" in code_of(convn)
+        np.testing.assert_allclose(convn(x).numpy(), [1.0])
+
+    def test_temp_var_in_loop(self):
+        conv = convert_to_static(temp_in_loop)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(conv(x, 3).numpy(), [6.0])
+        jf = jax.jit(lambda v, n: conv(Tensor(v), Tensor(n))._value)
+        np.testing.assert_allclose(jf(jnp.asarray([1.0]), jnp.asarray(4)),
+                                   [8.0])
+
+    def test_return_branch_left_native(self):
+        def early(x):
+            if x.sum() > 0:
+                return x
+            return -x
+        conv = convert_to_static(early)
+        # stays python `if` (flow escape) — works eagerly
+        x = paddle.to_tensor(np.array([-2.0], np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [2.0])
+        assert "convert_ifelse" not in code_of(conv)
+
+
+class ControlFlowNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.sum() > 0:
+            out = h * 2
+        else:
+            out = h * 0.5
+        return out
+
+
+class TestToStaticIntegration:
+    def test_layer_with_control_flow(self):
+        net = ControlFlowNet()
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        eager = net(x).numpy()
+        st = paddle.jit.to_static(net)
+        got = st.forward_static(x).numpy()
+        np.testing.assert_allclose(got, eager, rtol=1e-5)
+
+    def test_function_to_static(self):
+        @paddle.jit.to_static
+        def f(x):
+            s = x * 0
+            i = 0
+            while i < 3:
+                s = s + x
+                i = i + 1
+            if s.sum() > 100:
+                s = s / 10
+            return s
+        x = paddle.to_tensor(np.full((2,), 100.0, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [30.0, 30.0])
+        x2 = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(f(x2).numpy(), [3.0, 3.0])
+
+    def test_translator_disable(self):
+        pt = paddle.jit.ProgramTranslator()
+        pt.enable(False)
+        try:
+            @paddle.jit.to_static
+            def g(x):
+                return x + 1
+            x = paddle.ones([2])
+            np.testing.assert_allclose(g(x).numpy(), [2.0, 2.0])
+        finally:
+            pt.enable(True)
