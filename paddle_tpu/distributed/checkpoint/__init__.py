@@ -92,3 +92,10 @@ def load_state_dict(path: str, template: Optional[dict] = None) -> dict:
             if hasattr(v, "shape") else v, _to_arrays(template))
         return ckptr.restore(path, tmpl)
     return ckptr.restore(path)
+
+
+from .converter import (  # noqa: F401,E402
+    Converter, dist_attr_from_sharding, load_distributed_checkpoint,
+    merge_with_dist_attr, save_distributed_checkpoint, shards_from_array,
+    slice_with_dist_attr,
+)

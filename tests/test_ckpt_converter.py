@@ -1,0 +1,139 @@
+"""Checkpoint re-shard converter tests (the converter.py capability,
+SURVEY.md §5): merge shards from one topology, re-slice to another, and the
+jax NamedSharding bridge on the virtual 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (
+    Converter, dist_attr_from_sharding, load_distributed_checkpoint,
+    merge_with_dist_attr, save_distributed_checkpoint, shards_from_array,
+    slice_with_dist_attr,
+)
+
+
+def attr(process_shape, dims_mapping, group=None):
+    n = int(np.prod(process_shape))
+    return {"process_shape": list(process_shape),
+            "process_group": group or list(range(n)),
+            "dims_mapping": list(dims_mapping)}
+
+
+class TestMergeSlice:
+    def test_roundtrip_1d_split(self):
+        full = np.arange(24, dtype=np.float32).reshape(6, 4)
+        a = attr([2], [0, -1])
+        shards = slice_with_dist_attr(full, a)
+        assert shards[0].shape == (3, 4)
+        np.testing.assert_array_equal(merge_with_dist_attr(shards, a), full)
+
+    def test_roundtrip_2d_mesh(self):
+        full = np.arange(64, dtype=np.float32).reshape(8, 8)
+        a = attr([2, 2], [0, 1])
+        shards = slice_with_dist_attr(full, a)
+        assert len(shards) == 4 and shards[0].shape == (4, 4)
+        np.testing.assert_array_equal(merge_with_dist_attr(shards, a), full)
+        # row-major group order: shard 1 is mesh coords (0, 1) -> cols 4:8
+        np.testing.assert_array_equal(shards[1], full[:4, 4:])
+
+    def test_replicated_dim(self):
+        full = np.random.rand(4, 6).astype(np.float32)
+        a = attr([2], [-1, 0])
+        shards = slice_with_dist_attr(full, a)
+        assert shards[0].shape == (4, 3)
+        np.testing.assert_array_equal(merge_with_dist_attr(shards, a), full)
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            slice_with_dist_attr(np.zeros((5, 4)), attr([2], [0, -1]))
+
+
+class TestConverter:
+    def test_tp2_to_tp4(self):
+        full = np.arange(32, dtype=np.float32).reshape(8, 4)
+        pre = attr([2], [0, -1])
+        cur = attr([4], [0, -1])
+        shards2 = slice_with_dist_attr(full, pre)
+        conv = Converter({"w": shards2}, {"w": pre}, {"w": cur})
+        out = conv.convert()
+        assert len(out["w"]) == 4
+        np.testing.assert_array_equal(
+            merge_with_dist_attr(out["w"], cur), full)
+
+    def test_axis_change(self):
+        full = np.arange(32, dtype=np.float32).reshape(8, 4)
+        pre = attr([2], [0, -1])   # row split
+        cur = attr([2], [-1, 0])   # col split
+        out = Converter({"w": slice_with_dist_attr(full, pre)},
+                        {"w": pre}, {"w": cur}).convert()
+        np.testing.assert_array_equal(out["w"][0], full[:, :2])
+
+    def test_gather_to_replicated(self):
+        full = np.random.rand(4, 4).astype(np.float32)
+        pre = attr([4], [0, -1])
+        cur = attr([1], [-1, -1])
+        out = Converter({"w": slice_with_dist_attr(full, pre)},
+                        {"w": pre}, {"w": cur}).convert()
+        np.testing.assert_array_equal(out["w"][0], full)
+
+    def test_same_attr_passthrough(self):
+        full = np.random.rand(4, 4).astype(np.float32)
+        a = attr([2], [0, -1])
+        shards = slice_with_dist_attr(full, a)
+        out = Converter({"w": shards}, {"w": a}, {"w": a}).convert()
+        np.testing.assert_array_equal(out["w"][0], shards[0])
+
+    def test_prefix_match(self):
+        full = np.random.rand(4, 4).astype(np.float32)
+        a = attr([1], [-1, -1])
+        conv = Converter({"layer0.weight": [full]},
+                         {"layer0.weight": a},
+                         {"layer0.weight.renamed": a})
+        with pytest.raises(ValueError):
+            conv.convert(strict=True)
+        out = conv.convert(strict=False)
+        np.testing.assert_array_equal(out["layer0.weight.renamed"][0], full)
+
+
+class TestJaxBridge:
+    def test_dist_attr_from_named_sharding(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("dp", "mp"))
+        sh = NamedSharding(mesh, P("mp", None))
+        a = dist_attr_from_sharding(sh, (8, 4))
+        assert a["process_shape"] == [2, 4]
+        assert a["dims_mapping"] == [1, -1]
+
+    def test_shards_from_sharded_array(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = np.array(jax.devices()[:8]).reshape(8)
+        mesh = Mesh(devs, ("mp",))
+        full = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+        sharded = jax.device_put(full, NamedSharding(mesh, P("mp", None)))
+        shards = shards_from_array(sharded)
+        assert len(shards) == 8 and shards[0].shape == (1, 4)
+        np.testing.assert_array_equal(np.concatenate(shards), np.asarray(full))
+
+    def test_save_load_distributed_roundtrip(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "mp"))
+        w = jax.device_put(jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+                           NamedSharding(mesh, P(None, "mp")))
+        b = jnp.ones((4,), jnp.float32)
+        path = str(tmp_path / "dist.ckpt")
+        save_distributed_checkpoint({"w": w, "b": b}, path)
+        # load merged (topology-free)
+        merged = load_distributed_checkpoint(path)
+        np.testing.assert_array_equal(merged["w"], np.asarray(w))
+        # load re-sharded to a 4-way row split
+        cur = {"w": attr([4], [0, -1]), "b": attr([1], [-1])}
+        out = load_distributed_checkpoint(path, cur)
+        assert out["w"][0].shape == (1, 4)
+        np.testing.assert_array_equal(
+            merge_with_dist_attr(out["w"], cur["w"]), np.asarray(w))
