@@ -7,3 +7,80 @@ from ..core.place import (  # noqa: F401
 
 def cuda_device_count() -> int:  # API-compat shim: "cuda" means accelerator
     return device_count()
+
+
+# ---- memory stats ----------------------------------------------------------
+# ~ paddle/fluid/memory/stats.h:35 (peak/current allocated+reserved per
+# device, exposed as paddle.device.cuda.max_memory_allocated etc.). Backed
+# by the runtime's per-device memory_stats() (XLA allocator counters);
+# jax owns the BFC-style caching allocator that AllocatorFacade provides in
+# the reference.
+
+def _dev(device=None):
+    import jax
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, Place):
+        return device.jax_device
+    return device
+
+
+def memory_stats(device=None) -> dict:
+    d = _dev(device)
+    stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+    return dict(stats) if stats else {}
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("pool_bytes", s.get(
+        "bytes_limit", 0))))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved", memory_reserved(device)))
+
+
+def reset_peak_memory_stats(device=None) -> None:
+    # XLA exposes no peak reset; deleting dead buffers is the useful part
+    empty_cache()
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    reset_peak_memory_stats(device)
+
+
+def empty_cache() -> None:
+    """~ paddle.device.cuda.empty_cache: return cached blocks. Live arrays
+    are owned by Python references here, so freeing = dropping dead
+    client-side buffers."""
+    import gc
+    gc.collect()
+
+
+class cuda:
+    """paddle.device.cuda namespace shim (accelerator = TPU)."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    device_count = staticmethod(cuda_device_count)
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        # block on all outstanding work for the device
+        jax.effects_barrier()

@@ -6,3 +6,4 @@ incubate.distributed.models.moe mirroring the reference layout.
 """
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
+from . import autotune  # noqa: F401

@@ -1,0 +1,20 @@
+"""paddle.incubate.autotune shim over ops/autotune.
+
+~ python/paddle/incubate/autotune.py set_config({"kernel": {"enable": ...,
+"tuning_range": ...}}) driving phi/kernels/autotune/switch_autotune.cc.
+"""
+from ..ops.autotune import (  # noqa: F401
+    AutoTuneCache, autotune, autotune_enabled, cache, disable_autotune,
+    enable_autotune, tuned_flash_attention,
+)
+
+
+def set_config(config=None):
+    if config is None:
+        enable_autotune()
+        return
+    kernel = (config or {}).get("kernel", {})
+    if kernel.get("enable", False):
+        enable_autotune()
+    else:
+        disable_autotune()

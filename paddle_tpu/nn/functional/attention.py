@@ -36,8 +36,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                          and q.shape[-1] in (64, 128, 256))
         if use_flash:
             try:
-                from ...ops.pallas.flash_attention import flash_attention
-                return flash_attention(
+                from ...ops.autotune import tuned_flash_attention
+                return tuned_flash_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                     jnp.swapaxes(v, 1, 2), causal=is_causal,
                 ).swapaxes(1, 2)

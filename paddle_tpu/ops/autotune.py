@@ -1,0 +1,153 @@
+"""Runtime kernel autotuning with a persistent cache.
+
+~ paddle/phi/kernels/autotune/ (AutoTuneBase auto_tune_base.h:48: time every
+candidate once, pick the fastest; AutoTuneCache cache.h:144 keyed by op +
+shape/dtype signature; switch_autotune.cc flag gating).
+
+TPU shape: candidates are whole jitted callables (e.g. a Pallas kernel at
+several block sizes) — each is compiled + timed on the real arguments the
+first time a (op, signature) key is seen; the winner is cached for the
+process and exportable/importable like the reference's cache file.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Sequence
+
+import jax
+
+from ..core import flags as _flags
+
+_flags.define_flag("use_autotune", False, "enable runtime kernel autotune")
+
+
+class AutoTuneCache:
+    """(op, signature) -> chosen candidate index (+ timings for report)."""
+
+    def __init__(self):
+        self._cache: Dict[tuple, int] = {}
+        self._timings: Dict[tuple, list] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def key(self, op: str, args) -> tuple:
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in args
+                    if hasattr(a, "shape"))
+        return (op, sig)
+
+    def get(self, key):
+        if key in self._cache:
+            self.hits += 1
+            return self._cache[key]
+        self.misses += 1
+        return None
+
+    def put(self, key, idx, timings=None):
+        self._cache[key] = idx
+        if timings is not None:
+            self._timings[key] = timings
+
+    def report(self):
+        return {"hits": self.hits, "misses": self.misses,
+                "size": len(self._cache)}
+
+    def export(self, path: str):
+        payload = {json.dumps(list(k)): v for k, v in self._cache.items()}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+
+    def load(self, path: str):
+        with open(path) as f:
+            payload = json.load(f)
+        for k, v in payload.items():
+            op, sig = json.loads(k)
+            self._cache[(op, tuple(tuple(s) if isinstance(s, list) else s
+                                   for s in map(tuple, sig)))] = v
+
+
+_CACHE = AutoTuneCache()
+
+
+def cache() -> AutoTuneCache:
+    return _CACHE
+
+
+def enable_autotune():
+    _flags.set_flags({"use_autotune": True})
+
+
+def disable_autotune():
+    _flags.set_flags({"use_autotune": False})
+
+
+def autotune_enabled() -> bool:
+    return bool(_flags.get_flag("use_autotune"))
+
+
+def _time_once(fn: Callable, args, warmup: int = 1, iters: int = 3) -> float:
+    try:
+        for _ in range(warmup):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+    except Exception:
+        return float("inf")
+
+
+def autotune(op: str, candidates: Sequence[Callable], args,
+             default: int = 0) -> Callable:
+    """Pick the fastest candidate for these argument shapes.
+
+    Off (the default, like FLAGS_use_autotune): returns candidates[default].
+    On: first call per (op, signature) times each candidate on the real
+    args; later calls hit the cache.
+    """
+    if not autotune_enabled() or len(candidates) == 1:
+        return candidates[default]
+    key = _CACHE.key(op, args)
+    idx = _CACHE.get(key)
+    if idx is not None:
+        return candidates[idx]
+    timings = [_time_once(c, args) for c in candidates]
+    best = min(range(len(timings)), key=timings.__getitem__)
+    if timings[best] == float("inf"):
+        best = default
+    _CACHE.put(key, best, timings)
+    return candidates[best]
+
+
+# ---- tuned flash attention -------------------------------------------------
+
+_FA_BLOCKS = ((128, 128), (256, 256), (128, 512), (512, 128), (256, 512))
+
+
+def tuned_flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Flash attention with autotuned (block_q, block_k).
+
+    Candidates are block configs that divide the sequence lengths. Timing
+    happens only on concrete (eager) calls; under a jit trace the cached
+    choice for this signature is used (falling back to the default blocks),
+    so the tune is race-free with compilation."""
+    from .pallas.flash_attention import flash_attention
+    Sq, Sk = q.shape[2], k.shape[2]
+    configs = [(bq, bk) for bq, bk in _FA_BLOCKS
+               if Sq % bq == 0 and Sk % bk == 0]
+    if not configs:
+        configs = [(min(128, Sq), min(128, Sk))]
+
+    def make(bq, bk):
+        def run(q_, k_, v_):
+            return flash_attention(q_, k_, v_, causal, sm_scale, bq, bk)
+        return run
+
+    cands = [make(bq, bk) for bq, bk in configs]
+    if isinstance(q, jax.core.Tracer):
+        idx = _CACHE.get(_CACHE.key("flash_attention", (q, k, v))) or 0
+        return cands[idx](q, k, v)
+    chosen = autotune("flash_attention", cands, (q, k, v))
+    return chosen(q, k, v)

@@ -47,3 +47,6 @@ def name_scope(prefix=None):
     def _g():
         yield
     return _g()
+
+from . import passes  # noqa: F401,E402
+from .passes import apply_pass, register_pass  # noqa: F401,E402

@@ -62,6 +62,12 @@ def _eval_var(var, env):
     key = id(var)
     if key in env:
         return env[key]
+    const = getattr(var, "_const_value", None)
+    if const is not None:
+        # stamped by static.passes constant_folding: feeds never reach this
+        # var, its value is known ahead of trace
+        env[key] = const
+        return const
     node = var._node
     if node is None:
         raise RuntimeError(

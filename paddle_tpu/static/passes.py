@@ -1,0 +1,153 @@
+"""Graph pass framework over the captured Program DAG.
+
+~ paddle/fluid/framework/ir/ (Pass/PassRegistry pass.h:53,215, REGISTER_PASS
+:317; ~150 passes). TPU reality: XLA performs fusion/DCE/CSE/layout inside
+jit, so the pass layer here is thin and targets what XLA can't see —
+program-level dead op elimination (fewer ops to trace), constant folding of
+host-known subgraphs (smaller jaxprs), and analysis passes that report
+structure (op stats). The registry/apply API mirrors the reference so
+downstream tooling (distributed passes in distributed/passes-style) can hook
+in.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from .graph import OpNode, Program, StaticVar
+
+PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """~ REGISTER_PASS(name, class) ir/pass.h:317."""
+    def deco(fn):
+        PASS_REGISTRY[name] = fn
+        fn.pass_name = name
+        return fn
+    return deco
+
+
+def apply_pass(program: Program, name: str, **kwargs):
+    """~ Pass::Apply(graph)."""
+    if name not in PASS_REGISTRY:
+        raise KeyError(f"no pass registered under {name!r}; "
+                       f"have {sorted(PASS_REGISTRY)}")
+    return PASS_REGISTRY[name](program, **kwargs)
+
+
+def apply_build_strategy(program: Program, build_strategy=None,
+                         fetch_vars=None):
+    """Run the standard pipeline (~ BuildStrategy-driven pass list)."""
+    stats = {}
+    stats["dead_ops_removed"] = apply_pass(
+        program, "dead_code_elimination", fetch_vars=fetch_vars)
+    stats["ops_folded"] = apply_pass(program, "constant_folding")
+    return stats
+
+
+def _reachable_nodes(program: Program, fetch_vars) -> set:
+    seen_nodes = set()
+    stack = []
+    for v in fetch_vars or []:
+        node = getattr(v, "_node", None)
+        if node is not None:
+            stack.append(node)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen_nodes:
+            continue
+        seen_nodes.add(id(node))
+        for a in node.args:
+            sub = getattr(a, "_node", None)
+            if sub is not None:
+                stack.append(sub)
+    return seen_nodes
+
+
+@register_pass("dead_code_elimination")
+def dead_code_elimination(program: Program, fetch_vars=None) -> int:
+    """Drop captured vars whose producing ops can't reach any fetch target
+    (~ ir passes' DCE; the reference prunes ProgramDesc similarly in
+    framework/prune.cc). Returns number of removed vars."""
+    if not fetch_vars:
+        return 0
+    live = _reachable_nodes(program, fetch_vars)
+    dead = [name for name, v in program._vars.items()
+            if getattr(v, "_node", None) is not None
+            and id(v._node) not in live]
+    for name in dead:
+        del program._vars[name]
+    program._version += 1
+    return len(dead)
+
+
+@register_pass("constant_folding")
+def constant_folding(program: Program) -> int:
+    """Evaluate ops with no feed slot upstream: outputs become constants
+    (stamped as ``_const_value``, honored by the Executor before tracing),
+    shrinking the jitted program (~ ir/constant_folding_pass). Parameters
+    do NOT count as constants — they change across steps. Returns the
+    number of folded ops."""
+    from ..core.tensor import Parameter, Tensor
+
+    folded = 0
+    seen_nodes = set()
+    # program._vars is insertion-ordered = topological
+    for v in list(program._vars.values()):
+        node = getattr(v, "_node", None)
+        if node is None or id(node) in seen_nodes:
+            continue
+        seen_nodes.add(id(node))
+        if getattr(node.out_vars[0], "_const_value", None) is not None:
+            continue
+        args_c = []
+        ok = True
+        for a in node.args:
+            if isinstance(a, StaticVar):
+                cv = getattr(a, "_const_value", None)
+                if cv is None:
+                    ok = False
+                    break
+                args_c.append(cv)
+            elif isinstance(a, Parameter):
+                ok = False
+                break
+            elif isinstance(a, Tensor):
+                args_c.append(a._value)
+            else:
+                args_c.append(a)
+        if not ok:
+            continue
+        try:
+            out = node.fn(*args_c, **node.kwargs)
+        except Exception:
+            continue
+        outs = (out,) if node.single else tuple(out)
+        for ov, val in zip(node.out_vars, outs):
+            ov._const_value = val
+        folded += 1
+    program._version += 1
+    return folded
+
+
+def freeze_feed(var, value):
+    """Bind a feed slot to a fixed value so constant_folding can collapse
+    everything downstream of it (~ inference freezing: feed vars replaced
+    by persistable constants before the ir pass pipeline runs)."""
+    import jax.numpy as jnp
+    var._const_value = jnp.asarray(value)
+    return var
+
+
+@register_pass("op_stats")
+def op_stats(program: Program) -> Dict[str, int]:
+    """Analysis pass: op-name histogram (~ ir cost_model inputs)."""
+    counts: Dict[str, int] = {}
+    seen = set()
+    for v in program._vars.values():
+        node = getattr(v, "_node", None)
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        counts[node.name] = counts.get(node.name, 0) + 1
+    return counts
