@@ -84,3 +84,5 @@ def gloo_barrier():
 def gloo_release():
     """~ paddle.distributed.gloo_release — tear down CPU rendezvous state."""
     return None
+from . import fleet_executor  # noqa: F401
+from .fleet_executor import DistModel, DistModelConfig, FleetExecutor  # noqa

@@ -214,17 +214,26 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        """~ python/paddle/profiler/profiler_statistic.py summary tables:
+        per-op calls/total/avg/max/ratio sorted by total time."""
         with _spans.lock:
             events = list(_spans.events)
         agg = {}
         for e in events:
             name = e["name"]
-            a = agg.setdefault(name, [0, 0.0])
+            a = agg.setdefault(name, [0, 0.0, 0.0])
+            dur = e["dur"] / 1000.0
             a[0] += 1
-            a[1] += e["dur"] / 1000.0
-        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
-        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
-            lines.append(f"{name[:40]:40s} {calls:8d} {total:12.3f}")
+            a[1] += dur
+            a[2] = max(a[2], dur)
+        grand = sum(a[1] for a in agg.values()) or 1.0
+        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s} "
+                 f"{'avg_ms':>10s} {'max_ms':>10s} {'ratio':>7s}"]
+        for name, (calls, total, mx) in sorted(
+                agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(
+                f"{name[:40]:40s} {calls:8d} {total:12.3f} "
+                f"{total / calls:10.3f} {mx:10.3f} {total / grand:6.1%}")
         return "\n".join(lines)
 
 

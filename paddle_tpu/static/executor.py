@@ -289,3 +289,57 @@ class Executor:
                 (new_opt_states if train else None)
 
         return jax.jit(step)
+
+
+def _dataset_batches(dataset):
+    """Iterate batches from a fleet Dataset (InMemoryDataset/QueueDataset)
+    or any iterable of feed tuples."""
+    return iter(dataset)
+
+
+def _install_dataset_loops():
+    """Executor.train_from_dataset / infer_from_dataset.
+
+    ~ framework/trainer.h MultiTrainer + Executor::RunFromDataset
+    (framework/executor.cc:157): the reference spawns DeviceWorker threads
+    pulling from a C++ DataFeed; here each batch feeds the jit-compiled
+    program (XLA's async dispatch keeps the device busy while the host
+    prepares the next feed — the HogwildWorker role)."""
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      print_period, debug)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      print_period, debug)
+
+    def _run_from_dataset(self, program, dataset, fetch_list, print_period,
+                          debug):
+        prog = program if program is not None else G.default_main_program()
+        if isinstance(prog, CompiledProgram):
+            prog = prog._program
+        if dataset is None:
+            raise ValueError("dataset must be provided")
+        feed_names = sorted(prog._datas)
+        last = None
+        for it, batch in enumerate(_dataset_batches(dataset)):
+            if not isinstance(batch, (tuple, list)):
+                batch = (batch,)
+            feed = dict(zip(feed_names, batch))
+            last = self.run(prog, feed=feed, fetch_list=fetch_list)
+            if debug and fetch_list and it % print_period == 0:
+                print(f"[dataset iter {it}] "
+                      + " ".join(str(v) for v in last))
+        return last
+
+    Executor.train_from_dataset = train_from_dataset
+    Executor.infer_from_dataset = infer_from_dataset
+    Executor._run_from_dataset = _run_from_dataset
+
+
+_install_dataset_loops()

@@ -168,3 +168,56 @@ class TestSpawn:
     def test_spawn_api_exists(self):
         import paddle_tpu.distributed as dist
         assert callable(dist.spawn)
+
+
+class TestTrainerLoops:
+    def test_train_from_dataset(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            start = static.Program()
+            with static.program_guard(prog, start):
+                x = static.data("x", [4, 8], "float32")
+                y = static.data("y", [4, 1], "float32")
+                pred = static.nn.fc(x, 1)
+                loss = paddle.mean(paddle.pow(pred - y, 2.0))
+                popt.SGD(learning_rate=0.1).minimize(loss)
+            exe = static.Executor()
+            exe.run(start)
+            rng = np.random.default_rng(0)
+            w_true = rng.standard_normal(8).astype("f4")
+            f = tmp_path / "train.txt"
+            lines = []
+            for _ in range(64):
+                feat = rng.standard_normal(8).astype("f4")
+                lines.append(" ".join(
+                    map(str, feat.tolist() + [float(feat @ w_true)])))
+            f.write_text("\n".join(lines))
+            ds = dist.InMemoryDataset()
+            ds.init(batch_size=4)
+            ds.set_filelist([str(f)])
+            ds.set_parse_fn(lambda line: (
+                np.array(line.split()[:8], np.float32),
+                np.array(line.split()[8:9], np.float32)))
+            ds.load_into_memory()
+            out = None
+            for _ in range(5):
+                out = exe.train_from_dataset(prog, ds, fetch_list=[loss])
+            assert out[0] < 1.0  # converged on the linear target
+        finally:
+            paddle.disable_static()
+
+
+class TestProfilerSummary:
+    def test_summary_table(self):
+        import paddle_tpu.profiler as prof
+        with prof.profile() as p:
+            a = paddle.randn([32, 32])
+            for _ in range(2):
+                a = paddle.matmul(a, a)
+        table = p.summary()
+        assert "op::matmul" in table
+        assert "ratio" in table.splitlines()[0]
