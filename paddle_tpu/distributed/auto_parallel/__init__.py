@@ -189,3 +189,8 @@ class Engine:
         # trivial analytic cost (params count) — planner parity stub
         n = sum(p.size for p in self.model.parameters())
         return {"total_params": n}
+
+from . import cost_model  # noqa: F401,E402
+from . import planner  # noqa: F401,E402
+from .cost_model import Cluster, CostModel, DeviceSpec, LinkSpec, ModelSpec  # noqa: F401,E402
+from .planner import Plan, Planner  # noqa: F401,E402

@@ -1,0 +1,161 @@
+"""Cost model for parallelization planning.
+
+~ python/paddle/distributed/auto_parallel/cost_model.py:185 (+ cost/ op-cost
+classes, cluster.py:395 device/link modeling): analytic estimates of
+compute time (FLOPs / peak), collective time (ring allreduce / all-gather /
+all-to-all over link bandwidth) and pipeline bubble, used by the Planner to
+rank (dp, mp, pp) factorizations.
+
+TPU numbers default to a v5p-ish chip (bf16 peak, ICI bandwidth per
+direction); override via ``Cluster``.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class DeviceSpec:
+    """~ cluster.py Device: one accelerator."""
+    peak_flops: float = 459e12        # bf16 FLOP/s (v5p)
+    mem_bytes: float = 95e9           # HBM per chip
+    mem_bw: float = 2.76e12           # HBM bytes/s
+
+
+@dataclass
+class LinkSpec:
+    """~ cluster.py Link: ICI (intra-slice) or DCN (cross-slice)."""
+    bandwidth: float = 9e10           # bytes/s per direction per link (ICI)
+    latency: float = 1e-6
+
+
+@dataclass
+class Cluster:
+    """~ cluster.py Cluster — homogeneous mesh of devices."""
+    n_devices: int = 8
+    device: DeviceSpec = field(default_factory=DeviceSpec)
+    ici: LinkSpec = field(default_factory=LinkSpec)
+    dcn: LinkSpec = field(default_factory=LinkSpec(
+        bandwidth=2.5e10, latency=1e-4).__class__)
+
+    def __post_init__(self):
+        if isinstance(self.dcn, type):
+            self.dcn = LinkSpec(bandwidth=2.5e10, latency=1e-4)
+
+
+class CommCost:
+    """Collective time estimates on a ring of ``n`` devices."""
+
+    def __init__(self, link: LinkSpec, n: int):
+        self.link = link
+        self.n = max(1, n)
+
+    def all_reduce(self, nbytes: float) -> float:
+        if self.n <= 1:
+            return 0.0
+        return (2 * (self.n - 1) / self.n) * nbytes / self.link.bandwidth \
+            + 2 * (self.n - 1) * self.link.latency
+
+    def all_gather(self, nbytes_per_shard: float) -> float:
+        if self.n <= 1:
+            return 0.0
+        return (self.n - 1) * nbytes_per_shard / self.link.bandwidth \
+            + (self.n - 1) * self.link.latency
+
+    reduce_scatter = all_gather
+
+    def all_to_all(self, nbytes_total: float) -> float:
+        if self.n <= 1:
+            return 0.0
+        return (self.n - 1) / self.n * nbytes_total / self.link.bandwidth \
+            + (self.n - 1) * self.link.latency
+
+    def p2p(self, nbytes: float) -> float:
+        return nbytes / self.link.bandwidth + self.link.latency
+
+
+@dataclass
+class ModelSpec:
+    """Transformer-LM shape for planning (the role of the serial program +
+    dist attrs in the reference's cost model). ``global_batch`` is fixed
+    across candidate plans — dp divides it."""
+    n_layers: int = 32
+    hidden: int = 4096
+    intermediate: int = 11008
+    vocab: int = 32000
+    seq: int = 2048
+    global_batch: int = 64
+    bytes_per_param: int = 2          # bf16
+
+    @property
+    def n_params(self) -> int:
+        per_layer = (4 * self.hidden * self.hidden
+                     + 3 * self.hidden * self.intermediate)
+        return self.n_layers * per_layer + 2 * self.vocab * self.hidden
+
+    def step_flops(self) -> float:
+        """Total training FLOPs of one global step (all replicas)."""
+        tokens = self.global_batch * self.seq
+        attn = 12 * self.n_layers * self.hidden * self.seq * tokens
+        return 6 * self.n_params * tokens + attn
+
+
+class CostModel:
+    """Per-step time estimate for a (dp, mp, pp) plan
+    (~ cost_model.py CostModel.get_runtime)."""
+
+    def __init__(self, cluster: Cluster, model: ModelSpec):
+        self.cluster = cluster
+        self.model = model
+
+    def estimate(self, dp: int, mp: int, pp: int,
+                 n_microbatches: Optional[int] = None) -> Dict[str, float]:
+        c = self.cluster
+        m = self.model
+        if dp * mp * pp != c.n_devices:
+            raise ValueError(f"dp*mp*pp = {dp * mp * pp} != "
+                             f"{c.n_devices} devices")
+        if m.global_batch % dp:
+            raise ValueError(f"global_batch {m.global_batch} not divisible "
+                             f"by dp {dp}")
+        batch_per_replica = m.global_batch // dp
+        M = n_microbatches or max(1, 4 * pp)
+        # compute: the global step's FLOPs spread over every device (dp
+        # splits batch, mp splits matmuls, pp splits layers)
+        eff = 0.55  # achievable fraction of peak for dense transformer steps
+        compute = m.step_flops() / (dp * mp * pp) / (c.device.peak_flops * eff)
+
+        comm_mp = CommCost(c.ici, mp)
+        comm_dp = CommCost(c.ici, dp)
+        comm_pp = CommCost(c.ici, pp)
+
+        # tensor-parallel: 4 allreduces of (b, s, h) activations per layer
+        # (2 fwd + 2 bwd), layers split over pp
+        act_bytes = batch_per_replica * m.seq * m.hidden \
+            * m.bytes_per_param / M
+        tp_time = (m.n_layers / pp) * 4 * M * comm_mp.all_reduce(act_bytes) \
+            if mp > 1 else 0.0
+
+        # data-parallel gradient allreduce of this rank's param shard
+        grad_bytes = m.n_params / (mp * pp) * 4  # f32 grads
+        dp_time = comm_dp.all_reduce(grad_bytes) if dp > 1 else 0.0
+
+        # pipeline: bubble fraction + p2p per microbatch boundary
+        bubble = (pp - 1) / (M + pp - 1) if pp > 1 else 0.0
+        p2p_time = 2 * M * (pp - 1) * comm_pp.p2p(act_bytes) / max(1, pp) \
+            if pp > 1 else 0.0
+
+        total = (compute + tp_time) / (1 - bubble) + dp_time + p2p_time
+
+        # memory per device: params + grads + adam moments + activations
+        param_b = m.n_params / (mp * pp) * m.bytes_per_param
+        opt_b = m.n_params / (mp * pp) * 8  # two f32 moments
+        grad_b = m.n_params / (mp * pp) * 4
+        act_b = (m.n_layers / pp) * batch_per_replica * m.seq * m.hidden \
+            * m.bytes_per_param * 4 / M  # remat'd working set
+        mem = param_b + opt_b + grad_b + act_b
+        return {"total": total, "compute": compute, "tp_comm": tp_time,
+                "dp_comm": dp_time, "pp_p2p": p2p_time, "bubble": bubble,
+                "memory_bytes": mem, "fits": mem < c.device.mem_bytes}

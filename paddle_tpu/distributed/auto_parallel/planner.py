@@ -1,0 +1,91 @@
+"""Parallelization planner: search (dp, mp, pp) over the cost model.
+
+~ python/paddle/distributed/auto_parallel/planner.py:826 (PlanSpace
+enumerating dist-attr combinations + MCMC search) and tuner/ — here the
+search space is the factorization lattice of the device count, ranked by
+the analytic CostModel; infeasible plans (OOM) are filtered first, mirroring
+the reference planner's constraint pass.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .cost_model import Cluster, CostModel, ModelSpec
+
+
+def _factorizations(n: int) -> List[tuple]:
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        rem = n // dp
+        for mp in range(1, rem + 1):
+            if rem % mp:
+                continue
+            out.append((dp, mp, rem // mp))
+    return out
+
+
+class Plan:
+    def __init__(self, dp, mp, pp, cost):
+        self.dp, self.mp, self.pp = dp, mp, pp
+        self.cost = cost
+
+    @property
+    def mesh_shape(self):
+        return {"data": self.dp, "model": self.mp, "pipe": self.pp}
+
+    def __repr__(self):
+        return (f"Plan(dp={self.dp}, mp={self.mp}, pp={self.pp}, "
+                f"step={self.cost['total'] * 1e3:.1f}ms, "
+                f"mem={self.cost['memory_bytes'] / 1e9:.1f}GB)")
+
+
+class Planner:
+    """~ planner.py Planner: enumerate, filter by memory, rank by time."""
+
+    def __init__(self, cluster: Optional[Cluster] = None,
+                 model: Optional[ModelSpec] = None,
+                 max_mp: Optional[int] = None,
+                 max_pp: Optional[int] = None):
+        self.cluster = cluster or Cluster()
+        self.model = model or ModelSpec()
+        self.max_mp = max_mp
+        self.max_pp = max_pp
+
+    def plans(self, include_oom: bool = False) -> List[Plan]:
+        cm = CostModel(self.cluster, self.model)
+        out = []
+        for dp, mp, pp in _factorizations(self.cluster.n_devices):
+            if self.max_mp and mp > self.max_mp:
+                continue
+            if self.max_pp and pp > self.max_pp:
+                continue
+            if pp > 1 and self.model.n_layers % pp:
+                continue
+            if self.model.global_batch % dp:
+                continue
+            cost = cm.estimate(dp, mp, pp)
+            if cost["fits"] or include_oom:
+                out.append(Plan(dp, mp, pp, cost))
+        out.sort(key=lambda p: (not p.cost["fits"], p.cost["total"]))
+        return out
+
+    def best(self) -> Plan:
+        plans = self.plans(include_oom=True)
+        if not plans:
+            raise RuntimeError("no feasible plan found")
+        return plans[0]
+
+    def to_mesh(self, plan: Plan):
+        """Materialize the chosen plan as a jax Mesh (axes data/model/pipe,
+        singleton axes dropped)."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        shape = [(k, v) for k, v in plan.mesh_shape.items() if v > 1]
+        if not shape:
+            shape = [("data", 1)]
+        devs = np.asarray(jax.devices()[:self.cluster.n_devices])
+        return Mesh(devs.reshape(tuple(v for _, v in shape)),
+                    tuple(k for k, _ in shape))

@@ -1,0 +1,86 @@
+"""auto_parallel cost model + planner tests."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import (
+    Cluster, CostModel, ModelSpec, Planner)
+
+
+class TestCostModel:
+    def setup_method(self, m):
+        self.cluster = Cluster(n_devices=8)
+        self.model = ModelSpec(n_layers=32, hidden=4096, intermediate=11008,
+                               vocab=32000, seq=2048, global_batch=64)
+
+    def test_factor_constraint(self):
+        cm = CostModel(self.cluster, self.model)
+        with pytest.raises(ValueError):
+            cm.estimate(3, 1, 1)
+
+    def test_pure_dp_needs_more_memory_than_sharded(self):
+        cm = CostModel(self.cluster, self.model)
+        dp8 = cm.estimate(8, 1, 1)
+        mp8 = cm.estimate(1, 8, 1)
+        assert mp8["memory_bytes"] < dp8["memory_bytes"]
+        # a 7B model on one chip with adam state doesn't fit in 95GB/8-way dp
+        assert not dp8["fits"] or dp8["memory_bytes"] > 50e9
+
+    def test_tp_adds_comm(self):
+        cm = CostModel(self.cluster, self.model)
+        assert cm.estimate(1, 8, 1)["tp_comm"] > 0
+        assert cm.estimate(8, 1, 1)["tp_comm"] == 0
+
+    def test_pp_bubble(self):
+        cm = CostModel(self.cluster, self.model)
+        e = cm.estimate(1, 1, 8)
+        assert 0 < e["bubble"] < 1
+        assert cm.estimate(8, 1, 1)["bubble"] == 0
+
+
+class TestPlanner:
+    def test_plans_cover_factorizations(self):
+        p = Planner(Cluster(n_devices=8),
+                    ModelSpec(n_layers=16, hidden=1024, intermediate=2816,
+                              vocab=32000, seq=1024, global_batch=32))
+        plans = p.plans(include_oom=True)
+        combos = {(x.dp, x.mp, x.pp) for x in plans}
+        assert (8, 1, 1) in combos and (1, 8, 1) in combos
+        assert all(x.dp * x.mp * x.pp == 8 for x in plans)
+
+    def test_best_fits_memory(self):
+        # big model: pure dp OOMs, planner must pick a sharded plan
+        p = Planner(Cluster(n_devices=8),
+                    ModelSpec(n_layers=32, hidden=8192, intermediate=28672,
+                              vocab=128000, seq=4096, global_batch=64))
+        best = p.best()
+        assert best.cost["fits"]
+        assert best.mp * best.pp > 1
+
+    def test_small_model_avoids_tensor_parallel(self):
+        p = Planner(Cluster(n_devices=8),
+                    ModelSpec(n_layers=4, hidden=512, intermediate=1024,
+                              vocab=8000, seq=512, global_batch=32))
+        best = p.best()
+        # tiny model: per-layer TP allreduces can't pay for themselves
+        assert best.mp == 1
+        assert best.cost["fits"]
+        # ranking is by estimated step time among feasible plans
+        plans = p.plans()
+        totals = [x.cost["total"] for x in plans]
+        assert totals == sorted(totals)
+
+    def test_to_mesh(self):
+        p = Planner(Cluster(n_devices=8),
+                    ModelSpec(n_layers=8, hidden=512, intermediate=1024,
+                              vocab=8000, seq=512, global_batch=32))
+        best = p.best()
+        mesh = p.to_mesh(best)
+        assert int(np.prod(list(mesh.shape.values()))) == 8
+
+    def test_layer_divisibility_filter(self):
+        p = Planner(Cluster(n_devices=8),
+                    ModelSpec(n_layers=30, hidden=1024, intermediate=2816,
+                              vocab=32000, seq=1024, global_batch=32))
+        plans = p.plans(include_oom=True)
+        assert all(x.pp in (1, 2, 5, 6) or 30 % x.pp == 0 for x in plans)
+        assert not any(x.pp == 4 for x in plans)
