@@ -1,0 +1,78 @@
+"""Custom-device plugin slot.
+
+~ paddle/phi/backends/device_ext.h ``C_DeviceInterface`` + custom_device.cc
+:692 (dlopen + InitPlugin): the reference lets vendors ship a shared object
+implementing a C device ABI, discovered from CUSTOM_DEVICE_ROOT.
+
+TPU-native equivalent: the PJRT plugin ABI — jax discovers backend plugins
+(shared objects exporting GetPjrtApi) via explicit registration or the
+``jax_plugins`` entry-point namespace. This module is the paddle-flavored
+registration surface over it, plus a fake test double (the
+fake_cpu_device.h role) that aliases the CPU backend so plugin-path code is
+testable without vendor hardware.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+_REGISTERED: Dict[str, dict] = {}
+_FAKE_DEVICES: Dict[str, str] = {}
+
+
+def register_custom_device(name: str, library_path: Optional[str] = None,
+                           options: Optional[dict] = None) -> None:
+    """Register a PJRT plugin as a named custom device.
+
+    library_path: shared object exporting ``GetPjrtApi`` (the PJRT C ABI —
+    the C_DeviceInterface analog). Must exist at call time.
+    """
+    if library_path is not None:
+        if not os.path.exists(library_path):
+            raise FileNotFoundError(
+                f"custom device plugin not found: {library_path}")
+        from jax._src import xla_bridge
+        xla_bridge.register_plugin(name, library_path=library_path,
+                                   options=options or {})
+    _REGISTERED[name] = {"library_path": library_path,
+                         "options": options or {}}
+
+
+def register_fake_device(name: str, backend: str = "cpu") -> None:
+    """Test double (~ phi/backends/custom/fake_cpu_device.h): alias an
+    existing backend under a custom device name so plugin-path code can be
+    exercised hardware-free."""
+    _FAKE_DEVICES[name] = backend
+    _REGISTERED[name] = {"library_path": None, "fake_backend": backend,
+                         "options": {}}
+
+
+def get_all_custom_device_type() -> list:
+    """~ paddle.device.get_all_custom_device_type."""
+    return sorted(_REGISTERED)
+
+
+def is_custom_device(name: str) -> bool:
+    return name in _REGISTERED
+
+
+def get_device_count(name: str) -> int:
+    import jax
+    if name in _FAKE_DEVICES:
+        return len(jax.devices(_FAKE_DEVICES[name]))
+    try:
+        return len(jax.devices(name))
+    except RuntimeError:
+        return 0
+
+
+def devices(name: str) -> list:
+    import jax
+    if name in _FAKE_DEVICES:
+        return jax.devices(_FAKE_DEVICES[name])
+    return jax.devices(name)
+
+
+def unregister_custom_device(name: str) -> None:
+    _REGISTERED.pop(name, None)
+    _FAKE_DEVICES.pop(name, None)

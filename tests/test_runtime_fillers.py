@@ -221,3 +221,22 @@ class TestProfilerSummary:
         table = p.summary()
         assert "op::matmul" in table
         assert "ratio" in table.splitlines()[0]
+
+
+class TestCustomDevice:
+    def test_fake_device_roundtrip(self):
+        from paddle_tpu.framework import custom_device as cd
+        cd.register_fake_device("my_npu", backend="cpu")
+        try:
+            assert cd.is_custom_device("my_npu")
+            assert "my_npu" in cd.get_all_custom_device_type()
+            assert cd.get_device_count("my_npu") >= 1
+            assert len(cd.devices("my_npu")) >= 1
+        finally:
+            cd.unregister_custom_device("my_npu")
+        assert not cd.is_custom_device("my_npu")
+
+    def test_missing_plugin_rejected(self):
+        from paddle_tpu.framework import custom_device as cd
+        with pytest.raises(FileNotFoundError):
+            cd.register_custom_device("ghost", "/nonexistent/plugin.so")

@@ -1,0 +1,90 @@
+"""Per-op latency benchmark harness.
+
+~ tools/ci_op_benchmark.sh + paddle/fluid/operators/benchmark/op_tester.cc
+(+ check_op_benchmark_result.py): measure registered ops on canonical
+shapes, write a JSON report, and compare against a stored baseline with a
+relative-regression gate — the per-op CI gate of the reference.
+
+Usage:
+  python tools/op_bench.py --out /tmp/ops.json            # measure
+  python tools/op_bench.py --out new.json --baseline old.json --gate 1.15
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+CASES = [
+    # (op path under paddle_tpu, args builder, name)
+    ("matmul", lambda p, np: (p.randn([1024, 1024]), p.randn([1024, 1024]))),
+    ("add", lambda p, np: (p.randn([4096, 1024]), p.randn([4096, 1024]))),
+    ("softmax", lambda p, np: (p.randn([256, 4096]),)),
+    ("exp", lambda p, np: (p.randn([4096, 1024]),)),
+    ("sum", lambda p, np: (p.randn([4096, 1024]),)),
+    ("transpose", lambda p, np: (p.randn([512, 512, 16]), [2, 0, 1])),
+    ("tanh", lambda p, np: (p.randn([4096, 1024]),)),
+    ("mean", lambda p, np: (p.randn([4096, 1024]),)),
+]
+
+
+def time_op(fn, args, iters=20, warmup=3):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out._value if hasattr(out, "_value") else out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out._value if hasattr(out, "_value") else out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/op_bench.json")
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--gate", type=float, default=1.2,
+                    help="fail if new/old latency ratio exceeds this")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import numpy as np
+    import paddle_tpu as paddle
+
+    report = {}
+    for name, build in CASES:
+        fn = getattr(paddle, name)
+        case_args = build(paddle, np)
+        dt = time_op(fn, case_args, iters=args.iters)
+        report[name] = {"latency_ms": dt * 1e3}
+        print(f"{name:12s} {dt * 1e3:10.4f} ms")
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"wrote {args.out}")
+
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        regressions = []
+        for name, entry in report.items():
+            if name in base:
+                ratio = entry["latency_ms"] / max(
+                    1e-9, base[name]["latency_ms"])
+                flag = " REGRESSION" if ratio > args.gate else ""
+                print(f"{name:12s} ratio {ratio:6.3f}{flag}")
+                if ratio > args.gate:
+                    regressions.append(name)
+        if regressions:
+            print(f"FAILED gate ({args.gate}x): {regressions}")
+            sys.exit(1)
+        print("op benchmark gate passed")
+
+
+if __name__ == "__main__":
+    main()
