@@ -88,7 +88,6 @@ def build_pod(args) -> List[Container]:
     world = args.nnodes * nproc
     master_ep = args.master or "127.0.0.1:34782"
 
-    endpoints = None
     if args.nnodes > 1:
         master = HTTPMaster(master_ep, is_host=args.node_rank == 0)
         import socket
@@ -96,6 +95,12 @@ def build_pod(args) -> List[Container]:
         peers = master.sync_peers("peers", f"{my_ip}:{nproc}",
                                   args.node_rank, args.nnodes)
         endpoints = ",".join(peers)
+    else:
+        # single node: one endpoint per local rank (reference contract —
+        # PADDLE_TRAINER_ENDPOINTS is always present, collective.py:83-91)
+        host, port = (master_ep.split(":") + ["34782"])[:2]
+        endpoints = ",".join(f"{host}:{int(port) + 100 + r}"
+                             for r in range(world))
 
     containers = []
     for local_rank in range(nproc):
