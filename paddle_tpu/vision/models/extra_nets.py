@@ -1,0 +1,359 @@
+"""MobileNetV3, GoogLeNet, InceptionV3 + variant factories.
+
+~ python/paddle/vision/models/{mobilenetv3,googlenet,inceptionv3}.py and the
+resnext/wide/densenet/shufflenet variant constructors of the reference's
+model zoo. Plain conv/SE compositions — XLA fuses the conv+BN+act chains.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = {"relu": nn.ReLU(), "hardswish": nn.Hardswish(),
+                    None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, c, reduce=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, c // reduce, 1)
+        self.fc2 = nn.Conv2D(c // reduce, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidualV3(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(_ConvBNAct(in_c, exp_c, 1, act=act))
+        layers.append(_ConvBNAct(exp_c, exp_c, k, stride=stride,
+                                 padding=k // 2, groups=exp_c, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(exp_c))
+        layers.append(_ConvBNAct(exp_c, out_c, 1, act=None))
+        self.body = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.body(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    """~ vision/models/mobilenetv3.py MobileNetV3Small/Large."""
+
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+
+        def c(v):
+            return max(8, int(v * scale + 4) // 8 * 8)
+
+        self.stem = _ConvBNAct(3, c(16), 3, stride=2, padding=1,
+                               act="hardswish")
+        blocks = []
+        in_c = c(16)
+        for k, exp, out, se, act, stride in config:
+            blocks.append(_InvertedResidualV3(in_c, c(exp), c(out), k,
+                                              stride, se, act))
+            in_c = c(out)
+        last_conv = c(config[-1][1])
+        blocks.append(_ConvBNAct(in_c, last_conv, 1, act="hardswish"))
+        self.blocks = nn.Sequential(*blocks)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+# ---- GoogLeNet (Inception v1) ----------------------------------------------
+
+class _InceptionBlock(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, c1, 1)
+        self.b2 = nn.Sequential(_ConvBNAct(in_c, c3r, 1),
+                                _ConvBNAct(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvBNAct(in_c, c5r, 1),
+                                _ConvBNAct(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                _ConvBNAct(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """~ vision/models/googlenet.py — returns (main, aux1, aux2) logits in
+    train mode like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, 2, padding=1),
+            _ConvBNAct(64, 64, 1),
+            _ConvBNAct(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _InceptionBlock(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionBlock(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _InceptionBlock(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionBlock(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionBlock(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionBlock(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionBlock(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _InceptionBlock(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionBlock(832, 384, 192, 384, 48, 128, 128)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        # aux heads
+        self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                  _ConvBNAct(512, 128, 1))
+        self.aux1_fc = nn.Sequential(nn.Linear(128 * 16, 1024), nn.ReLU(),
+                                     nn.Dropout(0.7),
+                                     nn.Linear(1024, num_classes))
+        self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                  _ConvBNAct(528, 128, 1))
+        self.aux2_fc = nn.Sequential(nn.Linear(128 * 16, 1024), nn.ReLU(),
+                                     nn.Dropout(0.7),
+                                     nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self.aux1_fc(flatten(self.aux1(x), 1)) if self.training \
+            else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2_fc(flatten(self.aux2(x), 1)) if self.training \
+            else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        out = self.fc(self.dropout(flatten(self.pool(x), 1)))
+        if self.training:
+            return out, aux2, aux1
+        return out
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+# ---- InceptionV3 -----------------------------------------------------------
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, 64, 1)
+        self.b2 = nn.Sequential(_ConvBNAct(in_c, 48, 1),
+                                _ConvBNAct(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBNAct(in_c, 64, 1),
+                                _ConvBNAct(64, 96, 3, padding=1),
+                                _ConvBNAct(96, 96, 3, padding=1))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNAct(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], 1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, 384, 3, stride=2)
+        self.b2 = nn.Sequential(_ConvBNAct(in_c, 64, 1),
+                                _ConvBNAct(64, 96, 3, padding=1),
+                                _ConvBNAct(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.pool(x)], 1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, 192, 1)
+        self.b2 = nn.Sequential(
+            _ConvBNAct(in_c, c7, 1),
+            _ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNAct(c7, 192, (7, 1), padding=(3, 0)))
+        self.b3 = nn.Sequential(
+            _ConvBNAct(in_c, c7, 1),
+            _ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNAct(c7, 192, (1, 7), padding=(0, 3)))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNAct(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], 1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = nn.Sequential(_ConvBNAct(in_c, 192, 1),
+                                _ConvBNAct(192, 320, 3, stride=2))
+        self.b2 = nn.Sequential(
+            _ConvBNAct(in_c, 192, 1),
+            _ConvBNAct(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBNAct(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBNAct(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.pool(x)], 1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, 320, 1)
+        self.b2_stem = _ConvBNAct(in_c, 384, 1)
+        self.b2_a = _ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b2_b = _ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.b3_stem = nn.Sequential(_ConvBNAct(in_c, 448, 1),
+                                     _ConvBNAct(448, 384, 3, padding=1))
+        self.b3_a = _ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNAct(in_c, 192, 1))
+
+    def forward(self, x):
+        h2 = self.b2_stem(x)
+        h3 = self.b3_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b2_a(h2), self.b2_b(h2)], 1),
+                       concat([self.b3_a(h3), self.b3_b(h3)], 1),
+                       self.b4(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """~ vision/models/inceptionv3.py (299x299 input)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 32, 3, stride=2),
+            _ConvBNAct(32, 32, 3),
+            _ConvBNAct(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2),
+            _ConvBNAct(64, 80, 1),
+            _ConvBNAct(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)

@@ -53,14 +53,24 @@ class _Fire(nn.Layer):
 class SqueezeNet(nn.Layer):
     def __init__(self, version="1.1", num_classes=1000):
         super().__init__()
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
-            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
-            nn.MaxPool2D(3, 2),
-            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-            nn.MaxPool2D(3, 2),
-            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
         self.classifier = nn.Sequential(
             nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
             nn.AdaptiveAvgPool2D((1, 1)))
@@ -70,13 +80,18 @@ class SqueezeNet(nn.Layer):
         return flatten(x, 1)
 
 
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
 def squeezenet1_1(pretrained=False, **kw):
     return SqueezeNet("1.1", **kw)
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
         self.stride = stride
         branch_c = out_c // 2
         if stride > 1:
@@ -85,19 +100,19 @@ class _ShuffleUnit(nn.Layer):
                           groups=in_c, bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU())
+                nn.BatchNorm2D(branch_c), act_layer())
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.BatchNorm2D(branch_c), act_layer(),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
             nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU())
+            nn.BatchNorm2D(branch_c), act_layer())
 
     def forward(self, x):
         from ...nn.functional import channel_shuffle
@@ -111,9 +126,11 @@ class _ShuffleUnit(nn.Layer):
 
 
 class ShuffleNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000):
+    def __init__(self, scale=1.0, num_classes=1000, act="relu"):
         super().__init__()
-        stage_out = {0.5: [24, 48, 96, 192, 1024],
+        stage_out = {0.25: [24, 24, 48, 96, 512],
+                     0.33: [24, 32, 64, 128, 512],
+                     0.5: [24, 48, 96, 192, 1024],
                      1.0: [24, 116, 232, 464, 1024],
                      1.5: [24, 176, 352, 704, 1024],
                      2.0: [24, 244, 488, 976, 2048]}[scale]
@@ -127,9 +144,9 @@ class ShuffleNetV2(nn.Layer):
         in_c = stage_out[0]
         for i, r in enumerate(repeats):
             out_c = stage_out[i + 1]
-            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
             for _ in range(r - 1):
-                units.append(_ShuffleUnit(out_c, out_c, 1))
+                units.append(_ShuffleUnit(out_c, out_c, 1, act))
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.LayerList(stages)
@@ -151,6 +168,30 @@ def shufflenet_v2_x1_0(pretrained=False, **kw):
     return ShuffleNetV2(1.0, **kw)
 
 
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)
+
+
 class _DenseLayer(nn.Layer):
     def __init__(self, in_c, growth, bn_size):
         super().__init__()
@@ -170,7 +211,8 @@ class DenseNet(nn.Layer):
                  num_classes=1000):
         super().__init__()
         cfg = {121: [6, 12, 24, 16], 161: [6, 12, 36, 24],
-               169: [6, 12, 32, 32], 201: [6, 12, 48, 32]}[layers]
+               169: [6, 12, 32, 32], 201: [6, 12, 48, 32],
+               264: [6, 12, 64, 48]}[layers]
         c = 64
         feats = [nn.Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False),
                  nn.BatchNorm2D(c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1)]
@@ -195,6 +237,22 @@ class DenseNet(nn.Layer):
 
 def densenet121(pretrained=False, **kw):
     return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, growth_rate=48, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
 
 
 class MobileNetV1(nn.Layer):
