@@ -6,3 +6,39 @@ MNIST/Cifar/... datasets).
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+
+from .datasets import DatasetFolder, Flowers, VOC2012  # noqa: F401
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """~ paddle.vision.set_image_backend ('pil' | 'cv2' | 'tensor')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """~ paddle.vision.image_load — decode an image file via the configured
+    host backend (PIL; 'tensor' returns a CHW float Tensor)."""
+    import numpy as np
+    b = backend or _image_backend
+    from PIL import Image
+    img = Image.open(path)
+    if b == "pil":
+        return img
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    if b == "tensor":
+        from ..core.tensor import Tensor
+        return Tensor(arr.astype(np.float32) / 255.0)
+    return arr  # cv2-style ndarray

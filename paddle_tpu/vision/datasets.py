@@ -170,3 +170,69 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class DatasetFolder(ImageFolder):
+    """~ vision/datasets/folder.py DatasetFolder: class-subdirectory layout
+    -> (sample, class_idx). (ImageFolder above already implements this
+    layout; the reference's flat ImageFolder variant is the loader=None
+    case of paddle.vision.image_load over a file list.)"""
+
+
+class Flowers(Dataset):
+    """~ vision/datasets/flowers.py (102-category flowers); local copy or
+    deterministic synthetic fallback (zero-egress env)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        local = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/datasets/flowers.npz")
+        if os.path.exists(local):
+            d = np.load(local)
+            self.x = d[f"x_{mode}"]
+            self.y = d[f"y_{mode}"]
+        else:
+            rng = np.random.default_rng(11 if mode == "train" else 12)
+            n = 1020 if mode == "train" else 102
+            self.x = rng.random((n, 3, 32, 32), np.float32)
+            self.y = np.tile(np.arange(102), n // 102 + 1)[:n].astype(
+                np.int64)
+
+    def __getitem__(self, i):
+        img = self.x[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class VOC2012(Dataset):
+    """~ vision/datasets/voc2012.py (segmentation pairs); local copy or
+    synthetic image/mask pairs."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        local = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/datasets/voc2012.npz")
+        if os.path.exists(local):
+            d = np.load(local)
+            self.x = d[f"x_{mode}"]
+            self.y = d[f"y_{mode}"]
+        else:
+            rng = np.random.default_rng(13 if mode == "train" else 14)
+            n = 128 if mode == "train" else 32
+            self.x = rng.random((n, 3, 64, 64), np.float32)
+            self.y = rng.integers(0, 21, (n, 64, 64)).astype(np.int64)
+
+    def __getitem__(self, i):
+        img = self.x[i]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.y[i]
+
+    def __len__(self):
+        return len(self.x)
