@@ -1,0 +1,122 @@
+// Native profiler span collector.
+//
+// ~ the reference's HostTracer ring (paddle/fluid/platform/profiler/
+// host_tracer.h:46 consuming RecordEvent spans, event collection in
+// host_event_recorder.h): the per-op instrumentation path runs on every
+// eager dispatch, so span recording must not contend or allocate.
+// This is a fixed-capacity ring of POD records with an interned name table;
+// writers take an atomic slot (overwrite-oldest), the only lock guards the
+// cold name-intern path.
+//
+// C ABI for ctypes (paddle_tpu/profiler binds with python fallback parity).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct SpanRecord {
+  int32_t name_id;
+  int32_t pad;
+  double t0;       // seconds
+  double dur;      // seconds
+  uint64_t tid;
+};
+
+struct Collector {
+  std::vector<SpanRecord> ring;
+  std::atomic<uint64_t> next{0};
+  std::mutex intern_mu;
+  std::unordered_map<std::string, int32_t> ids;
+  std::vector<std::string> names;
+
+  explicit Collector(size_t cap) : ring(cap) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* spans_create(uint64_t capacity) {
+  if (capacity == 0) capacity = 1 << 16;
+  return new Collector(static_cast<size_t>(capacity));
+}
+
+void spans_destroy(void* h) { delete static_cast<Collector*>(h); }
+
+int32_t spans_intern(void* h, const char* name) {
+  auto* c = static_cast<Collector*>(h);
+  std::lock_guard<std::mutex> g(c->intern_mu);
+  auto it = c->ids.find(name);
+  if (it != c->ids.end()) return it->second;
+  int32_t id = static_cast<int32_t>(c->names.size());
+  c->names.emplace_back(name);
+  c->ids.emplace(name, id);
+  return id;
+}
+
+void spans_add(void* h, int32_t name_id, double t0, double dur,
+               uint64_t tid) {
+  auto* c = static_cast<Collector*>(h);
+  uint64_t slot = c->next.fetch_add(1, std::memory_order_relaxed);
+  SpanRecord& r = c->ring[slot % c->ring.size()];
+  r.name_id = name_id;
+  r.t0 = t0;
+  r.dur = dur;
+  r.tid = tid;
+}
+
+uint64_t spans_count(void* h) {
+  auto* c = static_cast<Collector*>(h);
+  uint64_t n = c->next.load(std::memory_order_relaxed);
+  uint64_t cap = c->ring.size();
+  return n < cap ? n : cap;
+}
+
+uint64_t spans_total(void* h) {
+  return static_cast<Collector*>(h)->next.load(std::memory_order_relaxed);
+}
+
+// Copy up to max_n oldest-to-newest records into parallel output arrays.
+// Returns number copied.
+uint64_t spans_dump(void* h, int32_t* name_ids, double* t0s, double* durs,
+                    uint64_t* tids, uint64_t max_n) {
+  auto* c = static_cast<Collector*>(h);
+  uint64_t total = c->next.load(std::memory_order_relaxed);
+  uint64_t cap = c->ring.size();
+  uint64_t n = total < cap ? total : cap;
+  if (n > max_n) n = max_n;
+  uint64_t start = total < cap ? 0 : total % cap;  // oldest slot
+  for (uint64_t i = 0; i < n; ++i) {
+    const SpanRecord& r = c->ring[(start + i) % cap];
+    name_ids[i] = r.name_id;
+    t0s[i] = r.t0;
+    durs[i] = r.dur;
+    tids[i] = r.tid;
+  }
+  return n;
+}
+
+// Name for an interned id; returns bytes copied (0 if unknown).
+uint64_t spans_name(void* h, int32_t id, char* out, uint64_t out_len) {
+  auto* c = static_cast<Collector*>(h);
+  std::lock_guard<std::mutex> g(c->intern_mu);
+  if (id < 0 || static_cast<size_t>(id) >= c->names.size()) return 0;
+  const std::string& s = c->names[id];
+  uint64_t n = s.size() < out_len - 1 ? s.size() : out_len - 1;
+  std::memcpy(out, s.data(), n);
+  out[n] = '\0';
+  return n;
+}
+
+void spans_reset(void* h) {
+  auto* c = static_cast<Collector*>(h);
+  c->next.store(0, std::memory_order_relaxed);
+}
+
+}  // extern "C"

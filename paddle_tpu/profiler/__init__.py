@@ -64,17 +64,87 @@ def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
 
 
 class _SpanStore:
-    def __init__(self):
+    """Span sink. Hot path (add) goes through the native ring collector
+    (csrc/span_collector.cc — atomic slot claim, interned names, no
+    allocation; ~ the reference's HostTracer/host_event_recorder ring) when
+    libpaddle_tpu_native is built; pure-python list otherwise."""
+
+    def __init__(self, capacity=1 << 16):
         self.lock = threading.Lock()
-        self.events = []
+        self.events = None          # python fallback storage
         self.enabled = False
+        self._native = None
+        self._ids = {}
+        try:
+            import ctypes
+            from ..utils import native as _nat
+            lib = _nat.get_lib()
+            if lib is not None and hasattr(lib, "spans_create"):
+                lib.spans_create.restype = ctypes.c_void_p
+                lib.spans_create.argtypes = [ctypes.c_uint64]
+                lib.spans_destroy.argtypes = [ctypes.c_void_p]
+                lib.spans_intern.restype = ctypes.c_int32
+                lib.spans_intern.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p]
+                lib.spans_add.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                          ctypes.c_double, ctypes.c_double,
+                                          ctypes.c_uint64]
+                lib.spans_count.restype = ctypes.c_uint64
+                lib.spans_count.argtypes = [ctypes.c_void_p]
+                lib.spans_dump.restype = ctypes.c_uint64
+                lib.spans_name.restype = ctypes.c_uint64
+                lib.spans_reset.argtypes = [ctypes.c_void_p]
+                self._lib = lib
+                self._native = ctypes.c_void_p(lib.spans_create(capacity))
+                self._capacity = capacity
+        except Exception:
+            self._native = None
+        if self._native is None:
+            self.events = []
 
     def add(self, name, ts, dur, tid):
         if not self.enabled:
             return
+        if self._native is not None:
+            nid = self._ids.get(name)
+            if nid is None:
+                nid = self._lib.spans_intern(self._native, name.encode())
+                self._ids[name] = nid
+            self._lib.spans_add(self._native, nid, ts, dur, tid & ((1 << 63) - 1))
+            return
         with self.lock:
             self.events.append({"name": name, "ph": "X", "pid": os.getpid(),
                                 "tid": tid, "ts": ts * 1e6, "dur": dur * 1e6})
+
+    def drain(self):
+        """Chrome-trace event dicts for everything recorded so far."""
+        if self._native is None:
+            with self.lock:
+                return list(self.events)
+        import ctypes
+        import numpy as np
+        n = int(self._lib.spans_count(self._native))
+        if n == 0:
+            return []
+        name_ids = (ctypes.c_int32 * n)()
+        t0s = (ctypes.c_double * n)()
+        durs = (ctypes.c_double * n)()
+        tids = (ctypes.c_uint64 * n)()
+        got = int(self._lib.spans_dump(self._native, name_ids, t0s, durs,
+                                       tids, n))
+        id_to_name = {v: k for k, v in self._ids.items()}
+        pid = os.getpid()
+        return [{"name": id_to_name.get(name_ids[i], f"id{name_ids[i]}"),
+                 "ph": "X", "pid": pid, "tid": int(tids[i]),
+                 "ts": t0s[i] * 1e6, "dur": durs[i] * 1e6}
+                for i in range(got)]
+
+    def clear(self):
+        if self._native is not None:
+            self._lib.spans_reset(self._native)
+        elif self.events is not None:
+            with self.lock:
+                self.events.clear()
 
 
 _spans = _SpanStore()
@@ -204,8 +274,7 @@ class Profiler:
 
     # -- export -------------------------------------------------------------
     def _export_chrome(self, path):
-        with _spans.lock:
-            events = list(_spans.events)
+        events = _spans.drain()
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 
@@ -216,8 +285,7 @@ class Profiler:
                 time_unit="ms"):
         """~ python/paddle/profiler/profiler_statistic.py summary tables:
         per-op calls/total/avg/max/ratio sorted by total time."""
-        with _spans.lock:
-            events = list(_spans.events)
+        events = _spans.drain()
         agg = {}
         for e in events:
             name = e["name"]
