@@ -86,3 +86,4 @@ def gloo_release():
     return None
 from . import fleet_executor  # noqa: F401
 from .fleet_executor import DistModel, DistModelConfig, FleetExecutor  # noqa
+from . import passes  # noqa: F401

@@ -1,0 +1,182 @@
+"""Distributed program-level passes.
+
+~ python/paddle/distributed/passes/ (pass_base.py PassBase/PassContext +
+register_pass, with auto_parallel_{amp,fp16,recompute,sharding,
+gradient_merge}.py and fuse_all_reduce.py).
+
+TPU form: the reference's passes rewrite ProgramDesc blocks; here the
+train-step factories compile whatever the DistributedStrategy requests, so
+a pass is a typed transformation of (strategy, model, optimizer) — amp
+flips the bf16 policy, recompute flips remat, sharding sets the ZeRO axis,
+gradient_merge sets accumulate steps. ``fuse_all_reduce`` is advisory (XLA
+fuses collective chains itself) but validates/records the bucket size.
+The PassManager contract (apply in order, check_before/after) matches the
+reference so tooling built against it ports over.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    """~ pass_base.py register_pass decorator."""
+    def deco(cls):
+        cls.name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def new_pass(name: str, attrs: Optional[dict] = None):
+    """~ paddle.distributed.passes.new_pass."""
+    if name not in PASS_REGISTRY:
+        raise KeyError(f"no distributed pass named {name!r}; "
+                       f"have {sorted(PASS_REGISTRY)}")
+    p = PASS_REGISTRY[name]()
+    p.attrs = dict(attrs or {})
+    return p
+
+
+class PassContext:
+    """~ pass_base.py PassContext: carries strategy/model/optimizer through
+    the pipeline + a log of applied passes."""
+
+    def __init__(self, strategy=None, model=None, optimizer=None):
+        from ..fleet.distributed_strategy import DistributedStrategy
+        self.strategy = strategy if strategy is not None \
+            else DistributedStrategy()
+        self.model = model
+        self.optimizer = optimizer
+        self.applied: List[str] = []
+
+
+class PassBase:
+    """~ pass_base.py PassBase."""
+
+    name = "base"
+
+    def __init__(self):
+        self.attrs: dict = {}
+
+    def check_before(self, context: PassContext) -> bool:
+        return True
+
+    def check_after(self, context: PassContext) -> bool:
+        return True
+
+    def apply_impl(self, context: PassContext) -> None:
+        raise NotImplementedError
+
+    def apply(self, context: PassContext) -> PassContext:
+        if not self.check_before(context):
+            raise RuntimeError(f"pass {self.name}: precondition failed")
+        self.apply_impl(context)
+        context.applied.append(self.name)
+        if not self.check_after(context):
+            raise RuntimeError(f"pass {self.name}: postcondition failed")
+        return context
+
+
+class PassManager:
+    """~ pass_base.py PassManager: ordered application."""
+
+    def __init__(self, passes: List[PassBase]):
+        self.passes = list(passes)
+
+    def apply(self, context: PassContext) -> PassContext:
+        for p in self.passes:
+            context = p.apply(context)
+        return context
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """bf16 compute policy (~ auto_parallel_amp.py O1)."""
+
+    def apply_impl(self, ctx):
+        ctx.strategy.amp = True
+        ctx.strategy.amp_configs = {**getattr(ctx.strategy, "amp_configs",
+                                              {}) ,
+                                    "dtype": self.attrs.get("dtype",
+                                                            "bfloat16"),
+                                    "level": self.attrs.get("level", "O1")}
+
+    def check_after(self, ctx):
+        return bool(ctx.strategy.amp)
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(AMPPass):
+    """O2 (pure low-precision params) variant (~ auto_parallel_fp16.py)."""
+
+    def apply_impl(self, ctx):
+        self.attrs.setdefault("level", "O2")
+        super().apply_impl(ctx)
+        if ctx.model is not None and hasattr(ctx.model, "to"):
+            ctx.model.to(dtype=self.attrs.get("dtype", "bfloat16"))
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Activation rematerialization (~ auto_parallel_recompute.py) —
+    compiled as jax.checkpoint around layer blocks."""
+
+    def apply_impl(self, ctx):
+        ctx.strategy.recompute = True
+        ctx.strategy.recompute_configs = {
+            "checkpoints": self.attrs.get("checkpoints", [])}
+
+    def check_after(self, ctx):
+        return bool(ctx.strategy.recompute)
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """ZeRO state sharding over the 'sharding' axis
+    (~ auto_parallel_sharding.py)."""
+
+    def apply_impl(self, ctx):
+        stage = int(self.attrs.get("stage", 1))
+        ctx.strategy.sharding = True
+        ctx.strategy.sharding_configs = {
+            "stage": stage,
+            "sharding_degree": self.attrs.get("degree", 8)}
+        if ctx.optimizer is not None:
+            ctx.optimizer._shard_states_axis = "sharding"
+        if stage >= 3 and ctx.model is not None:
+            from ..sharding import _annotate_stage3
+            _annotate_stage3(ctx.model)
+
+    def check_after(self, ctx):
+        return bool(ctx.strategy.sharding)
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """Micro-batch gradient accumulation (~ auto_parallel_gradient_merge)."""
+
+    def apply_impl(self, ctx):
+        k = int(self.attrs.get("k_steps", 4))
+        ctx.strategy.gradient_merge = True
+        ctx.strategy.gradient_merge_configs = {"k_steps": k,
+                                               "avg": self.attrs.get("avg",
+                                                                     True)}
+
+    def check_after(self, ctx):
+        return bool(ctx.strategy.gradient_merge)
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(PassBase):
+    """Gradient-bucket fusion (~ fuse_all_reduce.py). XLA's collective
+    combiner does the fusing at compile time; the pass records the bucket
+    budget it should combine up to."""
+
+    def apply_impl(self, ctx):
+        mb = int(self.attrs.get("fuse_grad_size_in_MB", 32))
+        ctx.strategy.fuse_grad_size_in_MB = mb
+
+    def check_after(self, ctx):
+        return ctx.strategy.fuse_grad_size_in_MB > 0

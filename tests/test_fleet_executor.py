@@ -60,3 +60,44 @@ class TestDistModel:
                        n_stages=2)
         got = dm.run(x).numpy()
         np.testing.assert_allclose(got, direct, rtol=1e-5)
+
+
+class TestDistributedPasses:
+    def test_registry_and_manager(self):
+        from paddle_tpu.distributed import passes as dp
+        ctx = dp.PassContext()
+        mgr = dp.PassManager([
+            dp.new_pass("auto_parallel_amp", {"dtype": "bfloat16"}),
+            dp.new_pass("auto_parallel_recompute"),
+            dp.new_pass("auto_parallel_gradient_merge", {"k_steps": 8}),
+            dp.new_pass("fuse_all_reduce", {"fuse_grad_size_in_MB": 64}),
+        ])
+        mgr.apply(ctx)
+        assert ctx.strategy.amp
+        assert ctx.strategy.recompute
+        assert ctx.strategy.gradient_merge
+        assert ctx.strategy.gradient_merge_configs["k_steps"] == 8
+        assert ctx.strategy.fuse_grad_size_in_MB == 64
+        assert ctx.applied == ["auto_parallel_amp",
+                               "auto_parallel_recompute",
+                               "auto_parallel_gradient_merge",
+                               "fuse_all_reduce"]
+
+    def test_sharding_pass_marks_optimizer(self):
+        from paddle_tpu.distributed import passes as dp
+        import paddle_tpu.optimizer as popt
+        net = paddle.nn.Linear(4, 4)
+        opt = popt.AdamW(1e-3, parameters=net.parameters())
+        ctx = dp.PassContext(model=net, optimizer=opt)
+        dp.new_pass("auto_parallel_sharding", {"stage": 3,
+                                               "degree": 4}).apply(ctx)
+        assert ctx.strategy.sharding
+        assert opt._shard_states_axis == "sharding"
+        assert any(getattr(p, "sharding_spec", None) is not None
+                   for p in net.parameters())
+
+    def test_unknown_pass(self):
+        from paddle_tpu.distributed import passes as dp
+        import pytest
+        with pytest.raises(KeyError):
+            dp.new_pass("not_a_pass")

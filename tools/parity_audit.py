@@ -26,6 +26,9 @@ NAMESPACES = {
     "paddle.metric": (["metric/__init__.py"], "paddle_tpu.metric"),
     "paddle.distribution": (["distribution/__init__.py"], "paddle_tpu.distribution"),
     "paddle.distributed": (["distributed/__init__.py"], "paddle_tpu.distributed"),
+    "paddle.vision": (["vision/__init__.py"], "paddle_tpu.vision"),
+    "paddle.vision.models": (["vision/models/__init__.py"], "paddle_tpu.vision.models"),
+    "paddle.vision.datasets": (["vision/datasets/__init__.py"], "paddle_tpu.vision.datasets"),
     "paddle.vision.ops": (["vision/ops.py"], "paddle_tpu.vision.ops"),
     "paddle.vision.transforms": (["vision/transforms/__init__.py"], "paddle_tpu.vision.transforms"),
     "paddle.io": (["io/__init__.py"], "paddle_tpu.io"),
