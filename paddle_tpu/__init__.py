@@ -166,3 +166,11 @@ def enable_static():
 def in_dynamic_mode() -> bool:
     from .static.graph import in_static_mode
     return not in_static_mode()
+from . import callbacks  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import onnx  # noqa: F401
+from .framework.device import (  # noqa: F401
+    is_compiled_with_cinn, is_compiled_with_cuda, is_compiled_with_ipu,
+    is_compiled_with_mlu, is_compiled_with_npu, is_compiled_with_rocm,
+    is_compiled_with_xpu, get_cudnn_version,
+)

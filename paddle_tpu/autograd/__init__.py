@@ -54,3 +54,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 "(pass allow_unused=True to return None)")
         results.append(g)
     return results
+
+
+def __getattr__(name):
+    # lazy: py_layer imports core.tensor which imports autograd.tape — a
+    # top-level import here would be circular
+    if name in ("PyLayer", "PyLayerContext"):
+        from . import py_layer
+        return getattr(py_layer, name)
+    raise AttributeError(name)

@@ -1,0 +1,6 @@
+"""paddle.callbacks namespace (~ python/paddle/callbacks.py re-exporting
+hapi callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL,
+)

@@ -84,3 +84,80 @@ class cuda:
         import jax
         # block on all outstanding work for the device
         jax.effects_barrier()
+
+
+# ---- paddle.device namespace completion ------------------------------------
+from ..core.place import (  # noqa: F401,E402
+    CUDAPinnedPlace, CUDAPlace, NPUPlace, XPUPlace,
+)
+
+
+class IPUPlace(TPUPlace):
+    """API-compat alias (Graphcore slot; accelerator here is the TPU)."""
+
+
+class MLUPlace(TPUPlace):
+    """API-compat alias."""
+
+
+def get_all_device_type() -> list:
+    import jax
+    kinds = []
+    for d in jax.devices():
+        if d.platform not in kinds:
+            kinds.append(d.platform)
+    if "cpu" not in kinds:
+        kinds.append("cpu")
+    return kinds
+
+
+def get_all_custom_device_type() -> list:
+    from .custom_device import get_all_custom_device_type as _g
+    return _g()
+
+
+def get_available_device() -> list:
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device() -> list:
+    from .custom_device import _REGISTERED, get_device_count
+    out = []
+    for name in _REGISTERED:
+        out.extend(f"{name}:{i}" for i in range(get_device_count(name)))
+    return out
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU — None like the reference on non-CUDA builds."""
+    return None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """XLA plays the compiler role natively; CINN flag reports False."""
+    return False

@@ -221,3 +221,55 @@ class VisualDL(Callback):
 
     def on_train_end(self, logs=None):
         self._f.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """~ hapi/callbacks.py ReduceLROnPlateau: shrink LR when the monitored
+    metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_counter = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "max" or (self.mode == "auto"
+                                  and "acc" in self.monitor):
+            return cur > self._best + self.min_delta
+        return cur < self._best - self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
+                opt.set_lr(lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {lr:.3e}")
+            self._cooldown_counter = self.cooldown
+            self._wait = 0

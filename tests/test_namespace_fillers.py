@@ -1,0 +1,127 @@
+"""incubate graph/segment ops, regularizer, callbacks, profiler export,
+device namespace fillers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+
+
+class TestSegmentOps:
+    def test_segment_reductions(self):
+        x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.],
+                                       [7., 8.]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(
+            incubate.segment_sum(x, ids).numpy(), [[4., 6.], [12., 14.]])
+        np.testing.assert_allclose(
+            incubate.segment_mean(x, ids).numpy(), [[2., 3.], [6., 7.]])
+        np.testing.assert_allclose(
+            incubate.segment_max(x, ids).numpy(), [[3., 4.], [7., 8.]])
+        np.testing.assert_allclose(
+            incubate.segment_min(x, ids).numpy(), [[1., 2.], [5., 6.]])
+
+    def test_graph_send_recv(self):
+        x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 0, 2]))
+        out = incubate.graph_send_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 0, 1], [1, 0, 0], [1, 1, 0]])
+
+    def test_softmax_mask_fuse(self):
+        x = paddle.randn([2, 4, 4])
+        m = paddle.zeros([2, 4, 4])
+        out = incubate.softmax_mask_fuse(x, m)
+        np.testing.assert_allclose(out.numpy().sum(-1), np.ones((2, 4)),
+                                   rtol=1e-5)
+        tri = incubate.softmax_mask_fuse_upper_triangle(x)
+        got = tri.numpy()
+        assert np.allclose(got[0][np.triu_indices(4, 1)], 0.0, atol=1e-6)
+
+    def test_graph_sampling(self):
+        # CSC graph: node n's in-neighbors are row[colptr[n]:colptr[n+1]]
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 0, 1]))
+        colptr = paddle.to_tensor(np.array([0, 2, 4, 6]))
+        nodes = paddle.to_tensor(np.array([0, 2]))
+        nbrs, counts = incubate.graph_sample_neighbors(row, colptr, nodes,
+                                                       sample_size=1)
+        assert counts.numpy().tolist() == [1, 1]
+        nbrs_all, counts_all = incubate.graph_sample_neighbors(
+            row, colptr, nodes, sample_size=-1)
+        assert counts_all.numpy().tolist() == [2, 2]
+        rs, rd, uniq = incubate.graph_reindex(
+            nodes, nbrs_all, counts_all)
+        assert len(rs.numpy()) == 4
+        assert (rs.numpy() < len(uniq.numpy())).all()
+        src, dst, seen, cnts = incubate.graph_khop_sampler(
+            row, colptr, nodes, [2, 2])
+        assert len(src.numpy()) == len(dst.numpy())
+
+
+class TestRegularizer:
+    def test_l1_l2(self):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+        p = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        assert float(L1Decay(0.1)(p).numpy()) == pytest.approx(0.3)
+        assert float(L2Decay(0.1)(p).numpy()) == pytest.approx(0.25)
+
+
+class TestCallbacksNamespace:
+    def test_exports(self):
+        from paddle_tpu import callbacks
+        for n in ("Callback", "EarlyStopping", "ModelCheckpoint",
+                  "ProgBarLogger", "ReduceLROnPlateau", "VisualDL",
+                  "LRScheduler"):
+            assert hasattr(callbacks, n)
+
+    def test_reduce_lr_on_plateau(self):
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.callbacks import ReduceLROnPlateau
+        net = paddle.nn.Linear(2, 2)
+        opt = popt.SGD(learning_rate=1.0, parameters=net.parameters())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+
+        class FakeModel:
+            _optimizer = opt
+        cb.model = FakeModel()
+        cb.on_epoch_end(0, {"loss": 1.0})   # sets best
+        cb.on_epoch_end(1, {"loss": 1.0})   # patience hit -> halve
+        assert float(opt.get_lr()) == pytest.approx(0.5)
+        cb.on_epoch_end(2, {"loss": 1.0})   # halve again
+        assert float(opt.get_lr()) == pytest.approx(0.25)
+
+
+class TestProfilerExport:
+    def test_protobuf_roundtrip(self, tmp_path):
+        import paddle_tpu.profiler as prof
+        with prof.profile(on_trace_ready=prof.export_protobuf(
+                str(tmp_path))) as p:
+            paddle.tanh(paddle.randn([8, 8]))
+        import os
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".pb")]
+        assert files
+        events = prof.load_profiler_result(str(tmp_path / files[0]))
+        assert any(e["name"].startswith("op::") for e in events)
+        assert prof.SortedKeys.CPUTotal is not None
+
+
+class TestDeviceNamespace:
+    def test_queries(self):
+        from paddle_tpu.framework import device as d
+        assert not paddle.is_compiled_with_cuda()
+        assert paddle.get_cudnn_version() is None
+        assert "cpu" in d.get_all_device_type()
+        assert d.get_available_device()
+        assert isinstance(d.get_available_custom_device(), list)
+
+    def test_onnx_export_fallback(self, tmp_path):
+        import warnings
+        net = paddle.nn.Linear(4, 2)
+        from paddle_tpu.jit import InputSpec
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            paddle.onnx.export(net, str(tmp_path / "m"),
+                               input_spec=[InputSpec([1, 4])])
+        assert (tmp_path / "m.pdexport").exists()

@@ -39,6 +39,13 @@ NAMESPACES = {
     "paddle.sparse": (["sparse/__init__.py"], "paddle_tpu.sparse"),
     "paddle.text": (["text/__init__.py"], "paddle_tpu.text"),
     "paddle.utils": (["utils/__init__.py"], "paddle_tpu.utils"),
+    "paddle.incubate": (["incubate/__init__.py"], "paddle_tpu.incubate"),
+    "paddle.autograd": (["autograd/__init__.py"], "paddle_tpu.autograd"),
+    "paddle.callbacks": (["callbacks/__init__.py"], "paddle_tpu.callbacks"),
+    "paddle.regularizer": (["regularizer/__init__.py"], "paddle_tpu.regularizer"),
+    "paddle.profiler": (["profiler/__init__.py"], "paddle_tpu.profiler"),
+    "paddle.device": (["device/__init__.py"], "paddle_tpu.framework.device"),
+    "paddle.onnx": (["onnx/__init__.py"], "paddle_tpu.onnx"),
 }
 
 
