@@ -107,6 +107,7 @@ uint64_t spans_name(void* h, int32_t id, char* out, uint64_t out_len) {
   auto* c = static_cast<Collector*>(h);
   std::lock_guard<std::mutex> g(c->intern_mu);
   if (id < 0 || static_cast<size_t>(id) >= c->names.size()) return 0;
+  if (out_len == 0) return 0;  // out_len-1 would wrap to UINT64_MAX below
   const std::string& s = c->names[id];
   uint64_t n = s.size() < out_len - 1 ? s.size() : out_len - 1;
   std::memcpy(out, s.data(), n);

@@ -86,6 +86,13 @@ class Interceptor:
                 self.error = e
                 for dst in self.task.downstream:
                     self.bus.send(dst, _STOP)
+                # keep draining the bounded inbox until _STOP arrives, else
+                # an upstream blocked in bus.send on this queue never exits
+                # and Carrier.run's join() hangs instead of raising
+                while True:
+                    p = self.inbox.get()
+                    if p is _STOP:
+                        break
                 break
 
     def start(self):

@@ -103,9 +103,10 @@ def irfftn(x, s=None, axes=None, norm="backward"):
 
 @def_op("hfft2")
 def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
-    # hermitian fft over the last axis after an inverse fft on the rest
-    out = jnp.fft.ifftn(x, s=None if s is None else s[:-1], axes=axes[:-1],
-                        norm=_norm(norm))
+    # hermitian fft over the last axis after a forward fft on the rest
+    # (matches scipy.fft.hfft2: hfftn == fftn over leading axes + hfft last)
+    out = jnp.fft.fftn(x, s=None if s is None else s[:-1], axes=axes[:-1],
+                       norm=_norm(norm))
     return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=axes[-1],
                         norm=_norm(norm))
 
@@ -114,15 +115,15 @@ def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
     out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
                         norm=_norm(norm))
-    return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=axes[:-1],
-                        norm=_norm(norm))
+    return jnp.fft.ifftn(out, s=None if s is None else s[:-1], axes=axes[:-1],
+                         norm=_norm(norm))
 
 
 @def_op("hfftn")
 def hfftn(x, s=None, axes=None, norm="backward"):
     ax = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
-    out = jnp.fft.ifftn(x, s=None if s is None else s[:-1], axes=ax[:-1],
-                        norm=_norm(norm))
+    out = jnp.fft.fftn(x, s=None if s is None else s[:-1], axes=ax[:-1],
+                       norm=_norm(norm))
     return jnp.fft.hfft(out, n=None if s is None else s[-1], axis=ax[-1],
                         norm=_norm(norm))
 
@@ -132,5 +133,5 @@ def ihfftn(x, s=None, axes=None, norm="backward"):
     ax = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
     out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=ax[-1],
                         norm=_norm(norm))
-    return jnp.fft.fftn(out, s=None if s is None else s[:-1], axes=ax[:-1],
-                        norm=_norm(norm))
+    return jnp.fft.ifftn(out, s=None if s is None else s[:-1], axes=ax[:-1],
+                         norm=_norm(norm))

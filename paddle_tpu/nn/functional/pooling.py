@@ -64,29 +64,48 @@ def _max_pool_indices(x, ks, st, pad, n):
     layout. Built from conv_general_dilated_patches so stride/padding follow
     the exact same windowing as the pooling reduce_window."""
     spatial = x.shape[2:]
-    # patches of the *linear index* grid, window-extracted like the values
-    lin = jnp.arange(int(np.prod(spatial)), dtype=jnp.float32).reshape(
-        (1, 1) + spatial)
-    lin = jnp.broadcast_to(lin, (x.shape[0], 1) + spatial)
     if isinstance(pad, str):
-        padding = pad
+        pads = jax.lax.padtype_to_pads(spatial, ks, st, pad)
     else:
-        padding = pad
+        pads = list(pad)
+    full_pads = [(0, 0), (0, 0)] + list(pads)
+    # conv_general_dilated_patches zero-pads, but the value path pads with
+    # -inf; pad manually so the argmax never selects a padded element, then
+    # extract with VALID. The pad value is the finite dtype minimum, not
+    # -inf: patch extraction is a conv with a one-hot kernel and -inf * 0 =
+    # nan would poison every pad-adjacent window. Real elements are nudged
+    # strictly above the pad value so a pad slot can never win the argmax,
+    # even for all--inf windows.
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        neg = jnp.array(jnp.finfo(x.dtype).min, x.dtype)
+        xv = jnp.maximum(x, jnp.finfo(x.dtype).min / 2)
+    else:
+        neg = jnp.array(jnp.iinfo(x.dtype).min, x.dtype)
+        xv = jnp.maximum(x, jnp.iinfo(x.dtype).min + 1)
+    xpad = jnp.pad(xv, full_pads, constant_values=neg)
     xp = jax.lax.conv_general_dilated_patches(
-        x, filter_shape=ks, window_strides=st, padding=padding)
-    lp = jax.lax.conv_general_dilated_patches(
-        lin, filter_shape=ks, window_strides=st,
-        padding=padding, precision=None)
+        xpad, filter_shape=ks, window_strides=st, padding="VALID")
     # xp: (N, C*prod(ks), *out_spatial); reshape to (N, C, prod(ks), ...)
     out_spatial = xp.shape[2:]
     k = int(np.prod(ks))
     xp = xp.reshape(x.shape[0], x.shape[1], k, *out_spatial)
-    lp = lp.reshape(x.shape[0], 1, k, *out_spatial)
-    arg = jnp.argmax(xp, axis=2)
-    idx = jnp.take_along_axis(
-        jnp.broadcast_to(lp, (x.shape[0], x.shape[1], k) + out_spatial),
-        arg[:, :, None], axis=2)[:, :, 0]
-    return idx.astype(jnp.int32)
+    arg = jnp.argmax(xp, axis=2)  # within-window offset, row-major over ks
+    # exact integer linear index: window origin + within-window offset per
+    # dim (no float index grid — float32 can't represent indices > 2^24)
+    rem = arg
+    offs = [None] * n
+    for d in range(n - 1, -1, -1):
+        offs[d] = rem % ks[d]
+        rem = rem // ks[d]
+    lin = None
+    for d in range(n):
+        shape = [1] * arg.ndim
+        shape[2 + d] = out_spatial[d]
+        start = (jnp.arange(out_spatial[d]) * st[d]
+                 - pads[d][0]).reshape(shape)
+        coord = jnp.clip(start + offs[d], 0, spatial[d] - 1)
+        lin = coord if lin is None else lin * spatial[d] + coord
+    return lin.astype(jnp.int32)
 
 
 def _max_pool_nd(x, kernel_size, stride, padding, n, data_format, ceil_mode,

@@ -66,6 +66,16 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(np.asarray(keep, np.int64))
 
 
+def _roi_batch_index(boxes_num, N, R):
+    """Per-RoI image index from boxes_num (RoIs are listed image-major)."""
+    if boxes_num is None:
+        return jnp.zeros((R,), jnp.int32)
+    bn = jnp.asarray(boxes_num._value if isinstance(boxes_num, Tensor)
+                     else boxes_num, jnp.int32)
+    return jnp.repeat(jnp.arange(N, dtype=jnp.int32), bn,
+                      total_repeat_length=R)
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True):
     """RoIAlign via bilinear gather (jit-friendly; ~ roi_align op)."""
@@ -74,10 +84,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     oh, ow = output_size
 
     def fn(feat, rois):
-        # feat: (N,C,H,W); rois: (R,4) in input coords; all rois on image 0
-        # (multi-image routing via boxes_num handled by caller slicing)
+        # feat: (N,C,H,W); rois: (R,4) in input coords, image-major order;
+        # each RoI is routed to its image via boxes_num
         N, Cc, H, W = feat.shape
         R = rois.shape[0]
+        bidx = _roi_batch_index(boxes_num, N, R)
         offset = 0.5 if aligned else 0.0
         x1 = rois[:, 0] * spatial_scale - offset
         y1 = rois[:, 1] * spatial_scale - offset
@@ -87,24 +98,24 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         rh = jnp.maximum(y2 - y1, 1e-3)
         ys = (y1[:, None] + (jnp.arange(oh) + 0.5)[None] * rh[:, None] / oh)
         xs = (x1[:, None] + (jnp.arange(ow) + 0.5)[None] * rw[:, None] / ow)
-        img0 = feat[0]
 
-        def one_roi(ygrid, xgrid):
+        def one_roi(ygrid, xgrid, b):
+            img = feat[b]
             yy0 = jnp.clip(jnp.floor(ygrid).astype(jnp.int32), 0, H - 1)
             xx0 = jnp.clip(jnp.floor(xgrid).astype(jnp.int32), 0, W - 1)
             yy1 = jnp.clip(yy0 + 1, 0, H - 1)
             xx1 = jnp.clip(xx0 + 1, 0, W - 1)
             fy = ygrid - yy0
             fx = xgrid - xx0
-            i00 = img0[:, yy0][:, :, xx0]
-            i01 = img0[:, yy0][:, :, xx1]
-            i10 = img0[:, yy1][:, :, xx0]
-            i11 = img0[:, yy1][:, :, xx1]
+            i00 = img[:, yy0][:, :, xx0]
+            i01 = img[:, yy0][:, :, xx1]
+            i10 = img[:, yy1][:, :, xx0]
+            i11 = img[:, yy1][:, :, xx1]
             top = i00 * (1 - fx)[None, None, :] + i01 * fx[None, None, :]
             bot = i10 * (1 - fx)[None, None, :] + i11 * fx[None, None, :]
             return top * (1 - fy)[None, :, None] + bot * fy[None, :, None]
 
-        return jax.vmap(one_roi)(ys, xs)  # (R, C, oh, ow)
+        return jax.vmap(one_roi)(ys, xs, bidx)  # (R, C, oh, ow)
     return apply_op("roi_align", fn, x, boxes)
 
 
@@ -130,10 +141,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
 
     def fn(feat, rois):
         N, C, H, W = feat.shape
-        img0 = feat[0]
+        bidx = _roi_batch_index(boxes_num, N, rois.shape[0])
         x1, y1, rw, rh = _roi_grid(rois, spatial_scale, oh, ow, H, W)
 
-        def one_roi(px1, py1, prw, prh):
+        def one_roi(px1, py1, prw, prh, b):
+            img = feat[b]
             # integer bin boundaries like the reference's roi_pool
             ys = py1 + jnp.arange(oh + 1) * prh / oh
             xs = px1 + jnp.arange(ow + 1) * prw / ow
@@ -148,15 +160,15 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                 col_m = (xx >= xs[j]) & (xx < jnp.maximum(xs[j + 1],
                                                           xs[j] + 1))
                 m = row_m[:, None] & col_m[None, :]
-                neg = jnp.finfo(img0.dtype).min
-                return jnp.max(jnp.where(m[None], img0, neg), axis=(1, 2))
+                neg = jnp.finfo(img.dtype).min
+                return jnp.max(jnp.where(m[None], img, neg), axis=(1, 2))
 
             rows = []
             for i in range(oh):
                 cols = [bin_max(i, j) for j in range(ow)]
                 rows.append(jnp.stack(cols, -1))
             return jnp.stack(rows, -2)  # (C, oh, ow)
-        return jax.vmap(one_roi)(x1, y1, rw, rh)
+        return jax.vmap(one_roi)(x1, y1, rw, rh, bidx)
     return apply_op("roi_pool", fn, x, boxes)
 
 
@@ -171,10 +183,11 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     def fn(feat, rois):
         N, C, H, W = feat.shape
         c_out = C // (oh * ow)
-        img0 = feat[0]
+        bidx = _roi_batch_index(boxes_num, N, rois.shape[0])
         x1, y1, rw, rh = _roi_grid(rois, spatial_scale, oh, ow, H, W)
 
-        def one_roi(px1, py1, prw, prh):
+        def one_roi(px1, py1, prw, prh, b):
+            img = feat[b]
             ys = py1 + jnp.arange(oh + 1) * prh / oh
             xs = px1 + jnp.arange(ow + 1) * prw / ow
             yy = jnp.arange(H)
@@ -185,13 +198,13 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                 for j in range(ow):
                     row_m = (yy + 0.5 >= ys[i]) & (yy + 0.5 <= ys[i + 1])
                     col_m = (xx + 0.5 >= xs[j]) & (xx + 0.5 <= xs[j + 1])
-                    m = (row_m[:, None] & col_m[None, :]).astype(img0.dtype)
+                    m = (row_m[:, None] & col_m[None, :]).astype(img.dtype)
                     cnt = jnp.maximum(jnp.sum(m), 1.0)
-                    chans = img0[jnp.arange(c_out) * (oh * ow) + i * ow + j]
+                    chans = img[jnp.arange(c_out) * (oh * ow) + i * ow + j]
                     row.append(jnp.sum(chans * m[None], axis=(1, 2)) / cnt)
                 out.append(jnp.stack(row, -1))
             return jnp.stack(out, -2)  # (c_out, oh, ow)
-        return jax.vmap(one_roi)(x1, y1, rw, rh)
+        return jax.vmap(one_roi)(x1, y1, rw, rh, bidx)
     return apply_op("psroi_pool", fn, x, boxes)
 
 

@@ -279,3 +279,66 @@ class TestVisionTransforms:
         out = T.RandomVerticalFlip(prob=1.0)(img)
         np.testing.assert_allclose(out, img[:, ::-1])
         assert T.Pad([1, 2])(img).shape == (3, 20, 18)
+
+
+class TestAdviceRegressions:
+    """Regressions for round-1 advisor findings (ADVICE.md)."""
+
+    def test_hfft2_hfftn_match_scipy(self):
+        import scipy.fft as sfft
+        x = (np.random.rand(4, 5) + 1j * np.random.rand(4, 5)).astype(
+            np.complex64)
+        np.testing.assert_allclose(
+            paddle.fft.hfft2(paddle.to_tensor(x)).numpy(),
+            sfft.hfft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.hfftn(paddle.to_tensor(x)).numpy(),
+            sfft.hfftn(x), rtol=1e-4, atol=1e-4)
+        r = np.random.rand(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.ihfft2(paddle.to_tensor(r)).numpy(),
+            sfft.ihfft2(r), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.fft.ihfftn(paddle.to_tensor(r)).numpy(),
+            sfft.ihfftn(r), rtol=1e-4, atol=1e-5)
+
+    def test_roi_pool_routes_rois_to_their_image(self):
+        import paddle_tpu.vision.ops as vops
+        # image 0 all zeros, image 1 all ones: an RoI on image 1 must pool 1s
+        x = np.zeros((2, 3, 8, 8), np.float32)
+        x[1] = 1.0
+        boxes = paddle.to_tensor(
+            np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32))
+        bn = paddle.to_tensor(np.array([1, 1], np.int32))
+        out = vops.roi_pool(paddle.to_tensor(x), boxes, bn, 2).numpy()
+        np.testing.assert_allclose(out[0], 0.0)
+        np.testing.assert_allclose(out[1], 1.0)
+        al = vops.roi_align(paddle.to_tensor(x), boxes, bn, 2).numpy()
+        np.testing.assert_allclose(al[0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(al[1], 1.0, atol=1e-6)
+
+    def test_max_pool_mask_with_padding_all_negative(self):
+        import paddle_tpu.nn.functional as F
+        # all-negative input + explicit padding: the mask path used to
+        # zero-pad patches so argmax picked the pad (index 0 everywhere)
+        x = paddle.to_tensor(-np.arange(1, 17, dtype=np.float32).reshape(
+            1, 1, 4, 4))
+        out, mask = F.max_pool2d(x, 2, 2, padding=1, return_mask=True)
+        ov, mv = out.numpy(), mask.numpy()
+        flat = x.numpy().reshape(-1)
+        # every selected index must address the element equal to the output
+        np.testing.assert_allclose(flat[mv.reshape(-1)], ov.reshape(-1))
+
+    def test_fleet_executor_error_propagates_not_hangs(self):
+        from paddle_tpu.distributed.fleet_executor import Carrier, TaskNode
+
+        def boom(v):
+            raise RuntimeError("stage failed")
+
+        tasks = [TaskNode(rank=0, node_type="Compute", task_id=i,
+                          program=(boom if i == 1 else (lambda v: v)))
+                 for i in range(2)]
+        car = Carrier(tasks)
+        with pytest.raises(RuntimeError, match="stage failed"):
+            # enough microbatches to overflow the bounded (8) inboxes
+            car.run(list(range(32)))
