@@ -37,9 +37,11 @@ def _annotate_stage3(model: Layer):
 
 
 class GroupShardedOptimizerStage2:
-    """API-parity shim (~ group_sharded_optimizer_stage2.py:48): marks the
-    optimizer for state sharding; the compiled train step reads this flag
-    and shards accumulator pytrees over the 'sharding' axis."""
+    """~ group_sharded_optimizer_stage2.py:48: marks the optimizer for
+    state sharding. Consumed by Optimizer.step (eager: accumulators get
+    NamedShardings over the 'sharding' mesh axis via
+    Optimizer._ensure_sharded_state) and by the compiled train-step
+    factories (moments laid out P('sharding', ...))."""
 
     def __init__(self, params, optim, group=None, offload=False, **kw):
         self._optim = optim

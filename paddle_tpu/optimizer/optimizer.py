@@ -60,6 +60,71 @@ class Optimizer:
             self._accumulators[id(p)] = self._create_accumulators(p)
         return self._accumulators[id(p)]
 
+    # ---- ZeRO state sharding (consumer of _shard_states_axis) -------------
+    def _zero_mesh(self):
+        """Mesh to shard optimizer state over, or None.
+
+        ~ group_sharded_optimizer_stage2.py:48 — the reference segments
+        params across ranks by size; here states get NamedShardings over
+        the '_shard_states_axis' mesh axis and GSPMD keeps every device's
+        addressable shard at 1/N."""
+        axis = getattr(self, "_shard_states_axis", None)
+        if not axis:
+            return None, None
+        from ..distributed.topology import get_global_mesh
+        mesh = get_global_mesh()
+        if mesh is None or axis not in mesh.axis_names \
+                or mesh.shape[axis] <= 1:
+            return None, None
+        return mesh, axis
+
+    def _state_sharding(self, arr, mesh, axis, param_spec=None):
+        """Spec for one state array: keep the param's own annotated axes,
+        then shard the largest remaining divisible dim over `axis`."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = [None] * arr.ndim
+        if param_spec is not None:
+            for i, s in enumerate(param_spec[:arr.ndim]):
+                if s in mesh.axis_names:
+                    spec[i] = s
+        if axis not in spec:
+            n = mesh.shape[axis]
+            for i in sorted(range(arr.ndim), key=lambda i: -arr.shape[i]):
+                if spec[i] is None and arr.shape[i] % n == 0 \
+                        and arr.shape[i] >= n:
+                    spec[i] = axis
+                    break
+        return NamedSharding(mesh, P(*spec))
+
+    def _ensure_sharded_state(self, params, mesh, axis):
+        """Place params (per their annotation; replicated otherwise), grads
+        and accumulators onto the mesh. Stage os/os_g: states sharded,
+        params replicated. Stage p_g_os: params carry a 'sharding'
+        annotation too (group_sharded_stage3.py:58's param segmentation)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        for p in params:
+            pspec = getattr(p, "sharding_spec", None)
+            if pspec is not None:
+                fixed = [s if s in mesh.axis_names else None for s in pspec]
+                n = mesh.shape[axis]
+                for i, s in enumerate(fixed):
+                    if s == axis and p._value.shape[i] % n != 0:
+                        fixed[i] = None  # indivisible: keep replicated
+                tgt = NamedSharding(mesh, P(*fixed))
+            else:
+                tgt = NamedSharding(mesh, P())
+            if p._value.sharding != tgt:
+                p._value = jax.device_put(p._value, tgt)
+            if p._grad is not None and p._grad._value.sharding != tgt:
+                p._grad._value = jax.device_put(p._grad._value, tgt)
+            accs = self._accs_for(p)
+            for k, a in accs.items():
+                if not hasattr(a, "ndim"):
+                    continue
+                sh = self._state_sharding(a, mesh, axis, pspec)
+                if a.sharding != sh:
+                    accs[k] = jax.device_put(a, sh)
+
     def _apply_grad_clip(self, params, grads):
         from ..nn import (ClipGradByGlobalNorm, ClipGradByNorm,
                           ClipGradByValue)
@@ -89,6 +154,9 @@ class Optimizer:
         if not params:
             self._step_count += 1
             return
+        mesh, shard_axis = self._zero_mesh()
+        if mesh is not None:
+            self._ensure_sharded_state(params, mesh, shard_axis)
         grads = [p._grad._value for p in params]
         grads = self._apply_grad_clip(params, grads)
         lr = jnp.asarray(self.get_lr(), jnp.float32)
@@ -105,7 +173,14 @@ class Optimizer:
             return new_vals, new_accs
 
         if self._jit_update is None:
-            self._jit_update = jax.jit(fused)
+            if mesh is not None:
+                # pin output shardings so updated params/states stay laid
+                # out as placed by _ensure_sharded_state (ZeRO invariant)
+                out_sh = ([v.sharding for v in vals],
+                          [{k: a[k].sharding for k in a} for a in accs])
+                self._jit_update = jax.jit(fused, out_shardings=out_sh)
+            else:
+                self._jit_update = jax.jit(fused)
         new_vals, new_accs = self._jit_update(vals, grads, accs, lr, step)
         for p, nv, na in zip(params, new_vals, new_accs):
             p._value = nv

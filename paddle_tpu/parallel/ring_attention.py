@@ -42,12 +42,18 @@ def _block_attn(q, k, v, mask):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
-                   causal: bool = True, sm_scale=None):
+                   causal: bool = True, sm_scale=None,
+                   batch_axis=None, head_axis=None):
     """q/k/v: GLOBAL (batch, heads, seq, head_dim) arrays (or sharded);
-    seq dim is sharded over `axis` inside. Returns same-shape output."""
+    seq dim is sharded over `axis` inside. batch_axis/head_axis optionally
+    name mesh axes the batch/head dims are sharded over (composing context
+    parallelism with data and tensor parallelism in one shard_map).
+    Returns same-shape output."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     n = mesh.shape[axis]
+    b_ax = batch_axis if batch_axis in mesh.axis_names else None
+    h_ax = head_axis if head_axis in mesh.axis_names else None
 
     def spmd(ql, kl, vl):
         # local chunks: (B,H,S/n,D)
@@ -85,8 +91,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
         l = jnp.where(l == 0.0, 1.0, l)
         return (acc / l[..., None]).astype(q.dtype)
 
+    spec = P(b_ax, h_ax, axis, None)
     fn = jax.shard_map(
         spmd, mesh=mesh,
-        in_specs=(P(None, None, axis, None),) * 3,
-        out_specs=P(None, None, axis, None), check_vma=False)
+        in_specs=(spec,) * 3,
+        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -269,3 +269,56 @@ class TestGSPMDTrainStep:
             model, mesh, learning_rate=1e-2, remat=False)
         _, _, loss = step(params, opt_state, tokens, labels)
         np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+
+class TestContextParallelLlama:
+    """Ring attention wired into the flagship when the mesh has sep>1
+    (round-1 verdict #4): loss parity with the single-device oracle and a
+    collective-permute (ring KV rotation) in the lowered step — not an
+    all-gather of the sequence."""
+
+    def _oracle(self, model, tokens, labels):
+        from paddle_tpu.core.tensor import Tensor
+        model_params = {k: v._value for k, v in model.state_dict().items()}
+
+        def oracle_loss(params):
+            model.load_tree(params)
+            logits = model(Tensor(tokens))._value.astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, -1)
+            return jnp.mean(-jnp.take_along_axis(
+                logp, labels[..., None].astype(jnp.int32), -1)[..., 0])
+
+        ref = float(jax.jit(oracle_loss)(model_params))
+        model.load_tree(model_params)
+        return ref
+
+    def test_sep_parity_and_ring_in_hlo(self):
+        cfg = LlamaConfig.tiny()
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        tokens = jnp.asarray(_tokens(4, 32, cfg.vocab_size))
+        labels = jnp.asarray(_tokens(4, 32, cfg.vocab_size, 1))
+        ref = self._oracle(model, tokens, labels)
+
+        mesh = _mesh({"data": 2, "sep": 2, "model": 2})
+        params, opt_state, step, _ = llama_train_step_factory(
+            model, mesh, learning_rate=1e-2, remat=False)
+        lowered = step.lower(params, opt_state, tokens, labels)
+        stablehlo = lowered.as_text()
+        assert "collective_permute" in stablehlo, \
+            "sep>1 step must rotate KV via ppermute (ring attention)"
+        _, _, loss = step(params, opt_state, tokens, labels)
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+    def test_sep_only_mesh_parity(self):
+        cfg = LlamaConfig.tiny()
+        paddle.seed(1)
+        model = LlamaForCausalLM(cfg)
+        tokens = jnp.asarray(_tokens(2, 64, cfg.vocab_size))
+        labels = jnp.asarray(_tokens(2, 64, cfg.vocab_size, 1))
+        ref = self._oracle(model, tokens, labels)
+        mesh = _mesh({"sep": 4})
+        params, opt_state, step, _ = llama_train_step_factory(
+            model, mesh, learning_rate=1e-2, remat=True)
+        _, _, loss = step(params, opt_state, tokens, labels)
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-4)

@@ -1,0 +1,95 @@
+"""ZeRO group-sharded parallelism: optimizer states (and, at stage 3,
+params) must actually be sharded across the 'sharding' mesh axis — each
+device's addressable shard is 1/N of the full array.
+
+~ reference fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:48
+(param→rank segmentation), group_sharded_stage3.py:58 (param sharding with
+re-gather at use). Here GSPMD does the segmentation from NamedShardings.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+@pytest.fixture
+def sharding_mesh():
+    from paddle_tpu.distributed.topology import (build_mesh, get_global_mesh,
+                                                 set_global_mesh)
+    prev = get_global_mesh()
+    mesh = build_mesh({"sharding": 8})
+    set_global_mesh(mesh)
+    yield mesh
+    set_global_mesh(prev)
+
+
+def _train_one_step(model, opt):
+    x = paddle.to_tensor(np.random.rand(4, 64).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(4, 64).astype(np.float32))
+    loss = paddle.nn.functional.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def _shard_fraction(arr):
+    return arr.addressable_shards[0].data.size / arr.size
+
+
+class TestGroupSharded:
+    def test_stage_os_shards_moments_not_params(self, sharding_mesh):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        model = nn.Linear(64, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt = group_sharded_parallel(model, opt, "os")
+        _train_one_step(model, opt)
+        accs = [a for d in opt._accumulators.values() for a in d.values()
+                if hasattr(a, "ndim") and a.ndim >= 1]
+        assert accs, "no accumulators created"
+        for a in accs:
+            assert _shard_fraction(a) == pytest.approx(1 / 8), \
+                f"moment not 1/8-sharded: {a.sharding}"
+        # stage 1: params stay replicated (full copy on every device)
+        for p in model.parameters():
+            assert _shard_fraction(p._value) == pytest.approx(1.0)
+
+    def test_stage_p_g_os_shards_params_too(self, sharding_mesh):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        model = nn.Linear(64, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt = group_sharded_parallel(model, opt, "p_g_os")
+        l0 = _train_one_step(model, opt)
+        w = model.weight._value
+        assert _shard_fraction(w) == pytest.approx(1 / 8), \
+            f"stage-3 param not sharded: {w.sharding}"
+        # training still works on sharded params (all-gather at use)
+        l1 = _train_one_step(model, opt)
+        assert np.isfinite(l1) and l1 < l0 * 2
+
+    def test_sharded_matches_unsharded_update(self, sharding_mesh):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        paddle.seed(7)
+        ref = nn.Linear(64, 64)
+        paddle.seed(7)
+        shd = nn.Linear(64, 64)
+        opt_ref = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=ref.parameters())
+        opt_shd = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=shd.parameters())
+        shd, opt_shd = group_sharded_parallel(shd, opt_shd, "os_g")
+        np.random.seed(3)
+        for _ in range(3):
+            x = paddle.to_tensor(np.random.rand(4, 64).astype(np.float32))
+            y = paddle.to_tensor(np.random.rand(4, 64).astype(np.float32))
+            for m, o in ((ref, opt_ref), (shd, opt_shd)):
+                loss = paddle.nn.functional.mse_loss(m(x), y)
+                loss.backward()
+                o.step()
+                o.clear_grad()
+        np.testing.assert_allclose(np.asarray(ref.weight._value),
+                                   np.asarray(shd.weight._value),
+                                   rtol=1e-5, atol=1e-6)
