@@ -185,3 +185,27 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
     # Reset pending counts for any unprocessed nodes (disconnected pieces).
     for n in order:
         n.pending = 0
+
+    # Post-backward hooks: the DataParallel grad-sync trigger (the role of
+    # the reference's EagerReducer firing allreduce from GradNode hooks,
+    # distributed/collective/reducer.h:86).
+    for hook in list(_post_backward_hooks.values()):
+        hook()
+
+
+_post_backward_hooks: dict = {}
+_hook_counter = [0]
+
+
+def register_post_backward_hook(fn):
+    """Register fn() to run after every backward(). Returns a handle with
+    .remove()."""
+    _hook_counter[0] += 1
+    hid = _hook_counter[0]
+    _post_backward_hooks[hid] = fn
+
+    class _Handle:
+        def remove(self):
+            _post_backward_hooks.pop(hid, None)
+
+    return _Handle()

@@ -9,7 +9,6 @@ launch controller (launch/main.py elastic_level). No etcd dependency.
 """
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Callable, List, Optional
@@ -55,10 +54,20 @@ class ElasticManager:
             self._thread.join(timeout=5)
 
     def _register_member(self):
-        raw = self.store.get("__members__") or b"[]"
-        members = set(json.loads(raw.decode() or "[]"))
-        members.add(self.node_id)
-        self.store.set("__members__", json.dumps(sorted(members)))
+        # atomic slot claim via TCPStore.add — a read-modify-write on one
+        # JSON membership key loses registrations when two nodes join
+        # concurrently (the round-1 flaky TestElastic race)
+        slot = self.store.add("__member_count__", 1) - 1
+        self.store.set(f"__member_slot__/{slot}", self.node_id)
+
+    def _members(self) -> List[str]:
+        n = self.store.add("__member_count__", 0)
+        seen = set()
+        for i in range(int(n)):
+            v = self.store.get(f"__member_slot__/{i}")
+            if v:
+                seen.add(v.decode())
+        return sorted(seen)
 
     def _loop(self):
         while not self._stop.is_set():
@@ -72,8 +81,7 @@ class ElasticManager:
 
     # -- membership ---------------------------------------------------------
     def alive_members(self) -> List[str]:
-        raw = self.store.get("__members__") or b"[]"
-        members = json.loads(raw.decode() or "[]")
+        members = self._members()
         now = time.time()
         alive = []
         for m in members:

@@ -128,7 +128,18 @@ class PipelineLayer(Layer):
     def _build_layer(self):
         run_funcs = []
         local = []
+        # deterministic per-layer-index RNG: each stage builds ONLY its
+        # segment, so without this the generator state (and thus param
+        # init) would depend on which stage builds — breaking cross-stage
+        # parity with the single-process model (the reference gets this
+        # from per-rank seed control in fleet.meta_parallel random.py)
+        from .....core.generator import default_generator
+        _gen = default_generator()
+        _seed, _off0 = _gen.get_state()
+        _stride = 100003
+        self._init_rng = (_seed, _off0)
         for i, d in enumerate(self._layers_desc):
+            _gen.set_state((_seed, _off0 + i * _stride))
             in_local = self._start <= i < self._end
             if isinstance(d, SharedLayerDesc):
                 # build shared layers everywhere they appear (weights tied)
@@ -154,6 +165,7 @@ class PipelineLayer(Layer):
                     if isinstance(d, Layer):
                         local.append(d)
                     run_funcs.append(d)
+        _gen.set_state((_seed, _off0 + len(self._layers_desc) * _stride))
         self.run_function = run_funcs
         self._local_layers = LayerList(
             [l for l in local if isinstance(l, Layer)])
@@ -193,20 +205,37 @@ class PipelineLayer(Layer):
         return out
 
     def forward_full(self, input):
-        """Run ALL stages (single-program GSPMD mode)."""
+        """Run ALL stages (single-program GSPMD mode). Reuses the local
+        segment's already-built (trained!) layers; only non-local descs are
+        instantiated — and those with the same per-index deterministic RNG
+        as _build_layer so init matches the staged build."""
         out = input
         built = getattr(self, "_full_layers", None)
         if built is None:
+            from .....core.generator import default_generator
+            _gen = default_generator()
+            _seed, _off0 = _gen.get_state()
+            _stride = 100003
             built = []
-            for d in self._layers_desc:
+            li = 0
+            for i, d in enumerate(self._layers_desc):
+                in_local = self._start <= i < self._end
                 if isinstance(d, SharedLayerDesc):
                     layer = self.shared_layers[d.layer_name]
                     built.append(layer if d.forward_func is None
                                  else partial(d.forward_func, layer))
+                    if in_local:
+                        li += 1
+                elif in_local:
+                    built.append(self.run_function[li])
+                    li += 1
                 elif isinstance(d, LayerDesc):
+                    bseed, boff = getattr(self, "_init_rng", (_seed, 0))
+                    _gen.set_state((bseed, boff + i * _stride))
                     built.append(d.build_layer())
                 else:
                     built.append(d)
+            _gen.set_state((_seed, _off0))
             self._full_layers = built
         for fn in built:
             out = fn(*out) if isinstance(out, tuple) else fn(out)

@@ -2,22 +2,28 @@
 
 ~ fleet/meta_parallel/pipeline_parallel.py: PipelineParallel:31,
 forward_backward_pipeline:81 (1F1B startup/steady/cooldown :97-146),
-train_batch:153; p2p protocol pp_utils/p2p_communication.py.
+train_batch:153; p2p protocol pp_utils/p2p_communication.py:217.
 
 TPU execution modes:
-  * single-program (default when the whole mesh is visible): micro-batches
-    run sequentially over the FULL layer stack with grad accumulation —
-    semantically identical to 1F1B (same loss/grads); stage overlap comes
-    from the compiled pipeline in paddle_tpu.parallel.pipeline (shard_map +
-    ppermute over the 'pipe' axis) used on the jit path.
-  * multi-process: eager p2p via host collectives (correctness path).
+  * single-process (whole mesh visible): micro-batches run sequentially
+    over the FULL layer stack with grad accumulation — same loss/grads;
+    stage overlap comes from the compiled pipeline in
+    paddle_tpu.parallel.pipeline (shard_map + ppermute over 'pipe').
+  * multi-process (world == pp stages): REAL pipeline — each rank runs
+    only its PipelineLayer segment; activations/grads move between stage
+    processes over TCPStore p2p in 1F1B order (warmup fwds = stages -
+    stage_id - 1, steady 1F1B, cooldown bwds).
 """
 from __future__ import annotations
+
+import os
+from collections import deque
 
 import jax.numpy as jnp
 
 from ....core.tensor import Tensor
 from ....nn.layer.layers import Layer
+from ... import env as _env
 from .parallel_layers.pp_layers import PipelineLayer
 
 
@@ -35,6 +41,26 @@ class PipelineParallel(Layer):
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.stage_id = hcg.get_stage_id()
         self.total_loss = None
+        self._p2p = None
+
+    # -- multi-process plumbing --------------------------------------------
+    def _multiproc(self) -> bool:
+        return (_env.get_world_size() > 1 and self.num_stages > 1
+                and os.environ.get("PADDLE_MASTER") is not None)
+
+    def _get_p2p(self):
+        if self._p2p is None:
+            from ....distributed.store import TCPStore
+            from .pp_utils import P2PCommunicator
+            host, port = os.environ["PADDLE_MASTER"].split(":")
+            store = TCPStore(host, int(port) + 57,
+                             is_master=(_env.get_rank() == 0),
+                             world_size=_env.get_world_size())
+            dp = self._hcg.get_data_parallel_rank() \
+                if hasattr(self._hcg, "get_data_parallel_rank") else 0
+            self._p2p = P2PCommunicator(
+                store, self.stage_id, prefix=f"__pp_p2p__/dp{dp}")
+        return self._p2p
 
     def forward(self, *inputs, **kwargs):
         return self._layers.forward_full(*inputs, **kwargs)
@@ -49,10 +75,12 @@ class PipelineParallel(Layer):
         return [data[i * mb:(i + 1) * mb] for i in range(n)]
 
     def forward_backward_pipeline(self, data, scaler=None):
-        """1F1B-equivalent accumulation (~ pipeline_parallel.py:81)."""
+        """~ pipeline_parallel.py:81."""
         inputs, labels = data
         micro_inputs = self._split_micro(inputs)
         micro_labels = self._split_micro(labels)
+        if self._multiproc():
+            return self._pipeline_1f1b(micro_inputs, micro_labels, scaler)
         total = None
         for x, y in zip(micro_inputs, micro_labels):
             out = self._layers.forward_full(x)
@@ -69,6 +97,67 @@ class PipelineParallel(Layer):
                 else total + loss.detach()
         self._layers.allreduce_shared_weight_gradients()
         self.total_loss = total * (1.0 / self.accumulate_steps)
+        return self.total_loss
+
+    # -- real multi-process 1F1B -------------------------------------------
+    def _pipeline_1f1b(self, micro_inputs, micro_labels, scaler):
+        """1F1B over stage processes (~ forward_backward_pipeline:97-146:
+        startup forwards, steady one-forward-one-backward, cooldown
+        backwards). Each rank runs ONLY its segment; boundary tensors move
+        via TCPStore p2p."""
+        p2p = self._get_p2p()
+        first = self.stage_id == 0
+        last = self.stage_id == self.num_stages - 1
+        n = len(micro_inputs)
+        inflight = deque()  # (x_leaf|None, out|None, loss|None) FIFO
+        total = 0.0
+
+        def fwd(i):
+            if first:
+                x = micro_inputs[i]
+                if not isinstance(x, Tensor):
+                    x = Tensor(jnp.asarray(x))
+            else:
+                x = Tensor(jnp.asarray(p2p.recv(self.stage_id - 1)),
+                           stop_gradient=False)
+            out = self._layers.forward(x)
+            loss = None
+            if last:
+                y = micro_labels[i]
+                loss = self._layers._loss_fn(out, y) \
+                    if self._layers._loss_fn is not None else out
+                loss = loss * (1.0 / n)
+            else:
+                p2p.send(out.numpy(), self.stage_id + 1)
+            inflight.append((x, out, loss))
+
+        def bwd():
+            nonlocal total
+            x, out, loss = inflight.popleft()
+            if last:
+                (scaler.scale(loss) if scaler is not None
+                 else loss).backward()
+                total += float(loss.numpy()) * n
+            else:
+                g = p2p.recv(self.stage_id + 1, tag="grad")
+                from ....autograd import backward as tape_backward
+                tape_backward(out, Tensor(jnp.asarray(g)))
+            if not first:
+                p2p.send(x.grad.numpy(), self.stage_id - 1, tag="grad")
+
+        warmup = min(self.num_stages - self.stage_id - 1, n)
+        for i in range(warmup):                   # startup
+            fwd(i)
+        for i in range(warmup, n):                # steady 1F1B
+            fwd(i)
+            bwd()
+        while inflight:                           # cooldown
+            bwd()
+
+        self._layers.allreduce_shared_weight_gradients()
+        mean_loss = p2p.bcast_scalar(
+            total / n if last else None, self.num_stages - 1)
+        self.total_loss = Tensor(jnp.asarray(mean_loss, jnp.float32))
         return self.total_loss
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

@@ -1,0 +1,1 @@
+from .p2p_communication import P2PCommunicator  # noqa: F401

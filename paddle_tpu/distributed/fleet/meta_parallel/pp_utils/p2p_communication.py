@@ -1,0 +1,82 @@
+"""Point-to-point activation/grad transport between pipeline stages.
+
+~ reference fleet/meta_parallel/pp_utils/p2p_communication.py
+(SendRecvMeta:39 — dtype/shape metadata protocol — and _p2p_helper:217,
+batched isend/irecv between pipe stages). TPU-native difference: the
+compiled pipeline (paddle_tpu.parallel.pipeline) moves activations with
+ppermute over the 'pipe' mesh axis inside one XLA program; THIS module is
+the eager multi-process correctness path, carrying tensors out-of-band
+through the TCPStore rendezvous (true point-to-point — no global
+collective alignment needed between stages running different schedules).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_DTYPES = {
+    0: np.float32, 1: np.float64, 2: np.float16, 3: np.int32,
+    4: np.int64, 5: np.uint8, 6: np.bool_,
+}
+_DTYPE_IDS = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def _pack(arr: np.ndarray) -> bytes:
+    """SendRecvMeta analog: [dtype u8][ndim u8][dims i64...] + raw bytes."""
+    arr = np.ascontiguousarray(arr)
+    head = struct.pack("<BB", _DTYPE_IDS[arr.dtype], arr.ndim)
+    head += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    return head + arr.tobytes()
+
+
+def _unpack(buf: bytes) -> np.ndarray:
+    dt_id, ndim = struct.unpack_from("<BB", buf, 0)
+    shape = struct.unpack_from(f"<{ndim}q", buf, 2)
+    off = 2 + 8 * ndim
+    return np.frombuffer(buf, dtype=_DTYPES[dt_id],
+                         offset=off).reshape(shape).copy()
+
+
+class P2PCommunicator:
+    """Sequenced p2p channels keyed (src_stage -> dst_stage, tag)."""
+
+    def __init__(self, store, stage_id: int, prefix: str = "__pp_p2p__"):
+        self._store = store
+        self.stage_id = stage_id
+        self._prefix = prefix
+        self._send_seq: Dict[Tuple[int, str], int] = {}
+        self._recv_seq: Dict[Tuple[int, str], int] = {}
+
+    def _key(self, src: int, dst: int, tag: str, seq: int) -> str:
+        return f"{self._prefix}/{src}->{dst}/{tag}/{seq}"
+
+    def send(self, arr, dst_stage: int, tag: str = "act") -> None:
+        k = (dst_stage, tag)
+        seq = self._send_seq.get(k, 0)
+        self._send_seq[k] = seq + 1
+        self._store.set(self._key(self.stage_id, dst_stage, tag, seq),
+                        _pack(np.asarray(arr)))
+
+    def recv(self, src_stage: int, tag: str = "act") -> np.ndarray:
+        k = (src_stage, tag)
+        seq = self._recv_seq.get(k, 0)
+        self._recv_seq[k] = seq + 1
+        key = self._key(src_stage, self.stage_id, tag, seq)
+        buf = self._store.wait(key)
+        self._store.delete_key(key)
+        return _unpack(buf)
+
+    # -- scalar broadcast (the _broadcast_final_loss analog) ---------------
+    def bcast_scalar(self, value: Optional[float], src_stage: int,
+                     tag: str = "loss") -> float:
+        k = (src_stage, tag)
+        seq = self._send_seq.get(("__bc__", tag), 0)
+        self._send_seq[("__bc__", tag)] = seq + 1
+        key = f"{self._prefix}/bcast/{src_stage}/{tag}/{seq}"
+        if self.stage_id == src_stage:
+            self._store.set(key, struct.pack("<d", float(value)))
+            return float(value)
+        buf = self._store.wait(key)
+        return struct.unpack("<d", buf)[0]

@@ -24,19 +24,53 @@ class DataParallel(Layer):
         self._layers = layers
         self.group = group
         self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
         # mark param sharding: replicated across "data" axis (GSPMD)
         for p in layers.parameters():
             if getattr(p, "sharding_spec", None) is None:
                 p.sharding_spec = None  # replicated
+        # fire grad sync from backward() itself, like the reference's
+        # EagerReducer hooks (reducer.h:86) — user code never has to call
+        # apply_collective_grads by hand
+        import weakref
+
+        from ..autograd.tape import register_post_backward_hook
+        ref = weakref.ref(self)
+
+        def _sync():
+            dp = ref()
+            if dp is None:
+                self._hook.remove()
+                return
+            if dp._grad_sync_enabled:
+                dp.apply_collective_grads()
+
+        self._hook = register_post_backward_hook(_sync)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        """Context manager suppressing grad sync (grad accumulation), ~
+        fluid/dygraph/parallel.py DataParallel.no_sync."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._grad_sync_enabled
+            self._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                self._grad_sync_enabled = prev
+
+        return ctx()
 
     @no_grad()
     def apply_collective_grads(self):
         """Eager DP grad averaging (~ Reducer::FusedAllReduceSchedule)."""
         world = C.get_world_size(self.group)
-        if world <= 1:
+        if world <= 1 or not C._multi_process():
             return
         for p in self._layers.parameters():
             if p._grad is not None:

@@ -142,6 +142,10 @@ class TCPStore:
         self._server = None
         self._fd = None
         self._py = None
+        # one client socket per store: concurrent threads interleaving
+        # request/response frames on the same fd deadlock the protocol
+        # (observed in the elastic heartbeat thread vs the caller)
+        self._mu = threading.Lock()
         if is_master:
             if self._lib is not None:
                 self._server = self._lib.tcpstore_server_start(port)
@@ -169,7 +173,8 @@ class TCPStore:
     def set(self, key: str, value) -> None:
         v = value if isinstance(value, bytes) else str(value).encode()
         if self._fd is not None:
-            rc = self._lib.tcpstore_set(self._fd, key.encode(), v, len(v))
+            with self._mu:
+                rc = self._lib.tcpstore_set(self._fd, key.encode(), v, len(v))
             if rc != 0:
                 raise ConnectionError("TCPStore set failed")
         else:
@@ -180,8 +185,9 @@ class TCPStore:
             buf = (ctypes_buffer := bytearray(1 << 20))
             import ctypes
             c_buf = (ctypes.c_char * len(buf)).from_buffer(buf)
-            n = self._lib.tcpstore_get(self._fd, key.encode(), c_buf,
-                                       len(buf))
+            with self._mu:
+                n = self._lib.tcpstore_get(self._fd, key.encode(), c_buf,
+                                           len(buf))
             if n < 0:
                 raise ConnectionError("TCPStore get failed")
             return bytes(buf[:n])
@@ -189,7 +195,8 @@ class TCPStore:
 
     def add(self, key: str, delta: int) -> int:
         if self._fd is not None:
-            out = self._lib.tcpstore_add(self._fd, key.encode(), delta)
+            with self._mu:
+                out = self._lib.tcpstore_add(self._fd, key.encode(), delta)
             if out == -(2 ** 63):
                 raise ConnectionError("TCPStore add failed")
             return int(out)
@@ -202,8 +209,9 @@ class TCPStore:
             import ctypes
             buf = bytearray(1 << 20)
             c_buf = (ctypes.c_char * len(buf)).from_buffer(buf)
-            n = self._lib.tcpstore_wait(self._fd, key.encode(), c_buf,
-                                        len(buf))
+            with self._mu:
+                n = self._lib.tcpstore_wait(self._fd, key.encode(), c_buf,
+                                            len(buf))
             if n < 0:
                 raise ConnectionError("TCPStore wait failed")
             return bytes(buf[:n])
@@ -211,7 +219,8 @@ class TCPStore:
 
     def delete_key(self, key: str) -> None:
         if self._fd is not None:
-            self._lib.tcpstore_delete(self._fd, key.encode())
+            with self._mu:
+                self._lib.tcpstore_delete(self._fd, key.encode())
         else:
             self._py._roundtrip(4, key.encode(), b"")
 

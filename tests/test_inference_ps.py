@@ -102,10 +102,11 @@ class TestElastic:
         m2 = ElasticManager(TCPStore("127.0.0.1", 29633), "node-b",
                             np_range=(1, 3), heartbeat_interval=0.2,
                             dead_after=2.0).start()
-        import time
-        time.sleep(0.6)
+        # registration is synchronous in start(); membership must be
+        # immediately visible — no sleeps (the round-1 flaky race)
         alive = m1.alive_members()
         assert set(alive) == {"node-a", "node-b"}
+        assert set(m2.alive_members()) == {"node-a", "node-b"}
         m2.stop()
         m1.stop()
         master.close()

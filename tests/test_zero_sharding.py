@@ -15,13 +15,15 @@ from paddle_tpu import nn
 
 @pytest.fixture
 def sharding_mesh():
-    from paddle_tpu.distributed.topology import (build_mesh, get_global_mesh,
-                                                 set_global_mesh)
-    prev = get_global_mesh()
-    mesh = build_mesh({"sharding": 8})
-    set_global_mesh(mesh)
+    from paddle_tpu.distributed import topology as topo
+    prev = topo.get_global_mesh()
+    prev_hcg = topo.get_hybrid_communicate_group()
+    topo.set_hybrid_communicate_group(None)  # isolate from other tests
+    mesh = topo.build_mesh({"sharding": 8})
+    topo.set_global_mesh(mesh)
     yield mesh
-    set_global_mesh(prev)
+    topo.set_global_mesh(prev)
+    topo.set_hybrid_communicate_group(prev_hcg)
 
 
 def _train_one_step(model, opt):
