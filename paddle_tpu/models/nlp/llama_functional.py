@@ -223,3 +223,166 @@ def llama_pp_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                       batch_sh, batch_sh),
         donate_argnums=(0, 1))
     return params, opt_state, jitted
+
+
+# ---------------------------------------------------------------------------
+# Full 4D composition: data x sharding x model x pipe in ONE program
+# ---------------------------------------------------------------------------
+
+# TP layout of the stacked layer leaves (n_stages, per_stage, in, out):
+# column-parallel projections shard the output dim over 'model',
+# row-parallel shard the input dim (~ mp_layers.py ColumnParallelLinear:97 /
+# RowParallelLinear:170 expressed as GSPMD specs)
+_COL_KEYS = {"self_attn.q_proj.weight", "self_attn.k_proj.weight",
+             "self_attn.v_proj.weight", "mlp.gate_proj.weight",
+             "mlp.up_proj.weight"}
+_ROW_KEYS = {"self_attn.o_proj.weight", "mlp.down_proj.weight"}
+
+
+def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
+                                n_microbatches: int = 2,
+                                learning_rate=1e-4, weight_decay=0.01,
+                                beta1=0.9, beta2=0.95, eps=1e-8,
+                                remat: bool = True):
+    """ONE jitted train step over data x sharding x model x pipe.
+
+    ~ the reference's 4D HybridCommunicateGroup axes
+    (fleet/base/topology.py:52 ["data","pipe","sharding","model"]) — but
+    composed by GSPMD in a single XLA program rather than four comm-group
+    runtimes: 'pipe' rotates stages via ppermute inside a partial-manual
+    shard_map, 'model' partitions the stage matmuls (TP), 'data' shards the
+    microbatch, and 'sharding' holds the ZeRO-sharded adamw moments.
+    Mesh axes absent (or size 1) degrade gracefully.
+    """
+    cfg = model.config
+    n_stages = mesh.shape["pipe"]
+    have = {a for a in mesh.axis_names if mesh.shape[a] > 1}
+    data_axis = "data" if "data" in mesh.axis_names else None
+    mdl = "model" if "model" in have else None
+    L = cfg.num_hidden_layers
+    assert L % n_stages == 0, (L, n_stages)
+    per = L // n_stages
+
+    outer, layers = split_params(model)
+    layers = jax.tree.map(
+        lambda a: jnp.array(a, copy=True).reshape(
+            (n_stages, per) + a.shape[1:]), layers)
+    outer = {k: jnp.array(v, copy=True) for k, v in outer.items()}
+
+    def layer_spec(key, shape):
+        spec = ["pipe"] + [None] * (len(shape) - 1)
+        if mdl and key in _COL_KEYS and shape[-1] % mesh.shape[mdl] == 0:
+            spec[-1] = mdl
+        elif mdl and key in _ROW_KEYS and shape[-2] % mesh.shape[mdl] == 0:
+            spec[-2] = mdl
+        return P(*spec)
+
+    def outer_spec(key, shape):
+        if mdl and key == "model.embed_tokens.weight" \
+                and shape[0] % mesh.shape[mdl] == 0:
+            return P(mdl, None)   # vocab-parallel (~ VocabParallelEmbedding)
+        if mdl and key == "lm_head.weight" \
+                and shape[-1] % mesh.shape[mdl] == 0:
+            return P(None, mdl)
+        return P()
+
+    def zero_spec(base: P, shape):
+        """Moment layout: param spec + 'sharding' on the largest free,
+        divisible dim (ZeRO over the 'sharding' axis)."""
+        spec = list(base) + [None] * (len(shape) - len(base))
+        if "sharding" in have:
+            n = mesh.shape["sharding"]
+            for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+                if spec[i] is None and shape[i] % n == 0 and shape[i] >= n:
+                    spec[i] = "sharding"
+                    break
+        return P(*spec)
+
+    layer_sh = {k: NamedSharding(mesh, layer_spec(k, v.shape))
+                for k, v in layers.items()}
+    outer_sh = {k: NamedSharding(mesh, outer_spec(k, v.shape))
+                for k, v in outer.items()}
+    layer_msh = {k: NamedSharding(mesh, zero_spec(layer_sh[k].spec, v.shape))
+                 for k, v in layers.items()}
+    outer_msh = {k: NamedSharding(mesh, zero_spec(outer_sh[k].spec, v.shape))
+                 for k, v in outer.items()}
+
+    outer = {k: jax.device_put(v, outer_sh[k]) for k, v in outer.items()}
+    layers = {k: jax.device_put(v, layer_sh[k]) for k, v in layers.items()}
+    params = {"outer": outer, "layers": layers}
+
+    def zeros_tree(tree, sh):
+        return {k: jax.device_put(jnp.zeros(v.shape, jnp.float32), sh[k])
+                for k, v in tree.items()}
+
+    rep = NamedSharding(mesh, P())
+    opt_state = {
+        "step": jnp.zeros((), jnp.int32),
+        "m": {"outer": zeros_tree(outer, outer_msh),
+              "layers": zeros_tree(layers, layer_msh)},
+        "v": {"outer": zeros_tree(outer, outer_msh),
+              "layers": zeros_tree(layers, layer_msh)},
+    }
+
+    def stage_fn(stage_params, x):
+        body = lambda carry, lp: (layer_forward(cfg, lp, carry), None)
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    auto = {a for a in ("model", "sharding") if a in mesh.axis_names}
+
+    def pipe_loss(params, tokens, labels):
+        emb = jnp.take(params["outer"]["model.embed_tokens.weight"], tokens,
+                       axis=0)
+        from ...parallel.pipeline import pipeline_apply
+        h = pipeline_apply(stage_fn, params["layers"], emb, mesh,
+                           n_microbatches, remat=remat, data_axis=data_axis,
+                           auto_axes=auto)
+        h = _rms(h, params["outer"]["model.norm.weight"], cfg.rms_norm_eps)
+        head = params["outer"].get("lm_head.weight")
+        logits = (h @ (head if head is not None
+                       else params["outer"]["model.embed_tokens.weight"].T))
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.mean(
+            -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
+
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(pipe_loss)(params, tokens, labels)
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m2 = beta1 * m + (1 - beta1) * g
+            v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+            mhat = m2 / (1 - beta1 ** t)
+            vhat = v2 / (1 - beta2 ** t)
+            delta = mhat / (jnp.sqrt(vhat) + eps) \
+                + weight_decay * p.astype(jnp.float32)
+            return ((p.astype(jnp.float32)
+                     - learning_rate * delta).astype(p.dtype), m2, v2)
+
+        new_p = {"outer": {}, "layers": {}}
+        new_m = {"outer": {}, "layers": {}}
+        new_v = {"outer": {}, "layers": {}}
+        for grp in ("outer", "layers"):
+            for k in params[grp]:
+                new_p[grp][k], new_m[grp][k], new_v[grp][k] = upd(
+                    params[grp][k], grads[grp][k],
+                    opt_state["m"][grp][k], opt_state["v"][grp][k])
+        return new_p, {"step": step, "m": new_m, "v": new_v}, loss
+
+    batch_sh = NamedSharding(mesh, P(data_axis) if data_axis else P())
+    param_sh = {"outer": outer_sh, "layers": layer_sh}
+    mom_sh = {"outer": outer_msh, "layers": layer_msh}
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(param_sh,
+                      {"step": rep, "m": mom_sh, "v": mom_sh},
+                      batch_sh, batch_sh),
+        out_shardings=(param_sh,
+                       {"step": rep, "m": mom_sh, "v": mom_sh},
+                       rep),
+        donate_argnums=(0, 1))
+    return params, opt_state, jitted

@@ -31,7 +31,8 @@ def stack_stage_params(per_stage_params):
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
                    n_microbatches: int, axis: str = "pipe",
-                   remat: bool = True, data_axis: str | None = None):
+                   remat: bool = True, data_axis: str | None = None,
+                   auto_axes=None):
     """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
 
     stage_fn(stage_params, activation) -> activation (same shape) — the body
@@ -99,7 +100,15 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     # each data shard runs its own pipeline instance over the same stages
     x_spec = P(None, data_axis) if data_axis else P()
     in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), x_spec)
+    kw = {}
+    if auto_axes:
+        # partial-manual shard_map: 'pipe'/'data' rotate explicitly, the
+        # listed axes (e.g. 'model' for TP, 'sharding' for ZeRO) stay with
+        # GSPMD — the compiler partitions the stage body's matmuls from the
+        # incoming param shardings (4D composition in ONE program)
+        kw["axis_names"] = frozenset(
+            a for a in mesh.axis_names if a not in auto_axes)
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
-                       out_specs=x_spec, check_vma=False)
+                       out_specs=x_spec, check_vma=False, **kw)
     y = fn(stacked_params, xm)
     return y.reshape((B,) + y.shape[2:])

@@ -76,3 +76,49 @@ def test_split_merge_roundtrip(tiny_model):
     LF.merge_params(model, outer, layers2)
     w_after = model.model.layers[2].mlp.gate_proj.weight.numpy()
     np.testing.assert_allclose(w_after, w_before + 1.0, rtol=1e-6)
+
+
+class Test4DComposition:
+    """data x sharding x model x pipe in ONE jitted step (round-1 verdict
+    #6; ~ reference topology.py:52 4D HybridCommunicateGroup)."""
+
+    def test_4d_loss_matches_oracle_and_moments_sharded(self, tiny_model):
+        cfg, model = tiny_model
+        tokens = _tokens(4, 8, cfg.vocab_size)
+        labels = _tokens(4, 8, cfg.vocab_size, 1)
+        outer, layers = LF.split_params(model)
+        ref = float(LF.loss_fn(cfg, outer, layers, tokens, labels,
+                               remat=False))
+
+        devs = np.asarray(jax.devices()[:8])
+        mesh = Mesh(devs.reshape(1, 2, 2, 2),
+                    ("data", "pipe", "sharding", "model"))
+        params, opt_state, step = LF.llama_4d_train_step_factory(
+            model, mesh, n_microbatches=2, learning_rate=1e-3, remat=False)
+        p1, o1, loss1 = step(params, opt_state, tokens, labels)
+        np.testing.assert_allclose(float(loss1), ref, rtol=1e-4)
+        # ZeRO: every >=2-dim moment leaf is additionally sharded over
+        # 'sharding' — addressable shard of q_proj moment is 1/8 (pipe x
+        # sharding x model)
+        mv = o1["m"]["layers"]["self_attn.q_proj.weight"]
+        assert "sharding" in [ax for s in mv.sharding.spec
+                              for ax in ([s] if isinstance(s, str) else
+                                         (s or []))]
+        assert mv.addressable_shards[0].data.size * 8 == mv.size
+        _, _, loss2 = step(p1, o1, tokens, labels)
+        assert float(loss2) < float(loss1)
+
+    def test_4d_with_data_axis(self, tiny_model):
+        cfg, model = tiny_model
+        tokens = _tokens(4, 8, cfg.vocab_size)
+        labels = _tokens(4, 8, cfg.vocab_size, 1)
+        outer, layers = LF.split_params(model)
+        ref = float(LF.loss_fn(cfg, outer, layers, tokens, labels,
+                               remat=False))
+        devs = np.asarray(jax.devices()[:8])
+        mesh = Mesh(devs.reshape(2, 2, 1, 2),
+                    ("data", "pipe", "sharding", "model"))
+        params, opt_state, step = LF.llama_4d_train_step_factory(
+            model, mesh, n_microbatches=2, learning_rate=1e-3, remat=False)
+        _, _, loss = step(params, opt_state, tokens, labels)
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
