@@ -194,3 +194,6 @@ from . import cost_model  # noqa: F401,E402
 from . import planner  # noqa: F401,E402
 from .cost_model import Cluster, CostModel, DeviceSpec, LinkSpec, ModelSpec  # noqa: F401,E402
 from .planner import Plan, Planner  # noqa: F401,E402
+from .completion import (Completer, DistContext, OpDistAttr,  # noqa: F401,E402
+                         TensorDistAttr)
+from .partitioner import Partitioner, Resharder  # noqa: F401,E402

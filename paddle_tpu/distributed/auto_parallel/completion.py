@@ -1,0 +1,201 @@
+"""Dist-attribute completion over captured static Programs.
+
+~ reference auto_parallel/completion.py:139 (Completer.complete_forward_
+annotation: propagate ProcessMesh + dims_mapping through every op from the
+user's shard_tensor annotations, :726 update loop). Same contract, over the
+TPU build's functional OpNode DAG (static/graph.py) instead of ProgramDesc:
+dims_mapping is a per-tensor-dim list of mesh-axis indices (-1 =
+replicated), exactly the reference's dist_attribute.py convention.
+
+The completed DistContext feeds the Partitioner (per-rank local programs)
+and the Resharder (communication insertion) — golden-testable program text,
+the reference's auto_parallel test style (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _var_name(v) -> str:
+    return getattr(v, "name", None) or f"lit_{id(v)}"
+
+
+def _ndim(v) -> int:
+    shp = getattr(v, "shape", None)
+    if shp is None:
+        return 0
+    return len(shp)
+
+
+class TensorDistAttr:
+    """~ dist_attribute.py TensorDistributedAttribute."""
+
+    def __init__(self, dims_mapping: List[int], is_partial_on: frozenset =
+                 frozenset()):
+        self.dims_mapping = list(dims_mapping)
+        # mesh axes over which the value is a partial sum (pending psum)
+        self.is_partial_on = frozenset(is_partial_on)
+
+    def __repr__(self):
+        p = f" partial{sorted(self.is_partial_on)}" if self.is_partial_on \
+            else ""
+        return f"{self.dims_mapping}{p}"
+
+
+class OpDistAttr:
+    """~ dist_attribute.py OperatorDistributedAttribute."""
+
+    def __init__(self, op_name: str, inputs: List[str], outputs: List[str],
+                 in_attrs: List[TensorDistAttr],
+                 out_attrs: List[TensorDistAttr]):
+        self.op_name = op_name
+        self.inputs = inputs
+        self.outputs = outputs
+        self.in_attrs = in_attrs
+        self.out_attrs = out_attrs
+
+
+class DistContext:
+    """~ dist_context.py DistributedContext: mesh + all completed attrs."""
+
+    def __init__(self, process_mesh):
+        self.process_mesh = process_mesh
+        self.var_attrs: Dict[str, TensorDistAttr] = {}
+        self.var_shapes: Dict[str, List[int]] = {}
+        self.ops: List[OpDistAttr] = []
+        self.outputs: List[str] = []  # fetch vars: partials resolve here
+
+    def set_var(self, name, attr, shape=None):
+        self.var_attrs[name] = attr
+        if shape is not None:
+            self.var_shapes[name] = list(shape)
+
+    def get_var(self, name) -> Optional[TensorDistAttr]:
+        return self.var_attrs.get(name)
+
+
+def _rep(nd):
+    return TensorDistAttr([-1] * nd)
+
+
+class Completer:
+    """~ completion.py:139 — forward dist-attr propagation."""
+
+    def __init__(self, process_mesh,
+                 annotations: Dict[str, Sequence[Optional[str]]]):
+        """annotations: var name -> shard_spec (mesh dim NAMES per tensor
+        dim, None = replicated), the shard_tensor surface."""
+        self.mesh = process_mesh
+        self.annotations = {}
+        for name, spec in annotations.items():
+            self.annotations[name] = [
+                -1 if s is None else process_mesh.dim_names.index(s)
+                for s in spec]
+
+    # -- per-op propagation rules ------------------------------------------
+    def _prop(self, op_name, in_attrs: List[TensorDistAttr],
+              in_vars) -> (List[TensorDistAttr], TensorDistAttr):
+        """Returns (REQUIRED input attrs, output attr). A required attr that
+        differs from the producer's is a reshard edge."""
+        ew = {"relu", "tanh", "sigmoid", "gelu", "silu", "add", "subtract",
+              "multiply", "divide", "scale", "softmax", "exp", "dropout"}
+        if op_name in ("linear", "matmul"):
+            x, w = in_attrs[0], in_attrs[1]
+            xm = list(x.dims_mapping)
+            wm = list(w.dims_mapping)
+            k_x, k_w = xm[-1], wm[0] if wm else -1
+            out = xm[:-1] + [wm[-1] if len(wm) > 1 else -1]
+            partial = frozenset()
+            req_x, req_w = list(xm), list(wm)
+            if k_x != k_w:
+                # contracted dim must agree: gather the sharded side
+                req_x[-1] = -1
+                if wm:
+                    req_w[0] = -1
+            elif k_x != -1:
+                # both sharded the contraction dim -> partial sum (the
+                # reference inserts c_allreduce_sum here, reshard.py:603)
+                partial = frozenset({k_x})
+            req = [TensorDistAttr(req_x), TensorDistAttr(req_w)]
+            if len(in_attrs) > 2:  # bias: follow output's last dim
+                req.append(TensorDistAttr([out[-1]]))
+            return req, TensorDistAttr(out, partial)
+        if op_name in ("mean", "sum", "reduce_mean", "reduce_sum"):
+            x = in_attrs[0]
+            partial = frozenset(m for m in x.dims_mapping if m != -1) \
+                | x.is_partial_on
+            return [x], TensorDistAttr([], partial)
+        if op_name in ew:
+            base = next((a for a in in_attrs if a.dims_mapping), None)
+            out = list(base.dims_mapping) if base else []
+            req = []
+            for a, v in zip(in_attrs, in_vars):
+                nd = _ndim(v)
+                req.append(TensorDistAttr(out[-nd:] if nd else []))
+            partial = frozenset().union(*[a.is_partial_on
+                                          for a in in_attrs]) \
+                if in_attrs else frozenset()
+            return req, TensorDistAttr(out, partial)
+        if op_name in ("transpose", "t"):
+            x = in_attrs[0]
+            return [x], TensorDistAttr(list(reversed(x.dims_mapping)),
+                                       x.is_partial_on)
+        # unknown op: demand fully replicated inputs, replicated out
+        req = [_rep(len(a.dims_mapping)) for a in in_attrs]
+        nd_out = len(in_attrs[0].dims_mapping) if in_attrs else 0
+        return req, _rep(nd_out)
+
+    # -- the walk -----------------------------------------------------------
+    def complete_forward_annotation(self, outputs) -> DistContext:
+        """outputs: fetch StaticVars; walks producers topologically."""
+        ctx = DistContext(self.mesh)
+        if not isinstance(outputs, (list, tuple)):
+            outputs = [outputs]
+        ctx.outputs = [_var_name(o) for o in outputs]
+
+        # topo order of OpNodes (post-order from outputs)
+        order, seen = [], set()
+
+        def visit(v):
+            node = getattr(v, "_node", None)
+            if node is None or id(node) in seen:
+                return
+            seen.add(id(node))
+            for a in node.args:
+                if hasattr(a, "_node") or hasattr(a, "shape"):
+                    visit(a)
+            order.append(node)
+
+        for o in outputs:
+            visit(o)
+
+        def attr_for(v) -> TensorDistAttr:
+            name = _var_name(v)
+            if name in ctx.var_attrs:
+                return ctx.var_attrs[name]
+            nd = _ndim(v)
+            if name in self.annotations:
+                m = self.annotations[name]
+                a = TensorDistAttr(m + [-1] * (nd - len(m)))
+            else:
+                a = _rep(nd)
+            ctx.set_var(name, a, getattr(v, "shape", None))
+            return a
+
+        for node in order:
+            tens_in = [a for a in node.args
+                       if hasattr(a, "shape") and _ndim(a) >= 0
+                       and hasattr(a, "dtype")]
+            in_attrs = [attr_for(a) for a in tens_in]
+            req, out_attr = self._prop(node.name, in_attrs, tens_in)
+            for ov in node.out_vars:
+                ctx.set_var(_var_name(ov), out_attr,
+                            getattr(ov, "shape", None))
+            ctx.ops.append(OpDistAttr(
+                node.name,
+                [_var_name(a) for a in tens_in],
+                [_var_name(ov) for ov in node.out_vars],
+                req, [out_attr]))
+        return ctx

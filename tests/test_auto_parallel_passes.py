@@ -1,0 +1,126 @@
+"""Auto-parallel program transformation: Completer / Partitioner /
+Resharder golden tests.
+
+~ reference auto_parallel tests (SURVEY.md §4): build a serial program,
+run completion + partition + reshard, and assert on the GENERATED PROGRAM
+TEXT per rank — ops, dist attrs, local shapes, inserted communication.
+Refs: completion.py:139, partitioner.py:37, reshard.py:603.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed.auto_parallel import (Completer, Partitioner,
+                                                  ProcessMesh)
+
+
+@pytest.fixture
+def mlp_program():
+    paddle.enable_static()
+    import paddle_tpu.nn.functional as F
+    x = static.data("x", [8, 16], "float32")
+    h = static.nn.fc(x, 16, name="fc1")
+    r = F.relu(h)
+    o = static.nn.fc(r, 4, name="fc2")
+    loss = paddle.mean(o)
+    yield x, h, r, o, loss
+    paddle.disable_static()
+
+
+def _param_names(loss):
+    # walk producers, collect Parameter arg names in deterministic order
+    names, seen, stack = [], set(), [loss]
+    while stack:
+        v = stack.pop()
+        node = getattr(v, "_node", None)
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        for a in node.args:
+            if getattr(a, "persistable", False):
+                names.append(a.name)
+            elif hasattr(a, "_node"):
+                stack.append(a)
+    return names
+
+
+class TestCompleter:
+    def test_mp_propagation_marks_partial_and_allreduce(self, mlp_program):
+        x, h, r, o, loss = mlp_program
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+        params = _param_names(loss)
+        # fc1 weight column-parallel (out dim over mp), fc2 weight
+        # row-parallel (in dim over mp) — Megatron MLP split
+        w1, b1 = params[-2], params[-1]  # reverse topo: fc2 first
+        w2 = params[0]
+        ann = {"x": [None, None],
+               w1: [None, "mp"], b1: ["mp"],
+               w2: ["mp", None]}
+        ctx = Completer(mesh, ann).complete_forward_annotation(loss)
+
+        names = [op.op_name for op in ctx.ops]
+        assert names == ["linear", "relu", "linear", "mean"]
+        # fc1 out sharded over mp (axis 1) on its last dim
+        assert ctx.ops[0].out_attrs[0].dims_mapping == [-1, 1]
+        # relu preserves the sharding
+        assert ctx.ops[1].out_attrs[0].dims_mapping == [-1, 1]
+        # fc2 contracts the mp-sharded dim on both sides -> partial sum
+        assert ctx.ops[2].out_attrs[0].dims_mapping == [-1, -1]
+        assert ctx.ops[2].out_attrs[0].is_partial_on == frozenset({1})
+
+    def test_dp_batch_annotation(self, mlp_program):
+        x, h, r, o, loss = mlp_program
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+        ctx = Completer(mesh, {"x": ["dp", None]}) \
+            .complete_forward_annotation(loss)
+        # batch dim stays dp-sharded through the stack
+        assert ctx.ops[0].out_attrs[0].dims_mapping == [0, -1]
+        assert ctx.ops[2].out_attrs[0].dims_mapping == [0, -1]
+
+
+class TestPartitionerGolden:
+    def test_rank_program_text(self, mlp_program):
+        x, h, r, o, loss = mlp_program
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+        params = _param_names(loss)
+        w1, b1, w2 = params[-2], params[-1], params[0]
+        ann = {"x": ["dp", None],
+               w1: [None, "mp"], b1: ["mp"],
+               w2: ["mp", None]}
+        ctx = Completer(mesh, ann).complete_forward_annotation(loss)
+        text = Partitioner(ctx).partition(0)
+        lines = [ln.strip() for ln in text.splitlines()]
+
+        # golden: local shapes halve over dp (batch 8->4) and mp (16->8)
+        assert lines[0].startswith("rank 0 coords {'dp': 0, 'mp': 0}")
+        assert any(ln.startswith("linear(x[4, 16]") and "[16, 8]" in ln
+                   for ln in lines), text
+        assert any(ln.startswith("relu") and "[4, 8]" in ln
+                   for ln in lines), text
+        # the partial sum from the row-parallel fc2 resolves with an
+        # inserted c_allreduce_sum over the mp mesh dim before mean's
+        # replicated requirement... mean keeps partial over mp AND dp
+        assert any("c_allreduce_sum" in ln and "'mp'" in ln
+                   for ln in lines), text
+
+    def test_reshard_allgather_inserted_on_mismatch(self, mlp_program):
+        x, h, r, o, loss = mlp_program
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+        params = _param_names(loss)
+        w1, b1, w2 = params[-2], params[-1], params[0]
+        # fc1 column-parallel but fc2 NOT row-parallel: the mp-sharded
+        # activation must be all-gathered before entering fc2
+        ann = {w1: [None, "mp"], b1: ["mp"], w2: [None, None]}
+        ctx = Completer(mesh, ann).complete_forward_annotation(loss)
+        text = Partitioner(ctx).partition(2)
+        assert "c_allgather" in text and "'mp'" in text, text
+
+    def test_partition_all_covers_every_rank(self, mlp_program):
+        *_, loss = mlp_program
+        mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+        ctx = Completer(mesh, {"x": ["dp", None]}) \
+            .complete_forward_annotation(loss)
+        progs = Partitioner(ctx).partition_all()
+        assert sorted(progs) == [0, 1, 2, 3]
+        assert progs[1] != progs[0]  # coords differ in the header
