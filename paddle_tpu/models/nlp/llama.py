@@ -349,6 +349,11 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
         finally:
             model.load_tree(saved)  # don't leave tracers in the Layer
             set_context_parallel_mesh(prev[0], prev[1])
+        if jax.default_backend() != "cpu":
+            # Pallas fused softmax-xent: skips the (B*S, V) softmax HBM
+            # round trip (the largest intermediate of the training loss)
+            from ...ops.pallas.fused_ce import causal_lm_loss
+            return causal_lm_loss(logits, labels)
         logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, -1)
         nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]

@@ -112,10 +112,20 @@ def forward(cfg: LlamaConfig, outer, layers, tokens, remat=True):
     return x @ head
 
 
-def loss_fn(cfg, outer, layers, tokens, labels, remat=True):
-    logits = forward(cfg, outer, layers, tokens, remat).astype(jnp.float32)
+def _ce(logits, labels):
+    """Causal-LM CE: Pallas fused softmax-xent on TPU (no (N,V) softmax
+    HBM round-trip), dense log_softmax on CPU."""
+    if jax.default_backend() != "cpu":
+        from ...ops.pallas.fused_ce import causal_lm_loss
+        return causal_lm_loss(logits, labels)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, -1)
     return jnp.mean(-jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
+
+
+def loss_fn(cfg, outer, layers, tokens, labels, remat=True):
+    logits = forward(cfg, outer, layers, tokens, remat)
+    return _ce(logits, labels)
 
 
 def llama_pp_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,

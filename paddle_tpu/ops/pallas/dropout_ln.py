@@ -1,0 +1,92 @@
+"""Fused dropout + residual-add + layernorm Pallas kernel.
+
+~ the reference's fused_bias_dropout_residual_layer_norm family
+(paddle/fluid/operators/fused/fused_attention_op.cu,
+fused_dropout_helper.h): the transformer residual path
+``ln(residual + dropout(x))`` done in one VMEM pass — the three
+intermediates never round-trip HBM. Dropout randomness comes in as a
+uint32 bits tensor generated with the framework Generator outside the
+kernel (seed+offset reproducibility, phi/core/generator.h:23 semantics)
+so the kernel itself stays deterministic.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+BLOCK_ROWS = 128
+
+
+def _kernel(x_ref, res_ref, bits_ref, w_ref, b_ref, o_ref, *, p, eps,
+            training):
+    x = x_ref[...].astype(jnp.float32)
+    if training and p > 0.0:
+        # keep when uniform(bits) >= p; inverted scaling keeps E[out]=x
+        u = bits_ref[...].astype(jnp.float32) / 4294967296.0
+        keep = (u >= p).astype(jnp.float32)
+        x = x * keep / (1.0 - p)
+    h = x + res_ref[...].astype(jnp.float32)
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    hc = h - mu
+    var = jnp.mean(hc * hc, axis=-1, keepdims=True)
+    y = hc * jax.lax.rsqrt(var + eps)
+    y = y * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def fused_dropout_add_layer_norm(x, residual, weight, bias, p=0.1,
+                                 eps=1e-5, training=True, bits=None):
+    """x, residual: (..., H); weight/bias: (H,). Returns ln(res+drop(x)).
+
+    bits: optional uint32 tensor shaped like x (dropout randomness); when
+    None and training, drawn from the framework Generator.
+    """
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    x2 = x.reshape(-1, H)
+    r2 = residual.reshape(-1, H)
+    N = x2.shape[0]
+    R = min(BLOCK_ROWS, N)
+    if N % R != 0:  # ragged: dense fallback keeps semantics
+        xf = x2.astype(jnp.float32)
+        if training and p > 0.0:
+            if bits is None:
+                from ...core.generator import default_generator
+                bits = jax.random.bits(default_generator().next_key(),
+                                       (N, H), jnp.uint32)
+            u = bits.reshape(N, H).astype(jnp.float32) / 4294967296.0
+            xf = xf * (u >= p).astype(jnp.float32) / (1.0 - p)
+        h = xf + r2.astype(jnp.float32)
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        y = (h - mu) * jax.lax.rsqrt(var + eps) * weight + bias
+        return y.astype(x.dtype).reshape(orig_shape)
+    if bits is None:
+        if training and p > 0.0:
+            from ...core.generator import default_generator
+            bits = jax.random.bits(default_generator().next_key(), (N, H),
+                                   jnp.uint32)
+        else:
+            bits = jnp.zeros((N, H), jnp.uint32)
+    out = pl.pallas_call(
+        functools.partial(_kernel, p=float(p), eps=float(eps),
+                          training=bool(training)),
+        grid=(N // R,),
+        in_specs=[pl.BlockSpec((R, H), lambda i: (i, 0)),
+                  pl.BlockSpec((R, H), lambda i: (i, 0)),
+                  pl.BlockSpec((R, H), lambda i: (i, 0)),
+                  pl.BlockSpec((H,), lambda i: (0,)),
+                  pl.BlockSpec((H,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((R, H), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, H), x.dtype),
+        interpret=_interpret(),
+    )(x2, r2, bits.reshape(N, H), weight, bias)
+    return out.reshape(orig_shape)

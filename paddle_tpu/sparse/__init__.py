@@ -92,11 +92,119 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
     return SparseCsrTensor(crows, cols, values, shape, stop_gradient)
 
 
+def _coo_rows_cols(x):
+    """(rows, cols, vals) jnp arrays for a 2-D sparse tensor."""
+    if isinstance(x, SparseCooTensor):
+        idx = x.indices_._value
+        return idx[0], idx[1], x.values_._value
+    # CSR: expand crows to per-nnz row ids (host side — crows is static)
+    crows = np.asarray(x.crows_._value)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return jnp.asarray(rows), x.cols_._value, x.values_._value
+
+
 def matmul(x, y):
+    """sparse @ dense without densifying x: gather rows of y by col index
+    and segment-sum into output rows (~ phi/kernels/sparse/matmul_kernel;
+    the scatter-add formulation XLA lowers to MXU-friendly gathers)."""
     from ..ops.linalg import matmul as dense_matmul
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        rows, cols, vals = _coo_rows_cols(x)
+        M = x.dense_shape[0]
+        contrib = vals[:, None] * yv[cols]          # (nnz, N)
+        out = jax.ops.segment_sum(contrib, rows, num_segments=M)
+        return Tensor(out.astype(yv.dtype))
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # dense @ sparse == (sparse^T @ dense^T)^T using the same kernel
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        rows, cols, vals = _coo_rows_cols(y)
+        N = y.dense_shape[1]
+        contrib = vals[:, None] * xv.T[rows]        # (nnz, M)
+        out = jax.ops.segment_sum(contrib, cols, num_segments=N)
+        return Tensor(out.T.astype(xv.dtype))
+    return dense_matmul(x, y)
+
+
+def masked_matmul(x, y, mask):
+    """~ paddle.sparse.masked_matmul: dense @ dense sampled at `mask`'s
+    sparsity pattern — out.values[n] = x[i_n] . y[:, j_n]; never builds
+    the dense product."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    rows, cols, _ = _coo_rows_cols(mask)
+    vals = jnp.einsum("nk,nk->n", xv[rows], yv.T[cols])
+    out_shape = [xv.shape[0], yv.shape[1]]
+    return SparseCooTensor(jnp.stack([rows, cols]), vals, out_shape)
+
+
+def _coalesce_arrays(idx, vals, shape):
+    """Sum duplicate coordinates; returns sorted unique (idx, vals)."""
+    idx_np = np.asarray(idx)
+    lin = np.ravel_multi_index(tuple(idx_np), tuple(shape))
+    uniq, inv = np.unique(lin, return_inverse=True)
+    summed = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(inv),
+                                 num_segments=len(uniq))
+    coords = np.stack(np.unravel_index(uniq, tuple(shape)))
+    return jnp.asarray(coords), summed
+
+
+def coalesce(x: "SparseCooTensor") -> "SparseCooTensor":
+    """~ phi sparse coalesce kernel: merge duplicate indices."""
+    idx, vals = _coalesce_arrays(x.indices_._value, x.values_._value,
+                                 x.dense_shape)
+    return SparseCooTensor(idx, vals, x.dense_shape)
+
+
+def add(x, y):
+    """sparse + sparse (same shape): concatenate and coalesce — index/value
+    compute only (~ phi/kernels/sparse/elementwise_kernel)."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        idx = jnp.concatenate([x.indices_._value, y.indices_._value], axis=1)
+        vals = jnp.concatenate([x.values_._value, y.values_._value])
+        cidx, cvals = _coalesce_arrays(idx, vals, x.dense_shape)
+        return SparseCooTensor(cidx, cvals, x.dense_shape)
+    from ..ops.math import add as dense_add
     xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
     yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
-    return dense_matmul(xd, yd)
+    return dense_add(xd, yd)
+
+
+def multiply(x, y):
+    """Elementwise multiply; sparse*dense keeps x's pattern (gather)."""
+    if isinstance(x, SparseCooTensor) and not isinstance(
+            y, (SparseCooTensor, SparseCsrTensor)):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        gathered = yv[tuple(x.indices_._value)]
+        return SparseCooTensor(x.indices_, x.values_._value * gathered,
+                               x.dense_shape)
+    from ..ops.math import multiply as dense_mul
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+    return dense_mul(xd, yd)
+
+
+def transpose(x: "SparseCooTensor", perm):
+    """Permute sparse dims by reordering the index rows."""
+    idx = x.indices_._value[jnp.asarray(perm)]
+    shape = [x.dense_shape[p] for p in perm]
+    return coalesce(SparseCooTensor(idx, x.values_._value, shape))
+
+
+def sparse_csr_to_coo(x: "SparseCsrTensor") -> "SparseCooTensor":
+    rows, cols, vals = _coo_rows_cols(x)
+    return SparseCooTensor(jnp.stack([rows, cols]), vals, x.dense_shape)
+
+
+def sparse_coo_to_csr(x: "SparseCooTensor") -> "SparseCsrTensor":
+    idx = np.asarray(x.indices_._value)
+    order = np.lexsort((idx[1], idx[0]))
+    rows, cols = idx[0][order], idx[1][order]
+    vals = x.values_._value[jnp.asarray(order)]
+    crows = np.zeros(x.dense_shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor(crows, jnp.asarray(cols), vals, x.dense_shape)
 
 
 def relu(x):

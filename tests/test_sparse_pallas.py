@@ -1,0 +1,177 @@
+"""Real sparse index/value kernels vs scipy oracles + fused Pallas kernels.
+
+~ reference phi/kernels/sparse/ (matmul, elementwise, coalesce) tested the
+OpTest way (numpy/scipy oracle, SURVEY.md §4), and the fused_ops rows
+(fused_attention_op.cu softmax-xent / fused dropout+residual+LN).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(m, n, nnz, seed=0):
+    rng = np.random.default_rng(seed)
+    lin = rng.choice(m * n, size=nnz, replace=False)
+    rows, cols = np.unravel_index(lin, (m, n))
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    st = sparse.sparse_coo_tensor(np.stack([rows, cols]), vals, [m, n])
+    oracle = sp.coo_matrix((vals, (rows, cols)), shape=(m, n))
+    return st, oracle
+
+
+class TestSparseKernels:
+    def test_spmm_vs_scipy(self):
+        st, oracle = _rand_coo(16, 24, 60)
+        y = np.random.default_rng(1).standard_normal((24, 8)).astype(
+            np.float32)
+        out = sparse.matmul(st, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), oracle @ y, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_dense_at_sparse_vs_scipy(self):
+        st, oracle = _rand_coo(16, 24, 60, seed=3)
+        x = np.random.default_rng(2).standard_normal((8, 16)).astype(
+            np.float32)
+        out = sparse.matmul(paddle.to_tensor(x), st)
+        np.testing.assert_allclose(out.numpy(), x @ oracle.toarray(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_csr_matmul_vs_scipy(self):
+        st, oracle = _rand_coo(12, 20, 40, seed=4)
+        csr_o = oracle.tocsr()
+        st_csr = sparse.sparse_csr_tensor(
+            csr_o.indptr, csr_o.indices, csr_o.data, [12, 20])
+        y = np.random.default_rng(5).standard_normal((20, 6)).astype(
+            np.float32)
+        out = sparse.matmul(st_csr, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), csr_o @ y, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_masked_matmul(self):
+        mask, _ = _rand_coo(10, 12, 30, seed=6)
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((10, 9)).astype(np.float32)
+        b = rng.standard_normal((9, 12)).astype(np.float32)
+        out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                                   mask)
+        dense = a @ b
+        idx = np.asarray(out.indices_.numpy())
+        np.testing.assert_allclose(out.values_.numpy(),
+                                   dense[idx[0], idx[1]], rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_add_and_coalesce_vs_scipy(self):
+        a, oa = _rand_coo(8, 8, 20, seed=8)
+        b, ob = _rand_coo(8, 8, 20, seed=9)
+        out = sparse.add(a, b)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   (oa + ob).toarray(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_multiply_sparse_dense_keeps_pattern(self):
+        a, oa = _rand_coo(8, 8, 20, seed=10)
+        d = np.random.default_rng(11).standard_normal((8, 8)).astype(
+            np.float32)
+        out = sparse.multiply(a, paddle.to_tensor(d))
+        assert out.nnz == a.nnz
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   oa.toarray() * d, rtol=1e-5, atol=1e-5)
+
+    def test_transpose_and_format_conversion(self):
+        a, oa = _rand_coo(6, 9, 15, seed=12)
+        t = sparse.transpose(a, [1, 0])
+        np.testing.assert_allclose(t.to_dense().numpy(), oa.T.toarray(),
+                                   rtol=1e-6)
+        csr = sparse.sparse_coo_to_csr(a)
+        oc = oa.tocsr()
+        np.testing.assert_allclose(np.asarray(csr.crows_.numpy()), oc.indptr)
+        back = sparse.sparse_csr_to_coo(csr)
+        np.testing.assert_allclose(back.to_dense().numpy(), oa.toarray(),
+                                   rtol=1e-6)
+
+
+class TestFusedCE:
+    def test_forward_matches_dense(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((32, 128)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 128, (32,)), jnp.int32)
+        from paddle_tpu.ops.pallas.fused_ce import softmax_cross_entropy
+        loss = softmax_cross_entropy(logits, labels)
+        logp = jax.nn.log_softmax(logits, -1)
+        ref = -jnp.take_along_axis(logp, labels[:, None], -1)[:, 0]
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches_dense(self):
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.standard_normal((16, 64)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 64, (16,)), jnp.int32)
+        from paddle_tpu.ops.pallas.fused_ce import softmax_cross_entropy
+
+        g1 = jax.grad(lambda x: jnp.mean(
+            softmax_cross_entropy(x, labels)))(logits)
+
+        def dense(x):
+            logp = jax.nn.log_softmax(x, -1)
+            return jnp.mean(-jnp.take_along_axis(
+                logp, labels[:, None], -1)[:, 0])
+
+        g2 = jax.grad(dense)(logits)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_causal_lm_loss_wrapper(self):
+        rng = np.random.default_rng(2)
+        logits = jnp.asarray(rng.standard_normal((2, 8, 32)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 32, (2, 8)), jnp.int32)
+        from paddle_tpu.ops.pallas.fused_ce import causal_lm_loss
+        loss = causal_lm_loss(logits, labels)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        ref = jnp.mean(-jnp.take_along_axis(
+            logp, labels[..., None], -1)[..., 0])
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
+class TestFusedDropoutLN:
+    def test_eval_mode_matches_dense_layernorm(self):
+        from paddle_tpu.ops.pallas.dropout_ln import (
+            fused_dropout_add_layer_norm)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+        res = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        out = fused_dropout_add_layer_norm(x, res, w, b, p=0.5,
+                                           training=False)
+        h = x + res
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        ref = (h - mu) / jnp.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_train_mode_dropout_statistics(self):
+        from paddle_tpu.ops.pallas.dropout_ln import (
+            fused_dropout_add_layer_norm)
+        paddle.seed(0)
+        x = jnp.ones((128, 256), jnp.float32) * 3.0
+        res = jnp.zeros((128, 256), jnp.float32)
+        w = jnp.ones(256, jnp.float32)
+        b = jnp.zeros(256, jnp.float32)
+        p = 0.3
+        bits = jax.random.bits(jax.random.PRNGKey(0), (128, 256),
+                               jnp.uint32)
+        out = fused_dropout_add_layer_norm(x, res, w, b, p=p, training=True,
+                                           bits=bits)
+        # dropout then LN of a constant input: surviving entries share one
+        # positive value, dropped are another; just check drop fraction via
+        # the pre-LN reconstruction
+        u = np.asarray(bits).astype(np.float64) / 4294967296.0
+        keep_frac = (u >= p).mean()
+        assert abs(keep_frac - (1 - p)) < 0.02
+        assert np.isfinite(np.asarray(out)).all()
