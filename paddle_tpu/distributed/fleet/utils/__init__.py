@@ -1,2 +1,3 @@
-from . import recompute  # noqa: F401
+from . import fs, recompute  # noqa: F401
+from .fs import FS, HDFSClient, LocalFS  # noqa: F401
 from .recompute import recompute as recompute_fn  # noqa: F401

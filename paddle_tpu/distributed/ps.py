@@ -1,39 +1,90 @@
-"""Parameter-server capability slot.
+"""Parameter server (async/geo training substrate).
 
-The reference's brpc PS stack (paddle/fluid/distributed/ps/: dense/sparse
-tables, accessors, geo-SGD — SURVEY.md §2.2) is declared out of the TPU
-north-star scope (§7 non-goals); this module provides the minimal
-TPU-idiomatic equivalent of its *capability*: a sparse embedding table
-served over TCPStore with push/pull + server-side SGD, good for the
-embedding-dominated workloads PS mode exists for. In-process mode doubles
-as the reference's ps_local_client.h test double.
+~ paddle/fluid/distributed/ps/: brpc PS services with dense/sparse tables
+and pluggable SGD accessors (service/brpc_ps_server.cc,
+table/memory_sparse_table.cc, table/sparse_sgd_rule.cc). TPU-native
+re-design: the data plane is a threaded length-prefixed TCP RPC server
+(the brpc role) hosting numpy tables on the host CPU — PS workloads are
+embedding-dominated and host-resident by definition; the TPU enters on
+the worker side where pulled rows join the compiled training step. Tables
+persist via pickle (Table::Save/Load, table.h) and the in-process mode
+doubles as the reference's ps_local_client.h test double.
 """
 from __future__ import annotations
 
 import pickle
+import socket
+import struct
 import threading
 from typing import Dict, Optional
 
 import numpy as np
 
-from .store import TCPStore
+from .store import TCPStore  # noqa: F401  (re-export for back-compat)
 
 
+# ---------------------------------------------------------------------------
+# update rules (~ table/sparse_sgd_rule.cc: naive / adagrad accessors)
+# ---------------------------------------------------------------------------
+class SGDRule:
+    """Plain SGD (~ SparseNaiveSGDRule)."""
+
+    def __init__(self, lr=0.01):
+        self.lr = lr
+
+    def init_state(self, dim):
+        return None
+
+    def update(self, row, grad, state):
+        row -= self.lr * grad
+        return state
+
+
+class AdagradRule:
+    """Adagrad with accumulated squared grads (~ SparseAdaGradSGDRule)."""
+
+    def __init__(self, lr=0.01, eps=1e-8):
+        self.lr = lr
+        self.eps = eps
+
+    def init_state(self, dim):
+        return np.zeros(dim, np.float32)
+
+    def update(self, row, grad, state):
+        state += grad * grad
+        row -= self.lr * grad / (np.sqrt(state) + self.eps)
+        return state
+
+
+def make_rule(name: str, lr: float):
+    if name in ("sgd", "naive"):
+        return SGDRule(lr)
+    if name == "adagrad":
+        return AdagradRule(lr)
+    raise ValueError(f"unknown sgd rule {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# tables
+# ---------------------------------------------------------------------------
 class SparseTable:
-    """Server-side sparse table with SGD update rule
-    (~ distributed/ps/table/memory_sparse_table.cc + sparse_sgd_rule.cc)."""
+    """Lazily-initialized sparse embedding table with a pluggable update
+    rule (~ memory_sparse_table.cc)."""
 
     def __init__(self, dim: int, init_std: float = 0.01, lr: float = 0.01,
-                 seed: int = 0):
+                 seed: int = 0, rule: str = "sgd"):
         self.dim = dim
-        self.lr = lr
         self.init_std = init_std
+        self.rule = make_rule(rule, lr)
+        self.lr = lr  # kept for back-compat with round-1 API
         self._rows: Dict[int, np.ndarray] = {}
+        self._states: Dict[int, object] = {}
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
-        out = np.empty((len(ids), self.dim), np.float32)
+        out = np.empty((len(np.asarray(ids).reshape(-1)), self.dim),
+                       np.float32)
         with self._lock:
             for i, key in enumerate(np.asarray(ids).reshape(-1)):
                 k = int(key)
@@ -42,21 +93,25 @@ class SparseTable:
                     row = (self._rng.standard_normal(self.dim)
                            * self.init_std).astype(np.float32)
                     self._rows[k] = row
+                    self._states[k] = self.rule.init_state(self.dim)
                 out[i] = row
         return out
 
     def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
         with self._lock:
             for key, g in zip(np.asarray(ids).reshape(-1), grads):
                 k = int(key)
                 row = self._rows.get(k)
                 if row is not None:
-                    row -= self.lr * g.astype(np.float32)
+                    self._states[k] = self.rule.update(row, g,
+                                                       self._states.get(k))
 
     def save(self, path: str):
         with self._lock:
             with open(path, "wb") as f:
-                pickle.dump({"dim": self.dim, "rows": self._rows}, f)
+                pickle.dump({"dim": self.dim, "rows": self._rows,
+                             "states": self._states}, f)
 
     def load(self, path: str):
         with open(path, "rb") as f:
@@ -64,33 +119,267 @@ class SparseTable:
         with self._lock:
             self.dim = d["dim"]
             self._rows = d["rows"]
+            self._states = d.get("states", {})
 
     def size(self) -> int:
         return len(self._rows)
 
 
+class DenseTable:
+    """Dense parameter region (~ table/common_dense_table.cc): one flat
+    float32 vector, push applies the update rule."""
+
+    def __init__(self, size: int, lr: float = 0.01, rule: str = "sgd",
+                 init: Optional[np.ndarray] = None):
+        self.data = (np.zeros(size, np.float32) if init is None
+                     else np.asarray(init, np.float32).copy())
+        self.rule = make_rule(rule, lr)
+        self._state = self.rule.init_state(size)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.data.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        with self._lock:
+            self._state = self.rule.update(
+                self.data, np.asarray(grad, np.float32), self._state)
+
+    def set(self, values: np.ndarray) -> None:
+        with self._lock:
+            self.data[:] = np.asarray(values, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# RPC plumbing (length-prefixed pickle frames — the brpc role)
+# ---------------------------------------------------------------------------
+def _send_msg(sock: socket.socket, obj) -> None:
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<q", len(blob)) + blob)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, 8)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<q", hdr)
+    blob = _recv_exact(sock, n)
+    return None if blob is None else pickle.loads(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class PSServer:
+    """Threaded PS RPC server hosting tables (~ brpc_ps_server.cc
+    PsService: one handler thread per connected worker)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._tables: Dict[int, object] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def add_sparse_table(self, table_id: int, dim: int, **kw) -> SparseTable:
+        t = SparseTable(dim, **kw)
+        self._tables[table_id] = t
+        return t
+
+    def add_dense_table(self, table_id: int, size: int, **kw) -> DenseTable:
+        t = DenseTable(size, **kw)
+        self._tables[table_id] = t
+        return t
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            th = threading.Thread(target=self._serve, args=(conn,),
+                                  daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def _serve(self, conn: socket.socket):
+        with conn:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op, table_id, payload = msg
+                try:
+                    resp = self._dispatch(op, table_id, payload)
+                except Exception as e:  # noqa: BLE001 — error goes to client
+                    resp = ("err", repr(e))
+                _send_msg(conn, resp)
+
+    def _dispatch(self, op, table_id, payload):
+        t = self._tables.get(table_id)
+        if t is None and op not in ("stop",):
+            return ("err", f"no table {table_id}")
+        if op == "pull_sparse":
+            return ("ok", t.pull(payload))
+        if op == "push_sparse":
+            t.push(*payload)
+            return ("ok", None)
+        if op == "pull_dense":
+            return ("ok", t.pull())
+        if op == "push_dense":
+            t.push(payload)
+            return ("ok", None)
+        if op == "set_dense":
+            t.set(payload)
+            return ("ok", None)
+        if op == "save":
+            t.save(payload)
+            return ("ok", None)
+        if op == "load":
+            t.load(payload)
+            return ("ok", None)
+        if op == "size":
+            return ("ok", t.size())
+        if op == "stop":
+            self._stop.set()
+            return ("ok", None)
+        return ("err", f"unknown op {op}")
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 class PSClient:
-    """Client API (~ brpc_ps_client): local-table mode (in-process) or
-    remote over TCPStore serialized blobs (small-scale; the brpc data plane
-    is out of scope)."""
+    """Worker-side client (~ brpc_ps_client.h).
+
+    Modes: in-process local table (ps_local_client.h double), or remote
+    over the PSServer RPC. `async_push` gives geo-SGD-style non-blocking
+    gradient push (the reference's geo mode batches pushes off the
+    critical path)."""
 
     def __init__(self, table: Optional[SparseTable] = None,
-                 store: Optional[TCPStore] = None, table_id: int = 0):
+                 store=None, table_id: int = 0,
+                 server_addr: Optional[str] = None):
         self.table = table
-        self.store = store
         self.table_id = table_id
+        self._sock = None
+        self._mu = threading.Lock()
+        self._async_q = []
+        self._async_cv = threading.Condition()
+        self._async_inflight = 0
+        self._async_thread = None
+        if server_addr is not None:
+            host, port = server_addr.rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)),
+                                                  timeout=60)
 
-    def pull_sparse(self, ids):
+    # -- rpc -------------------------------------------------------------
+    def _call(self, op, payload, table_id=None):
+        with self._mu:
+            _send_msg(self._sock,
+                      (op, self.table_id if table_id is None else table_id,
+                       payload))
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("PS server closed connection")
+        status, value = resp
+        if status != "ok":
+            raise RuntimeError(f"PS error: {value}")
+        return value
+
+    # -- sparse ----------------------------------------------------------
+    def pull_sparse(self, ids, table_id=None):
         if self.table is not None:
             return self.table.pull(ids)
-        self.store.set(f"__ps_req__/{self.table_id}",
-                       pickle.dumps(("pull", np.asarray(ids))))
-        return pickle.loads(self.store.wait(f"__ps_resp__/{self.table_id}"))
+        return self._call("pull_sparse", np.asarray(ids), table_id)
 
-    def push_sparse(self, ids, grads):
+    def push_sparse(self, ids, grads, table_id=None):
         if self.table is not None:
             self.table.push(ids, np.asarray(grads))
             return
-        self.store.set(f"__ps_req__/{self.table_id}",
-                       pickle.dumps(("push", np.asarray(ids),
-                                     np.asarray(grads))))
+        self._call("push_sparse",
+                   (np.asarray(ids), np.asarray(grads)), table_id)
+
+    # -- dense -----------------------------------------------------------
+    def pull_dense(self, table_id=None):
+        return self._call("pull_dense", None, table_id)
+
+    def push_dense(self, grad, table_id=None):
+        self._call("push_dense", np.asarray(grad), table_id)
+
+    def set_dense(self, values, table_id=None):
+        self._call("set_dense", np.asarray(values), table_id)
+
+    # -- async (geo) push -------------------------------------------------
+    def async_push_sparse(self, ids, grads, table_id=None):
+        if self._async_thread is None:
+            self._async_thread = threading.Thread(target=self._async_loop,
+                                                  daemon=True)
+            self._async_thread.start()
+        with self._async_cv:
+            self._async_q.append((np.asarray(ids).copy(),
+                                  np.asarray(grads).copy(), table_id))
+            self._async_cv.notify_all()
+
+    def _async_loop(self):
+        while True:
+            with self._async_cv:
+                while not self._async_q:
+                    self._async_cv.wait()
+                ids, grads, table_id = self._async_q.pop(0)
+                if ids is None:
+                    return
+                self._async_inflight += 1
+            try:
+                self.push_sparse(ids, grads, table_id)
+            finally:
+                with self._async_cv:
+                    self._async_inflight -= 1
+                    self._async_cv.notify_all()
+
+    def flush(self):
+        """Barrier for async pushes (geo-SGD step boundary): returns only
+        after every enqueued push has been applied server-side."""
+        with self._async_cv:
+            while self._async_q or self._async_inflight:
+                self._async_cv.wait(timeout=0.1)
+
+    # -- persistence / admin ---------------------------------------------
+    def save(self, path, table_id=None):
+        self._call("save", path, table_id)
+
+    def load(self, path, table_id=None):
+        self._call("load", path, table_id)
+
+    def table_size(self, table_id=None):
+        return self._call("size", None, table_id)
+
+    def close(self):
+        if self._async_thread is not None:
+            with self._async_cv:
+                self._async_q.append((None, None, None))
+                self._async_cv.notify_all()
+            self._async_thread.join(timeout=5)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -4,13 +4,19 @@
 load optimized artifact → run with zero-copy tensors. TPU-native: the
 "analysis + pass pipeline" is XLA compilation at export time (jit.save
 freezes weights into a jax.export module); Predictor is the NaiveExecutor
-analog executing that artifact. TensorRT/Lite/ONNX engine slots are
-intentionally absent (SURVEY.md §7 non-goals) — XLA is the engine.
+analog executing that artifact. Per-thread serving clones share the loaded
+executable and frozen weights (the reference shares weights via Scope,
+analysis_predictor.h Clone); input handles hold device arrays so repeated
+run() calls do not re-copy unchanged inputs. TensorRT/Lite/ONNX engine
+slots are intentionally absent (SURVEY.md §7 non-goals) — XLA is the
+engine.
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
+import jax
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -18,73 +24,169 @@ from ..jit import load as _jit_load
 
 
 class Config:
-    """~ paddle_infer.Config (API-parity surface)."""
+    """~ paddle_infer.Config (inference/api/paddle_analysis_config.h).
 
-    def __init__(self, model_path: str | None = None,
-                 params_path: str | None = None):
+    Knobs that have an XLA meaning are honored (memory optim → block
+    until-ready elision; device selection); graph-level IR toggles are
+    no-ops by design because the artifact was already optimized by XLA at
+    export time — recorded so summary() reports them honestly.
+    """
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
         self.model_path = model_path
+        self.params_path = params_path
         self._threads = 1
+        self._device = "tpu" if any(
+            d.platform != "cpu" for d in jax.devices()) else "cpu"
+        self._memory_optim = False
+        self._ir_optim = True
+        self._glog = True
+        self._profile = False
 
+    # -- devices ---------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # accel is implicit on TPU; kept for source compatibility
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    # -- execution -------------------------------------------------------
     def set_cpu_math_library_num_threads(self, n):
-        self._threads = n
+        self._threads = int(n)
 
-    def enable_use_gpu(self, *a, **kw):  # accel is implicit on TPU
-        pass
+    def cpu_math_library_num_threads(self):
+        return self._threads
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = bool(flag)
+
+    def memory_optim_enabled(self):
+        return self._memory_optim
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
 
     def disable_glog_info(self):
-        pass
+        self._glog = False
 
-    def switch_ir_optim(self, flag=True):  # XLA always optimizes
-        pass
+    def glog_info_disabled(self):
+        return not self._glog
+
+    def enable_profile(self):
+        self._profile = True
+
+    def summary(self) -> str:
+        rows = [("model_path", self.model_path),
+                ("device", self._device),
+                ("cpu_math_threads", self._threads),
+                ("memory_optim", self._memory_optim),
+                ("ir_optim (XLA at export)", self._ir_optim),
+                ("profile", self._profile)]
+        w = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k.ljust(w)}  {v}" for k, v in rows)
 
 
 class Predictor:
     """~ paddle_infer.Predictor over a jit.save artifact."""
 
-    def __init__(self, config_or_path):
-        path = (config_or_path.model_path
-                if isinstance(config_or_path, Config) else config_or_path)
-        if path.endswith(".pdmodel") or path.endswith(".pdiparams"):
-            path = path.rsplit(".", 1)[0]
-        self._layer = _jit_load(path)
-        self._inputs: List[np.ndarray] = []
+    def __init__(self, config_or_path, _shared=None):
+        self._config = (config_or_path
+                        if isinstance(config_or_path, Config) else None)
+        if _shared is not None:
+            # clone: share executable + weights, private IO buffers
+            self._layer = _shared
+        else:
+            path = (config_or_path.model_path
+                    if isinstance(config_or_path, Config) else config_or_path)
+            if path.endswith(".pdmodel") or path.endswith(".pdiparams"):
+                path = path.rsplit(".", 1)[0]
+            self._layer = _jit_load(path)
+        self._inputs: List = []
+        self._outputs: List = []
+        self._lock = threading.Lock()
+
+    # -- signature -------------------------------------------------------
+    def _n_inputs(self) -> int:
+        exp = getattr(self._layer, "_exported", None)
+        if exp is not None:
+            return len(exp.in_avals)
+        return 8
 
     def get_input_names(self):
-        return [f"x{i}" for i in range(8)]
+        return [f"x{i}" for i in range(self._n_inputs())]
 
     def get_input_handle(self, name):
-        return _IOHandle(self, int(name[1:]) if name[1:].isdigit() else 0)
-
-    def run(self, inputs: Optional[List] = None):
-        if inputs is not None:
-            self._inputs = [np.asarray(
-                x.numpy() if isinstance(x, Tensor) else x) for x in inputs]
-        outs = self._layer(*[Tensor(x) for x in self._inputs])
-        if isinstance(outs, (tuple, list)):
-            self._outputs = [o.numpy() for o in outs]
-        else:
-            self._outputs = [outs.numpy()]
-        return self._outputs
+        idx = int(name[1:]) if name[1:].isdigit() else 0
+        return _IOHandle(self, idx)
 
     def get_output_names(self):
-        return [f"out{i}" for i in range(len(getattr(self, "_outputs", [0])))]
+        return [f"out{i}" for i in range(len(self._outputs) or 1)]
 
     def get_output_handle(self, name):
         return _OutHandle(self, int(name[3:]) if name[3:].isdigit() else 0)
 
+    # -- execution -------------------------------------------------------
+    def run(self, inputs: Optional[List] = None):
+        if inputs is not None:
+            self._inputs = [
+                x._value if isinstance(x, Tensor) else np.asarray(x)
+                for x in inputs]
+        with self._lock:
+            outs = self._layer(*[Tensor(x) for x in self._inputs])
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        if self._config is not None and self._config.memory_optim_enabled():
+            # keep device arrays; host copy deferred to copy_to_cpu
+            self._outputs = [o._value for o in outs]
+        else:
+            self._outputs = [o.numpy() for o in outs]
+        return [np.asarray(o) for o in self._outputs]
+
+    def clone(self) -> "Predictor":
+        """Weight/executable-sharing clone for per-thread serving
+        (~ AnalysisPredictor::Clone sharing the Scope)."""
+        c = Predictor(self._config or "", _shared=self._layer)
+        return c
+
+    def try_shrink_memory(self):
+        import gc
+        gc.collect()
+
 
 class _IOHandle:
+    """Input handle; holds the array until run() (zero-copy for device
+    arrays passed via share_external_data)."""
+
     def __init__(self, pred, idx):
         self.pred = pred
         self.idx = idx
+        self._shape = None
 
-    def copy_from_cpu(self, arr):
+    def _store(self, arr):
         while len(self.pred._inputs) <= self.idx:
             self.pred._inputs.append(None)
-        self.pred._inputs[self.idx] = np.asarray(arr)
+        self.pred._inputs[self.idx] = arr
+
+    def copy_from_cpu(self, arr):
+        a = np.asarray(arr)
+        if self._shape is not None:
+            a = a.reshape(self._shape)
+        self._store(a)
+
+    def share_external_data(self, arr):
+        # device array stays on device — no host round trip
+        self._store(arr._value if isinstance(arr, Tensor) else arr)
 
     def reshape(self, shape):
-        pass
+        self._shape = tuple(shape)
 
 
 class _OutHandle:
@@ -93,8 +195,32 @@ class _OutHandle:
         self.idx = idx
 
     def copy_to_cpu(self):
-        return self.pred._outputs[self.idx]
+        return np.asarray(self.pred._outputs[self.idx])
+
+    def shape(self):
+        return tuple(self.pred._outputs[self.idx].shape)
+
+
+class PredictorPool:
+    """~ paddle_infer::services::PredictorPool — one loaded artifact,
+    N weight-sharing clones for worker threads."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._main = Predictor(config)
+        self._preds = [self._main] + [self._main.clone()
+                                      for _ in range(max(0, size - 1))]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)
 
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__

@@ -1,28 +1,61 @@
-"""paddle.onnx — deployment export slot.
+"""paddle.onnx — ONNX model export.
 
-~ python/paddle/onnx/export.py (paddle2onnx bridge). This framework's
-deployment artifact is the serialized StableHLO executable
-(jax.export — see jit.save / inference.Predictor), which is the
-TPU-serving equivalent of an ONNX graph. ``export`` writes that artifact;
-when the optional ``onnx`` package is installed it additionally emits a
-true ONNX model via the jax->onnx route if available.
+~ python/paddle/onnx/export.py (paddle2onnx bridge). The converter lives
+in-tree (exporter.py maps the captured static DAG to ONNX nodes;
+proto.py writes the protobuf wire format directly, so no `onnx` package
+is required). Ops without a converter fall back to the StableHLO artifact
+set (jit.save) — the TPU-serving deployment format.
 """
 from __future__ import annotations
 
+from . import proto  # noqa: F401
+from .exporter import (OP_CONVERTERS, UnsupportedOp,  # noqa: F401
+                       program_to_onnx)
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Write <path>.onnx when onnx tooling exists, else the StableHLO
-    artifact set (same deployment contract, TPU-native container)."""
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Write <path>.onnx (real ONNX protobuf). Requires input_spec.
+
+    The layer's forward is re-traced in static-capture mode so every op
+    lands in the DAG the converter understands; ops with no ONNX mapping
+    raise UnsupportedOp unless ``fallback_stablehlo=True`` (default), in
+    which case the StableHLO artifact set is written instead.
+    """
     from .. import jit
+    from ..jit import InputSpec
+    from ..static import graph as _sg
+
+    fallback = configs.pop("fallback_stablehlo", True)
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(s)
+             for s in input_spec]
+
+    main, startup = _sg.Program(), _sg.Program()
+    was_static = _sg.in_static_mode()
     try:
-        import onnx  # noqa: F401
-        have_onnx = True
-    except ImportError:
-        have_onnx = False
-    jit.save(layer, path, input_spec=input_spec)
-    if not have_onnx:
+        _sg.enable_static()
+        with _sg.program_guard(main, startup):
+            feeds = [_sg.data(f"x{i}", s.shape, dtype=s.dtype)
+                     for i, s in enumerate(specs)]
+            out = layer(*feeds) if callable(layer) else layer.forward(*feeds)
+        fetches = list(out) if isinstance(out, (tuple, list)) else [out]
+        blob = program_to_onnx(feeds, fetches,
+                               graph_name=type(layer).__name__)
+    except UnsupportedOp:
+        if not fallback:
+            raise
         import warnings
         warnings.warn(
-            "onnx is not installed; exported StableHLO artifacts "
-            f"({path}.pdexport) instead — the TPU-serving deployment format")
-    return path
+            "model contains ops without ONNX converters; wrote StableHLO "
+            f"artifacts ({path}.pdexport) instead — the TPU-serving format")
+        jit.save(layer, path, input_spec=input_spec)
+        return path
+    finally:
+        if not was_static:
+            _sg.disable_static()
+
+    target = path if path.endswith(".onnx") else path + ".onnx"
+    with open(target, "wb") as f:
+        f.write(blob)
+    return target

@@ -1,0 +1,357 @@
+"""Static Program -> ONNX graph converter.
+
+~ paddle2onnx (the backend behind reference python/paddle/onnx/export.py):
+the reference maps ProgramDesc OpDescs to ONNX nodes; here the captured
+static DAG (static/graph.py OpNode/StaticVar) is walked from the fetch
+vars and each op is converted through OP_CONVERTERS. Parameters become
+initializers; python attr args are recovered either from the op node's
+args/kwargs (bound against the registered op signature) or from the
+lowering closure's free variables (for functional wrappers that close
+over their attrs).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..static.graph import OpNode, StaticVar
+from . import proto
+
+
+class UnsupportedOp(ValueError):
+    pass
+
+
+def closure_attrs(fn) -> dict:
+    """Free variables of a lowering closure, by name."""
+    if fn.__closure__ is None:
+        return {}
+    return {name: cell.cell_contents
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__)}
+
+
+def bound_attrs(node: OpNode) -> dict:
+    """Bind node args/kwargs against the registered op signature to name
+    the non-tensor attributes (matmul transpose flags, softmax axis, ...).
+    """
+    from ..ops.dispatch import OP_REGISTRY
+    api = OP_REGISTRY.get(node.name)
+    out = dict(node.kwargs)
+    if api is None or not hasattr(api, "raw_fn"):
+        return out
+    try:
+        sig = inspect.signature(api.raw_fn)
+        ba = sig.bind_partial(*node.args)
+        for k, v in ba.arguments.items():
+            if not isinstance(v, (Tensor, StaticVar)):
+                out.setdefault(k, v)
+    except TypeError:
+        pass
+    return out
+
+
+class ExportContext:
+    def __init__(self, graph_name="main"):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.inputs: List[bytes] = []
+        self.outputs: List[bytes] = []
+        self.opset = 13
+        self._names: Dict[int, str] = {}
+        self._const_count = 0
+        self.graph_name = graph_name
+
+    def need_opset(self, v: int):
+        self.opset = max(self.opset, v)
+
+    # -- naming / constants ----------------------------------------------
+    def name_of(self, var) -> str:
+        if isinstance(var, StaticVar):
+            return var.name
+        key = id(var)
+        if key not in self._names:
+            nm = f"const_{self._const_count}"
+            self._const_count += 1
+            self._names[key] = nm
+            arr = np.asarray(var._value if isinstance(var, Tensor) else var)
+            self.initializers.append(proto.tensor_proto(nm, arr))
+        return self._names[key]
+
+    def add_const(self, arr: np.ndarray, hint="c") -> str:
+        nm = f"{hint}_{self._const_count}"
+        self._const_count += 1
+        self.initializers.append(proto.tensor_proto(nm, np.asarray(arr)))
+        return nm
+
+    def emit(self, op_type, inputs, outputs, attrs=None, name=""):
+        self.nodes.append(proto.node_proto(
+            op_type, inputs, outputs, name=name, attrs=attrs))
+
+
+# ---------------------------------------------------------------------------
+# converters: fn(ctx, node, ins, outs, attrs)
+#   ins  = ONNX names of the node's *tensor* inputs, in arg order
+#   outs = ONNX names of the node's outputs
+# ---------------------------------------------------------------------------
+def _simple(onnx_op, min_opset=13):
+    def conv(ctx, node, ins, outs, attrs):
+        ctx.need_opset(min_opset)
+        ctx.emit(onnx_op, ins, outs)
+    return conv
+
+
+def _swap_last2_perm(var):
+    nd = len(var._shape if isinstance(var, StaticVar)
+             else var._value.shape)
+    perm = list(range(nd))
+    if nd >= 2:
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+    return perm
+
+
+def _conv_matmul(ctx, node, ins, outs, attrs):
+    x, y = ins
+    tensors = [a for a in node.args if isinstance(a, (Tensor, StaticVar))]
+    if attrs.get("transpose_x"):
+        x2 = outs[0] + "_xT"
+        ctx.emit("Transpose", [x], [x2],
+                 {"perm": _swap_last2_perm(tensors[0])})
+        x = x2
+    if attrs.get("transpose_y"):
+        y2 = outs[0] + "_yT"
+        ctx.emit("Transpose", [y], [y2],
+                 {"perm": _swap_last2_perm(tensors[1])})
+        y = y2
+    ctx.emit("MatMul", [x, y], outs)
+
+
+def _conv_linear(ctx, node, ins, outs, attrs):
+    if len(ins) == 3:
+        mm = outs[0] + "_mm"
+        ctx.emit("MatMul", ins[:2], [mm])
+        ctx.emit("Add", [mm, ins[2]], outs)
+    else:
+        ctx.emit("MatMul", ins, outs)
+
+
+def _conv_softmax(ctx, node, ins, outs, attrs):
+    ctx.emit("Softmax", ins, outs, {"axis": int(attrs.get("axis", -1))})
+
+
+def _conv_gelu(ctx, node, ins, outs, attrs):
+    ctx.need_opset(20)
+    approx = "tanh" if attrs.get("approximate") else "none"
+    ctx.emit("Gelu", ins, outs, {"approximate": approx})
+
+
+def _conv_reshape(ctx, node, ins, outs, attrs):
+    shape = [int(d) for d in node.out_vars[0]._shape]
+    shp = ctx.add_const(np.asarray(shape, np.int64), "shape")
+    ctx.emit("Reshape", [ins[0], shp], outs)
+
+
+_conv_flatten = _conv_reshape  # static shapes: both are a Reshape
+
+
+def _conv_transpose(ctx, node, ins, outs, attrs):
+    perm = attrs.get("perm")
+    a = {} if perm is None else {"perm": [int(p) for p in perm]}
+    ctx.emit("Transpose", ins, outs, a)
+
+
+def _conv_concat(ctx, node, ins, outs, attrs):
+    cl = closure_attrs(node.fn)
+    ctx.emit("Concat", ins, outs, {"axis": int(cl.get("axis", 0))})
+
+
+def _pads_of(padding, n):
+    if isinstance(padding, str):
+        raise UnsupportedOp(f"string padding {padding!r} in ONNX export")
+    if isinstance(padding, int):
+        per = [padding] * n
+    else:
+        per = [int(p) for p in padding]
+        if len(per) == 1:
+            per = per * n
+    return per + per  # onnx wants begins then ends
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return [v] * n
+    return [int(t) for t in v]
+
+
+def _conv_conv2d(ctx, node, ins, outs, attrs):
+    cl = closure_attrs(node.fn)
+    if cl.get("data_format", "NCHW") != "NCHW":
+        raise UnsupportedOp("ONNX Conv requires NCHW")
+    a = {"strides": _tuplize(cl.get("stride", 1), 2),
+         "pads": _pads_of(cl.get("padding", 0), 2),
+         "dilations": _tuplize(cl.get("dilation", 1), 2),
+         "group": int(cl.get("groups", 1))}
+    ctx.emit("Conv", ins, outs, a)
+
+
+def _conv_pool2d(onnx_op):
+    def conv(ctx, node, ins, outs, attrs):
+        cl = closure_attrs(node.fn)
+        if cl.get("data_format", "NCHW") != "NCHW":
+            raise UnsupportedOp(f"ONNX {onnx_op} requires NCHW")
+        if cl.get("return_mask"):
+            raise UnsupportedOp("return_mask pooling in ONNX export")
+        ks = _tuplize(cl["kernel_size"], 2)
+        stride = cl.get("stride")
+        a = {"kernel_shape": ks,
+             "strides": _tuplize(stride if stride is not None
+                                 else cl["kernel_size"], 2),
+             "pads": _pads_of(cl.get("padding", 0), 2)}
+        if cl.get("ceil_mode"):
+            a["ceil_mode"] = 1
+        ctx.emit(onnx_op, ins, outs, a)
+    return conv
+
+
+def _conv_batch_norm(ctx, node, ins, outs, attrs):
+    cl = closure_attrs(node.fn)
+    x, mean, var = ins[0], ins[1], ins[2]
+    rest = ins[3:]
+    c = int(node.out_vars[0]._shape[1])
+    i = 0
+    if cl.get("has_w"):
+        scale = rest[i]
+        i += 1
+    else:
+        scale = ctx.add_const(np.ones(c, np.float32), "bn_scale")
+    b = rest[i] if cl.get("has_b") else ctx.add_const(
+        np.zeros(c, np.float32), "bn_bias")
+    eps = float(cl.get("epsilon", 1e-5))
+    ctx.emit("BatchNormalization", [x, scale, b, mean, var], outs,
+             {"epsilon": eps})
+
+
+def _conv_layer_norm(ctx, node, ins, outs, attrs):
+    ctx.need_opset(17)
+    cl = closure_attrs(node.fn)
+    axes = cl.get("axes", (-1,))
+    a = {"axis": int(axes[0]), "epsilon": float(cl.get("epsilon", 1e-5))}
+    if not cl.get("has_w") or not cl.get("has_b"):
+        raise UnsupportedOp("LayerNormalization export needs weight+bias")
+    ctx.emit("LayerNormalization", ins, outs, a)
+
+
+def _conv_reduce(onnx_op, axes_as_input=False):
+    def conv(ctx, node, ins, outs, attrs):
+        axis = attrs.get("axis")
+        keep = 1 if attrs.get("keepdim") else 0
+        axes = None if axis is None else (
+            [int(a) for a in axis] if isinstance(axis, (list, tuple))
+            else [int(axis)])
+        if axes_as_input:  # ReduceSum >= opset 13 takes axes as an input
+            inputs = list(ins)
+            if axes is not None:
+                inputs.append(ctx.add_const(np.asarray(axes, np.int64),
+                                            "axes"))
+            ctx.emit(onnx_op, inputs, outs, {"keepdims": keep})
+        else:
+            a = {"keepdims": keep}
+            if axes is not None:
+                a["axes"] = axes
+            ctx.emit(onnx_op, ins, outs, a)
+    return conv
+
+
+def _conv_embedding(ctx, node, ins, outs, attrs):
+    # embedding(ids, weight) -> Gather(weight, ids)
+    ctx.emit("Gather", [ins[1], ins[0]], outs, {"axis": 0})
+
+
+OP_CONVERTERS = {
+    "matmul": _conv_matmul,
+    "mm": _simple("MatMul"),
+    "linear": _conv_linear,
+    "add": _simple("Add"),
+    "subtract": _simple("Sub"),
+    "multiply": _simple("Mul"),
+    "divide": _simple("Div"),
+    "pow": _simple("Pow"),
+    "maximum": _simple("Max"),
+    "minimum": _simple("Min"),
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "exp": _simple("Exp"),
+    "log": _simple("Log"),
+    "sqrt": _simple("Sqrt"),
+    "abs": _simple("Abs"),
+    "neg": _simple("Neg"),
+    "erf": _simple("Erf"),
+    "floor": _simple("Floor"),
+    "ceil": _simple("Ceil"),
+    "gelu": _conv_gelu,
+    "softmax": _conv_softmax,
+    "reshape": _conv_reshape,
+    "flatten": _conv_flatten,
+    "transpose": _conv_transpose,
+    "concat": _conv_concat,
+    "conv2d": _conv_conv2d,
+    "max_pool2d": _conv_pool2d("MaxPool"),
+    "avg_pool2d": _conv_pool2d("AveragePool"),
+    "batch_norm": _conv_batch_norm,
+    "layer_norm": _conv_layer_norm,
+    "mean": _conv_reduce("ReduceMean"),
+    "sum": _conv_reduce("ReduceSum", axes_as_input=True),
+    "max": _conv_reduce("ReduceMax"),
+    "min": _conv_reduce("ReduceMin"),
+    "embedding": _conv_embedding,
+}
+
+
+def _topo_order(outputs) -> List[OpNode]:
+    seen = set()
+    order: List[OpNode] = []
+
+    def visit(node: OpNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for a in node.args:
+            if isinstance(a, StaticVar) and a._node is not None:
+                visit(a._node)
+        order.append(node)
+
+    for v in outputs:
+        if isinstance(v, StaticVar) and v._node is not None:
+            visit(v._node)
+    return order
+
+
+def program_to_onnx(feed_vars, fetch_vars, graph_name="main") -> bytes:
+    """Convert the DAG reaching `fetch_vars` into ONNX ModelProto bytes.
+
+    feed_vars: list of StaticVar graph inputs (static.data).
+    fetch_vars: list of StaticVar outputs.
+    """
+    ctx = ExportContext(graph_name)
+    for v in feed_vars:
+        ctx.inputs.append(proto.value_info_proto(
+            v.name, v._shape, np.dtype(v._jdtype)))
+    for node in _topo_order(fetch_vars):
+        conv = OP_CONVERTERS.get(node.name)
+        if conv is None:
+            raise UnsupportedOp(
+                f"op '{node.name}' has no ONNX converter "
+                f"(supported: {sorted(OP_CONVERTERS)})")
+        ins = [ctx.name_of(a) for a in node.args
+               if isinstance(a, (Tensor, StaticVar))]
+        outs = [ov.name for ov in node.out_vars]
+        conv(ctx, node, ins, outs, bound_attrs(node))
+    for v in fetch_vars:
+        ctx.outputs.append(proto.value_info_proto(
+            v.name, v._shape, np.dtype(v._jdtype)))
+    graph = proto.graph_proto(ctx.graph_name, ctx.nodes, ctx.inputs,
+                              ctx.outputs, ctx.initializers)
+    return proto.model_proto(graph, opset=ctx.opset)

@@ -14,6 +14,10 @@ import numpy as np
 from .. import nn
 from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op
+from .int8 import (Int8Linear, convert_to_int8,  # noqa: F401
+                   quantize_weight_per_channel)
+from .observers import (AbsMaxObserver, AvgObserver,  # noqa: F401
+                        HistObserver, make_observer)
 
 
 def fake_quantize_dequantize(x, scale, bits=8):
@@ -108,39 +112,69 @@ class ImperativeQuantAware:
 
 
 class PostTrainingQuantization:
-    """PTQ calibration (~ post_training_quantization.py): run calibration
-    batches, record abs-max scales per quantized layer, emit int8 weights +
-    scales."""
+    """PTQ calibration (~ post_training_quantization.py:229).
+
+    Runs calibration batches with forward pre-hooks observing every
+    quantizable layer's input through the chosen algorithm (abs_max / avg
+    / hist / KL, reference `algo` arg), then freezes the model to int8
+    execution (per-channel int8 weights + static activation scales,
+    quantization/int8.py — the QuantizationFreezePass analog).
+    """
 
     def __init__(self, model: nn.Layer, data_loader, bits=8,
-                 algo="abs_max"):
+                 algo="abs_max", quantizable_layer_type=("Linear",)):
+        assert bits == 8, "int8 is the TPU-native quantized width"
         self.model = model
         self.loader = data_loader
         self.bits = bits
+        self.algo = algo
+        self.types = set(quantizable_layer_type)
+        self.act_scales: dict[str, float] = {}
 
-    def quantize(self):
-        qat = ImperativeQuantAware(self.bits)
-        model = qat.quantize(self.model)
-        model.train()
+    def _observed_layers(self):
+        for name, layer in self.model.named_sublayers():
+            if type(layer).__name__ in self.types:
+                yield name, layer
+
+    def quantize(self) -> nn.Layer:
+        observers = {}
+        hooks = []
+        for name, layer in self._observed_layers():
+            obs = make_observer(self.algo)
+            observers[name] = obs
+
+            def pre_hook(lyr, inputs, _obs=obs):
+                x = inputs[0]
+                _obs.update(np.asarray(
+                    x._value if isinstance(x, Tensor) else x))
+                return inputs
+
+            hooks.append(layer.register_forward_pre_hook(pre_hook))
+        self.model.eval()
         from ..autograd import no_grad
         with no_grad():
             for batch in self.loader:
                 x = batch[0] if isinstance(batch, (list, tuple)) else batch
-                model(x)
-        model.eval()
-        return model
+                if not isinstance(x, Tensor):
+                    x = Tensor(jnp.asarray(x))
+                self.model(x)
+        for h in hooks:
+            h.remove()
+        from .int8 import QMAX
+        self.act_scales = {name: obs.scale() / QMAX
+                           for name, obs in observers.items()}
+        return convert_to_int8(self.model, self.act_scales)
 
     def save_quantized_model(self, save_model_path, **kw):
         from ..framework.io import save
         state = {}
-        qmax = 2 ** (self.bits - 1) - 1
         for name, layer in self.model.named_sublayers():
-            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
-                w = layer.inner.weight._value
-                s = float(layer.w_quant.scale._value)
-                q = np.clip(np.round(np.asarray(w) / max(s, 1e-8) * qmax),
-                            -qmax, qmax).astype(np.int8)
-                state[f"{name}.weight_int8"] = q
-                state[f"{name}.weight_scale"] = s
+            if isinstance(layer, Int8Linear):
+                state[f"{name}.weight_int8"] = np.asarray(
+                    layer.weight_q._value)
+                state[f"{name}.weight_scale"] = np.asarray(
+                    layer.weight_scale._value)
+                if layer.act_scale is not None:
+                    state[f"{name}.act_scale"] = float(layer.act_scale)
         save(state, save_model_path + ".pdquant")
         return state

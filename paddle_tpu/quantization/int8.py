@@ -1,0 +1,87 @@
+"""Int8 execution layers — the deploy artifact of quantization.
+
+~ the reference's quantized inference path (slim QuantizationFreezePass +
+int8 cuDNN/mkldnn kernels): after PTQ/QAT, Linear weights are stored as
+int8 with per-output-channel scales and the matmul runs in int8 with an
+int32 accumulator — on TPU this hits the MXU's native int8 path
+(lax.dot_general with preferred_element_type=int32), giving 2x the bf16
+peak on v5e-class chips.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+QMAX = 127
+
+
+def quantize_weight_per_channel(w: np.ndarray, axis: int = 1):
+    """int8 per-output-channel symmetric quantization.
+
+    Returns (q_int8, scales) with scales shaped to broadcast along
+    ``axis`` (the output-feature axis; 1 for (in, out) Linear weights).
+    ~ fake_channel_wise_quantize_dequantize_abs_max.
+    """
+    w = np.asarray(w, np.float32)
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    amax = np.maximum(np.abs(w).max(axis=red, keepdims=True), 1e-8)
+    q = np.clip(np.round(w / amax * QMAX), -QMAX, QMAX).astype(np.int8)
+    return q, (amax / QMAX).astype(np.float32)
+
+
+class Int8Linear(nn.Layer):
+    """Linear with frozen int8 weights + dynamic int8 activations.
+
+    Activation scale comes from calibration (static, preferred) or from
+    the runtime abs-max when none was recorded (dynamic quantization).
+    """
+
+    def __init__(self, linear: nn.Linear, act_scale: float | None = None):
+        super().__init__()
+        q, w_scale = quantize_weight_per_channel(
+            np.asarray(linear.weight._value), axis=1)
+        self.register_buffer("weight_q", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale", Tensor(jnp.asarray(w_scale)))
+        self.bias = linear.bias
+        self.act_scale = act_scale
+        self.in_features = linear.in_features
+        self.out_features = linear.out_features
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.act_scale is not None:
+            s_x = jnp.asarray(self.act_scale, jnp.float32)
+        else:
+            s_x = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8) / QMAX
+        q_x = jnp.clip(jnp.round(xv / s_x), -QMAX, QMAX).astype(jnp.int8)
+        # int8 x int8 -> int32 accumulate: MXU-native
+        acc = jax.lax.dot_general(
+            q_x, self.weight_q._value,
+            (((q_x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * s_x * self.weight_scale._value[0]
+        if self.bias is not None:
+            out = out + self.bias._value
+        return Tensor(out.astype(xv.dtype))
+
+
+def convert_to_int8(model: nn.Layer, act_scales: dict | None = None,
+                    prefix: str = "") -> nn.Layer:
+    """Swap Linear sublayers for Int8Linear (~ QuantizationFreezePass).
+
+    act_scales maps sublayer path -> calibrated activation scale; layers
+    without an entry fall back to dynamic activation quantization.
+    """
+    act_scales = act_scales or {}
+    for name, sub in list(model._sub_layers.items()):
+        path = f"{prefix}.{name}" if prefix else name
+        if isinstance(sub, nn.Linear):
+            model._sub_layers[name] = Int8Linear(
+                sub, act_scale=act_scales.get(path))
+        else:
+            convert_to_int8(sub, act_scales, path)
+    return model

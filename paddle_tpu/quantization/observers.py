@@ -1,0 +1,132 @@
+"""Calibration observers for post-training quantization.
+
+~ fluid/contrib/slim/quantization/post_training_quantization.py: the
+reference offers abs_max / avg / hist / KL / mse activation-scale
+algorithms (its `algo` arg). Same capability here, numpy-side (calibration
+is host work; only the resulting scales enter the compiled graph).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class AbsMaxObserver:
+    """Running abs-max (~ algo='abs_max')."""
+
+    def __init__(self):
+        self._max = 0.0
+
+    def update(self, arr: np.ndarray):
+        self._max = max(self._max, float(np.max(np.abs(arr))))
+
+    def scale(self) -> float:
+        return max(self._max, 1e-8)
+
+
+class AvgObserver:
+    """Average of per-batch abs-max (~ algo='avg')."""
+
+    def __init__(self):
+        self._sum = 0.0
+        self._n = 0
+
+    def update(self, arr: np.ndarray):
+        self._sum += float(np.max(np.abs(arr)))
+        self._n += 1
+
+    def scale(self) -> float:
+        return max(self._sum / max(self._n, 1), 1e-8)
+
+
+class HistObserver:
+    """Histogram collector with percentile or KL threshold selection
+    (~ algo='hist' / algo='KL', reference PostTrainingQuantization
+    _sample_histogram + _get_kl_scaling_factor)."""
+
+    def __init__(self, bins=2048, percentile=0.99999, algo="hist"):
+        self.bins = bins
+        self.percentile = percentile
+        self.algo = algo
+        self._hist = None
+        self._edges = None
+
+    def update(self, arr: np.ndarray):
+        a = np.abs(np.asarray(arr, np.float32)).ravel()
+        amax = float(a.max()) if a.size else 0.0
+        if self._hist is None:
+            hi = max(amax, 1e-8)
+            self._hist, self._edges = np.histogram(a, bins=self.bins,
+                                                   range=(0.0, hi))
+            return
+        hi = self._edges[-1]
+        if amax > hi:
+            # stretch: rebin old histogram into the wider range
+            new_edges = np.linspace(0.0, amax, self.bins + 1)
+            centers = (self._edges[:-1] + self._edges[1:]) / 2
+            idx = np.clip(np.searchsorted(new_edges, centers) - 1, 0,
+                          self.bins - 1)
+            new_hist = np.zeros(self.bins, self._hist.dtype)
+            np.add.at(new_hist, idx, self._hist)
+            self._hist, self._edges = new_hist, new_edges
+        h, _ = np.histogram(a, bins=self.bins,
+                            range=(0.0, self._edges[-1]))
+        self._hist += h
+
+    def _percentile_scale(self) -> float:
+        total = self._hist.sum()
+        if total == 0:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cdf, self.percentile))
+        return float(self._edges[min(idx + 1, self.bins)])
+
+    def _kl_scale(self, quant_bins=128) -> float:
+        """KL-divergence threshold search (TensorRT-style, mirroring the
+        reference's cal_kl_threshold)."""
+        hist = self._hist.astype(np.float64)
+        total = hist.sum()
+        if total == 0:
+            return 1e-8
+        best_div, best_i = np.inf, self.bins
+        for i in range(quant_bins, self.bins + 1, 8):
+            p = hist[:i].copy()
+            p[i - 1] += hist[i:].sum()  # clip outliers into last bin
+            p /= p.sum()
+            # quantize the i bins down to quant_bins then expand back
+            factor = i / quant_bins
+            q = np.zeros(i)
+            for j in range(quant_bins):
+                lo, hi = int(j * factor), max(int((j + 1) * factor),
+                                              int(j * factor) + 1)
+                chunk = hist[lo:hi]
+                nz = chunk > 0
+                if nz.any():
+                    q[lo:hi][nz] = chunk[nz].sum() / nz.sum()
+            qs = q.sum()
+            if qs == 0:
+                continue
+            q /= qs
+            mask = p > 0
+            div = float(np.sum(p[mask] * np.log(
+                p[mask] / np.maximum(q[mask], 1e-12))))
+            if div < best_div:
+                best_div, best_i = div, i
+        return float(self._edges[best_i])
+
+    def scale(self) -> float:
+        if self._hist is None:
+            return 1e-8
+        if self.algo == "KL":
+            return max(self._kl_scale(), 1e-8)
+        return max(self._percentile_scale(), 1e-8)
+
+
+def make_observer(algo: str):
+    if algo == "abs_max":
+        return AbsMaxObserver()
+    if algo == "avg":
+        return AvgObserver()
+    if algo in ("hist", "KL"):
+        return HistObserver(algo=algo)
+    raise ValueError(f"unknown calibration algo {algo!r} "
+                     "(want abs_max|avg|hist|KL)")

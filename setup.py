@@ -1,0 +1,58 @@
+"""Build script: compiles the native runtime (csrc/) at install time.
+
+The native library is a plain C++ shared object loaded via ctypes
+(paddle_tpu/utils/native.py) — it does not link against libpython, so we
+drive the compiler directly from a custom build step rather than using
+setuptools.Extension (which would add Python headers and an ABI-tagged
+filename). Mirrors the reference's CMake native build
+(/root/reference/CMakeLists.txt) at the scale this runtime needs.
+
+Everything declarative lives in pyproject.toml; this file only adds the
+native build hook, so `pip install .` and `pip install -e .` both produce
+paddle_tpu/lib/libpaddle_tpu_native.so without any import-time compile.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.abspath(os.path.dirname(__file__))
+CSRC = os.path.join(ROOT, "csrc")
+SOURCES = ["tcp_store.cc", "batch_loader.cc", "span_collector.cc"]
+LIB_RELPATH = os.path.join("paddle_tpu", "lib", "libpaddle_tpu_native.so")
+
+
+def compile_native(out_path: str) -> bool:
+    """Compile csrc/*.cc into one shared library at out_path."""
+    cxx = os.environ.get("CXX", "g++")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    srcs = [os.path.join(CSRC, s) for s in SOURCES]
+    if not all(os.path.exists(s) for s in srcs):
+        return False
+    cflags = ["-O2", "-fPIC", "-std=c++17", "-pthread", "-Wall", "-shared"]
+    cmd = [cxx, *cflags, "-o", out_path, *srcs]
+    try:
+        subprocess.run(cmd, check=True, timeout=300)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        print(f"warning: native build failed ({e}); "
+              "paddle_tpu will use pure-python fallbacks")
+        return False
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        # Build into the source tree (editable installs) and, when building
+        # a wheel, also into the build dir so package_data picks it up.
+        compile_native(os.path.join(ROOT, LIB_RELPATH))
+        if not getattr(self, "editable_mode", False):
+            compile_native(os.path.join(self.build_lib, LIB_RELPATH))
+
+
+if __name__ == "__main__":
+    setup(cmdclass={"build_py": BuildPyWithNative})

@@ -29,3 +29,24 @@ def _seed():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+def _free_port() -> int:
+    """A port currently free on localhost (bind-to-0 probe). Avoids
+    collisions between concurrently running suites/processes that the old
+    hard-coded ports suffered."""
+    import socket
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def free_port():
+    return _free_port()
+
+
+@pytest.fixture
+def free_port_factory():
+    return _free_port

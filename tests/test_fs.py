@@ -1,0 +1,140 @@
+"""LocalFS + HDFSClient (over a fake `hadoop` CLI shim).
+
+~ reference python/paddle/fluid/tests/unittests/test_fs_interface.py and
+hdfs tests: the reference exercises HDFSClient against a live hadoop CLI;
+here a shell shim on PATH emulates `hadoop fs` over a local directory so
+the exact command-line contract is tested hermetically.
+"""
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_tpu.distributed.fleet.utils.fs import (  # noqa: E402
+    ExecuteError, FSFileExistsError, HDFSClient, LocalFS)
+
+FAKE_HADOOP = r"""#!/bin/bash
+# Minimal `hadoop fs` emulation over $FAKE_HDFS_ROOT.
+shift  # drop "fs"
+while [ "$1" = "-D" ]; do shift 2; done  # skip -D k=v config pairs
+cmd="$1"; shift
+root="${FAKE_HDFS_ROOT:?}"
+p() { echo "$root/${1#/}"; }
+case "$cmd" in
+  -test)
+    flag="$1"; path="$(p "$2")"
+    case "$flag" in
+      -d) [ -d "$path" ] ;;
+      -e) [ -e "$path" ] ;;
+      *) exit 2 ;;
+    esac ;;
+  -ls)
+    path="$(p "$1")"
+    [ -e "$path" ] || exit 1
+    echo "Found $(ls "$path" | wc -l) items"
+    for e in "$path"/*; do
+      [ -e "$e" ] || continue
+      if [ -d "$e" ]; then perm="drwxr-xr-x"; else perm="-rw-r--r--"; fi
+      echo "$perm 1 u g 0 2026-01-01 00:00 $1/$(basename "$e")"
+    done ;;
+  -mkdir) shift; mkdir -p "$(p "$1")" ;;
+  -put) src="$1"; cp "$src" "$(p "$2")" ;;
+  -get) cp "$(p "$1")" "$2" ;;
+  -mv) mv "$(p "$1")" "$(p "$2")" ;;
+  -rm) shift; rm -rf "$(p "$1")" ;;
+  -touchz) touch "$(p "$1")" ;;
+  -cat) cat "$(p "$1")" ;;
+  *) echo "unknown cmd $cmd" >&2; exit 1 ;;
+esac
+"""
+
+
+@pytest.fixture
+def fake_hadoop(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    sh = bindir / "hadoop"
+    sh.write_text(FAKE_HADOOP)
+    sh.chmod(sh.stat().st_mode | stat.S_IEXEC)
+    hdfs_root = tmp_path / "hdfs"
+    hdfs_root.mkdir()
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_HDFS_ROOT", str(hdfs_root))
+    return hdfs_root
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        d = tmp_path / "a" / "b"
+        fs.mkdirs(str(d))
+        assert fs.is_dir(str(d)) and fs.is_exist(str(d))
+        f = d / "x.txt"
+        f.write_text("hello")
+        assert fs.is_file(str(f))
+        assert fs.cat(str(f)) == "hello"
+        dirs, files = fs.ls_dir(str(d))
+        assert files == ["x.txt"] and dirs == []
+        fs.mv(str(f), str(d / "y.txt"))
+        assert fs.is_file(str(d / "y.txt"))
+        with pytest.raises(FSFileExistsError):
+            fs.touch(str(d / "y.txt"), exist_ok=False)
+        fs.delete(str(d))
+        assert not fs.is_exist(str(d))
+
+    def test_mv_no_overwrite(self, tmp_path):
+        fs = LocalFS()
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.write_text("1")
+        b.write_text("2")
+        with pytest.raises(FSFileExistsError):
+            fs.mv(str(a), str(b), overwrite=False)
+        fs.mv(str(a), str(b), overwrite=True)
+        assert b.read_text() == "1"
+
+
+class TestHDFSClient:
+    def test_roundtrip(self, fake_hadoop, tmp_path):
+        fs = HDFSClient()
+        assert fs.need_upload_download()
+        fs.mkdirs("/ckpt/step1")
+        assert fs.is_dir("/ckpt/step1")
+        local = tmp_path / "w.bin"
+        local.write_text("weights")
+        fs.upload(str(local), "/ckpt/step1/w.bin")
+        assert fs.is_file("/ckpt/step1/w.bin")
+        assert fs.cat("/ckpt/step1/w.bin") == "weights"
+        dirs, files = fs.ls_dir("/ckpt")
+        assert dirs == ["step1"] and files == []
+        _, files = fs.ls_dir("/ckpt/step1")
+        assert files == ["w.bin"]
+        out = tmp_path / "out.bin"
+        fs.download("/ckpt/step1/w.bin", str(out))
+        assert out.read_text() == "weights"
+        fs.mv("/ckpt/step1", "/ckpt/step2")
+        assert fs.is_dir("/ckpt/step2") and not fs.is_exist("/ckpt/step1")
+        fs.touch("/ckpt/DONE")
+        assert fs.is_file("/ckpt/DONE")
+        fs.delete("/ckpt")
+        assert not fs.is_exist("/ckpt")
+
+    def test_missing_binary(self, monkeypatch, tmp_path):
+        fs = HDFSClient(hadoop_home=str(tmp_path / "nope"))
+        with pytest.raises(ExecuteError):
+            fs.mkdirs("/x")
+
+    def test_hadoop_home_and_configs(self, fake_hadoop, tmp_path):
+        # hadoop_home path resolution: link the shim under home/bin
+        home = tmp_path / "hh"
+        (home / "bin").mkdir(parents=True)
+        shim = subprocess.run(["which", "hadoop"], capture_output=True,
+                              text=True).stdout.strip()
+        os.symlink(shim, home / "bin" / "hadoop")
+        fs = HDFSClient(hadoop_home=str(home),
+                        configs={"fs.default.name": "hdfs://x:9000"})
+        fs.mkdirs("/via_home")
+        assert fs.is_dir("/via_home")

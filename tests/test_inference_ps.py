@@ -32,6 +32,54 @@ class TestInference:
         out = pred.get_output_handle("out0").copy_to_cpu()
         assert out.shape == (1, 2)
 
+    def test_clone_and_pool_share_weights(self, tmp_path):
+        from paddle_tpu import inference
+        m = nn.Linear(3, 2)
+        m.eval()
+        path = str(tmp_path / "m3")
+        paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([1, 3])])
+        cfg = inference.Config(path)
+        cfg.enable_memory_optim()
+        cfg.disable_glog_info()
+        pred = inference.Predictor(cfg)
+        clone = pred.clone()
+        assert clone._layer is pred._layer  # shared executable + weights
+        x = np.random.randn(1, 3).astype(np.float32)
+        np.testing.assert_allclose(pred.run([x])[0], clone.run([x])[0],
+                                   rtol=1e-6)
+        pool = inference.PredictorPool(cfg, size=3)
+        assert len(pool) == 3
+        assert pool.retrieve(2)._layer is pool.retrieve(0)._layer
+        np.testing.assert_allclose(pool.retrieve(1).run([x])[0],
+                                   pred.run([x])[0], rtol=1e-6)
+
+    def test_signature_names_and_zero_copy(self, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu import inference
+        m = nn.Linear(4, 2)
+        m.eval()
+        path = str(tmp_path / "m4")
+        paddle.jit.save(m, path, input_spec=[paddle.jit.InputSpec([2, 4])])
+        pred = inference.Predictor(path)
+        # input names derive from the exported signature, not a fixed pad
+        assert pred.get_input_names() == ["x0"]
+        h = pred.get_input_handle("x0")
+        h.share_external_data(jnp.ones((2, 4), jnp.float32))  # no host copy
+        out = pred.run()
+        assert out[0].shape == (2, 2)
+        assert pred.get_output_handle("out0").shape() == (2, 2)
+
+    def test_config_summary(self):
+        from paddle_tpu import inference
+        cfg = inference.Config("some/model")
+        cfg.set_cpu_math_library_num_threads(4)
+        assert cfg.cpu_math_library_num_threads() == 4
+        s = cfg.summary()
+        assert "some/model" in s and "cpu_math_threads" in s
+        cfg.switch_ir_optim(False)
+        assert not cfg.ir_optim()
+
 
 class TestPS:
     def test_sparse_table_pull_push(self):
@@ -92,14 +140,14 @@ class TestRoleMaker:
 
 
 class TestElastic:
-    def test_membership_and_heartbeat(self):
+    def test_membership_and_heartbeat(self, free_port):
         from paddle_tpu.distributed.fleet.elastic import ElasticManager
         from paddle_tpu.distributed.store import TCPStore
-        master = TCPStore("127.0.0.1", 29633, is_master=True)
-        m1 = ElasticManager(TCPStore("127.0.0.1", 29633), "node-a",
+        master = TCPStore("127.0.0.1", free_port, is_master=True)
+        m1 = ElasticManager(TCPStore("127.0.0.1", free_port), "node-a",
                             np_range=(1, 3), heartbeat_interval=0.2,
                             dead_after=2.0).start()
-        m2 = ElasticManager(TCPStore("127.0.0.1", 29633), "node-b",
+        m2 = ElasticManager(TCPStore("127.0.0.1", free_port), "node-b",
                             np_range=(1, 3), heartbeat_interval=0.2,
                             dead_after=2.0).start()
         # registration is synchronous in start(); membership must be

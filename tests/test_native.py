@@ -14,9 +14,9 @@ def test_native_lib_builds():
 
 
 class TestTCPStore:
-    def test_set_get_add(self):
-        store = TCPStore("127.0.0.1", 29617, is_master=True)
-        client = TCPStore("127.0.0.1", 29617, is_master=False)
+    def test_set_get_add(self, free_port):
+        store = TCPStore("127.0.0.1", free_port, is_master=True)
+        client = TCPStore("127.0.0.1", free_port, is_master=False)
         store.set("k", b"hello")
         assert client.get("k") == b"hello"
         assert client.add("ctr", 5) == 5
@@ -26,12 +26,12 @@ class TestTCPStore:
         store.close()
         client.close()
 
-    def test_wait_blocks_until_set(self):
-        store = TCPStore("127.0.0.1", 29618, is_master=True)
+    def test_wait_blocks_until_set(self, free_port):
+        store = TCPStore("127.0.0.1", free_port, is_master=True)
         results = []
 
         def waiter():
-            c = TCPStore("127.0.0.1", 29618, is_master=False)
+            c = TCPStore("127.0.0.1", free_port, is_master=False)
             results.append(c.wait("late_key"))
             c.close()
 
@@ -45,13 +45,13 @@ class TestTCPStore:
         assert results == [b"now"]
         store.close()
 
-    def test_barrier(self):
-        store = TCPStore("127.0.0.1", 29619, is_master=True)
+    def test_barrier(self, free_port):
+        store = TCPStore("127.0.0.1", free_port, is_master=True)
         n = 4
         done = []
 
         def rank(i):
-            c = TCPStore("127.0.0.1", 29619, is_master=False)
+            c = TCPStore("127.0.0.1", free_port, is_master=False)
             c.barrier("b1", n)
             done.append(i)
             c.close()
@@ -64,10 +64,10 @@ class TestTCPStore:
         assert sorted(done) == list(range(n))
         store.close()
 
-    def test_python_fallback_protocol_interop(self):
+    def test_python_fallback_protocol_interop(self, free_port):
         # python server + python client speak the same protocol as C
-        srv = _PyServer(29620)
-        c = _PyClient("127.0.0.1", 29620)
+        srv = _PyServer(free_port)
+        c = _PyClient("127.0.0.1", free_port)
         assert c._roundtrip(0, b"x", b"v") == b""
         assert c._roundtrip(1, b"x", b"") == b"v"
         import struct

@@ -1,0 +1,153 @@
+"""ONNX exporter: wire format, op conversion, structural round trip.
+
+~ reference paddle2onnx usage (python/paddle/onnx/export.py +
+test_onnx_export.py): export models, then parse the emitted protobuf
+back with the in-tree generic decoder and assert the graph structure
+(ops, initializers, IO signatures) — no onnx package needed.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx import export, proto
+from paddle_tpu.onnx.exporter import UnsupportedOp
+
+
+def _decode_model(path):
+    blob = open(path, "rb").read()
+    model = proto.decode_message(blob)
+    graph = proto.decode_message(model[7][0])
+    nodes = [proto.decode_message(n) for n in graph.get(1, [])]
+    inits = [proto.decode_message(t) for t in graph.get(5, [])]
+    return model, graph, nodes, inits
+
+
+def _op_types(nodes):
+    return [n[4][0].decode() for n in nodes]
+
+
+class TestWire:
+    def test_varint_roundtrip(self):
+        msg = proto.emit_varint(3, 300) + proto.emit_string(2, "hi")
+        d = proto.decode_message(msg)
+        assert d[3] == [300] and d[2] == [b"hi"]
+
+    def test_tensor_proto_raw_data(self):
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        t = proto.decode_message(proto.tensor_proto("w", arr))
+        assert t[1] == [2, 3]                      # dims
+        assert t[2] == [proto.DataType.FLOAT]      # data_type
+        assert t[8] == [b"w"]                      # name
+        back = np.frombuffer(t[9][0], np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(back, arr)
+
+
+class TestExportMLP:
+    def test_mlp_structure(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+        m.eval()
+        path = export(m, str(tmp_path / "mlp"), input_spec=[
+            paddle.jit.InputSpec([2, 4])])
+        assert path.endswith(".onnx")
+        model, graph, nodes, inits = _decode_model(path)
+        assert model[1] == [8]  # IR version
+        ops = _op_types(nodes)
+        # linear -> MatMul+Add (x2), ReLU between
+        assert ops == ["MatMul", "Add", "Relu", "MatMul", "Add"]
+        # 2 weights + 2 biases as initializers
+        assert len(inits) == 4
+        shapes = sorted(tuple(t.get(1, [])) for t in inits)
+        assert shapes == [(3,), (4, 8), (8,), (8, 3)]
+        # graph IO
+        gin = proto.decode_message(graph[11][0])
+        assert gin[1] == [b"x0"]
+        gout = proto.decode_message(graph[12][0])
+        assert len(gout[1]) == 1
+
+    def test_initializer_values_match(self, tmp_path):
+        m = nn.Linear(3, 2)
+        m.eval()
+        path = export(m, str(tmp_path / "lin"), input_spec=[
+            paddle.jit.InputSpec([1, 3])])
+        _, _, nodes, inits = _decode_model(path)
+        w = np.asarray(m.weight.numpy())
+        found = [np.frombuffer(t[9][0], np.float32).reshape(3, 2)
+                 for t in inits if t[1] == [3, 2]]
+        assert len(found) == 1
+        np.testing.assert_allclose(found[0], w)
+
+    def test_edge_wiring(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Sigmoid())
+        m.eval()
+        path = export(m, str(tmp_path / "s"), input_spec=[
+            paddle.jit.InputSpec([1, 4])])
+        _, graph, nodes, _ = _decode_model(path)
+        # every node input is either a graph input, an initializer name,
+        # or a previous node's output
+        gin = {proto.decode_message(v)[1][0]
+               for v in graph.get(11, [])}
+        init_names = {proto.decode_message(t)[8][0]
+                      for t in graph.get(5, [])}
+        produced = set(gin) | init_names
+        for n in nodes:
+            for i in n.get(1, []):
+                assert i in produced, f"dangling edge {i}"
+            produced |= set(n.get(2, []))
+        gout = {proto.decode_message(v)[1][0] for v in graph.get(12, [])}
+        assert gout <= produced
+
+
+class TestExportConvNet:
+    def test_lenet_like(self, tmp_path):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 4, 3, stride=1, padding=1)
+                self.bn = nn.BatchNorm2D(4)
+                self.fc = nn.Linear(4 * 4 * 4, 10)
+
+            def forward(self, x):
+                h = paddle.nn.functional.relu(self.bn(self.conv(x)))
+                h = paddle.nn.functional.max_pool2d(h, 2)
+                h = paddle.flatten(h, start_axis=1)
+                return paddle.nn.functional.softmax(self.fc(h), axis=-1)
+
+        net = Net()
+        net.eval()
+        path = export(net, str(tmp_path / "cnn"), input_spec=[
+            paddle.jit.InputSpec([1, 1, 8, 8])])
+        _, _, nodes, inits = _decode_model(path)
+        ops = _op_types(nodes)
+        assert ops[0] == "Conv"
+        assert "BatchNormalization" in ops and "MaxPool" in ops
+        assert "Reshape" in ops and "Softmax" in ops
+        conv_node = nodes[0]
+        attrs = {proto.decode_message(a)[1][0].decode():
+                 proto.decode_message(a)
+                 for a in conv_node.get(5, [])}
+        assert attrs["strides"][8] == [1, 1]
+        assert attrs["pads"][8] == [1, 1, 1, 1]
+        assert attrs["group"][3] == [1]
+
+    def test_unsupported_op_raises(self, tmp_path):
+        class Odd(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        with pytest.raises(UnsupportedOp):
+            export(Odd(), str(tmp_path / "odd"),
+                   input_spec=[paddle.jit.InputSpec([2, 2])],
+                   fallback_stablehlo=False)
+
+    def test_fallback_writes_stablehlo(self, tmp_path):
+        import os
+
+        class Odd(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        with pytest.warns(UserWarning, match="StableHLO"):
+            export(Odd(), str(tmp_path / "odd2"),
+                   input_spec=[paddle.jit.InputSpec([2, 2])])
+        assert os.path.exists(str(tmp_path / "odd2") + ".pdexport")

@@ -1,0 +1,123 @@
+"""Quantization toolkit: QAT (STE), observers, PTQ -> int8 execution.
+
+~ reference slim tests (test_post_training_quantization_*.py,
+test_imperative_qat.py): calibrate on data, quantize, assert the
+quantized model stays close to the fp32 oracle.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    AbsMaxObserver, HistObserver, ImperativeQuantAware, Int8Linear,
+    PostTrainingQuantization, convert_to_int8, quantize_weight_per_channel)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+class TestObservers:
+    def test_abs_max(self):
+        obs = AbsMaxObserver()
+        obs.update(np.array([1.0, -3.0]))
+        obs.update(np.array([2.0]))
+        assert obs.scale() == 3.0
+
+    def test_hist_percentile_ignores_outlier(self):
+        obs = HistObserver(bins=256, percentile=0.99)
+        rng = np.random.default_rng(0)
+        obs.update(rng.normal(0, 1.0, 10000))
+        obs.update(np.array([50.0]))  # single outlier
+        # percentile scale should sit near the bulk, far below the outlier
+        assert obs.scale() < 10.0
+
+    def test_hist_range_stretch(self):
+        obs = HistObserver(bins=64)
+        obs.update(np.linspace(0, 1, 100))
+        obs.update(np.linspace(0, 4, 100))  # wider range rebins
+        assert 0 < obs.scale() <= 4.0
+
+    def test_kl(self):
+        obs = HistObserver(bins=512, algo="KL")
+        rng = np.random.default_rng(1)
+        obs.update(rng.normal(0, 1.0, 20000))
+        s = obs.scale()
+        assert 0.5 < s < 6.0
+
+
+class TestWeightQuant:
+    def test_per_channel_roundtrip(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(0, 1, (8, 4)).astype(np.float32)
+        w[:, 2] *= 100.0  # one large-magnitude channel
+        q, s = quantize_weight_per_channel(w, axis=1)
+        assert q.dtype == np.int8 and s.shape == (1, 4)
+        deq = q.astype(np.float32) * s
+        # per-channel scales keep small channels accurate despite channel 2
+        per_chan_err = np.abs(deq - w).max(axis=0)
+        per_chan_bound = np.abs(w).max(axis=0) / 100
+        assert (per_chan_err <= per_chan_bound).all(), per_chan_err
+
+
+class TestQAT:
+    def test_ste_gradients_flow(self):
+        m = ImperativeQuantAware().quantize(_mlp())
+        m.train()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(0, 1, (4, 16)).astype(np.float32))
+        loss = m(x).mean()
+        loss.backward()
+        lin = m[0].inner
+        g = lin.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+        assert np.abs(g.numpy()).sum() > 0  # straight-through, not zeroed
+
+
+class TestPTQ:
+    @pytest.mark.parametrize("algo", ["abs_max", "avg", "hist", "KL"])
+    def test_int8_close_to_fp32(self, algo):
+        rng = np.random.default_rng(0)
+        m = _mlp()
+        m.eval()
+        x = rng.normal(0, 1, (32, 16)).astype(np.float32)
+        ref = m(paddle.to_tensor(x)).numpy()
+        loader = [paddle.to_tensor(x[i:i + 8]) for i in range(0, 32, 8)]
+        ptq = PostTrainingQuantization(m, loader, algo=algo)
+        qm = ptq.quantize()
+        assert isinstance(qm[0], Int8Linear)
+        assert qm[0].act_scale is not None  # static calibrated scale
+        out = qm(paddle.to_tensor(x)).numpy()
+        # mean error: all algos must track the fp32 oracle closely; max
+        # error additionally bounded loosely because avg/KL clip outliers
+        # by design
+        mean_rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-8)
+        max_rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert mean_rel < 0.1, f"{algo}: mean deviation {mean_rel:.3f}"
+        assert max_rel < 0.5, f"{algo}: max deviation {max_rel:.3f}"
+
+    def test_save_quantized_model(self, tmp_path):
+        rng = np.random.default_rng(0)
+        m = _mlp()
+        m.eval()
+        loader = [paddle.to_tensor(
+            rng.normal(0, 1, (8, 16)).astype(np.float32))]
+        ptq = PostTrainingQuantization(m, loader)
+        ptq.quantize()
+        state = ptq.save_quantized_model(str(tmp_path / "q"))
+        int8_keys = [k for k in state if k.endswith("weight_int8")]
+        assert len(int8_keys) == 2
+        assert all(state[k].dtype == np.int8 for k in int8_keys)
+
+    def test_dynamic_fallback(self):
+        # convert without calibration -> dynamic activation scales
+        m = _mlp()
+        m.eval()
+        x = np.random.default_rng(0).normal(0, 1, (4, 16)).astype(np.float32)
+        ref = m(paddle.to_tensor(x)).numpy()
+        qm = convert_to_int8(m)
+        assert qm[0].act_scale is None
+        out = qm(paddle.to_tensor(x)).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert rel < 0.1
