@@ -1,0 +1,62 @@
+"""Sparse-gradient embedding lookup (SelectedRows producer).
+
+Split out of nn.functional to keep the tape wiring in one place: the
+lookup bypasses apply_op (jax.vjp only moves arrays) and records a
+hand-built GradNode whose weight cotangent is a SelectedRows — mirroring
+the reference's codegened lookup_table_v2_grad op that emits a
+SelectedRows when is_sparse=True (fluid/operators/lookup_table_v2_op.cc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd import tape as _tape
+from .core.selected_rows import SelectedRows
+from .core.tensor import Tensor
+
+
+def maybe_sparse_embedding(x, weight, padding_idx, sparse):
+    """Returns the lookup Tensor with sparse grad recording, or None to
+    fall through to the dense apply_op path (static capture, no-grad,
+    sparse=False)."""
+    if not sparse:
+        return None
+    if getattr(x, "_symbolic", False) or getattr(weight, "_symbolic", False):
+        return None  # static capture keeps the dense program form
+    ids = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    wv = weight._value
+    out = jnp.take(wv, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    grad_wanted = (_tape.grad_enabled() and isinstance(weight, Tensor)
+                   and not weight.stop_gradient)
+    t = Tensor(out, stop_gradient=not grad_wanted)
+    if not grad_wanted:
+        return t
+
+    V, H = wv.shape
+    flat_ids = ids.reshape(-1)
+    if padding_idx is not None:
+        # ids are concrete in this eager path: drop padding entries with a
+        # STATIC index set, so no row (not even row 0) is spuriously
+        # touched by moment-carrying/weight-decaying lazy optimizers
+        import numpy as np
+        keep_idx = jnp.asarray(
+            np.flatnonzero(np.asarray(flat_ids) != padding_idx), jnp.int32)
+    else:
+        keep_idx = None
+
+    def vjp_fn(ct):
+        vals = ct.reshape(-1, H).astype(jnp.float32)
+        rows = flat_ids
+        if keep_idx is not None:
+            vals = vals[keep_idx]
+            rows = rows[keep_idx]
+        return (SelectedRows(rows, vals, height=V),)
+
+    node = _tape.GradNode("sparse_embedding", vjp_fn, inputs=[weight],
+                          out_avals=[(tuple(out.shape), out.dtype)])
+    t._grad_node = node
+    t._output_index = 0
+    return t

@@ -90,9 +90,25 @@ class GradNode:
 
 def _accumulate_leaf(tensor, value) -> None:
     # GradNodeAccumulation analog: accumulate into .grad on the leaf.
+    from ..core.selected_rows import SelectedRows
     from ..core.tensor import Tensor
+    if isinstance(value, SelectedRows):
+        # sparse embedding grads stay as SelectedRows on the leaf (the
+        # reference's is_sparse lookup_table grad); mixing with a dense
+        # grad densifies via SelectedRows.__add__
+        if tensor._grad is None:
+            tensor._grad = value
+        elif isinstance(tensor._grad, SelectedRows):
+            tensor._grad = tensor._grad + value
+        else:
+            tensor._grad = Tensor(tensor._grad._value + value.to_dense(),
+                                  stop_gradient=True)
+        return
     if tensor._grad is None:
         tensor._grad = Tensor(value, stop_gradient=True)
+    elif isinstance(tensor._grad, SelectedRows):
+        tensor._grad = Tensor(tensor._grad.to_dense() + value,
+                              stop_gradient=True)
     else:
         tensor._grad = Tensor(tensor._grad._value + value, stop_gradient=True)
 

@@ -1,0 +1,73 @@
+"""SelectedRows: sparse row-set gradients.
+
+~ paddle/phi/core/selected_rows.h: a (rows, values, height) triple
+standing in for a mostly-zero dense tensor whose only non-zero rows are
+``rows`` — the gradient type of sparse embedding lookups, consumed by the
+optimizers' lazy row-wise update kernels
+(phi/kernels/selected_rows/adam_kernel.h). TPU-native: rows/values are
+jax arrays; merge/dense conversion are segment ops XLA lowers to
+scatter-adds.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """height x values.shape[1:] virtual tensor, non-zero on `rows`."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        self.values = jnp.asarray(values)
+        assert self.values.shape[0] == self.rows.shape[0], \
+            (self.values.shape, self.rows.shape)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (~ scatter_op MergeAdd,
+        phi/kernels/funcs/selected_rows_functor.h). Eager-path op: row ids
+        are concrete, so unique runs host-side and the result has exactly
+        the distinct rows — no padding entries that would make moment-
+        carrying optimizers touch rows they shouldn't."""
+        import numpy as np
+        uniq, inv = np.unique(np.asarray(self.rows), return_inverse=True)
+        summed = jax.ops.segment_sum(self.values,
+                                     jnp.asarray(inv, jnp.int32),
+                                     num_segments=len(uniq))
+        return SelectedRows(jnp.asarray(uniq, jnp.int32), summed,
+                            self.height)
+
+    def to_dense(self) -> jnp.ndarray:
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    # -- arithmetic (leaf grad accumulation) -------------------------------
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # sparse + dense -> dense
+        return self.to_dense() + other
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_dim={self.values.shape[1:]})")

@@ -12,3 +12,5 @@ from .fleet_base import (  # noqa: F401
 )
 from . import meta_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
+
+from . import data_generator  # noqa: F401,E402

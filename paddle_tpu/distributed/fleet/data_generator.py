@@ -1,0 +1,139 @@
+"""Fleet data generators (PS file-dataset pipeline).
+
+~ python/paddle/distributed/fleet/data_generator/data_generator.py:
+user subclasses override ``generate_sample(line)`` returning an iterator
+of (slot_name, feasign_list) pairs; the base class streams stdin/memory
+lines into the MultiSlot text protocol that the reference's C++ DataFeed
+parses (framework/data_feed.cc). The TPU build's InMemoryDataset/
+QueueDataset wrappers consume the same protocol, and ``to_arrays`` bridges
+generated batches straight to numpy for DataLoader-style use.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Base generator: inherit + override generate_sample."""
+
+    def __init__(self):
+        self.batch_size_ = 1
+        self._proto_info = None
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ------------------------------------------------------
+    def generate_sample(self, line):
+        """Returns a zero-arg iterator function yielding
+        [(slot_name, [feasigns...]), ...] per sample."""
+        raise NotImplementedError(
+            "subclass DataGenerator and override generate_sample")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- drivers ---------------------------------------------------------
+    def run_from_stdin(self):
+        """stdin lines -> protocol lines on stdout (the PS trainer pipe)."""
+        batch = []
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    self._flush(batch, sys.stdout)
+                    batch = []
+        if batch:
+            self._flush(batch, sys.stdout)
+
+    def run_from_memory(self, lines=None):
+        """Returns the protocol lines for in-memory lines (tests/datasets)."""
+        out: List[str] = []
+        batch = []
+        for line in (lines if lines is not None else [None]):
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) == self.batch_size_:
+                    out.extend(self._render(batch))
+                    batch = []
+        if batch:
+            out.extend(self._render(batch))
+        return out
+
+    def _flush(self, batch, fh):
+        for ln in self._render(batch):
+            fh.write(ln + "\n")
+
+    def _render(self, batch) -> List[str]:
+        lines = []
+        for sample in self.generate_batch(batch)():
+            lines.append(self._gen_str(sample))
+        return lines
+
+    def _gen_str(self, sample) -> str:
+        raise NotImplementedError
+
+    # -- numpy bridge ----------------------------------------------------
+    @staticmethod
+    def to_arrays(proto_lines: List[str]) -> List[Dict[str, np.ndarray]]:
+        """Parse MultiSlot protocol lines back into per-sample
+        {slot: values} dicts (the DataFeed parse, host-side)."""
+        out = []
+        for ln in proto_lines:
+            toks = ln.split()
+            i = 0
+            rec: Dict[str, np.ndarray] = {}
+            slot_idx = 0
+            while i < len(toks):
+                n = int(toks[i])
+                vals = toks[i + 1:i + 1 + n]
+                i += 1 + n
+                arr = (np.asarray([float(v) for v in vals], np.float32)
+                       if any("." in v for v in vals)
+                       else np.asarray([int(v) for v in vals], np.int64))
+                rec[f"slot_{slot_idx}"] = arr
+                slot_idx += 1
+            out.append(rec)
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """~ MultiSlotDataGenerator: sample = [(slot, [ints/floats]), ...]
+    rendered as `<n> v1..vn <n> v1..vn ...` per line."""
+
+    def _gen_str(self, sample) -> str:
+        parts = []
+        for _slot, feasigns in sample:
+            parts.append(str(len(feasigns)))
+            parts.extend(str(f) for f in feasigns)
+        return " ".join(parts)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """~ MultiSlotStringDataGenerator: feasigns already strings."""
+
+    def _gen_str(self, sample) -> str:
+        parts = []
+        for _slot, feasigns in sample:
+            parts.append(str(len(feasigns)))
+            parts.extend(feasigns)
+        return " ".join(parts)

@@ -19,7 +19,8 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["TaskNode", "Interceptor", "ComputeInterceptor", "MessageBus",
-           "Carrier", "FleetExecutor", "DistModel", "DistModelConfig"]
+           "DistMessageBus", "Carrier", "DistCarrier", "FleetExecutor",
+           "DistModel", "DistModelConfig"]
 
 _STOP = object()
 
@@ -58,6 +59,128 @@ class MessageBus:
 
     def send(self, dst: int, payload) -> None:
         self._inboxes[dst].put(payload)
+
+
+class DistMessageBus(MessageBus):
+    """Cross-process message bus (~ message_bus.h over brpc: InitBus with a
+    rank-to-addr table, remote sends serialized over the wire).
+
+    task_to_rank: owner rank of every task id in the runtime graph.
+    addrs: rank -> "host:port" listen addresses (the brpc endpoint list).
+    Local tasks get in-process queues; sends to remote tasks ship
+    length-prefixed pickle frames over cached sockets. Frames arriving
+    before the destination inbox registers are buffered.
+    """
+
+    _STOP_WIRE = "__fleet_executor_stop__"
+
+    def __init__(self, task_to_rank: Dict[int, int], rank: int,
+                 addrs: Dict[int, str]):
+        super().__init__()
+        import socket
+        self._task_to_rank = dict(task_to_rank)
+        self._rank = rank
+        self._addrs = dict(addrs)
+        self._socks: Dict[int, Any] = {}
+        self._pending: Dict[int, list] = {}
+        self._mu = threading.Lock()
+        host, port = addrs[rank].rsplit(":", 1)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(32)
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # -- wire ------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn):
+        from .ps import _recv_msg
+        with conn:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                dst, payload = msg
+                if payload == self._STOP_WIRE:
+                    payload = _STOP
+                self._deliver(dst, payload)
+
+    def _deliver(self, dst: int, payload):
+        with self._mu:
+            q = self._inboxes.get(dst)
+            if q is None:
+                self._pending.setdefault(dst, []).append(payload)
+                return
+        q.put(payload)
+
+    def register(self, task_id: int, maxsize: int = 8) -> "queue.Queue":
+        q = super().register(task_id, maxsize)
+        with self._mu:
+            backlog = self._pending.pop(task_id, [])
+        for p in backlog:
+            q.put(p)
+        return q
+
+    def send(self, dst: int, payload) -> None:
+        owner = self._task_to_rank.get(dst, self._rank)
+        if owner == self._rank:
+            self._deliver(dst, payload)
+            return
+        import socket
+        from .ps import _send_msg
+        if payload is _STOP:
+            payload = self._STOP_WIRE
+        else:
+            payload = _host_payload(payload)
+        # per-destination lock; the (possibly blocking) network write must
+        # NOT hold the global _mu — a full remote inbox would otherwise
+        # stall _deliver on the reader threads and deadlock both ranks
+        with self._mu:
+            entry = self._socks.get(owner)
+            if entry is None:
+                host, port = self._addrs[owner].rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=60)
+                entry = (sock, threading.Lock())
+                self._socks[owner] = entry
+        sock, sock_mu = entry
+        with sock_mu:
+            _send_msg(sock, (dst, payload))
+
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for s, _mu in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def _host_payload(payload):
+    """Device arrays -> numpy before pickling onto the wire (the DCN-hop
+    analog: cross-host tensors move through host memory)."""
+    try:
+        import jax
+        import numpy as np
+
+        def conv(x):
+            return np.asarray(x) if isinstance(x, jax.Array) else x
+        return jax.tree.map(conv, payload)
+    except ImportError:
+        return payload
 
 
 class Interceptor:
@@ -166,6 +289,65 @@ class Carrier:
             if ic.error is not None:
                 raise ic.error
         return [self.results[i] for i in sorted(self.results)]
+
+
+class DistCarrier:
+    """Cross-process carrier: each rank owns the interceptors of its local
+    TaskNodes; messages between ranks ride the DistMessageBus
+    (~ carrier.cc + message_bus.cc in multi-rank deployment).
+
+    Graph convention: tasks are linearly chained by task_id (explicit
+    edges honored when present); rank 0 feeds microbatches, the rank
+    owning the highest task id hosts the sink and returns the gathered
+    results — other ranks return [].
+    """
+
+    def __init__(self, tasks: List[TaskNode], rank: int,
+                 addrs: Dict[int, str]):
+        self.rank = rank
+        ordered = sorted(tasks, key=lambda t: t.task_id)
+        if not any(t.downstream for t in tasks):
+            for a, b in zip(ordered, ordered[1:]):
+                a.add_downstream_task(b.task_id)
+                b.add_upstream_task(a.task_id)
+        sink_owner = ordered[-1].rank
+        sink = TaskNode(rank=sink_owner, node_type="Sink",
+                        task_id=ordered[-1].task_id + 1)
+        # every tail (no downstream) feeds the sink — same tails rule as
+        # the local Carrier, so multi-branch graphs don't drop results
+        for t in ordered:
+            if not t.downstream:
+                t.add_downstream_task(sink.task_id)
+        all_tasks = ordered + [sink]
+        task_to_rank = {t.task_id: t.rank for t in all_tasks}
+        self.bus = DistMessageBus(task_to_rank, rank, addrs)
+        self._head = ordered[0]
+        self.results: Dict[int, Any] = {}
+        self.interceptors: List[Interceptor] = []
+        for t in ordered:
+            if t.rank == rank:
+                self.interceptors.append(ComputeInterceptor(t, self.bus))
+        if sink_owner == rank:
+            self.interceptors.append(
+                _SinkInterceptor(sink, self.bus, self.results))
+        for ic in self.interceptors:
+            ic.start()
+
+    def run(self, microbatches: Optional[List[Any]] = None) -> List[Any]:
+        self.results.clear()
+        if self.rank == self._head.rank:
+            for i, mb in enumerate(microbatches or []):
+                self.bus.send(self._head.task_id, (i, mb))
+            self.bus.send(self._head.task_id, _STOP)
+        for ic in self.interceptors:
+            ic.join()
+        for ic in self.interceptors:
+            if ic.error is not None:
+                raise ic.error
+        return [self.results[i] for i in sorted(self.results)]
+
+    def close(self):
+        self.bus.close()
 
 
 class FleetExecutor:

@@ -1,3 +1,5 @@
 """Framework-level utilities: save/load, device namespace, random."""
 from . import io  # noqa: F401
 from . import device  # noqa: F401
+
+from ..core.selected_rows import SelectedRows  # noqa: F401,E402

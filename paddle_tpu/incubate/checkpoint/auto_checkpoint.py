@@ -1,0 +1,213 @@
+"""Auto-checkpoint: job-keyed epoch-range training with transparent resume.
+
+~ python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71 (epoch
+range generator :598, ExeTrainStatus :193, save_checkpoint :458) +
+checkpoint_saver.py:53 — the reference checkpoints to HDFS keyed by
+PADDLE_JOB_ID and, on restart, `train_epoch_range` silently skips the
+epochs that already ran. Same contract here over the fs abstraction
+(LocalFS default, HDFSClient when PADDLE_CHECKPOINT_FS=hdfs), with
+atomic tmp-dir renames and bounded history (max_ckpt_nums analog).
+
+Usage::
+
+    for epoch in train_epoch_range(10, model=model, optimizer=opt):
+        ...train one epoch...
+    # on restart with the same PADDLE_JOB_ID + checkpoint dir, completed
+    # epochs are skipped and model/optimizer state is restored.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Iterator, Optional
+
+from ...distributed.fleet.utils.fs import FS, LocalFS
+
+
+def _job_id() -> str:
+    return os.environ.get("PADDLE_JOB_ID", "default_job")
+
+
+def _root_dir() -> str:
+    return os.environ.get("PADDLE_AUTO_CHECKPOINT_DIR",
+                          "./auto_checkpoint")
+
+
+def _enabled() -> bool:
+    return os.environ.get("PADDLE_ENABLE_AUTO_CHECKPOINT", "1") != "0"
+
+
+class ExeTrainStatus:
+    """Serializable training progress (~ auto_checkpoint.py:193)."""
+
+    def __init__(self, epoch_no: int = -1, checkpoint_no: int = 0):
+        self.epoch_no = epoch_no
+        self.checkpoint_no = checkpoint_no
+
+    def to_dict(self):
+        return {"epoch_no": self.epoch_no,
+                "checkpoint_no": self.checkpoint_no,
+                "timestamp": time.time()}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(int(d["epoch_no"]), int(d.get("checkpoint_no", 0)))
+
+
+class CheckpointSaver:
+    """Versioned checkpoint directory manager (~ checkpoint_saver.py:53).
+
+    Layout: <root>/<job_id>/ckpt_<n>/ containing `state.pdparams`
+    (model+optimizer state via framework io) and `meta.json`
+    (ExeTrainStatus). Saves go to a tmp dir then mv — readers never see a
+    torn checkpoint. Keeps the newest ``max_ckpt_nums``.
+    """
+
+    def __init__(self, fs: Optional[FS] = None, root: Optional[str] = None,
+                 job_id: Optional[str] = None, max_ckpt_nums: int = 3):
+        self.fs = fs or LocalFS()
+        self.root = root or _root_dir()
+        self.job_id = job_id or _job_id()
+        self.max_ckpt_nums = max_ckpt_nums
+
+    @property
+    def job_dir(self) -> str:
+        return f"{self.root}/{self.job_id}"
+
+    def _ckpt_nos(self):
+        dirs, _ = self.fs.ls_dir(self.job_dir)
+        nos = []
+        for d in dirs:
+            if d.startswith("ckpt_") and d[5:].isdigit():
+                nos.append(int(d[5:]))
+        return sorted(nos)
+
+    def save_checkpoint(self, state_bytes: bytes, status: ExeTrainStatus,
+                        local_cache_path: str = ".ckpt_cache") -> int:
+        nos = self._ckpt_nos()
+        no = (nos[-1] + 1) if nos else 0
+        status.checkpoint_no = no
+        final = f"{self.job_dir}/ckpt_{no}"
+        tmp = f"{self.job_dir}/.tmp_ckpt_{no}_{os.getpid()}"
+        if self.fs.need_upload_download():
+            os.makedirs(local_cache_path, exist_ok=True)
+            sp = os.path.join(local_cache_path, f"state_{no}")
+            with open(sp, "wb") as f:
+                f.write(state_bytes)
+            mp = os.path.join(local_cache_path, f"meta_{no}.json")
+            with open(mp, "w") as f:
+                json.dump(status.to_dict(), f)
+            self.fs.mkdirs(tmp)
+            self.fs.upload(sp, f"{tmp}/state.pdparams")
+            self.fs.upload(mp, f"{tmp}/meta.json")
+            os.remove(sp)
+            os.remove(mp)
+        else:
+            self.fs.mkdirs(tmp)
+            with open(f"{tmp}/state.pdparams", "wb") as f:
+                f.write(state_bytes)
+            with open(f"{tmp}/meta.json", "w") as f:
+                json.dump(status.to_dict(), f)
+        self.fs.mv(tmp, final, overwrite=True)
+        self._gc()
+        return no
+
+    def load_checkpoint(self, ckpt_no: Optional[int] = None,
+                        local_cache_path: str = ".ckpt_cache"):
+        """Returns (state_bytes, ExeTrainStatus) or (None, None)."""
+        nos = self._ckpt_nos()
+        if not nos:
+            return None, None
+        no = nos[-1] if ckpt_no is None else ckpt_no
+        d = f"{self.job_dir}/ckpt_{no}"
+        try:
+            meta = json.loads(self.fs.cat(f"{d}/meta.json"))
+        except (ValueError, OSError):
+            return None, None
+        if self.fs.need_upload_download():
+            os.makedirs(local_cache_path, exist_ok=True)
+            lp = os.path.join(local_cache_path, f"load_{no}")
+            self.fs.download(f"{d}/state.pdparams", lp)
+            with open(lp, "rb") as f:
+                blob = f.read()
+            os.remove(lp)
+        else:
+            with open(f"{d}/state.pdparams", "rb") as f:
+                blob = f.read()
+        return blob, ExeTrainStatus.from_dict(meta)
+
+    def _gc(self):
+        nos = self._ckpt_nos()
+        for no in nos[:-self.max_ckpt_nums]:
+            self.fs.delete(f"{self.job_dir}/ckpt_{no}")
+
+
+def _to_numpy_tree(tree):
+    import jax
+    import numpy as np
+
+    from ...core.tensor import Tensor
+    return jax.tree.map(
+        lambda x: np.asarray(x._value) if isinstance(x, Tensor) else x,
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _to_tensor_tree(tree):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...core.tensor import Tensor
+    return jax.tree.map(
+        lambda x: Tensor(jnp.asarray(x)) if isinstance(x, np.ndarray)
+        else x, tree)
+
+
+def _pack_state(model, optimizer) -> bytes:
+    import pickle
+    state = {}
+    if model is not None:
+        state["model"] = _to_numpy_tree(dict(model.state_dict()))
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        state["opt"] = _to_numpy_tree(optimizer.state_dict())
+    return pickle.dumps(state, protocol=4)
+
+
+def _unpack_state(blob: bytes, model, optimizer):
+    import pickle
+    state = pickle.loads(blob)
+    if model is not None and "model" in state:
+        model.set_state_dict(_to_tensor_tree(state["model"]))
+    if optimizer is not None and "opt" in state and \
+            hasattr(optimizer, "set_state_dict"):
+        optimizer.set_state_dict(_to_tensor_tree(state["opt"]))
+
+
+def train_epoch_range(max_epoch_num: int, model=None, optimizer=None,
+                      save_checkpoint_inter: int = 1,
+                      saver: Optional[CheckpointSaver] = None
+                      ) -> Iterator[int]:
+    """Epoch generator with transparent resume (~ auto_checkpoint.py:598).
+
+    Yields epoch numbers that still need to run; after each yielded epoch
+    (every ``save_checkpoint_inter`` epochs) the model+optimizer state is
+    checkpointed. On restart under the same job id, already-completed
+    epochs are skipped and state is restored before the first yield.
+    """
+    if not _enabled():
+        yield from range(max_epoch_num)
+        return
+    saver = saver or CheckpointSaver()
+    start = 0
+    blob, status = saver.load_checkpoint()
+    if status is not None:
+        start = status.epoch_no + 1
+        if blob is not None:
+            _unpack_state(blob, model, optimizer)
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        if (epoch - start) % max(1, save_checkpoint_inter) == 0 or \
+                epoch == max_epoch_num - 1:
+            saver.save_checkpoint(_pack_state(model, optimizer),
+                                  ExeTrainStatus(epoch_no=epoch))

@@ -3,13 +3,30 @@
 ~ python/paddle/incubate/nn/layer/fused_transformer.py
 (FusedMultiHeadAttention:39, FusedFeedForward:230, FusedMultiTransformer:627
 backed by CUDA fused_attention_op/fused_feedforward_op). On TPU "fused"
-means: one jitted region; attention uses the Pallas flash kernel; XLA fuses
-bias/dropout/residual/layernorm into the surrounding matmuls.
+means: the residual epilogue ``ln(residual + dropout(x))`` runs the Pallas
+dropout-add-layernorm kernel (one VMEM pass, differentiable custom VJP —
+the fused_bias_dropout_residual_layer_norm analog); attention rides the
+Pallas flash kernel where eligible; XLA fuses the rest into the matmuls.
 """
 from __future__ import annotations
 
 from ... import nn
+from ...core.tensor import Tensor
 from ...nn import functional as F
+from ...ops.dispatch import apply_op
+
+
+def _fused_epilogue(x, residual, ln: "nn.LayerNorm", p: float,
+                    training: bool):
+    """ln(residual + dropout(x)) through the Pallas fused kernel."""
+    from ...ops.pallas.dropout_ln import fused_dropout_add_layer_norm
+
+    def fn(xv, rv, wv, bv):
+        return fused_dropout_add_layer_norm(
+            xv, rv, wv, bv, p=p, eps=ln.epsilon, training=training)
+
+    return apply_op("fused_dropout_add_ln", fn, x, residual,
+                    ln.weight, ln.bias)
 
 
 class FusedMultiHeadAttention(nn.Layer):
@@ -38,10 +55,11 @@ class FusedMultiHeadAttention(nn.Layer):
         out = self.attn(query, key, value, attn_mask=attn_mask, cache=cache)
         if isinstance(out, tuple):
             out = out[0]
-        out = residual + self.dropout(out)
         if not self.normalize_before:
-            out = self.ln_post(out)
-        return out
+            # post-LN epilogue in one fused VMEM pass
+            return _fused_epilogue(out, residual, self.ln_post,
+                                   self.dropout.p, self.training)
+        return residual + self.dropout(out)
 
 
 class FusedFeedForward(nn.Layer):
@@ -71,10 +89,10 @@ class FusedFeedForward(nn.Layer):
         if self.normalize_before:
             src = self.norm(src)
         src = self.linear2(self.dropout1(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
         if not self.normalize_before:
-            src = self.norm(src)
-        return src
+            return _fused_epilogue(src, residual, self.norm,
+                                   self.dropout2.p, self.training)
+        return residual + self.dropout2(src)
 
 
 class FusedTransformerEncoderLayer(nn.Layer):

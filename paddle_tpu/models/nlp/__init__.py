@@ -1,4 +1,5 @@
-from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401
+from .bert import (BertConfig, BertForPretraining,  # noqa: F401
+                   BertModel, bert_pretrain_step_factory)
 from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_train_step_factory,

@@ -128,3 +128,82 @@ class BertForSequenceClassification(nn.Layer):
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         return self.classifier(self.dropout(pooled))
+
+
+def bert_pretrain_step_factory(model: BertForPretraining, mesh,
+                               learning_rate=1e-4, weight_decay=0.01,
+                               beta1=0.9, beta2=0.999, eps=1e-8,
+                               remat=False):
+    """(params, opt_state, step) for compiled BERT pretraining
+    (BASELINE.md config 3: PaddleNLP BERT-base pretraining, Fleet DP).
+
+    Same pjit pattern as llama_train_step_factory (llama.py): params per
+    sharding annotation, adamw moments optionally ZeRO-sharded over
+    'sharding', batch over 'data'. Loss = masked-LM CE (ignore_index -100)
+    + NSP CE.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ...autograd import no_grad
+    from ...core.tensor import Tensor
+    from .llama import param_shardings
+
+    from .train_utils import (adamw_state_shardings, adamw_update,
+                              make_adamw_state)
+    shardings = param_shardings(model, mesh)
+    params = {k: jax.device_put(jnp.array(v._value, copy=True), shardings[k])
+              for k, v in model.state_dict().items()}
+    opt_state = make_adamw_state(mesh, shardings, params)
+    data_sh = NamedSharding(
+        mesh, P("data" if "data" in mesh.axis_names else None))
+
+    def forward_loss(params, input_ids, type_ids, mlm_labels, nsp_labels):
+        saved = model.tree_flatten_params()
+        model.load_tree(params)
+        try:
+            with no_grad():
+                mlm_logits, nsp_logits = model(Tensor(input_ids),
+                                               Tensor(type_ids))
+                mlm_logits = mlm_logits._value
+                nsp_logits = nsp_logits._value
+        finally:
+            model.load_tree(saved)
+        V = mlm_logits.shape[-1]
+        flat = mlm_logits.reshape(-1, V).astype(jnp.float32)
+        lbl = mlm_labels.reshape(-1)
+        valid = lbl != -100
+        logp = jax.nn.log_softmax(flat, -1)
+        nll = -jnp.take_along_axis(
+            logp, jnp.where(valid, lbl, 0)[:, None], -1)[:, 0]
+        mlm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
+        nsp_loss = -jnp.mean(
+            jnp.take_along_axis(nsp_logp, nsp_labels[:, None], -1)[:, 0])
+        return mlm_loss + nsp_loss
+
+    loss_fn = jax.checkpoint(forward_loss) if remat else forward_loss
+
+    def train_step(params, opt_state, input_ids, type_ids, mlm_labels,
+                   nsp_labels):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, input_ids, type_ids, mlm_labels, nsp_labels)
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            new_p[k], new_m[k], new_v[k] = adamw_update(
+                params[k], grads[k], opt_state["m"][k], opt_state["v"][k],
+                t, learning_rate, beta1, beta2, eps, weight_decay)
+        return new_p, {"step": step, "m": new_m, "v": new_v}, loss
+
+    state_sh = adamw_state_shardings(mesh, opt_state, params)
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(shardings, state_sh, data_sh, data_sh, data_sh,
+                      data_sh),
+        out_shardings=(shardings, state_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1))
+    return params, opt_state, jitted

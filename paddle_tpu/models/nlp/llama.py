@@ -294,12 +294,18 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                              learning_rate=1e-4, weight_decay=0.01,
                              beta1=0.9, beta2=0.95, eps=1e-8,
                              accum_dtype=jnp.float32,
-                             remat: bool = True):
+                             remat: bool | str = True):
     """Returns (params, opt_state, train_step) for pjit execution.
 
     Shardings: params per annotation; adamw moments mirror the params but
     additionally sharded over 'sharding' axis if present (ZeRO-1); batch on
     'data'; sequence on 'sep' (context parallel).
+
+    remat: False = no rematerialization (fastest when activations fit HBM
+    — measured 0.55 vs 0.42 MFU on v5e for the 0.5B bench config);
+    True = full jax.checkpoint (lowest memory, ~33% extra FLOPs);
+    "dots" = selective policy saving matmul outputs and recomputing
+    elementwise ops (the middle ground, ~9% over full remat).
     """
     config = model.config
     shardings = param_shardings(model, mesh)
@@ -308,25 +314,8 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     params = {k: jax.device_put(jnp.array(v._value, copy=True), shardings[k])
               for k, v in model.state_dict().items()}
 
-    def zero_like_sharded(name, v):
-        sh = shardings[name]
-        spec = list(sh.spec) + [None] * (v.ndim - len(sh.spec))
-        if "sharding" in mesh.axis_names and \
-                mesh.shape.get("sharding", 1) > 1:
-            # ZeRO: shard moments along the largest unsharded dim
-            for i in np.argsort([-s for s in v.shape]):
-                i = int(i)
-                if spec[i] is None and v.shape[i] % mesh.shape["sharding"] == 0:
-                    spec[i] = "sharding"
-                    break
-        return jax.device_put(jnp.zeros(v.shape, accum_dtype),
-                              NamedSharding(mesh, P(*spec)))
-
-    opt_state = {
-        "step": jnp.zeros((), jnp.int32),
-        "m": {k: zero_like_sharded(k, v) for k, v in params.items()},
-        "v": {k: zero_like_sharded(k, v) for k, v in params.items()},
-    }
+    from .train_utils import adamw_update, make_adamw_state
+    opt_state = make_adamw_state(mesh, shardings, params, accum_dtype)
 
     batch_sharding = NamedSharding(
         mesh, P("data" if "data" in mesh.axis_names else None,
@@ -360,29 +349,23 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
         return jnp.mean(nll)
 
     loss_fn = forward_loss
-    if remat:
+    if remat == "dots":
+        loss_fn = jax.checkpoint(
+            forward_loss,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif remat:
         loss_fn = jax.checkpoint(forward_loss)
 
     def train_step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         step = opt_state["step"] + 1
         t = step.astype(jnp.float32)
-        lr = learning_rate
-
-        def upd(p, g, m, v):
-            g = g.astype(accum_dtype)
-            m2 = beta1 * m + (1 - beta1) * g
-            v2 = beta2 * v + (1 - beta2) * jnp.square(g)
-            mhat = m2 / (1 - beta1 ** t)
-            vhat = v2 / (1 - beta2 ** t)
-            delta = mhat / (jnp.sqrt(vhat) + eps) \
-                + weight_decay * p.astype(accum_dtype)
-            return (p.astype(accum_dtype) - lr * delta).astype(p.dtype), m2, v2
-
         new_p, new_m, new_v = {}, {}, {}
         for k in params:
-            new_p[k], new_m[k], new_v[k] = upd(
-                params[k], grads[k], opt_state["m"][k], opt_state["v"][k])
+            new_p[k], new_m[k], new_v[k] = adamw_update(
+                params[k], grads[k], opt_state["m"][k], opt_state["v"][k],
+                t, learning_rate, beta1, beta2, eps, weight_decay,
+                accum_dtype)
         return new_p, {"step": step, "m": new_m, "v": new_v}, loss
 
     jitted = jax.jit(

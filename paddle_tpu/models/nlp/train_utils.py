@@ -1,0 +1,60 @@
+"""Shared pieces of the compiled train-step factories (llama/bert/...).
+
+One implementation of the ZeRO moment-sharding rule and the AdamW update
+so the per-model factories can't drift (they previously carried verbatim
+copies).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def zero_like_sharded(mesh, shardings, name, v, accum_dtype=jnp.float32):
+    """A zeros moment buffer for param ``v``: inherits the param's
+    annotated axes, then (when a >1 'sharding' axis exists) shards the
+    largest remaining divisible dim over it — ZeRO-1
+    (~ group_sharded_optimizer_stage2.py:48 param segmentation)."""
+    sh = shardings[name]
+    spec = list(sh.spec) + [None] * (v.ndim - len(sh.spec))
+    if "sharding" in mesh.axis_names and mesh.shape.get("sharding", 1) > 1:
+        for i in np.argsort([-s for s in v.shape]):
+            i = int(i)
+            if spec[i] is None and v.shape[i] % mesh.shape["sharding"] == 0:
+                spec[i] = "sharding"
+                break
+    return jax.device_put(jnp.zeros(v.shape, accum_dtype),
+                          NamedSharding(mesh, P(*spec)))
+
+
+def adamw_update(p, g, m, v, t, lr, beta1, beta2, eps, weight_decay,
+                 accum_dtype=jnp.float32):
+    """One decoupled-weight-decay Adam step on a single tensor; moments in
+    ``accum_dtype``, param returned in its own dtype."""
+    g = g.astype(accum_dtype)
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m2 / (1 - beta1 ** t)
+    vhat = v2 / (1 - beta2 ** t)
+    delta = mhat / (jnp.sqrt(vhat) + eps) \
+        + weight_decay * p.astype(accum_dtype)
+    return (p.astype(accum_dtype) - lr * delta).astype(p.dtype), m2, v2
+
+
+def make_adamw_state(mesh, shardings, params, accum_dtype=jnp.float32):
+    """step/m/v opt-state pytree with ZeRO-aware shardings."""
+    return {
+        "step": jnp.zeros((), jnp.int32),
+        "m": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype)
+              for k, v in params.items()},
+        "v": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype)
+              for k, v in params.items()},
+    }
+
+
+def adamw_state_shardings(mesh, opt_state, params):
+    return {"step": NamedSharding(mesh, P()),
+            "m": {k: opt_state["m"][k].sharding for k in params},
+            "v": {k: opt_state["v"][k].sharding for k in params}}

@@ -29,7 +29,19 @@ def linear(x, weight, bias=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False):
     """~ phi embedding (lookup_table_v2); padding_idx rows get zero grad via
-    zeroed output rows."""
+    zeroed output rows.
+
+    sparse=True: the weight gradient is recorded as a SelectedRows
+    (rows=looked-up ids, values=output cotangent rows) instead of a dense
+    (V, H) scatter — the reference's lookup_table_v2 is_sparse path whose
+    grad flows into the optimizers' lazy row-wise updates
+    (phi/kernels/selected_rows/).
+    """
+    from ..._internal_sparse_embed import maybe_sparse_embedding
+    out = maybe_sparse_embedding(x, weight, padding_idx, sparse)
+    if out is not None:
+        return out
+
     def fn(ids, wv):
         out = jnp.take(wv, ids, axis=0)
         if padding_idx is not None:

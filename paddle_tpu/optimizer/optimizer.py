@@ -149,8 +149,30 @@ class Optimizer:
     # ---- main entry -------------------------------------------------------
     @no_grad()
     def step(self):
-        params = [p for p in self._parameters
-                  if p.trainable and p._grad is not None]
+        from ..core.selected_rows import SelectedRows
+        all_params = [p for p in self._parameters
+                      if p.trainable and p._grad is not None]
+        sparse_params = [p for p in all_params
+                         if isinstance(p._grad, SelectedRows)]
+        params = [p for p in all_params if not isinstance(p._grad,
+                                                          SelectedRows)]
+        # ClipGradByGlobalNorm must see ONE norm over dense + sparse grads
+        # (reference merges SelectedRows into the global norm); per-tensor
+        # clips stay per-group.
+        from ..nn import ClipGradByGlobalNorm
+        joint_scale = None
+        if sparse_params and params and \
+                isinstance(self._grad_clip, ClipGradByGlobalNorm):
+            merged = [p._grad.merge() for p in sparse_params]
+            sq = sum(jnp.sum(jnp.square(p._grad._value.astype(jnp.float32)))
+                     for p in params)
+            sq = sq + sum(jnp.sum(jnp.square(sr.values.astype(jnp.float32)))
+                          for sr in merged)
+            gn = jnp.sqrt(sq)
+            joint_scale = jnp.minimum(
+                1.0, self._grad_clip.clip_norm / jnp.maximum(gn, 1e-12))
+        if sparse_params:
+            self._sparse_step(sparse_params, scale=joint_scale)
         if not params:
             self._step_count += 1
             return
@@ -158,7 +180,10 @@ class Optimizer:
         if mesh is not None:
             self._ensure_sharded_state(params, mesh, shard_axis)
         grads = [p._grad._value for p in params]
-        grads = self._apply_grad_clip(params, grads)
+        if joint_scale is not None:
+            grads = [(g * joint_scale).astype(g.dtype) for g in grads]
+        else:
+            grads = self._apply_grad_clip(params, grads)
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count + 1, jnp.int32)
         vals = [p._value for p in params]
@@ -188,6 +213,41 @@ class Optimizer:
         self._step_count += 1
         if isinstance(self._lr, LRScheduler) and self._lr._auto_step:
             pass  # paddle semantics: user calls scheduler.step()
+
+    def _sparse_step(self, sparse_params, scale=None):
+        """Lazy row-wise update for SelectedRows grads (~ the reference's
+        selected_rows optimizer kernels, phi/kernels/selected_rows/
+        adam_kernel.h with lazy_mode semantics: only looked-up rows'
+        params AND moments advance). ``scale`` is the precomputed joint
+        global-norm factor when dense params share the clip."""
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count + 1, jnp.int32)
+        for p in sparse_params:
+            sr = p._grad.merge()
+            rows = sr.rows
+            grad_rows = sr.values.astype(jnp.float32)
+            if scale is not None:
+                grad_rows = grad_rows * scale
+            elif self._grad_clip is not None:
+                grad_rows = self._apply_grad_clip([p], [grad_rows])[0]
+            accs = self._accs_for(p)
+            row_keys = [k for k, a in accs.items()
+                        if hasattr(a, "ndim") and a.ndim >= 1
+                        and a.shape[:1] == p._value.shape[:1]]
+            p_rows = p._value[rows]
+            acc_rows = {k: accs[k][rows] for k in row_keys}
+            # scalar accumulators (e.g. beta power) pass through untouched
+            for k in accs:
+                if k not in row_keys:
+                    acc_rows[k] = accs[k]
+            new_rows, new_accs = self._update(
+                p_rows.astype(jnp.float32), grad_rows, acc_rows, lr, step)
+            p._value = p._value.at[rows].set(new_rows.astype(p._value.dtype))
+            for k in row_keys:
+                accs[k] = accs[k].at[rows].set(new_accs[k])
+            for k in new_accs:
+                if k not in row_keys:
+                    accs[k] = new_accs[k]
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

@@ -153,6 +153,7 @@ class Executor:
             opt_states.append([optimizer._accs_for(p) for p in ps])
             lrs.append(jnp.asarray(optimizer.get_lr(), jnp.float32))
             steps.append(jnp.asarray(optimizer._step_count + 1, jnp.int32))
+        self._shard_opt_states(prog, opt_states)
 
         fetches, new_param_vals, new_opt_states = step_fn(
             feed_vals, param_vals, opt_states, lrs, steps)
@@ -189,6 +190,29 @@ class Executor:
         if isinstance(f, (G.StaticVar, Parameter, Tensor)):
             return f
         raise TypeError(f"bad fetch_list entry: {f!r}")
+
+    def _shard_opt_states(self, prog, opt_states):
+        """Static-graph ZeRO-1 (~ meta_optimizers/sharding_optimizer.py:45):
+        when an optimizer carries `_shard_states_axis` and the global mesh
+        has that axis, its accumulators are placed with NamedShardings so
+        each device holds 1/N of every moment tensor; XLA's sharding
+        propagation keeps the compiled update's outputs on the same
+        layout (the program-rewrite the reference does by inserting
+        broadcast/reduce ops collapses into GSPMD)."""
+        for (optimizer, _loss, opt_params), accs in zip(prog._opts,
+                                                        opt_states):
+            mesh, axis = optimizer._zero_mesh()
+            if mesh is None:
+                continue
+            ps = self._opt_params(prog, optimizer, opt_params)
+            for p, a in zip(ps, accs):
+                pspec = getattr(p, "sharding_spec", None)
+                for k, arr in list(a.items()):
+                    if not hasattr(arr, "ndim") or arr.ndim < 1:
+                        continue
+                    sh = optimizer._state_sharding(arr, mesh, axis, pspec)
+                    if arr.sharding != sh:
+                        a[k] = jax.device_put(arr, sh)
 
     @staticmethod
     def _opt_params(prog, optimizer, opt_params):

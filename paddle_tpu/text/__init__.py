@@ -189,3 +189,7 @@ class WMT16(_SyntheticTranslationDataset):
                          min(src_dict_size, 30000), seed=7)
         self.src_dict_size = src_dict_size
         self.trg_dict_size = trg_dict_size
+
+from . import strings  # noqa: F401,E402
+from .strings import (FasterTokenizer, StringTensor,  # noqa: F401,E402
+                      to_string_tensor)

@@ -1,0 +1,209 @@
+"""StringTensor + strings kernels + FasterTokenizer analog.
+
+~ paddle/phi/core/string_tensor.h (pstring array tensor) and
+phi/kernels/strings/strings_lower_upper_kernel.h (+ unicode.h case
+tables); tokenizer ~ the faster_tokenizer op family
+(test_faster_tokenizer_op.py surface). TPU-native split: strings live on
+the host as numpy object arrays (device tensors are numeric by
+definition on XLA); the tokenizer's OUTPUT (ids/type-ids padded arrays)
+is what crosses onto the device. Case mapping uses Python's full Unicode
+tables — the role phi/kernels/strings/unicode.h plays in C++.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "lower", "upper",
+           "FasterTokenizer", "BasicTokenizer", "WordpieceTokenizer"]
+
+
+class StringTensor:
+    """Host-resident string array (~ phi::StringTensor)."""
+
+    def __init__(self, data: Union[Sequence[str], np.ndarray],
+                 name: str = ""):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self.tolist()!r})"
+
+
+def to_string_tensor(strings: Sequence[str]) -> StringTensor:
+    return StringTensor(strings)
+
+
+def _elementwise(st, fn):
+    data = st._data if isinstance(st, StringTensor) else np.asarray(
+        st, dtype=object)
+    return StringTensor(np.vectorize(fn, otypes=[object])(data))
+
+
+def lower(x, use_utf8_encoding: bool = True) -> StringTensor:
+    """~ strings_lower_upper_kernel.h StringLowerKernel."""
+    return _elementwise(x, lambda s: s.lower())
+
+
+def upper(x, use_utf8_encoding: bool = True) -> StringTensor:
+    """~ strings_lower_upper_kernel.h StringUpperKernel."""
+    return _elementwise(x, lambda s: s.upper())
+
+
+# ---------------------------------------------------------------------------
+# tokenizer (faster_tokenizer analog)
+# ---------------------------------------------------------------------------
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    import unicodedata
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_chinese_char(ch: str) -> bool:
+    cp = ord(ch)
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0xF900 <= cp <= 0xFAFF)
+
+
+class BasicTokenizer:
+    """Whitespace + punctuation + CJK splitting (BERT basic tokenizer —
+    the first stage of the reference faster_tokenizer pipeline)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        import unicodedata
+        if self.do_lower_case:
+            text = text.lower()
+            text = "".join(c for c in unicodedata.normalize("NFD", text)
+                           if unicodedata.category(c) != "Mn")
+        out: List[str] = []
+        buf = []
+        for ch in text:
+            if ch.isspace():
+                if buf:
+                    out.append("".join(buf))
+                    buf = []
+            elif _is_punctuation(ch) or _is_chinese_char(ch):
+                if buf:
+                    out.append("".join(buf))
+                    buf = []
+                out.append(ch)
+            else:
+                buf.append(ch)
+        if buf:
+            out.append("".join(buf))
+        return out
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword splitting (BERT wordpiece —
+    second stage of the faster_tokenizer pipeline)."""
+
+    def __init__(self, vocab: dict, unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_chars:
+            return [self.unk_token]
+        tokens = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = piece
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            tokens.append(cur)
+            start = end
+        return tokens
+
+
+class FasterTokenizer:
+    """~ the faster_tokenizer op (test_faster_tokenizer_op.py surface):
+    text (+ optional text pair) -> padded input_ids / token_type_ids
+    numpy arrays ready for device transfer."""
+
+    def __init__(self, vocab: dict, do_lower_case: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]"):
+        self.vocab = vocab
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(vocab, unk_token)
+        self.cls_id = vocab[cls_token]
+        self.sep_id = vocab[sep_token]
+        self.pad_id = vocab.get(pad_token, 0)
+
+    def _encode_one(self, text: str) -> List[int]:
+        ids = []
+        for w in self.basic.tokenize(text):
+            for piece in self.wordpiece.tokenize(w):
+                ids.append(self.vocab[piece])
+        return ids
+
+    def __call__(self, text, text_pair=None, max_seq_len: int = 0,
+                 pad_to_max_seq_len: bool = False):
+        texts = (text.tolist() if isinstance(text, StringTensor)
+                 else list(text))
+        pairs = None
+        if text_pair is not None:
+            pairs = (text_pair.tolist()
+                     if isinstance(text_pair, StringTensor)
+                     else list(text_pair))
+        all_ids, all_types = [], []
+        for i, t in enumerate(texts):
+            ids = [self.cls_id] + self._encode_one(t) + [self.sep_id]
+            types = [0] * len(ids)
+            if pairs is not None:
+                pids = self._encode_one(pairs[i]) + [self.sep_id]
+                ids += pids
+                types += [1] * len(pids)
+            if max_seq_len and len(ids) > max_seq_len:
+                ids = ids[:max_seq_len - 1] + [self.sep_id]
+                types = types[:max_seq_len]
+            all_ids.append(ids)
+            all_types.append(types)
+        width = max(len(i) for i in all_ids)
+        if pad_to_max_seq_len and max_seq_len:
+            width = max_seq_len
+        input_ids = np.full((len(all_ids), width), self.pad_id, np.int64)
+        token_type = np.zeros((len(all_ids), width), np.int64)
+        for r, (ids, types) in enumerate(zip(all_ids, all_types)):
+            input_ids[r, :len(ids)] = ids
+            token_type[r, :len(types)] = types
+        return input_ids, token_type

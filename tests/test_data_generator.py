@@ -1,0 +1,58 @@
+"""Fleet data generators (MultiSlot protocol).
+
+~ reference test_data_generator.py: subclass generate_sample, render the
+MultiSlot text protocol, parse back.
+"""
+import io
+
+from paddle_tpu.distributed.fleet.data_generator import (
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+
+class _G(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def it():
+            a, b = line.split(",")
+            yield [("ids", [int(a), int(a) + 1]), ("label", [int(b)])]
+        return it
+
+
+class TestMultiSlot:
+    def test_protocol_lines(self):
+        g = _G()
+        g.set_batch(2)
+        lines = g.run_from_memory(["1,0", "5,1", "9,0"])
+        assert lines == ["2 1 2 1 0", "2 5 6 1 1", "2 9 10 1 0"]
+
+    def test_to_arrays_roundtrip(self):
+        g = _G()
+        recs = DataGenerator.to_arrays(g.run_from_memory(["3,1"]))
+        assert recs[0]["slot_0"].tolist() == [3, 4]
+        assert recs[0]["slot_1"].tolist() == [1]
+
+    def test_float_slots(self):
+        class F(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("x", [0.5, 1.5])]
+                return it
+
+        recs = DataGenerator.to_arrays(F().run_from_memory([None]))
+        assert recs[0]["slot_0"].dtype.kind == "f"
+
+    def test_string_generator(self):
+        class S(MultiSlotStringDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("w", ["7", "8"])]
+                return it
+
+        assert S().run_from_memory([None]) == ["2 7 8"]
+
+    def test_stdin_driver(self, monkeypatch, capsys):
+        g = _G()
+        g.set_batch(1)
+        monkeypatch.setattr("sys.stdin", io.StringIO("2,1\n4,0\n"))
+        g.run_from_stdin()
+        out = capsys.readouterr().out.strip().split("\n")
+        assert out == ["2 2 3 1 1", "2 4 5 1 0"]

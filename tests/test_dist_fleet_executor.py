@@ -1,0 +1,94 @@
+"""Cross-process FleetExecutor: DistMessageBus + DistCarrier.
+
+~ reference fleet_executor multi-rank tests (test_fleet_executor_*.py
+with brpc message bus between ranks): a 2-stage pipeline split across two
+OS processes on localhost, microbatches fed on rank 0, results gathered
+at the sink on rank 1. Payloads are plain python — the bus is transport,
+jax arrays convert to numpy at the wire (_host_payload).
+"""
+import multiprocessing as mp
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _stage0(x):
+    return x + 1
+
+
+def _stage1(x):
+    return x * 2
+
+
+def _rank_main(rank, addrs, q):
+    from paddle_tpu.distributed.fleet_executor import DistCarrier, TaskNode
+    tasks = [TaskNode(rank=0, program=_stage0, task_id=0),
+             TaskNode(rank=1, program=_stage1, task_id=1)]
+    carrier = DistCarrier(tasks, rank=rank, addrs=addrs)
+    if rank == 0:
+        out = carrier.run([1, 2, 3])
+    else:
+        out = carrier.run()
+    q.put((rank, out))
+    carrier.close()
+
+
+def _two_free_ports():
+    import socket
+    socks, ports = [], []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TestDistCarrier:
+    def test_two_process_pipeline(self):
+        ctx = mp.get_context("spawn")
+        p0, p1 = _two_free_ports()
+        addrs = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_rank_main, args=(r, addrs, q))
+                 for r in (0, 1)]
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(2):
+            rank, out = q.get(timeout=120)
+            results[rank] = out
+        for p in procs:
+            p.join(timeout=30)
+        assert results[0] == []            # feeder rank has no sink
+        assert results[1] == [4, 6, 8]     # (x+1)*2 per microbatch
+
+    def test_single_process_two_rank_buses(self):
+        # both "ranks" inside one process: exercises remote send/recv,
+        # pre-registration buffering, and STOP forwarding over TCP
+        from paddle_tpu.distributed.fleet_executor import (DistCarrier,
+                                                           TaskNode)
+        p0, p1 = _two_free_ports()
+        addrs = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
+
+        import threading
+        results = {}
+
+        def run_rank(rank):
+            tasks = [TaskNode(rank=0, program=_stage0, task_id=0),
+                     TaskNode(rank=1, program=_stage1, task_id=1)]
+            carrier = DistCarrier(tasks, rank=rank, addrs=addrs)
+            out = carrier.run([5, 6] if rank == 0 else None)
+            results[rank] = out
+            carrier.close()
+
+        ts = [threading.Thread(target=run_rank, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert results[0] == []
+        assert results[1] == [12, 14]

@@ -1,0 +1,186 @@
+"""SelectedRows sparse embedding gradients + lazy optimizer updates.
+
+~ reference test_lookup_table_v2_op.py (is_sparse) + selected_rows
+optimizer kernel tests (test_adam_op.py lazy_mode): the sparse path must
+match the dense oracle on touched rows and leave untouched rows' params
+alone (lazy semantics).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.framework import SelectedRows as SRAlias
+
+
+class TestSelectedRows:
+    def test_merge_and_dense(self):
+        sr = SelectedRows(rows=[2, 0, 2], values=np.array(
+            [[1., 1.], [2., 2.], [3., 3.]], np.float32), height=4)
+        m = sr.merge()
+        assert sorted(np.asarray(m.rows).tolist()) == [0, 2]
+        d = np.asarray(sr.to_dense())
+        np.testing.assert_allclose(d[2], [4., 4.])
+        np.testing.assert_allclose(d[0], [2., 2.])
+        np.testing.assert_allclose(d[1], 0.0)
+        assert sr.shape == (4, 2)
+        assert SRAlias is SelectedRows
+
+    def test_add_sparse_sparse_and_dense(self):
+        a = SelectedRows([0], np.ones((1, 2), np.float32), height=3)
+        b = SelectedRows([1], np.ones((1, 2), np.float32), height=3)
+        c = a + b
+        assert isinstance(c, SelectedRows)
+        np.testing.assert_allclose(np.asarray(c.to_dense()),
+                                   [[1, 1], [1, 1], [0, 0]])
+        d = a + np.full((3, 2), 5.0, np.float32)
+        np.testing.assert_allclose(np.asarray(d),
+                                   [[6, 6], [5, 5], [5, 5]])
+
+
+class TestSparseEmbeddingGrad:
+    def test_grad_is_selected_rows(self):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 4, sparse=True)
+        ids = paddle.to_tensor(np.array([[1, 3, 1]], np.int64))
+        out = emb(ids)
+        out.sum().backward()
+        g = emb.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert g.height == 10
+        dense = np.asarray(g.to_dense())
+        np.testing.assert_allclose(dense[1], 2.0)  # id 1 twice
+        np.testing.assert_allclose(dense[3], 1.0)
+        assert np.abs(dense[[0, 2, 4, 5, 6, 7, 8, 9]]).sum() == 0
+
+    def test_dense_flag_unchanged(self):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 4, sparse=False)
+        ids = paddle.to_tensor(np.array([[1, 3]], np.int64))
+        emb(ids).sum().backward()
+        assert not isinstance(emb.weight.grad, SelectedRows)
+
+    def test_padding_idx_rows_zero(self):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 4, padding_idx=0, sparse=True)
+        ids = paddle.to_tensor(np.array([[0, 2]], np.int64))
+        emb(ids).sum().backward()
+        dense = np.asarray(emb.weight.grad.to_dense())
+        assert np.abs(dense[0]).sum() == 0
+        np.testing.assert_allclose(dense[2], 1.0)
+
+
+class TestLazyOptimizerUpdate:
+    def _pair(self, opt_cls, **kw):
+        """Two identical embeddings: one sparse-grad, one dense-grad."""
+        paddle.seed(3)
+        e1 = nn.Embedding(8, 4, sparse=True)
+        e2 = nn.Embedding(8, 4, sparse=False)
+        e2.weight.set_value(paddle.to_tensor(e1.weight.numpy().copy()))
+        o1 = opt_cls(parameters=e1.parameters(), **kw)
+        o2 = opt_cls(parameters=e2.parameters(), **kw)
+        return e1, e2, o1, o2
+
+    def test_sgd_matches_dense(self):
+        e1, e2, o1, o2 = self._pair(paddle.optimizer.SGD, learning_rate=0.1)
+        ids = paddle.to_tensor(np.array([1, 5, 1], np.int64))
+        for e, o in ((e1, o1), (e2, o2)):
+            (e(ids) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        np.testing.assert_allclose(e1.weight.numpy(), e2.weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_adam_touched_rows_match_untouched_frozen(self):
+        e1, e2, o1, o2 = self._pair(paddle.optimizer.Adam,
+                                    learning_rate=0.05)
+        w0 = e1.weight.numpy().copy()
+        ids = paddle.to_tensor(np.array([2, 6], np.int64))
+        for _ in range(3):
+            for e, o in ((e1, o1), (e2, o2)):
+                (e(ids) ** 2).sum().backward()
+                o.step()
+                o.clear_grad()
+        w_sparse = e1.weight.numpy()
+        w_dense = e2.weight.numpy()
+        # touched rows: sparse lazy == dense (zero grads elsewhere don't
+        # perturb adam moments of touched rows)
+        np.testing.assert_allclose(w_sparse[[2, 6]], w_dense[[2, 6]],
+                                   rtol=1e-4, atol=1e-5)
+        # untouched rows stay EXACTLY at init under lazy mode
+        untouched = [0, 1, 3, 4, 5, 7]
+        np.testing.assert_array_equal(w_sparse[untouched], w0[untouched])
+
+    def test_training_converges(self):
+        paddle.seed(0)
+        emb = nn.Embedding(20, 8, sparse=True)
+        head = nn.Linear(8, 1)
+        opt = paddle.optimizer.Adam(
+            parameters=list(emb.parameters()) + list(head.parameters()),
+            learning_rate=0.05)
+        rng = np.random.default_rng(0)
+        target = rng.normal(0, 1, (20,)).astype(np.float32)
+        losses = []
+        for _ in range(40):
+            ids_np = rng.integers(0, 20, (16,))
+            ids = paddle.to_tensor(ids_np.astype(np.int64))
+            pred = head(emb(ids))[:, 0]
+            y = paddle.to_tensor(target[ids_np])
+            loss = ((pred - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+class TestJointGlobalNormClip:
+    def test_one_norm_over_dense_and_sparse(self):
+        """ClipGradByGlobalNorm must use ONE norm spanning sparse + dense
+        grads (reference merges SelectedRows into the global norm)."""
+        paddle.seed(1)
+        emb = nn.Embedding(8, 4, sparse=True)
+        lin = nn.Linear(4, 2)
+        emb_d = nn.Embedding(8, 4, sparse=False)
+        lin_d = nn.Linear(4, 2)
+        emb_d.weight.set_value(paddle.to_tensor(emb.weight.numpy().copy()))
+        lin_d.weight.set_value(paddle.to_tensor(lin.weight.numpy().copy()))
+        lin_d.bias.set_value(paddle.to_tensor(lin.bias.numpy().copy()))
+        clip = nn.ClipGradByGlobalNorm(0.01)  # tiny: clip always active
+        o1 = paddle.optimizer.SGD(
+            learning_rate=1.0,
+            parameters=list(emb.parameters()) + list(lin.parameters()),
+            grad_clip=clip)
+        o2 = paddle.optimizer.SGD(
+            learning_rate=1.0,
+            parameters=list(emb_d.parameters()) + list(lin_d.parameters()),
+            grad_clip=nn.ClipGradByGlobalNorm(0.01))
+        ids = paddle.to_tensor(np.array([1, 5], np.int64))
+        for e, l, o in ((emb, lin, o1), (emb_d, lin_d, o2)):
+            (l(e(ids)) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        # sparse and dense runs must take the SAME (jointly-normed) step
+        np.testing.assert_allclose(emb.weight.numpy(), emb_d.weight.numpy(),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(lin.weight.numpy(), lin_d.weight.numpy(),
+                                   rtol=1e-5, atol=1e-7)
+
+
+class TestPaddingNotTouched:
+    def test_weight_decay_does_not_shrink_padding_row(self):
+        paddle.seed(0)
+        emb = nn.Embedding(10, 4, padding_idx=3, sparse=True)
+        opt = paddle.optimizer.AdamW(parameters=emb.parameters(),
+                                     learning_rate=0.1, weight_decay=0.5)
+        row0_before = emb.weight.numpy()[0].copy()
+        ids = paddle.to_tensor(np.array([3, 3, 7], np.int64))  # mostly pad
+        for _ in range(3):
+            emb(ids).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        w = emb.weight.numpy()
+        # row 0 was never looked up: weight decay must NOT have touched it
+        np.testing.assert_array_equal(w[0], row0_before)
+        # row 7 was looked up and did move
+        assert not np.allclose(w[7], 0.0)

@@ -1,0 +1,91 @@
+"""StringTensor + strings kernels + FasterTokenizer.
+
+~ reference phi strings kernels (strings_lower_upper_kernel.h) and
+test_faster_tokenizer_op.py: tokenization output must match the
+HuggingFace-style BERT basic+wordpiece algorithm on the same vocab.
+"""
+import numpy as np
+
+from paddle_tpu.text.strings import (BasicTokenizer, FasterTokenizer,
+                                     StringTensor, WordpieceTokenizer,
+                                     lower, to_string_tensor, upper)
+
+
+class TestStringTensor:
+    def test_basic(self):
+        st = to_string_tensor(["Hello", "World"])
+        assert st.shape == (2,)
+        assert st.tolist() == ["Hello", "World"]
+        assert st[0] == "Hello"
+        assert len(st) == 2
+
+    def test_lower_upper(self):
+        st = StringTensor(["HeLLo", "WöRLD", "Straße"])
+        assert lower(st).tolist() == ["hello", "wörld", "straße"]
+        assert upper(st).tolist() == ["HELLO", "WÖRLD", "STRASSE"]
+
+    def test_nd_shape(self):
+        st = StringTensor(np.array([["a", "B"], ["c", "D"]], object))
+        assert st.shape == (2, 2)
+        assert lower(st).tolist() == [["a", "b"], ["c", "d"]]
+
+
+class TestBasicTokenizer:
+    def test_whitespace_punct(self):
+        bt = BasicTokenizer(do_lower_case=True)
+        assert bt.tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+
+    def test_accent_stripping(self):
+        bt = BasicTokenizer(do_lower_case=True)
+        assert bt.tokenize("Héllo") == ["hello"]
+
+    def test_chinese_chars_split(self):
+        bt = BasicTokenizer()
+        assert bt.tokenize("你好ab") == ["你", "好", "ab"]
+
+
+class TestWordpiece:
+    def test_greedy_longest_match(self):
+        vocab = {"un": 0, "##aff": 1, "##able": 2, "[UNK]": 3, "aff": 4}
+        wp = WordpieceTokenizer(vocab)
+        assert wp.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert wp.tokenize("zzz") == ["[UNK]"]
+
+
+class TestFasterTokenizer:
+    VOCAB = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+             "hello": 4, "world": 5, "##s": 6, ",": 7, "!": 8, "good": 9}
+
+    def test_single_text(self):
+        tok = FasterTokenizer(self.VOCAB)
+        ids, types = tok(["Hello, Worlds!"])
+        # [CLS] hello , world ##s ! [SEP]
+        np.testing.assert_array_equal(ids[0], [2, 4, 7, 5, 6, 8, 3])
+        assert types.sum() == 0
+
+    def test_pair_and_padding(self):
+        tok = FasterTokenizer(self.VOCAB)
+        ids, types = tok(["hello"], text_pair=["good world"],
+                         max_seq_len=10, pad_to_max_seq_len=True)
+        assert ids.shape == (1, 10)
+        # [CLS] hello [SEP] good world [SEP] [PAD]...
+        np.testing.assert_array_equal(ids[0, :6], [2, 4, 3, 9, 5, 3])
+        np.testing.assert_array_equal(types[0, :6], [0, 0, 0, 1, 1, 1])
+        np.testing.assert_array_equal(ids[0, 6:], 0)
+
+    def test_batch_ragged_padding(self):
+        tok = FasterTokenizer(self.VOCAB)
+        ids, _ = tok(["hello", "hello world !"])
+        assert ids.shape[0] == 2
+        assert ids[0, -1] == 0  # short row padded
+
+    def test_truncation(self):
+        tok = FasterTokenizer(self.VOCAB)
+        ids, _ = tok(["hello world hello world hello"], max_seq_len=5)
+        assert ids.shape[1] == 5
+        assert ids[0, -1] == 3  # ends with [SEP]
+
+    def test_string_tensor_input(self):
+        tok = FasterTokenizer(self.VOCAB)
+        ids, _ = tok(to_string_tensor(["hello world"]))
+        np.testing.assert_array_equal(ids[0], [2, 4, 5, 3])

@@ -1,0 +1,196 @@
+"""BASELINE.md config-ladder benchmark driver.
+
+Runs each north-star config at a scale matched to the available backend
+and prints one JSON line per config:
+  1 LeNet/MNIST        -> trains to accuracy target (smoke)
+  2 ResNet-50          -> images/sec
+  3 BERT-base pretrain -> tokens/sec
+  4 Llama train step   -> MFU (delegates to bench.py's model/config)
+  5 MoE decoder        -> tokens/sec
+
+On CPU the model sizes shrink to keep the run under a few minutes while
+exercising the exact same code paths; on a real TPU chip the full-size
+configs run. Usage: python tools/ladder_bench.py [1 2 3 4 5]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _backend():
+    """Probe the accelerator in a throwaway SUBPROCESS (the axon TPU
+    plugin ignores JAX_PLATFORMS env and can hang in-process init —
+    bench.py's _probe_tpu lesson); pin CPU unless the probe succeeds."""
+    import subprocess
+    import jax
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=120)
+        plat = r.stdout.strip()
+        if r.returncode == 0 and plat and plat != "cpu":
+            return plat
+    except subprocess.TimeoutExpired:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
+def bench_lenet():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    # synthetic MNIST-shaped task (dataset download is offline):
+    # class-template images + noise — digit-recognition difficulty class
+    templates = rng.normal(0, 1, (10, 1, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, 512)
+    X = (templates[y]
+         + 0.3 * rng.normal(0, 1, (512, 1, 28, 28))).astype(np.float32)
+    for epoch in range(3):
+        for i in range(0, 512, 64):
+            xb = paddle.to_tensor(X[i:i + 64])
+            yb = paddle.to_tensor(y[i:i + 64].astype(np.int64))
+            loss = paddle.nn.functional.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+    model.eval()
+    pred = np.argmax(model(paddle.to_tensor(X)).numpy(), 1)
+    acc = float((pred == y).mean())
+    return {"metric": "lenet_train_acc", "value": round(acc, 4),
+            "unit": "accuracy", "target": 0.9}
+
+
+def bench_resnet50(on_tpu):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    model.eval()
+    B, HW = (32, 224) if on_tpu else (4, 64)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        0, 1, (B, 3, HW, HW)).astype(np.float32))
+
+    from paddle_tpu.jit import to_static
+    fwd = to_static(model.forward)
+    out = fwd(x)
+    jax.block_until_ready(out._value)
+    t0 = time.perf_counter()
+    n = 10 if on_tpu else 3
+    for _ in range(n):
+        out = fwd(x)
+    jax.block_until_ready(out._value)
+    dt = (time.perf_counter() - t0) / n
+    return {"metric": "resnet50_fwd_images_per_sec",
+            "value": round(B / dt, 1), "unit": "images/sec",
+            "batch": B, "hw": HW}
+
+
+def bench_bert(on_tpu):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import (BertConfig, BertForPretraining,
+                                       bert_pretrain_step_factory)
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig()  # base
+        B, S, steps = 16, 512, 10
+    else:
+        cfg = BertConfig.tiny()
+        B, S, steps = 4, 32, 3
+    model = BertForPretraining(cfg)
+    model.eval()
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    params, opt, step = bert_pretrain_step_factory(model, mesh)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    types = jnp.zeros((B, S), jnp.int32)
+    mlm = jnp.asarray(np.where(rng.random((B, S)) < 0.15,
+                               rng.integers(0, cfg.vocab_size, (B, S)),
+                               -100), jnp.int32)
+    nsp = jnp.asarray(rng.integers(0, 2, (B,)), jnp.int32)
+    params, opt, loss = step(params, opt, ids, types, mlm, nsp)  # compile
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, ids, types, mlm, nsp)
+    lv = float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    return {"metric": "bert_pretrain_tokens_per_sec",
+            "value": round(B * S / dt, 1), "unit": "tokens/sec",
+            "loss": round(lv, 4), "batch": B, "seq": S}
+
+
+def bench_moe(on_tpu):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.nlp import MoEConfig, MoEForCausalLM
+
+    paddle.seed(0)
+    cfg = MoEConfig.tiny()
+    model = MoEForCausalLM(cfg)
+    model.eval()
+    params = {k: v._value for k, v in model.state_dict().items()}
+
+    def fwd(params, tokens):
+        model.load_tree(params)
+        return model(Tensor(tokens))._value
+
+    B, S = (8, 256) if on_tpu else (2, 16)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    jit_fwd = jax.jit(fwd)
+    jax.block_until_ready(jit_fwd(params, tokens))
+    n = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = jit_fwd(params, tokens)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    return {"metric": "moe_fwd_tokens_per_sec",
+            "value": round(B * S / dt, 1), "unit": "tokens/sec"}
+
+
+def main():
+    want = set(sys.argv[1:]) or {"1", "2", "3", "5"}
+    backend = _backend()
+    on_tpu = backend != "cpu"
+    runners = {"1": bench_lenet,
+               "2": lambda: bench_resnet50(on_tpu),
+               "3": lambda: bench_bert(on_tpu),
+               "5": lambda: bench_moe(on_tpu)}
+    if "4" in want:
+        print(json.dumps({"metric": "llama_train_mfu",
+                          "note": "run bench.py (the driver entry)"}))
+    for k in sorted(want & set(runners)):
+        try:
+            res = runners[k]() if k != "1" else runners[k]()
+            res["config"] = int(k)
+            res["backend"] = backend
+            print(json.dumps(res))
+        except Exception as e:  # noqa: BLE001 — ladder keeps going
+            print(json.dumps({"config": int(k), "error": repr(e)[-400:]}))
+
+
+if __name__ == "__main__":
+    main()
