@@ -1,0 +1,180 @@
+// Shared-memory record ring for multiprocess DataLoader workers.
+//
+// ~ the reference's shared-memory LoDTensor transport between DataLoader
+// worker processes and the trainer (python/paddle/fluid/dataloader/
+// dataloader_iter.py:542 riding memory/allocation/mmap_allocator.h): worker
+// processes serialize batches into a POSIX shm segment instead of piping
+// bytes through multiprocessing queues.
+//
+// Layout: header { write_ticket, read_ticket, n_slots, slot_size } followed
+// by n_slots slots of { seq, size, payload[slot_size] }. Vyukov-style
+// bounded MPSC: producers atomically take a write ticket, wait for their
+// slot to drain, memcpy, then publish by setting slot.seq = ticket + 1.
+// The single consumer takes read tickets in order, so records arrive
+// ticket-ordered even with racing producers.
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+  std::atomic<uint64_t> write_ticket;
+  std::atomic<uint64_t> read_ticket;
+  uint64_t n_slots;
+  uint64_t slot_size;
+};
+
+struct Slot {
+  std::atomic<uint64_t> seq;  // published when seq == ticket + 1
+  uint64_t size;
+  // payload follows
+};
+
+struct Ring {
+  Header* hdr;
+  char* base;
+  size_t total;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+inline Slot* slot_at(Ring* r, uint64_t idx) {
+  size_t stride = sizeof(Slot) + r->hdr->slot_size;
+  return reinterpret_cast<Slot*>(r->base + sizeof(Header) + idx * stride);
+}
+
+inline void backoff(unsigned n) {
+  struct timespec ts {0, n < 16 ? 1000L : 100000L};  // 1us then 100us
+  nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, int64_t slot_size, int64_t n_slots) {
+  slot_size = (slot_size + 7) & ~int64_t(7);  // keep Slot atomics aligned
+  size_t total = sizeof(Header) +
+                 (sizeof(Slot) + (size_t)slot_size) * (size_t)n_slots;
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = reinterpret_cast<Header*>(mem);
+  r->base = reinterpret_cast<char*>(mem);
+  r->total = total;
+  r->fd = fd;
+  r->owner = true;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  r->hdr->write_ticket.store(0);
+  r->hdr->read_ticket.store(0);
+  r->hdr->n_slots = (uint64_t)n_slots;
+  r->hdr->slot_size = (uint64_t)slot_size;
+  for (int64_t i = 0; i < n_slots; ++i) {
+    slot_at(r, (uint64_t)i)->seq.store((uint64_t)i);  // "empty for turn 0"
+  }
+  return r;
+}
+
+void* shm_ring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = reinterpret_cast<Header*>(mem);
+  r->base = reinterpret_cast<char*>(mem);
+  r->total = (size_t)st.st_size;
+  r->fd = fd;
+  r->owner = false;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+int64_t shm_ring_slot_size(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  return (int64_t)r->hdr->slot_size;
+}
+
+// Producer: claim a ticket, wait for the slot, copy, publish.
+// Returns the ticket (>=0) or -1 if payload exceeds slot_size.
+int64_t shm_ring_write(void* handle, const void* buf, int64_t n) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  if ((uint64_t)n > r->hdr->slot_size) return -1;
+  uint64_t ticket = r->hdr->write_ticket.fetch_add(1);
+  uint64_t ns = r->hdr->n_slots;
+  Slot* s = slot_at(r, ticket % ns);
+  // wait until the slot's previous occupant (ticket - n_slots) was consumed:
+  // consumer sets seq = old_ticket + n_slots after reading
+  for (unsigned spin = 0; s->seq.load(std::memory_order_acquire) != ticket;
+       ++spin) {
+    backoff(spin);
+  }
+  s->size = (uint64_t)n;
+  memcpy(reinterpret_cast<char*>(s) + sizeof(Slot), buf, (size_t)n);
+  s->seq.store(ticket + 1, std::memory_order_release);  // published
+  return (int64_t)ticket;
+}
+
+// Consumer: read the next record in ticket order into buf (cap bytes).
+// Returns bytes read (0 = legitimately empty record), -2 on timeout
+// (timeout_us), -1 if cap too small.
+int64_t shm_ring_read(void* handle, void* buf, int64_t cap,
+                      int64_t timeout_us) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  uint64_t ticket = r->hdr->read_ticket.load();
+  uint64_t ns = r->hdr->n_slots;
+  Slot* s = slot_at(r, ticket % ns);
+  int64_t waited = 0;
+  for (unsigned spin = 0;
+       s->seq.load(std::memory_order_acquire) != ticket + 1; ++spin) {
+    if (timeout_us >= 0 && waited > timeout_us) return -2;
+    backoff(spin);
+    waited += spin < 16 ? 1 : 100;
+  }
+  int64_t n = (int64_t)s->size;
+  if (n > cap) return -1;
+  memcpy(buf, reinterpret_cast<char*>(s) + sizeof(Slot), (size_t)n);
+  s->seq.store(ticket + ns, std::memory_order_release);  // slot drained
+  r->hdr->read_ticket.store(ticket + 1);
+  return n;
+}
+
+void shm_ring_close(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  munmap(r->base, r->total);
+  close(r->fd);
+  if (r->owner) shm_unlink(r->name);
+  delete r;
+}
+
+}  // extern "C"

@@ -10,6 +10,7 @@ idiomatic design.
 """
 from __future__ import annotations
 
+import os
 import itertools
 import queue
 import threading
@@ -372,6 +373,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -405,7 +408,42 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_sync()
+        if self.use_shared_memory and not self._holds_device_arrays():
+            # worker PROCESSES over the native shm ring (the reference's
+            # multiprocess+shared-memory mode); threads otherwise
+            from ..utils import native
+            if native.available() and hasattr(os, "fork"):
+                from .shm_channel import MultiprocessDataLoaderIter
+                return MultiprocessDataLoaderIter(self)
         return _DataLoaderIter(self)
+
+    def _holds_device_arrays(self) -> bool:
+        """Forked workers must never touch XLA state (jax is multithreaded;
+        fork + device access can deadlock). Recurse through wrapper
+        datasets and probe one sample: anything yielding live device
+        arrays stays on the thread path."""
+        import jax
+
+        def ds_has_tensors(ds) -> bool:
+            if isinstance(ds, TensorDataset):
+                return True
+            if isinstance(ds, Subset):
+                return ds_has_tensors(ds.dataset)
+            if isinstance(ds, (ConcatDataset, ComposeDataset)):
+                return any(ds_has_tensors(d) for d in ds.datasets)
+            return False
+
+        if ds_has_tensors(self.dataset):
+            return True
+        try:  # probe one sample's tree for device arrays
+            sample = self.dataset[0]
+        except Exception:  # noqa: BLE001 — leave it to the worker to fail
+            return False
+        leaves = jax.tree.leaves(
+            sample, is_leaf=lambda x: isinstance(x, (Tensor, jax.Array)))
+        return any(isinstance(v, (Tensor, jax.Array))
+                   or isinstance(getattr(v, "_value", None), jax.Array)
+                   for v in leaves)
 
     def _iter_sync(self):
         for idx_batch in self._index_iter():

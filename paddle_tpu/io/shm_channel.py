@@ -1,0 +1,202 @@
+"""Shared-memory record channel + multiprocess DataLoader workers.
+
+~ the reference's multiprocess DataLoader transport
+(fluid/dataloader/dataloader_iter.py:341 _DataLoaderIterMultiProcess,
+`_worker_loop` :402, shared-memory LoDTensor handoff :542-546 over
+memory/allocation/mmap_allocator.h): worker PROCESSES (not threads — real
+CPU parallelism for python-heavy datasets) fetch and serialize batches
+into the native shm ring (csrc/shm_ring.cc); the parent deserializes in
+ticket order. Falls back to multiprocessing queues when the native lib is
+unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import Optional
+
+from ..utils import native
+
+
+class ShmRing:
+    """ctypes view over one csrc/shm_ring.cc segment."""
+
+    def __init__(self, name: str, slot_size: int = 1 << 20,
+                 n_slots: int = 8, create: bool = False):
+        lib = native.get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.name = name.encode()
+        if create:
+            self._h = lib.shm_ring_create(self.name, slot_size, n_slots)
+        else:
+            self._h = lib.shm_ring_open(self.name)
+        if not self._h:
+            raise OSError(f"shm_ring {'create' if create else 'open'} "
+                          f"failed for {name}")
+        self.slot_size = lib.shm_ring_slot_size(self._h)
+        self._buf = ctypes.create_string_buffer(self.slot_size)
+
+    def write(self, payload: bytes) -> int:
+        r = self._lib.shm_ring_write(self._h, payload, len(payload))
+        if r < 0:
+            raise ValueError(
+                f"record of {len(payload)} bytes exceeds slot_size "
+                f"{self.slot_size}; construct the ring with a larger "
+                "slot_size")
+        return r
+
+    def read(self, timeout_us: int = -1) -> Optional[bytes]:
+        """Next record in ticket order; None on timeout. b'' is a valid
+        (empty) record, distinct from timeout (C side returns -2)."""
+        n = self._lib.shm_ring_read(self._h, self._buf, self.slot_size,
+                                    timeout_us)
+        if n == -2:
+            return None
+        if n == -1:
+            raise ValueError("shm_ring record larger than reader buffer")
+        return self._buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+_STOP_WORKER = b"__stop__"
+
+
+def _mp_worker_loop(loader, work_q, ring_name, err_q, worker_id,
+                    worker_init_fn):
+    """Worker process body (~ dataloader_iter.py _worker_loop:402). Every
+    failure mode reports to err_q — the parent must never have to guess
+    from a timeout."""
+    try:
+        ring = ShmRing(ring_name, create=False)
+    except (OSError, RuntimeError) as e:
+        err_q.put((worker_id, repr(e)))
+        return
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        while True:
+            item = work_q.get()
+            if item is None:
+                ring.write(pickle.dumps(("done", worker_id, None)))
+                return
+            seq, idx_batch = item
+            try:
+                data = loader._fetch(idx_batch)
+                blob = pickle.dumps(("ok", seq, data), protocol=4)
+            except Exception as e:  # noqa: BLE001 — shipped to parent
+                blob = pickle.dumps(("err", seq, repr(e)), protocol=4)
+            ring.write(blob)
+    except Exception as e:  # noqa: BLE001 — init fn / oversize record
+        err_q.put((worker_id, repr(e)))
+    finally:
+        ring.close()
+
+
+class MultiprocessDataLoaderIter:
+    """Parent-side iterator over N worker processes + one shm ring."""
+
+    def __init__(self, loader, slot_size: int = 4 << 20):
+        import multiprocessing as mp
+        self.loader = loader
+        nw = max(1, loader.num_workers)
+        self._ring_name = f"/pt_dl_{os.getpid()}_{id(self)}"
+        self._ring = ShmRing(self._ring_name, slot_size=slot_size,
+                             n_slots=max(4, 2 * nw), create=True)
+        import threading
+        ctx = mp.get_context("fork")  # workers touch only dataset + numpy
+        # bounded: a feeder thread streams index batches with backpressure
+        # (the thread path's _feed pattern) instead of materializing the
+        # whole epoch's indices in the queue
+        self._work_q = ctx.Queue(maxsize=nw * 2)
+        self._err_q = ctx.Queue()
+        self._procs = []
+        for w in range(nw):
+            p = ctx.Process(target=_mp_worker_loop,
+                            args=(loader, self._work_q, self._ring_name,
+                                  self._err_q, w, loader.worker_init_fn),
+                            daemon=True)
+            p.start()
+            self._procs.append(p)
+        self._total = len(loader.batch_sampler)
+        self._feeder = threading.Thread(target=self._feed, daemon=True)
+        self._feeder.start()
+        self._done_workers = 0
+        self._next_seq = 0
+        self._stash = {}
+
+    def _feed(self):
+        for seq, idx_batch in enumerate(self.loader._index_iter()):
+            self._work_q.put((seq, list(idx_batch)))
+        for _ in self._procs:
+            self._work_q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._next_seq in self._stash:
+                data = self._stash.pop(self._next_seq)
+                self._next_seq += 1
+                return self.loader._to_tensors(data)
+            if self._next_seq >= self._total:
+                self._shutdown()
+                raise StopIteration
+            blob = None
+            for _ in range(30):  # 1s slices: react to errors fast
+                blob = self._ring.read(timeout_us=1_000_000)
+                if blob is not None:
+                    break
+                self._check_errors()  # raises the reported cause
+                if any(not p.is_alive() and p.exitcode not in (0, None)
+                       for p in self._procs):
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker died without reporting "
+                        f"(exitcodes {[p.exitcode for p in self._procs]})")
+            if blob is None:
+                self._shutdown()
+                raise TimeoutError("DataLoader workers stalled (30s)")
+            kind, seq, data = pickle.loads(blob)
+            if kind == "done":
+                self._done_workers += 1
+                continue
+            if kind == "err":
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed: {data}")
+            self._stash[seq] = data
+
+    def _check_errors(self):
+        try:
+            wid, err = self._err_q.get_nowait()
+        except Exception:  # noqa: BLE001 — queue empty
+            return
+        self._shutdown()
+        raise RuntimeError(f"DataLoader worker {wid} failed to start: {err}")
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        self._ring.close()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:  # noqa: BLE001
+            pass

@@ -40,10 +40,29 @@ def get_lib() -> Optional[ctypes.CDLL]:
             return None
     if not os.path.exists(path):
         return None
+    lib = _load_and_bind(path)
+    if lib is None and _build():
+        # a stale prebuilt .so missing newer symbols: rebuild once
+        lib = _load_and_bind(path)
+    _lib = lib
+    return _lib
+
+
+def _load_and_bind(path: str) -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(path)
     except OSError:
         return None
+    try:
+        _bind_signatures(lib)
+    except AttributeError:
+        # missing symbol (stale build) — caller may rebuild; contract is
+        # "None means pure-python fallback", never an exception
+        return None
+    return lib
+
+
+def _bind_signatures(lib: ctypes.CDLL) -> None:
     # signatures
     lib.tcpstore_server_start.restype = ctypes.c_void_p
     lib.tcpstore_server_start.argtypes = [ctypes.c_int]
@@ -75,8 +94,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.bl_next.restype = ctypes.c_int64
     lib.bl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.bl_destroy.argtypes = [ctypes.c_void_p]
-    _lib = lib
-    return _lib
+    lib.shm_ring_create.restype = ctypes.c_void_p
+    lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64]
+    lib.shm_ring_open.restype = ctypes.c_void_p
+    lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+    lib.shm_ring_slot_size.restype = ctypes.c_int64
+    lib.shm_ring_slot_size.argtypes = [ctypes.c_void_p]
+    lib.shm_ring_write.restype = ctypes.c_int64
+    lib.shm_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int64]
+    lib.shm_ring_read.restype = ctypes.c_int64
+    lib.shm_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_int64, ctypes.c_int64]
+    lib.shm_ring_close.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:

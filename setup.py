@@ -22,7 +22,8 @@ from setuptools.command.build_py import build_py
 
 ROOT = os.path.abspath(os.path.dirname(__file__))
 CSRC = os.path.join(ROOT, "csrc")
-SOURCES = ["tcp_store.cc", "batch_loader.cc", "span_collector.cc"]
+SOURCES = ["tcp_store.cc", "batch_loader.cc", "span_collector.cc",
+           "shm_ring.cc"]
 LIB_RELPATH = os.path.join("paddle_tpu", "lib", "libpaddle_tpu_native.so")
 
 
@@ -34,7 +35,8 @@ def compile_native(out_path: str) -> bool:
     if not all(os.path.exists(s) for s in srcs):
         return False
     cflags = ["-O2", "-fPIC", "-std=c++17", "-pthread", "-Wall", "-shared"]
-    cmd = [cxx, *cflags, "-o", out_path, *srcs]
+    # -lrt: shm_open lives in librt on glibc < 2.34 (stub on newer)
+    cmd = [cxx, *cflags, "-o", out_path, *srcs, "-lrt"]
     try:
         subprocess.run(cmd, check=True, timeout=300)
         return True
