@@ -87,3 +87,5 @@ def gloo_release():
 from . import fleet_executor  # noqa: F401
 from .fleet_executor import DistModel, DistModelConfig, FleetExecutor  # noqa
 from . import passes  # noqa: F401
+
+from . import metric  # noqa: F401,E402

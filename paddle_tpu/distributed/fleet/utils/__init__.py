@@ -1,3 +1,10 @@
 from . import fs, recompute  # noqa: F401
 from .fs import FS, HDFSClient, LocalFS  # noqa: F401
 from .recompute import recompute as recompute_fn  # noqa: F401
+from . import internal_storage  # noqa: F401,E402
+from .internal_storage import (GradStorage,  # noqa: F401,E402
+                               ParamStorage, TensorBucket,
+                               fused_all_reduce)
+from . import hybrid_parallel_inference  # noqa: F401,E402
+from .hybrid_parallel_inference import (  # noqa: F401,E402
+    HybridParallelInferenceHelper)

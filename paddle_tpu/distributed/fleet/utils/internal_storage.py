@@ -1,0 +1,99 @@
+"""Fused flat buffers for parameters/gradients.
+
+~ fleet/meta_parallel/sharding/group_sharded_storage.py (ParamStorage /
+GradStorage: one contiguous buffer per rank+dtype that many tensors view
+into, so comm ops run once per bucket instead of once per tensor). The
+TPU form packs with concatenate/split — XLA turns the pack-allreduce-
+unpack into a single fused collective over the bucket.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TensorBucket:
+    """One dtype-homogeneous bucket of tensors with a flat fused form."""
+
+    def __init__(self, dtype):
+        self.dtype = jnp.dtype(dtype)
+        self._shapes: List[Tuple[int, ...]] = []
+        self._sizes: List[int] = []
+        self.tensors: List = []
+
+    def add(self, value) -> int:
+        """Register one array; returns its slot index."""
+        self._shapes.append(tuple(value.shape))
+        self._sizes.append(int(np.prod(value.shape)) if value.ndim else 1)
+        self.tensors.append(value)
+        return len(self.tensors) - 1
+
+    @property
+    def numel(self) -> int:
+        return sum(self._sizes)
+
+    def pack(self) -> jnp.ndarray:
+        """Flatten all registered arrays into one contiguous buffer."""
+        return jnp.concatenate(
+            [jnp.ravel(t).astype(self.dtype) for t in self.tensors])
+
+    def unpack(self, flat) -> List[jnp.ndarray]:
+        """Split a fused buffer back into the registered shapes."""
+        out = []
+        off = 0
+        for shape, size in zip(self._shapes, self._sizes):
+            out.append(jnp.reshape(flat[off:off + size], shape))
+            off += size
+        return out
+
+
+class GradStorage:
+    """~ group_sharded_storage.py GradStorage: bucket gradients by dtype
+    under a byte budget; comm runs per bucket."""
+
+    def __init__(self, max_bucket_bytes: int = 25 * 1024 * 1024):
+        self.max_bucket_bytes = max_bucket_bytes
+        self.buckets: List[TensorBucket] = []
+
+    def build(self, grads: List) -> List[TensorBucket]:
+        by_dtype: Dict = {}
+        for g in grads:
+            key = jnp.dtype(g.dtype)
+            cur = by_dtype.get(key)
+            nbytes = int(np.prod(g.shape)) * key.itemsize
+            if cur is None or cur._bytes + nbytes > self.max_bucket_bytes:
+                cur = TensorBucket(key)
+                cur._bytes = 0
+                by_dtype[key] = cur
+                self.buckets.append(cur)
+            cur.add(g)
+            cur._bytes += nbytes
+        return self.buckets
+
+
+ParamStorage = GradStorage  # same mechanics; kept for API parity
+
+
+def fused_all_reduce(grads: List, all_reduce_fn,
+                     max_bucket_bytes: int = 25 * 1024 * 1024) -> List:
+    """All-reduce ``grads`` in fused dtype buckets
+    (~ Reducer::FusedAllReduceSchedule, imperative/reducer.h:153).
+
+    all_reduce_fn: flat_array -> flat_array (the collective).
+    Returns the reduced grads in the original order.
+    """
+    storage = GradStorage(max_bucket_bytes)
+    buckets = storage.build(grads)
+    slot_of = {}
+    for bi, b in enumerate(buckets):
+        for ti, t in enumerate(b.tensors):
+            slot_of[id(t)] = (bi, ti)
+    reduced_per_bucket = []
+    for b in buckets:
+        flat = b.pack()
+        flat = all_reduce_fn(flat)
+        reduced_per_bucket.append(b.unpack(flat))
+    return [reduced_per_bucket[slot_of[id(g)][0]][slot_of[id(g)][1]]
+            for g in grads]

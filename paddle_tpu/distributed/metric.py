@@ -1,0 +1,88 @@
+"""Distributed metrics (PS-mode global metric aggregation).
+
+~ python/paddle/distributed/metric/metrics.py (init_metric :26,
+print_metric :98, print_auc :116 — metrics accumulated in distributed
+table memory and reduced across trainers). TPU-native: local metric
+state (AUC buckets, counts) lives in numpy; `all-reduce` across workers
+rides the eager collective API when multi-process, identity otherwise.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["DistributedAuc", "init_metric", "print_metric", "print_auc"]
+
+_registry: Dict[str, "DistributedAuc"] = {}
+
+
+class DistributedAuc:
+    """Bucketed global AUC (~ the reference's distributed AUC table:
+    positive/negative histograms over prediction buckets, merged across
+    workers before the trapezoid integration)."""
+
+    def __init__(self, n_buckets: int = 2 ** 12):
+        self.n_buckets = n_buckets
+        self._pos = np.zeros(n_buckets, np.float64)
+        self._neg = np.zeros(n_buckets, np.float64)
+
+    def update(self, preds, labels):
+        preds = np.clip(np.asarray(preds, np.float64).reshape(-1), 0, 1)
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.minimum((preds * self.n_buckets).astype(np.int64),
+                         self.n_buckets - 1)
+        np.add.at(self._pos, idx[labels == 1], 1)
+        np.add.at(self._neg, idx[labels == 0], 1)
+
+    def _merged(self):
+        """All-reduce the histograms across workers when distributed."""
+        from . import collective as C
+        if C._multi_process():
+            from ..core.tensor import Tensor
+            import jax.numpy as jnp
+            buf = Tensor(jnp.asarray(np.stack([self._pos, self._neg])
+                                     .astype(np.float32)))
+            C.all_reduce(buf)
+            merged = np.asarray(buf.numpy(), np.float64)
+            return merged[0], merged[1]
+        return self._pos, self._neg
+
+    def value(self) -> float:
+        pos, neg = self._merged()
+        # integrate from the highest bucket down (descending threshold)
+        tp = np.cumsum(pos[::-1])
+        fp = np.cumsum(neg[::-1])
+        P, N = tp[-1], fp[-1]
+        if P == 0 or N == 0:
+            return 0.5
+        tpr = np.concatenate([[0.0], tp / P])
+        fpr = np.concatenate([[0.0], fp / N])
+        return float(np.trapezoid(tpr, fpr))
+
+    def reset(self):
+        self._pos[:] = 0
+        self._neg[:] = 0
+
+
+def init_metric(metric_ptr=None, name: str = "auc", method: str = "auc",
+                n_buckets: int = 2 ** 12, **kw) -> DistributedAuc:
+    m = DistributedAuc(n_buckets)
+    _registry[name] = m
+    return m
+
+
+def get_metric(name: str = "auc") -> Optional[DistributedAuc]:
+    return _registry.get(name)
+
+
+def print_metric(metric_ptr=None, name: str = "auc") -> str:
+    m = _registry.get(name)
+    msg = f"{name}: {m.value():.6f}" if m else f"{name}: <uninitialized>"
+    print(msg)
+    return msg
+
+
+def print_auc(metric_ptr=None, is_day: bool = False,
+              phase: str = "all") -> str:
+    return print_metric(name="auc")

@@ -23,6 +23,7 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.group = group
+        self.comm_buffer_size = comm_buffer_size
         self.find_unused_parameters = find_unused_parameters
         self._grad_sync_enabled = True
         # mark param sharding: replicated across "data" axis (GSPMD)
@@ -68,14 +69,60 @@ class DataParallel(Layer):
 
     @no_grad()
     def apply_collective_grads(self):
-        """Eager DP grad averaging (~ Reducer::FusedAllReduceSchedule)."""
+        """Eager DP grad averaging in fused buckets
+        (~ Reducer::FusedAllReduceSchedule with comm_buffer_size_MB
+        grouping — one collective per dtype bucket, not per tensor)."""
         world = C.get_world_size(self.group)
         if world <= 1 or not C._multi_process():
             return
-        for p in self._layers.parameters():
-            if p._grad is not None:
-                C.all_reduce(p._grad, group=self.group)
-                p._grad._value = p._grad._value / world
+        from ..core.selected_rows import SelectedRows
+        from .fleet.utils.internal_storage import fused_all_reduce
+        from ..core.tensor import Tensor
+        sparse = [p for p in self._layers.parameters()
+                  if isinstance(p._grad, SelectedRows)]
+        params = [p for p in self._layers.parameters()
+                  if p._grad is not None
+                  and not isinstance(p._grad, SelectedRows)]
+        for p in sparse:
+            p._grad = self._sync_selected_rows(p._grad, world)
+        if not params:
+            return
+
+        def collective(flat):
+            t = Tensor(flat)
+            C.all_reduce(t, group=self.group)
+            return t._value / world
+
+        reduced = fused_all_reduce([p._grad._value for p in params],
+                                   collective,
+                                   self.comm_buffer_size * 1024 * 1024)
+        for p, g in zip(params, reduced):
+            p._grad._value = g.astype(p._grad._value.dtype)
+
+    def _sync_selected_rows(self, sr, world):
+        """Average a SelectedRows grad across DP ranks (~ the reference
+        Reducer's sparse allreduce: allgather rows+values, concatenate).
+        Row counts differ per rank, so pad to the global max first."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core.selected_rows import SelectedRows
+        from ..core.tensor import Tensor
+        merged = sr.merge()
+        n = merged.rows.shape[0]
+        cnt = Tensor(jnp.asarray([n], jnp.int32))
+        C.all_reduce(cnt, op=C.ReduceOp.MAX, group=self.group)
+        n_max = int(np.asarray(cnt.numpy())[0])
+        pad = n_max - n
+        # pad with row 0 / zero values: contributes nothing after merge
+        rows = jnp.pad(merged.rows, (0, pad))
+        vals = jnp.pad(merged.values, ((0, pad), (0, 0)))
+        rows_l, vals_l = [], []
+        C.all_gather(rows_l, Tensor(rows), group=self.group)
+        C.all_gather(vals_l, Tensor(vals), group=self.group)
+        g_rows = jnp.concatenate([t._value for t in rows_l])
+        g_vals = jnp.concatenate([t._value for t in vals_l])
+        return SelectedRows(g_rows, g_vals / world, sr.height)
 
     # delegate the Layer surface to the wrapped model
     def parameters(self, include_sublayers=True):

@@ -8,6 +8,7 @@ from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import autotune  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .graph_ops import (  # noqa: F401
     graph_khop_sampler, graph_reindex, graph_sample_neighbors,

@@ -1,0 +1,112 @@
+"""Tensor sharing across processes.
+
+~ python/paddle/incubate/multiprocessing (reductions.py:104
+reduce_tensor): registers a ForkingPickler reduction for Tensor so
+tensors crossing multiprocessing queues/pipes travel as shared-memory
+segments instead of pickled byte copies. TPU-native shape: device arrays
+are host-materialized once into a multiprocessing.shared_memory block
+(the file-descriptor LoDTensor IPC of the reference); the receiver maps
+the block zero-copy as numpy and re-wraps. An LRU keeps segments alive in
+the producer until the consumer has had a chance to map them.
+
+Use ``multiprocessing.get_context("spawn")`` for the worker processes: a
+forked child of a jax-active parent deadlocks on first device access
+(XLA's threads don't survive fork), while spawn starts a clean
+interpreter — the same constraint the reference documents for CUDA
+tensors.
+"""
+from __future__ import annotations
+
+import atexit
+from collections import OrderedDict
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["init_reductions", "reduce_tensor", "rebuild_tensor",
+           "allocate_shared", "LRUSharedCache"]
+
+
+class LRUSharedCache(OrderedDict):
+    """~ reductions.py:49 — bounded cache pinning shm segments in the
+    producer so they outlive the pickle round trip."""
+
+    LIMIT = 128
+
+    def put(self, key, shm):
+        self[key] = shm
+        self.move_to_end(key)
+        while len(self) > self.LIMIT:
+            _k, old = self.popitem(last=False)
+            try:
+                old.close()
+                old.unlink()
+            except FileNotFoundError:
+                pass
+
+
+_producer_cache = LRUSharedCache()
+
+
+@atexit.register
+def _cleanup():
+    for shm in _producer_cache.values():
+        try:
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    _producer_cache.clear()
+
+
+def allocate_shared(arr: np.ndarray):
+    """Copy ``arr`` into a fresh shared-memory block; returns (shm, view)."""
+    arr = np.ascontiguousarray(arr)
+    shm = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    view = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+    view[...] = arr
+    return shm, view
+
+
+def rebuild_tensor(shm_name, shape, dtype_str, stop_gradient):
+    """Consumer side: map the segment and wrap (~ rebuild_tensor :87)."""
+    shm = shared_memory.SharedMemory(name=shm_name)
+    arr = np.ndarray(shape, np.dtype(dtype_str), buffer=shm.buf)
+    # copy out: the producer's LRU may unlink the segment later, and jax
+    # will anyway copy host->device on first use
+    t = Tensor(np.array(arr), stop_gradient=stop_gradient)
+    shm.close()
+    # ownership stays with the producer: detach from this process's
+    # resource tracker so it doesn't double-unlink at exit
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # noqa: BLE001 — tracker API is CPython-internal
+        pass
+    return t
+
+
+def reduce_tensor(t: Tensor):
+    """Producer side (~ reduce_tensor :104): host-materialize once, ship
+    the segment name + metadata."""
+    arr = np.asarray(t._value)
+    shm, _ = allocate_shared(arr)
+    _producer_cache.put(shm.name, shm)
+    return (rebuild_tensor,
+            (shm.name, tuple(arr.shape), arr.dtype.str, t.stop_gradient))
+
+
+_initialized = False
+
+
+def init_reductions():
+    """Register the Tensor reduction with ForkingPickler
+    (~ reductions.py init_reductions). Idempotent."""
+    global _initialized
+    if _initialized:
+        return
+    ForkingPickler.register(Tensor, reduce_tensor)
+    _initialized = True

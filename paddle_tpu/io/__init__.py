@@ -421,7 +421,17 @@ class DataLoader:
         """Forked workers must never touch XLA state (jax is multithreaded;
         fork + device access can deadlock). Recurse through wrapper
         datasets and probe one sample: anything yielding live device
-        arrays stays on the thread path."""
+        arrays stays on the thread path. Cached — the probe costs one
+        __getitem__ (and possibly an RNG draw), so it must not repeat
+        every epoch."""
+        cached = getattr(self, "_fork_safe_cache", None)
+        if cached is not None:
+            return cached
+        result = self._probe_device_arrays()
+        self._fork_safe_cache = result
+        return result
+
+    def _probe_device_arrays(self) -> bool:
         import jax
 
         def ds_has_tensors(ds) -> bool:

@@ -57,7 +57,8 @@ class ShmRing:
             return None
         if n == -1:
             raise ValueError("shm_ring record larger than reader buffer")
-        return self._buf.raw[:n]
+        # copy exactly n bytes (ctypes .raw would copy the whole slot)
+        return ctypes.string_at(self._buf, n)
 
     def close(self):
         if self._h:
@@ -131,6 +132,7 @@ class MultiprocessDataLoaderIter:
             p.start()
             self._procs.append(p)
         self._total = len(loader.batch_sampler)
+        self._stopping = threading.Event()
         self._feeder = threading.Thread(target=self._feed, daemon=True)
         self._feeder.start()
         self._done_workers = 0
@@ -138,10 +140,27 @@ class MultiprocessDataLoaderIter:
         self._stash = {}
 
     def _feed(self):
-        for seq, idx_batch in enumerate(self.loader._index_iter()):
-            self._work_q.put((seq, list(idx_batch)))
-        for _ in self._procs:
-            self._work_q.put(None)
+        import queue as _q
+        try:
+            for seq, idx_batch in enumerate(self.loader._index_iter()):
+                while True:  # bounded put that honors shutdown
+                    if self._stopping.is_set():
+                        return
+                    try:
+                        self._work_q.put((seq, list(idx_batch)),
+                                         timeout=0.2)
+                        break
+                    except _q.Full:
+                        continue
+            for _ in self._procs:
+                while not self._stopping.is_set():
+                    try:
+                        self._work_q.put(None, timeout=0.2)
+                        break
+                    except _q.Full:
+                        continue
+        except (OSError, ValueError):
+            pass  # queue torn down under us during shutdown
 
     def __iter__(self):
         return self
@@ -188,11 +207,14 @@ class MultiprocessDataLoaderIter:
         raise RuntimeError(f"DataLoader worker {wid} failed to start: {err}")
 
     def _shutdown(self):
+        self._stopping.set()  # unblock the feeder's bounded puts
         for p in self._procs:
             if p.is_alive():
                 p.terminate()
         for p in self._procs:
             p.join(timeout=5)
+        if hasattr(self, "_feeder"):
+            self._feeder.join(timeout=5)
         self._ring.close()
 
     def __del__(self):

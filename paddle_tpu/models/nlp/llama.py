@@ -54,6 +54,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # fuse q/k/v (and gate/up) into single wider matmuls — fewer, larger
+    # MXU calls (~ reference fused_attention's qkv packing); weight names
+    # change (qkv_proj / gate_up_proj), so default off for ckpt compat
+    fuse_attention_qkv: bool = False
+    fuse_ffn_gate_up: bool = False
 
     @staticmethod
     def llama3_8b():
@@ -123,20 +128,40 @@ class LlamaAttention(nn.Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.rope_theta = c.rope_theta
-        self.q_proj = ColumnParallelLinear(c.hidden_size, c.hidden_size,
-                                           has_bias=False)
-        self.k_proj = ColumnParallelLinear(
-            c.hidden_size, self.num_kv_heads * self.head_dim, has_bias=False)
-        self.v_proj = ColumnParallelLinear(
-            c.hidden_size, self.num_kv_heads * self.head_dim, has_bias=False)
+        self.fused_qkv = bool(getattr(c, "fuse_attention_qkv", False))
+        kv_out = self.num_kv_heads * self.head_dim
+        if self.fused_qkv:
+            # one (H, H + 2*kv) matmul instead of three — fewer, larger
+            # MXU calls (the reference fused_attention_op's QKV packing)
+            self.qkv_proj = ColumnParallelLinear(
+                c.hidden_size, c.hidden_size + 2 * kv_out, has_bias=False)
+        else:
+            self.q_proj = ColumnParallelLinear(c.hidden_size, c.hidden_size,
+                                               has_bias=False)
+            self.k_proj = ColumnParallelLinear(c.hidden_size, kv_out,
+                                               has_bias=False)
+            self.v_proj = ColumnParallelLinear(c.hidden_size, kv_out,
+                                               has_bias=False)
         self.o_proj = RowParallelLinear(c.hidden_size, c.hidden_size,
                                         has_bias=False)
 
     def forward(self, x, positions=None):
         B, S, H = x.shape
-        q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
-        k = self.k_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
-        v = self.v_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
+        kv_out = self.num_kv_heads * self.head_dim
+        if self.fused_qkv:
+            qkv = self.qkv_proj(x)
+            q = qkv[:, :, :H].reshape([B, S, self.num_heads, self.head_dim])
+            k = qkv[:, :, H:H + kv_out].reshape(
+                [B, S, self.num_kv_heads, self.head_dim])
+            v = qkv[:, :, H + kv_out:].reshape(
+                [B, S, self.num_kv_heads, self.head_dim])
+        else:
+            q = self.q_proj(x).reshape(
+                [B, S, self.num_heads, self.head_dim])
+            k = self.k_proj(x).reshape(
+                [B, S, self.num_kv_heads, self.head_dim])
+            v = self.v_proj(x).reshape(
+                [B, S, self.num_kv_heads, self.head_dim])
 
         theta = self.rope_theta
         n_rep = self.num_heads // self.num_kv_heads
@@ -187,16 +212,27 @@ class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         c = config
-        self.gate_proj = ColumnParallelLinear(c.hidden_size,
-                                              c.intermediate_size,
-                                              has_bias=False)
-        self.up_proj = ColumnParallelLinear(c.hidden_size,
-                                            c.intermediate_size,
-                                            has_bias=False)
+        self.fused_gate_up = bool(getattr(c, "fuse_ffn_gate_up", False))
+        self.intermediate = c.intermediate_size
+        if self.fused_gate_up:
+            self.gate_up_proj = ColumnParallelLinear(
+                c.hidden_size, 2 * c.intermediate_size, has_bias=False)
+        else:
+            self.gate_proj = ColumnParallelLinear(c.hidden_size,
+                                                  c.intermediate_size,
+                                                  has_bias=False)
+            self.up_proj = ColumnParallelLinear(c.hidden_size,
+                                                c.intermediate_size,
+                                                has_bias=False)
         self.down_proj = RowParallelLinear(c.intermediate_size, c.hidden_size,
                                            has_bias=False)
 
     def forward(self, x):
+        if self.fused_gate_up:
+            gu = self.gate_up_proj(x)
+            gate = gu[..., :self.intermediate]
+            up = gu[..., self.intermediate:]
+            return self.down_proj(F.silu(gate) * up)
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 

@@ -42,8 +42,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return None
     lib = _load_and_bind(path)
     if lib is None and _build():
-        # a stale prebuilt .so missing newer symbols: rebuild once
-        lib = _load_and_bind(path)
+        # a stale prebuilt .so missing newer symbols: rebuild, then load
+        # via a fresh temp path — re-dlopening the SAME path returns the
+        # already-mapped stale image from the loader cache
+        import shutil
+        import tempfile
+        try:
+            tmp = tempfile.NamedTemporaryFile(
+                suffix=".so", delete=False)
+            tmp.close()
+            shutil.copy(path, tmp.name)
+            lib = _load_and_bind(tmp.name)
+        except OSError:
+            lib = None
     _lib = lib
     return _lib
 

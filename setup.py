@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import os
 import subprocess
-import sysconfig
+import sys
+
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
@@ -35,8 +36,11 @@ def compile_native(out_path: str) -> bool:
     if not all(os.path.exists(s) for s in srcs):
         return False
     cflags = ["-O2", "-fPIC", "-std=c++17", "-pthread", "-Wall", "-shared"]
-    # -lrt: shm_open lives in librt on glibc < 2.34 (stub on newer)
-    cmd = [cxx, *cflags, "-o", out_path, *srcs, "-lrt"]
+    cmd = [cxx, *cflags, "-o", out_path, *srcs]
+    if sys.platform.startswith("linux"):
+        # -lrt: shm_open lives in librt on glibc < 2.34 (stub on newer);
+        # macOS/musl have no librt and need no flag
+        cmd.append("-lrt")
     try:
         subprocess.run(cmd, check=True, timeout=300)
         return True

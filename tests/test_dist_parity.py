@@ -107,3 +107,91 @@ def test_dp_two_proc_loss_parity(tmp_path):
                                atol=1e-6)
     # and training must actually progress
     assert single[-1] < single[0]
+
+
+SPARSE_TRAINER = textwrap.dedent("""
+    import json
+    import os
+    import sys
+    sys.path.insert(0, "/root/repo")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    rank = int(os.environ.get("PADDLE_GLOBAL_RANK", "0"))
+    world = int(os.environ.get("PADDLE_WORLD_SIZE", "1"))
+    if world > 1:
+        host, port = os.environ["PADDLE_MASTER"].split(":")
+        os.environ["PADDLE_MASTER"] = f"{host}:{int(port) + 43}"
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    dist.init_parallel_env()
+    fleet.init(is_collective=True)
+
+    paddle.seed(21)
+    emb = paddle.nn.Embedding(16, 8, sparse=True)
+    head = paddle.nn.Linear(8, 1)
+    model = paddle.nn.Sequential(emb, head)
+    model = fleet.distributed_model(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    rng = np.random.default_rng(3)
+    B = 8
+    ids0 = rng.integers(0, 16, B).astype(np.int64)
+    y0 = rng.standard_normal((B, 1)).astype(np.float32)
+    losses = []
+    for step in range(4):
+        lo, hi = rank * B // world, (rank + 1) * B // world
+        x = paddle.to_tensor(ids0[lo:hi])
+        y = paddle.to_tensor(y0[lo:hi])
+        loss = paddle.nn.functional.mse_loss(model(x), y)
+        loss.backward()   # sparse grad must sync across ranks here
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+
+    out = os.environ["TEST_OUT_DIR"]
+    with open(os.path.join(out, f"loss_rank{rank}.json"), "w") as f:
+        json.dump(losses, f)
+""")
+
+
+def test_sparse_embedding_dp_parity(tmp_path):
+    """SelectedRows grads must sync across DP ranks (allgather-average) —
+    a silently-unsynced sparse embedding diverges per rank and fails the
+    loss-parity identity."""
+    def run(nproc):
+        script = tmp_path / "sparse_trainer.py"
+        script.write_text(SPARSE_TRAINER)
+        out = tmp_path / f"sp{nproc}"
+        out.mkdir()
+        env = dict(os.environ)
+        env["TEST_OUT_DIR"] = str(out)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_GLOBAL_RANK", None)
+        env.pop("PADDLE_WORLD_SIZE", None)
+        if nproc == 1:
+            proc = subprocess.run([sys.executable, str(script)],
+                                  cwd="/root/repo", env=env,
+                                  capture_output=True, text=True,
+                                  timeout=240)
+        else:
+            proc = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node", str(nproc), str(script)],
+                cwd="/root/repo", env=env, capture_output=True, text=True,
+                timeout=240)
+        assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+        return np.asarray([
+            json.loads((out / f"loss_rank{r}.json").read_text())
+            for r in range(nproc)])
+
+    single = run(1)[0]
+    two = run(2)
+    np.testing.assert_allclose(two.mean(axis=0), single, rtol=1e-4,
+                               atol=1e-6)
+    assert single[-1] < single[0]

@@ -1,0 +1,35 @@
+"""HybridParallelInferenceHelper (pipelined inference over the carrier).
+
+~ reference test_hybrid_parallel_inference_helper.py capability: staged
+inference matches the unstaged forward.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.utils import HybridParallelInferenceHelper
+
+
+class TestHelper:
+    def test_pipelined_matches_plain(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                          nn.Linear(16, 4), nn.Softmax())
+        m.eval()
+        helper = HybridParallelInferenceHelper(model=m, num_pp=2,
+                                               micro_batch_size=4)
+        helper.gen_infer_program()
+        x = np.random.default_rng(0).normal(0, 1, (10, 8)).astype(np.float32)
+        out = helper.run(paddle.to_tensor(x))
+        ref = m(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_single_stage(self):
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(4, 4))
+        m.eval()
+        helper = HybridParallelInferenceHelper(model=m, num_pp=1)
+        x = np.ones((3, 4), np.float32)
+        out = helper.run(paddle.to_tensor(x))
+        assert out.shape == [3, 4]

@@ -1,0 +1,59 @@
+"""Fused flat buffers + bucketed DP grad sync.
+
+~ reference group_sharded_storage.py + Reducer bucket tests: pack/unpack
+round-trips, byte-budget bucketing, and fused_all_reduce preserving
+order/shape across mixed dtypes.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.distributed.fleet.utils.internal_storage import (
+    GradStorage, TensorBucket, fused_all_reduce)
+
+
+class TestTensorBucket:
+    def test_pack_unpack_roundtrip(self):
+        b = TensorBucket(jnp.float32)
+        xs = [jnp.arange(6.).reshape(2, 3), jnp.ones(4), jnp.zeros((1, 2))]
+        for x in xs:
+            b.add(x)
+        flat = b.pack()
+        assert flat.shape == (12,)
+        out = b.unpack(flat)
+        for x, o in zip(xs, out):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(o))
+
+
+class TestGradStorage:
+    def test_byte_budget_splits_buckets(self):
+        gs = GradStorage(max_bucket_bytes=40)  # 10 f32 elements
+        grads = [jnp.ones(8), jnp.ones(8), jnp.ones(2)]
+        buckets = gs.build(grads)
+        assert len(buckets) == 2  # 8 | 8+2
+        assert buckets[0].numel == 8 and buckets[1].numel == 10
+
+    def test_mixed_dtypes_separate_buckets(self):
+        gs = GradStorage()
+        buckets = gs.build([jnp.ones(3, jnp.float32),
+                            jnp.ones(3, jnp.bfloat16)])
+        assert len(buckets) == 2
+        assert {b.dtype for b in buckets} == {jnp.dtype(jnp.float32),
+                                              jnp.dtype(jnp.bfloat16)}
+
+
+class TestFusedAllReduce:
+    def test_preserves_order_and_values(self):
+        grads = [jnp.full((2, 2), 1.0), jnp.full((3,), 2.0),
+                 jnp.full((1,), 3.0, jnp.bfloat16)]
+        calls = []
+
+        def fake_allreduce(flat):
+            calls.append(flat.shape[0])
+            return flat * 2  # "sum over 2 ranks"
+
+        out = fused_all_reduce(grads, fake_allreduce)
+        assert len(calls) == 2  # f32 bucket + bf16 bucket, not 3 calls
+        np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+        np.testing.assert_allclose(np.asarray(out[1]), 4.0)
+        assert out[2].dtype == jnp.bfloat16
+        assert out[0].shape == (2, 2) and out[1].shape == (3,)

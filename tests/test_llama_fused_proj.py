@@ -1,0 +1,70 @@
+"""Fused QKV / gate-up projection variants of Llama.
+
+~ reference fused_attention_op's packed-QKV layout: the fused config must
+match the unfused model exactly when weights are concatenated.
+"""
+import dataclasses
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+
+
+def _copy_fused(m_from, m_to):
+    import jax.numpy as jnp
+    sd1 = {k: v.numpy() for k, v in m_from.state_dict().items()}
+    for k, v in m_to.state_dict().items():
+        if "qkv_proj" in k:
+            base = k.replace("qkv_proj", "{}")
+            w = np.concatenate([sd1[base.format("q_proj")],
+                                sd1[base.format("k_proj")],
+                                sd1[base.format("v_proj")]], axis=1)
+        elif "gate_up_proj" in k:
+            base = k.replace("gate_up_proj", "{}")
+            w = np.concatenate([sd1[base.format("gate_proj")],
+                                sd1[base.format("up_proj")]], axis=1)
+        else:
+            w = sd1[k]
+        v._value = jnp.asarray(w)
+
+
+class TestFusedProjections:
+    def test_logits_parity_with_unfused(self):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        m1 = LlamaForCausalLM(cfg)
+        m1.eval()
+        cfg2 = dataclasses.replace(cfg, fuse_attention_qkv=True,
+                                   fuse_ffn_gate_up=True)
+        m2 = LlamaForCausalLM(cfg2)
+        m2.eval()
+        _copy_fused(m1, m2)
+        ids = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, 128, (2, 16)).astype(np.int32))
+        np.testing.assert_allclose(m1(ids).numpy(), m2(ids).numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fused_trains(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from paddle_tpu.models.nlp.llama import llama_train_step_factory
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=4,
+                               kv_heads=4)
+        cfg = dataclasses.replace(cfg, fuse_attention_qkv=True,
+                                  fuse_ffn_gate_up=True)
+        model = LlamaForCausalLM(cfg)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        params, opt, step = llama_train_step_factory(
+            model, mesh, learning_rate=1e-2, remat=False)[:3]
+        rng = np.random.default_rng(0)
+        t = jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32)
+        losses = []
+        for _ in range(4):
+            params, opt, loss = step(params, opt, t, t)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

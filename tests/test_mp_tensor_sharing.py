@@ -1,0 +1,76 @@
+"""incubate.multiprocessing tensor IPC reductions.
+
+~ reference test_paddle_multiprocessing.py: tensors crossing mp queues
+travel via shared memory; values round-trip, stop_gradient survives, and
+the producer cache bounds live segments.
+"""
+import multiprocessing as mp
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.multiprocessing import (LRUSharedCache,
+                                                 init_reductions,
+                                                 rebuild_tensor,
+                                                 reduce_tensor)
+
+
+def _child_double(q_in, q_out):
+    # spawned child: fresh interpreter, safe to use jax; register the
+    # reduction so the reply Tensor also ships via shared memory
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.incubate.multiprocessing import init_reductions
+    init_reductions()
+    t = q_in.get()
+    q_out.put(t * 2)
+
+
+class TestReduction:
+    def test_reduce_rebuild_roundtrip(self):
+        t = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+        t.stop_gradient = False
+        fn, args = reduce_tensor(t)
+        assert fn is rebuild_tensor
+        back = fn(*args)
+        np.testing.assert_allclose(back.numpy(), t.numpy())
+        assert back.stop_gradient is False
+
+    def test_int_dtype(self):
+        t = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int32))
+        fn, args = reduce_tensor(t)
+        back = fn(*args)
+        assert back.numpy().dtype == np.int32
+        np.testing.assert_array_equal(back.numpy(), t.numpy())
+
+    def test_cross_process_queue(self):
+        init_reductions()
+        # spawn, not fork: a forked child of a jax-active parent deadlocks
+        # on device access (XLA threads don't survive fork) — spawn is the
+        # supported IPC contract for live tensors
+        ctx = mp.get_context("spawn")
+        q_in, q_out = ctx.Queue(), ctx.Queue()
+        p = ctx.Process(target=_child_double, args=(q_in, q_out))
+        p.start()
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        q_in.put(t)
+        out = q_out.get(timeout=60)
+        p.join(timeout=15)
+        np.testing.assert_allclose(out.numpy(), t.numpy() * 2)
+
+    def test_lru_cache_bounds_segments(self):
+        cache = LRUSharedCache()
+        cache.LIMIT = 3
+        from paddle_tpu.incubate.multiprocessing import allocate_shared
+        names = []
+        for i in range(5):
+            shm, _ = allocate_shared(np.zeros(4, np.float32))
+            names.append(shm.name)
+            cache.put(shm.name, shm)
+        assert len(cache) == 3
+        assert names[-1] in cache and names[0] not in cache
+        # drain remaining
+        for shm in list(cache.values()):
+            shm.close()
+            shm.unlink()
+        cache.clear()
