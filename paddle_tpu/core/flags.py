@@ -69,6 +69,8 @@ define_flag("eager_delete_tensor_gb", 0.0, "kept for API compat; XLA manages mem
 define_flag("use_autotune", True, "enable XLA autotuning knobs where applicable")
 define_flag("low_precision_op_list", "", "comma list of ops forced to bf16 under amp")
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("context_parallel_backend", "ring",
+            "sequence-parallel attention impl: ring (KV ppermute, any head count) | ulysses (two all-to-alls, needs heads % sep == 0)")
 define_flag("use_flash_attention", True,
             "use the Pallas flash-attention kernel on eligible shapes; "
             "a kernel failure raises instead of silently degrading")

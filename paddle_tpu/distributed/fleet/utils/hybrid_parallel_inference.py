@@ -30,8 +30,11 @@ class HybridParallelInferenceHelper:
         self._dist_model = None
         if model is not None:
             from ...fleet_executor import DistModel, DistModelConfig
+            # n_microbatches is resolved per batch in run() — the
+            # reference's micro_batch_size is the SIZE of each micro, not
+            # the count
             cfg = DistModelConfig(model=model, nranks=num_mp * num_pp,
-                                  n_microbatches=max(1, micro_batch_size))
+                                  n_microbatches=1)
             self._dist_model = DistModel(cfg, n_stages=max(1, num_pp))
 
     def gen_infer_program(self, sync_in_while_lastpp2firstpp_var_names=None,
@@ -47,6 +50,10 @@ class HybridParallelInferenceHelper:
         """Run pipelined inference: eager Layer path streams micro-batches
         through the carrier; static path delegates to the Executor."""
         if self._dist_model is not None:
+            import math as _math
+            batch = inputs.shape[0]
+            self._dist_model._config.n_microbatches = max(
+                1, _math.ceil(batch / max(1, self.micro_batch_size)))
             return self._dist_model.run(inputs)
         if exe is None:
             from ....static import Executor

@@ -56,6 +56,9 @@ class GradStorage:
     def __init__(self, max_bucket_bytes: int = 25 * 1024 * 1024):
         self.max_bucket_bytes = max_bucket_bytes
         self.buckets: List[TensorBucket] = []
+        # assignments[i] = (bucket_idx, slot_idx) for input i — recorded
+        # here so callers can restore input order without id() tricks
+        self.assignments: List[Tuple[int, int]] = []
 
     def build(self, grads: List) -> List[TensorBucket]:
         by_dtype: Dict = {}
@@ -68,8 +71,9 @@ class GradStorage:
                 cur._bytes = 0
                 by_dtype[key] = cur
                 self.buckets.append(cur)
-            cur.add(g)
+            slot = cur.add(g)
             cur._bytes += nbytes
+            self.assignments.append((self.buckets.index(cur), slot))
         return self.buckets
 
 
@@ -86,14 +90,6 @@ def fused_all_reduce(grads: List, all_reduce_fn,
     """
     storage = GradStorage(max_bucket_bytes)
     buckets = storage.build(grads)
-    slot_of = {}
-    for bi, b in enumerate(buckets):
-        for ti, t in enumerate(b.tensors):
-            slot_of[id(t)] = (bi, ti)
-    reduced_per_bucket = []
-    for b in buckets:
-        flat = b.pack()
-        flat = all_reduce_fn(flat)
-        reduced_per_bucket.append(b.unpack(flat))
-    return [reduced_per_bucket[slot_of[id(g)][0]][slot_of[id(g)][1]]
-            for g in grads]
+    reduced_per_bucket = [b.unpack(all_reduce_fn(b.pack()))
+                          for b in buckets]
+    return [reduced_per_bucket[bi][ti] for bi, ti in storage.assignments]

@@ -36,16 +36,22 @@ class DistributedAuc:
         np.add.at(self._neg, idx[labels == 0], 1)
 
     def _merged(self):
-        """All-reduce the histograms across workers when distributed."""
+        """All-reduce the histograms across workers when distributed.
+        Counts reduce as two f32 limbs (lo = count mod 2^20, hi =
+        count // 2^20) — a single f32 silently rounds counts past 2^24,
+        skewing the global AUC on large jobs."""
         from . import collective as C
         if C._multi_process():
             from ..core.tensor import Tensor
             import jax.numpy as jnp
-            buf = Tensor(jnp.asarray(np.stack([self._pos, self._neg])
-                                     .astype(np.float32)))
+            both = np.stack([self._pos, self._neg])
+            hi = np.floor(both / 2 ** 20)
+            lo = both - hi * 2 ** 20
+            buf = Tensor(jnp.asarray(np.stack([hi, lo]).astype(np.float32)))
             C.all_reduce(buf)
             merged = np.asarray(buf.numpy(), np.float64)
-            return merged[0], merged[1]
+            total = merged[0] * 2 ** 20 + merged[1]
+            return total[0], total[1]
         return self._pos, self._neg
 
     def value(self) -> float:
@@ -58,7 +64,8 @@ class DistributedAuc:
             return 0.5
         tpr = np.concatenate([[0.0], tp / P])
         fpr = np.concatenate([[0.0], fp / N])
-        return float(np.trapezoid(tpr, fpr))
+        trapz = getattr(np, "trapezoid", None) or np.trapz  # numpy<2.0
+        return float(trapz(tpr, fpr))
 
     def reset(self):
         self._pos[:] = 0

@@ -31,8 +31,13 @@ __all__ = ["init_reductions", "reduce_tensor", "rebuild_tensor",
 
 
 class LRUSharedCache(OrderedDict):
-    """~ reductions.py:49 — bounded cache pinning shm segments in the
-    producer so they outlive the pickle round trip."""
+    """~ reductions.py:49 — bounded cache of producer-side shm handles.
+
+    Ownership protocol: the CONSUMER unlinks a segment after rebuilding
+    (it copies the data out), so eviction here only closes the producer's
+    handle — an unread in-flight segment stays alive no matter how many
+    tensors were sent after it. Segments never consumed (dropped
+    messages) are unlinked at producer exit."""
 
     LIMIT = 128
 
@@ -41,11 +46,13 @@ class LRUSharedCache(OrderedDict):
         self.move_to_end(key)
         while len(self) > self.LIMIT:
             _k, old = self.popitem(last=False)
+            self._evicted_names.append(old.name)
             try:
-                old.close()
-                old.unlink()
-            except FileNotFoundError:
+                old.close()  # close only; consumer owns the unlink
+            except OSError:
                 pass
+
+    _evicted_names: list = []
 
 
 _producer_cache = LRUSharedCache()
@@ -53,11 +60,17 @@ _producer_cache = LRUSharedCache()
 
 @atexit.register
 def _cleanup():
+    # reap everything this producer created that no consumer unlinked
     for shm in _producer_cache.values():
         try:
             shm.close()
             shm.unlink()
-        except FileNotFoundError:
+        except (FileNotFoundError, OSError):
+            pass
+    for name in LRUSharedCache._evicted_names:
+        try:
+            shared_memory.SharedMemory(name=name).unlink()
+        except (FileNotFoundError, OSError):
             pass
     _producer_cache.clear()
 
@@ -78,14 +91,14 @@ def rebuild_tensor(shm_name, shape, dtype_str, stop_gradient):
     # copy out: the producer's LRU may unlink the segment later, and jax
     # will anyway copy host->device on first use
     t = Tensor(np.array(arr), stop_gradient=stop_gradient)
-    shm.close()
-    # ownership stays with the producer: detach from this process's
-    # resource tracker so it doesn't double-unlink at exit
+    # the consumer owns the unlink (see LRUSharedCache): data is copied
+    # out, so release the name now; the producer's atexit double-unlink
+    # attempts are FileNotFoundError-guarded
     try:
-        from multiprocessing import resource_tracker
-        resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:  # noqa: BLE001 — tracker API is CPython-internal
+        shm.unlink()
+    except (FileNotFoundError, OSError):
         pass
+    shm.close()
     return t
 
 

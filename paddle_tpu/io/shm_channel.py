@@ -133,6 +133,7 @@ class MultiprocessDataLoaderIter:
             self._procs.append(p)
         self._total = len(loader.batch_sampler)
         self._stopping = threading.Event()
+        self._feed_error = None
         self._feeder = threading.Thread(target=self._feed, daemon=True)
         self._feeder.start()
         self._done_workers = 0
@@ -141,26 +142,26 @@ class MultiprocessDataLoaderIter:
 
     def _feed(self):
         import queue as _q
+
+        def bounded_put(item) -> bool:
+            while not self._stopping.is_set():
+                try:  # teardown races surface as OSError/ValueError
+                    self._work_q.put(item, timeout=0.2)
+                    return True
+                except _q.Full:
+                    continue
+                except (OSError, ValueError):
+                    return False
+            return False
+
         try:
             for seq, idx_batch in enumerate(self.loader._index_iter()):
-                while True:  # bounded put that honors shutdown
-                    if self._stopping.is_set():
-                        return
-                    try:
-                        self._work_q.put((seq, list(idx_batch)),
-                                         timeout=0.2)
-                        break
-                    except _q.Full:
-                        continue
-            for _ in self._procs:
-                while not self._stopping.is_set():
-                    try:
-                        self._work_q.put(None, timeout=0.2)
-                        break
-                    except _q.Full:
-                        continue
-        except (OSError, ValueError):
-            pass  # queue torn down under us during shutdown
+                if not bounded_put((seq, list(idx_batch))):
+                    return
+        except Exception as e:  # noqa: BLE001 — user sampler failure
+            self._feed_error = e  # surfaced by __next__, never swallowed
+        for _ in self._procs:
+            bounded_put(None)
 
     def __iter__(self):
         return self
@@ -179,6 +180,11 @@ class MultiprocessDataLoaderIter:
                 blob = self._ring.read(timeout_us=1_000_000)
                 if blob is not None:
                     break
+                if self._feed_error is not None:
+                    err = self._feed_error
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader batch sampler failed") from err
                 self._check_errors()  # raises the reported cause
                 if any(not p.is_alive() and p.exitcode not in (0, None)
                        for p in self._procs):

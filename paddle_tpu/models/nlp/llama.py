@@ -180,10 +180,21 @@ class LlamaAttention(nn.Layer):
 
             cp_mesh, cp_axis = _context_parallel_mesh()
             if cp_mesh is not None and S % cp_mesh.shape[cp_axis] == 0:
-                from ...parallel.ring_attention import ring_attention
-                out = ring_attention(qt, kt, vt, cp_mesh, axis=cp_axis,
-                                     causal=True, sm_scale=scale,
-                                     batch_axis="data", head_axis="model")
+                from ...core import flags as _flags
+                backend = _flags.get_flag("context_parallel_backend")
+                n_heads = qt.shape[1]
+                if backend == "ulysses" and \
+                        n_heads % cp_mesh.shape[cp_axis] == 0:
+                    from ...parallel.ulysses import ulysses_attention
+                    out = ulysses_attention(qt, kt, vt, cp_mesh,
+                                            axis=cp_axis, causal=True,
+                                            sm_scale=scale)
+                else:
+                    from ...parallel.ring_attention import ring_attention
+                    out = ring_attention(qt, kt, vt, cp_mesh, axis=cp_axis,
+                                         causal=True, sm_scale=scale,
+                                         batch_axis="data",
+                                         head_axis="model")
                 return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
             from ...core import flags as _flags

@@ -11,3 +11,4 @@ where the TPU-native execution actually scales:
 """
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

@@ -32,7 +32,7 @@ def stack_stage_params(per_stage_params):
 def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
                    n_microbatches: int, axis: str = "pipe",
                    remat: bool = True, data_axis: str | None = None,
-                   auto_axes=None):
+                   auto_axes=None, shard_input: bool = False):
     """Run ``stage_fn`` as a pipeline over mesh axis ``axis``.
 
     stage_fn(stage_params, activation) -> activation (same shape) — the body
@@ -47,27 +47,54 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     comes from remat + scan rather than schedule interleaving; the compiled
     program overlaps ppermute with the next tick's compute via XLA's
     latency-hiding scheduler.
+
+    shard_input=True (requires n_microbatches % n_stages == 0): the
+    microbatch buffer is sharded over the pipe axis instead of replicated
+    — each stage stores M/P micros and the tick's micro is routed to
+    stage 0 by a masked psum (one mb of comm per tick). Cuts the input
+    buffer's per-stage memory by P at the cost of ~2x the final
+    broadcast's comm volume spread over ticks.
     """
     n_stages = mesh.shape[axis]
+    if shard_input and n_microbatches % n_stages != 0:
+        raise ValueError(
+            f"shard_input needs n_microbatches ({n_microbatches}) "
+            f"divisible by n_stages ({n_stages})")
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def spmd(params, xm):
-        # params: (1, ...) local stage slice; xm: (M, mb, ...) microbatches
-        # (replicated; each stage reads only what it needs)
+        # params: (1, ...) local stage slice; xm: microbatches — either
+        # (M, mb, ...) replicated or (M/P, mb, ...) pipe-sharded
         params = jax.tree.map(lambda p: p[0], params)
         stage = jax.lax.axis_index(axis)
-        M = xm.shape[0]
+        M = n_microbatches
+        local_m = xm.shape[0]
         ticks = M + n_stages - 1
         state = jnp.zeros_like(xm[0])          # current activation buffer
-        outputs = jnp.zeros_like(xm)           # last stage writes here
+        out_shape = (M,) + xm.shape[1:]
+        outputs = jnp.zeros(out_shape, xm.dtype)  # last stage writes here
+
+        def fetch_micro(xm, t):
+            if not shard_input:
+                mb_idx = jnp.clip(t, 0, M - 1)
+                return jax.lax.dynamic_index_in_dim(xm, mb_idx, 0,
+                                                    keepdims=False)
+            # owner stage holds micro t at local index t % (M/P); route it
+            # to everyone with a masked psum (stage 0 consumes)
+            owner = jnp.clip(t, 0, M - 1) // local_m
+            local_idx = jnp.clip(t, 0, M - 1) % local_m
+            mine = jax.lax.dynamic_index_in_dim(xm, local_idx, 0,
+                                                keepdims=False)
+            return jax.lax.psum(
+                jnp.where(stage == owner, 1.0, 0.0).astype(mine.dtype)
+                * mine, axis)
 
         def tick(carry, t):
             state, outputs = carry
             # stage 0 ingests microbatch t (if in range) else keeps buffer
-            mb_idx = jnp.clip(t, 0, M - 1)
             injected = jax.lax.select(
                 jnp.logical_and(stage == 0, t < M),
-                jax.lax.dynamic_index_in_dim(xm, mb_idx, 0, keepdims=False),
+                fetch_micro(xm, t),
                 state)
             out = body(params, injected)
             # last stage records micro (t - (n_stages-1))
@@ -98,7 +125,9 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
 
     # batch (microbatch dim 1) may additionally shard over a data axis —
     # each data shard runs its own pipeline instance over the same stages
-    x_spec = P(None, data_axis) if data_axis else P()
+    in_axis0 = axis if shard_input else None
+    x_spec = P(in_axis0, data_axis)
+    out_spec = P(None, data_axis) if data_axis else P()
     in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), x_spec)
     kw = {}
     if auto_axes:
@@ -109,6 +138,6 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
         kw["axis_names"] = frozenset(
             a for a in mesh.axis_names if a not in auto_axes)
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
-                       out_specs=x_spec, check_vma=False, **kw)
+                       out_specs=out_spec, check_vma=False, **kw)
     y = fn(stacked_params, xm)
     return y.reshape((B,) + y.shape[2:])

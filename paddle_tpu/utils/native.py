@@ -45,6 +45,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         # a stale prebuilt .so missing newer symbols: rebuild, then load
         # via a fresh temp path — re-dlopening the SAME path returns the
         # already-mapped stale image from the loader cache
+        import atexit
         import shutil
         import tempfile
         try:
@@ -53,6 +54,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
             tmp.close()
             shutil.copy(path, tmp.name)
             lib = _load_and_bind(tmp.name)
+            # the mapping survives unlink on Linux; don't litter /tmp
+            atexit.register(lambda p=tmp.name: os.path.exists(p)
+                            and os.unlink(p))
         except OSError:
             lib = None
     _lib = lib

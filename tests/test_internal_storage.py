@@ -57,3 +57,16 @@ class TestFusedAllReduce:
         np.testing.assert_allclose(np.asarray(out[1]), 4.0)
         assert out[2].dtype == jnp.bfloat16
         assert out[0].shape == (2, 2) and out[1].shape == (3,)
+
+    def test_interleaved_dtypes_restore_order(self):
+        # f32 / bf16 / f32 / bf16: assignment tracking must restore the
+        # exact input order across interleaved dtype buckets
+        grads = [jnp.full((2,), 1.0, jnp.float32),
+                 jnp.full((3,), 2.0, jnp.bfloat16),
+                 jnp.full((4,), 3.0, jnp.float32),
+                 jnp.full((5,), 4.0, jnp.bfloat16)]
+        out = fused_all_reduce(grads, lambda f: f * 10)
+        for g, o in zip(grads, out):
+            assert o.dtype == g.dtype and o.shape == g.shape
+            np.testing.assert_allclose(np.asarray(o, np.float32),
+                                       np.asarray(g, np.float32) * 10)

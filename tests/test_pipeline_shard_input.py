@@ -1,0 +1,71 @@
+"""pipeline_apply shard_input: pipe-sharded microbatch buffer parity.
+
+The replicated-input and sharded-input schedules must produce identical
+outputs; sharded mode must actually place 1/P of the buffer per device.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+
+def _setup(n_stages=4):
+    rng = np.random.default_rng(0)
+    H = 8
+    per_stage = [
+        {"w": jnp.asarray(rng.normal(0, 0.5, (H, H)), jnp.float32)}
+        for _ in range(n_stages)]
+    stacked = stack_stage_params(per_stage)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    x = jnp.asarray(rng.normal(0, 1, (16, H)), jnp.float32)
+    return stage_fn, stacked, x, per_stage
+
+
+class TestShardInput:
+    def test_parity_with_replicated(self):
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+        stage_fn, stacked, x, _ = _setup(4)
+        y_rep = pipeline_apply(stage_fn, stacked, x, mesh,
+                               n_microbatches=8, shard_input=False)
+        y_sh = pipeline_apply(stage_fn, stacked, x, mesh,
+                              n_microbatches=8, shard_input=True)
+        np.testing.assert_allclose(np.asarray(y_rep), np.asarray(y_sh),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_matches_sequential_oracle(self):
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+        stage_fn, stacked, x, per_stage = _setup(4)
+        y = pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=4,
+                           shard_input=True)
+        ref = x
+        for p in per_stage:
+            ref = jnp.tanh(ref @ p["w"])
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_indivisible_raises(self):
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+        stage_fn, stacked, x, _ = _setup(4)
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=6,
+                           shard_input=True)
+
+    def test_grads_flow(self):
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+        stage_fn, stacked, x, _ = _setup(4)
+
+        def loss(params):
+            y = pipeline_apply(stage_fn, params, x, mesh,
+                               n_microbatches=4, shard_input=True)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(stacked)
+        assert np.isfinite(np.asarray(g["w"])).all()
+        assert np.abs(np.asarray(g["w"])).sum() > 0
