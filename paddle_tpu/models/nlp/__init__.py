@@ -5,3 +5,4 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_train_step_factory,
 )
 from .moe import MoEConfig, MoEForCausalLM  # noqa: F401
+from .llama_decode import llama_decode_factory  # noqa: F401,E402

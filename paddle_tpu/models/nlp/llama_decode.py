@@ -1,0 +1,174 @@
+"""Compiled KV-cache generation for Llama.
+
+~ the reference's generative-inference flagship
+(fused_multi_transformer_op.cu: stacked weights + in-place KV cache, one
+kernel per decode step). TPU-native: prefill captures per-layer K/V into
+a (L, B, kv_heads, max_len, head_dim) functional cache; each decode step
+is ONE jitted program (lax.scan over the stacked layer weights) that
+attends a single query position against the cache and writes its K/V at
+`pos` via dynamic_update_slice — O(S) per token instead of the O(S²)
+recompute of the eager `LlamaForCausalLM.generate`.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, LlamaForCausalLM, apply_rotary
+from .llama_functional import _rms, split_params
+
+
+def _proj_qkv(cfg: LlamaConfig, p, h, pos):
+    """h: (B, T, H); pos: (T,) absolute positions. Returns q,k,v with
+    rotary applied — q (B, nh, T, hd), k/v (B, nkv, T, hd)."""
+    B, T, H = h.shape
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = H // nh
+    q = (h @ p["self_attn.q_proj.weight"]).reshape(B, T, nh, hd)
+    k = (h @ p["self_attn.k_proj.weight"]).reshape(B, T, nkv, hd)
+    v = (h @ p["self_attn.v_proj.weight"]).reshape(B, T, nkv, hd)
+    q = apply_rotary(q, pos, cfg.rope_theta)
+    k = apply_rotary(k, pos, cfg.rope_theta)
+    return (jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2))
+
+
+def _attend(cfg, q, k_all, v_all, key_mask):
+    """q: (B, nh, T, hd); k/v_all: (B, nkv, S, hd); key_mask (T, S) or
+    broadcastable bool."""
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    if nh != nkv:
+        k_all = jnp.repeat(k_all, nh // nkv, axis=1)
+        v_all = jnp.repeat(v_all, nh // nkv, axis=1)
+    hd = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all) / math.sqrt(hd)
+    s = jnp.where(key_mask, s, jnp.finfo(s.dtype).min)
+    probs = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v_all)
+
+
+def _layer_step(cfg, lp, x, k_cache, v_cache, pos_vec, key_mask, write_at):
+    """One decoder layer over T positions with cache read+write.
+
+    x: (B, T, H); caches (B, nkv, max_len, hd); pos_vec (T,) absolute
+    positions; write_at: scalar start index where this block's K/V land.
+    Returns (x_out, new_k_cache, new_v_cache).
+    """
+    B, T, H = x.shape
+    h = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
+    q, k, v = _proj_qkv(cfg, lp, h, pos_vec)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, write_at, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, write_at, 0))
+    ctx = _attend(cfg, q, k_cache, v_cache, key_mask)
+    attn = jnp.swapaxes(ctx, 1, 2).reshape(B, T, H) \
+        @ lp["self_attn.o_proj.weight"]
+    x = x + attn
+    h2 = _rms(x, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(h2 @ lp["mlp.gate_proj.weight"])
+           * (h2 @ lp["mlp.up_proj.weight"])) @ lp["mlp.down_proj.weight"]
+    return x + mlp, k_cache, v_cache
+
+
+def _logits(cfg, outer, x_last):
+    head = outer.get("lm_head.weight")
+    if head is None:
+        return x_last @ outer["model.embed_tokens.weight"].T
+    return x_last @ head
+
+
+def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
+    """Returns ``generate(tokens, max_new_tokens, key=None,
+    temperature=0.0, top_k=0) -> (B, S0+max_new) token array`` running a
+    fully jitted prefill + per-token decode with functional KV caches."""
+    cfg = model.config
+    outer, layers = split_params(model)
+    L = cfg.num_hidden_layers
+    nkv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+
+    def init_caches(B, dtype):
+        return jnp.zeros((L, B, nkv, max_len, hd), dtype)
+
+    @partial(jax.jit, donate_argnums=(3, 4))
+    def prefill(outer, layers, tokens, k_caches, v_caches):
+        B, S0 = tokens.shape
+        x = jnp.take(outer["model.embed_tokens.weight"], tokens, axis=0)
+        pos_vec = jnp.arange(S0)
+        causal = jnp.tril(jnp.ones((S0, S0), bool))
+        key_mask = jnp.concatenate(
+            [causal, jnp.zeros((S0, max_len - S0), bool)], axis=1)
+
+        def body(x, per_layer):
+            lp, kc, vc = per_layer
+            x, kc, vc = _layer_step(cfg, lp, x, kc, vc, pos_vec,
+                                    key_mask, 0)
+            return x, (kc, vc)
+
+        x, (k_caches, v_caches) = jax.lax.scan(
+            body, x, (layers, k_caches, v_caches))
+        x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+        return _logits(cfg, outer, x[:, -1]), k_caches, v_caches
+
+    # donate the caches: dynamic_update_slice aliases in place instead of
+    # copying the whole (L,B,nkv,max_len,hd) buffers every token
+    @partial(jax.jit, donate_argnums=(4, 5))
+    def decode_step(outer, layers, token, pos, k_caches, v_caches):
+        """token: (B,) int; pos: scalar absolute position of `token`."""
+        x = jnp.take(outer["model.embed_tokens.weight"], token[:, None],
+                     axis=0)
+        pos_vec = jnp.full((1,), pos)
+        key_mask = (jnp.arange(max_len) <= pos)[None, :]
+
+        def body(x, per_layer):
+            lp, kc, vc = per_layer
+            x, kc, vc = _layer_step(cfg, lp, x, kc, vc, pos_vec,
+                                    key_mask, pos)
+            return x, (kc, vc)
+
+        x, (k_caches, v_caches) = jax.lax.scan(
+            body, x, (layers, k_caches, v_caches))
+        x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+        return _logits(cfg, outer, x[:, 0]), k_caches, v_caches
+
+    def sample(logits, key, temperature, top_k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, -1)
+        logits = logits / temperature
+        top_k = min(top_k, logits.shape[-1])  # huge k = no truncation
+        if top_k > 0:
+            kth = jnp.sort(logits, -1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, -1)
+
+    def generate(tokens, max_new_tokens: int, key=None,
+                 temperature: float = 0.0, top_k: int = 0):
+        tokens = jnp.asarray(tokens)
+        B, S0 = tokens.shape
+        if S0 + max_new_tokens > max_len:
+            # hard error (not assert): past max_len the cache writes
+            # would silently clamp and corrupt generations
+            raise ValueError(
+                f"prompt {S0} + max_new_tokens {max_new_tokens} exceeds "
+                f"the factory's max_len {max_len}")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        dtype = outer["model.embed_tokens.weight"].dtype
+        kc = init_caches(B, dtype)
+        vc = init_caches(B, dtype)
+        logits, kc, vc = prefill(outer, layers, tokens, kc, vc)
+        out = [tokens]
+        pos = S0
+        for i in range(max_new_tokens):
+            key, sub = jax.random.split(key)
+            nxt = sample(logits, sub, temperature, top_k)
+            out.append(nxt[:, None])
+            if i + 1 < max_new_tokens:
+                logits, kc, vc = decode_step(outer, layers, nxt,
+                                             jnp.asarray(pos), kc, vc)
+                pos += 1
+        return jnp.concatenate(out, axis=1)
+
+    return generate
