@@ -22,6 +22,10 @@ def _stage1(x):
 
 
 def _rank_main(rank, addrs, q):
+    # pin CPU defensively: a wedged axon tunnel hangs ANY backend init,
+    # and spawn children don't inherit the parent's jax config
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.distributed.fleet_executor import DistCarrier, TaskNode
     tasks = [TaskNode(rank=0, program=_stage0, task_id=0),
              TaskNode(rank=1, program=_stage1, task_id=1)]
@@ -53,13 +57,16 @@ class TestDistCarrier:
         p0, p1 = _two_free_ports()
         addrs = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
         q = ctx.Queue()
-        procs = [ctx.Process(target=_rank_main, args=(r, addrs, q))
+        # daemon: a hung child (e.g. import stalled under heavy machine
+        # load) must never be able to block pytest shutdown
+        procs = [ctx.Process(target=_rank_main, args=(r, addrs, q),
+                             daemon=True)
                  for r in (0, 1)]
         for p in procs:
             p.start()
         results = {}
         for _ in range(2):
-            rank, out = q.get(timeout=120)
+            rank, out = q.get(timeout=300)
             results[rank] = out
         for p in procs:
             p.join(timeout=30)

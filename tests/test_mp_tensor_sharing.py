@@ -50,7 +50,8 @@ class TestReduction:
         # supported IPC contract for live tensors
         ctx = mp.get_context("spawn")
         q_in, q_out = ctx.Queue(), ctx.Queue()
-        p = ctx.Process(target=_child_double, args=(q_in, q_out))
+        p = ctx.Process(target=_child_double, args=(q_in, q_out),
+                        daemon=True)
         p.start()
         t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
         q_in.put(t)
