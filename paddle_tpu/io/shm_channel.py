@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import os
 import pickle
+import time
 from typing import Optional
 
 from ..utils import native
@@ -173,7 +174,7 @@ class MultiprocessDataLoaderIter:
                 self._next_seq += 1
                 return self.loader._to_tensors(data)
             if self._next_seq >= self._total:
-                self._shutdown()
+                self._shutdown(graceful=True)
                 raise StopIteration
             blob = None
             for _ in range(30):  # 1s slices: react to errors fast
@@ -212,7 +213,15 @@ class MultiprocessDataLoaderIter:
         self._shutdown()
         raise RuntimeError(f"DataLoader worker {wid} failed to start: {err}")
 
-    def _shutdown(self):
+    def _shutdown(self, graceful: bool = False):
+        if graceful:
+            # End of a fully-consumed epoch: sentinels are already queued, so
+            # let workers drain them and exit on their own. Terminating
+            # immediately races a worker still mid-fork under machine load —
+            # it would be killed before even running worker_init_fn.
+            deadline = time.time() + 10.0
+            for p in self._procs:
+                p.join(timeout=max(0.0, deadline - time.time()))
         self._stopping.set()  # unblock the feeder's bounded puts
         for p in self._procs:
             if p.is_alive():
