@@ -218,10 +218,22 @@ class MultiprocessDataLoaderIter:
             # End of a fully-consumed epoch: sentinels are already queued, so
             # let workers drain them and exit on their own. Terminating
             # immediately races a worker still mid-fork under machine load —
-            # it would be killed before even running worker_init_fn.
-            deadline = time.time() + 10.0
+            # it would be killed before even running worker_init_fn. Drain
+            # the ring for "done" markers first so the joins below are
+            # near-instant in the normal case; a genuinely wedged worker
+            # costs at most the 5s budget before falling through to
+            # terminate.
+            deadline = time.time() + 5.0
+            while (self._done_workers < len(self._procs)
+                   and time.time() < deadline):
+                blob = self._ring.read(timeout_us=200_000)
+                if blob is None:
+                    continue
+                kind = pickle.loads(blob)[0]
+                if kind == "done":
+                    self._done_workers += 1
             for p in self._procs:
-                p.join(timeout=max(0.0, deadline - time.time()))
+                p.join(timeout=max(0.0, deadline - time.time()) + 0.5)
         self._stopping.set()  # unblock the feeder's bounded puts
         for p in self._procs:
             if p.is_alive():
