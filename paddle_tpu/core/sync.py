@@ -1,0 +1,33 @@
+"""Device synchronization that is real on every backend.
+
+`jax.block_until_ready` does not guarantee execution has finished on
+remote-tunneled platforms (observed on the axon TPU plugin: it returns at
+dispatch time, so timings and wait() contracts silently break). A tiny
+host readback of one scalar per buffer is the portable barrier — it cannot
+complete before the producing computation has.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def hard_sync(value) -> None:
+    """Block until every array in the pytree is materialized on device.
+
+    Uses block_until_ready first (correct + cheap on local backends), then
+    forces a one-element host readback per leaf as the portable barrier.
+    """
+    leaves = jax.tree_util.tree_leaves(value)
+    jax.block_until_ready(leaves)
+    for leaf in leaves:
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+            jax.device_get(jax.numpy.ravel(leaf)[0])
+
+
+def is_ready(value) -> bool:
+    """Non-blocking readiness poll over a pytree (True when unknowable)."""
+    for leaf in jax.tree_util.tree_leaves(value):
+        probe = getattr(leaf, "is_ready", None)
+        if probe is not None and not probe():
+            return False
+    return True

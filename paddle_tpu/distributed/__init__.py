@@ -7,7 +7,7 @@ from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
 from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+    ReduceOp, Task, all_gather, all_reduce, alltoall, barrier, broadcast,
     destroy_process_group, get_group, new_group, recv, reduce, scatter, send,
     split, wait,
 )

@@ -35,6 +35,48 @@ class ReduceOp:
     AVG = 4
 
 
+class Task:
+    """Async collective handle ~ ProcessGroup.h:82-146 Task virtuals
+    (is_completed/wait/synchronize). JAX dispatch is asynchronous by
+    construction, so the 'task' is a view over the result buffers:
+    is_completed() polls buffer readiness, wait() blocks until the
+    collective's outputs are materialized."""
+
+    def __init__(self, tensors):
+        if not isinstance(tensors, (list, tuple)):
+            tensors = [tensors]
+        self._tensors = list(tensors)
+
+    def is_completed(self) -> bool:
+        from ..core.sync import is_ready
+        return all(is_ready(getattr(t, "_value", t)) for t in self._tensors)
+
+    def wait(self, timeout=None) -> bool:
+        """Block until the collective's outputs are materialized. With a
+        timeout (seconds), polls readiness and returns False on expiry
+        without blocking — ~ ProcessGroup Task::Wait(timeout)."""
+        import time as _time
+        from ..core.sync import hard_sync
+        if timeout is not None:
+            deadline = _time.time() + timeout
+            while not self.is_completed():
+                if _time.time() >= deadline:
+                    return False
+                _time.sleep(0.001)
+        for t in self._tensors:
+            hard_sync(getattr(t, "_value", t))
+        return True
+
+    def synchronize(self) -> None:
+        self.wait()
+
+
+def _maybe_task(tensor, sync_op: bool):
+    """sync_op=False returns an awaitable Task (reference async PG path);
+    sync_op=True keeps the historical return-the-tensor behavior."""
+    return tensor if sync_op else Task(tensor)
+
+
 _groups = {}
 _group_counter = 0
 
@@ -81,7 +123,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if group.nranks <= 1 or not _multi_process():
         if op == ReduceOp.AVG:
             pass
-        return tensor
+        return _maybe_task(tensor, sync_op)
     gathered = _allgather_host(tensor._value)  # (world, ...)
     sub = gathered[np.asarray(group.ranks)]
     if op == ReduceOp.SUM:
@@ -95,16 +137,16 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     else:
         out = jnp.mean(sub, axis=0)
     tensor._value = out.astype(tensor._value.dtype)
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def broadcast(tensor: Tensor, src: int, group=None, sync_op=True):
     group = group or _default_group()
     if group.nranks <= 1 or not _multi_process():
-        return tensor
+        return _maybe_task(tensor, sync_op)
     gathered = _allgather_host(tensor._value)
     tensor._value = jnp.asarray(gathered[src])
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
@@ -113,18 +155,18 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
     if group.nranks <= 1 or not _multi_process():
         tensor_list.extend([Tensor(tensor._value)
                             for _ in range(max(group.nranks, 1))])
-        return tensor_list
+        return _maybe_task(tensor_list, sync_op)
     gathered = _allgather_host(tensor._value)
     for r in group.ranks:
         tensor_list.append(Tensor(jnp.asarray(gathered[r])))
-    return tensor_list
+    return _maybe_task(tensor_list, sync_op)
 
 
 def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group=None,
            sync_op=True):
     group = group or _default_group()
     all_reduce(tensor, op=op, group=group)
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -132,7 +174,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if group.nranks <= 1 or not _multi_process():
         if tensor_list:
             tensor._value = tensor_list[0]._value
-        return tensor
+        return _maybe_task(tensor, sync_op)
     me = group.rank
     if tensor_list is not None:
         stacked = jnp.stack([t._value for t in tensor_list])
@@ -141,7 +183,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
                             tensor._value.dtype)
     gathered = _allgather_host(stacked)  # (world, n, ...)
     tensor._value = jnp.asarray(gathered[src][me])
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
@@ -149,13 +191,13 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     group = group or _default_group()
     if group.nranks <= 1 or not _multi_process():
         out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
-        return out_tensor_list
+        return _maybe_task(out_tensor_list, sync_op)
     stacked = jnp.stack([t._value for t in in_tensor_list])
     gathered = _allgather_host(stacked)  # (world, n, ...)
     me = group.rank
     for r in group.ranks:
         out_tensor_list.append(Tensor(jnp.asarray(gathered[r][me])))
-    return out_tensor_list
+    return _maybe_task(out_tensor_list, sync_op)
 
 
 def send(tensor: Tensor, dst: int, group=None, sync_op=True):
@@ -164,9 +206,9 @@ def send(tensor: Tensor, dst: int, group=None, sync_op=True):
     group = group or _default_group()
     if not _multi_process():
         _p2p_buffer.append(tensor._value)
-        return tensor
+        return _maybe_task(tensor, sync_op)
     _allgather_host(tensor._value)
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 _p2p_buffer: list = []
@@ -177,10 +219,10 @@ def recv(tensor: Tensor, src: int, group=None, sync_op=True):
     if not _multi_process():
         if _p2p_buffer:
             tensor._value = _p2p_buffer.pop(0)
-        return tensor
+        return _maybe_task(tensor, sync_op)
     gathered = _allgather_host(tensor._value)
     tensor._value = jnp.asarray(gathered[src])
-    return tensor
+    return _maybe_task(tensor, sync_op)
 
 
 def barrier(group=None):
@@ -192,7 +234,8 @@ def barrier(group=None):
 
 def wait(tensor: Tensor, group=None, use_calc_stream=True):
     """~ collective.py wait:440 — XLA has no user streams; block instead."""
-    jax.block_until_ready(tensor._value)
+    from ..core.sync import hard_sync
+    hard_sync(tensor._value)
 
 
 def get_rank(group=None) -> int:

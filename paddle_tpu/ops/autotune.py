@@ -99,15 +99,10 @@ def autotune_enabled() -> bool:
 
 
 def _sync(out) -> None:
-    """Force real device synchronization. Under the axon TPU tunnel
-    jax.block_until_ready returns before execution finishes — only a host
-    readback synchronizes — so read one scalar back (4 bytes)."""
-    leaves = [x for x in jax.tree_util.tree_leaves(out)
-              if hasattr(x, "ravel") and getattr(x, "size", 0)]
-    if leaves:
-        float(leaves[0].ravel()[0])
-    else:
-        jax.block_until_ready(out)
+    """Force real device synchronization (block_until_ready is not a real
+    barrier on remote-tunneled platforms — see core/sync.py)."""
+    from ..core.sync import hard_sync
+    hard_sync(out)
 
 
 def _time_once(fn: Callable, args, warmup: int = 1, iters: int = 3) -> float:

@@ -1,0 +1,70 @@
+"""Async Task semantics on the eager collective API.
+
+~ reference distributed/collective/ProcessGroup.h:82-146: every collective
+returns a Task with is_completed()/wait()/synchronize(). Here sync_op=False
+returns the Task view over the result buffers (JAX dispatch is async by
+construction); sync_op=True keeps the tensor-returning surface.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestCollectiveTask:
+    def test_all_reduce_async_returns_task(self):
+        t = Tensor(np.ones((4,), np.float32))
+        task = dist.all_reduce(t, sync_op=False)
+        assert isinstance(task, dist.Task)
+        assert task.wait() is True
+        assert task.is_completed()
+        np.testing.assert_allclose(t.numpy(), np.ones((4,), np.float32))
+
+    def test_sync_op_keeps_tensor_surface(self):
+        t = Tensor(np.ones((4,), np.float32))
+        out = dist.all_reduce(t, sync_op=True)
+        assert isinstance(out, Tensor)
+
+    def test_broadcast_and_reduce_tasks(self):
+        for fn in (lambda t: dist.broadcast(t, 0, sync_op=False),
+                   lambda t: dist.reduce(t, 0, sync_op=False)):
+            t = Tensor(np.arange(4, dtype=np.float32))
+            task = fn(t)
+            assert isinstance(task, dist.Task)
+            task.synchronize()
+            assert task.is_completed()
+
+    def test_all_gather_task_wraps_list(self):
+        t = Tensor(np.ones((2,), np.float32))
+        outs = []
+        task = dist.all_gather(outs, t, sync_op=False)
+        assert isinstance(task, dist.Task)
+        task.wait()
+        assert len(outs) >= 1
+        np.testing.assert_allclose(outs[0].numpy(), t.numpy())
+
+    def test_alltoall_task(self):
+        ins = [Tensor(np.full((2,), i, np.float32)) for i in range(2)]
+        outs = []
+        task = dist.alltoall(ins, outs, sync_op=False)
+        assert isinstance(task, dist.Task)
+        task.wait()
+        assert len(outs) == 2
+
+    def test_send_recv_tasks(self):
+        t = Tensor(np.arange(3, dtype=np.float32))
+        st = dist.send(t, dst=0, sync_op=False)
+        assert isinstance(st, dist.Task) and st.wait()
+        r = Tensor(np.zeros(3, np.float32))
+        rt = dist.recv(r, src=0, sync_op=False)
+        assert isinstance(rt, dist.Task) and rt.wait()
+        np.testing.assert_allclose(r.numpy(), t.numpy())
+
+    def test_scatter_task(self):
+        t = Tensor(np.zeros((2,), np.float32))
+        task = dist.scatter(t, [Tensor(np.ones((2,), np.float32))],
+                            src=0, sync_op=False)
+        assert isinstance(task, dist.Task)
+        task.wait()
+        np.testing.assert_allclose(t.numpy(), np.ones((2,), np.float32))

@@ -73,7 +73,6 @@ def bench_lenet():
 
 
 def bench_resnet50(on_tpu):
-    import jax
     import paddle_tpu as paddle
     from paddle_tpu.vision.models import resnet50
 
@@ -84,15 +83,16 @@ def bench_resnet50(on_tpu):
     x = paddle.to_tensor(np.random.default_rng(0).normal(
         0, 1, (B, 3, HW, HW)).astype(np.float32))
 
+    from paddle_tpu.core.sync import hard_sync
     from paddle_tpu.jit import to_static
     fwd = to_static(model.forward)
     out = fwd(x)
-    jax.block_until_ready(out._value)
+    hard_sync(out._value)  # block_until_ready is not a real sync on axon
     t0 = time.perf_counter()
     n = 10 if on_tpu else 3
     for _ in range(n):
         out = fwd(x)
-    jax.block_until_ready(out._value)
+    hard_sync(out._value)
     dt = (time.perf_counter() - t0) / n
     return {"metric": "resnet50_fwd_images_per_sec",
             "value": round(B / dt, 1), "unit": "images/sec",
@@ -159,32 +159,77 @@ def bench_moe(on_tpu):
     B, S = (8, 256) if on_tpu else (2, 16)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    from paddle_tpu.core.sync import hard_sync
     jit_fwd = jax.jit(fwd)
-    jax.block_until_ready(jit_fwd(params, tokens))
+    hard_sync(jit_fwd(params, tokens))
     n = 10 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(n):
         out = jit_fwd(params, tokens)
-    jax.block_until_ready(out)
+    hard_sync(out)
     dt = (time.perf_counter() - t0) / n
     return {"metric": "moe_fwd_tokens_per_sec",
             "value": round(B * S / dt, 1), "unit": "tokens/sec"}
 
 
+def bench_decode(on_tpu):
+    """Config 6 (exceeds the ladder): compiled KV-cache greedy decode
+    throughput — the fused_multi_transformer serving analog."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048, dtype=jnp.bfloat16)
+        B, prompt_len, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        B, prompt_len, new = 2, 8, 8
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    gen = llama_decode_factory(model, max_len=prompt_len + new)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt_len)),
+                         jnp.int32)
+    from paddle_tpu.core.sync import hard_sync
+    out = gen(prompt, max_new_tokens=new)
+    hard_sync(out)
+    n = 3 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = gen(prompt, max_new_tokens=new)
+    hard_sync(out)
+    dt = (time.perf_counter() - t0) / n
+    return {"metric": "llama_decode_tokens_per_sec",
+            "value": round(B * new / dt, 1), "unit": "tokens/sec",
+            "batch": B, "prompt": prompt_len, "new_tokens": new}
+
+
 def main():
-    want = set(sys.argv[1:]) or {"1", "2", "3", "5"}
+    want = set(sys.argv[1:]) or {"1", "2", "3", "5", "6"}
     backend = _backend()
     on_tpu = backend != "cpu"
     runners = {"1": bench_lenet,
                "2": lambda: bench_resnet50(on_tpu),
                "3": lambda: bench_bert(on_tpu),
-               "5": lambda: bench_moe(on_tpu)}
+               "5": lambda: bench_moe(on_tpu),
+               "6": lambda: bench_decode(on_tpu)}
     if "4" in want:
         print(json.dumps({"metric": "llama_train_mfu",
                           "note": "run bench.py (the driver entry)"}))
     for k in sorted(want & set(runners)):
         try:
-            res = runners[k]() if k != "1" else runners[k]()
+            res = runners[k]()
             res["config"] = int(k)
             res["backend"] = backend
             print(json.dumps(res))

@@ -33,14 +33,14 @@ CASES = [
 
 
 def time_op(fn, args, iters=20, warmup=3):
-    import jax
+    from paddle_tpu.core.sync import hard_sync
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out._value if hasattr(out, "_value") else out)
+    hard_sync(out._value if hasattr(out, "_value") else out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out._value if hasattr(out, "_value") else out)
+    hard_sync(out._value if hasattr(out, "_value") else out)
     return (time.perf_counter() - t0) / iters
 
 
