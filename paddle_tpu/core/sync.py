@@ -20,7 +20,10 @@ def hard_sync(value) -> None:
     leaves = jax.tree_util.tree_leaves(value)
     jax.block_until_ready(leaves)
     for leaf in leaves:
-        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+        if (hasattr(leaf, "ravel") and getattr(leaf, "size", 0)
+                and getattr(leaf, "is_fully_addressable", True)):
+            # multi-host global arrays can't be fetched from one process;
+            # block_until_ready above is the best available barrier there
             jax.device_get(jax.numpy.ravel(leaf)[0])
 
 

@@ -53,19 +53,30 @@ class Task:
 
     def wait(self, timeout=None) -> bool:
         """Block until the collective's outputs are materialized. With a
-        timeout (seconds), polls readiness and returns False on expiry
-        without blocking — ~ ProcessGroup Task::Wait(timeout)."""
-        import time as _time
+        timeout (seconds), returns False on expiry — ~ ProcessGroup
+        Task::Wait(timeout). The bounded wait runs the sync in a helper
+        thread (readiness polling alone is unreliable on platforms whose
+        buffers lack is_ready), so the deadline holds on every backend."""
         from ..core.sync import hard_sync
-        if timeout is not None:
-            deadline = _time.time() + timeout
-            while not self.is_completed():
-                if _time.time() >= deadline:
-                    return False
-                _time.sleep(0.001)
-        for t in self._tensors:
-            hard_sync(getattr(t, "_value", t))
-        return True
+
+        def _sync_all():
+            for t in self._tensors:
+                hard_sync(getattr(t, "_value", t))
+
+        if timeout is None:
+            _sync_all()
+            return True
+        import threading
+        done = threading.Event()
+
+        def _worker():
+            try:
+                _sync_all()
+            finally:
+                done.set()
+
+        threading.Thread(target=_worker, daemon=True).start()
+        return done.wait(timeout)
 
     def synchronize(self) -> None:
         self.wait()

@@ -15,5 +15,6 @@ from . import tensor_methods as _tm
 from . import codegen as _codegen
 from .codegen import infer_meta  # noqa: F401
 
-_generated_ops = _codegen.generate(globals())
+# math-group specs are generated inside ops/math.py (imported above via *)
+_generated_ops = _codegen.generate(globals(), exclude_groups={"math"})
 _tm.install()

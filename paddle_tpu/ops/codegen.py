@@ -20,7 +20,14 @@ _SPEC_PATH = os.path.join(os.path.dirname(__file__), "specs.yaml")
 
 
 def _compile_lowering(expr: str):
-    """'x, y=1 -> body' -> python function over jax values."""
+    """'x, y=1 -> body' lambda spec, or a dotted callable path such as
+    'jnp.add' / 'jax.lax.rsqrt' -> python function over jax values."""
+    if "->" not in expr:
+        root, *attrs = expr.strip().split(".")
+        obj = {"jnp": jnp, "jax": jax}[root]
+        for a in attrs:
+            obj = getattr(obj, a)
+        return obj
     sig, body = expr.split("->", 1)
     src = f"lambda {sig.strip()}: {body.strip()}"
     return eval(src, {"jnp": jnp, "jax": jax})  # noqa: S307 (trusted spec)
@@ -31,16 +38,35 @@ def _parse_attr(s: str):
     return name.strip(), eval(default, {})  # noqa: S307
 
 
+_SPEC_CACHE: Dict[str, List[Dict[str, Any]]] = {}
+
+
 def load_specs(path: str = _SPEC_PATH) -> List[Dict[str, Any]]:
-    import yaml
-    with open(path) as f:
-        return yaml.safe_load(f)
+    # cached: the YAML is parsed once even though ops/math.py and
+    # ops/__init__.py both generate (different groups) at import
+    if path not in _SPEC_CACHE:
+        import yaml
+        with open(path) as f:
+            _SPEC_CACHE[path] = yaml.safe_load(f)
+    return _SPEC_CACHE[path]
 
 
-def generate(namespace: dict, path: str = _SPEC_PATH) -> List[str]:
-    """Create API functions for every spec entry; returns generated names."""
+def generate(namespace: dict, path: str = _SPEC_PATH, groups=None,
+             exclude_groups=None) -> List[str]:
+    """Create API functions for spec entries; returns generated names.
+
+    ``groups``/``exclude_groups`` filter on each spec's ``group`` field
+    (default group: "misc") so kernel-family modules (ops/math.py) can own
+    their sections of the YAML while ops/__init__ generates the rest —
+    mirroring the reference's per-family api.yaml organisation.
+    """
     names = []
     for spec in load_specs(path):
+        g = spec.get("group", "misc")
+        if groups is not None and g not in groups:
+            continue
+        if exclude_groups is not None and g in exclude_groups:
+            continue
         opname = spec["op"]
         fn = _compile_lowering(spec["lowering"])
         nondiff = bool(spec.get("nondiff", False))
@@ -50,9 +76,13 @@ def generate(namespace: dict, path: str = _SPEC_PATH) -> List[str]:
         def make_api(opname=opname, fn=fn, nondiff=nondiff, attrs=attrs,
                      n_args=n_args):
             def api(*args, **kwargs):
+                if len(args) > n_args:
+                    raise TypeError(
+                        f"{opname}() takes {n_args} positional argument(s) "
+                        f"but {len(args)} were given")
                 merged = dict(attrs)
                 merged.update(kwargs)
-                return apply_op(opname, fn, *args[:n_args], nondiff=nondiff,
+                return apply_op(opname, fn, *args, nondiff=nondiff,
                                 **merged)
             api.__name__ = opname
             api.op_name = opname
