@@ -46,6 +46,9 @@ class Task:
         if not isinstance(tensors, (list, tuple)):
             tensors = [tensors]
         self._tensors = list(tensors)
+        self._sync_thread = None
+        self._sync_done = None
+        self._sync_exc = []
 
     def is_completed(self) -> bool:
         from ..core.sync import is_ready
@@ -54,9 +57,10 @@ class Task:
     def wait(self, timeout=None) -> bool:
         """Block until the collective's outputs are materialized. With a
         timeout (seconds), returns False on expiry — ~ ProcessGroup
-        Task::Wait(timeout). The bounded wait runs the sync in a helper
-        thread (readiness polling alone is unreliable on platforms whose
-        buffers lack is_ready), so the deadline holds on every backend."""
+        Task::Wait(timeout). The bounded wait runs the sync in ONE helper
+        thread per Task, reused across retries (readiness polling alone is
+        unreliable on platforms whose buffers lack is_ready), so the
+        deadline holds on every backend; sync failures re-raise here."""
         from ..core.sync import hard_sync
 
         def _sync_all():
@@ -64,19 +68,32 @@ class Task:
                 hard_sync(getattr(t, "_value", t))
 
         if timeout is None:
-            _sync_all()
-            return True
-        import threading
-        done = threading.Event()
-
-        def _worker():
-            try:
+            if self._sync_thread is None:
                 _sync_all()
-            finally:
-                done.set()
+                return True
+            self._sync_done.wait()
+            if self._sync_exc:
+                raise self._sync_exc[0]
+            return True
 
-        threading.Thread(target=_worker, daemon=True).start()
-        return done.wait(timeout)
+        import threading
+        if self._sync_thread is None:
+            self._sync_done = threading.Event()
+
+            def _worker():
+                try:
+                    _sync_all()
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    self._sync_exc.append(e)
+                finally:
+                    self._sync_done.set()
+
+            self._sync_thread = threading.Thread(target=_worker, daemon=True)
+            self._sync_thread.start()
+        ok = self._sync_done.wait(timeout)
+        if ok and self._sync_exc:
+            raise self._sync_exc[0]
+        return ok
 
     def synchronize(self) -> None:
         self.wait()

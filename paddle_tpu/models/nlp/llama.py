@@ -103,6 +103,17 @@ def _context_parallel_mesh():
     return None, None
 
 
+def _flash_eligible(seq_len: int, head_dim: int, dtype) -> bool:
+    """One gate for every flash-attention entry (GQA and MHA paths must
+    never diverge): kernel supports 128-multiple sequences >= 256 and the
+    MXU-tiled head dims, under the FLAGS_use_flash_attention switch."""
+    from ...core import flags as _flags
+    return (bool(_flags.get_flag("use_flash_attention"))
+            and seq_len >= 256 and seq_len % 128 == 0
+            and head_dim in (64, 128, 256)
+            and dtype in (jnp.float32, jnp.bfloat16))
+
+
 def _rope_freqs(head_dim, theta):
     return 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
 
@@ -170,10 +181,25 @@ class LlamaAttention(nn.Layer):
             pos = jnp.arange(S) if positions is None else positions
             qv = apply_rotary(qv, pos, theta)
             kv = apply_rotary(kv, pos, theta)
+            scale = 1.0 / math.sqrt(qv.shape[-1])
+
+            # GQA fast path: the grouped kernel keeps K/V at their true
+            # head count (no n_rep x HBM/VMEM blowup from jnp.repeat)
+            use_flash_gqa = (n_rep > 1
+                             and _flash_eligible(qv.shape[1], qv.shape[-1],
+                                                 qv.dtype)
+                             and _context_parallel_mesh()[0] is None)
+            if use_flash_gqa:
+                from ...ops.pallas.flash_attention_gqa import (
+                    grouped_flash_attention)
+                out = grouped_flash_attention(
+                    jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kv, 1, 2),
+                    jnp.swapaxes(vv, 1, 2), True, scale)
+                return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
+
             if n_rep > 1:
                 kv = jnp.repeat(kv, n_rep, axis=2)
                 vv = jnp.repeat(vv, n_rep, axis=2)
-            scale = 1.0 / math.sqrt(qv.shape[-1])
             qt = jnp.swapaxes(qv, 1, 2)
             kt = jnp.swapaxes(kv, 1, 2)
             vt = jnp.swapaxes(vv, 1, 2)
@@ -197,12 +223,7 @@ class LlamaAttention(nn.Layer):
                                          head_axis="model")
                 return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
-            from ...core import flags as _flags
-            use_flash = (_flags.get_flag("use_flash_attention")
-                         and S >= 256 and S % 128 == 0
-                         and qt.shape[-1] in (64, 128, 256)
-                         and qt.dtype in (jnp.float32, jnp.bfloat16))
-            if use_flash:
+            if _flash_eligible(S, qt.shape[-1], qt.dtype):
                 # no silent fallback: a failing kernel must raise, not
                 # quietly degrade to the O(S^2) path (round-1 verdict)
                 from ...ops.pallas.flash_attention import flash_attention

@@ -1,0 +1,304 @@
+"""Grouped-query (GQA/MQA) flash attention Pallas TPU kernel.
+
+The reference has no GQA-aware fused attention (fused_attention_op.cu
+predates GQA); the portable fallback repeats K/V across query groups
+(jnp.repeat) which multiplies K/V HBM traffic and VMEM residency by
+n_groups. This kernel keeps K/V at their true head count: each grid
+program processes ALL G query heads that share one kv head, flattening
+the group into the matmul M dimension — the MXU sees a (G*bq, d)@(d, bk)
+score matmul (bigger, not more, calls) and K/V are fetched once per kv
+head instead of once per query head.
+
+Layouts: q (B, G*Hkv, S, D) with head order grouped by kv head
+(h = kv_head * G + g — jnp.repeat convention); k/v (B, Hkv, S, D).
+Same resident-KV fori-walk + exp2-domain design as flash_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import (LN2, LOG2E, NEG_INF, _interpret,
+                              _resolve_blocks)
+
+
+def _pos_grids(rows, block_k, qi, kj, block_q):
+    """(q_pos, k_pos) grids for a (G*bq, bk) score block: row r belongs to
+    query position qi*bq + (r % bq) — the group index g = r // bq shares
+    positions across the G heads."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+    q_pos = qi * block_q + jax.lax.rem(r, block_q)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_k), 1)
+    return q_pos, k_pos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, kv_len, groups):
+    qi = pl.program_id(1)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+    q = q_ref[0].reshape(rows, D)  # (G, bq, D) -> (G*bq, D)
+    q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+
+    m = jnp.full((rows,), NEG_INF, jnp.float32)
+    l = jnp.zeros((rows,), jnp.float32)
+    acc = jnp.zeros((rows, D), jnp.float32)
+
+    num_kv = kv_len // block_k
+    if causal:
+        num_live = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                               num_kv)
+    else:
+        num_live = num_kv
+
+    def body(kj, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(kj * block_k, block_k)]
+        v = v_ref[0, pl.dslice(kj * block_k, block_k)]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos, k_pos = _pos_grids(rows, block_k, qi, kj, block_q)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_live, body, (m, l, acc))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).reshape(G, block_q, D).astype(
+        o_ref.dtype)
+    lse_ref[0] = (LN2 * m + jnp.log(l_safe)).reshape(G, block_q, 1).astype(
+        jnp.float32)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, sm_scale, causal, block_q, block_k, kv_len, groups):
+    qi = pl.program_id(1)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+    q = q_ref[0].reshape(rows, D)
+    q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+    do = do_ref[0].reshape(rows, D)
+    lse2 = lse_ref[0].reshape(rows) * LOG2E
+    delta = delta_ref[0].reshape(rows)
+    dq = jnp.zeros((rows, D), jnp.float32)
+    num_kv = kv_len // block_k
+    if causal:
+        num_live = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                               num_kv)
+    else:
+        num_live = num_kv
+
+    def body(kj, dq):
+        k = k_ref[0, pl.dslice(kj * block_k, block_k)]
+        v = v_ref[0, pl.dslice(kj * block_k, block_k)]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos, k_pos = _pos_grids(rows, block_k, qi, kj, block_q)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp2(s - lse2[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k,
+                                        (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_live, body, dq)
+    dq_ref[0] = dq.reshape(G, block_q, D).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
+                    q_len, groups):
+    kj = pl.program_id(1)
+    G = groups
+    D = q_ref.shape[-1]
+    k = k_ref[0]  # (block_k, D)
+    v = v_ref[0]
+    k2 = (k.astype(jnp.float32) * (sm_scale * LOG2E)).astype(k.dtype)
+    dk = jnp.zeros(k.shape, jnp.float32)
+    dv = jnp.zeros(v.shape, jnp.float32)
+    num_q = q_len // block_q
+    first_live = (kj * block_k) // block_q if causal else 0
+    rows = G * block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(rows, D)
+        do = do_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(rows, D)
+        lse2 = lse_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(
+            rows) * LOG2E
+        delta = delta_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(
+            rows)
+        s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos, k_pos = _pos_grids(rows, block_k, qi, kj, block_q)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp2(s - lse2[:, None])  # (G*bq, bk)
+        dv_new = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_new = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(first_live, num_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _shapes(q, k):
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
+    if Hq % Hkv:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hkv}")
+    return B, Hq, Hkv, Hq // Hkv, Sq, D
+
+
+def _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
+    B, Hq, Hkv, G, Sq, D = _shapes(q, k)
+    Sk = k.shape[2]
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"grouped_flash_attention blocks ({block_q},{block_k}) must "
+            f"divide seq lens ({Sq},{Sk})")
+    bh = B * Hkv
+    # head order: h = kv*G + g (jnp.repeat convention)
+    qr = q.reshape(bh, G, Sq, D)
+    kr = k.reshape(bh, Sk, D)
+    vr = v.reshape(bh, Sk, D)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k, kv_len=Sk,
+                               groups=G)
+    out, lse = functools.partial(pl.pallas_call, interpret=_interpret())(
+        kernel,
+        grid=(bh, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, G, block_q, D), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, G, block_q, D), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, block_q, 1), lambda b, i: (b, 0, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, G, Sq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(qr, kr, vr)
+    return (out.reshape(B, Hq, Sq, D),
+            lse.reshape(B, Hq, Sq))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
+                            block_q=None, block_k=None):
+    """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) with Hq = G*Hkv. Equivalent to
+    flash_attention over jnp.repeat(k/v, G, axis=1) without the repeat."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
+                                       block_q, block_k)
+    out, _ = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
+                                       block_q, block_k)
+    out, lse = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
+                                       block_q, block_k)
+    B, Hq, Hkv, G, Sq, D = _shapes(q, k)
+    Sk = k.shape[2]
+    bh = B * Hkv
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, G, Sq, 1)
+    qr = q.reshape(bh, G, Sq, D)
+    kr = k.reshape(bh, Sk, D)
+    vr = v.reshape(bh, Sk, D)
+    dor = do.reshape(bh, G, Sq, D)
+    lser = lse.reshape(bh, G, Sq, 1)
+
+    dq = functools.partial(pl.pallas_call, interpret=_interpret())(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=Sk,
+                          groups=G),
+        grid=(bh, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, G, block_q, D), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, G, block_q, D), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, block_q, 1), lambda b, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, block_q, 1), lambda b, i: (b, 0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, block_q, D), lambda b, i: (b, 0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lser, delta)
+
+    dk, dv = functools.partial(pl.pallas_call, interpret=_interpret())(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, q_len=Sq,
+                          groups=G),
+        grid=(bh, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, G, Sq, D), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, G, Sq, D), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, G, Sq, 1), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, G, Sq, 1), lambda b, j: (b, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((bh, Sk, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(B, Hq, Sq, D), dk.reshape(B, Hkv, Sk, D),
+            dv.reshape(B, Hkv, Sk, D))
+
+
+grouped_flash_attention.defvjp(_fa_fwd, _fa_bwd)
