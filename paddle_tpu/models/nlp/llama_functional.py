@@ -132,31 +132,45 @@ def llama_pp_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                                 n_microbatches: int = 2,
                                 learning_rate=1e-4, weight_decay=0.01,
                                 beta1=0.9, beta2=0.95, eps=1e-8,
-                                remat: bool = True):
+                                remat: bool = True, n_virtual: int = 1):
     """dp x pp compiled training step.
 
     mesh axes: 'pipe' (required) and optionally 'data'. Decoder layers are
     evenly split over stages; stage leaf shape (n_stages, L/stage, ...).
+    n_virtual > 1 switches to the breadth-first interleaved schedule
+    (pipeline_apply_interleaved): layers lay out as (V, P, L/(P*V), ...)
+    with round-robin stage placement, shrinking the pipeline bubble by V.
     Returns (params, opt_state, step_fn).
     """
-    from ...parallel.pipeline import pipeline_apply
+    from ...parallel.pipeline import (pipeline_apply,
+                                      pipeline_apply_interleaved)
 
     cfg = model.config
     n_stages = mesh.shape["pipe"]
     data_axis = "data" if "data" in mesh.axis_names else None
     L = cfg.num_hidden_layers
-    assert L % n_stages == 0, (L, n_stages)
-    per = L // n_stages
+    V = n_virtual
+    assert L % (n_stages * V) == 0, (L, n_stages, V)
+    per = L // (n_stages * V)
 
     outer, layers = split_params(model)
-    # reshape stacked layers (L, ...) -> (n_stages, per, ...)
-    layers = jax.tree.map(
-        lambda a: jnp.array(a, copy=True).reshape(
-            (n_stages, per) + a.shape[1:]), layers)
+    if V > 1:
+        # (L, ...) -> (V, P, per, ...): [v, d] holds global stage v*P + d,
+        # i.e. decoder layers (v*P + d)*per ... +per
+        layers = jax.tree.map(
+            lambda a: jnp.array(a, copy=True).reshape(
+                (V, n_stages, per) + a.shape[1:]), layers)
+        pipe_spec = P(None, "pipe")
+    else:
+        # reshape stacked layers (L, ...) -> (n_stages, per, ...)
+        layers = jax.tree.map(
+            lambda a: jnp.array(a, copy=True).reshape(
+                (n_stages, per) + a.shape[1:]), layers)
+        pipe_spec = P("pipe")
     outer = {k: jnp.array(v, copy=True) for k, v in outer.items()}
 
     rep = NamedSharding(mesh, P())
-    pipe_sh = {k: NamedSharding(mesh, P("pipe"))
+    pipe_sh = {k: NamedSharding(mesh, pipe_spec)
                for k in layers}
     outer_sh = {k: rep for k in outer}
     outer = {k: jax.device_put(v, rep) for k, v in outer.items()}
@@ -186,8 +200,15 @@ def llama_pp_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     def pipe_loss(params, tokens, labels):
         emb = jnp.take(params["outer"]["model.embed_tokens.weight"], tokens,
                        axis=0)
-        h = pipeline_apply(stage_fn, params["layers"], emb, mesh,
-                           n_microbatches, remat=remat, data_axis=data_axis)
+        if V > 1:
+            h = pipeline_apply_interleaved(
+                stage_fn, params["layers"], emb, mesh, n_microbatches,
+                n_virtual=V, remat=remat, data_axis=data_axis,
+                params_layout="vp")
+        else:
+            h = pipeline_apply(stage_fn, params["layers"], emb, mesh,
+                               n_microbatches, remat=remat,
+                               data_axis=data_axis)
         h = _rms(h, params["outer"]["model.norm.weight"], cfg.rms_norm_eps)
         head = params["outer"].get("lm_head.weight")
         logits = (h @ (head if head is not None

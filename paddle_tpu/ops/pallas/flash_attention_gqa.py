@@ -23,8 +23,24 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import (LN2, LOG2E, NEG_INF, _interpret,
+from .flash_attention import (LN2, LOG2E, NEG_INF, _interpret, _pick_block,
                               _resolve_blocks)
+
+
+def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
+    """Group-aware block pick: score/probability buffers are (G*block_q,
+    block_k) f32, so block_q shrinks with G to keep rows <= 1024 (2 MB of
+    f32 at block_k=512) — the ungrouped 512 default would put G=8 configs
+    over VMEM."""
+    if block_q is None:
+        cap = max(128, 1024 // G)
+        for cand in (512, 256, 128):
+            if cand <= cap and Sq % cand == 0:
+                block_q = cand
+                break
+        else:
+            block_q = min(_pick_block(Sq), cap)
+    return _resolve_blocks(Sq, Sk, block_q, block_k)
 
 
 def _pos_grids(rows, block_k, qi, kj, block_q):
@@ -124,48 +140,54 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
-                    q_len, groups):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_k, num_q, groups):
+    """Unlike the MHA kernel (full q/do resident in VMEM — fine at
+    rows=block_q), the grouped q/do blocks are G-times taller, so the q
+    walk streams through the innermost GRID dimension with dk/dv in VMEM
+    scratch; Mosaic double-buffers the next q/do block DMA."""
     kj = pl.program_id(1)
+    qi = pl.program_id(2)
     G = groups
     D = q_ref.shape[-1]
-    k = k_ref[0]  # (block_k, D)
-    v = v_ref[0]
-    k2 = (k.astype(jnp.float32) * (sm_scale * LOG2E)).astype(k.dtype)
-    dk = jnp.zeros(k.shape, jnp.float32)
-    dv = jnp.zeros(v.shape, jnp.float32)
-    num_q = q_len // block_q
-    first_live = (kj * block_k) // block_q if causal else 0
     rows = G * block_q
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(rows, D)
-        do = do_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(rows, D)
-        lse2 = lse_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(
-            rows) * LOG2E
-        delta = delta_ref[0, :, pl.dslice(qi * block_q, block_q)].reshape(
-            rows)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    live = (qi * block_q + block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k = k_ref[0]  # (block_k, D)
+        v = v_ref[0]
+        k2 = (k.astype(jnp.float32) * (sm_scale * LOG2E)).astype(k.dtype)
+        q = q_ref[0].reshape(rows, D)
+        do = do_ref[0].reshape(rows, D)
+        lse2 = lse_ref[0].reshape(rows) * LOG2E
+        delta = delta_ref[0].reshape(rows)
         s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
             q_pos, k_pos = _pos_grids(rows, block_k, qi, kj, block_q)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp2(s - lse2[:, None])  # (G*bq, bk)
-        dv_new = dv + jax.lax.dot_general(
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        dk_new = dk + jax.lax.dot_general(
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(first_live, num_q, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == num_q - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _shapes(q, k):
@@ -221,8 +243,9 @@ def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
     flash_attention over jnp.repeat(k/v, G, axis=1) without the repeat."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
-                                       block_q, block_k)
+    G = q.shape[1] // max(1, k.shape[1])
+    block_q, block_k = _gqa_resolve_blocks(q.shape[2], k.shape[2], G,
+                                           block_q, block_k)
     out, _ = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
     return out
 
@@ -230,8 +253,9 @@ def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
-                                       block_q, block_k)
+    G = q.shape[1] // max(1, k.shape[1])
+    block_q, block_k = _gqa_resolve_blocks(q.shape[2], k.shape[2], G,
+                                           block_q, block_k)
     out, lse = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
     return out, (q, k, v, out, lse)
 
@@ -240,8 +264,9 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
     q, k, v, out, lse = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
-                                       block_q, block_k)
+    G0 = q.shape[1] // max(1, k.shape[1])
+    block_q, block_k = _gqa_resolve_blocks(q.shape[2], k.shape[2], G0,
+                                           block_q, block_k)
     B, Hq, Hkv, G, Sq, D = _shapes(q, k)
     Sk = k.shape[2]
     bh = B * Hkv
@@ -272,29 +297,34 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
             dimension_semantics=("parallel", "arbitrary")),
     )(qr, kr, vr, dor, lser, delta)
 
+    num_q = Sq // block_q
     dk, dv = functools.partial(pl.pallas_call, interpret=_interpret())(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, q_len=Sq,
+                          block_q=block_q, block_k=block_k, num_q=num_q,
                           groups=G),
-        grid=(bh, Sk // block_k),
+        grid=(bh, Sk // block_k, num_q),
         in_specs=[
-            pl.BlockSpec((1, G, Sq, D), lambda b, j: (b, 0, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, G, Sq, D), lambda b, j: (b, 0, 0, 0)),
-            pl.BlockSpec((1, G, Sq, 1), lambda b, j: (b, 0, 0, 0)),
-            pl.BlockSpec((1, G, Sq, 1), lambda b, j: (b, 0, 0, 0)),
+            pl.BlockSpec((1, G, block_q, D), lambda b, j, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, G, block_q, D), lambda b, j, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, block_q, 1), lambda b, j, i: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, block_q, 1), lambda b, j, i: (b, 0, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, Sk, D), k.dtype),
             jax.ShapeDtypeStruct((bh, Sk, D), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(qr, kr, vr, dor, lser, delta)
 
     return (dq.reshape(B, Hq, Sq, D), dk.reshape(B, Hkv, Sk, D),

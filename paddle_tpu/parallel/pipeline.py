@@ -122,13 +122,129 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     B = x.shape[0]
     mb = B // n_microbatches
     xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+    return _launch(spmd, stacked_params, xm, mesh, axis, data_axis,
+                   auto_axes, shard_input, B, stage_leading_spec=P(axis))
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stacked_params, x,
+                               mesh: Mesh, n_microbatches: int,
+                               n_virtual: int, axis: str = "pipe",
+                               remat: bool = True,
+                               data_axis: str | None = None,
+                               auto_axes=None,
+                               params_layout: str = "stacked"):
+    """Breadth-first interleaved pipeline (virtual pipeline stages).
+
+    Exceeds both the GPipe schedule above and the reference's 1F1B (which
+    carries a comment that interleaving is NOT implemented,
+    pipeline_parallel.py:84): global stage s = v*P + d lives on device
+    s % P as virtual chunk v = s // P (Megatron-style round-robin
+    placement), and micro m's stage s runs at tick
+
+        t(m, s) = (m // P)*P*V + s + (m % P)
+
+    which satisfies the hop dependency t(m, s) = t(m, s-1) + 1 under a
+    uniform +1 ring rotation — INCLUDING the wrap from device P-1 back to
+    device 0 (the activation re-enters one tick later as chunk v+1, so no
+    inter-chunk buffering exists at all). Every device does exactly one
+    stage-computation per tick for the whole M*V working window: the only
+    bubble is the ring skew, (P-1)/(M*V + P - 1) — a factor V smaller
+    than GPipe's (P-1)/(M + P - 1).
+
+    stacked_params: pytree with leading axis Sg = P*V in global stage
+    order (params_layout="stacked"), or already laid out as (V, P, ...)
+    with axis 1 sharded over `axis` (params_layout="vp" — what a train
+    step should keep between iterations to avoid relayout). Requires
+    n_microbatches % P == 0.
+    """
+    n_stages = mesh.shape[axis]
+    V = n_virtual
+    if V < 1:
+        raise ValueError("n_virtual must be >= 1")
+    if n_microbatches % n_stages != 0:
+        raise ValueError(
+            f"interleaved schedule needs n_microbatches "
+            f"({n_microbatches}) divisible by n_stages ({n_stages})")
+    lead = jax.tree_util.tree_leaves(stacked_params)[0].shape
+    if params_layout == "vp":
+        if lead[0] != V or lead[1] != n_stages:
+            raise ValueError(
+                f"vp-layout params lead with {lead[:2]}, expected "
+                f"({V}, {n_stages})")
+        params_vp = stacked_params
+    else:
+        if lead[0] != n_stages * V:
+            raise ValueError(
+                f"stacked params carry {lead[0]} stages, expected "
+                f"n_stages*n_virtual = {n_stages * V}")
+        # (Sg, ...) -> (V, P, ...): element [v, d] is global stage v*P + d
+        params_vp = jax.tree.map(
+            lambda l: l.reshape((V, n_stages) + l.shape[1:]), stacked_params)
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def spmd(params, xm):
+        # params leaf: (V, 1, ...) local slice -> (V, ...)
+        params = jax.tree.map(lambda p: p[:, 0], params)
+        d = jax.lax.axis_index(axis)
+        P_ = n_stages
+        M = n_microbatches
+        PV = P_ * V
+        work = M * V
+        ticks = work + P_ - 1
+        state = jnp.zeros_like(xm[0])
+        outputs = jnp.zeros((M,) + xm.shape[1:], xm.dtype)
+
+        def tick(carry, t):
+            state, outputs = carry
+            u = t - d
+            valid = jnp.logical_and(u >= 0, u < work)
+            uc = jnp.clip(u, 0, work - 1)
+            g = uc // PV
+            v = (uc % PV) // P_
+            r = uc % P_
+            m = g * P_ + r
+            inject = jnp.logical_and(jnp.logical_and(d == 0, v == 0), valid)
+            x_in = jax.lax.select(
+                inject,
+                jax.lax.dynamic_index_in_dim(xm, m, 0, keepdims=False),
+                state)
+            pv = jax.tree.map(
+                lambda l: jax.lax.dynamic_index_in_dim(l, v, 0,
+                                                       keepdims=False),
+                params)
+            out = body(pv, x_in)
+            emit = jnp.logical_and(
+                jnp.logical_and(d == P_ - 1, v == V - 1), valid)
+            outputs = jax.lax.cond(
+                emit,
+                lambda o: jax.lax.dynamic_update_index_in_dim(o, out, m, 0),
+                lambda o: o, outputs)
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % P_) for i in range(P_)])
+            return (nxt, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, (state, outputs),
+                                       jnp.arange(ticks))
+        outputs = jax.lax.psum(
+            jnp.where(d == P_ - 1, 1.0, 0.0) * outputs, axis)
+        return outputs
+
+    B = x.shape[0]
+    mb = B // n_microbatches
+    xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+    return _launch(spmd, params_vp, xm, mesh, axis, data_axis, auto_axes,
+                   False, B, stage_leading_spec=P(None, axis))
+
+
+def _launch(spmd, params, xm, mesh, axis, data_axis, auto_axes,
+            shard_input, B, stage_leading_spec):
 
     # batch (microbatch dim 1) may additionally shard over a data axis —
     # each data shard runs its own pipeline instance over the same stages
     in_axis0 = axis if shard_input else None
     x_spec = P(in_axis0, data_axis)
     out_spec = P(None, data_axis) if data_axis else P()
-    in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), x_spec)
+    in_specs = (jax.tree.map(lambda _: stage_leading_spec, params), x_spec)
     kw = {}
     if auto_axes:
         # partial-manual shard_map: 'pipe'/'data' rotate explicitly, the
@@ -139,5 +255,5 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
             a for a in mesh.axis_names if a not in auto_axes)
     fn = jax.shard_map(spmd, mesh=mesh, in_specs=in_specs,
                        out_specs=out_spec, check_vma=False, **kw)
-    y = fn(stacked_params, xm)
+    y = fn(params, xm)
     return y.reshape((B,) + y.shape[2:])

@@ -1,0 +1,149 @@
+"""Breadth-first interleaved pipeline: parity with sequential stages.
+
+Exceeds the reference, whose dygraph pipeline carries a comment that
+interleaving is NOT implemented (pipeline_parallel.py:84): V virtual
+chunks per device with round-robin placement shrink the bubble to
+(P-1)/(M*V + P - 1).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel.pipeline import (pipeline_apply,
+                                          pipeline_apply_interleaved,
+                                          stack_stage_params)
+
+
+def _mesh(n, name="pipe"):
+    devs = jax.devices()[:n]
+    return Mesh(np.asarray(devs), (name,))
+
+
+def _stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(n, h, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"w": jnp.asarray(rng.normal(0, 0.5, (h, h)), jnp.float32),
+             "b": jnp.asarray(rng.normal(0, 0.1, (h,)), jnp.float32)}
+            for _ in range(n)]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage(p, x)
+    return x
+
+
+class TestInterleavedPipeline:
+    @pytest.mark.parametrize("P_,V,M", [(2, 2, 4), (2, 3, 4), (4, 2, 8)])
+    def test_forward_parity(self, P_, V, M):
+        h = 8
+        stages = _make_stages(P_ * V, h)
+        stacked = stack_stage_params(stages)
+        x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (M * 2, h)),
+                        jnp.float32)
+        mesh = _mesh(P_)
+        y = pipeline_apply_interleaved(_stage, stacked, x, mesh,
+                                       n_microbatches=M, n_virtual=V,
+                                       remat=False)
+        ref = _sequential(stages, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matches_plain_gpipe(self):
+        h = 8
+        P_, V, M = 2, 2, 4
+        stages = _make_stages(P_ * V, h, seed=3)
+        x = jnp.asarray(np.random.default_rng(2).normal(0, 1, (M * 2, h)),
+                        jnp.float32)
+        mesh = _mesh(P_)
+        y_int = pipeline_apply_interleaved(_stage, stack_stage_params(stages),
+                                           x, mesh, n_microbatches=M,
+                                           n_virtual=V, remat=False)
+
+        # plain GPipe over P devices: each device runs V chunks in sequence
+        def fused_stage(p, x):
+            for v in range(V):
+                x = _stage(jax.tree.map(lambda l: l[v], p), x)
+            return x
+
+        # contiguous pipeline: device d owns global stages d*V..d*V+V-1
+        per_dev_contig = [stack_stage_params(stages[d * V:(d + 1) * V])
+                          for d in range(P_)]
+        stacked_contig = stack_stage_params(per_dev_contig)
+        y_gpipe = pipeline_apply(fused_stage, stacked_contig, x, mesh,
+                                 n_microbatches=M, remat=False)
+        np.testing.assert_allclose(np.asarray(y_int), np.asarray(y_gpipe),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_flow_to_all_chunks(self):
+        h = 4
+        P_, V, M = 2, 2, 4
+        stages = _make_stages(P_ * V, h, seed=5)
+        stacked = stack_stage_params(stages)
+        x = jnp.asarray(np.random.default_rng(4).normal(0, 1, (M, h)),
+                        jnp.float32)
+        mesh = _mesh(P_)
+
+        def loss_pipe(params):
+            y = pipeline_apply_interleaved(_stage, params, x, mesh,
+                                           n_microbatches=M, n_virtual=V,
+                                           remat=True)
+            return jnp.sum(y ** 2)
+
+        def loss_ref(params):
+            xx = x
+            for s in range(P_ * V):
+                p = jax.tree.map(lambda l: l[s], params)
+                xx = _stage(p, xx)
+            return jnp.sum(xx ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_ref = jax.grad(loss_ref)(stacked)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+            assert float(jnp.abs(a).max()) > 0  # every chunk got gradient
+
+    def test_bad_config_raises(self):
+        h = 4
+        stages = _make_stages(4, h)
+        stacked = stack_stage_params(stages)
+        x = jnp.zeros((6, h), jnp.float32)
+        mesh = _mesh(2)
+        with pytest.raises(ValueError):
+            pipeline_apply_interleaved(_stage, stacked, x, mesh,
+                                       n_microbatches=3, n_virtual=2)
+        with pytest.raises(ValueError):
+            pipeline_apply_interleaved(_stage, stacked, x, mesh,
+                                       n_microbatches=2, n_virtual=3)
+
+
+class TestLlamaInterleavedFactory:
+    def test_pp_factory_n_virtual_loss_parity(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp import llama_functional as LF
+
+        cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=4, heads=4)
+        devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("data", "pipe"))
+        rng = np.random.default_rng(0)
+        tok = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+        lab = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+        losses = {}
+        for v in (1, 2):
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg)
+            p, o, step = LF.llama_pp_train_step_factory(
+                m, mesh, n_microbatches=2, remat=True, n_virtual=v)
+            p, o, loss = step(p, o, tok, lab)
+            _, _, loss2 = step(p, o, tok, lab)
+            losses[v] = (float(loss), float(loss2))
+        np.testing.assert_allclose(losses[1], losses[2], rtol=1e-5)
