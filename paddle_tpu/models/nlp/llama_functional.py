@@ -274,7 +274,7 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                                 n_microbatches: int = 2,
                                 learning_rate=1e-4, weight_decay=0.01,
                                 beta1=0.9, beta2=0.95, eps=1e-8,
-                                remat: bool = True):
+                                remat: bool = True, n_virtual: int = 1):
     """ONE jitted train step over data x sharding x model x pipe.
 
     ~ the reference's 4D HybridCommunicateGroup axes
@@ -291,17 +291,27 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     data_axis = "data" if "data" in mesh.axis_names else None
     mdl = "model" if "model" in have else None
     L = cfg.num_hidden_layers
-    assert L % n_stages == 0, (L, n_stages)
-    per = L // n_stages
+    V = n_virtual
+    assert L % (n_stages * V) == 0, (L, n_stages, V)
+    per = L // (n_stages * V)
 
     outer, layers = split_params(model)
-    layers = jax.tree.map(
-        lambda a: jnp.array(a, copy=True).reshape(
-            (n_stages, per) + a.shape[1:]), layers)
+    if V > 1:
+        # (L, ...) -> (V, P, per, ...): [v, d] = global stage v*P + d
+        # (breadth-first interleaved placement)
+        layers = jax.tree.map(
+            lambda a: jnp.array(a, copy=True).reshape(
+                (V, n_stages, per) + a.shape[1:]), layers)
+        pipe_prefix = [None, "pipe"]
+    else:
+        layers = jax.tree.map(
+            lambda a: jnp.array(a, copy=True).reshape(
+                (n_stages, per) + a.shape[1:]), layers)
+        pipe_prefix = ["pipe"]
     outer = {k: jnp.array(v, copy=True) for k, v in outer.items()}
 
     def layer_spec(key, shape):
-        spec = ["pipe"] + [None] * (len(shape) - 1)
+        spec = list(pipe_prefix) + [None] * (len(shape) - len(pipe_prefix))
         if mdl and key in _COL_KEYS and shape[-1] % mesh.shape[mdl] == 0:
             spec[-1] = mdl
         elif mdl and key in _ROW_KEYS and shape[-2] % mesh.shape[mdl] == 0:
@@ -365,10 +375,17 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     def pipe_loss(params, tokens, labels):
         emb = jnp.take(params["outer"]["model.embed_tokens.weight"], tokens,
                        axis=0)
-        from ...parallel.pipeline import pipeline_apply
-        h = pipeline_apply(stage_fn, params["layers"], emb, mesh,
-                           n_microbatches, remat=remat, data_axis=data_axis,
-                           auto_axes=auto)
+        from ...parallel.pipeline import (pipeline_apply,
+                                          pipeline_apply_interleaved)
+        if V > 1:
+            h = pipeline_apply_interleaved(
+                stage_fn, params["layers"], emb, mesh, n_microbatches,
+                n_virtual=V, remat=remat, data_axis=data_axis,
+                auto_axes=auto, params_layout="vp")
+        else:
+            h = pipeline_apply(stage_fn, params["layers"], emb, mesh,
+                               n_microbatches, remat=remat,
+                               data_axis=data_axis, auto_axes=auto)
         h = _rms(h, params["outer"]["model.norm.weight"], cfg.rms_norm_eps)
         head = params["outer"].get("lm_head.weight")
         logits = (h @ (head if head is not None
