@@ -46,6 +46,7 @@ class GroupShardedOptimizerStage2:
     def __init__(self, params, optim, group=None, offload=False, **kw):
         self._optim = optim
         optim._shard_states_axis = "sharding"
+        optim._offload_states = bool(offload)
         self.offload = offload
 
     def __getattr__(self, name):
@@ -58,6 +59,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     """~ python/paddle/distributed/sharding/group_sharded.py:32."""
     assert level in ("os", "os_g", "p_g_os"), f"bad sharding level {level}"
     optimizer._shard_states_axis = "sharding"
+    optimizer._offload_states = bool(offload)
     if level == "p_g_os":
         _annotate_stage3(model)
     from ..topology import get_hybrid_communicate_group

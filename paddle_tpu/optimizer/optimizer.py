@@ -20,6 +20,21 @@ from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 
+_HOST_MEM_OK = None
+
+
+def _host_memory_supported() -> bool:
+    """Whether the backend exposes pinned host memory for state offload."""
+    global _HOST_MEM_OK
+    if _HOST_MEM_OK is None:
+        try:
+            kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+            _HOST_MEM_OK = "pinned_host" in kinds
+        except Exception:  # noqa: BLE001 — older backends
+            _HOST_MEM_OK = False
+    return _HOST_MEM_OK
+
+
 def _global_norm(grads):
     return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                         for g in grads))
@@ -118,10 +133,19 @@ class Optimizer:
             if p._grad is not None and p._grad._value.sharding != tgt:
                 p._grad._value = jax.device_put(p._grad._value, tgt)
             accs = self._accs_for(p)
+            offload = bool(getattr(self, "_offload_states", False)) \
+                and _host_memory_supported()
             for k, a in accs.items():
                 if not hasattr(a, "ndim"):
                     continue
                 sh = self._state_sharding(a, mesh, axis, pspec)
+                if offload:
+                    # ZeRO-offload (~ group_sharded stage2/3 offload=True):
+                    # accumulators live in pinned host memory between
+                    # steps; step() moves them to device memory before the
+                    # jitted update and back after it (transfers stay
+                    # outside jit — see the staging block in step())
+                    sh = sh.with_memory_kind("pinned_host")
                 if a.sharding != sh:
                     accs[k] = jax.device_put(a, sh)
 
@@ -189,6 +213,22 @@ class Optimizer:
         vals = [p._value for p in params]
         accs = [self._accs_for(p) for p in params]
 
+        # ZeRO-offload: host-resident accumulators stream to device memory
+        # before the jitted update and back after it (transfers stay
+        # OUTSIDE jit — in-jit placement annotations are not supported on
+        # every backend). The compute itself always sees device memory.
+        acc_host_sh = [
+            {k: a[k].sharding
+             for k in a
+             if getattr(getattr(a[k], "sharding", None), "memory_kind",
+                        None) == "pinned_host"}
+            for a in accs]
+        if any(acc_host_sh):
+            accs = [
+                {k: (jax.device_put(x, hs[k].with_memory_kind("device"))
+                     if k in hs else x) for k, x in a.items()}
+                for a, hs in zip(accs, acc_host_sh)]
+
         def fused(vals, grads, accs, lr, step):
             new_vals, new_accs = [], []
             for v, g, a in zip(vals, grads, accs):
@@ -198,17 +238,26 @@ class Optimizer:
             return new_vals, new_accs
 
         if self._jit_update is None:
+            # donate the accumulator buffers: the update replaces them, and
+            # in the offload path they are freshly-staged device copies —
+            # without donation the jit would hold old+new state (2x HBM)
             if mesh is not None:
                 # pin output shardings so updated params/states stay laid
-                # out as placed by _ensure_sharded_state (ZeRO invariant)
+                # out as placed by _ensure_sharded_state (ZeRO invariant);
+                # offloaded accumulators exit in device memory and are
+                # moved back to host below
                 out_sh = ([v.sharding for v in vals],
                           [{k: a[k].sharding for k in a} for a in accs])
-                self._jit_update = jax.jit(fused, out_shardings=out_sh)
+                self._jit_update = jax.jit(fused, out_shardings=out_sh,
+                                           donate_argnums=(2,))
             else:
-                self._jit_update = jax.jit(fused)
+                self._jit_update = jax.jit(fused, donate_argnums=(2,))
         new_vals, new_accs = self._jit_update(vals, grads, accs, lr, step)
-        for p, nv, na in zip(params, new_vals, new_accs):
+        for p, nv, na, hs in zip(params, new_vals, new_accs, acc_host_sh):
             p._value = nv
+            if hs:
+                na = {k: (jax.device_put(x, hs[k]) if k in hs else x)
+                      for k, x in na.items()}
             self._accumulators[id(p)] = na
         self._step_count += 1
         if isinstance(self._lr, LRScheduler) and self._lr._auto_step:
