@@ -197,31 +197,44 @@ class LlamaAttention(nn.Layer):
                     jnp.swapaxes(vv, 1, 2), True, scale)
                 return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
+            cp_mesh, cp_axis = _context_parallel_mesh()
+            if cp_mesh is not None and S % cp_mesh.shape[cp_axis] == 0:
+                from ...core import flags as _flags
+                backend = _flags.get_flag("context_parallel_backend")
+                if backend == "ulysses" and \
+                        qv.shape[2] % cp_mesh.shape[cp_axis] == 0:
+                    # ulysses all-to-alls the head dim — needs full heads
+                    kvr = jnp.repeat(kv, n_rep, axis=2) if n_rep > 1 else kv
+                    vvr = jnp.repeat(vv, n_rep, axis=2) if n_rep > 1 else vv
+                    from ...parallel.ulysses import ulysses_attention
+                    out = ulysses_attention(
+                        jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kvr, 1, 2),
+                        jnp.swapaxes(vvr, 1, 2), cp_mesh, axis=cp_axis,
+                        causal=True, sm_scale=scale)
+                else:
+                    # ring rotates K/V at their TRUE head count (GQA: G x
+                    # less ICI traffic) unless the kv heads don't divide
+                    # the TP axis sharding
+                    mdl_sz = (cp_mesh.shape["model"]
+                              if "model" in cp_mesh.axis_names else 1)
+                    kvr, vvr = kv, vv
+                    if n_rep > 1 and kv.shape[2] % max(1, mdl_sz) != 0:
+                        kvr = jnp.repeat(kv, n_rep, axis=2)
+                        vvr = jnp.repeat(vv, n_rep, axis=2)
+                    from ...parallel.ring_attention import ring_attention
+                    out = ring_attention(
+                        jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kvr, 1, 2),
+                        jnp.swapaxes(vvr, 1, 2), cp_mesh, axis=cp_axis,
+                        causal=True, sm_scale=scale,
+                        batch_axis="data", head_axis="model")
+                return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
+
             if n_rep > 1:
                 kv = jnp.repeat(kv, n_rep, axis=2)
                 vv = jnp.repeat(vv, n_rep, axis=2)
             qt = jnp.swapaxes(qv, 1, 2)
             kt = jnp.swapaxes(kv, 1, 2)
             vt = jnp.swapaxes(vv, 1, 2)
-
-            cp_mesh, cp_axis = _context_parallel_mesh()
-            if cp_mesh is not None and S % cp_mesh.shape[cp_axis] == 0:
-                from ...core import flags as _flags
-                backend = _flags.get_flag("context_parallel_backend")
-                n_heads = qt.shape[1]
-                if backend == "ulysses" and \
-                        n_heads % cp_mesh.shape[cp_axis] == 0:
-                    from ...parallel.ulysses import ulysses_attention
-                    out = ulysses_attention(qt, kt, vt, cp_mesh,
-                                            axis=cp_axis, causal=True,
-                                            sm_scale=scale)
-                else:
-                    from ...parallel.ring_attention import ring_attention
-                    out = ring_attention(qt, kt, vt, cp_mesh, axis=cp_axis,
-                                         causal=True, sm_scale=scale,
-                                         batch_axis="data",
-                                         head_axis="model")
-                return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
             if _flash_eligible(S, qt.shape[-1], qt.dtype):
                 # no silent fallback: a failing kernel must raise, not

@@ -28,7 +28,11 @@ def _host_memory_supported() -> bool:
     global _HOST_MEM_OK
     if _HOST_MEM_OK is None:
         try:
-            kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+            # local_devices: on multi-process runs jax.devices()[0] may be
+            # another host's (non-addressable) device and the probe would
+            # disable offload inconsistently across ranks
+            dev = jax.local_devices()[0]
+            kinds = {m.kind for m in dev.addressable_memories()}
             _HOST_MEM_OK = "pinned_host" in kinds
         except Exception:  # noqa: BLE001 — older backends
             _HOST_MEM_OK = False
@@ -238,9 +242,12 @@ class Optimizer:
             return new_vals, new_accs
 
         if self._jit_update is None:
-            # donate the accumulator buffers: the update replaces them, and
-            # in the offload path they are freshly-staged device copies —
-            # without donation the jit would hold old+new state (2x HBM)
+            # Donate accumulators ONLY on the offload path, where they are
+            # freshly-staged device copies private to this step — without
+            # donation the jit would hold old+new state (2x HBM), defeating
+            # offload. The ordinary path must NOT donate: live accumulators
+            # are aliased by state_dict() snapshots / set_state_dict inputs.
+            donate = (2,) if any(acc_host_sh) else ()
             if mesh is not None:
                 # pin output shardings so updated params/states stay laid
                 # out as placed by _ensure_sharded_state (ZeRO invariant);
@@ -249,9 +256,9 @@ class Optimizer:
                 out_sh = ([v.sharding for v in vals],
                           [{k: a[k].sharding for k in a} for a in accs])
                 self._jit_update = jax.jit(fused, out_shardings=out_sh,
-                                           donate_argnums=(2,))
+                                           donate_argnums=donate)
             else:
-                self._jit_update = jax.jit(fused, donate_argnums=(2,))
+                self._jit_update = jax.jit(fused, donate_argnums=donate)
         new_vals, new_accs = self._jit_update(vals, grads, accs, lr, step)
         for p, nv, na, hs in zip(params, new_vals, new_accs, acc_host_sh):
             p._value = nv

@@ -26,10 +26,16 @@ NEG_INF = -1e30
 
 
 def _block_attn(q, k, v, mask):
-    """q: (B,H,Sq,D); k/v: (B,H,Sk,D); mask broadcastable (Sq,Sk) bool.
-    Returns (scores_max, exp_sum, acc) partials in f32."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32))
+    """q: (B,Hq,Sq,D); k/v: (B,Hkv,Sk,D) with Hq a multiple of Hkv (GQA:
+    the ring rotates K/V at their TRUE head count, so grouped-query
+    configs move G-times less data over ICI per step); mask broadcastable
+    (Sq,Sk) bool. Returns (scores_max, exp_sum, acc) partials in f32,
+    shaped with Hq heads."""
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv  # G == 1 is plain MHA (the reshape below is free)
+    qg = q.reshape(B, Hkv, G, Sq, D).astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k.astype(jnp.float32))
     s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, -1)
     # guard fully-masked rows
@@ -37,8 +43,9 @@ def _block_attn(q, k, v, mask):
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, -1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    return m_safe, l, acc
+    acc = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    return (m_safe.reshape(B, Hq, Sq), l.reshape(B, Hq, Sq),
+            acc.reshape(B, Hq, Sq, D))
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",

@@ -137,3 +137,23 @@ class TestZeroOffload:
             losses[offload] = [_train_one_step(model, opt)
                                for _ in range(3)]
         np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+
+    def test_state_dict_snapshot_survives_step(self, sharding_mesh):
+        # regression: accumulator donation must not invalidate state_dict
+        # snapshots taken before a later step (checkpoint-then-continue)
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        model = nn.Linear(64, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt = group_sharded_parallel(model, opt, "os")
+        _train_one_step(model, opt)
+        snap = opt.state_dict()
+        _train_one_step(model, opt)
+        for v in snap.values():
+            if hasattr(v, "numpy"):
+                assert np.isfinite(v.numpy()).all()
+            elif hasattr(v, "items"):
+                for x in v.values():
+                    arr = getattr(x, "_value", x)
+                    if hasattr(arr, "shape"):
+                        assert np.isfinite(np.asarray(arr)).all()
