@@ -15,6 +15,7 @@ from . import tensor_methods as _tm
 from . import codegen as _codegen
 from .codegen import infer_meta  # noqa: F401
 
-# math-group specs are generated inside ops/math.py (imported above via *)
-_generated_ops = _codegen.generate(globals(), exclude_groups={"math"})
+# family groups are generated inside their modules (imported above via *)
+_generated_ops = _codegen.generate(
+    globals(), exclude_groups={"math", "activation"})
 _tm.install()

@@ -12,124 +12,14 @@ import jax.numpy as jnp
 from .dispatch import def_op
 
 
-@def_op("relu")
-def relu(x):
-    return jax.nn.relu(x)
+# The simple elementwise activations are YAML-spec-generated (specs.yaml
+# group "activation"), mirroring api.yaml-driven generation; complex ops
+# (randomness, shape logic) stay hand-written below.
+from .codegen import generate as _generate
 
+_GENERATED_ACTIVATIONS = _generate(globals(), groups={"activation"})
 
-@def_op("relu6")
-def relu6(x):
-    return jax.nn.relu6(x)
-
-
-@def_op("leaky_relu")
-def leaky_relu(x, negative_slope=0.01):
-    return jax.nn.leaky_relu(x, negative_slope)
-
-
-@def_op("prelu")
-def prelu(x, weight):
-    return jnp.where(x >= 0, x, weight * x)
-
-
-@def_op("elu")
-def elu(x, alpha=1.0):
-    return jax.nn.elu(x, alpha)
-
-
-@def_op("selu")
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
-    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
-
-
-@def_op("celu")
-def celu(x, alpha=1.0):
-    return jax.nn.celu(x, alpha)
-
-
-@def_op("gelu")
-def gelu(x, approximate=False):
-    return jax.nn.gelu(x, approximate=approximate)
-
-
-@def_op("sigmoid")
-def sigmoid(x):
-    return jax.nn.sigmoid(x)
-
-
-@def_op("hardsigmoid")
-def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
-    return jnp.clip(slope * x + offset, 0.0, 1.0)
-
-
-@def_op("hardswish")
-def hardswish(x):
-    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
-
-
-@def_op("hardtanh")
-def hardtanh(x, min=-1.0, max=1.0):
-    return jnp.clip(x, min, max)
-
-
-@def_op("hardshrink")
-def hardshrink(x, threshold=0.5):
-    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
-
-
-@def_op("softshrink")
-def softshrink(x, threshold=0.5):
-    return jnp.where(x > threshold, x - threshold,
-                     jnp.where(x < -threshold, x + threshold, 0.0))
-
-
-@def_op("tanhshrink")
-def tanhshrink(x):
-    return x - jnp.tanh(x)
-
-
-@def_op("silu")
-def silu(x):
-    return jax.nn.silu(x)
-
-
-swish = silu
-
-
-@def_op("mish")
-def mish(x):
-    return x * jnp.tanh(jax.nn.softplus(x))
-
-
-@def_op("softplus")
-def softplus(x, beta=1.0, threshold=20.0):
-    scaled = beta * x
-    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
-
-
-@def_op("softsign")
-def softsign(x):
-    return jax.nn.soft_sign(x)
-
-
-@def_op("thresholded_relu")
-def thresholded_relu(x, threshold=1.0):
-    return jnp.where(x > threshold, x, 0.0)
-
-
-@def_op("log_sigmoid")
-def log_sigmoid(x):
-    return jax.nn.log_sigmoid(x)
-
-
-@def_op("softmax")
-def softmax(x, axis=-1):
-    return jax.nn.softmax(x, axis=int(axis))
-
-
-@def_op("log_softmax")
-def log_softmax(x, axis=-1):
-    return jax.nn.log_softmax(x, axis=int(axis))
+swish = silu  # noqa: F821 — generated above
 
 
 @def_op("gumbel_softmax")

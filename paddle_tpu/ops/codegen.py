@@ -75,14 +75,26 @@ def generate(namespace: dict, path: str = _SPEC_PATH, groups=None,
 
         def make_api(opname=opname, fn=fn, nondiff=nondiff, attrs=attrs,
                      n_args=n_args):
+            attr_names = list(attrs)
+
             def api(*args, **kwargs):
-                if len(args) > n_args:
+                if len(args) > n_args + len(attr_names):
                     raise TypeError(
-                        f"{opname}() takes {n_args} positional argument(s) "
+                        f"{opname}() takes at most "
+                        f"{n_args + len(attr_names)} positional argument(s) "
                         f"but {len(args)} were given")
                 merged = dict(attrs)
+                # attrs may be passed positionally after the tensor args
+                # (matching the reference signatures, e.g.
+                # leaky_relu(x, 0.1))
+                for name, val in zip(attr_names, args[n_args:]):
+                    if name in kwargs:
+                        raise TypeError(
+                            f"{opname}() got multiple values for "
+                            f"argument '{name}'")
+                    merged[name] = val
                 merged.update(kwargs)
-                return apply_op(opname, fn, *args, nondiff=nondiff,
+                return apply_op(opname, fn, *args[:n_args], nondiff=nondiff,
                                 **merged)
             api.__name__ = opname
             api.op_name = opname

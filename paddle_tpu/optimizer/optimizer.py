@@ -54,6 +54,10 @@ class Optimizer:
         self._accumulators: Dict[int, dict] = {}
         self._step_count = 0
         self._jit_update = None
+        # ~ reference multi_precision: low-precision params keep an f32
+        # master copy in the accumulators; the update runs on the master
+        # and the param receives its downcast (no bf16 update rounding)
+        self._multi_precision = bool(multi_precision)
 
     # ---- lr ---------------------------------------------------------------
     def get_lr(self) -> float:
@@ -74,9 +78,27 @@ class Optimizer:
         raise NotImplementedError
 
     # ---- helpers ----------------------------------------------------------
+    def _update_with_master(self, v, g, a, lr, step):
+        """Shared wrapper for EVERY update call site (eager fused step,
+        sparse rows, static executor): when the accumulators carry an f32
+        '_master', the rule runs on the master and the param receives its
+        downcast — otherwise plain _update."""
+        master = a.get("_master") if isinstance(a, dict) else None
+        if master is None:
+            return self._update(v, g, a, lr, step)
+        rest = {k: x for k, x in a.items() if k != "_master"}
+        nm, na = self._update(master, g, rest, lr, step)
+        na = dict(na)
+        na["_master"] = nm
+        return nm.astype(v.dtype), na
+
     def _accs_for(self, p: Parameter) -> dict:
         if id(p) not in self._accumulators:
-            self._accumulators[id(p)] = self._create_accumulators(p)
+            accs = self._create_accumulators(p)
+            if self._multi_precision and p._value.dtype in (
+                    jnp.bfloat16, jnp.float16):
+                accs["_master"] = p._value.astype(jnp.float32)
+            self._accumulators[id(p)] = accs
         return self._accumulators[id(p)]
 
     # ---- ZeRO state sharding (consumer of _shard_states_axis) -------------
@@ -236,7 +258,8 @@ class Optimizer:
         def fused(vals, grads, accs, lr, step):
             new_vals, new_accs = [], []
             for v, g, a in zip(vals, grads, accs):
-                nv, na = self._update(v, g.astype(jnp.float32), a, lr, step)
+                nv, na = self._update_with_master(
+                    v, g.astype(jnp.float32), a, lr, step)
                 new_vals.append(nv)
                 new_accs.append(na)
             return new_vals, new_accs
@@ -287,22 +310,30 @@ class Optimizer:
             elif self._grad_clip is not None:
                 grad_rows = self._apply_grad_clip([p], [grad_rows])[0]
             accs = self._accs_for(p)
+            master = accs.get("_master")
             row_keys = [k for k, a in accs.items()
-                        if hasattr(a, "ndim") and a.ndim >= 1
+                        if k != "_master"
+                        and hasattr(a, "ndim") and a.ndim >= 1
                         and a.shape[:1] == p._value.shape[:1]]
-            p_rows = p._value[rows]
+            # multi_precision: the rule runs on the f32 master's rows; the
+            # param rows receive the downcast (lazy rows only, like the
+            # reference's selected_rows kernels)
+            p_rows = (master[rows] if master is not None
+                      else p._value[rows].astype(jnp.float32))
             acc_rows = {k: accs[k][rows] for k in row_keys}
             # scalar accumulators (e.g. beta power) pass through untouched
             for k in accs:
-                if k not in row_keys:
+                if k not in row_keys and k != "_master":
                     acc_rows[k] = accs[k]
             new_rows, new_accs = self._update(
-                p_rows.astype(jnp.float32), grad_rows, acc_rows, lr, step)
+                p_rows, grad_rows, acc_rows, lr, step)
+            if master is not None:
+                accs["_master"] = master.at[rows].set(new_rows)
             p._value = p._value.at[rows].set(new_rows.astype(p._value.dtype))
             for k in row_keys:
                 accs[k] = accs[k].at[rows].set(new_accs[k])
             for k in new_accs:
-                if k not in row_keys:
+                if k not in row_keys and k != "_master":
                     accs[k] = new_accs[k]
 
     def minimize(self, loss, startup_program=None, parameters=None,
@@ -369,7 +400,8 @@ class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  multi_precision=False, name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._momentum = momentum
         self._nesterov = use_nesterov
 
@@ -394,7 +426,8 @@ class Adam(Optimizer):
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._beta1 = beta1
         self._beta2 = beta2
         self._eps = epsilon
@@ -431,7 +464,8 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip)
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decoupled(self):

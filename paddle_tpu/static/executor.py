@@ -304,7 +304,7 @@ class Executor:
                     grads = optimizer._apply_grad_clip(ps, grads)
                     new_accs = []
                     for p, g, a in zip(ps, grads, accs):
-                        nv, na = optimizer._update(
+                        nv, na = optimizer._update_with_master(
                             new_param_vals[pos[id(p)]], g, a, lr, stp)
                         new_param_vals[pos[id(p)]] = nv
                         new_accs.append(na)

@@ -189,3 +189,62 @@ class TestAmp:
         scaler.step(opt)
         np.testing.assert_allclose(p.numpy(), [1.0])
         assert scaler._scale < 4.0 or scaler._bad > 0
+
+
+class TestMultiPrecision:
+    def test_master_weights_accumulate_sub_ulp_updates(self):
+        # bf16 has ~3 decimal digits: at lr where each update is below the
+        # bf16 ulp of the weight, a bf16-only optimizer stalls while the
+        # f32 master keeps accumulating (~ reference multi_precision).
+        import jax.numpy as jnp
+        results = {}
+        for mp in (False, True):
+            paddle.seed(0)
+            w = paddle.to_tensor(np.full((4,), 1.0, np.float32))
+            p = paddle.create_parameter([4], "bfloat16")
+            p._value = w._value.astype(jnp.bfloat16)
+            opt = paddle.optimizer.SGD(learning_rate=1e-4,
+                                       parameters=[p],
+                                       multi_precision=mp)
+            for _ in range(50):
+                from paddle_tpu.core.tensor import Tensor
+                p._grad = Tensor(jnp.ones((4,), jnp.bfloat16))
+                opt.step()
+            master = opt._accumulators[id(p)].get("_master")
+            end = (np.asarray(master) if master is not None
+                   else np.asarray(p._value, dtype=np.float32))
+            results[mp] = float(end[0])
+        # 50 * 1e-4 = 5e-3 decrease expected with master weights
+        assert abs(results[True] - (1.0 - 5e-3)) < 5e-4, results
+        # without master, bf16 rounding loses most of it
+        assert abs(results[False] - 1.0) < 2e-3, results
+        assert results[True] < results[False] - 2e-3, results
+
+    def test_master_weights_adam_and_static_and_sparse(self):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        # Adam forwards the flag and creates masters
+        p = paddle.create_parameter([4], "bfloat16")
+        opt = paddle.optimizer.Adam(learning_rate=1e-4, parameters=[p],
+                                    multi_precision=True)
+        p._grad = Tensor(jnp.ones((4,), jnp.bfloat16))
+        opt.step()
+        assert "_master" in opt._accumulators[id(p)]
+        assert opt._accumulators[id(p)]["_master"].dtype == jnp.float32
+
+        # sparse (SelectedRows) path consults and maintains the master
+        from paddle_tpu.core.selected_rows import SelectedRows
+        emb = paddle.create_parameter([8, 4], "bfloat16")
+        so = paddle.optimizer.SGD(learning_rate=1e-4, parameters=[emb],
+                                  multi_precision=True)
+        start = np.asarray(emb._value, dtype=np.float32).copy()
+        for _ in range(50):
+            emb._grad = SelectedRows(
+                rows=jnp.asarray([1]), values=jnp.ones((1, 4), jnp.bfloat16),
+                height=8)
+            so.step()
+        m = np.asarray(so._accumulators[id(emb)]["_master"])
+        # row 1's master accumulated 50 * 1e-4 (each step below bf16 ulp);
+        # other rows untouched
+        np.testing.assert_allclose(m[1], start[1] - 5e-3, atol=5e-5)
+        np.testing.assert_allclose(m[0], start[0], atol=1e-7)
