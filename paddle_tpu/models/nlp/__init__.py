@@ -1,6 +1,7 @@
 from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    BertModel, bert_pretrain_step_factory)
-from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401
+from .gpt import (GPTConfig, GPTForCausalLM,  # noqa: F401
+                  gpt_pretrain_step_factory)
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_train_step_factory,
 )

@@ -70,3 +70,74 @@ class GPTForCausalLM(nn.Layer):
         x = self.ln_f(x)
         from ...ops.linalg import matmul
         return matmul(x, self.wte.weight, transpose_y=True)
+
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy decode (tied lm head). For compiled KV-cache serving use
+        the Llama stack (llama_decode_factory); GPT keeps the simple
+        recompute form the reference's generation API exposes."""
+        import paddle_tpu as paddle
+        import numpy as np
+        out = input_ids
+        for _ in range(int(max_new_tokens)):
+            window = out
+            if window.shape[1] > self.config.max_position_embeddings:
+                window = window[:, -self.config.max_position_embeddings:]
+            logits = self.forward(window)
+            nxt = paddle.argmax(logits[:, -1, :], axis=-1)
+            nxt_np = nxt.numpy().reshape(-1, 1).astype(np.int64)
+            out = paddle.concat([out, paddle.to_tensor(nxt_np)], axis=1)
+        return out
+
+
+def gpt_pretrain_step_factory(model: GPTForCausalLM, mesh,
+                              learning_rate=1e-4, weight_decay=0.01,
+                              beta1=0.9, beta2=0.95, eps=1e-8):
+    """Jitted causal-LM pretrain step over a mesh ('data' axis sharded
+    batch) — the GPT analog of llama_train_step_factory, built on the
+    same functional adamw pattern."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model.eval()  # deterministic dropout in the compiled path
+    params = {k: v._value for k, v in model.state_dict().items()}
+    rep = NamedSharding(mesh, P())
+    params = {k: jax.device_put(v, rep) for k, v in params.items()}
+    opt_state = {
+        "step": jnp.zeros((), jnp.int32),
+        "m": {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()},
+        "v": {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()},
+    }
+
+    def loss_fn(params, tokens, labels):
+        from ...core.tensor import Tensor
+        model.load_tree(params)
+        logits = model(Tensor(tokens))._value.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.mean(
+            -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
+
+    data_spec = NamedSharding(
+        mesh, P("data" if "data" in mesh.axis_names else None))
+
+    @jax.jit
+    def step(params, opt_state, tokens, labels):
+        tokens = jax.lax.with_sharding_constraint(tokens, data_spec)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
+        t = (opt_state["step"] + 1).astype(jnp.float32)
+        new_p, new_m, new_v = {}, {}, {}
+        for k, p in params.items():
+            g = grads[k].astype(jnp.float32)
+            m2 = beta1 * opt_state["m"][k] + (1 - beta1) * g
+            v2 = beta2 * opt_state["v"][k] + (1 - beta2) * jnp.square(g)
+            mh = m2 / (1 - beta1 ** t)
+            vh = v2 / (1 - beta2 ** t)
+            delta = mh / (jnp.sqrt(vh) + eps) \
+                + weight_decay * p.astype(jnp.float32)
+            new_p[k] = (p.astype(jnp.float32)
+                        - learning_rate * delta).astype(p.dtype)
+            new_m[k], new_v[k] = m2, v2
+        return new_p, {"step": opt_state["step"] + 1, "m": new_m,
+                       "v": new_v}, loss
+
+    return params, opt_state, step

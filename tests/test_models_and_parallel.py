@@ -322,3 +322,36 @@ class TestContextParallelLlama:
             model, mesh, learning_rate=1e-2, remat=True)
         _, _, loss = step(params, opt_state, tokens, labels)
         np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+
+class TestGPTFamily:
+    def test_gpt_generate_and_pretrain_factory(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp.gpt import (GPTConfig, GPTForCausalLM,
+                                               gpt_pretrain_step_factory)
+
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        prompt = paddle.to_tensor(
+            np.arange(6, dtype=np.int64).reshape(1, 6))
+        out = m.generate(prompt, max_new_tokens=4)
+        assert tuple(out.shape) == (1, 10)
+        np.testing.assert_array_equal(out.numpy()[:, :6], prompt.numpy())
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+        params, opt, step = gpt_pretrain_step_factory(m, mesh)
+        rng = np.random.default_rng(0)
+        tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)),
+                          jnp.int32)
+        lab = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)),
+                          jnp.int32)
+        losses = []
+        for _ in range(3):
+            params, opt, loss = step(params, opt, tok, lab)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
