@@ -92,51 +92,61 @@ class GPTForCausalLM(nn.Layer):
 def gpt_pretrain_step_factory(model: GPTForCausalLM, mesh,
                               learning_rate=1e-4, weight_decay=0.01,
                               beta1=0.9, beta2=0.95, eps=1e-8):
-    """Jitted causal-LM pretrain step over a mesh ('data' axis sharded
-    batch) — the GPT analog of llama_train_step_factory, built on the
-    same functional adamw pattern."""
+    """(params, opt_state, step) for compiled GPT causal-LM pretraining —
+    same pjit pattern and shared train_utils adamw as the llama/bert
+    factories: params per sharding annotation (TP axes honored when
+    annotated), moments ZeRO-sharded over 'sharding' when present, batch
+    over 'data'. Dropout is inactive in the compiled path (traced under
+    no_grad with the layer state untouched, like bert's factory)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    model.eval()  # deterministic dropout in the compiled path
-    params = {k: v._value for k, v in model.state_dict().items()}
-    rep = NamedSharding(mesh, P())
-    params = {k: jax.device_put(v, rep) for k, v in params.items()}
-    opt_state = {
-        "step": jnp.zeros((), jnp.int32),
-        "m": {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()},
-        "v": {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()},
-    }
+    from ...autograd import no_grad
+    from ...core.tensor import Tensor
+    from .llama import param_shardings
+    from .train_utils import adamw_update, make_adamw_state
+
+    was_training = model.training
+    model.eval()
+    try:
+        shardings = param_shardings(model, mesh)
+        params = {k: jax.device_put(jnp.array(v._value, copy=True),
+                                    shardings[k])
+                  for k, v in model.state_dict().items()}
+    finally:
+        if was_training:
+            model.train()
+    opt_state = make_adamw_state(mesh, shardings, params)
+    data_sh = NamedSharding(
+        mesh, P("data" if "data" in mesh.axis_names else None))
 
     def loss_fn(params, tokens, labels):
-        from ...core.tensor import Tensor
+        saved = model.tree_flatten_params()
+        was = model.training
+        model.eval()  # deterministic dropout inside the trace
         model.load_tree(params)
-        logits = model(Tensor(tokens))._value.astype(jnp.float32)
+        try:
+            with no_grad():
+                logits = model(Tensor(tokens))._value.astype(jnp.float32)
+        finally:
+            model.load_tree(saved)  # never leave tracers in the Layer
+            if was:
+                model.train()
         logp = jax.nn.log_softmax(logits, -1)
         return jnp.mean(
             -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
 
-    data_spec = NamedSharding(
-        mesh, P("data" if "data" in mesh.axis_names else None))
-
     @jax.jit
     def step(params, opt_state, tokens, labels):
-        tokens = jax.lax.with_sharding_constraint(tokens, data_spec)
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sh)
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         t = (opt_state["step"] + 1).astype(jnp.float32)
         new_p, new_m, new_v = {}, {}, {}
         for k, p in params.items():
-            g = grads[k].astype(jnp.float32)
-            m2 = beta1 * opt_state["m"][k] + (1 - beta1) * g
-            v2 = beta2 * opt_state["v"][k] + (1 - beta2) * jnp.square(g)
-            mh = m2 / (1 - beta1 ** t)
-            vh = v2 / (1 - beta2 ** t)
-            delta = mh / (jnp.sqrt(vh) + eps) \
-                + weight_decay * p.astype(jnp.float32)
-            new_p[k] = (p.astype(jnp.float32)
-                        - learning_rate * delta).astype(p.dtype)
-            new_m[k], new_v[k] = m2, v2
+            new_p[k], new_m[k], new_v[k] = adamw_update(
+                p, grads[k], opt_state["m"][k], opt_state["v"][k], t,
+                learning_rate, beta1, beta2, eps, weight_decay)
         return new_p, {"step": opt_state["step"] + 1, "m": new_m,
                        "v": new_v}, loss
 
