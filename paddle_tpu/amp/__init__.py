@@ -105,6 +105,13 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
             m.to(dtype=dtype)
     if optimizers is None:
         return models if single else ms
+    # O2 + master_weight (reference default: None means True at O2):
+    # optimizers keep f32 masters for the now-low-precision params
+    if level == "O2" and master_weight is not False:
+        opts = (optimizers if isinstance(optimizers, (list, tuple))
+                else [optimizers])
+        for o in opts:
+            o._multi_precision = True
     return (models, optimizers)
 
 

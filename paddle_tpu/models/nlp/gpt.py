@@ -105,19 +105,15 @@ def gpt_pretrain_step_factory(model: GPTForCausalLM, mesh,
     from ...autograd import no_grad
     from ...core.tensor import Tensor
     from .llama import param_shardings
-    from .train_utils import adamw_update, make_adamw_state
+    from .train_utils import (adamw_state_shardings, adamw_update,
+                              make_adamw_state)
 
-    was_training = model.training
-    model.eval()
-    try:
-        shardings = param_shardings(model, mesh)
-        params = {k: jax.device_put(jnp.array(v._value, copy=True),
-                                    shardings[k])
-                  for k, v in model.state_dict().items()}
-    finally:
-        if was_training:
-            model.train()
+    shardings = param_shardings(model, mesh)
+    params = {k: jax.device_put(jnp.array(v._value, copy=True),
+                                shardings[k])
+              for k, v in model.state_dict().items()}
     opt_state = make_adamw_state(mesh, shardings, params)
+    opt_sh = adamw_state_shardings(mesh, opt_state, params)
     data_sh = NamedSharding(
         mesh, P("data" if "data" in mesh.axis_names else None))
 
@@ -137,8 +133,7 @@ def gpt_pretrain_step_factory(model: GPTForCausalLM, mesh,
         return jnp.mean(
             -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0])
 
-    @jax.jit
-    def step(params, opt_state, tokens, labels):
+    def _step(params, opt_state, tokens, labels):
         tokens = jax.lax.with_sharding_constraint(tokens, data_sh)
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         t = (opt_state["step"] + 1).astype(jnp.float32)
@@ -149,5 +144,13 @@ def gpt_pretrain_step_factory(model: GPTForCausalLM, mesh,
                 learning_rate, beta1, beta2, eps, weight_decay)
         return new_p, {"step": opt_state["step"] + 1, "m": new_m,
                        "v": new_v}, loss
+
+    # pin output shardings (ZeRO moments stay sharded step over step, no
+    # recompile from drifting layouts) and donate the old params/opt_state
+    # — same contract as the llama/bert factories
+    step = jax.jit(
+        _step,
+        out_shardings=({k: shardings[k] for k in params}, opt_sh, None),
+        donate_argnums=(0, 1))
 
     return params, opt_state, step

@@ -182,3 +182,28 @@ class TestProfilerExport:
         assert any("matmul" in n for n in names)
         assert "avg step" in prof.step_info()
         prof.stop()
+
+
+class TestAmpO2MasterWeights:
+    def test_decorate_o2_enables_multi_precision(self):
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        m = nn.Linear(8, 8)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        m2, o2 = paddle.amp.decorate(m, opt, level="O2")
+        assert o2._multi_precision
+        x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+        loss = paddle.sum(m2(x))
+        loss.backward()
+        o2.step()
+        masters = [a["_master"] for a in o2._accumulators.values()
+                   if "_master" in a]
+        assert masters and all(mm.dtype == jnp.float32 for mm in masters)
+
+    def test_decorate_o2_master_weight_false_opts_out(self):
+        from paddle_tpu import nn
+        m = nn.Linear(4, 4)
+        o = paddle.optimizer.SGD(parameters=m.parameters())
+        paddle.amp.decorate(m, o, level="O2", master_weight=False)
+        assert not o._multi_precision
