@@ -367,10 +367,13 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.persistent_workers = persistent_workers
+        self._persistent_iter = None
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
         self.use_shared_memory = use_shared_memory
@@ -414,7 +417,26 @@ class DataLoader:
             from ..utils import native
             if native.available() and hasattr(os, "fork"):
                 from .shm_channel import MultiprocessDataLoaderIter
-                return MultiprocessDataLoaderIter(self)
+                if not self.persistent_workers:
+                    return MultiprocessDataLoaderIter(self)
+                # persistent workers: fork once, reuse processes + ring
+                # across epochs (fork of a JAX-loaded parent costs tens of
+                # ms per worker — dominates short epochs); an iterator that
+                # shut down (worker error / stall) cleared the cache and is
+                # rebuilt fresh here
+                if self._persistent_iter is None:
+                    self._persistent_iter = MultiprocessDataLoaderIter(
+                        self, persistent=True)
+                else:
+                    self._persistent_iter.start_epoch()
+                return self._persistent_iter
+        if self.persistent_workers and self.num_workers > 0:
+            import warnings
+            warnings.warn(
+                "persistent_workers=True has no effect on the thread "
+                "fallback path (native shm unavailable or dataset holds "
+                "device arrays): workers are threads recreated per epoch",
+                stacklevel=2)
         return _DataLoaderIter(self)
 
     def _holds_device_arrays(self) -> bool:

@@ -166,3 +166,61 @@ class TestReviewRegressions:
 
         dl = DataLoader(DeviceDS(), batch_size=2, num_workers=2)
         assert not isinstance(iter(dl), MultiprocessDataLoaderIter)
+
+
+@needs_native
+class TestPersistentWorkers:
+    def test_epochs_consistent_and_processes_reused(self, tmp_path):
+        marks = tmp_path / "marks"
+        marks.mkdir()
+
+        def init(worker_id, _d=str(marks)):
+            import os as _os
+            open(f"{_d}/w{worker_id}_{_os.getpid()}", "w").close()
+
+        dl = DataLoader(_DS(), batch_size=8, num_workers=2,
+                        worker_init_fn=init, persistent_workers=True)
+        e1 = [xb.numpy().copy() for xb, _ in dl]
+        e2 = [xb.numpy().copy() for xb, _ in dl]
+        assert len(e1) == len(e2) and all(
+            (a == b).all() for a, b in zip(e1, e2))
+        # init ran once per worker process — not once per epoch
+        assert len(list(marks.iterdir())) == 2
+
+    def test_mid_epoch_abort_then_full_epoch(self):
+        dl = DataLoader(_DS(), batch_size=8, num_workers=2,
+                        persistent_workers=True)
+        full = [xb.numpy().copy() for xb, _ in dl]
+        it = iter(dl)
+        next(it)  # abort after one batch
+        again = [xb.numpy().copy() for xb, _ in dl]
+        assert len(again) == len(full)
+        assert all((a == b).all() for a, b in zip(full, again))
+
+    def test_error_shutdown_invalidates_cache_and_recovers(self):
+        class FlakyOnce(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                import os as _os
+                flag = "/tmp/pt_flaky_once_flag"
+                if i == 3 and not _os.path.exists(flag):
+                    open(flag, "w").close()
+                    raise ValueError("transient")
+                return np.full((2,), i, np.float32)
+
+        import os as _os
+        try:
+            _os.unlink("/tmp/pt_flaky_once_flag")
+        except FileNotFoundError:
+            pass
+        dl = DataLoader(FlakyOnce(), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+        with pytest.raises(RuntimeError, match="transient"):
+            for _ in dl:
+                pass
+        assert dl._persistent_iter is None  # dead iter not cached
+        # a fresh epoch rebuilds workers and succeeds
+        assert sum(1 for _ in dl) == 2
+        _os.unlink("/tmp/pt_flaky_once_flag")
