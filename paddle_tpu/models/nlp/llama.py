@@ -84,6 +84,42 @@ class LlamaConfig:
 # instead of the dense S x S einsum — without this the 'sep' sharding of the
 # batch buys nothing, as XLA must all-gather the sequence for the einsum.
 _CP = {"mesh": None, "axis": "sep"}
+_TP = {"mesh": None, "axis": "model"}
+
+
+def set_tensor_parallel_mesh(mesh, axis: str = "model"):
+    """Mesh whose `axis` shards attention heads (set by the train-step
+    factories). Needed because GSPMD cannot partition a Pallas custom
+    call: without it, flash attention under TP forces per-layer
+    all-gathers of Q/K/V (measured: 140 all-gathers vs 0 on a 2-layer
+    TP=2 program). With it, the flash call runs inside a partial-manual
+    shard_map over `axis` — per-device kernels on local heads."""
+    _TP["mesh"] = mesh
+    _TP["axis"] = axis
+
+
+def _tensor_parallel_mesh():
+    mesh, axis = _TP["mesh"], _TP["axis"]
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] <= 1:
+        return None, None
+    return mesh, axis
+
+
+def _shard_map_heads(fn, mesh, axis, *qkv, batch_axis="data"):
+    """Run fn(q, k, v) with the head dim manually sharded over `axis` and
+    the batch dim over `batch_axis` when divisible (GSPMD can't partition
+    a Pallas call over EITHER dim — leaving batch auto still all-gathers
+    it around the kernel). Remaining mesh axes stay with GSPMD."""
+    b_ax = batch_axis if (batch_axis in mesh.axis_names
+                          and mesh.shape[batch_axis] > 1
+                          and qkv[0].shape[0] % mesh.shape[batch_axis] == 0
+                          ) else None
+    spec = P(b_ax, axis, None, None)
+    manual = frozenset({axis} | ({b_ax} if b_ax else set()))
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                         out_specs=spec, check_vma=False,
+                         axis_names=manual)(*qkv)
 
 
 def set_context_parallel_mesh(mesh, axis: str = "sep"):
@@ -192,9 +228,20 @@ class LlamaAttention(nn.Layer):
             if use_flash_gqa:
                 from ...ops.pallas.flash_attention_gqa import (
                     grouped_flash_attention)
-                out = grouped_flash_attention(
-                    jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kv, 1, 2),
-                    jnp.swapaxes(vv, 1, 2), True, scale)
+                qt3 = jnp.swapaxes(qv, 1, 2)
+                kt3 = jnp.swapaxes(kv, 1, 2)
+                vt3 = jnp.swapaxes(vv, 1, 2)
+                tp_mesh, tp_axis = _tensor_parallel_mesh()
+                if (tp_mesh is not None
+                        and qt3.shape[1] % tp_mesh.shape[tp_axis] == 0
+                        and kt3.shape[1] % tp_mesh.shape[tp_axis] == 0):
+                    out = _shard_map_heads(
+                        lambda q, k, v: grouped_flash_attention(
+                            q, k, v, True, scale),
+                        tp_mesh, tp_axis, qt3, kt3, vt3)
+                else:
+                    out = grouped_flash_attention(qt3, kt3, vt3, True,
+                                                  scale)
                 return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
             cp_mesh, cp_axis = _context_parallel_mesh()
@@ -240,7 +287,15 @@ class LlamaAttention(nn.Layer):
                 # no silent fallback: a failing kernel must raise, not
                 # quietly degrade to the O(S^2) path (round-1 verdict)
                 from ...ops.pallas.flash_attention import flash_attention
-                out = flash_attention(qt, kt, vt, True)
+                tp_mesh, tp_axis = _tensor_parallel_mesh()
+                if (tp_mesh is not None
+                        and qt.shape[1] % tp_mesh.shape[tp_axis] == 0):
+                    out = _shard_map_heads(
+                        lambda q, k, v: flash_attention(q, k, v, True,
+                                                        scale),
+                        tp_mesh, tp_axis, qt, kt, vt)
+                else:
+                    out = flash_attention(qt, kt, vt, True, scale)
                 return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
             s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
             causal = jnp.tril(jnp.ones((S, S), bool))
@@ -404,12 +459,20 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
 
     has_sep = "sep" in mesh.axis_names and mesh.shape["sep"] > 1
 
+    has_model = "model" in mesh.axis_names and mesh.shape["model"] > 1
+
     def forward_loss(params, tokens, labels):
         from ...autograd import no_grad
         saved = model.tree_flatten_params()
         model.load_tree(params)
         prev = (_CP["mesh"], _CP["axis"])
+        prev_tp = (_TP["mesh"], _TP["axis"])
         set_context_parallel_mesh(mesh if has_sep else None)
+        # GSPMD can't partition Pallas calls: give the attention the mesh
+        # so the flash kernel runs shard_mapped over 'model' (no Q/K/V
+        # all-gathers under TP)
+        set_tensor_parallel_mesh(mesh if (has_model and not has_sep)
+                                 else None)
         try:
             # tape off: jax.value_and_grad differentiates this trace; the
             # eager tape's per-op jax.vjp would otherwise nest a second
@@ -419,6 +482,7 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
         finally:
             model.load_tree(saved)  # don't leave tracers in the Layer
             set_context_parallel_mesh(prev[0], prev[1])
+            set_tensor_parallel_mesh(prev_tp[0], prev_tp[1])
         if jax.default_backend() != "cpu":
             # Pallas fused softmax-xent: skips the (B*S, V) softmax HBM
             # round trip (the largest intermediate of the training loss)
