@@ -483,11 +483,37 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
             model.load_tree(saved)  # don't leave tracers in the Layer
             set_context_parallel_mesh(prev[0], prev[1])
             set_tensor_parallel_mesh(prev_tp[0], prev_tp[1])
-        if jax.default_backend() != "cpu":
+        if jax.default_backend() != "cpu" and not has_model:
             # Pallas fused softmax-xent: skips the (B*S, V) softmax HBM
-            # round trip (the largest intermediate of the training loss)
+            # round trip (the largest intermediate of the training loss).
+            # GSPMD can't partition the Pallas call, so batch/sequence
+            # mesh axes go manual (per-shard mean + pmean == global mean:
+            # no label shift, equal shard sizes). With a >1 'model' axis
+            # the logits are vocab-sharded — the dense path below is the
+            # right form there (GSPMD partitions the log_softmax
+            # reductions with psums instead of gathering (B,S,V)).
             from ...ops.pallas.fused_ce import causal_lm_loss
-            return causal_lm_loss(logits, labels)
+            B_, S_ = labels.shape
+            dim_for = {"data": B_, "sep": S_}
+            manual = [a for a in ("data", "sep")
+                      if a in mesh.axis_names and mesh.shape[a] > 1
+                      and dim_for[a] % mesh.shape[a] == 0]
+            if not manual:
+                return causal_lm_loss(logits, labels)
+
+            def _fused(lg, lb):
+                loss = causal_lm_loss(lg, lb)
+                for a in manual:
+                    loss = jax.lax.pmean(loss, a)
+                return loss
+
+            b_ax = "data" if "data" in manual else None
+            s_ax = "sep" if "sep" in manual else None
+            return jax.shard_map(
+                _fused, mesh=mesh,
+                in_specs=(P(b_ax, s_ax, None), P(b_ax, s_ax)),
+                out_specs=P(), check_vma=False,
+                axis_names=frozenset(manual))(logits, labels)
         logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, -1)
         nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
