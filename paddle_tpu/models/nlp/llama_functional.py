@@ -60,6 +60,9 @@ def _rms(x, w, eps):
     return y.astype(x.dtype) * w
 
 
+_FORCE_FLASH_FOR_TESTS = False  # CPU interpret-mode flash in the factories
+
+
 def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
     """One decoder layer over its param dict (pure)."""
     B, S, H = x.shape
@@ -78,10 +81,27 @@ def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     use_flash = (S >= 256 and S % 128 == 0 and hd in (64, 128, 256)
                  and qt.dtype in (jnp.float32, jnp.bfloat16)
-                 and jax.default_backend() != "cpu")
+                 and (jax.default_backend() != "cpu"
+                      or _FORCE_FLASH_FOR_TESTS))
     if use_flash:
         from ...ops.pallas.flash_attention import flash_attention
-        ctx = flash_attention(qt, kt, vt, True)
+        # GSPMD can't partition a Pallas call: when this stage body runs
+        # with a >1 AUTO 'model' axis (the 4D factory's partial-manual
+        # pipeline), nest a shard_map so heads go manual instead of
+        # all-gathering Q/K/V per microbatch
+        amesh = jax.sharding.get_abstract_mesh()
+        if (amesh is not None
+                and "model" in getattr(amesh, "auto_axes", ())
+                and amesh.shape["model"] > 1
+                and qt.shape[1] % amesh.shape["model"] == 0):
+            spec = P(None, "model", None, None)
+            ctx = jax.shard_map(
+                lambda a, b, c: flash_attention(a, b, c, True),
+                mesh=amesh, in_specs=(spec,) * 3, out_specs=spec,
+                check_vma=False,
+                axis_names=frozenset({"model"}))(qt, kt, vt)
+        else:
+            ctx = flash_attention(qt, kt, vt, True)
     else:
         s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(hd)
         causal = jnp.tril(jnp.ones((S, S), bool))

@@ -200,3 +200,35 @@ class TestShardMappedFusedCE:
             labels[..., None], -1)[..., 0]))(logits)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    atol=1e-6)
+
+
+class TestFlashInPipelineFactory:
+    def test_4d_factory_flash_nested_shard_map_parity(self):
+        """Inside the 4D factory's partial-manual pipeline the 'model'
+        axis is AUTO — the stage body must nest a shard_map around the
+        Pallas flash call (GSPMD would all-gather Q/K/V per microbatch
+        otherwise) and match the dense path exactly."""
+        from jax.sharding import Mesh
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp import llama_functional as LF
+
+        rng = np.random.default_rng(0)
+        tok = jnp.asarray(rng.integers(0, 128, (4, 256)), jnp.int32)
+        losses = {}
+        for force in (False, True):
+            LF._FORCE_FLASH_FOR_TESTS = force
+            try:
+                paddle.seed(0)
+                cfg = LlamaConfig.tiny(vocab=128, hidden=256, layers=4,
+                                       heads=4, kv_heads=4)
+                m = LlamaForCausalLM(cfg)
+                mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(
+                    1, 2, 2, 2), ("data", "pipe", "sharding", "model"))
+                p, o, step = LF.llama_4d_train_step_factory(
+                    m, mesh, n_microbatches=2, remat=False)
+                p, o, loss = step(p, o, tok, tok)
+                losses[force] = float(loss)
+            finally:
+                LF._FORCE_FLASH_FOR_TESTS = False
+        np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
