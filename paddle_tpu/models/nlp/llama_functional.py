@@ -61,6 +61,8 @@ def _rms(x, w, eps):
 
 
 _FORCE_FLASH_FOR_TESTS = False  # CPU interpret-mode flash in the factories
+_NESTED_FLASH_USED = False      # set at trace time; tests assert the
+#                                 nested shard_map branch really engaged
 
 
 def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
@@ -75,16 +77,21 @@ def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
     pos = jnp.arange(S)
     q = apply_rotary(q, pos, cfg.rope_theta)
     k = apply_rotary(k, pos, cfg.rope_theta)
-    if nh != nkv:
-        k = jnp.repeat(k, nh // nkv, axis=2)
-        v = jnp.repeat(v, nh // nkv, axis=2)
-    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)  # (B, nkv, S, hd) — true kv head count
+    vt = jnp.swapaxes(v, 1, 2)
     use_flash = (S >= 256 and S % 128 == 0 and hd in (64, 128, 256)
                  and qt.dtype in (jnp.float32, jnp.bfloat16)
                  and (jax.default_backend() != "cpu"
                       or _FORCE_FLASH_FOR_TESTS))
     if use_flash:
-        from ...ops.pallas.flash_attention import flash_attention
+        # GQA configs keep K/V at nkv heads (grouped kernel — no repeat
+        # blowup through HBM)
+        if nh != nkv:
+            from ...ops.pallas.flash_attention_gqa import (
+                grouped_flash_attention as _fa)
+        else:
+            from ...ops.pallas.flash_attention import flash_attention as _fa
         # GSPMD can't partition a Pallas call: when this stage body runs
         # with a >1 AUTO 'model' axis (the 4D factory's partial-manual
         # pipeline), nest a shard_map so heads go manual instead of
@@ -93,16 +100,22 @@ def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
         if (amesh is not None
                 and "model" in getattr(amesh, "auto_axes", ())
                 and amesh.shape["model"] > 1
-                and qt.shape[1] % amesh.shape["model"] == 0):
+                and qt.shape[1] % amesh.shape["model"] == 0
+                and kt.shape[1] % amesh.shape["model"] == 0):
+            global _NESTED_FLASH_USED
+            _NESTED_FLASH_USED = True
             spec = P(None, "model", None, None)
             ctx = jax.shard_map(
-                lambda a, b, c: flash_attention(a, b, c, True),
+                lambda a, b, c: _fa(a, b, c, True),
                 mesh=amesh, in_specs=(spec,) * 3, out_specs=spec,
                 check_vma=False,
                 axis_names=frozenset({"model"}))(qt, kt, vt)
         else:
-            ctx = flash_attention(qt, kt, vt, True)
+            ctx = _fa(qt, kt, vt, True)
     else:
+        if nh != nkv:
+            kt = jnp.repeat(kt, nh // nkv, axis=1)
+            vt = jnp.repeat(vt, nh // nkv, axis=1)
         s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(hd)
         causal = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(causal, s, jnp.finfo(s.dtype).min)

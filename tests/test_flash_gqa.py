@@ -218,17 +218,25 @@ class TestFlashInPipelineFactory:
         losses = {}
         for force in (False, True):
             LF._FORCE_FLASH_FOR_TESTS = force
+            LF._NESTED_FLASH_USED = False
             try:
                 paddle.seed(0)
+                # kv_heads=2 exercises the grouped (GQA) kernel branch
                 cfg = LlamaConfig.tiny(vocab=128, hidden=256, layers=4,
-                                       heads=4, kv_heads=4)
+                                       heads=4, kv_heads=2)
                 m = LlamaForCausalLM(cfg)
                 mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(
                     1, 2, 2, 2), ("data", "pipe", "sharding", "model"))
                 p, o, step = LF.llama_4d_train_step_factory(
                     m, mesh, n_microbatches=2, remat=False)
                 p, o, loss = step(p, o, tok, tok)
-                losses[force] = float(loss)
+                # second step covers the backward through the nested
+                # shard_map: a wrong dQ/dK/dV would diverge the params
+                p, o, loss2 = step(p, o, tok, tok)
+                losses[force] = (float(loss), float(loss2))
+                if force:
+                    assert LF._NESTED_FLASH_USED, \
+                        "nested shard_map branch did not engage"
             finally:
                 LF._FORCE_FLASH_FOR_TESTS = False
         np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
