@@ -240,3 +240,29 @@ class TestFlashInPipelineFactory:
             finally:
                 LF._FORCE_FLASH_FOR_TESTS = False
         np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
+
+
+class TestSdpaUnderMesh:
+    def test_sdpa_flash_model_axis_manual(self):
+        """scaled_dot_product_attention's flash path must shard_map over
+        an AUTO 'model' mesh axis (GSPMD can't partition Pallas) and
+        match the plain call exactly."""
+        from jax.sharding import Mesh
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.core.tensor import Tensor
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+        rng = np.random.default_rng(0)
+        q = rng.standard_normal((2, 256, 4, 64)).astype(np.float32)
+
+        def run(qv):
+            out = F.scaled_dot_product_attention(
+                Tensor(qv), Tensor(qv), Tensor(qv), is_causal=True,
+                use_pallas=True)
+            return out._value
+
+        with jax.sharding.set_mesh(mesh):
+            sharded = jax.jit(run)(jnp.asarray(q))
+        plain = run(jnp.asarray(q))
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),
+                                   atol=2e-5)
