@@ -107,19 +107,12 @@ def _tensor_parallel_mesh():
 
 
 def _shard_map_heads(fn, mesh, axis, *qkv, batch_axis="data"):
-    """Run fn(q, k, v) with the head dim manually sharded over `axis` and
-    the batch dim over `batch_axis` when divisible (GSPMD can't partition
-    a Pallas call over EITHER dim — leaving batch auto still all-gathers
-    it around the kernel). Remaining mesh axes stay with GSPMD."""
-    b_ax = batch_axis if (batch_axis in mesh.axis_names
-                          and mesh.shape[batch_axis] > 1
-                          and qkv[0].shape[0] % mesh.shape[batch_axis] == 0
-                          ) else None
-    spec = P(b_ax, axis, None, None)
-    manual = frozenset({axis} | ({b_ax} if b_ax else set()))
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                         out_specs=spec, check_vma=False,
-                         axis_names=manual)(*qkv)
+    """Shared wrapper (parallel/pallas_sharding.py): heads manual over
+    `axis`, batch over `batch_axis` when divisible — GSPMD can't
+    partition a Pallas call over either dim."""
+    from ...parallel.pallas_sharding import shard_map_attention
+    return shard_map_attention(fn, *qkv, mesh=mesh, head_axis=axis,
+                               batch_axis=batch_axis)
 
 
 def set_context_parallel_mesh(mesh, axis: str = "sep"):

@@ -61,8 +61,6 @@ def _rms(x, w, eps):
 
 
 _FORCE_FLASH_FOR_TESTS = False  # CPU interpret-mode flash in the factories
-_NESTED_FLASH_USED = False      # set at trace time; tests assert the
-#                                 nested shard_map branch really engaged
 
 
 def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
@@ -94,24 +92,11 @@ def layer_forward(cfg: LlamaConfig, p: Dict[str, jax.Array], x):
             from ...ops.pallas.flash_attention import flash_attention as _fa
         # GSPMD can't partition a Pallas call: when this stage body runs
         # with a >1 AUTO 'model' axis (the 4D factory's partial-manual
-        # pipeline), nest a shard_map so heads go manual instead of
-        # all-gathering Q/K/V per microbatch
-        amesh = jax.sharding.get_abstract_mesh()
-        if (amesh is not None
-                and "model" in getattr(amesh, "auto_axes", ())
-                and amesh.shape["model"] > 1
-                and qt.shape[1] % amesh.shape["model"] == 0
-                and kt.shape[1] % amesh.shape["model"] == 0):
-            global _NESTED_FLASH_USED
-            _NESTED_FLASH_USED = True
-            spec = P(None, "model", None, None)
-            ctx = jax.shard_map(
-                lambda a, b, c: _fa(a, b, c, True),
-                mesh=amesh, in_specs=(spec,) * 3, out_specs=spec,
-                check_vma=False,
-                axis_names=frozenset({"model"}))(qt, kt, vt)
-        else:
-            ctx = _fa(qt, kt, vt, True)
+        # pipeline), the shared wrapper nests a shard_map so heads go
+        # manual instead of all-gathering Q/K/V per microbatch
+        from ...parallel.pallas_sharding import shard_map_attention
+        ctx = shard_map_attention(lambda a, b, c: _fa(a, b, c, True),
+                                  qt, kt, vt)
     else:
         if nh != nkv:
             kt = jnp.repeat(kt, nh // nkv, axis=1)

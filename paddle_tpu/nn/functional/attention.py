@@ -37,29 +37,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if use_flash:
             try:
                 from ...ops.autotune import tuned_flash_attention
-                qt_ = jnp.swapaxes(q, 1, 2)
-                kt_ = jnp.swapaxes(k, 1, 2)
-                vt_ = jnp.swapaxes(v, 1, 2)
-                # GSPMD can't partition a Pallas call: under a mesh whose
-                # AUTO 'model' axis shards the heads, run the kernel inside
-                # a shard_map so Q/K/V aren't all-gathered around it
-                amesh = jax.sharding.get_abstract_mesh()
-                if (amesh is not None
-                        and "model" in getattr(amesh, "auto_axes", ())
-                        and amesh.shape["model"] > 1
-                        and qt_.shape[1] % amesh.shape["model"] == 0
-                        and kt_.shape[1] % amesh.shape["model"] == 0):
-                    from jax.sharding import PartitionSpec as _P
-                    spec = _P(None, "model", None, None)
-                    out = jax.shard_map(
-                        lambda a, b, c: tuned_flash_attention(
-                            a, b, c, causal=is_causal),
-                        mesh=amesh, in_specs=(spec,) * 3, out_specs=spec,
-                        check_vma=False,
-                        axis_names=frozenset({"model"}))(qt_, kt_, vt_)
-                else:
-                    out = tuned_flash_attention(qt_, kt_, vt_,
-                                                causal=is_causal)
+                from ...parallel.pallas_sharding import shard_map_attention
+                # GSPMD can't partition a Pallas call: the shared wrapper
+                # runs the kernel shard_mapped over auto 'model'/'data'
+                # axes so Q/K/V aren't all-gathered around it
+                out = shard_map_attention(
+                    lambda a, b, c: tuned_flash_attention(
+                        a, b, c, causal=is_causal),
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2))
                 return out.swapaxes(1, 2)
             except Exception:
                 pass

@@ -1,0 +1,59 @@
+"""Manual sharding wrapper for Pallas attention kernels.
+
+GSPMD cannot partition a Pallas custom call over ANY dimension: left to
+itself it all-gathers the operands around the kernel (measured on a
+2-layer TP=2 x dp=2 Llama step: 36 all-gathers / 27.3 MB per step vs 0 on
+the dense path). Every flash-attention call site therefore routes through
+``shard_map_attention``: heads go manual over the 'model' axis and batch
+over 'data' when divisible, other mesh axes stay with GSPMD.
+
+One implementation for the three call-site families (LlamaAttention,
+llama_functional.layer_forward inside the partial-manual pipeline, and
+the public nn.functional.scaled_dot_product_attention) so guards cannot
+drift.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+# test hook: set True whenever a wrapped (manual) kernel launch is traced
+ENGAGED = {"flag": False}
+
+
+def shard_map_attention(fn, q, k, v, mesh=None, head_axis: str = "model",
+                        batch_axis: str = "data"):
+    """Run ``fn(q, k, v)`` (layout (B, H, S, D); k/v may carry fewer heads
+    — GQA) with the head dim manual over ``head_axis`` and the batch dim
+    manual over ``batch_axis`` when divisible.
+
+    mesh=None probes the context abstract mesh (pjit/GSPMD traces and
+    nested shard_map regions — only AUTO axes are eligible there); a
+    concrete mesh skips the probe (the train-step factories pass theirs).
+    Falls back to a plain ``fn(q, k, v)`` call whenever manual sharding
+    does not apply.
+    """
+    if mesh is None:
+        amesh = jax.sharding.get_abstract_mesh()
+        eligible = getattr(amesh, "auto_axes", ()) if amesh is not None \
+            else ()
+        if head_axis not in eligible:
+            return fn(q, k, v)
+        mesh = amesh
+    else:
+        eligible = mesh.axis_names
+    if (head_axis not in mesh.axis_names
+            or mesh.shape[head_axis] <= 1
+            or q.shape[1] % mesh.shape[head_axis]
+            or k.shape[1] % mesh.shape[head_axis]):
+        return fn(q, k, v)
+    b_ax = batch_axis if (batch_axis in eligible
+                          and mesh.shape.get(batch_axis, 1) > 1
+                          and q.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    spec = P(b_ax, head_axis, None, None)
+    manual = frozenset({head_axis} | ({b_ax} if b_ax else set()))
+    ENGAGED["flag"] = True
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                         out_specs=spec, check_vma=False,
+                         axis_names=manual)(q, k, v)

@@ -216,9 +216,10 @@ class TestFlashInPipelineFactory:
         rng = np.random.default_rng(0)
         tok = jnp.asarray(rng.integers(0, 128, (4, 256)), jnp.int32)
         losses = {}
+        from paddle_tpu.parallel import pallas_sharding as PS
         for force in (False, True):
             LF._FORCE_FLASH_FOR_TESTS = force
-            LF._NESTED_FLASH_USED = False
+            PS.ENGAGED["flag"] = False
             try:
                 paddle.seed(0)
                 # kv_heads=2 exercises the grouped (GQA) kernel branch
@@ -235,7 +236,7 @@ class TestFlashInPipelineFactory:
                 p, o, loss2 = step(p, o, tok, tok)
                 losses[force] = (float(loss), float(loss2))
                 if force:
-                    assert LF._NESTED_FLASH_USED, \
+                    assert PS.ENGAGED["flag"], \
                         "nested shard_map branch did not engage"
             finally:
                 LF._FORCE_FLASH_FOR_TESTS = False
@@ -251,6 +252,9 @@ class TestSdpaUnderMesh:
         import paddle_tpu.nn.functional as F
         from paddle_tpu.core.tensor import Tensor
 
+        from paddle_tpu.parallel import pallas_sharding as PS
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
         mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
         rng = np.random.default_rng(0)
         q = rng.standard_normal((2, 256, 4, 64)).astype(np.float32)
@@ -261,8 +265,10 @@ class TestSdpaUnderMesh:
                 use_pallas=True)
             return out._value
 
+        PS.ENGAGED["flag"] = False
         with jax.sharding.set_mesh(mesh):
             sharded = jax.jit(run)(jnp.asarray(q))
+        assert PS.ENGAGED["flag"], "manual shard_map path did not engage"
         plain = run(jnp.asarray(q))
         np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),
                                    atol=2e-5)
