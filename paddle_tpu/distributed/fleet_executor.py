@@ -146,12 +146,36 @@ class DistMessageBus(MessageBus):
         # stall _deliver on the reader threads and deadlock both ranks
         with self._mu:
             entry = self._socks.get(owner)
-            if entry is None:
-                host, port = self._addrs[owner].rsplit(":", 1)
-                sock = socket.create_connection((host, int(port)),
-                                                timeout=60)
-                entry = (sock, threading.Lock())
-                self._socks[owner] = entry
+        if entry is None:
+            host, port = self._addrs[owner].rsplit(":", 1)
+            # Retry refused connections OUTSIDE _mu (holding it would stall
+            # _deliver on the reader threads — the deadlock the per-socket
+            # locks exist to avoid): a peer rank spawned under machine load
+            # may not have bound its listener yet, and create_connection's
+            # timeout does NOT cover ECONNREFUSED, which returns instantly.
+            # Only connection-level errors retry; resolution errors raise.
+            import time as _time
+            deadline = _time.time() + 180.0
+            while True:
+                if self._closed:
+                    raise OSError("bus closed during connect")
+                try:
+                    sock = socket.create_connection((host, int(port)),
+                                                    timeout=60)
+                    break
+                except (ConnectionRefusedError, ConnectionResetError,
+                        ConnectionAbortedError, TimeoutError):
+                    if _time.time() >= deadline:
+                        raise
+                    _time.sleep(0.2)
+            with self._mu:
+                existing = self._socks.get(owner)
+                if existing is None:
+                    entry = (sock, threading.Lock())
+                    self._socks[owner] = entry
+                else:  # lost the race: reuse the winner's socket
+                    sock.close()
+                    entry = existing
         sock, sock_mu = entry
         with sock_mu:
             _send_msg(sock, (dst, payload))

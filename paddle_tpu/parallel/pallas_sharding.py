@@ -53,7 +53,10 @@ def shard_map_attention(fn, q, k, v, mesh=None, head_axis: str = "model",
         else None
     spec = P(b_ax, head_axis, None, None)
     manual = frozenset({head_axis} | ({b_ax} if b_ax else set()))
-    ENGAGED["flag"] = True
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                         out_specs=spec, check_vma=False,
-                         axis_names=manual)(q, k, v)
+    out = jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                        out_specs=spec, check_vma=False,
+                        axis_names=manual)(q, k, v)
+    ENGAGED["flag"] = True  # after the call: a tracing failure above must
+    #                         not leave the marker set (call sites may
+    #                         catch and fall back)
+    return out

@@ -64,10 +64,20 @@ class TestDistCarrier:
                  for r in (0, 1)]
         for p in procs:
             p.start()
+        import queue as _q
+        import time as _time
         results = {}
-        for _ in range(2):
-            rank, out = q.get(timeout=300)
-            results[rank] = out
+        deadline = _time.time() + 600  # spawn re-imports the whole stack
+        while len(results) < 2 and _time.time() < deadline:
+            try:
+                rank, out = q.get(timeout=5)
+                results[rank] = out
+            except _q.Empty:
+                # fail fast on a dead child instead of burning the deadline
+                for p_ in procs:
+                    assert p_.is_alive() or p_.exitcode == 0, \
+                        f"child died rc={p_.exitcode}"
+        assert len(results) == 2, "children did not report in time"
         for p in procs:
             p.join(timeout=30)
         assert results[0] == []            # feeder rank has no sink

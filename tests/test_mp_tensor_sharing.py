@@ -55,8 +55,10 @@ class TestReduction:
         p.start()
         t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
         q_in.put(t)
-        out = q_out.get(timeout=60)
-        p.join(timeout=15)
+        # spawn re-imports jax+paddle_tpu from scratch; on a contended
+        # 1-core host that alone can take minutes
+        out = q_out.get(timeout=420)
+        p.join(timeout=30)
         np.testing.assert_allclose(out.numpy(), t.numpy() * 2)
 
     def test_lru_cache_bounds_segments(self):
