@@ -1,0 +1,63 @@
+"""MFU experiment on the real chip: fused QKV / gate-up vs baseline."""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_variant(fused: bool, steps=20, warmup=3):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama import llama_train_step_factory
+
+    dev = jax.devices()[0]
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                      intermediate_size=4096, num_hidden_layers=12,
+                      num_attention_heads=12, num_key_value_heads=12,
+                      max_position_embeddings=2048,
+                      dtype=jnp.bfloat16,
+                      fuse_attention_qkv=fused, fuse_ffn_gate_up=fused)
+    B, S = 8, 2048
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    params, opt_state, step, _ = llama_train_step_factory(
+        model, mesh, learning_rate=1e-4, remat=False)
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    def timed(n):
+        nonlocal params, opt_state
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            params, opt_state, loss = step(params, opt_state, tokens, labels)
+        lv = float(loss)
+        return time.perf_counter() - t0, lv
+
+    timed(warmup)
+    small_n = max(2, steps // 5)
+    t_small, _ = timed(small_n)
+    t_big, loss = timed(steps)
+    dt = (t_big - t_small) / (steps - small_n)
+    if dt <= 0:
+        dt = t_big / steps
+    tok = B * S
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * S * tok
+    flops = 6 * n_params * tok + attn_flops
+    mfu = (flops / dt) / 197e12
+    return {"fused": fused, "step_ms": round(dt * 1000, 2),
+            "mfu": round(mfu, 4), "loss": loss}
+
+
+if __name__ == "__main__":
+    fused = sys.argv[1] == "fused"
+    print(json.dumps(run_variant(fused)))
