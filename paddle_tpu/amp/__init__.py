@@ -112,6 +112,15 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
                 else [optimizers])
         for o in opts:
             o._multi_precision = True
+            # Retrofit masters for accumulators created BEFORE decorate()
+            # (a step taken pre-decorate, or resume via set_state_dict):
+            # _accs_for caches per param id, so without this those params
+            # would silently never get an f32 master.
+            for p in getattr(o, "_parameters", []):
+                accs = o._accumulators.get(id(p))
+                if accs is not None and "_master" not in accs and \
+                        p._value.dtype in (jnp.bfloat16, jnp.float16):
+                    accs["_master"] = p._value.astype(jnp.float32)
     return (models, optimizers)
 
 

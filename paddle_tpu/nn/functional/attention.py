@@ -29,26 +29,35 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     def fn(q, k, v, *rest):
         mask = rest[0] if rest else None
         use_flash = use_pallas is True
+        if use_flash and mask is not None:
+            # the flash kernel has no mask input; silently running unmasked
+            # (or silently falling back to the dense path the caller
+            # explicitly opted out of) would both be wrong
+            raise ValueError(
+                "scaled_dot_product_attention(use_pallas=True) does not "
+                "support attn_mask; use is_causal or use_pallas='auto'")
         if use_pallas == "auto":
-            # flash kernel needs seq multiples of block size and no custom mask
+            # flash kernel needs seq multiples of block size and no custom
+            # mask — eligibility is decided HERE, up front, so any error
+            # out of the kernel/wrapper below (shard_map spec mismatches,
+            # tracing failures, Mosaic rejections) propagates instead of
+            # silently degrading to the dense path (repo-wide no-silent-
+            # fallback policy, matching the llama flash path).
             use_flash = (mask is None and q.shape[1] >= 256
                          and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
                          and q.shape[-1] in (64, 128, 256))
         if use_flash:
-            try:
-                from ...ops.autotune import tuned_flash_attention
-                from ...parallel.pallas_sharding import shard_map_attention
-                # GSPMD can't partition a Pallas call: the shared wrapper
-                # runs the kernel shard_mapped over auto 'model'/'data'
-                # axes so Q/K/V aren't all-gathered around it
-                out = shard_map_attention(
-                    lambda a, b, c: tuned_flash_attention(
-                        a, b, c, causal=is_causal),
-                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                    jnp.swapaxes(v, 1, 2))
-                return out.swapaxes(1, 2)
-            except Exception:
-                pass
+            from ...ops.autotune import tuned_flash_attention
+            from ...parallel.pallas_sharding import shard_map_attention
+            # GSPMD can't partition a Pallas call: the shared wrapper
+            # runs the kernel shard_mapped over auto 'model'/'data'
+            # axes so Q/K/V aren't all-gathered around it
+            out = shard_map_attention(
+                lambda a, b, c: tuned_flash_attention(
+                    a, b, c, causal=is_causal),
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2))
+            return out.swapaxes(1, 2)
         scale = 1.0 / math.sqrt(q.shape[-1])
         # (b, s, h, d) -> (b, h, s, d)
         qt = jnp.swapaxes(q, 1, 2)

@@ -57,12 +57,12 @@ def _pick_block(seq_len: int) -> int:
     for cand in (512, 256, 128):
         if seq_len % cand == 0:
             return cand
-    if seq_len <= 128:
-        return seq_len
     # Correctness fallback for non-128-multiple sequences: the block MUST
     # divide seq_len (grid steps would otherwise skip output rows / kv
-    # positions) and stay sublane-aligned for Mosaic (multiple of 8).
-    for cand in range(128, 7, -1):
+    # positions) and stay sublane-aligned for Mosaic (multiple of 8) —
+    # including seq_len <= 128, where returning seq_len verbatim would hand
+    # Mosaic an unaligned sublane count (e.g. S=100).
+    for cand in range(min(128, seq_len), 7, -1):
         if seq_len % cand == 0 and cand % 8 == 0:
             return cand
     raise ValueError(

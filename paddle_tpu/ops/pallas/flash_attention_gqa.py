@@ -27,11 +27,20 @@ from .flash_attention import (LN2, LOG2E, NEG_INF, _interpret, _pick_block,
                               _resolve_blocks)
 
 
+# f32-element budget for ONE (G*block_q, block_k) score/probability buffer
+# (2 MB each; the kernel holds score + p + acc + resident K/V in VMEM).
+_SCORE_ELEMS = 512 * 1024
+
+
 def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
     """Group-aware block pick: score/probability buffers are (G*block_q,
-    block_k) f32, so block_q shrinks with G to keep rows <= 1024 (2 MB of
-    f32 at block_k=512) — the ungrouped 512 default would put G=8 configs
-    over VMEM."""
+    block_k) f32, so the JOINT product G*block_q*block_k is bounded — a
+    per-axis cap alone lets rows grow unboundedly with G (MQA G=32 at the
+    512 default block_k would put ~16 MB of f32 score buffers in VMEM and
+    fail Mosaic compilation). Auto-picked blocks shrink (block_k first,
+    then block_q down to the 8-sublane floor) until the product fits;
+    user-pinned blocks are honored as given."""
+    user_q, user_k = block_q is not None, block_k is not None
     if block_q is None:
         cap = max(128, 1024 // G)
         for cand in (512, 256, 128):
@@ -40,7 +49,14 @@ def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
                 break
         else:
             block_q = min(_pick_block(Sq), cap)
-    return _resolve_blocks(Sq, Sk, block_q, block_k)
+    bq, bk = _resolve_blocks(Sq, Sk, block_q, block_k)
+    # halving preserves divisibility (bk | Sk implies bk/2 | Sk)
+    while G * bq * bk > _SCORE_ELEMS and not user_k and bk > 128:
+        bk //= 2
+    while G * bq * bk > _SCORE_ELEMS and not user_q and bq > 8 \
+            and (bq // 2) % 8 == 0:
+        bq //= 2
+    return bq, bk
 
 
 def _pos_grids(rows, block_k, qi, kj, block_q):

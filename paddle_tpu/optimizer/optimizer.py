@@ -54,6 +54,7 @@ class Optimizer:
         self._accumulators: Dict[int, dict] = {}
         self._step_count = 0
         self._jit_update = None
+        self._jit_sig = None
         # ~ reference multi_precision: low-precision params keep an f32
         # master copy in the accumulators; the update runs on the master
         # and the param receives its downcast (no bf16 update rounding)
@@ -249,40 +250,55 @@ class Optimizer:
              if getattr(getattr(a[k], "sharding", None), "memory_kind",
                         None) == "pinned_host"}
             for a in accs]
-        if any(acc_host_sh):
-            accs = [
-                {k: (jax.device_put(x, hs[k].with_memory_kind("device"))
-                     if k in hs else x) for k, x in a.items()}
+        offload = any(acc_host_sh)
+        # Donation safety: only the freshly-staged device copies of the
+        # host-pinned entries are private to this step; every other
+        # accumulator entry is a LIVE array (aliased by state_dict()
+        # snapshots / set_state_dict inputs) whose buffer must survive. So
+        # the staged entries travel in their own jit argument, which is the
+        # only one donated — without donation the jit would hold old+new
+        # offloaded state (2x HBM), defeating offload.
+        staged = [
+            {k: jax.device_put(a[k], hs[k].with_memory_kind("device"))
+             for k in hs}
+            for a, hs in zip(accs, acc_host_sh)]
+        live = [{k: x for k, x in a.items() if k not in hs}
                 for a, hs in zip(accs, acc_host_sh)]
 
-        def fused(vals, grads, accs, lr, step):
+        def fused(vals, grads, staged, live, lr, step):
             new_vals, new_accs = [], []
-            for v, g, a in zip(vals, grads, accs):
+            for v, g, s, a in zip(vals, grads, staged, live):
                 nv, na = self._update_with_master(
-                    v, g.astype(jnp.float32), a, lr, step)
+                    v, g.astype(jnp.float32), dict(a, **s), lr, step)
                 new_vals.append(nv)
                 new_accs.append(na)
             return new_vals, new_accs
 
-        if self._jit_update is None:
-            # Donate accumulators ONLY on the offload path, where they are
-            # freshly-staged device copies private to this step — without
-            # donation the jit would hold old+new state (2x HBM), defeating
-            # offload. The ordinary path must NOT donate: live accumulators
-            # are aliased by state_dict() snapshots / set_state_dict inputs.
-            donate = (2,) if any(acc_host_sh) else ()
+        # The cached jit bakes in the donation decision AND (on the mesh
+        # path) out_shardings over the accumulator pytree — recreate it when
+        # either the offload condition or the accumulator structure changes
+        # (e.g. amp.decorate(level='O2') retrofitting '_master' keys after a
+        # step has already compiled the update).
+        jit_sig = (offload, len(vals),
+                   tuple(tuple(sorted(a)) for a in accs))
+        if self._jit_update is None or self._jit_sig != jit_sig:
+            donate = (2,) if offload else ()
             if mesh is not None:
                 # pin output shardings so updated params/states stay laid
                 # out as placed by _ensure_sharded_state (ZeRO invariant);
                 # offloaded accumulators exit in device memory and are
                 # moved back to host below
                 out_sh = ([v.sharding for v in vals],
-                          [{k: a[k].sharding for k in a} for a in accs])
+                          [dict({k: a[k].sharding for k in a},
+                                **{k: s[k].sharding for k in s})
+                           for a, s in zip(live, staged)])
                 self._jit_update = jax.jit(fused, out_shardings=out_sh,
                                            donate_argnums=donate)
             else:
                 self._jit_update = jax.jit(fused, donate_argnums=donate)
-        new_vals, new_accs = self._jit_update(vals, grads, accs, lr, step)
+            self._jit_sig = jit_sig
+        new_vals, new_accs = self._jit_update(vals, grads, staged, live,
+                                              lr, step)
         for p, nv, na, hs in zip(params, new_vals, new_accs, acc_host_sh):
             p._value = nv
             if hs:

@@ -157,3 +157,19 @@ class TestZeroOffload:
                     arr = getattr(x, "_value", x)
                     if hasattr(arr, "shape"):
                         assert np.isfinite(np.asarray(arr)).all()
+
+    def test_decorate_o2_after_step_recreates_jit(self, sharding_mesh):
+        # regression: amp.decorate(level='O2') retrofits '_master' into
+        # existing accumulators; the cached mesh-path jit bakes
+        # out_shardings over the OLD accumulator pytree and must be
+        # recreated (keyed on accumulator structure), not reused.
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        model = nn.Linear(64, 64)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt = group_sharded_parallel(model, opt, "os")
+        _train_one_step(model, opt)  # compiles the {m, v} update
+        paddle.amp.decorate(model, opt, level="O2")
+        loss = _train_one_step(model, opt)  # must retrace, not crash
+        assert np.isfinite(loss)
+        assert all("_master" in a for a in opt._accumulators.values())
