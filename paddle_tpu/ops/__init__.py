@@ -11,6 +11,7 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .activation import *  # noqa: F401,F403
 from .misc import *  # noqa: F401,F403
+from .array_ops import *  # noqa: F401,F403
 from . import tensor_methods as _tm
 from . import codegen as _codegen
 from .codegen import infer_meta  # noqa: F401

@@ -1,0 +1,64 @@
+"""TensorArray ops.
+
+~ python/paddle/tensor/array.py (create_array/array_write/array_read/
+array_length over LoDTensorArray; fluid/operators/array_operator.h). TPU
+lowering: eagerly a TensorArray IS a Python list of Tensors — there is no
+LoDTensorArray runtime object to mirror because XLA has no dynamic-length
+containers; compiled loops express accumulation as `lax.scan`/stacked
+buffers via the train-step factories instead. Indices accept Python ints
+or scalar int Tensors (the reference's fill_constant counters).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]
+
+
+def _index(i) -> int:
+    if isinstance(i, Tensor):
+        return int(i._value)
+    return int(i)
+
+
+def create_array(dtype: str = "float32", initialized_list=None) -> list:
+    """~ paddle.tensor.create_array: a new TensorArray, optionally seeded
+    with ``initialized_list``. ``dtype`` is accepted for API parity (the
+    eager list is heterogeneous-tolerant like the reference's dygraph
+    path)."""
+    out = []
+    if initialized_list is not None:
+        out.extend(initialized_list)
+    return out
+
+
+def array_write(x, i, array: list | None = None) -> list:
+    """~ paddle.tensor.array_write: write ``x`` at index ``i``; appends
+    when ``i == len(array)`` (the common increment-counter pattern)."""
+    if array is None:
+        array = []
+    idx = _index(i)
+    if idx > len(array):
+        raise IndexError(
+            f"array_write index {idx} beyond array length {len(array)}")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array: list, i):
+    """~ paddle.tensor.array_read."""
+    return array[_index(i)]
+
+
+def array_length(array: list) -> Tensor:
+    """~ paddle.tensor.array_length: int64 scalar length (int32 when x64
+    is disabled — the repo-wide truncation convention)."""
+    import jax
+    t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return Tensor(jnp.asarray(len(array), t))

@@ -8,4 +8,5 @@ from ..ops.reduction import *  # noqa: F401,F403
 from ..ops.manipulation import *  # noqa: F401,F403
 from ..ops.linalg import *  # noqa: F401,F403
 from ..ops.activation import *  # noqa: F401,F403
+from ..ops.array_ops import *  # noqa: F401,F403
 from ..core.tensor import Tensor, to_tensor  # noqa: F401

@@ -119,12 +119,6 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return apply_op("roi_align", fn, x, boxes)
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError(
-        "deform_conv2d: irregular gather pattern — planned as a Pallas "
-        "kernel; use roi_align/grid-sample style gathers meanwhile")
-
-
 def _roi_grid(rois, spatial_scale, oh, ow, H, W):
     x1 = rois[:, 0] * spatial_scale
     y1 = rois[:, 1] * spatial_scale
@@ -209,25 +203,30 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
 
 
 def _bilinear_sample_nchw(img, ygrid, xgrid):
-    """img: (C,H,W); grids: arbitrary equal shapes -> (C, *grid.shape)."""
+    """img: (C,H,W); grids: arbitrary equal shapes -> (C, *grid.shape).
+
+    Zero-padding semantics per-CORNER, matching the reference's deformable
+    im2col (deformable_conv_op.cu dmcn_im2col_bilinear): an out-of-bounds
+    corner contributes 0 while in-bounds corners keep their weights — NOT
+    the replicate-padding that clipping all four corners would give. A
+    position fully outside (-1, size) has no valid corner and samples 0.
+    """
     C, H, W = img.shape
     y0 = jnp.floor(ygrid).astype(jnp.int32)
     x0 = jnp.floor(xgrid).astype(jnp.int32)
-    y1c = jnp.clip(y0 + 1, 0, H - 1)
-    x1c = jnp.clip(x0 + 1, 0, W - 1)
-    y0c = jnp.clip(y0, 0, H - 1)
-    x0c = jnp.clip(x0, 0, W - 1)
     fy = ygrid - y0
     fx = xgrid - x0
-    valid = ((ygrid > -1) & (ygrid < H) & (xgrid > -1)
-             & (xgrid < W)).astype(img.dtype)
-    i00 = img[:, y0c, x0c]
-    i01 = img[:, y0c, x1c]
-    i10 = img[:, y1c, x0c]
-    i11 = img[:, y1c, x1c]
-    top = i00 * (1 - fx) + i01 * fx
-    bot = i10 * (1 - fx) + i11 * fx
-    return (top * (1 - fy) + bot * fy) * valid
+
+    def corner(yy, xx, wgt):
+        valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1)
+        xc = jnp.clip(xx, 0, W - 1)
+        return img[:, yc, xc] * (wgt * valid.astype(img.dtype))
+
+    return (corner(y0, x0, (1 - fy) * (1 - fx))
+            + corner(y0, x0 + 1, (1 - fy) * fx)
+            + corner(y0 + 1, x0, fy * (1 - fx))
+            + corner(y0 + 1, x0 + 1, fy * fx))
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,

@@ -342,3 +342,107 @@ class TestAdviceRegressions:
         with pytest.raises(RuntimeError, match="stage failed"):
             # enough microbatches to overflow the bounded (8) inboxes
             car.run(list(range(32)))
+
+
+def _np_deform_conv2d(x, off, w, bias=None, stride=(1, 1), pad=(0, 0),
+                      dil=(1, 1), dg=1, groups=1, mask=None):
+    """Direct-loop numpy oracle for deform_conv2d (DCNv1/v2 semantics:
+    per-tap (y, x) offsets, bilinear sampling with zero outside, optional
+    modulation mask, channel groups + deformable groups)."""
+    B, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = w.shape
+    Ho = (H + 2 * pad[0] - dil[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - dil[1] * (kw - 1) - 1) // stride[1] + 1
+    off = off.reshape(B, dg, kh * kw, 2, Ho, Wo)
+    if mask is not None:
+        mask = mask.reshape(B, dg, kh * kw, Ho, Wo)
+    cg = Cin // dg
+    og = Cout // groups
+    out = np.zeros((B, Cout, Ho, Wo), np.float64)
+
+    def bil(img, y, xx):
+        if y <= -1 or y >= H or xx <= -1 or xx >= W:
+            return np.zeros(img.shape[0])
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        fy, fx = y - y0, xx - x0
+        acc = np.zeros(img.shape[0])
+        for (yy, wy) in ((y0, 1 - fy), (y0 + 1, fy)):
+            for (xc, wxx) in ((x0, 1 - fx), (x0 + 1, fx)):
+                if 0 <= yy < H and 0 <= xc < W:
+                    acc += wy * wxx * img[:, yy, xc]
+        return acc
+
+    for b in range(B):
+        for ho in range(Ho):
+            for wo in range(Wo):
+                for k in range(kh * kw):
+                    ky, kx = divmod(k, kw)
+                    for d in range(dg):
+                        y = (ho * stride[0] - pad[0] + ky * dil[0]
+                             + off[b, d, k, 0, ho, wo])
+                        xx = (wo * stride[1] - pad[1] + kx * dil[1]
+                              + off[b, d, k, 1, ho, wo])
+                        s = bil(x[b, d * cg:(d + 1) * cg], y, xx)
+                        if mask is not None:
+                            s = s * mask[b, d, k, ho, wo]
+                        for ci_local, ci in enumerate(
+                                range(d * cg, (d + 1) * cg)):
+                            g = ci // Cin_g
+                            out[b, g * og:(g + 1) * og, ho, wo] += (
+                                w[g * og:(g + 1) * og, ci % Cin_g, ky, kx]
+                                * s[ci_local])
+    if bias is not None:
+        out += bias[None, :, None, None]
+    return out
+
+
+class TestDeformConvOracle:
+    def test_random_offsets_vs_numpy(self):
+        import paddle_tpu.vision.ops as vops
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 7, 9).astype(np.float32)
+        w = rng.randn(5, 4, 3, 3).astype(np.float32)
+        off = (rng.randn(2, 18, 7, 9) * 2).astype(np.float32)
+        out = vops.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+            padding=1)
+        ref = _np_deform_conv2d(x, off, w, pad=(1, 1))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_mask_groups_stride_dilation_vs_numpy(self):
+        import paddle_tpu.vision.ops as vops
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 4, 9, 8).astype(np.float32)
+        w = rng.randn(6, 2, 3, 3).astype(np.float32)  # groups=2
+        Ho = (9 + 2 - 2 * 2 - 1) // 2 + 1
+        Wo = (8 + 2 - 2 * 2 - 1) // 2 + 1
+        off = (rng.randn(1, 2 * 2 * 9, Ho, Wo) * 1.5).astype(np.float32)
+        mask = rng.rand(1, 2 * 9, Ho, Wo).astype(np.float32)
+        bias = rng.randn(6).astype(np.float32)
+        out = vops.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+            bias=paddle.to_tensor(bias), stride=2, padding=1, dilation=2,
+            deformable_groups=2, groups=2, mask=paddle.to_tensor(mask))
+        ref = _np_deform_conv2d(x, off, w, bias, (2, 2), (1, 1), (2, 2),
+                                dg=2, groups=2, mask=mask)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestTensorArray:
+    def test_create_write_read_length(self):
+        import paddle_tpu.tensor as pt
+        arr = pt.create_array("float32")
+        i = paddle.to_tensor(np.asarray(0, np.int32))
+        arr = pt.array_write(paddle.ones([3]), i, arr)
+        arr = pt.array_write(paddle.full([3], 2.0), 1, arr)
+        np.testing.assert_allclose(pt.array_read(arr, 1).numpy(), [2.0] * 3)
+        assert int(pt.array_length(arr)._value) == 2
+        arr = pt.array_write(paddle.zeros([3]), 0, arr)  # overwrite
+        np.testing.assert_allclose(pt.array_read(arr, 0).numpy(), [0.0] * 3)
+        with pytest.raises(IndexError):
+            pt.array_write(paddle.ones([3]), 5, arr)
+
+    def test_initialized_list_and_top_level_alias(self):
+        arr = paddle.create_array(
+            "float32", initialized_list=[paddle.ones([2])])
+        assert int(paddle.array_length(arr)._value) == 1
