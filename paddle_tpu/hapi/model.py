@@ -1,7 +1,10 @@
 """hapi Model: fit/evaluate/predict/save/load + summary.
 
 ~ python/paddle/hapi/model.py:907 with the DynamicGraphAdapter (:667)
-folded in (there is no static adapter — jit is a per-step detail).
+folded in, plus a StaticGraphAdapter (~ model.py:248): constructing a
+Model under ``paddle.enable_static()`` builds one captured Program per
+mode (train/eval/predict) from the declared InputSpecs and drives it
+through the static Executor — same fit/evaluate/predict surface.
 """
 from __future__ import annotations
 
@@ -25,6 +28,113 @@ def _to_list(x):
     return [x]
 
 
+class StaticGraphAdapter:
+    """~ hapi/model.py StaticGraphAdapter:248.
+
+    Builds one (main, startup) Program pair per mode from the Model's
+    InputSpecs: inputs/labels become ``static.data`` feed slots, the
+    network + loss trace into the captured graph, and ``train`` appends
+    ``optimizer.minimize``. Metrics run host-side on the fetched outputs
+    (the reference fetches metric op outputs; capability-identical).
+    """
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self._progs = {}
+        self._exe = None
+        self._startup_done = set()
+
+    def _executor(self):
+        if self._exe is None:
+            from ..static import Executor
+            self._exe = Executor()
+        return self._exe
+
+    @staticmethod
+    def _declare(specs, prefix):
+        from .. import static
+        out = []
+        for i, s in enumerate(specs):
+            shape = [(-1 if d is None else int(d)) for d in s.shape]
+            out.append(static.data(s.name or f"{prefix}{i}", shape, s.dtype))
+        return out
+
+    def _build(self, mode):
+        if mode in self._progs:
+            return self._progs[mode]
+        from ..static import Program, program_guard
+        m = self.model
+        if not m._input_specs:
+            raise ValueError(
+                "Model in static mode requires inputs=[InputSpec(...)]")
+        if mode in ("train", "eval") and m._loss is not None \
+                and not m._label_specs:
+            raise ValueError(
+                "Model prepared with a loss in static mode requires "
+                "labels=[InputSpec(...)] at construction")
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            ins = self._declare(m._input_specs, "x")
+            m.network.train() if mode == "train" else m.network.eval()
+            outs = _to_list(m.network(*ins))
+            feed_names = [v.name for v in ins]
+            fetches = list(outs)
+            if mode in ("train", "eval") and m._loss is not None \
+                    and m._label_specs:
+                lbls = self._declare(m._label_specs, "label")
+                feed_names += [v.name for v in lbls]
+                loss = m._loss(*(outs + lbls))
+                fetches = [loss] + fetches
+                if mode == "train":
+                    m._optimizer.minimize(loss)
+        self._progs[mode] = (main, startup, feed_names, fetches)
+        return self._progs[mode]
+
+    def _run(self, mode, inputs, labels):
+        main, startup, feed_names, fetches = self._build(mode)
+        exe = self._executor()
+        if mode not in self._startup_done:
+            exe.run(startup)
+            self._startup_done.add(mode)
+        vals = list(inputs) + list(labels)
+        feed = {n: (v.numpy() if hasattr(v, "numpy") else np.asarray(v))
+                for n, v in zip(feed_names, vals)}
+        return exe.run(main, feed=feed, fetch_list=fetches)
+
+    def _host_metrics(self, outs_np, labels):
+        m = self.model
+        metrics = []
+        outs = [Tensor(o) for o in outs_np]
+        lbls = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+                for x in labels]
+        for mt in m._metrics:
+            mt.update(*_to_list(mt.compute(*(outs + lbls))))
+            metrics.append(mt.accumulate())
+        return metrics
+
+    def train_batch(self, inputs, labels):
+        res = self._run("train", inputs, labels)
+        loss, outs = res[0], res[1:]
+        metrics = self._host_metrics(outs, labels)
+        return ([float(loss)], metrics) if metrics else [float(loss)]
+
+    def eval_batch(self, inputs, labels):
+        has_loss = self.model._loss is not None and labels
+        res = self._run("eval", inputs, labels)
+        if has_loss:
+            loss, outs = res[0], res[1:]
+        else:
+            loss, outs = None, res
+        metrics = self._host_metrics(outs, labels)
+        if loss is not None:
+            return [float(loss)], metrics
+        return metrics
+
+    def predict_batch(self, inputs):
+        res = self._run("predict", inputs, [])
+        return res[0] if len(res) == 1 else res
+
+
 class Model:
     """~ hapi/model.py Model:907."""
 
@@ -34,6 +144,13 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics: List[Metric] = []
+        self._input_specs = _to_list(inputs)
+        self._label_specs = _to_list(labels)
+        # adapter chosen at construction time, like the reference (model.py
+        # picks by in_dynamic_mode() when Model is created)
+        from ..static import in_static_mode
+        self._adapter = StaticGraphAdapter(self) if in_static_mode() \
+            else None
 
     # -- setup --------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -45,6 +162,9 @@ class Model:
 
     # -- single-batch ops ---------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        if self._adapter is not None:
+            return self._adapter.train_batch(_to_list(inputs),
+                                             _to_list(labels))
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
@@ -63,6 +183,9 @@ class Model:
 
     @no_grad()
     def eval_batch(self, inputs, labels=None):
+        if self._adapter is not None:
+            return self._adapter.eval_batch(_to_list(inputs),
+                                            _to_list(labels))
         self.network.eval()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
@@ -78,6 +201,8 @@ class Model:
 
     @no_grad()
     def predict_batch(self, inputs):
+        if self._adapter is not None:
+            return self._adapter.predict_batch(_to_list(inputs))
         self.network.eval()
         return self.network(*_to_list(inputs))
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 from ..jit import InputSpec  # noqa: F401
 from .graph import (Program, StaticVar, GradVar, data, program_guard,  # noqa
                     default_main_program, default_startup_program,
-                    append_backward, gradients)
+                    append_backward, gradients, in_static_mode)
 from .executor import (Executor, CompiledProgram, Scope, global_scope,  # noqa
                        scope_guard)
 from .io import save_inference_model, load_inference_model  # noqa: F401
