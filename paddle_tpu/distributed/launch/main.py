@@ -34,6 +34,13 @@ def _parse_args(argv=None):
     p.add_argument("--elastic_level", type=int, default=0,
                    help=">0: restart pod on child failure (max_restart times)")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--np", dest="np_range", default=None,
+                   help="MIN:MAX elastic node range; membership changes "
+                        "within the range relaunch trainers with rewritten "
+                        "rank envs (~ elastic/manager.py:34)")
+    p.add_argument("--elastic_node_id", default=None,
+                   help="stable node identity in the elastic membership "
+                        "registry (default: host:node_rank)")
     p.add_argument("--devices", default=None,
                    help="comma ids exported as PADDLE_VISIBLE_DEVICES")
     p.add_argument("training_script")
@@ -80,20 +87,32 @@ class Container:
             self._log_f = None
 
 
-def build_pod(args) -> List[Container]:
-    """~ CollectiveController.build_pod (controllers/collective.py:32)."""
+def build_pod(args, n_nodes=None, node_index=None,
+              endpoints_override=None) -> List[Container]:
+    """~ CollectiveController.build_pod (controllers/collective.py:32).
+
+    ``n_nodes``/``node_index`` override the static --nnodes/--node_rank
+    when elastic membership decides the pod size (~ manager.py:130's
+    rank-env rewrite on scale events); ``endpoints_override`` then carries
+    the endpoint list assembled from the membership registry (each node's
+    published IP), since the static HTTPMaster sync expects a fixed node
+    count.
+    """
     nproc = args.nproc_per_node
     if nproc is None:
         nproc = 1
-    world = args.nnodes * nproc
+    nn = args.nnodes if n_nodes is None else n_nodes
+    ni = args.node_rank if node_index is None else node_index
+    world = nn * nproc
     master_ep = args.master or "127.0.0.1:34782"
 
-    if args.nnodes > 1:
-        master = HTTPMaster(master_ep, is_host=args.node_rank == 0)
+    if endpoints_override is not None:
+        endpoints = endpoints_override
+    elif nn > 1 and n_nodes is None:
+        master = HTTPMaster(master_ep, is_host=ni == 0)
         import socket
         my_ip = socket.gethostbyname(socket.gethostname())
-        peers = master.sync_peers("peers", f"{my_ip}:{nproc}",
-                                  args.node_rank, args.nnodes)
+        peers = master.sync_peers("peers", f"{my_ip}:{nproc}", ni, nn)
         endpoints = ",".join(peers)
     else:
         # single node: one endpoint per local rank (reference contract —
@@ -104,7 +123,7 @@ def build_pod(args) -> List[Container]:
 
     containers = []
     for local_rank in range(nproc):
-        rank = args.node_rank * nproc + local_rank
+        rank = ni * nproc + local_rank
         env = {
             "PADDLE_MASTER": master_ep,
             "PADDLE_COORDINATOR": master_ep,
@@ -128,8 +147,11 @@ def build_pod(args) -> List[Container]:
     return containers
 
 
-def watch(containers: List[Container], poll: float = 2.0) -> int:
-    """~ controller.watch: exit 0 when all done, kill pod on any failure."""
+def watch(containers: List[Container], poll: float = 2.0,
+          rescale_check=None):
+    """~ controller.watch: exit 0 when all done, kill pod on any failure.
+    With ``rescale_check`` (elastic), returns "scale" when the membership
+    watcher decides the pod must relaunch at a new world size."""
     while True:
         codes = [c.returncode for c in containers]
         if any(c is not None and c != 0 for c in codes):
@@ -138,14 +160,95 @@ def watch(containers: List[Container], poll: float = 2.0) -> int:
             return next(c for c in codes if c)
         if all(c == 0 for c in codes):
             return 0
+        if rescale_check is not None and rescale_check():
+            for c in containers:
+                c.terminate()
+            return "scale"
         time.sleep(poll)
+
+
+def _elastic_manager(args):
+    """Membership registry for --np MIN:MAX (~ ElasticManager over etcd,
+    elastic/manager.py:34 — here over the TCPStore)."""
+    from ..fleet.elastic import ElasticManager
+    from ..store import TCPStore
+    min_np, _, max_np = args.np_range.partition(":")
+    min_np = int(min_np)
+    max_np = int(max_np or min_np)
+    master_ep = args.master or "127.0.0.1:34782"
+    host, port = (master_ep.split(":") + ["34782"])[:2]
+    # the membership store lives beside the trainer rendezvous port
+    store = TCPStore(host, int(port) + 7, is_master=args.node_rank == 0)
+    node_id = args.elastic_node_id or f"{host}:{args.node_rank}"
+    mgr = ElasticManager(store, node_id, (min_np, max_np),
+                         heartbeat_interval=0.5, dead_after=3.0)
+    mgr.start()
+    # publish this node's IP so every pod can assemble the true endpoint
+    # list from the live membership (the static HTTPMaster sync can't —
+    # it expects a fixed node count)
+    import socket
+    try:
+        my_ip = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        my_ip = "127.0.0.1"
+    store.set(f"__node_ip__/{node_id}", my_ip)
+    return mgr, node_id, min_np, max_np
+
+
+def _elastic_endpoints(manager, alive, nproc, base_port):
+    """PADDLE_TRAINER_ENDPOINTS from live membership: each node's
+    published IP, nproc consecutive ports per node in sorted-member
+    order (the reference's rank-env rewrite, manager.py:130)."""
+    eps = []
+    for idx, node in enumerate(alive):
+        ip = manager.store.get(f"__node_ip__/{node}")
+        ip = ip.decode() if ip else "127.0.0.1"
+        for lr in range(nproc):
+            eps.append(f"{ip}:{base_port + 100 + idx * nproc + lr}")
+    return ",".join(eps)
 
 
 def launch(argv=None) -> int:
     args = _parse_args(argv)
+    manager = None
+    if args.np_range:
+        manager, node_id, min_np, max_np = _elastic_manager(args)
+        pending = {"flag": False}
+        manager.watch(lambda old, new: pending.update(flag=True))
     restarts = 0
+    cur = {"n_nodes": None, "node_index": None}
     while True:
-        containers = build_pod(args)
+        if manager is not None:
+            # effective pod size from live membership, clamped to the
+            # range; this node must ALSO be in the alive list — assuming
+            # index 0 while absent would duplicate the real rank-0 pod
+            deadline = time.time() + 60.0
+            alive = manager.alive_members()
+            while (len(alive) < min_np or node_id not in alive) \
+                    and time.time() < deadline:
+                time.sleep(0.5)
+                alive = manager.alive_members()
+            if len(alive) < min_np:
+                print(f"[launch] elastic hold: {len(alive)} < np min "
+                      f"{min_np}", file=sys.stderr)
+                return 1
+            if node_id not in alive:
+                print(f"[launch] elastic error: this node ({node_id}) "
+                      f"missing from membership {alive}", file=sys.stderr)
+                return 1
+            n_nodes = min(len(alive), max_np)
+            node_index = alive.index(node_id)
+            pending["flag"] = False
+            cur.update(n_nodes=n_nodes, node_index=node_index)
+            master_ep = args.master or "127.0.0.1:34782"
+            base_port = int((master_ep.split(":") + ["34782"])[1])
+            containers = build_pod(
+                args, n_nodes=n_nodes, node_index=node_index,
+                endpoints_override=_elastic_endpoints(
+                    manager, alive[:n_nodes], args.nproc_per_node or 1,
+                    base_port))
+        else:
+            containers = build_pod(args)
         for c in containers:
             c.start()
 
@@ -156,11 +259,40 @@ def launch(argv=None) -> int:
         signal.signal(signal.SIGINT, handler)
         signal.signal(signal.SIGTERM, handler)
 
-        code = watch(containers)
+        def rescale_check():
+            # relaunch only when the EFFECTIVE size/rank changes (a join
+            # beyond max_np or a leave still >= current view is a no-op)
+            if not pending["flag"]:
+                return False
+            alive = manager.alive_members()
+            if node_id not in alive:
+                # transient self-absence (slow heartbeat): never rescale
+                # on it — assuming an index would duplicate another node's
+                # rank block
+                return False
+            n_new = min(len(alive), max_np)
+            idx_new = alive.index(node_id)
+            if n_new >= min_np and (n_new != cur["n_nodes"]
+                                    or idx_new != cur["node_index"]):
+                return True
+            pending["flag"] = False
+            return False
+
+        code = watch(containers,
+                     rescale_check=rescale_check if manager else None)
+        if code == "scale":
+            print(f"[launch] elastic scale: membership now "
+                  f"{manager.alive_members()} -> relaunch with rewritten "
+                  f"rank envs", file=sys.stderr)
+            continue  # scale events do not consume the restart budget
         if code == 0:
+            if manager is not None:
+                manager.stop()
             return 0
         restarts += 1
         if args.elastic_level <= 0 or restarts > args.max_restart:
+            if manager is not None:
+                manager.stop()
             return code
         print(f"[launch] pod failed (exit {code}); elastic restart "
               f"{restarts}/{args.max_restart}", file=sys.stderr)
