@@ -11,6 +11,7 @@ from .fleet_base import (  # noqa: F401
     init, is_first_worker, worker_index, worker_num,
 )
 from . import meta_parallel  # noqa: F401
+from . import heter  # noqa: F401
 from .utils import recompute  # noqa: F401
 
 from . import data_generator  # noqa: F401,E402

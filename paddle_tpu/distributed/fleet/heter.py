@@ -1,0 +1,149 @@
+"""Heterogeneous staged PS trainer (CPU section <-> accelerator section).
+
+~ paddle/fluid/framework/heter_pipeline_trainer.cc + heter_section_worker.cc
+and the heter service (distributed/ps/service/heter_client.h,
+collective/ProcessGroupHeter.h:64): embedding-dominated work runs in a
+HOST-side section colocated with the parameter server (sparse pull/push on
+numpy tables), while the dense math runs in an ACCELERATOR section as one
+jitted step; micro-batches stream between the sections over a
+length-prefixed message channel so both stay busy (the staged
+producer/consumer queues of heter_section_worker).
+
+TPU-native shape: the accelerator section's step is a single compiled
+function (params, emb_rows, dense_x, labels) -> (params', loss, emb_grad)
+— embedding rows enter as a dense input (so XLA never sees the sparse
+lookup), and the returned row gradients ride back to the CPU section,
+which pushes them into the PS sparse table's SGD/Adagrad rule.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from ..ps import PSClient, _recv_msg, _send_msg
+
+_STOP = "__heter_stop__"
+
+
+class StageChannel:
+    """Point-to-point staged message channel between two sections
+    (~ the heter worker's send/recv service). Length-prefixed pickle
+    frames over TCP; either endpoint may be the listener."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 listen: bool = False, timeout: float = 120.0):
+        self.host = host
+        if listen:
+            self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind((host, port))
+            self._srv.listen(1)
+            self.port = self._srv.getsockname()[1]
+            self._sock: Optional[socket.socket] = None
+            self._timeout = timeout
+        else:
+            self._srv = None
+            self.port = port
+            self._sock = socket.create_connection((host, port),
+                                                  timeout=timeout)
+        self._mu = threading.Lock()
+
+    def _ensure(self):
+        if self._sock is None:
+            self._srv.settimeout(self._timeout)
+            self._sock, _ = self._srv.accept()
+        return self._sock
+
+    def send(self, obj) -> None:
+        with self._mu:
+            _send_msg(self._ensure(), obj)
+
+    def recv(self):
+        return _recv_msg(self._ensure())
+
+    def close(self):
+        for s in (self._sock, self._srv):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class CpuSection:
+    """Host-side stage: sparse pull -> stage send -> grad recv -> sparse
+    push (~ heter_section_worker.cc RunListen/RunForward split). Keeps up
+    to ``window`` micro-batches in flight toward the accelerator section
+    so the PS round trips overlap device compute."""
+
+    def __init__(self, ps: PSClient, channel: StageChannel,
+                 table_id: int = 0, window: int = 2):
+        self.ps = ps
+        self.channel = channel
+        self.table_id = table_id
+        self.window = max(1, window)
+
+    def _drain_one(self):
+        out = self.channel.recv()
+        if out is None:
+            raise ConnectionError("heter section closed the channel")
+        ids, emb_grad, loss = out
+        self.ps.push_sparse(ids, emb_grad, self.table_id)
+        return loss
+
+    def run_epoch(self, batches: Iterable) -> list:
+        """batches: iterable of (sparse_ids, dense_x, labels). Returns
+        per-micro-batch losses (device-section order preserved)."""
+        losses = []
+        inflight = 0
+        for ids, dense_x, labels in batches:
+            rows = self.ps.pull_sparse(ids, self.table_id)
+            self.channel.send((np.asarray(ids), rows,
+                               None if dense_x is None
+                               else np.asarray(dense_x),
+                               np.asarray(labels)))
+            inflight += 1
+            if inflight >= self.window:
+                losses.append(self._drain_one())
+                inflight -= 1
+        while inflight:
+            losses.append(self._drain_one())
+            inflight -= 1
+        return losses
+
+    def finish(self):
+        self.channel.send(_STOP)
+
+
+class HeterSection:
+    """Accelerator-side stage: recv staged batch -> one compiled step ->
+    send row grads back (~ heter_pipeline_trainer device section).
+
+    ``train_step(params, emb_rows, dense_x, labels) -> (params, loss,
+    emb_grad)`` should be jit-compiled by the caller; rows arrive dense so
+    the whole step lives on the MXU.
+    """
+
+    def __init__(self, channel: StageChannel, train_step: Callable,
+                 params):
+        self.channel = channel
+        self.train_step = train_step
+        self.params = params
+        self.steps = 0
+
+    def serve(self) -> int:
+        """Consume staged micro-batches until the CPU section finishes.
+        Returns the number of steps executed."""
+        while True:
+            msg = self.channel.recv()
+            if msg is None or msg == _STOP:
+                return self.steps
+            ids, rows, dense_x, labels = msg
+            self.params, loss, emb_grad = self.train_step(
+                self.params, rows, dense_x, labels)
+            self.channel.send((ids, np.asarray(emb_grad),
+                               float(np.asarray(loss))))
+            self.steps += 1
