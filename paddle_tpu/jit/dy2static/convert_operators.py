@@ -190,3 +190,64 @@ def convert_logical_not(x):
     if _is_tensorish(x):
         return Tensor(jnp.logical_not(_to_bool_value(x)))
     return not x
+
+
+def convert_print(*args):
+    """~ print_transformer.py convert_print: traced tensors print at
+    runtime via the compiled program's host callback (jax.debug.print —
+    the TPU-native Print op); plain values print natively."""
+    if any(_is_traced(a) for a in args):
+        fmt = " ".join("{}" for _ in args)
+        jax.debug.print(fmt, *[_to_bool_value(a) if isinstance(a, Tensor)
+                               else a for a in args])
+        return
+    print(*args)
+
+
+def convert_assert(test, msg=None):
+    """~ assert_transformer.py convert_assert (Assert op). Traced test ->
+    host callback raising AssertionError when the compiled value is
+    falsy; concrete -> native assert semantics."""
+    if _is_traced(test):
+        pv = _to_bool_value(test)
+        if getattr(pv, "ndim", 0) > 0:
+            pv = jnp.all(pv)
+
+        def _check(ok):
+            if not bool(ok):
+                raise AssertionError(
+                    msg if msg is not None else "dy2static assert failed")
+        jax.debug.callback(_check, pv)
+        return
+    v = _to_bool_value(test)
+    ok = bool(jnp.all(v)) if getattr(v, "ndim", 0) > 0 else bool(v)
+    if not ok:
+        raise AssertionError(msg if msg is not None else None)
+
+
+def convert_var_dtype(x, dtype_name: str):
+    """~ cast_transformer.py: bool/int/float(x) on a tensor becomes a
+    dtype cast that survives tracing; concrete scalars keep native Python
+    cast semantics so eager behavior is unchanged."""
+    if _is_tensorish(x):
+        v = _to_bool_value(x)
+        if not _is_traced(x) and getattr(v, "ndim", 0) == 0:
+            return {"bool": bool, "int": int,
+                    "float": float}[dtype_name](v)
+        # reference cast_transformer maps int -> int64; without x64 jax
+        # would truncate (with a warning), so pick the widest available
+        int_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        target = {"bool": jnp.bool_, "int": int_t,
+                  "float": jnp.float32}[dtype_name]
+        return Tensor(v.astype(target))
+    return {"bool": bool, "int": int, "float": float}[dtype_name](x)
+
+
+def convert_len(x):
+    """~ convert_operators.py convert_len: leading-dim length for tensors
+    (static under tracing), native len() for containers."""
+    if isinstance(x, Tensor):
+        return x.shape[0]
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return x.shape[0]
+    return len(x)

@@ -6,6 +6,7 @@ converted parity style, plus jit-traced checks that tensor-dependent
 control flow actually compiles (lax.cond / lax.while_loop in the jaxpr).
 """
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -150,16 +151,32 @@ class TestConversion:
         np.testing.assert_allclose(jf(jnp.asarray([1.0]), jnp.asarray(4)),
                                    [8.0])
 
-    def test_return_branch_left_native(self):
+    def test_return_canonicalized_to_ifelse(self):
+        # ~ return_transformer.py: the early return folds into an explicit
+        # if/else assigning one return slot, so it reaches convert_ifelse
+        # (round 2 left these native; round 3 canonicalizes)
         def early(x):
             if x.sum() > 0:
                 return x
             return -x
         conv = convert_to_static(early)
-        # stays python `if` (flow escape) — works eagerly
         x = paddle.to_tensor(np.array([-2.0], np.float32))
         np.testing.assert_allclose(conv(x).numpy(), [2.0])
-        assert "convert_ifelse" not in code_of(conv)
+        assert "convert_ifelse" in code_of(conv)
+        # and it now compiles under a tensor-dependent predicate
+        out = jax.jit(lambda v: conv(Tensor(v))._value)(
+            np.array([3.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [3.0])
+
+    def test_return_in_loop_stays_native(self):
+        def f(x):
+            for i in range(3):
+                if i == 2:
+                    return x * i
+            return x
+        conv = convert_to_static(f)
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [4.0])
 
 
 class ControlFlowNet(paddle.nn.Layer):
@@ -212,3 +229,128 @@ class TestToStaticIntegration:
             np.testing.assert_allclose(g(x).numpy(), [2.0, 2.0])
         finally:
             pt.enable(True)
+
+
+class TestBreakContinue:
+    """Flag-rewritten break/continue (~ break_continue_transformer.py):
+    the same source must run natively (python values) AND compile
+    (tensor condition under jit)."""
+
+    def test_break_leaves_induction_var_at_break_value(self):
+        # regression: the for-range increment must NOT run on the
+        # breaking iteration (python leaves i at its break value)
+        def f(x):
+            for i in range(5):
+                if i == 2:
+                    break
+            return x * i
+        conv = convert_to_static(f)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), f(x).numpy())
+        np.testing.assert_allclose(conv(x).numpy(), [2.0, 2.0])
+
+    def test_continue_in_for_range(self):
+        def f(x):
+            s = x * 0
+            for i in range(5):
+                if i % 2 == 1:
+                    continue
+                s = s + x
+            return s
+        conv = convert_to_static(f)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), f(x).numpy())
+        np.testing.assert_allclose(conv(x).numpy(), [3.0, 3.0])
+
+    def test_break_on_tensor_condition_compiles(self):
+        def f(x):
+            s = x * 0
+            i = x.sum() * 0  # tensor counter -> compiled while
+            while i < 10:
+                s = s + x
+                if s.sum() >= 6:
+                    break
+                i = i + 1
+            return s
+        conv = convert_to_static(f)
+
+        def jitted(xv):
+            return conv(Tensor(xv))._value
+
+        x = np.full((2,), 1.0, np.float32)
+        out = jax.jit(jitted)(x)
+        # s grows by 2 per iter; stops once sum >= 6 -> 3 iterations
+        np.testing.assert_allclose(np.asarray(out), [3.0, 3.0])
+        # and natively (eager) the same trajectory
+        np.testing.assert_allclose(conv(paddle.to_tensor(x)).numpy(),
+                                   [3.0, 3.0])
+
+    def test_nested_break_continue(self):
+        def f(x):
+            s = x * 0
+            for i in range(4):
+                if i == 3:
+                    break
+                for j in range(4):
+                    if j == 0:
+                        continue
+                    if j == 3:
+                        break
+                    s = s + x
+            return s
+        conv = convert_to_static(f)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        # i in {0,1,2}, j in {1,2}: 6 additions
+        np.testing.assert_allclose(conv(x).numpy(), [6.0, 6.0])
+
+
+class TestStmtConverters:
+    def test_assert_native_and_traced(self):
+        def f(x):
+            assert x.sum() > 0, "must be positive"
+            return x * 2
+        conv = convert_to_static(f)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [2.0, 2.0])
+        with pytest.raises(AssertionError, match="must be positive"):
+            conv(paddle.to_tensor(np.full((2,), -1.0, np.float32)))
+        # traced: compiles and passes; the failing case raises at runtime
+        out = jax.jit(lambda v: conv(Tensor(v))._value)(
+            np.ones((2,), np.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+
+    def test_cast_and_len(self):
+        def f(x):
+            n = len(x)          # static leading dim
+            y = float(n) + x * 0
+            z = int(x.sum())    # concrete eager -> python int
+            return y, z
+        conv = convert_to_static(f)
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        y, z = conv(x)
+        np.testing.assert_allclose(y.numpy(), [3.0, 3.0, 3.0])
+        assert z == 3 and isinstance(z, int)
+
+    def test_cast_under_tracing(self):
+        def f(x):
+            return float(x > 0) * 2.0
+
+        conv = convert_to_static(f)
+
+        def run(v):
+            out = conv(Tensor(v))
+            return out._value if isinstance(out, Tensor) else out
+        got = jax.jit(run)(np.asarray(3.0, np.float32))
+        assert float(got) == 2.0
+
+    def test_print_traced_does_not_break_jit(self, capsys):
+        def f(x):
+            print("value:", x)
+            return x + 1
+        conv = convert_to_static(f)
+        out = jax.jit(lambda v: conv(Tensor(v))._value)(
+            np.ones((2,), np.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+        # native path still prints
+        conv(paddle.to_tensor(np.zeros((1,), np.float32)))
+        assert "value:" in capsys.readouterr().out
