@@ -13,6 +13,9 @@ from typing import Any, Callable, Dict
 
 _lock = threading.Lock()
 _registry: Dict[str, "_Flag"] = {}
+# lock-free value mirror for the eager dispatch hot path (GIL-atomic dict
+# reads; every write path below keeps it in sync under _lock)
+_values: Dict[str, Any] = {}
 
 
 class _Flag:
@@ -37,6 +40,7 @@ def define_flag(name: str, default: Any, help: str = "", typ: type | None = None
     value = _coerce(typ, env) if env is not None else default
     with _lock:
         _registry[name] = _Flag(name, value, typ, help)
+        _values[name] = value
 
 
 def get_flags(names=None) -> Dict[str, Any]:
@@ -49,8 +53,12 @@ def get_flags(names=None) -> Dict[str, Any]:
 
 
 def get_flag(name: str) -> Any:
-    with _lock:
-        return _registry[name].value
+    # hot path (called per eager op): plain dict read, no lock
+    try:
+        return _values[name]
+    except KeyError:
+        with _lock:
+            return _registry[name].value
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
@@ -60,6 +68,7 @@ def set_flags(flags: Dict[str, Any]) -> None:
                 raise KeyError(f"unknown flag {name!r}")
             f = _registry[name]
             f.value = _coerce(f.type, value)
+            _values[name] = f.value
 
 
 # Core flags (subset of platform/flags.cc that is meaningful on TPU).

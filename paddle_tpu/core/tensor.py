@@ -24,26 +24,40 @@ from ..autograd import tape as _tape
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node",
-                 "_output_index", "name", "persistable", "__weakref__",
+                 "_output_index", "_name", "persistable", "__weakref__",
                  "__dict__")
 
     _next_id = 0
 
     def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
-        if isinstance(value, Tensor):
+        # ordered for the dispatch hot path: outputs of eager ops arrive
+        # as jax.Array already
+        if isinstance(value, jax.Array):
+            pass
+        elif isinstance(value, Tensor):
             value = value._value
-        elif not isinstance(value, jax.Array):
+        else:
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = stop_gradient
         self._grad: Optional[Tensor] = None
         self._grad_node = None
         self._output_index = 0
-        if name is None:
-            name = f"tensor_{Tensor._next_id}"
-            Tensor._next_id += 1
-        self.name = name
+        self._name = name  # generated lazily on first access
         self.persistable = False
+
+    @property
+    def name(self) -> str:
+        n = self._name
+        if n is None:
+            n = f"tensor_{Tensor._next_id}"
+            Tensor._next_id += 1
+            self._name = n
+        return n
+
+    @name.setter
+    def name(self, value):
+        self._name = value
 
     # ---- basic properties -------------------------------------------------
     @property

@@ -59,6 +59,22 @@ def _check_nan_inf(name: str, vals) -> None:
                     "(FLAGS_check_nan_inf=1)")
 
 
+# lazily-bound module refs for the per-op hot path (importing at module
+# load would cycle through the package __init__; importing per call costs
+# ~1.5us/op of import-machinery lookups — measured in tools/op_bench.py
+# --eager-vs-jit)
+_spans = None
+_amp = None
+
+
+def _bind_hot_modules():
+    global _spans, _amp
+    from .. import amp as am
+    from ..profiler import _spans as sp
+    _spans = sp
+    _amp = am
+
+
 def apply_op(name: str, fn: Callable, *args, nondiff: bool = False, **kwargs):
     """Run one op eagerly with tape recording.
 
@@ -66,7 +82,8 @@ def apply_op(name: str, fn: Callable, *args, nondiff: bool = False, **kwargs):
     static attributes. Tensor positional args are unwrapped; non-Tensor
     positional args pass through untouched.
     """
-    from ..profiler import _spans
+    if _spans is None:
+        _bind_hot_modules()
     if _spans.enabled:
         import time as _time
         _t0 = _time.perf_counter()
@@ -84,8 +101,7 @@ def _apply_op_inner(name, fn, args, kwargs, nondiff):
         from ..static import graph as _sg
         return _sg.capture(name, fn, args, kwargs)
     vals = [_unwrap(a) for a in args]
-    from .. import amp as _amp
-    if _amp.amp_state() is not None:
+    if getattr(_amp._state, "amp", None) is not None:
         vals = _amp._maybe_cast(name, vals)
     grad_wanted = (not nondiff) and _tape.grad_enabled() and any(
         _is_diff_tensor(a) for a in args)

@@ -44,14 +44,60 @@ def time_op(fn, args, iters=20, warmup=3):
     return (time.perf_counter() - t0) / iters
 
 
+def eager_vs_jit(sizes=(16, 256, 2048), iters=50):
+    """Eager per-op dispatch overhead vs jit (SURVEY §3.1 hot-loop
+    concern): the same 5-op chain runs (a) through the eager dispatcher
+    (one apply_op per op: AMP hook, tape record, registry lookup) and
+    (b) as one jax.jit program. The per-op overhead is the eager-minus-
+    jit gap divided by the op count; at small sizes this is pure host
+    dispatch cost, at large sizes compute dominates and the gap vanishes.
+    """
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.core.sync import hard_sync
+
+    rows = []
+    for n in sizes:
+        x = paddle.randn([n, n])
+        xv = x._value
+
+        def chain_eager(t):
+            return paddle.sum(paddle.tanh(t * 2.0 + 1.0) * t)
+
+        def chain_jnp(v):
+            return jnp.sum(jnp.tanh(v * 2.0 + 1.0) * v)
+
+        jitted = jax.jit(chain_jnp)
+        e = time_op(chain_eager, (x,), iters=iters)
+        j = time_op(jitted, (xv,), iters=iters)
+        n_ops = 5  # mul, add, tanh, mul, sum
+        rows.append({"size": n, "eager_us": e * 1e6, "jit_us": j * 1e6,
+                     "per_op_overhead_us": (e - j) * 1e6 / n_ops,
+                     "ratio": e / max(j, 1e-12)})
+        print(f"n={n:5d}  eager {e * 1e6:9.1f}us  jit {j * 1e6:9.1f}us  "
+              f"per-op overhead {(e - j) * 1e6 / n_ops:7.2f}us  "
+              f"ratio {e / max(j, 1e-12):5.2f}x")
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="/tmp/op_bench.json")
+    ap.add_argument("--eager-vs-jit", action="store_true",
+                    help="measure eager dispatch overhead vs jit and exit")
     ap.add_argument("--baseline", default=None)
     ap.add_argument("--gate", type=float, default=1.2,
                     help="fail if new/old latency ratio exceeds this")
     ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args()
+
+    if args.eager_vs_jit:
+        rows = eager_vs_jit()
+        with open(args.out, "w") as f:
+            json.dump({"eager_vs_jit": rows}, f, indent=1)
+        print(f"wrote {args.out}")
+        return
 
     import numpy as np
     import paddle_tpu as paddle
