@@ -413,7 +413,8 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                              learning_rate=1e-4, weight_decay=0.01,
                              beta1=0.9, beta2=0.95, eps=1e-8,
                              accum_dtype=jnp.float32,
-                             remat: bool | str = True):
+                             remat: bool | str = True,
+                             offload_moments: bool = False):
     """Returns (params, opt_state, train_step) for pjit execution.
 
     Shardings: params per annotation; adamw moments mirror the params but
@@ -425,6 +426,12 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     True = full jax.checkpoint (lowest memory, ~33% extra FLOPs);
     "dots" = selective policy saving matmul outputs and recomputing
     elementwise ops (the middle ground, ~9% over full remat).
+
+    offload_moments: place adamw moments in pinned host memory and declare
+    the memory kind in the jit's in/out shardings — XLA streams them
+    across PCIe around the update (~ group_sharded_stage3.py:58 offload);
+    the config every >1B single-chip model needs (f32 moments are 8 bytes
+    per param — more than v5e HBM above ~2B params).
     """
     config = model.config
     shardings = param_shardings(model, mesh)
@@ -434,7 +441,8 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
               for k, v in model.state_dict().items()}
 
     from .train_utils import adamw_update, make_adamw_state
-    opt_state = make_adamw_state(mesh, shardings, params, accum_dtype)
+    opt_state = make_adamw_state(mesh, shardings, params, accum_dtype,
+                                 offload=offload_moments)
 
     batch_sharding = NamedSharding(
         mesh, P("data" if "data" in mesh.axis_names else None,
@@ -510,30 +518,68 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     elif remat:
         loss_fn = jax.checkpoint(forward_loss)
 
+    # Host-offloaded moments, two lowerings:
+    #  - TPU: fetched to device INSIDE the jit (jax memories pattern —
+    #    compute can't mix host/device operands); out_shardings carry the
+    #    pinned_host kind, so XLA emits both DMAs and schedules them
+    #    around the update.
+    #  - CPU (tests): the placement custom-call isn't implemented, so the
+    #    step wrapper stages moments outside the jit — functionally
+    #    identical, exercised by the CPU suite.
+    moment_dev_sh = {k: opt_state["m"][k].sharding.with_memory_kind(
+        "device") for k in params} if offload_moments else None
+    in_jit_offload = offload_moments and jax.default_backend() != "cpu"
+
     def train_step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         step = opt_state["step"] + 1
         t = step.astype(jnp.float32)
         new_p, new_m, new_v = {}, {}, {}
         for k in params:
+            m, v = opt_state["m"][k], opt_state["v"][k]
+            if in_jit_offload:
+                m = jax.device_put(m, moment_dev_sh[k])
+                v = jax.device_put(v, moment_dev_sh[k])
             new_p[k], new_m[k], new_v[k] = adamw_update(
-                params[k], grads[k], opt_state["m"][k], opt_state["v"][k],
+                params[k], grads[k], m, v,
                 t, learning_rate, beta1, beta2, eps, weight_decay,
                 accum_dtype)
         return new_p, {"step": step, "m": new_m, "v": new_v}, loss
 
+    if offload_moments and not in_jit_offload:
+        # CPU staging path: the jit sees device-resident moments
+        jit_m_sh = moment_dev_sh
+    else:
+        jit_m_sh = {k: opt_state["m"][k].sharding for k in params}
     jitted = jax.jit(
         train_step,
         in_shardings=(shardings,
                       {"step": NamedSharding(mesh, P()),
-                       "m": {k: opt_state["m"][k].sharding for k in params},
-                       "v": {k: opt_state["v"][k].sharding for k in params}},
+                       "m": jit_m_sh, "v": jit_m_sh},
                       batch_sharding, batch_sharding),
         out_shardings=(shardings,
                        {"step": NamedSharding(mesh, P()),
-                        "m": {k: opt_state["m"][k].sharding for k in params},
-                        "v": {k: opt_state["v"][k].sharding for k in params}},
+                        "m": jit_m_sh, "v": jit_m_sh},
                        NamedSharding(mesh, P())),
         donate_argnums=(0, 1),
     )
+    if offload_moments and not in_jit_offload:
+        host_sh = {k: opt_state["m"][k].sharding for k in params}
+
+        def staged_step(params, opt_state, tokens, labels):
+            staged = dict(
+                opt_state,
+                m={k: jax.device_put(x, moment_dev_sh[k])
+                   for k, x in opt_state["m"].items()},
+                v={k: jax.device_put(x, moment_dev_sh[k])
+                   for k, x in opt_state["v"].items()})
+            new_p, new_o, loss = jitted(params, staged, tokens, labels)
+            new_o = dict(
+                new_o,
+                m={k: jax.device_put(x, host_sh[k])
+                   for k, x in new_o["m"].items()},
+                v={k: jax.device_put(x, host_sh[k])
+                   for k, x in new_o["v"].items()})
+            return new_p, new_o, loss
+        return params, opt_state, staged_step, batch_sharding
     return params, opt_state, jitted, batch_sharding

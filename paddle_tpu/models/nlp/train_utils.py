@@ -12,11 +12,18 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def zero_like_sharded(mesh, shardings, name, v, accum_dtype=jnp.float32):
+def zero_like_sharded(mesh, shardings, name, v, accum_dtype=jnp.float32,
+                      offload=False):
     """A zeros moment buffer for param ``v``: inherits the param's
     annotated axes, then (when a >1 'sharding' axis exists) shards the
     largest remaining divisible dim over it — ZeRO-1
-    (~ group_sharded_optimizer_stage2.py:48 param segmentation)."""
+    (~ group_sharded_optimizer_stage2.py:48 param segmentation).
+
+    ``offload=True`` places the buffer in pinned host memory
+    (~ group_sharded_stage3.py:58 offload): the jitted step declares the
+    same memory kind in its in/out shardings, so XLA owns the
+    host<->device DMA and can overlap it with compute — the TPU-native
+    form of the reference's cudaMemcpyAsync offload stream."""
     sh = shardings[name]
     spec = list(sh.spec) + [None] * (v.ndim - len(sh.spec))
     if "sharding" in mesh.axis_names and mesh.shape.get("sharding", 1) > 1:
@@ -25,8 +32,10 @@ def zero_like_sharded(mesh, shardings, name, v, accum_dtype=jnp.float32):
             if spec[i] is None and v.shape[i] % mesh.shape["sharding"] == 0:
                 spec[i] = "sharding"
                 break
-    return jax.device_put(jnp.zeros(v.shape, accum_dtype),
-                          NamedSharding(mesh, P(*spec)))
+    target = NamedSharding(mesh, P(*spec))
+    if offload:
+        target = target.with_memory_kind("pinned_host")
+    return jax.device_put(jnp.zeros(v.shape, accum_dtype), target)
 
 
 def adamw_update(p, g, m, v, t, lr, beta1, beta2, eps, weight_decay,
@@ -43,13 +52,17 @@ def adamw_update(p, g, m, v, t, lr, beta1, beta2, eps, weight_decay,
     return (p.astype(accum_dtype) - lr * delta).astype(p.dtype), m2, v2
 
 
-def make_adamw_state(mesh, shardings, params, accum_dtype=jnp.float32):
-    """step/m/v opt-state pytree with ZeRO-aware shardings."""
+def make_adamw_state(mesh, shardings, params, accum_dtype=jnp.float32,
+                     offload=False):
+    """step/m/v opt-state pytree with ZeRO-aware shardings; ``offload``
+    pins the moments in host memory (see zero_like_sharded)."""
     return {
         "step": jnp.zeros((), jnp.int32),
-        "m": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype)
+        "m": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype,
+                                   offload)
               for k, v in params.items()},
-        "v": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype)
+        "v": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype,
+                                   offload)
               for k, v in params.items()},
     }
 

@@ -173,3 +173,36 @@ class TestZeroOffload:
         loss = _train_one_step(model, opt)  # must retrace, not crash
         assert np.isfinite(loss)
         assert all("_master" in a for a in opt._accumulators.values())
+
+
+def test_factory_offload_moments_matches_device_states():
+    # compiled-factory offload (~ group_sharded_stage3.py:58): moments in
+    # pinned host memory must produce the identical training trajectory
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama import llama_train_step_factory
+
+    cfg = LlamaConfig.tiny(vocab=128, hidden=32, layers=1, heads=2)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+
+    losses = {}
+    for offload in (False, True):
+        paddle.seed(7)
+        model = LlamaForCausalLM(cfg)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        params, opt, step, _ = llama_train_step_factory(
+            model, mesh, learning_rate=1e-2, remat=False,
+            offload_moments=offload)
+        if offload:
+            assert all(a.sharding.memory_kind == "pinned_host"
+                       for a in opt["m"].values())
+        ls = []
+        for _ in range(3):
+            params, opt, loss = step(params, opt, tokens, labels)
+            ls.append(float(loss))
+        losses[offload] = ls
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
