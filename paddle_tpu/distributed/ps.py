@@ -125,6 +125,137 @@ class SparseTable:
         return len(self._rows)
 
 
+class SSDSparseTable(SparseTable):
+    """Disk-backed sparse table: hot rows in memory, cold rows on disk
+    (~ table/ssd_sparse_table.cc, whose rocksdb store here is sqlite —
+    in the Python stdlib, transactional, and fine for the host-side
+    embedding workload). An LRU at ``mem_rows`` evicts (row, rule-state)
+    pairs to disk; pulls fault them back in. The update rule only ever
+    runs on in-memory rows — push targets were just pulled.
+    """
+
+    def __init__(self, dim: int, path: str, mem_rows: int = 100_000,
+                 **kw):
+        super().__init__(dim, **kw)
+        import sqlite3
+        self.mem_rows = max(1, mem_rows)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows ("
+            "key INTEGER PRIMARY KEY, row BLOB, state BLOB)")
+        self._db.commit()
+
+    # -- disk I/O (all callers hold self._lock) ---------------------------
+    def _disk_get(self, k: int):
+        cur = self._db.execute(
+            "SELECT row, state FROM rows WHERE key=?", (k,))
+        hit = cur.fetchone()
+        if hit is None:
+            return None
+        row = np.frombuffer(hit[0], np.float32).copy()
+        state = pickle.loads(hit[1]) if hit[1] is not None else None
+        return row, state
+
+    def _disk_put(self, k: int, row, state):
+        self._db.execute(
+            "INSERT OR REPLACE INTO rows (key, row, state) VALUES (?,?,?)",
+            (k, np.asarray(row, np.float32).tobytes(),
+             None if state is None else pickle.dumps(state)))
+
+    def _evict(self):
+        while len(self._rows) > self.mem_rows:
+            k, row = next(iter(self._rows.items()))  # LRU head
+            self._disk_put(k, row, self._states.get(k))
+            del self._rows[k]
+            self._states.pop(k, None)
+        self._db.commit()
+
+    def _touch(self, k: int):
+        # dict preserves insertion order; re-inserting marks recency
+        row = self._rows.pop(k)
+        st = self._states.pop(k, None)
+        self._rows[k] = row
+        self._states[k] = st
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        flat = np.asarray(ids).reshape(-1)
+        out = np.empty((len(flat), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(flat):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is None:
+                    hit = self._disk_get(k)
+                    if hit is not None:
+                        row, st = hit
+                        self._rows[k] = row
+                        self._states[k] = st
+                    else:
+                        row = (self._rng.standard_normal(self.dim)
+                               * self.init_std).astype(np.float32)
+                        self._rows[k] = row
+                        self._states[k] = self.rule.init_state(self.dim)
+                else:
+                    self._touch(k)
+                out[i] = row
+            self._evict()
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
+        with self._lock:
+            for key, g in zip(np.asarray(ids).reshape(-1), grads):
+                k = int(key)
+                row = self._rows.get(k)
+                if row is None:
+                    # evicted between pull and push (another trainer's
+                    # pull crowded it out): fault it back in
+                    hit = self._disk_get(k)
+                    if hit is None:
+                        continue
+                    row, st = hit
+                    self._rows[k] = row
+                    self._states[k] = st
+                self._states[k] = self.rule.update(row, g,
+                                                   self._states.get(k))
+            self._evict()
+
+    def size(self) -> int:
+        with self._lock:
+            # union: a row may exist both in memory (hot) and on disk
+            # (stale evicted copy)
+            disk_keys = {k for (k,) in
+                         self._db.execute("SELECT key FROM rows")}
+            return len(disk_keys | set(self._rows))
+
+    def load(self, path: str):
+        """Replace ALL state with the snapshot: without clearing the
+        disk store, stale pre-load rows would resurrect on pull and
+        inflate size()."""
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        with self._lock:
+            self._db.execute("DELETE FROM rows")
+            self.dim = d["dim"]
+            self._rows = dict(d["rows"])
+            self._states = dict(d.get("states", {}))
+            self._evict()
+
+    def save(self, path: str):
+        with self._lock:
+            for k, row in self._rows.items():
+                self._disk_put(k, row, self._states.get(k))
+            self._db.commit()
+            rows, states = {}, {}
+            for k, rb, sb in self._db.execute(
+                    "SELECT key, row, state FROM rows"):
+                rows[k] = np.frombuffer(rb, np.float32).copy()
+                states[k] = pickle.loads(sb) if sb is not None else None
+            with open(path, "wb") as f:
+                pickle.dump({"dim": self.dim, "rows": rows,
+                             "states": states}, f)
+
+
 class DenseTable:
     """Dense parameter region (~ table/common_dense_table.cc): one flat
     float32 vector, push applies the update rule."""
@@ -197,6 +328,15 @@ class PSServer:
 
     def add_sparse_table(self, table_id: int, dim: int, **kw) -> SparseTable:
         t = SparseTable(dim, **kw)
+        self._tables[table_id] = t
+        return t
+
+    def add_ssd_sparse_table(self, table_id: int, dim: int, path: str,
+                             mem_rows: int = 100_000,
+                             **kw) -> "SSDSparseTable":
+        """Disk-backed table (~ ssd_sparse_table.cc) — embedding vocabs
+        larger than host memory spill to ``path``."""
+        t = SSDSparseTable(dim, path, mem_rows=mem_rows, **kw)
         self._tables[table_id] = t
         return t
 
