@@ -14,6 +14,7 @@ engine.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -215,6 +216,117 @@ class PredictorPool:
 
     def __len__(self):
         return len(self._preds)
+
+
+class DynamicBatcher:
+    """Serving-side request coalescing (~ the reference serving stack's
+    request batching in front of AnalysisPredictor).
+
+    Concurrent ``infer()`` calls gather into ONE batch executed on a
+    single Predictor call — the TPU-native serving shape: the MXU wants
+    few large matmuls, and XLA compiles one executable per batch size, so
+    gathered batches PAD UP to power-of-two buckets (<= max_batch) to
+    keep the compiled-shape set logarithmic. Results are split back per
+    request; padding rows are dropped.
+    """
+
+    def __init__(self, predictor: Predictor, max_batch: int = 32,
+                 max_delay_ms: float = 2.0):
+        self.predictor = predictor
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1e3
+        self._pending: List = []
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._runs = 0  # underlying predictor.run calls (telemetry/tests)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def infer(self, inputs: List) -> List[np.ndarray]:
+        """Submit one request (list of arrays, leading dim = this
+        request's rows); blocks until its slice of the batched result is
+        ready."""
+        arrs = [np.asarray(x._value if isinstance(x, Tensor) else x)
+                for x in inputs]
+        done = threading.Event()
+        # signature groups batch assembly: only shape/dtype-compatible
+        # requests coalesce, so one malformed request can't poison the
+        # valid requests that happened to land in the same window
+        sig = tuple((a.shape[1:], str(a.dtype)) for a in arrs)
+        slot = {"inputs": arrs, "rows": arrs[0].shape[0], "sig": sig,
+                "done": done, "out": None, "err": None}
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("DynamicBatcher is shut down")
+            self._pending.append(slot)
+            self._cv.notify_all()
+        done.wait()
+        if slot["err"] is not None:
+            raise slot["err"]
+        return slot["out"]
+
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, max(cap, n))
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._pending:
+                    return
+                deadline = time.perf_counter() + self.max_delay
+                while (sum(s["rows"] for s in self._pending) < self.max_batch
+                       and not self._stopped):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cv.wait(timeout=left)
+                sig = self._pending[0]["sig"]
+                batch, taken, rest = [], 0, []
+                for s in self._pending:
+                    if s["sig"] == sig and (
+                            not batch
+                            or taken + s["rows"] <= self.max_batch):
+                        # the first request is always taken (even if its
+                        # own rows exceed max_batch); later ones only
+                        # while the budget holds
+                        batch.append(s)
+                        taken += s["rows"]
+                    else:
+                        rest.append(s)
+                self._pending = rest
+            try:
+                n_in = len(batch[0]["inputs"])
+                cat = [np.concatenate([s["inputs"][i] for s in batch])
+                       for i in range(n_in)]
+                rows = cat[0].shape[0]
+                padded = self._bucket(rows, self.max_batch)
+                if padded > rows:
+                    cat = [np.concatenate(
+                        [c, np.repeat(c[-1:], padded - rows, axis=0)])
+                        for c in cat]
+                outs = self.predictor.run(cat)
+                self._runs += 1
+                off = 0
+                for s in batch:
+                    s["out"] = [o[off:off + s["rows"]] for o in outs]
+                    off += s["rows"]
+            except Exception as e:  # noqa: BLE001 — delivered per request
+                for s in batch:
+                    s["err"] = e
+            for s in batch:
+                s["done"].set()
+
+    def shutdown(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
 
 
 def create_predictor(config: Config) -> Predictor:

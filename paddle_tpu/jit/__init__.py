@@ -203,7 +203,44 @@ def save(layer, path, input_spec=None, **configs):
                     out = fn(*[Tensor(x) for x in xs])
             return _unwrap_tree(out)
 
-        exp = jax_export.export(jax.jit(frozen))(*example)
+        # Shape polymorphism: InputSpec dims of None/-1 export as symbolic
+        # dimensions (jax.export), so ONE artifact serves any batch size —
+        # the dynamic-batching serving path (inference.DynamicBatcher)
+        # depends on this. Falls back to the concrete example shapes when
+        # the model's lowering is shape-dependent.
+        sym_args = []
+        any_sym = False
+        scope = None
+        for i, s in enumerate(specs):
+            if any(d is None or (isinstance(d, int) and d <= 0)
+                   for d in s.shape):
+                if scope is None:
+                    scope = jax_export.SymbolicScope()
+                # one symbol PER DIM POSITION shared across inputs: the
+                # common case is a shared batch (and seq) dimension, and
+                # distinct per-input symbols would make x + y between two
+                # (None, 4) inputs un-exportable
+                dims = ",".join(
+                    f"_d{j}" if (d is None or d <= 0) else str(d)
+                    for j, d in enumerate(s.shape))
+                shp = jax_export.symbolic_shape(dims, scope=scope)
+                any_sym = True
+            else:
+                shp = tuple(s.shape)
+            sym_args.append(jax.ShapeDtypeStruct(shp, np.dtype(s.dtype)))
+        exp = None
+        if any_sym:
+            try:
+                exp = jax_export.export(jax.jit(frozen))(*sym_args)
+            except Exception as e:  # shape-dependent lowering
+                import warnings
+                warnings.warn(
+                    "jit.save: symbolic-shape export failed "
+                    f"({type(e).__name__}: {str(e)[:120]}); falling back "
+                    "to the concrete example shapes — the artifact will "
+                    "only accept those exact shapes", stacklevel=2)
+        if exp is None:
+            exp = jax_export.export(jax.jit(frozen))(*example)
         exported_bytes = exp.serialize()
         with open(path + ".pdexport", "wb") as f:
             f.write(exported_bytes)
