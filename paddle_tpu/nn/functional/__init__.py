@@ -48,7 +48,7 @@ from .common import (  # noqa: F401
 from .vision import (  # noqa: F401
     affine_grid, grid_sample, temporal_shift,
 )
-from .attention import sparse_attention  # noqa: F401
+from .attention import block_sparse_attention, sparse_attention  # noqa: F401
 
 
 def _make_inplace_act(fn):

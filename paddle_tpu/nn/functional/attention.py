@@ -87,9 +87,9 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     TPU lowering: the CSR pattern (offset/columns per head) is expanded to an
     attention mask with static-nnz scatter (searchsorted over the offset
     vector gives each nonzero's row), then one fused masked softmax-matmul —
-    XLA tiles it on the MXU; true block-sparsity on TPU comes from the
-    Pallas splash kernel (ops/pallas/flash_attention.py) which skips masked
-    blocks."""
+    XLA tiles it on the MXU. True block-sparsity (masked blocks SKIPPED,
+    not computed) is ``block_sparse_attention`` below over the Pallas
+    splash kernel (ops/pallas/splash_attention.py)."""
     import numpy as np
 
     def fn(q, k, v, off, cols):
@@ -113,3 +113,28 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return apply_op("sparse_attention", fn, query, key, value,
                     sparse_csr_offset, sparse_csr_columns)
+
+
+def block_sparse_attention(query, key, value, block_mask, is_causal=False,
+                           block_q=None, block_k=None):
+    """Block-sparse flash attention over a static (nq, nk) bool block
+    pattern — masked-out blocks are skipped entirely (compute scales with
+    density). query/key/value: (batch, seq, heads, head_dim) paddle
+    layout; ``block_mask`` a numpy bool array tiling the seq dims.
+
+    TPU-native form of the reference's sparse_attention capability
+    (sparse_attention_op.cu computes dense scores then masks); see
+    ops/pallas/splash_attention.py for the kernel design.
+    """
+    import numpy as _np
+
+    from ...ops.pallas.splash_attention import splash_attention
+
+    bm = _np.asarray(block_mask, bool)
+
+    def fn(q, k, v):
+        out = splash_attention(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), bm, is_causal, None, block_q, block_k)
+        return out.swapaxes(1, 2)
+    return apply_op("block_sparse_attention", fn, query, key, value)
