@@ -59,6 +59,11 @@ class LlamaConfig:
     # change (qkv_proj / gate_up_proj), so default off for ckpt compat
     fuse_attention_qkv: bool = False
     fuse_ffn_gate_up: bool = False
+    # Mistral-style sliding-window attention (tokens; None = full causal).
+    # Flash-eligible shapes run the splash kernel over a banded block
+    # pattern — compute scales with window/S, not S^2; small shapes apply
+    # the window in the dense path.
+    sliding_window: int | None = None
 
     @staticmethod
     def llama3_8b():
@@ -132,6 +137,21 @@ def _context_parallel_mesh():
     return None, None
 
 
+def _dense_attention_tail(qt, kt, vt, scale, window=None):
+    """The one dense causal-softmax path (flash-ineligible shapes), with
+    the optional sliding-window band folded into its mask."""
+    S = qt.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    i = jnp.arange(S)[:, None]
+    j = jnp.arange(S)[None, :]
+    live = i >= j
+    if window is not None:
+        live = live & (i - j < window)
+    s = jnp.where(live, s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qt.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+
+
 def _flash_eligible(seq_len: int, head_dim: int, dtype) -> bool:
     """One gate for every flash-attention entry (GQA and MHA paths must
     never diverge): kernel supports 128-multiple sequences >= 256 and the
@@ -168,6 +188,11 @@ class LlamaAttention(nn.Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.rope_theta = c.rope_theta
+        self.sliding_window = getattr(c, "sliding_window", None)
+        if self.sliding_window is not None and self.sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1 (got "
+                f"{self.sliding_window}); use None to disable")
         self.fused_qkv = bool(getattr(c, "fuse_attention_qkv", False))
         kv_out = self.num_kv_heads * self.head_dim
         if self.fused_qkv:
@@ -206,11 +231,43 @@ class LlamaAttention(nn.Layer):
         theta = self.rope_theta
         n_rep = self.num_heads // self.num_kv_heads
 
+        window = self.sliding_window
+
         def attn(qv, kv, vv):
             pos = jnp.arange(S) if positions is None else positions
             qv = apply_rotary(qv, pos, theta)
             kv = apply_rotary(kv, pos, theta)
             scale = 1.0 / math.sqrt(qv.shape[-1])
+
+            if window is not None and window < S:
+                if _context_parallel_mesh()[0] is not None:
+                    raise ValueError(
+                        "sliding_window with context parallelism ('sep' "
+                        "axis) is not supported — the ring walk would "
+                        "need window-aware skipping; drop the 'sep' axis "
+                        "or unset sliding_window")
+                kvw, vvw = kv, vv
+                if n_rep > 1:
+                    # grouped splash is a queued follow-up; repeat is
+                    # correct, costs G x K/V HBM
+                    kvw = jnp.repeat(kv, n_rep, axis=2)
+                    vvw = jnp.repeat(vv, n_rep, axis=2)
+                qt = jnp.swapaxes(qv, 1, 2)
+                kt = jnp.swapaxes(kvw, 1, 2)
+                vt = jnp.swapaxes(vvw, 1, 2)
+                if _flash_eligible(S, qt.shape[-1], qt.dtype):
+                    # banded splash: compute scales with window/S
+                    from ...ops.pallas.splash_attention import (
+                        banded_block_mask, splash_attention)
+                    bm = banded_block_mask(S, S, 128, 128, window)
+                    tp_mesh, tp_axis = _tensor_parallel_mesh()
+                    out = _shard_map_heads(
+                        lambda q, k, v: splash_attention(
+                            q, k, v, bm, True, scale, 128, 128, window),
+                        tp_mesh, tp_axis or "model", qt, kt, vt)
+                    return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
+                out = _dense_attention_tail(qt, kt, vt, scale, window)
+                return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
             # GQA fast path: the grouped kernel keeps K/V at their true
             # head count (no n_rep x HBM/VMEM blowup from jnp.repeat)
@@ -280,11 +337,7 @@ class LlamaAttention(nn.Layer):
                     lambda q, k, v: flash_attention(q, k, v, True, scale),
                     tp_mesh, tp_axis or "model", qt, kt, vt)
                 return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-            causal = jnp.tril(jnp.ones((S, S), bool))
-            s = jnp.where(causal, s, jnp.finfo(s.dtype).min)
-            p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qt.dtype)
-            out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            out = _dense_attention_tail(qt, kt, vt, scale)
             return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
 
         ctx = apply_op("llama_attention", attn, q, k, v)

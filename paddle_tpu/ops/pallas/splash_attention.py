@@ -55,16 +55,23 @@ def _pattern_tables(block_mask: np.ndarray):
     return kv_idx, kv_cnt, q_idx, q_cnt
 
 
-def _tri(qi, kj, block_q, block_k):
+def _live_mask(qi, kj, block_q, block_k, causal, window):
+    """Elementwise live mask inside a block: causal triangle and/or the
+    sliding-window band (q_pos - k_pos < window, Mistral semantics)."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return q_pos >= k_pos
+    live = jnp.ones((block_q, block_k), bool)
+    if causal:
+        live &= q_pos >= k_pos
+    if window is not None:
+        live &= (q_pos - k_pos) < window
+    return live
 
 
 def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_q, block_k):
+                sm_scale, causal, block_q, block_k, window):
     qi = pl.program_id(1)
     q = q_ref[0]
     q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
@@ -79,8 +86,9 @@ def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         v = v_ref[0, pl.dslice(kj * block_k, block_k)]
         s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_tri(qi, kj, block_q, block_k), s, NEG_INF)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, block_q, block_k, causal,
+                                     window), s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp2(s - m_new[:, None])
         # rows with NO live entry yet (m_new still NEG_INF — e.g. a live
@@ -108,7 +116,7 @@ def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, *, sm_scale, causal, block_q,
-                   block_k):
+                   block_k, window):
     qi = pl.program_id(1)
     q = q_ref[0]
     q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
@@ -123,8 +131,9 @@ def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
         v = v_ref[0, pl.dslice(kj * block_k, block_k)]
         s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_tri(qi, kj, block_q, block_k), s, NEG_INF)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, block_q, block_k, causal,
+                                     window), s, NEG_INF)
         # masked entries must be 0 regardless of lse: for an all-masked
         # row lse is NEG_INF and s - lse2 would OVERFLOW to +inf
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
@@ -141,7 +150,7 @@ def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(q_idx, q_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, sm_scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, window):
     kj = pl.program_id(1)
     k = k_ref[0]
     v = v_ref[0]
@@ -158,8 +167,9 @@ def _bwd_dkv_kernel(q_idx, q_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
         delta = delta_ref[0, pl.dslice(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = jnp.where(_tri(qi, kj, block_q, block_k), s, NEG_INF)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, block_q, block_k, causal,
+                                     window), s, NEG_INF)
         # see dq kernel: guard against all-masked rows' NEG_INF lse
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
         dv_new = dv + jax.lax.dot_general(
@@ -178,8 +188,31 @@ def _bwd_dkv_kernel(q_idx, q_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def banded_block_mask(Sq, Sk, block_q, block_k, window,
+                      causal=True) -> np.ndarray:
+    """Block mask for sliding-window attention: block (i, j) is live iff
+    some (q_pos, k_pos) pair in it satisfies the causal triangle and
+    q_pos - k_pos < window (token-exact masking happens in-kernel)."""
+    nq, nk = Sq // block_q, Sk // block_k
+    bm = np.zeros((nq, nk), bool)
+    for i in range(nq):
+        q_hi = (i + 1) * block_q - 1
+        q_lo = i * block_q
+        for j in range(nk):
+            k_hi = (j + 1) * block_k - 1
+            k_lo = j * block_k
+            if causal and k_lo > q_hi:
+                continue
+            # the block's MINIMUM q_pos - k_pos is q_lo - k_hi; the block
+            # is dead only when even that violates the band
+            if window is not None and q_lo - k_hi >= window:
+                continue
+            bm[i, j] = True
+    return bm
+
+
 def _fwd_impl(q, k, v, kv_idx, kv_cnt, causal, sm_scale, block_q,
-              block_k):
+              block_k, window):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bh = B * H
@@ -201,7 +234,8 @@ def _fwd_impl(q, k, v, kv_idx, kv_cnt, causal, sm_scale, block_q,
     )
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          window=window),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
@@ -214,15 +248,15 @@ def _fwd_impl(q, k, v, kv_idx, kv_cnt, causal, sm_scale, block_q,
     return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def splash_attention(q, k, v, block_mask, causal=False, sm_scale=None,
-                     block_q=None, block_k=None):
+                     block_q=None, block_k=None, window=None):
     """q/k/v: (B, H, S, D). block_mask: (Sq//block_q, Sk//block_k) bool
     numpy array (a static pattern — it defines the compiled kernel).
     Equivalent to dense attention with masked-out blocks at -inf, but
     skipped rather than computed."""
     out, _ = _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q,
-                         block_k)
+                         block_k, window)
     return out
 
 
@@ -239,16 +273,19 @@ def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
     return sm_scale, bq, bk
 
 
-def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k):
+def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
+                window=None):
     sm_scale, bq, bk = _resolve(q, k, block_mask, sm_scale, block_q,
                                 block_k)
     kv_idx, kv_cnt, q_idx, q_cnt = _pattern_tables(block_mask)
     out, lse = _fwd_impl(q, k, v, jnp.asarray(kv_idx),
-                         jnp.asarray(kv_cnt), causal, sm_scale, bq, bk)
+                         jnp.asarray(kv_cnt), causal, sm_scale, bq, bk,
+                         window)
     return out, (q, k, v, out, lse)
 
 
-def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, res, do):
+def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
+                res, do):
     q, k, v, out, lse = res
     sm_scale, bq, bk = _resolve(q, k, block_mask, sm_scale, block_q,
                                 block_k)
@@ -279,7 +316,8 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, res, do):
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=bq, block_k=bk),
+                          causal=causal, block_q=bq, block_k=bk,
+                          window=window),
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
         interpret=_interpret(),
@@ -306,7 +344,8 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, res, do):
     )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=bq, block_k=bk),
+                          causal=causal, block_q=bq, block_k=bk,
+                          window=window),
         grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, Sk, D), k.dtype),

@@ -125,3 +125,58 @@ def test_functional_wrapper_paddle_layout():
     np.testing.assert_allclose(out.numpy(),
                                np.swapaxes(np.asarray(ref), 1, 2),
                                rtol=2e-4, atol=2e-4)
+
+
+class TestLlamaSlidingWindow:
+    """config.sliding_window routes attention through the banded splash
+    kernel (flash-eligible shapes) or a window-masked dense path; both
+    must match a full-model oracle built with an explicit window mask."""
+
+    def _logits(self, cfg, tokens):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaForCausalLM
+        paddle.seed(11)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m(paddle.to_tensor(tokens)).numpy()
+
+    def test_small_shape_dense_window_matches_full_when_window_covers(self):
+        from paddle_tpu.models.nlp import LlamaConfig
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 128, (2, 16)).astype(np.int32)
+        cfg = LlamaConfig.tiny(vocab=128, hidden=32, layers=1, heads=2,
+                               kv_heads=2)
+        full = self._logits(cfg, tokens)
+        cfg_w = LlamaConfig.tiny(vocab=128, hidden=32, layers=1, heads=2,
+                                 kv_heads=2)
+        cfg_w.sliding_window = 16  # covers the whole sequence
+        same = self._logits(cfg_w, tokens)
+        np.testing.assert_allclose(same, full, rtol=1e-5, atol=1e-5)
+        cfg_w.sliding_window = 4   # actually windowed: must differ
+        windowed = self._logits(cfg_w, tokens)
+        assert np.abs(windowed - full).max() > 1e-3
+
+    def test_flash_shape_splash_matches_dense_window_path(self):
+        from paddle_tpu.core import flags as _flags
+        from paddle_tpu.models.nlp import LlamaConfig
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, 256, (1, 512)).astype(np.int32)
+
+        def build(window):
+            cfg = LlamaConfig.tiny(vocab=256, hidden=128, layers=1,
+                                   heads=2, kv_heads=1)
+            cfg.max_position_embeddings = 512
+            cfg.sliding_window = window
+            return cfg
+
+        # splash path (flash enabled, D=64 eligible)
+        splash_out = self._logits(build(256), tokens)
+        # dense window path (flash disabled -> elementwise mask)
+        prev = _flags.get_flag("use_flash_attention")
+        _flags.set_flags({"use_flash_attention": False})
+        try:
+            dense_out = self._logits(build(256), tokens)
+        finally:
+            _flags.set_flags({"use_flash_attention": prev})
+        np.testing.assert_allclose(splash_out, dense_out, rtol=2e-4,
+                                   atol=2e-4)
