@@ -50,6 +50,24 @@ def _attend(cfg, q, k_all, v_all, key_mask):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v_all)
 
 
+def _layer_math(cfg, lp, x, pos_vec, attend):
+    """The shared decoder-layer body (rms -> qkv+rope -> attend ->
+    o_proj residual -> mlp residual); ``attend(q, k, v) -> (ctx, extra)``
+    owns the cache strategy so the two cache variants below can't
+    diverge on the math."""
+    B, T, H = x.shape
+    h = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
+    q, k, v = _proj_qkv(cfg, lp, h, pos_vec)
+    ctx, extra = attend(q, k, v)
+    attn = jnp.swapaxes(ctx, 1, 2).reshape(B, T, H) \
+        @ lp["self_attn.o_proj.weight"]
+    x = x + attn
+    h2 = _rms(x, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(h2 @ lp["mlp.gate_proj.weight"])
+           * (h2 @ lp["mlp.up_proj.weight"])) @ lp["mlp.down_proj.weight"]
+    return x + mlp, extra
+
+
 def _layer_step(cfg, lp, x, k_cache, v_cache, pos_vec, key_mask, write_at):
     """One decoder layer over T positions with cache read+write.
 
@@ -57,19 +75,13 @@ def _layer_step(cfg, lp, x, k_cache, v_cache, pos_vec, key_mask, write_at):
     positions; write_at: scalar start index where this block's K/V land.
     Returns (x_out, new_k_cache, new_v_cache).
     """
-    B, T, H = x.shape
-    h = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
-    q, k, v = _proj_qkv(cfg, lp, h, pos_vec)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, write_at, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, write_at, 0))
-    ctx = _attend(cfg, q, k_cache, v_cache, key_mask)
-    attn = jnp.swapaxes(ctx, 1, 2).reshape(B, T, H) \
-        @ lp["self_attn.o_proj.weight"]
-    x = x + attn
-    h2 = _rms(x, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu(h2 @ lp["mlp.gate_proj.weight"])
-           * (h2 @ lp["mlp.up_proj.weight"])) @ lp["mlp.down_proj.weight"]
-    return x + mlp, k_cache, v_cache
+    def attend(q, k, v):
+        kc = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, write_at, 0))
+        vc = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, write_at, 0))
+        return _attend(cfg, q, kc, vc, key_mask), (kc, vc)
+
+    x, (kc, vc) = _layer_math(cfg, lp, x, pos_vec, attend)
+    return x, kc, vc
 
 
 def _logits(cfg, outer, x_last):
@@ -79,53 +91,120 @@ def _logits(cfg, outer, x_last):
     return x_last @ head
 
 
+def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W):
+    """Prefill layer for a ROLLING (sliding-window) cache: attention runs
+    banded over this block's own K/V, then only the last W positions land
+    in the cache, each at slot p % W (~ Mistral's rolling buffer — cache
+    memory is O(window), not O(sequence))."""
+    B, S0, _ = x.shape
+
+    def attend(q, k, v):
+        ctx = _attend(cfg, q, k, v, key_mask)
+        if S0 >= W:
+            # slot for absolute position p is p % W; the last W positions
+            # in order are a cyclic shift of the slot sequence
+            kc = jnp.roll(k[:, :, S0 - W:, :], S0 % W, axis=2)
+            vc = jnp.roll(v[:, :, S0 - W:, :], S0 % W, axis=2)
+        else:
+            nkv, hd = k.shape[1], k.shape[-1]
+            kc = jnp.zeros((B, nkv, W, hd), k.dtype).at[:, :, :S0].set(k)
+            vc = jnp.zeros((B, nkv, W, hd), v.dtype).at[:, :, :S0].set(v)
+        return ctx, (kc, vc)
+
+    x, (kc, vc) = _layer_math(cfg, lp, x, pos_vec, attend)
+    return x, kc, vc
+
+
 def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
     """Returns ``generate(tokens, max_new_tokens, key=None,
     temperature=0.0, top_k=0) -> (B, S0+max_new) token array`` running a
-    fully jitted prefill + per-token decode with functional KV caches."""
+    fully jitted prefill + per-token decode with functional KV caches.
+
+    With ``config.sliding_window`` < max_len the cache is a ROLLING
+    buffer of window slots (write at pos % window): memory stays
+    O(window) and generation length is unbounded by the cache.
+    """
     cfg = model.config
     outer, layers = split_params(model)
     L = cfg.num_hidden_layers
     nkv = cfg.num_key_value_heads
     hd = cfg.hidden_size // cfg.num_attention_heads
+    window = getattr(cfg, "sliding_window", None)
+    rolling = window is not None and window < max_len
+    C = window if rolling else max_len  # cache slots
 
     def init_caches(B, dtype):
-        return jnp.zeros((L, B, nkv, max_len, hd), dtype)
+        return jnp.zeros((L, B, nkv, C, hd), dtype)
 
-    @partial(jax.jit, donate_argnums=(3, 4))
-    def prefill(outer, layers, tokens, k_caches, v_caches):
-        B, S0 = tokens.shape
-        x = jnp.take(outer["model.embed_tokens.weight"], tokens, axis=0)
-        pos_vec = jnp.arange(S0)
+    def _band(S0):
         causal = jnp.tril(jnp.ones((S0, S0), bool))
-        key_mask = jnp.concatenate(
-            [causal, jnp.zeros((S0, max_len - S0), bool)], axis=1)
+        if window is not None:
+            i = jnp.arange(S0)[:, None]
+            j = jnp.arange(S0)[None, :]
+            causal &= (i - j) < window
+        return causal
 
-        def body(x, per_layer):
-            lp, kc, vc = per_layer
-            x, kc, vc = _layer_step(cfg, lp, x, kc, vc, pos_vec,
-                                    key_mask, 0)
-            return x, (kc, vc)
+    if rolling:
+        # rolling prefill PRODUCES the caches (scan ys) — no zero-filled
+        # buffers allocated and threaded through as dead inputs
+        @jax.jit
+        def prefill(outer, layers, tokens):
+            B, S0 = tokens.shape
+            x = jnp.take(outer["model.embed_tokens.weight"], tokens,
+                         axis=0)
+            pos_vec = jnp.arange(S0)
+            band_mask = _band(S0)  # vs this block's own S0 keys
 
-        x, (k_caches, v_caches) = jax.lax.scan(
-            body, x, (layers, k_caches, v_caches))
-        x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
-        return _logits(cfg, outer, x[:, -1]), k_caches, v_caches
+            def body(x, lp):
+                x, kc, vc = _layer_step_rolling_prefill(
+                    cfg, lp, x, pos_vec, band_mask, C)
+                return x, (kc, vc)
+
+            x, (k_caches, v_caches) = jax.lax.scan(body, x, layers)
+            x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+            return _logits(cfg, outer, x[:, -1]), k_caches, v_caches
+    else:
+        @partial(jax.jit, donate_argnums=(3, 4))
+        def prefill(outer, layers, tokens, k_caches, v_caches):
+            B, S0 = tokens.shape
+            x = jnp.take(outer["model.embed_tokens.weight"], tokens,
+                         axis=0)
+            pos_vec = jnp.arange(S0)
+            key_mask = jnp.concatenate(
+                [_band(S0), jnp.zeros((S0, max_len - S0), bool)], axis=1)
+
+            def body(x, per_layer):
+                lp, kc, vc = per_layer
+                x, kc, vc = _layer_step(cfg, lp, x, kc, vc, pos_vec,
+                                        key_mask, 0)
+                return x, (kc, vc)
+
+            x, (k_caches, v_caches) = jax.lax.scan(
+                body, x, (layers, k_caches, v_caches))
+            x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+            return _logits(cfg, outer, x[:, -1]), k_caches, v_caches
 
     # donate the caches: dynamic_update_slice aliases in place instead of
-    # copying the whole (L,B,nkv,max_len,hd) buffers every token
+    # copying the whole (L,B,nkv,C,hd) buffers every token
     @partial(jax.jit, donate_argnums=(4, 5))
     def decode_step(outer, layers, token, pos, k_caches, v_caches):
         """token: (B,) int; pos: scalar absolute position of `token`."""
         x = jnp.take(outer["model.embed_tokens.weight"], token[:, None],
                      axis=0)
         pos_vec = jnp.full((1,), pos)
-        key_mask = (jnp.arange(max_len) <= pos)[None, :]
+        if rolling:
+            # every cache slot already written is within the window by
+            # construction (the buffer only ever holds the last C keys)
+            key_mask = ((jnp.arange(C) <= pos) | (pos >= C))[None, :]
+            write_at = jax.lax.rem(pos, C)
+        else:
+            key_mask = (jnp.arange(C) <= pos)[None, :]
+            write_at = pos
 
         def body(x, per_layer):
             lp, kc, vc = per_layer
             x, kc, vc = _layer_step(cfg, lp, x, kc, vc, pos_vec,
-                                    key_mask, pos)
+                                    key_mask, write_at)
             return x, (kc, vc)
 
         x, (k_caches, v_caches) = jax.lax.scan(
@@ -147,18 +226,22 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
                  temperature: float = 0.0, top_k: int = 0):
         tokens = jnp.asarray(tokens)
         B, S0 = tokens.shape
-        if S0 + max_new_tokens > max_len:
+        if not rolling and S0 + max_new_tokens > max_len:
             # hard error (not assert): past max_len the cache writes
-            # would silently clamp and corrupt generations
+            # would silently clamp and corrupt generations (the rolling
+            # window cache has no such limit — it wraps by design)
             raise ValueError(
                 f"prompt {S0} + max_new_tokens {max_new_tokens} exceeds "
                 f"the factory's max_len {max_len}")
         if key is None:
             key = jax.random.PRNGKey(0)
         dtype = outer["model.embed_tokens.weight"].dtype
-        kc = init_caches(B, dtype)
-        vc = init_caches(B, dtype)
-        logits, kc, vc = prefill(outer, layers, tokens, kc, vc)
+        if rolling:
+            logits, kc, vc = prefill(outer, layers, tokens)
+        else:
+            kc = init_caches(B, dtype)
+            vc = init_caches(B, dtype)
+            logits, kc, vc = prefill(outer, layers, tokens, kc, vc)
         out = [tokens]
         pos = S0
         for i in range(max_new_tokens):

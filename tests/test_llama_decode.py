@@ -57,3 +57,54 @@ class TestCompiledDecode:
         gen = llama_decode_factory(model, max_len=8)
         with pytest.raises(ValueError, match="max_len"):
             gen(jnp.asarray(np.ones((1, 6), np.int32)), max_new_tokens=5)
+
+
+class TestRollingWindowCache:
+    """sliding_window decode uses a rolling KV buffer (O(window) memory,
+    unbounded length); generations must match the eager windowed model
+    recomputing full attention every step."""
+
+    def _greedy_oracle(self, model, tokens, n_new):
+        import paddle_tpu as paddle
+        cur = np.asarray(tokens)
+        for _ in range(n_new):
+            logits = model(paddle.to_tensor(cur)).numpy()
+            nxt = logits[:, -1].argmax(-1).astype(cur.dtype)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        return cur
+
+    @pytest.mark.parametrize("s0,new,window", [
+        (6, 10, 8),    # generation crosses the wrap boundary
+        (12, 6, 8),    # prompt longer than the window (rolled prefill)
+    ])
+    def test_matches_eager_windowed_oracle(self, s0, new, window):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        cfg.sliding_window = window
+        paddle.seed(3)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        gen = llama_decode_factory(model, max_len=64)
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, 97, (2, s0)).astype(np.int32)
+        got = np.asarray(gen(prompt, max_new_tokens=new))
+        want = self._greedy_oracle(model, prompt, new)
+        np.testing.assert_array_equal(got, want)
+
+    def test_unbounded_generation_past_max_len(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+        cfg = LlamaConfig.tiny(vocab=61, hidden=32, layers=1, heads=2,
+                               kv_heads=2)
+        cfg.sliding_window = 8
+        paddle.seed(4)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        gen = llama_decode_factory(model, max_len=16)
+        prompt = np.ones((1, 4), np.int32)
+        out = np.asarray(gen(prompt, max_new_tokens=40))  # 44 > max_len
+        assert out.shape == (1, 44)
