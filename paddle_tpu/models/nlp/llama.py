@@ -246,10 +246,27 @@ class LlamaAttention(nn.Layer):
                         "axis) is not supported — the ring walk would "
                         "need window-aware skipping; drop the 'sep' axis "
                         "or unset sliding_window")
+                from ...ops.pallas.splash_attention import SCORE_ELEMS
+                if n_rep > 1 and _flash_eligible(S, qv.shape[-1],
+                                                 qv.dtype) \
+                        and n_rep * 128 * 128 <= SCORE_ELEMS:
+                    # grouped banded splash: K/V stay at the true kv-head
+                    # count AND compute scales with window/S (very large
+                    # groups exceed the kernel's VMEM score budget and
+                    # fall through to the repeat path below)
+                    from ...ops.pallas.splash_attention import (
+                        banded_block_mask, grouped_splash_attention)
+                    bm = banded_block_mask(S, S, 128, 128, window)
+                    tp_mesh, tp_axis = _tensor_parallel_mesh()
+                    out = _shard_map_heads(
+                        lambda q, k, v: grouped_splash_attention(
+                            q, k, v, bm, True, scale, 128, 128, window),
+                        tp_mesh, tp_axis or "model",
+                        jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kv, 1, 2),
+                        jnp.swapaxes(vv, 1, 2))
+                    return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
                 kvw, vvw = kv, vv
                 if n_rep > 1:
-                    # grouped splash is a queued follow-up; repeat is
-                    # correct, costs G x K/V HBM
                     kvw = jnp.repeat(kv, n_rep, axis=2)
                     vvw = jnp.repeat(vv, n_rep, axis=2)
                 qt = jnp.swapaxes(qv, 1, 2)

@@ -2,17 +2,27 @@
 
 ~ the reference's sparse_attention_op.cu (block-sparse SDD attention over
 a CSR pattern) — which computes DENSE scores and masks. Here masked-out
-blocks are truly SKIPPED: a per-q-block list of live kv-block indices
-(scalar-prefetched into SMEM) drives the online-softmax walk, so compute
-and VMEM traffic scale with the pattern's density, not O(S^2). Same
-resident-KV + exp2-domain design as flash_attention.py; the backward
-walks the transposed index lists for dK/dV.
+blocks are truly SKIPPED in the forward and dQ walks: a per-q-block list
+of live kv-block indices (scalar-prefetched into SMEM) drives the
+online-softmax walk, so compute and VMEM traffic scale with the
+pattern's density, not O(S^2). Same resident-KV + exp2-domain design as
+flash_attention.py.
+
+ONE kernel family serves both MHA and GQA/MQA: queries carry a group
+dimension (the G query heads sharing a kv head fold into the matmul M
+dimension, K/V stay at their true head count — flash_attention_gqa.py's
+layout); plain multi-head attention is the G=1 case. The dK/dV backward
+STREAMS q blocks through an innermost grid dimension with VMEM scratch
+accumulators (full-sequence q/do residency would be G*Sq*D — over VMEM
+at training shapes); dead (q, kv) block pairs skip their compute via a
+prefetched block-mask predicate (their DMA still runs — Mosaic fetches
+per grid step — so the dkv pass is DMA-dense but compute-sparse).
 
 The block pattern is a (num_q_blocks, num_kv_blocks) bool mask — the
 natural TPU granularity (MXU tiles), and the form local/strided/BigBird
-patterns compress to. ``causal=True`` additionally applies the
-elementwise triangle inside live blocks (diagonal blocks of a causal
-pattern are partially masked).
+patterns compress to. ``causal=True`` applies the elementwise triangle
+inside live blocks; ``window`` additionally applies the token-exact
+sliding-window band (q_pos - k_pos < window, Mistral semantics).
 """
 from __future__ import annotations
 
@@ -27,165 +37,28 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import LN2, LOG2E, NEG_INF, _interpret
 
+# f32-element budget for one (G*block_q, block_k) score/probability buffer
+# (2 MB each); _resolve raises when a grouped config exceeds it
+SCORE_ELEMS = 512 * 1024
+
 
 def _pattern_tables(block_mask: np.ndarray):
-    """Dense (nq, nk) bool -> padded index lists both ways.
+    """Dense (nq, nk) bool -> padded per-q-block kv index lists.
 
-    Returns (kv_idx (nq, max_kv), kv_cnt (nq,), q_idx (nk, max_q),
-    q_cnt (nk,)) int32; padding entries repeat the last valid index (they
-    are never walked — counts bound the fori_loop)."""
+    Returns (kv_idx (nq, max_kv), kv_cnt (nq,)) int32; padding entries
+    repeat the last valid index (never walked — counts bound the
+    fori_loop)."""
     bm = np.asarray(block_mask, bool)
-    nq, nk = bm.shape
+    nq, _ = bm.shape
     kv_cnt = bm.sum(1).astype(np.int32)
-    q_cnt = bm.sum(0).astype(np.int32)
     max_kv = max(1, int(kv_cnt.max()))
-    max_q = max(1, int(q_cnt.max()))
     kv_idx = np.zeros((nq, max_kv), np.int32)
-    q_idx = np.zeros((nk, max_q), np.int32)
     for i in range(nq):
         live = np.flatnonzero(bm[i])
         kv_idx[i, :len(live)] = live
         if len(live):
             kv_idx[i, len(live):] = live[-1]
-    for j in range(nk):
-        live = np.flatnonzero(bm[:, j])
-        q_idx[j, :len(live)] = live
-        if len(live):
-            q_idx[j, len(live):] = live[-1]
-    return kv_idx, kv_cnt, q_idx, q_cnt
-
-
-def _live_mask(qi, kj, block_q, block_k, causal, window):
-    """Elementwise live mask inside a block: causal triangle and/or the
-    sliding-window band (q_pos - k_pos < window, Mistral semantics)."""
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    live = jnp.ones((block_q, block_k), bool)
-    if causal:
-        live &= q_pos >= k_pos
-    if window is not None:
-        live &= (q_pos - k_pos) < window
-    return live
-
-
-def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_q, block_k, window):
-    qi = pl.program_id(1)
-    q = q_ref[0]
-    q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-
-    def body(t, carry):
-        m, l, acc = carry
-        kj = kv_idx[qi, t]
-        k = k_ref[0, pl.dslice(kj * block_k, block_k)]
-        v = v_ref[0, pl.dslice(kj * block_k, block_k)]
-        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or window is not None:
-            s = jnp.where(_live_mask(qi, kj, block_q, block_k, causal,
-                                     window), s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp2(s - m_new[:, None])
-        # rows with NO live entry yet (m_new still NEG_INF — e.g. a live
-        # block entirely above the causal diagonal): exp2(s - m_new) is
-        # exp2(0) = 1 per entry since NEG_INF is finite; zero them so
-        # such rows accumulate no bogus mass
-        p = jnp.where((m_new > NEG_INF * 0.5)[:, None], p, 0.0)
-        alpha = jnp.exp2(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, kv_cnt[qi], body, (m, l, acc))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    # fully-masked rows (no live block, or live blocks fully above the
-    # causal diagonal) output 0
-    any_mass = l > 0.0
-    o_ref[0] = jnp.where(any_mass[:, None], acc / l_safe[:, None],
-                         0.0).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(any_mass, LN2 * m + jnp.log(l_safe),
-                           NEG_INF)[:, None].astype(jnp.float32)
-
-
-def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, sm_scale, causal, block_q,
-                   block_k, window):
-    qi = pl.program_id(1)
-    q = q_ref[0]
-    q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
-    do = do_ref[0]
-    lse2 = lse_ref[0, :, 0] * LOG2E
-    delta = delta_ref[0, :, 0]
-    dq = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
-
-    def body(t, dq):
-        kj = kv_idx[qi, t]
-        k = k_ref[0, pl.dslice(kj * block_k, block_k)]
-        v = v_ref[0, pl.dslice(kj * block_k, block_k)]
-        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or window is not None:
-            s = jnp.where(_live_mask(qi, kj, block_q, block_k, causal,
-                                     window), s, NEG_INF)
-        # masked entries must be 0 regardless of lse: for an all-masked
-        # row lse is NEG_INF and s - lse2 would OVERFLOW to +inf
-        p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(ds.astype(k.dtype), k,
-                                        (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, kv_cnt[qi], body, dq)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_idx, q_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, sm_scale, causal,
-                    block_q, block_k, window):
-    kj = pl.program_id(1)
-    k = k_ref[0]
-    v = v_ref[0]
-    k2 = (k.astype(jnp.float32) * (sm_scale * LOG2E)).astype(k.dtype)
-    dk = jnp.zeros(k.shape, jnp.float32)
-    dv = jnp.zeros(v.shape, jnp.float32)
-
-    def body(t, carry):
-        dk, dv = carry
-        qi = q_idx[kj, t]
-        q = q_ref[0, pl.dslice(qi * block_q, block_q)]
-        do = do_ref[0, pl.dslice(qi * block_q, block_q)]
-        lse2 = lse_ref[0, pl.dslice(qi * block_q, block_q), 0] * LOG2E
-        delta = delta_ref[0, pl.dslice(qi * block_q, block_q), 0]
-        s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or window is not None:
-            s = jnp.where(_live_mask(qi, kj, block_q, block_k, causal,
-                                     window), s, NEG_INF)
-        # see dq kernel: guard against all-masked rows' NEG_INF lse
-        p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
-        dv_new = dv + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk_new = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk_new, dv_new
-
-    dk, dv = jax.lax.fori_loop(0, q_cnt[kj], body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    return kv_idx, kv_cnt
 
 
 def banded_block_mask(Sq, Sk, block_q, block_k, window,
@@ -211,53 +84,156 @@ def banded_block_mask(Sq, Sk, block_q, block_k, window,
     return bm
 
 
-def _fwd_impl(q, k, v, kv_idx, kv_cnt, causal, sm_scale, block_q,
-              block_k, window):
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
-    bh = B * H
-    qr = q.reshape(bh, Sq, D)
-    kr = k.reshape(bh, Sk, D)
-    vr = v.reshape(bh, Sk, D)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, Sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, *_: (b, i, 0)),
-        ],
-    )
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          window=window),
-        grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, Sq, 1), jnp.float32),
-        ],
-        interpret=_interpret(),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-    )(kv_idx, kv_cnt, qr, kr, vr)
-    return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
+def _live_mask(qi, kj, rows, block_q, block_k, causal, window):
+    """Elementwise live mask for a (G*block_q, block_k) score block: row
+    r belongs to query position qi*block_q + (r % block_q) — the group
+    index r // block_q shares positions across the G heads."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+    q_pos = qi * block_q + jax.lax.rem(r, block_q)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_k), 1)
+    live = jnp.ones((rows, block_k), bool)
+    if causal:
+        live &= q_pos >= k_pos
+    if window is not None:
+        live &= (q_pos - k_pos) < window
+    return live
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def splash_attention(q, k, v, block_mask, causal=False, sm_scale=None,
-                     block_q=None, block_k=None, window=None):
-    """q/k/v: (B, H, S, D). block_mask: (Sq//block_q, Sk//block_k) bool
-    numpy array (a static pattern — it defines the compiled kernel).
-    Equivalent to dense attention with masked-out blocks at -inf, but
-    skipped rather than computed."""
-    out, _ = _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q,
-                         block_k, window)
-    return out
+def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_q, block_k, window, groups):
+    qi = pl.program_id(1)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+    q = q_ref[0].reshape(rows, D)
+    q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+    m = jnp.full((rows,), NEG_INF, jnp.float32)
+    l = jnp.zeros((rows,), jnp.float32)
+    acc = jnp.zeros((rows, D), jnp.float32)
+
+    def body(t, carry):
+        m, l, acc = carry
+        kj = kv_idx[qi, t]
+        k = k_ref[0, pl.dslice(kj * block_k, block_k)]
+        v = v_ref[0, pl.dslice(kj * block_k, block_k)]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
+                                     causal, window), s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp2(s - m_new[:, None])
+        # rows with NO live entry yet (m_new still NEG_INF — e.g. a live
+        # block entirely above the causal diagonal): exp2(s - m_new) is
+        # exp2(0) = 1 per entry since NEG_INF is finite; zero them so
+        # such rows accumulate no bogus mass
+        p = jnp.where((m_new > NEG_INF * 0.5)[:, None], p, 0.0)
+        alpha = jnp.exp2(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, kv_cnt[qi], body, (m, l, acc))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    # fully-masked rows (no live block, or live blocks fully above the
+    # causal diagonal) output 0
+    any_mass = l > 0.0
+    o_ref[0] = jnp.where(any_mass[:, None], acc / l_safe[:, None],
+                         0.0).reshape(G, block_q, D).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(any_mass, LN2 * m + jnp.log(l_safe),
+                           NEG_INF).reshape(G, block_q, 1).astype(
+        jnp.float32)
+
+
+def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, sm_scale, causal, block_q,
+                   block_k, window, groups):
+    qi = pl.program_id(1)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+    q = q_ref[0].reshape(rows, D)
+    q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+    do = do_ref[0].reshape(rows, D)
+    lse2 = lse_ref[0].reshape(rows) * LOG2E
+    delta = delta_ref[0].reshape(rows)
+    dq = jnp.zeros((rows, D), jnp.float32)
+
+    def body(t, dq):
+        kj = kv_idx[qi, t]
+        k = k_ref[0, pl.dslice(kj * block_k, block_k)]
+        v = v_ref[0, pl.dslice(kj * block_k, block_k)]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
+                                     causal, window), s, NEG_INF)
+        # masked entries must be 0 regardless of lse: for an all-masked
+        # row lse is NEG_INF and s - lse2 would OVERFLOW to +inf
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k,
+                                        (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, kv_cnt[qi], body, dq)
+    dq_ref[0] = dq.reshape(G, block_q, D).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(bm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    sm_scale, causal, block_q, block_k, window, groups,
+                    num_q):
+    """dK/dV with q blocks STREAMED through the innermost grid dimension
+    (VMEM holds one (G, bq, D) q/do block, not the sequence); compute for
+    dead (q, kv) pairs is skipped via the prefetched block-mask
+    predicate."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    @pl.when(bm_ref[qi, kj] > 0)
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        k2 = (k.astype(jnp.float32) * (sm_scale * LOG2E)).astype(k.dtype)
+        q = q_ref[0].reshape(rows, D)
+        do = do_ref[0].reshape(rows, D)
+        lse2 = lse_ref[0].reshape(rows) * LOG2E
+        delta = delta_ref[0].reshape(rows)
+        s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
+                                     causal, window), s, NEG_INF)
+        # same NEG_INF-lse guard as the dq kernel
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
@@ -270,82 +246,130 @@ def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
         raise ValueError(
             f"splash_attention: block_mask {nq}x{nk} with blocks "
             f"({bq},{bk}) does not tile seqs ({q.shape[2]},{k.shape[2]})")
-    return sm_scale, bq, bk
+    if q.shape[1] % max(1, k.shape[1]):
+        raise ValueError(
+            f"query heads {q.shape[1]} not a multiple of kv heads "
+            f"{k.shape[1]}")
+    G = q.shape[1] // max(1, k.shape[1])
+    if G * bq * bk > SCORE_ELEMS:
+        raise ValueError(
+            f"grouped splash: G*block_q*block_k = {G * bq * bk} exceeds "
+            f"the VMEM score budget ({SCORE_ELEMS}); use smaller blocks "
+            f"in the mask or repeat K/V across fewer groups")
+    return sm_scale, bq, bk, G
 
 
 def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
                 window=None):
-    sm_scale, bq, bk = _resolve(q, k, block_mask, sm_scale, block_q,
-                                block_k)
-    kv_idx, kv_cnt, q_idx, q_cnt = _pattern_tables(block_mask)
-    out, lse = _fwd_impl(q, k, v, jnp.asarray(kv_idx),
-                         jnp.asarray(kv_cnt), causal, sm_scale, bq, bk,
-                         window)
-    return out, (q, k, v, out, lse)
+    sm_scale, bq, bk, G = _resolve(q, k, block_mask, sm_scale, block_q,
+                                   block_k)
+    kv_idx, kv_cnt = _pattern_tables(block_mask)
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
+    Sk = k.shape[2]
+    bh = B * Hkv
+    qr = q.reshape(bh, G, Sq, D)
+    kr = k.reshape(bh, Sk, D)
+    vr = v.reshape(bh, Sk, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, window=window, groups=G),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, G, Sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(jnp.asarray(kv_idx), jnp.asarray(kv_cnt), qr, kr, vr)
+    out = out.reshape(B, Hq, Sq, D)
+    return out, (q, k, v, out, lse.reshape(B, Hq, Sq))
 
 
 def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
                 res, do):
     q, k, v, out, lse = res
-    sm_scale, bq, bk = _resolve(q, k, block_mask, sm_scale, block_q,
-                                block_k)
-    kv_idx, kv_cnt, q_idx, q_cnt = _pattern_tables(block_mask)
-    B, H, Sq, D = q.shape
+    sm_scale, bq, bk, G = _resolve(q, k, block_mask, sm_scale, block_q,
+                                   block_k)
+    kv_idx, kv_cnt = _pattern_tables(block_mask)
+    B, Hq, Sq, D = q.shape
+    Hkv = k.shape[1]
     Sk = k.shape[2]
-    bh = B * H
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, Sq, 1)
-    qr = q.reshape(bh, Sq, D)
+    bh = B * Hkv
+    qr = q.reshape(bh, G, Sq, D)
     kr = k.reshape(bh, Sk, D)
     vr = v.reshape(bh, Sk, D)
-    dor = do.reshape(bh, Sq, D)
-    lser = lse.reshape(bh, Sq, 1)
+    dor = do.reshape(bh, G, Sq, D)
+    lser = lse.reshape(bh, G, Sq, 1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, G, Sq, 1)
 
     dq_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bh, Sq // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, *_: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, G, bq, D),
+                               lambda b, i, *_: (b, 0, i, 0)),
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=bq, block_k=bk,
-                          window=window),
+                          window=window, groups=G),
         grid_spec=dq_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(jnp.asarray(kv_idx), jnp.asarray(kv_cnt), qr, kr, vr, dor, lser,
       delta)
 
+    num_q = Sq // bq
     dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, Sk // bk),
+        num_scalar_prefetch=1,
+        grid=(bh, Sk // bk, num_q),
         in_specs=[
-            pl.BlockSpec((1, Sq, D), lambda b, j, *_: (b, 0, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, *_: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, *_: (b, j, 0)),
-            pl.BlockSpec((1, Sq, D), lambda b, j, *_: (b, 0, 0)),
-            pl.BlockSpec((1, Sq, 1), lambda b, j, *_: (b, 0, 0)),
-            pl.BlockSpec((1, Sq, 1), lambda b, j, *_: (b, 0, 0)),
+            pl.BlockSpec((1, G, bq, D), lambda b, j, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i, *_: (b, j, 0)),
+            pl.BlockSpec((1, G, bq, D), lambda b, j, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, bq, 1), lambda b, j, i, *_: (b, 0, i, 0)),
+            pl.BlockSpec((1, G, bq, 1), lambda b, j, i, *_: (b, 0, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j, *_: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i, *_: (b, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
         ],
     )
+    bm_i32 = jnp.asarray(np.asarray(block_mask, np.int32))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=bq, block_k=bk,
-                          window=window),
+                          window=window, groups=G, num_q=num_q),
         grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, Sk, D), k.dtype),
@@ -353,12 +377,29 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
         ],
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-    )(jnp.asarray(q_idx), jnp.asarray(q_cnt), qr, kr, vr, dor, lser,
-      delta)
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(bm_i32, qr, kr, vr, dor, lser, delta)
 
-    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
-            dv.reshape(B, H, Sk, D))
+    return (dq.reshape(B, Hq, Sq, D), dk.reshape(B, Hkv, Sk, D),
+            dv.reshape(B, Hkv, Sk, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def splash_attention(q, k, v, block_mask, causal=False, sm_scale=None,
+                     block_q=None, block_k=None, window=None):
+    """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) with Hq a multiple of Hkv
+    (MHA is Hq == Hkv; GQA/MQA fold the group into the kernel's M dim).
+    block_mask: (Sq//block_q, Sk//block_k) bool numpy array (a static
+    pattern — it defines the compiled kernel). Equivalent to dense
+    attention with masked-out blocks at -inf, but skipped rather than
+    computed."""
+    out, _ = _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q,
+                         block_k, window)
+    return out
 
 
 splash_attention.defvjp(_splash_fwd, _splash_bwd)
+
+# GQA entry point: same kernel family; kept as a named alias so call
+# sites read as grouped (and for parity with flash_attention_gqa.py)
+grouped_splash_attention = splash_attention

@@ -180,3 +180,90 @@ class TestLlamaSlidingWindow:
             _flags.set_flags({"use_flash_attention": prev})
         np.testing.assert_allclose(splash_out, dense_out, rtol=2e-4,
                                    atol=2e-4)
+
+
+class TestGroupedSplash:
+    """GQA splash: equivalent to splash over jnp.repeat'ed K/V without
+    the repeat; gradients sum over each kv head's G query groups."""
+
+    def _data(self, Hq=4, Hkv=2, S=256, D=64):
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((1, Hq, S, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, Hkv, S, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, Hkv, S, D)), jnp.float32)
+        return q, k, v
+
+    @pytest.mark.parametrize("window", [None, 100])
+    def test_matches_repeat_oracle(self, window):
+        from paddle_tpu.ops.pallas.splash_attention import (
+            grouped_splash_attention)
+        q, k, v = self._data()
+        G = q.shape[1] // k.shape[1]
+        bm = np.tril(np.ones((2, 2), bool))
+
+        def oracle(q, k, v):
+            kr = jnp.repeat(k, G, axis=1)
+            vr = jnp.repeat(v, G, axis=1)
+            return splash_attention(q, kr, vr, bm, True, None, 128, 128,
+                                    window)
+
+        out = grouped_splash_attention(q, k, v, bm, True, None, 128, 128,
+                                       window)
+        ref = oracle(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss_g(q, k, v):
+            return jnp.sum(grouped_splash_attention(
+                q, k, v, bm, True, None, 128, 128, window) ** 2)
+
+        def loss_o(q, k, v):
+            return jnp.sum(oracle(q, k, v) ** 2)
+
+        gg = jax.grad(loss_g, argnums=(0, 1, 2))(q, k, v)
+        go = jax.grad(loss_o, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gg, go, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=f"d{name}")
+
+    def test_llama_gqa_window_uses_grouped_path(self):
+        # full-model parity: GQA + sliding_window (grouped splash) vs the
+        # dense window path (flash disabled)
+        import paddle_tpu as paddle
+        from paddle_tpu.core import flags as _flags
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        rng = np.random.default_rng(8)
+        tokens = rng.integers(0, 128, (1, 512)).astype(np.int32)
+
+        def logits():
+            cfg = LlamaConfig.tiny(vocab=128, hidden=128, layers=1,
+                                   heads=2, kv_heads=1)
+            cfg.max_position_embeddings = 512
+            cfg.sliding_window = 200
+            paddle.seed(21)
+            m = LlamaForCausalLM(cfg)
+            m.eval()
+            return m(paddle.to_tensor(tokens)).numpy()
+
+        splash_out = logits()
+        prev = _flags.get_flag("use_flash_attention")
+        _flags.set_flags({"use_flash_attention": False})
+        try:
+            dense_out = logits()
+        finally:
+            _flags.set_flags({"use_flash_attention": prev})
+        np.testing.assert_allclose(splash_out, dense_out, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_vmem_budget_raises_and_model_falls_back(self):
+        from paddle_tpu.ops.pallas.splash_attention import (
+            SCORE_ELEMS, grouped_splash_attention)
+        rng = np.random.default_rng(9)
+        # MQA G=64: G*128*128 = 1M f32 > budget -> explicit error
+        q = jnp.asarray(rng.standard_normal((1, 64, 256, 8)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 1, 256, 8)), jnp.float32)
+        bm = np.tril(np.ones((2, 2), bool))
+        with pytest.raises(ValueError, match="VMEM score budget"):
+            grouped_splash_attention(q, k, k, bm, True)
+        assert 64 * 128 * 128 > SCORE_ELEMS  # the llama gate constant
