@@ -246,10 +246,11 @@ class LlamaAttention(nn.Layer):
                         "axis) is not supported — the ring walk would "
                         "need window-aware skipping; drop the 'sep' axis "
                         "or unset sliding_window")
-                from ...ops.pallas.splash_attention import SCORE_ELEMS
+                from ...ops.pallas.splash_attention import \
+                    fits_score_budget
                 if n_rep > 1 and _flash_eligible(S, qv.shape[-1],
                                                  qv.dtype) \
-                        and n_rep * 128 * 128 <= SCORE_ELEMS:
+                        and fits_score_budget(n_rep):
                     # grouped banded splash: K/V stay at the true kv-head
                     # count AND compute scales with window/S (very large
                     # groups exceed the kernel's VMEM score budget and

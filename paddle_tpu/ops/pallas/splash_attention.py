@@ -42,6 +42,14 @@ from .flash_attention import LN2, LOG2E, NEG_INF, _interpret
 SCORE_ELEMS = 512 * 1024
 
 
+def fits_score_budget(groups: int, block_q: int = 128,
+                      block_k: int = 128) -> bool:
+    """The kernel's VMEM eligibility predicate — ONE definition shared
+    with model-level gates (llama's grouped sliding-window path) so the
+    bound can't drift between the kernel and its callers."""
+    return groups * block_q * block_k <= SCORE_ELEMS
+
+
 def _pattern_tables(block_mask: np.ndarray):
     """Dense (nq, nk) bool -> padded per-q-block kv index lists.
 
@@ -251,11 +259,13 @@ def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
             f"query heads {q.shape[1]} not a multiple of kv heads "
             f"{k.shape[1]}")
     G = q.shape[1] // max(1, k.shape[1])
-    if G * bq * bk > SCORE_ELEMS:
+    if not fits_score_budget(G, bq, bk):
         raise ValueError(
-            f"grouped splash: G*block_q*block_k = {G * bq * bk} exceeds "
-            f"the VMEM score budget ({SCORE_ELEMS}); use smaller blocks "
-            f"in the mask or repeat K/V across fewer groups")
+            f"splash_attention: G*block_q*block_k = {G * bq * bk} f32 "
+            f"elements exceeds the VMEM score budget ({SCORE_ELEMS}); "
+            f"use a finer block_mask granularity"
+            + (" or repeat K/V across fewer query groups" if G > 1
+               else ""))
     return sm_scale, bq, bk, G
 
 

@@ -110,3 +110,56 @@ def test_two_process_global_mesh_train_step(tmp_path):
     np.testing.assert_allclose(two[0], two[1], rtol=1e-6)
     np.testing.assert_allclose(two[0], single, rtol=1e-4, atol=1e-6)
     assert single[-1] < single[0], "loss did not decrease"
+
+
+def test_two_node_launch_httpmaster_rendezvous(tmp_path):
+    """The --nnodes > 1 path: two launch pods (node_rank 0/1) rendezvous
+    through HTTPMaster.sync_peers, each contributing one trainer to ONE
+    jax.distributed global mesh (~ the reference's multi-node launch
+    contract, launch/controllers/collective.py + controllers/master.py).
+    """
+    import subprocess
+    import time as _time
+    script = tmp_path / "mesh_trainer.py"
+    src = TRAINER.replace("jax.devices()[:8]", "jax.devices()[:2]") \
+                 .replace("devs.reshape(2, 2, 2)", "devs.reshape(1, 1, 2)")
+    assert "reshape(1, 1, 2)" in src
+    script.write_text(src)
+    out = tmp_path / "nodes"
+    out.mkdir()
+    env = dict(os.environ)
+    env["TEST_OUT_DIR"] = str(out)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_GLOBAL_RANK", None)
+    env.pop("PADDLE_WORLD_SIZE", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    master = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    pods = []
+    try:
+        for nr in (0, 1):
+            pods.append(subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--master", master, "--nnodes", "2",
+                 "--node_rank", str(nr),
+                 "--nproc_per_node", "1", str(script)],
+                cwd="/root/repo", env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+            _time.sleep(0.5)  # node 0 binds the HTTP master first
+        outs = [p.communicate(timeout=600) for p in pods]
+    finally:
+        for p in pods:
+            if p.poll() is None:
+                p.kill()
+    for p, (so, se) in zip(pods, outs):
+        assert p.returncode == 0, so + "\n" + se
+    losses = []
+    for r in range(2):
+        f = out / f"loss_rank{r}.json"
+        assert f.exists(), (outs[0][0], outs[0][1], outs[1][1])
+        losses.append(json.loads(f.read_text()))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    assert losses[0][-1] < losses[0][0]

@@ -256,14 +256,44 @@ class TestGroupedSplash:
         np.testing.assert_allclose(splash_out, dense_out, rtol=2e-4,
                                    atol=2e-4)
 
-    def test_vmem_budget_raises_and_model_falls_back(self):
-        from paddle_tpu.ops.pallas.splash_attention import (
-            SCORE_ELEMS, grouped_splash_attention)
+    def test_vmem_budget_raises_and_model_falls_back(self, monkeypatch):
+        import paddle_tpu.ops.pallas.splash_attention as sp
         rng = np.random.default_rng(9)
         # MQA G=64: G*128*128 = 1M f32 > budget -> explicit error
         q = jnp.asarray(rng.standard_normal((1, 64, 256, 8)), jnp.float32)
         k = jnp.asarray(rng.standard_normal((1, 1, 256, 8)), jnp.float32)
         bm = np.tril(np.ones((2, 2), bool))
         with pytest.raises(ValueError, match="VMEM score budget"):
-            grouped_splash_attention(q, k, k, bm, True)
-        assert 64 * 128 * 128 > SCORE_ELEMS  # the llama gate constant
+            sp.grouped_splash_attention(q, k, k, bm, True)
+        assert not sp.fits_score_budget(64)  # the llama gate predicate
+
+        # model-level fallback: with the budget shrunk so even G=2 is
+        # over, the GQA windowed model must take the repeat path and
+        # still match the dense window oracle (not raise)
+        import paddle_tpu as paddle
+        from paddle_tpu.core import flags as _flags
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        tokens = rng.integers(0, 128, (1, 256)).astype(np.int32)
+
+        def logits():
+            cfg = LlamaConfig.tiny(vocab=128, hidden=128, layers=1,
+                                   heads=2, kv_heads=1)
+            cfg.max_position_embeddings = 256
+            cfg.sliding_window = 100
+            paddle.seed(23)
+            m = LlamaForCausalLM(cfg)
+            m.eval()
+            return m(paddle.to_tensor(tokens)).numpy()
+
+        # G=2 over budget, G=1 (the repeat path) still within it
+        monkeypatch.setattr(sp, "SCORE_ELEMS", 128 * 128 + 1)
+        via_repeat = logits()  # grouped gate now fails -> repeat splash
+        monkeypatch.undo()
+        prev = _flags.get_flag("use_flash_attention")
+        _flags.set_flags({"use_flash_attention": False})
+        try:
+            dense = logits()
+        finally:
+            _flags.set_flags({"use_flash_attention": prev})
+        np.testing.assert_allclose(via_repeat, dense, rtol=2e-4,
+                                   atol=2e-4)
