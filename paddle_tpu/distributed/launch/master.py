@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -71,11 +72,23 @@ class HTTPMaster:
                                  daemon=True)
             t.start()
 
-    def put(self, key: str, value: str):
+    def put(self, key: str, value: str, retry_for: float = 60.0):
+        """PUT with connection retry: non-host nodes race the host's
+        server bind (a node-1 launcher can reach here before node 0 has
+        bound the port — without retry that start-order race crashes the
+        pod)."""
         req = urllib.request.Request(
             f"http://{self.endpoint}/{key}", data=value.encode(),
             method="PUT")
-        urllib.request.urlopen(req, timeout=10)
+        deadline = time.time() + retry_for
+        while True:
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                return
+            except (ConnectionError, urllib.error.URLError):
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
 
     def get(self, key: str):
         try:

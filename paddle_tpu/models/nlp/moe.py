@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from ... import nn
 from ...incubate.distributed.models.moe import MoELayer
-from .llama import LlamaAttention, LlamaConfig
+from .llama import LlamaAttention, LlamaConfig, LlamaMLP
 
 
 @dataclasses.dataclass
@@ -29,6 +29,10 @@ class MoEConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     aux_loss_weight: float = 0.01
+    # DeepSeekMoE/Qwen2-MoE shape: dense "shared" experts run on EVERY
+    # token alongside the routed ones (isolating common knowledge so the
+    # fine-grained routed experts specialize); 0 = classic gshard/switch
+    num_shared_experts: int = 0
 
     @staticmethod
     def tiny():
@@ -36,6 +40,17 @@ class MoEConfig:
                          intermediate_size=128, num_hidden_layers=2,
                          num_attention_heads=4, num_key_value_heads=4,
                          num_experts=4, moe_every=1)
+
+    @staticmethod
+    def deepseek_tiny():
+        """Fine-grained + shared-expert shape (BASELINE config 5's
+        DeepSeekMoE/Qwen2-MoE family): many small routed experts, one
+        always-on shared expert."""
+        return MoEConfig(vocab_size=256, hidden_size=64,
+                         intermediate_size=32, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=4,
+                         num_experts=8, top_k=2, moe_every=1,
+                         num_shared_experts=1)
 
     def as_llama(self) -> LlamaConfig:
         return LlamaConfig(
@@ -57,6 +72,7 @@ class MoEDecoderLayer(nn.Layer):
         self.self_attn = LlamaAttention(lc)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
                                                    config.rms_norm_eps)
+        self.shared_mlp = None
         if use_moe:
             self.mlp = MoELayer(config.hidden_size, config.intermediate_size,
                                 config.num_experts,
@@ -64,14 +80,25 @@ class MoEDecoderLayer(nn.Layer):
                                 else "switch",
                                 capacity_factor=config.capacity_factor,
                                 top_k=config.top_k)
+            if config.num_shared_experts > 0:
+                # always-on shared expert(s): one dense SwiGLU whose
+                # intermediate width is n_shared x the routed experts'
+                # (DeepSeekMoE isolates common knowledge here; routed
+                # experts specialize)
+                self.shared_mlp = LlamaMLP(dataclasses.replace(
+                    lc, intermediate_size=config.intermediate_size
+                    * config.num_shared_experts))
         else:
-            from .llama import LlamaMLP
             self.mlp = LlamaMLP(lc)
         self.use_moe = use_moe
 
     def forward(self, x):
         x = x + self.self_attn(self.input_layernorm(x))
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        h = self.post_attention_layernorm(x)
+        out = self.mlp(h)
+        if self.shared_mlp is not None:
+            out = out + self.shared_mlp(h)
+        x = x + out
         return x
 
 

@@ -71,19 +71,24 @@ TRAINER = textwrap.dedent("""
 """)
 
 
+def _trainer_env(out_dir, n_local_devices):
+    env = dict(os.environ)
+    env["TEST_OUT_DIR"] = str(out_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_GLOBAL_RANK", None)
+    env.pop("PADDLE_WORLD_SIZE", None)
+    env["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={n_local_devices}"
+    return env
+
+
 def _run(tmp_path, nproc):
     script = tmp_path / "mesh_trainer.py"
     script.write_text(TRAINER)
     out = tmp_path / f"np{nproc}"
     out.mkdir()
-    env = dict(os.environ)
-    env["TEST_OUT_DIR"] = str(out)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PADDLE_GLOBAL_RANK", None)
-    env.pop("PADDLE_WORLD_SIZE", None)
     # every process contributes 8//nproc local devices to the global mesh
-    env["XLA_FLAGS"] = \
-        f"--xla_force_host_platform_device_count={8 // nproc}"
+    env = _trainer_env(out, 8 // nproc)
     if nproc == 1:
         proc = subprocess.run([sys.executable, str(script)],
                               cwd="/root/repo", env=env,
@@ -127,12 +132,7 @@ def test_two_node_launch_httpmaster_rendezvous(tmp_path):
     script.write_text(src)
     out = tmp_path / "nodes"
     out.mkdir()
-    env = dict(os.environ)
-    env["TEST_OUT_DIR"] = str(out)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PADDLE_GLOBAL_RANK", None)
-    env.pop("PADDLE_WORLD_SIZE", None)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env = _trainer_env(out, 1)
     import socket
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

@@ -43,14 +43,16 @@ def main():
     k = jnp.asarray(rng.standard_normal((B, H, S, D)), dtype)
     v = jnp.asarray(rng.standard_normal((B, H, S, D)), dtype)
 
-    def timed(fn):
+    def timed(fn, kk=None, vv=None):
+        kk = k if kk is None else kk
+        vv = v if vv is None else vv
         g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
             fn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2)))
-        out = g(q, k, v)
+        out = g(q, kk, vv)
         hard_sync(out[0])  # readback: the only real sync under axon
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = g(q, k, v)
+            out = g(q, kk, vv)
         hard_sync(out[0])
         return (time.perf_counter() - t0) / iters * 1e3
 
@@ -72,22 +74,11 @@ def main():
     vg = v[:, :Hkv]
     bm = banded_block_mask(S, S, 128, 128, S // 4)
 
-    def timed_kv(fn):
-        g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
-            fn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2)))
-        out = g(q, kg, vg)
-        hard_sync(out[0])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = g(q, kg, vg)
-        hard_sync(out[0])
-        return (time.perf_counter() - t0) / iters * 1e3
-
-    grouped_ms = timed_kv(lambda a, b, c: splash_attention(
-        a, b, c, bm, True, None, 128, 128, S // 4))
-    repeat_ms = timed_kv(lambda a, b, c: splash_attention(
+    grouped_ms = timed(lambda a, b, c: splash_attention(
+        a, b, c, bm, True, None, 128, 128, S // 4), kg, vg)
+    repeat_ms = timed(lambda a, b, c: splash_attention(
         a, jnp.repeat(b, G, axis=1), jnp.repeat(c, G, axis=1), bm, True,
-        None, 128, 128, S // 4))
+        None, 128, 128, S // 4), kg, vg)
     rows.append({"variant": f"grouped_splash_G{G}",
                  "ms": round(grouped_ms, 2)})
     rows.append({"variant": f"repeat_kv_splash_G{G}",
