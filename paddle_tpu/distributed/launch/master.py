@@ -85,6 +85,8 @@ class HTTPMaster:
             try:
                 urllib.request.urlopen(req, timeout=10)
                 return
+            except urllib.error.HTTPError:
+                raise  # the server answered: a real error, not the race
             except (ConnectionError, urllib.error.URLError):
                 if time.time() >= deadline:
                     raise

@@ -212,7 +212,7 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         return _logits(cfg, outer, x[:, 0]), k_caches, v_caches
 
-    def sample(logits, key, temperature, top_k):
+    def sample(logits, key, temperature, top_k, top_p):
         if temperature <= 0.0:
             return jnp.argmax(logits, -1)
         logits = logits / temperature
@@ -220,10 +220,23 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
         if top_k > 0:
             kth = jnp.sort(logits, -1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            # nucleus: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p; top_p <= 0 clamps to
+            # the minimal nucleus (top-1, i.e. greedy) so the parameter
+            # stays monotonic instead of 0.0 meaning "unrestricted"
+            p = max(float(top_p), 1e-9)
+            srt = jnp.sort(logits, -1)[:, ::-1]
+            probs = jax.nn.softmax(srt, -1)
+            cum = jnp.cumsum(probs, -1)
+            keep = (cum - probs) < p  # mass BEFORE this token
+            cutoff = jnp.where(keep, srt, jnp.inf).min(-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
         return jax.random.categorical(key, logits, -1)
 
     def generate(tokens, max_new_tokens: int, key=None,
-                 temperature: float = 0.0, top_k: int = 0):
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
         tokens = jnp.asarray(tokens)
         B, S0 = tokens.shape
         if not rolling and S0 + max_new_tokens > max_len:
@@ -246,7 +259,7 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
         pos = S0
         for i in range(max_new_tokens):
             key, sub = jax.random.split(key)
-            nxt = sample(logits, sub, temperature, top_k)
+            nxt = sample(logits, sub, temperature, top_k, top_p)
             out.append(nxt[:, None])
             if i + 1 < max_new_tokens:
                 logits, kc, vc = decode_step(outer, layers, nxt,

@@ -108,3 +108,49 @@ class TestRollingWindowCache:
         prompt = np.ones((1, 4), np.int32)
         out = np.asarray(gen(prompt, max_new_tokens=40))  # 44 > max_len
         assert out.shape == (1, 44)
+
+
+def test_top_p_nucleus_sampling():
+    """top_p truncation: a tiny nucleus reduces to argmax; a moderate one
+    only ever samples tokens inside the nucleus."""
+    import jax
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    cfg = LlamaConfig.tiny(vocab=61, hidden=32, layers=1, heads=2,
+                           kv_heads=2)
+    paddle.seed(6)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    gen = llama_decode_factory(model, max_len=32)
+    prompt = np.ones((2, 4), np.int32)
+    greedy = np.asarray(gen(prompt, max_new_tokens=8))
+    tiny_p = np.asarray(gen(prompt, max_new_tokens=8,
+                            key=jax.random.PRNGKey(1), temperature=1.0,
+                            top_p=1e-6))
+    np.testing.assert_array_equal(tiny_p, greedy)
+    # moderate nucleus still generates valid tokens and differs from
+    # greedy for at least one position across keys
+    outs = [np.asarray(gen(prompt, max_new_tokens=8,
+                           key=jax.random.PRNGKey(k), temperature=1.0,
+                           top_p=0.9)) for k in range(3)]
+    assert any(not np.array_equal(o, greedy) for o in outs)
+    for o in outs:
+        assert o.min() >= 0 and o.max() < cfg.vocab_size
+
+
+def test_top_p_zero_clamps_to_greedy():
+    import jax
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    cfg = LlamaConfig.tiny(vocab=61, hidden=32, layers=1, heads=2,
+                           kv_heads=2)
+    paddle.seed(6)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    gen = llama_decode_factory(model, max_len=32)
+    prompt = np.ones((2, 4), np.int32)
+    greedy = np.asarray(gen(prompt, max_new_tokens=6))
+    zero_p = np.asarray(gen(prompt, max_new_tokens=6,
+                            key=jax.random.PRNGKey(2), temperature=1.0,
+                            top_p=0.0))
+    np.testing.assert_array_equal(zero_p, greedy)
