@@ -236,7 +236,11 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
 
     def generate(tokens, max_new_tokens: int, key=None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0):
+                 top_p: float = 1.0, eos_token_id: int | None = None,
+                 pad_token_id: int = 0):
+        """``eos_token_id`` enables batched early stop: rows that have
+        emitted EOS produce ``pad_token_id`` from then on, and the decode
+        loop exits once every row has finished."""
         tokens = jnp.asarray(tokens)
         B, S0 = tokens.shape
         if not rolling and S0 + max_new_tokens > max_len:
@@ -257,10 +261,22 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
             logits, kc, vc = prefill(outer, layers, tokens, kc, vc)
         out = [tokens]
         pos = S0
+        finished = jnp.zeros((B,), bool)
         for i in range(max_new_tokens):
             key, sub = jax.random.split(key)
             nxt = sample(logits, sub, temperature, top_k, top_p)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, pad_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
             out.append(nxt[:, None])
+            # all-finished poll every 8 steps: the bool() readback is a
+            # host sync that would otherwise serialize the async decode
+            # dispatch pipeline on EVERY token (costly over the tunnel);
+            # at most 7 wasted padded steps in exchange
+            if eos_token_id is not None \
+                    and (i % 8 == 7 or i + 1 == max_new_tokens) \
+                    and bool(finished.all()):
+                break  # every row has emitted EOS
             if i + 1 < max_new_tokens:
                 logits, kc, vc = decode_step(outer, layers, nxt,
                                              jnp.asarray(pos), kc, vc)

@@ -154,3 +154,30 @@ def test_top_p_zero_clamps_to_greedy():
                             key=jax.random.PRNGKey(2), temperature=1.0,
                             top_p=0.0))
     np.testing.assert_array_equal(zero_p, greedy)
+
+
+def test_eos_early_stop_batched():
+    """Rows that emit EOS pad from then on; the loop exits early when all
+    rows are done (fewer decode steps than max_new_tokens)."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    cfg = LlamaConfig.tiny(vocab=61, hidden=32, layers=1, heads=2,
+                           kv_heads=2)
+    paddle.seed(6)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    gen = llama_decode_factory(model, max_len=64)
+    prompt = np.ones((2, 4), np.int32)
+    # find the model's first greedy token and use it as "EOS" so the
+    # very first decode step finishes every row
+    first = np.asarray(gen(prompt, max_new_tokens=1))[:, -1]
+    # identical prompt rows + greedy decode: first tokens must match
+    assert first[0] == first[1]
+    out = np.asarray(gen(prompt, max_new_tokens=40,
+                         eos_token_id=int(first[0])))
+    assert out.shape[1] < 4 + 40  # stopped early (8-step poll bound)
+    assert int(out[0, 4]) == int(first[0])  # EOS itself is emitted
+    assert (out[:, 5:] == 0).all()  # pads after EOS
+    # pad semantics: with an eos that never fires, shape is full length
+    out2 = np.asarray(gen(prompt, max_new_tokens=5, eos_token_id=60))
+    assert out2.shape == (2, 9)
