@@ -52,7 +52,11 @@ def _two_free_ports():
 
 
 class TestDistCarrier:
-    def test_two_process_pipeline(self):
+    def _attempt_two_process(self):
+        """One attempt; returns results dict or None on an environmental
+        failure (dead child / timeout — e.g. the free-port race when the
+        ports are reused between probe-close and child bind, or child
+        startup starved on a loaded machine)."""
         ctx = mp.get_context("spawn")
         p0, p1 = _two_free_ports()
         addrs = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
@@ -68,18 +72,35 @@ class TestDistCarrier:
         import time as _time
         results = {}
         deadline = _time.time() + 600  # spawn re-imports the whole stack
-        while len(results) < 2 and _time.time() < deadline:
-            try:
-                rank, out = q.get(timeout=5)
-                results[rank] = out
-            except _q.Empty:
-                # fail fast on a dead child instead of burning the deadline
-                for p_ in procs:
-                    assert p_.is_alive() or p_.exitcode == 0, \
-                        f"child died rc={p_.exitcode}"
-        assert len(results) == 2, "children did not report in time"
-        for p in procs:
-            p.join(timeout=30)
+        try:
+            while len(results) < 2 and _time.time() < deadline:
+                try:
+                    rank, out = q.get(timeout=5)
+                    results[rank] = out
+                except _q.Empty:
+                    # fail fast on a dead child
+                    if any(not p_.is_alive() and p_.exitcode != 0
+                           for p_ in procs):
+                        return None
+            if len(results) < 2:
+                return None
+            return results
+        finally:
+            for p in procs:
+                p.join(timeout=30)
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=5)  # reap — kill alone leaves a zombie
+            self._last_rcs = [p.exitcode for p in procs]
+
+    def test_two_process_pipeline(self):
+        results = self._attempt_two_process()
+        if results is None:  # environmental (ports/startup): one retry
+            rcs_first = self._last_rcs
+            results = self._attempt_two_process()
+        assert results is not None, (
+            f"children did not report in 2 attempts; exit codes: "
+            f"first={rcs_first}, second={self._last_rcs}")
         assert results[0] == []            # feeder rank has no sink
         assert results[1] == [4, 6, 8]     # (x+1)*2 per microbatch
 
