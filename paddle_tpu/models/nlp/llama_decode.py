@@ -36,6 +36,37 @@ def _proj_qkv(cfg: LlamaConfig, p, h, pos):
             jnp.swapaxes(v, 1, 2))
 
 
+def _q8(x):
+    """Per-(batch, head, slot) absmax int8 quantization over head_dim —
+    the KV-cache codec (serving memory halves vs bf16; the dequant
+    multiply fuses into the attention matmuls)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), -1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _cache_write(cache, kv, write_at):
+    """Write a (B, nkv, T, hd) block at slot ``write_at``; quantized
+    caches are (int8 data, f32 scales) tuples."""
+    if isinstance(cache, tuple):
+        data, sc = cache
+        qv, s = _q8(kv)
+        data = jax.lax.dynamic_update_slice(data, qv, (0, 0, write_at, 0))
+        sc = jax.lax.dynamic_update_slice(sc, s, (0, 0, write_at))
+        return (data, sc)
+    return jax.lax.dynamic_update_slice(cache, kv, (0, 0, write_at, 0))
+
+
+def _cache_read(cache, dtype):
+    if isinstance(cache, tuple):
+        data, sc = cache
+        # dequant in f32: casting the scales to bf16 first would stack a
+        # second quantization on top of the int8 rounding
+        return (data.astype(jnp.float32) * sc[..., None]).astype(dtype)
+    return cache
+
+
 def _attend(cfg, q, k_all, v_all, key_mask):
     """q: (B, nh, T, hd); k/v_all: (B, nkv, S, hd); key_mask (T, S) or
     broadcastable bool."""
@@ -76,9 +107,20 @@ def _layer_step(cfg, lp, x, k_cache, v_cache, pos_vec, key_mask, write_at):
     Returns (x_out, new_k_cache, new_v_cache).
     """
     def attend(q, k, v):
-        kc = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, write_at, 0))
-        vc = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, write_at, 0))
-        return _attend(cfg, q, kc, vc, key_mask), (kc, vc)
+        kc = _cache_write(k_cache, k, write_at)
+        vc = _cache_write(v_cache, v, write_at)
+        k_all = _cache_read(kc, q.dtype)
+        v_all = _cache_read(vc, q.dtype)
+        if isinstance(kc, tuple):
+            # overlay the EXACT current block over the dequantized cache:
+            # this step's own keys aren't round-tripped (quantization
+            # error applies only to the stored past, matching the rolling
+            # prefill path)
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, k.astype(k_all.dtype), (0, 0, write_at, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, v.astype(v_all.dtype), (0, 0, write_at, 0))
+        return _attend(cfg, q, k_all, v_all, key_mask), (kc, vc)
 
     x, (kc, vc) = _layer_math(cfg, lp, x, pos_vec, attend)
     return x, kc, vc
@@ -91,7 +133,8 @@ def _logits(cfg, outer, x_last):
     return x_last @ head
 
 
-def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W):
+def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W,
+                                quantized=False):
     """Prefill layer for a ROLLING (sliding-window) cache: attention runs
     banded over this block's own K/V, then only the last W positions land
     in the cache, each at slot p % W (~ Mistral's rolling buffer — cache
@@ -109,13 +152,16 @@ def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W):
             nkv, hd = k.shape[1], k.shape[-1]
             kc = jnp.zeros((B, nkv, W, hd), k.dtype).at[:, :, :S0].set(k)
             vc = jnp.zeros((B, nkv, W, hd), v.dtype).at[:, :, :S0].set(v)
+        if quantized:
+            kc, vc = _q8(kc), _q8(vc)
         return ctx, (kc, vc)
 
     x, (kc, vc) = _layer_math(cfg, lp, x, pos_vec, attend)
     return x, kc, vc
 
 
-def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
+def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
+                         kv_cache_dtype: str | None = None):
     """Returns ``generate(tokens, max_new_tokens, key=None,
     temperature=0.0, top_k=0) -> (B, S0+max_new) token array`` running a
     fully jitted prefill + per-token decode with functional KV caches.
@@ -123,6 +169,11 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
     With ``config.sliding_window`` < max_len the cache is a ROLLING
     buffer of window slots (write at pos % window): memory stays
     O(window) and generation length is unbounded by the cache.
+
+    ``kv_cache_dtype="int8"`` stores the cache quantized (per-slot absmax
+    over head_dim): cache memory halves vs bf16 and the dequant fuses
+    into the attention matmuls — the serving-memory lever the
+    reference's fused_multi_transformer lacks.
     """
     cfg = model.config
     outer, layers = split_params(model)
@@ -132,8 +183,15 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
     window = getattr(cfg, "sliding_window", None)
     rolling = window is not None and window < max_len
     C = window if rolling else max_len  # cache slots
+    quantized = kv_cache_dtype == "int8"
+    if kv_cache_dtype not in (None, "int8"):
+        raise ValueError(f"kv_cache_dtype {kv_cache_dtype!r}: use None "
+                         "(model dtype) or 'int8'")
 
     def init_caches(B, dtype):
+        if quantized:
+            return (jnp.zeros((L, B, nkv, C, hd), jnp.int8),
+                    jnp.ones((L, B, nkv, C), jnp.float32))
         return jnp.zeros((L, B, nkv, C, hd), dtype)
 
     def _band(S0):
@@ -157,7 +215,7 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256):
 
             def body(x, lp):
                 x, kc, vc = _layer_step_rolling_prefill(
-                    cfg, lp, x, pos_vec, band_mask, C)
+                    cfg, lp, x, pos_vec, band_mask, C, quantized)
                 return x, (kc, vc)
 
             x, (k_caches, v_caches) = jax.lax.scan(body, x, layers)

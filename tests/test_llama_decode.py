@@ -181,3 +181,44 @@ def test_eos_early_stop_batched():
     # pad semantics: with an eos that never fires, shape is full length
     out2 = np.asarray(gen(prompt, max_new_tokens=5, eos_token_id=60))
     assert out2.shape == (2, 9)
+
+
+def test_int8_kv_cache_close_to_fp():
+    """kv_cache_dtype='int8': greedy generations match the fp cache on a
+    short horizon and the stored cache really is int8 (half the bytes)."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    cfg = LlamaConfig.tiny(vocab=97, hidden=64, layers=2, heads=4,
+                           kv_heads=2)
+    paddle.seed(12)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    gen_fp = llama_decode_factory(model, max_len=32)
+    gen_q = llama_decode_factory(model, max_len=32, kv_cache_dtype="int8")
+    prompt = np.asarray(
+        np.random.default_rng(1).integers(0, 97, (2, 6)), np.int32)
+    fp = np.asarray(gen_fp(prompt, max_new_tokens=8))
+    q8 = np.asarray(gen_q(prompt, max_new_tokens=8))
+    # compare GENERATED tokens only (the echoed prompt is equal by
+    # construction); int8 KV error is tiny at these scales
+    assert (fp[:, 6:] == q8[:, 6:]).mean() > 0.8, (fp, q8)
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        llama_decode_factory(model, max_len=32, kv_cache_dtype="fp4")
+
+
+def test_int8_kv_cache_with_rolling_window():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    cfg = LlamaConfig.tiny(vocab=61, hidden=32, layers=1, heads=2,
+                           kv_heads=2)
+    cfg.sliding_window = 8
+    paddle.seed(13)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    gen_fp = llama_decode_factory(model, max_len=16)
+    gen_q = llama_decode_factory(model, max_len=16, kv_cache_dtype="int8")
+    prompt = np.ones((1, 12), np.int32)  # rolled prefill (S0 > window)
+    fp = np.asarray(gen_fp(prompt, max_new_tokens=10))
+    q8 = np.asarray(gen_q(prompt, max_new_tokens=10))
+    assert fp.shape == q8.shape == (1, 22)
+    assert (fp[:, 12:] == q8[:, 12:]).mean() > 0.7, (fp, q8)
