@@ -16,6 +16,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .llama import LlamaConfig, LlamaForCausalLM, apply_rotary
 from .llama_functional import _rms, split_params
@@ -341,4 +342,158 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                 pos += 1
         return jnp.concatenate(out, axis=1)
 
+    return generate
+
+
+def llama_speculative_decode_factory(target: LlamaForCausalLM,
+                                     draft: LlamaForCausalLM,
+                                     max_len: int = 256,
+                                     n_draft: int = 4):
+    """Greedy speculative decoding: a small draft model proposes
+    ``n_draft`` tokens (ONE jitted program — the autoregressive draft
+    walk runs as an in-jit scan, so the whole draft phase costs a single
+    host readback); the target model VERIFIES them in ONE batched block
+    step (k+1 positions through the cache — matmul-heavy, instead of k+1
+    sequential target steps). Accepted-prefix + the target's correction
+    token advance the sequence; rejected cache slots are overwritten by
+    the next block (the key mask never reaches stale slots beyond the
+    write position), so rollback is free. On a fully-accepted round the
+    draft hasn't consumed its own last proposal — it is fed as part of
+    the next round's block, so the draft cache never holds a hole.
+
+    Greedy acceptance makes the output EXACTLY the target model's greedy
+    generation — speculation changes latency, never content. The serving
+    analog the reference's fused_multi_transformer stack lacks.
+
+    Both models must share a vocabulary. Batch size 1 per call (the
+    accepted-prefix length is data-dependent; batching rows with
+    different acceptance lengths needs per-row position bookkeeping —
+    future work)."""
+    if target.config.vocab_size != draft.config.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    if getattr(target.config, "sliding_window", None) or \
+            getattr(draft.config, "sliding_window", None):
+        raise ValueError("speculative decoding with sliding_window is "
+                         "not supported (rolling slots break the "
+                         "overwrite-rollback invariant)")
+
+    def build(model):
+        cfg = model.config
+        outer, layers = split_params(model)
+        L = cfg.num_hidden_layers
+        nkv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        dtype = outer["model.embed_tokens.weight"].dtype
+
+        def init(B):
+            return (jnp.zeros((L, B, nkv, max_len, hd), dtype),
+                    jnp.zeros((L, B, nkv, max_len, hd), dtype))
+
+        def block_body(outer, layers, tokens, k_caches, v_caches, pos0):
+            """tokens (B, T) at absolute positions pos0..pos0+T-1; writes
+            their K/V at the same slots; returns logits for EVERY
+            position (B, T, V)."""
+            T = tokens.shape[1]
+            x = jnp.take(outer["model.embed_tokens.weight"], tokens,
+                         axis=0)
+            pos_vec = pos0 + jnp.arange(T)
+            key_mask = jnp.arange(max_len)[None, :] <= pos_vec[:, None]
+
+            def body(x, per_layer):
+                lp, kc, vc = per_layer
+                x, kc, vc = _layer_step(cfg, lp, x, kc, vc, pos_vec,
+                                        key_mask, pos0)
+                return x, (kc, vc)
+
+            x, (k_caches, v_caches) = jax.lax.scan(
+                body, x, (layers, k_caches, v_caches))
+            x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+            return _logits(cfg, outer, x), k_caches, v_caches
+
+        block = partial(jax.jit, donate_argnums=(3, 4))(block_body)
+        return outer, layers, init, block_body, block
+
+    outerT, layersT, initT, _, blockT = build(target)
+    outerD, layersD, initD, blockD_body, _ = build(draft)
+
+    @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(5,))
+    def draft_round(outer, layers, feed, k_caches, v_caches, k, pos0):
+        """Consume the pending ``feed`` block (ends at position pos0 +
+        T0 - 1), then greedily draft ``k`` tokens with an in-jit scan —
+        the whole draft phase is one program, one readback."""
+        T0 = feed.shape[1]
+        lg, k_caches, v_caches = blockD_body(outer, layers, feed,
+                                             k_caches, v_caches, pos0)
+        cur = jnp.argmax(lg[:, -1], -1)  # (B,) — the first draft token
+
+        def step(carry, i):
+            cur, kc, vc = carry
+            lg, kc, vc = blockD_body(outer, layers, cur[:, None], kc, vc,
+                                     pos0 + T0 + i)
+            return (jnp.argmax(lg[:, -1], -1), kc, vc), cur
+
+        (last_d, k_caches, v_caches), ds = jax.lax.scan(
+            step, (cur, k_caches, v_caches), jnp.arange(k - 1))
+        # ds: (k-1, B) of d_0..d_{k-2}; last carry is d_{k-1}
+        drafts = jnp.concatenate(
+            [jnp.swapaxes(ds, 0, 1), last_d[:, None]], 1) \
+            if k > 1 else last_d[:, None]
+        return drafts, k_caches, v_caches
+
+    def generate(tokens, max_new_tokens: int):
+        tokens = jnp.asarray(tokens)
+        B, S0 = tokens.shape
+        if B != 1:
+            raise ValueError("speculative generate supports batch 1")
+        if S0 + max_new_tokens + n_draft + 1 > max_len:
+            raise ValueError(
+                f"prompt {S0} + max_new {max_new_tokens} + draft window "
+                f"{n_draft + 1} exceeds max_len {max_len}")
+        kT, vT = initT(B)
+        kD, vD = initD(B)
+        logitsT, kT, vT = blockT(outerT, layersT, tokens, kT, vT, 0)
+        seq = [int(t) for t in np.asarray(tokens)[0]]
+        last = int(np.asarray(jnp.argmax(logitsT[:, -1], -1))[0])
+        seq.append(last)
+        produced = 1
+        pos = S0          # `last` occupies sequence position pos
+        pending = seq[S0:]  # tokens the DRAFT has not consumed yet
+        # (the draft skipped prefill of nothing: feed it the prompt too)
+        _, kD, vD = draft_round(
+            outerD, layersD, tokens, kD, vD, 1,
+            jnp.asarray(0))  # consumes prompt; 1 throwaway draft token
+        rounds = 0
+        while produced < max_new_tokens:
+            k = min(n_draft, max_new_tokens - produced)
+            feed = jnp.asarray([pending], jnp.int32)
+            T0 = len(pending)
+            drafts_arr, kD, vD = draft_round(
+                outerD, layersD, feed, kD, vD, k,
+                jnp.asarray(pos - T0 + 1))
+            drafts = [int(x) for x in np.asarray(drafts_arr)[0]]
+            # ONE target block verifies [last, d0..d_{k-1}]
+            blk = jnp.asarray([[last] + drafts], jnp.int32)
+            lgT, kT, vT = blockT(outerT, layersT, blk, kT, vT,
+                                 jnp.asarray(pos))
+            t = [int(x) for x in np.asarray(jnp.argmax(lgT[0], -1))]
+            n = 0
+            while n < k and drafts[n] == t[n]:
+                n += 1
+            seq.extend(drafts[:n] + [t[n]])  # accepted + correction/bonus
+            produced += n + 1
+            pos += n + 1
+            last = t[n]
+            # the draft consumed [pending, d0..d_{k-2}]; feed it whatever
+            # of the accepted sequence it hasn't seen, plus the new last
+            pending = ([drafts[k - 1]] if n == k else []) + [last]
+            rounds += 1
+        out = np.asarray(seq[:S0 + max_new_tokens], np.int32)[None, :]
+        generate.last_stats = {
+            "rounds": rounds,
+            "tokens": min(produced, max_new_tokens),
+            "target_steps": 1 + rounds,
+        }
+        return out
+
+    generate.last_stats = {}
     return generate

@@ -222,3 +222,56 @@ def test_int8_kv_cache_with_rolling_window():
     q8 = np.asarray(gen_q(prompt, max_new_tokens=10))
     assert fp.shape == q8.shape == (1, 22)
     assert (fp[:, 12:] == q8[:, 12:]).mean() > 0.7, (fp, q8)
+
+
+def test_speculative_decode_exactly_matches_target_greedy():
+    """Greedy speculative decoding must produce EXACTLY the target
+    model's greedy generation (speculation changes latency, not content)
+    while running far fewer target steps than tokens generated."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_decode_factory, llama_speculative_decode_factory)
+    paddle.seed(31)
+    target = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab=97, hidden=64, layers=3, heads=4, kv_heads=2))
+    target.eval()
+    paddle.seed(32)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab=97, hidden=32, layers=1, heads=2, kv_heads=2))
+    draft.eval()
+    prompt = np.asarray(
+        np.random.default_rng(2).integers(0, 97, (1, 6)), np.int32)
+    oracle = np.asarray(llama_decode_factory(target, max_len=64)(
+        prompt, max_new_tokens=24))
+    spec = llama_speculative_decode_factory(target, draft, max_len=64,
+                                            n_draft=4)
+    got = spec(prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(got, oracle)
+    assert spec.last_stats["tokens"] == 24
+
+    # with the TARGET as its own draft every proposal is accepted: this
+    # exercises the full-acceptance path (the unconsumed last draft is
+    # re-fed, so the draft cache never holds a hole) and the speedup
+    # accounting must show ~5 tokens per target step
+    spec2 = llama_speculative_decode_factory(target, target, max_len=64,
+                                             n_draft=4)
+    got2 = spec2(prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(got2, oracle)
+    stats = spec2.last_stats
+    assert stats["target_steps"] < 24 // 3, stats  # ~24/5 rounds + 1
+
+
+def test_speculative_decode_rejects_bad_configs():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_speculative_decode_factory)
+    t = LlamaForCausalLM(LlamaConfig.tiny(vocab=97))
+    d = LlamaForCausalLM(LlamaConfig.tiny(vocab=61))
+    with pytest.raises(ValueError, match="vocabulary"):
+        llama_speculative_decode_factory(t, d)
+    cfg = LlamaConfig.tiny(vocab=97)
+    cfg.sliding_window = 8
+    w = LlamaForCausalLM(cfg)
+    t2 = LlamaForCausalLM(LlamaConfig.tiny(vocab=97))
+    with pytest.raises(ValueError, match="sliding_window"):
+        llama_speculative_decode_factory(t2, w)
