@@ -371,6 +371,10 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
     future work)."""
     if target.config.vocab_size != draft.config.vocab_size:
         raise ValueError("target and draft must share a vocabulary")
+    if n_draft < 1:
+        raise ValueError("n_draft must be >= 1 (0 would still emit one "
+                         "unverified draft per round and desync the draft "
+                         "cache)")
     if getattr(target.config, "sliding_window", None) or \
             getattr(draft.config, "sliding_window", None):
         raise ValueError("speculative decoding with sliding_window is "
