@@ -10,6 +10,7 @@ so the MFU ceiling is the honest yardstick.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -144,10 +145,18 @@ def main():
     # min-over-passes is the standard benchmarking answer: compile is
     # already paid, so extra passes are cheap, and the min is the
     # machine's real capability rather than the tunnel's worst mood.
-    passes = 3 if on_tpu else 1
+    max_passes = 3 if on_tpu else 1
+    t_start = time.perf_counter()
     dt, loss = measure_once()
-    for _ in range(passes - 1):
+    passes = 1
+    while passes < max_passes:
+        # stay well inside the 1500s SIGALRM watchdog: if the tunnel is
+        # degraded (observed 8.3s/step), one pass already took minutes —
+        # reporting the slow-but-real number beats tripping the alarm
+        if time.perf_counter() - t_start > 400:
+            break
         d2, l2 = measure_once()
+        passes += 1
         if d2 < dt:
             dt, loss = d2, l2
 
@@ -171,7 +180,6 @@ def main():
     if on_tpu and mfu > 0.1:
         # refresh the repo-resident chip record so CPU-fallback runs can
         # always cite the latest real measurement (keyed by commit)
-        import os
         import subprocess
         try:
             commit = subprocess.run(
@@ -192,8 +200,8 @@ def main():
                     "config": f"{n_params/1e9:.2f}B Llama, bf16, B={B}, "
                               f"S={S}, flash attention, fused CE, no remat",
                     "measured_at_commit": commit or "unknown",
-                    "methodology": "bench.py (min over 3 two-point passes, "
-                                   "host-readback sync)",
+                    "methodology": f"bench.py (min over {passes} two-point "
+                                   "passes, host-readback sync)",
                 }, f, indent=2)
                 f.write("\n")
             os.replace(tmp, rec)  # atomic: watchdog can't half-write it
@@ -205,7 +213,6 @@ def main():
         # most recent real-chip measurement lives in PERF_LAST_TPU.json
         # (updated by chip runs, keyed by the commit it measured) so this
         # block can never go stale independently of the record.
-        import os
         rec = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "PERF_LAST_TPU.json")
         if os.path.exists(rec):

@@ -30,6 +30,11 @@ from .flash_attention import (LN2, LOG2E, NEG_INF, _interpret, _pick_block,
 # f32-element budget for ONE (G*block_q, block_k) score/probability buffer
 # (2 MB each; the kernel holds score + p + acc + resident K/V in VMEM).
 _SCORE_ELEMS = 512 * 1024
+# Row cap for the G*block_q dimension: q/q2/acc/out buffers are rows-tall
+# regardless of block_k, so the score budget alone can't bound them.
+# Measured on v5e: rows=4096 (MQA G=32, bq=128, bk=128) exceeds the 16M
+# scoped-vmem limit by 912K even with the score budget satisfied.
+_MAX_ROWS = 2048
 
 
 def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
@@ -51,6 +56,9 @@ def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
             block_q = min(_pick_block(Sq), cap)
     bq, bk = _resolve_blocks(Sq, Sk, block_q, block_k)
     # halving preserves divisibility (bk | Sk implies bk/2 | Sk)
+    while G * bq > _MAX_ROWS and not user_q and bq > 8 \
+            and (bq // 2) % 8 == 0:
+        bq //= 2
     while G * bq * bk > _SCORE_ELEMS and not user_k and bk > 128:
         bk //= 2
     while G * bq * bk > _SCORE_ELEMS and not user_q and bq > 8 \

@@ -42,12 +42,19 @@ from .flash_attention import LN2, LOG2E, NEG_INF, _interpret
 SCORE_ELEMS = 512 * 1024
 
 
+# see flash_attention_gqa._MAX_ROWS — same v5e scoped-vmem measurement
+MAX_ROWS = 2048
+
+
 def fits_score_budget(groups: int, block_q: int = 128,
                       block_k: int = 128) -> bool:
     """The kernel's VMEM eligibility predicate — ONE definition shared
     with model-level gates (llama's grouped sliding-window path) so the
-    bound can't drift between the kernel and its callers."""
-    return groups * block_q * block_k <= SCORE_ELEMS
+    bound can't drift between the kernel and its callers. Checks both
+    the (G*bq, bk) score-buffer budget and the G*bq row cap (rows-tall
+    q/acc/out buffers bound VMEM independently of block_k)."""
+    return (groups * block_q * block_k <= SCORE_ELEMS
+            and groups * block_q <= MAX_ROWS)
 
 
 def _pattern_tables(block_mask: np.ndarray):
@@ -260,6 +267,17 @@ def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
             f"{k.shape[1]}")
     G = q.shape[1] // max(1, k.shape[1])
     if not fits_score_budget(G, bq, bk):
+        # rows-tall (G*bq) q/acc/out buffers bound VMEM independently of
+        # bk: measured on v5e, rows=4096 exceeds the 16M scoped-vmem
+        # limit by ~1M even with the score budget satisfied (see
+        # flash_attention_gqa._MAX_ROWS). Splash blocks are pinned by
+        # the mask tiling, so the fix is a clear error, not auto-shrink.
+        if G * bq > MAX_ROWS:
+            raise ValueError(
+                f"splash_attention: G*block_q = {G * bq} rows exceeds "
+                f"the VMEM row budget ({MAX_ROWS}); use a finer "
+                f"block_mask granularity (smaller block_q) or fewer "
+                f"query groups")
         raise ValueError(
             f"splash_attention: G*block_q*block_k = {G * bq * bk} f32 "
             f"elements exceeds the VMEM score budget ({SCORE_ELEMS}); "

@@ -259,13 +259,22 @@ class TestGroupedSplash:
     def test_vmem_budget_raises_and_model_falls_back(self, monkeypatch):
         import paddle_tpu.ops.pallas.splash_attention as sp
         rng = np.random.default_rng(9)
-        # MQA G=64: G*128*128 = 1M f32 > budget -> explicit error
+        # MQA G=64: G*128 = 8192 rows > row cap -> explicit error (rows
+        # checked first; a v5e-measured scoped-vmem limit, not a guess)
         q = jnp.asarray(rng.standard_normal((1, 64, 256, 8)), jnp.float32)
         k = jnp.asarray(rng.standard_normal((1, 1, 256, 8)), jnp.float32)
         bm = np.tril(np.ones((2, 2), bool))
-        with pytest.raises(ValueError, match="VMEM score budget"):
+        with pytest.raises(ValueError, match="VMEM row budget"):
             sp.grouped_splash_attention(q, k, k, bm, True)
         assert not sp.fits_score_budget(64)  # the llama gate predicate
+        # score budget binds when rows fit: G=16, bq=128 (rows 2048 ok)
+        # but bk=512 -> 16*128*512 = 1M f32 > SCORE_ELEMS
+        q2 = jnp.asarray(rng.standard_normal((1, 16, 256, 8)), jnp.float32)
+        k2 = jnp.asarray(rng.standard_normal((1, 1, 1024, 8)), jnp.float32)
+        bm2 = np.ones((2, 2), bool)
+        with pytest.raises(ValueError, match="VMEM score budget"):
+            sp.grouped_splash_attention(q2, k2, k2, bm2, False)
+        assert not sp.fits_score_budget(16, 128, 512)
 
         # model-level fallback: with the budget shrunk so even G=2 is
         # over, the GQA windowed model must take the repeat path and
