@@ -203,7 +203,9 @@ def llama_pp_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                 for k, v in tree.items()}
 
     opt_state = {
-        "step": jnp.zeros((), jnp.int32),
+        # committed to the mesh: an uncommitted scalar aval mismatches
+        # the jit output's and recompiles the step (see make_adamw_state)
+        "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
         "m": {"outer": zeros_like_tree(outer, outer_sh),
               "layers": zeros_like_tree(layers, pipe_sh)},
         "v": {"outer": zeros_like_tree(outer, outer_sh),
@@ -376,7 +378,7 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
 
     rep = NamedSharding(mesh, P())
     opt_state = {
-        "step": jnp.zeros((), jnp.int32),
+        "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
         "m": {"outer": zeros_tree(outer, outer_msh),
               "layers": zeros_tree(layers, layer_msh)},
         "v": {"outer": zeros_tree(outer, outer_msh),

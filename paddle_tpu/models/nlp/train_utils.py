@@ -57,7 +57,12 @@ def make_adamw_state(mesh, shardings, params, accum_dtype=jnp.float32,
     """step/m/v opt-state pytree with ZeRO-aware shardings; ``offload``
     pins the moments in host memory (see zero_like_sharded)."""
     return {
-        "step": jnp.zeros((), jnp.int32),
+        # commit the step counter to the mesh: an uncommitted scalar's
+        # aval (empty mesh) differs from the jit output's (mesh-attached)
+        # and the mismatch silently RECOMPILES the whole train step on
+        # its second call (~50s for BERT-base — found on chip)
+        "step": jax.device_put(jnp.zeros((), jnp.int32),
+                               NamedSharding(mesh, P())),
         "m": {k: zero_like_sharded(mesh, shardings, k, v, accum_dtype,
                                    offload)
               for k, v in params.items()},

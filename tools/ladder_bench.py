@@ -117,6 +117,8 @@ def bench_bert(on_tpu):
         B, S, steps = 4, 32, 3
     model = BertForPretraining(cfg)
     model.eval()
+    if on_tpu:
+        model.to(dtype="bfloat16")  # AMP-style pretrain: bf16 MXU rate
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
     params, opt, step = bert_pretrain_step_factory(model, mesh)
     rng = np.random.default_rng(0)
