@@ -33,18 +33,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_stats = (not training) if use_global_stats is None else use_global_stats
 
     if training and not use_stats:
-        # compute batch stats and update running stats host-side state
-        mean_v = apply_op("bn_mean", lambda v: jnp.mean(v, axis=axes), x)
-        var_v = apply_op("bn_var", lambda v: jnp.var(v, axis=axes), x)
+        # compute batch stats and update running stats host-side state.
+        # Stats are f32 regardless of compute dtype: bf16 mean/var loses
+        # ~3 decimal digits and the running buffers are f32 anyway.
+        mean_v = apply_op(
+            "bn_mean",
+            lambda v: jnp.mean(v.astype(jnp.float32), axis=axes), x)
+        var_v = apply_op(
+            "bn_var",
+            lambda v: jnp.var(v.astype(jnp.float32), axis=axes), x)
         with_stats_x = x
         if running_mean is not None and not getattr(mean_v, "_symbolic",
                                                     False):
             # static-graph capture: batch stats are symbolic, so the running
-            # stats stay frozen inside the compiled program
-            running_mean._value = (momentum * running_mean._value
-                                   + (1 - momentum) * mean_v._value)
-            running_var._value = (momentum * running_var._value
-                                  + (1 - momentum) * var_v._value)
+            # stats stay frozen inside the compiled program. The blend casts
+            # back to the buffer's dtype — f32 batch stats must not silently
+            # promote a bf16-cast model's buffers.
+            running_mean._value = (
+                momentum * running_mean._value
+                + (1 - momentum) * mean_v._value
+            ).astype(running_mean._value.dtype)
+            running_var._value = (
+                momentum * running_var._value
+                + (1 - momentum) * var_v._value
+            ).astype(running_var._value.dtype)
         mean_use, var_use = mean_v, var_v
     else:
         mean_use, var_use = running_mean, running_var
@@ -62,13 +74,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         wv = rest[i] if has_w else None
         i += has_w
         bv = rest[i] if has_b else None
-        inv = jnp.reciprocal(jnp.sqrt(vv.reshape(shape) + epsilon))
-        out = (xv - mv.reshape(shape)) * inv
+        # normalize in f32 and cast back: with bf16 activations + f32
+        # running stats, plain promotion would silently upcast the whole
+        # downstream network to f32 (half MXU rate); with bf16 stats the
+        # rsqrt loses precision. f32 inside, storage dtype outside.
+        xf = xv.astype(jnp.float32)
+        inv = jnp.reciprocal(jnp.sqrt(
+            vv.astype(jnp.float32).reshape(shape) + epsilon))
+        out = (xf - mv.astype(jnp.float32).reshape(shape)) * inv
         if wv is not None:
-            out = out * wv.reshape(shape)
+            out = out * wv.astype(jnp.float32).reshape(shape)
         if bv is not None:
-            out = out + bv.reshape(shape)
-        return out
+            out = out + bv.astype(jnp.float32).reshape(shape)
+        return out.astype(xv.dtype)
     return apply_op("batch_norm", fn, *args)
 
 

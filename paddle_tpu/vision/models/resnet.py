@@ -121,6 +121,110 @@ class ResNet(nn.Layer):
         return x
 
 
+def resnet_train_step_factory(model, mesh, learning_rate=0.1, momentum=0.9,
+                              weight_decay=1e-4):
+    """Compiled SGD-momentum train step for the ResNet family —
+    BASELINE.md config 2 (PaddleClas ResNet-50 recipe:
+    ~ python/paddle/vision/models/resnet.py + Momentum optimizer,
+    python/paddle/optimizer/momentum.py): CE loss, L2-coupled decay.
+
+    Returns ``(params, buffers, opt_state, step)`` where
+    ``step(params, buffers, opt_state, images, labels) ->
+    (params, buffers, opt_state, loss)``. BatchNorm running stats are
+    threaded FUNCTIONALLY: the forward runs in training mode, the
+    traced stat updates are read back off the model and returned as the
+    new ``buffers`` — same pattern the reference implements with
+    mutable mean/variance op outputs (phi batch_norm kernel). Under a
+    >1 'data' mesh axis the batch is sharded and XLA computes global
+    batch stats (SyncBatchNorm semantics for free).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ...autograd import no_grad
+    from ...core.tensor import Tensor
+
+    param_names = {name for name, _ in model.named_parameters()}
+    state = model.state_dict()
+    rep = NamedSharding(mesh, P())
+    data_axis = "data" if "data" in mesh.axis_names else None
+    data_sh = NamedSharding(mesh, P(data_axis))
+    params = {k: jax.device_put(jnp.array(v._value, copy=True), rep)
+              for k, v in state.items() if k in param_names}
+    # stat buffers ride in f32 even for a bf16-cast model (Layer.to casts
+    # float buffers — torch/paddle semantics — but momentum-blended
+    # running stats degrade fast in bf16; batch_norm computes f32
+    # internally either way)
+    buffers = {
+        k: jax.device_put(
+            jnp.array(v._value, copy=True).astype(jnp.float32)
+            if jnp.issubdtype(v._value.dtype, jnp.floating)
+            else jnp.array(v._value, copy=True), rep)
+        for k, v in state.items() if k not in param_names}
+    # low-precision params get f32 masters (velocity alone is not enough:
+    # re-quantizing the weight each step loses any update below ~2^-9 of
+    # its magnitude, freezing weights once grads shrink)
+    low_prec = {k for k, v in params.items() if v.dtype != jnp.float32}
+    opt_state = {
+        "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
+        "velocity": {k: jax.device_put(jnp.zeros(v.shape, jnp.float32), rep)
+                     for k, v in params.items()},
+        "master": {k: jax.device_put(params[k].astype(jnp.float32), rep)
+                   for k in sorted(low_prec)},
+    }
+
+    def forward_loss(params, buffers, images, labels):
+        saved = model.tree_flatten_params()
+        was_training = model.training
+        model.train()
+        try:
+            with no_grad():  # jax.grad differentiates; the tape must not
+                model.load_tree({**params, **buffers})
+                logits = model(Tensor(images))._value
+                # training-mode BN rebound the stat buffers to traced
+                # values — read the updates back off the model
+                sd = model.state_dict()
+                new_buffers = {k: sd[k]._value for k in buffers}
+        finally:
+            model.load_tree(saved)
+            if not was_training:
+                model.eval()
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        loss = -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, None], -1)[:, 0])
+        return loss, new_buffers
+
+    def train_step(params, buffers, opt_state, images, labels):
+        (loss, new_buffers), grads = jax.value_and_grad(
+            forward_loss, has_aux=True)(params, buffers, images, labels)
+        new_p, new_vel, new_master = {}, {}, {}
+        for k in params:
+            p32 = opt_state["master"][k] if k in low_prec \
+                else params[k].astype(jnp.float32)
+            g = grads[k].astype(jnp.float32) + weight_decay * p32
+            v = momentum * opt_state["velocity"][k] + g
+            new_vel[k] = v
+            p32 = p32 - learning_rate * v
+            if k in low_prec:
+                new_master[k] = p32
+            new_p[k] = p32.astype(params[k].dtype)
+        return (new_p, new_buffers,
+                {"step": opt_state["step"] + 1, "velocity": new_vel,
+                 "master": new_master}, loss)
+
+    param_sh = {k: rep for k in params}
+    buf_sh = {k: rep for k in buffers}
+    state_sh = {"step": rep, "velocity": {k: rep for k in params},
+                "master": {k: rep for k in sorted(low_prec)}}
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(param_sh, buf_sh, state_sh, data_sh, data_sh),
+        out_shardings=(param_sh, buf_sh, state_sh, rep),
+        donate_argnums=(0, 1, 2))
+    return params, buffers, opt_state, jitted
+
+
 def _resnet(block, depth, **kwargs):
     return ResNet(block, depth, **kwargs)
 
