@@ -601,27 +601,64 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
         "device") for k in params} if offload_moments else None
     in_jit_offload = offload_moments and jax.default_backend() != "cpu"
 
+    host_m_sh = {k: opt_state["m"][k].sharding
+                 for k in params} if offload_moments else None
+
     def train_step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         step = opt_state["step"] + 1
         t = step.astype(jnp.float32)
         new_p, new_m, new_v = {}, {}, {}
-        for k in params:
-            m, v = opt_state["m"][k], opt_state["v"][k]
-            if in_jit_offload:
-                m = jax.device_put(m, moment_dev_sh[k])
-                v = jax.device_put(v, moment_dev_sh[k])
-            new_p[k], new_m[k], new_v[k] = adamw_update(
-                params[k], grads[k], m, v,
-                t, learning_rate, beta1, beta2, eps, weight_decay,
-                accum_dtype)
+        if not in_jit_offload:
+            for k in params:
+                new_p[k], new_m[k], new_v[k] = adamw_update(
+                    params[k], grads[k], opt_state["m"][k],
+                    opt_state["v"][k], t, learning_rate, beta1, beta2,
+                    eps, weight_decay, accum_dtype)
+            return new_p, {"step": step, "m": new_m, "v": new_v}, loss
+        # In-jit offload: the naive form (fetch every moment with
+        # device_put, update, store) lets XLA hoist ALL fetches to the
+        # start of the schedule — the fetch DMAs depend only on jit
+        # inputs — so the full f32 moment set lands in HBM at once
+        # (measured: 1.9B params / 15.2G moments OOM a 15.75G v5e even
+        # with full remat). Chunk the update and thread an
+        # optimization_barrier token host-store -> next-chunk-fetch so
+        # at most one chunk of moments is device-resident at a time;
+        # within a chunk XLA still overlaps DMA with the elementwise
+        # update.
+        keys = list(params)
+        token = t
+        chunk_n = 4
+        for i in range(0, len(keys), chunk_n):
+            chunk = keys[i:i + chunk_n]
+            fetched = {}
+            for k in chunk:
+                m_h, v_h, _ = jax.lax.optimization_barrier(
+                    (opt_state["m"][k], opt_state["v"][k], token))
+                fetched[k] = (jax.device_put(m_h, moment_dev_sh[k]),
+                              jax.device_put(v_h, moment_dev_sh[k]))
+            for k in chunk:
+                m, v = fetched[k]
+                new_p[k], m_d, v_d = adamw_update(
+                    params[k], grads[k], m, v,
+                    t, learning_rate, beta1, beta2, eps, weight_decay,
+                    accum_dtype)
+                new_m[k] = jax.device_put(m_d, host_m_sh[k])
+                new_v[k] = jax.device_put(v_d, host_m_sh[k])
+            *arrs, token = jax.lax.optimization_barrier(
+                tuple(new_m[k] for k in chunk)
+                + tuple(new_v[k] for k in chunk) + (token,))
+            for j, k in enumerate(chunk):
+                new_m[k] = arrs[j]
+                new_v[k] = arrs[len(chunk) + j]
         return new_p, {"step": step, "m": new_m, "v": new_v}, loss
 
     if offload_moments and not in_jit_offload:
         # CPU staging path: the jit sees device-resident moments
         jit_m_sh = moment_dev_sh
     else:
-        jit_m_sh = {k: opt_state["m"][k].sharding for k in params}
+        jit_m_sh = host_m_sh or {
+            k: opt_state["m"][k].sharding for k in params}
     jitted = jax.jit(
         train_step,
         in_shardings=(shardings,
@@ -635,7 +672,7 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
         donate_argnums=(0, 1),
     )
     if offload_moments and not in_jit_offload:
-        host_sh = {k: opt_state["m"][k].sharding for k in params}
+        host_sh = host_m_sh
 
         def staged_step(params, opt_state, tokens, labels):
             staged = dict(
