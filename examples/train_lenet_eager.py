@@ -1,0 +1,50 @@
+"""Eager training: LeNet on a synthetic digit task.
+
+The everyday loop — forward, loss.backward(), optimizer.step() — with
+accuracy tracked by paddle_tpu.metric. Synthetic data keeps the example
+offline-runnable; swap in paddle_tpu.vision.datasets.MNIST when you have
+the files.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import LeNet
+
+
+def synthetic_digits(n=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(0, 1, (10, 1, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, n)
+    x = (templates[y] + 0.3 * rng.normal(0, 1, (n, 1, 28, 28))
+         ).astype(np.float32)
+    return x, y.astype(np.int64)
+
+
+def main():
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    acc = paddle.metric.Accuracy()
+    x, y = synthetic_digits()
+
+    for epoch in range(3):
+        model.train()
+        for i in range(0, len(x), 64):
+            xb = paddle.to_tensor(x[i:i + 64])
+            yb = paddle.to_tensor(y[i:i + 64])
+            logits = model(xb)
+            loss = paddle.nn.functional.cross_entropy(logits, yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        model.eval()
+        acc.reset()
+        acc.update(acc.compute(model(paddle.to_tensor(x)),
+                               paddle.to_tensor(y[:, None])))
+        print(f"epoch {epoch}: loss {float(loss):.4f} "
+              f"acc {float(acc.accumulate()):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,6 +159,49 @@ def ignore_module(modules):
     return None
 
 
+def symbolic_export(frozen_fn, shapes_dtypes, warn_prefix="jit.save"):
+    """Export ``frozen_fn`` with jax.export, mapping None/-1 dims to
+    symbolic dimensions shared per dim-position (one artifact serves any
+    batch/seq size); falls back to concrete example shapes (dynamic dims
+    → 1) when the lowering is shape-dependent.
+
+    ``shapes_dtypes``: list of (shape, np.dtype) with None/-1 for dynamic
+    dims. Shared contract for jit.save and static.save_inference_model.
+    """
+    from jax import export as jax_export
+    sym_args, any_sym, scope = [], False, None
+    for shape, dtype in shapes_dtypes:
+        if any(d is None or (isinstance(d, int) and d <= 0) for d in shape):
+            if scope is None:
+                scope = jax_export.SymbolicScope()
+            # one symbol PER DIM POSITION shared across inputs: the
+            # common case is a shared batch (and seq) dimension, and
+            # distinct per-input symbols would make x + y between two
+            # (None, 4) inputs un-exportable
+            dims = ",".join(
+                f"_d{j}" if (d is None or d <= 0) else str(d)
+                for j, d in enumerate(shape))
+            shp = jax_export.symbolic_shape(dims, scope=scope)
+            any_sym = True
+        else:
+            shp = tuple(shape)
+        sym_args.append(jax.ShapeDtypeStruct(shp, dtype))
+    if any_sym:
+        try:
+            return jax_export.export(jax.jit(frozen_fn))(*sym_args)
+        except Exception as e:  # shape-dependent lowering
+            import warnings
+            warnings.warn(
+                f"{warn_prefix}: symbolic-shape export failed "
+                f"({type(e).__name__}: {str(e)[:120]}); falling back "
+                "to the concrete example shapes — the artifact will "
+                "only accept those exact shapes", stacklevel=2)
+    example = [jnp.zeros(tuple(1 if (d is None or d <= 0) else d
+                               for d in shape), dtype)
+               for shape, dtype in shapes_dtypes]
+    return jax_export.export(jax.jit(frozen_fn))(*example)
+
+
 def save(layer, path, input_spec=None, **configs):
     """~ paddle.jit.save: serialize compiled artifact + weights.
 
@@ -206,41 +249,9 @@ def save(layer, path, input_spec=None, **configs):
         # Shape polymorphism: InputSpec dims of None/-1 export as symbolic
         # dimensions (jax.export), so ONE artifact serves any batch size —
         # the dynamic-batching serving path (inference.DynamicBatcher)
-        # depends on this. Falls back to the concrete example shapes when
-        # the model's lowering is shape-dependent.
-        sym_args = []
-        any_sym = False
-        scope = None
-        for i, s in enumerate(specs):
-            if any(d is None or (isinstance(d, int) and d <= 0)
-                   for d in s.shape):
-                if scope is None:
-                    scope = jax_export.SymbolicScope()
-                # one symbol PER DIM POSITION shared across inputs: the
-                # common case is a shared batch (and seq) dimension, and
-                # distinct per-input symbols would make x + y between two
-                # (None, 4) inputs un-exportable
-                dims = ",".join(
-                    f"_d{j}" if (d is None or d <= 0) else str(d)
-                    for j, d in enumerate(s.shape))
-                shp = jax_export.symbolic_shape(dims, scope=scope)
-                any_sym = True
-            else:
-                shp = tuple(s.shape)
-            sym_args.append(jax.ShapeDtypeStruct(shp, np.dtype(s.dtype)))
-        exp = None
-        if any_sym:
-            try:
-                exp = jax_export.export(jax.jit(frozen))(*sym_args)
-            except Exception as e:  # shape-dependent lowering
-                import warnings
-                warnings.warn(
-                    "jit.save: symbolic-shape export failed "
-                    f"({type(e).__name__}: {str(e)[:120]}); falling back "
-                    "to the concrete example shapes — the artifact will "
-                    "only accept those exact shapes", stacklevel=2)
-        if exp is None:
-            exp = jax_export.export(jax.jit(frozen))(*example)
+        # depends on this.
+        exp = symbolic_export(
+            frozen, [(s.shape, np.dtype(s.dtype)) for s in specs])
         exported_bytes = exp.serialize()
         with open(path + ".pdexport", "wb") as f:
             f.write(exported_bytes)

@@ -38,10 +38,13 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             env[id(p)] = v
         return tuple(_eval_var(f, env) for f in fetch_vars)
 
-    example = [jnp.zeros(tuple(1 if d == -1 else d for d in dv.shape),
-                         dv._jdtype) for dv in feed_vars]
-    from jax import export as jax_export
-    exp = jax_export.export(jax.jit(frozen))(*example)
+    # Shape polymorphism: -1 feed dims export as symbolic dimensions
+    # shared per dim-position — one artifact serves any batch size
+    # (shared contract with paddle_tpu.jit.save).
+    from ..jit import symbolic_export
+    exp = symbolic_export(
+        frozen, [(dv.shape, dv._jdtype) for dv in feed_vars],
+        warn_prefix="save_inference_model")
 
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     with open(path_prefix + ".pdmodel", "w") as f:

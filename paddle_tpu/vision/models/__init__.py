@@ -18,3 +18,7 @@ from .extra_nets import (  # noqa: F401
     GoogLeNet, InceptionV3, MobileNetV3Large, MobileNetV3Small, googlenet,
     inception_v3, mobilenet_v3_large, mobilenet_v3_small,
 )
+from .vit import (  # noqa: F401
+    VisionTransformer, vit_base_patch16_224, vit_base_patch32_224,
+    vit_large_patch16_224, vit_small_patch16_224, vit_tiny_patch16_224,
+)

@@ -1,0 +1,144 @@
+"""Vision Transformer (ViT) family.
+
+The reference repo's paddle.vision zoo stops at CNNs; ViT lives in the
+PaddleClas ecosystem (ppcls/arch/backbone/model_zoo/vision_transformer.py)
+that BASELINE.md's config ladder draws from. Implemented here TPU-first:
+patchify is a single Conv2D (one big MXU matmul per image), the encoder
+is pre-LN transformer blocks whose matmuls dominate FLOPs, and the whole
+forward is shape-static so one jit covers train and eval.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+
+
+class PatchEmbed(nn.Layer):
+    """Image -> (B, N, D) patch tokens via a stride=patch conv."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 embed_dim=768):
+        super().__init__()
+        assert img_size % patch_size == 0
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, kernel_size=patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                      # B, D, H/p, W/p
+        B, D = x.shape[0], x.shape[1]
+        x = x.reshape([B, D, -1])             # B, D, N
+        return x.transpose([0, 2, 1])         # B, N, D
+
+
+class MLP(nn.Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.act = nn.GELU()
+        self.fc2 = nn.Linear(hidden, dim)
+        self.drop = nn.Dropout(drop)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class Block(nn.Layer):
+    """Pre-LN encoder block (LN -> MHA -> +res, LN -> MLP -> +res)."""
+
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, drop=0.0,
+                 attn_drop=0.0, epsilon=1e-6):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.attn = nn.MultiHeadAttention(dim, num_heads, dropout=attn_drop)
+        self.norm2 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.mlp = MLP(dim, int(dim * mlp_ratio), drop=drop)
+
+    def forward(self, x):
+        y = self.norm1(x)
+        x = x + self.attn(y, y, y)
+        return x + self.mlp(self.norm2(x))
+
+
+class VisionTransformer(nn.Layer):
+    """ViT backbone + classification head.
+
+    ~ PaddleClas vision_transformer.py (class token + learned position
+    embedding + pre-LN encoder); TPU notes: all sequence ops are static
+    (N = num_patches + 1 fixed at build), so XLA tiles every matmul on
+    the MXU with no dynamic shapes.
+    """
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 class_num=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, drop_rate=0.0, attn_drop_rate=0.0,
+                 epsilon=1e-6):
+        super().__init__()
+        self.class_num = class_num
+        self.embed_dim = embed_dim
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], default_initializer=nn.initializer.Constant(0.0))
+        self.pos_embed = self.create_parameter(
+            [1, n + 1, embed_dim],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            Block(embed_dim, num_heads, mlp_ratio, drop_rate,
+                  attn_drop_rate, epsilon) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.head = (nn.Linear(embed_dim, class_num)
+                     if class_num > 0 else None)
+
+    def forward_features(self, x):
+        B = x.shape[0]
+        x = self.patch_embed(x)
+        from ...ops.manipulation import concat
+        cls = self.cls_token.expand([B, 1, self.embed_dim])
+        x = concat([cls, x], axis=1) + self.pos_embed
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.norm(x)[:, 0]
+
+    def forward(self, x):
+        x = self.forward_features(x)
+        if self.head is not None:
+            x = self.head(x)
+        return x
+
+
+def _vit(arch, **kwargs):
+    cfgs = {
+        "tiny": dict(embed_dim=192, depth=12, num_heads=3),
+        "small": dict(embed_dim=384, depth=12, num_heads=6),
+        "base": dict(embed_dim=768, depth=12, num_heads=12),
+        "large": dict(embed_dim=1024, depth=24, num_heads=16),
+    }
+    cfg = dict(cfgs[arch])
+    cfg.update(kwargs)
+    return VisionTransformer(**cfg)
+
+
+def vit_tiny_patch16_224(**kwargs):
+    return _vit("tiny", patch_size=16, **kwargs)
+
+
+def vit_small_patch16_224(**kwargs):
+    return _vit("small", patch_size=16, **kwargs)
+
+
+def vit_base_patch16_224(**kwargs):
+    return _vit("base", patch_size=16, **kwargs)
+
+
+def vit_base_patch32_224(**kwargs):
+    return _vit("base", patch_size=32, **kwargs)
+
+
+def vit_large_patch16_224(**kwargs):
+    return _vit("large", patch_size=16, **kwargs)

@@ -228,6 +228,27 @@ class TestInferenceIO:
         np.testing.assert_allclose(np.asarray(out0.numpy()), xs @ W + b,
                                    atol=1e-5)
 
+    def test_dynamic_batch_export(self, static_mode, tmp_path):
+        """-1 feed dims export shape-polymorphically: one artifact
+        serves any batch size (same contract as jit.save)."""
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [-1, 3], "float32")
+            h = static.nn.fc(x, 2)
+        exe = static.Executor()
+        path = os.path.join(str(tmp_path), "poly")
+        static.save_inference_model(path, [x], [h], exe, program=main)
+        layer, _, _ = static.load_inference_model(path, exe)
+        W = np.asarray(main._params[0]._value)
+        b = np.asarray(main._params[1]._value)
+        for n in (1, 4, 7):
+            xs = np.random.default_rng(n).normal(size=(n, 3)).astype(
+                "float32")
+            out = layer(xs)
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            np.testing.assert_allclose(np.asarray(out0.numpy()),
+                                       xs @ W + b, atol=1e-5)
+
 
 class TestStaticNN:
     def test_conv_bn_pipeline(self, static_mode):
