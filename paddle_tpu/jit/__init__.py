@@ -216,7 +216,6 @@ def save(layer, path, input_spec=None, **configs):
     hlo_text = None
     exported_bytes = None
     if input_spec:
-        from jax import export as jax_export
         specs = [s if isinstance(s, InputSpec) else InputSpec(s)
                  for s in input_spec]
         example = [jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),

@@ -1,4 +1,6 @@
-"""MFU experiment on the real chip: fused QKV / gate-up vs baseline."""
+"""MFU experiment on the real chip: fused QKV / gate-up vs baseline;
+`gqa` variant runs the same model with 4 kv heads (grouped flash
+kernel end-to-end in a full train step)."""
 import json
 import sys
 import time
@@ -6,7 +8,7 @@ import time
 import numpy as np
 
 
-def run_variant(fused: bool, steps=20, warmup=3):
+def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -18,7 +20,7 @@ def run_variant(fused: bool, steps=20, warmup=3):
     dev = jax.devices()[0]
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
                       intermediate_size=4096, num_hidden_layers=12,
-                      num_attention_heads=12, num_key_value_heads=12,
+                      num_attention_heads=12, num_key_value_heads=kv_heads,
                       max_position_embeddings=2048,
                       dtype=jnp.bfloat16,
                       fuse_attention_qkv=fused, fuse_ffn_gate_up=fused)
@@ -54,10 +56,15 @@ def run_variant(fused: bool, steps=20, warmup=3):
     attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * S * tok
     flops = 6 * n_params * tok + attn_flops
     mfu = (flops / dt) / 197e12
-    return {"fused": fused, "step_ms": round(dt * 1000, 2),
+    return {"fused": fused, "kv_heads": kv_heads,
+            "step_ms": round(dt * 1000, 2),
             "mfu": round(mfu, 4), "loss": loss}
 
 
 if __name__ == "__main__":
-    fused = sys.argv[1] == "fused"
-    print(json.dumps(run_variant(fused)))
+    variant = sys.argv[1] if len(sys.argv) > 1 else "unfused"
+    if variant not in {"fused", "unfused", "gqa"}:
+        raise SystemExit(f"unknown variant {variant!r}: "
+                         "expected fused | unfused | gqa")
+    print(json.dumps(run_variant(
+        variant == "fused", kv_heads=4 if variant == "gqa" else 12)))
