@@ -1,0 +1,41 @@
+"""The examples/ recipes stay runnable (subprocess smoke).
+
+Each example is a user-facing contract; run the quick ones end-to-end
+the way a user would (fresh process, PYTHONPATH=repo, CPU backend).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name, extra_env=None, timeout=420):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONSTARTUP", None)
+    env.update(extra_env or {})
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, (name, r.stdout[-800:], r.stderr[-800:])
+    return r.stdout
+
+
+@pytest.mark.parametrize("name,expect", [
+    ("train_static_graph.py", "reloaded artifact output"),
+    ("serve_predictor.py", "served 8 requests"),
+    ("finetune_hapi.py", "predict logits shape: (4, 10)"),
+])
+def test_example_runs(name, expect):
+    out = _run(name)
+    assert expect in out, out[-800:]
+
+
+def test_example_4d_mesh():
+    out = _run("train_llama_4d_mesh.py",
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "1/8 of the moments" in out, out[-800:]

@@ -1,6 +1,10 @@
-"""MFU experiment on the real chip: fused QKV / gate-up vs baseline;
-`gqa` variant runs the same model with 4 kv heads (grouped flash
-kernel end-to-end in a full train step)."""
+"""MFU experiments on the real chip, one end-to-end train step each.
+
+Variants: `unfused` (the headline config), `fused` (fused QKV +
+gate/up projections), `gqa` (kv_heads=4 — grouped flash kernel in a
+full train step), `bf16moments` (adamw moments in bf16, halving the
+~10 GB/step optimizer-state HBM stream; numerics differ from the f32
+default — measure, don't default)."""
 import json
 import sys
 import time
@@ -8,7 +12,8 @@ import time
 import numpy as np
 
 
-def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12):
+def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
+                accum_dtype="float32"):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -30,7 +35,8 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12):
     model.to(dtype="bfloat16")
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
     params, opt_state, step, _ = llama_train_step_factory(
-        model, mesh, learning_rate=1e-4, remat=False)
+        model, mesh, learning_rate=1e-4, remat=False,
+        accum_dtype=jnp.dtype(accum_dtype))
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
@@ -57,14 +63,17 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12):
     flops = 6 * n_params * tok + attn_flops
     mfu = (flops / dt) / 197e12
     return {"fused": fused, "kv_heads": kv_heads,
+            "accum_dtype": accum_dtype,
             "step_ms": round(dt * 1000, 2),
             "mfu": round(mfu, 4), "loss": loss}
 
 
 if __name__ == "__main__":
     variant = sys.argv[1] if len(sys.argv) > 1 else "unfused"
-    if variant not in {"fused", "unfused", "gqa"}:
+    if variant not in {"fused", "unfused", "gqa", "bf16moments"}:
         raise SystemExit(f"unknown variant {variant!r}: "
-                         "expected fused | unfused | gqa")
+                         "expected fused | unfused | gqa | bf16moments")
     print(json.dumps(run_variant(
-        variant == "fused", kv_heads=4 if variant == "gqa" else 12)))
+        variant == "fused",
+        kv_heads=4 if variant == "gqa" else 12,
+        accum_dtype="bfloat16" if variant == "bf16moments" else "float32")))
