@@ -1,3 +1,3 @@
 from . import auto_checkpoint  # noqa: F401
 from .auto_checkpoint import (CheckpointSaver, ExeTrainStatus,  # noqa: F401
-                              train_epoch_range)
+                              PreemptionGuard, train_epoch_range)

@@ -184,9 +184,56 @@ def _unpack_state(blob: bytes, model, optimizer):
         optimizer.set_state_dict(_to_tensor_tree(state["opt"]))
 
 
+class PreemptionGuard:
+    """SIGTERM-aware training guard for preemptible TPU pods.
+
+    Cloud TPU preemption delivers SIGTERM with a grace window; the
+    reference's trainers rely on external checkpoint cadence instead
+    (incubate/checkpoint/auto_checkpoint.py has no signal path). Here
+    the guard flips a flag on SIGTERM/SIGINT so the training loop can
+    save at the next step boundary and exit cleanly:
+
+        with PreemptionGuard() as guard:
+            for epoch in train_epoch_range(100, model, opt, guard=guard):
+                ...train...
+        # on SIGTERM: state saved, loop ends; relaunch resumes the epoch
+
+    The previous handler is chained (a second signal still kills the
+    process through it) and restored on __exit__.
+    """
+
+    def __init__(self, signals=None):
+        import signal as _sig
+        self._sig = _sig
+        self.signals = tuple(signals or (_sig.SIGTERM, _sig.SIGINT))
+        self.preempted = False
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        self.preempted = True
+        prev = self._prev.get(signum)
+        # restore the previous handler so a second signal is fatal
+        self._sig.signal(signum, prev if callable(prev)
+                         else self._sig.SIG_DFL)
+
+    def __enter__(self):
+        for s in self.signals:
+            self._prev[s] = self._sig.signal(s, self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            try:
+                self._sig.signal(s, prev)
+            except (ValueError, TypeError):
+                pass
+        return False
+
+
 def train_epoch_range(max_epoch_num: int, model=None, optimizer=None,
                       save_checkpoint_inter: int = 1,
-                      saver: Optional[CheckpointSaver] = None
+                      saver: Optional[CheckpointSaver] = None,
+                      guard: Optional[PreemptionGuard] = None
                       ) -> Iterator[int]:
     """Epoch generator with transparent resume (~ auto_checkpoint.py:598).
 
@@ -194,9 +241,15 @@ def train_epoch_range(max_epoch_num: int, model=None, optimizer=None,
     (every ``save_checkpoint_inter`` epochs) the model+optimizer state is
     checkpointed. On restart under the same job id, already-completed
     epochs are skipped and state is restored before the first yield.
+    With a ``guard`` (PreemptionGuard), a SIGTERM during an epoch saves
+    that epoch's state and ends the loop at the boundary — the relaunch
+    resumes from the next epoch.
     """
     if not _enabled():
-        yield from range(max_epoch_num)
+        for epoch in range(max_epoch_num):
+            yield epoch
+            if guard is not None and guard.preempted:
+                return
         return
     saver = saver or CheckpointSaver()
     start = 0
@@ -207,7 +260,10 @@ def train_epoch_range(max_epoch_num: int, model=None, optimizer=None,
             _unpack_state(blob, model, optimizer)
     for epoch in range(start, max_epoch_num):
         yield epoch
-        if (epoch - start) % max(1, save_checkpoint_inter) == 0 or \
-                epoch == max_epoch_num - 1:
+        preempted = guard is not None and guard.preempted
+        if preempted or (epoch - start) % max(1, save_checkpoint_inter) \
+                == 0 or epoch == max_epoch_num - 1:
             saver.save_checkpoint(_pack_state(model, optimizer),
                                   ExeTrainStatus(epoch_no=epoch))
+        if preempted:
+            return

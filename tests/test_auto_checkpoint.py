@@ -94,6 +94,58 @@ class TestEpochRange:
         assert s._ckpt_nos() == []  # nothing written when disabled
 
 
+class TestPreemption:
+    def test_guard_flag_and_boundary_save(self, ckpt_env):
+        """In-process: a SIGTERM mid-epoch saves at the boundary and ends
+        the loop; resume continues from the next epoch."""
+        import os as _os
+        import signal as _signal
+
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+            PreemptionGuard
+
+        paddle.seed(7)
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                    learning_rate=0.1)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        ran = []
+        with PreemptionGuard() as guard:
+            for epoch in train_epoch_range(10, model=m, optimizer=opt,
+                                           guard=guard):
+                loss = (m(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                ran.append(epoch)
+                if epoch == 1:  # "preemption" arrives mid-epoch 1
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+        assert guard.preempted
+        assert ran == [0, 1]  # loop ended at the boundary, not killed
+        # epoch 1 WAS checkpointed (preemption forces the save)
+        s = CheckpointSaver()
+        _, status = s.load_checkpoint()
+        assert status.epoch_no == 1
+        # relaunch resumes at epoch 2
+        m2 = nn.Linear(4, 2)
+        opt2 = paddle.optimizer.Adam(parameters=m2.parameters(),
+                                     learning_rate=0.1)
+        gen = train_epoch_range(10, model=m2, optimizer=opt2)
+        assert next(gen) == 2
+        gen.close()
+
+    def test_handlers_restored_on_exit(self, ckpt_env):
+        import signal as _signal
+
+        from paddle_tpu.incubate.checkpoint.auto_checkpoint import \
+            PreemptionGuard
+
+        prev = _signal.getsignal(_signal.SIGTERM)
+        with PreemptionGuard():
+            assert _signal.getsignal(_signal.SIGTERM) != prev
+        assert _signal.getsignal(_signal.SIGTERM) == prev
+
+
 class TestHdfsMode:
     def test_upload_download_flow(self, ckpt_env, tmp_path, monkeypatch):
         # reuse the fake hadoop shim from test_fs

@@ -1,0 +1,84 @@
+"""Run the PERF.md chip queue as soon as the axon tunnel returns.
+
+The tunnel drops for hours at a time (observed twice this round); this
+poller probes it in a throwaway subprocess every few minutes and, on
+success, runs the queued experiments back to back, appending one JSON
+line each to --out (default /tmp/chip_queue_results.jsonl). Usage:
+
+  PYTHONPATH=/root/repo:/root/.axon_site python tools/chip_queue_runner.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUEUE = [
+    ("gqa_train", [sys.executable, "tools/mfu_exp.py", "gqa"], {}),
+    ("bf16_moments", [sys.executable, "tools/mfu_exp.py", "bf16moments"],
+     {}),
+    ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
+    ("decode_b64", [sys.executable, "tools/ladder_bench.py", "6"],
+     {"LADDER_DECODE_B": "64"}),
+]
+
+
+def tunnel_up(timeout=90) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices()[0]; print(d.platform)"],
+            capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0 and "cpu" not in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    out_path = "/tmp/chip_queue_results.jsonl"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    poll_s = 240
+    deadline = time.time() + float(
+        os.environ.get("CHIP_QUEUE_DEADLINE_S", 6 * 3600))
+    while time.time() < deadline:
+        if tunnel_up():
+            print("tunnel up; running queue", flush=True)
+            break
+        print("tunnel down; sleeping", flush=True)
+        time.sleep(poll_s)
+    else:
+        print("deadline reached, tunnel never returned", flush=True)
+        return
+
+    for name, cmd, env_extra in QUEUE:
+        env = dict(os.environ, **env_extra)
+        t0 = time.time()
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=3000, cwd=REPO, env=env)
+            results = []
+            for ln in r.stdout.splitlines():
+                if ln.startswith("{"):
+                    try:
+                        results.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        results.append({"unparseable": ln[:200]})
+            rec = {"name": name, "rc": r.returncode,
+                   "wall_s": round(time.time() - t0, 1),
+                   "results": results,
+                   "stderr_tail": r.stderr[-400:] if r.returncode else ""}
+        except subprocess.TimeoutExpired:
+            rec = {"name": name, "rc": -1, "timeout": True,
+                   "wall_s": round(time.time() - t0, 1)}
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -200,7 +200,11 @@ def bench_decode(on_tpu):
                           intermediate_size=4096, num_hidden_layers=12,
                           num_attention_heads=12, num_key_value_heads=12,
                           max_position_embeddings=2048, dtype=jnp.bfloat16)
-        B, prompt_len, new = 8, 128, 128
+        # serving batch override: at B=8 a decode step is dominated by
+        # the ~8-10 ms tunnel dispatch floor; B=64 shows the chip
+        import os
+        B = int(os.environ.get("LADDER_DECODE_B", "8"))
+        prompt_len, new = 128, 128
     else:
         cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
                                kv_heads=2)

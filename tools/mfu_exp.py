@@ -4,7 +4,9 @@ Variants: `unfused` (the headline config), `fused` (fused QKV +
 gate/up projections), `gqa` (kv_heads=4 — grouped flash kernel in a
 full train step), `bf16moments` (adamw moments in bf16, halving the
 ~10 GB/step optimizer-state HBM stream; numerics differ from the f32
-default — measure, don't default)."""
+default — measure, don't default), `long8k` (B=2, S=8192 — the
+single-chip long-context point of the resident-KV flash design; same
+tokens/step as the headline, 4x the attention FLOPs)."""
 import json
 import sys
 import time
@@ -13,7 +15,7 @@ import numpy as np
 
 
 def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
-                accum_dtype="float32"):
+                accum_dtype="float32", B=8, S=2048):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -26,10 +28,9 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
                       intermediate_size=4096, num_hidden_layers=12,
                       num_attention_heads=12, num_key_value_heads=kv_heads,
-                      max_position_embeddings=2048,
+                      max_position_embeddings=max(2048, S),
                       dtype=jnp.bfloat16,
                       fuse_attention_qkv=fused, fuse_ffn_gate_up=fused)
-    B, S = 8, 2048
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.to(dtype="bfloat16")
@@ -63,17 +64,20 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
     flops = 6 * n_params * tok + attn_flops
     mfu = (flops / dt) / 197e12
     return {"fused": fused, "kv_heads": kv_heads,
-            "accum_dtype": accum_dtype,
+            "accum_dtype": accum_dtype, "batch": B, "seq": S,
             "step_ms": round(dt * 1000, 2),
             "mfu": round(mfu, 4), "loss": loss}
 
 
 if __name__ == "__main__":
     variant = sys.argv[1] if len(sys.argv) > 1 else "unfused"
-    if variant not in {"fused", "unfused", "gqa", "bf16moments"}:
-        raise SystemExit(f"unknown variant {variant!r}: "
-                         "expected fused | unfused | gqa | bf16moments")
+    if variant not in {"fused", "unfused", "gqa", "bf16moments", "long8k"}:
+        raise SystemExit(
+            f"unknown variant {variant!r}: expected "
+            "fused | unfused | gqa | bf16moments | long8k")
     print(json.dumps(run_variant(
         variant == "fused",
         kv_heads=4 if variant == "gqa" else 12,
-        accum_dtype="bfloat16" if variant == "bf16moments" else "float32")))
+        accum_dtype="bfloat16" if variant == "bf16moments" else "float32",
+        B=2 if variant == "long8k" else 8,
+        S=8192 if variant == "long8k" else 2048)))
