@@ -1,0 +1,97 @@
+"""PS scale/concurrency stress: multi-PROCESS trainers, large tables.
+
+~ the brpc PS many-workers contract (brpc_ps_server.cc one handler
+thread per worker; table/memory_sparse_table.cc shard locking) and the
+SSD table capacity story (table/ssd_sparse_table.cc). Thread-level
+concurrency is covered in test_ps_server.py; here the workers are real
+processes (separate interpreters, real sockets) and the SSD variant's
+id space exceeds mem_rows so eviction happens mid-training.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import PSServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    from paddle_tpu.distributed.ps import PSClient
+
+    addr, rank, n_ids, rounds = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), int(sys.argv[4]))
+    c = PSClient(server_addr=addr)
+    # disjoint id range per rank -> exact-once effect verifiable
+    base = rank * n_ids
+    ids = np.arange(base, base + n_ids, dtype=np.int64)
+    for r in range(rounds):
+        rows = c.pull_sparse(ids)
+        c.push_sparse(ids, np.ones_like(rows))  # constant unit grad
+    # geo-style async pushes on a SHARED range (contended across ranks)
+    shared = np.arange(0, 64, dtype=np.int64) + 10_000_000
+    rows = c.pull_sparse(shared)
+    for r in range(rounds):
+        c.async_push_sparse(shared, np.ones_like(rows))
+    c.flush()
+    c.close()
+    print(json.dumps({"rank": rank, "ok": True}))
+""")
+
+N_WORKERS, N_IDS, ROUNDS, LR = 3, 2000, 5, 0.1
+
+
+@pytest.fixture
+def server():
+    srv = PSServer(port=0)
+    yield srv
+    srv.stop()
+
+
+def _spawn_workers(addr):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WORKER, addr, str(rank), str(N_IDS),
+         str(ROUNDS)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for rank in range(N_WORKERS)]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, (out[-400:], err[-400:])
+
+
+def _check_rows(table):
+    """SGD with unit grads: row = init - lr * n_pushes; init_std=0.01
+    makes the expected shift dominate."""
+    for rank in range(N_WORKERS):
+        ids = np.arange(rank * N_IDS, (rank + 1) * N_IDS, dtype=np.int64)
+        rows = table.pull(ids)
+        np.testing.assert_allclose(rows, -LR * ROUNDS, atol=0.08)
+    # shared contended range took every rank's async pushes exactly once
+    shared = np.arange(0, 64, dtype=np.int64) + 10_000_000
+    np.testing.assert_allclose(table.pull(shared),
+                               -LR * ROUNDS * N_WORKERS, atol=0.08)
+
+
+def test_memory_table_3proc(server):
+    table = server.add_sparse_table(0, dim=8, lr=LR, init_std=0.01)
+    _spawn_workers(f"127.0.0.1:{server.port}")
+    assert table.size() == N_WORKERS * N_IDS + 64
+    _check_rows(table)
+
+
+def test_ssd_table_eviction_under_load(server, tmp_path):
+    # mem_rows far below the touched id space: pushes/pulls force
+    # eviction to sqlite mid-training; correctness must survive it
+    table = server.add_ssd_sparse_table(
+        0, dim=8, path=str(tmp_path / "ssd.db"), mem_rows=500,
+        lr=LR, init_std=0.01)
+    _spawn_workers(f"127.0.0.1:{server.port}")
+    assert table.size() == N_WORKERS * N_IDS + 64
+    assert len(table._rows) <= 500  # eviction actually happened
+    _check_rows(table)
