@@ -244,10 +244,18 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """q/k/v: (batch, heads, seq, head_dim). Returns same shape as q."""
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    bwd_block_q=None, bwd_block_k=None):
+    """q/k/v: (batch, heads, seq, head_dim). Returns same shape as q.
+
+    ``bwd_block_q``/``bwd_block_k`` tile the two backward kernels
+    independently of the forward (None = same as forward). The backward
+    walks the opposite operand full-length per block (dq walks K/V,
+    dk/dv walks Q), so its VMEM/pipelining optimum need not match the
+    forward's — tools/flash_bwd_sweep.py measures the grid on chip.
+    """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
@@ -256,7 +264,8 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     return out
 
 
-def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+            bwd_block_q, bwd_block_k):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
@@ -265,12 +274,20 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
+def _fa_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k,
+            res, do):
     q, k, v, out, lse = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
-                                       block_q, block_k)
+    block_q, block_k = _resolve_blocks(
+        q.shape[2], k.shape[2],
+        bwd_block_q or block_q, bwd_block_k or block_k)
+    # explicit bwd blocks skip the fwd path's validation; a non-dividing
+    # block would silently leave output rows unwritten (grid truncation)
+    if q.shape[2] % block_q or k.shape[2] % block_k:
+        raise ValueError(
+            f"flash_attention backward blocks ({block_q}, {block_k}) must "
+            f"divide seq lens ({q.shape[2]}, {k.shape[2]})")
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bh = B * H

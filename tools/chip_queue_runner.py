@@ -24,6 +24,7 @@ QUEUE = [
     ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
     ("decode_b64", [sys.executable, "tools/ladder_bench.py", "6"],
      {"LADDER_DECODE_B": "64"}),
+    ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
 ]
 
 
