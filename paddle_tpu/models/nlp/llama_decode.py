@@ -22,15 +22,46 @@ from .llama import LlamaConfig, LlamaForCausalLM, apply_rotary
 from .llama_functional import _rms, split_params
 
 
+def _mm(x, w):
+    """Matmul against a weight that may be int8-quantized.
+
+    Plain array -> x @ w. Tuple (w_q int8 (in,out), scale f32 (out,)) ->
+    the shared int8 GEMM (quantization.int8.int8_matmul): 2x the bf16
+    dot rate on v5e-class MXUs and half the weight HBM bytes — decode at
+    small batch is weight-bandwidth-bound.
+    """
+    if not isinstance(w, tuple):
+        return x @ w
+    from ...quantization.int8 import int8_matmul
+    return int8_matmul(x, w[0], w[1])
+
+
+def _quantize_weights(tree, keys):
+    """Per-output-channel int8 for the named (..., in, out) weights:
+    value -> (int8 data, f32 scale over the 'in' axis)."""
+    from ...quantization.int8 import quantize_stacked_jnp
+    out = dict(tree)
+    for k in keys:
+        if tree.get(k) is not None:
+            out[k] = quantize_stacked_jnp(tree[k])
+    return out
+
+
+_PROJ_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+              "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+              "mlp.gate_proj.weight", "mlp.up_proj.weight",
+              "mlp.down_proj.weight")
+
+
 def _proj_qkv(cfg: LlamaConfig, p, h, pos):
     """h: (B, T, H); pos: (T,) absolute positions. Returns q,k,v with
     rotary applied — q (B, nh, T, hd), k/v (B, nkv, T, hd)."""
     B, T, H = h.shape
     nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
     hd = H // nh
-    q = (h @ p["self_attn.q_proj.weight"]).reshape(B, T, nh, hd)
-    k = (h @ p["self_attn.k_proj.weight"]).reshape(B, T, nkv, hd)
-    v = (h @ p["self_attn.v_proj.weight"]).reshape(B, T, nkv, hd)
+    q = _mm(h, p["self_attn.q_proj.weight"]).reshape(B, T, nh, hd)
+    k = _mm(h, p["self_attn.k_proj.weight"]).reshape(B, T, nkv, hd)
+    v = _mm(h, p["self_attn.v_proj.weight"]).reshape(B, T, nkv, hd)
     q = apply_rotary(q, pos, cfg.rope_theta)
     k = apply_rotary(k, pos, cfg.rope_theta)
     return (jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
@@ -91,12 +122,13 @@ def _layer_math(cfg, lp, x, pos_vec, attend):
     h = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
     q, k, v = _proj_qkv(cfg, lp, h, pos_vec)
     ctx, extra = attend(q, k, v)
-    attn = jnp.swapaxes(ctx, 1, 2).reshape(B, T, H) \
-        @ lp["self_attn.o_proj.weight"]
+    attn = _mm(jnp.swapaxes(ctx, 1, 2).reshape(B, T, H),
+               lp["self_attn.o_proj.weight"])
     x = x + attn
     h2 = _rms(x, lp["post_attention_layernorm.weight"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu(h2 @ lp["mlp.gate_proj.weight"])
-           * (h2 @ lp["mlp.up_proj.weight"])) @ lp["mlp.down_proj.weight"]
+    mlp = _mm(jax.nn.silu(_mm(h2, lp["mlp.gate_proj.weight"]))
+              * _mm(h2, lp["mlp.up_proj.weight"]),
+              lp["mlp.down_proj.weight"])
     return x + mlp, extra
 
 
@@ -130,8 +162,10 @@ def _layer_step(cfg, lp, x, k_cache, v_cache, pos_vec, key_mask, write_at):
 def _logits(cfg, outer, x_last):
     head = outer.get("lm_head.weight")
     if head is None:
+        # tied embeddings stay unquantized (the same array feeds the
+        # token lookup, where int8 would distort every embedding)
         return x_last @ outer["model.embed_tokens.weight"].T
-    return x_last @ head
+    return _mm(x_last, head)
 
 
 def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W,
@@ -162,7 +196,8 @@ def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W,
 
 
 def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
-                         kv_cache_dtype: str | None = None):
+                         kv_cache_dtype: str | None = None,
+                         weight_dtype: str | None = None):
     """Returns ``generate(tokens, max_new_tokens, key=None,
     temperature=0.0, top_k=0) -> (B, S0+max_new) token array`` running a
     fully jitted prefill + per-token decode with functional KV caches.
@@ -175,9 +210,22 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
     over head_dim): cache memory halves vs bf16 and the dequant fuses
     into the attention matmuls — the serving-memory lever the
     reference's fused_multi_transformer lacks.
+
+    ``weight_dtype="int8"`` additionally quantizes the projection and
+    lm-head weights per output channel (~ QuantizationFreezePass +
+    fused int8 inference, paddle/fluid/operators/fused/): activations
+    quantize dynamically per tensor and the matmuls run int8 x int8 ->
+    int32 on the MXU — half the weight HBM traffic, which is what bounds
+    small-batch decode. Tied embeddings stay full precision.
     """
     cfg = model.config
     outer, layers = split_params(model)
+    if weight_dtype not in (None, "int8"):
+        raise ValueError(f"weight_dtype {weight_dtype!r}: use None or "
+                         "'int8'")
+    if weight_dtype == "int8":
+        layers = _quantize_weights(layers, _PROJ_KEYS)
+        outer = _quantize_weights(outer, ("lm_head.weight",))
     L = cfg.num_hidden_layers
     nkv = cfg.num_key_value_heads
     hd = cfg.hidden_size // cfg.num_attention_heads

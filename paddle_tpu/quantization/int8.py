@@ -33,6 +33,29 @@ def quantize_weight_per_channel(w: np.ndarray, axis: int = 1):
     return q, (amax / QMAX).astype(np.float32)
 
 
+def quantize_stacked_jnp(w):
+    """jnp variant for (..., in, out) (possibly layer-stacked) weights:
+    per-output-channel scales over the 'in' axis. Returns
+    (q int8, scale f32 with the 'in' axis reduced away)."""
+    wf = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2), 1e-8) / QMAX
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -QMAX, QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def int8_matmul(x, wq, scale):
+    """x (..., in) @ wq (in, out) int8 with dynamic per-tensor activation
+    quantization; accumulates int32 on the MXU, rescales to x.dtype.
+    The shared int8 GEMM used by Int8Linear and the compiled decode."""
+    xf = x.astype(jnp.float32)
+    sx = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / QMAX
+    xq = jnp.clip(jnp.round(xf / sx), -QMAX, QMAX).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * (sx * scale)).astype(x.dtype)
+
+
 class Int8Linear(nn.Layer):
     """Linear with frozen int8 weights + dynamic int8 activations.
 
@@ -76,12 +99,27 @@ def convert_to_int8(model: nn.Layer, act_scales: dict | None = None,
     act_scales maps sublayer path -> calibrated activation scale; layers
     without an entry fall back to dynamic activation quantization.
     """
+    # model-parallel Linears are Linear-shaped (weight (in,out) + bias)
+    # and quantize the same way; their sharding annotations carry over to
+    # the int8 buffers (scales follow the out-channel axis), so the MP
+    # memory sharding survives conversion. Imported here to avoid a
+    # quantization<->distributed import cycle.
+    from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers \
+        import ColumnParallelLinear, RowParallelLinear
+    quantizable = (nn.Linear, ColumnParallelLinear, RowParallelLinear)
     act_scales = act_scales or {}
     for name, sub in list(model._sub_layers.items()):
         path = f"{prefix}.{name}" if prefix else name
-        if isinstance(sub, nn.Linear):
-            model._sub_layers[name] = Int8Linear(
-                sub, act_scale=act_scales.get(path))
+        if isinstance(sub, quantizable):
+            q = Int8Linear(sub, act_scale=act_scales.get(path))
+            spec = getattr(sub.weight, "sharding_spec", None)
+            if spec is not None:
+                from jax.sharding import PartitionSpec as P
+                q.weight_q.sharding_spec = spec
+                # weight_scale is (1, out): axis 1 follows the weight's
+                # out-channel placement
+                q.weight_scale.sharding_spec = P(None, spec[1])
+            model._sub_layers[name] = q
         else:
             convert_to_int8(sub, act_scales, path)
     return model

@@ -206,6 +206,35 @@ def test_int8_kv_cache_close_to_fp():
         llama_decode_factory(model, max_len=32, kv_cache_dtype="fp4")
 
 
+def test_int8_weights_close_to_fp():
+    """weight_dtype='int8': per-channel weight quant + dynamic activation
+    quant keep greedy decode on-sequence; weights really stored int8."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    cfg = LlamaConfig.tiny(vocab=97, hidden=64, layers=2, heads=4,
+                           kv_heads=2)
+    paddle.seed(12)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    gen_fp = llama_decode_factory(model, max_len=32)
+    gen_w8 = llama_decode_factory(model, max_len=32, weight_dtype="int8")
+    prompt = np.asarray(
+        np.random.default_rng(1).integers(0, 97, (2, 6)), np.int32)
+    fp = np.asarray(gen_fp(prompt, max_new_tokens=8))
+    w8 = np.asarray(gen_w8(prompt, max_new_tokens=8))
+    assert (fp[:, 6:] == w8[:, 6:]).mean() > 0.8, (fp, w8)
+    # and the two quantizations compose
+    gen_both = llama_decode_factory(model, max_len=32,
+                                    kv_cache_dtype="int8",
+                                    weight_dtype="int8")
+    b8 = np.asarray(gen_both(prompt, max_new_tokens=8))
+    # stacked quantizations: one early flip cascades autoregressively,
+    # so assert a short pre-divergence prefix instead of total agreement
+    assert (fp[:, 6:9] == b8[:, 6:9]).all(), (fp, b8)
+    with pytest.raises(ValueError, match="weight_dtype"):
+        llama_decode_factory(model, max_len=32, weight_dtype="fp8")
+
+
 def test_int8_kv_cache_with_rolling_window():
     from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.models.nlp.llama_decode import llama_decode_factory

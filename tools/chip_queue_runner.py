@@ -24,6 +24,8 @@ QUEUE = [
     ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
     ("decode_b64", [sys.executable, "tools/ladder_bench.py", "6"],
      {"LADDER_DECODE_B": "64"}),
+    ("decode_b64_int8", [sys.executable, "tools/ladder_bench.py", "6"],
+     {"LADDER_DECODE_B": "64", "LADDER_DECODE_WEIGHTS": "int8"}),
     ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
 ]
 

@@ -15,6 +15,7 @@ configs run. Usage: python tools/ladder_bench.py [1 2 3 4 5]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -202,7 +203,6 @@ def bench_decode(on_tpu):
                           max_position_embeddings=2048, dtype=jnp.bfloat16)
         # serving batch override: at B=8 a decode step is dominated by
         # the ~8-10 ms tunnel dispatch floor; B=64 shows the chip
-        import os
         B = int(os.environ.get("LADDER_DECODE_B", "8"))
         prompt_len, new = 128, 128
     else:
@@ -213,7 +213,9 @@ def bench_decode(on_tpu):
     model.eval()
     if on_tpu:
         model.to(dtype="bfloat16")
-    gen = llama_decode_factory(model, max_len=prompt_len + new)
+    weight_dtype = os.environ.get("LADDER_DECODE_WEIGHTS") or None
+    gen = llama_decode_factory(model, max_len=prompt_len + new,
+                               weight_dtype=weight_dtype)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt_len)),
                          jnp.int32)
@@ -228,7 +230,8 @@ def bench_decode(on_tpu):
     dt = (time.perf_counter() - t0) / n
     return {"metric": "llama_decode_tokens_per_sec",
             "value": round(B * new / dt, 1), "unit": "tokens/sec",
-            "batch": B, "prompt": prompt_len, "new_tokens": new}
+            "batch": B, "prompt": prompt_len, "new_tokens": new,
+            "weights": weight_dtype or "bf16"}
 
 
 def main():
