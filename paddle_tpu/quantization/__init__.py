@@ -132,8 +132,15 @@ class PostTrainingQuantization:
         self.act_scales: dict[str, float] = {}
 
     def _observed_layers(self):
+        # "Linear" also covers the model-parallel Linears, which
+        # convert_to_int8 quantizes — calibration must observe every
+        # layer the conversion will touch or they'd silently fall back
+        # to dynamic activation scales
+        aliases = {"ColumnParallelLinear": "Linear",
+                   "RowParallelLinear": "Linear"}
         for name, layer in self.model.named_sublayers():
-            if type(layer).__name__ in self.types:
+            cls = type(layer).__name__
+            if cls in self.types or aliases.get(cls) in self.types:
                 yield name, layer
 
     def quantize(self) -> nn.Layer:

@@ -43,12 +43,16 @@ def quantize_stacked_jnp(w):
     return q.astype(jnp.int8), scale
 
 
-def int8_matmul(x, wq, scale):
-    """x (..., in) @ wq (in, out) int8 with dynamic per-tensor activation
-    quantization; accumulates int32 on the MXU, rescales to x.dtype.
-    The shared int8 GEMM used by Int8Linear and the compiled decode."""
+def int8_matmul(x, wq, scale, act_scale=None):
+    """x (..., in) @ wq (in, out) int8; activation scale is calibrated
+    (``act_scale``) or dynamic per-tensor abs-max. Accumulates int32 on
+    the MXU, rescales to x.dtype. The shared int8 GEMM used by
+    Int8Linear and the compiled decode."""
     xf = x.astype(jnp.float32)
-    sx = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / QMAX
+    if act_scale is None:
+        sx = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / QMAX
+    else:
+        sx = jnp.asarray(act_scale, jnp.float32)
     xq = jnp.clip(jnp.round(xf / sx), -QMAX, QMAX).astype(jnp.int8)
     acc = jax.lax.dot_general(
         xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
@@ -76,17 +80,10 @@ class Int8Linear(nn.Layer):
 
     def forward(self, x):
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-        if self.act_scale is not None:
-            s_x = jnp.asarray(self.act_scale, jnp.float32)
-        else:
-            s_x = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8) / QMAX
-        q_x = jnp.clip(jnp.round(xv / s_x), -QMAX, QMAX).astype(jnp.int8)
-        # int8 x int8 -> int32 accumulate: MXU-native
-        acc = jax.lax.dot_general(
-            q_x, self.weight_q._value,
-            (((q_x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        out = acc.astype(jnp.float32) * s_x * self.weight_scale._value[0]
+        # f32 in -> shared GEMM returns f32; bias adds in f32 before the
+        # downcast to the caller's dtype
+        out = int8_matmul(xv.astype(jnp.float32), self.weight_q._value,
+                          self.weight_scale._value[0], self.act_scale)
         if self.bias is not None:
             out = out + self.bias._value
         return Tensor(out.astype(xv.dtype))

@@ -22,3 +22,7 @@ from .vit import (  # noqa: F401
     VisionTransformer, vit_base_patch16_224, vit_base_patch32_224,
     vit_large_patch16_224, vit_small_patch16_224, vit_tiny_patch16_224,
 )
+from .convnext import (  # noqa: F401
+    ConvNeXt, convnext_base, convnext_large, convnext_small,
+    convnext_tiny,
+)

@@ -1,0 +1,109 @@
+"""ConvNeXt family (A ConvNet for the 2020s).
+
+PaddleClas-era modern CNN (ppcls/arch/backbone/model_zoo/convnext.py);
+the reference repo's own zoo predates it. TPU notes: the depthwise 7x7
+is a grouped conv XLA lowers well at NHWC-equivalent tilings; the
+inverted-bottleneck MLP (1x1 convs as Linear over channels-last) puts
+~90% of the FLOPs in plain MXU matmuls; LayerNorm is channels-last so
+no transposes survive fusion.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+
+
+class _LayerNormChannelsFirst(nn.Layer):
+    """LayerNorm over C for (B, C, H, W) without leaving NCHW."""
+
+    def __init__(self, dim, epsilon=1e-6):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [dim], default_initializer=nn.initializer.Constant(1.0))
+        self.bias = self.create_parameter([dim], is_bias=True)
+        self.eps = epsilon
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        mu = jnp.mean(xv, axis=1, keepdims=True)
+        var = jnp.var(xv, axis=1, keepdims=True)
+        y = (xv - mu) / jnp.sqrt(var + self.eps)
+        y = (y * self.weight._value[None, :, None, None]
+             + self.bias._value[None, :, None, None])
+        return Tensor(y.astype(xv.dtype))
+
+
+class ConvNeXtBlock(nn.Layer):
+    """dwconv7x7 -> LN -> pwconv(4x) -> GELU -> pwconv -> layer-scale ->
+    +residual. Pointwise convs are Linear over a channels-last view."""
+
+    def __init__(self, dim, layer_scale_init=1e-6):
+        super().__init__()
+        self.dwconv = nn.Conv2D(dim, dim, 7, padding=3, groups=dim)
+        self.norm = nn.LayerNorm(dim, epsilon=1e-6)
+        self.pwconv1 = nn.Linear(dim, 4 * dim)
+        self.act = nn.GELU()
+        self.pwconv2 = nn.Linear(4 * dim, dim)
+        self.gamma = self.create_parameter(
+            [dim],
+            default_initializer=nn.initializer.Constant(layer_scale_init))
+
+    def forward(self, x):
+        inp = x
+        x = self.dwconv(x)
+        x = x.transpose([0, 2, 3, 1])        # channels-last for LN+MLP
+        x = self.norm(x)
+        x = self.pwconv2(self.act(self.pwconv1(x)))
+        x = self.gamma * x
+        return inp + x.transpose([0, 3, 1, 2])
+
+
+class ConvNeXt(nn.Layer):
+    def __init__(self, in_chans=3, class_num=1000,
+                 depths=(3, 3, 9, 3), dims=(96, 192, 384, 768),
+                 layer_scale_init=1e-6):
+        super().__init__()
+        self.downsample_layers = nn.LayerList()
+        stem = nn.Sequential(
+            nn.Conv2D(in_chans, dims[0], 4, stride=4),
+            _LayerNormChannelsFirst(dims[0]))
+        self.downsample_layers.append(stem)
+        for i in range(3):
+            self.downsample_layers.append(nn.Sequential(
+                _LayerNormChannelsFirst(dims[i]),
+                nn.Conv2D(dims[i], dims[i + 1], 2, stride=2)))
+        self.stages = nn.LayerList([
+            nn.Sequential(*[ConvNeXtBlock(dims[i], layer_scale_init)
+                            for _ in range(depths[i])])
+            for i in range(4)])
+        self.norm = nn.LayerNorm(dims[-1], epsilon=1e-6)
+        self.head = nn.Linear(dims[-1], class_num)
+
+    def forward(self, x):
+        for down, stage in zip(self.downsample_layers, self.stages):
+            x = stage(down(x))
+        x = x.mean(axis=[2, 3])              # global average pool
+        return self.head(self.norm(x))
+
+
+def _convnext(depths, dims, **kwargs):
+    return ConvNeXt(depths=depths, dims=dims, **kwargs)
+
+
+def convnext_tiny(**kwargs):
+    return _convnext((3, 3, 9, 3), (96, 192, 384, 768), **kwargs)
+
+
+def convnext_small(**kwargs):
+    return _convnext((3, 3, 27, 3), (96, 192, 384, 768), **kwargs)
+
+
+def convnext_base(**kwargs):
+    return _convnext((3, 3, 27, 3), (128, 256, 512, 1024), **kwargs)
+
+
+def convnext_large(**kwargs):
+    return _convnext((3, 3, 27, 3), (192, 384, 768, 1536), **kwargs)

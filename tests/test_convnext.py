@@ -1,0 +1,48 @@
+"""ConvNeXt family. ~ PaddleClas convnext.py (post-reference zoo)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import ConvNeXt, convnext_tiny
+
+
+def _tiny(classes=5):
+    return ConvNeXt(class_num=classes, depths=(1, 1, 2, 1),
+                    dims=(16, 32, 64, 128))
+
+
+def test_forward_shape():
+    net = _tiny()
+    net.eval()
+    out = net(paddle.randn([2, 3, 64, 64]))
+    assert out.shape == [2, 5]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_depthwise_and_scale_structure():
+    net = convnext_tiny(class_num=10)
+    blk = net.stages[0][0]
+    assert blk.dwconv.groups == 96          # depthwise
+    assert blk.pwconv1.weight.shape == [96, 384]  # 4x expansion
+    np.testing.assert_allclose(blk.gamma.numpy(), 1e-6)  # layer scale
+
+
+def test_train_step_learns():
+    paddle.seed(0)
+    net = _tiny(classes=3)
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=2e-3)
+    rng = np.random.default_rng(0)
+    temp = rng.normal(0, 1, (3, 3, 32, 32)).astype(np.float32)
+    y = rng.integers(0, 3, 18)
+    x = (temp[y] + 0.1 * rng.normal(0, 1, (18, 3, 32, 32))
+         ).astype(np.float32)
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y.astype(np.int64))
+    first = None
+    for _ in range(12):
+        loss = paddle.nn.functional.cross_entropy(net(xt), yt)
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first * 0.6, (first, float(loss))
