@@ -91,8 +91,32 @@ def top2_gating(logits, capacity, noise_key=None):
     return jnp.maximum(d1, d2), c1 + c2, aux
 
 
+def expert_choice_gating(logits, capacity):
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT picks its
+    top-``capacity`` tokens instead of tokens picking experts. Load
+    balance is exact by construction (every expert processes exactly C
+    tokens), so there is no aux loss and no overflow dropping; a token
+    may be picked by 0..E experts. Static shapes throughout — the
+    top_k is over a fixed (E, T) score matrix, TPU-friendly.
+
+    Returns (dispatch (T,E,C), combine (T,E,C), aux=0).
+    """
+    T, E = logits.shape
+    capacity = min(capacity, T)  # top_k requires k <= T (tiny batches /
+    #                              many experts; token-routing gates
+    #                              tolerate cap > T but top_k raises)
+    probs = jax.nn.softmax(logits, -1)           # per-token over experts
+    g, idx = jax.lax.top_k(probs.T, capacity)    # (E, C): weights, tokens
+    dispatch = jnp.transpose(
+        jax.nn.one_hot(idx, T, dtype=jnp.float32), (2, 0, 1))  # (T,E,C)
+    combine = dispatch * g[None, :, :]
+    return dispatch, combine, jnp.zeros((), jnp.float32)
+
+
 class BaseGate(nn.Layer):
     """~ gate/base_gate.py."""
+
+    routing = "token"  # tokens pick experts (gshard/switch family)
 
     def __init__(self, d_model, num_experts):
         super().__init__()
@@ -109,6 +133,14 @@ class GShardGate(BaseGate):
 
 
 class NaiveGate(BaseGate):
+    top_k = 2
+
+
+class ExpertChoiceGate(BaseGate):
+    """Experts pick tokens; top_k only feeds the capacity formula
+    (C = top_k * capacity_factor * T / E)."""
+
+    routing = "expert"
     top_k = 2
 
 
@@ -129,7 +161,8 @@ class MoELayer(nn.Layer):
         self.capacity_factor = capacity_factor
         if isinstance(gate, str):
             gate_cls = {"gshard": GShardGate, "switch": SwitchGate,
-                        "naive": NaiveGate}[gate]
+                        "naive": NaiveGate,
+                        "expert_choice": ExpertChoiceGate}[gate]
             self.gate = gate_cls(d_model, num_experts)
         else:
             self.gate = gate
@@ -160,10 +193,14 @@ class MoELayer(nn.Layer):
         topk = self.top_k
         key = _gen.next_key() if self.training else None
 
+        routing = getattr(self.gate, "routing", "token")
+
         def fused(xv, gl, w_in, w_out):
             xt = xv.reshape(T, H)
             glt = gl.reshape(T, self.num_experts).astype(jnp.float32)
-            if topk == 1:
+            if routing == "expert":
+                dispatch, combine, aux = expert_choice_gating(glt, cap)
+            elif topk == 1:
                 dispatch, combine, aux = top1_gating(glt, cap, key,
                                                      0.01 if key is not None
                                                      else 0.0)

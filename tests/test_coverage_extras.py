@@ -144,6 +144,43 @@ class TestMoEGating:
         cw = np.asarray(combine.sum(axis=(1, 2)))
         assert cw.max() <= 1 + 1e-5
 
+    def test_expert_choice_exact_load(self):
+        """Expert-choice routing: every expert takes EXACTLY capacity
+        tokens (perfect balance by construction), no aux loss."""
+        from paddle_tpu.incubate.distributed.models.moe import \
+            expert_choice_gating
+        logits = jnp.asarray(np.random.default_rng(0).normal(
+            0, 1, (32, 4)).astype(np.float32))
+        dispatch, combine, aux = expert_choice_gating(logits, capacity=8)
+        per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+        np.testing.assert_allclose(per_expert, 8.0)  # exact
+        assert float(aux) == 0.0
+        # each (expert, slot) holds exactly one token
+        np.testing.assert_allclose(np.asarray(dispatch.sum(0)), 1.0)
+        # combine weights are the picked tokens' softmax probs
+        assert np.asarray(combine).max() <= 1.0 + 1e-6
+
+    def test_expert_choice_layer_runs_and_learns(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        paddle.seed(3)
+        layer = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                         gate="expert_choice")
+        opt = paddle.optimizer.Adam(parameters=layer.parameters(),
+                                    learning_rate=1e-2)
+        x = paddle.to_tensor(np.random.default_rng(1).normal(
+            0, 1, (2, 8, 16)).astype(np.float32))
+        first = None
+        for _ in range(6):
+            out = layer(x)
+            assert layer.aux_loss is not None
+            loss = ((out - x) ** 2).mean()
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first, (first, float(loss))
+
 
 class TestGeneratedOps:
     def test_infer_meta_matches_run(self):
