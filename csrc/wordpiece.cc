@@ -1,0 +1,123 @@
+// Native BERT tokenizer fast path (basic split + greedy wordpiece).
+//
+// ~ the reference ecosystem's faster_tokenizer C++ core: tokenization is
+// host-side data-pipeline work that Python does one char at a time; this
+// does the ASCII common case in one pass. Non-ASCII texts are flagged
+// (out_lens[i] = -1) for the Python implementation, which owns unicode
+// normalization/CJK splitting — the two paths are behavior-identical on
+// the inputs the native one accepts (tests/test_strings.py parity test).
+//
+// API (ctypes, paddle_tpu/utils/native.py):
+//   wp_new(blob, offsets, n)    vocab pieces, concatenated + offsets
+//   wp_encode(handle, blob, offsets, n, unk, max_chars, lower,
+//             out_ids, out_lens, max_out)
+//   wp_free(handle)
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+struct Vocab {
+    std::unordered_map<std::string, int32_t> map;
+};
+
+inline bool is_punct(unsigned char c) {
+    return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+           (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+// greedy longest-match-first wordpiece; returns false on [UNK]
+bool wordpiece(const Vocab& v, const std::string& word, int32_t unk_id,
+               int32_t max_chars, int32_t* out, int32_t& n,
+               int32_t max_out) {
+    if ((int32_t)word.size() > max_chars) {
+        if (n >= max_out) return false;
+        out[n++] = unk_id;
+        return true;
+    }
+    size_t start = 0;
+    int32_t first = n;
+    while (start < word.size()) {
+        size_t end = word.size();
+        int32_t id = -1;
+        while (start < end) {
+            std::string piece = (start > 0 ? "##" : "") +
+                                word.substr(start, end - start);
+            auto it = v.map.find(piece);
+            if (it != v.map.end()) { id = it->second; break; }
+            --end;
+        }
+        if (id < 0) {  // whole word -> UNK (BERT semantics)
+            n = first;
+            if (n >= max_out) return false;
+            out[n++] = unk_id;
+            return true;
+        }
+        if (n >= max_out) return false;
+        out[n++] = id;
+        start = end;
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wp_new(const char* blob, const int32_t* offsets, int32_t n) {
+    auto* v = new Vocab();
+    v->map.reserve(n * 2);
+    for (int32_t i = 0; i < n; ++i)
+        v->map.emplace(std::string(blob + offsets[i],
+                                   offsets[i + 1] - offsets[i]), i);
+    return v;
+}
+
+void wp_free(void* h) { delete static_cast<Vocab*>(h); }
+
+// Encodes n texts. out_ids is (n, max_out) int32 row-major; out_lens[i]
+// is the id count, or -1 when the text needs the Python path (non-ASCII
+// byte seen) or the row overflowed max_out.
+void wp_encode(void* h, const char* blob, const int32_t* offsets,
+               int32_t n, int32_t unk_id, int32_t max_chars,
+               int32_t do_lower, int32_t* out_ids, int32_t* out_lens,
+               int32_t max_out) {
+    const Vocab& v = *static_cast<Vocab*>(h);
+    for (int32_t i = 0; i < n; ++i) {
+        const char* s = blob + offsets[i];
+        int32_t len = offsets[i + 1] - offsets[i];
+        int32_t* row = out_ids + (int64_t)i * max_out;
+        int32_t cnt = 0;
+        bool ok = true;
+        std::string word;
+        for (int32_t j = 0; j <= len && ok; ++j) {
+            unsigned char c = j < len ? (unsigned char)s[j] : ' ';
+            if (c >= 0x80) { ok = false; break; }  // Python path owns it
+            // rare control chars (0x00-0x1f outside \t\n\v\f\r) differ
+            // between str.isspace() and any simple C rule — punt them
+            if (c < 0x20 && !(c >= '\t' && c <= '\r')) { ok = false;
+                                                        break; }
+            if (do_lower && c >= 'A' && c <= 'Z') c += 32;
+            if (c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+                c == '\f' || c == '\v' || is_punct(c)) {
+                if (!word.empty()) {
+                    ok = wordpiece(v, word, unk_id, max_chars, row, cnt,
+                                   max_out);
+                    word.clear();
+                }
+                if (ok && is_punct(c)) {
+                    std::string p(1, (char)c);
+                    ok = wordpiece(v, p, unk_id, max_chars, row, cnt,
+                                   max_out);
+                }
+            } else {
+                word.push_back((char)c);
+            }
+        }
+        out_lens[i] = ok ? cnt : -1;
+    }
+}
+
+}  // extern "C"

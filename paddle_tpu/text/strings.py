@@ -168,6 +168,74 @@ class FasterTokenizer:
         self.cls_id = vocab[cls_token]
         self.sep_id = vocab[sep_token]
         self.pad_id = vocab.get(pad_token, 0)
+        # native fast path bookkeeping: the C map stores piece -> ROW
+        # (insertion index); rows translate back through _row_to_id
+        self._pieces = list(vocab)
+        self._row_to_id = np.asarray([vocab[p] for p in self._pieces],
+                                     np.int64)
+        self._unk_row = (self._pieces.index(unk_token)
+                         if unk_token in vocab else 0)
+        self._native = None  # lazy: False (unavailable) or (lib, handle)
+
+    def _native_handle(self):
+        """Build the C vocab once (~ faster_tokenizer's C++ core). The
+        native path covers pure-ASCII texts; others fall back per-text
+        to the Python pipeline (which owns unicode/CJK)."""
+        if self._native is None:
+            import ctypes
+
+            from ..utils import native as _nat
+            lib = _nat.get_lib()
+            if lib is None or not hasattr(lib, "wp_new"):
+                self._native = False
+            else:
+                blob = "".join(self._pieces).encode("utf-8")
+                offs = np.zeros(len(self._pieces) + 1, np.int32)
+                np.cumsum([len(p.encode("utf-8")) for p in self._pieces],
+                          out=offs[1:])
+                handle = lib.wp_new(
+                    blob,
+                    offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    len(self._pieces))
+                import weakref
+                weakref.finalize(self, lib.wp_free, handle)
+                self._native = (lib, handle)
+        return self._native
+
+    # texts longer than this go to Python (keeps the per-row output
+    # buffer, n x 2*longest, bounded for mixed batches)
+    _NATIVE_MAX_TEXT_BYTES = 4096
+
+    def _encode_batch_native(self, texts):
+        """Returns list[list[int] | None] (None = needs Python path)."""
+        nat = self._native_handle()
+        if not nat or not texts:
+            return [None] * len(texts)
+        import ctypes
+        lib, handle = nat
+        enc_all = [t.encode("utf-8") for t in texts]
+        keep = [i for i, e in enumerate(enc_all)
+                if len(e) <= self._NATIVE_MAX_TEXT_BYTES]
+        out: list = [None] * len(texts)
+        if not keep or sum(len(enc_all[i]) for i in keep) >= 2**31:
+            return out  # int32 offsets can't address the blob
+        enc = [enc_all[i] for i in keep]
+        blob = b"".join(enc)
+        offs = np.zeros(len(enc) + 1, np.int32)
+        np.cumsum([len(e) for e in enc], out=offs[1:])
+        max_out = 2 * max(len(e) for e in enc) + 8
+        ids = np.empty((len(enc), max_out), np.int32)
+        lens = np.empty(len(enc), np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.wp_encode(handle, blob, offs.ctypes.data_as(i32p), len(enc),
+                      self._unk_row, self.wordpiece.max_chars,
+                      int(self.basic.do_lower_case),
+                      ids.ctypes.data_as(i32p), lens.ctypes.data_as(i32p),
+                      max_out)
+        for r, i in enumerate(keep):
+            if lens[r] >= 0:
+                out[i] = self._row_to_id[ids[r, :lens[r]]].tolist()
+        return out
 
     def _encode_one(self, text: str) -> List[int]:
         ids = []
@@ -185,12 +253,18 @@ class FasterTokenizer:
             pairs = (text_pair.tolist()
                      if isinstance(text_pair, StringTensor)
                      else list(text_pair))
+        fast = self._encode_batch_native(texts)
+        fast_pairs = (self._encode_batch_native(pairs)
+                      if pairs is not None else None)
         all_ids, all_types = [], []
         for i, t in enumerate(texts):
-            ids = [self.cls_id] + self._encode_one(t) + [self.sep_id]
+            body = fast[i] if fast[i] is not None else self._encode_one(t)
+            ids = [self.cls_id] + body + [self.sep_id]
             types = [0] * len(ids)
             if pairs is not None:
-                pids = self._encode_one(pairs[i]) + [self.sep_id]
+                pbody = (fast_pairs[i] if fast_pairs[i] is not None
+                         else self._encode_one(pairs[i]))
+                pids = pbody + [self.sep_id]
                 ids += pids
                 types += [1] * len(pids)
             if max_seq_len and len(ids) > max_seq_len:

@@ -124,6 +124,15 @@ def _bind_signatures(lib: ctypes.CDLL) -> None:
                                   ctypes.c_int64, ctypes.c_int64]
     lib.shm_ring_close.argtypes = [ctypes.c_void_p]
 
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.wp_new.restype = ctypes.c_void_p
+    lib.wp_new.argtypes = [ctypes.c_char_p, i32p, ctypes.c_int32]
+    lib.wp_free.argtypes = [ctypes.c_void_p]
+    lib.wp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i32p,
+                              ctypes.c_int32, ctypes.c_int32,
+                              ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+                              ctypes.c_int32]
+
 
 def available() -> bool:
     return get_lib() is not None
