@@ -26,3 +26,7 @@ from .convnext import (  # noqa: F401
     ConvNeXt, convnext_base, convnext_large, convnext_small,
     convnext_tiny,
 )
+from .swin import (  # noqa: F401
+    SwinTransformer, swin_base_patch4_window7_224,
+    swin_small_patch4_window7_224, swin_tiny_patch4_window7_224,
+)

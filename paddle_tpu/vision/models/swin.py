@@ -1,0 +1,206 @@
+"""Swin Transformer (hierarchical windowed attention).
+
+PaddleClas-era backbone (ppcls/arch/backbone/model_zoo/swin_transformer.py).
+TPU notes: window partitioning is pure reshape/transpose (free under XLA
+layout assignment); every window attends over a FIXED w*w=49 sequence, so
+one attention shape serves all stages — no dynamic shapes, and the
+(num_windows*B, 49, C) batch keeps the MXU fed. The shifted variant is
+jnp.roll (a cheap static rotation) + an additive mask precomputed at
+build time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...ops.manipulation import roll
+
+
+def _window_partition(x, w):
+    # (B, H, W, C) -> (B*nW, w*w, C)
+    B, H, W, C = x.shape
+    x = x.reshape([B, H // w, w, W // w, w, C])
+    x = x.transpose([0, 1, 3, 2, 4, 5])
+    return x.reshape([-1, w * w, C])
+
+
+def _window_reverse(x, w, H, W):
+    B = x.shape[0] // (H * W // (w * w))
+    x = x.reshape([B, H // w, W // w, w, w, -1])
+    x = x.transpose([0, 1, 3, 2, 4, 5])
+    return x.reshape([B, H, W, -1])
+
+
+class WindowAttention(nn.Layer):
+    """MSA within one window + learned relative position bias."""
+
+    def __init__(self, dim, window, num_heads):
+        super().__init__()
+        self.dim = dim
+        self.window = window
+        self.num_heads = num_heads
+        self.scale = (dim // num_heads) ** -0.5
+        self.qkv = nn.Linear(dim, dim * 3)
+        self.proj = nn.Linear(dim, dim)
+        n = 2 * window - 1
+        self.rpb_table = self.create_parameter(
+            [n * n, num_heads],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        # pairwise relative-position index, fixed at build
+        coords = np.stack(np.meshgrid(np.arange(window), np.arange(window),
+                                      indexing="ij"))        # (2, w, w)
+        flat = coords.reshape(2, -1)                          # (2, w*w)
+        rel = flat[:, :, None] - flat[:, None, :] + window - 1
+        idx = (rel[0] * n + rel[1]).astype(np.int64)          # (w*w, w*w)
+        self.register_buffer("rpb_index", Tensor(idx.reshape(-1)))
+
+    def forward(self, x, mask=None):
+        # x: (B_, N, C) with N = window*window
+        B_, N, C = x.shape
+        h = self.num_heads
+        qkv = self.qkv(x).reshape([B_, N, 3, h, C // h])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])     # (3, B_, h, N, hd)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        attn = (q * self.scale) @ k.transpose([0, 1, 3, 2])  # (B_,h,N,N)
+        bias = self.rpb_table[self.rpb_index].reshape([N, N, h])
+        attn = attn + bias.transpose([2, 0, 1]).unsqueeze(0)
+        if mask is not None:                      # (nW, N, N) additive
+            nW = mask.shape[0]
+            attn = attn.reshape([B_ // nW, nW, h, N, N]) \
+                + mask.unsqueeze(1).unsqueeze(0)
+            attn = attn.reshape([B_, h, N, N])
+        attn = nn.functional.softmax(attn, axis=-1)
+        out = (attn @ v).transpose([0, 2, 1, 3]).reshape([B_, N, C])
+        return self.proj(out)
+
+
+class SwinBlock(nn.Layer):
+    def __init__(self, dim, input_resolution, num_heads, window=7,
+                 shift=0, mlp_ratio=4.0):
+        super().__init__()
+        self.dim = dim
+        self.resolution = input_resolution
+        if min(input_resolution) <= window:
+            window, shift = min(input_resolution), 0
+        if input_resolution[0] % window or input_resolution[1] % window:
+            raise ValueError(
+                f"Swin: feature map {input_resolution} must be divisible "
+                f"by window {window} at every stage — pick img_size/"
+                f"patch_size so each stage resolution is a multiple of "
+                f"the window (e.g. 224/4 with window 7)")
+        self.window = window
+        self.shift = shift
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn = WindowAttention(dim, window, num_heads)
+        self.norm2 = nn.LayerNorm(dim)
+        self.mlp = nn.Sequential(nn.Linear(dim, int(dim * mlp_ratio)),
+                                 nn.GELU(),
+                                 nn.Linear(int(dim * mlp_ratio), dim))
+        if shift > 0:
+            self.register_buffer("attn_mask",
+                                 Tensor(self._shift_mask()))
+        else:
+            self.attn_mask = None
+
+    def _shift_mask(self):
+        """Additive mask keeping attention within pre-shift regions
+        (-100 between tokens whose windows wrapped differently)."""
+        H, W = self.resolution
+        w, s = self.window, self.shift
+        img = np.zeros((1, H, W, 1), np.float32)
+        cnt = 0
+        for hs in (slice(0, -w), slice(-w, -s), slice(-s, None)):
+            for ws in (slice(0, -w), slice(-w, -s), slice(-s, None)):
+                img[:, hs, ws, :] = cnt
+                cnt += 1
+        win = img.reshape(1, H // w, w, W // w, w, 1)
+        win = win.transpose(0, 1, 3, 2, 4, 5).reshape(-1, w * w)
+        diff = win[:, :, None] - win[:, None, :]
+        return np.where(diff != 0, -100.0, 0.0).astype(np.float32)
+
+    def forward(self, x):
+        H, W = self.resolution
+        B, L, C = x.shape
+        shortcut = x
+        x = self.norm1(x).reshape([B, H, W, C])
+        if self.shift > 0:
+            x = roll(x, shifts=[-self.shift, -self.shift], axis=[1, 2])
+        xw = _window_partition(x, self.window)
+        xw = self.attn(xw, self.attn_mask)
+        x = _window_reverse(xw, self.window, H, W)
+        if self.shift > 0:
+            x = roll(x, shifts=[self.shift, self.shift], axis=[1, 2])
+        x = shortcut + x.reshape([B, L, C])
+        return x + self.mlp(self.norm2(x))
+
+
+class PatchMerging(nn.Layer):
+    """Downsample 2x: concat 2x2 neighborhood -> LN -> Linear(4C->2C)."""
+
+    def __init__(self, input_resolution, dim):
+        super().__init__()
+        self.resolution = input_resolution
+        self.norm = nn.LayerNorm(4 * dim)
+        self.reduction = nn.Linear(4 * dim, 2 * dim, bias_attr=False)
+
+    def forward(self, x):
+        H, W = self.resolution
+        B, L, C = x.shape
+        x = x.reshape([B, H // 2, 2, W // 2, 2, C])
+        x = x.transpose([0, 1, 3, 2, 4, 5]).reshape(
+            [B, (H // 2) * (W // 2), 4 * C])
+        return self.reduction(self.norm(x))
+
+
+class SwinTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=4, in_chans=3,
+                 class_num=1000, embed_dim=96, depths=(2, 2, 6, 2),
+                 num_heads=(3, 6, 12, 24), window=7, mlp_ratio=4.0):
+        super().__init__()
+        self.patch_embed = nn.Conv2D(in_chans, embed_dim, patch_size,
+                                     stride=patch_size)
+        res = img_size // patch_size
+        self.norm0 = nn.LayerNorm(embed_dim)
+        self.stages = nn.LayerList()
+        self.merges = nn.LayerList()
+        dim = embed_dim
+        for si, (d, h) in enumerate(zip(depths, num_heads)):
+            blocks = nn.Sequential(*[
+                SwinBlock(dim, (res, res), h, window,
+                          shift=0 if i % 2 == 0 else window // 2,
+                          mlp_ratio=mlp_ratio) for i in range(d)])
+            self.stages.append(blocks)
+            if si < len(depths) - 1:
+                self.merges.append(PatchMerging((res, res), dim))
+                dim *= 2
+                res //= 2
+        self.norm = nn.LayerNorm(dim)
+        self.head = nn.Linear(dim, class_num)
+
+    def forward(self, x):
+        x = self.patch_embed(x)                  # (B, C, H', W')
+        B, C = x.shape[0], x.shape[1]
+        x = x.reshape([B, C, -1]).transpose([0, 2, 1])
+        x = self.norm0(x)
+        for si, stage in enumerate(self.stages):
+            x = stage(x)
+            if si < len(self.merges):
+                x = self.merges[si](x)
+        x = self.norm(x).mean(axis=1)            # global pool over tokens
+        return self.head(x)
+
+
+def swin_tiny_patch4_window7_224(**kwargs):
+    return SwinTransformer(depths=(2, 2, 6, 2), num_heads=(3, 6, 12, 24),
+                           embed_dim=96, **kwargs)
+
+
+def swin_small_patch4_window7_224(**kwargs):
+    return SwinTransformer(depths=(2, 2, 18, 2), num_heads=(3, 6, 12, 24),
+                           embed_dim=96, **kwargs)
+
+
+def swin_base_patch4_window7_224(**kwargs):
+    return SwinTransformer(depths=(2, 2, 18, 2), num_heads=(4, 8, 16, 32),
+                           embed_dim=128, **kwargs)

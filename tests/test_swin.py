@@ -1,0 +1,67 @@
+"""Swin Transformer. ~ PaddleClas swin_transformer.py."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import SwinTransformer
+from paddle_tpu.vision.models.swin import (_window_partition,
+                                           _window_reverse)
+
+
+def _tiny(classes=5, img=32):
+    return SwinTransformer(img_size=img, patch_size=4, class_num=classes,
+                           embed_dim=16, depths=(2, 2), num_heads=(2, 4),
+                           window=4)
+
+
+def test_window_partition_roundtrip():
+    x = paddle.randn([2, 8, 8, 3])
+    w = _window_partition(x, 4)
+    assert w.shape == [2 * 4, 16, 3]
+    back = _window_reverse(w, 4, 8, 8)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+def test_forward_shape_and_shift_mask():
+    net = _tiny()
+    net.eval()
+    out = net(paddle.randn([2, 3, 32, 32]))
+    assert out.shape == [2, 5]
+    assert np.isfinite(out.numpy()).all()
+    # stage 1's second block is shifted with a precomputed additive
+    # mask; stage 2's window covers the whole 4x4 map so its shift
+    # correctly degrades to 0
+    shifted = net.stages[0][1]
+    assert shifted.shift == 2
+    m = shifted.attn_mask.numpy()
+    assert set(np.unique(m)) == {-100.0, 0.0}
+    assert net.stages[1][1].shift == 0
+
+
+def test_hierarchy_dims():
+    net = _tiny()
+    # after one merge: dim doubles, resolution halves
+    assert net.stages[0][0].dim == 16
+    assert net.stages[1][0].dim == 32
+    assert net.stages[1][0].resolution == (4, 4)
+
+
+def test_train_step_learns():
+    paddle.seed(0)
+    net = _tiny(classes=3)
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    temp = rng.normal(0, 1, (3, 3, 32, 32)).astype(np.float32)
+    y = rng.integers(0, 3, 18)
+    x = (temp[y] + 0.1 * rng.normal(0, 1, (18, 3, 32, 32))
+         ).astype(np.float32)
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y.astype(np.int64))
+    first = None
+    for _ in range(10):
+        loss = paddle.nn.functional.cross_entropy(net(xt), yt)
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first * 0.6, (first, float(loss))
