@@ -27,6 +27,7 @@ QUEUE = [
     ("decode_b64_int8", [sys.executable, "tools/ladder_bench.py", "6"],
      {"LADDER_DECODE_B": "64", "LADDER_DECODE_WEIGHTS": "int8"}),
     ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
+    ("vit_train", [sys.executable, "tools/ladder_bench.py", "7"], {}),
 ]
 
 

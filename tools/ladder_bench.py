@@ -7,10 +7,14 @@ and prints one JSON line per config:
   3 BERT-base pretrain -> tokens/sec
   4 Llama train step   -> MFU (delegates to bench.py's model/config)
   5 MoE decoder        -> tokens/sec
+  6 Llama KV-cache decode -> tokens/sec (env LADDER_DECODE_B batch,
+    LADDER_DECODE_WEIGHTS=int8 for quantized weights)
+  7 ViT-Base/16 train  -> images/sec
 
 On CPU the model sizes shrink to keep the run under a few minutes while
 exercising the exact same code paths; on a real TPU chip the full-size
-configs run. Usage: python tools/ladder_bench.py [1 2 3 4 5]
+configs run. Usage: python tools/ladder_bench.py [1 2 3 5 6 7]
+(no args = configs 1,2,3,5,6).
 """
 from __future__ import annotations
 
@@ -236,6 +240,59 @@ def bench_decode(on_tpu):
             "weights": weight_dtype or "bf16"}
 
 
+def bench_vit(on_tpu):
+    """Config 7 (exceeds the ladder): ViT-Base/16 training images/sec —
+    the PaddleClas transformer-backbone analog; pure MXU matmuls."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.vision.models import (VisionTransformer,
+                                          vit_base_patch16_224)
+
+    paddle.seed(0)
+    if on_tpu:
+        model = vit_base_patch16_224()
+        B, HW, steps = 64, 224, 10
+        model.to(dtype="bfloat16")
+    else:
+        model = VisionTransformer(img_size=32, patch_size=8, class_num=10,
+                                  embed_dim=48, depth=2, num_heads=4)
+        B, HW, steps = 4, 32, 3
+    model.train()
+    params = model.tree_flatten_params()
+
+    def loss_fn(params, x, y):
+        model.load_tree(params)
+        logits = model(Tensor(x))._value.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(logp, y[:, None], -1).mean()
+
+    @jax.jit
+    def step(params, x, y, lr):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        return ({k: p - lr * g[k].astype(p.dtype)
+                 for k, p in params.items()}, loss)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (B, 3, HW, HW)),
+                    jnp.bfloat16 if on_tpu else jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, B), jnp.int32)
+    params, loss = step(params, x, y, 1e-3)
+    float(loss)  # host readback = the only real sync under axon
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = step(params, x, y, 1e-3)
+    lv = float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    return {"metric": "vit_train_images_per_sec",
+            "value": round(B / dt, 1), "unit": "images/sec",
+            "batch": B, "hw": HW, "loss": round(lv, 4)}
+
+
 def main():
     want = set(sys.argv[1:]) or {"1", "2", "3", "5", "6"}
     backend = _backend()
@@ -244,7 +301,8 @@ def main():
                "2": lambda: bench_resnet50(on_tpu),
                "3": lambda: bench_bert(on_tpu),
                "5": lambda: bench_moe(on_tpu),
-               "6": lambda: bench_decode(on_tpu)}
+               "6": lambda: bench_decode(on_tpu),
+               "7": lambda: bench_vit(on_tpu)}
     if "4" in want:
         print(json.dumps({"metric": "llama_train_mfu",
                           "note": "run bench.py (the driver entry)"}))
