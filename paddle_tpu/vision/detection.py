@@ -1,0 +1,253 @@
+"""SSD/RetinaNet-era detection ops.
+
+~ python/paddle/fluid/layers/detection.py (prior_box:1778,
+anchor_generator:2413, box_coder:819, iou_similarity:765, box_clip:3057,
+multiclass_nms:3276) and their C++ ops under
+paddle/fluid/operators/detection/. TPU-shaped where it matters:
+prior/anchor generation and box coding are pure array math (jit-able,
+static shapes); multiclass_nms returns FIXED-size keep_top_k-padded
+results (label -1 padding) instead of the reference's LoD
+variable-length outputs — the standard accelerator-side detection
+post-processing contract.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .ops import box_iou
+
+
+def _arr(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+def iou_similarity(x, y, box_normalized: bool = True):
+    """(N,4) x (M,4) -> (N,M) IoU. ~ detection.py:765."""
+    xa, ya = _arr(x).astype(np.float32), _arr(y).astype(np.float32)
+    if not box_normalized:
+        # unnormalized boxes count the boundary pixel (w = x2-x1+1)
+        xa = xa.copy()
+        ya = ya.copy()
+        xa[:, 2:] += 1.0
+        ya[:, 2:] += 1.0
+    return Tensor(_arr(box_iou(Tensor(xa), Tensor(ya))))
+
+
+def box_clip(input, im_info):
+    """Clip (…,4) boxes to the ORIGINAL image extent. ~ detection.py:3057
+    / box_clip_op.h: im_info is (H, W, scale) of the network input, and
+    boxes clip to [0, round(W/scale)-1] x [0, round(H/scale)-1]."""
+    b = _arr(input).astype(np.float32)
+    info = _arr(im_info).astype(np.float32).reshape(-1)
+    scale = info[2] if info.size > 2 and info[2] > 0 else 1.0
+    hmax = np.round(info[0] / scale) - 1.0
+    wmax = np.round(info[1] / scale) - 1.0
+    out = b.copy()
+    out[..., 0::2] = np.clip(b[..., 0::2], 0.0, wmax)
+    out[..., 1::2] = np.clip(b[..., 1::2], 0.0, hmax)
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0):
+    """SSD box encode/decode. ~ detection.py:819 / box_coder_op.cc.
+
+    encode: target (N,4) corners vs priors (M,4) -> (N,M,4) offsets.
+    decode: target (N,M,4) offsets + priors -> (N,M,4) corners
+    (axis=0: priors broadcast over rows; axis=1: over columns).
+    """
+    p = _arr(prior_box).astype(np.float32)
+    t = _arr(target_box).astype(np.float32)
+    pv = (None if prior_box_var is None
+          else np.broadcast_to(_arr(prior_box_var).astype(np.float32),
+                               p.shape))
+    norm = 0.0 if box_normalized else 1.0
+    pw = p[:, 2] - p[:, 0] + norm
+    ph = p[:, 3] - p[:, 1] + norm
+    pcx = p[:, 0] + pw * 0.5
+    pcy = p[:, 1] + ph * 0.5
+    if code_type.startswith("encode"):
+        tw = t[:, 2] - t[:, 0] + norm
+        th = t[:, 3] - t[:, 1] + norm
+        tcx = t[:, 0] + tw * 0.5
+        tcy = t[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = np.log(np.maximum(tw[:, None] / pw[None, :], 1e-10))
+        oh = np.log(np.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = np.stack([ox, oy, ow, oh], -1)  # (N, M, 4)
+        if pv is not None:
+            out = out / pv[None, :, :]
+        return Tensor(out.astype(np.float32))
+    # decode
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (a[None, :] for a in (pw, ph, pcx, pcy))
+        pv_ = None if pv is None else pv[None, :, :]
+    else:
+        pw_, ph_, pcx_, pcy_ = (a[:, None] for a in (pw, ph, pcx, pcy))
+        pv_ = None if pv is None else pv[:, None, :]
+    d = t if pv_ is None else t * pv_
+    cx = d[..., 0] * pw_ + pcx_
+    cy = d[..., 1] * ph_ + pcy_
+    w = np.exp(d[..., 2]) * pw_
+    h = np.exp(d[..., 3]) * ph_
+    out = np.stack([cx - w * 0.5, cy - h * 0.5,
+                    cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+    return Tensor(out.astype(np.float32))
+
+
+def prior_box(input, image, min_sizes: Sequence[float],
+              max_sizes: Optional[Sequence[float]] = None,
+              aspect_ratios: Sequence[float] = (1.0,),
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+              flip: bool = False, clip: bool = False,
+              steps: Sequence[float] = (0.0, 0.0), offset: float = 0.5,
+              min_max_aspect_ratios_order: bool = False):
+    """SSD prior boxes over a feature map. ~ detection.py:1778 /
+    prior_box_op.cc. Returns (boxes (H,W,P,4), variances (H,W,P,4)),
+    normalized corner form."""
+    fm = _arr(input)
+    img = _arr(image)
+    H, W = fm.shape[2], fm.shape[3]
+    ih, iw = float(img.shape[2]), float(img.shape[3])
+    step_h = steps[1] if steps[1] > 0 else ih / H
+    step_w = steps[0] if steps[0] > 0 else iw / W
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs: List = []  # (w, h) per prior, in pixels
+    for i, ms in enumerate(float(m) for m in min_sizes):
+        sq = (np.sqrt(ms * float(max_sizes[i])),) * 2 if max_sizes \
+            else None
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if sq:
+                whs.append(sq)
+            whs.extend((ms * np.sqrt(ar), ms / np.sqrt(ar))
+                       for ar in ars if abs(ar - 1.0) >= 1e-6)
+        else:
+            whs.extend((ms * np.sqrt(ar), ms / np.sqrt(ar))
+                       for ar in ars)
+            if sq:
+                whs.append(sq)
+    P = len(whs)
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)                      # (H, W)
+    wh = np.asarray(whs, np.float32)                    # (P, 2)
+    boxes = np.empty((H, W, P, 4), np.float32)
+    boxes[..., 0] = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / iw
+    boxes[..., 1] = (cyg[:, :, None] - wh[None, None, :, 1] / 2) / ih
+    boxes[..., 2] = (cxg[:, :, None] + wh[None, None, :, 0] / 2) / iw
+    boxes[..., 3] = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(boxes), Tensor(var)
+
+
+def anchor_generator(input, anchor_sizes: Sequence[float],
+                     aspect_ratios: Sequence[float],
+                     variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                     stride: Sequence[float] = (16.0, 16.0),
+                     offset: float = 0.5):
+    """RPN anchors over a feature map (pixel coords, unnormalized).
+    ~ detection.py:2413 / anchor_generator_op.cc. Returns
+    (anchors (H,W,A,4), variances (H,W,A,4))."""
+    fm = _arr(input)
+    H, W = fm.shape[2], fm.shape[3]
+    whs = []
+    for s in anchor_sizes:
+        area = float(s) * float(s)
+        for ar in aspect_ratios:
+            w = np.sqrt(area / ar)
+            whs.append((w, w * ar))
+    A = len(whs)
+    cx = (np.arange(W, dtype=np.float32) + offset) * stride[0]
+    cy = (np.arange(H, dtype=np.float32) + offset) * stride[1]
+    cxg, cyg = np.meshgrid(cx, cy)
+    wh = np.asarray(whs, np.float32)
+    anchors = np.empty((H, W, A, 4), np.float32)
+    anchors[..., 0] = cxg[:, :, None] - wh[None, None, :, 0] / 2
+    anchors[..., 1] = cyg[:, :, None] - wh[None, None, :, 1] / 2
+    anchors[..., 2] = cxg[:, :, None] + wh[None, None, :, 0] / 2
+    anchors[..., 3] = cyg[:, :, None] + wh[None, None, :, 1] / 2
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          anchors.shape).copy()
+    return Tensor(anchors), Tensor(var)
+
+
+def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
+                   nms_top_k: int = 400, keep_top_k: int = 100,
+                   nms_threshold: float = 0.3, normalized: bool = True,
+                   nms_eta: float = 1.0, background_label: int = 0):
+    """Per-class NMS + cross-class keep_top_k. ~ detection.py:3276 /
+    multiclass_nms_op.cc — with the TPU-side contract: FIXED-size
+    outputs padded to keep_top_k per image.
+
+    bboxes (N, M, 4), scores (N, C, M) ->
+      out (N, keep_top_k, 6) rows [label, score, x1, y1, x2, y2]
+      (label -1 on padding), valid counts (N,) int32.
+    """
+    b = _arr(bboxes).astype(np.float32)
+    s = _arr(scores).astype(np.float32)
+    N, C, M = s.shape
+    norm = 0.0 if normalized else 1.0
+
+    def _class_nms(boxes, sc):
+        """Greedy NMS with the reference's normalized (+1 width) and
+        nms_eta (adaptive threshold decay) semantics."""
+        order = np.argsort(-sc)
+        areas = ((boxes[:, 2] - boxes[:, 0] + norm)
+                 * (boxes[:, 3] - boxes[:, 1] + norm))
+        keep, suppressed = [], np.zeros(len(boxes), bool)
+        th = nms_threshold
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(int(i))
+            xx1 = np.maximum(boxes[i, 0], boxes[:, 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[:, 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[:, 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[:, 3])
+            inter = (np.clip(xx2 - xx1 + norm, 0, None)
+                     * np.clip(yy2 - yy1 + norm, 0, None))
+            iou = inter / (areas[i] + areas - inter + 1e-10)
+            suppressed |= iou > th
+            if nms_eta < 1.0 and th > 0.5:
+                th *= nms_eta
+        return keep
+
+    out = np.full((N, int(keep_top_k), 6), -1.0, np.float32)
+    counts = np.zeros((N,), np.int32)
+    for n in range(N):
+        dets = []  # (label, score, box)
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = s[n, c] > score_threshold
+            if not mask.any():
+                continue
+            idx = np.nonzero(mask)[0]
+            if nms_top_k > 0 and len(idx) > nms_top_k:
+                idx = idx[np.argsort(-s[n, c, idx])[:nms_top_k]]
+            for k in _class_nms(b[n, idx], s[n, c, idx]):
+                dets.append((c, s[n, c, idx[k]], b[n, idx[k]]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:int(keep_top_k)]
+        for r, (c, sc, box) in enumerate(dets):
+            out[n, r, 0] = c
+            out[n, r, 1] = sc
+            out[n, r, 2:] = box
+        counts[n] = len(dets)
+    return Tensor(out), Tensor(counts)

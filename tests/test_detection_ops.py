@@ -1,0 +1,125 @@
+"""SSD/RPN detection ops vs hand-computed oracles.
+
+~ fluid/layers/detection.py (prior_box, anchor_generator, box_coder,
+iou_similarity, box_clip, multiclass_nms) and unittests
+test_prior_box_op.py / test_box_coder_op.py / test_multiclass_nms_op.py.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.detection import (anchor_generator, box_clip,
+                                         box_coder, iou_similarity,
+                                         multiclass_nms, prior_box)
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [10, 10, 12, 12]], np.float32)
+    iou = iou_similarity(x, y).numpy()
+    np.testing.assert_allclose(iou[0], [1.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, atol=1e-6)
+
+
+def test_box_clip():
+    boxes = np.array([[-5, -5, 20, 20], [2, 3, 4, 5]], np.float32)
+    out = box_clip(boxes, np.array([10.0, 8.0, 1.0])).numpy()
+    np.testing.assert_allclose(out[0], [0, 0, 7, 9])  # W-1=7, H-1=9
+    np.testing.assert_allclose(out[1], [2, 3, 4, 5])
+    # scale: network input 20x16 at scale 2 -> original 10x8 extent
+    out = box_clip(boxes, np.array([20.0, 16.0, 2.0])).numpy()
+    np.testing.assert_allclose(out[0], [0, 0, 7, 9])
+
+
+def test_multiclass_nms_unnormalized_iou():
+    """normalized=False counts the boundary pixel in IoU (reference
+    multiclass_nms_op): two abutting 2-px boxes overlap by 1/3 then."""
+    boxes = np.array([[[0, 0, 1, 1], [1, 0, 2, 1]]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.9, 0.8]
+    # normalized: IoU = 0 -> both kept
+    _, counts = multiclass_nms(boxes, scores, score_threshold=0.1,
+                               nms_threshold=0.3)
+    assert int(counts.numpy()[0]) == 2
+    # unnormalized: IoU = 2/6 = 0.33 > 0.3 -> second suppressed
+    _, counts = multiclass_nms(boxes, scores, score_threshold=0.1,
+                               nms_threshold=0.3, normalized=False)
+    assert int(counts.numpy()[0]) == 1
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 10]], np.float32)
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+    targets = np.array([[1, 1, 5, 5], [0, 0, 6, 8]], np.float32)
+    enc = box_coder(priors, pvar, targets, "encode_center_size").numpy()
+    assert enc.shape == (2, 2, 4)
+    # decode(encode(x)) == x, per prior column
+    dec = box_coder(priors, pvar, enc, "decode_center_size").numpy()
+    for j in range(2):
+        np.testing.assert_allclose(dec[:, j], targets, rtol=1e-4,
+                                   atol=1e-4)
+    # hand oracle for target 0 vs prior 0 (no variance)
+    e = box_coder(priors, None, targets, "encode_center_size").numpy()
+    # prior0: c=(2,2) wh=(4,4); target0: c=(3,3) wh=(4,4)
+    np.testing.assert_allclose(e[0, 0], [0.25, 0.25, 0.0, 0.0],
+                               atol=1e-6)
+
+
+def test_prior_box_shapes_and_values():
+    fm = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 8, 8), np.float32)
+    boxes, var = prior_box(fm, img, min_sizes=[4.0], max_sizes=[6.0],
+                           aspect_ratios=[2.0], clip=True)
+    # priors: ar1 + ar2 + sqrt(min*max) = 3
+    assert boxes.shape == [2, 2, 3, 4]
+    b = boxes.numpy()
+    # first cell center = (0.5*4, 0.5*4) = (2,2); ar=1 prior is
+    # 4x4 px -> normalized [0, 0, 0.5, 0.5]
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 0.5, 0.5], atol=1e-6)
+    assert (b >= 0).all() and (b <= 1).all()  # clip
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_centers():
+    fm = np.zeros((1, 8, 2, 3), np.float32)
+    anchors, var = anchor_generator(fm, anchor_sizes=[32.0],
+                                    aspect_ratios=[1.0],
+                                    stride=[16.0, 16.0])
+    assert anchors.shape == [2, 3, 1, 4]
+    a = anchors.numpy()
+    # cell (0,0): center (8,8), 32x32 anchor
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24])
+    # x stride moves the center by 16
+    np.testing.assert_allclose(a[0, 1, 0], [8, -8, 40, 24])
+
+
+def test_multiclass_nms_padded():
+    # 1 image, 2 classes (0 = background), 4 boxes
+    boxes = np.array([[[0, 0, 4, 4], [0, 0, 4.1, 4.1],
+                       [10, 10, 14, 14], [20, 20, 22, 22]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.8, 0.05]
+    out, counts = multiclass_nms(boxes, scores, score_threshold=0.1,
+                                 nms_threshold=0.5, keep_top_k=10)
+    assert out.shape == [1, 10, 6]
+    assert int(counts.numpy()[0]) == 2  # overlap suppressed, 0.05 cut
+    o = out.numpy()[0]
+    assert o[0, 0] == 1 and abs(o[0, 1] - 0.9) < 1e-6
+    np.testing.assert_allclose(o[1, 2:], [10, 10, 14, 14])
+    assert (o[2:, 0] == -1).all()  # padding rows
+
+
+def test_multiclass_nms_batch_and_topk():
+    rng = np.random.default_rng(0)
+    boxes = np.broadcast_to(
+        rng.uniform(0, 10, (1, 8, 4)).astype(np.float32),
+        (2, 8, 4)).copy()
+    boxes[..., 2:] = boxes[..., :2] + 1.0  # valid 1x1 boxes
+    scores = rng.uniform(0.2, 1.0, (2, 3, 8)).astype(np.float32)
+    out, counts = multiclass_nms(boxes, scores, keep_top_k=3,
+                                 score_threshold=0.1)
+    assert out.shape == [2, 3, 6]
+    assert (counts.numpy() <= 3).all() and (counts.numpy() > 0).all()
+    # rows sorted by score within each image
+    for n in range(2):
+        s = out.numpy()[n, :counts.numpy()[n], 1]
+        assert (np.diff(s) <= 1e-6).all()
