@@ -187,6 +187,121 @@ def anchor_generator(input, anchor_sizes: Sequence[float],
     return Tensor(anchors), Tensor(var)
 
 
+def bipartite_match(dist_matrix, match_type: str = "bipartite",
+                    dist_threshold: float = 0.5):
+    """Greedy bipartite matching. ~ detection.py:1331 /
+    bipartite_match_op.cc. dist_matrix (G, P): similarity of each
+    ground-truth row to each prior column. Returns
+    (match_indices (P,) int32 — gt index per prior or -1,
+     match_dist (P,) f32).
+
+    'bipartite': iteratively take the global argmax, retiring its row
+    and column (each gt matches its best unclaimed prior).
+    'per_prediction': additionally match every unmatched prior to its
+    best gt when that similarity > dist_threshold (the SSD recipe).
+    """
+    d = _arr(dist_matrix).astype(np.float32).copy()
+    G, P = d.shape
+    match_idx = np.full((P,), -1, np.int32)
+    match_dist = np.zeros((P,), np.float32)
+    if G == 0:  # no ground truth: nothing matches (negatives-only image)
+        return Tensor(match_idx), Tensor(match_dist)
+    work = d.copy()
+    for _ in range(min(G, P)):
+        g, p = np.unravel_index(np.argmax(work), work.shape)
+        if work[g, p] <= 0:
+            break
+        match_idx[p] = g
+        match_dist[p] = d[g, p]
+        work[g, :] = -1.0
+        work[:, p] = -1.0
+    if match_type == "per_prediction":
+        best_gt = np.argmax(d, axis=0)
+        best_dist = d[best_gt, np.arange(P)]
+        extra = (match_idx < 0) & (best_dist > dist_threshold)
+        match_idx[extra] = best_gt[extra]
+        match_dist[extra] = best_dist[extra]
+    return Tensor(match_idx), Tensor(match_dist)
+
+
+def target_assign(input, match_indices, mismatch_value=0):
+    """Scatter per-gt rows to priors by match index.
+    ~ detection.py:1421 / target_assign_op.h. input (G, K),
+    match_indices (P,) -> (out (P, K), weight (P, 1)); unmatched priors
+    get mismatch_value with weight 0."""
+    x = _arr(input).astype(np.float32)
+    mi = _arr(match_indices).astype(np.int64)
+    P = mi.shape[0]
+    out = np.full((P, x.shape[1]), float(mismatch_value), np.float32)
+    w = np.zeros((P, 1), np.float32)
+    matched = mi >= 0
+    out[matched] = x[mi[matched]]
+    w[matched] = 1.0
+    return Tensor(out), Tensor(w)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label: int = 0,
+             overlap_threshold: float = 0.5, neg_pos_ratio: float = 3.0,
+             loc_loss_weight: float = 1.0, conf_loss_weight: float = 1.0):
+    """The SSD multibox training loss for ONE image. ~ detection.py:1527
+    / the MultiBoxLoss recipe: per_prediction matching, localization
+    smooth-L1 on matched priors against box_coder-encoded offsets, and
+    softmax confidence loss with 3:1 hard negative mining.
+
+    location (P, 4) predicted offsets; confidence (P, C) logits;
+    gt_box (G, 4); gt_label (G,) int (values in [1, C));
+    prior_box (P, 4), prior_box_var (P, 4) or None. Returns scalar
+    Tensor (differentiable through location/confidence).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply_op
+
+    pb = _arr(prior_box).astype(np.float32)
+    gtb = _arr(gt_box).astype(np.float32)
+    gtl = _arr(gt_label).astype(np.int64).reshape(-1)
+    P = pb.shape[0]
+
+    # host-side matching + target construction (no gradients flow here)
+    iou = _arr(iou_similarity(gtb, pb))                     # (G, P)
+    mi, _ = bipartite_match(iou, "per_prediction", overlap_threshold)
+    mi = _arr(mi)
+    enc = _arr(box_coder(pb, prior_box_var, gtb,
+                         "encode_center_size"))             # (G, P, 4)
+    matched = mi >= 0
+    loc_target = np.zeros((P, 4), np.float32)
+    loc_target[matched] = enc[mi[matched], np.arange(P)[matched]]
+    conf_target = np.full((P,), background_label, np.int64)
+    conf_target[matched] = gtl[mi[matched]]
+    n_pos = max(int(matched.sum()), 1)
+    n_neg_keep = int(min(neg_pos_ratio * n_pos, P - n_pos))
+
+    def fused(loc, conf, loc_t, conf_t, pos_mask):
+        logp = jax.nn.log_softmax(conf.astype(jnp.float32), -1)
+        ce = -jnp.take_along_axis(logp, conf_t[:, None], -1)[:, 0]  # (P,)
+        # hard negative mining: EXACTLY the top-k background CE among
+        # negatives (a >=-threshold rule would keep every tied negative
+        # — with a fresh zero-init head that is ALL of them)
+        neg_ce = jnp.where(pos_mask, -jnp.inf, ce)
+        if n_neg_keep > 0:
+            _, neg_idx = jax.lax.top_k(neg_ce, n_neg_keep)
+            neg_keep = jnp.zeros_like(pos_mask).at[neg_idx].set(True)
+        else:
+            neg_keep = jnp.zeros_like(pos_mask)
+        conf_loss = jnp.sum(jnp.where(pos_mask | neg_keep, ce, 0.0))
+        diff = jnp.abs((loc - loc_t).astype(jnp.float32))
+        sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+        loc_loss = jnp.sum(jnp.where(pos_mask[:, None], sl1, 0.0))
+        return ((conf_loss_weight * conf_loss
+                 + loc_loss_weight * loc_loss) / n_pos)
+
+    return apply_op("ssd_loss", fused, location, confidence,
+                    Tensor(loc_target), Tensor(conf_target),
+                    Tensor(matched))
+
+
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
                    nms_top_k: int = 400, keep_top_k: int = 100,
                    nms_threshold: float = 0.3, normalized: bool = True,

@@ -108,6 +108,62 @@ def test_multiclass_nms_padded():
     assert (o[2:, 0] == -1).all()  # padding rows
 
 
+def test_bipartite_match():
+    from paddle_tpu.vision.detection import bipartite_match
+    d = np.array([[0.9, 0.1, 0.6],
+                  [0.2, 0.8, 0.7]], np.float32)
+    mi, md = bipartite_match(d)
+    np.testing.assert_array_equal(mi.numpy(), [0, 1, -1])
+    np.testing.assert_allclose(md.numpy(), [0.9, 0.8, 0.0])
+    # per_prediction: prior 2's best gt (1, 0.7) clears the threshold
+    mi2, md2 = bipartite_match(d, "per_prediction", 0.5)
+    np.testing.assert_array_equal(mi2.numpy(), [0, 1, 1])
+    np.testing.assert_allclose(md2.numpy(), [0.9, 0.8, 0.7])
+
+
+def test_target_assign():
+    from paddle_tpu.vision.detection import target_assign
+    gt = np.array([[1, 2], [3, 4]], np.float32)
+    out, w = target_assign(gt, np.array([1, -1, 0], np.int32))
+    np.testing.assert_allclose(out.numpy(), [[3, 4], [0, 0], [1, 2]])
+    np.testing.assert_allclose(w.numpy()[:, 0], [1, 0, 1])
+
+
+def test_ssd_loss_learns():
+    """The full multibox loss trains a toy head toward the targets."""
+    from paddle_tpu.vision.detection import anchor_generator, ssd_loss
+    paddle.seed(0)
+    fm = np.zeros((1, 8, 4, 4), np.float32)
+    priors, _ = anchor_generator(fm, anchor_sizes=[8.0],
+                                 aspect_ratios=[1.0], stride=[8.0, 8.0])
+    priors = priors.numpy().reshape(-1, 4)
+    P = len(priors)
+    gt_box = np.array([[6, 6, 14, 14]], np.float32)  # near one anchor
+    gt_label = np.array([1], np.int64)
+    from paddle_tpu import nn
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.loc = self.create_parameter(
+                [P, 4], default_initializer=nn.initializer.Constant(0.0))
+            self.conf = self.create_parameter(
+                [P, 3], default_initializer=nn.initializer.Constant(0.0))
+
+    head = Head()
+    opt = paddle.optimizer.Adam(parameters=head.parameters(),
+                                learning_rate=0.1)
+    first = None
+    for _ in range(15):
+        loss = ssd_loss(head.loc, head.conf, gt_box, gt_label, priors)
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first * 0.5, (first, float(loss))
+
+
 def test_multiclass_nms_batch_and_topk():
     rng = np.random.default_rng(0)
     boxes = np.broadcast_to(
