@@ -187,6 +187,35 @@ def anchor_generator(input, anchor_sizes: Sequence[float],
     return Tensor(anchors), Tensor(var)
 
 
+def _greedy_nms(boxes, scores, thresh, norm, eta, max_keep=None):
+    """Shared greedy suppression: ``norm`` 1.0 = the reference's
+    unnormalized (+1 pixel) convention, 0.0 = normalized; ``eta`` < 1
+    decays the threshold adaptively while it stays > 0.5. ``boxes``
+    must be score-ordered already when scores is None."""
+    order = np.arange(len(boxes)) if scores is None \
+        else np.argsort(-scores)
+    areas = ((boxes[:, 2] - boxes[:, 0] + norm)
+             * (boxes[:, 3] - boxes[:, 1] + norm))
+    keep, suppressed, th = [], np.zeros(len(boxes), bool), thresh
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        if max_keep is not None and len(keep) >= max_keep:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = (np.clip(xx2 - xx1 + norm, 0, None)
+                 * np.clip(yy2 - yy1 + norm, 0, None))
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > th
+        if eta < 1.0 and th > 0.5:
+            th *= eta
+    return keep
+
+
 def bipartite_match(dist_matrix, match_type: str = "bipartite",
                     dist_threshold: float = 0.5):
     """Greedy bipartite matching. ~ detection.py:1331 /
@@ -302,6 +331,87 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                     Tensor(matched))
 
 
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n: int = 6000,
+                       post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0, return_rois_num: bool = False):
+    """Faster-RCNN RPN proposals. ~ detection.py:2908 /
+    generate_proposals_op.cc: decode RPN deltas against anchors, clip to
+    the network input, drop tiny boxes, per-image top-pre_nms_top_n +
+    NMS. TPU-side contract: rois come back FIXED-size
+    (N, post_nms_top_n, 4) zero-padded with per-image counts.
+
+    scores (N, A, H, W); bbox_deltas (N, 4A, H, W); im_info (N, 3)
+    rows (H_in, W_in, scale); anchors/variances (H, W, A, 4) unnormalized
+    corner form (anchor_generator output).
+    """
+    sc = _arr(scores).astype(np.float32)
+    bd = _arr(bbox_deltas).astype(np.float32)
+    info = _arr(im_info).astype(np.float32).reshape(-1, 3)
+    an = _arr(anchors).astype(np.float32).reshape(-1, 4)
+    var = _arr(variances).astype(np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    K = post_nms_top_n
+    rois = np.zeros((N, K, 4), np.float32)
+    counts = np.zeros((N,), np.int32)
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # (H*W*A,)
+        d = bd[n].reshape(A, 4, H, W).transpose(
+            2, 3, 0, 1).reshape(-1, 4)                    # (H*W*A, 4)
+        # decode (box_coder decode semantics, one delta per anchor)
+        dec = np.array(_arr(box_coder(an, var, d[:, None, :],
+                                      "decode_center_size", axis=1))[:, 0])
+        hmax, wmax = info[n, 0] - 1.0, info[n, 1] - 1.0
+        dec[:, 0::2] = np.clip(dec[:, 0::2], 0.0, wmax)
+        dec[:, 1::2] = np.clip(dec[:, 1::2], 0.0, hmax)
+        ms = max(min_size, 1.0) * (info[n, 2] if info[n, 2] > 0 else 1.0)
+        wh = dec[:, 2:] - dec[:, :2] + 1.0
+        valid = (wh >= ms).all(axis=1)
+        idx = np.nonzero(valid)[0]
+        if len(idx) == 0:
+            continue
+        order = idx[np.argsort(-s[idx])][:int(pre_nms_top_n)]
+        boxes = dec[order]  # score-sorted already
+        keep = _greedy_nms(boxes, None, nms_thresh, 1.0, eta,
+                           max_keep=K)
+        rois[n, :len(keep)] = boxes[keep]
+        counts[n] = len(keep)
+    # return_rois_num kept for signature parity; the fixed-size contract
+    # always needs the counts, so both forms return them
+    return Tensor(rois), Tensor(counts)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: float,
+                             rois_num=None):
+    """Assign RoIs to FPN levels by scale. ~ detection.py (fluid
+    distribute_fpn_proposals / distribute_fpn_proposals_op.cc):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)),
+    clamped to [min_level, max_level]. Returns (list of per-level RoI
+    arrays, restore_index (R,) mapping concatenated-level order back to
+    the input order)."""
+    if rois_num is not None:
+        raise NotImplementedError(
+            "distribute_fpn_proposals: batched rois_num is not supported"
+            " — call per image (generate_proposals' fixed-size output "
+            "makes per-image slicing trivial)")
+    r = _arr(fpn_rois).astype(np.float32).reshape(-1, 4)
+    w = np.maximum(r[:, 2] - r[:, 0], 0.0)
+    h = np.maximum(r[:, 3] - r[:, 1], 0.0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(refer_level + np.log2(
+        np.maximum(scale, 1e-6) / refer_scale))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, order = [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        outs.append(Tensor(r[idx]))
+        order.append(idx)
+    restore = np.argsort(np.concatenate(order))
+    return outs, Tensor(restore.astype(np.int64))
+
+
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
                    nms_top_k: int = 400, keep_top_k: int = 100,
                    nms_threshold: float = 0.3, normalized: bool = True,
@@ -319,30 +429,6 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
     N, C, M = s.shape
     norm = 0.0 if normalized else 1.0
 
-    def _class_nms(boxes, sc):
-        """Greedy NMS with the reference's normalized (+1 width) and
-        nms_eta (adaptive threshold decay) semantics."""
-        order = np.argsort(-sc)
-        areas = ((boxes[:, 2] - boxes[:, 0] + norm)
-                 * (boxes[:, 3] - boxes[:, 1] + norm))
-        keep, suppressed = [], np.zeros(len(boxes), bool)
-        th = nms_threshold
-        for i in order:
-            if suppressed[i]:
-                continue
-            keep.append(int(i))
-            xx1 = np.maximum(boxes[i, 0], boxes[:, 0])
-            yy1 = np.maximum(boxes[i, 1], boxes[:, 1])
-            xx2 = np.minimum(boxes[i, 2], boxes[:, 2])
-            yy2 = np.minimum(boxes[i, 3], boxes[:, 3])
-            inter = (np.clip(xx2 - xx1 + norm, 0, None)
-                     * np.clip(yy2 - yy1 + norm, 0, None))
-            iou = inter / (areas[i] + areas - inter + 1e-10)
-            suppressed |= iou > th
-            if nms_eta < 1.0 and th > 0.5:
-                th *= nms_eta
-        return keep
-
     out = np.full((N, int(keep_top_k), 6), -1.0, np.float32)
     counts = np.zeros((N,), np.int32)
     for n in range(N):
@@ -356,7 +442,8 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
             idx = np.nonzero(mask)[0]
             if nms_top_k > 0 and len(idx) > nms_top_k:
                 idx = idx[np.argsort(-s[n, c, idx])[:nms_top_k]]
-            for k in _class_nms(b[n, idx], s[n, c, idx]):
+            for k in _greedy_nms(b[n, idx], s[n, c, idx], nms_threshold,
+                                 norm, nms_eta):
                 dets.append((c, s[n, c, idx[k]], b[n, idx[k]]))
         dets.sort(key=lambda d: -d[1])
         dets = dets[:int(keep_top_k)]

@@ -164,6 +164,47 @@ def test_ssd_loss_learns():
     assert float(loss) < first * 0.5, (first, float(loss))
 
 
+def test_generate_proposals():
+    from paddle_tpu.vision.detection import (anchor_generator,
+                                             generate_proposals)
+    rng = np.random.default_rng(0)
+    H = W = 4
+    A = 2
+    fm = np.zeros((1, 8, H, W), np.float32)
+    anchors, var = anchor_generator(fm, anchor_sizes=[16.0],
+                                    aspect_ratios=[1.0, 2.0],
+                                    stride=[8.0, 8.0])
+    scores = rng.uniform(0, 1, (1, A, H, W)).astype(np.float32)
+    deltas = np.zeros((1, 4 * A, H, W), np.float32)  # boxes = anchors
+    rois, n = generate_proposals(scores, deltas,
+                                 np.array([[32.0, 32.0, 1.0]]),
+                                 anchors, var, post_nms_top_n=10,
+                                 nms_thresh=0.7, min_size=1.0)
+    assert rois.shape == [1, 10, 4]
+    cnt = int(n.numpy()[0])
+    assert 0 < cnt <= 10
+    r = rois.numpy()[0, :cnt]
+    # clipped to the 32x32 input
+    assert (r >= 0).all() and (r <= 31).all()
+    # rows beyond the count are zero padding
+    assert (rois.numpy()[0, cnt:] == 0).all()
+
+
+def test_distribute_fpn_proposals_restore():
+    from paddle_tpu.vision.detection import distribute_fpn_proposals
+    rois = np.array([[0, 0, 10, 10],      # sqrt(area)=10 -> low level
+                     [0, 0, 200, 200],    # 200 -> high level
+                     [0, 0, 50, 50]], np.float32)
+    outs, restore = distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    assert len(outs) == 4
+    # levels: 10px,50px -> clamp to 2; 200px -> floor(4+log2(200/224))=3
+    sizes = [len(o.numpy()) for o in outs]
+    assert sizes == [2, 1, 0, 0]
+    # restore maps concatenated per-level order back to input order
+    cat = np.concatenate([o.numpy() for o in outs])
+    np.testing.assert_allclose(cat[restore.numpy()], rois)
+
+
 def test_multiclass_nms_batch_and_topk():
     rng = np.random.default_rng(0)
     boxes = np.broadcast_to(
