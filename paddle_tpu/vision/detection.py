@@ -331,6 +331,92 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                     Tensor(matched))
 
 
+def rpn_target_assign(anchor_box, anchor_var, gt_boxes, im_info,
+                      rpn_batch_size_per_im: int = 256,
+                      rpn_straddle_thresh: float = 0.0,
+                      rpn_fg_fraction: float = 0.5,
+                      rpn_positive_overlap: float = 0.7,
+                      rpn_negative_overlap: float = 0.3,
+                      use_random: bool = True, rng=None):
+    """RPN anchor sampling + targets for ONE image (Faster-RCNN recipe).
+    ~ detection.py:312 / rpn_target_assign_op.cc. Positives: each gt's
+    best-IoU anchor plus any anchor with IoU > rpn_positive_overlap;
+    negatives: IoU < rpn_negative_overlap everywhere; both subsampled to
+    rpn_batch_size_per_im at rpn_fg_fraction. Anchors straddling the
+    image border by more than rpn_straddle_thresh px are excluded.
+
+    Returns (loc_index (F,), score_index (F+B,), tgt_bbox (F,4) encoded,
+    tgt_label (F+B,) {1,0}) — index tensors into the M anchors, the
+    reference's gather-style training contract.
+    """
+    an = _arr(anchor_box).astype(np.float32).reshape(-1, 4)
+    av = (None if anchor_var is None
+          else _arr(anchor_var).astype(np.float32).reshape(-1, 4))
+    gtb = _arr(gt_boxes).astype(np.float32).reshape(-1, 4)
+    info = _arr(im_info).astype(np.float32).reshape(-1)
+    M = an.shape[0]
+    # fresh entropy by default (a fixed default seed would drop the SAME
+    # negatives every call, defeating random subsampling); pass an int
+    # or Generator for reproducibility
+    if not isinstance(rng, np.random.Generator):
+        rng = np.random.default_rng(rng)
+
+    if rpn_straddle_thresh >= 0:
+        t = rpn_straddle_thresh
+        inside = ((an[:, 0] >= -t) & (an[:, 1] >= -t)
+                  & (an[:, 2] < info[1] + t) & (an[:, 3] < info[0] + t))
+    else:
+        inside = np.ones(M, bool)
+    cand = np.nonzero(inside)[0]
+
+    labels = np.full(M, -1, np.int64)  # -1 ignore, 0 neg, 1 pos
+    assigned_gt = np.zeros(M, np.int64)
+    if len(gtb) and len(cand):
+        # pixel-coordinate anchors use the +1 (unnormalized) IoU
+        # convention, matching generate_proposals and the reference op
+        iou = _arr(iou_similarity(gtb, an[cand],
+                                  box_normalized=False))   # (G, C)
+        best_per_anchor = iou.max(axis=0)
+        assigned_gt[cand] = iou.argmax(axis=0)
+        labels[cand[best_per_anchor >= rpn_positive_overlap]] = 1
+        # each gt's best anchor(s) are positive even below the
+        # threshold — ALL ties share the max (symmetric grids tie often)
+        gt_max = iou.max(axis=1, keepdims=True)
+        labels[cand[((iou >= gt_max - 1e-6) & (gt_max > 0)).any(axis=0)]] \
+            = 1
+        labels[cand[(best_per_anchor < rpn_negative_overlap)
+                    & (labels[cand] != 1)]] = 0
+    elif len(cand):
+        labels[cand] = 0  # no gt: all inside anchors are negatives
+
+    n_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+    fg = np.nonzero(labels == 1)[0]
+    if len(fg) > n_fg:
+        drop = (rng.choice(fg, len(fg) - n_fg, replace=False)
+                if use_random else fg[n_fg:])
+        labels[drop] = -1
+        fg = np.nonzero(labels == 1)[0]
+    n_bg = rpn_batch_size_per_im - len(fg)
+    bg = np.nonzero(labels == 0)[0]
+    if len(bg) > n_bg:
+        drop = (rng.choice(bg, len(bg) - n_bg, replace=False)
+                if use_random else bg[n_bg:])
+        labels[drop] = -1
+        bg = np.nonzero(labels == 0)[0]
+
+    tgt = np.zeros((len(fg), 4), np.float32)
+    if len(fg) and len(gtb):
+        enc = _arr(box_coder(an[fg], av[fg] if av is not None else None,
+                             gtb, "encode_center_size"))   # (G, F, 4)
+        tgt = enc[assigned_gt[fg], np.arange(len(fg))]
+    score_index = np.concatenate([fg, bg])
+    tgt_label = np.concatenate([np.ones(len(fg), np.int64),
+                                np.zeros(len(bg), np.int64)])
+    return (Tensor(fg.astype(np.int64)),
+            Tensor(score_index.astype(np.int64)),
+            Tensor(tgt), Tensor(tgt_label))
+
+
 def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
                        pre_nms_top_n: int = 6000,
                        post_nms_top_n: int = 1000,

@@ -205,6 +205,34 @@ def test_distribute_fpn_proposals_restore():
     np.testing.assert_allclose(cat[restore.numpy()], rois)
 
 
+def test_rpn_target_assign():
+    from paddle_tpu.vision.detection import (anchor_generator,
+                                             rpn_target_assign)
+    fm = np.zeros((1, 8, 4, 4), np.float32)
+    anchors, var = anchor_generator(fm, anchor_sizes=[8.0],
+                                    aspect_ratios=[1.0],
+                                    stride=[8.0, 8.0])
+    an = anchors.numpy().reshape(-1, 4)
+    av = var.numpy().reshape(-1, 4)
+    gt = np.array([[4, 4, 12, 12]], np.float32)  # ~ anchor 0 region
+    loc_idx, score_idx, tgt_bbox, tgt_label = rpn_target_assign(
+        an, av, gt, np.array([32.0, 32.0, 1.0]),
+        rpn_batch_size_per_im=8, use_random=False)
+    fg = loc_idx.numpy()
+    assert len(fg) >= 1                      # gt's best anchor is fg
+    assert tgt_bbox.shape[0] == len(fg)
+    lab = tgt_label.numpy()
+    assert set(np.unique(lab)) <= {0, 1}
+    assert (lab[:len(fg)] == 1).all()
+    assert len(score_idx.numpy()) == len(lab) <= 8
+    # no gt: every inside anchor becomes a negative candidate
+    _, si, tb, tl = rpn_target_assign(
+        an, av, np.zeros((0, 4), np.float32),
+        np.array([32.0, 32.0, 1.0]), rpn_batch_size_per_im=8,
+        use_random=False)
+    assert tb.shape[0] == 0 and (tl.numpy() == 0).all()
+
+
 def test_multiclass_nms_batch_and_topk():
     rng = np.random.default_rng(0)
     boxes = np.broadcast_to(
