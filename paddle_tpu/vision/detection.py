@@ -468,6 +468,78 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     return Tensor(rois), Tensor(counts)
 
 
+def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes, im_info,
+                             batch_size_per_im: int = 256,
+                             fg_fraction: float = 0.25,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums: int = 81,
+                             use_random: bool = True, rng=None):
+    """Sample RoIs + build RCNN-head targets for ONE image.
+    ~ detection.py:2610 / generate_proposal_labels_op.cc: gt boxes join
+    the candidate set, fg = IoU >= fg_thresh (sampled to fg_fraction),
+    bg = IoU in [bg_thresh_lo, bg_thresh_hi), targets are per-class
+    box_coder offsets with bbox_reg_weights as inverse variances.
+
+    Returns (rois (R,4) in ORIGINAL-image coords, labels (R,) int64,
+    bbox_targets (R, 4*C), bbox_inside_weights (R, 4*C),
+    bbox_outside_weights (R, 4*C)).
+    """
+    if not isinstance(rng, np.random.Generator):
+        rng = np.random.default_rng(rng)
+    rois = _arr(rpn_rois).astype(np.float32).reshape(-1, 4)
+    gtb = _arr(gt_boxes).astype(np.float32).reshape(-1, 4)
+    gtc = _arr(gt_classes).astype(np.int64).reshape(-1)
+    info = _arr(im_info).astype(np.float32).reshape(-1)
+    # rpn rois are in network-input coords, gt boxes in original-image
+    # coords (the reference divides by im_scale before joining them)
+    scale = info[2] if info.size > 2 and info[2] > 0 else 1.0
+    rois = rois / scale
+    cand = np.concatenate([rois, gtb]) if len(gtb) else rois
+
+    if len(gtb):
+        iou = _arr(iou_similarity(gtb, cand,
+                                  box_normalized=False))  # (G, R+G)
+        best = iou.max(axis=0)
+        best_gt = iou.argmax(axis=0)
+    else:
+        best = np.zeros(len(cand), np.float32)
+        best_gt = np.zeros(len(cand), np.int64)
+
+    fg_all = np.nonzero(best >= fg_thresh)[0]
+    bg_all = np.nonzero((best < bg_thresh_hi)
+                        & (best >= bg_thresh_lo))[0]
+    n_fg = min(int(fg_fraction * batch_size_per_im), len(fg_all))
+    fg = (rng.choice(fg_all, n_fg, replace=False) if use_random
+          else fg_all[:n_fg]) if len(fg_all) else fg_all
+    n_bg = min(batch_size_per_im - n_fg, len(bg_all))
+    bg = (rng.choice(bg_all, n_bg, replace=False) if use_random
+          else bg_all[:n_bg]) if len(bg_all) else bg_all
+
+    keep = np.concatenate([fg, bg]).astype(np.int64)
+    out_rois = cand[keep]
+    labels = np.zeros(len(keep), np.int64)
+    labels[:len(fg)] = gtc[best_gt[fg]] if len(fg) else labels[:0]
+
+    targets = np.zeros((len(keep), 4 * class_nums), np.float32)
+    inside_w = np.zeros_like(targets)
+    if len(fg):
+        enc = _arr(box_coder(cand[fg],
+                             np.asarray(bbox_reg_weights, np.float32),
+                             gtb, "encode_center_size"))
+        off = enc[best_gt[fg], np.arange(len(fg))]       # (F, 4)
+        for r, c in enumerate(labels[:len(fg)]):
+            targets[r, 4 * c:4 * c + 4] = off[r]
+            inside_w[r, 4 * c:4 * c + 4] = 1.0
+    # outside weights equal inside in the standard recipe (loss
+    # normalization happens in the loss, not here) — kept as a separate
+    # output for the reference's 5-tuple contract
+    return (Tensor(out_rois), Tensor(labels), Tensor(targets),
+            Tensor(inside_w), Tensor(inside_w.copy()))
+
+
 def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
                              refer_level: int, refer_scale: float,
                              rois_num=None):

@@ -233,6 +233,41 @@ def test_rpn_target_assign():
     assert tb.shape[0] == 0 and (tl.numpy() == 0).all()
 
 
+def test_generate_proposal_labels():
+    from paddle_tpu.vision.detection import generate_proposal_labels
+    rois = np.array([[4, 4, 12, 12],    # overlaps gt heavily
+                     [20, 20, 28, 28],  # background
+                     [5, 5, 13, 13]], np.float32)
+    gt_boxes = np.array([[4, 4, 12, 12]], np.float32)
+    gt_classes = np.array([3], np.int64)
+    out_rois, labels, targets, inw, outw = generate_proposal_labels(
+        rois, gt_classes, gt_boxes, np.array([32.0, 32.0, 1.0]),
+        batch_size_per_im=4, fg_fraction=0.5, class_nums=5,
+        use_random=False)
+    np.testing.assert_allclose(outw.numpy(), inw.numpy())
+    lab = labels.numpy()
+    # fg rows carry the gt class, bg rows are 0; gt box itself joined
+    assert (lab[:2] == 3).all() or (lab == 3).sum() >= 1
+    assert (lab == 0).sum() >= 1
+    t = targets.numpy()
+    w = inw.numpy()
+    for r in range(len(lab)):
+        if lab[r] > 0:
+            sl = slice(4 * lab[r], 4 * lab[r] + 4)
+            assert (w[r, sl] == 1).all()      # class-slot weights set
+            assert w[r].sum() == 4
+        else:
+            assert w[r].sum() == 0            # bg: no box loss
+    assert out_rois.shape[1] == 4
+    # im_scale != 1: rois (network-input coords) map back to original-
+    # image coords before IoU vs gt — same fg as the scale-1 case
+    _, lab2, _, _, _ = generate_proposal_labels(
+        rois * 2.0, gt_classes, gt_boxes, np.array([64.0, 64.0, 2.0]),
+        batch_size_per_im=4, fg_fraction=0.5, class_nums=5,
+        use_random=False)
+    assert (lab2.numpy() == 3).sum() == (lab == 3).sum()
+
+
 def test_multiclass_nms_batch_and_topk():
     rng = np.random.default_rng(0)
     boxes = np.broadcast_to(
