@@ -570,6 +570,67 @@ def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
     return outs, Tensor(restore.astype(np.int64))
 
 
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold: float = 0.05,
+                               nms_top_k: int = 1000,
+                               keep_top_k: int = 100,
+                               nms_threshold: float = 0.45,
+                               nms_eta: float = 1.0):
+    """RetinaNet inference head for ONE image. ~ detection.py:3120 /
+    retinanet_detection_output_op.cc: per-FPN-level sigmoid scores are
+    thresholded and top-nms_top_k decoded against that level's anchors;
+    merged candidates then go through class-wise NMS + keep_top_k
+    (fixed-size padded output, as multiclass_nms here).
+
+    bboxes: list of (Mi, 4) per-level deltas; scores: list of (Mi, C)
+    per-level sigmoid scores; anchors: list of (Mi, 4) per-level
+    anchors (unnormalized corners). Returns (out (keep_top_k, 6),
+    count () int32) with [label, score, x1, y1, x2, y2] rows.
+    """
+    info = _arr(im_info).astype(np.float32).reshape(-1)
+    var = np.asarray([1.0, 1.0, 1.0, 1.0], np.float32)
+    cand_boxes, cand_scores = [], []
+    for lb, ls, la in zip(bboxes, scores, anchors):
+        d = _arr(lb).astype(np.float32)
+        s = _arr(ls).astype(np.float32)
+        a = _arr(la).astype(np.float32)
+        # keep this level's top-nms_top_k candidate (box, class) pairs
+        flat = s.reshape(-1)
+        mask = flat > score_threshold
+        if not mask.any():
+            continue
+        idx = np.nonzero(mask)[0]
+        if nms_top_k > 0 and len(idx) > nms_top_k:
+            idx = idx[np.argsort(-flat[idx])[:nms_top_k]]
+        bi, ci = np.unravel_index(idx, s.shape)
+        dec = np.array(_arr(box_coder(
+            a[bi], var, d[bi][:, None, :], "decode_center_size",
+            axis=1))[:, 0])
+        hmax, wmax = info[0] - 1.0, info[1] - 1.0
+        dec[:, 0::2] = np.clip(dec[:, 0::2], 0.0, wmax)
+        dec[:, 1::2] = np.clip(dec[:, 1::2], 0.0, hmax)
+        cand_boxes.append(dec)
+        cand_scores.append(np.stack([ci.astype(np.float32),
+                                     flat[idx]], 1))
+    out = np.full((int(keep_top_k), 6), -1.0, np.float32)
+    if not cand_boxes:
+        return Tensor(out), Tensor(np.zeros((), np.int32))
+    boxes = np.concatenate(cand_boxes)
+    cls_sc = np.concatenate(cand_scores)
+    dets = []
+    for c in np.unique(cls_sc[:, 0]):
+        m = cls_sc[:, 0] == c
+        mb, ms = boxes[m], cls_sc[m, 1]
+        dets.extend((c, ms[k], mb[k])
+                    for k in _greedy_nms(mb, ms, nms_threshold,
+                                         1.0, nms_eta))
+    dets.sort(key=lambda d: -d[1])
+    dets = dets[:int(keep_top_k)]
+    for r, (c, sc, box) in enumerate(dets):
+        out[r, 0], out[r, 1], out[r, 2:] = c, sc, box
+    return Tensor(out), Tensor(np.asarray(len(dets), np.int32))
+
+
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
                    nms_top_k: int = 400, keep_top_k: int = 100,
                    nms_threshold: float = 0.3, normalized: bool = True,

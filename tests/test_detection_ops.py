@@ -246,8 +246,9 @@ def test_generate_proposal_labels():
         use_random=False)
     np.testing.assert_allclose(outw.numpy(), inw.numpy())
     lab = labels.numpy()
-    # fg rows carry the gt class, bg rows are 0; gt box itself joined
-    assert (lab[:2] == 3).all() or (lab == 3).sum() >= 1
+    # fg rows (deterministic with use_random=False: rois 0,2 + the
+    # joined gt box, capped at fg_fraction) carry the gt class
+    assert (lab[:2] == 3).all()
     assert (lab == 0).sum() >= 1
     t = targets.numpy()
     w = inw.numpy()
@@ -266,6 +267,27 @@ def test_generate_proposal_labels():
         batch_size_per_im=4, fg_fraction=0.5, class_nums=5,
         use_random=False)
     assert (lab2.numpy() == 3).sum() == (lab == 3).sum()
+
+
+def test_retinanet_detection_output():
+    from paddle_tpu.vision.detection import retinanet_detection_output
+    # two levels; level-0 anchor 0 is a confident class-1 detection
+    anchors = [np.array([[0, 0, 8, 8], [8, 8, 16, 16]], np.float32),
+               np.array([[0, 0, 16, 16]], np.float32)]
+    deltas = [np.zeros((2, 4), np.float32),
+              np.zeros((1, 4), np.float32)]
+    scores = [np.array([[0.01, 0.9], [0.02, 0.03]], np.float32),
+              np.array([[0.01, 0.6]], np.float32)]
+    out, cnt = retinanet_detection_output(
+        deltas, scores, anchors, np.array([32.0, 32.0, 1.0]),
+        score_threshold=0.05, keep_top_k=5, nms_threshold=0.5)
+    assert out.shape == [5, 6]
+    c = int(cnt.numpy())
+    assert c == 2  # 0.9 and 0.6 survive; 0.01/0.02/0.03 cut
+    o = out.numpy()
+    assert o[0, 0] == 1 and abs(o[0, 1] - 0.9) < 1e-6
+    np.testing.assert_allclose(o[0, 2:], [0, 0, 8, 8])
+    assert (o[c:, 0] == -1).all()
 
 
 def test_multiclass_nms_batch_and_topk():
