@@ -140,9 +140,7 @@ def prior_box(input, image, min_sizes: Sequence[float],
             if sq:
                 whs.append(sq)
     P = len(whs)
-    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
-    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
-    cxg, cyg = np.meshgrid(cx, cy)                      # (H, W)
+    cxg, cyg = _cell_centers(H, W, step_w, step_h, offset)
     wh = np.asarray(whs, np.float32)                    # (P, 2)
     boxes = np.empty((H, W, P, 4), np.float32)
     boxes[..., 0] = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / iw
@@ -151,9 +149,70 @@ def prior_box(input, image, min_sizes: Sequence[float],
     boxes[..., 3] = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / ih
     if clip:
         boxes = np.clip(boxes, 0.0, 1.0)
-    var = np.broadcast_to(np.asarray(variance, np.float32),
-                          boxes.shape).copy()
-    return Tensor(boxes), Tensor(var)
+    return Tensor(boxes), Tensor(_broadcast_var(variance, boxes.shape))
+
+
+def _cell_centers(H, W, step_w, step_h, offset):
+    """(H, W) grids of cell-center pixel coordinates."""
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    return np.meshgrid(cx, cy)
+
+
+def _broadcast_var(variance, shape):
+    return np.broadcast_to(np.asarray(variance, np.float32),
+                           shape).copy()
+
+
+def density_prior_box(input, image, densities: Sequence[int],
+                      fixed_sizes: Sequence[float],
+                      fixed_ratios: Sequence[float] = (1.0,),
+                      variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+                      clip: bool = False,
+                      steps: Sequence[float] = (0.0, 0.0),
+                      offset: float = 0.5):
+    """Density prior boxes (face-detection SSD variant).
+    ~ detection.py:1939 / density_prior_box_op.cc: each (density,
+    fixed_size) pair lays a density x density sub-grid of shifted
+    centers inside every cell, one box per fixed_ratio. Returns
+    (boxes (H, W, P, 4), variances (H, W, P, 4)), normalized."""
+    if len(densities) != len(fixed_sizes):
+        raise ValueError(
+            f"density_prior_box: densities ({len(densities)}) and "
+            f"fixed_sizes ({len(fixed_sizes)}) must pair up 1:1")
+    fm = _arr(input)
+    img = _arr(image)
+    H, W = fm.shape[2], fm.shape[3]
+    ih, iw = float(img.shape[2]), float(img.shape[3])
+    step_h = steps[1] if steps[1] > 0 else ih / H
+    step_w = steps[0] if steps[0] > 0 else iw / W
+    # the reference shifts the sub-grid by the INTEGER averaged step on
+    # both axes (density_prior_box_op.cc step_average)
+    step_avg = int(0.5 * (step_w + step_h))
+    entries = []  # (dx, dy, w, h) center shift in px + box size
+    for dens, fs in zip(densities, fixed_sizes):
+        dens = int(dens)
+        shift = int(step_avg / dens)
+        for r in fixed_ratios:
+            bw, bh = fs * np.sqrt(r), fs / np.sqrt(r)
+            for di in range(dens):
+                for dj in range(dens):
+                    entries.append(((dj + 0.5) * shift - step_avg / 2.0,
+                                    (di + 0.5) * shift - step_avg / 2.0,
+                                    bw, bh))
+    P = len(entries)
+    e = np.asarray(entries, np.float32)                  # (P, 4)
+    cxg, cyg = _cell_centers(H, W, step_w, step_h, offset)
+    boxes = np.empty((H, W, P, 4), np.float32)
+    ctrx = cxg[:, :, None] + e[None, None, :, 0]
+    ctry = cyg[:, :, None] + e[None, None, :, 1]
+    boxes[..., 0] = (ctrx - e[None, None, :, 2] / 2) / iw
+    boxes[..., 1] = (ctry - e[None, None, :, 3] / 2) / ih
+    boxes[..., 2] = (ctrx + e[None, None, :, 2] / 2) / iw
+    boxes[..., 3] = (ctry + e[None, None, :, 3] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    return Tensor(boxes), Tensor(_broadcast_var(variance, boxes.shape))
 
 
 def anchor_generator(input, anchor_sizes: Sequence[float],
@@ -173,18 +232,15 @@ def anchor_generator(input, anchor_sizes: Sequence[float],
             w = np.sqrt(area / ar)
             whs.append((w, w * ar))
     A = len(whs)
-    cx = (np.arange(W, dtype=np.float32) + offset) * stride[0]
-    cy = (np.arange(H, dtype=np.float32) + offset) * stride[1]
-    cxg, cyg = np.meshgrid(cx, cy)
+    cxg, cyg = _cell_centers(H, W, stride[0], stride[1], offset)
     wh = np.asarray(whs, np.float32)
     anchors = np.empty((H, W, A, 4), np.float32)
     anchors[..., 0] = cxg[:, :, None] - wh[None, None, :, 0] / 2
     anchors[..., 1] = cyg[:, :, None] - wh[None, None, :, 1] / 2
     anchors[..., 2] = cxg[:, :, None] + wh[None, None, :, 0] / 2
     anchors[..., 3] = cyg[:, :, None] + wh[None, None, :, 1] / 2
-    var = np.broadcast_to(np.asarray(variance, np.float32),
-                          anchors.shape).copy()
-    return Tensor(anchors), Tensor(var)
+    return Tensor(anchors), Tensor(_broadcast_var(variance,
+                                                  anchors.shape))
 
 
 def _greedy_nms(boxes, scores, thresh, norm, eta, max_keep=None):

@@ -79,6 +79,22 @@ def test_prior_box_shapes_and_values():
     np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
 
 
+def test_density_prior_box():
+    from paddle_tpu.vision.detection import density_prior_box
+    fm = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 8, 8), np.float32)
+    boxes, var = density_prior_box(fm, img, densities=[2, 1],
+                                   fixed_sizes=[2.0, 4.0])
+    # P = 2*2 (density 2) + 1 (density 1) = 5 per cell
+    assert boxes.shape == [2, 2, 5, 4]
+    b = boxes.numpy()
+    # density-2 sub-grid: centers at cell_center +- step/4 (step=4 -> +-1)
+    # first entry of cell (0,0): center (2-1, 2-1)=(1,1), 2x2 box
+    np.testing.assert_allclose(b[0, 0, 0] * 8, [0, 0, 2, 2], atol=1e-5)
+    # density-1 entry: centered at (2,2), 4x4 box
+    np.testing.assert_allclose(b[0, 0, 4] * 8, [0, 0, 4, 4], atol=1e-5)
+
+
 def test_anchor_generator_centers():
     fm = np.zeros((1, 8, 2, 3), np.float32)
     anchors, var = anchor_generator(fm, anchor_sizes=[32.0],
