@@ -210,8 +210,10 @@ def density_prior_box(input, image, densities: Sequence[int],
     boxes[..., 1] = (ctry - e[None, None, :, 3] / 2) / ih
     boxes[..., 2] = (ctrx + e[None, None, :, 2] / 2) / iw
     boxes[..., 3] = (ctry + e[None, None, :, 3] / 2) / ih
-    if clip:
-        boxes = np.clip(boxes, 0.0, 1.0)
+    # the reference kernel clamps every corner to [0, 1] regardless of
+    # the clip attr (density_prior_box_op.h); `clip` is kept for
+    # signature parity only
+    boxes = np.clip(boxes, 0.0, 1.0)
     return Tensor(boxes), Tensor(_broadcast_var(variance, boxes.shape))
 
 
@@ -270,6 +272,26 @@ def _greedy_nms(boxes, scores, thresh, norm, eta, max_keep=None):
         if eta < 1.0 and th > 0.5:
             th *= eta
     return keep
+
+
+def polygon_box_transform(input):
+    """EAST-style quad decoding. ~ detection.py:970 /
+    polygon_box_transform_op.cc: even geometry channels hold x offsets,
+    odd channels y offsets, each against its pixel's coordinate on the
+    4x-downsampled grid: out = 4*w - in (even) / 4*h - in (odd).
+    Pure elementwise+iota — jit-able."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply_op
+
+    def fn(x):
+        N, C, H, W = x.shape
+        wgrid = jnp.arange(W, dtype=x.dtype) * 4.0
+        hgrid = (jnp.arange(H, dtype=x.dtype) * 4.0)[:, None]
+        even = jnp.arange(C)[:, None, None] % 2 == 0
+        return jnp.where(even[None], wgrid - x, hgrid - x)
+
+    return apply_op("polygon_box_transform", fn, input)
 
 
 def bipartite_match(dist_matrix, match_type: str = "bipartite",

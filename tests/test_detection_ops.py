@@ -124,6 +124,21 @@ def test_multiclass_nms_padded():
     assert (o[2:, 0] == -1).all()  # padding rows
 
 
+def test_polygon_box_transform():
+    from paddle_tpu.vision.detection import polygon_box_transform
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 8, 3, 4)).astype(np.float32)
+    out = polygon_box_transform(paddle.to_tensor(x)).numpy()
+    # oracle straight from polygon_box_transform_op.cc
+    ref = np.empty_like(x)
+    for c in range(8):
+        for h in range(3):
+            for w in range(4):
+                ref[:, c, h, w] = (w * 4 - x[:, c, h, w] if c % 2 == 0
+                                   else h * 4 - x[:, c, h, w])
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
 def test_bipartite_match():
     from paddle_tpu.vision.detection import bipartite_match
     d = np.array([[0.9, 0.1, 0.6],
