@@ -495,6 +495,58 @@ def rpn_target_assign(anchor_box, anchor_var, gt_boxes, im_info,
             Tensor(tgt), Tensor(tgt_label))
 
 
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label: int = 0,
+                     nms_threshold: float = 0.3, nms_top_k: int = 400,
+                     keep_top_k: int = 200,
+                     score_threshold: float = 0.01, nms_eta: float = 1.0):
+    """SSD inference head: decode + multiclass NMS for ONE image.
+    ~ detection.py:622 / detection_output_op: loc (P, 4) offsets against
+    priors, scores (P, C) softmax probabilities. Returns the
+    multiclass_nms fixed-size contract: (out (keep_top_k, 6), count)."""
+    p = _arr(prior_box).astype(np.float32)
+    pv = None if prior_box_var is None else _arr(prior_box_var)
+    d = _arr(loc).astype(np.float32)
+    boxes = np.array(_arr(box_coder(p, pv, d[None],
+                                    "decode_center_size", axis=0))[0])
+    s = _arr(scores).astype(np.float32)
+    out, counts = multiclass_nms(
+        boxes[None], s.T[None], score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, nms_eta=nms_eta,
+        background_label=background_label)
+    return (Tensor(_arr(out)[0]),
+            Tensor(np.asarray(_arr(counts)[0], np.int32)))
+
+
+def retinanet_target_assign(anchor_box, anchor_var, gt_boxes, gt_labels,
+                            im_info, positive_overlap: float = 0.5,
+                            negative_overlap: float = 0.4, rng=None):
+    """RetinaNet anchor targets. ~ detection.py:71 /
+    retinanet_target_assign_op: the RPN assignment rule with (a) NO
+    fg/bg subsampling (focal loss handles imbalance) and (b) per-class
+    fg labels instead of binary objectness.
+
+    Returns (loc_index (F,), score_index (F+B,), tgt_bbox (F, 4),
+    tgt_label (F+B,) — gt class for fg, 0 for bg)."""
+    gtl = _arr(gt_labels).astype(np.int64).reshape(-1)
+    an = _arr(anchor_box).astype(np.float32).reshape(-1, 4)
+    gtb = _arr(gt_boxes).astype(np.float32).reshape(-1, 4)
+    fg, score_idx, tgt_bbox, _ = rpn_target_assign(
+        anchor_box, anchor_var, gt_boxes, im_info,
+        rpn_batch_size_per_im=len(an) + len(gtb) + 1,  # no subsampling
+        rpn_fg_fraction=1.0,       # ...of positives either
+        rpn_straddle_thresh=-1.0,  # RetinaNet keeps border anchors
+        rpn_positive_overlap=positive_overlap,
+        rpn_negative_overlap=negative_overlap, rng=rng)
+    fg_a = _arr(fg)
+    labels = np.zeros(len(_arr(score_idx)), np.int64)
+    if len(fg_a) and len(gtb):
+        iou = _arr(iou_similarity(gtb, an[fg_a], box_normalized=False))
+        labels[:len(fg_a)] = gtl[iou.argmax(axis=0)]
+    return fg, score_idx, tgt_bbox, Tensor(labels)
+
+
 def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
                        pre_nms_top_n: int = 6000,
                        post_nms_top_n: int = 1000,

@@ -300,6 +300,45 @@ def test_generate_proposal_labels():
     assert (lab2.numpy() == 3).sum() == (lab == 3).sum()
 
 
+def test_detection_output_ssd_inference():
+    from paddle_tpu.vision.detection import detection_output
+    priors = np.array([[0, 0, 8, 8], [8, 8, 16, 16]], np.float32)
+    loc = np.zeros((2, 4), np.float32)          # boxes = priors
+    scores = np.array([[0.05, 0.95], [0.9, 0.1]], np.float32)
+    out, cnt = detection_output(loc, scores, priors, None,
+                                score_threshold=0.3, keep_top_k=4)
+    assert out.shape == [4, 6]
+    assert int(cnt.numpy()) == 1                # only prior 0 is fg
+    o = out.numpy()
+    assert o[0, 0] == 1 and abs(o[0, 1] - 0.95) < 1e-6
+    np.testing.assert_allclose(o[0, 2:], [0, 0, 8, 8])
+
+
+def test_retinanet_target_assign():
+    from paddle_tpu.vision.detection import (anchor_generator,
+                                             retinanet_target_assign)
+    fm = np.zeros((1, 8, 4, 4), np.float32)
+    anchors, var = anchor_generator(fm, anchor_sizes=[8.0],
+                                    aspect_ratios=[1.0],
+                                    stride=[8.0, 8.0])
+    an, av = anchors.numpy().reshape(-1, 4), var.numpy().reshape(-1, 4)
+    gt = np.array([[3, 3, 13, 13]], np.float32)
+    gl = np.array([7], np.int64)
+    fg, si, tb, tl = retinanet_target_assign(
+        an, av, gt, gl, np.array([32.0, 32.0, 1.0]))
+    lab = tl.numpy()
+    nf = len(fg.numpy())
+    assert nf >= 1 and (lab[:nf] == 7).all()   # per-class fg labels
+    # NO subsampling: every below-negative-overlap anchor is kept as bg
+    # (the [0.4, 0.5) ignore band is excluded by design)
+    from paddle_tpu.vision.detection import iou_similarity
+    iou = iou_similarity(gt, an, box_normalized=False).numpy().max(0)
+    # forced positives (per-gt best anchors) can sit below 0.4; the
+    # rest of the below-negative-overlap anchors are ALL kept as bg
+    assert (lab == 0).sum() == (iou < 0.4).sum() - nf
+    assert len(lab) == (iou < 0.4).sum()  # = nf + bg, no subsampling
+
+
 def test_retinanet_detection_output():
     from paddle_tpu.vision.detection import retinanet_detection_output
     # two levels; level-0 anchor 0 is a confident class-1 detection
