@@ -761,6 +761,97 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
     return Tensor(out), Tensor(np.asarray(len(dets), np.int32))
 
 
+def matrix_nms(bboxes, scores, score_threshold: float, post_threshold:
+               float = 0.0, nms_top_k: int = 400, keep_top_k: int = 200,
+               use_gaussian: bool = False, gaussian_sigma: float = 2.0,
+               background_label: int = 0, normalized: bool = True):
+    """Matrix NMS (SOLOv2) — the closed-form soft-NMS.
+    ~ paddle.vision.ops.matrix_nms / matrix_nms_op.cc. Unlike greedy
+    NMS, the decay of every box is a pure matrix expression over the
+    pairwise IoUs of higher-scored boxes — no sequential suppression
+    loop — so THIS nms runs on the TPU inside jit (the serving-side
+    NMS for compiled detection heads; greedy variants here are host
+    ops).
+
+    bboxes (N, M, 4), scores (N, C, M) -> (out (N, keep_top_k, 6)
+    [label, score, box] rows padded with -1, counts (N,)).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply_op
+
+    norm = 0.0 if normalized else 1.0
+    C_idx = background_label
+
+    def one_class(boxes, sc):
+        """boxes (M, 4), sc (M,) -> decayed scores (M,)."""
+        order = jnp.argsort(-sc)
+        b = boxes[order]
+        s = sc[order]
+        area = ((b[:, 2] - b[:, 0] + norm)
+                * (b[:, 3] - b[:, 1] + norm))
+        x1 = jnp.maximum(b[:, None, 0], b[None, :, 0])
+        y1 = jnp.maximum(b[:, None, 1], b[None, :, 1])
+        x2 = jnp.minimum(b[:, None, 2], b[None, :, 2])
+        y2 = jnp.minimum(b[:, None, 3], b[None, :, 3])
+        inter = (jnp.clip(x2 - x1 + norm, 0, None)
+                 * jnp.clip(y2 - y1 + norm, 0, None))
+        iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+        # iou[i, j] for i < j (i scored higher): upper triangle
+        iou = jnp.triu(iou, k=1)
+        # compensation: how much row i was ITSELF overlapped by boxes
+        # above it (max over k<i of iou[k, i]) — broadcast along rows
+        comp = jnp.max(iou, axis=0)[:, None]
+        if use_gaussian:
+            decay = jnp.exp(-(jnp.square(iou) - jnp.square(comp))
+                            / gaussian_sigma)
+        else:
+            decay = (1.0 - iou) / jnp.maximum(1.0 - comp, 1e-10)
+        # decay only applies from higher-scored rows
+        decay = jnp.where(jnp.triu(jnp.ones_like(iou), k=1) > 0,
+                          decay, 1.0)
+        dec = jnp.min(decay, axis=0) * s
+        # un-sort back to input order
+        out = jnp.zeros_like(sc).at[order].set(dec)
+        return out
+
+    def fn(b, s):
+        N, C, M = s.shape
+        mask = s > score_threshold
+        s_in = jnp.where(mask, s, 0.0)
+        # per-class top-nms_top_k pre-filter (bounds the O(k^2) decay
+        # matrix and matches the reference's pre-decay drop)
+        k0 = min(int(nms_top_k), M) if nms_top_k > 0 else M
+
+        def per_class(bb, sc):
+            if k0 == M:
+                return one_class(bb, sc)
+            sv, si = jax.lax.top_k(sc, k0)
+            dec = one_class(bb[si], sv)
+            return jnp.zeros_like(sc).at[si].set(dec)
+
+        decayed = jax.vmap(                     # over batch
+            lambda bb, ss: jax.vmap(            # over classes
+                lambda sc: per_class(bb, sc))(ss))(b, s_in)
+        if C_idx >= 0:
+            decayed = decayed.at[:, C_idx].set(0.0)
+        decayed = jnp.where(decayed > post_threshold, decayed, 0.0)
+        flat = decayed.reshape(N, C * M)
+        k = min(int(keep_top_k), C * M)
+        top_s, top_i = jax.lax.top_k(flat, k)
+        cls = (top_i // M).astype(jnp.float32)
+        box = jnp.take_along_axis(b, (top_i % M)[..., None], axis=1)
+        valid = top_s > 0.0
+        out = jnp.concatenate(
+            [jnp.where(valid, cls, -1.0)[..., None],
+             jnp.where(valid, top_s, -1.0)[..., None],
+             jnp.where(valid[..., None], box, -1.0)], axis=-1)
+        return out, valid.sum(-1).astype(jnp.int32)
+
+    return apply_op("matrix_nms", fn, bboxes, scores)
+
+
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
                    nms_top_k: int = 400, keep_top_k: int = 100,
                    nms_threshold: float = 0.3, normalized: bool = True,
