@@ -274,6 +274,70 @@ def _greedy_nms(boxes, scores, thresh, norm, eta, max_keep=None):
     return keep
 
 
+def detection_map(detections, gt_boxes, gt_labels, class_num: int,
+                  overlap_threshold: float = 0.5,
+                  ap_version: str = "integral"):
+    """Mean average precision over ONE image set.
+    ~ detection.py:1238 / detection_map_op (+ the DetectionMAP metric).
+
+    detections: list per image of (K, 6) [label, score, x1, y1, x2, y2]
+    rows (padding label -1 rows ignored — the multiclass_nms /
+    detection_output contract); gt_boxes/gt_labels: lists per image.
+    ap_version: 'integral' (VOC2010+) or '11point'. Returns float mAP.
+    """
+    aps = []
+    for c in range(class_num):
+        records = []  # (score, is_tp)
+        n_gt = 0
+        for det, gb, gl in zip(detections, gt_boxes, gt_labels):
+            det = _arr(det)
+            det = det[det[:, 0] == c]
+            gb = _arr(gb).astype(np.float32).reshape(-1, 4)
+            gl = _arr(gl).reshape(-1)
+            gmask = gl == c
+            gsel = gb[gmask]
+            n_gt += len(gsel)
+            used = np.zeros(len(gsel), bool)
+            order = np.argsort(-det[:, 1])
+            # one batched IoU matrix per (image, class) — a per-row
+            # device dispatch would dominate eval time
+            iou_all = (_arr(iou_similarity(det[order, 2:], gsel))
+                       if len(gsel) and len(order) else None)
+            for r, row in enumerate(det[order]):
+                if iou_all is None:
+                    records.append((row[1], False))
+                    continue
+                j = int(np.argmax(iou_all[r]))
+                if iou_all[r, j] >= overlap_threshold and not used[j]:
+                    used[j] = True
+                    records.append((row[1], True))
+                else:
+                    records.append((row[1], False))
+        if n_gt == 0:
+            continue
+        if not records:
+            aps.append(0.0)
+            continue
+        records.sort(key=lambda r: -r[0])
+        tp = np.cumsum([r[1] for r in records])
+        fp = np.cumsum([not r[1] for r in records])
+        recall = tp / n_gt
+        precision = tp / np.maximum(tp + fp, 1)
+        if ap_version == "11point":
+            ap = float(np.mean([
+                precision[recall >= t].max() if (recall >= t).any()
+                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:  # integral (VOC2010+): area under monotone envelope
+            mrec = np.concatenate([[0.0], recall, [1.0]])
+            mpre = np.concatenate([[0.0], precision, [0.0]])
+            mpre = np.maximum.accumulate(mpre[::-1])[::-1]
+            idx = np.nonzero(mrec[1:] != mrec[:-1])[0]
+            ap = float(np.sum((mrec[idx + 1] - mrec[idx])
+                              * mpre[idx + 1]))
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0
+
+
 def polygon_box_transform(input):
     """EAST-style quad decoding. ~ detection.py:970 /
     polygon_box_transform_op.cc: even geometry channels hold x offsets,

@@ -165,6 +165,29 @@ def test_multiclass_nms_padded():
     assert (o[2:, 0] == -1).all()  # padding rows
 
 
+def test_detection_map():
+    from paddle_tpu.vision.detection import detection_map
+    # image 1: one gt of class 1, detected perfectly + one false positive
+    det1 = np.array([[1, 0.9, 0, 0, 4, 4],
+                     [1, 0.3, 20, 20, 24, 24],
+                     [-1, -1, -1, -1, -1, -1]], np.float32)
+    gt1 = np.array([[0, 0, 4, 4]], np.float32)
+    gl1 = np.array([1], np.int64)
+    # perfect single detection: integral AP = 1.0 regardless of the FP
+    # at lower score? precision at recall 1.0 is 1/1 -> then FP adds
+    # (1.0, 0.5) point after full recall: AP stays 1.0
+    m = detection_map([det1], [gt1], [gl1], class_num=2)
+    assert abs(m - 1.0) < 1e-6, m
+    # missed gt halves recall: two images, second gt undetected
+    m2 = detection_map([det1, np.zeros((0, 6), np.float32)],
+                       [gt1, gt1], [gl1, gl1], class_num=2)
+    assert 0.4 < m2 < 0.6, m2
+    # 11-point variant runs and is bounded
+    m3 = detection_map([det1], [gt1], [gl1], class_num=2,
+                       ap_version="11point")
+    assert 0.9 < m3 <= 1.0
+
+
 def test_polygon_box_transform():
     from paddle_tpu.vision.detection import polygon_box_transform
     rng = np.random.default_rng(0)
