@@ -916,6 +916,29 @@ def matrix_nms(bboxes, scores, score_threshold: float, post_threshold:
     return apply_op("matrix_nms", fn, bboxes, scores)
 
 
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n: int):
+    """Merge per-FPN-level proposals and keep the global top-n by
+    score. ~ fluid collect_fpn_proposals / collect_fpn_proposals_op.cc.
+    multi_rois: list of (Ri, 4); multi_scores: list of (Ri,).
+    Returns (rois (n, 4), scores (n,)) with n <= post_nms_top_n."""
+    if len(multi_rois) != len(multi_scores):
+        raise ValueError(f"collect_fpn_proposals: {len(multi_rois)} roi "
+                         f"levels vs {len(multi_scores)} score levels")
+    per_r = [_arr(r).astype(np.float32).reshape(-1, 4)
+             for r in multi_rois]
+    per_s = [_arr(s).astype(np.float32).reshape(-1)
+             for s in multi_scores]
+    for i, (r, s) in enumerate(zip(per_r, per_s)):
+        if len(r) != len(s):  # totals can match while levels mispair
+            raise ValueError(f"collect_fpn_proposals: level {i} has "
+                             f"{len(r)} rois vs {len(s)} scores")
+    rois = np.concatenate(per_r)
+    sc = np.concatenate(per_s)
+    # stable sort: deterministic tie order at the top-n cutoff
+    order = np.argsort(-sc, kind="stable")[:int(post_nms_top_n)]
+    return Tensor(rois[order]), Tensor(sc[order])
+
+
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
                    nms_top_k: int = 400, keep_top_k: int = 100,
                    nms_threshold: float = 0.3, normalized: bool = True,

@@ -285,6 +285,21 @@ def test_generate_proposals():
     assert (rois.numpy()[0, cnt:] == 0).all()
 
 
+def test_collect_fpn_proposals():
+    from paddle_tpu.vision.detection import collect_fpn_proposals
+    r1 = np.array([[0, 0, 4, 4], [1, 1, 5, 5]], np.float32)
+    r2 = np.array([[2, 2, 6, 6]], np.float32)
+    s1 = np.array([0.3, 0.9], np.float32)
+    s2 = np.array([0.5], np.float32)
+    rois, sc = collect_fpn_proposals([r1, r2], [s1, s2],
+                                     post_nms_top_n=2)
+    np.testing.assert_allclose(sc.numpy(), [0.9, 0.5])
+    np.testing.assert_allclose(rois.numpy()[0], [1, 1, 5, 5])
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="rois vs"):
+        collect_fpn_proposals([r1], [s2], post_nms_top_n=2)
+
+
 def test_distribute_fpn_proposals_restore():
     from paddle_tpu.vision.detection import distribute_fpn_proposals
     rois = np.array([[0, 0, 10, 10],      # sqrt(area)=10 -> low level
