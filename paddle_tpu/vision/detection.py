@@ -611,6 +611,38 @@ def retinanet_target_assign(anchor_box, anchor_var, gt_boxes, gt_labels,
     return fg, score_idx, tgt_bbox, Tensor(labels)
 
 
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip: float):
+    """Cascade-RCNN per-class decode + best-class assignment.
+    ~ detection.py:3811 / box_decoder_and_assign_op.h: prior (R, 4)
+    unnormalized (+1 widths), target (R, 4*C) per-class offsets scaled
+    by the SHARED 4-vector prior_box_var, dw/dh clipped at box_clip.
+    Returns (decode_box (R, 4*C), assign_box (R, 4) — the decoded box
+    of each roi's best NON-background class, or the prior itself when
+    no foreground class wins).
+    """
+    p = _arr(prior_box).astype(np.float32).reshape(-1, 4)
+    pv = _arr(prior_box_var).astype(np.float32).reshape(4)
+    t = _arr(target_box).astype(np.float32)
+    s = _arr(box_score).astype(np.float32)
+    R, C = s.shape
+    # pre-scale by the shared variance and clip dw/dh, then the shared
+    # decode (box_coder) owns the center-size math
+    d = t.reshape(R, C, 4) * pv
+    d[..., 2:] = np.minimum(d[..., 2:], box_clip)
+    dec = np.array(_arr(box_coder(p, None, d, "decode_center_size",
+                                  box_normalized=False, axis=1)))
+    # best foreground class per roi (class 0 is background); the
+    # reference requires score >= 0.01 to assign a class box
+    fg = s[:, 1:]
+    best = fg.argmax(axis=1) + 1 if C > 1 else np.zeros(R, np.int64)
+    has_fg = (fg.max(axis=1) >= 0.01) if C > 1 else np.zeros(R, bool)
+    assign = np.where(has_fg[:, None],
+                      dec[np.arange(R), best], p)
+    return Tensor(dec.reshape(R, C * 4)), Tensor(assign.astype(
+        np.float32))
+
+
 def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
                        pre_nms_top_n: int = 6000,
                        post_nms_top_n: int = 1000,

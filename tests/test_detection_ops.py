@@ -259,6 +259,30 @@ def test_ssd_loss_learns():
     assert float(loss) < first * 0.5, (first, float(loss))
 
 
+def test_box_decoder_and_assign():
+    from paddle_tpu.vision.detection import box_decoder_and_assign
+    priors = np.array([[0, 0, 9, 9]], np.float32)  # w=h=10 (+1 conv)
+    pv = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    # class 0 (bg) zero offsets; class 1 shifts center by +1 in x
+    t = np.zeros((1, 8), np.float32)
+    t[0, 4] = 1.0  # dx for class 1
+    scores = np.array([[0.3, 0.7]], np.float32)
+    dec, assign = box_decoder_and_assign(priors, pv, t, scores,
+                                         box_clip=4.135)
+    d = dec.numpy().reshape(1, 2, 4)
+    # class 0 decodes back to the prior
+    np.testing.assert_allclose(d[0, 0], [0, 0, 9, 9], atol=1e-5)
+    # class 1: center (5,5) -> (5 + 0.1*1*10, 5) = (6,5), same size
+    np.testing.assert_allclose(d[0, 1], [1, 0, 10, 9], atol=1e-5)
+    # assign picks best fg class (1)
+    np.testing.assert_allclose(assign.numpy()[0], d[0, 1], atol=1e-5)
+    # fg score below the reference's 0.01 floor: prior wins
+    _, a2 = box_decoder_and_assign(priors, pv, t,
+                                   np.array([[1.0, 0.005]], np.float32),
+                                   box_clip=4.135)
+    np.testing.assert_allclose(a2.numpy()[0], priors[0])
+
+
 def test_generate_proposals():
     from paddle_tpu.vision.detection import (anchor_generator,
                                              generate_proposals)
