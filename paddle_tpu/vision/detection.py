@@ -857,6 +857,83 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
     return Tensor(out), Tensor(np.asarray(len(dets), np.int32))
 
 
+def locality_aware_nms(bboxes, scores, score_threshold: float,
+                       nms_top_k: int = -1, keep_top_k: int = -1,
+                       nms_threshold: float = 0.3,
+                       normalized: bool = True):
+    """Locality-aware NMS (EAST OCR). ~ detection.py:3430 /
+    locality_aware_nms_op.cc: a linear pre-pass MERGES consecutive
+    same-class boxes whose IoU exceeds the threshold by score-weighted
+    averaging (accumulating the scores), then standard per-class greedy
+    NMS runs on the merged set. bboxes (1, M, 4), scores (1, C, M)
+    (batch 1, as the reference op enforces) -> the multiclass_nms
+    padded contract: fixed keep_top_k rows when keep_top_k > 0, the
+    exact merged set otherwise.
+    """
+    barr = _arr(bboxes).astype(np.float32)
+    sarr = _arr(scores).astype(np.float32)
+    if barr.shape[0] != 1 or sarr.shape[0] != 1:
+        raise ValueError("locality_aware_nms supports batch 1 (got "
+                         f"{barr.shape[0]}) — the reference op contract")
+    b, s = barr[0], sarr[0]
+    C, M = s.shape
+    norm = 0.0 if normalized else 1.0
+
+    def _iou1(a, c):
+        x1, y1 = max(a[0], c[0]), max(a[1], c[1])
+        x2, y2 = min(a[2], c[2]), min(a[3], c[3])
+        inter = max(0.0, x2 - x1 + norm) * max(0.0, y2 - y1 + norm)
+        aa = (a[2] - a[0] + norm) * (a[3] - a[1] + norm)
+        ac = (c[2] - c[0] + norm) * (c[3] - c[1] + norm)
+        return inter / (aa + ac - inter + 1e-10)
+
+    mb, ms = [], []  # merged per class
+    for c in range(C):
+        cur_box, cur_sc = None, 0.0
+        boxes_c, scores_c = [], []
+        for m in range(M):
+            if s[c, m] <= score_threshold:
+                continue
+            box = b[m]
+            if cur_box is not None and \
+                    _iou1(cur_box, box) > nms_threshold:
+                # weighted merge, scores accumulate (EAST recipe)
+                w1, w2 = cur_sc, s[c, m]
+                cur_box = (cur_box * w1 + box * w2) / (w1 + w2)
+                cur_sc = w1 + w2
+            else:
+                if cur_box is not None:
+                    boxes_c.append(cur_box)
+                    scores_c.append(cur_sc)
+                cur_box, cur_sc = box.copy(), float(s[c, m])
+        if cur_box is not None:
+            boxes_c.append(cur_box)
+            scores_c.append(cur_sc)
+        mb.append(boxes_c)
+        ms.append(scores_c)
+
+    Mm = max((len(x) for x in mb), default=0)
+    if Mm == 0:
+        k = int(keep_top_k) if keep_top_k > 0 else 0
+        return (Tensor(np.full((1, max(k, 0), 6), -1.0, np.float32)),
+                Tensor(np.zeros((1,), np.int32)))
+    bb = np.zeros((1, C * Mm, 4), np.float32)
+    # -inf padding: empty slots can never pass the inner threshold, and
+    # the caller's threshold was already applied in the merge pre-pass
+    # (accumulated scores must not be re-thresholded)
+    ss = np.full((1, C, C * Mm), -np.inf, np.float32)
+    for c in range(C):
+        for i, (box, sc) in enumerate(zip(mb[c], ms[c])):
+            bb[0, c * Mm + i] = box
+            ss[0, c, c * Mm + i] = sc
+    return multiclass_nms(bb, ss, score_threshold=-np.inf,
+                          nms_top_k=nms_top_k,
+                          keep_top_k=keep_top_k if keep_top_k > 0
+                          else C * Mm,
+                          nms_threshold=nms_threshold,
+                          normalized=normalized, background_label=-1)
+
+
 def matrix_nms(bboxes, scores, score_threshold: float, post_threshold:
                float = 0.0, nms_top_k: int = 400, keep_top_k: int = 200,
                use_gaussian: bool = False, gaussian_sigma: float = 2.0,

@@ -108,6 +108,27 @@ def test_anchor_generator_centers():
     np.testing.assert_allclose(a[0, 1, 0], [8, -8, 40, 24])
 
 
+def test_locality_aware_nms_merges():
+    from paddle_tpu.vision.detection import locality_aware_nms
+    # two near-duplicate boxes MERGE (weighted average, scores add)
+    boxes = np.array([[[0, 0, 4, 4], [0, 0, 4.2, 4.2],
+                       [10, 10, 14, 14]]], np.float32)
+    scores = np.array([[[0.6, 0.6, 0.5]]], np.float32)  # C=1
+    out, cnt = locality_aware_nms(boxes, scores, score_threshold=0.1,
+                                  nms_threshold=0.5, keep_top_k=5)
+    c = int(cnt.numpy()[0])
+    assert c == 2
+    o = out.numpy()[0]
+    # merged box: equal weights -> midpoint corners, score 1.2
+    assert abs(o[0, 1] - 1.2) < 1e-5
+    np.testing.assert_allclose(o[0, 2:], [0, 0, 4.1, 4.1], atol=1e-5)
+    np.testing.assert_allclose(o[1, 2:], [10, 10, 14, 14])
+    # empty after threshold
+    _, cnt0 = locality_aware_nms(boxes, scores, score_threshold=0.9,
+                                 keep_top_k=5)
+    assert int(cnt0.numpy()[0]) == 0
+
+
 def test_matrix_nms_decay_and_jit():
     import jax
 
