@@ -559,6 +559,120 @@ def rpn_target_assign(anchor_box, anchor_var, gt_boxes, im_info,
             Tensor(tgt), Tensor(tgt_label))
 
 
+from .. import nn as _nn  # noqa: E402  (nn loads before vision)
+
+
+class MultiBoxHead(_nn.Layer):
+    """SSD multi-feature-map head. ~ detection.py:2120 (fluid
+    multi_box_head): one (loc, conf) conv pair per feature map + its
+    prior boxes, flattened and concatenated in matching prior order —
+    the glue between a backbone pyramid and ssd_loss/detection_output.
+    A real nn.Layer: parameters register with the parent model's
+    optimizer/state_dict. (static/nn.py's multi_box_head is the
+    declarative-mode sibling with its own fluid-faithful prior
+    counting; this class is the canonical eager implementation.)
+
+    forward(inputs, image) -> (mbox_locs (B, P, 4), mbox_confs
+    (B, P, num_classes), priors (P, 4) normalized, variances (P, 4)).
+    Priors are cached per feature/image shape tuple.
+    """
+
+    def __init__(self, num_classes, min_sizes, max_sizes=None,
+                 aspect_ratios=None, in_channels=None,
+                 variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                 clip=False, steps=None, offset=0.5):
+        super().__init__()
+        n_maps = len(min_sizes)
+        if in_channels is None:
+            raise ValueError("MultiBoxHead needs in_channels (one per "
+                             "feature map) to build its convs")
+        if aspect_ratios is None:
+            aspect_ratios = [[2.0]] * n_maps
+        # fluid accepts scalar per-map ratios (aspect_ratios=[2., 3.])
+        aspect_ratios = [list(a) if isinstance(a, (list, tuple))
+                         else [float(a)] for a in aspect_ratios]
+        steps = list(steps) if steps else [0.0] * n_maps
+        for name, seq in (("in_channels", in_channels),
+                          ("aspect_ratios", aspect_ratios),
+                          ("steps", steps)):
+            if len(seq) != n_maps:
+                raise ValueError(
+                    f"MultiBoxHead: {name} has {len(seq)} entries for "
+                    f"{n_maps} feature maps")
+        if max_sizes is not None and len(max_sizes) != n_maps:
+            raise ValueError(
+                f"MultiBoxHead: max_sizes has {len(max_sizes)} entries "
+                f"for {n_maps} feature maps")
+        self.num_classes = num_classes
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes) if max_sizes else None
+        self.aspect_ratios = aspect_ratios
+        self.variance = tuple(variance)
+        self.flip = flip
+        self.clip = clip
+        self.steps = steps
+        self.offset = offset
+        self._prior_cache = {}
+        self.loc_convs = _nn.LayerList()
+        self.conf_convs = _nn.LayerList()
+        self._prior_counts = []
+        for i, cin in enumerate(in_channels):
+            p = self._n_priors(i)
+            self._prior_counts.append(p)
+            self.loc_convs.append(_nn.Conv2D(cin, p * 4, 3, padding=1))
+            self.conf_convs.append(_nn.Conv2D(cin, p * num_classes, 3,
+                                              padding=1))
+
+    def _n_priors(self, i: int) -> int:
+        # derived by running the REAL prior generator on a 1x1 map, so
+        # the conv channel counts can never desync from prior_box's
+        # counting rules
+        mx = [self.max_sizes[i]] if self.max_sizes else None
+        boxes, _ = prior_box(np.zeros((1, 1, 1, 1), np.float32),
+                             np.zeros((1, 1, 8, 8), np.float32),
+                             [self.min_sizes[i]], mx,
+                             self.aspect_ratios[i], self.variance,
+                             self.flip, self.clip)
+        return boxes.shape[2]
+
+    def _priors_for(self, i, fm, image):
+        key = (i, tuple(fm.shape[2:]), tuple(image.shape[2:]))
+        if key not in self._prior_cache:
+            mx = [self.max_sizes[i]] if self.max_sizes else None
+            boxes, v = prior_box(
+                fm, image, [self.min_sizes[i]], mx,
+                self.aspect_ratios[i], self.variance, self.flip,
+                self.clip, (self.steps[i], self.steps[i]), self.offset)
+            self._prior_cache[key] = (_arr(boxes).reshape(-1, 4),
+                                      _arr(v).reshape(-1, 4))
+        return self._prior_cache[key]
+
+    def forward(self, inputs, image):
+        from ..ops.manipulation import concat
+        if len(inputs) != len(self.loc_convs):
+            raise ValueError(
+                f"MultiBoxHead built for {len(self.loc_convs)} feature "
+                f"maps, got {len(inputs)}")
+        locs, confs, pri, var = [], [], [], []
+        for i, fm in enumerate(inputs):
+            p = self._prior_counts[i]  # fixed at __init__
+            loc_map = self.loc_convs[i](fm)
+            conf_map = self.conf_convs[i](fm)
+            B = loc_map.shape[0]
+            # (B, p*4, H, W) -> (B, H, W, p*4) -> (B, H*W*p, 4):
+            # matches prior_box's (H, W, P, 4) flatten order
+            H, W = loc_map.shape[2], loc_map.shape[3]
+            locs.append(loc_map.transpose([0, 2, 3, 1])
+                        .reshape([B, H * W * p, 4]))
+            confs.append(conf_map.transpose([0, 2, 3, 1])
+                         .reshape([B, H * W * p, self.num_classes]))
+            pb, pv = self._priors_for(i, fm, image)
+            pri.append(pb)
+            var.append(pv)
+        return (concat(locs, axis=1), concat(confs, axis=1),
+                Tensor(np.concatenate(pri)), Tensor(np.concatenate(var)))
+
+
 def detection_output(loc, scores, prior_box, prior_box_var,
                      background_label: int = 0,
                      nms_threshold: float = 0.3, nms_top_k: int = 400,

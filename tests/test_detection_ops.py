@@ -424,6 +424,42 @@ def test_generate_proposal_labels():
     assert (lab2.numpy() == 3).sum() == (lab == 3).sum()
 
 
+def test_multi_box_head():
+    from paddle_tpu.vision.detection import MultiBoxHead, ssd_loss
+    paddle.seed(0)
+    head = MultiBoxHead(num_classes=3, min_sizes=[4.0, 8.0],
+                        max_sizes=[8.0, 16.0],
+                        aspect_ratios=[[2.0], [2.0]],
+                        in_channels=[8, 16], flip=True)
+    img = paddle.randn([2, 3, 32, 32])
+    f1 = paddle.randn([2, 8, 8, 8])
+    f2 = paddle.randn([2, 16, 4, 4])
+    locs, confs, priors, var = head([f1, f2], img)
+    # priors per cell: 1 + 2 (ar 2 + flip) + 1 (sqrt min*max) = 4
+    P = 8 * 8 * 4 + 4 * 4 * 4
+    assert locs.shape == [2, P, 4]
+    assert confs.shape == [2, P, 3]
+    assert priors.shape == [P, 4] and var.shape == [P, 4]
+    # the head output feeds ssd_loss directly (prior order matches)
+    gt = np.array([[4, 4, 12, 12]], np.float32)
+    loss = ssd_loss(locs[0], confs[0], gt, np.array([1], np.int64),
+                    priors.numpy() * 32)
+    assert np.isfinite(float(loss))
+    assert len(head.parameters()) == 8  # 2 maps x (loc+conf) x (w+b)
+    # real nn.Layer: registers under a parent model
+    from paddle_tpu import nn
+
+    class Parent(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.head = head
+
+    assert len(Parent().parameters()) == 8
+    # second call with the same shapes hits the prior cache
+    head([f1, f2], img)
+    assert len(head._prior_cache) == 2
+
+
 def test_detection_output_ssd_inference():
     from paddle_tpu.vision.detection import detection_output
     priors = np.array([[0, 0, 8, 8], [8, 8, 16, 16]], np.float32)
