@@ -1,0 +1,219 @@
+"""MFU-vs-scale measurements arguing the 8B/40% north star (BASELINE #4).
+
+VERDICT r3 item 2: the MFU story was one point (0.44B/S2048/0.766).
+This tool adds the missing axes on the one real chip:
+
+  ladder   — largest model trainable fully in HBM with bf16 adamw
+             moments + remat="dots": tries descending configs, reports
+             step_ms/MFU for the first that fits and OOM records for the
+             rest. (The >2B regime previously required pinned-host
+             moment offload at 0.105 MFU — this row shows the in-HBM
+             frontier instead.)
+  tp_shard — the per-chip compute of Llama-3-8B sliced TP=8 (BASELINE
+             config 4's per-chip shard): hand-built scan over 32 layers
+             of the sliced matmul shapes (q 4096->512, kv 4096->128,
+             o 512->4096, ffn 4096->1792->4096, vocab shard 16032) with
+             GQA flash attention at S=8192, fwd+bwd, remat per layer.
+             One chip cannot measure ICI collectives; this row bounds
+             the compute term of the pod MFU projection (comm term comes
+             from parallel/cost_model).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/mfu_scale.py ladder
+     PYTHONPATH=/root/repo:/root/.axon_site python tools/mfu_scale.py tp_shard
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+PEAK = 197e12  # v5e bf16
+
+
+def run_ladder():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama import llama_train_step_factory
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    # (layers, hidden, inter, heads, kv) descending ~2.4B -> ~1.5B; GQA
+    # kv=4 keeps the KV projections from dominating the HBM budget
+    ladder = [(32, 2560, 6912, 20, 4),
+              (26, 2560, 6912, 20, 4),
+              (20, 2560, 6912, 20, 4)]
+    if not on_tpu:
+        ladder = [(2, 64, 128, 4, 2)]
+    B, S = (4, 2048) if on_tpu else (1, 128)
+    for L, h, inter, heads, kv in ladder:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=h,
+                          intermediate_size=inter, num_hidden_layers=L,
+                          num_attention_heads=heads, num_key_value_heads=kv,
+                          max_position_embeddings=2048, dtype=jnp.bfloat16)
+        try:
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            model.to(dtype="bfloat16")
+            mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+            params, opt_state, step, _ = llama_train_step_factory(
+                model, mesh, learning_rate=1e-4, remat="dots",
+                accum_dtype=jnp.bfloat16)
+            n_params = sum(int(np.prod(v.shape)) for v in params.values())
+            rng = np.random.default_rng(0)
+            tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
+                              jnp.int32)
+            lab = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
+                              jnp.int32)
+            loss = None
+            t0 = time.perf_counter()
+            for _ in range(2):
+                params, opt_state, loss = step(params, opt_state, tok, lab)
+            float(loss)
+            compile_s = time.perf_counter() - t0
+            steps = 10 if on_tpu else 2
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state, tok, lab)
+            lv = float(loss)
+            dt = (time.perf_counter() - t0) / steps
+            flops = 6 * n_params * B * S + 12 * L * h * S * B * S
+            rec = {"mode": "ladder", "params_b": round(n_params / 1e9, 3),
+                   "layers": L, "hidden": h, "B": B, "S": S,
+                   "moments": "bf16", "remat": "dots",
+                   "step_ms": round(dt * 1e3, 1),
+                   "mfu": round(flops / dt / PEAK, 4),
+                   "loss": lv, "compile_s": round(compile_s, 1),
+                   "device": str(jax.devices()[0])}
+            print(json.dumps(rec), flush=True)
+            return  # largest fitting config measured — done
+        except Exception as e:  # noqa: BLE001 — OOM is a data point
+            msg = repr(e)
+            oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
+            print(json.dumps({"mode": "ladder", "layers": L, "hidden": h,
+                              "oom": oom, "error": msg[-200:]}), flush=True)
+            # free everything before the next rung
+            del cfg
+            import gc
+            gc.collect()
+
+
+def run_tp_shard():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.flash_attention_gqa import (
+        grouped_flash_attention)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        B, S, L = 1, 8192, 32
+        H, HKV, D, HID, INTER, VOC = 4, 1, 128, 4096, 1792, 16032
+        dtype = jnp.bfloat16
+    else:
+        B, S, L = 1, 256, 2
+        H, HKV, D, HID, INTER, VOC = 2, 1, 32, 64, 96, 128
+        dtype = jnp.float32
+
+    rng = np.random.default_rng(0)
+
+    def w(*shape):
+        return jnp.asarray(
+            rng.standard_normal(shape) * (0.02), dtype)
+
+    # stacked per-layer weights so one lax.scan covers all 32 layers
+    ws = {
+        "wq": w(L, HID, H * D), "wk": w(L, HID, HKV * D),
+        "wv": w(L, HID, HKV * D), "wo": w(L, H * D, HID),
+        "wg": w(L, HID, INTER), "wu": w(L, HID, INTER),
+        "wd": w(L, INTER, HID),
+    }
+    emb = w(VOC, HID)
+    head = w(HID, VOC)
+
+    def rms(x):
+        v = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+        return (x.astype(jnp.float32) * jax.lax.rsqrt(v + 1e-5)).astype(
+            x.dtype)
+
+    def layer(x, lw):
+        def body(x, lw):
+            h0 = rms(x)
+            q = (h0 @ lw["wq"]).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+            k = (h0 @ lw["wk"]).reshape(B, S, HKV, D).transpose(0, 2, 1, 3)
+            v = (h0 @ lw["wv"]).reshape(B, S, HKV, D).transpose(0, 2, 1, 3)
+            a = grouped_flash_attention(q, k, v, True)
+            a = a.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+            x = x + (a @ lw["wo"]).astype(x.dtype)
+            h1 = rms(x)
+            f = (jax.nn.silu((h1 @ lw["wg"]).astype(jnp.float32)).astype(
+                x.dtype) * (h1 @ lw["wu"])) @ lw["wd"]
+            return x + f.astype(x.dtype)
+        return jax.checkpoint(body)(x, lw)
+
+    def loss_fn(ws, emb, head, ids, labels):
+        x = emb[ids]
+        def scan_body(x, lw):
+            return layer(x, lw), None
+        x, _ = jax.lax.scan(scan_body, x, ws)
+        logits = (rms(x) @ head).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(lp, labels[..., None],
+                                             -1))
+
+    ids = jnp.asarray(rng.integers(0, VOC, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, VOC, (B, S)), jnp.int32)
+
+    @jax.jit
+    def train(ws, emb, head):
+        g = jax.grad(loss_fn, argnums=(0, 1, 2))(ws, emb, head, ids, labels)
+        lr = 1e-6
+        new_ws = {k: (v - lr * g[0][k].astype(jnp.float32)).astype(v.dtype)
+                  for k, v in ws.items()}
+        return (new_ws, (emb - lr * g[1].astype(jnp.float32)).astype(
+            emb.dtype), (head - lr * g[2].astype(jnp.float32)).astype(
+            head.dtype))
+
+    t0 = time.perf_counter()
+    ws, emb, head = train(ws, emb, head)
+    float(emb[0, 0])
+    compile_s = time.perf_counter() - t0
+    steps = 8 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ws, emb, head = train(ws, emb, head)
+    float(emb[0, 0])
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = sum(int(np.prod(v.shape)) for v in ws.values()) + \
+        int(np.prod(emb.shape)) + int(np.prod(head.shape))
+    tok = B * S
+    # attention flops at the sliced head count: fwd 2*2*B*H*S^2*D, x3 bwd
+    attn = 12 * L * H * S * S * D * B
+    flops = 6 * n_params * tok + attn
+    rec = {"mode": "tp_shard",
+           "what": "llama3-8b TP=8 per-chip shard shapes, fwd+bwd+sgd",
+           "shard_params_b": round(n_params / 1e9, 3),
+           "B": B, "S": S, "layers": L,
+           "step_ms": round(dt * 1e3, 1),
+           "compute_mfu": round(flops / dt / PEAK, 4),
+           "compile_s": round(compile_s, 1),
+           "note": "compute term only; ICI comm term from cost model",
+           "device": str(jax.devices()[0])}
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "ladder"
+    if mode == "ladder":
+        run_ladder()
+    elif mode == "tp_shard":
+        run_tp_shard()
+    else:
+        raise SystemExit("mode: ladder | tp_shard")

@@ -1,0 +1,131 @@
+"""Amortized chip benches for the sequence-parallel attention family.
+
+VERDICT r3 item 3: ring attention, Ulysses, and splash density scaling
+had CPU-correctness tests only — per-call chip timings were swamped by
+the ~8-10 ms axon dispatch floor. This tool scan-chains ITERS fwd+bwd
+iterations inside ONE jit (the flash_bwd_sweep.py pattern) so per-layer
+cost is measurable, and reports each variant as a fraction of dense
+flash-attention throughput at equal shapes.
+
+Rows at the bench shape (B=8, H=12, S=2048, D=128, bf16):
+  - flash dense causal (the yardstick)
+  - ring attention on a 1-device 'sep' mesh (machinery overhead vs flash;
+    the multi-chip claim is comm-overlap, which one chip cannot measure —
+    this row bounds the non-comm overhead)
+  - Ulysses on a 1-device 'sep' mesh (same purpose)
+  - splash banded at window S, S/2, S/4, S/8 (density scaling curve: the
+    reference's sparse_attention_op.cu pays dense compute at any
+    sparsity; splash cost should track density)
+Long-context rows (B=2, S=8192): flash vs ring vs splash window 2048.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/seq_attn_bench.py
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+ITERS = 8
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    from paddle_tpu.ops.pallas.splash_attention import (banded_block_mask,
+                                                        splash_attention)
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    from paddle_tpu.parallel.ulysses import ulysses_attention
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("sep",))
+
+    def bench(fn, q, k, v, repeats=3):
+        """min ms per fwd+bwd over a scan chain of ITERS grads."""
+        g = jax.grad(lambda a, b, c: fn(a, b, c).astype(jnp.float32).sum(),
+                     argnums=(0, 1, 2))
+
+        def many(q, k, v):
+            def body(carry, _):
+                cq, ck, cv = carry
+                dq, dk, dv = g(cq, ck, cv)
+                # all three grads feed the carry or XLA DCEs the dkv pass
+                return ((cq + (1e-6 * dq).astype(cq.dtype),
+                         ck + (1e-6 * dk).astype(ck.dtype),
+                         cv + (1e-6 * dv).astype(cv.dtype)), None)
+            (cq, _, _), _ = jax.lax.scan(body, (q, k, v), None, length=ITERS)
+            return cq
+
+        f = jax.jit(many)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = f(q, k, v)
+            float(out[0, 0, 0, 0])  # host readback = the only real sync
+            times.append(time.perf_counter() - t0)
+        return min(times[1:]) / ITERS * 1e3, round(times[0], 1)
+
+    def make_qkv(B, H, S, D, dtype):
+        rng = np.random.default_rng(0)
+        return tuple(jnp.asarray(rng.standard_normal((B, H, S, D)), dtype)
+                     for _ in range(3))
+
+    if on_tpu:
+        shapes = [("bench", 8, 12, 2048, 128, jnp.bfloat16),
+                  ("long", 2, 12, 8192, 128, jnp.bfloat16)]
+    else:
+        shapes = [("bench", 1, 2, 512, 64, jnp.float32)]
+
+    rows = []
+
+    def emit(rec):
+        rec["device"] = str(dev)
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    for tag, B, H, S, D, dtype in shapes:
+        q, k, v = make_qkv(B, H, S, D, dtype)
+        flash_ms, comp = bench(lambda a, b, c: flash_attention(a, b, c, True),
+                               q, k, v)
+        emit({"shape": tag, "variant": "flash_dense", "S": S, "B": B,
+              "ms": round(flash_ms, 3), "compile_s": comp})
+
+        ms, comp = bench(lambda a, b, c: ring_attention(
+            a, b, c, mesh, "sep", True), q, k, v)
+        emit({"shape": tag, "variant": "ring_p1", "S": S, "B": B,
+              "ms": round(ms, 3), "compile_s": comp,
+              "frac_of_flash": round(flash_ms / ms, 3)})
+
+        if tag == "bench":
+            ms, comp = bench(lambda a, b, c: ulysses_attention(
+                a, b, c, mesh, "sep", True), q, k, v)
+            emit({"shape": tag, "variant": "ulysses_p1", "S": S, "B": B,
+                  "ms": round(ms, 3), "compile_s": comp,
+                  "frac_of_flash": round(flash_ms / ms, 3)})
+            windows = (S, S // 2, S // 4, S // 8)
+        else:
+            windows = (2048,)
+
+        for w in windows:
+            bm = banded_block_mask(S, S, 128, 128, w)
+            ms, comp = bench(
+                lambda a, b, c, bm=bm, w=w: splash_attention(
+                    a, b, c, bm, True, None, 128, 128, w), q, k, v)
+            emit({"shape": tag, "variant": f"splash_w{w}", "S": S, "B": B,
+                  "density": round(float(bm.mean()), 3),
+                  "ms": round(ms, 3), "compile_s": comp,
+                  "frac_of_flash": round(flash_ms / ms, 3)})
+
+    with open("/tmp/seq_attn_bench.json", "w") as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
