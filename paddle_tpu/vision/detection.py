@@ -6,9 +6,10 @@ multiclass_nms:3276) and their C++ ops under
 paddle/fluid/operators/detection/. TPU-shaped where it matters:
 prior/anchor generation and box coding are pure array math (jit-able,
 static shapes); multiclass_nms returns FIXED-size keep_top_k-padded
-results (label -1 padding) instead of the reference's LoD
-variable-length outputs — the standard accelerator-side detection
-post-processing contract.
+results (label -1 padding) when keep_top_k >= 0 instead of the
+reference's LoD variable-length outputs — the standard
+accelerator-side detection post-processing contract (keep_top_k < 0
+keeps everything and is host-only, data-dependent width).
 """
 from __future__ import annotations
 
@@ -227,20 +228,29 @@ def anchor_generator(input, anchor_sizes: Sequence[float],
     (anchors (H,W,A,4), variances (H,W,A,4))."""
     fm = _arr(input)
     H, W = fm.shape[2], fm.shape[3]
+    # Detectron-style anchors (anchor_generator_op.h): base w/h are ROUNDED
+    # at stride scale then scaled by size/stride; ratios are the OUTER loop
+    # (sizes inner) — the ordering must match or the 4A delta channels of a
+    # reference-trained RPN head pair with the wrong anchors
+    sw, sh = float(stride[0]), float(stride[1])
     whs = []
-    for s in anchor_sizes:
-        area = float(s) * float(s)
-        for ar in aspect_ratios:
-            w = np.sqrt(area / ar)
-            whs.append((w, w * ar))
+    for ar in aspect_ratios:
+        base_w = np.round(np.sqrt(sw * sh / ar))
+        base_h = np.round(base_w * ar)
+        for s in anchor_sizes:
+            whs.append((float(s) / sw * base_w, float(s) / sh * base_h))
     A = len(whs)
-    cxg, cyg = _cell_centers(H, W, stride[0], stride[1], offset)
+    # centers: idx*stride + offset*(stride-1), corners at +/-0.5*(w-1)
+    cxg = (np.arange(W, dtype=np.float32) * sw + offset * (sw - 1))[None, :]
+    cyg = (np.arange(H, dtype=np.float32) * sh + offset * (sh - 1))[:, None]
+    cxg = np.broadcast_to(cxg, (H, W))
+    cyg = np.broadcast_to(cyg, (H, W))
     wh = np.asarray(whs, np.float32)
     anchors = np.empty((H, W, A, 4), np.float32)
-    anchors[..., 0] = cxg[:, :, None] - wh[None, None, :, 0] / 2
-    anchors[..., 1] = cyg[:, :, None] - wh[None, None, :, 1] / 2
-    anchors[..., 2] = cxg[:, :, None] + wh[None, None, :, 0] / 2
-    anchors[..., 3] = cyg[:, :, None] + wh[None, None, :, 1] / 2
+    anchors[..., 0] = cxg[:, :, None] - (wh[None, None, :, 0] - 1) / 2
+    anchors[..., 1] = cyg[:, :, None] - (wh[None, None, :, 1] - 1) / 2
+    anchors[..., 2] = cxg[:, :, None] + (wh[None, None, :, 0] - 1) / 2
+    anchors[..., 3] = cyg[:, :, None] + (wh[None, None, :, 1] - 1) / 2
     return Tensor(anchors), Tensor(_broadcast_var(variance,
                                                   anchors.shape))
 
@@ -746,13 +756,18 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
     d[..., 2:] = np.minimum(d[..., 2:], box_clip)
     dec = np.array(_arr(box_coder(p, None, d, "decode_center_size",
                                   box_normalized=False, axis=1)))
-    # best foreground class per roi (class 0 is background); the
-    # reference requires score >= 0.01 to assign a class box
-    fg = s[:, 1:]
-    best = fg.argmax(axis=1) + 1 if C > 1 else np.zeros(R, np.int64)
-    has_fg = (fg.max(axis=1) >= 0.01) if C > 1 else np.zeros(R, bool)
-    assign = np.where(has_fg[:, None],
-                      dec[np.arange(R), best], p)
+    # best foreground class per roi (class 0 is background); the reference
+    # assigns the best non-background class's decoded box — its only gate
+    # is the max_score = -1 initializer with a strict '>', so the prior
+    # wins only when every fg score is <= -1 (raw-logit callers)
+    # (box_decoder_and_assign_op.h:77-97)
+    if C > 1:
+        fg = s[:, 1:]
+        best = fg.argmax(axis=1) + 1
+        has_fg = fg.max(axis=1) > -1
+        assign = np.where(has_fg[:, None], dec[np.arange(R), best], p)
+    else:
+        assign = p
     return Tensor(dec.reshape(R, C * 4)), Tensor(assign.astype(
         np.float32))
 
@@ -977,19 +992,26 @@ def locality_aware_nms(bboxes, scores, score_threshold: float,
                        normalized: bool = True):
     """Locality-aware NMS (EAST OCR). ~ detection.py:3430 /
     locality_aware_nms_op.cc: a linear pre-pass MERGES consecutive
-    same-class boxes whose IoU exceeds the threshold by score-weighted
-    averaging (accumulating the scores), then standard per-class greedy
-    NMS runs on the merged set. bboxes (1, M, 4), scores (1, C, M)
-    (batch 1, as the reference op enforces) -> the multiclass_nms
-    padded contract: fixed keep_top_k rows when keep_top_k > 0, the
-    exact merged set otherwise.
+    overlapping boxes unconditionally by score-weighted averaging
+    (accumulating the scores) — score_threshold applies only to the
+    accumulated post-merge scores — then standard per-class greedy NMS
+    runs on the merged set. The box array is SHARED and mutated across
+    classes (the reference's bbox_slice aliases the input), so class
+    c > 0 merges against boxes already merged by earlier classes, and
+    the output gathers box coordinates after ALL classes ran.
+
+    bboxes (1, M, 4), scores (1, C, M) (batch 1, as the reference op
+    enforces) -> out (1, keep_top_k, 6) padded with -1 when
+    keep_top_k >= 0 (0 keeps nothing, as the reference's
+    `keep_top_k > -1` resize does); keep_top_k < 0 returns the exact
+    kept set (data-dependent width). counts (1,) int32.
     """
     barr = _arr(bboxes).astype(np.float32)
     sarr = _arr(scores).astype(np.float32)
     if barr.shape[0] != 1 or sarr.shape[0] != 1:
         raise ValueError("locality_aware_nms supports batch 1 (got "
                          f"{barr.shape[0]}) — the reference op contract")
-    b, s = barr[0], sarr[0]
+    b, s = barr[0].copy(), sarr[0]
     C, M = s.shape
     norm = 0.0 if normalized else 1.0
 
@@ -1001,51 +1023,43 @@ def locality_aware_nms(bboxes, scores, score_threshold: float,
         ac = (c[2] - c[0] + norm) * (c[3] - c[1] + norm)
         return inter / (aa + ac - inter + 1e-10)
 
-    mb, ms = [], []  # merged per class
+    picked = []  # (class, score, box_index) — boxes gathered at the end
     for c in range(C):
-        cur_box, cur_sc = None, 0.0
-        boxes_c, scores_c = [], []
-        for m in range(M):
-            if s[c, m] <= score_threshold:
-                continue
-            box = b[m]
-            if cur_box is not None and \
-                    _iou1(cur_box, box) > nms_threshold:
-                # weighted merge, scores accumulate (EAST recipe)
-                w1, w2 = cur_sc, s[c, m]
-                cur_box = (cur_box * w1 + box * w2) / (w1 + w2)
-                cur_sc = w1 + w2
+        sc = s[c].copy()
+        skip = np.ones(M, bool)
+        index = -1
+        for i in range(M):
+            if index > -1:
+                if _iou1(b[i], b[index]) > nms_threshold:
+                    # PolyWeightedMerge: merge box i INTO slot `index`
+                    # of the shared array; scores accumulate
+                    w1, w2 = sc[i], sc[index]
+                    b[index] = (b[i] * w1 + b[index] * w2) / (w1 + w2)
+                    sc[index] += sc[i]
+                else:
+                    skip[index] = False
+                    index = i
             else:
-                if cur_box is not None:
-                    boxes_c.append(cur_box)
-                    scores_c.append(cur_sc)
-                cur_box, cur_sc = box.copy(), float(s[c, m])
-        if cur_box is not None:
-            boxes_c.append(cur_box)
-            scores_c.append(cur_sc)
-        mb.append(boxes_c)
-        ms.append(scores_c)
+                index = i
+        if index > -1:
+            skip[index] = False
+        cand = np.nonzero((sc > score_threshold) & ~skip)[0]
+        if len(cand) == 0:
+            continue
+        order = cand[np.argsort(-sc[cand], kind="stable")]
+        if nms_top_k > -1 and len(order) > nms_top_k:
+            order = order[:nms_top_k]
+        for k in _greedy_nms(b[order], None, nms_threshold, norm, 1.0):
+            picked.append((c, float(sc[order[k]]), int(order[k])))
 
-    Mm = max((len(x) for x in mb), default=0)
-    if Mm == 0:
-        k = int(keep_top_k) if keep_top_k > 0 else 0
-        return (Tensor(np.full((1, max(k, 0), 6), -1.0, np.float32)),
-                Tensor(np.zeros((1,), np.int32)))
-    bb = np.zeros((1, C * Mm, 4), np.float32)
-    # -inf padding: empty slots can never pass the inner threshold, and
-    # the caller's threshold was already applied in the merge pre-pass
-    # (accumulated scores must not be re-thresholded)
-    ss = np.full((1, C, C * Mm), -np.inf, np.float32)
-    for c in range(C):
-        for i, (box, sc) in enumerate(zip(mb[c], ms[c])):
-            bb[0, c * Mm + i] = box
-            ss[0, c, c * Mm + i] = sc
-    return multiclass_nms(bb, ss, score_threshold=-np.inf,
-                          nms_top_k=nms_top_k,
-                          keep_top_k=keep_top_k if keep_top_k > 0
-                          else C * Mm,
-                          nms_threshold=nms_threshold,
-                          normalized=normalized, background_label=-1)
+    picked.sort(key=lambda d: -d[1])
+    if keep_top_k > -1:
+        picked = picked[:int(keep_top_k)]
+    K = int(keep_top_k) if keep_top_k >= 0 else len(picked)
+    out = np.full((1, K, 6), -1.0, np.float32)
+    for r, (c, sv, bi) in enumerate(picked):
+        out[0, r, 0], out[0, r, 1], out[0, r, 2:] = c, sv, b[bi]
+    return Tensor(out), Tensor(np.asarray([len(picked)], np.int32))
 
 
 def matrix_nms(bboxes, scores, score_threshold: float, post_threshold:
@@ -1108,8 +1122,9 @@ def matrix_nms(bboxes, scores, score_threshold: float, post_threshold:
         mask = s > score_threshold
         s_in = jnp.where(mask, s, 0.0)
         # per-class top-nms_top_k pre-filter (bounds the O(k^2) decay
-        # matrix and matches the reference's pre-decay drop)
-        k0 = min(int(nms_top_k), M) if nms_top_k > 0 else M
+        # matrix and matches the reference's pre-decay drop; nms_util.h
+        # truncates whenever top_k > -1, so 0 keeps nothing)
+        k0 = min(int(nms_top_k), M) if nms_top_k > -1 else M
 
         def per_class(bb, sc):
             if k0 == M:
@@ -1168,10 +1183,12 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
                    nms_eta: float = 1.0, background_label: int = 0):
     """Per-class NMS + cross-class keep_top_k. ~ detection.py:3276 /
     multiclass_nms_op.cc — with the TPU-side contract: FIXED-size
-    outputs padded to keep_top_k per image.
+    outputs padded to keep_top_k per image when keep_top_k >= 0.
+    keep_top_k < 0 keeps everything; the padded width then becomes the
+    largest per-image post-NMS count (data-dependent — host-only path).
 
     bboxes (N, M, 4), scores (N, C, M) ->
-      out (N, keep_top_k, 6) rows [label, score, x1, y1, x2, y2]
+      out (N, K, 6) rows [label, score, x1, y1, x2, y2]
       (label -1 on padding), valid counts (N,) int32.
     """
     b = _arr(bboxes).astype(np.float32)
@@ -1179,8 +1196,7 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
     N, C, M = s.shape
     norm = 0.0 if normalized else 1.0
 
-    out = np.full((N, int(keep_top_k), 6), -1.0, np.float32)
-    counts = np.zeros((N,), np.int32)
+    per_image = []
     for n in range(N):
         dets = []  # (label, score, box)
         for c in range(C):
@@ -1190,13 +1206,25 @@ def multiclass_nms(bboxes, scores, score_threshold: float = 0.0,
             if not mask.any():
                 continue
             idx = np.nonzero(mask)[0]
-            if nms_top_k > 0 and len(idx) > nms_top_k:
+            # nms_util.h resizes whenever top_k > -1 (0 keeps nothing)
+            if nms_top_k > -1 and len(idx) > nms_top_k:
                 idx = idx[np.argsort(-s[n, c, idx])[:nms_top_k]]
             for k in _greedy_nms(b[n, idx], s[n, c, idx], nms_threshold,
                                  norm, nms_eta):
                 dets.append((c, s[n, c, idx[k]], b[n, idx[k]]))
         dets.sort(key=lambda d: -d[1])
-        dets = dets[:int(keep_top_k)]
+        if keep_top_k > -1:
+            dets = dets[:int(keep_top_k)]
+        per_image.append(dets)
+
+    # keep_top_k < 0 means keep ALL detections; 0 keeps none — the
+    # reference resizes whenever keep_top_k > -1 (multiclass_nms_op.cc).
+    # The unlimited case pads to the largest per-image post-NMS count.
+    K = int(keep_top_k) if keep_top_k >= 0 else \
+        max((len(d) for d in per_image), default=0)
+    out = np.full((N, K, 6), -1.0, np.float32)
+    counts = np.zeros((N,), np.int32)
+    for n, dets in enumerate(per_image):
         for r, (c, sc, box) in enumerate(dets):
             out[n, r, 0] = c
             out[n, r, 1] = sc

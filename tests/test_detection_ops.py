@@ -102,10 +102,12 @@ def test_anchor_generator_centers():
                                     stride=[16.0, 16.0])
     assert anchors.shape == [2, 3, 1, 4]
     a = anchors.numpy()
-    # cell (0,0): center (8,8), 32x32 anchor
-    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24])
+    # reference convention (anchor_generator_op.h): center at
+    # idx*stride + offset*(stride-1) = 7.5, corners at +/-0.5*(w-1)
+    # with base_w = round(sqrt(256/1)) = 16 scaled by 32/16 -> w = 32
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 23, 23])
     # x stride moves the center by 16
-    np.testing.assert_allclose(a[0, 1, 0], [8, -8, 40, 24])
+    np.testing.assert_allclose(a[0, 1, 0], [8, -8, 39, 23])
 
 
 def test_locality_aware_nms_merges():
@@ -123,10 +125,13 @@ def test_locality_aware_nms_merges():
     assert abs(o[0, 1] - 1.2) < 1e-5
     np.testing.assert_allclose(o[0, 2:], [0, 0, 4.1, 4.1], atol=1e-5)
     np.testing.assert_allclose(o[1, 2:], [10, 10, 14, 14])
-    # empty after threshold
-    _, cnt0 = locality_aware_nms(boxes, scores, score_threshold=0.9,
-                                 keep_top_k=5)
-    assert int(cnt0.numpy()[0]) == 0
+    # score_threshold applies to the ACCUMULATED post-merge scores
+    # (locality_aware_nms_op.cc): the merged pair's 1.2 beats 0.9 and
+    # survives; the lone 0.5 box is dropped
+    out0, cnt0 = locality_aware_nms(boxes, scores, score_threshold=0.9,
+                                    keep_top_k=5)
+    assert int(cnt0.numpy()[0]) == 1
+    assert abs(out0.numpy()[0, 0, 1] - 1.2) < 1e-5
 
 
 def test_matrix_nms_decay_and_jit():
@@ -297,11 +302,13 @@ def test_box_decoder_and_assign():
     np.testing.assert_allclose(d[0, 1], [1, 0, 10, 9], atol=1e-5)
     # assign picks best fg class (1)
     np.testing.assert_allclose(assign.numpy()[0], d[0, 1], atol=1e-5)
-    # fg score below the reference's 0.01 floor: prior wins
+    # the reference has NO score floor (box_decoder_and_assign_op.h:77-97):
+    # the best non-background class's decoded box is assigned whenever
+    # class_num > 1, even for confident-background rois
     _, a2 = box_decoder_and_assign(priors, pv, t,
                                    np.array([[1.0, 0.005]], np.float32),
                                    box_clip=4.135)
-    np.testing.assert_allclose(a2.numpy()[0], priors[0])
+    np.testing.assert_allclose(a2.numpy()[0], d[0, 1], atol=1e-5)
 
 
 def test_generate_proposals():

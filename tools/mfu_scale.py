@@ -51,55 +51,59 @@ def run_ladder():
     if not on_tpu:
         ladder = [(2, 64, 128, 4, 2)]
     B, S = (4, 2048) if on_tpu else (1, 128)
-    for L, h, inter, heads, kv in ladder:
+
+    def try_rung(L, h, inter, heads, kv):
+        # all device buffers (params/moments/compiled step) are locals of
+        # this frame: an OOM unwinds the frame and frees them before the
+        # next rung allocates
         cfg = LlamaConfig(vocab_size=32000, hidden_size=h,
                           intermediate_size=inter, num_hidden_layers=L,
                           num_attention_heads=heads, num_key_value_heads=kv,
                           max_position_embeddings=2048, dtype=jnp.bfloat16)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.to(dtype="bfloat16")
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        params, opt_state, step, _ = llama_train_step_factory(
+            model, mesh, learning_rate=1e-4, remat="dots",
+            accum_dtype=jnp.bfloat16)
+        n_params = sum(int(np.prod(v.shape)) for v in params.values())
+        rng = np.random.default_rng(0)
+        tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
+                          jnp.int32)
+        lab = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
+                          jnp.int32)
+        loss = None
+        t0 = time.perf_counter()
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, tok, lab)
+        float(loss)
+        compile_s = time.perf_counter() - t0
+        steps = 10 if on_tpu else 2
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tok, lab)
+        lv = float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        flops = 6 * n_params * B * S + 12 * L * h * S * B * S
+        return {"mode": "ladder", "params_b": round(n_params / 1e9, 3),
+                "layers": L, "hidden": h, "B": B, "S": S,
+                "moments": "bf16", "remat": "dots",
+                "step_ms": round(dt * 1e3, 1),
+                "mfu": round(flops / dt / PEAK, 4),
+                "loss": lv, "compile_s": round(compile_s, 1),
+                "device": str(jax.devices()[0])}
+
+    import gc
+    for L, h, inter, heads, kv in ladder:
         try:
-            paddle.seed(0)
-            model = LlamaForCausalLM(cfg)
-            model.to(dtype="bfloat16")
-            mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
-            params, opt_state, step, _ = llama_train_step_factory(
-                model, mesh, learning_rate=1e-4, remat="dots",
-                accum_dtype=jnp.bfloat16)
-            n_params = sum(int(np.prod(v.shape)) for v in params.values())
-            rng = np.random.default_rng(0)
-            tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
-                              jnp.int32)
-            lab = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
-                              jnp.int32)
-            loss = None
-            t0 = time.perf_counter()
-            for _ in range(2):
-                params, opt_state, loss = step(params, opt_state, tok, lab)
-            float(loss)
-            compile_s = time.perf_counter() - t0
-            steps = 10 if on_tpu else 2
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                params, opt_state, loss = step(params, opt_state, tok, lab)
-            lv = float(loss)
-            dt = (time.perf_counter() - t0) / steps
-            flops = 6 * n_params * B * S + 12 * L * h * S * B * S
-            rec = {"mode": "ladder", "params_b": round(n_params / 1e9, 3),
-                   "layers": L, "hidden": h, "B": B, "S": S,
-                   "moments": "bf16", "remat": "dots",
-                   "step_ms": round(dt * 1e3, 1),
-                   "mfu": round(flops / dt / PEAK, 4),
-                   "loss": lv, "compile_s": round(compile_s, 1),
-                   "device": str(jax.devices()[0])}
-            print(json.dumps(rec), flush=True)
+            print(json.dumps(try_rung(L, h, inter, heads, kv)), flush=True)
             return  # largest fitting config measured — done
         except Exception as e:  # noqa: BLE001 — OOM is a data point
             msg = repr(e)
             oom = "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower()
             print(json.dumps({"mode": "ladder", "layers": L, "hidden": h,
                               "oom": oom, "error": msg[-200:]}), flush=True)
-            # free everything before the next rung
-            del cfg
-            import gc
             gc.collect()
 
 
