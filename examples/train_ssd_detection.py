@@ -1,28 +1,42 @@
-"""SSD-style detection training on synthetic boxes.
+"""SSD-style detection training, COMPILED end-to-end.
 
-The detection pipeline end-to-end: anchor generation -> multibox loss
-(per_prediction matching + hard negative mining) training a tiny conv
-head -> multiclass NMS inference with fixed-size padded outputs.
+The whole train step — anchor grid, head forward, per-prediction
+matching, multibox loss (hard negative mining) and the Adam update —
+is one jax.jit program built from `paddle_tpu.vision.detection_jit`
+(the jnp twins of the ops the reference runs as CUDA kernels:
+prior_box_op.cu, box_coder_op.cu, generate_proposals_op.cu, ...).
+Ground truth is padded to a fixed G_MAX with a validity mask — the XLA
+static-shape contract — so every step hits the same executable.
+
 Synthetic task: one bright square per image; the head learns to put a
-confident box on it.
+confident box on it. Inference reuses the host-side multiclass NMS
+(greedy NMS is CPU-pinned in the reference too).
 """
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
-from paddle_tpu.vision.detection import (anchor_generator, box_coder,
-                                         multiclass_nms, ssd_loss)
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import detection_jit as DJ
+from paddle_tpu.vision.detection import box_coder, multiclass_nms
 
 IMG, GRID, STRIDE = 32, 4, 8
+G_MAX = 4  # fixed ground-truth padding
 
 
 def synthetic_scene(rng):
-    """A bright 8x8 square at a random cell; gt box around it."""
-    img = rng.normal(0, 0.1, (1, 3, IMG, IMG)).astype(np.float32)
+    """A bright 8x8 square at a random cell; gt box around it, padded
+    to G_MAX rows with a validity mask."""
+    img = rng.normal(0, 0.1, (3, IMG, IMG)).astype(np.float32)
     cx, cy = rng.integers(0, GRID, 2) * STRIDE + STRIDE // 2
-    img[0, :, cy - 4:cy + 4, cx - 4:cx + 4] += 1.0
-    gt = np.array([[cx - 4, cy - 4, cx + 4, cy + 4]], np.float32)
-    return img, gt, np.array([1], np.int64)
+    img[:, cy - 4:cy + 4, cx - 4:cx + 4] += 1.0
+    gt = np.zeros((G_MAX, 4), np.float32)
+    gt[0] = [cx - 4, cy - 4, cx + 4, cy + 4]
+    lbl = np.zeros((G_MAX,), np.int64)
+    lbl[0] = 1
+    mask = np.zeros((G_MAX,), bool)
+    mask[0] = True
+    return img, gt, lbl, mask
 
 
 class TinySSDHead(nn.Layer):
@@ -39,39 +53,66 @@ class TinySSDHead(nn.Layer):
 
     def forward(self, x):
         f = self.trunk(x)                          # (B, 32, 4, 4)
-        loc = self.loc(f).transpose([0, 2, 3, 1]).reshape([-1, 4])
-        conf = self.conf(f).transpose([0, 2, 3, 1]).reshape([-1, 2])
+        B = x.shape[0]
+        loc = self.loc(f).transpose([0, 2, 3, 1]).reshape([B, -1, 4])
+        conf = self.conf(f).transpose([0, 2, 3, 1]).reshape([B, -1, 2])
         return loc, conf
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+
     paddle.seed(0)
     rng = np.random.default_rng(0)
     head = TinySSDHead()
-    opt = paddle.optimizer.Adam(parameters=head.parameters(),
-                                learning_rate=2e-3)
-    fm = np.zeros((1, 32, GRID, GRID), np.float32)
-    priors, _ = anchor_generator(fm, anchor_sizes=[8.0],
-                                 aspect_ratios=[1.0],
-                                 stride=[STRIDE, STRIDE])
-    priors = priors.numpy().reshape(-1, 4)
+    params = {k: v._value for k, v in head.state_dict().items()}
+    priors = DJ.anchor_grid(GRID, GRID, [8.0], [1.0],
+                            [STRIDE, STRIDE]).reshape(-1, 4)
 
-    for step in range(120):
-        img, gt, lbl = synthetic_scene(rng)
-        loc, conf = head(paddle.to_tensor(img))
-        loss = ssd_loss(loc, conf, gt, lbl, priors)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        if step % 40 == 0:
+    def loss_fn(params, imgs, gt, gtl, mask):
+        head.load_tree(params)
+        loc, conf = head(Tensor(imgs))
+        per_image = jax.vmap(
+            lambda lo, co, g, gl, m: DJ.ssd_loss_jit(
+                lo, co, g, gl, m, priors))
+        return jnp.mean(per_image(loc._value, conf._value, gt, gtl,
+                                  mask))
+
+    from paddle_tpu.models.nlp.train_utils import adamw_update
+
+    @jax.jit  # ONE executable: forward + matching + loss + adam
+    def train_step(params, opt, t, imgs, gt, gtl, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, imgs, gt,
+                                                  gtl, mask)
+        new_p, new_o = {}, {}
+        for k, g in grads.items():
+            new_p[k], m, v = adamw_update(
+                params[k], g, opt[k][0], opt[k][1], t, lr=2e-3,
+                beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0)
+            new_o[k] = (m, v)
+        return new_p, new_o, loss
+
+    opt = {k: (jnp.zeros_like(v), jnp.zeros_like(v))
+           for k, v in params.items()}
+    B = 4
+    for step in range(90):
+        batch = [synthetic_scene(rng) for _ in range(B)]
+        imgs, gt, gtl, mask = (np.stack([b[i] for b in batch])
+                               for i in range(4))
+        params, opt, loss = train_step(params, opt, step + 1.0,
+                                       imgs, gt, gtl, mask)
+        if step % 30 == 0:
             print(f"step {step}: loss {float(loss):.4f}")
 
-    # inference: decode + per-class NMS (fixed-size padded output)
-    img, gt, _ = synthetic_scene(rng)
-    loc, conf = head(paddle.to_tensor(img))
-    boxes = box_coder(priors, None, loc.numpy()[None],
+    # inference: decode + per-class NMS on host (fixed-size padded out)
+    head.load_tree(params)
+    img, gt, _, _ = synthetic_scene(rng)
+    loc, conf = head(paddle.to_tensor(img[None]))
+    pri_np = np.asarray(priors)
+    boxes = box_coder(pri_np, None, loc.numpy(),
                       "decode_center_size", axis=0).numpy()[0]
-    probs = paddle.nn.functional.softmax(conf, axis=-1).numpy()
+    probs = paddle.nn.functional.softmax(conf, axis=-1).numpy()[0]
     out, count = multiclass_nms(boxes[None], probs.T[None],
                                 score_threshold=0.5, keep_top_k=5)
     if int(count.numpy()[0]) == 0:  # padded rows are -1, not detections

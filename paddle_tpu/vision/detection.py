@@ -25,8 +25,27 @@ def _arr(x):
     return np.asarray(x._value if isinstance(x, Tensor) else x)
 
 
+def _traced(*xs):
+    """True when any input is a JAX tracer — the caller is inside jit,
+    so the op must route to its detection_jit twin (numpy would fail on
+    the tracer and host-sync the step)."""
+    import jax.core
+    for x in xs:
+        v = x._value if isinstance(x, Tensor) else x
+        if isinstance(v, jax.core.Tracer):
+            return True
+    return False
+
+
+def _jval(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
 def iou_similarity(x, y, box_normalized: bool = True):
     """(N,4) x (M,4) -> (N,M) IoU. ~ detection.py:765."""
+    if _traced(x, y):
+        from .detection_jit import iou_matrix
+        return Tensor(iou_matrix(_jval(x), _jval(y), box_normalized))
     xa, ya = _arr(x).astype(np.float32), _arr(y).astype(np.float32)
     if not box_normalized:
         # unnormalized boxes count the boundary pixel (w = x2-x1+1)
@@ -41,6 +60,12 @@ def box_clip(input, im_info):
     """Clip (…,4) boxes to the ORIGINAL image extent. ~ detection.py:3057
     / box_clip_op.h: im_info is (H, W, scale) of the network input, and
     boxes clip to [0, round(W/scale)-1] x [0, round(H/scale)-1]."""
+    if _traced(input, im_info):
+        import jax.numpy as jnp
+
+        from .detection_jit import clip_boxes
+        return Tensor(clip_boxes(jnp.asarray(_jval(input)),
+                                 jnp.asarray(_jval(im_info))))
     b = _arr(input).astype(np.float32)
     info = _arr(im_info).astype(np.float32).reshape(-1)
     scale = info[2] if info.size > 2 and info[2] > 0 else 1.0
@@ -61,6 +86,15 @@ def box_coder(prior_box, prior_box_var, target_box,
     decode: target (N,M,4) offsets + priors -> (N,M,4) corners
     (axis=0: priors broadcast over rows; axis=1: over columns).
     """
+    if _traced(prior_box, prior_box_var, target_box):
+        from .detection_jit import decode_center_size, encode_center_size
+        pv = None if prior_box_var is None else _jval(prior_box_var)
+        if code_type.startswith("encode"):
+            return Tensor(encode_center_size(
+                _jval(prior_box), pv, _jval(target_box), box_normalized))
+        return Tensor(decode_center_size(
+            _jval(prior_box), pv, _jval(target_box), axis,
+            box_normalized))
     p = _arr(prior_box).astype(np.float32)
     t = _arr(target_box).astype(np.float32)
     pv = (None if prior_box_var is None

@@ -1,0 +1,471 @@
+"""jit-compiled detection geometry — the TPU training path.
+
+The host module (``vision/detection.py``) keeps the reference's eager
+semantics for host-side post-processing (greedy NMS and friends, which
+the reference itself pins to CPU). THIS module provides pure-jnp,
+fixed-shape twins of the geometry and training-path ops — the ones the
+reference ships as CUDA kernels (prior_box_op.cu, anchor_generator_op.cu,
+box_coder_op.cu, box_clip_op.cu, iou_similarity_op.cu,
+generate_proposals_op.cu, distribute_fpn_proposals_op.cu,
+collect_fpn_proposals_op.cu, target_assign_op.h, the MultiBoxLoss
+recipe) — so an SSD/RCNN train step compiles end-to-end under jax.jit.
+
+XLA static-shape contract: ground truth arrives padded to a fixed G_max
+with a boolean validity mask; every output is fixed-size with
+counts/masks instead of the reference's LoD variable-length tensors.
+Anchor/prior grids take only static hyperparameters, so inside a jitted
+step they constant-fold into the executable.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "iou_matrix", "clip_boxes", "encode_center_size",
+    "decode_center_size", "anchor_grid", "prior_box_grid",
+    "density_prior_box_grid", "match_priors", "ssd_loss_jit",
+    "generate_proposals_jit", "distribute_fpn_proposals_jit",
+    "collect_fpn_proposals_jit",
+]
+
+NEG_INF = -1e30
+
+
+# --- pairwise geometry ----------------------------------------------------
+
+def iou_matrix(a, b, normalized: bool = True):
+    """(N, 4) x (M, 4) -> (N, M) IoU. ~ iou_similarity_op.h (the +1
+    boundary-pixel convention when unnormalized)."""
+    norm = 0.0 if normalized else 1.0
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    inter_w = jnp.clip(jnp.minimum(ax2[:, None], bx2[None, :])
+                       - jnp.maximum(ax1[:, None], bx1[None, :]) + norm,
+                       0.0, None)
+    inter_h = jnp.clip(jnp.minimum(ay2[:, None], by2[None, :])
+                       - jnp.maximum(ay1[:, None], by1[None, :]) + norm,
+                       0.0, None)
+    inter = inter_w * inter_h
+    area_a = (ax2 - ax1 + norm) * (ay2 - ay1 + norm)
+    area_b = (bx2 - bx1 + norm) * (by2 - by1 + norm)
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+def clip_boxes(boxes, im_info):
+    """Clip (..., 4) boxes to the ORIGINAL image extent. ~ box_clip_op.h:
+    im_info = (H, W, scale) of the network input; boxes clip to
+    [0, round(W/scale)-1] x [0, round(H/scale)-1]."""
+    info = im_info.reshape(-1).astype(jnp.float32)
+    scale = jnp.where(info[2] > 0, info[2], 1.0) if info.shape[0] > 2 \
+        else jnp.float32(1.0)
+    hmax = jnp.round(info[0] / scale) - 1.0
+    wmax = jnp.round(info[1] / scale) - 1.0
+    x = jnp.clip(boxes[..., 0::2], 0.0, wmax)
+    y = jnp.clip(boxes[..., 1::2], 0.0, hmax)
+    out = jnp.stack([x[..., 0], y[..., 0], x[..., 1], y[..., 1]], -1)
+    return out.astype(boxes.dtype)
+
+
+# --- box coding (box_coder_op.cc semantics) -------------------------------
+
+def encode_center_size(priors, prior_var, targets, normalized: bool = True):
+    """targets (G, 4) corners vs priors (P, 4) -> (G, P, 4) offsets."""
+    norm = 0.0 if normalized else 1.0
+    p = priors.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    pw = p[:, 2] - p[:, 0] + norm
+    ph = p[:, 3] - p[:, 1] + norm
+    pcx = p[:, 0] + pw * 0.5
+    pcy = p[:, 1] + ph * 0.5
+    tw = t[:, 2] - t[:, 0] + norm
+    th = t[:, 3] - t[:, 1] + norm
+    tcx = t[:, 0] + tw * 0.5
+    tcy = t[:, 1] + th * 0.5
+    ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+    oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+    ow = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+    oh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+    out = jnp.stack([ox, oy, ow, oh], -1)
+    if prior_var is not None:
+        pv = jnp.broadcast_to(jnp.asarray(prior_var, jnp.float32),
+                              p.shape)
+        out = out / pv[None, :, :]
+    return out
+
+
+def decode_center_size(priors, prior_var, deltas, axis: int = 0,
+                       normalized: bool = True):
+    """deltas (N, M, 4) + priors (broadcast over axis 0 or 1) -> corners.
+    A 2-D deltas array decodes elementwise against its own prior row
+    (the RPN one-delta-per-anchor case)."""
+    norm = 0.0 if normalized else 1.0
+    p = priors.astype(jnp.float32)
+    d = deltas.astype(jnp.float32)
+    pw = p[:, 2] - p[:, 0] + norm
+    ph = p[:, 3] - p[:, 1] + norm
+    pcx = p[:, 0] + pw * 0.5
+    pcy = p[:, 1] + ph * 0.5
+    pv = None if prior_var is None else jnp.broadcast_to(
+        jnp.asarray(prior_var, jnp.float32), p.shape)
+    if d.ndim == 2:  # one delta per prior, elementwise
+        pass
+    elif axis == 0:
+        pw, ph, pcx, pcy = (a[None, :] for a in (pw, ph, pcx, pcy))
+        pv = None if pv is None else pv[None, :, :]
+    else:
+        pw, ph, pcx, pcy = (a[:, None] for a in (pw, ph, pcx, pcy))
+        pv = None if pv is None else pv[:, None, :]
+    if pv is not None:
+        d = d * pv
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+
+
+# --- static prior/anchor grids (constant-fold under jit) ------------------
+
+def anchor_grid(H: int, W: int, anchor_sizes: Sequence[float],
+                aspect_ratios: Sequence[float],
+                stride: Sequence[float] = (16.0, 16.0),
+                offset: float = 0.5):
+    """(H, W, A, 4) RPN anchors, reference Detectron convention
+    (anchor_generator_op.h: rounded base w/h at stride scale,
+    ratio-outer/size-inner, offset*(stride-1) centers, +/-0.5*(w-1)
+    corners). All-static args: a compile-time constant under jit."""
+    sw, sh = float(stride[0]), float(stride[1])
+    whs = []
+    for ar in aspect_ratios:
+        base_w = round(math.sqrt(sw * sh / ar))
+        base_h = round(base_w * ar)
+        for s in anchor_sizes:
+            whs.append((float(s) / sw * base_w, float(s) / sh * base_h))
+    wh = jnp.asarray(whs, jnp.float32)                      # (A, 2)
+    cx = jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1)
+    cy = jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1)
+    cxg = jnp.broadcast_to(cx[None, :], (H, W))
+    cyg = jnp.broadcast_to(cy[:, None], (H, W))
+    return jnp.stack([
+        cxg[:, :, None] - (wh[None, None, :, 0] - 1) / 2,
+        cyg[:, :, None] - (wh[None, None, :, 1] - 1) / 2,
+        cxg[:, :, None] + (wh[None, None, :, 0] - 1) / 2,
+        cyg[:, :, None] + (wh[None, None, :, 1] - 1) / 2,
+    ], -1)
+
+
+def _cell_centers(H, W, step_w, step_h, offset):
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    return (jnp.broadcast_to(cx[None, :], (H, W)),
+            jnp.broadcast_to(cy[:, None], (H, W)))
+
+
+def prior_box_grid(H: int, W: int, image_h: int, image_w: int,
+                   min_sizes: Sequence[float],
+                   max_sizes: Optional[Sequence[float]] = None,
+                   aspect_ratios: Sequence[float] = (1.0,),
+                   flip: bool = False, clip: bool = False,
+                   steps: Sequence[float] = (0.0, 0.0),
+                   offset: float = 0.5,
+                   min_max_aspect_ratios_order: bool = False):
+    """(H, W, P, 4) normalized SSD priors. ~ prior_box_op.cc (same
+    enumeration as the host twin vision/detection.py::prior_box)."""
+    ih, iw = float(image_h), float(image_w)
+    step_h = steps[1] if steps[1] > 0 else ih / H
+    step_w = steps[0] if steps[0] > 0 else iw / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    whs: List = []
+    for i, ms in enumerate(float(m) for m in min_sizes):
+        sq = (math.sqrt(ms * float(max_sizes[i])),) * 2 if max_sizes \
+            else None
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if sq:
+                whs.append(sq)
+            whs.extend((ms * math.sqrt(ar), ms / math.sqrt(ar))
+                       for ar in ars if abs(ar - 1.0) >= 1e-6)
+        else:
+            whs.extend((ms * math.sqrt(ar), ms / math.sqrt(ar))
+                       for ar in ars)
+            if sq:
+                whs.append(sq)
+    wh = jnp.asarray(whs, jnp.float32)
+    cxg, cyg = _cell_centers(H, W, step_w, step_h, offset)
+    boxes = jnp.stack([
+        (cxg[:, :, None] - wh[None, None, :, 0] / 2) / iw,
+        (cyg[:, :, None] - wh[None, None, :, 1] / 2) / ih,
+        (cxg[:, :, None] + wh[None, None, :, 0] / 2) / iw,
+        (cyg[:, :, None] + wh[None, None, :, 1] / 2) / ih,
+    ], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def density_prior_box_grid(H: int, W: int, image_h: int, image_w: int,
+                           densities: Sequence[int],
+                           fixed_sizes: Sequence[float],
+                           fixed_ratios: Sequence[float] = (1.0,),
+                           steps: Sequence[float] = (0.0, 0.0),
+                           offset: float = 0.5):
+    """(H, W, P, 4) density priors. ~ density_prior_box_op.cu (integer
+    averaged-step sub-grid shifts; corners always clamped to [0, 1])."""
+    if len(densities) != len(fixed_sizes):
+        raise ValueError("densities and fixed_sizes must pair up 1:1")
+    ih, iw = float(image_h), float(image_w)
+    step_h = steps[1] if steps[1] > 0 else ih / H
+    step_w = steps[0] if steps[0] > 0 else iw / W
+    step_avg = int(0.5 * (step_w + step_h))
+    entries = []
+    for dens, fs in zip(densities, fixed_sizes):
+        dens = int(dens)
+        shift = int(step_avg / dens)
+        for r in fixed_ratios:
+            bw, bh = fs * math.sqrt(r), fs / math.sqrt(r)
+            for di in range(dens):
+                for dj in range(dens):
+                    entries.append(((dj + 0.5) * shift - step_avg / 2.0,
+                                    (di + 0.5) * shift - step_avg / 2.0,
+                                    bw, bh))
+    e = jnp.asarray(entries, jnp.float32)
+    cxg, cyg = _cell_centers(H, W, step_w, step_h, offset)
+    ctrx = cxg[:, :, None] + e[None, None, :, 0]
+    ctry = cyg[:, :, None] + e[None, None, :, 1]
+    boxes = jnp.stack([
+        (ctrx - e[None, None, :, 2] / 2) / iw,
+        (ctry - e[None, None, :, 3] / 2) / ih,
+        (ctrx + e[None, None, :, 2] / 2) / iw,
+        (ctry + e[None, None, :, 3] / 2) / ih,
+    ], -1)
+    return jnp.clip(boxes, 0.0, 1.0)
+
+
+# --- matching + the SSD multibox loss, fully traced -----------------------
+
+def match_priors(iou, gt_mask=None, match_type: str = "per_prediction",
+                 dist_threshold: float = 0.5):
+    """Greedy bipartite matching under jit. ~ bipartite_match_op.cc.
+
+    iou (G, P) similarity; gt_mask (G,) marks real (non-padding) rows.
+    Returns (match_idx (P,) int32 gt-per-prior or -1, match_dist (P,)).
+    The greedy loop runs a fixed G iterations (lax.fori_loop); retired
+    rows/columns and sub-zero maxima are handled by masking, matching
+    the host twin's early-break semantics exactly.
+    """
+    G, P = iou.shape
+    d = iou.astype(jnp.float32)
+    if gt_mask is not None:
+        d = jnp.where(gt_mask[:, None], d, 0.0)
+
+    def body(_, state):
+        work, midx, mdist = state
+        flat = jnp.argmax(work)
+        g, p = flat // P, flat % P
+        take = work[g, p] > 0.0
+        midx = midx.at[p].set(jnp.where(take, g.astype(jnp.int32),
+                                        midx[p]))
+        mdist = mdist.at[p].set(jnp.where(take, d[g, p], mdist[p]))
+        row_gone = jnp.where(take & (jnp.arange(G) == g), NEG_INF, 0.0)
+        col_gone = jnp.where(take & (jnp.arange(P) == p), NEG_INF, 0.0)
+        work = work + row_gone[:, None] + col_gone[None, :]
+        return work, midx, mdist
+
+    midx0 = jnp.full((P,), -1, jnp.int32)
+    mdist0 = jnp.zeros((P,), jnp.float32)
+    _, midx, mdist = jax.lax.fori_loop(0, min(G, P), body,
+                                       (d, midx0, mdist0))
+    if match_type == "per_prediction":
+        best_gt = jnp.argmax(d, axis=0).astype(jnp.int32)
+        best_dist = jnp.max(d, axis=0)
+        extra = (midx < 0) & (best_dist > dist_threshold)
+        midx = jnp.where(extra, best_gt, midx)
+        mdist = jnp.where(extra, best_dist, mdist)
+    return midx, mdist
+
+
+def ssd_loss_jit(location, confidence, gt_boxes, gt_labels, gt_mask,
+                 prior_box, prior_box_var=None, background_label: int = 0,
+                 overlap_threshold: float = 0.5,
+                 neg_pos_ratio: float = 3.0, loc_loss_weight: float = 1.0,
+                 conf_loss_weight: float = 1.0):
+    """The SSD multibox loss for ONE image, fully inside jit.
+    ~ the MultiBoxLoss recipe (fluid layers/detection.py:1527):
+    per_prediction matching, smooth-L1 on encoded offsets, softmax CE
+    with rank-exact 3:1 hard negative mining (a sorted-rank mask, so the
+    dynamic keep count needs no dynamic-shape top_k).
+
+    location (P, 4), confidence (P, C) logits; gt_boxes (G, 4) padded,
+    gt_labels (G,) int, gt_mask (G,) bool marks real rows;
+    prior_box (P, 4). Returns a scalar. vmap over images for a batch.
+    """
+    P = prior_box.shape[0]
+    iou = iou_matrix(gt_boxes, prior_box)
+    midx, _ = match_priors(iou, gt_mask, "per_prediction",
+                           overlap_threshold)
+    enc = encode_center_size(prior_box, prior_box_var, gt_boxes)  # (G,P,4)
+    matched = midx >= 0
+    safe = jnp.clip(midx, 0, None)
+    loc_t = jnp.where(matched[:, None],
+                      enc[safe, jnp.arange(P)], 0.0)
+    conf_t = jnp.where(matched, gt_labels.astype(jnp.int32)[safe],
+                       background_label)
+    n_pos = jnp.maximum(jnp.sum(matched), 1)
+    n_neg_keep = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                             P - n_pos)
+
+    logp = jax.nn.log_softmax(confidence.astype(jnp.float32), -1)
+    ce = -jnp.take_along_axis(logp, conf_t[:, None], -1)[:, 0]
+    # rank-based hard-negative mining: EXACTLY the top-n_neg_keep
+    # background CEs (ties broken by sort order, as the host twin's
+    # top_k does) — rank masks make the dynamic count jit-safe
+    neg_ce = jnp.where(matched, -jnp.inf, ce)
+    order = jnp.argsort(-neg_ce)
+    keep_sorted = jnp.arange(P) < n_neg_keep
+    neg_keep = jnp.zeros((P,), bool).at[order].set(keep_sorted)
+    conf_loss = jnp.sum(jnp.where(matched | neg_keep, ce, 0.0))
+    diff = jnp.abs((location - loc_t).astype(jnp.float32))
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    loc_loss = jnp.sum(jnp.where(matched[:, None], sl1, 0.0))
+    return (conf_loss_weight * conf_loss
+            + loc_loss_weight * loc_loss) / n_pos
+
+
+# --- RPN proposals + FPN routing, fully traced ----------------------------
+
+def _nms_fixed(boxes, valid, nms_thresh: float, max_keep: int,
+               eta: float = 1.0):
+    """Greedy NMS over score-DESCENDING ``boxes`` with a fixed pick
+    count. Returns (keep_idx (max_keep,) int32 padded -1, count).
+    The reference's +1 pixel convention (norm=1), like
+    generate_proposals_op.cc's NMS."""
+    n = boxes.shape[0]
+    areas = ((boxes[:, 2] - boxes[:, 0] + 1.0)
+             * (boxes[:, 3] - boxes[:, 1] + 1.0))
+
+    def body(i, state):
+        alive, keep, th = state
+        any_alive = jnp.any(alive)
+        # boxes are score-sorted: the next pick is the first alive row
+        p = jnp.argmax(alive)  # first True (argmax of bool)
+        keep = keep.at[i].set(jnp.where(any_alive, p.astype(jnp.int32),
+                                        -1))
+        x1 = jnp.maximum(boxes[p, 0], boxes[:, 0])
+        y1 = jnp.maximum(boxes[p, 1], boxes[:, 1])
+        x2 = jnp.minimum(boxes[p, 2], boxes[:, 2])
+        y2 = jnp.minimum(boxes[p, 3], boxes[:, 3])
+        inter = (jnp.clip(x2 - x1 + 1.0, 0, None)
+                 * jnp.clip(y2 - y1 + 1.0, 0, None))
+        iou = inter / (areas[p] + areas - inter + 1e-10)
+        suppress = iou > th
+        alive = jnp.where(any_alive, alive & ~suppress, alive)
+        th = jnp.where((eta < 1.0) & (th > 0.5), th * eta, th)
+        return alive, keep, th
+
+    keep0 = jnp.full((max_keep,), -1, jnp.int32)
+    _, keep, _ = jax.lax.fori_loop(
+        0, max_keep, body, (valid, keep0, jnp.float32(nms_thresh)))
+    return keep, jnp.sum(keep >= 0)
+
+
+def generate_proposals_jit(scores, bbox_deltas, im_info, anchors,
+                           variances, pre_nms_top_n: int = 6000,
+                           post_nms_top_n: int = 1000,
+                           nms_thresh: float = 0.5,
+                           min_size: float = 0.1, eta: float = 1.0):
+    """RPN proposals for ONE image, fully inside jit.
+    ~ generate_proposals_op.cc (the reference's CUDA path). scores
+    (A, H, W); bbox_deltas (4A, H, W); im_info (3,); anchors/variances
+    (H, W, A, 4) or flat (H*W*A, 4). Returns (rois (post_nms_top_n, 4)
+    zero-padded, scores (post_nms_top_n,), count). vmap over images.
+    """
+    A, H, W = scores.shape
+    s = scores.transpose(1, 2, 0).reshape(-1)
+    d = bbox_deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+    an = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+    dec = decode_center_size(an, None, d * var)   # per-anchor elementwise
+    info = im_info.reshape(-1).astype(jnp.float32)
+    hmax, wmax = info[0] - 1.0, info[1] - 1.0
+    x = jnp.clip(dec[:, 0::2], 0.0, wmax)
+    y = jnp.clip(dec[:, 1::2], 0.0, hmax)
+    dec = jnp.stack([x[:, 0], y[:, 0], x[:, 1], y[:, 1]], -1)
+    ms = max(min_size, 1.0) * jnp.where(info[2] > 0, info[2], 1.0)
+    wh = dec[:, 2:] - dec[:, :2] + 1.0
+    valid = jnp.all(wh >= ms, axis=1)
+
+    k = min(int(pre_nms_top_n), s.shape[0])
+    sv, si = jax.lax.top_k(jnp.where(valid, s, -jnp.inf), k)
+    boxes = dec[si]
+    keep, count = _nms_fixed(boxes, sv > -jnp.inf, nms_thresh,
+                             int(post_nms_top_n), eta)
+    picked = keep >= 0
+    safe = jnp.clip(keep, 0, None)
+    rois = jnp.where(picked[:, None], boxes[safe], 0.0)
+    rsc = jnp.where(picked, sv[safe], 0.0)
+    return rois, rsc, count
+
+
+def distribute_fpn_proposals_jit(rois, valid, min_level: int,
+                                 max_level: int, refer_level: int,
+                                 refer_scale: float):
+    """Route (R, 4) rois to FPN levels, fixed shapes.
+    ~ distribute_fpn_proposals_op.cu: level = clamp(floor(refer_level +
+    log2(sqrt(area)/refer_scale))). Returns (per-level rois
+    (L, R, 4) compacted to the front, per-level counts (L,),
+    restore_row (R,) — the row index of each input roi in the
+    concatenated (L*R, 4) layout, -1 for invalid inputs)."""
+    r = rois.reshape(-1, 4).astype(jnp.float32)
+    R = r.shape[0]
+    w = jnp.clip(r[:, 2] - r[:, 0], 0.0, None)
+    h = jnp.clip(r[:, 3] - r[:, 1], 0.0, None)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(refer_level + jnp.log2(
+        jnp.maximum(scale, 1e-6) / refer_scale))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl = jnp.where(valid, lvl, -1)
+
+    outs, counts, restore = [], [], jnp.full((R,), -1, jnp.int32)
+    for i, level in enumerate(range(min_level, max_level + 1)):
+        m = lvl == level
+        # stable compaction: rows of this level move to the front in
+        # input order, the rest pad the tail
+        order = jnp.argsort(jnp.where(m, 0, 1), stable=True)
+        outs.append(jnp.where((jnp.arange(R) < jnp.sum(m))[:, None],
+                              r[order], 0.0))
+        counts.append(jnp.sum(m))
+        rank = jnp.cumsum(m) - 1
+        restore = jnp.where(m, i * R + rank.astype(jnp.int32), restore)
+    return jnp.stack(outs), jnp.stack(counts), restore
+
+
+def collect_fpn_proposals_jit(multi_rois, multi_scores, multi_valid,
+                              post_nms_top_n: int
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                         jnp.ndarray]:
+    """Merge per-level proposals, keep the global top-n by score.
+    ~ collect_fpn_proposals_op.cc. multi_rois (L, R, 4) or list;
+    multi_scores (L, R); multi_valid (L, R) bool. Returns
+    (rois (n, 4), scores (n,), count) with n = post_nms_top_n fixed."""
+    rois = jnp.concatenate([r.reshape(-1, 4) for r in multi_rois])
+    sc = jnp.concatenate([s.reshape(-1) for s in multi_scores])
+    vd = jnp.concatenate([v.reshape(-1) for v in multi_valid])
+    masked = jnp.where(vd, sc, -jnp.inf)
+    k = min(int(post_nms_top_n), masked.shape[0])
+    sv, si = jax.lax.top_k(masked, k)
+    picked = sv > -jnp.inf
+    out_r = jnp.where(picked[:, None], rois[si], 0.0)
+    out_s = jnp.where(picked, sv, 0.0)
+    return out_r, out_s, jnp.sum(picked)

@@ -1,0 +1,260 @@
+"""Parity: vision/detection_jit (pure-jnp, jit-compiled) vs the host
+numpy oracles in vision/detection — plus the end-to-end jitted SSD
+train step (VERDICT r3 item 4: the ops the reference runs as CUDA
+kernels must compile into the train step)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import detection as D
+from paddle_tpu.vision import detection_jit as J
+
+
+def _rand_boxes(rng, n, lo=0.0, hi=60.0):
+    xy = rng.uniform(lo, hi, (n, 2)).astype(np.float32)
+    wh = rng.uniform(1.0, 20.0, (n, 2)).astype(np.float32)
+    return np.concatenate([xy, xy + wh], -1)
+
+
+def test_iou_clip_coder_parity():
+    rng = np.random.default_rng(0)
+    a, b = _rand_boxes(rng, 7), _rand_boxes(rng, 11)
+    for normalized in (True, False):
+        got = jax.jit(lambda x, y: J.iou_matrix(x, y, normalized))(a, b)
+        want = D.iou_similarity(a, b, box_normalized=normalized).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    info = np.array([48.0, 64.0, 1.0], np.float32)
+    got = jax.jit(J.clip_boxes)(a, info)
+    np.testing.assert_allclose(np.asarray(got),
+                               D.box_clip(a, info).numpy(), rtol=1e-6)
+
+    pv = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    enc = jax.jit(J.encode_center_size)(b, pv, a)
+    want = D.box_coder(b, pv, a, "encode_center_size").numpy()
+    np.testing.assert_allclose(np.asarray(enc), want, rtol=1e-4,
+                               atol=1e-5)
+    # decode roundtrip, broadcast both ways
+    deltas = rng.normal(0, 0.3, (7, 11, 4)).astype(np.float32)
+    for axis in (0, 1):
+        pr = b if axis == 0 else a
+        got = jax.jit(lambda p, t: J.decode_center_size(
+            p, pv, t, axis=axis))(pr, deltas)
+        want = D.box_coder(pr, pv, deltas, "decode_center_size",
+                           axis=axis).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_grid_parity():
+    fm = np.zeros((1, 8, 3, 5), np.float32)
+    img = np.zeros((1, 3, 48, 80), np.float32)
+
+    got = J.anchor_grid(3, 5, [32.0, 64.0], [0.5, 1.0, 2.0], [16.0, 16.0])
+    want, _ = D.anchor_generator(fm, [32.0, 64.0], [0.5, 1.0, 2.0],
+                                 stride=[16.0, 16.0])
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-6)
+
+    got = J.prior_box_grid(3, 5, 48, 80, [8.0, 16.0], [20.0, 40.0],
+                           aspect_ratios=[2.0], flip=True)
+    want, _ = D.prior_box(fm, img, [8.0, 16.0], [20.0, 40.0],
+                          aspect_ratios=[2.0], flip=True)
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-5)
+
+    got = J.density_prior_box_grid(3, 5, 48, 80, [2, 1], [4.0, 8.0],
+                                   fixed_ratios=[1.0, 2.0])
+    want, _ = D.density_prior_box(fm, img, [2, 1], [4.0, 8.0],
+                                  fixed_ratios=[1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("match_type", ["bipartite", "per_prediction"])
+def test_match_priors_parity(match_type):
+    rng = np.random.default_rng(1)
+    for trial in range(4):
+        G, P = rng.integers(1, 6), rng.integers(4, 24)
+        iou = rng.uniform(0, 1, (G, P)).astype(np.float32)
+        midx, mdist = jax.jit(
+            lambda x: J.match_priors(x, None, match_type, 0.5))(iou)
+        want_idx, want_dist = D.bipartite_match(iou, match_type, 0.5)
+        np.testing.assert_array_equal(np.asarray(midx),
+                                      want_idx.numpy())
+        np.testing.assert_allclose(np.asarray(mdist),
+                                   want_dist.numpy(), rtol=1e-6)
+
+
+def test_match_priors_gt_mask():
+    # padded gt rows (mask False) must never match
+    iou = np.full((3, 6), 0.9, np.float32)
+    mask = np.array([True, False, False])
+    midx, _ = J.match_priors(iou, mask, "per_prediction", 0.5)
+    assert set(np.asarray(midx).tolist()) <= {-1, 0}
+    assert (np.asarray(midx) == 0).sum() >= 1
+
+
+def test_ssd_loss_jit_matches_host():
+    rng = np.random.default_rng(2)
+    P, C, G = 16, 3, 2
+    priors = _rand_boxes(rng, P, 0, 30) / 32.0
+    gt = _rand_boxes(rng, G, 0, 30) / 32.0
+    gtl = np.array([1, 2], np.int64)
+    loc = rng.normal(0, 0.1, (P, 4)).astype(np.float32)
+    conf = rng.normal(0, 0.1, (P, C)).astype(np.float32)
+
+    want = float(D.ssd_loss(loc, conf, gt, gtl, priors))
+    got = float(jax.jit(J.ssd_loss_jit)(
+        loc, conf, gt, gtl, np.ones(G, bool), priors))
+    assert abs(got - want) < 1e-4 * max(1.0, abs(want)), (got, want)
+
+    # padding invariance: adding masked gt rows must not change the loss
+    gt_pad = np.concatenate([gt, np.zeros((3, 4), np.float32)])
+    gtl_pad = np.concatenate([gtl, np.zeros(3, np.int64)])
+    mask = np.array([True, True, False, False, False])
+    got_pad = float(jax.jit(J.ssd_loss_jit)(
+        loc, conf, gt_pad, gtl_pad, mask, priors))
+    assert abs(got_pad - got) < 1e-5
+
+
+def test_generate_proposals_jit_parity():
+    rng = np.random.default_rng(3)
+    A, H, W = 3, 5, 6
+    anchors, var = D.anchor_generator(
+        np.zeros((1, 8, H, W), np.float32), [16.0, 32.0, 64.0], [1.0],
+        stride=[8.0, 8.0])
+    scores = rng.uniform(0, 1, (1, A, H, W)).astype(np.float32)
+    deltas = rng.normal(0, 0.2, (1, 4 * A, H, W)).astype(np.float32)
+    info = np.array([[40.0, 48.0, 1.0]], np.float32)
+
+    want_rois, want_cnt = D.generate_proposals(
+        scores, deltas, info, anchors, var, pre_nms_top_n=50,
+        post_nms_top_n=10, nms_thresh=0.6, min_size=2.0)
+    got_rois, got_sc, got_cnt = jax.jit(
+        lambda s, d, i, an, v: J.generate_proposals_jit(
+            s, d, i, an, v, pre_nms_top_n=50, post_nms_top_n=10,
+            nms_thresh=0.6, min_size=2.0))(
+        scores[0], deltas[0], info[0], anchors.numpy(), var.numpy())
+    assert int(got_cnt) == int(want_cnt.numpy()[0])
+    np.testing.assert_allclose(np.asarray(got_rois),
+                               want_rois.numpy()[0], rtol=1e-4,
+                               atol=1e-3)
+
+
+def test_fpn_distribute_collect_parity():
+    rng = np.random.default_rng(4)
+    R = 12
+    rois = _rand_boxes(rng, R, 0, 200)
+    outs, restore = D.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    j_outs, j_counts, j_restore = jax.jit(
+        lambda r: J.distribute_fpn_proposals_jit(
+            r, jnp.ones(R, bool), 2, 5, 4, 224))(rois)
+    j_outs = np.asarray(j_outs)
+    j_counts = np.asarray(j_counts)
+    for i, o in enumerate(outs):
+        o = o.numpy().reshape(-1, 4)
+        assert j_counts[i] == len(o)
+        np.testing.assert_allclose(j_outs[i, :len(o)], o, rtol=1e-6)
+    # restore_row round-trip: gathering the concatenated layout by
+    # restore_row reproduces the input rois
+    concat = j_outs.reshape(-1, 4)
+    np.testing.assert_allclose(concat[np.asarray(j_restore)], rois,
+                               rtol=1e-6)
+
+    # collect: global top-n by score across levels
+    L = 3
+    mr = [_rand_boxes(rng, 5) for _ in range(L)]
+    msc = [rng.uniform(0, 1, 5).astype(np.float32) for _ in range(L)]
+    want_r, want_s = D.collect_fpn_proposals(mr, msc, 7)
+    got_r, got_s, got_n = jax.jit(
+        lambda r, s: J.collect_fpn_proposals_jit(
+            r, s, jnp.ones((L, 5), bool), 7))(np.stack(mr),
+                                              np.stack(msc))
+    assert int(got_n) == 7
+    np.testing.assert_allclose(np.asarray(got_s), want_s.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_r), want_r.numpy(),
+                               rtol=1e-6)
+
+
+def test_jitted_ssd_train_step_end_to_end():
+    """The VERDICT item-4 'done' check: one jax.jit train step covering
+    anchor grid -> head forward -> matching -> multibox loss -> adam,
+    loss decreasing, no host sync inside the step."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    paddle.seed(0)
+    from paddle_tpu import nn
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 8, 3, stride=4, padding=1)
+            self.loc = nn.Conv2D(8, 4, 1)
+            self.conf = nn.Conv2D(8, 2, 1)
+
+        def forward(self, x):
+            f = nn.functional.relu(self.conv(x))
+            loc = self.loc(f).transpose([0, 2, 3, 1]).reshape([-1, 4])
+            conf = self.conf(f).transpose([0, 2, 3, 1]).reshape([-1, 2])
+            return loc, conf
+
+    head = Head()
+    params = {k: v._value for k, v in head.state_dict().items()}
+    priors = J.anchor_grid(4, 4, [8.0], [1.0], [4.0, 4.0]).reshape(-1, 4)
+
+    def loss_fn(params, img, gt, gtl, mask):
+        head.load_tree(params)
+        loc, conf = head(Tensor(img))
+        return J.ssd_loss_jit(loc._value, conf._value, gt, gtl, mask,
+                              priors)
+
+    from paddle_tpu.models.nlp.train_utils import adamw_update
+
+    @jax.jit
+    def step(params, opt, t, img, gt, gtl, mask):
+        loss, g = jax.value_and_grad(loss_fn)(params, img, gt, gtl, mask)
+        new_p, new_o = {}, {}
+        for k in params:
+            new_p[k], m, v = adamw_update(
+                params[k], g[k], opt[k][0], opt[k][1], t, lr=5e-3,
+                beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0)
+            new_o[k] = (m, v)
+        return new_p, new_o, loss
+
+    opt = {k: (jnp.zeros_like(v), jnp.zeros_like(v)) for k, v in
+           params.items()}
+    rng = np.random.default_rng(0)
+    losses = []
+    for i in range(60):
+        img = rng.normal(0, 0.1, (1, 3, 16, 16)).astype(np.float32)
+        cx = int(rng.integers(0, 4)) * 4 + 2
+        img[0, :, 2:6, cx - 2:cx + 2] += 1.0
+        gt = np.array([[cx - 2.0, 2.0, cx + 2.0, 6.0]], np.float32)
+        params, opt, loss = step(params, opt, i + 1.0, img, gt,
+                                 np.array([1], np.int64),
+                                 np.ones(1, bool))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_host_api_tracer_dispatch():
+    """The public host ops route to their jnp twins under jit — an
+    existing eager pipeline composes into a compiled step unchanged."""
+    from paddle_tpu.vision.detection import (box_clip, box_coder,
+                                             iou_similarity)
+    rng = np.random.default_rng(5)
+    a, b = _rand_boxes(rng, 4), _rand_boxes(rng, 6)
+    pv = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+
+    @jax.jit
+    def f(a, b):
+        iou = iou_similarity(a, b)._value
+        enc = box_coder(b, pv, a, "encode_center_size")._value
+        clipped = box_clip(a, jnp.asarray([40.0, 40.0, 1.0]))._value
+        return iou.sum() + enc.sum() + clipped.sum()
+
+    want = (iou_similarity(a, b).numpy().sum()
+            + box_coder(b, pv, a, "encode_center_size").numpy().sum()
+            + box_clip(a, np.array([40.0, 40.0, 1.0])).numpy().sum())
+    np.testing.assert_allclose(float(f(a, b)), want, rtol=1e-4)
