@@ -5,6 +5,7 @@ SURVEY.md §4): 2 stage processes, each building only ITS PipelineLayer
 segment, exchanging activations/grads over TCPStore p2p in 1F1B order —
 loss trajectory must match the single-process full-model run exactly.
 """
+import pytest
 import json
 import os
 import subprocess
@@ -114,6 +115,7 @@ def _run(tmp_path, nproc):
     return losses
 
 
+@pytest.mark.dist_retry(n=1)
 def test_pp_two_stage_loss_parity(tmp_path):
     single = np.asarray(_run(tmp_path, 1)[0])
     multi = _run(tmp_path, 2)

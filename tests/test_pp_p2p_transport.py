@@ -1,0 +1,97 @@
+"""Direct-socket PP p2p transport unit tests (VERDICT r3 item 6).
+
+The P2PCommunicator now moves tensors over persistent rank-to-rank
+sockets; the TCPStore is rendezvous-only (address exchange + scalar
+broadcast). These tests drive two communicators in one process (threads
+stand in for stages — the transport is the thing under test; the real
+two-process path is exercised by test_pp_multiproc.py)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.meta_parallel.pp_utils import (
+    P2PCommunicator)
+from paddle_tpu.distributed.store import TCPStore
+
+
+@pytest.fixture()
+def pair(free_port):
+    # one client per communicator, as in real multi-process use — a
+    # TCPStore client connection is not shared across threads
+    master = TCPStore("127.0.0.1", free_port, is_master=True,
+                      world_size=1)
+    sb = TCPStore("127.0.0.1", free_port, is_master=False, world_size=1)
+    a = P2PCommunicator(master, 0, prefix="__t__")
+    b = P2PCommunicator(sb, 1, prefix="__t__")
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_roundtrip_dtypes_and_shapes(pair):
+    a, b = pair
+    for arr in [np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.ones((2, 2, 2), np.float16),
+                np.array([[True, False]]),
+                np.arange(5, dtype=np.int64)]:
+        a.send(arr, 1)
+        got = b.recv(0)
+        assert got.dtype == arr.dtype and got.shape == arr.shape
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_fifo_per_tag_and_tag_isolation(pair):
+    a, b = pair
+    # interleave two tags; each tag's stream must stay FIFO and isolated
+    for i in range(5):
+        a.send(np.full((2,), i, np.float32), 1, tag="act")
+        a.send(np.full((3,), 100 + i, np.float32), 1, tag="grad")
+    for i in range(5):
+        assert b.recv(0, tag="act")[0] == i
+    for i in range(5):
+        assert b.recv(0, tag="grad")[0] == 100 + i
+
+
+def test_bidirectional_concurrent(pair):
+    a, b = pair
+    n = 20
+    errs = []
+
+    def pump(src, dst, base):
+        try:
+            for i in range(n):
+                src.send(np.full((256,), base + i, np.float32),
+                         dst.stage_id)
+                got = src.recv(dst.stage_id)
+                assert got[0] == (base ^ 1024) + i
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ta = threading.Thread(target=pump, args=(a, b, 0))
+    tb = threading.Thread(target=pump, args=(b, a, 1024))
+    ta.start()
+    tb.start()
+    ta.join(60)
+    tb.join(60)
+    assert not errs, errs
+
+
+def test_recv_timeout_is_diagnostic(pair, monkeypatch):
+    import paddle_tpu.distributed.fleet.meta_parallel.pp_utils.\
+        p2p_communication as p2p
+    monkeypatch.setattr(p2p, "_RECV_TIMEOUT_S", 0.2)
+    a, b = pair
+    with pytest.raises(TimeoutError, match="stage 0"):
+        b.recv(0, tag="never_sent")
+
+
+def test_bcast_scalar(pair):
+    a, b = pair
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(b.bcast_scalar(None, src_stage=0)))
+    t.start()
+    assert a.bcast_scalar(3.25, src_stage=0) == 3.25
+    t.join(30)
+    assert out == [3.25]
