@@ -18,6 +18,7 @@ from .topology import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from .store import TCPStore  # noqa: F401
+from .reshard import reshard, reshard_like  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -1,0 +1,94 @@
+"""Runtime reshard: move a live array between sharding layouts NOW.
+
+~ python/paddle/distributed/auto_parallel/reshard.py:603 (Resharder —
+inserts the send/recv/concat/slice ops that convert a tensor between two
+dist_attrs at runtime). The TPU-native version needs no op surgery: a
+jitted identity with ``out_shardings`` makes XLA's GSPMD partitioner
+emit the optimal collective schedule (all-gather / all-to-all /
+collective-permute over ICI) for the layout change — including
+cross-mesh moves and multi-process global meshes, where every process
+calls reshard() with its addressable shards and receives the
+addressable shards of the target layout.
+
+The offline sibling (checkpoint/converter.py) reshapes *saved* shards
+between topologies; THIS is the live-array path the reference's
+Resharder covers, completing the pair.
+"""
+from __future__ import annotations
+
+import functools as _functools
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["reshard", "reshard_like"]
+
+
+def _as_sharding(dst, spec) -> NamedSharding:
+    if isinstance(dst, NamedSharding):
+        return dst
+    if isinstance(dst, Mesh):
+        if spec is None:
+            raise ValueError("reshard(x, mesh, spec): spec required when "
+                             "passing a Mesh")
+        if not isinstance(spec, PartitionSpec):
+            spec = PartitionSpec(*spec)
+        return NamedSharding(dst, spec)
+    raise TypeError(f"reshard target must be NamedSharding or Mesh, got "
+                    f"{type(dst).__name__}")
+
+
+def reshard(x, dst: Union[NamedSharding, Mesh],
+            spec: Optional[Union[PartitionSpec, Sequence]] = None,
+            donate: bool = False):
+    """Return ``x`` laid out as ``dst`` (a NamedSharding, or Mesh + spec).
+
+    Works for: same-mesh respec (e.g. row-shard -> col-shard), cross-mesh
+    moves over the same device set (e.g. (8,) 'x' -> (2, 4) 'a','b'),
+    and multi-process global meshes (each process passes its view of the
+    global array; XLA moves bytes over ICI/DCN). Under jit tracing it
+    degrades to a sharding constraint on the traced value.
+
+    ``donate``: donate the source buffers (the old layout's memory is
+    released as the collective runs — the in-place flavor of the
+    reference's Resharder).
+    """
+    from ..core.tensor import Tensor
+    wrap = isinstance(x, Tensor)
+    arr = x._value if wrap else x
+    sharding = _as_sharding(dst, spec)
+
+    if isinstance(arr, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(arr, sharding)
+        return Tensor(out) if wrap else out
+
+    arr = jax.numpy.asarray(arr)
+    if getattr(arr, "sharding", None) is not None \
+            and arr.sharding.is_equivalent_to(sharding, arr.ndim):
+        return x  # already there: no program, no copy
+
+    out = _jitted_identity(sharding, donate)(arr)
+    return Tensor(out) if wrap else out
+
+
+def _identity(a):
+    return a
+
+
+@_functools.lru_cache(maxsize=256)
+def _jitted_identity(sharding: NamedSharding, donate: bool):
+    """One cached executable per (sharding, donate): a fresh lambda per
+    call would miss jax's compilation cache and re-trace+compile the
+    GSPMD program on every training-loop step."""
+    return jax.jit(_identity, out_shardings=sharding,
+                   donate_argnums=(0,) if donate else ())
+
+
+def reshard_like(x, other):
+    """Reshard ``x`` to the layout of array ``other``."""
+    from ..core.tensor import Tensor
+    ref = other._value if isinstance(other, Tensor) else other
+    if getattr(ref, "sharding", None) is None:
+        raise ValueError("reshard_like: reference has no sharding")
+    return reshard(x, ref.sharding)

@@ -306,7 +306,9 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     Mesh axes absent (or size 1) degrade gracefully.
     """
     cfg = model.config
-    n_stages = mesh.shape["pipe"]
+    # absent axes degrade to size 1 (the docstring contract): a planner
+    # mesh may carry only the axes its plan actually uses
+    n_stages = mesh.shape.get("pipe", 1)
     have = {a for a in mesh.axis_names if mesh.shape[a] > 1}
     data_axis = "data" if "data" in mesh.axis_names else None
     mdl = "model" if "model" in have else None
@@ -316,18 +318,28 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     per = L // (n_stages * V)
 
     outer, layers = split_params(model)
+    pipe_name = "pipe" if "pipe" in mesh.axis_names else None
+    if pipe_name is None and n_microbatches > 1:
+        # microbatching is a pipeline concept: without a pipe axis the
+        # batch runs in one shot (use gradient_merge for accumulation),
+        # so peak activation memory is NOT bounded by n_microbatches
+        import warnings
+        warnings.warn(
+            "llama_4d_train_step_factory: mesh has no 'pipe' axis — "
+            f"n_microbatches={n_microbatches} is ignored (full-batch "
+            "step)", stacklevel=2)
     if V > 1:
         # (L, ...) -> (V, P, per, ...): [v, d] = global stage v*P + d
         # (breadth-first interleaved placement)
         layers = jax.tree.map(
             lambda a: jnp.array(a, copy=True).reshape(
                 (V, n_stages, per) + a.shape[1:]), layers)
-        pipe_prefix = [None, "pipe"]
+        pipe_prefix = [None, pipe_name]
     else:
         layers = jax.tree.map(
             lambda a: jnp.array(a, copy=True).reshape(
                 (n_stages, per) + a.shape[1:]), layers)
-        pipe_prefix = ["pipe"]
+        pipe_prefix = [pipe_name]
     outer = {k: jnp.array(v, copy=True) for k, v in outer.items()}
 
     def layer_spec(key, shape):
@@ -397,7 +409,19 @@ def llama_4d_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                        axis=0)
         from ...parallel.pipeline import (pipeline_apply,
                                           pipeline_apply_interleaved)
-        if V > 1:
+        if pipe_name is None:
+            # no pipe axis on the planner's mesh: run the single stage
+            # in place (GSPMD still applies data/model/sharding layouts);
+            # remat must survive the degradation — the pipe branches get
+            # it inside pipeline_apply. Microbatching is a pipeline
+            # concept: without a pipe axis the batch runs in one shot
+            # (use gradient_merge for accumulation), so warn when the
+            # caller asked for it.
+            assert V == 1, "virtual stages need a 'pipe' mesh axis"
+            stage0 = jax.tree.map(lambda a: a[0], params["layers"])
+            fn = jax.checkpoint(stage_fn) if remat else stage_fn
+            h = fn(stage0, emb)
+        elif V > 1:
             h = pipeline_apply_interleaved(
                 stage_fn, params["layers"], emb, mesh, n_microbatches,
                 n_virtual=V, remat=remat, data_axis=data_axis,
