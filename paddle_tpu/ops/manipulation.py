@@ -271,7 +271,9 @@ def masked_fill(x, mask, value):
 
 @def_op("index_put")
 def index_put(x, indices, value, accumulate=False):
-    idx = tuple(i for i in indices)
+    # indices arrive as a python tuple, outside the dispatch unwrap:
+    # Tensor entries must be unwrapped by hand or jnp indexing rejects them
+    idx = tuple(i._value if isinstance(i, Tensor) else i for i in indices)
     if accumulate:
         return x.at[idx].add(value)
     return x.at[idx].set(value)
