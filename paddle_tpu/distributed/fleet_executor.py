@@ -117,17 +117,29 @@ class DistMessageBus(MessageBus):
     def _deliver(self, dst: int, payload):
         with self._mu:
             q = self._inboxes.get(dst)
-            if q is None:
+            # while a pre-registration backlog exists, new frames must
+            # keep appending to it — putting them straight into the
+            # fresh queue would let a late frame (worst case: _STOP)
+            # overtake earlier buffered data and drop microbatches
+            # (the round-3 flake in test_two_process_pipeline)
+            if q is None or dst in self._pending:
                 self._pending.setdefault(dst, []).append(payload)
                 return
         q.put(payload)
 
     def register(self, task_id: int, maxsize: int = 8) -> "queue.Queue":
         q = super().register(task_id, maxsize)
-        with self._mu:
-            backlog = self._pending.pop(task_id, [])
-        for p in backlog:
-            q.put(p)
+        while True:
+            with self._mu:
+                backlog = self._pending.get(task_id)
+                if not backlog:
+                    # fully drained: drop the key so _deliver goes
+                    # direct — order is preserved because frames kept
+                    # appending to the backlog until this moment
+                    self._pending.pop(task_id, None)
+                    break
+                p = backlog.pop(0)
+            q.put(p)  # outside _mu: a bounded queue may block here
         return q
 
     def send(self, dst: int, payload) -> None:
@@ -358,7 +370,11 @@ class DistCarrier:
             ic.start()
 
     def run(self, microbatches: Optional[List[Any]] = None) -> List[Any]:
-        self.results.clear()
+        # NO results.clear() here: the sink interceptor starts collecting
+        # at construction, and a fast feeder rank can deliver results
+        # before the sink rank's main thread even enters run() — clearing
+        # now would drop them (the round-3 load-dependent flake). The
+        # carrier is one-shot: construct a new one per run.
         if self.rank == self._head.rank:
             for i, mb in enumerate(microbatches or []):
                 self.bus.send(self._head.task_id, (i, mb))

@@ -50,3 +50,56 @@ def free_port():
 @pytest.fixture
 def free_port_factory():
     return _free_port
+
+
+# --- dist-test retry + quarantine discipline ------------------------------
+# ~ reference dist_test.sh (retry loop around multi-process tests) and
+# tools/get_quick_disable_lt.py (quarantine list fetched before the run).
+# Multi-process rendezvous tests are load-sensitive by nature; marked
+# tests get bounded reruns, and tests/quarantine.txt names node-id
+# substrings to skip outright (one per line, '#' comments).
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "dist_retry(n=1): rerun a load-sensitive multi-process test up to "
+        "n extra times on failure (~ dist_test.sh retry discipline)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import os
+    qpath = os.path.join(os.path.dirname(__file__), "quarantine.txt")
+    if not os.path.exists(qpath):
+        return
+    with open(qpath) as f:
+        # node-id substring, optional trailing '# issue-ref' comment
+        patterns = [ln.split("#")[0].strip() for ln in f
+                    if ln.split("#")[0].strip()]
+    if not patterns:
+        return
+    skip = pytest.mark.skip(reason="quarantined (tests/quarantine.txt)")
+    for item in items:
+        if any(p in item.nodeid for p in patterns):
+            item.add_marker(skip)
+
+
+def pytest_runtest_protocol(item, nextitem):
+    m = item.get_closest_marker("dist_retry")
+    if m is None:
+        return None
+    retries = int(m.kwargs.get("n", m.args[0] if m.args else 1))
+    from _pytest.runner import runtestprotocol
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    for attempt in range(retries + 1):
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+        if not any(r.failed for r in reports) or attempt == retries:
+            for r in reports:
+                item.ihook.pytest_runtest_logreport(report=r)
+            break
+        import warnings
+        warnings.warn(f"dist_retry: {item.nodeid} failed attempt "
+                      f"{attempt + 1}/{retries + 1}, retrying")
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True

@@ -6,6 +6,7 @@ OS processes on localhost, microbatches fed on rank 0, results gathered
 at the sink on rank 1. Payloads are plain python — the bus is transport,
 jax arrays convert to numpy at the wire (_host_payload).
 """
+import pytest
 import multiprocessing as mp
 import os
 import sys
@@ -93,6 +94,7 @@ class TestDistCarrier:
                     p.join(timeout=5)  # reap — kill alone leaves a zombie
             self._last_rcs = [p.exitcode for p in procs]
 
+    @pytest.mark.dist_retry(n=1)
     def test_two_process_pipeline(self):
         results = self._attempt_two_process()
         if results is None:  # environmental (ports/startup): one retry
@@ -104,6 +106,7 @@ class TestDistCarrier:
         assert results[0] == []            # feeder rank has no sink
         assert results[1] == [4, 6, 8]     # (x+1)*2 per microbatch
 
+    @pytest.mark.dist_retry(n=1)
     def test_single_process_two_rank_buses(self):
         # both "ranks" inside one process: exercises remote send/recv,
         # pre-registration buffering, and STOP forwarding over TCP

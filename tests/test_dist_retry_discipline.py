@@ -1,0 +1,24 @@
+"""The dist-test retry machinery itself (~ dist_test.sh discipline)."""
+import os
+
+import pytest
+
+_attempts = {"n": 0}  # process-local: no cross-run or cross-worker state
+
+
+@pytest.mark.dist_retry(n=1)
+def test_retry_reruns_failed_attempt():
+    """Fails on the first attempt, passes on the rerun — the marked
+    protocol must absorb exactly that pattern."""
+    _attempts["n"] += 1
+    assert _attempts["n"] >= 2, "first attempt fails by design"
+
+
+def test_quarantine_file_is_documented():
+    path = os.path.join(os.path.dirname(__file__), "quarantine.txt")
+    assert os.path.exists(path)
+    with open(path) as f:
+        active = [ln for ln in f
+                  if ln.strip() and not ln.startswith("#")]
+    # the list must stay empty unless a line carries an issue reference
+    assert all("#" in ln for ln in active), active

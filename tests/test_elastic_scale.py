@@ -10,6 +10,7 @@ relaunch. Collective execution across the processes is covered separately
 by test_multihost_mesh.py; this test validates the launcher's elastic
 contract: watch -> terminate -> env rewrite -> relaunch -> resume.
 """
+import pytest
 import json
 import os
 import subprocess
@@ -73,6 +74,7 @@ PEER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.dist_retry(n=1)
 def test_scale_up_down_relaunch_resume(tmp_path):
     script = tmp_path / "trainer.py"
     script.write_text(TRAINER)

@@ -5,6 +5,7 @@ micro-batches to an accelerator section over a stage channel) and the
 brpc PS service's many-workers contract (one handler thread per
 connection, table/memory_sparse_table.cc).
 """
+import pytest
 import json
 import os
 import socket
@@ -111,6 +112,7 @@ CPU_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.dist_retry(n=1)
 def test_heter_pipeline_three_processes(tmp_path):
     server = PSServer(port=0)
     server.add_sparse_table(0, dim=8, lr=0.05, rule="adagrad")
@@ -147,6 +149,7 @@ def test_heter_pipeline_three_processes(tmp_path):
     assert cres["table_size"] > 0  # sparse rows created + updated on the PS
 
 
+@pytest.mark.dist_retry(n=1)
 def test_ps_concurrent_trainers_large_table():
     """Many trainer connections hammering one sparse table concurrently
     (~ the brpc server's one-thread-per-worker contract); rows must stay
@@ -188,6 +191,7 @@ class TestSSDSparseTable:
     rocksdb role): rows must survive LRU eviction round trips bit-exact,
     the memory budget must hold, and the RPC path must serve it."""
 
+    @pytest.mark.dist_retry(n=1)
     def test_eviction_roundtrip_matches_in_memory_oracle(self, tmp_path):
         from paddle_tpu.distributed.ps import SparseTable, SSDSparseTable
         oracle = SparseTable(dim=8, lr=0.05, rule="adagrad", seed=3)
@@ -210,6 +214,7 @@ class TestSSDSparseTable:
                                    rtol=1e-6)
         assert ssd.size() == oracle.size() == 500
 
+    @pytest.mark.dist_retry(n=1)
     def test_save_load_and_rpc(self, tmp_path):
         from paddle_tpu.distributed.ps import (PSClient, PSServer,
                                                SparseTable)
@@ -231,6 +236,7 @@ class TestSSDSparseTable:
         t2.load(str(tmp_path / "snap.pkl"))
         np.testing.assert_allclose(t2.pull(ids), after, atol=1e-6)
 
+    @pytest.mark.dist_retry(n=1)
     def test_load_replaces_disk_state(self, tmp_path):
         # regression: stale pre-load rows must not resurrect from disk
         from paddle_tpu.distributed.ps import SSDSparseTable

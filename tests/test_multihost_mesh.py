@@ -13,6 +13,7 @@ crossing the process boundary).
 Losses must be identical on every rank (replicated output) and match the
 single-process 8-virtual-device oracle step for step.
 """
+import pytest
 import json
 import os
 import subprocess
@@ -109,6 +110,7 @@ def _run(tmp_path, nproc):
     return np.asarray(losses)
 
 
+@pytest.mark.dist_retry(n=1)
 def test_two_process_global_mesh_train_step(tmp_path):
     single = _run(tmp_path, 1)[0]
     two = _run(tmp_path, 2)
@@ -117,6 +119,7 @@ def test_two_process_global_mesh_train_step(tmp_path):
     assert single[-1] < single[0], "loss did not decrease"
 
 
+@pytest.mark.dist_retry(n=1)
 def test_two_node_launch_httpmaster_rendezvous(tmp_path):
     """The --nnodes > 1 path: two launch pods (node_rank 0/1) rendezvous
     through HTTPMaster.sync_peers, each contributing one trainer to ONE

@@ -6,6 +6,7 @@ over the 'model' axis (GSPMD inserts the mp allreduce the reference's
 RowParallelLinear does by hand); per-step losses must match the dense
 single-process oracle.
 """
+import pytest
 import json
 import os
 import subprocess
@@ -107,6 +108,7 @@ def _run(tmp_path, nproc):
     return np.asarray(losses)
 
 
+@pytest.mark.dist_retry(n=1)
 def test_tp_two_proc_loss_parity(tmp_path):
     single = _run(tmp_path, 1)[0]
     two = _run(tmp_path, 2)
