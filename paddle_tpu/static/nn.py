@@ -649,5 +649,77 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     return out
 
 
+def sequence_erase(input, tokens, length=None, name=None):
+    """Remove every occurrence of ``tokens`` from each row, compacting
+    left. ~ sequence_erase_op.h (LoD shrink) in the padded+lengths form:
+    returns (erased (B, T) with trailing pad 0, new_lengths (B,)).
+    jit-able: the compaction is a stable argsort over the keep mask."""
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import apply_op
+    import jax.numpy as jnp
+
+    tok = [int(t) for t in (tokens if hasattr(tokens, "__len__")
+                            else [tokens])]
+
+    def fn(v, *rest):
+        keep = _seq_mask(v, rest[0] if rest else None)
+        for t in tok:
+            keep = keep & (v != t)
+        # stable compaction: kept entries first, original order preserved
+        order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+        compacted = jnp.take_along_axis(v, order, 1)
+        kept_sorted = jnp.take_along_axis(keep, order, 1)
+        return (jnp.where(kept_sorted, compacted, 0),
+                keep.sum(1).astype(jnp.int32))
+
+    args = [input] + ([length] if length is not None else [])
+    out, new_len = apply_op("sequence_erase", fn, *args, nondiff=True)
+    return out, new_len
+
+
+def sequence_topk_avg_pooling(input, topks, channel_num=None, row=None,
+                              col=None, name=None):
+    """Per-row top-k column averages per channel.
+    ~ sequence_topk_avg_pooling_op.h (text-matching TopKPooling): input
+    (B, C, R, L); for each (b, c, r) take the top-k values over the L
+    (column) axis for every k in ``topks`` and average the REAL hits
+    (rows shorter than k average what exists — the reference pads
+    positions with TopKPosPaddingId and skips them). Returns
+    (B, R, C * len(topks)); ``col`` (B,) masks valid columns.
+    """
+    from ..ops.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    ks = [int(k) for k in topks]
+    kmax = max(ks)
+
+    def fn(x, *rest):
+        B, C, R, L = x.shape
+        if rest:
+            cm = (jnp.arange(L)[None, :]
+                  < rest[0].astype(jnp.int32)[:, None])  # (B, L)
+            valid = cm[:, None, None, :]
+        else:
+            valid = jnp.ones((B, 1, 1, L), bool)
+        neg = jnp.finfo(x.dtype).min
+        masked = jnp.where(valid, x, neg)
+        kk = min(kmax, L)
+        top, _ = jax.lax.top_k(masked, kk)          # (B, C, R, kk)
+        n_valid = jnp.broadcast_to(valid, x.shape).sum(-1)  # (B, C, R)
+        outs = []
+        for k in ks:
+            kcl = min(k, kk)
+            hit = jnp.minimum(n_valid, kcl)
+            take = (jnp.arange(kk)[None, None, None, :] < hit[..., None])
+            s = jnp.where(take, top[..., :kk], 0.0).sum(-1)
+            outs.append(s / jnp.maximum(hit, 1).astype(x.dtype))
+        out = jnp.stack(outs, -1)                   # (B, C, R, K)
+        return out.transpose(0, 2, 1, 3).reshape(B, R, -1)
+
+    args = [input] + ([col] if col is not None else [])
+    return apply_op("sequence_topk_avg_pooling", fn, *args)
+
+
 # ---- control flow re-exports ----------------------------------------------
 from ..ops.control_flow import case, cond, switch_case, while_loop  # noqa

@@ -5,6 +5,7 @@ MNIST/Cifar/... datasets).
 """
 from . import datasets  # noqa: F401
 from . import detection  # noqa: F401
+from . import detection_jit  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 

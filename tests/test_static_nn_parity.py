@@ -268,3 +268,44 @@ class TestDistributedEntries:
         import paddle_tpu.distributed as dist
         assert dist.ParallelMode.DATA_PARALLEL == 0
         assert dist.ParallelMode.SHARDING_PARALLEL == 3
+
+
+def test_sequence_erase():
+    import paddle_tpu.static.nn as snn
+    x = np.array([[2, 1, 3, 1, 5, 0], [1, 1, 2, 9, 0, 0]], np.int64)
+    lens = np.array([5, 4], np.int32)
+    out, nl = snn.sequence_erase(paddle.to_tensor(x), [1],
+                                 length=paddle.to_tensor(lens))
+    o = out.numpy()
+    # row 0 keeps [2,3,5] (the 1s erased, pad stays out)
+    np.testing.assert_array_equal(o[0, :3], [2, 3, 5])
+    assert (o[0, 3:] == 0).all()
+    np.testing.assert_array_equal(o[1, :2], [2, 9])
+    np.testing.assert_array_equal(nl.numpy(), [3, 2])
+    # multiple tokens
+    out2, nl2 = snn.sequence_erase(paddle.to_tensor(x), [1, 2],
+                                   length=paddle.to_tensor(lens))
+    np.testing.assert_array_equal(nl2.numpy(), [2, 1])
+    np.testing.assert_array_equal(out2.numpy()[0, :2], [3, 5])
+
+
+def test_sequence_topk_avg_pooling():
+    import jax
+    import paddle_tpu.static.nn as snn
+    rng = np.random.default_rng(0)
+    B, C, R, L = 2, 3, 4, 6
+    x = rng.normal(0, 1, (B, C, R, L)).astype(np.float32)
+    col = np.array([6, 4], np.int32)
+    out = snn.sequence_topk_avg_pooling(paddle.to_tensor(x), [1, 3],
+                                        col=paddle.to_tensor(col))
+    o = out.numpy()
+    assert o.shape == (B, R, C * 2)
+    # oracle for batch 1 (4 valid cols), channel 2, row 3
+    vals = np.sort(x[1, 2, 3, :4])[::-1]
+    np.testing.assert_allclose(o[1, 3, 2 * 2 + 0], vals[0], rtol=1e-6)
+    np.testing.assert_allclose(o[1, 3, 2 * 2 + 1], vals[:3].mean(),
+                               rtol=1e-6)
+    # jits (static shapes)
+    f = jax.jit(lambda v: snn.sequence_topk_avg_pooling(
+        paddle.Tensor(v), [2])._value)
+    assert f(x).shape == (B, R, C)
