@@ -33,6 +33,11 @@ class MoEConfig:
     # token alongside the routed ones (isolating common knowledge so the
     # fine-grained routed experts specialize); 0 = classic gshard/switch
     num_shared_experts: int = 0
+    # width of the fused shared-expert SwiGLU; None = num_shared_experts
+    # x intermediate_size (DeepSeek's same-width experts). Qwen-MoE uses
+    # a shared expert WIDER than the routed ones (e.g. 20480 vs 2560),
+    # which this overrides directly.
+    shared_expert_intermediate: int | None = None
 
     @staticmethod
     def tiny():
@@ -40,6 +45,19 @@ class MoEConfig:
                          intermediate_size=128, num_hidden_layers=2,
                          num_attention_heads=4, num_key_value_heads=4,
                          num_experts=4, moe_every=1)
+
+    @staticmethod
+    def qwen2_57b_a14b():
+        """Qwen2-57B-A14B shape (BASELINE config 5): 64 fine-grained
+        routed experts top-8 + one 20480-wide shared expert on every
+        MoE layer, GQA attention. Full-size preset — shard 'expert'
+        over EP and 'data'/'model' per the 4D factory for pod runs."""
+        return MoEConfig(vocab_size=151936, hidden_size=3584,
+                         intermediate_size=2560, num_hidden_layers=28,
+                         num_attention_heads=28, num_key_value_heads=4,
+                         num_experts=64, top_k=8, moe_every=1,
+                         num_shared_experts=1,
+                         shared_expert_intermediate=20480)
 
     @staticmethod
     def deepseek_tiny():
@@ -85,9 +103,10 @@ class MoEDecoderLayer(nn.Layer):
                 # intermediate width is n_shared x the routed experts'
                 # (DeepSeekMoE isolates common knowledge here; routed
                 # experts specialize)
+                shared_w = config.shared_expert_intermediate \
+                    or config.intermediate_size * config.num_shared_experts
                 self.shared_mlp = LlamaMLP(dataclasses.replace(
-                    lc, intermediate_size=config.intermediate_size
-                    * config.num_shared_experts))
+                    lc, intermediate_size=shared_w))
         else:
             self.mlp = LlamaMLP(lc)
         self.use_moe = use_moe

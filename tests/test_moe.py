@@ -1,0 +1,27 @@
+
+
+def test_qwen2_moe_preset_shape():
+    from paddle_tpu.models.nlp.moe import MoEConfig
+    c = MoEConfig.qwen2_57b_a14b()
+    # the published 57B-A14B routing shape: 64 routed top-8 + one
+    # 20480-wide shared expert (8x the routed width)
+    assert (c.num_experts, c.top_k, c.num_shared_experts) == (64, 8, 1)
+    assert c.shared_expert_intermediate == 20480
+    assert c.num_key_value_heads < c.num_attention_heads  # GQA
+
+
+def test_wide_shared_expert_builds():
+    import dataclasses
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp.moe import MoEConfig, MoEForCausalLM
+    paddle.seed(0)
+    cfg = dataclasses.replace(MoEConfig.deepseek_tiny(),
+                              shared_expert_intermediate=96)
+    m = MoEForCausalLM(cfg)
+    # the shared SwiGLU takes the override width, not n_shared x inter
+    gate = m.layers[0].shared_mlp.gate_proj.weight
+    assert gate.shape[-1] == 96 or gate.shape[0] == 96, gate.shape
+    tok = paddle.to_tensor(np.zeros((1, 8), np.int64))
+    out = m(tok)
+    assert out.shape[-1] == cfg.vocab_size

@@ -129,3 +129,23 @@ class TestPlannerGolden:
                           jnp.int32)
         _, _, loss = step(params, opt, tok, tok)
         assert np.isfinite(float(loss))
+
+
+def test_pod_projection_tool():
+    """tools/pod_projection.py: BASELINE #4 argued from measured eff +
+    the same CostModel the planner uses (no drift between them)."""
+    import json
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "pod_projection.py")],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-500:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["plan"]["dp"] * rec["plan"]["mp"] * rec["plan"]["pp"] == 64
+    assert 0 < rec["projected_mfu"] < 1
+    assert rec["memory_gb_per_chip"] < 95  # plan must fit v5p HBM
+    assert "eff_source" in rec

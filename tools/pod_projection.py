@@ -1,0 +1,113 @@
+"""Project the BASELINE #4 north star (Llama-3-8B, >=40% MFU, v5p-64)
+from single-chip measurements + the analytic comm model.
+
+One real chip cannot run the pod; what it CAN pin down is the compute
+term — the achieved fraction of peak at exactly the per-chip shard
+shapes an 8B TP-sliced layer puts on each chip (tools/mfu_scale.py
+tp_shard row, falling back to the 0.44B headline MFU from
+PERF_LAST_TPU.json). The ICI terms (TP allreduces, DP gradient
+allreduce, pipeline p2p + bubble) come from the same CostModel the
+planner ranks plans with (distributed/auto_parallel/cost_model.py),
+so the projection and the planner cannot drift apart.
+
+    projected_mfu = step_flops / (n_chips * peak * t_step)
+    t_step = (t_compute / measured_eff + t_tp) / (1 - bubble)
+             + t_dp + t_p2p
+
+Prints one JSON line; cites which measurement fed measured_eff.
+Run: PYTHONPATH=/root/repo python tools/pod_projection.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def measured_efficiency():
+    """(eff, source): achieved fraction of peak on the real chip."""
+    # best: the TP-shard-shaped row from the chip queue — check both
+    # output locations (the runner's default and the repo-rooted --out),
+    # and take the LATEST row (the runner appends across re-runs)
+    latest = None
+    for cq in (os.path.join(REPO, "CHIP_QUEUE_RESULTS.jsonl"),
+               "/tmp/chip_queue_results.jsonl"):
+        if not os.path.exists(cq):
+            continue
+        with open(cq) as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("name") == "mfu_scale_tp_shard":
+                    for row in rec.get("results", []):
+                        if "compute_mfu" in row:
+                            latest = float(row["compute_mfu"])
+    if latest is not None:
+        return latest, ("mfu_scale.py tp_shard (8B TP=8 per-chip "
+                        "shapes, measured)")
+    # fallback: the commit-keyed headline measurement
+    rec_path = os.path.join(REPO, "PERF_LAST_TPU.json")
+    if os.path.exists(rec_path):
+        with open(rec_path) as f:
+            rec = json.load(f)
+        if "mfu" in rec:
+            return (float(rec["mfu"]),
+                    f"PERF_LAST_TPU.json headline "
+                    f"({rec.get('config', '?')}, "
+                    f"commit {rec.get('measured_at_commit', '?')})")
+    return 0.55, "cost-model default (NO chip measurement found)"
+
+
+def main():
+    from paddle_tpu.distributed.auto_parallel import (Cluster, ModelSpec,
+                                                      Planner)
+
+    eff, source = measured_efficiency()
+
+    # Llama-3-8B pretraining shape at S=8192 on a v5p-64 slice
+    model = ModelSpec(n_layers=32, hidden=4096, intermediate=14336,
+                      vocab=128256, seq=8192, global_batch=128)
+    cluster = Cluster(n_devices=64)  # v5p defaults in DeviceSpec
+    planner = Planner(cluster, model)
+    best = planner.best()
+    est = best.cost  # the planner already ran the cost model
+
+    # compute term from first principles with the MEASURED efficiency
+    # (recomputing rather than rescaling est["compute"] keeps this
+    # independent of the cost model's internal eff constant)
+    t_compute = model.step_flops() / (cluster.n_devices
+                                      * cluster.device.peak_flops * eff)
+    t_step = ((t_compute + est["tp_comm"]) / (1 - est["bubble"])
+              + est["dp_comm"] + est["pp_p2p"])
+    peak = cluster.device.peak_flops
+    mfu = model.step_flops() / (cluster.n_devices * peak * t_step)
+    tok_per_chip = model.global_batch * model.seq / t_step \
+        / cluster.n_devices
+
+    print(json.dumps({
+        "target": "llama3-8b v5p-64 (BASELINE #4)",
+        "plan": {"dp": best.dp, "mp": best.mp, "pp": best.pp},
+        "measured_eff": round(eff, 4),
+        "eff_source": source,
+        "step_ms": round(t_step * 1e3, 1),
+        "projected_mfu": round(mfu, 4),
+        "tokens_per_sec_per_chip": round(tok_per_chip, 1),
+        "meets_40pct": bool(mfu >= 0.40),
+        "terms_ms": {
+            "compute": round(t_compute * 1e3, 1),
+            "tp_comm": round(est["tp_comm"] * 1e3, 1),
+            "dp_comm": round(est["dp_comm"] * 1e3, 1),
+            "pp_p2p": round(est["pp_p2p"] * 1e3, 1),
+            "bubble_frac": round(est["bubble"], 3),
+        },
+        "memory_gb_per_chip": round(est["memory_bytes"] / 1e9, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
