@@ -86,9 +86,16 @@ def _local_host() -> str:
 class P2PCommunicator:
     """Direct-socket p2p channels keyed (src_stage -> dst_stage, tag)."""
 
-    def __init__(self, store, stage_id: int, prefix: str = "__pp_p2p__"):
+    def __init__(self, store, stage_id: int, prefix: str = "__pp_p2p__",
+                 sub_rank: int = 0):
+        """``sub_rank``: the TP (mp) rank within the stage when PP
+        composes with TP — each mp-rank process publishes a DISTINCT
+        listener (addr key ``{prefix}/addr/{stage}:{sub}``) and p2p is
+        column-wise: sends dial the peer stage's communicator with the
+        SAME sub_rank (Megatron's partial p2p pairing)."""
         self._store = store
         self.stage_id = stage_id
+        self.sub_rank = sub_rank
         self._prefix = prefix
         self._send_socks: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
@@ -104,7 +111,7 @@ class P2PCommunicator:
         self._listener.bind(("0.0.0.0", 0))
         self._listener.listen(64)
         port = self._listener.getsockname()[1]
-        store.set(f"{prefix}/addr/{stage_id}",
+        store.set(f"{prefix}/addr/{stage_id}:{sub_rank}",
                   f"{_local_host()}:{port}".encode())
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
@@ -148,7 +155,7 @@ class P2PCommunicator:
         listener must produce a diagnostic, not a silent hang (the send
         side's analog of _RECV_TIMEOUT_S)."""
         res: "queue.Queue" = queue.Queue()
-        key = f"{self._prefix}/addr/{dst_stage}"
+        key = f"{self._prefix}/addr/{dst_stage}:{self.sub_rank}"
 
         def _w():
             try:
@@ -207,6 +214,36 @@ class P2PCommunicator:
                 f"after {_RECV_TIMEOUT_S}s — peer stage dead or schedule "
                 "mismatch") from None
         return _unpack(buf)
+
+    # -- partial p2p (the reference's partial_send/partial_recv) -----------
+    # When PP composes with TP, each mp rank ships only ITS 1/mp slice of
+    # the boundary tensor over its COLUMN's pipe wire
+    # (p2p_communication.py:156-215 _partial_send): the wire carries 1/mp
+    # of the bytes per rank. In the multi-process topology each mp-rank
+    # pair runs its own communicator (``sub_rank``) and the mp group's
+    # allgather reassembles (the reference's _partial_allgather is an mp
+    # collective); ``recv_partial`` below is the single-receiver form
+    # that pulls every slice over tags and reassembles in-process.
+
+    def send_partial(self, arr, dst_stage: int, mp_degree: int,
+                     mp_rank: int, tag: str = "act") -> None:
+        a = np.ascontiguousarray(np.asarray(arr))
+        flat = a.reshape(-1)
+        if flat.size % mp_degree:
+            raise ValueError(f"send_partial: {flat.size} elements not "
+                             f"divisible by mp_degree {mp_degree}")
+        step = flat.size // mp_degree
+        self.send(flat[mp_rank * step:(mp_rank + 1) * step], dst_stage,
+                  tag=f"{tag}/p{mp_rank}")
+
+    def recv_partial(self, src_stage: int, mp_degree: int, shape,
+                     tag: str = "act") -> np.ndarray:
+        """Gather all mp slices of one boundary tensor and reassemble to
+        ``shape`` (the receiving side's _partial_allgather)."""
+        parts = [self.recv(src_stage, tag=f"{tag}/p{r}")
+                 for r in range(mp_degree)]
+        return np.concatenate([p.reshape(-1) for p in parts]).reshape(
+            shape)
 
     # -- scalar broadcast (the _broadcast_final_loss analog) ---------------
     def bcast_scalar(self, value: Optional[float], src_stage: int,

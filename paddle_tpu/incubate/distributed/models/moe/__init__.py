@@ -91,6 +91,42 @@ def top2_gating(logits, capacity, noise_key=None):
     return jnp.maximum(d1, d2), c1 + c2, aux
 
 
+def topk_gating(logits, capacity, k):
+    """Generalized GShard-style top-k gate (k >= 2): the fine-grained
+    DeepSeek/Qwen routing regimes use top-4/top-8 over many small
+    experts. Iteratively takes the argmax k times (static unroll),
+    normalizes the k gate probs, and queues each choice's capacity
+    positions AFTER all earlier choices' per-expert counts — for k=2
+    this reproduces ``top2_gating`` exactly (tested)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, -1)
+    remaining = probs
+    picks = []
+    for _ in range(k):
+        g = jnp.argmax(remaining, -1)
+        p = jnp.max(remaining, -1)
+        oh = jax.nn.one_hot(g, E, dtype=jnp.float32)
+        remaining = remaining * (1 - oh)
+        picks.append((g, p, oh))
+    denom = jnp.maximum(sum(p for _, p, _ in picks), 1e-9)
+    aux = E * jnp.sum(jnp.mean(picks[0][2], 0) * jnp.mean(probs, 0))
+
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    prior_counts = jnp.zeros((1, E), jnp.float32)
+    for g, p, oh in picks:
+        pos = (jnp.sum((jnp.cumsum(oh, 0) - 1.0) * oh
+                       + prior_counts * oh, -1)).astype(jnp.int32)
+        keep = pos < capacity
+        d = (oh[:, :, None]
+             * jax.nn.one_hot(pos, capacity, dtype=jnp.float32)[:, None, :])
+        d = d * keep[:, None, None]
+        dispatch = jnp.maximum(dispatch, d)
+        combine = combine + d * ((p / denom) * keep)[:, None, None]
+        prior_counts = prior_counts + jnp.sum(oh, 0, keepdims=True)
+    return dispatch, combine, aux
+
+
 def expert_choice_gating(logits, capacity):
     """Expert-choice routing (Zhou et al. 2022): each EXPERT picks its
     top-``capacity`` tokens instead of tokens picking experts. Load
@@ -204,8 +240,10 @@ class MoELayer(nn.Layer):
                 dispatch, combine, aux = top1_gating(glt, cap, key,
                                                      0.01 if key is not None
                                                      else 0.0)
-            else:
+            elif topk == 2:
                 dispatch, combine, aux = top2_gating(glt, cap)
+            else:
+                dispatch, combine, aux = topk_gating(glt, cap, topk)
             # (T,E,C) x (T,H) -> (E,C,H): the all_to_all boundary under SPMD
             expert_in = jnp.einsum("tec,th->ech",
                                    dispatch.astype(xt.dtype), xt)

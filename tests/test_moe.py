@@ -25,3 +25,46 @@ def test_wide_shared_expert_builds():
     tok = paddle.to_tensor(np.zeros((1, 8), np.int64))
     out = m(tok)
     assert out.shape[-1] == cfg.vocab_size
+
+
+def test_topk_gating_reduces_to_top2():
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.incubate.distributed.models.moe import (top2_gating,
+                                                            topk_gating)
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 1, (12, 6)), jnp.float32)
+    d2, c2, a2 = top2_gating(logits, capacity=5)
+    dk, ck, ak = topk_gating(logits, capacity=5, k=2)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(d2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ck), np.asarray(c2), atol=1e-6)
+    np.testing.assert_allclose(float(ak), float(a2), rtol=1e-6)
+
+
+def test_topk_gating_k4_routes_four_experts():
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.incubate.distributed.models.moe import topk_gating
+    rng = np.random.default_rng(1)
+    T, E, C, K = 16, 8, 16, 4
+    logits = jnp.asarray(rng.normal(0, 1, (T, E)), jnp.float32)
+    d, c, _ = topk_gating(logits, capacity=C, k=K)
+    # ample capacity: every token hits EXACTLY k distinct experts
+    per_token = np.asarray(d).sum(axis=(1, 2))
+    np.testing.assert_array_equal(per_token, np.full(T, K))
+    # combine weights are the normalized top-k gate probs (sum to 1)
+    np.testing.assert_allclose(np.asarray(c).sum(axis=(1, 2)),
+                               np.ones(T), rtol=1e-5)
+
+
+def test_moe_forward_topk4():
+    import dataclasses
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp.moe import MoEConfig, MoEForCausalLM
+    paddle.seed(0)
+    cfg = dataclasses.replace(MoEConfig.tiny(), num_experts=8, top_k=4)
+    m = MoEForCausalLM(cfg)
+    out = m(paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 256, (2, 8))))
+    assert np.isfinite(np.asarray(out._value)).all()

@@ -95,3 +95,42 @@ def test_bcast_scalar(pair):
     assert a.bcast_scalar(3.25, src_stage=0) == 3.25
     t.join(30)
     assert out == [3.25]
+
+
+def test_partial_send_recv(pair):
+    """PP x TP boundary protocol: each mp rank ships 1/mp of the tensor;
+    the receiver reassembles (~ _partial_send/_partial_allgather)."""
+    a, b = pair
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    for r in range(2):  # both "mp ranks" share stage 0's communicator
+        a.send_partial(x, 1, mp_degree=2, mp_rank=r)
+    got = b.recv_partial(0, mp_degree=2, shape=x.shape)
+    np.testing.assert_array_equal(got, x)
+    with pytest.raises(ValueError, match="not divisible"):
+        a.send_partial(np.zeros(7, np.float32), 1, mp_degree=2, mp_rank=0)
+
+
+def test_sub_rank_columnwise_p2p(free_port):
+    """PP x TP: each mp rank runs its OWN communicator per stage; p2p is
+    column-wise (same sub_rank), so two mp ranks at one stage no longer
+    overwrite each other's listener address."""
+    master = TCPStore("127.0.0.1", free_port, is_master=True, world_size=1)
+    clients = [TCPStore("127.0.0.1", free_port, is_master=False,
+                        world_size=1) for _ in range(3)]
+    comms = {}
+    for stage in (0, 1):
+        for sub in (0, 1):
+            st = master if (stage, sub) == (0, 0) else clients.pop()
+            comms[(stage, sub)] = P2PCommunicator(
+                st, stage, prefix="__col__", sub_rank=sub)
+    x = np.arange(8, dtype=np.float32)
+    try:
+        # stage 0's two mp ranks each send their half down their column
+        comms[(0, 0)].send_partial(x, 1, mp_degree=2, mp_rank=0)
+        comms[(0, 1)].send_partial(x, 1, mp_degree=2, mp_rank=1)
+        got0 = comms[(1, 0)].recv(0, tag="act/p0")
+        got1 = comms[(1, 1)].recv(0, tag="act/p1")
+        np.testing.assert_array_equal(np.concatenate([got0, got1]), x)
+    finally:
+        for c in comms.values():
+            c.close()

@@ -29,14 +29,16 @@ sys.path.insert(0, REPO)
 
 def measured_efficiency():
     """(eff, source): achieved fraction of peak on the real chip."""
-    # best: the TP-shard-shaped row from the chip queue — check both
-    # output locations (the runner's default and the repo-rooted --out),
-    # and take the LATEST row (the runner appends across re-runs)
-    latest = None
+    # best: the TP-shard-shaped row from the chip queue. The repo-rooted
+    # file is authoritative (the round-4 runner's --out); /tmp is only a
+    # fallback for the runner's default path — a stale /tmp file must
+    # never shadow a fresh repo file. Within a file, the LAST row wins
+    # (the runner appends across re-runs).
     for cq in (os.path.join(REPO, "CHIP_QUEUE_RESULTS.jsonl"),
                "/tmp/chip_queue_results.jsonl"):
         if not os.path.exists(cq):
             continue
+        latest = None
         with open(cq) as f:
             for ln in f:
                 try:
@@ -47,9 +49,9 @@ def measured_efficiency():
                     for row in rec.get("results", []):
                         if "compute_mfu" in row:
                             latest = float(row["compute_mfu"])
-    if latest is not None:
-        return latest, ("mfu_scale.py tp_shard (8B TP=8 per-chip "
-                        "shapes, measured)")
+        if latest is not None:
+            return latest, ("mfu_scale.py tp_shard (8B TP=8 per-chip "
+                            f"shapes, measured; {os.path.basename(cq)})")
     # fallback: the commit-keyed headline measurement
     rec_path = os.path.join(REPO, "PERF_LAST_TPU.json")
     if os.path.exists(rec_path):
