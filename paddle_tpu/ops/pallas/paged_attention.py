@@ -1,0 +1,220 @@
+"""Paged KV-cache decode attention — the vLLM-style serving kernel.
+
+The reference's generative path (fused_multi_transformer_op.cu) allocates
+a DENSE (B, H, max_len, D) cache per batch slot: memory scales with
+max_len whatever the actual lengths, and sequences cannot share a pool.
+Paged attention stores K/V in fixed-size PAGES drawn from one global
+pool; each sequence holds a page table of indices, so cache memory
+tracks the sum of real lengths and slots are reused across requests —
+the design that makes continuous batching work.
+
+TPU mapping: the page table rides the scalar-prefetch channel
+(pltpu.PrefetchScalarGridSpec) so the BlockSpec index_map can address
+the NEXT page's (page_size, D) K/V block in HBM while the current one
+computes — Pallas double-buffers the gather; the kernel itself is an
+online-softmax accumulation over the grid's page axis with VMEM scratch
+carrying (m, l, acc) between pages. GQA: all G query heads sharing a kv
+head run in one program, so each page is fetched ONCE per kv head.
+
+API:
+  paged_attention(q, k_pages, v_pages, page_tables, seq_lens)
+    q           (B, Hq, D)            one decode position per sequence
+    k/v_pages   (Hkv, P, page_size, D) global page pools
+    page_tables (B, pages_per_seq)    page ids (padding ids are masked)
+    seq_lens    (B,)                  real lengths -> (B, Hq, D)
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _interpret
+
+
+def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, sm_scale, page_size):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = sl_ref[b]
+    base = j * page_size
+    q = q_ref[0, 0].astype(jnp.float32)            # (G, D)
+    k = k_ref[0, 0].astype(jnp.float32)            # (page_size, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * sm_scale                               # (G, page_size)
+    pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = pos < seq_len                           # padding pages: all F
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[...]                            # (G, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[...] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _done():
+        o_ref[0, 0] = (acc_scr[...]
+                       / jnp.maximum(l_scr[...], 1e-20)).astype(
+            o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
+                    sm_scale=None):
+    """Decode-step attention over a paged KV pool (shapes in the module
+    docstring). Non-differentiable by design — a serving kernel."""
+    B, Hq, D = q.shape
+    Hkv, P, page_size, Dk = k_pages.shape
+    if D != Dk:
+        raise ValueError(f"head_dim mismatch: q {D} vs pages {Dk}")
+    if Hq % Hkv:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads "
+                         f"{Hkv}")
+    G = Hq // Hkv
+    n_pages = page_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+
+    qr = q.reshape(B, Hkv, G, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
+                         (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D), lambda b, h, j, pt, sl:
+                         (h, pt[b, j], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D), lambda b, h, j, pt, sl:
+                         (h, pt[b, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
+                               (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, sm_scale=sm_scale,
+                          page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        interpret=_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(jnp.asarray(page_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), qr, k_pages, v_pages)
+    return out.reshape(B, Hq, D)
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_tables, seq_lens,
+                              sm_scale=None):
+    """Dense jnp oracle (gathers pages, masks, exact softmax)."""
+    B, Hq, D = q.shape
+    Hkv, P, page_size, _ = k_pages.shape
+    G = Hq // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    n_pages = page_tables.shape[1]
+    S = n_pages * page_size
+    # (B, Hkv, S, D) gathered caches
+    k = k_pages[:, page_tables].transpose(1, 0, 2, 3, 4).reshape(
+        B, Hkv, S, D)
+    v = v_pages[:, page_tables].transpose(1, 0, 2, 3, 4).reshape(
+        B, Hkv, S, D)
+    qg = q.reshape(B, Hkv, G, D).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhsd->bhgs", qg,
+                   k.astype(jnp.float32)) * sm_scale
+    mask = jnp.arange(S)[None, :] < jnp.asarray(seq_lens)[:, None]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, -1)
+    out = jnp.einsum("bhgs,bhsd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(B, Hq, D).astype(q.dtype)
+
+
+class PagedKVCache:
+    """Host-side page-pool bookkeeping for serving loops: a free list of
+    pages plus per-sequence tables (~ vLLM's BlockManager). Device data
+    stays functional — ``write`` returns the updated pools."""
+
+    def __init__(self, n_pages: int, page_size: int, kv_heads: int,
+                 head_dim: int, dtype=jnp.bfloat16):
+        self.page_size = page_size
+        self.k_pages = jnp.zeros((kv_heads, n_pages, page_size, head_dim),
+                                 dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self._free = list(range(n_pages - 1, 0, -1))  # page 0 = padding
+        self.tables: dict = {}
+        self.lengths: dict = {}
+
+    def allocate(self, seq_id, n_tokens: int):
+        """Reserve pages so ``seq_id`` can hold n_tokens total."""
+        table = self.tables.setdefault(seq_id, [])
+        need = -(-n_tokens // self.page_size) - len(table)
+        if need > len(self._free):
+            raise MemoryError(
+                f"paged cache exhausted: need {need} pages, "
+                f"{len(self._free)} free")
+        for _ in range(max(0, need)):
+            table.append(self._free.pop())
+        return table
+
+    def write(self, seq_id, k_new, v_new):
+        """Append (Hkv, T, D) keys/values for seq_id; returns the pool
+        arrays (functional update via dynamic slices per page)."""
+        T = k_new.shape[1]
+        start = self.lengths.get(seq_id, 0)
+        self.allocate(seq_id, start + T)
+        table = self.tables[seq_id]
+        ps = self.page_size
+        written = 0
+        while written < T:
+            pos = start + written
+            page = table[pos // ps]
+            off = pos % ps
+            n = min(ps - off, T - written)  # chunk ends at a page edge
+            self.k_pages = jax.lax.dynamic_update_slice(
+                self.k_pages, k_new[:, None, written:written + n].astype(
+                    self.k_pages.dtype), (0, page, off, 0))
+            self.v_pages = jax.lax.dynamic_update_slice(
+                self.v_pages, v_new[:, None, written:written + n].astype(
+                    self.v_pages.dtype), (0, page, off, 0))
+            written += n
+        self.lengths[seq_id] = start + T
+
+    def free(self, seq_id):
+        for p in self.tables.pop(seq_id, []):
+            self._free.append(p)
+        self.lengths.pop(seq_id, None)
+
+    def batch_views(self, seq_ids):
+        """(page_tables (B, max_pages), seq_lens (B,)) padded with the
+        reserved page 0."""
+        import numpy as np
+        tables = [self.tables[s] for s in seq_ids]
+        width = max(len(t) for t in tables)
+        pt = np.zeros((len(seq_ids), width), np.int32)
+        for i, t in enumerate(tables):
+            pt[i, :len(t)] = t
+        sl = np.asarray([self.lengths[s] for s in seq_ids], np.int32)
+        return jnp.asarray(pt), jnp.asarray(sl)

@@ -37,6 +37,8 @@ QUEUE = [
      {}),
     ("mfu_scale_tp_shard",
      [sys.executable, "tools/mfu_scale.py", "tp_shard"], {}),
+    ("kernel_chip_check",
+     [sys.executable, "tools/kernel_chip_check.py"], {}),
 ]
 
 

@@ -120,6 +120,57 @@ def splash_check(B, H, S, D, density):
     return ok
 
 
+def paged_check(B, Hq, Hkv, D, page_size, n_pages_per_seq, pool_pages):
+    """Real-Mosaic compile + numerics of the paged decode kernel (the
+    scalar-prefetch page gather is exactly what interpret mode cannot
+    validate), plus per-call ms at a serving-ish shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention, paged_attention_reference)
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal(
+        (Hkv, pool_pages, page_size, D)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal(
+        (Hkv, pool_pages, page_size, D)), jnp.bfloat16)
+    pt = jnp.asarray(rng.integers(1, pool_pages,
+                                  (B, n_pages_per_seq)), jnp.int32)
+    sl = jnp.asarray(rng.integers(page_size,
+                                  n_pages_per_seq * page_size + 1,
+                                  (B,)), jnp.int32)
+    # amortize the ~8-10 ms tunnel dispatch floor: chain ITERS decode
+    # steps inside ONE jit (the flash_bwd_sweep pattern) — the carry
+    # perturbs q so XLA cannot collapse the chain
+    ITERS = 32
+
+    def chained(q, kp, vp, pt, sl):
+        def body(carry, _):
+            o = paged_attention(carry, kp, vp, pt, sl)
+            return carry + (1e-6 * o).astype(carry.dtype), None
+        out, _ = jax.lax.scan(body, q, None, length=ITERS)
+        return out
+
+    fn = jax.jit(chained)
+    ms_total, _ = _sync_time(fn, q, kp, vp, pt, sl, n=3)
+    ms = ms_total / ITERS
+    out = jax.jit(paged_attention)(q, kp, vp, pt, sl)
+    _ = np.asarray(out.ravel()[0])
+    ref = paged_attention_reference(q.astype(jnp.float32),
+                                    kp.astype(jnp.float32),
+                                    vp.astype(jnp.float32), pt, sl)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    ok = err < 0.05  # bf16 kernel vs f32 oracle
+    print(json.dumps({
+        "check": f"paged B{B} Hq{Hq}/kv{Hkv} D{D} ps{page_size} "
+                 f"pages{n_pages_per_seq}",
+        "ms": round(ms, 3), "max_err": round(err, 4), "ok": ok,
+    }))
+    return ok
+
+
 if __name__ == "__main__":
     import sys
 
@@ -135,4 +186,13 @@ if __name__ == "__main__":
     results.append(gqa_check(B=4, Hkv=4, G=4, S=1024, D=64, causal=False))
     for den in (0.25, 0.5, 1.0):
         results.append(splash_check(B=4, H=8, S=2048, D=128, density=den))
+    # LAST + guarded: the paged kernel's first real-Mosaic compile must
+    # not burn the established checks' scarce tunnel window
+    try:
+        results.append(paged_check(B=8, Hq=32, Hkv=8, D=128,
+                                   page_size=64, n_pages_per_seq=128,
+                                   pool_pages=1024))
+    except Exception as e:  # noqa: BLE001 — report, don't abort
+        print(json.dumps({"check": "paged", "error": repr(e)[-300:]}))
+        results.append(False)
     sys.exit(0 if all(results) else 1)
