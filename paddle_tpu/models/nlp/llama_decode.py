@@ -549,3 +549,129 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
 
     generate.last_stats = {}
     return generate
+
+
+# --- paged decode (continuous batching) ------------------------------------
+
+def llama_paged_decode_factory(model: LlamaForCausalLM,
+                               page_size: int = 64,
+                               n_pool_pages: int = 256):
+    """Compiled decode over a PAGED KV pool — the continuous-batching
+    serving path (ops/pallas/paged_attention.py; the reference's dense
+    fused_multi_transformer cache cannot share memory across requests).
+
+    Per layer the pool is (Hkv, P, page_size, hd); sequences hold page
+    tables (B, pages_per_seq — the caller's table width) and real
+    lengths (B,). Ragged batches are
+    first-class: rotary positions, cache writes and attention masks are
+    all per-sequence, so requests at different depths decode together in
+    ONE jitted step — admit/evict between steps by editing the tables
+    (PagedKVCache does the host bookkeeping).
+
+    Returns (outer, layers, pools, prefill, decode_step):
+      pools: (k_pools, v_pools) each (L, Hkv, P, page_size, hd)
+      prefill(outer, layers, tokens (B,T), page_tables, lengths, pools)
+          -> (next_token (B,), pools')   [prompt K/V written to pages]
+      decode_step(outer, layers, tok (B,), page_tables, lengths, pools)
+          -> (next_token (B,), pools')   [lengths' = lengths + 1 is the
+                                          caller's bookkeeping]
+    """
+    from ...ops.pallas.paged_attention import paged_attention
+
+    cfg = model.config
+    outer, layers = split_params(model)
+    outer = {k: jnp.asarray(v) for k, v in outer.items()}
+    layers = {k: jnp.asarray(v) for k, v in layers.items()}
+    L = cfg.num_hidden_layers
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = cfg.hidden_size // nh
+    dtype = layers["self_attn.q_proj.weight"].dtype
+
+    def init_pools():
+        shape = (L, nkv, n_pool_pages, page_size, hd)
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    def _write_prompt(pool_l, kv, page_tables, T_pad):
+        """kv (B, nkv, T_pad, hd) -> pages at the tables' first
+        T_pad/page_size entries. Page ids are unique across the batch
+        (the allocator's invariant), so one scatter lands them all."""
+        B = kv.shape[0]
+        npg = T_pad // page_size
+        chunks = kv.reshape(B, nkv, npg, page_size, hd)
+        chunks = jnp.transpose(chunks, (1, 0, 2, 3, 4)).reshape(
+            nkv, B * npg, page_size, hd)
+        ids = page_tables[:, :npg].reshape(-1)
+        return pool_l.at[:, ids].set(chunks.astype(pool_l.dtype))
+
+    def _write_token(pool_l, kv, page_tables, lengths):
+        """kv (B, nkv, 1, hd) written at each sequence's current end."""
+        pages = jnp.take_along_axis(
+            page_tables, (lengths // page_size)[:, None], 1)[:, 0]
+        offs = lengths % page_size
+        upd = jnp.transpose(kv[:, :, 0], (1, 0, 2))     # (nkv, B, hd)
+        return pool_l.at[:, pages, offs].set(upd.astype(pool_l.dtype))
+
+    @partial(jax.jit, donate_argnums=(5,))  # pools alias in place
+    def prefill(outer, layers, tokens, page_tables, lengths, pools):
+        """Prompts padded to a page multiple; ``lengths`` are the REAL
+        prompt lengths (padding K/V lands in allocated pages but is
+        masked by lengths everywhere downstream)."""
+        k_pools, v_pools = pools
+        B, T = tokens.shape
+        if T % page_size:
+            raise ValueError(f"prefill length {T} must be a multiple of "
+                             f"page_size {page_size} (pad the prompt)")
+        x = jnp.take(outer["model.embed_tokens.weight"], tokens, axis=0)
+        pos_vec = jnp.arange(T)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        # padding keys never attend: key j valid iff j < len(b)
+        key_ok = jnp.arange(T)[None, :] < lengths[:, None]
+        mask = causal[None, None] & key_ok[:, None, None, :]
+
+        def body(x, per_layer):
+            lp, kp_l, vp_l = per_layer
+
+            def attend(q, k, v):
+                kp = _write_prompt(kp_l, k, page_tables, T)
+                vp = _write_prompt(vp_l, v, page_tables, T)
+                return _attend(cfg, q, k, v, mask), (kp, vp)
+
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend)
+            return x, (kp, vp)
+
+        x, (k_pools, v_pools) = jax.lax.scan(
+            body, x, (layers, k_pools, v_pools))
+        x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+        # each sequence's last REAL position owns the next token
+        x_last = jnp.take_along_axis(
+            x, (lengths - 1)[:, None, None].astype(jnp.int32), 1)[:, 0]
+        nxt = jnp.argmax(_logits(cfg, outer, x_last), -1)
+        return nxt, (k_pools, v_pools)
+
+    @partial(jax.jit, donate_argnums=(5,))  # no per-token pool copy
+    def decode_step(outer, layers, tok, page_tables, lengths, pools):
+        k_pools, v_pools = pools
+        x = jnp.take(outer["model.embed_tokens.weight"], tok,
+                     axis=0)[:, None]                    # (B, 1, H)
+        pos = lengths[:, None]                           # per-sequence
+
+        def body(x, per_layer):
+            lp, kp_l, vp_l = per_layer
+
+            def attend(q, k, v):
+                kp = _write_token(kp_l, k, page_tables, lengths)
+                vp = _write_token(vp_l, v, page_tables, lengths)
+                ctx = paged_attention(q[:, :, 0], kp, vp, page_tables,
+                                      lengths + 1)
+                return ctx[:, :, None], (kp, vp)
+
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend)
+            return x, (kp, vp)
+
+        x, (k_pools, v_pools) = jax.lax.scan(
+            body, x, (layers, k_pools, v_pools))
+        x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+        nxt = jnp.argmax(_logits(cfg, outer, x[:, 0]), -1)
+        return nxt, (k_pools, v_pools)
+
+    return outer, layers, init_pools(), prefill, decode_step

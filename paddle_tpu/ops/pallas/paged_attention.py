@@ -180,8 +180,9 @@ class PagedKVCache:
         return table
 
     def write(self, seq_id, k_new, v_new):
-        """Append (Hkv, T, D) keys/values for seq_id; returns the pool
-        arrays (functional update via dynamic slices per page)."""
+        """Append (Hkv, T, D) keys/values for seq_id; returns the
+        updated (k_pages, v_pages) pool arrays (also stored on self —
+        each update is a functional dynamic slice per page)."""
         T = k_new.shape[1]
         start = self.lengths.get(seq_id, 0)
         self.allocate(seq_id, start + T)
@@ -201,6 +202,7 @@ class PagedKVCache:
                     self.v_pages.dtype), (0, page, off, 0))
             written += n
         self.lengths[seq_id] = start + T
+        return self.k_pages, self.v_pages
 
     def free(self, seq_id):
         for p in self.tables.pop(seq_id, []):

@@ -17,12 +17,19 @@ import numpy as np
 
 
 def _sync_time(fn, *args, n=10):
+    import jax
+
+    def _sync(o):
+        # host readback of one leaf = the only real sync under axon
+        leaf = jax.tree_util.tree_leaves(o)[0]
+        _ = np.asarray(leaf.ravel()[0])
+
     out = fn(*args)
-    _ = np.asarray(out.ravel()[0])  # host readback = sync
+    _sync(out)
     t0 = time.perf_counter()
     for _i in range(n):
         out = fn(*args)
-    _ = np.asarray(out.ravel()[0])
+    _sync(out)
     return (time.perf_counter() - t0) / n * 1000, out
 
 
@@ -149,15 +156,15 @@ def paged_check(B, Hq, Hkv, D, page_size, n_pages_per_seq, pool_pages):
     def chained(q, kp, vp, pt, sl):
         def body(carry, _):
             o = paged_attention(carry, kp, vp, pt, sl)
-            return carry + (1e-6 * o).astype(carry.dtype), None
-        out, _ = jax.lax.scan(body, q, None, length=ITERS)
-        return out
+            return carry + (1e-6 * o).astype(carry.dtype), o
+        out, ys = jax.lax.scan(body, q, None, length=ITERS)
+        # ys[0] is the UNperturbed first call: numerics come from the
+        # same executable as the timing (one Mosaic compile, not two)
+        return out, ys[0]
 
     fn = jax.jit(chained)
-    ms_total, _ = _sync_time(fn, q, kp, vp, pt, sl, n=3)
+    ms_total, (_, out) = _sync_time(fn, q, kp, vp, pt, sl, n=3)
     ms = ms_total / ITERS
-    out = jax.jit(paged_attention)(q, kp, vp, pt, sl)
-    _ = np.asarray(out.ravel()[0])
     ref = paged_attention_reference(q.astype(jnp.float32),
                                     kp.astype(jnp.float32),
                                     vp.astype(jnp.float32), pt, sl)
