@@ -29,6 +29,7 @@ def _run(name, extra_env=None, timeout=420):
     ("serve_predictor.py", "served 8 requests"),
     ("finetune_hapi.py", "predict logits shape: (4, 10)"),
     ("train_ssd_detection.py", "top detection: class 1"),
+    ("serve_paged_llama.py", "served 6 requests"),
 ])
 def test_example_runs(name, expect):
     out = _run(name)
