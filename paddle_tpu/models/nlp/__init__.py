@@ -8,3 +8,4 @@ from .llama import (  # noqa: F401
 from .moe import MoEConfig, MoEForCausalLM  # noqa: F401
 from .llama_decode import llama_decode_factory  # noqa: F401,E402
 from .llama_decode import llama_paged_decode_factory  # noqa: F401,E402
+from .llama_decode import llama_speculative_decode_factory  # noqa: F401,E402
