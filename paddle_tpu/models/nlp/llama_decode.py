@@ -555,7 +555,8 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
 
 def llama_paged_decode_factory(model: LlamaForCausalLM,
                                page_size: int = 64,
-                               n_pool_pages: int = 256):
+                               n_pool_pages: int = 256,
+                               chunked_prefill: int | None = None):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -575,6 +576,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
       decode_step(outer, layers, tok (B,), page_tables, lengths, pools)
           -> (next_token (B,), pools')   [lengths' = lengths + 1 is the
                                           caller's bookkeeping]
+
+    ``chunked_prefill=C`` (a page multiple): the returned prefill walks
+    the prompt in C-token chunks, each attending causally to the pool
+    pages written so far — score memory per layer is O(C x table_width
+    x page_size) instead of the one-shot O(T^2): the long-prompt
+    admission path of serving stacks (vLLM's chunked prefill).
     """
     from ...ops.pallas.paged_attention import paged_attention
 
@@ -593,15 +600,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
 
     def _write_prompt(pool_l, kv, page_tables, T_pad):
         """kv (B, nkv, T_pad, hd) -> pages at the tables' first
-        T_pad/page_size entries. Page ids are unique across the batch
-        (the allocator's invariant), so one scatter lands them all."""
-        B = kv.shape[0]
-        npg = T_pad // page_size
-        chunks = kv.reshape(B, nkv, npg, page_size, hd)
-        chunks = jnp.transpose(chunks, (1, 0, 2, 3, 4)).reshape(
-            nkv, B * npg, page_size, hd)
-        ids = page_tables[:, :npg].reshape(-1)
-        return pool_l.at[:, ids].set(chunks.astype(pool_l.dtype))
+        T_pad/page_size entries: the start=0 case of _write_chunk."""
+        return _write_chunk(pool_l, kv, page_tables, 0, T_pad)
 
     def _write_token(pool_l, kv, page_tables, lengths):
         """kv (B, nkv, 1, hd) written at each sequence's current end."""
@@ -673,5 +673,92 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         nxt = jnp.argmax(_logits(cfg, outer, x[:, 0]), -1)
         return nxt, (k_pools, v_pools)
+
+    @partial(jax.jit, donate_argnums=(6,))
+    def _prefill_chunk(outer, layers, chunk, start, page_tables, lengths,
+                       pools, x_last):
+        """One C-token chunk at absolute positions start..start+C-1:
+        writes its pages, attends to every pool position < start+C, and
+        harvests the hidden state of each sequence's (length-1) row when
+        it falls inside this chunk."""
+        k_pools, v_pools = pools
+        B, C = chunk.shape
+        W = page_tables.shape[1]
+        S = W * page_size
+        x = jnp.take(outer["model.embed_tokens.weight"], chunk, axis=0)
+        pos_vec = start + jnp.arange(C)
+        # causal over ABSOLUTE key positions, bounded by real length
+        key_ok = (jnp.arange(S)[None, None, :]
+                  <= (start + jnp.arange(C))[None, :, None]) \
+            & (jnp.arange(S)[None, None, :]
+               < lengths[:, None, None])
+        mask = key_ok[:, None]                       # (B, 1, C, S)
+
+        def body(x, per_layer):
+            lp, kp_l, vp_l = per_layer
+
+            def attend(q, k, v):
+                kp = _write_chunk(kp_l, k, page_tables, start, C)
+                vp = _write_chunk(vp_l, v, page_tables, start, C)
+                # gather this batch's pages: (nkv, B, W, ps, hd)
+                k_all = jnp.swapaxes(kp[:, page_tables], 0, 1).reshape(
+                    B, nkv, S, hd)
+                v_all = jnp.swapaxes(vp[:, page_tables], 0, 1).reshape(
+                    B, nkv, S, hd)
+                return _attend(cfg, q, k_all.astype(q.dtype),
+                               v_all.astype(q.dtype), mask), (kp, vp)
+
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend)
+            return x, (kp, vp)
+
+        x, (k_pools, v_pools) = jax.lax.scan(
+            body, x, (layers, k_pools, v_pools))
+        # harvest rows whose (length-1) position lives in this chunk
+        idx = jnp.clip(lengths - 1 - start, 0, C - 1)
+        row = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                                  1)[:, 0]
+        hit = ((lengths - 1 >= start)
+               & (lengths - 1 < start + C))[:, None]
+        x_last = jnp.where(hit, row, x_last)
+        return x_last, (k_pools, v_pools)
+
+    def _write_chunk(pool_l, kv, page_tables, start, C):
+        """kv (B, nkv, C, hd) written at absolute positions start.. —
+        start and C are page multiples, so whole pages scatter."""
+        B = kv.shape[0]
+        npg = C // page_size
+        chunks = kv.reshape(B, nkv, npg, page_size, hd)
+        chunks = jnp.transpose(chunks, (1, 0, 2, 3, 4)).reshape(
+            nkv, B * npg, page_size, hd)
+        first = start // page_size
+        ids = jax.lax.dynamic_slice_in_dim(page_tables, first, npg,
+                                           1).reshape(-1)
+        return pool_l.at[:, ids].set(chunks.astype(pool_l.dtype))
+
+    @jax.jit
+    def _finish_prefill(outer, x_last):
+        x = _rms(x_last, outer["model.norm.weight"], cfg.rms_norm_eps)
+        return jnp.argmax(_logits(cfg, outer, x), -1)
+
+    def prefill_chunked(outer, layers, tokens, page_tables, lengths,
+                        pools):
+        C = chunked_prefill
+        B, T = tokens.shape
+        if T % C:
+            raise ValueError(
+                f"chunked prefill: padded prompt length {T} must be a "
+                f"multiple of the chunk size {C}")
+        x_last = jnp.zeros((B, cfg.hidden_size), dtype)
+        for s in range(0, T, C):     # static count; ONE compiled chunk fn
+            x_last, pools = _prefill_chunk(
+                outer, layers, tokens[:, s:s + C], s, page_tables,
+                lengths, pools, x_last)
+        return _finish_prefill(outer, x_last), pools
+
+    if chunked_prefill is not None:
+        if chunked_prefill % page_size:
+            raise ValueError("chunked_prefill must be a multiple of "
+                             f"page_size ({page_size})")
+        prefill = prefill_chunked
 
     return outer, layers, init_pools(), prefill, decode_step

@@ -85,3 +85,50 @@ def test_paged_decode_crosses_page_boundary():
 
     want = _greedy_eager(model, prompt, N)
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_chunked_prefill_matches_oneshot():
+    """Chunked prefill (C-token chunks attending through the pool) must
+    produce the same next token and the same subsequent decode stream as
+    the one-shot prefill."""
+    paddle.seed(2)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_paged_decode_factory as factory)
+    o1, l1, pools1, prefill1, decode1 = factory(model, page_size=PS,
+                                                n_pool_pages=16)
+    o2, l2, pools2, prefill2, decode2 = factory(model, page_size=PS,
+                                                n_pool_pages=16,
+                                                chunked_prefill=PS)
+    # chunk = 2 pages: exercises the multi-page scatter (npg > 1)
+    o3, l3, pools3, prefill3, decode3 = factory(model, page_size=PS,
+                                                n_pool_pages=16,
+                                                chunked_prefill=2 * PS)
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 64, 14).tolist(),
+               rng.integers(1, 64, 9).tolist()]
+    lengths = jnp.asarray([len(p) for p in prompts], jnp.int32)
+    T = 2 * PS  # two chunks
+    toks = np.zeros((2, T), np.int64)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2, head_dim=8)
+    for i in range(2):
+        book.allocate(i, 3 * PS)
+    pt = jnp.asarray(np.stack([book.tables[0], book.tables[1]]),
+                     jnp.int32)
+
+    n1, pools1 = prefill1(o1, l1, jnp.asarray(toks), pt, lengths, pools1)
+    n2, pools2 = prefill2(o2, l2, jnp.asarray(toks), pt, lengths, pools2)
+    n3, pools3 = prefill3(o3, l3, jnp.asarray(toks), pt, lengths, pools3)
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n3))
+    lens = lengths
+    for _ in range(4):
+        n1, pools1 = decode1(o1, l1, n1, pt, lens, pools1)
+        n2, pools2 = decode2(o2, l2, n2, pt, lens, pools2)
+        lens = lens + 1
+        np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
