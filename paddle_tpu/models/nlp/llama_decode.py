@@ -556,7 +556,8 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
 def llama_paged_decode_factory(model: LlamaForCausalLM,
                                page_size: int = 64,
                                n_pool_pages: int = 256,
-                               chunked_prefill: int | None = None):
+                               chunked_prefill: int | None = None,
+                               kv_cache_dtype: str | None = None):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -582,6 +583,10 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     pages written so far — score memory per layer is O(C x table_width
     x page_size) instead of the one-shot O(T^2): the long-prompt
     admission path of serving stacks (vLLM's chunked prefill).
+
+    ``kv_cache_dtype="int8"``: pool pages store the per-slot absmax
+    int8 codec (the dense cache's _q8) — serving cache memory halves
+    and the Pallas kernel dequantizes in VMEM per page.
     """
     from ...ops.pallas.paged_attention import paged_attention
 
@@ -594,8 +599,18 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     hd = cfg.hidden_size // nh
     dtype = layers["self_attn.q_proj.weight"].dtype
 
+    quantized = kv_cache_dtype == "int8"
+    if kv_cache_dtype not in (None, "int8"):
+        raise ValueError(f"kv_cache_dtype {kv_cache_dtype!r}: use None "
+                         "(model dtype) or 'int8'")
+
     def init_pools():
         shape = (L, nkv, n_pool_pages, page_size, hd)
+        if quantized:
+            def one():
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.ones(shape[:-1], jnp.float32))
+            return one(), one()
         return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
     def _write_prompt(pool_l, kv, page_tables, T_pad):
@@ -608,6 +623,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         pages = jnp.take_along_axis(
             page_tables, (lengths // page_size)[:, None], 1)[:, 0]
         offs = lengths % page_size
+        if isinstance(pool_l, tuple):
+            data, sc = pool_l
+            qd, s = _q8(kv)                              # (B,nkv,1,hd)
+            return (data.at[:, pages, offs].set(
+                        jnp.transpose(qd[:, :, 0], (1, 0, 2))),
+                    sc.at[:, pages, offs].set(s[:, :, 0].T))
         upd = jnp.transpose(kv[:, :, 0], (1, 0, 2))     # (nkv, B, hd)
         return pool_l.at[:, pages, offs].set(upd.astype(pool_l.dtype))
 
@@ -661,8 +682,14 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             def attend(q, k, v):
                 kp = _write_token(kp_l, k, page_tables, lengths)
                 vp = _write_token(vp_l, v, page_tables, lengths)
-                ctx = paged_attention(q[:, :, 0], kp, vp, page_tables,
-                                      lengths + 1)
+                if isinstance(kp, tuple):
+                    ctx = paged_attention(
+                        q[:, :, 0], kp[0], vp[0], page_tables,
+                        lengths + 1, k_scales=kp[1], v_scales=vp[1])
+                    ctx = ctx.astype(q.dtype)
+                else:
+                    ctx = paged_attention(q[:, :, 0], kp, vp,
+                                          page_tables, lengths + 1)
                 return ctx[:, :, None], (kp, vp)
 
             x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend)
@@ -700,11 +727,19 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             def attend(q, k, v):
                 kp = _write_chunk(kp_l, k, page_tables, start, C)
                 vp = _write_chunk(vp_l, v, page_tables, start, C)
-                # gather this batch's pages: (nkv, B, W, ps, hd)
-                k_all = jnp.swapaxes(kp[:, page_tables], 0, 1).reshape(
-                    B, nkv, S, hd)
-                v_all = jnp.swapaxes(vp[:, page_tables], 0, 1).reshape(
-                    B, nkv, S, hd)
+
+                def gather(pool):
+                    """(B, nkv, S, hd): gather the batch's pages FIRST,
+                    dequantize only that slice — never the whole pool."""
+                    if isinstance(pool, tuple):
+                        data, sc = pool
+                        g = (data[:, page_tables].astype(jnp.float32)
+                             * sc[:, page_tables][..., None])
+                    else:
+                        g = pool[:, page_tables]
+                    return jnp.swapaxes(g, 0, 1).reshape(B, nkv, S, hd)
+
+                k_all, v_all = gather(kp), gather(vp)
                 return _attend(cfg, q, k_all.astype(q.dtype),
                                v_all.astype(q.dtype), mask), (kp, vp)
 
@@ -727,13 +762,23 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         start and C are page multiples, so whole pages scatter."""
         B = kv.shape[0]
         npg = C // page_size
-        chunks = kv.reshape(B, nkv, npg, page_size, hd)
-        chunks = jnp.transpose(chunks, (1, 0, 2, 3, 4)).reshape(
-            nkv, B * npg, page_size, hd)
         first = start // page_size
         ids = jax.lax.dynamic_slice_in_dim(page_tables, first, npg,
                                            1).reshape(-1)
-        return pool_l.at[:, ids].set(chunks.astype(pool_l.dtype))
+
+        def pageify(a, *trail):
+            a = a.reshape((B, nkv, npg, page_size) + tuple(trail))
+            order = (1, 0, 2, 3) + tuple(range(4, a.ndim))
+            return jnp.transpose(a, order).reshape(
+                (nkv, B * npg, page_size) + tuple(trail))
+
+        if isinstance(pool_l, tuple):
+            data, sc = pool_l
+            qd, s = _q8(kv)
+            return (data.at[:, ids].set(pageify(qd, hd)),
+                    sc.at[:, ids].set(pageify(s)))
+        return pool_l.at[:, ids].set(
+            pageify(kv, hd).astype(pool_l.dtype))
 
     @jax.jit
     def _finish_prefill(outer, x_last):

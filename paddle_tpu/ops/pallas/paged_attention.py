@@ -36,8 +36,15 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, _interpret
 
 
-def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, sm_scale, page_size):
+def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale, page_size, quantized=False):
+    """One program per (sequence, kv head, page). ``quantized``: K/V
+    refs are int8 and two extra per-slot f32 scale refs precede the
+    output — dequant happens here in VMEM, halving cache HBM traffic."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -52,6 +59,9 @@ def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32)            # (G, D)
     k = k_ref[0, 0].astype(jnp.float32)            # (page_size, D)
     v = v_ref[0, 0].astype(jnp.float32)
+    if quantized:
+        k = k * ks_ref[0, 0]
+        v = v * vs_ref[0, 0]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
@@ -79,9 +89,11 @@ def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
-                    sm_scale=None):
+                    sm_scale=None, k_scales=None, v_scales=None):
     """Decode-step attention over a paged KV pool (shapes in the module
-    docstring). Non-differentiable by design — a serving kernel."""
+    docstring). ``k_scales``/``v_scales`` (Hkv, P, page_size) switch the
+    int8-pool path: pages are int8 and dequantized in VMEM per block.
+    Non-differentiable by design — a serving kernel."""
     B, Hq, D = q.shape
     Hkv, P, page_size, Dk = k_pages.shape
     if D != Dk:
@@ -95,17 +107,27 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
         sm_scale = 1.0 / math.sqrt(D)
 
     qr = q.reshape(B, Hkv, G, D)
+    quantized = k_scales is not None or v_scales is not None
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("int8 pools need BOTH k_scales and v_scales")
+
+    q_spec = pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
+                          (b, h, 0, 0))
+    page_spec = pl.BlockSpec((1, 1, page_size, D),
+                             lambda b, h, j, pt, sl: (h, pt[b, j], 0, 0))
+    scale_spec = pl.BlockSpec((1, 1, page_size, 1),
+                              lambda b, h, j, pt, sl:
+                              (h, pt[b, j], 0, 0))
+    in_specs = [q_spec, page_spec, page_spec]
+    args = [qr, k_pages, v_pages]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scales[..., None].astype(jnp.float32),
+                 v_scales[..., None].astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, Hkv, n_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
-                         (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D), lambda b, h, j, pt, sl:
-                         (h, pt[b, j], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D), lambda b, h, j, pt, sl:
-                         (h, pt[b, j], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
                                (b, h, 0, 0)),
         scratch_shapes=[
@@ -116,14 +138,14 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
     )
     out = pl.pallas_call(
         functools.partial(_paged_kernel, sm_scale=sm_scale,
-                          page_size=page_size),
+                          page_size=page_size, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(jnp.asarray(page_tables, jnp.int32),
-      jnp.asarray(seq_lens, jnp.int32), qr, k_pages, v_pages)
+      jnp.asarray(seq_lens, jnp.int32), *args)
     return out.reshape(B, Hq, D)
 
 

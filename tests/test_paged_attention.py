@@ -106,3 +106,35 @@ def test_pool_exhaustion_and_padding_page():
     cache.allocate("s", 8)
     with pytest.raises(MemoryError):
         cache.allocate("s", 12)
+
+
+def test_int8_pool_matches_dequant_oracle():
+    """int8 pages + per-slot scales: the kernel's in-VMEM dequant must
+    match the dense oracle run over the dequantized pool."""
+    rng = np.random.default_rng(7)
+    B, Hq, Hkv, D, P, ps, n = 2, 4, 2, 16, 9, 8, 3
+    q = jnp.asarray(rng.normal(0, 1, (B, Hq, D)), jnp.float32)
+    kf = rng.normal(0, 1, (Hkv, P, ps, D)).astype(np.float32)
+    vf = rng.normal(0, 1, (Hkv, P, ps, D)).astype(np.float32)
+
+    def quant(x):
+        scale = np.maximum(np.abs(x).max(-1), 1e-8) / 127.0
+        qd = np.clip(np.round(x / scale[..., None]), -127, 127)
+        return qd.astype(np.int8), scale.astype(np.float32)
+
+    kq, ks = quant(kf)
+    vq, vs = quant(vf)
+    pt = jnp.asarray(rng.choice(np.arange(1, P), (B, n), replace=False),
+                     jnp.int32)
+    sl = jnp.asarray([13, 16], jnp.int32)
+    got = paged_attention(q, jnp.asarray(kq), jnp.asarray(vq), pt, sl,
+                          k_scales=jnp.asarray(ks),
+                          v_scales=jnp.asarray(vs))
+    want = paged_attention_reference(
+        q, jnp.asarray(kq.astype(np.float32) * ks[..., None]),
+        jnp.asarray(vq.astype(np.float32) * vs[..., None]), pt, sl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="BOTH"):
+        paged_attention(q, jnp.asarray(kq), jnp.asarray(vq), pt, sl,
+                        k_scales=jnp.asarray(ks))

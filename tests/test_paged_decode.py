@@ -132,3 +132,43 @@ def test_chunked_prefill_matches_oneshot():
         n2, pools2 = decode2(o2, l2, n2, pt, lens, pools2)
         lens = lens + 1
         np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+
+
+def test_int8_pool_decode_close_to_fp():
+    """kv_cache_dtype='int8' on the paged path: greedy tokens match the
+    fp pools on a short horizon (the dense cache's int8 bar) and the
+    pools really store int8."""
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=64, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_paged_decode_factory as factory)
+    mk = lambda **kw: factory(model, page_size=PS, n_pool_pages=16, **kw)
+    o1, l1, pools_f, pre_f, dec_f = mk()
+    o2, l2, pools_q, pre_q, dec_q = mk(kv_cache_dtype="int8")
+    assert pools_q[0][0].dtype == jnp.int8
+
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 64, 6).tolist(),
+               rng.integers(1, 64, 4).tolist()]
+    lengths = jnp.asarray([len(p) for p in prompts], jnp.int32)
+    toks = np.zeros((2, PS), np.int64)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2,
+                        head_dim=16)
+    for i in range(2):
+        book.allocate(i, 2 * PS)
+    pt = jnp.asarray(np.stack([book.tables[0], book.tables[1]]),
+                     jnp.int32)
+
+    nf, pools_f = pre_f(o1, l1, jnp.asarray(toks), pt, lengths, pools_f)
+    nq, pools_q = pre_q(o2, l2, jnp.asarray(toks), pt, lengths, pools_q)
+    np.testing.assert_array_equal(np.asarray(nf), np.asarray(nq))
+    lens = lengths
+    for _ in range(5):
+        nf, pools_f = dec_f(o1, l1, nf, pt, lens, pools_f)
+        nq, pools_q = dec_q(o2, l2, nq, pt, lens, pools_q)
+        lens = lens + 1
+        np.testing.assert_array_equal(np.asarray(nf), np.asarray(nq))
