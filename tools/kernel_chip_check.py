@@ -165,6 +165,18 @@ def paged_check(B, Hq, Hkv, D, page_size, n_pages_per_seq, pool_pages):
     fn = jax.jit(chained)
     ms_total, (_, out) = _sync_time(fn, q, kp, vp, pt, sl, n=3)
     ms = ms_total / ITERS
+
+    # int8 pool variant through the same Mosaic path (dequant in VMEM)
+    kq = jnp.clip(jnp.round(kp.astype(jnp.float32) * 16), -127,
+                  127).astype(jnp.int8)
+    vq = jnp.clip(jnp.round(vp.astype(jnp.float32) * 16), -127,
+                  127).astype(jnp.int8)
+    sc = jnp.full(kp.shape[:-1], 1 / 16, jnp.float32)
+    out8 = jax.jit(lambda *a: paged_attention(
+        a[0], a[1], a[2], a[3], a[4], k_scales=sc, v_scales=sc))(
+        q, kq, vq, pt, sl)
+    _ = np.asarray(out8.ravel()[0])
+    int8_finite = bool(jnp.isfinite(out8.astype(jnp.float32)).all())
     ref = paged_attention_reference(q.astype(jnp.float32),
                                     kp.astype(jnp.float32),
                                     vp.astype(jnp.float32), pt, sl)
@@ -173,9 +185,10 @@ def paged_check(B, Hq, Hkv, D, page_size, n_pages_per_seq, pool_pages):
     print(json.dumps({
         "check": f"paged B{B} Hq{Hq}/kv{Hkv} D{D} ps{page_size} "
                  f"pages{n_pages_per_seq}",
-        "ms": round(ms, 3), "max_err": round(err, 4), "ok": ok,
+        "ms": round(ms, 3), "max_err": round(err, 4),
+        "int8_finite": int8_finite, "ok": ok and int8_finite,
     }))
-    return ok
+    return ok and int8_finite
 
 
 if __name__ == "__main__":
