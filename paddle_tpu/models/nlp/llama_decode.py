@@ -557,7 +557,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                page_size: int = 64,
                                n_pool_pages: int = 256,
                                chunked_prefill: int | None = None,
-                               kv_cache_dtype: str | None = None):
+                               kv_cache_dtype: str | None = None,
+                               emit: str = "token"):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -587,6 +588,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     ``kv_cache_dtype="int8"``: pool pages store the per-slot absmax
     int8 codec (the dense cache's _q8) — serving cache memory halves
     and the Pallas kernel dequantizes in VMEM per page.
+
+    ``emit="logits"``: prefill/decode_step return the last-position
+    logits (B, V) instead of greedy tokens, so the serving loop owns
+    sampling (temperature/top-k/top-p live with the request, not the
+    compiled program — the dense factory's in-jit sampler is the other
+    option when the whole loop is compiled).
     """
     from ...ops.pallas.paged_attention import paged_attention
 
@@ -603,6 +610,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     if kv_cache_dtype not in (None, "int8"):
         raise ValueError(f"kv_cache_dtype {kv_cache_dtype!r}: use None "
                          "(model dtype) or 'int8'")
+    if emit not in ("token", "logits"):
+        raise ValueError(f"emit {emit!r}: use 'token' or 'logits'")
+
+    def _emit(logits):
+        return jnp.argmax(logits, -1) if emit == "token" \
+            else logits.astype(jnp.float32)
 
     def init_pools():
         shape = (L, nkv, n_pool_pages, page_size, hd)
@@ -666,8 +679,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         # each sequence's last REAL position owns the next token
         x_last = jnp.take_along_axis(
             x, (lengths - 1)[:, None, None].astype(jnp.int32), 1)[:, 0]
-        nxt = jnp.argmax(_logits(cfg, outer, x_last), -1)
-        return nxt, (k_pools, v_pools)
+        out = _emit(_logits(cfg, outer, x_last))
+        return out, (k_pools, v_pools)
 
     @partial(jax.jit, donate_argnums=(5,))  # no per-token pool copy
     def decode_step(outer, layers, tok, page_tables, lengths, pools):
@@ -698,8 +711,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         x, (k_pools, v_pools) = jax.lax.scan(
             body, x, (layers, k_pools, v_pools))
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
-        nxt = jnp.argmax(_logits(cfg, outer, x[:, 0]), -1)
-        return nxt, (k_pools, v_pools)
+        out = _emit(_logits(cfg, outer, x[:, 0]))
+        return out, (k_pools, v_pools)
 
     @partial(jax.jit, donate_argnums=(6,))
     def _prefill_chunk(outer, layers, chunk, start, page_tables, lengths,
@@ -783,7 +796,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     @jax.jit
     def _finish_prefill(outer, x_last):
         x = _rms(x_last, outer["model.norm.weight"], cfg.rms_norm_eps)
-        return jnp.argmax(_logits(cfg, outer, x), -1)
+        return _emit(_logits(cfg, outer, x))
 
     def prefill_chunked(outer, layers, tokens, page_tables, lengths,
                         pools):

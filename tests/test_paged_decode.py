@@ -172,3 +172,37 @@ def test_int8_pool_decode_close_to_fp():
         nq, pools_q = dec_q(o2, l2, nq, pt, lens, pools_q)
         lens = lens + 1
         np.testing.assert_array_equal(np.asarray(nf), np.asarray(nq))
+
+
+def test_emit_logits_mode():
+    """emit='logits': the serving loop owns sampling; argmax over the
+    emitted logits must reproduce the token-mode stream."""
+    paddle.seed(6)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_paged_decode_factory as factory)
+    o1, l1, p1, pre_t, dec_t = factory(model, page_size=PS,
+                                       n_pool_pages=16)
+    o2, l2, p2, pre_l, dec_l = factory(model, page_size=PS,
+                                       n_pool_pages=16, emit="logits")
+
+    rng = np.random.default_rng(6)
+    toks = np.zeros((1, PS), np.int64)
+    toks[0, :5] = rng.integers(1, 64, 5)
+    lens = jnp.asarray([5], jnp.int32)
+    book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2, head_dim=8)
+    book.allocate(0, 2 * PS)
+    pt = jnp.asarray([book.tables[0]], jnp.int32)
+
+    nt, p1 = pre_t(o1, l1, jnp.asarray(toks), pt, lens, p1)
+    lg, p2 = pre_l(o2, l2, jnp.asarray(toks), pt, lens, p2)
+    assert lg.shape == (1, 64)
+    assert int(np.argmax(np.asarray(lg), -1)[0]) == int(nt[0])
+    for _ in range(3):
+        nt, p1 = dec_t(o1, l1, nt, pt, lens, p1)
+        tok_from_logits = jnp.argmax(lg, -1)
+        lg, p2 = dec_l(o2, l2, tok_from_logits, pt, lens, p2)
+        lens = lens + 1
+        assert int(np.argmax(np.asarray(lg), -1)[0]) == int(nt[0])
