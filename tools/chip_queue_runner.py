@@ -39,6 +39,8 @@ QUEUE = [
      [sys.executable, "tools/mfu_scale.py", "tp_shard"], {}),
     ("kernel_chip_check",
      [sys.executable, "tools/kernel_chip_check.py"], {}),
+    ("serving_bench",
+     [sys.executable, "tools/serving_bench.py"], {}),
 ]
 
 

@@ -1,0 +1,147 @@
+"""Serving-path chip bench: paged vs dense decode + speculative speedup.
+
+Chip-queue item complementing ladder_bench config 6 (dense compiled
+decode). Same 0.44B-ish model; measures on the real chip:
+  1. dense decode_step tokens/sec at B=8 (the ladder's serving shape)
+  2. paged decode_step tokens/sec at the same shape (fp and int8
+     pools) — the continuous-batching price/win vs the dense cache
+  3. greedy speculative decoding wall-clock vs plain decode at equal
+     output (draft = 2-layer slice config), with acceptance stats
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/serving_bench.py
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import os
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # the axon sitecustomize overrides the env var; the programmatic
+        # update still wins if applied before first backend use
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import (LlamaConfig, LlamaForCausalLM,
+                                       llama_paged_decode_factory)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_decode_factory, llama_speculative_decode_factory)
+    from paddle_tpu.ops.pallas.paged_attention import PagedKVCache
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048,
+                          dtype=jnp.bfloat16)
+        B, prompt_len, new, ps = 8, 128, 128, 64
+    else:
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        B, prompt_len, new, ps = 2, 8, 8, 8
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    prompt = np.asarray(rng.integers(1, cfg.vocab_size, (B, prompt_len)),
+                        np.int32)
+
+    def emit(rec):
+        rec["device"] = str(jax.devices()[0])
+        print(json.dumps(rec), flush=True)
+
+    # 1. dense decode (the ladder baseline, re-measured side by side)
+    gen = llama_decode_factory(model, max_len=prompt_len + new)
+    out = gen(jnp.asarray(prompt), max_new_tokens=new)
+    _ = np.asarray(out)          # host readback sync
+    reps = 3 if on_tpu else 1
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = gen(jnp.asarray(prompt), max_new_tokens=new)
+    _ = np.asarray(out)
+    dense_dt = (time.perf_counter() - t0) / reps
+    emit({"bench": "dense_decode", "B": B, "new": new,
+          "tokens_per_sec": round(B * new / dense_dt, 1)})
+
+    # 2. paged decode at the same shape (fp + int8 pools)
+    npages_seq = -(-(prompt_len + new) // ps)
+    pool_pages = B * npages_seq + 2
+    for kv_dtype in (None, "int8"):
+        o, l, pools, prefill, step = llama_paged_decode_factory(
+            model, page_size=ps, n_pool_pages=pool_pages,
+            kv_cache_dtype=kv_dtype)
+        book = PagedKVCache(pool_pages, ps,
+                            cfg.num_key_value_heads,
+                            cfg.hidden_size // cfg.num_attention_heads)
+        for b in range(B):
+            book.allocate(b, npages_seq * ps)
+            book.lengths[b] = prompt_len
+        pt, lens = book.batch_views(list(range(B)))
+        T = ps * (-(-prompt_len // ps))
+        toks = np.zeros((B, T), np.int64)
+        toks[:, :prompt_len] = prompt
+        nxt, pools = prefill(o, l, jnp.asarray(toks), pt, lens, pools)
+        t0 = time.perf_counter()
+        cur = lens
+        for _ in range(new):
+            nxt, pools = step(o, l, nxt, pt, cur, pools)
+            cur = cur + 1
+        _ = np.asarray(nxt)
+        dt = time.perf_counter() - t0
+        emit({"bench": f"paged_decode_{kv_dtype or 'fp'}", "B": B,
+              "new": new, "page_size": ps,
+              "tokens_per_sec": round(B * new / dt, 1),
+              # dense row includes its prefill inside gen(); this row is
+              # decode-only — compare tokens/sec with that caveat
+              "vs_dense_gen": round(dense_dt / dt, 3)})
+
+    # 3. speculative vs plain at equal (greedy) output, B=1
+    draft_cfg = LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size // 2,
+        intermediate_size=cfg.intermediate_size // 2,
+        num_hidden_layers=max(2, cfg.num_hidden_layers // 6),
+        num_attention_heads=max(2, cfg.num_attention_heads // 2),
+        num_key_value_heads=max(2, cfg.num_key_value_heads // 2),
+        max_position_embeddings=cfg.max_position_embeddings,
+        dtype=cfg.dtype) if on_tpu else LlamaConfig.tiny(
+        vocab=97, hidden=16, layers=1, heads=2, kv_heads=1)
+    draft = LlamaForCausalLM(draft_cfg)
+    draft.eval()
+    if on_tpu:
+        draft.to(dtype="bfloat16")
+    spec = llama_speculative_decode_factory(
+        model, draft, max_len=prompt_len + new + 8, n_draft=4)
+    p1 = prompt[:1]
+    out_plain = gen(jnp.asarray(p1), max_new_tokens=new)
+    _ = np.asarray(out_plain)
+    t0 = time.perf_counter()
+    out_plain = gen(jnp.asarray(p1), max_new_tokens=new)
+    _ = np.asarray(out_plain)
+    plain_dt = time.perf_counter() - t0
+    out_spec = np.asarray(spec(p1, max_new_tokens=new))  # warm
+    t0 = time.perf_counter()
+    out_spec = np.asarray(spec(p1, max_new_tokens=new))
+    spec_dt = time.perf_counter() - t0
+    match = bool((out_spec[:, :out_plain.shape[1]]
+                  == np.asarray(out_plain)).all())
+    emit({"bench": "speculative_vs_plain", "new": new,
+          "plain_s": round(plain_dt, 3), "spec_s": round(spec_dt, 3),
+          "speedup": round(plain_dt / spec_dt, 2),
+          "output_identical": match,
+          "stats": getattr(spec, "last_stats", {})})
+
+
+if __name__ == "__main__":
+    main()
