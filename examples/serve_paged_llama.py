@@ -68,11 +68,31 @@ def main():
     admit()
     step = 0
     while active or waiting:
+        if not active:
+            # nothing placeable: every waiting request needs more pages
+            # than the pool can ever free — a config error, not a state
+            # to spin on
+            raise RuntimeError(
+                f"pool too small for any waiting request "
+                f"({len(waiting)} waiting, {len(book._free)} pages free)")
         step += 1
         sids = sorted(active)
-        pt, ln = book.batch_views(sids)
-        assert pt.shape[1] == WIDTH  # every request allocates WIDTH pages
-        toks = jnp.asarray([active[s]["tok"] for s in sids])
+        # FIXED batch shape: empty slots ride along with length 0 and a
+        # page table of 0s, so the decode step never recompiles as
+        # requests come and go. A pad row writes its K/V into the
+        # RESERVED page 0 (PagedKVCache never allocates it) and attends
+        # only that slot — real requests never touch page 0, so the pad
+        # traffic is harmless by reservation, not by masking
+        pt_live, ln_live = book.batch_views(sids)
+        assert pt_live.shape[1] == WIDTH
+        pad = B - len(sids)
+        pt = jnp.concatenate(
+            [pt_live, jnp.zeros((pad, WIDTH), jnp.int32)]) if pad \
+            else pt_live
+        ln = jnp.concatenate(
+            [ln_live, jnp.zeros((pad,), jnp.int32)]) if pad else ln_live
+        toks = jnp.asarray([active[s]["tok"] for s in sids]
+                           + [0] * pad)
         nxt, state["pools"] = decode(outer, layers, toks, pt, ln,
                                      state["pools"])
         for i, s in enumerate(sids):

@@ -236,7 +236,7 @@ class PagedKVCache:
         reserved page 0."""
         import numpy as np
         tables = [self.tables[s] for s in seq_ids]
-        width = max(len(t) for t in tables)
+        width = max((len(t) for t in tables), default=1)
         pt = np.zeros((len(seq_ids), width), np.int32)
         for i, t in enumerate(tables):
             pt[i, :len(t)] = t
