@@ -558,7 +558,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                n_pool_pages: int = 256,
                                chunked_prefill: int | None = None,
                                kv_cache_dtype: str | None = None,
-                               emit: str = "token"):
+                               emit: str = "token",
+                               prefill_attention: str = "gather"):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -594,6 +595,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     sampling (temperature/top-k/top-p live with the request, not the
     compiled program — the dense factory's in-jit sampler is the other
     option when the whole loop is compiled).
+
+    ``prefill_attention="kernel"`` (chunked prefill only): attend each
+    chunk through the paged_prefill_attention Pallas kernel instead of
+    the dense page gather — no (B, nkv, S, hd) gathered temporary, and
+    int8 pools stay int8 all the way into VMEM. "gather" remains the
+    default until the kernel carries a chip measurement.
     """
     from ...ops.pallas.paged_attention import paged_attention
 
@@ -612,6 +619,9 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                          "(model dtype) or 'int8'")
     if emit not in ("token", "logits"):
         raise ValueError(f"emit {emit!r}: use 'token' or 'logits'")
+    if prefill_attention not in ("gather", "kernel"):
+        raise ValueError(f"prefill_attention {prefill_attention!r}: "
+                         "use 'gather' or 'kernel'")
 
     def _emit(logits):
         return jnp.argmax(logits, -1) if emit == "token" \
@@ -740,6 +750,17 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             def attend(q, k, v):
                 kp = _write_chunk(kp_l, k, page_tables, start, C)
                 vp = _write_chunk(vp_l, v, page_tables, start, C)
+                if prefill_attention == "kernel":
+                    from ...ops.pallas.paged_attention import (
+                        paged_prefill_attention)
+                    if isinstance(kp, tuple):
+                        ctx = paged_prefill_attention(
+                            q, kp[0], vp[0], page_tables, lengths,
+                            start, k_scales=kp[1], v_scales=vp[1])
+                    else:
+                        ctx = paged_prefill_attention(
+                            q, kp, vp, page_tables, lengths, start)
+                    return ctx.astype(q.dtype), (kp, vp)
 
                 def gather(pool):
                     """(B, nkv, S, hd): gather the batch's pages FIRST,

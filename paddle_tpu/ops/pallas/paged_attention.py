@@ -36,11 +36,17 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, _interpret
 
 
-def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
-                  sm_scale, page_size, quantized=False):
-    """One program per (sequence, kv head, page). ``quantized``: K/V
-    refs are int8 and two extra per-slot f32 scale refs precede the
-    output — dequant happens here in VMEM, halving cache HBM traffic."""
+def _paged_kernel(st_ref, pt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
+                  sm_scale, page_size, chunk, quantized=False):
+    """ONE program per (sequence, kv head, page), shared by decode and
+    chunked prefill: (G*chunk) query rows accumulate online softmax over
+    the page axis with VMEM scratch. Row r sits at absolute position
+    st_ref[b] + (r % chunk); masking is causal over absolute positions
+    AND bounded by seq_len — decode is simply the chunk=1 case with
+    st = seq_len - 1. ``quantized``: int8 K/V refs with two per-slot f32
+    scale refs preceding the output; dequant happens here in VMEM.
+    Pages entirely beyond the causal horizon or the sequence length are
+    skipped (no dot/exp), though their DMA is already pipelined."""
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -55,31 +61,39 @@ def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     seq_len = sl_ref[b]
+    start = st_ref[b]
     base = j * page_size
-    q = q_ref[0, 0].astype(jnp.float32)            # (G, D)
-    k = k_ref[0, 0].astype(jnp.float32)            # (page_size, D)
-    v = v_ref[0, 0].astype(jnp.float32)
-    if quantized:
-        k = k * ks_ref[0, 0]
-        v = v * vs_ref[0, 0]
+    live = (base <= start + chunk - 1) & (base < seq_len)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    s = s * sm_scale                               # (G, page_size)
-    pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = pos < seq_len                           # padding pages: all F
-    s = jnp.where(mask, s, NEG_INF)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)        # (G*chunk, D)
+        k = k_ref[0, 0].astype(jnp.float32)        # (page_size, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            kk = k * ks_ref[0, 0]
+            vv = v * vs_ref[0, 0]
+        else:
+            kk, vv = k, v
+        s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                           # (G*chunk, page_size)
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        row_pos = start + jax.lax.rem(rows, chunk)
+        col_pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (col_pos <= row_pos) & (col_pos < seq_len)
+        s = jnp.where(mask, s, NEG_INF)
 
-    m_prev = m_scr[...]                            # (G, 1)
-    m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1, keepdims=True)
-    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[...] = m_new
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, vv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _done():
@@ -88,64 +102,77 @@ def _paged_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, *rest,
             o_ref.dtype)
 
 
-def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
-                    sm_scale=None, k_scales=None, v_scales=None):
-    """Decode-step attention over a paged KV pool (shapes in the module
-    docstring). ``k_scales``/``v_scales`` (Hkv, P, page_size) switch the
-    int8-pool path: pages are int8 and dequantized in VMEM per block.
-    Non-differentiable by design — a serving kernel."""
-    B, Hq, D = q.shape
-    Hkv, P, page_size, Dk = k_pages.shape
+def _paged_call(q4, k_pages, v_pages, page_tables, seq_lens, starts,
+                chunk, sm_scale, k_scales, v_scales):
+    """Shared launcher: q4 (B, Hkv, G*chunk, D) -> same shape out."""
+    B, Hkv, rows, D = q4.shape
+    _, P, page_size, Dk = k_pages.shape
     if D != Dk:
         raise ValueError(f"head_dim mismatch: q {D} vs pages {Dk}")
-    if Hq % Hkv:
-        raise ValueError(f"query heads {Hq} not a multiple of kv heads "
-                         f"{Hkv}")
-    G = Hq // Hkv
     n_pages = page_tables.shape[1]
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(D)
-
-    qr = q.reshape(B, Hkv, G, D)
     quantized = k_scales is not None or v_scales is not None
     if quantized and (k_scales is None or v_scales is None):
         raise ValueError("int8 pools need BOTH k_scales and v_scales")
 
-    q_spec = pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
+    q_spec = pl.BlockSpec((1, 1, rows, D), lambda b, h, j, st, pt, sl:
                           (b, h, 0, 0))
     page_spec = pl.BlockSpec((1, 1, page_size, D),
-                             lambda b, h, j, pt, sl: (h, pt[b, j], 0, 0))
+                             lambda b, h, j, st, pt, sl:
+                             (h, pt[b, j], 0, 0))
     scale_spec = pl.BlockSpec((1, 1, page_size, 1),
-                              lambda b, h, j, pt, sl:
+                              lambda b, h, j, st, pt, sl:
                               (h, pt[b, j], 0, 0))
     in_specs = [q_spec, page_spec, page_spec]
-    args = [qr, k_pages, v_pages]
+    args = [q4, k_pages, v_pages]
     if quantized:
         in_specs += [scale_spec, scale_spec]
         args += [k_scales[..., None].astype(jnp.float32),
                  v_scales[..., None].astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, Hkv, n_pages),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, j, pt, sl:
-                               (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, rows, D),
+                               lambda b, h, j, st, pt, sl: (b, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, D), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_paged_kernel, sm_scale=sm_scale,
-                          page_size=page_size, quantized=quantized),
+                          page_size=page_size, chunk=chunk,
+                          quantized=quantized),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, D), q4.dtype),
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(jnp.asarray(page_tables, jnp.int32),
+    )(jnp.asarray(starts, jnp.int32).reshape(B),
+      jnp.asarray(page_tables, jnp.int32),
       jnp.asarray(seq_lens, jnp.int32), *args)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
+                    sm_scale=None, k_scales=None, v_scales=None):
+    """Decode-step attention over a paged KV pool (shapes in the module
+    docstring). ``k_scales``/``v_scales`` (Hkv, P, page_size) switch the
+    int8-pool path: pages are int8 and dequantized in VMEM per block.
+    Non-differentiable by design — a serving kernel. Internally the
+    chunk=1 case of the shared paged kernel with start = seq_len - 1."""
+    B, Hq, D = q.shape
+    Hkv = k_pages.shape[0]
+    if Hq % Hkv:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads "
+                         f"{Hkv}")
+    G = Hq // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+    out = _paged_call(q.reshape(B, Hkv, G, D), k_pages, v_pages,
+                      page_tables, sl, jnp.maximum(sl - 1, 0), 1,
+                      sm_scale, k_scales, v_scales)
     return out.reshape(B, Hq, D)
 
 
@@ -242,3 +269,32 @@ class PagedKVCache:
             pt[i, :len(t)] = t
         sl = np.asarray([self.lengths[s] for s in seq_ids], np.int32)
         return jnp.asarray(pt), jnp.asarray(sl)
+
+
+# --- prefill over pages (chunked-prefill attention) ------------------------
+
+def paged_prefill_attention(q, k_pages, v_pages, page_tables, seq_lens,
+                            q_start, sm_scale=None, k_scales=None,
+                            v_scales=None):
+    """Causal attention of a C-token query chunk against the paged pool
+    (the chunk's own K/V must already be written to its pages).
+
+    q (B, Hq, C, D); pools as in paged_attention; q_start: scalar
+    absolute position of the chunk's first token (shared across the
+    left-aligned batch). Returns (B, Hq, C, D). The chunk=C case of the
+    shared paged kernel; pages entirely beyond start+C or the sequence
+    length are skipped.
+    """
+    B, Hq, C, D = q.shape
+    Hkv = k_pages.shape[0]
+    if Hq % Hkv:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads "
+                         f"{Hkv}")
+    G = Hq // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    starts = jnp.full((B,), q_start, jnp.int32)
+    out = _paged_call(q.reshape(B, Hkv, G * C, D), k_pages, v_pages,
+                      page_tables, jnp.asarray(seq_lens, jnp.int32),
+                      starts, C, sm_scale, k_scales, v_scales)
+    return out.reshape(B, Hq, C, D)

@@ -138,3 +138,55 @@ def test_int8_pool_matches_dequant_oracle():
     with pytest.raises(ValueError, match="BOTH"):
         paged_attention(q, jnp.asarray(kq), jnp.asarray(vq), pt, sl,
                         k_scales=jnp.asarray(ks))
+
+
+def test_prefill_kernel_matches_dense_gather():
+    """paged_prefill_attention (chunk queries x pages, absolute-position
+    causal) vs the dense gather+softmax oracle, fp and int8 pools."""
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_prefill_attention)
+    rng = np.random.default_rng(11)
+    B, Hq, Hkv, C, D, P, ps, W = 2, 4, 2, 8, 16, 9, 8, 3
+    start = 8  # second page
+    q = jnp.asarray(rng.normal(0, 1, (B, Hq, C, D)), jnp.float32)
+    kp = jnp.asarray(rng.normal(0, 1, (Hkv, P, ps, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(0, 1, (Hkv, P, ps, D)), jnp.float32)
+    pt = jnp.asarray(rng.choice(np.arange(1, P), (B, W), replace=False),
+                     jnp.int32)
+    sl = jnp.asarray([start + C, start + 5], jnp.int32)
+
+    got = paged_prefill_attention(q, kp, vp, pt, sl, start)
+
+    # dense oracle
+    S = W * ps
+    k = jnp.swapaxes(kp[:, pt], 0, 1).reshape(B, Hkv, S, D)
+    v = jnp.swapaxes(vp[:, pt], 0, 1).reshape(B, Hkv, S, D)
+    G = Hq // Hkv
+    qg = q.reshape(B, Hkv, G, C, D)
+    s = jnp.einsum("bhgcd,bhsd->bhgcs", qg, k) / np.sqrt(D)
+    col = jnp.arange(S)[None, None, None, None, :]
+    row = start + jnp.arange(C)[None, None, None, :, None]
+    mask = (col <= row) & (col < jnp.asarray(sl)[:, None, None, None,
+                                                 None])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    want = jnp.einsum("bhgcs,bhsd->bhgcd", p, v).reshape(B, Hq, C, D)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    # int8 pool path agrees with its own dequantized oracle
+    def quant(x):
+        x = np.asarray(x)
+        sc = np.maximum(np.abs(x).max(-1), 1e-8) / 127.0
+        qd = np.clip(np.round(x / sc[..., None]), -127, 127)
+        return jnp.asarray(qd.astype(np.int8)), jnp.asarray(
+            sc.astype(np.float32))
+    kq, ks = quant(kp)
+    vq, vs = quant(vp)
+    got8 = paged_prefill_attention(q, kq, vq, pt, sl, start,
+                                   k_scales=ks, v_scales=vs)
+    want8 = paged_prefill_attention(
+        q, kq.astype(jnp.float32) * ks[..., None],
+        vq.astype(jnp.float32) * vs[..., None], pt, sl, start)
+    np.testing.assert_allclose(np.asarray(got8), np.asarray(want8),
+                               rtol=2e-5, atol=2e-5)

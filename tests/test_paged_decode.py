@@ -206,3 +206,42 @@ def test_emit_logits_mode():
         lg, p2 = dec_l(o2, l2, tok_from_logits, pt, lens, p2)
         lens = lens + 1
         assert int(np.argmax(np.asarray(lg), -1)[0]) == int(nt[0])
+
+
+def test_prefill_kernel_mode_matches_gather():
+    """prefill_attention='kernel' routes chunk attention through the
+    Pallas paged prefill kernel; the token stream must equal the
+    gather path, fp and int8."""
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_paged_decode_factory as factory)
+    for kv_dtype in (None, "int8"):
+        mk = lambda pa: factory(model, page_size=PS, n_pool_pages=16,
+                                chunked_prefill=PS,
+                                kv_cache_dtype=kv_dtype,
+                                prefill_attention=pa)
+        o1, l1, p1, pre_g, dec_g = mk("gather")
+        o2, l2, p2, pre_k, dec_k = mk("kernel")
+        rng = np.random.default_rng(8)
+        toks = np.zeros((2, 2 * PS), np.int64)
+        toks[0, :11] = rng.integers(1, 64, 11)
+        toks[1, :14] = rng.integers(1, 64, 14)
+        lens = jnp.asarray([11, 14], jnp.int32)
+        book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2,
+                            head_dim=8)
+        for i in range(2):
+            book.allocate(i, 3 * PS)
+        pt = jnp.asarray(np.stack([book.tables[0], book.tables[1]]),
+                         jnp.int32)
+        ng, p1 = pre_g(o1, l1, jnp.asarray(toks), pt, lens, p1)
+        nk, p2 = pre_k(o2, l2, jnp.asarray(toks), pt, lens, p2)
+        np.testing.assert_array_equal(np.asarray(ng), np.asarray(nk))
+        cur = lens
+        for _ in range(3):
+            ng, p1 = dec_g(o1, l1, ng, pt, cur, p1)
+            nk, p2 = dec_k(o2, l2, nk, pt, cur, p2)
+            cur = cur + 1
+            np.testing.assert_array_equal(np.asarray(ng), np.asarray(nk))

@@ -177,6 +177,35 @@ def paged_check(B, Hq, Hkv, D, page_size, n_pages_per_seq, pool_pages):
         q, kq, vq, pt, sl)
     _ = np.asarray(out8.ravel()[0])
     int8_finite = bool(jnp.isfinite(out8.astype(jnp.float32)).all())
+
+    # chunked-prefill kernel (chunk queries x pages) at a 256-token
+    # chunk, checked against a dense gather oracle — finite-but-wrong
+    # page gathers under real Mosaic must not pass
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_prefill_attention)
+    C = 256
+    start = 256
+    qc = jnp.asarray(rng.standard_normal((B, Hq, C, D)), jnp.bfloat16)
+    outp = jax.jit(lambda *a: paged_prefill_attention(*a))(
+        qc, kp, vp, pt, sl, start)
+    _ = np.asarray(outp.ravel()[0])
+    W = pt.shape[1]
+    S = W * page_size
+    G = Hq // Hkv
+    kg = jnp.swapaxes(kp[:, pt], 0, 1).reshape(B, Hkv, S, D)
+    vg = jnp.swapaxes(vp[:, pt], 0, 1).reshape(B, Hkv, S, D)
+    qg = qc.reshape(B, Hkv, G, C, D).astype(jnp.float32)
+    sc_ = jnp.einsum("bhgcd,bhsd->bhgcs", qg,
+                     kg.astype(jnp.float32)) / math.sqrt(D)
+    col = jnp.arange(S)[None, None, None, None, :]
+    row = start + jnp.arange(C)[None, None, None, :, None]
+    msk = (col <= row) & (col < sl[:, None, None, None, None])
+    sc_ = jnp.where(msk, sc_, -1e30)
+    pr = jax.nn.softmax(sc_, -1)
+    refp = jnp.einsum("bhgcs,bhsd->bhgcd", pr,
+                      vg.astype(jnp.float32)).reshape(B, Hq, C, D)
+    perr = float(jnp.max(jnp.abs(outp.astype(jnp.float32) - refp)))
+    prefill_finite = perr < 0.05
     ref = paged_attention_reference(q.astype(jnp.float32),
                                     kp.astype(jnp.float32),
                                     vp.astype(jnp.float32), pt, sl)
@@ -186,9 +215,11 @@ def paged_check(B, Hq, Hkv, D, page_size, n_pages_per_seq, pool_pages):
         "check": f"paged B{B} Hq{Hq}/kv{Hkv} D{D} ps{page_size} "
                  f"pages{n_pages_per_seq}",
         "ms": round(ms, 3), "max_err": round(err, 4),
-        "int8_finite": int8_finite, "ok": ok and int8_finite,
+        "int8_finite": int8_finite, "prefill_ok": prefill_finite,
+        "prefill_max_err": round(perr, 4),
+        "ok": ok and int8_finite and prefill_finite,
     }))
-    return ok and int8_finite
+    return ok and int8_finite and prefill_finite
 
 
 if __name__ == "__main__":
