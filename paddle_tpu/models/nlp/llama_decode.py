@@ -820,15 +820,27 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         return _emit(_logits(cfg, outer, x))
 
     def prefill_chunked(outer, layers, tokens, page_tables, lengths,
-                        pools):
+                        pools, resume_from: int = 0):
+        """``resume_from`` (a chunk multiple): skip chunks whose pages
+        already hold real K/V — the prefix-cache path
+        (PagedKVCache.acquire_prefix returns the cached token count;
+        pass the MINIMUM across the batch, rounded DOWN to a chunk
+        multiple — a larger value would skip chunks that are
+        uninitialized for the less-cached sequences). The final chunk
+        always runs so the last-position logits exist; its page writes
+        rewrite identical content when the tail was cached."""
         C = chunked_prefill
         B, T = tokens.shape
         if T % C:
             raise ValueError(
                 f"chunked prefill: padded prompt length {T} must be a "
                 f"multiple of the chunk size {C}")
+        if resume_from % C:
+            raise ValueError(f"resume_from {resume_from} must be a "
+                             f"chunk multiple ({C})")
+        resume = min(resume_from, T - C)
         x_last = jnp.zeros((B, cfg.hidden_size), dtype)
-        for s in range(0, T, C):     # static count; ONE compiled chunk fn
+        for s in range(resume, T, C):  # static count; ONE compiled fn
             x_last, pools = _prefill_chunk(
                 outer, layers, tokens[:, s:s + C], s, page_tables,
                 lengths, pools, x_last)

@@ -215,6 +215,14 @@ class PagedKVCache:
         self._free = list(range(n_pages - 1, 0, -1))  # page 0 = padding
         self.tables: dict = {}
         self.lengths: dict = {}
+        # prefix cache (~ vLLM automatic prefix caching): FULL pages of
+        # identical token prefixes are shared across sequences. Key =
+        # (parent_page_or_0, tuple of page tokens) -> page id; refcounts
+        # keep shared pages alive until every user frees them.
+        self._prefix: dict = {}
+        self._refs: dict = {}
+        self._page_key: dict = {}  # page id -> its prefix key
+        self._children: dict = {}  # page id -> keys with it as parent
 
     def allocate(self, seq_id, n_tokens: int):
         """Reserve pages so ``seq_id`` can hold n_tokens total."""
@@ -225,8 +233,58 @@ class PagedKVCache:
                 f"paged cache exhausted: need {need} pages, "
                 f"{len(self._free)} free")
         for _ in range(max(0, need)):
-            table.append(self._free.pop())
+            p = self._free.pop()
+            self._refs[p] = 1
+            table.append(p)
         return table
+
+    def acquire_prefix(self, seq_id, tokens) -> int:
+        """Match ``tokens`` against cached FULL prompt pages; matched
+        pages are SHARED into seq_id's table (refcounted) and the
+        number of cached tokens (a page multiple) is returned — the
+        prefill can resume past them (for a BATCHED prefill, resume at
+        the MINIMUM cached count across the batch). Call BEFORE
+        allocate(); if allocate() then raises MemoryError, call
+        free(seq_id) before retrying or requeueing, or the shared
+        refcounts leak."""
+        if seq_id in self.tables:
+            raise ValueError(
+                f"acquire_prefix: {seq_id!r} already holds pages — "
+                "free() it first (e.g. after a failed allocate)")
+        table = self.tables.setdefault(seq_id, [])
+        parent = 0
+        n = 0
+        ps = self.page_size
+        while n + ps <= len(tokens):
+            key = (parent, tuple(int(t) for t in tokens[n:n + ps]))
+            page = self._prefix.get(key)
+            if page is None:
+                break
+            self._refs[page] = self._refs.get(page, 0) + 1
+            table.append(page)
+            parent = page
+            n += ps
+        # write()/decode append after the cached prefix, never inside it
+        self.lengths[seq_id] = n
+        return n
+
+    def register_prefix(self, seq_id, tokens):
+        """Publish seq_id's FULL prompt pages (now holding real K/V) for
+        sharing. Call after the prompt's prefill wrote its pages."""
+        table = self.tables.get(seq_id, [])
+        parent = 0
+        ps = self.page_size
+        for i in range(len(tokens) // ps):
+            key = (parent, tuple(int(t) for t in tokens[i * ps:(i + 1)
+                                                        * ps]))
+            page = table[i]
+            existing = self._prefix.get(key)
+            if existing is None:
+                self._prefix[key] = page
+                self._page_key[page] = key
+                self._children.setdefault(parent, set()).add(key) \
+                    if parent else None
+            parent = self._prefix[key]
 
     def write(self, seq_id, k_new, v_new):
         """Append (Hkv, T, D) keys/values for seq_id; returns the
@@ -255,7 +313,24 @@ class PagedKVCache:
 
     def free(self, seq_id):
         for p in self.tables.pop(seq_id, []):
-            self._free.append(p)
+            rc = self._refs.get(p, 1) - 1
+            if rc <= 0:
+                self._refs.pop(p, None)
+                key = self._page_key.pop(p, None)
+                if key is not None:
+                    self._prefix.pop(key, None)
+                # a dead page's id may be recycled: every prefix key
+                # chained THROUGH it must die with it, or a future
+                # sequence could match stale children under the
+                # recycled id and share wrong-context K/V
+                for ck in self._children.pop(p, ()):  # noqa: B007
+                    page_c = self._prefix.pop(ck, None)
+                    if page_c is not None \
+                            and self._page_key.get(page_c) == ck:
+                        self._page_key.pop(page_c, None)
+                self._free.append(p)
+            else:
+                self._refs[p] = rc
         self.lengths.pop(seq_id, None)
 
     def batch_views(self, seq_ids):

@@ -190,3 +190,47 @@ def test_prefill_kernel_matches_dense_gather():
         vq.astype(jnp.float32) * vs[..., None], pt, sl, start)
     np.testing.assert_allclose(np.asarray(got8), np.asarray(want8),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_prefix_cache_child_keys_die_with_parent():
+    """Recycled page ids must never resurrect prefix chains: freeing a
+    parent page removes every child key chained through it (the
+    wrong-context-KV hazard), and a partially-failed admit can recover
+    via free() and retry."""
+    ps = 4
+    cache = PagedKVCache(n_pages=8, page_size=ps, kv_heads=1, head_dim=8)
+    X = list(range(10, 10 + ps))
+    Y = list(range(20, 20 + ps))
+    Z = list(range(30, 30 + ps))
+
+    # A publishes X+Y; B publishes X+Z uncached-overlapping (collides on X)
+    assert cache.acquire_prefix("A", X + Y) == 0
+    cache.allocate("A", 2 * ps)
+    cache.register_prefix("A", X + Y)
+    assert cache.acquire_prefix("B", X + Z) == ps  # shares A's X page
+    cache.allocate("B", 2 * ps)
+    cache.register_prefix("B", X + Z)
+    pX = cache.tables["A"][0]
+    assert cache.tables["B"][0] == pX and cache._refs[pX] == 2
+
+    # free both: X's page dies; the (X -> Z) child key must die with it
+    cache.free("A")
+    cache.free("B")
+    assert pX in cache._free
+    # a new sequence with prefix W then W+Z must NOT match stale chains
+    W = list(range(40, 40 + ps))
+    assert cache.acquire_prefix("C", W) == 0
+    cache.allocate("C", ps)
+    cache.register_prefix("C", W)
+    assert cache.acquire_prefix("D", W + Z) == ps  # only W matches
+    # lengths bookkeeping: write() appends AFTER the cached prefix
+    assert cache.lengths["D"] == ps
+
+    # recovery contract: failed allocate -> free -> retry works
+    cache.free("D")
+    assert cache.acquire_prefix("D", W + Z) == ps
+    with pytest.raises(MemoryError):
+        cache.allocate("D", 100 * ps)
+    cache.free("D")
+    assert cache.acquire_prefix("D", W + Z) == ps  # no assert, no leak
+    cache.free("D")

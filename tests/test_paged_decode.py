@@ -245,3 +245,94 @@ def test_prefill_kernel_mode_matches_gather():
             nk, p2 = dec_k(o2, l2, nk, pt, cur, p2)
             cur = cur + 1
             np.testing.assert_array_equal(np.asarray(ng), np.asarray(nk))
+
+
+def test_prefix_cache_reuses_pages_and_skips_chunks():
+    """vLLM-style prefix caching: a second request sharing a full-page
+    prompt prefix acquires the cached pages (refcounted) and resumes
+    prefill past them — tokens equal the uncached run exactly."""
+    paddle.seed(9)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_paged_decode_factory as factory)
+    o, l, pools, prefill, decode = factory(model, page_size=PS,
+                                           n_pool_pages=16,
+                                           chunked_prefill=PS)
+    rng = np.random.default_rng(10)
+    shared = rng.integers(1, 64, PS).tolist()        # one full page
+    tailA = rng.integers(1, 64, 3).tolist()
+    tailB = rng.integers(1, 64, 5).tolist()
+    book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2, head_dim=8)
+
+    def run(sid, prompt, resume):
+        T = 2 * PS
+        toks = np.zeros((1, T), np.int64)
+        toks[0, :len(prompt)] = prompt
+        book.allocate(sid, 3 * PS)
+        pt = jnp.asarray([book.tables[sid]], jnp.int32)
+        lens = jnp.asarray([len(prompt)], jnp.int32)
+        book.lengths[sid] = len(prompt)
+        nxt, p = prefill(o, l, jnp.asarray(toks), pt, lens,
+                         pools_box[0], resume_from=resume)
+        pools_box[0] = p
+        out = [int(nxt[0])]
+        cur = lens
+        for _ in range(3):
+            nxt, pools_box[0] = decode(o, l, nxt, pt, cur, pools_box[0])
+            cur = cur + 1
+            out.append(int(nxt[0]))
+        return out
+
+    pools_box = [pools]
+
+    # request A: no cache; publish its prompt pages
+    promptA = shared + tailA
+    nc = book.acquire_prefix("A", promptA)
+    assert nc == 0
+    outA = run("A", promptA, resume=0)
+    book.register_prefix("A", promptA)
+
+    # request B: same first page — acquire + resume past it
+    promptB = shared + tailB
+    ncB = book.acquire_prefix("B", promptB)
+    assert ncB == PS
+    assert book.tables["B"][0] == book.tables["A"][0]  # SHARED page
+    assert book._refs[book.tables["A"][0]] == 2
+    outB = run("B", promptB, resume=ncB)
+
+    # oracle: B uncached in a fresh book/pools
+    o2, l2, pools2, prefill2, decode2 = factory(model, page_size=PS,
+                                                n_pool_pages=16,
+                                                chunked_prefill=PS)
+    book2 = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2,
+                         head_dim=8)
+    pools_box2 = [pools2]
+
+    def run2(prompt):
+        T = 2 * PS
+        toks = np.zeros((1, T), np.int64)
+        toks[0, :len(prompt)] = prompt
+        book2.allocate("x", 3 * PS)
+        pt = jnp.asarray([book2.tables["x"]], jnp.int32)
+        lens = jnp.asarray([len(prompt)], jnp.int32)
+        nxt, pools_box2[0] = prefill2(o2, l2, jnp.asarray(toks), pt,
+                                      lens, pools_box2[0])
+        out = [int(nxt[0])]
+        cur = lens
+        for _ in range(3):
+            nxt, pools_box2[0] = decode2(o2, l2, nxt, pt, cur,
+                                         pools_box2[0])
+            cur = cur + 1
+            out.append(int(nxt[0]))
+        return out
+
+    np.testing.assert_array_equal(outB, run2(promptB))
+
+    # freeing A keeps the shared page alive for B; freeing B releases it
+    page = book.tables["A"][0]
+    book.free("A")
+    assert book._refs[page] == 1 and page not in book._free
+    book.free("B")
+    assert page in book._free
