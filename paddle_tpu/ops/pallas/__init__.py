@@ -2,4 +2,5 @@
 (operators/fused/*.cu) rebuilt for the MXU/VMEM model."""
 from .flash_attention import flash_attention  # noqa: F401
 from .layer_norm import fused_layer_norm, fused_rms_norm  # noqa: F401
-from .paged_attention import (PagedKVCache, paged_attention)  # noqa: F401
+from .paged_attention import (PagedKVCache, paged_attention,  # noqa: F401
+                              paged_prefill_attention)
