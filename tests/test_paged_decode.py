@@ -336,3 +336,33 @@ def test_prefix_cache_reuses_pages_and_skips_chunks():
     assert book._refs[page] == 1 and page not in book._free
     book.free("B")
     assert page in book._free
+
+
+def test_fixed_shape_batching_never_recompiles():
+    """The serving property the paged design promises: one compiled
+    decode executable serves every mix of live/pad slots — page tables
+    and lengths are data, not shapes (pad slots: length 0, page 0)."""
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(vocab=32, hidden=32, layers=1, heads=2,
+                           kv_heads=1)
+    model = LlamaForCausalLM(cfg)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_paged_decode_factory as factory)
+    o, l, pools, prefill, decode = factory(model, page_size=PS,
+                                           n_pool_pages=8)
+    B, W = 2, 2
+    toks = jnp.asarray(np.ones((B, PS), np.int64))
+    pt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    lens = jnp.asarray([5, 3], jnp.int32)
+    nxt, pools = prefill(o, l, toks, pt, lens, pools)
+    mixes = [  # (page_tables, lengths, tokens) — shapes identical
+        (pt, lens, nxt),
+        (jnp.asarray([[1, 2], [0, 0]], jnp.int32),
+         jnp.asarray([6, 0], jnp.int32), nxt),          # slot 1 empty
+        (jnp.asarray([[5, 6], [3, 4]], jnp.int32),
+         jnp.asarray([1, 7], jnp.int32), nxt),          # new request
+    ]
+    for ptx, lnx, tok in mixes:
+        out, pools = decode(o, l, tok, ptx, lnx, pools)
+        assert np.isfinite(np.asarray(out)).all() or True  # int tokens
+    assert decode._cache_size() == 1, decode._cache_size()
