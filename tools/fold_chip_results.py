@@ -1,0 +1,76 @@
+"""Fold CHIP_QUEUE_RESULTS.jsonl into PERF.md, idempotently.
+
+The detached queue runner appends one JSON line per finished chip
+experiment; this tool renders each NEW record (not yet folded, tracked
+by a marker comment) as a PERF.md subsection with the raw result rows.
+Safe to run any time — it only appends unseen records, so the next
+session (or a human) can fold whatever the tunnel window produced:
+
+    python tools/fold_chip_results.py            # fold + print summary
+
+Analysis (e.g. flipping flash backward-block defaults after a sweep)
+stays manual — this captures the DATA next to the narrative so a
+results file on a dying tunnel is never the only copy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF = os.path.join(REPO, "PERF.md")
+MARK = "<!-- folded-chip-record:"
+
+
+def main():
+    src = os.path.join(REPO, "CHIP_QUEUE_RESULTS.jsonl")
+    if not os.path.exists(src):
+        print("no CHIP_QUEUE_RESULTS.jsonl — nothing to fold")
+        return
+    with open(PERF) as f:
+        perf = f.read()
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        commit = "unknown"
+
+    folded = 0
+    out = []
+    with open(src) as f:
+        for i, ln in enumerate(f):
+            ln = ln.strip()
+            if not ln:
+                continue
+            marker = f"{MARK}{i}:{zlib.crc32(ln.encode())} -->"
+            if marker in perf:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            name = rec.get("name", f"record_{i}")
+            rows = rec.get("results", [])
+            body = "\n".join(f"    {json.dumps(r)}" for r in rows) \
+                or f"    rc={rec.get('rc')} {rec.get('stderr_tail', '')[:200]}"
+            out.append(f"\n### chip: {name} {marker}\n\n"
+                       f"(queue runner, folded at commit {commit}; "
+                       f"wall {rec.get('wall_s', '?')}s)\n\n{body}\n")
+            folded += 1
+
+    if not folded:
+        print("no new records to fold")
+        return
+    header = "\n## Chip queue results (raw, auto-folded)\n"
+    if header not in perf:
+        perf += header
+    with open(PERF, "w") as f:
+        f.write(perf + "".join(out))
+    print(f"folded {folded} new record(s) into PERF.md")
+
+
+if __name__ == "__main__":
+    main()
