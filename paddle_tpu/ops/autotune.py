@@ -144,10 +144,10 @@ def autotune(op: str, candidates: Sequence[Callable], args,
 
 # ---- tuned flash attention -------------------------------------------------
 
-# Ordered best-first for v5e (measured fwd+bwd at S=2048, D=128):
-# 512x512 = 11.6ms, 256x512 = 13.6ms, 256x256 = 15.1ms, 128x128 = 18.4ms.
-_FA_BLOCKS = ((512, 512), (256, 512), (512, 256), (256, 256), (128, 512),
-              (512, 128), (128, 128))
+# The canonical measured best-first ordering lives next to the kernels;
+# sharing it keeps the tuner's candidate order and the resolver's
+# auto-pick from ever diverging.
+from .pallas.flash_attention import MEASURED_BLOCK_ORDER as _FA_BLOCKS
 
 
 def tuned_flash_attention(q, k, v, causal=False, sm_scale=None):

@@ -11,10 +11,14 @@ Design notes (measured on v5e at B=8, H=12, S=2048, D=128, bf16):
 - K/V stay RESIDENT in VMEM for the whole kv walk (full-seq BlockSpec) and
   the walk is a fori_loop — measured faster (337ms train step) than
   streaming kv blocks through an innermost grid dimension with scratch
-  accumulators (366ms): resident K/V costs zero DMA inside the loop, and at
-  S<=16k the footprint (S*D*2B per tensor) fits VMEM comfortably. Longer
-  sequences should shard over the 'sep' mesh axis (ring attention) rather
-  than stream here.
+  accumulators (366ms): resident K/V costs zero DMA inside the loop. The
+  resident footprint grows with S, and the chip showed the 512x512-block
+  kernels overflow the 16M scoped-vmem budget at S=8192 (21M) — so
+  `_resolve_blocks` runs a fit model that shrinks blocks as S grows and
+  switches to the grid-streamed kernel variants (O(block) VMEM at any S)
+  past the resident frontier. Multi-chip long context should still shard
+  over the 'sep' mesh axis (ring attention); streaming is the single-chip
+  escape hatch.
 - Matmul operands stay in their storage dtype (bf16 runs the MXU at full
   rate; f32 at half), accumulating in f32 via preferred_element_type.
 - Softmax runs in the exp2 domain with sm_scale*log2e folded into q (or k)
@@ -70,8 +74,109 @@ def _pick_block(seq_len: int) -> int:
         f"{seq_len}; pad the sequence to a multiple of 128")
 
 
-def _resolve_blocks(Sq, Sk, block_q, block_k):
-    return (block_q or _pick_block(Sq), block_k or _pick_block(Sk))
+# Scoped-VMEM fit model, calibrated on chip (v5e, 16M scoped limit):
+# the S=2048 train step compiles at 512x512 while S=8192 fails with
+# "Scoped allocation with size 21.00M" — consistent with resident K/V
+# double-buffered by Mosaic (2 tensors x 2 buffers x Sk*D*2B: 2M at
+# S=2048, 8M at S=8192) plus ~13 (block_q x block_k) f32-buffer
+# equivalents of compute temporaries/streams in the worst kernel
+# (s/p/dp/ds, masked copies, iota pair, exp2 results, acc, q/o streams).
+# tools/long8k_vmem_repro.py re-measures the frontier on chip; adjust
+# _TEMP_COEF if Mosaic's allocator changes.
+_SCOPED_VMEM = 16 * 2**20
+_TEMP_COEF = 13
+_FIT_MARGIN = 2**20
+
+
+def _resident_fits(bq, bk, Sres, D, itemsize=2) -> bool:
+    # Sres: the longest sequence any resident-mode kernel holds full-length
+    # in VMEM — Sk for the forward/dq kernels (K+V resident), and
+    # max(Sq, Sk) on the backward path (the dk/dv kernel keeps Q+dO
+    # resident at Sq)
+    resident = 2 * 2 * Sres * D * itemsize  # 2 tensors, double-buffered
+    temps = _TEMP_COEF * bq * bk * 4
+    return resident + temps + _FIT_MARGIN <= _SCOPED_VMEM
+
+
+def _stream_fits(bq, bk, D, itemsize=2) -> bool:
+    # streamed path: no resident K/V; scratch acc/m/l + double-buffered
+    # q/k/v/o block streams + the same f32 temporaries
+    scratch = bq * D * 4 + 2 * bq * 4
+    streams = 2 * 2 * (2 * bq + 2 * bk) * D * itemsize
+    temps = _TEMP_COEF * bq * bk * 4
+    return scratch + streams + temps + _FIT_MARGIN <= _SCOPED_VMEM
+
+
+# Canonical block-pair preference, best-first from the v5e fwd+bwd
+# measurements at S=2048/D=128 (512x512 = 11.6ms, 256x512 = 13.6ms,
+# 256x256 = 15.1ms, 128x128 = 18.4ms). autotune._FA_BLOCKS derives from
+# this list so the tuner and the resolver can never disagree.
+MEASURED_BLOCK_ORDER = ((512, 512), (256, 512), (512, 256), (256, 256),
+                        (128, 512), (512, 128), (128, 128))
+_PAIR_ORDER = MEASURED_BLOCK_ORDER[:-1] + ((128, 256), (256, 128),
+                                           (128, 128))
+
+
+def _resolve_blocks(Sq, Sk, block_q, block_k, D=128, itemsize=2,
+                    stream=None, bwd=False):
+    """Pick (block_q, block_k, streamed). Explicit blocks are honored
+    verbatim (sweeps/experiments own the consequences); auto-pick walks
+    the measured-fast pairs largest-first and returns the first that
+    fits the scoped-VMEM model with K/V resident, else falls back to the
+    grid-streamed kernels (unbounded S at O(block) VMEM). ``stream``
+    True/False forces the mode; None decides from the fit model.
+    ``bwd`` widens the resident term to max(Sq, Sk): the dk/dv kernel
+    keeps Q+dO resident at Sq where the forward keeps K+V at Sk."""
+    Sres = max(Sq, Sk) if bwd else Sk
+    if block_q and block_k:
+        if stream is None:
+            stream = not _resident_fits(block_q, block_k, Sres, D,
+                                        itemsize)
+        return block_q, block_k, stream
+    seen = set()
+    cands = []
+    for bq, bk in _PAIR_ORDER:
+        cq, ck = block_q or bq, block_k or bk
+        if (cq, ck) in seen or Sq % cq or Sk % ck:
+            continue
+        seen.add((cq, ck))
+        cands.append((cq, ck))
+    if stream:
+        for cq, ck in cands:
+            if _stream_fits(cq, ck, D, itemsize):
+                return cq, ck, True
+        # forced streaming with no fitting 128-multiple pair: divisor
+        # blocks are <=128 and always stream-fit
+        return (block_q or _pick_block(Sq), block_k or _pick_block(Sk),
+                True)
+    for cq, ck in cands:
+        if _resident_fits(cq, ck, Sres, D, itemsize):
+            return cq, ck, False
+    if stream is None:
+        for cq, ck in cands:
+            if _stream_fits(cq, ck, D, itemsize):
+                return cq, ck, True
+    # no 128-multiple pair divides S: divisor-search blocks are <=128.
+    # They may still not make RESIDENT K/V fit (odd does not imply
+    # tiny) — honor the fit model and stream when it says no, unless
+    # the caller forced resident and owns the compile outcome.
+    cq = block_q or _pick_block(Sq)
+    ck = block_k or _pick_block(Sk)
+    if stream is False and cands:
+        return cands[0][0], cands[0][1], False
+    if stream is False:
+        return cq, ck, False
+    return cq, ck, not _resident_fits(cq, ck, Sres, D, itemsize)
+
+
+def _mask_causal(s, qi, kj, block_q, block_k):
+    """NEG_INF-mask score entries above the causal diagonal for the
+    (qi, kj) block pair — shared by all six kernel variants."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
@@ -101,11 +206,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _mask_causal(s, qi, kj, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp2(s - m_new[:, None])
         alpha = jnp.exp2(m - m_new)
@@ -144,11 +245,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _mask_causal(s, qi, kj, block_q, block_k)
         p = jnp.exp2(s - lse2[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -186,11 +283,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _mask_causal(s, qi, kj, block_q, block_k)
         p = jnp.exp2(s - lse2[:, None])  # (bq, bk)
         dv_new = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -206,6 +299,264 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk, dv = jax.lax.fori_loop(first_live, num_q, body, (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---- grid-streamed variants (long sequences) ----
+#
+# Beyond the resident-KV frontier (~14k at D=128: double-buffered K+V
+# alone approach the 16M scoped-vmem limit) K/V blocks stream through an
+# innermost grid dimension and the online-softmax state (m, l, acc)
+# lives in VMEM scratch across grid steps — O(block) VMEM at any S.
+# Measured 8% slower than resident at S=2048 (PERF.md round-2
+# ablations), so the resolver only picks streaming when resident can't
+# compile. Same math as the resident kernels; dead causal blocks skip
+# compute via pl.when (the DMA still runs — acceptable for a fallback
+# whose alternative is failing to compile).
+
+
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                       acc_scr, *, sm_scale, causal, block_q, block_k,
+                       num_kv):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0]
+        q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _mask_causal(s, qi, kj, block_q, block_k)
+        m = m_scr[...][:, 0]
+        l = l_scr[...][:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new[:, None]
+        l_scr[...] = l_new[:, None]
+
+    if causal:
+        pl.when((qi + 1) * block_q > kj * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == num_kv - 1)
+    def _flush():
+        l = l_scr[...][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (LN2 * m_scr[...][:, 0] + jnp.log(l_safe))[
+            :, None].astype(jnp.float32)
+
+
+def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_scr, *, sm_scale, causal, block_q,
+                          block_k, num_kv):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0]
+        q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+        do = do_ref[0]
+        lse2 = lse_ref[0, :, 0] * LOG2E
+        delta = delta_ref[0, :, 0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _mask_causal(s, qi, kj, block_q, block_k)
+        p = jnp.exp2(s - lse2[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((qi + 1) * block_q > kj * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == num_kv - 1)
+    def _flush():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                           sm_scale, causal, block_q, block_k, num_q):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        k2 = (k.astype(jnp.float32) * (sm_scale * LOG2E)).astype(k.dtype)
+        q = q_ref[0]
+        do = do_ref[0]
+        lse2 = lse_ref[0, :, 0] * LOG2E
+        delta = delta_ref[0, :, 0]
+        s = jax.lax.dot_general(q, k2, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _mask_causal(s, qi, kj, block_q, block_k)
+        p = jnp.exp2(s - lse2[:, None])
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((qi + 1) * block_q > kj * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_fwd_stream(q, k, v, causal, sm_scale, block_q, block_k):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"flash_attention blocks ({block_q},{block_k}) must divide "
+            f"seq lens ({Sq},{Sk}); pass block_q/block_k=None to auto-pick")
+    bh = B * H
+    qr = q.reshape(bh, Sq, D)
+    kr = k.reshape(bh, Sk, D)
+    vr = v.reshape(bh, Sk, D)
+    num_kv = Sk // block_k
+    out, lse = functools.partial(pl.pallas_call, interpret=_interpret())(
+        functools.partial(_fwd_kernel_stream, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_kv=num_kv),
+        grid=(bh, Sq // block_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, t: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, t: (b, t, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, t: (b, t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, t: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, t: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((bh, Sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
+
+
+def _flash_bwd_stream(q, k, v, out, lse, do, causal, sm_scale, block_q,
+                      block_k):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"flash_attention backward blocks ({block_q},{block_k}) must "
+            f"divide seq lens ({Sq},{Sk})")
+    bh = B * H
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, Sq, 1)
+    qr = q.reshape(bh, Sq, D)
+    kr = k.reshape(bh, Sk, D)
+    vr = v.reshape(bh, Sk, D)
+    dor = do.reshape(bh, Sq, D)
+    lser = lse.reshape(bh, Sq, 1)
+    num_kv = Sk // block_k
+    num_q = Sq // block_q
+
+    dq = functools.partial(pl.pallas_call, interpret=_interpret())(
+        functools.partial(_bwd_dq_kernel_stream, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_kv=num_kv),
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, t: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, t: (b, t, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, t: (b, t, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, t: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, t: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, t: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, t: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lser, delta)
+
+    dk, dv = functools.partial(pl.pallas_call, interpret=_interpret())(
+        functools.partial(_bwd_dkv_kernel_stream, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          num_q=num_q),
+        grid=(bh, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((bh, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
@@ -244,10 +595,10 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     return out.reshape(B, H, Sq, D), lse[..., 0].reshape(B, H, Sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, causal=False, sm_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    bwd_block_q=None, bwd_block_k=None):
+                    bwd_block_q=None, bwd_block_k=None, stream=None):
     """q/k/v: (batch, heads, seq, head_dim). Returns same shape as q.
 
     ``bwd_block_q``/``bwd_block_k`` tile the two backward kernels
@@ -255,39 +606,52 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     walks the opposite operand full-length per block (dq walks K/V,
     dk/dv walks Q), so its VMEM/pipelining optimum need not match the
     forward's — tools/flash_bwd_sweep.py measures the grid on chip.
+
+    ``stream`` selects the K/V-streaming kernels (None = automatic:
+    resident K/V while the scoped-VMEM fit model allows it, streaming
+    beyond — long sequences where double-buffered resident K/V would
+    blow the 16M scoped-vmem limit that interpret-mode tests can't see).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
-                                       block_q, block_k)
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    block_q, block_k, streamed = _resolve_blocks(
+        q.shape[2], k.shape[2], block_q, block_k, q.shape[-1],
+        q.dtype.itemsize, stream)
+    fwd = _flash_fwd_stream if streamed else _flash_fwd
+    out, _ = fwd(q, k, v, causal, sm_scale, block_q, block_k)
     return out
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-            bwd_block_q, bwd_block_k):
+            bwd_block_q, bwd_block_k, stream=None):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(q.shape[2], k.shape[2],
-                                       block_q, block_k)
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    block_q, block_k, streamed = _resolve_blocks(
+        q.shape[2], k.shape[2], block_q, block_k, q.shape[-1],
+        q.dtype.itemsize, stream)
+    fwd = _flash_fwd_stream if streamed else _flash_fwd
+    out, lse = fwd(q, k, v, causal, sm_scale, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k,
-            res, do):
+            stream, res, do):
     q, k, v, out, lse = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k = _resolve_blocks(
+    block_q, block_k, streamed = _resolve_blocks(
         q.shape[2], k.shape[2],
-        bwd_block_q or block_q, bwd_block_k or block_k)
+        bwd_block_q or block_q, bwd_block_k or block_k, q.shape[-1],
+        q.dtype.itemsize, stream, bwd=True)
     # explicit bwd blocks skip the fwd path's validation; a non-dividing
     # block would silently leave output rows unwritten (grid truncation)
     if q.shape[2] % block_q or k.shape[2] % block_k:
         raise ValueError(
             f"flash_attention backward blocks ({block_q}, {block_k}) must "
             f"divide seq lens ({q.shape[2]}, {k.shape[2]})")
+    if streamed:
+        return _flash_bwd_stream(q, k, v, out, lse, do, causal, sm_scale,
+                                 block_q, block_k)
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bh = B * H

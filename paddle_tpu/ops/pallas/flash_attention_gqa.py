@@ -23,8 +23,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import (LN2, LOG2E, NEG_INF, _interpret, _pick_block,
-                              _resolve_blocks)
+from .flash_attention import LN2, LOG2E, NEG_INF, _interpret, _pick_block
 
 
 # f32-element budget for ONE (G*block_q, block_k) score/probability buffer
@@ -54,7 +53,11 @@ def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
                 break
         else:
             block_q = min(_pick_block(Sq), cap)
-    bq, bk = _resolve_blocks(Sq, Sk, block_q, block_k)
+    # plain per-axis pick only: the group-aware caps below own the VMEM
+    # bound for these kernels (the MHA resolver's resident-fit model is
+    # calibrated for the non-grouped kernels and a hardcoded D/itemsize)
+    bq = block_q or _pick_block(Sq)
+    bk = block_k or _pick_block(Sk)
     # halving preserves divisibility (bk | Sk implies bk/2 | Sk)
     while G * bq > _MAX_ROWS and not user_q and bq > 8 \
             and (bq // 2) % 8 == 0:

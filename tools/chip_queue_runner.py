@@ -79,6 +79,10 @@ def main():
     while pending:
         name, cmd, env_extra = pending[0]
         env = dict(os.environ, **env_extra)
+        # some queue tools don't sys.path-insert the repo themselves;
+        # guarantee imports resolve no matter how the runner was launched
+        env["PYTHONPATH"] = REPO + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         t0 = time.time()
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
@@ -90,10 +94,14 @@ def main():
                         results.append(json.loads(ln))
                     except json.JSONDecodeError:
                         results.append({"unparseable": ln[:200]})
-            rec = {"name": name, "rc": r.returncode,
+            rc = r.returncode
+            if rc == 0 and results and all(
+                    isinstance(x, dict) and "error" in x for x in results):
+                rc = 1  # tool printed only error rows but exited 0
+            rec = {"name": name, "rc": rc,
                    "wall_s": round(time.time() - t0, 1),
                    "results": results,
-                   "stderr_tail": r.stderr[-400:] if r.returncode else ""}
+                   "stderr_tail": r.stderr[-400:] if rc else ""}
         except subprocess.TimeoutExpired:
             rec = {"name": name, "rc": -1, "timeout": True,
                    "wall_s": round(time.time() - t0, 1)}

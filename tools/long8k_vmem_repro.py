@@ -1,0 +1,65 @@
+"""Map the flash-attention scoped-VMEM feasibility frontier (compile-only).
+
+The long8k chip run exposed a Mosaic scoped-vmem overflow (21M > 16M) at
+S=8192 with the auto-picked 512x512 blocks: the resident-KV design's f32
+compute blocks + double-buffered streams outgrow the 16M scoped budget as
+S grows, which interpret-mode tests can never catch. This tool
+lower()+compile()s each kernel (fwd / bwd-dq / bwd-dkv, via jax.vjp so
+the two bwd kernels compile in one pass) separately per (S, bq, bk)
+combo — Mosaic's scoped-vmem check fires at compile time, so the chip is
+only needed as a compile target. Prints one JSON line per combo.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python tools/long8k_vmem_repro.py
+"""
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, H, D = 2, 12, 128
+    rng = np.random.default_rng(0)
+
+    def compile_one(S, bq, bk, phase, stream):
+        q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+
+        def fwd(q, k, v):
+            return flash_attention(q, k, v, True, None, bq, bk, bq, bk,
+                                   stream)
+
+        def fwdbwd(q, k, v):
+            out, vjp = jax.vjp(fwd, q, k, v)
+            return vjp(out)
+
+        fn = fwd if phase == "fwd" else fwdbwd
+        try:
+            jax.jit(fn).lower(q, q, q).compile()
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001
+            m = re.search(r"Scoped allocation with size ([0-9.]+[KMG]) ",
+                          str(e))
+            return {"ok": False,
+                    "scoped": m.group(1) if m else str(e)[:120]}
+
+    for S in (2048, 4096, 8192, 16384, 32768):
+        for blk in (512, 256, 128):
+            for stream in (False, True):
+                for phase in ("fwd", "fwdbwd"):
+                    r = compile_one(S, blk, blk, phase, stream)
+                    print(json.dumps(
+                        {"S": S, "block": blk, "phase": phase,
+                         "stream": stream, **r}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
