@@ -34,9 +34,21 @@ _SCORE_ELEMS = 512 * 1024
 # Measured on v5e: rows=4096 (MQA G=32, bq=128, bk=128) exceeds the 16M
 # scoped-vmem limit by 912K even with the score budget satisfied.
 _MAX_ROWS = 2048
+# Resident K/V grows with Sk (the long8k chip failure mode of the MHA
+# kernels); the GQA temp coefficient is bounded by the round-3 chip
+# evidence — rows=1024 x bk=512 at S=2048 COMPILED (2M resident +
+# C*rows*bk*4 <= 16M gives C <= 6.8). 6 is the provisional value;
+# tools/long8k_vmem_repro.py's GQA section re-measures the frontier.
+_GQA_TEMP_COEF = 6
+_GQA_VMEM = 16 * 2**20 - 2**20  # scoped limit less margin
 
 
-def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
+def _gqa_fits(rows, bk, Sk, D, itemsize):
+    resident = 2 * 2 * Sk * D * itemsize  # K+V per kv head, double-buffered
+    return resident + _GQA_TEMP_COEF * rows * bk * 4 <= _GQA_VMEM
+
+
+def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k, D=128, itemsize=2):
     """Group-aware block pick: score/probability buffers are (G*block_q,
     block_k) f32, so the JOINT product G*block_q*block_k is bounded — a
     per-axis cap alone lets rows grow unboundedly with G (MQA G=32 at the
@@ -67,6 +79,30 @@ def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k):
     while G * bq * bk > _SCORE_ELEMS and not user_q and bq > 8 \
             and (bq // 2) % 8 == 0:
         bq //= 2
+    # long-Sk resident term (auto blocks only): shrink until the resident
+    # K/V plus temp buffers fit scoped VMEM
+    while not _gqa_fits(G * bq, bk, Sk, D, itemsize) and not user_k \
+            and bk > 128:
+        bk //= 2
+    while not _gqa_fits(G * bq, bk, Sk, D, itemsize) and not user_q \
+            and bq > 8 and (bq // 2) % 8 == 0:
+        bq //= 2
+    if not (user_q or user_k) and not _gqa_fits(G * bq, bk, Sk, D,
+                                                itemsize):
+        # either resident K/V alone exceeds scoped VMEM (no block choice
+        # can compile) or the shrink loops stalled on divisibility /
+        # sublane alignment short of a fitting pair — both end in an
+        # opaque Mosaic compile failure, so raise the clear error here.
+        # The grouped kernels have no streamed variant; the supported
+        # long-context paths are the 'sep' mesh axis (ring attention),
+        # splash windowing, or MHA flash_attention's streamed mode over
+        # repeated K/V.
+        raise ValueError(
+            f"grouped_flash_attention: resident K/V at Sk={Sk} "
+            f"(D={D}, {itemsize}B) cannot fit the 16M scoped-VMEM "
+            f"budget at any block size; shard the sequence (ring "
+            f"attention / 'sep' axis) or use splash/flash streaming "
+            f"for single-chip sequences this long")
     return bq, bk
 
 
@@ -272,7 +308,8 @@ def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     G = q.shape[1] // max(1, k.shape[1])
     block_q, block_k = _gqa_resolve_blocks(q.shape[2], k.shape[2], G,
-                                           block_q, block_k)
+                                           block_q, block_k,
+                                           q.shape[-1], q.dtype.itemsize)
     out, _ = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
     return out
 
@@ -282,7 +319,8 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     G = q.shape[1] // max(1, k.shape[1])
     block_q, block_k = _gqa_resolve_blocks(q.shape[2], k.shape[2], G,
-                                           block_q, block_k)
+                                           block_q, block_k,
+                                           q.shape[-1], q.dtype.itemsize)
     out, lse = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
     return out, (q, k, v, out, lse)
 
@@ -293,7 +331,8 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     G0 = q.shape[1] // max(1, k.shape[1])
     block_q, block_k = _gqa_resolve_blocks(q.shape[2], k.shape[2], G0,
-                                           block_q, block_k)
+                                           block_q, block_k,
+                                           q.shape[-1], q.dtype.itemsize)
     B, Hq, Hkv, G, Sq, D = _shapes(q, k)
     Sk = k.shape[2]
     bh = B * Hkv

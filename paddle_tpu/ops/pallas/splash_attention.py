@@ -45,6 +45,19 @@ SCORE_ELEMS = 512 * 1024
 # see flash_attention_gqa._MAX_ROWS — same v5e scoped-vmem measurement
 MAX_ROWS = 2048
 
+# Resident K/V in the fwd/dq kernels grows with Sk (the long8k failure
+# mode of the MHA flash kernels); past this frontier the kernels switch
+# to STREAMING live kv blocks through an innermost grid dimension whose
+# index map reads the prefetched kv_idx table — VMEM drops to O(block)
+# and DMA to O(live blocks), i.e. the pattern's density (the resident
+# walk DMAs nothing per step but holds all of K/V; the dkv pass was
+# always streamed). The fit model is flash_attention_gqa's — one
+# definition, recalibrated in one place by tools/long8k_vmem_repro.py.
+# None = automatic; tests/benches may force a mode.
+from .flash_attention_gqa import _gqa_fits as _resident_fits  # noqa: E402
+
+_FORCE_STREAM = None
+
 
 def fits_score_budget(groups: int, block_q: int = 128,
                       block_k: int = 128) -> bool:
@@ -200,6 +213,107 @@ def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
     dq_ref[0] = dq.reshape(G, block_q, D).astype(dq_ref.dtype)
 
 
+def _fwd_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref,
+                       lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
+                       causal, block_q, block_k, window, groups, t_max):
+    """Forward with LIVE kv blocks streamed through the innermost grid
+    dimension: the k/v BlockSpec index maps read kv_idx[qi, t] from the
+    scalar-prefetch channel, so only live blocks are ever DMA'd and VMEM
+    holds one block — no resident K/V, no S ceiling. Same online-softmax
+    math as `_fwd_kernel`, with the (m, l, acc) carry in VMEM scratch."""
+    qi = pl.program_id(1)
+    t = pl.program_id(2)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when(t < kv_cnt[qi])
+    def _compute():
+        kj = kv_idx[qi, t]
+        q = q_ref[0].reshape(rows, D)
+        q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
+                                     causal, window), s, NEG_INF)
+        m = m_scr[...][:, 0]
+        l = l_scr[...][:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp2(s - m_new[:, None])
+        p = jnp.where((m_new > NEG_INF * 0.5)[:, None], p, 0.0)
+        alpha = jnp.exp2(m - m_new)
+        l_scr[...] = (alpha * l + jnp.sum(p, axis=1))[:, None]
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new[:, None]
+
+    @pl.when(t == t_max - 1)
+    def _flush():
+        m = m_scr[...][:, 0]
+        l = l_scr[...][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        any_mass = l > 0.0
+        o_ref[0] = jnp.where(
+            any_mass[:, None], acc_scr[...] / l_safe[:, None],
+            0.0).reshape(G, block_q, D).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(any_mass, LN2 * m + jnp.log(l_safe),
+                               NEG_INF).reshape(G, block_q, 1).astype(
+            jnp.float32)
+
+
+def _bwd_dq_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, delta_ref, dq_ref, dq_scr, *,
+                          sm_scale, causal, block_q, block_k, window,
+                          groups, t_max):
+    qi = pl.program_id(1)
+    t = pl.program_id(2)
+    G = groups
+    D = q_ref.shape[-1]
+    rows = G * block_q
+
+    @pl.when(t == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    @pl.when(t < kv_cnt[qi])
+    def _compute():
+        kj = kv_idx[qi, t]
+        q = q_ref[0].reshape(rows, D)
+        q2 = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+        do = do_ref[0].reshape(rows, D)
+        lse2 = lse_ref[0].reshape(rows) * LOG2E
+        delta = delta_ref[0].reshape(rows)
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal or window is not None:
+            s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
+                                     causal, window), s, NEG_INF)
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == t_max - 1)
+    def _flush():
+        dq_ref[0] = dq_scr[...].reshape(G, block_q, D).astype(
+            dq_ref.dtype)
+
+
 def _bwd_dkv_kernel(bm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                     sm_scale, causal, block_q, block_k, window, groups,
@@ -284,13 +398,18 @@ def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
             f"use a finer block_mask granularity"
             + (" or repeat K/V across fewer query groups" if G > 1
                else ""))
-    return sm_scale, bq, bk, G
+    if _FORCE_STREAM is not None:
+        streamed = _FORCE_STREAM
+    else:
+        streamed = not _resident_fits(G * bq, bk, k.shape[2],
+                                      q.shape[-1], q.dtype.itemsize)
+    return sm_scale, bq, bk, G, streamed
 
 
 def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
                 window=None):
-    sm_scale, bq, bk, G = _resolve(q, k, block_mask, sm_scale, block_q,
-                                   block_k)
+    sm_scale, bq, bk, G, streamed = _resolve(q, k, block_mask, sm_scale,
+                                             block_q, block_k)
     kv_idx, kv_cnt = _pattern_tables(block_mask)
     B, Hq, Sq, D = q.shape
     Hkv = k.shape[1]
@@ -299,22 +418,55 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
     qr = q.reshape(bh, G, Sq, D)
     kr = k.reshape(bh, Sk, D)
     vr = v.reshape(bh, Sk, D)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, Sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
-            pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
-        ],
-    )
+    if streamed:
+        t_max = kv_idx.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, Sq // bq, t_max),
+            in_specs=[
+                pl.BlockSpec((1, G, bq, D),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, t, idx, cnt: (b, idx[i, t], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, t, idx, cnt: (b, idx[i, t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, G, bq, D),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+                pl.BlockSpec((1, G, bq, 1),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((G * bq, 1), jnp.float32),
+                pltpu.VMEM((G * bq, 1), jnp.float32),
+                pltpu.VMEM((G * bq, D), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(
+            _fwd_kernel_stream, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_k=bk, window=window, groups=G, t_max=t_max)
+        semantics = ("parallel", "parallel", "arbitrary")
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+                pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
+            ],
+        )
+        kernel = functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+            block_k=bk, window=window, groups=G)
+        semantics = ("parallel", "arbitrary")
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, block_k=bk, window=window, groups=G),
+        kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
@@ -322,7 +474,7 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
         ],
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=semantics),
     )(jnp.asarray(kv_idx), jnp.asarray(kv_cnt), qr, kr, vr)
     out = out.reshape(B, Hq, Sq, D)
     return out, (q, k, v, out, lse.reshape(B, Hq, Sq))
@@ -331,8 +483,8 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
 def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
                 res, do):
     q, k, v, out, lse = res
-    sm_scale, bq, bk, G = _resolve(q, k, block_mask, sm_scale, block_q,
-                                   block_k)
+    sm_scale, bq, bk, G, streamed = _resolve(q, k, block_mask, sm_scale,
+                                             block_q, block_k)
     kv_idx, kv_cnt = _pattern_tables(block_mask)
     B, Hq, Sq, D = q.shape
     Hkv = k.shape[1]
@@ -346,29 +498,59 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(bh, G, Sq, 1)
 
-    dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, Sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
-            pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
-            pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, G, bq, D),
-                               lambda b, i, *_: (b, 0, i, 0)),
-    )
+    if streamed:
+        t_max = kv_idx.shape[1]
+        dq_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, Sq // bq, t_max),
+            in_specs=[
+                pl.BlockSpec((1, G, bq, D),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, t, idx, cnt: (b, idx[i, t], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, t, idx, cnt: (b, idx[i, t], 0)),
+                pl.BlockSpec((1, G, bq, D),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+                pl.BlockSpec((1, G, bq, 1),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+                pl.BlockSpec((1, G, bq, 1),
+                             lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, G, bq, D), lambda b, i, t, idx, cnt: (b, 0, i, 0)),
+            scratch_shapes=[pltpu.VMEM((G * bq, D), jnp.float32)],
+        )
+        dq_kernel = functools.partial(
+            _bwd_dq_kernel_stream, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_k=bk, window=window, groups=G, t_max=t_max)
+        dq_semantics = ("parallel", "parallel", "arbitrary")
+    else:
+        dq_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, G, bq, D), lambda b, i, *_: (b, 0, i, 0)),
+                pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
+                pl.BlockSpec((1, G, bq, 1), lambda b, i, *_: (b, 0, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, G, bq, D),
+                                   lambda b, i, *_: (b, 0, i, 0)),
+        )
+        dq_kernel = functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+            block_k=bk, window=window, groups=G)
+        dq_semantics = ("parallel", "arbitrary")
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=bq, block_k=bk,
-                          window=window, groups=G),
+        dq_kernel,
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=dq_semantics),
     )(jnp.asarray(kv_idx), jnp.asarray(kv_cnt), qr, kr, vr, dor, lser,
       delta)
 

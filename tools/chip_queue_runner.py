@@ -17,21 +17,16 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Ordered by information-per-tunnel-minute: the VMEM frontier repro
+# (compile-only, calibrates the _resolve_blocks fit model) and the
+# long8k retry (acid test of the streamed/shrunk-block fix) lead; the
+# 50-minute flash_bwd_sweep runs late so a short window isn't spent
+# entirely inside it. Items already recorded in CHIP_QUEUE_RESULTS.jsonl
+# (headline/gqa/bf16moments/decode) are done and dropped.
 QUEUE = [
-    # headline first: even a short tunnel window refreshes
-    # PERF_LAST_TPU.json at the current HEAD (VERDICT r3 weak #1)
-    ("headline_bench", [sys.executable, "bench.py"], {}),
-    ("gqa_train", [sys.executable, "tools/mfu_exp.py", "gqa"], {}),
-    ("bf16_moments", [sys.executable, "tools/mfu_exp.py", "bf16moments"],
-     {}),
+    ("long8k_vmem_repro",
+     [sys.executable, "tools/long8k_vmem_repro.py"], {}),
     ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
-    ("decode_b64", [sys.executable, "tools/ladder_bench.py", "6"],
-     {"LADDER_DECODE_B": "64"}),
-    ("decode_b64_int8", [sys.executable, "tools/ladder_bench.py", "6"],
-     {"LADDER_DECODE_B": "64", "LADDER_DECODE_WEIGHTS": "int8"}),
-    ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
-    ("vit_train", [sys.executable, "tools/ladder_bench.py", "7"], {}),
-    # round-4 additions (VERDICT r3 items 2+3)
     ("seq_attn_bench", [sys.executable, "tools/seq_attn_bench.py"], {}),
     ("mfu_scale_ladder", [sys.executable, "tools/mfu_scale.py", "ladder"],
      {}),
@@ -41,6 +36,10 @@ QUEUE = [
      [sys.executable, "tools/kernel_chip_check.py"], {}),
     ("serving_bench",
      [sys.executable, "tools/serving_bench.py"], {}),
+    ("vit_train", [sys.executable, "tools/ladder_bench.py", "7"], {}),
+    ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
+    # refresh the headline last so PERF_LAST_TPU.json stamps this HEAD
+    ("headline_bench", [sys.executable, "bench.py"], {}),
 ]
 
 

@@ -30,26 +30,29 @@ def main():
     B, H, D = 2, 12, 128
     rng = np.random.default_rng(0)
 
-    def compile_one(S, bq, bk, phase, stream):
-        q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
-
-        def fwd(q, k, v):
-            return flash_attention(q, k, v, True, None, bq, bk, bq, bk,
-                                   stream)
-
-        def fwdbwd(q, k, v):
-            out, vjp = jax.vjp(fwd, q, k, v)
+    def probe(fwd, phase, *args):
+        """jit-lower-compile fwd (or its fwd+bwd vjp) and parse a Mosaic
+        scoped-allocation overflow out of the failure, if any."""
+        def fwdbwd(*a):
+            out, vjp = jax.vjp(fwd, *a)
             return vjp(out)
 
         fn = fwd if phase == "fwd" else fwdbwd
         try:
-            jax.jit(fn).lower(q, q, q).compile()
+            jax.jit(fn).lower(*args).compile()
             return {"ok": True}
         except Exception as e:  # noqa: BLE001
             m = re.search(r"Scoped allocation with size ([0-9.]+[KMG]) ",
                           str(e))
             return {"ok": False,
                     "scoped": m.group(1) if m else str(e)[:120]}
+
+    def compile_one(S, bq, bk, phase, stream):
+        q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+        return probe(
+            lambda a, b, c: flash_attention(a, b, c, True, None, bq, bk,
+                                            bq, bk, stream),
+            phase, q, q, q)
 
     for S in (2048, 4096, 8192, 16384, 32768):
         for blk in (512, 256, 128):
@@ -59,6 +62,49 @@ def main():
                     print(json.dumps(
                         {"S": S, "block": blk, "phase": phase,
                          "stream": stream, **r}), flush=True)
+
+    # GQA frontier: same resident-K/V exposure, rows = G*bq. Gates the
+    # queued mfu_scale tp_shard row (G=4, S=8192).
+    from paddle_tpu.ops.pallas.flash_attention_gqa import (
+        grouped_flash_attention)
+
+    def compile_gqa(S, G, bq, bk, phase):
+        q = jnp.asarray(rng.standard_normal((1, 4 * G, S, D)),
+                        jnp.bfloat16)
+        kv = jnp.asarray(rng.standard_normal((1, 4, S, D)), jnp.bfloat16)
+        return probe(
+            lambda a, b, c: grouped_flash_attention(a, b, c, True, None,
+                                                    bq, bk),
+            phase, q, kv, kv)
+
+    for S in (2048, 8192):
+        for G in (4, 8):
+            for bq, bk in ((256, 512), (256, 256), (128, 256), (128, 128)):
+                for phase in ("fwd", "fwdbwd"):
+                    r = compile_gqa(S, G, bq, bk, phase)
+                    print(json.dumps(
+                        {"kernel": "gqa", "S": S, "G": G, "bq": bq,
+                         "bk": bk, "phase": phase, **r}), flush=True)
+
+    # splash banded frontier at long S (gates seq_attn_bench long rows)
+    from paddle_tpu.ops.pallas.splash_attention import (
+        banded_block_mask, splash_attention)
+
+    def compile_splash(S, blk, window, phase):
+        q = jnp.asarray(rng.standard_normal((1, 4, S, D)), jnp.bfloat16)
+        bm = banded_block_mask(S, S, blk, blk, window, causal=True)
+        return probe(
+            lambda a, b, c: splash_attention(a, b, c, bm, True, None,
+                                             blk, blk, window),
+            phase, q, q, q)
+
+    for S, window in ((8192, 2048), (16384, 2048)):
+        for blk in (512, 256):
+            for phase in ("fwd", "fwdbwd"):
+                r = compile_splash(S, blk, window, phase)
+                print(json.dumps(
+                    {"kernel": "splash", "S": S, "window": window,
+                     "block": blk, "phase": phase, **r}), flush=True)
 
 
 if __name__ == "__main__":
