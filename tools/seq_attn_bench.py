@@ -78,8 +78,12 @@ def main():
                      for _ in range(3))
 
     if on_tpu:
+        # xlong sits past the resident-KV frontier: flash/splash resolve
+        # to the round-4 grid-streamed kernels (the single-chip path the
+        # resident design could not compile at all)
         shapes = [("bench", 8, 12, 2048, 128, jnp.bfloat16),
-                  ("long", 2, 12, 8192, 128, jnp.bfloat16)]
+                  ("long", 2, 12, 8192, 128, jnp.bfloat16),
+                  ("xlong", 1, 12, 16384, 128, jnp.bfloat16)]
     else:
         shapes = [("bench", 1, 2, 512, 64, jnp.float32)]
 
@@ -96,6 +100,16 @@ def main():
                                q, k, v)
         emit({"shape": tag, "variant": "flash_dense", "S": S, "B": B,
               "ms": round(flash_ms, 3), "compile_s": comp})
+
+        if tag == "long":
+            # resident (auto, shrunk blocks) vs forced streaming at the
+            # same shape: the direct price of the O(block)-VMEM kernels
+            ms, comp = bench(lambda a, b, c: flash_attention(
+                a, b, c, True, None, None, None, None, None, True),
+                q, k, v)
+            emit({"shape": tag, "variant": "flash_streamed", "S": S,
+                  "B": B, "ms": round(ms, 3), "compile_s": comp,
+                  "frac_of_flash": round(flash_ms / ms, 3)})
 
         ms, comp = bench(lambda a, b, c: ring_attention(
             a, b, c, mesh, "sep", True), q, k, v)
