@@ -48,6 +48,12 @@ def _gqa_fits(rows, bk, Sk, D, itemsize):
     return resident + _GQA_TEMP_COEF * rows * bk * 4 <= _GQA_VMEM
 
 
+class ResidentOverflowError(ValueError):
+    """No reachable block pair fits resident K/V in scoped VMEM —
+    grouped_flash_attention auto-delegates to splash streaming on this,
+    other ValueErrors (bad shapes etc.) propagate."""
+
+
 def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k, D=128, itemsize=2):
     """Group-aware block pick: score/probability buffers are (G*block_q,
     block_k) f32, so the JOINT product G*block_q*block_k is bounded — a
@@ -92,12 +98,11 @@ def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k, D=128, itemsize=2):
         # either resident K/V alone exceeds scoped VMEM (no block choice
         # can compile) or the shrink loops stalled on divisibility /
         # sublane alignment short of a fitting pair — both end in an
-        # opaque Mosaic compile failure, so raise the clear error here.
-        # The grouped kernels have no streamed variant; the supported
-        # long-context paths are the 'sep' mesh axis (ring attention),
-        # splash windowing, or MHA flash_attention's streamed mode over
-        # repeated K/V.
-        raise ValueError(
+        # opaque Mosaic compile failure, so raise the typed error here.
+        # grouped_flash_attention's public entry catches it and
+        # delegates to the K/V-streaming splash kernels; direct core
+        # callers see the message below.
+        raise ResidentOverflowError(
             f"grouped_flash_attention: resident K/V at Sk={Sk} "
             f"(D={D}, {itemsize}B) cannot fit the 16M scoped-VMEM "
             f"budget at any block size; shard the sequence (ring "
@@ -300,10 +305,8 @@ def _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
-                            block_q=None, block_k=None):
-    """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) with Hq = G*Hkv. Equivalent to
-    flash_attention over jnp.repeat(k/v, G, axis=1) without the repeat."""
+def _grouped_flash_core(q, k, v, causal=False, sm_scale=None,
+                        block_q=None, block_k=None):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     G = q.shape[1] // max(1, k.shape[1])
@@ -312,6 +315,62 @@ def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
                                            q.shape[-1], q.dtype.itemsize)
     out, _ = _gqa_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
     return out
+
+
+def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
+                            block_q=None, block_k=None):
+    """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) with Hq = G*Hkv. Equivalent to
+    flash_attention over jnp.repeat(k/v, G, axis=1) without the repeat.
+
+    Past the resident-K/V VMEM frontier (auto blocks only) the call
+    delegates to the K/V-STREAMING splash kernels with a full causal (or
+    dense) block mask — same grouped math, O(block) VMEM at any S — so
+    GQA long-context works on one chip instead of failing to compile."""
+    G = q.shape[1] // max(1, k.shape[1])
+    if block_q is None and block_k is None:
+        try:
+            bq, bk = _gqa_resolve_blocks(q.shape[2], k.shape[2], G, None,
+                                         None, q.shape[-1],
+                                         q.dtype.itemsize)
+            # pass the resolved blocks through — the core (and its vjp)
+            # would otherwise re-run the identical resolution
+            return _grouped_flash_core(q, k, v, causal, sm_scale, bq, bk)
+        except ResidentOverflowError:
+            from .splash_attention import (fits_score_budget,
+                                           splash_attention)
+            import numpy as _np
+            # group-aware splash blocks: splash's _resolve enforces the
+            # (G*bq, bk) score and row budgets, so shrink until they
+            # hold (Llama-3 G=4 at bq=bk=512 would otherwise be
+            # REJECTED by splash — the exact config delegation is for)
+            cap = max(128, 1024 // G)
+            for cand in (512, 256, 128):
+                if cand <= cap and q.shape[2] % cand == 0:
+                    bq = cand
+                    break
+            else:
+                # no 128-multiple divides Sq: the divisor search yields
+                # <=128, always under the row cap
+                bq = _pick_block(q.shape[2])
+            bk = _pick_block(k.shape[2])
+            while not fits_score_budget(G, bq, bk) and bk > 128:
+                bk //= 2
+            while not fits_score_budget(G, bq, bk) and bq > 8 \
+                    and (bq // 2) % 8 == 0 \
+                    and q.shape[2] % (bq // 2) == 0:
+                bq //= 2
+            nq, nk = q.shape[2] // bq, k.shape[2] // bk
+            # full causal = lower-triangular block mask (the token-exact
+            # triangle applies in-kernel); non-causal or mismatched
+            # tilings use the dense mask — still streamed, just no
+            # block skipping
+            if causal and nq == nk:
+                bm = _np.tril(_np.ones((nq, nk), bool))
+            else:
+                bm = _np.ones((nq, nk), bool)
+            return splash_attention(q, k, v, bm, causal, sm_scale, bq, bk)
+    return _grouped_flash_core(q, k, v, causal, sm_scale, block_q,
+                               block_k)
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
@@ -397,4 +456,4 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
             dv.reshape(B, Hkv, Sk, D))
 
 
-grouped_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_grouped_flash_core.defvjp(_fa_fwd, _fa_bwd)

@@ -272,3 +272,62 @@ class TestSdpaUnderMesh:
         plain = run(jnp.asarray(q))
         np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),
                                    atol=2e-5)
+
+
+class TestGQALongContextDelegation:
+    """Past the resident-K/V frontier, grouped_flash_attention delegates
+    to the K/V-streaming splash kernels (full causal block mask) instead
+    of failing to compile. Must be bit-exact vs the grouped core."""
+
+    @pytest.mark.parametrize("G,S", [(2, 256), (4, 512), (8, 512)])
+    def test_delegation_matches_core(self, G, S, monkeypatch):
+        # G=4/8 at 512-divisible S are the realistic Llama-3 delegation
+        # configs: naive 512x512 splash blocks would be REJECTED by the
+        # score/row budgets — the wrapper must shrink group-aware
+        import importlib
+        ga = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention_gqa")
+        rng = np.random.default_rng(11)
+        q = jnp.asarray(rng.standard_normal((1, 2 * G, S, 64)),
+                        jnp.float32)
+        kv = jnp.asarray(rng.standard_normal((1, 2, S, 64)), jnp.float32)
+
+        def run():
+            f = lambda a, b, c: ga.grouped_flash_attention(a, b, c, True)
+            out, vjp = jax.vjp(f, q, kv, kv)
+            return (out, *vjp(out))
+
+        ref = run()
+
+        def reject(*a, **k):
+            raise ga.ResidentOverflowError("test-forced")
+        monkeypatch.setattr(ga, "_gqa_resolve_blocks", reject)
+        got = run()
+        for a, b in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
+    def test_pinned_blocks_do_not_delegate(self, monkeypatch):
+        import importlib
+        ga = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention_gqa")
+        rng = np.random.default_rng(12)
+        q = jnp.asarray(rng.standard_normal((1, 4, 256, 64)), jnp.float32)
+        kv = jnp.asarray(rng.standard_normal((1, 2, 256, 64)),
+                         jnp.float32)
+        called = []
+        orig = ga._grouped_flash_core
+
+        def spy(*a, **k):
+            called.append(1)
+            return orig(*a, **k)
+        monkeypatch.setattr(ga, "_grouped_flash_core", spy)
+        ga.grouped_flash_attention(q, kv, kv, True, None, 128, 128)
+        assert called  # pinned blocks go straight to the core kernel
+
+    def test_resolver_raises_typed_error_at_extreme_s(self):
+        import importlib
+        ga = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention_gqa")
+        with pytest.raises(ga.ResidentOverflowError):
+            ga._gqa_resolve_blocks(16384, 16384, 4, None, None)
