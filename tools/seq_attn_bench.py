@@ -137,6 +137,19 @@ def main():
                   "ms": round(ms, 3), "compile_s": comp,
                   "frac_of_flash": round(flash_ms / ms, 3)})
 
+        if tag == "xlong":
+            # full-causal tril splash vs flash streamed at the same
+            # shape: table streaming skips dead-block DMA (tril halves
+            # it), flash streaming DMAs every block — the winner should
+            # own the long-S causal auto route
+            bm = np.tril(np.ones((S // 128, S // 128), bool))
+            ms, comp = bench(
+                lambda a, b, c, bm=bm: splash_attention(
+                    a, b, c, bm, True, None, 128, 128), q, k, v)
+            emit({"shape": tag, "variant": "splash_tril_full", "S": S,
+                  "B": B, "ms": round(ms, 3), "compile_s": comp,
+                  "frac_of_flash": round(flash_ms / ms, 3)})
+
     with open("/tmp/seq_attn_bench.json", "w") as f:
         json.dump(rows, f, indent=1)
 
