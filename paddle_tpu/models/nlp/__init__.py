@@ -5,7 +5,8 @@ from .gpt import (GPTConfig, GPTForCausalLM,  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_train_step_factory,
 )
-from .moe import MoEConfig, MoEForCausalLM  # noqa: F401
+from .moe import (MoEConfig, MoEForCausalLM,  # noqa: F401
+                  moe_train_step_factory)
 from .llama_decode import llama_decode_factory  # noqa: F401,E402
 from .llama_decode import llama_paged_decode_factory  # noqa: F401,E402
 from .llama_decode import llama_speculative_decode_factory  # noqa: F401,E402

@@ -152,3 +152,90 @@ class MoEForCausalLM(nn.Layer):
             import paddle_tpu as paddle
             return paddle.zeros([])
         return total * self.config.aux_loss_weight
+
+    def activated_params(self) -> int:
+        """Parameters touched per token (MoE MFU accounting): everything
+        except the routed experts, plus top_k/num_experts of them."""
+        import numpy as np
+        total = routed = 0
+        for name, p in self.state_dict().items():
+            n = int(np.prod(p.shape))
+            total += n
+            if ".mlp.w_in" in name or ".mlp.w_out" in name:
+                routed += n
+        cfg = self.config
+        return total - routed + routed * cfg.top_k // cfg.num_experts
+
+
+def moe_train_step_factory(model: MoEForCausalLM, mesh,
+                           learning_rate=1e-4, weight_decay=0.01,
+                           beta1=0.9, beta2=0.95, eps=1e-8,
+                           remat=False):
+    """(params, opt_state, step) for compiled MoE causal-LM pretraining
+    (BASELINE.md config 5: DeepSeekMoE / Qwen2-MoE, expert parallel).
+
+    Same pjit pattern as bert_pretrain_step_factory: params per sharding
+    annotation — MoELayer's expert-stacked weights carry
+    P('expert', ...) specs, so a mesh with an 'expert' axis runs true
+    expert parallelism (dispatch/combine einsums compile to all_to_all
+    over ICI) with no factory-side special casing. Loss = CE of logits
+    against POSITION-ALIGNED labels (the family convention shared with
+    llama/bert factories and causal_lm_loss: callers shift, e.g.
+    tokens[:, :-1] -> labels tokens[:, 1:]) + the gates' load-balancing
+    aux loss.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ...autograd import no_grad
+    from ...core.tensor import Tensor
+    from .llama import param_shardings
+    from .train_utils import (adamw_state_shardings, adamw_update,
+                              make_adamw_state)
+
+    shardings = param_shardings(model, mesh)
+    params = {k: jax.device_put(jnp.array(v._value, copy=True),
+                                shardings[k])
+              for k, v in model.state_dict().items()}
+    opt_state = make_adamw_state(mesh, shardings, params)
+    data_sh = NamedSharding(
+        mesh, P("data" if "data" in mesh.axis_names else None))
+
+    def forward_loss(params, tokens, labels):
+        saved = model.tree_flatten_params()
+        model.load_tree(params)
+        try:
+            with no_grad():
+                logits = model(Tensor(tokens))._value
+                aux = model.aux_loss()._value
+        finally:
+            model.load_tree(saved)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(
+            logits.reshape(-1, V).astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(
+            logp, labels.reshape(-1)[:, None], -1)[:, 0]
+        return jnp.mean(nll) + aux.astype(jnp.float32)
+
+    loss_fn = jax.checkpoint(forward_loss) if remat else forward_loss
+
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
+        step = opt_state["step"] + 1
+        t = step.astype(jnp.float32)
+        new_p, new_m, new_v = {}, {}, {}
+        for k in params:
+            new_p[k], new_m[k], new_v[k] = adamw_update(
+                params[k], grads[k], opt_state["m"][k],
+                opt_state["v"][k], t, learning_rate, beta1, beta2, eps,
+                weight_decay)
+        return new_p, {"step": step, "m": new_m, "v": new_v}, loss
+
+    state_sh = adamw_state_shardings(mesh, opt_state, params)
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(shardings, state_sh, data_sh, data_sh),
+        out_shardings=(shardings, state_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1))
+    return params, opt_state, jitted

@@ -37,6 +37,7 @@ QUEUE = [
     ("serving_bench",
      [sys.executable, "tools/serving_bench.py"], {}),
     ("vit_train", [sys.executable, "tools/ladder_bench.py", "7"], {}),
+    ("moe_train", [sys.executable, "tools/ladder_bench.py", "8"], {}),
     ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
     # refresh the headline last so PERF_LAST_TPU.json stamps this HEAD
     ("headline_bench", [sys.executable, "bench.py"], {}),
