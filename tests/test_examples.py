@@ -41,3 +41,11 @@ def test_example_4d_mesh():
                extra_env={"XLA_FLAGS":
                           "--xla_force_host_platform_device_count=8"})
     assert "1/8 of the moments" in out, out[-800:]
+
+
+def test_example_moe_ep():
+    out = _run("train_moe_ep.py",
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "expert shard fraction: 0.250" in out, out[-800:]
+    assert "step 7: loss" in out, out[-800:]

@@ -205,10 +205,8 @@ def bench_moe_train(on_tpu):
     from paddle_tpu.models.nlp import (MoEConfig, MoEForCausalLM,
                                        moe_train_step_factory)
 
-    import os
-    import sys as _sys
-    _sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    # repo root is already importable (paddle_tpu resolved above), and
+    # bench.py lives at the same root
     from bench import peak_for
 
     paddle.seed(0)
@@ -229,14 +227,17 @@ def bench_moe_train(on_tpu):
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
     params, opt_state, step = moe_train_step_factory(model, mesh)
     rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)),
-                         jnp.int32)
-    params, opt_state, loss = step(params, opt_state, tokens, tokens)
+    seq = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S + 1)),
+                      jnp.int32)
+    # the factory scores position-aligned labels; callers shift —
+    # unshifted tokens would report the degenerate copy-task loss
+    tokens, labels = seq[:, :-1], seq[:, 1:]
+    params, opt_state, loss = step(params, opt_state, tokens, labels)
     float(loss)  # warm + sync
     n = 10 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(n):
-        params, opt_state, loss = step(params, opt_state, tokens, tokens)
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
     lv = float(loss)
     dt = (time.perf_counter() - t0) / n
     tok = B * S
