@@ -13,6 +13,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -84,30 +85,57 @@ def main():
         env["PYTHONPATH"] = REPO + (
             ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         t0 = time.time()
-        try:
-            r = subprocess.run(cmd, capture_output=True, text=True,
-                               timeout=3000, cwd=REPO, env=env)
-            results = []
-            for ln in r.stdout.splitlines():
-                if ln.startswith("{"):
-                    try:
-                        results.append(json.loads(ln))
-                    except json.JSONDecodeError:
-                        results.append({"unparseable": ln[:200]})
-            rc = r.returncode
+        # stdout/stderr go to FILES, not pipes: a killed-on-timeout
+        # child's pipe output is unreliably recoverable (observed lost
+        # with both run() and the documented communicate-after-kill
+        # pattern), while a file retains every flushed row — tools emit
+        # one flushed JSON line per experiment precisely so partial
+        # windows still count
+        with tempfile.TemporaryFile(mode="w+") as fo, \
+                tempfile.TemporaryFile(mode="w+") as fe:
+            proc = subprocess.Popen(cmd, stdout=fo, stderr=fe,
+                                    cwd=REPO, env=env)
+            timed_out = False
+            try:
+                proc.wait(timeout=3000)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                timed_out = True
+            fo.seek(0)
+            out = fo.read()
+            fe.seek(0)
+            err = fe.read()
+        results = []
+        for ln in (out or "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    results.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    results.append({"unparseable": ln[:200]})
+        if timed_out:
+            rec = {"name": name, "rc": -1, "timeout": True,
+                   "results": results,
+                   "stderr_tail": (err or "")[-400:],
+                   "wall_s": round(time.time() - t0, 1)}
+        else:
+            rc = proc.returncode
             if rc == 0 and results and all(
                     isinstance(x, dict) and "error" in x for x in results):
                 rc = 1  # tool printed only error rows but exited 0
             rec = {"name": name, "rc": rc,
                    "wall_s": round(time.time() - t0, 1),
                    "results": results,
-                   "stderr_tail": r.stderr[-400:] if rc else ""}
-        except subprocess.TimeoutExpired:
-            rec = {"name": name, "rc": -1, "timeout": True,
-                   "wall_s": round(time.time() - t0, 1)}
+                   "stderr_tail": (err or "")[-400:] if rc else ""}
         if rec.get("rc", -1) != 0 and not tunnel_up():
-            # tunnel dropped mid-item: don't burn the rest of the queue on
-            # a dead link — keep this item pending and resume polling
+            # tunnel dropped mid-item: keep the item pending and resume
+            # polling — but WRITE the partial rows first (a 45-min sweep
+            # that died at experiment 7 still banked experiments 1-6)
+            if rec.get("results"):
+                rec["tunnel_dropped"] = True
+                rec["requeued"] = True
+                with open(out_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
             print(json.dumps({"name": name, "tunnel_dropped": True,
                               "requeued": True}), flush=True)
             if not wait_for_tunnel():

@@ -54,14 +54,22 @@ def main():
                                             bq, bk, stream),
             phase, q, q, q)
 
-    for S in (2048, 4096, 8192, 16384, 32768):
-        for blk in (512, 256, 128):
-            for stream in (False, True):
-                for phase in ("fwd", "fwdbwd"):
-                    r = compile_one(S, blk, blk, phase, stream)
-                    print(json.dumps(
-                        {"S": S, "block": blk, "phase": phase,
-                         "stream": stream, **r}), flush=True)
+    # decision-critical combos only (~25 probes; compile-only, but each
+    # rides the tunnel — a full cartesian grid could eat a short window):
+    # S=8192 maps the failure frontier, 16384 validates streaming where
+    # resident cannot fit, 2048@512 re-confirms the known-good headline
+    flash_grid = [
+        (2048, 512, False), (2048, 512, True),
+        (8192, 512, False), (8192, 256, False), (8192, 512, True),
+        (16384, 256, False), (16384, 512, True), (16384, 256, True),
+        (32768, 256, True),
+    ]
+    for S, blk, stream in flash_grid:
+        for phase in ("fwd", "fwdbwd"):
+            r = compile_one(S, blk, blk, phase, stream)
+            print(json.dumps(
+                {"S": S, "block": blk, "phase": phase,
+                 "stream": stream, **r}), flush=True)
 
     # GQA frontier: same resident-K/V exposure, rows = G*bq. Gates the
     # queued mfu_scale tp_shard row (G=4, S=8192).
@@ -77,14 +85,18 @@ def main():
                                                     bq, bk),
             phase, q, kv, kv)
 
-    for S in (2048, 8192):
-        for G in (4, 8):
-            for bq, bk in ((256, 512), (256, 256), (128, 256), (128, 128)):
-                for phase in ("fwd", "fwdbwd"):
-                    r = compile_gqa(S, G, bq, bk, phase)
-                    print(json.dumps(
-                        {"kernel": "gqa", "S": S, "G": G, "bq": bq,
-                         "bk": bk, "phase": phase, **r}), flush=True)
+    gqa_grid = [
+        (8192, 4, 256, 256),   # the resolver's tp_shard pick — must pass
+        (8192, 4, 256, 512),   # one step larger: how much margin exists
+        (8192, 8, 128, 256),
+        (2048, 4, 256, 512),   # round-3 known-good (calibration anchor)
+    ]
+    for S, G, bq, bk in gqa_grid:
+        for phase in ("fwd", "fwdbwd"):
+            r = compile_gqa(S, G, bq, bk, phase)
+            print(json.dumps(
+                {"kernel": "gqa", "S": S, "G": G, "bq": bq,
+                 "bk": bk, "phase": phase, **r}), flush=True)
 
     # splash banded frontier at long S (gates seq_attn_bench long rows)
     from paddle_tpu.ops.pallas.splash_attention import (
@@ -98,13 +110,12 @@ def main():
                                              blk, blk, window),
             phase, q, q, q)
 
-    for S, window in ((8192, 2048), (16384, 2048)):
-        for blk in (512, 256):
-            for phase in ("fwd", "fwdbwd"):
-                r = compile_splash(S, blk, window, phase)
-                print(json.dumps(
-                    {"kernel": "splash", "S": S, "window": window,
-                     "block": blk, "phase": phase, **r}), flush=True)
+    for S, window, blk in ((8192, 2048, 256), (16384, 2048, 256)):
+        for phase in ("fwd", "fwdbwd"):
+            r = compile_splash(S, blk, window, phase)
+            print(json.dumps(
+                {"kernel": "splash", "S": S, "window": window,
+                 "block": blk, "phase": phase, **r}), flush=True)
 
 
 if __name__ == "__main__":
