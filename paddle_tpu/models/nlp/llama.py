@@ -485,7 +485,8 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
                              beta1=0.9, beta2=0.95, eps=1e-8,
                              accum_dtype=jnp.float32,
                              remat: bool | str = True,
-                             offload_moments: bool = False):
+                             offload_moments: bool = False,
+                             chunked_vocab_ce: int | None = None):
     """Returns (params, opt_state, train_step) for pjit execution.
 
     Shardings: params per annotation; adamw moments mirror the params but
@@ -503,8 +504,24 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     across PCIe around the update (~ group_sharded_stage3.py:58 offload);
     the config every >1B single-chip model needs (f32 moments are 8 bytes
     per param — more than v5e HBM above ~2B params).
+
+    chunked_vocab_ce: chunk size for the fused head-projection+CE
+    (ops/chunked_ce.py) — the (B*S, V) logits tensor is never
+    materialized (~4.2 GB bf16 at Llama-3's V=128256, B=8, S=2048, plus
+    three HBM round-trips); requires tied embeddings and no >1 'model'
+    axis (vocab-sharded logits already avoid the gather via the dense
+    GSPMD path).
     """
     config = model.config
+    if chunked_vocab_ce and model.lm_head is not None:
+        raise ValueError("chunked_vocab_ce requires tied word embeddings "
+                         "(the (V, H) embedding doubles as the head)")
+    if chunked_vocab_ce and "model" in mesh.axis_names \
+            and mesh.shape["model"] > 1:
+        raise ValueError(
+            "chunked_vocab_ce is a single-chip/vocab-replicated path; "
+            "with a >1 'model' axis the vocab-sharded dense CE already "
+            "avoids the (B*S, V) gather — drop the flag there")
     shardings = param_shardings(model, mesh)
     # copy defensively: device_put to an identical sharding would alias the
     # model's own buffers, and the donated train step would delete them
@@ -535,16 +552,25 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
         # all-gathers under TP)
         set_tensor_parallel_mesh(mesh if (has_model and not has_sep)
                                  else None)
+        use_chunked = bool(chunked_vocab_ce) and not has_model
         try:
             # tape off: jax.value_and_grad differentiates this trace; the
             # eager tape's per-op jax.vjp would otherwise nest a second
             # linearization around the Pallas custom_vjp kernels
             with no_grad():
-                logits = model(Tensor(tokens))._value
+                if use_chunked:
+                    h = model.model(Tensor(tokens))._value
+                    w_head = model.model.embed_tokens.weight._value
+                else:
+                    logits = model(Tensor(tokens))._value
         finally:
             model.load_tree(saved)  # don't leave tracers in the Layer
             set_context_parallel_mesh(prev[0], prev[1])
             set_tensor_parallel_mesh(prev_tp[0], prev_tp[1])
+        if use_chunked:
+            from ...ops.chunked_ce import chunked_causal_lm_loss
+            return chunked_causal_lm_loss(h, w_head, labels,
+                                          int(chunked_vocab_ce))
         if jax.default_backend() != "cpu" and not has_model:
             # Pallas fused softmax-xent: skips the (B*S, V) softmax HBM
             # round trip (the largest intermediate of the training loss).

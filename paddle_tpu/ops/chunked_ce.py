@@ -1,0 +1,141 @@
+"""Chunked-vocabulary causal-LM cross entropy: loss without the logits.
+
+~ the reference's c_softmax_with_cross_entropy op family
+(operators/collective/c_softmax_with_cross_entropy_op.cu) solves vocab
+pressure by SHARDING logits over tensor parallelism; this solves the
+orthogonal single-chip problem: at Llama-3 scale (V=128256) the (B*S, V)
+bf16 logits tensor is ~4.2 GB at B=8/S=2048 — materializing it costs
+HBM capacity plus three full HBM round-trips (head-matmul write, CE
+read, backward read). Here the head projection and the CE fuse: a
+lax.scan walks vocab chunks, each chunk's logits live only as a
+(B*S, chunk) VMEM/HBM temporary inside one scan step, and the backward
+recomputes each chunk's softmax from the saved online logsumexp
+(flash-attention's trick applied to the vocab axis).
+
+Memory: O(B*S*chunk) working set vs O(B*S*V); FLOPs: the same head
+matmul + one recompute of it in the backward (2x head FLOPs for
+V-independent memory — the classic rematerialization trade).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30
+
+
+def _num_chunks(V, chunk):
+    # ceil: a partial last chunk is handled by padding w with zero rows
+    # and NEG-masking the out-of-vocab columns (real vocabs like
+    # Llama-3's 128256 rarely have convenient divisors)
+    return -(-V // chunk)
+
+
+def _padded(w, C, chunk):
+    V = w.shape[0]
+    pad = C * chunk - V
+    return w if pad == 0 else jnp.pad(w, ((0, pad), (0, 0)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_causal_lm_loss(x, w, labels, chunk_size=16384):
+    """Mean CE of softmax(x @ w.T) against labels, without materializing
+    the full logits.
+
+    x: (B, S, H) activations (bf16/f32); w: (V, H) head weights (the
+    tied-embedding layout Llama uses — vocab-major chunks cleanly);
+    labels: (B, S) int32, position-aligned (callers shift, the family
+    convention). Returns the scalar mean loss in f32.
+    """
+    loss, _ = _fwd_impl(x, w, labels, chunk_size)
+    return loss
+
+
+def _fwd_impl(x, w, labels, chunk):
+    B, S, H = x.shape
+    V = w.shape[0]
+    C = _num_chunks(V, chunk)
+    N = B * S
+    x2 = x.reshape(N, H)
+    lbl = labels.reshape(N)
+
+    wp = _padded(w, C, chunk)
+
+    def body(carry, ci):
+        m, l, lab = carry
+        wc = jax.lax.dynamic_slice_in_dim(wp, ci * chunk, chunk, 0)
+        lg = jax.lax.dot_general(
+            x2, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (N, chunk)
+        col = ci * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk), 1)
+        lg = jnp.where(col < V, lg, NEG)  # out-of-vocab pad columns
+        m_new = jnp.maximum(m, jnp.max(lg, axis=1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(lg - m_new[:, None]), axis=1)
+        off = lbl - ci * chunk
+        in_c = (off >= 0) & (off < chunk)
+        picked = jnp.take_along_axis(
+            lg, jnp.clip(off, 0, chunk - 1)[:, None], 1)[:, 0]
+        lab = jnp.where(in_c, picked, lab)
+        return (m_new, l, lab), None
+
+    init = (jnp.full((N,), NEG, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.full((N,), NEG, jnp.float32))
+    (m, l, lab), _ = jax.lax.scan(body, init, jnp.arange(C))
+    lse = m + jnp.log(l)
+    loss = jnp.mean(lse - lab)
+    return loss, (x2, w, lbl, lse, (B, S, H))
+
+
+def _fwd_vjp(x, w, labels, chunk):
+    # custom_vjp passes nondiff args IN POSITION to fwd (bwd gets them
+    # moved to the front)
+    loss, res = _fwd_impl(x, w, labels, chunk)
+    return loss, res
+
+
+def _bwd_vjp(chunk, res, g):
+    x2, w, lbl, lse, (B, S, H) = res
+    V = w.shape[0]
+    C = _num_chunks(V, chunk)
+    N = B * S
+    scale = g / N  # d(mean)/d(per-row)
+
+    wp = _padded(w, C, chunk)
+
+    def body(dx, ci):
+        wc = jax.lax.dynamic_slice_in_dim(wp, ci * chunk, chunk, 0)
+        lg = jax.lax.dot_general(
+            x2, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col = ci * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (1, chunk), 1)
+        lg = jnp.where(col < V, lg, NEG)
+        p = jnp.exp(lg - lse[:, None])  # softmax rows for this chunk
+        off = lbl - ci * chunk
+        in_c = (off >= 0) & (off < chunk)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (N, chunk), 1)
+                  == jnp.clip(off, 0, chunk - 1)[:, None]) \
+            & in_c[:, None]
+        d_lg = (p - onehot.astype(jnp.float32)) * scale  # (N, chunk)
+        d_lg = d_lg.astype(x2.dtype)
+        dx = dx + jax.lax.dot_general(
+            d_lg, wc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwc = jax.lax.dot_general(
+            d_lg, x2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (chunk, H)
+        return dx, dwc
+
+    dx0 = jnp.zeros((N, H), jnp.float32)
+    dx, dwcs = jax.lax.scan(body, dx0, jnp.arange(C))
+    dw = dwcs.reshape(C * chunk, H)[:V]
+    return (dx.reshape(B, S, H).astype(x2.dtype),
+            dw.astype(w.dtype), None)
+
+
+chunked_causal_lm_loss.defvjp(_fwd_vjp, _bwd_vjp)

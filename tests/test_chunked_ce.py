@@ -1,0 +1,129 @@
+"""Chunked-vocabulary CE (ops/chunked_ce.py): loss without the logits.
+
+~ the memory problem the reference addresses only via vocab-sharded
+c_softmax_with_cross_entropy (TP); this is the single-chip form — the
+(B*S, V) logits tensor never exists, the head matmul streams vocab
+chunks through a lax.scan with online logsumexp, and the backward
+recomputes each chunk's softmax (flash attention's trick on the vocab
+axis).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.chunked_ce import chunked_causal_lm_loss
+
+
+def _dense(x, w, lbl):
+    lg = jnp.einsum("bsh,vh->bsv", x, w).astype(jnp.float32)
+    lp = jax.nn.log_softmax(lg, -1)
+    return -jnp.mean(jnp.take_along_axis(lp, lbl[..., None], -1))
+
+
+class TestChunkedCE:
+    @pytest.mark.parametrize("V,chunk", [(96, 32), (101, 32), (101, 128),
+                                         (96, 96)])
+    def test_matches_dense_loss_and_grads(self, V, chunk):
+        rng = np.random.default_rng(0)
+        B, S, H = 2, 16, 32
+        x = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((V, H)) * 0.3, jnp.float32)
+        lbl = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+        lc = chunked_causal_lm_loss(x, w, lbl, chunk)
+        np.testing.assert_allclose(float(lc), float(_dense(x, w, lbl)),
+                                   rtol=1e-6)
+        gc = jax.grad(lambda a, b: chunked_causal_lm_loss(a, b, lbl,
+                                                          chunk),
+                      argnums=(0, 1))(x, w)
+        gd = jax.grad(lambda a, b: _dense(a, b, lbl),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(gc, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_bf16_inputs(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((1, 8, 32)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((64, 32)) * 0.3, jnp.bfloat16)
+        lbl = jnp.asarray(rng.integers(0, 64, (1, 8)), jnp.int32)
+        lc = chunked_causal_lm_loss(x, w, lbl, 32)
+        ld = _dense(x.astype(jnp.float32), w.astype(jnp.float32), lbl)
+        assert abs(float(lc) - float(ld)) < 0.05
+        dx, dw = jax.grad(
+            lambda a, b: chunked_causal_lm_loss(a, b, lbl, 32),
+            argnums=(0, 1))(x, w)
+        assert dx.dtype == jnp.bfloat16 and dw.dtype == jnp.bfloat16
+
+    def test_under_jit_and_grad_compose(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((1, 8, 16)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((48, 16)) * 0.3, jnp.float32)
+        lbl = jnp.asarray(rng.integers(0, 48, (1, 8)), jnp.int32)
+        f = jax.jit(lambda a, b: jax.value_and_grad(
+            lambda a2, b2: chunked_causal_lm_loss(a2, b2, lbl, 16),
+            argnums=(0, 1))(a, b))
+        loss, (dx, dw) = f(x, w)
+        assert np.isfinite(float(loss))
+        assert np.isfinite(np.asarray(dx)).all()
+
+
+class TestFactoryIntegration:
+    def test_factory_loss_matches_standard_path(self):
+        from jax.sharding import Mesh
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp.llama import llama_train_step_factory
+
+        cfg = LlamaConfig.tiny(vocab=101, hidden=32, layers=1, heads=2,
+                               kv_heads=2)
+        cfg.tie_word_embeddings = True
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        rng = np.random.default_rng(3)
+        tok = jnp.asarray(rng.integers(0, 101, (2, 17)), jnp.int32)
+
+        def one_step(**kw):
+            paddle.seed(7)
+            m = LlamaForCausalLM(cfg)
+            p, o, step, _ = llama_train_step_factory(
+                m, mesh, remat=False, **kw)
+            _, _, loss = step(p, o, tok[:, :-1], tok[:, 1:])
+            return float(loss)
+
+        base = one_step()
+        chunked = one_step(chunked_vocab_ce=32)
+        assert abs(base - chunked) < 1e-4, (base, chunked)
+
+    def test_rejects_model_axis_mesh(self):
+        from jax.sharding import Mesh
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp.llama import llama_train_step_factory
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2,
+                               kv_heads=2)
+        cfg.tie_word_embeddings = True
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2),
+                    ("data", "model"))
+        with pytest.raises(ValueError, match="model"):
+            llama_train_step_factory(m, mesh, chunked_vocab_ce=32)
+
+    def test_rejects_untied_head(self):
+        from jax.sharding import Mesh
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.nlp.llama import llama_train_step_factory
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2,
+                               kv_heads=2)
+        cfg.tie_word_embeddings = False
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        with pytest.raises(ValueError, match="tied"):
+            llama_train_step_factory(m, mesh, chunked_vocab_ce=32)

@@ -28,6 +28,7 @@ QUEUE = [
     ("long8k_vmem_repro",
      [sys.executable, "tools/long8k_vmem_repro.py"], {}),
     ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
+    ("bigvocab", [sys.executable, "tools/mfu_exp.py", "bigvocab"], {}),
     ("seq_attn_bench", [sys.executable, "tools/seq_attn_bench.py"], {}),
     ("mfu_scale_ladder", [sys.executable, "tools/mfu_scale.py", "ladder"],
      {}),

@@ -247,8 +247,9 @@ def flash_stream_check(B, H, S, D):
 
     f_s, g_s = make(True)
     out_s, grads_s = f_s(q, k, v), g_s(q, k, v)  # compile once
-    ms, _ = _sync_time(lambda a, b, c: (f_s(a, b, c), g_s(a, b, c)),
-                       q, k, v)
+    # time the grad alone: jax.grad recomputes its own forward, so
+    # adding f_s would double-count one forward pass
+    ms, _ = _sync_time(g_s, q, k, v)
     f_r, g_r = make(False)
     out_r, grads_r = f_r(q, k, v), g_r(q, k, v)
     err = float(jnp.max(jnp.abs(out_s.astype(jnp.float32) -

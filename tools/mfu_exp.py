@@ -15,7 +15,8 @@ import numpy as np
 
 
 def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
-                accum_dtype="float32", B=8, S=2048):
+                accum_dtype="float32", B=8, S=2048, vocab=32000,
+                chunked_ce=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -25,19 +26,25 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
     from paddle_tpu.models.nlp.llama import llama_train_step_factory
 
     dev = jax.devices()[0]
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=1536,
                       intermediate_size=4096, num_hidden_layers=12,
                       num_attention_heads=12, num_key_value_heads=kv_heads,
                       max_position_embeddings=max(2048, S),
                       dtype=jnp.bfloat16,
                       fuse_attention_qkv=fused, fuse_ffn_gate_up=fused)
+    if chunked_ce:
+        # big-vocab mode: tied head + fused chunked projection+CE (the
+        # dense (B*S, V) logits at V=128k would be ~4.2 GB bf16 plus
+        # round trips)
+        cfg.tie_word_embeddings = True
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.to(dtype="bfloat16")
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
     params, opt_state, step, _ = llama_train_step_factory(
         model, mesh, learning_rate=1e-4, remat=False,
-        accum_dtype=jnp.dtype(accum_dtype))
+        accum_dtype=jnp.dtype(accum_dtype),
+        chunked_vocab_ce=chunked_ce)
     n_params = sum(int(np.prod(v.shape)) for v in params.values())
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
@@ -65,19 +72,23 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
     mfu = (flops / dt) / 197e12
     return {"fused": fused, "kv_heads": kv_heads,
             "accum_dtype": accum_dtype, "batch": B, "seq": S,
-            "step_ms": round(dt * 1000, 2),
+            "vocab": vocab, "chunked_ce": chunked_ce,
+            "params": n_params, "step_ms": round(dt * 1000, 2),
             "mfu": round(mfu, 4), "loss": loss}
 
 
 if __name__ == "__main__":
     variant = sys.argv[1] if len(sys.argv) > 1 else "unfused"
-    if variant not in {"fused", "unfused", "gqa", "bf16moments", "long8k"}:
+    known = {"fused", "unfused", "gqa", "bf16moments", "long8k",
+             "bigvocab"}
+    if variant not in known:
         raise SystemExit(
-            f"unknown variant {variant!r}: expected "
-            "fused | unfused | gqa | bf16moments | long8k")
+            f"unknown variant {variant!r}: expected one of {sorted(known)}")
     print(json.dumps(run_variant(
         variant == "fused",
         kv_heads=4 if variant == "gqa" else 12,
         accum_dtype="bfloat16" if variant == "bf16moments" else "float32",
         B=2 if variant == "long8k" else 8,
-        S=8192 if variant == "long8k" else 2048)))
+        S=8192 if variant == "long8k" else 2048,
+        vocab=128256 if variant == "bigvocab" else 32000,
+        chunked_ce=16032 if variant == "bigvocab" else None)))
