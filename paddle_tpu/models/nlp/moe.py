@@ -134,11 +134,16 @@ class MoEForCausalLM(nn.Layer):
             for i in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def hidden_states(self, input_ids):
+        """Final-norm hidden states — the head projection's input (the
+        chunked-CE path fuses that projection into the loss)."""
         x = self.embed_tokens(input_ids)
         for layer in self.layers:
             x = layer(x)
-        x = self.norm(x)
+        return self.norm(x)
+
+    def forward(self, input_ids):
+        x = self.hidden_states(input_ids)
         from ...ops.linalg import matmul
         return matmul(x, self.embed_tokens.weight, transpose_y=True)
 
@@ -170,7 +175,7 @@ class MoEForCausalLM(nn.Layer):
 def moe_train_step_factory(model: MoEForCausalLM, mesh,
                            learning_rate=1e-4, weight_decay=0.01,
                            beta1=0.9, beta2=0.95, eps=1e-8,
-                           remat=False):
+                           remat=False, chunked_vocab_ce=None):
     """(params, opt_state, step) for compiled MoE causal-LM pretraining
     (BASELINE.md config 5: DeepSeekMoE / Qwen2-MoE, expert parallel).
 
@@ -207,10 +212,22 @@ def moe_train_step_factory(model: MoEForCausalLM, mesh,
         model.load_tree(params)
         try:
             with no_grad():
-                logits = model(Tensor(tokens))._value
+                if chunked_vocab_ce:
+                    h = model.hidden_states(Tensor(tokens))._value
+                    w_head = model.embed_tokens.weight._value
+                else:
+                    logits = model(Tensor(tokens))._value
                 aux = model.aux_loss()._value
         finally:
             model.load_tree(saved)
+        if chunked_vocab_ce:
+            # fused head-projection + CE: the (B*S, V) logits are never
+            # materialized (Qwen2-MoE's V=151936 makes them ~5 GB bf16
+            # at B=8/S=2048)
+            from ...ops.chunked_ce import chunked_causal_lm_loss
+            ce = chunked_causal_lm_loss(h, w_head, labels,
+                                        int(chunked_vocab_ce))
+            return ce + aux.astype(jnp.float32)
         V = logits.shape[-1]
         logp = jax.nn.log_softmax(
             logits.reshape(-1, V).astype(jnp.float32), -1)

@@ -129,13 +129,17 @@ def _bwd_vjp(chunk, res, g):
         dwc = jax.lax.dot_general(
             d_lg, x2, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # (chunk, H)
-        return dx, dwc
+        # downcast inside the body: chunks never accumulate across scan
+        # steps, so this is bit-identical to a post-hoc astype while the
+        # stacked (C*chunk, H) ys buffer shrinks to w.dtype (f32 stacking
+        # at V=128k/H=1536 would be a ~788 MB temporary — material for
+        # an op whose purpose is HBM savings)
+        return dx, dwc.astype(w.dtype)
 
     dx0 = jnp.zeros((N, H), jnp.float32)
     dx, dwcs = jax.lax.scan(body, dx0, jnp.arange(C))
     dw = dwcs.reshape(C * chunk, H)[:V]
-    return (dx.reshape(B, S, H).astype(x2.dtype),
-            dw.astype(w.dtype), None)
+    return (dx.reshape(B, S, H).astype(x2.dtype), dw, None)
 
 
 chunked_causal_lm_loss.defvjp(_fwd_vjp, _bwd_vjp)

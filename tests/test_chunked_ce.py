@@ -97,6 +97,29 @@ class TestFactoryIntegration:
         chunked = one_step(chunked_vocab_ce=32)
         assert abs(base - chunked) < 1e-4, (base, chunked)
 
+    def test_moe_factory_chunked_matches_dense(self):
+        from jax.sharding import Mesh
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.nlp import (MoEConfig, MoEForCausalLM,
+                                           moe_train_step_factory)
+        cfg = MoEConfig.deepseek_tiny()
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        rng = np.random.default_rng(4)
+        tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 17)),
+                          jnp.int32)
+
+        def one_step(**kw):
+            paddle.seed(9)
+            m = MoEForCausalLM(cfg)
+            p, o, step = moe_train_step_factory(m, mesh, **kw)
+            _, _, loss = step(p, o, tok[:, :-1], tok[:, 1:])
+            return float(loss)
+
+        base = one_step()
+        chunked = one_step(chunked_vocab_ce=96)  # 256 % 96 != 0: pad path
+        assert abs(base - chunked) < 1e-4, (base, chunked)
+
     def test_rejects_model_axis_mesh(self):
         from jax.sharding import Mesh
 

@@ -260,7 +260,8 @@ def flash_stream_check(B, H, S, D):
     ok = err < 0.02 and gerr < 0.05
     print(json.dumps({
         "check": f"flash_streamed B{B} H{H} S{S} D{D}",
-        "ms_fwdbwd": round(ms, 3), "max_err": round(err, 4),
+        "ms_grad": round(ms, 3),  # one jax.grad call = fwd+bwd
+        "max_err": round(err, 4),
         "max_grad_err": round(gerr, 4), "ok": ok}))
     return ok
 
