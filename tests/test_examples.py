@@ -43,6 +43,11 @@ def test_example_4d_mesh():
     assert "1/8 of the moments" in out, out[-800:]
 
 
+def test_example_long_context():
+    out = _run("train_llama_long_context.py")
+    assert "long-context train OK" in out, out[-800:]
+
+
 def test_example_moe_ep():
     out = _run("train_moe_ep.py",
                extra_env={"XLA_FLAGS":
