@@ -596,6 +596,34 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, causal=False, sm_scale=None,
+                block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                bwd_block_q=None, bwd_block_k=None, stream=None):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_k, streamed = _resolve_blocks(
+        q.shape[2], k.shape[2], block_q, block_k, q.shape[-1],
+        q.dtype.itemsize, stream)
+    fwd = _flash_fwd_stream if streamed else _flash_fwd
+    out, _ = fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+# When AUTO resolution lands in streamed mode for a causal self-attention,
+# route through the splash kernels with a lower-triangular block mask
+# instead of the hand-written streamed variants: splash's prefetched
+# kv_idx tables make dead blocks cost nothing in the FORWARD and dQ
+# walks (Pallas elides the DMA when consecutive grid steps map the same
+# block) — ~2x DMA saved there; the dK/dV pass remains DMA-dense in both
+# designs (it streams q blocks whose indices always advance; dead pairs
+# skip compute only). Toggle for benchmarking (tools/seq_attn_bench.py
+# measures both at S=16384). Only taken for 256-multiple sequences:
+# odd lengths would force tiny divisor blocks whose tril tables blow up
+# (e.g. S=16392 -> 683x683 kv_idx in SMEM) — those stay on the plain
+# streamed kernels.
+CAUSAL_STREAM_VIA_SPLASH = True
+
+
 def flash_attention(q, k, v, causal=False, sm_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     bwd_block_q=None, bwd_block_k=None, stream=None):
@@ -611,15 +639,27 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     resident K/V while the scoped-VMEM fit model allows it, streaming
     beyond — long sequences where double-buffered resident K/V would
     blow the 16M scoped-vmem limit that interpret-mode tests can't see).
+    Auto-streamed CAUSAL self-attention takes the splash lower-triangular
+    route (dead-block DMA elided); forced ``stream=True`` keeps the
+    plain streamed kernels (sweeps measure exactly what they name).
     """
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    block_q, block_k, streamed = _resolve_blocks(
-        q.shape[2], k.shape[2], block_q, block_k, q.shape[-1],
-        q.dtype.itemsize, stream)
-    fwd = _flash_fwd_stream if streamed else _flash_fwd
-    out, _ = fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return out
+    auto = (block_q is None and block_k is None and bwd_block_q is None
+            and bwd_block_k is None and stream is None)
+    if auto and causal and CAUSAL_STREAM_VIA_SPLASH \
+            and q.shape[2] == k.shape[2] and q.shape[2] % 256 == 0:
+        _, _, streamed = _resolve_blocks(
+            q.shape[2], k.shape[2], None, None, q.shape[-1],
+            q.dtype.itemsize)
+        if streamed:
+            import numpy as _np
+
+            from .splash_attention import splash_attention
+            bq = bk = 256
+            n = q.shape[2] // bq
+            bm = _np.tril(_np.ones((n, n), bool))
+            return splash_attention(q, k, v, bm, True, sm_scale, bq, bk)
+    return _flash_core(q, k, v, causal, sm_scale, block_q, block_k,
+                       bwd_block_q, bwd_block_k, stream)
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k,
@@ -709,4 +749,4 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k,
             dv.reshape(B, H, Sk, D))
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash_core.defvjp(_fa_fwd, _fa_bwd)

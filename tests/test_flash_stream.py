@@ -163,7 +163,30 @@ class TestAutoStreamEndToEnd:
         bq, bk, streamed = fa._resolve_blocks(512, 512, None, None, 64, 4)
         assert streamed
         q, k, v = _qkv(S=512)
-        out = flash_attention(q, k, v, True)  # auto → streamed
+        # auto CAUSAL streaming routes via splash-tril (dead-block DMA
+        # elided in fwd/dq); force splash's own STREAMED kernels too —
+        # that is the path real S>=16k causal traffic hits
+        sp = importlib.import_module(
+            "paddle_tpu.ops.pallas.splash_attention")
+        monkeypatch.setattr(sp, "_FORCE_STREAM", True)
+        out = flash_attention(q, k, v, True)
         ref = flash_attention(q, k, v, True, None, bq, bk, None, None,
                               False)
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        g_route = jax.grad(lambda a, b, c: flash_attention(
+            a, b, c, True).sum(), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda a, b, c: flash_attention(
+            a, b, c, True, None, bq, bk, None, None, False).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_route, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5)
+        monkeypatch.setattr(sp, "_FORCE_STREAM", None)
+        # auto NON-causal streaming keeps the plain streamed kernels:
+        # same blocks as the forced-mode call -> bit-exact
+        out_nc = flash_attention(q, k, v, False)
+        ref_nc = flash_attention(q, k, v, False, None, bq, bk, None,
+                                 None, True)
+        np.testing.assert_array_equal(np.asarray(out_nc),
+                                      np.asarray(ref_nc))
