@@ -74,27 +74,39 @@ def _pick_block(seq_len: int) -> int:
         f"{seq_len}; pad the sequence to a multiple of 128")
 
 
-# Scoped-VMEM fit model, calibrated on chip (v5e, 16M scoped limit):
-# the S=2048 train step compiles at 512x512 while S=8192 fails with
-# "Scoped allocation with size 21.00M" — consistent with resident K/V
-# double-buffered by Mosaic (2 tensors x 2 buffers x Sk*D*2B: 2M at
-# S=2048, 8M at S=8192) plus ~13 (block_q x block_k) f32-buffer
-# equivalents of compute temporaries/streams in the worst kernel
-# (s/p/dp/ds, masked copies, iota pair, exp2 results, acc, q/o streams).
-# tools/long8k_vmem_repro.py re-measures the frontier on chip; adjust
-# _TEMP_COEF if Mosaic's allocator changes.
+# Scoped-VMEM fit model, calibrated on chip (v5e, 16M scoped limit).
+# Chip facts driving the coefficients (tools/long8k_vmem_repro.py,
+# 2026-08-01 window, D=128 bf16):
+#   fwd  resident 512x512 @ S=8192  COMPILES      -> fwd temps <= ~7M
+#   f+b  resident 512x512 @ S=8192  FAILS @17.00M -> ~16M + ~1M
+#   f+b  resident 256x256 @ S=8192  FAILS @16.50M -> ~16M + ~0.5M
+#   fwd  resident 256     @ S=16384 FAILS @16.50M -> resident alone 16M
+#   f+b  streamed 512x512 @ S=8192  COMPILES
+# The backward failures sit at ~2x the bf16 resident bytes plus a small
+# block term: the dk/dv kernel's full-length operands (Q, dO) are ALSO
+# materialized as f32 compute copies (2 x Sres x D x 4B), which the
+# round-3 model missed — its coef-13 block term was calibrated against
+# what was actually this S-scaled backward failure. Forward temps are
+# block-sized only (s/p/exp2/acc/iota ~ a few (bq,bk) f32 buffers).
 _SCOPED_VMEM = 16 * 2**20
-_TEMP_COEF = 13
+_TEMP_COEF = 6        # fwd/dq: (bq,bk) f32-buffer equivalents, safe side
+_BWD_TEMP_COEF = 2    # dk/dv block temps (chip: ~1-2 buffer equivalents)
 _FIT_MARGIN = 2**20
 
 
-def _resident_fits(bq, bk, Sres, D, itemsize=2) -> bool:
+def _resident_fits(bq, bk, Sres, D, itemsize=2, bwd=False) -> bool:
     # Sres: the longest sequence any resident-mode kernel holds full-length
     # in VMEM — Sk for the forward/dq kernels (K+V resident), and
     # max(Sq, Sk) on the backward path (the dk/dv kernel keeps Q+dO
     # resident at Sq)
     resident = 2 * 2 * Sres * D * itemsize  # 2 tensors, double-buffered
-    temps = _TEMP_COEF * bq * bk * 4
+    if bwd:
+        # full-length f32 compute copies of the resident pair (chip-
+        # calibrated: the 17.00M/16.50M failures above)
+        resident += 2 * Sres * D * 4
+        temps = _BWD_TEMP_COEF * bq * bk * 4
+    else:
+        temps = _TEMP_COEF * bq * bk * 4
     return resident + temps + _FIT_MARGIN <= _SCOPED_VMEM
 
 
@@ -131,7 +143,7 @@ def _resolve_blocks(Sq, Sk, block_q, block_k, D=128, itemsize=2,
     if block_q and block_k:
         if stream is None:
             stream = not _resident_fits(block_q, block_k, Sres, D,
-                                        itemsize)
+                                        itemsize, bwd)
         return block_q, block_k, stream
     seen = set()
     cands = []
@@ -150,7 +162,7 @@ def _resolve_blocks(Sq, Sk, block_q, block_k, D=128, itemsize=2,
         return (block_q or _pick_block(Sq), block_k or _pick_block(Sk),
                 True)
     for cq, ck in cands:
-        if _resident_fits(cq, ck, Sres, D, itemsize):
+        if _resident_fits(cq, ck, Sres, D, itemsize, bwd):
             return cq, ck, False
     if stream is None:
         for cq, ck in cands:
@@ -166,7 +178,7 @@ def _resolve_blocks(Sq, Sk, block_q, block_k, D=128, itemsize=2,
         return cands[0][0], cands[0][1], False
     if stream is False:
         return cq, ck, False
-    return cq, ck, not _resident_fits(cq, ck, Sres, D, itemsize)
+    return cq, ck, not _resident_fits(cq, ck, Sres, D, itemsize, bwd)
 
 
 def _mask_causal(s, qi, kj, block_q, block_k):

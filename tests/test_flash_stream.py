@@ -82,10 +82,16 @@ class TestResolverDecisions:
         assert _resolve_blocks(2048, 2048, None, None, 128, 2) == \
             (512, 512, False)
 
-    def test_long_seq_shrinks_blocks_but_stays_resident(self):
-        bq, bk, streamed = _resolve_blocks(8192, 8192, None, None, 128, 2)
-        assert not streamed
-        assert (bq, bk) != (512, 512)  # the chip-failing combo
+    def test_long_seq_fwd_resident_bwd_streams(self):
+        # chip facts (long8k_vmem_repro, 2026-08-01): at S=8192 the
+        # FORWARD compiles resident even at 512x512, while the backward
+        # (dk/dv holds full-length Q/dO bf16 + f32 compute copies) fails
+        # at any block size — 17.00M @512, 16.50M @256 — so bwd streams.
+        assert _resolve_blocks(8192, 8192, None, None, 128, 2) == \
+            (512, 512, False)
+        _, _, streamed = _resolve_blocks(8192, 8192, None, None, 128, 2,
+                                         bwd=True)
+        assert streamed
 
     def test_very_long_seq_streams(self):
         for S in (16384, 32768, 131072):

@@ -43,11 +43,18 @@ def run_ladder():
     from paddle_tpu.models.nlp.llama import llama_train_step_factory
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    # (layers, hidden, inter, heads, kv) descending ~2.4B -> ~1.5B; GQA
-    # kv=4 keeps the KV projections from dominating the HBM budget
-    ladder = [(32, 2560, 6912, 20, 4),
-              (26, 2560, 6912, 20, 4),
-              (20, 2560, 6912, 20, 4)]
+    # (layers, hidden, inter, heads, kv) descending ~2.4B -> ~1.0B; GQA
+    # kv=4 keeps the KV projections from dominating the HBM budget.
+    # Window-2 chip fact: every rung >= 1.5B at B=4 OOMs in HLO temps
+    # (bf16 params+moments alone are ~9.3 GB at 1.5B; grads + fused-CE
+    # temps push past 15.75 GB), so the ladder now descends far enough
+    # to bracket the true in-HBM frontier instead of reporting only OOMs.
+    ladder = [(32, 2560, 6912, 20, 4),   # ~2.36B
+              (26, 2560, 6912, 20, 4),   # ~1.95B
+              (20, 2560, 6912, 20, 4),   # ~1.54B
+              (16, 2560, 6912, 20, 4),   # ~1.26B
+              (24, 2048, 5504, 16, 4),   # ~1.19B
+              (12, 2560, 6912, 20, 4)]   # ~0.99B
     if not on_tpu:
         ladder = [(2, 64, 128, 4, 2)]
     B, S = (4, 2048) if on_tpu else (1, 128)
