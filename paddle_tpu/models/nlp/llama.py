@@ -153,14 +153,10 @@ def _dense_attention_tail(qt, kt, vt, scale, window=None):
 
 
 def _flash_eligible(seq_len: int, head_dim: int, dtype) -> bool:
-    """One gate for every flash-attention entry (GQA and MHA paths must
-    never diverge): kernel supports 128-multiple sequences >= 256 and the
-    MXU-tiled head dims, under the FLAGS_use_flash_attention switch."""
-    from ...core import flags as _flags
-    return (bool(_flags.get_flag("use_flash_attention"))
-            and seq_len >= 256 and seq_len % 128 == 0
-            and head_dim in (64, 128, 256)
-            and dtype in (jnp.float32, jnp.bfloat16))
+    """Delegates to the ops-layer gate (shared with Ulysses/ring so the
+    model and sequence-parallel entries can never diverge)."""
+    from ...ops.pallas.flash_attention import flash_eligible
+    return flash_eligible(seq_len, head_dim, dtype)
 
 
 def _rope_freqs(head_dim, theta):

@@ -53,6 +53,18 @@ LOG2E = 1.4426950408889634
 LN2 = 0.6931471805599453
 
 
+def flash_eligible(seq_len: int, head_dim: int, dtype) -> bool:
+    """The one shape/dtype gate for every flash-attention entry point
+    (model layers, Ulysses, ring — they must never diverge): kernel
+    supports 128-multiple sequences >= 256 and MXU-tiled head dims,
+    under the FLAGS_use_flash_attention switch."""
+    from ...core import flags as _flags
+    return (bool(_flags.get_flag("use_flash_attention"))
+            and seq_len >= 256 and seq_len % 128 == 0
+            and head_dim in (64, 128, 256)
+            and dtype in (jnp.float32, jnp.bfloat16))
+
+
 def _pick_block(seq_len: int) -> int:
     # Measured on v5e at (B8,H12,S2048,D128) fwd+bwd: 512 blocks run 11.6ms
     # vs 18.4ms at the MXU-tile minimum of 128 — bigger blocks amortize the
@@ -127,6 +139,12 @@ MEASURED_BLOCK_ORDER = ((512, 512), (256, 512), (512, 256), (256, 256),
                         (128, 512), (512, 128), (128, 128))
 _PAIR_ORDER = MEASURED_BLOCK_ORDER[:-1] + ((128, 256), (256, 128),
                                            (128, 128))
+# Backward-kernel preference, from the on-chip 3x3 sweep at S=2048/D=128
+# (tools/flash_bwd_sweep.py, 2026-08-01): 1024x512 measured fastest
+# (13.51 ms/fwd+bwd vs 13.68 at 512x512); taller dq blocks amortize the
+# full-length kv walk. Tried first when S divides; everything after
+# falls back to the shared order.
+_BWD_PAIR_ORDER = ((1024, 512),) + _PAIR_ORDER
 
 
 def _resolve_blocks(Sq, Sk, block_q, block_k, D=128, itemsize=2,
@@ -147,7 +165,7 @@ def _resolve_blocks(Sq, Sk, block_q, block_k, D=128, itemsize=2,
         return block_q, block_k, stream
     seen = set()
     cands = []
-    for bq, bk in _PAIR_ORDER:
+    for bq, bk in (_BWD_PAIR_ORDER if bwd else _PAIR_ORDER):
         cq, ck = block_q or bq, block_k or bk
         if (cq, ck) in seen or Sq % cq or Sk % ck:
             continue
@@ -622,18 +640,16 @@ def _flash_core(q, k, v, causal=False, sm_scale=None,
 
 
 # When AUTO resolution lands in streamed mode for a causal self-attention,
-# route through the splash kernels with a lower-triangular block mask
-# instead of the hand-written streamed variants: splash's prefetched
-# kv_idx tables make dead blocks cost nothing in the FORWARD and dQ
-# walks (Pallas elides the DMA when consecutive grid steps map the same
-# block) — ~2x DMA saved there; the dK/dV pass remains DMA-dense in both
-# designs (it streams q blocks whose indices always advance; dead pairs
-# skip compute only). Toggle for benchmarking (tools/seq_attn_bench.py
-# measures both at S=16384). Only taken for 256-multiple sequences:
-# odd lengths would force tiny divisor blocks whose tril tables blow up
-# (e.g. S=16392 -> 683x683 kv_idx in SMEM) — those stay on the plain
-# streamed kernels.
-CAUSAL_STREAM_VIA_SPLASH = True
+# optionally route through the splash kernels with a lower-triangular
+# block mask instead of the hand-written streamed variants. The theory
+# (splash's prefetched kv_idx tables elide dead-block DMA, ~2x saved in
+# the fwd/dQ walks) LOST on chip: at S=16384 the plain streamed kernels
+# measure 48.3 ms/fwd+bwd vs 97.4 ms through splash-tril
+# (tools/seq_attn_bench.py, 2026-08-01) — splash's per-block overhead
+# (128/256 tiles, table machinery) outweighs the halved DMA, so the
+# route is OFF. Kept as a switch so future splash block-size tuning can
+# re-measure against the same yardstick.
+CAUSAL_STREAM_VIA_SPLASH = False
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None,
@@ -651,9 +667,11 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     resident K/V while the scoped-VMEM fit model allows it, streaming
     beyond — long sequences where double-buffered resident K/V would
     blow the 16M scoped-vmem limit that interpret-mode tests can't see).
-    Auto-streamed CAUSAL self-attention takes the splash lower-triangular
-    route (dead-block DMA elided); forced ``stream=True`` keeps the
-    plain streamed kernels (sweeps measure exactly what they name).
+    The forward and backward resolve independently: at S=8192 the
+    forward stays resident while the backward streams. Auto-streamed
+    causal self-attention can route through splash-tril via
+    CAUSAL_STREAM_VIA_SPLASH, but that route measured 2x slower on chip
+    and is off (see the toggle's comment).
     """
     auto = (block_q is None and block_k is None and bwd_block_q is None
             and bwd_block_k is None and stream is None)

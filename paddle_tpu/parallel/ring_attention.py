@@ -1,21 +1,39 @@
-"""Ring attention — sequence/context parallelism over the 'sep' mesh axis.
+"""Ring attention over a 'sep' mesh axis (context parallelism).
 
-The reference has NO sequence/context parallelism (grep-verified,
-SURVEY.md §0/§5); this is the capability the TPU build adds to reach
-long-context scale. Design: sequence sharded over 'sep'; each step every
-device computes blockwise attention of its local Q against the currently
-held KV chunk with online-softmax accumulation, then rotates KV one
-neighbor over ICI via ppermute. Compute (local attention block) overlaps
-the KV transfer thanks to XLA's latency-hiding scheduler — the classic
-ring schedule.
+The reference has NO sequence/context parallelism (SURVEY.md §0/§5) —
+this is an exceeds-reference capability. Sequence is sharded over the
+ring axis; each device computes blockwise attention of its local Q
+against the currently-held K/V chunk, then passes the chunk to its
+neighbor over ICI via ppermute. Compute (local attention block)
+overlaps the rotation; after n steps every Q chunk has seen every K/V
+chunk.
 
-Causal masking uses global block positions: chunk c attends chunk k fully
-if k < c, diagonally if k == c, not at all if k > c (those steps still run
-for SPMD uniformity; their contribution is masked to -inf).
+Causal masking uses global block positions: chunk c attends chunk k
+fully when k < c, causally (triangular) when k == c, not at all when
+k > c.
+
+Two local-attention engines:
+
+- **flash kernel path** (default for MXU-shaped chunks): each chunk
+  pair runs the Pallas flash kernel's forward, producing normalized
+  partial (out, lse); partials merge online in log space. The custom
+  VJP re-runs the ring in the backward, calling the flash backward
+  kernel per chunk with the GLOBAL (out, lse, dO) — mathematically the
+  chunk-restricted softmax gradient, the classical ring-attention
+  backward. dK/dV accumulators rotate with their chunks and arrive
+  home after the full cycle. No (Sq, Sk) score tensor ever
+  materializes, so memory is O(block) regardless of S — the dense
+  einsum engine below OOMed at S=16384 (12.9 GB of f32 scores) and
+  measured 0.29-0.46x flash throughput at S=2k-8k
+  (tools/seq_attn_bench.py, 2026-08-01).
+- **dense einsum fallback** for flash-ineligible shapes (tiny heads,
+  odd lengths, CPU oracle tests): exact f32 softmax over the chunk.
+
+GQA: K/V rotate at their TRUE head count (G-times less ICI traffic);
+the flash path repeats them to full heads locally after each hop.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -26,11 +44,10 @@ NEG_INF = -1e30
 
 
 def _block_attn(q, k, v, mask):
-    """q: (B,Hq,Sq,D); k/v: (B,Hkv,Sk,D) with Hq a multiple of Hkv (GQA:
-    the ring rotates K/V at their TRUE head count, so grouped-query
-    configs move G-times less data over ICI per step); mask broadcastable
-    (Sq,Sk) bool. Returns (scores_max, exp_sum, acc) partials in f32,
-    shaped with Hq heads."""
+    """Dense-engine partials: q (B,Hq,Sq,D) pre-scaled f32; k/v
+    (B,Hkv,Sk,D) with Hq a multiple of Hkv; mask broadcastable (Sq,Sk)
+    bool. Returns (scores_max, exp_sum, acc) partials in f32 with Hq
+    heads."""
     B, Hq, Sq, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv  # G == 1 is plain MHA (the reshape below is free)
@@ -48,6 +65,137 @@ def _block_attn(q, k, v, mask):
             acc.reshape(B, Hq, Sq, D))
 
 
+def _ring_flash_local(axis: str, n: int, causal: bool, sm_scale: float):
+    """Builds the per-device (custom-VJP) ring function for the flash
+    engine. ql: (B,Hq,Sloc,D); kl/vl: (B,Hkv,Sloc,D)."""
+    from ..ops.pallas.flash_attention import _fa_bwd, _fa_fwd
+
+    def _expand(kb, vb, G):
+        if G == 1:
+            return kb, vb
+        return jnp.repeat(kb, G, axis=1), jnp.repeat(vb, G, axis=1)
+
+    def _chunk_fwd(ql, kb, vb, diag_causal: bool):
+        out, res = _fa_fwd(ql, kb, vb, diag_causal, sm_scale,
+                           None, None, None, None, None)
+        return out, res[4]  # (out, lse)
+
+    def _merge(O, LSE, o, lse):
+        LSE_new = jnp.logaddexp(LSE, lse)
+        wO = jnp.exp(LSE - LSE_new)[..., None]
+        wo = jnp.exp(lse - LSE_new)[..., None]
+        return O * wO + o.astype(jnp.float32) * wo, LSE_new
+
+    def fwd_loop(ql, kl, vl):
+        my = jax.lax.axis_index(axis)
+        B, Hq, Sq, D = ql.shape
+        G = Hq // kl.shape[1]
+        O = jnp.zeros((B, Hq, Sq, D), jnp.float32)
+        LSE = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
+
+        def step(carry, i):
+            O, LSE, kb, vb = carry
+            src = (my - i) % n
+            kf, vf = _expand(kb, vb, G)
+
+            def diag_fn(ops):
+                return _chunk_fwd(*ops, diag_causal=True)
+
+            def full_fn(ops):
+                return _chunk_fwd(*ops, diag_causal=False)
+
+            def none_fn(ops):
+                return (jnp.zeros((B, Hq, Sq, D), ql.dtype),
+                        jnp.full((B, Hq, Sq), NEG_INF, jnp.float32))
+
+            ops = (ql, kf, vf)
+            if causal:
+                o, lse = jax.lax.cond(
+                    src == my, diag_fn,
+                    lambda ops: jax.lax.cond(src < my, full_fn, none_fn,
+                                             ops), ops)
+            else:
+                o, lse = full_fn(ops)
+            O, LSE = _merge(O, LSE, o, lse)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return (O, LSE, kb, vb), None
+
+        (O, LSE, _, _), _ = jax.lax.scan(
+            step, (O, LSE, kl, vl), jnp.arange(n))
+        return O.astype(ql.dtype), LSE
+
+    @jax.custom_vjp
+    def ring(ql, kl, vl):
+        return fwd_loop(ql, kl, vl)[0]
+
+    def ring_fwd(ql, kl, vl):
+        O, LSE = fwd_loop(ql, kl, vl)
+        return O, (ql, kl, vl, O, LSE)
+
+    def ring_bwd(res, dO):
+        ql, kl, vl, O, LSE = res
+        my = jax.lax.axis_index(axis)
+        B, Hq, Sq, D = ql.shape
+        Hkv = kl.shape[1]
+        G = Hq // Hkv
+        dq = jnp.zeros(ql.shape, jnp.float32)
+        dk_acc = jnp.zeros(kl.shape, jnp.float32)
+        dv_acc = jnp.zeros(vl.shape, jnp.float32)
+
+        def chunk_bwd(diag_causal, ops):
+            ql, kf, vf = ops
+            # flash backward with the GLOBAL (out, lse): p = exp(s - LSE)
+            # is the global softmax restricted to this chunk, so the
+            # returned (dq, dk, dv) are exactly this chunk's terms
+            dql, dkf, dvf = _fa_bwd(diag_causal, sm_scale, None, None,
+                                    None, None, None,
+                                    (ql, kf, vf, O, LSE), dO)
+            if G > 1:
+                dkf = dkf.reshape(B, Hkv, G, dkf.shape[2], D).sum(2)
+                dvf = dvf.reshape(B, Hkv, G, dvf.shape[2], D).sum(2)
+            return (dql.astype(jnp.float32), dkf.astype(jnp.float32),
+                    dvf.astype(jnp.float32))
+
+        def step(carry, i):
+            dq, dk_acc, dv_acc, kb, vb = carry
+            src = (my - i) % n
+            kf, vf = _expand(kb, vb, G)
+            zero = (jnp.zeros(ql.shape, jnp.float32),
+                    jnp.zeros(kb.shape, jnp.float32),
+                    jnp.zeros(vb.shape, jnp.float32))
+            ops = (ql, kf, vf)
+            if causal:
+                dql, dkb, dvb = jax.lax.cond(
+                    src == my,
+                    lambda ops: chunk_bwd(True, ops),
+                    lambda ops: jax.lax.cond(
+                        src < my, lambda ops: chunk_bwd(False, ops),
+                        lambda ops: zero, ops), ops)
+            else:
+                dql, dkb, dvb = chunk_bwd(False, ops)
+            dq = dq + dql
+            dk_acc = dk_acc + dkb
+            dv_acc = dv_acc + dvb
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            # accumulators ride with their chunks: after the full cycle
+            # each chunk's dK/dV arrives back at its home device
+            dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+            return (dq, dk_acc, dv_acc, kb, vb), None
+
+        (dq, dk_acc, dv_acc, _, _), _ = jax.lax.scan(
+            step, (dq, dk_acc, dv_acc, kl, vl), jnp.arange(n))
+        return (dq.astype(ql.dtype), dk_acc.astype(kl.dtype),
+                dv_acc.astype(vl.dtype))
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
                    causal: bool = True, sm_scale=None,
                    batch_axis=None, head_axis=None):
@@ -56,47 +204,55 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
     name mesh axes the batch/head dims are sharded over (composing context
     parallelism with data and tensor parallelism in one shard_map).
     Returns same-shape output."""
+    from ..ops.pallas.flash_attention import flash_eligible
+
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     n = mesh.shape[axis]
     b_ax = batch_axis if batch_axis in mesh.axis_names else None
     h_ax = head_axis if head_axis in mesh.axis_names else None
+    Sloc = q.shape[2] // max(1, n)
+    use_flash = (q.shape[2] % max(1, n) == 0
+                 and flash_eligible(Sloc, q.shape[-1], q.dtype))
 
-    def spmd(ql, kl, vl):
-        # local chunks: (B,H,S/n,D)
-        my = jax.lax.axis_index(axis)
-        ql32 = ql.astype(jnp.float32) * sm_scale
-        Sq = ql.shape[2]
+    if use_flash:
+        spmd = _ring_flash_local(axis, n, causal, sm_scale)
+    else:
+        def spmd(ql, kl, vl):
+            # dense fallback engine (exact f32 oracle; O(Sq*Sk) scores)
+            my = jax.lax.axis_index(axis)
+            ql32 = ql.astype(jnp.float32) * sm_scale
+            Sq = ql.shape[2]
 
-        m = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
-        l = jnp.zeros(ql.shape[:3], jnp.float32)
-        acc = jnp.zeros(ql32.shape, jnp.float32)
+            m = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+            l = jnp.zeros(ql.shape[:3], jnp.float32)
+            acc = jnp.zeros(ql32.shape, jnp.float32)
 
-        def step(carry, i):
-            m, l, acc, kb, vb = carry
-            src_chunk = (my - i) % n  # whose KV we hold at step i
-            if causal:
-                full = src_chunk < my
-                diag = src_chunk == my
-                tri = jnp.tril(jnp.ones((Sq, kb.shape[2]), bool))
-                mask = jnp.where(diag, tri, full)
-            else:
-                mask = jnp.ones((Sq, kb.shape[2]), bool)
-            bm, bl, bacc = _block_attn(ql32, kb, vb, mask)
-            m_new = jnp.maximum(m, bm)
-            alpha = jnp.exp(m - m_new)
-            beta = jnp.exp(bm - m_new)
-            l_new = alpha * l + beta * bl
-            acc_new = acc * alpha[..., None] + bacc * beta[..., None]
-            perm = [(j, (j + 1) % n) for j in range(n)]
-            kb = jax.lax.ppermute(kb, axis, perm)
-            vb = jax.lax.ppermute(vb, axis, perm)
-            return (m_new, l_new, acc_new, kb, vb), None
+            def step(carry, i):
+                m, l, acc, kb, vb = carry
+                src_chunk = (my - i) % n  # whose KV we hold at step i
+                if causal:
+                    full = src_chunk < my
+                    diag = src_chunk == my
+                    tri = jnp.tril(jnp.ones((Sq, kb.shape[2]), bool))
+                    mask = jnp.where(diag, tri, full)
+                else:
+                    mask = jnp.ones((Sq, kb.shape[2]), bool)
+                bm, bl, bacc = _block_attn(ql32, kb, vb, mask)
+                m_new = jnp.maximum(m, bm)
+                alpha = jnp.exp(m - m_new)
+                beta = jnp.exp(bm - m_new)
+                l_new = alpha * l + beta * bl
+                acc_new = acc * alpha[..., None] + bacc * beta[..., None]
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                kb = jax.lax.ppermute(kb, axis, perm)
+                vb = jax.lax.ppermute(vb, axis, perm)
+                return (m_new, l_new, acc_new, kb, vb), None
 
-        (m, l, acc, _, _), _ = jax.lax.scan(
-            step, (m, l, acc, kl, vl), jnp.arange(n))
-        l = jnp.where(l == 0.0, 1.0, l)
-        return (acc / l[..., None]).astype(q.dtype)
+            (m, l, acc, _, _), _ = jax.lax.scan(
+                step, (m, l, acc, kl, vl), jnp.arange(n))
+            l = jnp.where(l == 0.0, 1.0, l)
+            return (acc / l[..., None]).astype(q.dtype)
 
     spec = P(b_ax, h_ax, axis, None)
     fn = jax.shard_map(

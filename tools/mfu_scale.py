@@ -31,8 +31,21 @@ sys.path.insert(0, "/root/repo")
 
 PEAK = 197e12  # v5e bf16
 
+# ladder rungs: (layers, hidden, inter, heads, kv) descending ~2.4B ->
+# ~1.0B; GQA kv=4 keeps the KV projections from dominating the HBM
+# budget. Window-2 chip fact: every rung >= 1.5B at B=4 OOMs in HLO
+# temps (bf16 params+moments alone are ~9.3 GB at 1.5B; grads +
+# fused-CE temps push past 15.75 GB), so the ladder descends far enough
+# to bracket the true in-HBM frontier instead of reporting only OOMs.
+LADDER = [(32, 2560, 6912, 20, 4),   # ~2.36B
+          (26, 2560, 6912, 20, 4),   # ~1.95B
+          (20, 2560, 6912, 20, 4),   # ~1.54B
+          (16, 2560, 6912, 20, 4),   # ~1.26B
+          (24, 2048, 5504, 16, 4),   # ~1.19B
+          (12, 2560, 6912, 20, 4)]   # ~0.99B
 
-def run_ladder():
+
+def run_ladder(only: int | None = None, B_override: int | None = None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -43,21 +56,10 @@ def run_ladder():
     from paddle_tpu.models.nlp.llama import llama_train_step_factory
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    # (layers, hidden, inter, heads, kv) descending ~2.4B -> ~1.0B; GQA
-    # kv=4 keeps the KV projections from dominating the HBM budget.
-    # Window-2 chip fact: every rung >= 1.5B at B=4 OOMs in HLO temps
-    # (bf16 params+moments alone are ~9.3 GB at 1.5B; grads + fused-CE
-    # temps push past 15.75 GB), so the ladder now descends far enough
-    # to bracket the true in-HBM frontier instead of reporting only OOMs.
-    ladder = [(32, 2560, 6912, 20, 4),   # ~2.36B
-              (26, 2560, 6912, 20, 4),   # ~1.95B
-              (20, 2560, 6912, 20, 4),   # ~1.54B
-              (16, 2560, 6912, 20, 4),   # ~1.26B
-              (24, 2048, 5504, 16, 4),   # ~1.19B
-              (12, 2560, 6912, 20, 4)]   # ~0.99B
-    if not on_tpu:
-        ladder = [(2, 64, 128, 4, 2)]
+    ladder = list(LADDER) if on_tpu else [(2, 64, 128, 4, 2)]
     B, S = (4, 2048) if on_tpu else (1, 128)
+    if B_override is not None:
+        B = B_override
 
     def try_rung(L, h, inter, heads, kv):
         # all device buffers (params/moments/compiled step) are locals of
@@ -102,6 +104,8 @@ def run_ladder():
                 "device": str(jax.devices()[0])}
 
     import gc
+    if only is not None:
+        ladder = ladder[only:only + 1]
     for L, h, inter, heads, kv in ladder:
         try:
             print(json.dumps(try_rung(L, h, inter, heads, kv)), flush=True)
@@ -112,6 +116,47 @@ def run_ladder():
             print(json.dumps({"mode": "ladder", "layers": L, "hidden": h,
                               "oom": oom, "error": msg[-200:]}), flush=True)
             gc.collect()
+
+
+def run_ladder_subproc():
+    """Window-2 chip fact: after one rung OOMs, every later rung in the
+    SAME process reports RESOURCE_EXHAUSTED even at sizes that fit cold
+    (device memory from the failed attempt is not reclaimed by the
+    runtime). So the driver mode runs each rung in a fresh subprocess
+    (fresh TPU client, clean HBM) and stops at the first success."""
+    import subprocess
+    for idx in range(len(LADDER)):
+        # B=4 for MFU quality; a B=2 retry probes whether the rung fits
+        # at all (the frontier is 2-D in (params, batch)). Both in fresh
+        # subprocesses: an OOM poisons the TPU client's HBM accounting
+        # for the rest of its process (window-2 chip fact).
+        for B in (4, 2):
+            try:
+                r = subprocess.run(
+                    [sys.executable, __file__, "ladder_rung", str(idx),
+                     str(B)],
+                    capture_output=True, text=True, timeout=900)
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"mode": "ladder", "rung": idx, "B": B,
+                                  "error": "timeout after 900s"}),
+                      flush=True)
+                continue
+            wrote = False
+            fit = False
+            for line in r.stdout.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    wrote = True
+                    try:
+                        fit = fit or "step_ms" in json.loads(line)
+                    except ValueError:
+                        pass
+            if not wrote:
+                print(json.dumps({"mode": "ladder", "rung": idx, "B": B,
+                                  "error": (r.stderr or "")[-200:]}),
+                      flush=True)
+            if fit:
+                return  # largest fitting config measured
 
 
 def run_tp_shard():
@@ -223,8 +268,12 @@ def run_tp_shard():
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "ladder"
     if mode == "ladder":
-        run_ladder()
+        run_ladder_subproc()
+    elif mode == "ladder_rung":
+        run_ladder(only=int(sys.argv[2]),
+                   B_override=int(sys.argv[3]) if len(sys.argv) > 3
+                   else None)
     elif mode == "tp_shard":
         run_tp_shard()
     else:
-        raise SystemExit("mode: ladder | tp_shard")
+        raise SystemExit("mode: ladder | ladder_rung <i> | tp_shard")

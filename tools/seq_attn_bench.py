@@ -126,10 +126,10 @@ def main():
                   "ms": round(flash_ms, 3), "compile_s": comp})
 
         if tag in ("long", "xlong"):
-            # auto (fwd resident + streamed bwd past the frontier; at
-            # xlong the auto causal route is splash-tril) vs forced
-            # plain streaming at the same shape — at xlong this is the
-            # head-to-head that decides CAUSAL_STREAM_VIA_SPLASH
+            # auto resolution (fwd resident + streamed bwd at 8k; fully
+            # streamed at 16k — splash-tril routing is OFF after losing
+            # this head-to-head 97.4 vs 48.3 ms) vs forced full
+            # streaming at the same shape
             r = bench_or_record(tag, "flash_streamed",
                                 lambda a, b, c: flash_attention(
                                     a, b, c, True, None, None, None, None,
