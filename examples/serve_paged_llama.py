@@ -28,7 +28,7 @@ def main():
     model = LlamaForCausalLM(LlamaConfig.tiny(vocab=96, hidden=32,
                                               layers=2, heads=4,
                                               kv_heads=2))
-    outer, layers, pools, prefill, decode = llama_paged_decode_factory(
+    outer, layers, pools, prefill, decode, _ = llama_paged_decode_factory(
         model, page_size=PS, n_pool_pages=POOL)
     book = PagedKVCache(POOL, PS, kv_heads=2, head_dim=8,
                         dtype=jnp.float32)

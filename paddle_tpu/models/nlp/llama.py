@@ -252,12 +252,14 @@ class LlamaAttention(nn.Layer):
                     # groups exceed the kernel's VMEM score budget and
                     # fall through to the repeat path below)
                     from ...ops.pallas.splash_attention import (
-                        banded_block_mask, grouped_splash_attention)
-                    bm = banded_block_mask(S, S, 128, 128, window)
+                        banded_block_mask, grouped_splash_attention,
+                        pick_splash_blocks)
+                    sbq, sbk = pick_splash_blocks(S, S, n_rep)
+                    bm = banded_block_mask(S, S, sbq, sbk, window)
                     tp_mesh, tp_axis = _tensor_parallel_mesh()
                     out = _shard_map_heads(
                         lambda q, k, v: grouped_splash_attention(
-                            q, k, v, bm, True, scale, 128, 128, window),
+                            q, k, v, bm, True, scale, sbq, sbk, window),
                         tp_mesh, tp_axis or "model",
                         jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kv, 1, 2),
                         jnp.swapaxes(vv, 1, 2))
@@ -272,12 +274,14 @@ class LlamaAttention(nn.Layer):
                 if _flash_eligible(S, qt.shape[-1], qt.dtype):
                     # banded splash: compute scales with window/S
                     from ...ops.pallas.splash_attention import (
-                        banded_block_mask, splash_attention)
-                    bm = banded_block_mask(S, S, 128, 128, window)
+                        banded_block_mask, pick_splash_blocks,
+                        splash_attention)
+                    sbq, sbk = pick_splash_blocks(S, S)
+                    bm = banded_block_mask(S, S, sbq, sbk, window)
                     tp_mesh, tp_axis = _tensor_parallel_mesh()
                     out = _shard_map_heads(
                         lambda q, k, v: splash_attention(
-                            q, k, v, bm, True, scale, 128, 128, window),
+                            q, k, v, bm, True, scale, sbq, sbk, window),
                         tp_mesh, tp_axis or "model", qt, kt, vt)
                     return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
                 out = _dense_attention_tail(qt, kt, vt, scale, window)

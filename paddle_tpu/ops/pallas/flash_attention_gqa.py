@@ -50,8 +50,8 @@ def _gqa_fits(rows, bk, Sk, D, itemsize):
 
 class ResidentOverflowError(ValueError):
     """No reachable block pair fits resident K/V in scoped VMEM —
-    grouped_flash_attention auto-delegates to splash streaming on this,
-    other ValueErrors (bad shapes etc.) propagate."""
+    grouped_flash_attention auto-delegates to coarse-tile splash
+    streaming on this, other ValueErrors (bad shapes etc.) propagate."""
 
 
 def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k, D=128, itemsize=2):
@@ -100,8 +100,8 @@ def _gqa_resolve_blocks(Sq, Sk, G, block_q, block_k, D=128, itemsize=2):
         # sublane alignment short of a fitting pair — both end in an
         # opaque Mosaic compile failure, so raise the typed error here.
         # grouped_flash_attention's public entry catches it and
-        # delegates to the K/V-streaming splash kernels; direct core
-        # callers see the message below.
+        # delegates to the coarse-tile K/V-streaming splash kernels;
+        # direct core callers see the message below.
         raise ResidentOverflowError(
             f"grouped_flash_attention: resident K/V at Sk={Sk} "
             f"(D={D}, {itemsize}B) cannot fit the 16M scoped-VMEM "
@@ -323,9 +323,13 @@ def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
     flash_attention over jnp.repeat(k/v, G, axis=1) without the repeat.
 
     Past the resident-K/V VMEM frontier (auto blocks only) the call
-    delegates to the K/V-STREAMING splash kernels with a full causal (or
-    dense) block mask — same grouped math, O(block) VMEM at any S — so
-    GQA long-context works on one chip instead of failing to compile."""
+    delegates to the K/V-STREAMING splash kernels at the true kv-head
+    count with coarse (pick_splash_blocks) tiles — so GQA long-context
+    works on one chip instead of failing to compile. Block size decides
+    this race: at the round-3 128-tiles splash lost to repeat+flash
+    (46.2 vs 34.0 ms at S=16384/G=4), at 512-tiles it wins while moving
+    G x less K/V (28.2 vs 34.0 ms; 18.6 vs 20.0 at S=8192 —
+    tools/gqa_xlong_bench.py, 2026-08-01)."""
     G = q.shape[1] // max(1, k.shape[1])
     if block_q is None and block_k is None:
         try:
@@ -336,29 +340,11 @@ def grouped_flash_attention(q, k, v, causal=False, sm_scale=None,
             # would otherwise re-run the identical resolution
             return _grouped_flash_core(q, k, v, causal, sm_scale, bq, bk)
         except ResidentOverflowError:
-            from .splash_attention import (fits_score_budget,
-                                           splash_attention)
             import numpy as _np
-            # group-aware splash blocks: splash's _resolve enforces the
-            # (G*bq, bk) score and row budgets, so shrink until they
-            # hold (Llama-3 G=4 at bq=bk=512 would otherwise be
-            # REJECTED by splash — the exact config delegation is for)
-            cap = max(128, 1024 // G)
-            for cand in (512, 256, 128):
-                if cand <= cap and q.shape[2] % cand == 0:
-                    bq = cand
-                    break
-            else:
-                # no 128-multiple divides Sq: the divisor search yields
-                # <=128, always under the row cap
-                bq = _pick_block(q.shape[2])
-            bk = _pick_block(k.shape[2])
-            while not fits_score_budget(G, bq, bk) and bk > 128:
-                bk //= 2
-            while not fits_score_budget(G, bq, bk) and bq > 8 \
-                    and (bq // 2) % 8 == 0 \
-                    and q.shape[2] % (bq // 2) == 0:
-                bq //= 2
+
+            from .splash_attention import (pick_splash_blocks,
+                                           splash_attention)
+            bq, bk = pick_splash_blocks(q.shape[2], k.shape[2], G)
             nq, nk = q.shape[2] // bq, k.shape[2] // bk
             # full causal = lower-triangular block mask (the token-exact
             # triangle applies in-kernel); non-causal or mismatched

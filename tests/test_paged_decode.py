@@ -23,7 +23,7 @@ def test_paged_decode_matches_eager_ragged():
     cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
                            kv_heads=2)
     model = LlamaForCausalLM(cfg)
-    outer, layers, pools, prefill, decode_step = \
+    outer, layers, pools, prefill, decode_step, _ = \
         llama_paged_decode_factory(model, page_size=PS, n_pool_pages=16)
 
     rng = np.random.default_rng(0)
@@ -66,7 +66,7 @@ def test_paged_decode_crosses_page_boundary():
     cfg = LlamaConfig.tiny(vocab=32, hidden=32, layers=1, heads=2,
                            kv_heads=1)
     model = LlamaForCausalLM(cfg)
-    outer, layers, pools, prefill, decode_step = \
+    outer, layers, pools, prefill, decode_step, _ = \
         llama_paged_decode_factory(model, page_size=PS, n_pool_pages=8)
     prompt = list(range(1, PS))  # length 7: boundary hits mid-decode
     book = PagedKVCache(n_pages=8, page_size=PS, kv_heads=1, head_dim=16)
@@ -97,13 +97,13 @@ def test_chunked_prefill_matches_oneshot():
     model = LlamaForCausalLM(cfg)
     from paddle_tpu.models.nlp.llama_decode import (
         llama_paged_decode_factory as factory)
-    o1, l1, pools1, prefill1, decode1 = factory(model, page_size=PS,
+    o1, l1, pools1, prefill1, decode1, *_ = factory(model, page_size=PS,
                                                 n_pool_pages=16)
-    o2, l2, pools2, prefill2, decode2 = factory(model, page_size=PS,
+    o2, l2, pools2, prefill2, decode2, *_ = factory(model, page_size=PS,
                                                 n_pool_pages=16,
                                                 chunked_prefill=PS)
     # chunk = 2 pages: exercises the multi-page scatter (npg > 1)
-    o3, l3, pools3, prefill3, decode3 = factory(model, page_size=PS,
+    o3, l3, pools3, prefill3, decode3, *_ = factory(model, page_size=PS,
                                                 n_pool_pages=16,
                                                 chunked_prefill=2 * PS)
 
@@ -145,8 +145,8 @@ def test_int8_pool_decode_close_to_fp():
     from paddle_tpu.models.nlp.llama_decode import (
         llama_paged_decode_factory as factory)
     mk = lambda **kw: factory(model, page_size=PS, n_pool_pages=16, **kw)
-    o1, l1, pools_f, pre_f, dec_f = mk()
-    o2, l2, pools_q, pre_q, dec_q = mk(kv_cache_dtype="int8")
+    o1, l1, pools_f, pre_f, dec_f, *_ = mk()
+    o2, l2, pools_q, pre_q, dec_q, *_ = mk(kv_cache_dtype="int8")
     assert pools_q[0][0].dtype == jnp.int8
 
     rng = np.random.default_rng(4)
@@ -183,9 +183,9 @@ def test_emit_logits_mode():
     model = LlamaForCausalLM(cfg)
     from paddle_tpu.models.nlp.llama_decode import (
         llama_paged_decode_factory as factory)
-    o1, l1, p1, pre_t, dec_t = factory(model, page_size=PS,
+    o1, l1, p1, pre_t, dec_t, *_ = factory(model, page_size=PS,
                                        n_pool_pages=16)
-    o2, l2, p2, pre_l, dec_l = factory(model, page_size=PS,
+    o2, l2, p2, pre_l, dec_l, *_ = factory(model, page_size=PS,
                                        n_pool_pages=16, emit="logits")
 
     rng = np.random.default_rng(6)
@@ -223,8 +223,8 @@ def test_prefill_kernel_mode_matches_gather():
                                 chunked_prefill=PS,
                                 kv_cache_dtype=kv_dtype,
                                 prefill_attention=pa)
-        o1, l1, p1, pre_g, dec_g = mk("gather")
-        o2, l2, p2, pre_k, dec_k = mk("kernel")
+        o1, l1, p1, pre_g, dec_g, *_ = mk("gather")
+        o2, l2, p2, pre_k, dec_k, *_ = mk("kernel")
         rng = np.random.default_rng(8)
         toks = np.zeros((2, 2 * PS), np.int64)
         toks[0, :11] = rng.integers(1, 64, 11)
@@ -257,7 +257,7 @@ def test_prefix_cache_reuses_pages_and_skips_chunks():
     model = LlamaForCausalLM(cfg)
     from paddle_tpu.models.nlp.llama_decode import (
         llama_paged_decode_factory as factory)
-    o, l, pools, prefill, decode = factory(model, page_size=PS,
+    o, l, pools, prefill, decode, *_ = factory(model, page_size=PS,
                                            n_pool_pages=16,
                                            chunked_prefill=PS)
     rng = np.random.default_rng(10)
@@ -303,7 +303,7 @@ def test_prefix_cache_reuses_pages_and_skips_chunks():
     outB = run("B", promptB, resume=ncB)
 
     # oracle: B uncached in a fresh book/pools
-    o2, l2, pools2, prefill2, decode2 = factory(model, page_size=PS,
+    o2, l2, pools2, prefill2, decode2, *_ = factory(model, page_size=PS,
                                                 n_pool_pages=16,
                                                 chunked_prefill=PS)
     book2 = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2,
@@ -348,7 +348,7 @@ def test_fixed_shape_batching_never_recompiles():
     model = LlamaForCausalLM(cfg)
     from paddle_tpu.models.nlp.llama_decode import (
         llama_paged_decode_factory as factory)
-    o, l, pools, prefill, decode = factory(model, page_size=PS,
+    o, l, pools, prefill, decode, *_ = factory(model, page_size=PS,
                                            n_pool_pages=8)
     B, W = 2, 2
     toks = jnp.asarray(np.ones((B, PS), np.int64))
@@ -366,3 +366,105 @@ def test_fixed_shape_batching_never_recompiles():
         out, pools = decode(o, l, tok, ptx, lnx, pools)
         assert np.isfinite(np.asarray(out)).all() or True  # int tokens
     assert decode._cache_size() == 1, decode._cache_size()
+
+
+def test_decode_n_matches_per_step_loop():
+    """The factory's scan-amortized decode_n (n steps in ONE compiled
+    program — the serving loop's dispatch amortizer) must emit exactly
+    the per-step decode_step tokens and leave identical pools."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    outer, layers, pools, prefill, decode_step, decode_n = \
+        llama_paged_decode_factory(model, page_size=PS, n_pool_pages=16)
+
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 64, 5).tolist(),
+               rng.integers(1, 64, 3).tolist()]
+    lengths = np.asarray([len(p) for p in prompts], np.int32)
+    toks = np.zeros((2, PS), np.int64)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2, head_dim=8)
+    for i in range(2):
+        book.allocate(i, 3 * PS)
+    pt = jnp.asarray(np.stack([book.tables[0], book.tables[1]]),
+                     jnp.int32)
+
+    N = 5
+    nxt, pools = prefill(outer, layers, jnp.asarray(toks), pt,
+                         jnp.asarray(lengths), pools)
+
+    # per-step reference (fresh pools for the scan run: deep-copy now)
+    import jax
+    pools_scan = jax.tree.map(jnp.copy, pools)
+    ref_nxt, lens = nxt, jnp.asarray(lengths)
+    ref = []
+    pools_ref = pools
+    for _ in range(N):
+        ref_nxt, pools_ref = decode_step(outer, layers, ref_nxt, pt,
+                                         lens, pools_ref)
+        lens = lens + 1
+        ref.append(np.asarray(ref_nxt))
+    ref = np.stack(ref, 0)  # (N, B)
+
+    emits, last, pools_scan = decode_n(outer, layers, nxt, pt,
+                                       jnp.asarray(lengths), pools_scan,
+                                       N)
+    np.testing.assert_array_equal(np.asarray(emits), ref)
+    np.testing.assert_array_equal(np.asarray(last), ref[-1])
+    for a, b in zip(jax.tree.leaves(pools_scan),
+                    jax.tree.leaves(pools_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6)
+
+
+def test_decode_n_logits_mode_greedy_feedback():
+    """decode_n with emit="logits": per-step logits stack to (N, B, V),
+    the greedy-argmax feedback reproduces token-mode output, and an
+    int64 seed token (np.argmax default) doesn't break the scan carry."""
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, 64, 6).tolist()
+    lengths = jnp.asarray(np.asarray([len(prompt)], np.int32))
+    toks = np.zeros((1, PS), np.int64)
+    toks[0, :len(prompt)] = prompt
+
+    def fresh_table():
+        book = PagedKVCache(n_pages=16, page_size=PS, kv_heads=2,
+                            head_dim=8)
+        book.allocate(0, 3 * PS)
+        return jnp.asarray(np.stack([book.tables[0]]), jnp.int32)
+
+    # token mode reference
+    outer, layers, pools, prefill, _, decode_n = \
+        llama_paged_decode_factory(model, page_size=PS, n_pool_pages=16)
+    pt = fresh_table()
+    tok0, pools = prefill(outer, layers, jnp.asarray(toks), pt, lengths,
+                          pools)
+    tok0_np = np.asarray(tok0)
+    emits_t, last_t, _ = decode_n(outer, layers, tok0, pt, lengths,
+                                  pools, 4)
+
+    # logits mode: caller-side greedy, int64 seed on purpose
+    outer, layers, pools, prefill, _, decode_n = \
+        llama_paged_decode_factory(model, page_size=PS, n_pool_pages=16,
+                                   emit="logits")
+    pt = fresh_table()
+    logits0, pools = prefill(outer, layers, jnp.asarray(toks), pt,
+                             lengths, pools)
+    tok0_l = np.argmax(np.asarray(logits0), -1)
+    assert tok0_l.dtype == np.int64
+    np.testing.assert_array_equal(tok0_l.astype(np.int32), tok0_np)
+    emits_l, last_l, _ = decode_n(outer, layers, jnp.asarray(tok0_l),
+                                  pt, lengths, pools, 4)
+
+    assert np.asarray(emits_l).shape == (4, 1, 64)
+    np.testing.assert_array_equal(np.argmax(np.asarray(emits_l), -1),
+                                  np.asarray(emits_t))
+    np.testing.assert_array_equal(np.asarray(last_l),
+                                  np.asarray(last_t))

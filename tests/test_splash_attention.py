@@ -361,3 +361,32 @@ class TestStreamedSplash:
         assert not sp._resident_fits(512, 512, 16384, 128, 2)
         # the S=2048 bench shape stays resident (status-quo perf)
         assert sp._resident_fits(512, 512, 2048, 128, 2)
+
+
+class TestPickSplashBlocks:
+    """pick_splash_blocks: coarsest tiling the budgets allow (512-block
+    banded splash measured 3x the 128-block kernel on chip, PERF.md
+    round 4)."""
+
+    def test_mha_picks_512(self):
+        from paddle_tpu.ops.pallas.splash_attention import (
+            pick_splash_blocks)
+        assert pick_splash_blocks(8192, 8192, 1) == (512, 512)
+
+    def test_g4_shrinks_bk_for_score_budget(self):
+        from paddle_tpu.ops.pallas.splash_attention import (
+            SCORE_ELEMS, pick_splash_blocks)
+        bq, bk = pick_splash_blocks(8192, 8192, 4)
+        assert 4 * bq * bk <= SCORE_ELEMS
+        assert bq == 512  # rows 4*512=2048 still under the row cap
+
+    def test_mqa_g32_respects_row_cap(self):
+        from paddle_tpu.ops.pallas.splash_attention import (
+            MAX_ROWS, SCORE_ELEMS, pick_splash_blocks)
+        bq, bk = pick_splash_blocks(2048, 2048, 32)
+        assert 32 * bq <= MAX_ROWS and 32 * bq * bk <= SCORE_ELEMS
+
+    def test_odd_seq_falls_back(self):
+        from paddle_tpu.ops.pallas.splash_attention import (
+            pick_splash_blocks)
+        assert pick_splash_blocks(384, 384, 1) == (128, 128)

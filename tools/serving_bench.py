@@ -62,24 +62,37 @@ def main():
         rec["device"] = str(jax.devices()[0])
         print(json.dumps(rec), flush=True)
 
-    # 1. dense decode (the ladder baseline, re-measured side by side)
+    # 1. dense decode (the ladder baseline, re-measured side by side).
+    # gen() runs prefill + decode inside one call; the paged rows below
+    # time decode ONLY — so the decode-only dense time is isolated by
+    # differencing a full run against a 1-token run (both warmed).
     gen = llama_decode_factory(model, max_len=prompt_len + new)
     out = gen(jnp.asarray(prompt), max_new_tokens=new)
-    _ = np.asarray(out)          # host readback sync
+    _ = np.asarray(out)          # host readback sync (and compile)
+    _ = np.asarray(gen(jnp.asarray(prompt), max_new_tokens=1))
     reps = 3 if on_tpu else 1
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = gen(jnp.asarray(prompt), max_new_tokens=new)
-    _ = np.asarray(out)
-    dense_dt = (time.perf_counter() - t0) / reps
+
+    def timed(n_tok):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            o = gen(jnp.asarray(prompt), max_new_tokens=n_tok)
+        _ = np.asarray(o)
+        return (time.perf_counter() - t0) / reps
+
+    dense_full_dt = timed(new)
+    dense_one_dt = timed(1)
+    dense_dt = dense_full_dt - dense_one_dt  # decode-only, new-1 steps
+    dense_per_tok = dense_dt / max(1, new - 1)
     emit({"bench": "dense_decode", "B": B, "new": new,
-          "tokens_per_sec": round(B * new / dense_dt, 1)})
+          "tokens_per_sec": round(B * new / dense_full_dt, 1),
+          "decode_only_tokens_per_sec": round(B / dense_per_tok, 1),
+          "prefill_plus_1_s": round(dense_one_dt, 3)})
 
     # 2. paged decode at the same shape (fp + int8 pools)
     npages_seq = -(-(prompt_len + new) // ps)
     pool_pages = B * npages_seq + 2
     for kv_dtype in (None, "int8"):
-        o, l, pools, prefill, step = llama_paged_decode_factory(
+        o, l, pools, prefill, step, decode_n = llama_paged_decode_factory(
             model, page_size=ps, n_pool_pages=pool_pages,
             kv_cache_dtype=kv_dtype)
         book = PagedKVCache(pool_pages, ps,
@@ -93,19 +106,49 @@ def main():
         toks = np.zeros((B, T), np.int64)
         toks[:, :prompt_len] = prompt
         nxt, pools = prefill(o, l, jnp.asarray(toks), pt, lens, pools)
+
+        # (a) scan-amortized: all `new` steps inside ONE jit — the
+        # factory's decode_n — measures the kernels. The per-step python
+        # loop below measures the axon tunnel's ~8-10ms dispatch floor x
+        # `new`, an artifact of this test rig (a production host
+        # dispatches in ~100us), so the amortized row is the recordable
+        # number. decode_n donates its pools arg: thread the returned
+        # pools forward.
+        _, nxt2, pools = decode_n(o, l, nxt, pt, lens, pools, new)
+        _ = np.asarray(nxt2)
         t0 = time.perf_counter()
-        cur = lens
-        for _ in range(new):
+        _, nxt2, pools = decode_n(o, l, nxt, pt, lens, pools, new)
+        _ = np.asarray(nxt2)
+        dt_amort = time.perf_counter() - t0
+        # vs dense DECODE-ONLY per-token time (prefill excluded on both
+        # sides — the window-2 row compared against prefill+decode and
+        # overstated the paged win)
+        vs_dense = (dense_per_tok * new) / dt_amort
+        emit({"bench": f"paged_decode_{kv_dtype or 'fp'}_amortized",
+              "B": B, "new": new, "page_size": ps,
+              "tokens_per_sec": round(B * new / dt_amort, 1),
+              "vs_dense_decode_only": round(vs_dense, 3)})
+
+        # (b) per-step loop (tunnel dispatch floor dominated; kept to
+        # quantify that floor next to the amortized number). decode_n's
+        # trace does NOT warm decode_step's own jit cache — warm one
+        # step first or its compile lands in dispatch_floor_ms.
+        nxt, pools = step(o, l, nxt, pt, lens, pools)
+        cur = lens + 1
+        _ = np.asarray(nxt)
+        t0 = time.perf_counter()
+        for _ in range(new - 1):
             nxt, pools = step(o, l, nxt, pt, cur, pools)
             cur = cur + 1
         _ = np.asarray(nxt)
-        dt = time.perf_counter() - t0
-        emit({"bench": f"paged_decode_{kv_dtype or 'fp'}", "B": B,
+        dt = (time.perf_counter() - t0) / max(1, new - 1)
+        emit({"bench": f"paged_decode_{kv_dtype or 'fp'}_per_step", "B": B,
               "new": new, "page_size": ps,
-              "tokens_per_sec": round(B * new / dt, 1),
-              # dense row includes its prefill inside gen(); this row is
-              # decode-only — compare tokens/sec with that caveat
-              "vs_dense_gen": round(dense_dt / dt, 3)})
+              "tokens_per_sec": round(B / dt, 1),
+              "dispatch_floor_ms": round(
+                  (dt - dt_amort / new) * 1e3, 2),
+              "vs_dense_decode_only": round(
+                  dense_per_tok / dt, 3)})
 
     # 3. speculative vs plain at equal (greedy) output, B=1
     draft_cfg = LlamaConfig(
@@ -121,8 +164,6 @@ def main():
     draft.eval()
     if on_tpu:
         draft.to(dtype="bfloat16")
-    spec = llama_speculative_decode_factory(
-        model, draft, max_len=prompt_len + new + 8, n_draft=4)
     p1 = prompt[:1]
     out_plain = gen(jnp.asarray(p1), max_new_tokens=new)
     _ = np.asarray(out_plain)
@@ -130,17 +171,29 @@ def main():
     out_plain = gen(jnp.asarray(p1), max_new_tokens=new)
     _ = np.asarray(out_plain)
     plain_dt = time.perf_counter() - t0
-    out_spec = np.asarray(spec(p1, max_new_tokens=new))  # warm
-    t0 = time.perf_counter()
-    out_spec = np.asarray(spec(p1, max_new_tokens=new))
-    spec_dt = time.perf_counter() - t0
-    match = bool((out_spec[:, :out_plain.shape[1]]
-                  == np.asarray(out_plain)).all())
-    emit({"bench": "speculative_vs_plain", "new": new,
-          "plain_s": round(plain_dt, 3), "spec_s": round(spec_dt, 3),
-          "speedup": round(plain_dt / spec_dt, 2),
-          "output_identical": match,
-          "stats": getattr(spec, "last_stats", {})})
+
+    # Two drafts bracket the speculative mechanism: draft == target
+    # gives 100% acceptance (the mechanical upper bound — what the
+    # machinery costs when proposals are perfect), while the RANDOMLY
+    # INITIALIZED half-size draft is the adversarial lower bound (~0
+    # acceptance: untrained draft and target agree almost never, so
+    # every round pays draft+verify for one emitted token — a
+    # measurement artifact of random weights, not the mechanism;
+    # trained draft/target pairs sit between the brackets).
+    for tag, d in (("draft=target", model), ("random_half_draft", draft)):
+        spec = llama_speculative_decode_factory(
+            model, d, max_len=prompt_len + new + 8, n_draft=4)
+        out_spec = np.asarray(spec(p1, max_new_tokens=new))  # warm
+        t0 = time.perf_counter()
+        out_spec = np.asarray(spec(p1, max_new_tokens=new))
+        spec_dt = time.perf_counter() - t0
+        match = bool((out_spec[:, :out_plain.shape[1]]
+                      == np.asarray(out_plain)).all())
+        emit({"bench": f"speculative_vs_plain[{tag}]", "new": new,
+              "plain_s": round(plain_dt, 3), "spec_s": round(spec_dt, 3),
+              "speedup": round(plain_dt / spec_dt, 2),
+              "output_identical": match,
+              "stats": getattr(spec, "last_stats", {})})
 
 
 if __name__ == "__main__":
