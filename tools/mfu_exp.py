@@ -16,7 +16,7 @@ import numpy as np
 
 def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
                 accum_dtype="float32", B=8, S=2048, vocab=32000,
-                chunked_ce=None):
+                chunked_ce=None, window=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -37,6 +37,10 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
         # dense (B*S, V) logits at V=128k would be ~4.2 GB bf16 plus
         # round trips)
         cfg.tie_word_embeddings = True
+    if window is not None:
+        # Mistral-style sliding window: routes through the banded
+        # splash kernel at pick_splash_blocks coarse tiles
+        cfg.sliding_window = window
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.to(dtype="bfloat16")
@@ -67,20 +71,25 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
     if dt <= 0:
         dt = t_big / steps
     tok = B * S
-    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * S * tok
+    # windowed attention computes <= W keys per query (the standard
+    # 12*L*h*S*tok causal term becomes 12*L*h*W*tok; slight overcount
+    # of the ramp-up rows, so windowed MFU is a lower bound)
+    s_eff = min(S, window) if window else S
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * s_eff * tok
     flops = 6 * n_params * tok + attn_flops
     mfu = (flops / dt) / 197e12
     return {"fused": fused, "kv_heads": kv_heads,
             "accum_dtype": accum_dtype, "batch": B, "seq": S,
             "vocab": vocab, "chunked_ce": chunked_ce,
             "params": n_params, "step_ms": round(dt * 1000, 2),
+            "window": window,
             "mfu": round(mfu, 4), "loss": loss}
 
 
 if __name__ == "__main__":
     variant = sys.argv[1] if len(sys.argv) > 1 else "unfused"
     known = {"fused", "unfused", "gqa", "bf16moments", "long8k",
-             "bigvocab"}
+             "bigvocab", "window8k"}
     if variant not in known:
         raise SystemExit(
             f"unknown variant {variant!r}: expected one of {sorted(known)}")
@@ -88,7 +97,8 @@ if __name__ == "__main__":
         variant == "fused",
         kv_heads=4 if variant == "gqa" else 12,
         accum_dtype="bfloat16" if variant == "bf16moments" else "float32",
-        B=2 if variant == "long8k" else 8,
-        S=8192 if variant == "long8k" else 2048,
+        B=2 if variant in ("long8k", "window8k") else 8,
+        S=8192 if variant in ("long8k", "window8k") else 2048,
         vocab=128256 if variant == "bigvocab" else 32000,
-        chunked_ce=16032 if variant == "bigvocab" else None)))
+        chunked_ce=16032 if variant == "bigvocab" else None,
+        window=2048 if variant == "window8k" else None)))

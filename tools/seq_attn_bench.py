@@ -162,17 +162,23 @@ def main():
         else:
             windows = (2048,)
 
+        from paddle_tpu.ops.pallas.splash_attention import \
+            pick_splash_blocks
         for w in windows:
-            bm = banded_block_mask(S, S, 128, 128, w)
+            # coarse tiles, as the model's sliding-window path picks
+            # them (512-tile banded splash measured 3x the 128-tile
+            # kernel — PERF.md round 4)
+            sbq, sbk = pick_splash_blocks(S, S)
+            bm = banded_block_mask(S, S, sbq, sbk, w)
             density = round(float(bm.mean()), 3)
             r = bench_or_record(tag, f"splash_w{w}",
                                 lambda a, b, c, bm=bm, w=w: splash_attention(
-                                    a, b, c, bm, True, None, 128, 128, w),
-                                q, k, v, density=density)
+                                    a, b, c, bm, True, None, sbq, sbk, w),
+                                q, k, v, density=density, blocks=sbq)
             if r:
                 ms, comp = r
                 emit({"shape": tag, "variant": f"splash_w{w}", "S": S,
-                      "B": B, "density": density,
+                      "B": B, "density": density, "blocks": sbq,
                       "ms": round(ms, 3), "compile_s": comp,
                       "frac_of_flash": frac(ms, flash_ms)})
 
@@ -181,10 +187,11 @@ def main():
             # shape: table streaming skips dead-block DMA (tril halves
             # it), flash streaming DMAs every block — the winner should
             # own the long-S causal auto route
-            bm = np.tril(np.ones((S // 128, S // 128), bool))
+            sbq, sbk = pick_splash_blocks(S, S)
+            bm = np.tril(np.ones((S // sbq, S // sbk), bool))
             r = bench_or_record(tag, "splash_tril_full",
                                 lambda a, b, c, bm=bm: splash_attention(
-                                    a, b, c, bm, True, None, 128, 128),
+                                    a, b, c, bm, True, None, sbq, sbk),
                                 q, k, v)
             if r:
                 ms, comp = r
