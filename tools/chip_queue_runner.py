@@ -24,23 +24,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # 50-minute flash_bwd_sweep runs late so a short window isn't spent
 # entirely inside it. Items already recorded in CHIP_QUEUE_RESULTS.jsonl
 # (headline/gqa/bf16moments/decode) are done and dropped.
+# Round-4 windows 2-3 cleared the full round-3 queue (PERF.md carries
+# the analysis; CHIP_QUEUE_RESULTS.jsonl the raw rows). The standing
+# queue is now the regression sweep worth re-running in any fresh
+# tunnel window: kernel numerics on real Mosaic, the long-context and
+# windowed model points, the sequence-parallel family at current
+# routing, and a headline refresh stamping HEAD.
 QUEUE = [
-    ("long8k_vmem_repro",
-     [sys.executable, "tools/long8k_vmem_repro.py"], {}),
-    ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
-    ("bigvocab", [sys.executable, "tools/mfu_exp.py", "bigvocab"], {}),
-    ("seq_attn_bench", [sys.executable, "tools/seq_attn_bench.py"], {}),
-    ("mfu_scale_ladder", [sys.executable, "tools/mfu_scale.py", "ladder"],
-     {}),
-    ("mfu_scale_tp_shard",
-     [sys.executable, "tools/mfu_scale.py", "tp_shard"], {}),
     ("kernel_chip_check",
      [sys.executable, "tools/kernel_chip_check.py"], {}),
+    ("long8k", [sys.executable, "tools/mfu_exp.py", "long8k"], {}),
+    ("window8k", [sys.executable, "tools/mfu_exp.py", "window8k"], {}),
+    ("seq_attn_bench", [sys.executable, "tools/seq_attn_bench.py"], {}),
+    ("gqa_xlong_ab", [sys.executable, "tools/gqa_xlong_bench.py"], {}),
     ("serving_bench",
      [sys.executable, "tools/serving_bench.py"], {}),
-    ("vit_train", [sys.executable, "tools/ladder_bench.py", "7"], {}),
-    ("moe_train", [sys.executable, "tools/ladder_bench.py", "8"], {}),
-    ("flash_bwd_sweep", [sys.executable, "tools/flash_bwd_sweep.py"], {}),
     # refresh the headline last so PERF_LAST_TPU.json stamps this HEAD
     ("headline_bench", [sys.executable, "bench.py"], {}),
 ]
