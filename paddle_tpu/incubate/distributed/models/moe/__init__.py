@@ -91,6 +91,25 @@ def top2_gating(logits, capacity, noise_key=None):
     return jnp.maximum(d1, d2), c1 + c2, aux
 
 
+def _topk_picks(probs, k):
+    """Shared pick loop: argmax k times with chosen experts masked out.
+    Returns ([(expert_ids, probs, one_hot)] * k, aux_loss) — both the
+    dense gating family and the index-form gate build on this, so the
+    production (indexed) path and its dense oracle stay structurally in
+    sync."""
+    E = probs.shape[1]
+    remaining = probs
+    picks = []
+    for _ in range(k):
+        g = jnp.argmax(remaining, -1)
+        p = jnp.max(remaining, -1)
+        oh = jax.nn.one_hot(g, E, dtype=jnp.float32)
+        remaining = remaining * (1 - oh)
+        picks.append((g, p, oh))
+    aux = E * jnp.sum(jnp.mean(picks[0][2], 0) * jnp.mean(probs, 0))
+    return picks, aux
+
+
 def topk_gating(logits, capacity, k):
     """Generalized GShard-style top-k gate (k >= 2): the fine-grained
     DeepSeek/Qwen routing regimes use top-4/top-8 over many small
@@ -100,16 +119,8 @@ def topk_gating(logits, capacity, k):
     this reproduces ``top2_gating`` exactly (tested)."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits, -1)
-    remaining = probs
-    picks = []
-    for _ in range(k):
-        g = jnp.argmax(remaining, -1)
-        p = jnp.max(remaining, -1)
-        oh = jax.nn.one_hot(g, E, dtype=jnp.float32)
-        remaining = remaining * (1 - oh)
-        picks.append((g, p, oh))
+    picks, aux = _topk_picks(probs, k)
     denom = jnp.maximum(sum(p for _, p, _ in picks), 1e-9)
-    aux = E * jnp.sum(jnp.mean(picks[0][2], 0) * jnp.mean(probs, 0))
 
     dispatch = jnp.zeros((T, E, capacity), jnp.float32)
     combine = jnp.zeros((T, E, capacity), jnp.float32)
@@ -125,6 +136,77 @@ def topk_gating(logits, capacity, k):
         combine = combine + d * ((p / denom) * keep)[:, None, None]
         prior_counts = prior_counts + jnp.sum(oh, 0, keepdims=True)
     return dispatch, combine, aux
+
+
+def topk_gating_idx(logits, capacity, k, noise_key=None, eps_std=0.0):
+    """Index-form gating: the same expert choices, queue positions and
+    combine weights as the dense (T,E,C) gating family (top1/top2/topk),
+    returned per (token, choice) for the scatter/gather dispatch path.
+
+    The dense one-hot dispatch einsum costs O(T*E*C*H) = O(T^2*k*cf*H)
+    MACs — quadratic in tokens (the round-4 chip row measured 0.294
+    activated MFU on it). Index form carries only (T,k) ids/positions;
+    dispatch becomes a scatter-add and combine a gather, O(T*k*H) data
+    movement with zero matmul FLOPs. Dense equivalence is tested
+    (tests/test_moe_dispatch.py).
+
+    Returns (eids (T,k) int32, pos (T,k) int32, keep (T,k) bool,
+    w (T,k) f32 — zeroed where dropped, aux).
+    """
+    T, E = logits.shape
+    if noise_key is not None and eps_std > 0:
+        logits = logits + eps_std * jax.random.normal(noise_key, logits.shape)
+    probs = jax.nn.softmax(logits, -1)
+    picks, aux = _topk_picks(probs, k)
+    if k == 1:
+        weights = [picks[0][1]]  # Switch combine weight = raw top-1 prob
+    else:
+        denom = jnp.maximum(sum(p for _, p, _ in picks), 1e-9)
+        weights = [p / denom for _, p, _ in picks]
+    eids, poss, keeps, ws = [], [], [], []
+    prior = jnp.zeros((1, E), jnp.float32)
+    for (g, _, oh), w in zip(picks, weights):
+        # position within the expert queue; later choices stack after
+        # all earlier choices' per-expert counts (as in top2/topk dense)
+        pos = jnp.sum((jnp.cumsum(oh, 0) - 1.0) * oh + prior * oh,
+                      -1).astype(jnp.int32)
+        keep = pos < capacity
+        eids.append(g.astype(jnp.int32))
+        poss.append(jnp.where(keep, pos, 0))
+        keeps.append(keep)
+        ws.append(w * keep)
+        prior = prior + jnp.sum(oh, 0, keepdims=True)
+    return (jnp.stack(eids, 1), jnp.stack(poss, 1), jnp.stack(keeps, 1),
+            jnp.stack(ws, 1), aux)
+
+
+def indexed_dispatch(xt, eids, pos, keep, capacity, num_experts):
+    """(T,H) tokens -> (E,C,H) expert inputs by scatter-add.
+
+    Kept (token, choice) pairs hold unique (expert, position) slots by
+    construction (queue positions), so add == set; dropped pairs have
+    masked (zero) updates. Under pjit with the expert dim sharded this
+    is the all_to_all boundary the reference codes by hand in
+    global_scatter_op.cu.cc:1.
+    """
+    T, H = xt.shape
+    k = eids.shape[1]
+    flat = (eids * capacity + pos).reshape(T * k)
+    upd = jnp.broadcast_to(xt[:, None, :], (T, k, H)).reshape(T * k, H)
+    upd = upd * keep.reshape(T * k, 1).astype(xt.dtype)
+    buf = jnp.zeros((num_experts * capacity, H), xt.dtype)
+    buf = buf.at[flat].add(upd, mode="drop", unique_indices=False)
+    return buf.reshape(num_experts, capacity, H)
+
+
+def indexed_combine(expert_out, eids, pos, w, capacity):
+    """(E,C,H) expert outputs -> (T,H) tokens: gather each (token,
+    choice) slot and weighted-sum over the k choices (the reverse
+    all_to_all, ~ global_gather_op.cu.cc)."""
+    E, C, H = expert_out.shape
+    flat = eids * capacity + pos  # (T, k)
+    g = expert_out.reshape(E * C, H)[flat]  # (T, k, H)
+    return jnp.sum(g * w[..., None].astype(expert_out.dtype), axis=-2)
 
 
 def expert_choice_gating(logits, capacity):
@@ -189,12 +271,17 @@ class MoELayer(nn.Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
                  capacity_factor=1.25, top_k=None, group=None,
-                 recompute_interval=0, name=None):
+                 recompute_interval=0, dispatch_mode="indexed", name=None):
         super().__init__()
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
+        # "indexed" (default): scatter/gather dispatch, O(T*k*H) data
+        # movement. "einsum": the dense one-hot (T,E,C) formulation —
+        # O(T^2) MACs, kept as the numerics oracle and for A/B benches.
+        assert dispatch_mode in ("indexed", "einsum"), dispatch_mode
+        self.dispatch_mode = dispatch_mode
         if isinstance(gate, str):
             gate_cls = {"gshard": GShardGate, "switch": SwitchGate,
                         "naive": NaiveGate,
@@ -231,11 +318,41 @@ class MoELayer(nn.Layer):
 
         routing = getattr(self.gate, "routing", "token")
 
+        E = self.num_experts
+        mode = self.dispatch_mode
+
+        def expert_ffn(expert_in, w_in, w_out):
+            h = jnp.einsum("ech,ehf->ecf", expert_in, w_in)
+            h = jax.nn.gelu(h)
+            return jnp.einsum("ecf,efh->ech", h, w_out)
+
         def fused(xv, gl, w_in, w_out):
             xt = xv.reshape(T, H)
-            glt = gl.reshape(T, self.num_experts).astype(jnp.float32)
+            glt = gl.reshape(T, E).astype(jnp.float32)
             if routing == "expert":
+                if mode == "indexed":
+                    # experts pick tokens: the top_k already yields
+                    # (E,C) token indices — dispatch is a plain gather,
+                    # combine a scatter-add over picked tokens
+                    c = min(cap, T)
+                    probs = jax.nn.softmax(glt, -1)
+                    g, idx = jax.lax.top_k(probs.T, c)  # (E,C)
+                    expert_in = xt[idx]  # (E,C,H)
+                    expert_out = expert_ffn(expert_in, w_in, w_out)
+                    contrib = (g[..., None].astype(xt.dtype) * expert_out)
+                    out = jnp.zeros((T, H), xt.dtype).at[
+                        idx.reshape(-1)].add(contrib.reshape(E * c, H))
+                    return (out.reshape(B, S, H),
+                            jnp.zeros((), xt.dtype))
                 dispatch, combine, aux = expert_choice_gating(glt, cap)
+            elif mode == "indexed":
+                eids, pos, keep, w, aux = topk_gating_idx(
+                    glt, cap, topk, key,
+                    0.01 if (topk == 1 and key is not None) else 0.0)
+                expert_in = indexed_dispatch(xt, eids, pos, keep, cap, E)
+                expert_out = expert_ffn(expert_in, w_in, w_out)
+                out = indexed_combine(expert_out, eids, pos, w, cap)
+                return out.reshape(B, S, H), aux.astype(xt.dtype)
             elif topk == 1:
                 dispatch, combine, aux = top1_gating(glt, cap, key,
                                                      0.01 if key is not None
@@ -247,9 +364,7 @@ class MoELayer(nn.Layer):
             # (T,E,C) x (T,H) -> (E,C,H): the all_to_all boundary under SPMD
             expert_in = jnp.einsum("tec,th->ech",
                                    dispatch.astype(xt.dtype), xt)
-            h = jnp.einsum("ech,ehf->ecf", expert_in, w_in)
-            h = jax.nn.gelu(h)
-            expert_out = jnp.einsum("ecf,efh->ech", h, w_out)
+            expert_out = expert_ffn(expert_in, w_in, w_out)
             out = jnp.einsum("tec,ech->th", combine.astype(xt.dtype),
                              expert_out)
             return out.reshape(B, S, H), aux.astype(xt.dtype)

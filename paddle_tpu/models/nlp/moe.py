@@ -38,6 +38,9 @@ class MoEConfig:
     # a shared expert WIDER than the routed ones (e.g. 20480 vs 2560),
     # which this overrides directly.
     shared_expert_intermediate: int | None = None
+    # "indexed" = scatter/gather dispatch (O(T*k*H) data movement);
+    # "einsum" = dense one-hot (T,E,C) oracle (O(T^2) MACs) for A/B
+    dispatch_mode: str = "indexed"
 
     @staticmethod
     def tiny():
@@ -97,7 +100,8 @@ class MoEDecoderLayer(nn.Layer):
                                 gate="gshard" if config.top_k == 2
                                 else "switch",
                                 capacity_factor=config.capacity_factor,
-                                top_k=config.top_k)
+                                top_k=config.top_k,
+                                dispatch_mode=config.dispatch_mode)
             if config.num_shared_experts > 0:
                 # always-on shared expert(s): one dense SwiGLU whose
                 # intermediate width is n_shared x the routed experts'

@@ -705,7 +705,11 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k,
 
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k,
-            stream, res, do):
+            stream, res, do, *, delta=None):
+    # delta: optional precomputed sum(dO*O, -1) as (B,H,Sq) f32 — ring
+    # attention calls this once per ring step with the SAME (dO, O), so
+    # it hoists the reduction out of its scan instead of recomputing it
+    # n times (advisor round-4 finding)
     q, k, v, out, lse = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -725,8 +729,10 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, bwd_block_q, bwd_block_k,
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bh = B * H
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, Sq, 1)
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+    delta = delta.reshape(bh, Sq, 1)
     qr = q.reshape(bh, Sq, D)
     kr = k.reshape(bh, Sk, D)
     vr = v.reshape(bh, Sk, D)

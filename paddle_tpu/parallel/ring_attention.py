@@ -143,6 +143,11 @@ def _ring_flash_local(axis: str, n: int, causal: bool, sm_scale: float):
         dq = jnp.zeros(ql.shape, jnp.float32)
         dk_acc = jnp.zeros(kl.shape, jnp.float32)
         dv_acc = jnp.zeros(vl.shape, jnp.float32)
+        # delta = sum(dO*O) depends only on the (global) output — hoist
+        # the reduction out of the ring scan instead of recomputing it
+        # once per ring step inside _fa_bwd
+        delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32),
+                        axis=-1)
 
         def chunk_bwd(diag_causal, ops):
             ql, kf, vf = ops
@@ -151,7 +156,7 @@ def _ring_flash_local(axis: str, n: int, causal: bool, sm_scale: float):
             # returned (dq, dk, dv) are exactly this chunk's terms
             dql, dkf, dvf = _fa_bwd(diag_causal, sm_scale, None, None,
                                     None, None, None,
-                                    (ql, kf, vf, O, LSE), dO)
+                                    (ql, kf, vf, O, LSE), dO, delta=delta)
             if G > 1:
                 dkf = dkf.reshape(B, Hkv, G, dkf.shape[2], D).sum(2)
                 dvf = dvf.reshape(B, Hkv, G, dvf.shape[2], D).sum(2)

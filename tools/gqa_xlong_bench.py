@@ -71,16 +71,17 @@ def main():
         k = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), dt)
         v = jnp.asarray(rng.standard_normal((B, Hkv, S, D)), dt)
 
-        # "grouped_splash" reconstructs the pre-2026-08-01 delegation
-        # explicitly (the delegation itself now routes repeat+flash, so
-        # "grouped_auto" and "repeat_flash" share a path past the
-        # frontier — keeping the splash variant explicit keeps the A/B
-        # that justified the switch reproducible)
-        from paddle_tpu.ops.pallas.splash_attention import (
-            pick_splash_blocks, splash_attention)
+        # grouped_flash_attention's overflow delegation routes to
+        # COARSE-TILE splash (pick_splash_blocks — see
+        # flash_attention_gqa.py:326), so "grouped_auto" already covers
+        # that path past the resident frontier. "grouped_splash" here
+        # reconstructs the PRE-SWITCH fixed 128-tile splash config so
+        # the round-3 A/B that justified the coarse-tile switch (128
+        # tiles lost to repeat+flash) stays reproducible.
+        from paddle_tpu.ops.pallas.splash_attention import splash_attention
 
         def grouped_splash(a, b, c):
-            bq, bk = pick_splash_blocks(S, S, G)
+            bq = bk = 128
             bm = np.tril(np.ones((S // bq, S // bk), bool))
             return splash_attention(a, b, c, bm, True, None, bq, bk)
 

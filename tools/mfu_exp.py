@@ -71,9 +71,13 @@ def run_variant(fused: bool, steps=20, warmup=3, kv_heads=12,
     if dt <= 0:
         dt = t_big / steps
     tok = B * S
-    # windowed attention computes <= W keys per query (the standard
-    # 12*L*h*S*tok causal term becomes 12*L*h*W*tok; slight overcount
-    # of the ramp-up rows, so windowed MFU is a lower bound)
+    # windowed attention computes <= W keys per query. Counting W for
+    # every query matches the full-attention rows' convention (those
+    # count S keys per query, ignoring the causal halving), keeping
+    # windowed and full MFU rows comparable — but note the ramp-up rows
+    # (query pos < W) attend fewer keys, so windowed MFU is SLIGHTLY
+    # OVERSTATED (by ~W/2S of the attention term; ~12% of it at
+    # W=2048/S=8192), not a lower bound as previously claimed.
     s_eff = min(S, window) if window else S
     attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * s_eff * tok
     flops = 6 * n_params * tok + attn_flops

@@ -1,0 +1,147 @@
+"""MoE dispatch A/B on chip: indexed (scatter/gather) vs einsum (one-hot).
+
+Round-4 verdict item 1b: the dense (T,E,C) dispatch einsums (~ reference
+global_scatter_op.cu.cc's role) measured 0.294 activated MFU at the
+chip config because they cost O(T^2*k*cf*H) MACs. This bench re-runs
+the exact ladder `moe_train` config with both dispatch modes plus a
+segment ablation (gate+dispatch / expert matmuls / combine) so PERF.md
+gets the A/B table the verdict asked for.
+
+Usage: python tools/moe_dispatch_bench.py [--quick]
+Emits one JSON line per row.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def bench_train(mode: str, on_tpu: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import (MoEConfig, MoEForCausalLM,
+                                       moe_train_step_factory)
+    from bench import peak_for
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = MoEConfig(vocab_size=32000, hidden_size=1024,
+                        intermediate_size=2816, num_hidden_layers=8,
+                        num_attention_heads=16, num_key_value_heads=16,
+                        num_experts=8, top_k=2, moe_every=2,
+                        num_shared_experts=1, dispatch_mode=mode)
+        B, S = 8, 2048
+    else:
+        cfg = dataclasses.replace(MoEConfig.deepseek_tiny(),
+                                  dispatch_mode=mode)
+        B, S = 2, 32
+    model = MoEForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    n_act = model.activated_params()
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    params, opt_state, step = moe_train_step_factory(model, mesh)
+    rng = np.random.default_rng(0)
+    seq = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S + 1)),
+                      jnp.int32)
+    tokens, labels = seq[:, :-1], seq[:, 1:]
+    params, opt_state, loss = step(params, opt_state, tokens, labels)
+    float(loss)
+    n = 10 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    lv = float(loss)
+    dt = (time.perf_counter() - t0) / n
+    tok = B * S
+    attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * S * tok
+    mfu = (6 * n_act * tok + attn) / dt / peak_for(jax.devices()[0])
+    return {"metric": f"moe_train_{mode}", "tokens_per_sec":
+            round(tok / dt, 1), "step_ms": round(dt * 1e3, 2),
+            "mfu_activated": round(mfu, 4), "loss": round(lv, 3),
+            "activated_params": n_act}
+
+
+def bench_segments(mode: str, on_tpu: bool):
+    """Time the MoE layer's stages in isolation at the chip shape:
+    gate+dispatch (routing math + scatter or one-hot einsum), expert
+    FFN matmuls, and the full layer (adds combine + residual glue)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.sync import hard_sync
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.distributed.models.moe import (
+        MoELayer, indexed_dispatch, top2_gating, topk_gating_idx)
+
+    H, F, E = (1024, 2816, 8) if on_tpu else (16, 32, 4)
+    B, S = (8, 2048) if on_tpu else (2, 16)
+    T = B * S
+    paddle.seed(0)
+    lay = MoELayer(H, F, E, gate="gshard", dispatch_mode=mode)
+    lay.eval()
+    cap = lay.capacity(T)
+    dt_kind = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.default_rng(0)
+    xt = jnp.asarray(rng.normal(0, 1, (T, H)), dt_kind)
+    gl = jnp.asarray(rng.normal(0, 1, (T, E)), jnp.float32)
+    w_in = jnp.asarray(lay.w_in._value, dt_kind)
+    w_out = jnp.asarray(lay.w_out._value, dt_kind)
+
+    def gate_dispatch(xt, gl):
+        if mode == "indexed":
+            eids, pos, keep, w, aux = topk_gating_idx(gl, cap, 2)
+            return indexed_dispatch(xt, eids, pos, keep, cap, E)
+        d, c, aux = top2_gating(gl, cap)
+        return jnp.einsum("tec,th->ech", d.astype(xt.dtype), xt)
+
+    def ffn(ein, w_in, w_out):
+        h = jnp.einsum("ech,ehf->ecf", ein, w_in)
+        h = jax.nn.gelu(h)
+        return jnp.einsum("ecf,efh->ech", h, w_out)
+
+    def full(xv):
+        return lay(Tensor(xv))._value
+
+    rows = {}
+    for name, fn, args in [
+            ("gate_dispatch", gate_dispatch, (xt, gl)),
+            ("expert_ffn", ffn, (gate_dispatch(xt, gl), w_in, w_out)),
+            ("full_layer", full, (jnp.asarray(
+                rng.normal(0, 1, (B, S, H)), dt_kind),))]:
+        jf = jax.jit(fn)
+        hard_sync(jf(*args))
+        n = 20 if on_tpu else 2
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = jf(*args)
+        hard_sync(out)
+        rows[name] = round((time.perf_counter() - t0) / n * 1e3, 3)
+    return {"metric": f"moe_segments_{mode}", "ms": rows,
+            "T": T, "E": E, "capacity": cap}
+
+
+def main():
+    import jax
+    on_tpu = jax.devices()[0].platform != "cpu" and \
+        "--quick" not in sys.argv
+    for mode in ("indexed", "einsum"):
+        print(json.dumps(bench_segments(mode, on_tpu)), flush=True)
+        print(json.dumps(bench_train(mode, on_tpu)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
