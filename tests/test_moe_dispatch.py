@@ -86,7 +86,8 @@ def test_moelayer_indexed_matches_einsum(gate, topk):
     for (k1, p1), (k2, p2) in zip(lay_i.state_dict().items(),
                                   lay_e.state_dict().items()):
         np.testing.assert_array_equal(np.asarray(p1._value),
-                                      np.asarray(p2._value)), (k1, k2)
+                                      np.asarray(p2._value),
+                                      err_msg=f"{k1} vs {k2}")
     lay_i.eval(); lay_e.eval()  # no gate noise: deterministic parity
     x = paddle.to_tensor(rng.normal(0, 1, (B, S, H)).astype(np.float32))
     yi = lay_i(x); ye = lay_e(x)

@@ -1,0 +1,115 @@
+"""Benchmark regression gate (~ reference tools/ci_op_benchmark.sh:1 +
+check_op_benchmark_result.py:1 + ci_model_benchmark.sh:37-60 discipline).
+
+Compares a fresh chip measurement against the commit-stamped last
+recorded row and FAILS (exit 1) on >threshold regression, so a round
+cannot silently ship a slower build. Two modes:
+
+  python tools/bench_gate.py check <fresh.json>   # compare a bench.py
+      output file (or '-' for stdin) against PERF_LAST_TPU.json
+  python tools/bench_gate.py run                  # run bench.py now,
+      then compare (the first chip-queue item each round)
+
+The gate compares the LEGACY row when present (fixed MHA config —
+stable across rounds) and falls back to the headline value; a config
+change that renames rows therefore can't masquerade as a speedup.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THRESHOLD = 0.05  # fail on >5% MFU regression
+
+
+def _legacy_mfu(detail: dict, fallback: float) -> float:
+    row = detail.get("legacy_mha_config")
+    if isinstance(row, dict) and "mfu" in row:
+        return float(row["mfu"])
+    return fallback
+
+
+def load_baseline():
+    """Snapshot PERF_LAST_TPU.json BEFORE running bench.py — the bench
+    itself refreshes that file on a good chip run, so reading it after
+    would compare the fresh row against itself."""
+    rec_path = os.path.join(REPO, "PERF_LAST_TPU.json")
+    if not os.path.exists(rec_path):
+        return None
+    with open(rec_path) as f:
+        return json.load(f)
+
+
+def check(fresh: dict, last: dict | None) -> int:
+    if last is None:
+        print(json.dumps({"gate": "skip",
+                          "reason": "no PERF_LAST_TPU.json baseline"}))
+        return 0
+    last_legacy = _legacy_mfu(last, float(last.get("mfu", 0.0)))
+    detail = fresh.get("detail", {})
+    fresh_head = float(fresh.get("value", 0.0))
+    fresh_legacy = _legacy_mfu(detail, fresh_head)
+    if fresh.get("detail", {}).get("device", "").startswith("TFRT_CPU"):
+        print(json.dumps({"gate": "skip",
+                          "reason": "fresh run fell back to CPU; gate "
+                                    "only judges chip-vs-chip"}))
+        return 0
+    ratio = fresh_legacy / last_legacy if last_legacy else 1.0
+    rec = {
+        "gate": "pass" if ratio >= 1.0 - THRESHOLD else "FAIL",
+        "fresh_legacy_mfu": round(fresh_legacy, 4),
+        "last_legacy_mfu": round(last_legacy, 4),
+        "fresh_headline_mfu": round(fresh_head, 4),
+        "ratio": round(ratio, 4),
+        "threshold": THRESHOLD,
+        "baseline_commit": last.get("measured_at_commit", "?"),
+    }
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "run"
+    if mode == "check":
+        baseline = load_baseline()
+        src = sys.argv[2] if len(sys.argv) > 2 else "-"
+        text = sys.stdin.read() if src == "-" else open(src).read()
+        # bench.py prints one JSON line (possibly after warnings)
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("{")][-1]
+        return check(json.loads(line), baseline)
+    if mode == "run":
+        baseline = load_baseline()
+        r = subprocess.run([sys.executable,
+                            os.path.join(REPO, "bench.py")],
+                           capture_output=True, text=True, timeout=1800)
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        if r.returncode != 0 or not lines:
+            print(json.dumps({"gate": "FAIL",
+                              "reason": "bench.py did not produce a row",
+                              "stderr": (r.stderr or "")[-400:]}))
+            return 1
+        rc = check(json.loads(lines[-1]), baseline)
+        if rc != 0 and baseline is not None:
+            # bench.py stamped the REGRESSED row into PERF_LAST_TPU.json;
+            # restore the snapshot so a failing build cannot become the
+            # next run's baseline (self-laundering: fail once, pass
+            # forever after). Accepting an intended slowdown = commit
+            # the new stamp deliberately after reading the FAIL row.
+            rec_path = os.path.join(REPO, "PERF_LAST_TPU.json")
+            tmp = rec_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(baseline, f, indent=2)
+                f.write("\n")
+            os.replace(tmp, rec_path)
+            print(json.dumps({"gate_note":
+                              "restored pre-run baseline stamp"}))
+        return rc
+    raise SystemExit("mode: run | check <file|->")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

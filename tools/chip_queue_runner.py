@@ -39,8 +39,12 @@ QUEUE = [
     ("gqa_xlong_ab", [sys.executable, "tools/gqa_xlong_bench.py"], {}),
     ("serving_bench",
      [sys.executable, "tools/serving_bench.py"], {}),
-    # refresh the headline last so PERF_LAST_TPU.json stamps this HEAD
-    ("headline_bench", [sys.executable, "bench.py"], {}),
+    # ONE bench run per window, wrapped by the regression gate (round-4
+    # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
+    # gate snapshots the baseline, runs bench.py, fails on >5% legacy-
+    # row regression, and restores the snapshot on FAIL so a regressed
+    # build cannot launder itself into the next baseline
+    ("bench_gate", [sys.executable, "tools/bench_gate.py", "run"], {}),
 ]
 
 

@@ -159,7 +159,15 @@ def run_ladder_subproc():
                 return  # largest fitting config measured
 
 
-def run_tp_shard():
+def run_tp_shard(optimizer: str = "sgd", zero_dp: int = 8):
+    """optimizer="adamw": the round-4 verdict item 2 fix — the projected
+    v5p-64 plan trains with adamw + ZeRO-sliced moments, so the measured
+    per-chip efficiency must include the sliced adamw update's HBM
+    traffic, not sgd's. Each chip holds bf16 moments for a 1/zero_dp
+    slice of its shard and updates only that slice (the rest arrives by
+    all-gather on the pod — ICI term, cost model's job). zero_dp=8 over
+    the TP=8-shaped ~1.03B shard gives a ~129M-param slice, matching the
+    dp=32/mp=2 plan's 4B/32 = 125M slice per chip."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -226,26 +234,93 @@ def run_tp_shard():
     ids = jnp.asarray(rng.integers(0, VOC, (B, S)), jnp.int32)
     labels = jnp.asarray(rng.integers(0, VOC, (B, S)), jnp.int32)
 
-    @jax.jit
-    def train(ws, emb, head):
-        g = jax.grad(loss_fn, argnums=(0, 1, 2))(ws, emb, head, ids, labels)
-        lr = 1e-6
-        new_ws = {k: (v - lr * g[0][k].astype(jnp.float32)).astype(v.dtype)
-                  for k, v in ws.items()}
-        return (new_ws, (emb - lr * g[1].astype(jnp.float32)).astype(
-            emb.dtype), (head - lr * g[2].astype(jnp.float32)).astype(
-            head.dtype))
+    if optimizer == "adamw":
+        # ZeRO-sliced adamw: bf16 moments for the leading 1/zero_dp of
+        # each tensor's flat elements; only that slice of the param is
+        # updated locally. Slice choice is irrelevant to cost — the HBM
+        # traffic (read g + m + v + p slice, write m + v + p slice) only
+        # depends on the element count.
+        def slice_len(v):
+            return max(1, int(np.prod(v.shape)) // zero_dp)
 
+        moments = {
+            "m_ws": {k: jnp.zeros((slice_len(v),), jnp.bfloat16)
+                     for k, v in ws.items()},
+            "v_ws": {k: jnp.zeros((slice_len(v),), jnp.bfloat16)
+                     for k, v in ws.items()},
+            "m_emb": jnp.zeros((slice_len(emb),), jnp.bfloat16),
+            "v_emb": jnp.zeros((slice_len(emb),), jnp.bfloat16),
+            "m_head": jnp.zeros((slice_len(head),), jnp.bfloat16),
+            "v_head": jnp.zeros((slice_len(head),), jnp.bfloat16),
+            "t": jnp.zeros((), jnp.float32),
+        }
+
+        def adamw_slice(p, g, m, v, t, lr=1e-4, b1=0.9, b2=0.95,
+                        eps=1e-8, wd=0.01):
+            k = m.shape[0]
+            shape = p.shape
+            pf = p.reshape(-1)
+            gf = g.reshape(-1)[:k].astype(jnp.float32)
+            mf = m.astype(jnp.float32)
+            vf = v.astype(jnp.float32)
+            mf = b1 * mf + (1 - b1) * gf
+            vf = b2 * vf + (1 - b2) * gf * gf
+            mhat = mf / (1 - b1 ** t)
+            vhat = vf / (1 - b2 ** t)
+            ps = pf[:k].astype(jnp.float32)
+            ps = ps - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * ps)
+            pf = pf.at[:k].set(ps.astype(pf.dtype))
+            return (pf.reshape(shape), mf.astype(jnp.bfloat16),
+                    vf.astype(jnp.bfloat16))
+
+        @jax.jit
+        def train(state):
+            ws, emb, head, mom = state
+            g = jax.grad(loss_fn, argnums=(0, 1, 2))(ws, emb, head, ids,
+                                                     labels)
+            t = mom["t"] + 1.0
+            new_ws, new_m, new_v = {}, {}, {}
+            for k, v in ws.items():
+                new_ws[k], new_m[k], new_v[k] = adamw_slice(
+                    v, g[0][k], mom["m_ws"][k], mom["v_ws"][k], t)
+            emb2, me, ve = adamw_slice(emb, g[1], mom["m_emb"],
+                                       mom["v_emb"], t)
+            head2, mh, vh = adamw_slice(head, g[2], mom["m_head"],
+                                        mom["v_head"], t)
+            return new_ws, emb2, head2, {
+                "m_ws": new_m, "v_ws": new_v, "m_emb": me, "v_emb": ve,
+                "m_head": mh, "v_head": vh, "t": t}
+
+        state = (ws, emb, head, moments)
+    else:
+        @jax.jit
+        def train(state):
+            ws, emb, head = state
+            g = jax.grad(loss_fn, argnums=(0, 1, 2))(ws, emb, head, ids,
+                                                     labels)
+            lr = 1e-6
+            new_ws = {k: (v - lr * g[0][k].astype(jnp.float32)).astype(
+                v.dtype) for k, v in ws.items()}
+            return (new_ws, (emb - lr * g[1].astype(jnp.float32)).astype(
+                emb.dtype), (head - lr * g[2].astype(jnp.float32)).astype(
+                head.dtype))
+
+        state = (ws, emb, head)
+
+    # one shared timing scaffold for both optimizers — the sgd-vs-adamw
+    # comparison is only valid if the measurement discipline is identical
     t0 = time.perf_counter()
-    ws, emb, head = train(ws, emb, head)
-    float(emb[0, 0])
+    state = train(state)
+    float(state[1][0, 0])  # emb readback = sync
     compile_s = time.perf_counter() - t0
     steps = 8 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(steps):
-        ws, emb, head = train(ws, emb, head)
-    float(emb[0, 0])
+        state = train(state)
+    float(state[1][0, 0])
     dt = (time.perf_counter() - t0) / steps
+    ws = state[0]
+    emb, head = state[1], state[2]
 
     n_params = sum(int(np.prod(v.shape)) for v in ws.values()) + \
         int(np.prod(emb.shape)) + int(np.prod(head.shape))
@@ -253,8 +328,11 @@ def run_tp_shard():
     # attention flops at the sliced head count: fwd 2*2*B*H*S^2*D, x3 bwd
     attn = 12 * L * H * S * S * D * B
     flops = 6 * n_params * tok + attn
-    rec = {"mode": "tp_shard",
-           "what": "llama3-8b TP=8 per-chip shard shapes, fwd+bwd+sgd",
+    rec = {"mode": f"tp_shard_{optimizer}" if optimizer != "sgd"
+           else "tp_shard",
+           "what": ("llama3-8b TP=8 per-chip shard shapes, fwd+bwd+"
+                    + (f"zero-sliced adamw (bf16 moments, dp={zero_dp})"
+                       if optimizer == "adamw" else "sgd")),
            "shard_params_b": round(n_params / 1e9, 3),
            "B": B, "S": S, "layers": L,
            "step_ms": round(dt * 1e3, 1),
@@ -275,5 +353,10 @@ if __name__ == "__main__":
                    else None)
     elif mode == "tp_shard":
         run_tp_shard()
+    elif mode == "tp_shard_adamw":
+        run_tp_shard("adamw",
+                     zero_dp=int(sys.argv[2]) if len(sys.argv) > 2 else 8)
     else:
-        raise SystemExit("mode: ladder | ladder_rung <i> | tp_shard")
+        raise SystemExit(
+            "mode: ladder | ladder_rung <i> | tp_shard | "
+            "tp_shard_adamw [zero_dp]")
