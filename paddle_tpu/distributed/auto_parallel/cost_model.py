@@ -88,74 +88,129 @@ class ModelSpec:
     seq: int = 2048
     global_batch: int = 64
     bytes_per_param: int = 2          # bf16
+    # head geometry (GQA-aware params + attention FLOPs + ring-KV bytes;
+    # chip validation showed the MHA-only form misstates GQA rows by
+    # ~10% params and the TP-shard row by 3x flops). Defaults keep the
+    # classic MHA identity n_heads * head_dim == hidden.
+    n_heads: Optional[int] = None
+    kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    # K+V width per token for the ring-attention rotation; None derives
+    # 2 * kv_heads * head_dim * bytes from the head geometry
+    kv_bytes_per_token: Optional[int] = None
+
+    @property
+    def q_width(self) -> int:
+        if self.n_heads and self.head_dim:
+            return self.n_heads * self.head_dim
+        return self.hidden
+
+    @property
+    def kv_width(self) -> int:
+        if self.kv_heads and self.head_dim:
+            return self.kv_heads * self.head_dim
+        return self.q_width
 
     @property
     def n_params(self) -> int:
-        per_layer = (4 * self.hidden * self.hidden
-                     + 3 * self.hidden * self.intermediate)
+        attn = (2 * self.hidden * self.q_width      # q + o projections
+                + 2 * self.hidden * self.kv_width)  # k + v projections
+        per_layer = attn + 3 * self.hidden * self.intermediate
         return self.n_layers * per_layer + 2 * self.vocab * self.hidden
 
     def step_flops(self) -> float:
         """Total training FLOPs of one global step (all replicas)."""
         tokens = self.global_batch * self.seq
-        attn = 12 * self.n_layers * self.hidden * self.seq * tokens
+        attn = 12 * self.n_layers * self.q_width * self.seq * tokens
         return 6 * self.n_params * tokens + attn
 
 
 class CostModel:
-    """Per-step time estimate for a (dp, mp, pp) plan
+    """Per-step time estimate for a (dp, sep, mp, pp) plan
     (~ cost_model.py CostModel.get_runtime)."""
 
-    def __init__(self, cluster: Cluster, model: ModelSpec):
+    # Achievable fraction of peak for dense bf16 transformer steps.
+    # Chip-calibrated round 5 (tools/cost_validate.py publishes the
+    # predicted-vs-measured table): single-chip measurements on v5e span
+    # 0.59 (8B TP=8 shard shapes + zero-sliced adamw) to 0.82 (GQA best
+    # config); 0.60 is the sharded-shape value — the regime pod plans
+    # actually run in — and is conservative for fat single-chip configs.
+    DEFAULT_EFF = 0.60
+
+    def __init__(self, cluster: Cluster, model: ModelSpec,
+                 eff: Optional[float] = None):
         self.cluster = cluster
         self.model = model
+        self.eff = eff or self.DEFAULT_EFF
 
     def estimate(self, dp: int, mp: int, pp: int,
-                 n_microbatches: Optional[int] = None) -> Dict[str, float]:
+                 n_microbatches: Optional[int] = None,
+                 sep: int = 1) -> Dict[str, float]:
         c = self.cluster
         m = self.model
-        if dp * mp * pp != c.n_devices:
-            raise ValueError(f"dp*mp*pp = {dp * mp * pp} != "
+        if dp * mp * pp * sep != c.n_devices:
+            raise ValueError(f"dp*mp*pp*sep = {dp * mp * pp * sep} != "
                              f"{c.n_devices} devices")
         if m.global_batch % dp:
             raise ValueError(f"global_batch {m.global_batch} not divisible "
                              f"by dp {dp}")
+        if m.seq % sep:
+            raise ValueError(f"seq {m.seq} not divisible by sep {sep}")
         batch_per_replica = m.global_batch // dp
         M = n_microbatches or max(1, 4 * pp)
         # compute: the global step's FLOPs spread over every device (dp
-        # splits batch, mp splits matmuls, pp splits layers)
-        eff = 0.55  # achievable fraction of peak for dense transformer steps
-        compute = m.step_flops() / (dp * mp * pp) / (c.device.peak_flops * eff)
+        # splits batch, mp splits matmuls, pp splits layers, sep splits
+        # the sequence)
+        compute = m.step_flops() / (dp * mp * pp * sep) \
+            / (c.device.peak_flops * self.eff)
 
         comm_mp = CommCost(c.ici, mp)
-        comm_dp = CommCost(c.ici, dp)
         comm_pp = CommCost(c.ici, pp)
+        comm_sep = CommCost(c.ici, sep)
 
-        # tensor-parallel: 4 allreduces of (b, s, h) activations per layer
-        # (2 fwd + 2 bwd), layers split over pp
-        act_bytes = batch_per_replica * m.seq * m.hidden \
+        # tensor-parallel: 4 allreduces of (b, s_local, h) activations
+        # per layer (2 fwd + 2 bwd), layers split over pp, seq over sep
+        act_bytes = batch_per_replica * (m.seq // sep) * m.hidden \
             * m.bytes_per_param / M
         tp_time = (m.n_layers / pp) * 4 * M * comm_mp.all_reduce(act_bytes) \
             if mp > 1 else 0.0
 
-        # data-parallel gradient allreduce of this rank's param shard
+        # sequence/context parallel: ring attention rotates the local
+        # K+V chunk (sep-1) times per layer, fwd + bwd (the bwd ring also
+        # rotates dK/dV accumulators — x2 again), over the sep axis
+        if sep > 1:
+            kv_tok = m.kv_bytes_per_token \
+                or 2 * m.kv_width * m.bytes_per_param
+            kv_chunk = batch_per_replica * (m.seq // sep) * kv_tok \
+                / max(1, mp)  # heads split over mp shrink the local chunk
+            sep_time = (m.n_layers / pp) * (sep - 1) * 3 \
+                * comm_sep.p2p(kv_chunk)
+        else:
+            sep_time = 0.0
+
+        # gradient allreduce of this rank's param shard: params are
+        # replicated across BOTH dp and sep (sep shards activations by
+        # sequence, not weights), so the sync ring spans dp*sep devices
         grad_bytes = m.n_params / (mp * pp) * 4  # f32 grads
-        dp_time = comm_dp.all_reduce(grad_bytes) if dp > 1 else 0.0
+        comm_grad = CommCost(c.ici, dp * sep)
+        dp_time = comm_grad.all_reduce(grad_bytes) if dp * sep > 1 else 0.0
 
         # pipeline: bubble fraction + p2p per microbatch boundary
         bubble = (pp - 1) / (M + pp - 1) if pp > 1 else 0.0
         p2p_time = 2 * M * (pp - 1) * comm_pp.p2p(act_bytes) / max(1, pp) \
             if pp > 1 else 0.0
 
-        total = (compute + tp_time) / (1 - bubble) + dp_time + p2p_time
+        total = (compute + tp_time + sep_time) / (1 - bubble) \
+            + dp_time + p2p_time
 
         # memory per device: params + grads + adam moments + activations
         param_b = m.n_params / (mp * pp) * m.bytes_per_param
         opt_b = m.n_params / (mp * pp) * 8  # two f32 moments
         grad_b = m.n_params / (mp * pp) * 4
-        act_b = (m.n_layers / pp) * batch_per_replica * m.seq * m.hidden \
-            * m.bytes_per_param * 4 / M  # remat'd working set
+        act_b = (m.n_layers / pp) * batch_per_replica * (m.seq // sep) \
+            * m.hidden * m.bytes_per_param * 4 / M  # remat'd working set
         mem = param_b + opt_b + grad_b + act_b
         return {"total": total, "compute": compute, "tp_comm": tp_time,
-                "dp_comm": dp_time, "pp_p2p": p2p_time, "bubble": bubble,
+                "sep_comm": sep_time, "dp_comm": dp_time,
+                "pp_p2p": p2p_time, "bubble": bubble,
                 "memory_bytes": mem, "fits": mem < c.device.mem_bytes}

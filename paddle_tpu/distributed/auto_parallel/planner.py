@@ -1,10 +1,13 @@
-"""Parallelization planner: search (dp, mp, pp) over the cost model.
+"""Parallelization planner: search (dp, sep, mp, pp) over the cost model.
 
 ~ python/paddle/distributed/auto_parallel/planner.py:826 (PlanSpace
 enumerating dist-attr combinations + MCMC search) and tuner/ — here the
-search space is the factorization lattice of the device count, ranked by
-the analytic CostModel; infeasible plans (OOM) are filtered first, mirroring
-the reference planner's constraint pass.
+search space is the 4-axis factorization lattice of the device count,
+ranked by the analytic CostModel; infeasible plans (OOM) are filtered
+first, mirroring the reference planner's constraint pass. The 'sep'
+(sequence/context-parallel) axis exceeds the reference (SURVEY §5):
+ring-attention KV rotation is costed so long-sequence models can trade
+a sep slice against dp/mp.
 """
 from __future__ import annotations
 
@@ -14,29 +17,36 @@ from .cost_model import Cluster, CostModel, ModelSpec
 
 
 def _factorizations(n: int) -> List[tuple]:
+    """All (dp, sep, mp, pp) with dp*sep*mp*pp == n."""
     out = []
     for dp in range(1, n + 1):
         if n % dp:
             continue
-        rem = n // dp
-        for mp in range(1, rem + 1):
-            if rem % mp:
+        rem1 = n // dp
+        for sep in range(1, rem1 + 1):
+            if rem1 % sep:
                 continue
-            out.append((dp, mp, rem // mp))
+            rem2 = rem1 // sep
+            for mp in range(1, rem2 + 1):
+                if rem2 % mp:
+                    continue
+                out.append((dp, sep, mp, rem2 // mp))
     return out
 
 
 class Plan:
-    def __init__(self, dp, mp, pp, cost):
-        self.dp, self.mp, self.pp = dp, mp, pp
+    def __init__(self, dp, mp, pp, cost, sep=1):
+        self.dp, self.mp, self.pp, self.sep = dp, mp, pp, sep
         self.cost = cost
 
     @property
     def mesh_shape(self):
-        return {"data": self.dp, "model": self.mp, "pipe": self.pp}
+        return {"data": self.dp, "sep": self.sep, "model": self.mp,
+                "pipe": self.pp}
 
     def __repr__(self):
-        return (f"Plan(dp={self.dp}, mp={self.mp}, pp={self.pp}, "
+        return (f"Plan(dp={self.dp}, sep={self.sep}, mp={self.mp}, "
+                f"pp={self.pp}, "
                 f"step={self.cost['total'] * 1e3:.1f}ms, "
                 f"mem={self.cost['memory_bytes'] / 1e9:.1f}GB)")
 
@@ -47,27 +57,39 @@ class Planner:
     def __init__(self, cluster: Optional[Cluster] = None,
                  model: Optional[ModelSpec] = None,
                  max_mp: Optional[int] = None,
-                 max_pp: Optional[int] = None):
+                 max_pp: Optional[int] = None,
+                 max_sep: Optional[int] = None,
+                 eff: Optional[float] = None):
         self.cluster = cluster or Cluster()
         self.model = model or ModelSpec()
         self.max_mp = max_mp
         self.max_pp = max_pp
+        self.max_sep = max_sep
+        self.eff = eff
 
     def plans(self, include_oom: bool = False) -> List[Plan]:
-        cm = CostModel(self.cluster, self.model)
+        cm = CostModel(self.cluster, self.model, eff=self.eff)
         out = []
-        for dp, mp, pp in _factorizations(self.cluster.n_devices):
+        for dp, sep, mp, pp in _factorizations(self.cluster.n_devices):
             if self.max_mp and mp > self.max_mp:
                 continue
             if self.max_pp and pp > self.max_pp:
+                continue
+            if self.max_sep and sep > self.max_sep:
                 continue
             if pp > 1 and self.model.n_layers % pp:
                 continue
             if self.model.global_batch % dp:
                 continue
-            cost = cm.estimate(dp, mp, pp)
+            if self.model.seq % sep:
+                continue
+            # a sep chunk must hold at least one flash block (512) for
+            # the ring kernels to run at their tuned tile sizes
+            if sep > 1 and self.model.seq // sep < 512:
+                continue
+            cost = cm.estimate(dp, mp, pp, sep=sep)
             if cost["fits"] or include_oom:
-                out.append(Plan(dp, mp, pp, cost))
+                out.append(Plan(dp, mp, pp, cost, sep=sep))
         out.sort(key=lambda p: (not p.cost["fits"], p.cost["total"]))
         return out
 
@@ -78,8 +100,8 @@ class Planner:
         return plans[0]
 
     def to_mesh(self, plan: Plan):
-        """Materialize the chosen plan as a jax Mesh (axes data/model/pipe,
-        singleton axes dropped)."""
+        """Materialize the chosen plan as a jax Mesh (axes
+        data/sep/model/pipe, singleton axes dropped)."""
         import jax
         import numpy as np
         from jax.sharding import Mesh

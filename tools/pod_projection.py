@@ -71,7 +71,9 @@ def measured_efficiency():
                     f"PERF_LAST_TPU.json headline "
                     f"({rec.get('config', '?')}, "
                     f"commit {rec.get('measured_at_commit', '?')})")
-    return 0.55, "cost-model default (NO chip measurement found)"
+    from paddle_tpu.distributed.auto_parallel import CostModel
+    return (CostModel.DEFAULT_EFF,
+            "cost-model default (NO chip measurement found)")
 
 
 def main():
@@ -95,7 +97,11 @@ def main():
 
     def project(eff_x, ici_scale):
         t_compute = model.step_flops() / (cluster.n_devices * peak * eff_x)
-        t_step = ((t_compute + est["tp_comm"] / ici_scale)
+        # same term structure as CostModel.estimate (tp + sep ride
+        # inside the bubble with compute; dp grad sync and pp p2p
+        # outside) so planner and projection cannot drift apart
+        t_step = ((t_compute + (est["tp_comm"]
+                                + est.get("sep_comm", 0.0)) / ici_scale)
                   / (1 - est["bubble"])
                   + est["dp_comm"] / ici_scale
                   + est["pp_p2p"] / ici_scale)
@@ -118,7 +124,8 @@ def main():
 
     print(json.dumps({
         "target": "llama3-8b v5p-64 (BASELINE #4)",
-        "plan": {"dp": best.dp, "mp": best.mp, "pp": best.pp},
+        "plan": {"dp": best.dp, "mp": best.mp, "pp": best.pp,
+                 "sep": getattr(best, "sep", 1)},
         "measured_eff": round(eff, 4),
         "eff_source": source,
         "step_ms": round(t_step * 1e3, 1),
@@ -134,6 +141,7 @@ def main():
         "terms_ms": {
             "compute": round(t_compute * 1e3, 1),
             "tp_comm": round(est["tp_comm"] * 1e3, 1),
+            "sep_comm": round(est.get("sep_comm", 0.0) * 1e3, 1),
             "dp_comm": round(est["dp_comm"] * 1e3, 1),
             "pp_p2p": round(est["pp_p2p"] * 1e3, 1),
             "bubble_frac": round(est["bubble"], 3),
