@@ -179,8 +179,17 @@ class CostModel:
         # K+V chunk (sep-1) times per layer, fwd + bwd (the bwd ring also
         # rotates dK/dV accumulators — x2 again), over the sep axis
         if sep > 1:
+            # K/V rotate at their true head count ONLY when the kv heads
+            # divide the model axis; otherwise the implementation
+            # repeats them to full q heads before the ring
+            # (models/nlp/llama.py) — charge the repeated width there
+            # or the planner under-costs (mp, sep) combos by up to
+            # n_rep x
+            kv_width = m.kv_width
+            if mp > 1 and m.kv_heads and m.kv_heads % mp != 0:
+                kv_width = m.q_width
             kv_tok = m.kv_bytes_per_token \
-                or 2 * m.kv_width * m.bytes_per_param
+                or 2 * kv_width * m.bytes_per_param
             kv_chunk = batch_per_replica * (m.seq // sep) * kv_tok \
                 / max(1, mp)  # heads split over mp shrink the local chunk
             sep_time = (m.n_layers / pp) * (sep - 1) * 3 \

@@ -10,3 +10,5 @@ from .moe import (MoEConfig, MoEForCausalLM,  # noqa: F401
 from .llama_decode import llama_decode_factory  # noqa: F401,E402
 from .llama_decode import llama_paged_decode_factory  # noqa: F401,E402
 from .llama_decode import llama_speculative_decode_factory  # noqa: F401,E402
+from .llama_decode import llama_serving_decode_factory  # noqa: F401,E402
+from .llama_decode import route_decode  # noqa: F401,E402

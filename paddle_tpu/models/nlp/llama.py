@@ -236,12 +236,28 @@ class LlamaAttention(nn.Layer):
             scale = 1.0 / math.sqrt(qv.shape[-1])
 
             if window is not None and window < S:
-                if _context_parallel_mesh()[0] is not None:
-                    raise ValueError(
-                        "sliding_window with context parallelism ('sep' "
-                        "axis) is not supported — the ring walk would "
-                        "need window-aware skipping; drop the 'sep' axis "
-                        "or unset sliding_window")
+                cp_mesh, cp_axis = _context_parallel_mesh()
+                if cp_mesh is not None \
+                        and S % cp_mesh.shape[cp_axis] == 0:
+                    # window x 'sep' compose (round-4 verdict item 5):
+                    # the window-aware ring walks only the chunk pairs
+                    # the band touches (per-pair banded splash with a
+                    # shifted query frame); K/V rotate at their true
+                    # head count unless TP head sharding forbids it
+                    mdl_sz = (cp_mesh.shape["model"]
+                              if "model" in cp_mesh.axis_names else 1)
+                    kvr, vvr = kv, vv
+                    if n_rep > 1 and kv.shape[2] % max(1, mdl_sz) != 0:
+                        kvr = jnp.repeat(kv, n_rep, axis=2)
+                        vvr = jnp.repeat(vv, n_rep, axis=2)
+                    from ...parallel.ring_attention import \
+                        ring_window_attention
+                    out = ring_window_attention(
+                        jnp.swapaxes(qv, 1, 2), jnp.swapaxes(kvr, 1, 2),
+                        jnp.swapaxes(vvr, 1, 2), cp_mesh, window,
+                        axis=cp_axis, sm_scale=scale,
+                        batch_axis="data", head_axis="model")
+                    return jnp.swapaxes(out, 1, 2).reshape(B, S, -1)
                 from ...ops.pallas.splash_attention import \
                     fits_score_budget
                 if n_rep > 1 and _flash_eligible(S, qv.shape[-1],

@@ -136,12 +136,16 @@ def banded_block_mask(Sq, Sk, block_q, block_k, window,
     return bm
 
 
-def _live_mask(qi, kj, rows, block_q, block_k, causal, window):
+def _live_mask(qi, kj, rows, block_q, block_k, causal, window,
+               q_offset=0):
     """Elementwise live mask for a (G*block_q, block_k) score block: row
     r belongs to query position qi*block_q + (r % block_q) — the group
-    index r // block_q shares positions across the G heads."""
+    index r // block_q shares positions across the G heads. q_offset
+    shifts the query frame relative to the keys (ring attention's
+    cross-chunk pairs: chunk distance d puts queries d*S_local ahead of
+    the held K/V chunk)."""
     r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
-    q_pos = qi * block_q + jax.lax.rem(r, block_q)
+    q_pos = q_offset + qi * block_q + jax.lax.rem(r, block_q)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (rows, block_k), 1)
     live = jnp.ones((rows, block_k), bool)
@@ -153,7 +157,8 @@ def _live_mask(qi, kj, rows, block_q, block_k, causal, window):
 
 
 def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_q, block_k, window, groups):
+                sm_scale, causal, block_q, block_k, window, groups,
+                q_offset=0):
     qi = pl.program_id(1)
     G = groups
     D = q_ref.shape[-1]
@@ -173,7 +178,8 @@ def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                                 preferred_element_type=jnp.float32)
         if causal or window is not None:
             s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
-                                     causal, window), s, NEG_INF)
+                                     causal, window,
+                                     q_offset), s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp2(s - m_new[:, None])
         # rows with NO live entry yet (m_new still NEG_INF — e.g. a live
@@ -202,7 +208,7 @@ def _fwd_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, *, sm_scale, causal, block_q,
-                   block_k, window, groups):
+                   block_k, window, groups, q_offset=0):
     qi = pl.program_id(1)
     G = groups
     D = q_ref.shape[-1]
@@ -222,7 +228,8 @@ def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 preferred_element_type=jnp.float32)
         if causal or window is not None:
             s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
-                                     causal, window), s, NEG_INF)
+                                     causal, window,
+                                     q_offset), s, NEG_INF)
         # masked entries must be 0 regardless of lse: for an all-masked
         # row lse is NEG_INF and s - lse2 would OVERFLOW to +inf
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
@@ -239,7 +246,8 @@ def _bwd_dq_kernel(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _fwd_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref,
                        lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
-                       causal, block_q, block_k, window, groups, t_max):
+                       causal, block_q, block_k, window, groups, t_max,
+                       q_offset=0):
     """Forward with LIVE kv blocks streamed through the innermost grid
     dimension: the k/v BlockSpec index maps read kv_idx[qi, t] from the
     scalar-prefetch channel, so only live blocks are ever DMA'd and VMEM
@@ -268,7 +276,8 @@ def _fwd_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref,
                                 preferred_element_type=jnp.float32)
         if causal or window is not None:
             s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
-                                     causal, window), s, NEG_INF)
+                                     causal, window,
+                                     q_offset), s, NEG_INF)
         m = m_scr[...][:, 0]
         l = l_scr[...][:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
@@ -298,7 +307,7 @@ def _fwd_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, o_ref,
 def _bwd_dq_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref,
                           lse_ref, delta_ref, dq_ref, dq_scr, *,
                           sm_scale, causal, block_q, block_k, window,
-                          groups, t_max):
+                          groups, t_max, q_offset=0):
     qi = pl.program_id(1)
     t = pl.program_id(2)
     G = groups
@@ -323,7 +332,8 @@ def _bwd_dq_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref,
                                 preferred_element_type=jnp.float32)
         if causal or window is not None:
             s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
-                                     causal, window), s, NEG_INF)
+                                     causal, window,
+                                     q_offset), s, NEG_INF)
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -341,7 +351,7 @@ def _bwd_dq_kernel_stream(kv_idx, kv_cnt, q_ref, k_ref, v_ref, do_ref,
 def _bwd_dkv_kernel(bm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                     sm_scale, causal, block_q, block_k, window, groups,
-                    num_q):
+                    num_q, q_offset=0):
     """dK/dV with q blocks STREAMED through the innermost grid dimension
     (VMEM holds one (G, bq, D) q/do block, not the sequence); compute for
     dead (q, kv) pairs is skipped via the prefetched block-mask
@@ -370,7 +380,8 @@ def _bwd_dkv_kernel(bm_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                 preferred_element_type=jnp.float32)
         if causal or window is not None:
             s = jnp.where(_live_mask(qi, kj, rows, block_q, block_k,
-                                     causal, window), s, NEG_INF)
+                                     causal, window,
+                                     q_offset), s, NEG_INF)
         # same NEG_INF-lse guard as the dq kernel
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp2(s - lse2[:, None]), 0.0)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
@@ -431,7 +442,7 @@ def _resolve(q, k, block_mask, sm_scale, block_q, block_k):
 
 
 def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
-                window=None):
+                window=None, q_offset=0):
     sm_scale, bq, bk, G, streamed = _resolve(q, k, block_mask, sm_scale,
                                              block_q, block_k)
     kv_idx, kv_cnt = _pattern_tables(block_mask)
@@ -469,7 +480,8 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
         )
         kernel = functools.partial(
             _fwd_kernel_stream, sm_scale=sm_scale, causal=causal,
-            block_q=bq, block_k=bk, window=window, groups=G, t_max=t_max)
+            block_q=bq, block_k=bk, window=window, groups=G, t_max=t_max,
+            q_offset=q_offset)
         semantics = ("parallel", "parallel", "arbitrary")
     else:
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -487,7 +499,7 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
         )
         kernel = functools.partial(
             _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
-            block_k=bk, window=window, groups=G)
+            block_k=bk, window=window, groups=G, q_offset=q_offset)
         semantics = ("parallel", "arbitrary")
     out, lse = pl.pallas_call(
         kernel,
@@ -505,7 +517,7 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
 
 
 def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
-                res, do):
+                q_offset, res, do):
     q, k, v, out, lse = res
     sm_scale, bq, bk, G, streamed = _resolve(q, k, block_mask, sm_scale,
                                              block_q, block_k)
@@ -547,7 +559,8 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
         )
         dq_kernel = functools.partial(
             _bwd_dq_kernel_stream, sm_scale=sm_scale, causal=causal,
-            block_q=bq, block_k=bk, window=window, groups=G, t_max=t_max)
+            block_q=bq, block_k=bk, window=window, groups=G, t_max=t_max,
+            q_offset=q_offset)
         dq_semantics = ("parallel", "parallel", "arbitrary")
     else:
         dq_spec = pltpu.PrefetchScalarGridSpec(
@@ -566,7 +579,7 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
         )
         dq_kernel = functools.partial(
             _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
-            block_k=bk, window=window, groups=G)
+            block_k=bk, window=window, groups=G, q_offset=q_offset)
         dq_semantics = ("parallel", "arbitrary")
     dq = pl.pallas_call(
         dq_kernel,
@@ -603,7 +616,8 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=bq, block_k=bk,
-                          window=window, groups=G, num_q=num_q),
+                          window=window, groups=G, num_q=num_q,
+                          q_offset=q_offset),
         grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, Sk, D), k.dtype),
@@ -618,9 +632,11 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
             dv.reshape(B, Hkv, Sk, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def splash_attention(q, k, v, block_mask, causal=False, sm_scale=None,
-                     block_q=None, block_k=None, window=None):
+                     block_q=None, block_k=None, window=None,
+                     q_offset=0):
     """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) with Hq a multiple of Hkv
     (MHA is Hq == Hkv; GQA/MQA fold the group into the kernel's M dim).
     block_mask: (Sq//block_q, Sk//block_k) bool numpy array (a static
@@ -628,7 +644,7 @@ def splash_attention(q, k, v, block_mask, causal=False, sm_scale=None,
     attention with masked-out blocks at -inf, but skipped rather than
     computed."""
     out, _ = _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q,
-                         block_k, window)
+                         block_k, window, q_offset)
     return out
 
 

@@ -201,6 +201,200 @@ def _ring_flash_local(axis: str, n: int, causal: bool, sm_scale: float):
     return ring
 
 
+def _n_active_steps(n: int, window: int, Sloc: int) -> int:
+    """Ring steps that can carry any live (query, key) pair under a
+    sliding window: the pair at chunk distance d has minimum
+    q_pos - k_pos = (d-1)*Sloc + 1, live iff < window. Steps beyond
+    that are wholly outside the band and are SKIPPED — the window-aware
+    ring's whole point (round-4 verdict item 5)."""
+    d_max = max(0, (window - 2)) // Sloc + 1
+    return min(n, d_max + 1)
+
+
+def _ring_window_splash_local(axis: str, n: int, window: int,
+                              sm_scale: float, Sloc: int):
+    """Kernel-grade window x sep: per chunk pair (distance d) the banded
+    splash kernel computes (out, lse) partials in the SHIFTED query
+    frame (q_offset = d*Sloc), merged online in log space exactly like
+    the flash ring. Only `n_active` ring steps run; later chunk pairs
+    are wholly outside the band."""
+    import numpy as np
+
+    from ..ops.pallas.splash_attention import (_splash_bwd, _splash_fwd,
+                                               banded_block_mask,
+                                               pick_splash_blocks)
+
+    n_act = _n_active_steps(n, window, Sloc)
+
+    def _pair_mask(d, bq, bk):
+        if d == 0:
+            return banded_block_mask(Sloc, Sloc, bq, bk, window)
+        nq, nk = Sloc // bq, Sloc // bk
+        bm = np.zeros((nq, nk), bool)
+        for i in range(nq):
+            for j in range(nk):
+                # min q_pos - k_pos within the block pair at distance d
+                min_gap = d * Sloc + i * bq - (j + 1) * bk + 1
+                bm[i, j] = min_gap < window
+        return bm
+
+    def _merge(O, LSE, o, lse):
+        LSE_new = jnp.logaddexp(LSE, lse)
+        wO = jnp.exp(LSE - LSE_new)[..., None]
+        wo = jnp.exp(lse - LSE_new)[..., None]
+        return O * wO + o.astype(jnp.float32) * wo, LSE_new
+
+    def _blocks(G):
+        return pick_splash_blocks(Sloc, Sloc, G)
+
+    def fwd_loop(ql, kl, vl):
+        my = jax.lax.axis_index(axis)
+        B, Hq, Sq, D = ql.shape
+        G = Hq // kl.shape[1]
+        bq, bk = _blocks(G)
+        O = jnp.zeros((B, Hq, Sq, D), jnp.float32)
+        LSE = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
+        kb, vb = kl, vl
+        for d in range(n_act):
+            bm = _pair_mask(d, bq, bk)
+            o, res = _splash_fwd(ql, kb, vb, bm, d == 0, sm_scale,
+                                 bq, bk, window, d * Sloc)
+            lse = res[4]
+            valid = my >= d  # wrapped chunks are acausal: contribute 0
+            lse = jnp.where(valid, lse, NEG_INF)
+            o = jnp.where(valid, o, 0).astype(o.dtype)
+            O, LSE = _merge(O, LSE, o, lse)
+            if d + 1 < n_act:
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                kb = jax.lax.ppermute(kb, axis, perm)
+                vb = jax.lax.ppermute(vb, axis, perm)
+        return O.astype(ql.dtype), LSE
+
+    @jax.custom_vjp
+    def ring(ql, kl, vl):
+        return fwd_loop(ql, kl, vl)[0]
+
+    def ring_fwd(ql, kl, vl):
+        O, LSE = fwd_loop(ql, kl, vl)
+        return O, (ql, kl, vl, O, LSE)
+
+    def ring_bwd(res, dO):
+        ql, kl, vl, O, LSE = res
+        my = jax.lax.axis_index(axis)
+        B, Hq, Sq, D = ql.shape
+        G = Hq // kl.shape[1]
+        bq, bk = _blocks(G)
+        dq = jnp.zeros(ql.shape, jnp.float32)
+        dk_acc = jnp.zeros(kl.shape, jnp.float32)
+        dv_acc = jnp.zeros(vl.shape, jnp.float32)
+        kb, vb = kl, vl
+        for d in range(n_act):
+            bm = _pair_mask(d, bq, bk)
+            # splash backward with the GLOBAL (out, lse): the softmax
+            # gradient decomposes per key chunk (same argument as the
+            # flash ring) and dK/dV come back at the true kv-head count
+            dql, dkb, dvb = _splash_bwd(bm, d == 0, sm_scale, bq, bk,
+                                        window, d * Sloc,
+                                        (ql, kb, vb, O, LSE), dO)
+            valid = (my >= d).astype(jnp.float32)
+            dq = dq + dql.astype(jnp.float32) * valid
+            dk_acc = dk_acc + dkb.astype(jnp.float32) * valid
+            dv_acc = dv_acc + dvb.astype(jnp.float32) * valid
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            # accumulators ride with their chunks
+            dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+            if d + 1 < n_act:
+                kb = jax.lax.ppermute(kb, axis, perm)
+                vb = jax.lax.ppermute(vb, axis, perm)
+        # chunks rotated n_act hops from home: deliver dK/dV back in one
+        # permute instead of finishing the full cycle (the skipped steps
+        # carry no gradient)
+        if n_act < n:
+            perm_home = [(j, (j - n_act) % n) for j in range(n)]
+            dk_acc = jax.lax.ppermute(dk_acc, axis, perm_home)
+            dv_acc = jax.lax.ppermute(dv_acc, axis, perm_home)
+        return (dq.astype(ql.dtype), dk_acc.astype(kl.dtype),
+                dv_acc.astype(vl.dtype))
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
+def _dense_window_ring(axis: str, n: int, window: int, sm_scale: float,
+                       Sloc: int, causal: bool = True):
+    """Dense (exact f32, autodiff-able) window x sep engine: the CPU
+    oracle for the splash ring and the fallback for splash-ineligible
+    chunk shapes. Static per-distance masks; same early termination."""
+    n_act = _n_active_steps(n, window, Sloc)
+
+    def spmd(ql, kl, vl):
+        my = jax.lax.axis_index(axis)
+        ql32 = ql.astype(jnp.float32) * sm_scale
+        Sq = ql.shape[2]
+        m = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+        l = jnp.zeros(ql.shape[:3], jnp.float32)
+        acc = jnp.zeros(ql32.shape, jnp.float32)
+        kb, vb = kl, vl
+        for d in range(n_act):
+            qp = d * Sloc + jnp.arange(Sq)[:, None]
+            kp = jnp.arange(kb.shape[2])[None, :]
+            mask = (qp - kp) < window
+            if causal:
+                mask &= qp >= kp
+            bm_, bl, bacc = _block_attn(ql32, kb, vb, mask)
+            valid = my >= d
+            bm_ = jnp.where(valid, bm_, NEG_INF)
+            bl = jnp.where(valid, bl, 0.0)
+            bacc = jnp.where(valid, bacc, 0.0)
+            m_new = jnp.maximum(m, bm_)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(bm_ - m_new)
+            l = alpha * l + beta * bl
+            acc = acc * alpha[..., None] + bacc * beta[..., None]
+            m = m_new
+            if d + 1 < n_act:
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                kb = jax.lax.ppermute(kb, axis, perm)
+                vb = jax.lax.ppermute(vb, axis, perm)
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l[..., None]).astype(ql.dtype)
+
+    return spmd
+
+
+def ring_window_attention(q, k, v, mesh: Mesh, window: int,
+                          axis: str = "sep", sm_scale=None,
+                          batch_axis=None, head_axis=None):
+    """Sliding-window attention composed with context parallelism: the
+    seq dim shards over `axis` and the ring walks ONLY the chunk pairs
+    the band touches (n_active of n steps — window 2048 at S=8192 over
+    sep=4 runs 2 of 4). Replaces the round-4 ValueError at
+    models/nlp/llama.py (window x 'sep' could not compose). q/k/v:
+    GLOBAL (batch, heads, seq, head_dim); causal Mistral semantics
+    (q_pos - k_pos < window)."""
+    from ..ops.pallas.flash_attention import flash_eligible
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = mesh.shape[axis]
+    b_ax = batch_axis if batch_axis in mesh.axis_names else None
+    h_ax = head_axis if head_axis in mesh.axis_names else None
+    Sloc = q.shape[2] // max(1, n)
+    use_splash = (q.shape[2] % max(1, n) == 0 and Sloc % 128 == 0
+                  and flash_eligible(Sloc, q.shape[-1], q.dtype))
+    if use_splash:
+        spmd = _ring_window_splash_local(axis, n, window, sm_scale, Sloc)
+    else:
+        spmd = _dense_window_ring(axis, n, window, sm_scale, Sloc)
+    spec = P(b_ax, h_ax, axis, None)
+    fn = jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(spec,) * 3,
+        out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
                    causal: bool = True, sm_scale=None,
                    batch_axis=None, head_axis=None):

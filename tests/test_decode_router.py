@@ -1,0 +1,61 @@
+"""Serving decode router (round-4 verdict item 6): dense vs paged from
+batch statistics, policy pinned to the PERF.md chip rows."""
+import numpy as np
+
+
+def test_route_policy_rules():
+    from paddle_tpu.models.nlp import route_decode
+    # uniform full large batch -> dense (B=64 chip row: dense 1.66x)
+    assert route_decode([128] * 64, 64) == "dense"
+    # small batch -> paged (B=8 chip row: paged 1.90x dense)
+    assert route_decode([128] * 8, 8) == "paged"
+    # ragged lengths -> paged even at large B
+    lens = [256] * 32 + [32] * 32
+    assert route_decode(lens, 64) == "paged"
+    # shared prefix forces paged regardless of shape
+    assert route_decode([128] * 64, 64, shared_prefix=True) == "paged"
+    # churn (continuous batching) forces paged
+    assert route_decode([128] * 64, 64, expect_churn=True) == "paged"
+    # under-full large compiled capacity -> paged (dense pays for the
+    # empty slots)
+    assert route_decode([128] * 40, 64) == "paged"
+
+
+def test_serving_factory_routes_and_decodes():
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import (LlamaConfig, LlamaForCausalLM,
+                                       llama_serving_decode_factory)
+    from paddle_tpu.ops.pallas.paged_attention import PagedKVCache
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    serving = llama_serving_decode_factory(model, max_len=32,
+                                           page_size=8, n_pool_pages=32)
+    rng = np.random.default_rng(0)
+    prompt = np.asarray(rng.integers(1, cfg.vocab_size, (2, 8)), np.int32)
+
+    # ragged batch routes paged; drive the paged path end to end
+    backend, parts = serving.pick([8, 3])
+    assert backend == "paged"
+    outer, layers, pools, prefill, step, decode_n = parts
+    book = PagedKVCache(32, 8, cfg.num_key_value_heads,
+                        cfg.hidden_size // cfg.num_attention_heads)
+    for b in range(2):
+        book.allocate(b, 16)
+        book.lengths[b] = 8
+    pt, lens = book.batch_views([0, 1])
+    nxt, pools = prefill(outer, layers, jnp.asarray(prompt), pt, lens,
+                         pools)
+    nxt, pools = step(outer, layers, nxt, pt, lens, pools)
+    assert np.asarray(nxt).shape == (2,)
+
+    # uniform full large batch routes dense; drive the dense path
+    backend, gen = serving.pick([16] * 64, capacity=64)
+    assert backend == "dense"
+    out = gen(jnp.asarray(prompt), max_new_tokens=4)
+    assert np.asarray(out).shape[1] == prompt.shape[1] + 4

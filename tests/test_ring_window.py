@@ -1,0 +1,206 @@
+"""Window x sep composition: ring_window_attention vs the dense oracle.
+
+Round-4 verdict item 5: sliding_window and context parallelism (the two
+long-context features) must compose. The ring walks only the chunk
+pairs the band touches; these tests check exact parity (fwd + grads)
+against global dense windowed attention on the virtual CPU mesh,
+including GQA head grouping and windows that skip ring steps.
+"""
+import numpy as np
+import pytest
+
+
+def _dense_window_oracle(q, k, v, window, sm_scale):
+    """Global banded-causal attention in f64-ish f32 numpy."""
+    B, Hq, S, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    kf = np.repeat(k, G, axis=1)
+    vf = np.repeat(v, G, axis=1)
+    s = np.einsum("bhqd,bhkd->bhqk", q, kf).astype(np.float64) * sm_scale
+    qp = np.arange(S)[:, None]
+    kp = np.arange(S)[None, :]
+    live = (qp >= kp) & ((qp - kp) < window)
+    s = np.where(live, s, -1e30)
+    m = s.max(-1, keepdims=True)
+    p = np.exp(s - m)
+    p = np.where(live, p, 0.0)
+    l = p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p / np.maximum(l, 1e-30), vf)
+
+
+def _mesh(n):
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()[:n]), ("sep",))
+
+
+@pytest.mark.parametrize("sep,S,window,Hq,Hkv", [
+    (2, 64, 24, 2, 2),    # window inside one chunk: 1 active step of 2
+    (4, 64, 24, 2, 2),    # window spans 2 chunks of 4: skip 2 steps
+    (4, 64, 48, 4, 2),    # GQA + window spanning 3 chunks
+    (2, 64, 64, 2, 1),    # window == S degenerates to full causal, MQA
+])
+def test_ring_window_matches_dense_oracle(sep, S, window, Hq, Hkv):
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.ring_attention import ring_window_attention
+    rng = np.random.default_rng(0)
+    B, D = 2, 16
+    q = rng.standard_normal((B, Hq, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, Hkv, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, Hkv, S, D)).astype(np.float32)
+    sm = 1.0 / np.sqrt(D)
+    ref = _dense_window_oracle(q, k, v, window, sm)
+    out = ring_window_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v), _mesh(sep), window)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_ring_window_skips_out_of_band_steps():
+    from paddle_tpu.parallel.ring_attention import _n_active_steps
+    # S=8192, sep=4 -> Sloc=2048; window=2048 touches distance 0 and 1
+    # (queries at a chunk start still see the previous chunk's tail)
+    assert _n_active_steps(4, 2048, 2048) == 2
+    assert _n_active_steps(4, 1024, 2048) == 2
+    # window covering everything: full ring
+    assert _n_active_steps(4, 8192, 2048) == 4
+    # distance-2 pairs only come live once window exceeds Sloc + 1
+    assert _n_active_steps(4, 2050, 2048) == 3
+
+
+def test_ring_window_grads_match_dense_oracle():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.ring_attention import ring_window_attention
+    rng = np.random.default_rng(1)
+    B, Hq, Hkv, S, D, W, sep = 1, 2, 1, 64, 16, 24, 4
+    q = rng.standard_normal((B, Hq, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, Hkv, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, Hkv, S, D)).astype(np.float32)
+    mesh = _mesh(sep)
+    sm = 1.0 / np.sqrt(D)
+
+    def ring_loss(q, k, v):
+        out = ring_window_attention(q, k, v, mesh, W)
+        return jnp.sum(out * out)
+
+    def dense_loss(q, k, v):
+        G = Hq // Hkv
+        kf = jnp.repeat(k, G, axis=1)
+        vf = jnp.repeat(v, G, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kf) * sm
+        qp = jnp.arange(S)[:, None]
+        kp = jnp.arange(S)[None, :]
+        live = (qp >= kp) & ((qp - kp) < W)
+        s = jnp.where(live, s, -1e30)
+        p = jax.nn.softmax(s, -1)
+        p = jnp.where(live, p, 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        return jnp.sum(out * out)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b, name in zip(g_ring, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, err_msg=f"d{name}")
+
+
+def test_llama_window_on_sep_mesh_matches_single_device():
+    """Model-level: a sliding-window Llama forward on a sep=2 mesh must
+    equal the same model on one device (the round-4 ValueError path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama import llama_train_step_factory
+
+    cfg = LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4)
+    cfg.sliding_window = 8
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    ref = np.asarray(model(paddle.to_tensor(tok.copy()))._value)
+
+    mesh = _mesh(2)
+    params = {k: v._value for k, v in model.state_dict().items()}
+
+    def fwd(params, tokens):
+        model.load_tree(params)
+        return model(Tensor(tokens))._value
+
+    with mesh:
+        out = jax.jit(fwd)(params, jnp.asarray(tok))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4,
+                               rtol=2e-4)
+
+    # and the full train step runs on the sep mesh
+    paddle.seed(3)
+    m2 = LlamaForCausalLM(cfg)
+    p, o, step, _ = llama_train_step_factory(m2, mesh, remat=False)
+    _, _, loss = step(p, o, jnp.asarray(tok), jnp.asarray(tok))
+    assert np.isfinite(float(loss))
+
+
+def test_ring_window_splash_engine_interpret(monkeypatch):
+    """Splash-engine path (the one real TPU sep training takes) vs the
+    dense oracle in interpret mode — validates the q_offset
+    shifted-frame kernels, the online lse merge, the custom-VJP ring
+    backward and the early dK/dV homing permute. CPU's flash_eligible
+    gate is forced open so this does NOT silently take the dense
+    fallback (round-5 review finding)."""
+    import jax
+    import jax.numpy as jnp
+
+    import sys as _sys
+
+    import paddle_tpu.ops.pallas.flash_attention  # noqa: F401
+    from paddle_tpu.parallel.ring_attention import ring_window_attention
+    fa_mod = _sys.modules["paddle_tpu.ops.pallas.flash_attention"]
+    monkeypatch.setattr(fa_mod, "flash_eligible",
+                        lambda *a, **kw: True)
+    rng = np.random.default_rng(2)
+    B, Hq, Hkv, S, D, W, sep = 1, 2, 2, 512, 64, 160, 4
+    q = rng.standard_normal((B, Hq, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, Hkv, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, Hkv, S, D)).astype(np.float32)
+    sm = 1.0 / np.sqrt(D)
+    mesh = _mesh(sep)
+    # Sloc=128, window=160 -> 3 active ring steps of 4 (tests both the
+    # cross-chunk pairs AND the skipped step + homing permute)
+    out = ring_window_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v), mesh, W)
+    ref = _dense_window_oracle(q, k, v, W, sm)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-2, rtol=2e-2)
+
+    def ring_loss(q, k, v):
+        o = ring_window_attention(q, k, v, mesh, W)
+        return jnp.sum(o * o)
+
+    def dense_loss(q, k, v):
+        G = Hq // Hkv
+        kf = jnp.repeat(k, G, axis=1)
+        vf = jnp.repeat(v, G, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kf) * sm
+        qp = jnp.arange(S)[:, None]
+        kp = jnp.arange(S)[None, :]
+        live = (qp >= kp) & ((qp - kp) < W)
+        s = jnp.where(live, s, -1e30)
+        p = jax.nn.softmax(s, -1)
+        p = jnp.where(live, p, 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        return jnp.sum(o * o)
+
+    g = jax.grad(ring_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b, name in zip(g, gd, "qkv"):
+        scale = max(1e-3, float(jnp.abs(b).max()))
+        err = float(jnp.abs(a - b).max()) / scale
+        assert err < 5e-2, f"d{name} rel err {err}"

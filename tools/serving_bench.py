@@ -196,5 +196,109 @@ def main():
               "stats": getattr(spec, "last_stats", {})})
 
 
-if __name__ == "__main__":
+if __name__ == "__main__" and "b64" not in sys.argv:
     main()
+
+
+def b64_ablation():
+    """Round-4 verdict item 6b: the uniform-B=64 paged-vs-dense gap
+    (2093 vs 3474 tok/s at page_size=64) ablated over page_size, to
+    establish whether 0.6x dense is fundamental or a tile-size artifact.
+    Dense baseline re-measured in the same process."""
+    import os
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import (LlamaConfig, LlamaForCausalLM,
+                                       llama_paged_decode_factory)
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    from paddle_tpu.ops.pallas.paged_attention import PagedKVCache
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048,
+                          dtype=jnp.bfloat16)
+        B, prompt_len, new = 64, 128, 128
+        sizes = (256,) if "ps256" in sys.argv else (32, 64, 128)
+    else:
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        B, prompt_len, new = 4, 8, 8
+        sizes = (8,)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    prompt = np.asarray(rng.integers(1, cfg.vocab_size, (B, prompt_len)),
+                        np.int32)
+
+    def emit(rec):
+        rec["device"] = str(jax.devices()[0])
+        print(json.dumps(rec), flush=True)
+
+    # dense decode-only baseline (differenced, as in main())
+    gen = llama_decode_factory(model, max_len=prompt_len + new)
+    _ = np.asarray(gen(jnp.asarray(prompt), max_new_tokens=new))
+    _ = np.asarray(gen(jnp.asarray(prompt), max_new_tokens=1))
+    reps = 3 if on_tpu else 1
+
+    def timed(n_tok):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            o = gen(jnp.asarray(prompt), max_new_tokens=n_tok)
+        _ = np.asarray(o)
+        return (time.perf_counter() - t0) / reps
+
+    dense_per_tok = (timed(new) - timed(1)) / max(1, new - 1)
+    dense_tps = B / dense_per_tok
+    emit({"bench": "b64_dense_decode_only", "B": B,
+          "tokens_per_sec": round(dense_tps, 1)})
+
+    for ps in sizes:
+        npages_seq = -(-(prompt_len + new) // ps)
+        pool_pages = B * npages_seq + 2
+        try:
+            o, l, pools, prefill, step, decode_n = \
+                llama_paged_decode_factory(model, page_size=ps,
+                                           n_pool_pages=pool_pages)
+            book = PagedKVCache(pool_pages, ps, cfg.num_key_value_heads,
+                                cfg.hidden_size
+                                // cfg.num_attention_heads)
+            for b in range(B):
+                book.allocate(b, npages_seq * ps)
+                book.lengths[b] = prompt_len
+            pt, lens = book.batch_views(list(range(B)))
+            T = ps * (-(-prompt_len // ps))
+            toks = np.zeros((B, T), np.int64)
+            toks[:, :prompt_len] = prompt
+            nxt, pools = prefill(o, l, jnp.asarray(toks), pt, lens,
+                                 pools)
+            _, nxt2, pools = decode_n(o, l, nxt, pt, lens, pools, new)
+            _ = np.asarray(nxt2)
+            t0 = time.perf_counter()
+            _, nxt2, pools = decode_n(o, l, nxt, pt, lens, pools, new)
+            _ = np.asarray(nxt2)
+            dt = time.perf_counter() - t0
+            emit({"bench": "b64_paged_amortized", "B": B,
+                  "page_size": ps, "new": new,
+                  "tokens_per_sec": round(B * new / dt, 1),
+                  "vs_dense_decode_only": round(
+                      (B * new / dt) / dense_tps, 3)})
+        except Exception as e:  # noqa: BLE001 — a failing size is a row
+            emit({"bench": "b64_paged_amortized", "page_size": ps,
+                  "error": repr(e)[-300:]})
+
+
+if __name__ == "__main__" and "b64" in sys.argv:
+    b64_ablation()
+    sys.exit(0)
