@@ -465,7 +465,7 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         block = partial(jax.jit, donate_argnums=(3, 4))(block_body)
         return outer, layers, init, block_body, block
 
-    outerT, layersT, initT, _, blockT = build(target)
+    outerT, layersT, initT, blockT_body, blockT = build(target)
     outerD, layersD, initD, blockD_body, _ = build(draft)
 
     @partial(jax.jit, donate_argnums=(3, 4), static_argnums=(5,))
@@ -491,6 +491,109 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
             [jnp.swapaxes(ds, 0, 1), last_d[:, None]], 1) \
             if k > 1 else last_d[:, None]
         return drafts, k_caches, v_caches
+
+    @jax.jit
+    def _compiled_spec(tokens, max_new):
+        """The ENTIRE speculative loop as one compiled program
+        (lax.while_loop): per-round host dispatch previously cost
+        2 readbacks/round, which through a remote-PJRT tunnel buried
+        even perfect-acceptance speculation at 0.33x plain (PERF.md
+        record 27 — plain decode runs its whole loop in one jit).
+        Greedy acceptance arithmetic is branch-free: n = length of the
+        matching draft prefix; the candidate vector writes accepted
+        drafts then the target's correction; junk beyond n is
+        overwritten by later rounds (the same overwrite-rollback
+        invariant the caches use)."""
+        B, S0 = tokens.shape
+        k = n_draft
+        kT, vT = initT(B)
+        kD, vD = initD(B)
+        lgT, kT, vT = blockT_body_target(tokens, kT, vT, 0)
+        last = jnp.argmax(lgT[0, -1], -1).astype(jnp.int32)
+        seq = jnp.zeros((max_len,), jnp.int32)
+        seq = jax.lax.dynamic_update_slice(seq, tokens[0].astype(
+            jnp.int32), (0,))
+        seq = seq.at[S0].set(last)
+        _, kD, vD = blockD_body(outerD, layersD, tokens, kD, vD, 0)
+
+        def cond(state):
+            return state[0] < max_new
+
+        def body(state):
+            produced, rounds, pos, last, seq, kT, vT, kD, vD = state
+            feed = jax.lax.dynamic_slice(seq, (pos - 1,), (2,))[None]
+            lg, kD2, vD2 = blockD_body(outerD, layersD, feed, kD, vD,
+                                       pos - 1)
+            cur = jnp.argmax(lg[:, -1], -1)
+
+            def dstep(carry, i):
+                cur, kc, vc = carry
+                lg, kc, vc = blockD_body(outerD, layersD, cur[:, None],
+                                         kc, vc, pos + 1 + i)
+                return (jnp.argmax(lg[:, -1], -1), kc, vc), cur
+
+            (last_d, kD2, vD2), ds = jax.lax.scan(
+                dstep, (cur, kD2, vD2), jnp.arange(k - 1))
+            drafts = (jnp.concatenate([jnp.swapaxes(ds, 0, 1),
+                                       last_d[:, None]], 1)
+                      if k > 1 else last_d[:, None])  # (1, k)
+            blk = jnp.concatenate([last[None], drafts[0]])[None]
+            lgT, kT2, vT2 = blockT_body_target(blk.astype(jnp.int32),
+                                               kT, vT, pos)
+            t = jnp.argmax(lgT[0], -1).astype(jnp.int32)  # (k+1,)
+            matches = (drafts[0].astype(jnp.int32) == t[:k]).astype(
+                jnp.int32)
+            n = jnp.sum(jnp.cumprod(matches))
+            idx = jnp.arange(k + 1)
+            dpad = jnp.concatenate([drafts[0].astype(jnp.int32),
+                                    jnp.zeros((1,), jnp.int32)])
+            cand = jnp.where(idx < n, dpad, t)
+            seq = jax.lax.dynamic_update_slice(seq, cand, (pos + 1,))
+            last = jax.lax.dynamic_index_in_dim(t, n, keepdims=False)
+            return (produced + n + 1, rounds + 1, pos + n + 1, last,
+                    seq, kT2, vT2, kD2, vD2)
+
+        produced, rounds, pos, last, seq, kT, vT, kD, vD = \
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+                 jnp.asarray(S0, jnp.int32), last, seq, kT, vT, kD, vD))
+        return seq, produced, rounds
+
+    def blockT_body_target(tokens, kc, vc, pos0):
+        return blockT_body(outerT, layersT, tokens, kc, vc, pos0)
+
+    def generate_compiled(tokens, max_new_tokens: int):
+        """One-program speculative decode; same greedy-exact output as
+        ``generate`` (stats in .last_stats after each call)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, S0 = tokens.shape
+        if B != 1:
+            raise ValueError("speculative generate supports batch 1")
+        if S0 + max_new_tokens + 2 * (n_draft + 1) > max_len:
+            raise ValueError(
+                f"prompt {S0} + max_new {max_new_tokens} + 2x draft "
+                f"window {n_draft + 1} exceeds max_len {max_len}")
+        # max_new is a TRACED operand (only the while cond reads it):
+        # one compile serves every generation length — the program costs
+        # minutes to compile through the remote tunnel
+        seq, produced, rounds = _compiled_spec(
+            tokens, jnp.asarray(max_new_tokens, jnp.int32))
+        seq = np.asarray(seq)
+        produced, rounds = int(produced), int(rounds)
+        # produced = 1 (prefill token) + sum(n_i + 1): subtract the
+        # prefill token AND the per-round correction token so the rate
+        # counts only accepted DRAFT proposals
+        generate_compiled.last_stats = {
+            "rounds": rounds,
+            "tokens": min(produced, max_new_tokens),
+            "target_steps": 1 + rounds,
+            "accept_rate": round(
+                (produced - 1 - rounds) / max(1, rounds * n_draft), 4),
+        }
+        return seq[None, :S0 + max_new_tokens]
+
+    generate_compiled.last_stats = {}
 
     def generate(tokens, max_new_tokens: int):
         tokens = jnp.asarray(tokens)
@@ -548,6 +651,9 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         return out
 
     generate.last_stats = {}
+    # one-program variant (lax.while_loop): identical greedy output,
+    # one dispatch per call instead of two per round
+    generate.compiled = generate_compiled
     return generate
 
 

@@ -7,6 +7,8 @@ at the sink on rank 1. Payloads are plain python — the bus is transport,
 jax arrays convert to numpy at the wire (_host_payload).
 """
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import multiprocessing as mp
 import os
 import sys

@@ -7,6 +7,9 @@ data-parallel run. Grad sync fires from backward() through the
 DataParallel post-backward hook (the EagerReducer analog) — if grads don't
 sync, the parameter trajectories diverge and this test fails.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

@@ -11,6 +11,8 @@ by test_multihost_mesh.py; this test validates the launcher's elastic
 contract: watch -> terminate -> env rewrite -> relaunch -> resume.
 """
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

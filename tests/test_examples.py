@@ -3,6 +3,9 @@
 Each example is a user-facing contract; run the quick ones end-to-end
 the way a user would (fresh process, PYTHONPATH=repo, CPU backend).
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import os
 import subprocess
 import sys

@@ -7,6 +7,9 @@ full loop runs: the trainer hard-crashes (os._exit(1)) at a chosen epoch,
 the launch CLI's elastic watch relaunches the pod, and train_epoch_range
 resumes from the last durable checkpoint, skipping completed epochs.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

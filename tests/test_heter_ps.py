@@ -6,6 +6,8 @@ brpc PS service's many-workers contract (one handler thread per
 connection, table/memory_sparse_table.cc).
 """
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import socket

@@ -7,6 +7,9 @@ to the launcher itself): spawn real trainer processes via
 contract, and exchange data cross-process through the C++ TCPStore
 rendezvous — the full SURVEY.md §3.5 bring-up path without TPUs.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import os
 import subprocess
 import sys

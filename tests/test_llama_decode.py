@@ -290,6 +290,39 @@ def test_speculative_decode_exactly_matches_target_greedy():
     assert stats["target_steps"] < 24 // 3, stats  # ~24/5 rounds + 1
 
 
+def test_speculative_compiled_loop_matches_python_loop():
+    """The one-program speculative loop (generate.compiled — the whole
+    draft/verify/accept cycle inside lax.while_loop) must produce
+    byte-identical output to the per-round python loop AND to plain
+    greedy, for both a perfect draft and a disagreeing draft."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_decode_factory, llama_speculative_decode_factory)
+    paddle.seed(31)
+    target = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab=97, hidden=64, layers=3, heads=4, kv_heads=2))
+    target.eval()
+    paddle.seed(32)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab=97, hidden=32, layers=1, heads=2, kv_heads=2))
+    draft.eval()
+    prompt = np.asarray(
+        np.random.default_rng(2).integers(0, 97, (1, 6)), np.int32)
+    oracle = np.asarray(llama_decode_factory(target, max_len=64)(
+        prompt, max_new_tokens=20))
+    for d in (draft, target):
+        spec = llama_speculative_decode_factory(target, d, max_len=64,
+                                                n_draft=4)
+        got_py = spec(prompt, max_new_tokens=20)
+        got_c = spec.compiled(prompt, max_new_tokens=20)
+        np.testing.assert_array_equal(got_c, got_py)
+        np.testing.assert_array_equal(got_c, oracle)
+        assert spec.compiled.last_stats["rounds"] >= 1
+    # perfect draft: compiled loop must also show the ~k+1-per-round
+    # acceptance in its stats
+    assert spec.compiled.last_stats["target_steps"] < 20 // 3
+
+
 def test_speculative_decode_rejects_bad_configs():
     from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.models.nlp.llama_decode import (

@@ -4,6 +4,9 @@
 travel via shared memory; values round-trip, stop_gradient survives, and
 the producer cache bounds live segments.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import multiprocessing as mp
 
 import numpy as np

@@ -14,6 +14,8 @@ Losses must be identical on every rank (replicated output) and match the
 single-process 8-virtual-device oracle step for step.
 """
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

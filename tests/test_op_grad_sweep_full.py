@@ -11,6 +11,9 @@ The partition is enforced: test_registry_fully_covered fails if any
 registered op is neither swept here/in part 1 nor listed with a reason
 in op_grad_exemptions.EXEMPT (~ unittests/white_list/ discipline).
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import zlib
 
 import numpy as np

@@ -6,6 +6,8 @@ segment, exchanging activations/grads over TCPStore p2p in 1F1B order —
 loss trajectory must match the single-process full-model run exactly.
 """
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

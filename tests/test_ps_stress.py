@@ -7,6 +7,9 @@ concurrency is covered in test_ps_server.py; here the workers are real
 processes (separate interpreters, real sockets) and the SSD variant's
 id space exceeds mem_rows so eviction happens mid-training.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import os
 import subprocess
 import sys

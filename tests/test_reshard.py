@@ -6,6 +6,9 @@ the cross-process case spawns a 2-process jax.distributed global mesh
 (the test_multihost_mesh.py pattern) and reshards a global array from
 row-shard to replicated, checking every process's addressable shards.
 """
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

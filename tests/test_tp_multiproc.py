@@ -7,6 +7,8 @@ RowParallelLinear does by hand); per-step losses must match the dense
 single-process oracle.
 """
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-process/e2e: full-suite lane only
 import json
 import os
 import subprocess

@@ -13,6 +13,17 @@ from pathlib import Path
 REF = Path("/root/reference/python/paddle")
 
 # namespace -> (reference file(s) carrying __all__, our module path)
+# Namespaces whose reference module exposes no __all__ (round-4 verdict
+# item 9: the audit previously printed "NO __all__ FOUND" and checked
+# nothing there). Expected names hand-rolled from the reference source:
+# python/paddle/callbacks.py:15-21 re-exports exactly these from
+# hapi/callbacks.py (whose own __all__ is empty).
+HAND_ROLLED = {
+    "paddle.callbacks": ["Callback", "ProgBarLogger", "ModelCheckpoint",
+                         "VisualDL", "LRScheduler", "EarlyStopping",
+                         "ReduceLROnPlateau"],
+}
+
 NAMESPACES = {
     "paddle (tensor methods/ops)": (["__init__.py"], "paddle_tpu"),
     "paddle.nn": (["nn/__init__.py"], "paddle_tpu.nn"),
@@ -41,8 +52,8 @@ NAMESPACES = {
     "paddle.utils": (["utils/__init__.py"], "paddle_tpu.utils"),
     "paddle.incubate": (["incubate/__init__.py"], "paddle_tpu.incubate"),
     "paddle.autograd": (["autograd/__init__.py"], "paddle_tpu.autograd"),
-    "paddle.callbacks": (["callbacks/__init__.py"], "paddle_tpu.callbacks"),
-    "paddle.regularizer": (["regularizer/__init__.py"], "paddle_tpu.regularizer"),
+    "paddle.callbacks": (["callbacks.py"], "paddle_tpu.callbacks"),
+    "paddle.regularizer": (["regularizer.py"], "paddle_tpu.regularizer"),
     "paddle.profiler": (["profiler/__init__.py"], "paddle_tpu.profiler"),
     "paddle.device": (["device/__init__.py"], "paddle_tpu.framework.device"),
     "paddle.onnx": (["onnx/__init__.py"], "paddle_tpu.onnx"),
@@ -78,7 +89,7 @@ def main():
     total_missing = 0
     report = []
     for ns, (rels, ours_path) in NAMESPACES.items():
-        ref_names = ref_all(rels)
+        ref_names = ref_all(rels) or HAND_ROLLED.get(ns, [])
         if not ref_names:
             report.append((ns, None, None, "NO __all__ FOUND"))
             continue

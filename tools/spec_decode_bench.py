@@ -1,0 +1,201 @@
+"""Speculative decoding with a REAL (trained) draft — chip bench.
+
+Round-4 verdict item 7: the spec-decode rows were mechanism-only
+(draft=target accepted perfectly yet measured 0.33x plain because every
+round paid 2 host dispatches through the tunnel; a random draft accepts
+~0). This bench closes both gaps:
+
+  1. the ONE-PROGRAM speculative loop (generate.compiled — the whole
+     draft/verify/accept loop inside lax.while_loop, one dispatch per
+     call, same greedy-exact output), and
+  2. a draft that genuinely approximates the target: both models train
+     on a deterministic synthetic task (fixed random permutation
+     next-token map over a 256-id sub-vocabulary) until the mapping is
+     learned, so the 9x-smaller draft proposes what the target would
+     emit and acceptance is earned, not assumed.
+
+Emits one JSON line per row. Run:
+  PYTHONPATH=/root/repo:/root/.axon_site python tools/spec_decode_bench.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SUB_V = 256  # task sub-vocabulary (ids 1..256): memorizable quickly
+
+
+def _task_batch(rng, perm, B, S):
+    """Sequences following next = perm[cur] (ids offset by 1 to avoid
+    token 0). Returns (tokens, labels) position-aligned for the train
+    factories (callers of the task shift by construction here)."""
+    starts = rng.integers(0, SUB_V, B)
+    seq = np.empty((B, S + 1), np.int64)
+    seq[:, 0] = starts
+    for t in range(S):
+        seq[:, t + 1] = perm[seq[:, t]]
+    seq += 1
+    return seq[:, :-1], seq[:, 1:]
+
+
+def _train(model, mesh, perm, steps, B, S, lr, label):
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.nlp.llama import llama_train_step_factory
+    params, opt, step, _ = llama_train_step_factory(
+        model, mesh, learning_rate=lr, remat=False)
+    rng = np.random.default_rng(0)
+    loss = None
+    t0 = time.perf_counter()
+    for i in range(steps):
+        tok, lab = _task_batch(rng, perm, B, S)
+        params, opt, loss = step(params, opt, jnp.asarray(tok, jnp.int32),
+                                 jnp.asarray(lab, jnp.int32))
+    lv = float(loss)
+    # write the trained weights back into the model for the decode
+    # factories (they read model.state_dict())
+    model.load_tree({k: v for k, v in params.items()})
+    return lv, time.perf_counter() - t0
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_decode_factory, llama_speculative_decode_factory)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    paddle.seed(0)
+    if on_tpu:
+        tgt_cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                              intermediate_size=4096,
+                              num_hidden_layers=12,
+                              num_attention_heads=12,
+                              num_key_value_heads=12,
+                              max_position_embeddings=2048,
+                              dtype=jnp.bfloat16)
+        drf_cfg = LlamaConfig(vocab_size=32000, hidden_size=512,
+                              intermediate_size=1408,
+                              num_hidden_layers=4,
+                              num_attention_heads=8,
+                              num_key_value_heads=8,
+                              max_position_embeddings=2048,
+                              dtype=jnp.bfloat16)
+        steps_t, steps_d, B, S = 150, 300, 16, 256
+        prompt_len, new = 32, 128
+        drafts = (4, 8)
+    else:
+        tgt_cfg = LlamaConfig.tiny(vocab=300, hidden=64, layers=2,
+                                   heads=4)
+        drf_cfg = LlamaConfig.tiny(vocab=300, hidden=32, layers=1,
+                                   heads=2)
+        steps_t, steps_d, B, S = 60, 60, 8, 32
+        prompt_len, new = 8, 16
+        drafts = (4,)
+
+    rng = np.random.default_rng(7)
+    perm = rng.permutation(SUB_V)
+
+    def emit(rec):
+        rec["device"] = str(jax.devices()[0])
+        print(json.dumps(rec), flush=True)
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    target = LlamaForCausalLM(tgt_cfg)
+    draft = LlamaForCausalLM(drf_cfg)
+    if on_tpu:
+        target.to(dtype="bfloat16")
+        draft.to(dtype="bfloat16")
+    lt, tt = _train(target, mesh, perm, steps_t, B, S, 3e-4, "target")
+    ld, td = _train(draft, mesh, perm, steps_d, B, S, 1e-3, "draft")
+    n_t = sum(int(np.prod(p.shape)) for p in
+              target.state_dict().values())
+    n_d = sum(int(np.prod(p.shape)) for p in draft.state_dict().values())
+    emit({"bench": "spec_distill_train", "target_loss": round(lt, 4),
+          "draft_loss": round(ld, 4), "target_params": n_t,
+          "draft_params": n_d,
+          "size_ratio": round(n_t / n_d, 1),
+          "train_s": round(tt + td, 1)})
+    target.eval()
+    draft.eval()
+
+    # task-distribution prompt
+    ptok, _ = _task_batch(np.random.default_rng(99), perm, 1,
+                          prompt_len)
+    prompt = ptok[:, :prompt_len].astype(np.int32)
+
+    max_len = prompt_len + new + 32
+    gen = llama_decode_factory(target, max_len=max_len)
+    plain = np.asarray(gen(jnp.asarray(prompt), max_new_tokens=new))
+    reps = 3 if on_tpu else 1
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        plain = np.asarray(gen(jnp.asarray(prompt), max_new_tokens=new))
+    plain_dt = (time.perf_counter() - t0) / reps
+    emit({"bench": "spec_plain_decode", "new": new,
+          "s": round(plain_dt, 3),
+          "tokens_per_sec": round(new / plain_dt, 1)})
+
+    for nd in drafts:
+        spec = llama_speculative_decode_factory(target, draft,
+                                                max_len=max_len,
+                                                n_draft=nd)
+        skip_compiled = "--no-compiled" in sys.argv
+        if skip_compiled:
+            # the axon tunnel's remote_compile hung >35 min on the
+            # while_loop spec program (then broke the pipe on another
+            # try) — the compiled loop is CPU-verified by
+            # tests/test_llama_decode.py; on the tunnel, measure the
+            # python loop and report acceptance as the evidence
+            emit({"bench": "spec_compiled_distilled", "n_draft": nd,
+                  "skipped": "tunnel remote_compile hangs on the "
+                             "while_loop program (infra, not model)"})
+        else:
+            try:
+                out = spec.compiled(prompt, max_new_tokens=new)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    out = spec.compiled(prompt, max_new_tokens=new)
+                dt = (time.perf_counter() - t0) / reps
+                emit({"bench": "spec_compiled_distilled", "n_draft": nd,
+                      "new": new, "s": round(dt, 3),
+                      "speedup_vs_plain": round(plain_dt / dt, 2),
+                      "output_matches_plain": bool(
+                          (out[:, :plain.shape[1]] == plain).all()),
+                      "stats": spec.compiled.last_stats})
+                continue
+            except Exception as e:  # noqa: BLE001 — tunnel compile
+                # loss is a real failure mode; fall through to the
+                # python loop so the ACCEPTANCE evidence still lands
+                emit({"bench": "spec_compiled_distilled", "n_draft": nd,
+                      "error": repr(e)[-250:]})
+        out = spec(prompt, max_new_tokens=new)
+        t0 = time.perf_counter()
+        out = spec(prompt, max_new_tokens=new)
+        dt = time.perf_counter() - t0
+        emit({"bench": "spec_python_loop_distilled", "n_draft": nd,
+              "new": new, "s": round(dt, 3),
+              "speedup_vs_plain": round(plain_dt / dt, 2),
+              "output_matches_plain": bool(
+                  (out[:, :plain.shape[1]] == plain).all()),
+              "stats": spec.last_stats,
+              "note": "per-round host dispatch through the tunnel; "
+                      "acceptance is the distillation evidence"})
+
+
+if __name__ == "__main__":
+    main()
