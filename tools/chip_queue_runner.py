@@ -39,6 +39,18 @@ QUEUE = [
     ("gqa_xlong_ab", [sys.executable, "tools/gqa_xlong_bench.py"], {}),
     ("serving_bench",
      [sys.executable, "tools/serving_bench.py"], {}),
+    # round-5 additions: MoE dispatch A/B (indexed vs one-hot einsum),
+    # adamw-true TP-shard compute term, speculative decoding with the
+    # trained draft (python-loop rows; the compiled while_loop program
+    # hangs the tunnel's remote_compile — retry WITHOUT --no-compiled
+    # in a fresh window to probe whether the infra recovered)
+    ("moe_dispatch_ab",
+     [sys.executable, "tools/moe_dispatch_bench.py"], {}),
+    ("mfu_scale_tp_shard_adamw",
+     [sys.executable, "tools/mfu_scale.py", "tp_shard_adamw", "8"], {}),
+    ("spec_decode_distilled",
+     [sys.executable, "tools/spec_decode_bench.py", "--no-compiled"],
+     {}),
     # ONE bench run per window, wrapped by the regression gate (round-4
     # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
     # gate snapshots the baseline, runs bench.py, fails on >5% legacy-
