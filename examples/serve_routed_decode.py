@@ -1,0 +1,67 @@
+"""Serving with the decode ROUTER: dense vs paged picked per batch.
+
+`route_decode` encodes the measured chip policy (PERF.md records
+27/29/34): uniform near-full large batches decode fastest on the dense
+compiled cache; ragged, shared-prefix, or churning batches belong on
+the paged pool. `llama_serving_decode_factory` builds BOTH backends
+once; `pick()` routes each admission wave.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def main():
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.nlp import (LlamaConfig, LlamaForCausalLM,
+                                       llama_serving_decode_factory,
+                                       route_decode)
+    from paddle_tpu.ops.pallas import PagedKVCache
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=96, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    serving = llama_serving_decode_factory(model, max_len=48,
+                                           page_size=8, n_pool_pages=32)
+    rng = np.random.default_rng(0)
+
+    # wave 1: a uniform full batch of equal-length prompts -> dense
+    lens = [8] * 64
+    backend, gen = serving.pick(lens, capacity=64)
+    print(f"wave 1 (uniform x{len(lens)}): routed -> {backend}")
+    assert backend == "dense"
+    prompt = np.asarray(rng.integers(1, 96, (2, 8)), np.int32)
+    out = gen(jnp.asarray(prompt), max_new_tokens=6)
+    print("dense decode out shape:", tuple(np.asarray(out).shape))
+
+    # wave 2: ragged lengths -> paged (pages track real depths)
+    lens = [3, 8, 5, 2]
+    backend, parts = serving.pick(lens)
+    print(f"wave 2 (ragged {lens}): routed -> {backend}")
+    assert backend == "paged"
+    outer, layers, pools, prefill, step, _ = parts
+    book = PagedKVCache(32, 8, kv_heads=2,
+                        head_dim=cfg.hidden_size
+                        // cfg.num_attention_heads)
+    for b in range(2):
+        book.allocate(b, 16)
+        book.lengths[b] = 8
+    pt, lengths = book.batch_views([0, 1])
+    nxt, pools = prefill(outer, layers, jnp.asarray(prompt), pt,
+                         lengths, pools)
+    for i in range(4):
+        nxt, pools = step(outer, layers, nxt, pt, lengths + 1 + i,
+                          pools)
+    print("paged decode next tokens:", np.asarray(nxt).tolist())
+
+    # wave 3: shared prefix forces paged even when uniform
+    print("wave 3 (shared prefix):",
+          route_decode([8] * 64, 64, shared_prefix=True))
+    print("routed serving OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -199,6 +199,27 @@ def indexed_dispatch(xt, eids, pos, keep, capacity, num_experts):
     return buf.reshape(num_experts, capacity, H)
 
 
+def inverted_dispatch(xt, eids, pos, keep, capacity, num_experts):
+    """Same (E,C,H) expert inputs as ``indexed_dispatch``, built by
+    slot INVERSION + row gather instead of a float scatter: the only
+    scatter is (T*k,) int32 slot->token indices (tiny); the H-wide data
+    movement is a dense gather, which the TPU executes far faster than
+    row scatter-adds. Dropped pairs target a sentinel slot; empty slots
+    gather a zero row via a sentinel token."""
+    T, H = xt.shape
+    k = eids.shape[1]
+    EC = num_experts * capacity
+    flat = jnp.where(keep, eids * capacity + pos, EC).reshape(T * k)
+    tok_ids = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[:, None], (T, k)).reshape(T * k)
+    inv = jnp.full((EC + 1,), T, jnp.int32).at[flat].set(
+        tok_ids, mode="drop")
+    # empty/dropped slots hold the out-of-range sentinel T: take with
+    # fill produces their zero rows without copying xt to append one
+    return jnp.take(xt, inv[:EC], axis=0, mode="fill",
+                    fill_value=0).reshape(num_experts, capacity, H)
+
+
 def indexed_combine(expert_out, eids, pos, w, capacity):
     """(E,C,H) expert outputs -> (T,H) tokens: gather each (token,
     choice) slot and weighted-sum over the k choices (the reverse
@@ -278,9 +299,12 @@ class MoELayer(nn.Layer):
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         # "indexed" (default): scatter/gather dispatch, O(T*k*H) data
-        # movement. "einsum": the dense one-hot (T,E,C) formulation —
-        # O(T^2) MACs, kept as the numerics oracle and for A/B benches.
-        assert dispatch_mode in ("indexed", "einsum"), dispatch_mode
+        # movement. "inverted": same math with the dispatch built by
+        # int32 slot inversion + row gather (no H-wide scatter).
+        # "einsum": the dense one-hot (T,E,C) formulation — O(T^2)
+        # MACs, kept as the numerics oracle and for A/B benches.
+        assert dispatch_mode in ("indexed", "inverted", "einsum"), \
+            dispatch_mode
         self.dispatch_mode = dispatch_mode
         if isinstance(gate, str):
             gate_cls = {"gshard": GShardGate, "switch": SwitchGate,
@@ -345,11 +369,13 @@ class MoELayer(nn.Layer):
                     return (out.reshape(B, S, H),
                             jnp.zeros((), xt.dtype))
                 dispatch, combine, aux = expert_choice_gating(glt, cap)
-            elif mode == "indexed":
+            elif mode in ("indexed", "inverted"):
                 eids, pos, keep, w, aux = topk_gating_idx(
                     glt, cap, topk, key,
                     0.01 if (topk == 1 and key is not None) else 0.0)
-                expert_in = indexed_dispatch(xt, eids, pos, keep, cap, E)
+                disp = (inverted_dispatch if mode == "inverted"
+                        else indexed_dispatch)
+                expert_in = disp(xt, eids, pos, keep, cap, E)
                 expert_out = expert_ffn(expert_in, w_in, w_out)
                 out = indexed_combine(expert_out, eids, pos, w, cap)
                 return out.reshape(B, S, H), aux.astype(xt.dtype)

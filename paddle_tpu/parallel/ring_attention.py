@@ -201,7 +201,7 @@ def _ring_flash_local(axis: str, n: int, causal: bool, sm_scale: float):
     return ring
 
 
-def _n_active_steps(n: int, window: int, Sloc: int) -> int:
+def ring_window_active_steps(n: int, window: int, Sloc: int) -> int:
     """Ring steps that can carry any live (query, key) pair under a
     sliding window: the pair at chunk distance d has minimum
     q_pos - k_pos = (d-1)*Sloc + 1, live iff < window. Steps beyond
@@ -224,7 +224,7 @@ def _ring_window_splash_local(axis: str, n: int, window: int,
                                                banded_block_mask,
                                                pick_splash_blocks)
 
-    n_act = _n_active_steps(n, window, Sloc)
+    n_act = ring_window_active_steps(n, window, Sloc)
 
     def _pair_mask(d, bq, bk):
         if d == 0:
@@ -326,7 +326,7 @@ def _dense_window_ring(axis: str, n: int, window: int, sm_scale: float,
     """Dense (exact f32, autodiff-able) window x sep engine: the CPU
     oracle for the splash ring and the fallback for splash-ineligible
     chunk shapes. Static per-distance masks; same early termination."""
-    n_act = _n_active_steps(n, window, Sloc)
+    n_act = ring_window_active_steps(n, window, Sloc)
 
     def spmd(ql, kl, vl):
         my = jax.lax.axis_index(axis)

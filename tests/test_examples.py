@@ -51,6 +51,20 @@ def test_example_long_context():
     assert "long-context train OK" in out, out[-800:]
 
 
+def test_example_routed_decode():
+    out = _run("serve_routed_decode.py")
+    assert "routed serving OK" in out, out[-800:]
+    assert "routed -> dense" in out and "routed -> paged" in out
+
+
+def test_example_window_sep():
+    out = _run("train_llama_window_sep.py",
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "window x sep train OK" in out, out[-800:]
+    assert "ring walks 2 of 4 steps" in out, out[-800:]
+
+
 def test_example_moe_ep():
     out = _run("train_moe_ep.py",
                extra_env={"XLA_FLAGS":

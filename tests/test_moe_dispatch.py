@@ -68,6 +68,21 @@ def test_indexed_dispatch_combine_roundtrip():
                                atol=1e-5)
 
 
+def test_inverted_dispatch_matches_indexed():
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.distributed.models.moe import (
+        indexed_dispatch, inverted_dispatch, topk_gating_idx)
+    rng = np.random.default_rng(5)
+    T, E, H, cap = 24, 4, 8, 5  # tight capacity: exercises drops
+    logits = jnp.asarray(rng.normal(0, 1, (T, E)), jnp.float32)
+    xt = jnp.asarray(rng.normal(0, 1, (T, H)), jnp.float32)
+    eids, pos, keep, w, _ = topk_gating_idx(logits, cap, 2)
+    assert float(jnp.sum(keep)) < T * 2  # drops present
+    a = indexed_dispatch(xt, eids, pos, keep, cap, E)
+    b = inverted_dispatch(xt, eids, pos, keep, cap, E)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 @pytest.mark.parametrize("gate,topk", [("gshard", 2), ("switch", 1),
                                        ("gshard", 4), ("expert_choice", 2)])
 def test_moelayer_indexed_matches_einsum(gate, topk):

@@ -57,15 +57,15 @@ def test_ring_window_matches_dense_oracle(sep, S, window, Hq, Hkv):
 
 
 def test_ring_window_skips_out_of_band_steps():
-    from paddle_tpu.parallel.ring_attention import _n_active_steps
+    from paddle_tpu.parallel.ring_attention import ring_window_active_steps
     # S=8192, sep=4 -> Sloc=2048; window=2048 touches distance 0 and 1
     # (queries at a chunk start still see the previous chunk's tail)
-    assert _n_active_steps(4, 2048, 2048) == 2
-    assert _n_active_steps(4, 1024, 2048) == 2
+    assert ring_window_active_steps(4, 2048, 2048) == 2
+    assert ring_window_active_steps(4, 1024, 2048) == 2
     # window covering everything: full ring
-    assert _n_active_steps(4, 8192, 2048) == 4
+    assert ring_window_active_steps(4, 8192, 2048) == 4
     # distance-2 pairs only come live once window exceeds Sloc + 1
-    assert _n_active_steps(4, 2050, 2048) == 3
+    assert ring_window_active_steps(4, 2050, 2048) == 3
 
 
 def test_ring_window_grads_match_dense_oracle():

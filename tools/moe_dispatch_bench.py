@@ -85,7 +85,8 @@ def bench_segments(mode: str, on_tpu: bool):
     from paddle_tpu.core.sync import hard_sync
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.incubate.distributed.models.moe import (
-        MoELayer, indexed_dispatch, top2_gating, topk_gating_idx)
+        MoELayer, indexed_dispatch, inverted_dispatch, top2_gating,
+        topk_gating_idx)
 
     H, F, E = (1024, 2816, 8) if on_tpu else (16, 32, 4)
     B, S = (8, 2048) if on_tpu else (2, 16)
@@ -102,9 +103,11 @@ def bench_segments(mode: str, on_tpu: bool):
     w_out = jnp.asarray(lay.w_out._value, dt_kind)
 
     def gate_dispatch(xt, gl):
-        if mode == "indexed":
+        if mode in ("indexed", "inverted"):
             eids, pos, keep, w, aux = topk_gating_idx(gl, cap, 2)
-            return indexed_dispatch(xt, eids, pos, keep, cap, E)
+            disp = (inverted_dispatch if mode == "inverted"
+                    else indexed_dispatch)
+            return disp(xt, eids, pos, keep, cap, E)
         d, c, aux = top2_gating(gl, cap)
         return jnp.einsum("tec,th->ech", d.astype(xt.dtype), xt)
 
@@ -138,7 +141,7 @@ def main():
     import jax
     on_tpu = jax.devices()[0].platform != "cpu" and \
         "--quick" not in sys.argv
-    for mode in ("indexed", "einsum"):
+    for mode in ("indexed", "inverted", "einsum"):
         print(json.dumps(bench_segments(mode, on_tpu)), flush=True)
         print(json.dumps(bench_train(mode, on_tpu)), flush=True)
 
