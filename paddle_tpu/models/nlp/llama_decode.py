@@ -390,6 +390,54 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                 pos += 1
         return jnp.concatenate(out, axis=1)
 
+    @partial(jax.jit, static_argnums=(3,))
+    def _compiled_greedy(outer, layers, tokens, max_new):
+        """prefill + max_new greedy decode steps in ONE program
+        (lax.scan): generate()'s python loop pays a per-token host
+        dispatch, which through a remote-PJRT tunnel (~7 ms/call)
+        dominates small-batch decode; the in-jit loop has one dispatch
+        per CALL (round-5 discovery via the speculative while_loop —
+        spec beat 'plain' 4x at 0% acceptance purely on dispatch)."""
+        B, S0 = tokens.shape
+        dtype = outer["model.embed_tokens.weight"].dtype
+        if rolling:
+            logits, kc, vc = prefill(outer, layers, tokens)
+        else:
+            kc = init_caches(B, dtype)
+            vc = init_caches(B, dtype)
+            logits, kc, vc = prefill(outer, layers, tokens, kc, vc)
+
+        def step(carry, i):
+            logits, kc, vc = carry
+            nxt = jnp.argmax(logits, -1)
+            logits, kc, vc = decode_step(outer, layers, nxt, S0 + i,
+                                         kc, vc)
+            return (logits, kc, vc), nxt
+
+        (logits, _, _), toks = jax.lax.scan(
+            step, (logits, kc, vc), jnp.arange(max_new - 1))
+        last = jnp.argmax(logits, -1)
+        gen = jnp.concatenate([jnp.swapaxes(toks, 0, 1),
+                               last[:, None]], 1) if max_new > 1 \
+            else last[:, None]
+        return jnp.concatenate([tokens, gen], axis=1)
+
+    def generate_compiled(tokens, max_new_tokens: int):
+        """Greedy-only one-program variant of generate() (same output
+        as temperature=0)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, S0 = tokens.shape
+        if max_new_tokens < 1:
+            # match generate(): zero budget returns the prompt alone
+            return np.asarray(tokens)
+        if not rolling and S0 + max_new_tokens > max_len:
+            raise ValueError(
+                f"prompt {S0} + max_new_tokens {max_new_tokens} exceeds "
+                f"the factory's max_len {max_len}")
+        return np.asarray(_compiled_greedy(outer, layers, tokens,
+                                           max_new_tokens))
+
+    generate.compiled = generate_compiled
     return generate
 
 
@@ -493,19 +541,9 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         return drafts, k_caches, v_caches
 
     @jax.jit
-    def _compiled_spec(tokens, max_new):
-        """The ENTIRE speculative loop as one compiled program
-        (lax.while_loop): per-round host dispatch previously cost
-        2 readbacks/round, which through a remote-PJRT tunnel buried
-        even perfect-acceptance speculation at 0.33x plain (PERF.md
-        record 27 — plain decode runs its whole loop in one jit).
-        Greedy acceptance arithmetic is branch-free: n = length of the
-        matching draft prefix; the candidate vector writes accepted
-        drafts then the target's correction; junk beyond n is
-        overwritten by later rounds (the same overwrite-rollback
-        invariant the caches use)."""
+    def _spec_prefill(tokens):
+        """Prefill both models; returns the spec loop state."""
         B, S0 = tokens.shape
-        k = n_draft
         kT, vT = initT(B)
         kD, vD = initD(B)
         lgT, kT, vT = blockT_body_target(tokens, kT, vT, 0)
@@ -515,50 +553,90 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
             jnp.int32), (0,))
         seq = seq.at[S0].set(last)
         _, kD, vD = blockD_body(outerD, layersD, tokens, kD, vD, 0)
+        return (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.asarray(S0, jnp.int32), last, seq, kT, vT, kD, vD)
 
-        def cond(state):
-            return state[0] < max_new
+    def _spec_round(state):
+        """One draft/verify/accept round. Greedy acceptance arithmetic
+        is branch-free: n = length of the matching draft prefix; the
+        candidate vector writes accepted drafts then the target's
+        correction; junk beyond n is overwritten by later rounds (the
+        same overwrite-rollback invariant the caches use)."""
+        produced, rounds, pos, last, seq, kT, vT, kD, vD = state
+        k = n_draft
+        feed = jax.lax.dynamic_slice(seq, (pos - 1,), (2,))[None]
+        lg, kD2, vD2 = blockD_body(outerD, layersD, feed, kD, vD,
+                                   pos - 1)
+        cur = jnp.argmax(lg[:, -1], -1)
 
-        def body(state):
-            produced, rounds, pos, last, seq, kT, vT, kD, vD = state
-            feed = jax.lax.dynamic_slice(seq, (pos - 1,), (2,))[None]
-            lg, kD2, vD2 = blockD_body(outerD, layersD, feed, kD, vD,
-                                       pos - 1)
-            cur = jnp.argmax(lg[:, -1], -1)
+        # inner draft walk as a scan: one traced draft block instead of
+        # k-1 unrolled copies — program size is what breaks the axon
+        # remote compiler, and scan-in-scan compiles fine (the unrolled
+        # form did not at real model sizes)
+        def dstep(carry, i):
+            cur, kc, vc = carry
+            lg, kc, vc = blockD_body(outerD, layersD, cur[:, None],
+                                     kc, vc, pos + 1 + i)
+            return (jnp.argmax(lg[:, -1], -1), kc, vc), cur
 
-            def dstep(carry, i):
-                cur, kc, vc = carry
-                lg, kc, vc = blockD_body(outerD, layersD, cur[:, None],
-                                         kc, vc, pos + 1 + i)
-                return (jnp.argmax(lg[:, -1], -1), kc, vc), cur
+        (last_d, kD2, vD2), ds = jax.lax.scan(
+            dstep, (cur, kD2, vD2), jnp.arange(k - 1))
+        drafts = (jnp.concatenate([jnp.swapaxes(ds, 0, 1),
+                                   last_d[:, None]], 1)
+                  if k > 1 else last_d[:, None])  # (1, k)
+        blk = jnp.concatenate([last[None], drafts[0]])[None]
+        lgT, kT2, vT2 = blockT_body_target(blk.astype(jnp.int32),
+                                           kT, vT, pos)
+        t = jnp.argmax(lgT[0], -1).astype(jnp.int32)  # (k+1,)
+        matches = (drafts[0].astype(jnp.int32) == t[:k]).astype(
+            jnp.int32)
+        n = jnp.sum(jnp.cumprod(matches))
+        idx = jnp.arange(k + 1)
+        dpad = jnp.concatenate([drafts[0].astype(jnp.int32),
+                                jnp.zeros((1,), jnp.int32)])
+        cand = jnp.where(idx < n, dpad, t)
+        seq = jax.lax.dynamic_update_slice(seq, cand, (pos + 1,))
+        last = jax.lax.dynamic_index_in_dim(t, n, keepdims=False)
+        return (produced + n + 1, rounds + 1, pos + n + 1, last,
+                seq, kT2, vT2, kD2, vD2)
 
-            (last_d, kD2, vD2), ds = jax.lax.scan(
-                dstep, (cur, kD2, vD2), jnp.arange(k - 1))
-            drafts = (jnp.concatenate([jnp.swapaxes(ds, 0, 1),
-                                       last_d[:, None]], 1)
-                      if k > 1 else last_d[:, None])  # (1, k)
-            blk = jnp.concatenate([last[None], drafts[0]])[None]
-            lgT, kT2, vT2 = blockT_body_target(blk.astype(jnp.int32),
-                                               kT, vT, pos)
-            t = jnp.argmax(lgT[0], -1).astype(jnp.int32)  # (k+1,)
-            matches = (drafts[0].astype(jnp.int32) == t[:k]).astype(
-                jnp.int32)
-            n = jnp.sum(jnp.cumprod(matches))
-            idx = jnp.arange(k + 1)
-            dpad = jnp.concatenate([drafts[0].astype(jnp.int32),
-                                    jnp.zeros((1,), jnp.int32)])
-            cand = jnp.where(idx < n, dpad, t)
-            seq = jax.lax.dynamic_update_slice(seq, cand, (pos + 1,))
-            last = jax.lax.dynamic_index_in_dim(t, n, keepdims=False)
-            return (produced + n + 1, rounds + 1, pos + n + 1, last,
-                    seq, kT2, vT2, kD2, vD2)
+    @partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+    def _spec_chunk(state, R, max_new):
+        """R gated rounds inside ONE lax.scan program. The original
+        while_loop formulation is semantically identical but the axon
+        tunnel's remote compiler hangs >35 min on While programs at
+        real model sizes while this scan compiles in seconds (the same
+        discovery as the compiled plain decode). Rounds past max_new
+        become no-ops: the fresh state is computed then discarded by a
+        scalar select, so output and stats are EXACTLY the while_loop's.
+        The host re-dispatches chunks until produced >= max_new — ONE
+        dispatch when acceptance is high (R is sized for the accepted
+        case), <= k+1 when the draft never matches."""
+        def body(state, _):
+            new_state = _spec_round(state)
+            valid = state[0] < max_new
+            state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(valid, b, a), state, new_state)
+            return state, None
 
-        produced, rounds, pos, last, seq, kT, vT, kD, vD = \
-            jax.lax.while_loop(
-                cond, body,
-                (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
-                 jnp.asarray(S0, jnp.int32), last, seq, kT, vT, kD, vD))
-        return seq, produced, rounds
+        state, _ = jax.lax.scan(body, state, None, length=R)
+        return state
+
+    def _compiled_spec(tokens, max_new):
+        state = _spec_prefill(tokens)
+        # chunk size caps the compiled program (the axon remote compiler
+        # broke its pipe on large programs); at high acceptance 128
+        # tokens costs ~7 dispatches at R=4 (vs 2 per ROUND for the
+        # python loop)
+        # R static (scan length, few values); max_new TRACED (only the
+        # gating comparison reads it) so one compile serves every
+        # generation length; state donated so the KV caches alias
+        # across chunk re-dispatches instead of copying
+        R = min(4, max(1, -(-max_new // (n_draft + 1))))
+        mn = jnp.asarray(max_new, jnp.int32)
+        while int(state[0]) < max_new:
+            state = _spec_chunk(state, R, mn)
+        return state[4], state[0], state[1]
 
     def blockT_body_target(tokens, kc, vc, pos0):
         return blockT_body(outerT, layersT, tokens, kc, vc, pos0)
@@ -574,11 +652,7 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
             raise ValueError(
                 f"prompt {S0} + max_new {max_new_tokens} + 2x draft "
                 f"window {n_draft + 1} exceeds max_len {max_len}")
-        # max_new is a TRACED operand (only the while cond reads it):
-        # one compile serves every generation length — the program costs
-        # minutes to compile through the remote tunnel
-        seq, produced, rounds = _compiled_spec(
-            tokens, jnp.asarray(max_new_tokens, jnp.int32))
+        seq, produced, rounds = _compiled_spec(tokens, max_new_tokens)
         seq = np.asarray(seq)
         produced, rounds = int(produced), int(rounds)
         # produced = 1 (prefill token) + sum(n_i + 1): subtract the
@@ -651,8 +725,10 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         return out
 
     generate.last_stats = {}
-    # one-program variant (lax.while_loop): identical greedy output,
-    # one dispatch per call instead of two per round
+    # one-program-per-chunk variant (host-redispatched lax.scan chunks;
+    # the while_loop form breaks the axon remote compiler at real model
+    # sizes): identical greedy output, ~max_new/(R*(k+1)) dispatches
+    # instead of two per round
     generate.compiled = generate_compiled
     return generate
 
@@ -998,7 +1074,14 @@ def route_decode(lengths, capacity: int, shared_prefix: bool = False,
     (round-4 verdict item 6 — callers previously chose by hand).
 
     Returns "paged" or "dense". Policy derived from the chip rows in
-    PERF.md (records 27/29 + the round-5 page-size ablation):
+    PERF.md (records 27/29/34 + the round-5 compiled-decode
+    re-measurement, record 37): routing is by batch STRUCTURE, not
+    size — the round-4 "small batches -> paged (1.90x)" rule compared
+    scan-amortized paged against a per-token-dispatched dense loop;
+    with the dense loop compiled (gen.compiled) dense wins every
+    uniform shape measured (B=1: 559 vs ~166 tok/s paged-per-seq
+    equivalent; B=8: 3237 vs 1685; B=64: 3594 vs 3043 at the best
+    page size).
 
     - shared prompt prefixes -> paged (prefix pages are shared across
       sequences; the dense cache replicates them per slot)
@@ -1006,11 +1089,9 @@ def route_decode(lengths, capacity: int, shared_prefix: bool = False,
       slots pin max_len memory for the whole batch lifetime)
     - ragged lengths -> paged (the dense cache masks but still walks
       max-length KV for every row; pages walk only real lengths)
-    - uniform near-full large batches (B >= 32, spread < 25%) -> dense
-      (measured: B=64 uniform decode 3474 tok/s dense vs 2093 paged —
-      the dense cache's contiguous reads beat the page walk when no
-      memory is wasted by raggedness)
-    - small batches -> paged (B=8: 1.90x dense decode-only, record 27)
+    - severely under-full compiled capacity -> paged (dense pays
+      full-capacity compute for empty slots)
+    - otherwise (uniform, near-full) -> dense compiled
 
     ``lengths``: real sequence lengths (any array-like); ``capacity``:
     the batch size the dense cache would be compiled for.
@@ -1023,12 +1104,11 @@ def route_decode(lengths, capacity: int, shared_prefix: bool = False,
     if B == 0:
         return "dense"
     spread = float(lens.max() - lens.min()) / max(1.0, float(lens.max()))
-    ragged = spread > 0.25
-    if ragged:
+    if spread > 0.25:  # ragged
         return "paged"
-    if B >= 32 and B >= capacity:
-        return "dense"
-    return "paged"
+    if B < capacity // 2:  # dense would burn compute on empty slots
+        return "paged"
+    return "dense"
 
 
 def llama_serving_decode_factory(model: LlamaForCausalLM,

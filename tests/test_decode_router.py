@@ -5,10 +5,11 @@ import numpy as np
 
 def test_route_policy_rules():
     from paddle_tpu.models.nlp import route_decode
-    # uniform full large batch -> dense (B=64 chip row: dense 1.66x)
+    # uniform full batches -> dense at every size (round-5 compiled
+    # decode re-measurement: dense compiled wins all uniform shapes)
     assert route_decode([128] * 64, 64) == "dense"
-    # small batch -> paged (B=8 chip row: paged 1.90x dense)
-    assert route_decode([128] * 8, 8) == "paged"
+    assert route_decode([128] * 8, 8) == "dense"
+    assert route_decode([128], 1) == "dense"
     # ragged lengths -> paged even at large B
     lens = [256] * 32 + [32] * 32
     assert route_decode(lens, 64) == "paged"
@@ -16,9 +17,9 @@ def test_route_policy_rules():
     assert route_decode([128] * 64, 64, shared_prefix=True) == "paged"
     # churn (continuous batching) forces paged
     assert route_decode([128] * 64, 64, expect_churn=True) == "paged"
-    # under-full large compiled capacity -> paged (dense pays for the
-    # empty slots)
-    assert route_decode([128] * 40, 64) == "paged"
+    # severely under-full compiled capacity -> paged (dense pays for
+    # the empty slots)
+    assert route_decode([128] * 20, 64) == "paged"
 
 
 def test_serving_factory_routes_and_decodes():

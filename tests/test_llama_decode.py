@@ -290,6 +290,36 @@ def test_speculative_decode_exactly_matches_target_greedy():
     assert stats["target_steps"] < 24 // 3, stats  # ~24/5 rounds + 1
 
 
+def test_dense_compiled_greedy_matches_python_loop():
+    """gen.compiled (the one-program greedy loop serving routes uniform
+    batches to) must be byte-identical to generate() across the plain,
+    int8-cache and rolling-window cache variants, and honor the
+    zero-budget edge."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    prompt = np.asarray(
+        np.random.default_rng(2).integers(1, 97, (2, 6)), np.int32)
+    for label, cfg_kw, fac_kw in [
+            ("plain", {}, {}),
+            ("int8_cache", {}, {"kv_cache_dtype": "int8"}),
+            ("rolling", {"sliding_window": 8}, {})]:
+        paddle.seed(31)
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        for k, v in cfg_kw.items():
+            setattr(cfg, k, v)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        gen = llama_decode_factory(model, max_len=48, **fac_kw)
+        for new in (1, 16):
+            a = np.asarray(gen(jnp.asarray(prompt), max_new_tokens=new))
+            b = gen.compiled(prompt, new)
+            np.testing.assert_array_equal(a, b, err_msg=f"{label}/{new}")
+        np.testing.assert_array_equal(gen.compiled(prompt, 0), prompt,
+                                      err_msg=f"{label}/zero-budget")
+
+
 def test_speculative_compiled_loop_matches_python_loop():
     """The one-program speculative loop (generate.compiled — the whole
     draft/verify/accept cycle inside lax.while_loop) must produce

@@ -88,6 +88,18 @@ def main():
           "decode_only_tokens_per_sec": round(B / dense_per_tok, 1),
           "prefill_plus_1_s": round(dense_one_dt, 3)})
 
+    # one-program greedy loop (round-5): the python loop above pays a
+    # per-token dispatch through the tunnel; this is the number a
+    # production serving loop sees
+    _ = gen.compiled(np.asarray(prompt), new)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _ = gen.compiled(np.asarray(prompt), new)
+    dt_c = (time.perf_counter() - t0) / reps
+    emit({"bench": "dense_decode_compiled", "B": B, "new": new,
+          "tokens_per_sec": round(B * new / dt_c, 1),
+          "vs_python_loop": round(dense_full_dt / dt_c, 2)})
+
     # 2. paged decode at the same shape (fp + int8 pools)
     npages_seq = -(-(prompt_len + new) // ps)
     pool_pages = B * npages_seq + 2
