@@ -141,7 +141,12 @@ class CostModel:
                  eff: Optional[float] = None):
         self.cluster = cluster
         self.model = model
-        self.eff = eff or self.DEFAULT_EFF
+        # `eff or DEFAULT_EFF` silently swallowed an explicit eff=0.0
+        # (round-5 advice #5): only None means "use the default", and a
+        # non-physical efficiency is a caller bug, not a fallback
+        if eff is not None and not 0.0 < eff <= 1.0:
+            raise ValueError(f"eff {eff!r} must be in (0, 1]")
+        self.eff = self.DEFAULT_EFF if eff is None else eff
 
     def estimate(self, dp: int, mp: int, pp: int,
                  n_microbatches: Optional[int] = None,

@@ -1,0 +1,42 @@
+"""Version-bridging wrappers for jax APIs that were renamed in flight.
+
+The chip image carries a newer jax (``jax.shard_map`` with
+``check_vma``/``axis_names``, ``pltpu.CompilerParams``); CPU test
+images may carry an older one (``jax.experimental.shard_map`` with
+``check_rep``/``auto``, ``pltpu.TPUCompilerParams``). Importing from
+here keeps every kernel and parallel module loadable on both, instead
+of each call site feature-testing jax inline.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def tpu_compiler_params():
+    """``pltpu.CompilerParams`` (new jax) or ``pltpu.TPUCompilerParams``
+    (old name) — the Pallas kernel modules import this once instead of
+    each feature-testing pltpu."""
+    from jax.experimental.pallas import tpu as pltpu
+    return getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False,
+              axis_names=None):
+    """``jax.shard_map`` when available, else the experimental one.
+
+    ``axis_names`` (new-jax): the MANUAL axes. The old API takes the
+    complement — ``auto`` = mesh axes left to GSPMD — so the set is
+    inverted here. ``check_vma`` maps onto the old ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma, **kw)

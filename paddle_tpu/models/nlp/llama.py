@@ -37,6 +37,7 @@ from ...core.tensor import Parameter, Tensor
 from ...distributed.fleet.meta_parallel import (ColumnParallelLinear,
                                                 RowParallelLinear,
                                                 VocabParallelEmbedding)
+from ...jax_compat import shard_map as _shard_map
 from ...nn import functional as F
 from ...ops.dispatch import apply_op
 
@@ -613,7 +614,7 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
 
             b_ax = "data" if "data" in manual else None
             s_ax = "sep" if "sep" in manual else None
-            return jax.shard_map(
+            return _shard_map(
                 _fused, mesh=mesh,
                 in_specs=(P(b_ax, s_ax, None), P(b_ax, s_ax)),
                 out_specs=P(), check_vma=False,

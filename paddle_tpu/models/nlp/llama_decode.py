@@ -19,7 +19,31 @@ import jax.numpy as jnp
 import numpy as np
 
 from .llama import LlamaConfig, LlamaForCausalLM, apply_rotary
-from .llama_functional import _rms, split_params
+from .llama_functional import _rms, split_params  # noqa: F401 (re-export)
+from .llama_functional import stack_layers, unstack_layers  # noqa: F401
+
+
+def _stack_apply(body, x, stacked, scan_layers: bool = True):
+    """Run ``body(carry, per_layer) -> (carry, ys)`` over the leading L
+    axis of every leaf in ``stacked`` (the stack_layers convention shared
+    with the training path).
+
+    ``scan_layers=True`` lowers the layer body ONCE as a ``lax.scan`` —
+    program size is O(1) in depth, which is what lets the two-model
+    speculative program compile at real model sizes (the unrolled form
+    is ~L x larger and broke the remote compiler at 0.44B).
+    ``scan_layers=False`` python-unrolls L copies of the body into the
+    jaxpr: the parity/debug fallback the scan path is tested token-exact
+    against (and the shape a per-layer-heterogeneous model would need).
+    """
+    if scan_layers:
+        return jax.lax.scan(body, x, stacked)
+    L = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    ys = []
+    for i in range(L):
+        x, y = body(x, jax.tree_util.tree_map(lambda a: a[i], stacked))
+        ys.append(y)
+    return x, jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *ys)
 
 
 def _mm(x, w):
@@ -197,10 +221,16 @@ def _layer_step_rolling_prefill(cfg, lp, x, pos_vec, key_mask, W,
 
 def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                          kv_cache_dtype: str | None = None,
-                         weight_dtype: str | None = None):
+                         weight_dtype: str | None = None,
+                         scan_layers: bool = True):
     """Returns ``generate(tokens, max_new_tokens, key=None,
     temperature=0.0, top_k=0) -> (B, S0+max_new) token array`` running a
     fully jitted prefill + per-token decode with functional KV caches.
+
+    ``scan_layers`` (default True) runs the stacked (L, ...) layer
+    weights through ONE ``lax.scan`` layer body; False unrolls the L
+    layers into the program (parity/debug fallback — ~L x the HLO,
+    identical tokens).
 
     With ``config.sliding_window`` < max_len the cache is a ROLLING
     buffer of window slots (write at pos % window): memory stays
@@ -267,7 +297,8 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                     cfg, lp, x, pos_vec, band_mask, C, quantized)
                 return x, (kc, vc)
 
-            x, (k_caches, v_caches) = jax.lax.scan(body, x, layers)
+            x, (k_caches, v_caches) = _stack_apply(body, x, layers,
+                                                   scan_layers)
             x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
             return _logits(cfg, outer, x[:, -1]), k_caches, v_caches
     else:
@@ -286,8 +317,8 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                                         key_mask, 0)
                 return x, (kc, vc)
 
-            x, (k_caches, v_caches) = jax.lax.scan(
-                body, x, (layers, k_caches, v_caches))
+            x, (k_caches, v_caches) = _stack_apply(
+                body, x, (layers, k_caches, v_caches), scan_layers)
             x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
             return _logits(cfg, outer, x[:, -1]), k_caches, v_caches
 
@@ -314,8 +345,8 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                                     key_mask, write_at)
             return x, (kc, vc)
 
-        x, (k_caches, v_caches) = jax.lax.scan(
-            body, x, (layers, k_caches, v_caches))
+        x, (k_caches, v_caches) = _stack_apply(
+            body, x, (layers, k_caches, v_caches), scan_layers)
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         return _logits(cfg, outer, x[:, 0]), k_caches, v_caches
 
@@ -438,13 +469,22 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                                            max_new_tokens))
 
     generate.compiled = generate_compiled
+    # program introspection hooks: lower/compile the per-token step or
+    # the whole greedy program without running it (program-size parity
+    # tests + compile-time rows in tools/spec_decode_bench.py)
+    generate._parts = {"outer": outer, "layers": layers,
+                       "prefill": prefill, "decode_step": decode_step,
+                       "init_caches": init_caches,
+                       "compiled_greedy": _compiled_greedy,
+                       "scan_layers": scan_layers}
     return generate
 
 
 def llama_speculative_decode_factory(target: LlamaForCausalLM,
                                      draft: LlamaForCausalLM,
                                      max_len: int = 256,
-                                     n_draft: int = 4):
+                                     n_draft: int = 4,
+                                     scan_layers: bool = True):
     """Greedy speculative decoding: a small draft model proposes
     ``n_draft`` tokens (ONE jitted program — the autoregressive draft
     walk runs as an in-jit scan, so the whole draft phase costs a single
@@ -464,7 +504,13 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
     Both models must share a vocabulary. Batch size 1 per call (the
     accepted-prefix length is data-dependent; batching rows with
     different acceptance lengths needs per-row position bookkeeping —
-    future work)."""
+    future work).
+
+    ``scan_layers`` (default True) runs BOTH models' stacked (L, ...)
+    layer weights through one ``lax.scan`` layer body per block — the
+    two-model program is the largest HLO in the repo and scan-compression
+    is what lets it compile at 0.44B; False unrolls the layers
+    (parity/debug fallback, ~L x the program)."""
     if target.config.vocab_size != draft.config.vocab_size:
         raise ValueError("target and draft must share a vocabulary")
     if n_draft < 1:
@@ -505,8 +551,8 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
                                         key_mask, pos0)
                 return x, (kc, vc)
 
-            x, (k_caches, v_caches) = jax.lax.scan(
-                body, x, (layers, k_caches, v_caches))
+            x, (k_caches, v_caches) = _stack_apply(
+                body, x, (layers, k_caches, v_caches), scan_layers)
             x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
             return _logits(cfg, outer, x), k_caches, v_caches
 
@@ -540,32 +586,44 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
             if k > 1 else last_d[:, None]
         return drafts, k_caches, v_caches
 
+    # Both models' weights travel as ARGUMENTS through every jitted
+    # spec program, never as closure captures: a closed-over array is
+    # embedded in the lowered module as a literal constant, so the
+    # two-model program used to carry ~2 model-sizes of inline weight
+    # bytes — THE reason the remote compile service hung then broke its
+    # pipe at 0.44B while the plain decode (weights as args, ~kB of
+    # HLO) compiled in 1.6 s. With args + the scanned layer body the
+    # spec module text is size-O(1) in both depth and width.
+    _params = (outerT, layersT, outerD, layersD)
+
     @jax.jit
-    def _spec_prefill(tokens):
+    def _spec_prefill(params, tokens):
         """Prefill both models; returns the spec loop state."""
+        pouterT, playersT, pouterD, playersD = params
         B, S0 = tokens.shape
         kT, vT = initT(B)
         kD, vD = initD(B)
-        lgT, kT, vT = blockT_body_target(tokens, kT, vT, 0)
+        lgT, kT, vT = blockT_body(pouterT, playersT, tokens, kT, vT, 0)
         last = jnp.argmax(lgT[0, -1], -1).astype(jnp.int32)
         seq = jnp.zeros((max_len,), jnp.int32)
         seq = jax.lax.dynamic_update_slice(seq, tokens[0].astype(
             jnp.int32), (0,))
         seq = seq.at[S0].set(last)
-        _, kD, vD = blockD_body(outerD, layersD, tokens, kD, vD, 0)
+        _, kD, vD = blockD_body(pouterD, playersD, tokens, kD, vD, 0)
         return (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
                 jnp.asarray(S0, jnp.int32), last, seq, kT, vT, kD, vD)
 
-    def _spec_round(state):
+    def _spec_round(params, state):
         """One draft/verify/accept round. Greedy acceptance arithmetic
         is branch-free: n = length of the matching draft prefix; the
         candidate vector writes accepted drafts then the target's
         correction; junk beyond n is overwritten by later rounds (the
         same overwrite-rollback invariant the caches use)."""
+        pouterT, playersT, pouterD, playersD = params
         produced, rounds, pos, last, seq, kT, vT, kD, vD = state
         k = n_draft
         feed = jax.lax.dynamic_slice(seq, (pos - 1,), (2,))[None]
-        lg, kD2, vD2 = blockD_body(outerD, layersD, feed, kD, vD,
+        lg, kD2, vD2 = blockD_body(pouterD, playersD, feed, kD, vD,
                                    pos - 1)
         cur = jnp.argmax(lg[:, -1], -1)
 
@@ -575,7 +633,7 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         # form did not at real model sizes)
         def dstep(carry, i):
             cur, kc, vc = carry
-            lg, kc, vc = blockD_body(outerD, layersD, cur[:, None],
+            lg, kc, vc = blockD_body(pouterD, playersD, cur[:, None],
                                      kc, vc, pos + 1 + i)
             return (jnp.argmax(lg[:, -1], -1), kc, vc), cur
 
@@ -585,8 +643,8 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
                                    last_d[:, None]], 1)
                   if k > 1 else last_d[:, None])  # (1, k)
         blk = jnp.concatenate([last[None], drafts[0]])[None]
-        lgT, kT2, vT2 = blockT_body_target(blk.astype(jnp.int32),
-                                           kT, vT, pos)
+        lgT, kT2, vT2 = blockT_body(pouterT, playersT,
+                                    blk.astype(jnp.int32), kT, vT, pos)
         t = jnp.argmax(lgT[0], -1).astype(jnp.int32)  # (k+1,)
         matches = (drafts[0].astype(jnp.int32) == t[:k]).astype(
             jnp.int32)
@@ -600,8 +658,8 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         return (produced + n + 1, rounds + 1, pos + n + 1, last,
                 seq, kT2, vT2, kD2, vD2)
 
-    @partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
-    def _spec_chunk(state, R, max_new):
+    @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+    def _spec_chunk(params, state, R, max_new):
         """R gated rounds inside ONE lax.scan program. The original
         while_loop formulation is semantically identical but the axon
         tunnel's remote compiler hangs >35 min on While programs at
@@ -613,7 +671,7 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         dispatch when acceptance is high (R is sized for the accepted
         case), <= k+1 when the draft never matches."""
         def body(state, _):
-            new_state = _spec_round(state)
+            new_state = _spec_round(params, state)
             valid = state[0] < max_new
             state = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(valid, b, a), state, new_state)
@@ -623,7 +681,7 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         return state
 
     def _compiled_spec(tokens, max_new):
-        state = _spec_prefill(tokens)
+        state = _spec_prefill(_params, tokens)
         # chunk size caps the compiled program (the axon remote compiler
         # broke its pipe on large programs); at high acceptance 128
         # tokens costs ~7 dispatches at R=4 (vs 2 per ROUND for the
@@ -635,11 +693,8 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         R = min(4, max(1, -(-max_new // (n_draft + 1))))
         mn = jnp.asarray(max_new, jnp.int32)
         while int(state[0]) < max_new:
-            state = _spec_chunk(state, R, mn)
+            state = _spec_chunk(_params, state, R, mn)
         return state[4], state[0], state[1]
-
-    def blockT_body_target(tokens, kc, vc, pos0):
-        return blockT_body(outerT, layersT, tokens, kc, vc, pos0)
 
     def generate_compiled(tokens, max_new_tokens: int):
         """One-program speculative decode; same greedy-exact output as
@@ -730,6 +785,12 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
     # sizes): identical greedy output, ~max_new/(R*(k+1)) dispatches
     # instead of two per round
     generate.compiled = generate_compiled
+    # lower/compile the chunk program without generating (compile-time
+    # + program-size measurement at sizes where RUNNING is impractical)
+    generate._parts = {"spec_prefill": _spec_prefill,
+                       "spec_chunk": _spec_chunk,
+                       "params": _params,
+                       "scan_layers": scan_layers}
     return generate
 
 
@@ -741,7 +802,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                chunked_prefill: int | None = None,
                                kv_cache_dtype: str | None = None,
                                emit: str = "token",
-                               prefill_attention: str = "gather"):
+                               prefill_attention: str = "gather",
+                               scan_layers: bool = True):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -783,6 +845,10 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     the dense page gather — no (B, nkv, S, hd) gathered temporary, and
     int8 pools stay int8 all the way into VMEM. "gather" remains the
     default until the kernel carries a chip measurement.
+
+    ``scan_layers`` (default True): one scanned layer body over the
+    stacked (L, ...) weights and (L, ...) pools; False unrolls the
+    layers into the program (parity fallback).
     """
     from ...ops.pallas.paged_attention import paged_attention
 
@@ -865,8 +931,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend)
             return x, (kp, vp)
 
-        x, (k_pools, v_pools) = jax.lax.scan(
-            body, x, (layers, k_pools, v_pools))
+        x, (k_pools, v_pools) = _stack_apply(
+            body, x, (layers, k_pools, v_pools), scan_layers)
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         # each sequence's last REAL position owns the next token
         x_last = jnp.take_along_axis(
@@ -900,8 +966,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend)
             return x, (kp, vp)
 
-        x, (k_pools, v_pools) = jax.lax.scan(
-            body, x, (layers, k_pools, v_pools))
+        x, (k_pools, v_pools) = _stack_apply(
+            body, x, (layers, k_pools, v_pools), scan_layers)
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         out = _emit(_logits(cfg, outer, x[:, 0]))
         return out, (k_pools, v_pools)
@@ -962,8 +1028,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend)
             return x, (kp, vp)
 
-        x, (k_pools, v_pools) = jax.lax.scan(
-            body, x, (layers, k_pools, v_pools))
+        x, (k_pools, v_pools) = _stack_apply(
+            body, x, (layers, k_pools, v_pools), scan_layers)
         # harvest rows whose (length-1) position lives in this chunk
         idx = jnp.clip(lengths - 1 - start, 0, C - 1)
         row = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
@@ -1115,28 +1181,39 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  max_len: int = 256,
                                  page_size: int = 64,
                                  n_pool_pages: int = 256,
-                                 kv_cache_dtype: str | None = None):
+                                 kv_cache_dtype: str | None = None,
+                                 batch_capacity: int = 8,
+                                 scan_layers: bool = True):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
     ("paged", (outer, layers, pools, prefill, decode_step, decode_n))
     per batch. The dense program and the paged pool coexist; routing
-    per admission wave is how serving stacks exploit both regimes."""
-    import numpy as _np
+    per admission wave is how serving stacks exploit both regimes.
 
-    gen = llama_decode_factory(model, max_len=max_len)
+    ``batch_capacity`` is the batch size the dense compiled program is
+    expected to serve (gen.compiled specializes per batch shape; this
+    is the shape the serving loop pads uniform waves to). It is the
+    DEFAULT ``capacity`` for ``pick`` — previously capacity defaulted
+    to len(lengths), which made route_decode's under-full check
+    (B < capacity//2) unreachable: a 2-request wave against an 8-slot
+    compiled program now correctly routes paged."""
+    gen = llama_decode_factory(model, max_len=max_len,
+                               scan_layers=scan_layers)
     paged = llama_paged_decode_factory(model, page_size=page_size,
                                        n_pool_pages=n_pool_pages,
-                                       kv_cache_dtype=kv_cache_dtype)
+                                       kv_cache_dtype=kv_cache_dtype,
+                                       scan_layers=scan_layers)
 
     class _Serving:
         dense = gen
         paged_parts = paged
+        capacity = batch_capacity
 
-        @staticmethod
-        def pick(lengths, capacity=None, shared_prefix=False,
+        def pick(self, lengths, capacity=None, shared_prefix=False,
                  expect_churn=False):
-            cap = capacity if capacity is not None \
-                else int(_np.asarray(lengths).size)
+            # read the live attribute (not the factory closure) so
+            # callers who adjust serving.capacity see routing follow
+            cap = capacity if capacity is not None else self.capacity
             backend = route_decode(lengths, cap, shared_prefix,
                                    expect_churn)
             return backend, (gen if backend == "dense" else paged)

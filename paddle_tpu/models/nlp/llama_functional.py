@@ -34,23 +34,35 @@ LAYER_KEYS = [
 ]
 
 
+def stack_layers(per_layer: list) -> Dict[str, jax.Array]:
+    """List of L per-layer param dicts -> one dict of (L, ...) stacked
+    leaves. THE stacking convention: train (scan-over-layers forward),
+    pipeline stage splitting, and the decode factories all consume this
+    layout, so a weight tree round-trips between them with no reshapes."""
+    keys = per_layer[0].keys()
+    return {k: jnp.stack([p[k] for p in per_layer]) for k in keys}
+
+
+def unstack_layers(stacked: Dict[str, jax.Array]) -> list:
+    """Inverse of stack_layers: (L, ...) leaves -> list of L dicts."""
+    L = next(iter(stacked.values())).shape[0]
+    return [{k: v[i] for k, v in stacked.items()} for i in range(L)]
+
+
 def split_params(model: LlamaForCausalLM):
     """model state_dict -> (outer_params, stacked_layer_params)."""
     sd = {k: v._value for k, v in model.state_dict().items()}
     L = model.config.num_hidden_layers
-    layers = {}
-    for key in LAYER_KEYS:
-        leaves = [sd.pop(f"model.layers.{i}.{key}") for i in range(L)]
-        layers[key] = jnp.stack(leaves)
-    return sd, layers
+    per_layer = [{key: sd.pop(f"model.layers.{i}.{key}")
+                  for key in LAYER_KEYS} for i in range(L)]
+    return sd, stack_layers(per_layer)
 
 
 def merge_params(model: LlamaForCausalLM, outer, layers):
     sd = dict(outer)
-    L = model.config.num_hidden_layers
-    for key, stacked in layers.items():
-        for i in range(L):
-            sd[f"model.layers.{i}.{key}"] = stacked[i]
+    for i, lp in enumerate(unstack_layers(layers)):
+        for key, leaf in lp.items():
+            sd[f"model.layers.{i}.{key}"] = leaf
     model.load_tree(sd)
 
 

@@ -33,6 +33,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...jax_compat import tpu_compiler_params
+
+# jax renamed TPUCompilerParams -> CompilerParams (version-bridged in
+# one place, jax_compat)
+_CompilerParams = tpu_compiler_params()
+
 from .flash_attention import NEG_INF, _interpret
 
 
@@ -147,7 +153,7 @@ def _paged_call(q4, k_pages, v_pages, page_tables, seq_lens, starts,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, D), q4.dtype),
         interpret=_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(jnp.asarray(starts, jnp.int32).reshape(B),
       jnp.asarray(page_tables, jnp.int32),

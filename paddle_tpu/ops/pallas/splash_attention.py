@@ -35,6 +35,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...jax_compat import tpu_compiler_params
+
+# jax renamed TPUCompilerParams -> CompilerParams (version-bridged in
+# one place, jax_compat)
+_CompilerParams = tpu_compiler_params()
+
 from .flash_attention import LN2, LOG2E, NEG_INF, _interpret
 
 # f32-element budget for one (G*block_q, block_k) score/probability buffer
@@ -509,7 +515,7 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
             jax.ShapeDtypeStruct((bh, G, Sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=semantics),
     )(jnp.asarray(kv_idx), jnp.asarray(kv_cnt), qr, kr, vr)
     out = out.reshape(B, Hq, Sq, D)
@@ -517,7 +523,11 @@ def _splash_fwd(q, k, v, block_mask, causal, sm_scale, block_q, block_k,
 
 
 def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
-                q_offset, res, do):
+                q_offset, res, do, *, delta=None):
+    # delta: optional precomputed sum(dO*O, -1) as (B,H,Sq) f32 — ring
+    # attention calls this once per ring step with the same global
+    # (out, dO), so the reduction hoists out of the ring loop
+    # (mirrors flash_attention._fa_bwd's delta kwarg)
     q, k, v, out, lse = res
     sm_scale, bq, bk, G, streamed = _resolve(q, k, block_mask, sm_scale,
                                              block_q, block_k)
@@ -531,8 +541,10 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
     vr = v.reshape(bh, Sk, D)
     dor = do.reshape(bh, G, Sq, D)
     lser = lse.reshape(bh, G, Sq, 1)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, G, Sq, 1)
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+    delta = delta.reshape(bh, G, Sq, 1)
 
     if streamed:
         t_max = kv_idx.shape[1]
@@ -586,7 +598,7 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, G, Sq, D), q.dtype),
         interpret=_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=dq_semantics),
     )(jnp.asarray(kv_idx), jnp.asarray(kv_cnt), qr, kr, vr, dor, lser,
       delta)
@@ -624,7 +636,7 @@ def _splash_bwd(block_mask, causal, sm_scale, block_q, block_k, window,
             jax.ShapeDtypeStruct((bh, Sk, D), v.dtype),
         ],
         interpret=_interpret(),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(bm_i32, qr, kr, vr, dor, lser, delta)
 

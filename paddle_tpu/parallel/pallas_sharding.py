@@ -17,6 +17,8 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ..jax_compat import shard_map as _shard_map
+
 # test hook: set True whenever a wrapped (manual) kernel launch is traced
 ENGAGED = {"flag": False}
 
@@ -53,9 +55,9 @@ def shard_map_attention(fn, q, k, v, mesh=None, head_axis: str = "model",
         else None
     spec = P(b_ax, head_axis, None, None)
     manual = frozenset({head_axis} | ({b_ax} if b_ax else set()))
-    out = jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                        out_specs=spec, check_vma=False,
-                        axis_names=manual)(q, k, v)
+    out = _shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                     out_specs=spec, check_vma=False,
+                     axis_names=manual)(q, k, v)
     ENGAGED["flag"] = True  # after the call: a tracing failure above must
     #                         not leave the marker set (call sites may
     #                         catch and fall back)

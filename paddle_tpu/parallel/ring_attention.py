@@ -38,6 +38,8 @@ import math
 
 import jax
 import jax.numpy as jnp
+
+from ..jax_compat import shard_map as _shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -207,6 +209,12 @@ def ring_window_active_steps(n: int, window: int, Sloc: int) -> int:
     q_pos - k_pos = (d-1)*Sloc + 1, live iff < window. Steps beyond
     that are wholly outside the band and are SKIPPED — the window-aware
     ring's whole point (round-4 verdict item 5)."""
+    if window <= 1:
+        # only the diagonal can be live: the nearest cross-position
+        # pair has gap 1, dead for window <= 1 — the generic formula
+        # overshot by one here, costing a fully-masked kernel call +
+        # ppermute per layer (round-5 advice #1)
+        return 1
     d_max = max(0, (window - 2)) // Sloc + 1
     return min(n, d_max + 1)
 
@@ -288,6 +296,12 @@ def _ring_window_splash_local(axis: str, n: int, window: int,
         dk_acc = jnp.zeros(kl.shape, jnp.float32)
         dv_acc = jnp.zeros(vl.shape, jnp.float32)
         kb, vb = kl, vl
+        # delta = sum(dO*O) depends only on the GLOBAL (out, dO) —
+        # identical every ring step, so reduce once here instead of
+        # inside each _splash_bwd call (mirrors the flash ring's
+        # _fa_bwd delta hoist; round-5 advice #2)
+        delta = jnp.sum(dO.astype(jnp.float32) * O.astype(jnp.float32),
+                        axis=-1)
         for d in range(n_act):
             bm = _pair_mask(d, bq, bk)
             # splash backward with the GLOBAL (out, lse): the softmax
@@ -295,7 +309,8 @@ def _ring_window_splash_local(axis: str, n: int, window: int,
             # flash ring) and dK/dV come back at the true kv-head count
             dql, dkb, dvb = _splash_bwd(bm, d == 0, sm_scale, bq, bk,
                                         window, d * Sloc,
-                                        (ql, kb, vb, O, LSE), dO)
+                                        (ql, kb, vb, O, LSE), dO,
+                                        delta=delta)
             valid = (my >= d).astype(jnp.float32)
             dq = dq + dql.astype(jnp.float32) * valid
             dk_acc = dk_acc + dkb.astype(jnp.float32) * valid
@@ -388,7 +403,7 @@ def ring_window_attention(q, k, v, mesh: Mesh, window: int,
     else:
         spmd = _dense_window_ring(axis, n, window, sm_scale, Sloc)
     spec = P(b_ax, h_ax, axis, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         spmd, mesh=mesh,
         in_specs=(spec,) * 3,
         out_specs=spec, check_vma=False)
@@ -454,7 +469,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
             return (acc / l[..., None]).astype(q.dtype)
 
     spec = P(b_ax, h_ax, axis, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         spmd, mesh=mesh,
         in_specs=(spec,) * 3,
         out_specs=spec, check_vma=False)

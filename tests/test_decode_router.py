@@ -60,3 +60,31 @@ def test_serving_factory_routes_and_decodes():
     assert backend == "dense"
     out = gen(jnp.asarray(prompt), max_new_tokens=4)
     assert np.asarray(out).shape[1] == prompt.shape[1] + 4
+
+
+def test_pick_default_capacity_reaches_underfull_route():
+    """round-5 advice #4: ``pick`` used to default capacity to
+    len(lengths), so B < capacity//2 could never fire through the
+    factory — a 2-request wave against an 8-slot compiled program
+    claimed "dense". capacity now defaults to the factory's
+    batch_capacity (the shape gen.compiled is padded to)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import (LlamaConfig, LlamaForCausalLM,
+                                       llama_serving_decode_factory)
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    serving = llama_serving_decode_factory(model, max_len=32,
+                                           page_size=8, n_pool_pages=32,
+                                           batch_capacity=8)
+    assert serving.capacity == 8
+    # uniform 2-request wave, NO explicit capacity: under-full vs the
+    # 8-slot compiled program -> paged (previously dense: cap == B == 2)
+    backend, _ = serving.pick([16, 16])
+    assert backend == "paged"
+    # near-full uniform wave still routes dense through the default
+    backend, _ = serving.pick([16] * 8)
+    assert backend == "dense"

@@ -277,3 +277,76 @@ def test_pod_projection_tool():
     assert 0 < rec["projected_mfu"] < 1
     assert rec["memory_gb_per_chip"] < 95  # plan must fit v5p HBM
     assert "eff_source" in rec
+
+
+def test_cost_model_eff_validation():
+    """round-5 advice #5: ``eff or DEFAULT_EFF`` swallowed an explicit
+    eff=0.0; only None selects the default and non-physical values
+    raise instead of silently degrading every estimate."""
+    cluster = Cluster(n_devices=8)
+    model = ModelSpec(n_layers=32, hidden=4096, intermediate=11008,
+                      vocab=32000, seq=2048, global_batch=64)
+    assert CostModel(cluster, model).eff == CostModel.DEFAULT_EFF
+    assert CostModel(cluster, model, eff=0.5).eff == 0.5
+    assert CostModel(cluster, model, eff=1.0).eff == 1.0
+    for bad in (0.0, -0.1, 1.5):
+        with pytest.raises(ValueError):
+            CostModel(cluster, model, eff=bad)
+
+
+def test_bench_gate_check_handles_empty_input():
+    """round-5 advice #3: check mode on input with no JSON line emits a
+    graceful FAIL record and exit 1 (it used to die on a bare
+    IndexError, which reads as a tooling crash, not a gate verdict)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_gate.py"),
+         "check", "-"],
+        input="warning: no rows produced\n", capture_output=True,
+        text=True, timeout=60, cwd=repo)
+    assert r.returncode == 1
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["gate"] == "FAIL"
+    assert "no JSON line" in rec["reason"]
+    assert "IndexError" not in r.stderr
+
+
+def test_bench_gate_serving_modes(tmp_path):
+    """The serving gate: FAIL when the spec row is missing or carries a
+    recorded compile failure; pass with a ratio row; regression vs the
+    stamped baseline FAILs."""
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gate = os.path.join(repo, "tools", "bench_gate.py")
+    # isolate from any repo-root stamped baseline (and never stamp one)
+    env = {**os.environ, "BENCH_GATE_SERVING_BASELINE":
+           str(tmp_path / "serving_baseline.json")}
+
+    def run(text):
+        r = subprocess.run([sys.executable, gate, "serving", "-"],
+                           input=text, capture_output=True, text=True,
+                           timeout=60, cwd=repo, env=env)
+        return r.returncode, json.loads(
+            r.stdout.strip().splitlines()[-1])
+
+    rc, rec = run("no rows here\n")
+    assert rc == 1 and rec["gate"] == "FAIL"
+
+    rc, rec = run(json.dumps(
+        {"bench": "spec_vs_plain_compiled", "error": "XlaRuntimeError"})
+        + "\n")
+    assert rc == 1 and rec["gate"] == "FAIL"
+    assert "compile" in rec["reason"]
+
+    rc, rec = run(json.dumps(
+        {"bench": "spec_vs_plain_compiled", "n_draft": 4, "ratio": 1.4,
+         "compile_s_spec": 2.1, "output_matches_plain": True}) + "\n")
+    assert rc == 0 and rec["gate"] == "pass"
+    assert rec["fresh_spec_vs_plain"] == 1.4

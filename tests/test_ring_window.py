@@ -68,6 +68,61 @@ def test_ring_window_skips_out_of_band_steps():
     assert ring_window_active_steps(4, 2050, 2048) == 3
 
 
+def test_ring_window_degenerate_window_runs_one_step():
+    """window <= 1: only the diagonal (distance 0) can hold a live
+    pair — the nearest cross-chunk pair has gap 1, dead for window 1.
+    The old formula overshot by one, running a fully-masked splash call
+    + ppermute (round-5 advice #1)."""
+    from paddle_tpu.parallel.ring_attention import ring_window_active_steps
+    assert ring_window_active_steps(4, 1, 2048) == 1
+    assert ring_window_active_steps(4, 0, 2048) == 1
+    assert ring_window_active_steps(1, 1, 64) == 1
+    # window 2 genuinely needs the distance-1 step (gap 1 < 2)
+    assert ring_window_active_steps(4, 2, 2048) == 2
+    # and a window-1 ring still computes the right thing (diagonal-only
+    # attention == each position attends itself)
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.ring_attention import ring_window_attention
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((1, 2, 32, 8)).astype(np.float32)
+    k = rng.standard_normal((1, 2, 32, 8)).astype(np.float32)
+    v = rng.standard_normal((1, 2, 32, 8)).astype(np.float32)
+    out = ring_window_attention(jnp.asarray(q), jnp.asarray(k),
+                                jnp.asarray(v), _mesh(2), 1)
+    ref = _dense_window_oracle(q, k, v, 1, 1.0 / np.sqrt(8))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_splash_bwd_precomputed_delta_matches(monkeypatch):
+    """_splash_bwd's optional precomputed-delta kwarg (the ring hoists
+    sum(dO*O) out of its per-step loop) must be bit-identical to the
+    in-function reduction."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.splash_attention import (
+        _splash_bwd, _splash_fwd, banded_block_mask)
+    rng = np.random.default_rng(3)
+    B, H, S, D, W = 1, 2, 256, 64, 96
+    bq = bk = 128
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    do = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    bm = banded_block_mask(S, S, bq, bk, W)
+    sm = 1.0 / np.sqrt(D)
+    out, res = _splash_fwd(q, k, v, bm, True, sm, bq, bk, W, 0)
+    lse = res[4]
+    inner = _splash_bwd(bm, True, sm, bq, bk, W, 0,
+                        (q, k, v, out, lse), do)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    hoisted = _splash_bwd(bm, True, sm, bq, bk, W, 0,
+                          (q, k, v, out, lse), do, delta=delta)
+    for a, b, name in zip(inner, hoisted, ("dq", "dk", "dv")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
 def test_ring_window_grads_match_dense_oracle():
     import jax
     import jax.numpy as jnp

@@ -3,16 +3,23 @@ check_op_benchmark_result.py:1 + ci_model_benchmark.sh:37-60 discipline).
 
 Compares a fresh chip measurement against the commit-stamped last
 recorded row and FAILS (exit 1) on >threshold regression, so a round
-cannot silently ship a slower build. Two modes:
+cannot silently ship a slower build. Three modes:
 
   python tools/bench_gate.py check <fresh.json>   # compare a bench.py
       output file (or '-' for stdin) against PERF_LAST_TPU.json
   python tools/bench_gate.py run                  # run bench.py now,
       then compare (the first chip-queue item each round)
+  python tools/bench_gate.py serving <fresh.jsonl> [--stamp]
+      # gate the SERVING row: spec-compiled vs compiled-plain decode
+      # throughput from tools/spec_decode_bench.py output; a recorded
+      # spec compile failure also FAILS here (the claim is gated either
+      # way, not anecdotal). --stamp records the fresh row as the new
+      # baseline (PERF_LAST_SERVING.json) after a pass.
 
-The gate compares the LEGACY row when present (fixed MHA config —
-stable across rounds) and falls back to the headline value; a config
-change that renames rows therefore can't masquerade as a speedup.
+The training gate compares the LEGACY row when present (fixed MHA
+config — stable across rounds) and falls back to the headline value; a
+config change that renames rows therefore can't masquerade as a
+speedup.
 """
 from __future__ import annotations
 
@@ -71,16 +78,127 @@ def check(fresh: dict, last: dict | None) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+SERVING_BASELINE = "PERF_LAST_SERVING.json"
+
+
+def _serving_baseline_path():
+    # env override so tests (and out-of-tree CI) can isolate the
+    # stamped baseline from the repo-root file
+    return os.environ.get("BENCH_GATE_SERVING_BASELINE",
+                          os.path.join(REPO, SERVING_BASELINE))
+
+
+def load_serving_baseline():
+    path = _serving_baseline_path()
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _json_lines(text: str) -> list:
+    out = []
+    for ln in text.splitlines():
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
+    """Gate the spec-compiled vs compiled-plain decode row emitted by
+    tools/spec_decode_bench.py. FAILs on: no row at all, a recorded
+    compile failure, or a >threshold ratio regression vs the stamped
+    baseline — so the serving claim can only change deliberately."""
+    summary = [r for r in rows
+               if r.get("bench") == "spec_vs_plain_compiled"]
+    if not summary:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no spec_vs_plain_compiled row in "
+                                    "input (run tools/"
+                                    "spec_decode_bench.py)"}))
+        return 1
+    errors = [r for r in summary if "error" in r]
+    ok = [r for r in summary if "ratio" in r]
+    if not ok:
+        rec = {"gate": "FAIL",
+               "reason": ("spec compiled loop failed to compile/run "
+                          "(reproduced failure)" if errors else
+                          "spec row carries no ratio (compiled loop "
+                          "skipped?)")}
+        if errors:
+            rec["error"] = str(errors[0].get("error"))[-250:]
+        print(json.dumps(rec))
+        return 1
+    # a divergence on ANY row fails — not just the best-ratio one
+    # (the correctness backstop must not be maskable by a faster row)
+    diverged = [r for r in ok
+                if r.get("output_matches_plain") is False]
+    if diverged:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "spec output diverged from plain "
+                                    "greedy",
+                          "n_draft": diverged[0].get("n_draft")}))
+        return 1
+    best = max(ok, key=lambda r: float(r["ratio"]))
+    fresh_ratio = float(best["ratio"])
+    rec = {
+        "gate": "pass",
+        "fresh_spec_vs_plain": round(fresh_ratio, 4),
+        "n_draft": best.get("n_draft"),
+        "compile_s_spec": best.get("compile_s_spec"),
+        "device": best.get("device", "?"),
+    }
+    if last is None:
+        rec["baseline"] = "none (skip regression compare)"
+    else:
+        base_ratio = float(last.get("ratio", 0.0))
+        rec["last_spec_vs_plain"] = round(base_ratio, 4)
+        rec["baseline_device"] = last.get("device", "?")
+        if base_ratio and fresh_ratio < base_ratio * (1.0 - THRESHOLD):
+            rec["gate"] = "FAIL"
+            rec["reason"] = (f"spec/plain ratio regressed "
+                             f"{fresh_ratio:.3f} < {base_ratio:.3f} "
+                             f"- {THRESHOLD:.0%}")
+    print(json.dumps(rec))
+    if rec["gate"] == "pass" and stamp:
+        path = _serving_baseline_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(best, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+        print(json.dumps({"gate_note": f"stamped {SERVING_BASELINE}"}))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 def main() -> int:
     mode = sys.argv[1] if len(sys.argv) > 1 else "run"
     if mode == "check":
         baseline = load_baseline()
         src = sys.argv[2] if len(sys.argv) > 2 else "-"
         text = sys.stdin.read() if src == "-" else open(src).read()
-        # bench.py prints one JSON line (possibly after warnings)
-        line = [ln for ln in text.splitlines()
-                if ln.startswith("{")][-1]
-        return check(json.loads(line), baseline)
+        # bench.py prints one JSON line (possibly after warnings); no
+        # JSON line at all is a FAIL record, not a bare IndexError
+        # (round-5 advice #3 — run mode already failed gracefully)
+        lines = [ln for ln in text.splitlines() if ln.startswith("{")]
+        if not lines:
+            print(json.dumps({"gate": "FAIL",
+                              "reason": "input contains no JSON line "
+                                        "(bench produced no row)"}))
+            return 1
+        return check(json.loads(lines[-1]), baseline)
+    if mode == "serving":
+        # first non-flag operand is the source; "--stamp" may appear
+        # before or after it
+        stamp = "--stamp" in sys.argv
+        operands = [a for a in sys.argv[2:] if not a.startswith("--")]
+        src = operands[0] if operands else "-"
+        text = sys.stdin.read() if src == "-" else open(src).read()
+        return check_serving(_json_lines(text), load_serving_baseline(),
+                             stamp)
     if mode == "run":
         baseline = load_baseline()
         r = subprocess.run([sys.executable,
@@ -108,7 +226,8 @@ def main() -> int:
             print(json.dumps({"gate_note":
                               "restored pre-run baseline stamp"}))
         return rc
-    raise SystemExit("mode: run | check <file|->")
+    raise SystemExit("mode: run | check <file|-> | "
+                     "serving <file|-> [--stamp]")
 
 
 if __name__ == "__main__":
