@@ -13,6 +13,7 @@ engine.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import List, Optional
@@ -218,6 +219,28 @@ class PredictorPool:
         return len(self._preds)
 
 
+@dataclasses.dataclass
+class BatchingConfig:
+    """The request-coalescing knobs shared by BOTH batchers: how many
+    requests one flush may gather (``max_batch``) and how long the
+    oldest waiting request may sit before a partial batch flushes
+    anyway (``max_delay_ms``).
+
+    ``DynamicBatcher`` (request/response predictors) and the
+    continuous-batching ``paddle_tpu.serving.ServingEngine`` (token
+    streams) both take this as their admission config, so the two
+    batching layers cannot grow divergent knob sets.
+    """
+
+    max_batch: int = 32
+    max_delay_ms: float = 2.0
+
+    @property
+    def max_delay(self) -> float:
+        """max_delay_ms in seconds (the unit the wait loops use)."""
+        return self.max_delay_ms / 1e3
+
+
 class DynamicBatcher:
     """Serving-side request coalescing (~ the reference serving stack's
     request batching in front of AnalysisPredictor).
@@ -227,14 +250,23 @@ class DynamicBatcher:
     few large matmuls, and XLA compiles one executable per batch size, so
     gathered batches PAD UP to power-of-two buckets (<= max_batch) to
     keep the compiled-shape set logarithmic. Results are split back per
-    request; padding rows are dropped.
+    request; padding rows are dropped. A lone request never waits past
+    ``max_delay_ms``: the flush timer fires and it rides a batch of one.
     """
 
-    def __init__(self, predictor: Predictor, max_batch: int = 32,
-                 max_delay_ms: float = 2.0):
+    def __init__(self, predictor: Predictor, max_batch: int | None = None,
+                 max_delay_ms: float | None = None,
+                 config: BatchingConfig | None = None):
+        config = config or BatchingConfig()
+        if max_batch is not None:
+            config = dataclasses.replace(config, max_batch=max_batch)
+        if max_delay_ms is not None:
+            config = dataclasses.replace(config,
+                                         max_delay_ms=max_delay_ms)
         self.predictor = predictor
-        self.max_batch = max_batch
-        self.max_delay = max_delay_ms / 1e3
+        self.config = config
+        self.max_batch = config.max_batch
+        self.max_delay = config.max_delay
         self._pending: List = []
         self._cv = threading.Condition()
         self._stopped = False

@@ -9,7 +9,61 @@ of each call site feature-testing jax inline.
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
+
+# compat context-mesh slot for jax builds without jax.sharding.set_mesh
+# (set_mesh below stores the mesh here; get_context_mesh reads it)
+_CTX_MESH = {"mesh": None}
+
+
+def _native_ctx_mesh() -> bool:
+    """ONE feature test for the whole context-mesh pair: jax must have
+    BOTH jax.sharding.set_mesh and get_abstract_mesh for the native
+    path — on builds with only one (the 0.5.x window shipped
+    get_abstract_mesh before set_mesh went public), a split test would
+    store the mesh in the compat slot while the probe reads the empty
+    native abstract mesh, silently disabling manual sharding."""
+    return (hasattr(jax.sharding, "set_mesh")
+            and callable(getattr(jax.sharding, "get_abstract_mesh",
+                                 None)))
+
+
+def set_mesh(mesh):
+    """Context manager installing ``mesh`` as the ambient sharding mesh:
+    ``jax.sharding.set_mesh`` on new jax, else a module-level slot that
+    ``get_context_mesh`` (the pallas-sharding probe) reads."""
+    if _native_ctx_mesh():
+        return jax.sharding.set_mesh(mesh)
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = _CTX_MESH["mesh"]
+        _CTX_MESH["mesh"] = mesh
+        try:
+            yield mesh
+        finally:
+            _CTX_MESH["mesh"] = prev
+
+    return _cm()
+
+
+def get_context_mesh():
+    """(mesh, eligible_axes) for manual shard_map over the ambient mesh.
+
+    New jax: the abstract mesh + its AUTO axes (only those may go
+    manual inside a pjit trace). Old jax (no abstract-mesh API): the
+    compat ``set_mesh`` context, every axis eligible — 0.4.x has no
+    auto/manual axis types, shard_map with a concrete mesh under jit
+    is the normal form there."""
+    if _native_ctx_mesh():
+        amesh = jax.sharding.get_abstract_mesh()
+        eligible = getattr(amesh, "auto_axes", ()) if amesh is not None \
+            else ()
+        return amesh, eligible
+    mesh = _CTX_MESH["mesh"]
+    return mesh, (mesh.axis_names if mesh is not None else ())
 
 
 def tpu_compiler_params():

@@ -545,7 +545,8 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     params = {k: jax.device_put(jnp.array(v._value, copy=True), shardings[k])
               for k, v in model.state_dict().items()}
 
-    from .train_utils import adamw_update, make_adamw_state
+    from .train_utils import (adamw_update, make_adamw_state,
+                              with_memory_kind)
     opt_state = make_adamw_state(mesh, shardings, params, accum_dtype,
                                  offload=offload_moments)
 
@@ -640,8 +641,9 @@ def llama_train_step_factory(model: LlamaForCausalLM, mesh: Mesh,
     #  - CPU (tests): the placement custom-call isn't implemented, so the
     #    step wrapper stages moments outside the jit — functionally
     #    identical, exercised by the CPU suite.
-    moment_dev_sh = {k: opt_state["m"][k].sharding.with_memory_kind(
-        "device") for k in params} if offload_moments else None
+    moment_dev_sh = {k: with_memory_kind(opt_state["m"][k].sharding,
+                                         "device")
+                     for k in params} if offload_moments else None
     in_jit_offload = offload_moments and jax.default_backend() != "cpu"
 
     host_m_sh = {k: opt_state["m"][k].sharding

@@ -476,7 +476,7 @@ def llama_decode_factory(model: LlamaForCausalLM, max_len: int = 256,
                        "prefill": prefill, "decode_step": decode_step,
                        "init_caches": init_caches,
                        "compiled_greedy": _compiled_greedy,
-                       "scan_layers": scan_layers}
+                       "scan_layers": scan_layers, "rolling": rolling}
     return generate
 
 
@@ -1135,7 +1135,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
 
 
 def route_decode(lengths, capacity: int, shared_prefix: bool = False,
-                 expect_churn: bool = False) -> str:
+                 expect_churn: bool = False, explain: bool = False):
     """Serving router: pick the decode backend from batch statistics
     (round-4 verdict item 6 — callers previously chose by hand).
 
@@ -1161,20 +1161,36 @@ def route_decode(lengths, capacity: int, shared_prefix: bool = False,
 
     ``lengths``: real sequence lengths (any array-like); ``capacity``:
     the batch size the dense cache would be compiled for.
+
+    ``explain=True`` returns ``(backend, rule)`` where ``rule`` names
+    the policy clause that fired — the serving engine's decision log
+    (paddle_tpu.serving) records it so a workload bench can say WHICH
+    routing rule lost when routed trails a fixed policy.
     """
     import numpy as _np
+
+    def _r(backend, rule):
+        return (backend, rule) if explain else backend
+
     lens = _np.asarray(lengths)
-    if shared_prefix or expect_churn:
-        return "paged"
+    if shared_prefix:
+        return _r("paged", "shared-prefix (prefix pages shared across "
+                           "sequences; dense replicates per slot)")
+    if expect_churn:
+        return _r("paged", "churn (dense slots pin max_len memory for "
+                           "the batch lifetime)")
     B = int(lens.size)
     if B == 0:
-        return "dense"
+        return _r("dense", "empty wave")
     spread = float(lens.max() - lens.min()) / max(1.0, float(lens.max()))
-    if spread > 0.25:  # ragged
-        return "paged"
-    if B < capacity // 2:  # dense would burn compute on empty slots
-        return "paged"
-    return "dense"
+    if spread > 0.25:
+        return _r("paged", f"ragged lengths (spread {spread:.2f} > 0.25; "
+                           "pages walk only real lengths)")
+    if B < capacity // 2:
+        return _r("paged", f"under-full (B={B} < capacity {capacity}//2; "
+                           "dense pays full-capacity compute)")
+    return _r("dense", "uniform near-full wave (dense compiled wins "
+                       "every uniform shape measured, PERF record 37)")
 
 
 def llama_serving_decode_factory(model: LlamaForCausalLM,
@@ -1183,7 +1199,8 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  n_pool_pages: int = 256,
                                  kv_cache_dtype: str | None = None,
                                  batch_capacity: int = 8,
-                                 scan_layers: bool = True):
+                                 scan_layers: bool = True,
+                                 chunked_prefill: int | None = None):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
     ("paged", (outer, layers, pools, prefill, decode_step, decode_n))
@@ -1197,17 +1214,31 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
     to len(lengths), which made route_decode's under-full check
     (B < capacity//2) unreachable: a 2-request wave against an 8-slot
     compiled program now correctly routes paged."""
+    # kv_cache_dtype is the SERVING cache codec: it must reach BOTH
+    # backends, or an int8-configured engine would quantize only
+    # paged-routed traffic (and int8 rounding can flip a greedy token,
+    # breaking cross-backend output parity for no routing reason)
     gen = llama_decode_factory(model, max_len=max_len,
+                               kv_cache_dtype=kv_cache_dtype,
                                scan_layers=scan_layers)
     paged = llama_paged_decode_factory(model, page_size=page_size,
                                        n_pool_pages=n_pool_pages,
                                        kv_cache_dtype=kv_cache_dtype,
+                                       chunked_prefill=chunked_prefill,
                                        scan_layers=scan_layers)
 
     class _Serving:
-        dense = gen
+        # staticmethod: a bare function class-attribute would BIND as a
+        # method and eat the first positional arg (tokens) as self
+        dense = staticmethod(gen)
         paged_parts = paged
         capacity = batch_capacity
+        # build-config metadata the serving engine reads when handed a
+        # prebuilt factory (paddle_tpu.serving.ServingEngine(serving=...))
+        max_len_ = max_len
+        page_size_ = page_size
+        n_pool_pages_ = n_pool_pages
+        chunked_prefill_ = chunked_prefill
 
         def pick(self, lengths, capacity=None, shared_prefix=False,
                  expect_churn=False):

@@ -12,6 +12,18 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def with_memory_kind(sharding, kind):
+    """``sharding.with_memory_kind(kind)`` when the backend exposes that
+    memory space, else the sharding unchanged. CPU backends on some jax
+    builds address only ``unpinned_host`` — there offload degrades to a
+    no-op (nothing to offload to) instead of failing to build the
+    optimizer state."""
+    try:
+        return sharding.with_memory_kind(kind)
+    except (ValueError, TypeError):
+        return sharding
+
+
 def zero_like_sharded(mesh, shardings, name, v, accum_dtype=jnp.float32,
                       offload=False):
     """A zeros moment buffer for param ``v``: inherits the param's
@@ -34,7 +46,7 @@ def zero_like_sharded(mesh, shardings, name, v, accum_dtype=jnp.float32,
                 break
     target = NamedSharding(mesh, P(*spec))
     if offload:
-        target = target.with_memory_kind("pinned_host")
+        target = with_memory_kind(target, "pinned_host")
     return jax.device_put(jnp.zeros(v.shape, accum_dtype), target)
 
 

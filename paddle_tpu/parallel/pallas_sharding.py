@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ..jax_compat import get_context_mesh
 from ..jax_compat import shard_map as _shard_map
 
 # test hook: set True whenever a wrapped (manual) kernel launch is traced
@@ -36,9 +37,7 @@ def shard_map_attention(fn, q, k, v, mesh=None, head_axis: str = "model",
     does not apply.
     """
     if mesh is None:
-        amesh = jax.sharding.get_abstract_mesh()
-        eligible = getattr(amesh, "auto_axes", ()) if amesh is not None \
-            else ()
+        amesh, eligible = get_context_mesh()
         if head_axis not in eligible:
             return fn(q, k, v)
         mesh = amesh

@@ -1,0 +1,17 @@
+"""paddle_tpu.serving — continuous-batching serving engine + workload
+harness.
+
+The subsystem above ``models/nlp/llama_decode`` and ``inference``: a
+request-stream engine (``ServingEngine``) driving the dense compiled
+cache and the paged KV pool behind a pluggable routing policy, a
+seeded replayable trace generator (``workload``), and per-request
+TTFT/TPOT/SLO metrics (``metrics``). ``tools/serving_workload_bench.py``
+replays one trace through routed / dense-only / paged-only and
+``tools/bench_gate.py serving`` gates the routed row.
+"""
+from .engine import (EngineClock, FixedPolicy,  # noqa: F401
+                     Policy, RoutedPolicy, ServeResult, ServingEngine,
+                     make_policy)
+from .metrics import MetricsCollector  # noqa: F401
+from .workload import (Request, load_trace, merge_traces,  # noqa: F401
+                       save_trace, synthesize_trace, trace_stats)

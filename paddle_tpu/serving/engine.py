@@ -1,0 +1,550 @@
+"""Continuous-batching serving engine over the dense/paged decode stack.
+
+The layer between a request stream and the compiled decode programs —
+what the reference's inference engine wraps around
+fused_multi_transformer, rebuilt TPU-native on this repo's backends:
+
+  arrive -> (admission window) -> route -> prefill -> decode slots
+         -> complete / evict, pages freed for the next request.
+
+One engine, two execution backends, one policy seam:
+
+- **paged** (continuous batching): per-request chunked prefill into the
+  paged KV pool, then ONE fixed-shape jitted decode step for whatever
+  mix of requests occupies the slots — tables and lengths are data, so
+  admission/eviction never recompiles. Shared prompt prefixes ride the
+  pool's refcounted prefix cache (acquire before allocate, register
+  after prefill) and skip their cached prefill chunks.
+- **dense** (wave batching): a uniform admission wave runs on the dense
+  compiled cache as one batch — prefill + per-token decode steps — the
+  backend that wins uniform near-full shapes on chip (PERF record 37).
+- **policy**: ``RoutedPolicy`` (default) delegates to
+  ``route_decode``/``_Serving.pick`` per admission wave and logs WHICH
+  rule fired; ``FixedPolicy`` pins one backend (the bench's
+  dense-only/paged-only arms). Policies are pluggable objects — a
+  custom one needs only ``route(wave, ctx)``.
+
+Admission shares its config surface with ``inference.DynamicBatcher``
+(``BatchingConfig``: max_batch + max_delay) — the request/response
+batcher and this token-stream batcher coalesce with the same knobs.
+
+Time is VIRTUAL: the clock advances by the measured wall duration of
+each jitted call (``clock="measured"``, the bench mode — queueing and
+compute show up honestly without sleeping through arrival gaps) or by
+fixed per-action costs (``clock="fixed"``, the deterministic test mode:
+same trace -> same completion order, timestamps, slot occupancy).
+Replay a trace twice with the same engine to exclude compile time: the
+first pass warms every program shape.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference import BatchingConfig
+from ..models.nlp.llama_decode import (llama_serving_decode_factory,
+                                       route_decode)
+from ..ops.pallas.paged_attention import PagedKVCache
+from .metrics import MetricsCollector
+from .workload import Request
+
+
+class EngineClock:
+    """Virtual time. ``measured``: each timed action adds its wall
+    duration (block_until_ready'd). ``fixed``: each action adds
+    ``costs[kind]`` (default 1.0) — fully deterministic."""
+
+    def __init__(self, mode: str = "measured", costs: dict | None = None):
+        if mode not in ("measured", "fixed"):
+            raise ValueError(f"clock {mode!r}: use 'measured' or 'fixed'")
+        self.mode = mode
+        self.costs = costs or {}
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+    def advance_to(self, t: float):
+        self.t = max(self.t, t)
+
+    def timed(self, kind: str, fn):
+        if self.mode == "fixed":
+            out = fn()
+            self.t += float(self.costs.get(kind, 1.0))
+            return out
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        self.t += time.perf_counter() - t0
+        return out
+
+
+class Policy:
+    """Routes one admission wave. ``ctx`` carries the wave statistics
+    (lengths, capacity, shared_prefix, expect_churn) plus engine state
+    (active_paged). Returns (backend, reason)."""
+
+    name = "base"
+
+    def route(self, wave: List[Request], ctx: dict):
+        raise NotImplementedError
+
+
+class FixedPolicy(Policy):
+    """Everything to one backend — the bench's ablation arms."""
+
+    def __init__(self, backend: str):
+        if backend not in ("dense", "paged"):
+            raise ValueError(f"backend {backend!r}")
+        self.backend = backend
+        self.name = backend
+
+    def route(self, wave, ctx):
+        return self.backend, f"fixed policy ({self.backend}-only)"
+
+
+class RoutedPolicy(Policy):
+    """The default: delegate to ``route_decode`` (the chip-measured
+    policy behind ``_Serving.pick``), with one engine-level rule layered
+    on top — a wave arriving while paged requests are mid-flight joins
+    the running batch rather than stalling it behind a dense wave (one
+    chip serializes programs; parking N streaming requests to run a
+    wave start-to-finish would torch their TPOT)."""
+
+    name = "routed"
+
+    def route(self, wave, ctx):
+        if ctx.get("active_paged", 0) > 0:
+            return "paged", ("join-active-batch (paged requests "
+                             "mid-flight; a dense wave would stall "
+                             "their token streams)")
+        return route_decode([len(r.prompt) for r in wave],
+                            ctx["capacity"],
+                            shared_prefix=ctx["shared_prefix"],
+                            expect_churn=ctx["expect_churn"],
+                            explain=True)
+
+
+def make_policy(spec) -> Policy:
+    if isinstance(spec, Policy):
+        return spec
+    if spec == "routed":
+        return RoutedPolicy()
+    return FixedPolicy(spec)
+
+
+@dataclasses.dataclass
+class ServeResult:
+    policy: str
+    outputs: Dict[str, List[int]]   # rid -> generated tokens (in order)
+    metrics: MetricsCollector
+    decisions: List[dict]           # one per admission wave
+    slot_log: List[tuple]           # (t, "acquire"|"release", rid, slot)
+    prefix_cached: Dict[str, int]   # rid -> prompt tokens prefix-cache hit
+    pages_total: int
+    pages_free_end: int
+
+    def report(self, **slo) -> dict:
+        return self.metrics.report(**slo)
+
+
+class _PagedRow:
+    __slots__ = ("req", "slot", "tok", "out", "eff", "done")
+
+    def __init__(self, req: Request, slot: int, first_tok: int):
+        self.req = req
+        self.slot = slot
+        self.tok = first_tok
+        self.out = [first_tok]
+        cancel = req.cancel_after if req.cancel_after is not None \
+            else 10 ** 9
+        self.eff = min(req.max_new_tokens, cancel)
+        self.done = False
+
+
+class ServingEngine:
+    """Replay a trace (workload.Request list) through the serving stack.
+
+    ``slots``: concurrent paged decode rows (the fixed compiled batch
+    shape; empty slots ride along as length-0 page-0 rows) and the dense
+    routing capacity. ``decode_chunk``: decode steps fused per scheduler
+    turn via ``decode_n`` (dispatch amortization; tokens within a chunk
+    share a timestamp). ``serving``: a prebuilt
+    ``llama_serving_decode_factory(...)`` to share compiled programs
+    across engines (its build config must carry ``chunked_prefill`` —
+    the prefix-cache resume path needs chunked prefill).
+    """
+
+    def __init__(self, model=None, *, serving=None, slots: int = 4,
+                 max_len: int = 64, page_size: int = 8,
+                 n_pool_pages: Optional[int] = None, policy="routed",
+                 admission: Optional[BatchingConfig] = None,
+                 decode_chunk: int = 1, clock: str = "measured",
+                 fixed_costs: Optional[dict] = None,
+                 eos_token_id: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = None,
+                 scan_layers: bool = True,
+                 expect_churn: Optional[bool] = None):
+        if serving is None:
+            if model is None:
+                raise ValueError("pass a model or a prebuilt serving "
+                                 "factory")
+            if max_len % page_size:
+                raise ValueError(f"max_len {max_len} must be a multiple "
+                                 f"of page_size {page_size}")
+            if n_pool_pages is None:
+                # page 0 is the reserved padding page; each slot may
+                # need max_len/page_size pages
+                n_pool_pages = slots * (max_len // page_size) + 1
+            serving = llama_serving_decode_factory(
+                model, max_len=max_len, page_size=page_size,
+                n_pool_pages=n_pool_pages, kv_cache_dtype=kv_cache_dtype,
+                batch_capacity=slots, scan_layers=scan_layers,
+                chunked_prefill=page_size)
+        else:
+            max_len = serving.max_len_
+            page_size = serving.page_size_
+            n_pool_pages = serving.n_pool_pages_
+        if serving.chunked_prefill_ is None:
+            raise ValueError("the engine needs a chunked-prefill paged "
+                             "backend (llama_serving_decode_factory("
+                             "chunked_prefill=<page multiple>)) — "
+                             "prefix-cache resume skips whole chunks")
+        dense_parts = serving.dense._parts
+        if dense_parts.get("rolling"):
+            raise ValueError("dense wave batching over a rolling "
+                             "(sliding-window) cache is unsupported")
+        self.serving = serving
+        self.slots = slots
+        self.max_len = max_len
+        self.page_size = page_size
+        self.n_pool_pages = n_pool_pages
+        self.W = max_len // page_size  # fixed page-table width
+        self.chunk_C = serving.chunked_prefill_
+        if clock not in ("measured", "fixed"):
+            raise ValueError(f"clock {clock!r}: use 'measured' or "
+                             "'fixed'")
+        self.policy = make_policy(policy)
+        self.admission = admission or BatchingConfig()
+        self.decode_chunk = decode_chunk
+        self.clock_mode = clock
+        self.fixed_costs = fixed_costs
+        self.eos_token_id = eos_token_id
+        self._expect_churn = expect_churn
+        self._dense = dense_parts
+        (self._p_outer, self._p_layers, pools, self._p_prefill,
+         self._p_step, self._p_decode_n) = serving.paged_parts
+        # The pool buffers are DONATED through every prefill/decode call,
+        # so the factory's original arrays die at the first use. The live
+        # pools therefore ride on the (shareable) serving object, not the
+        # engine: engines sharing one factory hand the current buffers
+        # along. Stale content between runs is harmless — attention only
+        # reads positions < each row's length, all freshly written.
+        if not hasattr(serving, "_live_pools"):
+            serving._live_pools = pools
+
+    @property
+    def _pools(self):
+        return self.serving._live_pools
+
+    @_pools.setter
+    def _pools(self, value):
+        self.serving._live_pools = value
+
+    # --- helpers ----------------------------------------------------------
+    def _pad_len(self, n: int) -> int:
+        # pad prompts to the CHUNK multiple (a page multiple by factory
+        # contract): prefill_chunked rejects prompts that are not — a
+        # page-size pad under a larger chunk would crash mid-run
+        c = self.chunk_C
+        return max(c, -(-n // c) * c)
+
+    def _footprint(self, r: Request) -> int:
+        return self._pad_len(len(r.prompt)) + r.max_new_tokens \
+            + self.decode_chunk
+
+    def _validate(self, trace):
+        for r in trace:
+            if self._footprint(r) > self.max_len:
+                raise ValueError(
+                    f"{r.rid}: padded prompt {self._pad_len(len(r.prompt))}"
+                    f" + budget {r.max_new_tokens} + chunk "
+                    f"{self.decode_chunk} exceeds max_len {self.max_len}")
+
+    # --- the replay loop --------------------------------------------------
+    def run(self, trace: List[Request]) -> ServeResult:
+        self._validate(trace)
+        clock = EngineClock(self.clock_mode, self.fixed_costs)
+        m = MetricsCollector()
+        book = PagedKVCache(self.n_pool_pages, self.page_size,
+                            kv_heads=1, head_dim=1)  # bookkeeping only:
+        # tables/lengths/free-list/prefix refcounts — device pages live
+        # in the factory pools, written by prefill/decode_n
+        pages_total = len(book._free)
+        pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
+        waiting: List[Request] = []
+        active: Dict[str, _PagedRow] = {}
+        free_slots = list(range(self.slots))
+        outputs: Dict[str, List[int]] = {}
+        decisions: List[dict] = []
+        slot_log: List[tuple] = []
+        prefix_cached: Dict[str, int] = {}
+        seen_groups: set = set()
+        expect_churn = self._expect_churn if self._expect_churn \
+            is not None else any(r.cancel_after is not None
+                                 for r in trace)
+        ctx_base = {"capacity": self.slots, "expect_churn": expect_churn}
+
+        while pending or waiting or active:
+            now = clock.now()
+            while pending and pending[0].arrival <= now + 1e-12:
+                r = pending.popleft()
+                waiting.append(r)
+                m.on_arrival(r.rid, r.arrival)
+            m.on_queue_depth(now, len(waiting))
+
+            progressed = False
+            if waiting and self._admission_ready(waiting, pending,
+                                                 active, clock):
+                wave = waiting[:self.admission.max_batch]
+                groups = [r.prefix_group for r in wave
+                          if r.prefix_group is not None]
+                shared = (len(groups) != len(set(groups))
+                          or any(g in seen_groups for g in groups))
+                ctx = dict(ctx_base, shared_prefix=shared,
+                           active_paged=len(active))
+                backend, reason = self.policy.route(wave, ctx)
+                decision = {
+                    "t": round(clock.now(), 6), "wave": len(wave),
+                    "prompt_lens": [len(r.prompt) for r in wave],
+                    "backend": backend, "rule": reason}
+                if backend == "dense":
+                    decisions.append(decision)
+                    del waiting[:len(wave)]
+                    seen_groups.update(g for g in groups)
+                    self._run_dense_wave(wave, clock, m, outputs)
+                    progressed = True
+                else:
+                    n_adm = self._admit_paged(
+                        wave, book, clock, m, active, free_slots,
+                        slot_log, prefix_cached, seen_groups, outputs)
+                    del waiting[:n_adm]
+                    progressed = n_adm > 0
+                    if n_adm:
+                        # a BLOCKED wave (no slots/pages yet) is not a
+                        # decision — it will re-route once something
+                        # frees; logging every retry turn would inflate
+                        # the per-wave statistics the bench reports
+                        decision["admitted"] = n_adm
+                        decisions.append(decision)
+                    elif not active:
+                        raise RuntimeError(
+                            f"pool/slot config too small for "
+                            f"{wave[0].rid} (free pages "
+                            f"{len(book._free)}, free slots "
+                            f"{len(free_slots)})")
+
+            if active:
+                self._paged_chunk(book, clock, m, active, free_slots,
+                                  slot_log, outputs)
+                progressed = True
+
+            if not progressed and not active:
+                targets = []
+                if pending:
+                    targets.append(pending[0].arrival)
+                if waiting:
+                    targets.append(waiting[0].arrival
+                                   + self.admission.max_delay)
+                clock.advance_to(min(targets))
+
+        return ServeResult(policy=self.policy.name, outputs=outputs,
+                           metrics=m, decisions=decisions,
+                           slot_log=slot_log, prefix_cached=prefix_cached,
+                           pages_total=pages_total,
+                           pages_free_end=len(book._free))
+
+    def _admission_ready(self, waiting, pending, active, clock) -> bool:
+        if len(waiting) >= self.admission.max_batch:
+            return True
+        if clock.now() - waiting[0].arrival \
+                >= self.admission.max_delay - 1e-12:
+            return True
+        return not pending and not active  # nothing else will ever come
+
+    # --- paged backend ----------------------------------------------------
+    def _admit_paged(self, wave, book, clock, m, active, free_slots,
+                     slot_log, prefix_cached, seen_groups, outputs) -> int:
+        admitted = 0
+        for r in wave:
+            if not free_slots:
+                break
+            sid = r.rid
+            n_cached = 0
+            if r.prefix_group is not None:
+                n_cached = book.acquire_prefix(sid, list(r.prompt))
+            try:
+                book.allocate(sid, self._footprint(r))
+            except MemoryError:
+                book.free(sid)  # release any shared prefix refs
+                break
+            book.lengths[sid] = len(r.prompt)
+            slot = free_slots.pop(0)
+            T = self._pad_len(len(r.prompt))
+            toks = np.zeros((1, T), np.int32)
+            toks[0, :len(r.prompt)] = r.prompt
+            pt = np.zeros((1, self.W), np.int32)
+            table = book.tables[sid]
+            pt[0, :len(table)] = table
+            lens = np.asarray([len(r.prompt)], np.int32)
+            resume = (n_cached // self.chunk_C) * self.chunk_C
+            m.on_admit(sid, clock.now(), "paged")
+
+            def _call(toks=toks, pt=pt, lens=lens, resume=resume):
+                return self._p_prefill(
+                    self._p_outer, self._p_layers, jnp.asarray(toks),
+                    jnp.asarray(pt), jnp.asarray(lens), self._pools,
+                    resume_from=resume)
+            first, self._pools = clock.timed("prefill", _call)
+            first_tok = int(np.asarray(first)[0])
+            if r.prefix_group is not None:
+                book.register_prefix(sid, list(r.prompt))
+                seen_groups.add(r.prefix_group)
+            row = _PagedRow(r, slot, first_tok)
+            active[sid] = row
+            slot_log.append((round(clock.now(), 6), "acquire", sid, slot))
+            prefix_cached[sid] = n_cached
+            m.on_tokens(sid, clock.now(), 1)
+            admitted += 1
+            if len(row.out) >= row.eff or first_tok == self.eos_token_id:
+                self._finish_paged(sid, book, clock, m, active,
+                                   free_slots, slot_log, outputs)
+        return admitted
+
+    def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
+                     outputs):
+        n = self.decode_chunk
+        toks = np.zeros((self.slots,), np.int32)
+        pt = np.zeros((self.slots, self.W), np.int32)
+        lens = np.zeros((self.slots,), np.int32)
+        rows = sorted(active.values(), key=lambda s: s.slot)
+        for st in rows:
+            table = book.tables[st.req.rid]
+            pt[st.slot, :len(table)] = table
+            lens[st.slot] = book.lengths[st.req.rid]
+            toks[st.slot] = st.tok
+
+        def _call():
+            return self._p_decode_n(
+                self._p_outer, self._p_layers, jnp.asarray(toks),
+                jnp.asarray(pt), jnp.asarray(lens), self._pools, n)
+        emits, _, self._pools = clock.timed("decode", _call)
+        emits = np.asarray(emits)  # (n, slots) greedy tokens
+        t = clock.now()
+        for st in rows:
+            sid = st.req.rid
+            taken = 0
+            for k in range(n):
+                if len(st.out) >= st.eff or st.done:
+                    break
+                tok = int(emits[k, st.slot])
+                st.out.append(tok)
+                taken += 1
+                if tok == self.eos_token_id:
+                    st.done = True
+            st.tok = int(emits[-1, st.slot])
+            book.lengths[sid] += n  # all n K/V writes happened
+            if taken:
+                m.on_tokens(sid, t, taken)
+            if st.done or len(st.out) >= st.eff:
+                self._finish_paged(sid, book, clock, m, active,
+                                   free_slots, slot_log, outputs)
+
+    def _finish_paged(self, sid, book, clock, m, active, free_slots,
+                      slot_log, outputs):
+        st = active.pop(sid)
+        book.free(sid)
+        free_slots.append(st.slot)
+        free_slots.sort()
+        slot_log.append((round(clock.now(), 6), "release", sid, st.slot))
+        outputs[sid] = st.out
+        r = st.req
+        evicted = (r.cancel_after is not None
+                   and st.eff == r.cancel_after
+                   and st.eff < r.max_new_tokens and not st.done)
+        m.on_finish(sid, clock.now(), evicted=evicted)
+
+    # --- dense backend ----------------------------------------------------
+    def _run_dense_wave(self, wave, clock, m, outputs):
+        """A wave on the dense compiled cache: equal-length groups batch
+        together (the dense prefill needs one S0 per program); each
+        group runs prefill + per-token decode to the LONGEST effective
+        budget in the group — short-budget rows ride along, which is
+        exactly the dense tax on mixed traffic that the router prices.
+        The wave runs start-to-finish (dense slots cannot admit or
+        evict mid-stream); arrivals meanwhile queue."""
+        parts = self._dense
+        dtype = parts["outer"]["model.embed_tokens.weight"].dtype
+        groups: Dict[int, List[Request]] = {}
+        for r in wave:
+            groups.setdefault(len(r.prompt), []).append(r)
+        for S0 in sorted(groups):
+            grp = groups[S0]
+            B = len(grp)
+            toks = np.asarray([r.prompt for r in grp], np.int32)
+            kc = parts["init_caches"](B, dtype)
+            vc = parts["init_caches"](B, dtype)
+            t_admit = clock.now()
+            for r in grp:
+                m.on_admit(r.rid, t_admit, "dense")
+
+            def _pf(kc=kc, vc=vc):
+                return parts["prefill"](parts["outer"], parts["layers"],
+                                        jnp.asarray(toks), kc, vc)
+            logits, kc, vc = clock.timed("dense_prefill", _pf)
+            cur = np.argmax(np.asarray(logits), -1).astype(np.int32)
+            t = clock.now()
+            outs = [[int(c)] for c in cur]
+            eff = [min(r.max_new_tokens,
+                       r.cancel_after if r.cancel_after is not None
+                       else 10 ** 9) for r in grp]
+            fin: List[Optional[float]] = [None] * B
+            eos_hit = [False] * B
+            for i, r in enumerate(grp):
+                m.on_tokens(r.rid, t, 1)
+                if outs[i][0] == self.eos_token_id:
+                    eos_hit[i] = True
+                if len(outs[i]) >= eff[i] or eos_hit[i]:
+                    fin[i] = t
+            pos = S0
+            while any(f is None for f in fin):
+                def _st(cur=cur, pos=pos, kc=kc, vc=vc):
+                    return parts["decode_step"](
+                        parts["outer"], parts["layers"],
+                        jnp.asarray(cur), jnp.asarray(pos), kc, vc)
+                logits, kc, vc = clock.timed("dense_decode", _st)
+                cur = np.argmax(np.asarray(logits), -1).astype(np.int32)
+                pos += 1
+                t = clock.now()
+                for i, r in enumerate(grp):
+                    if fin[i] is None:
+                        tok = int(cur[i])
+                        outs[i].append(tok)
+                        m.on_tokens(r.rid, t, 1)
+                        if tok == self.eos_token_id:
+                            eos_hit[i] = True
+                        if len(outs[i]) >= eff[i] or eos_hit[i]:
+                            fin[i] = t
+            for i, r in enumerate(grp):
+                outputs[r.rid] = outs[i]
+                evicted = (r.cancel_after is not None
+                           and eff[i] == r.cancel_after
+                           and eff[i] < r.max_new_tokens
+                           and not eos_hit[i])
+                m.on_finish(r.rid, fin[i], evicted=evicted)

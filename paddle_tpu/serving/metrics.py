@@ -1,0 +1,143 @@
+"""Serving metrics: per-request latency decomposition + stream rates.
+
+The numbers a serving system is judged by, none of which a per-shape
+microbench can produce:
+
+- **TTFT** (time to first token): arrival -> first generated token.
+  Queueing + admission + prefill; the interactive-feel metric.
+- **TPOT** (time per output token): mean inter-token gap after the
+  first token. The streaming-rate metric; stalls (e.g. a dense wave
+  hogging the chip) show up here, not in TTFT.
+- **p50/p95** over requests, not tokens — tail latency is what SLOs
+  bind on.
+- **SLO attainment**: fraction of completed requests whose TTFT/TPOT
+  beat the target.
+
+``MetricsCollector`` ingests engine events with the engine's (virtual)
+clock timestamps and exports one PERF-style JSON record per run.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class _Req:
+    __slots__ = ("arrival", "admit", "backend", "token_times", "n_tokens",
+                 "finish", "evicted")
+
+    def __init__(self, arrival: float):
+        self.arrival = arrival
+        self.admit: Optional[float] = None
+        self.backend: Optional[str] = None
+        self.token_times: List[float] = []  # one stamp per token
+        self.n_tokens = 0
+        self.finish: Optional[float] = None
+        self.evicted = False
+
+
+def _pct(xs, q) -> Optional[float]:
+    return round(float(np.percentile(np.asarray(xs), q)), 6) if xs \
+        else None
+
+
+class MetricsCollector:
+    """Event sink for one engine run; all timestamps come from the
+    engine clock (wall-measured or fixed-cost — the collector does not
+    care which)."""
+
+    def __init__(self):
+        self._req: Dict[str, _Req] = {}
+        self._queue: List[tuple] = []  # (t, depth)
+
+    # --- events ----------------------------------------------------------
+    def on_arrival(self, rid: str, t: float):
+        self._req[rid] = _Req(t)
+
+    def on_admit(self, rid: str, t: float, backend: str):
+        r = self._req[rid]
+        r.admit = t
+        r.backend = backend
+
+    def on_tokens(self, rid: str, t: float, n: int):
+        """``n`` tokens materialized at time ``t`` (a decode chunk's
+        tokens share one stamp — TPOT is chunk-granular by design)."""
+        r = self._req[rid]
+        r.token_times.extend([t] * n)
+        r.n_tokens += n
+
+    def on_finish(self, rid: str, t: float, evicted: bool = False):
+        r = self._req[rid]
+        r.finish = t
+        r.evicted = evicted
+
+    def on_queue_depth(self, t: float, depth: int):
+        self._queue.append((t, depth))
+
+    # --- views -----------------------------------------------------------
+    def request(self, rid: str) -> dict:
+        r = self._req[rid]
+        ttft = (r.token_times[0] - r.arrival) if r.token_times else None
+        tpot = None
+        if len(r.token_times) > 1:
+            tpot = ((r.token_times[-1] - r.token_times[0])
+                    / (len(r.token_times) - 1))
+        return {"arrival": r.arrival, "admit": r.admit,
+                "backend": r.backend, "n_tokens": r.n_tokens,
+                "finish": r.finish, "evicted": r.evicted,
+                "ttft": ttft, "tpot": tpot,
+                "e2e": (r.finish - r.arrival)
+                if r.finish is not None else None}
+
+    def report(self, slo_ttft: Optional[float] = None,
+               slo_tpot: Optional[float] = None) -> dict:
+        """Aggregate over FINISHED requests (evictions included: a
+        canceled request still had a TTFT and a streaming rate while it
+        lived)."""
+        done = [self.request(rid) for rid in self._req
+                if self._req[rid].finish is not None]
+        ttfts = [d["ttft"] for d in done if d["ttft"] is not None]
+        tpots = [d["tpot"] for d in done if d["tpot"] is not None]
+        e2es = [d["e2e"] for d in done]
+        tokens = sum(d["n_tokens"] for d in done)
+        arrivals = [r.arrival for r in self._req.values()]
+        finishes = [r.finish for r in self._req.values()
+                    if r.finish is not None]
+        makespan = (max(finishes) - min(arrivals)) \
+            if finishes and arrivals else 0.0
+        depths = [d for _, d in self._queue]
+        rec = {
+            "completed": len(done),
+            "evicted": sum(1 for d in done if d["evicted"]),
+            "generated_tokens": tokens,
+            "makespan": round(makespan, 6),
+            "tokens_per_sec": round(tokens / makespan, 4)
+            if makespan > 0 else None,
+            "ttft_p50": _pct(ttfts, 50), "ttft_p95": _pct(ttfts, 95),
+            "tpot_p50": _pct(tpots, 50), "tpot_p95": _pct(tpots, 95),
+            "e2e_p50": _pct(e2es, 50), "e2e_p95": _pct(e2es, 95),
+            "queue_depth_max": max(depths) if depths else 0,
+            "queue_depth_mean": round(float(np.mean(depths)), 3)
+            if depths else 0.0,
+        }
+        if slo_ttft is not None and ttfts:
+            rec["slo_ttft"] = slo_ttft
+            rec["slo_ttft_attained"] = round(
+                sum(1 for x in ttfts if x <= slo_ttft) / len(ttfts), 4)
+        if slo_tpot is not None and tpots:
+            rec["slo_tpot"] = slo_tpot
+            rec["slo_tpot_attained"] = round(
+                sum(1 for x in tpots if x <= slo_tpot) / len(tpots), 4)
+        return rec
+
+    def to_record(self, policy: str, **extra) -> dict:
+        """The canonical ``serving_workload`` row
+        (tools/serving_workload_bench.py emits one per policy;
+        tools/bench_gate.py serving mode gates routed vs best fixed)."""
+        rec = {"bench": "serving_workload", "policy": policy}
+        rec.update(self.report(**{k: extra.pop(k) for k in
+                                  ("slo_ttft", "slo_tpot")
+                                  if k in extra}))
+        rec.update(extra)
+        return rec

@@ -210,6 +210,37 @@ class TestDynamicBatcher:
         assert batcher._runs < 12, batcher._runs
         batcher.shutdown()
 
+    def test_lone_request_flushes_at_max_delay(self, tmp_path):
+        """Max-wait timeout flush: a single request with no companions
+        must NOT wait for max_batch — the delay window closes and it
+        rides a batch of one. Also pins the BatchingConfig surface the
+        serving engine shares (one config type for both batchers)."""
+        import time as _time
+        from paddle_tpu import inference
+        m, path = self._artifact(tmp_path)
+        pred = inference.Predictor(path)
+        cfg = inference.BatchingConfig(max_batch=16, max_delay_ms=40.0)
+        batcher = inference.DynamicBatcher(pred, config=cfg)
+        assert batcher.max_batch == 16
+        assert abs(batcher.max_delay - 0.040) < 1e-9
+        x = np.random.randn(1, 4).astype(np.float32)
+        _ = batcher.infer([x])  # warm the compile outside the timing
+        t0 = _time.perf_counter()
+        out = batcher.infer([x])[0]
+        waited = _time.perf_counter() - t0
+        ref = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        # flushed by the timer (~40ms), not stuck until more requests
+        # arrive; generous ceiling for slow CI hosts
+        assert waited < 5.0, waited
+        assert batcher._runs == 2  # two flushes of one request each
+        # explicit kwargs still override the config (back-compat path)
+        b2 = inference.DynamicBatcher(pred, max_batch=4,
+                                      max_delay_ms=1.0, config=cfg)
+        assert b2.max_batch == 4 and b2.config.max_delay_ms == 1.0
+        b2.shutdown()
+        batcher.shutdown()
+
     def test_two_input_model_shares_batch_symbol(self, tmp_path):
         # regression: per-input symbols made x + y un-exportable and
         # silently fell back to a batch-1 artifact

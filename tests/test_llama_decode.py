@@ -184,8 +184,17 @@ def test_eos_early_stop_batched():
 
 
 def test_int8_kv_cache_close_to_fp():
-    """kv_cache_dtype='int8': greedy generations match the fp cache on a
-    short horizon and the stored cache really is int8 (half the bytes)."""
+    """kv_cache_dtype='int8': the quantized cache's LOGITS track the fp
+    cache within ~1% under teacher forcing, and the stored cache really
+    is int8 (half the bytes).
+
+    Teacher-forced logit error is the honest measure here: a random
+    2-layer model's greedy trajectory is chaotic (near-uniform logits),
+    so token-exact match over 8 free-running steps flips on numerics
+    noise image-to-image — while the cache's actual quantization error
+    is deterministic and small."""
+    import jax.numpy as jnp
+
     from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
     cfg = LlamaConfig.tiny(vocab=97, hidden=64, layers=2, heads=4,
@@ -197,11 +206,33 @@ def test_int8_kv_cache_close_to_fp():
     gen_q = llama_decode_factory(model, max_len=32, kv_cache_dtype="int8")
     prompt = np.asarray(
         np.random.default_rng(1).integers(0, 97, (2, 6)), np.int32)
-    fp = np.asarray(gen_fp(prompt, max_new_tokens=8))
-    q8 = np.asarray(gen_q(prompt, max_new_tokens=8))
-    # compare GENERATED tokens only (the echoed prompt is equal by
-    # construction); int8 KV error is tiny at these scales
-    assert (fp[:, 6:] == q8[:, 6:]).mean() > 0.8, (fp, q8)
+    seq = np.asarray(gen_fp(prompt, max_new_tokens=8))
+
+    def drive(parts):
+        """Prefill + 7 decode steps teacher-forced on the fp tokens."""
+        kc = parts["init_caches"](2, jnp.float32)
+        vc = parts["init_caches"](2, jnp.float32)
+        lg, kc, vc = parts["prefill"](parts["outer"], parts["layers"],
+                                      jnp.asarray(prompt), kc, vc)
+        logits = [np.asarray(lg)]
+        for i in range(7):
+            lg, kc, vc = parts["decode_step"](
+                parts["outer"], parts["layers"],
+                jnp.asarray(seq[:, 6 + i]), jnp.asarray(6 + i), kc, vc)
+            logits.append(np.asarray(lg))
+        return np.stack(logits, 1), kc
+
+    lf, _ = drive(gen_fp._parts)
+    lq, kc_q = drive(gen_q._parts)
+    # prefill logits are exact (the current block overlays unquantized);
+    # decode steps read the int8 past — error stays ~1% of logit scale
+    np.testing.assert_array_equal(lf[:, 0], lq[:, 0])
+    assert np.argmax(lf[:, 0], -1).tolist() == \
+        np.argmax(lq[:, 0], -1).tolist()
+    rel = np.abs(lf - lq).max() / np.abs(lf).max()
+    assert rel < 0.05, f"int8 KV logit error {rel:.4f}"
+    # the cache really stores int8 data (+ f32 scales)
+    assert isinstance(kc_q, tuple) and kc_q[0].dtype == jnp.int8
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         llama_decode_factory(model, max_len=32, kv_cache_dtype="fp4")
 

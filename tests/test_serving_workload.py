@@ -1,0 +1,205 @@
+"""paddle_tpu.serving.workload + metrics: seeded traces and the
+TTFT/TPOT/SLO record — plus the bench-gate contract for the
+serving_workload rows (no model needed anywhere here)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (MetricsCollector, Request, load_trace,
+                                merge_traces, save_trace,
+                                synthesize_trace, trace_stats)
+
+
+def test_synthesize_trace_is_deterministic():
+    a = synthesize_trace(seed=4, n_requests=12, shared_prefix_frac=0.5,
+                         churn_frac=0.4)
+    b = synthesize_trace(seed=4, n_requests=12, shared_prefix_frac=0.5,
+                         churn_frac=0.4)
+    assert a == b
+    c = synthesize_trace(seed=5, n_requests=12, shared_prefix_frac=0.5,
+                         churn_frac=0.4)
+    assert a != c
+    # arrivals are sorted and strictly drawn; lengths within bounds
+    arr = [r.arrival for r in a]
+    assert arr == sorted(arr)
+    assert all(4 <= len(r.prompt) for r in a)
+    assert all(4 <= r.max_new_tokens <= 16 for r in a)
+
+
+def test_bursty_arrivals_are_uniform_waves():
+    tr = synthesize_trace(seed=1, n_requests=8, arrival="bursty",
+                          burst_size=4, prompt_len=(6, 20))
+    by_time = {}
+    for r in tr:
+        by_time.setdefault(r.arrival, []).append(r)
+    assert sorted(len(v) for v in by_time.values()) == [4, 4]
+    for grp in by_time.values():
+        # one shared prompt length per burst: the dense-wave shape
+        assert len({len(r.prompt) for r in grp}) == 1
+    with pytest.raises(ValueError, match="arrival"):
+        synthesize_trace(arrival="tidal")
+
+
+def test_shared_prefix_and_churn_fields():
+    tr = synthesize_trace(seed=2, n_requests=40, shared_prefix_frac=0.5,
+                          prefix_len=8, n_prefix_groups=2,
+                          churn_frac=0.5, vocab_size=64)
+    grouped = [r for r in tr if r.prefix_group is not None]
+    assert grouped  # the frac actually fires
+    prefixes = {}
+    for r in grouped:
+        prefixes.setdefault(r.prefix_group, set()).add(r.prompt[:8])
+    for g, ps in prefixes.items():
+        assert len(ps) == 1  # every member opens with the group prefix
+    churned = [r for r in tr if r.cancel_after is not None]
+    assert churned
+    assert all(1 <= r.cancel_after < r.max_new_tokens for r in churned)
+    st = trace_stats(tr)
+    assert st["shared_prefix_requests"] == len(grouped)
+    assert st["churn_requests"] == len(churned)
+    assert st["n_requests"] == 40
+
+
+def test_trace_jsonl_round_trip(tmp_path):
+    tr = synthesize_trace(seed=6, n_requests=10, shared_prefix_frac=0.3,
+                          churn_frac=0.3)
+    p = str(tmp_path / "trace.jsonl")
+    save_trace(p, tr)
+    assert load_trace(p) == tr
+
+
+def test_merge_traces_sorts_and_rejects_dup_rids():
+    a = synthesize_trace(seed=1, n_requests=3, rid_prefix="a")
+    b = synthesize_trace(seed=2, n_requests=3, rid_prefix="b")
+    m = merge_traces(a, b)
+    assert [r.arrival for r in m] == sorted(r.arrival for r in m)
+    assert len(m) == 6
+    with pytest.raises(ValueError, match="duplicate"):
+        merge_traces(a, a)
+
+
+def test_metrics_report_arithmetic():
+    """Hand-built event stream -> exact TTFT/TPOT/SLO numbers."""
+    m = MetricsCollector()
+    # request a: arrives 0, first token at 2, tokens at 3,4 -> done 4
+    m.on_arrival("a", 0.0)
+    m.on_admit("a", 1.0, "paged")
+    m.on_tokens("a", 2.0, 1)
+    m.on_tokens("a", 3.0, 1)
+    m.on_tokens("a", 4.0, 1)
+    m.on_finish("a", 4.0)
+    # request b: arrives 1, first token 5, second 9 -> evicted
+    m.on_arrival("b", 1.0)
+    m.on_admit("b", 4.0, "dense")
+    m.on_tokens("b", 5.0, 1)
+    m.on_tokens("b", 9.0, 1)
+    m.on_finish("b", 9.0, evicted=True)
+    m.on_queue_depth(0.0, 2)
+    m.on_queue_depth(5.0, 0)
+    ra = m.request("a")
+    assert ra["ttft"] == 2.0 and ra["tpot"] == 1.0 and ra["e2e"] == 4.0
+    rb = m.request("b")
+    assert rb["ttft"] == 4.0 and rb["tpot"] == 4.0 and rb["evicted"]
+    rep = m.report(slo_ttft=3.0, slo_tpot=2.0)
+    assert rep["completed"] == 2 and rep["evicted"] == 1
+    assert rep["generated_tokens"] == 5
+    assert rep["makespan"] == 9.0
+    assert rep["tokens_per_sec"] == pytest.approx(5 / 9.0, abs=1e-3)
+    assert rep["ttft_p50"] == 3.0  # median of [2, 4]
+    assert rep["slo_ttft_attained"] == 0.5  # a yes, b no
+    assert rep["slo_tpot_attained"] == 0.5
+    assert rep["queue_depth_max"] == 2
+    rec = m.to_record(policy="routed", device="cpu")
+    assert rec["bench"] == "serving_workload"
+    assert rec["policy"] == "routed" and rec["device"] == "cpu"
+
+
+def _run_gate(text, tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "BENCH_GATE_SERVING_BASELINE":
+           str(tmp_path / "b.json")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_gate.py"),
+         "serving", "-"], input=text, capture_output=True, text=True,
+        timeout=60, cwd=repo, env=env)
+    return r.returncode, json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def _wl_row(policy, tps):
+    return json.dumps({"bench": "serving_workload", "policy": policy,
+                       "tokens_per_sec": tps, "device": "cpu"})
+
+
+def test_bench_gate_serving_workload_rows(tmp_path):
+    """The gate's serving mode learns the serving_workload family:
+    routed >= 0.95x best fixed passes; a >5% loss FAILs naming the
+    winner; missing rows FAIL gracefully (a record, not a traceback)."""
+    rows = "\n".join([_wl_row("routed", 100.0), _wl_row("dense", 60.0),
+                      _wl_row("paged", 98.0)])
+    rc, rec = _run_gate(rows + "\n", tmp_path)
+    assert rc == 0 and rec["gate"] == "pass"
+    assert rec["best_fixed_policy"] == "paged"
+    assert rec["routed_vs_best_fixed"] == pytest.approx(100 / 98, .01)
+
+    rows = "\n".join([_wl_row("routed", 80.0), _wl_row("paged", 100.0)])
+    rc, rec = _run_gate(rows + "\n", tmp_path)
+    assert rc == 1 and rec["gate"] == "FAIL"
+    assert "paged" in rec["reason"]
+
+    # routed row absent -> graceful FAIL
+    rc, rec = _run_gate(_wl_row("dense", 60.0) + "\n", tmp_path)
+    assert rc == 1 and rec["gate"] == "FAIL"
+    assert "routed" in rec["reason"]
+
+    # fixed rows absent -> graceful FAIL
+    rc, rec = _run_gate(_wl_row("routed", 60.0) + "\n", tmp_path)
+    assert rc == 1 and rec["gate"] == "FAIL"
+    assert "fixed" in rec["reason"]
+
+    # diverging outputs FAIL even when the ratio would pass
+    rows = "\n".join([
+        _wl_row("routed", 100.0), _wl_row("paged", 90.0),
+        json.dumps({"bench": "serving_workload_summary",
+                    "outputs_match": False})])
+    rc, rec = _run_gate(rows + "\n", tmp_path)
+    assert rc == 1 and "DIVERGING" in rec["reason"]
+
+
+def test_bench_gate_spec_rows_still_gate(tmp_path):
+    """The original spec family keeps working alongside (regression
+    guard for the extension)."""
+    rc, rec = _run_gate(json.dumps(
+        {"bench": "spec_vs_plain_compiled", "n_draft": 4, "ratio": 1.2,
+         "output_matches_plain": True}) + "\n", tmp_path)
+    assert rc == 0 and rec["gate"] == "pass"
+    # both families present: the worse verdict wins AND the final JSON
+    # line carries the combined verdict (consumers read the last line —
+    # a passing spec record must not mask the failed workload gate)
+    rows = "\n".join([
+        json.dumps({"bench": "spec_vs_plain_compiled", "n_draft": 4,
+                    "ratio": 1.2, "output_matches_plain": True}),
+        _wl_row("routed", 50.0), _wl_row("paged", 100.0)])
+    r = subprocess.run(
+        [sys.executable, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "bench_gate.py"), "serving", "-"],
+        input=rows + "\n", capture_output=True, text=True, timeout=60,
+        env={**os.environ, "BENCH_GATE_SERVING_BASELINE":
+             str(tmp_path / "b2.json")})
+    assert r.returncode == 1
+    last = json.loads(r.stdout.strip().splitlines()[-1])
+    assert last["gate"] == "FAIL" and last["combined"] is True
+    assert last["spec_gate"] == "pass"
+    assert last["workload_gate"] == "FAIL"
+
+
+def test_request_json_round_trip():
+    r = Request(rid="x", arrival=1.5, prompt=(1, 2, 3),
+                max_new_tokens=4, prefix_group=1, cancel_after=2)
+    assert Request.from_json(json.loads(json.dumps(r.to_json()))) == r
+    r2 = Request(rid="y", arrival=0.0, prompt=(7,), max_new_tokens=1)
+    assert Request.from_json(r2.to_json()) == r2

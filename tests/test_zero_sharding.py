@@ -198,8 +198,15 @@ def test_factory_offload_moments_matches_device_states():
             model, mesh, learning_rate=1e-2, remat=False,
             offload_moments=offload)
         if offload:
-            assert all(a.sharding.memory_kind == "pinned_host"
-                       for a in opt["m"].values())
+            # some CPU jax builds expose no pinned_host memory space at
+            # all — there offload degrades to a no-op placement
+            # (train_utils.with_memory_kind) and the trajectory-parity
+            # assertion below is the whole test
+            from paddle_tpu.optimizer.optimizer import (
+                _host_memory_supported)
+            if _host_memory_supported():
+                assert all(a.sharding.memory_kind == "pinned_host"
+                           for a in opt["m"].values())
         ls = []
         for _ in range(3):
             params, opt, loss = step(params, opt, tokens, labels)

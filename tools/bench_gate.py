@@ -10,11 +10,19 @@ cannot silently ship a slower build. Three modes:
   python tools/bench_gate.py run                  # run bench.py now,
       then compare (the first chip-queue item each round)
   python tools/bench_gate.py serving <fresh.jsonl> [--stamp]
-      # gate the SERVING row: spec-compiled vs compiled-plain decode
-      # throughput from tools/spec_decode_bench.py output; a recorded
-      # spec compile failure also FAILS here (the claim is gated either
-      # way, not anecdotal). --stamp records the fresh row as the new
-      # baseline (PERF_LAST_SERVING.json) after a pass.
+      # gate the SERVING rows. Two canonical families, judged by
+      # whichever is present (both when both are):
+      #  - spec_vs_plain_compiled (tools/spec_decode_bench.py):
+      #    spec-compiled vs compiled-plain decode throughput; a
+      #    recorded spec compile failure also FAILS here (the claim is
+      #    gated either way, not anecdotal). --stamp records the fresh
+      #    row as the new baseline (PERF_LAST_SERVING.json) after a
+      #    pass.
+      #  - serving_workload (tools/serving_workload_bench.py): the
+      #    routed policy must hold >= (1 - threshold) x the best FIXED
+      #    policy's tokens/sec on the mixed trace, and the policies'
+      #    greedy outputs must agree; a missing routed/fixed row FAILs
+      #    with a clean record (graceful, never a traceback).
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -107,18 +115,85 @@ def _json_lines(text: str) -> list:
     return out
 
 
+def check_serving_workload(rows: list) -> int:
+    """Gate the trace-replay rows from tools/serving_workload_bench.py:
+    routed tokens/sec must hold >= (1 - THRESHOLD) x the best fixed
+    policy's, and the three policies' greedy outputs must agree. The
+    routed-vs-fixed claim has no stamped baseline — the fixed arms ARE
+    the baseline, re-measured in the same run on the same trace."""
+    wl = [r for r in rows if r.get("bench") == "serving_workload"]
+    routed = [r for r in wl if r.get("policy") == "routed"]
+    fixed = [r for r in wl if r.get("policy") in ("dense", "paged")]
+    if not routed:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_workload rows carry no "
+                                    "routed-policy row (run tools/"
+                                    "serving_workload_bench.py with "
+                                    "routed in --policies)"}))
+        return 1
+    if not fixed:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_workload rows carry no "
+                                    "fixed-policy (dense/paged) row to "
+                                    "compare routed against"}))
+        return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_workload_summary"]
+    if any(r.get("outputs_match") is False for r in summaries):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "policies produced DIVERGING greedy "
+                                    "outputs on the same trace "
+                                    "(correctness, not routing)"}))
+        return 1
+    rtps = float(routed[0].get("tokens_per_sec") or 0.0)
+    best = max(fixed, key=lambda r: float(r.get("tokens_per_sec") or 0.0))
+    btps = float(best.get("tokens_per_sec") or 0.0)
+    if btps <= 0 or rtps <= 0:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_workload rows carry no "
+                                    "tokens_per_sec (empty trace?)"}))
+        return 1
+    ratio = rtps / btps
+    rec = {
+        "gate": "pass" if ratio >= 1.0 - THRESHOLD else "FAIL",
+        "routed_tokens_per_sec": round(rtps, 4),
+        "best_fixed_policy": best.get("policy"),
+        "best_fixed_tokens_per_sec": round(btps, 4),
+        "routed_vs_best_fixed": round(ratio, 4),
+        "threshold": THRESHOLD,
+        "device": routed[0].get("device", "?"),
+    }
+    if rec["gate"] == "FAIL":
+        rec["reason"] = (f"routed loses the mixed trace to "
+                         f"{best.get('policy')} by {1 - ratio:.1%} — see "
+                         "the serving_workload_diagnosis row for the "
+                         "routing rule to re-measure")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
-    """Gate the spec-compiled vs compiled-plain decode row emitted by
-    tools/spec_decode_bench.py. FAILs on: no row at all, a recorded
-    compile failure, or a >threshold ratio regression vs the stamped
-    baseline — so the serving claim can only change deliberately."""
+    """Gate the serving rows: the spec-compiled vs compiled-plain row
+    (tools/spec_decode_bench.py) and/or the workload-replay rows
+    (tools/serving_workload_bench.py) — whichever families the input
+    carries; both must pass when both are present. FAILs on: no
+    canonical row at all, a recorded compile failure, output
+    divergence, or a >threshold regression — so the serving claims can
+    only change deliberately."""
+    workload_rc = None
+    if any(r.get("bench", "").startswith("serving_workload")
+           for r in rows):
+        workload_rc = check_serving_workload(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:
+        if workload_rc is not None:
+            return workload_rc  # workload-only input: that gate decides
         print(json.dumps({"gate": "FAIL",
-                          "reason": "no spec_vs_plain_compiled row in "
-                                    "input (run tools/"
-                                    "spec_decode_bench.py)"}))
+                          "reason": "no spec_vs_plain_compiled or "
+                                    "serving_workload row in input (run "
+                                    "tools/spec_decode_bench.py or "
+                                    "tools/serving_workload_bench.py)"}))
         return 1
     errors = [r for r in summary if "error" in r]
     ok = [r for r in summary if "ratio" in r]
@@ -163,7 +238,22 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
                              f"{fresh_ratio:.3f} < {base_ratio:.3f} "
                              f"- {THRESHOLD:.0%}")
     print(json.dumps(rec))
-    if rec["gate"] == "pass" and stamp:
+    spec_rc = 0 if rec["gate"] == "pass" else 1
+    rc = max(spec_rc, workload_rc or 0)
+    if workload_rc is not None:
+        # both families ran: the LAST record must carry the combined
+        # verdict — consumers read the final JSON line, and a passing
+        # spec record must not mask a failed workload gate there
+        print(json.dumps({"gate": "pass" if rc == 0 else "FAIL",
+                          "combined": True,
+                          "spec_gate": "pass" if spec_rc == 0
+                          else "FAIL",
+                          "workload_gate": "pass" if workload_rc == 0
+                          else "FAIL"}))
+    # stamp only when the COMBINED gate passes: a failing workload
+    # family must not mutate the spec baseline on its way out (a rerun
+    # would then compare against the freshly stamped row)
+    if rc == 0 and stamp:
         path = _serving_baseline_path()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -171,7 +261,7 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
             f.write("\n")
         os.replace(tmp, path)
         print(json.dumps({"gate_note": f"stamped {SERVING_BASELINE}"}))
-    return 0 if rec["gate"] == "pass" else 1
+    return rc
 
 
 def main() -> int:

@@ -51,6 +51,12 @@ QUEUE = [
     ("spec_decode_distilled",
      [sys.executable, "tools/spec_decode_bench.py", "--no-compiled"],
      {}),
+    # PR-2 addition: the trace-driven serving workload — routed vs
+    # dense-only vs paged-only on one mixed stream (ragged + bursts +
+    # shared prefixes + churn); bench_gate.py serving gates the routed
+    # row against the best fixed policy
+    ("serving_workload",
+     [sys.executable, "tools/serving_workload_bench.py"], {}),
     # ONE bench run per window, wrapped by the regression gate (round-4
     # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
     # gate snapshots the baseline, runs bench.py, fails on >5% legacy-

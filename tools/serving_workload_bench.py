@@ -1,0 +1,201 @@
+"""Trace-driven serving workload bench: routed vs dense-only vs
+paged-only on ONE mixed request stream.
+
+The router (`route_decode`) was justified by per-shape microbenches;
+this bench makes it earn its keep as a SYSTEM: a seeded trace with
+ragged Poisson traffic, uniform bursts, shared prompt prefixes and
+mid-run cancellations replays through `paddle_tpu.serving.ServingEngine`
+under three policies, and the canonical `serving_workload` rows carry
+TTFT/TPOT/p95/tokens-per-sec per policy. `tools/bench_gate.py serving`
+gates the routed row against the best fixed policy (~5% threshold):
+either routed wins the mixed trace, or the `serving_workload_diagnosis`
+row documents which routing decision lost to which fixed policy.
+
+Each policy replays the trace TWICE: the first pass compiles every
+program shape (dense groups compile per (B, S0)), the second is the
+measured one — serving latency, not compile latency.
+
+Run:  python tools/serving_workload_bench.py --cpu
+      python tools/serving_workload_bench.py --cpu --save-trace t.jsonl
+      python tools/serving_workload_bench.py --trace t.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (tiny model)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="ragged-stream request count (default: 16 CPU / "
+                         "48 chip)")
+    ap.add_argument("--interarrival", type=float, default=None,
+                    help="mean interarrival seconds (default sized to "
+                         "keep the engine loaded: 0.02 CPU / 0.005 chip)")
+    ap.add_argument("--trace", type=str, default=None,
+                    help="replay a saved JSONL trace instead of "
+                         "synthesizing")
+    ap.add_argument("--save-trace", type=str, default=None)
+    ap.add_argument("--policies", type=str, default="routed,dense,paged")
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--decode-chunk", type=int, default=1)
+    ap.add_argument("--slo-ttft", type=float, default=None)
+    ap.add_argument("--slo-tpot", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    import os
+
+    import jax
+    if args.cpu or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np  # noqa: F401
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    from paddle_tpu.serving import (ServingEngine, load_trace,
+                                    merge_traces, save_trace,
+                                    synthesize_trace, trace_stats)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048,
+                          dtype=jnp.bfloat16)
+        slots = args.slots or 8
+        page_size, max_len = 64, 1024
+        prompt_rng, out_rng, prefix_len = (64, 320), (16, 64), 128
+        n_req = args.requests or 48
+        inter = args.interarrival or 0.005
+    else:
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=2)
+        slots = args.slots or 4
+        page_size, max_len = 8, 64
+        prompt_rng, out_rng, prefix_len = (6, 18), (4, 12), 16
+        n_req = args.requests or 16
+        inter = args.interarrival or 0.02
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if on_tpu:
+        model.to(dtype="bfloat16")
+
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        # the MIXED stream the router exists for: ragged poisson singles
+        # (shared prefixes + churn) interleaved with uniform bursts of
+        # exactly `slots` requests (the dense sweet spot)
+        ragged = synthesize_trace(
+            seed=args.seed, n_requests=n_req, arrival="poisson",
+            mean_interarrival=inter, prompt_len=prompt_rng,
+            output_len=out_rng, vocab_size=cfg.vocab_size,
+            shared_prefix_frac=0.35, prefix_len=prefix_len,
+            n_prefix_groups=2, churn_frac=0.2, rid_prefix="r")
+        burst = synthesize_trace(
+            seed=args.seed + 1, n_requests=2 * slots, arrival="bursty",
+            burst_size=slots, mean_interarrival=inter * 4,
+            prompt_len=prompt_rng, output_len=out_rng,
+            vocab_size=cfg.vocab_size, rid_prefix="b")
+        trace = merge_traces(ragged, burst)
+    if args.save_trace:
+        save_trace(args.save_trace, trace)
+    stats = trace_stats(trace)
+
+    srv = llama_serving_decode_factory(
+        model, max_len=max_len, page_size=page_size,
+        n_pool_pages=slots * (max_len // page_size) + 1,
+        batch_capacity=slots, chunked_prefill=page_size)
+    device = str(jax.devices()[0])
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    slo = {}
+    if args.slo_ttft is not None:
+        slo["slo_ttft"] = args.slo_ttft
+    if args.slo_tpot is not None:
+        slo["slo_tpot"] = args.slo_tpot
+
+    rows, outputs, decisions = {}, {}, {}
+    for pol in [p.strip() for p in args.policies.split(",") if p.strip()]:
+        eng = ServingEngine(serving=srv, slots=slots, policy=pol,
+                            decode_chunk=args.decode_chunk,
+                            clock="measured")
+        eng.run(trace)                 # warmup: compile every shape
+        res = eng.run(trace)           # measured replay
+        routed_waves = {}
+        for d in res.decisions:
+            routed_waves[d["backend"]] = \
+                routed_waves.get(d["backend"], 0) + 1
+        rec = res.metrics.to_record(
+            policy=pol, device=device, seed=args.seed,
+            decode_chunk=args.decode_chunk, slots=slots,
+            waves=routed_waves, trace=stats,
+            prefix_cached_tokens=sum(res.prefix_cached.values()), **slo)
+        rows[pol] = rec
+        outputs[pol] = res.outputs
+        decisions[pol] = res.decisions
+        emit(rec)
+
+    # cross-policy greedy-token parity: all three serve the same stream,
+    # so every request's tokens must agree (the correctness backstop)
+    pols = list(rows)
+    match = True
+    if len(pols) > 1:
+        base = outputs[pols[0]]
+        match = all(outputs[p] == base for p in pols[1:])
+    summary = {"bench": "serving_workload_summary", "device": device,
+               "outputs_match": bool(match)}
+    if "routed" in rows and len(pols) > 1:
+        fixed = {p: rows[p].get("tokens_per_sec") or 0.0
+                 for p in pols if p != "routed"}
+        best = max(fixed, key=fixed.get)
+        rtps = rows["routed"].get("tokens_per_sec") or 0.0
+        summary.update({
+            "routed_tokens_per_sec": rtps,
+            "best_fixed_policy": best,
+            "best_fixed_tokens_per_sec": fixed[best],
+            "routed_vs_best_fixed": round(rtps / fixed[best], 4)
+            if fixed[best] else None,
+        })
+        emit(summary)
+        if fixed[best] and rtps < fixed[best]:
+            # the acceptance contract: when routed loses, SAY which
+            # routing decisions diverged from the winning fixed policy
+            # and by how much — the rule to re-derive is named, not
+            # hidden in an aggregate
+            diverged = [d for d in decisions["routed"]
+                        if d["backend"] != best]
+            note = (("waves above were routed away from the winning "
+                     f"fixed policy ({best}); the 'rule' field names "
+                     "the route_decode clause to re-measure")
+                    if diverged else
+                    ("routed made the SAME backend choice as the "
+                     "winner on every wave — the gap is run-to-run "
+                     "noise, not a routing rule"))
+            emit({"bench": "serving_workload_diagnosis",
+                  "loser": "routed", "winner": best,
+                  "gap": round(1.0 - rtps / fixed[best], 4),
+                  "diverging_waves": diverged, "note": note})
+    else:
+        emit(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
