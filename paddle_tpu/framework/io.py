@@ -46,11 +46,22 @@ def _from_serializable(obj: Any, return_numpy: bool = False) -> Any:
 
 
 def save(obj: Any, path: str, protocol: int = 4) -> None:
+    """Atomic: pickle to a tmp file in the SAME directory, then
+    ``os.replace`` onto the final path (the tmp->mv discipline
+    incubate/checkpoint/auto_checkpoint.py follows). A crash or
+    pickling error mid-write can therefore never leave a truncated
+    file where a valid checkpoint used to be."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_serializable(obj), f, protocol=protocol)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def load(path: str, return_numpy: bool = False) -> Any:

@@ -3,15 +3,24 @@ harness.
 
 The subsystem above ``models/nlp/llama_decode`` and ``inference``: a
 request-stream engine (``ServingEngine``) driving the dense compiled
-cache and the paged KV pool behind a pluggable routing policy, a
-seeded replayable trace generator (``workload``), and per-request
-TTFT/TPOT/SLO metrics (``metrics``). ``tools/serving_workload_bench.py``
-replays one trace through routed / dense-only / paged-only and
-``tools/bench_gate.py serving`` gates the routed row.
+cache and the paged KV pool behind a pluggable routing policy, a QoS
+scheduling front door (``scheduler.QoSScheduler``: strict priorities
+over per-tenant weighted fair queueing, deadline-feasibility
+admission, overload shedding + degradation tiers), a seeded
+replayable trace generator (``workload``, including the multi-tenant
+overload trace), and per-request TTFT/TPOT/SLO/goodput/fairness
+metrics (``metrics``). ``tools/serving_workload_bench.py`` replays
+one trace through routed / dense-only / paged-only (and ``--qos``
+replays the overload trace fifo-vs-qos); ``tools/bench_gate.py
+serving`` gates both families.
 """
 from .engine import (EngineClock, FixedPolicy,  # noqa: F401
                      Policy, RoutedPolicy, ServeResult, ServingEngine,
                      make_policy)
 from .metrics import MetricsCollector  # noqa: F401
-from .workload import (Request, load_trace, merge_traces,  # noqa: F401
-                       save_trace, synthesize_trace, trace_stats)
+from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401
+                        ServiceEstimator)
+from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
+                       load_trace, merge_traces, save_trace,
+                       synthesize_overload_trace, synthesize_trace,
+                       trace_stats)

@@ -52,6 +52,7 @@ from ..models.nlp.llama_decode import (llama_serving_decode_factory,
                                        route_decode)
 from ..ops.pallas.paged_attention import PagedKVCache
 from .metrics import MetricsCollector
+from .scheduler import QoSScheduler, ServiceEstimator
 from .workload import Request
 
 
@@ -149,6 +150,9 @@ class ServeResult:
     prefix_cached: Dict[str, int]   # rid -> prompt tokens prefix-cache hit
     pages_total: int
     pages_free_end: int
+    scheduler: str = "fifo"         # admission discipline that ran
+    shed: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # rid -> shed reason (QoS scheduler only; FIFO never sheds)
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -179,6 +183,10 @@ class ServingEngine:
     ``llama_serving_decode_factory(...)`` to share compiled programs
     across engines (its build config must carry ``chunked_prefill`` —
     the prefix-cache resume path needs chunked prefill).
+    ``scheduler``: None (FIFO, byte-identical to PR 2), ``"qos"``, or
+    a configured ``QoSScheduler`` — the SLO-aware front door (priority
+    + weighted-fair admission, deadline feasibility, shedding and
+    degradation, timeouts).
     """
 
     def __init__(self, model=None, *, serving=None, slots: int = 4,
@@ -190,7 +198,8 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None,
                  kv_cache_dtype: Optional[str] = None,
                  scan_layers: bool = True,
-                 expect_churn: Optional[bool] = None):
+                 expect_churn: Optional[bool] = None,
+                 scheduler=None):
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -231,6 +240,16 @@ class ServingEngine:
             raise ValueError(f"clock {clock!r}: use 'measured' or "
                              "'fixed'")
         self.policy = make_policy(policy)
+        # scheduler=None is the FIFO default and replays PR-2 traces
+        # BYTE-IDENTICALLY (the determinism promise above); "qos" or a
+        # QoSScheduler instance routes runs through the QoS front door
+        if scheduler == "qos":
+            scheduler = QoSScheduler()
+        if scheduler is not None and not hasattr(scheduler, "select"):
+            raise ValueError("scheduler must be None, 'qos', or a "
+                             "QoSScheduler-like object with "
+                             "enqueue/select/commit")
+        self.scheduler = scheduler
         self.admission = admission or BatchingConfig()
         self.decode_chunk = decode_chunk
         self.clock_mode = clock
@@ -279,6 +298,8 @@ class ServingEngine:
 
     # --- the replay loop --------------------------------------------------
     def run(self, trace: List[Request]) -> ServeResult:
+        if self.scheduler is not None:
+            return self._run_scheduled(trace, self.scheduler)
         self._validate(trace)
         clock = EngineClock(self.clock_mode, self.fixed_costs)
         m = MetricsCollector()
@@ -306,7 +327,13 @@ class ServingEngine:
             while pending and pending[0].arrival <= now + 1e-12:
                 r = pending.popleft()
                 waiting.append(r)
-                m.on_arrival(r.rid, r.arrival)
+                # QoS fields ride along so a FIFO baseline run on a
+                # QoS trace still reports deadline attainment/goodput;
+                # on a plain trace they are all None and the metrics
+                # record stays byte-identical to PR 2
+                m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
+                             priority=r.priority,
+                             deadline_ms=r.deadline_ms)
             m.on_queue_depth(now, len(waiting))
 
             progressed = False
@@ -377,6 +404,159 @@ class ServingEngine:
                 >= self.admission.max_delay - 1e-12:
             return True
         return not pending and not active  # nothing else will ever come
+
+    # --- the QoS-scheduled replay loop ------------------------------------
+    def _run_scheduled(self, trace: List[Request],
+                       sched) -> ServeResult:
+        """The same arrive->admit->route->prefill->decode lifecycle,
+        with the scheduler owning the waiting set: it orders admission
+        (priority above weighted fair queueing), sheds what cannot meet
+        its deadline (at enqueue under a queue bound, at selection once
+        infeasible), clamps budgets through degradation tiers, and the
+        engine times out RUNNING rows past their deadline through the
+        same eviction path ``cancel_after`` uses."""
+        self._validate(trace)
+        sched.reset()
+        clock = EngineClock(self.clock_mode, self.fixed_costs)
+        costs = self.fixed_costs or {}
+        est = ServiceEstimator(prefill=costs.get("prefill", 1.0),
+                               decode=costs.get("decode", 1.0))
+        m = MetricsCollector()
+        book = PagedKVCache(self.n_pool_pages, self.page_size,
+                            kv_heads=1, head_dim=1)
+        pages_total = len(book._free)
+        pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
+        active: Dict[str, _PagedRow] = {}
+        free_slots = list(range(self.slots))
+        outputs: Dict[str, List[int]] = {}
+        decisions: List[dict] = []
+        slot_log: List[tuple] = []
+        prefix_cached: Dict[str, int] = {}
+        shed_log: Dict[str, str] = {}
+        seen_groups: set = set()
+        expect_churn = self._expect_churn if self._expect_churn \
+            is not None else any(r.cancel_after is not None
+                                 for r in trace)
+        ctx_base = {"capacity": self.slots, "expect_churn": expect_churn}
+
+        def _shed(pairs):
+            for r, reason in pairs:
+                m.on_shed(r.rid, clock.now(), reason)
+                shed_log[r.rid] = reason
+            return bool(pairs)
+
+        while pending or sched.waiting() or active:
+            now = clock.now()
+            while pending and pending[0].arrival <= now + 1e-12:
+                r = pending.popleft()
+                m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
+                             priority=r.priority,
+                             deadline_ms=r.deadline_ms)
+                _shed(sched.enqueue(r, now))
+            m.on_queue_depth(now, sched.waiting())
+            progressed = _shed(sched.shed_expired(now))
+
+            if sched.waiting() and self._sched_ready(sched, pending,
+                                                     active, clock):
+                dec = sched.select(now,
+                                   max_batch=self.admission.max_batch,
+                                   est=est,
+                                   decode_chunk=self.decode_chunk)
+                progressed |= _shed(dec.shed)
+                wave = dec.wave
+                if wave:
+                    groups = [r.prefix_group for r in wave
+                              if r.prefix_group is not None]
+                    shared = (len(groups) != len(set(groups))
+                              or any(g in seen_groups for g in groups))
+                    ctx = dict(ctx_base, shared_prefix=shared,
+                               active_paged=len(active))
+                    backend, reason = self.policy.route(wave, ctx)
+                    decision = {
+                        "t": round(clock.now(), 6), "wave": len(wave),
+                        "prompt_lens": [len(r.prompt) for r in wave],
+                        "backend": backend, "rule": reason,
+                        "rids": [r.rid for r in wave]}
+                    if backend == "dense":
+                        decisions.append(decision)
+                        seen_groups.update(g for g in groups)
+                        self._commit_wave(wave, dec, sched, m)
+                        self._run_dense_wave(wave, clock, m, outputs,
+                                             timeouts=True)
+                        progressed = True
+                    else:
+                        t0 = clock.now()
+                        n_adm = self._admit_paged(
+                            wave, book, clock, m, active, free_slots,
+                            slot_log, prefix_cached, seen_groups,
+                            outputs)
+                        if n_adm:
+                            est.observe("prefill",
+                                        (clock.now() - t0) / n_adm)
+                            self._commit_wave(wave[:n_adm], dec, sched,
+                                              m)
+                            decision["admitted"] = n_adm
+                            decisions.append(decision)
+                            progressed = True
+                        elif not active:
+                            raise RuntimeError(
+                                f"pool/slot config too small for "
+                                f"{wave[0].rid} (free pages "
+                                f"{len(book._free)}, free slots "
+                                f"{len(free_slots)})")
+
+            if active:
+                t0 = clock.now()
+                self._paged_chunk(book, clock, m, active, free_slots,
+                                  slot_log, outputs)
+                est.observe("decode", clock.now() - t0)
+                t = clock.now()
+                for sid in list(active):
+                    dl = active[sid].req.deadline_time()
+                    if dl is not None and t > dl + 1e-9:
+                        self._finish_paged(sid, book, clock, m, active,
+                                           free_slots, slot_log,
+                                           outputs, timeout=True)
+                progressed = True
+
+            if not progressed and not active:
+                targets = []
+                if pending:
+                    targets.append(pending[0].arrival)
+                if sched.waiting():
+                    targets.append(sched.oldest_arrival()
+                                   + self.admission.max_delay)
+                if not targets:
+                    break  # everything left this turn was shed
+                clock.advance_to(min(targets))
+
+        return ServeResult(policy=self.policy.name, outputs=outputs,
+                           metrics=m, decisions=decisions,
+                           slot_log=slot_log,
+                           prefix_cached=prefix_cached,
+                           pages_total=pages_total,
+                           pages_free_end=len(book._free),
+                           scheduler=sched.name, shed=shed_log)
+
+    @staticmethod
+    def _commit_wave(admitted, dec, sched, m):
+        """Charge the fair-queue tags for what actually ran (the
+        degraded budget when a tier fired) and record degradations
+        only then — a wave member blocked on slots stays queued,
+        uncharged, and may re-degrade differently next turn."""
+        for r in admitted:
+            sched.commit(r.rid, budget=r.max_new_tokens)
+            if r.rid in dec.degraded:
+                b, b0 = dec.degraded[r.rid]
+                m.on_degrade(r.rid, b, b0)
+
+    def _sched_ready(self, sched, pending, active, clock) -> bool:
+        if sched.waiting() >= self.admission.max_batch:
+            return True
+        if clock.now() - sched.oldest_arrival() \
+                >= self.admission.max_delay - 1e-12:
+            return True
+        return not pending and not active
 
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
@@ -467,7 +647,7 @@ class ServingEngine:
                                    free_slots, slot_log, outputs)
 
     def _finish_paged(self, sid, book, clock, m, active, free_slots,
-                      slot_log, outputs):
+                      slot_log, outputs, timeout: bool = False):
         st = active.pop(sid)
         book.free(sid)
         free_slots.append(st.slot)
@@ -478,17 +658,30 @@ class ServingEngine:
         evicted = (r.cancel_after is not None
                    and st.eff == r.cancel_after
                    and st.eff < r.max_new_tokens and not st.done)
-        m.on_finish(sid, clock.now(), evicted=evicted)
+        # a deadline timeout is the same eviction path as client churn
+        # (cancel_after): stop decoding, free pages, mark evicted —
+        # only the recorded reason differs
+        m.on_finish(sid, clock.now(), evicted=evicted or timeout,
+                    reason="timeout" if timeout
+                    else ("cancel" if evicted else None))
 
     # --- dense backend ----------------------------------------------------
-    def _run_dense_wave(self, wave, clock, m, outputs):
+    def _run_dense_wave(self, wave, clock, m, outputs,
+                        timeouts: bool = False):
         """A wave on the dense compiled cache: equal-length groups batch
         together (the dense prefill needs one S0 per program); each
         group runs prefill + per-token decode to the LONGEST effective
         budget in the group — short-budget rows ride along, which is
         exactly the dense tax on mixed traffic that the router prices.
         The wave runs start-to-finish (dense slots cannot admit or
-        evict mid-stream); arrivals meanwhile queue."""
+        evict mid-stream); arrivals meanwhile queue.
+
+        ``timeouts`` (the QoS-scheduled loop only): a row whose
+        deadline passes mid-wave stops STREAMING at that point — like
+        ``cancel_after``, the batch keeps computing but the row takes
+        no more tokens and is marked evicted with reason "timeout", so
+        the goodput/timeout accounting matches the paged path even
+        though dense cannot free resources mid-stream."""
         parts = self._dense
         dtype = parts["outer"]["model.embed_tokens.weight"].dtype
         groups: Dict[int, List[Request]] = {}
@@ -514,6 +707,9 @@ class ServingEngine:
             eff = [min(r.max_new_tokens,
                        r.cancel_after if r.cancel_after is not None
                        else 10 ** 9) for r in grp]
+            dls = [r.deadline_time() if timeouts else None
+                   for r in grp]
+            timed = [False] * B
             fin: List[Optional[float]] = [None] * B
             eos_hit = [False] * B
             for i, r in enumerate(grp):
@@ -522,6 +718,9 @@ class ServingEngine:
                     eos_hit[i] = True
                 if len(outs[i]) >= eff[i] or eos_hit[i]:
                     fin[i] = t
+                elif dls[i] is not None and t > dls[i] + 1e-9:
+                    fin[i] = t
+                    timed[i] = True
             pos = S0
             while any(f is None for f in fin):
                 def _st(cur=cur, pos=pos, kc=kc, vc=vc):
@@ -541,10 +740,15 @@ class ServingEngine:
                             eos_hit[i] = True
                         if len(outs[i]) >= eff[i] or eos_hit[i]:
                             fin[i] = t
+                        elif dls[i] is not None and t > dls[i] + 1e-9:
+                            fin[i] = t
+                            timed[i] = True
             for i, r in enumerate(grp):
                 outputs[r.rid] = outs[i]
                 evicted = (r.cancel_after is not None
                            and eff[i] == r.cancel_after
                            and eff[i] < r.max_new_tokens
                            and not eos_hit[i])
-                m.on_finish(r.rid, fin[i], evicted=evicted)
+                m.on_finish(r.rid, fin[i], evicted=evicted or timed[i],
+                            reason="timeout" if timed[i]
+                            else ("cancel" if evicted else None))

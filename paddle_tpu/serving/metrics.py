@@ -25,9 +25,12 @@ import numpy as np
 
 class _Req:
     __slots__ = ("arrival", "admit", "backend", "token_times", "n_tokens",
-                 "finish", "evicted")
+                 "finish", "evicted", "tenant", "priority", "deadline_ms",
+                 "shed", "shed_reason", "budget0", "budget",
+                 "finish_reason")
 
-    def __init__(self, arrival: float):
+    def __init__(self, arrival: float, tenant=None, priority=0,
+                 deadline_ms=None):
         self.arrival = arrival
         self.admit: Optional[float] = None
         self.backend: Optional[str] = None
@@ -35,6 +38,14 @@ class _Req:
         self.n_tokens = 0
         self.finish: Optional[float] = None
         self.evicted = False
+        self.tenant: Optional[str] = tenant
+        self.priority = priority
+        self.deadline_ms: Optional[float] = deadline_ms
+        self.shed = False
+        self.shed_reason: Optional[str] = None
+        self.budget0: Optional[int] = None  # pre-degradation budget
+        self.budget: Optional[int] = None   # admitted (clamped) budget
+        self.finish_reason: Optional[str] = None
 
 
 def _pct(xs, q) -> Optional[float]:
@@ -52,13 +63,32 @@ class MetricsCollector:
         self._queue: List[tuple] = []  # (t, depth)
 
     # --- events ----------------------------------------------------------
-    def on_arrival(self, rid: str, t: float):
-        self._req[rid] = _Req(t)
+    def on_arrival(self, rid: str, t: float, tenant: Optional[str] = None,
+                   priority: int = 0,
+                   deadline_ms: Optional[float] = None):
+        self._req[rid] = _Req(t, tenant=tenant, priority=priority,
+                              deadline_ms=deadline_ms)
 
     def on_admit(self, rid: str, t: float, backend: str):
         r = self._req[rid]
         r.admit = t
         r.backend = backend
+
+    def on_shed(self, rid: str, t: float, reason: str):
+        """The scheduler rejected ``rid`` (queue bound or deadline
+        infeasibility) — it never runs, never finishes, and can never
+        count as an SLO hit."""
+        r = self._req[rid]
+        r.shed = True
+        r.shed_reason = reason
+        r.finish_reason = "shed"
+
+    def on_degrade(self, rid: str, budget: int, orig_budget: int):
+        """Graceful-degradation tier fired: ``rid`` was admitted with
+        ``max_new_tokens`` clamped from ``orig_budget`` to ``budget``."""
+        r = self._req[rid]
+        r.budget = budget
+        r.budget0 = orig_budget
 
     def on_tokens(self, rid: str, t: float, n: int):
         """``n`` tokens materialized at time ``t`` (a decode chunk's
@@ -67,10 +97,13 @@ class MetricsCollector:
         r.token_times.extend([t] * n)
         r.n_tokens += n
 
-    def on_finish(self, rid: str, t: float, evicted: bool = False):
+    def on_finish(self, rid: str, t: float, evicted: bool = False,
+                  reason: Optional[str] = None):
         r = self._req[rid]
         r.finish = t
         r.evicted = evicted
+        if reason is not None:
+            r.finish_reason = reason
 
     def on_queue_depth(self, t: float, depth: int):
         self._queue.append((t, depth))
@@ -83,18 +116,43 @@ class MetricsCollector:
         if len(r.token_times) > 1:
             tpot = ((r.token_times[-1] - r.token_times[0])
                     / (len(r.token_times) - 1))
-        return {"arrival": r.arrival, "admit": r.admit,
-                "backend": r.backend, "n_tokens": r.n_tokens,
-                "finish": r.finish, "evicted": r.evicted,
-                "ttft": ttft, "tpot": tpot,
-                "e2e": (r.finish - r.arrival)
-                if r.finish is not None else None}
+        d = {"arrival": r.arrival, "admit": r.admit,
+             "backend": r.backend, "n_tokens": r.n_tokens,
+             "finish": r.finish, "evicted": r.evicted,
+             "ttft": ttft, "tpot": tpot,
+             "e2e": (r.finish - r.arrival)
+             if r.finish is not None else None,
+             "tenant": r.tenant, "priority": r.priority,
+             "deadline_ms": r.deadline_ms, "shed": r.shed,
+             "shed_reason": r.shed_reason,
+             "finish_reason": r.finish_reason,
+             "degraded_from": r.budget0}
+        # SLO verdict: a shed request is NEVER met; without a deadline,
+        # finishing UN-EVICTED counts as met (a canceled/timed-out
+        # stream delivered partial work, not an SLO-met answer)
+        if r.shed:
+            d["deadline_met"] = False
+        elif r.finish is None:
+            d["deadline_met"] = None
+        elif r.deadline_ms is None:
+            d["deadline_met"] = not r.evicted
+        else:
+            d["deadline_met"] = bool(
+                (r.finish - r.arrival) * 1000.0
+                <= r.deadline_ms + 1e-6)
+        return d
 
     def report(self, slo_ttft: Optional[float] = None,
-               slo_tpot: Optional[float] = None) -> dict:
+               slo_tpot: Optional[float] = None,
+               tenant_weights: Optional[Dict[str, float]] = None) -> dict:
         """Aggregate over FINISHED requests (evictions included: a
         canceled request still had a TTFT and a streaming rate while it
-        lived)."""
+        lived). When the run carried QoS traffic (tenants, deadlines,
+        or sheds), the record grows the QoS block — shed rate, deadline
+        attainment, goodput (tokens from SLO-met requests ONLY; a shed
+        or late request contributes nothing), per-tenant rows and the
+        Jain fairness index over weight-normalized tenant goodput.
+        Plain traces keep the PR-2 record byte-for-byte."""
         done = [self.request(rid) for rid in self._req
                 if self._req[rid].finish is not None]
         ttfts = [d["ttft"] for d in done if d["ttft"] is not None]
@@ -129,7 +187,72 @@ class MetricsCollector:
             rec["slo_tpot"] = slo_tpot
             rec["slo_tpot_attained"] = round(
                 sum(1 for x in tpots if x <= slo_tpot) / len(tpots), 4)
+        qos_run = any(r.tenant is not None or r.deadline_ms is not None
+                      or r.shed for r in self._req.values())
+        if qos_run:
+            rec.update(self._qos_block(done, makespan, tenant_weights))
         return rec
+
+    def _qos_block(self, done: List[dict], makespan: float,
+                   tenant_weights: Optional[Dict[str, float]]) -> dict:
+        arrived = len(self._req)
+        shed = sum(1 for r in self._req.values() if r.shed)
+        qb: dict = {
+            "arrived": arrived,
+            "shed": shed,
+            "shed_rate": round(shed / arrived, 4) if arrived else 0.0,
+        }
+        with_dl = [d for d in done if d["deadline_ms"] is not None]
+        hits = [d for d in done if d["deadline_met"]]
+        if with_dl:
+            dl_hits = sum(1 for d in with_dl if d["deadline_met"])
+            qb["deadline_requests"] = len(with_dl)
+            qb["deadline_hits"] = dl_hits
+            qb["slo_deadline_attained"] = round(
+                dl_hits / len(with_dl), 4)
+        good = sum(d["n_tokens"] for d in hits)
+        qb["goodput_tokens"] = good
+        qb["goodput_tokens_per_sec"] = round(good / makespan, 4) \
+            if makespan > 0 else None
+        qb["degraded"] = sum(1 for d in done
+                             if d["degraded_from"] is not None)
+        qb["timeout_evicted"] = sum(
+            1 for d in done if d["finish_reason"] == "timeout")
+        tenants = sorted({r.tenant for r in self._req.values()
+                          if r.tenant is not None})
+        if tenants:
+            w = tenant_weights or {}
+            per: dict = {}
+            xs = []
+            for t in tenants:
+                rids = [rid for rid, r in self._req.items()
+                        if r.tenant == t]
+                views = [self.request(rid) for rid in rids]
+                gtok = sum(v["n_tokens"] for v in views
+                           if v["deadline_met"])
+                n_shed = sum(1 for v in views if v["shed"])
+                n_dl = [v for v in views
+                        if v["deadline_ms"] is not None
+                        and v["finish"] is not None]
+                per[t] = {
+                    "arrived": len(views),
+                    "shed": n_shed,
+                    "completed": sum(1 for v in views
+                                     if v["finish"] is not None),
+                    "goodput_tokens": gtok,
+                }
+                if n_dl:
+                    per[t]["slo_deadline_attained"] = round(
+                        sum(1 for v in n_dl if v["deadline_met"])
+                        / len(n_dl), 4)
+                xs.append(gtok / float(w.get(t, 1.0)))
+            qb["tenants"] = per
+            # Jain index over weight-normalized per-tenant goodput:
+            # 1.0 = perfectly weighted-fair, 1/n = one tenant took all
+            sq = sum(x * x for x in xs)
+            qb["fairness_jain"] = round(
+                (sum(xs) ** 2) / (len(xs) * sq), 4) if sq > 0 else None
+        return qb
 
     def to_record(self, policy: str, **extra) -> dict:
         """The canonical ``serving_workload`` row
@@ -137,7 +260,8 @@ class MetricsCollector:
         tools/bench_gate.py serving mode gates routed vs best fixed)."""
         rec = {"bench": "serving_workload", "policy": policy}
         rec.update(self.report(**{k: extra.pop(k) for k in
-                                  ("slo_ttft", "slo_tpot")
+                                  ("slo_ttft", "slo_tpot",
+                                   "tenant_weights")
                                   if k in extra}))
         rec.update(extra)
         return rec

@@ -1,0 +1,300 @@
+"""QoS scheduling for the serving engine: the front door between trace
+arrival and admission.
+
+PR-2's engine admits FIFO — every request equal, no deadline consulted,
+and under overload the queue just grows. This module is the scheduling
+layer production stacks win with (Orca: iteration-level scheduling is
+where continuous batching pays off; Clockwork: SLO attainment comes
+from admission-time deadline-feasibility checks over a predictable
+cost model):
+
+- **Strict priority classes** above **weighted fair queueing across
+  tenants** (start-time fair queueing: each tenant carries a virtual
+  finish tag advanced by served-work/weight; the lowest tag in the top
+  priority class goes next, so an aggressive tenant can saturate only
+  its weight share, not the queue).
+- **Deadline-feasibility admission**: estimated completion =
+  now + queued-prefill delay + prefill + ceil(budget/chunk) x decode x
+  headroom, from the engine's observed (EWMA) or fixed-clock per-action
+  costs. A request that cannot meet its ``deadline_ms`` is shed AT
+  ADMISSION — before burning prefill compute — not timed out after.
+- **Overload as policy**: bounded queues shed lowest-value first
+  (lowest priority class, then the request least likely to make its
+  deadline, then latest arrival), and graceful-degradation tiers clamp
+  ``max_new_tokens`` (1.0 -> 0.75 -> 0.5 -> 0.25 of budget) before
+  rejecting outright — a shorter answer in time beats a full answer
+  late or none at all.
+- **Aging** (optional): a waiting request's effective priority rises by
+  one class per ``aging`` clock units, so strict priority cannot
+  starve a low class under a saturating high-priority tenant.
+
+The scheduler owns the waiting set; the engine asks ``select`` for the
+next admission wave, records the sheds, and ``commit``s the requests it
+actually admitted (a wave blocked on slots/pages stays queued and is
+NOT charged to its tenant's fair-queue tag). Timeout of RUNNING
+requests is the engine's half of the contract, unified with the
+``cancel_after`` eviction path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+from .workload import Request
+
+
+class ServiceEstimator:
+    """Per-action cost model the feasibility check prices against.
+
+    Seeded from the engine clock's fixed costs (exact under
+    ``clock="fixed"``); under a measured clock the engine feeds every
+    observed prefill/decode duration back and the EWMA tracks the real
+    machine. ``headroom`` multiplies the decode estimate — co-scheduled
+    prefills steal turns from a row's decode stream, so the lone-row
+    lower bound is optimistic by design.
+    """
+
+    def __init__(self, prefill: float = 1.0, decode: float = 1.0,
+                 alpha: float = 0.25):
+        if prefill <= 0 or decode <= 0:
+            raise ValueError("estimator costs must be positive")
+        self.costs = {"prefill": float(prefill), "decode": float(decode)}
+        self.alpha = alpha
+
+    def observe(self, kind: str, dt: float):
+        if dt <= 0:
+            return
+        c = self.costs.get(kind)
+        self.costs[kind] = dt if c is None else \
+            (1 - self.alpha) * c + self.alpha * dt
+
+    @property
+    def prefill(self) -> float:
+        return self.costs["prefill"]
+
+    @property
+    def decode(self) -> float:
+        return self.costs["decode"]
+
+
+@dataclasses.dataclass
+class SchedDecision:
+    """One scheduler turn: the wave to admit (budgets possibly clamped
+    by a degradation tier) and the requests shed this turn (original
+    request, reason)."""
+
+    wave: List[Request]
+    shed: List[Tuple[Request, str]]
+    degraded: Dict[str, Tuple[int, int]]  # rid -> (new, orig) budgets
+
+
+class _Entry:
+    __slots__ = ("req", "enq_t")
+
+    def __init__(self, req: Request, enq_t: float):
+        self.req = req
+        self.enq_t = enq_t
+
+
+class QoSScheduler:
+    """SLO-aware admission + per-tenant fairness + overload shedding.
+
+    ``tenant_weights``: WFQ weight per tenant (default 1.0; requests
+    without a tenant pool under ``default_tenant``). ``max_queue``
+    bounds the waiting set (None = unbounded; shedding then comes only
+    from deadline infeasibility). ``degrade_tiers`` are budget
+    fractions tried in order before shedding an infeasible-at-full-
+    budget request; () disables degradation. ``headroom`` scales the
+    decode-time estimate in the feasibility check. ``aging`` promotes a
+    waiting request one priority class per that many clock units
+    (None = strict classes, starvation possible by design).
+    """
+
+    name = "qos"
+
+    def __init__(self, *, tenant_weights: Optional[Dict[str, float]]
+                 = None, default_tenant: str = "_default",
+                 max_queue: Optional[int] = None,
+                 degrade_tiers: Tuple[float, ...] = (1.0, 0.75, 0.5,
+                                                     0.25),
+                 headroom: float = 1.5,
+                 aging: Optional[float] = None):
+        self.tenant_weights = dict(tenant_weights or {})
+        for t, w in self.tenant_weights.items():
+            if w <= 0:
+                raise ValueError(f"tenant {t!r}: weight must be > 0")
+        self.default_tenant = default_tenant
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None)")
+        self.max_queue = max_queue
+        if any(not 0 < f <= 1 for f in degrade_tiers):
+            raise ValueError("degrade_tiers must be fractions in (0, 1]")
+        self.degrade_tiers = tuple(sorted(degrade_tiers, reverse=True))
+        if headroom < 1.0:
+            raise ValueError("headroom must be >= 1.0")
+        self.headroom = headroom
+        if aging is not None and aging <= 0:
+            raise ValueError("aging must be > 0 clock units (or None)")
+        self.aging = aging
+        self.reset()
+
+    # --- state ------------------------------------------------------------
+    def reset(self):
+        """Fresh run: empty queue, fair-queue tags back to zero (an
+        engine reuses one scheduler across ``run`` calls)."""
+        self._q: Dict[str, _Entry] = {}
+        self._tags: Dict[str, float] = {}
+
+    def waiting(self) -> int:
+        return len(self._q)
+
+    def oldest_arrival(self) -> float:
+        return min(e.req.arrival for e in self._q.values())
+
+    def queued_rids(self) -> List[str]:
+        return list(self._q)
+
+    def _tenant(self, r: Request) -> str:
+        return r.tenant if r.tenant is not None else self.default_tenant
+
+    def _weight(self, tenant: str) -> float:
+        return float(self.tenant_weights.get(tenant, 1.0))
+
+    def _eff_priority(self, e: _Entry, now: float) -> int:
+        p = e.req.priority
+        if self.aging is not None:
+            p += int((now - e.req.arrival) / self.aging)
+        return p
+
+    # --- enqueue + queue-bound shedding -----------------------------------
+    def enqueue(self, r: Request, now: float) \
+            -> List[Tuple[Request, str]]:
+        """Accept an arrival; under a full queue, shed the lowest-value
+        request (possibly the newcomer). Returns this turn's sheds."""
+        t = self._tenant(r)
+        if not any(self._tenant(e.req) == t for e in self._q.values()):
+            # SFQ re-activation: a tenant returning from idle re-enters
+            # at the current virtual time (the min tag among tenants
+            # with queued work), never below — idle time earns no
+            # credit, but accumulated debt is kept
+            live = [self._tags.get(self._tenant(e.req), 0.0)
+                    for e in self._q.values()]
+            if live:
+                self._tags[t] = max(self._tags.get(t, 0.0), min(live))
+        self._q[r.rid] = _Entry(r, now)
+        if self.max_queue is None or len(self._q) <= self.max_queue:
+            return []
+        victim = min(self._q.values(),
+                     key=lambda e: self._shed_key(e, now))
+        del self._q[victim.req.rid]
+        return [(victim.req, f"queue bound ({self.max_queue}) — lowest-"
+                 "value victim (priority, deadline slack, recency)")]
+
+    def _shed_key(self, e: _Entry, now: float):
+        """Lowest value first: lowest effective priority; then the
+        request LEAST likely to meet its deadline (smallest slack —
+        shedding the doomed wastes the least); deadline-free requests
+        rank above any deadline (infinite slack); latest arrival last."""
+        r = e.req
+        dl = r.deadline_time()
+        slack = math.inf if dl is None else dl - now
+        return (self._eff_priority(e, now), slack, -r.arrival, r.rid)
+
+    # --- the admission turn ------------------------------------------------
+    def select(self, now: float, *, max_batch: int,
+               est: ServiceEstimator, decode_chunk: int = 1) \
+            -> SchedDecision:
+        """Build the next admission wave.
+
+        Order: strict effective priority, then WFQ across tenants
+        (lowest virtual finish tag), then FIFO within a tenant. Each
+        candidate passes the deadline-feasibility check at its wave
+        position (earlier wave members' prefills delay it); an
+        infeasible candidate tries the degradation tiers, then is shed.
+        Tags are NOT charged here — the engine ``commit``s what it
+        actually admitted.
+        """
+        shed: List[Tuple[Request, str]] = []
+        degraded: Dict[str, Tuple[int, int]] = {}
+        wave: List[Request] = []
+        remaining = dict(self._q)
+        while remaining and len(wave) < max_batch:
+            top = max(self._eff_priority(e, now)
+                      for e in remaining.values())
+            cands = [e for e in remaining.values()
+                     if self._eff_priority(e, now) == top]
+            tenants = {self._tenant(e.req) for e in cands}
+            tenant = min(tenants,
+                         key=lambda t: (self._tags.get(t, 0.0), t))
+            e = min((c for c in cands if self._tenant(c.req) == tenant),
+                    key=lambda c: (c.req.arrival, c.req.rid))
+            del remaining[e.req.rid]
+            r, verdict = self._feasible(e.req, now, len(wave), est,
+                                        decode_chunk)
+            if r is None:
+                del self._q[e.req.rid]
+                shed.append((e.req, verdict))
+                continue
+            if r.max_new_tokens < e.req.max_new_tokens:
+                degraded[r.rid] = (r.max_new_tokens,
+                                   e.req.max_new_tokens)
+            wave.append(r)
+        return SchedDecision(wave=wave, shed=shed, degraded=degraded)
+
+    def _feasible(self, r: Request, now: float, wave_pos: int,
+                  est: ServiceEstimator, decode_chunk: int):
+        """Clockwork-style check: estimated completion =
+        now + (wave_pos + 1) * prefill            (admissions serialize)
+            + ceil(budget / decode_chunk) * decode * headroom.
+        Returns (request-or-degraded-copy, rule) or (None, shed
+        reason)."""
+        dl = r.deadline_time()
+        if dl is None:
+            return r, "no deadline"
+        t0 = now + (wave_pos + 1) * est.prefill
+        budget = r.max_new_tokens
+        # the FULL budget is always tried first — degrade_tiers only
+        # say what to fall back to when it does not fit (a tier tuple
+        # without 1.0 must not silently clamp feasible requests)
+        tiers = (1.0,) + tuple(f for f in self.degrade_tiers
+                               if f < 1.0)
+        for frac in tiers:
+            b = max(1, math.ceil(budget * frac))
+            fin = t0 + math.ceil(b / decode_chunk) * est.decode \
+                * self.headroom
+            if fin <= dl + 1e-9:
+                if b >= budget:
+                    return r, "feasible at full budget"
+                return (dataclasses.replace(r, max_new_tokens=b),
+                        f"degraded to tier {frac} ({b}/{budget} tokens)")
+        return None, (
+            f"deadline-infeasible at admission: even the lowest "
+            f"degradation tier ({tiers[-1]}) finishes past the "
+            f"deadline (deadline in {max(0.0, dl - now):.3f} units, "
+            f"estimated service {t0 - now + est.decode:.3f}+)")
+
+    def commit(self, rid: str, budget: Optional[int] = None):
+        """The engine ADMITTED ``rid``: leave the queue and charge the
+        tenant's fair-queue tag by served-work/weight. ``budget`` is
+        the budget that actually ran (the degradation-clamped value
+        when a tier fired) — a degraded tenant is charged for the
+        short answer it got, not the long one it asked for.
+        Uncommitted selections stay queued for the next turn,
+        uncharged."""
+        e = self._q.pop(rid)
+        t = self._tenant(e.req)
+        b = budget if budget is not None else e.req.max_new_tokens
+        cost = (len(e.req.prompt) + b) / self._weight(t)
+        self._tags[t] = self._tags.get(t, 0.0) + cost
+
+    def shed_expired(self, now: float) -> List[Tuple[Request, str]]:
+        """Drop queued requests whose deadline already passed (they
+        could only be timed out later for more cost)."""
+        out = []
+        for rid in list(self._q):
+            dl = self._q[rid].req.deadline_time()
+            if dl is not None and now > dl + 1e-9:
+                e = self._q.pop(rid)
+                out.append((e.req, "deadline passed while queued"))
+        return out

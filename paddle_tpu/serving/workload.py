@@ -28,6 +28,14 @@ class Request:
     with the same token prefix, the prefix-cache case.
     ``cancel_after`` models churn — the client disconnects after that
     many generated tokens and the engine must evict mid-stream.
+
+    QoS fields (read by ``scheduler.QoSScheduler``; the default FIFO
+    engine ignores them, so PR-2 traces replay unchanged):
+    ``tenant`` names the traffic source for weighted fair queueing;
+    ``priority`` is a strict class (higher preempts lower at admission,
+    never mid-flight); ``deadline_ms`` is the end-to-end SLO relative
+    to arrival, in milliseconds of clock time (1 clock unit = 1000 ms,
+    so a fixed-cost replay can reason about deadlines too).
     """
 
     rid: str
@@ -36,6 +44,9 @@ class Request:
     max_new_tokens: int
     prefix_group: Optional[int] = None
     cancel_after: Optional[int] = None
+    tenant: Optional[str] = None
+    priority: int = 0
+    deadline_ms: Optional[float] = None
 
     def to_json(self) -> dict:
         d = {"rid": self.rid, "arrival": self.arrival,
@@ -45,6 +56,12 @@ class Request:
             d["prefix_group"] = self.prefix_group
         if self.cancel_after is not None:
             d["cancel_after"] = self.cancel_after
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
+        if self.priority:
+            d["priority"] = self.priority
+        if self.deadline_ms is not None:
+            d["deadline_ms"] = self.deadline_ms
         return d
 
     @staticmethod
@@ -53,7 +70,16 @@ class Request:
                        prompt=tuple(int(t) for t in d["prompt"]),
                        max_new_tokens=int(d["max_new_tokens"]),
                        prefix_group=d.get("prefix_group"),
-                       cancel_after=d.get("cancel_after"))
+                       cancel_after=d.get("cancel_after"),
+                       tenant=d.get("tenant"),
+                       priority=int(d.get("priority", 0)),
+                       deadline_ms=d.get("deadline_ms"))
+
+    def deadline_time(self) -> Optional[float]:
+        """Absolute deadline in clock units (None when unbounded)."""
+        if self.deadline_ms is None:
+            return None
+        return self.arrival + self.deadline_ms / 1000.0
 
 
 def synthesize_trace(seed: int = 0, n_requests: int = 24, *,
@@ -141,6 +167,111 @@ def synthesize_trace(seed: int = 0, n_requests: int = 24, *,
     return reqs
 
 
+DEFAULT_TENANTS = {
+    # the three-tenant overload cast: an interactive tenant with tight
+    # deadlines and a priority class above the rest, a standard tenant
+    # with mixed deadlines, and one AGGRESSIVE bulk tenant that issues
+    # bursts at twice everyone's share with loose deadlines — the
+    # tenant fair queueing exists to contain.
+    "intl": {"share": 0.30, "priority": 1, "burst": 1,
+             "deadline": "tight"},
+    "std": {"share": 0.30, "priority": 0, "burst": 1,
+            "deadline": "mix"},
+    "bulk": {"share": 0.40, "priority": 0, "burst": 4,
+             "deadline": "loose"},
+}
+
+
+def synthesize_overload_trace(seed: int = 0, n_requests: int = 48, *,
+                              service_tokens_per_unit: float = 4.0,
+                              overload: float = 2.0,
+                              tenants: Optional[dict] = None,
+                              prompt_len: Tuple[int, int] = (4, 12),
+                              output_len: Tuple[int, int] = (4, 12),
+                              vocab_size: int = 128,
+                              unit_ms: float = 1000.0,
+                              tight_slack: float = 2.5,
+                              loose_slack: float = 10.0,
+                              rid_prefix: str = "q",
+                              start: float = 0.0) -> List[Request]:
+    """A seeded multi-tenant OVERLOAD trace: total demanded decode
+    tokens arrive at ``overload`` x the engine's service rate, so a
+    FIFO queue must grow without bound and only a scheduler that sheds
+    or reorders can protect anyone's SLO.
+
+    ``service_tokens_per_unit`` is the engine's decode capacity in
+    tokens per clock unit (``slots * decode_chunk / decode_cost`` for a
+    fixed-cost clock); arrival times are scaled so the trace's total
+    output budget divided by its span equals ``overload`` x that rate.
+
+    ``tenants`` maps name -> {share, priority, burst, deadline} (see
+    ``DEFAULT_TENANTS``). ``burst > 1`` makes that tenant aggressive:
+    its requests land in simultaneous bursts of that size. ``deadline``
+    is "tight" / "loose" / "mix"; per-request ``deadline_ms`` is
+    ``(1 + budget) * unit_ms * slack`` — the ideal lone-request service
+    time (one prefill unit + one decode unit per token) times the
+    cohort's slack. rids end in ".tight" / ".loose" so benches can
+    split cohorts without a side channel.
+
+    Deterministic in every field: same (seed, knobs) -> same trace.
+    """
+    spec = tenants if tenants is not None else DEFAULT_TENANTS
+    if not spec:
+        raise ValueError("need at least one tenant")
+    rng = np.random.default_rng(seed)
+    names = sorted(spec)
+    # integer request counts per tenant, largest-share tenants absorb
+    # the rounding remainder (deterministic)
+    shares = np.asarray([float(spec[n].get("share", 1.0))
+                         for n in names])
+    shares = shares / shares.sum()
+    counts = np.floor(shares * n_requests).astype(int)
+    order = np.argsort(-shares)
+    k = 0
+    while counts.sum() < n_requests:
+        counts[order[k % len(names)]] += 1
+        k += 1
+
+    # draw budgets first so the span can be sized to the demanded work
+    budgets = {n: [int(rng.integers(output_len[0], output_len[1] + 1))
+                   for _ in range(counts[i])]
+               for i, n in enumerate(names)}
+    total_tokens = sum(sum(b) for b in budgets.values())
+    span = total_tokens / (overload * service_tokens_per_unit)
+
+    reqs: List[Request] = []
+    for i, name in enumerate(names):
+        cfg = spec[name]
+        n_t = int(counts[i])
+        if n_t == 0:
+            continue
+        burst = max(1, int(cfg.get("burst", 1)))
+        # a Poisson process conditioned on N arrivals in [0, span] IS
+        # N sorted uniforms; bursty tenants share one draw per burst
+        n_bursts = -(-n_t // burst)
+        burst_times = np.sort(rng.uniform(0.0, span, n_bursts))
+        times = np.repeat(burst_times, burst)[:n_t]
+        mode = cfg.get("deadline", "mix")
+        for j in range(n_t):
+            plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+            prompt = tuple(int(t) for t in rng.integers(
+                1, vocab_size, plen))
+            budget = budgets[name][j]
+            tight = {"tight": True, "loose": False}.get(
+                mode, None)
+            if tight is None:
+                tight = bool(rng.random() < 0.5)
+            slack = tight_slack if tight else loose_slack
+            cohort = "tight" if tight else "loose"
+            reqs.append(Request(
+                rid=f"{rid_prefix}-{name}{j}.{cohort}",
+                arrival=start + float(times[j]), prompt=prompt,
+                max_new_tokens=budget, tenant=name,
+                priority=int(cfg.get("priority", 0)),
+                deadline_ms=round((1 + budget) * unit_ms * slack, 3)))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def merge_traces(*traces: Sequence[Request]) -> List[Request]:
     """Interleave traces by arrival time (rids must already be unique —
     give each source a distinct ``rid_prefix``)."""
@@ -175,7 +306,7 @@ def trace_stats(trace: Sequence[Request]) -> dict:
     plens = np.asarray([len(r.prompt) for r in trace])
     budgets = np.asarray([r.max_new_tokens for r in trace])
     arr = np.asarray([r.arrival for r in trace])
-    return {
+    out = {
         "n_requests": len(trace),
         "prompt_len_min": int(plens.min()),
         "prompt_len_max": int(plens.max()),
@@ -187,3 +318,10 @@ def trace_stats(trace: Sequence[Request]) -> dict:
         "churn_requests": sum(
             1 for r in trace if r.cancel_after is not None),
     }
+    tenants = sorted({r.tenant for r in trace if r.tenant is not None})
+    if tenants:
+        out["tenants"] = tenants
+    n_deadline = sum(1 for r in trace if r.deadline_ms is not None)
+    if n_deadline:
+        out["deadline_requests"] = n_deadline
+    return out

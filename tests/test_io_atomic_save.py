@@ -1,0 +1,65 @@
+"""framework/io.py atomic save: a failed write must never clobber the
+previous checkpoint (tmp-file + os.replace discipline, matching
+incubate/checkpoint/auto_checkpoint.py's tmp->mv)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class _Unpicklable:
+    def __reduce__(self):
+        raise RuntimeError("simulated mid-write failure")
+
+
+def test_failed_save_preserves_old_checkpoint(tmp_path):
+    """A crash while pickling the NEW state leaves the OLD file intact
+    and byte-valid — no truncated file where a checkpoint used to be,
+    and no tmp litter in the directory."""
+    path = str(tmp_path / "model.pdparams")
+    old = {"w": paddle.to_tensor(np.arange(6.0).reshape(2, 3)),
+           "step": 7}
+    paddle.save(old, path)
+    before = open(path, "rb").read()
+
+    bad = {"w": paddle.to_tensor(np.zeros((4, 4))),
+           "boom": _Unpicklable()}
+    with pytest.raises(RuntimeError, match="simulated"):
+        paddle.save(bad, path)
+
+    assert open(path, "rb").read() == before  # old bytes survive
+    loaded = paddle.load(path)
+    np.testing.assert_array_equal(loaded["w"].numpy(),
+                                  old["w"].numpy())
+    assert loaded["step"] == 7
+    assert os.listdir(tmp_path) == ["model.pdparams"]  # no tmp litter
+
+
+def test_failed_first_save_leaves_no_file(tmp_path):
+    """When there was no previous checkpoint, a failed save leaves
+    NOTHING — a partial first write must not masquerade as a file."""
+    path = str(tmp_path / "fresh.pdparams")
+    with pytest.raises(RuntimeError, match="simulated"):
+        paddle.save({"boom": _Unpicklable()}, path)
+    assert os.listdir(tmp_path) == []
+
+
+def test_save_still_round_trips(tmp_path):
+    """The happy path through the tmp+replace discipline is unchanged:
+    nested state dicts round-trip, and the on-disk file is one valid
+    pickle (no tmp suffix leaked into the final name)."""
+    path = str(tmp_path / "nested" / "opt.pdopt")  # dir auto-created
+    state = {"lr": 0.1,
+             "moments": [paddle.to_tensor(np.ones((3,)))],
+             "name": "adam"}
+    paddle.save(state, path)
+    assert sorted(os.listdir(tmp_path / "nested")) == ["opt.pdopt"]
+    with open(path, "rb") as f:
+        pickle.load(f)  # one complete pickle stream
+    back = paddle.load(path)
+    assert back["lr"] == 0.1 and back["name"] == "adam"
+    np.testing.assert_array_equal(back["moments"][0].numpy(),
+                                  np.ones((3,)))

@@ -1,6 +1,8 @@
 """Vision model zoo completion: MobileNetV3, GoogLeNet, InceptionV3,
 ResNeXt/wide/densenet/shufflenet/squeezenet variants (the reference's 13
 model families, python/paddle/vision/models/)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -57,6 +59,11 @@ def test_densenet_variants_exist():
     assert net(paddle.randn([1, 3, 64, 64])).shape == [1, 10]
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference"),
+    reason="environment-only audit: needs the reference Paddle "
+           "checkout at /root/reference, which this image does not "
+           "carry (auto-revives on images that do)")
 def test_zoo_covers_reference_all():
     import ast
     from pathlib import Path

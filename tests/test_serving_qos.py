@@ -1,0 +1,651 @@
+"""paddle_tpu.serving.scheduler: the QoS front door — SLO-aware
+admission, per-tenant weighted fair queueing, overload shedding,
+graceful degradation, deadline timeouts — plus the engine integration
+(deterministic fixed-clock replays), the overload acceptance claim
+(qos goodput >= 1.15x fifo with tight-cohort SLO >= 0.9) and the
+bench-gate contract for the serving_qos rows."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (MetricsCollector, QoSScheduler, Request,
+                                ServiceEstimator, ServingEngine,
+                                synthesize_overload_trace, trace_stats)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# scheduler unit tests (no model, no engine)
+# ---------------------------------------------------------------------------
+
+def _req(rid, arrival=0.0, prompt=(1, 2, 3, 4), budget=8, **kw):
+    return Request(rid=rid, arrival=arrival, prompt=tuple(prompt),
+                   max_new_tokens=budget, **kw)
+
+
+def _drain(sched, now=0.0, max_batch=1, est=None, chunk=1, n=100):
+    """Serve the queue one admission at a time, committing each pick —
+    the order a slots=1 engine would run."""
+    est = est or ServiceEstimator()
+    order = []
+    for _ in range(n):
+        if not sched.waiting():
+            break
+        dec = sched.select(now, max_batch=max_batch, est=est,
+                           decode_chunk=chunk)
+        assert dec.wave, (dec.shed, sched.queued_rids())
+        r = dec.wave[0]
+        sched.commit(r.rid)
+        order.append(r.rid)
+    return order
+
+
+def test_wfq_weighted_service_order():
+    """Two tenants, weight 2:1, equal-cost requests: the served stream
+    interleaves ~2 A's per B instead of draining A first."""
+    s = QoSScheduler(tenant_weights={"A": 2.0, "B": 1.0})
+    for i in range(6):
+        s.enqueue(_req(f"a{i}", tenant="A"), 0.0)
+        s.enqueue(_req(f"b{i}", tenant="B"), 0.0)
+    order = _drain(s)
+    # after any prefix, A's served count stays ~2x B's (within one)
+    a = b = 0
+    for rid in order[:9]:
+        a += rid.startswith("a")
+        b += rid.startswith("b")
+        assert a <= 2 * (b + 1) and b <= a // 2 + 1, order
+    assert set(order) == {f"{t}{i}" for t in "ab" for i in range(6)}
+
+
+def test_wfq_contains_aggressive_tenant():
+    """A tenant flooding 3x the requests at equal weight still gets
+    only ~half the early service — fair queueing, not FIFO."""
+    s = QoSScheduler()
+    for i in range(9):
+        s.enqueue(_req(f"flood{i}", tenant="F"), 0.0)
+    for i in range(3):
+        s.enqueue(_req(f"meek{i}", tenant="M"), 0.0)
+    first6 = _drain(s)[:6]
+    assert sum(r.startswith("meek") for r in first6) == 3, first6
+
+
+def test_strict_priority_above_wfq():
+    """Priority classes trump tenant tags: every p1 request serves
+    before any p0, regardless of tenant debt."""
+    s = QoSScheduler(tenant_weights={"A": 100.0, "B": 1.0})
+    for i in range(3):
+        s.enqueue(_req(f"lo{i}", tenant="A", priority=0), 0.0)
+        s.enqueue(_req(f"hi{i}", tenant="B", priority=1), 0.0)
+    order = _drain(s)
+    assert order[:3] == ["hi0", "hi1", "hi2"], order
+
+
+def test_aging_prevents_priority_starvation():
+    """With aging, a p0 request waiting long enough joins the p1 class
+    and gets served ahead of fresher p1 traffic."""
+    s = QoSScheduler(aging=10.0)
+    s.enqueue(_req("old_lo", arrival=0.0, priority=0), 0.0)
+    s.enqueue(_req("fresh_hi", arrival=29.0, priority=1), 29.0)
+    dec = s.select(30.0, max_batch=1, est=ServiceEstimator())
+    # old_lo aged +3 classes (30/10) > fresh_hi's static 1
+    assert [r.rid for r in dec.wave] == ["old_lo"]
+
+
+def test_deadline_infeasible_shed_at_admission():
+    """A request whose deadline cannot be met even at the lowest
+    degradation tier is shed at selection, never admitted."""
+    s = QoSScheduler()
+    # deadline 3 units out; even 2 tokens (tier 0.25 of 8) need
+    # 1 prefill + 2 decode = 3 > 3 - already-elapsed margin... use 2.
+    s.enqueue(_req("doomed", arrival=0.0, budget=8,
+                   deadline_ms=2000.0), 0.0)
+    s.enqueue(_req("fine", arrival=0.0, budget=4), 0.0)
+    dec = s.select(0.0, max_batch=4, est=ServiceEstimator(),
+                   decode_chunk=1)
+    assert [r.rid for r in dec.wave] == ["fine"]
+    assert len(dec.shed) == 1
+    r, reason = dec.shed[0]
+    assert r.rid == "doomed" and "infeasible" in reason
+    assert s.waiting() == 1  # only "fine" remains queued
+
+
+def test_degradation_tier_clamps_budget_before_shedding():
+    """A deadline that fits half the budget admits the request CLAMPED
+    (graceful degradation), not shed."""
+    s = QoSScheduler(headroom=1.0)
+    # budget 8: full needs 1 + 8 = 9 units; deadline 6 fits tier 0.5
+    # (1 + 4 = 5 <= 6) but not 0.75 (1 + 6 = 7 > 6)
+    s.enqueue(_req("clamp", arrival=0.0, budget=8,
+                   deadline_ms=6000.0), 0.0)
+    dec = s.select(0.0, max_batch=1, est=ServiceEstimator())
+    assert len(dec.wave) == 1 and not dec.shed
+    assert dec.wave[0].max_new_tokens == 4
+    assert dec.degraded["clamp"] == (4, 8)
+
+
+def test_custom_tiers_never_clamp_a_feasible_request():
+    """degrade_tiers without 1.0 are FALLBACKS: a request whose full
+    budget fits its deadline is admitted unclamped."""
+    s = QoSScheduler(headroom=1.0, degrade_tiers=(0.75, 0.5))
+    s.enqueue(_req("roomy", arrival=0.0, budget=10,
+                   deadline_ms=100000.0), 0.0)
+    dec = s.select(0.0, max_batch=1, est=ServiceEstimator())
+    assert dec.wave[0].max_new_tokens == 10 and not dec.degraded
+    # and the fallback still fires when full budget does NOT fit:
+    # 1 + 10 = 11 > 9, but tier 0.75 -> 8 tokens, 1 + 8 = 9 <= 9
+    s.enqueue(_req("squeezed", arrival=0.0, budget=10,
+                   deadline_ms=9000.0), 0.0)
+    s.commit("roomy")
+    dec = s.select(0.0, max_batch=1, est=ServiceEstimator())
+    assert dec.wave[0].max_new_tokens == 8
+    assert dec.degraded["squeezed"] == (8, 10)
+
+
+def test_commit_charges_the_degraded_budget():
+    """A tenant served a clamped answer is charged for the clamp, not
+    the original ask — otherwise degradation would also tax its
+    future admission turns."""
+    s = QoSScheduler()
+    s.enqueue(_req("d", prompt=(1, 2, 3, 4), budget=8, tenant="T"),
+              0.0)
+    s.commit("d", budget=2)  # degraded 8 -> 2
+    assert s._tags["T"] == pytest.approx((4 + 2) / 1.0)
+
+
+def test_queue_bound_sheds_lowest_value_first():
+    """Bounded queue: the victim is the lowest priority class, and
+    within it the request least likely to meet its deadline."""
+    s = QoSScheduler(max_queue=2)
+    assert s.enqueue(_req("hi", priority=1), 0.0) == []
+    assert s.enqueue(_req("lo_slack", priority=0,
+                          deadline_ms=50000.0), 0.0) == []
+    shed = s.enqueue(_req("lo_tight", priority=0, deadline_ms=5000.0),
+                     0.0)
+    assert len(shed) == 1
+    assert shed[0][0].rid == "lo_tight"  # least slack among p0
+    assert "queue bound" in shed[0][1]
+    assert sorted(s.queued_rids()) == ["hi", "lo_slack"]
+
+
+def test_shed_expired_drops_posthumous_requests():
+    s = QoSScheduler()
+    s.enqueue(_req("late", arrival=0.0, deadline_ms=1000.0), 0.0)
+    s.enqueue(_req("alive", arrival=0.0, deadline_ms=100000.0), 0.0)
+    out = s.shed_expired(5.0)
+    assert [r.rid for r, _ in out] == ["late"]
+    assert s.queued_rids() == ["alive"]
+
+
+def test_scheduler_config_validation():
+    with pytest.raises(ValueError, match="weight"):
+        QoSScheduler(tenant_weights={"A": 0.0})
+    with pytest.raises(ValueError, match="max_queue"):
+        QoSScheduler(max_queue=0)
+    with pytest.raises(ValueError, match="tiers"):
+        QoSScheduler(degrade_tiers=(1.5,))
+    with pytest.raises(ValueError, match="headroom"):
+        QoSScheduler(headroom=0.5)
+    with pytest.raises(ValueError, match="aging"):
+        QoSScheduler(aging=0.0)
+
+
+def test_estimator_ewma_tracks_observations():
+    e = ServiceEstimator(prefill=1.0, decode=1.0, alpha=0.5)
+    e.observe("decode", 3.0)
+    assert e.decode == pytest.approx(2.0)
+    e.observe("decode", 2.0)
+    assert e.decode == pytest.approx(2.0)
+    e.observe("prefill", -1.0)  # non-positive ignored
+    assert e.prefill == 1.0
+    with pytest.raises(ValueError, match="positive"):
+        ServiceEstimator(prefill=0.0)
+
+
+# ---------------------------------------------------------------------------
+# QoS fields: JSONL round trip + trace generator
+# ---------------------------------------------------------------------------
+
+def test_request_qos_json_round_trip():
+    r = Request(rid="x", arrival=1.5, prompt=(1, 2), max_new_tokens=4,
+                tenant="gold", priority=2, deadline_ms=1500.0)
+    assert Request.from_json(json.loads(json.dumps(r.to_json()))) == r
+    # pre-QoS JSON (no new keys) loads with defaults — old traces
+    # stay readable
+    legacy = {"rid": "y", "arrival": 0.0, "prompt": [7],
+              "max_new_tokens": 1}
+    r2 = Request.from_json(legacy)
+    assert (r2.tenant, r2.priority, r2.deadline_ms) == (None, 0, None)
+    assert "tenant" not in r2.to_json()  # defaults stay off the wire
+    assert r.deadline_time() == pytest.approx(3.0)
+    assert r2.deadline_time() is None
+
+
+def test_overload_trace_shape():
+    """The generator delivers what it promises: 2x-capacity demand,
+    one bursty aggressive tenant, tight/loose cohorts, determinism."""
+    kw = dict(seed=3, n_requests=40, service_tokens_per_unit=4.0,
+              overload=2.0, vocab_size=97)
+    tr = synthesize_overload_trace(**kw)
+    assert tr == synthesize_overload_trace(**kw)
+    assert tr != synthesize_overload_trace(**{**kw, "seed": 4})
+    assert len(tr) == 40
+    arr = [r.arrival for r in tr]
+    assert arr == sorted(arr)
+    # demanded tokens / span == overload * service rate
+    total = sum(r.max_new_tokens for r in tr)
+    span = max(arr) - 0.0
+    assert total / span == pytest.approx(8.0, rel=0.15)
+    # every request carries a tenant, a priority and a deadline
+    assert all(r.tenant in ("intl", "std", "bulk") for r in tr)
+    assert all(r.deadline_ms is not None for r in tr)
+    assert {r.priority for r in tr} == {0, 1}
+    assert all(r.priority == 1 for r in tr if r.tenant == "intl")
+    # the aggressive tenant arrives in simultaneous bursts of 4
+    bulk_times = {}
+    for r in tr:
+        if r.tenant == "bulk":
+            bulk_times.setdefault(r.arrival, 0)
+            bulk_times[r.arrival] += 1
+    assert max(bulk_times.values()) == 4
+    # cohorts are named in the rid and consistent with the slack
+    tight = [r for r in tr if r.rid.endswith(".tight")]
+    loose = [r for r in tr if r.rid.endswith(".loose")]
+    assert len(tight) + len(loose) == 40 and tight and loose
+    for r in tight:
+        assert r.deadline_ms == pytest.approx(
+            (1 + r.max_new_tokens) * 1000.0 * 2.5)
+    st = trace_stats(tr)
+    assert st["tenants"] == ["bulk", "intl", "std"]
+    assert st["deadline_requests"] == 40
+    with pytest.raises(ValueError, match="tenant"):
+        synthesize_overload_trace(tenants={})
+
+
+# ---------------------------------------------------------------------------
+# metrics: the QoS block
+# ---------------------------------------------------------------------------
+
+def test_metrics_qos_arithmetic():
+    """Hand-built event stream -> exact shed/goodput/fairness numbers,
+    and the invariant the gate checks: a shed request is never a hit."""
+    m = MetricsCollector()
+    # a: tenant A, met its 3s deadline, 3 tokens
+    m.on_arrival("a", 0.0, tenant="A", deadline_ms=3000.0)
+    m.on_admit("a", 0.5, "paged")
+    m.on_tokens("a", 1.0, 3)
+    m.on_finish("a", 2.0)
+    # b: tenant B, missed its deadline, 4 tokens (no goodput)
+    m.on_arrival("b", 0.0, tenant="B", deadline_ms=1000.0)
+    m.on_admit("b", 0.5, "paged")
+    m.on_tokens("b", 4.0, 4)
+    m.on_finish("b", 5.0)
+    # c: tenant B, shed — never admitted, never finished
+    m.on_arrival("c", 1.0, tenant="B", priority=0, deadline_ms=500.0)
+    m.on_shed("c", 1.0, "deadline-infeasible")
+    # d: tenant A, timed out mid-decode (evicted), 2 tokens
+    m.on_arrival("d", 0.0, tenant="A", deadline_ms=2000.0)
+    m.on_admit("d", 0.5, "paged")
+    m.on_tokens("d", 1.5, 2)
+    m.on_finish("d", 4.0, evicted=True, reason="timeout")
+
+    va = m.request("a")
+    assert va["deadline_met"] is True and va["tenant"] == "A"
+    assert m.request("b")["deadline_met"] is False
+    vc = m.request("c")
+    assert vc["shed"] and vc["deadline_met"] is False
+    assert vc["finish"] is None and vc["finish_reason"] == "shed"
+    vd = m.request("d")
+    assert vd["deadline_met"] is False
+    assert vd["evicted"] and vd["finish_reason"] == "timeout"
+
+    rep = m.report(tenant_weights={"A": 1.0, "B": 1.0})
+    assert rep["arrived"] == 4
+    assert rep["completed"] == 3          # c shed, never completed
+    assert rep["shed"] == 1 and rep["shed_rate"] == 0.25
+    assert rep["deadline_requests"] == 3  # finished with deadlines
+    assert rep["deadline_hits"] == 1      # only a
+    assert rep["deadline_hits"] <= rep["completed"]
+    assert rep["shed"] + rep["completed"] == rep["arrived"]
+    assert rep["slo_deadline_attained"] == pytest.approx(1 / 3, abs=1e-4)
+    assert rep["goodput_tokens"] == 3     # a only; b late, d timeout
+    assert rep["timeout_evicted"] == 1
+    # makespan 5.0 (first arrival 0 -> last finish 5)
+    assert rep["goodput_tokens_per_sec"] == pytest.approx(0.6)
+    t = rep["tenants"]
+    assert t["A"]["goodput_tokens"] == 3 and t["B"]["goodput_tokens"] == 0
+    assert t["B"]["shed"] == 1
+    # Jain over [3, 0] = 9 / (2*9) = 0.5
+    assert rep["fairness_jain"] == pytest.approx(0.5)
+
+
+def test_deadline_free_evicted_request_is_not_goodput():
+    """A canceled/timed-out stream without a deadline delivered
+    partial work, not an SLO-met answer — its tokens must not inflate
+    goodput (the metric the qos gate floors on)."""
+    m = MetricsCollector()
+    m.on_arrival("churn", 0.0, tenant="bulk")  # no deadline
+    m.on_admit("churn", 0.5, "paged")
+    m.on_tokens("churn", 1.0, 5)
+    m.on_finish("churn", 2.0, evicted=True, reason="cancel")
+    m.on_arrival("ok", 0.0, tenant="bulk")
+    m.on_admit("ok", 0.5, "paged")
+    m.on_tokens("ok", 1.0, 3)
+    m.on_finish("ok", 4.0)
+    assert m.request("churn")["deadline_met"] is False
+    assert m.request("ok")["deadline_met"] is True
+    rep = m.report()
+    assert rep["goodput_tokens"] == 3
+
+
+def test_plain_trace_report_has_no_qos_block():
+    """No tenants, no deadlines, no sheds -> the PR-2 record, byte
+    for byte (the default engine's determinism promise extends to the
+    metrics schema)."""
+    m = MetricsCollector()
+    m.on_arrival("a", 0.0)
+    m.on_admit("a", 0.5, "paged")
+    m.on_tokens("a", 1.0, 2)
+    m.on_finish("a", 2.0)
+    rep = m.report()
+    for k in ("arrived", "shed", "shed_rate", "goodput_tokens",
+              "goodput_tokens_per_sec", "fairness_jain", "tenants",
+              "degraded", "timeout_evicted"):
+        assert k not in rep, k
+
+
+# ---------------------------------------------------------------------------
+# engine integration (tiny model, fixed-cost clock)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def srv_model():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    srv = llama_serving_decode_factory(model, max_len=48, page_size=8,
+                                       n_pool_pages=25,
+                                       batch_capacity=4,
+                                       chunked_prefill=8)
+    return srv
+
+
+def _engine(srv, sched, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("policy", "paged")
+    return ServingEngine(serving=srv, slots=4, scheduler=sched, **kw)
+
+
+WEIGHTS = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+
+
+def _overload_trace(seed=0, n=40):
+    return synthesize_overload_trace(
+        seed=seed, n_requests=n, service_tokens_per_unit=4.0,
+        overload=2.0, prompt_len=(4, 12), output_len=(4, 12),
+        vocab_size=97)
+
+
+def test_engine_rejects_bogus_scheduler(srv_model):
+    with pytest.raises(ValueError, match="scheduler"):
+        ServingEngine(serving=srv_model, scheduler="lifo")
+
+
+def test_scheduler_determinism(srv_model):
+    """Same seeded trace + same scheduler config => identical
+    completion order, slot log and metrics across two runs — the
+    engine's determinism guarantee extended to the QoS path."""
+    trace = _overload_trace()
+    runs = []
+    for _ in range(2):
+        res = _engine(srv_model,
+                      QoSScheduler(tenant_weights=WEIGHTS)).run(trace)
+        order = sorted(res.outputs,
+                       key=lambda rid: (
+                           res.metrics.request(rid)["finish"], rid))
+        runs.append((order, res.slot_log, res.shed,
+                     res.report(tenant_weights=WEIGHTS)))
+    assert runs[0] == runs[1]
+    assert runs[0][2]  # overload actually shed something
+    res = _engine(srv_model,
+                  QoSScheduler(tenant_weights=WEIGHTS)).run(trace)
+    assert res.scheduler == "qos"
+    assert res.pages_free_end == res.pages_total  # sheds leak no pages
+
+
+def test_qos_goodput_beats_fifo_on_overload(srv_model):
+    """THE acceptance claim, in-tree: on the seeded 2x-overload
+    multi-tenant trace (CPU, fixed-cost virtual clock) the QoS
+    scheduler's goodput >= 1.15x FIFO's, with tight-cohort SLO
+    attainment >= 0.9 — and shed requests are never counted as SLO
+    hits."""
+    trace = _overload_trace()
+    rep_f = _engine(srv_model, None).run(trace) \
+        .report(tenant_weights=WEIGHTS)
+    res_q = _engine(srv_model,
+                    QoSScheduler(tenant_weights=WEIGHTS)).run(trace)
+    rep_q = res_q.report(tenant_weights=WEIGHTS)
+    assert rep_q["goodput_tokens_per_sec"] >= \
+        1.15 * rep_f["goodput_tokens_per_sec"], (rep_q, rep_f)
+    hits = tot = 0
+    for r in trace:
+        if not r.rid.endswith(".tight"):
+            continue
+        v = res_q.metrics.request(r.rid)
+        if v["shed"]:
+            assert v["deadline_met"] is False  # shed is never a hit
+            continue
+        tot += 1
+        hits += bool(v["deadline_met"])
+    assert tot > 0 and hits / tot >= 0.9, (hits, tot)
+    # the aggregate invariant the gate re-checks from the row
+    assert rep_q["deadline_hits"] <= rep_q["completed"]
+    assert rep_q["shed"] + rep_q["completed"] == rep_q["arrived"]
+    # fairness: WFQ must not be WORSE than FIFO for the weighted mix
+    assert rep_q["fairness_jain"] >= rep_f["fairness_jain"] - 1e-6
+
+
+def test_no_starvation_under_saturating_high_priority(srv_model):
+    """A high-priority tenant saturating capacity cannot starve the
+    low-priority tenant when aging is on: every low request still
+    completes (none shed, none starved past the run)."""
+    rng = np.random.default_rng(17)
+    trace = []
+    for i in range(12):  # p1 flood: one arrival per time unit
+        trace.append(Request(
+            rid=f"hi{i:02d}", arrival=float(i),
+            prompt=tuple(int(t) for t in rng.integers(1, 97, 6)),
+            max_new_tokens=6, tenant="vip", priority=1))
+    for i in range(3):   # p0 trickle arriving early
+        trace.append(Request(
+            rid=f"lo{i}", arrival=float(i),
+            prompt=tuple(int(t) for t in rng.integers(1, 97, 6)),
+            max_new_tokens=4, tenant="meek", priority=0))
+    trace.sort(key=lambda r: (r.arrival, r.rid))
+    res = _engine(srv_model, QoSScheduler(aging=8.0)).run(trace)
+    assert not res.shed
+    for i in range(3):
+        assert len(res.outputs[f"lo{i}"]) == 4, i
+    rep = res.report()
+    assert rep["completed"] == 15
+
+
+def test_deadline_timeout_unified_with_cancel_eviction(srv_model):
+    """A running request whose deadline passes mid-decode is evicted
+    through the cancel path: decode stops, pages free, metrics mark it
+    evicted with reason 'timeout' — and the slot serves the next
+    request."""
+    rng = np.random.default_rng(23)
+    mk = lambda rid, arrival, **kw: Request(
+        rid=rid, arrival=arrival,
+        prompt=tuple(int(t) for t in rng.integers(1, 97, 6)), **kw)
+    # the honest trigger: admission says feasible when squeeze arrives
+    # ALONE (headroom=1.0: 1 prefill + 10 decode ~ 11 <= 11.9), but
+    # three later riders' prefills each steal a turn from squeeze's
+    # decode stream as the second slot churns, so token 10 would land
+    # past the deadline. The engine must evict at the first chunk past
+    # 11.9 with 9 tokens, not burn the last chunk on a request
+    # already lost.
+    trace = [
+        mk("squeeze", 0.0, max_new_tokens=10, deadline_ms=11900.0),
+        mk("late0", 0.5, max_new_tokens=3),
+        mk("late1", 0.5, max_new_tokens=3),
+        mk("late2", 0.5, max_new_tokens=3),
+    ]
+    sched = QoSScheduler(headroom=1.0, degrade_tiers=())
+    eng = ServingEngine(serving=srv_model, slots=2, scheduler=sched,
+                        clock="fixed", policy="paged")
+    res = eng.run(trace)
+    v = res.metrics.request("squeeze")
+    assert v["evicted"] and v["finish_reason"] == "timeout"
+    assert v["n_tokens"] < 10        # stopped early
+    assert v["deadline_met"] is False
+    assert res.pages_free_end == res.pages_total
+    for rid in ("late0", "late1", "late2"):
+        assert len(res.outputs[rid]) == 3, rid
+
+
+def test_dense_wave_honors_deadline_timeout(srv_model):
+    """The timeout promise holds on the DENSE backend too: a wave
+    member whose deadline passes while an earlier equal-length group
+    monopolizes the chip stops streaming at the deadline and is marked
+    evicted/timeout — dense handles it exactly like cancel_after
+    (the batch computes on, the row takes no more tokens)."""
+    rng = np.random.default_rng(41)
+    pk = lambda n: tuple(int(t) for t in rng.integers(1, 97, n))
+    trace = [
+        # group S0=6 runs first: prefill + 11 decode units
+        Request(rid="longrun", arrival=0.0, prompt=pk(6),
+                max_new_tokens=12),
+        # group S0=8 starts ~t=12 — past its 9-unit deadline, which
+        # admission (pos 1: 2 prefills + 4 decode = 6 <= 9) could not
+        # foresee because dense groups serialize
+        Request(rid="misses", arrival=0.0, prompt=pk(8),
+                max_new_tokens=4, deadline_ms=9000.0),
+    ]
+    sched = QoSScheduler(headroom=1.0)
+    eng = ServingEngine(serving=srv_model, slots=4, scheduler=sched,
+                        clock="fixed", policy="dense")
+    res = eng.run(trace)
+    v = res.metrics.request("misses")
+    assert v["evicted"] and v["finish_reason"] == "timeout"
+    assert v["n_tokens"] < 4 and v["deadline_met"] is False
+    assert len(res.outputs["longrun"]) == 12
+    # and the FIFO default on the same trace keeps PR-2 dense
+    # semantics: no timeout, full budget streams late
+    res_f = ServingEngine(serving=srv_model, slots=4, clock="fixed",
+                          policy="dense").run(trace)
+    vf = res_f.metrics.request("misses")
+    assert not vf["evicted"] and vf["n_tokens"] == 4
+
+
+def test_degraded_request_completes_within_deadline(srv_model):
+    """End to end: a lone request whose deadline fits only half its
+    budget is admitted clamped, streams the clamped count, and makes
+    its SLO."""
+    rng = np.random.default_rng(31)
+    r = Request(rid="half", arrival=0.0,
+                prompt=tuple(int(t) for t in rng.integers(1, 97, 6)),
+                max_new_tokens=12, deadline_ms=8000.0)
+    sched = QoSScheduler(headroom=1.0)
+    res = _engine(srv_model, sched).run([r])
+    v = res.metrics.request("half")
+    assert v["degraded_from"] == 12
+    assert len(res.outputs["half"]) < 12
+    assert v["deadline_met"] is True
+    assert not res.shed
+
+
+def test_fifo_default_ignores_qos_fields(srv_model):
+    """scheduler=None on a QoS trace: nothing is shed, nothing times
+    out, everything completes FIFO — but the report still scores the
+    deadlines (the baseline arm of the bench)."""
+    trace = _overload_trace(n=12)
+    res = _engine(srv_model, None).run(trace)
+    assert res.scheduler == "fifo" and not res.shed
+    rep = res.report()
+    assert rep["completed"] == 12 and rep["shed"] == 0
+    assert "slo_deadline_attained" in rep
+
+
+# ---------------------------------------------------------------------------
+# bench gate: the serving_qos family
+# ---------------------------------------------------------------------------
+
+def _run_gate(text, tmp_path):
+    env = {**os.environ,
+           "BENCH_GATE_SERVING_BASELINE": str(tmp_path / "b.json")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "serving", "-"], input=text, capture_output=True, text=True,
+        timeout=60, cwd=REPO, env=env)
+    return r.returncode, [json.loads(ln) for ln in
+                          r.stdout.strip().splitlines()]
+
+
+def _qos_row(sched, goodput, *, tight=1.0, hits=10, completed=20,
+             shed=5, arrived=25):
+    return json.dumps({
+        "bench": "serving_qos", "scheduler": sched,
+        "goodput_tokens_per_sec": goodput, "slo_tight_attained": tight,
+        "tight_requests": 10, "deadline_hits": hits,
+        "completed": completed, "shed": shed, "arrived": arrived,
+        "shed_rate": round(shed / arrived, 4), "overload": 2.0,
+        "device": "cpu"})
+
+
+def test_bench_gate_serving_qos_family(tmp_path):
+    # pass: 1.6x goodput, tight attained
+    rc, recs = _run_gate("\n".join([
+        _qos_row("fifo", 1.0), _qos_row("qos", 1.6)]) + "\n", tmp_path)
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+    assert recs[-1]["qos_vs_fifo_goodput"] == pytest.approx(1.6)
+
+    # sub-floor goodput FAILs naming the floor
+    rc, recs = _run_gate("\n".join([
+        _qos_row("fifo", 1.0), _qos_row("qos", 1.1)]) + "\n", tmp_path)
+    assert rc == 1 and "1.15" in json.dumps(recs[-1])
+
+    # tight-cohort attainment below 0.9 FAILs even with great goodput
+    rc, recs = _run_gate("\n".join([
+        _qos_row("fifo", 1.0), _qos_row("qos", 2.0, tight=0.5)]) + "\n",
+        tmp_path)
+    assert rc == 1 and "cohort" in recs[-1]["reason"]
+
+    # a shed request counted as a hit breaks the aggregates -> FAIL
+    rc, recs = _run_gate("\n".join([
+        _qos_row("fifo", 1.0),
+        _qos_row("qos", 2.0, hits=25, completed=20)]) + "\n", tmp_path)
+    assert rc == 1 and "shed accounting" in recs[-1]["reason"]
+    rc, recs = _run_gate("\n".join([
+        _qos_row("fifo", 1.0),
+        _qos_row("qos", 2.0, shed=0, completed=20, arrived=25)]) + "\n",
+        tmp_path)
+    assert rc == 1 and "shed accounting" in recs[-1]["reason"]
+
+    # missing fifo row -> graceful FAIL, a record not a traceback
+    rc, recs = _run_gate(_qos_row("qos", 2.0) + "\n", tmp_path)
+    assert rc == 1 and "fifo" in recs[-1]["reason"]
+
+    # qos family FAIL must not be masked by a passing workload family:
+    # the last line carries the combined verdict
+    wl = [json.dumps({"bench": "serving_workload", "policy": p,
+                      "tokens_per_sec": t, "device": "cpu"})
+          for p, t in (("routed", 100.0), ("paged", 90.0))]
+    rc, recs = _run_gate("\n".join(wl + [
+        _qos_row("fifo", 1.0), _qos_row("qos", 1.0)]) + "\n", tmp_path)
+    assert rc == 1
+    assert recs[-1]["combined"] is True
+    assert recs[-1]["workload_gate"] == "pass"
+    assert recs[-1]["qos_gate"] == "FAIL"
+    assert recs[-1]["gate"] == "FAIL"

@@ -23,6 +23,13 @@ cannot silently ship a slower build. Three modes:
       #    policy's tokens/sec on the mixed trace, and the policies'
       #    greedy outputs must agree; a missing routed/fixed row FAILs
       #    with a clean record (graceful, never a traceback).
+      #  - serving_qos (tools/serving_workload_bench.py --qos): under
+      #    the 2x-overload multi-tenant trace, the QoS scheduler's
+      #    goodput (tokens from SLO-met requests only) must reach
+      #    >= 1.15x the FIFO baseline's, tight-deadline-cohort SLO
+      #    attainment must hold >= 0.9, and the rows' aggregates must
+      #    prove shed requests were never counted as SLO hits
+      #    (deadline_hits <= completed, shed + completed == arrived).
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -172,28 +179,118 @@ def check_serving_workload(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+QOS_GOODPUT_FLOOR = 1.15   # qos goodput must beat fifo by >= 15%
+QOS_TIGHT_SLO_FLOOR = 0.90  # tight-deadline cohort attainment floor
+
+
+def check_serving_qos(rows: list) -> int:
+    """Gate the overload rows from serving_workload_bench.py --qos:
+    the QoS scheduler earns its keep only if goodput under 2x overload
+    beats FIFO by >= QOS_GOODPUT_FLOOR while the tight-deadline cohort
+    still attains >= QOS_TIGHT_SLO_FLOOR. Like the workload family,
+    FIFO is the baseline re-measured in the same run on the same trace
+    — no stamped file. The shed-accounting invariant is checked from
+    the aggregates: a shed request must appear in `shed`, never in
+    `deadline_hits` (hits <= completed and shed + completed ==
+    arrived would both break if sheds were counted as served)."""
+    qr = [r for r in rows if r.get("bench") == "serving_qos"]
+    by = {r.get("scheduler"): r for r in qr}
+    fifo, qos = by.get("fifo"), by.get("qos")
+    if fifo is None or qos is None:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_qos rows need BOTH a fifo "
+                                    "and a qos scheduler row (run "
+                                    "tools/serving_workload_bench.py "
+                                    "--qos)"}))
+        return 1
+    for r in (fifo, qos):
+        hits = int(r.get("deadline_hits") or 0)
+        completed = int(r.get("completed") or 0)
+        shed = int(r.get("shed") or 0)
+        arrived = int(r.get("arrived") or 0)
+        if hits > completed or shed + completed != arrived:
+            print(json.dumps({
+                "gate": "FAIL", "scheduler": r.get("scheduler"),
+                "reason": f"shed accounting broken: deadline_hits "
+                          f"{hits} / completed {completed} / shed "
+                          f"{shed} / arrived {arrived} — a shed "
+                          f"request may have been counted as an SLO "
+                          f"hit"}))
+            return 1
+    ftps = float(fifo.get("goodput_tokens_per_sec") or 0.0)
+    qtps = float(qos.get("goodput_tokens_per_sec") or 0.0)
+    if ftps <= 0 or qtps <= 0:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_qos rows carry no "
+                                    "goodput_tokens_per_sec (no "
+                                    "deadlines in the trace?)"}))
+        return 1
+    ratio = qtps / ftps
+    tight = qos.get("slo_tight_attained")
+    rec = {
+        "gate": "pass",
+        "qos_goodput_tokens_per_sec": round(qtps, 4),
+        "fifo_goodput_tokens_per_sec": round(ftps, 4),
+        "qos_vs_fifo_goodput": round(ratio, 4),
+        "goodput_floor": QOS_GOODPUT_FLOOR,
+        "slo_tight_attained": tight,
+        "tight_floor": QOS_TIGHT_SLO_FLOOR,
+        "shed_rate": qos.get("shed_rate"),
+        "overload": qos.get("overload"),
+        "device": qos.get("device", "?"),
+    }
+    if ratio < QOS_GOODPUT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"qos goodput only {ratio:.3f}x fifo under "
+                         f"overload (floor {QOS_GOODPUT_FLOOR}) — the "
+                         "scheduler is not earning its shed rate")
+    elif int(qos.get("tight_requests") or 0) > 0 and (
+            tight is None or float(tight) < QOS_TIGHT_SLO_FLOOR):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"tight-deadline cohort attained {tight} < "
+                         f"{QOS_TIGHT_SLO_FLOOR} under qos — goodput "
+                         "was bought by abandoning the interactive "
+                         "cohort")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     """Gate the serving rows: the spec-compiled vs compiled-plain row
-    (tools/spec_decode_bench.py) and/or the workload-replay rows
-    (tools/serving_workload_bench.py) — whichever families the input
-    carries; both must pass when both are present. FAILs on: no
+    (tools/spec_decode_bench.py), the workload-replay rows
+    (tools/serving_workload_bench.py) and/or the QoS overload rows
+    (tools/serving_workload_bench.py --qos) — whichever families the
+    input carries; every family present must pass. FAILs on: no
     canonical row at all, a recorded compile failure, output
-    divergence, or a >threshold regression — so the serving claims can
-    only change deliberately."""
-    workload_rc = None
+    divergence, a >threshold regression, a sub-floor qos-vs-fifo
+    goodput ratio, or broken shed accounting — so the serving claims
+    can only change deliberately."""
+    fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
-        workload_rc = check_serving_workload(rows)
+        fam_rcs["workload"] = check_serving_workload(rows)
+    if any(r.get("bench", "").startswith("serving_qos") for r in rows):
+        fam_rcs["qos"] = check_serving_qos(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:
-        if workload_rc is not None:
-            return workload_rc  # workload-only input: that gate decides
+        if len(fam_rcs) == 1:
+            return next(iter(fam_rcs.values()))  # that gate decides
+        if fam_rcs:
+            rc = max(fam_rcs.values())
+            combined = {"gate": "pass" if rc == 0 else "FAIL",
+                        "combined": True}
+            for k, v in fam_rcs.items():
+                combined[f"{k}_gate"] = "pass" if v == 0 else "FAIL"
+            print(json.dumps(combined))
+            return rc
         print(json.dumps({"gate": "FAIL",
-                          "reason": "no spec_vs_plain_compiled or "
-                                    "serving_workload row in input (run "
-                                    "tools/spec_decode_bench.py or "
-                                    "tools/serving_workload_bench.py)"}))
+                          "reason": "no spec_vs_plain_compiled, "
+                                    "serving_workload or serving_qos "
+                                    "row in input (run tools/"
+                                    "spec_decode_bench.py or tools/"
+                                    "serving_workload_bench.py "
+                                    "[--qos])"}))
         return 1
     errors = [r for r in summary if "error" in r]
     ok = [r for r in summary if "ratio" in r]
@@ -239,17 +336,17 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
                              f"- {THRESHOLD:.0%}")
     print(json.dumps(rec))
     spec_rc = 0 if rec["gate"] == "pass" else 1
-    rc = max(spec_rc, workload_rc or 0)
-    if workload_rc is not None:
-        # both families ran: the LAST record must carry the combined
+    rc = max([spec_rc, *fam_rcs.values()])
+    if fam_rcs:
+        # several families ran: the LAST record must carry the combined
         # verdict — consumers read the final JSON line, and a passing
-        # spec record must not mask a failed workload gate there
-        print(json.dumps({"gate": "pass" if rc == 0 else "FAIL",
-                          "combined": True,
-                          "spec_gate": "pass" if spec_rc == 0
-                          else "FAIL",
-                          "workload_gate": "pass" if workload_rc == 0
-                          else "FAIL"}))
+        # spec record must not mask a failed workload/qos gate there
+        combined = {"gate": "pass" if rc == 0 else "FAIL",
+                    "combined": True,
+                    "spec_gate": "pass" if spec_rc == 0 else "FAIL"}
+        for k, v in fam_rcs.items():
+            combined[f"{k}_gate"] = "pass" if v == 0 else "FAIL"
+        print(json.dumps(combined))
     # stamp only when the COMBINED gate passes: a failing workload
     # family must not mutate the spec baseline on its way out (a rerun
     # would then compare against the freshly stamped row)

@@ -57,6 +57,13 @@ QUEUE = [
     # row against the best fixed policy
     ("serving_workload",
      [sys.executable, "tools/serving_workload_bench.py"], {}),
+    # PR-3 addition: the QoS overload arm — fifo vs QoSScheduler on
+    # the seeded 2x-overload multi-tenant trace (fixed-cost clock, so
+    # the chip run validates the real-model admission path while the
+    # scheduling verdict stays deterministic); bench_gate.py serving
+    # gates qos goodput >= 1.15x fifo with tight-cohort SLO >= 0.9
+    ("serving_qos",
+     [sys.executable, "tools/serving_workload_bench.py", "--qos"], {}),
     # ONE bench run per window, wrapped by the regression gate (round-4
     # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
     # gate snapshots the baseline, runs bench.py, fails on >5% legacy-

@@ -15,9 +15,21 @@ Each policy replays the trace TWICE: the first pass compiles every
 program shape (dense groups compile per (B, S0)), the second is the
 measured one — serving latency, not compile latency.
 
+The QoS arm (``--qos``) replays ONE seeded multi-tenant OVERLOAD trace
+(2x engine capacity, one aggressive bursty tenant, tight-vs-loose
+deadline cohorts) under a fixed-cost clock twice: once FIFO
+(scheduler=None, the PR-2 front door) and once through the
+`QoSScheduler` (priority + weighted fair queueing + deadline-
+feasibility admission + shedding/degradation). It emits one
+`serving_qos` row per scheduler — goodput (tokens from SLO-met
+requests only), shed rate, deadline attainment, tight-cohort
+attainment, Jain fairness — and `bench_gate.py serving` gates
+qos goodput >= 1.15x fifo with tight-cohort attainment >= 0.9.
+
 Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --cpu --save-trace t.jsonl
       python tools/serving_workload_bench.py --trace t.jsonl
+      python tools/serving_workload_bench.py --cpu --qos
 """
 from __future__ import annotations
 
@@ -50,6 +62,13 @@ def main(argv=None):
     ap.add_argument("--decode-chunk", type=int, default=1)
     ap.add_argument("--slo-ttft", type=float, default=None)
     ap.add_argument("--slo-tpot", type=float, default=None)
+    ap.add_argument("--qos", action="store_true",
+                    help="run the QoS arm instead: fifo vs qos "
+                         "scheduler on a multi-tenant overload trace "
+                         "(fixed-cost clock)")
+    ap.add_argument("--overload", type=float, default=2.0,
+                    help="QoS arm: demanded-tokens / engine-capacity "
+                         "ratio")
     args = ap.parse_args(argv)
 
     import os
@@ -93,6 +112,77 @@ def main(argv=None):
     model.eval()
     if on_tpu:
         model.to(dtype="bfloat16")
+
+    if args.qos:
+        from paddle_tpu.serving import (QoSScheduler,
+                                        synthesize_overload_trace)
+        srv = llama_serving_decode_factory(
+            model, max_len=max_len, page_size=page_size,
+            n_pool_pages=slots * (max_len // page_size) + 1,
+            batch_capacity=slots, chunked_prefill=page_size)
+        device = str(jax.devices()[0])
+        # the overload trace: demanded decode tokens arrive at
+        # `overload` x the engine's fixed-clock capacity
+        # (slots * decode_chunk tokens per decode unit)
+        trace = synthesize_overload_trace(
+            seed=args.seed, n_requests=args.requests or 40,
+            service_tokens_per_unit=float(slots * args.decode_chunk),
+            overload=args.overload,
+            prompt_len=(4, min(12, prompt_rng[1])),
+            output_len=(4, 12), vocab_size=cfg.vocab_size)
+        if args.save_trace:
+            save_trace(args.save_trace, trace)
+        stats = trace_stats(trace)
+        weights = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+        tight = [r.rid for r in trace if r.rid.endswith(".tight")]
+        rows = {}
+        for name, sched in (("fifo", None),
+                            ("qos", QoSScheduler(
+                                tenant_weights=weights))):
+            # fixed clock: the QoS claim is about SCHEDULING under a
+            # deterministic cost model, not wall speed — the same
+            # seeded trace replays bit-identically on any machine
+            eng = ServingEngine(serving=srv, slots=slots,
+                                policy="paged",
+                                decode_chunk=args.decode_chunk,
+                                clock="fixed", scheduler=sched)
+            res = eng.run(trace)
+            rec = res.metrics.to_record(
+                policy="paged", tenant_weights=weights, device=device,
+                seed=args.seed, slots=slots,
+                decode_chunk=args.decode_chunk,
+                overload=args.overload, trace=stats)
+            rec["bench"] = "serving_qos"
+            rec["scheduler"] = name
+            hits = n = 0
+            for rid in tight:
+                v = res.metrics.request(rid)
+                if v["shed"]:
+                    continue  # a shed request is NEVER an SLO hit
+                n += 1
+                hits += bool(v["deadline_met"])
+            rec["tight_requests"] = len(tight)
+            rec["tight_completed"] = n
+            rec["slo_tight_attained"] = round(hits / n, 4) if n \
+                else None
+            rows[name] = rec
+            print(json.dumps(rec), flush=True)
+        f, q = rows["fifo"], rows["qos"]
+        ftps = f.get("goodput_tokens_per_sec") or 0.0
+        qtps = q.get("goodput_tokens_per_sec") or 0.0
+        print(json.dumps({
+            "bench": "serving_qos_summary", "device": device,
+            "overload": args.overload,
+            "fifo_goodput_tokens_per_sec": ftps,
+            "qos_goodput_tokens_per_sec": qtps,
+            "qos_vs_fifo_goodput": round(qtps / ftps, 4) if ftps
+            else None,
+            "qos_slo_tight_attained": q.get("slo_tight_attained"),
+            "qos_shed_rate": q.get("shed_rate"),
+            "fifo_fairness_jain": f.get("fairness_jain"),
+            "qos_fairness_jain": q.get("fairness_jain"),
+        }), flush=True)
+        return 0
 
     if args.trace:
         trace = load_trace(args.trace)
