@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -26,6 +27,8 @@ import numpy as np
 from ..autograd import tape as _tape
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer.layers import Layer
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 
 
 def _unwrap_tree(tree):
@@ -64,7 +67,44 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._jitted = None
-        self._cache_info = {"hits": 0, "misses": 0}
+        # the program-cache ledger behind cache_stats(); _cache_info is
+        # the legacy (pre-obs) alias and stays the SAME dict
+        self._cache_info = {"hits": 0, "misses": 0, "compiles": 0,
+                            "last_compile_s": None}
+        self._seen_sigs: set = set()
+        # counters prefetched once — the per-call path must not pay
+        # registry lookups (same discipline as the serving engine)
+        self._ctr_hit = _obs_metrics.counter(
+            "jit_cache_hits_total", "to_static program-cache hits")
+        self._ctr_miss = _obs_metrics.counter(
+            "jit_cache_misses_total", "to_static program-cache misses")
+        self._hist_compile = _obs_metrics.histogram(
+            "jit_compile_seconds", "wall seconds per to_static compile")
+
+    @staticmethod
+    def _signature(tree, training: bool):
+        """The program-cache key: pytree structure + per-leaf
+        shape/dtype (+ the static training flag) — the same signature
+        jax.jit specializes on, so hit/miss counts what XLA caches.
+        The treedef is hashable as-is; leaves reduce to (shape, dtype)
+        tuples — no stringification on the call path."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        descr = tuple(
+            (tuple(leaf.shape), leaf.dtype)
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+            else ("scalar", type(leaf).__name__)
+            for leaf in leaves)
+        return (treedef, descr, bool(training))
+
+    def cache_stats(self) -> dict:
+        """Public program-cache statistics: ``hits`` / ``misses`` /
+        ``compiles`` (misses whose dispatch actually grew the
+        underlying jax.jit program cache — a re-trace that hit an
+        already-compiled program is a miss but not a compile) and
+        ``last_compile_s`` (wall seconds of the most recent compiling
+        call). Mirrored into the obs metrics registry
+        (``jit_cache_{hits,misses}_total``, ``jit_compile_seconds``)."""
+        return dict(self._cache_info)
 
     def _build(self):
         layer = self._layer
@@ -108,8 +148,44 @@ class StaticFunction:
         if self._jitted is None:
             self._build()
         params = self._layer.tree_flatten_params() if self._layer else {}
-        out = self._jitted(params, _unwrap_tree(args), _unwrap_tree(kwargs),
-                           self._layer.training if self._layer else False)
+        args_u = _unwrap_tree(args)
+        kwargs_u = _unwrap_tree(kwargs)
+        training = self._layer.training if self._layer else False
+        key = self._signature((params, args_u, kwargs_u), training)
+        ci = self._cache_info
+        if key in self._seen_sigs:
+            ci["hits"] += 1
+            self._ctr_hit.inc()
+            out = self._jitted(params, args_u, kwargs_u, training)
+        else:
+            self._seen_sigs.add(key)
+            ci["misses"] += 1
+            self._ctr_miss.inc()
+            try:
+                c0 = int(self._jitted._cache_size())
+            except Exception:
+                c0 = None
+            t0 = time.perf_counter()
+            out = self._jitted(params, args_u, kwargs_u, training)
+            # dispatch of a fresh signature blocks until trace+compile
+            # finish (execution stays async), so this wall delta IS the
+            # compile cost
+            dt = time.perf_counter() - t0
+            try:
+                compiled = c0 is None or int(
+                    self._jitted._cache_size()) > c0
+            except Exception:
+                compiled = True
+            if compiled:
+                ci["compiles"] += 1
+                ci["last_compile_s"] = dt
+                self._hist_compile.observe(dt)
+                tr = _obs_trace.active()
+                if tr is not None:
+                    name = getattr(self._fn, "__qualname__",
+                                   getattr(self._fn, "__name__", "fn"))
+                    tr.instant("jit.compile", track="jit",
+                               fn=str(name), wall_s=round(dt, 6))
         return _wrap_tree(out)
 
     @property

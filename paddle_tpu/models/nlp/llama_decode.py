@@ -1094,6 +1094,11 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                 lengths, pools, x_last)
         return _finish_prefill(outer, x_last), pools
 
+    # the shim itself is plain python; expose the jitted programs it
+    # drives so the serving engine's recompile detector (obs layer:
+    # program-cache growth across a call) can watch prefill too
+    prefill_chunked._jit_inner = (_prefill_chunk, _finish_prefill)
+
     if chunked_prefill is not None:
         if chunked_prefill % page_size:
             raise ValueError("chunked_prefill must be a multiple of "
@@ -1169,7 +1174,15 @@ def route_decode(lengths, capacity: int, shared_prefix: bool = False,
     """
     import numpy as _np
 
+    from ...obs import metrics as _obs_metrics
+
     def _r(backend, rule):
+        # obs counter per (clause, backend): the short label is the
+        # rule text up to its parenthesized rationale — stable across
+        # wording tweaks inside the parens, low-cardinality by design
+        _obs_metrics.counter(
+            "route_decode_total", "routing-rule firings by clause",
+            rule=rule.split(" (")[0], backend=backend).inc()
         return (backend, rule) if explain else backend
 
     lens = _np.asarray(lengths)

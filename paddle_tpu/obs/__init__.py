@@ -1,0 +1,28 @@
+"""paddle_tpu.obs — the observability spine: request-scoped tracing +
+a process-global metrics registry.
+
+Two halves, both dependency-free and import-light (no jax):
+
+- ``obs.trace``: ``Tracer`` (tracks, nested spans, async request
+  lifecycles, chrome://tracing export), a process-global active
+  tracer for layers that cannot be handed one (the jit program cache,
+  ``route_decode``), and a ``trace_id`` contextvar tying spans to the
+  request that caused them. ``ServingEngine(trace=...)`` threads one
+  through the serving lifecycle; ``tools/trace_report.py`` summarizes
+  the export (per-request waterfall, top recompiles, shed timeline,
+  slot occupancy).
+- ``obs.metrics``: counters / gauges / fixed-bucket histograms with
+  Prometheus text exposition (``REGISTRY.expose_text()``) and JSONL
+  snapshots (``REGISTRY.write_jsonl(path)``). Counters stay live even
+  when no trace records; ``REGISTRY.disable()`` is the no-obs
+  baseline arm of ``tools/bench_gate.py obs`` (tracing-off overhead
+  gated <= 2% on the serving workload bench).
+
+Span taxonomy, metric names and the Perfetto how-to live in
+docs/OBSERVABILITY.md.
+"""
+from . import metrics, trace  # noqa: F401
+from .metrics import (REGISTRY, Counter, Gauge,  # noqa: F401
+                      Histogram, MetricsRegistry, get_registry)
+from .trace import (Tracer, activate, active,  # noqa: F401
+                    deactivate, get_trace_id, trace_scope, use)

@@ -54,12 +54,20 @@ def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
     return fn
 
 
-def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None,
+                          *, timestamp: bool = True):
+    """Trace-ready handler writing chrome JSON under ``dir_name``.
+
+    ``timestamp=True`` (default) keeps the historical wall-stamped
+    suffix so repeated runs never clobber each other;
+    ``timestamp=False`` writes exactly ``<worker_name>.json`` — the
+    deterministic name tests and diffable artifacts need."""
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"worker_{os.getpid()}"
-        prof._export_chrome(os.path.join(
-            dir_name, f"{name}_{int(time.time())}.json"))
+        fname = f"{name}_{int(time.time())}.json" if timestamp \
+            else f"{name}.json"
+        prof._export_chrome(os.path.join(dir_name, fname))
     return handler
 
 

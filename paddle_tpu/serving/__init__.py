@@ -16,7 +16,7 @@ serving`` gates both families.
 """
 from .engine import (EngineClock, FixedPolicy,  # noqa: F401
                      Policy, RoutedPolicy, ServeResult, ServingEngine,
-                     make_policy)
+                     load_engine_log, make_policy)
 from .metrics import MetricsCollector  # noqa: F401
 from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401
                         ServiceEstimator)

@@ -39,6 +39,7 @@ first pass warms every program shape.
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -48,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..inference import BatchingConfig
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..models.nlp.llama_decode import (llama_serving_decode_factory,
                                        route_decode)
 from ..ops.pallas.paged_attention import PagedKVCache
@@ -153,19 +156,91 @@ class ServeResult:
     scheduler: str = "fifo"         # admission discipline that ran
     shed: Dict[str, str] = dataclasses.field(default_factory=dict)
     # rid -> shed reason (QoS scheduler only; FIFO never sheds)
+    trace: Optional[object] = None  # obs.Tracer when the run traced
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
 
+    def save_log(self, path: str) -> str:
+        """Dump the engine's decision + slot + shed log as JSONL, so an
+        overload incident can be replayed offline (``load_engine_log``
+        round-trips it). One ``meta`` line, then one line per wave
+        decision, slot acquire/release, and shed."""
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "kind": "meta", "policy": self.policy,
+                "scheduler": self.scheduler,
+                "pages_total": self.pages_total,
+                "pages_free_end": self.pages_free_end}) + "\n")
+            for d in self.decisions:
+                f.write(json.dumps({"kind": "decision", **d}) + "\n")
+            for t, ev, rid, slot in self.slot_log:
+                f.write(json.dumps({"kind": "slot", "t": t,
+                                    "event": ev, "rid": rid,
+                                    "slot": slot}) + "\n")
+            for rid, reason in self.shed.items():
+                f.write(json.dumps({"kind": "shed", "rid": rid,
+                                    "reason": reason}) + "\n")
+        return path
+
+
+def load_engine_log(path: str) -> dict:
+    """Parse a ``ServeResult.save_log`` JSONL back into
+    ``{"meta", "decisions", "slot_log", "shed"}`` with the engine's
+    in-memory types (slot entries as ``(t, event, rid, slot)``
+    tuples), so offline analysis sees what the live run saw."""
+    out: dict = {"meta": None, "decisions": [], "slot_log": [],
+                 "shed": {}}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            d = json.loads(ln)
+            kind = d.pop("kind", None)
+            if kind == "meta":
+                out["meta"] = d
+            elif kind == "decision":
+                out["decisions"].append(d)
+            elif kind == "slot":
+                out["slot_log"].append(
+                    (d["t"], d["event"], d["rid"], d["slot"]))
+            elif kind == "shed":
+                out["shed"][d["rid"]] = d["reason"]
+            else:
+                raise ValueError(f"engine log line has unknown kind "
+                                 f"{kind!r}")
+    return out
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    """Entry count of a jax.jit program cache. A python shim that
+    advertises its inner jitted programs via ``_jit_inner`` (the
+    chunked-prefill wrapper) reports their summed count; anything
+    else non-jitted reports None (detection off, never wrong)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        pass
+    inner = getattr(fn, "_jit_inner", None)
+    if inner:
+        sizes = [_jit_cache_size(f) for f in inner]
+        if any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+    return None
+
 
 class _PagedRow:
-    __slots__ = ("req", "slot", "tok", "out", "eff", "done")
+    __slots__ = ("req", "slot", "tok", "out", "eff", "done", "t0")
 
-    def __init__(self, req: Request, slot: int, first_tok: int):
+    def __init__(self, req: Request, slot: int, first_tok: int,
+                 t0: float = 0.0):
         self.req = req
         self.slot = slot
         self.tok = first_tok
         self.out = [first_tok]
+        self.t0 = t0  # admit time (slot-occupancy span start)
         cancel = req.cancel_after if req.cancel_after is not None \
             else 10 ** 9
         self.eff = min(req.max_new_tokens, cancel)
@@ -187,6 +262,14 @@ class ServingEngine:
     a configured ``QoSScheduler`` — the SLO-aware front door (priority
     + weighted-fair admission, deadline feasibility, shedding and
     degradation, timeouts).
+    ``trace``: None (tracing off — the default, zero spans recorded),
+    an ``obs.Tracer`` (caller keeps the handle; cleared at each run's
+    start), or a path string (a fresh tracer exports chrome://tracing
+    JSON there after every run). Spans ride the run's VIRTUAL clock:
+    request roots on one track per tenant, occupancy on one track per
+    decode slot, prefill/decode work on the engine track, scheduler
+    decisions + jit recompiles as instants. Outputs, metrics records
+    and logs are byte-identical with tracing on or off.
     """
 
     def __init__(self, model=None, *, serving=None, slots: int = 4,
@@ -199,7 +282,7 @@ class ServingEngine:
                  kv_cache_dtype: Optional[str] = None,
                  scan_layers: bool = True,
                  expect_churn: Optional[bool] = None,
-                 scheduler=None):
+                 scheduler=None, trace=None):
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -251,6 +334,24 @@ class ServingEngine:
                              "enqueue/select/commit")
         self.scheduler = scheduler
         self.admission = admission or BatchingConfig()
+        self._trace_spec = trace
+        # obs counters prefetched once: the per-event hot path is then
+        # one enabled-check + add (the <= 2% tracing-off overhead gate,
+        # tools/bench_gate.py obs, prices exactly this)
+        _c = obs_metrics.REGISTRY.counter
+        self._ctr_arrived = _c("serving_requests_arrived_total",
+                               "requests entering the engine")
+        self._ctr_tokens = _c("serving_tokens_generated_total",
+                              "tokens emitted across all requests")
+        self._ctr_shed = _c("serving_requests_shed_total",
+                            "requests rejected by the scheduler")
+        self._ctr_finished = {
+            o: _c("serving_requests_finished_total",
+                  "finished requests by outcome", outcome=o)
+            for o in ("completed", "cancel", "timeout")}
+        self._ctr_compiles = _c("serving_jit_compiles_total",
+                                "jit program-cache compiles observed "
+                                "by the engine")
         self.decode_chunk = decode_chunk
         self.clock_mode = clock
         self.fixed_costs = fixed_costs
@@ -275,6 +376,103 @@ class ServingEngine:
     @_pools.setter
     def _pools(self, value):
         self.serving._live_pools = value
+
+    # --- tracing helpers --------------------------------------------------
+    @staticmethod
+    def _tenant_track(r: Request) -> str:
+        return f"tenant/{r.tenant}" if r.tenant is not None \
+            else "requests"
+
+    def _make_tracer(self, clock) -> Optional[obs_trace.Tracer]:
+        spec = self._trace_spec
+        if spec is None or spec is False:
+            return None
+        if isinstance(spec, obs_trace.Tracer):
+            t = spec
+            t.clear()   # each run() is one trace
+        else:
+            t = obs_trace.Tracer()
+        t.set_clock(clock.now)  # spans live in VIRTUAL time
+        return t
+
+    def _close_trace(self, tr: Optional[obs_trace.Tracer]):
+        if tr is not None and isinstance(self._trace_spec, str):
+            tr.export(self._trace_spec)
+
+    def _req_open(self, tr, r: Request):
+        if tr is None:
+            return
+        attrs = {"prompt_len": len(r.prompt),
+                 "budget": r.max_new_tokens}
+        if r.tenant is not None:
+            attrs["tenant"] = r.tenant
+        if r.priority:
+            attrs["priority"] = r.priority
+        if r.deadline_ms is not None:
+            attrs["deadline_ms"] = r.deadline_ms
+        tr.async_begin("request", r.rid, t=r.arrival,
+                       track=self._tenant_track(r), **attrs)
+
+    def _req_close(self, tr, r: Request, t: float, outcome: str,
+                   n_tokens: int, reason: Optional[str] = None):
+        if tr is None:
+            return
+        attrs = {"outcome": outcome, "n_tokens": n_tokens}
+        if reason is not None:
+            attrs["reason"] = reason
+        tr.async_end("request", r.rid, t=t,
+                     track=self._tenant_track(r), **attrs)
+
+    def _wave_instant(self, tr, decision: dict):
+        if tr is not None:
+            tr.instant("wave", t=decision["t"], track="engine",
+                       **{k: v for k, v in decision.items()
+                          if k != "t"})
+
+    def _timed(self, tr, clock, kind, fn, jitfn=None, rid=None,
+               **attrs):
+        """``clock.timed`` plus, when tracing, a span in virtual time
+        (wall seconds as an attr) and jit-recompile detection: the
+        wrapped program cache growing across the call means THIS call
+        compiled — the ``jit.compile`` instant names the site and the
+        wall cost, the counter feeds the metrics registry."""
+        if tr is None:
+            # no trace: recompile COUNTING stays live (the obs
+            # contract — counters record when nobody traces) unless
+            # the registry kill-switch is down (the no-obs arm);
+            # detection is two cache-size reads around the call
+            if jitfn is None or not obs_metrics.REGISTRY.enabled:
+                return clock.timed(kind, fn)
+            c0 = _jit_cache_size(jitfn)
+            out = clock.timed(kind, fn)
+            if c0 is not None:
+                c1 = _jit_cache_size(jitfn)
+                if c1 is not None and c1 > c0:
+                    self._ctr_compiles.inc()
+            return out
+        t0 = clock.now()
+        w0 = time.perf_counter()
+        c0 = _jit_cache_size(jitfn) if jitfn is not None else None
+        scope = obs_trace.trace_scope(rid) if rid is not None else None
+        if scope is not None:
+            with scope:
+                out = clock.timed(kind, fn)
+        else:
+            out = clock.timed(kind, fn)
+        wall = time.perf_counter() - w0
+        if rid is not None:
+            attrs["rid"] = rid
+        tr.add_span(kind, t0, clock.now() - t0, track="engine",
+                    wall_s=round(wall, 6), **attrs)
+        if c0 is not None:
+            c1 = _jit_cache_size(jitfn)
+            if c1 is not None and c1 > c0:
+                self._ctr_compiles.inc()
+                inst = {"site": kind, "wall_s": round(wall, 6)}
+                if rid is not None:
+                    inst["rid"] = rid
+                tr.instant("jit.compile", t=t0, track="jit", **inst)
+        return out
 
     # --- helpers ----------------------------------------------------------
     def _pad_len(self, n: int) -> int:
@@ -302,6 +500,7 @@ class ServingEngine:
             return self._run_scheduled(trace, self.scheduler)
         self._validate(trace)
         clock = EngineClock(self.clock_mode, self.fixed_costs)
+        tr = self._make_tracer(clock)
         m = MetricsCollector()
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)  # bookkeeping only:
@@ -322,80 +521,98 @@ class ServingEngine:
                                  for r in trace)
         ctx_base = {"capacity": self.slots, "expect_churn": expect_churn}
 
-        while pending or waiting or active:
-            now = clock.now()
-            while pending and pending[0].arrival <= now + 1e-12:
-                r = pending.popleft()
-                waiting.append(r)
-                # QoS fields ride along so a FIFO baseline run on a
-                # QoS trace still reports deadline attainment/goodput;
-                # on a plain trace they are all None and the metrics
-                # record stays byte-identical to PR 2
-                m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
-                             priority=r.priority,
-                             deadline_ms=r.deadline_ms)
-            m.on_queue_depth(now, len(waiting))
+        prev_tr = obs_trace.active()
+        if tr is not None:
+            obs_trace.activate(tr)
+        try:
+            while pending or waiting or active:
+                now = clock.now()
+                while pending and pending[0].arrival <= now + 1e-12:
+                    r = pending.popleft()
+                    waiting.append(r)
+                    # QoS fields ride along so a FIFO baseline run on a
+                    # QoS trace still reports deadline attainment/goodput;
+                    # on a plain trace they are all None and the metrics
+                    # record stays byte-identical to PR 2
+                    m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
+                                 priority=r.priority,
+                                 deadline_ms=r.deadline_ms)
+                    self._ctr_arrived.inc()
+                    self._req_open(tr, r)
+                m.on_queue_depth(now, len(waiting))
+                if tr is not None:
+                    tr.counter("queue_depth", len(waiting), t=now)
 
-            progressed = False
-            if waiting and self._admission_ready(waiting, pending,
-                                                 active, clock):
-                wave = waiting[:self.admission.max_batch]
-                groups = [r.prefix_group for r in wave
-                          if r.prefix_group is not None]
-                shared = (len(groups) != len(set(groups))
-                          or any(g in seen_groups for g in groups))
-                ctx = dict(ctx_base, shared_prefix=shared,
-                           active_paged=len(active))
-                backend, reason = self.policy.route(wave, ctx)
-                decision = {
-                    "t": round(clock.now(), 6), "wave": len(wave),
-                    "prompt_lens": [len(r.prompt) for r in wave],
-                    "backend": backend, "rule": reason}
-                if backend == "dense":
-                    decisions.append(decision)
-                    del waiting[:len(wave)]
-                    seen_groups.update(g for g in groups)
-                    self._run_dense_wave(wave, clock, m, outputs)
-                    progressed = True
-                else:
-                    n_adm = self._admit_paged(
-                        wave, book, clock, m, active, free_slots,
-                        slot_log, prefix_cached, seen_groups, outputs)
-                    del waiting[:n_adm]
-                    progressed = n_adm > 0
-                    if n_adm:
-                        # a BLOCKED wave (no slots/pages yet) is not a
-                        # decision — it will re-route once something
-                        # frees; logging every retry turn would inflate
-                        # the per-wave statistics the bench reports
-                        decision["admitted"] = n_adm
+                progressed = False
+                if waiting and self._admission_ready(waiting, pending,
+                                                     active, clock):
+                    wave = waiting[:self.admission.max_batch]
+                    groups = [r.prefix_group for r in wave
+                              if r.prefix_group is not None]
+                    shared = (len(groups) != len(set(groups))
+                              or any(g in seen_groups for g in groups))
+                    ctx = dict(ctx_base, shared_prefix=shared,
+                               active_paged=len(active))
+                    backend, reason = self.policy.route(wave, ctx)
+                    decision = {
+                        "t": round(clock.now(), 6), "wave": len(wave),
+                        "prompt_lens": [len(r.prompt) for r in wave],
+                        "backend": backend, "rule": reason}
+                    if backend == "dense":
                         decisions.append(decision)
-                    elif not active:
-                        raise RuntimeError(
-                            f"pool/slot config too small for "
-                            f"{wave[0].rid} (free pages "
-                            f"{len(book._free)}, free slots "
-                            f"{len(free_slots)})")
+                        self._wave_instant(tr, decision)
+                        del waiting[:len(wave)]
+                        seen_groups.update(g for g in groups)
+                        self._run_dense_wave(wave, clock, m, outputs,
+                                             tr=tr)
+                        progressed = True
+                    else:
+                        n_adm = self._admit_paged(
+                            wave, book, clock, m, active, free_slots,
+                            slot_log, prefix_cached, seen_groups,
+                            outputs, tr=tr)
+                        del waiting[:n_adm]
+                        progressed = n_adm > 0
+                        if n_adm:
+                            # a BLOCKED wave (no slots/pages yet) is not a
+                            # decision — it will re-route once something
+                            # frees; logging every retry turn would inflate
+                            # the per-wave statistics the bench reports
+                            decision["admitted"] = n_adm
+                            decisions.append(decision)
+                            self._wave_instant(tr, decision)
+                        elif not active:
+                            raise RuntimeError(
+                                f"pool/slot config too small for "
+                                f"{wave[0].rid} (free pages "
+                                f"{len(book._free)}, free slots "
+                                f"{len(free_slots)})")
 
-            if active:
-                self._paged_chunk(book, clock, m, active, free_slots,
-                                  slot_log, outputs)
-                progressed = True
+                if active:
+                    self._paged_chunk(book, clock, m, active, free_slots,
+                                      slot_log, outputs, tr=tr)
+                    progressed = True
 
-            if not progressed and not active:
-                targets = []
-                if pending:
-                    targets.append(pending[0].arrival)
-                if waiting:
-                    targets.append(waiting[0].arrival
-                                   + self.admission.max_delay)
-                clock.advance_to(min(targets))
-
+                if not progressed and not active:
+                    targets = []
+                    if pending:
+                        targets.append(pending[0].arrival)
+                    if waiting:
+                        targets.append(waiting[0].arrival
+                                       + self.admission.max_delay)
+                    clock.advance_to(min(targets))
+        finally:
+            if tr is not None:
+                if prev_tr is not None:
+                    obs_trace.activate(prev_tr)
+                else:
+                    obs_trace.deactivate()
+        self._close_trace(tr)
         return ServeResult(policy=self.policy.name, outputs=outputs,
                            metrics=m, decisions=decisions,
                            slot_log=slot_log, prefix_cached=prefix_cached,
                            pages_total=pages_total,
-                           pages_free_end=len(book._free))
+                           pages_free_end=len(book._free), trace=tr)
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -418,6 +635,7 @@ class ServingEngine:
         self._validate(trace)
         sched.reset()
         clock = EngineClock(self.clock_mode, self.fixed_costs)
+        tr = self._make_tracer(clock)
         costs = self.fixed_costs or {}
         est = ServiceEstimator(prefill=costs.get("prefill", 1.0),
                                decode=costs.get("decode", 1.0))
@@ -441,105 +659,133 @@ class ServingEngine:
 
         def _shed(pairs):
             for r, reason in pairs:
-                m.on_shed(r.rid, clock.now(), reason)
+                t = clock.now()
+                m.on_shed(r.rid, t, reason)
                 shed_log[r.rid] = reason
+                self._ctr_shed.inc()
+                if tr is not None:
+                    tr.instant("shed", t=t, track="scheduler",
+                               rid=r.rid, reason=reason,
+                               tenant=r.tenant)
+                self._req_close(tr, r, t, "shed", 0, reason=reason)
             return bool(pairs)
 
-        while pending or sched.waiting() or active:
-            now = clock.now()
-            while pending and pending[0].arrival <= now + 1e-12:
-                r = pending.popleft()
-                m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
-                             priority=r.priority,
-                             deadline_ms=r.deadline_ms)
-                _shed(sched.enqueue(r, now))
-            m.on_queue_depth(now, sched.waiting())
-            progressed = _shed(sched.shed_expired(now))
+        prev_tr = obs_trace.active()
+        if tr is not None:
+            obs_trace.activate(tr)
+        try:
+            while pending or sched.waiting() or active:
+                now = clock.now()
+                while pending and pending[0].arrival <= now + 1e-12:
+                    r = pending.popleft()
+                    m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
+                                 priority=r.priority,
+                                 deadline_ms=r.deadline_ms)
+                    self._ctr_arrived.inc()
+                    self._req_open(tr, r)
+                    _shed(sched.enqueue(r, now))
+                m.on_queue_depth(now, sched.waiting())
+                if tr is not None:
+                    tr.counter("queue_depth", sched.waiting(), t=now)
+                progressed = _shed(sched.shed_expired(now))
 
-            if sched.waiting() and self._sched_ready(sched, pending,
-                                                     active, clock):
-                dec = sched.select(now,
-                                   max_batch=self.admission.max_batch,
-                                   est=est,
-                                   decode_chunk=self.decode_chunk)
-                progressed |= _shed(dec.shed)
-                wave = dec.wave
-                if wave:
-                    groups = [r.prefix_group for r in wave
-                              if r.prefix_group is not None]
-                    shared = (len(groups) != len(set(groups))
-                              or any(g in seen_groups for g in groups))
-                    ctx = dict(ctx_base, shared_prefix=shared,
-                               active_paged=len(active))
-                    backend, reason = self.policy.route(wave, ctx)
-                    decision = {
-                        "t": round(clock.now(), 6), "wave": len(wave),
-                        "prompt_lens": [len(r.prompt) for r in wave],
-                        "backend": backend, "rule": reason,
-                        "rids": [r.rid for r in wave]}
-                    if backend == "dense":
-                        decisions.append(decision)
-                        seen_groups.update(g for g in groups)
-                        self._commit_wave(wave, dec, sched, m)
-                        self._run_dense_wave(wave, clock, m, outputs,
-                                             timeouts=True)
-                        progressed = True
-                    else:
-                        t0 = clock.now()
-                        n_adm = self._admit_paged(
-                            wave, book, clock, m, active, free_slots,
-                            slot_log, prefix_cached, seen_groups,
-                            outputs)
-                        if n_adm:
-                            est.observe("prefill",
-                                        (clock.now() - t0) / n_adm)
-                            self._commit_wave(wave[:n_adm], dec, sched,
-                                              m)
-                            decision["admitted"] = n_adm
+                if sched.waiting() and self._sched_ready(sched, pending,
+                                                         active, clock):
+                    dec = sched.select(now,
+                                       max_batch=self.admission.max_batch,
+                                       est=est,
+                                       decode_chunk=self.decode_chunk)
+                    progressed |= _shed(dec.shed)
+                    wave = dec.wave
+                    if wave:
+                        groups = [r.prefix_group for r in wave
+                                  if r.prefix_group is not None]
+                        shared = (len(groups) != len(set(groups))
+                                  or any(g in seen_groups
+                                         for g in groups))
+                        ctx = dict(ctx_base, shared_prefix=shared,
+                                   active_paged=len(active))
+                        backend, reason = self.policy.route(wave, ctx)
+                        decision = {
+                            "t": round(clock.now(), 6), "wave": len(wave),
+                            "prompt_lens": [len(r.prompt) for r in wave],
+                            "backend": backend, "rule": reason,
+                            "rids": [r.rid for r in wave]}
+                        if backend == "dense":
                             decisions.append(decision)
+                            self._wave_instant(tr, decision)
+                            seen_groups.update(g for g in groups)
+                            self._commit_wave(wave, dec, sched, m,
+                                              tr=tr, t=clock.now())
+                            self._run_dense_wave(wave, clock, m, outputs,
+                                                 timeouts=True, tr=tr)
                             progressed = True
-                        elif not active:
-                            raise RuntimeError(
-                                f"pool/slot config too small for "
-                                f"{wave[0].rid} (free pages "
-                                f"{len(book._free)}, free slots "
-                                f"{len(free_slots)})")
+                        else:
+                            t0 = clock.now()
+                            n_adm = self._admit_paged(
+                                wave, book, clock, m, active, free_slots,
+                                slot_log, prefix_cached, seen_groups,
+                                outputs, tr=tr)
+                            if n_adm:
+                                est.observe("prefill",
+                                            (clock.now() - t0) / n_adm)
+                                self._commit_wave(wave[:n_adm], dec,
+                                                  sched, m, tr=tr,
+                                                  t=clock.now())
+                                decision["admitted"] = n_adm
+                                decisions.append(decision)
+                                self._wave_instant(tr, decision)
+                                progressed = True
+                            elif not active:
+                                raise RuntimeError(
+                                    f"pool/slot config too small for "
+                                    f"{wave[0].rid} (free pages "
+                                    f"{len(book._free)}, free slots "
+                                    f"{len(free_slots)})")
 
-            if active:
-                t0 = clock.now()
-                self._paged_chunk(book, clock, m, active, free_slots,
-                                  slot_log, outputs)
-                est.observe("decode", clock.now() - t0)
-                t = clock.now()
-                for sid in list(active):
-                    dl = active[sid].req.deadline_time()
-                    if dl is not None and t > dl + 1e-9:
-                        self._finish_paged(sid, book, clock, m, active,
-                                           free_slots, slot_log,
-                                           outputs, timeout=True)
-                progressed = True
+                if active:
+                    t0 = clock.now()
+                    self._paged_chunk(book, clock, m, active, free_slots,
+                                      slot_log, outputs, tr=tr)
+                    est.observe("decode", clock.now() - t0)
+                    t = clock.now()
+                    for sid in list(active):
+                        dl = active[sid].req.deadline_time()
+                        if dl is not None and t > dl + 1e-9:
+                            self._finish_paged(sid, book, clock, m,
+                                               active, free_slots,
+                                               slot_log, outputs,
+                                               timeout=True, tr=tr)
+                    progressed = True
 
-            if not progressed and not active:
-                targets = []
-                if pending:
-                    targets.append(pending[0].arrival)
-                if sched.waiting():
-                    targets.append(sched.oldest_arrival()
-                                   + self.admission.max_delay)
-                if not targets:
-                    break  # everything left this turn was shed
-                clock.advance_to(min(targets))
-
+                if not progressed and not active:
+                    targets = []
+                    if pending:
+                        targets.append(pending[0].arrival)
+                    if sched.waiting():
+                        targets.append(sched.oldest_arrival()
+                                       + self.admission.max_delay)
+                    if not targets:
+                        break  # everything left this turn was shed
+                    clock.advance_to(min(targets))
+        finally:
+            if tr is not None:
+                if prev_tr is not None:
+                    obs_trace.activate(prev_tr)
+                else:
+                    obs_trace.deactivate()
+        self._close_trace(tr)
         return ServeResult(policy=self.policy.name, outputs=outputs,
                            metrics=m, decisions=decisions,
                            slot_log=slot_log,
                            prefix_cached=prefix_cached,
                            pages_total=pages_total,
                            pages_free_end=len(book._free),
-                           scheduler=sched.name, shed=shed_log)
+                           scheduler=sched.name, shed=shed_log,
+                           trace=tr)
 
     @staticmethod
-    def _commit_wave(admitted, dec, sched, m):
+    def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
         """Charge the fair-queue tags for what actually ran (the
         degraded budget when a tier fired) and record degradations
         only then — a wave member blocked on slots stays queued,
@@ -549,6 +795,10 @@ class ServingEngine:
             if r.rid in dec.degraded:
                 b, b0 = dec.degraded[r.rid]
                 m.on_degrade(r.rid, b, b0)
+                if tr is not None:
+                    tr.instant("degrade", t=t, track="scheduler",
+                               rid=r.rid, budget=b, orig_budget=b0,
+                               tenant=r.tenant)
 
     def _sched_ready(self, sched, pending, active, clock) -> bool:
         if sched.waiting() >= self.admission.max_batch:
@@ -560,7 +810,8 @@ class ServingEngine:
 
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
-                     slot_log, prefix_cached, seen_groups, outputs) -> int:
+                     slot_log, prefix_cached, seen_groups, outputs,
+                     tr=None) -> int:
         admitted = 0
         for r in wave:
             if not free_slots:
@@ -584,31 +835,44 @@ class ServingEngine:
             pt[0, :len(table)] = table
             lens = np.asarray([len(r.prompt)], np.int32)
             resume = (n_cached // self.chunk_C) * self.chunk_C
-            m.on_admit(sid, clock.now(), "paged")
+            t_admit = clock.now()
+            m.on_admit(sid, t_admit, "paged")
+            if tr is not None:
+                tr.instant("admit", t=t_admit,
+                           track=self._tenant_track(r), rid=sid,
+                           backend="paged", slot=slot)
 
             def _call(toks=toks, pt=pt, lens=lens, resume=resume):
                 return self._p_prefill(
                     self._p_outer, self._p_layers, jnp.asarray(toks),
                     jnp.asarray(pt), jnp.asarray(lens), self._pools,
                     resume_from=resume)
-            first, self._pools = clock.timed("prefill", _call)
+            first, self._pools = self._timed(
+                tr, clock, "prefill", _call, jitfn=self._p_prefill,
+                rid=sid, resume=resume, cached=n_cached)
             first_tok = int(np.asarray(first)[0])
             if r.prefix_group is not None:
                 book.register_prefix(sid, list(r.prompt))
                 seen_groups.add(r.prefix_group)
-            row = _PagedRow(r, slot, first_tok)
+            row = _PagedRow(r, slot, first_tok, t0=t_admit)
             active[sid] = row
             slot_log.append((round(clock.now(), 6), "acquire", sid, slot))
             prefix_cached[sid] = n_cached
-            m.on_tokens(sid, clock.now(), 1)
+            t_first = clock.now()
+            m.on_tokens(sid, t_first, 1)
+            self._ctr_tokens.inc()
+            if tr is not None:
+                tr.instant("first_token", t=t_first,
+                           track=self._tenant_track(r), rid=sid)
             admitted += 1
             if len(row.out) >= row.eff or first_tok == self.eos_token_id:
                 self._finish_paged(sid, book, clock, m, active,
-                                   free_slots, slot_log, outputs)
+                                   free_slots, slot_log, outputs,
+                                   tr=tr)
         return admitted
 
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
-                     outputs):
+                     outputs, tr=None):
         n = self.decode_chunk
         toks = np.zeros((self.slots,), np.int32)
         pt = np.zeros((self.slots, self.W), np.int32)
@@ -624,7 +888,9 @@ class ServingEngine:
             return self._p_decode_n(
                 self._p_outer, self._p_layers, jnp.asarray(toks),
                 jnp.asarray(pt), jnp.asarray(lens), self._pools, n)
-        emits, _, self._pools = clock.timed("decode", _call)
+        emits, _, self._pools = self._timed(
+            tr, clock, "decode", _call, jitfn=self._p_decode_n,
+            n=n, rows=len(rows))
         emits = np.asarray(emits)  # (n, slots) greedy tokens
         t = clock.now()
         for st in rows:
@@ -642,12 +908,15 @@ class ServingEngine:
             book.lengths[sid] += n  # all n K/V writes happened
             if taken:
                 m.on_tokens(sid, t, taken)
+                self._ctr_tokens.inc(taken)
             if st.done or len(st.out) >= st.eff:
                 self._finish_paged(sid, book, clock, m, active,
-                                   free_slots, slot_log, outputs)
+                                   free_slots, slot_log, outputs,
+                                   tr=tr)
 
     def _finish_paged(self, sid, book, clock, m, active, free_slots,
-                      slot_log, outputs, timeout: bool = False):
+                      slot_log, outputs, timeout: bool = False,
+                      tr=None):
         st = active.pop(sid)
         book.free(sid)
         free_slots.append(st.slot)
@@ -661,13 +930,21 @@ class ServingEngine:
         # a deadline timeout is the same eviction path as client churn
         # (cancel_after): stop decoding, free pages, mark evicted —
         # only the recorded reason differs
-        m.on_finish(sid, clock.now(), evicted=evicted or timeout,
+        t_fin = clock.now()
+        m.on_finish(sid, t_fin, evicted=evicted or timeout,
                     reason="timeout" if timeout
                     else ("cancel" if evicted else None))
+        outcome = "timeout" if timeout else (
+            "cancel" if evicted else "completed")
+        self._ctr_finished[outcome].inc()
+        if tr is not None:
+            tr.add_span(sid, st.t0, t_fin - st.t0,
+                        track=f"slot/{st.slot}", backend="paged")
+        self._req_close(tr, r, t_fin, outcome, len(st.out))
 
     # --- dense backend ----------------------------------------------------
     def _run_dense_wave(self, wave, clock, m, outputs,
-                        timeouts: bool = False):
+                        timeouts: bool = False, tr=None):
         """A wave on the dense compiled cache: equal-length groups batch
         together (the dense prefill needs one S0 per program); each
         group runs prefill + per-token decode to the LONGEST effective
@@ -696,11 +973,17 @@ class ServingEngine:
             t_admit = clock.now()
             for r in grp:
                 m.on_admit(r.rid, t_admit, "dense")
+                if tr is not None:
+                    tr.instant("admit", t=t_admit,
+                               track=self._tenant_track(r),
+                               rid=r.rid, backend="dense")
 
             def _pf(kc=kc, vc=vc):
                 return parts["prefill"](parts["outer"], parts["layers"],
                                         jnp.asarray(toks), kc, vc)
-            logits, kc, vc = clock.timed("dense_prefill", _pf)
+            logits, kc, vc = self._timed(
+                tr, clock, "dense_prefill", _pf,
+                jitfn=parts["prefill"], S0=S0, B=B)
             cur = np.argmax(np.asarray(logits), -1).astype(np.int32)
             t = clock.now()
             outs = [[int(c)] for c in cur]
@@ -714,6 +997,10 @@ class ServingEngine:
             eos_hit = [False] * B
             for i, r in enumerate(grp):
                 m.on_tokens(r.rid, t, 1)
+                self._ctr_tokens.inc()
+                if tr is not None:
+                    tr.instant("first_token", t=t,
+                               track=self._tenant_track(r), rid=r.rid)
                 if outs[i][0] == self.eos_token_id:
                     eos_hit[i] = True
                 if len(outs[i]) >= eff[i] or eos_hit[i]:
@@ -727,7 +1014,9 @@ class ServingEngine:
                     return parts["decode_step"](
                         parts["outer"], parts["layers"],
                         jnp.asarray(cur), jnp.asarray(pos), kc, vc)
-                logits, kc, vc = clock.timed("dense_decode", _st)
+                logits, kc, vc = self._timed(
+                    tr, clock, "dense_decode", _st,
+                    jitfn=parts["decode_step"], B=B)
                 cur = np.argmax(np.asarray(logits), -1).astype(np.int32)
                 pos += 1
                 t = clock.now()
@@ -736,6 +1025,7 @@ class ServingEngine:
                         tok = int(cur[i])
                         outs[i].append(tok)
                         m.on_tokens(r.rid, t, 1)
+                        self._ctr_tokens.inc()
                         if tok == self.eos_token_id:
                             eos_hit[i] = True
                         if len(outs[i]) >= eff[i] or eos_hit[i]:
@@ -743,6 +1033,10 @@ class ServingEngine:
                         elif dls[i] is not None and t > dls[i] + 1e-9:
                             fin[i] = t
                             timed[i] = True
+            t_end = clock.now()
+            if tr is not None:
+                tr.add_span("dense_wave", t_admit, t_end - t_admit,
+                            track="waves", S0=S0, B=B)
             for i, r in enumerate(grp):
                 outputs[r.rid] = outs[i]
                 evicted = (r.cancel_after is not None
@@ -752,3 +1046,7 @@ class ServingEngine:
                 m.on_finish(r.rid, fin[i], evicted=evicted or timed[i],
                             reason="timeout" if timed[i]
                             else ("cancel" if evicted else None))
+                outcome = "timeout" if timed[i] else (
+                    "cancel" if evicted else "completed")
+                self._ctr_finished[outcome].inc()
+                self._req_close(tr, r, fin[i], outcome, len(outs[i]))

@@ -254,6 +254,24 @@ class MetricsCollector:
                 (sum(xs) ** 2) / (len(xs) * sq), 4) if sq > 0 else None
         return qb
 
+    def publish(self, registry=None, prefix: str = "serving_run",
+                **slo) -> dict:
+        """Derived view into the obs metrics registry: the aggregate
+        ``report()`` (which itself stays byte-identical to PR 2/PR 3 —
+        the registry is fed FROM it, never the other way) lands as
+        ``<prefix>_*`` gauges, one per scalar field, so a Prometheus
+        scrape or JSONL snapshot sees the last run's TTFT/TPOT/goodput
+        next to the engine's live counters. Returns the record it
+        published."""
+        from ..obs import metrics as _obs
+        reg = registry if registry is not None else _obs.REGISTRY
+        rec = self.report(**slo)
+        for k, v in rec.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue  # nested tenant dicts / None stay trace-only
+            reg.gauge(f"{prefix}_{k}").set(float(v))
+        return rec
+
     def to_record(self, policy: str, **extra) -> dict:
         """The canonical ``serving_workload`` row
         (tools/serving_workload_bench.py emits one per policy;

@@ -1,0 +1,292 @@
+"""paddle_tpu.obs: metrics registry + tracer units, the jit
+program-cache stats satellite, the profiler export-name fix, and the
+bench_gate obs family (synthetic rows through the real subprocess).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- metrics registry -----------------------------------------------------
+def test_counter_gauge_histogram_semantics():
+    r = obs_metrics.MetricsRegistry()
+    c = r.counter("reqs_total", "requests", tenant="a")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotonic
+    # same (name, labels) -> the same child; new labels -> a sibling
+    assert r.counter("reqs_total", tenant="a") is c
+    assert r.counter("reqs_total", tenant="b") is not c
+    g = r.gauge("depth")
+    g.set(4)
+    g.dec()
+    assert g.value == 3
+    h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 3 and h.sum == pytest.approx(5.55)
+    assert h.cumulative() == [(0.1, 1), (1.0, 2), (float("inf"), 3)]
+    # a name cannot change type
+    with pytest.raises(ValueError):
+        r.gauge("reqs_total")
+
+
+def test_registry_disable_is_a_kill_switch():
+    r = obs_metrics.MetricsRegistry()
+    c = r.counter("c_total")
+    h = r.histogram("h_seconds", buckets=(1.0,))
+    g = r.gauge("g")
+    r.disable()
+    c.inc(5)
+    h.observe(0.5)
+    g.set(9)
+    assert c.value == 0 and h.count == 0 and g.value == 0
+    r.enable()
+    c.inc()
+    assert c.value == 1
+
+
+def test_prometheus_exposition_format():
+    r = obs_metrics.MetricsRegistry()
+    r.counter("a_total", "help text", rule="x").inc(2)
+    r.gauge("b").set(1.5)
+    r.histogram("c_seconds", buckets=(0.5,)).observe(0.1)
+    text = r.expose_text()
+    assert "# HELP a_total help text" in text
+    assert "# TYPE a_total counter" in text
+    assert 'a_total{rule="x"} 2' in text
+    assert "# TYPE b gauge" in text and "b 1.5" in text
+    assert 'c_seconds_bucket{le="0.5"} 1' in text
+    assert 'c_seconds_bucket{le="+Inf"} 1' in text
+    assert "c_seconds_sum 0.1" in text and "c_seconds_count 1" in text
+    # deterministic: families sorted by name
+    names = [ln.split("# TYPE ")[1].split()[0]
+             for ln in text.splitlines() if ln.startswith("# TYPE")]
+    assert names == sorted(names)
+
+
+def test_jsonl_snapshot_round_trip(tmp_path):
+    r = obs_metrics.MetricsRegistry()
+    r.counter("n_total").inc(7)
+    p = tmp_path / "snap.jsonl"
+    r.write_jsonl(str(p), run="unit")
+    r.counter("n_total").inc()
+    r.write_jsonl(str(p), run="unit")
+    lines = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["metrics"]["n_total"] == 7
+    assert lines[1]["metrics"]["n_total"] == 8
+    assert all(ln["run"] == "unit" and "ts" in ln for ln in lines)
+
+
+# --- tracer ---------------------------------------------------------------
+def test_tracer_chrome_export_schema(tmp_path):
+    t = obs_trace.Tracer(clock=lambda: 2.0)
+    t.add_span("work", 1.0, 0.5, track="engine", rid="A")
+    with t.span("inner", track="engine"):
+        pass
+    t.instant("mark", t=1.25, track="engine")
+    t.async_begin("request", "A", t=0.0, track="tenant/x")
+    t.async_end("request", "A", t=3.0, track="tenant/x")
+    t.counter("depth", 2, t=0.5)
+    p = tmp_path / "tr.json"
+    t.export(str(p))
+    d = json.loads(p.read_text())
+    evts = d["traceEvents"]
+    assert isinstance(evts, list) and evts
+    # every event chrome-well-formed; ts in MICROseconds
+    for e in evts:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] != "M":
+            assert "ts" in e
+    span = next(e for e in evts if e["name"] == "work")
+    assert span["ts"] == 1e6 and span["dur"] == 0.5e6
+    # track metadata present and bound to the tids used
+    tracks = {e["tid"]: e["args"]["name"] for e in evts
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "engine" in tracks.values()
+    assert tracks[span["tid"]] == "engine"
+    # async pair balanced
+    assert sum(1 for e in evts if e["ph"] == "b") == \
+        sum(1 for e in evts if e["ph"] == "e") == 1
+
+
+def test_trace_scope_tags_trace_id():
+    t = obs_trace.Tracer(clock=lambda: 0.0)
+    with obs_trace.trace_scope("req-1"):
+        t.add_span("prefill", 0.0, 1.0)
+        assert obs_trace.get_trace_id() == "req-1"
+    t.add_span("decode", 1.0, 1.0)
+    assert obs_trace.get_trace_id() is None
+    tagged = [e for e in t.events if e["name"] == "prefill"]
+    untagged = [e for e in t.events if e["name"] == "decode"]
+    assert tagged[0]["args"]["trace_id"] == "req-1"
+    assert "trace_id" not in untagged[0]["args"]
+
+
+def test_tracer_clear_drops_tracks_too():
+    """A reused tracer (the engine clears at each run start) must not
+    export ghost tracks from a previous run."""
+    t = obs_trace.Tracer(clock=lambda: 0.0)
+    t.add_span("w", 0.0, 1.0, track="tenant/old")
+    t.clear()
+    t.add_span("w", 0.0, 1.0, track="tenant/new")
+    tracks = {e["args"]["name"]
+              for e in t.to_chrome()["traceEvents"]
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert tracks == {"tenant/new"}
+
+
+def test_active_tracer_install_restore():
+    assert obs_trace.active() is None
+    t1, t2 = obs_trace.Tracer(), obs_trace.Tracer()
+    with obs_trace.use(t1):
+        assert obs_trace.active() is t1
+        with obs_trace.use(t2):
+            assert obs_trace.active() is t2
+        assert obs_trace.active() is t1
+        with obs_trace.use(None):  # None = no-op, not a clear
+            assert obs_trace.active() is t1
+    assert obs_trace.active() is None
+
+
+# --- jit program-cache stats (satellite) ----------------------------------
+def test_jit_cache_stats_public_api():
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    before = obs_metrics.REGISTRY.counter("jit_cache_hits_total").value
+    x = paddle.ones([2, 3])
+    f(x)           # miss + compile
+    f(x)           # hit
+    f(x * 0)       # hit (same signature)
+    f(paddle.ones([4, 3]))  # miss + compile (new shape)
+    st = f.cache_stats()
+    assert st["hits"] == 2 and st["misses"] == 2
+    assert st["compiles"] == 2
+    assert st["last_compile_s"] is not None and st["last_compile_s"] > 0
+    # the legacy private dict is the SAME ledger (back-compat)
+    assert f._cache_info["hits"] == 2
+    # obs counters moved with it
+    after = obs_metrics.REGISTRY.counter("jit_cache_hits_total").value
+    assert after - before == 2
+
+
+def test_jit_compile_span_reaches_active_tracer():
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def g(x):
+        return x + 1
+
+    t = obs_trace.Tracer(clock=lambda: 0.0)
+    with obs_trace.use(t):
+        g(paddle.ones([5]))
+    compiles = [e for e in t.events if e["name"] == "jit.compile"]
+    assert len(compiles) == 1
+    assert compiles[0]["args"]["wall_s"] > 0
+
+
+# --- profiler export filename (satellite) ---------------------------------
+def test_export_chrome_tracing_deterministic_name(tmp_path):
+    from paddle_tpu import profiler
+
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    handler = profiler.export_chrome_tracing(
+        str(tmp_path), worker_name="w0", timestamp=False)
+    handler(prof)
+    assert (tmp_path / "w0.json").exists()  # exactly, no suffix
+    # default keeps the historical wall-stamp suffix
+    handler2 = profiler.export_chrome_tracing(str(tmp_path),
+                                              worker_name="w1")
+    handler2(prof)
+    stamped = [p.name for p in tmp_path.iterdir()
+               if p.name.startswith("w1_")]
+    assert len(stamped) == 1 and stamped[0].endswith(".json")
+    prof.stop()
+
+
+# --- bench_gate obs family ------------------------------------------------
+def _run_obs_gate(rows):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "obs", "-"], input=rows, capture_output=True, text=True,
+        timeout=60, cwd=REPO)
+    return r.returncode, [json.loads(ln) for ln in
+                          r.stdout.strip().splitlines()]
+
+
+def _ovh_row(noobs, off, **kw):
+    return json.dumps({"bench": "obs_overhead", "noobs_wall_s": noobs,
+                       "off_wall_s": off, "on_wall_s": off * 1.1,
+                       "tokens_match": True, "device": "cpu", **kw})
+
+
+def _tr_row(**kw):
+    d = {"bench": "obs_trace", "events": 100, "roots_open": 4,
+         "roots_closed": 4, "unclosed_roots": [], "path": "t.json"}
+    d.update(kw)
+    return json.dumps(d)
+
+
+def test_bench_gate_obs_overhead():
+    rc, recs = _run_obs_gate(_ovh_row(1.0, 1.01) + "\n")
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+    # > 2% tracing-off tax FAILs with the reason named
+    rc, recs = _run_obs_gate(_ovh_row(1.0, 1.05) + "\n")
+    assert rc == 1 and recs[-1]["gate"] == "FAIL"
+    assert "not free" in recs[-1]["reason"]
+    # diverging token counts across arms FAIL (behavior, not cost)
+    rc, recs = _run_obs_gate(
+        _ovh_row(1.0, 1.0, tokens_match=False) + "\n")
+    assert rc == 1 and "DIVERGING" in recs[-1]["reason"]
+    # no wall measurements FAIL gracefully
+    rc, recs = _run_obs_gate(
+        json.dumps({"bench": "obs_overhead"}) + "\n")
+    assert rc == 1 and "wall" in recs[-1]["reason"]
+
+
+def test_bench_gate_obs_trace_and_combined():
+    rc, recs = _run_obs_gate(_tr_row() + "\n")
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+    rc, recs = _run_obs_gate(
+        _tr_row(roots_closed=3, unclosed_roots=["q1"]) + "\n")
+    assert rc == 1 and "never closed" in recs[-1]["reason"]
+    rc, recs = _run_obs_gate(_tr_row(events=0) + "\n")
+    assert rc == 1 and "zero events" in recs[-1]["reason"]
+    # no obs row at all -> graceful FAIL record, not a traceback
+    rc, recs = _run_obs_gate(json.dumps({"bench": "other"}) + "\n")
+    assert rc == 1 and recs[-1]["gate"] == "FAIL"
+    assert "obs_overhead" in recs[-1]["reason"]
+    # both families: combined verdict is the LAST record; a passing
+    # trace row must not mask a failed overhead gate
+    rc, recs = _run_obs_gate(
+        _ovh_row(1.0, 1.5) + "\n" + _tr_row() + "\n")
+    assert rc == 1
+    assert recs[-1]["combined"] is True and recs[-1]["gate"] == "FAIL"
+    assert recs[-1]["overhead_gate"] == "FAIL"
+    assert recs[-1]["trace_gate"] == "pass"
+
+
+def test_bench_gate_obs_empty_input():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "obs", "-"], input="", capture_output=True, text=True,
+        timeout=60, cwd=REPO)
+    assert r.returncode == 1
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["gate"] == "FAIL"  # graceful record, never a traceback

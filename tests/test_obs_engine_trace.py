@@ -1,0 +1,297 @@
+"""End-to-end observability through the serving engine: trace schema
+(valid chrome JSON, same-track spans nest, every completed/shed
+request closes its root span on BOTH backends), zero-span + identical
+results when tracing is off, the engine-log JSONL round trip, and
+tools/trace_report.py over a real export.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.serving import (QoSScheduler, Request, ServingEngine,
+                                load_engine_log,
+                                synthesize_overload_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def srv_model():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    srv = llama_serving_decode_factory(model, max_len=48, page_size=8,
+                                       n_pool_pages=25, batch_capacity=4,
+                                       chunked_prefill=8)
+    return srv
+
+
+def _trace(seed=5, n=5, cancel=None):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        prompt = tuple(int(t) for t in rng.integers(1, 97, 6))
+        out.append(Request(rid=f"r{i}", arrival=0.5 * i, prompt=prompt,
+                           max_new_tokens=2 + i,
+                           cancel_after=cancel if i == n - 1 else None))
+    return out
+
+
+def _engine(srv, policy, **kw):
+    kw.setdefault("clock", "fixed")
+    return ServingEngine(serving=srv, slots=4, policy=policy, **kw)
+
+
+def _chrome(res):
+    return res.trace.to_chrome()["traceEvents"]
+
+
+def _roots(evts):
+    opened = [e["id"] for e in evts if e["ph"] == "b"]
+    closed = [e["id"] for e in evts if e["ph"] == "e"]
+    return opened, closed
+
+
+@pytest.mark.parametrize("policy", ["paged", "dense"])
+def test_root_span_closed_per_request_both_backends(srv_model, policy):
+    """Every request (completed or evicted) opens exactly one root and
+    closes it, on the paged AND dense backends; outcomes ride the
+    closing event."""
+    trace = _trace(cancel=1)
+    res = _engine(srv_model, policy, trace=obs.Tracer()).run(trace)
+    evts = _chrome(res)
+    opened, closed = _roots(evts)
+    assert sorted(opened) == sorted(r.rid for r in trace)
+    assert sorted(closed) == sorted(opened)  # no dangling roots
+    ends = {e["id"]: e["args"] for e in evts if e["ph"] == "e"}
+    assert ends["r4"]["outcome"] == "cancel"  # the churned request
+    done = [r for r in trace if r.rid != "r4"]
+    assert all(ends[r.rid]["outcome"] == "completed" for r in done)
+    assert all("n_tokens" in a for a in ends.values())
+
+
+@pytest.mark.parametrize("policy", ["paged", "dense"])
+def test_same_track_spans_nest(srv_model, policy):
+    """Chrome renders same-tid X spans as a stack: any two must be
+    disjoint or contained, never partially overlapping."""
+    res = _engine(srv_model, policy, trace=obs.Tracer()).run(_trace())
+    evts = _chrome(res)
+    by_tid = {}
+    for e in evts:
+        if e["ph"] == "X":
+            by_tid.setdefault(e["tid"], []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    assert by_tid, "no spans recorded"
+    for tid, spans in by_tid.items():
+        spans.sort()
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            disjoint = b0 >= a1 - 1e-6
+            contained = b1 <= a1 + 1e-6
+            assert disjoint or contained, (tid, (a0, a1), (b0, b1))
+
+
+def test_trace_is_valid_chrome_json_with_tracks(srv_model, tmp_path):
+    p = tmp_path / "t.json"
+    res = _engine(srv_model, "paged", trace=str(p)).run(_trace())
+    assert res.trace is not None
+    d = json.loads(p.read_text())  # export happened, parses
+    evts = d["traceEvents"]
+    tracks = {e["args"]["name"] for e in evts
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    # the contract: one track per decode slot used, one per tenant
+    # cohort (plain trace -> "requests"), engine + jit + scheduler axes
+    assert "requests" in tracks and "engine" in tracks
+    assert any(t.startswith("slot/") for t in tracks)
+    for e in evts:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+    # slot occupancy spans: acquire/release pairs from the slot log
+    slot_tids = {e["tid"] for e in evts if e.get("ph") == "M"
+                 and e["name"] == "thread_name"
+                 and e["args"]["name"].startswith("slot/")}
+    occ = [e for e in evts if e["ph"] == "X" and e["tid"] in slot_tids]
+    releases = [s for s in res.slot_log if s[1] == "release"]
+    assert len(occ) == len(releases)
+
+
+def test_tracing_off_is_zero_span_and_byte_identical(srv_model):
+    """trace=None (the default): no tracer exists, nothing records —
+    and outputs/slot_log/metrics are byte-identical to a traced run
+    (observability must never change behavior)."""
+    trace = _trace(cancel=1)
+    base = _engine(srv_model, "paged").run(trace)
+    assert base.trace is None
+    # a bystander tracer activated OUTSIDE the engine sees nothing
+    # from a trace=None run: the engine's obs path is fully off
+    t = obs.Tracer(clock=lambda: 0.0)
+    with obs.use(t):
+        again = _engine(srv_model, "paged").run(trace)
+    assert len(t) == 0
+    traced = _engine(srv_model, "paged", trace=obs.Tracer()).run(trace)
+    assert len(traced.trace) > 0
+    for res in (again, traced):
+        assert res.outputs == base.outputs
+        assert res.slot_log == base.slot_log
+        assert res.decisions == base.decisions
+        assert res.report() == base.report()
+
+
+def test_qos_run_traces_sheds_and_closes_their_roots(srv_model):
+    trace = synthesize_overload_trace(
+        seed=0, n_requests=24, service_tokens_per_unit=4.0,
+        prompt_len=(4, 10), output_len=(4, 10), vocab_size=97)
+    sched = QoSScheduler(tenant_weights={"intl": 2.0, "std": 1.0,
+                                         "bulk": 0.5})
+    res = _engine(srv_model, "paged", scheduler=sched,
+                  trace=obs.Tracer()).run(trace)
+    assert res.shed, "overload trace must shed for this test to bite"
+    evts = _chrome(res)
+    opened, closed = _roots(evts)
+    assert sorted(opened) == sorted(r.rid for r in trace)
+    assert sorted(closed) == sorted(opened)
+    ends = {e["id"]: e["args"] for e in evts if e["ph"] == "e"}
+    sheds = [e for e in evts if e["ph"] == "i" and e["name"] == "shed"]
+    assert {s["args"]["rid"] for s in sheds} == set(res.shed)
+    for rid, reason in res.shed.items():
+        assert ends[rid]["outcome"] == "shed"
+        assert ends[rid]["reason"] == reason
+    for s in sheds:  # reason + tenant ride the scheduler instant
+        assert s["args"]["reason"] and "tenant" in s["args"]
+    # tenant tracks exist (one per tenant in the trace)
+    tracks = {e["args"]["name"] for e in evts
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"tenant/intl", "tenant/std", "tenant/bulk"} <= tracks
+    # wave decisions carry the routing rule
+    waves = [e for e in evts if e["ph"] == "i" and e["name"] == "wave"]
+    assert waves and all("rule" in e["args"] for e in waves)
+
+
+def test_engine_log_jsonl_round_trip(srv_model, tmp_path):
+    trace = _trace(cancel=1)
+    res = _engine(srv_model, "paged").run(trace)
+    p = tmp_path / "engine_log.jsonl"
+    res.save_log(str(p))
+    log = load_engine_log(str(p))
+    assert log["decisions"] == res.decisions
+    assert log["slot_log"] == res.slot_log  # tuples restored
+    assert log["shed"] == res.shed
+    assert log["meta"]["policy"] == res.policy
+    assert log["meta"]["pages_total"] == res.pages_total
+    # QoS run: sheds round-trip too
+    otrace = synthesize_overload_trace(
+        seed=0, n_requests=24, service_tokens_per_unit=4.0,
+        prompt_len=(4, 10), output_len=(4, 10), vocab_size=97)
+    res2 = _engine(srv_model, "paged",
+                   scheduler=QoSScheduler()).run(otrace)
+    res2.save_log(str(p))
+    log2 = load_engine_log(str(p))
+    assert log2["shed"] == res2.shed
+    assert log2["meta"]["scheduler"] == "qos"
+
+
+def test_trace_report_summarizes_engine_export(srv_model, tmp_path):
+    p = tmp_path / "t.json"
+    trace = _trace()
+    _engine(srv_model, "paged", trace=str(p)).run(trace)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         str(p), "--json"], capture_output=True, text=True, timeout=60,
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr[-500:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["bench"] == "trace_report"
+    assert rec["requests"] == len(trace)
+    assert rec["open_roots"] == []
+    assert rec["outcomes"].get("completed") == len(trace)
+    assert rec["slot_occupancy"]  # per-slot busy fractions present
+    # human mode renders the waterfall without crashing
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         str(p)], capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r2.returncode == 0
+    assert "waterfall" in r2.stdout and "slot occupancy" in r2.stdout
+    # graceful FAIL on a non-trace file
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         str(bad)], capture_output=True, text=True, timeout=60,
+        cwd=REPO)
+    assert r3.returncode == 1
+    assert json.loads(r3.stdout.strip().splitlines()[-1]).get("error")
+
+
+def test_jit_compile_events_recorded_cold(srv_model):
+    """A COLD engine (fresh factory) records jit.compile instants for
+    the programs its first run compiles, and the serving compile
+    counter moves."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    srv = llama_serving_decode_factory(model, max_len=48, page_size=8,
+                                       n_pool_pages=25,
+                                       batch_capacity=4,
+                                       chunked_prefill=8)
+    c = obs.REGISTRY.counter("serving_jit_compiles_total")
+    before = c.value
+    res = ServingEngine(serving=srv, slots=4, policy="paged",
+                        clock="fixed", trace=obs.Tracer()).run(_trace())
+    evts = _chrome(res)
+    compiles = [e for e in evts if e["ph"] == "i"
+                and e["name"] == "jit.compile"]
+    assert compiles, "cold run recorded no compile events"
+    assert all(e["args"]["wall_s"] > 0 for e in compiles)
+    sites = {e["args"]["site"] for e in compiles}
+    assert sites & {"prefill", "decode"}
+    assert c.value > before
+    # and the metrics registry exposes cleanly after all of it
+    assert "serving_jit_compiles_total" in obs.REGISTRY.expose_text()
+
+
+def test_compile_counter_live_without_tracing():
+    """The obs contract: counters record even when no trace does — a
+    COLD trace=None run still moves serving_jit_compiles_total (only
+    the registry kill-switch stops it)."""
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    srv = llama_serving_decode_factory(model, max_len=48, page_size=8,
+                                       n_pool_pages=25,
+                                       batch_capacity=4,
+                                       chunked_prefill=8)
+    c = obs.REGISTRY.counter("serving_jit_compiles_total")
+    before = c.value
+    ServingEngine(serving=srv, slots=4, policy="paged",
+                  clock="fixed").run(_trace())
+    assert c.value > before
+
+
+def test_metrics_collector_publish_derived_view(srv_model):
+    res = _engine(srv_model, "paged").run(_trace())
+    reg = obs.MetricsRegistry()
+    rec = res.metrics.publish(registry=reg, prefix="sr")
+    assert rec == res.report()  # publishing IS the unchanged report
+    snap = reg.snapshot()
+    assert snap["sr_completed"] == rec["completed"]
+    assert snap["sr_generated_tokens"] == rec["generated_tokens"]
+    assert "sr_ttft_p50" in snap

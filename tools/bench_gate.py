@@ -10,6 +10,17 @@ cannot silently ship a slower build. Three modes:
   python tools/bench_gate.py run                  # run bench.py now,
       then compare (the first chip-queue item each round)
   python tools/bench_gate.py serving <fresh.jsonl> [--stamp]
+  python tools/bench_gate.py obs <fresh.jsonl>
+      # gate the OBSERVABILITY rows (tools/serving_workload_bench.py
+      # --obs-overhead / --trace-out). Two families, judged by
+      # whichever is present (both when both are; combined verdict
+      # printed last):
+      #  - obs_overhead: engine wall time with obs merged but tracing
+      #    OFF must stay within 2% of the no-obs baseline arm measured
+      #    in the same process — instrumentation has to be free when
+      #    nobody is looking.
+      #  - obs_trace: a --trace-out run's span accounting must
+      #    balance: every opened request root closed, events present.
       # gate the SERVING rows. Two canonical families, judged by
       # whichever is present (both when both are):
       #  - spec_vs_plain_compiled (tools/spec_decode_bench.py):
@@ -255,6 +266,121 @@ def check_serving_qos(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+OBS_OFF_OVERHEAD_MAX = 0.02  # tracing-off tax allowed over no-obs
+
+
+def check_obs_overhead(rows: list) -> int:
+    """Gate the obs_overhead row (serving_workload_bench.py
+    --obs-overhead): the tracing-OFF replay's wall time must stay
+    within OBS_OFF_OVERHEAD_MAX of the no-obs baseline arm from the
+    SAME process — the observability layer must cost nothing while
+    disabled. The tracing-ON wall rides along for the record but is
+    not gated (recording spans is allowed to cost; turning them off
+    must not)."""
+    rs = [r for r in rows if r.get("bench") == "obs_overhead"]
+    if not rs:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no obs_overhead row in input "
+                                    "(run tools/serving_workload_"
+                                    "bench.py --obs-overhead)"}))
+        return 1
+    r = rs[-1]
+    noobs = float(r.get("noobs_wall_s") or 0.0)
+    off = float(r.get("off_wall_s") or 0.0)
+    if noobs <= 0 or off <= 0:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "obs_overhead row carries no wall "
+                                    "measurements"}))
+        return 1
+    if r.get("tokens_match") is False:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "obs arms generated DIVERGING "
+                                    "token counts — instrumentation "
+                                    "changed behavior, not just "
+                                    "cost"}))
+        return 1
+    overhead = off / noobs - 1.0
+    rec = {
+        "gate": "pass" if overhead <= OBS_OFF_OVERHEAD_MAX else "FAIL",
+        "overhead_off": round(overhead, 4),
+        "max_overhead_off": OBS_OFF_OVERHEAD_MAX,
+        "noobs_wall_s": round(noobs, 6),
+        "off_wall_s": round(off, 6),
+        "on_wall_s": r.get("on_wall_s"),
+        "overhead_on": r.get("overhead_on"),
+        "trace_events": r.get("trace_events"),
+        "device": r.get("device", "?"),
+    }
+    if rec["gate"] == "FAIL":
+        rec["reason"] = (f"tracing-off wall {off:.4f}s is "
+                         f"{overhead:.1%} over the no-obs baseline "
+                         f"{noobs:.4f}s (max "
+                         f"{OBS_OFF_OVERHEAD_MAX:.0%}) — the disabled "
+                         "path is not free")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
+def check_obs_trace(rows: list) -> int:
+    """Gate the obs_trace span-accounting row (a --trace-out run):
+    spans were recorded and every opened request root closed — a
+    dangling root means a request left the engine untracked."""
+    rs = [r for r in rows if r.get("bench") == "obs_trace"]
+    if not rs:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no obs_trace row in input (run "
+                                    "tools/serving_workload_bench.py "
+                                    "with --trace-out)"}))
+        return 1
+    r = rs[-1]
+    unclosed = r.get("unclosed_roots") or []
+    rec = {
+        "gate": "pass",
+        "events": r.get("events"),
+        "roots_open": r.get("roots_open"),
+        "roots_closed": r.get("roots_closed"),
+        "recompiles": r.get("recompiles"),
+        "path": r.get("path"),
+    }
+    if not r.get("events"):
+        rec["gate"] = "FAIL"
+        rec["reason"] = "trace recorded zero events"
+    elif unclosed:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"{len(unclosed)} request root span(s) never "
+                         f"closed: {unclosed[:5]}")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
+def check_obs(rows: list) -> int:
+    """The obs gate: judge whichever observability families the input
+    carries (both when both are); several families present -> the
+    LAST record printed carries the combined verdict, matching the
+    serving gate's convention."""
+    fam_rcs: dict = {}
+    if any(r.get("bench") == "obs_overhead" for r in rows):
+        fam_rcs["overhead"] = check_obs_overhead(rows)
+    if any(r.get("bench") == "obs_trace" for r in rows):
+        fam_rcs["trace"] = check_obs_trace(rows)
+    if not fam_rcs:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no obs_overhead or obs_trace row "
+                                    "in input (run tools/serving_"
+                                    "workload_bench.py --obs-overhead "
+                                    "or --trace-out)"}))
+        return 1
+    if len(fam_rcs) == 1:
+        return next(iter(fam_rcs.values()))
+    rc = max(fam_rcs.values())
+    combined = {"gate": "pass" if rc == 0 else "FAIL",
+                "combined": True}
+    for k, v in fam_rcs.items():
+        combined[f"{k}_gate"] = "pass" if v == 0 else "FAIL"
+    print(json.dumps(combined))
+    return rc
+
+
 def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     """Gate the serving rows: the spec-compiled vs compiled-plain row
     (tools/spec_decode_bench.py), the workload-replay rows
@@ -386,6 +512,11 @@ def main() -> int:
         text = sys.stdin.read() if src == "-" else open(src).read()
         return check_serving(_json_lines(text), load_serving_baseline(),
                              stamp)
+    if mode == "obs":
+        operands = [a for a in sys.argv[2:] if not a.startswith("--")]
+        src = operands[0] if operands else "-"
+        text = sys.stdin.read() if src == "-" else open(src).read()
+        return check_obs(_json_lines(text))
     if mode == "run":
         baseline = load_baseline()
         r = subprocess.run([sys.executable,
@@ -414,7 +545,7 @@ def main() -> int:
                               "restored pre-run baseline stamp"}))
         return rc
     raise SystemExit("mode: run | check <file|-> | "
-                     "serving <file|-> [--stamp]")
+                     "serving <file|-> [--stamp] | obs <file|->")
 
 
 if __name__ == "__main__":

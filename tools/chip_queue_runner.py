@@ -64,6 +64,13 @@ QUEUE = [
     # gates qos goodput >= 1.15x fifo with tight-cohort SLO >= 0.9
     ("serving_qos",
      [sys.executable, "tools/serving_workload_bench.py", "--qos"], {}),
+    # PR-4 addition: the observability overhead arm — no-obs vs
+    # tracing-off vs tracing-on wall time on one warmed engine;
+    # bench_gate.py obs gates the tracing-off tax <= 2% over the
+    # no-obs baseline (instrumentation must be free when disabled)
+    ("obs_overhead",
+     [sys.executable, "tools/serving_workload_bench.py",
+      "--obs-overhead"], {}),
     # ONE bench run per window, wrapped by the regression gate (round-4
     # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
     # gate snapshots the baseline, runs bench.py, fails on >5% legacy-

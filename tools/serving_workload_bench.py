@@ -26,10 +26,29 @@ requests only), shed rate, deadline attainment, tight-cohort
 attainment, Jain fairness — and `bench_gate.py serving` gates
 qos goodput >= 1.15x fifo with tight-cohort attainment >= 0.9.
 
+The observability arms (PR 4):
+
+- ``--trace-out out.json`` exports the measured replay of the FIRST
+  policy (non-qos) or the qos engine run (``--qos``) as
+  chrome://tracing JSON via ``ServingEngine(trace=...)`` — open it in
+  Perfetto or summarize with ``tools/trace_report.py``; an
+  ``obs_trace`` row (span/root counts) rides the output for
+  ``bench_gate.py obs``. Under ``--qos``, ``--trace`` (useless there
+  as a replay input — the qos arm synthesizes its own trace) is an
+  alias for ``--trace-out``.
+- ``--obs-overhead`` measures the obs tax on WALL time: the same
+  warmed engine replays the same trace with (a) the whole obs layer
+  disabled (no-obs baseline), (b) obs merged but tracing off (the
+  production default), (c) a live tracer; min-of-repeats wall per arm
+  lands in one ``obs_overhead`` row. ``bench_gate.py obs`` gates
+  (b) <= 2% over (a).
+
 Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --cpu --save-trace t.jsonl
       python tools/serving_workload_bench.py --trace t.jsonl
       python tools/serving_workload_bench.py --cpu --qos
+      python tools/serving_workload_bench.py --cpu --qos --trace t.json
+      python tools/serving_workload_bench.py --cpu --obs-overhead
 """
 from __future__ import annotations
 
@@ -69,7 +88,24 @@ def main(argv=None):
     ap.add_argument("--overload", type=float, default=2.0,
                     help="QoS arm: demanded-tokens / engine-capacity "
                          "ratio")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="export the measured replay (first policy, "
+                         "or the qos engine under --qos) as "
+                         "chrome://tracing JSON")
+    ap.add_argument("--obs-overhead", action="store_true",
+                    help="run the obs-overhead arm instead: no-obs vs "
+                         "tracing-off vs tracing-on wall time on one "
+                         "warmed engine (bench_gate.py obs gates "
+                         "off <= 2% over no-obs)")
+    ap.add_argument("--obs-repeats", type=int, default=5,
+                    help="obs-overhead arm: repeats per arm (min wall "
+                         "wins)")
     args = ap.parse_args(argv)
+    if args.qos and args.trace and args.trace_out is None:
+        # under --qos the replay-input meaning of --trace is moot (the
+        # arm synthesizes its own overload trace); it names the chrome
+        # trace output instead, per the PR-4 contract
+        args.trace_out = args.trace
 
     import os
 
@@ -113,6 +149,78 @@ def main(argv=None):
     if on_tpu:
         model.to(dtype="bfloat16")
 
+    def obs_trace_row(tracer, path):
+        """The gateable span-accounting row riding a --trace-out run."""
+        evts = tracer.events
+        opened = [e["id"] for e in evts if e.get("ph") == "b"]
+        closed = {e["id"] for e in evts if e.get("ph") == "e"}
+        return {"bench": "obs_trace", "path": path,
+                "events": len(evts),
+                "roots_open": len(opened), "roots_closed": len(closed),
+                "unclosed_roots": sorted(set(opened) - closed),
+                "recompiles": sum(1 for e in evts
+                                  if e.get("name") == "jit.compile")}
+
+    if args.obs_overhead:
+        import time as _time
+
+        from paddle_tpu import obs
+        srv = llama_serving_decode_factory(
+            model, max_len=max_len, page_size=page_size,
+            n_pool_pages=slots * (max_len // page_size) + 1,
+            batch_capacity=slots, chunked_prefill=page_size)
+        device = str(jax.devices()[0])
+        trace = synthesize_trace(
+            seed=args.seed, n_requests=args.requests or 24,
+            arrival="poisson", mean_interarrival=inter,
+            prompt_len=prompt_rng, output_len=out_rng,
+            vocab_size=cfg.vocab_size, rid_prefix="o")
+        # fixed clock: the jitted work per replay is then identical
+        # across arms — the WALL delta between arms is pure obs tax
+        tracer = obs.Tracer()
+        engines = {
+            "noobs": ServingEngine(serving=srv, slots=slots,
+                                   policy="paged", clock="fixed"),
+            "off": ServingEngine(serving=srv, slots=slots,
+                                 policy="paged", clock="fixed"),
+            "on": ServingEngine(serving=srv, slots=slots,
+                                policy="paged", clock="fixed",
+                                trace=tracer),
+        }
+        engines["off"].run(trace)  # warm every program shape
+        R = max(1, args.obs_repeats)
+        walls = {k: [] for k in engines}
+        tokens = {}
+        try:
+            for _ in range(R):  # interleaved so drift hits all arms
+                for name, eng in engines.items():
+                    if name == "noobs":
+                        obs.REGISTRY.disable()
+                    else:
+                        obs.REGISTRY.enable()
+                    t0 = _time.perf_counter()
+                    res = eng.run(trace)
+                    walls[name].append(_time.perf_counter() - t0)
+                    tokens[name] = res.report()["generated_tokens"]
+        finally:
+            obs.REGISTRY.enable()
+        noobs, off, on = (min(walls[k]) for k in ("noobs", "off", "on"))
+        row = {
+            "bench": "obs_overhead", "device": device,
+            "seed": args.seed, "policy": "paged", "clock": "fixed",
+            "repeats": R, "requests": len(trace),
+            "tokens": tokens["off"],
+            "tokens_match": len(set(tokens.values())) == 1,
+            "noobs_wall_s": round(noobs, 6),
+            "off_wall_s": round(off, 6),
+            "on_wall_s": round(on, 6),
+            "overhead_off": round(off / noobs - 1.0, 6),
+            "overhead_on": round(on / noobs - 1.0, 6),
+            "trace_events": len(tracer),
+        }
+        print(json.dumps(row), flush=True)
+        return 0
+
     if args.qos:
         from paddle_tpu.serving import (QoSScheduler,
                                         synthesize_overload_trace)
@@ -136,16 +244,24 @@ def main(argv=None):
         weights = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
         tight = [r.rid for r in trace if r.rid.endswith(".tight")]
         rows = {}
-        for name, sched in (("fifo", None),
-                            ("qos", QoSScheduler(
-                                tenant_weights=weights))):
+        obs_row = None
+        arms = [("fifo", None),
+                ("qos", QoSScheduler(tenant_weights=weights))]
+        if args.trace_out:
+            # run the TRACED qos arm first, cold: the decode/prefill
+            # compiles then land in its trace as jit.compile events
+            # (fixed clock -> run order cannot change any row)
+            arms.reverse()
+        for name, sched in arms:
             # fixed clock: the QoS claim is about SCHEDULING under a
             # deterministic cost model, not wall speed — the same
             # seeded trace replays bit-identically on any machine
             eng = ServingEngine(serving=srv, slots=slots,
                                 policy="paged",
                                 decode_chunk=args.decode_chunk,
-                                clock="fixed", scheduler=sched)
+                                clock="fixed", scheduler=sched,
+                                trace=args.trace_out
+                                if name == "qos" else None)
             res = eng.run(trace)
             rec = res.metrics.to_record(
                 policy="paged", tenant_weights=weights, device=device,
@@ -166,7 +282,14 @@ def main(argv=None):
             rec["slo_tight_attained"] = round(hits / n, 4) if n \
                 else None
             rows[name] = rec
-            print(json.dumps(rec), flush=True)
+            if res.trace is not None:
+                obs_row = obs_trace_row(res.trace, args.trace_out)
+        # emission order stays fifo -> qos -> obs regardless of which
+        # arm ran first for trace warmth
+        for name in ("fifo", "qos"):
+            print(json.dumps(rows[name]), flush=True)
+        if obs_row is not None:
+            print(json.dumps(obs_row), flush=True)
         f, q = rows["fifo"], rows["qos"]
         ftps = f.get("goodput_tokens_per_sec") or 0.0
         qtps = q.get("goodput_tokens_per_sec") or 0.0
@@ -222,12 +345,18 @@ def main(argv=None):
         slo["slo_tpot"] = args.slo_tpot
 
     rows, outputs, decisions = {}, {}, {}
-    for pol in [p.strip() for p in args.policies.split(",") if p.strip()]:
+    for k, pol in enumerate([p.strip()
+                             for p in args.policies.split(",")
+                             if p.strip()]):
         eng = ServingEngine(serving=srv, slots=slots, policy=pol,
                             decode_chunk=args.decode_chunk,
-                            clock="measured")
+                            clock="measured",
+                            trace=args.trace_out if k == 0 else None)
         eng.run(trace)                 # warmup: compile every shape
-        res = eng.run(trace)           # measured replay
+        res = eng.run(trace)           # measured replay (re-exports
+        #                                the trace over the warmup's)
+        if res.trace is not None:
+            emit(obs_trace_row(res.trace, args.trace_out))
         routed_waves = {}
         for d in res.decisions:
             routed_waves[d["backend"]] = \

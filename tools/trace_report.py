@@ -1,0 +1,223 @@
+"""Summarize a serving chrome-trace JSON (obs.Tracer export) offline.
+
+The trace a `ServingEngine(trace=...)` / `serving_workload_bench.py
+--trace-out` run writes answers "what happened to THIS request" — this
+tool turns it into the four summaries an on-call actually asks for:
+
+- **per-request waterfall**: arrival -> admit -> first token -> finish
+  per rid (outcome + deadline-relevant gaps), drawn as an ASCII gantt.
+- **top recompiles**: every `jit.compile` instant, grouped by site,
+  sorted by wall cost — the "which recompile blew up TTFT" view.
+- **shed timeline**: scheduler rejections in time order with reasons.
+- **slot occupancy**: busy% per decode slot track — idle slots mean
+  admission (not compute) is the bottleneck.
+
+Run:  python tools/trace_report.py trace.json
+      python tools/trace_report.py trace.json --json   # machine row
+      python tools/trace_report.py trace.json --width 60 --top 5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_trace(path: str) -> list:
+    with open(path) as f:
+        d = json.load(f)
+    evts = d.get("traceEvents")
+    if not isinstance(evts, list):
+        raise ValueError(f"{path}: not a chrome trace (no traceEvents)")
+    return evts
+
+
+def track_names(events: list) -> dict:
+    """tid -> track name from thread_name metadata."""
+    return {e["tid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+
+
+def request_rows(events: list, tracks: dict) -> list:
+    """One row per request root (async b/e pair), with the admit and
+    first-token instants folded in."""
+    rows: dict = {}
+    for e in events:
+        if e.get("cat") != "request":
+            continue
+        rid = e.get("id")
+        r = rows.setdefault(rid, {"rid": rid})
+        if e["ph"] == "b":
+            r["arrival"] = e["ts"]
+            r["track"] = tracks.get(e["tid"], str(e["tid"]))
+            r.update({k: v for k, v in e.get("args", {}).items()})
+        elif e["ph"] == "e":
+            r["finish"] = e["ts"]
+            r.update({k: v for k, v in e.get("args", {}).items()})
+    for e in events:
+        if e.get("ph") != "i":
+            continue
+        rid = e.get("args", {}).get("rid")
+        if rid is None or rid not in rows:
+            continue
+        if e["name"] == "admit":
+            rows[rid]["admit"] = e["ts"]
+            rows[rid].setdefault("backend",
+                                 e.get("args", {}).get("backend"))
+        elif e["name"] == "first_token":
+            rows[rid]["first_token"] = e["ts"]
+    out = sorted(rows.values(),
+                 key=lambda r: (r.get("arrival", 0.0), r["rid"]))
+    return out
+
+
+def recompiles(events: list) -> list:
+    return sorted(
+        ({"site": e.get("args", {}).get(
+            "site", e.get("args", {}).get("fn", "?")),
+          "t": e["ts"], "wall_s": e.get("args", {}).get("wall_s", 0.0),
+          "rid": e.get("args", {}).get("rid")}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") == "jit.compile"),
+        key=lambda r: -float(r["wall_s"] or 0.0))
+
+
+def sheds(events: list) -> list:
+    return sorted(
+        ({"t": e["ts"], **e.get("args", {})}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") == "shed"),
+        key=lambda r: r["t"])
+
+
+def slot_occupancy(events: list, tracks: dict) -> dict:
+    """slot track -> busy fraction of the trace span (X spans only)."""
+    xs = [e for e in events if e.get("ph") == "X"]
+    if not xs:
+        return {}
+    t0 = min(e["ts"] for e in xs)
+    t1 = max(e["ts"] + e.get("dur", 0.0) for e in xs)
+    span = max(t1 - t0, 1e-12)
+    out = {}
+    for tid, name in sorted(tracks.items()):
+        if not name.startswith("slot/"):
+            continue
+        busy = sum(e.get("dur", 0.0) for e in xs if e["tid"] == tid)
+        out[name] = round(min(busy / span, 1.0), 4)
+    return out
+
+
+def _gantt(r: dict, t0: float, span: float, width: int) -> str:
+    """arrival..finish bar; '.' queued (arrival->admit), '=' running,
+    '|' first token."""
+    a = r.get("arrival")
+    f = r.get("finish")
+    if a is None or f is None:
+        return "?" * 3
+    col = lambda t: int((t - t0) / span * (width - 1))  # noqa: E731
+    bar = [" "] * width
+    ca, cf = col(a), col(f)
+    for i in range(ca, cf + 1):
+        bar[i] = "."
+    adm = r.get("admit")
+    if adm is not None:
+        for i in range(col(adm), cf + 1):
+            bar[i] = "="
+    ft = r.get("first_token")
+    if ft is not None:
+        bar[col(ft)] = "|"
+    return "".join(bar)
+
+
+def summarize(events: list) -> dict:
+    tracks = track_names(events)
+    reqs = request_rows(events, tracks)
+    comp = recompiles(events)
+    sh = sheds(events)
+    occ = slot_occupancy(events, tracks)
+    open_roots = [r["rid"] for r in reqs if "finish" not in r
+                  or "arrival" not in r]
+    outcomes: dict = {}
+    for r in reqs:
+        o = r.get("outcome", "?")
+        outcomes[o] = outcomes.get(o, 0) + 1
+    return {"bench": "trace_report", "requests": len(reqs),
+            "open_roots": open_roots, "outcomes": outcomes,
+            "recompiles": len(comp),
+            "recompile_wall_s": round(sum(
+                float(c["wall_s"] or 0.0) for c in comp), 6),
+            "sheds": len(sh), "slot_occupancy": occ,
+            "tracks": sorted(tracks.values())}
+
+
+def report(events: list, width: int = 50, top: int = 10) -> str:
+    tracks = track_names(events)
+    reqs = request_rows(events, tracks)
+    lines = []
+    if reqs:
+        ts = [r["arrival"] for r in reqs if "arrival" in r] + \
+            [r["finish"] for r in reqs if "finish" in r]
+        t0, t1 = min(ts), max(ts)
+        span = max(t1 - t0, 1e-12)
+        lines.append(f"== per-request waterfall ({len(reqs)} requests, "
+                     f"span {span / 1e6:.4f}s; . queued  = running  "
+                     f"| first token) ==")
+        for r in reqs:
+            out = r.get("outcome", "?")
+            ttft = ""
+            if "first_token" in r and "arrival" in r:
+                ttft = f" ttft={(r['first_token'] - r['arrival']) / 1e6:.4f}"
+            lines.append(
+                f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
+                f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}")
+    comp = recompiles(events)
+    lines.append(f"\n== recompiles ({len(comp)}) ==")
+    by_site: dict = {}
+    for c in comp:
+        s = by_site.setdefault(c["site"], [0, 0.0])
+        s[0] += 1
+        s[1] += float(c["wall_s"] or 0.0)
+    for site, (n, wall) in sorted(by_site.items(),
+                                  key=lambda kv: -kv[1][1]):
+        lines.append(f"  {site:20s} x{n:<3d} wall {wall:.3f}s")
+    for c in comp[:top]:
+        lines.append(f"  t={c['t'] / 1e6:.4f}s {c['site']:16s} "
+                     f"wall={float(c['wall_s'] or 0):.3f}s"
+                     + (f" rid={c['rid']}" if c.get("rid") else ""))
+    sh = sheds(events)
+    lines.append(f"\n== shed timeline ({len(sh)}) ==")
+    for s in sh[:top * 2]:
+        lines.append(f"  t={s['t'] / 1e6:.4f}s {s.get('rid', '?'):20s} "
+                     f"tenant={s.get('tenant')} :: {s.get('reason')}")
+    occ = slot_occupancy(events, track_names(events))
+    lines.append("\n== slot occupancy ==")
+    for name, frac in sorted(occ.items()):
+        bar = "#" * int(frac * 30)
+        lines.append(f"  {name:8s} {frac:7.1%} {bar}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="chrome-trace JSON (obs.Tracer export)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable summary row instead")
+    ap.add_argument("--width", type=int, default=50)
+    ap.add_argument("--top", type=int, default=10)
+    args = ap.parse_args(argv)
+    try:
+        events = load_trace(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"bench": "trace_report", "error": str(e)}))
+        return 1
+    if args.json:
+        print(json.dumps(summarize(events)))
+    else:
+        print(report(events, width=args.width, top=args.top))
+        print()
+        print(json.dumps(summarize(events)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
