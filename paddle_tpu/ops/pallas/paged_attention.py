@@ -221,28 +221,85 @@ class PagedKVCache:
         self._free = list(range(n_pages - 1, 0, -1))  # page 0 = padding
         self.tables: dict = {}
         self.lengths: dict = {}
-        # prefix cache (~ vLLM automatic prefix caching): FULL pages of
-        # identical token prefixes are shared across sequences. Key =
-        # (parent_page_or_0, tuple of page tokens) -> page id; refcounts
-        # keep shared pages alive until every user frees them.
+        # prefix cache (~ vLLM automatic prefix caching / SGLang
+        # RadixAttention, flattened to exact-match chain hashing): FULL
+        # pages of identical token prefixes are shared across sequences.
+        # Key = (parent_page_or_0, tuple of page tokens) -> page id;
+        # refcounts keep shared pages alive while anyone holds them.
+        # RETENTION: a published page whose refcount hits 0 does NOT
+        # return to the free list — it parks in the evictable LRU pool,
+        # key intact, so a later identical prefix revives it for free.
+        # allocate() reclaims from the LRU leaf-first only once the
+        # free list runs dry (a parent page never dies before its
+        # children — the chain invariant that keeps recycled page ids
+        # from ever matching stale child keys).
         self._prefix: dict = {}
-        self._refs: dict = {}
-        self._page_key: dict = {}  # page id -> its prefix key
-        self._children: dict = {}  # page id -> keys with it as parent
+        self._refs: dict = {}       # page id -> holders (resident set)
+        self._page_key: dict = {}   # page id -> its prefix key
+        self._children: dict = {}   # page id -> keys with it as parent
+        self._evictable: dict = {}  # page id -> True; insertion = LRU
+        self._stats = {"hit_tokens": 0, "lookup_tokens": 0,
+                       "evictions": 0}
 
     def allocate(self, seq_id, n_tokens: int):
-        """Reserve pages so ``seq_id`` can hold n_tokens total."""
+        """Reserve pages so ``seq_id`` can hold n_tokens total. The
+        free list is spent first; evictable LRU pages are reclaimed
+        leaf-first only when it dries. MemoryError fires only when
+        free + evictable together cannot cover the need (and mutates
+        nothing, so a caller can free()/requeue safely)."""
         table = self.tables.setdefault(seq_id, [])
         need = -(-n_tokens // self.page_size) - len(table)
-        if need > len(self._free):
+        if need > len(self._free) + len(self._evictable):
             raise MemoryError(
                 f"paged cache exhausted: need {need} pages, "
-                f"{len(self._free)} free")
+                f"{len(self._free)} free + {len(self._evictable)} "
+                f"evictable")
         for _ in range(max(0, need)):
+            if not self._free:
+                self._evict_lru()
             p = self._free.pop()
             self._refs[p] = 1
             table.append(p)
         return table
+
+    def _evict_lru(self):
+        """Reclaim ONE evictable page onto the free list: the least-
+        recently-parked page with no LIVE child key (leaf-first). The
+        chain invariant — an acquirer always holds a page's parents,
+        so refs(parent) >= refs(child) — means an evictable page's
+        children are evictable too: a leaf always exists and parents
+        are never reclaimed before their children."""
+        for p in self._evictable:
+            kids = self._children.get(p)
+            if kids and any(k in self._prefix for k in kids):
+                continue  # still a parent of live keys: not a leaf
+            del self._evictable[p]
+            self._drop_keys(p)
+            self._stats["evictions"] += 1
+            self._free.append(p)
+            return
+        raise MemoryError("no evictable leaf page")  # unreachable by
+        # the chain invariant (kept as a loud guard, not a code path)
+
+    def _drop_keys(self, p):
+        """Forget page ``p``'s prefix identity before its id recycles:
+        its own key, its membership in the parent's child set, and —
+        the wrong-context-KV hazard — every key chained THROUGH it
+        (a future sequence must never match stale children under the
+        recycled id and share unrelated K/V)."""
+        key = self._page_key.pop(p, None)
+        if key is not None:
+            self._prefix.pop(key, None)
+            sibs = self._children.get(key[0])
+            if sibs is not None:
+                sibs.discard(key)
+                if not sibs:
+                    self._children.pop(key[0], None)
+        for ck in self._children.pop(p, ()):
+            page_c = self._prefix.pop(ck, None)
+            if page_c is not None \
+                    and self._page_key.get(page_c) == ck:
+                self._page_key.pop(page_c, None)
 
     def acquire_prefix(self, seq_id, tokens) -> int:
         """Match ``tokens`` against cached FULL prompt pages; matched
@@ -258,21 +315,58 @@ class PagedKVCache:
                 f"acquire_prefix: {seq_id!r} already holds pages — "
                 "free() it first (e.g. after a failed allocate)")
         table = self.tables.setdefault(seq_id, [])
+        n = 0
+        for page in self._chain(tokens):
+            if page in self._evictable:
+                del self._evictable[page]  # revival: LRU -> resident
+            self._refs[page] = self._refs.get(page, 0) + 1
+            table.append(page)
+            n += self.page_size
+        self._stats["hit_tokens"] += n
+        self._stats["lookup_tokens"] += \
+            (len(tokens) // self.page_size) * self.page_size
+        # write()/decode append after the cached prefix, never inside it
+        self.lengths[seq_id] = n
+        return n
+
+    def rollback_acquire(self, seq_id, tokens):
+        """Leak-proof admit rollback for acquire_prefix -> failed
+        allocate: free ``seq_id`` (shared refs released, revived pages
+        re-parked evictable) AND unwind the hit/lookup stats the
+        acquire recorded — a rolled-back admit was never served from
+        cache, and double counting would inflate hit_rate exactly
+        under the pool pressure blocked waves retry in. Valid only
+        while the table still holds ONLY acquired pages (allocate
+        failed without mutating)."""
+        n_cached = len(self.tables.get(seq_id, ())) * self.page_size
+        self.free(seq_id)
+        self._stats["hit_tokens"] -= n_cached
+        self._stats["lookup_tokens"] -= \
+            (len(tokens) // self.page_size) * self.page_size
+
+    def _chain(self, tokens):
+        """Walk the published chain for ``tokens`` from the root,
+        yielding each matched page — the ONE matcher under both
+        acquire_prefix and match_prefix, so acquisition and admission
+        pricing can never disagree on what the cache serves."""
         parent = 0
         n = 0
         ps = self.page_size
         while n + ps <= len(tokens):
-            key = (parent, tuple(int(t) for t in tokens[n:n + ps]))
-            page = self._prefix.get(key)
+            page = self._prefix.get(
+                (parent, tuple(int(t) for t in tokens[n:n + ps])))
             if page is None:
-                break
-            self._refs[page] = self._refs.get(page, 0) + 1
-            table.append(page)
+                return
+            yield page
             parent = page
             n += ps
-        # write()/decode append after the cached prefix, never inside it
-        self.lengths[seq_id] = n
-        return n
+
+    def match_prefix(self, tokens) -> int:
+        """Non-acquiring probe: how many leading tokens of ``tokens``
+        the cache could serve right now (a page multiple). No refcount,
+        LRU, or stats mutation — safe for a scheduler to call per
+        admission turn to price prefill work before committing."""
+        return sum(self.page_size for _ in self._chain(tokens))
 
     def register_prefix(self, seq_id, tokens):
         """Publish seq_id's FULL prompt pages (now holding real K/V) for
@@ -288,8 +382,12 @@ class PagedKVCache:
             if existing is None:
                 self._prefix[key] = page
                 self._page_key[page] = key
-                self._children.setdefault(parent, set()).add(key) \
-                    if parent else None
+                # root (parent == 0) keys are tracked too: _children is
+                # the leaf test's reverse index as well as the stale-key
+                # invalidator, so EVERY published key must sit under its
+                # parent (page 0 is never recycled, but its child set
+                # must shrink as root keys die or it leaks forever)
+                self._children.setdefault(parent, set()).add(key)
             parent = self._prefix[key]
 
     def write(self, seq_id, k_new, v_new):
@@ -322,22 +420,48 @@ class PagedKVCache:
             rc = self._refs.get(p, 1) - 1
             if rc <= 0:
                 self._refs.pop(p, None)
-                key = self._page_key.pop(p, None)
-                if key is not None:
-                    self._prefix.pop(key, None)
-                # a dead page's id may be recycled: every prefix key
-                # chained THROUGH it must die with it, or a future
-                # sequence could match stale children under the
-                # recycled id and share wrong-context K/V
-                for ck in self._children.pop(p, ()):  # noqa: B007
-                    page_c = self._prefix.pop(ck, None)
-                    if page_c is not None \
-                            and self._page_key.get(page_c) == ck:
-                        self._page_key.pop(page_c, None)
-                self._free.append(p)
+                if p in self._page_key:
+                    # retention: a PUBLISHED page outlives its last
+                    # holder — park it in the evictable LRU pool with
+                    # its key live, so a recurring prefix revives it
+                    # instead of re-prefilling; allocate() reclaims it
+                    # leaf-first only under free-list pressure
+                    self._evictable[p] = True
+                else:
+                    self._drop_keys(p)  # stale-chain invalidation for
+                    # the recycled id (unpublished pages normally have
+                    # no keys; kept defensive)
+                    self._free.append(p)
             else:
                 self._refs[p] = rc
         self.lengths.pop(seq_id, None)
+
+    def census_ok(self) -> bool:
+        """The accounting invariant in one place: every usable page
+        (page 0 is reserved padding) is exactly one of resident /
+        evictable / free. The serving engine samples this each turn;
+        the serving_prefix bench gate fails if it ever broke."""
+        return (len(self._refs) + len(self._evictable)
+                + len(self._free)) == int(self.k_pages.shape[1]) - 1
+
+    def cache_stats(self) -> dict:
+        """Prefix-cache accounting: cumulative hit/lookup tokens and
+        evictions plus the live page census. The census satisfies
+        ``resident + evictable + free == n_pages - 1`` at all times
+        (page 0 is the reserved padding page) — the invariant the
+        serving bench gate checks."""
+        hit = self._stats["hit_tokens"]
+        lookup = self._stats["lookup_tokens"]
+        return {
+            "n_pages": int(self.k_pages.shape[1]) - 1,
+            "resident_pages": len(self._refs),
+            "evictable_pages": len(self._evictable),
+            "free_pages": len(self._free),
+            "hit_tokens": hit,
+            "lookup_tokens": lookup,
+            "hit_rate": round(hit / lookup, 4) if lookup else 0.0,
+            "evictions": self._stats["evictions"],
+        }
 
     def batch_views(self, seq_ids):
         """(page_tables (B, max_pages), seq_lens (B,)) padded with the

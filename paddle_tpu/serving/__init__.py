@@ -22,5 +22,6 @@ from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401
                         ServiceEstimator)
 from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        load_trace, merge_traces, save_trace,
-                       synthesize_overload_trace, synthesize_trace,
-                       trace_stats)
+                       synthesize_overload_trace,
+                       synthesize_recurring_prefix_trace,
+                       synthesize_trace, trace_stats)

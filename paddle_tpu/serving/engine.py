@@ -77,10 +77,19 @@ class EngineClock:
     def advance_to(self, t: float):
         self.t = max(self.t, t)
 
-    def timed(self, kind: str, fn):
+    def timed(self, kind: str, fn, units: Optional[int] = None):
+        """``units`` (work items, e.g. prefill chunks computed) prices
+        a fixed-clock action per unit WHEN the cost table carries a
+        ``<kind>_unit`` entry — the honest clock for prefix caching,
+        where a cache hit skips real work. Without that entry (or
+        units) the flat per-call cost keeps legacy replays
+        bit-identical; a measured clock always charges wall time."""
         if self.mode == "fixed":
             out = fn()
-            self.t += float(self.costs.get(kind, 1.0))
+            if units is not None and f"{kind}_unit" in self.costs:
+                self.t += float(self.costs[f"{kind}_unit"]) * units
+            else:
+                self.t += float(self.costs.get(kind, 1.0))
             return out
         t0 = time.perf_counter()
         out = fn()
@@ -152,11 +161,19 @@ class ServeResult:
     slot_log: List[tuple]           # (t, "acquire"|"release", rid, slot)
     prefix_cached: Dict[str, int]   # rid -> prompt tokens prefix-cache hit
     pages_total: int
-    pages_free_end: int
+    pages_free_end: int             # RECLAIMABLE pages at run end:
+    # free list + evictable LRU (a retained prefix page is capacity,
+    # not a leak — it frees itself under allocation pressure)
     scheduler: str = "fifo"         # admission discipline that ran
     shed: Dict[str, str] = dataclasses.field(default_factory=dict)
     # rid -> shed reason (QoS scheduler only; FIFO never sheds)
     trace: Optional[object] = None  # obs.Tracer when the run traced
+    prefill_tokens: int = 0         # prompt tokens actually prefilled
+    # (padded, minus the cache-resumed chunks) across paged admits
+    cache_stats: Dict = dataclasses.field(default_factory=dict)
+    # PagedKVCache.cache_stats() at run end + "invariant_ok": the
+    # resident+evictable+free == pool-size census, sampled every
+    # engine turn
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -270,6 +287,13 @@ class ServingEngine:
     decode slot, prefill/decode work on the engine track, scheduler
     decisions + jit recompiles as instants. Outputs, metrics records
     and logs are byte-identical with tracing on or off.
+    ``prefix_cache``: True (default) makes prefix reuse AUTOMATIC for
+    every paged admit — acquire before allocate, register after
+    prefill, no ``prefix_group`` tag needed (the tag stays a routing
+    hint only); freed prompt pages are RETAINED in the pool's
+    evictable LRU, so a recurring system prompt skips its cached
+    prefill chunks even after every earlier sharer finished. False
+    disables all acquisition/retention (the bench's cache-off arm).
     """
 
     def __init__(self, model=None, *, serving=None, slots: int = 4,
@@ -282,7 +306,8 @@ class ServingEngine:
                  kv_cache_dtype: Optional[str] = None,
                  scan_layers: bool = True,
                  expect_churn: Optional[bool] = None,
-                 scheduler=None, trace=None):
+                 scheduler=None, trace=None,
+                 prefix_cache: bool = True):
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -352,6 +377,16 @@ class ServingEngine:
         self._ctr_compiles = _c("serving_jit_compiles_total",
                                 "jit program-cache compiles observed "
                                 "by the engine")
+        self._ctr_prefix_hits = _c("serving_prefix_hit_tokens_total",
+                                   "prompt tokens served from the "
+                                   "prefix cache")
+        self._ctr_prefix_evictions = _c(
+            "serving_prefix_evictions_total",
+            "prefix pages reclaimed from the evictable LRU pool")
+        self._g_resident = obs_metrics.REGISTRY.gauge(
+            "serving_prefix_resident_pages",
+            "pool pages held by live sequences")
+        self.prefix_cache = bool(prefix_cache)
         self.decode_chunk = decode_chunk
         self.clock_mode = clock
         self.fixed_costs = fixed_costs
@@ -430,7 +465,7 @@ class ServingEngine:
                           if k != "t"})
 
     def _timed(self, tr, clock, kind, fn, jitfn=None, rid=None,
-               **attrs):
+               units=None, **attrs):
         """``clock.timed`` plus, when tracing, a span in virtual time
         (wall seconds as an attr) and jit-recompile detection: the
         wrapped program cache growing across the call means THIS call
@@ -442,9 +477,9 @@ class ServingEngine:
             # the registry kill-switch is down (the no-obs arm);
             # detection is two cache-size reads around the call
             if jitfn is None or not obs_metrics.REGISTRY.enabled:
-                return clock.timed(kind, fn)
+                return clock.timed(kind, fn, units)
             c0 = _jit_cache_size(jitfn)
-            out = clock.timed(kind, fn)
+            out = clock.timed(kind, fn, units)
             if c0 is not None:
                 c1 = _jit_cache_size(jitfn)
                 if c1 is not None and c1 > c0:
@@ -456,9 +491,9 @@ class ServingEngine:
         scope = obs_trace.trace_scope(rid) if rid is not None else None
         if scope is not None:
             with scope:
-                out = clock.timed(kind, fn)
+                out = clock.timed(kind, fn, units)
         else:
-            out = clock.timed(kind, fn)
+            out = clock.timed(kind, fn, units)
         wall = time.perf_counter() - w0
         if rid is not None:
             attrs["rid"] = rid
@@ -485,6 +520,36 @@ class ServingEngine:
     def _footprint(self, r: Request) -> int:
         return self._pad_len(len(r.prompt)) + r.max_new_tokens \
             + self.decode_chunk
+
+    def _order_wave(self, wave) -> List[Request]:
+        """Cache-aware co-scheduling for the FIFO loop's PAGED branch:
+        requests whose prompts open with the same first page become
+        ADJACENT (groups in first-arrival order, members in their
+        incoming order), so when slots run out mid-wave a cohort is
+        admitted together — its publisher registers before the
+        siblings prefill (register-then-acquire) and the shared pages
+        stay resident while every sharer needs them. Prompts that
+        share no page keep their order exactly (every group is a
+        singleton), so plain traces replay bit-identically. Routing,
+        dense waves and the QoS loop never see this reordering: dense
+        has no page cache to win, and the QoS scheduler's
+        priority/WFQ order is authoritative (cache awareness enters
+        its admission through ``ServiceEstimator.prefill_cost``
+        pricing instead, so adjacency can never invert a priority
+        decision)."""
+        if not self.prefix_cache or len(wave) < 2:
+            return list(wave)
+        ps = self.page_size
+        groups: Dict = {}
+        order: List = []
+        for i, r in enumerate(wave):
+            key = tuple(r.prompt[:ps]) if len(r.prompt) >= ps \
+                else ("short", i)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(r)
+        return [r for k in order for r in groups[k]]
 
     def _validate(self, trace):
         for r in trace:
@@ -516,6 +581,8 @@ class ServingEngine:
         slot_log: List[tuple] = []
         prefix_cached: Dict[str, int] = {}
         seen_groups: set = set()
+        prefill_tokens = 0
+        inv_ok = True
         expect_churn = self._expect_churn if self._expect_churn \
             is not None else any(r.cancel_after is not None
                                  for r in trace)
@@ -567,11 +634,17 @@ class ServingEngine:
                                              tr=tr)
                         progressed = True
                     else:
-                        n_adm = self._admit_paged(
+                        # only the paged ADMISSION order is cache-
+                        # reordered (routing and the decision log keep
+                        # arrival order)
+                        wave = self._order_wave(wave)
+                        n_adm, _, ptoks = self._admit_paged(
                             wave, book, clock, m, active, free_slots,
                             slot_log, prefix_cached, seen_groups,
                             outputs, tr=tr)
-                        del waiting[:n_adm]
+                        prefill_tokens += ptoks
+                        for r in wave[:n_adm]:  # possibly reordered —
+                            waiting.remove(r)   # remove by identity
                         progressed = n_adm > 0
                         if n_adm:
                             # a BLOCKED wave (no slots/pages yet) is not a
@@ -579,6 +652,11 @@ class ServingEngine:
                             # frees; logging every retry turn would inflate
                             # the per-wave statistics the bench reports
                             decision["admitted"] = n_adm
+                            # prompt_lens above is ARRIVAL order; the
+                            # cache reorder means the first-n slice no
+                            # longer names the admitted set — the rids do
+                            decision["admit_rids"] = \
+                                [r.rid for r in wave[:n_adm]]
                             decisions.append(decision)
                             self._wave_instant(tr, decision)
                         elif not active:
@@ -601,6 +679,7 @@ class ServingEngine:
                         targets.append(waiting[0].arrival
                                        + self.admission.max_delay)
                     clock.advance_to(min(targets))
+                inv_ok &= book.census_ok()
         finally:
             if tr is not None:
                 if prev_tr is not None:
@@ -612,7 +691,11 @@ class ServingEngine:
                            metrics=m, decisions=decisions,
                            slot_log=slot_log, prefix_cached=prefix_cached,
                            pages_total=pages_total,
-                           pages_free_end=len(book._free), trace=tr)
+                           pages_free_end=(len(book._free)
+                                           + len(book._evictable)),
+                           trace=tr, prefill_tokens=prefill_tokens,
+                           cache_stats=dict(book.cache_stats(),
+                                            invariant_ok=inv_ok))
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -637,8 +720,16 @@ class ServingEngine:
         clock = EngineClock(self.clock_mode, self.fixed_costs)
         tr = self._make_tracer(clock)
         costs = self.fixed_costs or {}
+        est_kw = {}
+        if "prefill_unit" in costs:
+            # per-chunk clock pricing -> per-chunk admission pricing
+            # (the feasibility check then sees exactly what the clock
+            # will charge, cached chunks excluded)
+            est_kw = {"prefill_unit": costs["prefill_unit"],
+                      "chunk_tokens": self.chunk_C}
         est = ServiceEstimator(prefill=costs.get("prefill", 1.0),
-                               decode=costs.get("decode", 1.0))
+                               decode=costs.get("decode", 1.0),
+                               **est_kw)
         m = MetricsCollector()
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
@@ -652,6 +743,8 @@ class ServingEngine:
         prefix_cached: Dict[str, int] = {}
         shed_log: Dict[str, str] = {}
         seen_groups: set = set()
+        prefill_tokens = 0
+        inv_ok = True
         expect_churn = self._expect_churn if self._expect_churn \
             is not None else any(r.cancel_after is not None
                                  for r in trace)
@@ -694,8 +787,15 @@ class ServingEngine:
                     dec = sched.select(now,
                                        max_batch=self.admission.max_batch,
                                        est=est,
-                                       decode_chunk=self.decode_chunk)
+                                       decode_chunk=self.decode_chunk,
+                                       match_prefix=(book.match_prefix
+                                                     if self.prefix_cache
+                                                     else None))
                     progressed |= _shed(dec.shed)
+                    # the scheduler's priority/WFQ order is kept as-is:
+                    # its feasibility estimates assumed it, and a cache
+                    # reorder could hand a scarce slot to a lower class
+                    # (cache awareness is in the select() pricing)
                     wave = dec.wave
                     if wave:
                         groups = [r.prefix_group for r in wave
@@ -722,13 +822,18 @@ class ServingEngine:
                             progressed = True
                         else:
                             t0 = clock.now()
-                            n_adm = self._admit_paged(
+                            n_adm, n_chunks, ptoks = self._admit_paged(
                                 wave, book, clock, m, active, free_slots,
                                 slot_log, prefix_cached, seen_groups,
                                 outputs, tr=tr)
+                            prefill_tokens += ptoks
                             if n_adm:
-                                est.observe("prefill",
-                                            (clock.now() - t0) / n_adm)
+                                dt = clock.now() - t0
+                                est.observe("prefill", dt / n_adm)
+                                if n_chunks and "prefill_unit" \
+                                        in est.costs:
+                                    est.observe("prefill_unit",
+                                                dt / n_chunks)
                                 self._commit_wave(wave[:n_adm], dec,
                                                   sched, m, tr=tr,
                                                   t=clock.now())
@@ -768,6 +873,7 @@ class ServingEngine:
                     if not targets:
                         break  # everything left this turn was shed
                     clock.advance_to(min(targets))
+                inv_ok &= book.census_ok()
         finally:
             if tr is not None:
                 if prev_tr is not None:
@@ -780,9 +886,12 @@ class ServingEngine:
                            slot_log=slot_log,
                            prefix_cached=prefix_cached,
                            pages_total=pages_total,
-                           pages_free_end=len(book._free),
+                           pages_free_end=(len(book._free)
+                                           + len(book._evictable)),
                            scheduler=sched.name, shed=shed_log,
-                           trace=tr)
+                           trace=tr, prefill_tokens=prefill_tokens,
+                           cache_stats=dict(book.cache_stats(),
+                                            invariant_ok=inv_ok))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -811,20 +920,45 @@ class ServingEngine:
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
                      slot_log, prefix_cached, seen_groups, outputs,
-                     tr=None) -> int:
+                     tr=None):
+        """Returns (admitted, prefill chunks computed, prefill tokens
+        computed) for this wave."""
         admitted = 0
+        chunks_done = 0
+        tokens_done = 0
         for r in wave:
             if not free_slots:
                 break
             sid = r.rid
+            # AUTOMATIC prefix acquisition: every request probes the
+            # pool's chain-hashed page cache (page-aligned exact match
+            # gives token-level sharing with no trace tag;
+            # prefix_group stays a routing hint only). A failed
+            # allocate below MUST release these shared refs — the
+            # free() in the except arm is the leak-proof rollback,
+            # returning revived pages to the evictable pool so the
+            # requeue retries from a clean slate.
             n_cached = 0
-            if r.prefix_group is not None:
+            if self.prefix_cache:
                 n_cached = book.acquire_prefix(sid, list(r.prompt))
+            ev0 = book._stats["evictions"]
             try:
                 book.allocate(sid, self._footprint(r))
             except MemoryError:
-                book.free(sid)  # release any shared prefix refs
+                if self.prefix_cache:
+                    # shared refs released, revived pages re-parked,
+                    # hit/lookup stats unwound (the requeue must not
+                    # inflate hit_rate)
+                    book.rollback_acquire(sid, list(r.prompt))
+                else:
+                    book.free(sid)
                 break
+            d_ev = book._stats["evictions"] - ev0
+            if d_ev:
+                self._ctr_prefix_evictions.inc(d_ev)
+                if tr is not None:
+                    tr.instant("prefix_evict", t=clock.now(),
+                               track="engine", pages=d_ev, rid=sid)
             book.lengths[sid] = len(r.prompt)
             slot = free_slots.pop(0)
             T = self._pad_len(len(r.prompt))
@@ -835,12 +969,17 @@ class ServingEngine:
             pt[0, :len(table)] = table
             lens = np.asarray([len(r.prompt)], np.int32)
             resume = (n_cached // self.chunk_C) * self.chunk_C
+            # the factory clamps resume so the FINAL chunk always runs
+            # (last-position logits) — charge the clock for what it
+            # actually computes
+            n_chunks = (T - min(resume, T - self.chunk_C)) \
+                // self.chunk_C
             t_admit = clock.now()
             m.on_admit(sid, t_admit, "paged")
             if tr is not None:
                 tr.instant("admit", t=t_admit,
                            track=self._tenant_track(r), rid=sid,
-                           backend="paged", slot=slot)
+                           backend="paged", slot=slot, cached=n_cached)
 
             def _call(toks=toks, pt=pt, lens=lens, resume=resume):
                 return self._p_prefill(
@@ -849,11 +988,20 @@ class ServingEngine:
                     resume_from=resume)
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
-                rid=sid, resume=resume, cached=n_cached)
+                rid=sid, units=n_chunks, resume=resume,
+                cached=n_cached)
             first_tok = int(np.asarray(first)[0])
-            if r.prefix_group is not None:
+            if self.prefix_cache:
                 book.register_prefix(sid, list(r.prompt))
+            if r.prefix_group is not None:
                 seen_groups.add(r.prefix_group)
+            if n_cached:
+                self._ctr_prefix_hits.inc(n_cached)
+            m.on_prefix(sid, cached=n_cached,
+                        saved=min(resume, T - self.chunk_C),
+                        prompt=len(r.prompt))
+            chunks_done += n_chunks
+            tokens_done += n_chunks * self.chunk_C
             row = _PagedRow(r, slot, first_tok, t0=t_admit)
             active[sid] = row
             slot_log.append((round(clock.now(), 6), "acquire", sid, slot))
@@ -869,7 +1017,9 @@ class ServingEngine:
                 self._finish_paged(sid, book, clock, m, active,
                                    free_slots, slot_log, outputs,
                                    tr=tr)
-        return admitted
+        if admitted:
+            self._g_resident.set(float(len(book._refs)))
+        return admitted, chunks_done, tokens_done
 
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
                      outputs, tr=None):
@@ -919,6 +1069,7 @@ class ServingEngine:
                       tr=None):
         st = active.pop(sid)
         book.free(sid)
+        self._g_resident.set(float(len(book._refs)))
         free_slots.append(st.slot)
         free_slots.sort()
         slot_log.append((round(clock.now(), 6), "release", sid, st.slot))

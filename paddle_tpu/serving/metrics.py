@@ -61,6 +61,10 @@ class MetricsCollector:
     def __init__(self):
         self._req: Dict[str, _Req] = {}
         self._queue: List[tuple] = []  # (t, depth)
+        # prefix-cache totals over paged admits (engine-fed); the
+        # report grows its prefix block only when a hit happened, so
+        # plain no-hit traces stay byte-identical
+        self._prefix = {"cached": 0, "saved": 0, "prompt": 0}
 
     # --- events ----------------------------------------------------------
     def on_arrival(self, rid: str, t: float, tenant: Optional[str] = None,
@@ -89,6 +93,14 @@ class MetricsCollector:
         r = self._req[rid]
         r.budget = budget
         r.budget0 = orig_budget
+
+    def on_prefix(self, rid: str, cached: int, saved: int, prompt: int):
+        """``rid`` admitted to the paged backend with ``cached`` of its
+        ``prompt`` tokens found in the prefix cache, of which ``saved``
+        (chunk-aligned) actually skipped prefill compute."""
+        self._prefix["cached"] += cached
+        self._prefix["saved"] += saved
+        self._prefix["prompt"] += prompt
 
     def on_tokens(self, rid: str, t: float, n: int):
         """``n`` tokens materialized at time ``t`` (a decode chunk's
@@ -179,6 +191,14 @@ class MetricsCollector:
             "queue_depth_mean": round(float(np.mean(depths)), 3)
             if depths else 0.0,
         }
+        if self._prefix["cached"] > 0:
+            # the prefix block appears ONLY when the cache actually hit
+            # — a plain no-hit trace keeps the PR-4 record byte-for-byte
+            rec["prefix_cache_hit_tokens"] = self._prefix["cached"]
+            rec["prefix_cache_hit_rate"] = round(
+                self._prefix["cached"] / max(1, self._prefix["prompt"]),
+                4)
+            rec["prefill_tokens_saved"] = self._prefix["saved"]
         if slo_ttft is not None and ttfts:
             rec["slo_ttft"] = slo_ttft
             rec["slo_ttft_attained"] = round(

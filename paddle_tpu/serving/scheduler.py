@@ -53,14 +53,56 @@ class ServiceEstimator:
     machine. ``headroom`` multiplies the decode estimate — co-scheduled
     prefills steal turns from a row's decode stream, so the lone-row
     lower bound is optimistic by design.
+
+    ``prefill_unit`` + ``chunk_tokens`` switch prefill pricing from
+    flat-per-call to per-chunk: ``prefill_cost(n_uncached_tokens)``
+    then scales with the chunks the engine will actually compute —
+    the cache-aware admission price (a request whose prompt is mostly
+    prefix-cache resident is CHEAP, and the feasibility check should
+    know that before shedding it).
     """
 
     def __init__(self, prefill: float = 1.0, decode: float = 1.0,
-                 alpha: float = 0.25):
+                 alpha: float = 0.25,
+                 prefill_unit: Optional[float] = None,
+                 chunk_tokens: Optional[int] = None):
         if prefill <= 0 or decode <= 0:
             raise ValueError("estimator costs must be positive")
         self.costs = {"prefill": float(prefill), "decode": float(decode)}
+        if prefill_unit is not None:
+            if prefill_unit <= 0:
+                raise ValueError("estimator costs must be positive")
+            if not chunk_tokens or chunk_tokens <= 0:
+                raise ValueError("prefill_unit pricing needs "
+                                 "chunk_tokens (the prefill chunk "
+                                 "size in tokens)")
+            self.costs["prefill_unit"] = float(prefill_unit)
+        self.chunk_tokens = int(chunk_tokens) if chunk_tokens else None
         self.alpha = alpha
+
+    def prefill_cost(self, uncached_tokens: Optional[int] = None,
+                     prompt_tokens: Optional[int] = None) -> float:
+        """Admission price of one prefill. Per-chunk pricing with a
+        known uncached length charges exactly the chunks the engine
+        will compute: ``ceil(prompt/chunk) - cached//chunk``, floored
+        at one — the engine resumes at the CHUNK-ALIGNED cached count
+        and the FINAL chunk always runs (the last-position logits
+        must exist), so a cached prefix that is not chunk-aligned
+        still pays for its partial chunk. Without ``prompt_tokens``
+        the uncached length alone approximates (exact when the cache
+        is page==chunk aligned); without per-chunk pricing, or
+        without a probe result, the flat per-call cost keeps the
+        legacy arithmetic exactly."""
+        unit = self.costs.get("prefill_unit")
+        if unit is None or uncached_tokens is None \
+                or self.chunk_tokens is None:
+            return self.prefill
+        c = self.chunk_tokens
+        u = max(0, uncached_tokens)
+        if prompt_tokens is None:
+            return unit * max(1, math.ceil(u / c))
+        total = max(1, math.ceil(prompt_tokens / c))
+        return unit * max(1, total - (prompt_tokens - u) // c)
 
     def observe(self, kind: str, dt: float):
         if dt <= 0:
@@ -203,8 +245,8 @@ class QoSScheduler:
 
     # --- the admission turn ------------------------------------------------
     def select(self, now: float, *, max_batch: int,
-               est: ServiceEstimator, decode_chunk: int = 1) \
-            -> SchedDecision:
+               est: ServiceEstimator, decode_chunk: int = 1,
+               match_prefix=None) -> SchedDecision:
         """Build the next admission wave.
 
         Order: strict effective priority, then WFQ across tenants
@@ -214,11 +256,20 @@ class QoSScheduler:
         infeasible candidate tries the degradation tiers, then is shed.
         Tags are NOT charged here — the engine ``commit``s what it
         actually admitted.
+
+        ``match_prefix`` (optional, ``PagedKVCache.match_prefix``-
+        shaped: tokens -> cached token count) makes admission CACHE-
+        AWARE: each candidate's prefill is priced at
+        ``est.prefill_cost(len(prompt) - match_prefix(prompt))``, so a
+        recurring system prompt both admits more easily and delays the
+        rest of the wave less. ``None`` keeps the flat legacy pricing
+        bit-for-bit.
         """
         shed: List[Tuple[Request, str]] = []
         degraded: Dict[str, Tuple[int, int]] = {}
         wave: List[Request] = []
         remaining = dict(self._q)
+        queued_cost = 0.0  # prefill units ahead of the next candidate
         while remaining and len(wave) < max_batch:
             top = max(self._eff_priority(e, now)
                       for e in remaining.values())
@@ -230,29 +281,47 @@ class QoSScheduler:
             e = min((c for c in cands if self._tenant(c.req) == tenant),
                     key=lambda c: (c.req.arrival, c.req.rid))
             del remaining[e.req.rid]
-            r, verdict = self._feasible(e.req, now, len(wave), est,
-                                        decode_chunk)
+            uncached = None
+            if match_prefix is not None:
+                uncached = max(0, len(e.req.prompt)
+                               - int(match_prefix(e.req.prompt)))
+            elif "prefill_unit" in est.costs:
+                # per-chunk clock pricing with NO probe (the cache-off
+                # arm): everything computes — price the full prompt,
+                # not the flat per-call cost, or every candidate looks
+                # one-chunk cheap and blows its admitted deadline
+                uncached = len(e.req.prompt)
+            r, verdict, cost = self._feasible(e.req, now, queued_cost,
+                                              est, decode_chunk,
+                                              uncached)
             if r is None:
                 del self._q[e.req.rid]
                 shed.append((e.req, verdict))
                 continue
+            queued_cost += cost  # only ADMITTED prefills delay later
+            # wave members (a shed candidate never runs)
             if r.max_new_tokens < e.req.max_new_tokens:
                 degraded[r.rid] = (r.max_new_tokens,
                                    e.req.max_new_tokens)
             wave.append(r)
         return SchedDecision(wave=wave, shed=shed, degraded=degraded)
 
-    def _feasible(self, r: Request, now: float, wave_pos: int,
-                  est: ServiceEstimator, decode_chunk: int):
+    def _feasible(self, r: Request, now: float, queued_cost: float,
+                  est: ServiceEstimator, decode_chunk: int,
+                  uncached: Optional[int] = None):
         """Clockwork-style check: estimated completion =
-        now + (wave_pos + 1) * prefill            (admissions serialize)
+        now + queued_cost + own prefill        (admissions serialize;
+                                                each priced by its
+                                                UNCACHED length when a
+                                                probe is given)
             + ceil(budget / decode_chunk) * decode * headroom.
-        Returns (request-or-degraded-copy, rule) or (None, shed
-        reason)."""
+        Returns (request-or-degraded-copy, rule, prefill_cost) or
+        (None, shed reason, 0.0)."""
+        pf = est.prefill_cost(uncached, prompt_tokens=len(r.prompt))
         dl = r.deadline_time()
         if dl is None:
-            return r, "no deadline"
-        t0 = now + (wave_pos + 1) * est.prefill
+            return r, "no deadline", pf
+        t0 = now + queued_cost + pf
         budget = r.max_new_tokens
         # the FULL budget is always tried first — degrade_tiers only
         # say what to fall back to when it does not fit (a tier tuple
@@ -265,14 +334,15 @@ class QoSScheduler:
                 * self.headroom
             if fin <= dl + 1e-9:
                 if b >= budget:
-                    return r, "feasible at full budget"
+                    return r, "feasible at full budget", pf
                 return (dataclasses.replace(r, max_new_tokens=b),
-                        f"degraded to tier {frac} ({b}/{budget} tokens)")
+                        f"degraded to tier {frac} ({b}/{budget} tokens)",
+                        pf)
         return None, (
             f"deadline-infeasible at admission: even the lowest "
             f"degradation tier ({tiers[-1]}) finishes past the "
             f"deadline (deadline in {max(0.0, dl - now):.3f} units, "
-            f"estimated service {t0 - now + est.decode:.3f}+)")
+            f"estimated service {t0 - now + est.decode:.3f}+)"), 0.0
 
     def commit(self, rid: str, budget: Optional[int] = None):
         """The engine ADMITTED ``rid``: leave the queue and charge the

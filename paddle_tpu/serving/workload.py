@@ -272,6 +272,65 @@ def synthesize_overload_trace(seed: int = 0, n_requests: int = 48, *,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def synthesize_recurring_prefix_trace(seed: int = 0, *,
+                                      n_cohorts: int = 2,
+                                      cohort_size: int = 4,
+                                      rounds: int = 3,
+                                      prefix_len: int = 24,
+                                      tail_len: Tuple[int, int] = (2, 8),
+                                      output_len: Tuple[int, int]
+                                      = (4, 8),
+                                      vocab_size: int = 128,
+                                      round_gap: float = 60.0,
+                                      intra_gap: float = 0.5,
+                                      rid_prefix: str = "p",
+                                      start: float = 0.0,
+                                      tag_groups: bool = False) \
+        -> List[Request]:
+    """The recurring-system-prompt workload — the dominant production
+    shape automatic prefix caching exists for. ``n_cohorts`` system
+    prompts (fixed ``prefix_len`` tokens each; pass a page multiple so
+    whole pages are sharable) are each re-queried by ``cohort_size``
+    requests per round, for ``rounds`` rounds.
+
+    Rounds are separated by ``round_gap`` clock units — sized far past
+    a round's service time — so LIVENESS-only sharing (prefix pages
+    alive only while a sharer still holds them, the PR-2 behavior)
+    gets ZERO cross-round hits: only RETENTION (evictable LRU pages
+    surviving refcount 0) can serve round >= 2 from cache. Within a
+    round, requests arrive ``intra_gap`` apart, interleaved across
+    cohorts.
+
+    rids are ``{rid_prefix}-r<round>c<cohort>.<i>`` (rounds 1-based)
+    so benches can split rounds without a side channel.
+    ``prefix_group`` stays None unless ``tag_groups`` — automatic
+    caching needs no tag; the tag only feeds the router's
+    shared_prefix signal. Deterministic in every field."""
+    if prefix_len < 1 or rounds < 1 or n_cohorts < 1 or cohort_size < 1:
+        raise ValueError("need >= 1 cohort, round, member and prefix "
+                         "token")
+    rng = np.random.default_rng(seed)
+    prefixes = [tuple(int(t) for t in rng.integers(
+        1, vocab_size, prefix_len)) for _ in range(n_cohorts)]
+    reqs: List[Request] = []
+    for rnd in range(1, rounds + 1):
+        t0 = start + (rnd - 1) * round_gap
+        for i in range(cohort_size):
+            for c in range(n_cohorts):
+                tail = tuple(int(x) for x in rng.integers(
+                    1, vocab_size,
+                    int(rng.integers(tail_len[0], tail_len[1] + 1))))
+                budget = int(rng.integers(output_len[0],
+                                          output_len[1] + 1))
+                reqs.append(Request(
+                    rid=f"{rid_prefix}-r{rnd}c{c}.{i}",
+                    arrival=t0 + (i * n_cohorts + c) * intra_gap,
+                    prompt=prefixes[c] + tail,
+                    max_new_tokens=budget,
+                    prefix_group=c if tag_groups else None))
+    return reqs
+
+
 def merge_traces(*traces: Sequence[Request]) -> List[Request]:
     """Interleave traces by arrival time (rids must already be unique —
     give each source a distinct ``rid_prefix``)."""

@@ -193,10 +193,12 @@ def test_prefill_kernel_matches_dense_gather():
 
 
 def test_prefix_cache_child_keys_die_with_parent():
-    """Recycled page ids must never resurrect prefix chains: freeing a
-    parent page removes every child key chained through it (the
-    wrong-context-KV hazard), and a partially-failed admit can recover
-    via free() and retry."""
+    """Recycled page ids must never resurrect prefix chains. Under
+    retention, freeing the last holder PARKS published pages in the
+    evictable LRU (keys live, chains still matchable); only EVICTION
+    recycles an id, and it takes every key chained through the page
+    with it (the wrong-context-KV hazard) — children always before
+    parents. A partially-failed admit recovers via free() + retry."""
     ps = 4
     cache = PagedKVCache(n_pages=8, page_size=ps, kv_heads=1, head_dim=8)
     X = list(range(10, 10 + ps))
@@ -213,11 +215,28 @@ def test_prefix_cache_child_keys_die_with_parent():
     pX = cache.tables["A"][0]
     assert cache.tables["B"][0] == pX and cache._refs[pX] == 2
 
-    # free both: X's page dies; the (X -> Z) child key must die with it
+    # free both: the published pages are RETAINED (evictable), not
+    # dropped — both chains still match for free
     cache.free("A")
     cache.free("B")
-    assert pX in cache._free
-    # a new sequence with prefix W then W+Z must NOT match stale chains
+    assert pX in cache._evictable and pX not in cache._free
+    assert cache.match_prefix(X + Y) == 2 * ps
+    assert cache.match_prefix(X + Z) == 2 * ps
+
+    # allocation pressure reclaims leaf-first: 7 usable pages, 3
+    # evictable (X, Y-child, Z-child); taking 6 evicts the two LEAVES,
+    # X survives as the most valuable (still-parenting) page
+    cache.allocate("C", 6 * ps)
+    assert cache.match_prefix(X + Y) == ps  # children gone...
+    assert cache.match_prefix(X + Z) == ps
+    assert cache.match_prefix(X) == ps      # ...parent still cached
+    cache.free("C")
+
+    # full pressure recycles X too; a new sequence publishing W under
+    # X's recycled id must NOT make stale (X -> Y/Z) chains matchable
+    cache.allocate("C", 7 * ps)
+    assert cache.match_prefix(X) == 0
+    cache.free("C")
     W = list(range(40, 40 + ps))
     assert cache.acquire_prefix("C", W) == 0
     cache.allocate("C", ps)
@@ -234,3 +253,7 @@ def test_prefix_cache_child_keys_die_with_parent():
     cache.free("D")
     assert cache.acquire_prefix("D", W + Z) == ps  # no assert, no leak
     cache.free("D")
+    # census invariant held throughout
+    s = cache.cache_stats()
+    assert s["resident_pages"] + s["evictable_pages"] \
+        + s["free_pages"] == s["n_pages"]

@@ -330,12 +330,15 @@ def test_prefix_cache_reuses_pages_and_skips_chunks():
 
     np.testing.assert_array_equal(outB, run2(promptB))
 
-    # freeing A keeps the shared page alive for B; freeing B releases it
+    # freeing A keeps the shared page alive for B; freeing B parks the
+    # published page in the evictable LRU (retention) — it stays
+    # matchable until allocation pressure reclaims it
     page = book.tables["A"][0]
     book.free("A")
     assert book._refs[page] == 1 and page not in book._free
     book.free("B")
-    assert page in book._free
+    assert page in book._evictable and page not in book._free
+    assert book.match_prefix(shared) == PS
 
 
 def test_fixed_shape_batching_never_recompiles():

@@ -41,6 +41,12 @@ cannot silently ship a slower build. Three modes:
       #    attainment must hold >= 0.9, and the rows' aggregates must
       #    prove shed requests were never counted as SLO hits
       #    (deadline_hits <= completed, shed + completed == arrived).
+      #  - serving_prefix (tools/serving_workload_bench.py --prefix):
+      #    on the recurring-system-prompt trace, automatic prefix
+      #    caching must save >= 30% prefill tokens and improve round-2
+      #    TTFT p50 >= 1.3x vs the cache-off arm, with byte-identical
+      #    greedy tokens and the pool census invariant (resident +
+      #    evictable + free == pool size) held at every engine turn.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -266,6 +272,98 @@ def check_serving_qos(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+PREFIX_SAVED_FLOOR = 0.30     # prefill tokens saved by cache-on
+PREFIX_TTFT2_FLOOR = 1.30     # round-2 TTFT p50 improvement floor
+
+
+def check_serving_prefix(rows: list) -> int:
+    """Gate the prefix-cache rows from serving_workload_bench.py
+    --prefix: on the recurring-system-prompt trace (fixed clock,
+    per-chunk prefill pricing) the cache-on arm must save >=
+    PREFIX_SAVED_FLOOR of the cache-off arm's prefill tokens AND
+    improve round-2 TTFT p50 by >= PREFIX_TTFT2_FLOOR, with byte-
+    identical greedy tokens per request, and BOTH arms' pool census
+    must have held resident + evictable + free == pool size at every
+    engine turn (the refcount/LRU accounting invariant). Cache-off is
+    the baseline re-measured in the same run — no stamped file."""
+    pr = [r for r in rows if r.get("bench") == "serving_prefix"]
+    by = {r.get("cache"): r for r in pr}
+    off, on = by.get("off"), by.get("on")
+    if off is None or on is None:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_prefix rows need BOTH a "
+                                    "cache-off and a cache-on arm (run "
+                                    "tools/serving_workload_bench.py "
+                                    "--prefix)"}))
+        return 1
+    for r in (off, on):
+        cs = r.get("cache_stats") or {}
+        counted = (cs.get("resident_pages", -1)
+                   + cs.get("evictable_pages", 0)
+                   + cs.get("free_pages", 0))
+        if cs.get("invariant_ok") is not True \
+                or counted != cs.get("n_pages"):
+            print(json.dumps({
+                "gate": "FAIL", "cache": r.get("cache"),
+                "reason": f"refcount/LRU accounting broken: resident+"
+                          f"evictable+free == {counted} vs pool "
+                          f"{cs.get('n_pages')} (invariant_ok="
+                          f"{cs.get('invariant_ok')}) — pages leaked "
+                          f"or double-counted"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_prefix_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_prefix_summary row — "
+                                    "cached-vs-uncached token parity "
+                                    "is UNVERIFIED (rerun tools/"
+                                    "serving_workload_bench.py "
+                                    "--prefix end to end)"}))
+        return 1
+    if any(r.get("outputs_match") is not True for r in summaries):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "cache-on produced DIVERGING greedy "
+                                    "tokens vs cache-off on the same "
+                                    "trace (correctness, not savings)"}))
+        return 1
+    p_off = float(off.get("prefill_tokens") or 0.0)
+    p_on = float(on.get("prefill_tokens") or 0.0)
+    t_off = off.get("ttft_round2_p50")
+    t_on = on.get("ttft_round2_p50")
+    if p_off <= 0 or not t_off or not t_on:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_prefix rows carry no "
+                                    "prefill_tokens / ttft_round2_p50 "
+                                    "(empty trace or single round?)"}))
+        return 1
+    saved = 1.0 - p_on / p_off
+    imp = float(t_off) / float(t_on)
+    rec = {
+        "gate": "pass",
+        "prefill_tokens_saved_frac": round(saved, 4),
+        "saved_floor": PREFIX_SAVED_FLOOR,
+        "ttft_round2_improvement": round(imp, 4),
+        "ttft2_floor": PREFIX_TTFT2_FLOOR,
+        "hit_rate": (on.get("cache_stats") or {}).get("hit_rate"),
+        "evictions": (on.get("cache_stats") or {}).get("evictions"),
+        "device": on.get("device", "?"),
+    }
+    if saved < PREFIX_SAVED_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"cache-on saved only {saved:.1%} of prefill "
+                         f"tokens (floor {PREFIX_SAVED_FLOOR:.0%}) — "
+                         "retention is not serving the recurring "
+                         "prefixes")
+    elif imp < PREFIX_TTFT2_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"round-2 TTFT p50 improved only {imp:.3f}x "
+                         f"(floor {PREFIX_TTFT2_FLOOR}) — the saved "
+                         "prefill is not reaching time-to-first-token")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 OBS_OFF_OVERHEAD_MAX = 0.02  # tracing-off tax allowed over no-obs
 
 
@@ -384,19 +482,23 @@ def check_obs(rows: list) -> int:
 def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     """Gate the serving rows: the spec-compiled vs compiled-plain row
     (tools/spec_decode_bench.py), the workload-replay rows
-    (tools/serving_workload_bench.py) and/or the QoS overload rows
-    (tools/serving_workload_bench.py --qos) — whichever families the
-    input carries; every family present must pass. FAILs on: no
-    canonical row at all, a recorded compile failure, output
+    (tools/serving_workload_bench.py), the QoS overload rows
+    (--qos) and/or the prefix-cache rows (--prefix) — whichever
+    families the input carries; every family present must pass. FAILs
+    on: no canonical row at all, a recorded compile failure, output
     divergence, a >threshold regression, a sub-floor qos-vs-fifo
-    goodput ratio, or broken shed accounting — so the serving claims
-    can only change deliberately."""
+    goodput ratio, broken shed accounting, sub-floor prefix savings /
+    TTFT improvement, or a broken refcount/LRU census — so the
+    serving claims can only change deliberately."""
     fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
         fam_rcs["workload"] = check_serving_workload(rows)
     if any(r.get("bench", "").startswith("serving_qos") for r in rows):
         fam_rcs["qos"] = check_serving_qos(rows)
+    if any(r.get("bench", "").startswith("serving_prefix")
+           for r in rows):
+        fam_rcs["prefix"] = check_serving_prefix(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

@@ -64,6 +64,15 @@ QUEUE = [
     # gates qos goodput >= 1.15x fifo with tight-cohort SLO >= 0.9
     ("serving_qos",
      [sys.executable, "tools/serving_workload_bench.py", "--qos"], {}),
+    # PR-5 addition: the prefix-cache arm — cache-off vs cache-on on
+    # the recurring-system-prompt trace (fixed clock, so the chip run
+    # validates the real-model resumed-prefill path while the savings
+    # verdict stays deterministic); bench_gate.py serving gates
+    # >= 30% prefill tokens saved, round-2 TTFT p50 >= 1.3x, token
+    # parity and the pool-census invariant
+    ("serving_prefix",
+     [sys.executable, "tools/serving_workload_bench.py", "--prefix"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

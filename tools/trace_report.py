@@ -5,7 +5,9 @@ The trace a `ServingEngine(trace=...)` / `serving_workload_bench.py
 tool turns it into the four summaries an on-call actually asks for:
 
 - **per-request waterfall**: arrival -> admit -> first token -> finish
-  per rid (outcome + deadline-relevant gaps), drawn as an ASCII gantt.
+  per rid (outcome + deadline-relevant gaps), drawn as an ASCII gantt;
+  requests that hit the prefix cache show their cached token count
+  (``hit=N``) so saved prefill is visible next to the TTFT it bought.
 - **top recompiles**: every `jit.compile` instant, grouped by site,
   sorted by wall cost — the "which recompile blew up TTFT" view.
 - **shed timeline**: scheduler rejections in time order with reasons.
@@ -64,6 +66,9 @@ def request_rows(events: list, tracks: dict) -> list:
             rows[rid]["admit"] = e["ts"]
             rows[rid].setdefault("backend",
                                  e.get("args", {}).get("backend"))
+            cached = e.get("args", {}).get("cached")
+            if cached is not None:
+                rows[rid]["prefix_hit"] = cached
         elif e["name"] == "first_token":
             rows[rid]["first_token"] = e["ts"]
     out = sorted(rows.values(),
@@ -147,6 +152,8 @@ def summarize(events: list) -> dict:
             "recompile_wall_s": round(sum(
                 float(c["wall_s"] or 0.0) for c in comp), 6),
             "sheds": len(sh), "slot_occupancy": occ,
+            "prefix_hit_tokens": sum(
+                int(r.get("prefix_hit") or 0) for r in reqs),
             "tracks": sorted(tracks.values())}
 
 
@@ -167,9 +174,11 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
             ttft = ""
             if "first_token" in r and "arrival" in r:
                 ttft = f" ttft={(r['first_token'] - r['arrival']) / 1e6:.4f}"
+            hit = f" hit={r['prefix_hit']}" \
+                if r.get("prefix_hit") else ""
             lines.append(
                 f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
-                f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}")
+                f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}")
     comp = recompiles(events)
     lines.append(f"\n== recompiles ({len(comp)}) ==")
     by_site: dict = {}
