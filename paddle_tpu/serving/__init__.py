@@ -6,22 +6,35 @@ request-stream engine (``ServingEngine``) driving the dense compiled
 cache and the paged KV pool behind a pluggable routing policy, a QoS
 scheduling front door (``scheduler.QoSScheduler``: strict priorities
 over per-tenant weighted fair queueing, deadline-feasibility
-admission, overload shedding + degradation tiers), a seeded
-replayable trace generator (``workload``, including the multi-tenant
-overload trace), and per-request TTFT/TPOT/SLO/goodput/fairness
-metrics (``metrics``). ``tools/serving_workload_bench.py`` replays
-one trace through routed / dense-only / paged-only (and ``--qos``
-replays the overload trace fifo-vs-qos); ``tools/bench_gate.py
-serving`` gates both families.
+admission, overload shedding + degradation tiers), a multi-replica
+cluster router (``cluster.ClusterRouter``: round_robin /
+least_loaded / prefix_aware placement over N ``EngineSession``
+replicas on one shared virtual timeline, drain/join lifecycle,
+rollup goodput/fairness metrics; ``sim.make_sim_serving`` scales its
+gate to 10^5 requests), a seeded replayable trace generator
+(``workload``, including the multi-tenant overload and cluster
+traces), and per-request TTFT/TPOT/SLO/goodput/fairness metrics
+(``metrics``). ``tools/serving_workload_bench.py`` replays one trace
+through routed / dense-only / paged-only (``--qos`` replays the
+overload trace fifo-vs-qos, ``--cluster`` the 10^5-request trace
+across placements); ``tools/bench_gate.py serving`` gates every
+family.
 """
-from .engine import (EngineClock, FixedPolicy,  # noqa: F401
-                     Policy, RoutedPolicy, ServeResult, ServingEngine,
-                     load_engine_log, make_policy)
-from .metrics import MetricsCollector  # noqa: F401
+from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
+                      LeastLoadedPlacement, PlacementPolicy,
+                      PrefixAwarePlacement, RoundRobinPlacement,
+                      make_placement)
+from .engine import (EngineClock, EngineSession,  # noqa: F401
+                     FixedPolicy, Policy, RoutedPolicy, ServeResult,
+                     ServingEngine, load_engine_log, make_policy)
+from .metrics import (MetricsCollector, goodput_tokens,  # noqa: F401
+                      jain_fairness)
 from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401
                         ServiceEstimator)
+from .sim import SimServing, make_sim_serving  # noqa: F401
 from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        load_trace, merge_traces, save_trace,
+                       synthesize_cluster_trace,
                        synthesize_overload_trace,
                        synthesize_recurring_prefix_trace,
                        synthesize_trace, trace_stats)

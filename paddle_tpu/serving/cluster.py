@@ -1,0 +1,572 @@
+"""Multi-replica serving cluster: a prefix-aware router over N engines.
+
+One ``ServingEngine`` is a building block; a service at
+millions-of-users scale is N of them behind a front door that decides
+WHERE each request runs. ``ClusterRouter`` is that front door, built
+from pieces already in the repo:
+
+- each replica is an ``EngineSession`` (its own engine, paged pool and
+  QoS scheduler) on its own lane of one shared virtual timeline — the
+  router advances EVERY lane to each arrival before placing it, so
+  placement probes answer "as of now", not "as of whenever that
+  replica last ran";
+- **placement policies** (pluggable, ``place(request, replicas)``):
+
+  ============== ========================================================
+  round_robin    rotate over admitting replicas — the baseline every
+                 cluster claim is measured against
+  least_loaded   fewest queued + in-flight requests (the same live
+                 queue-depth signal the obs gauges export), replica
+                 index breaking ties
+  prefix_aware   probe every replica's paged pool with the
+                 NON-ACQUIRING ``match_prefix`` and send a request to
+                 the replica already holding >= threshold tokens of its
+                 prompt (ties: least loaded); below threshold, fall
+                 back to least_loaded. PR 5's cache-aware co-scheduling
+                 generalized ACROSS replicas: sharers concentrate where
+                 their prefix is resident instead of re-prefilling it
+                 N times and thrashing every pool's retention LRU
+  ============== ========================================================
+
+- **lifecycle**: ``drain`` stops admission, hands the replica's
+  queued-but-never-admitted backlog back to the router for placement
+  on surviving replicas (requeued requests keep their original arrival
+  — the queueing they suffered stays on their record — and are counted
+  exactly ONCE cluster-wide), lets in-flight rows stream to
+  completion, then retires the replica (its pool census must balance
+  with zero resident pages at removal). ``join`` adds a cold replica
+  mid-trace; placement starts steering traffic to it immediately
+  (least-loaded finds it empty, prefix-aware falls back until its pool
+  warms).
+
+The router itself never touches tokens: placement is bookkeeping, each
+replica's engine does exactly what a lone engine does, and every
+request's greedy stream therefore agrees token-for-token with any
+other placement's (and a single big engine's) on their common length —
+stream LENGTHS may differ where policy-dependent timeouts, degradation
+tiers or sheds truncate, the TOKENS may not. That overlap parity (with
+its coverage counts) is the cluster bench's correctness gate.
+
+``tools/serving_workload_bench.py --cluster`` replays the ~10^5-request
+``synthesize_cluster_trace`` through all three policies over
+``serving.sim`` replicas; ``tools/bench_gate.py serving`` gates the
+``serving_cluster`` family (prefix_aware goodput >= 1.15x round_robin
+with fairness held, strictly more prefill saved, parity, and the
+drain/join conservation invariant).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from .engine import EngineSession, ServeResult, ServingEngine
+from .metrics import _pct, goodput_tokens, jain_fairness
+from .workload import Request
+
+
+class PlacementPolicy:
+    """Chooses the replica one arriving request runs on. ``replicas``
+    is the ADMITTING subset, creation order; return one of them. A
+    policy may keep state (round-robin's rotation) — one policy
+    instance serves one ``ClusterRouter.run``."""
+
+    name = "base"
+
+    def place(self, r: Request, replicas: List["_Replica"]) -> "_Replica":
+        raise NotImplementedError
+
+
+class RoundRobinPlacement(PlacementPolicy):
+    name = "round_robin"
+
+    def __init__(self):
+        self._i = 0
+
+    def place(self, r, replicas):
+        rep = replicas[self._i % len(replicas)]
+        self._i += 1
+        return rep
+
+
+def _least_loaded(replicas):
+    return min(replicas, key=lambda rep: (rep.session.load(), rep.index))
+
+
+class LeastLoadedPlacement(PlacementPolicy):
+    name = "least_loaded"
+
+    def place(self, r, replicas):
+        return _least_loaded(replicas)
+
+
+class PrefixAwarePlacement(PlacementPolicy):
+    """Send sharers where their prefix lives; everyone else least
+    loaded. ``threshold`` is the minimum matched-token count (page
+    multiple) worth steering for — below it the cache can save at most
+    a partial chunk, so load balance wins; default one page."""
+
+    name = "prefix_aware"
+
+    def __init__(self, threshold: Optional[int] = None):
+        if threshold is not None and threshold < 1:
+            raise ValueError("prefix threshold must be >= 1 token")
+        self.threshold = threshold
+
+    def place(self, r, replicas):
+        probes = [(rep.session.match_prefix(r.prompt), rep)
+                  for rep in replicas]
+        best = max(p for p, _ in probes)
+        thr = self.threshold if self.threshold is not None \
+            else replicas[0].session.eng.page_size
+        if best >= thr:
+            return _least_loaded([rep for p, rep in probes
+                                  if p == best])
+        return _least_loaded(replicas)
+
+
+def make_placement(spec, threshold: Optional[int] = None) \
+        -> PlacementPolicy:
+    if isinstance(spec, PlacementPolicy):
+        return spec
+    if spec == "round_robin":
+        return RoundRobinPlacement()
+    if spec == "least_loaded":
+        return LeastLoadedPlacement()
+    if spec == "prefix_aware":
+        return PrefixAwarePlacement(threshold)
+    raise ValueError(f"placement {spec!r}: use 'round_robin', "
+                     "'least_loaded', 'prefix_aware' or a "
+                     "PlacementPolicy instance")
+
+
+class _ReplicaTracer:
+    """Track-prefixing view of one shared Tracer: replica ``name``'s
+    engine events land on ``<name>/...`` tracks, so a cluster trace
+    renders one lane group per replica and ``trace_report.py --json``
+    can report per-replica occupancy. Engine events always stamp
+    explicit times, so N per-replica virtual clocks share the tracer
+    safely."""
+
+    def __init__(self, tracer, name: str):
+        self._t = tracer
+        self._p = name
+
+    def add_span(self, name, t0, dur, track="main", **attrs):
+        self._t.add_span(name, t0, dur, track=f"{self._p}/{track}",
+                         **attrs)
+
+    def instant(self, name, t=None, track="main", **attrs):
+        self._t.instant(name, t=t, track=f"{self._p}/{track}", **attrs)
+
+    def counter(self, name, value, t=None, track="counters"):
+        self._t.counter(name, value, t=t, track=f"{self._p}/{track}")
+
+    def async_begin(self, name, id_, t=None, track="main", **kw):
+        self._t.async_begin(name, id_, t=t,
+                            track=f"{self._p}/{track}", **kw)
+
+    def async_end(self, name, id_, t=None, track="main", **kw):
+        self._t.async_end(name, id_, t=t,
+                          track=f"{self._p}/{track}", **kw)
+
+    def __getattr__(self, k):  # events/export/clear/... pass through
+        return getattr(self._t, k)
+
+
+class _Replica:
+    __slots__ = ("name", "index", "session", "admitting", "joined_at",
+                 "drained_at")
+
+    def __init__(self, name: str, index: int, session: EngineSession,
+                 joined_at: float):
+        self.name = name
+        self.index = index          # creation order: the tie-breaker
+        self.session = session
+        self.admitting = True
+        self.joined_at = joined_at
+        self.drained_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class ClusterResult:
+    """One cluster replay: per-replica ServeResults plus the router's
+    own ledger (placements/requeues) and lifecycle event log."""
+
+    placement: str
+    results: Dict[str, ServeResult]     # replica -> final result
+    ledger: Dict[str, dict]             # rid -> {tenant, replica,
+    #                                     requeues}
+    events: List[dict]                  # drain/join/remove log
+    trace: Optional[object] = None      # the shared Tracer, if any
+
+    def outputs(self) -> Dict[str, List[int]]:
+        """Every request's greedy stream, merged across replicas (rids
+        are cluster-unique by the census invariant)."""
+        out: Dict[str, List[int]] = {}
+        for name in self.results:
+            out.update(self.results[name].outputs)
+        return out
+
+    def census(self) -> dict:
+        """The no-request-lost-or-duplicated invariant, per tenant:
+        every routed rid finished OR shed on EXACTLY one replica, and
+        ``completed + shed == arrived`` for each tenant. Also folds in
+        each replica's pool census (``invariant_ok``) and, for retired
+        replicas, the at-removal census the router recorded."""
+        seen: Dict[str, str] = {}
+        dup: List[str] = []
+        per: Dict[str, dict] = {}
+
+        def bump(tenant, key):
+            t = tenant if tenant is not None else "_none"
+            per.setdefault(t, {"arrived": 0, "completed": 0,
+                               "shed": 0})[key] += 1
+
+        for rid, led in self.ledger.items():
+            bump(led["tenant"], "arrived")
+        for name, res in self.results.items():
+            for rid in res.outputs:
+                if rid in seen:
+                    dup.append(rid)
+                seen[rid] = name
+                bump(self.ledger[rid]["tenant"], "completed")
+            for rid in res.shed:
+                if rid in seen:
+                    dup.append(rid)
+                seen[rid] = name
+                bump(self.ledger[rid]["tenant"], "shed")
+        lost = sorted(set(self.ledger) - set(seen))
+        conserved = all(v["completed"] + v["shed"] == v["arrived"]
+                        for v in per.values())
+        pools_ok = all(res.cache_stats.get("invariant_ok") is True
+                       for res in self.results.values())
+        removal_ok = all(e.get("census_ok", True) for e in self.events)
+        return {"tenants": per,
+                "duplicated": sorted(set(dup)), "lost": lost,
+                "conserved": bool(conserved and not dup and not lost),
+                "pool_census_ok": bool(pools_ok),
+                "removal_census_ok": bool(removal_ok),
+                "requeued": sum(1 for led in self.ledger.values()
+                                if led["requeues"])}
+
+    def report(self, tenant_weights: Optional[Dict[str, float]] = None) \
+            -> dict:
+        """The cluster rollup: per-replica ``report()`` blocks reduced
+        to cluster goodput, TTFT/TPOT percentiles, per-tenant Jain
+        fairness (the SAME ``jain_fairness``/``goodput_tokens``
+        helpers the per-run QoS block uses) and per-replica prefix hit
+        rates."""
+        rows: List[dict] = []
+        for name in self.results:
+            for v in self.results[name].metrics.request_rows():
+                v["replica"] = name
+                rows.append(v)
+        done = [v for v in rows if v["finish"] is not None]
+        shed = [v for v in rows if v["shed"]]
+        ttfts = [v["ttft"] for v in done if v["ttft"] is not None]
+        tpots = [v["tpot"] for v in done if v["tpot"] is not None]
+        arrivals = [v["arrival"] for v in rows]
+        finishes = [v["finish"] for v in done]
+        makespan = (max(finishes) - min(arrivals)) \
+            if finishes and arrivals else 0.0
+        tokens = sum(v["n_tokens"] for v in done)
+        good = goodput_tokens(done)
+        rec: dict = {
+            "placement": self.placement,
+            "replicas": len(self.results),
+            "arrived": len(rows),
+            "completed": len(done),
+            "shed": len(shed),
+            "shed_rate": round(len(shed) / len(rows), 4) if rows
+            else 0.0,
+            "generated_tokens": tokens,
+            "makespan": round(makespan, 6),
+            "tokens_per_sec": round(tokens / makespan, 4)
+            if makespan > 0 else None,
+            "goodput_tokens": good,
+            "goodput_tokens_per_sec": round(good / makespan, 4)
+            if makespan > 0 else None,
+            "ttft_p50": _pct(ttfts, 50), "ttft_p95": _pct(ttfts, 95),
+            "tpot_p50": _pct(tpots, 50), "tpot_p95": _pct(tpots, 95),
+        }
+        with_dl = [v for v in done if v["deadline_ms"] is not None]
+        if with_dl:
+            rec["slo_deadline_attained"] = round(
+                sum(1 for v in with_dl if v["deadline_met"])
+                / len(with_dl), 4)
+        tenants = sorted({v["tenant"] for v in rows
+                          if v["tenant"] is not None})
+        if tenants:
+            w = tenant_weights or {}
+            per: Dict[str, dict] = {}
+            xs = []
+            for t in tenants:
+                tv = [v for v in rows if v["tenant"] == t]
+                gtok = goodput_tokens([v for v in tv
+                                       if v["finish"] is not None])
+                per[t] = {"arrived": len(tv),
+                          "shed": sum(1 for v in tv if v["shed"]),
+                          "completed": sum(1 for v in tv
+                                           if v["finish"] is not None),
+                          "goodput_tokens": gtok}
+                xs.append(gtok / float(w.get(t, 1.0)))
+            rec["tenants"] = per
+            rec["fairness_jain"] = jain_fairness(xs)
+        per_rep: Dict[str, dict] = {}
+        saved_total = 0
+        prefill_total = 0
+        for name in sorted(self.results):
+            res = self.results[name]
+            rrep = res.report()
+            saved = int(rrep.get("prefill_tokens_saved", 0))
+            saved_total += saved
+            prefill_total += res.prefill_tokens
+            per_rep[name] = {
+                "completed": rrep["completed"],
+                "shed": len(res.shed),
+                "prefill_tokens": res.prefill_tokens,
+                "prefill_tokens_saved": saved,
+                "prefix_hit_tokens": sum(res.prefix_cached.values()),
+                "prefix_hit_rate": res.cache_stats.get("hit_rate"),
+                "census_ok": res.cache_stats.get("invariant_ok"),
+                "drained": any(e.get("replica") == name
+                               and e.get("event") == "drain"
+                               for e in self.events),
+            }
+        rec["prefill_tokens"] = prefill_total
+        rec["prefill_tokens_saved"] = saved_total
+        rec["per_replica"] = per_rep
+        rec["lifecycle_events"] = len(self.events)
+        return rec
+
+
+class ClusterRouter:
+    """N engine replicas, one placement seam, one shared virtual
+    timeline.
+
+    ``spawn(name) -> ServingEngine`` builds one replica's engine (its
+    OWN serving factory — factories share live pool buffers, so two
+    replicas over one factory would corrupt each other's K/V; the sim
+    factory makes this cheap at any scale). ``run(trace, events)``
+    replays one arrival-ordered trace, advancing every replica's lane
+    to each arrival/lifecycle time before acting, so placement probes
+    (load, prefix match) are causally honest. A router runs ONCE —
+    build a fresh one per replay (determinism: same trace + events +
+    policy -> byte-identical ClusterResult).
+
+    ``events`` schedules lifecycle transitions deterministically:
+    ``[(t, "drain", name), (t, "join", name)]``; joins sort before
+    drains at equal ``t`` so a drain's requeued backlog can land on
+    the replica that just joined.
+    """
+
+    def __init__(self, spawn, n_replicas: int = 2, *,
+                 placement="prefix_aware",
+                 prefix_threshold: Optional[int] = None,
+                 trace=None):
+        if not callable(spawn):
+            raise ValueError("spawn must be callable: name -> "
+                             "ServingEngine (one engine+factory per "
+                             "replica)")
+        if n_replicas < 1:
+            raise ValueError("need >= 1 replica")
+        self._spawn = spawn
+        self.n_replicas = n_replicas
+        self.placement = make_placement(placement, prefix_threshold)
+        self._trace_spec = trace
+        self._tracer: Optional[obs_trace.Tracer] = None
+        self.replicas: List[_Replica] = []
+        self.results: Dict[str, ServeResult] = {}
+        self.ledger: Dict[str, dict] = {}
+        self.events_log: List[dict] = []
+        self._next_index = 0
+        self._expect_churn = False
+        self._ran = False
+        self._g_load = obs_metrics.REGISTRY.gauge
+
+    # --- lifecycle --------------------------------------------------------
+    def _add_replica(self, name: str, t: float) -> _Replica:
+        if any(rep.name == name for rep in self.replicas):
+            raise ValueError(f"replica {name!r} already live")
+        if name in self.results:
+            # a retired name's ServeResult is already banked; reusing
+            # it would overwrite that history and read as lost
+            # requests in census() — force a fresh name instead
+            raise ValueError(f"replica {name!r} already served and "
+                             "retired this run — join under a fresh "
+                             "name")
+        eng = self._spawn(name)
+        if not isinstance(eng, ServingEngine):
+            raise ValueError(f"spawn({name!r}) returned "
+                             f"{type(eng).__name__}, not a "
+                             "ServingEngine")
+        tr = _ReplicaTracer(self._tracer, name) \
+            if self._tracer is not None else None
+        sess = eng.session(tracer=tr, replica=name,
+                           expect_churn=self._expect_churn)
+        sess.clock.advance_to(t)   # a joiner starts life at NOW
+        rep = _Replica(name, self._next_index, sess, joined_at=t)
+        self._next_index += 1
+        self.replicas.append(rep)
+        self._g_load("cluster_replica_load",
+                     "queued + in-flight requests on a replica",
+                     replica=name).set(0.0)
+        return rep
+
+    def _rep(self, name: str) -> _Replica:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        raise ValueError(f"no live replica {name!r}")
+
+    def _join(self, name: str, t: float):
+        self._add_replica(name, t)
+        self.events_log.append({"t": round(t, 6), "event": "join",
+                                "replica": name})
+        if self._tracer is not None:
+            self._tracer.instant("join", t=t, track="cluster",
+                                 replica=name)
+
+    def _drain(self, name: str, t: float):
+        rep = self._rep(name)
+        if not rep.admitting:
+            raise ValueError(f"replica {name!r} is already draining")
+        rep.admitting = False
+        rep.drained_at = t
+        rep.session.more_expected = False
+        pulled = rep.session.pull_unadmitted()
+        self.events_log.append({"t": round(t, 6), "event": "drain",
+                                "replica": name,
+                                "requeued": [r.rid for r in pulled],
+                                "in_flight": len(rep.session.active)})
+        if self._tracer is not None:
+            self._tracer.instant("drain", t=t, track="cluster",
+                                 replica=name, requeued=len(pulled))
+        for r in pulled:
+            self.ledger[r.rid]["requeues"] += 1
+            self._place(r, requeue=True)
+        self._maybe_retire(rep)
+
+    def _maybe_retire(self, rep: _Replica):
+        """A draining replica whose in-flight rows have all finished
+        leaves the cluster; its pool census must balance with ZERO
+        resident pages (every sequence freed) at removal."""
+        if rep.admitting or rep.session.active or rep.session.queued():
+            return
+        res = rep.session.finish()
+        cs = res.cache_stats
+        ok = bool(cs.get("invariant_ok")
+                  and cs.get("resident_pages") == 0)
+        self.results[rep.name] = res
+        self.replicas.remove(rep)
+        self._g_load("cluster_replica_load",
+                     "queued + in-flight requests on a replica",
+                     replica=rep.name).set(0.0)
+        self.events_log.append({
+            "t": round(rep.session.clock.now(), 6), "event": "remove",
+            "replica": rep.name, "census_ok": ok,
+            "resident_pages": cs.get("resident_pages")})
+        if self._tracer is not None:
+            self._tracer.instant("remove", t=rep.session.clock.now(),
+                                 track="cluster", replica=rep.name,
+                                 census_ok=ok)
+
+    # --- placement --------------------------------------------------------
+    def _place(self, r: Request, requeue: bool = False):
+        cands = [rep for rep in self.replicas if rep.admitting]
+        if not cands:
+            raise RuntimeError(
+                f"no admitting replica for {r.rid} — drained the whole "
+                "cluster with work still arriving")
+        rep = self.placement.place(r, cands)
+        rep.session.submit(r)
+        led = self.ledger.get(r.rid)
+        if led is None:
+            self.ledger[r.rid] = {"tenant": r.tenant,
+                                  "replica": rep.name, "requeues": 0}
+        else:
+            led["replica"] = rep.name
+        # refresh EVERY admitting replica's gauge, not just the chosen
+        # one — a replica that drains its backlog between placements
+        # must not export its stale last-placement load
+        for rep2 in cands:
+            self._g_load("cluster_replica_load",
+                         "queued + in-flight requests on a replica",
+                         replica=rep2.name).set(
+                float(rep2.session.load()))
+
+    # --- the replay -------------------------------------------------------
+    def run(self, trace: List[Request], events=()) -> ClusterResult:
+        if self._ran:
+            raise RuntimeError("a ClusterRouter runs once — build a "
+                               "fresh router per replay")
+        self._ran = True
+        self._expect_churn = any(r.cancel_after is not None
+                                 for r in trace)
+        spec = self._trace_spec
+        if spec is not None and spec is not False:
+            if isinstance(spec, obs_trace.Tracer):
+                self._tracer = spec
+                self._tracer.clear()
+            else:
+                self._tracer = obs_trace.Tracer()
+        timeline: List[tuple] = []
+        for i, ev in enumerate(events):
+            t, op, name = ev
+            if op not in ("drain", "join"):
+                raise ValueError(f"lifecycle event {op!r}: use 'drain' "
+                                 "or 'join'")
+            timeline.append((float(t), 0 if op == "join" else 1, i,
+                             (op, name)))
+        for i, r in enumerate(sorted(trace,
+                                     key=lambda r: (r.arrival, r.rid))):
+            timeline.append((r.arrival, 2, i, r))
+        timeline.sort(key=lambda x: (x[0], x[1], x[2]))
+
+        prev_tr = obs_trace.active()
+        if self._tracer is not None:
+            obs_trace.activate(self._tracer)
+        try:
+            for i in range(self.n_replicas):
+                self._add_replica(f"r{i}", 0.0)
+            for t, _, _, item in timeline:
+                for rep in list(self.replicas):
+                    rep.session.advance_until(t)
+                    if not rep.admitting:
+                        self._maybe_retire(rep)
+                if isinstance(item, tuple):
+                    op, name = item
+                    (self._join if op == "join" else self._drain)(
+                        name, t)
+                else:
+                    self._place(item)
+            for rep in list(self.replicas):
+                rep.session.more_expected = False
+            for rep in list(self.replicas):
+                self.results[rep.name] = rep.session.finish()
+                if not rep.admitting:
+                    # retire bookkeeping for replicas that were still
+                    # streaming when the trace ran out
+                    cs = self.results[rep.name].cache_stats
+                    self.events_log.append({
+                        "t": round(rep.session.clock.now(), 6),
+                        "event": "remove", "replica": rep.name,
+                        "census_ok": bool(
+                            cs.get("invariant_ok")
+                            and cs.get("resident_pages") == 0),
+                        "resident_pages": cs.get("resident_pages")})
+                self.replicas.remove(rep)
+        finally:
+            if self._tracer is not None:
+                if prev_tr is not None:
+                    obs_trace.activate(prev_tr)
+                else:
+                    obs_trace.deactivate()
+        if self._tracer is not None and isinstance(spec, str):
+            self._tracer.export(spec)
+        return ClusterResult(placement=self.placement.name,
+                             results=self.results, ledger=self.ledger,
+                             events=self.events_log,
+                             trace=self._tracer)

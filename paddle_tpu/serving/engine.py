@@ -174,6 +174,8 @@ class ServeResult:
     # PagedKVCache.cache_stats() at run end + "invariant_ok": the
     # resident+evictable+free == pool-size census, sampled every
     # engine turn
+    replica: Optional[str] = None   # cluster replica name (a lone
+    # engine leaves it None and its logs stay byte-identical to PR 4)
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -182,22 +184,28 @@ class ServeResult:
         """Dump the engine's decision + slot + shed log as JSONL, so an
         overload incident can be replayed offline (``load_engine_log``
         round-trips it). One ``meta`` line, then one line per wave
-        decision, slot acquire/release, and shed."""
+        decision, slot acquire/release, and shed. A cluster replica's
+        result stamps its ``replica`` name on EVERY record, so logs
+        from N replicas can be concatenated into one cluster incident
+        file without losing attribution; with ``replica`` unset
+        (single-engine runs) the format is byte-identical to PR 4."""
+        tag = {} if self.replica is None else {"replica": self.replica}
         with open(path, "w") as f:
             f.write(json.dumps({
                 "kind": "meta", "policy": self.policy,
                 "scheduler": self.scheduler,
                 "pages_total": self.pages_total,
-                "pages_free_end": self.pages_free_end}) + "\n")
+                "pages_free_end": self.pages_free_end, **tag}) + "\n")
             for d in self.decisions:
-                f.write(json.dumps({"kind": "decision", **d}) + "\n")
+                f.write(json.dumps({"kind": "decision", **d, **tag})
+                        + "\n")
             for t, ev, rid, slot in self.slot_log:
                 f.write(json.dumps({"kind": "slot", "t": t,
                                     "event": ev, "rid": rid,
-                                    "slot": slot}) + "\n")
+                                    "slot": slot, **tag}) + "\n")
             for rid, reason in self.shed.items():
                 f.write(json.dumps({"kind": "shed", "rid": rid,
-                                    "reason": reason}) + "\n")
+                                    "reason": reason, **tag}) + "\n")
         return path
 
 
@@ -205,7 +213,13 @@ def load_engine_log(path: str) -> dict:
     """Parse a ``ServeResult.save_log`` JSONL back into
     ``{"meta", "decisions", "slot_log", "shed"}`` with the engine's
     in-memory types (slot entries as ``(t, event, rid, slot)``
-    tuples), so offline analysis sees what the live run saw."""
+    tuples), so offline analysis sees what the live run saw. Records
+    carrying the optional ``replica`` field (cluster logs, possibly
+    several replicas' files concatenated) keep it: decisions retain
+    their ``replica`` key, slot entries become 5-tuples
+    ``(t, event, rid, slot, replica)``, and sheds map
+    ``rid -> (reason, replica)``; replica-less logs load exactly as
+    before."""
     out: dict = {"meta": None, "decisions": [], "slot_log": [],
                  "shed": {}}
     with open(path) as f:
@@ -215,15 +229,18 @@ def load_engine_log(path: str) -> dict:
                 continue
             d = json.loads(ln)
             kind = d.pop("kind", None)
+            rep = d.get("replica")
             if kind == "meta":
                 out["meta"] = d
             elif kind == "decision":
                 out["decisions"].append(d)
             elif kind == "slot":
-                out["slot_log"].append(
-                    (d["t"], d["event"], d["rid"], d["slot"]))
+                row = (d["t"], d["event"], d["rid"], d["slot"])
+                out["slot_log"].append(row if rep is None
+                                       else row + (rep,))
             elif kind == "shed":
-                out["shed"][d["rid"]] = d["reason"]
+                out["shed"][d["rid"]] = d["reason"] if rep is None \
+                    else (d["reason"], rep)
             else:
                 raise ValueError(f"engine log line has unknown kind "
                                  f"{kind!r}")
@@ -403,6 +420,12 @@ class ServingEngine:
         # reads positions < each row's length, all freshly written.
         if not hasattr(serving, "_live_pools"):
             serving._live_pools = pools
+        # a factory may advertise wants_numpy_ (serving.sim does): its
+        # callables take host arrays directly, so the per-call
+        # jnp.asarray staging — pure overhead at 10^5-request cluster
+        # scale — is skipped; jitted factories keep the conversion
+        self._arr = (lambda x: x) \
+            if getattr(serving, "wants_numpy_", False) else jnp.asarray
 
     @property
     def _pools(self):
@@ -700,8 +723,14 @@ class ServingEngine:
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
             return True
-        if clock.now() - waiting[0].arrival \
-                >= self.admission.max_delay - 1e-12:
+        # the window-close test MUST round identically to the idle
+        # target `arrival + max_delay` the loop advances to: comparing
+        # `now - arrival >= max_delay` instead livelocks once the
+        # clock is large enough that one ulp exceeds the epsilon
+        # (advance_to(target) lands ON target yet reads as not-ready
+        # — first seen at t ~ 6e4 on the 10^5-request cluster trace)
+        if clock.now() >= waiting[0].arrival \
+                + self.admission.max_delay - 1e-12:
             return True
         return not pending and not active  # nothing else will ever come
 
@@ -912,8 +941,9 @@ class ServingEngine:
     def _sched_ready(self, sched, pending, active, clock) -> bool:
         if sched.waiting() >= self.admission.max_batch:
             return True
-        if clock.now() - sched.oldest_arrival() \
-                >= self.admission.max_delay - 1e-12:
+        # same-rounding rule as _admission_ready (see comment there)
+        if clock.now() >= sched.oldest_arrival() \
+                + self.admission.max_delay - 1e-12:
             return True
         return not pending and not active
 
@@ -982,9 +1012,10 @@ class ServingEngine:
                            backend="paged", slot=slot, cached=n_cached)
 
             def _call(toks=toks, pt=pt, lens=lens, resume=resume):
+                arr = self._arr
                 return self._p_prefill(
-                    self._p_outer, self._p_layers, jnp.asarray(toks),
-                    jnp.asarray(pt), jnp.asarray(lens), self._pools,
+                    self._p_outer, self._p_layers, arr(toks),
+                    arr(pt), arr(lens), self._pools,
                     resume_from=resume)
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
@@ -1035,9 +1066,10 @@ class ServingEngine:
             toks[st.slot] = st.tok
 
         def _call():
+            arr = self._arr
             return self._p_decode_n(
-                self._p_outer, self._p_layers, jnp.asarray(toks),
-                jnp.asarray(pt), jnp.asarray(lens), self._pools, n)
+                self._p_outer, self._p_layers, arr(toks),
+                arr(pt), arr(lens), self._pools, n)
         emits, _, self._pools = self._timed(
             tr, clock, "decode", _call, jitfn=self._p_decode_n,
             n=n, rows=len(rows))
@@ -1092,6 +1124,15 @@ class ServingEngine:
             tr.add_span(sid, st.t0, t_fin - st.t0,
                         track=f"slot/{st.slot}", backend="paged")
         self._req_close(tr, r, t_fin, outcome, len(st.out))
+
+    def session(self, *, tracer=None, replica: Optional[str] = None,
+                expect_churn: bool = False) -> "EngineSession":
+        """An incremental session over this engine's configuration —
+        the cluster router's entry point (see ``EngineSession``). The
+        engine object itself is untouched; ``run()`` keeps replaying
+        traces byte-identically."""
+        return EngineSession(self, tracer=tracer, replica=replica,
+                             expect_churn=expect_churn)
 
     # --- dense backend ----------------------------------------------------
     def _run_dense_wave(self, wave, clock, m, outputs,
@@ -1201,3 +1242,357 @@ class ServingEngine:
                     "cancel" if evicted else "completed")
                 self._ctr_finished[outcome].inc()
                 self._req_close(tr, r, fin[i], outcome, len(outs[i]))
+
+
+class EngineSession:
+    """One INCREMENTAL engine replay — the seam the cluster layer
+    composes N replicas through.
+
+    ``ServingEngine.run()`` replays a whole trace start-to-finish on a
+    private clock; a session is the same arrive→admit→route→prefill→
+    decode→finish lifecycle driven from outside, one event at a time:
+
+    - ``submit(r)`` feeds one arrival (the router has already advanced
+      this replica's clock to the arrival time);
+    - ``advance_until(t)`` processes this replica's lane of the shared
+      virtual timeline up to ``t`` — called for EVERY replica before
+      each placement decision, so load/prefix probes answer "as of
+      ``t``", not "as of whenever this replica last ran";
+    - ``pull_unadmitted()`` hands the queued-but-never-admitted backlog
+      back for placement elsewhere (the drain path; in-flight rows keep
+      streaming);
+    - ``finish()`` runs the backlog dry and builds the ``ServeResult``.
+
+    Both admission disciplines drive through here — FIFO
+    (``scheduler=None``) mirrors ``run()``'s loop body, a
+    ``QoSScheduler`` mirrors ``_run_scheduled``'s (shedding, degrade
+    tiers, cache-aware feasibility pricing, running-row timeouts). The
+    single-engine loops are untouched and replay byte-identically.
+
+    Each replica needs its OWN engine (and its own serving factory:
+    factories share live pool buffers, and two sessions allocating page
+    ids from independent bookkeepers over one buffer would corrupt each
+    other's K/V). Timestamps are always explicit, so one shared cluster
+    ``Tracer`` serves N per-replica clocks.
+
+    Per-request metrics, outputs, decisions and slot logs match
+    ``run()`` exactly on the same stream; the one sampled diagnostic
+    that differs is queue-depth cadence (``run()`` also samples on
+    pure arrival-ingestion iterations; a session samples once per
+    turn), so ``queue_depth_mean`` is comparable but not bit-equal.
+    """
+
+    def __init__(self, engine: ServingEngine, *, tracer=None,
+                 replica: Optional[str] = None,
+                 expect_churn: bool = False):
+        eng = self.eng = engine
+        self.replica = replica
+        self.clock = EngineClock(eng.clock_mode, eng.fixed_costs)
+        self.tr = tracer
+        self.m = MetricsCollector()
+        self.book = PagedKVCache(eng.n_pool_pages, eng.page_size,
+                                 kv_heads=1, head_dim=1)
+        self.pages_total = len(self.book._free)
+        self.sched = eng.scheduler
+        self.est: Optional[ServiceEstimator] = None
+        if self.sched is not None:
+            self.sched.reset()
+            costs = eng.fixed_costs or {}
+            est_kw = {}
+            if "prefill_unit" in costs:
+                est_kw = {"prefill_unit": costs["prefill_unit"],
+                          "chunk_tokens": eng.chunk_C}
+            self.est = ServiceEstimator(
+                prefill=costs.get("prefill", 1.0),
+                decode=costs.get("decode", 1.0), **est_kw)
+        self.waiting: List[Request] = []   # FIFO discipline only
+        self.active: Dict[str, _PagedRow] = {}
+        self.free_slots = list(range(eng.slots))
+        self.outputs: Dict[str, List[int]] = {}
+        self.decisions: List[dict] = []
+        self.slot_log: List[tuple] = []
+        self.prefix_cached: Dict[str, int] = {}
+        self.shed_log: Dict[str, str] = {}
+        self.seen_groups: set = set()
+        self.prefill_tokens = 0
+        self.inv_ok = True
+        # True while the router may still submit here; finish() (and a
+        # drain) clears it, enabling run()'s "nothing else will ever
+        # come" admission clause
+        self.more_expected = True
+        self._ctx_base = {"capacity": eng.slots,
+                          "expect_churn": bool(expect_churn)}
+        self._finished: Optional[ServeResult] = None
+
+    # --- placement probes --------------------------------------------------
+    def queued(self) -> int:
+        return self.sched.waiting() if self.sched is not None \
+            else len(self.waiting)
+
+    def load(self) -> int:
+        """The live load signal placement policies read: queued +
+        in-flight requests on this replica."""
+        return self.queued() + len(self.active)
+
+    def match_prefix(self, prompt) -> int:
+        """Non-acquiring probe of THIS replica's paged pool: leading
+        tokens of ``prompt`` its prefix cache could serve right now
+        (0 when the engine runs cache-off)."""
+        if not self.eng.prefix_cache:
+            return 0
+        return self.book.match_prefix(list(prompt))
+
+    # --- arrivals ----------------------------------------------------------
+    def submit(self, r: Request):
+        """One arrival (advance this lane to ``r.arrival`` first)."""
+        eng = self.eng
+        eng._validate([r])
+        self.m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
+                          priority=r.priority,
+                          deadline_ms=r.deadline_ms)
+        eng._ctr_arrived.inc()
+        eng._req_open(self.tr, r)
+        if self.sched is not None:
+            self._shed(self.sched.enqueue(r, self.clock.now()))
+        else:
+            self.waiting.append(r)
+
+    def pull_unadmitted(self) -> List[Request]:
+        """Drain support: remove every queued-but-never-admitted
+        request from this session — the queue entry, the metrics
+        arrival record (it moves with the request, so a cluster rollup
+        counts it ONCE, at wherever it finally runs or sheds) and the
+        trace root (closed with outcome "requeued") — and return them
+        in (arrival, rid) order. In-flight rows are untouched and keep
+        streaming to completion."""
+        if self.sched is not None:
+            reqs = self.sched.drain_queue()
+        else:
+            reqs = sorted(self.waiting,
+                          key=lambda r: (r.arrival, r.rid))
+            self.waiting = []
+        t = self.clock.now()
+        for r in reqs:
+            self.m.forget(r.rid)
+            self.eng._req_close(self.tr, r, t, "requeued", 0)
+        return reqs
+
+    # --- the drive loop ----------------------------------------------------
+    def _shed(self, pairs) -> bool:
+        eng = self.eng
+        for r, reason in pairs:
+            t = self.clock.now()
+            self.m.on_shed(r.rid, t, reason)
+            self.shed_log[r.rid] = reason
+            eng._ctr_shed.inc()
+            if self.tr is not None:
+                self.tr.instant("shed", t=t, track="scheduler",
+                                rid=r.rid, reason=reason,
+                                tenant=r.tenant)
+            eng._req_close(self.tr, r, t, "shed", 0, reason=reason)
+        return bool(pairs)
+
+    def _ready(self) -> bool:
+        """run()'s admission-window test with ``more_expected``
+        standing in for the trace's pending deque. The comparison uses
+        the IDENTICAL float expression ``oldest + max_delay`` as
+        ``_idle_target`` — advance_to(target) must always read as
+        ready on arrival, or one ulp of a large clock livelocks the
+        advance loop (see ``_admission_ready``)."""
+        if self.queued() >= self.eng.admission.max_batch:
+            return True
+        oldest = self.sched.oldest_arrival() if self.sched is not None \
+            else self.waiting[0].arrival
+        if self.clock.now() >= oldest \
+                + self.eng.admission.max_delay - 1e-12:
+            return True
+        return not self.more_expected and not self.active
+
+    def _idle_target(self) -> Optional[float]:
+        """When nothing progressed and nothing runs: the time the
+        oldest waiting request's admission window closes (None with an
+        empty queue — only a new arrival can wake this lane)."""
+        if self.queued() == 0:
+            return None
+        oldest = self.sched.oldest_arrival() if self.sched is not None \
+            else self.waiting[0].arrival
+        return oldest + self.eng.admission.max_delay
+
+    def _turn(self) -> bool:
+        """One scheduler turn: admission attempt + decode chunk —
+        run()'s / _run_scheduled's loop body minus arrival ingestion
+        (the router owns arrivals)."""
+        eng = self.eng
+        clock, tr, m = self.clock, self.tr, self.m
+        now = clock.now()
+        m.on_queue_depth(now, self.queued())
+        if tr is not None:
+            tr.counter("queue_depth", self.queued(), t=now)
+        progressed = False
+        if self.sched is not None:
+            progressed = self._shed(self.sched.shed_expired(now))
+            if self.sched.waiting() and self._ready():
+                progressed |= self._qos_wave(now)
+        elif self.waiting and self._ready():
+            progressed |= self._fifo_wave()
+        if self.active:
+            t0 = clock.now()
+            eng._paged_chunk(self.book, clock, m, self.active,
+                             self.free_slots, self.slot_log,
+                             self.outputs, tr=tr)
+            if self.est is not None:
+                self.est.observe("decode", clock.now() - t0)
+                t = clock.now()
+                for sid in list(self.active):
+                    dl = self.active[sid].req.deadline_time()
+                    if dl is not None and t > dl + 1e-9:
+                        eng._finish_paged(sid, self.book, clock, m,
+                                          self.active, self.free_slots,
+                                          self.slot_log, self.outputs,
+                                          timeout=True, tr=tr)
+            progressed = True
+        self.inv_ok &= self.book.census_ok()
+        return progressed
+
+    def _route_ctx(self, wave):
+        groups = [r.prefix_group for r in wave
+                  if r.prefix_group is not None]
+        shared = (len(groups) != len(set(groups))
+                  or any(g in self.seen_groups for g in groups))
+        return groups, dict(self._ctx_base, shared_prefix=shared,
+                            active_paged=len(self.active))
+
+    def _fifo_wave(self) -> bool:
+        eng, clock, tr, m = self.eng, self.clock, self.tr, self.m
+        wave = self.waiting[:eng.admission.max_batch]
+        groups, ctx = self._route_ctx(wave)
+        backend, reason = eng.policy.route(wave, ctx)
+        decision = {"t": round(clock.now(), 6), "wave": len(wave),
+                    "prompt_lens": [len(r.prompt) for r in wave],
+                    "backend": backend, "rule": reason}
+        if backend == "dense":
+            self.decisions.append(decision)
+            eng._wave_instant(tr, decision)
+            del self.waiting[:len(wave)]
+            self.seen_groups.update(g for g in groups)
+            eng._run_dense_wave(wave, clock, m, self.outputs, tr=tr)
+            return True
+        wave = eng._order_wave(wave)
+        n_adm, _, ptoks = eng._admit_paged(
+            wave, self.book, clock, m, self.active, self.free_slots,
+            self.slot_log, self.prefix_cached, self.seen_groups,
+            self.outputs, tr=tr)
+        self.prefill_tokens += ptoks
+        for r in wave[:n_adm]:
+            self.waiting.remove(r)  # possibly reordered: by identity
+        if n_adm:
+            decision["admitted"] = n_adm
+            decision["admit_rids"] = [r.rid for r in wave[:n_adm]]
+            self.decisions.append(decision)
+            eng._wave_instant(tr, decision)
+        elif not self.active:
+            raise RuntimeError(
+                f"pool/slot config too small for {wave[0].rid} (free "
+                f"pages {len(self.book._free)}, free slots "
+                f"{len(self.free_slots)})")
+        return n_adm > 0
+
+    def _qos_wave(self, now: float) -> bool:
+        eng, clock, tr, m = self.eng, self.clock, self.tr, self.m
+        dec = self.sched.select(
+            now, max_batch=eng.admission.max_batch, est=self.est,
+            decode_chunk=eng.decode_chunk,
+            match_prefix=(self.book.match_prefix if eng.prefix_cache
+                          else None))
+        progressed = self._shed(dec.shed)
+        wave = dec.wave
+        if not wave:
+            return progressed
+        groups, ctx = self._route_ctx(wave)
+        backend, reason = eng.policy.route(wave, ctx)
+        decision = {"t": round(clock.now(), 6), "wave": len(wave),
+                    "prompt_lens": [len(r.prompt) for r in wave],
+                    "backend": backend, "rule": reason,
+                    "rids": [r.rid for r in wave]}
+        if backend == "dense":
+            self.decisions.append(decision)
+            eng._wave_instant(tr, decision)
+            self.seen_groups.update(g for g in groups)
+            eng._commit_wave(wave, dec, self.sched, m, tr=tr,
+                             t=clock.now())
+            eng._run_dense_wave(wave, clock, m, self.outputs,
+                                timeouts=True, tr=tr)
+            return True
+        t0 = clock.now()
+        n_adm, n_chunks, ptoks = eng._admit_paged(
+            wave, self.book, clock, m, self.active, self.free_slots,
+            self.slot_log, self.prefix_cached, self.seen_groups,
+            self.outputs, tr=tr)
+        self.prefill_tokens += ptoks
+        if n_adm:
+            dt = clock.now() - t0
+            self.est.observe("prefill", dt / n_adm)
+            if n_chunks and "prefill_unit" in self.est.costs:
+                self.est.observe("prefill_unit", dt / n_chunks)
+            eng._commit_wave(wave[:n_adm], dec, self.sched, m, tr=tr,
+                             t=clock.now())
+            decision["admitted"] = n_adm
+            self.decisions.append(decision)
+            eng._wave_instant(tr, decision)
+            return True
+        if not self.active:
+            raise RuntimeError(
+                f"pool/slot config too small for {wave[0].rid} (free "
+                f"pages {len(self.book._free)}, free slots "
+                f"{len(self.free_slots)})")
+        return progressed
+
+    def advance_until(self, t: float):
+        """Process this lane up to virtual time ``t``. Compute may
+        overshoot ``t`` (a decode chunk crossing the horizon models a
+        busy replica — same as the single-engine loop); an idle lane's
+        clock jumps straight to ``t`` so later submissions see honest
+        queueing delays."""
+        while True:
+            if self.queued() == 0 and not self.active:
+                self.clock.advance_to(t)
+                return
+            if self.clock.now() >= t - 1e-12:
+                return
+            progressed = self._turn()
+            if not progressed and not self.active:
+                target = self._idle_target()
+                if target is not None and target <= t:
+                    self.clock.advance_to(target)
+                else:
+                    self.clock.advance_to(t)
+                    return
+
+    def finish(self) -> ServeResult:
+        """No more arrivals will ever reach this session: run the
+        backlog dry and build the ServeResult (idempotent)."""
+        if self._finished is not None:
+            return self._finished
+        self.more_expected = False
+        while self.queued() or self.active:
+            progressed = self._turn()
+            if not progressed and not self.active:
+                target = self._idle_target()
+                if target is None:
+                    break  # everything left this turn was shed
+                self.clock.advance_to(target)
+        self._finished = ServeResult(
+            policy=self.eng.policy.name, outputs=self.outputs,
+            metrics=self.m, decisions=self.decisions,
+            slot_log=self.slot_log, prefix_cached=self.prefix_cached,
+            pages_total=self.pages_total,
+            pages_free_end=(len(self.book._free)
+                            + len(self.book._evictable)),
+            scheduler=("fifo" if self.sched is None
+                       else self.sched.name),
+            shed=self.shed_log, trace=self.tr,
+            prefill_tokens=self.prefill_tokens,
+            cache_stats=dict(self.book.cache_stats(),
+                             invariant_ok=self.inv_ok),
+            replica=self.replica)
+        return self._finished

@@ -53,6 +53,28 @@ def _pct(xs, q) -> Optional[float]:
         else None
 
 
+def jain_fairness(xs) -> Optional[float]:
+    """Jain's fairness index over per-tenant allocations (typically
+    weight-normalized goodput): ``(sum x)^2 / (n * sum x^2)``. 1.0 is
+    perfectly fair, ``1/n`` is one tenant taking everything. Returns
+    None when every allocation is zero (the index is undefined, not
+    unfair). ONE implementation shared by the per-run QoS block and the
+    cluster rollup — the two can never disagree on the arithmetic."""
+    xs = [float(x) for x in xs]
+    sq = sum(x * x for x in xs)
+    if sq <= 0 or not xs:
+        return None
+    return round((sum(xs) ** 2) / (len(xs) * sq), 4)
+
+
+def goodput_tokens(views) -> int:
+    """Goodput over request views (``MetricsCollector.request`` dicts):
+    tokens from SLO-met requests ONLY — a shed, late, or evicted
+    request contributes nothing. Shared by the per-run QoS block and
+    the cluster rollup."""
+    return sum(int(v["n_tokens"]) for v in views if v["deadline_met"])
+
+
 class MetricsCollector:
     """Event sink for one engine run; all timestamps come from the
     engine clock (wall-measured or fixed-cost — the collector does not
@@ -120,7 +142,24 @@ class MetricsCollector:
     def on_queue_depth(self, t: float, depth: int):
         self._queue.append((t, depth))
 
+    def forget(self, rid: str):
+        """Erase every trace of ``rid`` from this collector — the
+        cluster router's requeue path: a drained replica's queued-but-
+        unadmitted request moves to a surviving replica, which records
+        the whole lifecycle; keeping the arrival here would count the
+        request twice in any cluster-wide rollup."""
+        self._req.pop(rid, None)
+
     # --- views -----------------------------------------------------------
+    def request_rows(self) -> List[dict]:
+        """Every request's view (``request()`` dict plus its ``rid``),
+        arrival-ordered — the public surface a cluster rollup
+        aggregates across replicas."""
+        return [dict(self.request(rid), rid=rid)
+                for rid in sorted(self._req,
+                                  key=lambda r: (self._req[r].arrival,
+                                                 r))]
+
     def request(self, rid: str) -> dict:
         r = self._req[rid]
         ttft = (r.token_times[0] - r.arrival) if r.token_times else None
@@ -223,14 +262,13 @@ class MetricsCollector:
             "shed_rate": round(shed / arrived, 4) if arrived else 0.0,
         }
         with_dl = [d for d in done if d["deadline_ms"] is not None]
-        hits = [d for d in done if d["deadline_met"]]
         if with_dl:
             dl_hits = sum(1 for d in with_dl if d["deadline_met"])
             qb["deadline_requests"] = len(with_dl)
             qb["deadline_hits"] = dl_hits
             qb["slo_deadline_attained"] = round(
                 dl_hits / len(with_dl), 4)
-        good = sum(d["n_tokens"] for d in hits)
+        good = goodput_tokens(done)
         qb["goodput_tokens"] = good
         qb["goodput_tokens_per_sec"] = round(good / makespan, 4) \
             if makespan > 0 else None
@@ -248,8 +286,7 @@ class MetricsCollector:
                 rids = [rid for rid, r in self._req.items()
                         if r.tenant == t]
                 views = [self.request(rid) for rid in rids]
-                gtok = sum(v["n_tokens"] for v in views
-                           if v["deadline_met"])
+                gtok = goodput_tokens(views)
                 n_shed = sum(1 for v in views if v["shed"])
                 n_dl = [v for v in views
                         if v["deadline_ms"] is not None
@@ -269,9 +306,7 @@ class MetricsCollector:
             qb["tenants"] = per
             # Jain index over weight-normalized per-tenant goodput:
             # 1.0 = perfectly weighted-fair, 1/n = one tenant took all
-            sq = sum(x * x for x in xs)
-            qb["fairness_jain"] = round(
-                (sum(xs) ** 2) / (len(xs) * sq), 4) if sq > 0 else None
+            qb["fairness_jain"] = jain_fairness(xs)
         return qb
 
     def publish(self, registry=None, prefix: str = "serving_run",

@@ -358,6 +358,17 @@ class QoSScheduler:
         cost = (len(e.req.prompt) + b) / self._weight(t)
         self._tags[t] = self._tags.get(t, 0.0) + cost
 
+    def drain_queue(self) -> List[Request]:
+        """Remove and return EVERY queued (never-admitted) request, in
+        (arrival, rid) order — the cluster router's drain path: a
+        draining replica keeps its in-flight rows but hands its queue
+        back for placement on surviving replicas. Fair-queue tags are
+        untouched (history of served work survives the drain)."""
+        reqs = sorted((e.req for e in self._q.values()),
+                      key=lambda r: (r.arrival, r.rid))
+        self._q.clear()
+        return reqs
+
     def shed_expired(self, now: float) -> List[Tuple[Request, str]]:
         """Drop queued requests whose deadline already passed (they
         could only be timed out later for more cost)."""

@@ -1,0 +1,198 @@
+"""Simulated paged decode factory: the scale harness for the serving
+stack.
+
+A real ``llama_serving_decode_factory`` prices a 10^5-request cluster
+trace out of reach on CPU — every prefill and decode turn is a jitted
+program call. The CLUSTER layer's claims, though, are about placement,
+scheduling, drain/join bookkeeping and prefix-cache *routing*, none of
+which need real logits: they need a decode backend whose tokens are a
+deterministic function of the request's full token history **as read
+back through the engine's own page tables**.
+
+``SimServing`` is exactly that surface:
+
+- the "KV pool" is one int array ``pools[page, offset]``; prefill
+  writes the prompt's tokens through the page table (honoring the
+  chunk-aligned ``resume_from`` prefix-cache skip — skipped positions
+  must already hold the publisher's identical tokens), decode writes
+  each input token at its position before emitting the next;
+- every emitted token folds in a read-back through the table (the
+  first token hashes the WHOLE pooled prompt; each decode step folds
+  the previous position's cell), so a wrong page table, a stale
+  prefix chain, or a cross-replica pool mixup diverges the stream —
+  the same failure surface the real backend has, at numpy speed;
+- tokens depend ONLY on the request's own history, so greedy parity
+  across placement policies / replica counts / a single-engine oracle
+  is the honest invariant it is with the real model.
+
+``wants_numpy_`` tells the engine to skip the ``jnp.asarray`` staging
+(pure overhead here). Paged-only by design: build engines with
+``policy="paged"``; the dense parts raise if a wave is ever routed
+there.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_MUL = np.uint64(6364136223846793005)   # splitmix/LCG-grade odd mult
+
+
+def _dense_unsupported(*a, **k):
+    raise NotImplementedError(
+        "SimServing is paged-only (policy='paged'): the sim validates "
+        "paged bookkeeping at scale; route dense waves to a real "
+        "factory")
+
+
+class _SimDense:
+    """Just enough surface for ServingEngine.__init__'s introspection;
+    any actual dense wave raises."""
+
+    def __init__(self):
+        self._parts = {
+            "rolling": False,
+            "outer": {"model.embed_tokens.weight":
+                      np.zeros((1, 1), np.float32)},
+            "init_caches": _dense_unsupported,
+            "prefill": _dense_unsupported,
+            "decode_step": _dense_unsupported,
+        }
+
+
+class SimServing:
+    """Drop-in ``serving=`` object for ``ServingEngine`` (paged only).
+
+    ``vocab`` bounds emitted tokens to ``[1, vocab)`` (0 is the pool's
+    padding value and never emitted); ``salt`` decorrelates two sims
+    that should NOT agree (a negative control for parity tests).
+    """
+
+    wants_numpy_ = True
+
+    def __init__(self, *, max_len: int = 64, page_size: int = 8,
+                 n_pool_pages: int | None = None, slots: int = 8,
+                 vocab: int = 509, salt: int = 0,
+                 chunked_prefill: int | None = None):
+        if max_len % page_size:
+            raise ValueError(f"max_len {max_len} must be a multiple of "
+                             f"page_size {page_size}")
+        if vocab < 3:
+            raise ValueError("vocab must be >= 3")
+        if n_pool_pages is None:
+            n_pool_pages = slots * (max_len // page_size) + 1
+        self.max_len_ = max_len
+        self.page_size_ = page_size
+        self.n_pool_pages_ = n_pool_pages
+        self.chunked_prefill_ = chunked_prefill or page_size
+        if self.chunked_prefill_ % page_size:
+            raise ValueError("chunked_prefill must be a page multiple")
+        self.vocab = int(vocab)
+        self.salt = int(salt)
+        # wrapping-uint64 polynomial-hash powers, highest degree first
+        # (built in python ints mod 2^64 — numpy warns on uint64
+        # SCALAR overflow even though the wrap is exactly what we want)
+        mul, mask = int(_MUL), (1 << 64) - 1
+        p, acc = [], 1
+        for _ in range(max_len):
+            p.append(acc)
+            acc = (acc * mul) & mask
+        self._pow = np.asarray(p, np.uint64)
+        pools = np.zeros((n_pool_pages, page_size), np.int64)
+        self.dense = _SimDense()
+        self.paged_parts = (None, None, pools, self._make_prefill(),
+                            None, self._make_decode_n())
+
+    # --- the token rule ---------------------------------------------------
+    def _first_token(self, seq: np.ndarray) -> int:
+        """Hash of the FULL pooled prompt (uint64 wraparound polynomial
+        — deterministic on any platform), mapped to [1, vocab)."""
+        L = len(seq)
+        with np.errstate(over="ignore"):
+            h = (seq.astype(np.uint64) * self._pow[L - 1::-1]).sum()
+        h = (int(h) + self.salt) & ((1 << 64) - 1)
+        return 1 + h % (self.vocab - 1)
+
+    def _next_token(self, cur: int, prev_cell: int, pos: int) -> int:
+        """One greedy step: the input token (whose 'K/V' was just
+        written), the PREVIOUS position's pooled cell (the read-back
+        that catches table/chain bugs), and the position."""
+        return 1 + (cur * 8121 + prev_cell * 28411
+                    + pos * 134775813 + self.salt) % (self.vocab - 1)
+
+    # --- the factory callables --------------------------------------------
+    def _make_prefill(self):
+        ps = self.page_size_
+        C = self.chunked_prefill_
+
+        def prefill(outer, layers, toks, pt, lens, pools,
+                    resume_from: int = 0):
+            toks = np.asarray(toks)
+            pt = np.asarray(pt)
+            L = int(np.asarray(lens)[0])
+            T = toks.shape[1]
+            # the real factory clamps resume so the FINAL chunk always
+            # runs (the last-position logits must exist)
+            resume = min(int(resume_from), T - C)
+            resume = max(resume, 0)
+            for pos in range(resume, L):
+                pools[pt[0, pos // ps], pos % ps] = toks[0, pos]
+            pages = pt[0, :-(-L // ps)]
+            seq = pools[pages].reshape(-1)[:L]
+            first = self._first_token(seq)
+            return np.asarray([first], np.int64), pools
+
+        prefill._cache_size = lambda: 0  # no jit cache to watch
+        return prefill
+
+    def _make_decode_n(self):
+        ps = self.page_size_
+
+        def decode_n(outer, layers, toks, pt, lens, pools, n: int):
+            toks = np.asarray(toks)
+            pt = np.asarray(pt)
+            lens = np.asarray(lens)
+            S = toks.shape[0]
+            emits = np.zeros((n, S), np.int64)
+            for s in range(S):
+                L = int(lens[s])
+                if L <= 0:
+                    continue  # empty slot rides along (page-0 row)
+                cur = int(toks[s])
+                for k in range(n):
+                    pools[pt[s, L // ps], L % ps] = cur
+                    prev = int(pools[pt[s, (L - 1) // ps], (L - 1) % ps])
+                    cur = self._next_token(cur, prev, L + 1)
+                    emits[k, s] = cur
+                    L += 1
+            return emits, None, pools
+
+        decode_n._cache_size = lambda: 0
+        return decode_n
+
+    # --- the offline oracle -----------------------------------------------
+    def expected_stream(self, prompt, n_tokens: int):
+        """The token stream a request with ``prompt`` generates,
+        computed WITHOUT any engine — the closed-form oracle parity
+        tests compare engine outputs against. (The engine path reads
+        these same values back through page tables; this path replays
+        the recurrence directly.)"""
+        seq = [int(t) for t in prompt]
+        out = []
+        cur = self._first_token(np.asarray(seq, np.int64))
+        out.append(cur)
+        L = len(seq)
+        hist = list(seq)
+        for _ in range(n_tokens - 1):
+            prev = hist[L - 1]
+            hist.append(cur)
+            nxt = self._next_token(cur, prev, L + 1)
+            out.append(nxt)
+            cur = nxt
+            L += 1
+        return out[:n_tokens]
+
+
+def make_sim_serving(**kw) -> SimServing:
+    """Convenience constructor mirroring the real factory's signature
+    style: ``make_sim_serving(max_len=64, page_size=8, slots=8, ...)``."""
+    return SimServing(**kw)

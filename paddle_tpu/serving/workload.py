@@ -331,6 +331,127 @@ def synthesize_recurring_prefix_trace(seed: int = 0, *,
     return reqs
 
 
+def synthesize_cluster_trace(seed: int = 0,
+                             n_requests: int = 100_000, *,
+                             service_tokens_per_unit: float = 7.5,
+                             overload: float = 1.7,
+                             tenants: Optional[dict] = None,
+                             n_cohorts: int = 24,
+                             prefix_len: int = 32,
+                             cohort_frac: float = 0.8,
+                             cohort_skew: float = 1.1,
+                             tail_len: Tuple[int, int] = (2, 8),
+                             output_len: Tuple[int, int] = (4, 12),
+                             vocab_size: int = 509,
+                             unit_ms: float = 1000.0,
+                             chunk_tokens: int = 8,
+                             tight_slack: float = 2.0,
+                             loose_slack: float = 6.0,
+                             rid_prefix: str = "c",
+                             start: float = 0.0) -> List[Request]:
+    """The cluster-scale workload: ~10^5 requests of multi-tenant
+    OVERLOAD traffic whose prompts are dominated by shared-prefix
+    cohorts — the shape where prefix-aware placement earns its keep.
+
+    ``service_tokens_per_unit`` is the CLUSTER's decode capacity
+    (``n_replicas * slots * decode_chunk / decode_cost`` on a fixed
+    clock); arrivals are scaled so demanded output tokens land at
+    ``overload`` x that rate — enough pressure that placement quality
+    converts into goodput, not just latency.
+
+    ``cohort_frac`` of requests open with one of ``n_cohorts`` fixed
+    ``prefix_len``-token system prompts; cohort choice is SKEWED by a
+    Zipf-like law (weight ``1/(rank+1)^cohort_skew``) so hot cohorts
+    dominate, exactly like production system prompts. Sized right
+    (total cohort prefix pages >> one replica's retention slack,
+    per-replica share of cohorts <= that slack), round-robin placement
+    makes every replica serve every cohort and thrash its retention
+    LRU, while prefix-aware placement partitions cohorts across
+    replicas and hits. Solo prompts draw a random prefix-length body
+    plus the same tail distribution, so cohort and solo requests load
+    the engine identically.
+
+    Tenants follow ``DEFAULT_TENANTS`` semantics (share / priority /
+    burst / deadline mode); per-request ``deadline_ms`` is
+    ``(ceil(prompt/chunk_tokens) + budget + 1) * unit_ms * slack`` —
+    the lone-request service estimate under per-chunk prefill pricing
+    times the cohort's slack. rids are
+    ``{rid_prefix}-{tenant}{i}.k{cohort|solo}.{tight|loose}`` so
+    benches can split cohorts and SLO classes without a side channel.
+    Deterministic in every field: same (seed, knobs) -> same trace.
+    """
+    spec = tenants if tenants is not None else DEFAULT_TENANTS
+    if not spec:
+        raise ValueError("need at least one tenant")
+    if not 0.0 <= cohort_frac <= 1.0:
+        raise ValueError("cohort_frac must be in [0, 1]")
+    if n_cohorts < 1 or prefix_len < 1:
+        raise ValueError("need >= 1 cohort and >= 1 prefix token")
+    rng = np.random.default_rng(seed)
+    prefixes = [tuple(int(t) for t in rng.integers(
+        1, vocab_size, prefix_len)) for _ in range(n_cohorts)]
+    cw = np.asarray([1.0 / (c + 1) ** cohort_skew
+                     for c in range(n_cohorts)])
+    cw = cw / cw.sum()
+
+    names = sorted(spec)
+    shares = np.asarray([float(spec[n].get("share", 1.0))
+                         for n in names])
+    shares = shares / shares.sum()
+    counts = np.floor(shares * n_requests).astype(int)
+    order = np.argsort(-shares)
+    k = 0
+    while counts.sum() < n_requests:
+        counts[order[k % len(names)]] += 1
+        k += 1
+
+    budgets = {n: [int(rng.integers(output_len[0], output_len[1] + 1))
+                   for _ in range(counts[i])]
+               for i, n in enumerate(names)}
+    total_tokens = sum(sum(b) for b in budgets.values())
+    span = total_tokens / (overload * service_tokens_per_unit)
+
+    reqs: List[Request] = []
+    for i, name in enumerate(names):
+        cfg = spec[name]
+        n_t = int(counts[i])
+        if n_t == 0:
+            continue
+        burst = max(1, int(cfg.get("burst", 1)))
+        n_bursts = -(-n_t // burst)
+        burst_times = np.sort(rng.uniform(0.0, span, n_bursts))
+        times = np.repeat(burst_times, burst)[:n_t]
+        mode = cfg.get("deadline", "mix")
+        for j in range(n_t):
+            tlen = int(rng.integers(tail_len[0], tail_len[1] + 1))
+            tail = tuple(int(t) for t in rng.integers(
+                1, vocab_size, tlen))
+            if cohort_frac > 0 and rng.random() < cohort_frac:
+                c = int(rng.choice(n_cohorts, p=cw))
+                prompt = prefixes[c] + tail
+                ctag = f"k{c}"
+            else:
+                body = tuple(int(t) for t in rng.integers(
+                    1, vocab_size, prefix_len))
+                prompt = body + tail
+                ctag = "solo"
+            budget = budgets[name][j]
+            tight = {"tight": True, "loose": False}.get(mode, None)
+            if tight is None:
+                tight = bool(rng.random() < 0.5)
+            slack = tight_slack if tight else loose_slack
+            cohort = "tight" if tight else "loose"
+            chunks = -(-len(prompt) // chunk_tokens)
+            reqs.append(Request(
+                rid=f"{rid_prefix}-{name}{j}.{ctag}.{cohort}",
+                arrival=start + float(times[j]), prompt=prompt,
+                max_new_tokens=budget, tenant=name,
+                priority=int(cfg.get("priority", 0)),
+                deadline_ms=round((chunks + budget + 1) * unit_ms
+                                  * slack, 3)))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def merge_traces(*traces: Sequence[Request]) -> List[Request]:
     """Interleave traces by arrival time (rids must already be unique —
     give each source a distinct ``rid_prefix``)."""

@@ -1,0 +1,588 @@
+"""Multi-replica serving cluster (PR 6).
+
+Sim level: the deterministic pool-backed token rule matches its
+closed-form oracle, is independent of slot count (tokens depend only on
+the request's own history), and decorrelates under ``salt``. Session
+level: the incremental ``EngineSession`` reproduces ``run()`` outputs /
+slot logs / sheds on both admission disciplines. Cluster level:
+placement policies, drain/join edge cases (zero in-flight, requeue
+under overload with no double-counting, cold-cache join), full-replay
+determinism, rollup/census, the shared-helper extraction
+(``jain_fairness``/``goodput_tokens``), the ``replica`` log field
+round-trip, per-replica trace-report rows, and the ``serving_cluster``
+bench-gate contract (no model needed for any of those). One real-model
+smoke proves cluster streams equal a lone engine's on actual weights.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (ClusterRouter, QoSScheduler, Request,
+                                ServingEngine, goodput_tokens,
+                                jain_fairness, load_engine_log,
+                                make_placement, make_sim_serving,
+                                synthesize_cluster_trace,
+                                synthesize_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill_unit": 1.0, "decode": 1.0}
+
+
+def _sim(slots=4, extra=8, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("vocab", 211)
+    kw.setdefault("n_pool_pages",
+                  slots * (kw["max_len"] // kw["page_size"]) + 1 + extra)
+    return make_sim_serving(slots=slots, **kw)
+
+
+def _engine(slots=4, scheduler=None, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", COSTS)
+    return ServingEngine(serving=_sim(slots=slots), slots=slots,
+                         policy="paged", scheduler=scheduler, **kw)
+
+
+def _req(rid, arrival, prompt, budget, **kw):
+    return Request(rid=rid, arrival=arrival, prompt=tuple(prompt),
+                   max_new_tokens=budget, **kw)
+
+
+def _mixed_trace(n=24, seed=3, **kw):
+    kw.setdefault("arrival", "poisson")
+    kw.setdefault("mean_interarrival", 0.5)
+    kw.setdefault("prompt_len", (4, 20))
+    kw.setdefault("output_len", (3, 10))
+    kw.setdefault("vocab_size", 211)
+    return synthesize_trace(seed=seed, n_requests=n, rid_prefix="m",
+                            **kw)
+
+
+def _run_cluster(trace, n=2, placement="round_robin", scheduler=None,
+                 events=(), slots=4, trace_out=None):
+    def spawn(name):
+        return _engine(slots=slots,
+                       scheduler=(QoSScheduler(max_queue=scheduler)
+                                  if scheduler else None))
+    r = ClusterRouter(spawn, n, placement=placement, trace=trace_out)
+    return r.run(trace, events=events)
+
+
+# --- shared metric helpers (satellite) --------------------------------------
+
+def test_jain_fairness_helper():
+    assert jain_fairness([5.0, 5.0, 5.0]) == 1.0
+    assert jain_fairness([9.0, 0.0, 0.0]) == pytest.approx(1 / 3,
+                                                           abs=5e-4)
+    assert jain_fairness([0.0, 0.0]) is None
+    assert jain_fairness([]) is None
+    # the qos block and the helper are ONE implementation: a lone
+    # engine's QoS report must carry exactly the helper's value
+    tr = [_req(f"q{i}", 0.0, range(1, 9), 4, tenant=t)
+          for i, t in enumerate(["a", "a", "b"])]
+    res = _engine().run(tr)
+    rep = res.report()
+    xs = [rep["tenants"][t]["goodput_tokens"] for t in sorted(
+        rep["tenants"])]
+    assert rep["fairness_jain"] == jain_fairness(xs)
+
+
+def test_goodput_tokens_helper():
+    views = [{"n_tokens": 5, "deadline_met": True},
+             {"n_tokens": 7, "deadline_met": False},
+             {"n_tokens": 2, "deadline_met": True}]
+    assert goodput_tokens(views) == 7
+
+
+# --- the sim backend --------------------------------------------------------
+
+def test_sim_matches_closed_form_oracle():
+    sim = _sim()
+    eng = ServingEngine(serving=sim, slots=4, policy="paged",
+                        clock="fixed", fixed_costs=COSTS)
+    trace = _mixed_trace(shared_prefix_frac=0.4, prefix_len=8,
+                         churn_frac=0.2)
+    res = eng.run(trace)
+    ref = _sim()  # fresh sim: expected_stream must not depend on state
+    for r in trace:
+        got = res.outputs[r.rid]
+        assert got == ref.expected_stream(r.prompt, len(got)), r.rid
+    assert res.cache_stats["invariant_ok"]
+
+
+def test_sim_tokens_independent_of_slots_and_salt():
+    trace = _mixed_trace(n=12)
+    a = _engine(slots=2).run(trace)
+    b = _engine(slots=6).run(trace)
+    assert a.outputs == b.outputs  # batch shape never leaks into tokens
+    salted = ServingEngine(serving=_sim(salt=1), slots=4,
+                           policy="paged", clock="fixed",
+                           fixed_costs=COSTS).run(trace)
+    assert salted.outputs != a.outputs  # the negative control
+
+
+def test_sim_is_paged_only():
+    with pytest.raises(NotImplementedError, match="paged-only"):
+        _sim().dense._parts["prefill"]()
+    with pytest.raises(ValueError, match="multiple"):
+        make_sim_serving(max_len=60, page_size=8)
+
+
+# --- EngineSession vs run() -------------------------------------------------
+
+def _drive_session(eng, trace, **kw):
+    s = eng.session(**kw)
+    for r in sorted(trace, key=lambda r: (r.arrival, r.rid)):
+        s.advance_until(r.arrival)
+        s.submit(r)
+    return s.finish()
+
+
+def test_session_matches_run_fifo():
+    trace = _mixed_trace(shared_prefix_frac=0.4, prefix_len=8,
+                         churn_frac=0.2)
+    res = _engine().run(trace)
+    ses = _drive_session(_engine(), trace, expect_churn=True)
+    assert ses.outputs == res.outputs
+    assert ses.slot_log == res.slot_log
+    assert ses.decisions == res.decisions
+    assert ses.prefix_cached == res.prefix_cached
+    assert ses.cache_stats == res.cache_stats
+
+
+def test_session_matches_run_qos():
+    from paddle_tpu.serving import synthesize_overload_trace
+    trace = synthesize_overload_trace(seed=0, n_requests=40,
+                                      service_tokens_per_unit=4.0,
+                                      overload=2.0, vocab_size=211)
+    w = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+    res = _engine(scheduler=QoSScheduler(tenant_weights=w)).run(trace)
+    ses = _drive_session(
+        _engine(scheduler=QoSScheduler(tenant_weights=w)), trace)
+    assert ses.outputs == res.outputs
+    assert ses.shed == res.shed
+    assert ses.slot_log == res.slot_log
+    a, b = res.report(tenant_weights=w), ses.report(tenant_weights=w)
+    # every per-request metric agrees; the one sampled diagnostic with
+    # a different cadence is queue_depth (documented on EngineSession)
+    for k in a:
+        if not k.startswith("queue_depth"):
+            assert a[k] == b[k], k
+
+
+# --- placement policies -----------------------------------------------------
+
+def test_round_robin_rotates():
+    trace = [_req(f"a{i}", float(i), range(1, 9), 2) for i in range(6)]
+    res = _run_cluster(trace, n=3, placement="round_robin")
+    assert [res.ledger[f"a{i}"]["replica"] for i in range(6)] == \
+        ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+
+def test_least_loaded_balances():
+    # 4 simultaneous arrivals over 2 replicas: 2 land on each
+    trace = [_req(f"b{i}", 0.0, range(1, 9), 6) for i in range(4)]
+    res = _run_cluster(trace, n=2, placement="least_loaded")
+    placed = [res.ledger[f"b{i}"]["replica"] for i in range(4)]
+    assert placed.count("r0") == placed.count("r1") == 2
+
+
+def test_prefix_aware_coplaces_sharers():
+    rng = np.random.default_rng(0)
+    pfx = [tuple(int(t) for t in rng.integers(1, 211, 16))
+           for _ in range(2)]
+    trace = []
+    t = 0.0
+    for i in range(8):
+        c = i % 2
+        tail = tuple(int(t_) for t_ in rng.integers(1, 211, 3))
+        trace.append(_req(f"p{i}.k{c}", t, pfx[c] + tail, 3))
+        t += 4.0  # spaced out: placement sees registered prefixes
+    res = _run_cluster(trace, n=2, placement="prefix_aware")
+    homes = {c: {res.ledger[r.rid]["replica"] for r in trace
+                 if r.rid.endswith(f"k{c}")} for c in (0, 1)}
+    # each cohort converges onto ONE replica...
+    assert all(len(h) == 1 for h in homes.values()), homes
+    # ...and the sharers actually hit its cache
+    hits = {}
+    for name, r in res.results.items():
+        hits.update(r.prefix_cached)
+    assert sum(1 for i in range(8) if hits[trace[i].rid] >= 16) == 6
+    # cross-check the rollup counts them
+    assert res.report()["prefill_tokens_saved"] > 0
+
+
+def test_prefix_aware_falls_back_below_threshold():
+    # nothing cached anywhere -> pure least-loaded placement
+    trace = [_req(f"f{i}", 0.0, range(10 * i + 1, 10 * i + 9), 4)
+             for i in range(4)]
+    res = _run_cluster(trace, n=2, placement="prefix_aware")
+    placed = [res.ledger[f"f{i}"]["replica"] for i in range(4)]
+    assert placed.count("r0") == placed.count("r1") == 2
+
+
+def test_make_placement_validates():
+    with pytest.raises(ValueError, match="placement"):
+        make_placement("best_effort")
+    pol = make_placement("prefix_aware", 8)
+    assert pol.threshold == 8 and pol.name == "prefix_aware"
+
+
+# --- drain / join edge cases ------------------------------------------------
+
+def test_drain_with_zero_inflight_removes_cleanly():
+    trace = [_req("z0", 0.0, range(1, 9), 2)]
+    # drain r1 long after r0 served everything: nothing to requeue
+    res = _run_cluster(trace, n=2, placement="round_robin",
+                       events=[(50.0, "drain", "r1")])
+    ev = {e["event"]: e for e in res.events}
+    assert ev["drain"]["requeued"] == []
+    assert ev["remove"]["replica"] == "r1"
+    assert ev["remove"]["census_ok"] is True
+    cen = res.census()
+    assert cen["conserved"] and cen["removal_census_ok"]
+    assert cen["requeued"] == 0
+
+
+def test_drain_under_overload_requeues_without_double_count():
+    # one-slot replicas + a burst: the drained replica is mid-prefill
+    # with a queue, which MUST move to the survivor and be counted once
+    trace = [_req(f"o{i}", 0.0, range(1, 17), 8) for i in range(8)]
+    res = _run_cluster(trace, n=2, placement="round_robin", slots=1,
+                       events=[(6.0, "drain", "r0")])
+    cen = res.census()
+    assert cen["requeued"] >= 1
+    assert cen["conserved"], cen
+    assert cen["duplicated"] == [] and cen["lost"] == []
+    per = cen["tenants"]["_none"]
+    assert per["completed"] + per["shed"] == per["arrived"] == 8
+    # requeued rids moved their whole metrics record: the drained
+    # replica's collector no longer knows them
+    drained = res.results["r0"]
+    requeued = [rid for rid, led in res.ledger.items()
+                if led["requeues"]]
+    for rid in requeued:
+        assert rid not in drained.outputs
+        assert rid not in [v["rid"] for v
+                           in drained.metrics.request_rows()]
+    # in-flight work on r0 was NOT killed: it finished something
+    assert drained.outputs
+    ev = {e["event"]: e for e in res.events}
+    assert ev["drain"]["in_flight"] >= 1
+    assert ev["remove"]["census_ok"] is True
+
+
+def test_join_mid_trace_gets_cold_cache_traffic():
+    rng = np.random.default_rng(1)
+    pfx = tuple(int(t) for t in rng.integers(1, 211, 16))
+    trace = [_req(f"j{i}", float(i), pfx + (100 + i,), 3)
+             for i in range(10)]
+    res = _run_cluster(trace, n=1, placement="least_loaded",
+                       events=[(4.5, "join", "r1")])
+    joined = res.results["r1"]
+    assert joined.outputs  # the joiner actually served traffic
+    # its FIRST request found a cold cache (0 prefix tokens), later
+    # sharers hit what it registered
+    first = min(joined.prefix_cached,
+                key=lambda rid: joined.metrics.request(rid)["arrival"])
+    assert joined.prefix_cached[first] == 0
+    assert res.census()["conserved"]
+
+
+def test_cluster_replay_is_deterministic():
+    trace = synthesize_cluster_trace(seed=7, n_requests=300,
+                                     service_tokens_per_unit=8.0,
+                                     vocab_size=211)
+    ev = [(trace[120].arrival, "drain", "r0"),
+          (trace[160].arrival, "join", "r2")]
+
+    def one():
+        res = _run_cluster(trace, n=2, placement="prefix_aware",
+                           scheduler=16, events=ev)
+        w = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+        return (json.dumps(res.report(tenant_weights=w),
+                           sort_keys=True),
+                res.outputs(), res.events,
+                {n: r.shed for n, r in res.results.items()})
+
+    assert one() == one()  # byte-identical replay, lifecycle included
+
+
+def test_drain_errors():
+    trace = [_req("e0", 0.0, range(1, 9), 2)]
+    with pytest.raises(ValueError, match="no live replica"):
+        _run_cluster(trace, n=1, events=[(0.0, "drain", "r9")])
+    with pytest.raises(RuntimeError, match="no admitting replica"):
+        _run_cluster(trace, n=1, events=[(0.0, "drain", "r0")])
+    with pytest.raises(ValueError, match="already live"):
+        _run_cluster(trace, n=2, events=[(0.0, "join", "r1")])
+    # rejoining a RETIRED name would overwrite its banked ServeResult
+    # (every request it served would read as lost) — refused loudly
+    with pytest.raises(ValueError, match="fresh name"):
+        _run_cluster(trace, n=2, events=[(10.0, "drain", "r1"),
+                                         (20.0, "join", "r1")])
+
+
+# --- rollup / result surfaces -----------------------------------------------
+
+def test_cluster_rollup_and_census():
+    trace = synthesize_cluster_trace(seed=2, n_requests=400,
+                                     service_tokens_per_unit=8.0,
+                                     vocab_size=211)
+    res = _run_cluster(trace, n=2, placement="prefix_aware",
+                       scheduler=16)
+    w = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+    rep = res.report(tenant_weights=w)
+    assert rep["arrived"] == 400
+    assert rep["completed"] + rep["shed"] == 400
+    assert rep["placement"] == "prefix_aware"
+    assert set(rep["per_replica"]) == {"r0", "r1"}
+    for pr in rep["per_replica"].values():
+        assert pr["census_ok"] is True
+    assert rep["prefill_tokens"] == sum(
+        pr["prefill_tokens"] for pr in rep["per_replica"].values())
+    assert rep["goodput_tokens"] <= rep["generated_tokens"]
+    assert set(rep["tenants"]) == {"bulk", "intl", "std"}
+    xs = [rep["tenants"][t]["goodput_tokens"] / w[t]
+          for t in sorted(rep["tenants"])]
+    assert rep["fairness_jain"] == jain_fairness(xs)
+    cen = res.census()
+    assert cen["conserved"] and cen["pool_census_ok"]
+    # outputs() merges without collisions
+    assert len(res.outputs()) == rep["completed"]
+
+
+def test_router_runs_once():
+    trace = [_req("x0", 0.0, range(1, 9), 2)]
+    router = ClusterRouter(lambda name: _engine(), 1)
+    router.run(trace)
+    with pytest.raises(RuntimeError, match="runs once"):
+        router.run(trace)
+
+
+# --- the replica log field (satellite) --------------------------------------
+
+def test_save_log_replica_field_roundtrip(tmp_path):
+    trace = _mixed_trace(n=6)
+    res = _engine().run(trace)
+    plain = str(tmp_path / "plain.jsonl")
+    res.save_log(plain)
+    body = open(plain).read()
+    assert '"replica"' not in body  # old format byte-identical
+    loaded = load_engine_log(plain)
+    assert all(len(t) == 4 for t in loaded["slot_log"])
+    # the same result stamped as a replica tags EVERY record
+    import dataclasses
+    tagged = dataclasses.replace(res, replica="r3")
+    tpath = str(tmp_path / "tagged.jsonl")
+    tagged.save_log(tpath)
+    for ln in open(tpath).read().splitlines():
+        assert json.loads(ln)["replica"] == "r3"
+    tl = load_engine_log(tpath)
+    assert tl["meta"]["replica"] == "r3"
+    assert all(len(t) == 5 and t[4] == "r3" for t in tl["slot_log"])
+    # and the untagged fields round-trip identically either way
+    assert [t[:4] for t in tl["slot_log"]] == loaded["slot_log"]
+    assert [{k: v for k, v in d.items() if k != "replica"}
+            for d in tl["decisions"]] == loaded["decisions"]
+
+
+# --- per-replica trace report rows (satellite) ------------------------------
+
+def test_cluster_trace_per_replica_rows(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from trace_report import (load_trace, replica_summaries,
+                                  summarize, track_names,
+                                  track_summaries)
+    finally:
+        sys.path.pop(0)
+    out = str(tmp_path / "cluster_trace.json")
+    trace = _mixed_trace(n=16)
+    _run_cluster(trace, n=2, placement="least_loaded", trace_out=out)
+    events = load_trace(out)
+    tracks = track_names(events)
+    reps = replica_summaries(events, tracks)
+    assert [r["replica"] for r in reps] == ["r0", "r1"]
+    for r in reps:
+        assert r["slot_busy_frac"] > 0 and r["requests"] > 0
+    # every root closed; global row still reads the cluster trace
+    summ = summarize(events)
+    assert summ["open_roots"] == [] and summ["requests"] == 16
+    per_track = {r["track"]: r for r in track_summaries(events, tracks)}
+    assert per_track["r0/engine"]["spans"] > 0
+    # a LONE engine's trace yields no replica rows (no prefix)
+    solo = str(tmp_path / "solo.json")
+    _engine(trace=solo).run(trace)
+    sev = load_trace(solo)
+    assert replica_summaries(sev, track_names(sev)) == []
+
+
+# --- the serving_cluster bench-gate family ----------------------------------
+
+def _run_gate(text, tmp_path):
+    env = {**os.environ,
+           "BENCH_GATE_SERVING_BASELINE": str(tmp_path / "b.json")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "serving", "-"], input=text, capture_output=True, text=True,
+        timeout=60, cwd=REPO, env=env)
+    return r.returncode, [json.loads(ln) for ln in
+                          r.stdout.strip().splitlines()]
+
+
+def _cluster_row(placement, goodput, *, jain=0.6, saved=1000,
+                 conserved=True, pools=True):
+    return json.dumps({
+        "bench": "serving_cluster", "placement": placement,
+        "goodput_tokens_per_sec": goodput, "fairness_jain": jain,
+        "prefill_tokens_saved": saved, "conserved": conserved,
+        "pool_census_ok": pools, "arrived": 1000, "replicas": 4,
+        "device": "sim"})
+
+
+def _summary_row(parity=True):
+    return json.dumps({"bench": "serving_cluster_summary",
+                       "parity_ok": parity,
+                       "parity_vs_oracle": {"round_robin": parity}})
+
+
+def _life_row(conserved=True, requeued=3, removal=True, parity=True):
+    return json.dumps({"bench": "serving_cluster_lifecycle",
+                       "conserved": conserved, "requeued": requeued,
+                       "removal_census_ok": removal,
+                       "pool_census_ok": True,
+                       "parity_vs_oracle": parity,
+                       "lost": [], "duplicated": []})
+
+
+def test_bench_gate_serving_cluster_family(tmp_path):
+    base = [_cluster_row("round_robin", 10.0),
+            _cluster_row("least_loaded", 10.5),
+            _cluster_row("prefix_aware", 12.0, jain=0.65, saved=2000)]
+
+    # pass: 1.2x goodput, fairness up, saved strictly greater
+    rc, recs = _run_gate("\n".join(base + [_summary_row(),
+                                           _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+    assert recs[-1]["prefix_vs_round_robin_goodput"] == 1.2
+
+    # sub-floor goodput FAILs naming the floor
+    rows = [base[0], base[1],
+            _cluster_row("prefix_aware", 11.0, saved=2000)]
+    rc, recs = _run_gate("\n".join(rows + [_summary_row(),
+                                           _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "1.15" in json.dumps(recs[-1])
+
+    # fairness traded away FAILs even with goodput
+    rows = [base[0], base[1],
+            _cluster_row("prefix_aware", 12.0, jain=0.3, saved=2000)]
+    rc, recs = _run_gate("\n".join(rows + [_summary_row(),
+                                           _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "fairness" in recs[-1]["reason"]
+
+    # saved must be STRICTLY greater
+    rows = [base[0], base[1],
+            _cluster_row("prefix_aware", 12.0, saved=1000)]
+    rc, recs = _run_gate("\n".join(rows + [_summary_row(),
+                                           _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "co-placed" in recs[-1]["reason"]
+
+    # parity divergence is correctness, not placement
+    rc, recs = _run_gate("\n".join(base + [_summary_row(False),
+                                           _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "DIVERGING" in recs[-1]["reason"]
+
+    # broken conservation on any placement row
+    rows = [base[0], base[1],
+            _cluster_row("prefix_aware", 12.0, saved=2000,
+                         conserved=False)]
+    rc, recs = _run_gate("\n".join(rows + [_summary_row(),
+                                           _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "census" in recs[-1]["reason"]
+
+    # lifecycle row: missing -> FAIL; requeued==0 -> FAIL (the drain
+    # never exercised the requeue path the invariant is about)
+    rc, recs = _run_gate("\n".join(base + [_summary_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "lifecycle" in recs[-1]["reason"]
+    rc, recs = _run_gate("\n".join(base + [
+        _summary_row(), _life_row(requeued=0)]) + "\n", tmp_path)
+    assert rc == 1 and "requeued" in recs[-1]["reason"]
+
+    # missing prefix_aware row -> graceful FAIL, never a traceback
+    rc, recs = _run_gate("\n".join(base[:2] + [_summary_row(),
+                                               _life_row()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "prefix_aware" in recs[-1]["reason"]
+
+    # a cluster FAIL must not be masked by a passing qos family: the
+    # combined verdict is the last record
+    qos = [json.dumps({"bench": "serving_qos", "scheduler": s,
+                       "goodput_tokens_per_sec": g,
+                       "slo_tight_attained": 1.0, "tight_requests": 5,
+                       "deadline_hits": 5, "completed": 10, "shed": 0,
+                       "arrived": 10, "device": "cpu"})
+           for s, g in (("fifo", 1.0), ("qos", 1.6))]
+    rows = [base[0], base[1],
+            _cluster_row("prefix_aware", 11.0, saved=2000)]
+    rc, recs = _run_gate("\n".join(qos + rows + [
+        _summary_row(), _life_row()]) + "\n", tmp_path)
+    assert rc == 1
+    assert recs[-1]["combined"] is True
+    assert recs[-1]["qos_gate"] == "pass"
+    assert recs[-1]["cluster_gate"] == "FAIL"
+
+
+# --- real-model smoke -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_cluster_matches_lone_engine_on_real_model(tiny_model):
+    """2 real-factory replicas vs one lone engine: every request's
+    greedy stream identical — placement is bookkeeping, never math.
+    Each replica gets its OWN factory (pool buffers are per-factory)."""
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+
+    def factory():
+        return llama_serving_decode_factory(
+            tiny_model, max_len=48, page_size=8, n_pool_pages=13,
+            batch_capacity=2, chunked_prefill=8)
+
+    trace = synthesize_trace(seed=5, n_requests=6, arrival="poisson",
+                             mean_interarrival=1.0, prompt_len=(4, 10),
+                             output_len=(2, 4), vocab_size=97,
+                             rid_prefix="rm")
+
+    def spawn(name):
+        return ServingEngine(serving=factory(), slots=2,
+                             policy="paged", clock="fixed",
+                             fixed_costs=COSTS)
+
+    res = ClusterRouter(spawn, 2, placement="least_loaded").run(trace)
+    lone = ServingEngine(serving=factory(), slots=2, policy="paged",
+                         clock="fixed", fixed_costs=COSTS).run(trace)
+    assert res.outputs() == lone.outputs
+    assert res.census()["conserved"]

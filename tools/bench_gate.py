@@ -47,6 +47,15 @@ cannot silently ship a slower build. Three modes:
       #    TTFT p50 >= 1.3x vs the cache-off arm, with byte-identical
       #    greedy tokens and the pool census invariant (resident +
       #    evictable + free == pool size) held at every engine turn.
+      #  - serving_cluster (tools/serving_workload_bench.py --cluster):
+      #    on the ~10^5-request multi-replica overload trace,
+      #    prefix_aware placement must reach >= 1.15x round_robin's
+      #    aggregate goodput with Jain fairness held and strictly more
+      #    prefill saved; greedy streams must agree across placements
+      #    and the single-engine oracle; per-tenant request
+      #    conservation (completed + shed == arrived) must hold
+      #    cluster-wide AND across the mid-trace drain+join arm, with
+      #    the drained replica's pool census balanced at removal.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -364,6 +373,150 @@ def check_serving_prefix(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+CLUSTER_GOODPUT_FLOOR = 1.15  # prefix_aware vs round_robin goodput
+
+
+def check_serving_cluster(rows: list) -> int:
+    """Gate the multi-replica rows from serving_workload_bench.py
+    --cluster: on the ~10^5-request overload trace (fixed clock, sim
+    replicas) prefix_aware placement must reach >=
+    CLUSTER_GOODPUT_FLOOR x round_robin's aggregate goodput WITHOUT
+    trading fairness away (Jain >= round_robin's) and with strictly
+    more prefill tokens saved; greedy streams must agree across all
+    placements and the single-engine oracle; every placement's census
+    must conserve requests (completed + shed == arrived per tenant, no
+    rid lost or duplicated) with the pool invariant held; and the
+    drain+join arm must conserve across the mid-trace lifecycle with
+    the drained replica's census balanced at removal. round_robin is
+    the baseline re-measured in the same run — no stamped file."""
+    cr = [r for r in rows if r.get("bench") == "serving_cluster"]
+    by = {r.get("placement"): r for r in cr}
+    rr, pa = by.get("round_robin"), by.get("prefix_aware")
+    if rr is None or pa is None:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_cluster rows need BOTH a "
+                                    "round_robin and a prefix_aware "
+                                    "placement row (run tools/serving_"
+                                    "workload_bench.py --cluster)"}))
+        return 1
+    for r in cr:
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "placement": r.get("placement"),
+                "reason": "cluster census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')} — a request was "
+                          "lost/duplicated or pages leaked"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_cluster_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_cluster_summary row — "
+                                    "cross-placement/oracle token "
+                                    "parity is UNVERIFIED (rerun the "
+                                    "--cluster arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("parity_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "placements produced DIVERGING "
+                                    "greedy streams vs each other or "
+                                    "the single-engine oracle "
+                                    "(correctness, not placement)",
+                          "parity_vs_oracle":
+                          s.get("parity_vs_oracle")}))
+        return 1
+    life = [r for r in rows
+            if r.get("bench") == "serving_cluster_lifecycle"]
+    if not life:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_cluster_lifecycle row "
+                                    "— the drain/join conservation "
+                                    "invariant is UNVERIFIED"}))
+        return 1
+    lf = life[-1]
+    if not (lf.get("conserved") is True
+            and lf.get("removal_census_ok") is True
+            and lf.get("pool_census_ok") is True
+            and int(lf.get("requeued") or 0) >= 1
+            and lf.get("parity_vs_oracle") is True):
+        print(json.dumps({
+            "gate": "FAIL",
+            "reason": "drain/join invariant broken: conserved="
+                      f"{lf.get('conserved')} removal_census_ok="
+                      f"{lf.get('removal_census_ok')} requeued="
+                      f"{lf.get('requeued')} parity="
+                      f"{lf.get('parity_vs_oracle')} (requeued must "
+                      "be >= 1 or the drain never exercised the "
+                      "requeue path)",
+            "lost": lf.get("lost"),
+            "duplicated": lf.get("duplicated")}))
+        return 1
+    tr_rows = [r for r in rows
+               if r.get("bench") == "serving_cluster_trace"]
+    if tr_rows:
+        reps = tr_rows[-1].get("replicas") or []
+        idle = [r.get("replica") for r in reps
+                if not (r.get("slot_busy_frac") or 0) > 0
+                or not (r.get("requests") or 0) > 0]
+        if not reps or idle:
+            print(json.dumps({
+                "gate": "FAIL",
+                "reason": f"per-replica trace evidence broken: "
+                          f"replicas {idle or 'MISSING'} show zero "
+                          "slot occupancy or zero requests in the "
+                          "chrome trace"}))
+            return 1
+    rr_g = float(rr.get("goodput_tokens_per_sec") or 0.0)
+    pa_g = float(pa.get("goodput_tokens_per_sec") or 0.0)
+    if rr_g <= 0 or pa_g <= 0:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_cluster rows carry no "
+                                    "goodput_tokens_per_sec (no "
+                                    "deadlines in the trace?)"}))
+        return 1
+    ratio = pa_g / rr_g
+    jain_rr = rr.get("fairness_jain")
+    jain_pa = pa.get("fairness_jain")
+    saved_rr = int(rr.get("prefill_tokens_saved") or 0)
+    saved_pa = int(pa.get("prefill_tokens_saved") or 0)
+    rec = {
+        "gate": "pass",
+        "prefix_vs_round_robin_goodput": round(ratio, 4),
+        "goodput_floor": CLUSTER_GOODPUT_FLOOR,
+        "fairness_jain_round_robin": jain_rr,
+        "fairness_jain_prefix_aware": jain_pa,
+        "prefill_saved_round_robin": saved_rr,
+        "prefill_saved_prefix_aware": saved_pa,
+        "requests": rr.get("arrived"),
+        "replicas": rr.get("replicas"),
+        "requeued_in_lifecycle": lf.get("requeued"),
+    }
+    if ratio < CLUSTER_GOODPUT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"prefix_aware goodput only {ratio:.3f}x "
+                         f"round_robin (floor {CLUSTER_GOODPUT_FLOOR})"
+                         " — placement is not converting prefix "
+                         "locality into goodput")
+    elif jain_rr is not None and (jain_pa is None
+                                  or float(jain_pa)
+                                  < float(jain_rr) - 1e-9):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"prefix_aware Jain fairness {jain_pa} fell "
+                         f"below round_robin's {jain_rr} — goodput "
+                         "was bought by starving a tenant")
+    elif saved_pa <= saved_rr:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"prefix_aware saved {saved_pa} prefill "
+                         f"tokens vs round_robin's {saved_rr} — "
+                         "sharers are not being co-placed with their "
+                         "prefixes")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 OBS_OFF_OVERHEAD_MAX = 0.02  # tracing-off tax allowed over no-obs
 
 
@@ -482,14 +635,17 @@ def check_obs(rows: list) -> int:
 def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     """Gate the serving rows: the spec-compiled vs compiled-plain row
     (tools/spec_decode_bench.py), the workload-replay rows
-    (tools/serving_workload_bench.py), the QoS overload rows
-    (--qos) and/or the prefix-cache rows (--prefix) — whichever
-    families the input carries; every family present must pass. FAILs
-    on: no canonical row at all, a recorded compile failure, output
-    divergence, a >threshold regression, a sub-floor qos-vs-fifo
-    goodput ratio, broken shed accounting, sub-floor prefix savings /
-    TTFT improvement, or a broken refcount/LRU census — so the
-    serving claims can only change deliberately."""
+    (tools/serving_workload_bench.py), the QoS overload rows (--qos),
+    the prefix-cache rows (--prefix) and/or the multi-replica cluster
+    rows (--cluster) — whichever families the input carries; every
+    family present must pass. FAILs on: no canonical row at all, a
+    recorded compile failure, output divergence, a >threshold
+    regression, a sub-floor qos-vs-fifo goodput ratio, broken shed
+    accounting, sub-floor prefix savings / TTFT improvement, a broken
+    refcount/LRU census, a sub-floor prefix-aware-vs-round-robin
+    cluster goodput ratio, or a broken cluster/drain-join request-
+    conservation census — so the serving claims can only change
+    deliberately."""
     fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
@@ -499,6 +655,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_prefix")
            for r in rows):
         fam_rcs["prefix"] = check_serving_prefix(rows)
+    if any(r.get("bench", "").startswith("serving_cluster")
+           for r in rows):
+        fam_rcs["cluster"] = check_serving_cluster(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

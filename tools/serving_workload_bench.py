@@ -37,6 +37,23 @@ tokens saved >= 30%, round-2 TTFT p50 improvement >= 1.3x, greedy
 token parity cached-vs-uncached, and the pool's refcount/LRU census
 invariant (resident + evictable + free == pool size).
 
+The cluster arm (``--cluster``) replays ONE seeded ~10^5-request
+multi-tenant overload trace (Zipf-skewed shared-prefix cohorts sized
+to overflow a single replica's retention slack) through a
+`ClusterRouter` over N sim-backed engine replicas (serving.sim: the
+deterministic paged-backend stub — cluster claims are about
+placement/scheduling/bookkeeping, so the verdict needs no jitted
+calls and runs in seconds) under round_robin, least_loaded and
+prefix_aware placement, plus a single consolidated FIFO engine as the
+greedy-token oracle and a mid-trace drain+join conservation arm.
+`bench_gate.py serving` gates the `serving_cluster` family:
+prefix_aware goodput >= 1.15x round_robin with Jain fairness held and
+strictly more prefill saved, stream parity across placements and vs
+the oracle, per-tenant request conservation (completed + shed ==
+arrived) cluster-wide and across the drain+join, and (with
+``--trace-out``) nonzero per-replica slot occupancy from the chrome
+trace.
+
 The observability arms (PR 4):
 
 - ``--trace-out out.json`` exports the measured replay of the FIRST
@@ -61,6 +78,8 @@ Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --cpu --qos --trace t.json
       python tools/serving_workload_bench.py --cpu --prefix
       python tools/serving_workload_bench.py --cpu --obs-overhead
+      python tools/serving_workload_bench.py --cluster
+      python tools/serving_workload_bench.py --cluster --replicas 8
 """
 from __future__ import annotations
 
@@ -71,6 +90,191 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _stream_parity(a: dict, b: dict):
+    """Greedy parity between two outputs maps: every request served by
+    BOTH must agree token-for-token on the common stream length
+    (lengths may differ — deadline timeouts and degradation tiers
+    truncate differently per placement; the TOKENS may not). Returns
+    (ok, n_compared, n_full_equal) so the summary row states exactly
+    how much evidence the verdict rests on — requests shed under one
+    arm but served under the other are never compared, and only
+    n_full_equal of the compared streams matched to their full
+    length."""
+    ok, n, full = True, 0, 0
+    for rid in a.keys() & b.keys():
+        x, y = a[rid], b[rid]
+        m = min(len(x), len(y))
+        n += 1
+        if x[:m] != y[:m]:
+            ok = False
+        elif len(x) == len(y):
+            full += 1
+    return ok, n, full
+
+
+def _streams_agree(a: dict, b: dict) -> bool:
+    return _stream_parity(a, b)[0]
+
+
+def _cluster_arm(args):
+    """The multi-replica scale arm: N sim-backed engine replicas (the
+    cluster claims are about placement/scheduling/bookkeeping, which
+    the deterministic sim backend exercises at 10^5-request scale —
+    see paddle_tpu/serving/sim.py), three placement policies on ONE
+    seeded overload trace, a single consolidated engine as the token-
+    parity oracle, and a mid-trace drain+join conservation arm."""
+    import json as _json
+
+    from paddle_tpu.serving import (ClusterRouter, QoSScheduler,
+                                    ServingEngine, make_sim_serving,
+                                    synthesize_cluster_trace,
+                                    trace_stats)
+
+    N = max(1, args.replicas)
+    SLOTS, PS, ML, CHUNK, EXTRA = 8, 8, 64, 4, 8
+    VOCAB = 509
+    costs = {"prefill_unit": 1.0, "decode": 1.0}
+    weights = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+
+    def spawn(name, slots=SLOTS, extra=EXTRA):
+        return ServingEngine(
+            serving=make_sim_serving(max_len=ML, page_size=PS,
+                                     slots=slots, vocab=VOCAB,
+                                     n_pool_pages=slots * (ML // PS)
+                                     + 1 + extra),
+            slots=slots, policy="paged", clock="fixed",
+            fixed_costs=costs, decode_chunk=CHUNK,
+            scheduler=QoSScheduler(max_queue=4 * slots,
+                                   tenant_weights=weights))
+
+    # honest UNCACHED cluster capacity under per-chunk pricing: each
+    # request costs ~5 exclusive prefill units (32-token prefix + tail
+    # padded to 40 = 5 chunks) plus its share of decode turns that
+    # serve slots*chunk tokens each; overload is priced against THIS,
+    # so placement quality (cache hits halve the prefill term) is what
+    # separates the policies
+    B, P = 8.0, 5.0
+    cap = N * B / (P + B / (SLOTS * CHUNK))
+    n_req = max(100, args.cluster_requests)
+    trace = synthesize_cluster_trace(
+        seed=args.seed, n_requests=n_req,
+        service_tokens_per_unit=cap, vocab_size=VOCAB)
+    stats = trace_stats(trace)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    rows, outs = {}, {}
+    for pol in ("round_robin", "least_loaded", "prefix_aware"):
+        res = ClusterRouter(spawn, N, placement=pol).run(trace)
+        rep = res.report(tenant_weights=weights)
+        cen = res.census()
+        rec = {"bench": "serving_cluster", "device": "sim",
+               "seed": args.seed, "replicas": N, "slots": SLOTS,
+               "decode_chunk": CHUNK,
+               "service_tokens_per_unit": round(cap, 4)}
+        rec.update(rep)
+        rec["conserved"] = cen["conserved"]
+        rec["pool_census_ok"] = cen["pool_census_ok"]
+        rec["trace"] = stats
+        rows[pol] = rec
+        outs[pol] = res.outputs()
+        emit(rec)
+
+    # the single-engine ORACLE: one consolidated FIFO machine with the
+    # cluster's total slot count — NOT a perf baseline (one chip
+    # serializes what N replicas overlap, and FIFO means its queue
+    # just grows), purely the greedy-token referee: it completes EVERY
+    # request's full budget, so every stream any placement produced
+    # has a reference to agree with
+    oracle = ServingEngine(
+        serving=make_sim_serving(max_len=ML, page_size=PS,
+                                 slots=N * SLOTS, vocab=VOCAB,
+                                 n_pool_pages=N * SLOTS * (ML // PS)
+                                 + 1 + EXTRA * N),
+        slots=N * SLOTS, policy="paged", clock="fixed",
+        fixed_costs=costs, decode_chunk=CHUNK)
+    ores = oracle.run(trace)
+    parity, compared, full_eq = {}, {}, {}
+    for p in outs:
+        parity[p], compared[p], full_eq[p] = _stream_parity(
+            outs[p], ores.outputs)
+    cross = all(_streams_agree(outs[a], outs[b])
+                for a in outs for b in outs if a < b)
+
+    # drain+join conservation arm on a mid-size slice: r0 drains at
+    # ~40% of the span (its queue requeues onto survivors), a cold
+    # replica joins at ~55%. With a single replica the order flips —
+    # the joiner must exist before the only replica drains, or the
+    # requeue has nowhere to go
+    lt = trace[:min(len(trace), 20_000)]
+    span0, span1 = lt[0].arrival, lt[-1].arrival
+    t_a = span0 + 0.40 * (span1 - span0)
+    t_b = span0 + 0.55 * (span1 - span0)
+    if N > 1:
+        ev = [(t_a, "drain", "r0"), (t_b, "join", f"r{N}")]
+    else:
+        ev = [(t_a, "join", f"r{N}"), (t_b, "drain", "r0")]
+    lres = ClusterRouter(spawn, N, placement="prefix_aware").run(
+        lt, events=ev)
+    lcen = lres.census()
+    lrep = lres.report(tenant_weights=weights)
+    emit({"bench": "serving_cluster_lifecycle", "device": "sim",
+          "seed": args.seed, "replicas": N, "requests": len(lt),
+          "events": lres.events, "conserved": lcen["conserved"],
+          "duplicated": lcen["duplicated"][:5],
+          "lost": lcen["lost"][:5],
+          "requeued": lcen["requeued"],
+          "removal_census_ok": lcen["removal_census_ok"],
+          "pool_census_ok": lcen["pool_census_ok"],
+          "per_tenant": lcen["tenants"],
+          "goodput_tokens": lrep["goodput_tokens"],
+          "parity_vs_oracle": _streams_agree(lres.outputs(),
+                                             ores.outputs)})
+
+    if args.trace_out:
+        # a small traced replay for the per-replica occupancy
+        # evidence (a 10^5-request chrome trace would be ~GB); the
+        # trace_report per-track rows are recomputed here so the gate
+        # needs only this JSONL
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from trace_report import (load_trace as _load_chrome,
+                                  replica_summaries, track_names)
+        tt = trace[:min(len(trace), 2000)]
+        tres = ClusterRouter(spawn, N, placement="prefix_aware",
+                             trace=args.trace_out).run(tt)
+        # read the EXPORT back: track names ride chrome thread_name
+        # metadata, which only the export carries
+        evts = _load_chrome(args.trace_out)
+        tracks = track_names(evts)
+        emit({"bench": "serving_cluster_trace", "path": args.trace_out,
+              "requests": len(tt), "events": len(evts),
+              "replicas": replica_summaries(evts, tracks)})
+
+    rr = rows["round_robin"]
+    pa = rows["prefix_aware"]
+    rr_g = rr.get("goodput_tokens_per_sec") or 0.0
+    pa_g = pa.get("goodput_tokens_per_sec") or 0.0
+    emit({"bench": "serving_cluster_summary", "device": "sim",
+          "seed": args.seed, "replicas": N, "requests": n_req,
+          "prefix_vs_round_robin_goodput": round(pa_g / rr_g, 4)
+          if rr_g else None,
+          "round_robin_goodput_tokens_per_sec": rr_g,
+          "prefix_aware_goodput_tokens_per_sec": pa_g,
+          "least_loaded_goodput_tokens_per_sec":
+          rows["least_loaded"].get("goodput_tokens_per_sec"),
+          "fairness_jain_round_robin": rr.get("fairness_jain"),
+          "fairness_jain_prefix_aware": pa.get("fairness_jain"),
+          "prefill_saved_round_robin": rr.get("prefill_tokens_saved"),
+          "prefill_saved_prefix_aware": pa.get("prefill_tokens_saved"),
+          "parity_vs_oracle": parity,
+          "parity_compared": compared,
+          "parity_full_equal": full_eq,
+          "parity_ok": bool(all(parity.values()) and cross),
+          "oracle_completed": len(ores.outputs)})
+    return 0
 
 
 def main(argv=None):
@@ -110,6 +314,20 @@ def main(argv=None):
     ap.add_argument("--overload", type=float, default=2.0,
                     help="QoS arm: demanded-tokens / engine-capacity "
                          "ratio")
+    ap.add_argument("--cluster", action="store_true",
+                    help="run the multi-replica cluster arm instead: "
+                         "round_robin vs least_loaded vs prefix_aware "
+                         "placement over N sim-backed engine replicas "
+                         "on the ~10^5-request overload trace (fixed "
+                         "clock), plus a single-engine token-parity "
+                         "oracle and a mid-trace drain+join "
+                         "conservation arm; bench_gate.py serving "
+                         "gates the serving_cluster family")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="cluster arm: replica count")
+    ap.add_argument("--cluster-requests", type=int, default=100_000,
+                    help="cluster arm: trace size (the scale gate "
+                         "runs the full 10^5)")
     ap.add_argument("--trace-out", type=str, default=None,
                     help="export the measured replay (first policy, "
                          "or the qos engine under --qos) as "
@@ -144,6 +362,9 @@ def main(argv=None):
     from paddle_tpu.serving import (ServingEngine, load_trace,
                                     merge_traces, save_trace,
                                     synthesize_trace, trace_stats)
+
+    if args.cluster:
+        return _cluster_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)

@@ -14,8 +14,15 @@ tool turns it into the four summaries an on-call actually asks for:
 - **slot occupancy**: busy% per decode slot track — idle slots mean
   admission (not compute) is the bottleneck.
 
+``--json`` emits one row PER TRACK, then (for cluster traces, whose
+engine tracks are replica-prefixed ``r0/engine``, ``r0/slot/3``, ...)
+one rollup row per replica with its mean slot occupancy, then the
+global summary row LAST — so consumers reading the final line see
+what they always saw, and the cluster gate can assert per-replica
+occupancy without re-parsing the chrome JSON.
+
 Run:  python tools/trace_report.py trace.json
-      python tools/trace_report.py trace.json --json   # machine row
+      python tools/trace_report.py trace.json --json   # machine rows
       python tools/trace_report.py trace.json --width 60 --top 5
 """
 from __future__ import annotations
@@ -105,7 +112,8 @@ def slot_occupancy(events: list, tracks: dict) -> dict:
     span = max(t1 - t0, 1e-12)
     out = {}
     for tid, name in sorted(tracks.items()):
-        if not name.startswith("slot/"):
+        # "slot/3" (single engine) or "r0/slot/3" (cluster replica)
+        if not (name.startswith("slot/") or "/slot/" in name):
             continue
         busy = sum(e.get("dur", 0.0) for e in xs if e["tid"] == tid)
         out[name] = round(min(busy / span, 1.0), 4)
@@ -132,6 +140,60 @@ def _gantt(r: dict, t0: float, span: float, width: int) -> str:
     if ft is not None:
         bar[col(ft)] = "|"
     return "".join(bar)
+
+
+def track_summaries(events: list, tracks: dict) -> list:
+    """One row per named track: span count, busy fraction of the trace
+    span, and request roots opened there. Cluster traces
+    (``ClusterRouter(trace=...)``) prefix every engine track with the
+    replica name (``r0/engine``, ``r0/slot/3``, ...), so these rows
+    are the per-replica evidence the cluster gate reads."""
+    xs = [e for e in events if e.get("ph") == "X"]
+    t0 = min((e["ts"] for e in xs), default=0.0)
+    t1 = max((e["ts"] + e.get("dur", 0.0) for e in xs), default=0.0)
+    span = max(t1 - t0, 1e-12)
+    rows = []
+    for tid, name in sorted(tracks.items(), key=lambda kv: kv[1]):
+        spans = [e for e in xs if e["tid"] == tid]
+        roots = sum(1 for e in events
+                    if e.get("ph") == "b" and e.get("tid") == tid)
+        rows.append({
+            "bench": "trace_report_track", "track": name,
+            "spans": len(spans),
+            "busy_frac": round(min(sum(e.get("dur", 0.0)
+                                       for e in spans) / span, 1.0), 4),
+            "roots": roots})
+    return rows
+
+
+def replica_summaries(events: list, tracks: dict) -> list:
+    """Per-replica rollups of the track rows: every ``<name>/engine``
+    track names a replica (a lone engine's tracks carry no prefix, so
+    single-engine traces yield no replica rows). Slot occupancy is
+    averaged over the replica's ``<name>/slot/*`` tracks — the number
+    the cluster gate asserts is nonzero for every replica that served
+    traffic."""
+    reps = sorted(t[:-len("/engine")] for t in tracks.values()
+                  if t.endswith("/engine") and len(t) > len("/engine"))
+    if not reps:
+        return []
+    per_track = {r["track"]: r for r in track_summaries(events, tracks)}
+    rows = []
+    for rep in reps:
+        slots = [r for t, r in per_track.items()
+                 if t.startswith(f"{rep}/slot/")]
+        roots = sum(r["roots"] for t, r in per_track.items()
+                    if t.startswith(f"{rep}/"))
+        rows.append({
+            "bench": "trace_report_replica", "replica": rep,
+            "slots": len(slots),
+            "slot_busy_frac": round(sum(r["busy_frac"]
+                                        for r in slots)
+                                    / len(slots), 4) if slots else 0.0,
+            "requests": roots,
+            "spans": sum(r["spans"] for t, r in per_track.items()
+                         if t.startswith(f"{rep}/"))})
+    return rows
 
 
 def summarize(events: list) -> dict:
@@ -220,6 +282,14 @@ def main(argv=None) -> int:
         print(json.dumps({"bench": "trace_report", "error": str(e)}))
         return 1
     if args.json:
+        # per-track rows, then per-replica rollups (cluster traces
+        # only), then the GLOBAL row LAST — consumers that read the
+        # final JSON line keep seeing exactly what they saw before
+        tracks = track_names(events)
+        for row in track_summaries(events, tracks):
+            print(json.dumps(row))
+        for row in replica_summaries(events, tracks):
+            print(json.dumps(row))
         print(json.dumps(summarize(events)))
     else:
         print(report(events, width=args.width, top=args.top))
