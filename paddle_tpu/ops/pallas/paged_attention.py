@@ -240,6 +240,12 @@ class PagedKVCache:
         self._evictable: dict = {}  # page id -> True; insertion = LRU
         self._stats = {"hit_tokens": 0, "lookup_tokens": 0,
                        "evictions": 0}
+        # pool generation: purge() bumps it. Content written under an
+        # earlier epoch is unreachable after a purge (every key dropped,
+        # every page back on the free list), so a restarted replica
+        # over this bookkeeper can never serve pre-crash pages; the
+        # tag makes "which generation is this pool" checkable.
+        self.epoch = 0
 
     def allocate(self, seq_id, n_tokens: int):
         """Reserve pages so ``seq_id`` can hold n_tokens total. The
@@ -435,6 +441,27 @@ class PagedKVCache:
             else:
                 self._refs[p] = rc
         self.lengths.pop(seq_id, None)
+
+    def purge(self):
+        """Crash/abort teardown: the pool is GONE, not drained. Every
+        sequence's pages are released, every RETAINED (evictable) page
+        is reclaimed and every prefix key dropped — unlike ``free()``,
+        nothing survives into the retention LRU, because a crashed
+        replica's K/V content cannot be trusted — and the pool's
+        ``epoch`` is bumped so no later sequence can ever be served
+        pages written before the purge. Leaves the census balanced:
+        0 resident, 0 evictable, every usable page free. (No per-page
+        ``_drop_keys`` walk: the whole key space is wiped below.)"""
+        n_pages = int(self.k_pages.shape[1])
+        self.tables.clear()
+        self.lengths.clear()
+        self._refs.clear()
+        self._evictable.clear()
+        self._prefix.clear()
+        self._page_key.clear()
+        self._children.clear()
+        self._free = list(range(n_pages - 1, 0, -1))
+        self.epoch += 1
 
     def census_ok(self) -> bool:
         """The accounting invariant in one place: every usable page

@@ -11,7 +11,11 @@ cluster router (``cluster.ClusterRouter``: round_robin /
 least_loaded / prefix_aware placement over N ``EngineSession``
 replicas on one shared virtual timeline, drain/join lifecycle,
 rollup goodput/fairness metrics; ``sim.make_sim_serving`` scales its
-gate to 10^5 requests), a seeded replayable trace generator
+gate to 10^5 requests), a fault-tolerance layer (``faults``: seeded
+replayable crash/stall/decode-error plans, heartbeat failure
+detection, failover with retry budgets + resume-from-prefix — the
+``--chaos`` arm gates zero lost/duplicated requests and token parity
+vs fault-free), a seeded replayable trace generator
 (``workload``, including the multi-tenant overload and cluster
 traces), and per-request TTFT/TPOT/SLO/goodput/fairness metrics
 (``metrics``). ``tools/serving_workload_bench.py`` replays one trace
@@ -24,9 +28,12 @@ from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       LeastLoadedPlacement, PlacementPolicy,
                       PrefixAwarePlacement, RoundRobinPlacement,
                       make_placement)
-from .engine import (EngineClock, EngineSession,  # noqa: F401
-                     FixedPolicy, Policy, RoutedPolicy, ServeResult,
-                     ServingEngine, load_engine_log, make_policy)
+from .engine import (DecodeError, EngineClock,  # noqa: F401
+                     EngineSession, FixedPolicy, Policy, RoutedPolicy,
+                     ServeResult, ServingEngine, load_engine_log,
+                     make_policy)
+from .faults import (FailoverConfig, FaultEvent,  # noqa: F401
+                     FaultPlan, synthesize_fault_plan)
 from .metrics import (MetricsCollector, goodput_tokens,  # noqa: F401
                       jain_fairness)
 from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401

@@ -57,11 +57,13 @@ drain/join conservation invariant).
 from __future__ import annotations
 
 import dataclasses
+import heapq
 from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .engine import EngineSession, ServeResult, ServingEngine
+from .faults import FailoverConfig, FaultEvent, FaultPlan
 from .metrics import _pct, goodput_tokens, jain_fairness
 from .workload import Request
 
@@ -177,7 +179,7 @@ class _ReplicaTracer:
 
 class _Replica:
     __slots__ = ("name", "index", "session", "admitting", "joined_at",
-                 "drained_at")
+                 "drained_at", "last_seen")
 
     def __init__(self, name: str, index: int, session: EngineSession,
                  joined_at: float):
@@ -187,42 +189,70 @@ class _Replica:
         self.admitting = True
         self.joined_at = joined_at
         self.drained_at: Optional[float] = None
+        # last time this replica answered a health probe (any timeline
+        # step while its session is alive); a crashed session goes
+        # silent and the gap is what the failure detector reads
+        self.last_seen = joined_at
 
 
 @dataclasses.dataclass
 class ClusterResult:
     """One cluster replay: per-replica ServeResults plus the router's
-    own ledger (placements/requeues) and lifecycle event log."""
+    own ledger (placements/requeues/retries) and lifecycle event log.
+    Under a fault plan, ``salvaged`` holds the tokens each failed-over
+    request had already emitted before its replica died (the stream
+    prefix its retry resumed from) and ``failed`` the requests whose
+    retry budget ran out — accounted exactly once, never silently
+    lost."""
 
     placement: str
     results: Dict[str, ServeResult]     # replica -> final result
     ledger: Dict[str, dict]             # rid -> {tenant, replica,
-    #                                     requeues}
-    events: List[dict]                  # drain/join/remove log
+    #                                     requeues, retries, path}
+    events: List[dict]                  # drain/join/crash/remove log
     trace: Optional[object] = None      # the shared Tracer, if any
+    salvaged: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict)           # rid -> pre-crash tokens
+    failed: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # rid -> reason (retry budget exhausted / unplaceable)
+    faulted: bool = False               # a fault plan ran OR the
+    # failover machinery actually engaged (backend-raised DecodeErrors
+    # under a failover-only config); gates the chaos report/census
+    # blocks so fault-free replays keep the PR-6 records byte-for-byte
 
     def outputs(self) -> Dict[str, List[int]]:
         """Every request's greedy stream, merged across replicas (rids
-        are cluster-unique by the census invariant)."""
+        are cluster-unique by the census invariant). A failed-over
+        request's stream is its salvaged pre-crash tokens + what the
+        survivor emitted after resuming — the full stream the client
+        actually received, the one fault-free parity is judged on."""
         out: Dict[str, List[int]] = {}
         for name in self.results:
             out.update(self.results[name].outputs)
+        for rid, pre in self.salvaged.items():
+            if rid in out:
+                out[rid] = list(pre) + list(out[rid])
         return out
 
     def census(self) -> dict:
         """The no-request-lost-or-duplicated invariant, per tenant:
-        every routed rid finished OR shed on EXACTLY one replica, and
-        ``completed + shed == arrived`` for each tenant. Also folds in
-        each replica's pool census (``invariant_ok``) and, for retired
-        replicas, the at-removal census the router recorded."""
+        every routed rid finished, shed, OR exhausted its retry budget
+        on EXACTLY one replica, and ``completed + shed + failed ==
+        arrived`` for each tenant (``failed`` can only be nonzero when
+        the failover machinery engaged — a fault plan or a
+        backend-raised DecodeError under a failover config). Also
+        folds in each
+        replica's pool census (``invariant_ok``) and, for retired or
+        crashed replicas, the at-removal census the router recorded."""
         seen: Dict[str, str] = {}
         dup: List[str] = []
         per: Dict[str, dict] = {}
 
         def bump(tenant, key):
             t = tenant if tenant is not None else "_none"
-            per.setdefault(t, {"arrived": 0, "completed": 0,
-                               "shed": 0})[key] += 1
+            d = per.setdefault(t, {"arrived": 0, "completed": 0,
+                                   "shed": 0})
+            d[key] = d.get(key, 0) + 1
 
         for rid, led in self.ledger.items():
             bump(led["tenant"], "arrived")
@@ -237,19 +267,30 @@ class ClusterResult:
                     dup.append(rid)
                 seen[rid] = name
                 bump(self.ledger[rid]["tenant"], "shed")
+        for rid in self.failed:
+            if rid in seen:
+                dup.append(rid)
+            seen[rid] = "_failed"
+            bump(self.ledger[rid]["tenant"], "failed")
         lost = sorted(set(self.ledger) - set(seen))
-        conserved = all(v["completed"] + v["shed"] == v["arrived"]
+        conserved = all(v["completed"] + v["shed"]
+                        + v.get("failed", 0) == v["arrived"]
                         for v in per.values())
         pools_ok = all(res.cache_stats.get("invariant_ok") is True
                        for res in self.results.values())
         removal_ok = all(e.get("census_ok", True) for e in self.events)
-        return {"tenants": per,
-                "duplicated": sorted(set(dup)), "lost": lost,
-                "conserved": bool(conserved and not dup and not lost),
-                "pool_census_ok": bool(pools_ok),
-                "removal_census_ok": bool(removal_ok),
-                "requeued": sum(1 for led in self.ledger.values()
-                                if led["requeues"])}
+        out = {"tenants": per,
+               "duplicated": sorted(set(dup)), "lost": lost,
+               "conserved": bool(conserved and not dup and not lost),
+               "pool_census_ok": bool(pools_ok),
+               "removal_census_ok": bool(removal_ok),
+               "requeued": sum(1 for led in self.ledger.values()
+                               if led["requeues"])}
+        if self.faulted:
+            out["retried"] = sum(1 for led in self.ledger.values()
+                                 if led.get("retries"))
+            out["failed"] = len(self.failed)
+        return out
 
     def report(self, tenant_weights: Optional[Dict[str, float]] = None) \
             -> dict:
@@ -339,6 +380,19 @@ class ClusterResult:
         rec["prefill_tokens_saved"] = saved_total
         rec["per_replica"] = per_rep
         rec["lifecycle_events"] = len(self.events)
+        if self.faulted:
+            # the chaos block appears ONLY when a fault plan ran — a
+            # fault-free replay keeps the PR-6 record byte-for-byte
+            ev = [e["event"] for e in self.events]
+            rec["crashes"] = ev.count("crash")
+            rec["stalls"] = ev.count("stall")
+            rec["decode_errors"] = ev.count("decode_error")
+            rec["failovers"] = ev.count("dead")
+            rec["retried_requests"] = sum(
+                1 for led in self.ledger.values()
+                if led.get("retries"))
+            rec["resumed_with_salvage"] = len(self.salvaged)
+            rec["failed_requests"] = len(self.failed)
         return rec
 
 
@@ -360,12 +414,21 @@ class ClusterRouter:
     ``[(t, "drain", name), (t, "join", name)]``; joins sort before
     drains at equal ``t`` so a drain's requeued backlog can land on
     the replica that just joined.
+
+    ``faults`` (a ``faults.FaultPlan``) schedules crash / stall /
+    decode-error injection on the same timeline; ``failover`` (a
+    ``faults.FailoverConfig``, defaulted when a plan is given) sets
+    the heartbeat detector and retry/backoff policy. With
+    ``faults=None`` the fault machinery is entirely inert — no probe
+    ticks, no detection pass — and the replay is byte-identical to a
+    fault-unaware router.
     """
 
     def __init__(self, spawn, n_replicas: int = 2, *,
                  placement="prefix_aware",
                  prefix_threshold: Optional[int] = None,
-                 trace=None):
+                 trace=None, faults: Optional[FaultPlan] = None,
+                 failover: Optional[FailoverConfig] = None):
         if not callable(spawn):
             raise ValueError("spawn must be callable: name -> "
                              "ServingEngine (one engine+factory per "
@@ -385,6 +448,21 @@ class ClusterRouter:
         self._expect_churn = False
         self._ran = False
         self._g_load = obs_metrics.REGISTRY.gauge
+        if faults is not None and not isinstance(faults, FaultPlan):
+            faults = FaultPlan(list(faults))
+        self._faults = faults
+        # failover defaults alongside a fault plan; it may also be
+        # passed ALONE — the retry policy for rows a backend-raised
+        # DecodeError aborts without any scheduled fault
+        self.failover = failover if failover is not None \
+            else (FailoverConfig() if faults is not None else None)
+        self._salvage: Dict[str, List[int]] = {}
+        self.failed: Dict[str, str] = {}
+        self._heap: List[tuple] = []
+        self._seq = 0
+        self._ctr_failovers = obs_metrics.REGISTRY.counter(
+            "cluster_failovers_total",
+            "replicas declared dead and failed over")
 
     # --- lifecycle --------------------------------------------------------
     def _add_replica(self, name: str, t: float) -> _Replica:
@@ -416,10 +494,10 @@ class ClusterRouter:
         return rep
 
     def _rep(self, name: str) -> _Replica:
-        for rep in self.replicas:
-            if rep.name == name:
-                return rep
-        raise ValueError(f"no live replica {name!r}")
+        rep = self._find(name)
+        if rep is None:
+            raise ValueError(f"no live replica {name!r}")
+        return rep
 
     def _join(self, name: str, t: float):
         self._add_replica(name, t)
@@ -431,6 +509,16 @@ class ClusterRouter:
 
     def _drain(self, name: str, t: float):
         rep = self._rep(name)
+        if rep.session.crashed:
+            # the operator drained a replica that is already dead but
+            # not yet detected: a graceful drain is impossible (the
+            # in-flight rows died at the crash) — resolve as an
+            # immediate failover so the crash salvage is NOT dropped
+            self.events_log.append({"t": round(t, 6),
+                                    "event": "drain_found_dead",
+                                    "replica": name})
+            self._declare_dead(rep, t)
+            return
         if not rep.admitting:
             raise ValueError(f"replica {name!r} is already draining")
         rep.admitting = False
@@ -446,15 +534,32 @@ class ClusterRouter:
                                  replica=name, requeued=len(pulled))
         for r in pulled:
             self.ledger[r.rid]["requeues"] += 1
-            self._place(r, requeue=True)
+            # a drained queue may hold a resumed (salvage-grown)
+            # request in a heterogeneous cluster: route it through the
+            # same fit-aware placement the retry path uses, so it can
+            # never be submitted to a replica it cannot fit
+            self._place_or_fail(r, t)
         self._maybe_retire(rep)
 
     def _maybe_retire(self, rep: _Replica):
         """A draining replica whose in-flight rows have all finished
         leaves the cluster; its pool census must balance with ZERO
-        resident pages (every sequence freed) at removal."""
+        resident pages (every sequence freed) at removal. A replica
+        that CRASHED while draining is never retired here — its crash
+        salvage must leave through ``_declare_dead``'s failover, not
+        be banked away with the corpse."""
         if rep.admitting or rep.session.active or rep.session.queued():
             return
+        if rep.session.crashed:
+            return
+        self._bank_removal(rep, rep.session.clock.now())
+
+    def _bank_removal(self, rep: _Replica, t: float, **extra) -> bool:
+        """The one replica-removal block (drain retirement AND crash
+        failover share it): finish the session, check the at-removal
+        pool census (zero resident pages), bank the result, drop the
+        replica and zero its load gauge, log the ``remove`` event
+        (``extra`` tags crash removals with ``crashed``/``pool_epoch``)."""
         res = rep.session.finish()
         cs = res.cache_stats
         ok = bool(cs.get("invariant_ok")
@@ -465,17 +570,24 @@ class ClusterRouter:
                      "queued + in-flight requests on a replica",
                      replica=rep.name).set(0.0)
         self.events_log.append({
-            "t": round(rep.session.clock.now(), 6), "event": "remove",
+            "t": round(t, 6), "event": "remove",
             "replica": rep.name, "census_ok": ok,
-            "resident_pages": cs.get("resident_pages")})
+            "resident_pages": cs.get("resident_pages"), **extra})
         if self._tracer is not None:
-            self._tracer.instant("remove", t=rep.session.clock.now(),
-                                 track="cluster", replica=rep.name,
-                                 census_ok=ok)
+            attrs = {"crashed": True} if extra.get("crashed") else {}
+            self._tracer.instant("remove", t=t, track="cluster",
+                                 replica=rep.name, census_ok=ok,
+                                 **attrs)
+        return ok
 
     # --- placement --------------------------------------------------------
-    def _place(self, r: Request, requeue: bool = False):
+    def _place(self, r: Request, requeue: bool = False, only=None):
+        """``only`` (predicate over replicas) narrows the candidate
+        set — the retry path restricts a resumed request to survivors
+        whose engine footprint actually admits it."""
         cands = [rep for rep in self.replicas if rep.admitting]
+        if only is not None:
+            cands = [rep for rep in cands if only(rep)]
         if not cands:
             raise RuntimeError(
                 f"no admitting replica for {r.rid} — drained the whole "
@@ -485,9 +597,11 @@ class ClusterRouter:
         led = self.ledger.get(r.rid)
         if led is None:
             self.ledger[r.rid] = {"tenant": r.tenant,
-                                  "replica": rep.name, "requeues": 0}
+                                  "replica": rep.name, "requeues": 0,
+                                  "retries": 0, "path": [rep.name]}
         else:
             led["replica"] = rep.name
+            led["path"].append(rep.name)
         # refresh EVERY admitting replica's gauge, not just the chosen
         # one — a replica that drains its backlog between placements
         # must not export its stale last-placement load
@@ -496,6 +610,285 @@ class ClusterRouter:
                          "queued + in-flight requests on a replica",
                          replica=rep2.name).set(
                 float(rep2.session.load()))
+
+    # --- failure detection + failover -------------------------------------
+    def _push(self, t: float, pri: int, item):
+        heapq.heappush(self._heap, (float(t), pri, self._seq, item))
+        self._seq += 1
+
+    def _find(self, name: str) -> Optional[_Replica]:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        return None
+
+    def _fault(self, ev: FaultEvent, t: float):
+        rep = self._find(ev.replica)
+        if rep is None:
+            if ev.replica in self.results:
+                # the target retired/died before the fault landed — a
+                # seeded plan may legitimately outlive a replica; noop
+                # loudly in the event log rather than crashing the
+                # replay
+                self.events_log.append({"t": round(t, 6),
+                                        "event": f"{ev.kind}_noop",
+                                        "replica": ev.replica,
+                                        "reason": "already removed"})
+                return
+            # never joined (or joins later): the plan is unsatisfiable
+            # as scheduled — silently swallowing the fault would make
+            # the chaos evidence claim an injection that never
+            # happened, so refuse loudly
+            raise ValueError(
+                f"fault plan targets replica {ev.replica!r} at "
+                f"t={ev.t}, which has not joined the cluster — "
+                "schedule faults inside the target's lifetime")
+        if ev.kind == "crash":
+            sess = rep.session
+            n_inflight = len(sess.active)
+            sess.crash()
+            self.events_log.append({
+                "t": round(t, 6), "event": "crash",
+                "replica": rep.name, "in_flight": n_inflight,
+                "queued": sess.queued()})
+            if self._tracer is not None:
+                self._tracer.instant("crash", t=t, track="cluster",
+                                     replica=rep.name,
+                                     in_flight=n_inflight)
+        elif ev.kind == "stall":
+            if rep.session.crashed:
+                return
+            # overlapping stalls extend, never shorten: the replica is
+            # paused until the LATEST scheduled resume time
+            rep.session.stall_until = max(
+                rep.session.stall_until or 0.0, t + float(ev.duration))
+            self.events_log.append({
+                "t": round(t, 6), "event": "stall",
+                "replica": rep.name, "duration": ev.duration})
+            if self._tracer is not None:
+                self._tracer.instant("stall", t=t, track="cluster",
+                                     replica=rep.name,
+                                     duration=ev.duration)
+        else:  # decode_error
+            sess = rep.session
+            if sess.crashed or not sess.active:
+                self.events_log.append({"t": round(t, 6),
+                                        "event": "decode_error_noop",
+                                        "replica": rep.name})
+                return
+            # deterministic victim: the OLDEST in-flight row (admit
+            # time, rid tie-break) — a seeded plan needs no rid names
+            rid = min(sess.active,
+                      key=lambda r: (sess.active[r].t0, r))
+            req, out = sess.abort_row(rid, reason="decode_error")
+            self.events_log.append({
+                "t": round(t, 6), "event": "decode_error",
+                "replica": rep.name, "rid": rid, "salvaged": len(out)})
+            if self._tracer is not None:
+                self._tracer.instant("decode_error", t=t,
+                                     track="cluster", replica=rep.name,
+                                     rid=rid)
+            self._schedule_retry(req, out, t, reason="decode_error")
+
+    def _collect_aborted(self, t: float) -> bool:
+        """Drain every session's ``aborted`` bank (rows a DecodeError
+        raised from inside a decode turn tore down — the backend-
+        exception path, as opposed to the plan's decode_error events
+        which abort through the router directly) and fail them over.
+        Without a failover config there is no retry policy to apply,
+        so losing the row silently is forbidden: raise instead."""
+        got = False
+        for rep in list(self.replicas):
+            if not rep.session.aborted:
+                continue
+            aborted, rep.session.aborted = rep.session.aborted, []
+            for req, out in aborted:
+                got = True
+                if self.failover is None:
+                    raise RuntimeError(
+                        f"{rep.name}: row {req.rid!r} aborted by a "
+                        "decode fault but the router has no failover "
+                        "config — pass failover=FailoverConfig() (or "
+                        "a fault plan) so aborted work can be "
+                        "re-placed instead of lost")
+                self._schedule_retry(req, out, t,
+                                     reason="decode_error")
+        return got
+
+    def _probe(self, t: float):
+        """One health-probe pass: live sessions answer (stalled ones
+        included — slow is not dead), crashed ones stay silent; any
+        replica silent past the heartbeat timeout is declared dead and
+        failed over. Runs at every timeline step plus the standing
+        probe ticks, so detection latency is bounded by
+        ``timeout + interval`` even in an arrival gap."""
+        cfg = self.failover
+        for rep in list(self.replicas):
+            if not rep.session.crashed:
+                rep.last_seen = max(rep.last_seen, t)
+            elif t - rep.last_seen >= cfg.heartbeat_timeout - 1e-9:
+                self._declare_dead(rep, t)
+
+    def _declare_dead(self, rep: _Replica, t: float):
+        """Failover: the dead replica leaves the cluster NOW. Its
+        queued-but-never-admitted backlog and its crash-salvaged
+        in-flight rows are re-placed on survivors (with backoff and a
+        retry budget); every moved request carries its metrics record
+        and trace root with it, so the cluster counts it exactly once.
+        The corpse's result banks only pre-crash completions, and its
+        purged pool must census to zero resident pages at removal."""
+        cfg = self.failover
+        sess = rep.session
+        silence = t - rep.last_seen
+        missed = max(1, int(silence / cfg.heartbeat_interval))
+        self._ctr_failovers.inc()
+        queued = sess.pull_unadmitted(outcome="failover")
+        salvage = sess.crash_salvage
+        self.events_log.append({
+            "t": round(t, 6), "event": "dead", "replica": rep.name,
+            "silent_for": round(silence, 6),
+            "missed_heartbeats": missed,
+            "requeued": [r.rid for r in queued],
+            "in_flight_lost": [r.rid for r, _ in salvage]})
+        if self._tracer is not None:
+            self._tracer.instant("dead", t=t, track="cluster",
+                                 replica=rep.name,
+                                 missed_heartbeats=missed,
+                                 requeued=len(queued),
+                                 in_flight_lost=len(salvage))
+        self._bank_removal(rep, t, crashed=True,
+                           pool_epoch=sess.book.epoch)
+        # queued work first (it never ran — plain re-place), then the
+        # in-flight rows in admit order with their salvage
+        for r in queued:
+            self._schedule_retry(r, [], t, reason="failover_queued")
+        for r, out in salvage:
+            self._schedule_retry(r, out, t, reason="failover_inflight")
+
+    def _place_or_fail(self, r: Request, t: float) -> bool:
+        """Placement with the footprint guard for every re-placement
+        path (drain requeues and failover retries): with the failover
+        machinery active, candidates are filtered to replicas whose
+        engine admits the request, and a request NO admitting replica
+        can fit is recorded FAILED — accounted exactly once — instead
+        of crashing the replay inside ``submit``'s validation. Without
+        a failover config this is exactly ``_place`` (the PR-6 drain
+        path, byte-identical)."""
+        if self.failover is None:
+            self._place(r, requeue=True)
+            return True
+        if not self._retry_fits(len(r.prompt), r.max_new_tokens):
+            self.failed[r.rid] = (
+                "no admitting replica can fit the request (none "
+                "left, or its footprint exceeds every survivor's "
+                "max_len)")
+            self._ctr_retry("unplaceable")
+            self.events_log.append({"t": round(t, 6),
+                                    "event": "retry_unplaceable",
+                                    "rid": r.rid})
+            if self._tracer is not None:
+                self._tracer.instant("retry_exhausted", t=t,
+                                     track="cluster", rid=r.rid,
+                                     reason="unplaceable")
+            return False
+        self._place(r, requeue=True,
+                    only=lambda rep: self._rep_fits(
+                        rep, len(r.prompt), r.max_new_tokens))
+        return True
+
+    @staticmethod
+    def _ctr_retry(reason: str):
+        obs_metrics.REGISTRY.counter(
+            "cluster_retries_total",
+            "request re-placements after failures",
+            reason=reason).inc()
+
+    def _schedule_retry(self, r: Request, salvage: List[int],
+                        t: float, reason: str):
+        led = self.ledger[r.rid]
+        led["retries"] += 1
+        attempt = led["retries"]
+        cfg = self.failover
+        if attempt > cfg.retry_budget:
+            self.failed[r.rid] = (f"retry budget exhausted "
+                                  f"({cfg.retry_budget}) after "
+                                  f"{reason}")
+            self._ctr_retry("exhausted")
+            self.events_log.append({
+                "t": round(t, 6), "event": "retry_exhausted",
+                "rid": r.rid, "attempts": attempt - 1})
+            if self._tracer is not None:
+                self._tracer.instant("retry_exhausted", t=t,
+                                     track="cluster", rid=r.rid)
+            return
+        self._ctr_retry(reason)
+        delay = cfg.backoff(attempt)
+        if self._tracer is not None:
+            self._tracer.instant("retry", t=t, track="cluster",
+                                 rid=r.rid, attempt=attempt,
+                                 reason=reason, backoff=round(delay, 6),
+                                 salvaged=len(salvage))
+        # the resumed request is BUILT at pop time, not here: the
+        # backoff window may see membership change (a joiner with a
+        # smaller max_len, another crash), and the salvage trim must
+        # size against the replicas that can actually receive it
+        self._push(t + delay, 5, ("retry", r, salvage))
+
+    def _resume_request(self, r: Request, salvage: List[int]):
+        """Resume-from-prefix: the retried request re-enters with its
+        already-emitted tokens appended to the prompt, so the survivor
+        re-prefills (cheaply, where the prefix cache holds the shared
+        prompt) instead of re-decoding, and the completed stream —
+        salvage + what the retry emits — is token-identical to an
+        uninterrupted run (prefill and decode agree on greedy
+        argmax/hash; the sim backend is built resume-consistent for
+        exactly this). Budgets shrink by what was already delivered:
+        ``max_new_tokens`` and any ``cancel_after`` both count TOTAL
+        stream tokens. Salvage is trimmed (newest tokens re-decoded
+        instead) only if appending it would overflow every fitting
+        survivor's max_len footprint. Returns ``(resumed_request,
+        kept_salvage)`` — the caller banks ``kept_salvage`` into
+        ``self._salvage`` ONLY after placement succeeds, so a request
+        that ends up unplaceable never reports as resumed."""
+        if not salvage:
+            return r, []
+        keep = len(salvage)
+        while keep > 0:
+            budget = r.max_new_tokens - keep
+            if budget >= 1 and self._retry_fits(
+                    len(r.prompt) + keep, budget):
+                break
+            keep -= 1
+        if keep <= 0:
+            return r, []
+        kept = list(salvage[:keep])
+        cancel = r.cancel_after
+        if cancel is not None:
+            cancel = max(1, cancel - keep)
+        return dataclasses.replace(
+            r, prompt=tuple(r.prompt) + tuple(kept),
+            max_new_tokens=r.max_new_tokens - keep,
+            cancel_after=cancel), kept
+
+    @staticmethod
+    def _rep_fits(rep: _Replica, prompt_len: int, budget: int) -> bool:
+        # the engine's own footprint rule — _validate applies exactly
+        # this arithmetic at submit
+        e = rep.session.eng
+        return e._footprint_len(prompt_len, budget) <= e.max_len
+
+    def _retry_fits(self, prompt_len: int, budget: int) -> bool:
+        """True when SOME admitting replica's engine footprint admits
+        a resumed request of this size (pad-to-chunk + budget + decode
+        chunk <= max_len) — retry placement is filtered to the fitting
+        survivors, so one small joiner in a heterogeneous cluster must
+        not doom a request a capable replica could serve. With NO
+        admitting replica left (the last survivor drained inside the
+        backoff window), or every survivor too small, nothing fits:
+        the caller records the request FAILED instead of crashing the
+        replay in _place."""
+        return any(self._rep_fits(rep, prompt_len, budget)
+                   for rep in self.replicas if rep.admitting)
 
     # --- the replay -------------------------------------------------------
     def run(self, trace: List[Request], events=()) -> ClusterResult:
@@ -512,18 +905,31 @@ class ClusterRouter:
                 self._tracer.clear()
             else:
                 self._tracer = obs_trace.Tracer()
-        timeline: List[tuple] = []
-        for i, ev in enumerate(events):
+        for ev in events:
             t, op, name = ev
             if op not in ("drain", "join"):
                 raise ValueError(f"lifecycle event {op!r}: use 'drain' "
                                  "or 'join'")
-            timeline.append((float(t), 0 if op == "join" else 1, i,
-                             (op, name)))
-        for i, r in enumerate(sorted(trace,
-                                     key=lambda r: (r.arrival, r.rid))):
-            timeline.append((r.arrival, 2, i, r))
-        timeline.sort(key=lambda x: (x[0], x[1], x[2]))
+            self._push(float(t), 0 if op == "join" else 1, (op, name))
+        t_last = 0.0
+        for r in sorted(trace, key=lambda r: (r.arrival, r.rid)):
+            self._push(r.arrival, 2, r)
+            t_last = max(t_last, r.arrival)
+        if self._faults is not None:
+            for fev in self._faults:
+                self._push(fev.t, 3, fev)
+                t_last = max(t_last, fev.t)
+            # standing health-probe ticks bound detection latency even
+            # across arrival gaps; they run past the last scheduled
+            # event far enough for the slowest detection + backoff
+            cfg = self.failover
+            horizon = t_last + cfg.heartbeat_timeout \
+                + 2 * cfg.heartbeat_interval \
+                + cfg.backoff(cfg.retry_budget)
+            k = 1
+            while k * cfg.heartbeat_interval <= horizon:
+                self._push(k * cfg.heartbeat_interval, 4, ("hb",))
+                k += 1
 
         prev_tr = obs_trace.active()
         if self._tracer is not None:
@@ -531,21 +937,62 @@ class ClusterRouter:
         try:
             for i in range(self.n_replicas):
                 self._add_replica(f"r{i}", 0.0)
-            for t, _, _, item in timeline:
+            t = 0.0
+            while self._heap:
+                t, _, _, item = heapq.heappop(self._heap)
                 for rep in list(self.replicas):
                     rep.session.advance_until(t)
                     if not rep.admitting:
                         self._maybe_retire(rep)
-                if isinstance(item, tuple):
-                    op, name = item
-                    (self._join if op == "join" else self._drain)(
-                        name, t)
-                else:
+                if self._faults is not None:
+                    self._probe(t)
+                if isinstance(item, FaultEvent):
+                    self._fault(item, t)
+                elif isinstance(item, Request):
                     self._place(item)
+                elif item[0] == "retry":
+                    r2, kept = self._resume_request(item[1], item[2])
+                    if self._place_or_fail(r2, t) and kept:
+                        self._salvage.setdefault(
+                            r2.rid, []).extend(kept)
+                elif item[0] != "hb":
+                    op, name = item
+                    if op == "drain" and self._faults is not None \
+                            and self._find(name) is None:
+                        # the drain's target was already removed by
+                        # crash failover — a scheduled lifecycle event
+                        # colliding with the fault plan noops loudly
+                        # (same policy as _fault on a gone replica)
+                        # instead of killing the replay
+                        self.events_log.append(
+                            {"t": round(t, 6), "event": "drain_noop",
+                             "replica": name})
+                    else:
+                        (self._join if op == "join" else self._drain)(
+                            name, t)
+                self._collect_aborted(t)
+                if not self._heap and self._faults is not None:
+                    # a crash whose detection window outran the probe
+                    # horizon (or whose failover pushed retries) must
+                    # still be failed over before the run closes
+                    for rep in list(self.replicas):
+                        if rep.session.crashed:
+                            self._declare_dead(
+                                rep, max(t, rep.last_seen
+                                         + self.failover
+                                         .heartbeat_timeout))
             for rep in list(self.replicas):
                 rep.session.more_expected = False
             for rep in list(self.replicas):
                 self.results[rep.name] = rep.session.finish()
+                if rep.session.aborted:
+                    # a decode fault fired inside the final backlog
+                    # drain, after the last survivor-placement window
+                    # closed — refusing loudly beats losing the row
+                    raise RuntimeError(
+                        f"{rep.name}: {len(rep.session.aborted)} "
+                        "row(s) aborted after the replay closed — "
+                        "nothing left to fail over to")
                 if not rep.admitting:
                     # retire bookkeeping for replicas that were still
                     # streaming when the trace ran out
@@ -569,4 +1016,11 @@ class ClusterRouter:
         return ClusterResult(placement=self.placement.name,
                              results=self.results, ledger=self.ledger,
                              events=self.events_log,
-                             trace=self._tracer)
+                             trace=self._tracer,
+                             salvaged=self._salvage,
+                             failed=self.failed,
+                             faulted=(self._faults is not None
+                                      or bool(self.failed)
+                                      or any(led.get("retries")
+                                             for led in
+                                             self.ledger.values())))

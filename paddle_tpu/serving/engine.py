@@ -40,9 +40,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +57,7 @@ from ..models.nlp.llama_decode import (llama_serving_decode_factory,
 from ..ops.pallas.paged_attention import PagedKVCache
 from .metrics import MetricsCollector
 from .scheduler import QoSScheduler, ServiceEstimator
-from .workload import Request
+from .workload import Request, iter_jsonl_tolerant
 
 
 class EngineClock:
@@ -96,6 +97,21 @@ class EngineClock:
         jax.block_until_ready(out)
         self.t += time.perf_counter() - t0
         return out
+
+
+class DecodeError(RuntimeError):
+    """An exception raised from inside one decode slot's turn —
+    ``rid`` names the row whose computation failed. The session's
+    drive loop catches it, tears down exactly that row (pages freed,
+    slot released, metrics record and trace root moved out — the
+    request fails over, it is not lost) and leaves every other row's
+    stream untouched. Anything raising from a decode turn that is NOT
+    a DecodeError still propagates: an unattributable backend failure
+    must stay loud."""
+
+    def __init__(self, rid: str, msg: Optional[str] = None):
+        super().__init__(msg or f"decode failed for row {rid!r}")
+        self.rid = rid
 
 
 class Policy:
@@ -188,24 +204,37 @@ class ServeResult:
         result stamps its ``replica`` name on EVERY record, so logs
         from N replicas can be concatenated into one cluster incident
         file without losing attribution; with ``replica`` unset
-        (single-engine runs) the format is byte-identical to PR 4."""
+        (single-engine runs) the format is byte-identical to PR 4.
+
+        The write is ATOMIC (tmp + ``os.replace``, the same discipline
+        as ``framework/io.py`` ``save``): a crash or serialization
+        error mid-dump can never leave a truncated file where the
+        previous incident log used to be."""
         tag = {} if self.replica is None else {"replica": self.replica}
-        with open(path, "w") as f:
-            f.write(json.dumps({
-                "kind": "meta", "policy": self.policy,
-                "scheduler": self.scheduler,
-                "pages_total": self.pages_total,
-                "pages_free_end": self.pages_free_end, **tag}) + "\n")
-            for d in self.decisions:
-                f.write(json.dumps({"kind": "decision", **d, **tag})
-                        + "\n")
-            for t, ev, rid, slot in self.slot_log:
-                f.write(json.dumps({"kind": "slot", "t": t,
-                                    "event": ev, "rid": rid,
-                                    "slot": slot, **tag}) + "\n")
-            for rid, reason in self.shed.items():
-                f.write(json.dumps({"kind": "shed", "rid": rid,
-                                    "reason": reason, **tag}) + "\n")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({
+                    "kind": "meta", "policy": self.policy,
+                    "scheduler": self.scheduler,
+                    "pages_total": self.pages_total,
+                    "pages_free_end": self.pages_free_end, **tag})
+                    + "\n")
+                for d in self.decisions:
+                    f.write(json.dumps({"kind": "decision", **d, **tag})
+                            + "\n")
+                for t, ev, rid, slot in self.slot_log:
+                    f.write(json.dumps({"kind": "slot", "t": t,
+                                        "event": ev, "rid": rid,
+                                        "slot": slot, **tag}) + "\n")
+                for rid, reason in self.shed.items():
+                    f.write(json.dumps({"kind": "shed", "rid": rid,
+                                        "reason": reason, **tag})
+                            + "\n")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return path
 
 
@@ -219,31 +248,33 @@ def load_engine_log(path: str) -> dict:
     their ``replica`` key, slot entries become 5-tuples
     ``(t, event, rid, slot, replica)``, and sheds map
     ``rid -> (reason, replica)``; replica-less logs load exactly as
-    before."""
+    before.
+
+    A log whose FINAL line is torn mid-record — the file a crashing
+    process leaves behind when the write was not atomic — loads with a
+    warning and returns the valid prefix (the incident evidence that
+    survived); a malformed line anywhere EARLIER is still a loud
+    error, because a mid-file tear means the file is not an engine
+    log (``workload.iter_jsonl_tolerant`` is the shared policy)."""
     out: dict = {"meta": None, "decisions": [], "slot_log": [],
                  "shed": {}}
-    with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if not ln:
-                continue
-            d = json.loads(ln)
-            kind = d.pop("kind", None)
-            rep = d.get("replica")
-            if kind == "meta":
-                out["meta"] = d
-            elif kind == "decision":
-                out["decisions"].append(d)
-            elif kind == "slot":
-                row = (d["t"], d["event"], d["rid"], d["slot"])
-                out["slot_log"].append(row if rep is None
-                                       else row + (rep,))
-            elif kind == "shed":
-                out["shed"][d["rid"]] = d["reason"] if rep is None \
-                    else (d["reason"], rep)
-            else:
-                raise ValueError(f"engine log line has unknown kind "
-                                 f"{kind!r}")
+    for d in iter_jsonl_tolerant(path):
+        kind = d.pop("kind", None)
+        rep = d.get("replica")
+        if kind == "meta":
+            out["meta"] = d
+        elif kind == "decision":
+            out["decisions"].append(d)
+        elif kind == "slot":
+            row = (d["t"], d["event"], d["rid"], d["slot"])
+            out["slot_log"].append(row if rep is None
+                                   else row + (rep,))
+        elif kind == "shed":
+            out["shed"][d["rid"]] = d["reason"] if rep is None \
+                else (d["reason"], rep)
+        else:
+            raise ValueError(f"engine log line has unknown kind "
+                             f"{kind!r}")
     return out
 
 
@@ -540,9 +571,15 @@ class ServingEngine:
         c = self.chunk_C
         return max(c, -(-n // c) * c)
 
+    def _footprint_len(self, prompt_len: int, budget: int) -> int:
+        """The one footprint formula (`_validate` enforces it against
+        ``max_len``; the cluster's retry sizing asks it before growing
+        a resumed prompt): padded prompt + decode budget + one decode
+        chunk of slack."""
+        return self._pad_len(prompt_len) + budget + self.decode_chunk
+
     def _footprint(self, r: Request) -> int:
-        return self._pad_len(len(r.prompt)) + r.max_new_tokens \
-            + self.decode_chunk
+        return self._footprint_len(len(r.prompt), r.max_new_tokens)
 
     def _order_wave(self, wave) -> List[Request]:
         """Cache-aware co-scheduling for the FIFO loop's PAGED branch:
@@ -1323,11 +1360,35 @@ class EngineSession:
         self._ctx_base = {"capacity": eng.slots,
                           "expect_churn": bool(expect_churn)}
         self._finished: Optional[ServeResult] = None
+        # --- fault-tolerance state (all inert on the happy path) ---
+        # crashed: the replica process is DEAD — it queues submissions
+        # (the router does not know yet) but processes nothing; its
+        # in-flight rows were torn down at crash time into
+        # crash_salvage for the router's failover to resume elsewhere.
+        self.crashed = False
+        self.crash_salvage: List[Tuple[Request, List[int]]] = []
+        # arrivals routed here AFTER the crash (the router has not
+        # detected the silence yet): no admission policy runs on a
+        # dead process — they wait for pull_unadmitted, uncounted by
+        # the scheduler
+        self._dead_letter: List[Request] = []
+        # stall_until: transient liveness-preserving pause — no turn
+        # runs before this virtual time, but the session still answers
+        # health probes (a stall is slow, not dead).
+        self.stall_until: Optional[float] = None
+        # decode_fault_hook: callable(session) invoked inside each
+        # decode turn's try block; raising DecodeError(rid) from it
+        # exercises the single-row teardown path. Aborted rows bank in
+        # .aborted as (Request, emitted tokens) for the driver to
+        # re-place.
+        self.decode_fault_hook = None
+        self.aborted: List[Tuple[Request, List[int]]] = []
 
     # --- placement probes --------------------------------------------------
     def queued(self) -> int:
-        return self.sched.waiting() if self.sched is not None \
+        n = self.sched.waiting() if self.sched is not None \
             else len(self.waiting)
+        return n + len(self._dead_letter)
 
     def load(self) -> int:
         """The live load signal placement policies read: queued +
@@ -1344,7 +1405,13 @@ class EngineSession:
 
     # --- arrivals ----------------------------------------------------------
     def submit(self, r: Request):
-        """One arrival (advance this lane to ``r.arrival`` first)."""
+        """One arrival (advance this lane to ``r.arrival`` first). On
+        a CRASHED session the request dead-letters instead of entering
+        the scheduler: a dead process cannot run admission policy, so
+        it must never shed (a terminal rejection issued by a corpse
+        would permanently drop a request the failover contract
+        promises to rescue) — the dead letters leave with the queue
+        at ``pull_unadmitted``."""
         eng = self.eng
         eng._validate([r])
         self.m.on_arrival(r.rid, r.arrival, tenant=r.tenant,
@@ -1352,30 +1419,89 @@ class EngineSession:
                           deadline_ms=r.deadline_ms)
         eng._ctr_arrived.inc()
         eng._req_open(self.tr, r)
-        if self.sched is not None:
+        if self.crashed:
+            self._dead_letter.append(r)
+        elif self.sched is not None:
             self._shed(self.sched.enqueue(r, self.clock.now()))
         else:
             self.waiting.append(r)
 
-    def pull_unadmitted(self) -> List[Request]:
-        """Drain support: remove every queued-but-never-admitted
-        request from this session — the queue entry, the metrics
-        arrival record (it moves with the request, so a cluster rollup
-        counts it ONCE, at wherever it finally runs or sheds) and the
-        trace root (closed with outcome "requeued") — and return them
-        in (arrival, rid) order. In-flight rows are untouched and keep
-        streaming to completion."""
+    def pull_unadmitted(self, outcome: str = "requeued") \
+            -> List[Request]:
+        """Drain/failover support: remove every queued-but-never-
+        admitted request from this session — the queue entry, the
+        metrics arrival record (it moves with the request, so a
+        cluster rollup counts it ONCE, at wherever it finally runs or
+        sheds) and the trace root (closed with ``outcome``: "requeued"
+        for a graceful drain, "failover" when a dead replica's queue
+        is rescued) — and return them in (arrival, rid) order.
+        In-flight rows are untouched and keep streaming to completion
+        (on a crashed session there are none left to touch)."""
         if self.sched is not None:
             reqs = self.sched.drain_queue()
         else:
-            reqs = sorted(self.waiting,
-                          key=lambda r: (r.arrival, r.rid))
+            reqs = list(self.waiting)
             self.waiting = []
+        reqs = sorted(reqs + self._dead_letter,
+                      key=lambda r: (r.arrival, r.rid))
+        self._dead_letter = []
         t = self.clock.now()
         for r in reqs:
             self.m.forget(r.rid)
-            self.eng._req_close(self.tr, r, t, "requeued", 0)
+            self.eng._req_close(self.tr, r, t, outcome, 0)
         return reqs
+
+    # --- fault teardown ----------------------------------------------------
+    def abort_row(self, rid: str, reason: str = "decode_error") \
+            -> Tuple[Request, List[int]]:
+        """Tear down ONE in-flight row without corrupting survivors:
+        its pool pages are released, its slot freed (logged as an
+        "abort" slot event), its metrics record forgotten and its
+        trace root closed with outcome "failover" — the request is
+        MOVING, not finishing, so nothing lands in ``outputs`` and no
+        finish counter fires. Returns (request, tokens emitted so
+        far): the salvage a failover resumes from."""
+        st = self.active.pop(rid)
+        self.book.free(rid)
+        eng = self.eng
+        eng._g_resident.set(float(len(self.book._refs)))
+        self.free_slots.append(st.slot)
+        self.free_slots.sort()
+        t = self.clock.now()
+        self.slot_log.append((round(t, 6), "abort", rid, st.slot))
+        obs_metrics.REGISTRY.counter(
+            "serving_rows_aborted_total",
+            "in-flight rows torn down by crash/decode faults",
+            reason=reason).inc()
+        if self.tr is not None:
+            self.tr.add_span(rid, st.t0, t - st.t0,
+                             track=f"slot/{st.slot}", backend="paged",
+                             aborted=reason)
+        eng._req_close(self.tr, st.req, t, "failover", len(st.out),
+                       reason=reason)
+        self.m.forget(rid)
+        self.inv_ok &= self.book.census_ok()
+        return st.req, list(st.out)
+
+    def crash(self) -> None:
+        """The replica process dies NOW (distinct from drain: nothing
+        is handed anywhere — the router's failure detector must notice
+        the silence). Every in-flight row is torn down into
+        ``crash_salvage`` (admission order, so failover is
+        deterministic), then the pool is PURGED — retained prefix
+        pages included, with the epoch bumped, because a dead
+        replica's K/V cannot serve anyone — and the session stops
+        processing. Submissions still queue here (the router does not
+        know yet); ``pull_unadmitted`` rescues them at detection."""
+        if self.crashed:
+            raise RuntimeError("session already crashed")
+        self.crashed = True
+        for rid in sorted(self.active,
+                          key=lambda r: (self.active[r].t0, r)):
+            self.crash_salvage.append(
+                self.abort_row(rid, reason="replica_crash"))
+        self.book.purge()
+        self.inv_ok &= self.book.census_ok()
 
     # --- the drive loop ----------------------------------------------------
     def _shed(self, pairs) -> bool:
@@ -1437,18 +1563,38 @@ class EngineSession:
             progressed |= self._fifo_wave()
         if self.active:
             t0 = clock.now()
-            eng._paged_chunk(self.book, clock, m, self.active,
-                             self.free_slots, self.slot_log,
-                             self.outputs, tr=tr)
+            try:
+                if self.decode_fault_hook is not None:
+                    self.decode_fault_hook(self)
+                eng._paged_chunk(self.book, clock, m, self.active,
+                                 self.free_slots, self.slot_log,
+                                 self.outputs, tr=tr)
+            except DecodeError as e:
+                # one slot's computation failed: tear down exactly
+                # that row (the decode turn is forfeit — survivors
+                # resume next turn with their state intact) and bank
+                # it for the driver to fail over
+                if e.rid not in self.active:
+                    raise
+                self.aborted.append(
+                    self.abort_row(e.rid, reason="decode_error"))
+            else:
+                if self.est is not None:
+                    self.est.observe("decode", clock.now() - t0)
             if self.est is not None:
-                self.est.observe("decode", clock.now() - t0)
+                # the deadline-timeout scan runs whether the decode
+                # turn completed or aborted — an expired row must not
+                # survive an extra chunk just because another slot's
+                # fault forfeited this turn
                 t = clock.now()
                 for sid in list(self.active):
                     dl = self.active[sid].req.deadline_time()
                     if dl is not None and t > dl + 1e-9:
                         eng._finish_paged(sid, self.book, clock, m,
-                                          self.active, self.free_slots,
-                                          self.slot_log, self.outputs,
+                                          self.active,
+                                          self.free_slots,
+                                          self.slot_log,
+                                          self.outputs,
                                           timeout=True, tr=tr)
             progressed = True
         self.inv_ok &= self.book.census_ok()
@@ -1552,7 +1698,22 @@ class EngineSession:
         overshoot ``t`` (a decode chunk crossing the horizon models a
         busy replica — same as the single-engine loop); an idle lane's
         clock jumps straight to ``t`` so later submissions see honest
-        queueing delays."""
+        queueing delays.
+
+        A CRASHED session advances its clock but processes nothing (a
+        dead process has no turns). A STALLED session does the same
+        until ``stall_until`` passes, then resumes mid-call — queued
+        and in-flight work eats the pause, exactly the transient-slow
+        replica the failure detector must NOT declare dead."""
+        if self.crashed:
+            self.clock.advance_to(t)
+            return
+        if self.stall_until is not None:
+            if t < self.stall_until - 1e-12:
+                self.clock.advance_to(t)
+                return
+            self.clock.advance_to(self.stall_until)
+            self.stall_until = None
         while True:
             if self.queued() == 0 and not self.active:
                 self.clock.advance_to(t)
@@ -1574,7 +1735,17 @@ class EngineSession:
         if self._finished is not None:
             return self._finished
         self.more_expected = False
-        while self.queued() or self.active:
+        # a stall outliving the driven timeline is still real time:
+        # the final backlog drain must eat the remaining pause, not
+        # skip it (advance_until honors stalls; this loop drives
+        # _turn directly)
+        if self.stall_until is not None and not self.crashed:
+            self.clock.advance_to(self.stall_until)
+            self.stall_until = None
+        # a crashed session has nothing left to run (its rows were
+        # torn down at crash; its queue is rescued by the router) —
+        # its result banks only the work that finished before death
+        while not self.crashed and (self.queued() or self.active):
             progressed = self._turn()
             if not progressed and not self.active:
                 target = self._idle_target()

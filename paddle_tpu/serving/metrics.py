@@ -144,10 +144,14 @@ class MetricsCollector:
 
     def forget(self, rid: str):
         """Erase every trace of ``rid`` from this collector — the
-        cluster router's requeue path: a drained replica's queued-but-
-        unadmitted request moves to a surviving replica, which records
-        the whole lifecycle; keeping the arrival here would count the
-        request twice in any cluster-wide rollup."""
+        cluster router's requeue/failover path: a request moving off a
+        drained replica's queue, or off a CRASHED replica (queued or
+        torn down mid-flight), is re-recorded in full wherever it
+        finally runs, sheds, or exhausts its retry budget; keeping the
+        arrival here would count the request twice in any cluster-wide
+        rollup. This is one half of the exactly-once contract the
+        cluster census gates (``completed + shed + failed ==
+        arrived``)."""
         self._req.pop(rid, None)
 
     # --- views -----------------------------------------------------------

@@ -360,10 +360,16 @@ class QoSScheduler:
 
     def drain_queue(self) -> List[Request]:
         """Remove and return EVERY queued (never-admitted) request, in
-        (arrival, rid) order — the cluster router's drain path: a
-        draining replica keeps its in-flight rows but hands its queue
-        back for placement on surviving replicas. Fair-queue tags are
-        untouched (history of served work survives the drain)."""
+        (arrival, rid) order — the cluster router's drain AND failover
+        path: a draining replica keeps its in-flight rows and hands
+        its queue back; a replica declared dead after a crash hands
+        back everything that was still queued there (including
+        arrivals placed during the undetected-silence window).
+        Fair-queue tags are untouched (history of served work survives
+        the drain; a corpse's tags die with its session). A RESUMED
+        request re-enqueues elsewhere with its original arrival, so
+        aging credits the waiting it already suffered and
+        ``shed_expired`` still prices its deadline honestly."""
         reqs = sorted((e.req for e in self._q.values()),
                       key=lambda r: (r.arrival, r.rid))
         self._q.clear()

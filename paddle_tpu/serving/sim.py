@@ -16,14 +16,22 @@ back through the engine's own page tables**.
   chunk-aligned ``resume_from`` prefix-cache skip — skipped positions
   must already hold the publisher's identical tokens), decode writes
   each input token at its position before emitting the next;
-- every emitted token folds in a read-back through the table (the
-  first token hashes the WHOLE pooled prompt; each decode step folds
-  the previous position's cell), so a wrong page table, a stale
-  prefix chain, or a cross-replica pool mixup diverges the stream —
-  the same failure surface the real backend has, at numpy speed;
+- there is ONE token rule: the next token after any history is a hash
+  of the FULL pooled sequence, read back through the page table every
+  step — so a wrong page table, a stale prefix chain, or a
+  cross-replica pool mixup diverges the stream (the same failure
+  surface the real backend has, at numpy speed);
+- because prefill and decode apply the SAME rule to the same history,
+  the sim is RESUME-CONSISTENT exactly like the real model: prefilling
+  ``prompt + already_emitted`` yields the token a decode step would
+  have emitted next. That is the property the fault-tolerance layer's
+  resume-from-prefix retries stand on — a request failed over
+  mid-decode re-enters with its emitted tokens as prompt and the
+  completed stream must be token-identical to an uninterrupted run;
 - tokens depend ONLY on the request's own history, so greedy parity
-  across placement policies / replica counts / a single-engine oracle
-  is the honest invariant it is with the real model.
+  across placement policies / replica counts / crash-failover retries
+  / a single-engine oracle is the honest invariant it is with the
+  real model.
 
 ``wants_numpy_`` tells the engine to skip the ``jnp.asarray`` staging
 (pure overhead here). Paged-only by design: build engines with
@@ -103,21 +111,19 @@ class SimServing:
                             None, self._make_decode_n())
 
     # --- the token rule ---------------------------------------------------
-    def _first_token(self, seq: np.ndarray) -> int:
-        """Hash of the FULL pooled prompt (uint64 wraparound polynomial
-        — deterministic on any platform), mapped to [1, vocab)."""
+    def _token(self, seq) -> int:
+        """THE greedy rule: next token after history ``seq`` = uint64
+        wraparound polynomial hash of the whole sequence (deterministic
+        on any platform), mapped to [1, vocab). Prefill applies it to
+        the pooled prompt; every decode step applies it to the pooled
+        prompt + emitted-so-far — one rule, so prefill and decode are
+        RESUME-CONSISTENT (see the module docstring)."""
+        seq = np.asarray(seq, np.uint64)
         L = len(seq)
         with np.errstate(over="ignore"):
-            h = (seq.astype(np.uint64) * self._pow[L - 1::-1]).sum()
+            h = (seq * self._pow[L - 1::-1]).sum()
         h = (int(h) + self.salt) & ((1 << 64) - 1)
         return 1 + h % (self.vocab - 1)
-
-    def _next_token(self, cur: int, prev_cell: int, pos: int) -> int:
-        """One greedy step: the input token (whose 'K/V' was just
-        written), the PREVIOUS position's pooled cell (the read-back
-        that catches table/chain bugs), and the position."""
-        return 1 + (cur * 8121 + prev_cell * 28411
-                    + pos * 134775813 + self.salt) % (self.vocab - 1)
 
     # --- the factory callables --------------------------------------------
     def _make_prefill(self):
@@ -138,7 +144,7 @@ class SimServing:
                 pools[pt[0, pos // ps], pos % ps] = toks[0, pos]
             pages = pt[0, :-(-L // ps)]
             seq = pools[pages].reshape(-1)[:L]
-            first = self._first_token(seq)
+            first = self._token(seq)
             return np.asarray([first], np.int64), pools
 
         prefill._cache_size = lambda: 0  # no jit cache to watch
@@ -160,8 +166,11 @@ class SimServing:
                 cur = int(toks[s])
                 for k in range(n):
                     pools[pt[s, L // ps], L % ps] = cur
-                    prev = int(pools[pt[s, (L - 1) // ps], (L - 1) % ps])
-                    cur = self._next_token(cur, prev, L + 1)
+                    # read the FULL history back through the table —
+                    # a wrong table/chain/pool diverges every token
+                    npages = -(-(L + 1) // ps)
+                    seq = pools[pt[s, :npages]].reshape(-1)[:L + 1]
+                    cur = self._token(seq)
                     emits[k, s] = cur
                     L += 1
             return emits, None, pools
@@ -175,21 +184,17 @@ class SimServing:
         computed WITHOUT any engine — the closed-form oracle parity
         tests compare engine outputs against. (The engine path reads
         these same values back through page tables; this path replays
-        the recurrence directly.)"""
-        seq = [int(t) for t in prompt]
+        the recurrence directly.) Resume identity falls out of the one
+        token rule: ``expected_stream(prompt + s[:e], n-e)`` equals
+        ``expected_stream(prompt, n)[e:]`` for any emitted prefix
+        ``s = expected_stream(prompt, n)``."""
+        hist = [int(t) for t in prompt]
         out = []
-        cur = self._first_token(np.asarray(seq, np.int64))
-        out.append(cur)
-        L = len(seq)
-        hist = list(seq)
-        for _ in range(n_tokens - 1):
-            prev = hist[L - 1]
-            hist.append(cur)
-            nxt = self._next_token(cur, prev, L + 1)
+        for _ in range(max(0, n_tokens)):
+            nxt = self._token(hist)
             out.append(nxt)
-            cur = nxt
-            L += 1
-        return out[:n_tokens]
+            hist.append(nxt)
+        return out
 
 
 def make_sim_serving(**kw) -> SimServing:

@@ -469,14 +469,59 @@ def save_trace(path: str, trace: Sequence[Request]) -> None:
             f.write(json.dumps(r.to_json()) + "\n")
 
 
-def load_trace(path: str) -> List[Request]:
-    out = []
+def iter_jsonl_tolerant(path: str):
+    """Stream a JSONL file's records, tolerating exactly the artifact
+    a crashing writer leaves behind: a torn FINAL line warns and ends
+    the stream at the valid prefix; a malformed line anywhere EARLIER
+    — or a file with NO valid record at all — raises, because those
+    mean the file is not what it claims, not that a writer died.
+    One-record lookahead, so a 10^5-line incident log never
+    materializes in memory. Shared by ``load_trace``,
+    ``engine.load_engine_log`` and any future crash-tolerant loader."""
+    import warnings
+    prev = None  # (line number, text) not yet parsed
+    n_ok = 0
     with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if ln:
-                out.append(Request.from_json(json.loads(ln)))
-    return out
+        for i, raw in enumerate(f, 1):
+            ln = raw.strip()
+            if not ln:
+                continue
+            if prev is not None:
+                try:
+                    d = json.loads(prev[1])
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{path}: malformed JSONL at line {prev[0]} "
+                        f"(not just a torn tail): {e}") from e
+                n_ok += 1
+                yield d
+            prev = (i, ln)
+    if prev is not None:
+        try:
+            d = json.loads(prev[1])
+        except json.JSONDecodeError as e:
+            if n_ok == 0:
+                # nothing valid precedes the bad line: that is not a
+                # torn tail, it is the wrong file — an empty "prefix"
+                # has no evidentiary value and returning it silently
+                # would let a mispointed path replay as an empty log
+                raise ValueError(
+                    f"{path}: no valid JSONL record (first line is "
+                    f"malformed): {e}") from e
+            warnings.warn(
+                f"{path}: final JSONL line (line {prev[0]}) is "
+                f"truncated — returning the {n_ok} valid records "
+                f"before it (crash-written log?)")
+            return
+        yield d
+
+
+def load_trace(path: str) -> List[Request]:
+    """Load a ``save_trace`` JSONL. A torn FINAL line (the file a
+    crashing writer leaves behind) loads with a warning and returns
+    the valid prefix; a malformed line anywhere earlier still raises —
+    that file is not a trace."""
+    return [Request.from_json(d) for d in iter_jsonl_tolerant(path)]
 
 
 def trace_stats(trace: Sequence[Request]) -> dict:

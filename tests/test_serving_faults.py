@@ -1,0 +1,889 @@
+"""Fault-tolerant serving cluster (PR 7).
+
+Plan level: FaultEvent/FaultPlan validation, JSONL round-trip, seeded
+synthesis determinism. Sim level: the unified token rule is
+RESUME-CONSISTENT (prefilling prompt+emitted equals decoding onward —
+the property failover retries stand on). Engine level: abort/crash
+teardown frees slots and pages with the census balanced, the pool
+purge drops every prefix key and bumps the epoch, a second session on
+the same engine starts clean, a DecodeError raised inside a decode
+turn tears down exactly one row. Cluster level: crash -> heartbeat
+detection -> failover with exactly-once accounting and token parity
+vs the fault-free replay, stalls are slow-not-dead, retry budgets
+exhaust into FAILED (never lost), backoff delays re-placement,
+cancel_after across a crash window counts once as cancelled, and the
+serving_chaos bench-gate family (pass + graceful FAIL rows). Satellites:
+truncated-tail JSONL loaders, atomic save_log, trace_report failover
+hops. One real-model test proves prefill/decode resume consistency on
+actual weights.
+"""
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (ClusterRouter, DecodeError,
+                                FailoverConfig, FaultEvent, FaultPlan,
+                                QoSScheduler, Request, ServingEngine,
+                                load_engine_log, load_trace,
+                                make_sim_serving, save_trace,
+                                synthesize_fault_plan,
+                                synthesize_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill_unit": 1.0, "decode": 1.0}
+
+
+def _sim(slots=4, extra=8, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("vocab", 211)
+    kw.setdefault("n_pool_pages",
+                  slots * (kw["max_len"] // kw["page_size"]) + 1 + extra)
+    return make_sim_serving(slots=slots, **kw)
+
+
+def _engine(slots=4, scheduler=None, serving=None, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", COSTS)
+    return ServingEngine(serving=serving or _sim(slots=slots),
+                         slots=slots, policy="paged",
+                         scheduler=scheduler, **kw)
+
+
+def _req(rid, arrival, prompt, budget, **kw):
+    return Request(rid=rid, arrival=arrival, prompt=tuple(prompt),
+                   max_new_tokens=budget, **kw)
+
+
+def _trace(n=24, seed=3, gap=0.7, plen=10, budget=8, **kw):
+    rng = np.random.default_rng(seed)
+    return [_req(f"m{i}", i * gap,
+                 [int(t) for t in rng.integers(1, 211, plen)],
+                 budget, **kw) for i in range(n)]
+
+
+def _cluster(trace, n=2, faults=None, failover=None, scheduler=None,
+             placement="round_robin", trace_out=None, slots=4,
+             events=()):
+    def spawn(name):
+        return _engine(slots=slots,
+                       scheduler=(QoSScheduler(max_queue=scheduler)
+                                  if scheduler else None))
+    if faults is not None and failover is None:
+        failover = FailoverConfig(heartbeat_interval=1.0,
+                                  heartbeat_timeout=3.0,
+                                  backoff_base=0.5)
+    return ClusterRouter(spawn, n, placement=placement, faults=faults,
+                         failover=failover, trace=trace_out).run(
+                             trace, events=events)
+
+
+# --- fault plans ------------------------------------------------------------
+
+def test_fault_event_validation():
+    with pytest.raises(ValueError, match="kind"):
+        FaultEvent(t=1.0, kind="explode", replica="r0")
+    with pytest.raises(ValueError, match="duration"):
+        FaultEvent(t=1.0, kind="stall", replica="r0")
+    with pytest.raises(ValueError, match="no duration"):
+        FaultEvent(t=1.0, kind="crash", replica="r0", duration=2.0)
+    with pytest.raises(ValueError, match="dead replica"):
+        FaultPlan([FaultEvent(t=1.0, kind="crash", replica="r0"),
+                   FaultEvent(t=2.0, kind="stall", replica="r0",
+                              duration=1.0)])
+
+
+def test_fault_plan_roundtrip_and_synthesis(tmp_path):
+    plan = synthesize_fault_plan(seed=4, replicas=["r0", "r1", "r2",
+                                                   "r3"],
+                                 span=100.0)
+    assert len(plan.crashes()) == 1
+    assert all(e.t <= 100.0 for e in plan)
+    # crashes land mid-trace; other faults only target survivors
+    victim = plan.crashes()[0].replica
+    assert 35.0 <= plan.crashes()[0].t <= 65.0
+    assert all(e.replica != victim for e in plan
+               if e.kind != "crash")
+    # same seed -> same plan; JSONL round-trips exactly
+    again = synthesize_fault_plan(seed=4,
+                                  replicas=["r0", "r1", "r2", "r3"],
+                                  span=100.0)
+    assert [e.to_json() for e in plan] == [e.to_json() for e in again]
+    p = str(tmp_path / "plan.jsonl")
+    plan.save(p)
+    assert [e.to_json() for e in FaultPlan.load(p)] == \
+        [e.to_json() for e in plan]
+    with pytest.raises(ValueError, match="survive"):
+        synthesize_fault_plan(seed=0, replicas=["r0"], span=10.0)
+
+
+# --- sim resume consistency -------------------------------------------------
+
+def test_sim_is_resume_consistent():
+    """The fault-tolerance keystone: prefilling prompt + the first e
+    emitted tokens yields exactly the stream an uninterrupted decode
+    would have continued with — at the oracle AND through the engine."""
+    sim = _sim()
+    prompt = tuple(range(1, 11))
+    full = sim.expected_stream(prompt, 10)
+    for e in (1, 3, 7):
+        resumed = sim.expected_stream(tuple(prompt) + tuple(full[:e]),
+                                      10 - e)
+        assert resumed == full[e:], e
+    # engine path: a fresh engine serving the resumed request agrees
+    res = _engine().run([_req("a", 0.0, prompt, 10)])
+    assert res.outputs["a"] == full
+    res2 = _engine().run([_req("a.retry", 0.0,
+                               tuple(prompt) + tuple(full[:4]), 6)])
+    assert res2.outputs["a.retry"] == full[4:]
+
+
+# --- engine teardown --------------------------------------------------------
+
+def _session_with_active(n=3):
+    eng = _engine()
+    s = eng.session(expect_churn=False)
+    for r in _trace(n=n, gap=0.0):
+        s.advance_until(r.arrival)
+        s.submit(r)
+    s.advance_until(6.0)  # admit + a few decode turns
+    assert s.active
+    return eng, s
+
+
+def test_abort_row_frees_slot_and_pages():
+    eng, s = _session_with_active()
+    rid = sorted(s.active)[0]
+    slots_before = list(s.free_slots)
+    req, out = s.abort_row(rid, reason="decode_error")
+    assert req.rid == rid and len(out) >= 1
+    assert rid not in s.active
+    assert len(s.free_slots) == len(slots_before) + 1
+    assert s.book.census_ok()
+    # the record MOVED: no output, no metrics row, an "abort" slot event
+    assert rid not in s.outputs
+    assert rid not in [v["rid"] for v in s.m.request_rows()]
+    assert any(ev == "abort" and r == rid
+               for _, ev, r, _ in s.slot_log)
+    # survivors stream on to their full budgets
+    res = s.finish()
+    ref = _sim()
+    for other in res.outputs:
+        assert res.outputs[other] == ref.expected_stream(
+            next(r.prompt for r in _trace() if r.rid == other),
+            len(res.outputs[other]))
+
+
+def test_crash_purges_pool_and_second_session_starts_clean():
+    eng, s = _session_with_active()
+    prompts = {rid: s.active[rid].req.prompt for rid in s.active}
+    epoch0 = s.book.epoch
+    s.crash()
+    assert s.crashed
+    assert [r.rid for r, _ in s.crash_salvage] == sorted(
+        prompts, key=lambda r: r)  # admit order == arrival order here
+    # pool GONE: zero resident, zero evictable, no key survives, epoch
+    # bumped — a restarted replica can never serve pre-crash pages
+    cs = s.book.cache_stats()
+    assert cs["resident_pages"] == 0 and cs["evictable_pages"] == 0
+    assert cs["free_pages"] == cs["n_pages"]
+    assert s.book.epoch == epoch0 + 1
+    for p in prompts.values():
+        assert s.book.match_prefix(list(p)) == 0
+    assert s.book.census_ok()
+    with pytest.raises(RuntimeError, match="already crashed"):
+        s.crash()
+    # crashed session: clock advances, nothing processes
+    s.advance_until(50.0)
+    assert not s.active and s.clock.now() == 50.0
+    res = s.finish()
+    assert res.cache_stats["invariant_ok"]
+    # a SECOND session on the same engine starts clean and serves
+    s2 = eng.session()
+    r = _req("fresh", 0.0, range(1, 11), 4)
+    s2.submit(r)
+    out = s2.finish().outputs["fresh"]
+    assert out == _sim().expected_stream(r.prompt, 4)
+
+
+def test_decode_error_inside_turn_kills_one_row_only():
+    eng, s = _session_with_active(n=3)
+    victim = sorted(s.active)[0]
+    fired = []
+
+    def hook(sess):
+        if victim in sess.active and not fired:
+            fired.append(True)
+            raise DecodeError(victim)
+
+    s.decode_fault_hook = hook
+    res = s.finish()
+    assert fired
+    assert len(s.aborted) == 1
+    req, out = s.aborted[0]
+    assert req.rid == victim
+    assert victim not in res.outputs
+    ref = _sim()
+    for rid in res.outputs:  # survivors: full, correct streams
+        r0 = next(r for r in _trace() if r.rid == rid)
+        assert res.outputs[rid] == ref.expected_stream(
+            r0.prompt, r0.max_new_tokens)
+    # a DecodeError for an unknown row is NOT swallowed
+    eng2, s2 = _session_with_active(n=1)
+    s2.decode_fault_hook = lambda sess: (_ for _ in ()).throw(
+        DecodeError("nobody"))
+    with pytest.raises(DecodeError):
+        s2.finish()
+
+
+# --- cluster failover -------------------------------------------------------
+
+def test_fault_targeting_never_joined_replica_refuses_loudly():
+    trace = _trace(n=4)
+    plan = FaultPlan([FaultEvent(t=1.0, kind="crash", replica="r9")])
+    with pytest.raises(ValueError, match="has not joined"):
+        _cluster(trace, n=2, faults=plan)
+
+
+def test_crash_failover_exactly_once_with_token_parity():
+    trace = _trace(n=24)
+    base = _cluster(trace, n=2).outputs()
+    plan = FaultPlan([FaultEvent(t=4.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan)
+    cen = res.census()
+    assert cen["conserved"], cen
+    assert cen["lost"] == [] and cen["duplicated"] == []
+    assert cen["retried"] >= 1 and cen["failed"] == 0
+    # every completed stream token-identical to the fault-free replay,
+    # salvage included (the resumed rows' streams are stitched)
+    out = res.outputs()
+    assert set(out) == set(base)
+    assert out == base
+    assert res.salvaged  # some rows really were resumed mid-stream
+    ev = {e["event"]: e for e in res.events}
+    assert ev["crash"]["replica"] == "r0"
+    assert ev["dead"]["missed_heartbeats"] >= 3
+    assert ev["remove"]["census_ok"] is True
+    assert ev["remove"]["resident_pages"] == 0
+    # the ledger shows the hop
+    moved = [rid for rid, led in res.ledger.items()
+             if led["retries"]]
+    assert moved and all(
+        res.ledger[rid]["path"][-1] == "r1" for rid in moved
+        if rid in out)
+    # detection waited for the heartbeat timeout, retries for backoff
+    assert ev["dead"]["t"] >= 4.0 + 3.0 - 1e-9
+    # fault-free results carry NO chaos keys (byte-identity guard)
+    ff = _cluster(trace, n=2)
+    assert "crashes" not in ff.report()
+    assert "retried" not in ff.census()
+
+
+def test_requests_placed_on_undetected_dead_replica_are_rescued():
+    # arrivals keep landing on r0 between its crash and detection —
+    # they must fail over with the queue, counted once
+    trace = _trace(n=16, gap=0.25)
+    plan = FaultPlan([FaultEvent(t=1.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan)
+    cen = res.census()
+    assert cen["conserved"] and not cen["lost"]
+    dead = next(e for e in res.events if e["event"] == "dead")
+    assert dead["requeued"]  # the silent window really queued work
+    base = _cluster(trace, n=2).outputs()
+    assert res.outputs() == base
+
+
+def test_stall_is_slow_not_dead():
+    trace = _trace(n=12)
+    plan = FaultPlan([FaultEvent(t=2.0, kind="stall", replica="r0",
+                                 duration=10.0)])
+    res = _cluster(trace, n=2, faults=plan)
+    assert not [e for e in res.events if e["event"] == "dead"]
+    assert [e for e in res.events if e["event"] == "stall"]
+    cen = res.census()
+    assert cen["conserved"] and cen["retried"] == 0
+    # the stalled replica's rows finish late but token-identical
+    assert res.outputs() == _cluster(trace, n=2).outputs()
+    # and the stall genuinely delayed its lane's completions
+    stalled = res.results["r0"].metrics.request_rows()
+    ff = _cluster(trace, n=2).results["r0"].metrics.request_rows()
+    assert max(v["finish"] for v in stalled) > \
+        max(v["finish"] for v in ff)
+
+
+def test_crashed_session_dead_letters_instead_of_shedding():
+    """A dead process runs no admission policy: submissions landing on
+    a crashed QoS session during the undetected-silence window must
+    dead-letter for rescue, never be shed by the corpse's queue bound
+    — and a drain event whose target was already removed by failover
+    noops instead of killing the replay."""
+    eng = _engine(scheduler=QoSScheduler(max_queue=2))
+    s = eng.session()
+    s.crash()
+    for i in range(6):  # 3x the queue bound
+        s.submit(_req(f"d{i}", 0.0, range(1, 9), 4))
+    assert not s.shed_log            # the corpse shed NOTHING
+    assert s.queued() == 6
+    pulled = s.pull_unadmitted(outcome="failover")
+    assert [r.rid for r in pulled] == [f"d{i}" for i in range(6)]
+    assert s.queued() == 0
+    # cluster level: crash + later drain of the (by then removed)
+    # replica — the drain noops, everything still conserved
+    trace = _trace(n=12)
+    plan = FaultPlan([FaultEvent(t=2.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan,
+                   events=[(30.0, "drain", "r0")])
+    assert "drain_noop" in [e["event"] for e in res.events]
+    cen = res.census()
+    assert cen["conserved"] and not cen["lost"]
+    assert res.outputs() == _cluster(trace, n=2).outputs()
+
+
+def test_drain_of_crashed_replica_resolves_to_failover():
+    """An operator drain landing on a crashed-but-undetected replica
+    cannot be graceful (the in-flight rows already died) — it must
+    resolve as an immediate failover so the crash salvage is retried,
+    never banked away with the corpse."""
+    trace = _trace(n=12)
+    plan = FaultPlan([FaultEvent(t=3.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan,
+                   failover=FailoverConfig(heartbeat_interval=1.0,
+                                           heartbeat_timeout=50.0,
+                                           backoff_base=0.5),
+                   events=[(4.0, "drain", "r0")])
+    ev = [e["event"] for e in res.events]
+    assert "drain_found_dead" in ev and "dead" in ev
+    cen = res.census()
+    assert cen["conserved"], cen
+    assert cen["lost"] == [] and cen["duplicated"] == []
+    assert res.salvaged  # the in-flight rows really moved
+    assert res.outputs() == _cluster(trace, n=2).outputs()
+
+
+def test_stall_outliving_timeline_still_delays_finish():
+    """A stall that extends past the last driven timeline event must
+    still be eaten by the final backlog drain — finish() may not skip
+    the remaining pause."""
+    eng = _engine()
+    s = eng.session()
+    s.submit(_req("s0", 0.0, range(1, 9), 4))
+    s.stall_until = 40.0
+    res = s.finish()
+    row = res.metrics.request("s0")
+    assert row["finish"] >= 40.0
+    assert res.outputs["s0"] == _sim().expected_stream(
+        tuple(range(1, 9)), 4)
+
+
+def test_decode_error_event_retries_oldest_row():
+    trace = _trace(n=8, gap=0.5)
+    plan = FaultPlan([FaultEvent(t=3.0, kind="decode_error",
+                                 replica="r0")])
+    res = _cluster(trace, n=2, faults=plan)
+    cen = res.census()
+    assert cen["conserved"] and cen["retried"] == 1
+    assert res.outputs() == _cluster(trace, n=2).outputs()
+    ev = next(e for e in res.events if e["event"] == "decode_error")
+    assert ev["salvaged"] >= 1
+    rid = ev["rid"]
+    assert res.ledger[rid]["retries"] == 1
+
+
+def test_backend_decode_error_fails_over_through_router():
+    """A DecodeError raised from INSIDE a decode turn (the backend-
+    exception path, not a scheduled fault) must fail over through the
+    router: the aborted row is collected, retried on a survivor, and
+    the stream completes token-identical. Without a failover config
+    the router refuses LOUDLY instead of losing the row."""
+    trace = _trace(n=6, gap=0.5)
+    fired = []
+
+    def make_spawn(arm):
+        def spawn(name):
+            eng = _engine()
+            orig = eng.session
+
+            def session(**kw):
+                s = orig(**kw)
+                if name == "r0":
+                    def hook(sess):
+                        if not fired and sess.active:
+                            fired.append(arm)
+                            raise DecodeError(sorted(sess.active)[0])
+                    s.decode_fault_hook = hook
+                return s
+            eng.session = session
+            return eng
+        return spawn
+
+    res = ClusterRouter(make_spawn("a"), 2, placement="round_robin",
+                        failover=FailoverConfig(
+                            backoff_base=0.5)).run(trace)
+    assert fired
+    cen = res.census()
+    assert cen["conserved"] and not cen["lost"] \
+        and not cen["duplicated"]
+    assert any(led["retries"] for led in res.ledger.values())
+    # failover-only (no plan) runs that actually retried still carry
+    # the chaos accounting blocks — `faulted` tracks engagement, not
+    # just plan presence
+    assert res.faulted and cen["retried"] >= 1 and cen["failed"] == 0
+    assert "retried_requests" in res.report()
+    assert res.outputs() == _cluster(trace, n=2).outputs()
+    # no failover config -> loud refusal, never a silent loss
+    fired.clear()
+    with pytest.raises(RuntimeError, match="no failover config"):
+        ClusterRouter(make_spawn("b"), 2,
+                      placement="round_robin").run(trace)
+
+
+def test_unplaceable_retry_fails_accounted_not_fatal():
+    """A failed-over request that no admitting survivor can fit (the
+    only replica left has a smaller max_len) must land in FAILED —
+    counted once, replay intact — not raise out of run()."""
+    def spawn(name):
+        ml = 64 if name == "r0" else 32
+        return ServingEngine(
+            serving=make_sim_serving(max_len=ml, page_size=8, slots=2,
+                                     vocab=211,
+                                     n_pool_pages=2 * (ml // 8) + 9),
+            slots=2, policy="paged", clock="fixed", fixed_costs=COSTS)
+
+    # h0 fits only r0 (footprint 40+8+1 > 32); r0 crashes mid-stream
+    trace = [_req("h0", 0.0, range(1, 36), 8),
+             _req("h1", 0.2, range(1, 9), 4)]
+    plan = FaultPlan([FaultEvent(t=1.0, kind="crash", replica="r0")])
+    res = ClusterRouter(spawn, 2, placement="least_loaded",
+                        faults=plan,
+                        failover=FailoverConfig(
+                            heartbeat_interval=1.0,
+                            heartbeat_timeout=2.0)).run(trace)
+    assert "h0" in res.failed and "fit" in res.failed["h0"]
+    assert "retry_unplaceable" in [e["event"] for e in res.events]
+    cen = res.census()
+    assert cen["conserved"], cen
+    assert cen["lost"] == [] and cen["failed"] == 1
+
+
+def test_retry_routes_to_the_survivor_that_fits():
+    """One small joiner must not doom a failed-over request a capable
+    survivor can serve: retry placement filters to fitting replicas."""
+    def spawn(name):
+        ml = 32 if name == "r1" else 64
+        return ServingEngine(
+            serving=make_sim_serving(max_len=ml, page_size=8, slots=2,
+                                     vocab=211,
+                                     n_pool_pages=2 * (ml // 8) + 9),
+            slots=2, policy="paged", clock="fixed", fixed_costs=COSTS)
+
+    # big fits r0/r2 (64) but not r1 (32); r2 crashes holding it
+    trace = [_req("pad0", 0.0, range(1, 9), 2),
+             _req("pad1", 0.1, range(10, 18), 2),
+             _req("big", 0.2, range(1, 36), 8),
+             _req("pad2", 0.3, range(20, 28), 2)]
+    plan = FaultPlan([FaultEvent(t=1.5, kind="crash", replica="r2")])
+    res = ClusterRouter(spawn, 3, placement="round_robin",
+                        faults=plan,
+                        failover=FailoverConfig(
+                            heartbeat_interval=1.0,
+                            heartbeat_timeout=2.0)).run(trace)
+    assert "big" not in res.failed
+    cen = res.census()
+    assert cen["conserved"] and cen["lost"] == [], cen
+    assert "big" in res.outputs()
+    assert res.ledger["big"]["path"][-1] == "r0"  # the fitting one
+
+
+def test_retry_with_no_admitting_survivor_fails_accounted():
+    """The last survivor drains inside the retry's backoff window:
+    the popped retry has nowhere to go — it must be recorded FAILED,
+    not crash the replay through _place."""
+    trace = [_req("n0", 0.0, range(1, 17), 8),
+             _req("n1", 0.1, range(20, 36), 8)]
+    plan = FaultPlan([FaultEvent(t=1.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan,
+                   failover=FailoverConfig(heartbeat_interval=1.0,
+                                           heartbeat_timeout=2.0,
+                                           backoff_base=8.0),
+                   events=[(3.5, "drain", "r1")])
+    cen = res.census()
+    assert res.failed
+    assert cen["conserved"] and cen["lost"] == [], cen
+    assert "retry_unplaceable" in [e["event"] for e in res.events]
+
+
+def test_retry_budget_exhausts_into_failed_not_lost():
+    trace = _trace(n=10)
+    plan = FaultPlan([FaultEvent(t=3.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan,
+                   failover=FailoverConfig(heartbeat_interval=1.0,
+                                           heartbeat_timeout=3.0,
+                                           retry_budget=0))
+    cen = res.census()
+    assert cen["failed"] >= 1
+    assert res.failed and all("budget exhausted" in v
+                              for v in res.failed.values())
+    assert cen["conserved"], cen  # failed is ACCOUNTED, not lost
+    assert cen["lost"] == [] and cen["duplicated"] == []
+    per = cen["tenants"]["_none"]
+    assert per["completed"] + per["shed"] + per["failed"] \
+        == per["arrived"] == 10
+
+
+def test_cancel_after_across_crash_window_counts_once_as_cancel():
+    # in-flight churn row: crashes after 2 tokens, cancel_after=5 —
+    # the retry must cancel after 3 MORE tokens, once, reason "cancel"
+    trace = [_req("c0", 0.0, range(1, 11), 9, cancel_after=5),
+             _req("c1", 0.0, range(20, 30), 9),
+             _req("q0", 1.5, range(40, 50), 6, cancel_after=2)]
+    plan = FaultPlan([FaultEvent(t=3.0, kind="crash", replica="r0")])
+    res = _cluster(trace, n=2, faults=plan)
+    base = _cluster(trace, n=2)
+    cen = res.census()
+    assert cen["conserved"] and not cen["lost"] \
+        and not cen["duplicated"]
+    out, bout = res.outputs(), base.outputs()
+    assert out["c0"] == bout["c0"] and len(out["c0"]) == 5
+    assert out["q0"] == bout["q0"] and len(out["q0"]) == 2
+    # exactly one finish record, reason "cancel", on the survivor
+    rows = [dict(v, replica=name) for name, r in res.results.items()
+            for v in r.metrics.request_rows() if v["rid"] == "c0"]
+    assert len(rows) == 1
+    assert rows[0]["finish_reason"] == "cancel"
+    assert rows[0]["evicted"] is True
+    assert rows[0]["replica"] == "r1"
+
+
+def test_chaos_replay_is_deterministic():
+    from paddle_tpu.serving import synthesize_cluster_trace
+    trace = synthesize_cluster_trace(seed=9, n_requests=400,
+                                     service_tokens_per_unit=8.0,
+                                     vocab_size=211)
+    span = trace[-1].arrival - trace[0].arrival
+    plan = synthesize_fault_plan(seed=1, replicas=["r0", "r1"],
+                                 span=span, n_stalls=1,
+                                 n_decode_errors=1)
+
+    def one():
+        res = _cluster(trace, n=2, faults=plan, scheduler=16,
+                       placement="prefix_aware")
+        return (json.dumps(res.report(), sort_keys=True),
+                res.outputs(), res.events, res.failed)
+
+    assert one() == one()
+
+
+# --- truncated-log loaders (satellite) --------------------------------------
+
+def test_load_engine_log_tolerates_torn_tail(tmp_path):
+    res = _engine().run(_trace(n=6))
+    p = str(tmp_path / "log.jsonl")
+    res.save_log(p)
+    whole = load_engine_log(p)
+    body = open(p).read()
+    open(p, "w").write(body[:-25])  # tear the final record mid-line
+    with pytest.warns(UserWarning, match="truncated"):
+        torn = load_engine_log(p)
+    # the valid prefix survived intact
+    n_whole = len(whole["decisions"]) + len(whole["slot_log"])
+    n_torn = len(torn["decisions"]) + len(torn["slot_log"])
+    assert n_torn == n_whole - 1
+    assert torn["decisions"] == whole["decisions"][:len(
+        torn["decisions"])]
+    # a MID-file tear is not a crash artifact: loud error
+    lines = body.splitlines(keepends=True)
+    open(p, "w").write(lines[0] + lines[1][:10] + "\n"
+                       + "".join(lines[2:]))
+    with pytest.raises(ValueError, match="malformed"):
+        load_engine_log(p)
+
+
+def test_load_trace_tolerates_torn_tail(tmp_path):
+    trace = synthesize_trace(seed=0, n_requests=5, vocab_size=97)
+    p = str(tmp_path / "t.jsonl")
+    save_trace(p, trace)
+    body = open(p).read()
+    open(p, "w").write(body[:-20])
+    with pytest.warns(UserWarning, match="truncated"):
+        torn = load_trace(p)
+    assert [r.rid for r in torn] == [r.rid for r in trace[:-1]]
+    assert torn == trace[:4]
+    # a file with NO valid record is the wrong file, not a torn tail
+    open(p, "w").write("definitely not json\n")
+    with pytest.raises(ValueError, match="no valid JSONL"):
+        load_trace(p)
+
+
+# --- atomic save_log (satellite) --------------------------------------------
+
+def test_save_log_atomic_failed_write_keeps_old_log(tmp_path):
+    p = str(tmp_path / "log.jsonl")
+    res = _engine().run(_trace(n=4))
+    res.save_log(p)
+    before = open(p).read()
+    bad = dataclasses.replace(res)
+    bad.decisions = res.decisions + [{"t": 0.0, "oops": object()}]
+    with pytest.raises(TypeError):
+        bad.save_log(p)
+    assert open(p).read() == before          # old log survived
+    assert os.listdir(tmp_path) == ["log.jsonl"]  # no tmp litter
+
+
+# --- trace_report failover evidence (satellite) -----------------------------
+
+def test_trace_report_failover_hops_and_chaos_row(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from trace_report import (chaos_events, failover_hops,
+                                  load_trace as _load, report,
+                                  track_names)
+    finally:
+        sys.path.pop(0)
+    out = str(tmp_path / "chaos.json")
+    trace = _trace(n=16)
+    plan = FaultPlan([FaultEvent(t=3.0, kind="crash", replica="r0")])
+    _cluster(trace, n=2, faults=plan, trace_out=out)
+    events = _load(out)
+    tracks = track_names(events)
+    hops = failover_hops(events, tracks)
+    assert hops
+    retried = next(iter(sorted(hops)))
+    assert hops[retried]["retries"] >= 1
+    assert hops[retried]["path"][-1] == "r1"
+    kinds = {c["event"] for c in chaos_events(events)}
+    assert {"crash", "dead", "retry"} <= kinds
+    txt = report(events)
+    assert "crash timeline" in txt and "retries=1" in txt
+    # the --json chaos row rides before the global summary
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "trace_report.py"), out,
+         "--json"], capture_output=True, text=True, timeout=60,
+        cwd=REPO)
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()]
+    chaos_rows = [x for x in rows
+                  if x.get("bench") == "trace_report_chaos"]
+    assert len(chaos_rows) == 1
+    assert chaos_rows[0]["retried_requests"] == len(hops)
+    assert rows[-1]["bench"] == "trace_report"  # global still LAST
+    # a fault-free trace yields NO chaos section or row
+    solo = str(tmp_path / "plain.json")
+    _cluster(trace, n=2, trace_out=solo)
+    sev = _load(solo)
+    assert chaos_events(sev) == []
+    assert "crash timeline" not in report(sev)
+
+
+# --- the serving_chaos bench-gate family ------------------------------------
+
+def _run_gate(text, tmp_path):
+    env = {**os.environ,
+           "BENCH_GATE_SERVING_BASELINE": str(tmp_path / "b.json")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "serving", "-"], input=text, capture_output=True, text=True,
+        timeout=60, cwd=REPO, env=env)
+    return r.returncode, [json.loads(ln) for ln in
+                          r.stdout.strip().splitlines()]
+
+
+def _chaos_row(arm, goodput, *, conserved=True, pools=True,
+               removal=True):
+    return json.dumps({
+        "bench": "serving_chaos", "arm": arm,
+        "goodput_tokens": goodput, "conserved": conserved,
+        "pool_census_ok": pools, "removal_census_ok": removal,
+        "lost": [], "duplicated": [], "device": "sim"})
+
+
+def _chaos_summary(*, ratio=0.9, parity=True, compared=1000,
+                   crashes=1, retried=5, lost=(), dup=(),
+                   membership=True):
+    return json.dumps({
+        "bench": "serving_chaos_summary", "crashes": crashes,
+        "stalls": 2, "decode_errors": 2, "failovers": crashes,
+        "retried": retried, "failed": 0, "resumed_with_salvage": 3,
+        "lost": list(lost), "duplicated": list(dup),
+        "conserved": True, "membership_census_ok": membership,
+        "parity_ok": parity, "parity_compared": compared,
+        "resumed_truncated_unexplained": [],
+        "chaos_vs_fault_free_goodput": ratio, "requests": 1000,
+        "replicas": 4})
+
+
+def test_bench_gate_serving_chaos_family(tmp_path):
+    base = [_chaos_row("fault_free", 1000),
+            _chaos_row("chaos", 900)]
+
+    rc, recs = _run_gate("\n".join(base + [_chaos_summary()]) + "\n",
+                         tmp_path)
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+    assert recs[-1]["chaos_vs_fault_free_goodput"] == 0.9
+
+    # a lost or duplicated request is an instant FAIL
+    rc, recs = _run_gate("\n".join(base + [_chaos_summary(
+        lost=["c-x1"])]) + "\n", tmp_path)
+    assert rc == 1 and "lost" in json.dumps(recs[-1])
+    # diverged streams are correctness, not degradation
+    rc, recs = _run_gate("\n".join(base + [_chaos_summary(
+        parity=False)]) + "\n", tmp_path)
+    assert rc == 1 and "DIVERGED" in recs[-1]["reason"]
+    # sub-floor goodput FAILs naming the floor
+    rc, recs = _run_gate("\n".join(base + [_chaos_summary(
+        ratio=0.7)]) + "\n", tmp_path)
+    assert rc == 1 and "0.8" in json.dumps(recs[-1])
+    # a chaos run that injected nothing gates nothing
+    rc, recs = _run_gate("\n".join(base + [_chaos_summary(
+        crashes=0)]) + "\n", tmp_path)
+    assert rc == 1 and "injects nothing" in recs[-1]["reason"]
+    # a resumed stream shorter than fault-free with nothing on the
+    # record to explain it is a resume-budget bug
+    bad = json.loads(_chaos_summary())
+    bad["resumed_truncated_unexplained"] = ["c-x9"]
+    rc, recs = _run_gate("\n".join(base + [json.dumps(bad)]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "dropping tokens" in recs[-1]["reason"]
+    # membership census broken at a removal
+    rc, recs = _run_gate("\n".join(base + [_chaos_summary(
+        membership=False)]) + "\n", tmp_path)
+    assert rc == 1 and "membership" in recs[-1]["reason"]
+    # missing arm / missing summary: graceful FAIL, never a traceback
+    rc, recs = _run_gate(base[0] + "\n", tmp_path)
+    assert rc == 1 and "BOTH" in recs[-1]["reason"]
+    rc, recs = _run_gate("\n".join(base) + "\n", tmp_path)
+    assert rc == 1 and "UNVERIFIED" in recs[-1]["reason"]
+    # broken per-arm census
+    rows = [_chaos_row("fault_free", 1000),
+            _chaos_row("chaos", 900, conserved=False)]
+    rc, recs = _run_gate("\n".join(rows + [_chaos_summary()]) + "\n",
+                         tmp_path)
+    assert rc == 1 and "census" in recs[-1]["reason"]
+
+    # a chaos FAIL is not masked by a passing qos family: combined
+    # verdict last
+    qos = [json.dumps({"bench": "serving_qos", "scheduler": s,
+                       "goodput_tokens_per_sec": g,
+                       "slo_tight_attained": 1.0, "tight_requests": 5,
+                       "deadline_hits": 5, "completed": 10, "shed": 0,
+                       "arrived": 10, "device": "cpu"})
+           for s, g in (("fifo", 1.0), ("qos", 1.6))]
+    rc, recs = _run_gate("\n".join(qos + base + [_chaos_summary(
+        ratio=0.5)]) + "\n", tmp_path)
+    assert rc == 1
+    assert recs[-1]["combined"] is True
+    assert recs[-1]["qos_gate"] == "pass"
+    assert recs[-1]["chaos_gate"] == "FAIL"
+
+
+# --- the end-to-end chaos arm (small) ---------------------------------------
+
+def test_chaos_arm_end_to_end_small(tmp_path):
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "serving_workload_bench.py"),
+         "--chaos", "--cluster-requests", "2000",
+         "--save-fault-plan", str(tmp_path / "plan.jsonl")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-800:]
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    summ = [r for r in rows
+            if r["bench"] == "serving_chaos_summary"][-1]
+    assert summ["lost"] == [] and summ["duplicated"] == []
+    assert summ["parity_ok"] is True and summ["crashes"] == 1
+    assert summ["conserved"] and summ["membership_census_ok"]
+    # the saved plan replays to the identical verdict
+    again = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "serving_workload_bench.py"),
+         "--chaos", "--cluster-requests", "2000",
+         "--fault-plan", str(tmp_path / "plan.jsonl")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    rows2 = [json.loads(ln) for ln in again.stdout.splitlines()
+             if ln.startswith("{")]
+    assert rows2[-1] == summ
+
+
+# --- real-model resume consistency ------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_real_model_resume_from_prefix_parity(tiny_model):
+    """The property the sim mimics, on actual weights: prefilling
+    prompt + already-emitted tokens continues the greedy stream
+    exactly where decode left it — so a failed-over request's
+    resumed stream is token-identical to an uninterrupted run."""
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+
+    def factory():
+        return llama_serving_decode_factory(
+            tiny_model, max_len=48, page_size=8, n_pool_pages=13,
+            batch_capacity=2, chunked_prefill=8)
+
+    prompt = tuple(range(3, 13))
+    eng = ServingEngine(serving=factory(), slots=2, policy="paged",
+                        clock="fixed", fixed_costs=COSTS)
+    full = eng.run([_req("f", 0.0, prompt, 8)]).outputs["f"]
+    for e in (2, 5):
+        eng2 = ServingEngine(serving=factory(), slots=2,
+                             policy="paged", clock="fixed",
+                             fixed_costs=COSTS)
+        resumed = eng2.run([_req("r", 0.0,
+                                 tuple(prompt) + tuple(full[:e]),
+                                 8 - e)]).outputs["r"]
+        assert resumed == full[e:], e
+
+
+def test_real_model_queued_cancel_across_crash(tiny_model):
+    """Satellite: a churn (cancel_after) request queued at a crashed
+    replica fails over and is counted ONCE, as cancelled, on the
+    survivor — here on the real dense/paged routed engine, the other
+    backend from the sim-paged cancel test above."""
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+
+    def spawn(name):
+        return ServingEngine(
+            serving=llama_serving_decode_factory(
+                tiny_model, max_len=48, page_size=8, n_pool_pages=13,
+                batch_capacity=2, chunked_prefill=8),
+            slots=2, policy="routed", clock="fixed",
+            fixed_costs=COSTS)
+
+    trace = [_req("k0", 0.0, range(3, 11), 6),
+             _req("k1", 0.2, range(5, 13), 6, cancel_after=2),
+             _req("k2", 0.4, range(7, 15), 4)]
+    plan = FaultPlan([FaultEvent(t=0.1, kind="crash", replica="r0")])
+    res = ClusterRouter(spawn, 2, placement="round_robin",
+                        faults=plan,
+                        failover=FailoverConfig(
+                            heartbeat_interval=1.0,
+                            heartbeat_timeout=2.0)).run(trace)
+    cen = res.census()
+    assert cen["conserved"] and not cen["lost"] \
+        and not cen["duplicated"]
+    assert len(res.outputs()["k1"]) == 2
+    rows = [v for _, r in res.results.items()
+            for v in r.metrics.request_rows() if v["rid"] == "k1"]
+    assert len(rows) == 1 and rows[0]["finish_reason"] == "cancel"
+    # parity with an undisturbed cluster
+    assert res.outputs() == ClusterRouter(
+        spawn, 2, placement="round_robin").run(trace).outputs()

@@ -56,6 +56,11 @@ cannot silently ship a slower build. Three modes:
       #    conservation (completed + shed == arrived) must hold
       #    cluster-wide AND across the mid-trace drain+join arm, with
       #    the drained replica's pool census balanced at removal.
+      #  - serving_chaos (tools/serving_workload_bench.py --chaos):
+      #    under the seeded crash+stall+decode-error schedule, zero
+      #    requests lost or duplicated (census conservation at every
+      #    membership change), completed streams token-identical to
+      #    the fault-free replay, goodput >= 0.80x fault-free.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -517,6 +522,126 @@ def check_serving_cluster(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+CHAOS_GOODPUT_FLOOR = 0.80  # goodput under faults vs fault-free
+
+
+def check_serving_chaos(rows: list) -> int:
+    """Gate the fault-tolerance rows from serving_workload_bench.py
+    --chaos: on the ~10^5-request sim trace under the seeded
+    crash+stall+decode-error schedule, ZERO requests may be lost or
+    duplicated (census conservation held at every membership change —
+    the crashed replica's pool must census to zero resident pages at
+    removal), every completed stream must be token-identical to the
+    fault-free replay (failed-over requests resume from their salvaged
+    prefix and must not diverge), and goodput under faults must hold
+    >= CHAOS_GOODPUT_FLOOR x the fault-free run's. The schedule must
+    actually have crashed a replica and retried work (a chaos gate
+    that injected nothing proves nothing). Fault-free is the baseline
+    re-measured in the same run — no stamped file."""
+    cr = [r for r in rows if r.get("bench") == "serving_chaos"]
+    by = {r.get("arm"): r for r in cr}
+    ff, ch = by.get("fault_free"), by.get("chaos")
+    if ff is None or ch is None:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_chaos rows need BOTH a "
+                                    "fault_free and a chaos arm (run "
+                                    "tools/serving_workload_bench.py "
+                                    "--chaos)"}))
+        return 1
+    for r in (ff, ch):
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True \
+                or r.get("removal_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "chaos census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')} "
+                          "removal_census_ok="
+                          f"{r.get('removal_census_ok')} — a request "
+                          "was lost/duplicated or a dead replica's "
+                          "pages leaked",
+                "lost": r.get("lost"),
+                "duplicated": r.get("duplicated")}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_chaos_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_chaos_summary row — "
+                                    "chaos-vs-fault-free token parity "
+                                    "is UNVERIFIED (rerun the --chaos "
+                                    "arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("lost") or s.get("duplicated"):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "requests lost or duplicated "
+                                    "across the crash",
+                          "lost": s.get("lost"),
+                          "duplicated": s.get("duplicated")}))
+        return 1
+    if s.get("membership_census_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "membership-change census broken: "
+                                    "a removed (crashed or drained) "
+                                    "replica's pool did not balance "
+                                    "at removal"}))
+        return 1
+    if s.get("parity_ok") is not True \
+            or not int(s.get("parity_compared") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "completed streams DIVERGED from "
+                                    "the fault-free replay (resume-"
+                                    "from-prefix is redoing work "
+                                    "wrong), or nothing was compared",
+                          "parity_compared": s.get("parity_compared")}))
+        return 1
+    if s.get("resumed_truncated_unexplained"):
+        # prefix parity held, but a salvage-resumed stream came back
+        # SHORTER than fault-free with no deadline/cancel/degradation
+        # on its record — a resume-budget bug, not a policy truncation
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "resumed stream(s) shorter than "
+                                    "fault-free with nothing on the "
+                                    "record to explain it — the "
+                                    "resume-from-prefix budget "
+                                    "arithmetic is dropping tokens",
+                          "rids": s.get(
+                              "resumed_truncated_unexplained")}))
+        return 1
+    if int(s.get("crashes") or 0) < 1 or int(s.get("retried") or 0) < 1:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": f"the schedule crashed "
+                                    f"{s.get('crashes')} replicas and "
+                                    f"retried {s.get('retried')} "
+                                    "requests — a chaos run that "
+                                    "injects nothing gates nothing"}))
+        return 1
+    ratio = s.get("chaos_vs_fault_free_goodput")
+    rec = {
+        "gate": "pass",
+        "chaos_vs_fault_free_goodput": ratio,
+        "goodput_floor": CHAOS_GOODPUT_FLOOR,
+        "crashes": s.get("crashes"), "stalls": s.get("stalls"),
+        "decode_errors": s.get("decode_errors"),
+        "failovers": s.get("failovers"),
+        "retried": s.get("retried"), "failed": s.get("failed"),
+        "resumed_with_salvage": s.get("resumed_with_salvage"),
+        "parity_compared": s.get("parity_compared"),
+        "requests": s.get("requests"), "replicas": s.get("replicas"),
+        "device": ch.get("device", "?"),
+    }
+    if ratio is None or float(ratio) < CHAOS_GOODPUT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"goodput under faults only {ratio} x "
+                         f"fault-free (floor {CHAOS_GOODPUT_FLOOR}) — "
+                         "failover is losing more than the crashed "
+                         "capacity")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 OBS_OFF_OVERHEAD_MAX = 0.02  # tracing-off tax allowed over no-obs
 
 
@@ -636,16 +761,17 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     """Gate the serving rows: the spec-compiled vs compiled-plain row
     (tools/spec_decode_bench.py), the workload-replay rows
     (tools/serving_workload_bench.py), the QoS overload rows (--qos),
-    the prefix-cache rows (--prefix) and/or the multi-replica cluster
-    rows (--cluster) — whichever families the input carries; every
-    family present must pass. FAILs on: no canonical row at all, a
-    recorded compile failure, output divergence, a >threshold
-    regression, a sub-floor qos-vs-fifo goodput ratio, broken shed
-    accounting, sub-floor prefix savings / TTFT improvement, a broken
-    refcount/LRU census, a sub-floor prefix-aware-vs-round-robin
-    cluster goodput ratio, or a broken cluster/drain-join request-
-    conservation census — so the serving claims can only change
-    deliberately."""
+    the prefix-cache rows (--prefix), the multi-replica cluster rows
+    (--cluster) and/or the fault-tolerance rows (--chaos) — whichever
+    families the input carries; every family present must pass. FAILs
+    on: no canonical row at all, a recorded compile failure, output
+    divergence, a >threshold regression, a sub-floor qos-vs-fifo
+    goodput ratio, broken shed accounting, sub-floor prefix savings /
+    TTFT improvement, a broken refcount/LRU census, a sub-floor
+    prefix-aware-vs-round-robin cluster goodput ratio, a broken
+    cluster/drain-join request-conservation census, a lost/duplicated
+    /diverging request across a crash, or sub-floor goodput under
+    faults — so the serving claims can only change deliberately."""
     fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
@@ -658,6 +784,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_cluster")
            for r in rows):
         fam_rcs["cluster"] = check_serving_cluster(rows)
+    if any(r.get("bench", "").startswith("serving_chaos")
+           for r in rows):
+        fam_rcs["chaos"] = check_serving_chaos(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

@@ -84,6 +84,16 @@ QUEUE = [
     ("serving_cluster",
      [sys.executable, "tools/serving_workload_bench.py", "--cluster"],
      {}),
+    # PR-7 addition: the fault-tolerance chaos arm — the same
+    # 10^5-request sim trace fault-free vs under a seeded
+    # crash+stall+decode-error schedule with heartbeat failover;
+    # bench_gate.py serving gates the serving_chaos family (zero
+    # lost/duplicated requests with census conservation at every
+    # membership change, completed-stream token parity vs fault-free,
+    # goodput >= 0.80x fault-free)
+    ("serving_chaos",
+     [sys.executable, "tools/serving_workload_bench.py", "--chaos"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

@@ -54,6 +54,16 @@ arrived) cluster-wide and across the drain+join, and (with
 ``--trace-out``) nonzero per-replica slot occupancy from the chrome
 trace.
 
+The chaos arm (``--chaos``) replays the SAME ~10^5-request sim-backed
+cluster trace through prefix_aware placement twice: fault-free, then
+under a seeded crash+stall+decode-error ``FaultPlan`` with the
+heartbeat-failover router (1-of-N replicas dies mid-trace; its queued
+and in-flight work fails over with resume-from-prefix retries).
+`bench_gate.py serving` gates the `serving_chaos` family: zero lost or
+duplicated requests with census conservation at every membership
+change, completed-stream token parity vs the fault-free run, and
+goodput under faults >= 0.80x fault-free.
+
 The observability arms (PR 4):
 
 - ``--trace-out out.json`` exports the measured replay of the FIRST
@@ -80,6 +90,8 @@ Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --cpu --obs-overhead
       python tools/serving_workload_bench.py --cluster
       python tools/serving_workload_bench.py --cluster --replicas 8
+      python tools/serving_workload_bench.py --chaos
+      python tools/serving_workload_bench.py --chaos --fault-plan p.jsonl
 """
 from __future__ import annotations
 
@@ -118,17 +130,14 @@ def _streams_agree(a: dict, b: dict) -> bool:
     return _stream_parity(a, b)[0]
 
 
-def _cluster_arm(args):
-    """The multi-replica scale arm: N sim-backed engine replicas (the
-    cluster claims are about placement/scheduling/bookkeeping, which
-    the deterministic sim backend exercises at 10^5-request scale —
-    see paddle_tpu/serving/sim.py), three placement policies on ONE
-    seeded overload trace, a single consolidated engine as the token-
-    parity oracle, and a mid-trace drain+join conservation arm."""
-    import json as _json
-
-    from paddle_tpu.serving import (ClusterRouter, QoSScheduler,
-                                    ServingEngine, make_sim_serving,
+def _sim_cluster_env(args):
+    """Shared setup for the --cluster and --chaos arms: the sim-backed
+    QoS replica spawner, the honest capacity estimate and the seeded
+    ~10^5-request overload trace (both arms must replay the SAME
+    trace, so the chaos arm's fault-free baseline IS the cluster
+    arm's prefix_aware row)."""
+    from paddle_tpu.serving import (QoSScheduler, ServingEngine,
+                                    make_sim_serving,
                                     synthesize_cluster_trace,
                                     trace_stats)
 
@@ -161,7 +170,32 @@ def _cluster_arm(args):
     trace = synthesize_cluster_trace(
         seed=args.seed, n_requests=n_req,
         service_tokens_per_unit=cap, vocab_size=VOCAB)
-    stats = trace_stats(trace)
+    return {"N": N, "SLOTS": SLOTS, "CHUNK": CHUNK, "VOCAB": VOCAB,
+            "ML": ML, "PS": PS, "EXTRA": EXTRA, "costs": costs,
+            "weights": weights, "spawn": spawn, "cap": cap,
+            "n_req": n_req, "trace": trace,
+            "stats": trace_stats(trace)}
+
+
+def _cluster_arm(args):
+    """The multi-replica scale arm: N sim-backed engine replicas (the
+    cluster claims are about placement/scheduling/bookkeeping, which
+    the deterministic sim backend exercises at 10^5-request scale —
+    see paddle_tpu/serving/sim.py), three placement policies on ONE
+    seeded overload trace, a single consolidated engine as the token-
+    parity oracle, and a mid-trace drain+join conservation arm."""
+    import json as _json
+
+    from paddle_tpu.serving import ClusterRouter, ServingEngine, \
+        make_sim_serving
+
+    env = _sim_cluster_env(args)
+    N, SLOTS, CHUNK, VOCAB = (env["N"], env["SLOTS"], env["CHUNK"],
+                              env["VOCAB"])
+    ML, PS, EXTRA = env["ML"], env["PS"], env["EXTRA"]
+    costs, weights, spawn = env["costs"], env["weights"], env["spawn"]
+    cap, n_req, trace, stats = (env["cap"], env["n_req"],
+                                env["trace"], env["stats"])
 
     def emit(rec):
         print(_json.dumps(rec), flush=True)
@@ -277,6 +311,125 @@ def _cluster_arm(args):
     return 0
 
 
+def _chaos_arm(args):
+    """The fault-tolerance arm: the SAME ~10^5-request sim-backed
+    overload trace as --cluster, replayed twice through prefix_aware
+    placement — once fault-free (the baseline) and once under a
+    seeded crash+stall+decode-error schedule with the heartbeat
+    failover router. One `serving_chaos` row per arm plus a
+    `serving_chaos_summary`; `bench_gate.py serving` gates the
+    serving_chaos family: zero lost or duplicated requests (census
+    conservation at every membership change), completed-stream token
+    parity vs fault-free, and goodput under faults >= 0.80x the
+    fault-free run."""
+    import json as _json
+
+    from paddle_tpu.serving import (ClusterRouter, FailoverConfig,
+                                    FaultPlan, synthesize_fault_plan)
+
+    env = _sim_cluster_env(args)
+    N, trace, stats = env["N"], env["trace"], env["stats"]
+    spawn, weights = env["spawn"], env["weights"]
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    if args.fault_plan:
+        plan = FaultPlan.load(args.fault_plan)
+    else:
+        span = trace[-1].arrival - trace[0].arrival
+        plan = synthesize_fault_plan(
+            seed=args.seed, replicas=[f"r{i}" for i in range(N)],
+            span=span, n_crashes=1, n_stalls=2,
+            stall_duration=(5.0, 20.0), n_decode_errors=2)
+    if args.save_fault_plan:
+        plan.save(args.save_fault_plan)
+    cfg = FailoverConfig()
+
+    rows = {}
+    outs = {}
+    results = {}
+    for arm, faults in (("fault_free", None), ("chaos", plan)):
+        res = ClusterRouter(spawn, N, placement="prefix_aware",
+                            faults=faults,
+                            failover=cfg if faults is not None
+                            else None).run(trace)
+        results[arm] = res
+        rep = res.report(tenant_weights=weights)
+        cen = res.census()
+        rec = {"bench": "serving_chaos", "arm": arm, "device": "sim",
+               "seed": args.seed, "replicas": N,
+               "requests": env["n_req"],
+               "heartbeat_interval": cfg.heartbeat_interval,
+               "heartbeat_timeout": cfg.heartbeat_timeout,
+               "retry_budget": cfg.retry_budget}
+        rec.update(rep)
+        rec["conserved"] = cen["conserved"]
+        rec["lost"] = cen["lost"][:5]
+        rec["duplicated"] = cen["duplicated"][:5]
+        rec["pool_census_ok"] = cen["pool_census_ok"]
+        rec["removal_census_ok"] = cen["removal_census_ok"]
+        if arm == "chaos":
+            rec["fault_events"] = len(plan)
+            rec["retried"] = cen.get("retried", 0)
+            rec["failed"] = cen.get("failed", 0)
+        rec["trace"] = stats
+        rows[arm] = rec
+        outs[arm] = res.outputs()
+        emit(rec)
+
+    ff, ch = rows["fault_free"], rows["chaos"]
+    parity, compared, full_eq = _stream_parity(outs["chaos"],
+                                               outs["fault_free"])
+    # prefix parity alone would let a resume bug that systematically
+    # SHORTENS failed-over streams pass: audit every salvage-resumed
+    # request completed in both arms — a chaos stream shorter than
+    # fault-free is legitimate ONLY when the survivor's record
+    # explains it (deadline timeout / cancel eviction / degraded
+    # budget); an unexplained short resume is a redo-work bug
+    chres = results["chaos"]
+    resumed_bad = []
+    for rid in sorted(chres.salvaged):
+        a = outs["chaos"].get(rid)
+        b = outs["fault_free"].get(rid)
+        if a is None or b is None or len(a) >= len(b):
+            continue
+        rep = chres.ledger[rid]["replica"]
+        v = chres.results[rep].metrics.request(rid)
+        if v["finish_reason"] is None and v["degraded_from"] is None:
+            resumed_bad.append(rid)
+    ff_g = ff.get("goodput_tokens") or 0
+    ch_g = ch.get("goodput_tokens") or 0
+    # membership conservation: every crash/drain removal recorded a
+    # balanced zero-resident pool census AND the global census
+    # conserved — "at every membership change" is exactly the removal
+    # events' census_ok plus the per-tenant conservation both rows
+    # already carry
+    emit({"bench": "serving_chaos_summary", "device": "sim",
+          "seed": args.seed, "replicas": N, "requests": env["n_req"],
+          "crashes": ch.get("crashes", 0),
+          "stalls": ch.get("stalls", 0),
+          "decode_errors": ch.get("decode_errors", 0),
+          "failovers": ch.get("failovers", 0),
+          "retried": ch.get("retried", 0),
+          "failed": ch.get("failed", 0),
+          "resumed_with_salvage": ch.get("resumed_with_salvage", 0),
+          "lost": ch.get("lost"), "duplicated": ch.get("duplicated"),
+          "conserved": bool(ff["conserved"] and ch["conserved"]),
+          "membership_census_ok": bool(ch["removal_census_ok"]
+                                       and ch["pool_census_ok"]),
+          "parity_ok": bool(parity), "parity_compared": compared,
+          "parity_full_equal": full_eq,
+          "resumed_truncated_unexplained": resumed_bad[:5],
+          "fault_free_goodput_tokens": ff_g,
+          "chaos_goodput_tokens": ch_g,
+          "chaos_vs_fault_free_goodput": round(ch_g / ff_g, 4)
+          if ff_g else None,
+          "fault_free_completed": ff.get("completed"),
+          "chaos_completed": ch.get("completed")})
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -328,6 +481,21 @@ def main(argv=None):
     ap.add_argument("--cluster-requests", type=int, default=100_000,
                     help="cluster arm: trace size (the scale gate "
                          "runs the full 10^5)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the fault-tolerance arm instead: the "
+                         "--cluster trace through prefix_aware "
+                         "placement fault-free vs under a seeded "
+                         "crash+stall+decode-error schedule with "
+                         "heartbeat failover; bench_gate.py serving "
+                         "gates the serving_chaos family (zero "
+                         "lost/duplicated, token parity vs "
+                         "fault-free, goodput >= 0.80x)")
+    ap.add_argument("--fault-plan", type=str, default=None,
+                    help="chaos arm: replay a saved FaultPlan JSONL "
+                         "instead of synthesizing")
+    ap.add_argument("--save-fault-plan", type=str, default=None,
+                    help="chaos arm: save the (synthesized or "
+                         "loaded) plan for replay")
     ap.add_argument("--trace-out", type=str, default=None,
                     help="export the measured replay (first policy, "
                          "or the qos engine under --qos) as "
@@ -365,6 +533,8 @@ def main(argv=None):
 
     if args.cluster:
         return _cluster_arm(args)
+    if args.chaos:
+        return _chaos_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)

@@ -13,6 +13,11 @@ tool turns it into the four summaries an on-call actually asks for:
 - **shed timeline**: scheduler rejections in time order with reasons.
 - **slot occupancy**: busy% per decode slot track — idle slots mean
   admission (not compute) is the bottleneck.
+- **crash timeline** (cluster chaos traces only): crash / stall /
+  decode-error / dead / retry instants from the router's cluster
+  track in time order, and per-request failover hops — a retried
+  request's waterfall row shows ``retries=N`` and its replica path
+  (``r0>r2``), so "which replica redid whose work" is one glance.
 
 ``--json`` emits one row PER TRACK, then (for cluster traces, whose
 engine tracks are replica-prefixed ``r0/engine``, ``r0/slot/3``, ...)
@@ -81,6 +86,56 @@ def request_rows(events: list, tracks: dict) -> list:
     out = sorted(rows.values(),
                  key=lambda r: (r.get("arrival", 0.0), r["rid"]))
     return out
+
+
+CHAOS_NAMES = ("crash", "stall", "decode_error", "dead", "retry",
+               "retry_exhausted")
+
+
+def chaos_events(events: list) -> list:
+    """The fault/failover instants a chaos cluster replay leaves on
+    the router's cluster track, in time order. Empty for any trace
+    recorded without a fault plan — every chaos section/row below is
+    omitted then, so pre-chaos traces summarize byte-identically."""
+    return sorted(
+        ({"t": e["ts"], "event": e["name"], **e.get("args", {})}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") in CHAOS_NAMES),
+        key=lambda r: (r["t"], r["event"]))
+
+
+def failover_hops(events: list, tracks: dict) -> dict:
+    """rid -> {"retries": N, "path": [replica, ...]} for every request
+    that failed over. Retry counts come from the router's ``retry``
+    instants; the replica path comes from the request's ``admit``
+    instants (their tracks are replica-prefixed in cluster traces), in
+    admit-time order, so the path shows where the work actually ran —
+    queued-only hops that never admitted anywhere do not appear in
+    it."""
+    hops: dict = {}
+    for e in events:
+        if e.get("ph") == "i" and e.get("name") == "retry":
+            rid = e.get("args", {}).get("rid")
+            if rid is not None:
+                h = hops.setdefault(rid, {"retries": 0, "path": []})
+                h["retries"] = max(h["retries"],
+                                   int(e["args"].get("attempt", 0)))
+    if not hops:
+        return {}
+    admits: dict = {}
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "admit":
+            continue
+        rid = e.get("args", {}).get("rid")
+        if rid not in hops:
+            continue
+        name = tracks.get(e["tid"], "")
+        rep = name.split("/", 1)[0] if "/" in name else None
+        if rep is not None:
+            admits.setdefault(rid, []).append((e["ts"], rep))
+    for rid, h in hops.items():
+        h["path"] = [rep for _, rep in sorted(admits.get(rid, []))]
+    return hops
 
 
 def recompiles(events: list) -> list:
@@ -222,6 +277,7 @@ def summarize(events: list) -> dict:
 def report(events: list, width: int = 50, top: int = 10) -> str:
     tracks = track_names(events)
     reqs = request_rows(events, tracks)
+    hops = failover_hops(events, tracks)
     lines = []
     if reqs:
         ts = [r["arrival"] for r in reqs if "arrival" in r] + \
@@ -238,9 +294,13 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                 ttft = f" ttft={(r['first_token'] - r['arrival']) / 1e6:.4f}"
             hit = f" hit={r['prefix_hit']}" \
                 if r.get("prefix_hit") else ""
+            hop = hops.get(r["rid"])
+            fo = (f" retries={hop['retries']} "
+                  f"path={'>'.join(hop['path'])}") if hop else ""
             lines.append(
                 f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
-                f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}")
+                f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}"
+                f"{fo}")
     comp = recompiles(events)
     lines.append(f"\n== recompiles ({len(comp)}) ==")
     by_site: dict = {}
@@ -265,6 +325,16 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
     for name, frac in sorted(occ.items()):
         bar = "#" * int(frac * 30)
         lines.append(f"  {name:8s} {frac:7.1%} {bar}")
+    chaos = chaos_events(events)
+    if chaos:
+        # only chaos traces grow this section — pre-fault traces
+        # render byte-identically
+        lines.append(f"\n== crash timeline ({len(chaos)}) ==")
+        for c in chaos[:top * 3]:
+            extra = " ".join(f"{k}={v}" for k, v in c.items()
+                             if k not in ("t", "event"))
+            lines.append(f"  t={c['t'] / 1e6:.4f}s "
+                         f"{c['event']:16s} {extra}")
     return "\n".join(lines)
 
 
@@ -283,13 +353,28 @@ def main(argv=None) -> int:
         return 1
     if args.json:
         # per-track rows, then per-replica rollups (cluster traces
-        # only), then the GLOBAL row LAST — consumers that read the
-        # final JSON line keep seeing exactly what they saw before
+        # only), then a chaos-evidence row (fault-plan traces only),
+        # then the GLOBAL row LAST — consumers that read the final
+        # JSON line keep seeing exactly what they saw before
         tracks = track_names(events)
         for row in track_summaries(events, tracks):
             print(json.dumps(row))
         for row in replica_summaries(events, tracks):
             print(json.dumps(row))
+        chaos = chaos_events(events)
+        if chaos:
+            kinds: dict = {}
+            for c in chaos:
+                kinds[c["event"]] = kinds.get(c["event"], 0) + 1
+            hops = failover_hops(events, tracks)
+            print(json.dumps({
+                "bench": "trace_report_chaos",
+                "fault_instants": len(chaos), **kinds,
+                "retried_requests": len(hops),
+                "failover_hops": {rid: {"retries": h["retries"],
+                                        "path": h["path"]}
+                                  for rid, h in sorted(hops.items())
+                                  [:20]}}))
         print(json.dumps(summarize(events)))
     else:
         print(report(events, width=args.width, top=args.top))
