@@ -463,6 +463,25 @@ class PagedKVCache:
         self._free = list(range(n_pages - 1, 0, -1))
         self.epoch += 1
 
+    def export_chain(self, seq_id, n_tokens: int):
+        """The page ids holding ``seq_id``'s first ``n_tokens``
+        tokens, in chain order — what a disaggregated serving handoff
+        exports (the pages beyond — decode slack the allocation
+        reserved — stay behind and are freed with the sequence).
+        Raises on an unknown sequence or a chain shorter than the
+        asked-for tokens: exporting a hole would hand the importer
+        unrelated K/V."""
+        table = self.tables.get(seq_id)
+        if table is None:
+            raise KeyError(f"export_chain: unknown sequence "
+                           f"{seq_id!r}")
+        need = -(-int(n_tokens) // self.page_size)
+        if need > len(table):
+            raise ValueError(
+                f"export_chain: {seq_id!r} holds {len(table)} pages, "
+                f"{need} needed for {n_tokens} tokens")
+        return list(table[:need])
+
     def census_ok(self) -> bool:
         """The accounting invariant in one place: every usable page
         (page 0 is reserved padding) is exactly one of resident /

@@ -25,13 +25,13 @@ across placements); ``tools/bench_gate.py serving`` gates every
 family.
 """
 from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
-                      LeastLoadedPlacement, PlacementPolicy,
-                      PrefixAwarePlacement, RoundRobinPlacement,
-                      make_placement)
+                      DisaggregatedPlacement, LeastLoadedPlacement,
+                      PlacementPolicy, PrefixAwarePlacement,
+                      RoundRobinPlacement, make_placement)
 from .engine import (DecodeError, EngineClock,  # noqa: F401
-                     EngineSession, FixedPolicy, Policy, RoutedPolicy,
-                     ServeResult, ServingEngine, load_engine_log,
-                     make_policy)
+                     EngineSession, FixedPolicy, KVHandoff, Policy,
+                     RoutedPolicy, ServeResult, ServingEngine,
+                     load_engine_log, make_policy)
 from .faults import (FailoverConfig, FaultEvent,  # noqa: F401
                      FaultPlan, synthesize_fault_plan)
 from .metrics import (MetricsCollector, goodput_tokens,  # noqa: F401
@@ -43,5 +43,6 @@ from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        load_trace, merge_traces, save_trace,
                        synthesize_cluster_trace,
                        synthesize_overload_trace,
+                       synthesize_prefill_heavy_trace,
                        synthesize_recurring_prefix_trace,
                        synthesize_trace, trace_stats)

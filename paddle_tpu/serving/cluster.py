@@ -62,7 +62,8 @@ from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
-from .engine import EngineSession, ServeResult, ServingEngine
+from .engine import (EngineSession, KVHandoff, ServeResult,
+                     ServingEngine)
 from .faults import FailoverConfig, FaultEvent, FaultPlan
 from .metrics import _pct, goodput_tokens, jain_fairness
 from .workload import Request
@@ -128,6 +129,55 @@ class PrefixAwarePlacement(PlacementPolicy):
         return _least_loaded(replicas)
 
 
+def _place_decode(h: KVHandoff, replicas) -> Optional["_Replica"]:
+    """The decode stage's default placement: the decode-capable
+    replica with the MOST open decode slots (slot availability is the
+    decode lane's scarce resource; load then creation order break
+    ties). None when no candidate is decode-capable."""
+    cands = [rep for rep in replicas
+             if rep.role in ("decode", "both")]
+    if not cands:
+        return None
+    return min(cands, key=lambda rep: (-rep.session.free_slot_count(),
+                                       rep.session.load(), rep.index))
+
+
+class DisaggregatedPlacement(PlacementPolicy):
+    """DistServe/Splitwise-style phase-split placement: ADMISSIONS go
+    to prefill-capable workers (role "prefill" or "both"), each
+    placed where its prefill finishes soonest — the candidate's
+    pending prefill-chunk backlog (queued prompts + async-lane
+    remainder) plus THIS prompt's own uncached chunks via the
+    non-acquiring ``match_prefix`` probe: the
+    ``ServiceEstimator.prefill_cost`` arithmetic in chunk units
+    (replicas share one cost table, so the unit cancels). DECODE
+    placement happens per finished prefill at handoff time
+    (``place_decode``): the decode-capable worker with the most open
+    slots. With no roles configured every replica is "both" and this
+    degrades to backlog-aware least-loaded placement (no handoffs
+    ever fire)."""
+
+    name = "disaggregated"
+
+    def place(self, r, replicas):
+        cands = [rep for rep in replicas
+                 if rep.role in ("prefill", "both")] or list(replicas)
+
+        def score(rep):
+            s = rep.session
+            own = -(-max(0, len(r.prompt)
+                         - s.match_prefix(r.prompt)) // s.eng.chunk_C)
+            # the final chunk always runs (last-position logits), so
+            # even a fully-cached prompt costs one chunk
+            return (s.prefill_backlog() + max(1, own), s.load(),
+                    rep.index)
+        return min(cands, key=score)
+
+    @staticmethod
+    def place_decode(h: KVHandoff, replicas):
+        return _place_decode(h, replicas)
+
+
 def make_placement(spec, threshold: Optional[int] = None) \
         -> PlacementPolicy:
     if isinstance(spec, PlacementPolicy):
@@ -138,9 +188,11 @@ def make_placement(spec, threshold: Optional[int] = None) \
         return LeastLoadedPlacement()
     if spec == "prefix_aware":
         return PrefixAwarePlacement(threshold)
+    if spec == "disaggregated":
+        return DisaggregatedPlacement()
     raise ValueError(f"placement {spec!r}: use 'round_robin', "
-                     "'least_loaded', 'prefix_aware' or a "
-                     "PlacementPolicy instance")
+                     "'least_loaded', 'prefix_aware', "
+                     "'disaggregated' or a PlacementPolicy instance")
 
 
 class _ReplicaTracer:
@@ -179,10 +231,10 @@ class _ReplicaTracer:
 
 class _Replica:
     __slots__ = ("name", "index", "session", "admitting", "joined_at",
-                 "drained_at", "last_seen")
+                 "drained_at", "last_seen", "role")
 
     def __init__(self, name: str, index: int, session: EngineSession,
-                 joined_at: float):
+                 joined_at: float, role: str = "both"):
         self.name = name
         self.index = index          # creation order: the tie-breaker
         self.session = session
@@ -193,6 +245,9 @@ class _Replica:
         # step while its session is alive); a crashed session goes
         # silent and the gap is what the failure detector reads
         self.last_seen = joined_at
+        # disaggregation stage ("prefill" / "decode" / "both") — the
+        # session enforces it; the placement policy reads it
+        self.role = role
 
 
 @dataclasses.dataclass
@@ -219,6 +274,11 @@ class ClusterResult:
     # failover machinery actually engaged (backend-raised DecodeErrors
     # under a failover-only config); gates the chaos report/census
     # blocks so fault-free replays keep the PR-6 records byte-for-byte
+    handoffs: Dict = dataclasses.field(default_factory=dict)
+    # disaggregated KV-handoff ledger {exported, imported, reclaimed,
+    # failed} — empty (and absent from census/report) when no
+    # prefill-role replica ever exported, so role-less replays keep
+    # the PR-7 records byte-for-byte
 
     def outputs(self) -> Dict[str, List[int]]:
         """Every request's greedy stream, merged across replicas (rids
@@ -238,10 +298,12 @@ class ClusterResult:
         """The no-request-lost-or-duplicated invariant, per tenant:
         every routed rid finished, shed, OR exhausted its retry budget
         on EXACTLY one replica, and ``completed + shed + failed ==
-        arrived`` for each tenant (``failed`` can only be nonzero when
+        arrived`` for each tenant (``failed`` is nonzero only when
         the failover machinery engaged — a fault plan or a
-        backend-raised DecodeError under a failover config). Also
-        folds in each
+        backend-raised DecodeError under a failover config — or when
+        a disaggregated KV handoff found no decode-capable replica
+        that could adopt it, the one placement failure a role-ful
+        router accounts instead of crashing on). Also folds in each
         replica's pool census (``invariant_ok``) and, for retired or
         crashed replicas, the at-removal census the router recorded."""
         seen: Dict[str, str] = {}
@@ -290,6 +352,18 @@ class ClusterResult:
             out["retried"] = sum(1 for led in self.ledger.values()
                                  if led.get("retries"))
             out["failed"] = len(self.failed)
+        if self.handoffs.get("exported"):
+            # the exactly-once KV-handoff balance: every exported
+            # chain was imported by a decode worker, reclaimed (its
+            # destination drained/crashed before adopting it — the
+            # request re-placed and re-prefilled), or accounted
+            # FAILED; nothing vanished in flight
+            ho = dict(self.handoffs)
+            ho["balanced"] = (ho["exported"] == ho["imported"]
+                              + ho["reclaimed"] + ho["failed"])
+            out["handoffs"] = ho
+            out["conserved"] = bool(out["conserved"]
+                                    and ho["balanced"])
         return out
 
     def report(self, tenant_weights: Optional[Dict[str, float]] = None) \
@@ -393,6 +467,12 @@ class ClusterResult:
                 if led.get("retries"))
             rec["resumed_with_salvage"] = len(self.salvaged)
             rec["failed_requests"] = len(self.failed)
+        if self.handoffs.get("exported"):
+            # only disaggregated (role-ful) replays grow this block
+            rec["kv_handoffs"] = dict(self.handoffs)
+            rec["handed_off_requests"] = sum(
+                1 for led in self.ledger.values()
+                if led.get("handoffs"))
         return rec
 
 
@@ -428,7 +508,9 @@ class ClusterRouter:
                  placement="prefix_aware",
                  prefix_threshold: Optional[int] = None,
                  trace=None, faults: Optional[FaultPlan] = None,
-                 failover: Optional[FailoverConfig] = None):
+                 failover: Optional[FailoverConfig] = None,
+                 roles: Optional[Dict[str, str]] = None,
+                 kv_transfer_unit: float = 0.0):
         if not callable(spawn):
             raise ValueError("spawn must be callable: name -> "
                              "ServingEngine (one engine+factory per "
@@ -463,6 +545,27 @@ class ClusterRouter:
         self._ctr_failovers = obs_metrics.REGISTRY.counter(
             "cluster_failovers_total",
             "replicas declared dead and failed over")
+        # --- disaggregation (inert without roles) -------------------
+        # roles: replica name -> "prefill" | "decode" | "both"
+        # (unnamed replicas default to "both"). A prefill-role
+        # session exports every finished prefill as a KVHandoff; the
+        # router prices its delivery at kv_transfer_unit PER PAGE on
+        # the shared timeline and places it on a decode worker
+        # (placement.place_decode when the policy has one, most open
+        # slots otherwise). With roles=None no session ever exports
+        # and the replay is byte-identical to a role-unaware router.
+        if roles:
+            bad = {n: v for n, v in roles.items()
+                   if v not in ("prefill", "decode", "both")}
+            if bad:
+                raise ValueError(f"roles {bad}: use 'prefill', "
+                                 "'decode' or 'both'")
+        self._roles = dict(roles or {})
+        if kv_transfer_unit < 0:
+            raise ValueError("kv_transfer_unit must be >= 0")
+        self.kv_transfer_unit = float(kv_transfer_unit)
+        self._handoff = {"exported": 0, "imported": 0,
+                         "reclaimed": 0, "failed": 0}
 
     # --- lifecycle --------------------------------------------------------
     def _add_replica(self, name: str, t: float) -> _Replica:
@@ -482,15 +585,20 @@ class ClusterRouter:
                              "ServingEngine")
         tr = _ReplicaTracer(self._tracer, name) \
             if self._tracer is not None else None
+        role = self._roles.get(name, "both")
         sess = eng.session(tracer=tr, replica=name,
-                           expect_churn=self._expect_churn)
+                           expect_churn=self._expect_churn, role=role)
         sess.clock.advance_to(t)   # a joiner starts life at NOW
-        rep = _Replica(name, self._next_index, sess, joined_at=t)
+        rep = _Replica(name, self._next_index, sess, joined_at=t,
+                       role=role)
         self._next_index += 1
         self.replicas.append(rep)
         self._g_load("cluster_replica_load",
                      "queued + in-flight requests on a replica",
                      replica=name).set(0.0)
+        if role != "both" and self._tracer is not None:
+            self._tracer.instant("role", t=t, track="cluster",
+                                 replica=name, role=role)
         return rep
 
     def _rep(self, name: str) -> _Replica:
@@ -547,8 +655,12 @@ class ClusterRouter:
         resident pages (every sequence freed) at removal. A replica
         that CRASHED while draining is never retired here — its crash
         salvage must leave through ``_declare_dead``'s failover, not
-        be banked away with the corpse."""
-        if rep.admitting or rep.session.active or rep.session.queued():
+        be banked away with the corpse. A prefill-role replica with
+        uncollected handoffs is not done either: banking it away
+        would bury exported KV the router still owes a decode
+        worker."""
+        if rep.admitting or rep.session.in_flight() \
+                or rep.session.queued() or rep.session.handoff_ready:
             return
         if rep.session.crashed:
             return
@@ -561,6 +673,7 @@ class ClusterRouter:
         replica and zero its load gauge, log the ``remove`` event
         (``extra`` tags crash removals with ``crashed``/``pool_epoch``)."""
         res = rep.session.finish()
+        self._fold_handoff_stats(rep.session)
         cs = res.cache_stats
         ok = bool(cs.get("invariant_ok")
                   and cs.get("resident_pages") == 0)
@@ -610,6 +723,82 @@ class ClusterRouter:
                          "queued + in-flight requests on a replica",
                          replica=rep2.name).set(
                 float(rep2.session.load()))
+
+    # --- KV handoff routing (the disaggregated decode stage) --------------
+    def _fold_handoff_stats(self, sess: EngineSession):
+        """Accumulate a session's import/reclaim counts into the
+        router's handoff ledger exactly once — at removal (crash or
+        retirement) or at the end-of-run bank."""
+        self._handoff["imported"] += sess.handoff_stats["imported"]
+        self._handoff["reclaimed"] += sess.handoff_stats["reclaimed"]
+        sess.handoff_stats = {"imported": 0, "reclaimed": 0}
+
+    def _collect_handoffs(self):
+        """Drain every session's handoff bank and place each exported
+        KV chain on a decode worker: delivery is priced at
+        ``kv_transfer_unit`` per page on the shared timeline
+        (``t_arrive = t_ready + pages * unit``), the ledger moves the
+        request to its decode replica (counted once — the source
+        forgot it at export), and a timeline tick lands at the
+        delivery time so lanes advance to meet it. Candidates must
+        match the chain's PAGE GEOMETRY (the exported data is
+        page-shaped — a different page size cannot adopt it; a
+        heterogeneous cluster simply narrows the candidate set) and
+        fit the request's footprint. A handoff no admitting
+        decode-capable replica can take is recorded FAILED —
+        accounted, never silently dropped."""
+        for rep in list(self.replicas):
+            if not rep.session.handoff_ready:
+                continue
+            ready = rep.session.handoff_ready
+            rep.session.handoff_ready = []
+            for h in ready:
+                self._handoff["exported"] += 1
+                rid = h.req.rid
+                led = self.ledger[rid]
+                led["handoffs"] = led.get("handoffs", 0) + 1
+                cands = [x for x in self.replicas
+                         if x.admitting
+                         and x.session.eng.page_size == h.page_size
+                         and self._rep_fits(
+                             x, len(h.req.prompt),
+                             h.req.max_new_tokens)]
+                pd = getattr(self.placement, "place_decode", None)
+                dest = pd(h, cands) if pd is not None \
+                    else _place_decode(h, cands)
+                if dest is None:
+                    self._handoff["failed"] += 1
+                    self.failed[rid] = (
+                        "no admitting decode-capable replica can "
+                        "adopt the handed-off KV chain")
+                    self.events_log.append(
+                        {"t": round(h.t_ready, 6),
+                         "event": "handoff_failed", "rid": rid})
+                    if self._tracer is not None:
+                        self._tracer.instant("handoff_failed",
+                                             t=h.t_ready,
+                                             track="cluster", rid=rid)
+                    continue
+                h.t_arrive = h.t_ready \
+                    + self.kv_transfer_unit * h.n_pages
+                dest.session.submit_handoff(h)
+                led["replica"] = dest.name
+                led["path"].append(dest.name)
+                self.events_log.append(
+                    {"t": round(h.t_ready, 6), "event": "handoff",
+                     "rid": rid, "from": h.replica_from,
+                     "to": dest.name, "pages": h.n_pages,
+                     "arrive": round(h.t_arrive, 6)})
+                if self._tracer is not None:
+                    self._tracer.instant(
+                        "handoff", t=h.t_ready, track="cluster",
+                        rid=rid, pages=h.n_pages, to=dest.name,
+                        **{"from": h.replica_from})
+                self._push(h.t_arrive, 4, ("ht",))
+                self._g_load("cluster_replica_load",
+                             "queued + in-flight requests on a "
+                             "replica", replica=dest.name).set(
+                    float(dest.session.load()))
 
     # --- failure detection + failover -------------------------------------
     def _push(self, t: float, pri: int, item):
@@ -937,6 +1126,7 @@ class ClusterRouter:
         try:
             for i in range(self.n_replicas):
                 self._add_replica(f"r{i}", 0.0)
+            has_roles = any(v != "both" for v in self._roles.values())
             t = 0.0
             while self._heap:
                 t, _, _, item = heapq.heappop(self._heap)
@@ -944,6 +1134,11 @@ class ClusterRouter:
                     rep.session.advance_until(t)
                     if not rep.admitting:
                         self._maybe_retire(rep)
+                if has_roles:
+                    # exports that completed during this advance move
+                    # to decode workers before anything else acts on
+                    # the new time
+                    self._collect_handoffs()
                 if self._faults is not None:
                     self._probe(t)
                 if isinstance(item, FaultEvent):
@@ -955,7 +1150,7 @@ class ClusterRouter:
                     if self._place_or_fail(r2, t) and kept:
                         self._salvage.setdefault(
                             r2.rid, []).extend(kept)
-                elif item[0] != "hb":
+                elif item[0] not in ("hb", "ht"):
                     op, name = item
                     if op == "drain" and self._faults is not None \
                             and self._find(name) is None:
@@ -983,8 +1178,19 @@ class ClusterRouter:
                                          .heartbeat_timeout))
             for rep in list(self.replicas):
                 rep.session.more_expected = False
+            if has_roles:
+                # the disaggregation pipeline drains in stage order:
+                # prefill-role lanes run dry first, their exports land
+                # on decode workers, THEN everyone else finishes (a
+                # decode worker finishing before its last handoffs
+                # were submitted would bank an incomplete stream set)
+                for rep in list(self.replicas):
+                    if rep.session.role == "prefill":
+                        rep.session.finish()
+                self._collect_handoffs()
             for rep in list(self.replicas):
                 self.results[rep.name] = rep.session.finish()
+                self._fold_handoff_stats(rep.session)
                 if rep.session.aborted:
                     # a decode fault fired inside the final backlog
                     # drain, after the last survivor-placement window
@@ -1023,4 +1229,7 @@ class ClusterRouter:
                                       or bool(self.failed)
                                       or any(led.get("retries")
                                              for led in
-                                             self.ledger.values())))
+                                             self.ledger.values())),
+                             handoffs=(dict(self._handoff)
+                                       if self._handoff["exported"]
+                                       else {}))

@@ -78,17 +78,31 @@ class EngineClock:
     def advance_to(self, t: float):
         self.t = max(self.t, t)
 
-    def timed(self, kind: str, fn, units: Optional[int] = None):
+    def timed(self, kind: str, fn, units: Optional[int] = None,
+              cost: Optional[float] = None):
         """``units`` (work items, e.g. prefill chunks computed) prices
         a fixed-clock action per unit WHEN the cost table carries a
         ``<kind>_unit`` entry — the honest clock for prefix caching,
-        where a cache hit skips real work. Without that entry (or
-        units) the flat per-call cost keeps legacy replays
-        bit-identical; a measured clock always charges wall time."""
+        where a cache hit skips real work. ``units=0`` (a call that
+        computes NOTHING — e.g. a fully-cached prefill) is free on the
+        fixed clock even without a per-unit entry: zero work priced at
+        the flat per-call cost would charge for compute that never
+        ran. ``cost`` (fixed clock only) overrides the table outright
+        — the async prefill lane uses it to split a flat per-call
+        prefill cost evenly across a prompt's chunk calls, so running
+        N bounded calls instead of one monolithic call charges the
+        SAME total. Without units/cost the flat per-call cost keeps
+        legacy replays bit-identical; a measured clock always charges
+        wall time."""
         if self.mode == "fixed":
             out = fn()
-            if units is not None and f"{kind}_unit" in self.costs:
-                self.t += float(self.costs[f"{kind}_unit"]) * units
+            if cost is not None:
+                self.t += float(cost)
+            elif units is not None and (units == 0
+                                        or f"{kind}_unit"
+                                        in self.costs):
+                self.t += float(self.costs.get(f"{kind}_unit", 0.0)) \
+                    * units
             else:
                 self.t += float(self.costs.get(kind, 1.0))
             return out
@@ -312,6 +326,74 @@ class _PagedRow:
         self.done = False
 
 
+class _PrefillingRow:
+    """One request in the ASYNC PREFILL LANE: admitted (pages + slot
+    reserved, ``book.lengths`` set) but not yet decoding — its prefill
+    runs one chunk per lane step, between the engine's decode turns,
+    so pending prefill can never monopolize a turn. ``next_chunk`` is
+    the absolute chunk index the next lane step computes (the cached
+    resume already skipped); when it reaches ``n_chunks`` the request
+    enters its decode slot (or exports as a KV handoff on a
+    prefill-role session)."""
+
+    __slots__ = ("req", "slot", "t_admit", "n_cached", "resume", "T",
+                 "next_chunk", "n_chunks", "run_chunks", "toks", "pt",
+                 "skipped")
+
+    def __init__(self, req: Request, slot: int, t_admit: float,
+                 n_cached: int, resume: int, T: int, chunk: int,
+                 toks, pt):
+        self.req = req
+        self.slot = slot
+        self.t_admit = t_admit
+        self.n_cached = n_cached
+        self.resume = resume          # chunk-aligned cached skip
+        self.T = T                    # padded prompt length
+        self.next_chunk = min(resume, T - chunk) // chunk
+        self.n_chunks = T // chunk
+        # chunks this request actually computes (cache skip excluded)
+        # — the denominator for flat-cost-per-chunk pricing
+        self.run_chunks = self.n_chunks - self.next_chunk
+        self.toks = toks              # (1, T) padded prompt tokens
+        self.pt = pt                  # (1, W) page table row
+        self.skipped = 0              # times passed over by a shorter
+        # entry — the anti-starvation aging counter
+
+    def remaining_chunks(self) -> int:
+        return self.n_chunks - self.next_chunk
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """A finished prefill MOVING from a prefill-role worker to a
+    decode worker: the prompt's KV page chain (exported from the
+    source pool along the page axis), the greedy first token the
+    prefill produced, and the timestamps the destination's metrics
+    record needs to stay honest (``t_admit`` — the admission that
+    actually happened, on the source; ``t_first`` — when the first
+    token materialized; ``t_ready`` — when the chain left the source,
+    the moment the per-page transfer cost starts ticking). The
+    request's metrics record and trace root move WITH the handoff
+    (PR-7 move-not-duplicate discipline): the source forgets it, the
+    destination re-records it, and the cluster census counts it
+    exactly once. ``t_arrive`` is stamped by the router:
+    ``t_ready + n_pages * kv_transfer_unit`` on the shared timeline."""
+
+    req: Request
+    first_tok: int
+    n_pages: int                      # exported chain length (pages)
+    kv_data: object                   # opaque per-factory page data
+    n_cached: int                     # source-side prefix-cache hit
+    t_admit: float
+    t_first: float
+    t_ready: float
+    replica_from: Optional[str] = None
+    t_arrive: float = 0.0             # router-stamped delivery time
+    page_size: int = 0                # source page geometry — an
+    # importer with a different page size cannot adopt this chain
+    # (the exported data is page-shaped), so placement filters on it
+
+
 class ServingEngine:
     """Replay a trace (workload.Request list) through the serving stack.
 
@@ -344,6 +426,17 @@ class ServingEngine:
     disables all acquisition/retention (the bench's cache-off arm).
     """
 
+    # async-lane anti-starvation: the oldest lane entry runs its next
+    # chunk after being passed over this many consecutive times by
+    # shorter entries, so a long prefill's first token is bounded by
+    # ~run_chunks * (limit+1) lane chunks REGARDLESS of how long a
+    # sustained short-prompt stream lasts (pure
+    # shortest-remaining-first would starve it for the stream's whole
+    # lifetime, pinning its slot and pages). The default trades a
+    # loose bound for zero short-prompt TTFT tax on the gated
+    # prefill-heavy trace; subclasses may tighten it.
+    _LANE_STARVE_LIMIT = 11
+
     def __init__(self, model=None, *, serving=None, slots: int = 4,
                  max_len: int = 64, page_size: int = 8,
                  n_pool_pages: Optional[int] = None, policy="routed",
@@ -355,7 +448,8 @@ class ServingEngine:
                  scan_layers: bool = True,
                  expect_churn: Optional[bool] = None,
                  scheduler=None, trace=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 prefill_chunk_budget: Optional[int] = None):
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -435,6 +529,29 @@ class ServingEngine:
             "serving_prefix_resident_pages",
             "pool pages held by live sequences")
         self.prefix_cache = bool(prefix_cache)
+        # --- async prefill lane (the disaggregation seam) -----------
+        # None: legacy interleaved loop — a wave's whole prefill runs
+        # at admission, byte-identical to every earlier PR. An int
+        # >= 1: admitted requests park in the PREFILL LANE and each
+        # engine turn runs the fixed-shape decode batch FIRST, then at
+        # most this many prefill chunks — TPOT becomes independent of
+        # how much prefill is queued (the DistServe/Splitwise split,
+        # in-engine). Requests enter decode slots only when their
+        # prefill completes; page/slot accounting and greedy tokens
+        # are unchanged (each chunk computes exactly what the
+        # monolithic prefill computed for those positions).
+        if prefill_chunk_budget is not None and prefill_chunk_budget < 1:
+            raise ValueError("prefill_chunk_budget must be >= 1 chunks "
+                             "per turn (or None for the interleaved "
+                             "legacy loop)")
+        self.prefill_chunk_budget = prefill_chunk_budget
+        self._g_lane_depth = None
+        if prefill_chunk_budget is not None:
+            # created ONLY when the lane exists, so pre-disagg runs
+            # leave no trace of it in the registry (PR-5 convention)
+            self._g_lane_depth = obs_metrics.REGISTRY.gauge(
+                "serving_prefill_lane_depth",
+                "requests parked in the async prefill lane")
         self.decode_chunk = decode_chunk
         self.clock_mode = clock
         self.fixed_costs = fixed_costs
@@ -519,7 +636,7 @@ class ServingEngine:
                           if k != "t"})
 
     def _timed(self, tr, clock, kind, fn, jitfn=None, rid=None,
-               units=None, **attrs):
+               units=None, cost=None, **attrs):
         """``clock.timed`` plus, when tracing, a span in virtual time
         (wall seconds as an attr) and jit-recompile detection: the
         wrapped program cache growing across the call means THIS call
@@ -531,9 +648,9 @@ class ServingEngine:
             # the registry kill-switch is down (the no-obs arm);
             # detection is two cache-size reads around the call
             if jitfn is None or not obs_metrics.REGISTRY.enabled:
-                return clock.timed(kind, fn, units)
+                return clock.timed(kind, fn, units, cost)
             c0 = _jit_cache_size(jitfn)
-            out = clock.timed(kind, fn, units)
+            out = clock.timed(kind, fn, units, cost)
             if c0 is not None:
                 c1 = _jit_cache_size(jitfn)
                 if c1 is not None and c1 > c0:
@@ -545,9 +662,9 @@ class ServingEngine:
         scope = obs_trace.trace_scope(rid) if rid is not None else None
         if scope is not None:
             with scope:
-                out = clock.timed(kind, fn, units)
+                out = clock.timed(kind, fn, units, cost)
         else:
-            out = clock.timed(kind, fn, units)
+            out = clock.timed(kind, fn, units, cost)
         wall = time.perf_counter() - w0
         if rid is not None:
             attrs["rid"] = rid
@@ -635,6 +752,8 @@ class ServingEngine:
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         waiting: List[Request] = []
         active: Dict[str, _PagedRow] = {}
+        lane = deque() if self.prefill_chunk_budget is not None \
+            else None
         free_slots = list(range(self.slots))
         outputs: Dict[str, List[int]] = {}
         decisions: List[dict] = []
@@ -652,7 +771,7 @@ class ServingEngine:
         if tr is not None:
             obs_trace.activate(tr)
         try:
-            while pending or waiting or active:
+            while pending or waiting or active or lane:
                 now = clock.now()
                 while pending and pending[0].arrival <= now + 1e-12:
                     r = pending.popleft()
@@ -679,7 +798,8 @@ class ServingEngine:
                     shared = (len(groups) != len(set(groups))
                               or any(g in seen_groups for g in groups))
                     ctx = dict(ctx_base, shared_prefix=shared,
-                               active_paged=len(active))
+                               active_paged=len(active)
+                               + (len(lane) if lane else 0))
                     backend, reason = self.policy.route(wave, ctx)
                     decision = {
                         "t": round(clock.now(), 6), "wave": len(wave),
@@ -701,7 +821,7 @@ class ServingEngine:
                         n_adm, _, ptoks = self._admit_paged(
                             wave, book, clock, m, active, free_slots,
                             slot_log, prefix_cached, seen_groups,
-                            outputs, tr=tr)
+                            outputs, tr=tr, lane=lane)
                         prefill_tokens += ptoks
                         for r in wave[:n_adm]:  # possibly reordered —
                             waiting.remove(r)   # remove by identity
@@ -719,7 +839,7 @@ class ServingEngine:
                                 [r.rid for r in wave[:n_adm]]
                             decisions.append(decision)
                             self._wave_instant(tr, decision)
-                        elif not active:
+                        elif not active and not lane:
                             raise RuntimeError(
                                 f"pool/slot config too small for "
                                 f"{wave[0].rid} (free pages "
@@ -729,6 +849,18 @@ class ServingEngine:
                 if active:
                     self._paged_chunk(book, clock, m, active, free_slots,
                                       slot_log, outputs, tr=tr)
+                    progressed = True
+
+                if lane:
+                    # the async lane: decode ran FIRST — pending
+                    # prefill gets at most prefill_chunk_budget chunks
+                    # of this turn, so TPOT is independent of how much
+                    # prefill is queued
+                    _, ptoks = self._lane_step(
+                        lane, book, clock, m, active, free_slots,
+                        slot_log, outputs, prefix_cached, seen_groups,
+                        tr=tr)
+                    prefill_tokens += ptoks
                     progressed = True
 
                 if not progressed and not active:
@@ -802,6 +934,8 @@ class ServingEngine:
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         active: Dict[str, _PagedRow] = {}
+        lane = deque() if self.prefill_chunk_budget is not None \
+            else None
         free_slots = list(range(self.slots))
         outputs: Dict[str, List[int]] = {}
         decisions: List[dict] = []
@@ -833,7 +967,7 @@ class ServingEngine:
         if tr is not None:
             obs_trace.activate(tr)
         try:
-            while pending or sched.waiting() or active:
+            while pending or sched.waiting() or active or lane:
                 now = clock.now()
                 while pending and pending[0].arrival <= now + 1e-12:
                     r = pending.popleft()
@@ -856,7 +990,11 @@ class ServingEngine:
                                        decode_chunk=self.decode_chunk,
                                        match_prefix=(book.match_prefix
                                                      if self.prefix_cache
-                                                     else None))
+                                                     else None),
+                                       backlog_cost=(
+                                           self._lane_backlog_cost(
+                                               lane, est)
+                                           if lane else 0.0))
                     progressed |= _shed(dec.shed)
                     # the scheduler's priority/WFQ order is kept as-is:
                     # its feasibility estimates assumed it, and a cache
@@ -870,7 +1008,8 @@ class ServingEngine:
                                   or any(g in seen_groups
                                          for g in groups))
                         ctx = dict(ctx_base, shared_prefix=shared,
-                                   active_paged=len(active))
+                                   active_paged=len(active)
+                                   + (len(lane) if lane else 0))
                         backend, reason = self.policy.route(wave, ctx)
                         decision = {
                             "t": round(clock.now(), 6), "wave": len(wave),
@@ -891,7 +1030,7 @@ class ServingEngine:
                             n_adm, n_chunks, ptoks = self._admit_paged(
                                 wave, book, clock, m, active, free_slots,
                                 slot_log, prefix_cached, seen_groups,
-                                outputs, tr=tr)
+                                outputs, tr=tr, lane=lane)
                             prefill_tokens += ptoks
                             if n_adm:
                                 dt = clock.now() - t0
@@ -907,7 +1046,7 @@ class ServingEngine:
                                 decisions.append(decision)
                                 self._wave_instant(tr, decision)
                                 progressed = True
-                            elif not active:
+                            elif not active and not lane:
                                 raise RuntimeError(
                                     f"pool/slot config too small for "
                                     f"{wave[0].rid} (free pages "
@@ -927,6 +1066,17 @@ class ServingEngine:
                                                active, free_slots,
                                                slot_log, outputs,
                                                timeout=True, tr=tr)
+                    progressed = True
+
+                if lane:
+                    _, ptoks = self._lane_step(
+                        lane, book, clock, m, active, free_slots,
+                        slot_log, outputs, prefix_cached, seen_groups,
+                        tr=tr)
+                    prefill_tokens += ptoks
+                    self._lane_timeouts(lane, book, clock, m,
+                                        free_slots, slot_log, outputs,
+                                        tr=tr)
                     progressed = True
 
                 if not progressed and not active:
@@ -987,9 +1137,15 @@ class ServingEngine:
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
                      slot_log, prefix_cached, seen_groups, outputs,
-                     tr=None):
+                     tr=None, lane=None, sink=None):
         """Returns (admitted, prefill chunks computed, prefill tokens
-        computed) for this wave."""
+        computed) for this wave. With ``lane`` (the async prefill
+        lane), admission only RESERVES — pages, slot, bookkeeping —
+        and parks the request in the lane; its chunks run later under
+        ``_lane_step``'s per-turn budget, so this wave's prefill never
+        stalls the decode batch (chunk counts are then accounted by
+        the lane steps, not here). ``sink`` is the prefill-role
+        handoff interceptor (see ``_prefill_complete``)."""
         admitted = 0
         chunks_done = 0
         tokens_done = 0
@@ -1047,6 +1203,12 @@ class ServingEngine:
                 tr.instant("admit", t=t_admit,
                            track=self._tenant_track(r), rid=sid,
                            backend="paged", slot=slot, cached=n_cached)
+            if lane is not None:
+                lane.append(_PrefillingRow(r, slot, t_admit, n_cached,
+                                           resume, T, self.chunk_C,
+                                           toks, pt))
+                admitted += 1
+                continue
 
             def _call(toks=toks, pt=pt, lens=lens, resume=resume):
                 arr = self._arr
@@ -1059,35 +1221,222 @@ class ServingEngine:
                 rid=sid, units=n_chunks, resume=resume,
                 cached=n_cached)
             first_tok = int(np.asarray(first)[0])
-            if self.prefix_cache:
-                book.register_prefix(sid, list(r.prompt))
-            if r.prefix_group is not None:
-                seen_groups.add(r.prefix_group)
-            if n_cached:
-                self._ctr_prefix_hits.inc(n_cached)
-            m.on_prefix(sid, cached=n_cached,
-                        saved=min(resume, T - self.chunk_C),
-                        prompt=len(r.prompt))
             chunks_done += n_chunks
             tokens_done += n_chunks * self.chunk_C
-            row = _PagedRow(r, slot, first_tok, t0=t_admit)
-            active[sid] = row
-            slot_log.append((round(clock.now(), 6), "acquire", sid, slot))
-            prefix_cached[sid] = n_cached
-            t_first = clock.now()
-            m.on_tokens(sid, t_first, 1)
-            self._ctr_tokens.inc()
-            if tr is not None:
-                tr.instant("first_token", t=t_first,
-                           track=self._tenant_track(r), rid=sid)
-            admitted += 1
-            if len(row.out) >= row.eff or first_tok == self.eos_token_id:
-                self._finish_paged(sid, book, clock, m, active,
+            self._prefill_complete(r, slot, first_tok, n_cached,
+                                   resume, T, book, clock, m, active,
                                    free_slots, slot_log, outputs,
-                                   tr=tr)
+                                   prefix_cached, seen_groups, tr=tr,
+                                   t0=t_admit, t_admit=t_admit,
+                                   sink=sink)
+            admitted += 1
         if admitted:
             self._g_resident.set(float(len(book._refs)))
         return admitted, chunks_done, tokens_done
+
+    def _prefill_complete(self, r, slot, first_tok, n_cached, resume,
+                          T, book, clock, m, active, free_slots,
+                          slot_log, outputs, prefix_cached,
+                          seen_groups, tr, t0, t_admit, sink=None):
+        """Everything that happens the moment a request's prompt pages
+        hold real K/V: publish them for prefix sharing, account the
+        cache hit, then either enter the decode slot (the default),
+        finish outright (eos / a 1-token budget at the first token),
+        or — when ``sink`` (a prefill-role session's handoff exporter)
+        takes the row — hand the KV chain off instead of decoding.
+        ``t0`` is the slot-occupancy span start: the admit time in the
+        interleaved loop (whose slot span covers the prefill), the
+        decode-entry time under the async lane (whose ``prefill_lane``
+        span covers admit→here instead)."""
+        sid = r.rid
+        if self.prefix_cache:
+            book.register_prefix(sid, list(r.prompt))
+        if r.prefix_group is not None:
+            seen_groups.add(r.prefix_group)
+        if n_cached:
+            self._ctr_prefix_hits.inc(n_cached)
+        m.on_prefix(sid, cached=n_cached,
+                    saved=min(resume, T - self.chunk_C),
+                    prompt=len(r.prompt))
+        prefix_cached[sid] = n_cached
+        row = _PagedRow(r, slot, first_tok, t0=t0)
+        done = len(row.out) >= row.eff \
+            or first_tok == self.eos_token_id
+        # a request DONE at its first token never hands off — the
+        # stream is complete where it stands, there is no decode
+        # phase to move
+        if sink is not None and not done \
+                and sink(r, slot, first_tok, n_cached, t_admit):
+            return None
+        active[sid] = row
+        slot_log.append((round(clock.now(), 6), "acquire", sid, slot))
+        t_first = clock.now()
+        m.on_tokens(sid, t_first, 1)
+        self._ctr_tokens.inc()
+        if tr is not None:
+            tr.instant("first_token", t=t_first,
+                       track=self._tenant_track(r), rid=sid)
+        if done:
+            self._finish_paged(sid, book, clock, m, active,
+                               free_slots, slot_log, outputs, tr=tr)
+        return row
+
+    def _lane_step(self, lane, book, clock, m, active, free_slots,
+                   slot_log, outputs, prefix_cached, seen_groups,
+                   tr=None, sink=None):
+        """Run up to ``prefill_chunk_budget`` prefill chunks from the
+        lane, SHORTEST-REMAINING-FIRST (admission order breaking
+        ties): a one-chunk prompt reaches its first token in one lane
+        turn instead of queueing behind a long prompt's whole chunk
+        walk — head-of-line blocking is exactly the TTFT tax the lane
+        exists to remove. Starvation is BOUNDED by aging: an entry
+        passed over ``_LANE_STARVE_LIMIT`` consecutive times runs its
+        next chunk regardless, so a long prefill drains at >= 1 chunk
+        per (limit+1) chunks even under a sustained stream of short
+        arrivals. Each chunk is ONE bounded call into the
+        chunked-prefill program — the prompt sliced to the chunk
+        boundary with ``lengths`` clamped to it — which computes
+        exactly what the monolithic prefill computes for those
+        positions (causal attention never looks past the chunk, so
+        greedy tokens are bit-equal); a request's own chunks still
+        run in order, and its final chunk passes the true length and
+        yields the real first-token logits. Fixed-clock pricing: with
+        a ``prefill_unit`` entry each chunk costs one unit; with only
+        a flat per-call cost, that cost is split EVENLY across the
+        request's chunk calls, so the lane charges the same total the
+        monolithic call would (an N-chunk prompt must not become N
+        times pricier just because the lane bounds its calls).
+        Returns (chunks computed, prompt tokens computed)."""
+        C = self.chunk_C
+        chunks_run = 0
+        tokens_run = 0
+        flat = self.clock_mode == "fixed" \
+            and "prefill_unit" not in (self.fixed_costs or {})
+        while lane and chunks_run < self.prefill_chunk_budget:
+            oldest = min(lane, key=lambda x: (x.t_admit, x.req.rid))
+            if oldest.skipped >= self._LANE_STARVE_LIMIT:
+                e = oldest
+            else:
+                e = min(lane, key=lambda x: (x.remaining_chunks(),
+                                             x.t_admit, x.req.rid))
+            if e is oldest:
+                oldest.skipped = 0
+            else:
+                oldest.skipped += 1
+            sid = e.req.rid
+            k = e.next_chunk
+            final = (k + 1 == e.n_chunks)
+            toks = e.toks[:, :(k + 1) * C]
+            lens = np.asarray(
+                [len(e.req.prompt) if final else (k + 1) * C],
+                np.int32)
+
+            def _call(toks=toks, pt=e.pt, lens=lens, resume=k * C):
+                arr = self._arr
+                return self._p_prefill(
+                    self._p_outer, self._p_layers, arr(toks),
+                    arr(pt), arr(lens), self._pools,
+                    resume_from=resume)
+            first, self._pools = self._timed(
+                tr, clock, "prefill", _call, jitfn=self._p_prefill,
+                rid=sid, units=1, chunk=k, of=e.n_chunks,
+                cost=((self.fixed_costs or {}).get("prefill", 1.0)
+                      / e.run_chunks if flat else None))
+            e.next_chunk += 1
+            chunks_run += 1
+            tokens_run += C
+            if not final:
+                continue
+            lane.remove(e)
+            t_done = clock.now()
+            if tr is not None:
+                tr.add_span(sid, e.t_admit, t_done - e.t_admit,
+                            track="prefill_lane", cached=e.n_cached)
+            self._prefill_complete(
+                e.req, e.slot, int(np.asarray(first)[0]), e.n_cached,
+                e.resume, e.T, book, clock, m, active, free_slots,
+                slot_log, outputs, prefix_cached, seen_groups, tr=tr,
+                t0=t_done, t_admit=e.t_admit, sink=sink)
+        if self._g_lane_depth is not None:
+            self._g_lane_depth.set(float(len(lane)))
+        if tr is not None:
+            tr.counter("prefill_lane_depth", len(lane), t=clock.now())
+        return chunks_run, tokens_run
+
+    def _lane_timeouts(self, lane, book, clock, m, free_slots,
+                       slot_log, outputs, tr=None):
+        """A lane entry whose deadline passes MID-PREFILL is evicted
+        exactly like a running row past deadline (reason "timeout",
+        pages and slot freed) — a state the interleaved loop cannot
+        reach (its prefill is atomic at admission), so only the
+        QoS-scheduled async lane scans for it. The stream is empty:
+        no token was ever produced."""
+        t = clock.now()
+        for e in list(lane):
+            dl = e.req.deadline_time()
+            if dl is None or t <= dl + 1e-9:
+                continue
+            lane.remove(e)
+            sid = e.req.rid
+            book.free(sid)
+            self._g_resident.set(float(len(book._refs)))
+            free_slots.append(e.slot)
+            free_slots.sort()
+            slot_log.append((round(t, 6), "release", sid, e.slot))
+            outputs[sid] = []
+            m.on_finish(sid, t, evicted=True, reason="timeout")
+            self._ctr_finished["timeout"].inc()
+            if tr is not None:
+                tr.add_span(sid, e.t_admit, t - e.t_admit,
+                            track="prefill_lane", timeout=True)
+            self._req_close(tr, e.req, t, "timeout", 0)
+
+    @staticmethod
+    def _lane_backlog_cost(lane, est) -> float:
+        """The admission-feasibility price of the prefill work already
+        COMMITTED to the lane: a new candidate's service cannot start
+        before the lane drains. Per-chunk priced when the estimator
+        carries a unit cost; under flat per-call pricing each entry's
+        remaining cost is its flat cost pro-rated by the chunks still
+        to run — exactly what ``_lane_step`` will charge the clock, so
+        feasibility verdicts agree with the clock they model."""
+        if not lane:
+            return 0.0
+        unit = est.costs.get("prefill_unit")
+        if unit is not None:
+            return float(unit) * sum(e.remaining_chunks()
+                                     for e in lane)
+        return est.prefill * sum(e.remaining_chunks() / e.run_chunks
+                                 for e in lane)
+
+    # --- KV page export/import (the cluster handoff's data plane) ---------
+    def export_kv_pages(self, page_ids):
+        """Gather the pool content of ``page_ids`` for a KV handoff.
+        A factory may provide its own ``export_kv_pages(pools, ids)``
+        (``serving.sim`` does — numpy pools); the default handles the
+        real llama factory's pools, whose every leaf is page-indexed
+        on axis 2 ((L, Hkv, P, page_size, ...) arrays — int8
+        data+scale tuples included)."""
+        fn = getattr(self.serving, "export_kv_pages", None)
+        ids = list(page_ids)
+        if fn is not None:
+            return fn(self._pools, ids)
+        idx = jnp.asarray(ids, jnp.int32)
+        return jax.tree_util.tree_map(lambda a: a[:, :, idx],
+                                      self._pools)
+
+    def import_kv_pages(self, page_ids, data):
+        """Scatter a handoff's exported page content into THIS
+        engine's pool at ``page_ids`` (the importer's freshly
+        allocated chain). Counterpart of ``export_kv_pages``."""
+        fn = getattr(self.serving, "import_kv_pages", None)
+        ids = list(page_ids)
+        if fn is not None:
+            self._pools = fn(self._pools, ids, data)
+            return
+        idx = jnp.asarray(ids, jnp.int32)
+        self._pools = jax.tree_util.tree_map(
+            lambda a, d: a.at[:, :, idx].set(d), self._pools, data)
 
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
                      outputs, tr=None):
@@ -1163,13 +1512,17 @@ class ServingEngine:
         self._req_close(tr, r, t_fin, outcome, len(st.out))
 
     def session(self, *, tracer=None, replica: Optional[str] = None,
-                expect_churn: bool = False) -> "EngineSession":
+                expect_churn: bool = False,
+                role: str = "both") -> "EngineSession":
         """An incremental session over this engine's configuration —
-        the cluster router's entry point (see ``EngineSession``). The
-        engine object itself is untouched; ``run()`` keeps replaying
-        traces byte-identically."""
+        the cluster router's entry point (see ``EngineSession``).
+        ``role`` is the disaggregation stage this session serves
+        ("prefill" exports finished prefills as KV handoffs, "decode"
+        adopts them, "both" is the classic replica). The engine object
+        itself is untouched; ``run()`` keeps replaying traces
+        byte-identically."""
         return EngineSession(self, tracer=tracer, replica=replica,
-                             expect_churn=expect_churn)
+                             expect_churn=expect_churn, role=role)
 
     # --- dense backend ----------------------------------------------------
     def _run_dense_wave(self, wave, clock, m, outputs,
@@ -1321,9 +1674,25 @@ class EngineSession:
 
     def __init__(self, engine: ServingEngine, *, tracer=None,
                  replica: Optional[str] = None,
-                 expect_churn: bool = False):
+                 expect_churn: bool = False, role: str = "both"):
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"role {role!r}: use 'prefill', 'decode' "
+                             "or 'both'")
         eng = self.eng = engine
         self.replica = replica
+        # --- disaggregation (all inert at role="both") --------------
+        # "prefill": every finished prefill EXPORTS its KV chain as a
+        # KVHandoff (banked in handoff_ready for the router) instead
+        # of entering a decode slot. "decode": this session never
+        # receives admissions from a disaggregated placement policy —
+        # it adopts handoffs through submit_handoff/import_queue and
+        # only decodes. "both" is the classic replica.
+        self.role = role
+        self.lane = deque() if eng.prefill_chunk_budget is not None \
+            else None
+        self.handoff_ready: List[KVHandoff] = []
+        self.import_queue: List[KVHandoff] = []
+        self.handoff_stats = {"imported": 0, "reclaimed": 0}
         self.clock = EngineClock(eng.clock_mode, eng.fixed_costs)
         self.tr = tracer
         self.m = MetricsCollector()
@@ -1392,8 +1761,36 @@ class EngineSession:
 
     def load(self) -> int:
         """The live load signal placement policies read: queued +
-        in-flight requests on this replica."""
-        return self.queued() + len(self.active)
+        in-flight requests on this replica (prefilling lane rows and
+        accepted-but-not-imported handoffs included — both are work
+        this replica owes)."""
+        return self.queued() + self.in_flight()
+
+    def in_flight(self) -> int:
+        """Rows this session still owes work for: decoding rows,
+        prefilling lane rows, and handoffs accepted but not yet
+        imported."""
+        return len(self.active) + len(self.lane or ()) \
+            + len(self.import_queue)
+
+    def free_slot_count(self) -> int:
+        """Open decode slots right now — the signal the disaggregated
+        placement's decode stage places handoffs by."""
+        return len(self.free_slots)
+
+    def prefill_backlog(self) -> int:
+        """Pending prefill CHUNKS on this replica: the lane's
+        remaining chunks plus every queued (not yet admitted) prompt's
+        padded chunk count — what the disaggregated placement policy
+        prices the prefill stage with (multiply by the estimator's
+        ``prefill_unit`` for clock units)."""
+        C = self.eng.chunk_C
+        n = sum(e.remaining_chunks() for e in self.lane or ())
+        reqs = self.sched.queued_requests() if self.sched is not None \
+            else self.waiting
+        for r in reqs:
+            n += self.eng._pad_len(len(r.prompt)) // C
+        return n
 
     def match_prefix(self, prompt) -> int:
         """Non-acquiring probe of THIS replica's paged pool: leading
@@ -1449,6 +1846,18 @@ class EngineSession:
         for r in reqs:
             self.m.forget(r.rid)
             self.eng._req_close(self.tr, r, t, outcome, 0)
+        # accepted-but-not-imported handoffs leave with the queue:
+        # their exported KV is RECLAIMED (dropped — wherever the
+        # request lands next re-prefills) and the request re-places;
+        # it has no metrics record or open trace root HERE (the source
+        # closed its root at export, the importer would have re-opened
+        # one), so there is nothing to forget or close
+        if self.import_queue:
+            self.handoff_stats["reclaimed"] += len(self.import_queue)
+            imports = [h.req for h in self.import_queue]
+            self.import_queue = []
+            reqs = sorted(reqs + imports,
+                          key=lambda r: (r.arrival, r.rid))
         return reqs
 
     # --- fault teardown ----------------------------------------------------
@@ -1500,8 +1909,169 @@ class EngineSession:
                           key=lambda r: (self.active[r].t0, r)):
             self.crash_salvage.append(
                 self.abort_row(rid, reason="replica_crash"))
+        # prefilling lane rows die with the pool: no token was ever
+        # emitted, so their salvage is an empty stream (admit order —
+        # deterministic failover, after the decoding rows)
+        for e in list(self.lane or ()):
+            self.lane.remove(e)
+            self.crash_salvage.append(
+                self._abort_lane_entry(e, reason="replica_crash"))
+        # accepted-but-not-imported handoffs: the exported KV dies
+        # here unlanded (reclaimed); the REQUEST fails over and
+        # re-prefills on a survivor — accounted, never lost
+        if self.import_queue:
+            self.handoff_stats["reclaimed"] += len(self.import_queue)
+            for h in self.import_queue:
+                self.crash_salvage.append((h.req, []))
+            self.import_queue = []
         self.book.purge()
         self.inv_ok &= self.book.census_ok()
+
+    def _abort_lane_entry(self, e: _PrefillingRow, reason: str) \
+            -> Tuple[Request, List[int]]:
+        """Tear down ONE prefilling lane row (the lane twin of
+        ``abort_row``): pages freed, slot released ("abort" slot
+        event), metrics record forgotten, trace root closed with
+        outcome "failover" — the request is moving, not finishing.
+        Salvage is always the empty stream: no token existed yet."""
+        sid = e.req.rid
+        self.book.free(sid)
+        eng = self.eng
+        eng._g_resident.set(float(len(self.book._refs)))
+        self.free_slots.append(e.slot)
+        self.free_slots.sort()
+        t = self.clock.now()
+        self.slot_log.append((round(t, 6), "abort", sid, e.slot))
+        obs_metrics.REGISTRY.counter(
+            "serving_rows_aborted_total",
+            "in-flight rows torn down by crash/decode faults",
+            reason=reason).inc()
+        if self.tr is not None:
+            self.tr.add_span(sid, e.t_admit, t - e.t_admit,
+                             track="prefill_lane", aborted=reason)
+        eng._req_close(self.tr, e.req, t, "failover", 0, reason=reason)
+        self.m.forget(sid)
+        self.inv_ok &= self.book.census_ok()
+        return e.req, []
+
+    # --- KV handoff (the disaggregated prefill->decode seam) --------------
+    def _handoff_sink(self, r: Request, slot: int, first_tok: int,
+                      n_cached: int, t_admit: float) -> bool:
+        """The prefill-role completion path: export the prompt's page
+        chain, free the row's pages and slot (the KV MOVED — the
+        registered prefix pages stay retained in this pool's evictable
+        LRU, so later sharers still skip their prefill here), move the
+        metrics record and trace root out (forgotten here, re-recorded
+        by the importer — the cluster counts the request exactly
+        once), and bank the handoff for the router."""
+        eng = self.eng
+        book = self.book
+        sid = r.rid
+        t = self.clock.now()
+        ids = book.export_chain(sid, len(r.prompt))
+        n_exp = len(ids)
+        data = eng.export_kv_pages(ids)
+        self.handoff_ready.append(KVHandoff(
+            req=r, first_tok=int(first_tok), n_pages=n_exp,
+            kv_data=data, n_cached=n_cached, t_admit=t_admit,
+            t_first=t, t_ready=t, replica_from=self.replica,
+            page_size=eng.page_size))
+        book.free(sid)
+        eng._g_resident.set(float(len(book._refs)))
+        self.free_slots.append(slot)
+        self.free_slots.sort()
+        self.slot_log.append((round(t, 6), "handoff", sid, slot))
+        obs_metrics.REGISTRY.counter(
+            "serving_kv_handoffs_total",
+            "KV chains moved between prefill and decode workers",
+            direction="export").inc()
+        if self.tr is not None:
+            self.tr.instant("handoff_export", t=t, track="engine",
+                            rid=sid, pages=n_exp)
+        eng._req_close(self.tr, r, t, "handoff", 0)
+        self.m.forget(sid)
+        self.inv_ok &= book.census_ok()
+        return True
+
+    def submit_handoff(self, h: KVHandoff):
+        """Router-facing: queue an exported KV chain for adoption.
+        The import runs inside ``_turn`` once this lane's clock
+        reaches ``h.t_arrive`` (the router stamps it with the
+        per-page transfer cost on the shared timeline) and a decode
+        slot is free."""
+        self.import_queue.append(h)
+
+    def _import_handoffs(self) -> bool:
+        """Adopt every deliverable handoff: allocate a fresh chain,
+        scatter the exported page content into it, re-record the
+        request (its real arrival, the admission that happened on the
+        source, the first token at its source timestamp — the client
+        already has it) and enter a decode slot. A handoff blocked on
+        pages retries next turn as rows finish; blocked with nothing
+        else running is a sizing error and refuses loudly."""
+        eng = self.eng
+        book = self.book
+        clock, m, tr = self.clock, self.m, self.tr
+        got = False
+        while self.import_queue and self.free_slots:
+            # deliverable = transfer complete by now. Submission order
+            # is NOT delivery order (t_arrive scales with each chain's
+            # page count), so scan the whole queue — gating on the
+            # head alone would park a delivered chain behind a slower
+            # transfer forever
+            ready = [h for h in self.import_queue
+                     if clock.now() >= h.t_arrive - 1e-12]
+            if not ready:
+                break
+            h = min(ready, key=lambda x: (x.t_arrive, x.req.rid))
+            r = h.req
+            sid = r.rid
+            try:
+                book.allocate(sid, eng._footprint(r))
+            except MemoryError:
+                if not self.active and not (self.lane or ()) \
+                        and not self.queued():
+                    raise RuntimeError(
+                        f"pool too small to import handoff {sid!r} "
+                        f"(free pages {len(book._free)}, needs "
+                        f"{eng._footprint(r)} tokens)")
+                break
+            self.import_queue.remove(h)
+            book.lengths[sid] = len(r.prompt)
+            eng.import_kv_pages(book.tables[sid][:h.n_pages],
+                                h.kv_data)
+            if eng.prefix_cache:
+                # the imported prompt pages hold real K/V: publish
+                # them, so sharers landing on this decode worker hit
+                book.register_prefix(sid, list(r.prompt))
+            slot = self.free_slots.pop(0)
+            t = clock.now()
+            m.on_arrival(sid, r.arrival, tenant=r.tenant,
+                         priority=r.priority,
+                         deadline_ms=r.deadline_ms)
+            eng._req_open(tr, r)
+            m.on_admit(sid, h.t_admit, "paged")
+            obs_metrics.REGISTRY.counter(
+                "serving_kv_handoffs_total",
+                "KV chains moved between prefill and decode workers",
+                direction="import").inc()
+            if tr is not None:
+                tr.instant("handoff_import", t=t, track="engine",
+                           rid=sid, pages=h.n_pages,
+                           source=h.replica_from)
+            row = _PagedRow(r, slot, h.first_tok, t0=t)
+            self.active[sid] = row
+            self.slot_log.append((round(t, 6), "acquire", sid, slot))
+            self.prefix_cached[sid] = 0
+            m.on_tokens(sid, h.t_first, 1)
+            eng._ctr_tokens.inc()
+            if tr is not None:
+                tr.instant("first_token", t=h.t_first,
+                           track=eng._tenant_track(r), rid=sid)
+            self.handoff_stats["imported"] += 1
+            eng._g_resident.set(float(len(book._refs)))
+            got = True
+        return got
 
     # --- the drive loop ----------------------------------------------------
     def _shed(self, pairs) -> bool:
@@ -1536,13 +2106,23 @@ class EngineSession:
 
     def _idle_target(self) -> Optional[float]:
         """When nothing progressed and nothing runs: the time the
-        oldest waiting request's admission window closes (None with an
-        empty queue — only a new arrival can wake this lane)."""
-        if self.queued() == 0:
-            return None
-        oldest = self.sched.oldest_arrival() if self.sched is not None \
-            else self.waiting[0].arrival
-        return oldest + self.eng.admission.max_delay
+        oldest waiting request's admission window closes, or the next
+        queued handoff's delivery time — whichever is sooner (None
+        with neither: only a new arrival can wake this lane)."""
+        targets = []
+        if self.queued():
+            oldest = self.sched.oldest_arrival() \
+                if self.sched is not None else self.waiting[0].arrival
+            targets.append(oldest + self.eng.admission.max_delay)
+        now = self.clock.now()
+        future = [h.t_arrive for h in self.import_queue
+                  if h.t_arrive > now + 1e-12]
+        if future:
+            # already-delivered-but-blocked handoffs define no idle
+            # target: they import the moment a slot/pages free, and
+            # an in-the-past target would spin the advance loop
+            targets.append(min(future))
+        return min(targets) if targets else None
 
     def _turn(self) -> bool:
         """One scheduler turn: admission attempt + decode chunk —
@@ -1555,8 +2135,12 @@ class EngineSession:
         if tr is not None:
             tr.counter("queue_depth", self.queued(), t=now)
         progressed = False
+        if self.import_queue:
+            # adopt deliverable handoffs first, so the imported row
+            # joins this turn's decode batch
+            progressed |= self._import_handoffs()
         if self.sched is not None:
-            progressed = self._shed(self.sched.shed_expired(now))
+            progressed |= self._shed(self.sched.shed_expired(now))
             if self.sched.waiting() and self._ready():
                 progressed |= self._qos_wave(now)
         elif self.waiting and self._ready():
@@ -1597,6 +2181,20 @@ class EngineSession:
                                           self.outputs,
                                           timeout=True, tr=tr)
             progressed = True
+        if self.lane:
+            sink = self._handoff_sink if self.role == "prefill" \
+                else None
+            _, ptoks = eng._lane_step(
+                self.lane, self.book, clock, m, self.active,
+                self.free_slots, self.slot_log, self.outputs,
+                self.prefix_cached, self.seen_groups, tr=tr,
+                sink=sink)
+            self.prefill_tokens += ptoks
+            if self.est is not None:
+                eng._lane_timeouts(self.lane, self.book, clock, m,
+                                   self.free_slots, self.slot_log,
+                                   self.outputs, tr=tr)
+            progressed = True
         self.inv_ok &= self.book.census_ok()
         return progressed
 
@@ -1606,7 +2204,8 @@ class EngineSession:
         shared = (len(groups) != len(set(groups))
                   or any(g in self.seen_groups for g in groups))
         return groups, dict(self._ctx_base, shared_prefix=shared,
-                            active_paged=len(self.active))
+                            active_paged=len(self.active)
+                            + len(self.lane or ()))
 
     def _fifo_wave(self) -> bool:
         eng, clock, tr, m = self.eng, self.clock, self.tr, self.m
@@ -1627,7 +2226,9 @@ class EngineSession:
         n_adm, _, ptoks = eng._admit_paged(
             wave, self.book, clock, m, self.active, self.free_slots,
             self.slot_log, self.prefix_cached, self.seen_groups,
-            self.outputs, tr=tr)
+            self.outputs, tr=tr, lane=self.lane,
+            sink=(self._handoff_sink if self.role == "prefill"
+                  else None))
         self.prefill_tokens += ptoks
         for r in wave[:n_adm]:
             self.waiting.remove(r)  # possibly reordered: by identity
@@ -1636,7 +2237,8 @@ class EngineSession:
             decision["admit_rids"] = [r.rid for r in wave[:n_adm]]
             self.decisions.append(decision)
             eng._wave_instant(tr, decision)
-        elif not self.active:
+        elif not self.active and not self.lane \
+                and not self.import_queue:
             raise RuntimeError(
                 f"pool/slot config too small for {wave[0].rid} (free "
                 f"pages {len(self.book._free)}, free slots "
@@ -1649,7 +2251,9 @@ class EngineSession:
             now, max_batch=eng.admission.max_batch, est=self.est,
             decode_chunk=eng.decode_chunk,
             match_prefix=(self.book.match_prefix if eng.prefix_cache
-                          else None))
+                          else None),
+            backlog_cost=(eng._lane_backlog_cost(self.lane, self.est)
+                          if self.lane else 0.0))
         progressed = self._shed(dec.shed)
         wave = dec.wave
         if not wave:
@@ -1673,7 +2277,9 @@ class EngineSession:
         n_adm, n_chunks, ptoks = eng._admit_paged(
             wave, self.book, clock, m, self.active, self.free_slots,
             self.slot_log, self.prefix_cached, self.seen_groups,
-            self.outputs, tr=tr)
+            self.outputs, tr=tr, lane=self.lane,
+            sink=(self._handoff_sink if self.role == "prefill"
+                  else None))
         self.prefill_tokens += ptoks
         if n_adm:
             dt = clock.now() - t0
@@ -1686,7 +2292,8 @@ class EngineSession:
             self.decisions.append(decision)
             eng._wave_instant(tr, decision)
             return True
-        if not self.active:
+        if not self.active and not self.lane \
+                and not self.import_queue:
             raise RuntimeError(
                 f"pool/slot config too small for {wave[0].rid} (free "
                 f"pages {len(self.book._free)}, free slots "
@@ -1715,13 +2322,14 @@ class EngineSession:
             self.clock.advance_to(self.stall_until)
             self.stall_until = None
         while True:
-            if self.queued() == 0 and not self.active:
+            if self.queued() == 0 and not self.active \
+                    and not self.lane and not self.import_queue:
                 self.clock.advance_to(t)
                 return
             if self.clock.now() >= t - 1e-12:
                 return
             progressed = self._turn()
-            if not progressed and not self.active:
+            if not progressed and not self.active and not self.lane:
                 target = self._idle_target()
                 if target is not None and target <= t:
                     self.clock.advance_to(target)
@@ -1745,9 +2353,11 @@ class EngineSession:
         # a crashed session has nothing left to run (its rows were
         # torn down at crash; its queue is rescued by the router) —
         # its result banks only the work that finished before death
-        while not self.crashed and (self.queued() or self.active):
+        while not self.crashed and (self.queued() or self.active
+                                    or self.lane
+                                    or self.import_queue):
             progressed = self._turn()
-            if not progressed and not self.active:
+            if not progressed and not self.active and not self.lane:
                 target = self._idle_target()
                 if target is None:
                     break  # everything left this turn was shed

@@ -197,6 +197,13 @@ class QoSScheduler:
     def queued_rids(self) -> List[str]:
         return list(self._q)
 
+    def queued_requests(self) -> List[Request]:
+        """Non-destructive view of the queued requests in (arrival,
+        rid) order — the disaggregated placement policy's backlog
+        probe (``drain_queue`` is the destructive twin)."""
+        return sorted((e.req for e in self._q.values()),
+                      key=lambda r: (r.arrival, r.rid))
+
     def _tenant(self, r: Request) -> str:
         return r.tenant if r.tenant is not None else self.default_tenant
 
@@ -246,7 +253,8 @@ class QoSScheduler:
     # --- the admission turn ------------------------------------------------
     def select(self, now: float, *, max_batch: int,
                est: ServiceEstimator, decode_chunk: int = 1,
-               match_prefix=None) -> SchedDecision:
+               match_prefix=None,
+               backlog_cost: float = 0.0) -> SchedDecision:
         """Build the next admission wave.
 
         Order: strict effective priority, then WFQ across tenants
@@ -264,12 +272,21 @@ class QoSScheduler:
         recurring system prompt both admits more easily and delays the
         rest of the wave less. ``None`` keeps the flat legacy pricing
         bit-for-bit.
+
+        ``backlog_cost`` seeds the queued-prefill delay with work
+        ALREADY committed ahead of this wave — the async prefill
+        lane's remaining chunks (``ServingEngine._lane_backlog_cost``)
+        — so feasibility verdicts stay honest when admission and
+        prefill are decoupled. 0.0 (the default) keeps the legacy
+        arithmetic exactly.
         """
         shed: List[Tuple[Request, str]] = []
         degraded: Dict[str, Tuple[int, int]] = {}
         wave: List[Request] = []
         remaining = dict(self._q)
-        queued_cost = 0.0  # prefill units ahead of the next candidate
+        # prefill units ahead of the next candidate (the lane's
+        # committed chunks first, then this wave's admitted prefills)
+        queued_cost = float(backlog_cost)
         while remaining and len(wave) < max_batch:
             top = max(self._eff_priority(e, now)
                       for e in remaining.values())

@@ -178,6 +178,22 @@ class SimServing:
         decode_n._cache_size = lambda: 0
         return decode_n
 
+    # --- KV handoff data plane ---------------------------------------------
+    @staticmethod
+    def export_kv_pages(pools, ids):
+        """Copy the pool rows of ``ids`` for a KV handoff (the sim's
+        "KV" is the token content itself, so a handoff moves exactly
+        what decode reads back through the page table — a wrong chain
+        or a dropped page diverges the stream like the real model)."""
+        return pools[np.asarray(ids, np.int64)].copy()
+
+    @staticmethod
+    def import_kv_pages(pools, ids, data):
+        """Scatter exported page content into this pool at ``ids``
+        (the importer's freshly allocated chain)."""
+        pools[np.asarray(ids, np.int64)] = data
+        return pools
+
     # --- the offline oracle -----------------------------------------------
     def expected_stream(self, prompt, n_tokens: int):
         """The token stream a request with ``prompt`` generates,

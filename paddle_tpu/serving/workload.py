@@ -452,6 +452,79 @@ def synthesize_cluster_trace(seed: int = 0,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def synthesize_prefill_heavy_trace(seed: int = 0, *,
+                                   n_short: int = 48,
+                                   n_long: int = 12,
+                                   short_gap: float = 5.0,
+                                   short_prompt: Tuple[int, int]
+                                   = (5, 8),
+                                   short_output: Tuple[int, int]
+                                   = (24, 32),
+                                   long_prompt: Tuple[int, int]
+                                   = (48, 64),
+                                   long_output: Tuple[int, int]
+                                   = (4, 8),
+                                   burst_size: int = 4,
+                                   burst_gap: float = 60.0,
+                                   first_burst: float = 10.0,
+                                   vocab_size: int = 128,
+                                   rid_prefix: str = "h",
+                                   start: float = 0.0) \
+        -> List[Request]:
+    """The ADVERSARIAL shape for an interleaved prefill/decode loop:
+    a steady stream of short-prompt, long-budget requests (they fill
+    the decode slots and stay mid-decode) punctuated by BURSTS of
+    long, mostly-uncached prompts (every prompt body is an
+    independent draw — nothing for the prefix cache to serve). Each
+    burst's prefill chunks are what stall every active decode slot
+    when prefill monopolizes the turn; the async prefill lane (and
+    cluster-level disaggregation) exists to make TPOT independent of
+    exactly this queue.
+
+    rids end in ``.short`` / ``.long`` so benches can split the
+    mid-decode cohort (whose TPOT the burst torches) from the burst
+    cohort (whose prefill does the torching) without a side channel.
+    Defaults are sized for a slots=8 / decode_chunk=4 engine on the
+    unit-cost fixed clock at ~80%% utilization — loaded enough that
+    bursts land while every slot decodes, slack enough that queueing
+    does not drown the phase split. Deterministic in every field;
+    JSONL round-trips through ``save_trace``/``load_trace`` like
+    every other synthesizer."""
+    if n_short < 1 or n_long < 0 or burst_size < 1:
+        raise ValueError("need >= 1 short request and a >= 1 burst "
+                         "size")
+    rng = np.random.default_rng(seed)
+    reqs: List[Request] = []
+    t = start
+    for i in range(n_short):
+        t += short_gap
+        plen = int(rng.integers(short_prompt[0], short_prompt[1] + 1))
+        reqs.append(Request(
+            rid=f"{rid_prefix}-s{i:03d}.short", arrival=t,
+            prompt=tuple(int(x) for x in rng.integers(
+                1, vocab_size, plen)),
+            max_new_tokens=int(rng.integers(short_output[0],
+                                            short_output[1] + 1))))
+    k = 0
+    b = 0
+    while k < n_long:
+        tb = start + first_burst + b * burst_gap
+        for j in range(burst_size):
+            if k >= n_long:
+                break
+            plen = int(rng.integers(long_prompt[0],
+                                    long_prompt[1] + 1))
+            reqs.append(Request(
+                rid=f"{rid_prefix}-l{b}.{j}.long", arrival=tb,
+                prompt=tuple(int(x) for x in rng.integers(
+                    1, vocab_size, plen)),
+                max_new_tokens=int(rng.integers(long_output[0],
+                                                long_output[1] + 1))))
+            k += 1
+        b += 1
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def merge_traces(*traces: Sequence[Request]) -> List[Request]:
     """Interleave traces by arrival time (rids must already be unique —
     give each source a distinct ``rid_prefix``)."""

@@ -61,6 +61,13 @@ cannot silently ship a slower build. Three modes:
       #    requests lost or duplicated (census conservation at every
       #    membership change), completed streams token-identical to
       #    the fault-free replay, goodput >= 0.80x fault-free.
+      #  - serving_disagg (tools/serving_workload_bench.py --disagg):
+      #    on the prefill-heavy burst trace, the async prefill lane's
+      #    TPOT p95 must be >= 1.3x better than the interleaved loop
+      #    with TTFT p50 held, token-identical streams across the
+      #    lane and both cluster arms, and the disaggregated
+      #    cluster's KV-handoff census balanced (every exported chain
+      #    imported or reclaimed exactly once).
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -522,6 +529,142 @@ def check_serving_cluster(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+DISAGG_TPOT_FLOOR = 1.30   # lane TPOT p95 improvement floor
+DISAGG_TTFT_HOLD = 1.02    # lane TTFT p50 may drift <= 2% ("no worse")
+
+
+def check_serving_disagg(rows: list) -> int:
+    """Gate the disaggregation rows from serving_workload_bench.py
+    --disagg: on the prefill-heavy burst trace (fixed unit-cost
+    clock) the async prefill lane's TPOT p95 must be >=
+    DISAGG_TPOT_FLOOR x better than the interleaved loop's while TTFT
+    p50 holds (<= DISAGG_TTFT_HOLD x — "no worse", with a 2% guard
+    band), every arm's greedy streams must be token-identical
+    (in-engine lane AND both cluster arms vs the interleaved
+    baseline), and the cluster KV-handoff census must balance: every
+    exported chain imported or reclaimed exactly once, with at least
+    one handoff actually exercised (a disagg gate that moved no KV
+    gates nothing). The interleaved arm is the baseline re-measured
+    in the same run — no stamped file."""
+    dr = [r for r in rows if r.get("bench") == "serving_disagg"]
+    by = {r.get("arm"): r for r in dr}
+    il, ln = by.get("interleaved"), by.get("async_lane")
+    if il is None or ln is None:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_disagg rows need BOTH an "
+                                    "interleaved and an async_lane "
+                                    "arm (run tools/serving_workload_"
+                                    "bench.py --disagg)"}))
+        return 1
+    for r in dr:
+        if r.get("census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "pool census broken under the prefill lane "
+                          "— pages leaked or double-counted"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_disagg_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_disagg_summary row — "
+                                    "lane-vs-interleaved token parity "
+                                    "is UNVERIFIED (rerun the "
+                                    "--disagg arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("outputs_match") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "the async lane produced "
+                                    "DIVERGING greedy tokens vs the "
+                                    "interleaved loop on the same "
+                                    "trace (correctness, not "
+                                    "latency)"}))
+        return 1
+    if s.get("cluster_parity_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "a cluster arm's streams diverged "
+                                    "from the interleaved baseline — "
+                                    "the KV handoff is corrupting "
+                                    "chains"}))
+        return 1
+    cl = [r for r in rows
+          if r.get("bench") == "serving_disagg_cluster"]
+    dis_cl = [r for r in cl if r.get("arm") == "cluster_disagg"]
+    if not dis_cl:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no cluster_disagg row — the "
+                                    "handoff census is UNVERIFIED"}))
+        return 1
+    for r in cl:
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "cluster census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')}"}))
+            return 1
+    ho = dis_cl[-1].get("handoffs") or {}
+    if not int(ho.get("exported") or 0) \
+            or ho.get("balanced") is not True \
+            or int(ho.get("failed") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": f"KV handoff census: exported="
+                                    f"{ho.get('exported')} balanced="
+                                    f"{ho.get('balanced')} failed="
+                                    f"{ho.get('failed')} — every "
+                                    "exported chain must be imported "
+                                    "or reclaimed exactly once, at "
+                                    "least one must have moved, and "
+                                    "none may fail ('balanced' alone "
+                                    "would count failures as "
+                                    "success)",
+                          "handoffs": ho}))
+        return 1
+    # intersection-only parity would let dropped requests vanish from
+    # the comparison: the disagg cluster must COMPLETE what the
+    # interleaved baseline completed
+    if int(dis_cl[-1].get("completed") or 0) \
+            != int(il.get("completed") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": f"cluster_disagg completed "
+                                    f"{dis_cl[-1].get('completed')} "
+                                    f"requests vs the interleaved "
+                                    f"baseline's "
+                                    f"{il.get('completed')} — "
+                                    "requests were dropped, not "
+                                    "just re-placed"}))
+        return 1
+    tpot_imp = s.get("tpot_p95_improvement")
+    ttft_ratio = s.get("ttft_p50_ratio")
+    rec = {
+        "gate": "pass",
+        "tpot_p95_improvement": tpot_imp,
+        "tpot_floor": DISAGG_TPOT_FLOOR,
+        "ttft_p50_ratio": ttft_ratio,
+        "ttft_hold": DISAGG_TTFT_HOLD,
+        "handoffs": ho,
+        "parity_compared": s.get("parity_compared"),
+        "prefill_chunk_budget": s.get("prefill_chunk_budget"),
+        "device": il.get("device", "?"),
+    }
+    if tpot_imp is None or float(tpot_imp) < DISAGG_TPOT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"async-lane TPOT p95 only {tpot_imp}x "
+                         f"better than interleaved (floor "
+                         f"{DISAGG_TPOT_FLOOR}) — decode is still "
+                         "stalling behind prefill")
+    elif ttft_ratio is None or float(ttft_ratio) > DISAGG_TTFT_HOLD:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"async-lane TTFT p50 is {ttft_ratio}x the "
+                         f"interleaved loop's (hold "
+                         f"{DISAGG_TTFT_HOLD}) — TPOT was bought by "
+                         "stalling first tokens")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 CHAOS_GOODPUT_FLOOR = 0.80  # goodput under faults vs fault-free
 
 
@@ -787,6 +930,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_chaos")
            for r in rows):
         fam_rcs["chaos"] = check_serving_chaos(rows)
+    if any(r.get("bench", "").startswith("serving_disagg")
+           for r in rows):
+        fam_rcs["disagg"] = check_serving_disagg(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

@@ -94,6 +94,15 @@ QUEUE = [
     ("serving_chaos",
      [sys.executable, "tools/serving_workload_bench.py", "--chaos"],
      {}),
+    # PR-8 addition: the disaggregated prefill/decode arm — the
+    # prefill-heavy burst trace through an interleaved vs
+    # async-prefill-lane engine plus a 2-prefill+2-decode sim cluster
+    # with KV handoffs; bench_gate.py serving gates the serving_disagg
+    # family (lane TPOT p95 >= 1.3x better with TTFT p50 held, token
+    # parity across arms, handoff census balanced)
+    ("serving_disagg",
+     [sys.executable, "tools/serving_workload_bench.py", "--disagg"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

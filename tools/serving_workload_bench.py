@@ -311,6 +311,164 @@ def _cluster_arm(args):
     return 0
 
 
+def _disagg_arm(args):
+    """The disaggregated prefill/decode arm: the seeded PREFILL-HEAVY
+    burst trace (long mostly-uncached prompts bursting in while many
+    short requests stream mid-decode — the adversarial shape for an
+    interleaved loop) replayed on the fixed unit-cost clock through
+
+    1. ONE sim engine, interleaved (the legacy loop: a wave's whole
+       prefill monopolizes the turn) vs the ASYNC PREFILL LANE
+       (``prefill_chunk_budget``: decode first, at most N prefill
+       chunks per turn) — one `serving_disagg` row per arm; and
+    2. a 4-replica sim CLUSTER, prefix_aware all-"both" (the PR-6
+       path) vs ``disaggregated`` placement over 2 prefill + 2 decode
+       workers with per-page-priced KV handoffs — one
+       `serving_disagg_cluster` row per arm carrying the exactly-once
+       handoff census.
+
+    `bench_gate.py serving` gates the serving_disagg family: lane
+    TPOT p95 >= 1.3x better than interleaved with TTFT p50 held,
+    token-identical streams across every arm, and the cluster handoff
+    census balanced (every exported KV chain imported or reclaimed
+    exactly once)."""
+    import json as _json
+
+    import numpy as np
+
+    from paddle_tpu.serving import (ClusterRouter, ServingEngine,
+                                    make_sim_serving,
+                                    synthesize_prefill_heavy_trace,
+                                    trace_stats)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    VOCAB = 509
+    SLOTS, PS, ML, CHUNK = 8, 8, 96, 4
+    costs = {"prefill_unit": 1.0, "decode": 1.0}
+    budget = max(1, args.lane_budget)
+
+    def make_engine(lane_budget=None, slots=SLOTS):
+        return ServingEngine(
+            serving=make_sim_serving(
+                max_len=ML, page_size=PS, slots=slots, vocab=VOCAB,
+                n_pool_pages=slots * (ML // PS) + 1 + 16),
+            slots=slots, policy="paged", clock="fixed",
+            fixed_costs=costs, decode_chunk=CHUNK,
+            prefill_chunk_budget=lane_budget)
+
+    trace = synthesize_prefill_heavy_trace(
+        seed=args.seed, n_short=96, n_long=24, vocab_size=VOCAB)
+    stats = trace_stats(trace)
+
+    rows, outs = {}, {}
+    for arm, lane in (("interleaved", None), ("async_lane", budget)):
+        eng = make_engine(lane)
+        res = eng.run(trace)
+        rec = res.metrics.to_record(
+            policy="paged", device="sim", seed=args.seed,
+            slots=SLOTS, decode_chunk=CHUNK, trace=stats)
+        rec["bench"] = "serving_disagg"
+        rec["arm"] = arm
+        if lane is not None:
+            rec["prefill_chunk_budget"] = lane
+        rec["prefill_tokens"] = res.prefill_tokens
+        rec["census_ok"] = res.cache_stats.get("invariant_ok")
+        # the mid-decode cohort (rids ending .short) is whose TPOT the
+        # bursts torch; the burst cohort (.long) pays the lane's TTFT
+        # stretch — both sides of the trade on the record
+        for tag in ("short", "long"):
+            vs = [res.metrics.request(r.rid) for r in trace
+                  if r.rid.endswith(f".{tag}")]
+            tp = [v["tpot"] for v in vs if v["tpot"] is not None]
+            tf = [v["ttft"] for v in vs if v["ttft"] is not None]
+            st = [v["decode_stall"] for v in vs
+                  if v["decode_stall"] is not None]
+            rec[f"{tag}_tpot_p95"] = round(
+                float(np.percentile(tp, 95)), 6) if tp else None
+            rec[f"{tag}_ttft_p50"] = round(
+                float(np.percentile(tf, 50)), 6) if tf else None
+            rec[f"{tag}_decode_stall_p95"] = round(
+                float(np.percentile(st, 95)), 6) if st else None
+        rows[arm] = rec
+        outs[arm] = res.outputs
+        emit(rec)
+
+    # --- cluster-level disaggregation over sim replicas -------------------
+    N = 4
+    roles = {"r0": "prefill", "r1": "prefill",
+             "r2": "decode", "r3": "decode"}
+    crows = {}
+    couts = {}
+    for arm, placement, rl in (("cluster_both", "prefix_aware", None),
+                               ("cluster_disagg", "disaggregated",
+                                roles)):
+        router = ClusterRouter(
+            lambda name: make_engine(budget), N, placement=placement,
+            roles=rl, kv_transfer_unit=args.kv_transfer_unit)
+        cres = router.run(trace)
+        rep = cres.report()
+        cen = cres.census()
+        rec = {"bench": "serving_disagg_cluster", "arm": arm,
+               "device": "sim", "seed": args.seed, "replicas": N,
+               "placement": placement,
+               "kv_transfer_unit": args.kv_transfer_unit}
+        rec.update({k: rep.get(k) for k in
+                    ("completed", "tpot_p50", "tpot_p95", "ttft_p50",
+                     "ttft_p95", "makespan")})
+        rec["conserved"] = cen["conserved"]
+        rec["pool_census_ok"] = cen["pool_census_ok"]
+        if cen.get("handoffs"):
+            rec["handoffs"] = cen["handoffs"]
+        if rep.get("kv_handoffs"):
+            rec["kv_handoffs"] = rep["kv_handoffs"]
+            rec["handed_off_requests"] = rep.get(
+                "handed_off_requests")
+        crows[arm] = rec
+        couts[arm] = cres.outputs()
+        emit(rec)
+
+    il, ln = rows["interleaved"], rows["async_lane"]
+    parity, compared, full_eq = _stream_parity(outs["async_lane"],
+                                               outs["interleaved"])
+    cl_par = all(_streams_agree(couts[a], outs["interleaved"])
+                 for a in couts)
+    tpot_il = il.get("tpot_p95") or 0.0
+    tpot_ln = ln.get("tpot_p95") or 0.0
+    ttft_il = il.get("ttft_p50") or 0.0
+    ttft_ln = ln.get("ttft_p50") or 0.0
+    ho = crows["cluster_disagg"].get("handoffs") or {}
+    emit({"bench": "serving_disagg_summary", "device": "sim",
+          "seed": args.seed, "requests": len(trace),
+          "prefill_chunk_budget": budget,
+          "outputs_match": bool(parity
+                                and outs["interleaved"]
+                                == outs["async_lane"]),
+          "cluster_parity_ok": bool(cl_par),
+          "parity_compared": compared,
+          "parity_full_equal": full_eq,
+          "tpot_p95_interleaved": tpot_il,
+          "tpot_p95_async_lane": tpot_ln,
+          "tpot_p95_improvement": round(tpot_il / tpot_ln, 4)
+          if tpot_ln else None,
+          "ttft_p50_interleaved": ttft_il,
+          "ttft_p50_async_lane": ttft_ln,
+          "ttft_p50_ratio": round(ttft_ln / ttft_il, 4)
+          if ttft_il else None,
+          "short_tpot_p95_interleaved": il.get("short_tpot_p95"),
+          "short_tpot_p95_async_lane": ln.get("short_tpot_p95"),
+          "decode_stall_p95_interleaved":
+          il.get("short_decode_stall_p95"),
+          "decode_stall_p95_async_lane":
+          ln.get("short_decode_stall_p95"),
+          "handoffs_exported": ho.get("exported", 0),
+          "handoffs_imported": ho.get("imported", 0),
+          "handoff_census_balanced": ho.get("balanced"),
+          })
+    return 0
+
+
 def _chaos_arm(args):
     """The fault-tolerance arm: the SAME ~10^5-request sim-backed
     overload trace as --cluster, replayed twice through prefix_aware
@@ -490,6 +648,22 @@ def main(argv=None):
                          "gates the serving_chaos family (zero "
                          "lost/duplicated, token parity vs "
                          "fault-free, goodput >= 0.80x)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode arm "
+                         "instead: the prefill-heavy burst trace "
+                         "through an interleaved vs async-prefill-"
+                         "lane sim engine, plus a 2-prefill+2-decode "
+                         "sim cluster with KV handoffs vs an all-both "
+                         "baseline; bench_gate.py serving gates the "
+                         "serving_disagg family (lane TPOT p95 >= "
+                         "1.3x, TTFT p50 held, token parity, handoff "
+                         "census balanced)")
+    ap.add_argument("--lane-budget", type=int, default=2,
+                    help="disagg arm: prefill chunks per engine turn "
+                         "in the async lane")
+    ap.add_argument("--kv-transfer-unit", type=float, default=0.05,
+                    help="disagg arm: per-page KV handoff transfer "
+                         "cost on the virtual clock")
     ap.add_argument("--fault-plan", type=str, default=None,
                     help="chaos arm: replay a saved FaultPlan JSONL "
                          "instead of synthesizing")
@@ -535,6 +709,8 @@ def main(argv=None):
         return _cluster_arm(args)
     if args.chaos:
         return _chaos_arm(args)
+    if args.disagg:
+        return _disagg_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)

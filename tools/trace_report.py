@@ -138,6 +138,69 @@ def failover_hops(events: list, tracks: dict) -> dict:
     return hops
 
 
+def handoff_hops(events: list) -> dict:
+    """rid -> {"handoffs": N, "path": [from, to, ...]} for every
+    request whose KV moved between disaggregated workers (the
+    router's ``handoff`` instants carry rid/from/to). Empty for any
+    trace recorded without roles — every handoff row/column below is
+    omitted then, so pre-disagg traces summarize byte-identically."""
+    hops: dict = {}
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "handoff":
+            continue
+        args = e.get("args", {})
+        rid = args.get("rid")
+        if rid is None:
+            continue
+        h = hops.setdefault(rid, {"handoffs": 0, "path": []})
+        h["handoffs"] += 1
+        for k in ("from", "to"):
+            rep = args.get(k)
+            if rep is not None and (not h["path"]
+                                    or h["path"][-1] != rep):
+                h["path"].append(rep)
+    return hops
+
+
+def replica_roles(events: list) -> dict:
+    """replica -> role from the router's ``role`` instants (emitted
+    only for non-"both" replicas of a disaggregated cluster)."""
+    return {e["args"]["replica"]: e["args"]["role"]
+            for e in events if e.get("ph") == "i"
+            and e.get("name") == "role"
+            and "replica" in e.get("args", {})}
+
+
+def lane_summaries(events: list, tracks: dict,
+                   per_track: dict = None) -> list:
+    """Per-LANE occupancy rows: the prefill lane (``prefill_lane``
+    tracks — one per engine running the async lane, replica-prefixed
+    under a cluster trace) vs the decode slots (``slot/*`` tracks),
+    each aggregated to one row. Emitted only when a prefill-lane
+    track exists, so pre-disagg traces keep their row set exactly.
+    ``per_track`` (a precomputed ``track_summaries`` map) avoids
+    re-walking a 10^5-request trace's events."""
+    if per_track is None:
+        per_track = {r["track"]: r
+                     for r in track_summaries(events, tracks)}
+    pf = {t: r for t, r in per_track.items()
+          if t == "prefill_lane" or t.endswith("/prefill_lane")}
+    if not pf:
+        return []
+    dec = {t: r for t, r in per_track.items()
+           if t.startswith("slot/") or "/slot/" in t}
+    rows = []
+    for lane, group in (("prefill", pf), ("decode", dec)):
+        rows.append({
+            "bench": "trace_report_lane", "lane": lane,
+            "tracks": len(group),
+            "spans": sum(r["spans"] for r in group.values()),
+            "busy_frac": round(sum(r["busy_frac"]
+                                   for r in group.values())
+                               / len(group), 4) if group else 0.0})
+    return rows
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -221,7 +284,8 @@ def track_summaries(events: list, tracks: dict) -> list:
     return rows
 
 
-def replica_summaries(events: list, tracks: dict) -> list:
+def replica_summaries(events: list, tracks: dict,
+                      per_track: dict = None) -> list:
     """Per-replica rollups of the track rows: every ``<name>/engine``
     track names a replica (a lone engine's tracks carry no prefix, so
     single-engine traces yield no replica rows). Slot occupancy is
@@ -232,14 +296,17 @@ def replica_summaries(events: list, tracks: dict) -> list:
                   if t.endswith("/engine") and len(t) > len("/engine"))
     if not reps:
         return []
-    per_track = {r["track"]: r for r in track_summaries(events, tracks)}
+    if per_track is None:
+        per_track = {r["track"]: r
+                     for r in track_summaries(events, tracks)}
+    roles = replica_roles(events)
     rows = []
     for rep in reps:
         slots = [r for t, r in per_track.items()
                  if t.startswith(f"{rep}/slot/")]
         roots = sum(r["roots"] for t, r in per_track.items()
                     if t.startswith(f"{rep}/"))
-        rows.append({
+        row = {
             "bench": "trace_report_replica", "replica": rep,
             "slots": len(slots),
             "slot_busy_frac": round(sum(r["busy_frac"]
@@ -247,7 +314,16 @@ def replica_summaries(events: list, tracks: dict) -> list:
                                     / len(slots), 4) if slots else 0.0,
             "requests": roots,
             "spans": sum(r["spans"] for t, r in per_track.items()
-                         if t.startswith(f"{rep}/"))})
+                         if t.startswith(f"{rep}/"))}
+        # disaggregated clusters only: the replica's stage and its
+        # prefill-lane occupancy ride along (absent otherwise, so
+        # pre-disagg rows keep their keys exactly)
+        if rep in roles:
+            row["role"] = roles[rep]
+        lane = per_track.get(f"{rep}/prefill_lane")
+        if lane is not None:
+            row["prefill_lane_busy_frac"] = lane["busy_frac"]
+        rows.append(row)
     return rows
 
 
@@ -278,6 +354,7 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
     tracks = track_names(events)
     reqs = request_rows(events, tracks)
     hops = failover_hops(events, tracks)
+    kv_hops = handoff_hops(events)
     lines = []
     if reqs:
         ts = [r["arrival"] for r in reqs if "arrival" in r] + \
@@ -297,10 +374,12 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
             hop = hops.get(r["rid"])
             fo = (f" retries={hop['retries']} "
                   f"path={'>'.join(hop['path'])}") if hop else ""
+            kv = kv_hops.get(r["rid"])
+            ho = f" handoff={'>'.join(kv['path'])}" if kv else ""
             lines.append(
                 f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
                 f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}"
-                f"{fo}")
+                f"{fo}{ho}")
     comp = recompiles(events)
     lines.append(f"\n== recompiles ({len(comp)}) ==")
     by_site: dict = {}
@@ -353,14 +432,29 @@ def main(argv=None) -> int:
         return 1
     if args.json:
         # per-track rows, then per-replica rollups (cluster traces
-        # only), then a chaos-evidence row (fault-plan traces only),
-        # then the GLOBAL row LAST — consumers that read the final
-        # JSON line keep seeing exactly what they saw before
+        # only), then per-lane rows + the handoff-evidence row
+        # (disaggregated traces only), then a chaos-evidence row
+        # (fault-plan traces only), then the GLOBAL row LAST —
+        # consumers that read the final JSON line keep seeing exactly
+        # what they saw before
         tracks = track_names(events)
-        for row in track_summaries(events, tracks):
+        track_rows = track_summaries(events, tracks)
+        per_track = {r["track"]: r for r in track_rows}
+        for row in track_rows:
             print(json.dumps(row))
-        for row in replica_summaries(events, tracks):
+        for row in replica_summaries(events, tracks, per_track):
             print(json.dumps(row))
+        for row in lane_summaries(events, tracks, per_track):
+            print(json.dumps(row))
+        kv_hops = handoff_hops(events)
+        if kv_hops:
+            print(json.dumps({
+                "bench": "trace_report_handoff",
+                "handoffs": sum(h["handoffs"]
+                                for h in kv_hops.values()),
+                "handed_off_requests": len(kv_hops),
+                "hops": {rid: h for rid, h
+                         in sorted(kv_hops.items())[:20]}}))
         chaos = chaos_events(events)
         if chaos:
             kinds: dict = {}
