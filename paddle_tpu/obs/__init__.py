@@ -18,11 +18,31 @@ Two halves, both dependency-free and import-light (no jax):
   baseline arm of ``tools/bench_gate.py obs`` (tracing-off overhead
   gated <= 2% on the serving workload bench).
 
-Span taxonomy, metric names and the Perfetto how-to live in
-docs/OBSERVABILITY.md.
+Two ACTIVE halves evaluate those streams (PR 9):
+
+- ``obs.slo``: declarative SLO rules (threshold, multi-window
+  burn-rate over an error budget, heartbeat silence) evaluated
+  STREAMING on the virtual clock by ``SLOMonitor``, firing typed
+  ``Incident`` objects into a shareable ``IncidentLog`` (JSONL,
+  deterministic ids). ``ServingEngine(slo=...)`` and
+  ``ClusterRouter(slo=...)`` thread monitors through the serving
+  stack; ``tools/slo_report.py`` renders the incident timeline and
+  per-rule budget burn-down.
+- ``obs.flight``: the incident flight recorder — an always-on bounded
+  ring of recent trace events (via the Tracer mirror sink) + metric
+  samples that freezes a deterministic postmortem bundle
+  (chrome-trace excerpt, metrics JSONL, incident JSON, offending
+  rids) the moment an incident fires.
+
+Span taxonomy, metric names, the SLO rule grammar / burn-rate math /
+bundle layout and the Perfetto how-to live in docs/OBSERVABILITY.md.
 """
-from . import metrics, trace  # noqa: F401
+from . import flight, metrics, slo, trace  # noqa: F401
+from .flight import FlightRecorder, load_bundle  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge,  # noqa: F401
                       Histogram, MetricsRegistry, get_registry)
+from .slo import (BurnRateRule, HeartbeatRule,  # noqa: F401
+                  Incident, IncidentLog, SLOMonitor, ThresholdRule,
+                  default_serving_rules, load_incidents)
 from .trace import (Tracer, activate, active,  # noqa: F401
                     deactivate, get_trace_id, trace_scope, use)

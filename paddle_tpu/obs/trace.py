@@ -76,6 +76,11 @@ class Tracer:
         self._events: List[dict] = []
         self._tracks: Dict[str, int] = {}
         self._mirror_profiler = True
+        # the mirror seam: an optional per-event sink (the incident
+        # flight recorder's bounded ring) fed alongside the event
+        # list — one is-None check per recorded event, nothing when
+        # tracing is off (no events are recorded at all then)
+        self._sink: Optional[Callable[[dict], None]] = None
 
     # --- clock / tracks ---------------------------------------------------
     def set_clock(self, clock: Callable[[], float]):
@@ -93,6 +98,17 @@ class Tracer:
             self._tracks[name] = tid
         return tid
 
+    def set_sink(self, sink: Optional[Callable[[dict], None]]):
+        """Install (or clear, with None) the per-event mirror sink —
+        ``obs.flight.FlightRecorder.attach`` uses this to keep a
+        bounded ring of the most recent events."""
+        self._sink = sink
+
+    def _emit(self, evt: dict):
+        self._events.append(evt)
+        if self._sink is not None:
+            self._sink(evt)
+
     # --- event emission ---------------------------------------------------
     def _args(self, attrs: dict) -> dict:
         tid = _trace_id.get()
@@ -103,10 +119,10 @@ class Tracer:
     def add_span(self, name: str, t0: float, dur: float,
                  track: str = "main", **attrs):
         """A complete span with explicit start/duration (clock units)."""
-        self._events.append({"name": name, "ph": "X", "ts": t0,
-                             "dur": max(dur, 0.0),
-                             "tid": self.track(track),
-                             "args": self._args(attrs)})
+        self._emit({"name": name, "ph": "X", "ts": t0,
+                    "dur": max(dur, 0.0),
+                    "tid": self.track(track),
+                    "args": self._args(attrs)})
         if self._mirror_profiler:
             self._to_profiler(name, t0, dur)
 
@@ -121,36 +137,36 @@ class Tracer:
 
     def instant(self, name: str, t: Optional[float] = None,
                 track: str = "main", **attrs):
-        self._events.append({"name": name, "ph": "i",
-                             "ts": self.now() if t is None else t,
-                             "s": "t", "tid": self.track(track),
-                             "args": self._args(attrs)})
+        self._emit({"name": name, "ph": "i",
+                    "ts": self.now() if t is None else t,
+                    "s": "t", "tid": self.track(track),
+                    "args": self._args(attrs)})
 
     def counter(self, name: str, value: float,
                 t: Optional[float] = None, track: str = "counters"):
-        self._events.append({"name": name, "ph": "C",
-                             "ts": self.now() if t is None else t,
-                             "tid": self.track(track),
-                             "args": {"value": value}})
+        self._emit({"name": name, "ph": "C",
+                    "ts": self.now() if t is None else t,
+                    "tid": self.track(track),
+                    "args": {"value": value}})
 
     def async_begin(self, name: str, id_: str,
                     t: Optional[float] = None, track: str = "main",
                     cat: str = "request", **attrs):
         """Open an async (overlap-capable) span, e.g. a request root."""
-        self._events.append({"name": name, "ph": "b", "cat": cat,
-                             "id": str(id_),
-                             "ts": self.now() if t is None else t,
-                             "tid": self.track(track),
-                             "args": self._args(attrs)})
+        self._emit({"name": name, "ph": "b", "cat": cat,
+                    "id": str(id_),
+                    "ts": self.now() if t is None else t,
+                    "tid": self.track(track),
+                    "args": self._args(attrs)})
 
     def async_end(self, name: str, id_: str,
                   t: Optional[float] = None, track: str = "main",
                   cat: str = "request", **attrs):
-        self._events.append({"name": name, "ph": "e", "cat": cat,
-                             "id": str(id_),
-                             "ts": self.now() if t is None else t,
-                             "tid": self.track(track),
-                             "args": self._args(attrs)})
+        self._emit({"name": name, "ph": "e", "cat": cat,
+                    "id": str(id_),
+                    "ts": self.now() if t is None else t,
+                    "tid": self.track(track),
+                    "args": self._args(attrs)})
 
     def _to_profiler(self, name, t0, dur):
         # feed the profiler's span store while a Profiler is recording

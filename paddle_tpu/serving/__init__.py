@@ -18,11 +18,16 @@ detection, failover with retry budgets + resume-from-prefix — the
 vs fault-free), a seeded replayable trace generator
 (``workload``, including the multi-tenant overload and cluster
 traces), and per-request TTFT/TPOT/SLO/goodput/fairness metrics
-(``metrics``). ``tools/serving_workload_bench.py`` replays one trace
+(``metrics``). The whole stack is watchable by the SLO layer
+(``paddle_tpu.obs.slo``/``obs.flight``): ``ServingEngine(slo=...)``
+and ``ClusterRouter(slo=..., flight=...)`` evaluate burn-rate /
+threshold / heartbeat rules streaming on the virtual clock and
+freeze postmortem bundles per incident, without changing a byte of
+output. ``tools/serving_workload_bench.py`` replays one trace
 through routed / dense-only / paged-only (``--qos`` replays the
 overload trace fifo-vs-qos, ``--cluster`` the 10^5-request trace
-across placements); ``tools/bench_gate.py serving`` gates every
-family.
+across placements, ``--chaos``/``--slo`` the seeded fault schedule);
+``tools/bench_gate.py serving``/``obs`` gate every family.
 """
 from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       DisaggregatedPlacement, LeastLoadedPlacement,

@@ -60,11 +60,14 @@ import dataclasses
 import heapq
 from typing import Dict, List, Optional
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from .engine import (EngineSession, KVHandoff, ServeResult,
                      ServingEngine)
-from .faults import FailoverConfig, FaultEvent, FaultPlan
+from .faults import (FAULT_SEVERITY, FailoverConfig, FaultEvent,
+                     FaultPlan)
 from .metrics import _pct, goodput_tokens, jain_fairness
 from .workload import Request
 
@@ -231,7 +234,7 @@ class _ReplicaTracer:
 
 class _Replica:
     __slots__ = ("name", "index", "session", "admitting", "joined_at",
-                 "drained_at", "last_seen", "role")
+                 "drained_at", "last_seen", "role", "monitor")
 
     def __init__(self, name: str, index: int, session: EngineSession,
                  joined_at: float, role: str = "both"):
@@ -248,6 +251,9 @@ class _Replica:
         # disaggregation stage ("prefill" / "decode" / "both") — the
         # session enforces it; the placement policy reads it
         self.role = role
+        # this replica's SLOMonitor (shared IncidentLog), None when
+        # the router runs without an SLO config
+        self.monitor = None
 
 
 @dataclasses.dataclass
@@ -279,6 +285,22 @@ class ClusterResult:
     # failed} — empty (and absent from census/report) when no
     # prefill-role replica ever exported, so role-less replays keep
     # the PR-7 records byte-for-byte
+    incidents: Optional[List] = None    # obs.slo.Incident list when
+    # the router ran with slo=...; None otherwise. Deliberately NOT
+    # folded into report()/census() — the obs_slo gate requires a
+    # monitor-on replay's records byte-identical to monitor-off
+    slo_log: Optional[object] = None    # the shared IncidentLog
+    flight: Optional[object] = None     # the FlightRecorder, if any
+
+    def save_incidents(self, path: str) -> str:
+        """Dump the run's incident set as JSONL (atomic; loads back
+        through ``obs.slo.load_incidents`` / the shared tolerant
+        policy). Raises when the router ran without slo=."""
+        if self.slo_log is None:
+            raise ValueError("this replay ran without an SLO monitor "
+                             "(ClusterRouter(slo=...)) — there is no "
+                             "incident log to save")
+        return self.slo_log.save(path)
 
     def outputs(self) -> Dict[str, List[int]]:
         """Every request's greedy stream, merged across replicas (rids
@@ -510,7 +532,8 @@ class ClusterRouter:
                  trace=None, faults: Optional[FaultPlan] = None,
                  failover: Optional[FailoverConfig] = None,
                  roles: Optional[Dict[str, str]] = None,
-                 kv_transfer_unit: float = 0.0):
+                 kv_transfer_unit: float = 0.0,
+                 slo=None, flight=None, slo_on_incident=()):
         if not callable(spawn):
             raise ValueError("spawn must be callable: name -> "
                              "ServingEngine (one engine+factory per "
@@ -566,6 +589,41 @@ class ClusterRouter:
         self.kv_transfer_unit = float(kv_transfer_unit)
         self._handoff = {"exported": 0, "imported": 0,
                          "reclaimed": 0, "failed": 0}
+        # --- SLO watchdog (inert without slo=) ----------------------
+        # slo: a sequence of obs.slo rules (may be EMPTY — fault
+        # events and heartbeats still auto-open/feed incidents). The
+        # router builds ONE SLOMonitor per replica over ONE shared
+        # IncidentLog, so ids stay cluster-unique and open-order
+        # deterministic; drain/join changes the watched membership
+        # (a joiner gets a monitor at join time, a removed replica's
+        # monitor retires — its silence is no longer an alert).
+        # flight: a FlightRecorder, or a bundle-directory path string
+        # (a recorder is built over it) — incidents then freeze
+        # postmortem bundles; requires slo=. slo_on_incident:
+        # callbacks delivered every incident as it opens (the QoS
+        # degradation seam — e.g. a scheduler's note_incident).
+        if slo is not None and isinstance(slo, obs_slo.SLOMonitor):
+            raise ValueError("cluster slo= takes a RULES sequence, "
+                             "not a monitor — the router builds one "
+                             "monitor per replica over a shared "
+                             "IncidentLog")
+        self._slo_rules = None if slo is None else list(slo)
+        self._slo_cbs = list(slo_on_incident)
+        if flight is not None and slo is None:
+            raise ValueError("flight= needs slo= (bundles are written "
+                             "when an SLO incident fires)")
+        if isinstance(flight, str):
+            flight = obs_flight.FlightRecorder(bundle_dir=flight)
+        self.flight = flight
+        self.slo_log: Optional[obs_slo.IncidentLog] = None
+        self._mon_cluster: Optional[obs_slo.SLOMonitor] = None
+        if self._slo_rules is not None:
+            self.slo_log = obs_slo.IncidentLog()
+            # router-scope events (a retry budget exhausting, an
+            # unadoptable KV handoff) have no single replica to blame
+            self._mon_cluster = obs_slo.SLOMonitor(
+                [], source="cluster", log=self.slo_log,
+                flight=self.flight, on_incident=self._slo_cbs)
 
     # --- lifecycle --------------------------------------------------------
     def _add_replica(self, name: str, t: float) -> _Replica:
@@ -586,11 +644,19 @@ class ClusterRouter:
         tr = _ReplicaTracer(self._tracer, name) \
             if self._tracer is not None else None
         role = self._roles.get(name, "both")
+        mon = None
+        if self._slo_rules is not None:
+            mon = obs_slo.SLOMonitor(self._slo_rules, source=name,
+                                     t0=t, log=self.slo_log,
+                                     flight=self.flight,
+                                     on_incident=self._slo_cbs)
         sess = eng.session(tracer=tr, replica=name,
-                           expect_churn=self._expect_churn, role=role)
+                           expect_churn=self._expect_churn, role=role,
+                           slo=mon)
         sess.clock.advance_to(t)   # a joiner starts life at NOW
         rep = _Replica(name, self._next_index, sess, joined_at=t,
                        role=role)
+        rep.monitor = mon
         self._next_index += 1
         self.replicas.append(rep)
         self._g_load("cluster_replica_load",
@@ -674,6 +740,14 @@ class ClusterRouter:
         (``extra`` tags crash removals with ``crashed``/``pool_epoch``)."""
         res = rep.session.finish()
         self._fold_handoff_stats(rep.session)
+        if rep.monitor is not None:
+            # membership change: the departing replica's monitor
+            # retires — open incidents close (crash ones were already
+            # resolved "failover" by _declare_dead) and its silence
+            # stops being evaluated
+            rep.monitor.retire(t, resolution="failover"
+                               if extra.get("crashed")
+                               else "replica_removed")
         cs = res.cache_stats
         ok = bool(cs.get("invariant_ok")
                   and cs.get("resident_pages") == 0)
@@ -778,6 +852,13 @@ class ClusterRouter:
                         self._tracer.instant("handoff_failed",
                                              t=h.t_ready,
                                              track="cluster", rid=rid)
+                    if self._mon_cluster is not None:
+                        self._mon_cluster.event(
+                            "handoff_failed", h.t_ready,
+                            severity=FAULT_SEVERITY["handoff_failed"],
+                            close_t=h.t_ready, rids=[rid],
+                            evidence={"pages": h.n_pages,
+                                      "from": h.replica_from})
                     continue
                 h.t_arrive = h.t_ready \
                     + self.kv_transfer_unit * h.n_pages
@@ -844,6 +925,13 @@ class ClusterRouter:
                 self._tracer.instant("crash", t=t, track="cluster",
                                      replica=rep.name,
                                      in_flight=n_inflight)
+            if rep.monitor is not None:
+                # auto-open: the replica process died — ONE incident
+                # per crash, open until the failover resolves it
+                rep.monitor.event(
+                    "crash", t, severity=FAULT_SEVERITY["crash"],
+                    evidence={"in_flight": n_inflight,
+                              "queued": sess.queued()})
         elif ev.kind == "stall":
             if rep.session.crashed:
                 return
@@ -858,6 +946,15 @@ class ClusterRouter:
                 self._tracer.instant("stall", t=t, track="cluster",
                                      replica=rep.name,
                                      duration=ev.duration)
+            if rep.monitor is not None:
+                # one incident per injected stall, self-closing when
+                # the pause ends (slow, not dead — "warn")
+                rep.monitor.event(
+                    "stall", t, severity=FAULT_SEVERITY["stall"],
+                    close_t=t + float(ev.duration),
+                    evidence={"duration": ev.duration,
+                              "resume_at": round(
+                                  rep.session.stall_until, 6)})
         else:  # decode_error
             sess = rep.session
             if sess.crashed or not sess.active:
@@ -877,6 +974,14 @@ class ClusterRouter:
                 self._tracer.instant("decode_error", t=t,
                                      track="cluster", replica=rep.name,
                                      rid=rid)
+            if rep.monitor is not None:
+                # a point incident: one slot failed, the row fails
+                # over — service continues
+                rep.monitor.event(
+                    "decode_error", t,
+                    severity=FAULT_SEVERITY["decode_error"],
+                    close_t=t, rids=[rid],
+                    evidence={"salvaged_tokens": len(out)})
             self._schedule_retry(req, out, t, reason="decode_error")
 
     def _collect_aborted(self, t: float) -> bool:
@@ -900,6 +1005,16 @@ class ClusterRouter:
                         "config — pass failover=FailoverConfig() (or "
                         "a fault plan) so aborted work can be "
                         "re-placed instead of lost")
+                if rep.monitor is not None:
+                    # backend-raised DecodeError (no scheduled fault
+                    # behind it): just as incident-worthy as a
+                    # planned one
+                    rep.monitor.event(
+                        "decode_error", t,
+                        severity=FAULT_SEVERITY["decode_error"],
+                        close_t=t, rids=[req.rid],
+                        evidence={"salvaged_tokens": len(out),
+                                  "backend_raised": True})
                 self._schedule_retry(req, out, t,
                                      reason="decode_error")
         return got
@@ -945,6 +1060,19 @@ class ClusterRouter:
                                  missed_heartbeats=missed,
                                  requeued=len(queued),
                                  in_flight_lost=len(salvage))
+        if rep.monitor is not None:
+            # the detector's conclusion: silence exceeded the timeout,
+            # work is moving — pages; the crash incident it resolves
+            # closes with resolution "failover"
+            rep.monitor.event(
+                "failover", t, severity=FAULT_SEVERITY["failover"],
+                close_t=t,
+                evidence={"silent_for": round(silence, 6),
+                          "missed_heartbeats": missed,
+                          "requeued": len(queued),
+                          "in_flight_lost": len(salvage)},
+                rids=[r.rid for r, _ in salvage])
+            rep.monitor.close_kind("crash", t, resolution="failover")
         self._bank_removal(rep, t, crashed=True,
                            pool_epoch=sess.book.epoch)
         # queued work first (it never ran — plain re-place), then the
@@ -979,6 +1107,12 @@ class ClusterRouter:
                 self._tracer.instant("retry_exhausted", t=t,
                                      track="cluster", rid=r.rid,
                                      reason="unplaceable")
+            if self._mon_cluster is not None:
+                self._mon_cluster.event(
+                    "retry_exhausted", t,
+                    severity=FAULT_SEVERITY["retry_exhausted"],
+                    close_t=t, rids=[r.rid],
+                    evidence={"reason": "unplaceable"})
             return False
         self._place(r, requeue=True,
                     only=lambda rep: self._rep_fits(
@@ -1009,6 +1143,13 @@ class ClusterRouter:
             if self._tracer is not None:
                 self._tracer.instant("retry_exhausted", t=t,
                                      track="cluster", rid=r.rid)
+            if self._mon_cluster is not None:
+                self._mon_cluster.event(
+                    "retry_exhausted", t,
+                    severity=FAULT_SEVERITY["retry_exhausted"],
+                    close_t=t, rids=[r.rid],
+                    evidence={"attempts": attempt - 1,
+                              "after": reason})
             return
         self._ctr_retry(reason)
         delay = cfg.backoff(attempt)
@@ -1094,6 +1235,10 @@ class ClusterRouter:
                 self._tracer.clear()
             else:
                 self._tracer = obs_trace.Tracer()
+        if self.flight is not None and self._tracer is not None:
+            # the flight recorder rides the tracer's mirror sink: the
+            # most recent spans stay in its bounded ring for bundles
+            self.flight.attach(self._tracer)
         for ev in events:
             t, op, name = ev
             if op not in ("drain", "join"):
@@ -1134,6 +1279,21 @@ class ClusterRouter:
                     rep.session.advance_until(t)
                     if not rep.admitting:
                         self._maybe_retire(rep)
+                if self._slo_rules is not None:
+                    # liveness feed, BEFORE any rule evaluation at t:
+                    # a live session (stalled included — slow is not
+                    # dead) answers the probe, so its monitor's
+                    # silence reads zero across arrival gaps; a
+                    # crashed session stays silent and only its clock
+                    # advances — exactly what a heartbeat-silence
+                    # rule is allowed to see
+                    for rep in list(self.replicas):
+                        if rep.monitor is None:
+                            continue
+                        if not rep.session.crashed:
+                            rep.monitor.heartbeat(t)
+                        else:
+                            rep.monitor.advance(t)
                 if has_roles:
                     # exports that completed during this advance move
                     # to decode workers before anything else acts on
@@ -1232,4 +1392,9 @@ class ClusterRouter:
                                              self.ledger.values())),
                              handoffs=(dict(self._handoff)
                                        if self._handoff["exported"]
-                                       else {}))
+                                       else {}),
+                             incidents=(list(self.slo_log.incidents)
+                                        if self.slo_log is not None
+                                        else None),
+                             slo_log=self.slo_log,
+                             flight=self.flight)

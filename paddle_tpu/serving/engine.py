@@ -51,6 +51,7 @@ import numpy as np
 
 from ..inference import BatchingConfig
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..models.nlp.llama_decode import (llama_serving_decode_factory,
                                        route_decode)
@@ -206,6 +207,11 @@ class ServeResult:
     # engine turn
     replica: Optional[str] = None   # cluster replica name (a lone
     # engine leaves it None and its logs stay byte-identical to PR 4)
+    incidents: Optional[List] = None  # obs.slo.Incident list when the
+    # run carried an SLO monitor; None otherwise. Never serialized by
+    # save_log — monitor-on logs stay byte-identical to monitor-off
+    # (the obs_slo gate's identity clause); the incident JSONL is the
+    # monitor's own IncidentLog.save
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -449,7 +455,8 @@ class ServingEngine:
                  expect_churn: Optional[bool] = None,
                  scheduler=None, trace=None,
                  prefix_cache: bool = True,
-                 prefill_chunk_budget: Optional[int] = None):
+                 prefill_chunk_budget: Optional[int] = None,
+                 slo=None):
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -502,6 +509,18 @@ class ServingEngine:
         self.scheduler = scheduler
         self.admission = admission or BatchingConfig()
         self._trace_spec = trace
+        # ``slo``: None (off — zero monitor work, the default), an
+        # obs.slo.SLOMonitor (caller keeps the handle and its
+        # IncidentLog), or a sequence of SLO rules (a FRESH monitor is
+        # built per run; its incidents land on ServeResult.incidents).
+        # The monitor observes the run through MetricsCollector's
+        # finish/shed/queue-depth feed — it never touches engine
+        # state, so outputs/logs/records are byte-identical either way.
+        if slo is not None and not isinstance(
+                slo, (obs_slo.SLOMonitor, list, tuple)):
+            raise ValueError("slo must be None, an SLOMonitor, or a "
+                             "sequence of SLO rules")
+        self._slo_spec = slo
         # obs counters prefetched once: the per-event hot path is then
         # one enabled-check + add (the <= 2% tracing-off overhead gate,
         # tools/bench_gate.py obs, prices exactly this)
@@ -604,6 +623,34 @@ class ServingEngine:
     def _close_trace(self, tr: Optional[obs_trace.Tracer]):
         if tr is not None and isinstance(self._trace_spec, str):
             tr.export(self._trace_spec)
+
+    def _make_monitor(self, fresh: bool = True) \
+            -> Optional[obs_slo.SLOMonitor]:
+        """``fresh``: a caller-held monitor instance is RESET (the
+        ``trace=Tracer`` convention — each run() is one monitoring
+        session; without the reset a second replay's low virtual
+        timestamps would be instantly outside the first run's
+        advanced windows and every rule would go blind). Sessions
+        pass ``fresh=False`` — they are incremental by design and a
+        reset would nuke a log shared with sibling sessions."""
+        spec = self._slo_spec
+        if spec is None:
+            return None
+        if isinstance(spec, obs_slo.SLOMonitor):
+            if fresh:
+                spec.reset()
+            return spec
+        return obs_slo.SLOMonitor(spec)
+
+    @staticmethod
+    def _bank_incidents(mon) -> Optional[List]:
+        """This run's incidents for ServeResult: the monitor's view of
+        its own source (a cluster replica shares one IncidentLog with
+        its siblings — its per-replica result banks only what IT
+        fired; the router's ClusterResult carries the full set)."""
+        if mon is None:
+            return None
+        return [i for i in mon.log.incidents if i.source == mon.source]
 
     def _req_open(self, tr, r: Request):
         if tr is None:
@@ -743,7 +790,8 @@ class ServingEngine:
         self._validate(trace)
         clock = EngineClock(self.clock_mode, self.fixed_costs)
         tr = self._make_tracer(clock)
-        m = MetricsCollector()
+        mon = self._make_monitor()
+        m = MetricsCollector(monitor=mon)
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)  # bookkeeping only:
         # tables/lengths/free-list/prefix refcounts — device pages live
@@ -887,7 +935,8 @@ class ServingEngine:
                                            + len(book._evictable)),
                            trace=tr, prefill_tokens=prefill_tokens,
                            cache_stats=dict(book.cache_stats(),
-                                            invariant_ok=inv_ok))
+                                            invariant_ok=inv_ok),
+                           incidents=self._bank_incidents(mon))
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -928,7 +977,8 @@ class ServingEngine:
         est = ServiceEstimator(prefill=costs.get("prefill", 1.0),
                                decode=costs.get("decode", 1.0),
                                **est_kw)
-        m = MetricsCollector()
+        mon = self._make_monitor()
+        m = MetricsCollector(monitor=mon)
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
         pages_total = len(book._free)
@@ -1107,7 +1157,8 @@ class ServingEngine:
                            scheduler=sched.name, shed=shed_log,
                            trace=tr, prefill_tokens=prefill_tokens,
                            cache_stats=dict(book.cache_stats(),
-                                            invariant_ok=inv_ok))
+                                            invariant_ok=inv_ok),
+                           incidents=self._bank_incidents(mon))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -1359,6 +1410,7 @@ class ServingEngine:
                 t0=t_done, t_admit=e.t_admit, sink=sink)
         if self._g_lane_depth is not None:
             self._g_lane_depth.set(float(len(lane)))
+        m.on_lane_depth(clock.now(), len(lane))
         if tr is not None:
             tr.counter("prefill_lane_depth", len(lane), t=clock.now())
         return chunks_run, tokens_run
@@ -1512,17 +1564,25 @@ class ServingEngine:
         self._req_close(tr, r, t_fin, outcome, len(st.out))
 
     def session(self, *, tracer=None, replica: Optional[str] = None,
-                expect_churn: bool = False,
-                role: str = "both") -> "EngineSession":
+                expect_churn: bool = False, role: str = "both",
+                slo=None) -> "EngineSession":
         """An incremental session over this engine's configuration —
         the cluster router's entry point (see ``EngineSession``).
         ``role`` is the disaggregation stage this session serves
         ("prefill" exports finished prefills as KV handoffs, "decode"
-        adopts them, "both" is the classic replica). The engine object
-        itself is untouched; ``run()`` keeps replaying traces
-        byte-identically."""
+        adopts them, "both" is the classic replica). ``slo`` is this
+        replica's ``obs.slo.SLOMonitor`` (the cluster router builds
+        one per replica over a shared IncidentLog); it observes the
+        session's metrics stream and never mutates it. With ``slo``
+        unset, an engine constructed with ``ServingEngine(slo=...)``
+        monitors its sessions too — both run paths see the same
+        watchdog config. The engine object itself is untouched;
+        ``run()`` keeps replaying traces byte-identically."""
+        if slo is None:
+            slo = self._make_monitor(fresh=False)
         return EngineSession(self, tracer=tracer, replica=replica,
-                             expect_churn=expect_churn, role=role)
+                             expect_churn=expect_churn, role=role,
+                             slo=slo)
 
     # --- dense backend ----------------------------------------------------
     def _run_dense_wave(self, wave, clock, m, outputs,
@@ -1674,7 +1734,8 @@ class EngineSession:
 
     def __init__(self, engine: ServingEngine, *, tracer=None,
                  replica: Optional[str] = None,
-                 expect_churn: bool = False, role: str = "both"):
+                 expect_churn: bool = False, role: str = "both",
+                 slo=None):
         if role not in ("prefill", "decode", "both"):
             raise ValueError(f"role {role!r}: use 'prefill', 'decode' "
                              "or 'both'")
@@ -1695,7 +1756,8 @@ class EngineSession:
         self.handoff_stats = {"imported": 0, "reclaimed": 0}
         self.clock = EngineClock(eng.clock_mode, eng.fixed_costs)
         self.tr = tracer
-        self.m = MetricsCollector()
+        self.slo = slo
+        self.m = MetricsCollector(monitor=slo)
         self.book = PagedKVCache(eng.n_pool_pages, eng.page_size,
                                  kv_heads=1, head_dim=1)
         self.pages_total = len(self.book._free)
@@ -2375,5 +2437,6 @@ class EngineSession:
             prefill_tokens=self.prefill_tokens,
             cache_stats=dict(self.book.cache_stats(),
                              invariant_ok=self.inv_ok),
-            replica=self.replica)
+            replica=self.replica,
+            incidents=ServingEngine._bank_incidents(self.slo))
         return self._finished

@@ -52,6 +52,16 @@ import numpy as np
 
 KINDS = ("crash", "stall", "decode_error")
 
+# the SLO severity each observed fault auto-opens its incident at
+# (obs.slo conventions: "page" wakes a human, "warn" files a ticket).
+# A crash pages — capacity is gone and work is in flight; a stall or
+# a single-slot decode error degrades service but self-heals, so it
+# warns; a failover (the detector's conclusion after a crash) pages
+# because it is the moment the cluster actually lost redundancy.
+FAULT_SEVERITY = {"crash": "page", "stall": "warn",
+                  "decode_error": "warn", "failover": "page",
+                  "retry_exhausted": "page", "handoff_failed": "page"}
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:

@@ -48,9 +48,27 @@ class _Req:
         self.finish_reason: Optional[str] = None
 
 
-def _pct(xs, q) -> Optional[float]:
-    return round(float(np.percentile(np.asarray(xs), q)), 6) if xs \
-        else None
+def percentile(xs, q) -> Optional[float]:
+    """THE percentile used by every serving report path (request
+    latencies in ``MetricsCollector.report``, the cluster rollup, the
+    bench rows) — one implementation so two reports can never disagree
+    on the arithmetic. Linear interpolation between closest ranks
+    (numpy's default), rounded to 6 places. Small-n semantics are
+    DEFINED, not accidental:
+
+    - ``n == 0``: ``None`` (a percentile of nothing is not 0.0);
+    - ``n == 1``: the value itself, for every ``q``;
+    - ``n == 2``: linear interpolation — ``q=50`` is the midpoint,
+      ``q=95`` sits 90% of the way to the larger value.
+    """
+    if xs is None or len(xs) == 0:
+        return None
+    return round(float(np.percentile(np.asarray(xs), q)), 6)
+
+
+# internal alias predating the public name; kept so call sites read
+# compactly in report-building code
+_pct = percentile
 
 
 def jain_fairness(xs) -> Optional[float]:
@@ -80,13 +98,20 @@ class MetricsCollector:
     engine clock (wall-measured or fixed-cost — the collector does not
     care which)."""
 
-    def __init__(self):
+    def __init__(self, monitor=None):
         self._req: Dict[str, _Req] = {}
         self._queue: List[tuple] = []  # (t, depth)
         # prefix-cache totals over paged admits (engine-fed); the
         # report grows its prefix block only when a hit happened, so
         # plain no-hit traces stay byte-identical
         self._prefix = {"cached": 0, "saved": 0, "prompt": 0}
+        # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
+        # request's FINAL record at finish/shed plus queue/lane depth
+        # samples — the one seam through which the streaming SLO layer
+        # sees everything the collector sees. It only READS: with a
+        # monitor attached or not, every record/report/output byte is
+        # identical (the obs_slo gate measures exactly this).
+        self._mon = monitor
 
     # --- events ----------------------------------------------------------
     def on_arrival(self, rid: str, t: float, tenant: Optional[str] = None,
@@ -108,6 +133,9 @@ class MetricsCollector:
         r.shed = True
         r.shed_reason = reason
         r.finish_reason = "shed"
+        if self._mon is not None:
+            self._mon.observe_request(dict(self.request(rid), rid=rid),
+                                      t)
 
     def on_degrade(self, rid: str, budget: int, orig_budget: int):
         """Graceful-degradation tier fired: ``rid`` was admitted with
@@ -138,9 +166,22 @@ class MetricsCollector:
         r.evicted = evicted
         if reason is not None:
             r.finish_reason = reason
+        if self._mon is not None:
+            self._mon.observe_request(dict(self.request(rid), rid=rid),
+                                      t)
 
     def on_queue_depth(self, t: float, depth: int):
         self._queue.append((t, depth))
+        if self._mon is not None:
+            self._mon.observe_value("queue_depth", depth, t)
+
+    def on_lane_depth(self, t: float, depth: int):
+        """Async-prefill-lane depth sample. Stored nowhere (the lane
+        gauge already exports it live); exists purely to stream the
+        signal to an attached SLO monitor — a no-op without one, so
+        pre-SLO replays are untouched."""
+        if self._mon is not None:
+            self._mon.observe_value("prefill_lane_depth", depth, t)
 
     def forget(self, rid: str):
         """Erase every trace of ``rid`` from this collector — the

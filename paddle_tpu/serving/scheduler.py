@@ -179,6 +179,13 @@ class QoSScheduler:
         if aging is not None and aging <= 0:
             raise ValueError("aging must be > 0 clock units (or None)")
         self.aging = aging
+        # the SLO subscription seam (obs.slo.SLOMonitor on_incident /
+        # subscribe): incidents delivered here accumulate for a future
+        # degradation policy to act on — today the scheduler only
+        # LISTENS (detect-and-report), so admission arithmetic is
+        # untouched by any incident. Survives reset(): incident
+        # history is operator state, not per-run queue state.
+        self.incidents_seen: List = []
         self.reset()
 
     # --- state ------------------------------------------------------------
@@ -187,6 +194,16 @@ class QoSScheduler:
         engine reuses one scheduler across ``run`` calls)."""
         self._q: Dict[str, _Entry] = {}
         self._tags: Dict[str, float] = {}
+
+    def note_incident(self, incident):
+        """``obs.slo`` incident callback: record that an SLO incident
+        fired (e.g. ``SLOMonitor(..., on_incident=[sched.
+        note_incident])``). Deliberately does NOT change admission
+        behavior — this is the seam a later degradation policy plugs
+        into (shed earlier / clamp tiers while a page-severity
+        incident is open); wiring it today keeps the monitor
+        detect-and-report only."""
+        self.incidents_seen.append(incident)
 
     def waiting(self) -> int:
         return len(self._q)

@@ -1,0 +1,130 @@
+"""obs.flight units (PR 9): bounded rings, the Tracer mirror-sink
+seam, four-file postmortem bundles (atomic, deterministic bytes),
+automatic bundle-on-incident via SLOMonitor, and the tolerant bundle
+loader (torn metrics.jsonl tail warns; satellite #3)."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.obs.flight import FlightRecorder, load_bundle
+from paddle_tpu.obs.slo import SLOMonitor, ThresholdRule
+from paddle_tpu.obs.trace import Tracer
+
+
+def test_rings_are_bounded():
+    fr = FlightRecorder(span_capacity=3, sample_capacity=2)
+    for i in range(10):
+        fr.on_event({"name": f"e{i}", "ph": "i", "ts": float(i),
+                     "tid": 1, "args": {}})
+        fr.sample("queue_depth", i, float(i))
+    snap = fr.snapshot()
+    assert [e["name"] for e in snap["events"]] == ["e7", "e8", "e9"]
+    assert [s["value"] for s in snap["samples"]] == [8, 9]
+    with pytest.raises(ValueError, match="capacities"):
+        FlightRecorder(span_capacity=0)
+
+
+def test_tracer_sink_mirrors_every_event_kind():
+    fr = FlightRecorder()
+    tr = Tracer(clock=lambda: 1.0)
+    fr.attach(tr)
+    tr.add_span("work", 0.0, 1.0, track="engine")
+    tr.instant("shed", t=2.0, track="scheduler", rid="x")
+    tr.counter("queue_depth", 4, t=3.0)
+    tr.async_begin("request", "r1", t=0.5, track="requests")
+    tr.async_end("request", "r1", t=4.0, track="requests")
+    snap = fr.snapshot()
+    assert len(snap["events"]) == len(tr.events) == 5
+    # the ring holds the SAME records the tracer exports, and the
+    # track registry rides the snapshot for the chrome excerpt
+    assert snap["events"][0]["name"] == "work"
+    assert "engine" in snap["tracks"]
+    # detach: clearing the sink stops the mirror
+    tr.set_sink(None)
+    tr.instant("late", t=5.0)
+    assert len(fr.snapshot()["events"]) == 5
+
+
+def test_bundle_write_load_and_determinism(tmp_path):
+    def build(root):
+        fr = FlightRecorder(bundle_dir=str(root))
+        tr = Tracer(clock=lambda: 0.0)
+        fr.attach(tr)
+        tr.add_span("prefill", 1.0, 2.0, track="engine", rid="r1")
+        fr.sample("queue_depth", 7, 3.0, source="r0")
+        mon = SLOMonitor(
+            [ThresholdRule(name="deep", signal="queue_depth",
+                           bound=5.0)], source="r0", flight=fr)
+        mon.observe_value("queue_depth", 9, 4.0)
+        return fr, mon
+    fr, mon = build(tmp_path / "a")
+    assert len(fr.bundles_written) == 1
+    bdir = fr.bundles_written[0]
+    assert os.path.basename(bdir) == mon.log.incidents[0].id
+    for fn in ("incident.json", "trace.json", "metrics.jsonl",
+               "requests.json"):
+        assert os.path.exists(os.path.join(bdir, fn))
+    back = load_bundle(bdir)
+    assert back["incident"].rule == "deep"
+    names = [e.get("name") for e in back["trace_events"]]
+    assert "prefill" in names and "thread_name" in names
+    # ts scaled to microseconds like the real chrome export
+    span = [e for e in back["trace_events"]
+            if e.get("name") == "prefill"][0]
+    assert span["ts"] == 1e6 and span["dur"] == 2e6
+    assert [s["name"] for s in back["samples"]] \
+        == ["queue_depth", "queue_depth"]
+    assert back["rids"] == []
+    # determinism: an identical run writes byte-identical files
+    fr2, _ = build(tmp_path / "b")
+    for fn in ("incident.json", "trace.json", "metrics.jsonl",
+               "requests.json"):
+        with open(os.path.join(fr.bundles_written[0], fn), "rb") as f:
+            da = f.read()
+        with open(os.path.join(fr2.bundles_written[0], fn),
+                  "rb") as f:
+            db = f.read()
+        assert da == db, fn
+
+
+def test_bundle_torn_metrics_tail_warns(tmp_path):
+    fr = FlightRecorder(bundle_dir=str(tmp_path))
+    for i in range(3):
+        fr.sample("queue_depth", i, float(i))
+    mon = SLOMonitor([ThresholdRule(name="deep",
+                                    signal="queue_depth", bound=1.0)],
+                     flight=fr)
+    mon.observe_value("queue_depth", 2, 1.0)
+    bdir = fr.bundles_written[0]
+    mp = os.path.join(bdir, "metrics.jsonl")
+    with open(mp) as f:
+        lines = f.read().splitlines(True)
+    with open(mp, "w") as f:
+        f.writelines(lines[:-1])
+        f.write(lines[-1][: len(lines[-1]) // 2])
+    with pytest.warns(UserWarning, match="truncated"):
+        back = load_bundle(bdir)
+    assert len(back["samples"]) == len(lines) - 1
+    # an earlier tear is the wrong file, not a crash artifact
+    with open(mp, "w") as f:
+        f.write('{"nope\n')
+        f.writelines(lines[1:])
+    with pytest.raises(ValueError, match="malformed"):
+        load_bundle(bdir)
+
+
+def test_recorder_without_bundle_dir_is_ring_only(tmp_path):
+    fr = FlightRecorder()
+    mon = SLOMonitor([ThresholdRule(name="deep",
+                                    signal="queue_depth", bound=1.0)],
+                     flight=fr)
+    mon.observe_value("queue_depth", 5, 1.0)
+    assert len(mon.log) == 1
+    assert fr.bundles_written == []
+    # manual write still works, to an explicit directory
+    out = fr.write_bundle(mon.log.incidents[0],
+                          out_dir=str(tmp_path / "manual"))
+    assert os.path.exists(os.path.join(out, "incident.json"))
+    with open(os.path.join(out, "incident.json")) as f:
+        assert json.load(f)["rule"] == "deep"

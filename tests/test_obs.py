@@ -75,6 +75,98 @@ def test_prometheus_exposition_format():
     assert names == sorted(names)
 
 
+def _scrape_parse(text: str) -> dict:
+    """A minimal Prometheus text-format scrape parser (the consumer's
+    view): every sample line must be `name{labels} value` with a
+    preceding # TYPE for its family. Returns
+    {family: {"type":..., "samples": [(name, {labels}, value)]}}."""
+    import re
+    fams: dict = {}
+    cur = None
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, kind = ln.split(" ", 3)
+            cur = name
+            fams[name] = {"type": kind, "samples": []}
+            continue
+        m = re.fullmatch(
+            r'([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="[^"]*",?)*)\})?'
+            r' (\S+)', ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        name, labels, value = m.group(1), m.group(2), m.group(3)
+        lab = {}
+        if labels:
+            for item in filter(None, labels.split('",')):
+                k, v = item.split('="', 1)
+                lab[k] = v.rstrip('"')
+        base = name
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[: -len(suf)] in fams:
+                base = name[: -len(suf)]
+        assert base in fams, f"sample {name} precedes its # TYPE"
+        fams[base]["samples"].append((name, lab, float(value)))
+    return fams
+
+
+def test_histogram_exposition_scrape_conformance():
+    """Satellite: the fixed-bucket histogram exposition against the
+    rules a Prometheus scrape enforces — cumulative buckets ending in
+    an explicit le="+Inf" equal to _count, monotone non-decreasing
+    counts, and _sum/_count lines per child."""
+    r = obs_metrics.MetricsRegistry()
+    h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0, 5.0),
+                    backend="paged")
+    for v in (0.05, 0.5, 0.7, 3.0, 99.0):  # 99.0 beyond every bound
+        h.observe(v)
+    fams = _scrape_parse(r.expose_text())
+    fam = fams["lat_seconds"]
+    assert fam["type"] == "histogram"
+    buckets = [(lab["le"], val) for name, lab, val in fam["samples"]
+               if name == "lat_seconds_bucket"]
+    # exposition order IS ascending le with +Inf last
+    assert [le for le, _ in buckets] == ["0.1", "1", "5", "+Inf"]
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts)          # cumulative-monotone
+    assert counts == [1.0, 3.0, 4.0, 5.0]
+    cnt = [val for name, lab, val in fam["samples"]
+           if name == "lat_seconds_count"]
+    sm = [val for name, lab, val in fam["samples"]
+          if name == "lat_seconds_sum"]
+    assert cnt == [5.0] and counts[-1] == cnt[0]  # +Inf == _count
+    assert sm[0] == pytest.approx(103.25)
+    # the child's own labels ride every bucket line
+    assert all(lab.get("backend") == "paged"
+               for name, lab, _ in fam["samples"])
+
+
+def test_histogram_exposition_golden_text():
+    """The exact exposition bytes, frozen: a scrape consumer diff
+    reads format drift here before a dashboard does."""
+    r = obs_metrics.MetricsRegistry()
+    h = r.histogram("q_seconds", "queue wait", buckets=(0.25, 2.0))
+    h.observe(0.1)
+    h.observe(1.0)
+    h.observe(9.0)
+    r.counter("n_total", "count", rule="x").inc(3)
+    golden = (
+        "# HELP n_total count\n"
+        "# TYPE n_total counter\n"
+        'n_total{rule="x"} 3\n'
+        "# HELP q_seconds queue wait\n"
+        "# TYPE q_seconds histogram\n"
+        'q_seconds_bucket{le="0.25"} 1\n'
+        'q_seconds_bucket{le="2"} 2\n'
+        'q_seconds_bucket{le="+Inf"} 3\n'
+        "q_seconds_sum 10.1\n"
+        "q_seconds_count 3\n")
+    assert r.expose_text() == golden
+
+
 def test_jsonl_snapshot_round_trip(tmp_path):
     r = obs_metrics.MetricsRegistry()
     r.counter("n_total").inc(7)
@@ -119,6 +211,46 @@ def test_tracer_chrome_export_schema(tmp_path):
     # async pair balanced
     assert sum(1 for e in evts if e["ph"] == "b") == \
         sum(1 for e in evts if e["ph"] == "e") == 1
+
+
+@pytest.mark.parametrize("virtual", [True, False])
+def test_counter_series_flushed_on_export(virtual, tmp_path):
+    """Satellite: counter samples survive export() even when the LAST
+    sample precedes the final span — a counter series must never be
+    dropped or reordered relative to its record order just because a
+    later span closed after it. Both clock types: a virtual fixed
+    clock (explicit timestamps) and the wall clock (tracer-stamped)."""
+    if virtual:
+        t = obs_trace.Tracer(clock=lambda: 10.0)
+        stamps = {"t": 1.0}
+        t.counter("queue_depth", 1, t=0.5)
+        t.add_span("turn0", 0.6, 0.2, track="engine")
+        t.counter("queue_depth", 3, t=1.0)
+        # the final span STARTS after the last counter sample and is
+        # recorded last
+        t.add_span("turn1", 2.0, 4.0, track="engine")
+    else:
+        t = obs_trace.Tracer()  # wall clock
+        t.counter("queue_depth", 1)
+        t.add_span("turn0", t.now(), 0.0, track="engine")
+        t.counter("queue_depth", 3)
+        t.add_span("turn1", t.now(), 0.0, track="engine")
+    p = tmp_path / "tr.json"
+    t.export(str(p))
+    evts = json.loads(p.read_text())["traceEvents"]
+    ctrs = [e for e in evts if e.get("ph") == "C"]
+    spans = [e for e in evts if e.get("ph") == "X"]
+    # every sample exported, values in record order, none coalesced
+    assert [e["args"]["value"] for e in ctrs] == [1, 3]
+    assert [e["name"] for e in spans] == ["turn0", "turn1"]
+    # the last counter's timestamp precedes the final span's close;
+    # export preserved the samples anyway (no tail-flush loss)
+    last_span = spans[-1]
+    assert ctrs[-1]["ts"] <= last_span["ts"] + last_span["dur"]
+    # counters land on their own track with metadata bound to it
+    tracks = {e["tid"]: e["args"]["name"] for e in evts
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert all(tracks[e["tid"]] == "counters" for e in ctrs)
 
 
 def test_trace_scope_tags_trace_id():

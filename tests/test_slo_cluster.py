@@ -1,0 +1,406 @@
+"""SLO watchdog threaded through engine, session, cluster and faults
+(PR 9): monitor transparency (byte-identical outputs/logs/records
+monitor-on vs off), ServeResult/ClusterResult incident surfaces,
+exactly-once crash/stall/decode-error incidents on a seeded fault
+plan with zero fault-free false positives and byte-identical replays,
+heartbeat-silence detection racing the router's own detector,
+drain/join membership changes, retry-budget exhaustion incidents,
+cluster-level flight-recorder bundles, the slo_report tool rows, and
+the bench_gate obs_slo family (pass + graceful FAIL rows through the
+real subprocess)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs.flight import FlightRecorder
+from paddle_tpu.obs.slo import (BurnRateRule, HeartbeatRule,
+                                SLOMonitor, ThresholdRule,
+                                load_incidents)
+from paddle_tpu.serving import (ClusterRouter, FailoverConfig,
+                                FaultEvent, FaultPlan, QoSScheduler,
+                                Request, ServingEngine,
+                                make_sim_serving)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill_unit": 1.0, "decode": 1.0}
+
+
+def _sim(slots=4, extra=8, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("vocab", 211)
+    kw.setdefault("n_pool_pages",
+                  slots * (kw["max_len"] // kw["page_size"]) + 1 + extra)
+    return make_sim_serving(slots=slots, **kw)
+
+
+def _engine(slots=4, scheduler=None, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", COSTS)
+    return ServingEngine(serving=_sim(slots=slots), slots=slots,
+                         policy="paged", scheduler=scheduler, **kw)
+
+
+def _req(rid, arrival, prompt, budget, **kw):
+    return Request(rid=rid, arrival=arrival, prompt=tuple(prompt),
+                   max_new_tokens=budget, **kw)
+
+
+def _trace(n=24, seed=3, gap=0.7, plen=10, budget=8, **kw):
+    rng = np.random.default_rng(seed)
+    return [_req(f"m{i}", i * gap,
+                 [int(t) for t in rng.integers(1, 211, plen)],
+                 budget, **kw) for i in range(n)]
+
+
+def _cluster(trace, n=2, faults=None, failover=None, slo=None,
+             flight=None, events=(), slots=4, qos=True, **kw):
+    def spawn(name):
+        return _engine(slots=slots,
+                       scheduler=QoSScheduler(max_queue=4 * slots)
+                       if qos else None)
+    if faults is not None and failover is None:
+        failover = FailoverConfig(heartbeat_interval=1.0,
+                                  heartbeat_timeout=3.0,
+                                  backoff_base=0.5)
+    return ClusterRouter(spawn, n, placement="round_robin",
+                         faults=faults, failover=failover, slo=slo,
+                         flight=flight, **kw).run(trace,
+                                                  events=events)
+
+
+def _res_fingerprint(res):
+    return (res.outputs,
+            res.slot_log,
+            res.decisions,
+            res.metrics.request_rows(),
+            res.report())
+
+
+# --- engine-level wiring ----------------------------------------------------
+
+def test_engine_monitor_transparent_and_banks_incidents():
+    tr = _trace(n=20, gap=0.2)
+    base = _engine().run(tr)
+    rules = [ThresholdRule(name="deep", signal="queue_depth",
+                           bound=3.0)]
+    mon_res = _engine(slo=rules).run(tr)
+    assert base.incidents is None
+    assert mon_res.incidents is not None
+    assert _res_fingerprint(base) == _res_fingerprint(mon_res)
+    # bursty arrivals against a slow fixed clock queue deep enough to
+    # breach; recovery closes the episode
+    assert any(i.kind == "threshold" for i in mon_res.incidents)
+
+
+def test_engine_rules_build_fresh_monitor_per_run():
+    eng = _engine(slo=[ThresholdRule(name="deep",
+                                     signal="queue_depth",
+                                     bound=3.0)])
+    a = eng.run(_trace(n=20, gap=0.2))
+    b = eng.run(_trace(n=20, gap=0.2))
+    # same trace, fresh monitor: identical incident sets, not doubled
+    assert [i.to_json() for i in a.incidents] \
+        == [i.to_json() for i in b.incidents]
+    assert len(a.incidents) >= 1
+
+
+def test_engine_monitor_instance_and_validation():
+    mon = SLOMonitor([ThresholdRule(name="deep",
+                                    signal="queue_depth", bound=3.0)])
+    eng = _engine(slo=mon)
+    res = eng.run(_trace(n=20, gap=0.2))
+    assert res.incidents == mon.incidents
+    # a caller-held monitor RESETS per run (the trace=Tracer
+    # convention): a second replay fires identically instead of going
+    # blind behind the first run's advanced windows / re-reporting
+    # its incidents
+    res2 = eng.run(_trace(n=20, gap=0.2))
+    assert [i.to_json() for i in res2.incidents] \
+        == [i.to_json() for i in res.incidents]
+    with pytest.raises(ValueError, match="slo"):
+        _engine(slo="yes please")
+
+
+def test_session_inherits_engine_slo_spec():
+    # both run paths see the same watchdog config: a session over an
+    # slo=rules engine monitors without re-passing slo=
+    eng = _engine(slo=[ThresholdRule(name="deep",
+                                     signal="queue_depth",
+                                     bound=3.0)])
+    sess = eng.session()
+    for r in _trace(n=20, gap=0.2):
+        sess.clock.advance_to(r.arrival)
+        sess.submit(r)
+        sess.advance_until(r.arrival)
+    res = sess.finish()
+    assert res.incidents is not None
+    assert any(i.rule == "deep" for i in res.incidents)
+    # explicit slo=None... is the default; an unmonitored engine's
+    # session stays unmonitored
+    assert _engine().session().finish().incidents is None
+
+
+def test_lane_depth_signal_reaches_monitor():
+    # the async prefill lane's depth is a first-class SLO signal
+    rules = [ThresholdRule(name="lane_backlog",
+                           signal="prefill_lane_depth", bound=1.0)]
+    rng = np.random.default_rng(0)
+    tr = [_req(f"L{i}", 0.1 * i,
+               [int(t) for t in rng.integers(1, 211, 24)], 4)
+          for i in range(8)]
+    res = _engine(slots=4, prefill_chunk_budget=1, slo=rules).run(tr)
+    assert any(i.rule == "lane_backlog" for i in res.incidents)
+
+
+def test_qos_shed_burn_fires_at_engine_level():
+    rules = [BurnRateRule(name="shed_burn", objective=0.9,
+                          windows=((8.0, 3.0), (3.0, 3.0)),
+                          bad="shed", min_events=4, severity="warn")]
+    # a queue bound of 2 under a burst sheds most of the wave
+    tr = _trace(n=30, gap=0.05, budget=6)
+    res = _engine(scheduler=QoSScheduler(max_queue=2),
+                  slo=rules).run(tr)
+    assert len(res.shed) > 0
+    fired = [i for i in res.incidents if i.rule == "shed_burn"]
+    assert fired and fired[0].rids  # offending rids attached
+
+
+# --- cluster wiring ---------------------------------------------------------
+
+def _plan2():
+    return FaultPlan([
+        FaultEvent(t=4.0, kind="stall", replica="r1", duration=2.5),
+        FaultEvent(t=6.0, kind="crash", replica="r0"),
+        FaultEvent(t=8.0, kind="decode_error", replica="r1"),
+    ])
+
+
+def test_cluster_chaos_incidents_exactly_once_and_transparent():
+    tr = _trace(n=40, gap=0.35)
+    off = _cluster(tr, n=2, faults=_plan2())
+    on = _cluster(tr, n=2, faults=_plan2(), slo=[])
+    assert off.incidents is None and on.incidents is not None
+    # the monitor changes NOTHING it watches
+    assert off.outputs() == on.outputs()
+    assert {k: off.results[k].slot_log for k in off.results} \
+        == {k: on.results[k].slot_log for k in on.results}
+    assert {k: off.results[k].metrics.request_rows()
+            for k in off.results} \
+        == {k: on.results[k].metrics.request_rows()
+            for k in on.results}
+    assert off.report() == on.report()
+    kinds = on.slo_log.by_kind()
+    assert kinds["crash"] == 1
+    assert kinds["stall"] == 1
+    assert kinds["decode_error"] == 1
+    assert kinds["failover"] == 1
+    crash = [i for i in on.incidents if i.kind == "crash"][0]
+    assert crash.source == "r0" and not crash.open
+    assert crash.resolution == "failover"
+    stall = [i for i in on.incidents if i.kind == "stall"][0]
+    assert stall.t_close == pytest.approx(stall.t_open + 2.5)
+    # per-replica ServeResult banks only its OWN incidents
+    assert all(i.source == "r0"
+               for i in on.results["r0"].incidents)
+    # determinism: a second replay byte-matches
+    on2 = _cluster(tr, n=2, faults=_plan2(), slo=[])
+    assert [i.to_json() for i in on.incidents] \
+        == [i.to_json() for i in on2.incidents]
+
+
+def test_cluster_fault_free_fires_nothing():
+    from paddle_tpu.obs.slo import default_serving_rules
+    res = _cluster(_trace(n=40, gap=0.35), n=2,
+                   slo=default_serving_rules())
+    assert res.incidents == []
+
+
+def test_heartbeat_rule_detects_crash_before_router():
+    # monitor silence threshold (2.0) beats the router's detector
+    # (3.0): the silence incident opens first, then failover retires
+    # the source and closes it
+    rules = [HeartbeatRule(name="silent", timeout=2.0)]
+    res = _cluster(_trace(n=40, gap=0.35), n=2,
+                   faults=FaultPlan([FaultEvent(t=6.0, kind="crash",
+                                                replica="r0")]),
+                   slo=rules)
+    silence = [i for i in res.incidents
+               if i.kind == "heartbeat_silence"]
+    assert len(silence) == 1 and silence[0].source == "r0"
+    crash = [i for i in res.incidents if i.kind == "crash"][0]
+    dead_t = [e for e in res.events if e["event"] == "dead"][0]["t"]
+    assert crash.t_open <= silence[0].t_open <= dead_t
+    assert not silence[0].open
+    # and a live-but-stalled replica never trips it (slow != dead)
+    res2 = _cluster(_trace(n=40, gap=0.35), n=2,
+                    faults=FaultPlan([FaultEvent(t=6.0, kind="stall",
+                                                 replica="r1",
+                                                 duration=8.0)]),
+                    slo=rules)
+    assert [i.kind for i in res2.incidents] == ["stall"]
+
+
+def test_membership_drain_and_join():
+    tr = _trace(n=40, gap=0.35)
+    # r0 drains mid-trace while its crash-free monitor holds an open
+    # threshold incident -> retirement closes it "replica_removed";
+    # a joiner gets a monitor at join time and a later fault on IT
+    # opens an incident under its name
+    rules = [ThresholdRule(name="deep", signal="queue_depth",
+                           bound=0.0)]  # always breached: stays open
+    plan = FaultPlan([FaultEvent(t=9.0, kind="stall", replica="rj",
+                                 duration=1.0)])
+    res = _cluster(tr, n=2, slo=rules, faults=plan,
+                   events=[(5.0, "join", "rj"), (6.0, "drain", "r0")])
+    assert any(e["event"] == "join" for e in res.events)
+    r0_closed = [i for i in res.incidents
+                 if i.source == "r0" and i.kind == "threshold"]
+    assert r0_closed and all(
+        i.resolution == "replica_removed" for i in r0_closed)
+    assert any(i.source == "rj" and i.kind == "stall"
+               for i in res.incidents)
+
+
+def test_retry_exhausted_opens_cluster_incident():
+    plan = FaultPlan([FaultEvent(t=6.0, kind="crash", replica="r0")])
+    res = _cluster(_trace(n=40, gap=0.35), n=2, faults=plan,
+                   failover=FailoverConfig(heartbeat_interval=1.0,
+                                           heartbeat_timeout=3.0,
+                                           retry_budget=0),
+                   slo=[])
+    assert res.failed  # budget 0: everything the crash tore loose
+    exhausted = [i for i in res.incidents
+                 if i.kind == "retry_exhausted"]
+    assert exhausted and all(i.source == "cluster"
+                             for i in exhausted)
+    assert sorted(r for i in exhausted for r in i.rids) \
+        == sorted(res.failed)
+
+
+def test_cluster_flight_bundles_on_crash(tmp_path):
+    plan = FaultPlan([FaultEvent(t=6.0, kind="crash", replica="r0")])
+    res = _cluster(_trace(n=40, gap=0.35), n=2, faults=plan,
+                   slo=[], flight=str(tmp_path))
+    assert isinstance(res.flight, FlightRecorder)
+    written = res.flight.bundles_written
+    # one bundle per incident (crash + failover at least)
+    assert len(written) == len(res.incidents) >= 2
+    ids = {os.path.basename(p) for p in written}
+    assert ids == {i.id for i in res.incidents}
+    inc_path = str(tmp_path / "incidents.jsonl")
+    res.save_incidents(inc_path)
+    assert [i.id for i in load_incidents(inc_path)] \
+        == [i.id for i in res.incidents]
+
+
+def test_cluster_slo_validation():
+    def spawn(name):
+        return _engine()
+    with pytest.raises(ValueError, match="RULES"):
+        ClusterRouter(spawn, 2, slo=SLOMonitor([]))
+    with pytest.raises(ValueError, match="flight= needs slo="):
+        ClusterRouter(spawn, 2, flight="/tmp/x")
+    # a plain router result has no incident log to save
+    res = _cluster(_trace(n=6), n=2)
+    with pytest.raises(ValueError, match="without an SLO monitor"):
+        res.save_incidents("/tmp/nope.jsonl")
+
+
+# --- tools: slo_report + bench gate -----------------------------------------
+
+def test_slo_report_rows_and_bundles(tmp_path):
+    plan = _plan2()
+    res = _cluster(_trace(n=40, gap=0.35), n=2, faults=plan,
+                   slo=[BurnRateRule(name="shed_burn", objective=0.9,
+                                     windows=((8.0, 3.0), (3.0, 3.0)),
+                                     bad="shed", min_events=4,
+                                     severity="warn")],
+                   flight=str(tmp_path / "bundles"))
+    inc_path = str(tmp_path / "incidents.jsonl")
+    res.save_incidents(inc_path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/slo_report.py"),
+         inc_path, "--bundles", str(tmp_path / "bundles"), "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    # the global row is LAST (consumers read the final line)
+    assert rows[-1]["bench"] == "slo_report"
+    assert rows[-1]["incidents"] == len(res.incidents)
+    assert rows[-1]["bundles"] == len(res.incidents)
+    assert rows[-1]["bundles_complete"] == len(res.incidents)
+    kinds = {r["rule"]: r for r in rows
+             if r["bench"] == "slo_report_rule"}
+    assert "crash" in kinds and kinds["crash"]["incidents"] == 1
+    # the human rendering exercises the same loader
+    txt = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/slo_report.py"),
+         inc_path], capture_output=True, text=True, cwd=REPO)
+    assert txt.returncode == 0 and "incident timeline" in txt.stdout
+
+
+def _gate_obs(rows):
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/bench_gate.py"),
+         "obs", "-"],
+        input="\n".join(json.dumps(r) for r in rows),
+        capture_output=True, text=True, cwd=REPO)
+    out = [json.loads(ln) for ln in p.stdout.splitlines()
+           if ln.startswith("{")]
+    return p.returncode, out
+
+
+def _slo_summary(**over):
+    row = {"bench": "obs_slo_summary", "device": "sim",
+           "crashes_injected": 1, "stalls_injected": 2,
+           "crash_incidents": 1, "stall_incidents": 2,
+           "detected_exactly_once": True, "fault_free_incidents": 0,
+           "incidents_total": 6, "incidents_loaded": 6,
+           "incidents_byte_identical": True,
+           "bundles_byte_identical": True,
+           "bundle_files_compared": 24,
+           "outputs_identical": True, "slot_logs_identical": True,
+           "metrics_records_identical": True,
+           "cluster_report_identical": True,
+           "by_kind": {"crash": 1, "stall": 2}}
+    row.update(over)
+    return row
+
+
+def test_bench_gate_obs_slo_family():
+    rc, out = _gate_obs([_slo_summary()])
+    assert rc == 0 and out[-1]["gate"] == "pass"
+    # every clause fails loudly, never a traceback
+    for bad, needle in (
+            ({"detected_exactly_once": False,
+              "crash_incidents": 0}, "exactly-once"),
+            ({"fault_free_incidents": 3}, "false-positive"),
+            ({"incidents_byte_identical": False}, "DIFFERENT"),
+            ({"bundle_files_compared": 0}, "not recording"),
+            ({"outputs_identical": False}, "changed"),
+            ({"incidents_total": 0}, "ZERO"),
+            ({"incidents_loaded": 5}, "round-trip")):
+        rc, out = _gate_obs([_slo_summary(**bad)])
+        assert rc == 1, bad
+        assert needle in out[-1]["reason"], bad
+    # monitor overhead riding the obs_overhead row is gated too —
+    # several families present prints a combined verdict LAST
+    over = {"bench": "obs_overhead", "noobs_wall_s": 1.0,
+            "off_wall_s": 1.01, "on_wall_s": 1.1, "tokens_match": True,
+            "overhead_slo": 0.15}
+    rc, out = _gate_obs([over, _slo_summary()])
+    assert rc == 1
+    assert out[-1].get("combined") is True
+    assert out[-1]["slo_gate"] == "FAIL"
+    over["overhead_slo"] = 0.01
+    rc, out = _gate_obs([over, _slo_summary()])
+    assert rc == 0 and out[-1]["gate"] == "pass"
+    # graceful no-summary FAIL
+    rc, out = _gate_obs([{"bench": "obs_slo", "arm": "x"}])
+    assert rc == 1 and "no obs_slo_summary" in out[0]["reason"]

@@ -1,0 +1,381 @@
+"""obs.slo units (PR 9): rule validation, streaming threshold /
+multi-window burn-rate / heartbeat-silence evaluation, incident
+lifecycle (open/close/re-arm, deterministic ids), the IncidentLog
+JSONL round-trip with the shared torn-tail tolerance, the event
+auto-open path, the QoSScheduler subscription seam, and the
+``percentile`` satellite (one public helper, defined small-n
+semantics)."""
+import json
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs.slo import (BurnRateRule, HeartbeatRule, Incident,
+                                IncidentLog, SLOMonitor,
+                                ThresholdRule, default_serving_rules,
+                                load_incidents)
+from paddle_tpu.serving.metrics import percentile
+from paddle_tpu.serving.scheduler import QoSScheduler
+
+
+def _view(rid="a", *, met=True, shed=False, reason=None, ttft=None,
+          tpot=None):
+    return {"rid": rid, "deadline_met": met, "shed": shed,
+            "finish_reason": "shed" if shed else reason,
+            "ttft": ttft, "tpot": tpot}
+
+
+# --- rule validation --------------------------------------------------------
+
+def test_rule_validation():
+    with pytest.raises(ValueError, match="op"):
+        ThresholdRule(name="t", signal="q", bound=1.0, op="==")
+    with pytest.raises(ValueError, match="severity"):
+        ThresholdRule(name="t", signal="q", bound=1.0,
+                      severity="panic")
+    with pytest.raises(ValueError, match="objective"):
+        BurnRateRule(name="b", objective=1.0)
+    with pytest.raises(ValueError, match="bad="):
+        BurnRateRule(name="b", objective=0.9, bad="explosions")
+    with pytest.raises(ValueError, match="window"):
+        BurnRateRule(name="b", objective=0.9, windows=((0.0, 1.0),))
+    with pytest.raises(ValueError, match="timeout"):
+        HeartbeatRule(name="h", timeout=0.0)
+    with pytest.raises(ValueError, match="unique"):
+        SLOMonitor([HeartbeatRule(name="x", timeout=1.0),
+                    HeartbeatRule(name="x", timeout=2.0)])
+    with pytest.raises(ValueError, match="rule type"):
+        SLOMonitor([object()])
+    assert BurnRateRule(name="b", objective=0.9).budget \
+        == pytest.approx(0.1)
+
+
+# --- threshold rules --------------------------------------------------------
+
+def test_threshold_fires_recovers_and_rearms():
+    mon = SLOMonitor([ThresholdRule(name="deep", signal="queue_depth",
+                                    bound=5.0)])
+    mon.observe_value("queue_depth", 3, 1.0)
+    assert len(mon.log) == 0
+    mon.observe_value("queue_depth", 7, 2.0)
+    assert len(mon.log) == 1
+    inc = mon.log.incidents[0]
+    assert inc.kind == "threshold" and inc.open
+    assert inc.evidence["value"] == 7
+    # still breached: the OPEN incident absorbs it (no re-fire)
+    mon.observe_value("queue_depth", 9, 3.0)
+    assert len(mon.log) == 1
+    # recovery closes; the next breach is a NEW incident
+    mon.observe_value("queue_depth", 2, 4.0)
+    assert not inc.open and inc.resolution == "recovered"
+    mon.observe_value("queue_depth", 8, 5.0)
+    assert len(mon.log) == 2
+
+
+def test_threshold_sustained_for_units():
+    mon = SLOMonitor([ThresholdRule(name="deep", signal="queue_depth",
+                                    bound=5.0, for_units=3.0)])
+    mon.observe_value("queue_depth", 7, 1.0)
+    assert len(mon.log) == 0          # breached, not yet sustained
+    mon.advance(2.0)
+    assert len(mon.log) == 0
+    mon.advance(4.0)                  # 3 units after breach start
+    assert len(mon.log) == 1
+    assert mon.log.incidents[0].evidence["breach_since"] == 1.0
+    # a dip resets the episode clock
+    mon2 = SLOMonitor([ThresholdRule(name="deep",
+                                     signal="queue_depth",
+                                     bound=5.0, for_units=3.0)])
+    mon2.observe_value("queue_depth", 7, 1.0)
+    mon2.observe_value("queue_depth", 1, 2.0)
+    mon2.observe_value("queue_depth", 7, 2.5)
+    mon2.advance(4.0)
+    assert len(mon2.log) == 0         # only 1.5 units sustained
+
+
+def test_threshold_sustained_breach_ending_at_next_sample():
+    # the breach's END is the first evaluation point (no unrelated
+    # traffic advanced the clock mid-episode): a 10-unit breach with
+    # for_units=5 must STILL fire — retroactively, at the recovery
+    # sample — and close there
+    mon = SLOMonitor([ThresholdRule(name="deep", signal="queue_depth",
+                                    bound=64.0, for_units=5.0)])
+    mon.observe_value("queue_depth", 80, 0.0)
+    mon.observe_value("queue_depth", 10, 10.0)
+    assert len(mon.log) == 1
+    inc = mon.log.incidents[0]
+    assert not inc.open and inc.resolution == "recovered"
+    assert inc.t_open == inc.t_close == 10.0
+    assert inc.evidence["value"] == 80.0       # the breaching value
+    assert inc.evidence["breach_since"] == 0.0
+    # a SHORT episode ending at the next sample stays silent
+    mon2 = SLOMonitor([ThresholdRule(name="deep",
+                                     signal="queue_depth",
+                                     bound=64.0, for_units=5.0)])
+    mon2.observe_value("queue_depth", 80, 0.0)
+    mon2.observe_value("queue_depth", 10, 2.0)
+    assert len(mon2.log) == 0
+
+
+def test_threshold_on_request_field():
+    mon = SLOMonitor([ThresholdRule(name="slow_ttft", signal="ttft",
+                                    bound=10.0)])
+    mon.observe_request(_view("r1", ttft=2.0), 1.0)
+    assert len(mon.log) == 0
+    mon.observe_request(_view("r2", ttft=30.0), 2.0)
+    assert len(mon.log) == 1
+    assert mon.log.incidents[0].rids == ["r2"]
+
+
+# --- burn-rate rules --------------------------------------------------------
+
+def _burn_rule(**kw):
+    kw.setdefault("name", "burn")
+    kw.setdefault("objective", 0.9)      # 10% error budget
+    kw.setdefault("windows", ((10.0, 5.0), (4.0, 5.0)))
+    kw.setdefault("min_events", 4)
+    return BurnRateRule(**kw)
+
+
+def test_burn_rate_fires_only_when_all_windows_burn():
+    mon = SLOMonitor([_burn_rule()])
+    # 4 bad of 4 in both windows: burn = 1.0/0.1 = 10 >= 5 -> fire
+    for i in range(4):
+        mon.observe_request(_view(f"r{i}", met=False), 1.0 + i)
+    assert len(mon.log) == 1
+    inc = mon.log.incidents[0]
+    assert inc.kind == "burn_rate" and inc.severity == "page"
+    wins = inc.evidence["windows"]
+    assert all(w["burn"] >= w["threshold"] for w in wins)
+    assert inc.rids == [f"r{i}" for i in range(4)]
+
+
+def test_burn_rate_respects_min_events_and_short_window():
+    mon = SLOMonitor([_burn_rule()])
+    # 3 bad: below min_events, silent no matter how bad the rate
+    for i in range(3):
+        mon.observe_request(_view(f"r{i}", met=False), 1.0 + i)
+    assert len(mon.log) == 0
+    # an OLD error storm outside the short window must not fire:
+    # 4 bad at t~1-2, then good traffic; at t=20 the short window
+    # (4 units) holds only good events
+    mon2 = SLOMonitor([_burn_rule()])
+    for i in range(4):
+        mon2.observe_request(_view(f"b{i}", met=False), 1.0 + 0.2 * i)
+    # already fired at t~1.6 (both windows bad); close it via recovery
+    for i in range(8):
+        mon2.observe_request(_view(f"g{i}", met=True), 17.0 + 0.2 * i)
+    assert len(mon2.log) == 1
+    assert not mon2.log.incidents[0].open
+    assert mon2.log.incidents[0].resolution == "burn_recovered"
+
+
+def test_burn_rate_shed_predicate_and_budget_evidence():
+    mon = SLOMonitor([_burn_rule(bad="shed", severity="warn")])
+    for i in range(2):
+        mon.observe_request(_view(f"ok{i}", met=True), 1.0 + i)
+    for i in range(6):
+        mon.observe_request(_view(f"s{i}", shed=True, met=False),
+                            3.0 + 0.1 * i)
+    # fires at the SECOND shed (4 events, 2 bad: burn 5.0 crosses the
+    # threshold with min_events met) and stays one open incident no
+    # matter how many more sheds pile on
+    assert len(mon.log) == 1
+    ev = mon.log.incidents[0].evidence
+    assert ev["cum_events"] == 4 and ev["cum_bad"] == 2
+    # budget_spent = cum_bad / (cum_events * (1 - objective))
+    assert ev["budget_spent"] == pytest.approx(
+        ev["cum_bad"] / (ev["cum_events"] * 0.1))
+
+
+def test_burn_rate_rids_exclude_recovered_bursts():
+    # a brief bad burst that recovers must not pollute a much later
+    # incident's offending-rid list (the postmortem pointer)
+    mon = SLOMonitor([_burn_rule()])
+    mon.observe_request(_view("old0", met=False), 1.0)
+    for i in range(20):
+        mon.observe_request(_view(f"good{i}", met=True), 2.0 + i)
+    assert len(mon.log) == 0          # 1-of-N never burns enough
+    for i in range(8):
+        mon.observe_request(_view(f"new{i}", met=False),
+                            100.0 + 0.1 * i)
+    assert len(mon.log) == 1
+    rids = mon.log.incidents[0].rids
+    assert rids and all(r.startswith("new") for r in rids)
+
+
+def test_monitor_reset_starts_a_fresh_session():
+    mon = SLOMonitor([_burn_rule()])
+    for i in range(4):
+        mon.observe_request(_view(f"a{i}", met=False), 1000.0 + i)
+    assert len(mon.log) == 1
+    mon.reset()
+    assert len(mon.log) == 0 and mon.t == 0.0
+    # a SECOND replay's low timestamps evaluate from scratch — the
+    # previous run's advanced clock must not blind the windows
+    for i in range(4):
+        mon.observe_request(_view(f"b{i}", met=False), 1.0 + i)
+    assert len(mon.log) == 1
+    assert mon.log.incidents[0].rids == [f"b{i}" for i in range(4)]
+
+
+# --- heartbeat silence ------------------------------------------------------
+
+def test_heartbeat_silence_fires_once_and_resumes():
+    mon = SLOMonitor([HeartbeatRule(name="hb", timeout=5.0)],
+                     source="r0")
+    mon.heartbeat(1.0)
+    mon.advance(5.9)
+    assert len(mon.log) == 0
+    mon.advance(6.0)                  # silent for 5.0
+    assert len(mon.log) == 1
+    inc = mon.log.incidents[0]
+    assert inc.kind == "heartbeat_silence" and inc.source == "r0"
+    mon.advance(8.0)                  # still silent: same incident
+    assert len(mon.log) == 1 and inc.open
+    mon.heartbeat(9.0)                # back: closes + re-arms
+    assert not inc.open and inc.resolution == "heartbeat_resumed"
+    mon.advance(14.5)
+    assert len(mon.log) == 2
+
+
+def test_any_signal_counts_as_liveness():
+    # a replica emitting metrics is alive even if nobody probes it
+    mon = SLOMonitor([HeartbeatRule(name="hb", timeout=5.0)])
+    mon.observe_value("queue_depth", 1, 4.0)
+    mon.observe_request(_view("a"), 8.0)
+    mon.advance(12.0)
+    assert len(mon.log) == 0          # never 5 silent units
+
+
+# --- events, retirement, callbacks ------------------------------------------
+
+def test_event_auto_open_close_and_close_kind():
+    mon = SLOMonitor([], source="r1")
+    stall = mon.event("stall", 2.0, severity="warn", close_t=6.0,
+                      evidence={"duration": 4.0})
+    crash = mon.event("crash", 3.0)
+    point = mon.event("decode_error", 4.0, severity="warn",
+                      close_t=4.0, rids=["x"])
+    assert point is not None and not point.open
+    assert stall.open and crash.open
+    mon.advance(6.0)                  # the stall's scheduled close
+    assert not stall.open and stall.resolution == "event_complete"
+    assert mon.close_kind("crash", 7.0, "failover") == 1
+    assert crash.resolution == "failover"
+    with pytest.raises(ValueError, match="severity"):
+        mon.event("crash", 1.0, severity="meh")
+
+
+def test_retire_closes_and_silences():
+    mon = SLOMonitor([HeartbeatRule(name="hb", timeout=2.0)],
+                     source="r0")
+    inc = mon.event("crash", 1.0)
+    mon.retire(2.0, resolution="failover")
+    assert not inc.open and inc.resolution == "failover"
+    # a retired monitor evaluates nothing and opens nothing
+    mon.advance(99.0)
+    assert mon.event("crash", 100.0) is None
+    mon.observe_value("queue_depth", 50, 101.0)
+    assert len(mon.log) == 1
+
+
+def test_incident_ids_deterministic_and_shared_log():
+    log = IncidentLog()
+    a = SLOMonitor([], source="r0", log=log)
+    b = SLOMonitor([], source="r1", log=log)
+    a.event("crash", 1.0)
+    b.event("stall", 2.0, severity="warn", close_t=3.0)
+    a.event("failover", 4.0, close_t=4.0)
+    assert [i.id for i in log] == ["inc-0000", "inc-0001", "inc-0002"]
+    assert log.by_kind() == {"crash": 1, "failover": 1, "stall": 1}
+
+
+def test_qos_scheduler_subscription_seam():
+    sched = QoSScheduler()
+    mon = SLOMonitor([], source="r0",
+                     on_incident=[sched.note_incident])
+    mon.event("crash", 1.0)
+    mon.event("stall", 2.0, severity="warn", close_t=3.0)
+    assert [i.kind for i in sched.incidents_seen] == ["crash",
+                                                      "stall"]
+    # detect-and-report only: a noted incident changes NO admission
+    # arithmetic (reset leaves the history in place, queue untouched)
+    sched.reset()
+    assert len(sched.incidents_seen) == 2
+    assert sched.waiting() == 0
+    # late subscription works too
+    seen = []
+    mon.subscribe(seen.append)
+    mon.event("decode_error", 4.0, severity="warn", close_t=4.0)
+    assert len(seen) == 1
+
+
+# --- persistence (satellite: tolerant JSONL) --------------------------------
+
+def test_incident_log_roundtrip_and_torn_tail(tmp_path):
+    log = IncidentLog()
+    mon = SLOMonitor([_burn_rule()], source="r0", log=log)
+    for i in range(4):
+        mon.observe_request(_view(f"r{i}", met=False), 1.0 + i)
+    mon.event("crash", 9.0)
+    # parents are created (framework/io.py save discipline): dumping
+    # into a fresh output tree must not crash after a long replay
+    path = str(tmp_path / "fresh" / "tree" / "incidents.jsonl")
+    log.save(path)
+    back = load_incidents(path)
+    assert [i.to_json() for i in back] \
+        == [i.to_json() for i in log]
+    assert isinstance(back[0], Incident)
+    # torn FINAL line: warn + valid prefix (the crash-written file)
+    with open(path) as f:
+        lines = f.read().splitlines(True)
+    with open(path, "w") as f:
+        f.writelines(lines[:-1])
+        f.write(lines[-1][: len(lines[-1]) // 2])
+    with pytest.warns(UserWarning, match="truncated"):
+        assert len(load_incidents(path)) == len(log) - 1
+    # a MID-file tear is not a torn tail: loud error
+    with open(path, "w") as f:
+        f.write('{"broken\n')
+        f.writelines(lines[1:])
+    with pytest.raises(ValueError, match="malformed"):
+        load_incidents(path)
+
+
+def test_default_serving_rules_shape():
+    rules = default_serving_rules(queue_bound=64)
+    kinds = sorted(type(r).__name__ for r in rules)
+    assert kinds == ["BurnRateRule", "BurnRateRule", "ThresholdRule"]
+    # the stock set is monitor-constructible as-is
+    SLOMonitor(rules)
+
+
+# --- percentile satellite ---------------------------------------------------
+
+def test_percentile_small_n_semantics():
+    assert percentile([], 50) is None
+    assert percentile(None, 95) is None
+    # n == 1: the value, for every q
+    assert percentile([7.0], 50) == 7.0
+    assert percentile([7.0], 95) == 7.0
+    # n == 2: linear interpolation between the two
+    assert percentile([0.0, 10.0], 50) == 5.0
+    assert percentile([0.0, 10.0], 95) == 9.5
+    # matches numpy on larger samples (the report paths' arithmetic)
+    xs = [3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0]
+    assert percentile(xs, 95) == pytest.approx(
+        round(float(np.percentile(np.asarray(xs), 95)), 6))
+
+
+def test_percentile_is_the_report_arithmetic():
+    # the collector's report percentiles go through the same helper
+    from paddle_tpu.serving.metrics import MetricsCollector
+    m = MetricsCollector()
+    m.on_arrival("a", 0.0)
+    m.on_admit("a", 1.0, "paged")
+    m.on_tokens("a", 2.0, 1)
+    m.on_finish("a", 3.0)
+    rec = m.report()
+    assert rec["ttft_p50"] == percentile([2.0], 50)
+    assert rec["e2e_p95"] == percentile([3.0], 95)

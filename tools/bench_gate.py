@@ -12,8 +12,8 @@ cannot silently ship a slower build. Three modes:
   python tools/bench_gate.py serving <fresh.jsonl> [--stamp]
   python tools/bench_gate.py obs <fresh.jsonl>
       # gate the OBSERVABILITY rows (tools/serving_workload_bench.py
-      # --obs-overhead / --trace-out). Two families, judged by
-      # whichever is present (both when both are; combined verdict
+      # --obs-overhead / --trace-out / --slo). Three families, judged
+      # by whichever is present (all that are; combined verdict
       # printed last):
       #  - obs_overhead: engine wall time with obs merged but tracing
       #    OFF must stay within 2% of the no-obs baseline arm measured
@@ -21,6 +21,13 @@ cannot silently ship a slower build. Three modes:
       #    nobody is looking.
       #  - obs_trace: a --trace-out run's span accounting must
       #    balance: every opened request root closed, events present.
+      #  - obs_slo: on the seeded chaos trace, the SLO watchdog must
+      #    detect every injected crash/stall as an incident exactly
+      #    once with ZERO fault-free false positives, incident JSONL
+      #    + postmortem bundles byte-identical across replays, engine
+      #    outputs/slot-logs/metrics untouched by the monitor, and
+      #    (when the obs_overhead row carries a monitor arm) the
+      #    monitor-on wall tax <= 2% over no-obs.
       # gate the SERVING rows. Two canonical families, judged by
       # whichever is present (both when both are):
       #  - spec_vs_plain_compiled (tools/spec_decode_bench.py):
@@ -872,9 +879,103 @@ def check_obs_trace(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+OBS_SLO_OVERHEAD_MAX = 0.02  # monitor-on tax allowed over no-obs
+
+
+def check_obs_slo(rows: list) -> int:
+    """Gate the obs_slo family (serving_workload_bench.py --slo): on
+    the seeded chaos trace the SLO watchdog must detect every injected
+    crash and stall as an incident EXACTLY once, fire NOTHING on the
+    fault-free replay, produce byte-identical incident JSONL and
+    postmortem bundles across two monitored runs (modulo paths), and
+    leave engine outputs / slot logs / metrics records byte-identical
+    to the monitor-off replay. When the input also carries an
+    obs_overhead row with a monitor arm (``overhead_slo``), that tax
+    is gated <= OBS_SLO_OVERHEAD_MAX alongside the tracing-off gate."""
+    rs = [r for r in rows if r.get("bench") == "obs_slo_summary"]
+    if not rs:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no obs_slo_summary row in input "
+                                    "(run tools/serving_workload_"
+                                    "bench.py --slo)"}))
+        return 1
+    r = rs[-1]
+    reasons = []
+    if not r.get("detected_exactly_once"):
+        reasons.append(
+            f"crash/stall detection not exactly-once: "
+            f"{r.get('crash_incidents')}/{r.get('crashes_injected')} "
+            f"crashes, "
+            f"{r.get('stall_incidents')}/{r.get('stalls_injected')} "
+            "stalls")
+    if r.get("fault_free_incidents", 1) != 0:
+        reasons.append(f"{r.get('fault_free_incidents')} "
+                       "false-positive incident(s) on the fault-free "
+                       "replay")
+    if not r.get("incidents_total"):
+        reasons.append("the chaos replay fired ZERO incidents — the "
+                       "watchdog is not watching")
+    if r.get("incidents_loaded") != r.get("incidents_total"):
+        reasons.append("incident JSONL did not round-trip "
+                       f"({r.get('incidents_loaded')} loaded of "
+                       f"{r.get('incidents_total')})")
+    if not r.get("incidents_byte_identical"):
+        reasons.append("two monitored replays produced DIFFERENT "
+                       "incident JSONL bytes")
+    if not r.get("bundles_byte_identical"):
+        reasons.append("postmortem bundles diverged across replays "
+                       f"(first diff: {r.get('bundle_first_diff')})")
+    elif r.get("incidents_total") \
+            and not r.get("bundle_files_compared"):
+        # two EMPTY bundle trees compare equal — with incidents fired
+        # that means the flight recorder wrote nothing, and the
+        # byte-identity clause silently tested nothing
+        reasons.append("incidents fired but zero bundle files were "
+                       "written/compared — the flight recorder is "
+                       "not recording")
+    for key in ("outputs_identical", "slot_logs_identical",
+                "metrics_records_identical",
+                "cluster_report_identical"):
+        if not r.get(key):
+            reasons.append(f"{key} is false — the monitor changed "
+                           "the system it watches")
+    overhead_slo = None
+    for o in rows:
+        if o.get("bench") == "obs_overhead" \
+                and o.get("overhead_slo") is not None:
+            overhead_slo = float(o["overhead_slo"])
+    if overhead_slo is not None \
+            and overhead_slo > OBS_SLO_OVERHEAD_MAX:
+        reasons.append(f"monitor-on wall {overhead_slo:.1%} over the "
+                       f"no-obs baseline (max "
+                       f"{OBS_SLO_OVERHEAD_MAX:.0%})")
+    rec = {
+        "gate": "pass" if not reasons else "FAIL",
+        "crashes": f"{r.get('crash_incidents')}/"
+                   f"{r.get('crashes_injected')}",
+        "stalls": f"{r.get('stall_incidents')}/"
+                  f"{r.get('stalls_injected')}",
+        "incidents_total": r.get("incidents_total"),
+        "fault_free_incidents": r.get("fault_free_incidents"),
+        "byte_identical": bool(r.get("incidents_byte_identical")
+                               and r.get("bundles_byte_identical")),
+        "monitor_transparent": bool(
+            r.get("outputs_identical")
+            and r.get("slot_logs_identical")
+            and r.get("metrics_records_identical")),
+        "overhead_slo": overhead_slo,
+        "by_kind": r.get("by_kind"),
+        "device": r.get("device", "?"),
+    }
+    if reasons:
+        rec["reason"] = "; ".join(reasons)
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 def check_obs(rows: list) -> int:
     """The obs gate: judge whichever observability families the input
-    carries (both when both are); several families present -> the
+    carries (all that are); several families present -> the
     LAST record printed carries the combined verdict, matching the
     serving gate's convention."""
     fam_rcs: dict = {}
@@ -882,12 +983,15 @@ def check_obs(rows: list) -> int:
         fam_rcs["overhead"] = check_obs_overhead(rows)
     if any(r.get("bench") == "obs_trace" for r in rows):
         fam_rcs["trace"] = check_obs_trace(rows)
+    if any(r.get("bench", "").startswith("obs_slo") for r in rows):
+        fam_rcs["slo"] = check_obs_slo(rows)
     if not fam_rcs:
         print(json.dumps({"gate": "FAIL",
-                          "reason": "no obs_overhead or obs_trace row "
-                                    "in input (run tools/serving_"
-                                    "workload_bench.py --obs-overhead "
-                                    "or --trace-out)"}))
+                          "reason": "no obs_overhead, obs_trace or "
+                                    "obs_slo row in input (run tools/"
+                                    "serving_workload_bench.py "
+                                    "--obs-overhead, --trace-out or "
+                                    "--slo)"}))
         return 1
     if len(fam_rcs) == 1:
         return next(iter(fam_rcs.values()))

@@ -110,6 +110,16 @@ QUEUE = [
     ("obs_overhead",
      [sys.executable, "tools/serving_workload_bench.py",
       "--obs-overhead"], {}),
+    # PR-9 addition: the SLO watchdog arm — the chaos trace+plan
+    # replayed monitor-off vs monitor-on (streaming burn-rate/event
+    # incidents + flight-recorder bundles) plus a fault-free monitored
+    # replay; bench_gate.py obs gates the obs_slo family (every
+    # injected crash/stall detected exactly once, zero fault-free
+    # false positives, byte-identical incidents/bundles, monitor
+    # transparency, monitor tax <= 2% via the obs_overhead row)
+    ("obs_slo",
+     [sys.executable, "tools/serving_workload_bench.py", "--slo"],
+     {}),
     # ONE bench run per window, wrapped by the regression gate (round-4
     # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
     # gate snapshots the baseline, runs bench.py, fails on >5% legacy-

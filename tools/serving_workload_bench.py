@@ -588,6 +588,171 @@ def _chaos_arm(args):
     return 0
 
 
+def _bundle_trees_equal(a: str, b: str):
+    """Byte-compare two bundle roots file-by-file (relative paths):
+    the determinism claim is 'byte-identical modulo output paths', so
+    path prefixes differ and CONTENT must not. Returns (equal,
+    n_files_compared, first_diff)."""
+    def walk(root):
+        out = {}
+        for dirpath, _, files in os.walk(root):
+            for fn in files:
+                p = os.path.join(dirpath, fn)
+                out[os.path.relpath(p, root)] = p
+        return out
+    fa, fb = walk(a), walk(b)
+    if set(fa) != set(fb):
+        only = sorted(set(fa) ^ set(fb))
+        return False, len(fa), f"file sets differ: {only[:3]}"
+    for rel in sorted(fa):
+        with open(fa[rel], "rb") as f:
+            da = f.read()
+        with open(fb[rel], "rb") as f:
+            db = f.read()
+        if da != db:
+            return False, len(fa), rel
+    return True, len(fa), None
+
+
+def _slo_arm(args):
+    """The SLO watchdog + flight recorder arm: the SAME
+    ~10^5-request sim cluster trace and seeded fault plan as --chaos,
+    replayed four times through prefix_aware placement —
+
+    1. chaos, monitor OFF          (the byte-identity reference)
+    2. chaos, monitor ON + flight  (the incident evidence)
+    3. chaos, monitor ON again     (determinism: incidents + bundles
+                                    byte-identical to run 2)
+    4. fault-free, monitor ON      (the zero-false-positive arm)
+
+    One `obs_slo` row per monitored arm plus an `obs_slo_summary`;
+    `bench_gate.py obs` gates the obs_slo family: every injected
+    crash/stall detected as an incident EXACTLY once, zero incidents
+    on the fault-free replay, incident JSONL and postmortem bundles
+    byte-identical across runs (modulo paths), and engine outputs /
+    slot logs / metrics records byte-identical monitor-on vs
+    monitor-off. Monitor overhead rides the --obs-overhead row
+    (`overhead_slo`), gated <= 2% alongside the tracing-off tax."""
+    import json as _json
+    import tempfile
+
+    from paddle_tpu.obs import default_serving_rules, load_incidents
+    from paddle_tpu.serving import (ClusterRouter, FailoverConfig,
+                                    FaultPlan, synthesize_fault_plan)
+
+    env = _sim_cluster_env(args)
+    N, trace, stats = env["N"], env["trace"], env["stats"]
+    spawn, weights = env["spawn"], env["weights"]
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    if args.fault_plan:
+        plan = FaultPlan.load(args.fault_plan)
+    else:
+        span = trace[-1].arrival - trace[0].arrival
+        plan = synthesize_fault_plan(
+            seed=args.seed, replicas=[f"r{i}" for i in range(N)],
+            span=span, n_crashes=1, n_stalls=2,
+            stall_duration=(5.0, 20.0), n_decode_errors=2)
+    cfg = FailoverConfig()
+    rules = default_serving_rules()
+    out_root = args.slo_out or tempfile.mkdtemp(prefix="obs_slo_")
+    os.makedirs(out_root, exist_ok=True)
+
+    def run(arm, faults, slo, flight_dir):
+        res = ClusterRouter(
+            spawn, N, placement="prefix_aware", faults=faults,
+            failover=cfg if faults is not None else None,
+            slo=slo, flight=flight_dir).run(trace)
+        return res
+
+    arms = {}
+    snapshots = {}
+    for arm, faults, slo in (("chaos_baseline", plan, None),
+                             ("chaos_monitored", plan, rules),
+                             ("chaos_monitored_2", plan, rules),
+                             ("fault_free_monitored", None, rules)):
+        fdir = os.path.join(out_root, arm, "bundles") \
+            if slo is not None else None
+        res = run(arm, faults, slo, fdir)
+        arms[arm] = res
+        # the byte-identity evidence: outputs, per-replica slot logs,
+        # per-replica per-request metric records
+        snapshots[arm] = {
+            "outputs": res.outputs(),
+            "slots": {n: res.results[n].slot_log
+                      for n in res.results},
+            "records": {n: res.results[n].metrics.request_rows()
+                        for n in res.results},
+            "report": res.report(tenant_weights=weights),
+        }
+        if slo is None:
+            continue
+        inc_path = os.path.join(out_root, arm, "incidents.jsonl")
+        res.save_incidents(inc_path)
+        log = res.slo_log
+        rec = {"bench": "obs_slo", "arm": arm, "device": "sim",
+               "seed": args.seed, "replicas": N,
+               "requests": env["n_req"],
+               "faulted": faults is not None,
+               "incidents": len(res.incidents),
+               "by_kind": log.by_kind(),
+               "open_at_end": sum(1 for i in res.incidents
+                                  if i.t_close is None),
+               "bundles_written": len(res.flight.bundles_written),
+               "incidents_path": inc_path}
+        emit(rec)
+
+    ch0 = snapshots["chaos_baseline"]
+    ch1 = snapshots["chaos_monitored"]
+    outputs_ok = ch0["outputs"] == ch1["outputs"]
+    slots_ok = ch0["slots"] == ch1["slots"]
+    records_ok = ch0["records"] == ch1["records"]
+    report_ok = ch0["report"] == ch1["report"]
+
+    p1 = os.path.join(out_root, "chaos_monitored", "incidents.jsonl")
+    p2 = os.path.join(out_root, "chaos_monitored_2", "incidents.jsonl")
+    with open(p1, "rb") as f:
+        inc_bytes_1 = f.read()
+    with open(p2, "rb") as f:
+        inc_bytes_2 = f.read()
+    bundles_ok, n_files, first_diff = _bundle_trees_equal(
+        os.path.join(out_root, "chaos_monitored", "bundles"),
+        os.path.join(out_root, "chaos_monitored_2", "bundles"))
+
+    kinds = arms["chaos_monitored"].slo_log.by_kind()
+    n_crashes = len(plan.crashes())
+    n_stalls = sum(1 for e in plan if e.kind == "stall")
+    # sanity: the tolerant loader round-trips what save wrote
+    n_loaded = len(load_incidents(p1))
+    emit({"bench": "obs_slo_summary", "device": "sim",
+          "seed": args.seed, "replicas": N,
+          "requests": env["n_req"], "fault_events": len(plan),
+          "crashes_injected": n_crashes,
+          "stalls_injected": n_stalls,
+          "crash_incidents": kinds.get("crash", 0),
+          "stall_incidents": kinds.get("stall", 0),
+          "detected_exactly_once": bool(
+              kinds.get("crash", 0) == n_crashes
+              and kinds.get("stall", 0) == n_stalls),
+          "fault_free_incidents":
+          len(arms["fault_free_monitored"].incidents),
+          "incidents_total": len(arms["chaos_monitored"].incidents),
+          "incidents_loaded": n_loaded,
+          "incidents_byte_identical": inc_bytes_1 == inc_bytes_2,
+          "bundles_byte_identical": bool(bundles_ok),
+          "bundle_files_compared": n_files,
+          "bundle_first_diff": first_diff,
+          "outputs_identical": bool(outputs_ok),
+          "slot_logs_identical": bool(slots_ok),
+          "metrics_records_identical": bool(records_ok),
+          "cluster_report_identical": bool(report_ok),
+          "by_kind": kinds,
+          "out_root": out_root})
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -664,6 +829,18 @@ def main(argv=None):
     ap.add_argument("--kv-transfer-unit", type=float, default=0.05,
                     help="disagg arm: per-page KV handoff transfer "
                          "cost on the virtual clock")
+    ap.add_argument("--slo", action="store_true",
+                    help="run the SLO watchdog arm instead: the "
+                         "--chaos trace+plan replayed monitor-off vs "
+                         "monitor-on (burn-rate/event incidents + "
+                         "flight-recorder bundles) plus a fault-free "
+                         "monitored replay; bench_gate.py obs gates "
+                         "the obs_slo family (crash/stall detected "
+                         "exactly once, zero fault-free incidents, "
+                         "byte-identical incidents/bundles/outputs)")
+    ap.add_argument("--slo-out", type=str, default=None,
+                    help="slo arm: root directory for incident JSONL "
+                         "+ bundles (default: a temp dir)")
     ap.add_argument("--fault-plan", type=str, default=None,
                     help="chaos arm: replay a saved FaultPlan JSONL "
                          "instead of synthesizing")
@@ -711,6 +888,8 @@ def main(argv=None):
         return _chaos_arm(args)
     if args.disagg:
         return _disagg_arm(args)
+    if args.slo:
+        return _slo_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)
@@ -765,7 +944,9 @@ def main(argv=None):
             prompt_len=prompt_rng, output_len=out_rng,
             vocab_size=cfg.vocab_size, rid_prefix="o")
         # fixed clock: the jitted work per replay is then identical
-        # across arms — the WALL delta between arms is pure obs tax
+        # across arms — the WALL delta between arms is pure obs tax.
+        # "slo" = tracing off + a live SLOMonitor (stock rule set):
+        # the streaming watchdog's tax, gated <= 2% like tracing-off
         tracer = obs.Tracer()
         engines = {
             "noobs": ServingEngine(serving=srv, slots=slots,
@@ -775,6 +956,9 @@ def main(argv=None):
             "on": ServingEngine(serving=srv, slots=slots,
                                 policy="paged", clock="fixed",
                                 trace=tracer),
+            "slo": ServingEngine(serving=srv, slots=slots,
+                                 policy="paged", clock="fixed",
+                                 slo=obs.default_serving_rules()),
         }
         engines["off"].run(trace)  # warm every program shape
         R = max(1, args.obs_repeats)
@@ -793,7 +977,9 @@ def main(argv=None):
                     tokens[name] = res.report()["generated_tokens"]
         finally:
             obs.REGISTRY.enable()
-        noobs, off, on = (min(walls[k]) for k in ("noobs", "off", "on"))
+        noobs, off, on, slo_w = (min(walls[k])
+                                 for k in ("noobs", "off", "on",
+                                           "slo"))
         row = {
             "bench": "obs_overhead", "device": device,
             "seed": args.seed, "policy": "paged", "clock": "fixed",
@@ -803,8 +989,10 @@ def main(argv=None):
             "noobs_wall_s": round(noobs, 6),
             "off_wall_s": round(off, 6),
             "on_wall_s": round(on, 6),
+            "slo_wall_s": round(slo_w, 6),
             "overhead_off": round(off / noobs - 1.0, 6),
             "overhead_on": round(on / noobs - 1.0, 6),
+            "overhead_slo": round(slo_w / noobs - 1.0, 6),
             "trace_events": len(tracer),
         }
         print(json.dumps(row), flush=True)

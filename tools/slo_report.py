@@ -1,0 +1,202 @@
+"""Incident-timeline + budget burn-down report over an SLO incident
+JSONL (``obs.slo.IncidentLog.save`` / ``ClusterResult.save_incidents``).
+
+The postmortem companion to ``trace_report.py``: where that tool
+summarizes what the engine DID (spans), this one summarizes what the
+watchdog CONCLUDED (incidents) —
+
+- the incident timeline: every incident in open order with its rule,
+  severity, source, open/close times and resolution;
+- per-rule budget burn-down: for burn-rate rules, how much of the
+  error budget was spent at each firing (``cum_bad / (cum_events *
+  (1 - objective))`` from the incident's own window evidence), so a
+  budget exhausting across a run reads as a rising column;
+- ``--bundles DIR``: cross-check the flight-recorder bundles — every
+  incident id with a bundle directory is validated for the four bundle
+  files (a missing ``metrics.jsonl`` means the recorder never froze).
+
+Loading is crash-tolerant by the shared ``iter_jsonl_tolerant``
+policy: a torn FINAL line (the file a dying process leaves) warns and
+reports the valid prefix; an earlier tear raises.
+
+``--json`` emits machine-readable rows (one per rule, the global
+``slo_report`` row LAST — the same convention as trace_report) for
+``bench_gate.py`` or ad-hoc scripting.
+
+Run:  python tools/slo_report.py incidents.jsonl
+      python tools/slo_report.py incidents.jsonl --bundles bundles/
+      python tools/slo_report.py incidents.jsonl --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def check_bundle(path: str) -> dict:
+    """One bundle directory's manifest check: the four files the
+    flight recorder writes, with basic shape validation."""
+    files = ("incident.json", "trace.json", "metrics.jsonl",
+             "requests.json")
+    present = {f: os.path.exists(os.path.join(path, f))
+               for f in files}
+    ok = all(present.values())
+    out = {"path": path, "complete": ok,
+           "missing": sorted(f for f, p in present.items() if not p)}
+    if present["incident.json"]:
+        with open(os.path.join(path, "incident.json")) as f:
+            out["incident_id"] = json.load(f).get("id")
+    return out
+
+
+def rule_rows(incidents) -> list:
+    """Per-rule aggregate + burn-down points (open order)."""
+    by_rule: dict = {}
+    for inc in incidents:
+        r = by_rule.setdefault(inc.rule, {
+            "bench": "slo_report_rule", "rule": inc.rule,
+            "kind": inc.kind, "severity": inc.severity,
+            "incidents": 0, "open": 0, "total_open_units": 0.0,
+            "sources": set(), "burn_down": []})
+        r["incidents"] += 1
+        r["sources"].add(inc.source if inc.source is not None
+                         else "-")
+        if inc.t_close is None:
+            r["open"] += 1
+        else:
+            r["total_open_units"] += inc.t_close - inc.t_open
+        if inc.kind == "burn_rate":
+            ev = inc.evidence
+            r["burn_down"].append({
+                "t": inc.t_open,
+                "budget_spent": ev.get("budget_spent"),
+                "cum_events": ev.get("cum_events"),
+                "cum_bad": ev.get("cum_bad"),
+                "objective": ev.get("objective")})
+    rows = []
+    for name in sorted(by_rule):
+        r = by_rule[name]
+        r["sources"] = sorted(r["sources"])
+        r["total_open_units"] = round(r["total_open_units"], 6)
+        if not r["burn_down"]:
+            del r["burn_down"]
+        rows.append(r)
+    return rows
+
+
+def global_row(incidents, bundle_checks=None) -> dict:
+    by_kind: dict = {}
+    by_sev: dict = {}
+    srcs = set()
+    for inc in incidents:
+        by_kind[inc.kind] = by_kind.get(inc.kind, 0) + 1
+        by_sev[inc.severity] = by_sev.get(inc.severity, 0) + 1
+        srcs.add(inc.source if inc.source is not None else "-")
+    row = {"bench": "slo_report",
+           "incidents": len(incidents),
+           "open": sum(1 for i in incidents if i.t_close is None),
+           "by_kind": dict(sorted(by_kind.items())),
+           "by_severity": dict(sorted(by_sev.items())),
+           "sources": sorted(srcs)}
+    if incidents:
+        row["t_first"] = min(i.t_open for i in incidents)
+        row["t_last"] = max(i.t_open for i in incidents)
+    if bundle_checks is not None:
+        row["bundles"] = len(bundle_checks)
+        row["bundles_complete"] = sum(
+            1 for b in bundle_checks if b["complete"])
+    return row
+
+
+def _fmt_evidence(inc) -> str:
+    ev = inc.evidence
+    if inc.kind == "burn_rate":
+        w = ev.get("windows") or []
+        parts = [f"burn {x.get('burn')}@{x.get('window')}u"
+                 for x in w]
+        parts.append(f"budget_spent={ev.get('budget_spent')}")
+        return " ".join(parts)
+    return " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                    if not isinstance(v, (list, dict)))[:60]
+
+
+def render_text(incidents, rules, bundle_checks=None):
+    print(f"# incident timeline ({len(incidents)} incidents)")
+    hdr = (f"{'id':10} {'t_open':>12} {'t_close':>12} {'sev':5} "
+           f"{'source':10} {'rule':18} resolution/evidence")
+    print(hdr)
+    print("-" * len(hdr))
+    for inc in incidents:
+        close = f"{inc.t_close:.3f}" if inc.t_close is not None \
+            else "OPEN"
+        res = inc.resolution or ""
+        print(f"{inc.id:10} {inc.t_open:12.3f} {close:>12} "
+              f"{inc.severity:5} {str(inc.source or '-'):10} "
+              f"{inc.rule:18} {res} {_fmt_evidence(inc)}")
+    print()
+    print("# per-rule budget burn-down")
+    for r in rules:
+        line = (f"{r['rule']:18} [{r['kind']}/{r['severity']}] "
+                f"incidents={r['incidents']} open={r['open']} "
+                f"open_units={r['total_open_units']}")
+        print(line)
+        for p in r.get("burn_down", []):
+            spent = p.get("budget_spent")
+            bar = "#" * min(40, int((spent or 0.0) * 40))
+            print(f"    t={p['t']:<12.3f} budget_spent="
+                  f"{spent if spent is not None else '?':<8} {bar}")
+    if bundle_checks is not None:
+        print()
+        complete = sum(1 for b in bundle_checks if b["complete"])
+        print(f"# bundles: {complete}/{len(bundle_checks)} complete")
+        for b in bundle_checks:
+            if not b["complete"]:
+                print(f"  INCOMPLETE {b['path']}: missing "
+                      f"{b['missing']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("incidents", help="incident JSONL "
+                    "(IncidentLog.save output)")
+    ap.add_argument("--bundles", type=str, default=None,
+                    help="flight-recorder bundle root: validate each "
+                         "incident's bundle directory")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable rows (global row LAST)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs.slo import load_incidents
+    incidents = load_incidents(args.incidents)
+
+    bundle_checks = None
+    if args.bundles is not None:
+        bundle_checks = []
+        for inc in incidents:
+            p = os.path.join(args.bundles, inc.id)
+            if os.path.isdir(p):
+                bundle_checks.append(check_bundle(p))
+
+    rules = rule_rows(incidents)
+    if args.json:
+        for r in rules:
+            print(json.dumps(r), flush=True)
+        if bundle_checks:
+            for b in bundle_checks:
+                print(json.dumps({"bench": "slo_report_bundle", **b}),
+                      flush=True)
+        # the global row stays LAST (consumers read the final line)
+        print(json.dumps(global_row(incidents, bundle_checks)),
+              flush=True)
+    else:
+        render_text(incidents, rules, bundle_checks)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
