@@ -66,6 +66,74 @@ def get_context_mesh():
     return mesh, (mesh.axis_names if mesh is not None else ())
 
 
+def make_mesh(shape, axis_names):
+    """A 1-or-more-D device mesh over the first prod(shape) local
+    devices: ``jax.make_mesh`` on jax builds that have it (it also
+    picks a bandwidth-aware device order on real topologies), else the
+    classic ``Mesh(np.reshape(devices), names)`` construction — the
+    form every 0.4.x build accepts."""
+    shape = tuple(int(s) for s in shape)
+    if hasattr(jax, "make_mesh"):
+        return jax.make_mesh(shape, tuple(axis_names))
+    import math
+
+    import numpy as np
+    n = math.prod(shape)
+    devs = jax.devices()
+    if n > len(devs):
+        raise ValueError(f"mesh {shape} needs {n} devices, have "
+                         f"{len(devs)}")
+    return jax.sharding.Mesh(np.array(devs[:n]).reshape(shape),
+                             tuple(axis_names))
+
+
+def named_sharding(mesh, *names):
+    """``NamedSharding(mesh, PartitionSpec(*names))`` in one call —
+    the SNIPPETS-[3] utility shape. ``names`` entries are mesh axis
+    names or None (replicated dim); no names at all = fully
+    replicated over the mesh. One construction site so callers never
+    touch the PartitionSpec class directly (its import path moved
+    across jax versions; ``jax.sharding.PartitionSpec`` is the stable
+    spelling both old and new builds expose)."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*names))
+
+
+def device_put_sharded(tree, mesh, specs=None):
+    """Place every leaf of ``tree`` on ``mesh`` under ``specs``:
+
+    - ``specs=None``: every leaf replicated (the activation-staging
+      case — a host batch must live on ALL mesh devices before a
+      sharded-weight program can consume it without an implicit
+      default-device transfer);
+    - a single PartitionSpec-args tuple: every leaf gets it;
+    - a dict keyed like ``tree`` (flat param dicts): per-leaf spec
+      tuples, missing keys replicated.
+
+    Unlike the LEGACY ``jax.device_put_sharded`` (per-device shard
+    lists, removed on newer jax), this is the NamedSharding form that
+    exists on both sides of the drift; the name is kept because it is
+    the operation serving code means — "put this tree on the mesh,
+    sharded as specified"."""
+    def _sh(spec):
+        return named_sharding(mesh, *spec) if spec else \
+            named_sharding(mesh)
+
+    if isinstance(tree, dict) and isinstance(specs, dict):
+        unknown = set(specs) - set(tree)
+        if unknown:
+            # a spec naming no leaf is a silent replication bug in the
+            # making (a renamed weight key would quietly lose its
+            # sharding and bloat every device) — refuse loudly instead
+            raise ValueError(f"device_put_sharded: spec keys "
+                             f"{sorted(unknown)} name no tree leaf")
+        return {k: jax.device_put(v, _sh(specs.get(k)))
+                for k, v in tree.items()}
+    sh = _sh(tuple(specs) if specs else ())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh),
+                                  tree)
+
+
 def tpu_compiler_params():
     """``pltpu.CompilerParams`` (new jax) or ``pltpu.TPUCompilerParams``
     (old name) — the Pallas kernel modules import this once instead of

@@ -11,6 +11,7 @@ recompute of the eager `LlamaForCausalLM.generate`.
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from functools import partial
 
@@ -18,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...jax_compat import device_put_sharded, make_mesh
 from .llama import LlamaConfig, LlamaForCausalLM, apply_rotary
 from .llama_functional import _rms, split_params  # noqa: F401 (re-export)
 from .llama_functional import stack_layers, unstack_layers  # noqa: F401
@@ -75,6 +77,140 @@ _PROJ_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
               "self_attn.v_proj.weight", "self_attn.o_proj.weight",
               "mlp.gate_proj.weight", "mlp.up_proj.weight",
               "mlp.down_proj.weight")
+
+
+# --- tensor parallelism (sharded decode weights + paged pool) --------------
+
+@dataclasses.dataclass(frozen=True)
+class TPConfig:
+    """Tensor-parallel layout for the serving decode path: a 1-D named
+    device mesh, attention heads and MLP hidden dims partitioned over
+    ``axis``, everything else (embeddings, norms, lm head, page
+    tables) replicated. Threaded into the decode/prefill factories —
+    weights and pools are placed ONCE at load (NamedSharding;
+    jax_compat.device_put_sharded) and every jitted call inherits the
+    arg shardings, so the fixed-shape ``decode_n`` batches still never
+    recompile across churn.
+
+    ``hbm_budget_bytes_per_device``: optional per-device byte budget
+    for weights + KV pool together; the factory measures the ACTUAL
+    per-device resident bytes after placement and refuses loudly
+    (MemoryError) when they exceed it — the "a model bigger than one
+    chip serves only under TP" check the serving_tp gate exercises.
+    """
+
+    mesh_shape: tuple = (2,)
+    axis: str = "tp"
+    hbm_budget_bytes_per_device: int | None = None
+
+    def __post_init__(self):
+        shape = tuple(int(s) for s in self.mesh_shape)
+        object.__setattr__(self, "mesh_shape", shape)
+        if len(shape) != 1 or shape[0] < 1:
+            raise ValueError(f"TPConfig mesh_shape {shape}: tensor "
+                             "parallelism is a 1-D mesh (one named "
+                             "axis)")
+
+    @property
+    def size(self) -> int:
+        return self.mesh_shape[0]
+
+    def build_mesh(self):
+        return make_mesh(self.mesh_shape, (self.axis,))
+
+
+def as_tp_config(tp) -> TPConfig | None:
+    """Normalize the ``tp=`` argument: None stays None, an int becomes
+    a 1-D TPConfig of that many devices, a TPConfig passes through."""
+    if tp is None or isinstance(tp, TPConfig):
+        return tp
+    if isinstance(tp, int):
+        return TPConfig(mesh_shape=(tp,))
+    raise ValueError(f"tp {tp!r}: pass None, an int degree, or a "
+                     "TPConfig")
+
+
+def tp_layer_specs(axis: str = "tp") -> dict:
+    """PartitionSpec args for the STACKED (L, in, out) decode layer
+    weights: column-parallel q/k/v and MLP gate/up (output features —
+    heads / hidden dims — split over ``axis``), row-parallel o_proj
+    and down_proj (input features split; jit inserts the psum over the
+    contraction), norms replicated (missing keys -> replicated in
+    ``device_put_sharded``). The Megatron layout: one all-reduce per
+    attention block, one per MLP, no resharding between them."""
+    col = (None, None, axis)
+    row = (None, axis, None)
+    return {
+        "self_attn.q_proj.weight": col,
+        "self_attn.k_proj.weight": col,
+        "self_attn.v_proj.weight": col,
+        "self_attn.o_proj.weight": row,
+        "mlp.gate_proj.weight": col,
+        "mlp.up_proj.weight": col,
+        "mlp.down_proj.weight": row,
+    }
+
+
+def tp_pool_spec(axis: str = "tp") -> tuple:
+    """PartitionSpec args for the paged KV pools (L, Hkv, P, page,
+    hd): page CONTENT splits by kv head over ``axis``; page ids,
+    tables and lengths stay host-side and replicated (trailing dims
+    unspecified = replicated, which also covers the int8 scale leaves'
+    (L, Hkv, P, page) shape)."""
+    return (None, axis)
+
+
+def _validate_tp(cfg: LlamaConfig, tp: TPConfig):
+    if tp.size > len(jax.devices()):
+        raise ValueError(f"tp={tp.size} needs {tp.size} devices, have "
+                         f"{len(jax.devices())}")
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    inter = cfg.intermediate_size
+    for name, dim in (("attention heads", nh), ("kv heads", nkv),
+                      ("mlp intermediate", inter)):
+        if dim % tp.size:
+            raise ValueError(
+                f"tp={tp.size} does not divide {name} ({dim}) — the "
+                "head/hidden partition would be ragged")
+
+
+def tree_device_bytes(tree) -> int:
+    """Resident bytes of ``tree``'s leaves on ONE device: a sharded
+    leaf contributes one device's shard bytes (computed from the
+    sharding's shard shape — metadata only, so a DONATED buffer that
+    already died still answers), a replicated or unsharded leaf its
+    full size — the per-device HBM footprint the TP capacity claims
+    are judged on. Host (numpy) leaves count whole."""
+    total = 0
+    for a in jax.tree_util.tree_leaves(tree):
+        sh = getattr(a, "sharding", None)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            shard = sh.shard_shape(a.shape)
+            total += int(np.prod(shard, dtype=np.int64)) \
+                * a.dtype.itemsize
+        else:
+            total += int(getattr(a, "nbytes", np.asarray(a).nbytes))
+    return total
+
+
+def decode_need_bytes_per_device(outer, layers, pools) -> int:
+    """THE per-device residency arithmetic for a decode factory:
+    weights + KV pools, one device's share each. The factory's
+    ``hbm_budget_bytes_per_device`` refusal, the bench's capacity
+    demo and the tests all call THIS — three private copies could
+    silently diverge and flip the refuses/serves verdict."""
+    return (tree_device_bytes(outer) + tree_device_bytes(layers)
+            + tree_device_bytes(pools))
+
+
+def shard_decode_params(outer, layers, tp: TPConfig):
+    """Place decode weights on the TP mesh ONCE at load: layer
+    projections per ``tp_layer_specs``, outer params (embeddings,
+    final norm, lm head) replicated. Returns (outer, layers, mesh)."""
+    mesh = tp.build_mesh()
+    layers = device_put_sharded(layers, mesh, tp_layer_specs(tp.axis))
+    outer = device_put_sharded(outer, mesh)
+    return outer, layers, mesh
 
 
 def _proj_qkv(cfg: LlamaConfig, p, h, pos):
@@ -803,7 +939,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                kv_cache_dtype: str | None = None,
                                emit: str = "token",
                                prefill_attention: str = "gather",
-                               scan_layers: bool = True):
+                               scan_layers: bool = True,
+                               tp: "TPConfig | int | None" = None):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -849,6 +986,16 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     ``scan_layers`` (default True): one scanned layer body over the
     stacked (L, ...) weights and (L, ...) pools; False unrolls the
     layers into the program (parity fallback).
+
+    ``tp`` (``TPConfig`` / int degree): shard the decode path over a
+    1-D named mesh — attention heads and MLP hidden dims partitioned
+    column/row-parallel (``tp_layer_specs``), the KV pools split by kv
+    head (``tp_pool_spec``), embeddings/norms replicated. Placement
+    happens ONCE here (NamedSharding device_put); the jitted
+    prefill/decode programs are byte-for-byte the same trace — they
+    inherit the arg shardings, GSPMD inserts the collectives, and the
+    fixed-shape ``decode_n`` batches still never recompile across
+    churn. ``tp=None`` builds exactly the single-device factory.
     """
     from ...ops.pallas.paged_attention import paged_attention
 
@@ -856,6 +1003,11 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     outer, layers = split_params(model)
     outer = {k: jnp.asarray(v) for k, v in outer.items()}
     layers = {k: jnp.asarray(v) for k, v in layers.items()}
+    tp = as_tp_config(tp)
+    tp_mesh = None
+    if tp is not None:
+        _validate_tp(cfg, tp)
+        outer, layers, tp_mesh = shard_decode_params(outer, layers, tp)
     L = cfg.num_hidden_layers
     nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
     hd = cfg.hidden_size // nh
@@ -881,8 +1033,15 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             def one():
                 return (jnp.zeros(shape, jnp.int8),
                         jnp.ones(shape[:-1], jnp.float32))
-            return one(), one()
-        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+            pools = one(), one()
+        else:
+            pools = jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+        if tp_mesh is not None:
+            # page CONTENT splits by kv head; the spec's trailing dims
+            # (and the int8 scale leaves' 4-D shape) stay replicated
+            pools = device_put_sharded(pools, tp_mesh,
+                                       tp_pool_spec(tp.axis))
+        return pools
 
     def _write_prompt(pool_l, kv, page_tables, T_pad):
         """kv (B, nkv, T_pad, hd) -> pages at the tables' first
@@ -1136,7 +1295,21 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             length=n)
         return emits, tok, pools
 
-    return outer, layers, init_pools(), prefill, decode_step, decode_n
+    pools = init_pools()
+    if tp is not None and tp.hbm_budget_bytes_per_device is not None:
+        # MEASURED per-device residency after placement (weights +
+        # pools) vs the declared budget: a model too big for one
+        # device's HBM must refuse loudly here, not OOM mid-serve —
+        # and the same model under a wider mesh fits and serves (the
+        # serving_tp capacity gate drives exactly this pair)
+        need = decode_need_bytes_per_device(outer, layers, pools)
+        if need > tp.hbm_budget_bytes_per_device:
+            raise MemoryError(
+                f"tp={tp.size}: weights + KV pool need {need} bytes "
+                f"per device, budget is "
+                f"{tp.hbm_budget_bytes_per_device} — widen the mesh "
+                "or shrink the pool")
+    return outer, layers, pools, prefill, decode_step, decode_n
 
 
 def route_decode(lengths, capacity: int, shared_prefix: bool = False,
@@ -1206,6 +1379,39 @@ def route_decode(lengths, capacity: int, shared_prefix: bool = False,
                        "every uniform shape measured, PERF record 37)")
 
 
+class PagedOnlyDense:
+    """THE dense-backend stub for paged-only serving factories (the
+    TP factory below and ``serving.sim`` share it): exactly enough
+    surface for ``ServingEngine.__init__``'s dense introspection —
+    the ``rolling`` check and the embed-tokens dtype read — with
+    every actual dense call raising ``reason``. One class, so when
+    the engine grows a new introspection read there is one stub to
+    keep in lockstep, not a copy per paged-only factory."""
+
+    def __init__(self, reason: str):
+        def _raise(*a, **k):
+            raise NotImplementedError(reason)
+        self._raise = _raise
+        self._parts = {
+            "rolling": False,
+            "outer": {"model.embed_tokens.weight":
+                      np.zeros((1, 1), np.float32)},
+            "init_caches": _raise,
+            "prefill": _raise,
+            "decode_step": _raise,
+        }
+
+    def __call__(self, *a, **k):
+        self._raise()
+
+
+_TP_DENSE_REASON = (
+    "a tensor-parallel serving factory is paged-only: the dense "
+    "wave cache replicates max_len K/V per slot on ONE device, "
+    "which is exactly the residency TP exists to break — route "
+    "with policy='paged'")
+
+
 def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  max_len: int = 256,
                                  page_size: int = 64,
@@ -1213,7 +1419,8 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  kv_cache_dtype: str | None = None,
                                  batch_capacity: int = 8,
                                  scan_layers: bool = True,
-                                 chunked_prefill: int | None = None):
+                                 chunked_prefill: int | None = None,
+                                 tp: "TPConfig | int | None" = None):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
     ("paged", (outer, layers, pools, prefill, decode_step, decode_n))
@@ -1231,14 +1438,21 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
     # backends, or an int8-configured engine would quantize only
     # paged-routed traffic (and int8 rounding can flip a greedy token,
     # breaking cross-backend output parity for no routing reason)
-    gen = llama_decode_factory(model, max_len=max_len,
-                               kv_cache_dtype=kv_cache_dtype,
-                               scan_layers=scan_layers)
+    tp = as_tp_config(tp)
+    if tp is None:
+        gen = llama_decode_factory(model, max_len=max_len,
+                                   kv_cache_dtype=kv_cache_dtype,
+                                   scan_layers=scan_layers)
+    else:
+        # tensor-parallel serving is PAGED-ONLY: no dense replica is
+        # built (see PagedOnlyDense) — the engine coerces its routing
+        # to the paged backend
+        gen = PagedOnlyDense(_TP_DENSE_REASON)
     paged = llama_paged_decode_factory(model, page_size=page_size,
                                        n_pool_pages=n_pool_pages,
                                        kv_cache_dtype=kv_cache_dtype,
                                        chunked_prefill=chunked_prefill,
-                                       scan_layers=scan_layers)
+                                       scan_layers=scan_layers, tp=tp)
 
     class _Serving:
         # staticmethod: a bare function class-attribute would BIND as a
@@ -1252,9 +1466,13 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         page_size_ = page_size
         n_pool_pages_ = n_pool_pages
         chunked_prefill_ = chunked_prefill
+        tp_ = tp  # TPConfig when the paged path is mesh-sharded
 
         def pick(self, lengths, capacity=None, shared_prefix=False,
                  expect_churn=False):
+            if self.tp_ is not None:
+                # no dense replica exists on a sharded factory
+                return "paged", paged
             # read the live attribute (not the factory closure) so
             # callers who adjust serving.capacity see routing follow
             cap = capacity if capacity is not None else self.capacity

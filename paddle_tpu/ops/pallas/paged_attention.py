@@ -246,6 +246,23 @@ class PagedKVCache:
         # over this bookkeeper can never serve pre-crash pages; the
         # tag makes "which generation is this pool" checkable.
         self.epoch = 0
+        # per-device pool residency, NOTED by the serving engine when
+        # its factory pools are mesh-sharded (this bookkeeper's own
+        # arrays are 1-element stand-ins there); None = never noted,
+        # and cache_stats stays byte-identical to the unsharded shape
+        self._pool_bytes: tuple | None = None
+
+    def note_pool_bytes(self, total_bytes: int,
+                        per_device_bytes: int | None = None):
+        """Record the REAL pool's byte footprint (the serving factory
+        owns the device arrays; this bookkeeper owns the accounting):
+        ``cache_stats()`` then reports ``bytes_per_device`` — the
+        number the tensor-parallel capacity claims are gated on. With
+        ``per_device_bytes`` omitted the pool is unsharded (one device
+        holds everything)."""
+        total = int(total_bytes)
+        self._pool_bytes = (total, int(per_device_bytes)
+                            if per_device_bytes is not None else total)
 
     def allocate(self, seq_id, n_tokens: int):
         """Reserve pages so ``seq_id`` can hold n_tokens total. The
@@ -498,7 +515,7 @@ class PagedKVCache:
         serving bench gate checks."""
         hit = self._stats["hit_tokens"]
         lookup = self._stats["lookup_tokens"]
-        return {
+        out = {
             "n_pages": int(self.k_pages.shape[1]) - 1,
             "resident_pages": len(self._refs),
             "evictable_pages": len(self._evictable),
@@ -508,6 +525,12 @@ class PagedKVCache:
             "hit_rate": round(hit / lookup, 4) if lookup else 0.0,
             "evictions": self._stats["evictions"],
         }
+        if self._pool_bytes is not None:
+            # only when noted (a sharded serving pool): unsharded runs
+            # keep the pre-TP dict byte-for-byte
+            out["bytes_total"] = self._pool_bytes[0]
+            out["bytes_per_device"] = self._pool_bytes[1]
+        return out
 
     def batch_views(self, seq_ids):
         """(page_tables (B, max_pages), seq_lens (B,)) padded with the

@@ -33,6 +33,7 @@ from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       DisaggregatedPlacement, LeastLoadedPlacement,
                       PlacementPolicy, PrefixAwarePlacement,
                       RoundRobinPlacement, make_placement)
+from ..models.nlp.llama_decode import TPConfig  # noqa: F401
 from .engine import (DecodeError, EngineClock,  # noqa: F401
                      EngineSession, FixedPolicy, KVHandoff, Policy,
                      RoutedPolicy, ServeResult, ServingEngine,

@@ -817,10 +817,12 @@ class ClusterRouter:
         delivery time so lanes advance to meet it. Candidates must
         match the chain's PAGE GEOMETRY (the exported data is
         page-shaped — a different page size cannot adopt it; a
-        heterogeneous cluster simply narrows the candidate set) and
-        fit the request's footprint. A handoff no admitting
-        decode-capable replica can take is recorded FAILED —
-        accounted, never silently dropped."""
+        heterogeneous cluster simply narrows the candidate set) AND
+        its TENSOR-PARALLEL degree (a head-sharded chain scatters
+        only into a pool split over the same mesh width), and fit the
+        request's footprint. A handoff no admitting decode-capable
+        replica can take is recorded FAILED — accounted, never
+        silently dropped."""
         for rep in list(self.replicas):
             if not rep.session.handoff_ready:
                 continue
@@ -834,6 +836,8 @@ class ClusterRouter:
                 cands = [x for x in self.replicas
                          if x.admitting
                          and x.session.eng.page_size == h.page_size
+                         and getattr(x.session.eng, "tp_size", 1)
+                         == h.tp
                          and self._rep_fits(
                              x, len(h.req.prompt),
                              h.req.max_new_tokens)]

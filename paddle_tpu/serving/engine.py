@@ -50,11 +50,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..inference import BatchingConfig
+from ..jax_compat import named_sharding
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..models.nlp.llama_decode import (llama_serving_decode_factory,
-                                       route_decode)
+from ..models.nlp.llama_decode import (as_tp_config,
+                                       llama_serving_decode_factory,
+                                       route_decode,
+                                       tree_device_bytes)
 from ..ops.pallas.paged_attention import PagedKVCache
 from .metrics import MetricsCollector
 from .scheduler import QoSScheduler, ServiceEstimator
@@ -398,6 +401,10 @@ class KVHandoff:
     page_size: int = 0                # source page geometry — an
     # importer with a different page size cannot adopt this chain
     # (the exported data is page-shaped), so placement filters on it
+    tp: int = 1                       # source tensor-parallel degree:
+    # exported page content is head-sharded over the source mesh, so
+    # only a decode worker on the SAME tp degree can scatter it into
+    # its pool — disaggregated placement filters on it like page_size
 
 
 class ServingEngine:
@@ -456,7 +463,15 @@ class ServingEngine:
                  scheduler=None, trace=None,
                  prefix_cache: bool = True,
                  prefill_chunk_budget: Optional[int] = None,
-                 slo=None):
+                 slo=None, tp=None):
+        # ``tp``: None (byte-identical to the single-device engine —
+        # outputs, slot logs, metrics records, registry contents), a
+        # TPConfig, or an int degree. With a MODEL it is threaded into
+        # the factory build (weights + pools placed once, sharded);
+        # with a PREBUILT factory the factory's own tp_ is
+        # authoritative — passing a conflicting tp here is an error,
+        # because arrays cannot be re-sharded after the build.
+        tp = as_tp_config(tp)
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -472,11 +487,35 @@ class ServingEngine:
                 model, max_len=max_len, page_size=page_size,
                 n_pool_pages=n_pool_pages, kv_cache_dtype=kv_cache_dtype,
                 batch_capacity=slots, scan_layers=scan_layers,
-                chunked_prefill=page_size)
+                chunked_prefill=page_size, tp=tp)
         else:
             max_len = serving.max_len_
             page_size = serving.page_size_
             n_pool_pages = serving.n_pool_pages_
+            fac_tp = getattr(serving, "tp_", None)
+            if tp is not None and fac_tp != tp:
+                raise ValueError(
+                    f"tp={tp} conflicts with the prebuilt factory's "
+                    f"tp_={fac_tp} — a factory's placement is fixed "
+                    "at build; pass tp to the factory (or the model "
+                    "path) instead")
+            tp = fac_tp
+        self.tp = tp
+        self.tp_size = tp.size if tp is not None else 1
+        if tp is not None:
+            # tensor-parallel serving is paged-only (no dense replica
+            # exists — see llama_decode.PagedOnlyDense): the routed
+            # policy — string OR instance — coerces to the paged
+            # fixed policy, and an explicitly dense one is a
+            # configuration error at construction, not a
+            # NotImplementedError mid-serve. A custom Policy object
+            # is the caller's responsibility to keep paged-only.
+            if policy == "routed" or isinstance(policy, RoutedPolicy):
+                policy = "paged"
+            elif policy == "dense" or (isinstance(policy, FixedPolicy)
+                                       and policy.backend == "dense"):
+                raise ValueError("policy='dense' under tp: a sharded "
+                                 "factory holds no dense replica")
         if serving.chunked_prefill_ is None:
             raise ValueError("the engine needs a chunked-prefill paged "
                              "backend (llama_serving_decode_factory("
@@ -590,9 +629,61 @@ class ServingEngine:
         # a factory may advertise wants_numpy_ (serving.sim does): its
         # callables take host arrays directly, so the per-call
         # jnp.asarray staging — pure overhead at 10^5-request cluster
-        # scale — is skipped; jitted factories keep the conversion
-        self._arr = (lambda x: x) \
-            if getattr(serving, "wants_numpy_", False) else jnp.asarray
+        # scale — is skipped; jitted factories keep the conversion.
+        # Under tp the staging routes through jax_compat.named_sharding
+        # instead of bare jnp.asarray: the plain form commits host
+        # batches to the DEFAULT device (the latent single-device
+        # assumption), which would force a transfer before every
+        # sharded-weight program — replicating onto the mesh up front
+        # keeps activations resident where the weights are.
+        self._tp_attr = {"tp": tp.size} if tp is not None else {}
+        if getattr(serving, "wants_numpy_", False):
+            self._arr = lambda x: x
+        elif tp is not None:
+            # ONE placement: device_put takes the host array straight
+            # onto the mesh (a jnp.asarray first would commit it to
+            # the default device and pay a second copy per call)
+            _rep = named_sharding(tp.build_mesh())
+            self._arr = lambda x, _s=_rep: jax.device_put(x, _s)
+        else:
+            self._arr = jnp.asarray
+        # per-device pool residency: measured from the LIVE pool
+        # arrays (factories may provide pool_device_bytes — the sim's
+        # host pools model the head split arithmetically). Noted on
+        # every run's bookkeeper and exported as the
+        # serving_pool_bytes_per_device gauge ONLY when sharded
+        # (PR-5 nonzero-only convention: tp=None leaves the registry
+        # byte-identical).
+        self._pool_bytes: Optional[Tuple[int, int]] = None
+        self._g_pool_bytes = None
+        if tp is not None:
+            total = sum(int(getattr(a, "nbytes", 0))
+                        for a in jax.tree_util.tree_leaves(self._pools))
+            fn = getattr(serving, "pool_device_bytes", None)
+            per_dev = int(fn(self._pools)) if fn is not None \
+                else tree_device_bytes(self._pools)
+            self._pool_bytes = (total, per_dev)
+            self._g_pool_bytes = obs_metrics.REGISTRY.gauge(
+                "serving_pool_bytes_per_device",
+                "KV pool bytes resident on one device of the TP mesh")
+            self._g_pool_bytes.set(float(per_dev))
+
+    def pool_bytes_per_device(self) -> Optional[int]:
+        """One device's share of the live KV pool, bytes (None when
+        the engine is unsharded — the whole pool is one device's)."""
+        return self._pool_bytes[1] if self._pool_bytes is not None \
+            else None
+
+    def _note_pool(self, book: PagedKVCache, m: MetricsCollector,
+                   t: float = 0.0):
+        """Stamp the run bookkeeper with the REAL pool's byte census
+        and stream the per-device signal to any attached SLO monitor
+        (``pool_bytes_per_device`` — a ThresholdRule can watch it).
+        No-op unsharded: cache_stats/metrics stay byte-identical."""
+        if self._pool_bytes is None:
+            return
+        book.note_pool_bytes(*self._pool_bytes)
+        m.on_pool_bytes(t, self._pool_bytes[1])
 
     @property
     def _pools(self):
@@ -796,6 +887,7 @@ class ServingEngine:
                             kv_heads=1, head_dim=1)  # bookkeeping only:
         # tables/lengths/free-list/prefix refcounts — device pages live
         # in the factory pools, written by prefill/decode_n
+        self._note_pool(book, m)
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         waiting: List[Request] = []
@@ -981,6 +1073,7 @@ class ServingEngine:
         m = MetricsCollector(monitor=mon)
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
+        self._note_pool(book, m)
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         active: Dict[str, _PagedRow] = {}
@@ -1270,7 +1363,7 @@ class ServingEngine:
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
                 rid=sid, units=n_chunks, resume=resume,
-                cached=n_cached)
+                cached=n_cached, **self._tp_attr)
             first_tok = int(np.asarray(first)[0])
             chunks_done += n_chunks
             tokens_done += n_chunks * self.chunk_C
@@ -1392,7 +1485,8 @@ class ServingEngine:
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
                 rid=sid, units=1, chunk=k, of=e.n_chunks,
                 cost=((self.fixed_costs or {}).get("prefill", 1.0)
-                      / e.run_chunks if flat else None))
+                      / e.run_chunks if flat else None),
+                **self._tp_attr)
             e.next_chunk += 1
             chunks_run += 1
             tokens_run += C
@@ -1510,7 +1604,7 @@ class ServingEngine:
                 arr(pt), arr(lens), self._pools, n)
         emits, _, self._pools = self._timed(
             tr, clock, "decode", _call, jitfn=self._p_decode_n,
-            n=n, rows=len(rows))
+            n=n, rows=len(rows), **self._tp_attr)
         emits = np.asarray(emits)  # (n, slots) greedy tokens
         t = clock.now()
         for st in rows:
@@ -1760,6 +1854,7 @@ class EngineSession:
         self.m = MetricsCollector(monitor=slo)
         self.book = PagedKVCache(eng.n_pool_pages, eng.page_size,
                                  kv_heads=1, head_dim=1)
+        eng._note_pool(self.book, self.m)
         self.pages_total = len(self.book._free)
         self.sched = eng.scheduler
         self.est: Optional[ServiceEstimator] = None
@@ -2037,7 +2132,7 @@ class EngineSession:
             req=r, first_tok=int(first_tok), n_pages=n_exp,
             kv_data=data, n_cached=n_cached, t_admit=t_admit,
             t_first=t, t_ready=t, replica_from=self.replica,
-            page_size=eng.page_size))
+            page_size=eng.page_size, tp=eng.tp_size))
         book.free(sid)
         eng._g_resident.set(float(len(book._refs)))
         self.free_slots.append(slot)

@@ -105,6 +105,10 @@ class MetricsCollector:
         # report grows its prefix block only when a hit happened, so
         # plain no-hit traces stay byte-identical
         self._prefix = {"cached": 0, "saved": 0, "prompt": 0}
+        # per-device pool bytes (tensor-parallel runs only): kept so
+        # publish() can export the sharded-only gauge; None = never
+        # sharded, nothing exported (PR-5 convention)
+        self._pool_dev_bytes: Optional[int] = None
         # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
         # request's FINAL record at finish/shed plus queue/lane depth
         # samples — the one seam through which the streaming SLO layer
@@ -182,6 +186,18 @@ class MetricsCollector:
         pre-SLO replays are untouched."""
         if self._mon is not None:
             self._mon.observe_value("prefill_lane_depth", depth, t)
+
+    def on_pool_bytes(self, t: float, per_device_bytes: int):
+        """Per-device KV-pool residency sample (tensor-parallel
+        engines only — unsharded runs never call this). Stored
+        nowhere (the serving_pool_bytes_per_device gauge exports it
+        live); exists to stream the signal to an attached SLO monitor
+        so a ``ThresholdRule(signal="pool_bytes_per_device", ...)``
+        can watch per-device HBM pressure."""
+        self._pool_dev_bytes = int(per_device_bytes)
+        if self._mon is not None:
+            self._mon.observe_value("pool_bytes_per_device",
+                                    per_device_bytes, t)
 
     def forget(self, rid: str):
         """Erase every trace of ``rid`` from this collector — the
@@ -424,6 +440,13 @@ class MetricsCollector:
                          5000.0, 10000.0, 25000.0, 100000.0))
             for s in stalls:
                 h.observe(s)
+        # per-device KV-pool residency: ONLY when the run was sharded
+        # (the engine streamed it through on_pool_bytes) — unsharded
+        # replays leave the registry byte-identical (PR-5 convention)
+        if self._pool_dev_bytes is not None:
+            reg.gauge("serving_pool_bytes_per_device",
+                      "KV pool bytes resident on one device of the "
+                      "TP mesh").set(float(self._pool_dev_bytes))
         return rec
 
     def to_record(self, policy: str, **extra) -> dict:

@@ -45,26 +45,13 @@ import numpy as np
 _MUL = np.uint64(6364136223846793005)   # splitmix/LCG-grade odd mult
 
 
-def _dense_unsupported(*a, **k):
-    raise NotImplementedError(
-        "SimServing is paged-only (policy='paged'): the sim validates "
-        "paged bookkeeping at scale; route dense waves to a real "
-        "factory")
-
-
-class _SimDense:
-    """Just enough surface for ServingEngine.__init__'s introspection;
-    any actual dense wave raises."""
-
-    def __init__(self):
-        self._parts = {
-            "rolling": False,
-            "outer": {"model.embed_tokens.weight":
-                      np.zeros((1, 1), np.float32)},
-            "init_caches": _dense_unsupported,
-            "prefill": _dense_unsupported,
-            "decode_step": _dense_unsupported,
-        }
+# the dense-introspection stub is SHARED with the TP factory
+# (models.nlp.llama_decode.PagedOnlyDense) so the engine's dense
+# surface has exactly one stub to keep in lockstep
+_SIM_DENSE_REASON = (
+    "SimServing is paged-only (policy='paged'): the sim validates "
+    "paged bookkeeping at scale; route dense waves to a real "
+    "factory")
 
 
 class SimServing:
@@ -80,10 +67,24 @@ class SimServing:
     def __init__(self, *, max_len: int = 64, page_size: int = 8,
                  n_pool_pages: int | None = None, slots: int = 8,
                  vocab: int = 509, salt: int = 0,
-                 chunked_prefill: int | None = None):
+                 chunked_prefill: int | None = None, tp=None):
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {page_size}")
+        # ``tp`` (TPConfig / int degree): the sim's TENSOR-PARALLEL
+        # stand-in. The token pool stays ONE host array — the token
+        # rule hashes full histories, there are no heads to split —
+        # but the factory advertises the tp degree (``tp_``) and the
+        # per-device byte arithmetic (``pool_device_bytes``: total /
+        # size, exactly what a head-sharded pool measures), so the
+        # ENGINE/CLUSTER tp machinery — paged-policy coercion, pool
+        # byte census + gauge, handoff tp tags and placement filters —
+        # runs at 10^5-request scale. Compute-sharding parity is the
+        # real factory's claim, not the sim's.
+        from ..models.nlp.llama_decode import (PagedOnlyDense,
+                                               as_tp_config)
+        self.tp_ = as_tp_config(tp)
+        self.dense = PagedOnlyDense(_SIM_DENSE_REASON)
         if vocab < 3:
             raise ValueError("vocab must be >= 3")
         if n_pool_pages is None:
@@ -106,7 +107,6 @@ class SimServing:
             acc = (acc * mul) & mask
         self._pow = np.asarray(p, np.uint64)
         pools = np.zeros((n_pool_pages, page_size), np.int64)
-        self.dense = _SimDense()
         self.paged_parts = (None, None, pools, self._make_prefill(),
                             None, self._make_decode_n())
 
@@ -177,6 +177,12 @@ class SimServing:
 
         decode_n._cache_size = lambda: 0
         return decode_n
+
+    def pool_device_bytes(self, pools) -> int:
+        """One device's share of the pool under the advertised tp
+        degree (the engine's per-device byte census hook)."""
+        size = self.tp_.size if self.tp_ is not None else 1
+        return int(np.asarray(pools).nbytes) // size
 
     # --- KV handoff data plane ---------------------------------------------
     @staticmethod

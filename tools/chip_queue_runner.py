@@ -103,6 +103,17 @@ QUEUE = [
     ("serving_disagg",
      [sys.executable, "tools/serving_workload_bench.py", "--disagg"],
      {}),
+    # PR-10 addition: the tensor-parallel sharded-serving arm — the
+    # mixed trace through the real factory at TP=1 vs TP=2/TP=4
+    # (decode weights + paged KV pool NamedSharding-split over a named
+    # mesh) plus a sim bookkeeping arm and a per-device HBM capacity
+    # demo; bench_gate.py serving gates the serving_tp family (greedy
+    # parity vs TP=1, per-device pool bytes <= 0.55x at TP=2,
+    # over-budget model serves only under TP). On a single-chip
+    # backend the arm degrades to a graceful no-JSON FAIL.
+    ("serving_tp",
+     [sys.executable, "tools/serving_workload_bench.py", "--tp"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

@@ -469,6 +469,192 @@ def _disagg_arm(args):
     return 0
 
 
+def _tp_arm(args):
+    """The tensor-parallel sharded-serving arm: ONE seeded mixed trace
+    (ragged lengths, shared prefixes, churn) replayed on the fixed
+    clock through the REAL tiny-llama chunked-prefill factory at
+    TP=1 (unsharded baseline, paged policy) vs TP=2 and TP=4
+    (``TPConfig``: decode weights column/row-parallel, paged KV pool
+    split by kv head over the named mesh) — one ``serving_tp`` row
+    per arm carrying the virtual TTFT/TPOT/tokens-per-sec AND the
+    measured per-device pool byte census; then a sim-backed
+    bookkeeping arm at larger request count, a CAPACITY demo (a
+    per-device HBM budget the TP=1 placement exceeds and refuses
+    loudly while TP=2 fits and serves), and a ``serving_tp_summary``
+    row with the greedy-parity verdicts.
+
+    `bench_gate.py serving` gates the serving_tp family: TP=2/TP=4
+    streams bit-equal to TP=1, sim parity held, per-device pool
+    bytes at TP=2 <= 0.55x of TP=1 at equal total capacity, and the
+    over-budget model serving ONLY under TP. Needs a multi-device
+    backend: on a single-device image the arm degrades to a graceful
+    no-JSON FAIL (bench_gate reads the absence as FAIL)."""
+    import json as _json
+    import time as _time
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        decode_need_bytes_per_device, llama_serving_decode_factory)
+    from paddle_tpu.serving import (ServingEngine, TPConfig,
+                                    make_sim_serving, synthesize_trace,
+                                    trace_stats)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    n_dev = len(jax.devices())
+    degrees = [d for d in (2, 4) if d <= n_dev]
+    if not degrees:
+        # graceful no-JSON FAIL: single-device images cannot shard
+        print("serving_tp: needs >= 2 devices (have "
+              f"{n_dev}) — run under the forced 8-device CPU mesh or "
+              "on a multi-chip slice", flush=True)
+        return 1
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    device = str(jax.devices()[0])
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12,
+                          num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        slots, page_size, max_len = 8, 64, 1024
+        prompt_rng, out_rng = (64, 320), (16, 64)
+        n_req = args.requests or 24
+    else:
+        # kv_heads=4 so TP=2 AND TP=4 divide the head partitions
+        cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                               kv_heads=4)
+        slots, page_size, max_len = 4, 8, 64
+        prompt_rng, out_rng = (6, 18), (4, 12)
+        n_req = args.requests or 16
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    trace = synthesize_trace(
+        seed=args.seed, n_requests=n_req, vocab_size=cfg.vocab_size,
+        prompt_len=prompt_rng, output_len=out_rng,
+        shared_prefix_frac=0.25, prefix_len=page_size * 2,
+        churn_frac=0.15)
+    stats = trace_stats(trace)
+
+    def build(tp):
+        return llama_serving_decode_factory(
+            model, max_len=max_len, page_size=page_size,
+            n_pool_pages=slots * (max_len // page_size) + 1 + 4,
+            batch_capacity=slots, chunked_prefill=page_size, tp=tp)
+
+    def factory_need(srv):
+        """Per-device resident bytes of weights + pools — the SAME
+        arithmetic the factory's budget refusal runs (sharding
+        metadata only, so donated pool buffers still answer)."""
+        return decode_need_bytes_per_device(*srv.paged_parts[:3])
+
+    rows, outs, needs = {}, {}, {}
+    for d in [1] + degrees:
+        tp = TPConfig((d,)) if d > 1 else None
+        srv = build(tp)
+        eng = ServingEngine(serving=srv, slots=slots, policy="paged",
+                            clock="fixed")
+        w0 = _time.perf_counter()
+        res = eng.run(trace)
+        wall = _time.perf_counter() - w0
+        pool_total = sum(int(getattr(a, "nbytes", 0))
+                         for a in jax.tree_util.tree_leaves(
+                             srv._live_pools))
+        per_dev = eng.pool_bytes_per_device()
+        if per_dev is None:
+            per_dev = pool_total  # unsharded: one device holds it all
+        needs[d] = factory_need(srv)
+        rec = res.metrics.to_record(
+            policy="paged", device=device, seed=args.seed,
+            slots=slots, trace=stats)
+        rec["bench"] = "serving_tp"
+        rec["arm"] = f"tp{d}"
+        rec["tp"] = d
+        rec["wall_s"] = round(wall, 3)
+        rec["pool_bytes_total"] = pool_total
+        rec["pool_bytes_per_device"] = per_dev
+        rec["weights_plus_pool_bytes_per_device"] = needs[d]
+        rec["census_ok"] = res.cache_stats.get("invariant_ok")
+        rows[d] = rec
+        outs[d] = res.outputs
+        emit(rec)
+
+    # --- sim bookkeeping arm (tp machinery at larger request count) ---
+    sim_trace = synthesize_trace(
+        seed=args.seed + 1, n_requests=max(200, 4 * n_req),
+        vocab_size=509, prompt_len=(6, 24), output_len=(4, 12),
+        shared_prefix_frac=0.25, prefix_len=16, churn_frac=0.15)
+    sim_outs = {}
+    for d in (1, degrees[0]):
+        sim = make_sim_serving(max_len=64, page_size=8, slots=8,
+                               vocab=509,
+                               tp=TPConfig((d,)) if d > 1 else None)
+        eng = ServingEngine(serving=sim, slots=8, policy="paged",
+                            clock="fixed")
+        res = eng.run(sim_trace)
+        sim_outs[d] = res.outputs
+        emit({"bench": "serving_tp", "arm": f"sim_tp{d}", "tp": d,
+              "device": "sim", "seed": args.seed + 1,
+              "requests": len(sim_trace),
+              "completed": res.report()["completed"],
+              "pool_bytes_per_device": eng.pool_bytes_per_device(),
+              "census_ok": res.cache_stats.get("invariant_ok")})
+
+    # --- capacity demo: a per-device budget only TP can fit ----------
+    d2 = degrees[0]
+    budget = (needs[1] + needs[d2]) // 2
+    tp1_refused = False
+    try:
+        build(TPConfig((1,), hbm_budget_bytes_per_device=budget))
+    except MemoryError:
+        tp1_refused = True
+    tp2_served = False
+    try:
+        srv_b = build(TPConfig((d2,),
+                               hbm_budget_bytes_per_device=budget))
+        engb = ServingEngine(serving=srv_b, slots=slots,
+                             policy="paged", clock="fixed")
+        small = trace[: min(4, len(trace))]
+        resb = engb.run(small)
+        tp2_served = (resb.report()["completed"] == len(small)
+                      and all(resb.outputs[r.rid] == outs[1][r.rid]
+                              for r in small))
+    except MemoryError:
+        pass
+    emit({"bench": "serving_tp_capacity", "device": device,
+          "budget_bytes_per_device": budget,
+          "tp1_need_bytes": needs[1], f"tp{d2}_need_bytes": needs[d2],
+          "tp1_refused": tp1_refused,
+          f"tp{d2}_served": tp2_served, "tp2_served": tp2_served})
+
+    ratio = (rows[d2]["pool_bytes_per_device"]
+             / rows[1]["pool_bytes_per_device"]) \
+        if rows[1]["pool_bytes_per_device"] else None
+    emit({"bench": "serving_tp_summary", "device": device,
+          "seed": args.seed, "requests": n_req,
+          "tp_degrees": degrees,
+          "parity_tp2": outs[degrees[0]] == outs[1],
+          "parity_tp4": (outs[4] == outs[1]) if 4 in outs else None,
+          "sim_parity": sim_outs[degrees[0]] == sim_outs[1],
+          "pool_bytes_per_device_tp1":
+          rows[1]["pool_bytes_per_device"],
+          f"pool_bytes_per_device_tp{d2}":
+          rows[d2]["pool_bytes_per_device"],
+          "pool_bytes_ratio_tp2": round(ratio, 4)
+          if ratio is not None else None,
+          "bytes_reduction_tp2": round(1.0 / ratio, 4)
+          if ratio else None,
+          "capacity_tp1_refused": tp1_refused,
+          "capacity_tp2_served": tp2_served})
+    return 0
+
+
 def _chaos_arm(args):
     """The fault-tolerance arm: the SAME ~10^5-request sim-backed
     overload trace as --cluster, replayed twice through prefix_aware
@@ -823,6 +1009,19 @@ def main(argv=None):
                          "serving_disagg family (lane TPOT p95 >= "
                          "1.3x, TTFT p50 held, token parity, handoff "
                          "census balanced)")
+    ap.add_argument("--tp", action="store_true",
+                    help="run the tensor-parallel arm instead: the "
+                         "mixed trace through the real tiny-llama "
+                         "factory at TP=1 vs TP=2/TP=4 (decode "
+                         "weights + paged KV pool sharded over a "
+                         "named mesh) plus a sim bookkeeping arm and "
+                         "a per-device HBM capacity demo; "
+                         "bench_gate.py serving gates the serving_tp "
+                         "family (greedy parity, per-device pool "
+                         "bytes <= 0.55x at TP=2, over-budget model "
+                         "serves only under TP). Degrades to a "
+                         "graceful no-JSON FAIL on single-device "
+                         "images")
     ap.add_argument("--lane-budget", type=int, default=2,
                     help="disagg arm: prefill chunks per engine turn "
                          "in the async lane")
@@ -868,6 +1067,16 @@ def main(argv=None):
 
     import os
 
+    if args.tp and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # the TP arm needs a multi-device backend: force the 8-virtual-
+        # device CPU mesh (tests/conftest.py's convention; a real
+        # multi-chip slice is unaffected — the flag only touches the
+        # host platform). Must land before first backend use.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+
     import jax
     if args.cpu or os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         jax.config.update("jax_platforms", "cpu")
@@ -890,6 +1099,8 @@ def main(argv=None):
         return _disagg_arm(args)
     if args.slo:
         return _slo_arm(args)
+    if args.tp:
+        return _tp_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)

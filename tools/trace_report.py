@@ -201,6 +201,28 @@ def lane_summaries(events: list, tracks: dict,
     return rows
 
 
+def tp_summary(events: list) -> dict | None:
+    """Tensor-parallel evidence: engine prefill/decode spans carry a
+    ``tp=N`` arg when the run's decode path was mesh-sharded
+    (``ServingEngine(tp=...)``). Returns the ``trace_report_tp`` row,
+    or None for unsharded traces — whose report output stays
+    byte-identical to pre-TP."""
+    tagged = [e for e in events if e.get("ph") == "X"
+              and e.get("args", {}).get("tp") is not None]
+    if not tagged:
+        return None
+    degrees = sorted({int(e["args"]["tp"]) for e in tagged})
+    by_kind: dict = {}
+    for e in tagged:
+        k = e.get("name", "?")
+        by_kind[k] = by_kind.get(k, 0) + 1
+    return {"bench": "trace_report_tp",
+            "tp": degrees[0] if len(degrees) == 1 else degrees,
+            "tagged_spans": len(tagged),
+            "prefill_spans": by_kind.get("prefill", 0),
+            "decode_spans": by_kind.get("decode", 0)}
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -404,6 +426,14 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
     for name, frac in sorted(occ.items()):
         bar = "#" * int(frac * 30)
         lines.append(f"  {name:8s} {frac:7.1%} {bar}")
+    tp_row = tp_summary(events)
+    if tp_row is not None:
+        # only sharded-decode traces grow this line — unsharded
+        # traces render byte-identically
+        lines.append(f"\n== tensor parallel: tp={tp_row['tp']} "
+                     f"({tp_row['prefill_spans']} prefill + "
+                     f"{tp_row['decode_spans']} decode spans "
+                     f"sharded) ==")
     chaos = chaos_events(events)
     if chaos:
         # only chaos traces grow this section — pre-fault traces
@@ -446,6 +476,11 @@ def main(argv=None) -> int:
             print(json.dumps(row))
         for row in lane_summaries(events, tracks, per_track):
             print(json.dumps(row))
+        tp_row = tp_summary(events)
+        if tp_row is not None:
+            # sharded-decode traces only: absent otherwise, so
+            # pre-TP --json output is byte-identical
+            print(json.dumps(tp_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
