@@ -210,6 +210,18 @@ class Incident:
             self.t_close = round(float(t), 6)
             self.resolution = resolution
 
+    def act(self, t: float, action: str):
+        """An automated responder (the autoscaling control plane)
+        ACTED on this incident: the action is stamped into the
+        evidence (``action_taken``) and the incident closes with
+        resolution ``"action_taken"`` — so the postmortem reader sees
+        not just that the alert fired but WHICH remediation resolved
+        it. Idempotent like ``close``: an already-closed incident is
+        left as the first resolution recorded it."""
+        if self.t_close is None:
+            self.evidence["action_taken"] = action
+            self.close(t, "action_taken")
+
     def to_json(self) -> dict:
         d = {"id": self.id, "rule": self.rule, "kind": self.kind,
              "severity": self.severity, "source": self.source,

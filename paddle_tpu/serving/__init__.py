@@ -29,6 +29,8 @@ overload trace fifo-vs-qos, ``--cluster`` the 10^5-request trace
 across placements, ``--chaos``/``--slo`` the seeded fault schedule);
 ``tools/bench_gate.py serving``/``obs`` gate every family.
 """
+from .autoscale import (AutoscaleConfig, Autoscaler,  # noqa: F401
+                        count_oscillations)
 from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       DisaggregatedPlacement, LeastLoadedPlacement,
                       PlacementPolicy, PrefixAwarePlacement,
@@ -48,6 +50,8 @@ from .sim import SimServing, make_sim_serving  # noqa: F401
 from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        load_trace, merge_traces, save_trace,
                        synthesize_cluster_trace,
+                       synthesize_diurnal_trace,
+                       synthesize_flash_crowd_trace,
                        synthesize_overload_trace,
                        synthesize_prefill_heavy_trace,
                        synthesize_recurring_prefix_trace,

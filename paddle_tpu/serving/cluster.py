@@ -64,6 +64,7 @@ from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
+from .autoscale import Autoscaler
 from .engine import (EngineSession, KVHandoff, ServeResult,
                      ServingEngine)
 from .faults import (FAULT_SEVERITY, FailoverConfig, FaultEvent,
@@ -291,6 +292,35 @@ class ClusterResult:
     # monitor-on replay's records byte-identical to monitor-off
     slo_log: Optional[object] = None    # the shared IncidentLog
     flight: Optional[object] = None     # the FlightRecorder, if any
+    autoscale: Optional[dict] = None    # Autoscaler.summary() — the
+    # byte-deterministic action log plus per-kind counts — when the
+    # router ran with autoscale=...; None otherwise (and nothing in
+    # the replay differs from a pre-autoscale router)
+    replica_hours: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)           # name -> {joined, left, hours}
+    # — the capacity-cost ledger elastic autoscaling is judged on
+    # (replica-hours strictly below a static fleet at equal goodput)
+
+    def replica_hours_total(self) -> float:
+        """Summed live time across every replica that ever joined —
+        the denominator of the autoscaling economics claim."""
+        return round(sum(h["hours"]
+                         for h in self.replica_hours.values()), 6)
+
+    def save_actions(self, path: str) -> str:
+        """Dump the autoscaler's action log as JSONL (atomic, the
+        shared ``obs`` write discipline) — the artifact the
+        determinism gate byte-compares across seeded replays. Raises
+        when the router ran without autoscale=."""
+        if self.autoscale is None:
+            raise ValueError("this replay ran without an autoscaler "
+                             "(ClusterRouter(autoscale=...)) — there "
+                             "is no action log to save")
+        import json as _json
+        obs_slo._atomic_write(
+            path, "".join(_json.dumps(a) + "\n"
+                          for a in self.autoscale["actions"]))
+        return path
 
     def save_incidents(self, path: str) -> str:
         """Dump the run's incident set as JSONL (atomic; loads back
@@ -495,6 +525,13 @@ class ClusterResult:
             rec["handed_off_requests"] = sum(
                 1 for led in self.ledger.values()
                 if led.get("handoffs"))
+        rec["replica_hours"] = self.replica_hours_total()
+        if self.autoscale is not None:
+            # only autoscaled replays grow this block
+            rec["autoscale"] = {k: self.autoscale[k]
+                                for k in ("joins", "drains",
+                                          "drain_noops",
+                                          "role_changes", "degrades")}
         return rec
 
 
@@ -533,7 +570,8 @@ class ClusterRouter:
                  failover: Optional[FailoverConfig] = None,
                  roles: Optional[Dict[str, str]] = None,
                  kv_transfer_unit: float = 0.0,
-                 slo=None, flight=None, slo_on_incident=()):
+                 slo=None, flight=None, slo_on_incident=(),
+                 autoscale: Optional[Autoscaler] = None):
         if not callable(spawn):
             raise ValueError("spawn must be callable: name -> "
                              "ServingEngine (one engine+factory per "
@@ -609,6 +647,31 @@ class ClusterRouter:
                              "IncidentLog")
         self._slo_rules = None if slo is None else list(slo)
         self._slo_cbs = list(slo_on_incident)
+        # --- elastic autoscaling (inert without autoscale=) ---------
+        # autoscale: an autoscale.Autoscaler — the control plane that
+        # ACTS on the incident stream: joins standby replicas on
+        # sustained burn, drains idle ones when the budget recovers,
+        # re-assigns prefill<->decode roles as the mix shifts, and
+        # fans page incidents into every live QoSScheduler (tier
+        # actuation). Decisions run at fixed ticks on the shared
+        # timeline (plus the incident-open callback), so seeded
+        # replays produce a byte-identical action log. Requires slo=
+        # (the detect half of the loop); with autoscale=None nothing
+        # here runs and the replay is byte-identical to a
+        # pre-autoscale router.
+        if autoscale is not None \
+                and not isinstance(autoscale, Autoscaler):
+            raise ValueError("autoscale= takes an autoscale.Autoscaler")
+        if autoscale is not None and slo is None:
+            raise ValueError("autoscale= needs slo= (pass a rules "
+                             "sequence — even [] — so the autoscaler "
+                             "has an incident stream to subscribe to)")
+        self._autoscaler = autoscale
+        if autoscale is not None:
+            autoscale.attach()
+            # subscription BEFORE the monitors copy the callback list
+            self._slo_cbs.append(self._autoscale_on_incident)
+        self._hours: Dict[str, dict] = {}
         if flight is not None and slo is None:
             raise ValueError("flight= needs slo= (bundles are written "
                              "when an SLO incident fires)")
@@ -659,6 +722,16 @@ class ClusterRouter:
         rep.monitor = mon
         self._next_index += 1
         self.replicas.append(rep)
+        self._hours[name] = {"joined": round(t, 6), "left": None,
+                             "hours": 0.0}
+        if self._autoscaler is not None and sess.sched is not None \
+                and hasattr(sess.sched, "note_incident"):
+            # a joiner enters mid-incident degraded like its peers:
+            # page incidents that are still open reach its scheduler
+            # now, not at the next incident (custom schedulers
+            # without the seam are skipped, same as at incident-open)
+            for inc in self._autoscaler.open_page_incidents():
+                sess.sched.note_incident(inc)
         self._g_load("cluster_replica_load",
                      "queued + in-flight requests on a replica",
                      replica=name).set(0.0)
@@ -752,6 +825,7 @@ class ClusterRouter:
         ok = bool(cs.get("invariant_ok")
                   and cs.get("resident_pages") == 0)
         self.results[rep.name] = res
+        self._close_hours(rep.name, t)
         self.replicas.remove(rep)
         self._g_load("cluster_replica_load",
                      "queued + in-flight requests on a replica",
@@ -1123,6 +1197,100 @@ class ClusterRouter:
                         rep, len(r.prompt), r.max_new_tokens))
         return True
 
+    # --- elastic autoscaling (the detect -> act loop) ----------------------
+    def _close_hours(self, name: str, t: float):
+        h = self._hours.get(name)
+        if h is not None and h["left"] is None:
+            h["left"] = round(t, 6)
+            h["hours"] = round(max(0.0, h["left"] - h["joined"]), 6)
+
+    def _standby_name(self, base: str) -> str:
+        """The generation-suffix allocator: a standby base name that
+        already served (and retired) this run rejoins as ``base#2``,
+        ``base#3``, ... — the recycled replica gets a fresh
+        ServeResult slot, so the exactly-once census (which is keyed
+        by REQUEST, not replica) conserves and no retired history is
+        overwritten. Direct (event-scheduled) joins of a retired name
+        still refuse — only the autoscaler recycles."""
+        if self._find(base) is None and base not in self.results:
+            return base
+        g = 2
+        while self._find(f"{base}#{g}") is not None \
+                or f"{base}#{g}" in self.results:
+            g += 1
+        return f"{base}#{g}"
+
+    def _autoscale_on_incident(self, inc):
+        """The autoscaler's incident subscription (rides the same
+        ``on_incident`` list as any other subscriber): scale-worthy
+        incidents arm the next tick's join; page-severity incidents
+        flip QoS degradation tiers in EVERY live scheduler the moment
+        they open — before any shed the overload would otherwise
+        force — via the ``note_incident`` seam declared in PR 3."""
+        if self._autoscaler.note_incident(inc) != "degrade":
+            return
+        n = 0
+        for rep in self.replicas:
+            sch = rep.session.sched
+            if sch is not None and hasattr(sch, "note_incident"):
+                sch.note_incident(inc)
+                n += 1
+        if n:
+            self._autoscaler.log_degrade(inc)
+            self.events_log.append({"t": round(inc.t_open, 6),
+                                    "event": "autoscale",
+                                    "action": "degrade",
+                                    "incident": inc.id,
+                                    "schedulers": n})
+            if self._tracer is not None:
+                self._tracer.instant("autoscale", t=inc.t_open,
+                                     track="cluster", action="degrade",
+                                     incident=inc.id)
+
+    def _autoscale_tick(self, t: float):
+        """One control-plane evaluation on the shared timeline: the
+        autoscaler decides (cooldowns/hysteresis inside), the router
+        executes — joins spawn through the standard ``_join`` path,
+        drains through ``_drain`` (requeue + retirement semantics
+        unchanged), role flips retag the replica and its session (the
+        per-turn export sink and the placement policy both read the
+        CURRENT role, so in-flight work finishes under the old stage
+        and new work enters under the new one)."""
+        # cluster-wide cumulative sheds (live sessions + banked
+        # results): the loss signal that carries an armed scale-up
+        # episode past its single triggering incident
+        sheds = sum(len(rep.session.shed_log) for rep in self.replicas) \
+            + sum(len(res.shed) for res in self.results.values())
+        acts = self._autoscaler.decide(t, self.replicas,
+                                       self._standby_name,
+                                       sheds_total=sheds)
+        for act in acts:
+            kind = act["action"]
+            self.events_log.append(
+                {"t": round(t, 6), "event": "autoscale",
+                 **{k: v for k, v in act.items() if k != "t"}})
+            if self._tracer is not None:
+                self._tracer.instant(
+                    "autoscale", t=t, track="cluster", action=kind,
+                    replica=act.get("replica"),
+                    reason=act.get("reason"))
+            if kind == "join":
+                self._join(act["replica"], t)
+            elif kind == "drain":
+                self._drain(act["replica"], t)
+            elif kind == "role":
+                rep = self._rep(act["replica"])
+                rep.role = act["to"]
+                rep.session.role = act["to"]
+                self._roles[act["replica"]] = act["to"]
+                if self._tracer is not None:
+                    self._tracer.instant("role", t=t, track="cluster",
+                                         replica=rep.name,
+                                         role=act["to"])
+            # "drain_noop_crashed" and "degrade" execute nothing here:
+            # the noop IS the action (logged loudly, the failover owns
+            # the removal), and degrades actuate at incident-open time
+
     @staticmethod
     def _ctr_retry(reason: str):
         obs_metrics.REGISTRY.counter(
@@ -1268,6 +1436,24 @@ class ClusterRouter:
             while k * cfg.heartbeat_interval <= horizon:
                 self._push(k * cfg.heartbeat_interval, 4, ("hb",))
                 k += 1
+        if self._autoscaler is not None:
+            # standing control-plane ticks: decisions evaluate at a
+            # fixed cadence on the shared timeline (priority AFTER
+            # arrivals/faults/probes at the same instant, so a tick
+            # reads the state those events left), which is what makes
+            # the action log byte-deterministic across replays. Ticks
+            # are scheduled statically up to the last arrival/fault;
+            # past it the loop below CHAINS further ticks while any
+            # live replica still owes work, so a spike at the end of
+            # the span keeps the control plane awake through its
+            # backlog drain (late joins answered, recovered capacity
+            # drained) without charging replica-hours for ticks over
+            # a fully idle fleet
+            iv = self._autoscaler.cfg.interval
+            k = 1
+            while k * iv <= t_last:
+                self._push(k * iv, 6, ("as",))
+                k += 1
 
         prev_tr = obs_trace.active()
         if self._tracer is not None:
@@ -1314,6 +1500,22 @@ class ClusterRouter:
                     if self._place_or_fail(r2, t) and kept:
                         self._salvage.setdefault(
                             r2.rid, []).extend(kept)
+                elif item[0] == "as":
+                    self._autoscale_tick(t)
+                    iv = self._autoscaler.cfg.interval
+                    if t + iv > t_last and any(
+                            not rep.session.crashed
+                            and rep.session.load() > 0
+                            for rep in self.replicas):
+                        # the tail extension: arrivals/faults are
+                        # exhausted but some live replica still owes
+                        # work, so the control plane stays awake one
+                        # more tick (deterministic — chained off the
+                        # same virtual state every replay sees).
+                        # Crashed corpses are excluded: their frozen
+                        # load never drains, and the heap must empty
+                        # for the end-of-run failover rescue to fire.
+                        self._push(t + iv, 6, ("as",))
                 elif item[0] not in ("hb", "ht"):
                     op, name = item
                     if op == "drain" and self._faults is not None \
@@ -1374,6 +1576,7 @@ class ClusterRouter:
                             cs.get("invariant_ok")
                             and cs.get("resident_pages") == 0),
                         "resident_pages": cs.get("resident_pages")})
+                self._close_hours(rep.name, rep.session.clock.now())
                 self.replicas.remove(rep)
         finally:
             if self._tracer is not None:
@@ -1401,4 +1604,8 @@ class ClusterRouter:
                                         if self.slo_log is not None
                                         else None),
                              slo_log=self.slo_log,
-                             flight=self.flight)
+                             flight=self.flight,
+                             autoscale=(self._autoscaler.summary()
+                                        if self._autoscaler is not None
+                                        else None),
+                             replica_hours=dict(self._hours))

@@ -1852,6 +1852,16 @@ class EngineSession:
         self.tr = tracer
         self.slo = slo
         self.m = MetricsCollector(monitor=slo)
+        self._g_busy = None
+        if slo is not None:
+            # the utilization gauge rides the monitored path only, so
+            # unmonitored replays leave no trace of it in the
+            # registry (PR-5 convention); the child is resolved once
+            # here, not per turn
+            self._g_busy = obs_metrics.REGISTRY.gauge(
+                "serving_replica_busy_frac",
+                "busy decode slots / slot capacity, sampled per turn",
+                replica=replica or "-")
         self.book = PagedKVCache(eng.n_pool_pages, eng.page_size,
                                  kv_heads=1, head_dim=1)
         eng._note_pool(self.book, self.m)
@@ -2289,6 +2299,15 @@ class EngineSession:
         clock, tr, m = self.clock, self.tr, self.m
         now = clock.now()
         m.on_queue_depth(now, self.queued())
+        # decode-slot utilization (busy slots / capacity), sampled
+        # once per turn like queue depth: the live gauge any scrape
+        # reads, and — through the collector — the SLO-watchable
+        # `replica_busy_frac` signal the autoscaler's drain decision
+        # stands on (`ThresholdRule(signal="replica_busy_frac")`)
+        busy = (eng.slots - self.free_slot_count()) / eng.slots
+        m.on_busy_frac(now, busy)
+        if self._g_busy is not None:
+            self._g_busy.set(busy)
         if tr is not None:
             tr.counter("queue_depth", self.queued(), t=now)
         progressed = False

@@ -187,6 +187,18 @@ class MetricsCollector:
         if self._mon is not None:
             self._mon.observe_value("prefill_lane_depth", depth, t)
 
+    def on_busy_frac(self, t: float, frac: float):
+        """Decode-slot utilization sample (busy slots / capacity,
+        engine-fed once per turn). Stored nowhere (the
+        ``serving_replica_busy_frac`` gauge exports it live); exists
+        to stream the signal to an attached SLO monitor — the drain-
+        decision input, watchable via ``ThresholdRule(signal=
+        "replica_busy_frac", op="<=", ...)`` like any gauge sample.
+        A no-op without a monitor, so pre-SLO replays are
+        untouched."""
+        if self._mon is not None:
+            self._mon.observe_value("replica_busy_frac", frac, t)
+
     def on_pool_bytes(self, t: float, per_device_bytes: int):
         """Per-device KV-pool residency sample (tensor-parallel
         engines only — unsharded runs never call this). Stored

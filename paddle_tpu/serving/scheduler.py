@@ -161,7 +161,8 @@ class QoSScheduler:
                  degrade_tiers: Tuple[float, ...] = (1.0, 0.75, 0.5,
                                                      0.25),
                  headroom: float = 1.5,
-                 aging: Optional[float] = None):
+                 aging: Optional[float] = None,
+                 incident_degrade: Optional[float] = None):
         self.tenant_weights = dict(tenant_weights or {})
         for t, w in self.tenant_weights.items():
             if w <= 0:
@@ -179,13 +180,26 @@ class QoSScheduler:
         if aging is not None and aging <= 0:
             raise ValueError("aging must be > 0 clock units (or None)")
         self.aging = aging
+        if incident_degrade is not None \
+                and not 0.0 < incident_degrade <= 1.0:
+            raise ValueError("incident_degrade is a budget fraction "
+                             "in (0, 1] (or None to disable incident-"
+                             "driven degradation)")
+        # incident-driven TIER ACTUATION (the autoscaling control
+        # plane's "degrade before shedding" action): while any
+        # page-severity incident delivered through note_incident is
+        # still OPEN, admission budgets are clamped to at most this
+        # fraction — every candidate degrades to a shorter answer
+        # before the feasibility check ever sheds it. None (the
+        # default) keeps the pre-actuation arithmetic bit-for-bit.
+        self.incident_degrade = incident_degrade
         # the SLO subscription seam (obs.slo.SLOMonitor on_incident /
-        # subscribe): incidents delivered here accumulate for a future
-        # degradation policy to act on — today the scheduler only
-        # LISTENS (detect-and-report), so admission arithmetic is
-        # untouched by any incident. Survives reset(): incident
-        # history is operator state, not per-run queue state.
+        # subscribe): every incident delivered accumulates here; page
+        # incidents additionally arm the incident_degrade clamp while
+        # open. Survives reset(): incident history is operator state,
+        # not per-run queue state.
         self.incidents_seen: List = []
+        self._page_open: List = []
         self.reset()
 
     # --- state ------------------------------------------------------------
@@ -198,12 +212,28 @@ class QoSScheduler:
     def note_incident(self, incident):
         """``obs.slo`` incident callback: record that an SLO incident
         fired (e.g. ``SLOMonitor(..., on_incident=[sched.
-        note_incident])``). Deliberately does NOT change admission
-        behavior — this is the seam a later degradation policy plugs
-        into (shed earlier / clamp tiers while a page-severity
-        incident is open); wiring it today keeps the monitor
-        detect-and-report only."""
+        note_incident])``). With ``incident_degrade`` unset this is
+        detect-and-report only — admission arithmetic untouched.
+        With it set, a delivered PAGE-severity incident arms the
+        degradation clamp for as long as the incident object stays
+        open (incidents close in place, so no un-note call exists or
+        is needed): the tier actuation the autoscaling control plane
+        drives through this seam."""
         self.incidents_seen.append(incident)
+        if self.incident_degrade is not None \
+                and getattr(incident, "severity", None) == "page":
+            self._page_open.append(incident)
+
+    def _degrade_cap(self) -> Optional[float]:
+        """The active incident-degradation budget fraction, or None.
+        Closed incidents are pruned lazily — the clamp lifts the
+        moment the last armed incident closes."""
+        if self.incident_degrade is None:
+            return None
+        if self._page_open:
+            self._page_open = [i for i in self._page_open
+                               if getattr(i, "open", False)]
+        return self.incident_degrade if self._page_open else None
 
     def waiting(self) -> int:
         return len(self._q)
@@ -300,6 +330,8 @@ class QoSScheduler:
         shed: List[Tuple[Request, str]] = []
         degraded: Dict[str, Tuple[int, int]] = {}
         wave: List[Request] = []
+        cap = self._degrade_cap()  # once per turn: one incident state
+        # governs the whole wave
         remaining = dict(self._q)
         # prefill units ahead of the next candidate (the lane's
         # committed chunks first, then this wave's admitted prefills)
@@ -327,7 +359,7 @@ class QoSScheduler:
                 uncached = len(e.req.prompt)
             r, verdict, cost = self._feasible(e.req, now, queued_cost,
                                               est, decode_chunk,
-                                              uncached)
+                                              uncached, cap=cap)
             if r is None:
                 del self._q[e.req.rid]
                 shed.append((e.req, verdict))
@@ -342,26 +374,42 @@ class QoSScheduler:
 
     def _feasible(self, r: Request, now: float, queued_cost: float,
                   est: ServiceEstimator, decode_chunk: int,
-                  uncached: Optional[int] = None):
+                  uncached: Optional[int] = None,
+                  cap: Optional[float] = None):
         """Clockwork-style check: estimated completion =
         now + queued_cost + own prefill        (admissions serialize;
                                                 each priced by its
                                                 UNCACHED length when a
                                                 probe is given)
             + ceil(budget / decode_chunk) * decode * headroom.
-        Returns (request-or-degraded-copy, rule, prefill_cost) or
-        (None, shed reason, 0.0)."""
+        ``cap`` (the open-incident degradation fraction) replaces the
+        full-budget top tier: every admission — deadline-free ones
+        included — is clamped to at most ``cap`` x its budget while
+        an incident is open, trading answer length for admission
+        headroom BEFORE any shed. Returns (request-or-degraded-copy,
+        rule, prefill_cost) or (None, shed reason, 0.0)."""
         pf = est.prefill_cost(uncached, prompt_tokens=len(r.prompt))
         dl = r.deadline_time()
+        budget = r.max_new_tokens
         if dl is None:
+            if cap is not None:
+                b = max(1, math.ceil(budget * cap))
+                if b < budget:
+                    return (dataclasses.replace(r, max_new_tokens=b),
+                            f"incident degradation tier {cap} "
+                            f"({b}/{budget} tokens)", pf)
             return r, "no deadline", pf
         t0 = now + queued_cost + pf
-        budget = r.max_new_tokens
         # the FULL budget is always tried first — degrade_tiers only
         # say what to fall back to when it does not fit (a tier tuple
-        # without 1.0 must not silently clamp feasible requests)
-        tiers = (1.0,) + tuple(f for f in self.degrade_tiers
-                               if f < 1.0)
+        # without 1.0 must not silently clamp feasible requests).
+        # Under an open incident the cap IS the top tier.
+        if cap is not None:
+            tiers = (cap,) + tuple(f for f in self.degrade_tiers
+                                   if f < cap)
+        else:
+            tiers = (1.0,) + tuple(f for f in self.degrade_tiers
+                                   if f < 1.0)
         for frac in tiers:
             b = max(1, math.ceil(budget * frac))
             fin = t0 + math.ceil(b / decode_chunk) * est.decode \

@@ -525,6 +525,215 @@ def synthesize_prefill_heavy_trace(seed: int = 0, *,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def _profile_times(rng, n: int, span: float, shape) -> np.ndarray:
+    """``n`` sorted arrival times over ``[0, span]`` drawn from an
+    inhomogeneous Poisson process with relative rate ``shape`` (an
+    array sampled on a uniform grid over the span): the standard
+    time-rescaling construction — N arrivals conditioned on the span
+    are N sorted uniforms over the CUMULATIVE intensity, mapped back
+    through its inverse (piecewise-linear interpolation over the
+    grid). Deterministic in (rng state, shape)."""
+    shape = np.asarray(shape, float)
+    if shape.ndim != 1 or len(shape) < 2 or (shape <= 0).any():
+        raise ValueError("shape must be >= 2 strictly positive "
+                         "relative-rate samples")
+    grid = np.linspace(0.0, span, len(shape))
+    cum = np.concatenate([[0.0], np.cumsum(
+        (shape[1:] + shape[:-1]) * 0.5 * np.diff(grid))])
+    u = np.sort(rng.uniform(0.0, cum[-1], n))
+    return np.interp(u, cum, grid)
+
+
+def _profiled_tenant_trace(rng, shape, span: float, *,
+                           tenants: dict,
+                           prompt_len: Tuple[int, int],
+                           budgets: dict, counts, names,
+                           vocab_size: int, unit_ms: float,
+                           chunk_tokens: int, tight_slack: float,
+                           loose_slack: float, rid_prefix: str,
+                           start: float) -> List[Request]:
+    """The shared tenant/deadline body of the rate-profiled traces:
+    identical request semantics to ``synthesize_cluster_trace`` (per-
+    chunk deadline pricing, tight/loose cohort rid tags, bursty
+    tenants sharing one arrival draw per burst) with arrival times
+    drawn from ``shape`` via ``_profile_times`` instead of a flat
+    uniform — so a diurnal day and a flash crowd load the engine with
+    the SAME request mix the overload gates are calibrated on, just
+    on a different clock."""
+    reqs: List[Request] = []
+    for i, name in enumerate(names):
+        cfg = tenants[name]
+        n_t = int(counts[i])
+        if n_t == 0:
+            continue
+        burst = max(1, int(cfg.get("burst", 1)))
+        n_bursts = -(-n_t // burst)
+        burst_times = _profile_times(rng, n_bursts, span, shape)
+        times = np.repeat(burst_times, burst)[:n_t]
+        mode = cfg.get("deadline", "mix")
+        for j in range(n_t):
+            plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+            prompt = tuple(int(t) for t in rng.integers(
+                1, vocab_size, plen))
+            budget = budgets[name][j]
+            tight = {"tight": True, "loose": False}.get(mode, None)
+            if tight is None:
+                tight = bool(rng.random() < 0.5)
+            slack = tight_slack if tight else loose_slack
+            cohort = "tight" if tight else "loose"
+            chunks = -(-len(prompt) // chunk_tokens)
+            reqs.append(Request(
+                rid=f"{rid_prefix}-{name}{j}.{cohort}",
+                arrival=start + float(times[j]), prompt=prompt,
+                max_new_tokens=budget, tenant=name,
+                priority=int(cfg.get("priority", 0)),
+                deadline_ms=round((chunks + budget + 1) * unit_ms
+                                  * slack, 3)))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
+def _tenant_counts_budgets(rng, spec, n_requests, output_len):
+    """The deterministic per-tenant request-count and budget draws
+    every overload-family synthesizer shares (largest-share tenants
+    absorb the rounding remainder; budgets drawn FIRST so the span
+    can be sized to the demanded work)."""
+    names = sorted(spec)
+    shares = np.asarray([float(spec[n].get("share", 1.0))
+                         for n in names])
+    shares = shares / shares.sum()
+    counts = np.floor(shares * n_requests).astype(int)
+    order = np.argsort(-shares)
+    k = 0
+    while counts.sum() < n_requests:
+        counts[order[k % len(names)]] += 1
+        k += 1
+    budgets = {n: [int(rng.integers(output_len[0], output_len[1] + 1))
+                   for _ in range(counts[i])]
+               for i, n in enumerate(names)}
+    return names, counts, budgets
+
+
+def synthesize_diurnal_trace(seed: int = 0,
+                             n_requests: int = 100_000, *,
+                             service_tokens_per_unit: float = 25.0,
+                             peak_overload: float = 1.05,
+                             trough: float = 0.2,
+                             days: float = 1.0,
+                             tenants: Optional[dict] = None,
+                             prompt_len: Tuple[int, int] = (4, 12),
+                             output_len: Tuple[int, int] = (4, 12),
+                             vocab_size: int = 509,
+                             unit_ms: float = 1000.0,
+                             chunk_tokens: int = 8,
+                             tight_slack: float = 2.0,
+                             loose_slack: float = 6.0,
+                             rid_prefix: str = "d",
+                             start: float = 0.0,
+                             grid: int = 2048) -> List[Request]:
+    """The DIURNAL workload: arrival rate follows a day cycle —
+    ``rate(x) = trough + (1 - trough) * sin(pi * days * x)^2`` over
+    the span (``days`` full trough->peak->trough cycles; peak 1.0 at
+    mid-cycle, ``trough`` at the edges). The span is sized so the
+    PEAK instantaneous token demand equals ``peak_overload`` x
+    ``service_tokens_per_unit`` (the fleet capacity the trace is
+    aimed at): a fleet sized to the peak idles most of the day, a
+    fleet sized to the mean burns its error budget every peak — the
+    exact gap elastic autoscaling exists to close, and the virtual
+    clock makes a 10^5-request "day" cheap.
+
+    Tenants/deadlines/rids follow ``synthesize_cluster_trace``'s
+    semantics (per-chunk deadline pricing, ``.tight``/``.loose``
+    cohort tags). Deterministic in every field; JSONL round-trips via
+    ``save_trace``/``load_trace``."""
+    if not 0.0 < trough <= 1.0:
+        raise ValueError("trough is a relative rate in (0, 1]")
+    if peak_overload <= 0 or days <= 0:
+        raise ValueError("peak_overload and days must be > 0")
+    spec = tenants if tenants is not None else DEFAULT_TENANTS
+    if not spec:
+        raise ValueError("need at least one tenant")
+    rng = np.random.default_rng(seed)
+    names, counts, budgets = _tenant_counts_budgets(
+        rng, spec, n_requests, output_len)
+    total_tokens = sum(sum(b) for b in budgets.values())
+    xs = np.linspace(0.0, 1.0, grid)
+    shape = trough + (1.0 - trough) * np.sin(np.pi * days * xs) ** 2
+    mean_f, peak_f = float(shape.mean()), float(shape.max())
+    # peak token rate = (T / (mean_f * span)) * peak_f == po * cap
+    span = total_tokens * peak_f \
+        / (mean_f * peak_overload * service_tokens_per_unit)
+    return _profiled_tenant_trace(
+        rng, shape, span, tenants=spec,
+        prompt_len=prompt_len, budgets=budgets,
+        counts=counts, names=names, vocab_size=vocab_size,
+        unit_ms=unit_ms, chunk_tokens=chunk_tokens,
+        tight_slack=tight_slack, loose_slack=loose_slack,
+        rid_prefix=rid_prefix, start=start)
+
+
+def synthesize_flash_crowd_trace(seed: int = 0,
+                                 n_requests: int = 100_000, *,
+                                 service_tokens_per_unit: float = 25.0,
+                                 base_overload: float = 0.55,
+                                 spikes: Tuple[Tuple[float, float,
+                                                     float], ...]
+                                 = ((0.55, 0.06, 3.5),),
+                                 tenants: Optional[dict] = None,
+                                 prompt_len: Tuple[int, int] = (4, 12),
+                                 output_len: Tuple[int, int] = (4, 12),
+                                 vocab_size: int = 509,
+                                 unit_ms: float = 1000.0,
+                                 chunk_tokens: int = 8,
+                                 tight_slack: float = 2.0,
+                                 loose_slack: float = 6.0,
+                                 rid_prefix: str = "f",
+                                 start: float = 0.0,
+                                 grid: int = 2048) -> List[Request]:
+    """The FLASH-CROWD workload: a steady base rate (sized so base
+    token demand = ``base_overload`` x ``service_tokens_per_unit`` —
+    comfortably under capacity) punctuated by sudden rate spikes.
+    Each spike is ``(t0_frac, dur_frac, magnitude)``: from ``t0_frac``
+    of the span, for ``dur_frac`` of it, the rate multiplies by
+    ``magnitude`` — the viral-moment shape no static fleet sized to
+    NORMAL traffic survives, and the detect->act loop's reaction-time
+    test (a burn-rate incident opens inside the spike; the join must
+    land before the budget is gone).
+
+    Same tenant/deadline semantics as the diurnal trace.
+    Deterministic in every field; JSONL round-trips."""
+    if base_overload <= 0:
+        raise ValueError("base_overload must be > 0")
+    for t0, dur, mag in spikes:
+        if not (0.0 <= t0 < 1.0 and 0.0 < dur <= 1.0 and mag >= 1.0):
+            raise ValueError("each spike is (t0_frac in [0,1), "
+                             "dur_frac in (0,1], magnitude >= 1)")
+    spec = tenants if tenants is not None else DEFAULT_TENANTS
+    if not spec:
+        raise ValueError("need at least one tenant")
+    rng = np.random.default_rng(seed)
+    names, counts, budgets = _tenant_counts_budgets(
+        rng, spec, n_requests, output_len)
+    total_tokens = sum(sum(b) for b in budgets.values())
+    xs = np.linspace(0.0, 1.0, grid)
+    shape = np.ones_like(xs)
+    for t0, dur, mag in spikes:
+        # multiplicative, as documented: overlapping spikes compound
+        # (a single spike from the base rate is identical either way)
+        shape = np.where((xs >= t0) & (xs < t0 + dur),
+                         shape * mag, shape)
+    mean_f = float(shape.mean())
+    # BASE token rate (relative rate 1.0) == base_overload * cap
+    span = total_tokens \
+        / (mean_f * base_overload * service_tokens_per_unit)
+    return _profiled_tenant_trace(
+        rng, shape, span, tenants=spec,
+        prompt_len=prompt_len, budgets=budgets,
+        counts=counts, names=names, vocab_size=vocab_size,
+        unit_ms=unit_ms, chunk_tokens=chunk_tokens,
+        tight_slack=tight_slack, loose_slack=loose_slack,
+        rid_prefix=rid_prefix, start=start)
+
+
 def merge_traces(*traces: Sequence[Request]) -> List[Request]:
     """Interleave traces by arrival time (rids must already be unique —
     give each source a distinct ``rid_prefix``)."""

@@ -1,0 +1,786 @@
+"""SLO-driven elastic autoscaling: the detect->act control plane.
+
+Covers the Autoscaler policy (burn-triggered joins with incident
+closure, low-utilization drains with hysteresis/cooldowns, the
+crashed-drain loud noop, generation-suffixed standby recycling, role
+rebalance), the QoSScheduler incident-degradation tier actuation, the
+``serving_replica_busy_frac`` signal, the diurnal/flash-crowd trace
+synthesizers, byte-identity with the autoscaler off, action-log
+determinism, replica-hours accounting, and the ``serving_autoscale``
+bench-gate family (pass + loud FAIL rows).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.obs import default_serving_rules  # noqa: E402
+from paddle_tpu.obs.slo import (IncidentLog, SLOMonitor,  # noqa: E402
+                                ThresholdRule)
+from paddle_tpu.serving import (AutoscaleConfig, Autoscaler,  # noqa: E402
+                                ClusterRouter, FailoverConfig,
+                                FaultEvent, FaultPlan, QoSScheduler,
+                                Request, ServiceEstimator,
+                                ServingEngine, count_oscillations,
+                                load_trace, make_sim_serving,
+                                save_trace, synthesize_diurnal_trace,
+                                synthesize_flash_crowd_trace,
+                                synthesize_prefill_heavy_trace,
+                                synthesize_trace)
+
+SLOTS, PS, ML, CHUNK = 8, 8, 64, 4
+COSTS = {"prefill_unit": 1.0, "decode": 1.0}
+WEIGHTS = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+CAP6 = 6 * 8.0 / (1.5 + 8.0 / (SLOTS * CHUNK))  # 6-replica fleet
+RULES = dict(long_window=200.0, short_window=40.0, min_events=60,
+             burn_threshold=2.5)
+
+
+def _spawn_qos(name, degrade=0.75):
+    return ServingEngine(
+        serving=make_sim_serving(max_len=ML, page_size=PS, slots=SLOTS,
+                                 vocab=509,
+                                 n_pool_pages=SLOTS * (ML // PS) + 9),
+        slots=SLOTS, policy="paged", clock="fixed", fixed_costs=COSTS,
+        decode_chunk=CHUNK,
+        scheduler=QoSScheduler(max_queue=4 * SLOTS,
+                               tenant_weights=WEIGHTS,
+                               incident_degrade=degrade))
+
+
+def _spawn_fifo(name, slots=4, max_len=96):
+    return ServingEngine(
+        serving=make_sim_serving(max_len=max_len, page_size=PS,
+                                 slots=slots, vocab=509,
+                                 n_pool_pages=slots * (max_len // PS)
+                                 + 17),
+        slots=slots, policy="paged", clock="fixed", fixed_costs=COSTS,
+        decode_chunk=CHUNK)
+
+
+def _asc(**over):
+    kw = dict(standby=("s0", "s1", "s2", "s3"), min_replicas=2,
+              max_replicas=8, interval=10.0, join_cooldown=30.0,
+              drain_cooldown=120.0, hold_after_join=150.0,
+              hold_after_drain=40.0, drain_sustain=120.0,
+              drain_below=0.5, recover_sustain=120.0)
+    kw.update(over)
+    return Autoscaler(AutoscaleConfig(**kw))
+
+
+def _flash(n=2000, seed=0):
+    return synthesize_flash_crowd_trace(
+        seed=seed, n_requests=n, service_tokens_per_unit=CAP6,
+        base_overload=0.55, spikes=((0.55, 0.08, 4.0),))
+
+
+# --- workload synthesizers --------------------------------------------------
+
+def test_diurnal_trace_deterministic_and_shaped(tmp_path):
+    a = synthesize_diurnal_trace(seed=3, n_requests=1500,
+                                 service_tokens_per_unit=CAP6)
+    b = synthesize_diurnal_trace(seed=3, n_requests=1500,
+                                 service_tokens_per_unit=CAP6)
+    assert a == b
+    p = str(tmp_path / "d.jsonl")
+    save_trace(p, a)
+    assert load_trace(p) == a
+    # the rate profile is real: the mid-span (peak) third carries far
+    # more arrivals than the edge (trough) thirds combined per unit
+    span = a[-1].arrival - a[0].arrival
+    t0 = a[0].arrival
+    thirds = [0, 0, 0]
+    for r in a:
+        thirds[min(2, int(3 * (r.arrival - t0) / (span + 1e-9)))] += 1
+    assert thirds[1] > 1.5 * max(thirds[0], thirds[2])
+
+
+def test_flash_trace_spike_density(tmp_path):
+    tr = _flash(n=3000)
+    assert tr == _flash(n=3000)
+    span = tr[-1].arrival - tr[0].arrival
+    t0 = tr[0].arrival
+    in_spike = sum(1 for r in tr
+                   if 0.55 <= (r.arrival - t0) / span < 0.63)
+    # spike window (8% of span at 4x rate) holds ~4x its uniform share
+    assert in_spike > 2.5 * 0.08 * len(tr)
+    p = str(tmp_path / "f.jsonl")
+    save_trace(p, tr)
+    assert load_trace(p) == tr
+
+
+def test_trace_synthesizer_validation():
+    with pytest.raises(ValueError, match="trough"):
+        synthesize_diurnal_trace(trough=0.0)
+    with pytest.raises(ValueError, match="spike"):
+        synthesize_flash_crowd_trace(spikes=((1.2, 0.1, 2.0),))
+    with pytest.raises(ValueError, match="spike"):
+        synthesize_flash_crowd_trace(spikes=((0.1, 0.1, 0.5),))
+
+
+# --- config + lifecycle validation ------------------------------------------
+
+def test_autoscale_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="interval"):
+        AutoscaleConfig(interval=0.0)
+    with pytest.raises(ValueError, match="join_above"):
+        AutoscaleConfig(drain_below=0.9, join_above=0.8)
+    with pytest.raises(ValueError, match="drain_sustain"):
+        AutoscaleConfig(drain_sustain=-1.0)
+    with pytest.raises(ValueError, match="scale_severity"):
+        AutoscaleConfig(scale_severity="critical")
+    with pytest.raises(ValueError, match="prefill_lo"):
+        AutoscaleConfig(prefill_lo=9.0, prefill_hi=3.0)
+    with pytest.raises(ValueError, match="not both"):
+        Autoscaler(AutoscaleConfig(), interval=5.0)
+
+
+def test_autoscaler_attach_once_and_requires_slo():
+    asc = _asc()
+    ClusterRouter(_spawn_fifo, 2, slo=[], autoscale=asc)
+    with pytest.raises(RuntimeError, match="fresh one"):
+        ClusterRouter(_spawn_fifo, 2, slo=[], autoscale=asc)
+    with pytest.raises(ValueError, match="needs slo="):
+        ClusterRouter(_spawn_fifo, 2, autoscale=_asc())
+    with pytest.raises(ValueError, match="Autoscaler"):
+        ClusterRouter(_spawn_fifo, 2, slo=[], autoscale="yes")
+
+
+# --- the decide() unit surface ----------------------------------------------
+
+class _FakeSession:
+    def __init__(self, slots=8, free=8, crashed=False, load=0,
+                 backlog=0, sheds=0):
+        self.eng = type("E", (), {"slots": slots})()
+        self._free = free
+        self.crashed = crashed
+        self._load = load
+        self._backlog = backlog
+        self.shed_log = {f"x{i}": "r" for i in range(sheds)}
+
+    def free_slot_count(self):
+        return self._free
+
+    def load(self):
+        return self._load
+
+    def prefill_backlog(self):
+        return self._backlog
+
+
+class _FakeRep:
+    def __init__(self, name, index, sess, role="both", admitting=True):
+        self.name = name
+        self.index = index
+        self.session = sess
+        self.role = role
+        self.admitting = admitting
+
+
+def _incident(log=None, kind="burn_rate", severity="page", t=0.0):
+    log = log if log is not None else IncidentLog()
+    return log.open(rule="deadline_burn", kind=kind, severity=severity,
+                    t=t, source="r0")
+
+
+def test_incident_storm_inside_cooldown_takes_one_join():
+    asc = _asc(join_cooldown=30.0)
+    reps = [_FakeRep("r0", 0, _FakeSession(free=0)),
+            _FakeRep("r1", 1, _FakeSession(free=0))]
+    log = IncidentLog()
+    # a storm: five incidents land before the first tick
+    incs = [_incident(log, t=float(i)) for i in range(5)]
+    for i in incs:
+        asc.note_incident(i)
+    acts = asc.decide(10.0, reps, lambda b: b)
+    assert [a["action"] for a in acts] == ["join"]
+    # every open scale incident was closed by THE one action
+    assert all(i.resolution == "action_taken" for i in incs)
+    assert all(i.evidence["action_taken"].startswith("join:")
+               for i in incs)
+    # more incidents inside the cooldown: NO duplicate action
+    for i in range(3):
+        asc.note_incident(_incident(log, t=12.0 + i))
+    assert asc.decide(20.0, reps, lambda b: b) == []
+    assert asc.decide(30.0, reps, lambda b: b) == []
+    # cooldown passed (first join at t=10): the next one may land
+    acts = asc.decide(40.0, reps, lambda b: b)
+    assert [a["action"] for a in acts] == ["join"]
+    assert asc.summary()["joins"] == 2
+
+
+def test_join_respects_max_replicas_and_standby():
+    asc = _asc(standby=("s0",), max_replicas=3)
+    reps = [_FakeRep(f"r{i}", i, _FakeSession(free=0))
+            for i in range(3)]
+    asc.note_incident(_incident())
+    assert asc.decide(10.0, reps, lambda b: b) == []  # at the cap
+    asc2 = _asc(standby=(), max_replicas=8)
+    asc2.note_incident(_incident())
+    assert asc2.decide(10.0, reps, lambda b: b) == []  # pool empty
+
+
+def test_drain_needs_sustained_low_util_and_hysteresis():
+    asc = _asc(drain_sustain=50.0, hold_after_join=150.0,
+               drain_cooldown=20.0, recover_sustain=20.0)
+    reps = [_FakeRep(f"r{i}", i, _FakeSession(free=8))
+            for i in range(4)]
+    # idle from t=10, but the sustain window must elapse first
+    assert asc.decide(10.0, reps, lambda b: b) == []
+    assert asc.decide(40.0, reps, lambda b: b) == []
+    acts = asc.decide(60.0, reps, lambda b: b)
+    assert [a["action"] for a in acts] == ["drain"]
+    # the drained base name returned to the standby pool
+    assert asc.standby_available()[-1] == acts[0]["replica"]
+    # a join resets the hysteresis: no drain inside hold_after_join
+    asc2 = _asc(drain_sustain=10.0, hold_after_join=100.0,
+                join_cooldown=1.0, hold_after_drain=0.0,
+                recover_sustain=20.0)
+    inc = _incident()
+    asc2.note_incident(inc)
+    a1 = asc2.decide(10.0, reps, lambda b: b)
+    assert [a["action"] for a in a1] == ["join"]
+    # calm after the join (recover_sustain passes, util zero) — but
+    # the hold window keeps drains off until t >= 110
+    assert all(a["action"] != "drain"
+               for t in (40.0, 80.0, 100.0)
+               for a in asc2.decide(t, reps, lambda b: b))
+    acts = asc2.decide(120.0, reps, lambda b: b)
+    assert [a["action"] for a in acts] == ["drain"]
+    assert count_oscillations(asc2.actions, 100.0) == 0
+
+
+def test_min_replicas_floor_holds():
+    asc = _asc(min_replicas=2, drain_sustain=10.0, drain_cooldown=5.0)
+    reps = [_FakeRep(f"r{i}", i, _FakeSession(free=8))
+            for i in range(2)]
+    for t in (20.0, 40.0, 80.0, 160.0):
+        assert asc.decide(t, reps, lambda b: b) == []
+
+
+def test_shed_pressure_carries_armed_episode():
+    """One burn incident opens the episode; continued SHEDDING (not a
+    new incident) keeps joins coming until the loss stops."""
+    asc = _asc(join_cooldown=10.0, recover_sustain=30.0)
+    sess = [_FakeSession(free=4, sheds=0) for _ in range(2)]
+    reps = [_FakeRep(f"r{i}", i, s) for i, s in enumerate(sess)]
+    asc.note_incident(_incident())
+    a1 = asc.decide(10.0, reps, lambda b: b, sheds_total=0)
+    assert [a["action"] for a in a1] == ["join"]
+    # incident closed by the join — but sheds keep climbing
+    a2 = asc.decide(20.0, reps, lambda b: b, sheds_total=5)
+    assert [a["action"] for a in a2] == ["join"]
+    assert a2[0]["reason"] == "armed_shedding"
+    # calm (no new sheds) for recover_sustain: the episode disarms
+    assert asc.decide(30.0, reps, lambda b: b, sheds_total=5) == []
+    assert asc.decide(70.0, reps, lambda b: b, sheds_total=5) == []
+    assert asc._armed is False
+
+
+# --- cluster integration ----------------------------------------------------
+
+def test_flash_crowd_joins_and_incident_closure():
+    tr = _flash(n=2000)
+    asc = _asc(standby=("s0", "s1", "s2"), min_replicas=4,
+               max_replicas=7)
+    res = ClusterRouter(_spawn_qos, 4, placement="least_loaded",
+                        slo=default_serving_rules(**RULES),
+                        autoscale=asc).run(tr)
+    a = res.autoscale
+    assert a["joins"] >= 1 and a["degrades"] >= 1
+    acted = [i for i in res.incidents
+             if i.resolution == "action_taken"]
+    assert acted and all("action_taken" in i.evidence for i in acted)
+    cen = res.census()
+    assert cen["conserved"] and cen["pool_census_ok"]
+    assert count_oscillations(a["actions"],
+                              asc.cfg.hold_after_join) == 0
+    # autoscale events mirrored into the router's event log
+    assert any(e["event"] == "autoscale" and e.get("action") == "join"
+               for e in res.events)
+    # the joiners actually served work
+    joined = [x["replica"] for x in a["actions"]
+              if x["action"] == "join"]
+    assert any(len(res.results[n].outputs) > 0 for n in joined)
+
+
+def test_end_of_span_spike_acts_past_last_arrival():
+    # a spike at the very END of the span: the burn incident opens
+    # with (almost) no arrival ticks left, so the control plane must
+    # CHAIN ticks past t_last while the backlog drains — before that
+    # tail extension existed, the second join below was structurally
+    # impossible (no tick in the heap after the last arrival) and the
+    # incident sat open and unanswered
+    tr = synthesize_flash_crowd_trace(
+        seed=3, n_requests=1500, service_tokens_per_unit=CAP6,
+        base_overload=0.55, spikes=((0.96, 0.04, 8.0),))
+    t_last = max(r.arrival for r in tr)
+    res = ClusterRouter(_spawn_qos, 2, placement="least_loaded",
+                        slo=default_serving_rules(**RULES),
+                        autoscale=_asc(min_replicas=2,
+                                       max_replicas=8)).run(tr)
+    a = res.autoscale
+    assert a["joins"] >= 2
+    assert any(x["t"] > t_last for x in a["actions"])
+    assert res.census()["conserved"]
+
+
+def test_autoscale_off_byte_identity():
+    tr = _flash(n=1200)
+    p1 = ClusterRouter(_spawn_qos, 3, placement="least_loaded").run(tr)
+    p2 = ClusterRouter(_spawn_qos, 3, placement="least_loaded",
+                       slo=default_serving_rules(**RULES)).run(tr)
+    assert p1.outputs() == p2.outputs()
+    assert {n: p1.results[n].slot_log for n in p1.results} \
+        == {n: p2.results[n].slot_log for n in p2.results}
+    assert {n: p1.results[n].metrics.request_rows()
+            for n in p1.results} \
+        == {n: p2.results[n].metrics.request_rows()
+            for n in p2.results}
+    assert p1.autoscale is None and p2.autoscale is None
+
+
+def test_action_log_deterministic_and_save(tmp_path):
+    tr = _flash(n=2000)
+
+    def run():
+        return ClusterRouter(
+            _spawn_qos, 4, placement="least_loaded",
+            slo=default_serving_rules(**RULES),
+            autoscale=_asc(standby=("s0", "s1", "s2"),
+                           min_replicas=4, max_replicas=7)).run(tr)
+
+    r1, r2 = run(), run()
+    assert r1.autoscale["actions"], "vacuous: the loop never acted"
+    assert json.dumps(r1.autoscale["actions"]) \
+        == json.dumps(r2.autoscale["actions"])
+    assert r1.outputs() == r2.outputs()
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    r1.save_actions(pa)
+    r2.save_actions(pb)
+    with open(pa, "rb") as f:
+        ba = f.read()
+    with open(pb, "rb") as f:
+        bb = f.read()
+    assert ba == bb and len(ba) > 0
+
+
+def test_save_actions_requires_autoscaler():
+    tr = synthesize_trace(seed=0, n_requests=6, vocab_size=509,
+                          prompt_len=(4, 8), output_len=(2, 4))
+    res = ClusterRouter(_spawn_fifo, 2).run(tr)
+    with pytest.raises(ValueError, match="no action log"):
+        res.save_actions("/tmp/never.jsonl")
+
+
+def test_drain_decision_on_crashed_replica_noops_loudly():
+    """A drain decision landing on a mid-crash-failover replica must
+    noop LOUDLY (action + event logged), leave the removal to the
+    failover, and conserve the census."""
+    # three replicas each holding one LONG decode; r1 crashes at t=5
+    # and stays undetected (huge heartbeat timeout). Its salvaged
+    # rows leave it with load 0, so the idlest-pick lands exactly on
+    # the corpse while r0/r2 still stream. degrade=False so the
+    # crash's own page incident does not suppress the drain timer
+    # (with tier actuation on, an open page incident blocks drains —
+    # the corpse is then shielded by its own crash alert).
+    tr = [Request(rid=f"q{i}", arrival=0.1 * i, prompt=(1, 2, 3, 4),
+                  max_new_tokens=60) for i in range(3)]
+    asc = _asc(standby=(), min_replicas=1, interval=1.0,
+               drain_below=0.9, join_above=0.95, drain_sustain=3.5,
+               drain_cooldown=2.0, hold_after_join=0.0,
+               hold_after_drain=0.0, recover_sustain=1.0,
+               degrade=False)
+    plan = FaultPlan([FaultEvent(t=5.0, kind="crash", replica="r1")])
+    res = ClusterRouter(
+        _spawn_fifo, 3, placement="least_loaded", faults=plan,
+        failover=FailoverConfig(heartbeat_timeout=120.0,
+                                heartbeat_interval=60.0),
+        slo=[], autoscale=asc).run(tr)
+    noops = [a for a in res.autoscale["actions"]
+             if a["action"] == "drain_noop_crashed"]
+    assert noops and noops[0]["replica"] == "r1"
+    assert any(e["event"] == "autoscale"
+               and e.get("action") == "drain_noop_crashed"
+               for e in res.events)
+    # the failover (not the drain) removed it, exactly once
+    assert any(e["event"] == "dead" and e["replica"] == "r1"
+               for e in res.events)
+    assert res.census()["conserved"]
+
+
+def test_standby_name_recycling_and_direct_join_refusal():
+    r = ClusterRouter(_spawn_fifo, 1, slo=[], autoscale=_asc())
+    assert r._standby_name("s0") == "s0"
+    r.results["s0"] = object()          # a retired s0
+    assert r._standby_name("s0") == "s0#2"
+    r.results["s0#2"] = object()
+    assert r._standby_name("s0") == "s0#3"
+    # the PR-6 refusal is untouched for DIRECT joins of retired names
+    r2 = ClusterRouter(_spawn_fifo, 1)
+    r2.results["r9"] = object()
+    with pytest.raises(ValueError, match="fresh name"):
+        r2._add_replica("r9", 0.0)
+
+
+def test_standby_recycle_full_loop():
+    """Two flash spikes: the replica joined for spike 1 drains in the
+    calm between them, returns to the pool, and rejoins for spike 2
+    under a generation suffix — census still exactly-once."""
+    tr = synthesize_flash_crowd_trace(
+        seed=0, n_requests=2600, service_tokens_per_unit=CAP6,
+        base_overload=0.5, spikes=((0.2, 0.06, 4.0), (0.7, 0.06, 4.0)))
+    asc = _asc(standby=("s0",), min_replicas=4, max_replicas=5,
+               interval=10.0, join_cooldown=30.0, drain_cooldown=60.0,
+               hold_after_join=80.0, hold_after_drain=20.0,
+               drain_sustain=60.0, drain_below=0.6,
+               recover_sustain=60.0)
+    res = ClusterRouter(_spawn_qos, 4, placement="least_loaded",
+                        slo=default_serving_rules(**RULES),
+                        autoscale=asc).run(tr)
+    joined = [a["replica"] for a in res.autoscale["actions"]
+              if a["action"] == "join"]
+    recycled = [n for n in joined if "#" in n]
+    assert recycled, (joined, res.autoscale["actions"])
+    base = recycled[0].split("#", 1)[0]
+    # the base name served (and retired) earlier in the SAME run, and
+    # the recycled generation banked its own result slot
+    assert base in res.results and recycled[0] in res.results
+    cen = res.census()
+    assert cen["conserved"] and cen["removal_census_ok"]
+
+
+def test_replica_hours_accounting():
+    tr = _flash(n=1500)
+    res = ClusterRouter(_spawn_qos, 4, placement="least_loaded",
+                        slo=default_serving_rules(**RULES),
+                        autoscale=_asc(standby=("s0", "s1"),
+                                       min_replicas=4,
+                                       max_replicas=6)).run(tr)
+    hours = res.replica_hours
+    assert set(hours) == set(res.results)
+    for h in hours.values():
+        assert h["left"] is not None and h["left"] >= h["joined"]
+        assert h["hours"] == round(h["left"] - h["joined"], 6)
+    total = res.replica_hours_total()
+    assert total == round(sum(h["hours"] for h in hours.values()), 6)
+    assert res.report(tenant_weights=WEIGHTS)["replica_hours"] == total
+    # a late joiner accrues strictly fewer hours than a founder
+    joined = [a["replica"] for a in res.autoscale["actions"]
+              if a["action"] == "join"]
+    if joined:
+        assert hours[joined[0]]["hours"] < hours["r0"]["hours"]
+
+
+# --- role rebalance ---------------------------------------------------------
+
+def test_role_rebalance_flips_decode_to_prefill():
+    tr = synthesize_prefill_heavy_trace(seed=0, n_short=40, n_long=24,
+                                        burst_size=8, vocab_size=509)
+
+    def spawn(name):
+        return ServingEngine(
+            serving=make_sim_serving(max_len=96, page_size=PS, slots=4,
+                                     vocab=509,
+                                     n_pool_pages=4 * (96 // PS) + 17),
+            slots=4, policy="paged", clock="fixed", fixed_costs=COSTS,
+            decode_chunk=CHUNK, prefill_chunk_budget=2)
+
+    asc = _asc(standby=(), min_replicas=1, interval=5.0,
+               role_rebalance=True, role_cooldown=30.0,
+               prefill_hi=6.0, prefill_lo=0.5)
+    roles = {"r0": "prefill", "r1": "decode", "r2": "decode",
+             "r3": "decode"}
+    res = ClusterRouter(spawn, 4, placement="disaggregated",
+                        roles=roles, kv_transfer_unit=0.05, slo=[],
+                        autoscale=asc).run(tr)
+    flips = [a for a in res.autoscale["actions"]
+             if a["action"] == "role"]
+    assert flips and flips[0]["from"] == "decode" \
+        and flips[0]["to"] == "prefill" \
+        and flips[0]["reason"] == "prefill_backlog_high"
+    # cooldown: consecutive flips are >= role_cooldown apart
+    for x, y in zip(flips, flips[1:]):
+        assert y["t"] - x["t"] >= 30.0 - 1e-9
+    cen = res.census()
+    assert cen["conserved"]
+    assert cen["handoffs"]["balanced"] and not cen["handoffs"]["failed"]
+
+
+def test_role_rebalance_inert_without_dedicated_roles():
+    tr = synthesize_trace(seed=0, n_requests=60, vocab_size=509,
+                          prompt_len=(4, 10), output_len=(3, 6),
+                          mean_interarrival=0.2)
+    asc = _asc(standby=(), min_replicas=1, role_rebalance=True,
+               prefill_hi=0.5, prefill_lo=0.1)
+    res = ClusterRouter(_spawn_fifo, 3, slo=[], autoscale=asc).run(tr)
+    assert res.autoscale["role_changes"] == 0
+
+
+# --- QoS tier actuation -----------------------------------------------------
+
+def test_incident_degrade_clamps_then_lifts():
+    sched = QoSScheduler(incident_degrade=0.5, degrade_tiers=(1.0,))
+    est = ServiceEstimator(prefill=1.0, decode=1.0)
+    log = IncidentLog()
+    inc = _incident(log, t=5.0)
+    sched.note_incident(inc)
+    # deadline-free request: clamped to half its budget while open
+    sched.enqueue(Request(rid="a", arrival=0.0, prompt=(1, 2),
+                          max_new_tokens=8), 0.0)
+    dec = sched.select(10.0, max_batch=4, est=est)
+    assert dec.wave[0].max_new_tokens == 4
+    assert dec.degraded["a"] == (4, 8)
+    sched.commit("a", 4)
+    # incident closes -> the clamp lifts
+    inc.close(20.0, "burn_recovered")
+    sched.enqueue(Request(rid="b", arrival=21.0, prompt=(1, 2),
+                          max_new_tokens=8), 21.0)
+    dec2 = sched.select(22.0, max_batch=4, est=est)
+    assert dec2.wave[0].max_new_tokens == 8 and not dec2.degraded
+
+
+def test_incident_degrade_prefers_clamp_over_shed():
+    """A request infeasible at full budget but feasible at the
+    incident tier is DEGRADED, not shed — the flip-before-shed
+    contract."""
+    est = ServiceEstimator(prefill=1.0, decode=1.0)
+    req = Request(rid="t", arrival=0.0, prompt=(1,),
+                  max_new_tokens=10, deadline_ms=9000.0)
+    # full budget: 1 + 10*1*1.5 = 16 > 9 -> shed without the tier
+    plain = QoSScheduler(degrade_tiers=(1.0,))
+    plain.enqueue(req, 0.0)
+    d0 = plain.select(0.0, max_batch=4, est=est)
+    assert not d0.wave and d0.shed
+    hot = QoSScheduler(degrade_tiers=(1.0,), incident_degrade=0.5)
+    hot.note_incident(_incident(t=0.0))
+    hot.enqueue(req, 0.0)
+    d1 = hot.select(0.0, max_batch=4, est=est)
+    # tier 0.5: 1 + 5*1.5 = 8.5 <= 9 -> admitted short
+    assert d1.wave and d1.wave[0].max_new_tokens == 5 and not d1.shed
+
+
+def test_incident_degrade_default_inert():
+    est = ServiceEstimator(prefill=1.0, decode=1.0)
+    a = QoSScheduler()
+    b = QoSScheduler()
+    b.note_incident(_incident())  # recorded, never actuated
+    for s in (a, b):
+        s.enqueue(Request(rid="x", arrival=0.0, prompt=(1, 2, 3),
+                          max_new_tokens=6, deadline_ms=60000.0), 0.0)
+    da = a.select(1.0, max_batch=4, est=est)
+    db = b.select(1.0, max_batch=4, est=est)
+    assert [r.max_new_tokens for r in da.wave] \
+        == [r.max_new_tokens for r in db.wave]
+    assert da.degraded == db.degraded == {}
+    with pytest.raises(ValueError, match="fraction"):
+        QoSScheduler(incident_degrade=1.5)
+
+
+# --- the busy-frac signal ---------------------------------------------------
+
+def test_busy_frac_signal_watchable():
+    rule = ThresholdRule(name="hot", signal="replica_busy_frac",
+                         bound=0.99, op=">=", severity="warn")
+    eng = _spawn_fifo("e", slots=2, max_len=64)
+    mon = SLOMonitor([rule], source="e")
+    sess = eng.session(slo=mon)
+    for i in range(6):
+        sess.submit(Request(rid=f"q{i}", arrival=0.0,
+                            prompt=(1, 2, 3, 4), max_new_tokens=8))
+    sess.advance_until(30.0)
+    sess.finish()
+    fired = [i for i in mon.incidents if i.rule == "hot"]
+    assert fired, "saturated slots never tripped the busy-frac rule"
+    # an idle engine never trips it
+    eng2 = _spawn_fifo("e2", slots=2, max_len=64)
+    mon2 = SLOMonitor([rule], source="e2")
+    s2 = eng2.session(slo=mon2)
+    s2.submit(Request(rid="one", arrival=0.0, prompt=(1, 2),
+                      max_new_tokens=2))
+    s2.advance_until(30.0)
+    s2.finish()
+    assert not [i for i in mon2.incidents if i.rule == "hot"]
+
+
+# --- Incident.act -----------------------------------------------------------
+
+def test_incident_act_closes_with_evidence():
+    inc = _incident(t=3.0)
+    inc.act(5.0, "join:s0")
+    assert inc.t_close == 5.0
+    assert inc.resolution == "action_taken"
+    assert inc.evidence["action_taken"] == "join:s0"
+    # idempotent: a second act (or act on a closed incident) is a noop
+    inc.act(9.0, "drain:r0")
+    assert inc.evidence["action_taken"] == "join:s0"
+    d = inc.to_json()
+    assert d["resolution"] == "action_taken"
+    assert d["evidence"]["action_taken"] == "join:s0"
+
+
+def test_count_oscillations():
+    acts = [{"t": 10.0, "action": "join"},
+            {"t": 50.0, "action": "drain"},
+            {"t": 400.0, "action": "drain"}]
+    assert count_oscillations(acts, 150.0) == 1
+    assert count_oscillations(acts, 30.0) == 0
+    assert count_oscillations([], 150.0) == 0
+
+
+# --- the acceptance claim, small scale --------------------------------------
+
+def test_autoscaled_beats_static_hours_holds_goodput():
+    tr = synthesize_diurnal_trace(seed=0, n_requests=3000,
+                                  service_tokens_per_unit=CAP6,
+                                  peak_overload=1.25)
+    auto = ClusterRouter(_spawn_qos, 2, placement="least_loaded",
+                         slo=default_serving_rules(**RULES),
+                         autoscale=_asc(standby=tuple(
+                             f"s{i}" for i in range(6)),
+                             min_replicas=2,
+                             max_replicas=8)).run(tr)
+    static = ClusterRouter(_spawn_qos, 6,
+                           placement="least_loaded").run(tr)
+    ra = auto.report(tenant_weights=WEIGHTS)
+    rs = static.report(tenant_weights=WEIGHTS)
+    assert ra["replica_hours"] < rs["replica_hours"]
+    # the full >= 1.0 claim is gated at 10^5 bench scale; at 3k the
+    # floor allows a small-sample haircut
+    assert ra["goodput_tokens"] >= 0.95 * rs["goodput_tokens"]
+    a = auto.autoscale
+    assert a["joins"] >= 1 and a["drains"] >= 1
+    assert count_oscillations(a["actions"], 150.0) == 0
+    assert auto.census()["conserved"]
+
+
+# --- bench gate family ------------------------------------------------------
+
+def _gate(rows):
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/bench_gate.py"),
+         "serving", "-"],
+        input="\n".join(json.dumps(r) for r in rows),
+        capture_output=True, text=True, cwd=REPO)
+    out = [json.loads(ln) for ln in p.stdout.splitlines()
+           if ln.startswith("{")]
+    return p.returncode, out
+
+
+def _as_row(kind, arm, **over):
+    row = {"bench": "serving_autoscale", "trace_kind": kind,
+           "arm": arm, "conserved": True, "pool_census_ok": True,
+           "removal_census_ok": True, "goodput_tokens": 40000,
+           "replica_hours": 12000.0}
+    row.update(over)
+    return row
+
+
+def _as_summary(**over):
+    row = {"bench": "serving_autoscale_summary",
+           "hysteresis_window": 150.0, "requests": 100000,
+           "static_replicas": 6,
+           "action_log_deterministic": True, "off_identity": True}
+    for kind in ("diurnal", "flash"):
+        row[f"{kind}_goodput_ratio"] = 1.05
+        row[f"{kind}_hours_ratio"] = 0.85
+        row[f"{kind}_joins"] = 5
+        row[f"{kind}_drains"] = 5
+        row[f"{kind}_oscillations"] = 0
+        row[f"{kind}_actions_taken"] = 2
+    row.update(over)
+    return row
+
+
+def _as_rows(**sum_over):
+    rows = [_as_row(k, a) for k in ("diurnal", "flash")
+            for a in ("static_peak", "autoscaled")]
+    rows.append(_as_summary(**sum_over))
+    return rows
+
+
+def test_bench_gate_serving_autoscale_family():
+    rc, out = _gate(_as_rows())
+    assert rc == 0 and out[-1]["gate"] == "pass"
+    for bad, needle in (
+            ({"diurnal_goodput_ratio": 0.97}, "reaction lag"),
+            ({"flash_hours_ratio": 1.0}, "strictly below"),
+            ({"flash_oscillations": 1}, "oscillation"),
+            ({"diurnal_drains": 0}, "both directions"),
+            ({"flash_actions_taken": 0}, "action_taken"),
+            ({"action_log_deterministic": False}, "deterministic"),
+            ({"off_identity": False}, "byte-identical")):
+        rc, out = _gate(_as_rows(**bad))
+        assert rc == 1, bad
+        assert needle in out[-1]["reason"], (bad, out[-1])
+    # broken census on any row fails before the summary is consulted
+    rows = _as_rows()
+    rows[1]["conserved"] = False
+    rc, out = _gate(rows)
+    assert rc == 1 and "census" in out[-1]["reason"]
+    # a missing arm FAILs gracefully
+    rc, out = _gate([_as_row("diurnal", "static_peak"),
+                     _as_summary()])
+    assert rc == 1 and "BOTH" in out[-1]["reason"]
+    # no summary row: the claims are unverified
+    rc, out = _gate([_as_row(k, a) for k in ("diurnal", "flash")
+                     for a in ("static_peak", "autoscaled")])
+    assert rc == 1 and "UNVERIFIED" in out[-1]["reason"]
+
+
+# --- report tooling ---------------------------------------------------------
+
+def test_trace_and_slo_reports_carry_action_timelines(tmp_path):
+    trace_path = str(tmp_path / "as.json")
+    res = ClusterRouter(_spawn_qos, 4, placement="least_loaded",
+                        slo=default_serving_rules(**RULES),
+                        autoscale=_asc(standby=("s0", "s1"),
+                                       min_replicas=4,
+                                       max_replicas=6),
+                        trace=trace_path).run(_flash(n=2000))
+    inc_path = str(tmp_path / "inc.jsonl")
+    res.save_incidents(inc_path)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/trace_report.py"),
+         trace_path, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    rows = [json.loads(ln) for ln in p.stdout.splitlines()
+            if ln.startswith("{")]
+    arow = [r for r in rows if r["bench"] == "trace_report_autoscale"]
+    assert arow and arow[0]["actions"] >= 1
+    assert arow[0]["by_action"].get("join", 0) >= 1
+    assert rows[-1]["bench"] == "trace_report"  # global row LAST
+    q = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/slo_report.py"),
+         inc_path, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    srows = [json.loads(ln) for ln in q.stdout.splitlines()
+             if ln.startswith("{")]
+    acts = [r for r in srows if r["bench"] == "slo_report_action"]
+    assert acts and all(r["action"] for r in acts)
+    assert srows[-1]["bench"] == "slo_report"
+    assert srows[-1]["actions_taken"] == len(acts)
+
+
+def test_reports_stay_byte_identical_without_autoscale(tmp_path):
+    tr = synthesize_trace(seed=0, n_requests=10, vocab_size=509,
+                          prompt_len=(4, 8), output_len=(2, 4))
+    trace_path = str(tmp_path / "plain.json")
+    ClusterRouter(_spawn_fifo, 2, trace=trace_path).run(tr)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/trace_report.py"),
+         trace_path, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    rows = [json.loads(ln) for ln in p.stdout.splitlines()
+            if ln.startswith("{")]
+    assert not [r for r in rows
+                if r["bench"] == "trace_report_autoscale"]
+    assert "autoscale" not in p.stdout.split("\n")[-2]

@@ -75,6 +75,17 @@ cannot silently ship a slower build. Three modes:
       #    lane and both cluster arms, and the disaggregated
       #    cluster's KV-handoff census balanced (every exported chain
       #    imported or reclaimed exactly once).
+      #  - serving_autoscale (tools/serving_workload_bench.py
+      #    --autoscale): on the diurnal and flash-crowd traces, the
+      #    autoscaled fleet's goodput must be >= a static fleet sized
+      #    to the diurnal peak with replica-hours STRICTLY below it,
+      #    zero join->drain oscillation inside the hysteresis window,
+      #    >= 1 join and >= 1 drain actually taken per trace, the
+      #    action log byte-identical across two seeded replays, >= 1
+      #    incident closed "action_taken", request conservation on
+      #    every arm, and autoscale-off byte-identity (a monitored
+      #    router without an autoscaler replays exactly like a plain
+      #    one).
       #  - serving_tp (tools/serving_workload_bench.py --tp): the
       #    mesh-sharded decode path must produce greedy streams
       #    bit-equal to the TP=1 engine on the mixed trace (real
@@ -890,6 +901,129 @@ def check_serving_chaos(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+AUTOSCALE_GOODPUT_FLOOR = 1.0   # autoscaled vs static-peak goodput
+AUTOSCALE_KINDS = ("diurnal", "flash")
+
+
+def check_serving_autoscale(rows: list) -> int:
+    """Gate the elastic-autoscaling rows from serving_workload_bench.py
+    --autoscale: on BOTH workload shapes (diurnal day + flash crowd,
+    fixed clock, sim replicas) the autoscaled fleet must reach >=
+    AUTOSCALE_GOODPUT_FLOOR x the static peak-sized fleet's goodput
+    with replica-hours STRICTLY below it, take >= 1 join and >= 1
+    drain (a loop that never acts proves nothing), show ZERO
+    join->drain oscillation inside the hysteresis window, close >= 1
+    incident with resolution action_taken, write a byte-identical
+    action log on a second seeded replay, and conserve every arm's
+    request census; autoscale-off must be byte-identical to a plain
+    router. The static fleet is the baseline re-measured in the same
+    run — no stamped file."""
+    ar = [r for r in rows if r.get("bench") == "serving_autoscale"]
+    by = {(r.get("trace_kind"), r.get("arm")): r for r in ar}
+    for kind in AUTOSCALE_KINDS:
+        if (kind, "static_peak") not in by \
+                or (kind, "autoscaled") not in by:
+            print(json.dumps({
+                "gate": "FAIL",
+                "reason": f"serving_autoscale rows need BOTH a "
+                          f"static_peak and an autoscaled arm for the "
+                          f"{kind} trace (run tools/serving_workload_"
+                          "bench.py --autoscale)"}))
+            return 1
+    for r in ar:
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True \
+                or r.get("removal_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "trace_kind": r.get("trace_kind"),
+                "arm": r.get("arm"),
+                "reason": "autoscale census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')} "
+                          "removal_census_ok="
+                          f"{r.get('removal_census_ok')} — a request "
+                          "was lost/duplicated across membership "
+                          "churn or a drained replica's pages "
+                          "leaked"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_autoscale_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_autoscale_summary row "
+                                    "— the goodput/hours/oscillation "
+                                    "claims are UNVERIFIED (rerun the "
+                                    "--autoscale arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("action_log_deterministic") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "two seeded replays produced "
+                                    "DIFFERENT action logs — the "
+                                    "control plane is not "
+                                    "deterministic (a non-virtual "
+                                    "input leaked into a decision)"}))
+        return 1
+    if s.get("off_identity") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "autoscale=None is NOT "
+                                    "byte-identical to a plain router "
+                                    "— the inert path mutated "
+                                    "behavior"}))
+        return 1
+    rec = {"gate": "pass", "goodput_floor": AUTOSCALE_GOODPUT_FLOOR,
+           "hysteresis_window": s.get("hysteresis_window"),
+           "requests": s.get("requests"),
+           "static_replicas": s.get("static_replicas"),
+           "device": "sim"}
+    for kind in AUTOSCALE_KINDS:
+        g = s.get(f"{kind}_goodput_ratio")
+        h = s.get(f"{kind}_hours_ratio")
+        osc = s.get(f"{kind}_oscillations")
+        rec[f"{kind}_goodput_ratio"] = g
+        rec[f"{kind}_hours_ratio"] = h
+        rec[f"{kind}_joins"] = s.get(f"{kind}_joins")
+        rec[f"{kind}_drains"] = s.get(f"{kind}_drains")
+        rec[f"{kind}_oscillations"] = osc
+        if g is None or float(g) < AUTOSCALE_GOODPUT_FLOOR:
+            rec["gate"] = "FAIL"
+            rec["reason"] = (f"{kind}: autoscaled goodput only {g}x "
+                             f"the static peak-sized fleet's (floor "
+                             f"{AUTOSCALE_GOODPUT_FLOOR}) — elasticity "
+                             "is losing more goodput to reaction lag "
+                             "than it recovers at the peak")
+        elif h is None or float(h) >= 1.0:
+            rec["gate"] = "FAIL"
+            rec["reason"] = (f"{kind}: autoscaled replica-hours {h}x "
+                             "the static fleet's — not strictly "
+                             "below, so the goodput was bought with "
+                             "MORE capacity, not elasticity")
+        elif osc is None or int(osc) != 0:
+            rec["gate"] = "FAIL"
+            rec["reason"] = (f"{kind}: {osc} join->drain "
+                             "oscillation(s) inside the hysteresis "
+                             "window — the cooldown/hysteresis "
+                             "machinery is not holding")
+        elif int(s.get(f"{kind}_joins") or 0) < 1 \
+                or int(s.get(f"{kind}_drains") or 0) < 1:
+            rec["gate"] = "FAIL"
+            rec["reason"] = (f"{kind}: joins="
+                             f"{s.get(f'{kind}_joins')} drains="
+                             f"{s.get(f'{kind}_drains')} — the loop "
+                             "never exercised both directions, so "
+                             "the elasticity claim is vacuous")
+        elif int(s.get(f"{kind}_actions_taken") or 0) < 1:
+            rec["gate"] = "FAIL"
+            rec["reason"] = (f"{kind}: no incident closed with "
+                             "resolution action_taken — the detect->"
+                             "act loop never attributed an action to "
+                             "the incident that triggered it")
+        if rec["gate"] == "FAIL":
+            break
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 OBS_OFF_OVERHEAD_MAX = 0.02  # tracing-off tax allowed over no-obs
 
 
@@ -1135,6 +1269,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_disagg")
            for r in rows):
         fam_rcs["disagg"] = check_serving_disagg(rows)
+    if any(r.get("bench", "").startswith("serving_autoscale")
+           for r in rows):
+        fam_rcs["autoscale"] = check_serving_autoscale(rows)
     if any(r.get("bench", "").startswith("serving_tp") for r in rows):
         fam_rcs["tp"] = check_serving_tp(rows)
     summary = [r for r in rows

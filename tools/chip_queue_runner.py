@@ -114,6 +114,17 @@ QUEUE = [
     ("serving_tp",
      [sys.executable, "tools/serving_workload_bench.py", "--tp"],
      {}),
+    # PR-11 addition: the elastic-autoscaling arm — the diurnal +
+    # flash-crowd traces through a static peak-sized fleet vs an
+    # Autoscaler-driven fleet (burn-rate joins, low-util drains, QoS
+    # tier actuation) over sim replicas (fixed clock, so the chip run
+    # is a smoke of the same code path); bench_gate.py serving gates
+    # the serving_autoscale family (goodput >= static, replica-hours
+    # strictly below, zero oscillation, byte-identical action log,
+    # autoscale-off identity)
+    ("serving_autoscale",
+     [sys.executable, "tools/serving_workload_bench.py",
+      "--autoscale"], {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

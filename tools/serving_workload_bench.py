@@ -774,6 +774,200 @@ def _chaos_arm(args):
     return 0
 
 
+def _autoscale_arm(args):
+    """The elastic-autoscaling arm: the detect->act loop measured on
+    the two workload shapes static provisioning handles worst —
+
+    - a DIURNAL day (``synthesize_diurnal_trace``: rate follows a
+      trough->peak->trough cycle, peak demand 1.25x a 6-replica
+      fleet's capacity), and
+    - a FLASH CROWD (``synthesize_flash_crowd_trace``: comfortable
+      base load, then a sudden 4x rate spike for 8% of the span) —
+
+    each replayed on the fixed clock through (a) a STATIC fleet of 6
+    sim replicas (sized to the diurnal peak — the provision-to-peak
+    baseline) and (b) an AUTOSCALED fleet that starts at the trough
+    size with the rest of its capacity in a cold standby pool, an SLO
+    monitor (burn-rate rules), and an ``Autoscaler`` that joins on
+    sustained burn, drains on recovered-budget low utilization, and
+    flips QoS degradation tiers through ``note_incident``.
+
+    One `serving_autoscale` row per (trace, arm) plus a
+    `serving_autoscale_summary`; `bench_gate.py serving` gates the
+    family: autoscaled goodput >= the static fleet's on BOTH traces,
+    replica-hours strictly below it, zero join->drain oscillation
+    inside the hysteresis window, a byte-identical action log across
+    two seeded replays, autoscale-off byte-identity, and request
+    conservation everywhere."""
+    import json as _json
+
+    from paddle_tpu.obs import default_serving_rules
+    from paddle_tpu.serving import (Autoscaler, AutoscaleConfig,
+                                    ClusterRouter, QoSScheduler,
+                                    ServingEngine, count_oscillations,
+                                    make_sim_serving,
+                                    synthesize_diurnal_trace,
+                                    synthesize_flash_crowd_trace,
+                                    trace_stats)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    SLOTS, PS, ML, CHUNK = 8, 8, 64, 4
+    VOCAB = 509
+    costs = {"prefill_unit": 1.0, "decode": 1.0}
+    weights = {"intl": 2.0, "std": 1.0, "bulk": 0.5}
+    N_STATIC = 6
+    # honest per-chunk capacity of the static fleet (the same
+    # arithmetic as _sim_cluster_env, at this arm's 4-12 token
+    # prompts: ~1.5 exclusive prefill chunks per request)
+    B, P = 8.0, 1.5
+    cap_static = N_STATIC * B / (P + B / (SLOTS * CHUNK))
+    n_req = max(100, args.cluster_requests)
+    HOLD = 300.0  # the join->drain hysteresis window (oscillation
+    # audit window) = hold_after_join below, so a drain inside the
+    # window is structurally impossible, not just unlikely
+
+    def spawn(name):
+        return ServingEngine(
+            serving=make_sim_serving(max_len=ML, page_size=PS,
+                                     slots=SLOTS, vocab=VOCAB,
+                                     n_pool_pages=SLOTS * (ML // PS)
+                                     + 9),
+            slots=SLOTS, policy="paged", clock="fixed",
+            fixed_costs=costs, decode_chunk=CHUNK,
+            scheduler=QoSScheduler(max_queue=4 * SLOTS,
+                                   tenant_weights=weights,
+                                   incident_degrade=0.75))
+
+    # a gradual diurnal ramp sheds steadily but gently — the burn
+    # threshold must catch THAT, not only a flash spike, or the fleet
+    # trails the ramp all morning
+    rules = default_serving_rules(long_window=200.0, short_window=40.0,
+                                  min_events=40, burn_threshold=1.8)
+
+    def mkasc(nmin, nmax):
+        # joins eager (short cooldown — one burn episode carries
+        # repeat joins until the fleet catches up), drains lazy
+        # (long sustain + cooldown — capacity is cheap to hold for a
+        # few hundred clock units and a mid-ramp drain costs a whole
+        # rejoin of reaction lag)
+        return Autoscaler(AutoscaleConfig(
+            standby=tuple(f"s{i}" for i in range(nmax - nmin)),
+            min_replicas=nmin, max_replicas=nmax, interval=10.0,
+            join_cooldown=20.0, drain_cooldown=240.0,
+            hold_after_join=HOLD, hold_after_drain=40.0,
+            drain_sustain=300.0, drain_below=0.4,
+            recover_sustain=180.0))
+
+    # (trace, autoscaled trough size, autoscaled ceiling): the static
+    # fleet is sized to the DIURNAL peak; the flash crowd is the
+    # beyond-any-static-sizing event, so the standby pool there may
+    # exceed the static fleet — exactly the elasticity claim
+    shapes = {
+        "diurnal": (synthesize_diurnal_trace(
+            seed=args.seed, n_requests=n_req,
+            service_tokens_per_unit=cap_static, peak_overload=1.25,
+            vocab_size=VOCAB), 3, 8),
+        "flash": (synthesize_flash_crowd_trace(
+            seed=args.seed, n_requests=n_req,
+            service_tokens_per_unit=cap_static, base_overload=0.55,
+            spikes=((0.55, 0.08, 4.0),), vocab_size=VOCAB), 4, 10),
+    }
+
+    summary: dict = {"bench": "serving_autoscale_summary",
+                     "device": "sim", "seed": args.seed,
+                     "requests": n_req, "static_replicas": N_STATIC,
+                     "hysteresis_window": HOLD}
+    det_ok = None
+    for kind, (trace, nmin, nmax) in shapes.items():
+        stats = trace_stats(trace)
+        runs, rows = {}, {}
+        for arm in ("static_peak", "autoscaled"):
+            if arm == "static_peak":
+                res = ClusterRouter(spawn, N_STATIC,
+                                    placement="least_loaded").run(trace)
+            else:
+                res = ClusterRouter(
+                    spawn, nmin, placement="least_loaded", slo=rules,
+                    autoscale=mkasc(nmin, nmax)).run(trace)
+            runs[arm] = res
+            rep = res.report(tenant_weights=weights)
+            cen = res.census()
+            rec = {"bench": "serving_autoscale", "trace_kind": kind,
+                   "arm": arm, "device": "sim", "seed": args.seed,
+                   "replicas_start": N_STATIC if arm == "static_peak"
+                   else nmin,
+                   "replicas_max": N_STATIC if arm == "static_peak"
+                   else nmax}
+            rec.update({k: rep.get(k) for k in
+                        ("arrived", "completed", "shed", "shed_rate",
+                         "goodput_tokens", "goodput_tokens_per_sec",
+                         "slo_deadline_attained", "fairness_jain",
+                         "ttft_p50", "ttft_p95", "replica_hours")})
+            rec["conserved"] = cen["conserved"]
+            rec["pool_census_ok"] = cen["pool_census_ok"]
+            rec["removal_census_ok"] = cen["removal_census_ok"]
+            if arm == "autoscaled":
+                a = res.autoscale
+                rec.update({k: a[k] for k in
+                            ("joins", "drains", "drain_noops",
+                             "role_changes", "degrades")})
+                rec["oscillations"] = count_oscillations(
+                    a["actions"], HOLD)
+                rec["actions"] = len(a["actions"])
+                rec["incidents"] = len(res.incidents)
+                rec["actions_taken"] = sum(
+                    1 for i in res.incidents
+                    if i.resolution == "action_taken")
+            rec["trace"] = stats
+            rows[arm] = rec
+            emit(rec)
+        # the summary reuses the per-arm rows (report() aggregates
+        # the full 10^5-request ledger — not worth computing twice)
+        sr, ar = rows["static_peak"], rows["autoscaled"]
+        a = runs["autoscaled"].autoscale
+        sg = sr["goodput_tokens"]
+        ah, sh = ar["replica_hours"], sr["replica_hours"]
+        summary[f"{kind}_goodput_ratio"] = round(
+            ar["goodput_tokens"] / sg, 4) if sg else None
+        summary[f"{kind}_hours_ratio"] = round(ah / sh, 4) if sh \
+            else None
+        summary[f"{kind}_joins"] = a["joins"]
+        summary[f"{kind}_drains"] = a["drains"]
+        summary[f"{kind}_oscillations"] = ar["oscillations"]
+        summary[f"{kind}_actions_taken"] = ar["actions_taken"]
+        if kind == "flash":
+            # action-log determinism on the spikier trace: a second
+            # seeded replay must write the byte-identical log
+            res2 = ClusterRouter(
+                spawn, nmin, placement="least_loaded", slo=rules,
+                autoscale=mkasc(nmin, nmax)).run(trace)
+            det_ok = (_json.dumps(a["actions"])
+                      == _json.dumps(res2.autoscale["actions"])
+                      and runs["autoscaled"].outputs()
+                      == res2.outputs())
+        if args.save_actions and kind == "flash":
+            runs["autoscaled"].save_actions(args.save_actions)
+            summary["actions_path"] = args.save_actions
+
+    # autoscale-off byte-identity: a monitored-but-not-autoscaled
+    # router must replay exactly like a plain one (the monitor only
+    # watches; the AUTOSCALER is the one component allowed to act)
+    lt = shapes["diurnal"][0][:min(n_req, 20_000)]
+    p1 = ClusterRouter(spawn, 2, placement="least_loaded").run(lt)
+    p2 = ClusterRouter(spawn, 2, placement="least_loaded",
+                       slo=rules).run(lt)
+    off_ok = (p1.outputs() == p2.outputs()
+              and {n: p1.results[n].slot_log for n in p1.results}
+              == {n: p2.results[n].slot_log for n in p2.results}
+              and p1.autoscale is None and p2.autoscale is None)
+    summary["action_log_deterministic"] = bool(det_ok)
+    summary["off_identity"] = bool(off_ok)
+    emit(summary)
+    return 0
+
+
 def _bundle_trees_equal(a: str, b: str):
     """Byte-compare two bundle roots file-by-file (relative paths):
     the determinism claim is 'byte-identical modulo output paths', so
@@ -1028,6 +1222,20 @@ def main(argv=None):
     ap.add_argument("--kv-transfer-unit", type=float, default=0.05,
                     help="disagg arm: per-page KV handoff transfer "
                          "cost on the virtual clock")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the elastic-autoscaling arm instead: "
+                         "the diurnal + flash-crowd traces (fixed "
+                         "clock, sim replicas) through a static "
+                         "peak-sized fleet vs an Autoscaler-driven "
+                         "fleet (burn-rate joins, low-util drains, "
+                         "QoS tier actuation); bench_gate.py serving "
+                         "gates the serving_autoscale family "
+                         "(goodput >= static, replica-hours strictly "
+                         "below, zero oscillation, byte-identical "
+                         "action log, autoscale-off identity)")
+    ap.add_argument("--save-actions", type=str, default=None,
+                    help="autoscale arm: save the flash-crowd "
+                         "replay's action log JSONL")
     ap.add_argument("--slo", action="store_true",
                     help="run the SLO watchdog arm instead: the "
                          "--chaos trace+plan replayed monitor-off vs "
@@ -1099,6 +1307,8 @@ def main(argv=None):
         return _disagg_arm(args)
     if args.slo:
         return _slo_arm(args)
+    if args.autoscale:
+        return _autoscale_arm(args)
     if args.tp:
         return _tp_arm(args)
 

@@ -13,7 +13,13 @@ watchdog CONCLUDED (incidents) —
   budget exhausting across a run reads as a rising column;
 - ``--bundles DIR``: cross-check the flight-recorder bundles — every
   incident id with a bundle directory is validated for the four bundle
-  files (a missing ``metrics.jsonl`` means the recorder never froze).
+  files (a missing ``metrics.jsonl`` means the recorder never froze);
+- the ACTION timeline (autoscaled runs only): every incident the
+  control plane resolved (resolution ``action_taken``), with the
+  action that closed it and the detect->act latency — the
+  ``serving_autoscale`` loop's postmortem evidence. Absent for logs
+  recorded without an autoscaler, so pre-autoscale reports are
+  byte-identical.
 
 Loading is crash-tolerant by the shared ``iter_jsonl_tolerant``
 policy: a torn FINAL line (the file a dying process leaves) warns and
@@ -70,6 +76,11 @@ def rule_rows(incidents) -> list:
             r["open"] += 1
         else:
             r["total_open_units"] += inc.t_close - inc.t_open
+        if inc.resolution == "action_taken":
+            # incidents an automated responder (the autoscaler)
+            # resolved — key absent on rules never acted on, so
+            # pre-autoscale logs keep their rows byte-identical
+            r["actions_taken"] = r.get("actions_taken", 0) + 1
         if inc.kind == "burn_rate":
             ev = inc.evidence
             r["burn_down"].append({
@@ -89,6 +100,26 @@ def rule_rows(incidents) -> list:
     return rows
 
 
+def action_timeline(incidents) -> list:
+    """Every incident an automated responder closed (resolution
+    ``action_taken``), in open order, with WHICH action resolved it
+    (the ``action_taken`` evidence ``Incident.act`` stamped) and the
+    detect->act latency. Empty for any log recorded without a control
+    plane — the action section/rows are omitted then, so
+    pre-autoscale reports are byte-identical."""
+    out = []
+    for inc in incidents:
+        if inc.resolution != "action_taken":
+            continue
+        out.append({"id": inc.id, "rule": inc.rule,
+                    "source": inc.source, "t_open": inc.t_open,
+                    "t_action": inc.t_close,
+                    "latency": round(inc.t_close - inc.t_open, 6)
+                    if inc.t_close is not None else None,
+                    "action": inc.evidence.get("action_taken")})
+    return out
+
+
 def global_row(incidents, bundle_checks=None) -> dict:
     by_kind: dict = {}
     by_sev: dict = {}
@@ -106,6 +137,12 @@ def global_row(incidents, bundle_checks=None) -> dict:
     if incidents:
         row["t_first"] = min(i.t_open for i in incidents)
         row["t_last"] = max(i.t_open for i in incidents)
+    acted = sum(1 for i in incidents
+                if i.resolution == "action_taken")
+    if acted:
+        # only logs a control plane acted on grow this key —
+        # pre-autoscale reports stay byte-identical
+        row["actions_taken"] = acted
     if bundle_checks is not None:
         row["bundles"] = len(bundle_checks)
         row["bundles_complete"] = sum(
@@ -150,6 +187,18 @@ def render_text(incidents, rules, bundle_checks=None):
             bar = "#" * min(40, int((spent or 0.0) * 40))
             print(f"    t={p['t']:<12.3f} budget_spent="
                   f"{spent if spent is not None else '?':<8} {bar}")
+    actions = action_timeline(incidents)
+    if actions:
+        # only acted-on logs grow this section — pre-autoscale
+        # reports render byte-identically
+        print()
+        print(f"# action timeline ({len(actions)} incidents "
+              "resolved by the control plane)")
+        for a in actions:
+            print(f"  {a['id']:10} {a['rule']:18} "
+                  f"t_open={a['t_open']:<12.3f} "
+                  f"latency={a['latency'] if a['latency'] is not None else '?':<10} "
+                  f"-> {a['action']}")
     if bundle_checks is not None:
         print()
         complete = sum(1 for b in bundle_checks if b["complete"])
@@ -190,6 +239,11 @@ def main(argv=None) -> int:
             for b in bundle_checks:
                 print(json.dumps({"bench": "slo_report_bundle", **b}),
                       flush=True)
+        for a in action_timeline(incidents):
+            # acted-on logs only: absent otherwise, so pre-autoscale
+            # --json output is byte-identical
+            print(json.dumps({"bench": "slo_report_action", **a}),
+                  flush=True)
         # the global row stays LAST (consumers read the final line)
         print(json.dumps(global_row(incidents, bundle_checks)),
               flush=True)

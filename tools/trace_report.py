@@ -104,6 +104,21 @@ def chaos_events(events: list) -> list:
         key=lambda r: (r["t"], r["event"]))
 
 
+def autoscale_actions(events: list) -> list:
+    """The control-plane action instants an autoscaled cluster replay
+    leaves on the router's cluster track (join/drain/role/degrade +
+    the loud drain-on-crashed noop), in time order. Empty for any
+    trace recorded without an autoscaler — the action section/row
+    below is omitted then, so pre-autoscale traces summarize
+    byte-identically."""
+    return sorted(
+        ({"t": e["ts"], **e.get("args", {})}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") == "autoscale"),
+        key=lambda r: (r["t"], str(r.get("action")),
+                       str(r.get("replica"))))
+
+
 def failover_hops(events: list, tracks: dict) -> dict:
     """rid -> {"retries": N, "path": [replica, ...]} for every request
     that failed over. Retry counts come from the router's ``retry``
@@ -434,6 +449,16 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                      f"({tp_row['prefill_spans']} prefill + "
                      f"{tp_row['decode_spans']} decode spans "
                      f"sharded) ==")
+    acts = autoscale_actions(events)
+    if acts:
+        # only autoscaled traces grow this section — pre-autoscale
+        # traces render byte-identically
+        lines.append(f"\n== autoscale actions ({len(acts)}) ==")
+        for a in acts[:top * 3]:
+            extra = " ".join(f"{k}={v}" for k, v in a.items()
+                             if k not in ("t", "action"))
+            lines.append(f"  t={a['t'] / 1e6:.4f}s "
+                         f"{str(a.get('action')):14s} {extra}")
     chaos = chaos_events(events)
     if chaos:
         # only chaos traces grow this section — pre-fault traces
@@ -463,7 +488,8 @@ def main(argv=None) -> int:
     if args.json:
         # per-track rows, then per-replica rollups (cluster traces
         # only), then per-lane rows + the handoff-evidence row
-        # (disaggregated traces only), then a chaos-evidence row
+        # (disaggregated traces only), then an autoscale-action row
+        # (autoscaled traces only), then a chaos-evidence row
         # (fault-plan traces only), then the GLOBAL row LAST —
         # consumers that read the final JSON line keep seeing exactly
         # what they saw before
@@ -490,6 +516,22 @@ def main(argv=None) -> int:
                 "handed_off_requests": len(kv_hops),
                 "hops": {rid: h for rid, h
                          in sorted(kv_hops.items())[:20]}}))
+        acts = autoscale_actions(events)
+        if acts:
+            # autoscaled traces only: absent otherwise, so
+            # pre-autoscale --json output is byte-identical
+            by_act: dict = {}
+            for a in acts:
+                k = str(a.get("action"))
+                by_act[k] = by_act.get(k, 0) + 1
+            print(json.dumps({
+                "bench": "trace_report_autoscale",
+                "actions": len(acts),
+                "by_action": dict(sorted(by_act.items())),
+                "timeline": [{"t": a["t"],
+                              "action": a.get("action"),
+                              "replica": a.get("replica")}
+                             for a in acts[:20]]}))
         chaos = chaos_events(events)
         if chaos:
             kinds: dict = {}
