@@ -79,6 +79,140 @@ _PROJ_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
               "mlp.down_proj.weight")
 
 
+# --- multi-adapter LoRA (batched multi-model serving) ----------------------
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """Multi-adapter LoRA layout for the paged serving decode path:
+    a device-resident ADAPTER BANK of ``n_slots`` stacked low-rank
+    delta sets over the q/v attention projections (the classic LoRA
+    target pair), applied per batch row by slot index — the
+    S-LoRA / Punica batched-multi-adapter design riding PR 1's
+    weights-as-args invariant: the bank and the per-row index vector
+    are jit INPUTS, so one fixed-shape ``decode_n`` program serves any
+    mix of adapters and admission churn never recompiles.
+
+    Slot 0 is the reserved IDENTITY (all-zero deltas): ``adapter=None``
+    rows are routed through it and their delta is an exact float zero
+    — token-for-token the base model. ``rank`` is the low-rank width
+    ``r`` (delta = ``(h @ A) @ B * scale``); ``scale`` is the merged
+    ``alpha / r`` multiplier applied at serve time."""
+
+    n_slots: int = 4
+    rank: int = 4
+    scale: float = 1.0
+
+    def __post_init__(self):
+        if self.n_slots < 2:
+            raise ValueError("LoRAConfig needs n_slots >= 2 (slot 0 "
+                             "is the reserved identity)")
+        if self.rank < 1:
+            raise ValueError("LoRAConfig rank must be >= 1")
+
+
+def as_lora_config(lora) -> "LoRAConfig | None":
+    """Normalize the ``lora=`` argument: None stays None, a
+    ``(n_slots, rank)`` tuple becomes a LoRAConfig, a LoRAConfig
+    passes through."""
+    if lora is None or isinstance(lora, LoRAConfig):
+        return lora
+    if isinstance(lora, tuple) and len(lora) == 2:
+        return LoRAConfig(n_slots=int(lora[0]), rank=int(lora[1]))
+    raise ValueError(f"lora {lora!r}: pass None, (n_slots, rank), or "
+                     "a LoRAConfig")
+
+
+LORA_KEYS = ("q_A", "q_B", "v_A", "v_B")
+
+
+def _bgmv(h, A, B_, ids):
+    """Batched gather matvec (Punica's BGMV): per-row low-rank delta
+    ``(h @ A[row]) @ B[row]``. ``h`` (B, T, H); ``A`` (n_slots, H, r);
+    ``B_`` (n_slots, r, out); ``ids`` (B,) int slot indices. The
+    gather is by row SEGMENT — every row of a same-adapter group reads
+    the same bank slice (the engine's admission ordering groups
+    adapter-sharers adjacently) — and the whole thing is fixed-shape:
+    slot indices are data, so adapter churn never recompiles."""
+    Ar = jnp.take(A, ids, axis=0)          # (B, H, r)
+    Br = jnp.take(B_, ids, axis=0)         # (B, r, out)
+    t = jnp.einsum("bth,bhr->btr", h, Ar)
+    return jnp.einsum("btr,bro->bto", t, Br)
+
+
+def synthesize_lora_deltas(cfg: LlamaConfig, rank: int, seed: int = 0,
+                           init_scale: float = 0.02) -> dict:
+    """One seeded host-resident LoRA delta set for ``cfg``'s decode
+    path, the layout ``llama_paged_decode_factory(lora=...)``'s
+    ``upload_adapter`` hook consumes: ``q_A``/``v_A`` (L, H, r) and
+    ``q_B``/``v_B`` (L, r, out) numpy float32. Both factors are drawn
+    nonzero (unlike training-init LoRA, where B starts at zero — a
+    zero delta would make every adapter the base model and parity
+    tests vacuous). Deterministic in (cfg, rank, seed)."""
+    rng = np.random.default_rng(seed)
+    L = cfg.num_hidden_layers
+    H = cfg.hidden_size
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = H // nh
+
+    def draw(*shape):
+        return (rng.standard_normal(shape) * init_scale).astype(
+            np.float32)
+
+    return {"q_A": draw(L, H, rank), "q_B": draw(L, rank, nh * hd),
+            "v_A": draw(L, H, rank), "v_B": draw(L, rank, nkv * hd)}
+
+
+def lora_bank_hooks(cfg: LlamaConfig, lora: "LoRAConfig", dtype,
+                    tp: "TPConfig | None" = None):
+    """The adapter-cache device hooks for a llama decode path:
+    ``(init_adapter_bank, upload_adapter)``.
+
+    ``init_adapter_bank()`` builds the all-zero device bank — per
+    LoRA key a ``(L, n_slots, ...)`` array stacked layer-first so it
+    scans with the layer weights; slot 0 stays zero forever (the
+    identity every ``adapter=None`` row decodes through). Under
+    ``tp`` the bank is placed REPLICATED on the mesh (rank is tiny —
+    a few KB per adapter — so replication costs nothing and the
+    delta add simply reshards into the column-parallel q/v layout).
+
+    ``upload_adapter(bank, slot, deltas)`` is the paced host->device
+    upload: a functional ``.at[:, slot].set`` per key (the returned
+    bank REBINDS — sharding and every other slot's content
+    preserved). ``deltas`` is a ``synthesize_lora_deltas``-shaped
+    host tree: ``q_A``/``v_A`` (L, H, r), ``q_B``/``v_B``
+    (L, r, out)."""
+    L = cfg.num_hidden_layers
+    H = cfg.hidden_size
+    nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = H // nh
+    r, ns = lora.rank, lora.n_slots
+    shapes = {"q_A": (L, ns, H, r), "q_B": (L, ns, r, nh * hd),
+              "v_A": (L, ns, H, r), "v_B": (L, ns, r, nkv * hd)}
+
+    def init_adapter_bank():
+        bank = {k: jnp.zeros(s, dtype) for k, s in shapes.items()}
+        if tp is not None:
+            bank = device_put_sharded(bank, tp.build_mesh())
+        return bank
+
+    def upload_adapter(bank, slot, deltas):
+        for k in LORA_KEYS:
+            if k not in deltas:
+                raise ValueError(f"adapter delta set missing {k!r} "
+                                 f"(needs {LORA_KEYS})")
+            want = shapes[k][:1] + shapes[k][2:]
+            got = tuple(np.asarray(deltas[k]).shape)
+            if got != want:
+                raise ValueError(f"adapter delta {k} has shape {got}, "
+                                 f"bank slot wants {want} (rank/model "
+                                 "mismatch?)")
+        return {k: bank[k].at[:, slot].set(
+            jnp.asarray(np.asarray(deltas[k]), bank[k].dtype))
+            for k in LORA_KEYS}
+
+    return init_adapter_bank, upload_adapter
+
+
 # --- tensor parallelism (sharded decode weights + paged pool) --------------
 
 @dataclasses.dataclass(frozen=True)
@@ -213,15 +347,29 @@ def shard_decode_params(outer, layers, tp: TPConfig):
     return outer, layers, mesh
 
 
-def _proj_qkv(cfg: LlamaConfig, p, h, pos):
+def _proj_qkv(cfg: LlamaConfig, p, h, pos, lora=None):
     """h: (B, T, H); pos: (T,) absolute positions. Returns q,k,v with
-    rotary applied — q (B, nh, T, hd), k/v (B, nkv, T, hd)."""
+    rotary applied — q (B, nh, T, hd), k/v (B, nkv, T, hd).
+
+    ``lora`` (multi-adapter serving only): ``(bank_l, ids, scale)`` —
+    this layer's adapter-bank slice (``q_A``/``q_B``/``v_A``/``v_B``,
+    each (n_slots, ...)) plus per-row slot indices; the low-rank
+    ``_bgmv`` delta lands on q and v BEFORE the head reshape/rotary.
+    Slot 0 holds zeros, so identity rows add an exact float 0."""
     B, T, H = h.shape
     nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
     hd = H // nh
-    q = _mm(h, p["self_attn.q_proj.weight"]).reshape(B, T, nh, hd)
+    q = _mm(h, p["self_attn.q_proj.weight"])
     k = _mm(h, p["self_attn.k_proj.weight"]).reshape(B, T, nkv, hd)
-    v = _mm(h, p["self_attn.v_proj.weight"]).reshape(B, T, nkv, hd)
+    v = _mm(h, p["self_attn.v_proj.weight"])
+    if lora is not None:
+        bank_l, ids, scale = lora
+        q = q + _bgmv(h, bank_l["q_A"], bank_l["q_B"], ids) \
+            * jnp.asarray(scale, q.dtype)
+        v = v + _bgmv(h, bank_l["v_A"], bank_l["v_B"], ids) \
+            * jnp.asarray(scale, v.dtype)
+    q = q.reshape(B, T, nh, hd)
+    v = v.reshape(B, T, nkv, hd)
     q = apply_rotary(q, pos, cfg.rope_theta)
     k = apply_rotary(k, pos, cfg.rope_theta)
     return (jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
@@ -273,14 +421,15 @@ def _attend(cfg, q, k_all, v_all, key_mask):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v_all)
 
 
-def _layer_math(cfg, lp, x, pos_vec, attend):
+def _layer_math(cfg, lp, x, pos_vec, attend, lora=None):
     """The shared decoder-layer body (rms -> qkv+rope -> attend ->
     o_proj residual -> mlp residual); ``attend(q, k, v) -> (ctx, extra)``
     owns the cache strategy so the two cache variants below can't
-    diverge on the math."""
+    diverge on the math. ``lora`` is the optional per-layer
+    multi-adapter delta (see ``_proj_qkv``)."""
     B, T, H = x.shape
     h = _rms(x, lp["input_layernorm.weight"], cfg.rms_norm_eps)
-    q, k, v = _proj_qkv(cfg, lp, h, pos_vec)
+    q, k, v = _proj_qkv(cfg, lp, h, pos_vec, lora=lora)
     ctx, extra = attend(q, k, v)
     attn = _mm(jnp.swapaxes(ctx, 1, 2).reshape(B, T, H),
                lp["self_attn.o_proj.weight"])
@@ -940,7 +1089,9 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                emit: str = "token",
                                prefill_attention: str = "gather",
                                scan_layers: bool = True,
-                               tp: "TPConfig | int | None" = None):
+                               tp: "TPConfig | int | None" = None,
+                               lora: "LoRAConfig | tuple | None"
+                               = None):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -996,10 +1147,26 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     inherit the arg shardings, GSPMD inserts the collectives, and the
     fixed-shape ``decode_n`` batches still never recompile across
     churn. ``tp=None`` builds exactly the single-device factory.
+
+    ``lora`` (``LoRAConfig`` / ``(n_slots, rank)``): multi-adapter
+    serving. Every prefill/decode callable accepts a trailing
+    ``lora=(adapter_bank, adapter_ids)`` argument — the bank is the
+    device-resident stack of per-slot low-rank q/v deltas
+    (``lora_bank_hooks`` builds and uploads it), ``adapter_ids`` the
+    per-row slot indices — applied per row via the batched ``_bgmv``
+    gather. Both are jit inputs (the PR-1 weights-as-args invariant),
+    so one compiled fixed-shape program serves ANY adapter mix and
+    adapter churn never recompiles. Slot 0 is the all-zero identity;
+    with ``lora=None`` at the call the programs trace exactly the
+    base-model math. Under ``tp`` the bank stays replicated (rank is
+    tiny; the delta add reshards into the column-parallel q/v
+    layout).
     """
     from ...ops.pallas.paged_attention import paged_attention
 
     cfg = model.config
+    lora_cfg = as_lora_config(lora)
+    lora_scale = lora_cfg.scale if lora_cfg is not None else 1.0
     outer, layers = split_params(model)
     outer = {k: jnp.asarray(v) for k, v in outer.items()}
     layers = {k: jnp.asarray(v) for k, v in layers.items()}
@@ -1026,6 +1193,24 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     def _emit(logits):
         return jnp.argmax(logits, -1) if emit == "token" \
             else logits.astype(jnp.float32)
+
+    # ONE definition of how the optional adapter bank rides the layer
+    # scan, shared by prefill / decode_step / _prefill_chunk (three
+    # private copies could silently diverge the chunked-prefill path
+    # from decode if the lora payload ever grows, e.g. k-proj deltas)
+    def _scan_operand(layers, k_pools, v_pools, lora):
+        return (layers, k_pools, v_pools) if lora is None \
+            else (layers, lora[0], k_pools, v_pools)
+
+    def _split_per_layer(per_layer, lora):
+        """One scan step's operand -> (lp, kp_l, vp_l, lo) where
+        ``lo`` is the per-layer lora triple for ``_layer_math`` (None
+        without adapters)."""
+        if lora is None:
+            lp, kp_l, vp_l = per_layer
+            return lp, kp_l, vp_l, None
+        lp, bl, kp_l, vp_l = per_layer
+        return lp, kp_l, vp_l, (bl, lora[1], lora_scale)
 
     def init_pools():
         shape = (L, nkv, n_pool_pages, page_size, hd)
@@ -1063,10 +1248,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         return pool_l.at[:, pages, offs].set(upd.astype(pool_l.dtype))
 
     @partial(jax.jit, donate_argnums=(5,))  # pools alias in place
-    def prefill(outer, layers, tokens, page_tables, lengths, pools):
+    def prefill(outer, layers, tokens, page_tables, lengths, pools,
+                lora=None):
         """Prompts padded to a page multiple; ``lengths`` are the REAL
         prompt lengths (padding K/V lands in allocated pages but is
-        masked by lengths everywhere downstream)."""
+        masked by lengths everywhere downstream). ``lora``: optional
+        ``(adapter_bank, adapter_ids)`` multi-adapter deltas."""
         k_pools, v_pools = pools
         B, T = tokens.shape
         if T % page_size:
@@ -1080,18 +1267,21 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         mask = causal[None, None] & key_ok[:, None, None, :]
 
         def body(x, per_layer):
-            lp, kp_l, vp_l = per_layer
+            lp, kp_l, vp_l, lo = _split_per_layer(per_layer, lora)
 
             def attend(q, k, v):
                 kp = _write_prompt(kp_l, k, page_tables, T)
                 vp = _write_prompt(vp_l, v, page_tables, T)
                 return _attend(cfg, q, k, v, mask), (kp, vp)
 
-            x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend)
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend,
+                                      lora=lo)
             return x, (kp, vp)
 
-        x, (k_pools, v_pools) = _stack_apply(
-            body, x, (layers, k_pools, v_pools), scan_layers)
+        x, ys = _stack_apply(
+            body, x, _scan_operand(layers, k_pools, v_pools, lora),
+            scan_layers)
+        k_pools, v_pools = ys
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         # each sequence's last REAL position owns the next token
         x_last = jnp.take_along_axis(
@@ -1100,14 +1290,15 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         return out, (k_pools, v_pools)
 
     @partial(jax.jit, donate_argnums=(5,))  # no per-token pool copy
-    def decode_step(outer, layers, tok, page_tables, lengths, pools):
+    def decode_step(outer, layers, tok, page_tables, lengths, pools,
+                    lora=None):
         k_pools, v_pools = pools
         x = jnp.take(outer["model.embed_tokens.weight"], tok,
                      axis=0)[:, None]                    # (B, 1, H)
         pos = lengths[:, None]                           # per-sequence
 
         def body(x, per_layer):
-            lp, kp_l, vp_l = per_layer
+            lp, kp_l, vp_l, lo = _split_per_layer(per_layer, lora)
 
             def attend(q, k, v):
                 kp = _write_token(kp_l, k, page_tables, lengths)
@@ -1122,18 +1313,21 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                           page_tables, lengths + 1)
                 return ctx[:, :, None], (kp, vp)
 
-            x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend)
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend,
+                                      lora=lo)
             return x, (kp, vp)
 
-        x, (k_pools, v_pools) = _stack_apply(
-            body, x, (layers, k_pools, v_pools), scan_layers)
+        x, ys = _stack_apply(
+            body, x, _scan_operand(layers, k_pools, v_pools, lora),
+            scan_layers)
+        k_pools, v_pools = ys
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         out = _emit(_logits(cfg, outer, x[:, 0]))
         return out, (k_pools, v_pools)
 
     @partial(jax.jit, donate_argnums=(6,))
     def _prefill_chunk(outer, layers, chunk, start, page_tables, lengths,
-                       pools, x_last):
+                       pools, x_last, lora=None):
         """One C-token chunk at absolute positions start..start+C-1:
         writes its pages, attends to every pool position < start+C, and
         harvests the hidden state of each sequence's (length-1) row when
@@ -1152,7 +1346,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         mask = key_ok[:, None]                       # (B, 1, C, S)
 
         def body(x, per_layer):
-            lp, kp_l, vp_l = per_layer
+            lp, kp_l, vp_l, lo = _split_per_layer(per_layer, lora)
 
             def attend(q, k, v):
                 kp = _write_chunk(kp_l, k, page_tables, start, C)
@@ -1184,11 +1378,14 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                 return _attend(cfg, q, k_all.astype(q.dtype),
                                v_all.astype(q.dtype), mask), (kp, vp)
 
-            x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend)
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos_vec, attend,
+                                      lora=lo)
             return x, (kp, vp)
 
-        x, (k_pools, v_pools) = _stack_apply(
-            body, x, (layers, k_pools, v_pools), scan_layers)
+        x, ys = _stack_apply(
+            body, x, _scan_operand(layers, k_pools, v_pools, lora),
+            scan_layers)
+        k_pools, v_pools = ys
         # harvest rows whose (length-1) position lives in this chunk
         idx = jnp.clip(lengths - 1 - start, 0, C - 1)
         row = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
@@ -1227,7 +1424,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         return _emit(_logits(cfg, outer, x))
 
     def prefill_chunked(outer, layers, tokens, page_tables, lengths,
-                        pools, resume_from: int = 0):
+                        pools, resume_from: int = 0, lora=None):
         """``resume_from`` (a chunk multiple): skip chunks whose pages
         already hold real K/V — the prefix-cache path
         (PagedKVCache.acquire_prefix returns the cached token count;
@@ -1235,7 +1432,9 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         multiple — a larger value would skip chunks that are
         uninitialized for the less-cached sequences). The final chunk
         always runs so the last-position logits exist; its page writes
-        rewrite identical content when the tail was cached."""
+        rewrite identical content when the tail was cached.
+        ``lora``: optional ``(adapter_bank, adapter_ids)`` deltas,
+        threaded into every chunk call."""
         C = chunked_prefill
         B, T = tokens.shape
         if T % C:
@@ -1250,7 +1449,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         for s in range(resume, T, C):  # static count; ONE compiled fn
             x_last, pools = _prefill_chunk(
                 outer, layers, tokens[:, s:s + C], s, page_tables,
-                lengths, pools, x_last)
+                lengths, pools, x_last, lora)
         return _finish_prefill(outer, x_last), pools
 
     # the shim itself is plain python; expose the jitted programs it
@@ -1265,7 +1464,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         prefill = prefill_chunked
 
     @partial(jax.jit, donate_argnums=(5,), static_argnums=(6,))
-    def decode_n(outer, layers, tok, page_tables, lengths, pools, n):
+    def decode_n(outer, layers, tok, page_tables, lengths, pools, n,
+                 lora=None):
         """n decode steps in ONE compiled program (lax.scan over the
         step body) — the serving loop's dispatch amortizer: per-step
         python dispatch costs ~8-15 ms through a remote-PJRT tunnel
@@ -1279,11 +1479,14 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         bookkeeping is lengths' = lengths + n. NOTE: ``pools`` is
         DONATED (like decode_step's) — rebind the returned pools and
         never reuse the argument, or JAX raises a donated-buffer
-        error."""
+        error. ``lora``: optional ``(adapter_bank, adapter_ids)``
+        multi-adapter deltas — both jit INPUTS, so the ONE compiled
+        program serves any adapter mix (the serving_lora recompile
+        gate counts exactly this cache staying at one entry)."""
         def body(carry, _):
             tok, lens, pools = carry
             nxt, pools = decode_step(outer, layers, tok, page_tables,
-                                     lens, pools)
+                                     lens, pools, lora)
             step_tok = nxt if nxt.ndim == 1 else jnp.argmax(
                 nxt, -1).astype(jnp.int32)
             return (step_tok.astype(jnp.int32), lens + 1, pools), nxt
@@ -1420,7 +1623,9 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  batch_capacity: int = 8,
                                  scan_layers: bool = True,
                                  chunked_prefill: int | None = None,
-                                 tp: "TPConfig | int | None" = None):
+                                 tp: "TPConfig | int | None" = None,
+                                 lora: "LoRAConfig | tuple | None"
+                                 = None):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
     ("paged", (outer, layers, pools, prefill, decode_step, decode_n))
@@ -1439,6 +1644,7 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
     # paged-routed traffic (and int8 rounding can flip a greedy token,
     # breaking cross-backend output parity for no routing reason)
     tp = as_tp_config(tp)
+    lora = as_lora_config(lora)
     if tp is None:
         gen = llama_decode_factory(model, max_len=max_len,
                                    kv_cache_dtype=kv_cache_dtype,
@@ -1452,7 +1658,15 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                        n_pool_pages=n_pool_pages,
                                        kv_cache_dtype=kv_cache_dtype,
                                        chunked_prefill=chunked_prefill,
-                                       scan_layers=scan_layers, tp=tp)
+                                       scan_layers=scan_layers, tp=tp,
+                                       lora=lora)
+    lora_hooks = None
+    if lora is not None:
+        # the adapter-cache device hooks (serving.adapters.AdapterCache
+        # consumes them); dtype follows the decode weights
+        lora_hooks = lora_bank_hooks(
+            model.config, lora,
+            paged[1]["self_attn.q_proj.weight"].dtype, tp=tp)
 
     class _Serving:
         # staticmethod: a bare function class-attribute would BIND as a
@@ -1467,6 +1681,11 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         n_pool_pages_ = n_pool_pages
         chunked_prefill_ = chunked_prefill
         tp_ = tp  # TPConfig when the paged path is mesh-sharded
+        lora_ = lora  # LoRAConfig when multi-adapter serving is built
+        if lora_hooks is not None:
+            # adapter-cache device hooks (paddle_tpu.serving.adapters)
+            init_adapter_bank = staticmethod(lora_hooks[0])
+            upload_adapter = staticmethod(lora_hooks[1])
 
         def pick(self, lengths, capacity=None, shared_prefix=False,
                  expect_churn=False):

@@ -15,10 +15,16 @@ gate to 10^5 requests), a fault-tolerance layer (``faults``: seeded
 replayable crash/stall/decode-error plans, heartbeat failure
 detection, failover with retry budgets + resume-from-prefix — the
 ``--chaos`` arm gates zero lost/duplicated requests and token parity
-vs fault-free), a seeded replayable trace generator
-(``workload``, including the multi-tenant overload and cluster
-traces), and per-request TTFT/TPOT/SLO/goodput/fairness metrics
-(``metrics``). The whole stack is watchable by the SLO layer
+vs fault-free), a multi-model LoRA layer
+(``adapters``: host-resident ``AdapterStore`` + budgeted
+``AdapterCache`` paging delta sets into the device bank the compiled
+fixed-shape decode batch reads per row — thousands of fine-tuned
+variants of one base model from one engine, ``--lora`` gates
+multiplexed goodput >= 1.2x a one-model-per-replica split), a seeded
+replayable trace generator
+(``workload``, including the multi-tenant overload, cluster and
+Zipf-adapter traces), and per-request TTFT/TPOT/SLO/goodput/fairness
+metrics (``metrics``). The whole stack is watchable by the SLO layer
 (``paddle_tpu.obs.slo``/``obs.flight``): ``ServingEngine(slo=...)``
 and ``ClusterRouter(slo=..., flight=...)`` evaluate burn-rate /
 threshold / heartbeat rules streaming on the virtual clock and
@@ -29,13 +35,16 @@ overload trace fifo-vs-qos, ``--cluster`` the 10^5-request trace
 across placements, ``--chaos``/``--slo`` the seeded fault schedule);
 ``tools/bench_gate.py serving``/``obs`` gate every family.
 """
+from .adapters import AdapterCache, AdapterStore  # noqa: F401
 from .autoscale import (AutoscaleConfig, Autoscaler,  # noqa: F401
                         count_oscillations)
 from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       DisaggregatedPlacement, LeastLoadedPlacement,
                       PlacementPolicy, PrefixAwarePlacement,
                       RoundRobinPlacement, make_placement)
-from ..models.nlp.llama_decode import TPConfig  # noqa: F401
+from ..models.nlp.llama_decode import (LoRAConfig,  # noqa: F401
+                                       TPConfig,
+                                       synthesize_lora_deltas)
 from .engine import (DecodeError, EngineClock,  # noqa: F401
                      EngineSession, FixedPolicy, KVHandoff, Policy,
                      RoutedPolicy, ServeResult, ServingEngine,
@@ -55,4 +64,5 @@ from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        synthesize_overload_trace,
                        synthesize_prefill_heavy_trace,
                        synthesize_recurring_prefix_trace,
-                       synthesize_trace, trace_stats)
+                       synthesize_trace,
+                       synthesize_zipf_adapter_trace, trace_stats)

@@ -109,19 +109,56 @@ class LeastLoadedPlacement(PlacementPolicy):
 
 
 class PrefixAwarePlacement(PlacementPolicy):
-    """Send sharers where their prefix lives; everyone else least
-    loaded. ``threshold`` is the minimum matched-token count (page
-    multiple) worth steering for — below it the cache can save at most
-    a partial chunk, so load balance wins; default one page."""
+    """Send sharers where their (cached) state lives; everyone else
+    least loaded. Two residency signals, one discipline:
+
+    - **adapter residency** (multi-model serving): a request naming a
+      LoRA adapter prefers a replica whose device bank already HOLDS
+      that adapter (non-acquiring ``adapter_resident`` probe; ties —
+      least loaded among holders) — re-uploading a delta set on N
+      replicas is exactly the thrash re-prefilling a shared prompt N
+      times is, so the same placement rule covers both. Residency is
+      a PREFERENCE, not a pin: when the least-loaded holder is
+      already ``adapter_load_slack`` requests deeper than the
+      least-loaded replica overall, the request goes there instead
+      and the hot adapter REPLICATES (one more upload buys another
+      replica's worth of capacity — the S-LoRA fleet behavior; a
+      sticky rule would recreate the one-model-per-replica split's
+      hot-spot exactly). With no holder, fall through to the
+      prefix/least-loaded logic below (the chosen replica uploads
+      once and becomes the holder).
+    - **prefix residency**: the PR-6 rule — probe every replica's
+      paged pool with the non-acquiring ``match_prefix`` and steer to
+      a replica holding >= ``threshold`` tokens of the prompt (page
+      multiple; default one page), ties least loaded; below
+      threshold, least loaded overall."""
 
     name = "prefix_aware"
 
-    def __init__(self, threshold: Optional[int] = None):
+    def __init__(self, threshold: Optional[int] = None,
+                 adapter_load_slack: Optional[int] = None):
         if threshold is not None and threshold < 1:
             raise ValueError("prefix threshold must be >= 1 token")
+        if adapter_load_slack is not None and adapter_load_slack < 1:
+            raise ValueError("adapter_load_slack must be >= 1 "
+                             "request")
         self.threshold = threshold
+        self.adapter_load_slack = adapter_load_slack
 
     def place(self, r, replicas):
+        if r.adapter is not None:
+            holders = [rep for rep in replicas
+                       if rep.session.adapter_resident(r.adapter)]
+            if holders:
+                best_h = _least_loaded(holders)
+                best_all = _least_loaded(replicas)
+                slack = self.adapter_load_slack \
+                    if self.adapter_load_slack is not None \
+                    else max(1, replicas[0].session.eng.slots // 2)
+                if best_h.session.load() \
+                        <= best_all.session.load() + slack:
+                    return best_h
+                return best_all  # replicate the hot adapter there
         probes = [(rep.session.match_prefix(r.prompt), rep)
                   for rep in replicas]
         best = max(p for p, _ in probes)

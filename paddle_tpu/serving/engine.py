@@ -54,11 +54,12 @@ from ..jax_compat import named_sharding
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..models.nlp.llama_decode import (as_tp_config,
+from ..models.nlp.llama_decode import (as_lora_config, as_tp_config,
                                        llama_serving_decode_factory,
                                        route_decode,
                                        tree_device_bytes)
 from ..ops.pallas.paged_attention import PagedKVCache
+from .adapters import AdapterCache, AdapterStore
 from .metrics import MetricsCollector
 from .scheduler import QoSScheduler, ServiceEstimator
 from .workload import Request, iter_jsonl_tolerant
@@ -186,6 +187,21 @@ def make_policy(spec) -> Policy:
     return FixedPolicy(spec)
 
 
+def _coerce_paged_only(policy, what: str, why: str):
+    """Paged-only feature coercion (tensor parallelism, adapter
+    multiplexing): the routed policy — string OR instance — coerces
+    to the paged fixed policy, and an explicitly dense one is a
+    configuration error at construction, not a NotImplementedError
+    mid-serve. A custom Policy object is the caller's responsibility
+    to keep paged-only."""
+    if policy == "routed" or isinstance(policy, RoutedPolicy):
+        return "paged"
+    if policy == "dense" or (isinstance(policy, FixedPolicy)
+                             and policy.backend == "dense"):
+        raise ValueError(f"policy='dense' {what}: {why}")
+    return policy
+
+
 @dataclasses.dataclass
 class ServeResult:
     policy: str
@@ -215,6 +231,12 @@ class ServeResult:
     # save_log — monitor-on logs stay byte-identical to monitor-off
     # (the obs_slo gate's identity clause); the incident JSONL is the
     # monitor's own IncidentLog.save
+    adapter_stats: Optional[Dict] = None  # AdapterCache.cache_stats()
+    # + "invariant_ok" (the ADAPTER slot census alone, sampled every
+    # engine turn — independent of cache_stats' pool flag, so each
+    # census names its own subsystem) when the run served adapters;
+    # None single-model — the result shape every pre-adapter consumer
+    # sees is unchanged
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -320,15 +342,17 @@ def _jit_cache_size(fn) -> Optional[int]:
 
 
 class _PagedRow:
-    __slots__ = ("req", "slot", "tok", "out", "eff", "done", "t0")
+    __slots__ = ("req", "slot", "tok", "out", "eff", "done", "t0",
+                 "aslot")
 
     def __init__(self, req: Request, slot: int, first_tok: int,
-                 t0: float = 0.0):
+                 t0: float = 0.0, aslot: int = 0):
         self.req = req
         self.slot = slot
         self.tok = first_tok
         self.out = [first_tok]
         self.t0 = t0  # admit time (slot-occupancy span start)
+        self.aslot = aslot  # adapter-bank slot (0 = identity)
         cancel = req.cancel_after if req.cancel_after is not None \
             else 10 ** 9
         self.eff = min(req.max_new_tokens, cancel)
@@ -347,11 +371,11 @@ class _PrefillingRow:
 
     __slots__ = ("req", "slot", "t_admit", "n_cached", "resume", "T",
                  "next_chunk", "n_chunks", "run_chunks", "toks", "pt",
-                 "skipped")
+                 "skipped", "aslot")
 
     def __init__(self, req: Request, slot: int, t_admit: float,
                  n_cached: int, resume: int, T: int, chunk: int,
-                 toks, pt):
+                 toks, pt, aslot: int = 0):
         self.req = req
         self.slot = slot
         self.t_admit = t_admit
@@ -367,6 +391,7 @@ class _PrefillingRow:
         self.pt = pt                  # (1, W) page table row
         self.skipped = 0              # times passed over by a shorter
         # entry — the anti-starvation aging counter
+        self.aslot = aslot            # adapter-bank slot (0 = identity)
 
     def remaining_chunks(self) -> int:
         return self.n_chunks - self.next_chunk
@@ -463,7 +488,7 @@ class ServingEngine:
                  scheduler=None, trace=None,
                  prefix_cache: bool = True,
                  prefill_chunk_budget: Optional[int] = None,
-                 slo=None, tp=None):
+                 slo=None, tp=None, adapters=None, lora=None):
         # ``tp``: None (byte-identical to the single-device engine —
         # outputs, slot logs, metrics records, registry contents), a
         # TPConfig, or an int degree. With a MODEL it is threaded into
@@ -471,7 +496,19 @@ class ServingEngine:
         # with a PREBUILT factory the factory's own tp_ is
         # authoritative — passing a conflicting tp here is an error,
         # because arrays cannot be re-sharded after the build.
+        # ``adapters``: None (byte-identical to the single-model
+        # engine) or an AdapterStore / {name: deltas} dict — the
+        # multi-model LoRA registry. Needs a lora-enabled factory:
+        # with a MODEL, pass ``lora=LoRAConfig(...)|(n_slots, rank)``
+        # and it is threaded into the build; with a PREBUILT factory
+        # the factory's own lora_ is authoritative (conflicts error,
+        # like tp). Per-request ``Request.adapter`` names the delta
+        # set; adapter weights page host->device through a budgeted
+        # ``AdapterCache`` (LRU retention, pin-while-in-flight) and
+        # every mix of adapters decodes through ONE fixed-shape
+        # compiled batch.
         tp = as_tp_config(tp)
+        lora = as_lora_config(lora)
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -487,7 +524,7 @@ class ServingEngine:
                 model, max_len=max_len, page_size=page_size,
                 n_pool_pages=n_pool_pages, kv_cache_dtype=kv_cache_dtype,
                 batch_capacity=slots, scan_layers=scan_layers,
-                chunked_prefill=page_size, tp=tp)
+                chunked_prefill=page_size, tp=tp, lora=lora)
         else:
             max_len = serving.max_len_
             page_size = serving.page_size_
@@ -500,22 +537,56 @@ class ServingEngine:
                     "at build; pass tp to the factory (or the model "
                     "path) instead")
             tp = fac_tp
+            fac_lora = getattr(serving, "lora_", None)
+            if lora is not None and fac_lora != lora:
+                raise ValueError(
+                    f"lora={lora} conflicts with the prebuilt "
+                    f"factory's lora_={fac_lora} — the adapter bank "
+                    "is sized at build; pass lora to the factory (or "
+                    "the model path) instead")
+            lora = fac_lora
+        # --- multi-model adapter serving (inert at adapters=None) ---
+        self.lora = getattr(serving, "lora_", None)
+        if adapters is not None and not isinstance(adapters,
+                                                  AdapterStore):
+            adapters = AdapterStore(dict(adapters))
+        if adapters is not None and self.lora is None:
+            raise ValueError(
+                "adapters= needs a lora-enabled serving factory "
+                "(llama_serving_decode_factory(lora=...) or "
+                "SimServing(lora_slots=...)) — the adapter bank is "
+                "part of the compiled program's inputs")
+        self._adapter_store = adapters
+        self._g_adapter_resident = None
+        self._ctr_adapter_hits = None
+        self._ctr_adapter_uploads = None
+        if adapters is not None:
+            # created ONLY when multi-model serving is configured, so
+            # single-model runs leave no trace in the registry (PR-5
+            # convention)
+            self._g_adapter_resident = obs_metrics.REGISTRY.gauge(
+                "serving_adapter_resident",
+                "LoRA adapters resident in the device bank "
+                "(pinned + retained)")
+            self._ctr_adapter_hits = obs_metrics.REGISTRY.counter(
+                "serving_adapter_hits_total",
+                "adapter admissions served from the resident bank")
+            self._ctr_adapter_uploads = obs_metrics.REGISTRY.counter(
+                "serving_adapter_uploads_total",
+                "host->device adapter delta uploads")
+            # multi-model serving is paged-only, exactly like tp: the
+            # dense wave cache has no adapter bank
+            policy = _coerce_paged_only(
+                policy, "with adapters",
+                "the dense backend holds no adapter bank")
         self.tp = tp
         self.tp_size = tp.size if tp is not None else 1
         if tp is not None:
             # tensor-parallel serving is paged-only (no dense replica
-            # exists — see llama_decode.PagedOnlyDense): the routed
-            # policy — string OR instance — coerces to the paged
-            # fixed policy, and an explicitly dense one is a
-            # configuration error at construction, not a
-            # NotImplementedError mid-serve. A custom Policy object
-            # is the caller's responsibility to keep paged-only.
-            if policy == "routed" or isinstance(policy, RoutedPolicy):
-                policy = "paged"
-            elif policy == "dense" or (isinstance(policy, FixedPolicy)
-                                       and policy.backend == "dense"):
-                raise ValueError("policy='dense' under tp: a sharded "
-                                 "factory holds no dense replica")
+            # exists — see llama_decode.PagedOnlyDense)
+            policy = _coerce_paged_only(
+                policy, "under tp",
+                "a sharded factory holds no dense replica")
         if serving.chunked_prefill_ is None:
             raise ValueError("the engine needs a chunked-prefill paged "
                              "backend (llama_serving_decode_factory("
@@ -733,6 +804,37 @@ class ServingEngine:
             return spec
         return obs_slo.SLOMonitor(spec)
 
+    def _make_adapter_cache(self) -> Optional[AdapterCache]:
+        """A FRESH adapter cache per run/session (cold bank — two
+        seeded replays upload identically), or None when the engine is
+        single-model. The device hooks come from the factory
+        (``init_adapter_bank``/``upload_adapter``); the bank is sized
+        by the factory's ``lora_.n_slots``."""
+        if self._adapter_store is None:
+            return None
+        return AdapterCache(self._adapter_store, self.lora.n_slots,
+                            self.serving.init_adapter_bank,
+                            self.serving.upload_adapter)
+
+    def _lora_arg(self, acache: Optional[AdapterCache], ids):
+        """The ``lora=`` argument for a factory call: ``(bank, ids)``
+        when multi-model serving is on (ids staged like every other
+        host batch input), None otherwise — single-model engines call
+        the factory EXACTLY as before, so their programs and outputs
+        are untouched."""
+        if acache is None:
+            return None
+        return (acache.bank, self._arr(np.asarray(ids, np.int32)))
+
+    def _note_adapters(self, acache: Optional[AdapterCache], m, t):
+        """Refresh the resident-adapter gauge and stream the count to
+        any attached SLO monitor. No-op single-model."""
+        if acache is None:
+            return
+        n = acache.resident_count()
+        self._g_adapter_resident.set(float(n))
+        m.on_adapter_resident(t, n)
+
     @staticmethod
     def _bank_incidents(mon) -> Optional[List]:
         """This run's incidents for ServeResult: the monitor's view of
@@ -858,8 +960,14 @@ class ServingEngine:
         groups: Dict = {}
         order: List = []
         for i, r in enumerate(wave):
-            key = tuple(r.prompt[:ps]) if len(r.prompt) >= ps \
-                else ("short", i)
+            # adapter id joins the grouping key: rows of one adapter
+            # become ADJACENT segments of the admission wave (the
+            # segment-gather layout the batched delta application
+            # reads), and a cohort sharing both prefix and adapter
+            # still co-schedules. Adapter-less traces key every row
+            # with the same None, so their ordering is untouched.
+            key = (r.adapter, tuple(r.prompt[:ps])) \
+                if len(r.prompt) >= ps else (r.adapter, ("short", i))
             if key not in groups:
                 groups[key] = []
                 order.append(key)
@@ -873,6 +981,17 @@ class ServingEngine:
                     f"{r.rid}: padded prompt {self._pad_len(len(r.prompt))}"
                     f" + budget {r.max_new_tokens} + chunk "
                     f"{self.decode_chunk} exceeds max_len {self.max_len}")
+            if r.adapter is not None:
+                if self._adapter_store is None:
+                    raise ValueError(
+                        f"{r.rid}: names adapter {r.adapter!r} but "
+                        "the engine was built without adapters= — a "
+                        "silent base-model answer would be the wrong "
+                        "model's tokens")
+                if r.adapter not in self._adapter_store:
+                    raise ValueError(
+                        f"{r.rid}: unknown adapter {r.adapter!r} "
+                        f"(registered: {self._adapter_store.names()})")
 
     # --- the replay loop --------------------------------------------------
     def run(self, trace: List[Request]) -> ServeResult:
@@ -888,6 +1007,7 @@ class ServingEngine:
         # tables/lengths/free-list/prefix refcounts — device pages live
         # in the factory pools, written by prefill/decode_n
         self._note_pool(book, m)
+        acache = self._make_adapter_cache()
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         waiting: List[Request] = []
@@ -902,6 +1022,7 @@ class ServingEngine:
         seen_groups: set = set()
         prefill_tokens = 0
         inv_ok = True
+        a_inv = True
         expect_churn = self._expect_churn if self._expect_churn \
             is not None else any(r.cancel_after is not None
                                  for r in trace)
@@ -961,7 +1082,7 @@ class ServingEngine:
                         n_adm, _, ptoks = self._admit_paged(
                             wave, book, clock, m, active, free_slots,
                             slot_log, prefix_cached, seen_groups,
-                            outputs, tr=tr, lane=lane)
+                            outputs, tr=tr, lane=lane, acache=acache)
                         prefill_tokens += ptoks
                         for r in wave[:n_adm]:  # possibly reordered —
                             waiting.remove(r)   # remove by identity
@@ -988,7 +1109,8 @@ class ServingEngine:
 
                 if active:
                     self._paged_chunk(book, clock, m, active, free_slots,
-                                      slot_log, outputs, tr=tr)
+                                      slot_log, outputs, tr=tr,
+                                      acache=acache)
                     progressed = True
 
                 if lane:
@@ -999,7 +1121,7 @@ class ServingEngine:
                     _, ptoks = self._lane_step(
                         lane, book, clock, m, active, free_slots,
                         slot_log, outputs, prefix_cached, seen_groups,
-                        tr=tr)
+                        tr=tr, acache=acache)
                     prefill_tokens += ptoks
                     progressed = True
 
@@ -1012,6 +1134,8 @@ class ServingEngine:
                                        + self.admission.max_delay)
                     clock.advance_to(min(targets))
                 inv_ok &= book.census_ok()
+                if acache is not None:
+                    a_inv &= acache.census_ok()
         finally:
             if tr is not None:
                 if prev_tr is not None:
@@ -1028,7 +1152,11 @@ class ServingEngine:
                            trace=tr, prefill_tokens=prefill_tokens,
                            cache_stats=dict(book.cache_stats(),
                                             invariant_ok=inv_ok),
-                           incidents=self._bank_incidents(mon))
+                           incidents=self._bank_incidents(mon),
+                           adapter_stats=(
+                               None if acache is None else
+                               dict(acache.cache_stats(),
+                                    invariant_ok=a_inv)))
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -1074,6 +1202,7 @@ class ServingEngine:
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
         self._note_pool(book, m)
+        acache = self._make_adapter_cache()
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         active: Dict[str, _PagedRow] = {}
@@ -1088,6 +1217,7 @@ class ServingEngine:
         seen_groups: set = set()
         prefill_tokens = 0
         inv_ok = True
+        a_inv = True
         expect_churn = self._expect_churn if self._expect_churn \
             is not None else any(r.cancel_after is not None
                                  for r in trace)
@@ -1099,6 +1229,8 @@ class ServingEngine:
                 m.on_shed(r.rid, t, reason)
                 shed_log[r.rid] = reason
                 self._ctr_shed.inc()
+                if acache is not None:
+                    acache.forget_pending(r.rid)
                 if tr is not None:
                     tr.instant("shed", t=t, track="scheduler",
                                rid=r.rid, reason=reason,
@@ -1173,7 +1305,8 @@ class ServingEngine:
                             n_adm, n_chunks, ptoks = self._admit_paged(
                                 wave, book, clock, m, active, free_slots,
                                 slot_log, prefix_cached, seen_groups,
-                                outputs, tr=tr, lane=lane)
+                                outputs, tr=tr, lane=lane,
+                                acache=acache)
                             prefill_tokens += ptoks
                             if n_adm:
                                 dt = clock.now() - t0
@@ -1199,7 +1332,8 @@ class ServingEngine:
                 if active:
                     t0 = clock.now()
                     self._paged_chunk(book, clock, m, active, free_slots,
-                                      slot_log, outputs, tr=tr)
+                                      slot_log, outputs, tr=tr,
+                                      acache=acache)
                     est.observe("decode", clock.now() - t0)
                     t = clock.now()
                     for sid in list(active):
@@ -1208,18 +1342,19 @@ class ServingEngine:
                             self._finish_paged(sid, book, clock, m,
                                                active, free_slots,
                                                slot_log, outputs,
-                                               timeout=True, tr=tr)
+                                               timeout=True, tr=tr,
+                                               acache=acache)
                     progressed = True
 
                 if lane:
                     _, ptoks = self._lane_step(
                         lane, book, clock, m, active, free_slots,
                         slot_log, outputs, prefix_cached, seen_groups,
-                        tr=tr)
+                        tr=tr, acache=acache)
                     prefill_tokens += ptoks
                     self._lane_timeouts(lane, book, clock, m,
                                         free_slots, slot_log, outputs,
-                                        tr=tr)
+                                        tr=tr, acache=acache)
                     progressed = True
 
                 if not progressed and not active:
@@ -1233,6 +1368,8 @@ class ServingEngine:
                         break  # everything left this turn was shed
                     clock.advance_to(min(targets))
                 inv_ok &= book.census_ok()
+                if acache is not None:
+                    a_inv &= acache.census_ok()
         finally:
             if tr is not None:
                 if prev_tr is not None:
@@ -1251,7 +1388,11 @@ class ServingEngine:
                            trace=tr, prefill_tokens=prefill_tokens,
                            cache_stats=dict(book.cache_stats(),
                                             invariant_ok=inv_ok),
-                           incidents=self._bank_incidents(mon))
+                           incidents=self._bank_incidents(mon),
+                           adapter_stats=(
+                               None if acache is None else
+                               dict(acache.cache_stats(),
+                                    invariant_ok=a_inv)))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -1281,7 +1422,7 @@ class ServingEngine:
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
                      slot_log, prefix_cached, seen_groups, outputs,
-                     tr=None, lane=None, sink=None):
+                     tr=None, lane=None, sink=None, acache=None):
         """Returns (admitted, prefill chunks computed, prefill tokens
         computed) for this wave. With ``lane`` (the async prefill
         lane), admission only RESERVES — pages, slot, bookkeeping —
@@ -1289,7 +1430,12 @@ class ServingEngine:
         ``_lane_step``'s per-turn budget, so this wave's prefill never
         stalls the decode batch (chunk counts are then accounted by
         the lane steps, not here). ``sink`` is the prefill-role
-        handoff interceptor (see ``_prefill_complete``)."""
+        handoff interceptor (see ``_prefill_complete``). ``acache``
+        (multi-model serving): admission PINS the request's adapter
+        in the device bank — a resident adapter is a free hit, a miss
+        pays one paced ``adapter_upload`` on the virtual clock, and a
+        bank whose every slot is pinned by in-flight rows requeues
+        the wave exactly like a page-pool refusal."""
         admitted = 0
         chunks_done = 0
         tokens_done = 0
@@ -1297,6 +1443,29 @@ class ServingEngine:
             if not free_slots:
                 break
             sid = r.rid
+            # adapter residency FIRST (it is the cheapest refusal):
+            # pin-while-in-flight guarantees the bank slot outlives
+            # this row; a rolled-back page allocate below releases
+            # the pin so the requeue retries from a clean slate
+            aslot, a_up = 0, False
+            if acache is not None and r.adapter is not None:
+                try:
+                    # a miss's host->device upload runs INSIDE the
+                    # timed wrapper: paced per upload on the fixed
+                    # clock, real transfer time attributed to the
+                    # adapter_upload span on the measured one (a
+                    # later page-refusal retry HITS and never
+                    # re-pays). Hit/upload COUNTING waits for the
+                    # admission to actually succeed — see
+                    # took_upload below.
+                    aslot, a_up = acache.acquire(
+                        r.adapter, sid,
+                        timed=lambda f: self._timed(
+                            tr, clock, "adapter_upload", f, rid=sid,
+                            adapter=r.adapter))
+                except MemoryError:
+                    break  # every slot pinned: requeue, retry as
+                    # rows finish and release their pins
             # AUTOMATIC prefix acquisition: every request probes the
             # pool's chain-hashed page cache (page-aligned exact match
             # gives token-level sharing with no trace tag;
@@ -1319,6 +1488,12 @@ class ServingEngine:
                     book.rollback_acquire(sid, list(r.prompt))
                 else:
                     book.free(sid)
+                if acache is not None and r.adapter is not None:
+                    # the adapter pin rolls back too; the upload — if
+                    # one ran — stays resident (the retry hits) and
+                    # is REMEMBERED so the successful admission still
+                    # reports it as this request's upload
+                    acache.note_rollback(r.adapter, sid, a_up)
                 break
             d_ev = book._stats["evictions"] - ev0
             if d_ev:
@@ -1343,23 +1518,38 @@ class ServingEngine:
                 // self.chunk_C
             t_admit = clock.now()
             m.on_admit(sid, t_admit, "paged")
+            if acache is not None and r.adapter is not None:
+                # one hit-or-upload event per ADMISSION: an upload
+                # paid by a rolled-back earlier acquire is attributed
+                # here, so every counter surface (registry, report,
+                # cache_stats) tells the same story
+                a_up = acache.took_upload(sid, a_up)
+                (self._ctr_adapter_uploads if a_up
+                 else self._ctr_adapter_hits).inc()
+                m.on_adapter(sid, r.adapter, hit=not a_up)
             if tr is not None:
+                attrs = {} if r.adapter is None \
+                    else {"adapter": r.adapter}
                 tr.instant("admit", t=t_admit,
                            track=self._tenant_track(r), rid=sid,
-                           backend="paged", slot=slot, cached=n_cached)
+                           backend="paged", slot=slot, cached=n_cached,
+                           **attrs)
             if lane is not None:
                 lane.append(_PrefillingRow(r, slot, t_admit, n_cached,
                                            resume, T, self.chunk_C,
-                                           toks, pt))
+                                           toks, pt, aslot=aslot))
                 admitted += 1
                 continue
 
-            def _call(toks=toks, pt=pt, lens=lens, resume=resume):
+            def _call(toks=toks, pt=pt, lens=lens, resume=resume,
+                      aslot=aslot):
                 arr = self._arr
                 return self._p_prefill(
                     self._p_outer, self._p_layers, arr(toks),
                     arr(pt), arr(lens), self._pools,
-                    resume_from=resume)
+                    resume_from=resume,
+                    **({} if acache is None else
+                       {"lora": self._lora_arg(acache, [aslot])}))
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
                 rid=sid, units=n_chunks, resume=resume,
@@ -1372,16 +1562,19 @@ class ServingEngine:
                                    free_slots, slot_log, outputs,
                                    prefix_cached, seen_groups, tr=tr,
                                    t0=t_admit, t_admit=t_admit,
-                                   sink=sink)
+                                   sink=sink, acache=acache,
+                                   aslot=aslot)
             admitted += 1
         if admitted:
             self._g_resident.set(float(len(book._refs)))
+            self._note_adapters(acache, m, clock.now())
         return admitted, chunks_done, tokens_done
 
     def _prefill_complete(self, r, slot, first_tok, n_cached, resume,
                           T, book, clock, m, active, free_slots,
                           slot_log, outputs, prefix_cached,
-                          seen_groups, tr, t0, t_admit, sink=None):
+                          seen_groups, tr, t0, t_admit, sink=None,
+                          acache=None, aslot=0):
         """Everything that happens the moment a request's prompt pages
         hold real K/V: publish them for prefix sharing, account the
         cache hit, then either enter the decode slot (the default),
@@ -1403,7 +1596,7 @@ class ServingEngine:
                     saved=min(resume, T - self.chunk_C),
                     prompt=len(r.prompt))
         prefix_cached[sid] = n_cached
-        row = _PagedRow(r, slot, first_tok, t0=t0)
+        row = _PagedRow(r, slot, first_tok, t0=t0, aslot=aslot)
         done = len(row.out) >= row.eff \
             or first_tok == self.eos_token_id
         # a request DONE at its first token never hands off — the
@@ -1422,12 +1615,13 @@ class ServingEngine:
                        track=self._tenant_track(r), rid=sid)
         if done:
             self._finish_paged(sid, book, clock, m, active,
-                               free_slots, slot_log, outputs, tr=tr)
+                               free_slots, slot_log, outputs, tr=tr,
+                               acache=acache)
         return row
 
     def _lane_step(self, lane, book, clock, m, active, free_slots,
                    slot_log, outputs, prefix_cached, seen_groups,
-                   tr=None, sink=None):
+                   tr=None, sink=None, acache=None):
         """Run up to ``prefill_chunk_budget`` prefill chunks from the
         lane, SHORTEST-REMAINING-FIRST (admission order breaking
         ties): a one-chunk prompt reaches its first token in one lane
@@ -1475,12 +1669,15 @@ class ServingEngine:
                 [len(e.req.prompt) if final else (k + 1) * C],
                 np.int32)
 
-            def _call(toks=toks, pt=e.pt, lens=lens, resume=k * C):
+            def _call(toks=toks, pt=e.pt, lens=lens, resume=k * C,
+                      aslot=e.aslot):
                 arr = self._arr
                 return self._p_prefill(
                     self._p_outer, self._p_layers, arr(toks),
                     arr(pt), arr(lens), self._pools,
-                    resume_from=resume)
+                    resume_from=resume,
+                    **({} if acache is None else
+                       {"lora": self._lora_arg(acache, [aslot])}))
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
                 rid=sid, units=1, chunk=k, of=e.n_chunks,
@@ -1501,7 +1698,8 @@ class ServingEngine:
                 e.req, e.slot, int(np.asarray(first)[0]), e.n_cached,
                 e.resume, e.T, book, clock, m, active, free_slots,
                 slot_log, outputs, prefix_cached, seen_groups, tr=tr,
-                t0=t_done, t_admit=e.t_admit, sink=sink)
+                t0=t_done, t_admit=e.t_admit, sink=sink,
+                acache=acache, aslot=e.aslot)
         if self._g_lane_depth is not None:
             self._g_lane_depth.set(float(len(lane)))
         m.on_lane_depth(clock.now(), len(lane))
@@ -1510,7 +1708,7 @@ class ServingEngine:
         return chunks_run, tokens_run
 
     def _lane_timeouts(self, lane, book, clock, m, free_slots,
-                       slot_log, outputs, tr=None):
+                       slot_log, outputs, tr=None, acache=None):
         """A lane entry whose deadline passes MID-PREFILL is evicted
         exactly like a running row past deadline (reason "timeout",
         pages and slot freed) — a state the interleaved loop cannot
@@ -1526,6 +1724,9 @@ class ServingEngine:
             sid = e.req.rid
             book.free(sid)
             self._g_resident.set(float(len(book._refs)))
+            if acache is not None and e.req.adapter is not None:
+                acache.release(e.req.adapter, sid)
+                self._note_adapters(acache, m, t)
             free_slots.append(e.slot)
             free_slots.sort()
             slot_log.append((round(t, 6), "release", sid, e.slot))
@@ -1585,23 +1786,32 @@ class ServingEngine:
             lambda a, d: a.at[:, :, idx].set(d), self._pools, data)
 
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
-                     outputs, tr=None):
+                     outputs, tr=None, acache=None):
         n = self.decode_chunk
         toks = np.zeros((self.slots,), np.int32)
         pt = np.zeros((self.slots, self.W), np.int32)
         lens = np.zeros((self.slots,), np.int32)
+        # per-slot adapter ids (0 = identity slot): built only when
+        # multi-model serving is on — this is the engine's hottest
+        # loop and single-model replays never read it
+        aids = np.zeros((self.slots,), np.int32) \
+            if acache is not None else None
         rows = sorted(active.values(), key=lambda s: s.slot)
         for st in rows:
             table = book.tables[st.req.rid]
             pt[st.slot, :len(table)] = table
             lens[st.slot] = book.lengths[st.req.rid]
             toks[st.slot] = st.tok
+            if aids is not None:
+                aids[st.slot] = st.aslot
 
         def _call():
             arr = self._arr
             return self._p_decode_n(
                 self._p_outer, self._p_layers, arr(toks),
-                arr(pt), arr(lens), self._pools, n)
+                arr(pt), arr(lens), self._pools, n,
+                **({} if acache is None else
+                   {"lora": self._lora_arg(acache, aids)}))
         emits, _, self._pools = self._timed(
             tr, clock, "decode", _call, jitfn=self._p_decode_n,
             n=n, rows=len(rows), **self._tp_attr)
@@ -1626,14 +1836,19 @@ class ServingEngine:
             if st.done or len(st.out) >= st.eff:
                 self._finish_paged(sid, book, clock, m, active,
                                    free_slots, slot_log, outputs,
-                                   tr=tr)
+                                   tr=tr, acache=acache)
 
     def _finish_paged(self, sid, book, clock, m, active, free_slots,
                       slot_log, outputs, timeout: bool = False,
-                      tr=None):
+                      tr=None, acache=None):
         st = active.pop(sid)
         book.free(sid)
         self._g_resident.set(float(len(book._refs)))
+        if acache is not None and st.req.adapter is not None:
+            # unpin: the adapter is RETAINED evictable (the next
+            # sharer hits), reclaimed only under bank pressure
+            acache.release(st.req.adapter, sid)
+            self._note_adapters(acache, m, clock.now())
         free_slots.append(st.slot)
         free_slots.sort()
         slot_log.append((round(clock.now(), 6), "release", sid, st.slot))
@@ -1865,6 +2080,10 @@ class EngineSession:
         self.book = PagedKVCache(eng.n_pool_pages, eng.page_size,
                                  kv_heads=1, head_dim=1)
         eng._note_pool(self.book, self.m)
+        # per-session adapter cache (multi-model serving; None when
+        # the engine is single-model): each replica owns its bank —
+        # residency is the signal adapter-aware placement routes on
+        self.acache = eng._make_adapter_cache()
         self.pages_total = len(self.book._free)
         self.sched = eng.scheduler
         self.est: Optional[ServiceEstimator] = None
@@ -1889,6 +2108,10 @@ class EngineSession:
         self.seen_groups: set = set()
         self.prefill_tokens = 0
         self.inv_ok = True
+        # adapter-slot census flag, SEPARATE from the pool census so
+        # a page leak is never reported as a bank-slot leak (and vice
+        # versa)
+        self.a_inv_ok = True
         # True while the router may still submit here; finish() (and a
         # drain) clears it, enabling run()'s "nothing else will ever
         # come" admission clause
@@ -1967,6 +2190,15 @@ class EngineSession:
             return 0
         return self.book.match_prefix(list(prompt))
 
+    def adapter_resident(self, name) -> bool:
+        """Non-acquiring probe of THIS replica's adapter bank: is
+        ``name`` on device right now (pinned or retained)? The
+        adapter-aware placement signal — False on a single-model
+        session or for ``name=None``."""
+        if self.acache is None or name is None:
+            return False
+        return self.acache.resident(name)
+
     # --- arrivals ----------------------------------------------------------
     def submit(self, r: Request):
         """One arrival (advance this lane to ``r.arrival`` first). On
@@ -2012,6 +2244,8 @@ class EngineSession:
         t = self.clock.now()
         for r in reqs:
             self.m.forget(r.rid)
+            if self.acache is not None:
+                self.acache.forget_pending(r.rid)
             self.eng._req_close(self.tr, r, t, outcome, 0)
         # accepted-but-not-imported handoffs leave with the queue:
         # their exported KV is RECLAIMED (dropped — wherever the
@@ -2041,6 +2275,9 @@ class EngineSession:
         self.book.free(rid)
         eng = self.eng
         eng._g_resident.set(float(len(self.book._refs)))
+        if self.acache is not None and st.req.adapter is not None:
+            self.acache.release(st.req.adapter, rid)
+            eng._note_adapters(self.acache, self.m, self.clock.now())
         self.free_slots.append(st.slot)
         self.free_slots.sort()
         t = self.clock.now()
@@ -2105,6 +2342,9 @@ class EngineSession:
         self.book.free(sid)
         eng = self.eng
         eng._g_resident.set(float(len(self.book._refs)))
+        if self.acache is not None and e.req.adapter is not None:
+            self.acache.release(e.req.adapter, sid)
+            eng._note_adapters(self.acache, self.m, self.clock.now())
         self.free_slots.append(e.slot)
         self.free_slots.sort()
         t = self.clock.now()
@@ -2145,6 +2385,12 @@ class EngineSession:
             page_size=eng.page_size, tp=eng.tp_size))
         book.free(sid)
         eng._g_resident.set(float(len(book._refs)))
+        if self.acache is not None and r.adapter is not None:
+            # the adapter pin moves with the request: the exporter
+            # unpins (its bank retains the adapter evictable for the
+            # next sharer), the importer re-pins at adoption
+            self.acache.release(r.adapter, sid)
+            eng._note_adapters(self.acache, self.m, t)
         self.free_slots.append(slot)
         self.free_slots.sort()
         self.slot_log.append((round(t, 6), "handoff", sid, slot))
@@ -2193,9 +2439,32 @@ class EngineSession:
             h = min(ready, key=lambda x: (x.t_arrive, x.req.rid))
             r = h.req
             sid = r.rid
+            aslot, a_up = 0, False
+            if r.adapter is not None:
+                if self.acache is None:
+                    raise RuntimeError(
+                        f"handoff {sid!r} names adapter "
+                        f"{r.adapter!r} but this decode worker was "
+                        "built without adapters= — disaggregated "
+                        "adapter serving needs the store on BOTH "
+                        "stages")
+                try:
+                    # the importer pays the paced upload too when its
+                    # bank never saw this adapter (run inside the
+                    # timed wrapper; counting waits for the adoption
+                    # to succeed)
+                    aslot, a_up = self.acache.acquire(
+                        r.adapter, sid,
+                        timed=lambda f: eng._timed(
+                            tr, clock, "adapter_upload", f, rid=sid,
+                            adapter=r.adapter))
+                except MemoryError:
+                    break  # bank fully pinned: retry as rows finish
             try:
                 book.allocate(sid, eng._footprint(r))
             except MemoryError:
+                if r.adapter is not None and self.acache is not None:
+                    self.acache.note_rollback(r.adapter, sid, a_up)
                 if not self.active and not (self.lane or ()) \
                         and not self.queued():
                     raise RuntimeError(
@@ -2203,6 +2472,10 @@ class EngineSession:
                         f"(free pages {len(book._free)}, needs "
                         f"{eng._footprint(r)} tokens)")
                 break
+            if r.adapter is not None:
+                a_up = self.acache.took_upload(sid, a_up)
+                (eng._ctr_adapter_uploads if a_up
+                 else eng._ctr_adapter_hits).inc()
             self.import_queue.remove(h)
             book.lengths[sid] = len(r.prompt)
             eng.import_kv_pages(book.tables[sid][:h.n_pages],
@@ -2226,7 +2499,10 @@ class EngineSession:
                 tr.instant("handoff_import", t=t, track="engine",
                            rid=sid, pages=h.n_pages,
                            source=h.replica_from)
-            row = _PagedRow(r, slot, h.first_tok, t0=t)
+            if r.adapter is not None:
+                m.on_adapter(sid, r.adapter, hit=not a_up)
+                eng._note_adapters(self.acache, m, t)
+            row = _PagedRow(r, slot, h.first_tok, t0=t, aslot=aslot)
             self.active[sid] = row
             self.slot_log.append((round(t, 6), "acquire", sid, slot))
             self.prefix_cached[sid] = 0
@@ -2248,6 +2524,8 @@ class EngineSession:
             self.m.on_shed(r.rid, t, reason)
             self.shed_log[r.rid] = reason
             eng._ctr_shed.inc()
+            if self.acache is not None:
+                self.acache.forget_pending(r.rid)
             if self.tr is not None:
                 self.tr.instant("shed", t=t, track="scheduler",
                                 rid=r.rid, reason=reason,
@@ -2328,7 +2606,8 @@ class EngineSession:
                     self.decode_fault_hook(self)
                 eng._paged_chunk(self.book, clock, m, self.active,
                                  self.free_slots, self.slot_log,
-                                 self.outputs, tr=tr)
+                                 self.outputs, tr=tr,
+                                 acache=self.acache)
             except DecodeError as e:
                 # one slot's computation failed: tear down exactly
                 # that row (the decode turn is forfeit — survivors
@@ -2355,7 +2634,8 @@ class EngineSession:
                                           self.free_slots,
                                           self.slot_log,
                                           self.outputs,
-                                          timeout=True, tr=tr)
+                                          timeout=True, tr=tr,
+                                          acache=self.acache)
             progressed = True
         if self.lane:
             sink = self._handoff_sink if self.role == "prefill" \
@@ -2364,14 +2644,17 @@ class EngineSession:
                 self.lane, self.book, clock, m, self.active,
                 self.free_slots, self.slot_log, self.outputs,
                 self.prefix_cached, self.seen_groups, tr=tr,
-                sink=sink)
+                sink=sink, acache=self.acache)
             self.prefill_tokens += ptoks
             if self.est is not None:
                 eng._lane_timeouts(self.lane, self.book, clock, m,
                                    self.free_slots, self.slot_log,
-                                   self.outputs, tr=tr)
+                                   self.outputs, tr=tr,
+                                   acache=self.acache)
             progressed = True
         self.inv_ok &= self.book.census_ok()
+        if self.acache is not None:
+            self.a_inv_ok &= self.acache.census_ok()
         return progressed
 
     def _route_ctx(self, wave):
@@ -2404,7 +2687,7 @@ class EngineSession:
             self.slot_log, self.prefix_cached, self.seen_groups,
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
-                  else None))
+                  else None), acache=self.acache)
         self.prefill_tokens += ptoks
         for r in wave[:n_adm]:
             self.waiting.remove(r)  # possibly reordered: by identity
@@ -2455,7 +2738,7 @@ class EngineSession:
             self.slot_log, self.prefix_cached, self.seen_groups,
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
-                  else None))
+                  else None), acache=self.acache)
         self.prefill_tokens += ptoks
         if n_adm:
             dt = clock.now() - t0
@@ -2552,5 +2835,9 @@ class EngineSession:
             cache_stats=dict(self.book.cache_stats(),
                              invariant_ok=self.inv_ok),
             replica=self.replica,
-            incidents=ServingEngine._bank_incidents(self.slo))
+            incidents=ServingEngine._bank_incidents(self.slo),
+            adapter_stats=(
+                None if self.acache is None else
+                dict(self.acache.cache_stats(),
+                     invariant_ok=self.a_inv_ok)))
         return self._finished

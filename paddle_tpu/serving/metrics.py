@@ -109,6 +109,13 @@ class MetricsCollector:
         # publish() can export the sharded-only gauge; None = never
         # sharded, nothing exported (PR-5 convention)
         self._pool_dev_bytes: Optional[int] = None
+        # adapter-cache totals over multi-model admits (engine-fed);
+        # the report grows its adapter block ONLY when an adapter
+        # request was actually served, so single-model traces stay
+        # byte-identical (the PR-5 hits>0 convention)
+        self._adapter = {"requests": 0, "hits": 0, "uploads": 0}
+        self._adapter_names: set = set()
+        self._adapter_resident: Optional[int] = None
         # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
         # request's FINAL record at finish/shed plus queue/lane depth
         # samples — the one seam through which the streaming SLO layer
@@ -198,6 +205,24 @@ class MetricsCollector:
         untouched."""
         if self._mon is not None:
             self._mon.observe_value("replica_busy_frac", frac, t)
+
+    def on_adapter(self, rid: str, adapter: str, hit: bool):
+        """``rid`` admitted decoding with LoRA ``adapter``; ``hit``
+        means the delta set was already resident in the device bank
+        (a miss paid one paced host->device upload)."""
+        self._adapter["requests"] += 1
+        self._adapter["hits" if hit else "uploads"] += 1
+        self._adapter_names.add(adapter)
+
+    def on_adapter_resident(self, t: float, count: int):
+        """Resident-adapter census sample (pinned + retained slots,
+        engine-fed on every acquire/release). Kept for publish()'s
+        gauge and streamed to an attached SLO monitor so a
+        ``ThresholdRule(signal="adapter_resident")`` can watch bank
+        pressure; a no-op single-model."""
+        self._adapter_resident = int(count)
+        if self._mon is not None:
+            self._mon.observe_value("adapter_resident", count, t)
 
     def on_pool_bytes(self, t: float, per_device_bytes: int):
         """Per-device KV-pool residency sample (tensor-parallel
@@ -345,6 +370,18 @@ class MetricsCollector:
                 self._prefix["cached"] / max(1, self._prefix["prompt"]),
                 4)
             rec["prefill_tokens_saved"] = self._prefix["saved"]
+        if self._adapter["requests"] > 0:
+            # the adapter block appears ONLY when the trace actually
+            # carried adapters (the same convention): single-model
+            # records stay byte-identical to PR 11
+            rec["adapter_requests"] = self._adapter["requests"]
+            rec["adapters_served"] = len(self._adapter_names)
+            rec["adapter_cache_hits"] = self._adapter["hits"]
+            rec["adapter_uploads"] = self._adapter["uploads"]
+            rec["adapter_cache_hit_rate"] = round(
+                self._adapter["hits"] / self._adapter["requests"], 4)
+            if self._adapter_resident is not None:
+                rec["adapters_resident_end"] = self._adapter_resident
         if slo_ttft is not None and ttfts:
             rec["slo_ttft"] = slo_ttft
             rec["slo_ttft_attained"] = round(
@@ -452,6 +489,14 @@ class MetricsCollector:
                          5000.0, 10000.0, 25000.0, 100000.0))
             for s in stalls:
                 h.observe(s)
+        # resident-adapter gauge: ONLY when the run served adapters
+        # (the engine streamed the census through on_adapter_resident)
+        # — single-model replays leave the registry byte-identical
+        if self._adapter_resident is not None:
+            reg.gauge("serving_adapter_resident",
+                      "LoRA adapters resident in the device bank "
+                      "(pinned + retained)").set(
+                float(self._adapter_resident))
         # per-device KV-pool residency: ONLY when the run was sharded
         # (the engine streamed it through on_pool_bytes) — unsharded
         # replays leave the registry byte-identical (PR-5 convention)

@@ -67,7 +67,8 @@ class SimServing:
     def __init__(self, *, max_len: int = 64, page_size: int = 8,
                  n_pool_pages: int | None = None, slots: int = 8,
                  vocab: int = 509, salt: int = 0,
-                 chunked_prefill: int | None = None, tp=None):
+                 chunked_prefill: int | None = None, tp=None,
+                 lora_slots: int | None = None):
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {page_size}")
@@ -81,9 +82,21 @@ class SimServing:
         # byte census + gauge, handoff tp tags and placement filters —
         # runs at 10^5-request scale. Compute-sharding parity is the
         # real factory's claim, not the sim's.
-        from ..models.nlp.llama_decode import (PagedOnlyDense,
+        from ..models.nlp.llama_decode import (LoRAConfig,
+                                               PagedOnlyDense,
                                                as_tp_config)
         self.tp_ = as_tp_config(tp)
+        # ``lora_slots``: the sim's MULTI-ADAPTER stand-in. A real
+        # adapter is a low-rank weight delta; the sim's is a per-slot
+        # SALT folded into the token rule, so two adapters diverge
+        # every stream while slot 0 (salt 0, the reserved identity)
+        # emits exactly the base rule — the same observable contract
+        # the real bank has, at numpy speed. The factory advertises
+        # ``lora_`` plus the ``init_adapter_bank``/``upload_adapter``
+        # hooks the engine's AdapterCache consumes; a delta set here
+        # is ``{"salt": int}`` (or a bare int).
+        self.lora_ = None if lora_slots is None \
+            else LoRAConfig(n_slots=int(lora_slots), rank=1)
         self.dense = PagedOnlyDense(_SIM_DENSE_REASON)
         if vocab < 3:
             raise ValueError("vocab must be >= 3")
@@ -111,19 +124,33 @@ class SimServing:
                             None, self._make_decode_n())
 
     # --- the token rule ---------------------------------------------------
-    def _token(self, seq) -> int:
+    def _token(self, seq, adapter_salt: int = 0) -> int:
         """THE greedy rule: next token after history ``seq`` = uint64
         wraparound polynomial hash of the whole sequence (deterministic
         on any platform), mapped to [1, vocab). Prefill applies it to
         the pooled prompt; every decode step applies it to the pooled
         prompt + emitted-so-far — one rule, so prefill and decode are
-        RESUME-CONSISTENT (see the module docstring)."""
+        RESUME-CONSISTENT (see the module docstring). ``adapter_salt``
+        (multi-adapter serving) folds the row's adapter into the hash:
+        salt 0 — slot 0, the identity — is EXACTLY the base rule."""
         seq = np.asarray(seq, np.uint64)
         L = len(seq)
         with np.errstate(over="ignore"):
             h = (seq * self._pow[L - 1::-1]).sum()
-        h = (int(h) + self.salt) & ((1 << 64) - 1)
+        h = (int(h) + self.salt + int(adapter_salt)) & ((1 << 64) - 1)
         return 1 + h % (self.vocab - 1)
+
+    # --- adapter-bank hooks (AdapterCache's device seam) ------------------
+    def init_adapter_bank(self):
+        if self.lora_ is None:
+            raise ValueError("SimServing built without lora_slots")
+        return np.zeros((self.lora_.n_slots,), np.int64)
+
+    @staticmethod
+    def upload_adapter(bank, slot, deltas):
+        salt = deltas["salt"] if isinstance(deltas, dict) else deltas
+        bank[int(slot)] = int(salt)
+        return bank
 
     # --- the factory callables --------------------------------------------
     def _make_prefill(self):
@@ -131,7 +158,7 @@ class SimServing:
         C = self.chunked_prefill_
 
         def prefill(outer, layers, toks, pt, lens, pools,
-                    resume_from: int = 0):
+                    resume_from: int = 0, lora=None):
             toks = np.asarray(toks)
             pt = np.asarray(pt)
             L = int(np.asarray(lens)[0])
@@ -144,7 +171,11 @@ class SimServing:
                 pools[pt[0, pos // ps], pos % ps] = toks[0, pos]
             pages = pt[0, :-(-L // ps)]
             seq = pools[pages].reshape(-1)[:L]
-            first = self._token(seq)
+            a_salt = 0
+            if lora is not None:
+                bank, ids = lora
+                a_salt = int(np.asarray(bank)[int(np.asarray(ids)[0])])
+            first = self._token(seq, a_salt)
             return np.asarray([first], np.int64), pools
 
         prefill._cache_size = lambda: 0  # no jit cache to watch
@@ -153,16 +184,23 @@ class SimServing:
     def _make_decode_n(self):
         ps = self.page_size_
 
-        def decode_n(outer, layers, toks, pt, lens, pools, n: int):
+        def decode_n(outer, layers, toks, pt, lens, pools, n: int,
+                     lora=None):
             toks = np.asarray(toks)
             pt = np.asarray(pt)
             lens = np.asarray(lens)
             S = toks.shape[0]
+            bank = ids = None
+            if lora is not None:
+                bank, ids = lora
+                bank, ids = np.asarray(bank), np.asarray(ids)
             emits = np.zeros((n, S), np.int64)
             for s in range(S):
                 L = int(lens[s])
                 if L <= 0:
                     continue  # empty slot rides along (page-0 row)
+                a_salt = int(bank[int(ids[s])]) if bank is not None \
+                    else 0
                 cur = int(toks[s])
                 for k in range(n):
                     pools[pt[s, L // ps], L % ps] = cur
@@ -170,7 +208,7 @@ class SimServing:
                     # a wrong table/chain/pool diverges every token
                     npages = -(-(L + 1) // ps)
                     seq = pools[pt[s, :npages]].reshape(-1)[:L + 1]
-                    cur = self._token(seq)
+                    cur = self._token(seq, a_salt)
                     emits[k, s] = cur
                     L += 1
             return emits, None, pools
@@ -201,7 +239,8 @@ class SimServing:
         return pools
 
     # --- the offline oracle -----------------------------------------------
-    def expected_stream(self, prompt, n_tokens: int):
+    def expected_stream(self, prompt, n_tokens: int,
+                        adapter_salt: int = 0):
         """The token stream a request with ``prompt`` generates,
         computed WITHOUT any engine — the closed-form oracle parity
         tests compare engine outputs against. (The engine path reads
@@ -209,11 +248,12 @@ class SimServing:
         the recurrence directly.) Resume identity falls out of the one
         token rule: ``expected_stream(prompt + s[:e], n-e)`` equals
         ``expected_stream(prompt, n)[e:]`` for any emitted prefix
-        ``s = expected_stream(prompt, n)``."""
+        ``s = expected_stream(prompt, n)``. ``adapter_salt`` is the
+        request's adapter (0 = base model)."""
         hist = [int(t) for t in prompt]
         out = []
         for _ in range(max(0, n_tokens)):
-            nxt = self._token(hist)
+            nxt = self._token(hist, adapter_salt)
             out.append(nxt)
             hist.append(nxt)
         return out

@@ -36,6 +36,13 @@ class Request:
     never mid-flight); ``deadline_ms`` is the end-to-end SLO relative
     to arrival, in milliseconds of clock time (1 clock unit = 1000 ms,
     so a fixed-cost replay can reason about deadlines too).
+
+    ``adapter`` names the LoRA adapter this request decodes with
+    (multi-model serving; ``None`` — the default, and what every
+    legacy trace loads as — is the base model, whose replay is
+    byte-identical to pre-adapter engines). The JSONL record carries
+    the key only when set, so adapter-less traces round-trip
+    byte-identically.
     """
 
     rid: str
@@ -47,6 +54,7 @@ class Request:
     tenant: Optional[str] = None
     priority: int = 0
     deadline_ms: Optional[float] = None
+    adapter: Optional[str] = None
 
     def to_json(self) -> dict:
         d = {"rid": self.rid, "arrival": self.arrival,
@@ -62,6 +70,8 @@ class Request:
             d["priority"] = self.priority
         if self.deadline_ms is not None:
             d["deadline_ms"] = self.deadline_ms
+        if self.adapter is not None:
+            d["adapter"] = self.adapter
         return d
 
     @staticmethod
@@ -73,7 +83,8 @@ class Request:
                        cancel_after=d.get("cancel_after"),
                        tenant=d.get("tenant"),
                        priority=int(d.get("priority", 0)),
-                       deadline_ms=d.get("deadline_ms"))
+                       deadline_ms=d.get("deadline_ms"),
+                       adapter=d.get("adapter"))
 
     def deadline_time(self) -> Optional[float]:
         """Absolute deadline in clock units (None when unbounded)."""
@@ -525,6 +536,86 @@ def synthesize_prefill_heavy_trace(seed: int = 0, *,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def synthesize_zipf_adapter_trace(seed: int = 0,
+                                  n_requests: int = 2000, *,
+                                  n_adapters: int = 4,
+                                  adapter_skew: float = 1.1,
+                                  base_frac: float = 0.0,
+                                  service_tokens_per_unit: float = 8.0,
+                                  overload: float = 1.4,
+                                  prompt_len: Tuple[int, int] = (4, 12),
+                                  output_len: Tuple[int, int] = (4, 12),
+                                  churn_frac: float = 0.05,
+                                  vocab_size: int = 509,
+                                  unit_ms: float = 1000.0,
+                                  slack: float = 6.0,
+                                  chunk_tokens: int = 8,
+                                  rid_prefix: str = "L",
+                                  start: float = 0.0) -> List[Request]:
+    """The MULTI-MODEL workload: mixed-churn traffic whose requests
+    each name one of ``n_adapters`` LoRA adapters, popularity SKEWED
+    by a Zipf-like law (weight ``1/(rank+1)^adapter_skew``) — exactly
+    how production fine-tune traffic concentrates on a few hot
+    variants while a long tail stays warm. ``base_frac`` of requests
+    carry ``adapter=None`` (base-model traffic riding the same
+    batches through the identity slot).
+
+    Arrivals are sorted uniforms over a span sized so demanded output
+    tokens land at ``overload`` x ``service_tokens_per_unit`` (the
+    multiplexed engine's capacity): hot-adapter demand alone then
+    exceeds any single dedicated replica's share, which is the gap
+    the one-engine-per-adapter split loses goodput to and adapter
+    multiplexing recovers. ``churn_frac`` of requests carry a
+    ``cancel_after`` below budget (the mixed-churn shape — adapter
+    pins must survive mid-stream eviction). Every request gets a
+    loose ``deadline_ms`` (lone-request per-chunk service estimate x
+    ``slack``) so goodput is deadline-honest.
+
+    Adapter ids are BAKED INTO rids — ``{rid_prefix}-00042.a3`` /
+    ``...base`` — so a gate can audit per-adapter routing and parity
+    without a side channel; the adapter NAME is ``a<k>``.
+    Deterministic in every field; JSONL round-trips via
+    ``save_trace``/``load_trace``."""
+    if n_adapters < 1:
+        raise ValueError("need >= 1 adapter")
+    if not 0.0 <= base_frac <= 1.0:
+        raise ValueError("base_frac must be in [0, 1]")
+    if adapter_skew < 0:
+        raise ValueError("adapter_skew must be >= 0")
+    rng = np.random.default_rng(seed)
+    w = np.asarray([1.0 / (k + 1) ** adapter_skew
+                    for k in range(n_adapters)])
+    w = w / w.sum()
+    budgets = [int(rng.integers(output_len[0], output_len[1] + 1))
+               for _ in range(n_requests)]
+    span = sum(budgets) / (overload * service_tokens_per_unit)
+    times = np.sort(rng.uniform(0.0, span, n_requests))
+    reqs: List[Request] = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        prompt = tuple(int(t) for t in rng.integers(1, vocab_size,
+                                                    plen))
+        budget = budgets[i]
+        if base_frac > 0 and rng.random() < base_frac:
+            adapter, tag = None, "base"
+        else:
+            k = int(rng.choice(n_adapters, p=w))
+            adapter, tag = f"a{k}", f"a{k}"
+        cancel = None
+        if churn_frac > 0 and budget > 1 \
+                and rng.random() < churn_frac:
+            cancel = int(rng.integers(1, budget))
+        chunks = -(-plen // chunk_tokens)
+        reqs.append(Request(
+            rid=f"{rid_prefix}-{i:05d}.{tag}",
+            arrival=start + float(times[i]), prompt=prompt,
+            max_new_tokens=budget, cancel_after=cancel,
+            deadline_ms=round((chunks + budget + 1) * unit_ms
+                              * slack, 3),
+            adapter=adapter))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def _profile_times(rng, n: int, span: float, shape) -> np.ndarray:
     """``n`` sorted arrival times over ``[0, span]`` drawn from an
     inhomogeneous Poisson process with relative rate ``shape`` (an
@@ -831,4 +922,12 @@ def trace_stats(trace: Sequence[Request]) -> dict:
     n_deadline = sum(1 for r in trace if r.deadline_ms is not None)
     if n_deadline:
         out["deadline_requests"] = n_deadline
+    adapters = sorted({r.adapter for r in trace
+                       if r.adapter is not None})
+    if adapters:
+        # only adapter-carrying traces grow these keys (adapter-less
+        # stats stay byte-identical)
+        out["adapters"] = adapters
+        out["adapter_requests"] = sum(
+            1 for r in trace if r.adapter is not None)
     return out

@@ -1,0 +1,733 @@
+"""Multi-model LoRA serving: adapter multiplexing at fleet scale.
+
+The claims: N LoRA adapters decode through ONE fixed-shape compiled
+batch (per-row bank slots are data — the ``decode_n`` program cache
+stays at one entry across adapter churn), every multiplexed stream is
+bit-equal to a dedicated single-adapter engine's (real tiny-llama
+factory AND the sim arm), ``adapter=None`` everywhere is
+byte-identical to the pre-adapter engine (outputs, slot logs,
+decisions, metrics records, report keys, registry contents), the
+budgeted ``AdapterCache`` honors LRU retention / pin-while-in-flight /
+refusal-requeues with its resident+evictable+free census conserved,
+``prefix_aware`` placement routes to adapter residency and replicates
+hot adapters under load, ``Request.adapter`` round-trips JSONL with
+legacy traces untouched, the metrics/trace adapter blocks appear ONLY
+for multi-model traffic, and the ``serving_lora`` bench-gate family
+passes its pass rows and fails its FAIL rows.
+"""
+import dataclasses
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.nlp.llama_decode import (
+    LoRAConfig, as_lora_config, lora_bank_hooks, synthesize_lora_deltas)
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.serving import (AdapterCache, AdapterStore,
+                                ClusterRouter, QoSScheduler, Request,
+                                ServingEngine, load_trace,
+                                make_sim_serving, save_trace,
+                                synthesize_trace,
+                                synthesize_zipf_adapter_trace,
+                                trace_stats)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB = 97
+COSTS = {"prefill_unit": 1.0, "decode": 1.0, "adapter_upload": 1.0}
+
+
+def _sim_store(n=4, prime=7919):
+    return AdapterStore({f"a{k}": {"salt": prime * (k + 1)}
+                         for k in range(n)})
+
+
+def _sim_engine(lora_slots=None, adapters=None, slots=8, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", dict(COSTS))
+    kw.setdefault("decode_chunk", 4)
+    return ServingEngine(
+        serving=make_sim_serving(max_len=64, page_size=8, slots=slots,
+                                 vocab=509, lora_slots=lora_slots),
+        slots=slots, policy="paged", adapters=adapters, **kw)
+
+
+def _zipf(seed=0, n=40, n_adapters=4, **kw):
+    kw.setdefault("base_frac", 0.2)
+    kw.setdefault("churn_frac", 0.1)
+    return synthesize_zipf_adapter_trace(seed=seed, n_requests=n,
+                                        n_adapters=n_adapters, **kw)
+
+
+# --- Request.adapter + trace round-trip -------------------------------------
+
+def test_request_adapter_roundtrip(tmp_path):
+    """The adapter field survives JSONL; the key is written only when
+    set, so adapter-less records are byte-identical to PR 11's."""
+    r = Request(rid="x", arrival=1.0, prompt=(1, 2), max_new_tokens=3,
+                adapter="support-bot")
+    assert Request.from_json(r.to_json()) == r
+    plain = Request(rid="y", arrival=2.0, prompt=(3,), max_new_tokens=1)
+    assert "adapter" not in plain.to_json()
+    assert Request.from_json(plain.to_json()).adapter is None
+    p = tmp_path / "t.jsonl"
+    save_trace(str(p), [r, plain])
+    back = load_trace(str(p))
+    assert back == [r, plain]
+
+
+def test_legacy_trace_jsonl_byte_identical(tmp_path):
+    """An adapter-less trace's JSONL is byte-for-byte what the
+    pre-adapter serializer wrote (no new key, no ordering drift)."""
+    trace = synthesize_trace(seed=3, n_requests=6, vocab_size=VOCAB)
+    p = tmp_path / "t.jsonl"
+    save_trace(str(p), trace)
+    for line, r in zip(open(p), trace):
+        d = json.loads(line)
+        assert set(d) <= {"rid", "arrival", "prompt", "max_new_tokens",
+                          "prefix_group", "cancel_after", "tenant",
+                          "priority", "deadline_ms"}
+        assert d["rid"] == r.rid
+
+
+def test_zipf_adapter_trace_shape():
+    """Seeded determinism, rid-baked adapter ids, Zipf head heavier
+    than tail, mixed-churn fields, JSONL round-trip."""
+    a = _zipf(seed=7, n=400)
+    b = _zipf(seed=7, n=400)
+    assert a == b
+    assert any(r.adapter is None and r.rid.endswith(".base")
+               for r in a)
+    counts = {}
+    for r in a:
+        if r.adapter is not None:
+            assert r.rid.endswith("." + r.adapter)
+            counts[r.adapter] = counts.get(r.adapter, 0) + 1
+    assert counts["a0"] > counts["a3"]  # the Zipf skew
+    assert any(r.cancel_after is not None for r in a)
+    assert all(r.deadline_ms is not None for r in a)
+    st = trace_stats(a)
+    assert st["adapters"] == sorted(counts)
+    assert st["adapter_requests"] == sum(counts.values())
+    # adapter-less stats carry no adapter keys
+    st0 = trace_stats(synthesize_trace(seed=0, n_requests=4))
+    assert "adapters" not in st0 and "adapter_requests" not in st0
+    with pytest.raises(ValueError, match="adapter"):
+        synthesize_zipf_adapter_trace(n_adapters=0)
+
+
+# --- AdapterCache units ------------------------------------------------------
+
+def _cache(n_slots=4, n_adapters=6):
+    store = _sim_store(n_adapters)
+    sim = make_sim_serving(lora_slots=n_slots)
+    return store, AdapterCache(store, n_slots, sim.init_adapter_bank,
+                               sim.upload_adapter)
+
+
+def test_cache_hit_miss_upload_and_bank_content():
+    store, c = _cache(n_slots=3)
+    s1, up1 = c.acquire("a0", "r1")
+    assert up1 and s1 == 1 and int(c.bank[s1]) == 7919
+    s2, up2 = c.acquire("a0", "r2")      # second pin: hit, same slot
+    assert (s2, up2) == (s1, False)
+    s3, up3 = c.acquire("a1", "r3")
+    assert up3 and s3 == 2 and int(c.bank[s3]) == 7919 * 2
+    assert c.cache_stats()["uploads"] == 2
+    assert c.cache_stats()["hits"] == 1
+    assert c.census_ok()
+
+
+def test_cache_lru_eviction_order():
+    """Released adapters park evictable in release order; a miss
+    reclaims the LEAST recently parked first."""
+    _, c = _cache(n_slots=3)
+    c.acquire("a0", "r0")
+    c.acquire("a1", "r1")
+    c.release("a0", "r0")
+    c.release("a1", "r1")        # LRU order now: a0, a1
+    slot_a0 = c.slot_of("a0")
+    c.acquire("a2", "r2")        # evicts a0 (oldest parked)
+    assert not c.resident("a0") and c.resident("a1")
+    assert c.slot_of("a2") == slot_a0
+    assert c.cache_stats()["evictions"] == 1
+    # revival: re-acquiring the survivor is a hit, not an upload
+    _, up = c.acquire("a1", "r3")
+    assert not up
+    assert c.census_ok()
+
+
+def test_cache_pin_survives_eviction_pressure():
+    """A pinned adapter is never evicted: misses churn through the
+    other slot while the pin holds, and its bank content is intact."""
+    _, c = _cache(n_slots=3)
+    c.acquire("a0", "live")          # pinned throughout
+    for i, name in enumerate(("a1", "a2", "a3", "a4")):
+        c.acquire(name, f"r{i}")
+        c.release(name, f"r{i}")
+    assert c.resident("a0")
+    assert int(c.bank[c.slot_of("a0")]) == 7919
+    assert c.cache_stats()["evictions"] == 3
+    assert c.census_ok()
+
+
+def test_cache_budget_refusal_mutates_nothing():
+    """Every usable slot pinned -> MemoryError; the census and the
+    pin table are untouched, and a later release unblocks."""
+    _, c = _cache(n_slots=3)
+    c.acquire("a0", "r0")
+    c.acquire("a1", "r1")
+    before = c.cache_stats()
+    with pytest.raises(MemoryError, match="pinned"):
+        c.acquire("a2", "r2")
+    after = c.cache_stats()
+    assert after["refusals"] == before["refusals"] + 1
+    for k in ("resident_slots", "evictable_slots", "free_slots",
+              "uploads"):
+        assert after[k] == before[k]
+    assert c.census_ok()
+    c.release("a0", "r0")
+    s, up = c.acquire("a2", "r2")    # now evicts a0
+    assert up and c.census_ok()
+
+
+def test_cache_acquire_exception_safe():
+    """A raising upload hook (e.g. a rank-mismatched delta set caught
+    by the real hook's shape check) must not leak the slot out of the
+    census: free list / evictable LRU / stats restore exactly, the
+    error stays loud, and the cache keeps serving."""
+    store = AdapterStore({"good": {"salt": 1}, "bad": "boom",
+                          "good2": {"salt": 2}})
+    sim = make_sim_serving(lora_slots=3)
+
+    def upload(bank, slot, deltas):
+        if deltas == "boom":
+            raise ValueError("delta shape mismatch")
+        return sim.upload_adapter(bank, slot, deltas)
+    c = AdapterCache(store, 3, sim.init_adapter_bank, upload)
+    # free-list path
+    before = c.cache_stats()
+    with pytest.raises(ValueError, match="mismatch"):
+        c.acquire("bad", "r0")
+    assert c.cache_stats() == before and c.census_ok()
+    # eviction path: fill both slots, park them, then fail an acquire
+    c.acquire("good", "r1")
+    c.acquire("good2", "r2")
+    c.release("good", "r1")
+    c.release("good2", "r2")
+    before = c.cache_stats()
+    with pytest.raises(ValueError, match="mismatch"):
+        c.acquire("bad", "r3")
+    assert c.cache_stats() == before and c.census_ok()
+    # the would-be victim survived with content intact
+    assert c.resident("good")
+    _, up = c.acquire("good", "r4")
+    assert not up and int(c.bank[c.slot_of("good")]) == 1
+
+
+def test_cache_validation():
+    store, c = _cache()
+    with pytest.raises(KeyError, match="unknown adapter"):
+        c.acquire("nope", "r")
+    c.acquire("a0", "r")
+    with pytest.raises(ValueError, match="already pinned"):
+        c.acquire("a0", "r")
+    with pytest.raises(ValueError, match="no pin"):
+        c.release("a0", "other")
+    with pytest.raises(ValueError, match="n_slots"):
+        AdapterCache(store, 1, lambda: None, lambda b, s, d: b)
+    with pytest.raises(ValueError, match="already registered"):
+        store.add("a0", {"salt": 1})
+    with pytest.raises(ValueError, match="non-empty"):
+        AdapterStore({"": 1})
+
+
+# --- sim engine: multiplexing ------------------------------------------------
+
+def test_sim_multiplexed_vs_dedicated_parity_and_oracle():
+    """One engine mixing 4 adapters (2-usable-slot bank, so the LRU
+    churns) produces per-request streams bit-equal to dedicated
+    runs AND to the closed-form sim oracle."""
+    store = _sim_store(4)
+    trace = _zipf(seed=0, n=60)
+    res = _sim_engine(lora_slots=3, adapters=store).run(trace)
+    assert len(res.outputs) == len(trace)
+    assert res.adapter_stats["invariant_ok"]
+    assert res.adapter_stats["evictions"] > 0  # the bank DID churn
+    sim = make_sim_serving(lora_slots=3)
+    for k in range(4):
+        sub = [r for r in trace if r.adapter == f"a{k}"]
+        dres = _sim_engine(lora_slots=3, adapters=store).run(sub)
+        for r in sub:
+            a, b = res.outputs[r.rid], dres.outputs[r.rid]
+            m = min(len(a), len(b))
+            assert a[:m] == b[:m], r.rid
+        full = next((r for r in sub if r.cancel_after is None), None)
+        if full is not None:
+            assert res.outputs[full.rid] == sim.expected_stream(
+                full.prompt, full.max_new_tokens,
+                adapter_salt=7919 * (k + 1))
+    # base rows decode the identity rule
+    base = next(r for r in trace if r.adapter is None
+                and r.cancel_after is None)
+    assert res.outputs[base.rid] == sim.expected_stream(
+        base.prompt, base.max_new_tokens)
+
+
+def test_sim_determinism_and_bank_size_independence():
+    """Same trace twice -> identical everything; a tight bank vs a
+    roomy bank changes timing (uploads/evictions), never tokens."""
+    store = _sim_store(4)
+    trace = _zipf(seed=2, n=50)
+    r1 = _sim_engine(lora_slots=3, adapters=store).run(trace)
+    r2 = _sim_engine(lora_slots=3, adapters=store).run(trace)
+    assert r1.outputs == r2.outputs
+    assert r1.slot_log == r2.slot_log
+    assert r1.decisions == r2.decisions
+    assert r1.adapter_stats == r2.adapter_stats
+    roomy = _sim_engine(lora_slots=5, adapters=store).run(trace)
+    assert roomy.outputs == r1.outputs
+    assert roomy.adapter_stats["evictions"] == 0
+    assert r1.adapter_stats["uploads"] > roomy.adapter_stats["uploads"]
+
+
+def test_adapterless_engine_byte_identical():
+    """The tentpole identity clause: an engine with adapters=None on
+    an adapter-less trace is byte-identical to PR 11 — and an engine
+    WITH adapters configured still produces identical outputs/logs
+    on that same trace (identity slot 0)."""
+    trace = synthesize_trace(seed=5, n_requests=12, vocab_size=509,
+                             prompt_len=(4, 12), output_len=(3, 8),
+                             churn_frac=0.2)
+    plain = _sim_engine().run(trace)
+    assert plain.adapter_stats is None      # result shape unchanged
+    rep = plain.report()
+    assert not any(k.startswith("adapter") for k in rep)
+    multi = _sim_engine(lora_slots=3, adapters=_sim_store()).run(trace)
+    assert multi.outputs == plain.outputs
+    assert multi.slot_log == plain.slot_log
+    assert multi.decisions == plain.decisions
+    assert multi.metrics.request_rows() == plain.metrics.request_rows()
+    # no adapter ever admitted -> the report block stays absent even
+    # on the configured engine (the hits>0 convention)
+    assert multi.report() == rep
+    assert multi.adapter_stats["uploads"] == 0
+
+
+def test_engine_save_log_no_adapter_fields(tmp_path):
+    """An adapter-less run's save_log carries no adapter artifact —
+    the byte-identity regression against a PR-11 log format."""
+    trace = synthesize_trace(seed=1, n_requests=6, vocab_size=509)
+    res = _sim_engine().run(trace)
+    p = tmp_path / "log.jsonl"
+    res.save_log(str(p))
+    body = open(p).read()
+    assert "adapter" not in body
+
+
+def test_engine_validation():
+    store = _sim_store(2)
+    trace = [Request(rid="q", arrival=0.0, prompt=(1, 2, 3),
+                     max_new_tokens=2, adapter="a0")]
+    with pytest.raises(ValueError, match="without adapters="):
+        _sim_engine(lora_slots=3).run(trace)
+    bad = [dataclasses.replace(trace[0], adapter="zz")]
+    with pytest.raises(ValueError, match="unknown adapter"):
+        _sim_engine(lora_slots=3, adapters=store).run(bad)
+    # adapters= without a lora-enabled factory refuses at build
+    with pytest.raises(ValueError, match="lora-enabled"):
+        _sim_engine(adapters=store)
+    # dense policy refuses; routed coerces to paged
+    with pytest.raises(ValueError, match="dense"):
+        ServingEngine(serving=make_sim_serving(lora_slots=3),
+                      slots=4, policy="dense", adapters=store,
+                      clock="fixed")
+    eng = ServingEngine(serving=make_sim_serving(lora_slots=3),
+                        slots=4, policy="routed", adapters=store,
+                        clock="fixed")
+    assert eng.policy.name == "paged"
+
+
+def test_upload_paced_on_fixed_clock():
+    """Each miss charges one adapter_upload unit; hits are free. Two
+    same-adapter requests arriving apart: exactly one upload span in
+    the virtual timeline (finish times shift by exactly one unit vs a
+    pre-warmed... measured via the metrics block)."""
+    store = _sim_store(2)
+    from paddle_tpu.inference import BatchingConfig
+    trace = [Request(rid="u0", arrival=0.0, prompt=(1, 2, 3, 4),
+                     max_new_tokens=2, adapter="a0"),
+             Request(rid="u1", arrival=50.0, prompt=(5, 6, 7, 8),
+                     max_new_tokens=2, adapter="a0")]
+    res = _sim_engine(lora_slots=3, adapters=store,
+                      admission=BatchingConfig(max_batch=1)).run(trace)
+    rep = res.report()
+    assert rep["adapter_requests"] == 2
+    assert rep["adapter_uploads"] == 1
+    assert rep["adapter_cache_hits"] == 1
+    assert rep["adapter_cache_hit_rate"] == 0.5
+    # the second request never paid the upload unit: its end-to-end
+    # span is exactly one adapter_upload cost shorter for identical
+    # work (the charge lands between arrival and admit)
+    rows = {r["rid"]: r for r in res.metrics.request_rows()}
+    assert rows["u0"]["e2e"] == pytest.approx(rows["u1"]["e2e"] + 1.0)
+
+
+def test_refusal_requeues_until_release():
+    """More distinct in-flight adapters than usable slots: admission
+    refuses, requeues, and completes everyone once pins release —
+    nothing lost, census conserved."""
+    store = _sim_store(4)
+    # 4 long-running rows with 4 distinct adapters, bank of 2 usable
+    trace = [Request(rid=f"p{k}", arrival=0.0,
+                     prompt=tuple(range(1, 5)), max_new_tokens=12,
+                     adapter=f"a{k}") for k in range(4)]
+    res = _sim_engine(lora_slots=3, adapters=store).run(trace)
+    assert len(res.outputs) == 4
+    assert all(len(v) == 12 for v in res.outputs.values())
+    assert res.adapter_stats["refusals"] > 0
+    assert res.adapter_stats["invariant_ok"]
+
+
+def test_qos_scheduled_loop_and_metrics_gauge():
+    """The QoS loop threads adapters too; publish() exports the
+    resident gauge only for multi-model runs."""
+    store = _sim_store(3)
+    trace = _zipf(seed=4, n=30, n_adapters=3)
+    res = _sim_engine(lora_slots=4, adapters=store,
+                      scheduler=QoSScheduler(max_queue=64)).run(trace)
+    assert res.adapter_stats["invariant_ok"]
+    rep = res.metrics.publish()
+    assert rep["adapter_requests"] > 0
+    g = obs_metrics.REGISTRY.gauge("serving_adapter_resident")
+    assert g.value >= 0
+    # single-model publish never touches the gauge
+    plain_trace = synthesize_trace(seed=0, n_requests=4,
+                                   vocab_size=509)
+    pres = _sim_engine().run(plain_trace)
+    rec = pres.metrics.publish()
+    assert not any(k.startswith("adapter") for k in rec)
+
+
+# --- cluster placement -------------------------------------------------------
+
+def _cluster_spawn(store, lora_slots=5):
+    def spawn(name):
+        return _sim_engine(lora_slots=lora_slots, adapters=store,
+                           scheduler=QoSScheduler(max_queue=32))
+    return spawn
+
+
+def test_placement_routes_to_adapter_residency():
+    """With the load-slack escape effectively off (huge slack), each
+    adapter converges onto one replica: one upload per adapter
+    fleet-wide, every later sharer routes to the holder and hits."""
+    from paddle_tpu.serving import PrefixAwarePlacement
+    store = _sim_store(4)
+    trace = _zipf(seed=0, n=200, n_adapters=4, base_frac=0.0,
+                  churn_frac=0.0, service_tokens_per_unit=60.0,
+                  overload=0.5)
+    res = ClusterRouter(
+        _cluster_spawn(store), 4,
+        placement=PrefixAwarePlacement(
+            adapter_load_slack=10 ** 6)).run(trace)
+    ups = [res.results[n].adapter_stats["uploads"]
+           for n in sorted(res.results)]
+    assert sum(ups) == 4
+    assert res.census()["conserved"]
+
+
+def test_placement_replicates_hot_adapter_under_load():
+    """One scorching adapter, four replicas: the load-slack rule must
+    replicate it instead of drowning the single holder."""
+    store = _sim_store(1)
+    trace = _zipf(seed=1, n=300, n_adapters=1, base_frac=0.0,
+                  churn_frac=0.0, service_tokens_per_unit=12.0,
+                  overload=1.6)
+    res = ClusterRouter(_cluster_spawn(store), 4,
+                        placement="prefix_aware").run(trace)
+    holders = sum(1 for n in sorted(res.results)
+                  if res.results[n].adapter_stats["uploads"] > 0)
+    assert holders >= 2  # replicated beyond the first holder
+    assert res.census()["conserved"]
+
+
+def test_placement_slack_validation():
+    from paddle_tpu.serving import PrefixAwarePlacement
+    with pytest.raises(ValueError, match="adapter_load_slack"):
+        PrefixAwarePlacement(adapter_load_slack=0)
+
+
+def test_disagg_handoff_moves_adapter_pin():
+    """Adapters compose with disaggregated prefill->decode handoffs:
+    the prefill worker prefills WITH the adapter and unpins at
+    export, the decode worker re-pins (uploading on first sight),
+    streams stay bit-equal to a lone multiplexed engine, and both
+    stages' slot censuses balance."""
+    store = _sim_store(2)
+    trace = [Request(rid=f"h{k}", arrival=float(k),
+                     prompt=tuple(range(1 + k, 7 + k)),
+                     max_new_tokens=4, adapter=f"a{k % 2}")
+             for k in range(8)]
+
+    def spawn(name):
+        return _sim_engine(lora_slots=3, adapters=store,
+                           prefill_chunk_budget=2)
+    res = ClusterRouter(spawn, 2, placement="disaggregated",
+                        roles={"r0": "prefill", "r1": "decode"},
+                        kv_transfer_unit=0.05).run(trace)
+    cen = res.census()
+    assert cen["conserved"] and cen["pool_census_ok"]
+    assert cen["handoffs"]["exported"] == len(trace)
+    lone = _sim_engine(lora_slots=3, adapters=store).run(trace)
+    outs = res.outputs()
+    assert outs == lone.outputs
+    for name in ("r0", "r1"):
+        ast = res.results[name].adapter_stats
+        assert ast["invariant_ok"]
+        assert ast["uploads"] == 2       # each stage saw both once
+        assert ast["resident_slots"] == 0  # every pin released
+
+
+# --- real tiny-llama factory -------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lora_model():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _real_factory(model, lora=None):
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    return llama_serving_decode_factory(
+        model, max_len=48, page_size=8, n_pool_pages=25,
+        batch_capacity=4, chunked_prefill=8, lora=lora)
+
+
+@pytest.fixture(scope="module")
+def real_env(lora_model):
+    model, cfg = lora_model
+    lc = LoRAConfig(n_slots=3, rank=2)
+    store = AdapterStore({
+        f"a{k}": synthesize_lora_deltas(cfg, 2, seed=k + 1,
+                                        init_scale=0.25)
+        for k in range(3)})
+    return {"model": model, "cfg": cfg, "lc": lc, "store": store,
+            "srv": _real_factory(model, lora=lc),
+            "srv_plain": _real_factory(model)}
+
+
+def _real_trace(seed=1, n=10):
+    return _zipf(seed=seed, n=n, n_adapters=3, base_frac=0.3,
+                 churn_frac=0.0, prompt_len=(5, 12), output_len=(3, 6),
+                 vocab_size=VOCAB)
+
+
+def test_real_multiplexed_vs_dedicated_parity(real_env):
+    """The acceptance claim on the real factory: every multiplexed
+    stream bit-equal to a dedicated single-adapter engine, and the
+    adapters genuinely change tokens."""
+    trace = _real_trace()
+    eng = ServingEngine(serving=real_env["srv"], slots=4,
+                        policy="paged", clock="fixed",
+                        adapters=real_env["store"])
+    res = eng.run(trace)
+    srv2 = _real_factory(real_env["model"], lora=real_env["lc"])
+    diverged = 0
+    for k in range(3):
+        sub = [r for r in trace if r.adapter == f"a{k}"]
+        if not sub:
+            continue
+        ded = ServingEngine(serving=srv2, slots=4, policy="paged",
+                            clock="fixed", adapters=real_env["store"])
+        dres = ded.run(sub)
+        for r in sub:
+            assert res.outputs[r.rid] == dres.outputs[r.rid], r.rid
+    # vs the BASE model the adapter streams must (mostly) differ —
+    # a delta that changes nothing would make parity vacuous
+    plain = ServingEngine(serving=real_env["srv_plain"], slots=4,
+                          policy="paged", clock="fixed")
+    base = plain.run([dataclasses.replace(r, adapter=None)
+                      for r in trace])
+    for r in trace:
+        if r.adapter is not None \
+                and res.outputs[r.rid] != base.outputs[r.rid]:
+            diverged += 1
+    assert diverged > 0
+    assert res.adapter_stats["invariant_ok"]
+
+
+def test_real_decode_never_recompiles_across_adapter_churn(real_env):
+    """The recompile acceptance claim: ONE decode_n cache entry
+    across adapter mix churn (bank + ids are jit inputs)."""
+    trace = _real_trace(seed=2, n=12)
+    eng = ServingEngine(serving=real_env["srv"], slots=4,
+                        policy="paged", clock="fixed",
+                        adapters=real_env["store"])
+    eng.run(trace)
+    assert eng._p_decode_n._cache_size() == 1
+    assert eng._p_decode_n is real_env["srv"].paged_parts[5]
+
+
+def test_real_adapterless_identity(real_env):
+    """adapter=None rows through the identity slot are bit-equal to
+    the PLAIN (no-lora) factory — outputs, slot logs, decisions,
+    records."""
+    trace = [dataclasses.replace(r, adapter=None)
+             for r in _real_trace(seed=3, n=8)]
+    plain = ServingEngine(serving=real_env["srv_plain"], slots=4,
+                          policy="paged", clock="fixed").run(trace)
+    multi = ServingEngine(serving=_real_factory(real_env["model"],
+                                                lora=real_env["lc"]),
+                          slots=4, policy="paged", clock="fixed",
+                          adapters=real_env["store"]).run(trace)
+    assert multi.outputs == plain.outputs
+    assert multi.slot_log == plain.slot_log
+    assert multi.decisions == plain.decisions
+    assert multi.metrics.request_rows() == plain.metrics.request_rows()
+    assert plain.adapter_stats is None
+
+
+def test_lora_config_and_hooks_validation(real_env):
+    assert as_lora_config(None) is None
+    assert as_lora_config((4, 2)) == LoRAConfig(n_slots=4, rank=2)
+    assert as_lora_config(LoRAConfig(3, 1)).n_slots == 3
+    with pytest.raises(ValueError, match="n_slots"):
+        LoRAConfig(n_slots=1)
+    with pytest.raises(ValueError, match="rank"):
+        LoRAConfig(rank=0)
+    with pytest.raises(ValueError, match="lora"):
+        as_lora_config("wide")
+    # delta-shape validation at upload
+    import jax.numpy as jnp
+    init, upload = lora_bank_hooks(real_env["cfg"], LoRAConfig(3, 2),
+                                   jnp.float32)
+    bank = init()
+    good = synthesize_lora_deltas(real_env["cfg"], 2, seed=9)
+    bank = upload(bank, 1, good)
+    assert float(abs(bank["q_A"][:, 1]).sum()) > 0
+    assert float(abs(bank["q_A"][:, 0]).sum()) == 0  # identity slot
+    bad = dict(good)
+    bad.pop("v_B")
+    with pytest.raises(ValueError, match="missing"):
+        upload(bank, 1, bad)
+    wrong = dict(good, q_A=good["q_A"][:, :, :1])
+    with pytest.raises(ValueError, match="shape"):
+        upload(bank, 1, wrong)
+    # engine-level lora conflict with a prebuilt factory
+    with pytest.raises(ValueError, match="conflicts"):
+        ServingEngine(serving=real_env["srv"], slots=4,
+                      policy="paged", lora=LoRAConfig(5, 2),
+                      adapters=real_env["store"])
+
+
+def test_real_lora_composes_with_tp(real_env):
+    """The docs' TP composition claim: a mesh-sharded factory with a
+    replicated adapter bank produces bit-equal multiplexed streams to
+    the unsharded engine (the delta add reshards into the
+    column-parallel q/v layout under GSPMD)."""
+    from paddle_tpu.models.nlp.llama_decode import (
+        TPConfig, llama_serving_decode_factory)
+    trace = _real_trace(seed=5, n=6)
+    srv_tp = llama_serving_decode_factory(
+        real_env["model"], max_len=48, page_size=8, n_pool_pages=25,
+        batch_capacity=4, chunked_prefill=8, tp=TPConfig((2,)),
+        lora=real_env["lc"])
+    r1 = ServingEngine(serving=real_env["srv"], slots=4,
+                       policy="paged", clock="fixed",
+                       adapters=real_env["store"]).run(trace)
+    r2 = ServingEngine(serving=srv_tp, slots=4, policy="paged",
+                       clock="fixed",
+                       adapters=real_env["store"]).run(trace)
+    assert r2.outputs == r1.outputs
+    assert r2.adapter_stats["invariant_ok"]
+
+
+# --- trace report ------------------------------------------------------------
+
+def test_trace_report_adapter_rows(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_report import adapter_summary, load_trace as _load
+    store = _sim_store(3)
+    trace = _zipf(seed=6, n=20, n_adapters=3)
+    p = tmp_path / "tr.json"
+    res = _sim_engine(lora_slots=4, adapters=store,
+                      trace=str(p)).run(trace)
+    row = adapter_summary(_load(str(p)))
+    assert row is not None and row["bench"] == "trace_report_adapter"
+    assert row["adapter_requests"] == sum(
+        1 for r in trace if r.adapter is not None)
+    assert row["uploads"] == res.adapter_stats["uploads"]
+    assert set(row["by_adapter"]) <= {"a0", "a1", "a2"}
+    # absence: a single-model trace yields no row at all
+    p2 = tmp_path / "tr2.json"
+    _sim_engine(trace=str(p2)).run(
+        synthesize_trace(seed=0, n_requests=4, vocab_size=509))
+    assert adapter_summary(_load(str(p2))) is None
+
+
+# --- gate family -------------------------------------------------------------
+
+def _gate_rows(ratio=1.5, parity=True, census=True, compared=100,
+               drop_arm=None):
+    def arm(name):
+        return {"bench": "serving_lora", "arm": name, "device": "sim",
+                "conserved": True, "pool_census_ok": True,
+                "adapter_census_ok": census}
+    rows = [arm("multiplexed"), arm("split"),
+            {"bench": "serving_lora_summary",
+             "multiplexed_vs_split_goodput": ratio,
+             "adapters": 4, "replicas": 4, "requests": 1000,
+             "adapter_census_ok": census,
+             "parity_ok": parity, "parity_compared": compared}]
+    if drop_arm:
+        rows = [r for r in rows if r.get("arm") != drop_arm]
+    return rows
+
+
+def test_gate_serving_lora_pass_and_fails(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from bench_gate import check_serving_lora
+
+    assert check_serving_lora(_gate_rows()) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["gate"] == "pass"
+    assert out["multiplexed_vs_split_goodput"] == 1.5
+
+    for rows, frag in (
+            (_gate_rows(ratio=1.1), "floor"),
+            (_gate_rows(parity=False), "DIVERGED"),
+            (_gate_rows(compared=0), "DIVERGED"),
+            (_gate_rows(census=False), "census"),
+            (_gate_rows(drop_arm="split"), "BOTH"),
+            ([r for r in _gate_rows()
+              if r["bench"] != "serving_lora_summary"], "UNVERIFIED")):
+        assert check_serving_lora(rows) == 1
+        out = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["gate"] == "FAIL"
+        assert frag in out["reason"]
+
+
+@pytest.mark.slow
+def test_lora_bench_arm_end_to_end(capsys):
+    """The --lora arm at reduced size: rows parse, the gate passes."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import serving_workload_bench as swb
+    from bench_gate import check_serving_lora
+    rc = swb.main(["--cpu", "--lora", "--lora-requests", "800"])
+    assert rc == 0
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    arms = {r.get("arm") for r in rows
+            if r.get("bench") == "serving_lora"}
+    assert arms == {"multiplexed", "split"}
+    assert check_serving_lora(rows) == 0

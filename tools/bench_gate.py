@@ -901,6 +901,102 @@ def check_serving_chaos(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+LORA_GOODPUT_FLOOR = 1.2  # multiplexed vs one-model-per-replica split
+
+
+def check_serving_lora(rows: list) -> int:
+    """Gate the multi-model LoRA rows from serving_workload_bench.py
+    --lora: on the seeded Zipf-adapter trace at EQUAL replica count,
+    the multiplexed fleet (every replica serves every adapter through
+    one fixed-shape batch; adapter-aware placement with hot-adapter
+    replication) must reach >= LORA_GOODPUT_FLOOR x the
+    one-model-per-replica split's goodput, every multiplexed stream
+    must be bit-equal to the split's dedicated single-adapter engine
+    on the common length (per-adapter greedy parity — the correctness
+    claim), and the census must hold on BOTH arms: requests conserved,
+    pool pages balanced, and the adapter cache's
+    resident+evictable+free slot invariant sampled every turn. The
+    split baseline is re-measured in the same run — no stamped
+    file. A missing-JSON input is the caller's no-JSON FAIL: the
+    claim was not checked."""
+    lr = [r for r in rows if r.get("bench") == "serving_lora"]
+    by = {r.get("arm"): r for r in lr}
+    if "multiplexed" not in by or "split" not in by:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_lora rows need BOTH a "
+                                    "multiplexed and a split arm (run "
+                                    "tools/serving_workload_bench.py "
+                                    "--lora)"}))
+        return 1
+    for r in lr:
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True \
+                or r.get("adapter_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "lora census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')} "
+                          "adapter_census_ok="
+                          f"{r.get('adapter_census_ok')} — a request "
+                          "was lost/duplicated, pool pages leaked, or "
+                          "an adapter slot escaped the "
+                          "resident+evictable+free census"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_lora_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_lora_summary row — "
+                                    "the goodput/parity claims are "
+                                    "UNVERIFIED (rerun the --lora arm "
+                                    "end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("parity_ok") is not True \
+            or not int(s.get("parity_compared") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "multiplexed streams DIVERGED "
+                                    "from the dedicated "
+                                    "single-adapter engines (the "
+                                    "batched delta application is "
+                                    "mixing adapters across rows), "
+                                    "or nothing was compared",
+                          "parity_compared": s.get("parity_compared")
+                          }))
+        return 1
+    if s.get("adapter_census_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "adapter-cache census broken in "
+                                    "the summary — a pin leaked or a "
+                                    "slot was double-counted"}))
+        return 1
+    ratio = s.get("multiplexed_vs_split_goodput")
+    rec = {
+        "gate": "pass",
+        "multiplexed_vs_split_goodput": ratio,
+        "goodput_floor": LORA_GOODPUT_FLOOR,
+        "adapters": s.get("adapters"), "replicas": s.get("replicas"),
+        "requests": s.get("requests"),
+        "adapter_hit_rate_multiplexed":
+        s.get("adapter_hit_rate_multiplexed"),
+        "adapter_uploads_multiplexed":
+        s.get("adapter_uploads_multiplexed"),
+        "parity_compared": s.get("parity_compared"),
+        "device": by["multiplexed"].get("device", "?"),
+    }
+    if ratio is None or float(ratio) < LORA_GOODPUT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"multiplexed goodput only {ratio}x the "
+                         f"one-model-per-replica split (floor "
+                         f"{LORA_GOODPUT_FLOOR}) — adapter "
+                         "multiplexing is not recovering the "
+                         "capacity the split strands on cold "
+                         "replicas")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 AUTOSCALE_GOODPUT_FLOOR = 1.0   # autoscaled vs static-peak goodput
 AUTOSCALE_KINDS = ("diurnal", "flash")
 
@@ -1249,8 +1345,10 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     TTFT improvement, a broken refcount/LRU census, a sub-floor
     prefix-aware-vs-round-robin cluster goodput ratio, a broken
     cluster/drain-join request-conservation census, a lost/duplicated
-    /diverging request across a crash, or sub-floor goodput under
-    faults — so the serving claims can only change deliberately."""
+    /diverging request across a crash, sub-floor goodput under
+    faults, or a sub-floor multiplexed-vs-split lora goodput ratio /
+    adapter-parity break (--lora) — so the serving claims can only
+    change deliberately."""
     fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
@@ -1274,6 +1372,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
         fam_rcs["autoscale"] = check_serving_autoscale(rows)
     if any(r.get("bench", "").startswith("serving_tp") for r in rows):
         fam_rcs["tp"] = check_serving_tp(rows)
+    if any(r.get("bench", "").startswith("serving_lora")
+           for r in rows):
+        fam_rcs["lora"] = check_serving_lora(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

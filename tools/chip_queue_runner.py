@@ -125,6 +125,18 @@ QUEUE = [
     ("serving_autoscale",
      [sys.executable, "tools/serving_workload_bench.py",
       "--autoscale"], {}),
+    # PR-12 addition: the multi-model LoRA arm — the Zipf-adapter
+    # trace through a multiplexed fleet (every replica serves every
+    # adapter via one fixed-shape batch with per-row bank slots;
+    # adapter-aware placement with hot-adapter replication) vs a
+    # one-model-per-replica split at equal replica count, over sim
+    # replicas (fixed clock — the chip run smokes the same code
+    # path); bench_gate.py serving gates the serving_lora family
+    # (goodput >= 1.2x the split, per-adapter greedy parity vs the
+    # dedicated engines, request + pool + adapter-slot census)
+    ("serving_lora",
+     [sys.executable, "tools/serving_workload_bench.py", "--lora"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

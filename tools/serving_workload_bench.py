@@ -64,6 +64,16 @@ duplicated requests with census conservation at every membership
 change, completed-stream token parity vs the fault-free run, and
 goodput under faults >= 0.80x fault-free.
 
+The lora arm (``--lora``) replays ONE seeded Zipf-adapter trace
+(hot fine-tunes dominate) through a multiplexed fleet — every replica
+serves every adapter via one fixed-shape batch with per-row bank
+slots, adapter-aware placement replicating hot adapters under load —
+vs a one-model-per-replica split at EQUAL replica count (which is
+also the dedicated-engine parity reference). `bench_gate.py serving`
+gates the `serving_lora` family: multiplexed goodput >= 1.2x the
+split, per-adapter greedy parity, request + pool + adapter-slot
+census conservation.
+
 The observability arms (PR 4):
 
 - ``--trace-out out.json`` exports the measured replay of the FIRST
@@ -92,6 +102,7 @@ Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --cluster --replicas 8
       python tools/serving_workload_bench.py --chaos
       python tools/serving_workload_bench.py --chaos --fault-plan p.jsonl
+      python tools/serving_workload_bench.py --lora
 """
 from __future__ import annotations
 
@@ -652,6 +663,156 @@ def _tp_arm(args):
           if ratio else None,
           "capacity_tp1_refused": tp1_refused,
           "capacity_tp2_served": tp2_served})
+    return 0
+
+
+def _lora_arm(args):
+    """The multi-model LoRA arm: one seeded Zipf-skewed adapter trace
+    (hot adapters dominate, the production fine-tune shape) replayed
+    through TWO fleets of equal replica count on the fixed clock:
+
+    - **multiplexed**: every replica serves EVERY adapter through one
+      fixed-shape decode batch (per-row bank slots, budgeted
+      host<->device AdapterCache), placement adapter-aware
+      (prefix_aware generalized: route to the replica already holding
+      your adapter) — hot-adapter demand spreads over the whole
+      fleet;
+    - **split** (the one-model-per-replica baseline): replica k
+      serves ONLY adapter k — the hot adapter's replica takes the
+      Zipf head alone and drowns while cold replicas idle, which is
+      exactly the capacity-stranding multi-model serving exists to
+      end.
+
+    The split arm doubles as the DEDICATED-ENGINE parity reference:
+    every stream the multiplexed fleet produced must be bit-equal on
+    the common length (per-adapter greedy parity — the acceptance
+    claim). Census (requests conserved, pool pages balanced, adapter
+    slot census) is asserted per arm; bench_gate.py serving gates the
+    serving_lora family (goodput >= LORA floor x split, parity,
+    census)."""
+    import json as _json
+
+    from paddle_tpu.serving import (AdapterStore, ClusterRouter,
+                                    PlacementPolicy, QoSScheduler,
+                                    ServingEngine, make_sim_serving,
+                                    synthesize_zipf_adapter_trace,
+                                    trace_stats)
+    from paddle_tpu.serving.cluster import _least_loaded
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    N = max(1, args.lora_adapters)
+    SLOTS, PS, ML, CHUNK = 8, 8, 64, 4
+    VOCAB = 509
+    costs = {"prefill_unit": 1.0, "decode": 1.0,
+             "adapter_upload": 1.0}
+    # deltas are sim salts: distinct primes so two adapters can never
+    # collide into one stream
+    store = AdapterStore({f"a{k}": {"salt": 7919 * (k + 1)}
+                          for k in range(N)})
+
+    def spawn(lora_slots):
+        def _spawn(name):
+            return ServingEngine(
+                serving=make_sim_serving(
+                    max_len=ML, page_size=PS, slots=SLOTS,
+                    vocab=VOCAB, lora_slots=lora_slots),
+                slots=SLOTS, policy="paged", clock="fixed",
+                fixed_costs=costs, decode_chunk=CHUNK,
+                adapters=store,
+                scheduler=QoSScheduler(max_queue=4 * SLOTS))
+        return _spawn
+
+    # honest cluster capacity under per-chunk pricing (the
+    # _sim_cluster_env arithmetic with this trace's ~2-chunk prompts)
+    B, P = 8.0, 2.0
+    cap = N * B / (P + B / (SLOTS * CHUNK))
+    n_req = max(100, args.lora_requests)
+    trace = synthesize_zipf_adapter_trace(
+        seed=args.seed, n_requests=n_req, n_adapters=N,
+        adapter_skew=1.5, service_tokens_per_unit=cap, overload=1.3,
+        vocab_size=VOCAB)
+    stats = trace_stats(trace)
+
+    class _ByAdapterPlacement(PlacementPolicy):
+        """One model per replica: adapter a<k> pins to replica k —
+        the baseline fleet that cannot multiplex. Base-model
+        (adapter=None) requests go least loaded: any replica serves
+        the base weights, so pinning them anywhere would handicap
+        the baseline beyond what the split actually implies."""
+
+        name = "by_adapter"
+
+        def place(self, r, replicas):
+            if r.adapter is None:
+                return _least_loaded(replicas)
+            k = int(r.adapter[1:])
+            return replicas[k % len(replicas)]
+
+    def run(arm, placement, lora_slots):
+        router = ClusterRouter(spawn(lora_slots), N,
+                               placement=placement)
+        res = router.run(trace)
+        rep = res.report()
+        cen = res.census()
+        astats = [res.results[n].adapter_stats
+                  for n in sorted(res.results)]
+        rec = {"bench": "serving_lora", "arm": arm, "device": "sim",
+               "seed": args.seed, "replicas": N, "adapters": N,
+               "slots": SLOTS, "decode_chunk": CHUNK,
+               "adapter_slots": lora_slots - 1,
+               "service_tokens_per_unit": round(cap, 4)}
+        rec.update(rep)
+        rec["conserved"] = cen["conserved"]
+        rec["pool_census_ok"] = cen["pool_census_ok"]
+        rec["adapter_census_ok"] = all(a["invariant_ok"]
+                                       for a in astats)
+        # LOOKUP-level hit accounting from the caches themselves
+        # (distinct keys from the report's per-admission
+        # adapter_cache_hit_rate: a page-refusal retry is one extra
+        # lookup but still one admission)
+        hits = sum(a["hits"] for a in astats)
+        misses = sum(a["misses"] for a in astats)
+        rec["adapter_lookup_hits"] = hits
+        rec["adapter_lookup_hit_rate"] = round(
+            hits / (hits + misses), 4) if hits + misses else None
+        rec["adapter_uploads"] = sum(a["uploads"] for a in astats)
+        rec["adapter_evictions"] = sum(a["evictions"] for a in astats)
+        rec["adapter_refusals"] = sum(a["refusals"] for a in astats)
+        rec["trace"] = stats
+        emit(rec)
+        return rec, res.outputs()
+
+    # multiplexed replicas can bank the full adapter set (N usable
+    # slots): hot-adapter REPLICATION is what buys the goodput — a
+    # replica pulled in by the load-slack rule must be able to hold
+    # the hot adapter next to the ones it already serves. (The
+    # smaller-bank LRU/refusal discipline is exercised by the
+    # serving_lora unit tests, not this throughput claim.)
+    multi_slots = N + 1
+    m_rec, m_out = run("multiplexed", "prefix_aware", multi_slots)
+    s_rec, s_out = run("split", _ByAdapterPlacement(), 2)
+
+    parity, compared, full_eq = _stream_parity(m_out, s_out)
+    m_g = m_rec.get("goodput_tokens_per_sec") or 0.0
+    s_g = s_rec.get("goodput_tokens_per_sec") or 0.0
+    emit({"bench": "serving_lora_summary", "device": "sim",
+          "seed": args.seed, "replicas": N, "adapters": N,
+          "requests": n_req,
+          "multiplexed_vs_split_goodput": round(m_g / s_g, 4)
+          if s_g else None,
+          "multiplexed_goodput_tokens_per_sec": m_g,
+          "split_goodput_tokens_per_sec": s_g,
+          "multiplexed_goodput_tokens": m_rec.get("goodput_tokens"),
+          "split_goodput_tokens": s_rec.get("goodput_tokens"),
+          "adapter_hit_rate_multiplexed":
+          m_rec.get("adapter_lookup_hit_rate"),
+          "adapter_uploads_multiplexed": m_rec.get("adapter_uploads"),
+          "adapter_census_ok": bool(m_rec.get("adapter_census_ok")
+                                    and s_rec.get("adapter_census_ok")),
+          "parity_ok": parity, "parity_compared": compared,
+          "parity_full_equal": full_eq})
     return 0
 
 
@@ -1222,6 +1383,18 @@ def main(argv=None):
     ap.add_argument("--kv-transfer-unit", type=float, default=0.05,
                     help="disagg arm: per-page KV handoff transfer "
                          "cost on the virtual clock")
+    ap.add_argument("--lora", action="store_true",
+                    help="multi-model LoRA arm: the Zipf-adapter "
+                         "trace through a multiplexed fleet (every "
+                         "replica serves every adapter via the "
+                         "batched bank) vs a one-model-per-replica "
+                         "split at equal replica count, fixed clock, "
+                         "sim replicas; emits serving_lora rows")
+    ap.add_argument("--lora-requests", type=int, default=20_000,
+                    help="requests in the Zipf-adapter trace")
+    ap.add_argument("--lora-adapters", type=int, default=4,
+                    help="adapter count == replica count for both "
+                         "--lora arms")
     ap.add_argument("--autoscale", action="store_true",
                     help="run the elastic-autoscaling arm instead: "
                          "the diurnal + flash-crowd traces (fixed "
@@ -1311,6 +1484,8 @@ def main(argv=None):
         return _autoscale_arm(args)
     if args.tp:
         return _tp_arm(args)
+    if args.lora:
+        return _lora_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)

@@ -18,6 +18,10 @@ tool turns it into the four summaries an on-call actually asks for:
   track in time order, and per-request failover hops — a retried
   request's waterfall row shows ``retries=N`` and its replica path
   (``r0>r2``), so "which replica redid whose work" is one glance.
+- **adapters** (multi-model traces only): per-adapter admit counts
+  and host->device upload totals from the engine's ``admit``
+  instants and ``adapter_upload`` spans; single-model traces render
+  byte-identically without the section.
 
 ``--json`` emits one row PER TRACK, then (for cluster traces, whose
 engine tracks are replica-prefixed ``r0/engine``, ``r0/slot/3``, ...)
@@ -238,6 +242,32 @@ def tp_summary(events: list) -> dict | None:
             "decode_spans": by_kind.get("decode", 0)}
 
 
+def adapter_summary(events: list) -> dict | None:
+    """Multi-model evidence: ``admit`` instants carry an ``adapter``
+    arg when the request decoded through a LoRA adapter
+    (``ServingEngine(adapters=...)``), and every paced host->device
+    delta upload leaves an ``adapter_upload`` span on the engine
+    track. Returns the ``trace_report_adapter`` row — per-adapter
+    admit counts plus the upload total — or None for single-model
+    traces, whose report output stays byte-identical to pre-adapter."""
+    by_adapter: dict = {}
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "admit":
+            continue
+        a = e.get("args", {}).get("adapter")
+        if a is not None:
+            by_adapter[a] = by_adapter.get(a, 0) + 1
+    uploads = sum(1 for e in events if e.get("ph") == "X"
+                  and e.get("name") == "adapter_upload")
+    if not by_adapter and not uploads:
+        return None
+    return {"bench": "trace_report_adapter",
+            "adapters": len(by_adapter),
+            "adapter_requests": sum(by_adapter.values()),
+            "uploads": uploads,
+            "by_adapter": dict(sorted(by_adapter.items()))}
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -449,6 +479,15 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                      f"({tp_row['prefill_spans']} prefill + "
                      f"{tp_row['decode_spans']} decode spans "
                      f"sharded) ==")
+    ad = adapter_summary(events)
+    if ad is not None:
+        # only multi-model traces grow this section — single-model
+        # traces render byte-identically
+        lines.append(f"\n== adapters ({ad['adapters']} served, "
+                     f"{ad['adapter_requests']} requests, "
+                     f"{ad['uploads']} uploads) ==")
+        for name, n in ad["by_adapter"].items():
+            lines.append(f"  {name:16s} x{n}")
     acts = autoscale_actions(events)
     if acts:
         # only autoscaled traces grow this section — pre-autoscale
@@ -507,6 +546,11 @@ def main(argv=None) -> int:
             # sharded-decode traces only: absent otherwise, so
             # pre-TP --json output is byte-identical
             print(json.dumps(tp_row))
+        ad = adapter_summary(events)
+        if ad is not None:
+            # multi-model traces only: absent otherwise, so
+            # single-model --json output is byte-identical
+            print(json.dumps(ad))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
