@@ -213,6 +213,240 @@ def lora_bank_hooks(cfg: LlamaConfig, lora: "LoRAConfig", dtype,
     return init_adapter_bank, upload_adapter
 
 
+# --- speculative serving (draft + target over ONE paged pool) --------------
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Adaptive speculative-decode policy for the serving engine.
+
+    ``n_draft`` is the draft window: each spec round proposes that
+    many tokens (one draft walk) and verifies them in ONE batched
+    target block — greedy acceptance keeps every emitted token
+    EXACTLY the target model's greedy token, so speculation changes
+    latency, never content.
+
+    The ADAPTIVE half is per-request + per-run:
+
+    - eligibility (``Policy.spec_route``): a request decodes
+      speculatively only when ``priority <= max_priority`` AND its
+      deadline is loose (``deadline_ms`` unset or >=
+      ``loose_deadline_ms``) — tight/high-priority traffic keeps the
+      plain fixed-latency decode path;
+    - acceptance floor: the engine EWMAs the measured per-turn
+      acceptance (accepted/proposed, ``ewma_alpha``); once at least
+      ``min_rounds`` spec TURNS (EWMA samples — a busy turn's eight
+      rows are still one sample) are in evidence and the EWMA sits
+      below ``accept_floor``, the route LATCHES to plain decode for
+      the rest of the run (draft compute that mostly misses is pure
+      waste);
+    - overload fallback (``overload_fallback``): while a
+      page-severity SLO incident delivered through
+      ``QoSScheduler.note_incident`` (e.g. a ``BurnRateRule`` firing)
+      stays open, spec rows decode plain — draft compute is spent
+      exactly when capacity is scarce, so overload is the moment to
+      stop spending it. The route re-enables when the incident
+      closes.
+
+    Every flip is logged on the virtual clock with the rule that
+    fired (``ServeResult.spec_stats["flips"]``)."""
+
+    n_draft: int = 4
+    accept_floor: float = 0.35
+    ewma_alpha: float = 0.25
+    min_rounds: int = 8
+    max_priority: int = 0
+    loose_deadline_ms: float = 8000.0
+    overload_fallback: bool = True
+
+    def __post_init__(self):
+        if self.n_draft < 1:
+            raise ValueError("SpecConfig n_draft must be >= 1")
+        if not 0.0 <= self.accept_floor <= 1.0:
+            raise ValueError("accept_floor is an acceptance fraction "
+                             "in [0, 1]")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError("ewma_alpha must be in (0, 1]")
+        if self.min_rounds < 1:
+            raise ValueError("min_rounds must be >= 1")
+        if self.loose_deadline_ms < 0:
+            raise ValueError("loose_deadline_ms must be >= 0")
+
+
+def as_spec_config(spec) -> "SpecConfig | None":
+    """Normalize the ``spec=`` argument: None/False stays off, True
+    is the stock SpecConfig (bool checked FIRST — ``True`` is an int
+    in python, and silently reading it as ``n_draft=1`` would cripple
+    the draft window), an int becomes a SpecConfig with that draft
+    window, a SpecConfig passes through."""
+    if isinstance(spec, bool):
+        return SpecConfig() if spec else None
+    if spec is None or isinstance(spec, SpecConfig):
+        return spec
+    if isinstance(spec, int):
+        return SpecConfig(n_draft=spec)
+    raise ValueError(f"spec {spec!r}: pass None, True, an int "
+                     "n_draft, or a SpecConfig")
+
+
+def _write_positions(pool_l, kv, page_tables, positions, page_size):
+    """kv (B, nkv, T, hd) written at PER-ROW absolute ``positions``
+    (B, T) through the page tables — the speculative draft/verify
+    write. Unlike ``_write_chunk`` (page-aligned) or ``_write_token``
+    (one slot), spec blocks start at each row's current length, so
+    every (row, t) scatters to its own (page, offset). Positions of
+    inactive rows resolve through page-table row 0 into the reserved
+    padding page (the same junk-write discipline empty decode slots
+    ride)."""
+    pages = jnp.take_along_axis(page_tables, positions // page_size, 1)
+    offs = positions % page_size
+    if isinstance(pool_l, tuple):
+        data, sc = pool_l
+        qd, s = _q8(kv)
+        return (data.at[:, pages, offs].set(
+                    jnp.transpose(qd, (1, 0, 2, 3))),
+                sc.at[:, pages, offs].set(jnp.transpose(s, (1, 0, 2))))
+    return pool_l.at[:, pages, offs].set(
+        jnp.transpose(kv, (1, 0, 2, 3)).astype(pool_l.dtype))
+
+
+def build_spec_step(cfg_t: LlamaConfig, cfg_d: LlamaConfig,
+                    page_size: int, scan_layers: bool = True):
+    """ONE compiled speculative round over the paged pool, batched
+    across decode slots: the draft consumes ``[prev, tok]`` (two
+    positions — re-consuming position len-1 rewrites identical K/V
+    and guarantees the draft cache has no hole after a
+    fully-accepted round, the PR-1 two-token-feed trick) then walks
+    ``k-1`` more greedy steps as an in-jit scan; the target verifies
+    ``[tok, d_0..d_{k-1}]`` in ONE (k+1)-position block through its
+    pool. Per-row positions are data (``lengths``), so rows at
+    different depths — and rows routed PLAIN this turn, riding along
+    as length-0 page-0 rows — share the one fixed-shape program and
+    admission churn never recompiles.
+
+    Acceptance is the branch-free PR-1 arithmetic: ``n`` = length of
+    the matching draft prefix, the candidate vector holds accepted
+    drafts then the target's correction/bonus token, junk beyond
+    ``n`` is overwritten by later rounds (the same
+    overwrite-rollback invariant both pools use — K/V written for
+    rejected proposals sits beyond the advanced length and the key
+    masks never reach it).
+
+    Both models' weights travel as ARGUMENTS (the PR-1
+    weights-as-jit-args invariant — a closure capture would inline
+    model-sized constants into the module); under TP the caller
+    passes target weights sharded and draft weights replicated, and
+    the program inherits the arg shardings unchanged.
+
+    Returns a host shim ``spec_step(outer_t, layers_t, outer_d,
+    layers_d, prev_tok, tok, page_tables, lengths, pools_t, pools_d,
+    k) -> (accepted (B,), cand (B, k+1), pools_t', pools_d')`` whose
+    inner jitted program is advertised via ``_jit_inner`` (the PR-4
+    convention), so the engine's recompile detector and
+    ``jit.compile`` trace instants see spec compiles."""
+
+    def make_block(cfg):
+        nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+        hd = cfg.hidden_size // nh
+
+        def block(outer, layers, tokens, pos, page_tables, pools):
+            """tokens (B, T) at per-row absolute positions ``pos``
+            (B, T): write K/V at those slots, attend causally over
+            the whole pool, return (logits (B, T, V), pools')."""
+            k_pools, v_pools = pools
+            B, T = tokens.shape
+            W = page_tables.shape[1]
+            S = W * page_size
+            x = jnp.take(outer["model.embed_tokens.weight"], tokens,
+                         axis=0)
+            key_ok = jnp.arange(S)[None, None, :] <= pos[:, :, None]
+            mask = key_ok[:, None]
+
+            def gather(pool):
+                if isinstance(pool, tuple):
+                    data, sc = pool
+                    g = (data[:, page_tables].astype(jnp.float32)
+                         * sc[:, page_tables][..., None])
+                else:
+                    g = pool[:, page_tables]
+                return jnp.swapaxes(g, 0, 1).reshape(B, nkv, S, hd)
+
+            def body(x, per_layer):
+                lp, kp_l, vp_l = per_layer
+
+                def attend(q, k, v):
+                    kp = _write_positions(kp_l, k, page_tables, pos,
+                                          page_size)
+                    vp = _write_positions(vp_l, v, page_tables, pos,
+                                          page_size)
+                    return _attend(cfg, q,
+                                   gather(kp).astype(q.dtype),
+                                   gather(vp).astype(q.dtype),
+                                   mask), (kp, vp)
+
+                x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend)
+                return x, (kp, vp)
+
+            x, (k_pools, v_pools) = _stack_apply(
+                body, x, (layers, k_pools, v_pools), scan_layers)
+            x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
+            return _logits(cfg, outer, x), (k_pools, v_pools)
+
+        return block
+
+    block_t = make_block(cfg_t)
+    block_d = make_block(cfg_d)
+
+    def _step_body(outer_t, layers_t, outer_d, layers_d, prev_tok,
+                   tok, page_tables, lengths, pools_t, pools_d, k):
+        B = tok.shape[0]
+        lens = lengths
+        # draft: consume [prev, tok] at (len-1, len), emit d_0, then
+        # walk k-1 more steps (in-jit scan — one traced draft block)
+        feed = jnp.stack([prev_tok, tok], 1).astype(jnp.int32)
+        pos0 = lens[:, None] + jnp.asarray([-1, 0])[None, :]
+        lg, pools_d = block_d(outer_d, layers_d, feed, pos0,
+                              page_tables, pools_d)
+        cur = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+
+        def dstep(carry, i):
+            cur, pd = carry
+            lg, pd = block_d(outer_d, layers_d, cur[:, None],
+                             lens[:, None] + 1 + i, page_tables, pd)
+            return (jnp.argmax(lg[:, -1], -1).astype(jnp.int32),
+                    pd), cur
+
+        (last_d, pools_d), ds = jax.lax.scan(
+            dstep, (cur, pools_d), jnp.arange(k - 1))
+        drafts = jnp.concatenate(
+            [jnp.swapaxes(ds, 0, 1), last_d[:, None]], 1) \
+            if k > 1 else last_d[:, None]                    # (B, k)
+        # target verifies [tok, d_0..d_{k-1}] in ONE (k+1)-pos block
+        blk = jnp.concatenate([tok[:, None], drafts], 1) \
+            .astype(jnp.int32)
+        pos_t = lens[:, None] + jnp.arange(k + 1)[None, :]
+        lg_t, pools_t = block_t(outer_t, layers_t, blk, pos_t,
+                                page_tables, pools_t)
+        t = jnp.argmax(lg_t, -1).astype(jnp.int32)       # (B, k+1)
+        matches = (drafts == t[:, :k]).astype(jnp.int32)
+        n = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+        idx = jnp.arange(k + 1)[None, :]
+        dpad = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], 1)
+        cand = jnp.where(idx < n[:, None], dpad, t)
+        return n, cand, pools_t, pools_d
+
+    step = partial(jax.jit, static_argnums=(10,),
+                   donate_argnums=(8, 9))(_step_body)
+
+    def spec_step(outer_t, layers_t, outer_d, layers_d, prev_tok,
+                  tok, page_tables, lengths, pools_t, pools_d, k):
+        return step(outer_t, layers_t, outer_d, layers_d, prev_tok,
+                    tok, page_tables, lengths, pools_t, pools_d, k)
+
+    spec_step._jit_inner = (step,)
+    return spec_step
+
+
 # --- tensor parallelism (sharded decode weights + paged pool) --------------
 
 @dataclasses.dataclass(frozen=True)
@@ -1008,6 +1242,11 @@ def llama_speculative_decode_factory(target: LlamaForCausalLM,
         return seq[None, :S0 + max_new_tokens]
 
     generate_compiled.last_stats = {}
+    # PR-4 convention: a python shim driving jitted programs
+    # advertises them via _jit_inner, so program-cache-growth
+    # detection (engine jit.compile instants, cache_stats consumers)
+    # sees spec compiles instead of missing them behind the shim
+    generate_compiled._jit_inner = (_spec_prefill, _spec_chunk)
 
     def generate(tokens, max_new_tokens: int):
         tokens = jnp.asarray(tokens)
@@ -1625,6 +1864,8 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  chunked_prefill: int | None = None,
                                  tp: "TPConfig | int | None" = None,
                                  lora: "LoRAConfig | tuple | None"
+                                 = None,
+                                 draft: LlamaForCausalLM | None
                                  = None):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
@@ -1667,6 +1908,43 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         lora_hooks = lora_bank_hooks(
             model.config, lora,
             paged[1]["self_attn.q_proj.weight"].dtype, tp=tp)
+    spec_built = None
+    if draft is not None:
+        # SPECULATIVE serving: the draft model gets its own paged
+        # parts over the SAME page geometry — its pool is indexed by
+        # the target's page ids, so draft K/V rides the target's
+        # PagedKVCache chains (one allocation per request covers
+        # both; prefix retention and eviction recycle draft pages in
+        # lockstep with target pages). The batched spec round program
+        # (draft propose + target verify + branch-free acceptance)
+        # comes from build_spec_step.
+        if lora is not None:
+            raise ValueError(
+                "speculative serving does not compose with lora= yet "
+                "— the draft has no adapter bank, so a per-row delta "
+                "would desync draft proposals from the verified "
+                "target (run spec engines single-model)")
+        if draft.config.vocab_size != model.config.vocab_size:
+            raise ValueError("target and draft must share a "
+                             "vocabulary")
+        d_outer, d_layers, d_pools, d_prefill, _, _ = \
+            llama_paged_decode_factory(
+                draft, page_size=page_size, n_pool_pages=n_pool_pages,
+                chunked_prefill=chunked_prefill,
+                scan_layers=scan_layers)
+        if tp is not None:
+            # the draft REPLICATES on the target's mesh (no partition
+            # specs = every device holds the whole draft): a draft is
+            # small by construction, and a replicated draft walk
+            # needs zero collectives — only the sharded target verify
+            # pays the per-block psums
+            mesh = tp.build_mesh()
+            d_outer = device_put_sharded(d_outer, mesh)
+            d_layers = device_put_sharded(d_layers, mesh)
+            d_pools = device_put_sharded(d_pools, mesh)
+        spec_built = (d_outer, d_layers, d_pools, d_prefill,
+                      build_spec_step(model.config, draft.config,
+                                      page_size, scan_layers))
 
     class _Serving:
         # staticmethod: a bare function class-attribute would BIND as a
@@ -1682,6 +1960,11 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         chunked_prefill_ = chunked_prefill
         tp_ = tp  # TPConfig when the paged path is mesh-sharded
         lora_ = lora  # LoRAConfig when multi-adapter serving is built
+        # (draft outer, layers, pools, chunked prefill, spec_step)
+        # when the factory is spec-capable; None otherwise — the
+        # engine refuses ServingEngine(spec=...) without it. A tuple,
+        # not a callable, so the class attribute never method-binds.
+        spec_parts = spec_built
         if lora_hooks is not None:
             # adapter-cache device hooks (paddle_tpu.serving.adapters)
             init_adapter_bank = staticmethod(lora_hooks[0])

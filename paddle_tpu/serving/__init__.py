@@ -43,7 +43,7 @@ from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       PlacementPolicy, PrefixAwarePlacement,
                       RoundRobinPlacement, make_placement)
 from ..models.nlp.llama_decode import (LoRAConfig,  # noqa: F401
-                                       TPConfig,
+                                       SpecConfig, TPConfig,
                                        synthesize_lora_deltas)
 from .engine import (DecodeError, EngineClock,  # noqa: F401
                      EngineSession, FixedPolicy, KVHandoff, Policy,
@@ -59,6 +59,7 @@ from .sim import SimServing, make_sim_serving  # noqa: F401
 from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        load_trace, merge_traces, save_trace,
                        synthesize_cluster_trace,
+                       synthesize_deadline_mix_trace,
                        synthesize_diurnal_trace,
                        synthesize_flash_crowd_trace,
                        synthesize_overload_trace,

@@ -54,7 +54,8 @@ from ..jax_compat import named_sharding
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..models.nlp.llama_decode import (as_lora_config, as_tp_config,
+from ..models.nlp.llama_decode import (as_lora_config,
+                                       as_spec_config, as_tp_config,
                                        llama_serving_decode_factory,
                                        route_decode,
                                        tree_device_bytes)
@@ -142,6 +143,28 @@ class Policy:
 
     def route(self, wave: List[Request], ctx: dict):
         raise NotImplementedError
+
+    def spec_route(self, r: Request, cfg) -> Tuple[bool, str]:
+        """The PER-REQUEST adaptive speculative rule (``RoutedPolicy``
+        applies it on a spec-configured engine; every policy shares
+        this default, and a custom policy may override it): a request
+        decodes speculatively only when its traffic can absorb a
+        missed draft — low priority AND a loose (or absent) deadline.
+        Tight/high-priority rows keep the plain fixed-latency decode
+        path regardless of how well the draft is doing. Returns
+        (eligible, rule) with the clause that fired, the same
+        ``explain=`` discipline as ``route_decode``."""
+        if r.priority > cfg.max_priority:
+            return False, (f"priority {r.priority} > spec ceiling "
+                           f"{cfg.max_priority} (latency-critical "
+                           "traffic decodes plain)")
+        if r.deadline_ms is not None \
+                and r.deadline_ms < cfg.loose_deadline_ms:
+            return False, (f"deadline {r.deadline_ms}ms < loose "
+                           f"floor {cfg.loose_deadline_ms}ms (a "
+                           "tight deadline cannot absorb a missed "
+                           "draft window)")
+        return True, "loose-deadline/low-priority (spec-eligible)"
 
 
 class FixedPolicy(Policy):
@@ -237,6 +260,11 @@ class ServeResult:
     # census names its own subsystem) when the run served adapters;
     # None single-model — the result shape every pre-adapter consumer
     # sees is unchanged
+    spec_stats: Optional[Dict] = None  # the speculative route's
+    # per-run evidence (rounds, draft tokens proposed/accepted,
+    # acceptance EWMA, and the deterministic flip log with explain
+    # rules) when the engine carried spec=; None otherwise — the
+    # result shape every pre-spec consumer sees is unchanged
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -341,18 +369,81 @@ def _jit_cache_size(fn) -> Optional[int]:
     return None
 
 
+class _SpecState:
+    """Per-run adaptive state of the speculative route: the measured
+    acceptance EWMA, the enable/latch flags, and the deterministic
+    flip log. One per ``run()``/session — two seeded replays flip at
+    identical virtual times with identical rules."""
+
+    __slots__ = ("cfg", "enabled", "latched", "ewma", "rounds",
+                 "samples", "proposed", "accepted", "flips")
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.enabled = True
+        self.latched = False   # acceptance-floor kill: plain for the
+        # rest of the run (no spec rounds run -> no new evidence
+        # could ever clear it, so the latch is honest, not lazy)
+        self.ewma: Optional[float] = None
+        self.rounds = 0        # row-rounds (one per row per turn)
+        self.samples = 0       # EWMA samples (one per spec TURN) —
+        # the min_rounds guard counts THESE: a busy first turn is
+        # still one sample, and one unlucky sample must not clear
+        # the cold-start guard just because eight rows shared it
+        self.proposed = 0
+        self.accepted = 0
+        self.flips: List[dict] = []
+
+    def note(self, rows: int, proposed: int, accepted: int):
+        """One spec TURN's evidence (``rows`` rows each ran one
+        draft/verify round). The EWMA samples per turn — per-row
+        sampling would weight busy turns quadratically."""
+        self.rounds += rows
+        self.proposed += proposed
+        self.accepted += accepted
+        if proposed > 0:
+            self.samples += 1
+            rate = accepted / proposed
+            a = self.cfg.ewma_alpha
+            self.ewma = rate if self.ewma is None \
+                else (1 - a) * self.ewma + a * rate
+
+    def stats(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "turns": self.samples,
+            "draft_tokens_proposed": self.proposed,
+            "draft_tokens_accepted": self.accepted,
+            "acceptance_rate": round(
+                self.accepted / self.proposed, 4)
+            if self.proposed else None,
+            "acceptance_ewma": round(self.ewma, 4)
+            if self.ewma is not None else None,
+            "enabled_end": self.enabled,
+            "latched": self.latched,
+            "flips": list(self.flips),
+        }
+
+
 class _PagedRow:
     __slots__ = ("req", "slot", "tok", "out", "eff", "done", "t0",
-                 "aslot")
+                 "aslot", "spec", "prev", "sprop", "sacc")
 
     def __init__(self, req: Request, slot: int, first_tok: int,
-                 t0: float = 0.0, aslot: int = 0):
+                 t0: float = 0.0, aslot: int = 0, spec: bool = False,
+                 prev: int = 0):
         self.req = req
         self.slot = slot
         self.tok = first_tok
         self.out = [first_tok]
         self.t0 = t0  # admit time (slot-occupancy span start)
         self.aslot = aslot  # adapter-bank slot (0 = identity)
+        self.spec = spec    # spec-eligible (admission-time verdict)
+        self.prev = prev    # token at position lengths-1 (the spec
+        # draft's two-token feed re-consumes it; plain rows never
+        # read it)
+        self.sprop = 0      # draft tokens proposed for this row
+        self.sacc = 0       # draft tokens accepted for this row
         cancel = req.cancel_after if req.cancel_after is not None \
             else 10 ** 9
         self.eff = min(req.max_new_tokens, cancel)
@@ -371,11 +462,11 @@ class _PrefillingRow:
 
     __slots__ = ("req", "slot", "t_admit", "n_cached", "resume", "T",
                  "next_chunk", "n_chunks", "run_chunks", "toks", "pt",
-                 "skipped", "aslot")
+                 "skipped", "aslot", "spec")
 
     def __init__(self, req: Request, slot: int, t_admit: float,
                  n_cached: int, resume: int, T: int, chunk: int,
-                 toks, pt, aslot: int = 0):
+                 toks, pt, aslot: int = 0, spec: bool = False):
         self.req = req
         self.slot = slot
         self.t_admit = t_admit
@@ -392,6 +483,7 @@ class _PrefillingRow:
         self.skipped = 0              # times passed over by a shorter
         # entry — the anti-starvation aging counter
         self.aslot = aslot            # adapter-bank slot (0 = identity)
+        self.spec = spec              # spec-eligible (admission-time)
 
     def remaining_chunks(self) -> int:
         return self.n_chunks - self.next_chunk
@@ -488,7 +580,8 @@ class ServingEngine:
                  scheduler=None, trace=None,
                  prefix_cache: bool = True,
                  prefill_chunk_budget: Optional[int] = None,
-                 slo=None, tp=None, adapters=None, lora=None):
+                 slo=None, tp=None, adapters=None, lora=None,
+                 spec=None, spec_draft=None):
         # ``tp``: None (byte-identical to the single-device engine —
         # outputs, slot logs, metrics records, registry contents), a
         # TPConfig, or an int degree. With a MODEL it is threaded into
@@ -509,6 +602,25 @@ class ServingEngine:
         # compiled batch.
         tp = as_tp_config(tp)
         lora = as_lora_config(lora)
+        # ``spec``: None (byte-identical to the plain engine —
+        # outputs, slot logs, decisions, metrics records, report
+        # keys, registry contents), a SpecConfig, or an int draft
+        # window. The SPECULATIVE route: eligible rows (see
+        # ``Policy.spec_route``) decode through one batched
+        # draft/verify round per turn instead of ``decode_n``, with
+        # greedy acceptance keeping every emitted token EXACTLY the
+        # target's greedy token; the route falls back to plain decode
+        # when the measured acceptance EWMA sinks below the floor or
+        # while an overload incident delivered through
+        # ``QoSScheduler.note_incident`` stays open. Needs a
+        # spec-capable factory: with a MODEL, pass the draft model as
+        # ``spec_draft=``; with a PREBUILT factory, build it with
+        # ``llama_serving_decode_factory(draft=...)`` (or
+        # ``SimServing(spec_accept=...)``). Draft and target share
+        # ONE PagedKVCache page-id space — draft K/V lands in its own
+        # pool arrays at the target's page ids, so prefix caching and
+        # eviction recycle both in lockstep.
+        spec = as_spec_config(spec)
         if serving is None:
             if model is None:
                 raise ValueError("pass a model or a prebuilt serving "
@@ -516,6 +628,19 @@ class ServingEngine:
             if max_len % page_size:
                 raise ValueError(f"max_len {max_len} must be a multiple "
                                  f"of page_size {page_size}")
+            if spec is not None and spec_draft is None:
+                raise ValueError(
+                    "spec= with a model needs the draft model too "
+                    "(spec_draft=), or pass a prebuilt spec-capable "
+                    "factory (llama_serving_decode_factory("
+                    "draft=...))")
+            if spec_draft is not None and spec is None:
+                raise ValueError(
+                    "spec_draft= without spec= would build the whole "
+                    "draft decode stack (programs + a full-size "
+                    "draft KV pool) that nothing ever uses — pass "
+                    "spec=SpecConfig(...) (or True) to serve "
+                    "speculatively, or drop the draft")
             if n_pool_pages is None:
                 # page 0 is the reserved padding page; each slot may
                 # need max_len/page_size pages
@@ -524,8 +649,15 @@ class ServingEngine:
                 model, max_len=max_len, page_size=page_size,
                 n_pool_pages=n_pool_pages, kv_cache_dtype=kv_cache_dtype,
                 batch_capacity=slots, scan_layers=scan_layers,
-                chunked_prefill=page_size, tp=tp, lora=lora)
+                chunked_prefill=page_size, tp=tp, lora=lora,
+                draft=spec_draft)
         else:
+            if spec_draft is not None:
+                raise ValueError(
+                    "spec_draft= is ignored with a prebuilt factory "
+                    "— build it spec-capable instead ("
+                    "llama_serving_decode_factory(draft=...) / "
+                    "SimServing(spec_accept=...))")
             max_len = serving.max_len_
             page_size = serving.page_size_
             n_pool_pages = serving.n_pool_pages_
@@ -579,6 +711,55 @@ class ServingEngine:
             policy = _coerce_paged_only(
                 policy, "with adapters",
                 "the dense backend holds no adapter bank")
+        # --- speculative serving (inert at spec=None) ---------------
+        self.spec = spec
+        self._spec_parts = getattr(serving, "spec_parts", None)
+        self._ctr_spec_rounds = None
+        self._ctr_draft_proposed = None
+        self._ctr_draft_accepted = None
+        self._ctr_spec_flips = None
+        if spec is not None:
+            if self._spec_parts is None:
+                raise ValueError(
+                    "spec= needs a spec-capable serving factory "
+                    "(llama_serving_decode_factory(draft=...) or "
+                    "SimServing(spec_accept=...)) — the draft "
+                    "programs and its paged pool are built with the "
+                    "factory")
+            if adapters is not None:
+                raise ValueError(
+                    "spec= does not compose with adapters= yet — the "
+                    "draft has no adapter bank (serve spec engines "
+                    "single-model)")
+            # speculative serving is paged-only, exactly like tp and
+            # adapters: the dense wave cache has no draft/verify
+            # program
+            policy = _coerce_paged_only(
+                policy, "with spec",
+                "the dense backend holds no draft/verify program")
+            if not hasattr(serving, "_live_spec_pools"):
+                # the draft pool buffers are DONATED through every
+                # draft prefill / spec round, like the target pools —
+                # the live buffers ride the shareable serving object
+                serving._live_spec_pools = self._spec_parts[2]
+            # created ONLY when a spec route is configured, so plain
+            # runs leave no trace in the registry (PR-5 convention)
+            _sc = obs_metrics.REGISTRY.counter
+            self._ctr_spec_rounds = _sc(
+                "serving_spec_rounds_total",
+                "speculative draft/verify rounds run (one per spec "
+                "row per turn)")
+            self._ctr_draft_proposed = _sc(
+                "serving_draft_tokens_proposed_total",
+                "draft tokens proposed for target verification")
+            self._ctr_draft_accepted = _sc(
+                "serving_draft_tokens_accepted_total",
+                "draft tokens the target verification accepted")
+            self._ctr_spec_flips = {
+                to: _sc("serving_spec_flips_total",
+                        "adaptive spec-route flips by direction",
+                        to=to)
+                for to in ("plain", "spec")}
         self.tp = tp
         self.tp_size = tp.size if tp is not None else 1
         if tp is not None:
@@ -617,6 +798,14 @@ class ServingEngine:
                              "QoSScheduler-like object with "
                              "enqueue/select/commit")
         self.scheduler = scheduler
+        if spec is not None and spec.overload_fallback \
+                and scheduler is not None \
+                and hasattr(scheduler, "track_overload"):
+            # arm the declared overload seam: note_incident then
+            # tracks open page-severity incidents so the spec gate's
+            # overload_active() probe answers — tracked only when a
+            # consumer is armed (the PR-11 hardening discipline)
+            scheduler.track_overload = True
         self.admission = admission or BatchingConfig()
         self._trace_spec = trace
         # ``slo``: None (off — zero monitor work, the default), an
@@ -682,6 +871,14 @@ class ServingEngine:
                 "serving_prefill_lane_depth",
                 "requests parked in the async prefill lane")
         self.decode_chunk = decode_chunk
+        # page-footprint slack beyond prompt+budget: the deepest
+        # write a decode turn can land. Plain decode_n writes at most
+        # decode_chunk positions past the last emitted token; a spec
+        # round's verify block writes n_draft+1 (rejected proposals
+        # included — overwritten later, but the pages must exist).
+        # spec=None keeps the legacy arithmetic bit-for-bit.
+        self._slack = decode_chunk if spec is None \
+            else max(decode_chunk, spec.n_draft + 1)
         self.clock_mode = clock
         self.fixed_costs = fixed_costs
         self.eos_token_id = eos_token_id
@@ -764,6 +961,14 @@ class ServingEngine:
     def _pools(self, value):
         self.serving._live_pools = value
 
+    @property
+    def _spec_pools(self):
+        return self.serving._live_spec_pools
+
+    @_spec_pools.setter
+    def _spec_pools(self, value):
+        self.serving._live_spec_pools = value
+
     # --- tracing helpers --------------------------------------------------
     @staticmethod
     def _tenant_track(r: Request) -> str:
@@ -815,6 +1020,111 @@ class ServingEngine:
         return AdapterCache(self._adapter_store, self.lora.n_slots,
                             self.serving.init_adapter_bank,
                             self.serving.upload_adapter)
+
+    def _make_spec_state(self) -> Optional[_SpecState]:
+        """Fresh adaptive-route state per run/session (cold EWMA,
+        empty flip log — two seeded replays flip identically), or
+        None when the engine is spec-free."""
+        if self.spec is None:
+            return None
+        return _SpecState(self.spec)
+
+    def _wire_spec_overload(self, mon, sched):
+        """The declared overload seam, auto-wired: with a spec route,
+        a QoS scheduler and an SLO monitor all configured, every
+        incident the monitor opens is delivered to
+        ``QoSScheduler.note_incident`` — a page-severity
+        ``BurnRateRule`` firing then parks the spec route until it
+        closes. Idempotent: a caller-held monitor reused across runs
+        never double-subscribes."""
+        if mon is None or sched is None or self.spec is None \
+                or not self.spec.overload_fallback \
+                or not hasattr(sched, "note_incident"):
+            return
+        if sched.note_incident not in mon._cbs:
+            mon.subscribe(sched.note_incident)
+
+    def _spec_flip(self, spst: _SpecState, clock, tr, enabled: bool,
+                   rule: str):
+        """One deterministic route flip on the virtual clock, with
+        the rule that fired (the ``explain=`` discipline)."""
+        spst.enabled = enabled
+        flip = {"t": round(clock.now(), 6), "enabled": enabled,
+                "rule": rule}
+        spst.flips.append(flip)
+        self._ctr_spec_flips["spec" if enabled else "plain"].inc()
+        if tr is not None:
+            tr.instant("spec_flip", t=clock.now(), track="engine",
+                       enabled=enabled, rule=rule)
+
+    def _spec_gate(self, spst: _SpecState, clock, tr):
+        """Evaluate the adaptive fallbacks once per decode turn,
+        BEFORE the rows are grouped: overload first (spec wastes
+        draft compute exactly when capacity is scarce — the moment a
+        page-severity incident lands through
+        ``QoSScheduler.note_incident``, spec rows decode plain until
+        it closes), then the acceptance floor (EWMA below
+        ``accept_floor`` after ``min_rounds`` row-rounds LATCHES
+        plain for the rest of the run — with no spec rounds running,
+        no new evidence could clear it)."""
+        cfg = spst.cfg
+        if spst.latched:
+            return
+        if cfg.overload_fallback and self.scheduler is not None \
+                and getattr(self.scheduler, "overload_active",
+                            None) is not None \
+                and self.scheduler.overload_active():
+            if spst.enabled:
+                self._spec_flip(
+                    spst, clock, tr, False,
+                    "overload (page-severity incident open via "
+                    "QoSScheduler.note_incident — draft compute is "
+                    "waste when capacity is scarce)")
+            return
+        if spst.ewma is not None and spst.samples >= cfg.min_rounds \
+                and spst.ewma < cfg.accept_floor:
+            spst.latched = True
+            if spst.enabled:
+                self._spec_flip(
+                    spst, clock, tr, False,
+                    f"acceptance ewma {spst.ewma:.4f} < floor "
+                    f"{cfg.accept_floor} after {spst.samples} spec "
+                    "turns (latched plain for the run)")
+            return
+        if not spst.enabled:
+            self._spec_flip(spst, clock, tr, True,
+                            "overload cleared (incident closed)")
+
+    def _spec_prefill_row(self, r: Request, book, T: int, clock, tr):
+        """DRAFT prefill for one spec-eligible row, at the moment its
+        target prompt pages hold real K/V: the draft walks the FULL
+        prompt through the SAME page chain into its own pool arrays.
+        Unlike the target, the draft never takes the prefix-cache
+        skip — a cached chain's publisher may have been plain-routed
+        (tight traffic, a latched run, ``prefix_cache`` off), in
+        which case its draft pages were never written, and a draft
+        conditioned on junk would quietly collapse acceptance. The
+        walk is cheap by construction (the draft is a fraction of
+        the target); the expensive TARGET prefill still takes the
+        full cache skip. Clock kind ``spec_prefill`` (per-unit via
+        ``spec_prefill_unit`` when the cost table carries it)."""
+        sid = r.rid
+        toks = np.zeros((1, T), np.int32)
+        toks[0, :len(r.prompt)] = r.prompt
+        pt = np.zeros((1, self.W), np.int32)
+        table = book.tables[sid]
+        pt[0, :len(table)] = table
+        lens = np.asarray([len(r.prompt)], np.int32)
+        s_outer, s_layers, _, s_prefill, _ = self._spec_parts
+
+        def _call():
+            arr = self._arr
+            return s_prefill(s_outer, s_layers, arr(toks), arr(pt),
+                             arr(lens), self._spec_pools,
+                             resume_from=0)
+        _, self._spec_pools = self._timed(
+            tr, clock, "spec_prefill", _call, jitfn=s_prefill,
+            rid=sid, units=T // self.chunk_C, **self._tp_attr)
 
     def _lora_arg(self, acache: Optional[AdapterCache], ids):
         """The ``lora=`` argument for a factory call: ``(bank, ids)``
@@ -931,9 +1241,10 @@ class ServingEngine:
     def _footprint_len(self, prompt_len: int, budget: int) -> int:
         """The one footprint formula (`_validate` enforces it against
         ``max_len``; the cluster's retry sizing asks it before growing
-        a resumed prompt): padded prompt + decode budget + one decode
-        chunk of slack."""
-        return self._pad_len(prompt_len) + budget + self.decode_chunk
+        a resumed prompt): padded prompt + decode budget + one turn of
+        write slack (a decode chunk, or the spec verify window when a
+        spec route is configured — whichever writes deeper)."""
+        return self._pad_len(prompt_len) + budget + self._slack
 
     def _footprint(self, r: Request) -> int:
         return self._footprint_len(len(r.prompt), r.max_new_tokens)
@@ -979,8 +1290,8 @@ class ServingEngine:
             if self._footprint(r) > self.max_len:
                 raise ValueError(
                     f"{r.rid}: padded prompt {self._pad_len(len(r.prompt))}"
-                    f" + budget {r.max_new_tokens} + chunk "
-                    f"{self.decode_chunk} exceeds max_len {self.max_len}")
+                    f" + budget {r.max_new_tokens} + write slack "
+                    f"{self._slack} exceeds max_len {self.max_len}")
             if r.adapter is not None:
                 if self._adapter_store is None:
                     raise ValueError(
@@ -1008,6 +1319,7 @@ class ServingEngine:
         # in the factory pools, written by prefill/decode_n
         self._note_pool(book, m)
         acache = self._make_adapter_cache()
+        spst = self._make_spec_state()
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         waiting: List[Request] = []
@@ -1082,7 +1394,8 @@ class ServingEngine:
                         n_adm, _, ptoks = self._admit_paged(
                             wave, book, clock, m, active, free_slots,
                             slot_log, prefix_cached, seen_groups,
-                            outputs, tr=tr, lane=lane, acache=acache)
+                            outputs, tr=tr, lane=lane, acache=acache,
+                            spst=spst)
                         prefill_tokens += ptoks
                         for r in wave[:n_adm]:  # possibly reordered —
                             waiting.remove(r)   # remove by identity
@@ -1110,7 +1423,7 @@ class ServingEngine:
                 if active:
                     self._paged_chunk(book, clock, m, active, free_slots,
                                       slot_log, outputs, tr=tr,
-                                      acache=acache)
+                                      acache=acache, spst=spst)
                     progressed = True
 
                 if lane:
@@ -1121,7 +1434,7 @@ class ServingEngine:
                     _, ptoks = self._lane_step(
                         lane, book, clock, m, active, free_slots,
                         slot_log, outputs, prefix_cached, seen_groups,
-                        tr=tr, acache=acache)
+                        tr=tr, acache=acache, spst=spst)
                     prefill_tokens += ptoks
                     progressed = True
 
@@ -1156,7 +1469,9 @@ class ServingEngine:
                            adapter_stats=(
                                None if acache is None else
                                dict(acache.cache_stats(),
-                                    invariant_ok=a_inv)))
+                                    invariant_ok=a_inv)),
+                           spec_stats=(None if spst is None
+                                       else spst.stats()))
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -1198,11 +1513,13 @@ class ServingEngine:
                                decode=costs.get("decode", 1.0),
                                **est_kw)
         mon = self._make_monitor()
+        self._wire_spec_overload(mon, sched)
         m = MetricsCollector(monitor=mon)
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
         self._note_pool(book, m)
         acache = self._make_adapter_cache()
+        spst = self._make_spec_state()
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         active: Dict[str, _PagedRow] = {}
@@ -1306,7 +1623,7 @@ class ServingEngine:
                                 wave, book, clock, m, active, free_slots,
                                 slot_log, prefix_cached, seen_groups,
                                 outputs, tr=tr, lane=lane,
-                                acache=acache)
+                                acache=acache, spst=spst)
                             prefill_tokens += ptoks
                             if n_adm:
                                 dt = clock.now() - t0
@@ -1333,7 +1650,7 @@ class ServingEngine:
                     t0 = clock.now()
                     self._paged_chunk(book, clock, m, active, free_slots,
                                       slot_log, outputs, tr=tr,
-                                      acache=acache)
+                                      acache=acache, spst=spst)
                     est.observe("decode", clock.now() - t0)
                     t = clock.now()
                     for sid in list(active):
@@ -1350,7 +1667,7 @@ class ServingEngine:
                     _, ptoks = self._lane_step(
                         lane, book, clock, m, active, free_slots,
                         slot_log, outputs, prefix_cached, seen_groups,
-                        tr=tr, acache=acache)
+                        tr=tr, acache=acache, spst=spst)
                     prefill_tokens += ptoks
                     self._lane_timeouts(lane, book, clock, m,
                                         free_slots, slot_log, outputs,
@@ -1392,7 +1709,9 @@ class ServingEngine:
                            adapter_stats=(
                                None if acache is None else
                                dict(acache.cache_stats(),
-                                    invariant_ok=a_inv)))
+                                    invariant_ok=a_inv)),
+                           spec_stats=(None if spst is None
+                                       else spst.stats()))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -1422,7 +1741,8 @@ class ServingEngine:
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
                      slot_log, prefix_cached, seen_groups, outputs,
-                     tr=None, lane=None, sink=None, acache=None):
+                     tr=None, lane=None, sink=None, acache=None,
+                     spst=None):
         """Returns (admitted, prefill chunks computed, prefill tokens
         computed) for this wave. With ``lane`` (the async prefill
         lane), admission only RESERVES — pages, slot, bookkeeping —
@@ -1516,6 +1836,13 @@ class ServingEngine:
             # actually computes
             n_chunks = (T - min(resume, T - self.chunk_C)) \
                 // self.chunk_C
+            # per-request adaptive spec verdict, decided ONCE at
+            # admission (the policy's spec_route rule): the row's
+            # route for its whole lifetime, modulo the run-level
+            # enable gate
+            sp = False
+            if spst is not None:
+                sp, _sp_rule = self.policy.spec_route(r, spst.cfg)
             t_admit = clock.now()
             m.on_admit(sid, t_admit, "paged")
             if acache is not None and r.adapter is not None:
@@ -1530,6 +1857,11 @@ class ServingEngine:
             if tr is not None:
                 attrs = {} if r.adapter is None \
                     else {"adapter": r.adapter}
+                if spst is not None:
+                    # the admit instant carries the verdict ONLY on
+                    # spec-configured runs, so plain traces keep
+                    # their event args exactly
+                    attrs["spec"] = sp
                 tr.instant("admit", t=t_admit,
                            track=self._tenant_track(r), rid=sid,
                            backend="paged", slot=slot, cached=n_cached,
@@ -1537,7 +1869,8 @@ class ServingEngine:
             if lane is not None:
                 lane.append(_PrefillingRow(r, slot, t_admit, n_cached,
                                            resume, T, self.chunk_C,
-                                           toks, pt, aslot=aslot))
+                                           toks, pt, aslot=aslot,
+                                           spec=sp))
                 admitted += 1
                 continue
 
@@ -1563,7 +1896,8 @@ class ServingEngine:
                                    prefix_cached, seen_groups, tr=tr,
                                    t0=t_admit, t_admit=t_admit,
                                    sink=sink, acache=acache,
-                                   aslot=aslot)
+                                   aslot=aslot, spst=spst,
+                                   spec_row=sp)
             admitted += 1
         if admitted:
             self._g_resident.set(float(len(book._refs)))
@@ -1574,7 +1908,8 @@ class ServingEngine:
                           T, book, clock, m, active, free_slots,
                           slot_log, outputs, prefix_cached,
                           seen_groups, tr, t0, t_admit, sink=None,
-                          acache=None, aslot=0):
+                          acache=None, aslot=0, spst=None,
+                          spec_row=False):
         """Everything that happens the moment a request's prompt pages
         hold real K/V: publish them for prefix sharing, account the
         cache hit, then either enter the decode slot (the default),
@@ -1596,7 +1931,22 @@ class ServingEngine:
                     saved=min(resume, T - self.chunk_C),
                     prompt=len(r.prompt))
         prefix_cached[sid] = n_cached
-        row = _PagedRow(r, slot, first_tok, t0=t0, aslot=aslot)
+        # a row joins the spec path only if the route is LIVE at its
+        # prefill: a parked route (overload) or a latched one would
+        # decode it plain — running the draft walk anyway would waste
+        # compute on a row whose first plain turn demotes it (see
+        # _paged_chunk), and skipping the walk while still flagging
+        # it spec would hand the draft an unwarmed pool. A
+        # prefill-ROLE session (sink set) never specs either: its
+        # rows hand off to a decode worker that recreates them plain,
+        # so a draft walk here would be compute the fleet never
+        # cashes (disaggregated spec is future work).
+        sp = bool(spec_row and spst is not None and spst.enabled
+                  and not spst.latched and sink is None)
+        if sp:
+            self._spec_prefill_row(r, book, T, clock, tr)
+        row = _PagedRow(r, slot, first_tok, t0=t0, aslot=aslot,
+                        spec=sp, prev=int(r.prompt[-1]))
         done = len(row.out) >= row.eff \
             or first_tok == self.eos_token_id
         # a request DONE at its first token never hands off — the
@@ -1621,7 +1971,7 @@ class ServingEngine:
 
     def _lane_step(self, lane, book, clock, m, active, free_slots,
                    slot_log, outputs, prefix_cached, seen_groups,
-                   tr=None, sink=None, acache=None):
+                   tr=None, sink=None, acache=None, spst=None):
         """Run up to ``prefill_chunk_budget`` prefill chunks from the
         lane, SHORTEST-REMAINING-FIRST (admission order breaking
         ties): a one-chunk prompt reaches its first token in one lane
@@ -1699,7 +2049,8 @@ class ServingEngine:
                 e.resume, e.T, book, clock, m, active, free_slots,
                 slot_log, outputs, prefix_cached, seen_groups, tr=tr,
                 t0=t_done, t_admit=e.t_admit, sink=sink,
-                acache=acache, aslot=e.aslot)
+                acache=acache, aslot=e.aslot, spst=spst,
+                spec_row=e.spec)
         if self._g_lane_depth is not None:
             self._g_lane_depth.set(float(len(lane)))
         m.on_lane_depth(clock.now(), len(lane))
@@ -1786,7 +2137,46 @@ class ServingEngine:
             lambda a, d: a.at[:, :, idx].set(d), self._pools, data)
 
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
-                     outputs, tr=None, acache=None):
+                     outputs, tr=None, acache=None, spst=None):
+        """One decode turn. With a spec route (``spst``), the active
+        rows split into the PLAIN group (decode_n, exactly the legacy
+        turn) and the SPEC group (one batched draft/verify round) —
+        two fixed-shape programs, each compiled once, rows outside a
+        group riding along as length-0 page-0 slots. ``spst=None``
+        is the legacy turn bit-for-bit."""
+        rows = sorted(active.values(), key=lambda s: s.slot)
+        spec_rows: List[_PagedRow] = []
+        if spst is not None:
+            self._spec_gate(spst, clock, tr)
+            if spst.enabled:
+                spec_rows = [st for st in rows if st.spec]
+                if spec_rows:
+                    rows = [st for st in rows if not st.spec]
+            else:
+                # a spec row that decodes even ONE plain turn is
+                # DEMOTED for its remainder: plain turns advance the
+                # target pool but write no draft K/V and move the
+                # two-token feed's anchor, so re-entering the spec
+                # group later would condition the draft on a stale
+                # prev token and a holed cache — acceptance would
+                # collapse and latch the route plain for everyone.
+                # Re-enabling therefore applies to rows ADMITTED
+                # after the incident clears, whose draft state is
+                # contiguous by construction.
+                for st in rows:
+                    st.spec = False
+        if rows:
+            self._plain_decode_rows(rows, book, clock, m, active,
+                                    free_slots, slot_log, outputs,
+                                    tr=tr, acache=acache)
+        if spec_rows:
+            self._spec_decode_rows(spec_rows, book, clock, m, active,
+                                   free_slots, slot_log, outputs,
+                                   spst, tr=tr)
+
+    def _plain_decode_rows(self, rows, book, clock, m, active,
+                           free_slots, slot_log, outputs, tr=None,
+                           acache=None):
         n = self.decode_chunk
         toks = np.zeros((self.slots,), np.int32)
         pt = np.zeros((self.slots, self.W), np.int32)
@@ -1796,7 +2186,6 @@ class ServingEngine:
         # loop and single-model replays never read it
         aids = np.zeros((self.slots,), np.int32) \
             if acache is not None else None
-        rows = sorted(active.values(), key=lambda s: s.slot)
         for st in rows:
             table = book.tables[st.req.rid]
             pt[st.slot, :len(table)] = table
@@ -1838,6 +2227,84 @@ class ServingEngine:
                                    free_slots, slot_log, outputs,
                                    tr=tr, acache=acache)
 
+    def _spec_decode_rows(self, rows, book, clock, m, active,
+                          free_slots, slot_log, outputs,
+                          spst: _SpecState, tr=None):
+        """One speculative round for the spec group: the draft
+        proposes ``n_draft`` tokens per row (two-token feed + in-jit
+        walk), the target verifies them in ONE batched block, and
+        each row advances by its accepted prefix + the correction
+        token — 1..n_draft+1 tokens for one ``spec_decode`` clock
+        action, vs ``decode_chunk`` tokens per ``decode``. Greedy
+        acceptance keeps every token EXACTLY the target's greedy
+        token (speculation changes latency, never content); rejected
+        K/V — in both pools — sits beyond the advanced length and is
+        overwritten by later writes, the PR-1 rollback-free
+        invariant."""
+        k = spst.cfg.n_draft
+        prev = np.zeros((self.slots,), np.int32)
+        toks = np.zeros((self.slots,), np.int32)
+        pt = np.zeros((self.slots, self.W), np.int32)
+        lens = np.zeros((self.slots,), np.int32)
+        for st in rows:
+            table = book.tables[st.req.rid]
+            pt[st.slot, :len(table)] = table
+            lens[st.slot] = book.lengths[st.req.rid]
+            toks[st.slot] = st.tok
+            prev[st.slot] = st.prev
+        s_outer, s_layers = self._spec_parts[0], self._spec_parts[1]
+        s_step = self._spec_parts[4]
+
+        def _call():
+            arr = self._arr
+            return s_step(self._p_outer, self._p_layers, s_outer,
+                          s_layers, arr(prev), arr(toks), arr(pt),
+                          arr(lens), self._pools, self._spec_pools,
+                          k)
+        counts, cands, self._pools, self._spec_pools = self._timed(
+            tr, clock, "spec_decode", _call, jitfn=s_step, k=k,
+            rows=len(rows), **self._tp_attr)
+        counts = np.asarray(counts)
+        cands = np.asarray(cands)
+        t = clock.now()
+        turn_prop = turn_acc = 0
+        for st in rows:
+            sid = st.req.rid
+            n = int(counts[st.slot])
+            cand = cands[st.slot]
+            taken = 0
+            for i in range(n + 1):
+                if len(st.out) >= st.eff or st.done:
+                    break
+                tok = int(cand[i])
+                st.out.append(tok)
+                taken += 1
+                if tok == self.eos_token_id:
+                    st.done = True
+            # position bookkeeping: all n+1 verified positions hold
+            # real K/V (position L took st.tok, L+1+i took d_i for
+            # i < n); the new last token t_n sits at position L+n+1,
+            # not yet written — exactly decode_n's lengths discipline
+            st.prev = int(cand[n - 1]) if n >= 1 else st.tok
+            st.tok = int(cand[n])
+            book.lengths[sid] += n + 1
+            st.sprop += k
+            st.sacc += n
+            turn_prop += k
+            turn_acc += n
+            if taken:
+                m.on_tokens(sid, t, taken)
+                self._ctr_tokens.inc(taken)
+            if st.done or len(st.out) >= st.eff:
+                self._finish_paged(sid, book, clock, m, active,
+                                   free_slots, slot_log, outputs,
+                                   tr=tr)
+        spst.note(len(rows), turn_prop, turn_acc)
+        m.on_spec(len(rows), turn_prop, turn_acc)
+        self._ctr_spec_rounds.inc(len(rows))
+        self._ctr_draft_proposed.inc(turn_prop)
+        self._ctr_draft_accepted.inc(turn_acc)
+
     def _finish_paged(self, sid, book, clock, m, active, free_slots,
                       slot_log, outputs, timeout: bool = False,
                       tr=None, acache=None):
@@ -1870,6 +2337,14 @@ class ServingEngine:
         if tr is not None:
             tr.add_span(sid, st.t0, t_fin - st.t0,
                         track=f"slot/{st.slot}", backend="paged")
+            if st.sprop > 0:
+                # per-request spec evidence for trace_report's
+                # accept=a/p waterfall column — emitted ONLY when the
+                # row actually ran spec rounds, so plain traces keep
+                # their event set exactly
+                tr.instant("spec", t=t_fin,
+                           track=self._tenant_track(r), rid=sid,
+                           proposed=st.sprop, accepted=st.sacc)
         self._req_close(tr, r, t_fin, outcome, len(st.out))
 
     def session(self, *, tracer=None, replica: Optional[str] = None,
@@ -2084,8 +2559,12 @@ class EngineSession:
         # the engine is single-model): each replica owns its bank —
         # residency is the signal adapter-aware placement routes on
         self.acache = eng._make_adapter_cache()
+        # per-session spec-route state (multi-replica: each replica
+        # EWMAs its own acceptance and flips independently)
+        self.spst = eng._make_spec_state()
         self.pages_total = len(self.book._free)
         self.sched = eng.scheduler
+        eng._wire_spec_overload(slo, self.sched)
         self.est: Optional[ServiceEstimator] = None
         if self.sched is not None:
             self.sched.reset()
@@ -2607,7 +3086,7 @@ class EngineSession:
                 eng._paged_chunk(self.book, clock, m, self.active,
                                  self.free_slots, self.slot_log,
                                  self.outputs, tr=tr,
-                                 acache=self.acache)
+                                 acache=self.acache, spst=self.spst)
             except DecodeError as e:
                 # one slot's computation failed: tear down exactly
                 # that row (the decode turn is forfeit — survivors
@@ -2644,7 +3123,7 @@ class EngineSession:
                 self.lane, self.book, clock, m, self.active,
                 self.free_slots, self.slot_log, self.outputs,
                 self.prefix_cached, self.seen_groups, tr=tr,
-                sink=sink, acache=self.acache)
+                sink=sink, acache=self.acache, spst=self.spst)
             self.prefill_tokens += ptoks
             if self.est is not None:
                 eng._lane_timeouts(self.lane, self.book, clock, m,
@@ -2687,7 +3166,7 @@ class EngineSession:
             self.slot_log, self.prefix_cached, self.seen_groups,
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
-                  else None), acache=self.acache)
+                  else None), acache=self.acache, spst=self.spst)
         self.prefill_tokens += ptoks
         for r in wave[:n_adm]:
             self.waiting.remove(r)  # possibly reordered: by identity
@@ -2738,7 +3217,7 @@ class EngineSession:
             self.slot_log, self.prefix_cached, self.seen_groups,
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
-                  else None), acache=self.acache)
+                  else None), acache=self.acache, spst=self.spst)
         self.prefill_tokens += ptoks
         if n_adm:
             dt = clock.now() - t0
@@ -2839,5 +3318,7 @@ class EngineSession:
             adapter_stats=(
                 None if self.acache is None else
                 dict(self.acache.cache_stats(),
-                     invariant_ok=self.a_inv_ok)))
+                     invariant_ok=self.a_inv_ok)),
+            spec_stats=(None if self.spst is None
+                        else self.spst.stats()))
         return self._finished

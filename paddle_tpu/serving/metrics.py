@@ -116,6 +116,11 @@ class MetricsCollector:
         self._adapter = {"requests": 0, "hits": 0, "uploads": 0}
         self._adapter_names: set = set()
         self._adapter_resident: Optional[int] = None
+        # speculative-route totals (engine-fed per spec turn); the
+        # report grows its spec block ONLY when a spec round actually
+        # ran, so plain traces — and spec=None replays — keep their
+        # records byte-identical (the PR-5 presence convention)
+        self._spec = {"rounds": 0, "proposed": 0, "accepted": 0}
         # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
         # request's FINAL record at finish/shed plus queue/lane depth
         # samples — the one seam through which the streaming SLO layer
@@ -223,6 +228,16 @@ class MetricsCollector:
         self._adapter_resident = int(count)
         if self._mon is not None:
             self._mon.observe_value("adapter_resident", count, t)
+
+    def on_spec(self, rows: int, proposed: int, accepted: int):
+        """One speculative decode turn: ``rows`` rows each ran one
+        draft/verify round, ``proposed`` draft tokens went to target
+        verification, ``accepted`` survived it. Wasted draft compute
+        is the difference — the number the adaptive fallback exists
+        to bound."""
+        self._spec["rounds"] += rows
+        self._spec["proposed"] += proposed
+        self._spec["accepted"] += accepted
 
     def on_pool_bytes(self, t: float, per_device_bytes: int):
         """Per-device KV-pool residency sample (tensor-parallel
@@ -382,6 +397,17 @@ class MetricsCollector:
                 self._adapter["hits"] / self._adapter["requests"], 4)
             if self._adapter_resident is not None:
                 rec["adapters_resident_end"] = self._adapter_resident
+        if self._spec["rounds"] > 0:
+            # the spec block appears ONLY when a spec route actually
+            # ran (the same convention): plain records — and any
+            # spec=None replay — stay byte-identical to PR 12
+            rec["spec_rounds"] = self._spec["rounds"]
+            rec["spec_acceptance_rate"] = round(
+                self._spec["accepted"] / max(1, self._spec["proposed"]),
+                4)
+            rec["draft_tokens_proposed"] = self._spec["proposed"]
+            rec["draft_tokens_wasted"] = (self._spec["proposed"]
+                                          - self._spec["accepted"])
         if slo_ttft is not None and ttfts:
             rec["slo_ttft"] = slo_ttft
             rec["slo_ttft_attained"] = round(

@@ -200,14 +200,30 @@ class QoSScheduler:
         # not per-run queue state.
         self.incidents_seen: List = []
         self._page_open: List = []
+        # overload tracking for the SPECULATIVE route's fallback
+        # (``ServingEngine(spec=...)`` arms it; untracked otherwise —
+        # the PR-11 "tracked only when a consumer is armed"
+        # discipline): while any page-severity incident delivered
+        # through note_incident stays open, ``overload_active()``
+        # answers True and the engine decodes spec rows plain —
+        # draft compute is waste exactly when capacity is scarce.
+        self.track_overload = False
+        self._overload_open: List = []
         self.reset()
 
     # --- state ------------------------------------------------------------
     def reset(self):
         """Fresh run: empty queue, fair-queue tags back to zero (an
-        engine reuses one scheduler across ``run`` calls)."""
+        engine reuses one scheduler across ``run`` calls). The
+        overload-tracking list clears too: a run's per-run SLO
+        monitor is discarded at run end, so an incident still open
+        then would otherwise NEVER close and park the next run's
+        spec route forever (``incidents_seen``/the degrade clamp
+        keep their PR-11 survive-reset semantics — they are operator
+        state)."""
         self._q: Dict[str, _Entry] = {}
         self._tags: Dict[str, float] = {}
+        self._overload_open = []
 
     def note_incident(self, incident):
         """``obs.slo`` incident callback: record that an SLO incident
@@ -220,9 +236,23 @@ class QoSScheduler:
         is needed): the tier actuation the autoscaling control plane
         drives through this seam."""
         self.incidents_seen.append(incident)
-        if self.incident_degrade is not None \
-                and getattr(incident, "severity", None) == "page":
-            self._page_open.append(incident)
+        if getattr(incident, "severity", None) == "page":
+            if self.incident_degrade is not None:
+                self._page_open.append(incident)
+            if self.track_overload:
+                self._overload_open.append(incident)
+
+    def overload_active(self) -> bool:
+        """True while any page-severity incident delivered through
+        ``note_incident`` is still open (armed via
+        ``track_overload``; always False untracked). The speculative
+        route's fallback signal: incidents close in place, so closed
+        ones are pruned lazily and the route re-enables the moment
+        the last one resolves."""
+        if self._overload_open:
+            self._overload_open = [i for i in self._overload_open
+                                   if getattr(i, "open", False)]
+        return bool(self._overload_open)
 
     def _degrade_cap(self) -> Optional[float]:
         """The active incident-degradation budget fraction, or None.

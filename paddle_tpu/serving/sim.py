@@ -68,7 +68,8 @@ class SimServing:
                  n_pool_pages: int | None = None, slots: int = 8,
                  vocab: int = 509, salt: int = 0,
                  chunked_prefill: int | None = None, tp=None,
-                 lora_slots: int | None = None):
+                 lora_slots: int | None = None,
+                 spec_accept: float | None = None):
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {page_size}")
@@ -122,6 +123,31 @@ class SimServing:
         pools = np.zeros((n_pool_pages, page_size), np.int64)
         self.paged_parts = (None, None, pools, self._make_prefill(),
                             None, self._make_decode_n())
+        # ``spec_accept``: the sim's SPECULATIVE stand-in. The real
+        # spec factory's draft is a second model whose proposals the
+        # target verifies; the sim's draft proposes the TRUE next
+        # token with this probability (decided by a second
+        # deterministic hash of the same history, so acceptance
+        # replays bit-identically) and a guaranteed-different token
+        # otherwise. Verification is the real acceptance arithmetic —
+        # emitted tokens are always the true rule's, so greedy parity
+        # with plain decode is exact, and only TIMING (rounds per
+        # token) depends on the draft. The factory then advertises
+        # ``spec_parts`` shaped like the real one's; the draft "pool"
+        # is a zero-size array (the sim's truth pool is the token
+        # history itself, so the draft reads the same pool — the
+        # page-chain sharing the model-side claim is about).
+        self.spec_accept = None
+        self.spec_parts = None
+        if spec_accept is not None:
+            if not 0.0 <= float(spec_accept) <= 1.0:
+                raise ValueError("spec_accept is an acceptance "
+                                 "probability in [0, 1]")
+            self.spec_accept = float(spec_accept)
+            self.spec_parts = (None, None,
+                               np.zeros((0,), np.int64),
+                               self._make_spec_prefill(),
+                               self._make_spec_step())
 
     # --- the token rule ---------------------------------------------------
     def _token(self, seq, adapter_salt: int = 0) -> int:
@@ -139,6 +165,91 @@ class SimServing:
             h = (seq * self._pow[L - 1::-1]).sum()
         h = (int(h) + self.salt + int(adapter_salt)) & ((1 << 64) - 1)
         return 1 + h % (self.vocab - 1)
+
+    def _draft_token(self, seq) -> int:
+        """The sim DRAFT's proposal after history ``seq``: the true
+        next token with probability ``spec_accept`` (a second
+        deterministic hash of the same history decides, so two seeded
+        replays accept identically), otherwise a token guaranteed to
+        differ — which the verify arithmetic then rejects."""
+        t = self._token(seq)
+        seq_a = np.asarray(seq, np.uint64)
+        L = len(seq_a)
+        with np.errstate(over="ignore"):
+            h = (seq_a * self._pow[L - 1::-1]).sum()
+        h = (int(h) * 0x9E3779B97F4A7C15 + 0xD1B54A32D192ED03) \
+            & ((1 << 64) - 1)
+        u = (h >> 11) / float(1 << 53)
+        if u < self.spec_accept:
+            return t
+        return 1 + (t % (self.vocab - 1))  # != t for vocab >= 3
+
+    def _make_spec_prefill(self):
+        """The sim draft's prefill: a no-op returning the (empty)
+        draft pool — the sim's token rule derives every proposal from
+        the TRUE pool content, so there is nothing to warm (the real
+        factory's draft prefill writes draft K/V through the shared
+        page chain)."""
+        def spec_prefill(outer, layers, toks, pt, lens, pools,
+                         resume_from: int = 0, lora=None):
+            return np.zeros((1,), np.int64), pools
+
+        spec_prefill._cache_size = lambda: 0
+        return spec_prefill
+
+    def _make_spec_step(self):
+        ps = self.page_size_
+
+        def spec_step(outer_t, layers_t, outer_d, layers_d, prev,
+                      toks, pt, lens, pools, pools_d, k):
+            """One batched speculative round, the real acceptance
+            arithmetic at numpy speed: per active row, draft ``k``
+            proposals (each conditioned on the draft's OWN walk, like
+            the real draft cache), verify against the true rule,
+            advance by accepted prefix + correction. The accepted
+            true tokens land in the pool through the page table —
+            wrong tables/chains diverge streams exactly like plain
+            decode."""
+            toks = np.asarray(toks)
+            pt = np.asarray(pt)
+            lens = np.asarray(lens)
+            S = toks.shape[0]
+            counts = np.zeros((S,), np.int64)
+            cands = np.zeros((S, k + 1), np.int64)
+            for s in range(S):
+                L = int(lens[s])
+                if L <= 0:
+                    continue  # plain/empty slot rides along
+                # this round's input token lands at position L first
+                # (the verify block's write), then the history reads
+                # back THROUGH the table
+                pools[pt[s, L // ps], L % ps] = int(toks[s])
+                npages = -(-(L + 1) // ps)
+                hist = [int(x) for x in
+                        pools[pt[s, :npages]].reshape(-1)[:L + 1]]
+                drafts, truths = [], []
+                h = list(hist)
+                for i in range(k):
+                    truths.append(self._token(h))
+                    drafts.append(self._draft_token(h))
+                    h.append(drafts[-1])
+                truths.append(self._token(h))  # the bonus token
+                n = 0
+                while n < k and drafts[n] == truths[n]:
+                    n += 1
+                emitted = drafts[:n] + [truths[n]]
+                counts[s] = n
+                cands[s, :n + 1] = emitted
+                # accepted TRUE tokens persist at L+1..L+n; the
+                # correction token is the row's next input, written
+                # by the NEXT round/turn — the decode_n discipline
+                for j in range(n):
+                    p = L + 1 + j
+                    pools[pt[s, p // ps], p % ps] = emitted[j]
+            return counts, cands, pools, pools_d
+
+        spec_step._cache_size = lambda: 0
+        return spec_step
 
     # --- adapter-bank hooks (AdapterCache's device seam) ------------------
     def init_adapter_bank(self):

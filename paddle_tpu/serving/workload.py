@@ -825,6 +825,86 @@ def synthesize_flash_crowd_trace(seed: int = 0,
         rid_prefix=rid_prefix, start=start)
 
 
+def synthesize_deadline_mix_trace(seed: int = 0,
+                                  n_requests: int = 160, *,
+                                  service_tokens_per_unit: float = 8.0,
+                                  base_load: float = 0.6,
+                                  surge: Tuple[float, float, float]
+                                  = (0.5, 0.18, 4.0),
+                                  loose_frac: float = 0.75,
+                                  prompt_len: Tuple[int, int] = (4, 12),
+                                  output_len: Tuple[int, int] = (4, 12),
+                                  vocab_size: int = 509,
+                                  unit_ms: float = 1000.0,
+                                  chunk_tokens: int = 8,
+                                  loose_slack: float = 6.0,
+                                  tight_slack: float = 2.0,
+                                  rid_prefix: str = "sx",
+                                  start: float = 0.0,
+                                  grid: int = 1024) -> List[Request]:
+    """The SPECULATIVE-serving workload: a deadline/priority COHORT
+    mix on a calm-then-surge arrival profile, sized so the adaptive
+    spec rule exercises BOTH of its paths.
+
+    - ``loose_frac`` of requests form the **loose** cohort (priority
+      0, ``deadline_ms = (chunks + budget + 1) * unit_ms *
+      loose_slack`` — comfortably above the default
+      ``SpecConfig.loose_deadline_ms``): the traffic the per-request
+      rule routes SPECULATIVE. The rest form the **tight** cohort
+      (priority 1, ``tight_slack``): latency-critical rows the rule
+      keeps on plain decode. The cohort is baked into the rid
+      (``{rid_prefix}-0042.loose`` / ``.tight``) so benches and gates
+      split them without a side channel.
+    - The base arrival rate is sized to ``base_load`` x
+      ``service_tokens_per_unit`` (comfortably under capacity — spec
+      pays off and nothing burns); ``surge = (t0_frac, dur_frac,
+      magnitude)`` multiplies the rate over that window of the span,
+      pushing demand past capacity so deadlines miss, a
+      ``BurnRateRule`` fires, and the overload fallback delivered
+      through ``QoSScheduler.note_incident`` parks the spec route
+      until the burn recovers.
+
+    Deterministic in every field; JSONL round-trips via
+    ``save_trace``/``load_trace`` like every other synthesizer."""
+    if not 0.0 < base_load:
+        raise ValueError("base_load must be > 0")
+    if not 0.0 <= loose_frac <= 1.0:
+        raise ValueError("loose_frac must be in [0, 1]")
+    t0f, durf, mag = surge
+    if not (0.0 <= t0f < 1.0 and 0.0 < durf <= 1.0 and mag >= 1.0):
+        raise ValueError("surge is (t0_frac in [0,1), dur_frac in "
+                         "(0,1], magnitude >= 1)")
+    rng = np.random.default_rng(seed)
+    budgets = [int(rng.integers(output_len[0], output_len[1] + 1))
+               for _ in range(n_requests)]
+    xs = np.linspace(0.0, 1.0, grid)
+    shape = np.where((xs >= t0f) & (xs < t0f + durf),
+                     float(mag), 1.0)
+    mean_f = float(shape.mean())
+    # BASE token rate (relative rate 1.0) == base_load * capacity
+    span = sum(budgets) \
+        / (mean_f * base_load * service_tokens_per_unit)
+    times = _profile_times(rng, n_requests, span, shape)
+    reqs: List[Request] = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        prompt = tuple(int(t) for t in rng.integers(1, vocab_size,
+                                                    plen))
+        budget = budgets[i]
+        loose = bool(rng.random() < loose_frac)
+        cohort = "loose" if loose else "tight"
+        slack = loose_slack if loose else tight_slack
+        chunks = -(-plen // chunk_tokens)
+        reqs.append(Request(
+            rid=f"{rid_prefix}-{i:04d}.{cohort}",
+            arrival=start + float(times[i]), prompt=prompt,
+            max_new_tokens=budget,
+            priority=0 if loose else 1,
+            deadline_ms=round((chunks + budget + 1) * unit_ms
+                              * slack, 3)))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def merge_traces(*traces: Sequence[Request]) -> List[Request]:
     """Interleave traces by arrival time (rids must already be unique —
     give each source a distinct ``rid_prefix``)."""

@@ -1,0 +1,736 @@
+"""Speculative decoding as an adaptive serving backend.
+
+The claims: eligible rows decode through ONE batched draft/verify
+round per turn (draft proposes ``n_draft`` tokens, the target
+verifies them in one (k+1)-position block through the paged pool)
+with greedy acceptance keeping every emitted token EXACTLY the
+target's greedy token — speculation changes latency, never content
+(sim AND real tiny-llama factory, TP composed); draft and target
+share ONE PagedKVCache page-id space so prefix caching and eviction
+recycle both pools in lockstep; the per-request adaptive rule routes
+loose-deadline/low-priority traffic speculative and keeps tight
+traffic plain; the route falls back deterministically when the
+acceptance EWMA sinks below its floor (latched) or while a
+page-severity incident delivered through
+``QoSScheduler.note_incident`` stays open (released at close), every
+flip logged on the virtual clock with its explain rule; ``spec=None``
+is byte-identical to the plain engine (outputs, slot logs,
+decisions, records, report keys, registry contents); the
+metrics/trace spec blocks appear ONLY for spec traffic; and the
+``serving_spec`` bench-gate family passes its pass rows and fails
+its FAIL rows.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.nlp.llama_decode import (
+    SpecConfig, as_spec_config, llama_serving_decode_factory)
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs.slo import BurnRateRule
+from paddle_tpu.serving import (Policy, QoSScheduler, Request,
+                                ServingEngine, load_trace,
+                                make_sim_serving, save_trace,
+                                synthesize_deadline_mix_trace,
+                                synthesize_recurring_prefix_trace,
+                                synthesize_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill_unit": 1.0, "decode": 1.0,
+         "spec_decode": 1.25, "spec_prefill": 0.25}
+
+
+def _sim_engine(spec_accept=None, spec=None, slots=8, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", dict(COSTS))
+    kw.setdefault("decode_chunk", 1)
+    kw.setdefault("expect_churn", True)
+    return ServingEngine(
+        serving=make_sim_serving(max_len=64, page_size=8, slots=slots,
+                                 vocab=509,
+                                 n_pool_pages=slots * 8 + 1 + 16,
+                                 spec_accept=spec_accept),
+        slots=slots, policy="paged", spec=spec, **kw)
+
+
+def _churn_trace(seed=0, n=60):
+    return synthesize_trace(
+        seed=seed, n_requests=n, arrival="poisson",
+        mean_interarrival=0.5, prompt_len=(4, 16), output_len=(8, 24),
+        vocab_size=509, shared_prefix_frac=0.3, prefix_len=8,
+        churn_frac=0.2, rid_prefix="m")
+
+
+# --- config + eligibility rule ------------------------------------------
+
+
+def test_spec_config_validation():
+    assert as_spec_config(None) is None
+    assert as_spec_config(3) == SpecConfig(n_draft=3)
+    # bool is checked BEFORE int: spec=True is the stock config, not
+    # a degenerate one-token draft window
+    assert as_spec_config(True) == SpecConfig()
+    assert as_spec_config(False) is None
+    cfg = SpecConfig(n_draft=2, accept_floor=0.5)
+    assert as_spec_config(cfg) is cfg
+    with pytest.raises(ValueError, match="n_draft"):
+        SpecConfig(n_draft=0)
+    with pytest.raises(ValueError, match="accept_floor"):
+        SpecConfig(accept_floor=1.5)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        SpecConfig(ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="min_rounds"):
+        SpecConfig(min_rounds=0)
+    with pytest.raises(ValueError, match="loose_deadline_ms"):
+        SpecConfig(loose_deadline_ms=-1.0)
+    with pytest.raises(ValueError, match="spec"):
+        as_spec_config("fast")
+
+
+def test_spec_route_rule():
+    """The per-request adaptive rule: low priority + loose/absent
+    deadline -> spec; tight deadline or high priority -> plain, with
+    the clause named (the explain discipline)."""
+    cfg = SpecConfig()
+    pol = Policy()
+
+    def req(priority=0, deadline_ms=None):
+        return Request(rid="r", arrival=0.0, prompt=(1, 2, 3),
+                       max_new_tokens=4, priority=priority,
+                       deadline_ms=deadline_ms)
+
+    ok, rule = pol.spec_route(req(), cfg)
+    assert ok and "spec-eligible" in rule
+    ok, rule = pol.spec_route(req(deadline_ms=60_000.0), cfg)
+    assert ok
+    ok, rule = pol.spec_route(req(priority=1), cfg)
+    assert not ok and "priority" in rule
+    ok, rule = pol.spec_route(req(deadline_ms=2_000.0), cfg)
+    assert not ok and "deadline" in rule
+
+
+# --- sim parity / throughput / determinism ------------------------------
+
+
+def test_sim_spec_parity_and_speedup():
+    """The tentpole claim at sim scale: token-for-token parity with
+    plain decode on the mixed churn trace (cancels and prefix hits
+    included), spec stats banked, and — at honest fixed pricing —
+    MORE tokens per clock unit."""
+    trace = _churn_trace()
+    plain = _sim_engine().run(trace)
+    spec = _sim_engine(spec_accept=0.85,
+                       spec=SpecConfig(n_draft=4)).run(trace)
+    assert spec.outputs == plain.outputs
+    st = spec.spec_stats
+    assert st is not None and st["rounds"] > 0
+    assert 0.0 < st["acceptance_rate"] <= 1.0
+    assert st["draft_tokens_proposed"] > st["draft_tokens_accepted"]
+    rp, rs = plain.report(), spec.report()
+    assert rs["tokens_per_sec"] > rp["tokens_per_sec"]
+    # prefix caching still serves spec admissions
+    assert rs.get("prefix_cache_hit_tokens", 0) > 0
+    assert spec.cache_stats["invariant_ok"]
+
+
+def test_sim_spec_deterministic_replay():
+    trace = _churn_trace(seed=5, n=40)
+
+    def run():
+        return _sim_engine(spec_accept=0.7,
+                           spec=SpecConfig(n_draft=4)).run(trace)
+    a, b = run(), run()
+    assert a.outputs == b.outputs
+    assert a.spec_stats == b.spec_stats
+    assert a.slot_log == b.slot_log
+
+
+def test_spec_none_byte_identity():
+    """The identity clause: spec=None on a spec-CAPABLE factory is
+    byte-identical to the plain factory's engine — outputs, slot
+    logs, decisions, records, report keys — and creates none of the
+    spec registry metrics."""
+    trace = _churn_trace(seed=2, n=24)
+    obs_metrics.REGISTRY.reset()
+    plain = _sim_engine().run(trace)
+    capable = _sim_engine(spec_accept=0.9, spec=None).run(trace)
+    assert capable.outputs == plain.outputs
+    assert capable.slot_log == plain.slot_log
+    assert capable.decisions == plain.decisions
+    assert capable.metrics.request_rows() == plain.metrics.request_rows()
+    assert capable.spec_stats is None
+    rep = capable.report()
+    assert json.dumps(rep, sort_keys=True) \
+        == json.dumps(plain.report(), sort_keys=True)
+    for k in ("spec_rounds", "spec_acceptance_rate",
+              "draft_tokens_proposed", "draft_tokens_wasted"):
+        assert k not in rep
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert not any(n.startswith(("serving_spec", "serving_draft"))
+                   for n in names)
+
+
+def test_spec_metrics_block_and_gauges():
+    trace = _churn_trace(seed=3, n=24)
+    res = _sim_engine(spec_accept=0.8,
+                      spec=SpecConfig(n_draft=4)).run(trace)
+    rep = res.report()
+    assert rep["spec_rounds"] == res.spec_stats["rounds"]
+    assert rep["draft_tokens_proposed"] \
+        == res.spec_stats["draft_tokens_proposed"]
+    assert rep["draft_tokens_wasted"] == (
+        res.spec_stats["draft_tokens_proposed"]
+        - res.spec_stats["draft_tokens_accepted"])
+    assert rep["spec_acceptance_rate"] \
+        == res.spec_stats["acceptance_rate"]
+    # publish() lands the block as gauges (scalar fields)
+    rec = res.metrics.publish()
+    g = obs_metrics.REGISTRY.gauge("serving_run_spec_acceptance_rate")
+    assert g.value == rec["spec_acceptance_rate"]
+
+
+# --- the adaptive fallbacks ---------------------------------------------
+
+
+def test_acceptance_floor_latches_plain():
+    """A draft that almost never matches: the EWMA sinks below the
+    floor after min_rounds and the route LATCHES plain — flip logged
+    with the acceptance rule, no spec rounds after, outputs still
+    bit-equal to plain decode."""
+    trace = _churn_trace(seed=7, n=40)
+    spec = _sim_engine(
+        spec_accept=0.0,
+        spec=SpecConfig(n_draft=4, accept_floor=0.3, min_rounds=6,
+                        ewma_alpha=0.5)).run(trace)
+    plain = _sim_engine().run(trace)
+    assert spec.outputs == plain.outputs
+    st = spec.spec_stats
+    assert st["latched"] and not st["enabled_end"]
+    assert len(st["flips"]) == 1
+    assert "acceptance ewma" in st["flips"][0]["rule"]
+    assert st["flips"][0]["enabled"] is False
+    # acceptance evidence stops accumulating once latched
+    assert st["acceptance_rate"] < 0.3
+
+
+class _FakeIncident:
+    severity = "page"
+
+    def __init__(self):
+        self.open = True
+
+
+def test_scheduler_overload_seam_unit():
+    s = QoSScheduler()
+    inc = _FakeIncident()
+    s.note_incident(inc)           # untracked: not armed
+    assert not s.overload_active()
+    s.track_overload = True
+    inc2 = _FakeIncident()
+    s.note_incident(inc2)
+    assert s.overload_active()
+    inc2.open = False
+    assert not s.overload_active()  # closed incidents prune lazily
+    # an incident still OPEN at run end must not park the NEXT run:
+    # its per-run monitor is gone, so nothing would ever close it —
+    # reset() clears the tracking list (the degrade clamp keeps its
+    # PR-11 survive-reset semantics)
+    s.note_incident(_FakeIncident())
+    assert s.overload_active()
+    s.reset()
+    assert not s.overload_active()
+
+
+def test_overload_fallback_and_reenable():
+    """The declared seam end to end: the deadline-mix surge burns, a
+    page-severity BurnRateRule incident lands through
+    QoSScheduler.note_incident, the route flips plain; the burn
+    recovers, the incident closes, the route re-enables — and spec
+    rounds actually RESUME for rows admitted after the clear, while
+    no spec round runs inside the parked window (rows caught by the
+    flip are demoted — their draft cache went stale on the plain
+    turns). Flip timeline deterministic across two seeded replays."""
+    from paddle_tpu import obs
+    trace = synthesize_deadline_mix_trace(
+        seed=0, n_requests=220, service_tokens_per_unit=8.0,
+        base_load=0.55, surge=(0.45, 0.2, 5.0), output_len=(6, 16))
+
+    def run(tr=None):
+        rule = BurnRateRule(
+            name="deadline_burn", objective=0.6,
+            windows=((60.0, 1.5), (15.0, 1.5)),
+            bad="deadline_missed", min_events=10, severity="page")
+        return _sim_engine(
+            spec_accept=0.85, spec=SpecConfig(n_draft=4),
+            scheduler=QoSScheduler(max_queue=64), slo=[rule],
+            trace=tr
+        ).run(trace)
+
+    tracer = obs.Tracer()
+    res = run(tracer)
+    st = res.spec_stats
+    downs = [f for f in st["flips"] if not f["enabled"]]
+    ups = [f for f in st["flips"] if f["enabled"]]
+    assert downs and ups
+    assert all("overload" in f["rule"] for f in downs)
+    assert all("cleared" in f["rule"] for f in ups)
+    assert not st["latched"]
+    assert any(i.rule == "deadline_burn" and i.resolution
+               == "burn_recovered" for i in res.incidents)
+    # spec_decode spans (in-memory tracer ts = virtual clock units):
+    # none inside any parked window, some after the final re-enable
+    # — rows admitted post-clear genuinely resume the spec route
+    spans = sorted(e["ts"] for e in tracer.events
+                   if e.get("ph") == "X"
+                   and e.get("name") == "spec_decode")
+    windows = []
+    for d in downs:
+        up_after = [u["t"] for u in ups if u["t"] > d["t"]]
+        windows.append((d["t"], min(up_after) if up_after
+                        else float("inf")))
+    assert spans
+    for t in spans:
+        assert not any(lo < t < hi for lo, hi in windows)
+    assert any(t > ups[-1]["t"] for t in spans)
+    assert run().spec_stats["flips"] == st["flips"]
+
+
+def test_mixed_spec_and_plain_rows():
+    """Tight/high-priority rows ride the PLAIN group of the same
+    engine while loose rows spec — admit instants carry the verdict,
+    outputs match a fully plain engine."""
+    from paddle_tpu import obs
+    base = _churn_trace(seed=9, n=20)
+    import dataclasses as dc
+    trace = [dc.replace(r, priority=1 if i % 3 == 0 else 0)
+             for i, r in enumerate(base)]
+    tr = obs.Tracer()
+    spec = _sim_engine(spec_accept=0.85, spec=SpecConfig(n_draft=4),
+                       trace=tr).run(trace)
+    plain = _sim_engine().run(trace)
+    assert spec.outputs == plain.outputs
+    admits = {e["args"]["rid"]: e["args"]
+              for e in tr.events if e.get("ph") == "i"
+              and e.get("name") == "admit"}
+    for r in trace:
+        assert admits[r.rid]["spec"] == (r.priority == 0)
+    # plain rows never bank draft evidence
+    specs = {e["args"]["rid"] for e in tr.events
+             if e.get("ph") == "i" and e.get("name") == "spec"}
+    assert all(r.priority == 0 for r in trace if r.rid in specs)
+    assert specs  # the loose cohort actually ran spec rounds
+
+
+def test_spec_trace_instants_absent_on_plain():
+    from paddle_tpu import obs
+    trace = _churn_trace(seed=4, n=12)
+    tr = obs.Tracer()
+    _sim_engine(trace=tr).run(trace)
+    names = {e.get("name") for e in tr.events}
+    assert "spec" not in names and "spec_flip" not in names
+
+
+# --- sim spec step unit -------------------------------------------------
+
+
+def test_sim_spec_step_oracle():
+    """The sim spec step's emitted tokens ARE the true rule's
+    (verified against expected_stream), acceptance counts come from
+    real draft-vs-truth comparison, and the pool ends holding the
+    true history."""
+    sim = make_sim_serving(max_len=64, page_size=8, slots=2,
+                           vocab=509, spec_accept=1.0)
+    pools = sim.paged_parts[2]
+    prefill = sim.paged_parts[3]
+    spec_step = sim.spec_parts[4]
+    prompt = [5, 9, 13, 17, 21, 25, 29, 33]
+    toks = np.asarray([prompt], np.int64)
+    pt = np.zeros((1, 8), np.int64)
+    pt[0, :2] = [1, 2]
+    first, pools = prefill(None, None, toks, pt,
+                           np.asarray([8]), pools)
+    exp = sim.expected_stream(prompt, 6)
+    assert int(first[0]) == exp[0]
+    prev = np.asarray([prompt[-1], 0], np.int64)
+    tok = np.asarray([exp[0], 0], np.int64)
+    bpt = np.zeros((2, 8), np.int64)
+    bpt[0] = pt[0]
+    lens = np.asarray([8, 0], np.int64)
+    counts, cands, pools, _ = spec_step(
+        None, None, None, None, prev, tok, bpt, lens, pools,
+        None, 4)
+    n = int(counts[0])
+    assert n == 4  # spec_accept=1.0: every draft matches
+    assert [int(x) for x in cands[0][:n + 1]] == exp[1:n + 2]
+    # inactive row untouched
+    assert int(counts[1]) == 0 and not cands[1].any()
+
+
+def test_deadline_mix_trace_shape(tmp_path):
+    a = synthesize_deadline_mix_trace(seed=11, n_requests=50)
+    b = synthesize_deadline_mix_trace(seed=11, n_requests=50)
+    assert a == b  # deterministic in every field
+    cohorts = {r.rid.rsplit(".", 1)[1] for r in a}
+    assert cohorts == {"loose", "tight"}
+    for r in a:
+        loose = r.rid.endswith(".loose")
+        assert r.priority == (0 if loose else 1)
+        assert r.deadline_ms is not None
+        if loose:
+            # loose deadlines clear the default eligibility floor
+            assert r.deadline_ms >= SpecConfig().loose_deadline_ms
+    p = tmp_path / "mix.jsonl"
+    save_trace(str(p), a)
+    assert load_trace(str(p)) == a
+    with pytest.raises(ValueError, match="surge"):
+        synthesize_deadline_mix_trace(surge=(1.5, 0.1, 2.0))
+    with pytest.raises(ValueError, match="loose_frac"):
+        synthesize_deadline_mix_trace(loose_frac=1.5)
+
+
+# --- engine construction errors / validation ----------------------------
+
+
+def test_spec_engine_construction_errors():
+    with pytest.raises(ValueError, match="spec-capable"):
+        _sim_engine(spec_accept=None, spec=SpecConfig())
+    with pytest.raises(ValueError, match="dense"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4, spec_accept=0.5),
+            slots=4, policy="dense", spec=SpecConfig())
+    with pytest.raises(ValueError, match="spec_accept"):
+        make_sim_serving(max_len=64, page_size=8, spec_accept=1.5)
+    # spec_draft without spec would build a draft stack nothing uses
+    with pytest.raises(ValueError, match="spec_draft"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4),
+            slots=4, policy="paged", spec_draft=object())
+
+
+def test_prefill_role_session_skips_draft_walk():
+    """A prefill-role session's rows hand off and decode PLAIN on
+    the importer — no draft prefill is paid for them (compute the
+    fleet could never cash)."""
+    from paddle_tpu import obs
+    eng = _sim_engine(spec_accept=0.85, spec=SpecConfig(n_draft=4))
+    tr = obs.Tracer()
+    sess = eng.session(tracer=tr, role="prefill")
+    for r in _churn_trace(seed=12, n=4)[:4]:
+        sess.advance_until(r.arrival)
+        sess.submit(r)
+    sess.advance_until(1e6)
+    assert sess.handoff_ready  # prefills exported as handoffs
+    assert not any(e.get("name") == "spec_prefill"
+                   for e in tr.events if e.get("ph") == "X")
+
+
+def test_spec_footprint_validation():
+    """The verify window deepens the page footprint: a request that
+    fits plain decode exactly refuses under a wide draft window."""
+    eng = _sim_engine(spec_accept=0.5, spec=SpecConfig(n_draft=8),
+                      slots=2)
+    r = Request(rid="big", arrival=0.0,
+                prompt=tuple(range(1, 41)), max_new_tokens=16)
+    assert _sim_engine(slots=2)._footprint(r) <= 64  # plain fits
+    with pytest.raises(ValueError, match="write slack"):
+        eng.run([r])
+
+
+def test_spec_session_matches_run():
+    """EngineSession's incremental drive produces the same streams
+    and spec evidence as run() on a spec engine."""
+    trace = _churn_trace(seed=6, n=24)
+    run_res = _sim_engine(spec_accept=0.8,
+                          spec=SpecConfig(n_draft=4)).run(trace)
+    eng = _sim_engine(spec_accept=0.8, spec=SpecConfig(n_draft=4))
+    sess = eng.session()
+    for r in sorted(trace, key=lambda r: (r.arrival, r.rid)):
+        sess.advance_until(r.arrival)
+        sess.submit(r)
+    res = sess.finish()
+    assert res.outputs == run_res.outputs
+    assert res.spec_stats["draft_tokens_accepted"] \
+        == run_res.spec_stats["draft_tokens_accepted"]
+
+
+# --- real tiny-llama factory --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def real_env():
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    cfg_d = LlamaConfig.tiny(vocab=97, hidden=16, layers=1, heads=2,
+                             kv_heads=1)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    paddle.seed(0)
+    twin = LlamaForCausalLM(cfg)   # same seed: a perfect draft
+    twin.eval()
+    paddle.seed(1)
+    draft = LlamaForCausalLM(cfg_d)
+    draft.eval()
+    return {"cfg": cfg, "model": model, "twin": twin, "draft": draft}
+
+
+def _real_factory(model, draft=None, tp=None):
+    return llama_serving_decode_factory(
+        model, max_len=64, page_size=8,
+        n_pool_pages=4 * 8 + 1 + 8, batch_capacity=4,
+        chunked_prefill=8, draft=draft, tp=tp)
+
+
+def _real_trace(seed=0, n=8):
+    return synthesize_trace(seed=seed, n_requests=n,
+                            arrival="poisson", mean_interarrival=0.5,
+                            prompt_len=(4, 12), output_len=(4, 10),
+                            vocab_size=97, churn_frac=0.2,
+                            rid_prefix="q")
+
+
+def test_real_spec_parity(real_env):
+    """The correctness tentpole on the REAL factory: a small
+    independent draft proposes mostly-wrong tokens, verification
+    rejects them, and every stream is bit-equal to plain decode."""
+    trace = _real_trace()
+    plain = ServingEngine(serving=_real_factory(real_env["model"]),
+                          slots=4, policy="paged",
+                          clock="fixed").run(trace)
+    spec = ServingEngine(
+        serving=_real_factory(real_env["model"],
+                              draft=real_env["draft"]),
+        slots=4, policy="paged", clock="fixed",
+        spec=SpecConfig(n_draft=3, accept_floor=0.0)).run(trace)
+    assert spec.outputs == plain.outputs
+    assert spec.spec_stats["rounds"] > 0
+
+
+def test_real_spec_perfect_draft_accepts(real_env):
+    """A draft identical to the target must accept every proposal —
+    the acceptance arithmetic's positive control."""
+    trace = _real_trace(seed=2, n=4)
+    spec = ServingEngine(
+        serving=_real_factory(real_env["model"],
+                              draft=real_env["twin"]),
+        slots=4, policy="paged", clock="fixed",
+        spec=SpecConfig(n_draft=3)).run(trace)
+    assert spec.spec_stats["acceptance_rate"] >= 0.99
+    plain = ServingEngine(serving=_real_factory(real_env["model"]),
+                          slots=4, policy="paged",
+                          clock="fixed").run(trace)
+    assert spec.outputs == plain.outputs
+
+
+def test_real_spec_prefix_cache_shares_chain(real_env):
+    """Draft K/V rides the target's page chains: a recurring prefix
+    hits for spec rows (round-2 cached tokens > 0 — the TARGET
+    prefill skips its cached chunks; the draft re-walks the shared
+    chain so its pool is warm no matter who published) and the
+    streams stay bit-equal to plain decode."""
+    trace = synthesize_recurring_prefix_trace(
+        seed=0, n_cohorts=1, cohort_size=3, rounds=2,
+        prefix_len=24, tail_len=(2, 6), output_len=(3, 5),
+        vocab_size=97, round_gap=80.0)
+    spec = ServingEngine(
+        serving=_real_factory(real_env["model"],
+                              draft=real_env["draft"]),
+        slots=4, policy="paged", clock="fixed",
+        fixed_costs={"prefill_unit": 1.0, "decode": 1.0},
+        spec=SpecConfig(n_draft=3)).run(trace)
+    plain = ServingEngine(
+        serving=_real_factory(real_env["model"]), slots=4,
+        policy="paged", clock="fixed",
+        fixed_costs={"prefill_unit": 1.0, "decode": 1.0}).run(trace)
+    assert spec.outputs == plain.outputs
+    r2 = [rid for rid in spec.prefix_cached if "-r2" in rid]
+    assert r2 and any(spec.prefix_cached[rid] > 0 for rid in r2)
+    assert spec.cache_stats["invariant_ok"]
+
+
+def test_real_spec_tp_composition(real_env):
+    """TP composes: target sharded on the 2-device mesh, draft
+    replicated — streams bit-equal to the unsharded spec engine and
+    to plain decode."""
+    if len(__import__("jax").devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    trace = _real_trace(seed=3, n=6)
+    plain = ServingEngine(serving=_real_factory(real_env["model"]),
+                          slots=4, policy="paged",
+                          clock="fixed").run(trace)
+    tp_spec = ServingEngine(
+        serving=_real_factory(real_env["model"],
+                              draft=real_env["draft"], tp=2),
+        slots=4, policy="paged", clock="fixed",
+        spec=SpecConfig(n_draft=3)).run(trace)
+    assert tp_spec.outputs == plain.outputs
+    assert tp_spec.spec_stats["rounds"] > 0
+
+
+def test_spec_factory_surface(real_env):
+    """Factory surface: spec_parts present with the draft pool on
+    the SAME page-id space; the spec step shim advertises its jitted
+    program via _jit_inner (the PR-4 compile-observability
+    convention), as does the PR-1 compiled spec generate."""
+    srv = _real_factory(real_env["model"], draft=real_env["draft"])
+    assert srv.spec_parts is not None
+    d_pools = srv.spec_parts[2]
+    import jax
+    leaves = jax.tree_util.tree_leaves(d_pools)
+    assert all(a.shape[2] == srv.n_pool_pages_ for a in leaves)
+    spec_step = srv.spec_parts[4]
+    assert getattr(spec_step, "_jit_inner", None)
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_speculative_decode_factory)
+    gen = llama_speculative_decode_factory(
+        real_env["model"], real_env["twin"], max_len=64, n_draft=2)
+    assert getattr(gen.compiled, "_jit_inner", None)
+    # vocab mismatch refuses
+    cfg_v = LlamaConfig.tiny(vocab=53, hidden=16, layers=1, heads=2,
+                             kv_heads=1)
+    paddle.seed(2)
+    other = LlamaForCausalLM(cfg_v)
+    with pytest.raises(ValueError, match="vocabulary"):
+        _real_factory(real_env["model"], draft=other)
+
+
+def test_spec_compile_instants(real_env):
+    """The engine's recompile detector sees spec compiles through
+    the _jit_inner seam: a cold spec run records jit.compile
+    instants at the spec_decode and spec_prefill sites."""
+    from paddle_tpu import obs
+    tr = obs.Tracer()
+    eng = ServingEngine(
+        serving=_real_factory(real_env["model"],
+                              draft=real_env["draft"]),
+        slots=4, policy="paged", clock="fixed", trace=tr,
+        spec=SpecConfig(n_draft=3))
+    eng.run(_real_trace(seed=5, n=4))
+    sites = {e["args"]["site"] for e in tr.events
+             if e.get("name") == "jit.compile"}
+    assert "spec_decode" in sites
+    assert "spec_prefill" in sites
+
+
+# --- trace_report + gate ------------------------------------------------
+
+
+def test_trace_report_spec_rows():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_report import report, spec_accepts, spec_summary
+
+    from paddle_tpu import obs
+    trace = _churn_trace(seed=8, n=16)
+    tr = obs.Tracer()
+    _sim_engine(spec_accept=0.8, spec=SpecConfig(n_draft=4),
+                trace=tr).run(trace)
+    evts = tr.events + [
+        {"ph": "M", "name": "thread_name", "tid": t,
+         "args": {"name": n}}
+        for t, n in getattr(tr, "_tracks", {}).items()]
+    # export round-trip is the honest event surface
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.json")
+        tr.export(p)
+        with open(p) as f:
+            evts = json.load(f)["traceEvents"]
+    acc = spec_accepts(evts)
+    assert acc and all(v["proposed"] >= v["accepted"] >= 0
+                       for v in acc.values())
+    row = spec_summary(evts)
+    assert row["bench"] == "trace_report_spec"
+    assert row["spec_requests"] == len(acc)
+    txt = report(evts)
+    assert "speculative route" in txt and "accept=" in txt
+
+    # pre-spec trace: no column, no section, no row
+    tr2 = obs.Tracer()
+    _sim_engine(trace=tr2).run(trace)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.json")
+        tr2.export(p)
+        with open(p) as f:
+            evts2 = json.load(f)["traceEvents"]
+    assert spec_summary(evts2) is None
+    txt2 = report(evts2)
+    assert "speculative route" not in txt2 and "accept=" not in txt2
+
+
+def _gate_rows(ratio=1.3, parity=True, compared=360, census=True,
+               fallback=1, reenable=1, deterministic=True,
+               drop_arm=None):
+    rows = [
+        {"bench": "serving_spec", "arm": "plain", "device": "sim",
+         "tokens_per_sec": 4.6, "census_ok": census},
+        {"bench": "serving_spec", "arm": "adaptive_spec",
+         "device": "sim", "tokens_per_sec": 4.6 * ratio,
+         "census_ok": census},
+        {"bench": "serving_spec_overload", "device": "sim",
+         "census_ok": census, "fallback_flips": fallback,
+         "reenable_flips": reenable,
+         "flips_deterministic": deterministic},
+        {"bench": "serving_spec_summary", "device": "sim",
+         "requests": compared, "n_draft": 4,
+         "outputs_match": parity, "parity_compared": compared,
+         "spec_vs_plain_tokens_per_sec": ratio,
+         "acceptance_rate": 0.66, "fallback_flips": fallback,
+         "reenable_flips": reenable,
+         "flips_deterministic": deterministic}]
+    if drop_arm:
+        rows = [r for r in rows if r.get("arm") != drop_arm]
+    return rows
+
+
+def test_gate_serving_spec_pass_and_fails(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from bench_gate import check_serving_spec
+
+    assert check_serving_spec(_gate_rows()) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["gate"] == "pass"
+    assert out["spec_vs_plain_tokens_per_sec"] == 1.3
+
+    for rows, frag in (
+            (_gate_rows(ratio=0.9), "floor"),
+            (_gate_rows(parity=False), "DIVERGED"),
+            (_gate_rows(compared=0), "DIVERGED"),
+            (_gate_rows(census=False), "census"),
+            (_gate_rows(fallback=0), "never flipped"),
+            (_gate_rows(reenable=0), "never flipped"),
+            (_gate_rows(deterministic=False), "diverged across"),
+            (_gate_rows(drop_arm="plain"), "BOTH"),
+            ([r for r in _gate_rows()
+              if r["bench"] != "serving_spec_overload"],
+             "UNVERIFIED"),
+            ([r for r in _gate_rows()
+              if r["bench"] != "serving_spec_summary"],
+             "UNVERIFIED")):
+        assert check_serving_spec(rows) == 1
+        out = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["gate"] == "FAIL"
+        assert frag in out["reason"]
+
+
+@pytest.mark.slow
+def test_spec_bench_arm_end_to_end(capsys):
+    """The --spec arm at reduced size: rows parse, the gate passes."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import serving_workload_bench as swb
+    from bench_gate import check_serving_spec
+    rc = swb.main(["--cpu", "--spec", "--spec-requests", "160"])
+    assert rc == 0
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    arms = {r.get("arm") for r in rows
+            if r.get("bench") == "serving_spec"}
+    assert arms == {"plain", "adaptive_spec"}
+    assert check_serving_spec(rows) == 0

@@ -93,6 +93,15 @@ cannot silently ship a slower build. Three modes:
       #    pool bytes at TP=2 must be <= 0.55x of TP=1 at equal total
       #    capacity, and the capacity demo must hold: a model over the
       #    per-device HBM budget refuses at TP=1 and serves under TP.
+      #  - serving_spec (tools/serving_workload_bench.py --spec): on
+      #    the mixed churn trace, the adaptive speculative route must
+      #    reach >= 1.0x plain decode's tokens/sec with FULL greedy
+      #    parity on every stream (speculation changes latency, never
+      #    content); the overload arm's BurnRateRule incident —
+      #    delivered through QoSScheduler.note_incident — must flip
+      #    the route plain and back, with the flip timeline
+      #    byte-identical across two seeded replays and censuses
+      #    intact on every arm.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -997,6 +1006,112 @@ def check_serving_lora(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+SPEC_TPS_FLOOR = 1.0  # adaptive-spec vs plain decode tokens/sec
+
+
+def check_serving_spec(rows: list) -> int:
+    """Gate the speculative-serving rows from
+    serving_workload_bench.py --spec: on the mixed churn trace the
+    adaptive route must reach >= SPEC_TPS_FLOOR x plain decode's
+    tokens/sec with FULL greedy parity on every stream — equal
+    output dicts, not just compared prefixes: speculation changes
+    latency, never content — and the overload arm must show the
+    fallback actually closing the loop: >= 1 flip to plain while the
+    BurnRateRule incident is open, >= 1 re-enable after it closes,
+    the whole flip timeline byte-identical across two seeded
+    replays, and the pool census intact on every arm. The plain
+    baseline is re-measured in the same run — no stamped file. A
+    missing-JSON input is the caller's no-JSON FAIL: the claim was
+    not checked."""
+    sr = [r for r in rows if r.get("bench") == "serving_spec"]
+    by = {r.get("arm"): r for r in sr}
+    if "plain" not in by or "adaptive_spec" not in by:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_spec rows need BOTH a "
+                                    "plain and an adaptive_spec arm "
+                                    "(run tools/serving_workload_"
+                                    "bench.py --spec)"}))
+        return 1
+    over = [r for r in rows
+            if r.get("bench") == "serving_spec_overload"]
+    for r in sr + over:
+        if r.get("census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm", "overload"),
+                "reason": "pool census broken under the spec route "
+                          "— a verify-window page escaped the "
+                          "resident+evictable+free invariant"}))
+            return 1
+    if not over:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_spec_overload row — "
+                                    "the fallback claim is "
+                                    "UNVERIFIED (rerun the --spec "
+                                    "arm end to end)"}))
+        return 1
+    o = over[-1]
+    if not int(o.get("fallback_flips") or 0) \
+            or not int(o.get("reenable_flips") or 0):
+        print(json.dumps({
+            "gate": "FAIL",
+            "reason": "the overload arm never flipped the route "
+                      f"(fallback={o.get('fallback_flips')} "
+                      f"reenable={o.get('reenable_flips')}) — the "
+                      "BurnRateRule incident is not reaching "
+                      "QoSScheduler.note_incident, or the surge is "
+                      "not burning"}))
+        return 1
+    if o.get("flips_deterministic") is not True:
+        print(json.dumps({
+            "gate": "FAIL",
+            "reason": "route flips diverged across two seeded "
+                      "replays — the adaptive gate is reading "
+                      "nondeterministic state"}))
+        return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_spec_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_spec_summary row — "
+                                    "the throughput/parity claims "
+                                    "are UNVERIFIED (rerun the "
+                                    "--spec arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("outputs_match") is not True \
+            or not int(s.get("parity_compared") or 0):
+        print(json.dumps({
+            "gate": "FAIL",
+            "reason": "adaptive-spec streams DIVERGED from plain "
+                      "decode (verification must make every token "
+                      "the target's greedy token), or nothing was "
+                      "compared",
+            "parity_compared": s.get("parity_compared")}))
+        return 1
+    ratio = s.get("spec_vs_plain_tokens_per_sec")
+    rec = {
+        "gate": "pass",
+        "spec_vs_plain_tokens_per_sec": ratio,
+        "tps_floor": SPEC_TPS_FLOOR,
+        "acceptance_rate": s.get("acceptance_rate"),
+        "n_draft": s.get("n_draft"),
+        "requests": s.get("requests"),
+        "parity_compared": s.get("parity_compared"),
+        "fallback_flips": o.get("fallback_flips"),
+        "reenable_flips": o.get("reenable_flips"),
+        "device": by["adaptive_spec"].get("device", "?"),
+    }
+    if ratio is None or float(ratio) < SPEC_TPS_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"adaptive-spec only {ratio}x plain "
+                         f"decode's tokens/sec (floor "
+                         f"{SPEC_TPS_FLOOR}) — the draft window is "
+                         "not paying for its verify blocks on this "
+                         "trace")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 AUTOSCALE_GOODPUT_FLOOR = 1.0   # autoscaled vs static-peak goodput
 AUTOSCALE_KINDS = ("diurnal", "flash")
 
@@ -1346,9 +1461,10 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     prefix-aware-vs-round-robin cluster goodput ratio, a broken
     cluster/drain-join request-conservation census, a lost/duplicated
     /diverging request across a crash, sub-floor goodput under
-    faults, or a sub-floor multiplexed-vs-split lora goodput ratio /
-    adapter-parity break (--lora) — so the serving claims can only
-    change deliberately."""
+    faults, a sub-floor multiplexed-vs-split lora goodput ratio /
+    adapter-parity break (--lora), or a spec route that is slower
+    than plain / breaks greedy parity / never flips under overload
+    (--spec) — so the serving claims can only change deliberately."""
     fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
@@ -1375,6 +1491,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_lora")
            for r in rows):
         fam_rcs["lora"] = check_serving_lora(rows)
+    if any(r.get("bench", "").startswith("serving_spec")
+           for r in rows):
+        fam_rcs["spec"] = check_serving_spec(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

@@ -137,6 +137,18 @@ QUEUE = [
     ("serving_lora",
      [sys.executable, "tools/serving_workload_bench.py", "--lora"],
      {}),
+    # PR-13 addition: the speculative-serving arm — the mixed churn
+    # trace through plain vs adaptive-spec engines (batched
+    # draft/verify rounds over the shared paged pool, honest fixed
+    # pricing) plus the deadline-mix overload arm whose BurnRateRule
+    # incident must park the route plain and release it (sim
+    # replicas, fixed clock — the chip run smokes the same code
+    # path); bench_gate.py serving gates the serving_spec family
+    # (tokens/sec >= plain, full greedy parity, fallback flips
+    # present + deterministic, censuses intact)
+    ("serving_spec",
+     [sys.executable, "tools/serving_workload_bench.py", "--spec"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

@@ -64,6 +64,17 @@ duplicated requests with census conservation at every membership
 change, completed-stream token parity vs the fault-free run, and
 goodput under faults >= 0.80x fault-free.
 
+The spec arm (``--spec``) replays the mixed churn trace through plain
+vs adaptive-spec sim engines on the fixed clock (honest draft/verify
+pricing: one spec round = 1.25 decode units for up to n_draft+1
+tokens), then the deadline-mix calm-then-surge trace through a QoS
+spec engine whose page-severity ``BurnRateRule`` — delivered through
+``QoSScheduler.note_incident`` — must park the route plain during the
+surge and release it after, replayed twice for flip determinism.
+`bench_gate.py serving` gates the `serving_spec` family: adaptive
+tokens/sec >= plain with full greedy parity on every stream, fallback
+flips present and deterministic, censuses intact.
+
 The lora arm (``--lora``) replays ONE seeded Zipf-adapter trace
 (hot fine-tunes dominate) through a multiplexed fleet — every replica
 serves every adapter via one fixed-shape batch with per-row bank
@@ -103,6 +114,7 @@ Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --chaos
       python tools/serving_workload_bench.py --chaos --fault-plan p.jsonl
       python tools/serving_workload_bench.py --lora
+      python tools/serving_workload_bench.py --spec
 """
 from __future__ import annotations
 
@@ -816,6 +828,162 @@ def _lora_arm(args):
     return 0
 
 
+def _spec_arm(args):
+    """The speculative-serving arm, two claims on the fixed clock:
+
+    1. THROUGHPUT: the mixed churn trace (ragged poisson arrivals,
+       shared prefixes, mid-stream cancels — every request loose, so
+       the per-request rule routes it all speculative) replays
+       through plain vs adaptive-spec sim engines under HONEST spec
+       pricing (``spec_decode`` = 1.25 decode units — one
+       (k+1)-position verify block plus the draft walk;
+       ``spec_prefill`` = a flat 0.25 units per admitted spec row —
+       the draft re-walks the prompt through the shared page chain
+       in one call). One
+       ``serving_spec`` row per arm; the gate wants adaptive
+       tokens/sec >= plain with full greedy parity on every stream
+       (speculation changes latency, never content).
+
+    2. FALLBACK: the deadline-mix trace (loose/tight cohorts on a
+       calm-then-surge profile) replays through a QoS spec engine
+       with a page-severity ``BurnRateRule`` delivered into
+       ``QoSScheduler.note_incident`` — the declared overload seam.
+       The surge must flip the route plain (draft compute is waste
+       when capacity is scarce) and the recovery must flip it back;
+       the arm replays TWICE and the ``serving_spec_overload`` row
+       carries the flip timeline plus its replay-determinism verdict.
+
+    `bench_gate.py serving` gates the serving_spec family on exactly
+    these rows."""
+    import json as _json
+
+    from paddle_tpu.obs.slo import BurnRateRule
+    from paddle_tpu.serving import (QoSScheduler, ServingEngine,
+                                    SpecConfig, make_sim_serving,
+                                    synthesize_deadline_mix_trace,
+                                    synthesize_trace, trace_stats)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    VOCAB = 509
+    SLOTS, PS, ML = 8, 8, 64
+    costs = {"prefill_unit": 1.0, "decode": 1.0,
+             "spec_decode": 1.25, "spec_prefill": 0.25}
+    cfg = SpecConfig(n_draft=4)
+    accept = args.spec_accept
+
+    def make_engine(spec_on, scheduler=None, slo=None, trace=None):
+        return ServingEngine(
+            serving=make_sim_serving(
+                max_len=ML, page_size=PS, slots=SLOTS, vocab=VOCAB,
+                n_pool_pages=SLOTS * (ML // PS) + 1 + 16,
+                spec_accept=accept if spec_on else None),
+            slots=SLOTS, policy="paged", clock="fixed",
+            fixed_costs=costs, decode_chunk=1, expect_churn=True,
+            spec=cfg if spec_on else None, scheduler=scheduler,
+            slo=slo, trace=trace)
+
+    n_req = args.spec_requests
+    trace = synthesize_trace(
+        seed=args.seed, n_requests=n_req, arrival="poisson",
+        mean_interarrival=0.5, prompt_len=(4, 16),
+        output_len=(8, 24), vocab_size=VOCAB,
+        shared_prefix_frac=0.3, prefix_len=PS, churn_frac=0.2,
+        rid_prefix="m")
+    stats = trace_stats(trace)
+
+    rows, outs = {}, {}
+    for arm, spec_on in (("plain", False), ("adaptive_spec", True)):
+        res = make_engine(
+            spec_on,
+            trace=args.trace_out if spec_on and args.trace_out
+            else None).run(trace)
+        rec = res.metrics.to_record(
+            policy="paged", device="sim", seed=args.seed,
+            slots=SLOTS, decode_chunk=1, n_draft=cfg.n_draft,
+            spec_accept=accept if spec_on else None, trace=stats)
+        rec["bench"] = "serving_spec"
+        rec["arm"] = arm
+        rec["census_ok"] = res.cache_stats.get("invariant_ok")
+        if res.spec_stats is not None:
+            rec["spec"] = {k: res.spec_stats[k] for k in
+                           ("rounds", "draft_tokens_proposed",
+                            "draft_tokens_accepted",
+                            "acceptance_rate", "acceptance_ewma",
+                            "enabled_end", "latched")}
+            rec["flips"] = res.spec_stats["flips"]
+        rows[arm] = rec
+        outs[arm] = res.outputs
+        emit(rec)
+
+    # --- overload fallback arm (replayed twice: the flip timeline
+    # must be deterministic on the virtual clock). The trace size is
+    # FIXED: the surge/recovery dynamics are calibrated so the burn
+    # incident both opens and closes inside the replay — scaling it
+    # with --spec-requests could leave the incident open at trace
+    # end and vacuously drop the re-enable flip.
+    otrace = synthesize_deadline_mix_trace(
+        seed=args.seed, n_requests=220,
+        service_tokens_per_unit=float(SLOTS), base_load=0.55,
+        surge=(0.45, 0.2, 5.0), output_len=(6, 16),
+        vocab_size=VOCAB)
+
+    def run_overload():
+        rule = BurnRateRule(
+            name="deadline_burn", objective=0.6,
+            windows=((60.0, 1.5), (15.0, 1.5)),
+            bad="deadline_missed", min_events=10, severity="page")
+        return make_engine(
+            True, scheduler=QoSScheduler(max_queue=8 * SLOTS),
+            slo=[rule]).run(otrace)
+
+    ores = run_overload()
+    ores2 = run_overload()
+    fl = ores.spec_stats["flips"]
+    orec = ores.metrics.to_record(
+        policy="paged", device="sim", seed=args.seed, slots=SLOTS,
+        decode_chunk=1, n_draft=cfg.n_draft, spec_accept=accept)
+    orec["bench"] = "serving_spec_overload"
+    orec["requests"] = len(otrace)
+    orec["census_ok"] = ores.cache_stats.get("invariant_ok")
+    orec["flips"] = fl
+    orec["fallback_flips"] = sum(1 for f in fl if not f["enabled"])
+    orec["reenable_flips"] = sum(1 for f in fl if f["enabled"])
+    orec["flips_deterministic"] = fl == ores2.spec_stats["flips"]
+    orec["incidents"] = [
+        {"rule": i.rule, "t_open": round(i.t_open, 6),
+         "resolution": i.resolution}
+        for i in (ores.incidents or [])]
+    orec["spec"] = {k: ores.spec_stats[k] for k in
+                    ("rounds", "acceptance_rate", "enabled_end",
+                     "latched")}
+    emit(orec)
+
+    pl, sp = rows["plain"], rows["adaptive_spec"]
+    parity, compared, full_eq = _stream_parity(outs["adaptive_spec"],
+                                               outs["plain"])
+    pl_tps = pl.get("tokens_per_sec") or 0.0
+    sp_tps = sp.get("tokens_per_sec") or 0.0
+    emit({"bench": "serving_spec_summary", "device": "sim",
+          "seed": args.seed, "requests": n_req,
+          "n_draft": cfg.n_draft, "spec_accept": accept,
+          "outputs_match": bool(parity
+                                and outs["plain"]
+                                == outs["adaptive_spec"]),
+          "parity_compared": compared,
+          "parity_full_equal": full_eq,
+          "plain_tokens_per_sec": pl_tps,
+          "spec_tokens_per_sec": sp_tps,
+          "spec_vs_plain_tokens_per_sec": round(sp_tps / pl_tps, 4)
+          if pl_tps else None,
+          "acceptance_rate": sp["spec"]["acceptance_rate"],
+          "fallback_flips": orec["fallback_flips"],
+          "reenable_flips": orec["reenable_flips"],
+          "flips_deterministic": orec["flips_deterministic"]})
+    return 0
+
+
 def _chaos_arm(args):
     """The fault-tolerance arm: the SAME ~10^5-request sim-backed
     overload trace as --cluster, replayed twice through prefix_aware
@@ -1395,6 +1563,27 @@ def main(argv=None):
     ap.add_argument("--lora-adapters", type=int, default=4,
                     help="adapter count == replica count for both "
                          "--lora arms")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-serving arm: plain vs "
+                         "adaptive-spec sim engines on the mixed "
+                         "churn trace (fixed clock, honest "
+                         "draft/verify pricing) + the deadline-mix "
+                         "overload arm whose BurnRateRule incident "
+                         "must flip the route plain and back, "
+                         "replayed twice for flip determinism; "
+                         "bench_gate.py serving gates the "
+                         "serving_spec family (tokens/sec >= plain, "
+                         "greedy parity, fallback flips present + "
+                         "deterministic)")
+    ap.add_argument("--spec-requests", type=int, default=360,
+                    help="spec arm: requests in the mixed churn "
+                         "trace (the overload arm's trace stays "
+                         "fixed at 220 — its surge/recovery "
+                         "dynamics are calibrated)")
+    ap.add_argument("--spec-accept", type=float, default=0.85,
+                    help="spec arm: the sim draft's per-token "
+                         "probability of proposing the true next "
+                         "token")
     ap.add_argument("--autoscale", action="store_true",
                     help="run the elastic-autoscaling arm instead: "
                          "the diurnal + flash-crowd traces (fixed "
@@ -1486,6 +1675,8 @@ def main(argv=None):
         return _tp_arm(args)
     if args.lora:
         return _lora_arm(args)
+    if args.spec:
+        return _spec_arm(args)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     paddle.seed(0)

@@ -22,6 +22,11 @@ tool turns it into the four summaries an on-call actually asks for:
   and host->device upload totals from the engine's ``admit``
   instants and ``adapter_upload`` spans; single-model traces render
   byte-identically without the section.
+- **speculative route** (spec traces only): an ``accept=a/p``
+  waterfall column per spec-decoded request (draft tokens accepted /
+  proposed), the deterministic route-flip timeline with the explain
+  rule each flip fired on, and a ``trace_report_spec`` ``--json``
+  row; pre-spec traces render byte-identically without any of it.
 
 ``--json`` emits one row PER TRACK, then (for cluster traces, whose
 engine tracks are replica-prefixed ``r0/engine``, ``r0/slot/3``, ...)
@@ -268,6 +273,59 @@ def adapter_summary(events: list) -> dict | None:
             "by_adapter": dict(sorted(by_adapter.items()))}
 
 
+def spec_accepts(events: list) -> dict:
+    """rid -> {"proposed": N, "accepted": N} from the engine's
+    per-request ``spec`` instants (emitted at row finish ONLY when
+    the row actually ran speculative rounds). Empty for any pre-spec
+    trace — every spec column/section/row below is omitted then, so
+    pre-spec traces summarize byte-identically."""
+    out: dict = {}
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "spec":
+            continue
+        a = e.get("args", {})
+        rid = a.get("rid")
+        if rid is not None:
+            out[rid] = {"proposed": int(a.get("proposed", 0)),
+                        "accepted": int(a.get("accepted", 0))}
+    return out
+
+
+def spec_flips(events: list) -> list:
+    """The adaptive spec route's deterministic flip timeline (the
+    engine's ``spec_flip`` instants, each carrying the explain rule
+    that fired), in time order. Empty for pre-spec traces."""
+    return sorted(
+        ({"t": e["ts"], **e.get("args", {})}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") == "spec_flip"),
+        key=lambda r: (r["t"], str(r.get("rule"))))
+
+
+def spec_summary(events: list) -> dict | None:
+    """Speculative-serving evidence: the ``trace_report_spec`` row —
+    spec request count, draft-token totals, and the route-flip
+    timeline. None for pre-spec traces, whose report output stays
+    byte-identical."""
+    acc = spec_accepts(events)
+    fl = spec_flips(events)
+    if not acc and not fl:
+        return None
+    return {"bench": "trace_report_spec",
+            "spec_requests": len(acc),
+            "draft_tokens_proposed": sum(v["proposed"]
+                                         for v in acc.values()),
+            "draft_tokens_accepted": sum(v["accepted"]
+                                         for v in acc.values()),
+            "flips": len(fl),
+            "flip_timeline": [{"t": f["t"],
+                               "enabled": f.get("enabled"),
+                               "rule": f.get("rule")}
+                              for f in fl[:20]],
+            "accepts": {rid: v
+                        for rid, v in sorted(acc.items())[:20]}}
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -422,6 +480,7 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
     reqs = request_rows(events, tracks)
     hops = failover_hops(events, tracks)
     kv_hops = handoff_hops(events)
+    accepts = spec_accepts(events)
     lines = []
     if reqs:
         ts = [r["arrival"] for r in reqs if "arrival" in r] + \
@@ -443,10 +502,15 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                   f"path={'>'.join(hop['path'])}") if hop else ""
             kv = kv_hops.get(r["rid"])
             ho = f" handoff={'>'.join(kv['path'])}" if kv else ""
+            sa = accepts.get(r["rid"])
+            # accept=a/p appears only for rows that ran spec rounds
+            # — pre-spec traces render byte-identically
+            sp = f" accept={sa['accepted']}/{sa['proposed']}" \
+                if sa else ""
             lines.append(
                 f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
                 f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}"
-                f"{fo}{ho}")
+                f"{fo}{ho}{sp}")
     comp = recompiles(events)
     lines.append(f"\n== recompiles ({len(comp)}) ==")
     by_site: dict = {}
@@ -488,6 +552,20 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                      f"{ad['uploads']} uploads) ==")
         for name, n in ad["by_adapter"].items():
             lines.append(f"  {name:16s} x{n}")
+    flips = spec_flips(events)
+    if accepts or flips:
+        # only spec traces grow this section — pre-spec traces
+        # render byte-identically
+        prop = sum(v["proposed"] for v in accepts.values())
+        acc_n = sum(v["accepted"] for v in accepts.values())
+        lines.append(f"\n== speculative route ({len(accepts)} spec "
+                     f"requests, {acc_n}/{prop} drafts accepted, "
+                     f"{len(flips)} flips) ==")
+        for f in flips[:top * 2]:
+            lines.append(
+                f"  t={f['t'] / 1e6:.4f}s -> "
+                f"{'spec' if f.get('enabled') else 'plain':5s} :: "
+                f"{f.get('rule')}")
     acts = autoscale_actions(events)
     if acts:
         # only autoscaled traces grow this section — pre-autoscale
@@ -551,6 +629,11 @@ def main(argv=None) -> int:
             # multi-model traces only: absent otherwise, so
             # single-model --json output is byte-identical
             print(json.dumps(ad))
+        sp_row = spec_summary(events)
+        if sp_row is not None:
+            # speculative traces only: absent otherwise, so pre-spec
+            # --json output is byte-identical (global row still LAST)
+            print(json.dumps(sp_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
