@@ -571,6 +571,78 @@ def decode_need_bytes_per_device(outer, layers, pools) -> int:
             + tree_device_bytes(pools))
 
 
+# --- quantized KV page tier (kv_quant serving) ------------------------------
+
+def kv_quant_page_bytes(cfg: "LlamaConfig", page_size: int,
+                        dtype) -> tuple:
+    """(full_precision, int8+scale) bytes ONE page costs across all
+    layers, k+v — the per-page prices ``PagedKVCache.stored_bytes()``
+    charges. A quantized slot stores head_dim int8 bytes plus one f32
+    per-slot scale (the _q8 codec), so the int8 price is
+    ``hd + 4`` bytes per slot vs ``hd * itemsize`` full precision."""
+    L = cfg.num_hidden_layers
+    nkv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    slots = L * nkv * page_size
+    fp = 2 * slots * hd * np.dtype(dtype).itemsize
+    q = 2 * slots * (hd + 4)
+    return fp, q
+
+
+@jax.jit
+def compact_kv_pages(pools, mask):
+    """Quantize the masked pages of a PRESSURE-tier pool (functional):
+    per-slot absmax int8 (``_q8``) written into the int8 arena, tier
+    bits set. Fixed shape — ``mask`` is a (P,) bool jit INPUT, so any
+    compaction batch reuses the one compiled program and compaction
+    churn never recompiles. The full-precision slots of a compacted
+    page are left in place but dead: every read goes through the tier
+    mask, and the write path clears a page's tier bit in the same
+    program that rewrites it."""
+    (kf, kq, ks), (vf, vq, vs), tier = pools
+    m5 = mask[None, None, :, None, None]
+    m4 = mask[None, None, :, None]
+
+    def one(fp, qd0, s0):
+        qd, s = _q8(fp)
+        return jnp.where(m5, qd, qd0), jnp.where(m4, s, s0)
+
+    kq, ks = one(kf, kq, ks)
+    vq, vs = one(vf, vq, vs)
+    return (kf, kq, ks), (vf, vq, vs), tier | mask
+
+
+def export_quant_pages(pools, page_ids):
+    """Slice a PRESSURE pool's pages for a disaggregated handoff: both
+    arenas AND the per-page tier bits travel, so a mixed-tier chain
+    re-materializes (quantized pages re-compact) exactly on import.
+    The default engine export (page-axis tree_map) cannot carry the
+    1-D tier leaf — this is the factory override it looks for."""
+    idx = jnp.asarray(list(page_ids))
+    (kf, kq, ks), (vf, vq, vs), tier = pools
+
+    def sl(a):
+        return a[:, :, idx]
+
+    return ((sl(kf), sl(kq), sl(ks)), (sl(vf), sl(vq), sl(vs)),
+            tier[idx])
+
+
+def import_quant_pages(pools, page_ids, data):
+    """Scatter an exported mixed-tier chain into a PRESSURE pool at
+    ``page_ids`` (the importer's freshly allocated pages)."""
+    idx = jnp.asarray(list(page_ids))
+    (kf, kq, ks), (vf, vq, vs), tier = pools
+    (kfd, kqd, ksd), (vfd, vqd, vsd), td = data
+
+    def st(a, d):
+        return a.at[:, :, idx].set(d)
+
+    return ((st(kf, kfd), st(kq, kqd), st(ks, ksd)),
+            (st(vf, vfd), st(vq, vqd), st(vs, vsd)),
+            tier.at[idx].set(td))
+
+
 def shard_decode_params(outer, layers, tp: TPConfig):
     """Place decode weights on the TP mesh ONCE at load: layer
     projections per ``tp_layer_specs``, outer params (embeddings,
@@ -1330,7 +1402,8 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
                                scan_layers: bool = True,
                                tp: "TPConfig | int | None" = None,
                                lora: "LoRAConfig | tuple | None"
-                               = None):
+                               = None,
+                               kv_quant: str | None = None):
     """Compiled decode over a PAGED KV pool — the continuous-batching
     serving path (ops/pallas/paged_attention.py; the reference's dense
     fused_multi_transformer cache cannot share memory across requests).
@@ -1360,6 +1433,16 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     ``kv_cache_dtype="int8"``: pool pages store the per-slot absmax
     int8 codec (the dense cache's _q8) — serving cache memory halves
     and the Pallas kernel dequantizes in VMEM per page.
+
+    ``kv_quant``: the serving-tier spelling of the pool codec.
+    ``"int8"`` is always-int8 — identical storage to
+    ``kv_cache_dtype="int8"``. ``"pressure"`` keeps hot pages full
+    precision and adds an int8+scale shadow arena plus a (P,) page
+    tier mask (all jit inputs): ``compact_kv_pages`` quantizes parked
+    pages under byte pressure, reads merge both tiers through ONE
+    fixed-shape where(), and the write paths clear a written page's
+    tier bit in-program — so compaction churn and page recycling
+    never recompile and never read stale int8 content.
 
     ``emit="logits"``: prefill/decode_step return the last-position
     logits (B, V) instead of greedy tokens, so the serving loop owns
@@ -1423,6 +1506,26 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     if kv_cache_dtype not in (None, "int8"):
         raise ValueError(f"kv_cache_dtype {kv_cache_dtype!r}: use None "
                          "(model dtype) or 'int8'")
+    if kv_quant not in (None, "int8", "pressure"):
+        raise ValueError(f"kv_quant {kv_quant!r}: use None, 'int8' "
+                         "(every page stored int8+scale) or 'pressure' "
+                         "(parked pages compacted to int8 under byte "
+                         "pressure)")
+    if kv_quant == "int8":
+        # always-int8 IS the existing int8 pool codec, named at the
+        # serving tier: one storage path, two spellings
+        quantized = True
+    pressure = kv_quant == "pressure"
+    if pressure:
+        if kv_cache_dtype is not None:
+            raise ValueError("kv_quant='pressure' owns the pool codec "
+                             "— drop kv_cache_dtype")
+        if tp is not None:
+            raise ValueError(
+                "kv_quant='pressure' does not compose with tp= yet: "
+                "the (P,) page-tier mask is a whole-pool jit input "
+                "with no kv-head axis to shard — use kv_quant='int8' "
+                "(scales shard with their kv heads per tp_pool_spec)")
     if emit not in ("token", "logits"):
         raise ValueError(f"emit {emit!r}: use 'token' or 'logits'")
     if prefill_attention not in ("gather", "kernel"):
@@ -1453,6 +1556,16 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
 
     def init_pools():
         shape = (L, nkv, n_pool_pages, page_size, hd)
+        if pressure:
+            # two-tier arena: full-precision pages PLUS an int8+scale
+            # shadow and a (P,) tier mask saying which arena each page
+            # reads from. All jit inputs — compaction flips tier bits,
+            # never shapes, so the degradation tier cannot recompile.
+            def one():
+                return (jnp.zeros(shape, dtype),
+                        jnp.zeros(shape, jnp.int8),
+                        jnp.ones(shape[:-1], jnp.float32))
+            return one(), one(), jnp.zeros((n_pool_pages,), bool)
         if quantized:
             def one():
                 return (jnp.zeros(shape, jnp.int8),
@@ -1466,6 +1579,47 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             pools = device_put_sharded(pools, tp_mesh,
                                        tp_pool_spec(tp.axis))
         return pools
+
+    def _tier_clear(pools, written_ids):
+        """PRESSURE: the pages this program is about to write get
+        fresh full-precision content, so their tier bit dies in the
+        SAME program — a recycled page id can never read stale int8
+        data (the device-side twin of PagedKVCache dropping a page's
+        tier with its id). Rewrites of still-cached tails clear too:
+        their fp slots hold identical content."""
+        (kf, kq, ks), (vf, vq, vs), tier = pools
+        tier = tier.at[written_ids.reshape(-1)].set(False)
+        return (kf, kq, ks), (vf, vq, vs), tier
+
+    def _tier_enter(pools):
+        """PRESSURE: merge both arenas into ONE full-precision view
+        (quantized pages dequantized through the tier mask) so every
+        downstream read/write path is the unquantized program — one
+        fixed-shape where() per pool, no second attention variant.
+        Returns (k_view, v_view, merge_ctx); passthrough otherwise."""
+        if not pressure:
+            k_pools, v_pools = pools
+            return k_pools, v_pools, None
+        (kf, kq, ks), (vf, vq, vs), tier = pools
+        t = tier[None, None, :, None, None]
+
+        def merge(fp, qd, s):
+            return jnp.where(
+                t, (qd.astype(jnp.float32) * s[..., None]).astype(
+                    fp.dtype), fp)
+
+        return merge(kf, kq, ks), merge(vf, vq, vs), (pools, t)
+
+    def _tier_exit(k_eff, v_eff, ctx):
+        """PRESSURE: fold the written merged view back into the
+        two-tier pool — quantized pages keep their (authoritative)
+        int8 arena and old fp slots, everything else takes the writes.
+        Passthrough otherwise."""
+        if ctx is None:
+            return k_eff, v_eff
+        ((kf, kq, ks), (vf, vq, vs), tier), t = ctx
+        return ((jnp.where(t, kf, k_eff), kq, ks),
+                (jnp.where(t, vf, v_eff), vq, vs), tier)
 
     def _write_prompt(pool_l, kv, page_tables, T_pad):
         """kv (B, nkv, T_pad, hd) -> pages at the tables' first
@@ -1493,8 +1647,11 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         prompt lengths (padding K/V lands in allocated pages but is
         masked by lengths everywhere downstream). ``lora``: optional
         ``(adapter_bank, adapter_ids)`` multi-adapter deltas."""
-        k_pools, v_pools = pools
         B, T = tokens.shape
+        if pressure:
+            pools = _tier_clear(pools,
+                                page_tables[:, :T // page_size])
+        k_pools, v_pools, _tm = _tier_enter(pools)
         if T % page_size:
             raise ValueError(f"prefill length {T} must be a multiple of "
                              f"page_size {page_size} (pad the prompt)")
@@ -1526,12 +1683,15 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         x_last = jnp.take_along_axis(
             x, (lengths - 1)[:, None, None].astype(jnp.int32), 1)[:, 0]
         out = _emit(_logits(cfg, outer, x_last))
-        return out, (k_pools, v_pools)
+        return out, _tier_exit(k_pools, v_pools, _tm)
 
     @partial(jax.jit, donate_argnums=(5,))  # no per-token pool copy
     def decode_step(outer, layers, tok, page_tables, lengths, pools,
                     lora=None):
-        k_pools, v_pools = pools
+        if pressure:
+            pools = _tier_clear(pools, jnp.take_along_axis(
+                page_tables, (lengths // page_size)[:, None], 1))
+        k_pools, v_pools, _tm = _tier_enter(pools)
         x = jnp.take(outer["model.embed_tokens.weight"], tok,
                      axis=0)[:, None]                    # (B, 1, H)
         pos = lengths[:, None]                           # per-sequence
@@ -1562,7 +1722,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         k_pools, v_pools = ys
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
         out = _emit(_logits(cfg, outer, x[:, 0]))
-        return out, (k_pools, v_pools)
+        return out, _tier_exit(k_pools, v_pools, _tm)
 
     @partial(jax.jit, donate_argnums=(6,))
     def _prefill_chunk(outer, layers, chunk, start, page_tables, lengths,
@@ -1571,8 +1731,11 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         writes its pages, attends to every pool position < start+C, and
         harvests the hidden state of each sequence's (length-1) row when
         it falls inside this chunk."""
-        k_pools, v_pools = pools
         B, C = chunk.shape
+        if pressure:
+            pools = _tier_clear(pools, jax.lax.dynamic_slice_in_dim(
+                page_tables, start // page_size, C // page_size, 1))
+        k_pools, v_pools, _tm = _tier_enter(pools)
         W = page_tables.shape[1]
         S = W * page_size
         x = jnp.take(outer["model.embed_tokens.weight"], chunk, axis=0)
@@ -1632,7 +1795,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         hit = ((lengths - 1 >= start)
                & (lengths - 1 < start + C))[:, None]
         x_last = jnp.where(hit, row, x_last)
-        return x_last, (k_pools, v_pools)
+        return x_last, _tier_exit(k_pools, v_pools, _tm)
 
     def _write_chunk(pool_l, kv, page_tables, start, C):
         """kv (B, nkv, C, hd) written at absolute positions start.. —
@@ -1853,6 +2016,12 @@ _TP_DENSE_REASON = (
     "which is exactly the residency TP exists to break — route "
     "with policy='paged'")
 
+_PRESSURE_DENSE_REASON = (
+    "a kv_quant='pressure' serving factory is paged-only: the "
+    "degradation tier compacts PAGES parked in the pool's evictable "
+    "LRU, and the dense wave cache has neither pages nor an LRU — "
+    "route with policy='paged'")
+
 
 def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  max_len: int = 256,
@@ -1866,7 +2035,8 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  lora: "LoRAConfig | tuple | None"
                                  = None,
                                  draft: LlamaForCausalLM | None
-                                 = None):
+                                 = None,
+                                 kv_quant: str | None = None):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
     ("paged", (outer, layers, pools, prefill, decode_step, decode_n))
@@ -1886,10 +2056,35 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
     # breaking cross-backend output parity for no routing reason)
     tp = as_tp_config(tp)
     lora = as_lora_config(lora)
+    if kv_quant not in (None, "int8", "pressure"):
+        raise ValueError(f"kv_quant {kv_quant!r}: use None, 'int8' or "
+                         "'pressure'")
+    if kv_quant == "int8":
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError("kv_quant='int8' IS kv_cache_dtype="
+                             f"'int8' — {kv_cache_dtype!r} conflicts")
+        # the serving cache codec must reach BOTH backends (see the
+        # kv_cache_dtype note below), so always-int8 rides it
+        kv_cache_dtype = "int8"
+    if kv_quant == "pressure":
+        if kv_cache_dtype is not None:
+            raise ValueError("kv_quant='pressure' owns the pool codec "
+                             "— drop kv_cache_dtype")
+        if draft is not None:
+            raise ValueError(
+                "kv_quant='pressure' does not compose with draft= "
+                "yet: the draft pool rides the target's page ids but "
+                "has no tier mask, so a compacted target page would "
+                "desync draft K/V — use kv_quant='int8'")
     if tp is None:
-        gen = llama_decode_factory(model, max_len=max_len,
-                                   kv_cache_dtype=kv_cache_dtype,
-                                   scan_layers=scan_layers)
+        if kv_quant == "pressure":
+            # pressure is PAGED-ONLY: the dense wave cache has no
+            # pages to tier
+            gen = PagedOnlyDense(_PRESSURE_DENSE_REASON)
+        else:
+            gen = llama_decode_factory(model, max_len=max_len,
+                                       kv_cache_dtype=kv_cache_dtype,
+                                       scan_layers=scan_layers)
     else:
         # tensor-parallel serving is PAGED-ONLY: no dense replica is
         # built (see PagedOnlyDense) — the engine coerces its routing
@@ -1900,7 +2095,7 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                        kv_cache_dtype=kv_cache_dtype,
                                        chunked_prefill=chunked_prefill,
                                        scan_layers=scan_layers, tp=tp,
-                                       lora=lora)
+                                       lora=lora, kv_quant=kv_quant)
     lora_hooks = None
     if lora is not None:
         # the adapter-cache device hooks (serving.adapters.AdapterCache
@@ -1960,6 +2155,19 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         chunked_prefill_ = chunked_prefill
         tp_ = tp  # TPConfig when the paged path is mesh-sharded
         lora_ = lora  # LoRAConfig when multi-adapter serving is built
+        # quantized page tier: None | "int8" | "pressure". page_bytes_
+        # prices ONE page (full-precision, int8+scale) for the
+        # bookkeeper's stored-bytes census; the pressure hooks are the
+        # device-side compaction/handoff programs the engine drives.
+        kv_quant_ = kv_quant
+        page_bytes_ = (kv_quant_page_bytes(
+            model.config, page_size,
+            paged[1]["self_attn.q_proj.weight"].dtype)
+            if kv_quant is not None else None)
+        if kv_quant == "pressure":
+            compact_pages = staticmethod(compact_kv_pages)
+            export_kv_pages = staticmethod(export_quant_pages)
+            import_kv_pages = staticmethod(import_quant_pages)
         # (draft outer, layers, pools, chunked prefill, spec_step)
         # when the factory is spec-capable; None otherwise — the
         # engine refuses ServingEngine(spec=...) without it. A tuple,
@@ -1972,8 +2180,9 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
 
         def pick(self, lengths, capacity=None, shared_prefix=False,
                  expect_churn=False):
-            if self.tp_ is not None:
-                # no dense replica exists on a sharded factory
+            if self.tp_ is not None or self.kv_quant_ == "pressure":
+                # no dense replica exists on a sharded or
+                # pressure-tiered factory
                 return "paged", paged
             # read the live attribute (not the factory closure) so
             # callers who adjust serving.capacity see routing follow

@@ -239,7 +239,18 @@ class PagedKVCache:
         self._children: dict = {}   # page id -> keys with it as parent
         self._evictable: dict = {}  # page id -> True; insertion = LRU
         self._stats = {"hit_tokens": 0, "lookup_tokens": 0,
-                       "evictions": 0}
+                       "evictions": 0, "compactions": 0}
+        # quantized-tier overlay (kv_quant serving): page ids whose
+        # device content is stored int8+scale. Strictly a subset of
+        # resident|evictable — the resident+evictable+free census is
+        # untouched; a page's tier dies with its id (eviction, an
+        # unpublished free, purge) so a recycled id never reads stale
+        # int8 data.
+        self._quant: set = set()
+        self._kv_quant: str | None = None
+        self._page_bytes: tuple | None = None  # (fp, int8+scale) /page
+        self._byte_budget: int | None = None
+        self._compact_cb = None
         # pool generation: purge() bumps it. Content written under an
         # earlier epoch is unreachable after a purge (every key dropped,
         # every page back on the free list), so a restarted replica
@@ -264,6 +275,82 @@ class PagedKVCache:
         self._pool_bytes = (total, int(per_device_bytes)
                             if per_device_bytes is not None else total)
 
+    # --- quantized page tier (kv_quant serving) ------------------------
+
+    def note_kv_quant(self, mode: str, fp_bytes_per_page: int | None = None,
+                      q_bytes_per_page: int | None = None,
+                      byte_budget: int | None = None, compact_cb=None):
+        """Arm the quantized-tier accounting. ``mode`` is ``"int8"``
+        (every occupied page already stored int8+scale by the factory)
+        or ``"pressure"`` (full-precision hot pages; parked pages are
+        compacted to int8 under byte pressure). The per-page byte costs
+        let ``stored_bytes()`` price the pool as actually stored;
+        ``byte_budget`` (pressure only) makes ``allocate()`` reclaim
+        bytes by compacting the evictable LRU — oldest first, prefix
+        keys intact — BEFORE giving up with MemoryError.
+        ``compact_cb(page_ids)`` is the device-side compaction hook the
+        engine installs (this bookkeeper never touches device data)."""
+        if mode not in ("int8", "pressure"):
+            raise ValueError(f"note_kv_quant: unknown mode {mode!r}")
+        self._kv_quant = mode
+        if fp_bytes_per_page is not None:
+            self._page_bytes = (int(fp_bytes_per_page),
+                                int(q_bytes_per_page))
+        self._byte_budget = int(byte_budget) \
+            if byte_budget is not None else None
+        self._compact_cb = compact_cb
+
+    def quantized_pages(self) -> set:
+        return set(self._quant)
+
+    def mark_quantized(self, page_ids):
+        """Record that ``page_ids`` are now stored int8 (e.g. after a
+        disaggregated import of a mixed-tier chain). Pages must be
+        occupied — a free page has no content to have a tier."""
+        for p in page_ids:
+            if p not in self._refs and p not in self._evictable:
+                raise ValueError(
+                    f"mark_quantized: page {p} is not occupied")
+            self._quant.add(p)
+
+    def compact_candidates(self):
+        """Evictable pages not yet quantized, oldest-parked first —
+        the order compaction spends them (mirrors the eviction LRU,
+        except nothing is forgotten: keys and census stay intact)."""
+        return [p for p in self._evictable if p not in self._quant]
+
+    def compact_evictable(self, max_pages: int | None = None) -> list:
+        """Compact up to ``max_pages`` (default: all) evictable
+        full-precision pages to int8, oldest first: the device hook
+        runs first (so a failure there leaves the tier unmarked), then
+        the pages join the quantized tier. Returns the page ids
+        compacted. Census is untouched — the pages stay evictable,
+        keys live, revivable by the same prefixes."""
+        cands = self.compact_candidates()
+        if max_pages is not None:
+            cands = cands[:max_pages]
+        if cands:
+            if self._compact_cb is not None:
+                self._compact_cb(list(cands))
+            self._quant.update(cands)
+            self._stats["compactions"] += len(cands)
+        return cands
+
+    def stored_bytes(self) -> int | None:
+        """Bytes the OCCUPIED pages (resident + evictable) actually
+        cost as stored: quantized pages at int8+scale size, the rest
+        at full precision. None until note_kv_quant supplied per-page
+        costs. This is the dynamic pressure signal — admission grows
+        it, compaction shrinks it, eviction zeroes a page's share."""
+        if self._page_bytes is None:
+            return None
+        fp, q = self._page_bytes
+        occupied = len(self._refs) + len(self._evictable)
+        n_q = len(self._quant)
+        if self._kv_quant == "int8":
+            return occupied * q
+        return (occupied - n_q) * fp + n_q * q
+
     def allocate(self, seq_id, n_tokens: int):
         """Reserve pages so ``seq_id`` can hold n_tokens total. The
         free list is spent first; evictable LRU pages are reclaimed
@@ -277,6 +364,30 @@ class PagedKVCache:
                 f"paged cache exhausted: need {need} pages, "
                 f"{len(self._free)} free + {len(self._evictable)} "
                 f"evictable")
+        if need > 0 and self._byte_budget is not None \
+                and self._kv_quant == "pressure":
+            # byte-budget admission: new pages land full precision; if
+            # that would breach the budget, reclaim bytes by compacting
+            # parked LRU pages to int8 FIRST (compaction before
+            # shedding — nothing is forgotten). Feasibility is checked
+            # before any mutation so MemoryError still mutates nothing.
+            # (Conservative: page-count evictions the loop below may do
+            # would free more bytes, but refusing early is deterministic
+            # and never over-admits.)
+            fp, q = self._page_bytes
+            projected = self.stored_bytes() + need * fp
+            over = projected - self._byte_budget
+            if over > 0:
+                save = fp - q
+                n_compact = -(-over // save) if save > 0 else 0
+                cands = self.compact_candidates()
+                if save <= 0 or n_compact > len(cands):
+                    raise MemoryError(
+                        f"paged cache byte budget exhausted: "
+                        f"{projected} stored bytes projected > "
+                        f"{self._byte_budget} budget and only "
+                        f"{len(cands)} compactable pages")
+                self.compact_evictable(max_pages=n_compact)
         for _ in range(max(0, need)):
             if not self._free:
                 self._evict_lru()
@@ -298,6 +409,8 @@ class PagedKVCache:
                 continue  # still a parent of live keys: not a leaf
             del self._evictable[p]
             self._drop_keys(p)
+            self._quant.discard(p)  # tier dies with the id: a recycled
+            # page must never read stale int8 content
             self._stats["evictions"] += 1
             self._free.append(p)
             return
@@ -454,6 +567,7 @@ class PagedKVCache:
                     self._drop_keys(p)  # stale-chain invalidation for
                     # the recycled id (unpublished pages normally have
                     # no keys; kept defensive)
+                    self._quant.discard(p)
                     self._free.append(p)
             else:
                 self._refs[p] = rc
@@ -477,6 +591,8 @@ class PagedKVCache:
         self._prefix.clear()
         self._page_key.clear()
         self._children.clear()
+        self._quant.clear()  # both tiers go: pre-purge int8 content is
+        # as untrusted as the full-precision pages
         self._free = list(range(n_pages - 1, 0, -1))
         self.epoch += 1
 
@@ -504,8 +620,13 @@ class PagedKVCache:
         (page 0 is reserved padding) is exactly one of resident /
         evictable / free. The serving engine samples this each turn;
         the serving_prefix bench gate fails if it ever broke."""
-        return (len(self._refs) + len(self._evictable)
-                + len(self._free)) == int(self.k_pages.shape[1]) - 1
+        balanced = (len(self._refs) + len(self._evictable)
+                    + len(self._free)) == int(self.k_pages.shape[1]) - 1
+        # the quantized tier is an overlay, never a fourth state: every
+        # quantized page must still be occupied
+        tier_ok = all(p in self._refs or p in self._evictable
+                      for p in self._quant)
+        return balanced and tier_ok
 
     def cache_stats(self) -> dict:
         """Prefix-cache accounting: cumulative hit/lookup tokens and
@@ -530,6 +651,19 @@ class PagedKVCache:
             # keep the pre-TP dict byte-for-byte
             out["bytes_total"] = self._pool_bytes[0]
             out["bytes_per_device"] = self._pool_bytes[1]
+        if self._kv_quant is not None:
+            # kv_quant census bucket — present only when the tier is
+            # armed (kv_quant=None keeps the dict byte-identical).
+            # always-int8 stores every occupied page quantized; pressure
+            # counts the compacted overlay.
+            occupied = len(self._refs) + len(self._evictable)
+            out["quantized_pages"] = (occupied
+                                      if self._kv_quant == "int8"
+                                      else len(self._quant))
+            out["compactions"] = self._stats["compactions"]
+            sb = self.stored_bytes()
+            if sb is not None:
+                out["stored_bytes"] = sb
         return out
 
     def batch_views(self, seq_ids):

@@ -949,6 +949,12 @@ class ClusterRouter:
                          and x.session.eng.page_size == h.page_size
                          and getattr(x.session.eng, "tp_size", 1)
                          == h.tp
+                         # the exported page data is TIER-shaped —
+                         # int8 scales / pressure dual-arena slices
+                         # scatter only into a pool of the same
+                         # kv_quant mode (filters like page_size/tp)
+                         and getattr(x.session.eng, "kv_quant", None)
+                         == h.kv_quant
                          and self._rep_fits(
                              x, len(h.req.prompt),
                              h.req.max_new_tokens)]

@@ -265,6 +265,12 @@ class ServeResult:
     # acceptance EWMA, and the deterministic flip log with explain
     # rules) when the engine carried spec=; None otherwise — the
     # result shape every pre-spec consumer sees is unchanged
+    kv_quant_stats: Optional[Dict] = None  # the quantized page
+    # tier's per-run evidence (mode, quantized-page count, the
+    # stored-byte census, and under 'pressure' the deterministic
+    # actuation flip log + pages compacted) when the engine carried
+    # kv_quant=; None otherwise — the result shape every pre-quant
+    # consumer sees is unchanged
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -522,6 +528,15 @@ class KVHandoff:
     # exported page content is head-sharded over the source mesh, so
     # only a decode worker on the SAME tp degree can scatter it into
     # its pool — disaggregated placement filters on it like page_size
+    kv_quant: Optional[str] = None    # source kv-quant mode: the
+    # exported page data is tier-shaped ('pressure' chains carry the
+    # dual-arena slices + tier bits, 'int8' chains carry scales), so
+    # only a decode worker on the SAME mode can adopt — placement
+    # filters on it like page_size/tp
+    quant_pages: Tuple[int, ...] = () # chain positions (indices into
+    # the exported chain, NOT pool page ids) that sat in the int8
+    # tier at export — the importer mirrors them into its own
+    # bookkeeper so its byte census prices the adopted chain right
 
 
 class ServingEngine:
@@ -581,7 +596,8 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefill_chunk_budget: Optional[int] = None,
                  slo=None, tp=None, adapters=None, lora=None,
-                 spec=None, spec_draft=None):
+                 spec=None, spec_draft=None, kv_quant=None,
+                 kv_quant_budget=None):
         # ``tp``: None (byte-identical to the single-device engine —
         # outputs, slot logs, metrics records, registry contents), a
         # TPConfig, or an int degree. With a MODEL it is threaded into
@@ -620,6 +636,20 @@ class ServingEngine:
         # ONE PagedKVCache page-id space — draft K/V lands in its own
         # pool arrays at the target's page ids, so prefix caching and
         # eviction recycle both in lockstep.
+        # ``kv_quant``: None (byte-identical to the plain engine —
+        # outputs, logs, metrics records, report keys, registry
+        # contents), 'int8' (EVERY page stored quantized with
+        # per-slot scales — the pool is ~half the fp bytes, so the
+        # same HBM budget holds ~2x the pages), or 'pressure' (pages
+        # stay full-precision while hot; pages parked in the
+        # evictable LRU compact to an int8 tier instead of being
+        # freed — under ``kv_quant_budget=`` stored bytes at
+        # allocation, and while a ``pool_bytes_per_device``
+        # ThresholdRule incident delivered through
+        # ``QoSScheduler.note_incident`` stays open). With a MODEL
+        # the mode is threaded into the factory build; with a
+        # PREBUILT factory the factory's own kv_quant_ is
+        # authoritative (conflicts error, like tp/lora).
         spec = as_spec_config(spec)
         if serving is None:
             if model is None:
@@ -650,7 +680,7 @@ class ServingEngine:
                 n_pool_pages=n_pool_pages, kv_cache_dtype=kv_cache_dtype,
                 batch_capacity=slots, scan_layers=scan_layers,
                 chunked_prefill=page_size, tp=tp, lora=lora,
-                draft=spec_draft)
+                draft=spec_draft, kv_quant=kv_quant)
         else:
             if spec_draft is not None:
                 raise ValueError(
@@ -677,6 +707,15 @@ class ServingEngine:
                     "is sized at build; pass lora to the factory (or "
                     "the model path) instead")
             lora = fac_lora
+            fac_q = getattr(serving, "kv_quant_", None)
+            if kv_quant is not None and fac_q != kv_quant:
+                raise ValueError(
+                    f"kv_quant={kv_quant!r} conflicts with the "
+                    f"prebuilt factory's kv_quant_={fac_q!r} — the "
+                    "page-tier layout is fixed at build; pass "
+                    "kv_quant to the factory (or the model path) "
+                    "instead")
+            kv_quant = fac_q
         # --- multi-model adapter serving (inert at adapters=None) ---
         self.lora = getattr(serving, "lora_", None)
         if adapters is not None and not isinstance(adapters,
@@ -768,6 +807,64 @@ class ServingEngine:
             policy = _coerce_paged_only(
                 policy, "under tp",
                 "a sharded factory holds no dense replica")
+        # --- quantized paged KV (inert at kv_quant=None) ------------
+        # 'int8': EVERY page stored as (int8, per-slot scale) — the
+        # pool arrays are physically ~half the fp bytes, decode reads
+        # through the existing dequant path. 'pressure': pages stay
+        # full-precision while hot; pages parked in the evictable LRU
+        # are COMPACTED to the int8 tier instead of freed — under a
+        # byte budget (kv_quant_budget=) at allocation, and whenever a
+        # pool_bytes_per_device incident delivered through
+        # QoSScheduler.note_incident stays open (capacity degradation
+        # one rung BEFORE any shedding tier). kv_quant=None is
+        # byte-identical to every earlier PR.
+        if kv_quant not in (None, "int8", "pressure"):
+            raise ValueError(f"kv_quant {kv_quant!r}: use None, "
+                             "'int8' or 'pressure'")
+        self.kv_quant = kv_quant
+        if kv_quant_budget is not None:
+            if kv_quant != "pressure":
+                raise ValueError(
+                    "kv_quant_budget= only means something under "
+                    "kv_quant='pressure' (the stored-byte ceiling "
+                    "allocation-time compaction defends); an "
+                    "always-int8 pool is already small")
+            if kv_quant_budget <= 0:
+                raise ValueError("kv_quant_budget must be > 0 bytes")
+        self.kv_quant_budget = kv_quant_budget
+        self._ctr_compactions = None
+        self._ctr_quant_flips = None
+        if kv_quant == "pressure":
+            if spec is not None:
+                raise ValueError(
+                    "kv_quant='pressure' does not compose with spec= "
+                    "— the draft pool rides the target's page ids "
+                    "but carries no page-tier mask (use "
+                    "kv_quant='int8')")
+            if tp is not None:
+                raise ValueError(
+                    "kv_quant='pressure' does not compose with tp= — "
+                    "the (P,) page-tier mask is a whole-pool jit "
+                    "input with no kv-head axis to shard (use "
+                    "kv_quant='int8')")
+            # pressure-tier serving is paged-only, exactly like tp:
+            # the dense wave cache has no page tiers to compact
+            policy = _coerce_paged_only(
+                policy, "under kv_quant='pressure'",
+                "the dense wave cache has no page tiers")
+            # created ONLY when the pressure tier is configured, so
+            # plain and always-int8 runs leave no trace of them in
+            # the registry (PR-5 convention)
+            _qc = obs_metrics.REGISTRY.counter
+            self._ctr_compactions = _qc(
+                "serving_kv_compactions_total",
+                "parked full-precision pages compacted to the int8 "
+                "tier")
+            self._ctr_quant_flips = {
+                to: _qc("serving_kv_quant_flips_total",
+                        "pressure-tier actuation flips by direction",
+                        to=to)
+                for to in ("on", "off")}
         if serving.chunked_prefill_ is None:
             raise ValueError("the engine needs a chunked-prefill paged "
                              "backend (llama_serving_decode_factory("
@@ -806,6 +903,13 @@ class ServingEngine:
             # overload_active() probe answers — tracked only when a
             # consumer is armed (the PR-11 hardening discipline)
             scheduler.track_overload = True
+        if kv_quant == "pressure" and scheduler is not None \
+                and hasattr(scheduler, "track_pressure"):
+            # same seam, one rung lower: note_incident then tracks
+            # open pool_bytes_per_device incidents so the pressure
+            # gate's pressure_active() probe answers — compaction
+            # fires before any shedding tier would
+            scheduler.track_pressure = True
         self.admission = admission or BatchingConfig()
         self._trace_spec = trace
         # ``slo``: None (off — zero monitor work, the default), an
@@ -924,9 +1028,14 @@ class ServingEngine:
         # byte-identical).
         self._pool_bytes: Optional[Tuple[int, int]] = None
         self._g_pool_bytes = None
-        if tp is not None:
-            total = sum(int(getattr(a, "nbytes", 0))
-                        for a in jax.tree_util.tree_leaves(self._pools))
+        if tp is not None or kv_quant is not None:
+            # a quantizing factory prices its own pool (the sim's
+            # token pools model the int8 layout arithmetically; the
+            # real factory's leaves ARE the small arrays)
+            tfn = getattr(serving, "pool_total_bytes", None)
+            total = int(tfn(self._pools)) if tfn is not None \
+                else sum(int(getattr(a, "nbytes", 0))
+                         for a in jax.tree_util.tree_leaves(self._pools))
             fn = getattr(serving, "pool_device_bytes", None)
             per_dev = int(fn(self._pools)) if fn is not None \
                 else tree_device_bytes(self._pools)
@@ -947,11 +1056,154 @@ class ServingEngine:
         """Stamp the run bookkeeper with the REAL pool's byte census
         and stream the per-device signal to any attached SLO monitor
         (``pool_bytes_per_device`` — a ThresholdRule can watch it).
-        No-op unsharded: cache_stats/metrics stay byte-identical."""
+        No-op unsharded and unquantized: cache_stats/metrics stay
+        byte-identical. With kv_quant= the bookkeeper is also armed
+        with the tier pricing/compaction hooks here (one seam for
+        run(), _run_scheduled() and sessions), and under 'pressure'
+        the streamed signal is the LOGICAL stored-byte census —
+        occupied pages priced by tier — not the static arena size:
+        it moves as rows land and parked pages compact, which is
+        exactly what a ThresholdRule needs to watch."""
+        if self.kv_quant is not None:
+            m.on_kv_quant(self.kv_quant)
+            self._arm_quant(book)
         if self._pool_bytes is None:
             return
         book.note_pool_bytes(*self._pool_bytes)
+        if self.kv_quant == "pressure":
+            sb = book.stored_bytes()
+            if sb is not None:
+                per = int(sb) // self.tp_size
+                m.on_pool_bytes(t, per)
+                self._g_pool_bytes.set(float(per))
+            return
         m.on_pool_bytes(t, self._pool_bytes[1])
+
+    def _arm_quant(self, book: PagedKVCache):
+        """Arm the run bookkeeper's tier census + compaction hooks:
+        per-page byte pricing from the factory, the allocation-time
+        byte budget, and (pressure) the device-side callback the book
+        invokes whenever pages compact — it rebinds the live pools
+        through the donating ``compact_pages`` program, so budget-
+        driven and incident-driven compaction mutate the device
+        arrays through ONE path."""
+        pb = getattr(self.serving, "page_bytes_", None)
+        cb = None
+        if self.kv_quant == "pressure":
+            compact = getattr(self.serving, "compact_pages", None)
+            if compact is not None:
+                wants_np = getattr(self.serving, "wants_numpy_", False)
+
+                def cb(ids, _c=compact, _np=wants_np):
+                    mask = np.zeros(self.n_pool_pages, dtype=bool)
+                    mask[np.asarray(list(ids), dtype=np.int64)] = True
+                    self._pools = _c(self._pools,
+                                     mask if _np else jnp.asarray(mask))
+        book.note_kv_quant(
+            self.kv_quant,
+            fp_bytes_per_page=(pb[0] if pb is not None else None),
+            q_bytes_per_page=(pb[1] if pb is not None else None),
+            byte_budget=self.kv_quant_budget, compact_cb=cb)
+
+    def _make_quant_state(self) -> Optional[dict]:
+        """Fresh pressure-actuation state per run/session (tier off,
+        empty flip log — two seeded replays flip and compact
+        identically), or None unless kv_quant='pressure'."""
+        if self.kv_quant != "pressure":
+            return None
+        return {"enabled": False, "flips": [],
+                "compactions": 0, "pages_compacted": 0}
+
+    def _wire_pressure(self, mon, sched):
+        """The pressure seam, auto-wired like ``_wire_spec_overload``:
+        with kv_quant='pressure', a QoS scheduler and an SLO monitor
+        all configured, every incident the monitor opens is delivered
+        to ``QoSScheduler.note_incident`` — a
+        ``pool_bytes_per_device`` ThresholdRule firing then flips the
+        compaction tier until it closes. Idempotent across runs."""
+        if mon is None or sched is None or self.kv_quant != "pressure" \
+                or not hasattr(sched, "note_incident"):
+            return
+        if hasattr(sched, "track_pressure"):
+            sched.track_pressure = True
+        if sched.note_incident not in mon._cbs:
+            mon.subscribe(sched.note_incident)
+
+    def _quant_flip(self, qst: dict, m, clock, tr, enabled: bool,
+                    rule: str):
+        """One deterministic pressure-tier flip on the virtual clock,
+        with the rule that fired (the ``explain=`` discipline —
+        mirrors ``_spec_flip``)."""
+        qst["enabled"] = enabled
+        qst["flips"].append({"t": round(clock.now(), 6),
+                             "enabled": enabled, "rule": rule})
+        m.on_kv_quant_flip(enabled)
+        self._ctr_quant_flips["on" if enabled else "off"].inc()
+        if tr is not None:
+            tr.instant("kv_quant_flip", t=clock.now(), track="engine",
+                       enabled=enabled, rule=rule)
+
+    def _quant_turn(self, book: PagedKVCache, m, clock, tr,
+                    qst: Optional[dict]):
+        """Per-turn pressure bookkeeping, evaluated where the pool
+        census is sampled: stream the stored-byte signal, flip the
+        tier on the scheduler's open-incident probe, and while it is
+        ON compact every page parked in the evictable LRU (capacity
+        degradation first — the shedding tiers stay untouched, and a
+        page freed by compaction is a request NOT shed). No-op unless
+        kv_quant='pressure' (qst is None otherwise)."""
+        if qst is None:
+            return
+        t = clock.now()
+        sb = book.stored_bytes()
+        if sb is not None:
+            per = int(sb) // self.tp_size
+            m.on_pool_bytes(t, per)
+            if self._g_pool_bytes is not None:
+                self._g_pool_bytes.set(float(per))
+        sched = self.scheduler
+        active = (sched is not None
+                  and getattr(sched, "pressure_active", None)
+                  is not None and sched.pressure_active())
+        if active and not qst["enabled"]:
+            self._quant_flip(
+                qst, m, clock, tr, True,
+                "pool_bytes_per_device incident open via "
+                "QoSScheduler.note_incident — compact parked pages "
+                "before any shedding tier fires")
+        elif not active and qst["enabled"]:
+            self._quant_flip(
+                qst, m, clock, tr, False,
+                "pool-byte incident closed (stored bytes back under "
+                "threshold)")
+        if qst["enabled"]:
+            ids = book.compact_evictable()
+            if ids:
+                qst["compactions"] += 1
+                qst["pages_compacted"] += len(ids)
+                m.on_compaction(t, len(ids))
+                self._ctr_compactions.inc(len(ids))
+                if tr is not None:
+                    tr.instant("kv_compaction", t=t, track="engine",
+                               pages=len(ids))
+
+    def _quant_result(self, book: PagedKVCache,
+                      qst: Optional[dict]) -> Optional[dict]:
+        """The ``ServeResult.kv_quant_stats`` block (None at
+        kv_quant=None — the pre-quant result shape)."""
+        if self.kv_quant is None:
+            return None
+        cs = book.cache_stats()
+        out = {"mode": self.kv_quant,
+               "quantized_pages": cs.get("quantized_pages", 0),
+               "compactions": cs.get("compactions", 0)}
+        sb = book.stored_bytes()
+        if sb is not None:
+            out["stored_bytes"] = int(sb)
+        if qst is not None:
+            out["flips"] = list(qst["flips"])
+            out["pages_compacted"] = qst["pages_compacted"]
+        return out
 
     @property
     def _pools(self):
@@ -1320,6 +1572,7 @@ class ServingEngine:
         self._note_pool(book, m)
         acache = self._make_adapter_cache()
         spst = self._make_spec_state()
+        qst = self._make_quant_state()
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         waiting: List[Request] = []
@@ -1446,6 +1699,7 @@ class ServingEngine:
                         targets.append(waiting[0].arrival
                                        + self.admission.max_delay)
                     clock.advance_to(min(targets))
+                self._quant_turn(book, m, clock, tr, qst)
                 inv_ok &= book.census_ok()
                 if acache is not None:
                     a_inv &= acache.census_ok()
@@ -1471,7 +1725,9 @@ class ServingEngine:
                                dict(acache.cache_stats(),
                                     invariant_ok=a_inv)),
                            spec_stats=(None if spst is None
-                                       else spst.stats()))
+                                       else spst.stats()),
+                           kv_quant_stats=self._quant_result(book,
+                                                             qst))
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -1514,12 +1770,14 @@ class ServingEngine:
                                **est_kw)
         mon = self._make_monitor()
         self._wire_spec_overload(mon, sched)
+        self._wire_pressure(mon, sched)
         m = MetricsCollector(monitor=mon)
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
         self._note_pool(book, m)
         acache = self._make_adapter_cache()
         spst = self._make_spec_state()
+        qst = self._make_quant_state()
         pages_total = len(book._free)
         pending = deque(sorted(trace, key=lambda r: (r.arrival, r.rid)))
         active: Dict[str, _PagedRow] = {}
@@ -1684,6 +1942,7 @@ class ServingEngine:
                     if not targets:
                         break  # everything left this turn was shed
                     clock.advance_to(min(targets))
+                self._quant_turn(book, m, clock, tr, qst)
                 inv_ok &= book.census_ok()
                 if acache is not None:
                     a_inv &= acache.census_ok()
@@ -1711,7 +1970,9 @@ class ServingEngine:
                                dict(acache.cache_stats(),
                                     invariant_ok=a_inv)),
                            spec_stats=(None if spst is None
-                                       else spst.stats()))
+                                       else spst.stats()),
+                           kv_quant_stats=self._quant_result(book,
+                                                             qst))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -2562,9 +2823,13 @@ class EngineSession:
         # per-session spec-route state (multi-replica: each replica
         # EWMAs its own acceptance and flips independently)
         self.spst = eng._make_spec_state()
+        # per-session pressure-tier state (each replica watches its
+        # own pool's byte census and flips/compacts independently)
+        self.qst = eng._make_quant_state()
         self.pages_total = len(self.book._free)
         self.sched = eng.scheduler
         eng._wire_spec_overload(slo, self.sched)
+        eng._wire_pressure(slo, self.sched)
         self.est: Optional[ServiceEstimator] = None
         if self.sched is not None:
             self.sched.reset()
@@ -2857,11 +3122,20 @@ class EngineSession:
         ids = book.export_chain(sid, len(r.prompt))
         n_exp = len(ids)
         data = eng.export_kv_pages(ids)
+        q_idx: Tuple[int, ...] = ()
+        if eng.kv_quant == "pressure":
+            # the exported slices carry the device tier bits; the
+            # chain POSITIONS in the int8 tier ride the handoff so
+            # the importer can mirror them into its own bookkeeper
+            # (pool page ids are meaningless across pools)
+            q_idx = tuple(i for i, p in enumerate(ids)
+                          if p in book._quant)
         self.handoff_ready.append(KVHandoff(
             req=r, first_tok=int(first_tok), n_pages=n_exp,
             kv_data=data, n_cached=n_cached, t_admit=t_admit,
             t_first=t, t_ready=t, replica_from=self.replica,
-            page_size=eng.page_size, tp=eng.tp_size))
+            page_size=eng.page_size, tp=eng.tp_size,
+            kv_quant=eng.kv_quant, quant_pages=q_idx))
         book.free(sid)
         eng._g_resident.set(float(len(book._refs)))
         if self.acache is not None and r.adapter is not None:
@@ -2918,6 +3192,13 @@ class EngineSession:
             h = min(ready, key=lambda x: (x.t_arrive, x.req.rid))
             r = h.req
             sid = r.rid
+            if h.kv_quant != eng.kv_quant:
+                raise RuntimeError(
+                    f"handoff {sid!r} was exported under kv_quant="
+                    f"{h.kv_quant!r} but this decode worker runs "
+                    f"kv_quant={eng.kv_quant!r} — the page data is "
+                    "tier-shaped, so disaggregated placement must "
+                    "filter on kv_quant like page_size/tp")
             aslot, a_up = 0, False
             if r.adapter is not None:
                 if self.acache is None:
@@ -2959,6 +3240,12 @@ class EngineSession:
             book.lengths[sid] = len(r.prompt)
             eng.import_kv_pages(book.tables[sid][:h.n_pages],
                                 h.kv_data)
+            if h.kv_quant == "pressure" and h.quant_pages:
+                # the scattered data restored the device tier bits;
+                # mirror them in this pool's bookkeeper so the byte
+                # census prices the adopted chain by its real tier
+                tbl = book.tables[sid]
+                book.mark_quantized([tbl[i] for i in h.quant_pages])
             if eng.prefix_cache:
                 # the imported prompt pages hold real K/V: publish
                 # them, so sharers landing on this decode worker hit
@@ -3131,6 +3418,7 @@ class EngineSession:
                                    self.outputs, tr=tr,
                                    acache=self.acache)
             progressed = True
+        eng._quant_turn(self.book, m, clock, tr, self.qst)
         self.inv_ok &= self.book.census_ok()
         if self.acache is not None:
             self.a_inv_ok &= self.acache.census_ok()
@@ -3320,5 +3608,7 @@ class EngineSession:
                 dict(self.acache.cache_stats(),
                      invariant_ok=self.a_inv_ok)),
             spec_stats=(None if self.spst is None
-                        else self.spst.stats()))
+                        else self.spst.stats()),
+            kv_quant_stats=self.eng._quant_result(self.book,
+                                                  self.qst))
         return self._finished

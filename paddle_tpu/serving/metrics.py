@@ -121,6 +121,12 @@ class MetricsCollector:
         # ran, so plain traces — and spec=None replays — keep their
         # records byte-identical (the PR-5 presence convention)
         self._spec = {"rounds": 0, "proposed": 0, "accepted": 0}
+        # quantized-page-tier totals (engine-fed); the report grows
+        # its kv_quant block ONLY when a quantized mode is armed, so
+        # kv_quant=None runs keep their records byte-identical (the
+        # PR-5 presence convention)
+        self._kv_quant = {"mode": None, "flips": 0, "compactions": 0,
+                          "pages": 0}
         # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
         # request's FINAL record at finish/shed plus queue/lane depth
         # samples — the one seam through which the streaming SLO layer
@@ -250,6 +256,23 @@ class MetricsCollector:
         if self._mon is not None:
             self._mon.observe_value("pool_bytes_per_device",
                                     per_device_bytes, t)
+
+    def on_kv_quant(self, mode: str):
+        """A quantized page tier is armed for this run (``"int8"`` or
+        ``"pressure"``): the report grows its kv_quant block. Called
+        once by the engine at run setup."""
+        self._kv_quant["mode"] = mode
+
+    def on_kv_quant_flip(self, enabled: bool):
+        """The pressure tier flipped (on or off) — one deterministic
+        actuation of the pool-byte incident."""
+        self._kv_quant["flips"] += 1
+
+    def on_compaction(self, t: float, pages: int):
+        """One compaction batch: ``pages`` parked pages quantized to
+        int8 (their prefix keys intact — nothing was forgotten)."""
+        self._kv_quant["compactions"] += 1
+        self._kv_quant["pages"] += int(pages)
 
     def forget(self, rid: str):
         """Erase every trace of ``rid`` from this collector — the
@@ -408,6 +431,19 @@ class MetricsCollector:
             rec["draft_tokens_proposed"] = self._spec["proposed"]
             rec["draft_tokens_wasted"] = (self._spec["proposed"]
                                           - self._spec["accepted"])
+        if self._kv_quant["mode"] is not None:
+            # quantized-page-tier block, present only when a kv_quant
+            # mode is armed (same convention): kv_quant=None replays
+            # stay byte-identical to PR 14
+            rec["kv_quant"] = self._kv_quant["mode"]
+            rec["kv_quant_flips"] = self._kv_quant["flips"]
+            rec["kv_compactions"] = self._kv_quant["compactions"]
+            rec["kv_pages_compacted"] = self._kv_quant["pages"]
+            if self._pool_dev_bytes is not None:
+                # the dynamic stored-bytes census the pressure rule
+                # watches (actual stored: quantized pages priced at
+                # int8+scale size)
+                rec["pool_bytes_per_device"] = self._pool_dev_bytes
         if slo_ttft is not None and ttfts:
             rec["slo_ttft"] = slo_ttft
             rec["slo_ttft_attained"] = round(

@@ -209,6 +209,15 @@ class QoSScheduler:
         # draft compute is waste exactly when capacity is scarce.
         self.track_overload = False
         self._overload_open: List = []
+        # pool-byte pressure tracking for the QUANTIZED page tier
+        # (``ServingEngine(kv_quant='pressure')`` arms it; same
+        # tracked-only-when-armed discipline): while any incident
+        # whose evidence names the ``pool_bytes_per_device`` signal
+        # stays open, ``pressure_active()`` answers True and the
+        # engine compacts parked pages to int8 — compaction before
+        # shedding, the gentler rung below the degrade clamp.
+        self.track_pressure = False
+        self._pressure_open: List = []
         self.reset()
 
     # --- state ------------------------------------------------------------
@@ -224,6 +233,7 @@ class QoSScheduler:
         self._q: Dict[str, _Entry] = {}
         self._tags: Dict[str, float] = {}
         self._overload_open = []
+        self._pressure_open = []
 
     def note_incident(self, incident):
         """``obs.slo`` incident callback: record that an SLO incident
@@ -241,6 +251,26 @@ class QoSScheduler:
                 self._page_open.append(incident)
             if self.track_overload:
                 self._overload_open.append(incident)
+        if self.track_pressure and isinstance(
+                getattr(incident, "evidence", None), dict) \
+                and incident.evidence.get("signal") \
+                == "pool_bytes_per_device":
+            # any severity qualifies: compaction is the low-regret
+            # rung, worth taking on a warn-level byte breach before
+            # anything pages
+            self._pressure_open.append(incident)
+
+    def pressure_active(self) -> bool:
+        """True while any pool-byte-pressure incident delivered
+        through ``note_incident`` is still open (armed via
+        ``track_pressure``; always False untracked). The quantized
+        page tier's trigger: closed incidents prune lazily, so
+        compaction stops the moment stored bytes recover below the
+        rule's bound."""
+        if self._pressure_open:
+            self._pressure_open = [i for i in self._pressure_open
+                                   if getattr(i, "open", False)]
+        return bool(self._pressure_open)
 
     def overload_active(self) -> bool:
         """True while any page-severity incident delivered through

@@ -69,7 +69,8 @@ class SimServing:
                  vocab: int = 509, salt: int = 0,
                  chunked_prefill: int | None = None, tp=None,
                  lora_slots: int | None = None,
-                 spec_accept: float | None = None):
+                 spec_accept: float | None = None,
+                 kv_quant: str | None = None):
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {page_size}")
@@ -98,6 +99,23 @@ class SimServing:
         # is ``{"salt": int}`` (or a bare int).
         self.lora_ = None if lora_slots is None \
             else LoRAConfig(n_slots=int(lora_slots), rank=1)
+        # ``kv_quant``: the sim's QUANTIZED-PAGE-TIER stand-in. The
+        # token pool is lossless content (int64 tokens have no numerics
+        # to degrade — greedy parity with the unquantized sim is EXACT,
+        # which is precisely what makes the engine/cluster bookkeeping
+        # testable at 10^5 scale), but the factory advertises the mode
+        # (``kv_quant_``), per-page prices (``page_bytes_``: a
+        # synthetic fp row vs an int8+scale row) and a no-op
+        # ``compact_pages``, so the ENGINE machinery — stored-bytes
+        # census, pressure incidents, compaction batches, handoff tier
+        # tags — runs for real. Accuracy claims live with the real
+        # factory.
+        if kv_quant not in (None, "int8", "pressure"):
+            raise ValueError(f"kv_quant {kv_quant!r}: use None, "
+                             "'int8' or 'pressure'")
+        self.kv_quant_ = kv_quant
+        self.page_bytes_ = None if kv_quant is None else \
+            (page_size * 8, page_size * 4 + 4)
         self.dense = PagedOnlyDense(_SIM_DENSE_REASON)
         if vocab < 3:
             raise ValueError("vocab must be >= 3")
@@ -327,11 +345,29 @@ class SimServing:
         decode_n._cache_size = lambda: 0
         return decode_n
 
+    def pool_total_bytes(self, pools) -> int:
+        """The pool's byte footprint as STORED: the sim's token pool
+        is physically int64 whatever the codec, so under
+        kv_quant='int8' the price is the advertised int8+scale row
+        cost, not the host array's nbytes — the arithmetic the real
+        int8 factory gets for free from its int8 leaves."""
+        if self.kv_quant_ == "int8":
+            return self.n_pool_pages_ * self.page_bytes_[1]
+        return int(np.asarray(pools).nbytes)
+
     def pool_device_bytes(self, pools) -> int:
         """One device's share of the pool under the advertised tp
         degree (the engine's per-device byte census hook)."""
         size = self.tp_.size if self.tp_ is not None else 1
-        return int(np.asarray(pools).nbytes) // size
+        return self.pool_total_bytes(pools) // size
+
+    @staticmethod
+    def compact_pages(pools, mask):
+        """Pressure-tier compaction, sim edition: token content is
+        lossless so the pool is untouched — the BOOKKEEPING (tier
+        sets, stored-bytes census, compaction counters) is what the
+        engine exercises here."""
+        return pools
 
     # --- KV handoff data plane ---------------------------------------------
     @staticmethod

@@ -121,3 +121,68 @@ class TestPTQ:
         out = qm(paddle.to_tensor(x)).numpy()
         rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
         assert rel < 0.1
+
+
+class TestActScalePlumbing:
+    """The shared int8 GEMM's activation-scale plumbing (the same
+    kernel the int8 serving KV tier and compiled decode ride):
+    ``int8_matmul(act_scale=)`` must honor a calibrated static scale
+    exactly, and ``convert_to_int8(act_scales=)`` must deliver scales
+    to NESTED sublayers by dotted path."""
+
+    def test_static_scale_matches_dynamic_at_absmax(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization.int8 import QMAX, int8_matmul
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (4, 16)).astype(np.float32)
+        w = rng.normal(0, 1, (16, 8)).astype(np.float32)
+        q, s = quantize_weight_per_channel(w, axis=1)
+        dyn = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q),
+                                     jnp.asarray(s[0])))
+        # a static scale equal to the dynamic rule's abs-max scale is
+        # the SAME quantization: bit-equal outputs
+        sx = float(np.abs(x).max()) / QMAX
+        stat = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q),
+                                      jnp.asarray(s[0]),
+                                      act_scale=sx))
+        assert (dyn == stat).all()
+        # a different calibrated scale changes the grid: the argument
+        # is live, not decorative
+        other = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q),
+                                       jnp.asarray(s[0]),
+                                       act_scale=sx / 4))
+        assert not (other == dyn).all()
+
+    def test_int8_matmul_error_bound(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization.int8 import int8_matmul
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (8, 32)).astype(np.float32)
+        w = rng.normal(0, 1, (32, 16)).astype(np.float32)
+        q, s = quantize_weight_per_channel(w, axis=1)
+        out = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q),
+                                     jnp.asarray(s[0])))
+        ref = x @ w
+        max_rel = np.abs(out - ref).max() / np.abs(ref).max()
+        mean_rel = np.abs(out - ref).mean() / np.abs(ref).mean()
+        assert max_rel < 0.06
+        assert mean_rel < 0.02
+
+    def test_convert_act_scales_nested_paths(self):
+        m = nn.Sequential(nn.Linear(16, 8), nn.ReLU(),
+                          nn.Sequential(nn.Linear(8, 4)))
+        m.eval()
+        x = np.random.default_rng(2).normal(
+            0, 1, (4, 16)).astype(np.float32)
+        ref = m(paddle.to_tensor(x)).numpy()
+        qm = convert_to_int8(m, act_scales={"2.0": 0.05})
+        # the nested layer got its calibrated scale by dotted path;
+        # the un-calibrated top-level layer fell back to dynamic
+        assert qm[0].act_scale is None
+        assert qm[2][0].act_scale == 0.05
+        assert isinstance(qm[2][0], Int8Linear)
+        out = qm(paddle.to_tensor(x)).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert rel < 0.15

@@ -1,0 +1,914 @@
+"""Quantized paged KV serving: the int8 page tier.
+
+The claims: ``kv_quant='int8'`` stores EVERY pool page as int8 data +
+per-slot f32 scales (the serving spelling of kv_cache_dtype='int8'),
+halving-or-better the per-device pool byte census at equal page count
+— so one HBM budget holds more pages; ``kv_quant='pressure'`` keeps
+hot pages full precision and compacts pages parked in the evictable
+LRU to int8 instead of freeing them — triggered by a byte budget at
+allocation time and by a ``pool_bytes_per_device`` ThresholdRule
+incident delivered through ``QoSScheduler.note_incident`` (capacity
+degradation one rung BEFORE any shedding tier), with every flip and
+compaction batch deterministic on the virtual clock; the quantized
+tier is an OVERLAY on the resident+evictable+free census (never a
+fourth state, dies with a recycled page id — the wrong-context-KV
+hazard); disaggregated handoffs carry the tier; ``kv_quant=None``
+stays byte-identical to the pre-quant engine (outputs, reports,
+registry); and the ``serving_quant`` bench-gate family passes its
+pass rows and fails its FAIL rows.
+"""
+import dataclasses as dc
+import json
+import os
+import sys
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.nlp.llama_decode import (
+    compact_kv_pages, export_quant_pages, import_quant_pages,
+    kv_quant_page_bytes, llama_serving_decode_factory)
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs.slo import ThresholdRule
+from paddle_tpu.ops.pallas.paged_attention import PagedKVCache
+from paddle_tpu.serving import (ClusterRouter, QoSScheduler, Request,
+                                ServingEngine, make_sim_serving,
+                                synthesize_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill_unit": 1.0, "decode": 1.0}
+
+
+def _sim_engine(kv_quant=None, slots=8, n_pool_pages=None, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", dict(COSTS))
+    return ServingEngine(
+        serving=make_sim_serving(
+            max_len=64, page_size=8, slots=slots, vocab=509,
+            n_pool_pages=(n_pool_pages if n_pool_pages is not None
+                          else slots * 8 + 1 + 16),
+            kv_quant=kv_quant),
+        slots=slots, policy="paged", **kw)
+
+
+def _churn_trace(seed=0, n=40):
+    return synthesize_trace(
+        seed=seed, n_requests=n, arrival="poisson",
+        mean_interarrival=0.5, prompt_len=(4, 16), output_len=(8, 24),
+        vocab_size=509, shared_prefix_frac=0.3, prefix_len=8,
+        churn_frac=0.2, rid_prefix="m")
+
+
+# --- bookkeeper: the quantized tier overlay -----------------------------
+
+
+def test_note_kv_quant_validation():
+    book = PagedKVCache(8, 4, 1, 8)
+    assert book.stored_bytes() is None  # unpriced until armed
+    with pytest.raises(ValueError, match="unknown mode"):
+        book.note_kv_quant("fp4")
+    book.note_kv_quant("int8", fp_bytes_per_page=100,
+                       q_bytes_per_page=30)
+    book.allocate("a", 8)  # 2 pages, every one priced int8
+    assert book.stored_bytes() == 60
+
+
+def test_mark_quantized_requires_occupied():
+    book = PagedKVCache(8, 4, 1, 8)
+    book.note_kv_quant("pressure", 100, 30)
+    book.allocate("a", 4)
+    p = book.tables["a"][0]
+    book.mark_quantized([p])
+    assert book.quantized_pages() == {p}
+    with pytest.raises(ValueError, match="not occupied"):
+        book.mark_quantized([7])  # a free page has no content to tier
+    # quantized_pages is a snapshot, not the live set
+    book.quantized_pages().add(99)
+    assert 99 not in book.quantized_pages()
+
+
+def test_stored_bytes_tier_pricing():
+    """The dynamic pressure signal: occupied pages priced by tier,
+    shrinking on compaction, zeroed when the page frees."""
+    book = PagedKVCache(8, 4, 1, 8)
+    book.note_kv_quant("pressure", fp_bytes_per_page=100,
+                       q_bytes_per_page=30)
+    book.allocate("a", 8)
+    assert book.stored_bytes() == 200
+    book.mark_quantized([book.tables["a"][0]])
+    assert book.stored_bytes() == 130
+    book.free("a")  # unpublished: pages free, the tier dies with them
+    assert book.stored_bytes() == 0
+    assert book.quantized_pages() == set()
+    assert book.census_ok()
+
+
+def test_compact_evictable_parks_not_forgets():
+    """Compaction spends the evictable LRU oldest-first through the
+    device callback, keeps keys live (the chains still match and
+    revive), and the census never moves — nothing is forgotten."""
+    ps = 4
+    book = PagedKVCache(8, ps, 1, 8)
+    calls = []
+    book.note_kv_quant("pressure", 100, 30, compact_cb=calls.append)
+    X = list(range(10, 10 + ps))
+    Y = list(range(20, 20 + ps))
+    book.acquire_prefix("a", X + Y)
+    book.allocate("a", 2 * ps)
+    book.register_prefix("a", X + Y)
+    book.free("a")  # both published pages park in the LRU
+    cands = book.compact_candidates()
+    assert len(cands) == 2
+    ids = book.compact_evictable(max_pages=1)
+    assert ids == cands[:1] and calls == [ids]
+    assert book.quantized_pages() == set(ids)
+    assert book.compact_candidates() == cands[1:]  # never re-spent
+    book.compact_evictable()
+    assert book.quantized_pages() == set(cands)
+    assert book.cache_stats()["compactions"] == 2
+    assert book.census_ok()
+    # keys stayed live: the chain revives WITH its tier intact
+    assert book.match_prefix(X + Y) == 2 * ps
+    assert book.acquire_prefix("b", X + Y) == 2 * ps
+    assert book.quantized_pages() == set(cands)
+    assert book.census_ok()
+
+
+def test_allocate_compacts_under_byte_budget():
+    """Byte-budget admission: compaction before refusal, and a
+    genuine refusal mutates nothing."""
+    ps = 4
+    book = PagedKVCache(8, ps, 1, 8)
+    book.note_kv_quant("pressure", fp_bytes_per_page=100,
+                       q_bytes_per_page=20, byte_budget=320)
+    X = list(range(10, 10 + ps))
+    book.acquire_prefix("a", X)
+    book.allocate("a", ps)
+    book.register_prefix("a", X)
+    book.free("a")  # one parked fp page: 100 stored bytes
+    book.allocate("b", 2 * ps)  # projected 300 <= 320: no compaction
+    assert book.quantized_pages() == set()
+    book.allocate("c", ps)  # projected 400 > 320: compact the parked
+    assert len(book.quantized_pages()) == 1
+    assert book.stored_bytes() == 320
+    assert book.census_ok()
+    before = (list(book._free), dict(book._refs),
+              set(book._quant), book.stored_bytes())
+    with pytest.raises(MemoryError, match="byte budget"):
+        book.allocate("d", ps)  # nothing left to compact
+    assert (list(book._free), dict(book._refs),
+            set(book._quant), book.stored_bytes()) == before
+
+
+def test_eviction_recycling_clears_tier():
+    """The wrong-context-KV regression, int8 edition: a recycled page
+    id must never read stale int8 content or match stale chains."""
+    ps = 4
+    book = PagedKVCache(4, ps, 1, 8)  # 3 usable pages
+    book.note_kv_quant("pressure", 100, 30)
+    X = list(range(10, 10 + ps))
+    book.acquire_prefix("a", X)
+    book.allocate("a", ps)
+    book.register_prefix("a", X)
+    book.free("a")
+    pX = next(iter(book._evictable))
+    book.compact_evictable()
+    assert pX in book.quantized_pages()
+    book.allocate("b", 3 * ps)  # pressure: the parked page recycles
+    assert pX in book.tables["b"]
+    assert pX not in book.quantized_pages()
+    assert book.match_prefix(X) == 0
+    assert book.census_ok()
+
+
+def test_purge_clears_both_tiers():
+    book = PagedKVCache(8, 4, 1, 8)
+    book.note_kv_quant("pressure", 100, 30)
+    book.allocate("a", 8)
+    book.mark_quantized(book.tables["a"])
+    e0 = book.epoch
+    book.purge()
+    assert book.quantized_pages() == set()
+    assert book.stored_bytes() == 0
+    assert book.census_ok() and book.epoch == e0 + 1
+    cs = book.cache_stats()
+    assert cs["free_pages"] == 7 and cs["quantized_pages"] == 0
+
+
+def test_cache_stats_quant_bucket_presence():
+    """PR-5 presence convention at the census: the quantized bucket
+    exists only when a tier is armed; always-int8 counts every
+    occupied page."""
+    plain = PagedKVCache(8, 4, 1, 8)
+    plain.allocate("a", 8)
+    cs = plain.cache_stats()
+    for k in ("quantized_pages", "compactions", "stored_bytes"):
+        assert k not in cs
+    q = PagedKVCache(8, 4, 1, 8)
+    q.note_kv_quant("int8", 100, 30)
+    q.allocate("a", 8)
+    cs = q.cache_stats()
+    assert cs["quantized_pages"] == 2
+    assert cs["stored_bytes"] == 60
+    assert q.census_ok()
+
+
+# --- scheduler pressure seam --------------------------------------------
+
+
+class _Inc:
+    severity = "warn"
+
+    def __init__(self, signal="pool_bytes_per_device"):
+        self.open = True
+        self.evidence = {"signal": signal}
+
+
+def test_scheduler_pressure_seam_unit():
+    s = QoSScheduler()
+    s.note_incident(_Inc())        # untracked: ignored
+    assert not s.pressure_active()
+    s.track_pressure = True
+    s.note_incident(_Inc("queue_depth"))  # wrong signal: ignored
+    assert not s.pressure_active()
+    inc = _Inc()                   # warn severity qualifies: the
+    s.note_incident(inc)           # compaction rung is low-regret
+    assert s.pressure_active()
+    inc.open = False
+    assert not s.pressure_active()  # closed incidents prune lazily
+    s.note_incident(_Inc())
+    assert s.pressure_active()
+    s.reset()                      # per-run monitors die with the run
+    assert not s.pressure_active()
+
+
+# --- sim engine: int8 mode, None identity, report block -----------------
+
+
+def test_sim_int8_parity_bytes_and_result_block():
+    trace = _churn_trace()
+    e_fp = _sim_engine()
+    e_q = _sim_engine(kv_quant="int8")
+    r_fp = e_fp.run(trace)
+    r_q = e_q.run(trace)
+    # the sim's token-hash pools are lossless under any codec: exact
+    # token parity is the sim-scale claim (the real factory's is the
+    # teacher-forced logit bound in the bench)
+    assert r_q.outputs == r_fp.outputs
+    # unsharded + unquantized: no byte census at all (PR-10 shape)
+    assert e_fp.pool_bytes_per_device() is None
+    sim = e_q.serving
+    assert e_q.pool_bytes_per_device() \
+        == sim.page_bytes_[1] * sim.n_pool_pages_
+    st = r_q.kv_quant_stats
+    assert st["mode"] == "int8" and "stored_bytes" in st
+    assert "flips" not in st  # pressure-only keys stay absent
+    assert r_fp.kv_quant_stats is None
+    rep = r_q.report()
+    assert rep["kv_quant"] == "int8"
+    assert rep["kv_quant_flips"] == 0 and rep["kv_compactions"] == 0
+    assert rep["pool_bytes_per_device"] > 0
+    assert r_q.cache_stats["invariant_ok"]
+    assert r_q.cache_stats["quantized_pages"] >= 0
+
+
+def test_kv_quant_none_byte_identity():
+    """The identity clause: kv_quant=None is the pre-quant engine —
+    outputs, slot logs, report keys, registry contents."""
+    obs_metrics.REGISTRY.reset()
+    trace = _churn_trace(seed=2, n=24)
+    plain = _sim_engine().run(trace)
+    again = _sim_engine(kv_quant=None).run(trace)
+    assert again.outputs == plain.outputs
+    assert again.slot_log == plain.slot_log
+    assert again.kv_quant_stats is None
+    rep = again.report()
+    assert json.dumps(rep, sort_keys=True) \
+        == json.dumps(plain.report(), sort_keys=True)
+    for k in ("kv_quant", "kv_quant_flips", "kv_compactions",
+              "kv_pages_compacted", "pool_bytes_per_device"):
+        assert k not in rep
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert not any(n.startswith(("serving_kv_compactions",
+                                 "serving_kv_quant",
+                                 "serving_pool_bytes"))
+                   for n in names)
+
+
+def test_pool_bytes_gauge_reports_actual_stored_bytes():
+    """The PR-10 gauge regression: with a quantized tier the
+    serving_pool_bytes_per_device gauge must price the pool as
+    actually stored — static int8 arena bytes for always-int8, the
+    moving stored-byte census for pressure — not the fp arena size."""
+    obs_metrics.REGISTRY.reset()
+    trace = _churn_trace(seed=3, n=24)
+    e_q = _sim_engine(kv_quant="int8")
+    e_q.run(trace)
+    g = obs_metrics.REGISTRY.gauge(
+        "serving_pool_bytes_per_device",
+        "KV pool bytes resident on one device of the TP mesh")
+    assert g.value == float(e_q.pool_bytes_per_device())
+    res = _sim_engine(kv_quant="pressure").run(trace)
+    # pressure streams the LOGICAL census: the gauge's final sample
+    # is the run-end stored bytes, which the cache census also prices
+    assert g.value == float(res.cache_stats["stored_bytes"])
+    rep = res.report()
+    assert rep["pool_bytes_per_device"] == int(g.value)
+
+
+# --- pressure mode: incidents, flips, compaction, determinism -----------
+
+
+def _pressure_engine(kv_quant="pressure", trace_sink=None):
+    sim = make_sim_serving(max_len=64, page_size=8, n_pool_pages=48,
+                           slots=8, vocab=509, chunked_prefill=8,
+                           kv_quant=kv_quant)
+    return ServingEngine(
+        serving=sim, slots=8, policy="paged", clock="fixed",
+        fixed_costs=dict(COSTS), scheduler=QoSScheduler(),
+        trace=trace_sink,
+        slo=([ThresholdRule(name="pool_pressure",
+                            signal="pool_bytes_per_device",
+                            bound=float(sim.page_bytes_[0] * 20),
+                            op=">=", severity="page")]
+             if kv_quant == "pressure" else None),
+        kv_quant_budget=(sim.page_bytes_[0] * 40
+                         if kv_quant == "pressure" else None))
+
+
+def _pressure_trace():
+    return synthesize_trace(seed=2, n_requests=80, vocab_size=509,
+                            prompt_len=(8, 24), output_len=(4, 12),
+                            shared_prefix_frac=0.3, prefix_len=16,
+                            churn_frac=0.1)
+
+
+def test_pressure_flips_and_compaction_deterministic():
+    """The pressure tentpole at sim scale: the ThresholdRule incident
+    flips the tier on (explain rule named), parked pages compact, the
+    incident closes and the tier flips off — byte-identical across
+    two seeded replays, token streams untouched vs plain."""
+    from paddle_tpu import obs
+    trace = _pressure_trace()
+    tr = obs.Tracer()
+    p1 = _pressure_engine(trace_sink=tr).run(trace)
+    p2 = _pressure_engine().run(trace)
+    pn = _pressure_engine(kv_quant=None).run(trace)
+    qs = p1.kv_quant_stats
+    assert qs["mode"] == "pressure"
+    assert qs["pages_compacted"] > 0 and qs["compactions"] >= 1
+    ons = [f for f in qs["flips"] if f["enabled"]]
+    offs = [f for f in qs["flips"] if not f["enabled"]]
+    assert ons and offs
+    assert all("incident open" in f["rule"] for f in ons)
+    assert all("closed" in f["rule"] for f in offs)
+    assert p1.outputs == p2.outputs
+    assert p1.kv_quant_stats == p2.kv_quant_stats
+    assert p1.outputs == pn.outputs  # compaction is never shedding
+    assert p1.cache_stats["invariant_ok"]
+    assert any(i.rule == "pool_pressure" for i in p1.incidents)
+    rep = p1.report()
+    assert rep["kv_quant"] == "pressure"
+    assert rep["kv_quant_flips"] == len(qs["flips"])
+    assert rep["kv_pages_compacted"] == qs["pages_compacted"]
+    names = {e.get("name") for e in tr.events}
+    assert "kv_quant_flip" in names and "kv_compaction" in names
+
+
+def test_pressure_trace_instants_absent_on_plain():
+    from paddle_tpu import obs
+    tr = obs.Tracer()
+    _sim_engine(trace=tr).run(_churn_trace(seed=4, n=12))
+    names = {e.get("name") for e in tr.events}
+    assert "kv_quant_flip" not in names
+    assert "kv_compaction" not in names
+
+
+def test_pressure_counters_gated_on_config():
+    obs_metrics.REGISTRY.reset()
+    _sim_engine().run(_churn_trace(seed=5, n=12))
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert not any(n.startswith(("serving_kv_compactions",
+                                 "serving_kv_quant"))
+                   for n in names)
+    _pressure_engine().run(_pressure_trace())
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert "serving_kv_compactions_total" in names
+    assert "serving_kv_quant_flips_total" in names
+
+
+def test_pressure_session_matches_run():
+    """EngineSession's incremental drive produces the same streams
+    and compaction evidence as run() (budget-driven compaction: no
+    monitor needed, the allocate seam fires it)."""
+    sim_kw = dict(max_len=64, page_size=8, n_pool_pages=30, slots=4,
+                  vocab=509, kv_quant="pressure")
+
+    def eng():
+        sim = make_sim_serving(**sim_kw)
+        return ServingEngine(serving=sim, slots=4, policy="paged",
+                             clock="fixed", fixed_costs=dict(COSTS),
+                             kv_quant_budget=sim.page_bytes_[0] * 22)
+
+    trace = _churn_trace(seed=6, n=24)
+    run_res = eng().run(trace)
+    sess = eng().session()
+    for r in sorted(trace, key=lambda r: (r.arrival, r.rid)):
+        sess.advance_until(r.arrival)
+        sess.submit(r)
+    res = sess.finish()
+    assert res.outputs == run_res.outputs
+    assert res.kv_quant_stats == run_res.kv_quant_stats
+    assert run_res.kv_quant_stats["compactions"] >= 1
+
+
+# --- engine construction / validation -----------------------------------
+
+
+def test_engine_kv_quant_validation():
+    with pytest.raises(ValueError, match="kv_quant"):
+        make_sim_serving(max_len=64, page_size=8, kv_quant="fp4")
+    # a prebuilt factory's mode is authoritative: a conflicting
+    # engine arg refuses instead of silently re-codec-ing the pool
+    with pytest.raises(ValueError, match="conflicts"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4, kv_quant="int8"),
+            slots=4, policy="paged", kv_quant="pressure")
+    with pytest.raises(ValueError, match="only means something"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4, kv_quant="int8"),
+            slots=4, policy="paged", kv_quant_budget=1 << 20)
+    with pytest.raises(ValueError, match="> 0"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4, kv_quant="pressure"),
+            slots=4, policy="paged", kv_quant_budget=0)
+    from paddle_tpu.models.nlp.llama_decode import SpecConfig
+    with pytest.raises(ValueError, match="spec"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4, spec_accept=0.5,
+                                     kv_quant="pressure"),
+            slots=4, policy="paged", spec=SpecConfig())
+    with pytest.raises(ValueError, match="kv_quant='pressure'"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4, kv_quant="pressure"),
+            slots=4, policy="dense")
+
+
+def test_engine_kv_quant_validation_fp_conflict():
+    with pytest.raises(ValueError, match="conflicts"):
+        ServingEngine(
+            serving=make_sim_serving(max_len=64, page_size=8,
+                                     slots=4),
+            slots=4, policy="paged", kv_quant="int8")
+
+
+def test_prebuilt_factory_mode_adopted():
+    eng = ServingEngine(
+        serving=make_sim_serving(max_len=64, page_size=8, slots=4,
+                                 kv_quant="int8"),
+        slots=4, policy="paged")
+    assert eng.kv_quant == "int8"
+    # naming the matching mode explicitly is also fine
+    eng2 = ServingEngine(
+        serving=make_sim_serving(max_len=64, page_size=8, slots=4,
+                                 kv_quant="int8"),
+        slots=4, policy="paged", kv_quant="int8")
+    assert eng2.kv_quant == "int8"
+
+
+# --- disaggregated handoffs carry the tier ------------------------------
+
+
+def _quant_cluster_engine(kv_quant):
+    def spawn(name):
+        return ServingEngine(
+            serving=make_sim_serving(max_len=96, page_size=8,
+                                     slots=8, vocab=101,
+                                     kv_quant=kv_quant),
+            slots=8, policy="paged", clock="fixed",
+            fixed_costs=dict(COSTS), decode_chunk=4,
+            prefill_chunk_budget=2)
+    return spawn
+
+
+def test_disagg_int8_handoffs_zero_failed():
+    """A quantized chain moves prefill->decode exactly once: both
+    stages on kv_quant='int8', zero FAILED handoffs, streams equal
+    the lone int8 engine's."""
+    trace = synthesize_trace(seed=0, n_requests=24, vocab_size=101,
+                             prompt_len=(4, 16), output_len=(4, 10),
+                             rid_prefix="h")
+    res = ClusterRouter(_quant_cluster_engine("int8"), 2,
+                        placement="disaggregated",
+                        roles={"r0": "prefill", "r1": "decode"},
+                        kv_transfer_unit=0.05).run(trace)
+    cen = res.census()
+    assert cen["conserved"] and cen["pool_census_ok"]
+    assert cen["handoffs"]["failed"] == 0
+    assert cen["handoffs"]["imported"] == len(trace)
+    lone = ServingEngine(
+        serving=make_sim_serving(max_len=96, page_size=8, slots=8,
+                                 vocab=101, kv_quant="int8"),
+        slots=8, policy="paged", clock="fixed",
+        fixed_costs=dict(COSTS), decode_chunk=4).run(trace)
+    outs = res.outputs()
+    assert set(outs) == set(lone.outputs)
+    assert all(outs[r] == lone.outputs[r] for r in outs)
+
+
+def test_disagg_kv_quant_mismatch_filtered():
+    """Placement filters on kv_quant like page_size/tp: an int8
+    prefill worker's chains cannot land on an fp decode worker — the
+    handoffs are recorded FAILED, never a tier-shape crash."""
+    def spawn(name):
+        return ServingEngine(
+            serving=make_sim_serving(
+                max_len=96, page_size=8, slots=8, vocab=101,
+                kv_quant="int8" if name == "r0" else None),
+            slots=8, policy="paged", clock="fixed",
+            fixed_costs=dict(COSTS), decode_chunk=4,
+            prefill_chunk_budget=2)
+    trace = [Request(rid=f"g{i}", arrival=float(i),
+                     prompt=tuple(range(1, 10)), max_new_tokens=4)
+             for i in range(3)]
+    res = ClusterRouter(spawn, 2, placement="disaggregated",
+                        roles={"r0": "prefill", "r1": "decode"},
+                        kv_transfer_unit=0.05).run(trace)
+    cen = res.census()
+    assert cen["conserved"]
+    assert cen["handoffs"]["failed"] == len(trace)
+    assert cen["handoffs"]["imported"] == 0
+
+
+def test_import_refuses_kv_quant_mismatch():
+    """The engine-level guard behind the placement filter: adopting a
+    tier-shaped chain under a different kv_quant raises loudly."""
+    src = ServingEngine(
+        serving=make_sim_serving(max_len=96, page_size=8, slots=8,
+                                 vocab=101, kv_quant="int8"),
+        slots=8, policy="paged", clock="fixed",
+        fixed_costs=dict(COSTS))
+    sess = src.session(role="prefill")
+    sess.submit(Request(rid="x", arrival=0.0,
+                        prompt=tuple(range(1, 10)), max_new_tokens=4))
+    sess.advance_until(1e6)
+    assert sess.handoff_ready
+    h = sess.handoff_ready[0]
+    assert h.kv_quant == "int8"
+    dst = ServingEngine(
+        serving=make_sim_serving(max_len=96, page_size=8, slots=8,
+                                 vocab=101),
+        slots=8, policy="paged", clock="fixed",
+        fixed_costs=dict(COSTS))
+    dsess = dst.session(role="decode")
+    dsess.submit_handoff(h)
+    with pytest.raises(RuntimeError, match="kv_quant"):
+        dsess.advance_until(1e6)
+
+
+def test_import_mirrors_pressure_tier():
+    """A pressure chain's tier positions ride the handoff as CHAIN
+    indices and land in the importer's bookkeeper, so its byte census
+    prices the adopted chain by its real tier."""
+    def eng():
+        return ServingEngine(
+            serving=make_sim_serving(max_len=96, page_size=8,
+                                     slots=8, vocab=101,
+                                     kv_quant="pressure"),
+            slots=8, policy="paged", clock="fixed",
+            fixed_costs=dict(COSTS))
+    sess = eng().session(role="prefill")
+    sess.submit(Request(rid="x", arrival=0.0,
+                        prompt=tuple(range(1, 18)), max_new_tokens=4))
+    sess.advance_until(1e6)
+    h = sess.handoff_ready[0]
+    assert h.kv_quant == "pressure" and h.quant_pages == ()
+    hq = dc.replace(h, quant_pages=(0,))  # as if page 0 was compacted
+    dst = eng()
+    dsess = dst.session(role="decode")
+    dsess.submit_handoff(hq)
+    dsess.advance_until(1.0)
+    book = dsess.book
+    assert book.tables["x"][0] in book.quantized_pages()
+    assert book.census_ok()
+    fp, q = dst.serving.page_bytes_
+    occupied = len(book._refs) + len(book._evictable)
+    assert book.stored_bytes() == (occupied - 1) * fp + q
+
+
+# --- real tiny-llama factory --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def renv():
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return {"cfg": cfg, "model": model}
+
+
+def _rfac(model, kv_quant=None, n_pages=None, tp=None, **kw):
+    return llama_serving_decode_factory(
+        model, max_len=64, page_size=8,
+        n_pool_pages=(n_pages if n_pages is not None else 4 * 8 + 1 + 8),
+        batch_capacity=4, chunked_prefill=8, kv_quant=kv_quant,
+        tp=tp, **kw)
+
+
+def _real_trace(seed=0, n=8):
+    return synthesize_trace(seed=seed, n_requests=n,
+                            arrival="poisson", mean_interarrival=0.5,
+                            prompt_len=(4, 12), output_len=(4, 10),
+                            vocab_size=97, churn_frac=0.2,
+                            rid_prefix="q")
+
+
+def test_real_factory_kv_quant_validation(renv):
+    with pytest.raises(ValueError, match="kv_quant"):
+        _rfac(renv["model"], kv_quant="fp4")
+    with pytest.raises(ValueError, match="IS kv_cache_dtype"):
+        _rfac(renv["model"], kv_quant="int8", kv_cache_dtype="bf16")
+    with pytest.raises(ValueError, match="owns the pool codec"):
+        _rfac(renv["model"], kv_quant="pressure",
+              kv_cache_dtype="int8")
+    with pytest.raises(ValueError, match="tp"):
+        _rfac(renv["model"], kv_quant="pressure", tp=2)
+
+
+def test_real_int8_is_the_serving_spelling(renv):
+    """kv_quant='int8' IS kv_cache_dtype='int8' plus the serving
+    surface: identical streams, plus the tier census/pricing the
+    plain codec never grew — and the pool actually measures small."""
+    import jax
+    trace = _real_trace()
+    e_q = ServingEngine(serving=_rfac(renv["model"], kv_quant="int8"),
+                        slots=4, policy="paged", clock="fixed")
+    e_d = ServingEngine(
+        serving=_rfac(renv["model"], kv_cache_dtype="int8"),
+        slots=4, policy="paged", clock="fixed")
+    e_f = ServingEngine(serving=_rfac(renv["model"]), slots=4,
+                        policy="paged", clock="fixed")
+    r_q = e_q.run(trace)
+    r_d = e_d.run(trace)
+    e_f.run(trace)
+    assert r_q.outputs == r_d.outputs
+    assert r_q.kv_quant_stats["mode"] == "int8"
+    assert r_d.kv_quant_stats is None  # the codec alone is not the tier
+    bytes_q = e_q.pool_bytes_per_device()
+
+    def pool_nbytes(e):
+        return sum(int(a.nbytes) for a in
+                   jax.tree_util.tree_leaves(e.serving._live_pools))
+    assert bytes_q == pool_nbytes(e_q)
+    assert bytes_q <= 0.55 * pool_nbytes(e_f)
+    fp, q = e_q.serving.page_bytes_
+    assert (fp, q) == kv_quant_page_bytes(renv["cfg"], 8, np.float32)
+    assert r_q.cache_stats["invariant_ok"]
+
+
+def test_real_pressure_parity_without_incident(renv):
+    """Hot pages stay full precision: with no incident and no byte
+    budget the pressure factory's streams are bit-equal to fp."""
+    trace = _real_trace(seed=1)
+    fp = ServingEngine(serving=_rfac(renv["model"]), slots=4,
+                       policy="paged", clock="fixed").run(trace)
+    pr = ServingEngine(
+        serving=_rfac(renv["model"], kv_quant="pressure"),
+        slots=4, policy="paged", clock="fixed").run(trace)
+    assert pr.outputs == fp.outputs
+    qs = pr.kv_quant_stats
+    assert qs["mode"] == "pressure"
+    assert qs["pages_compacted"] == 0 and qs["flips"] == []
+    assert pr.cache_stats["invariant_ok"]
+
+
+def test_real_pressure_compaction_churn_never_recompiles(renv):
+    """Budget-driven compaction on the REAL dual-arena pool: parked
+    pages compact at allocation time, every request still completes,
+    the census holds — and compaction/churn adds ZERO compiles beyond
+    the fp baseline (the (P,) tier mask is a jit input, so any
+    compaction batch reuses the one compiled program)."""
+    from paddle_tpu import obs
+    trace = synthesize_trace(seed=3, n_requests=10,
+                             arrival="poisson", mean_interarrival=0.5,
+                             prompt_len=(8, 16), output_len=(4, 8),
+                             vocab_size=97, shared_prefix_frac=0.5,
+                             prefix_len=8, churn_frac=0.2,
+                             rid_prefix="p")
+
+    def compiles(kv_quant, budget_pages=None):
+        srv = _rfac(renv["model"], kv_quant=kv_quant, n_pages=20)
+        tr = obs.Tracer()
+        eng = ServingEngine(
+            serving=srv, slots=4, policy="paged", clock="fixed",
+            trace=tr,
+            kv_quant_budget=(srv.page_bytes_[0] * budget_pages
+                             if budget_pages is not None else None))
+        res = eng.run(trace)
+        sites = Counter(e["args"]["site"] for e in tr.events
+                        if e.get("name") == "jit.compile")
+        return res, sites
+
+    res_fp, sites_fp = compiles(None)
+    res_pr, sites_pr = compiles("pressure", budget_pages=14)
+    assert len(res_pr.outputs) == len(trace)
+    assert res_pr.cache_stats["invariant_ok"]
+    assert res_pr.kv_quant_stats["compactions"] >= 1
+    assert res_pr.kv_quant_stats["quantized_pages"] >= 0
+    assert sites_pr == sites_fp  # no extra compiles, ever
+
+
+def test_real_tp_int8_parity(renv):
+    """TP composes with the int8 tier: per-slot scales shard with the
+    kv heads, streams bit-equal to the unsharded int8 engine."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    trace = _real_trace(seed=4, n=6)
+    lone = ServingEngine(serving=_rfac(renv["model"],
+                                       kv_quant="int8"),
+                         slots=4, policy="paged",
+                         clock="fixed").run(trace)
+    tp = ServingEngine(serving=_rfac(renv["model"], kv_quant="int8",
+                                     tp=2),
+                       slots=4, policy="paged",
+                       clock="fixed").run(trace)
+    assert tp.outputs == lone.outputs
+    assert tp.kv_quant_stats["mode"] == "int8"
+    assert tp.cache_stats["invariant_ok"]
+
+
+# --- factory-level codec units ------------------------------------------
+
+
+def test_kv_quant_page_bytes_arithmetic():
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    fp, q = kv_quant_page_bytes(cfg, 8, np.float32)
+    slots = 2 * 2 * 8  # layers * kv_heads * page_size
+    assert fp == 2 * slots * 8 * 4   # k+v, head_dim f32
+    assert q == 2 * slots * (8 + 4)  # int8 data + one f32 scale/slot
+    assert q / fp == 0.375
+
+
+def test_compact_kv_pages_codec_and_roundtrip():
+    """The device half of compaction: masked pages land in the int8
+    arena within the per-slot absmax error bound, unmasked arenas and
+    the fp slots are untouched, and export/import re-materializes a
+    mixed-tier chain exactly."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    L, H, P, S, D = 1, 2, 4, 4, 8
+    kf = jnp.asarray(rng.normal(0, 1, (L, H, P, S, D)), jnp.float32)
+    vf = jnp.asarray(rng.normal(0, 1, (L, H, P, S, D)), jnp.float32)
+    zq = jnp.zeros((L, H, P, S, D), jnp.int8)
+    zs = jnp.zeros((L, H, P, S), jnp.float32)
+    tier = jnp.zeros((P,), bool)
+    pools = ((kf, zq, zs), (vf, zq, zs), tier)
+    mask = jnp.asarray([False, True, False, False])
+    (kf2, kq2, ks2), (vf2, vq2, vs2), tier2 = compact_kv_pages(pools,
+                                                               mask)
+    assert list(np.asarray(tier2)) == [False, True, False, False]
+    assert (np.asarray(kf2) == np.asarray(kf)).all()  # fp left dead
+    assert not np.asarray(kq2)[:, :, 0].any()  # unmasked untouched
+    deq = (np.asarray(kq2)[:, :, 1].astype(np.float32)
+           * np.asarray(ks2)[:, :, 1][..., None])
+    ref = np.asarray(kf)[:, :, 1]
+    # per-slot absmax int8: error <= scale/2 = absmax/254
+    bound = np.abs(ref).max(axis=-1, keepdims=True) / 127.0
+    assert (np.abs(deq - ref) <= bound).all()
+    pools2 = ((kf2, kq2, ks2), (vf2, vq2, vs2), tier2)
+    data = export_quant_pages(pools2, [1, 2])
+    fresh = ((jnp.zeros_like(kf), zq, zs),
+             (jnp.zeros_like(vf), zq, zs),
+             jnp.zeros((P,), bool))
+    (kf3, kq3, ks3), _, tier3 = import_quant_pages(fresh, [0, 3],
+                                                   data)
+    assert list(np.asarray(tier3)) == [True, False, False, False]
+    assert (np.asarray(kq3)[:, :, 0] == np.asarray(kq2)[:, :, 1]).all()
+    assert (np.asarray(kf3)[:, :, 3] == np.asarray(kf)[:, :, 2]).all()
+
+
+# --- trace_report + gate ------------------------------------------------
+
+
+def test_trace_report_kv_quant_rows():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_report import kv_quant_summary, report
+
+    from paddle_tpu import obs
+    tr = obs.Tracer()
+    _pressure_engine(trace_sink=tr).run(_pressure_trace())
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.json")
+        tr.export(p)
+        with open(p) as f:
+            evts = json.load(f)["traceEvents"]
+    row = kv_quant_summary(evts)
+    assert row["bench"] == "trace_report_kv_quant"
+    assert row["flips"] >= 2 and row["pages_compacted"] > 0
+    assert row["flip_timeline"]
+    txt = report(evts)
+    assert "quantized KV tier" in txt
+
+    tr2 = obs.Tracer()
+    _sim_engine(trace=tr2).run(_churn_trace(seed=7, n=12))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.json")
+        tr2.export(p)
+        with open(p) as f:
+            evts2 = json.load(f)["traceEvents"]
+    assert kv_quant_summary(evts2) is None
+    assert "quantized KV tier" not in report(evts2)
+
+
+def _gate_rows(bytes_ratio=0.32, tps=1.4, err=0.01, none_id=True,
+               fp_refused=True, served=True, census=True,
+               deterministic=True, parity=True, pages=80,
+               fp_keys=False, drop_arm=None, drop_bench=None):
+    rows = [
+        {"bench": "serving_quant", "arm": "fp", "device": "cpu",
+         "census_ok": census,
+         **({"kv_quant": "int8"} if fp_keys else {})},
+        {"bench": "serving_quant", "arm": "int8", "device": "cpu",
+         "census_ok": census, "kv_quant": "int8"},
+        {"bench": "serving_quant", "arm": "fp_fixed_bytes",
+         "device": "cpu", "census_ok": census},
+        {"bench": "serving_quant", "arm": "int8_fixed_bytes",
+         "device": "cpu", "census_ok": census, "kv_quant": "int8"},
+        {"bench": "serving_quant_pressure", "device": "sim",
+         "deterministic": deterministic,
+         "token_parity_vs_plain": parity,
+         "pages_compacted": pages, "census_ok": census},
+        {"bench": "serving_quant_summary", "device": "cpu",
+         "bytes_ratio": bytes_ratio, "capacity_gain": 3.2,
+         "tps_ratio_fixed_bytes": tps, "logit_rel_err": err,
+         "none_identity": none_id, "capacity_fp_refused": fp_refused,
+         "capacity_int8_served": served,
+         "pressure_pages_compacted": pages, "census_ok": census}]
+    if drop_arm:
+        rows = [r for r in rows if r.get("arm") != drop_arm]
+    if drop_bench:
+        rows = [r for r in rows if r.get("bench") != drop_bench]
+    return rows
+
+
+def test_gate_serving_quant_pass_and_fails(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from bench_gate import check_serving_quant
+
+    assert check_serving_quant(_gate_rows()) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["gate"] == "pass"
+    assert out["bytes_ratio"] == 0.32
+
+    for rows, frag in (
+            (_gate_rows(bytes_ratio=0.8), "not actually smaller"),
+            (_gate_rows(tps=0.7), "not converting to throughput"),
+            (_gate_rows(err=0.2), "not faithful"),
+            (_gate_rows(none_id=False), "must stay byte-identical"),
+            (_gate_rows(fp_refused=False), "capacity pair"),
+            (_gate_rows(served=False), "capacity pair"),
+            (_gate_rows(census=False), "census"),
+            (_gate_rows(deterministic=False), "pressure arm broken"),
+            (_gate_rows(parity=False), "pressure arm broken"),
+            (_gate_rows(pages=0), "pressure arm broken"),
+            (_gate_rows(fp_keys=True), "no longer inert"),
+            (_gate_rows(drop_arm="int8"), "missing arms"),
+            (_gate_rows(drop_bench="serving_quant_pressure"),
+             "UNVERIFIED"),
+            (_gate_rows(drop_bench="serving_quant_summary"),
+             "UNVERIFIED")):
+        assert check_serving_quant(rows) == 1
+        out = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["gate"] == "FAIL"
+        assert frag in out["reason"]
+
+
+@pytest.mark.slow
+def test_quant_bench_arm_end_to_end(capsys):
+    """The --kv-quant arm end to end: rows parse, the gate passes."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import serving_workload_bench as swb
+    from bench_gate import check_serving_quant
+    rc = swb.main(["--cpu", "--kv-quant", "--requests", "8"])
+    assert rc == 0
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    arms = {r.get("arm") for r in rows
+            if r.get("bench") == "serving_quant"}
+    assert {"fp", "int8", "fp_fixed_bytes",
+            "int8_fixed_bytes"} <= arms
+    assert check_serving_quant(rows) == 0

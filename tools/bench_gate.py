@@ -102,6 +102,16 @@ cannot silently ship a slower build. Three modes:
       #    the route plain and back, with the flip timeline
       #    byte-identical across two seeded replays and censuses
       #    intact on every arm.
+      #  - serving_quant (tools/serving_workload_bench.py --kv-quant):
+      #    the always-int8 KV pool must measure <= 0.55x the fp
+      #    pool's per-device bytes at equal page count, reach >= 1.0x
+      #    fp tokens/sec at an EQUAL byte budget (capacity converts
+      #    to throughput), hold teacher-forced logits within 5% of
+      #    fp, serve the HBM-budget pair the fp build refuses, keep
+      #    the kv_quant=None arm free of quant machinery, and the
+      #    sim pressure arm must compact parked pages identically
+      #    across two seeded replays with token parity and the pool
+      #    census intact.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -1112,6 +1122,136 @@ def check_serving_spec(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+QUANT_BYTES_CEIL = 0.55     # int8 / fp pool bytes per device
+QUANT_TPS_FLOOR = 1.0       # int8 vs fp tokens/sec at EQUAL pool bytes
+QUANT_REL_ERR_CEIL = 0.05   # teacher-forced logit error vs fp
+
+
+def check_serving_quant(rows: list) -> int:
+    """Gate the quantized paged-KV rows from serving_workload_bench.py
+    --kv-quant: the always-int8 pool must measure <= QUANT_BYTES_CEIL
+    x the fp pool's per-device bytes at equal page count, win (>=
+    QUANT_TPS_FLOOR x) on tokens/sec at an EQUAL byte budget (the
+    capacity it bought must convert to throughput, not just a smaller
+    census), hold teacher-forced logits within QUANT_REL_ERR_CEIL of
+    fp, serve the HBM-budget pair the fp build refuses, keep the
+    kv_quant=None row free of any kv_quant machinery, and the sim
+    pressure arm must compact pages deterministically across two
+    seeded replays with token parity and the pool census intact on
+    every arm. A missing-JSON input is the caller's no-JSON FAIL: the
+    claim was not checked."""
+    qr = [r for r in rows if r.get("bench") == "serving_quant"]
+    by = {r.get("arm"): r for r in qr}
+    need = ("fp", "int8", "fp_fixed_bytes", "int8_fixed_bytes")
+    missing = [a for a in need if a not in by]
+    if missing:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_quant rows missing arms "
+                                    f"{missing} (run tools/serving_"
+                                    "workload_bench.py --kv-quant)"}))
+        return 1
+    for r in qr:
+        if r.get("census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "pool census broken under kv_quant — a "
+                          "quantized page escaped the resident+"
+                          "evictable+free invariant"}))
+            return 1
+    if "kv_quant" in by["fp"] or "kv_quant" in by["fp_fixed_bytes"]:
+        print(json.dumps({
+            "gate": "FAIL",
+            "reason": "the kv_quant=None arm carries kv_quant report "
+                      "keys — the off mode is no longer inert (PR-5 "
+                      "presence convention broken)"}))
+        return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_quant_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_quant_summary row — "
+                                    "the byte/throughput/accuracy "
+                                    "claims are UNVERIFIED (rerun "
+                                    "the --kv-quant arm end to "
+                                    "end)"}))
+        return 1
+    s = summaries[-1]
+    press = [r for r in rows
+             if r.get("bench") == "serving_quant_pressure"]
+    rec = {
+        "gate": "pass",
+        "bytes_ratio": s.get("bytes_ratio"),
+        "bytes_ceil": QUANT_BYTES_CEIL,
+        "capacity_gain": s.get("capacity_gain"),
+        "tps_ratio_fixed_bytes": s.get("tps_ratio_fixed_bytes"),
+        "tps_floor": QUANT_TPS_FLOOR,
+        "logit_rel_err": s.get("logit_rel_err"),
+        "rel_err_ceil": QUANT_REL_ERR_CEIL,
+        "pressure_pages_compacted": s.get("pressure_pages_compacted"),
+        "device": by["int8"].get("device", "?"),
+    }
+    ratio = s.get("bytes_ratio")
+    if ratio is None or float(ratio) > QUANT_BYTES_CEIL:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"int8 pool measures {ratio}x the fp pool's "
+                         f"per-device bytes (ceiling "
+                         f"{QUANT_BYTES_CEIL}) — the quantized tier "
+                         "is not actually smaller")
+    tps = s.get("tps_ratio_fixed_bytes")
+    if rec["gate"] == "pass" \
+            and (tps is None or float(tps) < QUANT_TPS_FLOOR):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"int8 only reaches {tps}x fp tokens/sec at "
+                         f"equal pool bytes (floor {QUANT_TPS_FLOOR})"
+                         " — the extra pages are not converting to "
+                         "throughput")
+    err = s.get("logit_rel_err")
+    if rec["gate"] == "pass" \
+            and (err is None or float(err) > QUANT_REL_ERR_CEIL):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"teacher-forced logit error {err} exceeds "
+                         f"{QUANT_REL_ERR_CEIL} — the int8 cache is "
+                         "not faithful enough to serve")
+    if rec["gate"] == "pass" and s.get("none_identity") is not True:
+        rec["gate"] = "FAIL"
+        rec["reason"] = ("kv_quant=None replay diverged or grew "
+                         "kv_quant state — the off mode must stay "
+                         "byte-identical")
+    if rec["gate"] == "pass" \
+            and (s.get("capacity_fp_refused") is not True
+                 or s.get("capacity_int8_served") is not True):
+        rec["gate"] = "FAIL"
+        rec["reason"] = ("capacity pair broken (fp_refused="
+                         f"{s.get('capacity_fp_refused')} int8_served"
+                         f"={s.get('capacity_int8_served')}) — the "
+                         "over-budget model must refuse at fp and "
+                         "serve under kv_quant='int8'")
+    if rec["gate"] == "pass":
+        if not press:
+            rec["gate"] = "FAIL"
+            rec["reason"] = ("no serving_quant_pressure row — the "
+                             "compact-under-pressure claim is "
+                             "UNVERIFIED")
+        else:
+            p = press[-1]
+            if p.get("deterministic") is not True \
+                    or p.get("token_parity_vs_plain") is not True \
+                    or not int(p.get("pages_compacted") or 0) \
+                    or p.get("census_ok") is not True:
+                rec["gate"] = "FAIL"
+                rec["reason"] = (
+                    "pressure arm broken (deterministic="
+                    f"{p.get('deterministic')} parity="
+                    f"{p.get('token_parity_vs_plain')} "
+                    f"pages_compacted={p.get('pages_compacted')} "
+                    f"census_ok={p.get('census_ok')}) — the "
+                    "ThresholdRule incident must flip compaction "
+                    "identically on two seeded replays without "
+                    "touching tokens")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 AUTOSCALE_GOODPUT_FLOOR = 1.0   # autoscaled vs static-peak goodput
 AUTOSCALE_KINDS = ("diurnal", "flash")
 
@@ -1494,6 +1634,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_spec")
            for r in rows):
         fam_rcs["spec"] = check_serving_spec(rows)
+    if any(r.get("bench", "").startswith("serving_quant")
+           for r in rows):
+        fam_rcs["quant"] = check_serving_quant(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

@@ -149,6 +149,18 @@ QUEUE = [
     ("serving_spec",
      [sys.executable, "tools/serving_workload_bench.py", "--spec"],
      {}),
+    # PR-14 addition: the quantized-KV arm — fp vs always-int8 pools
+    # on the real tiny llama (per-device bytes, equal-byte-budget
+    # tokens/sec, teacher-forced accuracy, the HBM-budget pair the fp
+    # build refuses) plus the sim pressure arm whose ThresholdRule
+    # incident compacts parked pages (seeded replays — the chip run
+    # smokes the same code path); bench_gate.py serving gates the
+    # serving_quant family (bytes <= 0.55x fp, fixed-byte tokens/sec
+    # >= 1.0x, logit rel err <= 5%, capacity pair, deterministic
+    # pressure compaction, kv_quant=None arm inert)
+    ("serving_quant",
+     [sys.executable, "tools/serving_workload_bench.py", "--kv-quant"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

@@ -678,6 +678,262 @@ def _tp_arm(args):
     return 0
 
 
+def _quant_arm(args):
+    """The quantized paged-KV arm: the mixed seeded trace replayed on
+    the fixed clock through the REAL tiny-llama chunked-prefill
+    factory at kv_quant=None (fp baseline) vs kv_quant='int8' (every
+    page stored as int8 + per-slot scales) — one ``serving_quant`` row
+    per arm with the measured pool byte census; then a FIXED-POOL-BYTE
+    capacity sweep (equal byte budget, the int8 pool holds ~2-3x the
+    pages, so the page-starved fp arm cannot beat its throughput); a
+    teacher-forced accuracy row (int8-cache logits within 5% of fp —
+    token parity is NOT the claim, a tiny random model's greedy
+    trajectory flips on quantization-scale numerics); a per-device
+    HBM-budget pair the fp build REFUSES and the int8 build SERVES;
+    and a sim-backed pressure arm (QoSScheduler + a
+    ``pool_bytes_per_device`` ThresholdRule flipping the
+    compact-under-pressure tier, replayed twice for flip determinism).
+
+    `bench_gate.py serving` gates the serving_quant family:
+    bytes_ratio <= 0.55, fixed-byte tokens/sec ratio >= 1.0, logit
+    rel err <= 0.05, capacity pair (fp refused / int8 served),
+    pressure flips deterministic with pages compacted, census flags
+    clean, and the kv_quant=None row carrying no kv_quant keys."""
+    import json as _json
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.nlp.llama_decode import (
+        decode_need_bytes_per_device, kv_quant_page_bytes,
+        llama_serving_decode_factory)
+    from paddle_tpu.obs.slo import ThresholdRule
+    from paddle_tpu.serving import (QoSScheduler, ServingEngine,
+                                    TPConfig, make_sim_serving,
+                                    synthesize_trace, trace_stats)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    device = str(jax.devices()[0])
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=12,
+                          num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        slots, page_size, max_len = 8, 64, 1024
+        prompt_rng, out_rng = (64, 320), (16, 64)
+        n_req = args.requests or 24
+    else:
+        cfg = LlamaConfig.tiny(vocab=97, hidden=64, layers=2, heads=4,
+                               kv_heads=2)
+        slots, page_size, max_len = 4, 8, 64
+        prompt_rng, out_rng = (6, 18), (4, 12)
+        n_req = args.requests or 16
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    W = max_len // page_size
+    trace = synthesize_trace(
+        seed=args.seed, n_requests=n_req, vocab_size=cfg.vocab_size,
+        prompt_len=prompt_rng, output_len=out_rng,
+        shared_prefix_frac=0.25, prefix_len=page_size * 2,
+        churn_frac=0.15)
+    stats = trace_stats(trace)
+
+    def build(kv_quant, n_pages=None, tp=None):
+        return llama_serving_decode_factory(
+            model, max_len=max_len, page_size=page_size,
+            n_pool_pages=(n_pages if n_pages is not None
+                          else slots * W + 1 + 4),
+            batch_capacity=slots, chunked_prefill=page_size,
+            kv_quant=kv_quant, tp=tp)
+
+    def run_arm(arm, srv, req_trace, extra=None):
+        eng = ServingEngine(serving=srv, slots=slots, policy="paged",
+                            clock="fixed")
+        w0 = _time.perf_counter()
+        res = eng.run(req_trace)
+        wall = _time.perf_counter() - w0
+        per_dev = eng.pool_bytes_per_device()
+        if per_dev is None:
+            per_dev = sum(int(getattr(a, "nbytes", 0))
+                          for a in jax.tree_util.tree_leaves(
+                              srv._live_pools))
+        rec = res.metrics.to_record(
+            policy="paged", device=device, seed=args.seed,
+            slots=slots, trace=stats)
+        rec["bench"] = "serving_quant"
+        rec["arm"] = arm
+        rec["wall_s"] = round(wall, 3)
+        rec["pool_bytes_per_device"] = per_dev
+        rec["n_pool_pages"] = srv.n_pool_pages_
+        rec["census_ok"] = res.cache_stats.get("invariant_ok")
+        if res.kv_quant_stats is not None:
+            rec["kv_quant"] = res.kv_quant_stats["mode"]
+        rec.update(extra or {})
+        emit(rec)
+        return rec, res
+
+    # --- fp vs int8 at EQUAL page count (byte halving) ---------------
+    rec_fp, res_fp = run_arm("fp", build(None), trace)
+    rec_q, res_q = run_arm("int8", build("int8"), trace)
+    bytes_ratio = (rec_q["pool_bytes_per_device"]
+                   / rec_fp["pool_bytes_per_device"])
+    # the None row must carry no kv_quant machinery (PR-5 presence
+    # convention) and a second None replay must stream identically
+    _, res_fp2 = run_arm("fp_replay", build(None), trace)
+    none_identity = (res_fp.outputs == res_fp2.outputs
+                     and res_fp.kv_quant_stats is None
+                     and "kv_quant" not in res_fp.report())
+
+    # --- fixed-pool-byte capacity sweep ------------------------------
+    fp_page, q_page = kv_quant_page_bytes(cfg, page_size, jnp.float32)
+    byte_budget = (slots * W + 1 + 4) * q_page
+    n_fp_pages = max(W + slots, byte_budget // fp_page)
+    n_q_pages = byte_budget // q_page
+    rec_fpb, res_fpb = run_arm(
+        "fp_fixed_bytes", build(None, n_pages=n_fp_pages), trace,
+        extra={"byte_budget": int(byte_budget)})
+    rec_qb, res_qb = run_arm(
+        "int8_fixed_bytes", build("int8", n_pages=n_q_pages), trace,
+        extra={"byte_budget": int(byte_budget)})
+    tps_ratio = (rec_qb["tokens_per_sec"] / rec_fpb["tokens_per_sec"]
+                 if rec_fpb.get("tokens_per_sec") else None)
+
+    # --- teacher-forced accuracy (logit closeness, not token parity) --
+    from paddle_tpu.models.nlp.llama_decode import llama_decode_factory
+    gen_fp = llama_decode_factory(model, max_len=32)
+    gen_q = llama_decode_factory(model, max_len=32,
+                                 kv_cache_dtype="int8")
+    prompt = np.asarray(
+        np.random.default_rng(args.seed + 1).integers(
+            0, cfg.vocab_size, (2, 6)), np.int32)
+    seq = np.asarray(gen_fp(prompt, max_new_tokens=8))
+
+    def drive(parts):
+        kc = parts["init_caches"](2, jnp.float32)
+        vc = parts["init_caches"](2, jnp.float32)
+        lg, kc, vc = parts["prefill"](parts["outer"], parts["layers"],
+                                      jnp.asarray(prompt), kc, vc)
+        logits = [np.asarray(lg)]
+        for i in range(7):
+            lg, kc, vc = parts["decode_step"](
+                parts["outer"], parts["layers"],
+                jnp.asarray(seq[:, 6 + i]), jnp.asarray(6 + i), kc, vc)
+            logits.append(np.asarray(lg))
+        return np.stack(logits, 1)
+
+    lf = drive(gen_fp._parts)
+    lq = drive(gen_q._parts)
+    rel_err = float(np.abs(lf - lq).max() / np.abs(lf).max())
+    emit({"bench": "serving_quant_accuracy", "device": device,
+          "seed": args.seed, "teacher_forced_steps": 8,
+          "logit_rel_err": round(rel_err, 6), "bound": 0.05})
+
+    # --- capacity pair: a budget only the int8 pool fits -------------
+    need_fp = decode_need_bytes_per_device(*build(None).paged_parts[:3])
+    need_q = decode_need_bytes_per_device(
+        *build("int8").paged_parts[:3])
+    budget = (need_fp + need_q) // 2
+    fp_refused = False
+    try:
+        build(None, tp=TPConfig((1,),
+                                hbm_budget_bytes_per_device=budget))
+    except MemoryError:
+        fp_refused = True
+    q_served = False
+    try:
+        srv_b = build("int8",
+                      tp=TPConfig((1,),
+                                  hbm_budget_bytes_per_device=budget))
+        engb = ServingEngine(serving=srv_b, slots=slots,
+                             policy="paged", clock="fixed")
+        small = trace[: min(4, len(trace))]
+        resb = engb.run(small)
+        q_served = resb.report()["completed"] == len(small)
+    except MemoryError:
+        pass
+    emit({"bench": "serving_quant_capacity", "device": device,
+          "budget_bytes_per_device": int(budget),
+          "fp_need_bytes": int(need_fp), "int8_need_bytes": int(need_q),
+          "fp_refused": fp_refused, "int8_served": q_served})
+
+    # --- sim pressure arm: incident-driven compaction, replayed twice -
+    def pressure_run(kv_quant):
+        sim = make_sim_serving(max_len=64, page_size=8,
+                               n_pool_pages=48, slots=8, vocab=509,
+                               chunked_prefill=8, kv_quant=kv_quant)
+        eng = ServingEngine(
+            serving=sim, slots=8, policy="paged", clock="fixed",
+            fixed_costs={"prefill": 1.0, "decode": 1.0},
+            scheduler=QoSScheduler(),
+            slo=([ThresholdRule(name="pool_pressure",
+                                signal="pool_bytes_per_device",
+                                bound=float(sim.page_bytes_[0] * 20),
+                                op=">=", severity="page")]
+                 if kv_quant == "pressure" else None),
+            kv_quant_budget=(sim.page_bytes_[0] * 40
+                             if kv_quant == "pressure" else None))
+        ptrace = synthesize_trace(
+            seed=args.seed + 2, n_requests=80, vocab_size=509,
+            prompt_len=(8, 24), output_len=(4, 12),
+            shared_prefix_frac=0.3, prefix_len=16, churn_frac=0.1)
+        return eng.run(ptrace)
+
+    p1 = pressure_run("pressure")
+    p2 = pressure_run("pressure")
+    pn = pressure_run(None)
+    qs = p1.kv_quant_stats
+    emit({"bench": "serving_quant_pressure", "device": "sim",
+          "seed": args.seed + 2, "requests": 80,
+          "flips": len(qs["flips"]),
+          "pages_compacted": qs["pages_compacted"],
+          "compactions": qs["compactions"],
+          "deterministic": (p1.outputs == p2.outputs
+                            and p1.kv_quant_stats
+                            == p2.kv_quant_stats),
+          "token_parity_vs_plain": p1.outputs == pn.outputs,
+          "census_ok": p1.cache_stats.get("invariant_ok")})
+
+    emit({"bench": "serving_quant_summary", "device": device,
+          "seed": args.seed, "requests": n_req,
+          "pool_bytes_per_device_fp": rec_fp["pool_bytes_per_device"],
+          "pool_bytes_per_device_int8":
+          rec_q["pool_bytes_per_device"],
+          "bytes_ratio": round(bytes_ratio, 4),
+          "capacity_gain": round(1.0 / bytes_ratio, 4),
+          "fixed_bytes_budget": int(byte_budget),
+          "fixed_bytes_pages_fp": int(n_fp_pages),
+          "fixed_bytes_pages_int8": int(n_q_pages),
+          "tokens_per_sec_fp_fixed_bytes":
+          rec_fpb.get("tokens_per_sec"),
+          "tokens_per_sec_int8_fixed_bytes":
+          rec_qb.get("tokens_per_sec"),
+          "tps_ratio_fixed_bytes": (round(tps_ratio, 4)
+                                    if tps_ratio is not None
+                                    else None),
+          "logit_rel_err": round(rel_err, 6),
+          "none_identity": none_identity,
+          "capacity_fp_refused": fp_refused,
+          "capacity_int8_served": q_served,
+          "pressure_deterministic": (p1.outputs == p2.outputs
+                                     and p1.kv_quant_stats
+                                     == p2.kv_quant_stats),
+          "pressure_pages_compacted": qs["pages_compacted"],
+          "census_ok": (rec_fp["census_ok"] and rec_q["census_ok"]
+                        and rec_fpb["census_ok"]
+                        and rec_qb["census_ok"]
+                        and p1.cache_stats.get("invariant_ok"))})
+    return 0
+
+
 def _lora_arm(args):
     """The multi-model LoRA arm: one seeded Zipf-skewed adapter trace
     (hot adapters dominate, the production fine-tune shape) replayed
@@ -1545,6 +1801,19 @@ def main(argv=None):
                          "serves only under TP). Degrades to a "
                          "graceful no-JSON FAIL on single-device "
                          "images")
+    ap.add_argument("--kv-quant", action="store_true",
+                    help="run the quantized paged-KV arm instead: fp "
+                         "vs always-int8 pools through the real "
+                         "tiny-llama factory (byte census + "
+                         "fixed-pool-byte throughput sweep + "
+                         "teacher-forced logit-error row + an "
+                         "HBM-budget pair only int8 fits) plus a sim "
+                         "pressure arm (ThresholdRule-driven "
+                         "compaction, replayed twice); bench_gate.py "
+                         "serving gates the serving_quant family "
+                         "(bytes <= 0.55x, fixed-byte tokens/sec >= "
+                         "1.0x, logit rel err <= 0.05, capacity "
+                         "pair, deterministic pressure flips)")
     ap.add_argument("--lane-budget", type=int, default=2,
                     help="disagg arm: prefill chunks per engine turn "
                          "in the async lane")
@@ -1673,6 +1942,8 @@ def main(argv=None):
         return _autoscale_arm(args)
     if args.tp:
         return _tp_arm(args)
+    if args.kv_quant:
+        return _quant_arm(args)
     if args.lora:
         return _lora_arm(args)
     if args.spec:

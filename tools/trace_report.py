@@ -27,6 +27,11 @@ tool turns it into the four summaries an on-call actually asks for:
   proposed), the deterministic route-flip timeline with the explain
   rule each flip fired on, and a ``trace_report_spec`` ``--json``
   row; pre-spec traces render byte-identically without any of it.
+- **quantized KV tier** (kv_quant='pressure' traces only): the
+  deterministic tier-flip timeline with the explain rule each flip
+  fired on, compacted-page totals from the engine's
+  ``kv_compaction`` instants, and a ``trace_report_kv_quant``
+  ``--json`` row; pre-quant traces render byte-identically.
 
 ``--json`` emits one row PER TRACK, then (for cluster traces, whose
 engine tracks are replica-prefixed ``r0/engine``, ``r0/slot/3``, ...)
@@ -326,6 +331,44 @@ def spec_summary(events: list) -> dict | None:
                         for rid, v in sorted(acc.items())[:20]}}
 
 
+def kv_quant_events(events: list) -> tuple:
+    """The pressure tier's deterministic actuation timeline: the
+    engine's ``kv_quant_flip`` instants (each carrying the explain
+    rule that fired) and its ``kv_compaction`` instants (pages moved
+    to the int8 tier), in time order. Both empty for any pre-quant
+    trace — every kv-quant section/row below is omitted then, so
+    pre-quant traces summarize byte-identically."""
+    flips = sorted(
+        ({"t": e["ts"], **e.get("args", {})}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") == "kv_quant_flip"),
+        key=lambda r: (r["t"], str(r.get("rule"))))
+    comps = sorted(
+        ({"t": e["ts"], **e.get("args", {})}
+         for e in events if e.get("ph") == "i"
+         and e.get("name") == "kv_compaction"),
+        key=lambda r: r["t"])
+    return flips, comps
+
+
+def kv_quant_summary(events: list) -> dict | None:
+    """Quantized-KV evidence: the ``trace_report_kv_quant`` row —
+    the tier flip timeline and compacted-page totals. None for
+    pre-quant traces, whose report output stays byte-identical."""
+    flips, comps = kv_quant_events(events)
+    if not flips and not comps:
+        return None
+    return {"bench": "trace_report_kv_quant",
+            "flips": len(flips),
+            "compactions": len(comps),
+            "pages_compacted": sum(int(c.get("pages", 0))
+                                   for c in comps),
+            "flip_timeline": [{"t": f["t"],
+                               "enabled": f.get("enabled"),
+                               "rule": f.get("rule")}
+                              for f in flips[:20]]}
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -566,6 +609,18 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                 f"  t={f['t'] / 1e6:.4f}s -> "
                 f"{'spec' if f.get('enabled') else 'plain':5s} :: "
                 f"{f.get('rule')}")
+    qflips, qcomps = kv_quant_events(events)
+    if qflips or qcomps:
+        # only kv-quant traces grow this section — pre-quant traces
+        # render byte-identically
+        pages = sum(int(c.get("pages", 0)) for c in qcomps)
+        lines.append(f"\n== quantized KV tier ({len(qflips)} flips, "
+                     f"{pages} pages compacted) ==")
+        for f in qflips[:top * 2]:
+            lines.append(
+                f"  t={f['t'] / 1e6:.4f}s -> "
+                f"{'int8' if f.get('enabled') else 'fp':5s}:: "
+                f"{f.get('rule')}")
     acts = autoscale_actions(events)
     if acts:
         # only autoscaled traces grow this section — pre-autoscale
@@ -634,6 +689,11 @@ def main(argv=None) -> int:
             # speculative traces only: absent otherwise, so pre-spec
             # --json output is byte-identical (global row still LAST)
             print(json.dumps(sp_row))
+        kvq_row = kv_quant_summary(events)
+        if kvq_row is not None:
+            # kv-quant traces only: absent otherwise, so pre-quant
+            # --json output is byte-identical
+            print(json.dumps(kvq_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
