@@ -1859,11 +1859,129 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
     # program-cache growth across a call) can watch prefill too
     prefill_chunked._jit_inner = (_prefill_chunk, _finish_prefill)
 
+    def _write_chunk_ragged(pool_l, kv, page_tables, starts, C):
+        """kv (R, nkv, C, hd) written at PER-ROW absolute positions
+        starts[r].. — per-row page ids gathered with take_along_axis
+        instead of one shared dynamic slice. Duplicate ids across rows
+        (idle rows all point at the reserved page 0; cohort rows
+        rewriting a shared cached page carry identical content) make
+        the scatter order unspecified but the result deterministic."""
+        R = kv.shape[0]
+        npg = C // page_size
+        col = (starts // page_size)[:, None] + jnp.arange(npg)[None, :]
+        ids = jnp.take_along_axis(page_tables, col, 1).reshape(-1)
+
+        def pageify(a, *trail):
+            a = a.reshape((R, nkv, npg, page_size) + tuple(trail))
+            order = (1, 0, 2, 3) + tuple(range(4, a.ndim))
+            return jnp.transpose(a, order).reshape(
+                (nkv, R * npg, page_size) + tuple(trail))
+
+        if isinstance(pool_l, tuple):
+            data, sc = pool_l
+            qd, s = _q8(kv)
+            return (data.at[:, ids].set(pageify(qd, hd)),
+                    sc.at[:, ids].set(pageify(s)))
+        return pool_l.at[:, ids].set(
+            pageify(kv, hd).astype(pool_l.dtype))
+
+    @partial(jax.jit, donate_argnums=(6,))
+    def _prefill_chunk_ragged(outer, layers, chunk, starts, page_tables,
+                              lengths, pools, x_last, lora=None):
+        """One C-token chunk PER ROW at per-row absolute positions
+        starts[r]..starts[r]+C-1: a lane's pending chunks ACROSS
+        requests fused into one fixed-shape program. ``starts`` rides
+        as jit data exactly like decode_n's lengths, so one compiled
+        program serves every admission mix. Rows with nothing to run
+        point their pages at the reserved padding page 0 and write
+        garbage there (the pool convention); their x_last never
+        updates because length-1 falls outside the chunk window."""
+        R, C = chunk.shape
+        if pressure:
+            col = (starts // page_size)[:, None] + jnp.arange(
+                C // page_size)[None, :]
+            pools = _tier_clear(
+                pools, jnp.take_along_axis(page_tables, col, 1))
+        k_pools, v_pools, _tm = _tier_enter(pools)
+        W = page_tables.shape[1]
+        S = W * page_size
+        x = jnp.take(outer["model.embed_tokens.weight"], chunk, axis=0)
+        pos = starts[:, None] + jnp.arange(C)[None, :]       # (R, C)
+        # causal over ABSOLUTE key positions, bounded by real length —
+        # the per-chunk mask with a per-row start
+        key_ok = (jnp.arange(S)[None, None, :] <= pos[:, :, None]) \
+            & (jnp.arange(S)[None, None, :]
+               < lengths[:, None, None])
+        mask = key_ok[:, None]                       # (R, 1, C, S)
+
+        def body(x, per_layer):
+            lp, kp_l, vp_l, lo = _split_per_layer(per_layer, lora)
+
+            def attend(q, k, v):
+                kp = _write_chunk_ragged(kp_l, k, page_tables, starts,
+                                         C)
+                vp = _write_chunk_ragged(vp_l, v, page_tables, starts,
+                                         C)
+
+                def gather(pool):
+                    """(R, nkv, S, hd): gather the batch's pages FIRST,
+                    dequantize only that slice — never the whole
+                    pool."""
+                    if isinstance(pool, tuple):
+                        data, sc = pool
+                        g = (data[:, page_tables].astype(jnp.float32)
+                             * sc[:, page_tables][..., None])
+                    else:
+                        g = pool[:, page_tables]
+                    return jnp.swapaxes(g, 0, 1).reshape(R, nkv, S, hd)
+
+                k_all, v_all = gather(kp), gather(vp)
+                return _attend(cfg, q, k_all.astype(q.dtype),
+                               v_all.astype(q.dtype), mask), (kp, vp)
+
+            x, (kp, vp) = _layer_math(cfg, lp, x, pos, attend, lora=lo)
+            return x, (kp, vp)
+
+        x, ys = _stack_apply(
+            body, x, _scan_operand(layers, k_pools, v_pools, lora),
+            scan_layers)
+        k_pools, v_pools = ys
+        idx = jnp.clip(lengths - 1 - starts, 0, C - 1)
+        row = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                                  1)[:, 0]
+        hit = ((lengths - 1 >= starts)
+               & (lengths - 1 < starts + C))[:, None]
+        x_last = jnp.where(hit, row, x_last)
+        return x_last, _tier_exit(k_pools, v_pools, _tm)
+
+    def prefill_ragged(outer, layers, chunk, starts, page_tables,
+                       lengths, pools, lora=None):
+        """ONE fused lane dispatch: row r runs the C tokens of
+        ``chunk[r]`` at absolute offset ``starts[r]`` against its own
+        page table. Returns per-row next-token logits-argmax like
+        ``prefill``; only rows whose FINAL chunk this is (length-1
+        inside the window) carry a meaningful value — the engine reads
+        exactly those rows and ignores the rest."""
+        R = chunk.shape[0]
+        x_last = jnp.zeros((R, cfg.hidden_size), dtype)
+        x_last, pools = _prefill_chunk_ragged(
+            outer, layers, chunk, starts, page_tables, lengths, pools,
+            x_last, lora)
+        return _finish_prefill(outer, x_last), pools
+
+    prefill_ragged._jit_inner = (_prefill_chunk_ragged, _finish_prefill)
+
     if chunked_prefill is not None:
         if chunked_prefill % page_size:
             raise ValueError("chunked_prefill must be a multiple of "
                              f"page_size ({page_size})")
         prefill = prefill_chunked
+        if prefill_attention != "kernel":
+            # the fused program always attends via the gather path;
+            # advertising it under kernel-mode prefill would silently
+            # mix two numerics in one run, so the engine only sees the
+            # ragged entry point when both programs share the math
+            prefill_chunked._ragged = prefill_ragged
 
     @partial(jax.jit, donate_argnums=(5,), static_argnums=(6,))
     def decode_n(outer, layers, tok, page_tables, lengths, pools, n,
@@ -2173,6 +2291,12 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         # engine refuses ServingEngine(spec=...) without it. A tuple,
         # not a callable, so the class attribute never method-binds.
         spec_parts = spec_built
+        if getattr(paged[3], "_ragged", None) is not None:
+            # the fused ragged-prefill entry point (one program for a
+            # whole lane turn); absent when the per-chunk prefill uses
+            # kernel attention, so the engine's ragged_prefill= flag
+            # fails loudly instead of mixing numerics
+            prefill_ragged = staticmethod(paged[3]._ragged)
         if lora_hooks is not None:
             # adapter-cache device hooks (paddle_tpu.serving.adapters)
             init_adapter_bank = staticmethod(lora_hooks[0])

@@ -58,6 +58,7 @@ from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401
 from .sim import SimServing, make_sim_serving  # noqa: F401
 from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        load_trace, merge_traces, save_trace,
+                       synthesize_admission_burst_trace,
                        synthesize_cluster_trace,
                        synthesize_deadline_mix_trace,
                        synthesize_diurnal_trace,

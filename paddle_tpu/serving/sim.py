@@ -141,6 +141,12 @@ class SimServing:
         pools = np.zeros((n_pool_pages, page_size), np.int64)
         self.paged_parts = (None, None, pools, self._make_prefill(),
                             None, self._make_decode_n())
+        # the fused ragged-prefill entry point (the engine's
+        # ragged_prefill= flag probes for this attribute), mirroring
+        # the real factory's contract: one call runs ONE pending chunk
+        # per row at per-row offsets, returning per-row first tokens
+        # that are meaningful only for rows whose final chunk this is
+        self.prefill_ragged = self._make_prefill_ragged()
         # ``spec_accept``: the sim's SPECULATIVE stand-in. The real
         # spec factory's draft is a second model whose proposals the
         # target verifies; the sim's draft proposes the TRUE next
@@ -309,6 +315,46 @@ class SimServing:
 
         prefill._cache_size = lambda: 0  # no jit cache to watch
         return prefill
+
+    def _make_prefill_ragged(self):
+        ps = self.page_size_
+
+        def prefill_ragged(outer, layers, chunk, starts, pt, lens,
+                           pools, lora=None):
+            """The real factory's fused lane dispatch, sim edition:
+            row r writes the C tokens of ``chunk[r]`` at absolute
+            positions ``starts[r]..`` through its own page table, then
+            rows whose length-1 position falls inside the window (the
+            row's FINAL chunk) hash their full pooled history into the
+            first token. Idle rows (the engine points them at page 0)
+            write garbage there, the pool convention."""
+            chunk = np.asarray(chunk)
+            starts = np.asarray(starts)
+            pt = np.asarray(pt)
+            lens = np.asarray(lens)
+            R, C = chunk.shape
+            bank = ids = None
+            if lora is not None:
+                bank, ids = lora
+                bank, ids = np.asarray(bank), np.asarray(ids)
+            firsts = np.zeros((R,), np.int64)
+            for s in range(R):
+                L = int(lens[s])
+                st = int(starts[s])
+                for pos in range(st, min(st + C, L)):
+                    pools[pt[s, pos // ps], pos % ps] = \
+                        chunk[s, pos - st]
+                if not (st <= L - 1 < st + C):
+                    continue  # mid-prompt row: no logits to harvest
+                pages = pt[s, :-(-L // ps)]
+                seq = pools[pages].reshape(-1)[:L]
+                a_salt = int(bank[int(ids[s])]) if bank is not None \
+                    else 0
+                firsts[s] = self._token(seq, a_salt)
+            return firsts, pools
+
+        prefill_ragged._cache_size = lambda: 0
+        return prefill_ragged
 
     def _make_decode_n(self):
         ps = self.page_size_

@@ -536,6 +536,73 @@ def synthesize_prefill_heavy_trace(seed: int = 0, *,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def synthesize_admission_burst_trace(seed: int = 0, *,
+                                     n_bursts: int = 3,
+                                     burst_size: int = 8,
+                                     burst_gap: float = 80.0,
+                                     first_burst: float = 16.0,
+                                     burst_prompt: Tuple[int, int]
+                                     = (28, 32),
+                                     burst_output: Tuple[int, int]
+                                     = (2, 4),
+                                     n_background: int = 12,
+                                     background_gap: float = 4.0,
+                                     background_prompt: Tuple[int, int]
+                                     = (3, 6),
+                                     background_output: Tuple[int, int]
+                                     = (48, 64),
+                                     vocab_size: int = 128,
+                                     rid_prefix: str = "ab",
+                                     start: float = 0.0) \
+        -> List[Request]:
+    """SYNCHRONIZED arrival spikes: every request of a burst arrives
+    at the SAME instant, so a per-chunk prefill lane must serialize
+    ``burst_size`` independent long prompts one bounded call at a
+    time — the shape whose TTFT a ragged batched prefill divides by
+    the batching factor (all lane rows ride ONE fused program per
+    turn). A background cohort of short-prompt, long-budget requests
+    keeps the decode slots busy so each serialized chunk turn also
+    pays for a decode batch, exactly the contention the fused lane
+    amortizes.
+
+    The burst factor is named in the rids — burst rows end in
+    ``.x{burst_size}`` (e.g. ``ab-b0.03.x8``) and background rows in
+    ``.bg`` — so benches split the spike cohort (the TTFT claim) from
+    the steady cohort without a side channel. Deterministic in every
+    field; JSONL round-trips through ``save_trace``/``load_trace``
+    like every other synthesizer."""
+    if n_bursts < 1 or burst_size < 1 or n_background < 0:
+        raise ValueError("need >= 1 burst of >= 1 request")
+    rng = np.random.default_rng(seed)
+    reqs: List[Request] = []
+    t = start
+    for i in range(n_background):
+        t += background_gap
+        plen = int(rng.integers(background_prompt[0],
+                                background_prompt[1] + 1))
+        reqs.append(Request(
+            rid=f"{rid_prefix}-g{i:03d}.bg", arrival=t,
+            prompt=tuple(int(x) for x in rng.integers(
+                1, vocab_size, plen)),
+            max_new_tokens=int(rng.integers(background_output[0],
+                                            background_output[1]
+                                            + 1))))
+    for b in range(n_bursts):
+        tb = start + first_burst + b * burst_gap
+        for j in range(burst_size):
+            plen = int(rng.integers(burst_prompt[0],
+                                    burst_prompt[1] + 1))
+            reqs.append(Request(
+                rid=f"{rid_prefix}-b{b}.{j:02d}.x{burst_size}",
+                arrival=tb,
+                prompt=tuple(int(x) for x in rng.integers(
+                    1, vocab_size, plen)),
+                max_new_tokens=int(rng.integers(burst_output[0],
+                                                burst_output[1]
+                                                + 1))))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def synthesize_zipf_adapter_trace(seed: int = 0,
                                   n_requests: int = 2000, *,
                                   n_adapters: int = 4,

@@ -709,6 +709,106 @@ def check_serving_disagg(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+RAGGED_TTFT_FLOOR = 2.0    # burst-cohort TTFT p95 improvement floor
+RAGGED_STARVE_SLACK = 1.05  # ragged worst-case TTFT vs per-chunk
+
+
+def check_serving_ragged(rows: list) -> int:
+    """Gate the ragged batched-prefill rows from
+    serving_workload_bench.py --ragged: greedy streams must be
+    token-identical to per-chunk prefill on EVERY trace (mixed churn,
+    prefill-heavy, admission-burst), the burst cohort's TTFT p95 must
+    be >= RAGGED_TTFT_FLOOR x better at equal prefill_chunk_budget,
+    the real tiny-llama ragged program cache must stay FLAT across
+    admission mixes (a fused prefill that recompiles per mix has no
+    claim), the lane-starvation aging bound must hold (ragged
+    worst-case TTFT within RAGGED_STARVE_SLACK of per-chunk on every
+    trace — fusing must not age anyone out), and the fixed clock must
+    be byte-identical with dispatch_ahead on. The per-chunk arm is
+    the baseline re-measured in the same run — no stamped file."""
+    rr = [r for r in rows if r.get("bench") == "serving_ragged"]
+    by = {(r.get("trace"), r.get("arm")): r for r in rr}
+    if ("admission_burst", "per_chunk") not in by \
+            or ("admission_burst", "ragged") not in by:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_ragged rows need BOTH a "
+                                    "per_chunk and a ragged arm on "
+                                    "the admission_burst trace (run "
+                                    "tools/serving_workload_bench.py "
+                                    "--ragged)"}))
+        return 1
+    for r in rr:
+        if r.get("census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "trace": r.get("trace"),
+                "arm": r.get("arm"),
+                "reason": "pool census broken under the ragged lane "
+                          "— pages leaked or double-counted"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_ragged_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_ragged_summary row — "
+                                    "ragged-vs-per-chunk token parity "
+                                    "is UNVERIFIED (rerun the "
+                                    "--ragged arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("outputs_match") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "parity_by_trace": s.get("parity_by_trace"),
+                          "reason": "the ragged lane produced "
+                                    "DIVERGING greedy tokens vs "
+                                    "per-chunk prefill on the same "
+                                    "trace (correctness, not "
+                                    "latency)"}))
+        return 1
+    if s.get("program_cache_flat") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "cache_calls": s.get("program_cache_calls"),
+                          "reason": "ragged prefill RECOMPILED across "
+                                    "admission mixes — the fused "
+                                    "shape is leaking trace data into "
+                                    "jit statics"}))
+        return 1
+    if s.get("starvation_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "lane-starvation aging bound "
+                                    "broken: some request's ragged "
+                                    "TTFT exceeds its per-chunk TTFT "
+                                    f"by > {RAGGED_STARVE_SLACK}x — "
+                                    "fusing is aging rows out"}))
+        return 1
+    if s.get("dispatch_ahead_parity_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "dispatch_ahead=True changed "
+                                    "fixed-clock outputs — the "
+                                    "overlap is supposed to be a "
+                                    "measured-clock optimization "
+                                    "only"}))
+        return 1
+    imp = s.get("burst_ttft_p95_improvement")
+    rec = {
+        "gate": "pass",
+        "burst_ttft_p95_improvement": imp,
+        "ttft_floor": RAGGED_TTFT_FLOOR,
+        "burst_ttft_p95_per_chunk": s.get("burst_ttft_p95_per_chunk"),
+        "burst_ttft_p95_ragged": s.get("burst_ttft_p95_ragged"),
+        "program_cache_calls": s.get("program_cache_calls"),
+        "prefill_chunk_budget": s.get("prefill_chunk_budget"),
+        "device": s.get("device", "?"),
+    }
+    if imp is None or float(imp) < RAGGED_TTFT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"burst TTFT p95 only {imp}x better than "
+                         f"per-chunk (floor {RAGGED_TTFT_FLOOR}) — "
+                         "the fused program is not amortizing the "
+                         "admission spike")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 TP_BYTES_CEIL = 0.55  # per-device pool bytes at TP=2 vs TP=1 (the
 # >= 1.8x-reduction floor, expressed as the ratio the row carries)
 
@@ -1623,6 +1723,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_disagg")
            for r in rows):
         fam_rcs["disagg"] = check_serving_disagg(rows)
+    if any(r.get("bench", "").startswith("serving_ragged")
+           for r in rows):
+        fam_rcs["ragged"] = check_serving_ragged(rows)
     if any(r.get("bench", "").startswith("serving_autoscale")
            for r in rows):
         fam_rcs["autoscale"] = check_serving_autoscale(rows)

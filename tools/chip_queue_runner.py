@@ -161,6 +161,17 @@ QUEUE = [
     ("serving_quant",
      [sys.executable, "tools/serving_workload_bench.py", "--kv-quant"],
      {}),
+    # PR-16 addition: the ragged batched-prefill arm — mixed-churn /
+    # prefill-heavy / admission-burst traces through per-chunk vs
+    # ragged-lane engines (every lane row rides ONE fused fixed-shape
+    # prefill program per dispatch) plus the real-chip program-cache
+    # flatness probe and the dispatch-ahead fixed-clock identity
+    # check; bench_gate.py serving gates the serving_ragged family
+    # (full greedy parity, burst TTFT p95 >= 2x at equal budget,
+    # compile count flat across admission mixes, starvation bound)
+    ("serving_ragged",
+     [sys.executable, "tools/serving_workload_bench.py", "--ragged"],
+     {}),
     # PR-4 addition: the observability overhead arm — no-obs vs
     # tracing-off vs tracing-on wall time on one warmed engine;
     # bench_gate.py obs gates the tracing-off tax <= 2% over the

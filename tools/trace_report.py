@@ -369,6 +369,42 @@ def kv_quant_summary(events: list) -> dict | None:
                               for f in flips[:20]]}
 
 
+def ragged_summary(events: list) -> dict | None:
+    """Ragged batched-prefill evidence: engine prefill spans carry a
+    ``ragged=k`` arg (rows fused into that ONE call) when the lane
+    ran with ``ServingEngine(ragged_prefill=True)``. Returns the
+    ``trace_report_ragged`` row, or None for per-chunk traces —
+    whose report output stays byte-identical to pre-ragged."""
+    tagged = [e for e in events if e.get("ph") == "X"
+              and e.get("args", {}).get("ragged") is not None]
+    if not tagged:
+        return None
+    ks = [int(e["args"]["ragged"]) for e in tagged]
+    return {"bench": "trace_report_ragged",
+            "fused_calls": len(tagged),
+            "rows_fused": sum(ks),
+            "max_rows_per_call": max(ks),
+            "mean_rows_per_call": round(sum(ks) / len(ks), 4)}
+
+
+def ahead_summary(events: list) -> dict | None:
+    """Dispatch-ahead evidence: a decode span served from the
+    ahead-dispatched stash carries ``ahead=true``
+    (``ServingEngine(dispatch_ahead=True)`` — the turn's batch was
+    dispatched before the previous turn's bookkeeping finished).
+    Returns the ``trace_report_ahead`` overlap row, or None
+    otherwise — legacy report output stays byte-identical."""
+    dec = [e for e in events if e.get("ph") == "X"
+           and e.get("name") == "decode"]
+    served = [e for e in dec if e.get("args", {}).get("ahead")]
+    if not served:
+        return None
+    return {"bench": "trace_report_ahead",
+            "decode_spans": len(dec),
+            "ahead_served": len(served),
+            "ahead_frac": round(len(served) / len(dec), 4)}
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -694,6 +730,15 @@ def main(argv=None) -> int:
             # kv-quant traces only: absent otherwise, so pre-quant
             # --json output is byte-identical
             print(json.dumps(kvq_row))
+        rg_row = ragged_summary(events)
+        if rg_row is not None:
+            # ragged-prefill traces only: absent otherwise, so
+            # per-chunk --json output is byte-identical
+            print(json.dumps(rg_row))
+        ah_row = ahead_summary(events)
+        if ah_row is not None:
+            # dispatch-ahead traces only: absent otherwise
+            print(json.dumps(ah_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
