@@ -251,6 +251,23 @@ class PagedKVCache:
         self._page_bytes: tuple | None = None  # (fp, int8+scale) /page
         self._byte_budget: int | None = None
         self._compact_cb = None
+        # host-DRAM offload tier (hostmem serving): pages the eviction
+        # scan would recycle spill their content to a byte-budgeted
+        # HostArena instead of dying, keyed by FULL token prefix
+        # (root..page) so a spilled chain's identity survives device
+        # page-id recycling. _spilled maps that key -> True for every
+        # page this bookkeeper parked in the arena; spill/page-in data
+        # movement is the engine's (the _spill_cb / page_in import_cb
+        # closures price it on the virtual clock — this bookkeeper
+        # never touches device arrays). None/empty when unarmed: the
+        # resident+evictable+free census and every stat dict stay
+        # byte-identical to the pre-hostmem engine.
+        self._arena = None
+        self._spill_cb = None
+        self._host_page_bytes: tuple | None = None  # (fp, q) /page
+        self._spilled: dict = {}
+        self._spill_stats = {"spills": 0, "pageins": 0,
+                             "spill_refusals": 0}
         # pool generation: purge() bumps it. Content written under an
         # earlier epoch is unreachable after a purge (every key dropped,
         # every page back on the free list), so a restarted replica
@@ -351,6 +368,220 @@ class PagedKVCache:
             return occupied * q
         return (occupied - n_q) * fp + n_q * q
 
+    # --- host-DRAM offload tier (hostmem serving) ----------------------
+
+    def note_hostmem(self, arena, spill_cb,
+                     fp_bytes_per_page: int,
+                     q_bytes_per_page: int | None = None):
+        """Arm the host-arena spill tier. ``arena`` is a
+        ``serving.hostmem.HostArena``; ``spill_cb(page_id, quant)``
+        is the engine's export closure — it copies the page's device
+        content host-side (priced as one ``kv_pageout`` on the
+        virtual clock) and returns the opaque data blob the arena
+        stores. Per-page byte prices charge the arena budget: a page
+        sitting in the int8 tier spills at ``q_bytes_per_page``
+        (the kv_quant_page_bytes arithmetic carried across the tier
+        boundary), everything else at ``fp_bytes_per_page``."""
+        self._arena = arena
+        self._spill_cb = spill_cb
+        q = int(q_bytes_per_page) if q_bytes_per_page is not None \
+            else int(fp_bytes_per_page)
+        self._host_page_bytes = (int(fp_bytes_per_page), q)
+
+    def _spill_key(self, p) -> tuple | None:
+        """Page ``p``'s FULL token prefix (root..p, a page multiple of
+        tokens), reconstructed by walking parent keys — the identity a
+        spilled page keeps after its device id recycles. None for an
+        unpublished page or a broken walk (nothing to spill under)."""
+        parts = []
+        while p != 0:
+            key = self._page_key.get(p)
+            if key is None:
+                return None
+            parts.append(key[1])
+            p = key[0]
+        toks: tuple = ()
+        for seg in reversed(parts):
+            toks += seg
+        return toks
+
+    def _try_spill(self, p):
+        """Park evicted page ``p``'s content in the host arena before
+        its device id recycles. Refusal (arena budget exhausted, or an
+        unpublished page with no prefix identity) is silent: the page
+        simply dies exactly as it did pre-hostmem. A key the arena
+        already holds is NOT re-copied — same token prefix, same K/V
+        content."""
+        key = self._spill_key(p)
+        if key is None:
+            return
+        if key in self._spilled:
+            if key in self._arena:
+                return  # identical content already parked host-side
+            del self._spilled[key]  # arena LRU reclaimed it since —
+            # fall through and re-spill the fresh copy
+        quant = p in self._quant
+        fp, q = self._host_page_bytes
+        try:
+            data = self._spill_cb(p, quant)
+            self._arena.put(key, data, q if quant else fp,
+                            quant=quant, epoch=self.epoch)
+        except MemoryError:
+            self._spill_stats["spill_refusals"] += 1
+            return
+        self._spilled[key] = True
+        self._spill_stats["spills"] += 1
+
+    def _prune_spilled(self):
+        """Drop bookkeeping for keys the arena's own LRU reclaimed
+        behind our back (the arena owes the bookkeeper no callback;
+        reconciliation is lazy, before any read of ``_spilled``)."""
+        gone = [k for k in self._spilled if k not in self._arena]
+        for k in gone:
+            del self._spilled[k]
+
+    def spilled_extension(self, tokens, start: int) -> list:
+        """The spilled keys that would EXTEND ``tokens``' resident
+        chain past ``start`` cached tokens (a page multiple), in chain
+        order — the admission probe for a priced page-in. Stops at the
+        first hole: a mid-chain gap means the earlier pages' K/V is
+        gone and everything past it would be wrong-context."""
+        out = []
+        n = int(start)
+        ps = self.page_size
+        while n + ps <= len(tokens):
+            key = tuple(int(t) for t in tokens[:n + ps])
+            if key not in self._spilled \
+                    or self._arena.peek(key) is None:
+                break
+            out.append(key)
+            n += ps
+        return out
+
+    def page_in(self, seq_id, tokens, start: int, import_cb) -> int:
+        """Restore the spilled extension of ``tokens[:start]`` into
+        ``seq_id``'s chain: per spilled page, take one device page
+        (free list first, eviction — which may itself spill — when
+        dry), hand it to ``import_cb(page_id, entry)`` (the engine's
+        scatter closure, priced as one ``kv_pagein``), then publish it
+        resident under ``seq_id`` exactly as if a prefill had written
+        and registered it. Stops — cleanly, partial restores are
+        valid prefixes — when the pool cannot yield a page. Returns
+        tokens paged in (``lengths[seq_id]`` advanced past them, so
+        the prefill resumes beyond the restored prefix). Call between
+        ``acquire_prefix`` and ``allocate``; ``rollback_acquire``
+        stays exact because restored tokens are counted as hits."""
+        table = self.tables.get(seq_id)
+        if table is None:
+            raise KeyError(f"page_in: unknown sequence {seq_id!r}")
+        ps = self.page_size
+        n = int(start)
+        restored = 0
+        for key in self.spilled_extension(tokens, n):
+            if not self._free and not self._evictable:
+                break
+            if not self._free:
+                self._evict_lru()  # may itself SPILL, which may evict
+                # arena LRU entries — re-probe the key below
+            if not self._free:
+                break
+            entry = self._arena.peek(key)
+            if entry is None or entry.epoch != self.epoch:
+                break  # evicted arena-side just now, or pre-purge
+                # content that must never serve
+            p = self._free.pop()
+            entry = self._arena.take(key)
+            self._spilled.pop(key, None)
+            import_cb(p, entry)
+            self._refs[p] = 1
+            table.append(p)
+            parent = table[-2] if len(table) >= 2 else 0
+            pkey = (parent, key[n:n + ps])
+            self._prefix[pkey] = p
+            self._page_key[p] = pkey
+            self._children.setdefault(parent, set()).add(pkey)
+            if entry.quant:
+                self._quant.add(p)
+            n += ps
+            restored += ps
+            self._spill_stats["pageins"] += 1
+        if restored:
+            self._stats["hit_tokens"] += restored
+            self.lengths[seq_id] = n
+        return restored
+
+    def spill_chain(self, seq_id, tokens, owner: str) -> list:
+        """Preemption-as-swap: park ``seq_id``'s live chain content in
+        the arena PINNED under ``owner`` (the rid — a preempted
+        request's only K/V copy must survive arbitrary spill traffic
+        until it pages back in). Spills every FULL page covered by
+        ``lengths[seq_id]`` positions of ``tokens`` (prompt + emitted
+        history; the trailing partial page re-prefills on resume).
+        ALL-OR-NOTHING: if the arena refuses any page, every put/pin
+        this call made is rolled back and [] returns — the caller
+        then declines to preempt. Returns the pinned keys on success.
+        Pages stay allocated; the caller frees the sequence after."""
+        table = self.tables.get(seq_id)
+        if table is None:
+            raise KeyError(f"spill_chain: unknown sequence "
+                           f"{seq_id!r}")
+        ps = self.page_size
+        n_full = min(int(self.lengths.get(seq_id, 0)) // ps,
+                     len(table))
+        fp, q = self._host_page_bytes
+        put_keys, pinned_keys = [], []
+        try:
+            for i in range(n_full):
+                key = tuple(int(t) for t in tokens[:(i + 1) * ps])
+                p = table[i]
+                quant = p in self._quant
+                if key in self._spilled:
+                    e = self._arena.peek(key)
+                    if e is not None and e.owner is None:
+                        self._arena.pin(key, owner)
+                        pinned_keys.append(key)
+                    continue  # already parked (or pinned elsewhere —
+                    # equally protected); content is identical
+                data = self._spill_cb(p, quant)
+                self._arena.put(key, data, q if quant else fp,
+                                quant=quant, epoch=self.epoch,
+                                pin=owner)
+                self._spilled[key] = True
+                self._spill_stats["spills"] += 1
+                put_keys.append(key)
+        except MemoryError:
+            self._spill_stats["spill_refusals"] += 1
+            for key in put_keys:
+                self._arena.drop(key)
+                self._spilled.pop(key, None)
+                self._spill_stats["spills"] -= 1
+            for key in pinned_keys:
+                self._arena.unpin(key)
+            return []
+        return put_keys + pinned_keys
+
+    def drop_spilled_owner(self, owner: str) -> int:
+        """A preempted request was shed while requeued: its pinned
+        chain will never page back in — release the arena bytes and
+        forget the keys. Returns entries dropped."""
+        dropped = [k for k in list(self._spilled)
+                   if (e := self._arena.peek(k)) is not None
+                   and e.owner == owner]
+        for k in dropped:
+            self._arena.drop(k)
+            del self._spilled[k]
+        return len(dropped)
+
+    def unpin_spilled_owner(self, owner: str):
+        """Demote ``owner``'s still-pinned keys to the arena LRU (a
+        restored request consumed the keys it needed; leftovers —
+        shared-prefix pages that matched resident instead — go back
+        to being ordinary spilled cache)."""
+        for k in list(self._spilled):
+            e = self._arena.peek(k)
+            if e is not None and e.owner == owner:
+                self._arena.unpin(k)
+
     def allocate(self, seq_id, n_tokens: int):
         """Reserve pages so ``seq_id`` can hold n_tokens total. The
         free list is spent first; evictable LRU pages are reclaimed
@@ -408,6 +639,9 @@ class PagedKVCache:
             if kids and any(k in self._prefix for k in kids):
                 continue  # still a parent of live keys: not a leaf
             del self._evictable[p]
+            if self._arena is not None:
+                self._try_spill(p)  # park content host-side BEFORE the
+                # prefix identity (and the device id) dies below
             self._drop_keys(p)
             self._quant.discard(p)  # tier dies with the id: a recycled
             # page must never read stale int8 content
@@ -594,6 +828,14 @@ class PagedKVCache:
         self._quant.clear()  # both tiers go: pre-purge int8 content is
         # as untrusted as the full-precision pages
         self._free = list(range(n_pages - 1, 0, -1))
+        if self._arena is not None:
+            # the host tier dies with the pool: pre-purge spilled
+            # content is exactly as untrusted as pre-purge device
+            # pages (the epoch guard below would refuse it anyway —
+            # dropping keeps the arena census honest about capacity)
+            for key in self._spilled:
+                self._arena.drop(key)
+            self._spilled.clear()
         self.epoch += 1
 
     def export_chain(self, seq_id, n_tokens: int):
@@ -626,6 +868,17 @@ class PagedKVCache:
         # quantized page must still be occupied
         tier_ok = all(p in self._refs or p in self._evictable
                       for p in self._quant)
+        if self._arena is not None:
+            # the host tier extends the census: spilled is a distinct
+            # state (spill != leak, like retention != leak) — after
+            # reconciling arena-side LRU deaths, every spilled key
+            # must be live in the arena, and the arena's own
+            # pinned+evictable+free conservation must hold
+            self._prune_spilled()
+            if not self._arena.census_ok():
+                return False
+            if any(k not in self._arena for k in self._spilled):
+                return False
         return balanced and tier_ok
 
     def cache_stats(self) -> dict:
@@ -664,6 +917,14 @@ class PagedKVCache:
             sb = self.stored_bytes()
             if sb is not None:
                 out["stored_bytes"] = sb
+        if self._arena is not None:
+            # hostmem census bucket — present only when the tier is
+            # armed (hostmem=None keeps the dict byte-identical)
+            self._prune_spilled()
+            out["spilled_pages"] = len(self._spilled)
+            out["spills"] = self._spill_stats["spills"]
+            out["pageins"] = self._spill_stats["pageins"]
+            out["spill_refusals"] = self._spill_stats["spill_refusals"]
         return out
 
     def batch_views(self, seq_ids):

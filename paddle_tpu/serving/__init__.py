@@ -51,6 +51,8 @@ from .engine import (DecodeError, EngineClock,  # noqa: F401
                      load_engine_log, make_policy)
 from .faults import (FailoverConfig, FaultEvent,  # noqa: F401
                      FaultPlan, synthesize_fault_plan)
+from .hostmem import (HostArena, HostMemConfig,  # noqa: F401
+                      as_hostmem_config)
 from .metrics import (MetricsCollector, goodput_tokens,  # noqa: F401
                       jain_fairness)
 from .scheduler import (QoSScheduler, SchedDecision,  # noqa: F401
@@ -66,5 +68,6 @@ from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        synthesize_overload_trace,
                        synthesize_prefill_heavy_trace,
                        synthesize_recurring_prefix_trace,
+                       synthesize_session_trace,
                        synthesize_trace,
                        synthesize_zipf_adapter_trace, trace_stats)

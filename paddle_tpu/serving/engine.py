@@ -61,6 +61,7 @@ from ..models.nlp.llama_decode import (as_lora_config,
                                        tree_device_bytes)
 from ..ops.pallas.paged_attention import PagedKVCache
 from .adapters import AdapterCache, AdapterStore
+from .hostmem import HostArena, as_hostmem_config
 from .metrics import MetricsCollector
 from .scheduler import QoSScheduler, ServiceEstimator
 from .workload import Request, iter_jsonl_tolerant
@@ -287,6 +288,16 @@ class ServeResult:
     # in-flight device work (dispatch-ahead shrinks it). None on fixed
     # clocks and sessions; never serialized by save_log, so logs stay
     # byte-identical either way
+    hostmem_stats: Optional[Dict] = None  # the host-DRAM arena tier's
+    # per-run evidence (arena census + transfer counts, preempt/restore
+    # tallies, spilled-page census) when the engine carried hostmem=;
+    # None otherwise — the result shape every pre-hostmem consumer
+    # sees is unchanged
+    pages_spilled: Optional[int] = None  # pages parked host-side at
+    # run end — spilled pages are NOT device capacity (pages_free_end
+    # never counts them; spill ≠ leak, the PR-5 retention rule one
+    # tier down), but an offline replay needs the census to balance.
+    # None at hostmem=None keeps save_log byte-identical
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -306,6 +317,10 @@ class ServeResult:
         error mid-dump can never leave a truncated file where the
         previous incident log used to be."""
         tag = {} if self.replica is None else {"replica": self.replica}
+        # spilled-page census joins the meta line ONLY on hostmem runs
+        # (key absent otherwise — legacy logs stay byte-identical)
+        spill = {} if self.pages_spilled is None \
+            else {"pages_spilled": self.pages_spilled}
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -313,7 +328,8 @@ class ServeResult:
                     "kind": "meta", "policy": self.policy,
                     "scheduler": self.scheduler,
                     "pages_total": self.pages_total,
-                    "pages_free_end": self.pages_free_end, **tag})
+                    "pages_free_end": self.pages_free_end,
+                    **spill, **tag})
                     + "\n")
                 for d in self.decisions:
                     f.write(json.dumps({"kind": "decision", **d, **tag})
@@ -637,7 +653,7 @@ class ServingEngine:
                  slo=None, tp=None, adapters=None, lora=None,
                  spec=None, spec_draft=None, kv_quant=None,
                  kv_quant_budget=None, ragged_prefill: bool = False,
-                 dispatch_ahead: bool = False):
+                 dispatch_ahead: bool = False, hostmem=None):
         # ``tp``: None (byte-identical to the single-device engine —
         # outputs, slot logs, metrics records, registry contents), a
         # TPConfig, or an int degree. With a MODEL it is threaded into
@@ -1064,6 +1080,68 @@ class ServingEngine:
                 "dispatch_ahead=True cannot compose with kv_quant=: "
                 "pressure/int8 tier moves rewrite pool pages between "
                 "turns underneath a dispatched-ahead batch")
+        # --- host-DRAM offload arena (inert at hostmem=None) --------
+        # None: capacity ends at HBM, byte-identical to every earlier
+        # PR (outputs, slot logs, records, report keys, registry).
+        # An int byte budget or HostMemConfig arms the THIRD memory
+        # tier: pages parked in the evictable LRU spill to a budgeted
+        # host arena instead of dying when allocate() recycles them,
+        # prefix hits on spilled chains page back in at priced
+        # kv_pagein/kv_pageout transfers, and under a QoS scheduler
+        # the engine gains the rung between degrade and shed —
+        # PREEMPT: swap a low-priority running row's chain out,
+        # requeue it with its emitted tokens, swap back in on
+        # re-admission.
+        self.hostmem = as_hostmem_config(hostmem)
+        self._ctr_pageouts = None
+        self._ctr_pageins = None
+        self._ctr_preempts = None
+        self._ctr_restores = None
+        if self.hostmem is not None:
+            if spec is not None:
+                raise ValueError(
+                    "hostmem= does not compose with spec= — the "
+                    "draft pool rides the target's page ids but "
+                    "spills no draft K/V, so a paged-in chain would "
+                    "hand the draft a holed cache")
+            if self.dispatch_ahead:
+                raise ValueError(
+                    "hostmem= cannot compose with dispatch_ahead=: "
+                    "page-ins and preemption swaps rewrite pool "
+                    "pages between turns underneath a "
+                    "dispatched-ahead batch")
+            # the arena tier is paged-only, exactly like tp: the
+            # dense wave cache has no page pool to spill from
+            # (self.policy was already built above — rebuild it from
+            # the coerced spec)
+            policy = _coerce_paged_only(
+                policy, "with hostmem",
+                "the dense wave cache has no page pool to spill")
+            self.policy = make_policy(policy)
+            if scheduler is not None \
+                    and hasattr(scheduler, "track_preempt"):
+                # arm the preempt rung: the scheduler's victim picker
+                # answers only when a swap target exists (the PR-11
+                # tracked-only-when-armed discipline)
+                scheduler.track_preempt = True
+            # created ONLY when the arena is configured, so
+            # HBM-only runs leave no trace in the registry (PR-5
+            # convention)
+            _hc = obs_metrics.REGISTRY.counter
+            self._ctr_pageouts = _hc(
+                "serving_kv_pageouts_total",
+                "device pages spilled to the host arena")
+            self._ctr_pageins = _hc(
+                "serving_kv_pageins_total",
+                "host arena pages restored into the device pool")
+            self._ctr_preempts = _hc(
+                "serving_preemptions_total",
+                "running rows swapped out to the host arena by the "
+                "QoS preempt rung")
+            self._ctr_restores = _hc(
+                "serving_preempt_restores_total",
+                "preempted rows re-admitted with their chain swapped "
+                "back in")
         self.decode_chunk = decode_chunk
         # page-footprint slack beyond prompt+budget: the deepest
         # write a decode turn can land. Plain decode_n writes at most
@@ -1294,6 +1372,112 @@ class ServingEngine:
             out["flips"] = list(qst["flips"])
             out["pages_compacted"] = qst["pages_compacted"]
         return out
+
+    def _arm_hostmem(self, book: PagedKVCache, clock, m,
+                     tr=None) -> Optional[dict]:
+        """Arm the run bookkeeper's host-arena spill tier: a FRESH
+        arena per run (two seeded replays spill and page identically),
+        the per-page byte prices, and the export closure the book
+        invokes whenever an evicted page spills — each crossing is
+        priced as one ``kv_pageout`` on the virtual clock (the
+        ``adapter_upload``/``KVHandoff`` transfer-pricing pattern).
+        Returns the per-run hostmem state dict, or None at
+        hostmem=None (every caller then stays byte-identical)."""
+        if self.hostmem is None:
+            return None
+        arena = HostArena(self.hostmem.byte_budget)
+        # full-precision per-page price: explicit config override,
+        # else the factory's advertisement, else the live pool's
+        # measured bytes / page count
+        fp = self.hostmem.page_bytes
+        if fp is None:
+            fp = getattr(self.serving, "page_host_bytes_", None)
+        if fp is None:
+            pb = getattr(self.serving, "page_bytes_", None)
+            fp = pb[0] if pb is not None else None
+        if fp is None:
+            tfn = getattr(self.serving, "pool_total_bytes", None)
+            total = int(tfn(self._pools)) if tfn is not None \
+                else sum(int(getattr(a, "nbytes", 0))
+                         for a in jax.tree_util.tree_leaves(self._pools))
+            fp = max(1, total // max(1, self.n_pool_pages))
+        qb = None
+        if self.kv_quant is not None:
+            # int8 pages spill at their int8+scale price — the
+            # kv_quant_page_bytes arithmetic carried across the tier
+            pb = getattr(self.serving, "page_bytes_", None)
+            qb = pb[1] if pb is not None else None
+        hst = {"arena": arena, "fp": int(fp), "qb": qb,
+               "preempts": 0, "restores": 0,
+               "resume_prefix": {}, "preempted": set()}
+
+        def spill_cb(p, quant):
+            data = self._timed(
+                tr, clock, "kv_pageout",
+                lambda: self.export_kv_pages([p]),
+                cost=self._hm_cost("kv_pageout", quant, hst),
+                page=p)
+            m.on_pageout(clock.now(), 1)
+            self._ctr_pageouts.inc()
+            return data
+
+        book.note_hostmem(arena, spill_cb, fp, qb)
+        return hst
+
+    def _hm_cost(self, kind, quant, hst) -> Optional[float]:
+        """Fixed-clock transfer price override for one page crossing:
+        an int8 page moves fewer bytes, so it pays the flat
+        ``kv_pageout``/``kv_pagein`` cost scaled by its byte ratio.
+        None (the clock's own default pricing) on measured clocks and
+        for full-precision pages."""
+        if self.clock_mode != "fixed" or not quant \
+                or hst["qb"] is None or not hst["fp"]:
+            return None
+        base = (self.fixed_costs or {}).get(kind, 1.0)
+        return base * (hst["qb"] / hst["fp"])
+
+    def _pagein_page(self, p, entry, rid, clock, m, tr, hst):
+        """The import closure ``PagedKVCache.page_in`` invokes per
+        restored page: scatter the arena blob into the device pool at
+        page ``p``, priced as one ``kv_pagein``."""
+        self._timed(
+            tr, clock, "kv_pagein",
+            lambda: self.import_kv_pages([p], entry.data),
+            rid=rid,
+            cost=self._hm_cost("kv_pagein", entry.quant, hst),
+            page=p)
+        m.on_pagein(clock.now(), 1)
+        self._ctr_pageins.inc()
+
+    @staticmethod
+    def _stitch_resumes(outputs, hst: Optional[dict]):
+        """A preempted request's stream was emitted in two (or more)
+        lives: the tokens it streamed before each swap-out, then what
+        its resumed run produced. The client saw ONE stream — the
+        result reports it as one (a preempted-then-shed request keeps
+        the partial stream it was actually served)."""
+        if hst is None:
+            return
+        for rid, pre in hst["resume_prefix"].items():
+            outputs[rid] = list(pre) + outputs.get(rid, [])
+
+    def _hostmem_result(self, book: PagedKVCache,
+                        hst: Optional[dict]) -> Optional[dict]:
+        """The ``ServeResult.hostmem_stats`` block (None at
+        hostmem=None — the pre-hostmem result shape)."""
+        if hst is None:
+            return None
+        cs = book.cache_stats()
+        return {"arena": hst["arena"].stats(),
+                "arena_census_ok": hst["arena"].census_ok(),
+                "spilled_pages": cs.get("spilled_pages", 0),
+                "spills": cs.get("spills", 0),
+                "pageins": cs.get("pageins", 0),
+                "spill_refusals": cs.get("spill_refusals", 0),
+                "preempts": hst["preempts"],
+                "restores": hst["restores"],
+                "preempted_rids": sorted(hst["preempted"]
+                                         | set(hst["resume_prefix"]))}
 
     @property
     def _pools(self):
@@ -1666,6 +1850,7 @@ class ServingEngine:
         # tables/lengths/free-list/prefix refcounts — device pages live
         # in the factory pools, written by prefill/decode_n
         self._note_pool(book, m)
+        hst = self._arm_hostmem(book, clock, m, tr)
         acache = self._make_adapter_cache()
         spst = self._make_spec_state()
         qst = self._make_quant_state()
@@ -1746,7 +1931,7 @@ class ServingEngine:
                             wave, book, clock, m, active, free_slots,
                             slot_log, prefix_cached, seen_groups,
                             outputs, tr=tr, lane=lane, acache=acache,
-                            spst=spst)
+                            spst=spst, hst=hst)
                         prefill_tokens += ptoks
                         for r in wave[:n_adm]:  # possibly reordered —
                             waiting.remove(r)   # remove by identity
@@ -1809,6 +1994,7 @@ class ServingEngine:
                 else:
                     obs_trace.deactivate()
         self._close_trace(tr)
+        self._stitch_resumes(outputs, hst)
         return ServeResult(policy=self.policy.name, outputs=outputs,
                            metrics=m, decisions=decisions,
                            slot_log=slot_log, prefix_cached=prefix_cached,
@@ -1827,7 +2013,13 @@ class ServingEngine:
                                        else spst.stats()),
                            kv_quant_stats=self._quant_result(book,
                                                              qst),
-                           overhead=self._overhead_row(clock, run_w0))
+                           overhead=self._overhead_row(clock, run_w0),
+                           hostmem_stats=self._hostmem_result(book,
+                                                              hst),
+                           pages_spilled=(
+                               None if hst is None else
+                               book.cache_stats().get(
+                                   "spilled_pages", 0)))
 
     def _overhead_row(self, clock, run_w0) -> Optional[Dict]:
         """The measured-clock host-overhead decomposition:
@@ -1891,6 +2083,7 @@ class ServingEngine:
         book = PagedKVCache(self.n_pool_pages, self.page_size,
                             kv_heads=1, head_dim=1)
         self._note_pool(book, m)
+        hst = self._arm_hostmem(book, clock, m, tr)
         acache = self._make_adapter_cache()
         spst = self._make_spec_state()
         qst = self._make_quant_state()
@@ -1924,6 +2117,13 @@ class ServingEngine:
                 self._ctr_shed.inc()
                 if acache is not None:
                     acache.forget_pending(r.rid)
+                if hst is not None and r.rid in hst["preempted"]:
+                    # a preempted request shed while requeued: its
+                    # pinned chain will never page back in — release
+                    # the arena bytes (the partial stream it was
+                    # served survives via _stitch_resumes)
+                    hst["preempted"].discard(r.rid)
+                    book.drop_spilled_owner(r.rid)
                 if tr is not None:
                     tr.instant("shed", t=t, track="scheduler",
                                rid=r.rid, reason=reason,
@@ -1999,7 +2199,7 @@ class ServingEngine:
                                 wave, book, clock, m, active, free_slots,
                                 slot_log, prefix_cached, seen_groups,
                                 outputs, tr=tr, lane=lane,
-                                acache=acache, spst=spst)
+                                acache=acache, spst=spst, hst=hst)
                             prefill_tokens += ptoks
                             if n_adm:
                                 dt = clock.now() - t0
@@ -2014,6 +2214,19 @@ class ServingEngine:
                                 decision["admitted"] = n_adm
                                 decisions.append(decision)
                                 self._wave_instant(tr, decision)
+                                progressed = True
+                            elif hst is not None and active \
+                                    and self._preempt_turn(
+                                        wave[0], book, clock, m,
+                                        active, free_slots, slot_log,
+                                        sched, hst, _shed, tr=tr,
+                                        acache=acache):
+                                # the rung between degrade and shed:
+                                # a fully blocked wave swaps ONE
+                                # lower-priority running row out to
+                                # the arena; the blocked request
+                                # stays queued and admits next turn
+                                # into the freed slot/pages
                                 progressed = True
                             elif not active and not lane:
                                 raise RuntimeError(
@@ -2072,6 +2285,7 @@ class ServingEngine:
                 else:
                     obs_trace.deactivate()
         self._close_trace(tr)
+        self._stitch_resumes(outputs, hst)
         return ServeResult(policy=self.policy.name, outputs=outputs,
                            metrics=m, decisions=decisions,
                            slot_log=slot_log,
@@ -2092,7 +2306,13 @@ class ServingEngine:
                                        else spst.stats()),
                            kv_quant_stats=self._quant_result(book,
                                                              qst),
-                           overhead=self._overhead_row(clock, run_w0))
+                           overhead=self._overhead_row(clock, run_w0),
+                           hostmem_stats=self._hostmem_result(book,
+                                                              hst),
+                           pages_spilled=(
+                               None if hst is None else
+                               book.cache_stats().get(
+                                   "spilled_pages", 0)))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -2119,11 +2339,77 @@ class ServingEngine:
             return True
         return not pending and not active
 
+    def _preempt_turn(self, blocked, book, clock, m, active,
+                      free_slots, slot_log, sched, hst, shed_fn,
+                      tr=None, acache=None) -> bool:
+        """The QoS rung between degrade and shed: a wave the pool/slots
+        fully blocked asks the scheduler for ONE strictly-lower-priority
+        running victim, swaps its chain out to the host arena (pinned
+        under its rid — the only K/V copy), releases its slot and pages,
+        and requeues it carrying its emitted tokens (the PR-7
+        resume-from-prefix arithmetic; re-admission swaps the chain
+        back in instead of recomputing it). One victim per turn keeps
+        the actuation deterministic and observable. Returns True when
+        a victim actually swapped out."""
+        running = [(sid, row.req, len(row.out))
+                   for sid, row in active.items()]
+        vic = sched.preempt_victim(clock.now(), blocked, running)
+        if vic is None:
+            return False
+        row = active[vic]
+        r = row.req
+        keep = len(row.out)
+        # the resumed request must still fit one slot (padded longer
+        # prompt + remaining budget) — decline otherwise
+        if self._footprint_len(len(r.prompt) + keep,
+                               r.max_new_tokens - keep) > self.max_len:
+            return False
+        history = list(r.prompt) + list(row.out)
+        keys = book.spill_chain(vic, history, owner=vic)
+        if not keys and int(book.lengths.get(vic, 0)) >= self.page_size:
+            # the arena refused (atomically — nothing moved): a swap
+            # that would DISCARD the chain is a worse shed, so the
+            # victim keeps decoding and the blocked request waits for
+            # ordinary finishes
+            return False
+        # tear the row down WITHOUT finishing it: pages freed (their
+        # content is safe in the arena), slot released, no on_finish —
+        # the request is still live, just queued again
+        active.pop(vic)
+        book.free(vic)
+        self._g_resident.set(float(len(book._refs)))
+        if acache is not None and r.adapter is not None:
+            acache.release(r.adapter, vic)
+            self._note_adapters(acache, m, clock.now())
+        free_slots.append(row.slot)
+        free_slots.sort()
+        t = clock.now()
+        slot_log.append((round(t, 6), "release", vic, row.slot))
+        hst["preempts"] += 1
+        self._ctr_preempts.inc()
+        m.on_preempt(vic, t, emitted=keep)
+        hst["resume_prefix"][vic] = (hst["resume_prefix"].get(vic, [])
+                                     + list(row.out))
+        hst["preempted"].add(vic)
+        if tr is not None:
+            tr.add_span(vic, row.t0, t - row.t0,
+                        track=f"slot/{row.slot}", backend="paged")
+            tr.instant("preempt", t=t, track="scheduler", rid=vic,
+                       emitted=keep, pages_spilled=len(keys),
+                       tenant=r.tenant)
+        res = dataclasses.replace(
+            r, prompt=tuple(history),
+            max_new_tokens=r.max_new_tokens - keep,
+            cancel_after=(max(1, r.cancel_after - keep)
+                          if r.cancel_after is not None else None))
+        shed_fn(sched.enqueue(res, t))
+        return True
+
     # --- paged backend ----------------------------------------------------
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
                      slot_log, prefix_cached, seen_groups, outputs,
                      tr=None, lane=None, sink=None, acache=None,
-                     spst=None):
+                     spst=None, hst=None):
         """Returns (admitted, prefill chunks computed, prefill tokens
         computed) for this wave. With ``lane`` (the async prefill
         lane), admission only RESERVES — pages, slot, bookkeeping —
@@ -2178,6 +2464,17 @@ class ServingEngine:
             n_cached = 0
             if self.prefix_cache:
                 n_cached = book.acquire_prefix(sid, list(r.prompt))
+                if hst is not None:
+                    # PRICED page-in: the spilled extension of the
+                    # resident match swaps back into fresh device
+                    # pages (one kv_pagein each) and counts as cached
+                    # — the prefill resumes past it exactly as past a
+                    # resident hit. A preempted request's swapped
+                    # chain restores through this same path.
+                    n_cached += book.page_in(
+                        sid, list(r.prompt), n_cached,
+                        lambda p, e, _s=sid: self._pagein_page(
+                            p, e, _s, clock, m, tr, hst))
             ev0 = book._stats["evictions"]
             try:
                 book.allocate(sid, self._footprint(r))
@@ -2203,6 +2500,20 @@ class ServingEngine:
                     tr.instant("prefix_evict", t=clock.now(),
                                track="engine", pages=d_ev, rid=sid)
             book.lengths[sid] = len(r.prompt)
+            if hst is not None and sid in hst["preempted"]:
+                # the preempted request is BACK: leftover pinned pages
+                # demote to ordinary spilled cache (the page-ins above
+                # already priced the swap-in; whatever the pool could
+                # not take re-prefills below, same tokens either way)
+                hst["preempted"].discard(sid)
+                book.unpin_spilled_owner(sid)
+                hst["restores"] += 1
+                self._ctr_restores.inc()
+                m.on_restore(sid, clock.now())
+                if tr is not None:
+                    tr.instant("restore", t=clock.now(),
+                               track="scheduler", rid=sid,
+                               tenant=r.tenant)
             slot = free_slots.pop(0)
             T = self._pad_len(len(r.prompt))
             toks = np.zeros((1, T), np.int32)
@@ -3111,6 +3422,11 @@ class EngineSession:
         self.book = PagedKVCache(eng.n_pool_pages, eng.page_size,
                                  kv_heads=1, head_dim=1)
         eng._note_pool(self.book, self.m)
+        # per-session host arena (hostmem= engines; None otherwise):
+        # each replica owns its spill tier — eviction spill, priced
+        # page-in and the QoS preempt rung all work per session
+        self.hst = eng._arm_hostmem(self.book, self.clock, self.m,
+                                    tracer)
         # per-session adapter cache (multi-model serving; None when
         # the engine is single-model): each replica owns its bank —
         # residency is the signal adapter-aware placement routes on
@@ -3590,6 +3906,12 @@ class EngineSession:
             eng._ctr_shed.inc()
             if self.acache is not None:
                 self.acache.forget_pending(r.rid)
+            if self.hst is not None \
+                    and r.rid in self.hst["preempted"]:
+                # preempted-then-shed: the pinned chain never pages
+                # back in — release its arena bytes
+                self.hst["preempted"].discard(r.rid)
+                self.book.drop_spilled_owner(r.rid)
             if self.tr is not None:
                 self.tr.instant("shed", t=t, track="scheduler",
                                 rid=r.rid, reason=reason,
@@ -3753,7 +4075,8 @@ class EngineSession:
             self.slot_log, self.prefix_cached, self.seen_groups,
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
-                  else None), acache=self.acache, spst=self.spst)
+                  else None), acache=self.acache, spst=self.spst,
+            hst=self.hst)
         self.prefill_tokens += ptoks
         for r in wave[:n_adm]:
             self.waiting.remove(r)  # possibly reordered: by identity
@@ -3804,7 +4127,8 @@ class EngineSession:
             self.slot_log, self.prefix_cached, self.seen_groups,
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
-                  else None), acache=self.acache, spst=self.spst)
+                  else None), acache=self.acache, spst=self.spst,
+            hst=self.hst)
         self.prefill_tokens += ptoks
         if n_adm:
             dt = clock.now() - t0
@@ -3816,6 +4140,13 @@ class EngineSession:
             decision["admitted"] = n_adm
             self.decisions.append(decision)
             eng._wave_instant(tr, decision)
+            return True
+        if self.hst is not None and self.active \
+                and eng._preempt_turn(wave[0], self.book, clock, m,
+                                      self.active, self.free_slots,
+                                      self.slot_log, self.sched,
+                                      self.hst, self._shed, tr=tr,
+                                      acache=self.acache):
             return True
         if not self.active and not self.lane \
                 and not self.import_queue:
@@ -3887,6 +4218,7 @@ class EngineSession:
                 if target is None:
                     break  # everything left this turn was shed
                 self.clock.advance_to(target)
+        ServingEngine._stitch_resumes(self.outputs, self.hst)
         self._finished = ServeResult(
             policy=self.eng.policy.name, outputs=self.outputs,
             metrics=self.m, decisions=self.decisions,
@@ -3909,5 +4241,10 @@ class EngineSession:
             spec_stats=(None if self.spst is None
                         else self.spst.stats()),
             kv_quant_stats=self.eng._quant_result(self.book,
-                                                  self.qst))
+                                                  self.qst),
+            hostmem_stats=self.eng._hostmem_result(self.book,
+                                                   self.hst),
+            pages_spilled=(
+                None if self.hst is None else
+                self.book.cache_stats().get("spilled_pages", 0)))
         return self._finished

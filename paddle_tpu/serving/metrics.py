@@ -127,6 +127,12 @@ class MetricsCollector:
         # PR-5 presence convention)
         self._kv_quant = {"mode": None, "flips": 0, "compactions": 0,
                           "pages": 0}
+        # host-arena tier totals (engine-fed); the report grows its
+        # hostmem block ONLY when a page actually crossed the tier
+        # boundary or a preemption fired, so hostmem=None runs keep
+        # their records byte-identical (the PR-5 presence convention)
+        self._hostmem = {"pageouts": 0, "pageins": 0,
+                         "preempts": 0, "restores": 0}
         # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
         # request's FINAL record at finish/shed plus queue/lane depth
         # samples — the one seam through which the streaming SLO layer
@@ -273,6 +279,32 @@ class MetricsCollector:
         int8 (their prefix keys intact — nothing was forgotten)."""
         self._kv_quant["compactions"] += 1
         self._kv_quant["pages"] += int(pages)
+
+    def on_pageout(self, t: float, pages: int):
+        """``pages`` device pages spilled to the host arena (eviction
+        spill or a preemption swap-out) — each paid one priced
+        ``kv_pageout`` transfer on the engine clock."""
+        self._hostmem["pageouts"] += int(pages)
+
+    def on_pagein(self, t: float, pages: int):
+        """``pages`` arena pages restored into the device pool at
+        admission (a prefix hit on a spilled chain, or a preempted
+        request swapping back in) — each paid one priced
+        ``kv_pagein`` transfer."""
+        self._hostmem["pageins"] += int(pages)
+
+    def on_preempt(self, rid: str, t: float, emitted: int):
+        """The QoS preempt rung fired: running row ``rid`` (with
+        ``emitted`` tokens already streamed) swapped its chain out to
+        the host arena and requeued — capacity surrendered to a
+        higher class WITHOUT discarding the work."""
+        self._hostmem["preempts"] += 1
+
+    def on_restore(self, rid: str, t: float):
+        """A preempted request re-admitted: its swapped chain paged
+        back in (or re-prefilled where the arena had let go) and its
+        stream resumes exactly where it stopped."""
+        self._hostmem["restores"] += 1
 
     def forget(self, rid: str):
         """Erase every trace of ``rid`` from this collector — the
@@ -444,6 +476,14 @@ class MetricsCollector:
                 # watches (actual stored: quantized pages priced at
                 # int8+scale size)
                 rec["pool_bytes_per_device"] = self._pool_dev_bytes
+        if any(self._hostmem.values()):
+            # host-arena tier block, present only when a page actually
+            # crossed the tier boundary or a preemption fired (same
+            # convention): hostmem=None replays stay byte-identical
+            rec["kv_pageouts"] = self._hostmem["pageouts"]
+            rec["kv_pageins"] = self._hostmem["pageins"]
+            rec["preemptions"] = self._hostmem["preempts"]
+            rec["preempt_restores"] = self._hostmem["restores"]
         if slo_ttft is not None and ttfts:
             rec["slo_ttft"] = slo_ttft
             rec["slo_ttft_attained"] = round(

@@ -218,6 +218,14 @@ class QoSScheduler:
         # shedding, the gentler rung below the degrade clamp.
         self.track_pressure = False
         self._pressure_open: List = []
+        # preemption-as-swap rung for the HOST-ARENA tier
+        # (``ServingEngine(hostmem=...)`` arms it; same tracked-only-
+        # when-armed discipline): when a wave candidate blocks on
+        # capacity, ``preempt_victim`` names the running row worth
+        # swapping OUT to the host arena so the blocked request can
+        # run — the rung between degrade (shorter answers) and shed
+        # (no answer): the victim still finishes, just later.
+        self.track_preempt = False
         self.reset()
 
     # --- state ------------------------------------------------------------
@@ -485,6 +493,37 @@ class QoSScheduler:
             f"degradation tier ({tiers[-1]}) finishes past the "
             f"deadline (deadline in {max(0.0, dl - now):.3f} units, "
             f"estimated service {t0 - now + est.decode:.3f}+)"), 0.0
+
+    def preempt_victim(self, now: float, blocked: Request,
+                       running: List[Tuple[str, Request, int]]) \
+            -> Optional[str]:
+        """Name the running row to swap out so ``blocked`` (a selected
+        wave member the engine could not admit for capacity) can run —
+        or None when no row is worth displacing. Armed via
+        ``track_preempt`` (always None untracked: the engine falls
+        through to the legacy stay-queued/shed path bit-for-bit).
+
+        ``running`` is the engine's view of in-flight rows as
+        ``(rid, request, emitted_tokens)``. A victim must be STRICTLY
+        lower priority than the blocked request's effective (aged)
+        priority — equal-priority swapping would thrash — and must
+        still have decode budget left (displacing a row about to
+        finish buys nothing and pays two transfers). Among eligible
+        victims: lowest priority first, then fewest emitted tokens
+        (the least sunk decode work re-queued), then rid for
+        determinism."""
+        if not self.track_preempt:
+            return None
+        e = self._q.get(blocked.rid)
+        want = self._eff_priority(e, now) if e is not None \
+            else blocked.priority
+        cands = [(rid, r, emitted) for rid, r, emitted in running
+                 if r.priority < want
+                 and emitted < r.max_new_tokens - 1]
+        if not cands:
+            return None
+        return min(cands,
+                   key=lambda c: (c[1].priority, c[2], c[0]))[0]
 
     def commit(self, rid: str, budget: Optional[int] = None):
         """The engine ADMITTED ``rid``: leave the queue and charge the

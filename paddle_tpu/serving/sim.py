@@ -116,6 +116,12 @@ class SimServing:
         self.kv_quant_ = kv_quant
         self.page_bytes_ = None if kv_quant is None else \
             (page_size * 8, page_size * 4 + 4)
+        # the host-arena tier's full-precision per-page price,
+        # advertised UNCONDITIONALLY (the int64 token pool is 8
+        # bytes/token whether or not a quant tier is armed) — the
+        # engine's hostmem= arming reads it so arena budgets price
+        # identically with and without kv_quant
+        self.page_host_bytes_ = page_size * 8
         self.dense = PagedOnlyDense(_SIM_DENSE_REASON)
         if vocab < 3:
             raise ValueError("vocab must be >= 3")

@@ -43,6 +43,16 @@ class Request:
     byte-identical to pre-adapter engines). The JSONL record carries
     the key only when set, so adapter-less traces round-trip
     byte-identically.
+
+    ``session``/``turn`` mark multi-turn conversation membership
+    (``synthesize_session_trace``): every turn of a session carries
+    the session id and its 1-based turn index, and each turn's prompt
+    EXTENDS the previous turn's — the shape whose round-2 prefixes
+    the KV memory hierarchy serves from swapped-in pages. Both
+    default None (one-shot requests, every legacy trace), and the
+    JSONL record carries the keys only when set — the
+    ``Request.adapter`` convention, so session-less traces round-trip
+    byte-identically.
     """
 
     rid: str
@@ -55,6 +65,8 @@ class Request:
     priority: int = 0
     deadline_ms: Optional[float] = None
     adapter: Optional[str] = None
+    session: Optional[str] = None
+    turn: Optional[int] = None
 
     def to_json(self) -> dict:
         d = {"rid": self.rid, "arrival": self.arrival,
@@ -72,6 +84,10 @@ class Request:
             d["deadline_ms"] = self.deadline_ms
         if self.adapter is not None:
             d["adapter"] = self.adapter
+        if self.session is not None:
+            d["session"] = self.session
+        if self.turn is not None:
+            d["turn"] = self.turn
         return d
 
     @staticmethod
@@ -84,7 +100,9 @@ class Request:
                        tenant=d.get("tenant"),
                        priority=int(d.get("priority", 0)),
                        deadline_ms=d.get("deadline_ms"),
-                       adapter=d.get("adapter"))
+                       adapter=d.get("adapter"),
+                       session=d.get("session"),
+                       turn=(int(d["turn"]) if "turn" in d else None))
 
     def deadline_time(self) -> Optional[float]:
         """Absolute deadline in clock units (None when unbounded)."""
@@ -683,6 +701,68 @@ def synthesize_zipf_adapter_trace(seed: int = 0,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def synthesize_session_trace(seed: int = 0, n_sessions: int = 8, *,
+                             turns: int = 3,
+                             think_time: float = 40.0,
+                             first_prompt_len: Tuple[int, int]
+                             = (16, 32),
+                             turn_prompt_len: Tuple[int, int] = (4, 8),
+                             output_len: Tuple[int, int] = (4, 8),
+                             vocab_size: int = 128,
+                             mean_interarrival: float = 2.0,
+                             rid_prefix: str = "s",
+                             start: float = 0.0) -> List[Request]:
+    """The MULTI-TURN workload — the real shape of million-user chat
+    traffic, and what the KV memory hierarchy is gated on. Each of
+    ``n_sessions`` conversations opens with a ``first_prompt_len``
+    prompt, then issues ``turns - 1`` follow-ups: turn ``k``'s prompt
+    is turn ``k-1``'s prompt EXTENDED by fresh ``turn_prompt_len``
+    tokens, arriving an exponential ``think_time`` gap after the
+    previous turn — long enough (size it far past a turn's service
+    time) that the session's prefix pages have left the running set
+    and only the retention LRU or the host arena can serve round 2
+    from cache instead of recomputing.
+
+    Session openers arrive ``mean_interarrival`` apart (exponential),
+    so sessions overlap and the resident pool must juggle many cold
+    prefixes at once — the pressure that makes spill-to-host pay.
+    rids are ``{rid_prefix}{j}.t{k}`` (turns 1-based) and every
+    request carries ``session={rid_prefix}{j}``/``turn=k``, so
+    benches split turn cohorts without a side channel. Deterministic
+    in every field; JSONL round-trips via ``save_trace``/
+    ``load_trace`` (legacy session-less traces stay byte-identical —
+    the keys are emitted only when set)."""
+    if n_sessions < 1 or turns < 1:
+        raise ValueError("need >= 1 session of >= 1 turn")
+    if think_time <= 0 or mean_interarrival <= 0:
+        raise ValueError("think_time and mean_interarrival must be "
+                         "> 0")
+    rng = np.random.default_rng(seed)
+    reqs: List[Request] = []
+    t0 = start
+    for j in range(n_sessions):
+        t0 += float(rng.exponential(mean_interarrival))
+        sid = f"{rid_prefix}{j}"
+        plen = int(rng.integers(first_prompt_len[0],
+                                first_prompt_len[1] + 1))
+        prompt = tuple(int(x) for x in rng.integers(1, vocab_size,
+                                                    plen))
+        t = t0
+        for k in range(1, turns + 1):
+            if k > 1:
+                t += float(rng.exponential(think_time))
+                ext = int(rng.integers(turn_prompt_len[0],
+                                       turn_prompt_len[1] + 1))
+                prompt = prompt + tuple(
+                    int(x) for x in rng.integers(1, vocab_size, ext))
+            budget = int(rng.integers(output_len[0],
+                                      output_len[1] + 1))
+            reqs.append(Request(
+                rid=f"{sid}.t{k}", arrival=t, prompt=prompt,
+                max_new_tokens=budget, session=sid, turn=k))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def _profile_times(rng, n: int, span: float, shape) -> np.ndarray:
     """``n`` sorted arrival times over ``[0, span]`` drawn from an
     inhomogeneous Poisson process with relative rate ``shape`` (an
@@ -1077,4 +1157,12 @@ def trace_stats(trace: Sequence[Request]) -> dict:
         out["adapters"] = adapters
         out["adapter_requests"] = sum(
             1 for r in trace if r.adapter is not None)
+    sessions = sorted({r.session for r in trace
+                       if r.session is not None})
+    if sessions:
+        # only session-carrying traces grow these keys (one-shot
+        # trace stats stay byte-identical)
+        out["sessions"] = len(sessions)
+        out["session_turns"] = sum(
+            1 for r in trace if r.session is not None)
     return out

@@ -1,0 +1,645 @@
+"""KV memory hierarchy: the host-DRAM offload arena tier.
+
+The claims: ``HostArena`` is the third instance of the budgeted-cache
+discipline (conservation census, atomic refusal, LRU retention with
+pinning); the paged bookkeeper SPILLS evicted published pages into it
+instead of letting them die, keyed by FULL token prefix so the
+identity survives device page-id recycling, and pages them back in on
+a prefix hit at a priced ``kv_pagein`` (epoch-guarded — pre-purge
+content can never serve); the QoS ladder gains a *preempt* rung
+between degrade and shed (a running low-priority row's chain swaps
+out pinned, the row requeues with its emitted tokens, swaps back in
+and resumes token-identically, on the sim AND the real tiny-llama
+backend); ``synthesize_session_trace`` emits the multi-turn shape and
+``Request.session``/``turn`` round-trip through JSONL with legacy
+traces byte-identical; ``hostmem=None`` stays byte-identical to the
+pre-hostmem engine (outputs, reports, registry, trace); and the
+``serving_hostmem`` bench-gate family passes its pass rows and fails
+its FAIL rows.
+"""
+import dataclasses as dc
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.nlp.llama_decode import llama_serving_decode_factory
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.ops.pallas.paged_attention import PagedKVCache
+from paddle_tpu.serving import (HostArena, HostMemConfig, QoSScheduler,
+                                Request, ServingEngine, SpecConfig,
+                                as_hostmem_config, make_sim_serving,
+                                synthesize_session_trace, synthesize_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill": 5.0, "decode": 1.0,
+         "kv_pageout": 2.0, "kv_pagein": 2.0}
+ARENA = 1 << 20
+
+
+def _hm_engine(hostmem=None, *, slots=4, n_pool_pages=24, sched=None,
+               trace=None, **kw):
+    sim = make_sim_serving(max_len=96, page_size=8, slots=slots,
+                           vocab=211, n_pool_pages=n_pool_pages,
+                           chunked_prefill=8)
+    eng = ServingEngine(serving=sim, slots=slots, policy="paged",
+                        clock="fixed", fixed_costs=dict(COSTS),
+                        scheduler=sched, trace=trace, hostmem=hostmem,
+                        **kw)
+    return sim, eng
+
+
+def _session_trace(seed=0, n_sessions=8, turns=3):
+    return synthesize_session_trace(
+        seed=seed, n_sessions=n_sessions, turns=turns,
+        think_time=150.0, first_prompt_len=(16, 32),
+        turn_prompt_len=(6, 12), output_len=(6, 10), vocab_size=211,
+        mean_interarrival=3.0)
+
+
+def _preempt_pair():
+    """One slot, a long low-priority row running when a short
+    high-priority one arrives: the admit-0 wave fires the preempt
+    rung (low swaps out, high runs, low swaps back and resumes)."""
+    return [Request(rid="lo", prompt=tuple(range(10, 26)),
+                    max_new_tokens=30, arrival=0.0, tenant="t0",
+                    priority=0),
+            Request(rid="hi", prompt=tuple(range(40, 56)),
+                    max_new_tokens=8, arrival=20.0, tenant="t1",
+                    priority=9)]
+
+
+# --- HostArena: the budgeted host store ---------------------------------
+
+
+def test_as_hostmem_config_validation():
+    assert as_hostmem_config(None) is None
+    cfg = as_hostmem_config(1 << 20)
+    assert isinstance(cfg, HostMemConfig)
+    assert cfg.byte_budget == 1 << 20 and cfg.page_bytes is None
+    assert as_hostmem_config(cfg) is cfg
+    with pytest.raises(ValueError, match="bare bool"):
+        as_hostmem_config(True)
+    with pytest.raises(ValueError, match="pass None"):
+        as_hostmem_config("lots")
+    with pytest.raises(ValueError, match="> 0"):
+        HostMemConfig(byte_budget=0)
+    with pytest.raises(ValueError, match="page_bytes"):
+        HostMemConfig(byte_budget=8, page_bytes=0)
+    with pytest.raises(ValueError, match="> 0"):
+        HostArena(0)
+
+
+def test_arena_put_peek_take_drop():
+    a = HostArena(100)
+    a.put("k1", "blob1", 40, quant=True, epoch=3)
+    e = a.peek("k1")
+    assert (e.data, e.nbytes, e.quant, e.epoch, e.owner) \
+        == ("blob1", 40, True, 3, None)
+    assert "k1" in a and len(a) == 1
+    assert a.stored_bytes() == 40 and a.free_bytes == 60
+    with pytest.raises(ValueError, match="already stored"):
+        a.put("k1", "dup", 10)
+    with pytest.raises(ValueError, match="> 0"):
+        a.put("k2", "void", 0)
+    got = a.take("k1")
+    assert got.data == "blob1" and "k1" not in a
+    assert a.free_bytes == 100
+    assert a.drop("k1") is False  # idempotent on a gone key
+    a.put("k2", "blob2", 10)
+    assert a.drop("k2") is True and len(a) == 0
+    s = a.stats()
+    assert s["pageouts"] == 2 and s["pageins"] == 1
+    assert s["peak_bytes"] == 40 and a.census_ok()
+
+
+def test_arena_refusal_is_atomic():
+    """A put that cannot fit even after evicting every evictable
+    entry refuses having mutated NOTHING — pinned bytes never die
+    for someone else's admission."""
+    a = HostArena(100)
+    a.put("pin", "live-chain", 60, pin="rid-0")
+    a.put("lru", "cold", 20)
+    before = (a.free_bytes, len(a), a.stats()["evictions"])
+    with pytest.raises(MemoryError, match="host arena exhausted"):
+        a.put("big", "x", 50)  # 20 free + 20 evictable < 50
+    assert (a.free_bytes, len(a), a.stats()["evictions"]) == before
+    assert "pin" in a and "lru" in a
+    assert a.stats()["refusals"] == 1 and a.census_ok()
+
+
+def test_arena_lru_evicts_oldest_first_pinned_survive():
+    a = HostArena(100)
+    a.put("old", "1", 30)
+    a.put("pin", "2", 30, pin="rid-1")
+    a.put("new", "3", 30)
+    a.put("in", "4", 40)  # needs 30 reclaimed: "old" dies, not "pin"
+    assert "old" not in a and "pin" in a and "new" in a
+    assert a.stats()["evictions"] == 1
+    assert a.pinned_bytes() == 30 and a.evictable_bytes() == 70
+    # pin/unpin move an entry between the protected and LRU states
+    a.unpin("pin")
+    assert a.evictable_bytes() == 100 and a.census_ok()
+    a.pin("new", "rid-2")
+    assert a.drop_owner("rid-2") == 1 and "new" not in a
+    assert a.census_ok()
+
+
+# --- bookkeeper: spill on eviction, priced page-in ----------------------
+
+
+def _spilling_book(n_pages=4, ps=4, budget=1024, fp=10):
+    book = PagedKVCache(n_pages, ps, 1, 8)
+    arena = HostArena(budget)
+    book.note_hostmem(arena, lambda p, quant: ("blob", p),
+                      fp_bytes_per_page=fp)
+    return book, arena
+
+
+def _park(book, seq, toks):
+    """Publish ``toks`` under ``seq`` then free: full pages park in
+    the evictable LRU with their prefix keys live."""
+    book.acquire_prefix(seq, toks)
+    book.allocate(seq, len(toks))
+    book.register_prefix(seq, toks)
+    book.free(seq)
+
+
+def test_eviction_spills_then_pagein_restores():
+    """The spill-instead-of-die tentpole at bookkeeper scale: an
+    evicted published page parks host-side under its full token
+    prefix, a later identical prefix pages it back in (priced through
+    the import callback), and both censuses hold throughout."""
+    ps = 4
+    book, arena = _spilling_book(n_pages=4, ps=ps)  # 3 usable pages
+    X = list(range(10, 10 + ps))
+    _park(book, "a", X)
+    book.allocate("b", 3 * ps)  # free list dries: the parked page
+    # evicts — and spills instead of dying
+    key = tuple(X)
+    assert key in arena
+    cs = book.cache_stats()
+    assert cs["spilled_pages"] == 1 and cs["spills"] == 1
+    assert book.census_ok()
+    book.free("b")  # unpublished: straight back to the free list
+    # the resident chain is gone, the spilled extension is not
+    assert book.match_prefix(X) == 0
+    assert book.acquire_prefix("c", X) == 0
+    assert book.spilled_extension(X, 0) == [key]
+    imported = []
+    n = book.page_in("c", X, 0, lambda p, e: imported.append((p, e)))
+    assert n == ps and book.lengths["c"] == ps
+    assert len(book.tables["c"]) == 1
+    assert imported[0][1].data == ("blob", imported[0][1].data[1])
+    assert key not in arena  # take(): the device copy is canonical
+    cs = book.cache_stats()
+    assert cs["pageins"] == 1 and cs["spilled_pages"] == 0
+    assert book.census_ok()
+    # restored pages are PUBLISHED: a sibling shares them resident
+    assert book.match_prefix(X) == ps
+    book.free("c")
+    assert book.census_ok()
+
+
+def test_spilled_extension_stops_at_holes():
+    ps = 4
+    book, arena = _spilling_book(n_pages=8, ps=ps)
+    X = list(range(10, 10 + 2 * ps))
+    _park(book, "a", X)
+    book.allocate("b", 7 * ps)  # evict both parked pages -> 2 spills
+    assert book.cache_stats()["spills"] == 2
+    keys = [tuple(X[:ps]), tuple(X)]
+    assert book.spilled_extension(X, 0) == keys
+    arena.drop(keys[0])  # mid-chain hole: everything past it is
+    # wrong-context and must not page in
+    assert book.spilled_extension(X, 0) == []
+    book.free("b")
+    book.acquire_prefix("c", X)
+    assert book.page_in("c", X, 0, lambda p, e: None) == 0
+    assert book.census_ok()
+
+
+def test_pagein_epoch_guard_and_purge():
+    """The stale-KV regression: purge() drops the spilled tier with
+    the pool, and even a manually resurrected pre-purge arena entry
+    is refused by the epoch guard — pre-crash content never serves."""
+    ps = 4
+    book, arena = _spilling_book(n_pages=4, ps=ps)
+    X = list(range(10, 10 + ps))
+    _park(book, "a", X)
+    book.allocate("b", 3 * ps)
+    assert tuple(X) in arena
+    book.purge()
+    assert len(arena) == 0  # the host tier dies with the pool
+    assert book.cache_stats()["spilled_pages"] == 0
+    assert book.census_ok() and book.epoch == 1
+    # resurrect a pre-purge entry behind the bookkeeper's back: the
+    # epoch tag (0 < 1) refuses it at the page_in gate
+    arena.put(tuple(X), ("stale", 0), 10, epoch=0)
+    book._spilled[tuple(X)] = True
+    book.acquire_prefix("c", X)
+    assert book.page_in("c", X, 0, lambda p, e: None) == 0
+    assert tuple(X) in arena  # refused BEFORE take: nothing consumed
+    assert book.lengths.get("c", 0) == 0
+
+
+def test_spill_chain_all_or_nothing():
+    """Preemption's invariant: a swapped-out chain is the request's
+    ONLY K/V copy, so a partial spill is worse than none — any arena
+    refusal rolls back every put/pin this call made."""
+    ps = 4
+    toks = list(range(10, 10 + 2 * ps))
+    book, arena = _spilling_book(n_pages=8, ps=ps, budget=15, fp=10)
+    book.allocate("a", 2 * ps)
+    book.lengths["a"] = 2 * ps
+    assert book.spill_chain("a", toks, "a") == []  # page 2 cannot
+    # fit (page 1 pinned): both rolled back
+    assert len(arena) == 0
+    cs = book.cache_stats()
+    assert cs["spills"] == 0 and cs["spill_refusals"] == 1
+    assert book.census_ok()
+    # a big-enough arena pins the whole chain under the owner
+    book2, arena2 = _spilling_book(n_pages=8, ps=ps, budget=100, fp=10)
+    book2.allocate("a", 2 * ps)
+    book2.lengths["a"] = 2 * ps
+    keys = book2.spill_chain("a", toks, "a")
+    assert len(keys) == 2 and arena2.pinned_bytes() == 20
+    assert all(arena2.peek(k).owner == "a" for k in keys)
+    book2.unpin_spilled_owner("a")
+    assert arena2.pinned_bytes() == 0 and arena2.evictable_bytes() == 20
+    book2.drop_spilled_owner("a")  # unpinned: no longer his to drop
+    assert len(arena2) == 2
+    assert book2.census_ok() and arena2.census_ok()
+
+
+def test_unarmed_bookkeeper_stats_byte_identical():
+    """hostmem never armed: no spilled-census keys, no behavior
+    change — the dict every pre-hostmem consumer parses."""
+    book = PagedKVCache(4, 4, 1, 8)
+    X = list(range(10, 14))
+    _park(book, "a", X)
+    book.allocate("b", 12)
+    cs = book.cache_stats()
+    for k in ("spilled_pages", "spills", "pageins", "spill_refusals"):
+        assert k not in cs
+    assert book.census_ok()
+
+
+# --- engine: construction, identity, spill/page-in, preempt rung --------
+
+
+def test_engine_hostmem_validation():
+    with pytest.raises(ValueError, match="bare bool"):
+        _hm_engine(hostmem=True)
+    with pytest.raises(ValueError, match="spec="):
+        _hm_engine(hostmem=ARENA, spec=SpecConfig(n_draft=4))
+    with pytest.raises(ValueError, match="dispatch_ahead"):
+        _hm_engine(hostmem=ARENA, dispatch_ahead=True)
+
+
+def test_hostmem_none_byte_identity():
+    """The identity clause: hostmem=None is the pre-hostmem engine —
+    outputs, slot logs, report keys, registry contents, result
+    shape."""
+    obs_metrics.REGISTRY.reset()
+    trace = _session_trace(seed=2, n_sessions=6)
+    plain = _hm_engine()[1].run(trace)
+    again = _hm_engine(hostmem=None)[1].run(trace)
+    assert again.outputs == plain.outputs
+    assert again.slot_log == plain.slot_log
+    assert again.hostmem_stats is None
+    assert again.pages_spilled is None
+    rep = again.report()
+    assert json.dumps(rep, sort_keys=True) \
+        == json.dumps(plain.report(), sort_keys=True)
+    for k in ("kv_pageouts", "kv_pageins", "preemptions",
+              "preempt_restores"):
+        assert k not in rep
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert not any(n.startswith(("serving_kv_page",
+                                 "serving_preempt"))
+                   for n in names)
+
+
+def test_hostmem_armed_spills_and_pages_in_token_identical():
+    """The capacity tentpole at sim scale: a session workload whose
+    parked prefixes overflow the pool spills host-side and pages back
+    in on round-2 prefix hits — streams stay bit-equal to the
+    hostmem=None engine, both censuses hold, the evidence keys exist
+    only on the armed run."""
+    obs_metrics.REGISTRY.reset()
+    trace = _session_trace(seed=0, n_sessions=12)
+    srv, eng = _hm_engine(hostmem=ARENA)
+    res = eng.run(trace)
+    base = _hm_engine()[1].run(trace)
+    assert res.outputs == base.outputs  # offload is never shedding
+    for r in trace:
+        out = res.outputs[r.rid]
+        assert out == srv.expected_stream(list(r.prompt), len(out))
+    hs = res.hostmem_stats
+    assert hs["arena_census_ok"] is True
+    assert hs["spills"] > 0 and hs["pageins"] > 0
+    assert hs["arena"]["peak_bytes"] > 0
+    assert res.pages_spilled == hs["spilled_pages"]
+    assert res.cache_stats["invariant_ok"]
+    rep = res.report()
+    assert rep["kv_pageouts"] == hs["spills"]
+    assert rep["kv_pageins"] == hs["pageins"]
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert "serving_kv_pageouts_total" in names
+    assert "serving_kv_pageins_total" in names
+    # determinism: a fresh arena per run, so a seeded replay spills
+    # and pages identically
+    res2 = _hm_engine(hostmem=ARENA)[1].run(trace)
+    assert res2.outputs == res.outputs
+    assert res2.hostmem_stats == hs
+
+
+def test_preempt_resume_parity_sim():
+    """The preempt rung end to end on the sim backend: the swapped
+    row's final stream is token-identical to the closed-form oracle
+    (i.e. to a run that was never preempted), the high-priority row
+    is served promptly, and every evidence surface agrees."""
+    obs_metrics.REGISTRY.reset()
+    trace = _preempt_pair()
+    srv, eng = _hm_engine(hostmem=ARENA, slots=1,
+                          sched=QoSScheduler())
+    res = eng.run(trace)
+    hs = res.hostmem_stats
+    assert hs["preempts"] >= 1 and hs["restores"] >= 1
+    assert "lo" in hs["preempted_rids"]
+    assert res.outputs["lo"] \
+        == srv.expected_stream(list(range(10, 26)), 30)
+    assert res.outputs["hi"] \
+        == srv.expected_stream(list(range(40, 56)), 8)
+    rep = res.report()
+    assert rep["preemptions"] == hs["preempts"]
+    assert rep["preempt_restores"] == hs["restores"]
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert "serving_preemptions_total" in names
+    assert "serving_preempt_restores_total" in names
+    # without the arena the same contention has no preempt rung and
+    # the same streams still come out (QoS alone just queues "hi")
+    res_n = _hm_engine(slots=1, sched=QoSScheduler())[1].run(trace)
+    assert res_n.outputs == res.outputs
+    assert res_n.hostmem_stats is None
+
+
+def test_preempt_trace_evidence_and_absence():
+    tr = obs.Tracer()
+    _hm_engine(hostmem=ARENA, slots=1, sched=QoSScheduler(),
+               trace=tr)[1].run(_preempt_pair())
+    names = {e.get("name") for e in tr.events}
+    assert {"preempt", "restore", "kv_pageout",
+            "kv_pagein"} <= names
+    pre = [e for e in tr.events if e.get("name") == "preempt"]
+    assert pre[0]["args"]["rid"] == "lo"
+    assert pre[0]["args"]["pages_spilled"] >= 1
+    assert pre[0]["args"]["emitted"] >= 1
+    rst = [e for e in tr.events if e.get("name") == "restore"]
+    assert rst and rst[0]["args"]["rid"] == "lo"
+    # hostmem=None leaves no hostmem evidence in the trace
+    tr2 = obs.Tracer()
+    _hm_engine(slots=1, sched=QoSScheduler(),
+               trace=tr2)[1].run(_preempt_pair())
+    names2 = {e.get("name") for e in tr2.events}
+    assert not ({"preempt", "restore", "kv_pageout",
+                 "kv_pagein"} & names2)
+
+
+def test_hostmem_session_matches_run():
+    """EngineSession's incremental drive carries the arena tier:
+    same streams, same spill/preempt evidence as run()."""
+    trace = _preempt_pair()
+
+    def eng():
+        return _hm_engine(hostmem=ARENA, slots=1,
+                          sched=QoSScheduler())[1]
+
+    run_res = eng().run(trace)
+    sess = eng().session()
+    for r in sorted(trace, key=lambda r: (r.arrival, r.rid)):
+        sess.advance_until(r.arrival)
+        sess.submit(r)
+    res = sess.finish()
+    assert res.outputs == run_res.outputs
+    assert res.hostmem_stats["preempts"] \
+        == run_res.hostmem_stats["preempts"]
+    assert res.hostmem_stats["arena_census_ok"] is True
+
+
+# --- real tiny-llama backend --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def renv():
+    cfg = LlamaConfig.tiny(vocab=97, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return {"cfg": cfg, "model": model}
+
+
+def _rfac(model, n_pages=20, **kw):
+    return llama_serving_decode_factory(
+        model, max_len=64, page_size=8, n_pool_pages=n_pages,
+        batch_capacity=4, chunked_prefill=8, **kw)
+
+
+def test_real_spill_pagein_parity(renv):
+    """The real factory's export/import closures move actual page
+    content through the arena: a session workload that overflows the
+    pool stays token-identical to the hostmem=None run."""
+    trace = synthesize_session_trace(
+        seed=0, n_sessions=4, turns=2, think_time=60.0,
+        first_prompt_len=(8, 16), turn_prompt_len=(4, 8),
+        output_len=(4, 8), vocab_size=97, mean_interarrival=1.0)
+
+    def eng(hostmem):
+        return ServingEngine(serving=_rfac(renv["model"], n_pages=13),
+                             slots=4, policy="paged", clock="fixed",
+                             fixed_costs=dict(COSTS), hostmem=hostmem)
+
+    res_h = eng(1 << 22).run(trace)
+    res_n = eng(None).run(trace)
+    assert res_h.outputs == res_n.outputs
+    hs = res_h.hostmem_stats
+    assert hs["spills"] > 0
+    assert hs["arena_census_ok"] is True
+    assert res_h.cache_stats["invariant_ok"]
+    assert res_n.hostmem_stats is None
+
+
+def test_real_preempt_resume_parity(renv):
+    """The preempt rung on the real backend: the swapped row's
+    restored stream is token-identical to the stream it produces
+    with the engine to itself — real K/V pages round-tripped through
+    the arena, not recomputed wrong."""
+    lo = Request(rid="lo", prompt=tuple(range(1, 17)),
+                 max_new_tokens=20, arrival=0.0, priority=0)
+    hi = Request(rid="hi", prompt=tuple(range(30, 46)),
+                 max_new_tokens=4, arrival=10.0, priority=9)
+
+    def eng(hostmem, sched):
+        return ServingEngine(serving=_rfac(renv["model"]), slots=1,
+                             policy="paged", clock="fixed",
+                             fixed_costs=dict(COSTS),
+                             scheduler=sched, hostmem=hostmem)
+
+    res = eng(1 << 22, QoSScheduler()).run([lo, hi])
+    assert res.hostmem_stats["preempts"] >= 1
+    assert res.hostmem_stats["restores"] >= 1
+    solo_lo = eng(None, None).run([dc.replace(lo, arrival=0.0)])
+    solo_hi = eng(None, None).run([dc.replace(hi, arrival=0.0)])
+    assert res.outputs["lo"] == solo_lo.outputs["lo"]
+    assert res.outputs["hi"] == solo_hi.outputs["hi"]
+    assert res.cache_stats["invariant_ok"]
+
+
+# --- workload: multi-turn sessions and the JSONL contract ---------------
+
+
+def test_session_trace_shape_and_determinism():
+    trace = _session_trace(seed=3, n_sessions=4, turns=3)
+    assert len(trace) == 12
+    by_sess: dict = {}
+    for r in trace:
+        assert r.session is not None and r.turn is not None
+        assert r.rid == f"{r.session}.t{r.turn}"
+        by_sess.setdefault(r.session, []).append(r)
+    for sess, turns in by_sess.items():
+        turns.sort(key=lambda r: r.turn)
+        assert [r.turn for r in turns] == [1, 2, 3]
+        for a, b in zip(turns, turns[1:]):
+            # turn k's prompt EXTENDS turn k-1's full history — the
+            # shape whose round-2 prefixes the hierarchy monetizes
+            assert b.prompt[:len(a.prompt)] == a.prompt
+            assert len(b.prompt) > len(a.prompt)
+            assert b.arrival > a.arrival
+    assert [r.rid for r in trace] \
+        == [r.rid for r in _session_trace(seed=3, n_sessions=4,
+                                          turns=3)]
+
+
+def test_session_jsonl_roundtrip_and_legacy_identity():
+    r = _session_trace(seed=1, n_sessions=2, turns=2)[0]
+    d = r.to_json()
+    assert d["session"] == r.session and d["turn"] == r.turn
+    assert Request.from_json(json.loads(json.dumps(d))) == r
+    # legacy traces: no session -> no key, the JSONL line is
+    # byte-identical to what the pre-hostmem writer emitted
+    legacy = synthesize_trace(seed=1, n_requests=4, vocab_size=211)[0]
+    dl = legacy.to_json()
+    assert "session" not in dl and "turn" not in dl
+    back = Request.from_json(json.loads(json.dumps(dl)))
+    assert back == legacy
+    assert back.session is None and back.turn is None
+
+
+# --- trace_report: swap waterfall, arena occupancy, summary row ---------
+
+
+def _hostmem_events(tmp_path, hostmem):
+    tr = obs.Tracer()
+    _hm_engine(hostmem=hostmem, slots=1, sched=QoSScheduler(),
+               trace=tr)[1].run(_preempt_pair())
+    path = os.path.join(str(tmp_path), f"t_{bool(hostmem)}.json")
+    tr.export(path)
+    with open(path) as f:
+        return json.load(f)["traceEvents"]
+
+
+def test_trace_report_hostmem_sections(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_report import (arena_occupancy, hostmem_summary,
+                              report, swap_events)
+    events = _hostmem_events(tmp_path, ARENA)
+    sw = swap_events(events)
+    assert "lo" in sw
+    leg = sw["lo"][0]
+    assert leg["pages"] >= 1 and leg["out"] < leg["in"]
+    occ = arena_occupancy(events)
+    assert occ is not None and occ["peak_pages"] >= 1
+    assert occ["pageouts"] >= occ["pageins"] >= 1
+    hm = hostmem_summary(events)
+    assert hm["bench"] == "trace_report_hostmem"
+    assert hm["preempts"] >= 1 and hm["restores"] >= 1
+    assert hm["swapped_requests"] == 1 and "lo" in hm["swaps"]
+    text = report(events)
+    assert "host arena" in text and "swap=out@" in text
+
+
+def test_trace_report_plain_traces_unchanged(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_report import (arena_occupancy, hostmem_summary,
+                              report, swap_events)
+    events = _hostmem_events(tmp_path, None)
+    assert swap_events(events) == {}
+    assert arena_occupancy(events) is None
+    assert hostmem_summary(events) is None
+    text = report(events)
+    assert "host arena" not in text and "swap=" not in text
+
+
+# --- bench gate: the serving_hostmem family -----------------------------
+
+
+def _gate_rows():
+    def arm(name, **kw):
+        return {"bench": "serving_hostmem", "arm": name,
+                "census_ok": True, **kw}
+
+    on = dict(arena_census_ok=True, kv_pageouts=9, kv_pageins=5,
+              preemptions=2, preempt_restores=2)
+    return [
+        arm("recompute"),
+        arm("hostmem", **on),
+        arm("swap_overload", **on),
+        arm("shed_only"),
+        arm("shed_hostmem", **on),
+        {"bench": "serving_hostmem_summary", "capacity_ratio": 3.4,
+         "ttft2_margin": 2.0, "transfer_cost_per_round2": 0.5,
+         "token_parity": True, "none_identity": True, "preempts": 2,
+         "restores": 2, "diverged": 0, "shed_only": 1,
+         "shed_hostmem": 0},
+    ]
+
+
+def test_gate_serving_hostmem(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import bench_gate
+
+    assert bench_gate.check_serving_hostmem(_gate_rows()) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["gate"] == "pass"
+
+    def fails(mutate):
+        rows = _gate_rows()
+        mutate(rows)
+        rc = bench_gate.check_serving_hostmem(rows)
+        verdict = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        return rc == 1 and verdict["gate"] == "FAIL"
+
+    assert fails(lambda r: r.pop(2))           # missing arm
+    assert fails(lambda r: r[1].update(census_ok=False))
+    assert fails(lambda r: r[1].update(arena_census_ok=False))
+    # the off arm must carry NO hostmem machinery (PR-5 convention)
+    assert fails(lambda r: r[0].update(kv_pageins=0))
+    assert fails(lambda r: r[-1].update(capacity_ratio=2.9))
+    assert fails(lambda r: r[-1].update(ttft2_margin=0.3))
+    assert fails(lambda r: r[-1].update(token_parity=False))
+    assert fails(lambda r: r[-1].update(none_identity=False))
+    assert fails(lambda r: r[-1].update(diverged=1))
+    assert fails(lambda r: r[-1].update(preempts=0))
+    assert fails(lambda r: r[-1].update(shed_hostmem=1))  # not
+    # strictly below the shed-only arm
+    assert fails(lambda r: r.pop())            # no summary row
+    # the family is registered in the serving dispatcher
+    assert bench_gate.check_serving(_gate_rows(), None, False) == 0
+    capsys.readouterr()

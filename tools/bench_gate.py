@@ -112,6 +112,18 @@ cannot silently ship a slower build. Three modes:
       #    sim pressure arm must compact parked pages identically
       #    across two seeded replays with token parity and the pool
       #    census intact.
+      #  - serving_hostmem (tools/serving_workload_bench.py
+      #    --hostmem): on the multi-turn session trace at one fixed
+      #    HBM page budget, effective capacity (HBM pages + peak
+      #    arena pages) must reach >= 3x the HBM budget, round-2
+      #    TTFT p50 must beat the recompute arm by at least the
+      #    priced mean kv_pagein transfer cost, every preempted/
+      #    swapped stream must match the sim oracle exactly (zero
+      #    diverged, >= 1 preempt and restore), the hostmem engine's
+      #    shed count must sit STRICTLY below the shed-only
+      #    engine's, pool AND arena censuses must hold on every
+      #    armed arm, and the hostmem=None arm must stay
+      #    byte-identical with no hostmem keys.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -1352,6 +1364,139 @@ def check_serving_quant(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+HOSTMEM_CAPACITY_FLOOR = 3.0  # (HBM + peak arena pages) / HBM pages
+
+
+def check_serving_hostmem(rows: list) -> int:
+    """Gate the KV-memory-hierarchy rows from serving_workload_bench
+    .py --hostmem: effective capacity (HBM pages + peak arena pages)
+    >= HOSTMEM_CAPACITY_FLOOR x the HBM page budget, round-2 TTFT p50
+    beating the recompute arm by at least the priced mean kv_pagein
+    transfer cost per round-2 request (the swap must PAY, not just
+    work), token parity between the hostmem and recompute arms, ZERO
+    preempted/swapped streams diverging from the sim oracle with the
+    preempt rung actually exercised (>= 1 preempt, >= 1 restore),
+    the hostmem engine shedding STRICTLY fewer requests than the
+    shed-only engine at the same deadline overload, pool and arena
+    censuses intact on every arm, and the hostmem=None arm carrying
+    no hostmem machinery (PR-5 presence convention). A missing-JSON
+    input is the caller's no-JSON FAIL: the claim was not checked."""
+    hr = [r for r in rows if r.get("bench") == "serving_hostmem"]
+    by = {r.get("arm"): r for r in hr}
+    need = ("recompute", "hostmem", "swap_overload", "shed_only",
+            "shed_hostmem")
+    missing = [a for a in need if a not in by]
+    if missing:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_hostmem rows missing "
+                                    f"arms {missing} (run tools/"
+                                    "serving_workload_bench.py "
+                                    "--hostmem)"}))
+        return 1
+    for r in hr:
+        if r.get("census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "pool census broken under hostmem — a "
+                          "spilled page escaped the resident+"
+                          "evictable+spilled+free invariant"}))
+            return 1
+    for arm in ("hostmem", "swap_overload", "shed_hostmem"):
+        if by[arm].get("arena_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": arm,
+                "reason": "host arena census broken — a budgeted "
+                          "byte escaped the pinned+evictable+free "
+                          "invariant"}))
+            return 1
+    for arm in ("recompute", "shed_only"):
+        if any(k in by[arm] for k in ("kv_pageouts", "kv_pageins",
+                                      "preemptions",
+                                      "preempt_restores",
+                                      "arena_census_ok")):
+            print(json.dumps({
+                "gate": "FAIL", "arm": arm,
+                "reason": "the hostmem=None arm carries hostmem "
+                          "report keys — the off mode is no longer "
+                          "inert (PR-5 presence convention broken)"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_hostmem_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_hostmem_summary row "
+                                    "— the capacity/TTFT/parity/shed "
+                                    "claims are UNVERIFIED (rerun "
+                                    "the --hostmem arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    rec = {
+        "gate": "pass",
+        "capacity_ratio": s.get("capacity_ratio"),
+        "capacity_floor": HOSTMEM_CAPACITY_FLOOR,
+        "ttft2_margin": s.get("ttft2_margin"),
+        "transfer_cost_per_round2": s.get("transfer_cost_per_round2"),
+        "preempts": s.get("preempts"),
+        "restores": s.get("restores"),
+        "diverged": s.get("diverged"),
+        "shed_only": s.get("shed_only"),
+        "shed_hostmem": s.get("shed_hostmem"),
+        "device": by["hostmem"].get("device", "?"),
+    }
+    cap = s.get("capacity_ratio")
+    if cap is None or float(cap) < HOSTMEM_CAPACITY_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"effective capacity only {cap}x the HBM "
+                         f"page budget (floor "
+                         f"{HOSTMEM_CAPACITY_FLOOR}) — the arena is "
+                         "not actually multiplying capacity")
+    margin = s.get("ttft2_margin")
+    cost = s.get("transfer_cost_per_round2")
+    if rec["gate"] == "pass" \
+            and (margin is None or cost is None
+                 or float(margin) < float(cost)):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"round-2 TTFT margin {margin} is below the "
+                         f"priced transfer cost {cost} — paging the "
+                         "session back in does not beat recomputing "
+                         "it")
+    if rec["gate"] == "pass" and s.get("token_parity") is not True:
+        rec["gate"] = "FAIL"
+        rec["reason"] = ("hostmem outputs diverge from the recompute "
+                         "arm — spill/page-in changed token content")
+    if rec["gate"] == "pass" and s.get("none_identity") is not True:
+        rec["gate"] = "FAIL"
+        rec["reason"] = ("hostmem=None replay diverged or grew "
+                         "hostmem state — the off mode must stay "
+                         "byte-identical")
+    if rec["gate"] == "pass" \
+            and (not int(s.get("preempts") or 0)
+                 or not int(s.get("restores") or 0)
+                 or int(s.get("diverged") or 0) != 0
+                 or s.get("diverged") is None):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"swap parity broken (preempts="
+                         f"{s.get('preempts')} restores="
+                         f"{s.get('restores')} diverged="
+                         f"{s.get('diverged')}) — the preempt rung "
+                         "must fire and every swapped stream must "
+                         "match the oracle exactly")
+    if rec["gate"] == "pass" \
+            and (s.get("shed_only") is None
+                 or s.get("shed_hostmem") is None
+                 or not int(s.get("shed_only") or 0)
+                 or int(s.get("shed_hostmem"))
+                 >= int(s.get("shed_only"))):
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"shed rate not strictly below "
+                         f"(shed_only={s.get('shed_only')} "
+                         f"shed_hostmem={s.get('shed_hostmem')}) — "
+                         "preempt-as-swap must beat shed-only at the "
+                         "same overload")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 AUTOSCALE_GOODPUT_FLOOR = 1.0   # autoscaled vs static-peak goodput
 AUTOSCALE_KINDS = ("diurnal", "flash")
 
@@ -1740,6 +1885,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_quant")
            for r in rows):
         fam_rcs["quant"] = check_serving_quant(rows)
+    if any(r.get("bench", "").startswith("serving_hostmem")
+           for r in rows):
+        fam_rcs["hostmem"] = check_serving_hostmem(rows)
     summary = [r for r in rows
                if r.get("bench") == "spec_vs_plain_compiled"]
     if not summary:

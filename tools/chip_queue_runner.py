@@ -161,6 +161,20 @@ QUEUE = [
     ("serving_quant",
      [sys.executable, "tools/serving_workload_bench.py", "--kv-quant"],
      {}),
+    # PR-17 addition: the KV memory hierarchy arm — the multi-turn
+    # session trace at one fixed HBM page budget through hostmem vs
+    # recompute engines (LRU-evicted pages spill to the byte-budgeted
+    # host arena, round-2 prefix matches page back in at priced
+    # kv_pagein transfers) plus the preempt-as-swap overload replay
+    # and the deadline shed pair (sim replicas, fixed clock — the
+    # chip run smokes the same code path); bench_gate.py serving
+    # gates the serving_hostmem family (capacity >= 3x HBM pages,
+    # round-2 TTFT margin >= the priced transfer cost, zero diverged
+    # swapped streams, shed rate strictly below shed-only, pool +
+    # arena censuses, hostmem=None arm inert)
+    ("serving_hostmem",
+     [sys.executable, "tools/serving_workload_bench.py", "--hostmem"],
+     {}),
     # PR-16 addition: the ragged batched-prefill arm — mixed-churn /
     # prefill-heavy / admission-burst traces through per-chunk vs
     # ragged-lane engines (every lane row rides ONE fused fixed-shape

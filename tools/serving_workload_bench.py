@@ -1098,6 +1098,230 @@ def _quant_arm(args):
     return 0
 
 
+def _hostmem_arm(args):
+    """The KV memory hierarchy arm: the seeded MULTI-TURN session
+    trace (``synthesize_session_trace`` — think-time gaps far past a
+    turn's service time, overlapping sessions) replayed sim-backed on
+    the fixed clock through TWO engines at ONE small HBM page budget:
+
+    - ``recompute`` (hostmem=None): pages recycled between turns are
+      GONE — every round >= 2 re-prefills its whole history;
+    - ``hostmem`` (host arena armed): recycled pages spill to the
+      byte-budgeted arena and the round-2 prefix match pages them
+      back in at the priced ``kv_pagein`` transfer cost.
+
+    Then a priority-mixed overload replay exercises the PREEMPT rung
+    (interactive turns swap background rows out to the arena and back;
+    every stream — preempted or not — is checked token-for-token
+    against the sim's closed-form ``expected_stream`` oracle) and a
+    deadline-overload pair requires the hostmem engine's shed rate
+    STRICTLY below the shed-only engine's (preempt-as-swap admits the
+    blocked request instead of letting its deadline rot in queue).
+
+    ``bench_gate.py serving`` gates the serving_hostmem family:
+    effective capacity (HBM pages + peak arena pages) >= 3x the HBM
+    page budget, round-2 TTFT p50 beating recompute by at least the
+    priced mean transfer cost, ZERO diverged streams with >= 1
+    preempt and >= 1 restore, shed rate strictly below, pool AND
+    arena censuses clean, and the hostmem=None row byte-identical in
+    outputs with no hostmem keys."""
+    import dataclasses
+    import json as _json
+
+    from paddle_tpu.serving import (QoSScheduler, ServingEngine,
+                                    make_sim_serving,
+                                    synthesize_session_trace,
+                                    trace_stats)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    def p50(xs):
+        if not xs:
+            return None
+        s = sorted(xs)
+        return s[len(s) // 2]
+
+    PAGE, MAXLEN, SLOTS, CHUNK, VOCAB = 8, 96, 4, 8, 211
+    POOL = 24          # the fixed HBM budget, in pages
+    ARENA = 1 << 20    # host DRAM budget, in bytes
+    COSTS = {"prefill": 1.0, "prefill_unit": 1.0, "decode": 1.0,
+             "kv_pageout": 0.25, "kv_pagein": 0.25}
+    n_sessions, turns = 16, 3
+    trace = synthesize_session_trace(
+        seed=args.seed, n_sessions=n_sessions, turns=turns,
+        think_time=150.0, first_prompt_len=(16, 32),
+        turn_prompt_len=(6, 12), output_len=(6, 10),
+        vocab_size=VOCAB, mean_interarrival=3.0)
+    stats = trace_stats(trace)
+
+    def engine(hostmem, *, sched=False, slots=SLOTS):
+        srv = make_sim_serving(max_len=MAXLEN, page_size=PAGE,
+                               n_pool_pages=POOL, slots=slots,
+                               vocab=VOCAB, chunked_prefill=CHUNK)
+        eng = ServingEngine(
+            serving=srv, slots=slots, policy="paged", clock="fixed",
+            fixed_costs=dict(COSTS),
+            scheduler=QoSScheduler(aging=50.0) if sched else None,
+            hostmem=hostmem)
+        return srv, eng
+
+    def run_arm(arm, hostmem, req_trace, *, sched=False, slots=SLOTS,
+                extra=None):
+        srv, eng = engine(hostmem, sched=sched, slots=slots)
+        res = eng.run(req_trace)
+        rec = res.metrics.to_record(
+            policy="paged", device="sim", seed=args.seed, slots=slots,
+            trace=trace_stats(req_trace))
+        rec["bench"] = "serving_hostmem"
+        rec["arm"] = arm
+        rec["n_pool_pages"] = POOL
+        rec["census_ok"] = res.cache_stats.get("invariant_ok")
+        hs = res.hostmem_stats
+        if hs is not None:
+            rec["arena_census_ok"] = hs["arena_census_ok"]
+            rec["arena_peak_bytes"] = hs["arena"]["peak_bytes"]
+            rec["pages_spilled"] = res.pages_spilled
+            rec["kv_pageins"] = hs["pageins"]
+            rec["preempts"] = hs["preempts"]
+            rec["restores"] = hs["restores"]
+        rec.update(extra or {})
+        emit(rec)
+        return rec, res, srv
+
+    def diverged(res, srv, req_trace):
+        """Streams that disagree with the closed-form sim oracle —
+        the swap-parity number the gate requires to be ZERO."""
+        bad = 0
+        for r in req_trace:
+            out = res.outputs.get(r.rid)
+            if not out:
+                continue  # shed / never admitted
+            if list(out) != srv.expected_stream(list(r.prompt),
+                                                len(out)):
+                bad += 1
+        return bad
+
+    def round2_ttft_p50(res, req_trace):
+        xs = []
+        for r in req_trace:
+            if (r.turn or 0) < 2:
+                continue
+            d = res.metrics.request(r.rid)
+            if d["ttft"] is not None:
+                xs.append(d["ttft"])
+        return p50(xs)
+
+    # --- capacity + round-2 TTFT: hostmem vs recompute ----------------
+    rec_n, res_n, srv_n = run_arm("recompute", None, trace)
+    rec_h, res_h, srv_h = run_arm("hostmem", ARENA, trace)
+    _, eng_n2 = engine(None)
+    res_n2 = eng_n2.run(trace)
+    none_identity = (
+        res_n.outputs == res_n2.outputs
+        and res_n.hostmem_stats is None
+        and res_n.pages_spilled is None
+        and not any(k in res_n.report()
+                    for k in ("kv_pageouts", "kv_pageins",
+                              "preemptions", "preempt_restores")))
+    hs = res_h.hostmem_stats
+    fp_page = srv_h.page_host_bytes_
+    peak_arena_pages = hs["arena"]["peak_bytes"] // fp_page
+    capacity_ratio = (POOL + peak_arena_pages) / POOL
+    ttft2_n = round2_ttft_p50(res_n, trace)
+    ttft2_h = round2_ttft_p50(res_h, trace)
+    n_round2 = sum(1 for r in trace if (r.turn or 0) >= 2)
+    transfer_cost = (COSTS["kv_pagein"] * hs["pageins"]
+                     / max(1, n_round2))
+    emit({"bench": "serving_hostmem_capacity", "device": "sim",
+          "seed": args.seed, "hbm_pages": POOL,
+          "arena_byte_budget": ARENA,
+          "fp_page_bytes": int(fp_page),
+          "peak_arena_pages": int(peak_arena_pages),
+          "effective_pages": int(POOL + peak_arena_pages),
+          "capacity_ratio": round(capacity_ratio, 4),
+          "pages_spilled_end": res_h.pages_spilled,
+          "kv_pageins": hs["pageins"],
+          "round2_requests": n_round2,
+          "ttft2_p50_recompute": ttft2_n,
+          "ttft2_p50_hostmem": ttft2_h,
+          "ttft2_margin": (round(ttft2_n - ttft2_h, 6)
+                           if None not in (ttft2_n, ttft2_h)
+                           else None),
+          "transfer_cost_per_round2": round(transfer_cost, 6),
+          "token_parity": res_h.outputs == res_n.outputs,
+          "none_identity": none_identity})
+
+    # --- preempt-as-swap: priority-mixed overload, oracle parity ------
+    def sess_idx(r):
+        return int(r.session.lstrip("sw"))
+
+    swap_base = synthesize_session_trace(
+        seed=args.seed + 1, n_sessions=8, turns=2, think_time=40.0,
+        first_prompt_len=(16, 32), turn_prompt_len=(6, 12),
+        output_len=(6, 10), vocab_size=VOCAB, mean_interarrival=1.0,
+        rid_prefix="w")
+    swap_trace = [
+        dataclasses.replace(
+            r, priority=(6 if sess_idx(r) % 2 else 0),
+            max_new_tokens=(r.max_new_tokens if sess_idx(r) % 2
+                            else r.max_new_tokens + 24))
+        for r in swap_base]
+    rec_s, res_s, srv_s = run_arm("swap_overload", ARENA, swap_trace,
+                                  sched=True, slots=2)
+    div = diverged(res_s, srv_s, swap_trace)
+    emit({"bench": "serving_hostmem_swap", "device": "sim",
+          "seed": args.seed + 1, "requests": len(swap_trace),
+          "preempts": res_s.hostmem_stats["preempts"],
+          "restores": res_s.hostmem_stats["restores"],
+          "preempted_rids": res_s.hostmem_stats["preempted_rids"],
+          "diverged": div,
+          "census_ok": rec_s["census_ok"],
+          "arena_census_ok": rec_s["arena_census_ok"]})
+
+    # --- shed rate: preempt rung vs shed-only at deadline overload ----
+    shed_trace = [
+        dataclasses.replace(
+            r, deadline_ms=(30_000.0 if sess_idx(r) % 2 else None))
+        for r in swap_trace]
+    rec_sn, res_sn, _ = run_arm("shed_only", None, shed_trace,
+                                sched=True, slots=2)
+    rec_sh, res_sh, _ = run_arm("shed_hostmem", ARENA, shed_trace,
+                                sched=True, slots=2)
+    emit({"bench": "serving_hostmem_shed", "device": "sim",
+          "seed": args.seed + 1, "requests": len(shed_trace),
+          "shed_only": rec_sn.get("shed", 0),
+          "shed_hostmem": rec_sh.get("shed", 0),
+          "shed_rate_only": rec_sn.get("shed_rate", 0.0),
+          "shed_rate_hostmem": rec_sh.get("shed_rate", 0.0),
+          "preempts": res_sh.hostmem_stats["preempts"]})
+
+    emit({"bench": "serving_hostmem_summary", "device": "sim",
+          "seed": args.seed, "sessions": n_sessions, "turns": turns,
+          "hbm_pages": POOL,
+          "capacity_ratio": round(capacity_ratio, 4),
+          "ttft2_p50_recompute": ttft2_n,
+          "ttft2_p50_hostmem": ttft2_h,
+          "ttft2_margin": (round(ttft2_n - ttft2_h, 6)
+                           if None not in (ttft2_n, ttft2_h)
+                           else None),
+          "transfer_cost_per_round2": round(transfer_cost, 6),
+          "token_parity": res_h.outputs == res_n.outputs,
+          "none_identity": none_identity,
+          "preempts": res_s.hostmem_stats["preempts"],
+          "restores": res_s.hostmem_stats["restores"],
+          "diverged": div,
+          "shed_only": rec_sn.get("shed", 0),
+          "shed_hostmem": rec_sh.get("shed", 0),
+          "census_ok": (rec_n["census_ok"] and rec_h["census_ok"]
+                        and rec_s["census_ok"]
+                        and rec_sh["census_ok"]),
+          "arena_census_ok": (rec_h["arena_census_ok"]
+                              and rec_s["arena_census_ok"]
+                              and rec_sh["arena_census_ok"])})
+    return 0
+
+
 def _lora_arm(args):
     """The multi-model LoRA arm: one seeded Zipf-skewed adapter trace
     (hot adapters dominate, the production fine-tune shape) replayed
@@ -2060,6 +2284,15 @@ def main(argv=None):
                          "(bench_gate.py serving gates parity, burst "
                          "TTFT p95 >= 2x, program-cache flatness, the "
                          "starvation bound)")
+    ap.add_argument("--hostmem", action="store_true",
+                    help="run the KV memory hierarchy arm instead: "
+                         "the multi-turn session trace, hostmem vs "
+                         "recompute at one HBM page budget, the "
+                         "preempt-as-swap overload replay and the "
+                         "deadline shed pair (bench_gate.py serving "
+                         "gates capacity >= 3x, the round-2 TTFT "
+                         "transfer margin, zero diverged streams, "
+                         "shed rate strictly below, both censuses)")
     ap.add_argument("--obs-overhead", action="store_true",
                     help="run the obs-overhead arm instead: no-obs vs "
                          "tracing-off vs tracing-on wall time on one "
@@ -2117,6 +2350,8 @@ def main(argv=None):
         return _tp_arm(args)
     if args.kv_quant:
         return _quant_arm(args)
+    if args.hostmem:
+        return _hostmem_arm(args)
     if args.lora:
         return _lora_arm(args)
     if args.spec:

@@ -369,6 +369,91 @@ def kv_quant_summary(events: list) -> dict | None:
                               for f in flips[:20]]}
 
 
+def swap_events(events: list) -> dict:
+    """rid -> [{"out": t, "in": t|None, "pages": N}] from the
+    scheduler's ``preempt``/``restore`` instants (the preempt rung
+    swapping a running row's KV chain to the host arena and back).
+    A row preempted but never re-admitted keeps ``"in": None``.
+    Empty for any pre-hostmem trace — every swap column/section/row
+    below is omitted then, so legacy traces summarize
+    byte-identically."""
+    outs: dict = {}
+    ins: dict = {}
+    for e in events:
+        if e.get("ph") != "i":
+            continue
+        rid = e.get("args", {}).get("rid")
+        if rid is None:
+            continue
+        if e["name"] == "preempt":
+            outs.setdefault(rid, []).append(
+                (e["ts"], int(e["args"].get("pages_spilled", 0))))
+        elif e["name"] == "restore":
+            ins.setdefault(rid, []).append(e["ts"])
+    swaps: dict = {}
+    for rid, os_ in sorted(outs.items()):
+        back = sorted(ins.get(rid, []))
+        swaps[rid] = [
+            {"out": t, "in": back[i] if i < len(back) else None,
+             "pages": pages}
+            for i, (t, pages) in enumerate(sorted(os_))]
+    return swaps
+
+
+def arena_occupancy(events: list, buckets: int = 30) -> dict | None:
+    """Host-arena page occupancy over the trace span, from the
+    engine's priced ``kv_pageout``/``kv_pagein`` transfer spans (one
+    page each). Drops (shed cleanup, arena LRU eviction) leave no
+    span, so this is the lower-bound page-in evidence plus an
+    upper-bound occupancy curve — exact arena byte accounting lives
+    in the run's ``hostmem_stats``. None for pre-hostmem traces."""
+    crossings = sorted(
+        ((e["ts"], 1 if e["name"] == "kv_pageout" else -1)
+         for e in events if e.get("ph") == "X"
+         and e.get("name") in ("kv_pageout", "kv_pagein")),
+        key=lambda r: r[0])
+    if not crossings:
+        return None
+    t0 = crossings[0][0]
+    t1 = max(t for t, _ in crossings)
+    span = max(t1 - t0, 1e-12)
+    occ, peak = 0, 0
+    curve = [0] * buckets
+    for t, d in crossings:
+        occ += d
+        peak = max(peak, occ)
+        b = min(int((t - t0) / span * (buckets - 1)), buckets - 1)
+        for i in range(b, buckets):
+            curve[i] = occ
+    return {"pageouts": sum(1 for _, d in crossings if d > 0),
+            "pageins": sum(1 for _, d in crossings if d < 0),
+            "peak_pages": peak, "final_pages": occ,
+            "t0": t0, "t1": t1, "curve": curve}
+
+
+def hostmem_summary(events: list) -> dict | None:
+    """KV-memory-hierarchy evidence: the ``trace_report_hostmem``
+    row — pageout/pagein transfer totals, the preempt/restore swap
+    count, and the per-rid swap timeline. None for pre-hostmem
+    traces, whose report output stays byte-identical."""
+    swaps = swap_events(events)
+    occ = arena_occupancy(events)
+    if not swaps and occ is None:
+        return None
+    pairs = [s for ss in swaps.values() for s in ss]
+    return {"bench": "trace_report_hostmem",
+            "pageouts": occ["pageouts"] if occ else 0,
+            "pageins": occ["pageins"] if occ else 0,
+            "peak_arena_pages": occ["peak_pages"] if occ else 0,
+            "preempts": len(pairs),
+            "restores": sum(1 for s in pairs
+                            if s["in"] is not None),
+            "pages_swapped_out": sum(s["pages"] for s in pairs),
+            "swapped_requests": len(swaps),
+            "swaps": {rid: ss for rid, ss
+                      in sorted(swaps.items())[:20]}}
+
+
 def ragged_summary(events: list) -> dict | None:
     """Ragged batched-prefill evidence: engine prefill spans carry a
     ``ragged=k`` arg (rows fused into that ONE call) when the lane
@@ -560,6 +645,7 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
     hops = failover_hops(events, tracks)
     kv_hops = handoff_hops(events)
     accepts = spec_accepts(events)
+    swaps = swap_events(events)
     lines = []
     if reqs:
         ts = [r["arrival"] for r in reqs if "arrival" in r] + \
@@ -586,10 +672,19 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
             # — pre-spec traces render byte-identically
             sp = f" accept={sa['accepted']}/{sa['proposed']}" \
                 if sa else ""
+            # swap=out@t>in@t' appears only for rows the preempt
+            # rung swapped to the host arena — pre-hostmem traces
+            # render byte-identically
+            sw = ""
+            for s in swaps.get(r["rid"], []):
+                leg = f"out@{s['out'] / 1e6:.4f}"
+                if s["in"] is not None:
+                    leg += f">in@{s['in'] / 1e6:.4f}"
+                sw += f" swap={leg}"
             lines.append(
                 f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
                 f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}"
-                f"{fo}{ho}{sp}")
+                f"{fo}{ho}{sp}{sw}")
     comp = recompiles(events)
     lines.append(f"\n== recompiles ({len(comp)}) ==")
     by_site: dict = {}
@@ -657,6 +752,32 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                 f"  t={f['t'] / 1e6:.4f}s -> "
                 f"{'int8' if f.get('enabled') else 'fp':5s}:: "
                 f"{f.get('rule')}")
+    occ_hm = arena_occupancy(events)
+    if occ_hm is not None or swaps:
+        # only hostmem traces grow this section — pre-hostmem traces
+        # render byte-identically
+        po = occ_hm["pageouts"] if occ_hm else 0
+        pi = occ_hm["pageins"] if occ_hm else 0
+        pairs = [s for ss in swaps.values() for s in ss]
+        lines.append(f"\n== host arena ({po} pageouts, {pi} pageins, "
+                     f"{len(pairs)} preempts, "
+                     f"{sum(1 for s in pairs if s['in'] is not None)}"
+                     f" restores) ==")
+        if occ_hm is not None and occ_hm["peak_pages"] > 0:
+            peak = occ_hm["peak_pages"]
+            bar = "".join(
+                "#" if v >= peak else str(min(int(v / peak * 10), 9))
+                if v else "."
+                for v in occ_hm["curve"])
+            lines.append(f"  occupancy {bar} peak={peak} pages "
+                         f"(. empty, 0-9 deciles, # peak)")
+        for rid, ss in sorted(swaps.items())[:top * 2]:
+            for s in ss:
+                back = (f" -> in t={s['in'] / 1e6:.4f}s"
+                        if s["in"] is not None else " (not restored)")
+                lines.append(f"  t={s['out'] / 1e6:.4f}s "
+                             f"{rid:20s} out {s['pages']} pages"
+                             f"{back}")
     acts = autoscale_actions(events)
     if acts:
         # only autoscaled traces grow this section — pre-autoscale
@@ -739,6 +860,11 @@ def main(argv=None) -> int:
         if ah_row is not None:
             # dispatch-ahead traces only: absent otherwise
             print(json.dumps(ah_row))
+        hm_row = hostmem_summary(events)
+        if hm_row is not None:
+            # hostmem traces only: absent otherwise, so pre-hostmem
+            # --json output is byte-identical (global row still LAST)
+            print(json.dumps(hm_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
