@@ -125,6 +125,98 @@ def as_lora_config(lora) -> "LoRAConfig | None":
 LORA_KEYS = ("q_A", "q_B", "v_A", "v_B")
 
 
+# --- constrained decoding (grammar/JSON-schema guided generation) ----------
+
+@dataclasses.dataclass(frozen=True)
+class GrammarConfig:
+    """Constrained-decoding layout for the paged serving decode path:
+    a device-resident GRAMMAR BANK of ``n_slots * max_states`` packed
+    uint32 allow-bitmask rows, indexed per batch row by a flat
+    ``slot * max_states + state`` id — the same per-row-state-as-jit-
+    data mechanism the adapter bank (PR 12) and the quantized page
+    tier (PR 14) ride: the bank and the id vector are jit INPUTS, so
+    one fixed-shape ``decode_n`` program serves any mix of schemas
+    and grammar churn never recompiles.
+
+    Slot 0 is the reserved ALL-ALLOW identity (every bit set): free
+    rows carry flat id 0 and their masked logits are exactly the base
+    logits — token-for-token the unconstrained model. ``max_states``
+    bounds one automaton's DFA size (compilation refuses larger
+    schemas loudly)."""
+
+    n_slots: int = 4
+    max_states: int = 64
+
+    def __post_init__(self):
+        if self.n_slots < 2:
+            raise ValueError("GrammarConfig needs n_slots >= 2 "
+                             "(slot 0 is the reserved all-allow "
+                             "identity)")
+        if self.max_states < 2:
+            raise ValueError("GrammarConfig max_states must be >= 2")
+
+
+def as_grammar_config(grammar) -> "GrammarConfig | None":
+    """Normalize the ``grammar=`` argument: None stays None, a
+    ``(n_slots, max_states)`` tuple becomes a GrammarConfig, a
+    GrammarConfig passes through."""
+    if grammar is None or isinstance(grammar, GrammarConfig):
+        return grammar
+    if isinstance(grammar, tuple) and len(grammar) == 2:
+        return GrammarConfig(n_slots=int(grammar[0]),
+                             max_states=int(grammar[1]))
+    raise ValueError(f"grammar {grammar!r}: pass None, (n_slots, "
+                     "max_states), or a GrammarConfig")
+
+
+def grammar_bank_hooks(vocab_size: int, grammar: "GrammarConfig",
+                       tp: "TPConfig | None" = None):
+    """The grammar-cache device hooks: ``(init_grammar_bank,
+    upload_grammar)``.
+
+    ``init_grammar_bank()`` builds the ``(n_slots * max_states,
+    ceil(vocab/32))`` uint32 bank with slot 0's whole block all-ones
+    (the all-allow identity every free row indexes at flat id 0) and
+    the rest zero until uploaded. Under ``tp`` the bank is placed
+    REPLICATED on the mesh (a bank is a few KB — replication costs
+    nothing and every shard masks its own logits copy identically).
+
+    ``upload_grammar(bank, slot, compiled)`` writes one compiled
+    automaton's packed per-state masks into the slot's block
+    (functional ``.at[...].set`` — the returned bank REBINDS), zeroing
+    the block's unused tail so a recycled slot can never leak a
+    larger predecessor's rows. ``compiled`` is a
+    ``serving.grammar.CompiledGrammar``-shaped object (``n_states``,
+    ``masks``)."""
+    words = (int(vocab_size) + 31) // 32
+    ms, ns = grammar.max_states, grammar.n_slots
+
+    def init_grammar_bank():
+        bank = np.zeros((ns * ms, words), np.uint32)
+        bank[:ms] = np.uint32(0xFFFFFFFF)
+        bank = jnp.asarray(bank)
+        if tp is not None:
+            bank = device_put_sharded(bank, tp.build_mesh())
+        return bank
+
+    def upload_grammar(bank, slot, compiled):
+        n = int(compiled.n_states)
+        if n > ms:
+            raise ValueError(f"grammar compiles to {n} states > "
+                             f"max_states {ms}")
+        masks = np.asarray(compiled.masks, np.uint32)
+        if masks.shape != (n, words):
+            raise ValueError(f"grammar masks have shape {masks.shape},"
+                             f" bank rows want (*, {words}) (vocab "
+                             "mismatch?)")
+        block = np.zeros((ms, words), np.uint32)
+        block[:n] = masks
+        return bank.at[slot * ms:(slot + 1) * ms].set(
+            jnp.asarray(block))
+
+    return init_grammar_bank, upload_grammar
+
+
 def _bgmv(h, A, B_, ids):
     """Batched gather matvec (Punica's BGMV): per-row low-rank delta
     ``(h @ A[row]) @ B[row]``. ``h`` (B, T, H); ``A`` (n_slots, H, r);
@@ -1536,6 +1628,29 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         return jnp.argmax(logits, -1) if emit == "token" \
             else logits.astype(jnp.float32)
 
+    def _gmask(logits, grammar):
+        """CONSTRAINED DECODING: mask each row's logits with its
+        grammar state's packed allow-bitmask BEFORE the emit argmax.
+        ``grammar`` is ``(mask_table, state_ids)`` — a
+        ``(rows, ceil(V/32))`` uint32 bank and a (B,) int32 flat-id
+        vector, BOTH jit inputs like lora's bank/ids, so one compiled
+        program serves any schema mix and grammar churn never
+        recompiles. Flat id 0 is the reserved all-allow row: free
+        rows' where() keeps every logit, bit-for-bit the base math.
+        ``grammar=None`` (the Python-level default) traces the
+        identical base program — no mask op exists in it at all."""
+        if grammar is None:
+            return logits
+        table, gids = grammar
+        v = logits.shape[-1]
+        rows = jnp.take(table, gids, axis=0)       # (B, words)
+        word = jnp.arange(v) // 32
+        bit = (jnp.arange(v) % 32).astype(jnp.uint32)
+        allow = (jnp.take(rows, word, axis=1) >> bit[None, :]) \
+            & jnp.uint32(1)
+        return jnp.where(allow.astype(bool), logits,
+                         jnp.asarray(-jnp.inf, logits.dtype))
+
     # ONE definition of how the optional adapter bank rides the layer
     # scan, shared by prefill / decode_step / _prefill_chunk (three
     # private copies could silently diverge the chunked-prefill path
@@ -1642,11 +1757,14 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
 
     @partial(jax.jit, donate_argnums=(5,))  # pools alias in place
     def prefill(outer, layers, tokens, page_tables, lengths, pools,
-                lora=None):
+                lora=None, grammar=None):
         """Prompts padded to a page multiple; ``lengths`` are the REAL
         prompt lengths (padding K/V lands in allocated pages but is
         masked by lengths everywhere downstream). ``lora``: optional
-        ``(adapter_bank, adapter_ids)`` multi-adapter deltas."""
+        ``(adapter_bank, adapter_ids)`` multi-adapter deltas.
+        ``grammar``: optional ``(mask_table, state_ids)`` constrained-
+        decoding masks over the FIRST emitted token (each row's id is
+        its automaton's start state; free rows pass 0)."""
         B, T = tokens.shape
         if pressure:
             pools = _tier_clear(pools,
@@ -1682,12 +1800,12 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         # each sequence's last REAL position owns the next token
         x_last = jnp.take_along_axis(
             x, (lengths - 1)[:, None, None].astype(jnp.int32), 1)[:, 0]
-        out = _emit(_logits(cfg, outer, x_last))
+        out = _emit(_gmask(_logits(cfg, outer, x_last), grammar))
         return out, _tier_exit(k_pools, v_pools, _tm)
 
     @partial(jax.jit, donate_argnums=(5,))  # no per-token pool copy
     def decode_step(outer, layers, tok, page_tables, lengths, pools,
-                    lora=None):
+                    lora=None, grammar=None):
         if pressure:
             pools = _tier_clear(pools, jnp.take_along_axis(
                 page_tables, (lengths // page_size)[:, None], 1))
@@ -1721,7 +1839,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             scan_layers)
         k_pools, v_pools = ys
         x = _rms(x, outer["model.norm.weight"], cfg.rms_norm_eps)
-        out = _emit(_logits(cfg, outer, x[:, 0]))
+        out = _emit(_gmask(_logits(cfg, outer, x[:, 0]), grammar))
         return out, _tier_exit(k_pools, v_pools, _tm)
 
     @partial(jax.jit, donate_argnums=(6,))
@@ -1821,12 +1939,13 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             pageify(kv, hd).astype(pool_l.dtype))
 
     @jax.jit
-    def _finish_prefill(outer, x_last):
+    def _finish_prefill(outer, x_last, grammar=None):
         x = _rms(x_last, outer["model.norm.weight"], cfg.rms_norm_eps)
-        return _emit(_logits(cfg, outer, x))
+        return _emit(_gmask(_logits(cfg, outer, x), grammar))
 
     def prefill_chunked(outer, layers, tokens, page_tables, lengths,
-                        pools, resume_from: int = 0, lora=None):
+                        pools, resume_from: int = 0, lora=None,
+                        grammar=None):
         """``resume_from`` (a chunk multiple): skip chunks whose pages
         already hold real K/V — the prefix-cache path
         (PagedKVCache.acquire_prefix returns the cached token count;
@@ -1852,7 +1971,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
             x_last, pools = _prefill_chunk(
                 outer, layers, tokens[:, s:s + C], s, page_tables,
                 lengths, pools, x_last, lora)
-        return _finish_prefill(outer, x_last), pools
+        return _finish_prefill(outer, x_last, grammar), pools
 
     # the shim itself is plain python; expose the jitted programs it
     # drives so the serving engine's recompile detector (obs layer:
@@ -1955,7 +2074,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         return x_last, _tier_exit(k_pools, v_pools, _tm)
 
     def prefill_ragged(outer, layers, chunk, starts, page_tables,
-                       lengths, pools, lora=None):
+                       lengths, pools, lora=None, grammar=None):
         """ONE fused lane dispatch: row r runs the C tokens of
         ``chunk[r]`` at absolute offset ``starts[r]`` against its own
         page table. Returns per-row next-token logits-argmax like
@@ -1967,7 +2086,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         x_last, pools = _prefill_chunk_ragged(
             outer, layers, chunk, starts, page_tables, lengths, pools,
             x_last, lora)
-        return _finish_prefill(outer, x_last), pools
+        return _finish_prefill(outer, x_last, grammar), pools
 
     prefill_ragged._jit_inner = (_prefill_chunk_ragged, _finish_prefill)
 
@@ -1985,7 +2104,7 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
 
     @partial(jax.jit, donate_argnums=(5,), static_argnums=(6,))
     def decode_n(outer, layers, tok, page_tables, lengths, pools, n,
-                 lora=None):
+                 lora=None, grammar=None):
         """n decode steps in ONE compiled program (lax.scan over the
         step body) — the serving loop's dispatch amortizer: per-step
         python dispatch costs ~8-15 ms through a remote-PJRT tunnel
@@ -2002,11 +2121,19 @@ def llama_paged_decode_factory(model: LlamaForCausalLM,
         error. ``lora``: optional ``(adapter_bank, adapter_ids)``
         multi-adapter deltas — both jit INPUTS, so the ONE compiled
         program serves any adapter mix (the serving_lora recompile
-        gate counts exactly this cache staying at one entry)."""
+        gate counts exactly this cache staying at one entry).
+        ``grammar``: optional ``(mask_table, state_ids)`` constrained-
+        decoding masks, the same jit-input discipline. NOTE the DFA
+        state advances HOST-side from each emitted token, so the mask
+        holds each row's dispatch-time state for all ``n`` scanned
+        steps — a wave carrying any constrained row must run ``n=1``
+        (the serving engine clamps exactly this; ``n`` is static, so
+        the clamp costs at most one extra cache entry, flat in the
+        number of schemas)."""
         def body(carry, _):
             tok, lens, pools = carry
             nxt, pools = decode_step(outer, layers, tok, page_tables,
-                                     lens, pools, lora)
+                                     lens, pools, lora, grammar)
             step_tok = nxt if nxt.ndim == 1 else jnp.argmax(
                 nxt, -1).astype(jnp.int32)
             return (step_tok.astype(jnp.int32), lens + 1, pools), nxt
@@ -2154,7 +2281,9 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
                                  = None,
                                  draft: LlamaForCausalLM | None
                                  = None,
-                                 kv_quant: str | None = None):
+                                 kv_quant: str | None = None,
+                                 grammar: "GrammarConfig | tuple | "
+                                 "None" = None):
     """Both decode backends behind one object + the router: build once,
     then ``pick(lengths, ...)`` returns ("dense", gen) or
     ("paged", (outer, layers, pools, prefill, decode_step, decode_n))
@@ -2174,6 +2303,7 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
     # breaking cross-backend output parity for no routing reason)
     tp = as_tp_config(tp)
     lora = as_lora_config(lora)
+    grammar = as_grammar_config(grammar)
     if kv_quant not in (None, "int8", "pressure"):
         raise ValueError(f"kv_quant {kv_quant!r}: use None, 'int8' or "
                          "'pressure'")
@@ -2221,6 +2351,12 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         lora_hooks = lora_bank_hooks(
             model.config, lora,
             paged[1]["self_attn.q_proj.weight"].dtype, tp=tp)
+    grammar_hooks = None
+    if grammar is not None:
+        # the grammar-cache device hooks (serving.grammar.GrammarCache
+        # consumes them); under tp the bank replicates on the mesh
+        grammar_hooks = grammar_bank_hooks(model.config.vocab_size,
+                                           grammar, tp=tp)
     spec_built = None
     if draft is not None:
         # SPECULATIVE serving: the draft model gets its own paged
@@ -2273,6 +2409,10 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
         chunked_prefill_ = chunked_prefill
         tp_ = tp  # TPConfig when the paged path is mesh-sharded
         lora_ = lora  # LoRAConfig when multi-adapter serving is built
+        # GrammarConfig when constrained decoding is built, plus the
+        # vocabulary size the engine compiles schemas against
+        grammar_ = grammar
+        grammar_vocab_ = model.config.vocab_size
         # quantized page tier: None | "int8" | "pressure". page_bytes_
         # prices ONE page (full-precision, int8+scale) for the
         # bookkeeper's stored-bytes census; the pressure hooks are the
@@ -2301,6 +2441,10 @@ def llama_serving_decode_factory(model: LlamaForCausalLM,
             # adapter-cache device hooks (paddle_tpu.serving.adapters)
             init_adapter_bank = staticmethod(lora_hooks[0])
             upload_adapter = staticmethod(lora_hooks[1])
+        if grammar_hooks is not None:
+            # grammar-cache device hooks (paddle_tpu.serving.grammar)
+            init_grammar_bank = staticmethod(grammar_hooks[0])
+            upload_grammar = staticmethod(grammar_hooks[1])
 
         def pick(self, lengths, capacity=None, shared_prefix=False,
                  expect_churn=False):

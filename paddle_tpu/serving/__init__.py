@@ -20,8 +20,13 @@ vs fault-free), a multi-model LoRA layer
 ``AdapterCache`` paging delta sets into the device bank the compiled
 fixed-shape decode batch reads per row — thousands of fine-tuned
 variants of one base model from one engine, ``--lora`` gates
-multiplexed goodput >= 1.2x a one-model-per-replica split), a seeded
-replayable trace generator
+multiplexed goodput >= 1.2x a one-model-per-replica split), a
+constrained-decoding layer (``grammar``: JSON-schema / EBNF sources
+compiled host-side into token-level DFAs whose packed allow-bitmasks
+live in a budgeted device bank — ``GrammarStore`` + ``GrammarCache``
+— so one fixed-shape decode batch mixes schema-locked and free rows,
+``--grammar`` gates 100% parse at >= 0.95x unconstrained
+throughput), a seeded replayable trace generator
 (``workload``, including the multi-tenant overload, cluster and
 Zipf-adapter traces), and per-request TTFT/TPOT/SLO/goodput/fairness
 metrics (``metrics``). The whole stack is watchable by the SLO layer
@@ -42,9 +47,13 @@ from .cluster import (ClusterResult, ClusterRouter,  # noqa: F401
                       DisaggregatedPlacement, LeastLoadedPlacement,
                       PlacementPolicy, PrefixAwarePlacement,
                       RoundRobinPlacement, make_placement)
-from ..models.nlp.llama_decode import (LoRAConfig,  # noqa: F401
-                                       SpecConfig, TPConfig,
+from ..models.nlp.llama_decode import (GrammarConfig,  # noqa: F401
+                                       LoRAConfig, SpecConfig,
+                                       TPConfig,
                                        synthesize_lora_deltas)
+from .grammar import (CompiledGrammar, GrammarCache,  # noqa: F401
+                      GrammarStore, TokenVocab, compile_grammar,
+                      compile_schema, compile_source, schema_accepts)
 from .engine import (DecodeError, EngineClock,  # noqa: F401
                      EngineSession, FixedPolicy, KVHandoff, Policy,
                      RoutedPolicy, ServeResult, ServingEngine,
@@ -68,6 +77,7 @@ from .workload import (DEFAULT_TENANTS, Request,  # noqa: F401
                        synthesize_overload_trace,
                        synthesize_prefill_heavy_trace,
                        synthesize_recurring_prefix_trace,
+                       synthesize_schema_trace,
                        synthesize_session_trace,
                        synthesize_trace,
                        synthesize_zipf_adapter_trace, trace_stats)

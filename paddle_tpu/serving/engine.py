@@ -54,13 +54,15 @@ from ..jax_compat import named_sharding
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..models.nlp.llama_decode import (as_lora_config,
+from ..models.nlp.llama_decode import (as_grammar_config,
+                                       as_lora_config,
                                        as_spec_config, as_tp_config,
                                        llama_serving_decode_factory,
                                        route_decode,
                                        tree_device_bytes)
 from ..ops.pallas.paged_attention import PagedKVCache
 from .adapters import AdapterCache, AdapterStore
+from .grammar import GrammarCache, GrammarStore, TokenVocab
 from .hostmem import HostArena, as_hostmem_config
 from .metrics import MetricsCollector
 from .scheduler import QoSScheduler, ServiceEstimator
@@ -298,6 +300,11 @@ class ServeResult:
     # never counts them; spill ≠ leak, the PR-5 retention rule one
     # tier down), but an offline replay needs the census to balance.
     # None at hostmem=None keeps save_log byte-identical
+    grammar_stats: Optional[Dict] = None  # GrammarCache.cache_stats()
+    # + "invariant_ok" (the grammar slot census alone — resident +
+    # evictable + free == n_slots-1, sampled every engine turn) when
+    # the run served constrained streams; None at grammar=None — the
+    # result shape every pre-grammar consumer sees is unchanged
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -465,11 +472,14 @@ class _SpecState:
 
 class _PagedRow:
     __slots__ = ("req", "slot", "tok", "out", "eff", "done", "t0",
-                 "aslot", "spec", "prev", "sprop", "sacc")
+                 "aslot", "spec", "prev", "sprop", "sacc",
+                 "gslot", "gname", "gaut", "gstate", "gmasked")
 
     def __init__(self, req: Request, slot: int, first_tok: int,
                  t0: float = 0.0, aslot: int = 0, spec: bool = False,
-                 prev: int = 0):
+                 prev: int = 0, gslot: int = 0,
+                 gname: Optional[str] = None, gaut=None,
+                 gstate: int = 0):
         self.req = req
         self.slot = slot
         self.tok = first_tok
@@ -482,6 +492,13 @@ class _PagedRow:
         # read it)
         self.sprop = 0      # draft tokens proposed for this row
         self.sacc = 0       # draft tokens accepted for this row
+        self.gslot = gslot    # grammar-bank slot (0 = all-allow)
+        self.gname = gname    # schema name (None = free-running)
+        self.gaut = gaut      # CompiledGrammar (host transition table)
+        self.gstate = gstate  # current DFA state (host-advanced per
+        # emitted token; the decode batch carries flat_id(gslot,
+        # gstate) as jit DATA)
+        self.gmasked = 0.0    # sum of per-token masked-vocab fracs
         cancel = req.cancel_after if req.cancel_after is not None \
             else 10 ** 9
         self.eff = min(req.max_new_tokens, cancel)
@@ -500,11 +517,14 @@ class _PrefillingRow:
 
     __slots__ = ("req", "slot", "t_admit", "n_cached", "resume", "T",
                  "next_chunk", "n_chunks", "run_chunks", "toks", "pt",
-                 "skipped", "aslot", "spec")
+                 "skipped", "aslot", "spec", "gslot", "gname", "gaut",
+                 "gstate")
 
     def __init__(self, req: Request, slot: int, t_admit: float,
                  n_cached: int, resume: int, T: int, chunk: int,
-                 toks, pt, aslot: int = 0, spec: bool = False):
+                 toks, pt, aslot: int = 0, spec: bool = False,
+                 gslot: int = 0, gname: Optional[str] = None,
+                 gaut=None, gstate: int = 0):
         self.req = req
         self.slot = slot
         self.t_admit = t_admit
@@ -522,6 +542,11 @@ class _PrefillingRow:
         # entry — the anti-starvation aging counter
         self.aslot = aslot            # adapter-bank slot (0 = identity)
         self.spec = spec              # spec-eligible (admission-time)
+        self.gslot = gslot            # grammar-bank slot (0=all-allow)
+        self.gname = gname            # schema name (None = free)
+        self.gaut = gaut              # CompiledGrammar
+        self.gstate = gstate          # DFA state the FIRST emitted
+        # token will be masked by (resume-walked for preempted rows)
 
     def remaining_chunks(self) -> int:
         return self.n_chunks - self.next_chunk
@@ -653,7 +678,9 @@ class ServingEngine:
                  slo=None, tp=None, adapters=None, lora=None,
                  spec=None, spec_draft=None, kv_quant=None,
                  kv_quant_budget=None, ragged_prefill: bool = False,
-                 dispatch_ahead: bool = False, hostmem=None):
+                 dispatch_ahead: bool = False, hostmem=None,
+                 grammar=None, grammar_config=None,
+                 adapter_schemas=None):
         # ``tp``: None (byte-identical to the single-device engine —
         # outputs, slot logs, metrics records, registry contents), a
         # TPConfig, or an int degree. With a MODEL it is threaded into
@@ -706,6 +733,24 @@ class ServingEngine:
         # the mode is threaded into the factory build; with a
         # PREBUILT factory the factory's own kv_quant_ is
         # authoritative (conflicts error, like tp/lora).
+        # ``grammar``: None (byte-identical to the free-running
+        # engine — outputs, slot logs, metrics records, report keys,
+        # registry contents) or a GrammarStore / {name: schema-dict |
+        # EBNF-str} registry — CONSTRAINED decoding. Needs a
+        # grammar-enabled factory: with a MODEL, pass
+        # ``grammar_config=GrammarConfig(...)|(n_slots, max_states)``
+        # and it is threaded into the build; with a PREBUILT factory
+        # the factory's own grammar_ is authoritative (conflicts
+        # error, like tp/lora). Per-request ``Request.schema`` names
+        # the grammar; compiled automata page into a device mask bank
+        # through a budgeted ``GrammarCache`` (LRU retention,
+        # pin-while-in-flight) and every mix of constrained and free
+        # rows decodes through ONE fixed-shape compiled batch — the
+        # per-row DFA state rides as jit data, never a recompile.
+        # ``adapter_schemas``: {adapter_name: schema_name} — the
+        # per-adapter DEFAULT schema; a request naming that adapter
+        # (with Request.schema unset) decodes constrained under it.
+        grammar_config = as_grammar_config(grammar_config)
         spec = as_spec_config(spec)
         if serving is None:
             if model is None:
@@ -736,7 +781,8 @@ class ServingEngine:
                 n_pool_pages=n_pool_pages, kv_cache_dtype=kv_cache_dtype,
                 batch_capacity=slots, scan_layers=scan_layers,
                 chunked_prefill=page_size, tp=tp, lora=lora,
-                draft=spec_draft, kv_quant=kv_quant)
+                draft=spec_draft, kv_quant=kv_quant,
+                grammar=grammar_config)
         else:
             if spec_draft is not None:
                 raise ValueError(
@@ -772,6 +818,13 @@ class ServingEngine:
                     "kv_quant to the factory (or the model path) "
                     "instead")
             kv_quant = fac_q
+            fac_g = getattr(serving, "grammar_", None)
+            if grammar_config is not None and fac_g != grammar_config:
+                raise ValueError(
+                    f"grammar_config={grammar_config} conflicts with "
+                    f"the prebuilt factory's grammar_={fac_g} — the "
+                    "mask bank is sized at build; pass grammar_config "
+                    "to the factory (or the model path) instead")
         # --- multi-model adapter serving (inert at adapters=None) ---
         self.lora = getattr(serving, "lora_", None)
         if adapters is not None and not isinstance(adapters,
@@ -806,6 +859,62 @@ class ServingEngine:
             policy = _coerce_paged_only(
                 policy, "with adapters",
                 "the dense backend holds no adapter bank")
+        # --- constrained decoding (inert at grammar=None) -----------
+        self.grammar_cfg = getattr(serving, "grammar_", None)
+        if grammar is not None and not isinstance(grammar,
+                                                  GrammarStore):
+            grammar = GrammarStore(dict(grammar))
+        if grammar is not None and self.grammar_cfg is None:
+            raise ValueError(
+                "grammar= needs a grammar-enabled serving factory "
+                "(llama_serving_decode_factory(grammar=...) or "
+                "SimServing(grammar_slots=...)) — the mask bank is "
+                "part of the compiled program's inputs")
+        self._grammar_store = grammar
+        # host-side compiled-automaton memo, shared by every run's
+        # GrammarCache AND the scheduler's min-tokens probe: one
+        # schema compiles ONCE per engine no matter how many runs,
+        # sessions or probes touch it
+        self._dfa_memo: Dict[str, object] = {}
+        self._adapter_schemas: Dict[str, str] = {}
+        if adapter_schemas:
+            if grammar is None:
+                raise ValueError(
+                    "adapter_schemas= names default schemas but no "
+                    "grammar= registry was given to resolve them")
+            if adapters is None:
+                raise ValueError(
+                    "adapter_schemas= without adapters= — there are "
+                    "no adapters to default")
+            for a, gname in dict(adapter_schemas).items():
+                if a not in adapters:
+                    raise ValueError(
+                        f"adapter_schemas names unknown adapter "
+                        f"{a!r} (registered: {adapters.names()})")
+                if gname not in grammar:
+                    raise ValueError(
+                        f"adapter_schemas[{a!r}] names unknown "
+                        f"schema {gname!r} (registered: "
+                        f"{grammar.names()})")
+            self._adapter_schemas = dict(adapter_schemas)
+        self._ctr_grammar_hits = None
+        self._ctr_grammar_compiles = None
+        if grammar is not None:
+            # created ONLY when constrained decoding is configured,
+            # so free-running runs leave no trace in the registry
+            # (PR-5 convention)
+            self._ctr_grammar_hits = obs_metrics.REGISTRY.counter(
+                "serving_grammar_hits_total",
+                "constrained admissions served from the resident "
+                "mask bank")
+            self._ctr_grammar_compiles = obs_metrics.REGISTRY.counter(
+                "serving_grammar_compiles_total",
+                "grammar automaton compiles + mask-bank uploads")
+            # constrained decoding is paged-only, exactly like tp and
+            # adapters: the dense wave cache has no grammar mask bank
+            policy = _coerce_paged_only(
+                policy, "with grammar",
+                "the dense backend holds no grammar mask bank")
         # --- speculative serving (inert at spec=None) ---------------
         self.spec = spec
         self._spec_parts = getattr(serving, "spec_parts", None)
@@ -966,6 +1075,13 @@ class ServingEngine:
             # gate's pressure_active() probe answers — compaction
             # fires before any shedding tier would
             scheduler.track_pressure = True
+        if grammar is not None and scheduler is not None \
+                and hasattr(scheduler, "grammar_min_tokens"):
+            # arm the degrade floor: a constrained stream is never
+            # clamped below its automaton's shortest-accept length —
+            # armed only when a consumer exists (the PR-11
+            # discipline), so grammar-less schedulers are untouched
+            scheduler.grammar_min_tokens = self._grammar_floor
         self.admission = admission or BatchingConfig()
         self._trace_spec = trace
         # ``slo``: None (off — zero monitor work, the default), an
@@ -1080,6 +1196,12 @@ class ServingEngine:
                 "dispatch_ahead=True cannot compose with kv_quant=: "
                 "pressure/int8 tier moves rewrite pool pages between "
                 "turns underneath a dispatched-ahead batch")
+        if self.dispatch_ahead and grammar is not None:
+            raise ValueError(
+                "dispatch_ahead=True cannot compose with grammar=: "
+                "a constrained row's next mask depends on the token "
+                "the CURRENT turn emits, so a dispatched-ahead batch "
+                "would mask with a stale DFA state by construction")
         # --- host-DRAM offload arena (inert at hostmem=None) --------
         # None: capacity ends at HBM, byte-identical to every earlier
         # PR (outputs, slot logs, records, report keys, registry).
@@ -1547,6 +1669,77 @@ class ServingEngine:
                             self.serving.init_adapter_bank,
                             self.serving.upload_adapter)
 
+    def _make_grammar_cache(self) -> Optional[GrammarCache]:
+        """A FRESH grammar cache per run/session (cold mask bank —
+        two seeded replays upload identically), or None when the
+        engine is free-running. The device hooks come from the
+        factory (``init_grammar_bank``/``upload_grammar``); the bank
+        is sized by the factory's ``grammar_`` config. The HOST
+        compile memo is the engine's (shared across runs/sessions and
+        with the scheduler's floor probe): a schema's automaton
+        compiles once per engine, only the bank upload repeats."""
+        if self._grammar_store is None:
+            return None
+        gc = GrammarCache(
+            self._grammar_store, self.grammar_cfg.n_slots,
+            self.grammar_cfg.max_states,
+            TokenVocab.ascii_default(self.serving.grammar_vocab_),
+            self.serving.init_grammar_bank,
+            self.serving.upload_grammar)
+        gc._dfa = self._dfa_memo
+        return gc
+
+    def _grammar_arg(self, gcache: Optional[GrammarCache], gids):
+        """The ``grammar=`` argument for a factory call:
+        ``(mask_table, state_ids)`` when constrained decoding is on
+        (flat ids staged like every other host batch input), None
+        otherwise — free-running engines call the factory EXACTLY as
+        before, so their programs and outputs are untouched."""
+        if gcache is None:
+            return None
+        return (gcache.bank, self._arr(np.asarray(gids, np.int32)))
+
+    def _schema_of(self, r: Request) -> Optional[str]:
+        """The schema this request decodes under: its own
+        ``Request.schema`` first, else its adapter's default from
+        ``adapter_schemas=``, else None (free-running). Always None
+        on a grammar-less engine — ``_validate`` already refused any
+        request that NAMES a schema there."""
+        if self._grammar_store is None:
+            return None
+        if r.schema is not None:
+            return r.schema
+        if r.adapter is not None:
+            return self._adapter_schemas.get(r.adapter)
+        return None
+
+    def _grammar_automaton(self, name: str):
+        """Compile-and-memoize ``name``'s automaton host-side (the
+        engine-lifetime memo every run's GrammarCache shares). No
+        bank slot is touched — this is the probe path."""
+        g = self._dfa_memo.get(name)
+        if g is None:
+            from .grammar import compile_source
+            g = compile_source(self._grammar_store.get(name),
+                               TokenVocab.ascii_default(
+                                   self.serving.grammar_vocab_))
+            if g.n_states > self.grammar_cfg.max_states:
+                raise ValueError(
+                    f"grammar {name!r} compiles to {g.n_states} "
+                    f"states but the bank holds max_states="
+                    f"{self.grammar_cfg.max_states}")
+            self._dfa_memo[name] = g
+        return g
+
+    def _grammar_floor(self, r: Request) -> Optional[int]:
+        """The scheduler's degrade floor for one request: the
+        shortest token count its automaton accepts (None for free
+        rows — the legacy floor of 1 applies)."""
+        name = self._schema_of(r)
+        if name is None:
+            return None
+        return int(self._grammar_automaton(name).min_tokens)
+
     def _make_spec_state(self) -> Optional[_SpecState]:
         """Fresh adaptive-route state per run/session (cold EWMA,
         empty flip log — two seeded replays flip identically), or
@@ -1835,6 +2028,17 @@ class ServingEngine:
                     raise ValueError(
                         f"{r.rid}: unknown adapter {r.adapter!r} "
                         f"(registered: {self._adapter_store.names()})")
+            if r.schema is not None:
+                if self._grammar_store is None:
+                    raise ValueError(
+                        f"{r.rid}: names schema {r.schema!r} but the "
+                        "engine was built without grammar= — a "
+                        "free-running answer would break the "
+                        "declared output contract")
+                if r.schema not in self._grammar_store:
+                    raise ValueError(
+                        f"{r.rid}: unknown schema {r.schema!r} "
+                        f"(registered: {self._grammar_store.names()})")
 
     # --- the replay loop --------------------------------------------------
     def run(self, trace: List[Request]) -> ServeResult:
@@ -1852,6 +2056,7 @@ class ServingEngine:
         self._note_pool(book, m)
         hst = self._arm_hostmem(book, clock, m, tr)
         acache = self._make_adapter_cache()
+        gcache = self._make_grammar_cache()
         spst = self._make_spec_state()
         qst = self._make_quant_state()
         ahst = self._make_ahead_state()
@@ -1871,6 +2076,7 @@ class ServingEngine:
         prefill_tokens = 0
         inv_ok = True
         a_inv = True
+        g_inv = True
         expect_churn = self._expect_churn if self._expect_churn \
             is not None else any(r.cancel_after is not None
                                  for r in trace)
@@ -1931,7 +2137,7 @@ class ServingEngine:
                             wave, book, clock, m, active, free_slots,
                             slot_log, prefix_cached, seen_groups,
                             outputs, tr=tr, lane=lane, acache=acache,
-                            spst=spst, hst=hst)
+                            spst=spst, hst=hst, gcache=gcache)
                         prefill_tokens += ptoks
                         for r in wave[:n_adm]:  # possibly reordered —
                             waiting.remove(r)   # remove by identity
@@ -1960,7 +2166,7 @@ class ServingEngine:
                     self._paged_chunk(book, clock, m, active, free_slots,
                                       slot_log, outputs, tr=tr,
                                       acache=acache, spst=spst,
-                                      ahst=ahst)
+                                      ahst=ahst, gcache=gcache)
                     progressed = True
 
                 if lane:
@@ -1971,7 +2177,8 @@ class ServingEngine:
                     _, ptoks = self._lane_step(
                         lane, book, clock, m, active, free_slots,
                         slot_log, outputs, prefix_cached, seen_groups,
-                        tr=tr, acache=acache, spst=spst)
+                        tr=tr, acache=acache, spst=spst,
+                        gcache=gcache)
                     prefill_tokens += ptoks
                     progressed = True
 
@@ -1987,6 +2194,8 @@ class ServingEngine:
                 inv_ok &= book.census_ok()
                 if acache is not None:
                     a_inv &= acache.census_ok()
+                if gcache is not None:
+                    g_inv &= gcache.census_ok()
         finally:
             if tr is not None:
                 if prev_tr is not None:
@@ -2019,7 +2228,11 @@ class ServingEngine:
                            pages_spilled=(
                                None if hst is None else
                                book.cache_stats().get(
-                                   "spilled_pages", 0)))
+                                   "spilled_pages", 0)),
+                           grammar_stats=(
+                               None if gcache is None else
+                               dict(gcache.cache_stats(),
+                                    invariant_ok=g_inv)))
 
     def _overhead_row(self, clock, run_w0) -> Optional[Dict]:
         """The measured-clock host-overhead decomposition:
@@ -2085,6 +2298,7 @@ class ServingEngine:
         self._note_pool(book, m)
         hst = self._arm_hostmem(book, clock, m, tr)
         acache = self._make_adapter_cache()
+        gcache = self._make_grammar_cache()
         spst = self._make_spec_state()
         qst = self._make_quant_state()
         ahst = self._make_ahead_state()
@@ -2104,6 +2318,7 @@ class ServingEngine:
         prefill_tokens = 0
         inv_ok = True
         a_inv = True
+        g_inv = True
         expect_churn = self._expect_churn if self._expect_churn \
             is not None else any(r.cancel_after is not None
                                  for r in trace)
@@ -2117,6 +2332,8 @@ class ServingEngine:
                 self._ctr_shed.inc()
                 if acache is not None:
                     acache.forget_pending(r.rid)
+                if gcache is not None:
+                    gcache.forget_pending(r.rid)
                 if hst is not None and r.rid in hst["preempted"]:
                     # a preempted request shed while requeued: its
                     # pinned chain will never page back in — release
@@ -2199,7 +2416,8 @@ class ServingEngine:
                                 wave, book, clock, m, active, free_slots,
                                 slot_log, prefix_cached, seen_groups,
                                 outputs, tr=tr, lane=lane,
-                                acache=acache, spst=spst, hst=hst)
+                                acache=acache, spst=spst, hst=hst,
+                                gcache=gcache)
                             prefill_tokens += ptoks
                             if n_adm:
                                 dt = clock.now() - t0
@@ -2220,7 +2438,7 @@ class ServingEngine:
                                         wave[0], book, clock, m,
                                         active, free_slots, slot_log,
                                         sched, hst, _shed, tr=tr,
-                                        acache=acache):
+                                        acache=acache, gcache=gcache):
                                 # the rung between degrade and shed:
                                 # a fully blocked wave swaps ONE
                                 # lower-priority running row out to
@@ -2240,7 +2458,7 @@ class ServingEngine:
                     self._paged_chunk(book, clock, m, active, free_slots,
                                       slot_log, outputs, tr=tr,
                                       acache=acache, spst=spst,
-                                      ahst=ahst)
+                                      ahst=ahst, gcache=gcache)
                     est.observe("decode", clock.now() - t0)
                     t = clock.now()
                     for sid in list(active):
@@ -2250,18 +2468,21 @@ class ServingEngine:
                                                active, free_slots,
                                                slot_log, outputs,
                                                timeout=True, tr=tr,
-                                               acache=acache)
+                                               acache=acache,
+                                               gcache=gcache)
                     progressed = True
 
                 if lane:
                     _, ptoks = self._lane_step(
                         lane, book, clock, m, active, free_slots,
                         slot_log, outputs, prefix_cached, seen_groups,
-                        tr=tr, acache=acache, spst=spst)
+                        tr=tr, acache=acache, spst=spst,
+                        gcache=gcache)
                     prefill_tokens += ptoks
                     self._lane_timeouts(lane, book, clock, m,
                                         free_slots, slot_log, outputs,
-                                        tr=tr, acache=acache)
+                                        tr=tr, acache=acache,
+                                        gcache=gcache)
                     progressed = True
 
                 if not progressed and not active:
@@ -2278,6 +2499,8 @@ class ServingEngine:
                 inv_ok &= book.census_ok()
                 if acache is not None:
                     a_inv &= acache.census_ok()
+                if gcache is not None:
+                    g_inv &= gcache.census_ok()
         finally:
             if tr is not None:
                 if prev_tr is not None:
@@ -2312,7 +2535,11 @@ class ServingEngine:
                            pages_spilled=(
                                None if hst is None else
                                book.cache_stats().get(
-                                   "spilled_pages", 0)))
+                                   "spilled_pages", 0)),
+                           grammar_stats=(
+                               None if gcache is None else
+                               dict(gcache.cache_stats(),
+                                    invariant_ok=g_inv)))
 
     @staticmethod
     def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
@@ -2341,7 +2568,7 @@ class ServingEngine:
 
     def _preempt_turn(self, blocked, book, clock, m, active,
                       free_slots, slot_log, sched, hst, shed_fn,
-                      tr=None, acache=None) -> bool:
+                      tr=None, acache=None, gcache=None) -> bool:
         """The QoS rung between degrade and shed: a wave the pool/slots
         fully blocked asks the scheduler for ONE strictly-lower-priority
         running victim, swaps its chain out to the host arena (pinned
@@ -2381,6 +2608,11 @@ class ServingEngine:
         if acache is not None and r.adapter is not None:
             acache.release(r.adapter, vic)
             self._note_adapters(acache, m, clock.now())
+        if gcache is not None and row.gname is not None:
+            # the automaton pin rolls off with the row; the DFA state
+            # itself needs no spill — re-admission re-walks it from
+            # the resume prefix (host arithmetic, no device work)
+            gcache.release(row.gname, vic)
         free_slots.append(row.slot)
         free_slots.sort()
         t = clock.now()
@@ -2409,7 +2641,7 @@ class ServingEngine:
     def _admit_paged(self, wave, book, clock, m, active, free_slots,
                      slot_log, prefix_cached, seen_groups, outputs,
                      tr=None, lane=None, sink=None, acache=None,
-                     spst=None, hst=None):
+                     spst=None, hst=None, gcache=None):
         """Returns (admitted, prefill chunks computed, prefill tokens
         computed) for this wave. With ``lane`` (the async prefill
         lane), admission only RESERVES — pages, slot, bookkeeping —
@@ -2453,6 +2685,25 @@ class ServingEngine:
                 except MemoryError:
                     break  # every slot pinned: requeue, retry as
                     # rows finish and release their pins
+            # grammar residency SECOND (same pin discipline, one tier
+            # over): a resident automaton is a free hit, a miss pays
+            # one paced grammar_compile (host DFA compile + mask-bank
+            # upload), and a bank whose every slot is pinned requeues
+            # the wave — rolling back the adapter pin first
+            gname = self._schema_of(r) if gcache is not None else None
+            gslot, g_up, gaut = 0, False, None
+            if gname is not None:
+                try:
+                    gslot, g_up = gcache.acquire(
+                        gname, sid,
+                        timed=lambda f: self._timed(
+                            tr, clock, "grammar_compile", f, rid=sid,
+                            schema=gname))
+                except MemoryError:
+                    if acache is not None and r.adapter is not None:
+                        acache.note_rollback(r.adapter, sid, a_up)
+                    break
+                gaut = gcache.automaton(gname)
             # AUTOMATIC prefix acquisition: every request probes the
             # pool's chain-hashed page cache (page-aligned exact match
             # gives token-level sharing with no trace tag;
@@ -2492,6 +2743,11 @@ class ServingEngine:
                     # is REMEMBERED so the successful admission still
                     # reports it as this request's upload
                     acache.note_rollback(r.adapter, sid, a_up)
+                if gname is not None:
+                    # same discipline for the automaton pin: the
+                    # compile — if one ran — stays resident and is
+                    # remembered for the retry's attribution
+                    gcache.note_rollback(gname, sid, g_up)
                 break
             d_ev = book._stats["evictions"] - ev0
             if d_ev:
@@ -2535,8 +2791,32 @@ class ServingEngine:
             sp = False
             if spst is not None:
                 sp, _sp_rule = self.policy.spec_route(r, spst.cfg)
+            if gaut is not None:
+                # a constrained row always decodes PLAIN: the draft
+                # proposes unmasked tokens the verify would reject
+                # almost surely, and acceptance bookkeeping under a
+                # mask would fork the emission rule — free rows in
+                # the same wave keep their spec verdict
+                sp = False
+            # DFA state the first emitted token is masked by: the
+            # start state, or — for a preempted request swapping back
+            # in — the state its already-served tokens walked to (the
+            # resume prefix is exactly the emitted stream)
+            gstate = 0
+            if gaut is not None:
+                gstate = gaut.start
+                if hst is not None and hst["resume_prefix"].get(sid):
+                    gstate = gaut.walk(hst["resume_prefix"][sid])
             t_admit = clock.now()
             m.on_admit(sid, t_admit, "paged")
+            if gname is not None:
+                # one hit-or-compile event per ADMISSION, the
+                # took_upload discipline: a compile paid by a
+                # rolled-back earlier acquire is attributed here
+                g_up = gcache.took_compile(sid, g_up)
+                (self._ctr_grammar_compiles if g_up
+                 else self._ctr_grammar_hits).inc()
+                m.on_grammar(sid, gname, hit=not g_up)
             if acache is not None and r.adapter is not None:
                 # one hit-or-upload event per ADMISSION: an upload
                 # paid by a rolled-back earlier acquire is attributed
@@ -2554,6 +2834,11 @@ class ServingEngine:
                     # spec-configured runs, so plain traces keep
                     # their event args exactly
                     attrs["spec"] = sp
+                if gname is not None:
+                    # schema tag ONLY on constrained rows — free rows
+                    # and grammar-less runs keep their event args
+                    # exactly (the trace_report waterfall reads it)
+                    attrs["schema"] = gname
                 tr.instant("admit", t=t_admit,
                            track=self._tenant_track(r), rid=sid,
                            backend="paged", slot=slot, cached=n_cached,
@@ -2562,19 +2847,26 @@ class ServingEngine:
                 lane.append(_PrefillingRow(r, slot, t_admit, n_cached,
                                            resume, T, self.chunk_C,
                                            toks, pt, aslot=aslot,
-                                           spec=sp))
+                                           spec=sp, gslot=gslot,
+                                           gname=gname, gaut=gaut,
+                                           gstate=gstate))
                 admitted += 1
                 continue
 
             def _call(toks=toks, pt=pt, lens=lens, resume=resume,
-                      aslot=aslot):
+                      aslot=aslot, gslot=gslot, gstate=gstate):
                 arr = self._arr
+                kw = {}
+                if acache is not None:
+                    kw["lora"] = self._lora_arg(acache, [aslot])
+                if gcache is not None:
+                    kw["grammar"] = self._grammar_arg(
+                        gcache, [gcache.flat_id(gslot, gstate)
+                                 if gslot else 0])
                 return self._p_prefill(
                     self._p_outer, self._p_layers, arr(toks),
                     arr(pt), arr(lens), self._pools,
-                    resume_from=resume,
-                    **({} if acache is None else
-                       {"lora": self._lora_arg(acache, [aslot])}))
+                    resume_from=resume, **kw)
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
                 rid=sid, units=n_chunks, resume=resume,
@@ -2589,7 +2881,9 @@ class ServingEngine:
                                    t0=t_admit, t_admit=t_admit,
                                    sink=sink, acache=acache,
                                    aslot=aslot, spst=spst,
-                                   spec_row=sp)
+                                   spec_row=sp, gcache=gcache,
+                                   gslot=gslot, gname=gname,
+                                   gaut=gaut, gstate=gstate)
             admitted += 1
         if admitted:
             self._g_resident.set(float(len(book._refs)))
@@ -2601,7 +2895,8 @@ class ServingEngine:
                           slot_log, outputs, prefix_cached,
                           seen_groups, tr, t0, t_admit, sink=None,
                           acache=None, aslot=0, spst=None,
-                          spec_row=False):
+                          spec_row=False, gcache=None, gslot=0,
+                          gname=None, gaut=None, gstate=0):
         """Everything that happens the moment a request's prompt pages
         hold real K/V: publish them for prefix sharing, account the
         cache hit, then either enter the decode slot (the default),
@@ -2634,13 +2929,23 @@ class ServingEngine:
         # so a draft walk here would be compute the fleet never
         # cashes (disaggregated spec is future work).
         sp = bool(spec_row and spst is not None and spst.enabled
-                  and not spst.latched and sink is None)
+                  and not spst.latched and sink is None
+                  and gaut is None)
         if sp:
             self._spec_prefill_row(r, book, T, clock, tr)
         row = _PagedRow(r, slot, first_tok, t0=t0, aslot=aslot,
-                        spec=sp, prev=int(r.prompt[-1]))
+                        spec=sp, prev=int(r.prompt[-1]), gslot=gslot,
+                        gname=gname, gaut=gaut, gstate=gstate)
+        g_mf = 0.0
+        if gaut is not None:
+            # the first token was emitted under gstate's mask — step
+            # the DFA host-side; acceptance ends the stream like eos
+            g_mf = gaut.masked_frac(gstate)
+            row.gmasked += g_mf
+            row.gstate = gaut.step(gstate, first_tok)
         done = len(row.out) >= row.eff \
-            or first_tok == self.eos_token_id
+            or first_tok == self.eos_token_id \
+            or (gaut is not None and gaut.accepts_at(row.gstate))
         # a request DONE at its first token never hands off — the
         # stream is complete where it stands, there is no decode
         # phase to move
@@ -2652,18 +2957,27 @@ class ServingEngine:
         t_first = clock.now()
         m.on_tokens(sid, t_first, 1)
         self._ctr_tokens.inc()
+        if gaut is not None:
+            m.on_grammar_tokens(1, g_mf)
+            if gaut.accepts_at(row.gstate):
+                m.on_grammar_accept(sid, t_first)
+                if tr is not None:
+                    tr.instant("grammar_accept", t=t_first,
+                               track=self._tenant_track(r), rid=sid,
+                               schema=gname)
         if tr is not None:
             tr.instant("first_token", t=t_first,
                        track=self._tenant_track(r), rid=sid)
         if done:
             self._finish_paged(sid, book, clock, m, active,
                                free_slots, slot_log, outputs, tr=tr,
-                               acache=acache)
+                               acache=acache, gcache=gcache)
         return row
 
     def _lane_step(self, lane, book, clock, m, active, free_slots,
                    slot_log, outputs, prefix_cached, seen_groups,
-                   tr=None, sink=None, acache=None, spst=None):
+                   tr=None, sink=None, acache=None, spst=None,
+                   gcache=None):
         """Run up to ``prefill_chunk_budget`` prefill chunks from the
         lane, SHORTEST-REMAINING-FIRST (admission order breaking
         ties): a one-chunk prompt reaches its first token in one lane
@@ -2691,7 +3005,7 @@ class ServingEngine:
             return self._lane_step_ragged(
                 lane, book, clock, m, active, free_slots, slot_log,
                 outputs, prefix_cached, seen_groups, tr=tr, sink=sink,
-                acache=acache, spst=spst)
+                acache=acache, spst=spst, gcache=gcache)
         C = self.chunk_C
         chunks_run = 0
         tokens_run = 0
@@ -2717,14 +3031,22 @@ class ServingEngine:
                 np.int32)
 
             def _call(toks=toks, pt=e.pt, lens=lens, resume=k * C,
-                      aslot=e.aslot):
+                      aslot=e.aslot, gslot=e.gslot, gstate=e.gstate):
                 arr = self._arr
+                kw = {}
+                if acache is not None:
+                    kw["lora"] = self._lora_arg(acache, [aslot])
+                if gcache is not None:
+                    # only the FINAL chunk's logits are harvested, so
+                    # masking every chunk with the row's current gid
+                    # is exact (intermediate chunks discard theirs)
+                    kw["grammar"] = self._grammar_arg(
+                        gcache, [gcache.flat_id(gslot, gstate)
+                                 if gslot else 0])
                 return self._p_prefill(
                     self._p_outer, self._p_layers, arr(toks),
                     arr(pt), arr(lens), self._pools,
-                    resume_from=resume,
-                    **({} if acache is None else
-                       {"lora": self._lora_arg(acache, [aslot])}))
+                    resume_from=resume, **kw)
             first, self._pools = self._timed(
                 tr, clock, "prefill", _call, jitfn=self._p_prefill,
                 rid=sid, units=1, chunk=k, of=e.n_chunks,
@@ -2747,7 +3069,8 @@ class ServingEngine:
                 slot_log, outputs, prefix_cached, seen_groups, tr=tr,
                 t0=t_done, t_admit=e.t_admit, sink=sink,
                 acache=acache, aslot=e.aslot, spst=spst,
-                spec_row=e.spec)
+                spec_row=e.spec, gcache=gcache, gslot=e.gslot,
+                gname=e.gname, gaut=e.gaut, gstate=e.gstate)
         if self._g_lane_depth is not None:
             self._g_lane_depth.set(float(len(lane)))
         m.on_lane_depth(clock.now(), len(lane))
@@ -2758,7 +3081,7 @@ class ServingEngine:
     def _lane_step_ragged(self, lane, book, clock, m, active,
                           free_slots, slot_log, outputs, prefix_cached,
                           seen_groups, tr=None, sink=None, acache=None,
-                          spst=None):
+                          spst=None, gcache=None):
         """The FUSED lane turn: every parked request's next pending
         chunk rides ONE fixed-shape ragged dispatch (row index = the
         request's reserved decode slot; per-row chunk tokens, resume
@@ -2795,6 +3118,8 @@ class ServingEngine:
             lens = np.full((R,), C, np.int32)
             aids = np.zeros((R,), np.int32) if acache is not None \
                 else None
+            gids = np.zeros((R,), np.int32) if gcache is not None \
+                else None
             finals = []
             for e in picked:
                 e.skipped = 0
@@ -2807,17 +3132,23 @@ class ServingEngine:
                     else (k + 1) * C
                 if aids is not None:
                     aids[e.slot] = e.aslot
+                if gids is not None and e.gslot:
+                    gids[e.slot] = gcache.flat_id(e.gslot, e.gstate)
                 if final:
                     finals.append(e)
 
             def _call(toks=toks, starts=starts, pt=pt, lens=lens,
-                      aids=aids):
+                      aids=aids, gids=gids):
                 arr = self._arr
+                kw = {}
+                if acache is not None:
+                    kw["lora"] = self._lora_arg(acache, aids)
+                if gcache is not None:
+                    kw["grammar"] = self._grammar_arg(gcache, gids)
                 return self._p_prefill_ragged(
                     self._p_outer, self._p_layers, arr(toks),
                     arr(starts), arr(pt), arr(lens), self._pools,
-                    **({} if acache is None else
-                       {"lora": self._lora_arg(acache, aids)}))
+                    **kw)
             firsts, self._pools = self._timed(
                 tr, clock, "prefill", _call,
                 jitfn=self._p_prefill_ragged, units=len(picked),
@@ -2845,7 +3176,8 @@ class ServingEngine:
                     slot_log, outputs, prefix_cached, seen_groups,
                     tr=tr, t0=t_done, t_admit=e.t_admit, sink=sink,
                     acache=acache, aslot=e.aslot, spst=spst,
-                    spec_row=e.spec)
+                    spec_row=e.spec, gcache=gcache, gslot=e.gslot,
+                    gname=e.gname, gaut=e.gaut, gstate=e.gstate)
         if self._g_lane_depth is not None:
             self._g_lane_depth.set(float(len(lane)))
         m.on_lane_depth(clock.now(), len(lane))
@@ -2854,7 +3186,8 @@ class ServingEngine:
         return dispatches, tokens_run
 
     def _lane_timeouts(self, lane, book, clock, m, free_slots,
-                       slot_log, outputs, tr=None, acache=None):
+                       slot_log, outputs, tr=None, acache=None,
+                       gcache=None):
         """A lane entry whose deadline passes MID-PREFILL is evicted
         exactly like a running row past deadline (reason "timeout",
         pages and slot freed) — a state the interleaved loop cannot
@@ -2873,6 +3206,8 @@ class ServingEngine:
             if acache is not None and e.req.adapter is not None:
                 acache.release(e.req.adapter, sid)
                 self._note_adapters(acache, m, t)
+            if gcache is not None and e.gname is not None:
+                gcache.release(e.gname, sid)
             free_slots.append(e.slot)
             free_slots.sort()
             slot_log.append((round(t, 6), "release", sid, e.slot))
@@ -2933,7 +3268,7 @@ class ServingEngine:
 
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
                      outputs, tr=None, acache=None, spst=None,
-                     ahst=None):
+                     ahst=None, gcache=None):
         """One decode turn. With a spec route (``spst``), the active
         rows split into the PLAIN group (decode_n, exactly the legacy
         turn) and the SPEC group (one batched draft/verify round) —
@@ -2966,16 +3301,18 @@ class ServingEngine:
         if rows:
             self._plain_decode_rows(rows, book, clock, m, active,
                                     free_slots, slot_log, outputs,
-                                    tr=tr, acache=acache, ahst=ahst)
+                                    tr=tr, acache=acache, ahst=ahst,
+                                    gcache=gcache)
         if spec_rows:
             self._spec_decode_rows(spec_rows, book, clock, m, active,
                                    free_slots, slot_log, outputs,
                                    spst, tr=tr)
 
-    def _decode_batch(self, rows, book, acache):
+    def _decode_batch(self, rows, book, acache, gcache=None):
         """The fixed-shape decode batch for ``rows`` (host side):
-        token feed, page tables, lengths, adapter ids — the inputs a
-        decode_n dispatch is a pure function of."""
+        token feed, page tables, lengths, adapter ids, grammar flat
+        state ids — the inputs a decode_n dispatch is a pure function
+        of."""
         toks = np.zeros((self.slots,), np.int32)
         pt = np.zeros((self.slots, self.W), np.int32)
         lens = np.zeros((self.slots,), np.int32)
@@ -2984,6 +3321,10 @@ class ServingEngine:
         # loop and single-model replays never read it
         aids = np.zeros((self.slots,), np.int32) \
             if acache is not None else None
+        # per-slot grammar flat ids (0 = the all-allow identity row):
+        # free rows and empty slots mask with row 0 by construction
+        gids = np.zeros((self.slots,), np.int32) \
+            if gcache is not None else None
         for st in rows:
             table = book.tables[st.req.rid]
             pt[st.slot, :len(table)] = table
@@ -2991,7 +3332,9 @@ class ServingEngine:
             toks[st.slot] = st.tok
             if aids is not None:
                 aids[st.slot] = st.aslot
-        return toks, pt, lens, aids
+            if gids is not None and st.gaut is not None:
+                gids[st.slot] = gcache.flat_id(st.gslot, st.gstate)
+        return toks, pt, lens, aids, gids
 
     @staticmethod
     def _roster_fp(rows, book):
@@ -3006,9 +3349,20 @@ class ServingEngine:
 
     def _plain_decode_rows(self, rows, book, clock, m, active,
                            free_slots, slot_log, outputs, tr=None,
-                           acache=None, ahst=None):
+                           acache=None, ahst=None, gcache=None):
         n = self.decode_chunk
-        toks, pt, lens, aids = self._decode_batch(rows, book, acache)
+        if gcache is not None and any(st.gaut is not None
+                                      for st in rows):
+            # the DFA advances HOST-side: a constrained row's mask for
+            # token k+1 depends on token k, so a wave with any
+            # constrained row decodes one token per turn. n is a
+            # static jit arg — this adds at most ONE extra program
+            # cache entry total, flat in the number of schemas; and
+            # greedy decode is chunking-invariant, so free rows in
+            # the same wave still emit byte-identical streams.
+            n = 1
+        toks, pt, lens, aids, gids = self._decode_batch(
+            rows, book, acache, gcache)
         served_ahead = (ahst is not None and ahst.emits is not None
                         and ahst.fp == self._roster_fp(rows, book))
         if served_ahead:
@@ -3031,11 +3385,14 @@ class ServingEngine:
         else:
             def _call():
                 arr = self._arr
+                kw = {}
+                if acache is not None:
+                    kw["lora"] = self._lora_arg(acache, aids)
+                if gcache is not None:
+                    kw["grammar"] = self._grammar_arg(gcache, gids)
                 return self._p_decode_n(
                     self._p_outer, self._p_layers, arr(toks),
-                    arr(pt), arr(lens), self._pools, n,
-                    **({} if acache is None else
-                       {"lora": self._lora_arg(acache, aids)}))
+                    arr(pt), arr(lens), self._pools, n, **kw)
         attrs = dict(self._tp_attr)
         if served_ahead:
             attrs["ahead"] = True
@@ -3053,6 +3410,22 @@ class ServingEngine:
                 tok = int(emits[k, st.slot])
                 st.out.append(tok)
                 taken += 1
+                if st.gaut is not None:
+                    # the mask the device just applied came from
+                    # gstate; account it, then advance to the state
+                    # the NEXT turn will mask with
+                    mf = st.gaut.masked_frac(st.gstate)
+                    st.gmasked += mf
+                    m.on_grammar_tokens(1, mf)
+                    st.gstate = st.gaut.step(st.gstate, tok)
+                    if st.gaut.accepts_at(st.gstate):
+                        st.done = True
+                        m.on_grammar_accept(sid, t)
+                        if tr is not None:
+                            tr.instant(
+                                "grammar_accept", t=t,
+                                track=self._tenant_track(st.req),
+                                rid=sid, schema=st.gname)
                 if tok == self.eos_token_id:
                     st.done = True
             st.tok = int(emits[-1, st.slot])
@@ -3063,7 +3436,8 @@ class ServingEngine:
             if st.done or len(st.out) >= st.eff:
                 self._finish_paged(sid, book, clock, m, active,
                                    free_slots, slot_log, outputs,
-                                   tr=tr, acache=acache)
+                                   tr=tr, acache=acache,
+                                   gcache=gcache)
         if ahst is not None:
             self._dispatch_ahead_turn(ahst, book, active, acache, n)
 
@@ -3083,7 +3457,7 @@ class ServingEngine:
         nxt = sorted(active.values(), key=lambda s: s.slot)
         if not nxt or any(st.spec for st in nxt):
             return
-        toks, pt, lens, aids = self._decode_batch(nxt, book, acache)
+        toks, pt, lens, aids, _ = self._decode_batch(nxt, book, acache)
         ahst.wall0 = time.perf_counter()
         arr = self._arr
         emits, _, self._pools = self._p_decode_n(
@@ -3174,7 +3548,7 @@ class ServingEngine:
 
     def _finish_paged(self, sid, book, clock, m, active, free_slots,
                       slot_log, outputs, timeout: bool = False,
-                      tr=None, acache=None):
+                      tr=None, acache=None, gcache=None):
         st = active.pop(sid)
         book.free(sid)
         self._g_resident.set(float(len(book._refs)))
@@ -3183,6 +3557,10 @@ class ServingEngine:
             # sharer hits), reclaimed only under bank pressure
             acache.release(st.req.adapter, sid)
             self._note_adapters(acache, m, clock.now())
+        if gcache is not None and st.gname is not None:
+            # same retention discipline as adapters: the automaton
+            # stays resident-evictable for the schema's next sharer
+            gcache.release(st.gname, sid)
         free_slots.append(st.slot)
         free_slots.sort()
         slot_log.append((round(clock.now(), 6), "release", sid, st.slot))
@@ -3431,6 +3809,10 @@ class EngineSession:
         # the engine is single-model): each replica owns its bank —
         # residency is the signal adapter-aware placement routes on
         self.acache = eng._make_adapter_cache()
+        # per-session grammar cache (constrained decoding; None when
+        # the engine has no grammar store): each replica owns its
+        # mask bank, so schema residency is per-replica too
+        self.gcache = eng._make_grammar_cache()
         # per-session spec-route state (multi-replica: each replica
         # EWMAs its own acceptance and flips independently)
         self.spst = eng._make_spec_state()
@@ -3470,6 +3852,8 @@ class EngineSession:
         # a page leak is never reported as a bank-slot leak (and vice
         # versa)
         self.a_inv_ok = True
+        # grammar-slot census flag, separate for the same reason
+        self.g_inv_ok = True
         # True while the router may still submit here; finish() (and a
         # drain) clears it, enabling run()'s "nothing else will ever
         # come" admission clause
@@ -3604,6 +3988,8 @@ class EngineSession:
             self.m.forget(r.rid)
             if self.acache is not None:
                 self.acache.forget_pending(r.rid)
+            if self.gcache is not None:
+                self.gcache.forget_pending(r.rid)
             self.eng._req_close(self.tr, r, t, outcome, 0)
         # accepted-but-not-imported handoffs leave with the queue:
         # their exported KV is RECLAIMED (dropped — wherever the
@@ -3636,6 +4022,8 @@ class EngineSession:
         if self.acache is not None and st.req.adapter is not None:
             self.acache.release(st.req.adapter, rid)
             eng._note_adapters(self.acache, self.m, self.clock.now())
+        if self.gcache is not None and st.gname is not None:
+            self.gcache.release(st.gname, rid)
         self.free_slots.append(st.slot)
         self.free_slots.sort()
         t = self.clock.now()
@@ -3703,6 +4091,8 @@ class EngineSession:
         if self.acache is not None and e.req.adapter is not None:
             self.acache.release(e.req.adapter, sid)
             eng._note_adapters(self.acache, self.m, self.clock.now())
+        if self.gcache is not None and e.gname is not None:
+            self.gcache.release(e.gname, sid)
         self.free_slots.append(e.slot)
         self.free_slots.sort()
         t = self.clock.now()
@@ -3758,6 +4148,13 @@ class EngineSession:
             # next sharer), the importer re-pins at adoption
             self.acache.release(r.adapter, sid)
             eng._note_adapters(self.acache, self.m, t)
+        gname = eng._schema_of(r)
+        if self.gcache is not None and gname is not None:
+            # the grammar pin moves with the request too: the
+            # importer re-acquires and re-derives the DFA state from
+            # the first token (the exporter advanced no stream, so
+            # grammar token metrics are the IMPORTER's to count)
+            self.gcache.release(gname, sid)
         self.free_slots.append(slot)
         self.free_slots.sort()
         self.slot_log.append((round(t, 6), "handoff", sid, slot))
@@ -3834,11 +4231,42 @@ class EngineSession:
                             adapter=r.adapter))
                 except MemoryError:
                     break  # bank fully pinned: retry as rows finish
+            if r.schema is not None and self.gcache is None:
+                # _schema_of goes silently None on a grammar-less
+                # engine (the single-engine _validate path refuses
+                # earlier); an ADOPTED row must refuse here instead
+                # of free-running past its declared output contract
+                raise RuntimeError(
+                    f"handoff {sid!r} names schema {r.schema!r} but "
+                    "this decode worker was built without grammar= "
+                    "— disaggregated constrained serving needs the "
+                    "store on BOTH stages")
+            gname = eng._schema_of(r)
+            gslot, g_up, gaut = 0, False, None
+            if gname is not None:
+                try:
+                    # the importer compiles when its bank never saw
+                    # this schema — the priced clock action fires
+                    # here, on adoption, like adapter_upload above
+                    gslot, g_up = self.gcache.acquire(
+                        gname, sid,
+                        timed=lambda f: eng._timed(
+                            tr, clock, "grammar_compile", f, rid=sid,
+                            schema=gname))
+                except MemoryError:
+                    if r.adapter is not None \
+                            and self.acache is not None:
+                        self.acache.note_rollback(r.adapter, sid,
+                                                  a_up)
+                    break  # bank fully pinned: retry as rows finish
+                gaut = self.gcache.automaton(gname)
             try:
                 book.allocate(sid, eng._footprint(r))
             except MemoryError:
                 if r.adapter is not None and self.acache is not None:
                     self.acache.note_rollback(r.adapter, sid, a_up)
+                if gname is not None:
+                    self.gcache.note_rollback(gname, sid, g_up)
                 if not self.active and not (self.lane or ()) \
                         and not self.queued():
                     raise RuntimeError(
@@ -3850,6 +4278,11 @@ class EngineSession:
                 a_up = self.acache.took_upload(sid, a_up)
                 (eng._ctr_adapter_uploads if a_up
                  else eng._ctr_adapter_hits).inc()
+            if gname is not None:
+                g_up = self.gcache.took_compile(sid, g_up)
+                (eng._ctr_grammar_compiles if g_up
+                 else eng._ctr_grammar_hits).inc()
+                m.on_grammar(sid, gname, hit=not g_up)
             self.import_queue.remove(h)
             book.lengths[sid] = len(r.prompt)
             eng.import_kv_pages(book.tables[sid][:h.n_pages],
@@ -3882,7 +4315,26 @@ class EngineSession:
             if r.adapter is not None:
                 m.on_adapter(sid, r.adapter, hit=not a_up)
                 eng._note_adapters(self.acache, m, t)
-            row = _PagedRow(r, slot, h.first_tok, t0=t, aslot=aslot)
+            gstate = 0
+            row = _PagedRow(r, slot, h.first_tok, t0=t, aslot=aslot,
+                            gslot=gslot, gname=gname, gaut=gaut)
+            if gaut is not None:
+                # the exporter advanced no stream: the first token's
+                # DFA step — and its grammar token metrics — land on
+                # the importer, mirroring m.on_tokens below
+                gstate = gaut.start
+                mf = gaut.masked_frac(gstate)
+                row.gmasked += mf
+                m.on_grammar_tokens(1, mf)
+                gstate = gaut.step(gstate, int(h.first_tok))
+                row.gstate = gstate
+                if gaut.accepts_at(gstate):
+                    row.done = True
+                    m.on_grammar_accept(sid, h.t_first)
+                    if tr is not None:
+                        tr.instant("grammar_accept", t=h.t_first,
+                                   track=eng._tenant_track(r),
+                                   rid=sid, schema=gname)
             self.active[sid] = row
             self.slot_log.append((round(t, 6), "acquire", sid, slot))
             self.prefix_cached[sid] = 0
@@ -3906,6 +4358,8 @@ class EngineSession:
             eng._ctr_shed.inc()
             if self.acache is not None:
                 self.acache.forget_pending(r.rid)
+            if self.gcache is not None:
+                self.gcache.forget_pending(r.rid)
             if self.hst is not None \
                     and r.rid in self.hst["preempted"]:
                 # preempted-then-shed: the pinned chain never pages
@@ -3994,7 +4448,7 @@ class EngineSession:
                                  self.free_slots, self.slot_log,
                                  self.outputs, tr=tr,
                                  acache=self.acache, spst=self.spst,
-                                 ahst=self.ahst)
+                                 ahst=self.ahst, gcache=self.gcache)
             except DecodeError as e:
                 # one slot's computation failed: tear down exactly
                 # that row (the decode turn is forfeit — survivors
@@ -4022,7 +4476,8 @@ class EngineSession:
                                           self.slot_log,
                                           self.outputs,
                                           timeout=True, tr=tr,
-                                          acache=self.acache)
+                                          acache=self.acache,
+                                          gcache=self.gcache)
             progressed = True
         if self.lane:
             sink = self._handoff_sink if self.role == "prefill" \
@@ -4031,18 +4486,22 @@ class EngineSession:
                 self.lane, self.book, clock, m, self.active,
                 self.free_slots, self.slot_log, self.outputs,
                 self.prefix_cached, self.seen_groups, tr=tr,
-                sink=sink, acache=self.acache, spst=self.spst)
+                sink=sink, acache=self.acache, spst=self.spst,
+                gcache=self.gcache)
             self.prefill_tokens += ptoks
             if self.est is not None:
                 eng._lane_timeouts(self.lane, self.book, clock, m,
                                    self.free_slots, self.slot_log,
                                    self.outputs, tr=tr,
-                                   acache=self.acache)
+                                   acache=self.acache,
+                                   gcache=self.gcache)
             progressed = True
         eng._quant_turn(self.book, m, clock, tr, self.qst)
         self.inv_ok &= self.book.census_ok()
         if self.acache is not None:
             self.a_inv_ok &= self.acache.census_ok()
+        if self.gcache is not None:
+            self.g_inv_ok &= self.gcache.census_ok()
         return progressed
 
     def _route_ctx(self, wave):
@@ -4076,7 +4535,7 @@ class EngineSession:
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
                   else None), acache=self.acache, spst=self.spst,
-            hst=self.hst)
+            hst=self.hst, gcache=self.gcache)
         self.prefill_tokens += ptoks
         for r in wave[:n_adm]:
             self.waiting.remove(r)  # possibly reordered: by identity
@@ -4128,7 +4587,7 @@ class EngineSession:
             self.outputs, tr=tr, lane=self.lane,
             sink=(self._handoff_sink if self.role == "prefill"
                   else None), acache=self.acache, spst=self.spst,
-            hst=self.hst)
+            hst=self.hst, gcache=self.gcache)
         self.prefill_tokens += ptoks
         if n_adm:
             dt = clock.now() - t0
@@ -4146,7 +4605,8 @@ class EngineSession:
                                       self.active, self.free_slots,
                                       self.slot_log, self.sched,
                                       self.hst, self._shed, tr=tr,
-                                      acache=self.acache):
+                                      acache=self.acache,
+                                      gcache=self.gcache):
             return True
         if not self.active and not self.lane \
                 and not self.import_queue:
@@ -4246,5 +4706,9 @@ class EngineSession:
                                                    self.hst),
             pages_spilled=(
                 None if self.hst is None else
-                self.book.cache_stats().get("spilled_pages", 0)))
+                self.book.cache_stats().get("spilled_pages", 0)),
+            grammar_stats=(
+                None if self.gcache is None else
+                dict(self.gcache.cache_stats(),
+                     invariant_ok=self.g_inv_ok)))
         return self._finished

@@ -133,6 +133,13 @@ class MetricsCollector:
         # their records byte-identical (the PR-5 presence convention)
         self._hostmem = {"pageouts": 0, "pageins": 0,
                          "preempts": 0, "restores": 0}
+        # constrained-decoding totals (engine-fed); the report grows
+        # its grammar block ONLY when a constrained row actually ran,
+        # so grammar=None runs keep their records byte-identical (the
+        # PR-5 presence convention)
+        self._grammar = {"streams": 0, "hits": 0, "compiles": 0,
+                        "tokens": 0, "masked_sum": 0.0, "accepts": 0}
+        self._grammar_names: set = set()
         # ``monitor`` (obs.slo.SLOMonitor, optional) receives each
         # request's FINAL record at finish/shed plus queue/lane depth
         # samples — the one seam through which the streaming SLO layer
@@ -305,6 +312,30 @@ class MetricsCollector:
         back in (or re-prefilled where the arena had let go) and its
         stream resumes exactly where it stopped."""
         self._hostmem["restores"] += 1
+
+    def on_grammar(self, rid: str, schema: str, hit: bool):
+        """``rid`` admitted as a CONSTRAINED stream under ``schema``;
+        ``hit`` means the compiled automaton was already resident in
+        the device mask bank (a miss paid one priced
+        ``grammar_compile`` on the engine clock)."""
+        self._grammar["streams"] += 1
+        self._grammar["hits" if hit else "compiles"] += 1
+        self._grammar_names.add(schema)
+
+    def on_grammar_tokens(self, n: int, masked_frac_sum: float):
+        """``n`` constrained tokens emitted under grammar masks whose
+        per-token forbidden-vocab fractions sum to
+        ``masked_frac_sum`` — the report's ``tokens_masked_frac`` is
+        the mean, how much of the vocabulary the automaton actually
+        pruned per step."""
+        self._grammar["tokens"] += int(n)
+        self._grammar["masked_sum"] += float(masked_frac_sum)
+
+    def on_grammar_accept(self, rid: str, t: float):
+        """``rid``'s automaton reached an accepting state and the
+        stream self-terminated — structurally complete output, before
+        (or at) its token budget."""
+        self._grammar["accepts"] += 1
 
     def forget(self, rid: str):
         """Erase every trace of ``rid`` from this collector — the
@@ -484,6 +515,21 @@ class MetricsCollector:
             rec["kv_pageins"] = self._hostmem["pageins"]
             rec["preemptions"] = self._hostmem["preempts"]
             rec["preempt_restores"] = self._hostmem["restores"]
+        if self._grammar["streams"] > 0:
+            # constrained-decoding block, present only when a
+            # constrained row actually ran (same convention):
+            # grammar=None replays stay byte-identical
+            rec["constrained_streams"] = self._grammar["streams"]
+            rec["schemas_served"] = len(self._grammar_names)
+            rec["grammar_cache_hits"] = self._grammar["hits"]
+            rec["grammar_compiles"] = self._grammar["compiles"]
+            rec["grammar_cache_hit_rate"] = round(
+                self._grammar["hits"] / self._grammar["streams"], 4)
+            rec["grammar_accepts"] = self._grammar["accepts"]
+            if self._grammar["tokens"] > 0:
+                rec["tokens_masked_frac"] = round(
+                    self._grammar["masked_sum"]
+                    / self._grammar["tokens"], 4)
         if slo_ttft is not None and ttfts:
             rec["slo_ttft"] = slo_ttft
             rec["slo_ttft_attained"] = round(
@@ -599,6 +645,25 @@ class MetricsCollector:
                       "LoRA adapters resident in the device bank "
                       "(pinned + retained)").set(
                 float(self._adapter_resident))
+        # constrained-decoding gauges: ONLY when a constrained row
+        # actually ran — grammar=None replays leave the registry
+        # byte-identical (PR-5 convention)
+        if self._grammar["streams"] > 0:
+            reg.gauge("serving_constrained_streams",
+                      "requests decoded under a grammar mask").set(
+                float(self._grammar["streams"]))
+            reg.gauge("serving_grammar_cache_hit_rate",
+                      "fraction of constrained admissions whose "
+                      "automaton was already resident").set(
+                round(self._grammar["hits"]
+                      / self._grammar["streams"], 4))
+            if self._grammar["tokens"] > 0:
+                reg.gauge("serving_tokens_masked_frac",
+                          "mean fraction of the vocabulary the "
+                          "grammar mask forbade per constrained "
+                          "token").set(
+                    round(self._grammar["masked_sum"]
+                          / self._grammar["tokens"], 4))
         # per-device KV-pool residency: ONLY when the run was sharded
         # (the engine streamed it through on_pool_bytes) — unsharded
         # replays leave the registry byte-identical (PR-5 convention)

@@ -226,6 +226,15 @@ class QoSScheduler:
         # run — the rung between degrade (shorter answers) and shed
         # (no answer): the victim still finishes, just later.
         self.track_preempt = False
+        # grammar floor for CONSTRAINED streams
+        # (``ServingEngine(grammar=...)`` arms it; same armed-only-
+        # when-a-consumer-exists discipline): a callable mapping a
+        # Request to the automaton's shortest-accept length (None for
+        # free rows). A degrade tier must never clamp a constrained
+        # budget below the shortest string its grammar accepts —
+        # that would GUARANTEE a structurally broken answer, strictly
+        # worse than shedding.
+        self.grammar_min_tokens = None
         self.reset()
 
     # --- state ------------------------------------------------------------
@@ -459,9 +468,18 @@ class QoSScheduler:
         pf = est.prefill_cost(uncached, prompt_tokens=len(r.prompt))
         dl = r.deadline_time()
         budget = r.max_new_tokens
+        # degrade floor: a constrained stream is never clamped below
+        # its automaton's shortest-accept length (armed by the engine
+        # through ``grammar_min_tokens``; free rows and unarmed
+        # schedulers keep the legacy floor of 1 bit-for-bit)
+        floor = 1
+        if self.grammar_min_tokens is not None:
+            g = self.grammar_min_tokens(r)
+            if g is not None:
+                floor = max(1, min(int(g), budget))
         if dl is None:
             if cap is not None:
-                b = max(1, math.ceil(budget * cap))
+                b = max(floor, math.ceil(budget * cap))
                 if b < budget:
                     return (dataclasses.replace(r, max_new_tokens=b),
                             f"incident degradation tier {cap} "
@@ -479,7 +497,7 @@ class QoSScheduler:
             tiers = (1.0,) + tuple(f for f in self.degrade_tiers
                                    if f < 1.0)
         for frac in tiers:
-            b = max(1, math.ceil(budget * frac))
+            b = max(floor, math.ceil(budget * frac))
             fin = t0 + math.ceil(b / decode_chunk) * est.decode \
                 * self.headroom
             if fin <= dl + 1e-9:

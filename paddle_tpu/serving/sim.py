@@ -70,7 +70,9 @@ class SimServing:
                  chunked_prefill: int | None = None, tp=None,
                  lora_slots: int | None = None,
                  spec_accept: float | None = None,
-                 kv_quant: str | None = None):
+                 kv_quant: str | None = None,
+                 grammar_slots: int | None = None,
+                 grammar_states: int = 64):
         if max_len % page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {page_size}")
@@ -84,7 +86,8 @@ class SimServing:
         # byte census + gauge, handoff tp tags and placement filters —
         # runs at 10^5-request scale. Compute-sharding parity is the
         # real factory's claim, not the sim's.
-        from ..models.nlp.llama_decode import (LoRAConfig,
+        from ..models.nlp.llama_decode import (GrammarConfig,
+                                               LoRAConfig,
                                                PagedOnlyDense,
                                                as_tp_config)
         self.tp_ = as_tp_config(tp)
@@ -99,6 +102,20 @@ class SimServing:
         # is ``{"salt": int}`` (or a bare int).
         self.lora_ = None if lora_slots is None \
             else LoRAConfig(n_slots=int(lora_slots), rank=1)
+        # ``grammar_slots``: the sim's CONSTRAINED-DECODING stand-in.
+        # The real factory masks logits with a packed per-state
+        # allow-bitmask before its argmax; the sim's token rule picks
+        # ``allowed[hash % len(allowed)]`` from the SAME unpacked bank
+        # row — deterministic, and an all-allow row (flat id 0, the
+        # identity every free row indexes) special-cases to EXACTLY
+        # the base rule, so free rows are byte-identical to a
+        # grammar-less sim. The factory advertises ``grammar_`` /
+        # ``grammar_vocab_`` plus the ``init_grammar_bank``/
+        # ``upload_grammar`` hooks the engine's GrammarCache consumes.
+        self.grammar_ = None if grammar_slots is None \
+            else GrammarConfig(n_slots=int(grammar_slots),
+                               max_states=int(grammar_states))
+        self.grammar_vocab_ = int(vocab)
         # ``kv_quant``: the sim's QUANTIZED-PAGE-TIER stand-in. The
         # token pool is lossless content (int64 tokens have no numerics
         # to degrade — greedy parity with the unquantized sim is EXACT,
@@ -180,6 +197,16 @@ class SimServing:
                                self._make_spec_step())
 
     # --- the token rule ---------------------------------------------------
+    def _hash(self, seq, adapter_salt: int = 0) -> int:
+        """The salted uint64 wraparound polynomial hash of ``seq`` —
+        the one source of randomness both token rules draw from."""
+        seq = np.asarray(seq, np.uint64)
+        L = len(seq)
+        with np.errstate(over="ignore"):
+            h = (seq * self._pow[L - 1::-1]).sum()
+        return (int(h) + self.salt + int(adapter_salt)) \
+            & ((1 << 64) - 1)
+
     def _token(self, seq, adapter_salt: int = 0) -> int:
         """THE greedy rule: next token after history ``seq`` = uint64
         wraparound polynomial hash of the whole sequence (deterministic
@@ -189,12 +216,33 @@ class SimServing:
         RESUME-CONSISTENT (see the module docstring). ``adapter_salt``
         (multi-adapter serving) folds the row's adapter into the hash:
         salt 0 — slot 0, the identity — is EXACTLY the base rule."""
-        seq = np.asarray(seq, np.uint64)
-        L = len(seq)
-        with np.errstate(over="ignore"):
-            h = (seq * self._pow[L - 1::-1]).sum()
-        h = (int(h) + self.salt + int(adapter_salt)) & ((1 << 64) - 1)
-        return 1 + h % (self.vocab - 1)
+        return 1 + self._hash(seq, adapter_salt) % (self.vocab - 1)
+
+    def _token_masked(self, seq, adapter_salt: int, allow) -> int:
+        """The CONSTRAINED rule: the same hash picks among the mask
+        row's allowed tokens. An all-allow row (the reserved flat id
+        0 every free row indexes) is EXACTLY the base rule — free
+        rows in a constrained wave stay byte-identical to
+        ``grammar=None``. Mirrors the real factory's masked argmax:
+        deterministic in (history, mask)."""
+        allow = np.asarray(allow, bool)
+        if allow.all():
+            return self._token(seq, adapter_salt)
+        allowed = np.nonzero(allow)[0]
+        if len(allowed) == 0:
+            raise ValueError("grammar mask allows no token (dead "
+                             "state reached — engine bug)")
+        return int(allowed[self._hash(seq, adapter_salt)
+                           % len(allowed)])
+
+    def _grammar_row(self, grammar, s: int):
+        """Unpack row ``s`` of a ``(bank, gids)`` grammar payload to
+        a (vocab,) bool allow vector; None without a payload or for
+        flat id 0 fast-path handled by the caller via all-allow."""
+        from .grammar import unpack_row
+        bank, gids = grammar
+        gid = int(np.asarray(gids)[s])
+        return unpack_row(np.asarray(bank)[gid], self.vocab)
 
     def _draft_token(self, seq) -> int:
         """The sim DRAFT's proposal after history ``seq``: the true
@@ -293,13 +341,42 @@ class SimServing:
         bank[int(slot)] = int(salt)
         return bank
 
+    # --- grammar-bank hooks (GrammarCache's device seam) ------------------
+    def init_grammar_bank(self):
+        """The packed allow-bitmask bank, sim edition: the SAME layout
+        the real factory stages on device — ``(n_slots * max_states,
+        ceil(vocab/32))`` uint32, slot 0 (flat ids ``0..max_states-1``)
+        all-ones so free rows index the reserved all-allow identity —
+        just host numpy (``wants_numpy_``)."""
+        if self.grammar_ is None:
+            raise ValueError("SimServing built without grammar_slots")
+        ns, ms = self.grammar_.n_slots, self.grammar_.max_states
+        words = (self.vocab + 31) // 32
+        bank = np.zeros((ns * ms, words), np.uint32)
+        bank[:ms] = np.uint32(0xFFFFFFFF)
+        return bank
+
+    def upload_grammar(self, bank, slot, compiled):
+        """Write a compiled automaton's per-state masks into its slot's
+        block (zero-padding unused state rows — a stale mask from the
+        evicted tenant must never leak into a shorter successor)."""
+        ms = self.grammar_.max_states
+        n = int(compiled.n_states)
+        if n > ms:
+            raise ValueError(f"automaton has {n} states but the bank "
+                             f"holds max_states={ms}")
+        lo = int(slot) * ms
+        bank[lo:lo + ms] = 0
+        bank[lo:lo + n] = np.asarray(compiled.masks, np.uint32)
+        return bank
+
     # --- the factory callables --------------------------------------------
     def _make_prefill(self):
         ps = self.page_size_
         C = self.chunked_prefill_
 
         def prefill(outer, layers, toks, pt, lens, pools,
-                    resume_from: int = 0, lora=None):
+                    resume_from: int = 0, lora=None, grammar=None):
             toks = np.asarray(toks)
             pt = np.asarray(pt)
             L = int(np.asarray(lens)[0])
@@ -316,7 +393,11 @@ class SimServing:
             if lora is not None:
                 bank, ids = lora
                 a_salt = int(np.asarray(bank)[int(np.asarray(ids)[0])])
-            first = self._token(seq, a_salt)
+            if grammar is not None:
+                first = self._token_masked(
+                    seq, a_salt, self._grammar_row(grammar, 0))
+            else:
+                first = self._token(seq, a_salt)
             return np.asarray([first], np.int64), pools
 
         prefill._cache_size = lambda: 0  # no jit cache to watch
@@ -326,7 +407,7 @@ class SimServing:
         ps = self.page_size_
 
         def prefill_ragged(outer, layers, chunk, starts, pt, lens,
-                           pools, lora=None):
+                           pools, lora=None, grammar=None):
             """The real factory's fused lane dispatch, sim edition:
             row r writes the C tokens of ``chunk[r]`` at absolute
             positions ``starts[r]..`` through its own page table, then
@@ -356,7 +437,11 @@ class SimServing:
                 seq = pools[pages].reshape(-1)[:L]
                 a_salt = int(bank[int(ids[s])]) if bank is not None \
                     else 0
-                firsts[s] = self._token(seq, a_salt)
+                if grammar is not None:
+                    firsts[s] = self._token_masked(
+                        seq, a_salt, self._grammar_row(grammar, s))
+                else:
+                    firsts[s] = self._token(seq, a_salt)
             return firsts, pools
 
         prefill_ragged._cache_size = lambda: 0
@@ -366,7 +451,7 @@ class SimServing:
         ps = self.page_size_
 
         def decode_n(outer, layers, toks, pt, lens, pools, n: int,
-                     lora=None):
+                     lora=None, grammar=None):
             toks = np.asarray(toks)
             pt = np.asarray(pt)
             lens = np.asarray(lens)
@@ -382,6 +467,12 @@ class SimServing:
                     continue  # empty slot rides along (page-0 row)
                 a_salt = int(bank[int(ids[s])]) if bank is not None \
                     else 0
+                # grammar ids are DISPATCH-TIME state (advanced
+                # host-side), so every scanned step masks with the
+                # same row — the engine clamps n=1 for constrained
+                # waves, exactly like the real factory's decode_n
+                g_allow = None if grammar is None \
+                    else self._grammar_row(grammar, s)
                 cur = int(toks[s])
                 for k in range(n):
                     pools[pt[s, L // ps], L % ps] = cur
@@ -389,7 +480,10 @@ class SimServing:
                     # a wrong table/chain/pool diverges every token
                     npages = -(-(L + 1) // ps)
                     seq = pools[pt[s, :npages]].reshape(-1)[:L + 1]
-                    cur = self._token(seq, a_salt)
+                    if g_allow is not None:
+                        cur = self._token_masked(seq, a_salt, g_allow)
+                    else:
+                        cur = self._token(seq, a_salt)
                     emits[k, s] = cur
                     L += 1
             return emits, None, pools
@@ -439,7 +533,7 @@ class SimServing:
 
     # --- the offline oracle -----------------------------------------------
     def expected_stream(self, prompt, n_tokens: int,
-                        adapter_salt: int = 0):
+                        adapter_salt: int = 0, grammar=None):
         """The token stream a request with ``prompt`` generates,
         computed WITHOUT any engine — the closed-form oracle parity
         tests compare engine outputs against. (The engine path reads
@@ -448,13 +542,27 @@ class SimServing:
         token rule: ``expected_stream(prompt + s[:e], n-e)`` equals
         ``expected_stream(prompt, n)[e:]`` for any emitted prefix
         ``s = expected_stream(prompt, n)``. ``adapter_salt`` is the
-        request's adapter (0 = base model)."""
+        request's adapter (0 = base model). ``grammar`` — a
+        ``CompiledGrammar`` — walks the automaton exactly like the
+        engine: each emission is the constrained rule under the
+        current state's mask, the state advances on the emitted
+        token, and the stream STOPS at an accepting state (shorter
+        than ``n_tokens`` when the automaton accepts first)."""
+        from .grammar import unpack_row
         hist = [int(t) for t in prompt]
         out = []
+        state = None if grammar is None else grammar.start
         for _ in range(max(0, n_tokens)):
-            nxt = self._token(hist, adapter_salt)
+            if grammar is None:
+                nxt = self._token(hist, adapter_salt)
+            else:
+                allow = unpack_row(grammar.masks[state], self.vocab)
+                nxt = self._token_masked(hist, adapter_salt, allow)
+                state = grammar.step(state, nxt)
             out.append(nxt)
             hist.append(nxt)
+            if grammar is not None and grammar.accepts_at(state):
+                break
         return out
 
 

@@ -53,6 +53,12 @@ class Request:
     JSONL record carries the keys only when set — the
     ``Request.adapter`` convention, so session-less traces round-trip
     byte-identically.
+
+    ``schema`` names the grammar/JSON-schema this request's output
+    must satisfy (constrained decoding; ``synthesize_schema_trace``).
+    ``None`` — the default, and what every legacy trace loads as —
+    is a free-running stream. The JSONL record carries the key only
+    when set, so schema-less traces round-trip byte-identically.
     """
 
     rid: str
@@ -67,6 +73,7 @@ class Request:
     adapter: Optional[str] = None
     session: Optional[str] = None
     turn: Optional[int] = None
+    schema: Optional[str] = None
 
     def to_json(self) -> dict:
         d = {"rid": self.rid, "arrival": self.arrival,
@@ -88,6 +95,8 @@ class Request:
             d["session"] = self.session
         if self.turn is not None:
             d["turn"] = self.turn
+        if self.schema is not None:
+            d["schema"] = self.schema
         return d
 
     @staticmethod
@@ -102,7 +111,8 @@ class Request:
                        deadline_ms=d.get("deadline_ms"),
                        adapter=d.get("adapter"),
                        session=d.get("session"),
-                       turn=(int(d["turn"]) if "turn" in d else None))
+                       turn=(int(d["turn"]) if "turn" in d else None),
+                       schema=d.get("schema"))
 
     def deadline_time(self) -> Optional[float]:
         """Absolute deadline in clock units (None when unbounded)."""
@@ -701,6 +711,78 @@ def synthesize_zipf_adapter_trace(seed: int = 0,
     return sorted(reqs, key=lambda r: (r.arrival, r.rid))
 
 
+def synthesize_schema_trace(seed: int = 0, n_requests: int = 2000, *,
+                            n_schemas: int = 4,
+                            schema_skew: float = 1.1,
+                            free_frac: float = 0.25,
+                            service_tokens_per_unit: float = 8.0,
+                            overload: float = 1.4,
+                            prompt_len: Tuple[int, int] = (4, 12),
+                            output_len: Tuple[int, int] = (24, 48),
+                            vocab_size: int = 509,
+                            unit_ms: float = 1000.0,
+                            slack: float = 6.0,
+                            chunk_tokens: int = 8,
+                            rid_prefix: str = "G",
+                            start: float = 0.0) -> List[Request]:
+    """The STRUCTURED-OUTPUT workload: traffic whose requests each
+    name one of ``n_schemas`` grammars (constrained decoding),
+    popularity SKEWED by a Zipf-like law (weight
+    ``1/(rank+1)^schema_skew``) — production tool-call traffic
+    concentrates on a few hot schemas while a long tail stays warm,
+    which is exactly the shape the budgeted ``GrammarCache`` serves
+    with one compile per schema. ``free_frac`` of requests carry
+    ``schema=None`` (free-running streams riding the same batches
+    through the all-allow state).
+
+    Arrivals are sorted uniforms over a span sized so demanded output
+    tokens land at ``overload`` x ``service_tokens_per_unit``; output
+    budgets are generous (``output_len`` high) because a constrained
+    stream self-terminates when its automaton accepts — the budget is
+    a ceiling, not the expected length. Every request gets a loose
+    ``deadline_ms`` so goodput stays deadline-honest.
+
+    Schema ids are BAKED INTO rids — ``{rid_prefix}-00042.s3`` /
+    ``...free`` — so a gate can audit per-schema routing and free-row
+    parity without a side channel; the schema NAME is ``s<k>``.
+    Deterministic in every field; JSONL round-trips via
+    ``save_trace``/``load_trace``."""
+    if n_schemas < 1:
+        raise ValueError("need >= 1 schema")
+    if not 0.0 <= free_frac <= 1.0:
+        raise ValueError("free_frac must be in [0, 1]")
+    if schema_skew < 0:
+        raise ValueError("schema_skew must be >= 0")
+    rng = np.random.default_rng(seed)
+    w = np.asarray([1.0 / (k + 1) ** schema_skew
+                    for k in range(n_schemas)])
+    w = w / w.sum()
+    budgets = [int(rng.integers(output_len[0], output_len[1] + 1))
+               for _ in range(n_requests)]
+    span = sum(budgets) / (overload * service_tokens_per_unit)
+    times = np.sort(rng.uniform(0.0, span, n_requests))
+    reqs: List[Request] = []
+    for i in range(n_requests):
+        plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        prompt = tuple(int(t) for t in rng.integers(1, vocab_size,
+                                                    plen))
+        budget = budgets[i]
+        if free_frac > 0 and rng.random() < free_frac:
+            schema, tag = None, "free"
+        else:
+            k = int(rng.choice(n_schemas, p=w))
+            schema, tag = f"s{k}", f"s{k}"
+        chunks = -(-plen // chunk_tokens)
+        reqs.append(Request(
+            rid=f"{rid_prefix}-{i:05d}.{tag}",
+            arrival=start + float(times[i]), prompt=prompt,
+            max_new_tokens=budget,
+            deadline_ms=round((chunks + budget + 1) * unit_ms
+                              * slack, 3),
+            schema=schema))
+    return sorted(reqs, key=lambda r: (r.arrival, r.rid))
+
+
 def synthesize_session_trace(seed: int = 0, n_sessions: int = 8, *,
                              turns: int = 3,
                              think_time: float = 40.0,
@@ -1165,4 +1247,12 @@ def trace_stats(trace: Sequence[Request]) -> dict:
         out["sessions"] = len(sessions)
         out["session_turns"] = sum(
             1 for r in trace if r.session is not None)
+    schemas = sorted({r.schema for r in trace
+                      if r.schema is not None})
+    if schemas:
+        # only schema-carrying traces grow these keys (free-running
+        # trace stats stay byte-identical)
+        out["schemas"] = schemas
+        out["schema_requests"] = sum(
+            1 for r in trace if r.schema is not None)
     return out

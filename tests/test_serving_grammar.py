@@ -1,0 +1,998 @@
+"""Constrained decoding: grammar/JSON-schema guided generation.
+
+The claims: JSON schemas and a small EBNF subset compile host-side to
+token-level DFAs whose packed allow-bitmasks decode through ONE
+fixed-shape compiled batch (the mask bank + per-row flat state ids are
+jit data — the ``decode_n`` program cache stays flat across schema
+churn), every constrained stream detokenizes to text its schema
+validates and stops at the automaton's accept, free rows riding the
+same batch are token-identical to an unconstrained engine,
+``grammar=None`` everywhere is byte-identical to the pre-grammar
+engine (outputs, slot logs, decisions, metrics records, report keys,
+registry contents), the budgeted ``GrammarCache`` honors LRU
+retention / pin-while-in-flight / refusal-requeues with its
+resident+evictable+free census conserved, constrained rows compose
+with LoRA (``adapter_schemas`` defaults) / TP / QoS degrade (the
+min-tokens floor) / disaggregated handoffs / host-DRAM preemption,
+``Request.schema`` round-trips JSONL with legacy traces untouched,
+the metrics/trace grammar blocks appear ONLY for constrained traffic,
+and the ``serving_grammar`` bench-gate family passes its pass rows
+and fails its FAIL rows.
+"""
+import dataclasses
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.nlp.llama_decode import (
+    GrammarConfig, as_grammar_config, grammar_bank_hooks)
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.serving import (AdapterStore, ClusterRouter,
+                                GrammarCache, GrammarStore,
+                                QoSScheduler, Request, ServingEngine,
+                                TokenVocab, compile_grammar,
+                                compile_schema, compile_source,
+                                load_trace, make_sim_serving,
+                                save_trace, schema_accepts,
+                                synthesize_schema_trace,
+                                synthesize_trace, trace_stats)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB = 97          # the real tiny-llama vocab (>= ascii_default's 97)
+SIMVOCAB = 509
+COSTS = {"prefill_unit": 1.0, "decode": 1.0, "grammar_compile": 1.0}
+VO = TokenVocab.ascii_default(SIMVOCAB)
+
+# one required property per schema, the KEY baked per schema id — two
+# schemas never accept the same text (the bench arm's palette)
+_KINDS = [{"type": "boolean"},
+          {"type": "integer", "maxDigits": 3},
+          {"enum": ["lo", "mid", "hi"]},
+          {"type": "string", "maxLength": 6}]
+
+
+def _schemas(n=4):
+    return {f"s{k}": {"type": "object",
+                      "properties": {f"k{k}": _KINDS[k % len(_KINDS)]},
+                      "required": [f"k{k}"]}
+            for k in range(n)}
+
+
+def _store(n=4):
+    return GrammarStore(_schemas(n))
+
+
+def _sim_engine(grammar_slots=None, grammar=None, slots=8, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", dict(COSTS))
+    kw.setdefault("decode_chunk", 4)
+    return ServingEngine(
+        serving=make_sim_serving(max_len=96, page_size=8, slots=slots,
+                                 vocab=SIMVOCAB,
+                                 grammar_slots=grammar_slots),
+        slots=slots, policy="paged", grammar=grammar, **kw)
+
+
+def _trace(seed=0, n=40, n_schemas=4, **kw):
+    kw.setdefault("overload", 0.6)     # sub-saturation: no evictions
+    return synthesize_schema_trace(seed=seed, n_requests=n,
+                                   n_schemas=n_schemas,
+                                   vocab_size=SIMVOCAB, **kw)
+
+
+# --- Request.schema + trace round-trip --------------------------------------
+
+def test_request_schema_roundtrip(tmp_path):
+    """The schema field survives JSONL; the key is written only when
+    set, so schema-less records are byte-identical to PR 17's."""
+    r = Request(rid="x", arrival=1.0, prompt=(1, 2), max_new_tokens=3,
+                schema="invoice")
+    assert Request.from_json(r.to_json()) == r
+    plain = Request(rid="y", arrival=2.0, prompt=(3,), max_new_tokens=1)
+    assert "schema" not in plain.to_json()
+    assert Request.from_json(plain.to_json()).schema is None
+    p = tmp_path / "t.jsonl"
+    save_trace(str(p), [r, plain])
+    assert load_trace(str(p)) == [r, plain]
+
+
+def test_legacy_trace_jsonl_no_schema_key(tmp_path):
+    """A schema-less trace's JSONL carries no ``schema`` key — the
+    byte-identity regression against the pre-grammar serializer."""
+    trace = synthesize_trace(seed=3, n_requests=6, vocab_size=VOCAB)
+    p = tmp_path / "t.jsonl"
+    save_trace(str(p), trace)
+    for line in open(p):
+        assert "schema" not in json.loads(line)
+
+
+def test_schema_trace_shape():
+    """Seeded determinism, rid-baked schema ids, Zipf head heavier
+    than tail, loose deadlines, trace_stats keys, JSONL round-trip."""
+    a = _trace(seed=7, n=400)
+    b = _trace(seed=7, n=400)
+    assert a == b
+    assert any(r.schema is None and r.rid.endswith(".free") for r in a)
+    counts = {}
+    for r in a:
+        if r.schema is not None:
+            assert r.rid.endswith("." + r.schema)
+            counts[r.schema] = counts.get(r.schema, 0) + 1
+    assert counts["s0"] > counts["s3"]  # the Zipf skew
+    assert all(r.deadline_ms is not None for r in a)
+    st = trace_stats(a)
+    assert st["schemas"] == sorted(counts)
+    assert st["schema_requests"] == sum(counts.values())
+    # schema-less stats carry no schema keys
+    st0 = trace_stats(synthesize_trace(seed=0, n_requests=4))
+    assert "schemas" not in st0 and "schema_requests" not in st0
+    with pytest.raises(ValueError, match="schema"):
+        synthesize_schema_trace(n_schemas=0)
+
+
+# --- the compiler ------------------------------------------------------------
+
+def test_token_vocab():
+    with pytest.raises(ValueError, match="97"):
+        TokenVocab.ascii_default(96)
+    v = TokenVocab.ascii_default(97)
+    text = '{"k": [1, "ab"]}'
+    assert v.decode(v.encode(text)) == text
+    assert v.surface(1) == " " and v.surface(96) is None
+    with pytest.raises(ValueError, match="no token"):
+        v.encode("é")
+    with pytest.raises(ValueError, match="vocab_size"):
+        TokenVocab({1: "a"}, 1)
+    with pytest.raises(ValueError, match="outside"):
+        TokenVocab({0: "a"}, 4)
+
+
+@pytest.mark.parametrize("schema,good,bad", [
+    ({"type": "boolean"}, "true", "yes"),
+    ({"type": "null"}, "null", "nil"),
+    ({"type": "integer", "maxDigits": 3}, "-42", "1234"),
+    ({"type": "integer", "maxDigits": 2, "minimum": 0}, "7", "-7"),
+    ({"enum": ["lo", "mid", "hi"]}, '"mid"', '"md"'),
+    ({"type": "string", "minLength": 2, "maxLength": 4},
+     '"abcd"', '"a"'),
+    ({"type": "array", "items": {"type": "boolean"}, "minItems": 1,
+      "maxItems": 2}, "[true,false]", "[true,false,true]"),
+    ({"type": "object",
+      "properties": {"ok": {"type": "boolean"},
+                     "n": {"type": "integer", "maxDigits": 2}},
+      "required": ["ok", "n"]},
+     '{"ok":true,"n":12}', '{"ok":1,"n":12}'),
+])
+def test_compile_schema_accepts_exactly(schema, good, bad):
+    """Each schema kind compiles to a DFA that accepts precisely the
+    strings ``schema_accepts`` validates: a valid serialization walks
+    to an accepting state, an invalid one is rejected (a forbidden
+    token or a non-accepting end state)."""
+    g = compile_schema(schema, VO)
+    st = g.walk(VO.encode(good))
+    assert g.accepts_at(st)
+    assert schema_accepts(schema, good)
+    assert not schema_accepts(schema, bad)
+    try:
+        st = g.walk(VO.encode(bad))
+        assert not g.accepts_at(st)
+    except ValueError:
+        pass  # rejected mid-walk: a token the mask forbids
+    if schema.get("type") == "object":
+        # the DFA emits properties in declaration order — a reordered
+        # (but semantically valid) serialization is NOT in the
+        # generated language
+        with pytest.raises(ValueError):
+            g.walk(VO.encode('{"n":12,"ok":true}'))
+    assert 1 <= g.min_tokens <= len(good)
+    # masks and trans can never disagree: every allowed bit has a
+    # transition and vice versa
+    from paddle_tpu.serving.grammar import unpack_row
+    for s in range(1, g.n_states):
+        allow = unpack_row(g.masks[s], g.vocab_size)
+        assert (allow == (g.trans[s] >= 0)).all()
+        frac = g.masked_frac(s)
+        assert 0.0 <= frac <= 1.0
+        if allow.any():
+            assert frac < 1.0
+
+
+def test_compile_ebnf_and_source_dispatch():
+    g = compile_grammar('root ::= "ab" | "c" d\nd ::= [0-9]{1,2}', VO)
+    for text in ("ab", "c7", "c07"):
+        assert g.accepts_at(g.walk(VO.encode(text)))
+    assert not g.accepts_at(g.walk(VO.encode("c")))
+    assert g.min_tokens == 2 and g.max_tokens == 3
+    # unbounded repetition -> cyclic DFA, max_tokens None
+    cyc = compile_grammar("root ::= [ab]+", VO)
+    assert cyc.max_tokens is None
+    with pytest.raises(ValueError, match="unknown rule"):
+        compile_grammar('root ::= miss', VO)
+    with pytest.raises(ValueError, match="recursive|expands"):
+        compile_grammar('root ::= "a" root', VO)
+    with pytest.raises(ValueError, match="::="):
+        compile_grammar("root = 'a'", VO)
+    # compile_source dispatches on the source type
+    assert compile_source({"type": "boolean"}, VO).accepts_at(
+        compile_source({"type": "boolean"}, VO).walk(VO.encode("true")))
+    assert compile_source('root ::= "x"', VO).min_tokens == 1
+    with pytest.raises(ValueError, match="schema dict or EBNF"):
+        compile_source(42, VO)
+    # a grammar whose alphabet is outside the vocab accepts nothing
+    tiny = TokenVocab({1: "a"}, 4)
+    with pytest.raises(ValueError, match="no token"):
+        compile_grammar('root ::= "b"', tiny)
+    # ...and one whose start allows tokens but can never reach an
+    # accepting state is refused at compile too
+    with pytest.raises(ValueError, match="accepts no string"):
+        compile_grammar('root ::= "a" "b"', tiny)
+
+
+def test_pack_unpack_roundtrip():
+    import numpy as np
+    from paddle_tpu.serving.grammar import pack_masks, unpack_row
+    rng = np.random.default_rng(0)
+    allow = rng.random((5, 77)) < 0.3
+    packed = pack_masks(allow)
+    assert packed.dtype == np.uint32
+    for s in range(5):
+        assert (unpack_row(packed[s], 77) == allow[s]).all()
+
+
+# --- GrammarCache units ------------------------------------------------------
+
+def _gcache(n_slots=3, n=6, max_states=64):
+    store = _store(n)
+    sim = make_sim_serving(grammar_slots=n_slots,
+                           grammar_states=max_states, vocab=SIMVOCAB)
+    return store, GrammarCache(store, n_slots, max_states,
+                               TokenVocab.ascii_default(SIMVOCAB),
+                               sim.init_grammar_bank,
+                               sim.upload_grammar)
+
+
+def test_gcache_hit_miss_compile_and_flat_ids():
+    _, c = _gcache(n_slots=3)
+    s1, up1 = c.acquire("s0", "r1")
+    assert up1 and s1 == 1
+    s2, up2 = c.acquire("s0", "r2")      # second pin: hit, same slot
+    assert (s2, up2) == (s1, False)
+    s3, up3 = c.acquire("s1", "r3")
+    assert up3 and s3 == 2
+    st = c.cache_stats()
+    assert st["compiles"] == 2 and st["hits"] == 1
+    assert c.census_ok()
+    # flat ids index slot*max_states + state; slot 0 state 0 is the
+    # reserved all-allow identity every free row carries
+    assert c.flat_id(0, 0) == 0
+    assert c.flat_id(s3, 5) == s3 * c.max_states + 5
+    # the host automaton memo compiles once, probes never pin
+    a = c.automaton("s2")
+    assert c.automaton("s2") is a and not c.resident("s2")
+
+
+def test_gcache_lru_eviction_order():
+    """Released grammars park evictable in release order; a miss
+    reclaims the LEAST recently parked first."""
+    _, c = _gcache(n_slots=3)
+    c.acquire("s0", "r0")
+    c.acquire("s1", "r1")
+    c.release("s0", "r0")
+    c.release("s1", "r1")        # LRU order now: s0, s1
+    slot_s0 = c.slot_of("s0")
+    c.acquire("s2", "r2")        # evicts s0 (oldest parked)
+    assert not c.resident("s0") and c.resident("s1")
+    assert c.slot_of("s2") == slot_s0
+    assert c.cache_stats()["evictions"] == 1
+    # revival: re-acquiring the survivor is a hit, not a compile
+    _, up = c.acquire("s1", "r3")
+    assert not up
+    assert c.census_ok()
+
+
+def test_gcache_pin_survives_eviction_pressure():
+    _, c = _gcache(n_slots=3)
+    c.acquire("s0", "live")          # pinned throughout
+    for i, name in enumerate(("s1", "s2", "s3", "s4")):
+        c.acquire(name, f"r{i}")
+        c.release(name, f"r{i}")
+    assert c.resident("s0")
+    assert c.cache_stats()["evictions"] == 3
+    assert c.census_ok()
+
+
+def test_gcache_budget_refusal_mutates_nothing():
+    _, c = _gcache(n_slots=3)
+    c.acquire("s0", "r0")
+    c.acquire("s1", "r1")
+    before = c.cache_stats()
+    with pytest.raises(MemoryError, match="pinned"):
+        c.acquire("s2", "r2")
+    after = c.cache_stats()
+    assert after["refusals"] == before["refusals"] + 1
+    for k in ("resident_slots", "evictable_slots", "free_slots",
+              "compiles"):
+        assert after[k] == before[k]
+    assert c.census_ok()
+    c.release("s0", "r0")
+    _, up = c.acquire("s2", "r2")    # now evicts s0
+    assert up and c.census_ok()
+
+
+def test_gcache_acquire_exception_safe():
+    """A raising compile (a DFA bigger than the bank's max_states)
+    must not leak the slot out of the census: free list / evictable
+    LRU / stats restore exactly, the error stays loud, and the cache
+    keeps serving."""
+    store = GrammarStore({"small": {"type": "boolean"},
+                          "small2": {"type": "null"},
+                          "big": {"type": "string", "minLength": 1,
+                                  "maxLength": 40}})
+    sim = make_sim_serving(grammar_slots=3, grammar_states=12,
+                           vocab=SIMVOCAB)
+    c = GrammarCache(store, 3, 12,
+                     TokenVocab.ascii_default(SIMVOCAB),
+                     sim.init_grammar_bank, sim.upload_grammar)
+    # free-list path
+    before = c.cache_stats()
+    with pytest.raises(ValueError, match="max_states"):
+        c.acquire("big", "r0")
+    assert c.cache_stats() == before and c.census_ok()
+    # eviction path: fill both slots, park them, then fail an acquire
+    c.acquire("small", "r1")
+    c.acquire("small2", "r2")
+    c.release("small", "r1")
+    c.release("small2", "r2")
+    before = c.cache_stats()
+    with pytest.raises(ValueError, match="max_states"):
+        c.acquire("big", "r3")
+    assert c.cache_stats() == before and c.census_ok()
+    # the would-be victim survived
+    assert c.resident("small")
+    _, up = c.acquire("small", "r4")
+    assert not up
+
+
+def test_gcache_rollback_and_took_compile():
+    """A page-pool refusal AFTER acquire rolls the pin back; the
+    compile the failed admission paid is attributed to the admission
+    that eventually succeeds (one priced grammar_compile total)."""
+    _, c = _gcache(n_slots=3)
+    _, up = c.acquire("s0", "r0")
+    assert up
+    c.note_rollback("s0", "r0", up)
+    assert c.census_ok()
+    _, up2 = c.acquire("s0", "r0")       # the retry hits
+    assert not up2
+    assert c.took_compile("r0", up2)     # ...but owns the compile
+    assert not c.took_compile("r0", False)  # consumed exactly once
+    c.forget_pending("r0")               # idempotent on empty
+
+
+def test_gcache_validation():
+    store, c = _gcache()
+    with pytest.raises(KeyError, match="unknown grammar"):
+        c.acquire("nope", "r")
+    c.acquire("s0", "r")
+    with pytest.raises(ValueError, match="already pinned"):
+        c.acquire("s0", "r")
+    with pytest.raises(ValueError, match="no pin"):
+        c.release("s0", "other")
+    with pytest.raises(ValueError, match="n_slots"):
+        GrammarCache(store, 1, 8, VO, lambda: None,
+                     lambda b, s, g: b)
+    with pytest.raises(ValueError, match="max_states"):
+        GrammarCache(store, 3, 1, VO, lambda: None,
+                     lambda b, s, g: b)
+    with pytest.raises(ValueError, match="already registered"):
+        store.add("s0", {"type": "boolean"})
+    with pytest.raises(ValueError, match="non-empty"):
+        GrammarStore({"": {"type": "boolean"}})
+    with pytest.raises(ValueError, match="schema dict or EBNF"):
+        GrammarStore({"bad": 42})
+
+
+# --- sim engine: constrained decoding ---------------------------------------
+
+def test_sim_constrained_streams_match_oracle_and_parse():
+    """Engine streams are bit-equal to the closed-form sim oracle
+    (masked emission + state advance + stop-at-accept) and every
+    constrained stream detokenizes to schema-valid JSON."""
+    store = _store(4)
+    trace = _trace(seed=0, n=40)
+    sim = make_sim_serving(max_len=96, page_size=8, slots=8,
+                           vocab=SIMVOCAB, grammar_slots=5)
+    eng = ServingEngine(serving=sim, slots=8, policy="paged",
+                        clock="fixed", fixed_costs=dict(COSTS),
+                        decode_chunk=4, grammar=store)
+    res = eng.run(trace)
+    assert len(res.outputs) == len(trace)
+    assert res.grammar_stats["invariant_ok"]
+    assert res.grammar_stats["compiles"] == 4
+    schemas = _schemas(4)
+    for r in trace:
+        if r.schema is None:
+            continue
+        g = compile_schema(schemas[r.schema], VO)
+        assert res.outputs[r.rid] == sim.expected_stream(
+            r.prompt, r.max_new_tokens, grammar=g), r.rid
+        assert schema_accepts(schemas[r.schema],
+                              VO.decode(res.outputs[r.rid])), r.rid
+        assert len(res.outputs[r.rid]) < r.max_new_tokens  # accepted
+    rep = res.report()
+    assert rep["constrained_streams"] == sum(
+        1 for r in trace if r.schema is not None)
+    assert rep["grammar_accepts"] == rep["constrained_streams"]
+    assert 0.0 < rep["tokens_masked_frac"] <= 1.0
+
+
+def test_sim_mixed_wave_free_row_parity():
+    """Free rows riding the same batches as constrained rows are
+    token-identical to a grammar=None engine — the mask never leaks
+    across rows."""
+    store = _store(4)
+    trace = _trace(seed=2, n=50, free_frac=0.4)
+    res = _sim_engine(grammar_slots=5, grammar=store).run(trace)
+    free = [dataclasses.replace(r, schema=None) for r in trace
+            if r.schema is None]
+    plain = _sim_engine().run(free)
+    assert free, "trace must carry free rows"
+    for r in free:
+        assert res.outputs[r.rid] == plain.outputs[r.rid], r.rid
+
+
+def test_grammarless_engine_byte_identical():
+    """The tentpole identity clause: grammar=None on a schema-less
+    trace is byte-identical to PR 17 — and an engine WITH a grammar
+    store still produces identical outputs/logs on that same trace
+    (every row decodes through the all-allow identity)."""
+    trace = synthesize_trace(seed=5, n_requests=12, vocab_size=SIMVOCAB,
+                             prompt_len=(4, 12), output_len=(3, 8),
+                             churn_frac=0.2)
+    plain = _sim_engine().run(trace)
+    assert plain.grammar_stats is None      # result shape unchanged
+    rep = plain.report()
+    assert not any(k.startswith("grammar") or k.startswith("constrained")
+                   for k in rep)
+    cons = _sim_engine(grammar_slots=3, grammar=_store()).run(trace)
+    assert cons.outputs == plain.outputs
+    assert cons.slot_log == plain.slot_log
+    assert cons.decisions == plain.decisions
+    assert cons.metrics.request_rows() == plain.metrics.request_rows()
+    # no schema ever admitted -> the report block stays absent even
+    # on the configured engine (the streams>0 convention)
+    assert cons.report() == rep
+    assert cons.grammar_stats["compiles"] == 0
+
+
+def test_sim_determinism_and_bank_size_independence():
+    """Same trace twice -> identical everything; a tight bank vs a
+    roomy bank changes timing (compiles/evictions), never tokens."""
+    store = _store(4)
+    trace = _trace(seed=3, n=50)
+    r1 = _sim_engine(grammar_slots=3, grammar=store).run(trace)
+    r2 = _sim_engine(grammar_slots=3, grammar=store).run(trace)
+    assert r1.outputs == r2.outputs
+    assert r1.slot_log == r2.slot_log
+    assert r1.grammar_stats == r2.grammar_stats
+    assert r1.grammar_stats["evictions"] > 0  # the bank DID churn
+    roomy = _sim_engine(grammar_slots=6, grammar=store).run(trace)
+    assert roomy.outputs == r1.outputs
+    assert roomy.grammar_stats["evictions"] == 0
+
+
+def test_engine_save_log_no_grammar_fields(tmp_path):
+    trace = synthesize_trace(seed=1, n_requests=6, vocab_size=SIMVOCAB)
+    res = _sim_engine().run(trace)
+    p = tmp_path / "log.jsonl"
+    res.save_log(str(p))
+    body = open(p).read()
+    assert "grammar" not in body and "schema" not in body
+
+
+def test_engine_validation():
+    store = _store(2)
+    trace = [Request(rid="q", arrival=0.0, prompt=(1, 2, 3),
+                     max_new_tokens=4, schema="s0")]
+    with pytest.raises(ValueError, match="without grammar="):
+        _sim_engine(grammar_slots=3).run(trace)
+    bad = [dataclasses.replace(trace[0], schema="zz")]
+    with pytest.raises(ValueError, match="unknown schema"):
+        _sim_engine(grammar_slots=3, grammar=store).run(bad)
+    # grammar= without a grammar-enabled factory refuses at build
+    with pytest.raises(ValueError, match="grammar-enabled"):
+        _sim_engine(grammar=store)
+    # dense policy refuses; routed coerces to paged
+    with pytest.raises(ValueError, match="dense"):
+        ServingEngine(serving=make_sim_serving(grammar_slots=3,
+                                               vocab=SIMVOCAB),
+                      slots=4, policy="dense", grammar=store,
+                      clock="fixed")
+    eng = ServingEngine(serving=make_sim_serving(grammar_slots=3,
+                                                 vocab=SIMVOCAB),
+                        slots=4, policy="routed", grammar=store,
+                        clock="fixed")
+    assert eng.policy.name == "paged"
+    # a dispatched-ahead batch would mask with a stale DFA state
+    with pytest.raises(ValueError, match="dispatch_ahead"):
+        _sim_engine(grammar_slots=3, grammar=store,
+                    dispatch_ahead=True)
+
+
+def test_compile_paced_on_fixed_clock():
+    """Each miss charges one grammar_compile unit; hits are free. Two
+    same-schema requests arriving apart: the second's end-to-end span
+    is exactly one unit shorter for identical work."""
+    from paddle_tpu.inference import BatchingConfig
+    store = GrammarStore({"only": {"enum": ["lo"]}})
+    trace = [Request(rid="u0", arrival=0.0, prompt=(1, 2, 3, 4),
+                     max_new_tokens=8, schema="only"),
+             Request(rid="u1", arrival=50.0, prompt=(5, 6, 7, 8),
+                     max_new_tokens=8, schema="only")]
+    res = _sim_engine(grammar_slots=3, grammar=store,
+                      admission=BatchingConfig(max_batch=1)).run(trace)
+    rep = res.report()
+    assert rep["constrained_streams"] == 2
+    assert rep["grammar_compiles"] == 1
+    assert rep["grammar_cache_hits"] == 1
+    assert rep["grammar_cache_hit_rate"] == 0.5
+    rows = {r["rid"]: r for r in res.metrics.request_rows()}
+    assert rows["u0"]["e2e"] == pytest.approx(rows["u1"]["e2e"] + 1.0)
+    # a single-value enum pins the whole stream: both decode '"lo"'
+    for rid in ("u0", "u1"):
+        assert VO.decode(res.outputs[rid]) == '"lo"'
+
+
+def test_refusal_requeues_until_release():
+    """More distinct in-flight schemas than usable slots: admission
+    refuses, requeues, and completes everyone once pins release —
+    nothing lost, census conserved, every stream still parses."""
+    schemas = _schemas(4)
+    store = GrammarStore(schemas)
+    trace = [Request(rid=f"p{k}", arrival=0.0,
+                     prompt=tuple(range(1, 5)), max_new_tokens=24,
+                     schema=f"s{k}") for k in range(4)]
+    res = _sim_engine(grammar_slots=3, grammar=store).run(trace)
+    assert len(res.outputs) == 4
+    assert res.grammar_stats["refusals"] > 0
+    assert res.grammar_stats["invariant_ok"]
+    for r in trace:
+        assert schema_accepts(schemas[r.schema],
+                              VO.decode(res.outputs[r.rid])), r.rid
+
+
+def test_qos_degrade_never_breaks_json_and_publish_gauges():
+    """The QoS loop threads grammar: the degrade floor keeps every
+    clamped constrained budget at >= the automaton's shortest accept,
+    so degraded streams still parse; publish() exports the
+    constrained gauges only for constrained runs."""
+    obs_metrics.REGISTRY.reset()
+    schemas = _schemas(4)
+    store = GrammarStore(schemas)
+    trace = _trace(seed=4, n=60, overload=2.0)
+    res = _sim_engine(grammar_slots=5, grammar=store,
+                      scheduler=QoSScheduler(max_queue=16)).run(trace)
+    assert res.grammar_stats["invariant_ok"]
+    for r in trace:
+        if r.schema is None or r.rid not in res.outputs:
+            continue
+        assert schema_accepts(schemas[r.schema],
+                              VO.decode(res.outputs[r.rid])), r.rid
+    rec = res.metrics.publish()
+    assert rec["constrained_streams"] > 0
+    g = obs_metrics.REGISTRY.gauge("serving_constrained_streams")
+    assert g.value > 0
+    # free-running publish never touches the constrained gauges
+    pres = _sim_engine().run(
+        synthesize_trace(seed=0, n_requests=4, vocab_size=SIMVOCAB))
+    rec2 = pres.metrics.publish()
+    assert not any(k.startswith("grammar") or k.startswith("constrained")
+                   for k in rec2)
+
+
+def test_grammar_floor_probe():
+    """The scheduler floor seam: ``_grammar_floor`` is the compiled
+    automaton's min_tokens for schema rows, None for free rows."""
+    store = GrammarStore({"long": "root ::= [a-z]{8,30}"})
+    eng = _sim_engine(grammar_slots=3, grammar=store)
+    r = Request(rid="a", arrival=0.0, prompt=(1,), max_new_tokens=30,
+                schema="long")
+    assert eng._grammar_floor(r) == 8
+    assert eng._grammar_floor(
+        dataclasses.replace(r, schema=None)) is None
+
+
+def test_adapter_schemas_defaults_compose_with_lora():
+    """``adapter_schemas=`` gives an adapter a default output
+    contract: its rows decode constrained with no per-request schema,
+    an explicit Request.schema overrides, and the stream matches the
+    lora+grammar oracle."""
+    schemas = _schemas(2)
+    store = GrammarStore(schemas)
+    astore = AdapterStore({"bot": {"salt": 7919}})
+    sim = make_sim_serving(max_len=96, page_size=8, slots=8,
+                           vocab=SIMVOCAB, grammar_slots=3,
+                           lora_slots=3)
+    eng = ServingEngine(serving=sim, slots=8, policy="paged",
+                        clock="fixed", fixed_costs=dict(COSTS),
+                        decode_chunk=4, grammar=store,
+                        adapters=astore,
+                        adapter_schemas={"bot": "s0"})
+    trace = [Request(rid="d0", arrival=0.0, prompt=(1, 2, 3, 4),
+                     max_new_tokens=24, adapter="bot"),
+             Request(rid="d1", arrival=0.0, prompt=(5, 6, 7, 8),
+                     max_new_tokens=24, adapter="bot", schema="s1"),
+             Request(rid="d2", arrival=0.0, prompt=(9, 10, 11),
+                     max_new_tokens=6)]
+    res = eng.run(trace)
+    g0 = compile_schema(schemas["s0"], VO)
+    g1 = compile_schema(schemas["s1"], VO)
+    assert res.outputs["d0"] == sim.expected_stream(
+        (1, 2, 3, 4), 24, adapter_salt=7919, grammar=g0)
+    assert schema_accepts(schemas["s0"], VO.decode(res.outputs["d0"]))
+    assert res.outputs["d1"] == sim.expected_stream(
+        (5, 6, 7, 8), 24, adapter_salt=7919, grammar=g1)
+    # the plain row stays free-running
+    assert res.outputs["d2"] == sim.expected_stream((9, 10, 11), 6)
+    assert res.report()["constrained_streams"] == 2
+    # validation: every name must resolve at build
+    with pytest.raises(ValueError, match="grammar="):
+        ServingEngine(serving=sim, slots=8, policy="paged",
+                      adapters=astore, adapter_schemas={"bot": "s0"})
+    with pytest.raises(ValueError, match="without adapters="):
+        ServingEngine(serving=sim, slots=8, policy="paged",
+                      grammar=store, adapter_schemas={"bot": "s0"})
+    with pytest.raises(ValueError, match="unknown adapter"):
+        ServingEngine(serving=sim, slots=8, policy="paged",
+                      grammar=store, adapters=astore,
+                      adapter_schemas={"zz": "s0"})
+    with pytest.raises(ValueError, match="unknown"):
+        ServingEngine(serving=sim, slots=8, policy="paged",
+                      grammar=store, adapters=astore,
+                      adapter_schemas={"bot": "zz"})
+
+
+# --- disaggregation + preemption --------------------------------------------
+
+def test_disagg_handoff_moves_grammar_pin():
+    """Grammar composes with disaggregated prefill->decode handoffs:
+    the prefill worker masks the first token and unpins at export,
+    the decode worker re-pins (compiling on first sight) and re-walks
+    the DFA, streams stay bit-equal to a lone constrained engine, and
+    both stages' slot censuses balance."""
+    schemas = _schemas(2)
+    store = GrammarStore(schemas)
+    trace = [Request(rid=f"h{k}", arrival=float(k),
+                     prompt=tuple(range(1 + k, 7 + k)),
+                     max_new_tokens=24, schema=f"s{k % 2}")
+             for k in range(8)]
+
+    def spawn(name):
+        return _sim_engine(grammar_slots=3, grammar=store,
+                           prefill_chunk_budget=2)
+    res = ClusterRouter(spawn, 2, placement="disaggregated",
+                        roles={"r0": "prefill", "r1": "decode"},
+                        kv_transfer_unit=0.05).run(trace)
+    cen = res.census()
+    assert cen["conserved"] and cen["pool_census_ok"]
+    lone = _sim_engine(grammar_slots=3, grammar=store).run(trace)
+    assert res.outputs() == lone.outputs
+    for r in trace:
+        assert schema_accepts(schemas[r.schema],
+                              VO.decode(lone.outputs[r.rid]))
+    for name in ("r0", "r1"):
+        gst = res.results[name].grammar_stats
+        assert gst["invariant_ok"]
+        assert gst["compiles"] == 2       # each stage saw both once
+        assert gst["resident_slots"] == 0  # every pin released
+    # a decode stage WITHOUT the store cannot honor the contract
+
+    def spawn_half(name):
+        return _sim_engine(grammar_slots=3,
+                           grammar=store if name == "r0" else None,
+                           prefill_chunk_budget=2)
+    with pytest.raises(RuntimeError, match="BOTH stages"):
+        ClusterRouter(spawn_half, 2, placement="disaggregated",
+                      roles={"r0": "prefill", "r1": "decode"},
+                      kv_transfer_unit=0.05).run(trace)
+
+
+def test_preempt_resume_reacquires_and_rewalks():
+    """A constrained row preempted to the host arena resumes with its
+    automaton re-acquired (a cache hit) and its DFA state re-derived
+    from the resume prefix: the final stream is token-identical to a
+    never-preempted run and still terminates at accept."""
+    store = GrammarStore({"long": "root ::= [a-z]{24,30}"})
+    costs = dict(COSTS, kv_pageout=0.5, kv_pagein=0.5)
+
+    def build(hostmem):
+        sim = make_sim_serving(max_len=96, page_size=8, slots=1,
+                               vocab=SIMVOCAB, grammar_slots=3,
+                               n_pool_pages=24, chunked_prefill=8)
+        eng = ServingEngine(serving=sim, slots=1, policy="paged",
+                            clock="fixed", fixed_costs=costs,
+                            scheduler=QoSScheduler(), grammar=store,
+                            hostmem=hostmem)
+        return sim, eng
+    trace = [Request(rid="lo", prompt=tuple(range(10, 26)),
+                     max_new_tokens=30, arrival=0.0, tenant="t0",
+                     priority=0, schema="long"),
+             Request(rid="hi", prompt=tuple(range(40, 56)),
+                     max_new_tokens=8, arrival=20.0, tenant="t1",
+                     priority=9)]
+    sim, eng = build(1 << 20)
+    res = eng.run(trace)
+    assert res.hostmem_stats["preempts"] >= 1
+    assert "lo" in res.hostmem_stats["preempted_rids"]
+    g = compile_grammar("root ::= [a-z]{24,30}", VO)
+    assert res.outputs["lo"] == sim.expected_stream(
+        tuple(range(10, 26)), 30, grammar=g)
+    assert len(res.outputs["lo"]) == 24       # stopped at accept
+    assert res.grammar_stats["hits"] >= 1     # resume re-pinned warm
+    assert res.grammar_stats["invariant_ok"]
+    # without the arena the same contention just queues "hi" — and
+    # the constrained stream is identical either way
+    res_n = build(None)[1].run(trace)
+    assert res_n.outputs == res.outputs
+
+
+# --- real tiny-llama factory -------------------------------------------------
+
+@pytest.fixture(scope="module")
+def grammar_model():
+    from paddle_tpu.models.nlp import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4,
+                           kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _real_factory(model, grammar=None, **kw):
+    from paddle_tpu.models.nlp.llama_decode import (
+        llama_serving_decode_factory)
+    return llama_serving_decode_factory(
+        model, max_len=48, page_size=8, n_pool_pages=25,
+        batch_capacity=4, chunked_prefill=8, grammar=grammar, **kw)
+
+
+@pytest.fixture(scope="module")
+def real_env(grammar_model):
+    model, cfg = grammar_model
+    gc = GrammarConfig(n_slots=3, max_states=64)
+    return {"model": model, "cfg": cfg, "gc": gc,
+            "store": GrammarStore(_schemas(3)),
+            "srv": _real_factory(model, grammar=gc),
+            "srv_plain": _real_factory(model)}
+
+
+def _real_trace(seed=1, n=6, n_schemas=3):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(4, 9))
+        prompt = tuple(int(t) for t in rng.integers(1, VOCAB, plen))
+        schema = None if i % 3 == 2 else f"s{i % n_schemas}"
+        reqs.append(Request(rid=f"R{i:02d}", arrival=float(i),
+                            prompt=prompt, max_new_tokens=20,
+                            schema=schema))
+    return reqs
+
+
+def test_real_constrained_streams_parse(real_env):
+    """The acceptance claim on the real factory: every constrained
+    stream detokenizes to JSON its schema validates and stops at the
+    automaton's accept; free rows in the same batches are bit-equal
+    to the plain (no-grammar) factory."""
+    vocab = TokenVocab.ascii_default(VOCAB)
+    schemas = _schemas(3)
+    trace = _real_trace()
+    eng = ServingEngine(serving=real_env["srv"], slots=4,
+                        policy="paged", clock="fixed",
+                        grammar=real_env["store"])
+    res = eng.run(trace)
+    assert res.grammar_stats["invariant_ok"]
+    n_con = 0
+    for r in trace:
+        if r.schema is None:
+            continue
+        n_con += 1
+        text = vocab.decode(res.outputs[r.rid])
+        assert schema_accepts(schemas[r.schema], text), (r.rid, text)
+        assert len(res.outputs[r.rid]) < r.max_new_tokens
+    assert n_con > 0
+    plain = ServingEngine(serving=real_env["srv_plain"], slots=4,
+                          policy="paged", clock="fixed")
+    pres = plain.run([dataclasses.replace(r, schema=None)
+                      for r in trace if r.schema is None])
+    for r in trace:
+        if r.schema is None:
+            assert res.outputs[r.rid] == pres.outputs[r.rid], r.rid
+
+
+def test_real_decode_program_cache_flat_across_schema_churn(real_env):
+    """The recompile acceptance claim: the decode program cache stays
+    flat as schemas churn (bank + flat state ids are jit inputs; the
+    only extra entry is the n=1 clamp constrained turns decode at)."""
+    trace = _real_trace(seed=2, n=9)
+    eng = ServingEngine(serving=real_env["srv"], slots=4,
+                        policy="paged", clock="fixed",
+                        grammar=real_env["store"])
+    eng.run(trace)
+    assert eng._p_decode_n._cache_size() <= 2
+
+
+def test_real_grammarless_identity(real_env):
+    """schema=None rows through the all-allow identity are bit-equal
+    to the PLAIN (no-grammar) factory — outputs, slot logs,
+    decisions, records."""
+    trace = [dataclasses.replace(r, schema=None)
+             for r in _real_trace(seed=3, n=6)]
+    plain = ServingEngine(serving=real_env["srv_plain"], slots=4,
+                          policy="paged", clock="fixed").run(trace)
+    cons = ServingEngine(serving=_real_factory(real_env["model"],
+                                               grammar=real_env["gc"]),
+                         slots=4, policy="paged", clock="fixed",
+                         grammar=real_env["store"]).run(trace)
+    assert cons.outputs == plain.outputs
+    assert cons.slot_log == plain.slot_log
+    assert cons.decisions == plain.decisions
+    assert cons.metrics.request_rows() == plain.metrics.request_rows()
+    assert plain.grammar_stats is None
+
+
+def test_real_grammar_composes_with_tp(real_env):
+    """A mesh-sharded factory with a replicated mask bank produces
+    bit-equal constrained streams to the unsharded engine (the mask
+    AND reshards into the row-parallel logits layout under GSPMD)."""
+    from paddle_tpu.models.nlp.llama_decode import TPConfig
+    trace = _real_trace(seed=5, n=4)
+    srv_tp = _real_factory(real_env["model"], grammar=real_env["gc"],
+                           tp=TPConfig((2,)))
+    r1 = ServingEngine(serving=real_env["srv"], slots=4,
+                       policy="paged", clock="fixed",
+                       grammar=real_env["store"]).run(trace)
+    r2 = ServingEngine(serving=srv_tp, slots=4, policy="paged",
+                       clock="fixed",
+                       grammar=real_env["store"]).run(trace)
+    assert r2.outputs == r1.outputs
+    assert r2.grammar_stats["invariant_ok"]
+
+
+def test_grammar_config_and_hooks_validation(real_env):
+    assert as_grammar_config(None) is None
+    assert as_grammar_config((4, 32)) == GrammarConfig(n_slots=4,
+                                                       max_states=32)
+    assert as_grammar_config(GrammarConfig(3, 16)).n_slots == 3
+    with pytest.raises(ValueError, match="n_slots"):
+        GrammarConfig(n_slots=1)
+    with pytest.raises(ValueError, match="max_states"):
+        GrammarConfig(max_states=1)
+    with pytest.raises(ValueError, match="grammar"):
+        as_grammar_config("tight")
+    # bank-hook shape validation at upload
+    init, upload = grammar_bank_hooks(VOCAB, GrammarConfig(3, 12))
+    bank = init()
+    small = compile_schema({"type": "boolean"},
+                           TokenVocab.ascii_default(VOCAB))
+    bank = upload(bank, 1, small)
+    big = compile_schema({"type": "string", "minLength": 1,
+                          "maxLength": 40},
+                         TokenVocab.ascii_default(VOCAB))
+    with pytest.raises(ValueError, match="states"):
+        upload(bank, 1, big)
+    # engine-level grammar_config conflict with a prebuilt factory
+    with pytest.raises(ValueError, match="conflicts"):
+        ServingEngine(serving=real_env["srv"], slots=4,
+                      policy="paged",
+                      grammar_config=GrammarConfig(5, 64),
+                      grammar=real_env["store"])
+
+
+# --- trace report ------------------------------------------------------------
+
+def test_trace_report_grammar_rows(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from trace_report import (grammar_schemas, grammar_summary,
+                              load_trace as _load)
+    store = _store(3)
+    trace = _trace(seed=6, n=20, n_schemas=3)
+    p = tmp_path / "tr.json"
+    res = _sim_engine(grammar_slots=4, grammar=store,
+                      trace=str(p)).run(trace)
+    events = _load(str(p))
+    row = grammar_summary(events)
+    assert row is not None and row["bench"] == "trace_report_grammar"
+    assert row["constrained_requests"] == sum(
+        1 for r in trace if r.schema is not None)
+    assert row["compiles"] == res.grammar_stats["compiles"]
+    assert row["grammar_accepts"] == res.report()["grammar_accepts"]
+    assert set(row["by_schema"]) <= {"s0", "s1", "s2"}
+    sch = grammar_schemas(events)
+    assert sch == {r.rid: r.schema for r in trace
+                   if r.schema is not None}
+    # absence: a free-running trace yields no row at all
+    p2 = tmp_path / "tr2.json"
+    _sim_engine(trace=str(p2)).run(
+        synthesize_trace(seed=0, n_requests=4, vocab_size=SIMVOCAB))
+    ev2 = _load(str(p2))
+    assert grammar_summary(ev2) is None and grammar_schemas(ev2) == {}
+
+
+# --- gate family -------------------------------------------------------------
+
+def _gate_rows(ratio=1.0, parse=1.0, parity=True, census=True,
+               compared=100, checked=500, programs=(1, 1),
+               drop_arm=None):
+    def arm(name):
+        row = {"bench": "serving_grammar", "arm": name,
+               "device": "sim", "conserved": True,
+               "pool_census_ok": True}
+        if name == "constrained":
+            row["grammar_census_ok"] = census
+        return row
+    rows = [arm("constrained"), arm("free"),
+            {"bench": "serving_grammar_summary",
+             "constrained_vs_free_goodput": ratio,
+             "constrained_parse_frac": parse,
+             "constrained_checked": checked,
+             "free_parity_ok": parity,
+             "free_parity_compared": compared,
+             "decode_programs_constrained": programs[0],
+             "decode_programs_free": programs[1],
+             "grammar_census_ok": census,
+             "schemas": 4, "requests": 1000,
+             "grammar_compiles": 4, "tokens_masked_frac": 0.99}]
+    if drop_arm:
+        rows = [r for r in rows if r.get("arm") != drop_arm]
+    return rows
+
+
+def test_gate_serving_grammar_pass_and_fails(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from bench_gate import check_serving_grammar
+
+    assert check_serving_grammar(_gate_rows()) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["gate"] == "pass"
+    assert out["constrained_vs_free_goodput"] == 1.0
+
+    for rows, frag in (
+            (_gate_rows(ratio=0.8), "floor"),
+            (_gate_rows(parse=0.97), "parse"),
+            (_gate_rows(checked=0), "parse"),
+            (_gate_rows(parity=False), "DIVERGED"),
+            (_gate_rows(compared=0), "DIVERGED"),
+            (_gate_rows(programs=(3, 1)), "decode programs"),
+            (_gate_rows(census=False), "census"),
+            (_gate_rows(drop_arm="free"), "BOTH"),
+            ([r for r in _gate_rows()
+              if r["bench"] != "serving_grammar_summary"],
+             "UNVERIFIED")):
+        assert check_serving_grammar(rows) == 1
+        out = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["gate"] == "FAIL"
+        assert frag in out["reason"]
+
+
+@pytest.mark.slow
+def test_grammar_bench_arm_end_to_end(capsys):
+    """The --grammar arm at reduced size: rows parse, the gate
+    passes."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import serving_workload_bench as swb
+    from bench_gate import check_serving_grammar
+    rc = swb.main(["--cpu", "--grammar", "--grammar-requests", "400"])
+    assert rc == 0
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    arms = {r.get("arm") for r in rows
+            if r.get("bench") == "serving_grammar"}
+    assert arms == {"constrained", "free"}
+    assert check_serving_grammar(rows) == 0

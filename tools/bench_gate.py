@@ -124,6 +124,15 @@ cannot silently ship a slower build. Three modes:
       #    engine's, pool AND arena censuses must hold on every
       #    armed arm, and the hostmem=None arm must stay
       #    byte-identical with no hostmem keys.
+      #  - serving_grammar (tools/serving_workload_bench.py
+      #    --grammar): on the seeded Zipf-schema trace every
+      #    completed constrained stream must detokenize to JSON its
+      #    schema validates (parse_frac == 1.0), free rows must stay
+      #    byte-identical to the unconstrained baseline on the
+      #    common length, constrained goodput must reach >= 0.95x
+      #    the budget-matched unconstrained run, the decode
+      #    program-cache must stay flat in schema count, and the
+      #    grammar cache's resident+evictable+free census must hold.
 
 The training gate compares the LEGACY row when present (fixed MHA
 config — stable across rounds) and falls back to the headline value; a
@@ -1128,6 +1137,131 @@ def check_serving_lora(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+GRAMMAR_GOODPUT_FLOOR = 0.95  # constrained vs unconstrained goodput
+
+
+def check_serving_grammar(rows: list) -> int:
+    """Gate the constrained-decoding rows from
+    serving_workload_bench.py --grammar: on the seeded Zipf-schema
+    trace every COMPLETED constrained stream must detokenize to JSON
+    its schema validates (parse_frac == 1.0 — no partial credit),
+    the free rows of the constrained run must be byte-identical to
+    the unconstrained baseline on the common stream length (the mask
+    never leaks across rows of the shared batch), constrained
+    goodput must stay >= GRAMMAR_GOODPUT_FLOOR x the budget-matched
+    unconstrained run (the mask is jit data; only the per-schema
+    grammar_compile units are priced), the distinct-static-decode-
+    length program count must stay flat vs the free arm (schemas are
+    data, not programs), and the census must hold on both arms:
+    requests conserved, pool pages balanced, and the grammar cache's
+    resident+evictable+free slot invariant sampled every turn. The
+    free baseline is re-measured in the same run — no stamped file.
+    A missing-JSON input is the caller's no-JSON FAIL: the claim was
+    not checked."""
+    gr = [r for r in rows if r.get("bench") == "serving_grammar"]
+    by = {r.get("arm"): r for r in gr}
+    if "constrained" not in by or "free" not in by:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_grammar rows need BOTH "
+                                    "a constrained and a free arm "
+                                    "(run tools/"
+                                    "serving_workload_bench.py "
+                                    "--grammar)"}))
+        return 1
+    for r in gr:
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True \
+                or (r.get("arm") == "constrained"
+                    and r.get("grammar_census_ok") is not True):
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "grammar census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')} "
+                          "grammar_census_ok="
+                          f"{r.get('grammar_census_ok')} — a request "
+                          "was lost/duplicated, pool pages leaked, or "
+                          "a grammar slot escaped the "
+                          "resident+evictable+free census"}))
+            return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_grammar_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_grammar_summary row "
+                                    "— the parse/parity/goodput "
+                                    "claims are UNVERIFIED (rerun "
+                                    "the --grammar arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    pf = s.get("constrained_parse_frac")
+    if pf != 1.0 or not int(s.get("constrained_checked") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "a completed constrained stream "
+                                    "failed to parse/validate "
+                                    "against its schema (the "
+                                    "allow-mask admitted a token the "
+                                    "DFA forbids), or nothing was "
+                                    "checked",
+                          "constrained_parse_frac": pf,
+                          "constrained_checked":
+                          s.get("constrained_checked")}))
+        return 1
+    if s.get("free_parity_ok") is not True \
+            or not int(s.get("free_parity_compared") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "free rows DIVERGED from the "
+                                    "unconstrained baseline (the "
+                                    "grammar mask leaked into "
+                                    "all-allow rows of the shared "
+                                    "batch), or nothing was compared",
+                          "free_parity_compared":
+                          s.get("free_parity_compared")}))
+        return 1
+    if int(s.get("decode_programs_constrained") or 0) > \
+            int(s.get("decode_programs_free") or 0) + 1:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "constrained arm compiled more "
+                                    "decode programs than "
+                                    "free-arm + 1 — schemas are "
+                                    "leaking into static jit keys "
+                                    "instead of riding the mask "
+                                    "bank as data",
+                          "decode_programs_constrained":
+                          s.get("decode_programs_constrained"),
+                          "decode_programs_free":
+                          s.get("decode_programs_free")}))
+        return 1
+    if s.get("grammar_census_ok") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "grammar-cache census broken in "
+                                    "the summary — a pin leaked or a "
+                                    "slot was double-counted"}))
+        return 1
+    ratio = s.get("constrained_vs_free_goodput")
+    rec = {
+        "gate": "pass",
+        "constrained_vs_free_goodput": ratio,
+        "goodput_floor": GRAMMAR_GOODPUT_FLOOR,
+        "schemas": s.get("schemas"), "requests": s.get("requests"),
+        "constrained_parse_frac": pf,
+        "constrained_checked": s.get("constrained_checked"),
+        "free_parity_compared": s.get("free_parity_compared"),
+        "grammar_compiles": s.get("grammar_compiles"),
+        "tokens_masked_frac": s.get("tokens_masked_frac"),
+        "device": by["constrained"].get("device", "?"),
+    }
+    if ratio is None or float(ratio) < GRAMMAR_GOODPUT_FLOOR:
+        rec["gate"] = "FAIL"
+        rec["reason"] = (f"constrained goodput only {ratio}x the "
+                         f"budget-matched unconstrained run (floor "
+                         f"{GRAMMAR_GOODPUT_FLOOR}) — the mask "
+                         "machinery is costing decode throughput "
+                         "beyond the priced per-schema compiles")
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 SPEC_TPS_FLOOR = 1.0  # adaptive-spec vs plain decode tokens/sec
 
 
@@ -1847,9 +1981,12 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     cluster/drain-join request-conservation census, a lost/duplicated
     /diverging request across a crash, sub-floor goodput under
     faults, a sub-floor multiplexed-vs-split lora goodput ratio /
-    adapter-parity break (--lora), or a spec route that is slower
-    than plain / breaks greedy parity / never flips under overload
-    (--spec) — so the serving claims can only change deliberately."""
+    adapter-parity break (--lora), a constrained stream whose text
+    fails its schema / a grammar mask leaking into free rows / a
+    sub-floor constrained-vs-free goodput ratio (--grammar), or a
+    spec route that is slower than plain / breaks greedy parity /
+    never flips under overload (--spec) — so the serving claims can
+    only change deliberately."""
     fam_rcs: dict = {}
     if any(r.get("bench", "").startswith("serving_workload")
            for r in rows):
@@ -1879,6 +2016,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_lora")
            for r in rows):
         fam_rcs["lora"] = check_serving_lora(rows)
+    if any(r.get("bench", "").startswith("serving_grammar")
+           for r in rows):
+        fam_rcs["grammar"] = check_serving_grammar(rows)
     if any(r.get("bench", "").startswith("serving_spec")
            for r in rows):
         fam_rcs["spec"] = check_serving_spec(rows)

@@ -175,6 +175,18 @@ QUEUE = [
     ("serving_hostmem",
      [sys.executable, "tools/serving_workload_bench.py", "--hostmem"],
      {}),
+    # PR-18 addition: the constrained-decoding arm — the Zipf-schema
+    # trace through ServingEngine(grammar=store) vs the
+    # budget-matched unconstrained baseline (per-row token-DFA
+    # allow-masks as jit data in the budgeted GrammarCache bank; one
+    # fixed-shape batch mixes schema-locked and free rows);
+    # bench_gate.py serving gates the serving_grammar family (100%
+    # schema-valid parse on completed constrained streams, free-row
+    # byte-identity, goodput >= 0.95x unconstrained, decode
+    # program-cache flat in schema count, grammar-slot census)
+    ("serving_grammar",
+     [sys.executable, "tools/serving_workload_bench.py", "--grammar"],
+     {}),
     # PR-16 addition: the ragged batched-prefill arm — mixed-churn /
     # prefill-heavy / admission-burst traces through per-chunk vs
     # ragged-lane engines (every lane row rides ONE fused fixed-shape

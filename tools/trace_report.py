@@ -278,6 +278,46 @@ def adapter_summary(events: list) -> dict | None:
             "by_adapter": dict(sorted(by_adapter.items()))}
 
 
+def grammar_schemas(events: list) -> dict:
+    """rid -> schema id from the engine's ``admit`` instants (the
+    ``schema`` arg rides the admit only for constrained rows). Empty
+    for free-running traces — the waterfall tag, the text section and
+    the summary row below are all omitted then, so pre-grammar traces
+    render byte-identically."""
+    out: dict = {}
+    for e in events:
+        if e.get("ph") != "i" or e.get("name") != "admit":
+            continue
+        a = e.get("args", {})
+        if a.get("schema") is not None and a.get("rid") is not None:
+            out[a["rid"]] = a["schema"]
+    return out
+
+
+def grammar_summary(events: list) -> dict | None:
+    """Constrained-decoding evidence: the ``trace_report_grammar``
+    row — per-schema admit counts, DFA-accept finishes
+    (``grammar_accept`` instants) and paced ``grammar_compile``
+    spans. None for free-running traces, whose report output stays
+    byte-identical to pre-grammar."""
+    schemas = grammar_schemas(events)
+    compiles = sum(1 for e in events if e.get("ph") == "X"
+                   and e.get("name") == "grammar_compile")
+    accepts = sum(1 for e in events if e.get("ph") == "i"
+                  and e.get("name") == "grammar_accept")
+    if not schemas and not compiles and not accepts:
+        return None
+    by_schema: dict = {}
+    for s in schemas.values():
+        by_schema[s] = by_schema.get(s, 0) + 1
+    return {"bench": "trace_report_grammar",
+            "schemas": len(by_schema),
+            "constrained_requests": len(schemas),
+            "grammar_accepts": accepts,
+            "compiles": compiles,
+            "by_schema": dict(sorted(by_schema.items()))}
+
+
 def spec_accepts(events: list) -> dict:
     """rid -> {"proposed": N, "accepted": N} from the engine's
     per-request ``spec`` instants (emitted at row finish ONLY when
@@ -646,6 +686,7 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
     kv_hops = handoff_hops(events)
     accepts = spec_accepts(events)
     swaps = swap_events(events)
+    gsch = grammar_schemas(events)
     lines = []
     if reqs:
         ts = [r["arrival"] for r in reqs if "arrival" in r] + \
@@ -672,6 +713,10 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
             # — pre-spec traces render byte-identically
             sp = f" accept={sa['accepted']}/{sa['proposed']}" \
                 if sa else ""
+            # schema=<id> appears only for constrained rows —
+            # free-running traces render byte-identically
+            gs = f" schema={gsch[r['rid']]}" \
+                if r["rid"] in gsch else ""
             # swap=out@t>in@t' appears only for rows the preempt
             # rung swapped to the host arena — pre-hostmem traces
             # render byte-identically
@@ -684,7 +729,7 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
             lines.append(
                 f"{r['rid'][:18]:18s} {_gantt(r, t0, span, width)} "
                 f"{out:9s} tok={r.get('n_tokens', '?'):>4}{ttft}{hit}"
-                f"{fo}{ho}{sp}{sw}")
+                f"{fo}{ho}{sp}{gs}{sw}")
     comp = recompiles(events)
     lines.append(f"\n== recompiles ({len(comp)}) ==")
     by_site: dict = {}
@@ -725,6 +770,16 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                      f"{ad['adapter_requests']} requests, "
                      f"{ad['uploads']} uploads) ==")
         for name, n in ad["by_adapter"].items():
+            lines.append(f"  {name:16s} x{n}")
+    gr = grammar_summary(events)
+    if gr is not None:
+        # only constrained-decoding traces grow this section —
+        # free-running traces render byte-identically
+        lines.append(f"\n== constrained decoding ({gr['schemas']} "
+                     f"schemas, {gr['constrained_requests']} requests"
+                     f", {gr['grammar_accepts']} accepts, "
+                     f"{gr['compiles']} compiles) ==")
+        for name, n in gr["by_schema"].items():
             lines.append(f"  {name:16s} x{n}")
     flips = spec_flips(events)
     if accepts or flips:
@@ -841,6 +896,12 @@ def main(argv=None) -> int:
             # multi-model traces only: absent otherwise, so
             # single-model --json output is byte-identical
             print(json.dumps(ad))
+        gr_row = grammar_summary(events)
+        if gr_row is not None:
+            # constrained-decoding traces only: absent otherwise, so
+            # free-running --json output is byte-identical (global
+            # row still LAST)
+            print(json.dumps(gr_row))
         sp_row = spec_summary(events)
         if sp_row is not None:
             # speculative traces only: absent otherwise, so pre-spec
