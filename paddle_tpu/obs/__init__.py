@@ -34,10 +34,25 @@ Two ACTIVE halves evaluate those streams (PR 9):
   (chrome-trace excerpt, metrics JSONL, incident JSON, offending
   rids) the moment an incident fires.
 
+And the ACCOUNTING half (PR 19):
+
+- ``obs.ledger``: the resource-attribution ledger — ``CostLedger``
+  books every priced virtual-clock unit against ``(rid | "engine",
+  kind)`` and per-turn pool occupancy against its holders, rolled up
+  request -> tenant -> feature, with exact integer conservation
+  audits (``attributed + idle == elapsed``; per-owner slot-turns ==
+  pool integral). Also the shared budgeted-cache census arithmetic
+  (``census_balanced`` / ``overlay_contained``) the four pool
+  ``census_ok()`` checks delegate to. ``ServingEngine(ledger=...)``
+  and ``ClusterRouter(cost_ledger=...)`` thread one through;
+  ``tools/cost_report.py`` renders the tables.
+
 Span taxonomy, metric names, the SLO rule grammar / burn-rate math /
 bundle layout and the Perfetto how-to live in docs/OBSERVABILITY.md.
 """
-from . import flight, metrics, slo, trace  # noqa: F401
+from . import flight, ledger, metrics, slo, trace  # noqa: F401
+from .ledger import (SCALE, CostLedger,  # noqa: F401
+                     census_balanced, load_costs, overlay_contained)
 from .flight import FlightRecorder, load_bundle  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge,  # noqa: F401
                       Histogram, MetricsRegistry, get_registry)

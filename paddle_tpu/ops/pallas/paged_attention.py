@@ -34,6 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...jax_compat import tpu_compiler_params
+from ...obs import ledger as obs_ledger
 
 # jax renamed TPUCompilerParams -> CompilerParams (version-bridged in
 # one place, jax_compat)
@@ -857,17 +858,34 @@ class PagedKVCache:
                 f"{need} needed for {n_tokens} tokens")
         return list(table[:need])
 
+    def populations(self) -> Tuple[int, int, int]:
+        """The census populations (resident, evictable, free) — the
+        counts ``census_ok`` balances against capacity and the cost
+        ledger's occupancy sampler integrates per turn."""
+        return len(self._refs), len(self._evictable), len(self._free)
+
+    def page_holders(self) -> Dict[int, List[str]]:
+        """page -> sorted holder seq_ids, from the live tables — the
+        attribution view of the resident tier (a shared prefix page
+        lists every sharer; refcounts mirror these memberships, which
+        the ledger's occupancy audit cross-checks)."""
+        holders: Dict[int, List[str]] = {}
+        for sid in sorted(self.tables):
+            for p in self.tables[sid]:
+                holders.setdefault(p, []).append(sid)
+        return holders
+
     def census_ok(self) -> bool:
         """The accounting invariant in one place: every usable page
         (page 0 is reserved padding) is exactly one of resident /
         evictable / free. The serving engine samples this each turn;
         the serving_prefix bench gate fails if it ever broke."""
-        balanced = (len(self._refs) + len(self._evictable)
-                    + len(self._free)) == int(self.k_pages.shape[1]) - 1
+        balanced = obs_ledger.census_balanced(
+            int(self.k_pages.shape[1]) - 1, *self.populations())
         # the quantized tier is an overlay, never a fourth state: every
         # quantized page must still be occupied
-        tier_ok = all(p in self._refs or p in self._evictable
-                      for p in self._quant)
+        tier_ok = obs_ledger.overlay_contained(
+            self._quant, self._refs, self._evictable)
         if self._arena is not None:
             # the host tier extends the census: spilled is a distinct
             # state (spill != leak, like retention != leak) — after

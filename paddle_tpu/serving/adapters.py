@@ -34,7 +34,9 @@ discipline a page-pool refusal gets.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import ledger as obs_ledger
 
 
 class AdapterStore:
@@ -256,13 +258,26 @@ class AdapterCache:
         ``serving_adapter_resident`` gauge's value."""
         return len(self._slot)
 
+    def populations(self) -> Tuple[int, int, int]:
+        """The census populations (pinned, evictable, free) — the
+        counts ``census_ok`` balances against capacity and the cost
+        ledger's occupancy sampler integrates per turn."""
+        pinned = sum(1 for n in self._slot if self._pins.get(n))
+        return pinned, len(self._evictable), len(self._free)
+
+    def pin_owners(self) -> Dict[str, List[str]]:
+        """adapter name -> sorted holder rids, pinned slots only —
+        the attribution view the cost ledger splits slot-turns by."""
+        return {n: sorted(self._pins[n]) for n in self._slot
+                if self._pins.get(n)}
+
     def census_ok(self) -> bool:
         """The accounting invariant, one line: every usable slot
         (slot 0 is the reserved identity) is exactly one of
-        pinned-resident / evictable / free."""
-        pinned = sum(1 for n in self._slot if self._pins.get(n))
-        return (pinned + len(self._evictable) + len(self._free)
-                == self.n_slots - 1)
+        pinned-resident / evictable / free (arithmetic shared with
+        every budgeted pool via ``obs.ledger.census_balanced``)."""
+        return obs_ledger.census_balanced(self.n_slots - 1,
+                                          *self.populations())
 
     def cache_stats(self) -> dict:
         """Adapter-cache accounting, the ``PagedKVCache.cache_stats``

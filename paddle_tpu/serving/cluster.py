@@ -61,6 +61,7 @@ import heapq
 from typing import Dict, List, Optional
 
 from ..obs import flight as obs_flight
+from ..obs import ledger as obs_ledger
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
@@ -337,6 +338,23 @@ class ClusterResult:
         default_factory=dict)           # name -> {joined, left, hours}
     # — the capacity-cost ledger elastic autoscaling is judged on
     # (replica-hours strictly below a static fleet at equal goodput)
+    cost_rollup: Optional[dict] = None  # CostLedger.rollup() — the
+    # request -> tenant -> feature attribution plus the cluster-wide
+    # conservation audit — when the router ran with cost_ledger=...;
+    # None otherwise (and nothing in the replay differs from a
+    # pre-ledger router)
+    cost_ledger: Optional[object] = None  # the shared CostLedger
+    # itself (save_costs/publish live here); None when un-armed
+
+    def save_costs(self, path: str) -> str:
+        """Dump the shared cost ledger's attribution rows as JSONL
+        (atomic; global conservation row LAST). Raises when the
+        replay ran without ``cost_ledger=`` — there is nothing to
+        save, and an empty file would read as a costless cluster."""
+        if self.cost_ledger is None:
+            raise ValueError("this replay ran without cost_ledger=; "
+                             "no cost rows to save")
+        return self.cost_ledger.save_costs(path)
 
     def replica_hours_total(self) -> float:
         """Summed live time across every replica that ever joined —
@@ -608,7 +626,8 @@ class ClusterRouter:
                  roles: Optional[Dict[str, str]] = None,
                  kv_transfer_unit: float = 0.0,
                  slo=None, flight=None, slo_on_incident=(),
-                 autoscale: Optional[Autoscaler] = None):
+                 autoscale: Optional[Autoscaler] = None,
+                 cost_ledger=None):
         if not callable(spawn):
             raise ValueError("spawn must be callable: name -> "
                              "ServingEngine (one engine+factory per "
@@ -708,6 +727,23 @@ class ClusterRouter:
             autoscale.attach()
             # subscription BEFORE the monitors copy the callback list
             self._slo_cbs.append(self._autoscale_on_incident)
+        # --- cost ledger (inert without cost_ledger=) ---------------
+        # cost_ledger: True builds ONE shared obs.ledger.CostLedger
+        # (or pass an instance) that every spawned replica's engine
+        # books against — one book per replica plus a "cluster" book
+        # for router-priced kv_transfer units. A request's account is
+        # SHARED across replicas, so handoff/failover/preempt move
+        # its open account exactly once (accounts are keyed by rid,
+        # not replica). None keeps every replay byte-identical to a
+        # pre-ledger router. (Distinct from self.ledger — the
+        # placement bookkeeping dict that predates cost accounting.)
+        if cost_ledger is True:
+            cost_ledger = obs_ledger.CostLedger()
+        if cost_ledger is not None \
+                and not isinstance(cost_ledger, obs_ledger.CostLedger):
+            raise ValueError("cost_ledger= takes True or an "
+                             "obs.ledger.CostLedger instance")
+        self._cost_ledger = cost_ledger
         self._hours: Dict[str, dict] = {}
         if flight is not None and slo is None:
             raise ValueError("flight= needs slo= (bundles are written "
@@ -743,6 +779,12 @@ class ClusterRouter:
                              "ServingEngine")
         tr = _ReplicaTracer(self._tracer, name) \
             if self._tracer is not None else None
+        if self._cost_ledger is not None:
+            # every replica books on the ONE shared ledger (accounts
+            # are rid-keyed, so a handed-off request keeps its single
+            # open account across replicas); injected before session
+            # creation so the session clock is ledger-armed from birth
+            eng._ledger = self._cost_ledger
         role = self._roles.get(name, "both")
         mon = None
         if self._slo_rules is not None:
@@ -983,6 +1025,14 @@ class ClusterRouter:
                     continue
                 h.t_arrive = h.t_ready \
                     + self.kv_transfer_unit * h.n_pages
+                if self._cost_ledger is not None:
+                    # the transfer is router-priced (no engine clock
+                    # ever times it), so it books on the router's own
+                    # "cluster" book — elapsed grows by the same
+                    # charge, keeping that book's conservation exact
+                    self._cost_ledger.charge(
+                        "cluster", "kv_transfer",
+                        self.kv_transfer_unit * h.n_pages, rid=rid)
                 dest.session.submit_handoff(h)
                 led["replica"] = dest.name
                 led["path"].append(dest.name)
@@ -1651,4 +1701,9 @@ class ClusterRouter:
                              autoscale=(self._autoscaler.summary()
                                         if self._autoscaler is not None
                                         else None),
-                             replica_hours=dict(self._hours))
+                             replica_hours=dict(self._hours),
+                             cost_rollup=(
+                                 self._cost_ledger.rollup()
+                                 if self._cost_ledger is not None
+                                 else None),
+                             cost_ledger=self._cost_ledger)

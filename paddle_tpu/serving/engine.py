@@ -51,6 +51,7 @@ import numpy as np
 
 from ..inference import BatchingConfig
 from ..jax_compat import named_sharding
+from ..obs import ledger as obs_ledger
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
@@ -130,6 +131,52 @@ class EngineClock:
         self.t += dt
         self.dev_wall += dt
         return out
+
+
+class _LedgerClock(EngineClock):
+    """An ``EngineClock`` that books every priced delta on a
+    ``CostLedger``. Attribution is pushed onto the clock by ``_timed``
+    immediately before the call (``push_attr``) and consumed by
+    exactly one ``timed``; a priced call that reaches the clock with
+    no attribution lands in the ledger's ``unattributed`` bucket,
+    which the conservation audit requires to be zero. ``advance_to``
+    books the idle jump, so per engine
+    ``sum(attributed) + idle == elapsed`` exactly — the arithmetic of
+    the wrapped clock is untouched (super() does all of it), so a
+    ledger-armed replay's outputs stay byte-identical."""
+
+    def __init__(self, mode, costs, ledger, label: str):
+        super().__init__(mode, costs)
+        self._ledger = ledger
+        self.label = label
+        self._attr = None
+
+    def push_attr(self, rid=None, rids=None, weights=None):
+        self._attr = (rid, rids, weights)
+
+    def timed(self, kind, fn, units=None, cost=None):
+        t0 = self.t
+        out = super().timed(kind, fn, units, cost)
+        attr, self._attr = self._attr, None
+        dt = self.t - t0
+        if attr is None:
+            self._ledger.charge(self.label, kind, dt)
+        else:
+            rid, rids, weights = attr
+            if rids:
+                self._ledger.charge(self.label, kind, dt, rids=rids,
+                                    weights=weights)
+            else:
+                self._ledger.charge(self.label, kind, dt,
+                                    rid=rid if rid is not None
+                                    else "engine")
+        return out
+
+    def advance_to(self, t):
+        t0 = self.t
+        super().advance_to(t)
+        if self.t > t0:
+            self._ledger.idle(self.label, self.t - t0)
 
 
 class DecodeError(RuntimeError):
@@ -305,6 +352,12 @@ class ServeResult:
     # evictable + free == n_slots-1, sampled every engine turn) when
     # the run served constrained streams; None at grammar=None — the
     # result shape every pre-grammar consumer sees is unchanged
+    cost_stats: Optional[Dict] = None  # obs.ledger.CostLedger
+    # cost_stats() for this engine's book (elapsed/idle/attributed
+    # unit totals, per-kind breakdown, page-turn integral, and the
+    # two conservation-audit flags) when the run carried ledger=;
+    # None otherwise — never serialized by save_log, so ledger-on
+    # logs stay byte-identical to ledger-off
 
     def report(self, **slo) -> dict:
         return self.metrics.report(**slo)
@@ -680,7 +733,7 @@ class ServingEngine:
                  kv_quant_budget=None, ragged_prefill: bool = False,
                  dispatch_ahead: bool = False, hostmem=None,
                  grammar=None, grammar_config=None,
-                 adapter_schemas=None):
+                 adapter_schemas=None, ledger=None):
         # ``tp``: None (byte-identical to the single-device engine —
         # outputs, slot logs, metrics records, registry contents), a
         # TPConfig, or an int degree. With a MODEL it is threaded into
@@ -1275,6 +1328,20 @@ class ServingEngine:
             else max(decode_chunk, spec.n_draft + 1)
         self.clock_mode = clock
         self.fixed_costs = fixed_costs
+        # ``ledger``: None (byte-identical — the tr-is-None
+        # convention), True (build a private CostLedger), or a shared
+        # obs.ledger.CostLedger (the cluster router passes one so
+        # every replica books onto the same accounts). Armed, every
+        # priced clock delta and per-turn pool occupancy is
+        # attributed (rid | "engine", kind) with exact integer
+        # conservation audits; see docs/OBSERVABILITY.md.
+        if ledger is True:
+            ledger = obs_ledger.CostLedger()
+        elif ledger is not None \
+                and not isinstance(ledger, obs_ledger.CostLedger):
+            raise ValueError("ledger= takes None, True or an "
+                             "obs.ledger.CostLedger")
+        self._ledger = ledger
         self.eos_token_id = eos_token_id
         self._expect_churn = expect_churn
         self._dense = dense_parts
@@ -1880,7 +1947,36 @@ class ServingEngine:
             return None
         return [i for i in mon.log.incidents if i.source == mon.source]
 
+    def _make_clock(self, label: str = "engine") -> EngineClock:
+        """This run's virtual clock: plain (byte-identical) without a
+        ledger, ledger-booking with one — ``label`` names the
+        per-engine conservation book (the replica name in cluster
+        runs)."""
+        if self._ledger is None:
+            return EngineClock(self.clock_mode, self.fixed_costs)
+        return _LedgerClock(self.clock_mode, self.fixed_costs,
+                            self._ledger, label)
+
+    def _req_features(self, r: Request) -> Tuple[str, ...]:
+        """The request's static feature tags for the ledger's
+        per-feature rollup (engine-wide transforms plus the request's
+        own asks); dynamic ones (spec/hostmem/ragged) derive from the
+        kinds actually charged."""
+        feats = []
+        if getattr(self, "tp_size", 1) > 1:
+            feats.append("tp")
+        if self.kv_quant is not None:
+            feats.append("kv_quant")
+        if r.adapter is not None:
+            feats.append("lora")
+        if self._schema_of(r) is not None:
+            feats.append("grammar")
+        return tuple(feats)
+
     def _req_open(self, tr, r: Request):
+        if self._ledger is not None:
+            self._ledger.open(r.rid, tenant=r.tenant,
+                              features=self._req_features(r))
         if tr is None:
             return
         attrs = {"prompt_len": len(r.prompt),
@@ -1896,6 +1992,11 @@ class ServingEngine:
 
     def _req_close(self, tr, r: Request, t: float, outcome: str,
                    n_tokens: int, reason: Optional[str] = None):
+        if self._ledger is not None:
+            # moves ("failover"/"handoff"/"requeued") and the final
+            # outcome collect IN ORDER on the one shared account —
+            # the exactly-once evidence chaos accounting asserts on
+            self._ledger.note_outcome(r.rid, outcome)
         if tr is None:
             return
         attrs = {"outcome": outcome, "n_tokens": n_tokens}
@@ -1911,12 +2012,25 @@ class ServingEngine:
                           if k != "t"})
 
     def _timed(self, tr, clock, kind, fn, jitfn=None, rid=None,
-               units=None, cost=None, **attrs):
+               units=None, cost=None, rids=None, **attrs):
         """``clock.timed`` plus, when tracing, a span in virtual time
         (wall seconds as an attr) and jit-recompile detection: the
         wrapped program cache growing across the call means THIS call
         compiled — the ``jit.compile`` instant names the site and the
-        wall cost, the counter feeds the metrics registry."""
+        wall cost, the counter feeds the metrics registry.
+
+        ``rids`` (batched dispatches) is the cost ledger's attribution
+        vector: the charge splits pro-rata across the rows — by the
+        per-row ``cost`` list when the call priced one (the ragged
+        fused convention), equally otherwise. With ``rids`` unset the
+        charge lands on ``rid``, or on "engine" when the call has no
+        single beneficiary. Every priced call site funnels through
+        here, so a ledger-armed run can never book an unattributed
+        unit (the audit enforces it)."""
+        setter = getattr(clock, "push_attr", None)
+        if setter is not None:
+            setter(rid, rids,
+                   cost if isinstance(cost, (list, tuple)) else None)
         if tr is None:
             # no trace: recompile COUNTING stays live (the obs
             # contract — counters record when nobody traces) unless
@@ -2045,7 +2159,7 @@ class ServingEngine:
         if self.scheduler is not None:
             return self._run_scheduled(trace, self.scheduler)
         self._validate(trace)
-        clock = EngineClock(self.clock_mode, self.fixed_costs)
+        clock = self._make_clock()
         tr = self._make_tracer(clock)
         mon = self._make_monitor()
         m = MetricsCollector(monitor=mon)
@@ -2196,12 +2310,18 @@ class ServingEngine:
                     a_inv &= acache.census_ok()
                 if gcache is not None:
                     g_inv &= gcache.census_ok()
+                if self._ledger is not None:
+                    self._ledger.sample_occupancy(
+                        clock.label, book=book, acache=acache,
+                        gcache=gcache,
+                        arena=getattr(book, "_arena", None))
         finally:
             if tr is not None:
                 if prev_tr is not None:
                     obs_trace.activate(prev_tr)
                 else:
                     obs_trace.deactivate()
+        cost_stats = self._cost_result(clock, tr, m)
         self._close_trace(tr)
         self._stitch_resumes(outputs, hst)
         return ServeResult(policy=self.policy.name, outputs=outputs,
@@ -2232,7 +2352,8 @@ class ServingEngine:
                            grammar_stats=(
                                None if gcache is None else
                                dict(gcache.cache_stats(),
-                                    invariant_ok=g_inv)))
+                                    invariant_ok=g_inv)),
+                           cost_stats=cost_stats)
 
     def _overhead_row(self, clock, run_w0) -> Optional[Dict]:
         """The measured-clock host-overhead decomposition:
@@ -2249,6 +2370,30 @@ class ServingEngine:
         return {"run_wall_s": round(run_wall, 6),
                 "device_wall_s": round(dev, 6),
                 "engine_host_frac": round(max(0.0, frac), 6)}
+
+    def _cost_result(self, clock, tr=None, m=None) -> Optional[Dict]:
+        """Bank the cost ledger's run-end evidence for this engine's
+        book: ``cost_stats`` (unit totals, per-kind breakdown, the
+        page-turn integral, and both conservation-audit flags), one
+        ``cost`` instant on the trace's engine track (armed AND
+        tracing only — un-armed traces stay byte-identical), and the
+        watermarked Prometheus publish (safe to repeat on a shared
+        cluster ledger). None when the run carries no ledger, so the
+        result shape every pre-ledger consumer sees is unchanged."""
+        if self._ledger is None:
+            return None
+        label = getattr(clock, "label", "engine")
+        stats = self._ledger.cost_stats(label)
+        if m is not None:
+            m.note_costs(self._ledger.tenant_costs())
+        if tr is not None:
+            tr.instant("cost", t=clock.now(), track="engine",
+                       **{k: stats[k] for k in
+                          ("engine", "elapsed_units", "idle_units",
+                           "attributed_units", "page_turns",
+                           "conserved_ok", "occupancy_ok")})
+        self._ledger.publish(obs_metrics.REGISTRY)
+        return stats
 
     def _admission_ready(self, waiting, pending, active, clock) -> bool:
         if len(waiting) >= self.admission.max_batch:
@@ -2276,7 +2421,7 @@ class ServingEngine:
         same eviction path ``cancel_after`` uses."""
         self._validate(trace)
         sched.reset()
-        clock = EngineClock(self.clock_mode, self.fixed_costs)
+        clock = self._make_clock()
         tr = self._make_tracer(clock)
         costs = self.fixed_costs or {}
         est_kw = {}
@@ -2501,12 +2646,18 @@ class ServingEngine:
                     a_inv &= acache.census_ok()
                 if gcache is not None:
                     g_inv &= gcache.census_ok()
+                if self._ledger is not None:
+                    self._ledger.sample_occupancy(
+                        clock.label, book=book, acache=acache,
+                        gcache=gcache,
+                        arena=getattr(book, "_arena", None))
         finally:
             if tr is not None:
                 if prev_tr is not None:
                     obs_trace.activate(prev_tr)
                 else:
                     obs_trace.deactivate()
+        cost_stats = self._cost_result(clock, tr, m)
         self._close_trace(tr)
         self._stitch_resumes(outputs, hst)
         return ServeResult(policy=self.policy.name, outputs=outputs,
@@ -2539,16 +2690,25 @@ class ServingEngine:
                            grammar_stats=(
                                None if gcache is None else
                                dict(gcache.cache_stats(),
-                                    invariant_ok=g_inv)))
+                                    invariant_ok=g_inv)),
+                           cost_stats=cost_stats)
 
-    @staticmethod
-    def _commit_wave(admitted, dec, sched, m, tr=None, t=0.0):
+    def _commit_wave(self, admitted, dec, sched, m, tr=None, t=0.0):
         """Charge the fair-queue tags for what actually ran (the
         degraded budget when a tier fired) and record degradations
         only then — a wave member blocked on slots stays queued,
-        uncharged, and may re-degrade differently next turn."""
+        uncharged, and may re-degrade differently next turn. With a
+        cost ledger armed, the scheduler's admission price is banked
+        on the request's account here — commit is the moment the
+        estimate became a promise — feeding the estimator-vs-actual
+        calibration report."""
         for r in admitted:
             sched.commit(r.rid, budget=r.max_new_tokens)
+            if self._ledger is not None:
+                priced = sched.priced(r.rid) \
+                    if hasattr(sched, "priced") else None
+                if priced is not None:
+                    self._ledger.note_estimate(r.rid, priced)
             if r.rid in dec.degraded:
                 b, b0 = dec.degraded[r.rid]
                 m.on_degrade(r.rid, b, b0)
@@ -3156,7 +3316,11 @@ class ServingEngine:
                 cost=([(self.fixed_costs or {}).get("prefill", 1.0)
                        / e.run_chunks for e in picked]
                       if flat else None),
+                rids=[e.req.rid for e in picked],
                 **self._tp_attr)
+            if self._ledger is not None:
+                for e in picked:
+                    self._ledger.tag(e.req.rid, "ragged")
             firsts = np.asarray(firsts)
             for e in picked:
                 e.next_chunk += 1
@@ -3398,7 +3562,8 @@ class ServingEngine:
             attrs["ahead"] = True
         emits, _, self._pools = self._timed(
             tr, clock, "decode", _call, jitfn=self._p_decode_n,
-            n=n, rows=len(rows), **attrs)
+            n=n, rows=len(rows),
+            rids=[st.req.rid for st in rows], **attrs)
         emits = np.asarray(emits)  # (n, slots) greedy tokens
         t = clock.now()
         for st in rows:
@@ -3504,7 +3669,8 @@ class ServingEngine:
                           k)
         counts, cands, self._pools, self._spec_pools = self._timed(
             tr, clock, "spec_decode", _call, jitfn=s_step, k=k,
-            rows=len(rows), **self._tp_attr)
+            rows=len(rows),
+            rids=[st.req.rid for st in rows], **self._tp_attr)
         counts = np.asarray(counts)
         cands = np.asarray(cands)
         t = clock.now()
@@ -3654,7 +3820,8 @@ class ServingEngine:
                                         jnp.asarray(toks), kc, vc)
             logits, kc, vc = self._timed(
                 tr, clock, "dense_prefill", _pf,
-                jitfn=parts["prefill"], S0=S0, B=B)
+                jitfn=parts["prefill"], S0=S0, B=B,
+                rids=[r.rid for r in grp])
             cur = np.argmax(np.asarray(logits), -1).astype(np.int32)
             t = clock.now()
             outs = [[int(c)] for c in cur]
@@ -3687,7 +3854,8 @@ class ServingEngine:
                         jnp.asarray(cur), jnp.asarray(pos), kc, vc)
                 logits, kc, vc = self._timed(
                     tr, clock, "dense_decode", _st,
-                    jitfn=parts["decode_step"], B=B)
+                    jitfn=parts["decode_step"], B=B,
+                    rids=[r.rid for r in grp])
                 cur = np.argmax(np.asarray(logits), -1).astype(np.int32)
                 pos += 1
                 t = clock.now()
@@ -3783,7 +3951,7 @@ class EngineSession:
         self.handoff_ready: List[KVHandoff] = []
         self.import_queue: List[KVHandoff] = []
         self.handoff_stats = {"imported": 0, "reclaimed": 0}
-        self.clock = EngineClock(eng.clock_mode, eng.fixed_costs)
+        self.clock = eng._make_clock(replica or "engine")
         self.tr = tracer
         self.slo = slo
         self.m = MetricsCollector(monitor=slo)
@@ -4502,6 +4670,11 @@ class EngineSession:
             self.a_inv_ok &= self.acache.census_ok()
         if self.gcache is not None:
             self.g_inv_ok &= self.gcache.census_ok()
+        if eng._ledger is not None:
+            eng._ledger.sample_occupancy(
+                clock.label, book=self.book, acache=self.acache,
+                gcache=self.gcache,
+                arena=getattr(self.book, "_arena", None))
         return progressed
 
     def _route_ctx(self, wave):
@@ -4710,5 +4883,7 @@ class EngineSession:
             grammar_stats=(
                 None if self.gcache is None else
                 dict(self.gcache.cache_stats(),
-                     invariant_ok=self.g_inv_ok)))
+                     invariant_ok=self.g_inv_ok)),
+            cost_stats=self.eng._cost_result(self.clock, self.tr,
+                                             self.m))
         return self._finished

@@ -47,6 +47,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import ledger as obs_ledger
+
 # the character set a bounded {"type": "string"} draws from: JSON-safe
 # without escapes, so the emitted text needs no backslash states
 STRING_CHARS = ("abcdefghijklmnopqrstuvwxyz"
@@ -865,10 +867,22 @@ class GrammarCache:
     def resident_count(self) -> int:
         return len(self._slot)
 
-    def census_ok(self) -> bool:
+    def populations(self) -> Tuple[int, int, int]:
+        """The census populations (pinned, evictable, free) — shared
+        between ``census_ok`` and the cost ledger's occupancy
+        sampler."""
         pinned = sum(1 for n in self._slot if self._pins.get(n))
-        return (pinned + len(self._evictable) + len(self._free)
-                == self.n_slots - 1)
+        return pinned, len(self._evictable), len(self._free)
+
+    def pin_owners(self) -> Dict[str, List[str]]:
+        """schema name -> sorted holder rids, pinned slots only — the
+        attribution view the cost ledger splits slot-turns by."""
+        return {n: sorted(self._pins[n]) for n in self._slot
+                if self._pins.get(n)}
+
+    def census_ok(self) -> bool:
+        return obs_ledger.census_balanced(self.n_slots - 1,
+                                          *self.populations())
 
     def cache_stats(self) -> dict:
         """The ``AdapterCache.cache_stats`` shape, grammar-named."""

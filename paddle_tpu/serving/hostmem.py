@@ -37,7 +37,9 @@ clock (``kv_pageout`` / ``kv_pagein``, the ``adapter_upload`` /
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
+
+from ..obs import ledger as obs_ledger
 
 
 @dataclasses.dataclass(frozen=True)
@@ -219,12 +221,36 @@ class HostArena:
         return len(keys)
 
     # --- census + stats ----------------------------------------------------
+    def populations(self) -> Tuple[int, int, int]:
+        """The byte-census populations (pinned, evictable, free) —
+        shared between ``census_ok`` and the cost ledger's occupancy
+        sampler (capacity = ``byte_budget``). Stored bytes are summed
+        from the live entries, NOT derived from ``free_bytes``, so
+        the balance check cross-checks the two bookkeepers."""
+        pinned = sum(e.nbytes for e in self._entries.values()
+                     if e.owner is not None)
+        evictable = sum(e.nbytes for e in self._entries.values()
+                        if e.owner is None)
+        return pinned, evictable, self.free_bytes
+
+    def owner_counts(self) -> Dict[str, int]:
+        """owner -> live entry count: pinned entries under their
+        preemption owner rid, plain LRU spill under ``"cache"`` — the
+        attribution view the cost ledger books host-tier page-turns
+        by."""
+        counts: Dict[str, int] = {}
+        for e in self._entries.values():
+            owner = e.owner if e.owner is not None else "cache"
+            counts[owner] = counts.get(owner, 0) + 1
+        return counts
+
     def census_ok(self) -> bool:
         """The conservation invariant: pinned + evictable + free ==
         budget, every LRU key stored and unpinned, every unpinned
-        entry in the LRU."""
-        stored = sum(e.nbytes for e in self._entries.values())
-        if stored + self.free_bytes != self.byte_budget:
+        entry in the LRU (arithmetic shared via
+        ``obs.ledger.census_balanced``)."""
+        if not obs_ledger.census_balanced(self.byte_budget,
+                                          *self.populations()):
             return False
         if any(k not in self._entries or
                self._entries[k].owner is not None for k in self._lru):

@@ -121,6 +121,11 @@ class MetricsCollector:
         # ran, so plain traces — and spec=None replays — keep their
         # records byte-identical (the PR-5 presence convention)
         self._spec = {"rounds": 0, "proposed": 0, "accepted": 0}
+        # per-tenant cost-ledger snapshot (engine-fed at run end via
+        # ``note_costs`` ONLY when a ledger is armed); the per-tenant
+        # report block grows its cost columns only then, so ledger-off
+        # reports stay byte-identical (the PR-5 presence convention)
+        self._tenant_costs: Optional[Dict[str, dict]] = None
         # quantized-page-tier totals (engine-fed); the report grows
         # its kv_quant block ONLY when a quantized mode is armed, so
         # kv_quant=None runs keep their records byte-identical (the
@@ -257,6 +262,14 @@ class MetricsCollector:
         self._spec["rounds"] += rows
         self._spec["proposed"] += proposed
         self._spec["accepted"] += accepted
+
+    def note_costs(self, per_tenant: Dict[str, dict]):
+        """Engine-fed at run end, ONLY when a cost ledger is armed:
+        ``CostLedger.tenant_costs()`` — tenant -> {cost_units,
+        page_turns}. The per-tenant report block grows its two cost
+        columns only for tenants present here; un-armed runs never
+        call this and their reports stay byte-identical."""
+        self._tenant_costs = dict(per_tenant)
 
     def on_pool_bytes(self, t: float, per_device_bytes: int):
         """Per-device KV-pool residency sample (tensor-parallel
@@ -594,6 +607,11 @@ class MetricsCollector:
                     per[t]["slo_deadline_attained"] = round(
                         sum(1 for v in n_dl if v["deadline_met"])
                         / len(n_dl), 4)
+                if self._tenant_costs is not None \
+                        and t in self._tenant_costs:
+                    c = self._tenant_costs[t]
+                    per[t]["cost_units"] = c.get("cost_units", 0.0)
+                    per[t]["page_turns"] = c.get("page_turns", 0.0)
                 xs.append(gtok / float(w.get(t, 1.0)))
             qb["tenants"] = per
             # Jain index over weight-normalized per-tenant goodput:

@@ -249,6 +249,7 @@ class QoSScheduler:
         state)."""
         self._q: Dict[str, _Entry] = {}
         self._tags: Dict[str, float] = {}
+        self._priced: Dict[str, float] = {}
         self._overload_open = []
         self._pressure_open = []
 
@@ -443,6 +444,12 @@ class QoSScheduler:
                 continue
             queued_cost += cost  # only ADMITTED prefills delay later
             # wave members (a shed candidate never runs)
+            # bank the full admission price (prefill + budgeted decode
+            # with headroom) — the cost ledger's calibration signal:
+            # estimator-priced vs ledger-actual units per admission
+            self._priced[r.rid] = (
+                cost + math.ceil(r.max_new_tokens / decode_chunk)
+                * est.decode * self.headroom)
             if r.max_new_tokens < e.req.max_new_tokens:
                 degraded[r.rid] = (r.max_new_tokens,
                                    e.req.max_new_tokens)
@@ -556,6 +563,15 @@ class QoSScheduler:
         b = budget if budget is not None else e.req.max_new_tokens
         cost = (len(e.req.prompt) + b) / self._weight(t)
         self._tags[t] = self._tags.get(t, 0.0) + cost
+
+    def priced(self, rid: str) -> Optional[float]:
+        """The admission price ``select`` computed for ``rid`` on its
+        LAST selection (prefill + budgeted decode with headroom), or
+        None for a request never selected. Read by the engine at
+        commit time to seed the cost ledger's estimator-vs-actual
+        calibration rows; purely observational — admission arithmetic
+        never reads it back."""
+        return self._priced.get(rid)
 
     def drain_queue(self) -> List[Request]:
         """Remove and return EVERY queued (never-admitted) request, in

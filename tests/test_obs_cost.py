@@ -1,0 +1,647 @@
+"""Resource-attribution ledger (PR 19).
+
+The claims: ``CostLedger`` books every priced virtual-clock unit
+under an owner (rid | "engine" | "idle"; batched dispatches split
+pro-rata by the per-row cost vector, integer-exact with the residual
+on the last row) and integrates per-turn resource occupancy
+(device/host page-turns, adapter/grammar slot-turns) — with the two
+conservation audits EXACT on the fixed clock: per engine book
+``sum(attributed) + idle == elapsed``, and per-request page-turns ==
+the per-turn pool-occupancy integral. ``ledger=None`` stays
+byte-identical everywhere; ``ledger=True`` leaves token streams
+untouched. Accounts MERGE across moves, so crash->failover, disagg
+handoff and hostmem preempt/restore each account exactly once (one
+account, at most one terminal outcome). The four budgeted caches
+share one census arithmetic (``obs.ledger.census_balanced``);
+``publish`` exposes armed-only Prometheus counter families; the
+report tools grow cost rows only when fed a ledger; and the
+``obs_cost`` bench-gate family passes its pass rows and FAILs each
+broken invariant.
+"""
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs import ledger as obs_ledger
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs.ledger import (SCALE, CostLedger, census_balanced,
+                                   load_costs, overlay_contained)
+from paddle_tpu.serving import (AdapterCache, AdapterStore,
+                                ClusterRouter, FailoverConfig,
+                                FaultEvent, FaultPlan, GrammarCache,
+                                GrammarStore, HostArena, QoSScheduler,
+                                Request, ServingEngine, TokenVocab,
+                                make_sim_serving,
+                                synthesize_prefill_heavy_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COSTS = {"prefill_unit": 1.0, "decode": 1.0}
+VOCAB = 211
+# outcomes that MOVE an account between engine books; everything else
+# is terminal and must appear at most once per account
+MOVES = {"failover", "requeued", "handoff"}
+
+
+def _sim(slots=4, extra=8, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("vocab", VOCAB)
+    kw.setdefault("n_pool_pages",
+                  slots * (kw["max_len"] // kw["page_size"]) + 1 + extra)
+    return make_sim_serving(slots=slots, **kw)
+
+
+def _engine(slots=4, scheduler=None, serving=None, **kw):
+    kw.setdefault("clock", "fixed")
+    kw.setdefault("fixed_costs", COSTS)
+    return ServingEngine(serving=serving or _sim(slots=slots),
+                         slots=slots, policy="paged",
+                         scheduler=scheduler, **kw)
+
+
+def _req(rid, arrival, prompt, budget, **kw):
+    return Request(rid=rid, arrival=arrival, prompt=tuple(prompt),
+                   max_new_tokens=budget, **kw)
+
+
+def _trace(n=16, seed=3, gap=0.7, plen=10, budget=8, **kw):
+    rng = np.random.default_rng(seed)
+    return [_req(f"m{i}", i * gap,
+                 [int(t) for t in rng.integers(1, VOCAB, plen)],
+                 budget, tenant=("acme" if i % 2 else "bob"), **kw)
+            for i in range(n)]
+
+
+def _terminals(acct):
+    return [o for o in acct["outcomes"] if o not in MOVES]
+
+
+# --- the shared census arithmetic -------------------------------------------
+
+def test_census_balanced_and_overlay_contained():
+    assert census_balanced(10, 3, 3, 4)
+    assert not census_balanced(10, 3, 3, 3)
+    assert census_balanced(0)
+    # the quantized overlay may only mark members of a base tier
+    assert overlay_contained({"a", "b"}, {"a"}, {"b", "c"})
+    assert not overlay_contained({"z"}, {"a"}, {"b"})
+    assert overlay_contained(set(), {"a"})
+
+
+def test_four_caches_delegate_shared_census(monkeypatch):
+    """PagedKVCache, AdapterCache, GrammarCache and HostArena all run
+    their census through obs.ledger.census_balanced — one arithmetic,
+    four pools."""
+    from paddle_tpu.ops.pallas.paged_attention import PagedKVCache
+    calls = []
+    real = obs_ledger.census_balanced
+
+    def spy(capacity, *pops):
+        calls.append(int(capacity))
+        return real(capacity, *pops)
+
+    for mod in ("paddle_tpu.ops.pallas.paged_attention",
+                "paddle_tpu.serving.adapters",
+                "paddle_tpu.serving.grammar",
+                "paddle_tpu.serving.hostmem"):
+        m = __import__(mod, fromlist=["obs_ledger"])
+        monkeypatch.setattr(m.obs_ledger, "census_balanced", spy)
+
+    book = PagedKVCache(n_pages=8, page_size=4, kv_heads=1, head_dim=4)
+    sim = _sim(lora_slots=3, grammar_slots=3, grammar_states=8)
+    acache = AdapterCache(AdapterStore({"a0": {"salt": 7}}), 3,
+                          sim.init_adapter_bank, sim.upload_adapter)
+    gcache = GrammarCache(
+        GrammarStore({"s0": {"type": "object", "properties": {},
+                             "required": []}}), 3, 8,
+        TokenVocab.ascii_default(VOCAB), sim.init_grammar_bank,
+        sim.upload_grammar)
+    arena = HostArena(100)
+    for cache in (book, acache, gcache, arena):
+        n = len(calls)
+        assert cache.census_ok()
+        assert len(calls) > n, type(cache).__name__
+
+
+# --- CostLedger units -------------------------------------------------------
+
+def test_split_exact_equal_and_weighted():
+    from paddle_tpu.obs.ledger import _split
+    assert _split(10, 3) == [3, 3, 4]          # residual on LAST
+    assert sum(_split(7, 4)) == 7
+    assert _split(0, 3) == [0, 0, 0]
+    assert _split(5, 0) == []
+    # pro-rata by the fused dispatch's cost vector, still exact
+    s = _split(100, 3, weights=[1.0, 1.0, 2.0])
+    assert s == [25, 25, 50] and sum(s) == 100
+    s = _split(10, 3, weights=[1.0, 1.0, 1.0])
+    assert sum(s) == 10
+    # degenerate weights fall back to the equal split
+    assert sum(_split(10, 2, weights=[0.0, 0.0])) == 10
+
+
+def test_charge_idle_audit_and_unattributed():
+    led = CostLedger()
+    led.charge("e", "prefill", 2.0, rid="a")
+    led.charge("e", "decode", 1.0, rids=["a", "b", "c"],
+               weights=[1.0, 1.0, 2.0])
+    led.idle("e", 0.5)
+    a = led.audit("e")
+    assert a["conserved_ok"] and a["ok"]
+    assert a["unattributed_units"] == 0.0
+    st = led.cost_stats("e")
+    assert st["elapsed_units"] == pytest.approx(3.5)
+    assert st["idle_units"] == pytest.approx(0.5)
+    assert st["attributed_units"] == pytest.approx(3.0)
+    assert st["kinds"] == {"decode": 1.0, "prefill": 2.0}
+    # an ownerless charge is booked — and audited to failure
+    led.charge("e", "mystery", 1.0)
+    a = led.audit("e")
+    assert a["conserved_ok"]          # still balances arithmetically
+    assert a["unattributed_units"] == 1.0 and not a["ok"]
+    # a doctored book breaks conservation
+    led._books["e"]["elapsed"] += 1
+    assert not led.audit("e")["conserved_ok"]
+
+
+def test_occupancy_integral_cross_checks_pool():
+    led = CostLedger()
+    book = SimpleNamespace(
+        populations=lambda: (2, 1, 5),
+        page_holders=lambda: {1: ["a"], 2: ["a", "b"]})
+    led.sample_occupancy("e", book=book)
+    st = led.cost_stats("e")
+    # 2 resident + 1 evictable pages for one turn = 3 page-turns
+    assert st["page_turns"] == {"kv": 3.0}
+    assert st["turns"] == 1
+    assert led.audit("e")["occupancy_ok"]
+    # a holder the populations don't cover breaks the integral
+    led._occ["e"][("ghost", "kv")] = SCALE
+    assert not led.audit("e")["occupancy_ok"]
+
+
+def test_account_merges_outcomes_and_estimates():
+    led = CostLedger()
+    led.open("a", tenant="acme", features=("lora",))
+    led.open("a", features=("grammar",))   # MERGE, never reset
+    acct = led._accounts["a"]
+    assert acct["tenant"] == "acme"
+    assert acct["features"] == {"lora", "grammar"}
+    led.note_outcome("a", "failover")
+    led.note_outcome("a", "completed")
+    assert acct["outcomes"] == ["failover", "completed"]
+    assert _terminals(acct) == ["completed"]
+    led.note_estimate("a", 3.0)
+    led.note_estimate("a", 2.0)            # retries accumulate
+    assert acct["est"] == pytest.approx(5.0)
+
+
+def test_save_costs_roundtrip_global_last(tmp_path):
+    led = CostLedger()
+    led.open("a", tenant="acme")
+    led.charge("e", "decode", 4.0, rid="a")
+    led.note_outcome("a", "completed")
+    p = str(tmp_path / "costs.jsonl")
+    led.save_costs(p)
+    rows = load_costs(p)
+    assert rows[-1]["row"] == "global"     # the global row stays LAST
+    kinds = [r["row"] for r in rows]
+    for k in ("request", "tenant", "feature", "engine"):
+        assert k in kinds
+    req = next(r for r in rows if r["row"] == "request")
+    assert req["rid"] == "a" and req["tenant"] == "acme"
+    assert req["total_units"] == pytest.approx(4.0)
+    assert req["outcomes"] == ["completed"]
+    assert rows[-1]["ok"] is True
+
+
+def test_publish_watermarked_golden_text():
+    """The armed-only Prometheus families, frozen to the exposition
+    byte: serving_cost_units_total{kind,tenant} and
+    serving_page_turns_total{tenant,tier}. Watermarked — a second
+    publish of the same books adds nothing."""
+    led = CostLedger()
+    led.open("r1", tenant="acme")
+    led.charge("e", "decode", 2.0, rid="r1")
+    led.charge("e", "prefill", 1.5, rid="engine")
+    book = SimpleNamespace(populations=lambda: (1, 1, 6),
+                           page_holders=lambda: {1: ["r1"]})
+    led.sample_occupancy("e", book=book)
+    r = obs_metrics.MetricsRegistry()
+    led.publish(r)
+    golden = (
+        "# HELP serving_cost_units_total attributed virtual-clock "
+        "cost units\n"
+        "# TYPE serving_cost_units_total counter\n"
+        'serving_cost_units_total{kind="decode",tenant="acme"} 2\n'
+        'serving_cost_units_total{kind="prefill",tenant="engine"} '
+        "1.5\n"
+        "# HELP serving_page_turns_total pool slot-turns held "
+        "(pages x engine turns)\n"
+        "# TYPE serving_page_turns_total counter\n"
+        'serving_page_turns_total{tenant="acme",tier="kv"} 1\n'
+        'serving_page_turns_total{tenant="cache",tier="kv"} 1\n')
+    assert r.expose_text() == golden
+    led.publish(r)                          # no delta -> no change
+    assert r.expose_text() == golden
+    led.charge("e", "decode", 1.0, rid="r1")
+    led.publish(r)                          # delta-only increment
+    assert 'kind="decode",tenant="acme"} 3' in r.expose_text()
+
+
+# --- the engine seam --------------------------------------------------------
+
+def test_ledger_none_byte_identity():
+    """ledger=None is the pre-ledger engine: outputs, slot logs,
+    report JSON, registry families, cost_stats absent."""
+    obs_metrics.REGISTRY.reset()
+    trace = _trace(n=12)
+    plain = _engine().run(trace)
+    again = _engine(ledger=None).run(trace)
+    assert again.outputs == plain.outputs
+    assert again.slot_log == plain.slot_log
+    assert again.cost_stats is None and plain.cost_stats is None
+    assert json.dumps(again.report(), sort_keys=True) \
+        == json.dumps(plain.report(), sort_keys=True)
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert not any(n.startswith(("serving_cost_",
+                                 "serving_page_turns"))
+                   for n in names)
+    with pytest.raises(ValueError, match="ledger="):
+        _engine(ledger="yes")
+
+
+def test_ledger_on_conservation_and_token_identity():
+    obs_metrics.REGISTRY.reset()
+    trace = _trace(n=12)
+    base = _engine().run(trace)
+    res = _engine(ledger=True).run(trace)
+    assert res.outputs == base.outputs      # accounting changes nothing
+    st = res.cost_stats
+    assert st["conserved_ok"] and st["occupancy_ok"]
+    assert st["unattributed_units"] == 0.0
+    assert st["attributed_units"] + st["idle_units"] \
+        == pytest.approx(st["elapsed_units"])
+    assert st["kinds"].get("decode", 0) > 0
+    assert st["page_turns"].get("kv", 0) > 0
+    assert st["turns"] > 0
+    # armed-only metric families reached the registry
+    names = {key[0] for key in obs_metrics.REGISTRY._metrics}
+    assert "serving_cost_units_total" in names
+    assert "serving_page_turns_total" in names
+
+
+def test_metrics_report_tenant_cost_columns():
+    """Satellite: the per-tenant report block grows cost_units /
+    page_turns columns only when the run carried a ledger."""
+    trace = _trace(n=10)
+    plain = _engine(scheduler=QoSScheduler()).run(trace)
+    res = _engine(scheduler=QoSScheduler(), ledger=True).run(trace)
+    per = res.report()["tenants"]
+    assert per and all("cost_units" in v and "page_turns" in v
+                       for v in per.values())
+    assert sum(v["cost_units"] for v in per.values()) > 0
+    per0 = plain.report()["tenants"]
+    assert all("cost_units" not in v and "page_turns" not in v
+               for v in per0.values())
+
+
+def test_qos_estimates_ride_request_rows(tmp_path):
+    """QoS admission prices every committed request; the ledger keeps
+    the estimate next to the actual for the calibration report."""
+    led = CostLedger()
+    sched = QoSScheduler()
+    res = _engine(scheduler=sched, ledger=led).run(_trace(n=10))
+    assert res.cost_stats["conserved_ok"]
+    p = str(tmp_path / "c.jsonl")
+    led.save_costs(p)
+    reqs = [r for r in load_costs(p) if r["row"] == "request"]
+    assert reqs and all("est_units" in r for r in reqs)
+    assert all(r["est_units"] > 0 for r in reqs)
+    # FIFO runs carry no estimates — the rows stay est-free
+    led2 = CostLedger()
+    _engine(ledger=led2).run(_trace(n=6))
+    led2.save_costs(p)
+    assert all("est_units" not in r for r in load_costs(p)
+               if r["row"] == "request")
+
+
+# --- exactly-once across moves ----------------------------------------------
+
+def _assert_exactly_once(led, outputs):
+    for rid in outputs:
+        acct = led._accounts.get(rid)
+        assert acct is not None, rid
+        assert len(_terminals(acct)) <= 1, (rid, acct["outcomes"])
+
+
+def test_crash_failover_accounts_exactly_once():
+    trace = _trace(n=24)
+
+    def run(faults=None, ledger=None):
+        def spawn(name):
+            return _engine()
+        return ClusterRouter(
+            spawn, 2, placement="round_robin", cost_ledger=ledger,
+            faults=faults,
+            failover=FailoverConfig(heartbeat_interval=1.0,
+                                    heartbeat_timeout=3.0,
+                                    backoff_base=0.5)
+            if faults else None).run(trace)
+
+    ff = run(ledger=True)
+    plan = FaultPlan([FaultEvent(t=4.0, kind="crash", replica="r0")])
+    ch = run(faults=plan, ledger=True)
+    assert ch.outputs() == ff.outputs()     # token-identical streams
+    for res in (ff, ch):
+        ru = res.cost_rollup
+        assert ru["ok"] and ru["conserved_ok"] and ru["occupancy_ok"]
+        assert ru["unattributed_units"] == 0.0
+        _assert_exactly_once(res.cost_ledger, res.outputs())
+    # the moved rows' accounts show the hop then ONE completion
+    led = ch.cost_ledger
+    moved = [rid for rid, l in ch.ledger.items() if l["retries"]]
+    assert moved
+    # attribution differs from fault-free ONLY by the priced retry
+    # kinds, asserted explicitly: no rid gains a kind that is not a
+    # retry/transfer price, and prefill (single-row priced, exact
+    # per rid) inflates ONLY on moved rows — the re-prefill. Decode
+    # SHARES may shift (a turn's flat price splits across whatever
+    # rows share the wave, and the crash changes co-residency) but
+    # the global audit above already proves nothing leaked.
+    fft = ff.cost_ledger._request_totals()
+    cht = led._request_totals()
+    retry_kinds = {"prefill", "kv_pageout", "kv_pagein",
+                   "kv_transfer"}
+    redone = 0
+    for rid in ch.outputs():
+        a, b = cht[rid]["units"], fft[rid]["units"]
+        assert set(a) - set(b) <= retry_kinds, rid
+        if rid in moved:
+            assert a.get("prefill", 0) >= b.get("prefill", 0), rid
+            redone += a.get("prefill", 0) > b.get("prefill", 0)
+        else:
+            assert a.get("prefill", 0) == b.get("prefill", 0), rid
+    assert redone                # >=1 salvage really paid the retry
+    for rid in moved:
+        outs = led._accounts[rid]["outcomes"]
+        assert "failover" in outs or "requeued" in outs, (rid, outs)
+        assert _terminals(led._accounts[rid]) == ["completed"]
+    # an unarmed cluster result carries no cost surfaces
+    off = run()
+    assert off.cost_rollup is None and off.cost_ledger is None
+    with pytest.raises(ValueError, match="without cost_ledger"):
+        off.save_costs("/dev/null")
+
+
+def test_disagg_handoff_accounts_exactly_once():
+    trace = synthesize_prefill_heavy_trace(seed=0, n_short=16,
+                                           n_long=6,
+                                           vocab_size=VOCAB)
+    roles = {"r0": "prefill", "r1": "decode"}
+
+    def spawn(name):
+        return _engine(slots=8, serving=_sim(slots=8, extra=16, max_len=96))
+
+    res = ClusterRouter(spawn, 2, placement="disaggregated",
+                        roles=roles, kv_transfer_unit=0.05,
+                        cost_ledger=True).run(trace)
+    assert res.census()["conserved"]
+    ru = res.cost_rollup
+    assert ru["ok"], ru
+    led = res.cost_ledger
+    _assert_exactly_once(led, res.outputs())
+    # ONE handoff move + one completion per account, and the
+    # transfer units landed under the disagg feature
+    for rid in res.outputs():
+        outs = led._accounts[rid]["outcomes"]
+        assert outs.count("handoff") == 1, (rid, outs)
+        assert _terminals(led._accounts[rid]) == ["completed"]
+    assert ru["features"].get("disagg", 0) > 0
+    # streams still token-identical to a lone interleaved engine
+    lone = _engine(slots=16,
+                   serving=_sim(slots=16, extra=64, max_len=96)).run(trace)
+    assert res.outputs() == lone.outputs
+
+
+def test_hostmem_preempt_accounts_exactly_once():
+    sim = _sim(slots=1, max_len=96, n_pool_pages=24,
+               chunked_prefill=8)
+    costs = {"prefill": 5.0, "decode": 1.0,
+             "kv_pageout": 2.0, "kv_pagein": 2.0}
+    trace = [_req("lo", 0.0, range(10, 26), 30, tenant="t0",
+                  priority=0),
+             _req("hi", 20.0, range(40, 56), 8, tenant="t1",
+                  priority=9)]
+
+    def run(**kw):
+        return ServingEngine(serving=sim, slots=1, policy="paged",
+                             clock="fixed", fixed_costs=costs,
+                             scheduler=QoSScheduler(),
+                             hostmem=1 << 20, **kw).run(trace)
+
+    base = run()
+    led = CostLedger()
+    res = run(ledger=led)
+    assert res.outputs == base.outputs
+    assert res.hostmem_stats["preempts"] >= 1
+    st = res.cost_stats
+    assert st["conserved_ok"] and st["occupancy_ok"]
+    assert st["unattributed_units"] == 0.0
+    # the preempted row's account: the requeue move, ONE completion,
+    # and host-tier page-turns from its parked chain
+    acct = led._accounts["lo"]
+    assert _terminals(acct) == ["completed"]
+    assert st["page_turns"].get("host", 0) > 0
+    p_kinds = set(st["kinds"])
+    assert "kv_pageout" in p_kinds and "kv_pagein" in p_kinds
+
+
+# --- report tools -----------------------------------------------------------
+
+def _ledgered_costs(tmp_path, qos=True):
+    led = CostLedger()
+    sched = QoSScheduler() if qos else None
+    _engine(scheduler=sched, ledger=led).run(_trace(n=10))
+    p = str(tmp_path / "costs.jsonl")
+    led.save_costs(p)
+    return p
+
+
+def test_cost_report_tool(tmp_path):
+    p = _ledgered_costs(tmp_path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "cost_report.py"), p, "--json"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    recs = [json.loads(ln) for ln in out.stdout.splitlines()]
+    assert recs[-1]["bench"] == "cost_report"   # global row LAST
+    assert recs[-1]["ok"] is True
+    kinds = {r["bench"] for r in recs}
+    assert {"cost_report_tenant", "cost_report_top",
+            "cost_report_calibration"} <= kinds
+    # FIFO ledger -> no calibration row (presence convention)
+    p2 = _ledgered_costs(tmp_path, qos=False)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "cost_report.py"), p2,
+         "--json"], capture_output=True, text=True)
+    kinds = {json.loads(ln)["bench"]
+             for ln in out.stdout.splitlines()}
+    assert "cost_report_calibration" not in kinds
+    # human rendering names the tenants
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "cost_report.py"), p],
+        capture_output=True, text=True)
+    assert "per-tenant" in out.stdout and "acme" in out.stdout
+    # a missing file FAILs gracefully with a JSON row
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "cost_report.py"),
+         str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert json.loads(out.stdout)["bench"] == "cost_report"
+
+
+def test_trace_report_cost_row_and_absence(tmp_path):
+    from paddle_tpu import obs
+    path = str(tmp_path / "tr.json")
+    tr = obs.Tracer()
+    _engine(trace=tr, ledger=True).run(_trace(n=8))
+    tr.export(path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "trace_report.py"), path,
+         "--json"], capture_output=True, text=True)
+    assert out.returncode == 0
+    recs = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    assert recs[-1]["bench"] == "trace_report"  # global still LAST
+    cost = [r for r in recs if r["bench"] == "trace_report_cost"]
+    assert len(cost) == 1
+    assert cost[0]["conserved_ok"] and cost[0]["occupancy_ok"]
+    assert cost[0]["attributed_units"] > 0
+    # an unledgered trace grows NO cost row
+    path2 = str(tmp_path / "tr2.json")
+    tr2 = obs.Tracer()
+    _engine(trace=tr2).run(_trace(n=8))
+    tr2.export(path2)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "trace_report.py"), path2,
+         "--json"], capture_output=True, text=True)
+    kinds = [json.loads(ln)["bench"]
+             for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert "trace_report_cost" not in kinds
+
+
+def test_slo_report_cost_snapshots(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from slo_report import cost_snapshots
+    p = _ledgered_costs(tmp_path)
+    rows = load_costs(p)
+    some_rid = next(r["rid"] for r in rows
+                    if r["row"] == "request")
+    tenant = next(r["tenant"] for r in rows
+                  if r["row"] == "request" and r["rid"] == some_rid)
+    incs = [SimpleNamespace(id="i-1", rule="burn", source="qos",
+                            rids=[some_rid]),
+            SimpleNamespace(id="i-2", rule="stall", source="eng",
+                            rids=["never-ledgered"]),
+            SimpleNamespace(id="i-3", rule="x", source="y", rids=[])]
+    snaps = cost_snapshots(incs, rows)
+    # only the incident whose rids ledgered yields a snapshot
+    assert len(snaps) == 1
+    s = snaps[0]
+    assert s["bench"] == "slo_report_cost" and s["id"] == "i-1"
+    assert tenant in s["tenants"]
+    assert s["tenants"][tenant]["cost_units"] > 0
+
+
+# --- the obs_cost bench-gate family -----------------------------------------
+
+def _gate(text, tmp_path):
+    p = tmp_path / "rows.jsonl"
+    p.write_text(text)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "obs", str(p)], capture_output=True, text=True)
+    recs = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    return r.returncode, recs
+
+
+def _cost_summary(**kw):
+    d = {"bench": "obs_cost_summary", "device": "sim", "seed": 0,
+         "replicas": 4, "requests": 1000,
+         "off_on_identical": True,
+         "on_audit_ok": True, "on_conserved_ok": True,
+         "on_occupancy_ok": True, "on_unattributed_units": 0,
+         "chaos_audit_ok": True, "chaos_conserved_ok": True,
+         "chaos_occupancy_ok": True, "chaos_unattributed_units": 0,
+         "chaos_exactly_once": True, "chaos_unledgered": [],
+         "chaos_multi_terminal": [], "chaos_parity_ok": True,
+         "chaos_parity_compared": 990}
+    d.update(kw)
+    return json.dumps(d)
+
+
+def test_bench_gate_obs_cost_family(tmp_path):
+    rc, recs = _gate(_cost_summary() + "\n", tmp_path)
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+
+    # broken unit conservation FAILs
+    rc, recs = _gate(_cost_summary(on_conserved_ok=False) + "\n",
+                     tmp_path)
+    assert rc == 1 and "conservation" in recs[-1]["reason"]
+
+    # broken occupancy integral FAILs
+    rc, recs = _gate(_cost_summary(chaos_occupancy_ok=False) + "\n",
+                     tmp_path)
+    assert rc == 1 and "occupancy" in recs[-1]["reason"]
+
+    # unattributed units FAIL
+    rc, recs = _gate(_cost_summary(on_unattributed_units=0.5) + "\n",
+                     tmp_path)
+    assert rc == 1 and "unattributed" in recs[-1]["reason"]
+
+    # the ledger changing the streams it accounts FAILs
+    rc, recs = _gate(_cost_summary(off_on_identical=False) + "\n",
+                     tmp_path)
+    assert rc == 1 and "changed the system" in recs[-1]["reason"]
+
+    # double-billed chaos accounting FAILs
+    rc, recs = _gate(
+        _cost_summary(chaos_exactly_once=False,
+                      chaos_multi_terminal=["m3"]) + "\n", tmp_path)
+    assert rc == 1 and "exactly-once" in recs[-1]["reason"]
+
+    # an over-budget ledger tax (via the obs_overhead row) FAILs;
+    # combined verdict rides last
+    over = json.dumps({"bench": "obs_overhead", "device": "cpu",
+                       "noobs_wall_s": 1.0, "off_wall_s": 1.005,
+                       "overhead_off": 0.005,
+                       "overhead_ledger": 0.08})
+    rc, recs = _gate(_cost_summary() + "\n" + over + "\n", tmp_path)
+    assert rc == 1
+    assert any("ledger-on wall" in json.dumps(r) for r in recs)
+    # within budget it passes combined
+    over = json.dumps({"bench": "obs_overhead", "device": "cpu",
+                       "noobs_wall_s": 1.0, "off_wall_s": 1.005,
+                       "overhead_off": 0.005,
+                       "overhead_ledger": 0.01})
+    rc, recs = _gate(_cost_summary() + "\n" + over + "\n", tmp_path)
+    assert rc == 0 and recs[-1]["gate"] == "pass"
+
+    # no obs_cost rows at all -> graceful FAIL naming the arm
+    rc, recs = _gate(json.dumps({"bench": "obs_cost",
+                                 "arm": "off"}) + "\n", tmp_path)
+    assert rc == 1 and "--cost" in recs[-1]["reason"]

@@ -12,9 +12,9 @@ cannot silently ship a slower build. Three modes:
   python tools/bench_gate.py serving <fresh.jsonl> [--stamp]
   python tools/bench_gate.py obs <fresh.jsonl>
       # gate the OBSERVABILITY rows (tools/serving_workload_bench.py
-      # --obs-overhead / --trace-out / --slo). Three families, judged
-      # by whichever is present (all that are; combined verdict
-      # printed last):
+      # --obs-overhead / --trace-out / --slo / --cost). Four
+      # families, judged by whichever is present (all that are;
+      # combined verdict printed last):
       #  - obs_overhead: engine wall time with obs merged but tracing
       #    OFF must stay within 2% of the no-obs baseline arm measured
       #    in the same process — instrumentation has to be free when
@@ -28,6 +28,13 @@ cannot silently ship a slower build. Three modes:
       #    outputs/slot-logs/metrics untouched by the monitor, and
       #    (when the obs_overhead row carries a monitor arm) the
       #    monitor-on wall tax <= 2% over no-obs.
+      #  - obs_cost: the resource-attribution ledger must conserve
+      #    exactly (sum(attributed) + idle == elapsed per engine
+      #    book, page-turns == pool-occupancy integral), attribute
+      #    every unit, keep ledger-off/on streams identical, account
+      #    exactly once across the chaos crash+failover, and (when
+      #    the obs_overhead row carries a ledger arm) cost <= 2%
+      #    wall tax over no-obs.
       # gate the SERVING rows. Two canonical families, judged by
       # whichever is present (both when both are):
       #  - spec_vs_plain_compiled (tools/spec_decode_bench.py):
@@ -1935,6 +1942,90 @@ def check_obs_slo(rows: list) -> int:
     return 0 if rec["gate"] == "pass" else 1
 
 
+OBS_LEDGER_OVERHEAD_MAX = 0.02  # ledger-on tax allowed over no-obs
+
+
+def check_obs_cost(rows: list) -> int:
+    """Gate the obs_cost family (serving_workload_bench.py --cost):
+    the resource-attribution ledger must conserve EXACTLY on every
+    armed arm — per engine book ``sum(attributed) + idle == elapsed``
+    on the fixed virtual clock, per-request page-turns equal to the
+    per-turn pool-occupancy integral — attribute every priced unit
+    (zero unattributed), leave the off-arm token streams identical to
+    ledger-on (a bookkeeper that changes the books it keeps is
+    disqualified), and account EXACTLY ONCE across the chaos arm's
+    crash + failover (every served rid ledgered, at most one terminal
+    outcome per request). When the input also carries an obs_overhead
+    row with a ledger arm (``overhead_ledger``), that tax is gated
+    <= OBS_LEDGER_OVERHEAD_MAX alongside the tracing-off gate."""
+    rs = [r for r in rows if r.get("bench") == "obs_cost_summary"]
+    if not rs:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no obs_cost_summary row in input "
+                                    "(run tools/serving_workload_"
+                                    "bench.py --cost)"}))
+        return 1
+    r = rs[-1]
+    reasons = []
+    for arm in ("on", "chaos"):
+        if not r.get(f"{arm}_conserved_ok"):
+            reasons.append(f"{arm} arm broke unit conservation: "
+                           "sum(attributed) + idle != elapsed on "
+                           "some engine book")
+        if not r.get(f"{arm}_occupancy_ok"):
+            reasons.append(f"{arm} arm broke occupancy conservation: "
+                           "per-request page-turns != per-turn "
+                           "pool-occupancy integral")
+        if r.get(f"{arm}_unattributed_units", 1) != 0:
+            reasons.append(
+                f"{arm} arm left "
+                f"{r.get(f'{arm}_unattributed_units')} units "
+                "unattributed — every priced unit must carry an "
+                "owner")
+        if not r.get(f"{arm}_audit_ok"):
+            reasons.append(f"{arm} arm audit_ok is false")
+    if not r.get("off_on_identical"):
+        reasons.append("ledger-on token streams differ from "
+                       "ledger-off — the ledger changed the system "
+                       "it accounts")
+    if not r.get("chaos_exactly_once"):
+        reasons.append(
+            "chaos accounting not exactly-once: "
+            f"unledgered={r.get('chaos_unledgered')} "
+            f"multi_terminal={r.get('chaos_multi_terminal')}")
+    if not r.get("chaos_parity_ok"):
+        reasons.append("chaos completed-stream parity vs ledger-off "
+                       "failed — the failover replay diverged")
+    overhead_ledger = None
+    for o in rows:
+        if o.get("bench") == "obs_overhead" \
+                and o.get("overhead_ledger") is not None:
+            overhead_ledger = float(o["overhead_ledger"])
+    if overhead_ledger is not None \
+            and overhead_ledger > OBS_LEDGER_OVERHEAD_MAX:
+        reasons.append(f"ledger-on wall {overhead_ledger:.1%} over "
+                       f"the no-obs baseline (max "
+                       f"{OBS_LEDGER_OVERHEAD_MAX:.0%})")
+    rec = {
+        "gate": "pass" if not reasons else "FAIL",
+        "requests": r.get("requests"),
+        "conserved": bool(r.get("on_conserved_ok")
+                          and r.get("chaos_conserved_ok")),
+        "occupancy": bool(r.get("on_occupancy_ok")
+                          and r.get("chaos_occupancy_ok")),
+        "unattributed_units": r.get("on_unattributed_units"),
+        "off_on_identical": r.get("off_on_identical"),
+        "chaos_exactly_once": r.get("chaos_exactly_once"),
+        "chaos_parity_compared": r.get("chaos_parity_compared"),
+        "overhead_ledger": overhead_ledger,
+        "device": r.get("device", "?"),
+    }
+    if reasons:
+        rec["reason"] = "; ".join(reasons)
+    print(json.dumps(rec))
+    return 0 if rec["gate"] == "pass" else 1
+
+
 def check_obs(rows: list) -> int:
     """The obs gate: judge whichever observability families the input
     carries (all that are); several families present -> the
@@ -1947,13 +2038,16 @@ def check_obs(rows: list) -> int:
         fam_rcs["trace"] = check_obs_trace(rows)
     if any(r.get("bench", "").startswith("obs_slo") for r in rows):
         fam_rcs["slo"] = check_obs_slo(rows)
+    if any(r.get("bench", "").startswith("obs_cost") for r in rows):
+        fam_rcs["cost"] = check_obs_cost(rows)
     if not fam_rcs:
         print(json.dumps({"gate": "FAIL",
-                          "reason": "no obs_overhead, obs_trace or "
-                                    "obs_slo row in input (run tools/"
+                          "reason": "no obs_overhead, obs_trace, "
+                                    "obs_slo or obs_cost row in "
+                                    "input (run tools/"
                                     "serving_workload_bench.py "
-                                    "--obs-overhead, --trace-out or "
-                                    "--slo)"}))
+                                    "--obs-overhead, --trace-out, "
+                                    "--slo or --cost)"}))
         return 1
     if len(fam_rcs) == 1:
         return next(iter(fam_rcs.values()))

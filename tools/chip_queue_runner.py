@@ -215,6 +215,15 @@ QUEUE = [
     ("obs_slo",
      [sys.executable, "tools/serving_workload_bench.py", "--slo"],
      {}),
+    # PR-19 addition: the resource-attribution arm — the 10^5-request
+    # cluster trace with the cost ledger off / on / on-under-chaos;
+    # bench_gate.py obs gates the obs_cost family (conservation audit
+    # exact, zero unattributed units, off/on streams identical, chaos
+    # exactly-once accounting, ledger tax <= 2% via the obs_overhead
+    # row)
+    ("obs_cost",
+     [sys.executable, "tools/serving_workload_bench.py", "--cost"],
+     {}),
     # ONE bench run per window, wrapped by the regression gate (round-4
     # verdict item 8), last so PERF_LAST_TPU.json stamps this HEAD: the
     # gate snapshots the baseline, runs bench.py, fails on >5% legacy-

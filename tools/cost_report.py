@@ -1,0 +1,214 @@
+"""Resource-attribution report over a cost-ledger JSONL
+(``CostLedger.save_costs`` / ``ClusterResult.save_costs``).
+
+The accounting companion to ``trace_report.py`` / ``slo_report.py``:
+where those tools summarize what the engine DID (spans) and what the
+watchdog CONCLUDED (incidents), this one summarizes what the serving
+fleet's capacity was SPENT ON —
+
+- **per-tenant table**: virtual-clock units and resource page-turns
+  attributed to each tenant (the chargeback view);
+- **per-feature table**: the same units cut by serving feature
+  (``base`` / ``lora`` / ``grammar`` / ``spec`` / ``hostmem`` /
+  ``disagg`` / ...) — a PARTITION of the attributed total, so the
+  column sums to it exactly;
+- **top-N expensive requests**: the rids that ate the most units,
+  with their kind breakdown and outcome path (a failed-over request
+  shows its retry/transfer path inline);
+- **estimator calibration**: admission-time scheduler estimates vs
+  ledger-actual units per request (QoS runs only — FIFO ledgers have
+  no estimates and the section is omitted), with the mean
+  actual/estimate ratio the headroom knob should be tuned against;
+- the **conservation audit**: the global row's exactness flags —
+  ``sum(attributed) + idle == elapsed`` per engine book and
+  per-request page-turns == per-turn pool-occupancy integral.
+
+``--json`` emits machine-readable rows (tenant/feature/top/
+calibration, the global ``cost_report`` row LAST — the shared report
+convention) for ``bench_gate.py`` or ad-hoc scripting.
+
+Run:  python tools/cost_report.py costs.jsonl
+      python tools/cost_report.py costs.jsonl --top 5
+      python tools/cost_report.py costs.jsonl --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def split_rows(rows: list) -> dict:
+    """Bucket a ``load_costs`` row list by its ``row`` tag."""
+    out: dict = {"request": [], "tenant": [], "feature": [],
+                 "engine": [], "global": []}
+    for r in rows:
+        out.setdefault(r.get("row", "?"), []).append(r)
+    return out
+
+
+def tenant_rows(buckets: dict) -> list:
+    return [{"bench": "cost_report_tenant", "tenant": r["tenant"],
+             "requests": r.get("requests", 0),
+             "cost_units": r.get("cost_units", 0.0),
+             "page_turns": r.get("page_turns", 0.0)}
+            for r in sorted(buckets["tenant"],
+                            key=lambda r: (-r.get("cost_units", 0.0),
+                                           str(r["tenant"])))]
+
+
+def feature_rows(buckets: dict) -> list:
+    return [{"bench": "cost_report_feature", "feature": r["feature"],
+             "cost_units": r.get("cost_units", 0.0)}
+            for r in sorted(buckets["feature"],
+                            key=lambda r: (-r.get("cost_units", 0.0),
+                                           str(r["feature"])))]
+
+
+def top_requests(buckets: dict, top: int) -> list:
+    reqs = sorted(buckets["request"],
+                  key=lambda r: (-r.get("total_units", 0.0),
+                                 r["rid"]))
+    return [{"bench": "cost_report_top", "rank": i + 1,
+             "rid": r["rid"], "tenant": r.get("tenant"),
+             "total_units": r.get("total_units", 0.0),
+             "units": r.get("units", {}),
+             "page_turns": r.get("page_turns", {}),
+             "features": r.get("features", []),
+             "outcomes": r.get("outcomes", [])}
+            for i, r in enumerate(reqs[:top])]
+
+
+def calibration_row(buckets: dict) -> dict | None:
+    """Estimator-priced vs ledger-actual units, over every request
+    that carries an admission estimate (``est_units`` rides the
+    request row only for QoS-scheduled runs with a ledger armed).
+    None when no estimates exist — FIFO ledgers keep their report
+    output byte-identical without the section."""
+    pairs = [(r["est_units"], r.get("total_units", 0.0))
+             for r in buckets["request"] if "est_units" in r]
+    if not pairs:
+        return None
+    ratios = sorted(a / e for e, a in pairs if e > 0)
+    n = len(ratios)
+    over = sum(1 for e, a in pairs if a > e)
+    return {"bench": "cost_report_calibration",
+            "estimated_requests": len(pairs),
+            "est_units": round(sum(e for e, _ in pairs), 9),
+            "actual_units": round(sum(a for _, a in pairs), 9),
+            "mean_ratio": round(sum(ratios) / n, 4) if n else None,
+            "p50_ratio": round(ratios[n // 2], 4) if n else None,
+            "over_estimate": over,
+            "under_estimate": len(pairs) - over}
+
+
+def global_row(buckets: dict) -> dict:
+    g = buckets["global"][0] if buckets["global"] else {}
+    return {"bench": "cost_report",
+            "requests": g.get("requests",
+                              len(buckets["request"])),
+            "tenants": len(buckets["tenant"]),
+            "features": len(buckets["feature"]),
+            "engines": len(buckets["engine"]),
+            "cost_units": g.get("cost_units"),
+            "conserved_ok": g.get("conserved_ok"),
+            "occupancy_ok": g.get("occupancy_ok"),
+            "unattributed_units": g.get("unattributed_units"),
+            "ok": g.get("ok")}
+
+
+def render_text(buckets: dict, top: int):
+    g = global_row(buckets)
+    print(f"# cost ledger: {g['requests']} requests, "
+          f"{g['cost_units']} units attributed across "
+          f"{g['engines']} engine books")
+    print(f"  conservation: conserved_ok={g['conserved_ok']} "
+          f"occupancy_ok={g['occupancy_ok']} "
+          f"unattributed={g['unattributed_units']}")
+    print()
+    print("# per-tenant")
+    hdr = f"{'tenant':16} {'requests':>8} {'cost_units':>14} " \
+          f"{'page_turns':>14}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in tenant_rows(buckets):
+        print(f"{str(r['tenant']):16} {r['requests']:>8} "
+              f"{r['cost_units']:>14} {r['page_turns']:>14}")
+    print()
+    print("# per-feature (partitions the attributed total)")
+    for r in feature_rows(buckets):
+        print(f"  {r['feature']:12} {r['cost_units']:>14}")
+    print()
+    print(f"# top-{top} expensive requests")
+    for r in top_requests(buckets, top):
+        kinds = " ".join(f"{k}={v}" for k, v
+                         in sorted(r["units"].items()))
+        path = ">".join(r["outcomes"]) if r["outcomes"] else "-"
+        print(f"  #{r['rank']:<3} {r['rid']:20} "
+              f"tenant={str(r['tenant']):8} "
+              f"units={r['total_units']:<10} [{kinds}] {path}")
+    cal = calibration_row(buckets)
+    if cal is not None:
+        # QoS-scheduled ledgers only: FIFO reports render
+        # byte-identically without the section
+        print()
+        print(f"# estimator calibration ({cal['estimated_requests']} "
+              "estimated requests)")
+        print(f"  est={cal['est_units']} actual={cal['actual_units']} "
+              f"mean actual/est={cal['mean_ratio']} "
+              f"p50={cal['p50_ratio']} "
+              f"(over={cal['over_estimate']} "
+              f"under={cal['under_estimate']})")
+    print()
+    print("# per-engine books")
+    for r in sorted(buckets["engine"],
+                    key=lambda r: str(r.get("engine"))):
+        print(f"  {str(r.get('engine')):10} "
+              f"elapsed={r.get('elapsed_units')} "
+              f"idle={r.get('idle_units')} "
+              f"attributed={r.get('attributed_units')} "
+              f"conserved_ok={r.get('conserved_ok')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("costs", help="cost JSONL "
+                    "(CostLedger.save_costs output)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="expensive-request rows to show")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable rows (global row LAST)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs.ledger import load_costs
+    try:
+        rows = load_costs(args.costs)
+    except (OSError, json.JSONDecodeError) as e:
+        print(json.dumps({"bench": "cost_report", "error": str(e)}))
+        return 1
+    buckets = split_rows(rows)
+    if args.json:
+        for r in tenant_rows(buckets):
+            print(json.dumps(r), flush=True)
+        for r in feature_rows(buckets):
+            print(json.dumps(r), flush=True)
+        for r in top_requests(buckets, args.top):
+            print(json.dumps(r), flush=True)
+        cal = calibration_row(buckets)
+        if cal is not None:
+            # QoS-scheduled ledgers only: absent otherwise, so FIFO
+            # --json output keeps its row set exactly
+            print(json.dumps(cal), flush=True)
+        # the global row stays LAST (consumers read the final line)
+        print(json.dumps(global_row(buckets)), flush=True)
+    else:
+        render_text(buckets, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

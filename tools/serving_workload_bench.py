@@ -101,6 +101,14 @@ The observability arms (PR 4):
   production default), (c) a live tracer; min-of-repeats wall per arm
   lands in one ``obs_overhead`` row. ``bench_gate.py obs`` gates
   (b) <= 2% over (a).
+- ``--cost`` (PR 19) replays the ~10^5-request sim cluster trace with
+  the resource-attribution ledger off / on / on-under-chaos: one
+  ``obs_cost`` row per arm plus an ``obs_cost_summary``.
+  ``bench_gate.py obs`` gates the obs_cost family: the conservation
+  audit exact (sum(attributed) + idle == elapsed per engine book,
+  page-turns == pool-occupancy integral), zero unattributed units,
+  off/on streams identical, chaos exactly-once accounting, and (from
+  the ``--obs-overhead`` row) ledger tax <= 2%.
 
 Run:  python tools/serving_workload_bench.py --cpu
       python tools/serving_workload_bench.py --cpu --save-trace t.jsonl
@@ -1913,6 +1921,135 @@ def _chaos_arm(args):
     return 0
 
 
+def _cost_arm(args):
+    """The resource-attribution arm: the SAME ~10^5-request sim
+    cluster trace as --cluster, replayed three times through
+    prefix_aware placement —
+
+    1. ledger OFF              (the byte-identity reference)
+    2. ledger ON               (conservation at scale)
+    3. ledger ON under a seeded crash + heartbeat failover
+                               (exactly-once accounting across moves)
+
+    One `obs_cost` row per arm plus an `obs_cost_summary`;
+    `bench_gate.py obs` gates the obs_cost family: the conservation
+    audit exact on every armed arm (sum(attributed) + idle == elapsed
+    per engine book AND page-turns == pool-occupancy integral), zero
+    unattributed units, off/on token streams identical, and chaos
+    exactly-once (every served rid ledgered, at most one terminal
+    outcome per request)."""
+    import json as _json
+    import time as _time
+
+    from paddle_tpu.serving import (ClusterRouter, FailoverConfig,
+                                    synthesize_fault_plan)
+
+    env = _sim_cluster_env(args)
+    N, trace, stats = env["N"], env["trace"], env["stats"]
+    spawn, weights = env["spawn"], env["weights"]
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    span = trace[-1].arrival - trace[0].arrival
+    # crash-only plan: stalls/decode-errors exercise the same failover
+    # path but muddy the exactly-once evidence with retry noise
+    plan = synthesize_fault_plan(
+        seed=args.seed, replicas=[f"r{i}" for i in range(N)],
+        span=span, n_crashes=1, n_stalls=0, n_decode_errors=0)
+    cfg = FailoverConfig()
+
+    # outcomes that MOVE a request's open account between books
+    # rather than closing it — everything else is terminal and must
+    # appear at most once per rid (the exactly-once invariant)
+    moves = {"failover", "requeued", "handoff"}
+
+    rows = {}
+    outs = {}
+    results = {}
+    walls = {}
+    for arm, armed, faults in (("off", False, None),
+                               ("on", True, None),
+                               ("chaos", True, plan)):
+        t0 = _time.perf_counter()
+        res = ClusterRouter(spawn, N, placement="prefix_aware",
+                            cost_ledger=True if armed else None,
+                            faults=faults,
+                            failover=cfg if faults is not None
+                            else None).run(trace)
+        walls[arm] = _time.perf_counter() - t0
+        results[arm] = res
+        outs[arm] = res.outputs()
+        rep = res.report(tenant_weights=weights)
+        rec = {"bench": "obs_cost", "arm": arm, "device": "sim",
+               "seed": args.seed, "replicas": N,
+               "requests": env["n_req"], "ledger": armed,
+               "completed": rep.get("completed"),
+               "wall_s": round(walls[arm], 3)}
+        if armed:
+            ru = res.cost_rollup
+            rec["ledgered_requests"] = ru["requests"]
+            rec["tenants"] = len(ru["tenants"])
+            rec["cost_units"] = round(
+                sum(t["cost_units"] for t in ru["tenants"].values()),
+                9)
+            rec["features"] = {f: round(u, 9) for f, u
+                               in sorted(ru["features"].items())}
+            rec["conserved_ok"] = ru["conserved_ok"]
+            rec["occupancy_ok"] = ru["occupancy_ok"]
+            rec["unattributed_units"] = ru["unattributed_units"]
+            rec["audit_ok"] = ru["ok"]
+        rec["trace"] = stats
+        rows[arm] = rec
+        emit(rec)
+
+    if args.cost_out:
+        # the armed fault-free ledger is the cost_report.py exemplar
+        results["on"].save_costs(args.cost_out)
+
+    # exactly-once under chaos: every rid that produced tokens holds
+    # exactly one account, and that account records at most ONE
+    # terminal outcome — a double-billed failover shows up here as a
+    # second "completed" (or a move with no terminal at all leaves
+    # the account open, caught by the unledgered check)
+    led = results["chaos"].cost_ledger
+    unledgered = [rid for rid in sorted(outs["chaos"])
+                  if rid not in led._accounts]
+    multi_terminal = []
+    for rid, acct in sorted(led._accounts.items()):
+        term = [o for o in acct.get("outcomes", ()) if o not in moves]
+        if len(term) > 1:
+            multi_terminal.append(rid)
+    parity, compared, full_eq = _stream_parity(outs["chaos"],
+                                               outs["off"])
+    on, ch = rows["on"], rows["chaos"]
+    emit({"bench": "obs_cost_summary", "device": "sim",
+          "seed": args.seed, "replicas": N, "requests": env["n_req"],
+          "off_on_identical": bool(outs["off"] == outs["on"]),
+          "on_audit_ok": on["audit_ok"],
+          "on_conserved_ok": on["conserved_ok"],
+          "on_occupancy_ok": on["occupancy_ok"],
+          "on_unattributed_units": on["unattributed_units"],
+          "chaos_audit_ok": ch["audit_ok"],
+          "chaos_conserved_ok": ch["conserved_ok"],
+          "chaos_occupancy_ok": ch["occupancy_ok"],
+          "chaos_unattributed_units": ch["unattributed_units"],
+          "chaos_exactly_once": not unledgered and not multi_terminal,
+          "chaos_unledgered": unledgered[:5],
+          "chaos_multi_terminal": multi_terminal[:5],
+          "chaos_parity_ok": bool(parity),
+          "chaos_parity_compared": compared,
+          "chaos_parity_full_equal": full_eq,
+          "off_wall_s": round(walls["off"], 3),
+          "on_wall_s": round(walls["on"], 3),
+          "chaos_wall_s": round(walls["chaos"], 3),
+          # informational only: the gated <=2% bound comes from the
+          # interleaved --obs-overhead arm, not this single pass
+          "ledger_wall_ratio": round(walls["on"] / walls["off"], 4)
+          if walls["off"] else None})
+    return 0
+
+
 def _autoscale_arm(args):
     """The elastic-autoscaling arm: the detect->act loop measured on
     the two workload shapes static provisioning handles worst —
@@ -2471,6 +2608,17 @@ def main(argv=None):
                          "gates capacity >= 3x, the round-2 TTFT "
                          "transfer margin, zero diverged streams, "
                          "shed rate strictly below, both censuses)")
+    ap.add_argument("--cost", action="store_true",
+                    help="run the resource-attribution arm instead: "
+                         "the 10^5-request sim cluster trace with the "
+                         "cost ledger off / on / on-under-chaos "
+                         "(bench_gate.py obs gates the obs_cost "
+                         "family: conservation exact, zero "
+                         "unattributed units, off/on identity, chaos "
+                         "exactly-once accounting)")
+    ap.add_argument("--cost-out", type=str, default=None,
+                    help="cost arm: save the armed fault-free "
+                         "ledger's JSONL (cost_report.py input)")
     ap.add_argument("--obs-overhead", action="store_true",
                     help="run the obs-overhead arm instead: no-obs vs "
                          "tracing-off vs tracing-on wall time on one "
@@ -2516,6 +2664,8 @@ def main(argv=None):
         return _cluster_arm(args)
     if args.chaos:
         return _chaos_arm(args)
+    if args.cost:
+        return _cost_arm(args)
     if args.disagg:
         return _disagg_arm(args)
     if args.ragged:
@@ -2605,6 +2755,9 @@ def main(argv=None):
             "slo": ServingEngine(serving=srv, slots=slots,
                                  policy="paged", clock="fixed",
                                  slo=obs.default_serving_rules()),
+            "ledger": ServingEngine(serving=srv, slots=slots,
+                                    policy="paged", clock="fixed",
+                                    ledger=True),
         }
         engines["off"].run(trace)  # warm every program shape
         R = max(1, args.obs_repeats)
@@ -2623,9 +2776,9 @@ def main(argv=None):
                     tokens[name] = res.report()["generated_tokens"]
         finally:
             obs.REGISTRY.enable()
-        noobs, off, on, slo_w = (min(walls[k])
-                                 for k in ("noobs", "off", "on",
-                                           "slo"))
+        noobs, off, on, slo_w, led_w = (
+            min(walls[k])
+            for k in ("noobs", "off", "on", "slo", "ledger"))
         row = {
             "bench": "obs_overhead", "device": device,
             "seed": args.seed, "policy": "paged", "clock": "fixed",
@@ -2636,9 +2789,11 @@ def main(argv=None):
             "off_wall_s": round(off, 6),
             "on_wall_s": round(on, 6),
             "slo_wall_s": round(slo_w, 6),
+            "ledger_wall_s": round(led_w, 6),
             "overhead_off": round(off / noobs - 1.0, 6),
             "overhead_on": round(on / noobs - 1.0, 6),
             "overhead_slo": round(slo_w / noobs - 1.0, 6),
+            "overhead_ledger": round(led_w / noobs - 1.0, 6),
             "trace_events": len(tracer),
         }
         print(json.dumps(row), flush=True)

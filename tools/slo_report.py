@@ -14,6 +14,11 @@ watchdog CONCLUDED (incidents) —
 - ``--bundles DIR``: cross-check the flight-recorder bundles — every
   incident id with a bundle directory is validated for the four bundle
   files (a missing ``metrics.jsonl`` means the recorder never froze);
+- ``--costs FILE``: join a cost-ledger JSONL
+  (``CostLedger.save_costs``) — every incident whose implicated rids
+  map to ledgered tenants gains those tenants' cost snapshot (units +
+  page-turns), the "who was burning capacity when this fired" view.
+  Absent without the flag, so pre-ledger reports are byte-identical;
 - the ACTION timeline (autoscaled runs only): every incident the
   control plane resolved (resolution ``action_taken``), with the
   action that closed it and the detect->act latency — the
@@ -120,6 +125,36 @@ def action_timeline(incidents) -> list:
     return out
 
 
+def cost_snapshots(incidents, cost_rows) -> list:
+    """Per-incident tenant cost snapshots (``--costs`` only): each
+    incident's implicated rids are mapped through the ledger's
+    request rows to their tenants, and those tenants' ledger rows
+    ride along — so the postmortem reader sees the offending
+    tenant's attributed spend next to the alert it tripped. Incidents
+    whose rids never ledgered (or that carry no rids at all) yield no
+    row."""
+    req = {r["rid"]: r for r in cost_rows
+           if r.get("row") == "request"}
+    ten = {r["tenant"]: r for r in cost_rows
+           if r.get("row") == "tenant"}
+    out = []
+    for inc in incidents:
+        tenants = sorted({req[rid].get("tenant") for rid in inc.rids
+                          if rid in req
+                          and req[rid].get("tenant") is not None})
+        if not tenants:
+            continue
+        out.append({
+            "bench": "slo_report_cost", "id": inc.id,
+            "rule": inc.rule, "source": inc.source,
+            "tenants": {
+                t: {"cost_units": ten[t].get("cost_units"),
+                    "page_turns": ten[t].get("page_turns"),
+                    "requests": ten[t].get("requests")}
+                for t in tenants if t in ten}})
+    return out
+
+
 def global_row(incidents, bundle_checks=None) -> dict:
     by_kind: dict = {}
     by_sev: dict = {}
@@ -162,7 +197,8 @@ def _fmt_evidence(inc) -> str:
                     if not isinstance(v, (list, dict)))[:60]
 
 
-def render_text(incidents, rules, bundle_checks=None):
+def render_text(incidents, rules, bundle_checks=None,
+                cost_snaps=None):
     print(f"# incident timeline ({len(incidents)} incidents)")
     hdr = (f"{'id':10} {'t_open':>12} {'t_close':>12} {'sev':5} "
            f"{'source':10} {'rule':18} resolution/evidence")
@@ -199,6 +235,18 @@ def render_text(incidents, rules, bundle_checks=None):
                   f"t_open={a['t_open']:<12.3f} "
                   f"latency={a['latency'] if a['latency'] is not None else '?':<10} "
                   f"-> {a['action']}")
+    if cost_snaps:
+        # --costs joins only: pre-ledger reports render
+        # byte-identically without the section
+        print()
+        print(f"# tenant cost snapshots ({len(cost_snaps)} incidents "
+              "with ledgered tenants)")
+        for s in cost_snaps:
+            parts = " ".join(
+                f"{t}: units={v['cost_units']} "
+                f"page_turns={v['page_turns']}"
+                for t, v in s["tenants"].items())
+            print(f"  {s['id']:10} {s['rule']:18} {parts}")
     if bundle_checks is not None:
         print()
         complete = sum(1 for b in bundle_checks if b["complete"])
@@ -216,12 +264,22 @@ def main(argv=None) -> int:
     ap.add_argument("--bundles", type=str, default=None,
                     help="flight-recorder bundle root: validate each "
                          "incident's bundle directory")
+    ap.add_argument("--costs", type=str, default=None,
+                    help="cost-ledger JSONL (CostLedger.save_costs): "
+                         "attach offending tenants' cost snapshots "
+                         "to incident rows")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable rows (global row LAST)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.obs.slo import load_incidents
     incidents = load_incidents(args.incidents)
+
+    cost_snaps = None
+    if args.costs is not None:
+        from paddle_tpu.obs.ledger import load_costs
+        cost_snaps = cost_snapshots(incidents,
+                                    load_costs(args.costs))
 
     bundle_checks = None
     if args.bundles is not None:
@@ -244,11 +302,15 @@ def main(argv=None) -> int:
             # --json output is byte-identical
             print(json.dumps({"bench": "slo_report_action", **a}),
                   flush=True)
+        for s in cost_snaps or ():
+            # --costs joins only: absent otherwise, so pre-ledger
+            # --json output is byte-identical (global row still LAST)
+            print(json.dumps(s), flush=True)
         # the global row stays LAST (consumers read the final line)
         print(json.dumps(global_row(incidents, bundle_checks)),
               flush=True)
     else:
-        render_text(incidents, rules, bundle_checks)
+        render_text(incidents, rules, bundle_checks, cost_snaps)
     return 0
 
 

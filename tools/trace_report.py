@@ -530,6 +530,39 @@ def ahead_summary(events: list) -> dict | None:
             "ahead_frac": round(len(served) / len(dec), 4)}
 
 
+def cost_summary(events: list) -> dict | None:
+    """Cost-ledger evidence: the engine's run-end ``cost`` instants
+    (``ServingEngine(ledger=...)`` runs only — one per engine book,
+    carrying elapsed/idle/attributed unit totals and both
+    conservation-audit flags). Returns the ``trace_report_cost`` row,
+    or None for un-armed traces — whose report output stays
+    byte-identical to pre-ledger."""
+    insts = [e for e in events if e.get("ph") == "i"
+             and e.get("name") == "cost"]
+    if not insts:
+        return None
+    engines: dict = {}
+    for e in insts:
+        a = e.get("args", {})
+        engines[str(a.get("engine"))] = {
+            k: a.get(k) for k in ("elapsed_units", "idle_units",
+                                  "attributed_units", "page_turns",
+                                  "conserved_ok", "occupancy_ok")}
+    return {"bench": "trace_report_cost",
+            "engines": len(engines),
+            "attributed_units": round(sum(
+                float(v.get("attributed_units") or 0.0)
+                for v in engines.values()), 9),
+            "idle_units": round(sum(
+                float(v.get("idle_units") or 0.0)
+                for v in engines.values()), 9),
+            "conserved_ok": all(bool(v.get("conserved_ok"))
+                                for v in engines.values()),
+            "occupancy_ok": all(bool(v.get("occupancy_ok"))
+                                for v in engines.values()),
+            "by_engine": dict(sorted(engines.items()))}
+
+
 def recompiles(events: list) -> list:
     return sorted(
         ({"site": e.get("args", {}).get(
@@ -833,6 +866,19 @@ def report(events: list, width: int = 50, top: int = 10) -> str:
                 lines.append(f"  t={s['out'] / 1e6:.4f}s "
                              f"{rid:20s} out {s['pages']} pages"
                              f"{back}")
+    co = cost_summary(events)
+    if co is not None:
+        # only ledger-armed traces grow this section — pre-ledger
+        # traces render byte-identically
+        lines.append(f"\n== cost ledger ({co['engines']} engine "
+                     f"books, {co['attributed_units']} units "
+                     f"attributed, conserved_ok={co['conserved_ok']} "
+                     f"occupancy_ok={co['occupancy_ok']}) ==")
+        for name, v in co["by_engine"].items():
+            lines.append(f"  {name:10s} "
+                         f"elapsed={v.get('elapsed_units')} "
+                         f"idle={v.get('idle_units')} "
+                         f"attributed={v.get('attributed_units')}")
     acts = autoscale_actions(events)
     if acts:
         # only autoscaled traces grow this section — pre-autoscale
@@ -926,6 +972,12 @@ def main(argv=None) -> int:
             # hostmem traces only: absent otherwise, so pre-hostmem
             # --json output is byte-identical (global row still LAST)
             print(json.dumps(hm_row))
+        co_row = cost_summary(events)
+        if co_row is not None:
+            # ledger-armed traces only: absent otherwise, so
+            # pre-ledger --json output is byte-identical (global row
+            # still LAST)
+            print(json.dumps(co_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
             print(json.dumps({
