@@ -735,6 +735,79 @@ def import_quant_pages(pools, page_ids, data):
             tier.at[idx].set(td))
 
 
+# --- heterogeneous-handoff transforms (reshard-on-import) -------------------
+
+def repage_kv_data(data, page_size_from: int, page_size_to: int,
+                   n_tokens: int):
+    """Re-page an exported KV chain across page geometries: every leaf
+    is ``(L, Hkv, n_pages, page_size, *tail)`` (page content ``tail =
+    (hd,)``; the int8 scale leaves' ``tail = ()``), tokens packed
+    contiguously in chain order — so the transform is flatten the slot
+    axis, keep the ``n_tokens`` real positions, pad to the destination
+    chain's slot count, refold. Pad slots sit beyond the row's length
+    like the slack of a directly-prefilled last page: data slots pad 0,
+    per-slot scale leaves pad 1 (the pool-init scale, so the adopted
+    chain is indistinguishable from one written in place). PRESSURE
+    chains never reach here — their per-page tier bits have no
+    token-resolution meaning, so ``handoff_steps`` refuses the pairing
+    upstream."""
+    n_to = -(-n_tokens // page_size_to)
+
+    def one(a):
+        a = np.asarray(a)
+        L, H, n, ps = a.shape[:4]
+        tail = a.shape[4:]
+        if n * ps < n_tokens:
+            raise ValueError(
+                f"repage: chain carries {n}x{ps} slots but claims "
+                f"{n_tokens} tokens")
+        flat = a.reshape(L, H, n * ps, *tail)[:, :, :n_tokens]
+        pad = n_to * page_size_to - n_tokens
+        if pad:
+            fill = np.ones if len(tail) == 0 else np.zeros
+            flat = np.concatenate(
+                [flat, fill((L, H, pad) + tail, a.dtype)], axis=2)
+        return flat.reshape(L, H, n_to, page_size_to, *tail)
+
+    return jax.tree_util.tree_map(one, data)
+
+
+def transcode_kv_data(data, quant_from, quant_to):
+    """Transcode an exported FULL-PRECISION chain ``(k, v)`` into the
+    destination codec. Runs the SAME ``_q8`` per-slot absmax codec the
+    destination's own write path uses (``_cache_write``), so a
+    transcoded page is bit-identical to the page a direct int8 engine
+    would have written from the same K/V values.
+
+    - ``'int8'``: ``((k_int8, k_scale), (v_int8, v_scale))`` — scales
+      stamped per slot over head_dim, the int8 pool leaf structure.
+    - ``'pressure'``: both arenas plus an ALL-SET tier mask — the
+      imported chain lands parked in the int8 tier (that is what the
+      priced transcode bought; the caller mirrors the positions into
+      ``quant_pages`` so the importer's byte census prices it), and the
+      fp arena keeps the exact source values so a later rewrite/tier
+      clear reads them back.
+
+    Quantized sources do not transcode: int8 cannot recover precision
+    (→ fp refused) and carries no tier bits (→ pressure refused);
+    ``handoff_steps`` refuses those pairings before data ever moves."""
+    if quant_from is not None:
+        raise ValueError(
+            f"transcode: source codec {quant_from!r} is not "
+            "transcodable (only full-precision chains re-encode)")
+    k, v = data
+    k, v = jnp.asarray(k), jnp.asarray(v)
+    if quant_to == "int8":
+        return _q8(k), _q8(v)
+    if quant_to == "pressure":
+        kq, ks = _q8(k)
+        vq, vs = _q8(v)
+        tier = jnp.ones((k.shape[2],), bool)
+        return (k, kq, ks), (v, vq, vs), tier
+    raise ValueError(f"transcode: unknown destination codec "
+                     f"{quant_to!r}")
+
+
 def shard_decode_params(outer, layers, tp: TPConfig):
     """Place decode weights on the TP mesh ONCE at load: layer
     projections per ``tp_layer_specs``, outer params (embeddings,

@@ -67,6 +67,12 @@ KIND_FEATURE = {
     "kv_pageout": "hostmem",
     "kv_pagein": "hostmem",
     "kv_transfer": "disagg",
+    # the heterogeneous-handoff transform steps (reshard-on-import):
+    # distinct attributable kinds, one feature — the disagg machinery
+    # priced them, whichever axis mismatched
+    "kv_reshard": "disagg",
+    "kv_repage": "disagg",
+    "kv_transcode": "disagg",
 }
 
 # non-request owners a charge/occupancy entry may carry: engine-owned

@@ -57,7 +57,8 @@ from .grammar import (CompiledGrammar, GrammarCache,  # noqa: F401
 from .engine import (DecodeError, EngineClock,  # noqa: F401
                      EngineSession, FixedPolicy, KVHandoff, Policy,
                      RoutedPolicy, ServeResult, ServingEngine,
-                     load_engine_log, make_policy)
+                     UnstampedHandoffError, load_engine_log,
+                     make_policy)
 from .faults import (FailoverConfig, FaultEvent,  # noqa: F401
                      FaultPlan, synthesize_fault_plan)
 from .hostmem import (HostArena, HostMemConfig,  # noqa: F401

@@ -15,7 +15,15 @@ peak:
   "sustained" filter), a cold replica from the standby pool joins the
   shared virtual timeline. The triggering incidents close with
   resolution ``action_taken`` (``Incident.act``), stamping WHICH
-  action resolved them into the postmortem evidence.
+  action resolved them into the postmortem evidence. The standby
+  need NOT be a twin of the fleet it joins: since handoff placement
+  scores tp-degree / page-geometry / codec mismatches by priced
+  reshard cost instead of filtering them out, a
+  compatible-but-unequal standby (say a tp=1 int8 decode box behind
+  tp=2 fp prefill workers) is a legal join target — its imports pay
+  the ``kv_reshard``/``kv_repage``/``kv_transcode`` spans on its own
+  clock, which is the autoscaler's capacity-vs-transform-price
+  trade, not a refusal.
 - **scale down** (drain): when the budget has recovered (no open
   scale/degrade incidents) and cluster decode-slot utilization stays
   below ``drain_below`` for ``drain_sustain`` units, the idlest

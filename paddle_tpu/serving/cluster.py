@@ -67,7 +67,7 @@ from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from .autoscale import Autoscaler
 from .engine import (EngineSession, KVHandoff, ServeResult,
-                     ServingEngine)
+                     ServingEngine, UnstampedHandoffError)
 from .faults import (FAULT_SEVERITY, FailoverConfig, FaultEvent,
                      FaultPlan)
 from .metrics import _pct, goodput_tokens, jain_fairness
@@ -162,25 +162,38 @@ class PrefixAwarePlacement(PlacementPolicy):
                 return best_all  # replicate the hot adapter there
         probes = [(rep.session.match_prefix(r.prompt), rep)
                   for rep in replicas]
-        best = max(p for p, _ in probes)
-        thr = self.threshold if self.threshold is not None \
-            else replicas[0].session.eng.page_size
-        if best >= thr:
-            return _least_loaded([rep for p, rep in probes
+        # the default threshold is each replica's OWN page geometry (a
+        # pool publishes prefixes in its own page multiples) — the old
+        # replicas[0] fallback silently mis-thresholded every other
+        # member of a heterogeneous fleet; homogeneous fleets score
+        # identically
+        hits = [(p, rep) for p, rep in probes
+                if p >= (self.threshold if self.threshold is not None
+                         else rep.session.eng.page_size)]
+        if hits:
+            best = max(p for p, _ in hits)
+            return _least_loaded([rep for p, rep in hits
                                   if p == best])
         return _least_loaded(replicas)
 
 
-def _place_decode(h: KVHandoff, replicas) -> Optional["_Replica"]:
-    """The decode stage's default placement: the decode-capable
-    replica with the MOST open decode slots (slot availability is the
-    decode lane's scarce resource; load then creation order break
-    ties). None when no candidate is decode-capable."""
+def _place_decode(h: KVHandoff, replicas,
+                  prices=None) -> Optional["_Replica"]:
+    """The decode stage's default placement: the CHEAPEST-to-import
+    decode-capable replica (``prices`` maps replica name → priced
+    reshard/repage/transcode cost on the virtual clock; a twin — same
+    tp/geometry/codec — prices 0.0, so homogeneous fleets keep the
+    pre-hetero order exactly), then the most open decode slots (slot
+    availability is the decode lane's scarce resource; load then
+    creation order break ties). None when no candidate is
+    decode-capable."""
     cands = [rep for rep in replicas
              if rep.role in ("decode", "both")]
     if not cands:
         return None
-    return min(cands, key=lambda rep: (-rep.session.free_slot_count(),
+    pr = prices or {}
+    return min(cands, key=lambda rep: (pr.get(rep.name, 0.0),
+                                       -rep.session.free_slot_count(),
                                        rep.session.load(), rep.index))
 
 
@@ -216,8 +229,8 @@ class DisaggregatedPlacement(PlacementPolicy):
         return min(cands, key=score)
 
     @staticmethod
-    def place_decode(h: KVHandoff, replicas):
-        return _place_decode(h, replicas)
+    def place_decode(h: KVHandoff, replicas, prices=None):
+        return _place_decode(h, replicas, prices)
 
 
 def make_placement(spec, threshold: Optional[int] = None) \
@@ -683,6 +696,10 @@ class ClusterRouter:
         self.kv_transfer_unit = float(kv_transfer_unit)
         self._handoff = {"exported": 0, "imported": 0,
                          "reclaimed": 0, "failed": 0}
+        # per-axis count of handoffs TRANSFORMED on import (tp /
+        # page / codec); stays empty — and absent from results — on
+        # homogeneous fleets
+        self._resharded: Dict[str, int] = {}
         # --- SLO watchdog (inert without slo=) ----------------------
         # slo: a sequence of obs.slo rules (may be EMPTY — fault
         # events and heartbeats still auto-open/feed incidents). The
@@ -959,6 +976,9 @@ class ClusterRouter:
         self._handoff["imported"] += sess.handoff_stats["imported"]
         self._handoff["reclaimed"] += sess.handoff_stats["reclaimed"]
         sess.handoff_stats = {"imported": 0, "reclaimed": 0}
+        for axis, n in sess.handoff_resharded.items():
+            self._resharded[axis] = self._resharded.get(axis, 0) + n
+        sess.handoff_resharded = {}
 
     def _collect_handoffs(self):
         """Drain every session's handoff bank and place each exported
@@ -967,15 +987,20 @@ class ClusterRouter:
         (``t_arrive = t_ready + pages * unit``), the ledger moves the
         request to its decode replica (counted once — the source
         forgot it at export), and a timeline tick lands at the
-        delivery time so lanes advance to meet it. Candidates must
-        match the chain's PAGE GEOMETRY (the exported data is
-        page-shaped — a different page size cannot adopt it; a
-        heterogeneous cluster simply narrows the candidate set) AND
-        its TENSOR-PARALLEL degree (a head-sharded chain scatters
-        only into a pool split over the same mesh width), and fit the
-        request's footprint. A handoff no admitting decode-capable
-        replica can take is recorded FAILED — accounted, never
-        silently dropped."""
+        delivery time so lanes advance to meet it. Candidates are no
+        longer FILTERED on tp degree / page geometry / codec — each
+        admitting, footprint-fitting replica is SCORED by the priced
+        cost of the reshard/repage/transcode steps its import would
+        run (``handoff_steps`` verdict + ``handoff_price``), and the
+        placement policy breaks ties among prices; a twin prices 0.0
+        so homogeneous fleets place identically to the old filters.
+        Only a genuinely untransformable pairing (quantized source
+        under a different codec, pressure across page geometries) or
+        a footprint miss drops a candidate. An UNSTAMPED handoff
+        (page_size/tp never filled in by the exporter) refuses loudly
+        — scoring garbage geometry would mis-price every candidate. A
+        handoff no admitting decode-capable replica can take is
+        recorded FAILED — accounted, never silently dropped."""
         for rep in list(self.replicas):
             if not rep.session.handoff_ready:
                 continue
@@ -986,28 +1011,38 @@ class ClusterRouter:
                 rid = h.req.rid
                 led = self.ledger[rid]
                 led["handoffs"] = led.get("handoffs", 0) + 1
-                cands = [x for x in self.replicas
-                         if x.admitting
-                         and x.session.eng.page_size == h.page_size
-                         and getattr(x.session.eng, "tp_size", 1)
-                         == h.tp
-                         # the exported page data is TIER-shaped —
-                         # int8 scales / pressure dual-arena slices
-                         # scatter only into a pool of the same
-                         # kv_quant mode (filters like page_size/tp)
-                         and getattr(x.session.eng, "kv_quant", None)
-                         == h.kv_quant
-                         and self._rep_fits(
-                             x, len(h.req.prompt),
-                             h.req.max_new_tokens)]
+                if h.page_size <= 0 or h.tp <= 0:
+                    raise UnstampedHandoffError(h)
+                cands, prices, axes = [], {}, {}
+                for x in self.replicas:
+                    if not (x.admitting and self._rep_fits(
+                            x, len(h.req.prompt),
+                            h.req.max_new_tokens)):
+                        continue
+                    steps = x.session.eng.handoff_steps(h)
+                    if steps is None:
+                        continue
+                    cands.append(x)
+                    prices[x.name] = x.session.eng.handoff_price(
+                        h, steps)
+                    axes[x.name] = steps
                 pd = getattr(self.placement, "place_decode", None)
-                dest = pd(h, cands) if pd is not None \
-                    else _place_decode(h, cands)
+                if pd is None:
+                    dest = _place_decode(h, cands, prices)
+                else:
+                    try:
+                        dest = pd(h, cands, prices)
+                    except TypeError:
+                        # a pre-hetero custom policy takes (h, cands)
+                        dest = pd(h, cands)
                 if dest is None:
                     self._handoff["failed"] += 1
                     self.failed[rid] = (
                         "no admitting decode-capable replica can "
-                        "adopt the handed-off KV chain")
+                        "adopt the handed-off KV chain (every "
+                        "candidate is full, untransformable from "
+                        "the chain's codec, or too small for its "
+                        "footprint)")
                     self.events_log.append(
                         {"t": round(h.t_ready, 6),
                          "event": "handoff_failed", "rid": rid})
@@ -1036,16 +1071,26 @@ class ClusterRouter:
                 dest.session.submit_handoff(h)
                 led["replica"] = dest.name
                 led["path"].append(dest.name)
-                self.events_log.append(
-                    {"t": round(h.t_ready, 6), "event": "handoff",
-                     "rid": rid, "from": h.replica_from,
-                     "to": dest.name, "pages": h.n_pages,
-                     "arrive": round(h.t_arrive, 6)})
+                ev = {"t": round(h.t_ready, 6), "event": "handoff",
+                      "rid": rid, "from": h.replica_from,
+                      "to": dest.name, "pages": h.n_pages,
+                      "arrive": round(h.t_arrive, 6)}
+                # transform/price keys appear ONLY when the chosen
+                # destination will actually run steps — twin-fleet
+                # event streams stay byte-identical to pre-hetero
+                steps = axes.get(dest.name) or ()
+                if steps:
+                    ev["transform"] = list(steps)
+                    ev["price"] = round(prices[dest.name], 6)
+                self.events_log.append(ev)
                 if self._tracer is not None:
+                    extra = ({"transform": ",".join(steps),
+                              "price": round(prices[dest.name], 6)}
+                             if steps else {})
                     self._tracer.instant(
                         "handoff", t=h.t_ready, track="cluster",
                         rid=rid, pages=h.n_pages, to=dest.name,
-                        **{"from": h.replica_from})
+                        **{"from": h.replica_from}, **extra)
                 self._push(h.t_arrive, 4, ("ht",))
                 self._g_load("cluster_replica_load",
                              "queued + in-flight requests on a "
@@ -1679,6 +1724,12 @@ class ClusterRouter:
                     obs_trace.deactivate()
         if self._tracer is not None and isinstance(spec, str):
             self._tracer.export(spec)
+        ho = dict(self._handoff) if self._handoff["exported"] else {}
+        if ho and self._resharded:
+            # per-axis transform counts ride the handoff block only
+            # when an import actually resharded — twin results carry
+            # the same keys they always did
+            ho["resharded"] = dict(self._resharded)
         return ClusterResult(placement=self.placement.name,
                              results=self.results, ledger=self.ledger,
                              events=self.events_log,
@@ -1690,9 +1741,7 @@ class ClusterRouter:
                                       or any(led.get("retries")
                                              for led in
                                              self.ledger.values())),
-                             handoffs=(dict(self._handoff)
-                                       if self._handoff["exported"]
-                                       else {}),
+                             handoffs=ho,
                              incidents=(list(self.slo_log.incidents)
                                         if self.slo_log is not None
                                         else None),

@@ -59,7 +59,8 @@ from ..models.nlp.llama_decode import (as_grammar_config,
                                        as_lora_config,
                                        as_spec_config, as_tp_config,
                                        llama_serving_decode_factory,
-                                       route_decode,
+                                       repage_kv_data, route_decode,
+                                       transcode_kv_data,
                                        tree_device_bytes)
 from ..ops.pallas.paged_attention import PagedKVCache
 from .adapters import AdapterCache, AdapterStore
@@ -191,6 +192,27 @@ class DecodeError(RuntimeError):
 
     def __init__(self, rid: str, msg: Optional[str] = None):
         super().__init__(msg or f"decode failed for row {rid!r}")
+        self.rid = rid
+
+
+class UnstampedHandoffError(ValueError):
+    """A ``KVHandoff`` reached placement or import WITHOUT its source
+    geometry stamped (``page_size``/``tp`` at their vacuous dataclass
+    defaults). Every exporter stamps real geometry + codec
+    (``_handoff_sink``); an unstamped handoff means hand-built plumbing
+    skipped it, and silently matching it against candidates would
+    either transform against garbage or — the pre-hetero failure —
+    match nothing and quietly fail every request. Refuse loudly
+    instead."""
+
+    def __init__(self, h, msg: Optional[str] = None):
+        rid = getattr(getattr(h, "req", None), "rid", None)
+        super().__init__(msg or (
+            f"handoff {rid!r} is unstamped (page_size="
+            f"{getattr(h, 'page_size', None)!r}, "
+            f"tp={getattr(h, 'tp', None)!r}) — the exporter must "
+            "stamp real source geometry/tp/codec before a handoff "
+            "can be placed or imported"))
         self.rid = rid
 
 
@@ -642,7 +664,17 @@ class KVHandoff:
     (PR-7 move-not-duplicate discipline): the source forgets it, the
     destination re-records it, and the cluster census counts it
     exactly once. ``t_arrive`` is stamped by the router:
-    ``t_ready + n_pages * kv_transfer_unit`` on the shared timeline."""
+    ``t_ready + n_pages * kv_transfer_unit`` on the shared timeline.
+
+    ``page_size``/``tp``/``kv_quant`` describe the SOURCE layout of
+    ``kv_data``. Since the hetero PR they are no longer placement
+    FILTERS: a destination whose geometry/mesh/codec differ runs the
+    priced ``kv_reshard``/``kv_repage``/``kv_transcode`` transform
+    steps at import (``ServingEngine.handoff_steps`` names which, and
+    which pairings still refuse), mutating these stamps to the
+    destination's values as each step lands. An exporter that leaves
+    them at the vacuous defaults gets an ``UnstampedHandoffError`` at
+    placement/import — loudly, never a silent match-nothing."""
 
     req: Request
     first_tok: int
@@ -654,18 +686,29 @@ class KVHandoff:
     t_ready: float
     replica_from: Optional[str] = None
     t_arrive: float = 0.0             # router-stamped delivery time
-    page_size: int = 0                # source page geometry — an
-    # importer with a different page size cannot adopt this chain
-    # (the exported data is page-shaped), so placement filters on it
+    page_size: int = 0                # source page geometry; a
+    # destination on a different geometry re-pages the chain at import
+    # (priced kv_repage). 0 = unstamped -> UnstampedHandoffError.
     tp: int = 1                       # source tensor-parallel degree:
-    # exported page content is head-sharded over the source mesh, so
-    # only a decode worker on the SAME tp degree can scatter it into
-    # its pool — disaggregated placement filters on it like page_size
+    # exported page content is head-sharded over the source mesh; a
+    # destination on a different mesh width gathers the shards into
+    # the canonical layout at import (priced kv_reshard) and its
+    # scatter re-splits under its own pool sharding
     kv_quant: Optional[str] = None    # source kv-quant mode: the
     # exported page data is tier-shaped ('pressure' chains carry the
-    # dual-arena slices + tier bits, 'int8' chains carry scales), so
-    # only a decode worker on the SAME mode can adopt — placement
-    # filters on it like page_size/tp
+    # dual-arena slices + tier bits, 'int8' chains carry scales). A
+    # full-precision chain transcodes to an int8/pressure destination
+    # at import (priced kv_transcode, scales + tier bits stamped);
+    # quantized sources only adopt same-codec (handoff_steps refuses
+    # the lossy/unliftable pairings)
+    layout: str = "head_major"        # canonical-layout descriptor of
+    # kv_data: "head_major" — every leaf page-indexed on axis 2 with
+    # the kv-head axis whole in the GLOBAL shape (the llama pools,
+    # sharded or not: kv_reshard gathers the shards into one host
+    # view of this same layout, so the descriptor survives every
+    # transform step); "tokens" — the sim's (n_pages, page_size)
+    # token rows. Transforms validate against it instead of guessing
+    # from array ranks.
     quant_pages: Tuple[int, ...] = () # chain positions (indices into
     # the exported chain, NOT pool page ids) that sat in the int8
     # tier at export — the importer mirrors them into its own
@@ -3430,6 +3473,108 @@ class ServingEngine:
         self._pools = jax.tree_util.tree_map(
             lambda a, d: a.at[:, :, idx].set(d), self._pools, data)
 
+    # --- heterogeneous handoffs: the reshard-on-import transform ----------
+    def handoff_steps(self, h: "KVHandoff"):
+        """Which priced transform steps THIS engine would run to adopt
+        ``h`` — the compatibility verdict that replaced the placement
+        filters. Returns ``()`` for a twin (adopt as-is, the
+        pre-hetero fast path, zero spans), an ordered tuple drawn from
+        ``("kv_reshard", "kv_repage", "kv_transcode")`` for a
+        transformable mismatch, or ``None`` for the pairings that
+        still refuse:
+
+        - a QUANTIZED source (int8 precision is unrecoverable → fp
+          refused; no tier bits to lift → pressure refused; int8
+          scales don't re-tier → the codec only adopts same-codec);
+        - a PRESSURE chain across page geometries (its per-page tier
+          bits have no token-resolution meaning, so a re-paged chain
+          could not say which arena each new page reads from).
+
+        Raises ``UnstampedHandoffError`` when the handoff never got
+        its source geometry stamped — loud, instead of the pre-hetero
+        silent match-nothing."""
+        if int(getattr(h, "page_size", 0)) <= 0 \
+                or int(getattr(h, "tp", 0)) <= 0:
+            raise UnstampedHandoffError(h)
+        steps = []
+        if h.tp != self.tp_size:
+            steps.append("kv_reshard")
+        if h.page_size != self.page_size:
+            if h.kv_quant == "pressure":
+                return None
+            steps.append("kv_repage")
+        if h.kv_quant != self.kv_quant:
+            if h.kv_quant is not None:
+                return None
+            steps.append("kv_transcode")
+        return tuple(steps)
+
+    def handoff_price(self, h: "KVHandoff", steps=None):
+        """Price the transform steps this engine would run to adopt
+        ``h``, in its OWN clock units — placement's scoring input.
+        Mirrors ``EngineClock``'s fixed arithmetic exactly (per-page
+        when the cost table carries a ``<kind>_unit`` entry, the flat
+        per-call default otherwise), so the score and the charge the
+        importer's clock will actually book can never disagree. The
+        router adds none of this to ``t_arrive``: delivery stays
+        ``kv_transfer``-priced, and the importer's clock charges the
+        transform spans when the import runs — one source of truth
+        per cost. ``None`` = untransformable."""
+        if steps is None:
+            steps = self.handoff_steps(h)
+        if steps is None:
+            return None
+        costs = self.fixed_costs or {}
+        n_dst = -(-len(h.req.prompt) // self.page_size)
+        total = 0.0
+        for kind in steps:
+            units = h.n_pages if kind == "kv_reshard" else n_dst
+            unit = costs.get(f"{kind}_unit")
+            total += float(unit) * units if unit is not None \
+                else float(costs.get(kind, 1.0))
+        return total
+
+    def reshard_kv_pages(self, data):
+        """The ``kv_reshard`` data plane: gather an exported chain
+        across the SOURCE mesh's kv-head shards into the canonical
+        head-major layout. A factory may override
+        (``reshard_kv_pages(data)`` — ``serving.sim``'s is the
+        identity, one host array has no shards); the default pulls
+        every leaf to a single host view (the cross-shard gather), and
+        the import scatter re-splits it under THIS engine's own pool
+        sharding (GSPMD does the distribution — the destination mesh
+        width never appears in the data plane)."""
+        fn = getattr(self.serving, "reshard_kv_pages", None)
+        if fn is not None:
+            return fn(data)
+        return jax.tree_util.tree_map(np.asarray, data)
+
+    def repage_kv_pages(self, data, page_size_from: int,
+                        n_tokens: int):
+        """The ``kv_repage`` data plane: refold an exported chain from
+        the source page geometry to THIS engine's. Factory hook
+        ``repage_kv_pages(data, ps_from, ps_to, n_tokens)`` when
+        provided (the sim's token rows), the llama head-major
+        arithmetic otherwise."""
+        fn = getattr(self.serving, "repage_kv_pages", None)
+        if fn is not None:
+            return fn(data, page_size_from, self.page_size, n_tokens)
+        return repage_kv_data(data, page_size_from, self.page_size,
+                              n_tokens)
+
+    def transcode_kv_pages(self, data, quant_from):
+        """The ``kv_transcode`` data plane: re-encode a full-precision
+        chain into THIS engine's codec (int8 scales / pressure arenas
+        + tier bits stamped). Factory hook
+        ``transcode_kv_pages(data, q_from, q_to)`` when provided (the
+        sim's lossless identity), the llama ``_q8`` codec otherwise —
+        the same codec the destination's own write path runs, so a
+        transcoded page is bit-identical to one written in place."""
+        fn = getattr(self.serving, "transcode_kv_pages", None)
+        if fn is not None:
+            return fn(data, quant_from, self.kv_quant)
+        return transcode_kv_data(data, quant_from, self.kv_quant)
+
     def _paged_chunk(self, book, clock, m, active, free_slots, slot_log,
                      outputs, tr=None, acache=None, spst=None,
                      ahst=None, gcache=None):
@@ -3951,6 +4096,10 @@ class EngineSession:
         self.handoff_ready: List[KVHandoff] = []
         self.import_queue: List[KVHandoff] = []
         self.handoff_stats = {"imported": 0, "reclaimed": 0}
+        # axis -> transform-step count ("tp"/"page"/"codec"); stays
+        # EMPTY on a twin fleet — the armed-only convention, folded
+        # into the router's census like handoff_stats
+        self.handoff_resharded: Dict[str, int] = {}
         self.clock = eng._make_clock(replica or "engine")
         self.tr = tracer
         self.slo = slo
@@ -4307,7 +4456,8 @@ class EngineSession:
             kv_data=data, n_cached=n_cached, t_admit=t_admit,
             t_first=t, t_ready=t, replica_from=self.replica,
             page_size=eng.page_size, tp=eng.tp_size,
-            kv_quant=eng.kv_quant, quant_pages=q_idx))
+            kv_quant=eng.kv_quant, quant_pages=q_idx,
+            layout=getattr(eng.serving, "kv_layout_", "head_major")))
         book.free(sid)
         eng._g_resident.set(float(len(book._refs)))
         if self.acache is not None and r.adapter is not None:
@@ -4346,6 +4496,67 @@ class EngineSession:
         slot is free."""
         self.import_queue.append(h)
 
+    def _transform_handoff(self, h: KVHandoff, steps):
+        """Run the priced reshard/repage/transcode steps on the
+        IMPORTER's clock — the ``adapter_upload`` discipline: each
+        step is one ``_timed`` span on the engine track (per-page
+        priced on a fixed clock via its ``<kind>_unit`` entry, flat
+        default otherwise), which the ledger funnel books as its own
+        first-class kind. Mutates the handoff's stamps in place as
+        each step lands, so every step's output is the next step's
+        honestly-described input and the downstream import/tier-mirror
+        code reads destination-true metadata."""
+        eng, clock, tr = self.eng, self.clock, self.tr
+        r = h.req
+        sid = r.rid
+        if "kv_reshard" in steps:
+            h.kv_data = eng._timed(
+                tr, clock, "kv_reshard",
+                lambda: eng.reshard_kv_pages(h.kv_data),
+                rid=sid, units=h.n_pages, tp_from=h.tp,
+                tp_to=eng.tp_size)
+            h.tp = eng.tp_size
+            self._note_reshard("tp")
+        if "kv_repage" in steps:
+            n_dst = -(-len(r.prompt) // eng.page_size)
+            ps_from = h.page_size
+            h.kv_data = eng._timed(
+                tr, clock, "kv_repage",
+                lambda: eng.repage_kv_pages(h.kv_data, ps_from,
+                                            len(r.prompt)),
+                rid=sid, units=n_dst, page_from=ps_from,
+                page_to=eng.page_size)
+            h.n_pages = n_dst
+            h.page_size = eng.page_size
+            self._note_reshard("page")
+        if "kv_transcode" in steps:
+            q_from = h.kv_quant
+            h.kv_data = eng._timed(
+                tr, clock, "kv_transcode",
+                lambda: eng.transcode_kv_pages(h.kv_data, q_from),
+                rid=sid, units=h.n_pages, codec_from=q_from or "fp",
+                codec_to=eng.kv_quant)
+            h.kv_quant = eng.kv_quant
+            if eng.kv_quant == "pressure":
+                # the transcode parked the WHOLE chain in the int8
+                # tier (tier bits all set); the chain positions ride
+                # quant_pages so the existing import mirror prices
+                # the adopted chain in this pool's byte census
+                h.quant_pages = tuple(range(h.n_pages))
+            self._note_reshard("codec")
+
+    def _note_reshard(self, axis: str):
+        """Account one transform step: the labeled counter is CREATED
+        on the first transform ever run (armed-only — a twin fleet's
+        registry stays byte-identical to pre-hetero) and the session
+        tally feeds the router's census fold at removal/bank time."""
+        obs_metrics.REGISTRY.counter(
+            "serving_handoff_resharded_total",
+            "KV handoffs transformed on import, by mismatch axis",
+            axis=axis).inc()
+        self.handoff_resharded[axis] = \
+            self.handoff_resharded.get(axis, 0) + 1
+
     def _import_handoffs(self) -> bool:
         """Adopt every deliverable handoff: allocate a fresh chain,
         scatter the exported page content into it, re-record the
@@ -4371,13 +4582,28 @@ class EngineSession:
             h = min(ready, key=lambda x: (x.t_arrive, x.req.rid))
             r = h.req
             sid = r.rid
-            if h.kv_quant != eng.kv_quant:
+            # the compatibility verdict (raises UnstampedHandoffError
+            # on a hand-built handoff that skipped the geometry
+            # stamps): () = twin, adopt as-is — the pre-hetero path
+            # bit-for-bit, zero transform spans
+            steps = eng.handoff_steps(h)
+            if steps is None:
                 raise RuntimeError(
                     f"handoff {sid!r} was exported under kv_quant="
-                    f"{h.kv_quant!r} but this decode worker runs "
-                    f"kv_quant={eng.kv_quant!r} — the page data is "
-                    "tier-shaped, so disaggregated placement must "
-                    "filter on kv_quant like page_size/tp")
+                    f"{h.kv_quant!r}/page_size={h.page_size} but this "
+                    f"decode worker runs kv_quant={eng.kv_quant!r}/"
+                    f"page_size={eng.page_size} — an untransformable "
+                    "pairing (quantized sources only adopt same-codec; "
+                    "pressure chains never re-page), so placement must "
+                    "refuse it like the geometry filters once did")
+            if steps and h.layout != getattr(eng.serving, "kv_layout_",
+                                             "head_major"):
+                raise RuntimeError(
+                    f"handoff {sid!r} carries canonical layout "
+                    f"{h.layout!r} but this worker's factory speaks "
+                    f"{getattr(eng.serving, 'kv_layout_', 'head_major')!r}"
+                    " — a transform cannot reinterpret a foreign "
+                    "layout (mixed sim/real fleets cannot exchange KV)")
             aslot, a_up = 0, False
             if r.adapter is not None:
                 if self.acache is None:
@@ -4453,6 +4679,12 @@ class EngineSession:
                 m.on_grammar(sid, gname, hit=not g_up)
             self.import_queue.remove(h)
             book.lengths[sid] = len(r.prompt)
+            if steps:
+                # priced on THIS clock only now — after the chain
+                # allocated, so a page-blocked import that retried
+                # across turns never charged for transforms it had to
+                # redo, and a twin import runs zero extra spans
+                self._transform_handoff(h, steps)
             eng.import_kv_pages(book.tables[sid][:h.n_pages],
                                 h.kv_data)
             if h.kv_quant == "pressure" and h.quant_pages:

@@ -63,6 +63,9 @@ class SimServing:
     """
 
     wants_numpy_ = True
+    # KVHandoff canonical-layout descriptor: exported chains are
+    # (n_pages, page_size) token rows, not head-major tensor leaves
+    kv_layout_ = "tokens"
 
     def __init__(self, *, max_len: int = 64, page_size: int = 8,
                  n_pool_pages: int | None = None, slots: int = 8,
@@ -530,6 +533,40 @@ class SimServing:
         (the importer's freshly allocated chain)."""
         pools[np.asarray(ids, np.int64)] = data
         return pools
+
+    # --- heterogeneous-handoff transforms (reshard-on-import) --------------
+    @staticmethod
+    def reshard_kv_pages(data):
+        """The sim's token pool is ONE host array whatever tp degree
+        it advertises (there are no heads to split), so gathering the
+        chain into the canonical layout is the identity — the PRICED
+        step still runs, which is exactly what the 10^5-scale hetero
+        bookkeeping needs."""
+        return data
+
+    @staticmethod
+    def repage_kv_pages(data, page_size_from, page_size_to, n_tokens):
+        """Refold an exported ``(n_pages, page_size_from)`` token
+        chain to the destination geometry: tokens are packed in chain
+        order, pad slots return to 0 (the pool padding value a direct
+        prefill leaves in its last page's slack)."""
+        n_to = -(-int(n_tokens) // int(page_size_to))
+        flat = np.asarray(data).reshape(-1)[:n_tokens]
+        out = np.zeros((n_to * int(page_size_to),), flat.dtype)
+        out[:n_tokens] = flat
+        return out.reshape(n_to, int(page_size_to))
+
+    @staticmethod
+    def transcode_kv_pages(data, quant_from, quant_to):
+        """Codec transcode, sim edition: int64 token content is
+        lossless under every codec, so the data is untouched — the
+        BOOKKEEPING (priced span, tier mirror via ``quant_pages``,
+        stored-bytes census) is what the engine exercises."""
+        if quant_from is not None:
+            raise ValueError(
+                f"transcode: source codec {quant_from!r} is not "
+                "transcodable (only full-precision chains re-encode)")
+        return data
 
     # --- the offline oracle -----------------------------------------------
     def expected_stream(self, prompt, n_tokens: int,

@@ -446,19 +446,24 @@ def test_disagg_cluster_real_model(srv_tiny_pair):
     assert outs == lone.outputs
 
 
-def test_handoff_refuses_mismatched_page_geometry():
-    """A decode replica with a DIFFERENT page size cannot adopt a
-    page-shaped KV chain: placement filters it out, and with no
-    geometry-compatible decode worker the handoff is recorded FAILED
-    — accounted exactly once, never a shape crash mid-replay."""
+def test_handoff_refuses_untransformable_codec():
+    """Page-geometry and tp mismatches now TRANSFORM on import (see
+    test_serving_hetero.py), but a QUANTIZED source chain under a
+    different destination codec stays genuinely untransformable
+    (dequantize-requantize would break the bit-identity contract):
+    placement scores it out, and with no codec-compatible decode
+    worker the handoff is recorded FAILED — accounted exactly once,
+    never a shape crash mid-replay."""
     def spawn(name):
-        if name == "r0":  # prefill: 8-token pages
-            return _sim_engine(2)
-        return ServingEngine(  # decode: 16-token pages
-            serving=make_sim_serving(max_len=96, page_size=16,
-                                     slots=8, vocab=VOCAB),
-            slots=8, policy="paged", clock="fixed", fixed_costs=COSTS,
-            decode_chunk=4, prefill_chunk_budget=2)
+        if name == "r0":  # prefill: int8-tiered pages
+            return ServingEngine(
+                serving=make_sim_serving(max_len=96, page_size=8,
+                                         slots=8, vocab=VOCAB,
+                                         kv_quant="int8"),
+                slots=8, policy="paged", clock="fixed",
+                fixed_costs=COSTS, decode_chunk=4,
+                prefill_chunk_budget=2)
+        return _sim_engine(2)  # decode: full-precision pool
     trace = [Request(rid=f"g{i}", arrival=float(i),
                      prompt=tuple(range(1, 10)), max_new_tokens=4)
              for i in range(3)]

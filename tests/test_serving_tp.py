@@ -398,11 +398,12 @@ def test_publish_exports_pool_bytes_gauge_only_when_sharded():
         not in reg1.expose_text()
 
 
-def test_handoff_refuses_mismatched_tp_degree():
-    """A decode worker on a DIFFERENT tp degree cannot adopt a
-    head-sharded chain: placement filters on the handoff's tp like
-    page geometry, and with no compatible decode worker the handoff
-    is accounted FAILED — never a silent wrong-shard import."""
+def test_handoff_reshards_mismatched_tp_degree():
+    """A decode worker on a DIFFERENT tp degree adopts a head-sharded
+    chain through the priced kv_reshard transform (PR 20): the import
+    gathers to the canonical layout on the importer's clock instead
+    of accounting the handoff FAILED — streams identical to a
+    same-degree fleet, census balanced with the tp axis counted."""
     def spawn(name):
         return _sim_cluster_engine(TPConfig((2,)) if name == "r0"
                                    else None)
@@ -413,9 +414,20 @@ def test_handoff_refuses_mismatched_tp_degree():
                         roles={"r0": "prefill", "r1": "decode"},
                         kv_transfer_unit=0.05).run(trace)
     cen = res.census()
-    assert cen["conserved"], cen  # failed IS accounted
-    assert cen["handoffs"]["failed"] == len(trace)
-    assert len(res.failed) == len(trace)
+    assert cen["conserved"], cen
+    assert cen["handoffs"]["failed"] == 0
+    assert cen["handoffs"]["imported"] == len(trace)
+    assert res.handoffs.get("resharded", {}).get("tp") == len(trace)
+    twin = ClusterRouter(
+        lambda name: _sim_cluster_engine(None), 2,
+        placement="disaggregated",
+        roles={"r0": "prefill", "r1": "decode"},
+        kv_transfer_unit=0.05).run(trace)
+    tokens = lambda r: sorted(  # noqa: E731
+        (rid, tuple(toks))
+        for res_ in r.results.values()
+        for rid, toks in res_.outputs.items())
+    assert tokens(res) == tokens(twin)
 
 
 # --- trace_report tp rows ---------------------------------------------------

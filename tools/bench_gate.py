@@ -82,6 +82,12 @@ cannot silently ship a slower build. Three modes:
       #    lane and both cluster arms, and the disaggregated
       #    cluster's KV-handoff census balanced (every exported chain
       #    imported or reclaimed exactly once).
+      #  - serving_hetero (tools/serving_workload_bench.py --hetero):
+      #    wide-fp-prefill -> narrow-int8-decode streams token-
+      #    identical to the twin fleet, both censuses balanced with
+      #    zero failed, the hetero arm resharded on both the page
+      #    AND codec axes while the twin arm resharded on none, and
+      #    hetero completions >= twin.
       #  - serving_autoscale (tools/serving_workload_bench.py
       #    --autoscale): on the diurnal and flash-crowd traces, the
       #    autoscaled fleet's goodput must be >= a static fleet sized
@@ -735,6 +741,112 @@ def check_serving_disagg(rows: list) -> int:
                          "stalling first tokens")
     print(json.dumps(rec))
     return 0 if rec["gate"] == "pass" else 1
+
+
+def check_serving_hetero(rows: list) -> int:
+    """Gate the heterogeneous-fleet rows from
+    serving_workload_bench.py --hetero: the wide-fp-prefill ->
+    narrow-int8-decode cluster's greedy streams must be
+    token-identical to the twin (equal-geometry) fleet's on the same
+    trace, BOTH handoff censuses must balance with ZERO failed (a
+    transform that drops chains is not a transform), the hetero arm
+    must actually reshard on BOTH mismatch axes (page geometry AND
+    codec — a hetero gate that transformed nothing gates nothing)
+    while the twin arm resharded on NONE (the absence regression:
+    equal-geometry imports must never open a transform span), and
+    the hetero fleet must complete no fewer requests than the twin
+    fleet. The twin arm is the baseline re-measured in the same run
+    — no stamped file."""
+    hr = [r for r in rows if r.get("bench") == "serving_hetero"]
+    by = {r.get("arm"): r for r in hr}
+    tw, he = by.get("twin"), by.get("hetero")
+    if tw is None or he is None:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "serving_hetero rows need BOTH a "
+                                    "twin and a hetero arm (run "
+                                    "tools/serving_workload_bench.py "
+                                    "--hetero)"}))
+        return 1
+    summaries = [r for r in rows
+                 if r.get("bench") == "serving_hetero_summary"]
+    if not summaries:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "no serving_hetero_summary row — "
+                                    "hetero-vs-twin token parity is "
+                                    "UNVERIFIED (rerun the --hetero "
+                                    "arm end to end)"}))
+        return 1
+    s = summaries[-1]
+    if s.get("outputs_match") is not True:
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "the heterogeneous fleet produced "
+                                    "DIVERGING greedy tokens vs the "
+                                    "twin fleet on the same trace — "
+                                    "a reshard/repage/transcode step "
+                                    "is corrupting chains"}))
+        return 1
+    for r in (tw, he):
+        if r.get("conserved") is not True \
+                or r.get("pool_census_ok") is not True:
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": "cluster census broken: conserved="
+                          f"{r.get('conserved')} pool_census_ok="
+                          f"{r.get('pool_census_ok')}"}))
+            return 1
+        ho = r.get("handoffs") or {}
+        if not int(ho.get("exported") or 0) \
+                or ho.get("balanced") is not True \
+                or int(ho.get("failed") or 0):
+            print(json.dumps({
+                "gate": "FAIL", "arm": r.get("arm"),
+                "reason": f"KV handoff census: exported="
+                          f"{ho.get('exported')} balanced="
+                          f"{ho.get('balanced')} failed="
+                          f"{ho.get('failed')} — every exported "
+                          "chain must be imported or reclaimed "
+                          "exactly once, at least one must have "
+                          "moved, and none may fail",
+                "handoffs": ho}))
+            return 1
+    het_rs = he.get("resharded") or {}
+    if not (int(het_rs.get("page") or 0)
+            and int(het_rs.get("codec") or 0)):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "the hetero arm resharded "
+                                    f"{het_rs} — a heterogeneous "
+                                    "fleet that never ran a "
+                                    "kv_repage AND a kv_transcode "
+                                    "transform gated nothing"}))
+        return 1
+    if tw.get("resharded"):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": "the TWIN arm resharded "
+                                    f"{tw.get('resharded')} — "
+                                    "equal-geometry imports must "
+                                    "never open a transform span "
+                                    "(the absence regression)"}))
+        return 1
+    if int(he.get("completed") or 0) < int(tw.get("completed") or 0):
+        print(json.dumps({"gate": "FAIL",
+                          "reason": f"hetero completed "
+                                    f"{he.get('completed')} requests "
+                                    f"vs the twin fleet's "
+                                    f"{tw.get('completed')} — priced "
+                                    "transforms must trade latency, "
+                                    "not completions"}))
+        return 1
+    rec = {
+        "gate": "pass",
+        "hetero_resharded": het_rs,
+        "hetero_transform_price": he.get("transform_price_total"),
+        "twin_completed": tw.get("completed"),
+        "hetero_completed": he.get("completed"),
+        "handoffs": he.get("handoffs"),
+        "device": he.get("device", "?"),
+    }
+    print(json.dumps(rec))
+    return 0
 
 
 RAGGED_TTFT_FLOOR = 2.0    # burst-cohort TTFT p95 improvement floor
@@ -2099,6 +2211,9 @@ def check_serving(rows: list, last: dict | None, stamp: bool) -> int:
     if any(r.get("bench", "").startswith("serving_disagg")
            for r in rows):
         fam_rcs["disagg"] = check_serving_disagg(rows)
+    if any(r.get("bench", "").startswith("serving_hetero")
+           for r in rows):
+        fam_rcs["hetero"] = check_serving_hetero(rows)
     if any(r.get("bench", "").startswith("serving_ragged")
            for r in rows):
         fam_rcs["ragged"] = check_serving_ragged(rows)

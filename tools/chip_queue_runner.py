@@ -103,6 +103,18 @@ QUEUE = [
     ("serving_disagg",
      [sys.executable, "tools/serving_workload_bench.py", "--disagg"],
      {}),
+    # PR-20 addition: the heterogeneous-fleet arm — the prefill-heavy
+    # burst trace through a twin disaggregated cluster vs wide
+    # full-precision prefill workers handing off to narrow int8
+    # decode workers of a different page geometry (reshard-on-import:
+    # priced kv_repage/kv_transcode transforms on the destination
+    # clock); bench_gate.py serving gates the serving_hetero family
+    # (token parity vs the twin fleet, both censuses balanced with
+    # zero failed, hetero resharded on both axes / twin on none,
+    # completions >= twin)
+    ("serving_hetero",
+     [sys.executable, "tools/serving_workload_bench.py", "--hetero"],
+     {}),
     # PR-10 addition: the tensor-parallel sharded-serving arm — the
     # mixed trace through the real factory at TP=1 vs TP=2/TP=4
     # (decode weights + paged KV pool NamedSharding-split over a named

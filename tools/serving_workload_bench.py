@@ -500,6 +500,114 @@ def _disagg_arm(args):
     return 0
 
 
+def _hetero_arm(args):
+    """The heterogeneous-fleet arm: the seeded PREFILL-HEAVY burst
+    trace replayed on the fixed unit-cost clock through two
+    disaggregated sim clusters —
+
+    1. TWIN: 2 prefill + 2 decode workers of identical geometry
+       (page_size=8, full-precision pools) — the fleet every config
+       before reshard-on-import HAD to run, because placement refused
+       any tp/page/codec mismatch; and
+    2. HETERO: the same 2 wide full-precision prefill workers
+       (page_size=8) handing off to 2 NARROW int8 decode workers
+       (page_size=16) — each import runs the priced
+       ``kv_repage``/``kv_transcode`` transforms on the destination
+       clock (the sim's token pool is lossless, so greedy streams
+       stay token-identical while the cluster machinery — pricing,
+       census, per-axis counters — runs for real).
+
+    One `serving_hetero` row per arm (handoff census + per-axis
+    resharded counts + transform price totals) and one
+    `serving_hetero_summary` row. `bench_gate.py serving` gates the
+    serving_hetero family: token parity across arms, both censuses
+    balanced with zero failed, the hetero arm resharded on BOTH axes
+    while the twin arm resharded on NONE, and hetero completes no
+    fewer requests than the twin fleet."""
+    import json as _json
+
+    from paddle_tpu.serving import (ClusterRouter, ServingEngine,
+                                    make_sim_serving,
+                                    synthesize_prefill_heavy_trace)
+
+    def emit(rec):
+        print(_json.dumps(rec), flush=True)
+
+    VOCAB = 509
+    SLOTS, ML, CHUNK = 8, 96, 4
+    costs = {"prefill_unit": 1.0, "decode": 1.0,
+             "kv_repage_unit": 0.02, "kv_transcode_unit": 0.01}
+    budget = max(1, args.lane_budget)
+
+    def make_engine(page_size=8, kv_quant=None):
+        return ServingEngine(
+            serving=make_sim_serving(
+                max_len=ML, page_size=page_size, slots=SLOTS,
+                vocab=VOCAB, kv_quant=kv_quant,
+                n_pool_pages=SLOTS * (ML // page_size) + 1 + 16,
+                chunked_prefill=max(8, page_size)),
+            slots=SLOTS, policy="paged", clock="fixed",
+            fixed_costs=costs, decode_chunk=CHUNK,
+            prefill_chunk_budget=budget)
+
+    trace = synthesize_prefill_heavy_trace(
+        seed=args.seed, n_short=96, n_long=24, vocab_size=VOCAB)
+    roles = {"r0": "prefill", "r1": "prefill",
+             "r2": "decode", "r3": "decode"}
+
+    def spawn(name, hetero):
+        if hetero and roles.get(name) == "decode":
+            return make_engine(page_size=16, kv_quant="int8")
+        return make_engine()
+
+    rows, couts = {}, {}
+    for arm, hetero in (("twin", False), ("hetero", True)):
+        router = ClusterRouter(
+            lambda name: spawn(name, hetero), 4,
+            placement="disaggregated", roles=roles,
+            kv_transfer_unit=args.kv_transfer_unit)
+        cres = router.run(trace)
+        rep = cres.report()
+        cen = cres.census()
+        ho = cen.get("handoffs") or {}
+        rec = {"bench": "serving_hetero", "arm": arm,
+               "device": "sim", "seed": args.seed, "replicas": 4,
+               "decode_page_size": 16 if hetero else 8,
+               "decode_kv_quant": "int8" if hetero else None,
+               "kv_transfer_unit": args.kv_transfer_unit}
+        rec.update({k: rep.get(k) for k in
+                    ("completed", "tpot_p50", "tpot_p95", "ttft_p50",
+                     "ttft_p95", "makespan")})
+        rec["conserved"] = cen["conserved"]
+        rec["pool_census_ok"] = cen["pool_census_ok"]
+        rec["handoffs"] = ho
+        rec["resharded"] = ho.get("resharded", {})
+        rec["transform_price_total"] = round(
+            sum(e.get("price", 0.0) for e in cres.events
+                if e.get("event") == "handoff"), 6)
+        rows[arm] = rec
+        couts[arm] = cres.outputs()
+        emit(rec)
+
+    tw, he = rows["twin"], rows["hetero"]
+    emit({"bench": "serving_hetero_summary", "device": "sim",
+          "seed": args.seed, "requests": len(trace),
+          "outputs_match": bool(couts["twin"] == couts["hetero"]),
+          "census_balanced": bool(
+              (tw["handoffs"].get("balanced") is True)
+              and (he["handoffs"].get("balanced") is True)),
+          "handoffs_failed": int(tw["handoffs"].get("failed", 0)
+                                 + he["handoffs"].get("failed", 0)),
+          "twin_resharded": tw["resharded"],
+          "hetero_resharded": he["resharded"],
+          "twin_completed": tw.get("completed"),
+          "hetero_completed": he.get("completed"),
+          "hetero_transform_price": he["transform_price_total"],
+          "twin_transform_price": tw["transform_price_total"],
+          })
+    return 0
+
+
 def _ragged_arm(args):
     """The ragged batched-prefill arm: three seeded traces (mixed
     churn, prefill-heavy, ADMISSION-BURST — synchronized spikes, the
@@ -2479,6 +2587,19 @@ def main(argv=None):
                          "serving_disagg family (lane TPOT p95 >= "
                          "1.3x, TTFT p50 held, token parity, handoff "
                          "census balanced)")
+    ap.add_argument("--hetero", action="store_true",
+                    help="run the heterogeneous-fleet arm instead: "
+                         "the prefill-heavy burst trace through a "
+                         "twin disaggregated sim cluster vs wide "
+                         "full-precision prefill workers handing "
+                         "off to narrow int8 decode workers of a "
+                         "different page geometry (reshard-on-"
+                         "import: priced kv_repage/kv_transcode "
+                         "transforms); bench_gate.py serving gates "
+                         "the serving_hetero family (token parity, "
+                         "balanced censuses, hetero resharded on "
+                         "both axes / twin on none, completed >= "
+                         "twin)")
     ap.add_argument("--tp", action="store_true",
                     help="run the tensor-parallel arm instead: the "
                          "mixed trace through the real tiny-llama "
@@ -2668,6 +2789,8 @@ def main(argv=None):
         return _cost_arm(args)
     if args.disagg:
         return _disagg_arm(args)
+    if args.hetero:
+        return _hetero_arm(args)
     if args.ragged:
         return _ragged_arm(args)
     if args.slo:

@@ -191,6 +191,27 @@ def handoff_hops(events: list) -> dict:
     return hops
 
 
+def reshard_summary(events: list) -> dict:
+    """Per-kind count + priced duration of the import-side handoff
+    transform spans (``kv_reshard`` / ``kv_repage`` /
+    ``kv_transcode`` complete spans on the worker clocks). Empty for
+    homogeneous fleets — no span ever opens there, so twin traces
+    summarize byte-identically (PR-5 absence convention)."""
+    out: dict = {}
+    for e in events:
+        name = e.get("name")
+        if e.get("ph") != "X" or name not in ("kv_reshard",
+                                              "kv_repage",
+                                              "kv_transcode"):
+            continue
+        row = out.setdefault(name, {"spans": 0, "units": 0.0})
+        row["spans"] += 1
+        row["units"] += float(e.get("dur", 0.0)) / 1e6
+    for row in out.values():
+        row["units"] = round(row["units"], 6)
+    return out
+
+
 def replica_roles(events: list) -> dict:
     """replica -> role from the router's ``role`` instants (emitted
     only for non-"both" replicas of a disaggregated cluster)."""
@@ -980,13 +1001,20 @@ def main(argv=None) -> int:
             print(json.dumps(co_row))
         kv_hops = handoff_hops(events)
         if kv_hops:
-            print(json.dumps({
+            ho_row = {
                 "bench": "trace_report_handoff",
                 "handoffs": sum(h["handoffs"]
                                 for h in kv_hops.values()),
                 "handed_off_requests": len(kv_hops),
                 "hops": {rid: h for rid, h
-                         in sorted(kv_hops.items())[:20]}}))
+                         in sorted(kv_hops.items())[:20]}}
+            rs = reshard_summary(events)
+            if rs:
+                # heterogeneous fleets only — twin traces never open
+                # a transform span, so their handoff row is
+                # byte-identical to pre-hetero output
+                ho_row["resharded"] = rs
+            print(json.dumps(ho_row))
         acts = autoscale_actions(events)
         if acts:
             # autoscaled traces only: absent otherwise, so
